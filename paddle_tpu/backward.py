@@ -1,0 +1,284 @@
+"""Static-graph autodiff: append_backward / gradients.
+
+Behavioral parity with /root/reference/python/paddle/fluid/backward.py
+(:1145 append_backward, :366 _addup_repetitive_outputs_, :448
+_remove_no_grad_branch_): walks the block in reverse, appends
+``<type>_grad`` ops, inserts ``sum`` ops where a forward var fans out to
+several consumers, and respects stop_gradient / no_grad_set.
+
+The grad ops themselves are the auto-VJP ops from the registry (or
+hand-registered customs), so unlike the reference there is no per-op C++
+GradOpMaker protocol to mirror — the maker here only decides *wiring*
+(which slots are bound), and shapes are copied from the forward vars
+instead of re-inferred.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from . import framework
+from .core.registry import GRAD_SUFFIX, OpInfoMap
+from .utils import unique_name
+
+
+def _find_op_path(block, loss_name: str, req: Set[str]) -> List[int]:
+    """Indices of ops that both (a) depend on a grad-requiring var and
+    (b) contribute to the loss."""
+    # forward reachability of req
+    contributes: Set[str] = set(req)
+    fwd_ops: Set[int] = set()
+    for i, op in enumerate(block.ops):
+        if any(n in contributes for n in op.input_arg_names):
+            fwd_ops.add(i)
+            contributes.update(op.output_arg_names)
+    # backward reachability from loss
+    needed: Set[str] = {loss_name}
+    path: List[int] = []
+    for i in reversed(range(len(block.ops))):
+        op = block.ops[i]
+        if i in fwd_ops and any(n in needed for n in op.output_arg_names):
+            path.append(i)
+            needed.update(op.input_arg_names)
+    return list(reversed(path))
+
+
+def _requires_grad_set(block, parameter_list=None, no_grad_set=None) -> Set[str]:
+    no_grad = set(no_grad_set or ())
+    req: Set[str] = set()
+    if parameter_list is not None:
+        for p in parameter_list:
+            name = p if isinstance(p, str) else p.name
+            if name not in no_grad:
+                req.add(name)
+    else:
+        for p in block.program.all_parameters():
+            if getattr(p, "trainable", True) and not p.stop_gradient \
+                    and p.name not in no_grad:
+                req.add(p.name)
+    # any non-stop-gradient var is a valid diff leaf too (matches
+    # reference: stop_gradient=False inputs get gradients)
+    for v in block.vars.values():
+        if not v.stop_gradient and v.name not in no_grad:
+            req.add(v.name)
+    return req
+
+
+def _ensure_grad_var(block, fwd_name: str, grad_name: str):
+    fwd = block._find_var_recursive(fwd_name)
+    if block.has_var_local(grad_name):
+        return block.vars[grad_name]
+    v = block.create_var(
+        name=grad_name,
+        shape=fwd.shape if fwd is not None else None,
+        dtype=fwd.dtype if fwd is not None else "float32",
+        persistable=False,
+        stop_gradient=True,
+    )
+    return v
+
+
+def append_backward(
+    loss,
+    parameter_list=None,
+    no_grad_set=None,
+    callbacks=None,
+    checkpoints=None,
+):
+    """Append grad ops computing d(loss)/d(var); returns
+    [(param, param_grad_var)] like the reference (backward.py:1145)."""
+    block = loss.block
+    program = block.program
+    program._appending_grad_times += 1
+
+    no_grad = set()
+    for b in program.blocks:
+        for v in b.vars.values():
+            if v.stop_gradient:
+                no_grad.add(v.name)
+    if no_grad_set:
+        no_grad |= {n if isinstance(n, str) else n.name for n in no_grad_set}
+
+    req = _requires_grad_set(block, parameter_list, no_grad)
+    # propagate requires-grad forward through the op list
+    diffable: Set[str] = set(req)
+    for op in block.ops:
+        info = _op_info(op.type)
+        if info is None or info.grad is None and not _has_grad_op(op.type):
+            continue
+        if any(n in diffable for n in op.input_arg_names):
+            for n in op.output_arg_names:
+                if n not in no_grad:
+                    diffable.add(n)
+
+    path = _find_op_path(block, loss.name, req)
+
+    # Seed d(loss)/d(loss) = 1
+    loss_grad_name = framework.grad_var_name(loss.name)
+    _ensure_grad_var(block, loss.name, loss_grad_name)
+    block.append_op(
+        "fill_constant",
+        inputs={},
+        outputs={"Out": loss_grad_name},
+        attrs={
+            "shape": list(loss.shape or ()),
+            "value": 1.0,
+            "dtype": _dtype_enum(loss.dtype),
+            "force_cpu": False,
+        },
+        infer_shape=False,
+    )
+
+    # pending grads per forward var (producers merge on arrival)
+    pending: Dict[str, List[str]] = {loss.name: [loss_grad_name]}
+    grad_to_var: Dict[str, str] = {loss_grad_name: loss.name}
+
+    def finalize(var_name: str) -> Optional[str]:
+        """Merge pending partial grads of var into canonical var@GRAD."""
+        glist = pending.get(var_name)
+        if not glist:
+            return None
+        canonical = framework.grad_var_name(var_name)
+        if len(glist) == 1 and glist[0] == canonical:
+            return canonical
+        _ensure_grad_var(block, var_name, canonical)
+        block.append_op(
+            "sum",
+            inputs={"X": list(glist)},
+            outputs={"Out": canonical},
+            infer_shape=False,
+        )
+        pending[var_name] = [canonical]
+        return canonical
+
+    for idx in reversed(path):
+        op = block.ops[idx]
+        info = _op_info(op.type)
+        if info is None:
+            continue
+        grad_type = op.type + "_grad"
+        if not OpInfoMap.instance().has(grad_type):
+            if info.grad is None:
+                # non-differentiable op: grads do not flow through
+                continue
+            if callable(info.grad):
+                info.grad(block, op, pending, finalize)
+                continue
+            continue
+        ginfo = OpInfoMap.instance().get(grad_type)
+
+        # which outputs have incoming grads?
+        out_grads = {}
+        has_grad = False
+        for slot in info.outputs:
+            names = op.output(slot.name)
+            if not names:
+                continue
+            gnames = []
+            for n in names:
+                g = finalize(n)
+                gnames.append(g if g is not None else "")
+                if g is not None:
+                    has_grad = True
+            if any(gnames):
+                out_grads[slot.name + GRAD_SUFFIX] = gnames
+        if not has_grad:
+            continue
+
+        # bind inputs: forward ins + out grads
+        g_inputs = {}
+        for slot in info.inputs:
+            names = op.input(slot.name)
+            if names:
+                g_inputs[slot.name] = list(names)
+        g_inputs.update(out_grads)
+        # some custom grad ops consume forward outputs too (slot name match)
+        for slot in ginfo.inputs:
+            if slot.name in g_inputs or slot.name.endswith(GRAD_SUFFIX):
+                continue
+            if slot.name in op.outputs:
+                g_inputs[slot.name] = list(op.outputs[slot.name])
+
+        # outputs: a fresh partial-grad name per diffable input var
+        g_outputs = {}
+        for slot in info.inputs:
+            names = op.input(slot.name)
+            if not names:
+                continue
+            gnames = []
+            bind = False
+            for n in names:
+                if n in diffable and n not in no_grad:
+                    if n in pending and pending[n]:
+                        gname = "%s%s@RENAME@%d" % (n, GRAD_SUFFIX, len(pending[n]))
+                    else:
+                        gname = framework.grad_var_name(n)
+                    _ensure_grad_var(block, n, gname)
+                    pending.setdefault(n, []).append(gname)
+                    grad_to_var[gname] = n
+                    gnames.append(gname)
+                    bind = True
+                else:
+                    gnames.append("")
+            if bind:
+                g_outputs[slot.name + GRAD_SUFFIX] = gnames
+
+        if not g_outputs:
+            continue
+
+        g_attrs = dict(op.attrs)
+        g_attrs["_fwd_op_id"] = op._id
+        block.append_op(grad_type, g_inputs, g_outputs, g_attrs,
+                        infer_shape=False)
+
+    # finalize leaves (parameters & data): merge their partial grads
+    params_and_grads = []
+    target_params = (
+        [p if isinstance(p, framework.Variable) else block.var(p)
+         for p in parameter_list]
+        if parameter_list is not None
+        else block.program.all_parameters()
+    )
+    for p in target_params:
+        g = finalize(p.name)
+        if g is None:
+            continue
+        params_and_grads.append((p, block.var(g)))
+    return params_and_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid.gradients (reference backward.py:1678): d(targets)/d(inputs)."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    assert len(targets) == 1, "multi-target gradients arrive with a later wave"
+    loss = targets[0]
+    block = loss.block
+    pre_names = {v.name for v in inputs}
+    append_backward(loss, parameter_list=[v.name for v in inputs]
+                    if all(isinstance(v, framework.Variable) for v in inputs)
+                    else None,
+                    no_grad_set=no_grad_set)
+    outs = []
+    for v in inputs:
+        gname = framework.grad_var_name(v.name)
+        outs.append(block.var(gname) if block.has_var(gname) else None)
+    return outs
+
+
+def _op_info(op_type):
+    try:
+        return OpInfoMap.instance().get(op_type)
+    except KeyError:
+        return None
+
+
+def _has_grad_op(op_type):
+    return OpInfoMap.instance().has(op_type + "_grad")
+
+
+def _dtype_enum(dtype):
+    from .core import dtypes as _dt
+
+    return _dt.dtype_to_enum(dtype)

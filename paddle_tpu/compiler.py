@@ -1,0 +1,87 @@
+"""CompiledProgram / BuildStrategy / ExecutionStrategy.
+
+Parity: /root/reference/python/paddle/fluid/compiler.py:87 (CompiledProgram,
+with_data_parallel :160) + details/build_strategy.h knobs. TPU-native
+semantics: ``with_data_parallel`` does NOT clone the graph per device with
+SSA all-reduce op-handles (the reference's ParallelExecutor); it marks the
+program for *mesh execution* — the whole-program trace is wrapped in
+shard_map over a 1-D device mesh with the batch dim sharded and gradients
+psum-ed where `c_allreduce`/loss-scaling ops appear (parallel/engine.py).
+BuildStrategy knobs that are XLA-automatic (op fusion, memory reuse,
+inplace) are accepted and ignored — the compiler does them.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = True
+
+
+class BuildStrategy:
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = (
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice)
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_all_optimizer_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = None
+        self.sync_batch_norm = False
+        self.enable_sequential_execution = False
+        self.remove_unnecessary_lock = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.nccl_comm_num = 1
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy: Optional[BuildStrategy] = None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._exec_strategy = None
+        self._places = None
+        self._share_vars_from = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._places = places
+        self._share_vars_from = share_vars_from
+        return self
+
+    # called by Executor.run
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        if not self._is_data_parallel:
+            return executor.run(self._program, feed=feed,
+                                fetch_list=fetch_list, scope=scope,
+                                return_numpy=return_numpy)
+        from .parallel.engine import run_data_parallel
+
+        return run_data_parallel(
+            executor._core, self._program, scope, feed, fetch_list,
+            loss_name=self._loss_name, places=self._places,
+            build_strategy=self._build_strategy, return_numpy=return_numpy)

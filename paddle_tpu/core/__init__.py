@@ -1,0 +1,29 @@
+"""Core runtime: places, dtypes, tensors, scopes, op registry, executors.
+
+This package is the TPU-native counterpart of the reference's C++
+``paddle/fluid/framework`` + ``platform`` + ``memory`` layers; memory and
+streams are owned by XLA/PJRT, so there is no allocator facade or device
+context pool to re-implement — see SURVEY.md §2.1/§2.4 for the mapping.
+"""
+from .place import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    is_cpu_place,
+    is_tpu_place,
+)
+from .scope import Scope, Variable, global_scope, scope_guard  # noqa: F401
+from .tensor import LoD, LoDTensor, LoDTensorArray, SelectedRows  # noqa: F401
+from .registry import (  # noqa: F401
+    In,
+    OpInfo,
+    OpInfoMap,
+    Out,
+    Slot,
+    register_host_op,
+    register_op,
+)
+from .executor_core import CoreExecutor  # noqa: F401
+from . import dtypes  # noqa: F401

@@ -1,0 +1,183 @@
+"""Whole-program compilation: trace a Block into ONE jitted XLA function.
+
+This is the TPU answer to the reference's op-by-op C++ executor hot loop
+(/root/reference/paddle/fluid/framework/executor.cc:449): instead of
+dispatching ~hundreds of kernels per step through an interpreter, the
+whole (feed → fetch) block is traced once into a single XLA program —
+fused, laid out for the MXU, with parameter/optimizer-state buffers
+DONATED so updates are in-place in HBM. Repeat steps are one dispatch.
+
+Semantics preserved vs the interpreter:
+- program order == trace order; same-name rebinding == SSA env update,
+  so in-place contracts (ParamOut==Param) hold via donation;
+- stateful RNG ops get a per-op stream folded from a step seed that the
+  host advances each run (no recompilation, masks vary per step);
+- persistable vars (params, optimizer state, BN running stats) round-trip
+  scope -> device args -> scope.
+
+Programs containing host ops / LoD-dependent ops fall back to the
+interpreter (executor_core.py) — the same duality the build plan calls
+for (SURVEY.md §7 step 3).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Set, Tuple
+
+import numpy as np
+
+from .registry import BOUND_OUTPUTS_ATTR, RNG_SEED_ATTR, OpInfoMap
+from .scope import Scope
+from .tensor import LoDTensor
+
+_cache: Dict = {}
+
+
+def _program_version(program) -> Tuple:
+    return (id(program), program._op_id,
+            tuple(len(b.ops) for b in program.blocks))
+
+
+def _analyze(program):
+    """Read-before-write set R (external inputs) and written set W."""
+    written: Set[str] = set()
+    read_first: Set[str] = set()
+    for op in program.global_block().ops:
+        for n in op.input_arg_names:
+            if n and n not in written:
+                read_first.add(n)
+        for n in op.output_arg_names:
+            if n:
+                written.add(n)
+    return read_first, written
+
+
+def _op_seed(step_seed, op_id: int):
+    import jax.numpy as jnp
+
+    return (step_seed * jnp.uint32(1000003)
+            + jnp.uint32((op_id * 131) & 0xFFFFFFFF))
+
+
+def _trace_block(block, env: Dict, step_seed) -> None:
+    infos = OpInfoMap.instance()
+    for op in block.ops:
+        info = infos.get(op.type)
+        ins = {}
+        for slot in info.inputs:
+            names = op.input(slot.name)
+            if not names:
+                ins[slot.name] = None
+                continue
+            vals = [env.get(n) for n in names]
+            ins[slot.name] = vals if slot.duplicable else vals[0]
+        attrs = dict(op.attrs)
+        attrs[BOUND_OUTPUTS_ATTR] = tuple(
+            s.name for s in info.outputs if op.output(s.name)
+        )
+        if info.needs_rng:
+            if attrs.get("seed", 0):
+                import jax.numpy as jnp
+
+                ins[RNG_SEED_ATTR] = jnp.uint32(attrs["seed"])
+            else:
+                sid = attrs.get("_fwd_op_id", op._id or 0)
+                ins[RNG_SEED_ATTR] = _op_seed(step_seed, sid)
+        outs = info.fn(ins, attrs)
+        for slot in info.outputs:
+            names = op.output(slot.name)
+            if not names:
+                continue
+            o = outs.get(slot.name)
+            if o is None:
+                continue
+            vals = o if slot.duplicable else [o]
+            for n, v in zip(names, vals):
+                if n and v is not None:
+                    env[n] = v
+
+
+def compile_program(program, feed_names: Tuple[str, ...],
+                    fetch_names: Tuple[str, ...], state_names: Tuple[str, ...],
+                    out_state_names: Tuple[str, ...], donate: bool = True):
+    """Build (and cache) the jitted step function for this program."""
+    import jax
+
+    key = (_program_version(program), feed_names, fetch_names, state_names,
+           out_state_names)
+    fn = _cache.get(key)
+    if fn is not None:
+        return fn
+
+    block = program.global_block()
+
+    def step(state: Dict, feeds: Dict, step_seed):
+        env = dict(state)
+        env.update(feeds)
+        _trace_block(block, env, step_seed)
+        new_state = {n: env[n] for n in out_state_names if n in env}
+        fetches = [env[n] for n in fetch_names]
+        return fetches, new_state
+
+    fn = jax.jit(step, donate_argnums=(0,) if donate else ())
+    _cache[key] = fn
+    return fn
+
+
+def run_compiled_program(core, program, scope: Scope, feed: Dict,
+                         fetch_list: Sequence, return_numpy: bool = True):
+    import jax
+    import jax.numpy as jnp
+
+    fetch_names = tuple(f if isinstance(f, str) else f.name
+                        for f in fetch_list)
+    feed_vals = {}
+    for name, value in feed.items():
+        if isinstance(value, LoDTensor):
+            if value.lod():
+                raise NotImplementedError("LoD feeds use the interpreter")
+            feed_vals[name] = value.array
+        else:
+            feed_vals[name] = jnp.asarray(np.asarray(value))
+    feed_names = tuple(sorted(feed_vals))
+
+    read_first, written = _analyze(program)
+    state_names = []
+    state = {}
+    for n in sorted(read_first - set(feed_names)):
+        var = scope.find_var(n)
+        if var is None or not var.is_initialized():
+            raise RuntimeError(
+                "variable %r must be fed or initialized in scope" % n)
+        h = var.raw()
+        if not isinstance(h, LoDTensor):
+            raise NotImplementedError("non-dense state %r" % n)
+        state[n] = h.array
+        state_names.append(n)
+    state_names = tuple(state_names)
+    # every written persistable (params from startup programs, optimizer
+    # state, BN running stats) must land back in the scope
+    block = program.global_block()
+    out_state_names = set(state_names)
+    for n in written:
+        v = block._find_var_recursive(n)
+        if v is not None and v.persistable:
+            out_state_names.add(n)
+    out_state_names = tuple(sorted(out_state_names))
+
+    fn = compile_program(program, feed_names, fetch_names, state_names,
+                         out_state_names)
+    with jax.default_device(core.place.jax_device()):
+        fetches, new_state = fn(state, feed_vals, jnp.uint32(
+            core.rng.next_seed(0) ^ (core.rng.step * 2654435761 & 0xFFFFFFFF)))
+    core.rng.advance()
+
+    for n, v in new_state.items():
+        var = scope.var(n)
+        t = var.get_tensor()
+        t._array = v
+    results = []
+    for name, v in zip(fetch_names, fetches):
+        var = scope.var(name)
+        var.get_tensor()._array = v
+        results.append(np.asarray(v) if return_numpy else var.get_tensor())
+    return results

@@ -1,0 +1,92 @@
+"""Dtype lattice shared by descs, tensors, and kernels.
+
+Mirrors the VarType.Type dtype enum of the reference proto IR
+(/root/reference/paddle/fluid/framework/framework.proto:104) but is backed
+directly by numpy/jax dtypes — there is no separate serialization enum
+since the IR here is Python-native.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# Canonical names (paddle spelling) -> numpy dtype
+_NAME2NP = {
+    "bool": np.dtype(np.bool_),
+    "int8": np.dtype(np.int8),
+    "uint8": np.dtype(np.uint8),
+    "int16": np.dtype(np.int16),
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "float16": np.dtype(np.float16),
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+}
+
+
+def _bfloat16():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+# VarType.Type enum values from the reference proto
+# (/root/reference/paddle/fluid/framework/framework.proto:104) — accepted
+# anywhere a dtype is taken, for attr-level compatibility.
+_ENUM2NAME = {
+    0: "bool",
+    1: "int16",
+    2: "int32",
+    3: "int64",
+    4: "float16",
+    5: "float32",
+    6: "float64",
+    20: "uint8",
+    21: "int8",
+    22: "bfloat16",
+}
+_NAME2ENUM = {v: k for k, v in _ENUM2NAME.items()}
+
+
+def dtype_to_enum(dtype) -> int:
+    return _NAME2ENUM[convert_dtype(dtype)]
+
+
+def convert_dtype(dtype) -> str:
+    """Normalise any dtype spelling to the canonical string name."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, (int, np.integer)) and not isinstance(dtype, (bool, np.bool_)):
+        return _ENUM2NAME[int(dtype)]
+    if isinstance(dtype, str):
+        name = dtype.lower()
+        if name in ("float", "fp32"):
+            name = "float32"
+        if name in ("double", "fp64"):
+            name = "float64"
+        if name in ("half", "fp16"):
+            name = "float16"
+        if name in ("bf16",):
+            name = "bfloat16"
+        if name == "bfloat16" or name in _NAME2NP:
+            return name
+        raise ValueError("unknown dtype %r" % (dtype,))
+    np_dtype = np.dtype(dtype) if not hasattr(dtype, "dtype") else np.dtype(dtype.dtype)
+    name = np_dtype.name
+    if name in _NAME2NP or name == "bfloat16":
+        return name
+    raise ValueError("unsupported dtype %r" % (dtype,))
+
+
+def to_numpy_dtype(dtype) -> np.dtype:
+    name = convert_dtype(dtype)
+    if name == "bfloat16":
+        return _bfloat16()
+    return _NAME2NP[name]
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")

@@ -1,0 +1,244 @@
+"""Op-by-op program interpreter (the fallback executor).
+
+Counterpart of the reference C++ Executor hot loop
+(/root/reference/paddle/fluid/framework/executor.cc:195,449: create ops
+from descs, ``for op in ops: op->Run(scope, place)``). TPU-native twists:
+
+- Each (op type, attrs) pair is jitted once and cached; jax's own aval
+  cache handles shape specialization. Kernels enqueue async on the device
+  — the host loop races ahead exactly like the reference's stream model.
+- Stateful RNG ops receive a traced uint32 seed derived from a host
+  counter, so repeated steps don't recompile and dropout masks vary.
+- Ops marked ``host_op`` (control flow, feed/fetch, prints) run on the
+  host against the Scope, possibly recursing into sub-blocks — the same
+  role the reference's OperatorBase (kernel-less) ops play.
+
+The preferred path for steady-state training is whole-program compilation
+(compiler_engine.py); this interpreter exists for arbitrary programs,
+debugging, and parity with Executor semantics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .registry import (
+    BOUND_OUTPUTS_ATTR,
+    LOD_ATTR_PREFIX,
+    RNG_SEED_ATTR,
+    OpInfoMap,
+)
+from .scope import Scope
+from .tensor import LoDTensor, LoDTensorArray, SelectedRows
+
+_jit_cache: Dict = {}
+
+
+def _canon(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _canon(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray):
+        return (v.dtype.str, v.shape, v.tobytes())
+    return v
+
+
+def _get_jitted(op_type: str, attrs: Dict):
+    import jax
+
+    key = (op_type, _canon(attrs))
+    fn = _jit_cache.get(key)
+    if fn is None:
+        info = OpInfoMap.instance().get(op_type)
+
+        def call(ins, _info=info, _attrs=dict(attrs)):
+            return _info.fn(ins, _attrs)
+
+        fn = jax.jit(call)
+        _jit_cache[key] = fn
+    return fn
+
+
+class RNGState:
+    """Host-side seed counter; folded per-op-id so every RNG op in a step
+    draws a distinct stream, and every step advances."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed or np.random.randint(1, 2**31 - 1)
+        self.step = 0
+
+    def next_seed(self, op_id: int) -> np.uint32:
+        s = np.uint32((self.seed * 1000003 + self.step * 8191 + op_id * 131) & 0xFFFFFFFF)
+        return s
+
+    def advance(self):
+        self.step += 1
+
+
+class CoreExecutor:
+    def __init__(self, place):
+        self.place = place
+        self.rng = RNGState()
+
+    # -- variable IO ------------------------------------------------------
+
+    def _read_var(self, scope: Scope, name: str):
+        if name in ("", "@EMPTY@"):
+            return None
+        var = scope.find_var(name)
+        if var is None or not var.is_initialized():
+            return None
+        h = var.raw()
+        if isinstance(h, LoDTensor):
+            return h.array
+        if isinstance(h, SelectedRows):
+            return h  # host ops deal with these directly
+        return h
+
+    def _write_var(self, scope: Scope, name: str, value, lod=None):
+        if name in ("", "@EMPTY@") or value is None:
+            return
+        var = scope.var(name)
+        if isinstance(value, (LoDTensor, SelectedRows, LoDTensorArray)):
+            var.set(value)
+            return
+        t = var.get_tensor() if isinstance(var.raw(), (LoDTensor, type(None))) else None
+        if t is None:
+            var.set(LoDTensor())
+            t = var.get_tensor()
+        t.set(value)
+        if lod is not None:
+            t._lod = [list(l) for l in lod]
+
+    # -- op execution -----------------------------------------------------
+
+    def run_op(self, op, scope: Scope):
+        info = OpInfoMap.instance().get(op.type)
+
+        if getattr(info, "host_fn", None) is not None:
+            info.host_fn(self, op, scope)
+            return
+
+        ins = {}
+        in_lods = {}
+        for slot in info.inputs:
+            names = op.input(slot.name)
+            if not names:
+                ins[slot.name] = None
+                continue
+            vals = [self._read_var(scope, n) for n in names]
+            if info.needs_lod:
+                lods = []
+                for n in names:
+                    v = scope.find_var(n)
+                    t = v.raw() if v else None
+                    lods.append(
+                        tuple(tuple(l) for l in t.lod())
+                        if isinstance(t, LoDTensor)
+                        else ()
+                    )
+                in_lods[slot.name] = tuple(lods)
+            ins[slot.name] = vals if slot.duplicable else vals[0]
+
+        attrs = dict(op.attrs)
+        attrs[BOUND_OUTPUTS_ATTR] = tuple(
+            s.name for s in info.outputs if op.output(s.name)
+        )
+        if info.needs_lod:
+            for k, v in in_lods.items():
+                attrs[LOD_ATTR_PREFIX + k] = v
+
+        fn = _get_jitted(op.type, attrs)
+        if info.needs_rng:
+            import jax.numpy as jnp
+
+            if attrs.get("seed", 0):
+                seed_val = np.uint32(attrs["seed"])
+            else:
+                # A grad op reuses its forward op's stream (attr set by
+                # backward.py) so e.g. dropout masks match fwd/bwd.
+                seed_id = attrs.get("_fwd_op_id", op._id or 0)
+                seed_val = self.rng.next_seed(seed_id)
+            ins = dict(ins)
+            ins[RNG_SEED_ATTR] = jnp.asarray(seed_val, dtype=jnp.uint32)
+
+        outs = fn(ins)
+
+        out_lods = self._infer_out_lods(info, op, in_lods, attrs)
+        for slot in info.outputs:
+            names = op.output(slot.name)
+            if not names:
+                continue
+            o = outs.get(slot.name)
+            if o is None:
+                continue
+            vals = o if slot.duplicable else [o]
+            for i, (n, v) in enumerate(zip(names, vals)):
+                lod = out_lods.get((slot.name, i))
+                self._write_var(scope, n, v, lod=lod)
+
+    def _infer_out_lods(self, info, op, in_lods, attrs):
+        out_lods: Dict = {}
+        if info.infer_lod is None:
+            return out_lods
+        if callable(info.infer_lod):
+            res = info.infer_lod(in_lods, attrs) or {}
+            for (slot, i), lod in res.items():
+                out_lods[(slot, i)] = lod
+            return out_lods
+        # "propagate": first input slot's lod flows to every output.
+        src = None
+        for slot in info.inputs:
+            lods = in_lods.get(slot.name)
+            if lods and lods[0]:
+                src = lods[0]
+                break
+        if src:
+            for slot in info.outputs:
+                for i in range(len(op.output(slot.name))):
+                    out_lods[(slot.name, i)] = src
+        return out_lods
+
+    # -- block / program --------------------------------------------------
+
+    def run_block(self, block, scope: Scope):
+        import jax
+
+        with jax.default_device(self.place.jax_device()):
+            for op in block.ops:
+                self.run_op(op, scope)
+
+    def run_program(
+        self,
+        program,
+        scope: Scope,
+        feed: Optional[Dict] = None,
+        fetch_list: Optional[Sequence] = None,
+        return_numpy: bool = True,
+    ):
+        feed = feed or {}
+        for name, value in feed.items():
+            if isinstance(value, LoDTensor):
+                self._write_var(scope, name, value)
+            else:
+                self._write_var(scope, name, np.asarray(value))
+
+        self.run_block(program.global_block(), scope)
+        self.rng.advance()
+
+        results = []
+        for f in fetch_list or []:
+            name = f if isinstance(f, str) else f.name
+            var = scope.find_var(name)
+            if var is None:
+                raise RuntimeError("fetch variable %r not produced" % name)
+            h = var.raw()
+            if isinstance(h, LoDTensor):
+                results.append(h.numpy() if return_numpy else h)
+            elif isinstance(h, SelectedRows):
+                results.append(np.asarray(h.to_dense()))
+            else:
+                results.append(h)
+        return results

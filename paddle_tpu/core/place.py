@@ -1,0 +1,115 @@
+"""Device places.
+
+TPU-native analogue of the reference's tagged place variant
+(/root/reference/paddle/fluid/platform/place.h). Instead of a C++ boost
+variant dispatched per kernel, a Place here simply selects the JAX device
+an op's arrays live on; XLA owns streams/layout so no DeviceContext pool
+is needed.
+"""
+from __future__ import annotations
+
+import functools
+
+
+class Place:
+    """Base place. Equality is (kind, device_id)."""
+
+    kind = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    # JAX integration -----------------------------------------------------
+    @property
+    def jax_platform(self) -> str:
+        raise NotImplementedError
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device (lazily; import-cheap)."""
+        import jax
+
+        devs = _devices_for_platform(self.jax_platform)
+        if not devs:
+            raise RuntimeError(
+                "No %s device available (jax backends: %s)"
+                % (self.jax_platform, [d.platform for d in jax.devices()])
+            )
+        return devs[self._device_id % len(devs)]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.kind == other.kind
+            and self._device_id == other._device_id
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self._device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self._device_id)
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_for_platform(platform: str):
+    import jax
+
+    if platform == "any_accelerator":
+        # Prefer the default backend's devices (TPU if present).
+        return tuple(jax.devices())
+    try:
+        return tuple(jax.devices(platform))
+    except RuntimeError:
+        return ()
+
+
+class CPUPlace(Place):
+    kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    @property
+    def jax_platform(self):
+        return "cpu"
+
+
+class TPUPlace(Place):
+    """The accelerator place. On hosts without a real TPU (unit tests on a
+
+    virtual CPU mesh) it resolves to the default JAX backend, so programs
+    written against TPUPlace run everywhere.
+    """
+
+    kind = "tpu"
+
+    @property
+    def jax_platform(self):
+        return "any_accelerator"
+
+
+# The reference exposes CUDAPlace; scripts being migrated may still name it.
+# It is an alias of the accelerator place here.
+CUDAPlace = TPUPlace
+
+
+class CUDAPinnedPlace(CPUPlace):
+    kind = "cpu_pinned"
+
+
+def is_cpu_place(p):
+    return isinstance(p, CPUPlace)
+
+
+def is_tpu_place(p):
+    return isinstance(p, TPUPlace)
+
+
+def _current_expected_place_default():
+    import jax
+
+    dev = jax.devices()[0]
+    return CPUPlace() if dev.platform == "cpu" else TPUPlace(0)

@@ -1,0 +1,371 @@
+"""Operator registry.
+
+TPU-native replacement for the reference's OpInfoMap / REGISTER_OPERATOR
+machinery (/root/reference/paddle/fluid/framework/op_info.h:124,
+op_registry.h:223). Key design differences, deliberately:
+
+- An op's "kernel" is ONE pure JAX function ``fn(ins, attrs) -> outs``.
+  There is no per-(place, layout, dtype, library) kernel table — XLA
+  compiles the same trace for every backend, which is the whole point of
+  building TPU-first.
+- Gradients default to an auto-generated VJP op: ``<type>_grad`` re-runs
+  the forward inside ``jax.vjp``. Under whole-program compilation XLA CSEs
+  the recomputed forward away; op-by-op it costs a rerun (the price of an
+  interpreter, same trade the reference makes with grad ops that re-read
+  forward inputs). Ops can override with a hand-written grad maker exactly
+  like the reference's GradOpMaker when the VJP route is wrong (RNG,
+  non-differentiable data paths) or when a fused backward kernel exists.
+- Shape inference defaults to ``jax.eval_shape`` over the same ``fn`` —
+  compile-time and runtime InferShape are one code path by construction
+  (the reference needs a dual InferShapeContext, shape_inference.h).
+
+LoD (variable-length metadata) travels host-side: the executor passes the
+input LoDs in ``attrs['_lod_<slot>']`` so sequence ops can lower to
+padded/masked dense compute, and declares output LoD via ``infer_lod``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# Reserved attr keys injected by executors (never serialized into descs):
+RNG_SEED_ATTR = "_rng_seed"  # traced uint32 scalar for stateful-RNG ops
+BOUND_OUTPUTS_ATTR = "_bound_outputs"  # tuple of output slots bound in desc
+LOD_ATTR_PREFIX = "_lod_"
+
+GRAD_SUFFIX = "@GRAD"
+
+
+class Slot:
+    """One named input/output slot of an op."""
+
+    __slots__ = ("name", "duplicable", "dispensable", "no_grad", "is_ref")
+
+    def __init__(self, name, duplicable=False, dispensable=False, no_grad=False,
+                 is_ref=False):
+        self.name = name
+        self.duplicable = duplicable  # slot holds a LIST of variables
+        self.dispensable = dispensable  # slot may be absent
+        self.no_grad = no_grad  # excluded from autodiff
+        self.is_ref = is_ref  # output aliases an input var (in-place, e.g. ParamOut)
+
+    def __repr__(self):
+        return "Slot(%s)" % self.name
+
+
+def In(name, **kw):
+    return Slot(name, **kw)
+
+
+def Out(name, **kw):
+    return Slot(name, **kw)
+
+
+class OpInfo:
+    def __init__(
+        self,
+        type: str,
+        fn: Callable,
+        inputs: Sequence[Slot],
+        outputs: Sequence[Slot],
+        attrs: Optional[Dict] = None,
+        grad: object = "auto",
+        infer_shape: Optional[Callable] = None,
+        infer_lod: object = "propagate",
+        needs_rng: bool = False,
+        needs_lod: bool = False,
+        side_effect: bool = False,
+        host_fn: Optional[Callable] = None,
+    ):
+        self.type = type
+        self.fn = fn
+        self.inputs = tuple(inputs)
+        self.outputs = tuple(outputs)
+        self.attrs = dict(attrs or {})
+        self.grad = grad  # "auto" | None | callable(op_desc, grad_ctx) -> [op_descs]
+        self.infer_shape = infer_shape
+        self.infer_lod = infer_lod  # "propagate" | None | callable
+        self.needs_rng = needs_rng
+        self.needs_lod = needs_lod
+        self.side_effect = side_effect  # never DCE'd / not pure (feed, fetch, prints)
+        self.host_fn = host_fn  # host-side impl(executor, op, scope); bypasses jit
+
+    def input_slot(self, name) -> Optional[Slot]:
+        for s in self.inputs:
+            if s.name == name:
+                return s
+        return None
+
+    def output_slot(self, name) -> Optional[Slot]:
+        for s in self.outputs:
+            if s.name == name:
+                return s
+        return None
+
+    @property
+    def has_kernel(self):
+        return self.fn is not None
+
+
+class OpInfoMap:
+    _instance: Optional["OpInfoMap"] = None
+
+    def __init__(self):
+        self._map: Dict[str, OpInfo] = {}
+
+    @classmethod
+    def instance(cls) -> "OpInfoMap":
+        if cls._instance is None:
+            cls._instance = OpInfoMap()
+        return cls._instance
+
+    def insert(self, info: OpInfo):
+        if info.type in self._map:
+            raise ValueError("op %r registered twice" % info.type)
+        self._map[info.type] = info
+
+    def get(self, type: str) -> OpInfo:
+        _ensure_ops_loaded()
+        info = self._map.get(type)
+        if info is None:
+            raise KeyError("operator %r is not registered" % type)
+        return info
+
+    def has(self, type: str) -> bool:
+        _ensure_ops_loaded()
+        return type in self._map
+
+    def all_op_types(self) -> List[str]:
+        _ensure_ops_loaded()
+        return sorted(self._map)
+
+
+_ops_loaded = False
+
+
+def _ensure_ops_loaded():
+    """Populate the registry on first lookup (the reference does this with
+    static initializers at .so load; we do it at first use)."""
+    global _ops_loaded
+    if not _ops_loaded:
+        _ops_loaded = True
+        from .. import ops as _ops  # noqa: F401  (imports register everything)
+
+
+def register_op(
+    type: str,
+    inputs: Sequence[Slot],
+    outputs: Sequence[Slot],
+    attrs: Optional[Dict] = None,
+    grad: object = "auto",
+    infer_shape: Optional[Callable] = None,
+    infer_lod: object = "propagate",
+    needs_rng: bool = False,
+    needs_lod: bool = False,
+    side_effect: bool = False,
+    host_fn: Optional[Callable] = None,
+):
+    """Decorator: register ``fn(ins, attrs) -> outs`` as an operator.
+
+    ``ins``/``outs`` are dicts keyed by slot name; duplicable slots map to
+    lists of arrays; unbound dispensable slots map to None. ``fn`` must be
+    pure & jax-traceable (host-side LoD values arrive as static attrs).
+    """
+
+    def deco(fn):
+        info = OpInfo(
+            type,
+            fn,
+            inputs,
+            outputs,
+            attrs,
+            grad=grad,
+            infer_shape=infer_shape,
+            infer_lod=infer_lod,
+            needs_rng=needs_rng,
+            needs_lod=needs_lod,
+            side_effect=side_effect,
+            host_fn=host_fn,
+        )
+        OpInfoMap.instance().insert(info)
+        _maybe_register_auto_grad(info)
+        return fn
+
+    return deco
+
+
+def register_host_op(type, inputs, outputs, attrs=None, infer_shape=None,
+                     grad=None):
+    """Register an op whose implementation runs on the host against the
+    Scope (control flow, feed/fetch, printing) — analogue of the
+    reference's kernel-less OperatorBase ops."""
+
+    def deco(host_fn):
+        info = OpInfo(
+            type,
+            None,
+            inputs,
+            outputs,
+            attrs,
+            grad=grad,
+            infer_shape=infer_shape,
+            infer_lod=None,
+            side_effect=True,
+            host_fn=host_fn,
+        )
+        OpInfoMap.instance().insert(info)
+        return host_fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Auto-VJP grad op
+# ---------------------------------------------------------------------------
+
+
+def _maybe_register_auto_grad(info: OpInfo):
+    if info.grad != "auto":
+        return
+    grad_type = info.type + "_grad"
+    if OpInfoMap.instance()._map.get(grad_type) is not None:
+        return
+
+    grad_inputs = [Slot(s.name, duplicable=s.duplicable, dispensable=True)
+                   for s in info.inputs]
+    # Forward outputs are made available too (some custom infer_lod/shape
+    # uses them); the VJP itself recomputes them.
+    grad_inputs += [
+        Slot(s.name + GRAD_SUFFIX, duplicable=s.duplicable, dispensable=True)
+        for s in info.outputs
+    ]
+    grad_outputs = [
+        Slot(s.name + GRAD_SUFFIX, duplicable=s.duplicable, dispensable=True)
+        for s in info.inputs
+    ]
+
+    def grad_fn(ins, attrs, _info=info):
+        return _vjp_grad_impl(_info, ins, attrs)
+
+    ginfo = OpInfo(
+        grad_type,
+        grad_fn,
+        grad_inputs,
+        grad_outputs,
+        attrs=dict(info.attrs),
+        grad=None,
+        infer_lod=None,
+        needs_rng=info.needs_rng,
+        needs_lod=info.needs_lod,
+    )
+    OpInfoMap.instance().insert(ginfo)
+
+
+def _is_float_arr(x):
+    import jax.numpy as jnp
+    import numpy as np
+
+    dt = np.dtype(x.dtype) if hasattr(x, "dtype") else np.dtype(type(x))
+    return jnp.issubdtype(dt, jnp.floating)
+
+
+def _vjp_grad_impl(info: OpInfo, ins: Dict, attrs: Dict):
+    """Generic backward: re-run ``info.fn`` under jax.vjp w.r.t. the
+    floating forward inputs whose ``<slot>@GRAD`` output is requested."""
+    import jax
+    import jax.numpy as jnp
+
+    bound = set(attrs.get(BOUND_OUTPUTS_ATTR) or ())
+
+    fwd_ins = {s.name: ins.get(s.name) for s in info.inputs}
+
+    # (slot, index_or_None) leaves we differentiate with respect to.
+    wrt: List[Tuple[str, Optional[int]]] = []
+    for s in info.inputs:
+        want = (not bound) or (s.name + GRAD_SUFFIX) in bound
+        if s.no_grad or not want:
+            continue
+        v = fwd_ins.get(s.name)
+        if v is None:
+            continue
+        if s.duplicable:
+            for i, x in enumerate(v):
+                if _is_float_arr(x):
+                    wrt.append((s.name, i))
+        elif _is_float_arr(v):
+            wrt.append((s.name, None))
+    if not wrt:
+        return {}
+
+    primals = [
+        fwd_ins[n] if i is None else fwd_ins[n][i] for (n, i) in wrt
+    ]
+
+    fwd_attrs = {
+        k: v
+        for k, v in attrs.items()
+        if k != BOUND_OUTPUTS_ATTR
+    }
+
+    def f(*diff_vals):
+        rebuilt = {}
+        for s in info.inputs:
+            v = fwd_ins.get(s.name)
+            rebuilt[s.name] = list(v) if s.duplicable and v is not None else v
+        for (n, i), val in zip(wrt, diff_vals):
+            if i is None:
+                rebuilt[n] = val
+            else:
+                rebuilt[n][i] = val
+        outs = info.fn(rebuilt, fwd_attrs)
+        flat = []
+        for s in info.outputs:
+            o = outs.get(s.name)
+            if o is None:
+                continue
+            flat.extend(o if s.duplicable else [o])
+        return tuple(flat)
+
+    out_vals, vjp = jax.vjp(f, *primals)
+
+    # Assemble cotangents aligned with f's flat outputs (declared order,
+    # skipping outputs fn didn't produce); missing @GRAD -> zeros. A probe
+    # run gives the slot->arity structure; XLA CSEs it with the vjp trace.
+    probe_ins = {
+        s.name: (list(fwd_ins[s.name]) if s.duplicable and fwd_ins.get(s.name)
+                 is not None else fwd_ins.get(s.name))
+        for s in info.inputs
+    }
+    probe = info.fn(probe_ins, fwd_attrs)
+    cots = []
+    k = 0
+    for s in info.outputs:
+        o = probe.get(s.name)
+        if o is None:
+            continue
+        g = ins.get(s.name + GRAD_SUFFIX)
+        if s.duplicable:
+            for j in range(len(o)):
+                if g is not None and g[j] is not None:
+                    cots.append(jnp.asarray(g[j], dtype=out_vals[k + j].dtype))
+                else:
+                    cots.append(jnp.zeros_like(out_vals[k + j]))
+            k += len(o)
+        else:
+            if g is None:
+                cots.append(jnp.zeros_like(out_vals[k]))
+            else:
+                cots.append(jnp.asarray(g, dtype=out_vals[k].dtype))
+            k += 1
+    grads = vjp(tuple(cots))
+
+    result: Dict[str, object] = {}
+    for (n, i), g in zip(wrt, grads):
+        key = n + GRAD_SUFFIX
+        slot = info.input_slot(n)
+        if slot.duplicable:
+            if key not in result:
+                result[key] = [None] * len(fwd_ins[n])
+            result[key][i] = g
+        else:
+            result[key] = g
+    return result

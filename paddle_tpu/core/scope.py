@@ -1,0 +1,123 @@
+"""Variable + Scope.
+
+Behavioral parity with the reference's type-erased variable holder and
+hierarchical scope (/root/reference/paddle/fluid/framework/variable.h:26,
+scope.h:46): FindVar walks parents, NewScope creates kids, DropKids frees
+them. Thread-safety is not needed — execution is single-threaded host code
+driving async XLA, which owns all device-side parallelism.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .tensor import LoDTensor, LoDTensorArray, SelectedRows
+
+
+class Variable:
+    """Type-erased holder; get() lazily default-constructs like the C++
+    Variable::GetMutable<T>()."""
+
+    __slots__ = ("_holder",)
+
+    def __init__(self):
+        self._holder = None
+
+    def is_initialized(self) -> bool:
+        return self._holder is not None
+
+    def get_tensor(self) -> LoDTensor:
+        if self._holder is None:
+            self._holder = LoDTensor()
+        if not isinstance(self._holder, LoDTensor):
+            raise TypeError("variable holds %s, not LoDTensor" % type(self._holder))
+        return self._holder
+
+    def get_selected_rows(self) -> SelectedRows:
+        if self._holder is None:
+            self._holder = SelectedRows()
+        if not isinstance(self._holder, SelectedRows):
+            raise TypeError("variable holds %s, not SelectedRows" % type(self._holder))
+        return self._holder
+
+    def get_lod_tensor_array(self) -> LoDTensorArray:
+        if self._holder is None:
+            self._holder = LoDTensorArray()
+        return self._holder
+
+    def set(self, holder):
+        self._holder = holder
+
+    def raw(self):
+        return self._holder
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Variable] = {}
+        self._parent = parent
+        self._kids: List[Scope] = []
+
+    # -- lookup -----------------------------------------------------------
+    def var(self, name: str) -> Variable:
+        """Find in this scope only, create if absent (C++ Scope::Var)."""
+        v = self._vars.get(name)
+        if v is None:
+            v = Variable()
+            self._vars[name] = v
+        return v
+
+    def find_var(self, name: str) -> Optional[Variable]:
+        v = self._vars.get(name)
+        if v is None and self._parent is not None:
+            return self._parent.find_var(name)
+        return v
+
+    def find_local_var(self, name: str) -> Optional[Variable]:
+        return self._vars.get(name)
+
+    def erase(self, name: str) -> None:
+        self._vars.pop(name, None)
+
+    def local_var_names(self) -> List[str]:
+        return list(self._vars)
+
+    # -- hierarchy --------------------------------------------------------
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self) -> None:
+        self._kids = []
+
+    def parent(self) -> Optional["Scope"]:
+        return self._parent
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class _ScopeGuard:
+    _stack: List[Scope] = []
+
+
+def scope_guard(scope: Scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        _ScopeGuard._stack.append(scope)
+        try:
+            yield
+        finally:
+            _ScopeGuard._stack.pop()
+
+    return _guard()
+
+
+def get_current_scope() -> Scope:
+    return _ScopeGuard._stack[-1] if _ScopeGuard._stack else _global_scope

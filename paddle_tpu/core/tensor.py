@@ -1,0 +1,170 @@
+"""Runtime tensor types.
+
+TPU-native counterparts of the reference runtime types:
+
+- ``LoDTensor`` (/root/reference/paddle/fluid/framework/lod_tensor.h:104):
+  here a thin host-side wrapper over a ``jax.Array``. The LoD (level of
+  detail — nested sequence offsets) stays *host metadata only*, because XLA
+  programs are static-shape: variable-length ops lower to padded/masked
+  dense compute and consult the LoD at trace time.
+- ``SelectedRows`` (/root/reference/paddle/fluid/framework/selected_rows.h:32):
+  sparse row-set gradients (embedding tables). Kept as (rows, values,
+  height); optimizers either scatter-apply them or densify via segment-sum.
+
+Unlike the reference there is no mutable_data/Resize protocol — arrays are
+immutable jax values and "mutation" is rebinding inside a Scope.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from . import dtypes
+
+LoD = List[List[int]]  # vector of offset vectors, like the reference's LoD
+
+
+def _check_lod(lod: LoD, first_dim: int) -> None:
+    for level in lod:
+        if len(level) < 1 or level[0] != 0:
+            raise ValueError("each LoD level must start with 0: %r" % (lod,))
+        if any(b > a for a, b in zip(level[1:], level[:-1])):
+            raise ValueError("LoD offsets must be non-decreasing: %r" % (lod,))
+    if lod and lod[-1][-1] != first_dim:
+        raise ValueError(
+            "last LoD level must end at dim0=%d, got %r" % (first_dim, lod)
+        )
+
+
+class LoDTensor:
+    """A dense device array plus optional host-side LoD metadata."""
+
+    __slots__ = ("_array", "_lod")
+
+    def __init__(self, array=None, lod: Optional[LoD] = None):
+        self._array = array
+        self._lod = [list(l) for l in lod] if lod else []
+
+    # -- array ------------------------------------------------------------
+    @property
+    def array(self):
+        return self._array
+
+    def set(self, value, place=None):
+        """Accept numpy/jax input; device placement is handled lazily by
+        jax (op execution commits arrays to the op's place)."""
+        import jax.numpy as jnp
+
+        if isinstance(value, np.ndarray):
+            self._array = jnp.asarray(value)
+        else:
+            self._array = value
+        return self
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- shape/dtype ------------------------------------------------------
+    def shape(self) -> Sequence[int]:
+        return tuple(self._array.shape) if self._array is not None else ()
+
+    def dtype(self) -> str:
+        return dtypes.convert_dtype(self._array.dtype) if self._array is not None else "float32"
+
+    def _is_initialized(self) -> bool:
+        return self._array is not None
+
+    # -- lod --------------------------------------------------------------
+    def lod(self) -> LoD:
+        return self._lod
+
+    def set_lod(self, lod: LoD):
+        if self._array is not None:
+            _check_lod(lod, int(self._array.shape[0]))
+        self._lod = [list(l) for l in lod]
+        return self
+
+    def recursive_sequence_lengths(self) -> List[List[int]]:
+        return [
+            [b - a for a, b in zip(level[:-1], level[1:])] for level in self._lod
+        ]
+
+    def set_recursive_sequence_lengths(self, lengths: List[List[int]]):
+        lod = []
+        for level in lengths:
+            offsets = [0]
+            for n in level:
+                offsets.append(offsets[-1] + int(n))
+            lod.append(offsets)
+        self._lod = lod
+        return self
+
+    def has_valid_recursive_sequence_lengths(self) -> bool:
+        try:
+            _check_lod(self._lod, int(self._array.shape[0]))
+            return True
+        except (ValueError, AttributeError):
+            return False
+
+    def __repr__(self):
+        return "LoDTensor(shape=%s, dtype=%s, lod=%s)" % (
+            self.shape(),
+            self.dtype(),
+            self._lod,
+        )
+
+
+class SelectedRows:
+    """Sparse row-set tensor: ``value[i]`` is the update for row ``rows[i]``
+    of a dense tensor with ``height`` rows."""
+
+    __slots__ = ("_rows", "_value", "_height")
+
+    def __init__(self, rows=None, height: int = 0, value=None):
+        self._rows = list(rows) if rows is not None else []
+        self._height = int(height)
+        self._value = value if value is not None else LoDTensor()
+
+    def rows(self):
+        return self._rows
+
+    def set_rows(self, rows):
+        self._rows = list(rows)
+
+    def height(self):
+        return self._height
+
+    def set_height(self, h):
+        self._height = int(h)
+
+    def get_tensor(self) -> LoDTensor:
+        return self._value
+
+    def to_dense(self):
+        """Densify via segment-sum (duplicate rows accumulate)."""
+        import jax.numpy as jnp
+
+        val = self._value.array
+        dense_shape = (self._height,) + tuple(val.shape[1:])
+        out = jnp.zeros(dense_shape, dtype=val.dtype)
+        idx = jnp.asarray(self._rows, dtype=jnp.int32)
+        return out.at[idx].add(val)
+
+    def __repr__(self):
+        return "SelectedRows(height=%d, rows=%s, value=%r)" % (
+            self._height,
+            self._rows[:8],
+            self._value,
+        )
+
+
+class LoDTensorArray(list):
+    """A growable list of LoDTensors (reference: vector<LoDTensor>), used by
+    while-loop bodies and fetch results."""
+
+    pass

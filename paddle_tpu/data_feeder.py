@@ -1,0 +1,92 @@
+"""DataFeeder — converts python samples into feed dicts.
+
+Parity: /root/reference/python/paddle/fluid/data_feeder.py.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from . import framework
+from .core import dtypes as _dt
+from .core.tensor import LoDTensor
+
+__all__ = ["DataFeeder", "convert_dtype", "check_variable_and_dtype"]
+
+convert_dtype = _dt.convert_dtype
+
+
+def check_variable_and_dtype(input, input_name, expected_dtype, op_name):
+    if not isinstance(input, framework.Variable):
+        raise TypeError(
+            "The input %s of %s must be Variable, got %s"
+            % (input_name, op_name, type(input)))
+    if _dt.convert_dtype(input.dtype) not in expected_dtype:
+        raise TypeError(
+            "The dtype of %s of %s must be one of %s, got %s"
+            % (input_name, op_name, expected_dtype, input.dtype))
+
+
+def check_type(input, input_name, expected_type, op_name):
+    if not isinstance(input, expected_type):
+        raise TypeError("The type of %s of %s must be %s, got %s"
+                        % (input_name, op_name, expected_type, type(input)))
+
+
+def check_dtype(dtype, input_name, expected_dtype, op_name):
+    if _dt.convert_dtype(dtype) not in expected_dtype:
+        raise TypeError("dtype of %s of %s must be one of %s, got %s"
+                        % (input_name, op_name, expected_dtype, dtype))
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_names: List[str] = []
+        self.feed_dtypes = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        program = program or framework.default_main_program()
+        for v in feed_list:
+            if isinstance(v, str):
+                v = program.global_block().var(v)
+            self.feed_names.append(v.name)
+            self.feed_dtypes.append(_dt.to_numpy_dtype(v.dtype))
+            self.feed_shapes.append(v.shape)
+            self.feed_lod_level.append(v.lod_level)
+        self.place = place
+
+    def feed(self, iterable):
+        rows = list(iterable)
+        feed = {}
+        for i, name in enumerate(self.feed_names):
+            col = [row[i] for row in rows]
+            if self.feed_lod_level[i]:
+                # variable-length samples -> concat + LoD offsets
+                lengths = [np.asarray(c).shape[0] for c in col]
+                flat = np.concatenate(
+                    [np.asarray(c, dtype=self.feed_dtypes[i]).reshape(
+                        len(c) if np.asarray(c).ndim == 1 else -1,
+                        *np.asarray(c).shape[1:]) for c in col], axis=0)
+                offsets = [0]
+                for l in lengths:
+                    offsets.append(offsets[-1] + l)
+                t = LoDTensor()
+                t.set(flat)
+                t.set_lod([offsets])
+                feed[name] = t
+            else:
+                arr = np.asarray(col, dtype=self.feed_dtypes[i])
+                shape = self.feed_shapes[i]
+                if shape is not None and len(shape) == arr.ndim + 1:
+                    pass  # batch of scalars already stacked
+                elif shape is not None and arr.ndim == len(shape) and \
+                        all(s == -1 or s == d for s, d in
+                            zip(shape[1:], arr.shape[1:])):
+                    pass
+                elif shape is not None:
+                    want = [d for d in shape if d != -1]
+                    arr = arr.reshape([len(rows)] + list(shape[1:])) \
+                        if -1 in shape else arr.reshape(shape)
+                feed[name] = arr
+        return feed

@@ -1,0 +1,33 @@
+"""Imperative (dygraph) mode.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/ + the C++
+imperative/ runtime (SURVEY.md §2.2): guard, to_variable, Layer, nn
+layers, tape autograd (Tracer/BasicEngine), save/load, DataParallel
+(parallel.py), TracedLayer (jit.py).
+"""
+from .base import (  # noqa: F401
+    disable_dygraph,
+    enable_dygraph,
+    enabled,
+    guard,
+    no_grad,
+    to_variable,
+)
+from .layers import Layer  # noqa: F401
+from .varbase import ParamBase, VarBase  # noqa: F401
+from .tracer import Tracer  # noqa: F401
+from . import nn  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from . import math_patch  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    CosineDecay,
+    ExponentialDecay,
+    InverseTimeDecay,
+    NaturalExpDecay,
+    NoamDecay,
+    PiecewiseDecay,
+    PolynomialDecay,
+)
+from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
+from .jit import TracedLayer  # noqa: F401

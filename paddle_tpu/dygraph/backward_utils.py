@@ -1,0 +1,105 @@
+"""Dygraph optimizer step: apply registry optimizer ops eagerly.
+
+Reference flow: loss.backward() fills grads; optimizer.minimize runs the
+optimizer op per parameter eagerly (optimizer.py _append_optimize_op via
+tracer). Accumulator state lives on the optimizer as VarBase arrays.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ..core.registry import BOUND_OUTPUTS_ATTR, OpInfoMap
+from .varbase import VarBase
+
+
+def _get_state(opt, pname, key, like, fill=0.0, shape=None):
+    store: Dict = opt._dygraph_state
+    k = "%s_%s" % (pname, key)
+    v = store.get(k)
+    if v is None:
+        import jax.numpy as jnp
+
+        if shape is not None:
+            arr = jnp.full(tuple(shape), fill, dtype=like._array.dtype)
+        else:
+            arr = jnp.full(like._array.shape, fill, dtype=like._array.dtype)
+        v = VarBase(arr, name=k, stop_gradient=True, persistable=True)
+        store[k] = v
+    return v
+
+
+_OPT_SPECS = {
+    # optimizer class name -> (op type, state slots builder, attr builder)
+}
+
+
+def dygraph_minimize(opt, loss, parameter_list=None):
+    import jax.numpy as jnp
+
+    from .tracer import current_tracer
+
+    tracer = current_tracer()
+    if loss is not None and all(
+            rec is not None for rec in [tracer]) and not tracer.tape:
+        loss.backward()
+    params = parameter_list or tracer.all_parameters()
+    lr = opt.current_step_lr
+    if not isinstance(lr, float):
+        lr = float(np.asarray(lr() if callable(lr) else lr).reshape(()))
+    lr_arr = jnp.asarray([lr], dtype=jnp.float32)
+    infos = OpInfoMap.instance()
+
+    name = type(opt).__name__
+    for p in params:
+        if p._grad is None or not getattr(p, "trainable", True):
+            continue
+        g = p._grad
+        ins = {"Param": p._array, "Grad": g, "LearningRate": lr_arr}
+        if name in ("SGDOptimizer", "SGD"):
+            op_type, attrs = "sgd", {}
+        elif name in ("MomentumOptimizer", "Momentum"):
+            vel = _get_state(opt, p.name, "velocity", p)
+            ins["Velocity"] = vel._array
+            op_type = "momentum"
+            attrs = {"mu": opt._momentum, "use_nesterov": opt._use_nesterov}
+        elif name in ("AdamOptimizer", "Adam", "AdamW", "LambOptimizer"):
+            m1 = _get_state(opt, p.name, "moment1", p)
+            m2 = _get_state(opt, p.name, "moment2", p)
+            b1p = _get_state(opt, p.name, "beta1pow", p, fill=opt._beta1,
+                             shape=(1,))
+            b2p = _get_state(opt, p.name, "beta2pow", p, fill=opt._beta2,
+                             shape=(1,))
+            ins.update({"Moment1": m1._array, "Moment2": m2._array,
+                        "Beta1Pow": b1p._array, "Beta2Pow": b2p._array})
+            op_type = {"AdamOptimizer": "adam", "Adam": "adam",
+                       "AdamW": "adamw", "LambOptimizer": "lamb"}[name]
+            attrs = {"beta1": opt._beta1, "beta2": opt._beta2,
+                     "epsilon": opt._epsilon}
+            if op_type in ("adamw", "lamb"):
+                attrs["weight_decay"] = opt._weight_decay
+        elif name in ("AdagradOptimizer", "Adagrad"):
+            mom = _get_state(opt, p.name, "moment", p,
+                             fill=opt._initial_accumulator_value)
+            ins["Moment"] = mom._array
+            op_type, attrs = "adagrad", {"epsilon": opt._epsilon}
+        else:
+            raise NotImplementedError(
+                "dygraph path for %s arrives with a later wave" % name)
+
+        info = infos.get(op_type)
+        attrs = dict(attrs)
+        attrs[BOUND_OUTPUTS_ATTR] = tuple(s.name for s in info.outputs)
+        outs = info.fn(ins, attrs)
+        p._array = outs["ParamOut"]
+        if "VelocityOut" in outs:
+            _get_state(opt, p.name, "velocity", p)._array = outs["VelocityOut"]
+        if "Moment1Out" in outs:
+            _get_state(opt, p.name, "moment1", p)._array = outs["Moment1Out"]
+            _get_state(opt, p.name, "moment2", p)._array = outs["Moment2Out"]
+            _get_state(opt, p.name, "beta1pow", p, shape=(1,))._array = outs["Beta1PowOut"]
+            _get_state(opt, p.name, "beta2pow", p, shape=(1,))._array = outs["Beta2PowOut"]
+        if "MomentOut" in outs:
+            _get_state(opt, p.name, "moment", p)._array = outs["MomentOut"]
+    return None, [(p, p._grad) for p in params]

@@ -1,0 +1,82 @@
+"""dygraph.guard / to_variable / no_grad.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/base.py.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+from .. import framework
+from .tracer import Tracer, _set_tracer, current_tracer
+from .varbase import VarBase
+
+__all__ = ["guard", "enabled", "to_variable", "no_grad", "enable_dygraph",
+           "disable_dygraph"]
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    tracer = Tracer()
+    old_tracer = framework._dygraph_tracer_
+    old_place = framework._dygraph_place_
+    framework._dygraph_tracer_ = tracer
+    framework._dygraph_place_ = place
+    _set_tracer(tracer)
+    try:
+        yield
+    finally:
+        framework._dygraph_tracer_ = old_tracer
+        framework._dygraph_place_ = old_place
+        _set_tracer(old_tracer)
+
+
+def enable_dygraph(place=None):
+    tracer = Tracer()
+    framework._dygraph_tracer_ = tracer
+    framework._dygraph_place_ = place
+    _set_tracer(tracer)
+
+
+def disable_dygraph():
+    framework._dygraph_tracer_ = None
+    framework._dygraph_place_ = None
+    _set_tracer(None)
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name, stop_gradient=True)
+
+
+def no_grad(fn=None):
+    if fn is None:
+        tracer = framework._dygraph_tracer()
+        if tracer is None:
+            return contextlib.nullcontext()
+        return tracer.no_grad_guard()
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        tracer = framework._dygraph_tracer()
+        if tracer is None:
+            return fn(*args, **kwargs)
+        with tracer.no_grad_guard():
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def _init_eager_var(var, initializer):
+    """Initialize a graph-declared var eagerly (LayerHelper
+    set_variable_initializer in dygraph mode)."""
+    from .varbase import ParamBase
+
+    return ParamBase.create(var.name, var.shape, var.dtype, initializer)

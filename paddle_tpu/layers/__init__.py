@@ -1,0 +1,34 @@
+"""fluid.layers — graph-building API surface.
+
+Parity: /root/reference/python/paddle/fluid/layers/ (~290 public APIs
+across nn.py, tensor.py, loss.py, control_flow.py, ops.py, metric_op.py,
+collective.py, sequence_lod.py, rnn.py, detection.py).
+"""
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+from .sequence_lod import *  # noqa: F401,F403
+from .io import data  # noqa: F401
+from . import math_op_patch  # noqa: F401  (patches Variable operators)
+
+from .nn import __all__ as _nn_all
+from .tensor import __all__ as _tensor_all
+from .loss import __all__ as _loss_all
+from .ops import __all__ as _ops_all
+from .control_flow import __all__ as _cf_all
+from .metric_op import __all__ as _metric_all
+from .sequence_lod import __all__ as _seq_all
+
+__all__ = (
+    ["data"]
+    + _nn_all
+    + _tensor_all
+    + _loss_all
+    + _ops_all
+    + _cf_all
+    + _metric_all
+    + _seq_all
+)

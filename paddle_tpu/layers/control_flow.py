@@ -1,0 +1,185 @@
+"""Control-flow layers.
+
+Parity: /root/reference/python/paddle/fluid/layers/control_flow.py
+(While :1046, array ops, compare layers, cond).
+"""
+from __future__ import annotations
+
+from .. import framework
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "While",
+    "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "equal",
+    "not_equal",
+    "array_write",
+    "array_read",
+    "array_length",
+    "create_array",
+    "logical_and",
+    "logical_or",
+    "logical_xor",
+    "logical_not",
+    "cond",
+]
+
+
+def _cmp_layer(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type, input=x)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool",
+                                                         stop_gradient=True)
+    helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _cmp_layer("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _cmp_layer("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _cmp_layer("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _cmp_layer("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _cmp_layer("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _cmp_layer("not_equal", x, y, cond)
+
+
+def _logical_layer(op_type, x, y=None, out=None):
+    helper = LayerHelper(op_type, input=x)
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool",
+                                                        stop_gradient=True)
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    helper.append_op(op_type, inputs=inputs, outputs={"Out": [out]})
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    return _logical_layer("logical_and", x, y, out)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical_layer("logical_or", x, y, out)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical_layer("logical_xor", x, y, out)
+
+
+def logical_not(x, out=None, name=None):
+    return _logical_layer("logical_not", x, None, out)
+
+
+def create_array(dtype):
+    helper = LayerHelper("create_array")
+    return helper.block.create_var(
+        name=framework.unique_name.generate("array"),
+        type="lod_tensor_array",
+        dtype=dtype,
+    )
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write", input=x)
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op("write_to_array", inputs={"X": [x], "I": [i]},
+                     outputs={"Out": [array]})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read", input=array)
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op("read_from_array", inputs={"X": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length", input=array)
+    out = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    helper.append_op("lod_array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]})
+    return out
+
+
+class While:
+    """``with While(cond).block():`` — builds a sub-block run by the
+    `while` host op (interpreter) or lowered to lax.while_loop by the
+    program compiler."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.is_test = is_test
+        self.helper = LayerHelper("while", name=name)
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            main = self.helper.main_program
+            parent_block = main.current_block()
+            sub = main._create_block()
+            try:
+                yield
+            finally:
+                main._rollback()
+                parent_block.append_op(
+                    "while",
+                    inputs={"Condition": [self.cond_var]},
+                    outputs={},
+                    attrs={"sub_block": sub, "is_test": self.is_test},
+                )
+
+        return _ctx()
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """fluid.layers.cond — both branches traced; merged with `where`.
+
+    TPU-native note: both branches execute (XLA select), matching
+    lax.cond-on-TPU semantics for cheap branches; the program compiler may
+    lower to lax.cond where branches are heavy.
+    """
+    from .nn import where
+    from .tensor import cast
+
+    true_out = true_fn() if true_fn is not None else None
+    false_out = false_fn() if false_fn is not None else None
+    if true_out is None and false_out is None:
+        return None
+    helper = LayerHelper("cond", name=name)
+
+    def merge(t, f):
+        c = pred
+        out = helper.create_variable_for_type_inference(t.dtype)
+        helper.append_op("where", inputs={"Condition": [c], "X": [t], "Y": [f]},
+                         outputs={"Out": [out]})
+        return out
+
+    if isinstance(true_out, (list, tuple)):
+        return [merge(t, f) for t, f in zip(true_out, false_out)]
+    return merge(true_out, false_out)

@@ -1,0 +1,33 @@
+"""Input declaration (fluid.layers.data / fluid.data).
+
+Parity: /root/reference/python/paddle/fluid/layers/io.py (data :25) and
+python/paddle/fluid/data.py.
+"""
+from __future__ import annotations
+
+from .. import framework
+from ..core import dtypes as _dt
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         type=None, stop_gradient=True):
+    helper_block = framework.default_main_program().global_block()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    var = helper_block.create_var(
+        name=name,
+        shape=shape,
+        dtype=_dt.convert_dtype(dtype),
+        lod_level=lod_level,
+        stop_gradient=stop_gradient,
+        is_data=True,
+        persistable=False,
+    )
+    return var
+
+
+def fluid_data(name, shape, dtype="float32", lod_level=0):
+    """2.0-style fluid.data: shape given in full (no implicit batch dim)."""
+    return data(name, shape, dtype=dtype, lod_level=lod_level,
+                append_batch_size=False)

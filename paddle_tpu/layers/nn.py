@@ -1,0 +1,1223 @@
+"""Neural-net layers.
+
+Parity: /root/reference/python/paddle/fluid/layers/nn.py (150 defs,
+13.9k lines). Each wrapper builds the same op + parameter structure the
+reference does, so programs serialize/optimize identically; the kernels
+underneath are the XLA ops in paddle_tpu/ops/.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..core import dtypes as _dt
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer, NormalInitializer, XavierInitializer
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "fc",
+    "embedding",
+    "conv2d",
+    "conv2d_transpose",
+    "conv3d",
+    "pool2d",
+    "pool3d",
+    "adaptive_pool2d",
+    "batch_norm",
+    "layer_norm",
+    "instance_norm",
+    "group_norm",
+    "dropout",
+    "softmax",
+    "log_softmax",
+    "matmul",
+    "mul",
+    "bmm",
+    "reshape",
+    "transpose",
+    "flatten",
+    "squeeze",
+    "unsqueeze",
+    "split",
+    "slice",
+    "strided_slice",
+    "expand",
+    "expand_as",
+    "stack",
+    "unstack",
+    "gather",
+    "gather_nd",
+    "scatter",
+    "scatter_nd_add",
+    "one_hot",
+    "topk",
+    "argsort",
+    "argmax",
+    "argmin",
+    "shape",
+    "reduce_sum",
+    "reduce_mean",
+    "reduce_max",
+    "reduce_min",
+    "reduce_prod",
+    "reduce_all",
+    "reduce_any",
+    "clip",
+    "clip_by_norm",
+    "mean",
+    "pad",
+    "pad2d",
+    "image_resize",
+    "resize_bilinear",
+    "resize_nearest",
+    "relu",
+    "leaky_relu",
+    "prelu",
+    "brelu",
+    "elu",
+    "relu6",
+    "swish",
+    "hard_swish",
+    "hard_sigmoid",
+    "maxout",
+    "l2_normalize",
+    "label_smooth",
+    "where",
+    "cond_not_used",
+    "lrn",
+    "unique_with_counts",
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
+    "elementwise_mod",
+    "elementwise_floordiv",
+    "uniform_random_batch_size_like",
+    "gaussian_random",
+    "uniform_random",
+    "sampling_id",
+    "flatten_contiguous_range",
+    "index_select",
+    "roll",
+    "tril",
+    "triu",
+    "kron",
+    "meshgrid",
+    "interpolate",
+]
+
+
+def _single_out_op(helper, op_type, inputs, attrs, out_dtype=None,
+                   out_slot="Out"):
+    out = helper.create_variable_for_type_inference(
+        dtype=out_dtype or helper.input_dtype())
+    helper.append_op(op_type, inputs=inputs, outputs={out_slot: [out]},
+                     attrs=attrs)
+    return out
+
+
+def fc(
+    input,
+    size,
+    num_flatten_dims=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    """Fully connected (reference layers/nn.py fc): mul per input +
+    sum + bias + act."""
+    helper = LayerHelper("fc", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    inputs = helper.input()
+    param_attrs = helper.multiple_param_attr(len(inputs))
+    mul_results = []
+    for inp, attr in zip(inputs, param_attrs):
+        in_shape = inp.shape
+        param_shape = [
+            int(np.prod(in_shape[num_flatten_dims:])),
+            size,
+        ]
+        w = helper.create_parameter(attr=attr, shape=param_shape, dtype=dtype)
+        tmp = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(
+            "mul",
+            inputs={"X": [inp], "Y": [w]},
+            outputs={"Out": [tmp]},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype)
+        helper.append_op("sum", inputs={"X": mul_results},
+                         outputs={"Out": [pre_bias]}, attrs={})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(
+    input,
+    size,
+    is_sparse=False,
+    is_distributed=False,
+    padding_idx=None,
+    param_attr=None,
+    dtype="float32",
+):
+    helper = LayerHelper("embedding", param_attr=param_attr)
+    w = helper.create_parameter(attr=helper.param_attr, shape=list(size),
+                                dtype=dtype, is_bias=False)
+    tmp = helper.create_variable_for_type_inference(dtype)
+    padding_idx = (
+        -1 if padding_idx is None
+        else padding_idx if padding_idx >= 0 else (size[0] + padding_idx)
+    )
+    helper.append_op(
+        "lookup_table",
+        inputs={"W": [w], "Ids": [input]},
+        outputs={"Out": [tmp]},
+        attrs={
+            "is_sparse": is_sparse,
+            "is_distributed": is_distributed,
+            "padding_idx": padding_idx,
+            "remote_prefetch": False,
+        },
+    )
+    return tmp
+
+
+def _pair(x, n=2):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x] * n
+
+
+def conv2d(
+    input,
+    num_filters,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups=None,
+    param_attr=None,
+    bias_attr=None,
+    use_cudnn=True,
+    act=None,
+    name=None,
+    data_format="NCHW",
+):
+    helper = LayerHelper("conv2d", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    groups = groups or 1
+    filter_size = _pair(filter_size)
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    num_channels = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+
+    def _default_weight_init():
+        fan_in = num_channels * filter_size[0] * filter_size[1] // groups
+        std = (2.0 / fan_in) ** 0.5
+        return NormalInitializer(0.0, std)
+
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=_default_weight_init(),
+    )
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    op_type = "depthwise_conv2d" if (
+        groups == num_channels and num_filters % num_channels == 0
+        and not use_cudnn
+    ) else "conv2d"
+    helper.append_op(
+        op_type,
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+            "use_cudnn": use_cudnn,
+            "data_format": data_format,
+        },
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(
+    input,
+    num_filters,
+    output_size=None,
+    filter_size=None,
+    padding=0,
+    stride=1,
+    dilation=1,
+    groups=None,
+    param_attr=None,
+    bias_attr=None,
+    use_cudnn=True,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("conv2d_transpose", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    groups = groups or 1
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    in_c = input.shape[1]
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("output_size or filter_size must be given")
+        output_size = _pair(output_size)
+        h, w_ = input.shape[2], input.shape[3]
+        filter_size = [
+            output_size[0] - (h - 1) * stride[0] + 2 * padding[0],
+            output_size[1] - (w_ - 1) * stride[1] + 2 * padding[1],
+        ]
+    else:
+        filter_size = _pair(filter_size)
+    filter_shape = [in_c, num_filters // groups] + filter_size
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "conv2d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+            "use_cudnn": use_cudnn,
+        },
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper("conv3d", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    groups = groups or 1
+    filter_size = _pair(filter_size, 3)
+    filter_shape = [num_filters, input.shape[1] // groups] + filter_size
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "conv3d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={
+            "strides": _pair(stride, 3),
+            "paddings": _pair(padding, 3),
+            "dilations": _pair(dilation, 3),
+            "groups": groups,
+        },
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(
+    input,
+    pool_size=-1,
+    pool_type="max",
+    pool_stride=1,
+    pool_padding=0,
+    global_pooling=False,
+    use_cudnn=True,
+    ceil_mode=False,
+    exclusive=True,
+    name=None,
+    data_format="NCHW",
+):
+    helper = LayerHelper("pool2d", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool2d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": _pair(pool_size),
+            "strides": _pair(pool_stride),
+            "paddings": _pair(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+            "data_format": data_format,
+        },
+    )
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, use_cudnn=True, ceil_mode=False,
+           exclusive=True, name=None):
+    helper = LayerHelper("pool3d", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool3d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": _pair(pool_size, 3),
+            "strides": _pair(pool_stride, 3),
+            "paddings": _pair(pool_padding, 3),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        },
+    )
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper("adaptive_pool2d", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool2d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": _pair(pool_size),
+            "adaptive": True,
+        },
+    )
+    return out
+
+
+def batch_norm(
+    input,
+    act=None,
+    is_test=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    data_layout="NCHW",
+    in_place=False,
+    name=None,
+    moving_mean_name=None,
+    moving_variance_name=None,
+    do_model_average_for_mean_and_var=True,
+    use_global_stats=False,
+):
+    helper = LayerHelper("batch_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    channels = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    shape = [channels]
+    scale = helper.create_parameter(
+        attr=helper.param_attr, shape=shape, dtype=dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=shape,
+                                   dtype=dtype, is_bias=True)
+    mean = helper.create_parameter(
+        attr=ParamAttr(name=moving_mean_name, trainable=False),
+        shape=shape, dtype=dtype,
+        default_initializer=ConstantInitializer(0.0))
+    mean.stop_gradient = True
+    variance = helper.create_parameter(
+        attr=ParamAttr(name=moving_variance_name, trainable=False),
+        shape=shape, dtype=dtype,
+        default_initializer=ConstantInitializer(1.0))
+    variance.stop_gradient = True
+
+    saved_mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "batch_norm",
+        inputs={
+            "X": [input],
+            "Scale": [scale],
+            "Bias": [bias],
+            "Mean": [mean],
+            "Variance": [variance],
+        },
+        outputs={
+            "Y": [out],
+            "MeanOut": [mean],
+            "VarianceOut": [variance],
+            "SavedMean": [saved_mean],
+            "SavedVariance": [saved_var],
+        },
+        attrs={
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "is_test": is_test,
+            "data_layout": data_layout,
+            "use_global_stats": use_global_stats,
+        },
+    )
+    return helper.append_activation(out)
+
+
+def layer_norm(
+    input,
+    scale=True,
+    shift=True,
+    begin_norm_axis=1,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("layer_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    param_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(attr=helper.param_attr, shape=param_shape,
+                                    dtype=dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=param_shape,
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    mean_out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var_out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "layer_norm",
+        inputs=inputs,
+        outputs={"Y": [out], "Mean": [mean_out], "Variance": [var_out]},
+        attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis},
+    )
+    return helper.append_activation(out)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    shape = [input.shape[1]]
+    scale = helper.create_parameter(attr=helper.param_attr, shape=shape,
+                                    dtype=dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=shape,
+                                   dtype=dtype, is_bias=True)
+    saved_mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "instance_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias]},
+        outputs={"Y": [out], "SavedMean": [saved_mean],
+                 "SavedVariance": [saved_var]},
+        attrs={"epsilon": epsilon},
+    )
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    shape = [input.shape[1]]
+    inputs = {"X": [input]}
+    if helper.param_attr is not False:
+        scale = helper.create_parameter(
+            attr=helper.param_attr, shape=shape, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [scale]
+    if helper.bias_attr is not False:
+        bias = helper.create_parameter(attr=helper.bias_attr, shape=shape,
+                                       dtype=dtype, is_bias=True)
+        inputs["Bias"] = [bias]
+    mean_out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var_out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "group_norm",
+        inputs=inputs,
+        outputs={"Y": [out], "Mean": [mean_out], "Variance": [var_out]},
+        attrs={"epsilon": epsilon, "groups": groups, "data_layout": data_layout},
+    )
+    return helper.append_activation(out)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(
+        "dropout",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "fix_seed": seed is not None,
+            "seed": seed or 0,
+            "dropout_implementation": dropout_implementation,
+        },
+    )
+    return out
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    helper = LayerHelper("softmax", input=input, name=name)
+    return _single_out_op(helper, "softmax", {"X": [input]}, {"axis": axis})
+
+
+def log_softmax(input, axis=-1, name=None):
+    helper = LayerHelper("log_softmax", input=input, name=name)
+    return _single_out_op(helper, "log_softmax", {"X": [input]}, {"axis": axis})
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "matmul",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y,
+               "alpha": float(alpha)},
+    )
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "mul",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"x_num_col_dims": x_num_col_dims, "y_num_col_dims": y_num_col_dims},
+    )
+    return out
+
+
+def bmm(x, y, name=None):
+    helper = LayerHelper("bmm", input=x, name=name)
+    return _single_out_op(helper, "bmm", {"X": [x], "Y": [y]}, {})
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2", input=x, act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(
+        "reshape2",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "XShape": [xshape]},
+        attrs={"shape": list(shape)},
+    )
+    return helper.append_activation(out)
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(
+        "transpose2",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "XShape": [xshape]},
+        attrs={"axis": list(perm)},
+    )
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten2", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("flatten2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": axis})
+    return out
+
+
+def flatten_contiguous_range(x, start_axis=1, stop_axis=-1, name=None):
+    helper = LayerHelper("flatten_contiguous_range", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("flatten_contiguous_range", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"start_axis": start_axis, "stop_axis": stop_axis})
+    return out
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze2", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype,
+                                                       stop_gradient=True)
+    helper.append_op("squeeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype,
+                                                       stop_gradient=True)
+    helper.append_op("unsqueeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": list(axes)})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", input=input, name=name)
+    dim = dim if dim >= 0 else dim + len(input.shape)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        attrs = {"num": num, "sections": [], "axis": dim}
+    else:
+        num = len(num_or_sections)
+        attrs = {"num": 0, "sections": list(num_or_sections), "axis": dim}
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(num)]
+    helper.append_op("split", inputs={"X": [input]}, outputs={"Out": outs},
+                     attrs=attrs)
+    return outs
+
+
+def slice(input, axes, starts, ends, name=None):
+    helper = LayerHelper("slice", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "slice",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"axes": list(axes), "starts": list(starts), "ends": list(ends),
+               "decrease_axis": []},
+    )
+    return out
+
+
+def strided_slice(input, axes, starts, ends, strides, name=None):
+    helper = LayerHelper("strided_slice", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "strided_slice",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"axes": list(axes), "starts": list(starts), "ends": list(ends),
+               "strides": list(strides), "decrease_axis": []},
+    )
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", input=x, name=name)
+    return _single_out_op(helper, "expand", {"X": [x]},
+                          {"expand_times": list(expand_times)})
+
+
+def expand_as(x, target_tensor, name=None):
+    helper = LayerHelper("expand_as", input=x, name=name)
+    return _single_out_op(helper, "expand_as",
+                          {"X": [x], "target_tensor": [target_tensor]}, {})
+
+
+def stack(x, axis=0, name=None):
+    if isinstance(x, framework.Variable):
+        x = [x]
+    helper = LayerHelper("stack", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op("stack", inputs={"X": list(x)}, outputs={"Y": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None, name=None):
+    helper = LayerHelper("unstack", input=x, name=name)
+    n = num if num is not None else x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in range(n)]
+    helper.append_op("unstack", inputs={"X": [x]}, outputs={"Y": outs},
+                     attrs={"axis": axis, "num": n})
+    return outs
+
+
+def gather(input, index, overwrite=True, name=None):
+    helper = LayerHelper("gather", input=input, name=name)
+    return _single_out_op(helper, "gather", {"X": [input], "Index": [index]},
+                          {"overwrite": overwrite})
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", input=input, name=name)
+    return _single_out_op(helper, "gather_nd", {"X": [input], "Index": [index]}, {})
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter", input=input, name=name)
+    return _single_out_op(
+        helper, "scatter",
+        {"X": [input], "Ids": [index], "Updates": [updates]},
+        {"overwrite": overwrite})
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    helper = LayerHelper("scatter_nd_add", input=ref, name=name)
+    return _single_out_op(
+        helper, "scatter_nd_add",
+        {"X": [ref], "Index": [index], "Updates": [updates]}, {})
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    helper = LayerHelper("one_hot", input=input)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("one_hot", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"depth": depth,
+                            "allow_out_of_range": allow_out_of_range})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", input=input, name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64",
+                                                        stop_gradient=True)
+    helper.append_op("top_k", inputs={"X": [input]},
+                     outputs={"Out": [values], "Indices": [indices]},
+                     attrs={"k": k})
+    return values, indices
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    helper = LayerHelper("argsort", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ids = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op("argsort", inputs={"X": [input]},
+                     outputs={"Out": [out], "Indices": [ids]},
+                     attrs={"axis": axis, "descending": descending})
+    return out, ids
+
+
+def argmax(x, axis=0, name=None):
+    helper = LayerHelper("arg_max", input=x, name=name)
+    out = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op("arg_max", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
+def argmin(x, axis=0, name=None):
+    helper = LayerHelper("arg_min", input=x, name=name)
+    out = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op("arg_min", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
+def shape(input):
+    helper = LayerHelper("shape", input=input)
+    out = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    helper.append_op("shape", inputs={"Input": [input]}, outputs={"Out": [out]})
+    return out
+
+
+def _reduce(op_type, input, dim, keep_dim, name):
+    helper = LayerHelper(op_type, input=input, name=name)
+    if dim is None:
+        attrs = {"dim": [0], "keep_dim": keep_dim, "reduce_all": True}
+    else:
+        dims = dim if isinstance(dim, (list, tuple)) else [dim]
+        attrs = {"dim": list(dims), "keep_dim": keep_dim, "reduce_all": False}
+    return _single_out_op(helper, op_type, {"X": [input]}, attrs)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim, name)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_all", input, dim, keep_dim, name)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_any", input, dim, keep_dim, name)
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", input=x, name=name)
+    return _single_out_op(helper, "clip", {"X": [x]},
+                          {"min": float(min), "max": float(max)})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", input=x, name=name)
+    return _single_out_op(helper, "clip_by_norm", {"X": [x]},
+                          {"max_norm": float(max_norm)})
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", input=x, name=name)
+    return _single_out_op(helper, "mean", {"X": [x]}, {})
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", input=x, name=name)
+    return _single_out_op(helper, "pad", {"X": [x]},
+                          {"paddings": list(paddings),
+                           "pad_value": float(pad_value)})
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper("pad2d", input=input, name=name)
+    return _single_out_op(helper, "pad2d", {"X": [input]},
+                          {"paddings": list(paddings), "mode": mode,
+                           "pad_value": float(pad_value),
+                           "data_format": data_format})
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", align_corners=True, align_mode=1):
+    helper = LayerHelper("interpolate", input=input, name=name)
+    attrs = {
+        "interp_method": resample.lower(),
+        "align_corners": align_corners,
+        "align_mode": align_mode,
+        "out_h": out_shape[0] if out_shape else -1,
+        "out_w": out_shape[1] if out_shape else -1,
+        "scale": float(scale or 0.0),
+    }
+    return _single_out_op(helper, "interpolate", {"X": [input]}, attrs)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        align_corners, align_mode)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True):
+    return image_resize(input, out_shape, scale, name, "NEAREST", align_corners)
+
+
+def interpolate(input, out_shape=None, scale=None, name=None,
+                resample="BILINEAR", align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, resample,
+                        align_corners, align_mode)
+
+
+def relu(x, name=None):
+    helper = LayerHelper("relu", input=x, name=name)
+    return _single_out_op(helper, "relu", {"X": [x]}, {})
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu", input=x, name=name)
+    return _single_out_op(helper, "leaky_relu", {"X": [x]}, {"alpha": alpha})
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper("prelu", input=x, param_attr=param_attr, name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(
+        attr=helper.param_attr, shape=alpha_shape, dtype=x.dtype,
+        default_initializer=ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    helper = LayerHelper("brelu", input=x, name=name)
+    return _single_out_op(helper, "brelu", {"X": [x]},
+                          {"t_min": t_min, "t_max": t_max})
+
+
+def elu(x, alpha=1.0, name=None):
+    helper = LayerHelper("elu", input=x, name=name)
+    return _single_out_op(helper, "elu", {"X": [x]}, {"alpha": alpha})
+
+
+def relu6(x, threshold=6.0, name=None):
+    helper = LayerHelper("relu6", input=x, name=name)
+    return _single_out_op(helper, "relu6", {"X": [x]}, {"threshold": threshold})
+
+
+def swish(x, beta=1.0, name=None):
+    helper = LayerHelper("swish", input=x, name=name)
+    return _single_out_op(helper, "swish", {"X": [x]}, {"beta": beta})
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    helper = LayerHelper("hard_swish", input=x, name=name)
+    return _single_out_op(helper, "hard_swish", {"X": [x]},
+                          {"threshold": threshold, "scale": scale,
+                           "offset": offset})
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    helper = LayerHelper("hard_sigmoid", input=x, name=name)
+    return _single_out_op(helper, "hard_sigmoid", {"X": [x]},
+                          {"slope": slope, "offset": offset})
+
+
+def maxout(x, groups, name=None, axis=1):
+    helper = LayerHelper("maxout", input=x, name=name)
+    # maxout via reshape+max: [N, C, H, W] -> [N, C/g, g, H, W] -> max over g
+    c = x.shape[axis]
+    out = reshape(x, [x.shape[0], c // groups, groups] + list(x.shape[2:]))
+    return reduce_max(out, dim=2)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", input=x, name=name)
+    return _single_out_op(helper, "l2_normalize", {"X": [x]},
+                          {"axis": axis, "epsilon": epsilon})
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    from . import tensor as _t
+
+    n_classes = label.shape[-1]
+    smooth = (1.0 - epsilon)
+    helper = LayerHelper("label_smooth", input=label, name=name)
+    scaled = _single_out_op(helper, "scale", {"X": [label]},
+                            {"scale": smooth, "bias": epsilon / n_classes,
+                             "bias_after_scale": True})
+    return scaled
+
+
+def where(condition, x=None, y=None, name=None):
+    helper = LayerHelper("where", input=condition, name=name)
+    if x is None or y is None:
+        out = helper.create_variable_for_type_inference("int64",
+                                                        stop_gradient=True)
+        helper.append_op("where_index", inputs={"Condition": [condition]},
+                         outputs={"Out": [out]})
+        return out
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("where", inputs={"Condition": [condition], "X": [x],
+                                      "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def cond_not_used():  # placeholder keeping __all__ importable pre-control-flow
+    raise NotImplementedError
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", input=input, name=name)
+    mid = helper.create_variable_for_type_inference(input.dtype,
+                                                    stop_gradient=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    count = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    helper.append_op("unique_with_counts", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index], "Count": [count]})
+    return out, index, count
+
+
+def _elementwise(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, input=x, act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_pow", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mod", x, y, axis, act, name)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_floordiv", x, y, axis, act, name)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    # Implemented via fill_constant_batch_size_like-shaped uniform: the
+    # batch dim is static under XLA anyway.
+    helper = LayerHelper("uniform_random_batch_size_like", input=input)
+    out = helper.create_variable_for_type_inference(dtype)
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    helper.append_op(
+        "uniform_random",
+        inputs={},
+        outputs={"Out": [out]},
+        attrs={"shape": shape, "min": min, "max": max, "seed": seed,
+               "dtype": _dt.dtype_to_enum(dtype)},
+    )
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    helper = LayerHelper("gaussian_random", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "gaussian_random",
+        inputs={},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "mean": mean, "std": std, "seed": seed,
+               "dtype": _dt.dtype_to_enum(dtype)},
+    )
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    helper = LayerHelper("uniform_random", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "uniform_random",
+        inputs={},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "min": min, "max": max, "seed": seed,
+               "dtype": _dt.dtype_to_enum(dtype)},
+    )
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    # categorical sample per row of probability matrix x
+    helper = LayerHelper("sampling_id", input=x)
+    cum = _single_out_op(helper, "cumsum", {"X": [x]}, {"axis": -1})
+    u = uniform_random([x.shape[0], 1], dtype=x.dtype, min=0.0, max=1.0,
+                       seed=seed)
+    from .tensor import cast
+
+    ge = _elementwise("elementwise_sub", cum, u)
+    hit = _single_out_op(helper, "greater_equal", {"X": [cum], "Y": [u]}, {},
+                         out_dtype="bool")
+    idx = _single_out_op(helper, "cast", {"X": [hit]},
+                         {"in_dtype": 0, "out_dtype": 2}, out_dtype="int32")
+    return argmax(idx, axis=-1)
+
+
+def index_select(input, index, dim=0, name=None):
+    helper = LayerHelper("index_select", input=input, name=name)
+    return _single_out_op(helper, "index_select",
+                          {"X": [input], "Index": [index]}, {"dim": dim})
+
+
+def roll(input, shifts, dims=None, name=None):
+    helper = LayerHelper("roll", input=input, name=name)
+    shifts = shifts if isinstance(shifts, (list, tuple)) else [shifts]
+    dims = dims if dims is None or isinstance(dims, (list, tuple)) else [dims]
+    return _single_out_op(helper, "roll", {"X": [input]},
+                          {"shifts": list(shifts),
+                           "axis": list(dims) if dims else []})
+
+
+def tril(input, diagonal=0, name=None):
+    helper = LayerHelper("tril_triu", input=input, name=name)
+    return _single_out_op(helper, "tril_triu", {"X": [input]},
+                          {"diagonal": diagonal, "lower": True})
+
+
+def triu(input, diagonal=0, name=None):
+    helper = LayerHelper("tril_triu", input=input, name=name)
+    return _single_out_op(helper, "tril_triu", {"X": [input]},
+                          {"diagonal": diagonal, "lower": False})
+
+
+def kron(x, y, name=None):
+    helper = LayerHelper("kron", input=x, name=name)
+    return _single_out_op(helper, "kron", {"X": [x], "Y": [y]}, {})
+
+
+def meshgrid(input, name=None):
+    helper = LayerHelper("meshgrid", input=input, name=name)
+    outs = [helper.create_variable_for_type_inference(input[0].dtype)
+            for _ in input]
+    helper.append_op("meshgrid", inputs={"X": list(input)},
+                     outputs={"Out": outs})
+    return outs

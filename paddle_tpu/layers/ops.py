@@ -1,0 +1,109 @@
+"""Auto-generated unary layer wrappers.
+
+Parity: /root/reference/python/paddle/fluid/layers/ops.py, which generates
+these from OpProtos via layer_function_generator; here they are generated
+from the registry the same way.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+_UNARY = [
+    "exp", "tanh", "sqrt", "rsqrt", "abs", "ceil", "floor", "cos", "sin",
+    "tan", "acos", "asin", "atan", "sinh", "cosh", "round", "reciprocal",
+    "square", "softplus", "softsign", "log", "log1p", "sigmoid", "logsigmoid",
+    "erf", "gelu", "sign", "softshrink_placeholder",
+]
+
+__all__ = [n for n in _UNARY if not n.endswith("_placeholder")] + [
+    "scale", "pow", "stanh", "hard_shrink", "soft_shrink",
+    "thresholded_relu", "cumsum", "increment",
+]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, input=x, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    layer.__doc__ = "Elementwise %s (see paddle_tpu/ops/activation_ops.py)" % op_type
+    return layer
+
+
+for _name in _UNARY:
+    if _name.endswith("_placeholder"):
+        continue
+    globals()[_name] = _make_unary(_name)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", input=x, act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "scale",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale), "bias": float(bias),
+               "bias_after_scale": bias_after_scale},
+    )
+    return helper.append_activation(out)
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pow", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"factor": float(factor)})
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    helper = LayerHelper("stanh", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("stanh", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"scale_a": scale_a, "scale_b": scale_b})
+    return out
+
+
+def hard_shrink(x, threshold=0.5):
+    helper = LayerHelper("hard_shrink", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("hard_shrink", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"threshold": threshold})
+    return out
+
+
+def soft_shrink(x, threshold=0.5):
+    helper = LayerHelper("soft_shrink", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("soft_shrink", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"lambda": threshold})
+    return out
+
+
+def thresholded_relu(x, threshold=1.0):
+    helper = LayerHelper("thresholded_relu", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("thresholded_relu", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"threshold": threshold})
+    return out
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False, name=None):
+    helper = LayerHelper("cumsum", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("cumsum", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis, "exclusive": exclusive,
+                            "reverse": reverse})
+    return out
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment", input=x)
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("increment", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"step": float(value)})
+    return out

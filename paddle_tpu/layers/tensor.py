@@ -1,0 +1,274 @@
+"""Tensor-creation layers.
+
+Parity: /root/reference/python/paddle/fluid/layers/tensor.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..core import dtypes as _dt
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "create_tensor",
+    "create_parameter",
+    "create_global_var",
+    "cast",
+    "concat",
+    "sums",
+    "assign",
+    "fill_constant",
+    "fill_constant_batch_size_like",
+    "ones",
+    "zeros",
+    "ones_like",
+    "zeros_like",
+    "full_like",
+    "linspace",
+    "range",
+    "diag",
+    "eye",
+    "has_inf",
+    "has_nan",
+    "isfinite",
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.block.create_var(name=name, dtype=dtype,
+                                   persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("create_parameter", param_attr=attr, name=name)
+    attr = ParamAttr._to_attr(attr)
+    if name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, list(shape), dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        dtype=dtype, shape=list(shape), persistable=persistable,
+        name=name or framework.unique_name.generate("global_var"))
+    var.stop_gradient = True
+    from ..initializer import ConstantInitializer
+
+    helper.set_variable_initializer(var, ConstantInitializer(float(value)))
+    return var
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast", input=x)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "cast",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"in_dtype": _dt.dtype_to_enum(x.dtype),
+               "out_dtype": _dt.dtype_to_enum(dtype)},
+    )
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("concat", inputs={"X": list(input)},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum", input=input)
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sum", inputs={"X": list(input)}, outputs={"Out": [out]})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, framework.Variable) or hasattr(input, "array"):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("assign", inputs={"X": [input]},
+                         outputs={"Out": [output]})
+        return output
+    value = np.asarray(input)
+    if output is None:
+        output = helper.create_variable_for_type_inference(str(value.dtype))
+    if value.dtype.kind == "f":
+        key, vals = "fp32_values", [float(v) for v in value.reshape(-1)]
+    elif value.dtype == np.int64:
+        key, vals = "int64_values", [int(v) for v in value.reshape(-1)]
+    else:
+        key, vals = "int32_values", [int(v) for v in value.reshape(-1)]
+    helper.append_op(
+        "assign_value",
+        outputs={"Out": [output]},
+        attrs={"shape": list(value.shape),
+               "dtype": _dt.dtype_to_enum(str(value.dtype).replace("int32", "int32")),
+               key: vals},
+    )
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype)
+    out.stop_gradient = True
+    helper.append_op(
+        "fill_constant",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": _dt.dtype_to_enum(dtype),
+               "value": float(value), "force_cpu": force_cpu},
+    )
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    helper = LayerHelper("fill_constant_batch_size_like", input=input)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.stop_gradient = True
+    helper.append_op(
+        "fill_constant_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": _dt.dtype_to_enum(dtype),
+               "value": float(value), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx},
+    )
+    return out
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0, force_cpu)
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0, force_cpu)
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("fill_any_like", input=x)
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_any_like", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"value": 1.0})
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("fill_zeros_like", input=x)
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_zeros_like", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    helper = LayerHelper("fill_any_like", input=x, name=name)
+    out = helper.create_variable_for_type_inference(dtype or x.dtype)
+    helper.append_op(
+        "fill_any_like", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"value": float(fill_value),
+               "dtype": -1 if dtype is None else _dt.dtype_to_enum(dtype)})
+    return out
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    if not isinstance(start, framework.Variable):
+        start = fill_constant([1], dtype, start)
+    if not isinstance(stop, framework.Variable):
+        stop = fill_constant([1], dtype, stop)
+    num_v = fill_constant([1], "int32", num) if not isinstance(
+        num, framework.Variable) else num
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "linspace",
+        inputs={"Start": [start], "Stop": [stop], "Num": [num_v]},
+        outputs={"Out": [out]},
+        attrs={"dtype": _dt.dtype_to_enum(dtype),
+               "num": int(num) if not isinstance(num, framework.Variable) else 0},
+    )
+    return out
+
+
+def range(start, end, step, dtype="float32"):
+    helper = LayerHelper("range")
+
+    def _to_var(v):
+        if isinstance(v, framework.Variable):
+            return v
+        return fill_constant([1], dtype, v)
+
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "range",
+        inputs={"Start": [_to_var(start)], "End": [_to_var(end)],
+                "Step": [_to_var(step)]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def diag(diagonal):
+    helper = LayerHelper("diag", input=diagonal)
+    out = helper.create_variable_for_type_inference(diagonal.dtype)
+    helper.append_op("diag", inputs={"Diagonal": [diagonal]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "eye",
+        outputs={"Out": [out]},
+        attrs={"num_rows": num_rows,
+               "num_columns": num_columns if num_columns is not None else -1,
+               "dtype": _dt.dtype_to_enum(dtype)},
+    )
+    if batch_shape:
+        from .nn import expand, reshape, unsqueeze
+
+        for _ in batch_shape:
+            out = unsqueeze(out, [0])
+        out = expand(out, list(batch_shape) + [1, 1])
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper("isinf", input=x)
+    out = helper.create_variable_for_type_inference("bool", stop_gradient=True)
+    helper.append_op("isinf", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def has_nan(x):
+    helper = LayerHelper("isnan", input=x)
+    out = helper.create_variable_for_type_inference("bool", stop_gradient=True)
+    helper.append_op("isnan", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite", input=x)
+    out = helper.create_variable_for_type_inference("bool", stop_gradient=True)
+    helper.append_op("isfinite", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out

@@ -1,0 +1,21 @@
+"""paddle.nn 2.0-alpha namespace.
+
+Parity: /root/reference/python/paddle/nn/ — the early 2.0 layer API
+(functional + Layer classes). Re-exports the dygraph layers plus
+functional wrappers.
+"""
+from ..dygraph.layers import Layer  # noqa: F401
+from ..dygraph.nn import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    Conv2DTranspose,
+    Dropout,
+    Embedding,
+    GroupNorm,
+    InstanceNorm,
+    LayerNorm,
+    Linear,
+    Pool2D,
+    PRelu,
+)
+from . import functional  # noqa: F401

@@ -1,0 +1,24 @@
+"""paddle.nn.functional — works in both dygraph and static mode by
+delegating to fluid.layers (which itself dispatches on mode)."""
+from ..layers.nn import (  # noqa: F401
+    dropout,
+    elu,
+    hard_sigmoid,
+    hard_swish,
+    leaky_relu,
+    log_softmax,
+    relu,
+    relu6,
+    softmax,
+    swish,
+)
+from ..layers.loss import (  # noqa: F401
+    cross_entropy,
+    kldiv_loss,
+    log_loss,
+    mse_loss,
+    sigmoid_cross_entropy_with_logits,
+    softmax_with_cross_entropy,
+    square_error_cost,
+)
+from ..layers.ops import sigmoid, tanh  # noqa: F401

@@ -1,0 +1,140 @@
+"""Unary activation ops.
+
+Parity with /root/reference/paddle/fluid/operators/activation_op.cc (the
+UnaryActivation family) plus softmax (softmax_op.cc). All gradients are
+auto-VJP; XLA fuses them into surrounding matmuls, which replaces the
+reference's hand-written *_grad functors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+def _unary(name, f, attrs=None):
+    @register_op(
+        name,
+        inputs=[In("X")],
+        outputs=[Out("Out")],
+        attrs=dict(attrs or {}),
+    )
+    def _op(ins, a, _f=f):
+        return {"Out": _f(ins["X"], a)}
+
+    return _op
+
+
+_unary("relu", lambda x, a: jax.nn.relu(x))
+_unary("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_unary("tanh", lambda x, a: jnp.tanh(x))
+_unary("exp", lambda x, a: jnp.exp(x))
+_unary("log", lambda x, a: jnp.log(x))
+_unary("log1p", lambda x, a: jnp.log1p(x))
+_unary("sqrt", lambda x, a: jnp.sqrt(x))
+_unary("rsqrt", lambda x, a: jax.lax.rsqrt(x))
+_unary("abs", lambda x, a: jnp.abs(x))
+_unary("square", lambda x, a: jnp.square(x))
+_unary("reciprocal", lambda x, a: 1.0 / x)
+_unary("ceil", lambda x, a: jnp.ceil(x))
+_unary("floor", lambda x, a: jnp.floor(x))
+_unary("round", lambda x, a: jnp.round(x))
+_unary("sin", lambda x, a: jnp.sin(x))
+_unary("cos", lambda x, a: jnp.cos(x))
+_unary("tan", lambda x, a: jnp.tan(x))
+_unary("asin", lambda x, a: jnp.arcsin(x))
+_unary("acos", lambda x, a: jnp.arccos(x))
+_unary("atan", lambda x, a: jnp.arctan(x))
+_unary("sinh", lambda x, a: jnp.sinh(x))
+_unary("cosh", lambda x, a: jnp.cosh(x))
+_unary("softsign", lambda x, a: x / (1 + jnp.abs(x)))
+_unary("softplus", lambda x, a: jax.nn.softplus(x))
+_unary("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_unary("erf", lambda x, a: jax.lax.erf(x))
+_unary("gelu", lambda x, a: jax.nn.gelu(x, approximate=bool(a.get("approximate", False))),
+       attrs={"approximate": False})
+_unary("leaky_relu", lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 0.02) * x),
+       attrs={"alpha": 0.02})
+_unary("elu", lambda x, a: jax.nn.elu(x, alpha=a.get("alpha", 1.0)),
+       attrs={"alpha": 1.0})
+_unary("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)),
+       attrs={"threshold": 6.0})
+_unary("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+       attrs={"t_min": 0.0, "t_max": 24.0})
+_unary(
+    "hard_sigmoid",
+    lambda x, a: jnp.clip(a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+    attrs={"slope": 0.2, "offset": 0.5},
+)
+_unary(
+    "hard_swish",
+    lambda x, a: x
+    * jnp.clip(x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0))
+    / a.get("scale", 6.0),
+    attrs={"threshold": 6.0, "scale": 6.0, "offset": 3.0},
+)
+_unary("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+       attrs={"beta": 1.0})
+_unary(
+    "thresholded_relu",
+    lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0),
+    attrs={"threshold": 1.0},
+)
+_unary(
+    "hard_shrink",
+    lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+    attrs={"threshold": 0.5},
+)
+_unary(
+    "soft_shrink",
+    lambda x, a: jnp.sign(x) * jnp.maximum(jnp.abs(x) - a.get("lambda", 0.5), 0.0),
+    attrs={"lambda": 0.5},
+)
+_unary(
+    "pow",
+    lambda x, a: jnp.power(x, a.get("factor", 1.0)),
+    attrs={"factor": 1.0},
+)
+_unary(
+    "stanh",
+    lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(a.get("scale_a", 0.67) * x),
+    attrs={"scale_a": 0.67, "scale_b": 1.7159},
+)
+_unary("sign", lambda x, a: jnp.sign(x))
+
+
+@register_op(
+    "softmax",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"axis": -1, "use_cudnn": False, "use_mkldnn": False},
+)
+def _softmax(ins, attrs):
+    return {"Out": jax.nn.softmax(ins["X"], axis=attrs.get("axis", -1))}
+
+
+@register_op(
+    "log_softmax",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"axis": -1},
+)
+def _log_softmax(ins, attrs):
+    return {"Out": jax.nn.log_softmax(ins["X"], axis=attrs.get("axis", -1))}
+
+
+@register_op(
+    "prelu",
+    inputs=[In("X"), In("Alpha")],
+    outputs=[Out("Out")],
+    attrs={"mode": "all"},
+)
+def _prelu(ins, attrs):
+    x, alpha = ins["X"], ins["Alpha"]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "all":
+        alpha = alpha.reshape(())
+    return {"Out": jnp.where(x >= 0, x, alpha * x)}

@@ -1,0 +1,196 @@
+"""Collective communication ops (`c_*`).
+
+Parity: /root/reference/paddle/fluid/operators/collective/ (c_allreduce_
+{sum,max,min,prod}, c_broadcast, c_allgather, c_reducescatter,
+c_gen_nccl_id, c_comm_init, c_sync_calc_stream, c_sync_comm_stream) —
+lowered TPU-natively:
+
+- Inside a mesh-mapped trace (pjit/shard_map data parallelism, see
+  paddle_tpu/parallel/), ``ring_id`` resolves to a *named mesh axis* and
+  the op emits the XLA collective (lax.psum / all_gather / psum_scatter)
+  that rides ICI — replacing the reference's ncclAllReduce kernels keyed
+  by NCCLCommContext ring_id.
+- Outside any mapped context (single process, world=1) they are identity,
+  matching reference behavior with nranks=1.
+- Bootstrap ops (gen_nccl_id/comm_init) are no-op hosts: rendezvous is
+  jax.distributed's coordination service over DCN, set up at launch
+  (parallel/env.py), not graph ops. Stream-sync ops are no-ops: XLA
+  program order subsumes them.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_host_op, register_op
+
+# ring_id -> mesh axis name, set while tracing under shard_map
+_ACTIVE_RING_AXES: Dict[int, str] = {}
+
+
+class ring_axis_guard:
+    """Context manager used by the parallel compiler: maps ring ids to the
+    mesh axis names live in the current mapped trace."""
+
+    def __init__(self, mapping: Dict[int, str]):
+        self.mapping = dict(mapping)
+
+    def __enter__(self):
+        self._saved = dict(_ACTIVE_RING_AXES)
+        _ACTIVE_RING_AXES.update(self.mapping)
+        return self
+
+    def __exit__(self, *exc):
+        _ACTIVE_RING_AXES.clear()
+        _ACTIVE_RING_AXES.update(self._saved)
+        return False
+
+
+def axis_for_ring(ring_id: int) -> Optional[str]:
+    return _ACTIVE_RING_AXES.get(ring_id, _ACTIVE_RING_AXES.get(-1))
+
+
+def _allreduce(name, reducer):
+    @register_op(
+        name,
+        inputs=[In("X")],
+        outputs=[Out("Out")],
+        attrs={"ring_id": 0, "use_calc_stream": False, "use_model_parallel": False},
+        grad=None,
+    )
+    def _op(ins, attrs, _red=reducer):
+        axis = axis_for_ring(attrs.get("ring_id", 0))
+        x = ins["X"]
+        return {"Out": x if axis is None else _red(x, axis)}
+
+    return _op
+
+
+_allreduce("c_allreduce_sum", lambda x, ax: jax.lax.psum(x, ax))
+_allreduce("c_allreduce_max", lambda x, ax: jax.lax.pmax(x, ax))
+_allreduce("c_allreduce_min", lambda x, ax: jax.lax.pmin(x, ax))
+_allreduce("c_allreduce_prod", lambda x, ax: jnp.exp(jax.lax.psum(jnp.log(x), ax)))
+
+
+@register_op(
+    "c_broadcast",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"ring_id": 0, "root": 0, "use_calc_stream": False},
+    grad=None,
+)
+def _c_broadcast(ins, attrs):
+    axis = axis_for_ring(attrs.get("ring_id", 0))
+    x = ins["X"]
+    if axis is None:
+        return {"Out": x}
+    # select root's value on every member of the axis
+    root = attrs.get("root", 0)
+    full = jax.lax.all_gather(x, axis)
+    return {"Out": full[root]}
+
+
+@register_op(
+    "c_allgather",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"ring_id": 0, "nranks": 1, "use_calc_stream": False},
+    grad=None,
+)
+def _c_allgather(ins, attrs):
+    axis = axis_for_ring(attrs.get("ring_id", 0))
+    x = ins["X"]
+    if axis is None:
+        return {"Out": x}
+    g = jax.lax.all_gather(x, axis)  # [nranks, ...]
+    return {"Out": g.reshape((-1,) + x.shape[1:])}
+
+
+@register_op(
+    "c_reducescatter",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"ring_id": 0, "nranks": 1, "use_calc_stream": False},
+    grad=None,
+)
+def _c_reducescatter(ins, attrs):
+    axis = axis_for_ring(attrs.get("ring_id", 0))
+    x = ins["X"]
+    if axis is None:
+        return {"Out": x}
+    return {"Out": jax.lax.psum_scatter(x, axis, tiled=True)}
+
+
+@register_op(
+    "c_concat",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"ring_id": 0, "nranks": 1, "rank": 0},
+    grad=None,
+)
+def _c_concat(ins, attrs):
+    axis = axis_for_ring(attrs.get("ring_id", 0))
+    x = ins["X"]
+    if axis is None:
+        return {"Out": x}
+    g = jax.lax.all_gather(x, axis)
+    return {"Out": jnp.concatenate([g[i] for i in range(g.shape[0])], axis=-1)}
+
+
+@register_op(
+    "alltoall",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"ring_id": 0},
+    grad=None,
+)
+def _alltoall(ins, attrs):
+    axis = axis_for_ring(attrs.get("ring_id", 0))
+    x = ins["X"]
+    if axis is None:
+        return {"Out": x}
+    n = jax.lax.axis_size(axis)
+    xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    out = jax.lax.all_to_all(xs, axis, split_axis=0, concat_axis=0, tiled=False)
+    return {"Out": out.reshape(x.shape)}
+
+
+# -- bootstrap / sync: no-ops under the XLA model ---------------------------
+
+
+@register_host_op("c_gen_nccl_id", inputs=[], outputs=[Out("Out", dispensable=True)],
+                  attrs={"rank": 0, "endpoint": "", "other_endpoints": [],
+                         "ring_id": 0})
+def _c_gen_nccl_id(executor, op, scope):
+    # Rendezvous is handled by jax.distributed (coordination service over
+    # DCN) at process launch; nothing to do per-ring.
+    pass
+
+
+@register_host_op("c_comm_init", inputs=[In("X", dispensable=True)], outputs=[],
+                  attrs={"nranks": 1, "rank": 0, "device_id": 0, "ring_id": 0})
+def _c_comm_init(executor, op, scope):
+    pass
+
+
+@register_host_op("c_sync_calc_stream", inputs=[In("X")], outputs=[Out("Out")],
+                  attrs={})
+def _c_sync_calc_stream(executor, op, scope):
+    # XLA program order subsumes stream sync; keep data flowing through.
+    executor._write_var(scope, op.output("Out")[0],
+                        executor._read_var(scope, op.input("X")[0]))
+
+
+@register_host_op("c_sync_comm_stream", inputs=[In("X")], outputs=[Out("Out")],
+                  attrs={"ring_id": 0})
+def _c_sync_comm_stream(executor, op, scope):
+    executor._write_var(scope, op.output("Out")[0],
+                        executor._read_var(scope, op.input("X")[0]))
+
+
+@register_host_op("barrier", inputs=[In("X", dispensable=True)],
+                  outputs=[Out("Out", dispensable=True)], attrs={"ring_id": 0})
+def _barrier(executor, op, scope):
+    pass

@@ -1,0 +1,70 @@
+"""Comparison + logical ops.
+
+Parity: /root/reference/paddle/fluid/operators/controlflow/{compare_op.cc,
+logical_op.cc}.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+def _cmp(name, f):
+    @register_op(
+        name,
+        inputs=[In("X", no_grad=True), In("Y", no_grad=True)],
+        outputs=[Out("Out")],
+        attrs={"axis": -1, "force_cpu": False},
+        grad=None,
+    )
+    def _op(ins, attrs, _f=f):
+        return {"Out": _f(ins["X"], ins["Y"])}
+
+    return _op
+
+
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+
+
+def _logical(name, f, binary=True):
+    ins_spec = [In("X", no_grad=True)] + ([In("Y", no_grad=True)] if binary else [])
+
+    @register_op(name, inputs=ins_spec, outputs=[Out("Out")], grad=None)
+    def _op(ins, attrs, _f=f, _binary=binary):
+        if _binary:
+            return {"Out": _f(ins["X"], ins["Y"])}
+        return {"Out": _f(ins["X"])}
+
+    return _op
+
+
+_logical("logical_and", jnp.logical_and)
+_logical("logical_or", jnp.logical_or)
+_logical("logical_xor", jnp.logical_xor)
+_logical("logical_not", jnp.logical_not, binary=False)
+
+
+@register_op(
+    "isinf",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    grad=None,
+)
+def _isinf(ins, attrs):
+    return {"Out": jnp.any(jnp.isinf(ins["X"])).reshape((1,))}
+
+
+@register_op(
+    "isnan",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    grad=None,
+)
+def _isnan(ins, attrs):
+    return {"Out": jnp.any(jnp.isnan(ins["X"])).reshape((1,))}

@@ -1,0 +1,175 @@
+"""Control-flow + feed/fetch + tensor-array host ops.
+
+Parity: /root/reference/paddle/fluid/operators/controlflow/{while_op.cc,
+conditional_block_op.cc, feed_op.cc, fetch_op.cc,
+tensor_array_read_write_op.cc}, print_op.cc, assign ops.
+
+These run on the host against the Scope, recursing into sub-blocks via the
+executor — the same structure as the reference's kernel-less OperatorBase
+ops that instantiate a framework::Executor on a sub-block. The
+whole-program compiler lowers `while`/`conditional_block` to
+lax.while_loop / lax.cond instead (compiler_engine.py), keeping these host
+paths for the interpreter.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op
+from ..core.tensor import LoDTensor, LoDTensorArray
+
+
+@register_host_op(
+    "feed",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _feed(executor, op, scope):
+    # Reference feed op reads feed_holder[col]; our Executor pre-stages the
+    # feed dict into a LoDTensorArray var named by X.
+    src = scope.find_var(op.input("X")[0])
+    col = op.attrs.get("col", 0)
+    arr = src.get_lod_tensor_array()
+    t = arr[col]
+    executor._write_var(scope, op.output("Out")[0], t)
+
+
+@register_host_op(
+    "fetch",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _fetch(executor, op, scope):
+    val = scope.find_var(op.input("X")[0])
+    dst = scope.var(op.output("Out")[0])
+    arr = dst.get_lod_tensor_array()
+    col = op.attrs.get("col", 0)
+    while len(arr) <= col:
+        arr.append(None)
+    arr[col] = val.raw()
+
+
+@register_host_op(
+    "while",
+    inputs=[In("Condition", no_grad=True), In("X", duplicable=True, dispensable=True)],
+    outputs=[Out("Out", duplicable=True, dispensable=True),
+             Out("StepScopes", dispensable=True)],
+    attrs={"sub_block": None, "is_test": False, "skip_eager_deletion_vars": []},
+)
+def _while(executor, op, scope):
+    sub_block = op.attrs["sub_block"]
+    cond_name = op.input("Condition")[0]
+    steps = 0
+    while True:
+        cond = executor._read_var(scope, cond_name)
+        if not bool(np.asarray(cond).reshape(())):
+            break
+        body_scope = scope.new_scope()
+        executor.run_block(sub_block, body_scope)
+        # while-op semantics: body writes to parent-scope vars directly via
+        # name lookup; sub-scope only holds temporaries.
+        for name in body_scope.local_var_names():
+            if scope.find_var(name) is not None:
+                scope.var(name).set(body_scope.find_local_var(name).raw())
+        steps += 1
+        if steps > 10_000_000:
+            raise RuntimeError("while op exceeded max trip count")
+    scope.drop_kids()
+
+
+@register_host_op(
+    "conditional_block",
+    inputs=[In("Cond", no_grad=True), In("Input", duplicable=True, dispensable=True)],
+    outputs=[Out("Out", duplicable=True, dispensable=True),
+             Out("Scope", dispensable=True)],
+    attrs={"sub_block": None, "is_scalar_condition": True},
+)
+def _conditional_block(executor, op, scope):
+    cond = executor._read_var(scope, op.input("Cond")[0])
+    flag = bool(np.asarray(cond).reshape(-1)[0])
+    if flag:
+        sub_scope = scope.new_scope()
+        executor.run_block(op.attrs["sub_block"], sub_scope)
+        for name in sub_scope.local_var_names():
+            if scope.find_var(name) is not None:
+                scope.var(name).set(sub_scope.find_local_var(name).raw())
+        scope.drop_kids()
+
+
+@register_host_op(
+    "write_to_array",
+    inputs=[In("X"), In("I", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _write_to_array(executor, op, scope):
+    i = int(np.asarray(executor._read_var(scope, op.input("I")[0])).reshape(()))
+    x_var = scope.find_var(op.input("X")[0])
+    arr = scope.var(op.output("Out")[0]).get_lod_tensor_array()
+    while len(arr) <= i:
+        arr.append(None)
+    arr[i] = x_var.raw()
+
+
+@register_host_op(
+    "read_from_array",
+    inputs=[In("X"), In("I", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _read_from_array(executor, op, scope):
+    i = int(np.asarray(executor._read_var(scope, op.input("I")[0])).reshape(()))
+    arr = scope.find_var(op.input("X")[0]).get_lod_tensor_array()
+    executor._write_var(scope, op.output("Out")[0], arr[i])
+
+
+@register_host_op(
+    "lod_array_length",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _lod_array_length(executor, op, scope):
+    arr = scope.find_var(op.input("X")[0]).get_lod_tensor_array()
+    executor._write_var(scope, op.output("Out")[0],
+                        np.asarray([len(arr)], dtype=np.int64))
+
+
+@register_host_op(
+    "print",
+    inputs=[In("In")],
+    outputs=[Out("Out", dispensable=True)],
+    attrs={"first_n": -1, "message": "", "summarize": 20, "print_tensor_name": True,
+           "print_tensor_type": True, "print_tensor_shape": True,
+           "print_tensor_lod": True, "print_phase": "BOTH", "is_forward": True},
+)
+def _print(executor, op, scope):
+    name = op.input("In")[0]
+    val = executor._read_var(scope, name)
+    msg = op.attrs.get("message", "")
+    arr = np.asarray(val)
+    summarize = op.attrs.get("summarize", 20)
+    flat = arr.reshape(-1)[: summarize if summarize > 0 else None]
+    print("%s %s shape=%s dtype=%s data=%s" % (msg, name, arr.shape, arr.dtype, flat))
+    outs = op.output("Out")
+    if outs:
+        executor._write_var(scope, outs[0], val)
+
+
+@register_host_op(
+    "select_input",
+    inputs=[In("X", duplicable=True), In("Mask", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _select_input(executor, op, scope):
+    m = int(np.asarray(executor._read_var(scope, op.input("Mask")[0])).reshape(()))
+    executor._write_var(scope, op.output("Out")[0],
+                        executor._read_var(scope, op.input("X")[m]))
+
+
+@register_host_op(
+    "select_output",
+    inputs=[In("X"), In("Mask", no_grad=True)],
+    outputs=[Out("Out", duplicable=True)],
+)
+def _select_output(executor, op, scope):
+    m = int(np.asarray(executor._read_var(scope, op.input("Mask")[0])).reshape(()))
+    executor._write_var(scope, op.output("Out")[m],
+                        executor._read_var(scope, op.input("X")[0]))

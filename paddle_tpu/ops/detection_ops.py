@@ -1,0 +1,49 @@
+"""CV detection ops (wave 2+).
+
+Parity target: /root/reference/paddle/fluid/operators/detection/ (~16k
+LoC: prior_box, multiclass_nms, yolo_box, roi_align, generate_proposals,
+...). First wave: the dense, shape-static ones; NMS-style value-dependent
+shapes become host ops when added.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+@register_op(
+    "box_coder",
+    inputs=[In("PriorBox", no_grad=True), In("PriorBoxVar", dispensable=True,
+            no_grad=True), In("TargetBox")],
+    outputs=[Out("OutputBox")],
+    attrs={"code_type": "encode_center_size", "box_normalized": True, "axis": 0,
+           "variance": []},
+)
+def _box_coder(ins, attrs):
+    prior = ins["PriorBox"]
+    target = ins["TargetBox"]
+    norm = attrs.get("box_normalized", True)
+    pw = prior[:, 2] - prior[:, 0] + (0.0 if norm else 1.0)
+    ph = prior[:, 3] - prior[:, 1] + (0.0 if norm else 1.0)
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + ph * 0.5
+    if attrs.get("code_type", "encode_center_size") == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + (0.0 if norm else 1.0)
+        th = target[:, 3] - target[:, 1] + (0.0 if norm else 1.0)
+        tx = target[:, 0] + tw * 0.5
+        ty = target[:, 1] + th * 0.5
+        out = jnp.stack(
+            [(tx[:, None] - px[None, :]) / pw[None, :],
+             (ty[:, None] - py[None, :]) / ph[None, :],
+             jnp.log(tw[:, None] / pw[None, :]),
+             jnp.log(th[:, None] / ph[None, :])],
+            axis=-1,
+        )
+        var = ins.get("PriorBoxVar")
+        if var is not None:
+            out = out / var[None, :, :]
+        elif attrs.get("variance"):
+            out = out / jnp.asarray(attrs["variance"]).reshape(1, 1, 4)
+        return {"OutputBox": out}
+    raise NotImplementedError("decode_center_size arrives with wave 2")

@@ -1,0 +1,59 @@
+"""Broadcasted binary elementwise ops.
+
+Parity with /root/reference/paddle/fluid/operators/elementwise/ (add, sub,
+mul, div, min, max, mod, pow, floordiv) including the Fluid ``axis``
+broadcast rule (elementwise_op_function.h): with ``axis >= 0``, Y's dims
+align to X starting at ``axis`` (trailing size-1 dims of Y trimmed);
+``axis == -1`` is numpy-style right alignment. Gradients come from the
+auto-VJP maker — XLA fuses the reduce-to-shape transposes the reference
+hand-writes in elementwise_*_grad kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+def _align(x, y, axis):
+    if x.ndim == y.ndim:
+        return x, y
+    if x.ndim < y.ndim:
+        # paddle requires rank(X) >= rank(Y); be permissive and mirror.
+        y2, x2 = _align(y, x, axis)
+        return x2, y2
+    yshape = list(y.shape)
+    while len(yshape) > 1 and yshape[-1] == 1:
+        yshape.pop()
+    if axis == -1:
+        axis = x.ndim - len(yshape)
+    new_shape = [1] * x.ndim
+    new_shape[axis : axis + len(yshape)] = yshape
+    return x, y.reshape(new_shape)
+
+
+def _binary(name, f):
+    @register_op(
+        name,
+        inputs=[In("X"), In("Y")],
+        outputs=[Out("Out")],
+        attrs={"axis": -1, "use_mkldnn": False, "scale_x": 1.0, "scale_y": 1.0,
+               "scale_out": 1.0},
+    )
+    def _op(ins, attrs, _f=f):
+        x, y = _align(ins["X"], ins["Y"], attrs.get("axis", -1))
+        return {"Out": _f(x, y)}
+
+    return _op
+
+
+_binary("elementwise_add", jnp.add)
+_binary("elementwise_sub", jnp.subtract)
+_binary("elementwise_mul", jnp.multiply)
+_binary("elementwise_div", jnp.divide)
+_binary("elementwise_min", jnp.minimum)
+_binary("elementwise_max", jnp.maximum)
+_binary("elementwise_pow", jnp.power)
+# C++ truncated-modulo semantics (sign of dividend), both int and float.
+_binary("elementwise_mod", jnp.fmod)
+_binary("elementwise_floordiv", jnp.floor_divide)

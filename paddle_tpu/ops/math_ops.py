@@ -1,0 +1,168 @@
+"""Scalar/misc math ops: scale, sum, mean, clip, cast, cumsum, increment.
+
+Parity: /root/reference/paddle/fluid/operators/{scale,sum,mean,clip,cast,
+cum,increment}_op.cc.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dtypes as _dt
+from ..core.registry import In, Out, register_op
+
+
+@register_op(
+    "scale",
+    inputs=[In("X"), In("ScaleTensor", dispensable=True, no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"scale": 1.0, "bias": 0.0, "bias_after_scale": True},
+)
+def _scale(ins, attrs):
+    x = ins["X"]
+    s = ins.get("ScaleTensor")
+    scale = s.reshape(()) if s is not None else attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        out = x * scale + jnp.asarray(bias, dtype=x.dtype)
+    else:
+        out = (x + jnp.asarray(bias, dtype=x.dtype)) * scale
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op(
+    "sum",
+    inputs=[In("X", duplicable=True)],
+    outputs=[Out("Out")],
+    attrs={"use_mkldnn": False},
+)
+def _sum(ins, attrs):
+    xs = [x for x in ins["X"] if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("mean", inputs=[In("X")], outputs=[Out("Out")])
+def _mean(ins, attrs):
+    return {"Out": jnp.mean(ins["X"])}
+
+
+@register_op(
+    "clip",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"min": 0.0, "max": 0.0},
+)
+def _clip(ins, attrs):
+    return {"Out": jnp.clip(ins["X"], attrs["min"], attrs["max"])}
+
+
+@register_op(
+    "clip_by_norm",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"max_norm": 1.0},
+)
+def _clip_by_norm(ins, attrs):
+    x = ins["X"]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": x * scale}
+
+
+@register_op(
+    "cast",
+    inputs=[In("X", no_grad=False)],
+    outputs=[Out("Out")],
+    attrs={"in_dtype": 5, "out_dtype": 5},
+)
+def _cast(ins, attrs):
+    out_dt = _dt.to_numpy_dtype(attrs["out_dtype"])
+    return {"Out": ins["X"].astype(out_dt)}
+
+
+@register_op(
+    "cumsum",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"axis": -1, "exclusive": False, "reverse": False, "flatten": False},
+)
+def _cumsum(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis=axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis=axis)
+    return {"Out": out}
+
+
+@register_op(
+    "increment",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"step": 1.0},
+)
+def _increment(ins, attrs):
+    x = ins["X"]
+    return {"Out": x + jnp.asarray(attrs.get("step", 1.0), dtype=x.dtype)}
+
+
+@register_op(
+    "squared_l2_norm",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+)
+def _squared_l2_norm(ins, attrs):
+    return {"Out": jnp.sum(jnp.square(ins["X"])).reshape((1,))}
+
+
+@register_op(
+    "norm",
+    inputs=[In("X")],
+    outputs=[Out("Out"), Out("Norm")],
+    attrs={"axis": -1, "epsilon": 1e-10},
+)
+def _norm(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True)
+                    + attrs.get("epsilon", 1e-10))
+    return {"Out": x / norm, "Norm": norm}
+
+
+@register_op(
+    "p_norm",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"porder": 2.0, "axis": -1, "epsilon": 1e-12, "keepdim": False},
+)
+def _p_norm(ins, attrs):
+    x = ins["X"]
+    p = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keep = attrs.get("keepdim", False)
+    out = jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keep), 1.0 / p
+    )
+    return {"Out": out}
+
+
+@register_op(
+    "isfinite",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    grad=None,
+)
+def _isfinite(ins, attrs):
+    # Reference returns a single bool: whether ALL entries are finite
+    # (operators/isfinite_op.cc semantics is "contains inf/nan" family).
+    return {"Out": jnp.all(jnp.isfinite(ins["X"])).reshape((1,))}

@@ -1,0 +1,90 @@
+"""Matmul family: mul, matmul, matmul_v2, bmm — the MXU workhorses.
+
+Parity: /root/reference/paddle/fluid/operators/{mul_op.cc, matmul_op.cc,
+bmm_op? (v2 era)}. All lower to a single jnp.matmul/einsum so XLA tiles
+them onto the MXU; `mul`'s x_num_col_dims flattening happens at trace
+time (free — just a reshape).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import In, Out, register_op
+
+
+def _flat2d(x, num_col_dims):
+    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims > 0 else 1
+    return x.reshape(lead, -1)
+
+
+@register_op(
+    "mul",
+    inputs=[In("X"), In("Y")],
+    outputs=[Out("Out")],
+    attrs={"x_num_col_dims": 1, "y_num_col_dims": 1,
+           "scale_x": 1.0, "scale_y": [1.0], "scale_out": 1.0},
+)
+def _mul(ins, attrs):
+    x, y = ins["X"], ins["Y"]
+    xd = attrs.get("x_num_col_dims", 1)
+    yd = attrs.get("y_num_col_dims", 1)
+    x2 = _flat2d(x, xd)
+    y2 = y.reshape(int(np.prod(y.shape[:yd])), -1)
+    out = jnp.matmul(x2, y2)
+    out_shape = x.shape[:xd] + y.shape[yd:]
+    return {"Out": out.reshape(out_shape)}
+
+
+def _maybe_transpose(a, t):
+    if not t:
+        return a
+    if a.ndim == 1:
+        return a
+    perm = list(range(a.ndim))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    return jnp.transpose(a, perm)
+
+
+@register_op(
+    "matmul",
+    inputs=[In("X"), In("Y")],
+    outputs=[Out("Out")],
+    attrs={"transpose_X": False, "transpose_Y": False, "alpha": 1.0},
+)
+def _matmul(ins, attrs):
+    x = _maybe_transpose(ins["X"], attrs.get("transpose_X", False))
+    y = _maybe_transpose(ins["Y"], attrs.get("transpose_Y", False))
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op(
+    "matmul_v2",
+    inputs=[In("X"), In("Y")],
+    outputs=[Out("Out")],
+    attrs={"trans_x": False, "trans_y": False},
+)
+def _matmul_v2(ins, attrs):
+    x = _maybe_transpose(ins["X"], attrs.get("trans_x", False))
+    y = _maybe_transpose(ins["Y"], attrs.get("trans_y", False))
+    return {"Out": jnp.matmul(x, y)}
+
+
+@register_op("bmm", inputs=[In("X"), In("Y")], outputs=[Out("Out")])
+def _bmm(ins, attrs):
+    return {"Out": jnp.matmul(ins["X"], ins["Y"])}
+
+
+@register_op(
+    "dot",
+    inputs=[In("X"), In("Y")],
+    outputs=[Out("Out")],
+)
+def _dot(ins, attrs):
+    return {"Out": jnp.sum(ins["X"] * ins["Y"], axis=-1, keepdims=True)}

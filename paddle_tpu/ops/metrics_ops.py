@@ -1,0 +1,63 @@
+"""Metric ops: accuracy, auc, precision/recall.
+
+Parity: /root/reference/paddle/fluid/operators/metrics/{accuracy_op.cc,
+auc_op.cc}.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+@register_op(
+    "accuracy",
+    inputs=[In("Out", no_grad=True), In("Indices", no_grad=True),
+            In("Label", no_grad=True)],
+    outputs=[Out("Accuracy"), Out("Correct"), Out("Total")],
+    grad=None,
+)
+def _accuracy(ins, attrs):
+    indices, label = ins["Indices"], ins["Label"]
+    if label.ndim == indices.ndim - 1:
+        label = label[..., None]
+    hit = jnp.any(indices == label, axis=-1)
+    total = hit.shape[0]
+    correct = jnp.sum(hit.astype(jnp.int32))
+    acc = correct.astype(jnp.float32) / float(total)
+    return {
+        "Accuracy": acc.reshape((1,)),
+        "Correct": correct.reshape((1,)),
+        "Total": jnp.asarray([total], dtype=jnp.int32),
+    }
+
+
+@register_op(
+    "auc",
+    inputs=[In("Predict", no_grad=True), In("Label", no_grad=True),
+            In("StatPos", no_grad=True), In("StatNeg", no_grad=True)],
+    outputs=[Out("AUC"), Out("StatPosOut", is_ref=True),
+             Out("StatNegOut", is_ref=True)],
+    attrs={"curve": "ROC", "num_thresholds": 4095, "slide_steps": 1},
+    grad=None,
+)
+def _auc(ins, attrs):
+    num_t = attrs.get("num_thresholds", 4095)
+    pred = ins["Predict"][:, 1] if ins["Predict"].ndim == 2 else ins["Predict"]
+    label = ins["Label"].reshape(-1)
+    bucket = jnp.clip((pred * num_t).astype(jnp.int32), 0, num_t)
+    pos = ins["StatPos"].reshape(-1).at[bucket].add((label > 0).astype(jnp.int64))
+    neg = ins["StatNeg"].reshape(-1).at[bucket].add((label <= 0).astype(jnp.int64))
+    # trapezoid over descending thresholds
+    pos_rev = jnp.cumsum(pos[::-1])
+    neg_rev = jnp.cumsum(neg[::-1])
+    tot_pos = pos_rev[-1].astype(jnp.float64)
+    tot_neg = neg_rev[-1].astype(jnp.float64)
+    tpr = pos_rev.astype(jnp.float64) / jnp.maximum(tot_pos, 1.0)
+    fpr = neg_rev.astype(jnp.float64) / jnp.maximum(tot_neg, 1.0)
+    auc = jnp.trapezoid(tpr, fpr)
+    return {
+        "AUC": auc.astype(jnp.float64).reshape((1,)),
+        "StatPosOut": pos.reshape(ins["StatPos"].shape),
+        "StatNegOut": neg.reshape(ins["StatNeg"].shape),
+    }

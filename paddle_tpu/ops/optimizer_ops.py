@@ -1,0 +1,351 @@
+"""Optimizer update ops.
+
+Parity: /root/reference/paddle/fluid/operators/optimizers/ (sgd, momentum,
+lars_momentum, adam, adamax, adagrad, decayed_adagrad, adadelta, rmsprop,
+ftrl, lamb, dpsgd). Contract kept from the reference: Param/Moment inputs
+are re-bound through same-named *Out outputs (is_ref), so the executor's
+rebinding (and buffer donation in compiled mode) realises in-place update.
+All are grad=None (never differentiated).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+def _op(name, inputs, outputs, attrs, fn):
+    register_op(
+        name,
+        inputs=[In(i) if isinstance(i, str) else i for i in inputs],
+        outputs=[Out(o, is_ref=True) for o in outputs],
+        attrs=attrs,
+        grad=None,
+    )(fn)
+
+
+def _lr(ins):
+    return ins["LearningRate"].reshape(())
+
+
+def _sgd(ins, attrs):
+    return {"ParamOut": ins["Param"] - _lr(ins) * ins["Grad"]}
+
+
+_op("sgd", ["Param", "Grad", "LearningRate"], ["ParamOut"], {}, _sgd)
+
+
+def _momentum(ins, attrs):
+    mu = attrs.get("mu", 0.9)
+    v = mu * ins["Velocity"] + ins["Grad"]
+    if attrs.get("use_nesterov", False):
+        p = ins["Param"] - (ins["Grad"] + mu * v) * _lr(ins)
+    else:
+        p = ins["Param"] - _lr(ins) * v
+    return {"ParamOut": p, "VelocityOut": v}
+
+
+_op(
+    "momentum",
+    ["Param", "Grad", "Velocity", "LearningRate"],
+    ["ParamOut", "VelocityOut"],
+    {"mu": 0.9, "use_nesterov": False, "regularization_method": "",
+     "regularization_coeff": 0.0},
+    _momentum,
+)
+
+
+def _lars_momentum(ins, attrs):
+    mu = attrs.get("mu", 0.9)
+    lars_coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    p, g, v = ins["Param"], ins["Grad"], ins["Velocity"]
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lars_coeff * p_norm / (g_norm + wd * p_norm + eps),
+        jnp.ones_like(p_norm),
+    )
+    v_out = mu * v + _lr(ins) * local_lr * (g + wd * p)
+    return {"ParamOut": p - v_out, "VelocityOut": v_out}
+
+
+_op(
+    "lars_momentum",
+    ["Param", "Grad", "Velocity", "LearningRate"],
+    ["ParamOut", "VelocityOut"],
+    {"mu": 0.9, "lars_coeff": 0.001, "lars_weight_decay": 0.0005, "epsilon": 0.0},
+    _lars_momentum,
+)
+
+
+def _adam(ins, attrs):
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    p, g = ins["Param"], ins["Grad"]
+    m1 = b1 * ins["Moment1"] + (1 - b1) * g
+    m2 = b2 * ins["Moment2"] + (1 - b2) * jnp.square(g)
+    b1pow, b2pow = ins["Beta1Pow"].reshape(()), ins["Beta2Pow"].reshape(())
+    lr = _lr(ins) * jnp.sqrt(1 - b2pow) / (1 - b1pow)
+    p_out = p - lr * m1 / (jnp.sqrt(m2) + eps)
+    return {
+        "ParamOut": p_out,
+        "Moment1Out": m1,
+        "Moment2Out": m2,
+        "Beta1PowOut": (b1pow * b1).reshape(ins["Beta1Pow"].shape),
+        "Beta2PowOut": (b2pow * b2).reshape(ins["Beta2Pow"].shape),
+    }
+
+
+_op(
+    "adam",
+    ["Param", "Grad", "LearningRate", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow"],
+    ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8, "lazy_mode": False,
+     "min_row_size_to_use_multithread": 1000},
+    _adam,
+)
+
+
+def _adamw(ins, attrs):
+    # AdamW decoupled weight decay (not in the v1.7 op set; provided for the
+    # 2.0-alpha paddle.optimizer surface and BERT configs).
+    out = _adam(ins, attrs)
+    wd = attrs.get("weight_decay", 0.01)
+    lr = _lr(ins)
+    out["ParamOut"] = out["ParamOut"] - lr * wd * ins["Param"]
+    return out
+
+
+_op(
+    "adamw",
+    ["Param", "Grad", "LearningRate", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow"],
+    ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8, "weight_decay": 0.01},
+    _adamw,
+)
+
+
+def _adamax(ins, attrs):
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    g = ins["Grad"]
+    m = b1 * ins["Moment"] + (1 - b1) * g
+    inf_norm = jnp.maximum(b2 * ins["InfNorm"], jnp.abs(g))
+    b1pow = ins["Beta1Pow"].reshape(())
+    lr = _lr(ins) / (1 - b1pow)
+    p_out = ins["Param"] - lr * m / (inf_norm + eps)
+    return {"ParamOut": p_out, "MomentOut": m, "InfNormOut": inf_norm}
+
+
+_op(
+    "adamax",
+    ["Param", "Grad", "LearningRate", "Moment", "InfNorm", "Beta1Pow"],
+    ["ParamOut", "MomentOut", "InfNormOut"],
+    {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+    _adamax,
+)
+
+
+def _adagrad(ins, attrs):
+    eps = attrs.get("epsilon", 1e-6)
+    g = ins["Grad"]
+    moment = ins["Moment"] + jnp.square(g)
+    p_out = ins["Param"] - _lr(ins) * g / (jnp.sqrt(moment) + eps)
+    return {"ParamOut": p_out, "MomentOut": moment}
+
+
+_op(
+    "adagrad",
+    ["Param", "Grad", "Moment", "LearningRate"],
+    ["ParamOut", "MomentOut"],
+    {"epsilon": 1e-6},
+    _adagrad,
+)
+
+
+def _decayed_adagrad(ins, attrs):
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g = ins["Grad"]
+    moment = decay * ins["Moment"] + (1 - decay) * jnp.square(g)
+    p_out = ins["Param"] - _lr(ins) * g / (jnp.sqrt(moment) + eps)
+    return {"ParamOut": p_out, "MomentOut": moment}
+
+
+_op(
+    "decayed_adagrad",
+    ["Param", "Grad", "Moment", "LearningRate"],
+    ["ParamOut", "MomentOut"],
+    {"decay": 0.95, "epsilon": 1e-6},
+    _decayed_adagrad,
+)
+
+
+def _adadelta(ins, attrs):
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g = ins["Grad"]
+    avg_sq = rho * ins["AvgSquaredGrad"] + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((ins["AvgSquaredUpdate"] + eps) / (avg_sq + eps)) * g
+    avg_upd = rho * ins["AvgSquaredUpdate"] + (1 - rho) * jnp.square(update)
+    return {
+        "ParamOut": ins["Param"] + update,
+        "AvgSquaredGradOut": avg_sq,
+        "AvgSquaredUpdateOut": avg_upd,
+    }
+
+
+_op(
+    "adadelta",
+    ["Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"],
+    ["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"],
+    {"rho": 0.95, "epsilon": 1e-6},
+    _adadelta,
+)
+
+
+def _rmsprop(ins, attrs):
+    eps = attrs.get("epsilon", 1e-10)
+    decay = attrs.get("decay", 0.9)
+    momentum = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    g = ins["Grad"]
+    ms = decay * ins["MeanSquare"] + (1 - decay) * jnp.square(g)
+    if centered:
+        mg = decay * ins["MeanGrad"] + (1 - decay) * g
+        denom = ms - jnp.square(mg) + eps
+    else:
+        mg = ins["MeanGrad"]
+        denom = ms + eps
+    mom = momentum * ins["Moment"] + _lr(ins) * g * jax.lax.rsqrt(denom)
+    return {
+        "ParamOut": ins["Param"] - mom,
+        "MomentOut": mom,
+        "MeanSquareOut": ms,
+        "MeanGradOut": mg,
+    }
+
+
+_op(
+    "rmsprop",
+    ["Param", "Grad", "LearningRate", "Moment", "MeanSquare", "MeanGrad"],
+    ["ParamOut", "MomentOut", "MeanSquareOut", "MeanGradOut"],
+    {"epsilon": 1e-10, "decay": 0.9, "momentum": 0.0, "centered": False},
+    _rmsprop,
+)
+
+
+def _ftrl(ins, attrs):
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    g = ins["Grad"]
+    lr = _lr(ins)
+    sq_accum = ins["SquaredAccumulator"]
+    lin_accum = ins["LinearAccumulator"]
+    new_accum = sq_accum + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_accum) - jnp.sqrt(sq_accum)) / lr
+    else:
+        sigma = (jnp.power(new_accum, -lr_power) - jnp.power(sq_accum, -lr_power)) / lr
+    lin_out = lin_accum + g - sigma * ins["Param"]
+    if lr_power == -0.5:
+        x = l2 + jnp.sqrt(new_accum) / lr
+    else:
+        x = l2 + jnp.power(new_accum, -lr_power) / lr
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = jnp.where(jnp.abs(lin_out) > l1, pre / x, jnp.zeros_like(pre))
+    return {
+        "ParamOut": p_out,
+        "SquaredAccumOut": new_accum,
+        "LinearAccumOut": lin_out,
+    }
+
+
+_op(
+    "ftrl",
+    ["Param", "SquaredAccumulator", "LinearAccumulator", "Grad", "LearningRate"],
+    ["ParamOut", "SquaredAccumOut", "LinearAccumOut"],
+    {"l1": 0.0, "l2": 0.0, "lr_power": -0.5},
+    _ftrl,
+)
+
+
+def _lamb(ins, attrs):
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    p, g = ins["Param"], ins["Grad"]
+    m1 = b1 * ins["Moment1"] + (1 - b1) * g
+    m2 = b2 * ins["Moment2"] + (1 - b2) * jnp.square(g)
+    b1pow, b2pow = ins["Beta1Pow"].reshape(()), ins["Beta2Pow"].reshape(())
+    m1_hat = m1 / (1 - b1pow)
+    m2_hat = m2 / (1 - b2pow)
+    r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return {
+        "ParamOut": p - _lr(ins) * ratio * r,
+        "Moment1Out": m1,
+        "Moment2Out": m2,
+        "Beta1PowOut": (b1pow * b1).reshape(ins["Beta1Pow"].shape),
+        "Beta2PowOut": (b2pow * b2).reshape(ins["Beta2Pow"].shape),
+    }
+
+
+_op(
+    "lamb",
+    ["Param", "Grad", "LearningRate", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow"],
+    ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6, "weight_decay": 0.01},
+    _lamb,
+)
+
+
+def _dpsgd(ins, attrs):
+    # Differentially-private SGD (operators/optimizers/dpsgd_op.cc):
+    # clip-by-norm then noised update. Noise omitted in deterministic mode.
+    clip = attrs.get("clip", 10.0)
+    g = ins["Grad"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    return {"ParamOut": ins["Param"] - _lr(ins) * g * scale}
+
+
+_op(
+    "dpsgd",
+    ["Param", "Grad", "LearningRate"],
+    ["ParamOut"],
+    {"clip": 10.0, "batch_size": 16.0, "sigma": 1.0, "seed": 0},
+    _dpsgd,
+)
+
+
+def _proximal_gd(ins, attrs):
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = _lr(ins)
+    prox = ins["Param"] - lr * ins["Grad"]
+    p_out = (
+        jnp.sign(prox)
+        * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+        / (1.0 + lr * l2)
+    )
+    return {"ParamOut": p_out}
+
+
+_op(
+    "proximal_gd",
+    ["Param", "Grad", "LearningRate"],
+    ["ParamOut"],
+    {"l1": 0.0, "l2": 0.0},
+    _proximal_gd,
+)

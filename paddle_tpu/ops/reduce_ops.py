@@ -1,0 +1,75 @@
+"""Reduce ops with Fluid dim/keep_dim/reduce_all semantics.
+
+Parity: /root/reference/paddle/fluid/operators/reduce_ops/ (reduce_sum,
+mean, max, min, prod, all, any).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+_ATTRS = {"dim": [0], "keep_dim": False, "reduce_all": False,
+          "in_dtype": -1, "out_dtype": -1}
+
+
+def _axes(x, attrs):
+    if attrs.get("reduce_all", False):
+        return None
+    dims = attrs.get("dim", [0])
+    if not isinstance(dims, (list, tuple)):
+        dims = [dims]
+    if not dims:
+        return None
+    return tuple(d % x.ndim for d in dims)
+
+
+def _reduce(name, f, grad="auto"):
+    @register_op(
+        name,
+        inputs=[In("X")],
+        outputs=[Out("Out")],
+        attrs=dict(_ATTRS),
+        grad=grad,
+    )
+    def _op(ins, attrs, _f=f):
+        x = ins["X"]
+        out = _f(x, axis=_axes(x, attrs), keepdims=attrs.get("keep_dim", False))
+        return {"Out": out}
+
+    return _op
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all, grad=None)
+_reduce("reduce_any", jnp.any, grad=None)
+
+
+@register_op(
+    "logsumexp",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"dim": [0], "keep_dim": False, "reduce_all": False},
+)
+def _logsumexp(ins, attrs):
+    import jax
+
+    x = ins["X"]
+    return {"Out": jax.nn.logsumexp(x, axis=_axes(x, attrs),
+                                    keepdims=attrs.get("keep_dim", False))}
+
+
+@register_op(
+    "max",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs=dict(_ATTRS),
+)
+def _max_v2(ins, attrs):
+    x = ins["X"]
+    return {"Out": jnp.max(x, axis=_axes(x, attrs),
+                           keepdims=attrs.get("keep_dim", False))}

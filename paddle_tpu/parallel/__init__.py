@@ -1,0 +1,11 @@
+"""Distributed & parallel execution.
+
+TPU-native replacement for the reference's multi-device stack (SURVEY.md
+§2.5): ParallelExecutor SSA-graph data parallelism, `c_*` collective ops
+over NCCL rings, fleet, transpilers. Here a `jax.sharding.Mesh` is the
+device fabric; ring_ids map to named mesh axes; collectives compile into
+the step program and ride ICI.
+"""
+from .mesh_utils import default_mesh, make_mesh  # noqa: F401
+from .engine import run_data_parallel  # noqa: F401
+from .transpiler import insert_allreduce_ops  # noqa: F401

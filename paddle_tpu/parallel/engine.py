@@ -1,0 +1,130 @@
+"""Mesh data-parallel execution engine.
+
+TPU-native replacement for ParallelExecutor
+(/root/reference/paddle/fluid/framework/parallel_executor.cc:443 — graph
+cloned per device, AllReduceOpHandles over NCCL, SSA thread schedulers):
+here the whole-program trace is wrapped in ONE shard_map over a 1-D mesh:
+
+- feeds are batch-sharded (in_spec P('dp')) — the scatter the reference
+  does by slicing feed tensors per device (executor.py _split_data);
+- params/optimizer state are replicated (in_spec P()); the collective
+  transpiler has inserted c_allreduce_sum on grads + 1/n loss scaling, so
+  updates stay bitwise-replicated — no BCastParamsToDevices needed;
+- `ring_id` attrs resolve to the mesh axis via ring_axis_guard, lowering
+  to lax.psum on ICI (replacing NCCLCommContext rings);
+- fetches are all-gathered to every shard and returned stacked [n, ...],
+  matching ParallelExecutor's merged fetch semantics.
+
+XLA compiles the one program per-shard and inserts the collectives —
+there is no SSA scheduler to build, which is the point.
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core.compiler_engine import _analyze, _program_version, _trace_block
+from ..core.registry import BOUND_OUTPUTS_ATTR
+from ..core.scope import Scope
+from ..core.tensor import LoDTensor
+from ..ops.collective_ops import ring_axis_guard
+from .mesh_utils import default_mesh
+from .transpiler import insert_allreduce_ops
+
+_dp_cache: Dict = {}
+_transpiled: Set[int] = set()
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    import jax
+
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
+def run_data_parallel(core, program, scope: Scope, feed: Dict,
+                      fetch_list: Sequence, loss_name=None, places=None,
+                      build_strategy=None, return_numpy=True,
+                      mesh=None, axis_name="dp"):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh or default_mesh(len(places) if places else None, axis_name)
+    nranks = int(np.prod(list(mesh.shape.values())))
+
+    # one-time collective rewrite (idempotent per program)
+    if id(program) not in _transpiled:
+        if nranks > 1:
+            insert_allreduce_ops(program, nranks)
+        _transpiled.add(id(program))
+
+    fetch_names = tuple(f if isinstance(f, str) else f.name
+                        for f in fetch_list)
+    feed_vals = {}
+    for name, value in (feed or {}).items():
+        arr = value.array if isinstance(value, LoDTensor) else jnp.asarray(
+            np.asarray(value))
+        feed_vals[name] = arr
+    feed_names = tuple(sorted(feed_vals))
+
+    read_first, written = _analyze(program)
+    state = {}
+    for n in sorted(read_first - set(feed_names)):
+        var = scope.find_var(n)
+        if var is None or not var.is_initialized():
+            raise RuntimeError("var %r must be fed or initialized" % n)
+        state[n] = var.raw().array
+    state_names = tuple(sorted(state))
+    block = program.global_block()
+    out_state_names = set(state_names)
+    for n in written:
+        v = block._find_var_recursive(n)
+        if v is not None and v.persistable:
+            out_state_names.add(n)
+    out_state_names = tuple(sorted(out_state_names))
+
+    key = (_program_version(program), feed_names, fetch_names, state_names,
+           out_state_names, id(mesh), axis_name)
+    fn = _dp_cache.get(key)
+    if fn is None:
+        def shard_step(state_d, feeds_d, seed):
+            with ring_axis_guard({0: axis_name, -1: axis_name}):
+                env = dict(state_d)
+                env.update(feeds_d)
+                _trace_block(block, env, seed)
+                fetches = [
+                    jax.lax.all_gather(env[n], axis_name) for n in fetch_names
+                ]
+                new_state = {n: env[n] for n in out_state_names if n in env}
+                return fetches, new_state
+
+        mapped = _shard_map(
+            shard_step, mesh,
+            in_specs=({n: P() for n in state_names},
+                      {n: P(axis_name) for n in feed_names}, P()),
+            out_specs=([P() for _ in fetch_names],
+                       {n: P() for n in out_state_names}),
+        )
+        fn = jax.jit(mapped, donate_argnums=(0,))
+        _dp_cache[key] = fn
+
+    fetches, new_state = fn(
+        state, feed_vals,
+        jnp.uint32(core.rng.next_seed(0) ^
+                   ((core.rng.step * 2654435761) & 0xFFFFFFFF)))
+    core.rng.advance()
+
+    for n, v in new_state.items():
+        scope.var(n).get_tensor()._array = v
+    results = []
+    for name, v in zip(fetch_names, fetches):
+        results.append(np.asarray(v) if return_numpy else v)
+    return results

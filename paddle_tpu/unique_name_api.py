@@ -1,0 +1,2 @@
+"""fluid.unique_name public API (re-export of utils.unique_name)."""
+from .utils.unique_name import generate, guard, switch  # noqa: F401

@@ -1,0 +1,46 @@
+"""Unique name generator (reference: python/paddle/fluid/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix=""):
+        self.ids = defaultdict(int)
+        self.prefix = prefix
+
+    def __call__(self, key):
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return self.prefix + "_".join([key, str(tmp)])
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return generator(key)
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    global generator
+    old = generator
+    if isinstance(new_generator, str):
+        generator = UniqueNameGenerator(new_generator)
+    elif new_generator is None:
+        generator = UniqueNameGenerator()
+    else:
+        generator = new_generator
+    try:
+        yield
+    finally:
+        generator = old
+
+
+def switch(new_generator=None):
+    global generator
+    old = generator
+    generator = new_generator or UniqueNameGenerator()
+    return old

@@ -1,0 +1,11 @@
+"""Test harness config: force a virtual 8-device CPU platform so mesh /
+collective tests run anywhere (SURVEY.md §4: the reference has no fake
+device backend and skips multi-GPU tests without hardware — we do better
+via XLA host-platform device simulation)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

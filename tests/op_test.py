@@ -1,0 +1,174 @@
+"""OpTest harness.
+
+Parity with the reference's operator-test contract
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:170):
+a test declares op_type/inputs/attrs/outputs (numpy reference);
+`check_output` builds a one-op program and compares; `check_grad`
+compares analytic grads (from the auto-VJP grad op via append_backward)
+against numeric finite differences.
+"""
+from __future__ import annotations
+
+import unittest
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+from paddle_tpu.backward import append_backward
+from paddle_tpu.core import CoreExecutor, CPUPlace, Scope
+from paddle_tpu.core.registry import OpInfoMap
+from paddle_tpu.core.tensor import LoDTensor
+
+
+class OpTest(unittest.TestCase):
+    op_type: str = ""
+
+    def _as_items(self, spec):
+        """inputs/outputs may be {slot: array} or {slot: [(name, array), ...]}"""
+        items = []
+        for slot, v in spec.items():
+            if isinstance(v, list) and v and isinstance(v[0], tuple):
+                items.append((slot, v))
+            else:
+                items.append((slot, [(slot.lower(), v)]))
+        return items
+
+    def _build(self):
+        prog = framework.Program()
+        block = prog.global_block()
+        in_map, feed = {}, {}
+        lods = {}
+        for slot, entries in self._as_items(self.inputs):
+            names = []
+            for name, arr in entries:
+                lod = None
+                if isinstance(arr, tuple):  # (array, lod) like the reference
+                    arr, lod = arr
+                arr = np.asarray(arr)
+                v = block.create_var(name=name, shape=list(arr.shape),
+                                     dtype=str(arr.dtype),
+                                     lod_level=1 if lod else 0)
+                v.stop_gradient = False
+                names.append(name)
+                if lod:
+                    t = LoDTensor()
+                    t.set(arr)
+                    t.set_recursive_sequence_lengths(lod)
+                    feed[name] = t
+                else:
+                    feed[name] = arr
+            in_map[slot] = names
+        out_map = {}
+        fetch = []
+        for slot, entries in self._as_items(self.outputs):
+            names = []
+            for name, arr in entries:
+                names.append(name)
+                fetch.append((name, arr))
+            out_map[slot] = names
+        block.append_op(self.op_type, in_map, out_map,
+                        dict(getattr(self, "attrs", {})))
+        return prog, feed, fetch
+
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=None):
+        prog, feed, fetch = self._build()
+        exe = fluid.Executor(CPUPlace())
+        scope = Scope()
+        names = [n for n, _ in fetch]
+        with fluid.scope_guard(scope):
+            got = exe.run(prog, feed=feed, fetch_list=names)
+        for (name, want), g in zip(fetch, got):
+            if no_check_set and name in no_check_set:
+                continue
+            if isinstance(want, tuple):
+                want = want[0]
+            want = np.asarray(want)
+            np.testing.assert_allclose(
+                np.asarray(g).astype(np.float64),
+                want.astype(np.float64),
+                atol=atol, rtol=rtol,
+                err_msg="output %r of op %r mismatch" % (name, self.op_type))
+
+    def check_grad(self, inputs_to_check: List[str], output_names,
+                   max_relative_error=0.005, no_grad_set=None,
+                   numeric_grad_delta=1e-3):
+        if isinstance(output_names, str):
+            output_names = [output_names]
+        # slot names -> var names (convention: first entry of the slot)
+        slot_to_var = {slot: entries[0][0]
+                       for slot, entries in self._as_items(self.outputs)}
+        output_names = [slot_to_var.get(n, n) for n in output_names]
+        prog, feed, fetch = self._build()
+        block = prog.global_block()
+        # scalar objective: sum of mean of each requested output
+        parts = []
+        for on in output_names:
+            m = block.create_var(name="__mean_%s" % on, shape=(),
+                                 dtype="float32")
+            block.append_op("mean", {"X": on}, {"Out": m})
+            parts.append("__mean_%s" % on)
+        if len(parts) == 1:
+            loss_name = parts[0]
+        else:
+            loss_name = "__loss__"
+            block.append_op("sum", {"X": parts}, {"Out": loss_name})
+        loss = block.var(loss_name)
+        append_backward(loss, parameter_list=list(inputs_to_check),
+                        no_grad_set=no_grad_set)
+
+        exe = fluid.Executor(CPUPlace())
+        grad_names = [framework.grad_var_name(n) for n in inputs_to_check]
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            analytic = exe.run(prog, feed=feed, fetch_list=grad_names)
+
+        # numeric FD on the forward-only objective
+        fwd_prog, feed2, _ = self._build()
+        fblock = fwd_prog.global_block()
+        parts = []
+        for on in output_names:
+            m = fblock.create_var(name="__mean_%s" % on, shape=(),
+                                  dtype="float32")
+            fblock.append_op("mean", {"X": on}, {"Out": m})
+            parts.append("__mean_%s" % on)
+        if len(parts) == 1:
+            floss = parts[0]
+        else:
+            floss = "__loss__"
+            fblock.append_op("sum", {"X": parts}, {"Out": floss})
+
+        def objective(feed_d):
+            s = Scope()
+            with fluid.scope_guard(s):
+                (v,) = exe.run(fwd_prog, feed=feed_d, fetch_list=[floss])
+            return float(np.asarray(v).reshape(()))
+
+        for name, g in zip(inputs_to_check, analytic):
+            base = feed2[name]
+            if isinstance(base, LoDTensor):
+                continue
+            base = np.asarray(base, dtype=np.float64)
+            num = np.zeros_like(base)
+            it = np.nditer(base, flags=["multi_index"])
+            while not it.finished:
+                idx = it.multi_index
+                delta = numeric_grad_delta
+                fplus = dict(feed2)
+                pert = base.copy()
+                pert[idx] += delta
+                fplus[name] = pert.astype(feed2[name].dtype)
+                fminus = dict(feed2)
+                pert2 = base.copy()
+                pert2[idx] -= delta
+                fminus[name] = pert2.astype(feed2[name].dtype)
+                num[idx] = (objective(fplus) - objective(fminus)) / (2 * delta)
+                it.iternext()
+            a = np.asarray(g, dtype=np.float64)
+            denom = np.maximum(np.maximum(np.abs(a), np.abs(num)), 1e-3)
+            rel = np.max(np.abs(a - num) / denom) if a.size else 0.0
+            self.assertLessEqual(
+                rel, max_relative_error,
+                "gradient of %r for op %r: max rel err %g" % (
+                    name, self.op_type, rel))
