@@ -198,9 +198,14 @@ def append_backward(
             if slot.name in op.outputs:
                 g_inputs[slot.name] = list(op.outputs[slot.name])
 
-        # outputs: a fresh partial-grad name per diffable input var
+        # outputs: a fresh partial-grad name per diffable input var.
+        # no_grad forward slots (labels, masks) never get a grad binding —
+        # the grad kernel won't write them, and binding one would leave an
+        # uninitialized var feeding the downstream sum (ADVICE r1 #3).
         g_outputs = {}
         for slot in info.inputs:
+            if slot.no_grad:
+                continue
             names = op.input(slot.name)
             if not names:
                 continue

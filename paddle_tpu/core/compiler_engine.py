@@ -33,12 +33,21 @@ _cache: Dict = {}
 
 
 def _program_version(program) -> Tuple:
-    return (id(program), program._op_id,
+    return (program._uid, program._op_id,
             tuple(len(b.ops) for b in program.blocks))
 
 
+_analysis_cache: Dict = {}
+
+
 def _analyze(program):
-    """Read-before-write set R (external inputs) and written set W."""
+    """Read-before-write set R (external inputs) and written set W.
+    Cached per program version — a full-program scan per step is real
+    overhead on 1000-op programs."""
+    key = _program_version(program)
+    hit = _analysis_cache.get(key)
+    if hit is not None:
+        return hit
     written: Set[str] = set()
     read_first: Set[str] = set()
     for op in program.global_block().ops:
@@ -48,7 +57,15 @@ def _analyze(program):
         for n in op.output_arg_names:
             if n:
                 written.add(n)
-    return read_first, written
+    # persistable outputs that must land back in the scope (params,
+    # optimizer state, BN stats) — also shape-stable per version
+    block = program.global_block()
+    persist_written = frozenset(
+        n for n in written
+        if (v := block._find_var_recursive(n)) is not None and v.persistable)
+    result = (read_first, written, persist_written)
+    _analysis_cache[key] = result
+    return result
 
 
 def _op_seed(step_seed, op_id: int):
@@ -140,7 +157,7 @@ def run_compiled_program(core, program, scope: Scope, feed: Dict,
             feed_vals[name] = jnp.asarray(np.asarray(value))
     feed_names = tuple(sorted(feed_vals))
 
-    read_first, written = _analyze(program)
+    read_first, written, persist_written = _analyze(program)
     state_names = []
     state = {}
     for n in sorted(read_first - set(feed_names)):
@@ -156,13 +173,7 @@ def run_compiled_program(core, program, scope: Scope, feed: Dict,
     state_names = tuple(state_names)
     # every written persistable (params from startup programs, optimizer
     # state, BN running stats) must land back in the scope
-    block = program.global_block()
-    out_state_names = set(state_names)
-    for n in written:
-        v = block._find_var_recursive(n)
-        if v is not None and v.persistable:
-            out_state_names.add(n)
-    out_state_names = tuple(sorted(out_state_names))
+    out_state_names = tuple(sorted(set(state_names) | persist_written))
 
     fn = compile_program(program, feed_names, fetch_names, state_names,
                          out_state_names)

@@ -277,6 +277,11 @@ def _vjp_grad_impl(info: OpInfo, ins: Dict, attrs: Dict):
     bound = set(attrs.get(BOUND_OUTPUTS_ATTR) or ())
 
     fwd_ins = {s.name: ins.get(s.name) for s in info.inputs}
+    # Executor-injected pseudo-inputs (the traced RNG seed) must reach the
+    # re-run forward too — they are not declared slots, and are never
+    # differentiated. Without this, needs_rng forwards (dropout) KeyError
+    # inside the grad op.
+    rng_seed = ins.get(RNG_SEED_ATTR) if info.needs_rng else None
 
     # (slot, index_or_None) leaves we differentiate with respect to.
     wrt: List[Tuple[str, Optional[int]]] = []
@@ -311,6 +316,8 @@ def _vjp_grad_impl(info: OpInfo, ins: Dict, attrs: Dict):
         for s in info.inputs:
             v = fwd_ins.get(s.name)
             rebuilt[s.name] = list(v) if s.duplicable and v is not None else v
+        if rng_seed is not None:
+            rebuilt[RNG_SEED_ATTR] = rng_seed
         for (n, i), val in zip(wrt, diff_vals):
             if i is None:
                 rebuilt[n] = val
@@ -335,6 +342,8 @@ def _vjp_grad_impl(info: OpInfo, ins: Dict, attrs: Dict):
                  is not None else fwd_ins.get(s.name))
         for s in info.inputs
     }
+    if rng_seed is not None:
+        probe_ins[RNG_SEED_ATTR] = rng_seed
     probe = info.fn(probe_ins, fwd_attrs)
     cots = []
     k = 0

@@ -98,7 +98,11 @@ _global_scope = Scope()
 
 
 def global_scope() -> Scope:
-    return _global_scope
+    """The scope Executor.run defaults to. Like the reference's
+    fluid.global_scope()/_switch_scope pair (executor.py:67-95), a
+    scope_guard swaps what this returns — otherwise guarded runs would
+    silently write params into the process-global scope."""
+    return get_current_scope()
 
 
 class _ScopeGuard:

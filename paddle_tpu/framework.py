@@ -316,8 +316,15 @@ def infer_op_shapes(block: Block, op: Operator) -> None:
 # ---------------------------------------------------------------------------
 
 
+_program_uid_counter = [0]
+
+
 class Program:
     def __init__(self):
+        # process-unique id for compile caches: unlike id(), never reused
+        # after GC, so a fresh Program can't alias a dead one's cache entry
+        _program_uid_counter[0] += 1
+        self._uid = _program_uid_counter[0]
         self.blocks: List[Block] = [Block(self, 0)]
         self._current_block_idx = 0
         self._op_id = 0
@@ -366,6 +373,8 @@ class Program:
         import copy
 
         p = Program.__new__(Program)
+        _program_uid_counter[0] += 1
+        p._uid = _program_uid_counter[0]
         p.blocks = []
         p._current_block_idx = 0
         p._op_id = self._op_id

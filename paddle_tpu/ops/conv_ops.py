@@ -202,24 +202,46 @@ _POOL_ATTRS = {
 }
 
 
-def _pool2d_impl(x, attrs):
+def _ceil_extra_pads(spatial, ksize, strides, pads, ceil_mode):
+    """Per-dim (lo, hi) pads; ceil_mode adds extra hi pad so the output
+    size follows ceil((H + pl + ph - k)/s) + 1 (reference pooling.cc)."""
+    out = []
+    for size, k, s, (lo, hi) in zip(spatial, ksize, strides, pads):
+        if ceil_mode:
+            n_out = -(-(size + lo + hi - k) // s) + 1  # ceil div
+            extra = (n_out - 1) * s + k - (size + lo + hi)
+            hi += max(0, extra)
+        out.append((lo, hi))
+    return out
+
+
+def _pool_impl(x, attrs, ndim):
+    """Rank-generic max/avg pooling over the trailing ``ndim`` spatial dims
+    of an NC... tensor. Covers ceil_mode (extra hi padding), exclusive avg
+    (valid-element count via a ones reduce_window), and adaptive pooling."""
     ptype = attrs.get("pooling_type", "max")
+    spatial_axes = tuple(range(2, 2 + ndim))
     if attrs.get("global_pooling", False) or (
-        attrs.get("adaptive", False) and list(attrs.get("ksize")) == [1, 1]
+        attrs.get("adaptive", False) and list(attrs.get("ksize")) == [1] * ndim
     ):
         f = jnp.max if ptype == "max" else jnp.mean
-        return f(x, axis=(2, 3), keepdims=True)
+        return f(x, axis=spatial_axes, keepdims=True)
     if attrs.get("adaptive", False):
-        oh, ow = attrs["ksize"]
-        h, w = x.shape[2], x.shape[3]
+        osize = attrs["ksize"]
         # adaptive pooling via even split (requires divisibility, the
         # common CNN case; reference supports ragged windows)
-        x4 = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
+        new_shape = list(x.shape[:2])
+        red_axes = []
+        for i, o in enumerate(osize):
+            new_shape += [o, x.shape[2 + i] // o]
+            red_axes.append(2 + 2 * i + 1)
         f = jnp.max if ptype == "max" else jnp.mean
-        return f(x4, axis=(3, 5))
+        return f(x.reshape(new_shape), axis=tuple(red_axes))
     ksize = tuple(attrs["ksize"])
-    strides = tuple(attrs.get("strides", [1, 1]))
-    pads = _norm_pads(attrs.get("paddings", [0, 0]), 2)
+    strides = tuple(attrs.get("strides", [1] * ndim))
+    pads = _norm_pads(attrs.get("paddings", [0] * ndim), ndim)
+    pads = _ceil_extra_pads(x.shape[2:], ksize, strides, pads,
+                            attrs.get("ceil_mode", False))
     pad_cfg = [(0, 0), (0, 0)] + list(pads)
     dims = (1, 1) + ksize
     strd = (1, 1) + strides
@@ -228,10 +250,14 @@ def _pool2d_impl(x, attrs):
         return lax.reduce_window(x, init, lax.max, dims, strd, pad_cfg)
     s = lax.reduce_window(x, 0.0, lax.add, dims, strd, pad_cfg)
     if attrs.get("exclusive", True):
-        ones = jnp.ones(x.shape[2:], dtype=x.dtype)[None, None]
+        ones = jnp.ones(x.shape[2:], dtype=x.dtype)[(None, None)]
         cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strd, pad_cfg)
         return s / cnt
     return s / float(np.prod(ksize))
+
+
+def _pool2d_impl(x, attrs):
+    return _pool_impl(x, attrs, 2)
 
 
 @register_op(
@@ -252,23 +278,7 @@ def _pool2d(ins, attrs):
            "paddings": [0, 0, 0]},
 )
 def _pool3d(ins, attrs):
-    x = ins["X"]
-    ptype = attrs.get("pooling_type", "max")
-    if attrs.get("global_pooling", False):
-        f = jnp.max if ptype == "max" else jnp.mean
-        return {"Out": f(x, axis=(2, 3, 4), keepdims=True)}
-    ksize = tuple(attrs["ksize"])
-    strides = tuple(attrs.get("strides", [1, 1, 1]))
-    pads = _norm_pads(attrs.get("paddings", [0, 0, 0]), 3)
-    pad_cfg = [(0, 0), (0, 0)] + list(pads)
-    dims = (1, 1) + ksize
-    strd = (1, 1) + strides
-    if ptype == "max":
-        out = lax.reduce_window(x, -jnp.inf, lax.max, dims, strd, pad_cfg)
-    else:
-        s = lax.reduce_window(x, 0.0, lax.add, dims, strd, pad_cfg)
-        out = s / float(np.prod(ksize))
-    return {"Out": out}
+    return {"Out": _pool_impl(ins["X"], attrs, 3)}
 
 
 @register_op(
@@ -289,8 +299,16 @@ def _interpolate(ins, attrs):
     method = attrs.get("interp_method", "bilinear")
     align = attrs.get("align_corners", True)
     if method == "nearest":
-        ridx = jnp.floor(jnp.arange(oh) * (h / oh)).astype(jnp.int32)
-        cidx = jnp.floor(jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+        # align_corners: ratio=(in-1)/(out-1), index=round(i*ratio)
+        # (reference interpolate_op.h NearestNeighborInterpolate)
+        if align and oh > 1:
+            ridx = jnp.round(jnp.arange(oh) * ((h - 1) / (oh - 1))).astype(jnp.int32)
+        else:
+            ridx = jnp.floor(jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+        if align and ow > 1:
+            cidx = jnp.round(jnp.arange(ow) * ((w - 1) / (ow - 1))).astype(jnp.int32)
+        else:
+            cidx = jnp.floor(jnp.arange(ow) * (w / ow)).astype(jnp.int32)
         out = x[:, :, ridx][:, :, :, cidx]
         return {"Out": out}
     # bilinear

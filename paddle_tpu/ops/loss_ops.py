@@ -136,7 +136,8 @@ def _log_loss(ins, attrs):
 
 @register_op(
     "huber_loss",
-    inputs=[In("X", no_grad=True), In("Y")],
+    # reference huber_loss_op.h:108,116 emits BOTH dX (sign -1) and dY
+    inputs=[In("X"), In("Y")],
     outputs=[Out("Out"), Out("Residual", no_grad=True)],
     attrs={"delta": 1.0},
 )

@@ -255,10 +255,11 @@ def _ftrl(ins, attrs):
     else:
         sigma = (jnp.power(new_accum, -lr_power) - jnp.power(sq_accum, -lr_power)) / lr
     lin_out = lin_accum + g - sigma * ins["Param"]
+    # reference ftrl_op.h shrink denominator uses 2*l2: y = sqrt/lr + 2*l2
     if lr_power == -0.5:
-        x = l2 + jnp.sqrt(new_accum) / lr
+        x = 2.0 * l2 + jnp.sqrt(new_accum) / lr
     else:
-        x = l2 + jnp.power(new_accum, -lr_power) / lr
+        x = 2.0 * l2 + jnp.power(new_accum, -lr_power) / lr
     pre = jnp.clip(lin_out, -l1, l1) - lin_out
     p_out = jnp.where(jnp.abs(lin_out) > l1, pre / x, jnp.zeros_like(pre))
     return {

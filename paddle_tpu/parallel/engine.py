@@ -61,10 +61,10 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     nranks = int(np.prod(list(mesh.shape.values())))
 
     # one-time collective rewrite (idempotent per program)
-    if id(program) not in _transpiled:
+    if program._uid not in _transpiled:
         if nranks > 1:
             insert_allreduce_ops(program, nranks)
-        _transpiled.add(id(program))
+        _transpiled.add(program._uid)
 
     fetch_names = tuple(f if isinstance(f, str) else f.name
                         for f in fetch_list)
@@ -75,7 +75,7 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
         feed_vals[name] = arr
     feed_names = tuple(sorted(feed_vals))
 
-    read_first, written = _analyze(program)
+    read_first, written, persist_written = _analyze(program)
     state = {}
     for n in sorted(read_first - set(feed_names)):
         var = scope.find_var(n)
@@ -84,12 +84,7 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
         state[n] = var.raw().array
     state_names = tuple(sorted(state))
     block = program.global_block()
-    out_state_names = set(state_names)
-    for n in written:
-        v = block._find_var_recursive(n)
-        if v is not None and v.persistable:
-            out_state_names.add(n)
-    out_state_names = tuple(sorted(out_state_names))
+    out_state_names = tuple(sorted(set(state_names) | persist_written))
 
     key = (_program_version(program), feed_names, fetch_names, state_names,
            out_state_names, id(mesh), axis_name)
