@@ -1,0 +1,204 @@
+"""Benchmark driver — prints ONE JSON line on stdout.
+
+Protocol (BASELINE.md): synthetic data, warm-up excluded, timed steps run
+fetch-free (results stay on device; a single fetch after the loop syncs)
+so host<->device transfer latency does not pollute device throughput.
+
+Headline metric: ResNet-50 ImageNet images/sec on the one available chip
+(BASELINE.json north-star config 2). The reference publishes no in-repo
+numbers; ``vs_baseline`` is computed against the fluid-era CUDA per-chip
+anchor of 360 images/sec (ResNet-50 fp32 on the V100 generation the
+reference targets) — the north star asks for >=90% of CUDA per-chip.
+Secondary metrics (MNIST MLP steps/sec, MFU estimate) ride in "extras".
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+CUDA_PER_CHIP_ANCHOR_IMG_S = 360.0  # ResNet-50 fp32 per-chip, V100 era
+
+
+def _build_resnet50(batch, use_bf16=False):
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data(name="img", shape=[batch, 3, 224, 224],
+                         dtype="float32")
+        label = fluid.data(name="label", shape=[batch, 1], dtype="int64")
+        pred = models.resnet50(img)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        opt = fluid.optimizer.MomentumOptimizer(learning_rate=0.1,
+                                                momentum=0.9)
+        if use_bf16:
+            try:
+                from paddle_tpu.contrib import mixed_precision as mp
+            except ImportError:
+                use_bf16 = False  # AMP not built yet — measure f32
+            else:
+                opt = mp.decorate(opt, use_dynamic_loss_scaling=False)
+        opt.minimize(loss)
+    return main, startup, loss, use_bf16
+
+
+def _build_mnist_mlp(batch):
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[batch, 784], dtype="float32")
+        label = fluid.data(name="label", shape=[batch, 1], dtype="int64")
+        pred = models.mlp(x)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    return main, startup, loss
+
+
+def _time_steps(exe, main, feed, loss, warmup=3, iters=20):
+    """Timed steps with device-side sync per step.
+
+    Fetches stay on device (``return_numpy=False``) so only ONE program
+    variant compiles and no per-step device->host transfer pollutes the
+    measurement (this host's transfer path has a large fixed cost); the
+    single untimed d2h at the end reads the final loss for a sanity check.
+    """
+    import jax
+
+    out = None
+    for _ in range(warmup):
+        (out,) = exe.run(main, feed=feed, fetch_list=[loss],
+                         return_numpy=False)
+    jax.block_until_ready(out.array)
+    # BASELINE.md protocol: median of 5 windows (the shared remote device
+    # pool this runs on has high run-to-run variance).
+    windows = []
+    per_window = max(1, iters // 5)
+    for _ in range(5):
+        t0 = time.time()
+        for _ in range(per_window):
+            (out,) = exe.run(main, feed=feed, fetch_list=[loss],
+                             return_numpy=False)
+        jax.block_until_ready(out.array)  # drain the async queue
+        windows.append((time.time() - t0) / per_window)
+    dt = float(np.median(windows))
+    return dt, float(np.asarray(out.array).ravel()[0])
+
+
+def bench_resnet50(batch=64, iters=20, use_bf16=False):
+    import paddle_tpu as fluid
+
+    main, startup, loss, use_bf16 = _build_resnet50(batch,
+                                                    use_bf16=use_bf16)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": rng.rand(batch, 3, 224, 224).astype("float32"),
+        "label": rng.randint(0, 1000, (batch, 1)).astype("int64"),
+    }
+    dt, final_loss = _time_steps(exe, main, feed, loss, iters=iters)
+    if not np.isfinite(final_loss):
+        raise RuntimeError("resnet50 diverged: loss=%r" % final_loss)
+    return {"images_per_sec": batch / dt, "step_ms": dt * 1e3,
+            "batch": batch, "loss": final_loss, "bf16": use_bf16}
+
+
+def bench_mnist_mlp(batch=512, iters=30):
+    import paddle_tpu as fluid
+
+    main, startup, loss = _build_mnist_mlp(batch)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "x": rng.rand(batch, 784).astype("float32"),
+        "label": rng.randint(0, 10, (batch, 1)).astype("int64"),
+    }
+    dt, final_loss = _time_steps(exe, main, feed, loss, iters=iters)
+    if not np.isfinite(final_loss):
+        raise RuntimeError("mnist mlp diverged: loss=%r" % final_loss)
+    return {"steps_per_sec": 1.0 / dt, "examples_per_sec": batch / dt,
+            "step_ms": dt * 1e3, "batch": batch, "loss": final_loss}
+
+
+def _run_one(name, use_bf16):
+    """Child-process entry: bench one model, print its JSON."""
+    if name == "mnist_mlp":
+        print(json.dumps(bench_mnist_mlp()))
+    elif name == "resnet50":
+        rn = bench_resnet50(use_bf16=use_bf16)
+        # ResNet-50 train step ~= 3x fwd FLOPs; fwd ~= 4.1 GFLOP/img @224
+        flops_per_img = 3 * 4.1e9
+        peak = 197e12 if rn["bf16"] else 98.5e12  # v5e MXU peak bf16/fp32
+        rn["mfu_est"] = rn["images_per_sec"] * flops_per_img / peak
+        print(json.dumps(rn))
+    else:
+        raise SystemExit("unknown model %r" % name)
+
+
+def _bench_subprocess(name, use_bf16):
+    """Each model benches in its own process: the remote device runtime
+    degrades badly when multiple compiled programs share a process (its
+    executable cache thrashes), which would corrupt the measurement."""
+    import subprocess
+
+    args = [sys.executable, __file__, "--model=" + name]
+    if not use_bf16:
+        args.append("--no-bf16")
+    proc = subprocess.run(args, capture_output=True, text=True, timeout=560)
+    if proc.returncode != 0:
+        raise RuntimeError("bench %s failed: %s" % (name,
+                                                    proc.stderr[-2000:]))
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main():
+    use_bf16 = "--no-bf16" not in sys.argv
+    for a in sys.argv[1:]:
+        if a.startswith("--model="):
+            _run_one(a.split("=", 1)[1], use_bf16)
+            return
+
+    extras = {}
+    t_start = time.time()
+    try:
+        extras["mnist_mlp"] = _bench_subprocess("mnist_mlp", use_bf16)
+    except Exception as e:  # keep the headline alive
+        extras["mnist_mlp_error"] = repr(e)
+        print("mnist mlp bench failed: %r" % e, file=sys.stderr)
+    try:
+        rn = _bench_subprocess("resnet50", use_bf16)
+    except Exception as e:
+        if use_bf16:
+            print("bf16 resnet bench failed (%r); retrying f32" % e,
+                  file=sys.stderr)
+            rn = _bench_subprocess("resnet50", False)
+        else:
+            raise
+    extras["resnet50"] = rn
+    extras["wall_s"] = time.time() - t_start
+    try:
+        import jax
+
+        extras["device"] = str(jax.devices()[0])
+    except Exception:
+        pass
+    result = {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(rn["images_per_sec"], 2),
+        "unit": "images/sec",
+        "vs_baseline": round(rn["images_per_sec"] / CUDA_PER_CHIP_ANCHOR_IMG_S,
+                             4),
+        "extras": extras,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

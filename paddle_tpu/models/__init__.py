@@ -1,0 +1,9 @@
+"""Built-in model families (fluid-style graph builders).
+
+These are the benchmark/book models the reference exercises in
+tests/book and its north-star configs; each is a plain function that
+appends ops to the current program via the ``layers`` API.
+"""
+from .lenet import lenet  # noqa: F401
+from .mlp import mlp  # noqa: F401
+from .resnet import resnet, resnet50, resnet_cifar  # noqa: F401

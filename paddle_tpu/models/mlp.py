@@ -1,0 +1,14 @@
+"""Plain MLP — the book MNIST softmax/multilayer models.
+
+Parity: /root/reference/python/paddle/fluid/tests/book/
+test_recognize_digits.py:38 (multilayer_perceptron).
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def mlp(x, hidden_sizes=(512, 512), class_dim=10, act="relu"):
+    for h in hidden_sizes:
+        x = layers.fc(x, size=h, act=act)
+    return layers.fc(x, size=class_dim, act="softmax")

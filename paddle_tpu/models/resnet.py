@@ -1,0 +1,87 @@
+"""ResNet model family built on the fluid layer surface.
+
+Mirrors the model the reference benchmarks with ``fluid.layers.conv2d`` +
+``batch_norm`` + residual shortcuts (the north-star ResNet-50 config in
+BASELINE.json; reference layer APIs at
+/root/reference/python/paddle/fluid/layers/nn.py conv2d/batch_norm/pool2d).
+The graph here is plain static-IR ops; the whole block compiles to one
+XLA program so conv+BN+relu fuse on-chip — no cuDNN-style per-kernel
+dispatch.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def _conv_bn(x, num_filters, filter_size, stride=1, act=None, is_test=False):
+    conv = layers.conv2d(
+        x,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        stride=stride,
+        padding=(filter_size - 1) // 2,
+        act=None,
+        bias_attr=False,
+    )
+    return layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def _shortcut(x, ch_out, stride, is_test=False):
+    ch_in = x.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return _conv_bn(x, ch_out, 1, stride, is_test=is_test)
+    return x
+
+
+def _bottleneck(x, num_filters, stride, is_test=False):
+    conv0 = _conv_bn(x, num_filters, 1, act="relu", is_test=is_test)
+    conv1 = _conv_bn(conv0, num_filters, 3, stride, act="relu",
+                     is_test=is_test)
+    conv2 = _conv_bn(conv1, num_filters * 4, 1, act=None, is_test=is_test)
+    short = _shortcut(x, num_filters * 4, stride, is_test=is_test)
+    return layers.relu(layers.elementwise_add(short, conv2))
+
+
+def _basic_block(x, num_filters, stride, is_test=False):
+    conv0 = _conv_bn(x, num_filters, 3, stride, act="relu", is_test=is_test)
+    conv1 = _conv_bn(conv0, num_filters, 3, act=None, is_test=is_test)
+    short = _shortcut(x, num_filters, stride, is_test=is_test)
+    return layers.relu(layers.elementwise_add(short, conv1))
+
+
+_DEPTH_CFG = {
+    18: (_basic_block, [2, 2, 2, 2]),
+    34: (_basic_block, [3, 4, 6, 3]),
+    50: (_bottleneck, [3, 4, 6, 3]),
+    101: (_bottleneck, [3, 4, 23, 3]),
+    152: (_bottleneck, [3, 8, 36, 3]),
+}
+
+
+def resnet(input, class_dim=1000, depth=50, is_test=False):
+    """ImageNet-layout ResNet. ``input`` is NCHW [N, 3, H, W]."""
+    block_fn, counts = _DEPTH_CFG[depth]
+    x = _conv_bn(input, 64, 7, stride=2, act="relu", is_test=is_test)
+    x = layers.pool2d(x, pool_size=3, pool_type="max", pool_stride=2,
+                      pool_padding=1)
+    for stage, n_blocks in enumerate(counts):
+        for i in range(n_blocks):
+            stride = 2 if i == 0 and stage > 0 else 1
+            x = block_fn(x, 64 * (2 ** stage), stride, is_test=is_test)
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    return layers.fc(x, class_dim, act="softmax")
+
+
+def resnet50(input, class_dim=1000, is_test=False):
+    return resnet(input, class_dim, 50, is_test)
+
+
+def resnet_cifar(input, class_dim=10, n=3, is_test=False):
+    """CIFAR-layout ResNet (6n+2 layers; n=3 -> ResNet-20)."""
+    x = _conv_bn(input, 16, 3, act="relu", is_test=is_test)
+    for stage in range(3):
+        for i in range(n):
+            stride = 2 if i == 0 and stage > 0 else 1
+            x = _basic_block(x, 16 * (2 ** stage), stride, is_test=is_test)
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    return layers.fc(x, class_dim, act="softmax")

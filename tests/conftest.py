@@ -1,11 +1,21 @@
 """Test harness config: force a virtual 8-device CPU platform so mesh /
 collective tests run anywhere (SURVEY.md §4: the reference has no fake
 device backend and skips multi-GPU tests without hardware — we do better
-via XLA host-platform device simulation)."""
+via XLA host-platform device simulation).
+
+The axon TPU-tunnel site package registers its PJRT backend from
+sitecustomize at interpreter startup — BEFORE this file runs — and wins
+over the JAX_PLATFORMS env var. ``jax.config.update`` is the only
+override that still works at this point, so the platform is pinned via
+the config API (verified: yields 8 CpuDevice even with axon registered).
+"""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
