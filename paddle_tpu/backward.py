@@ -89,6 +89,13 @@ def append_backward(
     block = loss.block
     program = block.program
     program._appending_grad_times += 1
+    with program._backward_role_guard():
+        return _append_backward_impl(loss, block, program, parameter_list,
+                                     no_grad_set, checkpoints)
+
+
+def _append_backward_impl(loss, block, program, parameter_list=None,
+                          no_grad_set=None, checkpoints=None):
 
     no_grad = set()
     for b in program.blocks:

@@ -98,6 +98,20 @@ class Parameter(Variable):
         super().__init__(block, shape=shape, dtype=dtype, **kwargs)
 
 
+class OpRole:
+    """Op phase tags (reference framework.py op_role attr / OpProto roles).
+
+    Bitmask: Loss may combine with Forward/Backward."""
+
+    Forward = 0x0000
+    Backward = 0x0001
+    Optimize = 0x0002
+    RPC = 0x0004
+    Dist = 0x0008
+    LRSched = 0x0010
+    Loss = 0x0100
+
+
 class Operator:
     """One op in a Block: (type, slot->var-names, attrs).
 
@@ -112,6 +126,11 @@ class Operator:
         self.outputs: Dict[str, List[str]] = {}
         self.attrs: Dict[str, object] = dict(attrs or {})
         self._id = None  # set by Block.append_op
+        # role of the phase appending this op (reference: the op_role attr
+        # set by Program.op_role / _optimized_guard, framework.py:3602);
+        # clone(for_test=True) prunes Backward/Optimize ops by it.
+        prog = getattr(block, "program", None) if block is not None else None
+        self._role = getattr(prog, "_current_role", 0)
 
         for slot, arg in (inputs or {}).items():
             self.inputs[slot] = _to_name_list(arg)
@@ -332,10 +351,28 @@ class Program:
         self.random_seed = 0
         # op-role bookkeeping used by backward/optimizer passes
         self._appending_grad_times = 0
+        self._current_role = OpRole.Forward
 
     def _next_op_id(self):
         self._op_id += 1
         return self._op_id
+
+    @contextlib.contextmanager
+    def _role_guard(self, role):
+        """Ops appended inside carry `role` (reference _optimized_guard /
+        _backward_role_guard, framework.py:3602)."""
+        prev = self._current_role
+        self._current_role = role
+        try:
+            yield
+        finally:
+            self._current_role = prev
+
+    def _optimized_guard(self, param_and_grads=None):
+        return self._role_guard(OpRole.Optimize)
+
+    def _backward_role_guard(self):
+        return self._role_guard(OpRole.Backward)
 
     def global_block(self) -> Block:
         return self.blocks[0]
@@ -377,6 +414,7 @@ class Program:
         p._uid = _program_uid_counter[0]
         p.blocks = []
         p._current_block_idx = 0
+        p._current_role = OpRole.Forward
         p._op_id = self._op_id
         p._seed = self._seed
         p.random_seed = self.random_seed
@@ -392,10 +430,14 @@ class Program:
             for op in b.ops:
                 if for_test and op.type in _TRAIN_ONLY_SKIP:
                     continue
+                if for_test and op._role & (OpRole.Backward
+                                            | OpRole.Optimize):
+                    continue  # reference clone(for_test) prunes by op_role
                 nop = Operator(nb, op.type, None, None, dict(op.attrs))
                 nop.inputs = {k: list(v) for k, v in op.inputs.items()}
                 nop.outputs = {k: list(v) for k, v in op.outputs.items()}
                 nop._id = op._id
+                nop._role = op._role
                 if for_test and "is_test" in _op_attr_names(op.type):
                     nop.attrs["is_test"] = True
                 nb.ops.append(nop)

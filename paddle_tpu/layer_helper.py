@@ -41,9 +41,14 @@ class LayerHelper:
     # -- inputs -----------------------------------------------------------
     def input(self, input_param_name="input"):
         inputs = self.kwargs.get(input_param_name, [])
-        if isinstance(inputs, framework.Variable):
-            return [inputs]
-        return list(inputs)
+        if inputs is None:
+            return []
+        if isinstance(inputs, (list, tuple)):
+            return list(inputs)
+        # single tensor (static Variable or dygraph VarBase). Anything
+        # else would otherwise be iterated — a VarBase iterates into
+        # per-row traced slices, which is both wrong and pathological.
+        return [inputs]
 
     @property
     def param_attr(self):

@@ -147,6 +147,13 @@ class Optimizer:
                                no_grad_set, callbacks)
 
     def apply_gradients(self, params_grads):
+        # the clip/regularization/optimize ops all append (via
+        # LayerHelper) into the default main program, so that is the
+        # program whose role must flip to Optimize
+        with framework.default_main_program()._optimized_guard():
+            return self._apply_gradients_impl(params_grads)
+
+    def _apply_gradients_impl(self, params_grads):
         params_grads = sorted(params_grads, key=lambda x: x[0].name)
         if self._grad_clip is not None:
             from .clip import GradientClipByGlobalNorm
