@@ -1,0 +1,6 @@
+"""Mixed-precision training (reference contrib/mixed_precision)."""
+from . import fp16_lists  # noqa: F401
+from . import fp16_utils  # noqa: F401
+from .decorator import OptimizerWithMixedPrecision, decorate  # noqa: F401
+from .fp16_lists import AutoMixedPrecisionLists  # noqa: F401
+from .fp16_utils import rewrite_program  # noqa: F401

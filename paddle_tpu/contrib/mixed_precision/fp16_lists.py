@@ -1,0 +1,113 @@
+"""Op lists steering mixed-precision rewriting.
+
+Parity: /root/reference/python/paddle/fluid/contrib/mixed_precision/
+fp16_lists.py:20 (AutoMixedPrecisionLists; white/black/gray sets).
+TPU-first difference: the low-precision dtype is bfloat16, whose 8-bit
+exponent makes the reference's fp16 overflow-driven black-listing less
+critical — but the list semantics are kept so user overrides port over.
+"""
+from __future__ import annotations
+
+import copy
+
+
+class AutoMixedPrecisionLists:
+    """Merge built-in white/black lists with user-supplied overrides."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self._custom_white_list = custom_white_list
+        self._custom_black_list = custom_black_list
+        self.white_list = copy.copy(white_list)
+        self.black_list = copy.copy(black_list)
+        self.gray_list = copy.copy(gray_list)
+        self._update_list()
+
+    def _update_list(self):
+        if self._custom_white_list and self._custom_black_list:
+            for op_name in self._custom_white_list:
+                if op_name in self._custom_black_list:
+                    raise ValueError(
+                        "Custom white list overlap custom black list: %s"
+                        % op_name)
+        if self._custom_white_list:
+            for op_name in self._custom_white_list:
+                if op_name in self.black_list:
+                    self.black_list.remove(op_name)
+                self.white_list.add(op_name)
+        if self._custom_black_list:
+            for op_name in self._custom_black_list:
+                if op_name in self.white_list:
+                    self.white_list.remove(op_name)
+                self.black_list.add(op_name)
+
+
+# MXU-bound ops: always run in bf16 (reference fp16_lists.py white_list)
+white_list = {
+    "conv2d",
+    "conv3d",
+    "conv2d_transpose",
+    "matmul",
+    "mul",
+}
+
+# numerically sensitive reductions/losses/normalizations: keep f32
+# (reference fp16_lists.py black_list; normalization moved here from the
+# reference's gray set — the TPU policy keeps stats math in f32, which
+# costs nothing on bandwidth-bound elementwise ops)
+black_list = {
+    "exp",
+    "square",
+    "log",
+    "mean",
+    "sum",
+    "cos_sim",
+    "softmax",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "cross_entropy",
+    "cross_entropy2",
+    "batch_norm",
+    "layer_norm",
+    "instance_norm",
+    "group_norm",
+}
+
+# follow their inputs (reference gray_list)
+gray_list = {
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
+    "elementwise_mod",
+    "elementwise_floordiv",
+    "tanh",
+    "sigmoid",
+    "lookup_table",
+    "top_k",
+    "pool2d",
+    "pool3d",
+    "dropout",
+    "relu",
+    "relu6",
+    "leaky_relu",
+    "soft_relu",
+    "flatten2",
+    "stack",
+    "unstack",
+    "uniform_random_batch_size_like",
+    "gaussian_random",
+    "gaussian_random_batch_size_like",
+    "slice",
+    "rank",
+    "scale",
+    "transpose2",
+    "reshape2",
+    "gather",
+    "fill_constant",
+    "get_tensor_from_selected_rows",
+    "sign",
+    "cast",
+}

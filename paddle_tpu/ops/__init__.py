@@ -24,3 +24,4 @@ from . import sequence_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
+from . import amp_ops  # noqa: F401

@@ -1,0 +1,78 @@
+"""AMP support ops: gradient finiteness check/unscale + loss-scale update.
+
+Parity: the dynamic loss scaling machinery of
+/root/reference/python/paddle/fluid/contrib/mixed_precision/fp16_utils.py:283
+(there built from isfinite/fill/scale primitives). Here the two fused
+steps are single ops — a shape XLA fuses into the optimizer program —
+matching the check_finite_and_unscale / update_loss_scaling ops the
+reference framework grew immediately after this snapshot.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+@register_op(
+    "check_finite_and_unscale",
+    inputs=[In("X", duplicable=True, no_grad=True),
+            In("Scale", no_grad=True)],
+    outputs=[Out("Out", duplicable=True), Out("FoundInfinite")],
+    attrs={},
+)
+def _check_finite_and_unscale(ins, attrs):
+    xs = ins["X"] or []
+    scale = ins["Scale"]
+    inv = (1.0 / scale).astype(jnp.float32)
+    found = jnp.zeros((), dtype=bool)
+    for x in xs:
+        found = found | ~jnp.all(jnp.isfinite(x))
+    # On overflow, zero every grad so the optimizer update is a no-op —
+    # the XLA-friendly stand-in for the reference's conditional skip.
+    # (Must be where(), not masking by multiply: inf * 0 == nan.)
+    outs = []
+    for x in xs:
+        ux = (x.astype(jnp.float32) * inv).astype(x.dtype)
+        outs.append(jnp.where(found, jnp.zeros_like(ux), ux))
+    return {"Out": outs, "FoundInfinite": found.reshape(1)}
+
+
+@register_op(
+    "update_loss_scaling",
+    inputs=[In("FoundInfinite", no_grad=True),
+            In("PrevLossScaling", no_grad=True),
+            In("InGoodSteps", no_grad=True),
+            In("InBadSteps", no_grad=True)],
+    outputs=[Out("LossScaling"), Out("OutGoodSteps"), Out("OutBadSteps")],
+    attrs={
+        "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2,
+        "incr_ratio": 2.0,
+        "decr_ratio": 0.8,
+    },
+)
+def _update_loss_scaling(ins, attrs):
+    found = ins["FoundInfinite"].reshape(()).astype(bool)
+    scale = ins["PrevLossScaling"]
+    good = ins["InGoodSteps"]
+    bad = ins["InBadSteps"]
+    incr_n = attrs["incr_every_n_steps"]
+    decr_n = attrs["decr_every_n_nan_or_inf"]
+    incr_ratio = jnp.float32(attrs["incr_ratio"])
+    decr_ratio = jnp.float32(attrs["decr_ratio"])
+
+    bad_new = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+    good_new = jnp.where(found, jnp.zeros_like(good), good + 1)
+    # shrink after decr_n consecutive overflow steps
+    do_decr = bad_new >= decr_n
+    scale_decr = jnp.maximum(scale * decr_ratio, jnp.float32(1.0))
+    # grow after incr_n consecutive clean steps
+    do_incr = good_new >= incr_n
+    scale_incr = scale * incr_ratio
+    new_scale = jnp.where(do_decr, scale_decr,
+                          jnp.where(do_incr, scale_incr, scale))
+    good_out = jnp.where(do_incr | do_decr, jnp.zeros_like(good), good_new)
+    bad_out = jnp.where(do_decr, jnp.zeros_like(bad), bad_new)
+    return {"LossScaling": new_scale, "OutGoodSteps": good_out,
+            "OutBadSteps": bad_out}

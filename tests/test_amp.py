@@ -1,0 +1,119 @@
+"""Mixed-precision (AMP) tests.
+
+Contract parity with the reference's AMP suite
+(/root/reference/python/paddle/fluid/contrib/tests/test_fp16_utils.py
+pattern: rewrite correctness + training still converges + loss-scaling
+reacts to non-finite grads)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import mixed_precision as mp
+
+
+def _build(use_amp=False, dyn=False, lr=0.5, decr_every=2):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[64, 32], dtype="float32")
+        y = fluid.data(name="y", shape=[64, 1], dtype="int64")
+        h = fluid.layers.fc(x, 64, act="relu")
+        pred = fluid.layers.fc(h, 10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        opt = fluid.optimizer.SGD(lr)
+        amp_opt = None
+        if use_amp:
+            amp_opt = mp.decorate(
+                opt, use_dynamic_loss_scaling=dyn,
+                init_loss_scaling=2 ** 10 if dyn else 1.0,
+                decr_every_n_nan_or_inf=decr_every)
+            amp_opt.minimize(loss)
+        else:
+            opt.minimize(loss)
+    return main, startup, loss, amp_opt
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(32, 10)
+    out = []
+    for _ in range(n):
+        xb = rng.randn(64, 32).astype("float32")
+        yb = (xb @ W).argmax(1).reshape(64, 1).astype("int64")
+        out.append((xb, yb))
+    return out
+
+
+class TestRewriteProgram:
+    def test_white_ops_get_bf16_casts(self):
+        main, startup, loss, _ = _build(use_amp=True)
+        blk = main.global_block()
+        n_bf16 = sum(1 for v in blk.vars.values() if v.dtype == "bfloat16")
+        assert n_bf16 > 0
+        cast_ops = [op for op in blk.ops if op.type == "cast"]
+        assert cast_ops, "no casts inserted"
+        # mul (fc matmul) must consume bf16 inputs
+        muls = [op for op in blk.ops
+                if op.type == "mul" and not op._role]
+        for op in muls:
+            for name in op.input_arg_names:
+                v = blk._find_var_recursive(name)
+                assert v.dtype == "bfloat16", (op, name, v.dtype)
+        # the loss stays f32
+        assert blk._find_var_recursive(loss.name).dtype == "float32"
+
+    def test_black_op_inputs_stay_f32(self):
+        main, _, _, _ = _build(use_amp=True)
+        blk = main.global_block()
+        for op in blk.ops:
+            if op.type == "cross_entropy":
+                for name in op.input_arg_names:
+                    v = blk._find_var_recursive(name)
+                    if v is not None and v.dtype != "int64":
+                        assert v.dtype == "float32", (name, v.dtype)
+
+
+class TestAmpTraining:
+    def _train(self, use_amp, dyn):
+        main, startup, loss, _ = _build(use_amp=use_amp, dyn=dyn)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = []
+            for xb, yb in _batches(50):
+                (l,) = exe.run(main, feed={"x": xb, "y": yb},
+                               fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+        return losses
+
+    def test_bf16_static_scaling_converges(self):
+        losses = self._train(True, False)
+        assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+    def test_bf16_dynamic_scaling_converges(self):
+        losses = self._train(True, True)
+        assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+
+class TestDynamicLossScaling:
+    def test_inf_batch_skips_update_and_shrinks_scale(self):
+        main, startup, loss, amp_opt = _build(use_amp=True, dyn=True,
+                                              decr_every=1)
+        scale_name = amp_opt.get_loss_scaling().name
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (xb, yb) = _batches(1, seed=3)[0]
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            w_name = main.global_block().all_parameters[0].name
+            w_before = np.asarray(scope.find_var(w_name).raw().array).copy()
+            s_before = float(np.asarray(
+                scope.find_var(scale_name).raw().array).ravel()[0])
+            bad = xb.copy()
+            bad[0, 0] = np.inf
+            exe.run(main, feed={"x": bad, "y": yb}, fetch_list=[loss])
+            w_after = np.asarray(scope.find_var(w_name).raw().array)
+            s_after = float(np.asarray(
+                scope.find_var(scale_name).raw().array).ravel()[0])
+        np.testing.assert_array_equal(w_before, w_after)
+        assert s_after < s_before, (s_before, s_after)

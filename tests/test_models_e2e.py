@@ -27,6 +27,25 @@ def _synth_mnist(rng, n):
     return x, y
 
 
+def _snapshot_persistables(program, scope):
+    out = {}
+    blk = program.global_block()
+    for name in blk.vars:
+        v = blk._find_var_recursive(name)
+        sv = scope.find_var(name)
+        if v is not None and v.persistable and sv is not None \
+                and sv.is_initialized():
+            out[name] = np.asarray(sv.raw().array).copy()
+    return out
+
+
+def _restore_persistables(scope, snap):
+    import jax.numpy as jnp
+
+    for name, arr in snap.items():
+        scope.var(name).get_tensor()._array = jnp.asarray(arr)
+
+
 def _build_lenet_train(batch, lr=0.01):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -149,21 +168,10 @@ class TestDataParallelParity:
         with fluid.scope_guard(scope):
             exe = fluid.Executor(fluid.TPUPlace())
             exe.run(startup)
-            snap = {}
-            blk = main.global_block()
-            for name in blk.vars:
-                v = blk._find_var_recursive(name)
-                sv = scope.find_var(name)
-                if v is not None and v.persistable and sv is not None \
-                        and sv.is_initialized():
-                    snap[name] = np.asarray(sv.raw().array)
+            snap = _snapshot_persistables(main, scope)
             (l_single,) = exe.run(main, feed=feed, fetch_list=[loss])
             l_single = float(np.asarray(l_single).ravel()[0])
-
-            import jax.numpy as jnp
-
-            for name, arr in snap.items():
-                scope.var(name).get_tensor()._array = jnp.asarray(arr)
+            _restore_persistables(scope, snap)
             compiled = fluid.CompiledProgram(main).with_data_parallel(
                 loss_name=loss.name)
             (l_dp,) = exe.run(compiled, feed=feed, fetch_list=[loss])
@@ -175,7 +183,6 @@ class TestDataParallelParity:
         the test_dist_base loss-comparison contract (reference
         test_dist_base.py:506)."""
         import jax
-        import jax.numpy as jnp
 
         if len(jax.devices()) < 8:
             pytest.skip("needs 8 (virtual) devices")
@@ -183,33 +190,18 @@ class TestDataParallelParity:
         rng = np.random.RandomState(4)
         batches = [_synth_mnist(rng, B) for _ in range(3)]
         main, startup, pred, loss = _build_lenet_train(B, lr=0.01)
-        blk = main.global_block()
-
-        def snapshot(scope):
-            out = {}
-            for name in blk.vars:
-                v = blk._find_var_recursive(name)
-                sv = scope.find_var(name)
-                if v is not None and v.persistable and sv is not None \
-                        and sv.is_initialized():
-                    out[name] = np.asarray(sv.raw().array).copy()
-            return out
-
-        def restore(scope, snap):
-            for name, arr in snap.items():
-                scope.var(name).get_tensor()._array = jnp.asarray(arr)
 
         scope = fluid.Scope()
         with fluid.scope_guard(scope):
             exe = fluid.Executor(fluid.TPUPlace())
             exe.run(startup)
-            init = snapshot(scope)
+            init = _snapshot_persistables(main, scope)
             single = []
             for x, y in batches:
                 (l,) = exe.run(main, feed={"img": x, "label": y},
                                fetch_list=[loss])
                 single.append(float(np.mean(np.asarray(l))))
-            restore(scope, init)
+            _restore_persistables(scope, init)
             compiled = fluid.CompiledProgram(main).with_data_parallel(
                 loss_name=loss.name)
             dp = []
