@@ -41,8 +41,7 @@ def _build_resnet50(batch, use_bf16=False):
             except ImportError:
                 use_bf16 = False  # AMP not built yet — measure f32
             else:
-                opt = mp.decorate(opt, use_dynamic_loss_scaling=False,
-                                  init_loss_scaling=1.0)
+                opt = mp.decorate(opt)  # bf16 defaults: no loss scaling
         opt.minimize(loss)
     return main, startup, loss, use_bf16
 

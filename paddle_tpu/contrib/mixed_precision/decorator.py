@@ -124,15 +124,21 @@ class OptimizerWithMixedPrecision:
         return optimize_ops, params_grads
 
 
-def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+def decorate(optimizer, amp_lists=None, init_loss_scaling=None,
              incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
              incr_ratio=2.0, decr_ratio=0.8,
-             use_dynamic_loss_scaling=True, dest_dtype="bfloat16"):
+             use_dynamic_loss_scaling=None, dest_dtype="bfloat16"):
     """Wrap `optimizer` for mixed-precision training (reference
     decorator.py:27 signature + TPU-native ``dest_dtype``).
 
-    With the bfloat16 default, pass use_dynamic_loss_scaling=False and
-    init_loss_scaling=1.0 unless fp16 parity is wanted."""
+    Scaling defaults key off the dtype: bfloat16 (the default) needs no
+    loss scaling (scale 1.0, dynamic off — bf16 shares f32's exponent
+    range); float16 gets the reference's defaults (2**15, dynamic on).
+    Explicit arguments always win."""
+    if init_loss_scaling is None:
+        init_loss_scaling = 1.0 if dest_dtype == "bfloat16" else 2 ** 15
+    if use_dynamic_loss_scaling is None:
+        use_dynamic_loss_scaling = dest_dtype != "bfloat16"
     return OptimizerWithMixedPrecision(
         optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
         incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio,

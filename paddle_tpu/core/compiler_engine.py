@@ -40,6 +40,32 @@ def _program_version(program) -> Tuple:
 _analysis_cache: Dict = {}
 
 
+def _block_rw(block) -> Tuple[Set[str], Set[str]]:
+    """(written, read-before-written) over a block, recursing through
+    while/conditional sub-blocks (their external reads are this block's
+    reads; their writes land in parent vars by name)."""
+    written: Set[str] = set()
+    read_first: Set[str] = set()
+    for op in block.ops:
+        sb = op.attrs.get("sub_block")
+        if op.type in ("while", "conditional_block") and sb is not None:
+            sw, sr = _block_rw(sb)
+            for n in sr | set(op.input_arg_names):
+                if n and n not in written:
+                    read_first.add(n)
+            for n in sw | set(op.output_arg_names):
+                if n:
+                    written.add(n)
+            continue
+        for n in op.input_arg_names:
+            if n and n not in written:
+                read_first.add(n)
+        for n in op.output_arg_names:
+            if n:
+                written.add(n)
+    return written, read_first
+
+
 def _analyze(program):
     """Read-before-write set R (external inputs) and written set W.
     Cached per program version — a full-program scan per step is real
@@ -48,15 +74,7 @@ def _analyze(program):
     hit = _analysis_cache.get(key)
     if hit is not None:
         return hit
-    written: Set[str] = set()
-    read_first: Set[str] = set()
-    for op in program.global_block().ops:
-        for n in op.input_arg_names:
-            if n and n not in written:
-                read_first.add(n)
-        for n in op.output_arg_names:
-            if n:
-                written.add(n)
+    written, read_first = _block_rw(program.global_block())
     # persistable outputs that must land back in the scope (params,
     # optimizer state, BN stats) — also shape-stable per version
     block = program.global_block()
@@ -75,9 +93,98 @@ def _op_seed(step_seed, op_id: int):
             + jnp.uint32((op_id * 131) & 0xFFFFFFFF))
 
 
+def block_is_traceable(block) -> bool:
+    """True if every op lowers to pure XLA (recursively through
+    while/conditional_block sub-blocks)."""
+    infos = OpInfoMap.instance()
+    for op in block.ops:
+        sb = op.attrs.get("sub_block")
+        if op.type in ("while", "conditional_block"):
+            if sb is None or not block_is_traceable(sb):
+                return False
+            continue
+        try:
+            info = infos.get(op.type)
+        except KeyError:
+            return False
+        if info.host_fn is not None or info.needs_lod:
+            return False
+    return True
+
+
+def _trace_while(block, op, env: Dict, step_seed) -> None:
+    """Lower the while op to lax.while_loop.
+
+    Reference semantics (operators/controlflow/while_op.cc): the body
+    writes parent-scope vars by name each trip. In SSA terms the loop
+    carry is {Condition} ∪ {parent vars the body writes}; vars the body
+    only reads are closed over; body temporaries stay inside the trace.
+    An iteration counter rides in the carry so stateful ops (dropout)
+    get a fresh RNG stream per trip.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    sub_block = op.attrs["sub_block"]
+    cond_name = op.input("Condition")[0]
+    writes = _block_rw(sub_block)[0]
+    carry_names = sorted({cond_name} | {n for n in writes if n in env})
+    if cond_name not in env:
+        raise NotImplementedError("while Condition %r not traced" % cond_name)
+
+    def cond_fn(state):
+        carry, _i = state
+        return carry[cond_name].reshape(()).astype(bool)
+
+    def body_fn(state):
+        carry, i = state
+        benv = dict(env)
+        benv.update(carry)
+        _trace_block(sub_block, benv,
+                     step_seed + jnp.uint32(0x9E3779B9) * i.astype(jnp.uint32))
+        return {n: benv[n] for n in carry_names}, i + 1
+
+    init = ({n: env[n] for n in carry_names}, jnp.uint32(1))
+    final_carry, _ = jax.lax.while_loop(cond_fn, body_fn, init)
+    env.update(final_carry)
+
+
+def _trace_conditional_block(block, op, env: Dict, step_seed) -> None:
+    """Lower conditional_block to lax.cond: true branch traces the sub
+    block, false branch keeps the carried vars unchanged."""
+    import jax
+
+    sub_block = op.attrs["sub_block"]
+    cond_name = op.input("Cond")[0]
+    if not op.attrs.get("is_scalar_condition", True):
+        raise NotImplementedError("non-scalar conditional_block")
+    writes = _block_rw(sub_block)[0]
+    carry_names = sorted(n for n in writes if n in env)
+
+    def true_fn(carry):
+        benv = dict(env)
+        benv.update(carry)
+        _trace_block(sub_block, benv, step_seed)
+        return {n: benv[n] for n in carry_names}
+
+    def false_fn(carry):
+        return carry
+
+    pred = env[cond_name].reshape(()).astype(bool)
+    out = jax.lax.cond(pred, true_fn, false_fn,
+                       {n: env[n] for n in carry_names})
+    env.update(out)
+
+
 def _trace_block(block, env: Dict, step_seed) -> None:
     infos = OpInfoMap.instance()
     for op in block.ops:
+        if op.type == "while":
+            _trace_while(block, op, env, step_seed)
+            continue
+        if op.type == "conditional_block":
+            _trace_conditional_block(block, op, env, step_seed)
+            continue
         info = infos.get(op.type)
         ins = {}
         for slot in info.inputs:

@@ -39,6 +39,8 @@ class Executor:
         self.place = _as_place(place)
         self._core = CoreExecutor(self.place)
         self._compiled_cache: Dict = {}
+        self._traceable_cache: Dict = {}
+        self._compile_fallbacks: Dict = {}
         self._closed = False
 
     def close(self):
@@ -70,28 +72,37 @@ class Executor:
         fetch_list = list(fetch_list or [])
 
         if self._can_whole_compile(program):
-            from .core.compiler_engine import run_compiled_program
+            from .core.compiler_engine import (_program_version,
+                                               run_compiled_program)
 
-            try:
-                return run_compiled_program(
-                    self._core, program, scope, feed, fetch_list, return_numpy
-                )
-            except NotImplementedError:
-                pass
+            ver = _program_version(program)
+            if ver not in self._compile_fallbacks:
+                try:
+                    return run_compiled_program(
+                        self._core, program, scope, feed, fetch_list,
+                        return_numpy)
+                except (NotImplementedError, TypeError) as e:
+                    # e.g. a while carry whose shape/dtype varies across
+                    # trips — valid for the host interpreter, untraceable
+                    # for lax.while_loop. Remember so later steps skip
+                    # the doomed trace attempt.
+                    self._compile_fallbacks[ver] = repr(e)
         return self._core.run_program(program, scope, feed, fetch_list,
                                       return_numpy)
 
     def _can_whole_compile(self, program) -> bool:
-        if program.num_blocks > 1:
-            return False
-        for op in program.global_block().ops:
-            try:
-                info = OpInfoMap.instance().get(op.type)
-            except KeyError:
-                return False
-            if info.host_fn is not None or info.needs_lod:
-                return False
-        return True
+        # sub-blocks (while/conditional bodies) are fine — they lower to
+        # lax.while_loop/lax.cond if pure; any other host/LoD op drops
+        # the program to the interpreter. Cached per program version:
+        # this runs on every step.
+        from .core.compiler_engine import _program_version, block_is_traceable
+
+        ver = _program_version(program)
+        hit = self._traceable_cache.get(ver)
+        if hit is None:
+            hit = block_is_traceable(program.global_block())
+            self._traceable_cache[ver] = hit
+        return hit
 
     # -- Dataset-driven training (reference train_from_dataset) -----------
     def train_from_dataset(self, program=None, dataset=None, scope=None,

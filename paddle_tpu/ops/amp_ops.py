@@ -67,9 +67,13 @@ def _update_loss_scaling(ins, attrs):
     # shrink after decr_n consecutive overflow steps
     do_decr = bad_new >= decr_n
     scale_decr = jnp.maximum(scale * decr_ratio, jnp.float32(1.0))
-    # grow after incr_n consecutive clean steps
+    # grow after incr_n consecutive clean steps — but never past float32
+    # range (reference fp16_utils update_loss_scaling guards with
+    # isfinite before assigning; without this the scale saturates at inf
+    # and every later step zeroes all grads)
     do_incr = good_new >= incr_n
-    scale_incr = scale * incr_ratio
+    grown = scale * incr_ratio
+    scale_incr = jnp.where(jnp.isfinite(grown), grown, scale)
     new_scale = jnp.where(do_decr, scale_decr,
                           jnp.where(do_incr, scale_incr, scale))
     good_out = jnp.where(do_incr | do_decr, jnp.zeros_like(good), good_new)

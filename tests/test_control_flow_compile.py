@@ -1,0 +1,105 @@
+"""While/conditional_block XLA lowering tests.
+
+The contract (SURVEY.md §7 step 3): programs containing control flow
+must still whole-program compile (lax.while_loop / lax.cond), and the
+compiled results must agree with the op-by-op interpreter
+(/root/reference/paddle/fluid/operators/controlflow/while_op.cc
+semantics: body writes parent-scope vars by name each trip)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.executor_core import CoreExecutor
+
+
+def _build_while_program():
+    """x doubles 5 times: while(i < 5) { x = 2x; i += 1 }"""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x0 = fluid.data(name="x0", shape=[4], dtype="float32")
+        x = fluid.layers.assign(x0)
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=5)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            doubled = fluid.layers.elementwise_add(x, x)
+            fluid.layers.assign(doubled, output=x)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+    return main, startup, x, i
+
+
+class TestWhileCompile:
+    def test_compiles_and_matches_interpreter(self):
+        main, startup, x, i = _build_while_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        assert exe._can_whole_compile(main), \
+            "while program must be traceable"
+        feed = {"x0": np.array([1.0, 2.0, 3.0, 4.0], dtype="float32")}
+
+        scope1 = fluid.Scope()
+        with fluid.scope_guard(scope1):
+            exe.run(startup)
+            from paddle_tpu.core.compiler_engine import run_compiled_program
+
+            out_c, i_c = run_compiled_program(exe._core, main, scope1, feed,
+                                              [x, i])
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe.run(startup)
+            core = CoreExecutor(fluid.CPUPlace())
+            out_i, i_i = core.run_program(main, scope2, feed, [x, i], True)
+
+        np.testing.assert_allclose(np.asarray(out_c),
+                                   feed["x0"] * 32.0, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_i),
+                                   rtol=1e-6)
+        assert int(np.asarray(i_c).ravel()[0]) == 5
+        assert int(np.asarray(i_i).ravel()[0]) == 5
+
+    def test_executor_routes_through_compiler(self):
+        main, startup, x, i = _build_while_program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (out,) = exe.run(main, feed={
+                "x0": np.ones(4, dtype="float32")}, fetch_list=[x])
+        np.testing.assert_allclose(np.asarray(out), np.full(4, 32.0),
+                                   rtol=1e-6)
+
+
+class TestConditionalBlockCompile:
+    def _build(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[4], dtype="float32")
+            flag = fluid.data(name="flag", shape=[1], dtype="bool")
+            y = fluid.layers.assign(x)
+            blk = main.current_block()
+            sub = main._create_block()
+            # sub-block body: y = y * 3 (writes the parent var by name)
+            tripled = fluid.layers.scale(y, scale=3.0)
+            fluid.layers.assign(tripled, output=y)
+            main._rollback()
+            blk.append_op(
+                "conditional_block",
+                inputs={"Cond": [flag]},
+                outputs={},
+                attrs={"sub_block": sub, "is_scalar_condition": True},
+            )
+        return main, startup, y
+
+    def test_both_branches(self):
+        main, startup, y = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        assert exe._can_whole_compile(main)
+        for flag, want in [(True, 3.0), (False, 1.0)]:
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                (out,) = exe.run(main, feed={
+                    "x": np.ones(4, dtype="float32"),
+                    "flag": np.array([flag])}, fetch_list=[y])
+            np.testing.assert_allclose(np.asarray(out), np.full(4, want),
+                                       rtol=1e-6)
