@@ -199,7 +199,7 @@ def _trace_block(block, env: Dict, step_seed) -> None:
             s.name for s in info.outputs if op.output(s.name)
         )
         if info.needs_rng:
-            if attrs.get("seed", 0):
+            if int(attrs.get("seed", 0) or 0) > 0:
                 import jax.numpy as jnp
 
                 ins[RNG_SEED_ATTR] = jnp.uint32(attrs["seed"])

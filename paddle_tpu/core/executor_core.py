@@ -154,7 +154,7 @@ class CoreExecutor:
         if info.needs_rng:
             import jax.numpy as jnp
 
-            if attrs.get("seed", 0):
+            if int(attrs.get("seed", 0) or 0) > 0:
                 seed_val = np.uint32(attrs["seed"])
             else:
                 # A grad op reuses its forward op's stream (attr set by

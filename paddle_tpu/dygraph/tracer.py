@@ -158,7 +158,8 @@ class Tracer:
         if info.needs_rng:
             self._seed_counter += 1
             in_map[RNG_SEED_ATTR] = jnp.uint32(
-                attrs.get("seed", 0) or (self._seed_counter & 0xFFFFFFFF))
+                max(int(attrs.get("seed", 0) or 0), 0)
+                or (self._seed_counter & 0xFFFFFFFF))
             if "is_test" in info.attrs and "is_test" not in attrs:
                 attrs["is_test"] = not self.train_mode
 

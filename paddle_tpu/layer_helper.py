@@ -159,11 +159,13 @@ class LayerHelper:
         return initializer(sv, sb)
 
     # -- ops --------------------------------------------------------------
-    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+    def append_op(self, type, inputs=None, outputs=None, attrs=None,
+                  infer_shape=True):
         if framework.in_dygraph_mode():
             tracer = framework._dygraph_tracer()
             return tracer.trace_op(type, inputs or {}, outputs or {}, attrs or {})
-        return self.block.append_op(type, inputs, outputs, attrs)
+        return self.block.append_op(type, inputs, outputs, attrs,
+                                    infer_shape=infer_shape)
 
     def append_bias_op(self, input_var, dim_start=1, dim_end=None):
         bias_attr = self.bias_attr

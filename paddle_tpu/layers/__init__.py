@@ -11,6 +11,8 @@ from .ops import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .sequence_lod import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
 from .io import data  # noqa: F401
 from . import math_op_patch  # noqa: F401  (patches Variable operators)
 
@@ -21,6 +23,8 @@ from .ops import __all__ as _ops_all
 from .control_flow import __all__ as _cf_all
 from .metric_op import __all__ as _metric_all
 from .sequence_lod import __all__ as _seq_all
+from .rnn import __all__ as _rnn_all
+from .detection import __all__ as _det_all
 
 __all__ = (
     ["data"]
@@ -31,4 +35,6 @@ __all__ = (
     + _cf_all
     + _metric_all
     + _seq_all
+    + _rnn_all
+    + _det_all
 )

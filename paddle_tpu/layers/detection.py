@@ -1,0 +1,180 @@
+"""Detection layer APIs.
+
+Parity: /root/reference/python/paddle/fluid/layers/detection.py (28
+public APIs; first wave here covers the graph-side box/anchor/NMS
+surface the SSD/YOLO/Faster-RCNN configs touch).
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box",
+    "anchor_generator",
+    "iou_similarity",
+    "box_coder",
+    "box_clip",
+    "yolo_box",
+    "roi_align",
+    "roi_pool",
+    "multiclass_nms",
+]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", input=input)
+    dtype = helper.input_dtype()
+    boxes = helper.create_variable_for_type_inference(dtype)
+    variances = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={
+            "min_sizes": list(min_sizes),
+            "max_sizes": list(max_sizes or []),
+            "aspect_ratios": list(aspect_ratios),
+            "variances": list(variance),
+            "flip": flip,
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+            "min_max_aspect_ratios_order": min_max_aspect_ratios_order,
+        },
+        infer_shape=False)
+    return boxes, variances
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", input=input)
+    dtype = helper.input_dtype()
+    anchors = helper.create_variable_for_type_inference(dtype)
+    variances = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [variances]},
+        attrs={
+            "anchor_sizes": list(anchor_sizes or [64.0]),
+            "aspect_ratios": list(aspect_ratios or [1.0]),
+            "variances": list(variance),
+            "stride": list(stride or [16.0, 16.0]),
+            "offset": offset,
+        },
+        infer_shape=False)
+    return anchors, variances
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", input=x)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op("iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"box_normalized": box_normalized},
+                     infer_shape=False)
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    helper = LayerHelper("box_coder", input=target_box)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    from ..framework import Variable
+
+    if isinstance(prior_box_var, Variable):
+        inputs["PriorBoxVar"] = [prior_box_var]
+    elif isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = list(prior_box_var)
+    helper.append_op("box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out]}, attrs=attrs,
+                     infer_shape=False)
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", input=input)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op("box_clip",
+                     inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out]}, infer_shape=False)
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None):
+    helper = LayerHelper("yolo_box", input=x)
+    dtype = helper.input_dtype()
+    boxes = helper.create_variable_for_type_inference(dtype)
+    scores = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "yolo_box",
+        inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={"anchors": list(anchors), "class_num": class_num,
+               "conf_thresh": conf_thresh,
+               "downsample_ratio": downsample_ratio,
+               "clip_bbox": clip_bbox},
+        infer_shape=False)
+    return boxes, scores
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    helper = LayerHelper("roi_align", input=input)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(
+        "roi_align",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"spatial_scale": spatial_scale,
+               "pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "sampling_ratio": sampling_ratio},
+        infer_shape=False)
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, name=None):
+    helper = LayerHelper("roi_pool", input=input)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(
+        "roi_pool",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"spatial_scale": spatial_scale,
+               "pooled_height": pooled_height,
+               "pooled_width": pooled_width},
+        infer_shape=False)
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    helper = LayerHelper("multiclass_nms", input=bboxes)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    out.lod_level = 1
+    helper.append_op(
+        "multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={"background_label": background_label,
+               "score_threshold": score_threshold,
+               "nms_top_k": nms_top_k,
+               "nms_threshold": nms_threshold,
+               "nms_eta": nms_eta,
+               "keep_top_k": keep_top_k,
+               "normalized": normalized},
+        infer_shape=False)
+    return out

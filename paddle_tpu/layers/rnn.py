@@ -1,0 +1,297 @@
+"""RNN layer APIs.
+
+Parity: /root/reference/python/paddle/fluid/layers/rnn.py
+(dynamic_lstm :1860, lstm :2017, dynamic_gru :2395, gru_unit :2548,
+lstm_unit :2921). The LoD variants keep the reference's pre-projected
+input contract ([T, 4*size] / [T, 3*size]); the dense ``lstm`` packs
+per-(layer, direction) weights into one flat parameter consumed by the
+scan-stack op (gate order candidate/input/forget/output, matching
+operators/math/detail/lstm_cpu_kernel.h).
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+
+__all__ = ["dynamic_lstm", "dynamic_gru", "lstm", "StaticRNN"]
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """LoD LSTM; ``input`` is the pre-projected [T, 4*size//4] sequence.
+    Returns (hidden, cell), both LoD-preserving."""
+    helper = LayerHelper("lstm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = size // 4
+    weight = helper.create_parameter(attr=helper.param_attr,
+                                     shape=[d, 4 * d], dtype=dtype)
+    bias_size = [1, 7 * d] if use_peepholes else [1, 4 * d]
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=bias_size,
+                                   dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    helper.append_op(
+        "lstm", inputs=inputs,
+        outputs={"Hidden": [hidden], "Cell": [cell]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation},
+        infer_shape=False)
+    hidden.shape = input.shape[:-1] + (d,)
+    cell.shape = input.shape[:-1] + (d,)
+    hidden.lod_level = input.lod_level
+    cell.lod_level = input.lod_level
+    return hidden, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                name=None):
+    """LoD GRU; ``input`` is the pre-projected [T, 3*size] sequence."""
+    helper = LayerHelper("gru", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = helper.input_dtype()
+    weight = helper.create_parameter(attr=helper.param_attr,
+                                     shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(attr=helper.bias_attr,
+                                   shape=[1, 3 * size], dtype=dtype,
+                                   is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    helper.append_op(
+        "gru", inputs=inputs, outputs={"Hidden": [hidden]},
+        attrs={"activation": candidate_activation,
+               "gate_activation": gate_activation,
+               "is_reverse": is_reverse, "origin_mode": origin_mode},
+        infer_shape=False)
+    hidden.shape = input.shape[:-1] + (size,)
+    hidden.lod_level = input.lod_level
+    return hidden
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Dense multi-layer (bi)LSTM over [T, N, D] (reference layers.lstm,
+    cudnn-backed there). Returns (out, last_h, last_c)."""
+    helper = LayerHelper("cudnn_lstm", input=input, name=name)
+    dtype = helper.input_dtype()
+    ndir = 2 if is_bidirec else 1
+    in_size = input.shape[-1]
+    n_weight = 0
+    din = in_size
+    for layer in range(num_layers):
+        for _ in range(ndir):
+            n_weight += din * 4 * hidden_size + hidden_size * 4 * hidden_size
+            n_weight += 4 * hidden_size
+        din = hidden_size * ndir
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[n_weight], dtype=dtype,
+        default_initializer=default_initializer)
+    out = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "cudnn_lstm",
+        inputs={"Input": [input], "InitH": [init_h], "InitC": [init_c],
+                "W": [weight]},
+        outputs={"Out": [out], "LastH": [last_h], "LastC": [last_c]},
+        attrs={"max_len": max_len, "hidden_size": hidden_size,
+               "num_layers": num_layers, "is_bidirec": is_bidirec,
+               "dropout_prob": dropout_prob, "is_test": is_test,
+               "input_size": in_size, "seed": seed},
+        infer_shape=False)
+    t, n = input.shape[0], input.shape[1]
+    out.shape = (t, n, hidden_size * ndir)
+    last_h.shape = (num_layers * ndir, n, hidden_size)
+    last_c.shape = (num_layers * ndir, n, hidden_size)
+    return out, last_h, last_c
+
+
+class StaticRNN:
+    """Fixed-length RNN builder (reference layers/control_flow.py
+    StaticRNN / operators/recurrent_op.cc).
+
+    The user's step body is captured into a sub-block once; on exit it is
+    UNROLLED: copied T times into the parent block with per-step variable
+    renaming — step inputs become time slices, memories thread from step
+    to step, step outputs stack back along time. Every unrolled op is an
+    ordinary pure op, so the program still whole-compiles (XLA dedups the
+    repeated computation structure).
+
+    Usage (reference contract)::
+
+        rnn = StaticRNN()
+        with rnn.step():
+            w = rnn.step_input(x_tbd)          # x [T, B, D] -> w [B, D]
+            prev = rnn.memory(shape=[-1, H], batch_ref=w)
+            h = layers.fc([w, prev], size=H)
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()                             # [T, B, H]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._step_inputs = []   # (sub_var, source_var)
+        self._mems = []          # (sub_var, init_var); _next set later
+        self._mem_next = {}      # sub_var.name -> sub-block var
+        self._step_outputs = []  # sub-block vars
+        self._seq_len = None
+        self._sub = None
+        self._result = None
+
+    def step(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            main = self.helper.main_program
+            self._parent_block = main.current_block()
+            self._sub = main._create_block()
+            try:
+                yield
+            finally:
+                main._rollback()
+                self._unroll()
+
+        return _ctx()
+
+    def _require_step(self):
+        if self._sub is None:
+            raise RuntimeError("call inside `with rnn.step():`")
+
+    def step_input(self, x):
+        self._require_step()
+        if self._seq_len is None:
+            self._seq_len = int(x.shape[0])
+        elif int(x.shape[0]) != self._seq_len:
+            raise ValueError("step inputs disagree on seq_len")
+        v = self._sub.create_var(
+            name=self.helper.unique_var_name("step_in"),
+            shape=tuple(x.shape[1:]), dtype=x.dtype)
+        self._step_inputs.append((v, x))
+        return v
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1,
+               value=None, dtype="float32"):
+        """Reference signature (control_flow.py StaticRNN.memory):
+        ``init_value`` is the canonical kwarg; ``value`` kept as an
+        alias. The batch-dim indices are accepted for compatibility
+        (batch_ref's dim 0 is used as the batch here)."""
+        self._require_step()
+        if value is not None:
+            init_value = value
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory needs init or (shape, batch_ref)")
+            from .tensor import fill_constant
+
+            dims = [int(batch_ref.shape[0])] + [int(s) for s in shape
+                                                if int(s) != -1]
+            # init belongs to the parent block, before the unroll
+            cur = self.helper.main_program.current_block()
+            self.helper.main_program._current_block_idx = \
+                self._parent_block.idx
+            try:
+                init = fill_constant(shape=dims, dtype=dtype,
+                                     value=init_value)
+            finally:
+                self.helper.main_program._current_block_idx = cur.idx
+        v = self._sub.create_var(
+            name=self.helper.unique_var_name("mem"),
+            shape=tuple(init.shape), dtype=init.dtype)
+        self._mems.append((v, init))
+        return v
+
+    def update_memory(self, mem, new_val):
+        self._require_step()
+        self._mem_next[mem.name] = new_val
+
+    def step_output(self, o):
+        self._require_step()
+        self._step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _unroll(self):
+        if self._seq_len is None:
+            raise ValueError("StaticRNN needs at least one step_input")
+        parent = self._parent_block
+        state = {}  # sub mem name -> parent var name (current value)
+        for mem, init in self._mems:
+            state[mem.name] = init.name
+        per_step_outs = {o.name: [] for o in self._step_outputs}
+
+        for t in range(self._seq_len):
+            mapping = dict(state)
+            for v, src in self._step_inputs:
+                mapping[v.name] = self._slice_t(parent, src, t).name
+            for op in self._sub.ops:
+                new_ins = {
+                    slot: [mapping.get(n, n) for n in names]
+                    for slot, names in op.inputs.items()}
+                new_outs = {}
+                for slot, names in op.outputs.items():
+                    outs = []
+                    for n in names:
+                        sv = self._sub.vars.get(n)
+                        nn = "%s@t%d" % (n, t)
+                        if sv is not None and nn not in parent.vars:
+                            parent.create_var(name=nn, shape=sv.shape,
+                                              dtype=sv.dtype)
+                        mapping[n] = nn
+                        outs.append(nn)
+                    new_outs[slot] = outs
+                parent.append_op(op.type, inputs=new_ins, outputs=new_outs,
+                                 attrs=dict(op.attrs), infer_shape=False)
+            for mem, _init in self._mems:
+                nxt = self._mem_next.get(mem.name)
+                if nxt is not None:
+                    state[mem.name] = mapping[nxt.name]
+            for o in self._step_outputs:
+                per_step_outs[o.name].append(parent.vars[mapping[o.name]])
+
+        results = []
+        cur = self.helper.main_program._current_block_idx
+        self.helper.main_program._current_block_idx = parent.idx
+        try:
+            from .nn import stack
+
+            for o in self._step_outputs:
+                results.append(stack(per_step_outs[o.name], axis=0))
+        finally:
+            self.helper.main_program._current_block_idx = cur
+        self._result = results
+
+    def _slice_t(self, parent, src, t):
+        from .nn import slice as nn_slice
+
+        cur = self.helper.main_program._current_block_idx
+        self.helper.main_program._current_block_idx = parent.idx
+        try:
+            s = nn_slice(src, axes=[0], starts=[t], ends=[t + 1])
+            from .nn import squeeze
+
+            return squeeze(s, axes=[0])
+        finally:
+            self.helper.main_program._current_block_idx = cur
+
+    def __call__(self):
+        if self._result is None:
+            raise RuntimeError("StaticRNN not built — use `with rnn.step()`")
+        return self._result[0] if len(self._result) == 1 else self._result

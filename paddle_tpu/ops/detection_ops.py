@@ -7,9 +7,11 @@ shapes become host ops when added.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
-from ..core.registry import In, Out, register_op
+from ..core.registry import In, Out, register_host_op, register_op
 
 
 @register_op(
@@ -46,4 +48,473 @@ def _box_coder(ins, attrs):
         elif attrs.get("variance"):
             out = out / jnp.asarray(attrs["variance"]).reshape(1, 1, 4)
         return {"OutputBox": out}
-    raise NotImplementedError("decode_center_size arrives with wave 2")
+    return {"OutputBox": _decode_center_size(prior, ins.get("PriorBoxVar"),
+                                             target, attrs)}
+
+
+def _decode_center_size(prior, var_in, target, attrs):
+    norm = attrs.get("box_normalized", True)
+    axis = attrs.get("axis", 0)
+    pw = prior[:, 2] - prior[:, 0] + (0.0 if norm else 1.0)
+    ph = prior[:, 3] - prior[:, 1] + (0.0 if norm else 1.0)
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + ph * 0.5
+    if axis == 0:
+        pw, ph, px, py = (v[None, :, None] for v in (pw, ph, px, py))
+    else:
+        pw, ph, px, py = (v[:, None, None] for v in (pw, ph, px, py))
+    # target: [N, M, 4]
+    t = target.reshape(target.shape[0], -1, 4)
+    var = None
+    if var_in is not None:
+        var = var_in[None, :, :] if axis == 0 else var_in[:, None, :]
+    elif attrs.get("variance"):
+        var = jnp.asarray(attrs["variance"]).reshape(1, 1, 4)
+    tv = t * var if var is not None else t
+    ox = tv[:, :, 0:1] * pw + px
+    oy = tv[:, :, 1:2] * ph + py
+    ow = jnp.exp(tv[:, :, 2:3]) * pw
+    oh = jnp.exp(tv[:, :, 3:4]) * ph
+    sub = 0.0 if norm else 1.0
+    out = jnp.concatenate(
+        [ox - ow / 2, oy - oh / 2, ox + ow / 2 - sub, oy + oh / 2 - sub],
+        axis=-1)
+    return out
+
+
+@register_op(
+    "prior_box",
+    inputs=[In("Input", no_grad=True), In("Image", no_grad=True)],
+    outputs=[Out("Boxes"), Out("Variances")],
+    attrs={"min_sizes": [], "max_sizes": [], "aspect_ratios": [1.0],
+           "variances": [0.1, 0.1, 0.2, 0.2], "flip": False, "clip": False,
+           "step_w": 0.0, "step_h": 0.0, "offset": 0.5,
+           "min_max_aspect_ratios_order": False},
+)
+def _prior_box(ins, attrs):
+    """SSD prior boxes (reference operators/detection/prior_box_op.h)."""
+    feat, img = ins["Input"], ins["Image"]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        exists = any(abs(ar - e) < 1e-6 for e in ars)
+        if not exists:
+            ars.append(float(ar))
+            if attrs.get("flip", False):
+                ars.append(1.0 / float(ar))
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+    offset = attrs.get("offset", 0.5)
+    order = attrs.get("min_max_aspect_ratios_order", False)
+
+    boxes_per_pos = []
+
+    def add(cw, ch):
+        boxes_per_pos.append((cw, ch))
+
+    # max_sizes[s] pairs with min_sizes[s] only (reference
+    # prior_box_op.h:116 `auto max_size = max_sizes[s]`)
+    for s_idx, ms in enumerate(min_sizes):
+        mx = max_sizes[s_idx] if s_idx < len(max_sizes) else None
+        if order:
+            add(ms / 2.0, ms / 2.0)
+            if mx is not None:
+                s = np.sqrt(ms * mx)
+                add(s / 2.0, s / 2.0)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                add(ms * np.sqrt(ar) / 2.0, ms / np.sqrt(ar) / 2.0)
+        else:
+            add(ms / 2.0, ms / 2.0)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                add(ms * np.sqrt(ar) / 2.0, ms / np.sqrt(ar) / 2.0)
+            if mx is not None:
+                s = np.sqrt(ms * mx)
+                add(s / 2.0, s / 2.0)
+    npri = len(boxes_per_pos)
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [h, w]
+    half = jnp.asarray(boxes_per_pos, dtype=jnp.float32)  # [npri, 2]
+    bw = half[:, 0][None, None, :]
+    bh = half[:, 1][None, None, :]
+    xmin = (cxg[:, :, None] - bw) / img_w
+    ymin = (cyg[:, :, None] - bh) / img_h
+    xmax = (cxg[:, :, None] + bw) / img_w
+    ymax = (cyg[:, :, None] + bh) / img_h
+    boxes = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)  # [h,w,npri,4]
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.broadcast_to(
+        jnp.asarray(attrs["variances"], dtype=jnp.float32).reshape(1, 1, 1, 4),
+        (h, w, npri, 4))
+    return {"Boxes": boxes, "Variances": variances}
+
+
+@register_op(
+    "iou_similarity",
+    inputs=[In("X", no_grad=True), In("Y", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"box_normalized": True},
+)
+def _iou_similarity(ins, attrs):
+    x, y = ins["X"], ins["Y"]
+    norm = attrs.get("box_normalized", True)
+    off = 0.0 if norm else 1.0
+
+    ax = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    ay = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    bx = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    by = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    iw = jnp.maximum(bx - ax + off, 0.0)
+    ih = jnp.maximum(by - ay + off, 0.0)
+    inter = iw * ih
+    area_x = (x[:, 2] - x[:, 0] + off) * (x[:, 3] - x[:, 1] + off)
+    area_y = (y[:, 2] - y[:, 0] + off) * (y[:, 3] - y[:, 1] + off)
+    union = area_x[:, None] + area_y[None, :] - inter
+    return {"Out": jnp.where(union > 0, inter / union, 0.0)}
+
+
+@register_op(
+    "box_clip",
+    inputs=[In("Input"), In("ImInfo", no_grad=True)],
+    outputs=[Out("Output")],
+    needs_lod=True,
+    infer_lod="propagate",
+)
+def _box_clip(ins, attrs):
+    """Clip boxes to image bounds (reference box_clip_op.h: im_info is
+    [h, w, scale]; bound = round(dim / scale) - 1). Accepts [N, M, 4]
+    (row i clipped against image i) or the LoD form [M, 4] with the
+    batch mapping taken from the input's LoD."""
+    from .lod_utils import batch_ids_for
+
+    boxes = ins["Input"]
+    im = ins["ImInfo"]
+    h = jnp.round(im[:, 0] / im[:, 2]) - 1
+    w = jnp.round(im[:, 1] / im[:, 2]) - 1
+    if boxes.ndim == 2:
+        ids = batch_ids_for(attrs, "Input", boxes.shape[0])
+        hb = h[ids][:, None]
+        wb = w[ids][:, None]
+        out = jnp.stack(
+            [jnp.clip(boxes[:, 0], 0, wb[:, 0]),
+             jnp.clip(boxes[:, 1], 0, hb[:, 0]),
+             jnp.clip(boxes[:, 2], 0, wb[:, 0]),
+             jnp.clip(boxes[:, 3], 0, hb[:, 0])], axis=-1)
+        return {"Output": out}
+    b = boxes.reshape(boxes.shape[0], -1, 4)
+    x0 = jnp.clip(b[:, :, 0], 0, w[:, None])
+    y0 = jnp.clip(b[:, :, 1], 0, h[:, None])
+    x1 = jnp.clip(b[:, :, 2], 0, w[:, None])
+    y1 = jnp.clip(b[:, :, 3], 0, h[:, None])
+    out = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(boxes.shape)
+    return {"Output": out}
+
+
+@register_op(
+    "yolo_box",
+    inputs=[In("X", no_grad=True), In("ImgSize", no_grad=True)],
+    outputs=[Out("Boxes"), Out("Scores")],
+    attrs={"anchors": [], "class_num": 0, "conf_thresh": 0.01,
+           "downsample_ratio": 32, "clip_bbox": True},
+)
+def _yolo_box(ins, attrs):
+    """YOLOv3 detection decode (reference yolo_box_op.h)."""
+    x = ins["X"]
+    imgsize = ins["ImgSize"]  # [N, 2] (h, w) int
+    anchors = attrs["anchors"]
+    an_num = len(anchors) // 2
+    class_num = int(attrs["class_num"])
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    n, c, h, w = x.shape
+    input_size = downsample * h
+    x = x.reshape(n, an_num, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], dtype=jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], dtype=jnp.float32)[None, :, None, None]
+    sig = jax.nn.sigmoid
+    bx = (sig(x[:, :, 0]) + grid_x) / w
+    by = (sig(x[:, :, 1]) + grid_y) / h
+    bw = jnp.exp(x[:, :, 2]) * aw / input_size
+    bh = jnp.exp(x[:, :, 3]) * ah / input_size
+    conf = sig(x[:, :, 4])
+    keep = (conf >= conf_thresh).astype(x.dtype)
+    conf = conf * keep
+    img_h = imgsize[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = imgsize[:, 1].astype(jnp.float32)[:, None, None, None]
+    x0 = (bx - bw / 2) * img_w
+    y0 = (by - bh / 2) * img_h
+    x1 = (bx + bw / 2) * img_w
+    y1 = (by + bh / 2) * img_h
+    if attrs.get("clip_bbox", True):
+        x0 = jnp.clip(x0, 0, img_w - 1)
+        y0 = jnp.clip(y0, 0, img_h - 1)
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1)  # [n, an, h, w, 4]
+    boxes = boxes.reshape(n, an_num * h * w, 4) * keep.reshape(
+        n, an_num * h * w, 1)
+    scores = sig(x[:, :, 5:]) * conf[:, :, None]
+    scores = jnp.moveaxis(scores, 2, -1).reshape(
+        n, an_num * h * w, class_num)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+@register_op(
+    "roi_align",
+    inputs=[In("X"), In("ROIs", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"spatial_scale": 1.0, "pooled_height": 1, "pooled_width": 1,
+           "sampling_ratio": -1},
+    needs_lod=True,
+)
+def _roi_align(ins, attrs):
+    """RoIAlign (reference roi_align_op.h): average of bilinear samples
+    per output bin. ROIs carry a batch-assignment LoD."""
+    x = ins["X"]  # [N, C, H, W]
+    rois = ins["ROIs"]  # [R, 4] (x0, y0, x1, y1)
+    from .lod_utils import batch_ids_for
+
+    batch_ids = batch_ids_for(attrs, "ROIs", rois.shape[0])
+    scale = attrs.get("spatial_scale", 1.0)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    ratio = int(attrs.get("sampling_ratio", -1))
+    _, c, hh, ww = x.shape
+
+    x0 = rois[:, 0] * scale
+    y0 = rois[:, 1] * scale
+    x1 = rois[:, 2] * scale
+    y1 = rois[:, 3] * scale
+    rw = jnp.maximum(x1 - x0, 1.0)
+    rh = jnp.maximum(y1 - y0, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    sr = ratio if ratio > 0 else 2  # static sample grid (ref: adaptive)
+
+    # sample positions: [R, ph, pw, sr, sr]
+    iy = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
+    ix = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
+    py = jnp.arange(ph, dtype=jnp.float32)
+    px = jnp.arange(pw, dtype=jnp.float32)
+    yy = (y0[:, None, None] + (py[None, :, None] + iy[None, None, :])
+          * bin_h[:, None, None])  # [R, ph, sr]
+    xx = (x0[:, None, None] + (px[None, :, None] + ix[None, None, :])
+          * bin_w[:, None, None])  # [R, pw, sr]
+
+    def bilinear(img, ys, xs):
+        # img [C, H, W]; ys [ph, sr]; xs [pw, sr] -> [C, ph, pw, sr, sr]
+        ys = jnp.clip(ys, 0.0, hh - 1)
+        xs = jnp.clip(xs, 0.0, ww - 1)
+        yl = jnp.floor(ys).astype(jnp.int32)
+        xl = jnp.floor(xs).astype(jnp.int32)
+        yh = jnp.minimum(yl + 1, hh - 1)
+        xh = jnp.minimum(xl + 1, ww - 1)
+        wy = ys - yl
+        wx = xs - xl
+        g = lambda yi, xi: img[:, yi[:, None, :, None], xi[None, :, None, :]]
+        v = (g(yl, xl) * ((1 - wy)[:, None, :, None] * (1 - wx)[None, :, None, :])
+             + g(yl, xh) * ((1 - wy)[:, None, :, None] * wx[None, :, None, :])
+             + g(yh, xl) * (wy[:, None, :, None] * (1 - wx)[None, :, None, :])
+             + g(yh, xh) * (wy[:, None, :, None] * wx[None, :, None, :]))
+        return v  # [C, ph, pw, sr, sr]
+
+    def per_roi(b, ys, xs):
+        img = x[b]
+        v = bilinear(img, ys, xs)
+        return v.mean(axis=(-1, -2))  # [C, ph, pw]
+
+    out = jax.vmap(per_roi)(batch_ids, yy, xx)
+    return {"Out": out}
+
+
+@register_op(
+    "roi_pool",
+    inputs=[In("X"), In("ROIs", no_grad=True)],
+    outputs=[Out("Out"), Out("Argmax", dispensable=True, no_grad=True)],
+    attrs={"spatial_scale": 1.0, "pooled_height": 1, "pooled_width": 1},
+    needs_lod=True,
+)
+def _roi_pool(ins, attrs):
+    """RoI max pooling (reference roi_pool_op.h), dense grid + mask."""
+    x = ins["X"]
+    rois = ins["ROIs"]
+    from .lod_utils import batch_ids_for
+
+    batch_ids = batch_ids_for(attrs, "ROIs", rois.shape[0])
+    scale = attrs.get("spatial_scale", 1.0)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    _, c, hh, ww = x.shape
+    x0 = jnp.round(rois[:, 0] * scale).astype(jnp.int32)
+    y0 = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
+    x1 = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
+    rw = jnp.maximum(x1 - x0 + 1, 1)
+    rh = jnp.maximum(y1 - y0 + 1, 1)
+
+    ygrid = jnp.arange(hh)
+    xgrid = jnp.arange(ww)
+
+    def per_roi(b, rx0, ry0, rrw, rrh):
+        img = x[b]  # [C, H, W]
+        # bin index of each pixel relative to the roi, or -1 outside
+        fy = (ygrid - ry0).astype(jnp.float32)
+        fx = (xgrid - rx0).astype(jnp.float32)
+        by = jnp.floor(fy * ph / rrh).astype(jnp.int32)
+        bx = jnp.floor(fx * pw / rrw).astype(jnp.int32)
+        valid_y = (ygrid >= ry0) & (ygrid <= ry0 + rrh - 1)
+        valid_x = (xgrid >= rx0) & (xgrid <= rx0 + rrw - 1)
+        by = jnp.where(valid_y, jnp.clip(by, 0, ph - 1), -1)
+        bx = jnp.where(valid_x, jnp.clip(bx, 0, pw - 1), -1)
+        onehot_y = (by[:, None] == jnp.arange(ph)[None, :])  # [H, ph]
+        onehot_x = (bx[:, None] == jnp.arange(pw)[None, :])  # [W, pw]
+        masked = jnp.where(
+            onehot_y[None, :, None, :, None] & onehot_x[None, None, :, None, :],
+            img[:, :, :, None, None], -jnp.inf)
+        out = masked.max(axis=(1, 2))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = jax.vmap(per_roi)(batch_ids, x0, y0, rw, rh)
+    return {"Out": out}
+
+
+@register_op(
+    "anchor_generator",
+    inputs=[In("Input", no_grad=True)],
+    outputs=[Out("Anchors"), Out("Variances")],
+    attrs={"anchor_sizes": [64.0], "aspect_ratios": [1.0],
+           "variances": [0.1, 0.1, 0.2, 0.2], "stride": [16.0, 16.0],
+           "offset": 0.5},
+)
+def _anchor_generator(ins, attrs):
+    """RPN anchors (reference anchor_generator_op.h)."""
+    feat = ins["Input"]
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ars = [float(a) for a in attrs["aspect_ratios"]]
+    sx, sy = attrs["stride"]
+    offset = attrs.get("offset", 0.5)
+    whs = []
+    for ar in ars:
+        for s in sizes:
+            area = sx * sy
+            area_ratios = area / ar
+            base_w = np.round(np.sqrt(area_ratios))
+            base_h = np.round(base_w * ar)
+            scale_w = s / sx
+            scale_h = s / sy
+            aw = scale_w * base_w
+            ah = scale_h * base_h
+            whs.append((aw, ah))
+    na = len(whs)
+    wh = jnp.asarray(whs, dtype=jnp.float32)
+    # reference anchor_generator_op.h:55-81: center = idx*stride +
+    # offset*(stride-1); corners at center ± (w-1)/2
+    cx = jnp.arange(w, dtype=jnp.float32) * sx + offset * (sx - 1)
+    cy = jnp.arange(h, dtype=jnp.float32) * sy + offset * (sy - 1)
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    half_w = (wh[:, 0][None, None, :] - 1) / 2
+    half_h = (wh[:, 1][None, None, :] - 1) / 2
+    anchors = jnp.stack(
+        [cxg[:, :, None] - half_w, cyg[:, :, None] - half_h,
+         cxg[:, :, None] + half_w, cyg[:, :, None] + half_h], axis=-1)
+    variances = jnp.broadcast_to(
+        jnp.asarray(attrs["variances"], dtype=jnp.float32).reshape(1, 1, 1, 4),
+        (h, w, na, 4))
+    return {"Anchors": anchors, "Variances": variances}
+
+
+def _nms_single_class(boxes, scores, thresh, nms_top_k, iou_thresh, eta,
+                      normalized=True):
+    """Greedy NMS over one class (numpy, host). `normalized` picks the
+    area convention (reference BBoxArea: +1 on w/h when pixel coords)."""
+    off = 0.0 if normalized else 1.0
+    keep = np.where(scores > thresh)[0]
+    if keep.size == 0:
+        return []
+    order = keep[np.argsort(-scores[keep])]
+    if nms_top_k > -1:
+        order = order[:nms_top_k]
+    selected = []
+    adaptive = iou_thresh
+    while order.size > 0:
+        i = order[0]
+        selected.append(int(i))
+        if order.size == 1:
+            break
+        xx0 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy0 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx1 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy1 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        iw = np.maximum(xx1 - xx0 + off, 0.0)
+        ih = np.maximum(yy1 - yy0 + off, 0.0)
+        inter = iw * ih
+        a0 = (boxes[i, 2] - boxes[i, 0] + off) * \
+            (boxes[i, 3] - boxes[i, 1] + off)
+        a1 = (boxes[order[1:], 2] - boxes[order[1:], 0] + off) * \
+            (boxes[order[1:], 3] - boxes[order[1:], 1] + off)
+        iou = np.where(a0 + a1 - inter > 0, inter / (a0 + a1 - inter), 0.0)
+        order = order[1:][iou <= adaptive]
+        if eta < 1.0 and adaptive > 0.5:
+            adaptive *= eta
+    return selected
+
+
+@register_host_op(
+    "multiclass_nms",
+    inputs=[In("BBoxes", no_grad=True), In("Scores", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"background_label": 0, "score_threshold": 0.0, "nms_top_k": -1,
+           "nms_threshold": 0.3, "nms_eta": 1.0, "keep_top_k": -1,
+           "normalized": True},
+)
+def _multiclass_nms(executor, op, scope):
+    """Greedy multi-class NMS (reference multiclass_nms_op.cc). Output
+    shape is value-dependent -> host op producing a LoD result
+    [[num_kept_per_image]] with rows [label, score, x0, y0, x1, y1]."""
+    from ..core.tensor import LoDTensor
+
+    bboxes = np.asarray(executor._read_var(scope, op.input("BBoxes")[0]))
+    scores = np.asarray(executor._read_var(scope, op.input("Scores")[0]))
+    a = op.attrs
+    n, nbox = bboxes.shape[0], bboxes.shape[1]
+    nclass = scores.shape[1]
+    all_rows = []
+    lod = [0]
+    for b in range(n):
+        dets = []
+        for c in range(nclass):
+            if c == a.get("background_label", 0):
+                continue
+            cls_boxes = bboxes[b] if bboxes.ndim == 3 else bboxes[b, :, c]
+            sel = _nms_single_class(
+                cls_boxes, scores[b, c], a.get("score_threshold", 0.0),
+                a.get("nms_top_k", -1), a.get("nms_threshold", 0.3),
+                a.get("nms_eta", 1.0), a.get("normalized", True))
+            for i in sel:
+                dets.append([float(c), float(scores[b, c, i])]
+                            + [float(v) for v in cls_boxes[i]])
+        keep_top_k = a.get("keep_top_k", -1)
+        if keep_top_k > -1 and len(dets) > keep_top_k:
+            dets.sort(key=lambda r: -r[1])
+            dets = dets[:keep_top_k]
+        all_rows.extend(dets)
+        lod.append(len(all_rows))
+    if all_rows:
+        out = np.asarray(all_rows, dtype=np.float32)
+    else:
+        out = np.full((1, 6), -1.0, dtype=np.float32)
+        lod = [0, 1]
+    t = LoDTensor(out)
+    t.set_lod([lod])
+    executor._write_var(scope, op.output("Out")[0], t)

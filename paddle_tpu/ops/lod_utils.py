@@ -1,0 +1,40 @@
+"""Shared helpers for ops that consume host-side LoD metadata.
+
+The executor passes each LoD input's table via ``attrs['_lod_<slot>']``
+as nested tuples; these helpers are the single source of truth for
+parsing it (used by sequence_ops, rnn_ops, detection_ops).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+LOD_ATTR_PREFIX = "_lod_"
+
+
+def lod_offsets(attrs, slot, level=-1):
+    """Last-level offset table [0, ...] for `slot`, or None if absent."""
+    lods = attrs.get(LOD_ATTR_PREFIX + slot)
+    if not lods or not lods[0]:
+        return None
+    return list(lods[0][level])
+
+
+def seg_ids(offsets):
+    """Row -> sequence-index map as a device array."""
+    ids = np.zeros(offsets[-1], dtype=np.int32)
+    for i in range(len(offsets) - 1):
+        ids[offsets[i]:offsets[i + 1]] = i
+    return jnp.asarray(ids)
+
+
+def seq_lens(offsets):
+    return np.diff(np.asarray(offsets))
+
+
+def batch_ids_for(attrs, slot, n_rows):
+    """Per-row batch assignment from the slot's LoD (zeros if absent)."""
+    offsets = lod_offsets(attrs, slot)
+    if offsets is None:
+        return jnp.zeros(n_rows, dtype=jnp.int32)
+    return seg_ids(offsets)

@@ -10,8 +10,43 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from ..core.registry import In, Out, register_op
+from ..core.registry import RNG_SEED_ATTR, In, Out, register_op
+from .lod_utils import lod_offsets as _lod_offsets
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+}
+
+
+def _act(name):
+    return _ACTS[name if isinstance(name, str) else "tanh"]
+
+
+def _pad_from_lod(x, offsets):
+    """[total, D] + offsets -> ([N, Tmax, D], lens)."""
+    lens = np.diff(np.asarray(offsets))
+    tmax = int(lens.max()) if len(lens) else 0
+    rows = []
+    for i in range(len(lens)):
+        seg = x[offsets[i]:offsets[i + 1]]
+        if seg.shape[0] < tmax:
+            seg = jnp.concatenate(
+                [seg, jnp.zeros((tmax - seg.shape[0],) + seg.shape[1:],
+                                seg.dtype)], axis=0)
+        rows.append(seg)
+    return jnp.stack(rows, axis=0), lens
+
+
+def _unpad_to_lod(padded, offsets):
+    lens = np.diff(np.asarray(offsets))
+    segs = [padded[i, :int(lens[i])] for i in range(len(lens))]
+    return jnp.concatenate(segs, axis=0)
 
 
 @register_op(
@@ -55,3 +90,243 @@ def _gru_unit(ins, attrs):
         h = (1 - u) * h_prev + u * c
     gate = jnp.concatenate([u, r, c], axis=-1)
     return {"Gate": gate, "ResetHiddenPrev": rhp, "Hidden": h}
+
+
+def _lstm_scan(x_pad, lens, w, checks, h0, c0, gate_act, cell_act,
+               cand_act, is_reverse):
+    """Masked lax.scan over the padded time axis.
+
+    x_pad: [N, T, 4D] pre-projected input; w: [D, 4D] recurrent weight.
+    Gate column order is the reference's (candidate, input, forget,
+    output) — operators/math/detail/lstm_cpu_kernel.h:50-53.
+    """
+    n, t, d4 = x_pad.shape
+    d = d4 // 4
+    check_i, check_f, check_o = checks
+    mask = (jnp.arange(t)[None, :] < jnp.asarray(lens)[:, None]).astype(
+        x_pad.dtype)  # [N, T]
+    xs = jnp.swapaxes(x_pad, 0, 1)  # [T, N, 4D]
+    ms = jnp.swapaxes(mask, 0, 1)  # [T, N]
+    if is_reverse:
+        # reverse VALID region per row: index (len-1-t) mod len
+        idx = (jnp.asarray(lens)[:, None] - 1 - jnp.arange(t)[None, :]) % \
+            jnp.maximum(jnp.asarray(lens)[:, None], 1)
+        xs = jnp.swapaxes(
+            jnp.take_along_axis(x_pad, idx[:, :, None], axis=1), 0, 1)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, m_t = inp
+        g = x_t + jnp.matmul(h_prev, w)
+        cand = cand_act(g[:, :d])
+        ig = gate_act(g[:, d:2 * d] + (c_prev * check_i if check_i is not None
+                                       else 0.0))
+        fg = gate_act(g[:, 2 * d:3 * d] + (c_prev * check_f
+                                           if check_f is not None else 0.0))
+        c = cand * ig + c_prev * fg
+        og = gate_act(g[:, 3 * d:] + (c * check_o if check_o is not None
+                                      else 0.0))
+        h = og * cell_act(c)
+        m = m_t[:, None]
+        h = h * m + h_prev * (1 - m)
+        c = c * m + c_prev * (1 - m)
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, ms))
+    hs = jnp.swapaxes(hs, 0, 1)  # [N, T, D]
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        idx = (jnp.asarray(lens)[:, None] - 1 - jnp.arange(t)[None, :]) % \
+            jnp.maximum(jnp.asarray(lens)[:, None], 1)
+        hs = jnp.take_along_axis(hs, idx[:, :, None], axis=1)
+        cs = jnp.take_along_axis(cs, idx[:, :, None], axis=1)
+    return hs, cs
+
+
+@register_op(
+    "lstm",
+    inputs=[In("Input"), In("H0", dispensable=True), In("C0", dispensable=True),
+            In("Weight"), In("Bias")],
+    outputs=[Out("Hidden"), Out("Cell"),
+             Out("BatchGate", dispensable=True, no_grad=True),
+             Out("BatchCellPreAct", dispensable=True, no_grad=True)],
+    attrs={"use_peepholes": True, "is_reverse": False,
+           "gate_activation": "sigmoid", "cell_activation": "tanh",
+           "candidate_activation": "tanh", "is_test": False},
+    needs_lod=True,
+)
+def _dynamic_lstm(ins, attrs):
+    """LoD lstm op (reference operators/lstm_op.cc): X is pre-projected
+    [total, 4D]; recurrence + peepholes here, padded + masked scan."""
+    x = ins["Input"]
+    w = ins["Weight"]
+    b = ins["Bias"]
+    offsets = _lod_offsets(attrs, "Input")
+    if offsets is None:
+        raise ValueError("lstm requires LoD input")
+    d = w.shape[0]
+    use_peep = attrs.get("use_peepholes", True)
+    b = b.reshape(-1)
+    gate_b = b[:4 * d]
+    checks = (None, None, None)
+    if use_peep:
+        checks = (b[4 * d:5 * d], b[5 * d:6 * d], b[6 * d:7 * d])
+    x_pad, lens = _pad_from_lod(x + gate_b[None, :], offsets)
+    n = len(lens)
+    h0 = ins.get("H0")
+    c0 = ins.get("C0")
+    h0 = jnp.zeros((n, d), x.dtype) if h0 is None else h0
+    c0 = jnp.zeros((n, d), x.dtype) if c0 is None else c0
+    hs, cs = _lstm_scan(
+        x_pad, lens, w, checks, h0, c0,
+        _act(attrs.get("gate_activation", "sigmoid")),
+        _act(attrs.get("cell_activation", "tanh")),
+        _act(attrs.get("candidate_activation", "tanh")),
+        attrs.get("is_reverse", False))
+    return {"Hidden": _unpad_to_lod(hs, offsets),
+            "Cell": _unpad_to_lod(cs, offsets)}
+
+
+@register_op(
+    "gru",
+    inputs=[In("Input"), In("H0", dispensable=True), In("Weight"),
+            In("Bias", dispensable=True)],
+    outputs=[Out("Hidden"),
+             Out("BatchGate", dispensable=True, no_grad=True),
+             Out("BatchResetHiddenPrev", dispensable=True, no_grad=True),
+             Out("BatchHidden", dispensable=True, no_grad=True)],
+    attrs={"activation": "tanh", "gate_activation": "sigmoid",
+           "is_reverse": False, "origin_mode": False, "is_test": False},
+    needs_lod=True,
+)
+def _dynamic_gru(ins, attrs):
+    """LoD gru op (reference operators/gru_op.cc): X pre-projected
+    [total, 3D] (update|reset|candidate), W [D, 3D]."""
+    x = ins["Input"]
+    w = ins["Weight"]
+    offsets = _lod_offsets(attrs, "Input")
+    if offsets is None:
+        raise ValueError("gru requires LoD input")
+    d = w.shape[0]
+    if ins.get("Bias") is not None:
+        x = x + ins["Bias"].reshape(1, -1)
+    x_pad, lens = _pad_from_lod(x, offsets)
+    n = len(lens)
+    h0 = ins.get("H0")
+    h0 = jnp.zeros((n, d), x.dtype) if h0 is None else h0
+    gact = _act(attrs.get("gate_activation", "sigmoid"))
+    cact = _act(attrs.get("activation", "tanh"))
+    origin = attrs.get("origin_mode", False)
+    t = x_pad.shape[1]
+    mask = (jnp.arange(t)[None, :] < jnp.asarray(lens)[:, None]).astype(
+        x.dtype)
+    xs = jnp.swapaxes(x_pad, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+    if attrs.get("is_reverse", False):
+        idx = (jnp.asarray(lens)[:, None] - 1 - jnp.arange(t)[None, :]) % \
+            jnp.maximum(jnp.asarray(lens)[:, None], 1)
+        xs = jnp.swapaxes(
+            jnp.take_along_axis(x_pad, idx[:, :, None], axis=1), 0, 1)
+
+    def step(h_prev, inp):
+        x_t, m_t = inp
+        g = x_t[:, :2 * d] + jnp.matmul(h_prev, w[:, :2 * d])
+        u = gact(g[:, :d])
+        r = gact(g[:, d:])
+        c = cact(x_t[:, 2 * d:] + jnp.matmul(r * h_prev, w[:, 2 * d:]))
+        h = u * h_prev + (1 - u) * c if origin else \
+            (1 - u) * h_prev + u * c
+        m = m_t[:, None]
+        h = h * m + h_prev * (1 - m)
+        return h, h
+
+    _, hs = jax.lax.scan(step, h0, (xs, ms))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if attrs.get("is_reverse", False):
+        idx = (jnp.asarray(lens)[:, None] - 1 - jnp.arange(t)[None, :]) % \
+            jnp.maximum(jnp.asarray(lens)[:, None], 1)
+        hs = jnp.take_along_axis(hs, idx[:, :, None], axis=1)
+    return {"Hidden": _unpad_to_lod(hs, offsets)}
+
+
+@register_op(
+    "cudnn_lstm",
+    inputs=[In("Input"), In("InitH"), In("InitC"), In("W")],
+    outputs=[Out("Out"), Out("LastH"), Out("LastC"),
+             Out("Reserve", dispensable=True, no_grad=True),
+             Out("StateOut", dispensable=True, no_grad=True)],
+    attrs={"max_len": 0, "hidden_size": 0, "num_layers": 1,
+           "is_bidirec": False, "dropout_prob": 0.0, "is_test": False,
+           "input_size": 0, "seed": -1},
+    needs_rng=True,
+)
+def _cudnn_lstm(ins, attrs):
+    """Dense multi-layer (bi)LSTM over [T, N, D] — the layers.lstm op
+    (reference operators/cudnn_lstm_op.cc, GPU-only there; here a pure
+    XLA scan stack, trainable via the auto-VJP).
+
+    Flat weight layout per (layer, direction), concatenated:
+    Wx [in, 4H], Wh [H, 4H], b [4H] — gate order (c, i, f, o).
+    """
+    x = ins["Input"]  # [T, N, Din]
+    h0 = ins["InitH"]  # [L*dir, N, H]
+    c0 = ins["InitC"]
+    w = ins["W"].reshape(-1)
+    hidden = int(attrs["hidden_size"])
+    layers = int(attrs.get("num_layers", 1))
+    bidi = bool(attrs.get("is_bidirec", False))
+    ndir = 2 if bidi else 1
+    t, n, din = x.shape
+
+    def take(off, num, shape):
+        return w[off:off + num].reshape(shape), off + num
+
+    def run_dir(inp, h_init, c_init, wx, wh, b, reverse):
+        xs = inp[::-1] if reverse else inp
+        xp = jnp.einsum("tnd,dk->tnk", xs, wx) + b[None, None, :]
+
+        def step(carry, x_t):
+            h_prev, c_prev = carry
+            g = x_t + jnp.matmul(h_prev, wh)
+            hsz = hidden
+            cand = jnp.tanh(g[:, :hsz])
+            ig = jax.nn.sigmoid(g[:, hsz:2 * hsz])
+            fg = jax.nn.sigmoid(g[:, 2 * hsz:3 * hsz])
+            og = jax.nn.sigmoid(g[:, 3 * hsz:])
+            c = cand * ig + c_prev * fg
+            h = og * jnp.tanh(c)
+            return (h, c), h
+
+        (h_l, c_l), hs = jax.lax.scan(step, (h_init, c_init), xp)
+        if reverse:
+            hs = hs[::-1]
+        return hs, h_l, c_l
+
+    off = 0
+    cur = x
+    last_h, last_c = [], []
+    for layer in range(layers):
+        din_l = cur.shape[-1]
+        outs = []
+        for dirn in range(ndir):
+            wx, off = take(off, din_l * 4 * hidden, (din_l, 4 * hidden))
+            wh, off = take(off, hidden * 4 * hidden, (hidden, 4 * hidden))
+            b, off = take(off, 4 * hidden, (4 * hidden,))
+            sidx = layer * ndir + dirn
+            hs, h_l, c_l = run_dir(cur, h0[sidx], c0[sidx], wx, wh, b,
+                                   reverse=(dirn == 1))
+            outs.append(hs)
+            last_h.append(h_l)
+            last_c.append(c_l)
+        cur = jnp.concatenate(outs, axis=-1) if ndir == 2 else outs[0]
+        # inter-layer dropout (reference cudnn_lstm: applied between
+        # stacked layers, never after the last)
+        p = float(attrs.get("dropout_prob", 0.0))
+        if p > 0.0 and not attrs.get("is_test", False) \
+                and layer < layers - 1:
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(ins[RNG_SEED_ATTR]), layer)
+            keep = jax.random.bernoulli(key, 1.0 - p, cur.shape)
+            cur = jnp.where(keep, cur / (1.0 - p), 0.0).astype(cur.dtype)
+    return {"Out": cur, "LastH": jnp.stack(last_h, axis=0),
+            "LastC": jnp.stack(last_c, axis=0)}

@@ -14,27 +14,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.registry import In, Out, register_op
-
-_LOD = "_lod_"
-
-
-def _offsets(attrs, slot, level=-1):
-    lods = attrs.get(_LOD + slot)
-    if not lods or not lods[0]:
-        return None
-    return list(lods[0][level])
-
-
-def _seg_ids(offsets):
-    ids = np.zeros(offsets[-1], dtype=np.int32)
-    for i in range(len(offsets) - 1):
-        ids[offsets[i] : offsets[i + 1]] = i
-    return jnp.asarray(ids)
-
-
-def _seq_lens(offsets):
-    return np.diff(np.asarray(offsets))
+from ..core.registry import In, Out, register_host_op, register_op
+from .lod_utils import LOD_ATTR_PREFIX as _LOD
+from .lod_utils import lod_offsets as _offsets
+from .lod_utils import seg_ids as _seg_ids
+from .lod_utils import seq_lens as _seq_lens
 
 
 @register_op(
@@ -202,21 +186,28 @@ def _sequence_pad(ins, attrs):
     return {"Out": out, "Length": jnp.asarray(lens, dtype=jnp.int64)}
 
 
-@register_op(
+@register_host_op(
     "sequence_unpad",
     inputs=[In("X"), In("Length", no_grad=True)],
     outputs=[Out("Out")],
-    needs_lod=True,
-    infer_lod=None,
 )
-def _sequence_unpad(ins, attrs):
-    # Lengths must be trace-static: read from the Length input's aval is not
-    # possible, so the executor path supplies them via lod of Out; we build
-    # indices from the static lod recorded on X if present, else require
-    # equal lengths.
-    raise NotImplementedError(
-        "sequence_unpad requires host lengths; use DataLoader-side unpad"
-    )
+def _sequence_unpad(executor, op, scope):
+    """Padded [N, T, ...] + lengths -> LoD [total, ...] (reference
+    sequence_ops/sequence_unpad_op.h). Output LoD depends on the Length
+    VALUES, so this is a host op that stamps the LoD directly."""
+    from ..core.tensor import LoDTensor
+
+    x = np.asarray(executor._read_var(scope, op.input("X")[0]))
+    lens = np.asarray(
+        executor._read_var(scope, op.input("Length")[0])).reshape(-1)
+    segs = [x[i, : int(lens[i])] for i in range(x.shape[0])]
+    out = np.concatenate(segs, axis=0) if segs else x[:0]
+    lod = [0]
+    for l in lens:
+        lod.append(lod[-1] + int(l))
+    t = LoDTensor(out)
+    t.set_lod([lod])
+    executor._write_var(scope, op.output("Out")[0], t)
 
 
 @register_op(
@@ -253,15 +244,37 @@ def _sequence_concat(ins, attrs):
     return {"Out": jnp.concatenate(parts, axis=0)}
 
 
-@register_op(
+@register_host_op(
     "sequence_slice",
     inputs=[In("X"), In("Offset", no_grad=True), In("Length", no_grad=True)],
     outputs=[Out("Out")],
-    needs_lod=True,
-    infer_lod=None,
 )
-def _sequence_slice(ins, attrs):
-    raise NotImplementedError("sequence_slice requires host offsets")
+def _sequence_slice(executor, op, scope):
+    """Per-sequence [offset, offset+length) slice (reference
+    sequence_ops/sequence_slice_op.h). Output LoD depends on the Length
+    values -> host op."""
+    from ..core.tensor import LoDTensor
+
+    xv = scope.find_var(op.input("X")[0]).raw()
+    x = np.asarray(xv.array if isinstance(xv, LoDTensor) else xv)
+    in_lod = xv.lod() if isinstance(xv, LoDTensor) else []
+    if not in_lod:
+        raise ValueError("sequence_slice requires LoD input")
+    offsets = list(in_lod[-1])
+    off = np.asarray(
+        executor._read_var(scope, op.input("Offset")[0])).reshape(-1)
+    length = np.asarray(
+        executor._read_var(scope, op.input("Length")[0])).reshape(-1)
+    segs = []
+    lod = [0]
+    for i in range(len(offsets) - 1):
+        s = offsets[i] + int(off[i])
+        segs.append(x[s: s + int(length[i])])
+        lod.append(lod[-1] + int(length[i]))
+    out = np.concatenate(segs, axis=0) if segs else x[:0]
+    t = LoDTensor(out)
+    t.set_lod([lod])
+    executor._write_var(scope, op.output("Out")[0], t)
 
 
 @register_op(
