@@ -94,6 +94,49 @@ def append_backward(
                                      no_grad_set, checkpoints)
 
 
+def _emit_recompute_ops(block, path, checkpoints) -> Dict[str, str]:
+    """Append renamed copies of the forward path ops (checkpoint vars and
+    externally-produced vars are read as-is). Returns the old->new name
+    map the grad binding uses for forward-value references."""
+    keep = {c.name if hasattr(c, "name") else str(c) for c in checkpoints}
+    rename: Dict[str, str] = {}
+    for idx in path:
+        op = block.ops[idx]
+        outs_to_rename = [n for n in op.output_arg_names
+                          if n and n not in keep]
+        if not outs_to_rename:
+            continue  # only checkpoint outputs: stored, not recomputed
+        new_inputs = {slot: [rename.get(n, n) for n in names]
+                      for slot, names in op.inputs.items()}
+        new_outputs = {}
+        for slot, names in op.outputs.items():
+            outs = []
+            for n in names:
+                if not n:
+                    outs.append(n)
+                    continue
+                # NEVER rebind the original name: checkpoint values are
+                # stored (reads go to the original), and persistable
+                # outputs (BN running stats) must not update twice.
+                nn = n + "@RECOMPUTE"
+                if nn not in block.vars:
+                    v = block._find_var_recursive(n)
+                    nv = block.create_var(
+                        name=nn,
+                        shape=None if v is None else v.shape,
+                        dtype="float32" if v is None else v.dtype)
+                    nv.stop_gradient = True
+                if n not in keep:
+                    rename[n] = nn
+                outs.append(nn)
+            new_outputs[slot] = outs
+        attrs = dict(op.attrs)
+        attrs.setdefault("_fwd_op_id", op._id or 0)
+        block.append_op(op.type, inputs=new_inputs, outputs=new_outputs,
+                        attrs=attrs, infer_shape=False)
+    return rename
+
+
 def _append_backward_impl(loss, block, program, parameter_list=None,
                           no_grad_set=None, checkpoints=None):
 
@@ -118,6 +161,19 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
                     diffable.add(n)
 
     path = _find_op_path(block, loss.name, req)
+
+    # Recompute (reference backward.py:623
+    # _append_backward_ops_with_checkpoints_): re-emit the forward ops of
+    # each inter-checkpoint segment at the start of the backward region
+    # with renamed outputs; grad ops then read the RECOMPUTED values, so
+    # the original intermediates have no backward consumers and die
+    # early. RNG ops re-emit with the original op's seed stream so
+    # dropout masks match. (Under whole-program compilation XLA may CSE
+    # a re-emitted op back onto its original when that is cheaper —
+    # memory behavior is then the compiler's call, never worse.)
+    recompute_rename: Dict[str, str] = {}
+    if checkpoints:
+        recompute_rename = _emit_recompute_ops(block, path, checkpoints)
 
     # Seed d(loss)/d(loss) = 1
     loss_grad_name = framework.grad_var_name(loss.name)
@@ -191,19 +247,23 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
         if not has_grad:
             continue
 
-        # bind inputs: forward ins + out grads
+        # bind inputs: forward ins + out grads. Forward VALUE references
+        # go through the recompute rename (grad math reads recomputed
+        # activations); grad accumulation stays on original names.
         g_inputs = {}
         for slot in info.inputs:
             names = op.input(slot.name)
             if names:
-                g_inputs[slot.name] = list(names)
+                g_inputs[slot.name] = [recompute_rename.get(n, n)
+                                       for n in names]
         g_inputs.update(out_grads)
         # some custom grad ops consume forward outputs too (slot name match)
         for slot in ginfo.inputs:
             if slot.name in g_inputs or slot.name.endswith(GRAD_SUFFIX):
                 continue
             if slot.name in op.outputs:
-                g_inputs[slot.name] = list(op.outputs[slot.name])
+                g_inputs[slot.name] = [recompute_rename.get(n, n)
+                                       for n in op.outputs[slot.name]]
 
         # outputs: a fresh partial-grad name per diffable input var.
         # no_grad forward slots (labels, masks) never get a grad binding —

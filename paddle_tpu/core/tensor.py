@@ -121,7 +121,18 @@ class LoDTensor:
 
 class SelectedRows:
     """Sparse row-set tensor: ``value[i]`` is the update for row ``rows[i]``
-    of a dense tensor with ``height`` rows."""
+    of a dense tensor with ``height`` rows.
+
+    Parity: /root/reference/paddle/fluid/framework/selected_rows.h:32.
+    TPU-native design decision (SURVEY.md §7 hard part (c)): under
+    whole-program compilation, embedding gradients stay DENSE —
+    lookup_table_grad lowers to an XLA scatter-add the compiler fuses
+    into the update, which on TPU beats materializing a ragged row set
+    on the host. SelectedRows therefore serves (a) host-side API parity
+    (merge_selected_rows / get_tensor_from_selected_rows ops and the
+    save/load surface), and (b) the parameter-server path, where large
+    sparse tables shard across workers and route rows via all-to-all
+    (parallel/sharded_embedding) instead of PS pull/push."""
 
     __slots__ = ("_rows", "_value", "_height")
 

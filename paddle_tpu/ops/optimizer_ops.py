@@ -350,3 +350,64 @@ _op(
     {"l1": 0.0, "l2": 0.0},
     _proximal_gd,
 )
+
+
+@register_op(
+    "ema_accumulate",
+    inputs=[In("Param", no_grad=True), In("Shadow", no_grad=True)],
+    outputs=[Out("ShadowOut")],
+    attrs={"decay": 0.999},
+)
+def _ema_accumulate(ins, attrs):
+    """shadow = decay*shadow + (1-decay)*param (reference
+    optimizer.py:3174 ExponentialMovingAverage update block)."""
+    d = attrs.get("decay", 0.999)
+    return {"ShadowOut": d * ins["Shadow"] + (1.0 - d) * ins["Param"]}
+
+
+@register_op(
+    "lookahead_update",
+    inputs=[In("Param", no_grad=True), In("Slow", no_grad=True),
+            In("Step", no_grad=True)],
+    outputs=[Out("ParamOut"), Out("SlowOut")],
+    attrs={"alpha": 0.5, "k": 5},
+)
+def _lookahead_update(ins, attrs):
+    """Every k steps: slow += alpha*(fast-slow); fast = slow (reference
+    optimizer.py:4018 Lookahead, functional select instead of cond)."""
+    p, slow, step = ins["Param"], ins["Slow"], ins["Step"]
+    alpha = attrs.get("alpha", 0.5)
+    k = attrs.get("k", 5)
+    sync = (step.reshape(()).astype(jnp.int32) % k) == 0
+    slow_new = slow + alpha * (p - slow)
+    return {"ParamOut": jnp.where(sync, slow_new, p),
+            "SlowOut": jnp.where(sync, slow_new, slow)}
+
+
+@register_op(
+    "model_average_accumulate",
+    inputs=[In("Param", no_grad=True), In("Sum", no_grad=True),
+            In("Count", no_grad=True), In("NumUpdates", no_grad=True)],
+    outputs=[Out("SumOut"), Out("CountOut")],
+    attrs={"average_window": 0.15, "min_average_window": 10000,
+           "max_average_window": 10000},
+)
+def _model_average_accumulate(ins, attrs):
+    """Sliding-window parameter-sum accumulator (reference
+    optimizer.py:2870 ModelAverage): when the count would exceed
+    min(max_average_window, num_updates * average_window_rate), the
+    window restarts at the current parameter value."""
+    p, s, c = ins["Param"], ins["Sum"], ins["Count"]
+    upd = ins["NumUpdates"].reshape(())
+    rate = attrs.get("average_window", 0.15)
+    max_w = attrs.get("max_average_window", 10000)
+    min_w = attrs.get("min_average_window", 10000)
+    # reference average_accumulates_op.h: restart only once the count
+    # passes BOTH min_average_window and min(max_window, updates*rate)
+    window = jnp.minimum(jnp.float32(max_w), upd * rate)
+    c_new = c + 1.0
+    cn = c_new.reshape(())
+    restart = (cn >= min_w) & (cn >= window)
+    sum_out = jnp.where(restart, p, s + p)
+    cnt_out = jnp.where(restart, jnp.ones_like(c), c_new)
+    return {"SumOut": sum_out, "CountOut": cnt_out}

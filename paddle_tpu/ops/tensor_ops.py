@@ -831,3 +831,77 @@ def _range(executor, op, scope):
     end = np.asarray(executor._read_var(scope, op.input("End")[0])).reshape(())
     step = np.asarray(executor._read_var(scope, op.input("Step")[0])).reshape(())
     executor._write_var(scope, op.output("Out")[0], np.arange(start, end, step))
+
+
+def _merge_rows(rows, vals):
+    """Sum duplicate rows: (ids, values) -> (unique ids, summed rows)."""
+    uniq, inv = np.unique(rows, return_inverse=True)
+    merged = np.zeros((len(uniq),) + vals.shape[1:], dtype=vals.dtype)
+    np.add.at(merged, inv, vals)
+    return uniq, merged
+
+
+@register_host_op(
+    "merge_selected_rows",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _merge_selected_rows(executor, op, scope):
+    """Sum duplicate rows of a SelectedRows (reference
+    operators/math/selected_rows_functor.cc MergeAdd)."""
+    from ..core.tensor import LoDTensor, SelectedRows
+
+    sr = scope.find_var(op.input("X")[0]).raw()
+    if not isinstance(sr, SelectedRows):
+        raise TypeError("merge_selected_rows expects SelectedRows input")
+    rows = np.asarray(sr.rows(), dtype=np.int64)
+    vals = np.asarray(sr.get_tensor().array)
+    uniq, merged = _merge_rows(rows, vals)
+    out = SelectedRows(rows=uniq.tolist(), height=sr.height(),
+                       value=LoDTensor(merged))
+    scope.var(op.output("Out")[0]).set(out)
+
+
+@register_host_op(
+    "get_tensor_from_selected_rows",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _get_tensor_from_selected_rows(executor, op, scope):
+    """SelectedRows -> dense rows tensor (reference
+    operators/get_tensor_from_selected_rows_op.cc)."""
+    from ..core.tensor import SelectedRows
+
+    sr = scope.find_var(op.input("X")[0]).raw()
+    if not isinstance(sr, SelectedRows):
+        raise TypeError("expects SelectedRows input")
+    executor._write_var(scope, op.output("Out")[0],
+                        np.asarray(sr.get_tensor().array))
+
+
+@register_host_op(
+    "lookup_sparse_table_grad_split",
+    inputs=[In("Grad", no_grad=True), In("Ids", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"height": 0},
+)
+def _lookup_sparse_table_grad_split(executor, op, scope):
+    """Dense embedding grad + ids -> SelectedRows (rows=unique ids,
+    values=summed grad rows) — the host-side bridge from the compiled
+    dense-grad path into SelectedRows consumers (save, PS send)."""
+    from ..core.tensor import LoDTensor, SelectedRows
+
+    grad = np.asarray(executor._read_var(scope, op.input("Grad")[0]))
+    ids = np.asarray(executor._read_var(scope, op.input("Ids")[0])).reshape(-1)
+    # grad rows: [n_ids, D]; numpy rejects reshape(0, -1) on size-0
+    # arrays, so build the empty case from the trailing dims directly
+    if len(ids):
+        g = grad.reshape(len(ids), -1)
+    else:
+        d = int(np.prod(grad.shape[1:])) if grad.ndim > 1 else 1
+        g = np.zeros((0, d), dtype=grad.dtype)
+    uniq, merged = _merge_rows(ids, g)
+    out = SelectedRows(rows=uniq.tolist(),
+                       height=int(op.attrs.get("height", 0)),
+                       value=LoDTensor(merged))
+    scope.var(op.output("Out")[0]).set(out)

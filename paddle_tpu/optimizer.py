@@ -48,6 +48,11 @@ __all__ = [
     "Ftrl",
     "FtrlOptimizer",
     "LambOptimizer",
+    "RecomputeOptimizer",
+    "PipelineOptimizer",
+    "ExponentialMovingAverage",
+    "ModelAverage",
+    "LookaheadOptimizer",
 ]
 
 
@@ -176,7 +181,9 @@ class Optimizer:
         return self._create_optimization_pass(params_grads)
 
     def _create_optimization_pass(self, params_grads):
-        block = framework.default_main_program().global_block()
+        # current (not global) block: PipelineOptimizer runs this inside
+        # a conditional sub-block; in the normal path they are the same
+        block = framework.default_main_program().current_block()
         self.helper = LayerHelper(self.__class__.__name__)
         self._create_global_learning_rate()
         self._create_accumulators(
@@ -533,6 +540,387 @@ class LambOptimizer(AdamOptimizer):
 
     def _extra_attrs(self):
         return {"weight_decay": self._weight_decay}
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation recomputation (reference optimizer.py:3722
+    RecomputeOptimizer + backward.py:623): only the listed checkpoint
+    activations are kept for backward; each inter-checkpoint forward
+    segment is re-emitted in the backward region and grad ops read the
+    recomputed values."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+        # delegate the shared-state surface the base class expects
+        self._parameter_list = getattr(optimizer, "_parameter_list", None)
+        self._grad_clip = getattr(optimizer, "_grad_clip", None)
+        self.regularization = getattr(optimizer, "regularization", None)
+
+    def _set_checkpoints(self, checkpoints):
+        if not isinstance(checkpoints, (list, tuple)):
+            raise ValueError("checkpoints must be a list of Variables")
+        self._checkpoints = list(checkpoints)
+
+    def load(self, state):
+        raise NotImplementedError(
+            "load function is not supported by Recompute Optimizer")
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        if self._checkpoints is None:
+            raise ValueError("_set_checkpoints must be called first")
+        return append_backward(
+            loss, parameter_list or self._parameter_list, no_grad_set,
+            callbacks, checkpoints=self._checkpoints)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+class PipelineOptimizer:
+    """Synchronous pipeline training (reference optimizer.py:3422
+    PipelineOptimizer + section_worker.cc).
+
+    TPU-native formulation: synchronous (GPipe-style) pipelining is
+    mathematically gradient accumulation over ``num_microbatches`` —
+    each run() call feeds ONE microbatch; gradients accumulate in-graph
+    and the wrapped optimizer's update ops run inside a
+    conditional_block that fires every k-th microbatch (lowered to
+    lax.cond, so the whole step stays one compiled program and
+    optimizer state is untouched on skip ticks). ``cut_list`` /
+    ``place_list`` / ``concurrency_list`` are accepted for API parity;
+    physical stage placement over a 'pp' mesh axis is the multi-host
+    runtime's concern (parallel/), not a per-op scope swap as in the
+    reference's SectionWorker threads."""
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0, num_microbatches=None):
+        self._optimizer = optimizer
+        self._cut_list = cut_list
+        self._place_list = place_list
+        self._num_microbatches = num_microbatches or max(
+            len(cut_list) + 1 if cut_list else 1, 1)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .layers import tensor as layers_tensor
+
+        k = int(self._num_microbatches)
+        program = loss.block.program
+        block = program.global_block()
+        # every append below (incl. the wrapped optimizer's update ops,
+        # which go to default_main_program().current_block()) must target
+        # THIS program even if minimize() is called outside the guard
+        # that built the graph
+        with framework.program_guard(program):
+            return self._minimize_impl(loss, startup_program,
+                                       parameter_list, no_grad_set, k,
+                                       program, block)
+
+    def _minimize_impl(self, loss, startup_program, parameter_list,
+                       no_grad_set, k, program, block):
+        from .layers import tensor as layers_tensor
+
+        # 1/k loss scaling so the accumulated grad is the full-batch mean
+        scaled = loss
+        if k > 1:
+            out = block.create_var(
+                name=framework.unique_name.generate(loss.name + ".pipe"),
+                shape=loss.shape, dtype=loss.dtype)
+            block.append_op("scale", inputs={"X": [loss]},
+                            outputs={"Out": [out]},
+                            attrs={"scale": 1.0 / k}, infer_shape=False)
+            scaled = out
+        params_grads = self._optimizer.backward(
+            scaled, startup_program, parameter_list, no_grad_set)
+        if k <= 1:
+            return (self._optimizer.apply_gradients(params_grads),
+                    params_grads)
+
+        with program._optimized_guard():
+            step = layers_tensor.create_global_var(
+                name=framework.unique_name.generate("pipe_step"),
+                shape=[1], dtype="int32", value=0, persistable=True)
+            block.append_op("increment", inputs={"X": [step]},
+                            outputs={"Out": [step]}, attrs={"step": 1.0},
+                            infer_shape=False)
+            accum_pg = []
+            for p, g in params_grads:
+                if g is None:
+                    accum_pg.append((p, g))
+                    continue
+                acc = layers_tensor.create_global_var(
+                    name=p.name + ".pipe_acc", shape=p.shape, dtype=p.dtype,
+                    value=0.0, persistable=True)
+                block.append_op("elementwise_add",
+                                inputs={"X": [acc], "Y": [g]},
+                                outputs={"Out": [acc]},
+                                attrs={"axis": -1}, infer_shape=False)
+                accum_pg.append((p, acc))
+            # fire the update every k-th microbatch
+            kconst = layers_tensor.fill_constant([1], "int32", k)
+            zero = layers_tensor.fill_constant([1], "int32", 0)
+            mod = block.create_var(
+                name=framework.unique_name.generate("pipe_mod"),
+                shape=(1,), dtype="int32")
+            block.append_op("elementwise_mod",
+                            inputs={"X": [step], "Y": [kconst]},
+                            outputs={"Out": [mod]}, attrs={"axis": -1},
+                            infer_shape=False)
+            cond = block.create_var(
+                name=framework.unique_name.generate("pipe_cond"),
+                shape=(1,), dtype="bool")
+            block.append_op("equal", inputs={"X": [mod], "Y": [zero]},
+                            outputs={"Out": [cond]}, infer_shape=False)
+
+            sub = program._create_block()
+            try:
+                optimize_ops = self._optimizer.apply_gradients(accum_pg)
+                for p, acc in accum_pg:
+                    if acc is None:
+                        continue
+                    sub.append_op(
+                        "fill_constant", inputs={},
+                        outputs={"Out": [acc.name]},
+                        attrs={"shape": list(acc.shape), "value": 0.0,
+                               "dtype": _dt.dtype_to_enum(acc.dtype)},
+                        infer_shape=False)
+            finally:
+                program._rollback()
+            block.append_op(
+                "conditional_block",
+                inputs={"Cond": [cond]}, outputs={},
+                attrs={"sub_block": sub, "is_scalar_condition": True},
+                infer_shape=False)
+        return optimize_ops, params_grads
+
+
+class _ParamSwapper:
+    """Shared apply()/restore() machinery: swap parameter arrays in the
+    global scope with computed replacements, then swap back."""
+
+    def __init__(self):
+        self._backups = {}
+
+    def _replacement(self, scope, pname):
+        """Return the replacement array for `pname`, or None to skip."""
+        raise NotImplementedError
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            from .core import global_scope
+
+            scope = global_scope()
+            for pname in self._param_names():
+                pv = scope.find_var(pname)
+                if pv is None:
+                    continue
+                repl = self._replacement(scope, pname)
+                if repl is None:
+                    continue
+                self._backups[pname] = pv.get_tensor().array
+                pv.get_tensor()._array = repl
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+
+        return _ctx()
+
+    def restore(self, executor=None):
+        from .core import global_scope
+
+        scope = global_scope()
+        for pname, arr in self._backups.items():
+            pv = scope.find_var(pname)
+            if pv is not None:
+                pv.get_tensor()._array = arr
+        self._backups = {}
+
+
+class ExponentialMovingAverage(_ParamSwapper):
+    """EMA of parameters (reference optimizer.py:3174): shadow vars
+    updated each step by `update()` ops; `apply()` swaps params with the
+    BIAS-CORRECTED shadows (ema / (1 - decay^t), as the reference's
+    apply program computes), `restore()` swaps back."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        super().__init__()
+        self._decay = decay
+        self._thres_steps = thres_steps  # accepted; step-adaptive decay
+        self._name = name or ""
+        self._shadows = {}  # param name -> shadow var
+        self._decay_pow = None
+
+    def _param_names(self):
+        return list(self._shadows)
+
+    def update(self):
+        from .layers import tensor as layers_tensor
+
+        block = framework.default_main_program().global_block()
+        params = [p for p in block.all_parameters
+                  if getattr(p, "trainable", True)]
+        self._decay_pow = layers_tensor.create_global_var(
+            name=framework.unique_name.generate(self._name + "ema_decay_pow"),
+            shape=[1], value=1.0, dtype="float32", persistable=True)
+        block.append_op(
+            "scale", inputs={"X": [self._decay_pow]},
+            outputs={"Out": [self._decay_pow]},
+            attrs={"scale": float(self._decay)}, infer_shape=False)
+        for p in params:
+            shadow = layers_tensor.create_global_var(
+                name=self._name + p.name + ".ema", shape=p.shape,
+                dtype=p.dtype, value=0.0, persistable=True)
+            self._shadows[p.name] = shadow
+            # shadow = decay*shadow + (1-decay)*param
+            block.append_op(
+                "ema_accumulate",
+                inputs={"Param": [p], "Shadow": [shadow]},
+                outputs={"ShadowOut": [shadow]},
+                attrs={"decay": self._decay},
+                infer_shape=False)
+
+    def _replacement(self, scope, pname):
+        sv = scope.find_var(self._shadows[pname].name)
+        if sv is None or not sv.is_initialized():
+            return None
+        correction = 1.0
+        if self._decay_pow is not None:
+            dv = scope.find_var(self._decay_pow.name)
+            if dv is not None and dv.is_initialized():
+                dp = float(np.asarray(dv.get_tensor().array).ravel()[0])
+                denom = 1.0 - dp
+                if denom > 1e-12:
+                    correction = denom
+        return sv.get_tensor().array / correction
+
+
+class ModelAverage(Optimizer, _ParamSwapper):
+    """Sliding-window average of parameters (reference optimizer.py:2870):
+    the accumulator RESTARTS whenever its count exceeds
+    min(max_average_window, num_updates * average_window_rate), so the
+    average covers recent steps, not all history; apply()/restore()
+    swap params to the averaged value for evaluation."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        Optimizer.__init__(self, learning_rate=0.0,
+                           regularization=regularization, name=name)
+        _ParamSwapper.__init__(self)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._sums = {}
+        self._counts = {}
+        block = framework.default_main_program().global_block()
+        from .layers import tensor as layers_tensor
+
+        upd = layers_tensor.create_global_var(
+            name=framework.unique_name.generate("avg_num_updates"),
+            shape=[1], dtype="float32", value=0.0, persistable=True)
+        block.append_op("increment", inputs={"X": [upd]},
+                        outputs={"Out": [upd]}, attrs={"step": 1.0},
+                        infer_shape=False)
+        for p in block.all_parameters:
+            if not getattr(p, "trainable", True):
+                continue
+            s = layers_tensor.create_global_var(
+                name=p.name + ".avg_sum", shape=p.shape, dtype=p.dtype,
+                value=0.0, persistable=True)
+            c = layers_tensor.create_global_var(
+                name=p.name + ".avg_cnt", shape=[1], dtype="float32",
+                value=0.0, persistable=True)
+            self._sums[p.name] = s
+            self._counts[p.name] = c
+            block.append_op(
+                "model_average_accumulate",
+                inputs={"Param": [p], "Sum": [s], "Count": [c],
+                        "NumUpdates": [upd]},
+                outputs={"SumOut": [s], "CountOut": [c]},
+                attrs={"average_window": self.average_window,
+                       "min_average_window": self.min_average_window,
+                       "max_average_window": self.max_average_window},
+                infer_shape=False)
+
+    def _param_names(self):
+        return list(self._sums)
+
+    def _replacement(self, scope, pname):
+        sv = scope.find_var(self._sums[pname].name)
+        cv = scope.find_var(self._counts[pname].name)
+        if sv is None or cv is None or not sv.is_initialized():
+            return None
+        cnt = float(np.asarray(cv.get_tensor().array).ravel()[0])
+        if cnt <= 0:
+            return None
+        return sv.get_tensor().array / cnt
+
+
+class LookaheadOptimizer:
+    """Lookahead wrapper (reference optimizer.py:4018): fast optimizer
+    steps every iteration; every k steps slow weights interpolate toward
+    fast weights and fast weights reset to slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .layers import tensor as layers_tensor
+
+        result = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        block = loss.block
+        params = [p for p in block.program.global_block().all_parameters
+                  if getattr(p, "trainable", True)]
+        step = layers_tensor.create_global_var(
+            name=framework.unique_name.generate("lookahead_step"),
+            shape=[1], dtype="int32", value=0, persistable=True)
+        block.append_op("increment", inputs={"X": [step]},
+                        outputs={"Out": [step]}, attrs={"step": 1.0},
+                        infer_shape=False)
+        startup = framework.default_startup_program().global_block()
+        for p in params:
+            slow = layers_tensor.create_global_var(
+                name=p.name + ".slow", shape=p.shape, dtype=p.dtype,
+                value=0.0, persistable=True)
+            # slow weights start AT the params (reference startup assign)
+            startup.append_op("assign", inputs={"X": [p.name]},
+                              outputs={"Out": [slow.name]},
+                              infer_shape=False)
+            block.append_op(
+                "lookahead_update",
+                inputs={"Param": [p], "Slow": [slow], "Step": [step]},
+                outputs={"ParamOut": [p], "SlowOut": [slow]},
+                attrs={"alpha": self.alpha, "k": self.k},
+                infer_shape=False)
+        return result
 
 
 # 2.0-alpha style aliases

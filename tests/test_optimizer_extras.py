@@ -1,0 +1,257 @@
+"""RecomputeOptimizer / EMA / ModelAverage / Lookahead tests.
+
+Contracts from the reference suite (test_recompute_optimizer.py:
+recompute training matches plain training; test_ema.py;
+test_lookahead.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _mlp_program(lr=0.1, recompute=False, depth=4):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[32, 16], dtype="float32")
+        y = fluid.data(name="y", shape=[32, 1], dtype="float32")
+        h = x
+        checkpoints = []
+        for i in range(depth):
+            h = fluid.layers.fc(h, 32, act="relu")
+            if i % 2 == 1:
+                checkpoints.append(h)
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGD(lr)
+        if recompute:
+            opt = fluid.optimizer.RecomputeOptimizer(opt)
+            opt._set_checkpoints(checkpoints)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, steps=10, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(16, 1).astype("float32")
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for i in range(steps):
+            xb = rng.randn(32, 16).astype("float32")
+            (l,) = exe.run(main, feed={"x": xb, "y": xb @ W},
+                           fetch_list=[loss_var_of(main)])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    return losses
+
+
+def loss_var_of(main):
+    # the mean op's output is the loss
+    for op in reversed(main.global_block().ops):
+        if op.type == "mean" and not op._role:
+            return op.output("Out")[0]
+    raise AssertionError("no loss found")
+
+
+class TestRecompute:
+    def test_program_contains_recomputed_segment(self):
+        main, startup, loss = _mlp_program(recompute=True)
+        ops = main.global_block().ops
+        rec_ops = [op for op in ops
+                   if any(n.endswith("@RECOMPUTE")
+                          for n in op.output_arg_names)]
+        assert rec_ops, "no recompute ops emitted"
+        # recompute ops carry the Backward role (pruned by for_test)
+        from paddle_tpu.framework import OpRole
+
+        assert all(op._role & OpRole.Backward for op in rec_ops)
+        test_prog = main.clone(for_test=True)
+        assert not any(
+            n.endswith("@RECOMPUTE")
+            for op in test_prog.global_block().ops
+            for n in op.output_arg_names)
+
+    def test_training_parity_with_plain(self):
+        """From identical inits, recompute training matches plain
+        training exactly (the reference test_recompute_optimizer
+        contract): recomputed activations are the same values."""
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        batches = [(rng.randn(32, 16).astype("float32"),
+                    rng.randn(32, 1).astype("float32")) for _ in range(3)]
+        inits = {}
+        traces = {}
+        for rc in (False, True):
+            main, startup, loss = _mlp_program(recompute=rc)
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                for i, p in enumerate(main.global_block().all_parameters):
+                    if i not in inits:
+                        inits[i] = np.random.RandomState(100 + i).randn(
+                            *p.shape).astype("float32") * 0.3
+                    scope.var(p.name).get_tensor()._array = \
+                        jnp.asarray(inits[i])
+                ls = []
+                for xb, yb in batches:
+                    (l,) = exe.run(main, feed={"x": xb, "y": yb},
+                                   fetch_list=[loss])
+                    ls.append(float(np.asarray(l).ravel()[0]))
+                traces[rc] = ls
+        np.testing.assert_allclose(traces[True], traces[False],
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestEMA:
+    def test_shadow_tracks_params(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[8, 4], dtype="float32")
+            y = fluid.data(name="y", shape=[8, 1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.2).minimize(loss)
+            ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+            ema.update()
+        rng = np.random.RandomState(1)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for i in range(5):
+                xb = rng.randn(8, 4).astype("float32")
+                exe.run(main, feed={"x": xb, "y": np.ones((8, 1), "float32")},
+                        fetch_list=[loss])
+            w_name = main.global_block().all_parameters[0].name
+            w_now = np.asarray(scope.find_var(w_name).raw().array).copy()
+            with ema.apply(exe):
+                w_ema = np.asarray(scope.find_var(w_name).raw().array).copy()
+            w_back = np.asarray(scope.find_var(w_name).raw().array)
+        assert not np.allclose(w_ema, w_now)  # shadow differs mid-training
+        np.testing.assert_array_equal(w_back, w_now)  # restored
+
+
+class TestLookahead:
+    def test_slow_weights_sync_every_k(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[8, 4], dtype="float32")
+            y = fluid.data(name="y", shape=[8, 1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            opt = fluid.optimizer.LookaheadOptimizer(
+                fluid.optimizer.SGD(0.3), alpha=0.5, k=3)
+            opt.minimize(loss)
+        rng = np.random.RandomState(2)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            w_name = main.global_block().all_parameters[0].name
+            slow_name = w_name + ".slow"
+            w0 = np.asarray(scope.find_var(w_name).raw().array).copy()
+            np.testing.assert_array_equal(
+                np.asarray(scope.find_var(slow_name).raw().array), w0)
+            losses = []
+            for i in range(6):
+                xb = rng.randn(8, 4).astype("float32")
+                (l,) = exe.run(main,
+                               feed={"x": xb, "y": np.ones((8, 1),
+                                                           "float32")},
+                               fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+            slow_end = np.asarray(scope.find_var(slow_name).raw().array)
+        assert not np.allclose(slow_end, w0)  # synced at steps 3 and 6
+        assert losses[-1] < losses[0]
+
+
+class TestModelAverage:
+    def test_average_applied_and_restored(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[8, 4], dtype="float32")
+            y = fluid.data(name="y", shape=[8, 1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.2).minimize(loss)
+            avg = fluid.optimizer.ModelAverage(0.15)
+        rng = np.random.RandomState(3)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for i in range(4):
+                xb = rng.randn(8, 4).astype("float32")
+                exe.run(main, feed={"x": xb, "y": np.ones((8, 1),
+                                                          "float32")},
+                        fetch_list=[loss])
+            w_name = main.global_block().all_parameters[0].name
+            w_now = np.asarray(scope.find_var(w_name).raw().array).copy()
+            with avg.apply(exe):
+                w_avg = np.asarray(
+                    scope.find_var(w_name).raw().array).copy()
+            w_back = np.asarray(scope.find_var(w_name).raw().array)
+        assert not np.allclose(w_avg, w_now)
+        np.testing.assert_array_equal(w_back, w_now)
+
+
+class TestPipeline:
+    def test_microbatches_equal_full_batch_step(self):
+        """K microbatches through PipelineOptimizer == one full-batch
+        SGD step, exactly (sync-pipeline/GPipe math)."""
+        import jax.numpy as jnp
+
+        K, B = 4, 8
+        rng = np.random.RandomState(0)
+        Xfull = rng.randn(B * K, 4).astype("float32")
+        Yfull = rng.randn(B * K, 1).astype("float32")
+
+        def build(pipeline):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                bs = B if pipeline else B * K
+                x = fluid.data(name="x", shape=[bs, 4], dtype="float32")
+                y = fluid.data(name="y", shape=[bs, 1], dtype="float32")
+                pred = fluid.layers.fc(x, 1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                opt = fluid.optimizer.SGD(0.1)
+                if pipeline:
+                    opt = fluid.optimizer.PipelineOptimizer(
+                        opt, num_microbatches=K)
+                opt.minimize(loss)
+            return main, startup, loss
+
+        init, w = {}, {}
+        for pipe in (False, True):
+            main, startup, loss = build(pipe)
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                for i, p in enumerate(
+                        main.global_block().all_parameters):
+                    if i not in init:
+                        init[i] = np.random.RandomState(50 + i).randn(
+                            *p.shape).astype("float32") * 0.3
+                    scope.var(p.name).get_tensor()._array = \
+                        jnp.asarray(init[i])
+                if pipe:
+                    for m in range(K):
+                        exe.run(main,
+                                feed={"x": Xfull[m * B:(m + 1) * B],
+                                      "y": Yfull[m * B:(m + 1) * B]},
+                                fetch_list=[loss])
+                else:
+                    exe.run(main, feed={"x": Xfull, "y": Yfull},
+                            fetch_list=[loss])
+                pname = main.global_block().all_parameters[0].name
+                w[pipe] = np.asarray(
+                    scope.find_var(pname).raw().array)
+        np.testing.assert_allclose(w[True], w[False], rtol=1e-5,
+                                   atol=1e-6)
