@@ -54,6 +54,10 @@ from .reader import DataLoader  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from . import unique_name_api as unique_name  # noqa: F401
 from . import install_check  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from . import incubate  # noqa: F401
+from . import contrib  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,2 @@
+"""incubate namespace (reference python/paddle/fluid/incubate)."""
+from . import fleet  # noqa: F401

@@ -1,0 +1,120 @@
+"""Collective fleet mode (reference incubate/fleet/collective/__init__.py
+:45 Collective(Fleet), :182 CollectiveOptimizer, :134 DistributedStrategy).
+
+TPU-native semantics: distributed_optimizer().minimize() runs the normal
+minimize then the collective transpiler (loss-grad 1/nranks scaling +
+per-grad c_allreduce_sum); main_program executes through the mesh engine
+(CompiledProgram.with_data_parallel), whose shard_map lowers the
+collectives to lax.psum over ICI. Multi-host: the same program under
+jax.distributed initialization — no NCCL rings to bootstrap.
+"""
+from __future__ import annotations
+
+from ....compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from ..base.fleet_base import DistributedOptimizer, Fleet
+
+
+class DistributedStrategy:
+    """Knobs (reference DistributedStrategy extends BuildStrategy)."""
+
+    def __init__(self):
+        self.build_strategy = BuildStrategy()
+        self.exec_strategy = ExecutionStrategy()
+        self.nccl_comm_num = 1
+        self.use_local_sgd = False
+        self.local_sgd_k_steps = 1
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.use_amp = False
+        self.amp_loss_scaling = 1.0
+
+
+class Collective(Fleet):
+    def __init__(self):
+        super().__init__("collective")
+        self._main_program = None
+        self._compiled_program = None
+        self._loss = None
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy, self)
+        return self._optimizer
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, model_dir=None):
+        raise NotImplementedError(
+            "Collective mode has no servers; use the transpiler PS mode")
+
+    def run_server(self):
+        raise NotImplementedError(
+            "Collective mode has no servers; use the transpiler PS mode")
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from .... import io
+
+        io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                executor, main_program or self._main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .... import io
+
+        io.save_persistables(executor, dirname,
+                             main_program or self._main_program)
+
+    @property
+    def main_program(self):
+        """The mesh-executable program (reference: fleet.main_program is
+        the compiled data-parallel program)."""
+        return self._compiled_program or self._main_program
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    def __init__(self, optimizer, strategy=None, fleet_instance=None):
+        super().__init__(optimizer, strategy or DistributedStrategy())
+        self._fleet = fleet_instance
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ....parallel.transpiler import (insert_allreduce_ops,
+                                             insert_local_sgd_ops)
+
+        opt = self._optimizer
+        strategy = self._strategy
+        if getattr(strategy, "use_amp", False):
+            from ....contrib import mixed_precision as mp
+
+            opt = mp.decorate(opt)
+        if getattr(strategy, "forward_recompute", False):
+            from ....optimizer import RecomputeOptimizer
+
+            opt = RecomputeOptimizer(opt)
+            opt._set_checkpoints(strategy.recompute_checkpoints)
+        optimize_ops, params_grads = opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+        program = loss.block.program
+        nranks = self._fleet.worker_num() if self._fleet else 1
+        if nranks > 1:
+            insert_allreduce_ops(program, nranks)
+            if getattr(strategy, "use_local_sgd", False):
+                insert_local_sgd_ops(program, nranks,
+                                     strategy.local_sgd_k_steps)
+        if self._fleet is not None:
+            self._fleet._main_program = program
+            self._fleet._loss = loss
+            self._fleet._compiled_program = CompiledProgram(
+                program).with_data_parallel(
+                    loss_name=loss.name,
+                    build_strategy=strategy.build_strategy,
+                    exec_strategy=strategy.exec_strategy)
+        return optimize_ops, params_grads
+
+
+fleet = Collective()

@@ -7,3 +7,4 @@ appends ops to the current program via the ``layers`` API.
 from .lenet import lenet  # noqa: F401
 from .mlp import mlp  # noqa: F401
 from .resnet import resnet, resnet50, resnet_cifar  # noqa: F401
+from .wide_deep import wide_deep  # noqa: F401

@@ -1,0 +1,31 @@
+"""Wide&Deep CTR model (the fleet north-star config 5).
+
+Parity model: /root/reference/python/paddle/fluid/tests/unittests/
+dist_ctr.py (sparse embeddings over hashed ids + wide LR part + deep
+MLP part, sigmoid CTR head).
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def wide_deep(dense_input, sparse_ids, vocab_size, embed_dim=16,
+              hidden_sizes=(64, 32), is_sparse=False):
+    """dense_input [N, Dd]; sparse_ids [N, S] int64 feature ids.
+    Returns (predict [N, 2] softmax, feature list)."""
+    # deep: embeddings + MLP
+    embs = []
+    s = int(sparse_ids.shape[1])
+    for i in range(s):
+        ids = layers.slice(sparse_ids, axes=[1], starts=[i], ends=[i + 1])
+        emb = layers.embedding(
+            ids, size=[vocab_size, embed_dim], is_sparse=is_sparse,
+            param_attr=None)
+        embs.append(layers.reshape(emb, [-1, embed_dim]))
+    deep = layers.concat(embs + [dense_input], axis=1)
+    for h in hidden_sizes:
+        deep = layers.fc(deep, size=h, act="relu")
+    # wide: linear over dense features
+    wide = layers.fc(dense_input, size=8, act=None)
+    merged = layers.concat([wide, deep], axis=1)
+    return layers.fc(merged, size=2, act="softmax")

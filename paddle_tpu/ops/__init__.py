@@ -25,3 +25,4 @@ from . import rnn_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import amp_ops  # noqa: F401
+from . import distributed_ops  # noqa: F401

@@ -1,0 +1,112 @@
+"""PS-mode distributed ops: send / recv / barriers / listen_and_serv.
+
+Parity: /root/reference/paddle/fluid/operators/distributed_ops/
+(send_op.cc, recv_op.cc, listen_and_serv_op.cc:110 RunSyncLoop). The
+reference runs these over gRPC between processes; here local endpoints
+are served by an IN-PROCESS emulated server registry — listen_and_serv
+registers its optimize sub-blocks, send routes a grad to the matching
+sub-block and runs it, recv copies the updated param back. That makes
+transpiled trainer+pserver programs runnable (and testable) in one
+process, the scope the reference covers with test_dist_transpiler plus
+localhost subprocesses. Multi-host TPU jobs use the collective fleet
+(ICI allreduce) instead of PS — see SURVEY §2.5.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op
+
+# endpoint -> dict(executor=, scope=, grad_to_block=, program=)
+_EMULATED_SERVERS: Dict[str, dict] = {}
+
+
+def reset_emulated_servers():
+    _EMULATED_SERVERS.clear()
+
+
+@register_host_op(
+    "listen_and_serv",
+    inputs=[In("X", duplicable=True, dispensable=True, no_grad=True)],
+    outputs=[],
+    attrs={"endpoint": "", "optimize_blocks": [], "grad_to_block_id": [],
+           "sync_mode": True, "Fanin": 1},
+)
+def _listen_and_serv(executor, op, scope):
+    """Register this endpoint's server state (emulation: non-blocking —
+    the reference event-loops; here sends drive the optimize blocks)."""
+    grad_to_block = {}
+    blocks = op.attrs.get("optimize_blocks", [])
+    for entry in op.attrs.get("grad_to_block_id", []):
+        gname, bid = entry.rsplit(":", 1)
+        for b in blocks:
+            if b.idx == int(bid):
+                grad_to_block[gname] = b
+    _EMULATED_SERVERS[op.attrs["endpoint"]] = {
+        "executor": executor,
+        "scope": scope,
+        "grad_to_block": grad_to_block,
+    }
+
+
+@register_host_op(
+    "send",
+    inputs=[In("X", duplicable=True, no_grad=True)],
+    outputs=[Out("Out", duplicable=True, dispensable=True)],
+    attrs={"epmap": [], "sync_mode": True, "table_name": ""},
+)
+def _send(executor, op, scope):
+    eps = op.attrs.get("epmap", [])
+    for name, ep in zip(op.input("X"), eps or [""] * len(op.input("X"))):
+        server = _EMULATED_SERVERS.get(ep)
+        if server is None:
+            raise RuntimeError(
+                "send: no server at %r — run the pserver program "
+                "(listen_and_serv) in this process first, or use the "
+                "collective fleet for multi-host" % ep)
+        val = executor._read_var(scope, name)
+        server["executor"]._write_var(server["scope"], name,
+                                      np.asarray(val))
+        sub = server["grad_to_block"].get(name)
+        if sub is not None:
+            server["executor"].run_block(sub, server["scope"])
+
+
+@register_host_op(
+    "recv",
+    inputs=[In("X", duplicable=True, dispensable=True, no_grad=True)],
+    outputs=[Out("Out", duplicable=True)],
+    attrs={"epmap": [], "table_name": ""},
+)
+def _recv(executor, op, scope):
+    eps = op.attrs.get("epmap", [])
+    for name, ep in zip(op.output("Out"), eps or [""] * len(op.output("Out"))):
+        server = _EMULATED_SERVERS.get(ep)
+        if server is None:
+            raise RuntimeError("recv: no server at %r" % ep)
+        val = server["executor"]._read_var(server["scope"], name)
+        if val is None:
+            raise RuntimeError("recv: server %r has no var %r" % (ep, name))
+        executor._write_var(scope, name, np.asarray(val))
+
+
+@register_host_op(
+    "send_barrier",
+    inputs=[In("X", duplicable=True, dispensable=True, no_grad=True)],
+    outputs=[Out("Out", duplicable=True, dispensable=True)],
+    attrs={"endpoints": [], "trainer_id": 0},
+)
+def _send_barrier(executor, op, scope):
+    pass  # in-process emulation: sends already applied synchronously
+
+
+@register_host_op(
+    "fetch_barrier",
+    inputs=[In("X", duplicable=True, dispensable=True, no_grad=True)],
+    outputs=[Out("Out", duplicable=True, dispensable=True)],
+    attrs={"endpoints": [], "trainer_id": 0},
+)
+def _fetch_barrier(executor, op, scope):
+    pass

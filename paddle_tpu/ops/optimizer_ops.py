@@ -354,15 +354,37 @@ _op(
 
 @register_op(
     "ema_accumulate",
-    inputs=[In("Param", no_grad=True), In("Shadow", no_grad=True)],
+    inputs=[In("Param", no_grad=True), In("Shadow", no_grad=True),
+            In("Decay", dispensable=True, no_grad=True)],
     outputs=[Out("ShadowOut")],
     attrs={"decay": 0.999},
 )
 def _ema_accumulate(ins, attrs):
     """shadow = decay*shadow + (1-decay)*param (reference
-    optimizer.py:3174 ExponentialMovingAverage update block)."""
-    d = attrs.get("decay", 0.999)
+    optimizer.py:3174 ExponentialMovingAverage update block). The
+    optional Decay input (a scalar var) overrides the attr — used by the
+    thres_steps-adaptive schedule."""
+    d = ins.get("Decay")
+    if d is None:
+        d = attrs.get("decay", 0.999)
+    else:
+        d = d.reshape(())
     return {"ShadowOut": d * ins["Shadow"] + (1.0 - d) * ins["Param"]}
+
+
+@register_op(
+    "ema_adaptive_decay",
+    inputs=[In("ThresSteps", no_grad=True)],
+    outputs=[Out("Decay")],
+    attrs={"decay": 0.999},
+)
+def _ema_adaptive_decay(ins, attrs):
+    """Step-adaptive EMA decay min(decay, (1+t)/(10+t)) — the reference
+    thres_steps warm-up schedule (optimizer.py:3174)."""
+    t = ins["ThresSteps"].reshape(()).astype(jnp.float32)
+    d = jnp.minimum(jnp.float32(attrs.get("decay", 0.999)),
+                    (1.0 + t) / (10.0 + t))
+    return {"Decay": d.reshape(1)}
 
 
 @register_op(

@@ -774,28 +774,53 @@ class ExponentialMovingAverage(_ParamSwapper):
     def update(self):
         from .layers import tensor as layers_tensor
 
-        block = framework.default_main_program().global_block()
+        program = framework.default_main_program()
+        block = program.global_block()
         params = [p for p in block.all_parameters
                   if getattr(p, "trainable", True)]
-        self._decay_pow = layers_tensor.create_global_var(
-            name=framework.unique_name.generate(self._name + "ema_decay_pow"),
-            shape=[1], value=1.0, dtype="float32", persistable=True)
-        block.append_op(
-            "scale", inputs={"X": [self._decay_pow]},
-            outputs={"Out": [self._decay_pow]},
-            attrs={"scale": float(self._decay)}, infer_shape=False)
-        for p in params:
-            shadow = layers_tensor.create_global_var(
-                name=self._name + p.name + ".ema", shape=p.shape,
-                dtype=p.dtype, value=0.0, persistable=True)
-            self._shadows[p.name] = shadow
-            # shadow = decay*shadow + (1-decay)*param
-            block.append_op(
-                "ema_accumulate",
-                inputs={"Param": [p], "Shadow": [shadow]},
-                outputs={"ShadowOut": [shadow]},
-                attrs={"decay": self._decay},
-                infer_shape=False)
+        # Optimize role: clone(for_test=True) must prune these, or eval
+        # batches would corrupt the shadows
+        with program._optimized_guard():
+            self._decay_pow = layers_tensor.create_global_var(
+                name=framework.unique_name.generate(
+                    self._name + "ema_decay_pow"),
+                shape=[1], value=1.0, dtype="float32", persistable=True)
+            decay_inputs = {}
+            if self._thres_steps is not None:
+                # reference: step-adaptive decay min(decay, (1+t)/(10+t))
+                decay_var = block.create_var(
+                    name=framework.unique_name.generate("ema_decay"),
+                    shape=(1,), dtype="float32")
+                block.append_op(
+                    "ema_adaptive_decay",
+                    inputs={"ThresSteps": [self._thres_steps]},
+                    outputs={"Decay": [decay_var]},
+                    attrs={"decay": float(self._decay)},
+                    infer_shape=False)
+                decay_inputs = {"Decay": [decay_var]}
+                block.append_op(
+                    "elementwise_mul",
+                    inputs={"X": [self._decay_pow], "Y": [decay_var]},
+                    outputs={"Out": [self._decay_pow]},
+                    attrs={"axis": -1}, infer_shape=False)
+            else:
+                block.append_op(
+                    "scale", inputs={"X": [self._decay_pow]},
+                    outputs={"Out": [self._decay_pow]},
+                    attrs={"scale": float(self._decay)}, infer_shape=False)
+            for p in params:
+                shadow = layers_tensor.create_global_var(
+                    name=self._name + p.name + ".ema", shape=p.shape,
+                    dtype=p.dtype, value=0.0, persistable=True)
+                self._shadows[p.name] = shadow
+                # shadow = decay*shadow + (1-decay)*param
+                block.append_op(
+                    "ema_accumulate",
+                    inputs=dict({"Param": [p], "Shadow": [shadow]},
+                                **decay_inputs),
+                    outputs={"ShadowOut": [shadow]},
+                    attrs={"decay": self._decay},
+                    infer_shape=False)
 
     def _replacement(self, scope, pname):
         sv = scope.find_var(self._shadows[pname].name)
@@ -829,35 +854,38 @@ class ModelAverage(Optimizer, _ParamSwapper):
         self.max_average_window = max_average_window
         self._sums = {}
         self._counts = {}
-        block = framework.default_main_program().global_block()
+        program = framework.default_main_program()
+        block = program.global_block()
         from .layers import tensor as layers_tensor
 
-        upd = layers_tensor.create_global_var(
-            name=framework.unique_name.generate("avg_num_updates"),
-            shape=[1], dtype="float32", value=0.0, persistable=True)
-        block.append_op("increment", inputs={"X": [upd]},
-                        outputs={"Out": [upd]}, attrs={"step": 1.0},
-                        infer_shape=False)
-        for p in block.all_parameters:
-            if not getattr(p, "trainable", True):
-                continue
-            s = layers_tensor.create_global_var(
-                name=p.name + ".avg_sum", shape=p.shape, dtype=p.dtype,
-                value=0.0, persistable=True)
-            c = layers_tensor.create_global_var(
-                name=p.name + ".avg_cnt", shape=[1], dtype="float32",
-                value=0.0, persistable=True)
-            self._sums[p.name] = s
-            self._counts[p.name] = c
-            block.append_op(
-                "model_average_accumulate",
-                inputs={"Param": [p], "Sum": [s], "Count": [c],
-                        "NumUpdates": [upd]},
-                outputs={"SumOut": [s], "CountOut": [c]},
-                attrs={"average_window": self.average_window,
-                       "min_average_window": self.min_average_window,
-                       "max_average_window": self.max_average_window},
-                infer_shape=False)
+        # Optimize role so clone(for_test=True) prunes the accumulation
+        with program._optimized_guard():
+            upd = layers_tensor.create_global_var(
+                name=framework.unique_name.generate("avg_num_updates"),
+                shape=[1], dtype="float32", value=0.0, persistable=True)
+            block.append_op("increment", inputs={"X": [upd]},
+                            outputs={"Out": [upd]}, attrs={"step": 1.0},
+                            infer_shape=False)
+            for p in block.all_parameters:
+                if not getattr(p, "trainable", True):
+                    continue
+                s = layers_tensor.create_global_var(
+                    name=p.name + ".avg_sum", shape=p.shape, dtype=p.dtype,
+                    value=0.0, persistable=True)
+                c = layers_tensor.create_global_var(
+                    name=p.name + ".avg_cnt", shape=[1], dtype="float32",
+                    value=0.0, persistable=True)
+                self._sums[p.name] = s
+                self._counts[p.name] = c
+                block.append_op(
+                    "model_average_accumulate",
+                    inputs={"Param": [p], "Sum": [s], "Count": [c],
+                            "NumUpdates": [upd]},
+                    outputs={"SumOut": [s], "CountOut": [c]},
+                    attrs={"average_window": self.average_window,
+                           "min_average_window": self.min_average_window,
+                           "max_average_window": self.max_average_window},
+                    infer_shape=False)
 
     def _param_names(self):
         return list(self._sums)
@@ -899,27 +927,30 @@ class LookaheadOptimizer:
         block = loss.block
         params = [p for p in block.program.global_block().all_parameters
                   if getattr(p, "trainable", True)]
-        step = layers_tensor.create_global_var(
-            name=framework.unique_name.generate("lookahead_step"),
-            shape=[1], dtype="int32", value=0, persistable=True)
-        block.append_op("increment", inputs={"X": [step]},
-                        outputs={"Out": [step]}, attrs={"step": 1.0},
-                        infer_shape=False)
         startup = framework.default_startup_program().global_block()
-        for p in params:
-            slow = layers_tensor.create_global_var(
-                name=p.name + ".slow", shape=p.shape, dtype=p.dtype,
-                value=0.0, persistable=True)
-            # slow weights start AT the params (reference startup assign)
-            startup.append_op("assign", inputs={"X": [p.name]},
-                              outputs={"Out": [slow.name]},
-                              infer_shape=False)
-            block.append_op(
-                "lookahead_update",
-                inputs={"Param": [p], "Slow": [slow], "Step": [step]},
-                outputs={"ParamOut": [p], "SlowOut": [slow]},
-                attrs={"alpha": self.alpha, "k": self.k},
-                infer_shape=False)
+        # Optimize role so clone(for_test=True) prunes the sync machinery
+        with block.program._optimized_guard():
+            step = layers_tensor.create_global_var(
+                name=framework.unique_name.generate("lookahead_step"),
+                shape=[1], dtype="int32", value=0, persistable=True)
+            block.append_op("increment", inputs={"X": [step]},
+                            outputs={"Out": [step]}, attrs={"step": 1.0},
+                            infer_shape=False)
+            for p in params:
+                slow = layers_tensor.create_global_var(
+                    name=p.name + ".slow", shape=p.shape, dtype=p.dtype,
+                    value=0.0, persistable=True)
+                # slow weights start AT the params (reference startup
+                # assign)
+                startup.append_op("assign", inputs={"X": [p.name]},
+                                  outputs={"Out": [slow.name]},
+                                  infer_shape=False)
+                block.append_op(
+                    "lookahead_update",
+                    inputs={"Param": [p], "Slow": [slow], "Step": [step]},
+                    outputs={"ParamOut": [p], "SlowOut": [slow]},
+                    attrs={"alpha": self.alpha, "k": self.k},
+                    infer_shape=False)
         return result
 
 

@@ -33,7 +33,6 @@ from .mesh_utils import default_mesh
 from .transpiler import insert_allreduce_ops
 
 _dp_cache: Dict = {}
-_transpiled: Set[int] = set()
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
@@ -60,11 +59,10 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     mesh = mesh or default_mesh(len(places) if places else None, axis_name)
     nranks = int(np.prod(list(mesh.shape.values())))
 
-    # one-time collective rewrite (idempotent per program)
-    if program._uid not in _transpiled:
-        if nranks > 1:
-            insert_allreduce_ops(program, nranks)
-        _transpiled.add(program._uid)
+    # collective rewrite (insert_allreduce_ops is itself idempotent
+    # per program — fleet may have transpiled already)
+    if nranks > 1:
+        insert_allreduce_ops(program, nranks)
 
     fetch_names = tuple(f if isinstance(f, str) else f.name
                         for f in fetch_list)

@@ -1,0 +1,56 @@
+"""Row-sharded embedding over a mesh axis.
+
+The TPU-native replacement for the reference's pslib sparse parameter
+server (SURVEY §2.5 pslib row: "sharded embedding + all-to-all"):
+instead of PullSparse/PushSparse RPC against remote tables
+(/root/reference/paddle/fluid/framework/fleet/fleet_wrapper.h:84), the
+table lives row-sharded across the mesh axis; each shard gathers its
+local hits and a psum combines them — one ICI collective per lookup,
+grads flow back through the same path (the psum's transpose). This is
+the standard SPMD formulation XLA optimizes well (the gather/psum pair
+lowers to an all-to-all-class exchange on the ICI torus).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def shard_rows(vocab_size: int, n_shards: int):
+    """Row ranges per shard: contiguous blocks, last shard padded."""
+    per = -(-vocab_size // n_shards)  # ceil
+    return [(s * per, min((s + 1) * per, vocab_size))
+            for s in range(n_shards)]
+
+
+def sharded_embedding_lookup(local_table, ids, axis_name: str):
+    """Lookup under shard_map: `local_table` is THIS shard's [rows_per,
+    D] block (sharded along the mesh axis), `ids` are GLOBAL row ids
+    (replicated or batch-sharded). Returns embeddings for all ids.
+
+    Each shard resolves ids landing in its row range and contributes
+    zeros elsewhere; the psum assembles the full lookup. Differentiable:
+    the psum transposes to an identity on the backward, and the local
+    gather's grad is the row-scatter into this shard's block.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    axis_idx = jax.lax.axis_index(axis_name)
+    rows_per = local_table.shape[0]
+    start = axis_idx * rows_per
+    local_ids = ids - start
+    hit = (local_ids >= 0) & (local_ids < rows_per)
+    safe = jnp.clip(local_ids, 0, rows_per - 1)
+    local = jnp.take(local_table, safe, axis=0)
+    contrib = jnp.where(hit[..., None], local, 0.0)
+    return jax.lax.psum(contrib, axis_name)
+
+
+def build_sharded_table(weight: np.ndarray, n_shards: int):
+    """Split a dense [V, D] table into n row-shard blocks (pad the last
+    so every shard is the same shape — SPMD needs uniformity)."""
+    v, d = weight.shape
+    per = -(-v // n_shards)
+    padded = np.zeros((per * n_shards, d), dtype=weight.dtype)
+    padded[:v] = weight
+    return padded.reshape(n_shards, per, d)

@@ -30,7 +30,12 @@ def insert_allreduce_ops(program, nranks: int, ring_id: int = 0,
                          scale_loss: bool = True):
     """Rewrite a training program for data parallelism: scale the loss
     grad by 1/nranks and allreduce every grad consumed by an optimizer op.
-    Returns the set of grad var names allreduced."""
+    Returns the set of grad var names allreduced. Idempotent: a program
+    is rewritten at most once (fleet may transpile before the mesh
+    engine sees the program)."""
+    if getattr(program, "_grads_allreduced", False):
+        return set()
+    program._grads_allreduced = True
     block = program.global_block()
     if scale_loss:
         for op in block.ops:

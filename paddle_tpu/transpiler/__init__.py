@@ -1,0 +1,36 @@
+"""Transpilers (reference python/paddle/fluid/transpiler/)."""
+from ..parallel.transpiler import (  # noqa: F401
+    insert_allreduce_ops,
+    insert_local_sgd_ops,
+)
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    slice_variable,
+)
+
+
+class HashName:
+    """RoundRobin/Hash pserver dispatchers (reference ps_dispatcher.py)."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+
+    def dispatch(self, varlist):
+        return [self._eps[hash(v.name) % len(self._eps)] for v in varlist]
+
+
+class RoundRobin:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self._eps[self._i % len(self._eps)])
+            self._i += 1
+        return out
+
+    def reset(self):
+        self._i = 0

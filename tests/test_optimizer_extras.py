@@ -29,30 +29,6 @@ def _mlp_program(lr=0.1, recompute=False, depth=4):
     return main, startup, loss
 
 
-def _train(main, startup, steps=10, seed=0):
-    rng = np.random.RandomState(seed)
-    W = rng.randn(16, 1).astype("float32")
-    scope = fluid.Scope()
-    losses = []
-    with fluid.scope_guard(scope):
-        exe = fluid.Executor(fluid.CPUPlace())
-        exe.run(startup)
-        for i in range(steps):
-            xb = rng.randn(32, 16).astype("float32")
-            (l,) = exe.run(main, feed={"x": xb, "y": xb @ W},
-                           fetch_list=[loss_var_of(main)])
-            losses.append(float(np.asarray(l).ravel()[0]))
-    return losses
-
-
-def loss_var_of(main):
-    # the mean op's output is the loss
-    for op in reversed(main.global_block().ops):
-        if op.type == "mean" and not op._role:
-            return op.output("Out")[0]
-    raise AssertionError("no loss found")
-
-
 class TestRecompute:
     def test_program_contains_recomputed_segment(self):
         main, startup, loss = _mlp_program(recompute=True)
@@ -255,3 +231,66 @@ class TestPipeline:
                     scope.find_var(pname).raw().array)
         np.testing.assert_allclose(w[True], w[False], rtol=1e-5,
                                    atol=1e-6)
+
+
+class TestStateOpsPrunedForTest:
+    def test_clone_for_test_drops_ema_lookahead_avg_ops(self):
+        """EMA/ModelAverage/Lookahead machinery carries the Optimize
+        role, so evaluation clones must not mutate training state."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[8, 4], dtype="float32")
+            y = fluid.data(name="y", shape=[8, 1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            opt = fluid.optimizer.LookaheadOptimizer(
+                fluid.optimizer.SGD(0.1), alpha=0.5, k=2)
+            opt.minimize(loss)
+            ema = fluid.optimizer.ExponentialMovingAverage(0.9)
+            ema.update()
+            fluid.optimizer.ModelAverage(0.15)
+        test_types = [op.type for op in
+                      main.clone(for_test=True).global_block().ops]
+        for t in ("increment", "lookahead_update", "ema_accumulate",
+                  "model_average_accumulate", "sgd"):
+            assert t not in test_types, t
+
+    def test_ema_thres_steps_adaptive_decay(self):
+        """With thres_steps, early decay follows (1+t)/(10+t) so the
+        shadow warms up from the params instead of zero-bias."""
+        from paddle_tpu.layers import tensor as layers_tensor
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[8, 4], dtype="float32")
+            y = fluid.data(name="y", shape=[8, 1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.0).minimize(loss)  # params frozen
+            step = layers_tensor.create_global_var(
+                name="ema_t", shape=[1], value=0, dtype="int64",
+                persistable=True)
+            main.global_block().append_op(
+                "increment", inputs={"X": [step]},
+                outputs={"Out": [step]}, attrs={"step": 1.0},
+                infer_shape=False)
+            ema = fluid.optimizer.ExponentialMovingAverage(
+                0.999, thres_steps=step)
+            ema.update()
+        rng = np.random.RandomState(0)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for i in range(3):
+                exe.run(main, feed={
+                    "x": rng.rand(8, 4).astype("float32"),
+                    "y": np.ones((8, 1), "float32")}, fetch_list=[loss])
+            w_name = main.global_block().all_parameters[0].name
+            w = np.asarray(scope.find_var(w_name).raw().array)
+            with ema.apply(exe):
+                w_ema = np.asarray(scope.find_var(w_name).raw().array)
+        # frozen params + bias-corrected warm-up EMA ~= params
+        np.testing.assert_allclose(w_ema, w, rtol=1e-4, atol=1e-5)
