@@ -55,6 +55,12 @@ from .data_feeder import DataFeeder  # noqa: F401
 from . import unique_name_api as unique_name  # noqa: F401
 from . import install_check  # noqa: F401
 from . import transpiler  # noqa: F401
+# NOTE: `paddle_tpu.dataset` is the readers package (paddle.dataset in
+# the reference); the fluid Dataset FACTORY surface lives at top level
+# (fluid.DatasetFactory) and as `dataset_module`.
+from . import dataset  # noqa: F401
+from . import dataset_module  # noqa: F401
+from .dataset_module import DatasetFactory  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from . import incubate  # noqa: F401
 from . import contrib  # noqa: F401

@@ -1,0 +1,128 @@
+"""ctypes binding for the native data-feed pipeline (csrc/data_feed.cc).
+
+Builds the shared library on first use (g++, baked into the image) and
+caches it next to the source; falls back cleanly (load() returns None)
+when no toolchain is available so the Python feed path takes over.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SRC = os.path.join(_CSRC, "data_feed.cc")
+_SO = os.path.join(_CSRC, "libptfeed.so")
+
+
+def load():
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not os.path.exists(_SRC):
+                return None
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     _SRC, "-o", _SO, "-pthread"],
+                    check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.ptfeed_create.restype = ctypes.c_void_p
+        lib.ptfeed_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
+        lib.ptfeed_next.restype = ctypes.c_int64
+        lib.ptfeed_next.argtypes = [ctypes.c_void_p]
+        lib.ptfeed_slot_size.restype = ctypes.c_int64
+        lib.ptfeed_slot_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptfeed_slot_fvals.restype = ctypes.POINTER(ctypes.c_float)
+        lib.ptfeed_slot_fvals.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptfeed_slot_ivals.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.ptfeed_slot_ivals.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptfeed_slot_offsets.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.ptfeed_slot_offsets.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptfeed_slot_num_offsets.restype = ctypes.c_int64
+        lib.ptfeed_slot_num_offsets.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_int]
+        lib.ptfeed_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeMultiSlotFeed:
+    """Iterates (slot arrays, slot lod offsets) batches parsed by the
+    C++ reader threads. slot_types: 'float' | 'int64' per slot."""
+
+    def __init__(self, filelist, slot_types, batch_size, num_threads=2,
+                 queue_capacity=16):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native feed library unavailable")
+        self._lib = lib
+        self._types = [0 if t in ("float", "float32") else 1
+                       for t in slot_types]
+        files = (ctypes.c_char_p * len(filelist))(
+            *[f.encode() for f in filelist])
+        types = (ctypes.c_int * len(self._types))(*self._types)
+        self._h = lib.ptfeed_create(files, len(filelist), types,
+                                    len(self._types), batch_size,
+                                    num_threads, queue_capacity)
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        n = self._lib.ptfeed_next(self._h)
+        if n == 0:
+            raise StopIteration
+        slots = []
+        for s in range(len(self._types)):
+            size = self._lib.ptfeed_slot_size(self._h, s)
+            noff = self._lib.ptfeed_slot_num_offsets(self._h, s)
+            offs = np.ctypeslib.as_array(
+                self._lib.ptfeed_slot_offsets(self._h, s),
+                shape=(noff,)).copy()
+            if self._types[s] == 0:
+                vals = np.ctypeslib.as_array(
+                    self._lib.ptfeed_slot_fvals(self._h, s),
+                    shape=(size,)).copy()
+            else:
+                vals = np.ctypeslib.as_array(
+                    self._lib.ptfeed_slot_ivals(self._h, s),
+                    shape=(size,)).copy()
+            slots.append((vals, offs))
+        return slots
+
+    def close(self):
+        if not self._closed and self._h:
+            self._lib.ptfeed_destroy(self._h)
+            self._closed = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

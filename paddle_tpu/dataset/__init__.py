@@ -1,0 +1,7 @@
+"""Dataset readers (reference python/paddle/dataset/).
+
+Offline environment: readers serve deterministic synthetic stand-ins
+with the reference sample contracts unless real data files are present
+(see each module's docstring)."""
+from . import mnist  # noqa: F401
+from . import uci_housing  # noqa: F401

@@ -1,0 +1,219 @@
+"""fluid.dataset — Dataset factory for file-driven training.
+
+Parity: /root/reference/python/paddle/fluid/dataset.py (:22
+DatasetFactory, :292 InMemoryDataset, :672 QueueDataset) over the C++
+DatasetImpl/DataFeed stack (framework/data_set.h:43). Here the record
+path is the native csrc/data_feed.cc pipeline (reader threads parsing
+multi-slot text through a blocking queue, bound via ctypes), with a
+NumPy fallback when no toolchain is available.
+"""
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetFactory:
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError("unknown dataset class %r" % datafeed_class)
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._use_vars = []
+        self._filelist: List[str] = []
+        self._pipe_command = "cat"
+        self._thread_num = 1
+        self._use_native = True
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, pipe_command):
+        self._pipe_command = pipe_command
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        pass  # no HDFS in this environment
+
+    # -- feeding -----------------------------------------------------------
+    def _slot_types(self):
+        types = []
+        for v in self._use_vars:
+            name = str(v.dtype)
+            types.append("int64" if "int" in name else "float")
+        return types
+
+    def _slot_shapes(self):
+        return [tuple(int(s) for s in (v.shape or ())[1:])
+                for v in self._use_vars]
+
+    def _record_batches(self, filelist):
+        """Yield feed dicts batch by batch via the native pipeline."""
+        types = self._slot_types()
+        try:
+            from .core.native_feed import NativeMultiSlotFeed
+
+            feed = NativeMultiSlotFeed(filelist, types, self._batch_size,
+                                       num_threads=self._thread_num)
+            native = True
+        except Exception:
+            feed = _python_multislot_feed(filelist, types, self._batch_size)
+            native = False
+        shapes = self._slot_shapes()
+        for slots in feed:
+            out = {}
+            for v, (vals, offs), shp in zip(self._use_vars, slots, shapes):
+                n = len(offs) - 1
+                per = int(np.prod(shp)) if shp else 1
+                if len(vals) == n * per and per > 0:
+                    out[v.name] = vals.reshape((n,) + (shp or (1,)))
+                else:
+                    from .core.tensor import LoDTensor
+
+                    t = LoDTensor(vals.reshape(-1, 1))
+                    t.set_lod([list(offs)])
+                    out[v.name] = t
+            yield out
+        if native:
+            feed.close()
+
+    def _iter_batches(self):
+        yield from self._record_batches(self._filelist)
+
+
+def _python_multislot_feed(filelist, types, batch_size):
+    """NumPy fallback parser, same record format as csrc/data_feed.cc."""
+    def gen():
+        batch_vals = [[] for _ in types]
+        batch_offs = [[0] for _ in types]
+        n = 0
+        for path in filelist:
+            with open(path) as f:
+                for line in f:
+                    toks = line.split()
+                    if not toks:
+                        continue
+                    i = 0
+                    ok = True
+                    row = []
+                    for t in types:
+                        cnt = int(toks[i])
+                        i += 1
+                        vals = toks[i:i + cnt]
+                        i += cnt
+                        if len(vals) != cnt:
+                            ok = False
+                            break
+                        row.append(vals)
+                    if not ok:
+                        continue
+                    for s, vals in enumerate(row):
+                        conv = (np.int64 if types[s] == "int64"
+                                else np.float32)
+                        batch_vals[s].extend(conv(v) for v in vals)
+                        batch_offs[s].append(len(batch_vals[s]))
+                    n += 1
+                    if n == batch_size:
+                        yield [(np.asarray(batch_vals[s],
+                                           dtype=np.int64 if types[s] ==
+                                           "int64" else np.float32),
+                                np.asarray(batch_offs[s]))
+                               for s in range(len(types))]
+                        batch_vals = [[] for _ in types]
+                        batch_offs = [[0] for _ in types]
+                        n = 0
+        if n:
+            yield [(np.asarray(batch_vals[s],
+                               dtype=np.int64 if types[s] == "int64"
+                               else np.float32),
+                    np.asarray(batch_offs[s]))
+                   for s in range(len(types))]
+
+    return gen()
+
+
+class InMemoryDataset(DatasetBase):
+    """(reference dataset.py:292) load files into memory once; shuffle
+    locally (global shuffle degenerates to local on one host — the
+    reference shuffles across nodes via FleetWrapper RPC)."""
+
+    def __init__(self):
+        super().__init__()
+        self._records: Optional[List[dict]] = None
+
+    def load_into_memory(self):
+        self._records = []
+        # keep per-RECORD granularity for shuffling: batch size 1 here,
+        # re-batched at iteration
+        saved_bs = self._batch_size
+        self._batch_size = 1
+        for rec in self._record_batches(self._filelist):
+            self._records.append(rec)
+        self._batch_size = saved_bs
+
+    def local_shuffle(self):
+        if self._records is None:
+            raise RuntimeError("load_into_memory first")
+        random.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None):
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._records = None
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records or [])
+
+    def _iter_batches(self):
+        if self._records is None:
+            yield from super()._iter_batches()
+            return
+        from .core.tensor import LoDTensor
+
+        for i in range(0, len(self._records), self._batch_size):
+            chunk = self._records[i:i + self._batch_size]
+            if len(chunk) < self._batch_size:
+                break  # drop remainder (static shapes)
+            merged = {}
+            for v in self._use_vars:
+                parts = [c[v.name] for c in chunk]
+                # a slot is LoD if ANY record parsed ragged — dense
+                # records in the same slot get a trivial 1-row lod
+                if any(isinstance(p, LoDTensor) for p in parts):
+                    arrays = [np.asarray(p.array if isinstance(
+                        p, LoDTensor) else p).reshape(-1, 1)
+                        for p in parts]
+                    vals = np.concatenate(arrays, axis=0)
+                    offs = [0]
+                    for a in arrays:
+                        offs.append(offs[-1] + a.shape[0])
+                    t = LoDTensor(vals)
+                    t.set_lod([offs])
+                    merged[v.name] = t
+                else:
+                    merged[v.name] = np.concatenate(parts, axis=0)
+            yield merged
+
+
+class QueueDataset(DatasetBase):
+    """(reference dataset.py:672) streaming: records flow straight from
+    the native reader threads, never materialized."""

@@ -1,16 +1,18 @@
 """DataLoader / PyReader.
 
 Parity: /root/reference/python/paddle/fluid/reader.py (DataLoader :179,
-GeneratorLoader :791, PyReader :1064). The reference pipeline is python
-generator -> LoDTensorBlockingQueue -> read ops -> BufferedReader GPU
-prefetch; here the queue + double-buffer prefetch stage is the native
-C++ pipeline in csrc/ (ctypes-bound) when built, else a Python
-thread-backed queue — both overlap host batching with device steps, which
-is the TPU equivalent of buffered_reader.cc's async staging.
+multiprocess DygraphGeneratorLoader :469, GeneratorLoader :791, PyReader
+:1064). Generator batches flow through a bounded queue filled by a
+producer thread (or worker PROCESSES with use_multiprocess=True), and
+``use_double_buffer`` stages the NEXT batch onto the device while the
+current step runs — the TPU equivalent of buffered_reader.cc's async
+GPU prefetch. File-driven datasets (fluid.dataset) ride the native C++
+parse pipeline in csrc/data_feed.cc instead.
 """
 from __future__ import annotations
 
 import itertools
+import multiprocessing
 import queue
 import threading
 from typing import Callable, Iterable, List, Optional
@@ -22,7 +24,7 @@ __all__ = ["DataLoader", "PyReader"]
 
 class _GeneratorLoader:
     def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
-                 iterable=True, return_list=False):
+                 iterable=True, return_list=False, use_multiprocess=False):
         self._feed_list = feed_list or []
         self._capacity = capacity
         self._iterable = iterable
@@ -30,6 +32,8 @@ class _GeneratorLoader:
         self._batch_reader = None
         self._places = None
         self._use_double_buffer = use_double_buffer
+        self._use_multiprocess = use_multiprocess
+        self._yields_feed_dicts = False
 
     # -- wiring -----------------------------------------------------------
     def set_sample_generator(self, reader, batch_size, drop_last=True,
@@ -63,8 +67,7 @@ class _GeneratorLoader:
         return self
 
     # -- iteration --------------------------------------------------------
-    def __iter__(self):
-        names = [v.name for v in self._feed_list]
+    def _thread_batches(self):
         q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
         stop = object()
 
@@ -81,6 +84,96 @@ class _GeneratorLoader:
             arrays = q.get()
             if arrays is stop:
                 break
+            yield arrays
+
+    def _process_batches(self):
+        """Worker-process producer (reference DygraphGeneratorLoader
+        :469): the generator runs in a child process; batches cross a
+        multiprocessing queue, freeing this process's GIL for the
+        device loop.
+
+        Fork caveat (as in the reference): start iterating BEFORE heavy
+        device work in the parent — forking after the accelerator
+        runtime spins up its threads risks deadlock in the child.
+        Producer errors propagate: the child ships the exception text
+        and the parent re-raises."""
+        ctx = multiprocessing.get_context("fork")
+        q = ctx.Queue(maxsize=self._capacity)
+        reader = self._batch_reader
+
+        def producer(q, reader):
+            try:
+                for arrays in reader():
+                    q.put(("batch", [np.asarray(a) for a in arrays]))
+                q.put(("end", None))
+            except BaseException as e:  # ship the failure to the parent
+                try:
+                    q.put(("error", "%s: %s" % (type(e).__name__, e)))
+                except Exception:
+                    pass
+
+        proc = ctx.Process(target=producer, args=(q, reader), daemon=True)
+        proc.start()
+        finished = False
+        try:
+            while True:
+                kind, payload = q.get()
+                if kind == "end":
+                    finished = True
+                    break
+                if kind == "error":
+                    finished = True
+                    raise RuntimeError(
+                        "DataLoader worker process failed: %s" % payload)
+                yield payload
+        finally:
+            if finished:
+                proc.join(timeout=5)
+            if proc.is_alive():
+                # early exit: the producer may be blocked on a full
+                # queue — don't wait for it
+                proc.terminate()
+                proc.join(timeout=1)
+
+    @staticmethod
+    def _stageable(a):
+        """Only stage dtypes the device keeps bit-exact: without x64,
+        jax truncates (u)int64 to 32 bits — embedding ids would corrupt
+        — and LoD tensors carry host metadata."""
+        if hasattr(a, "lod"):
+            return False
+        arr = np.asarray(a)
+        return arr.dtype.kind == "f" and arr.dtype.itemsize <= 4
+
+    def _device_prefetch(self, batches):
+        """Double-buffer: stage batch k+1 onto the device while batch k
+        is consumed (buffered_reader.cc semantics; jax transfers are
+        async so device_put returns immediately)."""
+        import jax
+
+        prev = None
+        for arrays in batches:
+            staged = [jax.device_put(np.asarray(a))
+                      if self._stageable(a) else a for a in arrays]
+            if prev is not None:
+                yield prev
+            prev = staged
+        if prev is not None:
+            yield prev
+
+    def __iter__(self):
+        names = [v.name for v in self._feed_list]
+        batches = (self._process_batches() if self._use_multiprocess
+                   else self._thread_batches())
+        if self._yields_feed_dicts:
+            # dataset-backed loader: batches are already feed dicts
+            yield from batches
+            return
+        # return_list pulls results back to numpy — staging to device
+        # first would just add a blocking round-trip
+        if self._use_double_buffer and not self._return_list:
+            batches = self._device_prefetch(batches)
+        for arrays in batches:
             if self._return_list:
                 yield [np.asarray(a) for a in arrays]
             else:
@@ -103,12 +196,13 @@ class DataLoader:
                        iterable=True, return_list=False,
                        use_multiprocess=False, drop_last=True):
         return _GeneratorLoader(feed_list, capacity, use_double_buffer,
-                                iterable, return_list)
+                                iterable, return_list, use_multiprocess)
 
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
         loader = _GeneratorLoader(iterable=True, return_list=False)
         loader.set_batch_generator(lambda: dataset._iter_batches())
+        loader._yields_feed_dicts = True
         return loader
 
 

@@ -1,0 +1,210 @@
+"""Data stack tests: native C++ feed, Dataset factory, DataLoader
+(thread + multiprocess + device prefetch), dataset readers,
+train_from_dataset.
+
+Contracts: reference data_feed.cc MultiSlotDataFeed record format,
+dataset.py InMemoryDataset/QueueDataset, reader.py DataLoader."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _write_multislot(path, n_lines, seed=0, dense=4):
+    """Lines: dense slot (count=dense floats) + label slot (1 int)."""
+    rng = np.random.RandomState(seed)
+    rows = []
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            vals = rng.rand(dense).round(4)
+            label = rng.randint(0, 10)
+            rows.append((vals, label))
+            f.write("%d %s 1 %d\n" % (
+                dense, " ".join("%g" % v for v in vals), label))
+    return rows
+
+
+class TestNativeFeed:
+    def test_parses_batches(self):
+        from paddle_tpu.core.native_feed import NativeMultiSlotFeed, load
+
+        if load() is None:
+            pytest.skip("no native toolchain")
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "part-0")
+            rows = _write_multislot(p, 10)
+            feed = NativeMultiSlotFeed([p], ["float", "int64"],
+                                       batch_size=5, num_threads=1)
+            batches = list(feed)
+            feed.close()
+        assert len(batches) == 2
+        total_labels = []
+        for slots in batches:
+            fvals, foffs = slots[0]
+            ivals, ioffs = slots[1]
+            assert len(foffs) == 6 and len(ioffs) == 6
+            assert len(fvals) == 20  # 5 rows x 4 dense vals
+            total_labels.extend(ivals.tolist())
+        assert sorted(total_labels) == sorted(r[1] for r in rows)
+
+    def test_matches_python_fallback(self):
+        from paddle_tpu.core.native_feed import NativeMultiSlotFeed, load
+        from paddle_tpu.dataset_module import _python_multislot_feed
+
+        if load() is None:
+            pytest.skip("no native toolchain")
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "part-0")
+            _write_multislot(p, 8, seed=3)
+            nat = list(NativeMultiSlotFeed([p], ["float", "int64"], 4,
+                                           num_threads=1))
+            py = list(_python_multislot_feed([p], ["float", "int64"], 4))
+        assert len(nat) == len(py)
+        for nb, pb in zip(nat, py):
+            for (nv, no), (pv, po) in zip(nb, pb):
+                np.testing.assert_allclose(nv, pv, rtol=1e-6)
+                np.testing.assert_array_equal(no, po)
+
+
+class TestDatasetFactory:
+    def _dataset(self, cls, d, batch=4):
+        p = os.path.join(d, "part-0")
+        _write_multislot(p, 12)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[batch, 4], dtype="float32")
+            y = fluid.data(name="y", shape=[batch, 1], dtype="int64")
+        ds = fluid.DatasetFactory().create_dataset(cls)
+        ds.set_batch_size(batch)
+        ds.set_use_var([x, y])
+        ds.set_filelist([p])
+        return ds
+
+    def test_queue_dataset_batches(self):
+        with tempfile.TemporaryDirectory() as d:
+            ds = self._dataset("QueueDataset", d)
+            batches = list(ds._iter_batches())
+        assert len(batches) == 3
+        for b in batches:
+            assert b["x"].shape == (4, 4)
+            assert b["y"].shape == (4, 1)
+
+    def test_inmemory_shuffle_keeps_records(self):
+        with tempfile.TemporaryDirectory() as d:
+            ds = self._dataset("InMemoryDataset", d)
+            ds.load_into_memory()
+            before = sorted(
+                float(np.asarray(r["x"]).ravel()[0]) for r in ds._records)
+            ds.local_shuffle()
+            after = sorted(
+                float(np.asarray(r["x"]).ravel()[0]) for r in ds._records)
+            assert before == after
+            batches = list(ds._iter_batches())
+        assert len(batches) == 3
+
+    def test_train_from_dataset(self):
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "part-0")
+            _write_multislot(p, 64, seed=1)
+            B = 8
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data(name="x", shape=[B, 4], dtype="float32")
+                y = fluid.data(name="y", shape=[B, 1], dtype="int64")
+                pred = fluid.layers.fc(x, 10, act="softmax")
+                loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+            ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+            ds.set_batch_size(B)
+            ds.set_use_var([x, y])
+            ds.set_filelist([p])
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                w = main.global_block().all_parameters[0].name
+                before = np.asarray(scope.find_var(w).raw().array).copy()
+                exe.train_from_dataset(main, ds, fetch_list=[loss])
+                after = np.asarray(scope.find_var(w).raw().array)
+            assert not np.allclose(before, after)  # trained
+
+
+class TestDataLoader:
+    def _check_loader(self, use_multiprocess):
+        B = 4
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[B, 3], dtype="float32")
+        loader = fluid.DataLoader.from_generator(
+            feed_list=[x], capacity=4, use_multiprocess=use_multiprocess)
+
+        def gen():
+            rng = np.random.RandomState(0)
+            for i in range(6):
+                yield [rng.rand(B, 3).astype("float32")]
+
+        loader.set_batch_generator(gen)
+        seen = list(loader)
+        assert len(seen) == 6
+        ref = np.random.RandomState(0)
+        for batch in seen:
+            np.testing.assert_allclose(np.asarray(batch["x"]),
+                                       ref.rand(B, 3).astype("float32"),
+                                       rtol=1e-6)
+
+    def test_thread_loader_with_prefetch(self):
+        self._check_loader(use_multiprocess=False)
+
+    def test_multiprocess_loader(self):
+        self._check_loader(use_multiprocess=True)
+
+    def test_loader_feeds_executor(self):
+        B = 8
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[B, 4], dtype="float32")
+            y = fluid.data(name="y", shape=[B, 1], dtype="float32")
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(x, 1), y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        loader = fluid.DataLoader.from_generator(feed_list=[x, y],
+                                                 capacity=4)
+        rng = np.random.RandomState(0)
+        W = rng.randn(4, 1).astype("float32")
+
+        def gen():
+            r = np.random.RandomState(1)
+            for i in range(20):
+                xb = r.randn(B, 4).astype("float32")
+                yield [xb, xb @ W]
+
+        loader.set_batch_generator(gen)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = []
+            for feed in loader:
+                (l,) = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+        assert losses[-1] < 0.5 * losses[0]
+
+
+class TestDatasetReaders:
+    def test_mnist_contract(self):
+        from paddle_tpu.dataset import mnist
+
+        it = mnist.train()()
+        img, label = next(it)
+        assert img.shape == (784,) and img.dtype == np.float32
+        assert -1.0 <= float(img.min()) and float(img.max()) <= 1.0
+        assert 0 <= label < 10
+
+    def test_uci_housing_contract(self):
+        from paddle_tpu.dataset import uci_housing
+
+        x, y = next(uci_housing.train()())
+        assert x.shape == (13,) and y.shape == (1,)
