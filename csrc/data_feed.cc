@@ -97,24 +97,33 @@ struct Feed {
 
 bool ParseLine(const char* p, const char* end, int num_slots,
                const std::vector<int>& types, Batch* batch) {
+  // Parse into temporaries and commit only on success: a malformed
+  // line must not leave stray values in the shared batch (they would
+  // misalign every later record's offsets).
+  std::vector<std::vector<float>> ftmp(num_slots);
+  std::vector<std::vector<int64_t>> itmp(num_slots);
   for (int s = 0; s < num_slots; ++s) {
     char* q = nullptr;
     long cnt = std::strtol(p, &q, 10);
     if (q == p) return false;
     p = q;
-    SlotBatch& sb = batch->slots[s];
     for (long i = 0; i < cnt; ++i) {
       if (types[s] == 0) {
         float v = std::strtof(p, &q);
         if (q == p) return false;
-        sb.fvals.push_back(v);
+        ftmp[s].push_back(v);
       } else {
         long long v = std::strtoll(p, &q, 10);
         if (q == p) return false;
-        sb.ivals.push_back(v);
+        itmp[s].push_back(v);
       }
       p = q;
     }
+  }
+  for (int s = 0; s < num_slots; ++s) {
+    SlotBatch& sb = batch->slots[s];
+    sb.fvals.insert(sb.fvals.end(), ftmp[s].begin(), ftmp[s].end());
+    sb.ivals.insert(sb.ivals.end(), itmp[s].begin(), itmp[s].end());
     sb.offsets.push_back(types[s] == 0 ? (int64_t)sb.fvals.size()
                                        : (int64_t)sb.ivals.size());
   }

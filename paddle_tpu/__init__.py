@@ -32,6 +32,8 @@ from .core import (  # noqa: F401
     scope_guard,
 )
 from .core import dtypes as _dtypes  # noqa: F401
+from .core import enforce  # noqa: F401
+from .core.flags import get_flags, set_flags  # noqa: F401
 from .executor import Executor  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from . import initializer  # noqa: F401
@@ -64,6 +66,7 @@ from .dataset_module import DatasetFactory  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from . import incubate  # noqa: F401
 from . import contrib  # noqa: F401
+from . import inference  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -27,3 +27,5 @@ from .registry import (  # noqa: F401
 )
 from .executor_core import CoreExecutor  # noqa: F401
 from . import dtypes  # noqa: F401
+from . import enforce  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401

@@ -1,0 +1,70 @@
+"""Typed errors + enforce helpers.
+
+Parity: /root/reference/paddle/fluid/platform/enforce.h:261
+(PADDLE_ENFORCE / EnforceNotMet) and errors.h's typed error taxonomy.
+Framework raise sites funnel through these so users get op/var context
+instead of bare KeyErrors from deep in the registry.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet",
+    "InvalidArgumentError",
+    "NotFoundError",
+    "OutOfRangeError",
+    "AlreadyExistsError",
+    "PermissionDeniedError",
+    "UnimplementedError",
+    "PreconditionNotMetError",
+    "ExecutionTimeoutError",
+    "enforce",
+    "enforce_not_none",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base framework error (reference EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    def __str__(self):  # KeyError quotes its arg; keep it readable
+        return RuntimeError.__str__(self)
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+def enforce(cond, message, error_cls=EnforceNotMet):
+    if not cond:
+        raise error_cls(message)
+
+
+def enforce_not_none(value, message, error_cls=NotFoundError):
+    if value is None:
+        raise error_cls(message)
+    return value

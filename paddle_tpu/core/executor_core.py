@@ -119,6 +119,7 @@ class CoreExecutor:
 
         if getattr(info, "host_fn", None) is not None:
             info.host_fn(self, op, scope)
+            self._maybe_check_nan_inf(op, scope)
             return
 
         ins = {}
@@ -178,6 +179,34 @@ class CoreExecutor:
             for i, (n, v) in enumerate(zip(names, vals)):
                 lod = out_lods.get((slot.name, i))
                 self._write_var(scope, n, v, lod=lod)
+        self._maybe_check_nan_inf(op, scope)
+
+    def _maybe_check_nan_inf(self, op, scope):
+        """FLAGS_check_nan_inf (reference operator.cc:1032): validate
+        every float output of the op just executed."""
+        from .flags import flag
+
+        if not flag("check_nan_inf"):
+            return
+        import jax.numpy as jnp
+
+        from .enforce import EnforceNotMet
+        from .tensor import LoDTensor
+
+        for n in op.output_arg_names:
+            var = scope.find_var(n)
+            if var is None or not var.is_initialized():
+                continue
+            h = var.raw()
+            if not isinstance(h, LoDTensor) or h.array is None:
+                continue
+            arr = h.array
+            if hasattr(arr, "dtype") and jnp.issubdtype(arr.dtype,
+                                                        jnp.floating):
+                if not bool(jnp.all(jnp.isfinite(arr))):
+                    raise EnforceNotMet(
+                        "Operator %r output %r contains Inf/Nan "
+                        "(FLAGS_check_nan_inf)" % (op.type, n))
 
     def _infer_out_lods(self, info, op, in_lods, attrs):
         out_lods: Dict = {}

@@ -127,7 +127,15 @@ class OpInfoMap:
         _ensure_ops_loaded()
         info = self._map.get(type)
         if info is None:
-            raise KeyError("operator %r is not registered" % type)
+            from .enforce import NotFoundError
+            import difflib
+
+            close = difflib.get_close_matches(type, self._map.keys(), n=3)
+            hint = ("; closest registered ops: %s" % ", ".join(close)
+                    if close else "")
+            raise NotFoundError(
+                "Operator %r is not registered (%d ops registered%s)"
+                % (type, len(self._map), hint))
         return info
 
     def has(self, type: str) -> bool:

@@ -83,7 +83,11 @@ class DatasetBase:
             for v, (vals, offs), shp in zip(self._use_vars, slots, shapes):
                 n = len(offs) - 1
                 per = int(np.prod(shp)) if shp else 1
-                if len(vals) == n * per and per > 0:
+                # dense only when EVERY record has exactly `per` values
+                # (a total that merely sums to n*per may still be ragged)
+                uniform = per > 0 and bool(
+                    np.all(np.diff(np.asarray(offs)) == per))
+                if uniform:
                     out[v.name] = vals.reshape((n,) + (shp or (1,)))
                 else:
                     from .core.tensor import LoDTensor
@@ -112,19 +116,18 @@ def _python_multislot_feed(filelist, types, batch_size):
                     if not toks:
                         continue
                     i = 0
-                    ok = True
                     row = []
-                    for t in types:
-                        cnt = int(toks[i])
-                        i += 1
-                        vals = toks[i:i + cnt]
-                        i += cnt
-                        if len(vals) != cnt:
-                            ok = False
-                            break
-                        row.append(vals)
-                    if not ok:
-                        continue
+                    try:
+                        for t in types:
+                            cnt = int(toks[i])
+                            i += 1
+                            vals = toks[i:i + cnt]
+                            i += cnt
+                            if len(vals) != cnt:
+                                raise ValueError("short record")
+                            row.append(vals)
+                    except (ValueError, IndexError):
+                        continue  # malformed line: skip, like the native parser
                     for s, vals in enumerate(row):
                         conv = (np.int64 if types[s] == "int64"
                                 else np.float32)

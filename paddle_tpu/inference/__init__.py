@@ -1,0 +1,133 @@
+"""Inference API: AnalysisConfig + Predictor.
+
+Parity: /root/reference/paddle/fluid/inference/api/
+(analysis_predictor.cc:485 AnalysisPredictor — load model, optimize,
+serve Run(); paddle_analysis_config.h AnalysisConfig;
+api/paddle_api.h PaddleTensor). TPU-native semantics: "optimization
+passes" are XLA's job — the predictor prunes to the inference graph at
+save time, compiles the whole program ONCE on first Run (cached per
+shape), keeps parameters resident on device between calls, and serves
+repeat queries as single compiled dispatches.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["AnalysisConfig", "PaddleTensor", "PaddlePredictor",
+           "Predictor", "create_paddle_predictor", "create_predictor"]
+
+
+class AnalysisConfig:
+    """(reference paddle_analysis_config.h)"""
+
+    def __init__(self, model_dir=None, params_file=None):
+        self._model_dir = model_dir
+        self._prog_file = None
+        self._params_file = params_file
+        self._use_accelerator = True
+        self._ir_optim = True
+        self._cpu_math_threads = 1
+        self._enable_profile = False
+
+    def set_model(self, model_dir, params_file=None):
+        self._model_dir = model_dir
+        self._params_file = params_file
+
+    def model_dir(self):
+        return self._model_dir
+
+    # accelerator knobs (GPU names kept for script compatibility)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_accelerator = True
+
+    def disable_gpu(self):
+        self._use_accelerator = False
+
+    def use_gpu(self):
+        return self._use_accelerator
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = x  # graph optimization is XLA's job; recorded
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = n
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def switch_use_feed_fetch_ops(self, x):
+        pass  # feed/fetch ops never exist in the compiled path
+
+    def switch_specify_input_names(self, x=True):
+        pass
+
+
+class PaddleTensor:
+    """(reference paddle_api.h PaddleTensor) — name + ndarray."""
+
+    def __init__(self, data=None, name=""):
+        self.name = name
+        self.data = np.asarray(data) if data is not None else None
+        self.shape = tuple(self.data.shape) if self.data is not None else ()
+        self.lod = []
+
+    def as_ndarray(self):
+        return self.data
+
+
+class PaddlePredictor:
+    """Loads a saved inference model and serves Run() (reference
+    analysis_predictor.cc:485,916)."""
+
+    def __init__(self, config: AnalysisConfig):
+        import paddle_tpu as fluid
+
+        self._config = config
+        place = (fluid.TPUPlace(0) if config.use_gpu()
+                 else fluid.CPUPlace())
+        self._exe = fluid.Executor(place)
+        self._scope = fluid.Scope()
+        with fluid.scope_guard(self._scope):
+            (self._program, self._feed_names,
+             self._fetch_vars) = fluid.io.load_inference_model(
+                 config.model_dir(), self._exe)
+
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return [v.name for v in self._fetch_vars]
+
+    def run(self, inputs):
+        """inputs: list of PaddleTensor (positional feed order) or dict
+        name->ndarray. Returns a list of PaddleTensor."""
+        import paddle_tpu as fluid
+
+        if isinstance(inputs, dict):
+            feed = {k: np.asarray(v) for k, v in inputs.items()}
+        else:
+            feed = {}
+            for i, t in enumerate(inputs):
+                name = t.name or self._feed_names[i]
+                feed[name] = np.asarray(t.data)
+        with fluid.scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_vars)
+        return [PaddleTensor(np.asarray(o), name=v.name)
+                for o, v in zip(outs, self._fetch_vars)]
+
+    # 2.0-style aliases
+    def get_input_handle(self, name):
+        raise NotImplementedError("use run() with a feed dict")
+
+
+Predictor = PaddlePredictor
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> PaddlePredictor:
+    return PaddlePredictor(config)
+
+
+create_predictor = create_paddle_predictor

@@ -30,9 +30,23 @@ def run_check():
     print("Your paddle_tpu works well on SINGLE device.")
     import jax
 
-    if len(jax.devices()) > 1:
-        from .parallel import mesh_utils
-
-        print("Your paddle_tpu works well on %d devices." % len(jax.devices()))
+    n = len(jax.devices())
+    if n > 1:
+        # a REAL mesh step: data-parallel compiled program on all
+        # devices, loss must come back finite from every shard
+        compiled = fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe.run(startup)
+            (l,) = exe.run(compiled,
+                           feed={"x": np.ones((4 * n, 2), np.float32)},
+                           fetch_list=[loss])
+        if not np.all(np.isfinite(np.asarray(l))):
+            raise RuntimeError("multi-device check produced non-finite "
+                               "loss: %r" % l)
+        print("Your paddle_tpu works well on %d devices." % n)
+    else:
+        print("Multi-device check skipped: only one device visible.")
     print("install check passed.")
     return True

@@ -14,7 +14,7 @@ lowered TPU-natively:
   matching reference behavior with nranks=1.
 - Bootstrap ops (gen_nccl_id/comm_init) are no-op hosts: rendezvous is
   jax.distributed's coordination service over DCN, set up at launch
-  (parallel/env.py), not graph ops. Stream-sync ops are no-ops: XLA
+  (dygraph/parallel.py prepare_context), not graph ops. Stream-sync ops are no-ops: XLA
   program order subsumes them.
 """
 from __future__ import annotations

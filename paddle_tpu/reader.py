@@ -69,22 +69,24 @@ class _GeneratorLoader:
     # -- iteration --------------------------------------------------------
     def _thread_batches(self):
         q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
-        stop = object()
 
         def producer():
             try:
                 for arrays in self._batch_reader():
-                    q.put(arrays)
-            finally:
-                q.put(stop)
+                    q.put(("batch", arrays))
+                q.put(("end", None))
+            except BaseException as e:  # surface, don't truncate the epoch
+                q.put(("error", e))
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         while True:
-            arrays = q.get()
-            if arrays is stop:
+            kind, payload = q.get()
+            if kind == "end":
                 break
-            yield arrays
+            if kind == "error":
+                raise payload
+            yield payload
 
     def _process_batches(self):
         """Worker-process producer (reference DygraphGeneratorLoader
@@ -117,7 +119,14 @@ class _GeneratorLoader:
         finished = False
         try:
             while True:
-                kind, payload = q.get()
+                try:
+                    kind, payload = q.get(timeout=2.0)
+                except queue.Empty:
+                    if not proc.is_alive():
+                        raise RuntimeError(
+                            "DataLoader worker process died without "
+                            "reporting (killed or crashed hard)")
+                    continue
                 if kind == "end":
                     finished = True
                     break
@@ -201,7 +210,22 @@ class DataLoader:
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
         loader = _GeneratorLoader(iterable=True, return_list=False)
-        loader.set_batch_generator(lambda: dataset._iter_batches())
+
+        def batches():
+            want = getattr(dataset, "_batch_size", None)
+            for feed in dataset._iter_batches():
+                if drop_last and want:
+                    # native workers flush partial tails; a static-shape
+                    # compiled program can't take them
+                    sizes = [np.asarray(v.array if hasattr(v, "array")
+                                        else v).shape[0]
+                             for v in feed.values()
+                             if not hasattr(v, "lod")]
+                    if sizes and min(sizes) < want:
+                        continue
+                yield feed
+
+        loader.set_batch_generator(batches)
         loader._yields_feed_dicts = True
         return loader
 

@@ -208,3 +208,157 @@ class TestDatasetReaders:
 
         x, y = next(uci_housing.train()())
         assert x.shape == (13,) and y.shape == (1,)
+
+
+class TestInferencePredictor:
+    def test_train_save_load_serve_roundtrip(self):
+        from paddle_tpu import models
+        from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                          create_paddle_predictor)
+
+        B = 8
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.data(name="img", shape=[B, 1, 28, 28],
+                             dtype="float32")
+            label = fluid.data(name="label", shape=[B, 1], dtype="int64")
+            pred = models.lenet(img)
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+        rng = np.random.RandomState(0)
+        scope = fluid.Scope()
+        with tempfile.TemporaryDirectory() as d:
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                for i in range(3):
+                    x = rng.rand(B, 1, 28, 28).astype("float32")
+                    y = rng.randint(0, 10, (B, 1)).astype("int64")
+                    exe.run(main, feed={"img": x, "label": y},
+                            fetch_list=[loss])
+                x = rng.rand(B, 1, 28, 28).astype("float32")
+                (ref,) = exe.run(main.clone(for_test=True),
+                                 feed={"img": x,
+                                       "label": np.zeros((B, 1), "int64")},
+                                 fetch_list=[pred])
+                fluid.io.save_inference_model(d, ["img"], [pred], exe,
+                                              main_program=main)
+            config = AnalysisConfig(d)
+            config.disable_gpu()
+            predictor = create_paddle_predictor(config)
+            assert predictor.get_input_names() == ["img"]
+            (out,) = predictor.run([PaddleTensor(x, name="img")])
+            np.testing.assert_allclose(out.as_ndarray(), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+            # repeat call exercises the compiled-once path
+            (out2,) = predictor.run({"img": x})
+            np.testing.assert_allclose(out2.as_ndarray(),
+                                       out.as_ndarray(), rtol=1e-6)
+
+
+class TestInstallCheck:
+    def test_run_check_multi_device(self, capsys):
+        import jax
+
+        import paddle_tpu
+
+        assert paddle_tpu.install_check.run_check() is True
+        out = capsys.readouterr().out
+        if len(jax.devices()) > 1:
+            assert "works well on %d devices" % len(jax.devices()) in out
+        else:
+            assert "skipped" in out
+
+
+class TestFlagsAndErrors:
+    def test_nan_checker_catches_inf(self):
+        import paddle_tpu
+        from paddle_tpu.core.enforce import EnforceNotMet
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.log(x)  # log(0) = -inf
+        paddle_tpu.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                # force the interpreter path so the per-op checker runs
+                with pytest.raises(EnforceNotMet, match="Inf/Nan"):
+                    exe._core.run_program(
+                        main, scope, {"x": np.zeros(4, "float32")}, [y],
+                        True)
+        finally:
+            paddle_tpu.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_unknown_op_error_has_context(self):
+        from paddle_tpu.core.enforce import NotFoundError
+        from paddle_tpu.core.registry import OpInfoMap
+
+        with pytest.raises(NotFoundError, match="conv2d"):
+            OpInfoMap.instance().get("conv2dd")
+
+    def test_get_set_flags_roundtrip(self):
+        import paddle_tpu
+
+        assert paddle_tpu.get_flags("FLAGS_allocator_strategy") == {
+            "FLAGS_allocator_strategy": "auto_growth"}
+        with pytest.raises(ValueError):
+            paddle_tpu.get_flags("FLAGS_no_such_flag")
+
+
+class TestMalformedRecords:
+    def _write_bad(self, p):
+        with open(p, "w") as f:
+            f.write("4 0.1 0.2 0.3 0.4 1 7\n")   # good
+            f.write("4 0.1 0.2 1 3\n")            # short dense slot
+            f.write("x y z\n")                    # garbage
+            f.write("4 0.5 0.6 0.7 0.8 1 2\n")   # good
+
+    def test_native_skips_malformed_without_corruption(self):
+        from paddle_tpu.core.native_feed import NativeMultiSlotFeed, load
+
+        if load() is None:
+            pytest.skip("no native toolchain")
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "part-0")
+            self._write_bad(p)
+            batches = list(NativeMultiSlotFeed([p], ["float", "int64"], 2,
+                                               num_threads=1))
+        assert len(batches) == 1
+        fvals, foffs = batches[0][0]
+        ivals, _ = batches[0][1]
+        np.testing.assert_allclose(
+            fvals, [0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8], rtol=1e-6)
+        assert ivals.tolist() == [7, 2]
+        assert foffs.tolist() == [0, 4, 8]  # no stray values
+
+    def test_python_fallback_skips_malformed(self):
+        from paddle_tpu.dataset_module import _python_multislot_feed
+
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "part-0")
+            self._write_bad(p)
+            batches = list(_python_multislot_feed([p], ["float", "int64"],
+                                                  2))
+        assert len(batches) == 1
+        assert batches[0][1][0].tolist() == [7, 2]
+
+
+class TestLoaderErrorPropagation:
+    def test_thread_producer_error_raises(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[2, 2], dtype="float32")
+        loader = fluid.DataLoader.from_generator(feed_list=[x], capacity=2)
+
+        def gen():
+            yield [np.zeros((2, 2), "float32")]
+            raise RuntimeError("reader exploded")
+
+        loader.set_batch_generator(gen)
+        with pytest.raises(RuntimeError, match="reader exploded"):
+            list(loader)
