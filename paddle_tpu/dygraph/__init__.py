@@ -30,4 +30,7 @@ from .learning_rate_scheduler import (  # noqa: F401
     PolynomialDecay,
 )
 from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
+from .tracer import grad  # noqa: F401
 from .jit import TracedLayer  # noqa: F401
+from . import dygraph_to_static  # noqa: F401
+from .dygraph_to_static import ProgramTranslator, declarative, to_static  # noqa: F401

@@ -1,0 +1,164 @@
+"""dygraph_to_static: ProgramTranslator + @declarative.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:229. The reference rewrites Python ASTs into
+static-graph code; the TPU-native mechanism is TRACE-based: the
+decorated function runs once eagerly per input signature while the
+tracer records every op into a Program, which then executes through the
+whole-program XLA compiler (single dispatch per call). Data-dependent
+Python control flow inside the function is therefore specialized per
+trace — the same constraint jax.jit imposes, and the honest contract on
+a tracing compiler (the reference's AST path re-plumbs `if`/`for` into
+cond/while ops instead; use fluid.layers.cond / While for dynamic
+control flow).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import numpy as np
+
+from .varbase import VarBase
+
+__all__ = ["ProgramTranslator", "declarative", "to_static"]
+
+
+class _TracedFunction:
+    def __init__(self, fn):
+        self._fn = fn
+        self._cache: Dict = {}  # signature -> (program, feeds, fetches, params)
+        self._staged: Dict = {}  # param name -> id(array) staged in scope
+
+    def __get__(self, obj, objtype=None):
+        """Descriptor protocol: @declarative on a method binds self."""
+        if obj is None:
+            return self
+        import functools
+
+        bound = functools.partial(self.__call__, obj)
+        bound.get_program = lambda *a: self.get_program(obj, *a)
+        return bound
+
+    def _signature(self, args):
+        sig = []
+        for a in args:
+            arr = a._array if isinstance(a, VarBase) else np.asarray(a)
+            sig.append((tuple(arr.shape), str(arr.dtype)))
+        return tuple(sig)
+
+    def _trace(self, args):
+        from .. import framework
+        from .base import enabled, guard
+        from .tracer import current_tracer
+
+        import contextlib
+
+        ctx = contextlib.nullcontext() if enabled() else guard()
+        with ctx:
+            tracer = current_tracer()
+            program = framework.Program()
+            blk = program.global_block()
+            in_vars = []
+            for a in args:
+                arr = a._array if isinstance(a, VarBase) else np.asarray(a)
+                v = VarBase(arr, stop_gradient=True)
+                var = blk.create_var(name=v.name, shape=tuple(arr.shape),
+                                     dtype=str(arr.dtype))
+                var.is_data = True
+                in_vars.append(v)
+            tracer.start_program_recording(program)
+            try:
+                outs = self._fn(*in_vars)
+            finally:
+                tracer.stop_program_recording()
+            single = not isinstance(outs, (list, tuple))
+            outs_l = [outs] if single else list(outs)
+            params = {p.name: p for p in tracer.all_parameters()
+                      if blk.has_var_local(p.name)}
+            return (program, [v.name for v in in_vars],
+                    [o.name for o in outs_l], params, single)
+
+    def __call__(self, *args):
+        if not ProgramTranslator().enabled:
+            return self._fn(*args)
+        sig = self._signature(args)
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._trace(args)
+            self._cache[sig] = entry
+        program, feed_names, fetch_names, params, single = entry
+
+        import paddle_tpu as fluid
+
+        import jax.numpy as jnp
+
+        scope = fluid.global_scope()
+        for name, p in params.items():
+            # stage a COPY (the compiled program donates its state
+            # buffers; the live dygraph parameter must survive) — but
+            # only when the parameter actually changed since last call
+            if self._staged.get(name) != id(p._array):
+                scope.var(name).get_tensor()._array = jnp.array(
+                    p._array, copy=True)
+                self._staged[name] = id(p._array)
+        exe = _shared_executor()
+        feed = {}
+        for n, a in zip(feed_names, args):
+            feed[n] = np.asarray(a._array if isinstance(a, VarBase)
+                                 else a)
+        outs = exe.run(program, feed=feed, fetch_list=fetch_names,
+                       return_numpy=False)
+        result = [VarBase(o.array if hasattr(o, "array") else o,
+                          stop_gradient=True) for o in outs]
+        # params may have been updated elsewhere; nothing to write back —
+        # the static program here is forward-only
+        return result[0] if single else result
+
+    def get_program(self, *args):
+        sig = self._signature(args)
+        entry = self._cache.get(sig) or self._trace(args)
+        self._cache[sig] = entry
+        return entry[0]
+
+
+_executor = None
+
+
+def _shared_executor():
+    global _executor
+    if _executor is None:
+        import paddle_tpu as fluid
+
+        _executor = fluid.Executor(fluid.TPUPlace(0))
+    return _executor
+
+
+class ProgramTranslator:
+    """Singleton switch + cache (reference program_translator.py:229)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enabled = True
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        self.enabled = bool(enable_to_static)
+
+    def get_program(self, dygraph_func, *args):
+        if not isinstance(dygraph_func, _TracedFunction):
+            dygraph_func = _TracedFunction(dygraph_func)
+        return dygraph_func.get_program(*args)
+
+
+def declarative(fn):
+    """@declarative / @to_static decorator."""
+    traced = _TracedFunction(fn)
+    functools.update_wrapper(traced, fn)
+    return traced
+
+
+to_static = declarative

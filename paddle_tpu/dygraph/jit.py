@@ -2,10 +2,10 @@
 
 Parity: /root/reference/python/paddle/fluid/dygraph/jit.py:156
 (TracedLayer over the C++ ProgramDesc tracer, imperative/jit/
-program_desc_tracer.cc). TPU-native: tracing a dygraph Layer gives a
-jitted XLA callable directly (jax.jit over the layer's eager ops) — the
-"program" artifact for save_inference_model is reconstructed by replaying
-the tape symbolically.
+program_desc_tracer.cc). TPU-native: one trace gives BOTH artifacts —
+a jitted XLA callable (jax.jit over the layer's eager ops) for serving
+in-process, and a recorded static Program (the tracer appends every
+traced op) for save_inference_model / the Predictor.
 """
 from __future__ import annotations
 
@@ -14,20 +14,27 @@ from typing import List
 import numpy as np
 
 from .layers import Layer
+from .tracer import current_tracer
 from .varbase import VarBase
 
 __all__ = ["TracedLayer"]
 
 
 class TracedLayer:
-    def __init__(self, fn, params, in_spec):
+    def __init__(self, fn, params, in_spec, program=None, feed_names=None,
+                 fetch_names=None):
         self._fn = fn  # jitted: (param_arrays, input_arrays) -> outputs
         self._params = params
         self._in_spec = in_spec
+        self._program = program
+        self._feed_names = feed_names or []
+        self._fetch_names = fetch_names or []
 
     @staticmethod
     def trace(layer: Layer, inputs: List[VarBase]):
         import jax
+
+        from .. import framework
 
         params = layer.parameters()
 
@@ -46,12 +53,41 @@ class TracedLayer:
                 for p, s in zip(params, saved):
                     p._array = s
 
+        # ONE recording run produces both the outputs and the program
+        # (running twice would double BN stat updates and fork RNG
+        # streams between the program and the returned outputs); the
+        # no-grad guard keeps the recording off the autograd tape.
+        tracer = current_tracer()
+        program = framework.Program()
+        in_vars = [VarBase(x._array, stop_gradient=True) for x in inputs]
+        blk = program.global_block()
+        for v in in_vars:
+            var = blk.create_var(name=v.name,
+                                 shape=tuple(v._array.shape),
+                                 dtype=str(v._array.dtype))
+            var.is_data = True
+        tracer.start_program_recording(program)
+        try:
+            with tracer.no_grad_guard():
+                rec_outs = layer(*in_vars)
+        finally:
+            tracer.stop_program_recording()
+        if not isinstance(rec_outs, (list, tuple)):
+            rec_outs = [rec_outs]
+        feed_names = [v.name for v in in_vars]
+        fetch_names = [o.name for o in rec_outs]
+
+        # jitted callable for in-process serving (compiles on first call)
         jitted = jax.jit(pure)
         in_arrays = [x._array for x in inputs]
-        out_arrays = jitted([p._array for p in params], in_arrays)
-        outs = [VarBase(a, stop_gradient=True) for a in out_arrays]
-        traced = TracedLayer(jitted, params, [a.shape for a in in_arrays])
+        outs = [VarBase(o._array, stop_gradient=True) for o in rec_outs]
+        traced = TracedLayer(jitted, params, [a.shape for a in in_arrays],
+                             program, feed_names, fetch_names)
         return outs, traced
+
+    @property
+    def program(self):
+        return self._program
 
     def __call__(self, inputs):
         arrays = [x._array if isinstance(x, VarBase) else np.asarray(x)
@@ -60,5 +96,21 @@ class TracedLayer:
         return [VarBase(a, stop_gradient=True) for a in outs]
 
     def save_inference_model(self, dirname, feed=None, fetch=None):
-        raise NotImplementedError(
-            "TracedLayer.save_inference_model arrives with the inference wave")
+        """Persist the recorded program + current param values in the
+        save_inference_model format the Predictor loads."""
+        import paddle_tpu as fluid
+
+        feed_names = ([self._feed_names[i] for i in feed] if feed
+                      else list(self._feed_names))
+        fetch_names = ([self._fetch_names[i] for i in fetch] if fetch
+                       else list(self._fetch_names))
+        blk = self._program.global_block()
+        fetch_vars = [blk.var(n) for n in fetch_names]
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            for p in self._params:
+                scope.var(p.name).get_tensor()._array = p._array
+            exe = fluid.Executor(fluid.CPUPlace())
+            fluid.io.save_inference_model(
+                dirname, feed_names, fetch_vars, exe,
+                main_program=self._program)

@@ -131,9 +131,12 @@ class DataParallel(Layer):
 
 
 def _coalesce(grads):
+    """One flat f32 buffer (mixed grad dtypes upcast for the collective;
+    _split_like restores each grad's own dtype — the reference groups
+    by dtype instead, one collective per group)."""
     import jax.numpy as jnp
 
-    return jnp.concatenate([g.ravel() for g in grads])
+    return jnp.concatenate([g.astype(jnp.float32).ravel() for g in grads])
 
 
 def _split_like(flat, refs):
@@ -141,7 +144,7 @@ def _split_like(flat, refs):
     off = 0
     for r in refs:
         n = int(np.prod(r.shape)) if r.ndim else 1
-        out.append(flat[off:off + n].reshape(r.shape))
+        out.append(flat[off:off + n].reshape(r.shape).astype(r.dtype))
         off += n
     return out
 
@@ -167,7 +170,12 @@ def _allreduce_across_processes(flat, nranks):
         return jax.jit(
             lambda x: x.sum(axis=0),
             out_shardings=NamedSharding(mesh, P()))(garr)
-    except Exception:
+    except Exception as e:
+        import warnings
+
+        warnings.warn(
+            "on-device cross-process allreduce unavailable (%s); falling "
+            "back to host-gather — expect much slower DP steps" % e)
         from jax.experimental import multihost_utils
 
         gathered = multihost_utils.process_allgather(flat)

@@ -36,13 +36,17 @@ def _set_tracer(t):
 
 
 class TapeRecord:
-    __slots__ = ("op_type", "vjp_fn", "in_vars", "out_vars")
+    __slots__ = ("op_type", "vjp_fn", "in_vars", "out_vars", "fwd_fn")
 
-    def __init__(self, op_type, vjp_fn, in_vars, out_vars):
+    def __init__(self, op_type, vjp_fn, in_vars, out_vars, fwd_fn=None):
         self.op_type = op_type
         self.vjp_fn = vjp_fn  # pullback: (cotangents,) -> input grads
         self.in_vars = in_vars  # [VarBase] aligned with pullback results
         self.out_vars = out_vars  # [VarBase] aligned with cotangent order
+        # pure forward (primals -> flat outputs); lets higher-order grads
+        # re-derive the pullback WITH its primal dependence (the saved
+        # vjp_fn treats residuals as constants)
+        self.fwd_fn = fwd_fn
 
 
 class BasicEngine:
@@ -90,6 +94,48 @@ class Tracer:
         self._no_grad = False
         self.train_mode = True
         self._seed_counter = np.random.randint(1, 2**31 - 1)
+        # ProgramDesc recording (reference imperative/jit/
+        # program_desc_tracer.cc): when set, every traced op is ALSO
+        # appended to this Program so jit.save / dygraph_to_static can
+        # emit a static graph
+        self._recording_program = None
+
+    # -- ProgramDesc recording --------------------------------------------
+    def start_program_recording(self, program):
+        self._recording_program = program
+
+    def stop_program_recording(self):
+        prog = self._recording_program
+        self._recording_program = None
+        return prog
+
+    def _record_var(self, vb: VarBase, block):
+        if not block.has_var_local(vb.name):
+            shape = tuple(vb._array.shape) if vb._array is not None else None
+            dtype = str(vb._array.dtype) if vb._array is not None \
+                else "float32"
+            if isinstance(vb, ParamBase):
+                v = block.create_var(name=vb.name, shape=shape,
+                                     dtype=dtype, persistable=True)
+                v.stop_gradient = vb.stop_gradient
+            else:
+                block.create_var(name=vb.name, shape=shape, dtype=dtype)
+        return vb.name
+
+    def _record_op(self, op_type, var_map, result, attrs):
+        block = self._recording_program.global_block()
+        ins = {}
+        for slot, vs in var_map.items():
+            if vs is None:
+                continue
+            vlist = vs if isinstance(vs, list) else [vs]
+            ins[slot] = [self._record_var(v, block) for v in vlist]
+        outs = {slot: [self._record_var(v, block) for v in vs]
+                for slot, vs in result.items()}
+        clean = {k: v for k, v in (attrs or {}).items()
+                 if k != BOUND_OUTPUTS_ATTR}
+        block.append_op(op_type, inputs=ins, outputs=outs, attrs=clean,
+                        infer_shape=False)
 
     # -- parameter registry (LayerHelper uses this in dygraph mode) -------
     def register_parameter(self, p: ParamBase):
@@ -244,13 +290,144 @@ class Tracer:
             result[slot_name] = vs
         if requires_grad:
             self.tape.append(
-                TapeRecord(op_type, vjp_fn, in_vars, out_vars_flat))
+                TapeRecord(op_type, vjp_fn, in_vars, out_vars_flat,
+                           fwd_fn=fwd_flat))
+        if self._recording_program is not None:
+            self._record_op(op_type, var_map, result, attrs)
         return result
 
     def trace_getitem(self, var: VarBase, idx):
         import jax
 
-        out, vjp_fn = jax.vjp(lambda x: (x[idx],), var._array)
+        if self._recording_program is not None:
+            from ..core.enforce import UnimplementedError
+
+            raise UnimplementedError(
+                "tensor slicing (__getitem__) inside a program-recorded "
+                "trace is not supported yet — use layers.slice")
+        fwd = lambda x: (x[idx],)  # noqa: E731
+        out, vjp_fn = jax.vjp(fwd, var._array)
         ov = VarBase(out[0], stop_gradient=False)
-        self.tape.append(TapeRecord("getitem", vjp_fn, [var], [ov]))
+        self.tape.append(TapeRecord("getitem", vjp_fn, [var], [ov],
+                                    fwd_fn=fwd))
         return ov
+
+
+class PartialGradEngine:
+    """paddle.grad()-style partial/higher-order gradients (reference
+    imperative/partial_grad_engine.cc): walk only the tape segment
+    between `outputs` and `inputs`, return grads without touching
+    `.grad` accumulators. With create_graph=True the backward ops are
+    themselves taped (each pullback call goes through jax.vjp), so
+    grad-of-grad works."""
+
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def run(self, outputs, inputs, grad_outputs=None, retain_graph=None,
+            create_graph=False, only_inputs=True, allow_unused=False,
+            no_grad_vars=None):
+        import jax
+        import jax.numpy as jnp
+
+        if not only_inputs:
+            raise NotImplementedError("only_inputs=False is not supported")
+        outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        no_grad_ids = {id(v) for v in (no_grad_vars or [])}
+        if retain_graph is None:
+            retain_graph = create_graph
+
+        # grad VarBases keyed by forward var identity
+        gvars: Dict[int, VarBase] = {}
+        for i, o in enumerate(outputs):
+            seed = None
+            if grad_outputs is not None and i < len(grad_outputs) \
+                    and grad_outputs[i] is not None:
+                go = grad_outputs[i]
+                seed = go if isinstance(go, VarBase) else VarBase(
+                    go, stop_gradient=not create_graph)
+            else:
+                seed = VarBase(jnp.ones_like(o._array),
+                               stop_gradient=not create_graph)
+            gvars[id(o)] = seed
+
+        tape = list(self.tracer.tape)
+        for rec in reversed(tape):
+            if not any(id(ov) in gvars for ov in rec.out_vars):
+                continue
+            cot_vars = []
+            for ov in rec.out_vars:
+                gv = gvars.get(id(ov))
+                if gv is None:
+                    gv = VarBase(jnp.zeros_like(ov._array),
+                                 stop_gradient=True)
+                cot_vars.append(gv)
+            cots = tuple(g._array for g in cot_vars)
+            if create_graph and rec.fwd_fn is not None:
+                # re-derive the pullback THROUGH the forward so the grads
+                # depend on the primals too (d(gx)/dx needs it)
+                n_p = len(rec.in_vars)
+                primals = tuple(v._array for v in rec.in_vars)
+
+                def grad_call(*args, _rec=rec, _np=n_p):
+                    prim, cot = args[:_np], args[_np:]
+                    _, pull = jax.vjp(_rec.fwd_fn, *prim)
+                    return pull(tuple(cot))
+
+                in_grad_arrays, vjp2 = jax.vjp(grad_call,
+                                               *(primals + cots))
+                new_gvars = [VarBase(a, stop_gradient=False)
+                             for a in in_grad_arrays]
+                self.tracer.tape.append(TapeRecord(
+                    rec.op_type + "_grad", vjp2,
+                    list(rec.in_vars) + cot_vars, new_gvars,
+                    fwd_fn=grad_call))
+            else:
+                in_grad_arrays = rec.vjp_fn(cots)
+                new_gvars = [VarBase(a, stop_gradient=True)
+                             for a in in_grad_arrays]
+            for iv, gv in zip(rec.in_vars, new_gvars):
+                if id(iv) in no_grad_ids:
+                    continue
+                prev = gvars.get(id(iv))
+                if prev is None:
+                    gvars[id(iv)] = gv
+                else:
+                    summed = prev._array + gv._array
+                    if create_graph:
+                        sv = VarBase(summed, stop_gradient=False)
+                        self.tracer.tape.append(TapeRecord(
+                            "grad_add", lambda c: (c[0], c[0]),
+                            [prev, gv], [sv]))
+                        gvars[id(iv)] = sv
+                    else:
+                        gvars[id(iv)] = VarBase(summed, stop_gradient=True)
+
+        results = []
+        for v in inputs:
+            gv = gvars.get(id(v))
+            if gv is None and not allow_unused:
+                raise ValueError(
+                    "one of the inputs is unreachable from outputs; pass "
+                    "allow_unused=True to get None for it")
+            results.append(gv)
+        if not retain_graph:
+            # reference semantics: the graph is freed after grad() unless
+            # retained — otherwise every call leaks taped residuals
+            self.tracer.tape.clear()
+        return results
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """fluid.dygraph.grad (reference dygraph/base.py grad ->
+    PartialGradEngine)."""
+    t = current_tracer()
+    if t is None:
+        raise RuntimeError("dygraph.grad() requires dygraph mode "
+                           "(fluid.dygraph.guard())")
+    return PartialGradEngine(t).run(
+        outputs, inputs, grad_outputs, retain_graph, create_graph,
+        only_inputs, allow_unused, no_grad_vars)

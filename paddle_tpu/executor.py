@@ -71,7 +71,11 @@ class Executor:
         feed = feed or {}
         fetch_list = list(fetch_list or [])
 
-        if self._can_whole_compile(program):
+        from .core.flags import flag as _flag
+
+        # FLAGS_check_nan_inf needs the per-op interpreter (the check
+        # runs after every op, reference operator.cc:1032)
+        if not _flag("check_nan_inf") and self._can_whole_compile(program):
             from .core.compiler_engine import (_program_version,
                                                run_compiled_program)
 

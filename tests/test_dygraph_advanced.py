@@ -1,0 +1,137 @@
+"""paddle.grad partial grads, higher-order grads, TracedLayer save,
+dygraph_to_static tests.
+
+Contracts: reference test_imperative_double_grad.py (grad/second
+order), test_traced_layer..., dygraph_to_static tests."""
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.dygraph import Linear, to_variable
+
+
+class TestPartialGrad:
+    def test_first_order_matches_formula(self):
+        with fluid.dygraph.guard():
+            x = to_variable(np.array([2.0, 3.0], dtype="float32"))
+            x.stop_gradient = False
+            y = fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(x, x))  # sum(x^2)
+            (gx,) = fluid.dygraph.grad(y, x)
+        np.testing.assert_allclose(np.asarray(gx.numpy()),
+                                   [4.0, 6.0], rtol=1e-6)
+
+    def test_does_not_touch_grad_accumulators(self):
+        with fluid.dygraph.guard():
+            x = to_variable(np.ones(3, dtype="float32"))
+            x.stop_gradient = False
+            y = fluid.layers.reduce_sum(fluid.layers.square(x))
+            fluid.dygraph.grad(y, x, retain_graph=True)
+            assert x._grad is None  # partial grads leave .grad alone
+
+    def test_unreachable_input(self):
+        with fluid.dygraph.guard():
+            x = to_variable(np.ones(2, dtype="float32"))
+            x.stop_gradient = False
+            z = to_variable(np.ones(2, dtype="float32"))
+            z.stop_gradient = False
+            y = fluid.layers.reduce_sum(fluid.layers.square(x))
+            with pytest.raises(ValueError):
+                fluid.dygraph.grad(y, z)
+            (gz,) = fluid.dygraph.grad(y, z, allow_unused=True,
+                                       retain_graph=True)
+            assert gz is None
+
+    def test_second_order(self):
+        """d2/dx2 of sum(x^3) = 6x (reference double-grad contract)."""
+        with fluid.dygraph.guard():
+            x = to_variable(np.array([1.0, 2.0], dtype="float32"))
+            x.stop_gradient = False
+            x2 = fluid.layers.elementwise_mul(x, x)
+            x3 = fluid.layers.elementwise_mul(x2, x)
+            y = fluid.layers.reduce_sum(x3)
+            (gx,) = fluid.dygraph.grad(y, x, create_graph=True)
+            gsum = fluid.layers.reduce_sum(gx)
+            (ggx,) = fluid.dygraph.grad(gsum, x)
+        np.testing.assert_allclose(np.asarray(ggx.numpy()),
+                                   [6.0, 12.0], rtol=1e-5)
+
+
+class TestTracedLayerSave:
+    def test_trace_save_load_serve(self):
+        from paddle_tpu.dygraph import TracedLayer
+        from paddle_tpu.inference import AnalysisConfig, create_predictor
+
+        with fluid.dygraph.guard():
+            layer = Linear(4, 2)
+            x = to_variable(np.random.RandomState(0).rand(
+                3, 4).astype("float32"))
+            outs, traced = TracedLayer.trace(layer, [x])
+            ref = np.asarray(outs[0].numpy())
+            # recorded program exists and contains the matmul
+            types = [op.type for op in
+                     traced.program.global_block().ops]
+            assert "mul" in types or "matmul" in types
+            with tempfile.TemporaryDirectory() as d:
+                traced.save_inference_model(d)
+                config = AnalysisConfig(d)
+                config.disable_gpu()
+                predictor = create_predictor(config)
+                (out,) = predictor.run(
+                    {predictor.get_input_names()[0]: x.numpy()})
+        np.testing.assert_allclose(out.as_ndarray(), ref, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestDygraphToStatic:
+    def test_declarative_matches_eager(self):
+        from paddle_tpu.dygraph import declarative
+
+        with fluid.dygraph.guard():
+            layer = Linear(4, 3, act="tanh")
+
+            def f(x):
+                return fluid.layers.reduce_sum(layer(x), dim=-1)
+
+            static_f = declarative(f)
+            x = np.random.RandomState(1).rand(2, 4).astype("float32")
+            eager = f(to_variable(x)).numpy()
+            static1 = static_f(to_variable(x)).numpy()
+            static2 = static_f(to_variable(x)).numpy()  # cached program
+        np.testing.assert_allclose(np.asarray(static1), np.asarray(eager),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(static2),
+                                   np.asarray(static1), rtol=1e-6)
+
+    def test_translator_disable_falls_back_to_eager(self):
+        from paddle_tpu.dygraph import ProgramTranslator, declarative
+
+        calls = []
+
+        with fluid.dygraph.guard():
+            @declarative
+            def f(x):
+                calls.append(1)
+                return fluid.layers.scale(x, scale=2.0)
+
+            x = to_variable(np.ones(2, dtype="float32"))
+            ProgramTranslator().enable(False)
+            try:
+                out = f(x)
+            finally:
+                ProgramTranslator().enable(True)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2.0, 2.0])
+
+    def test_get_program(self):
+        from paddle_tpu.dygraph import ProgramTranslator
+
+        with fluid.dygraph.guard():
+            def f(x):
+                return fluid.layers.scale(x, scale=3.0)
+
+            prog = ProgramTranslator().get_program(
+                f, to_variable(np.ones(2, dtype="float32")))
+        assert any(op.type == "scale"
+                   for op in prog.global_block().ops)
