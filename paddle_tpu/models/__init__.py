@@ -8,3 +8,9 @@ from .lenet import lenet  # noqa: F401
 from .mlp import mlp  # noqa: F401
 from .resnet import resnet, resnet50, resnet_cifar  # noqa: F401
 from .wide_deep import wide_deep  # noqa: F401
+from .transformer import (  # noqa: F401
+    bert_base_pretrain,
+    encoder_layer,
+    multi_head_attention,
+    transformer_encoder,
+)

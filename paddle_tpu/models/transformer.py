@@ -1,0 +1,106 @@
+"""Transformer encoder family (BERT-base config 3 / WMT config 4).
+
+Parity model: the reference's transformer test configs
+(/root/reference/python/paddle/fluid/tests/unittests/dist_transformer.py
+and the fused multihead path operators/fused/multihead_matmul_op.cu).
+Built from plain fluid.layers graph ops — under whole-program
+compilation XLA fuses QKV projections and attention into MXU-shaped
+matmuls, which is the TPU replacement for the reference's hand-fused
+CUDA encoder kernels.
+"""
+from __future__ import annotations
+
+
+from .. import layers
+
+
+def _dense(x, size, act=None, name=None):
+    return layers.fc(x, size=size, act=act, num_flatten_dims=2)
+
+
+def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
+                         is_test=False, attn_bias=None):
+    """Self-attention over [B, T, D]. ``attn_bias`` is an additive mask
+    broadcastable to [B, H, T, T] (the reference's src_slf_attn_bias:
+    0 for visible positions, a large negative value for padding)."""
+    B, T, D = q_in.shape
+    head = d_model // num_heads
+    q = _dense(q_in, d_model)
+    k = _dense(q_in, d_model)
+    v = _dense(q_in, d_model)
+
+    def split_heads(x):
+        x = layers.reshape(x, [B, T, num_heads, head])
+        return layers.transpose(x, [0, 2, 1, 3])  # [B, H, T, head]
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    q = layers.scale(q, scale=float(head) ** -0.5)
+    scores = layers.matmul(q, k, transpose_y=True)  # [B, H, T, T]
+    if attn_bias is not None:
+        scores = layers.elementwise_add(scores, attn_bias)
+    weights = layers.softmax(scores)
+    if dropout and not is_test:
+        weights = layers.dropout(weights, dropout_prob=dropout,
+                                 is_test=is_test)
+    ctx = layers.matmul(weights, v)  # [B, H, T, head]
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [B, T, d_model])
+    return _dense(ctx, d_model)
+
+
+def encoder_layer(x, num_heads, d_model, d_ff, dropout=0.0, is_test=False,
+                  attn_bias=None):
+    attn = multi_head_attention(x, num_heads, d_model, dropout, is_test,
+                                attn_bias)
+    if dropout and not is_test:
+        attn = layers.dropout(attn, dropout_prob=dropout, is_test=is_test)
+    x = layers.layer_norm(layers.elementwise_add(x, attn),
+                          begin_norm_axis=2)
+    ff = _dense(x, d_ff, act="gelu")
+    ff = _dense(ff, d_model)
+    if dropout and not is_test:
+        ff = layers.dropout(ff, dropout_prob=dropout, is_test=is_test)
+    return layers.layer_norm(layers.elementwise_add(x, ff),
+                             begin_norm_axis=2)
+
+
+def transformer_encoder(src_ids, pos_ids, vocab_size, max_len=512,
+                        num_layers=12, num_heads=12, d_model=768,
+                        d_ff=3072, dropout=0.0, is_test=False,
+                        attn_bias=None):
+    """BERT-style encoder over int64 [B, T] token + position ids.
+    ``attn_bias`` masks padding (additive, broadcastable to
+    [B, H, T, T]); returns [B, T, d_model] encodings."""
+    emb = layers.embedding(src_ids, size=[vocab_size, d_model])
+    pos = layers.embedding(pos_ids, size=[max_len, d_model])
+    x = layers.elementwise_add(emb, pos)
+    x = layers.layer_norm(x, begin_norm_axis=2)
+    for _ in range(num_layers):
+        x = encoder_layer(x, num_heads, d_model, d_ff, dropout, is_test,
+                          attn_bias)
+    return x
+
+
+def bert_base_pretrain(src_ids, pos_ids, masked_positions, vocab_size=30522,
+                       max_len=512, num_layers=12, num_heads=12,
+                       d_model=768, d_ff=3072, dropout=0.0, is_test=False,
+                       attn_bias=None):
+    """Masked-LM head over the encoder: predictions at masked positions.
+    masked_positions: int64 [B, M] token indices into T; ``attn_bias``
+    masks padding as in transformer_encoder."""
+    enc = transformer_encoder(src_ids, pos_ids, vocab_size, max_len,
+                              num_layers, num_heads, d_model, d_ff,
+                              dropout, is_test, attn_bias)
+    B, T, D = enc.shape
+    M = masked_positions.shape[1]
+    flat = layers.reshape(enc, [B * T, D])
+    # flat row index = b*T + position
+    tconst = layers.fill_constant([B, 1], "int64", T)
+    row_base = layers.cumsum(tconst, axis=0, exclusive=True)  # [B,1]: b*T
+    gather_idx = layers.reshape(
+        layers.elementwise_add(masked_positions,
+                               layers.expand(row_base, [1, M])),
+        [B * M])
+    picked = layers.gather(flat, gather_idx)  # [B*M, D]
+    logits = layers.fc(picked, size=vocab_size, num_flatten_dims=1)
+    return layers.reshape(logits, [B, M, vocab_size])

@@ -210,3 +210,61 @@ class TestDataParallelParity:
                                fetch_list=[loss])
                 dp.append(float(np.mean(np.asarray(l))))
         np.testing.assert_allclose(single, dp, rtol=2e-3, atol=2e-4)
+
+
+class TestTransformerModels:
+    def test_tiny_bert_trains(self):
+        from paddle_tpu import models
+
+        B, T, M, V = 2, 16, 4, 50
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            src = fluid.data(name="src", shape=[B, T], dtype="int64")
+            pos = fluid.data(name="pos", shape=[B, T], dtype="int64")
+            mpos = fluid.data(name="mpos", shape=[B, M], dtype="int64")
+            labels = fluid.data(name="labels", shape=[B, M, 1],
+                                dtype="int64")
+            logits = models.bert_base_pretrain(
+                src, pos, mpos, vocab_size=V, max_len=T, num_layers=2,
+                num_heads=4, d_model=32, d_ff=64)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    fluid.layers.reshape(logits, [B * M, V]),
+                    fluid.layers.reshape(labels, [B * M, 1])))
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+        rng = np.random.RandomState(0)
+        feed = {"src": rng.randint(0, V, (B, T)).astype("int64"),
+                "pos": np.tile(np.arange(T), (B, 1)).astype("int64"),
+                "mpos": rng.randint(0, T, (B, M)).astype("int64"),
+                "labels": rng.randint(0, V, (B, M, 1)).astype("int64")}
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = []
+            for i in range(10):
+                (l,) = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_encoder_shapes(self):
+        from paddle_tpu import models
+
+        B, T = 2, 8
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            src = fluid.data(name="src", shape=[B, T], dtype="int64")
+            pos = fluid.data(name="pos", shape=[B, T], dtype="int64")
+            enc = models.transformer_encoder(
+                src, pos, vocab_size=30, max_len=T, num_layers=1,
+                num_heads=2, d_model=16, d_ff=32)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (o,) = exe.run(main, feed={
+                "src": np.zeros((B, T), "int64"),
+                "pos": np.tile(np.arange(T), (B, 1)).astype("int64")},
+                fetch_list=[enc])
+        assert np.asarray(o).shape == (B, T, 16)
