@@ -1,0 +1,5 @@
+"""Distributed launch tooling (reference python/paddle/distributed/).
+
+Import the submodule explicitly (``python -m
+paddle_tpu.distributed.launch``); importing it here would shadow the
+runpy entry point."""

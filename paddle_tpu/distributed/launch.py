@@ -1,0 +1,108 @@
+"""Multi-process training launcher.
+
+Parity: /root/reference/python/paddle/distributed/launch.py:353 — spawn
+one worker process per device/host slot with the PADDLE_TRAINER_*
+environment contract. TPU-native: each worker also gets the
+jax.distributed coordination variables, so dygraph prepare_context /
+the collective fleet initialize over the coordination service instead
+of a NCCL TCP id broadcast.
+
+Usage:  python -m paddle_tpu.distributed.launch --nproc_per_node=2 \
+            train.py --your-args
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+__all__ = ["launch", "get_cluster_env"]
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes on this node")
+    p.add_argument("--ips", default="127.0.0.1",
+                   help="comma-separated node IPs (this node must be "
+                        "included)")
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster_env(node_ips, node_rank, nproc_per_node, started_port,
+                    local_rank):
+    """The PADDLE_* env contract for one worker (reference launch.py:175)."""
+    nnodes = len(node_ips)
+    nranks = nnodes * nproc_per_node
+    rank = node_rank * nproc_per_node + local_rank
+    endpoints = [
+        "%s:%d" % (ip, started_port + i)
+        for ip in node_ips for i in range(nproc_per_node)
+    ]
+    env = {
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nranks),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "FLAGS_selected_tpus": str(local_rank),
+        # jax.distributed contract: coordinator is rank 0's endpoint
+        "JAX_COORDINATOR_ADDRESS": endpoints[0],
+        "JAX_NUM_PROCESSES": str(nranks),
+        "JAX_PROCESS_ID": str(rank),
+    }
+    return env
+
+
+def launch(args=None):
+    args = args if args is not None else _parse_args()
+    node_ips = [ip for ip in args.ips.split(",") if ip]
+    procs = []
+    log_fps = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    try:
+        for local_rank in range(args.nproc_per_node):
+            env = dict(os.environ)
+            env.update(get_cluster_env(node_ips, args.node_rank,
+                                       args.nproc_per_node,
+                                       args.started_port, local_rank))
+            cmd = [sys.executable, "-u", args.training_script] + \
+                list(args.training_script_args)
+            stdout = stderr = None
+            if args.log_dir:
+                fp = open(os.path.join(
+                    args.log_dir, "workerlog.%d" % local_rank), "w")
+                log_fps.append(fp)
+                stdout = stderr = fp
+            procs.append(subprocess.Popen(cmd, env=env, stdout=stdout,
+                                          stderr=stderr))
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        return rc
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait()
+        return 1
+    finally:
+        for fp in log_fps:
+            fp.close()
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

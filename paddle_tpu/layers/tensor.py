@@ -217,11 +217,17 @@ def range(start, end, step, dtype="float32"):
         return fill_constant([1], dtype, v)
 
     out = helper.create_variable_for_type_inference(dtype)
+    if all(isinstance(v, (int, float)) for v in (start, end, step)) \
+            and step != 0:
+        import math
+
+        out.shape = (max(0, int(math.ceil((end - start) / step))),)
     helper.append_op(
         "range",
         inputs={"Start": [_to_var(start)], "End": [_to_var(end)],
                 "Step": [_to_var(step)]},
         outputs={"Out": [out]},
+        infer_shape=False,
     )
     return out
 

@@ -39,9 +39,10 @@ def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
     if attn_bias is not None:
         scores = layers.elementwise_add(scores, attn_bias)
     weights = layers.softmax(scores)
-    if dropout and not is_test:
+    if dropout:
         weights = layers.dropout(weights, dropout_prob=dropout,
-                                 is_test=is_test)
+                                 is_test=is_test,
+                                 dropout_implementation="upscale_in_train")
     ctx = layers.matmul(weights, v)  # [B, H, T, head]
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [B, T, d_model])
@@ -52,14 +53,16 @@ def encoder_layer(x, num_heads, d_model, d_ff, dropout=0.0, is_test=False,
                   attn_bias=None):
     attn = multi_head_attention(x, num_heads, d_model, dropout, is_test,
                                 attn_bias)
-    if dropout and not is_test:
-        attn = layers.dropout(attn, dropout_prob=dropout, is_test=is_test)
+    if dropout:
+        attn = layers.dropout(attn, dropout_prob=dropout, is_test=is_test,
+                              dropout_implementation="upscale_in_train")
     x = layers.layer_norm(layers.elementwise_add(x, attn),
                           begin_norm_axis=2)
     ff = _dense(x, d_ff, act="gelu")
     ff = _dense(ff, d_model)
-    if dropout and not is_test:
-        ff = layers.dropout(ff, dropout_prob=dropout, is_test=is_test)
+    if dropout:
+        ff = layers.dropout(ff, dropout_prob=dropout, is_test=is_test,
+                            dropout_implementation="upscale_in_train")
     return layers.layer_norm(layers.elementwise_add(x, ff),
                              begin_norm_axis=2)
 
@@ -95,8 +98,8 @@ def bert_base_pretrain(src_ids, pos_ids, masked_positions, vocab_size=30522,
     M = masked_positions.shape[1]
     flat = layers.reshape(enc, [B * T, D])
     # flat row index = b*T + position
-    tconst = layers.fill_constant([B, 1], "int64", T)
-    row_base = layers.cumsum(tconst, axis=0, exclusive=True)  # [B,1]: b*T
+    row_base = layers.reshape(
+        layers.range(0, B * T, T, "int64"), [B, 1])
     gather_idx = layers.reshape(
         layers.elementwise_add(masked_positions,
                                layers.expand(row_base, [1, M])),

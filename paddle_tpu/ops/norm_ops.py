@@ -64,8 +64,23 @@ def _batch_norm(ins, attrs):
         saved_inv_std = jax.lax.rsqrt(var + eps)
         mean_out, var_out = mean, var
     else:
-        use_mean = jnp.mean(x, axis=red_axes)
-        use_var = jnp.mean(jnp.square(x - use_mean.reshape(bshape)), axis=red_axes)
+        # sync-BN (reference sync_batch_norm_op.cu / sync_batch_norm_pass):
+        # when marked and running inside a mapped mesh axis, batch
+        # statistics average across the axis before normalization
+        axis_name = None
+        if attrs.get("_sync_stats"):
+            from .collective_ops import axis_for_ring
+
+            axis_name = axis_for_ring(attrs.get("_sync_ring_id", 0))
+        if axis_name is not None:
+            local_mean = jnp.mean(x, axis=red_axes)
+            local_sq = jnp.mean(jnp.square(x), axis=red_axes)
+            use_mean = jax.lax.pmean(local_mean, axis_name)
+            use_var = jax.lax.pmean(local_sq, axis_name) -                 jnp.square(use_mean)
+        else:
+            use_mean = jnp.mean(x, axis=red_axes)
+            use_var = jnp.mean(jnp.square(x - use_mean.reshape(bshape)),
+                               axis=red_axes)
         saved_mean = use_mean
         saved_inv_std = jax.lax.rsqrt(use_var + eps)
         mean_out = mean * momentum + use_mean * (1 - momentum)

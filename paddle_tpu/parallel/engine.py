@@ -59,10 +59,15 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     mesh = mesh or default_mesh(len(places) if places else None, axis_name)
     nranks = int(np.prod(list(mesh.shape.values())))
 
+    sync_bn = bool(build_strategy is not None and getattr(
+        build_strategy, "sync_batch_norm", False))
     # collective rewrite (insert_allreduce_ops is itself idempotent
     # per program — fleet may have transpiled already)
     if nranks > 1:
         insert_allreduce_ops(program, nranks)
+        from .transpiler import mark_sync_batch_norm
+
+        mark_sync_batch_norm(program, sync_bn)
 
     fetch_names = tuple(f if isinstance(f, str) else f.name
                         for f in fetch_list)
@@ -85,7 +90,7 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     out_state_names = tuple(sorted(set(state_names) | persist_written))
 
     key = (_program_version(program), feed_names, fetch_names, state_names,
-           out_state_names, id(mesh), axis_name)
+           out_state_names, id(mesh), axis_name, sync_bn)
     fn = _dp_cache.get(key)
     if fn is None:
         def shard_step(state_d, feeds_d, seed):

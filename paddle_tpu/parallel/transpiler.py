@@ -87,3 +87,18 @@ def insert_local_sgd_ops(program, nranks: int, k_steps: int = 1,
         sc._id = program._next_op_id()
         block.ops.append(sc)
     return params
+
+
+def mark_sync_batch_norm(program, enable=True):
+    """BuildStrategy.sync_batch_norm: tag batch_norm ops so their batch
+    statistics pmean across the mesh axis (reference
+    ir/sync_batch_norm_pass.cc rewriting batch_norm -> sync_batch_norm).
+    Applies the CURRENT strategy value each call (the engine keys its
+    compile cache on it, so flipping the knob between runs retraces)."""
+    if getattr(program, "_sync_bn_marked", None) == enable:
+        return
+    program._sync_bn_marked = enable
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type == "batch_norm":
+                op.attrs["_sync_stats"] = bool(enable)

@@ -246,3 +246,64 @@ class TestWideDeep:
                 losses.append(float(np.asarray(l).ravel()[0]))
         assert all(np.isfinite(losses))
         assert losses[-1] < 0.8 * losses[0], (losses[0], losses[-1])
+
+
+class TestLaunch:
+    def test_env_contract(self):
+        from paddle_tpu.distributed.launch import get_cluster_env
+
+        env = get_cluster_env(["10.0.0.1", "10.0.0.2"], 1, 2, 6170, 1)
+        assert env["PADDLE_TRAINER_ID"] == "3"
+        assert env["PADDLE_TRAINERS_NUM"] == "4"
+        assert env["PADDLE_CURRENT_ENDPOINT"] == "10.0.0.2:6171"
+        assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:6170"
+        assert env["JAX_PROCESS_ID"] == "3"
+
+    def test_spawns_workers(self, tmp_path):
+        import subprocess
+        import sys
+
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os; print('R%s' % os.environ['PADDLE_TRAINER_ID'])")
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=2", str(script)],
+            capture_output=True, text=True, timeout=60,
+            cwd="/root/repo").stdout
+        assert "R0" in out and "R1" in out
+
+
+class TestSyncBatchNorm:
+    def test_sharded_stats_match_global(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 (virtual) devices")
+        B = 32
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[B, 4, 6, 6], dtype="float32")
+            y = fluid.layers.batch_norm(x)
+            loss = fluid.layers.mean(fluid.layers.elementwise_mul(y, y))
+            fluid.optimizer.SGD(0.0).minimize(loss)
+        rng = np.random.RandomState(0)
+        xb = (rng.randn(B, 4, 6, 6)
+              * np.arange(1, B + 1).reshape(B, 1, 1, 1)).astype("float32")
+        bs = fluid.BuildStrategy()
+        bs.sync_batch_norm = True
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup)
+            compiled = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, build_strategy=bs)
+            (y_dp,) = exe.run(compiled, feed={"x": xb}, fetch_list=[y])
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe2 = fluid.Executor(fluid.TPUPlace())
+            exe2.run(startup)
+            (y_single,) = exe2.run(main, feed={"x": xb}, fetch_list=[y])
+        y_dp2 = np.asarray(y_dp).reshape(-1, 4, 6, 6)[:B]
+        np.testing.assert_allclose(y_dp2, np.asarray(y_single),
+                                   rtol=2e-4, atol=2e-5)
