@@ -77,6 +77,19 @@ class RNGState:
         self.step += 1
 
 
+_profiler_cache = []
+
+
+def _profiler_module():
+    """Lazy module ref (a top-level import would be circular; importing
+    per run_op call would tax the interpreter hot loop)."""
+    if not _profiler_cache:
+        from .. import profiler
+
+        _profiler_cache.append(profiler)
+    return _profiler_cache[0]
+
+
 class CoreExecutor:
     def __init__(self, place):
         self.place = place
@@ -115,6 +128,13 @@ class CoreExecutor:
     # -- op execution -----------------------------------------------------
 
     def run_op(self, op, scope: Scope):
+        prof = _profiler_module()
+        if prof.is_profiler_enabled():
+            with prof.record_event(op.type):
+                return self._run_op_impl(op, scope)
+        return self._run_op_impl(op, scope)
+
+    def _run_op_impl(self, op, scope: Scope):
         info = OpInfoMap.instance().get(op.type)
 
         if getattr(info, "host_fn", None) is not None:

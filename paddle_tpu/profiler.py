@@ -20,6 +20,8 @@ __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
            "stop_profiler"]
 
 _host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_trace_events = []  # (name, t0_us, dur_us) — chrome-trace export
+_last_trace = []  # snapshot of the finished session (stop clears live)
 _enabled = False
 _trace_dir = None
 
@@ -36,9 +38,12 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         if _enabled:
+            dur = time.perf_counter() - self._t0
             ev = _host_events[self.name]
             ev[0] += 1
-            ev[1] += time.perf_counter() - self._t0
+            ev[1] += dur
+            _trace_events.append(
+                (self.name, self._t0 * 1e6, dur * 1e6))
         return False
 
 
@@ -50,8 +55,16 @@ def is_profiler_enabled():
     return _enabled
 
 
+def get_trace_events():
+    """(name, ts_us, dur_us) host events for timeline export: the live
+    session while profiling, else the last finished session's snapshot
+    (stop_profiler clears live state so sessions never bleed)."""
+    return list(_trace_events) if _enabled else list(_last_trace)
+
+
 def reset_profiler():
     _host_events.clear()
+    del _trace_events[:]
 
 
 def start_profiler(state="All", tracer_option=None, trace_dir=None):
@@ -77,6 +90,12 @@ def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
         for name, (count, total) in rows[:50]:
             print("%-40s %10d %14.3f %14.3f"
                   % (name, count, total * 1e3, total * 1e3 / max(count, 1)))
+    # snapshot-and-clear so back-to-back sessions never bleed into each
+    # other (the reference's DisableProfiler resets after emitting)
+    del _last_trace[:]
+    _last_trace.extend(_trace_events)
+    del _trace_events[:]
+    _host_events.clear()
 
 
 @contextlib.contextmanager
