@@ -284,21 +284,19 @@ def run_compiled_program(core, program, scope: Scope, feed: Dict,
 
     fn = compile_program(program, feed_names, fetch_names, state_names,
                          out_state_names)
+    import contextlib
+
     from ..profiler import is_profiler_enabled, record_event
 
-    with jax.default_device(core.place.jax_device()):
-        if is_profiler_enabled():
-            # compiled path = ONE fused dispatch: a single step-level
-            # host event (per-op detail lives in the XPlane device
-            # trace; the op-by-op interpreter records per-op events)
-            with record_event("compiled_step"):
-                fetches, new_state = fn(state, feed_vals, jnp.uint32(
-                    core.rng.next_seed(0)
-                    ^ (core.rng.step * 2654435761 & 0xFFFFFFFF)))
-        else:
-            fetches, new_state = fn(state, feed_vals, jnp.uint32(
-                core.rng.next_seed(0)
-                ^ (core.rng.step * 2654435761 & 0xFFFFFFFF)))
+    # compiled path = ONE fused dispatch: a single step-level host event
+    # (per-op detail lives in the XPlane device trace; the op-by-op
+    # interpreter records per-op events)
+    ev = record_event("compiled_step") if is_profiler_enabled() \
+        else contextlib.nullcontext()
+    with jax.default_device(core.place.jax_device()), ev:
+        fetches, new_state = fn(state, feed_vals, jnp.uint32(
+            core.rng.next_seed(0)
+            ^ (core.rng.step * 2654435761 & 0xFFFFFFFF)))
     core.rng.advance()
 
     for n, v in new_state.items():

@@ -89,7 +89,14 @@ class Executor:
                     # e.g. a while carry whose shape/dtype varies across
                     # trips — valid for the host interpreter, untraceable
                     # for lax.while_loop. Remember so later steps skip
-                    # the doomed trace attempt.
+                    # the doomed trace attempt — and SAY so: this is a
+                    # large perf cliff that must not be silent.
+                    import warnings
+
+                    warnings.warn(
+                        "program %s falls back to op-by-op "
+                        "interpretation (whole-program compile failed: "
+                        "%r)" % (program._uid, e))
                     self._compile_fallbacks[ver] = repr(e)
         return self._core.run_program(program, scope, feed, fetch_list,
                                       return_numpy)

@@ -291,13 +291,27 @@ def infer_op_shapes(block: Block, op: Operator) -> None:
         ins[slot.name] = metas if slot.duplicable else metas[0]
 
     attrs = dict(op.attrs)
-    if info.needs_lod:
+    if info.needs_lod and info.infer_shape is None:
+        # LoD-dependent output shapes are runtime information (they vary
+        # with the fed sequence lengths); running the kernel for
+        # eval_shape would raise. Default every float output to
+        # [-1, trailing dims of the first input].
+        first = None
         for slot in info.inputs:
             names = op.input(slot.name)
-            lods = tuple(
-                ((),) * block.var(n).lod_level for n in names
-            )
-            attrs.setdefault("_lod_" + slot.name, None)
+            if names:
+                first = block._find_var_recursive(names[0])
+                break
+        for slot in info.outputs:
+            for n in op.output(slot.name):
+                v = block._find_var_recursive(n) or block.create_var(name=n)
+                if v.shape is None and first is not None \
+                        and first.shape is not None:
+                    v.shape = (-1,) + tuple(first.shape[1:])
+                    if v.dtype is None:
+                        v.dtype = first.dtype
+                v.op = op
+        return
     from .core.registry import BOUND_OUTPUTS_ATTR, RNG_SEED_ATTR
 
     attrs[BOUND_OUTPUTS_ATTR] = tuple(

@@ -12,6 +12,8 @@ from .control_flow import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .sequence_lod import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .io import data  # noqa: F401
 from . import math_op_patch  # noqa: F401  (patches Variable operators)
@@ -24,6 +26,8 @@ from .control_flow import __all__ as _cf_all
 from .metric_op import __all__ as _metric_all
 from .sequence_lod import __all__ as _seq_all
 from .rnn import __all__ as _rnn_all
+from .learning_rate_scheduler import __all__ as _lrs_all
+from .extras import __all__ as _extras_all
 from .detection import __all__ as _det_all
 
 __all__ = (
@@ -37,4 +41,6 @@ __all__ = (
     + _seq_all
     + _rnn_all
     + _det_all
+    + _lrs_all
+    + _extras_all
 )

@@ -1,0 +1,623 @@
+"""Wave-3 layer APIs.
+
+Parity: the remaining single-op wrappers and small compositions from
+/root/reference/python/paddle/fluid/layers/ (nn.py, loss.py, tensor.py,
+control_flow.py, detection.py) — each docstring names its op/source.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "reverse", "pixel_shuffle", "shuffle_channel", "space_to_depth",
+    "temporal_shift", "shard_index", "multiplex", "crop", "crop_tensor",
+    "affine_channel", "unfold", "affine_grid", "selu", "mean_iou",
+    "bilinear_tensor_product", "cos_sim", "bpr_loss",
+    "teacher_student_sigmoid_loss", "sigmoid_focal_loss", "row_conv",
+    "fsp_matrix", "hash", "unique", "edit_distance", "warpctc",
+    "ctc_greedy_decoder", "rank", "size", "is_empty", "sum",
+    "scatter_nd", "pad_constant_like", "add_position_encoding",
+    "dice_loss", "npair_loss", "while_loop", "case", "switch_case",
+    "gru_unit", "lstm_unit", "py_func", "double_buffer",
+    "image_resize_short", "gaussian_random_batch_size_like",
+    "sequence_reverse", "get_tensor_from_selected_rows",
+    "merge_selected_rows", "lod_reset",
+]
+
+
+def _one_out(op_type, inputs, attrs=None, dtype=None, out_slot="Out",
+             ref=None):
+    helper = LayerHelper(op_type, input=ref)
+    out = helper.create_variable_for_type_inference(
+        dtype or (ref.dtype if ref is not None else "float32"))
+    helper.append_op(op_type, inputs=inputs, outputs={out_slot: [out]},
+                     infer_shape=False)
+    return out, helper
+
+
+def _simple(op_type, x, attrs=None, dtype=None, out_slot="Out"):
+    helper = LayerHelper(op_type, input=x)
+    out = helper.create_variable_for_type_inference(dtype or x.dtype)
+    helper.append_op(op_type, inputs={"X": [x]},
+                     outputs={out_slot: [out]}, attrs=attrs or {},
+                     infer_shape=False)
+    return out
+
+
+def reverse(x, axis):
+    return _simple("reverse", x, {"axis": axis if isinstance(
+        axis, (list, tuple)) else [axis]})
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _simple("pixel_shuffle", x, {"upscale_factor": upscale_factor})
+
+
+def shuffle_channel(x, group, name=None):
+    return _simple("shuffle_channel", x, {"group": group})
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _simple("space_to_depth", x, {"blocksize": blocksize})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _simple("temporal_shift", x, {"seg_num": seg_num,
+                                         "shift_ratio": shift_ratio})
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _simple("shard_index", input,
+                   {"index_num": index_num, "nshards": nshards,
+                    "shard_id": shard_id, "ignore_value": ignore_value})
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex", input=inputs[0])
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op("multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, framework.Variable):
+        inputs["Y"] = [shape]
+    else:
+        attrs["shape"] = list(shape or [])
+    attrs["offsets"] = list(offsets or [])
+    helper.append_op("crop", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs, infer_shape=False)
+    return out
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    return crop(x, shape, offsets, name)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    from .tensor import fill_constant
+
+    helper = LayerHelper("affine_channel", input=x)
+    c = int(x.shape[1 if data_layout == "NCHW" else -1])
+    if scale is None:
+        scale = fill_constant([c], x.dtype, 1.0)
+    if bias is None:
+        bias = fill_constant([c], x.dtype, 0.0)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("affine_channel",
+                     inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
+                     outputs={"Out": [out]},
+                     attrs={"data_layout": data_layout},
+                     infer_shape=False)
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    pads = paddings if isinstance(paddings, (list, tuple)) and \
+        len(paddings) == 4 else _pair(paddings) * 2
+    return _simple("unfold", x,
+                   {"kernel_sizes": _pair(kernel_sizes),
+                    "strides": _pair(strides),
+                    "paddings": list(pads),
+                    "dilations": _pair(dilations)}, out_slot="Y")
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper("affine_grid", input=theta)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    inputs = {"Theta": [theta]}
+    attrs = {}
+    if isinstance(out_shape, framework.Variable):
+        inputs["OutputShape"] = [out_shape]
+    else:
+        attrs["output_shape"] = [int(v) for v in out_shape]
+    helper.append_op("affine_grid", inputs=inputs,
+                     outputs={"Output": [out]}, attrs=attrs,
+                     infer_shape=False)
+    return out
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = scale
+    if alpha is not None:
+        attrs["alpha"] = alpha
+    return _simple("selu", x, attrs)
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou", input=input)
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op("mean_iou",
+                     inputs={"Predictions": [input], "Labels": [label]},
+                     outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                              "OutCorrect": [correct]},
+                     attrs={"num_classes": num_classes},
+                     infer_shape=False)
+    return miou, wrong, correct
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", input=x,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    dtype = helper.input_dtype()
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[size, int(x.shape[1]), int(y.shape[1])], dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[1, size], dtype=dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    helper.append_op("bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper("cos_sim", input=X)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op("cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]},
+                     infer_shape=False)
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    helper = LayerHelper("bpr_loss", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("bpr_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]}, infer_shape=False)
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    helper = LayerHelper("teacher_student_sigmoid_loss", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("teacher_student_sigmoid_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_max_up_bound": soft_max_up_bound,
+                            "soft_max_lower_bound": soft_max_lower_bound},
+                     infer_shape=False)
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    helper = LayerHelper("sigmoid_focal_loss", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sigmoid_focal_loss",
+                     inputs={"X": [x], "Label": [label],
+                             "FgNum": [fg_num]},
+                     outputs={"Out": [out]},
+                     attrs={"gamma": gamma, "alpha": alpha},
+                     infer_shape=False)
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", input=input,
+                         param_attr=param_attr)
+    dtype = helper.input_dtype()
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[future_context_size + 1, int(input.shape[-1])],
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("row_conv",
+                     inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def fsp_matrix(x, y):
+    helper = LayerHelper("fsp", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fsp", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _simple("hash", input, {"mod_by": hash_size,
+                                   "num_hash": num_hash}, dtype="int64")
+
+
+def unique(x, dtype="int32"):
+    helper = LayerHelper("unique", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("unique", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index]},
+                     infer_shape=False)
+    return out, index
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    helper = LayerHelper("edit_distance", input=input)
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    helper.append_op("edit_distance",
+                     inputs={"Hyps": [input], "Refs": [label]},
+                     outputs={"Out": [out], "SequenceNum": [seq_num]},
+                     attrs={"normalized": normalized},
+                     infer_shape=False)
+    return out, seq_num
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    helper = LayerHelper("warpctc", input=input)
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("warpctc",
+                     inputs={"Logits": [input], "Label": [label]},
+                     outputs={"Loss": [loss]},
+                     attrs={"blank": blank,
+                            "norm_by_times": norm_by_times},
+                     infer_shape=False)
+    loss.shape = (int(input.shape[0]) if len(input.shape) == 3 else 1, 1)
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """argmax over classes then CTC alignment (reference
+    ctc_greedy_decoder = top_k + ctc_align)."""
+    from .nn import argmax
+
+    ids = argmax(input, axis=-1)
+    helper = LayerHelper("ctc_align", input=input)
+    out = helper.create_variable_for_type_inference("int64")
+    out.lod_level = 1
+    helper.append_op("ctc_align", inputs={"Input": [ids]},
+                     outputs={"Output": [out]},
+                     attrs={"blank": blank, "merge_repeated": True},
+                     infer_shape=False)
+    return out
+
+
+def rank(input):
+    """Static rank as a constant tensor (reference layers/nn.py rank)."""
+    from .tensor import fill_constant
+
+    return fill_constant([1], "int32", len(input.shape))
+
+
+def size(input):
+    from .tensor import fill_constant
+
+    return fill_constant([1], "int64", int(np.prod(input.shape)))
+
+
+def is_empty(x, cond=None):
+    from .control_flow import less_than
+    from .tensor import fill_constant
+
+    # numel == 0 is static here; emit the constant
+    return fill_constant([1], "bool",
+                         bool(int(np.prod(x.shape or (0,))) == 0))
+
+
+def sum(x):
+    """Elementwise sum of a LIST of tensors (reference layers.sum ->
+    sum op; distinct from reduce_sum)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    helper = LayerHelper("sum", input=xs[0])
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op("sum", inputs={"X": list(xs)},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """zeros(shape) with updates scattered (reference scatter_nd =
+    scatter_nd_add onto zeros)."""
+    from .nn import scatter_nd_add
+    from .tensor import fill_constant
+
+    zero = fill_constant(list(shape), updates.dtype, 0.0)
+    return scatter_nd_add(zero, index, updates)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y to x's shape (reference pad_constant_like_op)."""
+    from .nn import pad
+
+    paddings = []
+    for xs, ys in zip(x.shape, y.shape):
+        paddings.extend([0, int(xs) - int(ys)])
+    return pad(y, paddings, pad_value)
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """Sinusoidal position encoding added in-graph (reference
+    add_position_encoding_op)."""
+    from . import tensor as lt
+    from .nn import elementwise_add
+    from .ops import scale
+
+    T, D = int(input.shape[1]), int(input.shape[2])
+    pos = np.arange(T)[:, None]
+    dim = np.arange(D // 2)[None, :]
+    inv = 1.0 / np.power(10000.0, 2 * dim / D)
+    enc = np.zeros((T, D), np.float32)
+    enc[:, 0::2] = np.sin(pos * inv)
+    enc[:, 1::2] = np.cos(pos * inv)
+    # [1, T, D]: broadcast over the (possibly dynamic) batch dim
+    enc_var = lt.assign(enc[None])
+    return elementwise_add(scale(input, scale=alpha),
+                           scale(enc_var, scale=beta))
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """(reference layers/nn.py dice_loss composition)."""
+    from .nn import reduce_sum
+    from .ops import scale
+    from .tensor import cast
+
+    label_f = cast(label, input.dtype)
+    inter = reduce_sum(input * label_f)
+    union = reduce_sum(input) + reduce_sum(label_f)
+    dice = scale(inter, 2.0) / (union + epsilon)
+    return scale(dice, -1.0, bias=1.0)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """(reference layers/loss.py npair_loss composition)."""
+    from .loss import softmax_with_cross_entropy
+    from .nn import matmul, reduce_mean, reduce_sum, transpose
+    from .ops import scale
+    from .tensor import cast
+
+    reg = reduce_mean(reduce_sum(anchor * anchor, dim=1)) + \
+        reduce_mean(reduce_sum(positive * positive, dim=1))
+    sim = matmul(anchor, transpose(positive, [1, 0]))
+    n = int(anchor.shape[0])
+    lab = cast(labels, "int64")
+    from .nn import reshape
+
+    ce = softmax_with_cross_entropy(sim, reshape(lab, [n, 1]))
+    return reduce_mean(ce) + scale(reg, l2_reg / 2.0)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Functional while (reference layers/control_flow.py while_loop)
+    built on the While op: loop vars thread through assigns."""
+    from .control_flow import While
+    from .tensor import assign
+
+    c = cond(*loop_vars)
+    w = While(c)
+    with w.block():
+        new_vars = body(*loop_vars)
+        if not isinstance(new_vars, (list, tuple)):
+            new_vars = [new_vars]
+        for old, new in zip(loop_vars, new_vars):
+            assign(new, output=old)
+        assign(cond(*loop_vars), output=c)
+    return list(loop_vars)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-true-wins select chain (reference layers/control_flow.py
+    case; both branches evaluate — XLA select semantics)."""
+    outs = None
+    sel = None
+    helper = LayerHelper("case")
+    result = None
+    if default is None:
+        raise ValueError("case requires a default fn here")
+    result = default()
+    for pred, fn in reversed(pred_fn_pairs):
+        val = fn()
+        out = helper.create_variable_for_type_inference(val.dtype)
+        helper.append_op("where",
+                         inputs={"Condition": [pred], "X": [val],
+                                 "Y": [result]},
+                         outputs={"Out": [out]}, infer_shape=False)
+        result = out
+    return result
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    from .control_flow import equal  # noqa: F401
+    from .tensor import fill_constant
+
+    pairs = []
+    helper = LayerHelper("switch_case")
+    for idx, fn in (branch_fns.items() if isinstance(branch_fns, dict)
+                    else enumerate(branch_fns)):
+        iconst = fill_constant([1], branch_index.dtype, int(idx))
+        eq = helper.create_variable_for_type_inference("bool")
+        helper.append_op("equal",
+                         inputs={"X": [branch_index], "Y": [iconst]},
+                         outputs={"Out": [eq]}, infer_shape=False)
+        pairs.append((eq, fn))
+    return case(pairs, default=default or pairs[-1][1])
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """(reference layers/rnn.py gru_unit over the gru_unit op)."""
+    helper = LayerHelper("gru_unit", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dtype = helper.input_dtype()
+    d = size // 3
+    w = helper.create_parameter(attr=helper.param_attr, shape=[d, 3 * d],
+                                dtype=dtype)
+    inputs = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[1, 3 * d], dtype=dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    gate = helper.create_variable_for_type_inference(dtype)
+    rhp = helper.create_variable_for_type_inference(dtype)
+    hid = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gru_unit", inputs=inputs,
+                     outputs={"Gate": [gate], "ResetHiddenPrev": [rhp],
+                              "Hidden": [hid]},
+                     attrs={"origin_mode": origin_mode},
+                     infer_shape=False)
+    return hid, rhp, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """(reference layers/rnn.py lstm_unit: fc + lstm_unit op)."""
+    from .nn import concat, fc
+
+    helper = LayerHelper("lstm_unit", input=x_t)
+    d = int(cell_t_prev.shape[-1])
+    merged = concat([x_t, hidden_t_prev], axis=1)
+    gates = fc(merged, size=4 * d, param_attr=param_attr,
+               bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op("lstm_unit",
+                     inputs={"X": [gates], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [h]},
+                     attrs={"forget_bias": forget_bias},
+                     infer_shape=False)
+    return h, c
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python escape hatch (reference py_func_op.cc). Forward-only
+    here: the callable runs on host inside the interpreter; programs
+    containing it never whole-compile."""
+    from ..core.registry import In, OpInfoMap, Out, register_host_op
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    op_type = framework.unique_name.generate("py_func")
+
+    def host_impl(executor, op, scope, _fn=func):
+        vals = [np.asarray(executor._read_var(scope, n))
+                for n in op.input("X")]
+        res = _fn(*vals)
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        for n, v in zip(op.output("Out"), res):
+            executor._write_var(scope, n, np.asarray(v))
+
+    register_host_op(op_type, inputs=[In("X", duplicable=True,
+                                         no_grad=True)],
+                     outputs=[Out("Out", duplicable=True)])(host_impl)
+    helper = LayerHelper("py_func")
+    helper.append_op(op_type, inputs={"X": list(xs)},
+                     outputs={"Out": list(outs)}, infer_shape=False)
+    return outs if isinstance(out, (list, tuple)) else outs[0]
+
+
+def double_buffer(reader, place=None, name=None):
+    """Device double-buffering is built into DataLoader
+    (use_double_buffer=True); graph-side this is identity."""
+    return reader
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    from .nn import image_resize
+
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    scale = out_short_len / float(short)
+    return image_resize(input, out_shape=[int(round(h * scale)),
+                                          int(round(w * scale))],
+                        resample=resample)
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    from .nn import gaussian_random
+
+    shape = list(shape)
+    shape[0] = int(input.shape[0])
+    return gaussian_random(shape, mean=mean, std=std, seed=seed,
+                           dtype=dtype)
+
+
+def sequence_reverse(x, name=None):
+    """Reverse each sequence (LoD) — needs_lod op composition via the
+    reverse op on equal-length, else host path."""
+    helper = LayerHelper("sequence_reverse", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = getattr(x, "lod_level", 0)
+    helper.append_op("sequence_reverse", inputs={"X": [x]},
+                     outputs={"Y": [out]}, infer_shape=False)
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return _simple("get_tensor_from_selected_rows", x)
+
+
+def merge_selected_rows(x, name=None):
+    helper = LayerHelper("merge_selected_rows", input=x)
+    out = helper.main_program.current_block().create_var(
+        name=framework.unique_name.generate("merged_sr"),
+        type="selected_rows", dtype=x.dtype)
+    helper.append_op("merge_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Re-stamp a tensor's LoD (reference lod_reset_op)."""
+    helper = LayerHelper("lod_reset", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = 1
+    inputs = {"X": [x]}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = [y]
+    else:
+        attrs["target_lod"] = [int(v) for v in (target_lod or [])]
+    helper.append_op("lod_reset", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs, infer_shape=False)
+    out.shape = tuple(x.shape)
+    return out

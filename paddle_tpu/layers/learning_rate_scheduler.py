@@ -1,0 +1,197 @@
+"""In-graph learning-rate schedules.
+
+Parity: /root/reference/python/paddle/fluid/layers/
+learning_rate_scheduler.py (noam/exponential/natural_exp/inverse_time/
+polynomial/piecewise/cosine decay + linear_lr_warmup). Each builds a
+small op subgraph reading the auto-incremented global step counter, so
+the schedule runs inside the compiled step like everything else.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import framework
+from ..layer_helper import LayerHelper
+from . import ops as layers_ops
+from . import tensor as layers_tensor
+
+__all__ = [
+    "autoincreased_step_counter",
+    "noam_decay",
+    "exponential_decay",
+    "natural_exp_decay",
+    "inverse_time_decay",
+    "polynomial_decay",
+    "piecewise_decay",
+    "cosine_decay",
+    "linear_lr_warmup",
+]
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable int64 counter incremented once per executed step
+    (reference layers/tensor.py autoincreased_step_counter)."""
+    helper = LayerHelper("global_step_counter")
+    counter = layers_tensor.create_global_var(
+        name=counter_name or framework.unique_name.generate(
+            "@LR_DECAY_COUNTER@"),
+        shape=[1], value=float(begin - step), dtype="int64",
+        persistable=True)
+    helper.append_op(
+        "increment", inputs={"X": [counter]}, outputs={"Out": [counter]},
+        attrs={"step": float(step)}, infer_shape=False)
+    counter.stop_gradient = True
+    return counter
+
+
+def _step_f32():
+    return layers_tensor.cast(autoincreased_step_counter(), "float32")
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    from .nn import elementwise_min
+    from .ops import pow as pow_layer
+
+    step = _step_f32()
+    a = pow_layer(step, factor=-0.5)
+    b = _scale(step, float(warmup_steps) ** -1.5)
+    return _scale(elementwise_min(a, b),
+                  float(learning_rate) * float(d_model) ** -0.5)
+
+
+def _scale(x, s, bias=0.0):
+    from .ops import scale
+
+    return scale(x, scale=float(s), bias=float(bias))
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _step_f32()
+    exponent = _scale(step, 1.0 / decay_steps)
+    if staircase:
+        from .ops import floor
+
+        exponent = floor(exponent)
+    return _scale(_pow_const(decay_rate, exponent), learning_rate)
+
+
+def _pow_const(base, exponent):
+    """base ** exponent with a scalar python base."""
+    from .ops import exp, scale
+
+    return exp(scale(exponent, scale=math.log(base)))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _step_f32()
+    exponent = _scale(step, 1.0 / decay_steps)
+    if staircase:
+        from .ops import floor
+
+        exponent = floor(exponent)
+    from .ops import exp
+
+    return _scale(exp(_scale(exponent, -decay_rate)), learning_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    step = _step_f32()
+    ratio = _scale(step, 1.0 / decay_steps)
+    if staircase:
+        from .ops import floor
+
+        ratio = floor(ratio)
+    from .nn import elementwise_div
+
+    denom = _scale(ratio, decay_rate, bias=1.0)
+    one = layers_tensor.fill_constant([1], "float32", float(learning_rate))
+    return elementwise_div(one, denom)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _step_f32()
+    from .nn import elementwise_div, elementwise_min
+
+    if cycle:
+        from .ops import ceil
+
+        div = ceil(_scale(step, 1.0 / decay_steps))
+        # avoid zero on step 0
+        decay_steps_var = _scale(div, float(decay_steps))
+        capped = step
+    else:
+        decay_steps_var = layers_tensor.fill_constant(
+            [1], "float32", float(decay_steps))
+        capped = elementwise_min(
+            step, layers_tensor.fill_constant([1], "float32",
+                                              float(decay_steps)))
+    frac = elementwise_div(capped, decay_steps_var)
+    one_minus = _scale(frac, -1.0, bias=1.0)
+    poly = _pow_var(one_minus, power)
+    return _scale(poly, learning_rate - end_learning_rate,
+                  bias=end_learning_rate)
+
+
+def _pow_var(x, p):
+    from .ops import pow as pow_layer
+
+    return pow_layer(x, factor=float(p))
+
+
+def piecewise_decay(boundaries, values):
+    """Stepwise LR via nested where-selects (reference builds
+    conditional blocks; a select chain is the compile-friendly form)."""
+    if len(values) - len(boundaries) != 1:
+        raise ValueError("len(values) must be len(boundaries)+1")
+    step = _step_f32()
+    from .tensor import fill_constant
+
+    lr = fill_constant([1], "float32", float(values[-1]))
+    # build from the last boundary backwards: step < b -> values[i]
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        cond = less_than_scalar(step, float(b))
+        vconst = fill_constant([1], "float32", float(v))
+        lr = _select(cond, vconst, lr)
+    return lr
+
+
+def less_than_scalar(x, v):
+    from .control_flow import less_than
+    from .tensor import fill_constant
+
+    return less_than(x, fill_constant([1], x.dtype, float(v)))
+
+
+def _select(cond, a, b):
+    helper = LayerHelper("where", input=a)
+    out = helper.create_variable_for_type_inference(a.dtype)
+    helper.append_op("where", inputs={"Condition": [cond], "X": [a],
+                                      "Y": [b]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    from .ops import cos, floor
+
+    step = _step_f32()
+    epoch = floor(_scale(step, 1.0 / step_each_epoch))
+    cosv = cos(_scale(epoch, math.pi / epochs))
+    return _scale(_scale(cosv, 0.5, bias=0.5), learning_rate)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    step = _step_f32()
+    from .tensor import fill_constant
+
+    warm = _scale(step, (end_lr - start_lr) / float(warmup_steps),
+                  bias=start_lr)
+    cond = less_than_scalar(step, float(warmup_steps))
+    if isinstance(learning_rate, (float, int)):
+        learning_rate = fill_constant([1], "float32",
+                                      float(learning_rate))
+    return _select(cond, warm, learning_rate)

@@ -26,3 +26,4 @@ from . import detection_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import amp_ops  # noqa: F401
 from . import distributed_ops  # noqa: F401
+from . import misc_ops  # noqa: F401

@@ -1,0 +1,527 @@
+"""Wave-3 ops: tensor rearrangement, vision utilities, losses, CTC.
+
+Parity targets (reference /root/reference/paddle/fluid/operators/):
+pixel_shuffle_op.cc, shuffle_channel_op.cc, space_to_depth_op.cc,
+temporal_shift_op.cc, shard_index_op.cc, multiplex_op.cc, crop_op.cc,
+affine_channel_op.cc, unfold_op.cc, grid_sampler_op.cc,
+affine_grid_op.cc, selu_op.cc, mean_iou_op.cc,
+bilinear_tensor_product_op.cc, cos_sim_op.cc, bpr_loss_op.cc,
+teacher_student_sigmoid_loss_op.cc, sigmoid_focal_loss (detection/),
+row_conv_op.cc, warpctc_op.cc, edit_distance_op.cc,
+ctc_align_op.cc (ctc_greedy_decoder), hash_op.cc, unique_op.cc,
+reverse_op.cc, scatter_nd_op (via scatter_nd_add), fsp_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op, register_op
+
+
+@register_op("reverse", inputs=[In("X")], outputs=[Out("Out")],
+             attrs={"axis": []})
+def _reverse(ins, attrs):
+    x = ins["X"]
+    axes = attrs.get("axis", [])
+    for a in (axes if isinstance(axes, (list, tuple)) else [axes]):
+        x = jnp.flip(x, axis=int(a))
+    return {"Out": x}
+
+
+@register_op("pixel_shuffle", inputs=[In("X")], outputs=[Out("Out")],
+             attrs={"upscale_factor": 1})
+def _pixel_shuffle(ins, attrs):
+    x = ins["X"]  # [N, C*r*r, H, W]
+    r = int(attrs.get("upscale_factor", 1))
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    x = x.reshape(n, oc, r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return {"Out": x.reshape(n, oc, h * r, w * r)}
+
+
+@register_op("shuffle_channel", inputs=[In("X")], outputs=[Out("Out")],
+             attrs={"group": 1})
+def _shuffle_channel(ins, attrs):
+    x = ins["X"]
+    g = int(attrs.get("group", 1))
+    n, c, h, w = x.shape
+    x = x.reshape(n, g, c // g, h, w)
+    return {"Out": jnp.swapaxes(x, 1, 2).reshape(n, c, h, w)}
+
+
+@register_op("space_to_depth", inputs=[In("X")], outputs=[Out("Out")],
+             attrs={"blocksize": 1})
+def _space_to_depth(ins, attrs):
+    x = ins["X"]
+    b = int(attrs.get("blocksize", 1))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return {"Out": x.reshape(n, c * b * b, h // b, w // b)}
+
+
+@register_op("temporal_shift", inputs=[In("X")], outputs=[Out("Out")],
+             attrs={"seg_num": 1, "shift_ratio": 0.25})
+def _temporal_shift(ins, attrs):
+    x = ins["X"]  # [N*T, C, H, W]
+    t = int(attrs.get("seg_num", 1))
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // t
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    x = x.reshape(n, t, c, h, w)
+    fwd = jnp.concatenate([x[:, 1:, :c1], jnp.zeros_like(x[:, :1, :c1])],
+                          axis=1)
+    back = jnp.concatenate([jnp.zeros_like(x[:, :1, c1:c2]),
+                            x[:, :-1, c1:c2]], axis=1)
+    keep = x[:, :, c2:]
+    out = jnp.concatenate([fwd, back, keep], axis=2)
+    return {"Out": out.reshape(nt, c, h, w)}
+
+
+@register_op("shard_index", inputs=[In("X", no_grad=True)],
+             outputs=[Out("Out")],
+             attrs={"index_num": 0, "nshards": 1, "shard_id": 0,
+                    "ignore_value": -1}, grad=None)
+def _shard_index(ins, attrs):
+    x = ins["X"]
+    index_num = int(attrs["index_num"])
+    nshards = int(attrs["nshards"])
+    shard_id = int(attrs["shard_id"])
+    ignore = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return {"Out": jnp.where(in_shard, x % shard_size, ignore)}
+
+
+@register_op("multiplex",
+             inputs=[In("X", duplicable=True), In("Ids", no_grad=True)],
+             outputs=[Out("Out")])
+def _multiplex(ins, attrs):
+    xs = jnp.stack(ins["X"], axis=0)  # [K, N, ...]
+    ids = ins["Ids"].reshape(-1).astype(jnp.int32)  # [N]
+    rows = jnp.arange(ids.shape[0])
+    return {"Out": xs[ids, rows]}
+
+
+@register_op("crop", inputs=[In("X"), In("Y", dispensable=True,
+                                         no_grad=True),
+                             In("Offsets", dispensable=True, no_grad=True)],
+             outputs=[Out("Out")],
+             attrs={"offsets": [], "shape": []})
+def _crop(ins, attrs):
+    x = ins["X"]
+    shape = attrs.get("shape") or list(ins["Y"].shape)
+    offsets = attrs.get("offsets") or [0] * x.ndim
+    slices = tuple(slice(int(o), int(o) + int(s))
+                   for o, s in zip(offsets, shape))
+    return {"Out": x[slices]}
+
+
+@register_op("affine_channel",
+             inputs=[In("X"), In("Scale"), In("Bias")],
+             outputs=[Out("Out")], attrs={"data_layout": "NCHW"})
+def _affine_channel(ins, attrs):
+    x, scale, bias = ins["X"], ins["Scale"], ins["Bias"]
+    c_axis = 1 if attrs.get("data_layout", "NCHW") == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    return {"Out": x * scale.reshape(shape) + bias.reshape(shape)}
+
+
+@register_op("unfold", inputs=[In("X")], outputs=[Out("Y")],
+             attrs={"kernel_sizes": [1, 1], "strides": [1, 1],
+                    "paddings": [0, 0, 0, 0], "dilations": [1, 1]})
+def _unfold(ins, attrs):
+    """im2col (reference unfold_op.cc): [N,C,H,W] ->
+    [N, C*kh*kw, L]."""
+    x = ins["X"]
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pt, pl, pb, pr = (attrs.get("paddings", [0, 0, 0, 0]) + [0] * 4)[:4]
+    dh, dw = attrs.get("dilations", [1, 1])
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+    oh = (h + pt + pb - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + pl + pr - (dw * (kw - 1) + 1)) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            sub = x[:, :, i * dh:i * dh + oh * sh:sh,
+                    j * dw:j * dw + ow * sw:sw]
+            patches.append(sub)
+    out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+    return {"Y": out.reshape(n, c * kh * kw, oh * ow)}
+
+
+@register_op("affine_grid", inputs=[In("Theta"),
+                                    In("OutputShape", dispensable=True,
+                                       no_grad=True)],
+             outputs=[Out("Output")],
+             attrs={"output_shape": [], "align_corners": True})
+def _affine_grid(ins, attrs):
+    theta = ins["Theta"]  # [N, 2, 3]
+    shape = attrs.get("output_shape") or [int(v) for v in
+                                          np.asarray(ins["OutputShape"])]
+    n, c, h, w = shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    xg, yg = jnp.meshgrid(xs, ys)  # [h, w]
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], axis=-1)  # [h, w, 3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)  # [n, h, w, 2]
+    return {"Output": grid}
+
+
+@register_op("selu", inputs=[In("X")], outputs=[Out("Out")],
+             attrs={"scale": 1.0507009873554805,
+                    "alpha": 1.6732632423543772})
+def _selu(ins, attrs):
+    x = ins["X"]
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    return {"Out": scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1))}
+
+
+@register_op("mean_iou",
+             inputs=[In("Predictions", no_grad=True),
+                     In("Labels", no_grad=True)],
+             outputs=[Out("OutMeanIou"), Out("OutWrong"), Out("OutCorrect")],
+             attrs={"num_classes": 2}, grad=None)
+def _mean_iou(ins, attrs):
+    pred = ins["Predictions"].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"].reshape(-1).astype(jnp.int32)
+    k = int(attrs["num_classes"])
+    correct = jnp.zeros(k, jnp.int32).at[jnp.where(
+        pred == label, pred, k - 1)].add(
+            (pred == label).astype(jnp.int32))
+    pred_cnt = jnp.zeros(k, jnp.int32).at[pred].add(1)
+    label_cnt = jnp.zeros(k, jnp.int32).at[label].add(1)
+    union = pred_cnt + label_cnt - correct
+    present = union > 0
+    iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
+    miou = iou.sum() / jnp.maximum(present.sum(), 1)
+    # reference mean_iou_op.h counts a mismatch against BOTH classes
+    wrong = (pred_cnt - correct) + (label_cnt - correct)
+    return {"OutMeanIou": miou.astype(jnp.float32),
+            "OutWrong": wrong,
+            "OutCorrect": correct}
+
+
+@register_op("bilinear_tensor_product",
+             inputs=[In("X"), In("Y"), In("Weight"),
+                     In("Bias", dispensable=True)],
+             outputs=[Out("Out")])
+def _bilinear_tensor_product(ins, attrs):
+    x, y, w = ins["X"], ins["Y"], ins["Weight"]  # w: [size, dx, dy]
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if ins.get("Bias") is not None:
+        out = out + ins["Bias"].reshape(1, -1)
+    return {"Out": out}
+
+
+@register_op("cos_sim", inputs=[In("X"), In("Y")],
+             outputs=[Out("Out"), Out("XNorm", no_grad=True),
+                      Out("YNorm", no_grad=True)])
+def _cos_sim(ins, attrs):
+    x, y = ins["X"], ins["Y"]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    sim = jnp.sum(x * y, axis=-1, keepdims=True) / \
+        jnp.maximum(xn * yn, 1e-12)
+    return {"Out": sim, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("bpr_loss", inputs=[In("X"), In("Label", no_grad=True)],
+             outputs=[Out("Y")])
+def _bpr_loss(ins, attrs):
+    """Bayesian personalized ranking loss (reference bpr_loss_op.cc)."""
+    x = ins["X"]  # [N, C] scores
+    label = ins["Label"].reshape(-1).astype(jnp.int32)
+    n, c = x.shape
+    pos = x[jnp.arange(n), label][:, None]
+    diff = x - pos
+    lse = jnp.logaddexp(0.0, diff)  # stable log(1+e^x)
+    mask = jnp.ones((n, c)).at[jnp.arange(n), label].set(0.0)
+    return {"Y": (lse * mask).sum(axis=1, keepdims=True) / (c - 1)}
+
+
+@register_op("teacher_student_sigmoid_loss",
+             inputs=[In("X"), In("Label", no_grad=True)],
+             outputs=[Out("Y")],
+             attrs={"soft_max_up_bound": 15.0,
+                    "soft_max_lower_bound": -15.0})
+def _ts_sigmoid_loss(ins, attrs):
+    x = ins["X"].reshape(-1)
+    label = ins["Label"].reshape(-1)
+    # teacher (soft) part for label outside {0,1} + student (hard) part
+    sp = jnp.logaddexp(0.0, -jnp.abs(x)) + jnp.maximum(x, 0.0)
+    hard = sp - x * (label > 0.0)
+    soft = sp - x * label
+    use_soft = (label > 0.0) & (label < 1.0)
+    return {"Y": jnp.where(use_soft, soft + hard, hard).reshape(-1, 1)}
+
+
+@register_op("sigmoid_focal_loss",
+             inputs=[In("X"), In("Label", no_grad=True),
+                     In("FgNum", no_grad=True)],
+             outputs=[Out("Out")],
+             attrs={"gamma": 2.0, "alpha": 0.25})
+def _sigmoid_focal_loss(ins, attrs):
+    """Reference detection/sigmoid_focal_loss_op.cu: per-class focal
+    loss; Label in [0, C] with 0 = background."""
+    x = ins["X"]  # [N, C]
+    label = ins["Label"].reshape(-1).astype(jnp.int32)  # [N]
+    fg = jnp.maximum(ins["FgNum"].reshape(()).astype(x.dtype), 1.0)
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    n, c = x.shape
+    cls = jnp.arange(1, c + 1)[None, :]
+    t = (label[:, None] == cls).astype(x.dtype)  # one-hot over classes
+    p = jax.nn.sigmoid(x)
+    ce = jnp.logaddexp(0.0, -jnp.abs(x)) + jnp.maximum(x, 0.0) - x * t
+    # focal modulation
+    pt = jnp.where(t > 0, p, 1 - p)
+    af = jnp.where(t > 0, alpha, 1 - alpha)
+    valid = (label[:, None] >= 0).astype(x.dtype)
+    return {"Out": af * (1 - pt) ** gamma * ce * valid / fg}
+
+
+@register_op("row_conv", inputs=[In("X"), In("Filter")],
+             outputs=[Out("Out")])
+def _row_conv(ins, attrs):
+    """Lookahead row convolution over [N, T, D] with filter
+    [future_ctx, D] (reference row_conv_op.cc, dense layout)."""
+    x, f = ins["X"], ins["Filter"]
+    ctx = f.shape[0]
+    outs = jnp.zeros_like(x)
+    for k in range(ctx):
+        shifted = jnp.pad(x[:, k:], ((0, 0), (0, k), (0, 0)))
+        outs = outs + shifted * f[k][None, None, :]
+    return {"Out": outs}
+
+
+@register_op("fsp", inputs=[In("X"), In("Y")], outputs=[Out("Out")])
+def _fsp(ins, attrs):
+    """Flow-of-solution-procedure matrix (reference fsp_op.cc):
+    [N,C1,H,W] x [N,C2,H,W] -> [N,C1,C2]."""
+    x, y = ins["X"], ins["Y"]
+    n, c1, h, w = x.shape
+    return {"Out": jnp.einsum("nchw,ndhw->ncd", x, y) / (h * w)}
+
+
+@register_op("hash", inputs=[In("X", no_grad=True)], outputs=[Out("Out")],
+             attrs={"num_hash": 1, "mod_by": 100000000}, grad=None)
+def _hash(ins, attrs):
+    """Multiplicative int hashing (reference hash_op.cc uses xxhash;
+    the contract is a deterministic bucket id per (row, hash_idx))."""
+    x = ins["X"].astype(jnp.uint32)  # [N, D] int ids
+    num_hash = int(attrs.get("num_hash", 1))
+    mod = int(attrs.get("mod_by", 100000000))
+    outs = []
+    for i in range(num_hash):
+        seed = jnp.uint32(0x9E3779B1 * (i + 1) | 1)
+        h = jnp.zeros(x.shape[:-1], jnp.uint32)
+        for d in range(x.shape[-1]):
+            h = (h ^ (x[..., d] * seed)) * jnp.uint32(0x85EBCA77)
+        outs.append((h % jnp.uint32(mod)).astype(jnp.int64))
+    out = jnp.stack(outs, axis=-1)[..., None]
+    return {"Out": out}
+
+
+@register_host_op("unique",
+                  inputs=[In("X", no_grad=True)],
+                  outputs=[Out("Out"), Out("Index")],
+                  attrs={"dtype": 2})
+def _unique(executor, op, scope):
+    x = np.asarray(executor._read_var(scope, op.input("X")[0])).reshape(-1)
+    uniq, inv = np.unique(x, return_inverse=True)
+    executor._write_var(scope, op.output("Out")[0], uniq)
+    executor._write_var(scope, op.output("Index")[0],
+                        inv.astype(np.int32))
+
+
+@register_host_op("edit_distance",
+                  inputs=[In("Hyps", no_grad=True),
+                          In("Refs", no_grad=True)],
+                  outputs=[Out("Out"), Out("SequenceNum")],
+                  attrs={"normalized": True})
+def _edit_distance(executor, op, scope):
+    """Levenshtein distance per sequence pair (reference
+    edit_distance_op.h). LoD inputs or same-length dense batches."""
+    from ..core.tensor import LoDTensor
+
+    def seqs(name):
+        v = scope.find_var(name).raw()
+        arr = np.asarray(v.array if isinstance(v, LoDTensor) else v)
+        if isinstance(v, LoDTensor) and v.lod():
+            off = v.lod()[-1]
+            return [arr[off[i]:off[i + 1]].reshape(-1)
+                    for i in range(len(off) - 1)]
+        return [row.reshape(-1) for row in arr]
+
+    hyps = seqs(op.input("Hyps")[0])
+    refs = seqs(op.input("Refs")[0])
+    out = []
+    for h, r in zip(hyps, refs):
+        m, n = len(h), len(r)
+        dp = np.zeros((m + 1, n + 1), np.float32)
+        dp[:, 0] = np.arange(m + 1)
+        dp[0, :] = np.arange(n + 1)
+        for i in range(1, m + 1):
+            for j in range(1, n + 1):
+                cost = 0 if h[i - 1] == r[j - 1] else 1
+                dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                               dp[i - 1, j - 1] + cost)
+        d = dp[m, n]
+        if op.attrs.get("normalized", True) and n > 0:
+            d = d / n
+        out.append([d])
+    executor._write_var(scope, op.output("Out")[0],
+                        np.asarray(out, np.float32))
+    executor._write_var(scope, op.output("SequenceNum")[0],
+                        np.asarray([len(out)], np.int64))
+
+
+@register_op(
+    "warpctc",
+    inputs=[In("Logits"), In("Label", no_grad=True)],
+    outputs=[Out("Loss"), Out("WarpCTCGrad", dispensable=True,
+                              no_grad=True)],
+    attrs={"blank": 0, "norm_by_times": False},
+)
+def _warpctc(ins, attrs):
+    """CTC loss over DENSE [B, T, C] logits and [B, L] labels
+    (reference warpctc_op.cc wraps warp-ctc; here the forward algorithm
+    runs as a lax.scan over time — pure XLA, trainable via auto-VJP).
+    Label padding value must be negative or >= C (ignored)."""
+    logits = ins["Logits"]
+    labels = ins["Label"].astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    if logits.ndim == 2:
+        logits = logits[None]
+        labels = labels.reshape(1, -1)
+    b, t, c = logits.shape
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    L = labels.shape[1]
+    valid_lab = (labels >= 0) & (labels < c)  # pad = negative or >= C
+    # extended label sequence: blank l1 blank l2 ... blank, length 2L+1
+    ext = jnp.full((b, 2 * L + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.where(valid_lab, labels, blank))
+    lab_len = valid_lab.sum(axis=1)
+    s_len = 2 * lab_len + 1
+    neg_inf = jnp.float32(-1e30)
+
+    # can transition s-2 -> s when ext[s] != blank and ext[s] != ext[s-2]
+    skip_ok = jnp.zeros((b, 2 * L + 1), bool)
+    if L > 0:
+        skip_ok = skip_ok.at[:, 2:].set(
+            (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    alpha0 = jnp.full((b, 2 * L + 1), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(log_probs[:, 0, blank])
+    if L > 0:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0,
+                      log_probs[jnp.arange(b), 0, ext[:, 1]], neg_inf))
+
+    def step(alpha, lp_t):
+        stay = alpha
+        prev1 = jnp.concatenate(
+            [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(skip_ok, prev2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        return merged + emit, None
+
+    lp_seq = jnp.swapaxes(log_probs, 0, 1)  # [T, B, C]
+    alpha, _ = jax.lax.scan(step, alpha0, lp_seq[1:])
+    last = jnp.take_along_axis(alpha, (s_len - 1)[:, None],
+                               axis=1)[:, 0]
+    last2 = jnp.take_along_axis(
+        alpha, jnp.maximum(s_len - 2, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(last, jnp.where(s_len >= 2, last2, neg_inf))
+    return {"Loss": (-ll).reshape(b, 1)}
+
+
+@register_host_op(
+    "ctc_align",
+    inputs=[In("Input", no_grad=True)],
+    outputs=[Out("Output")],
+    attrs={"blank": 0, "merge_repeated": True},
+)
+def _ctc_align(executor, op, scope):
+    """CTC greedy-decode output alignment (reference ctc_align_op.h):
+    merge repeats, drop blanks. Dense [B, T] argmax ids in, LoD out."""
+    from ..core.tensor import LoDTensor
+
+    ids = np.asarray(executor._read_var(scope, op.input("Input")[0]))
+    blank = op.attrs.get("blank", 0)
+    merge = op.attrs.get("merge_repeated", True)
+    rows, lod = [], [0]
+    for row in ids:
+        prev = None
+        seq = []
+        for v in row.reshape(-1):
+            if merge and prev is not None and v == prev:
+                prev = v
+                continue
+            prev = v
+            if v != blank:
+                seq.append(v)
+        rows.extend(seq)
+        lod.append(len(rows))
+    out = np.asarray(rows, ids.dtype).reshape(-1, 1) if rows else \
+        np.full((1, 1), -1, ids.dtype)
+    if not rows:
+        lod = [0, 1]
+    t = LoDTensor(out)
+    t.set_lod([lod])
+    executor._write_var(scope, op.output("Output")[0], t)
+
+
+@register_op("sequence_reverse", inputs=[In("X")], outputs=[Out("Y")],
+             needs_lod=True, infer_lod="propagate")
+def _sequence_reverse(ins, attrs):
+    """Reverse each LoD sequence (reference
+    sequence_ops/sequence_reverse_op.h); dense inputs flip axis 0."""
+    from .lod_utils import lod_offsets
+
+    x = ins["X"]
+    offsets = lod_offsets(attrs, "X")
+    if offsets is None:
+        return {"Y": jnp.flip(x, axis=0)}
+    segs = [jnp.flip(x[offsets[i]:offsets[i + 1]], axis=0)
+            for i in range(len(offsets) - 1)]
+    return {"Y": jnp.concatenate(segs, axis=0)}
+
+
+@register_host_op("lod_reset",
+                  inputs=[In("X"), In("Y", dispensable=True,
+                                      no_grad=True)],
+                  outputs=[Out("Out")],
+                  attrs={"target_lod": []})
+def _lod_reset(executor, op, scope):
+    """Re-stamp LoD from attr or Y's lod/values (reference
+    lod_reset_op.h)."""
+    from ..core.tensor import LoDTensor
+
+    xv = scope.find_var(op.input("X")[0]).raw()
+    arr = np.asarray(xv.array if isinstance(xv, LoDTensor) else xv)
+    target = list(op.attrs.get("target_lod") or [])
+    if not target and op.input("Y"):
+        yv = scope.find_var(op.input("Y")[0]).raw()
+        if isinstance(yv, LoDTensor) and yv.lod():
+            target = list(yv.lod()[-1])
+        else:
+            target = [int(v) for v in np.asarray(
+                yv.array if isinstance(yv, LoDTensor) else yv).reshape(-1)]
+    t = LoDTensor(arr)
+    t.set_lod([target])
+    executor._write_var(scope, op.output("Out")[0], t)

@@ -1,0 +1,323 @@
+"""Wave-3 layer/op tests: rearrangement, losses, CTC, LR schedules,
+control-flow builders. Numpy references per the OpTest contract."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.tensor import LoDTensor
+
+
+def _run(build, feed, n_fetch=1, scope=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+        if not isinstance(fetches, (list, tuple)):
+            fetches = [fetches]
+    scope = scope or fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=list(fetches))
+
+
+class TestRearrangeOps:
+    def test_pixel_shuffle(self):
+        x = np.arange(16, dtype="float32").reshape(1, 4, 2, 2)
+
+        def build():
+            xv = fluid.data(name="x", shape=[1, 4, 2, 2], dtype="float32")
+            return fluid.layers.pixel_shuffle(xv, 2)
+
+        (o,) = _run(build, {"x": x})
+        assert np.asarray(o).shape == (1, 1, 4, 4)
+
+    def test_space_to_depth_roundtrip_shape(self):
+        x = np.random.RandomState(0).rand(2, 3, 4, 4).astype("float32")
+
+        def build():
+            xv = fluid.data(name="x", shape=[2, 3, 4, 4], dtype="float32")
+            return fluid.layers.space_to_depth(xv, 2)
+
+        (o,) = _run(build, {"x": x})
+        assert np.asarray(o).shape == (2, 12, 2, 2)
+
+    def test_shuffle_channel_involution(self):
+        x = np.random.RandomState(1).rand(1, 6, 2, 2).astype("float32")
+
+        def build():
+            xv = fluid.data(name="x", shape=[1, 6, 2, 2], dtype="float32")
+            s1 = fluid.layers.shuffle_channel(xv, 2)
+            return fluid.layers.shuffle_channel(s1, 3)
+
+        (o,) = _run(build, {"x": x})
+        np.testing.assert_allclose(np.asarray(o), x, rtol=1e-6)
+
+    def test_reverse_multiplex_crop(self):
+        x = np.arange(12, dtype="float32").reshape(3, 4)
+
+        def build():
+            xv = fluid.data(name="x", shape=[3, 4], dtype="float32")
+            r = fluid.layers.reverse(xv, axis=0)
+            c = fluid.layers.crop(xv, shape=[2, 2], offsets=[1, 1])
+            ids = fluid.layers.fill_constant([3], "int32", 0)
+            m = fluid.layers.multiplex([xv, r], ids)
+            return r, c, m
+
+        r, c, m = _run(build, {"x": x})
+        np.testing.assert_array_equal(np.asarray(r), x[::-1])
+        np.testing.assert_array_equal(np.asarray(c), x[1:3, 1:3])
+        np.testing.assert_array_equal(np.asarray(m), x)
+
+    def test_unfold_matches_manual(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+
+        def build():
+            xv = fluid.data(name="x", shape=[1, 1, 4, 4], dtype="float32")
+            return fluid.layers.unfold(xv, [2, 2], strides=2)
+
+        (o,) = _run(build, {"x": x})
+        o = np.asarray(o)
+        assert o.shape == (1, 4, 4)
+        np.testing.assert_array_equal(o[0, :, 0], [0, 1, 4, 5])
+
+    def test_shard_index(self):
+        def build():
+            xv = fluid.data(name="x", shape=[4, 1], dtype="int64")
+            return fluid.layers.shard_index(xv, index_num=20, nshards=2,
+                                            shard_id=0)
+
+        (o,) = _run(build, {"x": np.array([[1], [6], [12], [19]],
+                                          dtype="int64")})
+        np.testing.assert_array_equal(np.asarray(o).ravel(),
+                                      [1, 6, -1, -1])
+
+
+class TestLossesWave3:
+    def test_cos_sim_unit(self):
+        x = np.array([[1.0, 0.0]], dtype="float32")
+        y = np.array([[0.0, 1.0]], dtype="float32")
+
+        def build():
+            xv = fluid.data(name="x", shape=[1, 2], dtype="float32")
+            yv = fluid.data(name="y", shape=[1, 2], dtype="float32")
+            return fluid.layers.cos_sim(xv, yv)
+
+        (o,) = _run(build, {"x": x, "y": y})
+        np.testing.assert_allclose(np.asarray(o).ravel(), [0.0], atol=1e-6)
+
+    def test_dice_loss_perfect_overlap(self):
+        def build():
+            p = fluid.data(name="p", shape=[4, 2], dtype="float32")
+            l = fluid.data(name="l", shape=[4, 2], dtype="int64")
+            return fluid.layers.dice_loss(p, l)
+
+        ones = np.ones((4, 2))
+        (o,) = _run(build, {"p": ones.astype("float32"),
+                            "l": ones.astype("int64")})
+        np.testing.assert_allclose(np.asarray(o).ravel()[0], 0.0,
+                                   atol=1e-4)
+
+    def test_mean_iou_perfect(self):
+        def build():
+            p = fluid.data(name="p", shape=[8], dtype="int32")
+            l = fluid.data(name="l", shape=[8], dtype="int32")
+            miou, wrong, correct = fluid.layers.mean_iou(p, l, 4)
+            return miou
+
+        labels = np.array([0, 1, 2, 3, 0, 1, 2, 3], dtype="int32")
+        (o,) = _run(build, {"p": labels, "l": labels})
+        np.testing.assert_allclose(float(np.asarray(o)), 1.0, rtol=1e-6)
+
+    def test_bpr_loss_decreases_for_confident(self):
+        def build():
+            x = fluid.data(name="x", shape=[2, 3], dtype="float32")
+            l = fluid.data(name="l", shape=[2, 1], dtype="int64")
+            return fluid.layers.bpr_loss(x, l)
+
+        confident = np.array([[10.0, 0, 0], [0, 10.0, 0]], "float32")
+        uncertain = np.zeros((2, 3), "float32")
+        lab = np.array([[0], [1]], dtype="int64")
+        (lc,) = _run(build, {"x": confident, "l": lab})
+        (lu,) = _run(build, {"x": uncertain, "l": lab})
+        assert np.asarray(lc).mean() < np.asarray(lu).mean()
+
+
+class TestCTC:
+    def test_warpctc_simple(self):
+        """Single sequence, label [1]: loss = -log P(paths -> '1')."""
+        T, C = 2, 3
+        logits = np.zeros((1, T, C), dtype="float32")  # uniform
+        labels = np.array([[1]], dtype="int32")
+
+        def build():
+            lg = fluid.data(name="lg", shape=[1, T, C], dtype="float32")
+            lb = fluid.data(name="lb", shape=[1, 1], dtype="int32")
+            return fluid.layers.warpctc(lg, lb, blank=0)
+
+        (o,) = _run(build, {"lg": logits, "lb": labels})
+        # paths of length 2 mapping to '1': (b,1),(1,b),(1,1) = 3/9
+        ref = -np.log(3.0 / 9.0)
+        np.testing.assert_allclose(float(np.asarray(o).ravel()[0]), ref,
+                                   rtol=1e-5)
+
+    def test_warpctc_trains(self):
+        T, C = 6, 4
+        rng = np.random.RandomState(0)
+
+        def build():
+            lg = fluid.data(name="lg", shape=[2, T, C], dtype="float32")
+            lb = fluid.data(name="lb", shape=[2, 2], dtype="int32")
+            h = fluid.layers.fc(lg, C, num_flatten_dims=2)
+            loss = fluid.layers.mean(fluid.layers.warpctc(h, lb))
+            fluid.optimizer.AdamOptimizer(0.05).minimize(loss)
+            return loss
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            loss = build()
+        feed = {"lg": rng.rand(2, T, C).astype("float32"),
+                "lb": np.array([[1, 2], [3, 1]], dtype="int32")}
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = [float(np.asarray(exe.run(
+                main, feed=feed, fetch_list=[loss])[0]).ravel()[0])
+                for _ in range(15)]
+        assert losses[-1] < losses[0]
+
+    def test_ctc_greedy_decoder(self):
+        T, C = 5, 3
+        probs = np.zeros((1, T, C), dtype="float32")
+        # argmax path: 1 1 0 2 2 -> merge/deblank -> [1, 2]
+        path = [1, 1, 0, 2, 2]
+        for t, k in enumerate(path):
+            probs[0, t, k] = 5.0
+
+        def build():
+            p = fluid.data(name="p", shape=[1, T, C], dtype="float32")
+            return fluid.layers.ctc_greedy_decoder(p, blank=0)
+
+        (o,) = _run(build, {"p": probs})
+        np.testing.assert_array_equal(np.asarray(o).ravel(), [1, 2])
+
+    def test_edit_distance(self):
+        def build():
+            h = fluid.data(name="h", shape=[2, 3], dtype="int64")
+            r = fluid.data(name="r", shape=[2, 3], dtype="int64")
+            out, n = fluid.layers.edit_distance(h, r, normalized=False)
+            return out
+
+        (o,) = _run(build, {
+            "h": np.array([[1, 2, 3], [1, 1, 1]], dtype="int64"),
+            "r": np.array([[1, 2, 4], [2, 2, 2]], dtype="int64")})
+        np.testing.assert_allclose(np.asarray(o).ravel(), [1.0, 3.0])
+
+
+class TestControlFlowBuilders:
+    def test_while_loop(self):
+        def build():
+            i = fluid.layers.fill_constant([1], "int64", 0)
+            ten = fluid.layers.fill_constant([1], "int64", 10)
+
+            def cond(i):
+                return fluid.layers.less_than(i, ten)
+
+            def body(i):
+                return fluid.layers.increment(i, value=2, in_place=False)
+
+            (out,) = fluid.layers.while_loop(cond, body, [i])
+            return out
+
+        (o,) = _run(build, {})
+        assert int(np.asarray(o).ravel()[0]) == 10
+
+    def test_case_and_switch_case(self):
+        def build():
+            x = fluid.data(name="x", shape=[1], dtype="float32")
+            three = fluid.layers.fill_constant([1], "float32", 3.0)
+            pred = fluid.layers.less_than(x, three)
+            out = fluid.layers.case(
+                [(pred, lambda: fluid.layers.fill_constant(
+                    [1], "float32", 1.0))],
+                default=lambda: fluid.layers.fill_constant(
+                    [1], "float32", 2.0))
+            idx = fluid.layers.fill_constant([1], "int32", 1)
+            out2 = fluid.layers.switch_case(
+                idx, {0: lambda: fluid.layers.fill_constant(
+                    [1], "float32", 10.0),
+                    1: lambda: fluid.layers.fill_constant(
+                        [1], "float32", 20.0)},
+                default=lambda: fluid.layers.fill_constant(
+                    [1], "float32", -1.0))
+            return out, out2
+
+        o1, o2 = _run(build, {"x": np.array([1.0], "float32")})
+        assert float(np.asarray(o1)) == 1.0
+        assert float(np.asarray(o2)) == 20.0
+
+    def test_py_func(self):
+        def build():
+            x = fluid.data(name="x", shape=[3], dtype="float32")
+            out = fluid.default_main_program().current_block().create_var(
+                name="pyout", dtype="float32")
+            fluid.layers.py_func(lambda a: a * 3.0, x, out)
+            return out
+
+        (o,) = _run(build, {"x": np.ones(3, "float32")})
+        np.testing.assert_allclose(np.asarray(o), [3.0, 3.0, 3.0])
+
+
+class TestLRSchedules:
+    def test_noam_and_warmup_shapes(self):
+        def build():
+            lr1 = fluid.layers.noam_decay(512, 100)
+            lr2 = fluid.layers.linear_lr_warmup(0.1, 10, 0.0, 0.1)
+            lr3 = fluid.layers.cosine_decay(0.1, 5, 10)
+            lr4 = fluid.layers.polynomial_decay(0.1, 20)
+            return lr1, lr2, lr3, lr4
+
+        outs = _run(build, {})
+        for o in outs:
+            assert np.isfinite(np.asarray(o)).all()
+
+    def test_warmup_ramps(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            lr = fluid.layers.linear_lr_warmup(0.1, 10, 0.0, 0.1)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            vals = [float(np.asarray(exe.run(
+                main, feed={}, fetch_list=[lr])[0]).ravel()[0])
+                for _ in range(15)]
+        assert vals[0] < vals[5] < vals[9]
+        np.testing.assert_allclose(vals[10:], 0.1, rtol=1e-6)
+
+
+class TestSequenceExtras:
+    def test_sequence_reverse(self):
+        x = np.arange(10, dtype="float32").reshape(5, 2)
+        xt = LoDTensor(x)
+        xt.set_lod([[0, 2, 5]])
+
+        def build():
+            xv = fluid.data(name="x", shape=[5, 2], dtype="float32",
+                            lod_level=1)
+            return fluid.layers.sequence_reverse(xv)
+
+        (o,) = _run(build, {"x": xt})
+        ref = np.concatenate([x[1::-1], x[4:1:-1]], axis=0)
+        np.testing.assert_array_equal(np.asarray(o), ref)
+
+    def test_lod_reset(self):
+        x = np.arange(6, dtype="float32").reshape(6, 1)
+
+        def build():
+            xv = fluid.data(name="x", shape=[6, 1], dtype="float32")
+            out = fluid.layers.lod_reset(xv, target_lod=[0, 2, 6])
+            return fluid.layers.sequence_pool(out, "sum")
+
+        (o,) = _run(build, {"x": x})
+        np.testing.assert_allclose(np.asarray(o).ravel(), [1.0, 14.0])
