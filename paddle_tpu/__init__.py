@@ -67,6 +67,9 @@ from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa
 from . import incubate  # noqa: F401
 from . import contrib  # noqa: F401
 from . import inference  # noqa: F401
+from . import distribution  # noqa: F401
+from . import metric_api as metric  # noqa: F401
+from . import tensor_api as tensor  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,185 @@
+"""Probability distributions.
+
+Parity: /root/reference/python/paddle/fluid/layers/distributions.py
+(Uniform, Normal, Categorical, MultivariateNormalDiag) — graph-building
+classes whose methods append ops. Works in both static and dygraph mode
+(the layer ops route accordingly).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import layers
+from .layers import tensor as layers_tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag"]
+
+
+def _to_var(v, like=None):
+    from . import framework
+    from .dygraph.varbase import VarBase
+
+    if isinstance(v, (framework.Variable, VarBase)):
+        return v
+    arr = np.asarray(v, dtype="float32")
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    return layers_tensor.assign(arr)
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference distributions.py Uniform)."""
+
+    def __init__(self, low, high):
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        from .layers import nn
+
+        u = nn.uniform_random(list(shape), min=0.0, max=1.0, seed=seed)
+        span = layers.elementwise_sub(self.high, self.low)
+        return layers.elementwise_add(
+            layers.elementwise_mul(u, span), self.low)
+
+    def log_prob(self, value):
+        """-log(high-low), broadcast against `value` (in-support
+        density; the reference likewise ignores the boundary case)."""
+        from .layers.ops import log
+
+        span = layers.elementwise_sub(self.high, self.low)
+        lp = layers.scale(log(span), scale=-1.0)
+        zeros = layers.scale(value, scale=0.0)
+        return layers.elementwise_add(zeros, lp)
+
+    def entropy(self):
+        from .layers.ops import log
+
+        return log(layers.elementwise_sub(self.high, self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference distributions.py Normal)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        from .layers import nn
+
+        z = nn.gaussian_random(list(shape), mean=0.0, std=1.0, seed=seed)
+        return layers.elementwise_add(
+            layers.elementwise_mul(z, self.scale), self.loc)
+
+    def log_prob(self, value):
+        from .layers.ops import log
+
+        var = layers.elementwise_mul(self.scale, self.scale)
+        diff = layers.elementwise_sub(value, self.loc)
+        quad = layers.elementwise_div(
+            layers.elementwise_mul(diff, diff),
+            layers.scale(var, scale=2.0))
+        return layers.scale(
+            layers.elementwise_add(
+                quad, layers.elementwise_add(
+                    log(self.scale),
+                    layers.fill_constant([1], "float32",
+                                         0.5 * math.log(2 * math.pi)))),
+            scale=-1.0)
+
+    def entropy(self):
+        from .layers.ops import log
+
+        return layers.elementwise_add(
+            log(self.scale),
+            layers.fill_constant([1], "float32",
+                                 0.5 + 0.5 * math.log(2 * math.pi)))
+
+    def kl_divergence(self, other):
+        """KL(self || other), both Normal."""
+        from .layers.ops import log
+
+        var_ratio = layers.elementwise_div(self.scale, other.scale)
+        var_ratio = layers.elementwise_mul(var_ratio, var_ratio)
+        t1 = layers.elementwise_div(
+            layers.elementwise_sub(self.loc, other.loc), other.scale)
+        t1 = layers.elementwise_mul(t1, t1)
+        inner = layers.elementwise_sub(
+            layers.elementwise_add(var_ratio, t1),
+            layers.elementwise_add(
+                layers.fill_constant([1], "float32", 1.0),
+                log(var_ratio)))
+        return layers.scale(inner, scale=0.5)
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference
+    distributions.py Categorical: entropy + kl_divergence)."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def entropy(self):
+        logp = layers.log_softmax(self.logits, axis=-1)
+        p = layers.softmax(self.logits)
+        return layers.scale(
+            layers.reduce_sum(layers.elementwise_mul(p, logp), dim=-1),
+            scale=-1.0)
+
+    def kl_divergence(self, other):
+        logp = layers.log_softmax(self.logits, axis=-1)
+        logq = layers.log_softmax(other.logits, axis=-1)
+        p = layers.softmax(self.logits)
+        return layers.reduce_sum(
+            layers.elementwise_mul(p, layers.elementwise_sub(logp, logq)),
+            dim=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)) (reference distributions.py)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)  # [..., D] diagonal stddevs
+
+    def entropy(self):
+        from .layers.ops import log
+
+        d = int(self.loc.shape[-1])
+        logdet = layers.reduce_sum(log(self.scale), dim=-1)
+        return layers.elementwise_add(
+            logdet, layers.fill_constant(
+                [1], "float32", 0.5 * d * (1.0 + math.log(2 * math.pi))))
+
+    def kl_divergence(self, other):
+        from .layers.ops import log
+
+        var_ratio = layers.elementwise_div(self.scale, other.scale)
+        var_ratio2 = layers.elementwise_mul(var_ratio, var_ratio)
+        t1 = layers.elementwise_div(
+            layers.elementwise_sub(self.loc, other.loc), other.scale)
+        t12 = layers.elementwise_mul(t1, t1)
+        inner = layers.elementwise_sub(
+            layers.elementwise_add(var_ratio2, t12),
+            layers.elementwise_add(
+                layers.fill_constant([1], "float32", 1.0),
+                log(var_ratio2)))
+        return layers.scale(layers.reduce_sum(inner, dim=-1), scale=0.5)

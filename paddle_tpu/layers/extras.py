@@ -28,16 +28,6 @@ __all__ = [
 ]
 
 
-def _one_out(op_type, inputs, attrs=None, dtype=None, out_slot="Out",
-             ref=None):
-    helper = LayerHelper(op_type, input=ref)
-    out = helper.create_variable_for_type_inference(
-        dtype or (ref.dtype if ref is not None else "float32"))
-    helper.append_op(op_type, inputs=inputs, outputs={out_slot: [out]},
-                     infer_shape=False)
-    return out, helper
-
-
 def _simple(op_type, x, attrs=None, dtype=None, out_slot="Out"):
     helper = LayerHelper(op_type, input=x)
     out = helper.create_variable_for_type_inference(dtype or x.dtype)
@@ -293,8 +283,11 @@ def warpctc(input, label, blank=0, norm_by_times=False,
             input_length=None, label_length=None):
     helper = LayerHelper("warpctc", input=input)
     loss = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length]
     helper.append_op("warpctc",
-                     inputs={"Logits": [input], "Label": [label]},
+                     inputs=inputs,
                      outputs={"Loss": [loss]},
                      attrs={"blank": blank,
                             "norm_by_times": norm_by_times},
@@ -327,18 +320,24 @@ def rank(input):
 
 
 def size(input):
-    from .tensor import fill_constant
+    """Runtime element count (handles dynamic -1 dims via the shape op,
+    unlike a compile-time constant which would go negative)."""
+    from .nn import reduce_prod, shape
+    from .tensor import cast
 
-    return fill_constant([1], "int64", int(np.prod(input.shape)))
+    return cast(reduce_prod(cast(shape(input), "int64")), "int64")
 
 
 def is_empty(x, cond=None):
-    from .control_flow import less_than
-    from .tensor import fill_constant
+    from .control_flow import equal
+    from .tensor import assign, cast, fill_constant
 
-    # numel == 0 is static here; emit the constant
-    return fill_constant([1], "bool",
-                         bool(int(np.prod(x.shape or (0,))) == 0))
+    zero = fill_constant([1], "int64", 0)
+    out = equal(cast(size(x), "int64"), zero)
+    if cond is not None:
+        assign(out, output=cond)
+        return cond
+    return out
 
 
 def sum(x):
@@ -368,6 +367,10 @@ def pad_constant_like(x, y, pad_value=0.0, name=None):
 
     paddings = []
     for xs, ys in zip(x.shape, y.shape):
+        if int(xs) < 0 or int(ys) < 0:
+            raise ValueError(
+                "pad_constant_like requires static shapes; got %s vs %s"
+                % (x.shape, y.shape))
         paddings.extend([0, int(xs) - int(ys)])
     return pad(y, paddings, pad_value)
 
@@ -393,16 +396,21 @@ def add_position_encoding(input, alpha, beta, name=None):
 
 
 def dice_loss(input, label, epsilon=1e-5):
-    """(reference layers/nn.py dice_loss composition)."""
-    from .nn import reduce_sum
+    """(reference layers/nn.py dice_loss): one-hot the class labels,
+    per-sample dice, then mean."""
+    from .nn import one_hot, reduce_mean, reduce_sum
     from .ops import scale
     from .tensor import cast
 
-    label_f = cast(label, input.dtype)
-    inter = reduce_sum(input * label_f)
-    union = reduce_sum(input) + reduce_sum(label_f)
-    dice = scale(inter, 2.0) / (union + epsilon)
-    return scale(dice, -1.0, bias=1.0)
+    depth = int(input.shape[-1])
+    # one_hot squeezes label's trailing 1-dim: [..., 1] -> [..., depth]
+    label_oh = cast(one_hot(label, depth), input.dtype)
+    reduce_dim = list(range(1, len(input.shape)))
+    inse = reduce_sum(input * label_oh, dim=reduce_dim)
+    denom = reduce_sum(input, dim=reduce_dim) + \
+        reduce_sum(label_oh, dim=reduce_dim)
+    dice = scale(inse, 2.0) / (denom + epsilon)
+    return reduce_mean(scale(dice, -1.0, bias=1.0))
 
 
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
@@ -444,10 +452,7 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
 def case(pred_fn_pairs, default=None, name=None):
     """First-true-wins select chain (reference layers/control_flow.py
     case; both branches evaluate — XLA select semantics)."""
-    outs = None
-    sel = None
     helper = LayerHelper("case")
-    result = None
     if default is None:
         raise ValueError("case requires a default fn here")
     result = default()

@@ -1,0 +1,6 @@
+"""paddle.metric 2.0-alpha namespace (reference python/paddle/metric):
+class-style streaming metrics over the fluid.metrics implementations."""
+from .metrics import *  # noqa: F401,F403
+from .metrics import __all__ as _m_all  # noqa: F401
+
+__all__ = list(_m_all)

@@ -256,14 +256,19 @@ def _bpr_loss(ins, attrs):
              attrs={"soft_max_up_bound": 15.0,
                     "soft_max_lower_bound": -15.0})
 def _ts_sigmoid_loss(ins, attrs):
+    """Exact reference piecewise formula
+    (teacher_student_sigmoid_loss_op.h:44): label < -1 -> sp;
+    label in [-1,0) -> sp - x; label in [0,1) -> sp + sp - x*label;
+    label >= 1 -> (sp - x) + (sp - x*(label-1))."""
     x = ins["X"].reshape(-1)
     label = ins["Label"].reshape(-1)
-    # teacher (soft) part for label outside {0,1} + student (hard) part
-    sp = jnp.logaddexp(0.0, -jnp.abs(x)) + jnp.maximum(x, 0.0)
-    hard = sp - x * (label > 0.0)
-    soft = sp - x * label
-    use_soft = (label > 0.0) & (label < 1.0)
-    return {"Y": jnp.where(use_soft, soft + hard, hard).reshape(-1, 1)}
+    sp = jnp.maximum(x, 0.0) + jnp.logaddexp(0.0, -jnp.abs(x))
+    y = jnp.where(
+        label < -1.0, sp,
+        jnp.where(label < 0.0, sp - x,
+                  jnp.where(label < 1.0, sp + sp - x * label,
+                            (sp - x) + (sp - x * (label - 1.0)))))
+    return {"Y": y.reshape(-1, 1)}
 
 
 @register_op("sigmoid_focal_loss",
@@ -338,11 +343,14 @@ def _hash(ins, attrs):
                   outputs=[Out("Out"), Out("Index")],
                   attrs={"dtype": 2})
 def _unique(executor, op, scope):
+    from ..core import dtypes as _dt
+
     x = np.asarray(executor._read_var(scope, op.input("X")[0])).reshape(-1)
     uniq, inv = np.unique(x, return_inverse=True)
+    idx_dt = _dt.to_numpy_dtype(op.attrs.get("dtype", 2))
     executor._write_var(scope, op.output("Out")[0], uniq)
     executor._write_var(scope, op.output("Index")[0],
-                        inv.astype(np.int32))
+                        inv.astype(idx_dt))
 
 
 @register_host_op("edit_distance",
@@ -389,7 +397,8 @@ def _edit_distance(executor, op, scope):
 
 @register_op(
     "warpctc",
-    inputs=[In("Logits"), In("Label", no_grad=True)],
+    inputs=[In("Logits"), In("Label", no_grad=True),
+            In("LogitsLength", dispensable=True, no_grad=True)],
     outputs=[Out("Loss"), Out("WarpCTCGrad", dispensable=True,
                               no_grad=True)],
     attrs={"blank": 0, "norm_by_times": False},
@@ -407,6 +416,15 @@ def _warpctc(ins, attrs):
         labels = labels.reshape(1, -1)
     b, t, c = logits.shape
     log_probs = jax.nn.log_softmax(logits, axis=-1)
+    if ins.get("LogitsLength") is not None:
+        # padded timesteps emit blank with probability 1 (log-prob 0):
+        # trailing forced blanks collapse, leaving the true-path prob
+        lens = ins["LogitsLength"].reshape(-1).astype(jnp.int32)
+        tmask = jnp.arange(t)[None, :] < lens[:, None]  # [b, t]
+        blank_row = jnp.full((c,), -1e30).at[int(attrs.get("blank",
+                                                           0))].set(0.0)
+        log_probs = jnp.where(tmask[:, :, None], log_probs,
+                              blank_row[None, None, :])
     L = labels.shape[1]
     valid_lab = (labels >= 0) & (labels < c)  # pad = negative or >= C
     # extended label sequence: blank l1 blank l2 ... blank, length 2L+1

@@ -433,3 +433,53 @@ def _model_average_accumulate(ins, attrs):
     sum_out = jnp.where(restart, p, s + p)
     cnt_out = jnp.where(restart, jnp.ones_like(c), c_new)
     return {"SumOut": sum_out, "CountOut": cnt_out}
+
+
+@register_op(
+    "dgc",
+    inputs=[In("U", no_grad=True), In("V", no_grad=True),
+            In("Grad", no_grad=True), In("CurrentStep", no_grad=True)],
+    outputs=[Out("UOut"), Out("VOut"), Out("EncodeGrad"),
+             Out("GradOut")],
+    attrs={"m": 0.9, "use_nesterov": False, "sparsity": [0.999],
+           "rampup_begin_step": 0.0, "rampup_step": 1.0},
+    grad=None,
+)
+def _dgc(ins, attrs):
+    """Deep gradient compression (reference dgc_op.h semantics):
+    momentum correction (u = m*u + g), velocity accumulation
+    (v = v + u), top-k selection by |v|; selected entries emit as the
+    (dense-but-mostly-zero) EncodeGrad for the allreduce while local
+    u/v zero at selected slots. On TPU the collective stays dense —
+    XLA collectives have no sparse wire format — so DGC here preserves
+    the ALGORITHM (delayed small-gradient accumulation), not wire
+    compression."""
+    m = attrs.get("m", 0.9)
+    g = ins["Grad"]
+    if attrs.get("use_nesterov", False):
+        u = m * (ins["U"] + g)  # reference dgc_op.h:138
+        v = ins["V"] + u + g
+    else:
+        u = m * ins["U"] + g
+        v = ins["V"] + u
+    step = ins["CurrentStep"].reshape(()).astype(jnp.float32)
+    sparsity = [float(x) for x in attrs.get("sparsity", [0.999])] or \
+        [0.999]
+    begin = attrs.get("rampup_begin_step", 0.0)
+    period = max(float(attrs.get("rampup_step", 1.0)), 1.0)
+    # warm-up schedule (reference dgc_op GetDgcSparsity): walk the
+    # sparsity list across the rampup period, then hold the last value
+    prog = jnp.clip((step - begin) / period, 0.0, 1.0 - 1e-6)
+    idx = (prog * len(sparsity)).astype(jnp.int32)
+    s_now = jnp.asarray(sparsity)[idx]
+    in_rampup = step < begin
+    flat = jnp.abs(v).reshape(-1)
+    # dynamic sparsity -> dynamic k is not traceable; use the quantile
+    # of |v| as the selection threshold instead of an exact top-k
+    thresh = jnp.quantile(flat, s_now)
+    mask = (jnp.abs(v) >= thresh) | in_rampup  # no compression pre-rampup
+    encoded = jnp.where(mask, v, 0.0)
+    return {"UOut": jnp.where(mask, 0.0, u),
+            "VOut": jnp.where(mask, 0.0, v),
+            "EncodeGrad": encoded,
+            "GradOut": encoded}

@@ -48,6 +48,7 @@ __all__ = [
     "Ftrl",
     "FtrlOptimizer",
     "LambOptimizer",
+    "DGCMomentumOptimizer",
     "RecomputeOptimizer",
     "PipelineOptimizer",
     "ExponentialMovingAverage",
@@ -540,6 +541,72 @@ class LambOptimizer(AdamOptimizer):
 
     def _extra_attrs(self):
         return {"weight_decay": self._weight_decay}
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Momentum + deep gradient compression (reference optimizer.py:1039
+    DGCMomentumOptimizer + operators/dgc_op.cc): small gradients
+    accumulate locally (with momentum correction) until their velocity
+    crosses the top-k threshold; only the selected entries enter the
+    allreduced update. See the dgc op docstring for the TPU collective
+    note."""
+
+    _type = "momentum"
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._rampup_begin_step = rampup_begin_step
+        self._rampup_step = rampup_step
+        self._sparsity = list(sparsity)
+        self._global_step_var = None
+
+    def _create_accumulators(self, block, parameters):
+        from .layers import tensor as layers_tensor
+
+        for p in parameters:
+            self._add_accumulator("dgc_u", p)
+            self._add_accumulator("dgc_v", p)
+        if self._global_step_var is None:
+            self._global_step_var = layers_tensor.create_global_var(
+                name=framework.unique_name.generate("dgc_step"),
+                shape=[1], value=0, dtype="float32", persistable=True)
+            block.append_op("increment",
+                            inputs={"X": [self._global_step_var]},
+                            outputs={"Out": [self._global_step_var]},
+                            attrs={"step": 1.0}, infer_shape=False)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        u = self._get_accumulator("dgc_u", p)
+        v = self._get_accumulator("dgc_v", p)
+        encoded = block.create_var(
+            name=framework.unique_name.generate(p.name + "_dgc_enc"),
+            shape=p.shape, dtype=p.dtype)
+        block.append_op(
+            "dgc",
+            inputs={"U": [u], "V": [v], "Grad": [g],
+                    "CurrentStep": [self._global_step_var]},
+            outputs={"UOut": [u], "VOut": [v], "EncodeGrad": [encoded],
+                     "GradOut": [encoded]},
+            attrs={"m": self._momentum,
+                   "use_nesterov": self._use_nesterov,
+                   "sparsity": self._sparsity,
+                   "rampup_begin_step": float(self._rampup_begin_step),
+                   "rampup_step": float(self._rampup_step)},
+            infer_shape=False)
+        # the momentum lives INSIDE the dgc u-accumulator (momentum
+        # correction); the parameter update itself is plain SGD on the
+        # encoded gradient (reference dgc_momentum_op's post-rampup arm)
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [p], "Grad": [encoded],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [p]},
+            infer_shape=False)
 
 
 class RecomputeOptimizer(Optimizer):

@@ -1,0 +1,10 @@
+"""paddle.tensor 2.0-alpha namespace (reference python/paddle/tensor):
+thin re-exports of the tensor-manipulation surface, like the
+reference's early namespace stubs."""
+from .layers import (  # noqa: F401
+    abs, argmax, argmin, argsort, assign, cast, ceil, concat, cos, diag,
+    exp, eye, fill_constant, floor, gather, gather_nd, linspace, log,
+    matmul, ones, pow, range, reshape, rsqrt, scale, scatter, shape, sin,
+    slice, split, sqrt, square, squeeze, stack, tanh, topk, transpose,
+    unsqueeze, unstack, where, zeros,
+)

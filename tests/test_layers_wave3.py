@@ -108,12 +108,14 @@ class TestLossesWave3:
     def test_dice_loss_perfect_overlap(self):
         def build():
             p = fluid.data(name="p", shape=[4, 2], dtype="float32")
-            l = fluid.data(name="l", shape=[4, 2], dtype="int64")
+            l = fluid.data(name="l", shape=[4, 1], dtype="int64")
             return fluid.layers.dice_loss(p, l)
 
-        ones = np.ones((4, 2))
-        (o,) = _run(build, {"p": ones.astype("float32"),
-                            "l": ones.astype("int64")})
+        # prediction mass fully on the labeled class -> dice 1, loss 0
+        probs = np.zeros((4, 2), "float32")
+        probs[:, 1] = 1.0
+        labels = np.ones((4, 1), "int64")
+        (o,) = _run(build, {"p": probs, "l": labels})
         np.testing.assert_allclose(np.asarray(o).ravel()[0], 0.0,
                                    atol=1e-4)
 
@@ -321,3 +323,30 @@ class TestSequenceExtras:
 
         (o,) = _run(build, {"x": x})
         np.testing.assert_allclose(np.asarray(o).ravel(), [1.0, 14.0])
+
+
+class TestWarpctcLengths:
+    def test_padded_timesteps_ignored(self):
+        """Loss with explicit input_length == loss on the truncated
+        logits: pad steps must not contribute."""
+        T, C = 4, 3
+        rng = np.random.RandomState(0)
+        logits = rng.randn(1, T, C).astype("float32")
+
+        def build_padded():
+            lg = fluid.data(name="lg", shape=[1, T, C], dtype="float32")
+            lb = fluid.data(name="lb", shape=[1, 1], dtype="int32")
+            ln = fluid.data(name="ln", shape=[1], dtype="int32")
+            return fluid.layers.warpctc(lg, lb, blank=0, input_length=ln)
+
+        def build_short():
+            lg = fluid.data(name="lg", shape=[1, 2, C], dtype="float32")
+            lb = fluid.data(name="lb", shape=[1, 1], dtype="int32")
+            return fluid.layers.warpctc(lg, lb, blank=0)
+
+        lab = np.array([[1]], dtype="int32")
+        (lp,) = _run(build_padded, {"lg": logits, "lb": lab,
+                                    "ln": np.array([2], "int32")})
+        (ls,) = _run(build_short, {"lg": logits[:, :2], "lb": lab})
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(ls),
+                                   rtol=1e-5)

@@ -294,3 +294,83 @@ class TestStateOpsPrunedForTest:
                 w_ema = np.asarray(scope.find_var(w_name).raw().array)
         # frozen params + bias-corrected warm-up EMA ~= params
         np.testing.assert_allclose(w_ema, w, rtol=1e-4, atol=1e-5)
+
+
+class TestDGCMomentum:
+    def test_small_grads_accumulate_until_selected(self):
+        """DGC semantics: with high sparsity only the largest-velocity
+        entries update immediately; suppressed entries accumulate and
+        apply later — long-run training still converges."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[16, 8], dtype="float32")
+            y = fluid.data(name="y", shape=[16, 1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            opt = fluid.optimizer.DGCMomentumOptimizer(
+                learning_rate=0.05, momentum=0.9, rampup_begin_step=0,
+                sparsity=[0.75])
+            opt.minimize(loss)
+        types = [op.type for op in main.global_block().ops]
+        assert "dgc" in types and "sgd" in types
+        rng = np.random.RandomState(0)
+        W = rng.randn(8, 1).astype("float32")
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = []
+            for i in range(60):
+                xb = rng.randn(16, 8).astype("float32")
+                (l,) = exe.run(main, feed={"x": xb, "y": xb @ W},
+                               fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+        assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+class TestDistributions:
+    def test_normal_log_prob_and_kl(self):
+        from paddle_tpu.distribution import Normal
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            n1 = Normal(0.0, 1.0)
+            n2 = Normal(1.0, 2.0)
+            v = fluid.layers.fill_constant([1], "float32", 0.5)
+            lp = n1.log_prob(v)
+            kl = n1.kl_divergence(n2)
+            ent = n1.entropy()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            lp_v, kl_v, ent_v = exe.run(main, feed={},
+                                        fetch_list=[lp, kl, ent])
+        import math
+
+        np.testing.assert_allclose(
+            float(np.asarray(lp_v).ravel()[0]),
+            -0.5 * 0.25 - 0.5 * math.log(2 * math.pi), rtol=1e-5)
+        # KL(N(0,1) || N(1,2)) = log(2) + (1+1)/(2*4) - 0.5
+        np.testing.assert_allclose(
+            float(np.asarray(kl_v).ravel()[0]),
+            math.log(2.0) + 2.0 / 8.0 - 0.5, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(np.asarray(ent_v).ravel()[0]),
+            0.5 + 0.5 * math.log(2 * math.pi), rtol=1e-5)
+
+    def test_categorical_entropy_uniform(self):
+        from paddle_tpu.distribution import Categorical
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            logits = fluid.layers.fill_constant([1, 4], "float32", 0.0)
+            ent = Categorical(logits).entropy()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (e,) = exe.run(main, feed={}, fetch_list=[ent])
+        np.testing.assert_allclose(float(np.asarray(e).ravel()[0]),
+                                   np.log(4.0), rtol=1e-5)
