@@ -508,13 +508,18 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
                               "Hidden": [hid]},
                      attrs={"origin_mode": origin_mode},
                      infer_shape=False)
+    b = int(hidden.shape[0])
+    hid.shape = (b, d)
+    rhp.shape = (b, d)
+    gate.shape = (b, 3 * d)
     return hid, rhp, gate
 
 
 def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
               param_attr=None, bias_attr=None, name=None):
     """(reference layers/rnn.py lstm_unit: fc + lstm_unit op)."""
-    from .nn import concat, fc
+    from .nn import fc
+    from .tensor import concat
 
     helper = LayerHelper("lstm_unit", input=x_t)
     d = int(cell_t_prev.shape[-1])
@@ -528,6 +533,8 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
                      outputs={"C": [c], "H": [h]},
                      attrs={"forget_bias": forget_bias},
                      infer_shape=False)
+    c.shape = tuple(cell_t_prev.shape)
+    h.shape = tuple(cell_t_prev.shape)
     return h, c
 
 
@@ -626,3 +633,70 @@ def lod_reset(x, y=None, target_lod=None):
                      attrs=attrs, infer_shape=False)
     out.shape = tuple(x.shape)
     return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """CRF negative log-likelihood (reference layers/nn.py
+    linear_chain_crf over linear_chain_crf_op). Dense [B, T, K] input;
+    creates the [K+2, K] transition parameter. `length` is not yet
+    honored — pad with the repeated last label (the NLL of the padded
+    tail is then constant wrt the emissions)."""
+    if length is not None:
+        raise NotImplementedError(
+            "linear_chain_crf(length=...) is not supported yet; pad "
+            "labels with the repeated final label instead")
+    helper = LayerHelper("linear_chain_crf", input=input,
+                         param_attr=param_attr)
+    dtype = helper.input_dtype()
+    k = int(input.shape[-1])
+    trans = helper.create_parameter(attr=helper.param_attr,
+                                    shape=[k + 2, k], dtype=dtype)
+    alpha = helper.create_variable_for_type_inference(dtype)
+    em_exps = helper.create_variable_for_type_inference(dtype)
+    tr_exps = helper.create_variable_for_type_inference(dtype)
+    ll = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [trans],
+                "Label": [label]},
+        outputs={"Alpha": [alpha], "EmissionExps": [em_exps],
+                 "TransitionExps": [tr_exps], "LogLikelihood": [ll]},
+        infer_shape=False)
+    ll.shape = (int(input.shape[0]) if len(input.shape) == 3 else 1, 1)
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode using the transition learned by
+    linear_chain_crf (reference layers/nn.py crf_decoding)."""
+    helper = LayerHelper("crf_decoding", input=input)
+    # reuse the transition parameter created by linear_chain_crf
+    from ..param_attr import ParamAttr
+
+    name = param_attr.name if isinstance(param_attr, ParamAttr) else None
+    blk = helper.main_program.global_block()
+    trans = None
+    if name:
+        trans = blk._find_var_recursive(name)
+    if trans is None:
+        k = int(input.shape[-1])
+        matches = [p for p in blk.all_parameters
+                   if p.shape and len(p.shape) == 2
+                   and p.shape[0] == k + 2 and p.shape[1] == k]
+        # most recently created wins (the CRF layer built just before);
+        # pass a NAMED param_attr to disambiguate multiple CRFs
+        trans = matches[-1] if matches else None
+    if trans is None:
+        raise ValueError("crf_decoding: no transition parameter found; "
+                         "run linear_chain_crf first or name the param")
+    out = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": [input], "Transition": [trans]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op("crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [out]}, infer_shape=False)
+    out.shape = tuple(input.shape[:-1])
+    return out
+
+
+__all__ += ["linear_chain_crf", "crf_decoding"]

@@ -295,3 +295,149 @@ class StaticRNN:
         if self._result is None:
             raise RuntimeError("StaticRNN not built — use `with rnn.step()`")
         return self._result[0] if len(self._result) == 1 else self._result
+
+
+class RNNCell:
+    """Base cell (reference layers/rnn.py RNNCell): call(inputs, states)
+    -> (outputs, new_states)."""
+
+    def call(self, inputs, states):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states):
+        return self.call(inputs, states)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0):
+        from .tensor import fill_constant
+
+        b = int(batch_ref.shape[0])
+        shapes = shape if isinstance(shape, (list, tuple)) and shape and \
+            isinstance(shape[0], (list, tuple)) else [shape]
+        outs = [fill_constant([b] + [int(s) for s in sh], dtype,
+                              init_value) for sh in shapes]
+        return outs if len(outs) > 1 else outs[0]
+
+
+class LSTMCell(RNNCell):
+    """(reference layers/rnn.py LSTMCell): one LSTM step built from fc +
+    the lstm_unit op; state = [hidden, cell]. Parameters are NAMED once
+    per cell instance so every time step of an unroll shares the same
+    recurrent weights (LayerHelper reuses parameters by name)."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 name="LSTMCell"):
+        from .. import framework
+        from ..param_attr import ParamAttr
+
+        self.hidden_size = hidden_size
+        base = framework.unique_name.generate(name)
+        self._param_attr = param_attr if param_attr is not None else             ParamAttr(name=base + "_w")
+        self._bias_attr = bias_attr if bias_attr is not None else             ParamAttr(name=base + "_b")
+
+    def call(self, inputs, states):
+        from .extras import lstm_unit
+
+        h_prev, c_prev = states
+        h, c = lstm_unit(inputs, h_prev, c_prev,
+                         param_attr=self._param_attr,
+                         bias_attr=self._bias_attr)
+        return h, [h, c]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+
+class GRUCell(RNNCell):
+    """(reference layers/rnn.py GRUCell): fc projection + gru_unit op;
+    state = hidden. The projection and recurrent weights get DISTINCT
+    per-instance names (shared across steps, never across the two ops —
+    they have different shapes)."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 name="GRUCell"):
+        from .. import framework
+        from ..param_attr import ParamAttr
+
+        self.hidden_size = hidden_size
+        base = framework.unique_name.generate(name)
+        # a user-supplied NAMED param_attr cannot serve both ops (their
+        # shapes differ); derive distinct names from it
+        user_name = getattr(param_attr, "name", None) if param_attr else             None
+        prefix = user_name or base
+        self._proj_attr = ParamAttr(name=prefix + "_proj_w")
+        self._rec_attr = ParamAttr(name=prefix + "_rec_w")
+        self._bias_attr = bias_attr if bias_attr is not None else             ParamAttr(name=prefix + "_b")
+
+    def call(self, inputs, states):
+        from .extras import gru_unit
+        from .nn import fc
+
+        h_prev = states[0] if isinstance(states, (list, tuple)) else states
+        x = fc(inputs, size=3 * self.hidden_size,
+               param_attr=self._proj_attr, bias_attr=False)
+        h, _, _ = gru_unit(x, h_prev, 3 * self.hidden_size,
+                           param_attr=self._rec_attr,
+                           bias_attr=self._bias_attr)
+        return h, [h]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size]]
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run `cell` over the time axis of dense inputs (reference
+    layers/rnn.py rnn): unrolled via StaticRNN-style slicing, so the
+    whole program still compiles. Returns (outputs, final_states)."""
+    from .nn import slice as nn_slice
+    from .nn import squeeze, stack
+    from .tensor import cast, fill_constant
+
+    time_axis = 0 if time_major else 1
+    batch_axis = 1 if time_major else 0
+    T = int(inputs.shape[time_axis])
+    B = int(inputs.shape[batch_axis])
+    states = initial_states
+    if states is None:
+        shapes = cell.state_shape
+        states = [fill_constant([B] + [int(d) for d in sh], "float32",
+                                0.0) for sh in shapes]
+    if not isinstance(states, (list, tuple)):
+        states = [states]
+    states = list(states)
+    len_mask = None
+    if sequence_length is not None:
+        # [T, B] step-validity mask; padded steps carry the old state
+        from .sequence_lod import sequence_mask
+
+        m = sequence_mask(sequence_length, maxlen=T)  # [B, T]
+        len_mask = cast(m, inputs.dtype)
+    outs = []
+    steps = range(T - 1, -1, -1) if is_reverse else range(T)
+    for i in steps:
+        x_t = squeeze(nn_slice(inputs, axes=[time_axis], starts=[i],
+                               ends=[i + 1]), axes=[time_axis])
+        o, new_states = cell.call(x_t, list(states))
+        if len_mask is not None:
+            from .nn import elementwise_add, elementwise_mul
+            from .ops import scale as _scale_op
+
+            m_t = nn_slice(len_mask, axes=[1], starts=[i], ends=[i + 1])
+            inv_m = _scale_op(m_t, scale=-1.0, bias=1.0)
+            new_states = [
+                elementwise_add(elementwise_mul(n, m_t),
+                                elementwise_mul(s, inv_m))
+                for n, s in zip(new_states, states)]
+            o = elementwise_mul(o, m_t)
+        states = new_states
+        outs.append(o)
+    if is_reverse:
+        outs = outs[::-1]
+    outputs = stack(outs, axis=time_axis)
+    return outputs, states
+
+
+__all__ += ["RNNCell", "LSTMCell", "GRUCell", "rnn"]

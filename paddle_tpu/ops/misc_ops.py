@@ -543,3 +543,93 @@ def _lod_reset(executor, op, scope):
     t = LoDTensor(arr)
     t.set_lod([target])
     executor._write_var(scope, op.output("Out")[0], t)
+
+
+@register_op(
+    "linear_chain_crf",
+    inputs=[In("Emission"), In("Transition"), In("Label", no_grad=True)],
+    outputs=[Out("Alpha", no_grad=True), Out("EmissionExps", no_grad=True),
+             Out("TransitionExps", no_grad=True), Out("LogLikelihood")],
+)
+def _linear_chain_crf(ins, attrs):
+    """Linear-chain CRF negative log-likelihood over DENSE [B, T, K]
+    emissions (reference linear_chain_crf_op.h works on LoD sequences;
+    the padded-batch form is the TPU-native layout — pad with repeated
+    last label and length masking upstream).
+
+    Transition: [K+2, K] — row 0 start weights, row 1 end weights, rows
+    2.. the KxK transition matrix, the reference's exact layout."""
+    em = ins["Emission"]
+    if em.ndim == 2:
+        em = em[None]
+    labels = ins["Label"].astype(jnp.int32)
+    labels = labels.reshape(em.shape[0], -1)
+    trans = ins["Transition"]
+    k = em.shape[-1]
+    start, end, T_mat = trans[0], trans[1], trans[2:]
+    b, t, _ = em.shape
+
+    # log partition via forward algorithm
+    alpha0 = start[None, :] + em[:, 0]
+
+    def fwd(alpha, e_t):
+        scores = alpha[:, :, None] + T_mat[None, :, :] + e_t[:, None, :]
+        return jax.nn.logsumexp(scores, axis=1), None
+
+    alpha, _ = jax.lax.scan(fwd, alpha0,
+                            jnp.swapaxes(em[:, 1:], 0, 1))
+    log_z = jax.nn.logsumexp(alpha + end[None, :], axis=1)
+
+    # gold path score
+    rows = jnp.arange(b)
+    gold = start[labels[:, 0]] + em[rows, 0, labels[:, 0]]
+    for i in range(1, t):
+        gold = gold + T_mat[labels[:, i - 1], labels[:, i]] + \
+            em[rows, i, labels[:, i]]
+    gold = gold + end[labels[:, -1]]
+    return {"LogLikelihood": (log_z - gold).reshape(b, 1),
+            "Alpha": alpha, "EmissionExps": jnp.exp(em),
+            "TransitionExps": jnp.exp(trans)}
+
+
+@register_op(
+    "crf_decoding",
+    inputs=[In("Emission", no_grad=True), In("Transition", no_grad=True),
+            In("Label", dispensable=True, no_grad=True)],
+    outputs=[Out("ViterbiPath")],
+    grad=None,
+)
+def _crf_decoding(ins, attrs):
+    """Viterbi decode (reference crf_decoding_op.h) over dense
+    [B, T, K] emissions; returns the best path [B, T] (or a 0/1 match
+    mask against Label when provided, like the reference)."""
+    em = ins["Emission"]
+    if em.ndim == 2:
+        em = em[None]
+    trans = ins["Transition"]
+    start, end, T_mat = trans[0], trans[1], trans[2:]
+    b, t, k = em.shape
+
+    delta0 = start[None, :] + em[:, 0]
+
+    def step(delta, e_t):
+        scores = delta[:, :, None] + T_mat[None, :, :]
+        best = jnp.max(scores, axis=1) + e_t
+        arg = jnp.argmax(scores, axis=1)
+        return best, arg
+
+    delta, back = jax.lax.scan(step, delta0,
+                               jnp.swapaxes(em[:, 1:], 0, 1))
+    last = jnp.argmax(delta + end[None, :], axis=1)  # [b]
+
+    def backtrack(state, bp_t):
+        prev = jnp.take_along_axis(bp_t, state[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(backtrack, last, back, reverse=True)
+    path = jnp.concatenate([jnp.swapaxes(path_rev, 0, 1),
+                            last[:, None]], axis=1)  # [b, t]
+    if ins.get("Label") is not None:
+        lab = ins["Label"].astype(jnp.int32).reshape(b, t)
+        return {"ViterbiPath": (path == lab).astype(jnp.int64)}
+    return {"ViterbiPath": path.astype(jnp.int64)}

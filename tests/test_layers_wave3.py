@@ -350,3 +350,141 @@ class TestWarpctcLengths:
         (ls,) = _run(build_short, {"lg": logits[:, :2], "lb": lab})
         np.testing.assert_allclose(np.asarray(lp), np.asarray(ls),
                                    rtol=1e-5)
+
+
+class TestCRF:
+    def test_crf_nll_matches_brute_force(self):
+        B, T, K = 2, 3, 3
+        rng = np.random.RandomState(0)
+        em = rng.randn(B, T, K).astype("float32")
+        lab = rng.randint(0, K, (B, T)).astype("int64")
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            e = fluid.data(name="e", shape=[B, T, K], dtype="float32")
+            l = fluid.data(name="l", shape=[B, T], dtype="int64")
+            nll = fluid.layers.linear_chain_crf(e, l)
+            path = fluid.layers.crf_decoding(e, param_attr=None)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            trans_name = main.global_block().all_parameters[0].name
+            nll_v, path_v = exe.run(main, feed={"e": em, "l": lab},
+                                    fetch_list=[nll, path])
+            trans = np.asarray(scope.find_var(trans_name).raw().array)
+
+        start, end, T_mat = trans[0], trans[1], trans[2:]
+        import itertools
+
+        for b in range(B):
+            scores = {}
+            for seq in itertools.product(range(K), repeat=T):
+                s = start[seq[0]] + em[b, 0, seq[0]]
+                for i in range(1, T):
+                    s += T_mat[seq[i - 1], seq[i]] + em[b, i, seq[i]]
+                s += end[seq[-1]]
+                scores[seq] = s
+            log_z = np.log(np.sum(np.exp(list(scores.values()))))
+            gold = scores[tuple(lab[b])]
+            np.testing.assert_allclose(
+                float(np.asarray(nll_v)[b, 0]), log_z - gold, rtol=1e-4)
+            best = max(scores, key=scores.get)
+            np.testing.assert_array_equal(np.asarray(path_v)[b],
+                                          np.asarray(best))
+
+    def test_crf_trains(self):
+        B, T, K = 4, 5, 3
+        rng = np.random.RandomState(1)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            e = fluid.data(name="e", shape=[B, T, K], dtype="float32")
+            l = fluid.data(name="l", shape=[B, T], dtype="int64")
+            feat = fluid.layers.fc(e, K, num_flatten_dims=2)
+            nll = fluid.layers.mean(fluid.layers.linear_chain_crf(feat, l))
+            fluid.optimizer.AdamOptimizer(0.05).minimize(nll)
+        feed = {"e": rng.randn(B, T, K).astype("float32"),
+                "l": rng.randint(0, K, (B, T)).astype("int64")}
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ls = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[nll])[0]).ravel()[0])
+                  for _ in range(20)]
+        assert ls[-1] < ls[0]
+
+
+class TestRNNCells:
+    def test_lstm_cell_rnn_trains(self):
+        B, T, D, H = 4, 3, 5, 6
+        rng = np.random.RandomState(2)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[B, T, D], dtype="float32")
+            cell = fluid.layers.LSTMCell(H)
+            outs, final = fluid.layers.rnn(cell, x)
+            loss = fluid.layers.mean(outs)
+            fluid.optimizer.SGD(0.5).minimize(loss)
+        feed = {"x": rng.randn(B, T, D).astype("float32")}
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ls = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]).ravel()[0])
+                  for _ in range(8)]
+        assert ls[-1] < ls[0]
+
+    def test_gru_cell_shapes(self):
+        B, T, D, H = 2, 3, 4, 5
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[B, T, D], dtype="float32")
+            cell = fluid.layers.GRUCell(H)
+            outs, final = fluid.layers.rnn(cell, x)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (o,) = exe.run(main, feed={
+                "x": np.zeros((B, T, D), "float32")}, fetch_list=[outs])
+        assert np.asarray(o).shape == (B, T, H)
+
+
+class TestRNNCellSemantics:
+    def test_weights_shared_across_steps(self):
+        """The unroll must reuse ONE weight set (an RNN), not T sets."""
+        B, T, D, H = 2, 4, 3, 5
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[B, T, D], dtype="float32")
+            cell = fluid.layers.LSTMCell(H)
+            fluid.layers.rnn(cell, x)
+            n_params = len(main.global_block().all_parameters)
+        assert n_params == 2, n_params  # one weight + one bias, not 2*T
+
+    def test_sequence_length_freezes_state(self):
+        B, T, D, H = 2, 4, 3, 3
+        rng = np.random.RandomState(0)
+        x = rng.randn(B, T, D).astype("float32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.data(name="x", shape=[B, T, D], dtype="float32")
+            lens = fluid.data(name="lens", shape=[B], dtype="int64")
+            cell = fluid.layers.GRUCell(H)
+            outs, final = fluid.layers.rnn(cell, xv,
+                                           sequence_length=lens)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            o, f = exe.run(main, feed={
+                "x": x, "lens": np.array([2, 4], "int64")},
+                fetch_list=[outs, final[0]])
+        o = np.asarray(o)
+        # padded steps emit zeros and the final state equals the
+        # state at the last REAL step
+        np.testing.assert_allclose(o[0, 2:], 0.0, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(f)[0], o[0, 1],
+                                   rtol=1e-5, atol=1e-6)
