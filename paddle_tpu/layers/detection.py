@@ -78,6 +78,7 @@ def iou_similarity(x, y, box_normalized=True, name=None):
                      outputs={"Out": [out]},
                      attrs={"box_normalized": box_normalized},
                      infer_shape=False)
+    out.shape = (int(x.shape[0]), int(y.shape[0]))
     return out
 
 
@@ -98,6 +99,11 @@ def box_coder(prior_box, prior_box_var, target_box,
     helper.append_op("box_coder", inputs=inputs,
                      outputs={"OutputBox": [out]}, attrs=attrs,
                      infer_shape=False)
+    # encode: [num_target, num_prior, 4]; decode keeps target's shape
+    if code_type == "encode_center_size":
+        out.shape = (int(target_box.shape[0]), int(prior_box.shape[0]), 4)
+    else:
+        out.shape = tuple(target_box.shape)
     return out
 
 
@@ -178,3 +184,135 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
                "normalized": normalized},
         infer_shape=False)
     return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    helper = LayerHelper("bipartite_match", input=dist_matrix)
+    idx = helper.create_variable_for_type_inference("int32")
+    dist = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "bipartite_match", inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [idx],
+                 "ColToRowMatchDist": [dist]},
+        attrs={"match_type": match_type,
+               "dist_threshold": dist_threshold},
+        infer_shape=False)
+    cols = int(dist_matrix.shape[-1])
+    # dense (non-LoD) DistMat is ONE batch in the host kernel; LoD input
+    # has one row-group per sequence (unknown statically)
+    n = 1 if not getattr(dist_matrix, "lod_level", 0) else -1
+    idx.shape = (n, cols)
+    dist.shape = (n, cols)
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    w = helper.create_variable_for_type_inference("float32")
+    inputs = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        inputs["NegIndices"] = [negative_indices]
+    helper.append_op("target_assign", inputs=inputs,
+                     outputs={"Out": [out], "OutWeight": [w]},
+                     attrs={"mismatch_value": mismatch_value},
+                     infer_shape=False)
+    n = int(matched_indices.shape[0])
+    m = int(matched_indices.shape[1])
+    k = int(input.shape[-1])
+    out.shape = (n, m, k)
+    w.shape = (n, m, 1)
+    return out, w
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper("density_prior_box", input=input)
+    dtype = helper.input_dtype()
+    boxes = helper.create_variable_for_type_inference(dtype)
+    variances = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={"densities": list(densities or []),
+               "fixed_sizes": list(fixed_sizes or []),
+               "fixed_ratios": list(fixed_ratios or []),
+               "variances": list(variance), "clip": clip,
+               "step_w": steps[0], "step_h": steps[1], "offset": offset,
+               "flatten_to_2d": flatten_to_2d},
+        infer_shape=False)
+    return boxes, variances
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """Decode + NMS (reference layers/detection.py detection_output =
+    box_coder(decode_center_size) + transpose + multiclass_nms)."""
+    from .nn import softmax, transpose
+
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    scores_t = transpose(softmax(scores), perm=[0, 2, 1])
+    return multiclass_nms(decoded, scores_t,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, nms_eta=nms_eta,
+                          background_label=background_label)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mismatch_value=0, normalize=True, sample_size=None):
+    """SSD multibox loss (reference layers/detection.py ssd_loss
+    composition): IoU match gt->priors, box_coder-ENCODE the matched gt
+    against priors (so training and detection_output's decode agree),
+    assign loc/conf targets, smooth-L1 + softmax losses. All negatives
+    weigh into the confidence term (the reference mines the top-k
+    hardest; that refinement is a TODO). Single-image / dense-batch
+    contract: LoD-batched ground truth is not supported yet."""
+    from .loss import smooth_l1, softmax_with_cross_entropy
+    from .nn import reduce_sum, reshape
+
+    if getattr(gt_box, "lod_level", 0):
+        raise NotImplementedError(
+            "ssd_loss over LoD-batched ground truth is not supported "
+            "yet; feed per-image dense gt")
+    iou = iou_similarity(gt_box, prior_box)  # [num_gt, num_prior]
+    matched, _ = bipartite_match(iou, match_type, overlap_threshold)
+    # regression target = encoded offsets, matching the decode side
+    encoded = box_coder(prior_box,
+                        prior_box_var if prior_box_var is not None
+                        else [0.1, 0.1, 0.2, 0.2],
+                        gt_box, code_type="encode_center_size")
+    loc_tgt, loc_w = target_assign(encoded, matched,
+                                   mismatch_value=mismatch_value)
+    lab_tgt, _conf_w = target_assign(gt_label, matched,
+                                     mismatch_value=background_label)
+    B = int(location.shape[0])
+    P = int(prior_box.shape[0])
+    loc_r = reshape(location, [B, P, 4])
+    loc_l = smooth_l1(loc_r, loc_tgt)
+    loc_l = loc_l * loc_w
+    num_cls = int(confidence.shape[-1])
+    conf_r = reshape(confidence, [B * P, num_cls])
+    lab_r = reshape(lab_tgt, [B * P, 1])
+    conf_l = softmax_with_cross_entropy(conf_r, lab_r)
+    conf_l = reshape(conf_l, [B, P, 1])
+    total = (reduce_sum(loc_l) * loc_loss_weight
+             + reduce_sum(conf_l) * conf_loss_weight)
+    if normalize:
+        denom = reduce_sum(loc_w) + 1e-6
+        total = total / denom
+    return total
+
+
+__all__ += ["bipartite_match", "target_assign", "density_prior_box",
+            "detection_output", "ssd_loss"]

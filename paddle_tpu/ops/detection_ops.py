@@ -518,3 +518,151 @@ def _multiclass_nms(executor, op, scope):
     t = LoDTensor(out)
     t.set_lod([lod])
     executor._write_var(scope, op.output("Out")[0], t)
+
+
+@register_host_op(
+    "bipartite_match",
+    inputs=[In("DistMat", no_grad=True)],
+    outputs=[Out("ColToRowMatchIndices"), Out("ColToRowMatchDist")],
+    attrs={"match_type": "bipartite", "dist_threshold": 0.5},
+)
+def _bipartite_match(executor, op, scope):
+    """Greedy bipartite matching (reference bipartite_match_op.cc):
+    repeatedly take the globally-largest entry of the distance matrix,
+    optionally augmenting unmatched columns above a threshold
+    (per_prediction mode). DistMat may be LoD-batched over rows."""
+    from ..core.tensor import LoDTensor
+
+    v = scope.find_var(op.input("DistMat")[0]).raw()
+    dist = np.asarray(v.array if isinstance(v, LoDTensor) else v)
+    lod = v.lod() if isinstance(v, LoDTensor) and v.lod() else None
+    offsets = list(lod[-1]) if lod else [0, dist.shape[0]]
+    n = len(offsets) - 1
+    cols = dist.shape[1]
+    match_idx = np.full((n, cols), -1, np.int32)
+    match_dist = np.zeros((n, cols), np.float32)
+    for b in range(n):
+        sub = dist[offsets[b]:offsets[b + 1]].copy()
+        rows = sub.shape[0]
+        row_used = np.zeros(rows, bool)
+        for _ in range(min(rows, cols)):
+            r, c = np.unravel_index(np.argmax(sub), sub.shape)
+            if sub[r, c] <= 0:
+                break
+            match_idx[b, c] = r
+            match_dist[b, c] = sub[r, c]
+            sub[r, :] = -1.0
+            sub[:, c] = -1.0
+            row_used[r] = True
+        if op.attrs.get("match_type") == "per_prediction":
+            thr = op.attrs.get("dist_threshold", 0.5)
+            sub2 = dist[offsets[b]:offsets[b + 1]]
+            for c in range(cols):
+                if match_idx[b, c] == -1:
+                    r = int(np.argmax(sub2[:, c]))
+                    if sub2[r, c] >= thr:
+                        match_idx[b, c] = r
+                        match_dist[b, c] = sub2[r, c]
+    executor._write_var(scope, op.output("ColToRowMatchIndices")[0],
+                        match_idx)
+    executor._write_var(scope, op.output("ColToRowMatchDist")[0],
+                        match_dist)
+
+
+@register_host_op(
+    "target_assign",
+    inputs=[In("X", no_grad=True), In("MatchIndices", no_grad=True),
+            In("NegIndices", dispensable=True, no_grad=True)],
+    outputs=[Out("Out"), Out("OutWeight")],
+    attrs={"mismatch_value": 0},
+)
+def _target_assign(executor, op, scope):
+    """Scatter per-row matched targets (reference target_assign_op.h):
+    out[i, j] = X[i, match[i, j]] where matched, else mismatch_value;
+    weights 1 for matched (and negative-mined) entries."""
+    from ..core.tensor import LoDTensor
+
+    xv = scope.find_var(op.input("X")[0]).raw()
+    x = np.asarray(xv.array if isinstance(xv, LoDTensor) else xv)
+    lod = xv.lod() if isinstance(xv, LoDTensor) and xv.lod() else None
+    match = np.asarray(
+        executor._read_var(scope, op.input("MatchIndices")[0]))
+    n, m = match.shape
+    k = x.shape[-1]
+    offsets = list(lod[-1]) if lod else [0, x.shape[0]]
+    mismatch = op.attrs.get("mismatch_value", 0)
+    out = np.full((n, m, k), mismatch, x.dtype)
+    w = np.zeros((n, m, 1), np.float32)
+    for b in range(n):
+        base = offsets[b] if lod else 0
+        for j in range(m):
+            r = match[b, j]
+            if r >= 0:
+                # 3-D X carries per-(row, prior) targets (the encoded
+                # box_coder output); 2-D X is one target row per match
+                out[b, j] = x[base + r, j] if x.ndim == 3 else x[base + r]
+                w[b, j] = 1.0
+    if op.input("NegIndices"):
+        nv = scope.find_var(op.input("NegIndices")[0]).raw()
+        neg = np.asarray(nv.array if isinstance(nv, LoDTensor) else nv)
+        noff = (list(nv.lod()[-1]) if isinstance(nv, LoDTensor)
+                and nv.lod() else [0, len(neg)])
+        for b in range(min(n, len(noff) - 1)):
+            for j in neg[noff[b]:noff[b + 1]].reshape(-1):
+                w[b, int(j)] = 1.0
+    executor._write_var(scope, op.output("Out")[0], out)
+    executor._write_var(scope, op.output("OutWeight")[0], w)
+
+
+@register_op(
+    "density_prior_box",
+    inputs=[In("Input", no_grad=True), In("Image", no_grad=True)],
+    outputs=[Out("Boxes"), Out("Variances")],
+    attrs={"densities": [], "fixed_sizes": [], "fixed_ratios": [],
+           "variances": [0.1, 0.1, 0.2, 0.2], "clip": False,
+           "step_w": 0.0, "step_h": 0.0, "offset": 0.5, "flatten_to_2d": False},
+)
+def _density_prior_box(ins, attrs):
+    """Densified SSD priors (reference density_prior_box_op.h): each
+    fixed_size spawns density^2 shifted centers per ratio."""
+    feat, img = ins["Input"], ins["Image"]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+    offset = attrs.get("offset", 0.5)
+    densities = [int(d) for d in attrs["densities"]]
+    fixed_sizes = [float(s) for s in attrs["fixed_sizes"]]
+    fixed_ratios = [float(r) for r in attrs["fixed_ratios"]]
+    boxes_pp = []  # (shift_x_frac, shift_y_frac, half_w, half_h)
+    for density, fs in zip(densities, fixed_sizes):
+        shift = 1.0 / density
+        for ratio in fixed_ratios:
+            bw = fs * np.sqrt(ratio) / 2.0
+            bh = fs / np.sqrt(ratio) / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    cx_off = (dj + 0.5) * shift - 0.5
+                    cy_off = (di + 0.5) * shift - 0.5
+                    boxes_pp.append((cx_off, cy_off, bw, bh))
+    npri = len(boxes_pp)
+    arr = jnp.asarray(boxes_pp, dtype=jnp.float32)
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    ctr_x = cxg[:, :, None] + arr[None, None, :, 0] * step_w
+    ctr_y = cyg[:, :, None] + arr[None, None, :, 1] * step_h
+    bw = arr[None, None, :, 2]
+    bh = arr[None, None, :, 3]
+    boxes = jnp.stack([(ctr_x - bw) / img_w, (ctr_y - bh) / img_h,
+                       (ctr_x + bw) / img_w, (ctr_y + bh) / img_h],
+                      axis=-1)
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.broadcast_to(
+        jnp.asarray(attrs["variances"], dtype=jnp.float32).reshape(
+            1, 1, 1, 4), (h, w, npri, 4))
+    if attrs.get("flatten_to_2d", False):
+        boxes = boxes.reshape(-1, 4)
+        variances = variances.reshape(-1, 4)
+    return {"Boxes": boxes, "Variances": variances}

@@ -349,3 +349,98 @@ class TestSequenceTail:
                     ["slice_out"])
         ref = np.concatenate([x[1:2], x[2:4]], axis=0)
         np.testing.assert_array_equal(np.asarray(o), ref)
+
+
+class TestSSDPath:
+    def test_bipartite_match_greedy(self):
+        iou = np.array([[0.9, 0.1, 0.2],
+                        [0.3, 0.8, 0.1]], dtype="float32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            d = fluid.data(name="d", shape=[2, 3], dtype="float32")
+            idx, dist = fluid.layers.bipartite_match(d)
+        (i_v, d_v) = _run(main, startup, {"d": iou}, [idx, dist])
+        np.testing.assert_array_equal(np.asarray(i_v)[0], [0, 1, -1])
+        np.testing.assert_allclose(np.asarray(d_v)[0], [0.9, 0.8, 0.0],
+                                   rtol=1e-6)
+
+    def test_target_assign(self):
+        x = np.array([[1, 2], [3, 4]], dtype="float32")
+        match = np.array([[1, -1, 0]], dtype="int32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.data(name="x", shape=[2, 2], dtype="float32")
+            mv = fluid.data(name="m", shape=[1, 3], dtype="int32")
+            out, w = fluid.layers.target_assign(xv, mv, mismatch_value=9)
+        (o, wv) = _run(main, startup, {"x": x, "m": match}, [out, w])
+        np.testing.assert_allclose(
+            np.asarray(o)[0], [[3, 4], [9, 9], [1, 2]], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(wv)[0].ravel(),
+                                   [1, 0, 1], rtol=1e-6)
+
+    def test_density_prior_box_count(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            feat = fluid.data(name="f", shape=[1, 4, 2, 2],
+                              dtype="float32")
+            img = fluid.data(name="i", shape=[1, 3, 16, 16],
+                             dtype="float32")
+            boxes, variances = fluid.layers.density_prior_box(
+                feat, img, densities=[2], fixed_sizes=[4.0],
+                fixed_ratios=[1.0], clip=True)
+        (b,) = _run(main, startup,
+                    {"f": np.zeros((1, 4, 2, 2), "float32"),
+                     "i": np.zeros((1, 3, 16, 16), "float32")}, [boxes])
+        assert np.asarray(b).shape == (2, 2, 4, 4)  # density^2 priors
+
+    def test_ssd_loss_builds_and_decreases(self):
+        P, C = 4, 3
+        rng = np.random.RandomState(0)
+        prior = np.array([[0.0, 0.0, 0.4, 0.4], [0.3, 0.3, 0.7, 0.7],
+                          [0.6, 0.6, 1.0, 1.0], [0.1, 0.5, 0.5, 0.9]],
+                         dtype="float32")
+        gt = np.array([[0.05, 0.05, 0.35, 0.35]], dtype="float32")
+        gt_lab = np.array([[1]], dtype="int64")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            feats = fluid.data(name="f", shape=[1, 8], dtype="float32")
+            loc = fluid.layers.fc(feats, P * 4)
+            conf = fluid.layers.fc(feats, P * C)
+            loc_r = fluid.layers.reshape(loc, [1, P, 4])
+            conf_r = fluid.layers.reshape(conf, [1, P, C])
+            gtb = fluid.data(name="gtb", shape=[1, 4], dtype="float32")
+            gtl = fluid.data(name="gtl", shape=[1, 1], dtype="int64")
+            pb = fluid.data(name="pb", shape=[P, 4], dtype="float32")
+            loss = fluid.layers.ssd_loss(loc_r, conf_r, gtb, gtl, pb)
+            fluid.optimizer.AdamOptimizer(0.05).minimize(loss)
+        feed = {"f": rng.rand(1, 8).astype("float32"), "gtb": gt,
+                "gtl": gt_lab, "pb": prior}
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ls = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]).ravel()[0])
+                  for _ in range(12)]
+        assert all(np.isfinite(ls))
+        assert ls[-1] < ls[0]
+
+    def test_detection_output_runs(self):
+        P, C = 3, 2
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            loc = fluid.data(name="loc", shape=[1, P, 4], dtype="float32")
+            scr = fluid.data(name="scr", shape=[1, P, C], dtype="float32")
+            pb = fluid.data(name="pb", shape=[P, 4], dtype="float32")
+            pbv = fluid.data(name="pbv", shape=[P, 4], dtype="float32")
+            out = fluid.layers.detection_output(loc, scr, pb, pbv,
+                                                background_label=-1)
+        rng = np.random.RandomState(1)
+        (o,) = _run(main, startup,
+                    {"loc": np.zeros((1, P, 4), "float32"),
+                     "scr": rng.rand(1, P, C).astype("float32"),
+                     "pb": np.array([[0, 0, .5, .5], [.2, .2, .7, .7],
+                                     [.5, .5, 1, 1]], "float32"),
+                     "pbv": np.full((P, 4), 0.1, "float32")}, [out])
+        o = np.asarray(o)
+        assert o.ndim == 2 and o.shape[1] == 6
