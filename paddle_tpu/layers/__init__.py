@@ -14,6 +14,12 @@ from .sequence_lod import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
+from ..distribution import (  # noqa: F401
+    Categorical,
+    MultivariateNormalDiag,
+    Normal,
+    Uniform,
+)
 from .detection import *  # noqa: F401,F403
 from .io import data  # noqa: F401
 from . import math_op_patch  # noqa: F401  (patches Variable operators)
@@ -43,4 +49,5 @@ __all__ = (
     + _det_all
     + _lrs_all
     + _extras_all
+    + ["Categorical", "MultivariateNormalDiag", "Normal", "Uniform"]
 )

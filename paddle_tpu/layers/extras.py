@@ -700,3 +700,256 @@ def crf_decoding(input, param_attr, label=None, length=None):
 
 
 __all__ += ["linear_chain_crf", "crf_decoding"]
+
+
+def sequence_slice(input, offset, length, name=None):
+    """(reference sequence_ops sequence_slice layer over the host op)."""
+    helper = LayerHelper("sequence_slice", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = 1
+    out.shape = (-1,) + tuple(input.shape[1:])
+    helper.append_op("sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = 1
+    out.shape = (-1,) + tuple(x.shape[2:])
+    helper.append_op("sequence_unpad",
+                     inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0,
+                input_image_size=None, out_stride=1, name=None):
+    if input_image_size is not None:
+        raise NotImplementedError(
+            "im2sequence with per-sample input_image_size is not "
+            "supported yet; crop/pad to a uniform size upstream")
+
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    helper = LayerHelper("im2sequence", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pads = _pair(padding)
+    helper.append_op(
+        "im2sequence", inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"kernels": _pair(filter_size), "strides": _pair(stride),
+               "paddings": pads * 2 if len(pads) == 2 else pads,
+               "out_stride": _pair(out_stride)},
+        infer_shape=False)
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper("grid_sampler", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("grid_sampler",
+                     inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]}, infer_shape=False)
+    out.shape = (int(x.shape[0]), int(x.shape[1]),
+                 int(grid.shape[1]), int(grid.shape[2]))
+    return out
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """log(1 + exp(min(x, threshold))) (reference soft_relu)."""
+    from .nn import elementwise_min
+    from .ops import exp, log, scale
+    from .tensor import fill_constant
+
+    from .nn import elementwise_max
+
+    capped = elementwise_min(
+        x, fill_constant([1], x.dtype, float(threshold)))
+    capped = elementwise_max(
+        capped, fill_constant([1], x.dtype, -float(threshold)))
+    return log(scale(exp(capped), scale=1.0, bias=1.0))
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """(reference layers/control_flow.py Print over the print host op)."""
+    helper = LayerHelper("print", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={"first_n": first_n, "message": message or "",
+               "summarize": summarize,
+               "print_tensor_name": print_tensor_name,
+               "print_tensor_type": print_tensor_type,
+               "print_tensor_shape": print_tensor_shape,
+               "print_tensor_lod": print_tensor_lod,
+               "print_phase": print_phase.upper()},
+        infer_shape=False)
+    out.shape = tuple(input.shape or ())
+    return out
+
+
+def gather_tree(ids, parents):
+    helper = LayerHelper("gather_tree", input=ids)
+    out = helper.create_variable_for_type_inference(ids.dtype)
+    helper.append_op("gather_tree",
+                     inputs={"Ids": [ids], "Parents": [parents]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    out.shape = tuple(ids.shape)
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("random_crop", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": [int(s) for s in shape],
+                            "seed": int(seed or 0)},
+                     infer_shape=False)
+    out.shape = tuple(x.shape[:len(x.shape) - len(shape)]) + \
+        tuple(int(s) for s in shape)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from .tensor import create_global_var
+
+    helper = LayerHelper("spectral_norm", input=weight)
+    h = int(weight.shape[dim])
+    w = 1
+    for i, s in enumerate(weight.shape):
+        if i != dim:
+            w *= int(s)
+    # random init (reference uses Normal(0,1)): a CONSTANT init would
+    # zero out against weights orthogonal to the all-ones vector and
+    # divide by sigma=0
+    from ..initializer import NormalInitializer
+
+    u = helper.main_program.global_block().create_var(
+        name=framework.unique_name.generate("spectral_norm_u"),
+        shape=(h,), dtype="float32", persistable=True)
+    u.stop_gradient = True
+    helper.set_variable_initializer(u, NormalInitializer(0.0, 1.0))
+    v = helper.main_program.global_block().create_var(
+        name=framework.unique_name.generate("spectral_norm_v"),
+        shape=(w,), dtype="float32", persistable=True)
+    v.stop_gradient = True
+    helper.set_variable_initializer(v, NormalInitializer(0.0, 1.0))
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op("spectral_norm",
+                     inputs={"Weight": [weight], "U": [u], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps},
+                     infer_shape=False)
+    out.shape = tuple(weight.shape)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    from .tensor import create_global_var
+
+    helper = LayerHelper("data_norm", input=input)
+    d = int(input.shape[-1])
+    size = create_global_var(
+        name=framework.unique_name.generate("dn_size"), shape=[d],
+        value=1e4, dtype="float32", persistable=True)
+    ssum = create_global_var(
+        name=framework.unique_name.generate("dn_sum"), shape=[d],
+        value=0.0, dtype="float32", persistable=True)
+    sqsum = create_global_var(
+        name=framework.unique_name.generate("dn_sqsum"), shape=[d],
+        value=1e4, dtype="float32", persistable=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference("float32")
+    scales = helper.create_variable_for_type_inference("float32")
+    helper.append_op("data_norm",
+                     inputs={"X": [input], "BatchSize": [size],
+                             "BatchSum": [ssum],
+                             "BatchSquareSum": [sqsum]},
+                     outputs={"Y": [out], "Means": [means],
+                              "Scales": [scales]},
+                     attrs={"epsilon": epsilon}, infer_shape=False)
+    out.shape = tuple(input.shape)
+    return out
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    from .tensor import create_global_var, fill_constant
+
+    helper = LayerHelper("center_loss", input=input)
+    d = int(input.shape[-1])
+    centers = create_global_var(
+        name=framework.unique_name.generate("centers"),
+        shape=[num_classes, d], value=0.0, dtype="float32",
+        persistable=True)
+    rate = alpha if isinstance(alpha, framework.Variable) else \
+        fill_constant([1], "float32", float(alpha))
+    diff = helper.create_variable_for_type_inference(input.dtype)
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "center_loss",
+        inputs={"X": [input], "Label": [label], "Centers": [centers],
+                "CenterUpdateRate": [rate]},
+        outputs={"CentersOut": [centers], "SampleCenterDiff": [diff],
+                 "Loss": [loss]},
+        attrs={"cluster_num": num_classes,
+               "need_update": update_center},
+        infer_shape=False)
+    loss.shape = (int(input.shape[0]), 1)
+    return loss
+
+
+def tensor_array_to_tensor(input, axis=0, name=None, use_stack=False,
+                           dtype="float32"):
+    """NOTE: the array's element shapes are runtime information, so the
+    returned Variable has no static shape — set `out.shape` manually
+    before feeding it to shape-inferring layers."""
+    helper = LayerHelper("tensor_array_to_tensor", input=None)
+    out = helper.main_program.current_block().create_var(
+        name=framework.unique_name.generate("ta2t"), dtype=dtype)
+    idx = helper.main_program.current_block().create_var(
+        name=framework.unique_name.generate("ta2t_idx"), dtype="int32")
+    helper.append_op("tensor_array_to_tensor",
+                     inputs={"X": [input]},
+                     outputs={"Out": [out], "OutIndex": [idx]},
+                     attrs={"axis": axis, "use_stack": use_stack},
+                     infer_shape=False)
+    return out, idx
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    if require_index:
+        raise NotImplementedError(
+            "adaptive_pool3d(require_index=True) (mask output) is not "
+            "supported yet")
+
+    def _triple(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v, v]
+
+    helper = LayerHelper("adaptive_pool3d", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": _triple(pool_size),
+               "adaptive": True})
+    return out
+
+
+__all__ += ["sequence_slice", "sequence_unpad", "im2sequence",
+            "grid_sampler", "soft_relu", "Print", "gather_tree",
+            "random_crop", "spectral_norm", "data_norm", "center_loss",
+            "tensor_array_to_tensor", "adaptive_pool3d"]

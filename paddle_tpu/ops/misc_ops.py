@@ -633,3 +633,138 @@ def _crf_decoding(ins, attrs):
         lab = ins["Label"].astype(jnp.int32).reshape(b, t)
         return {"ViterbiPath": (path == lab).astype(jnp.int64)}
     return {"ViterbiPath": path.astype(jnp.int64)}
+
+
+@register_op("gather_tree",
+             inputs=[In("Ids", no_grad=True), In("Parents", no_grad=True)],
+             outputs=[Out("Out")], grad=None)
+def _gather_tree(ins, attrs):
+    """Beam-search backtrace (reference gather_tree_op.cc): walk parent
+    pointers from the last step, yielding full beams [T, B, W]."""
+    ids, parents = ins["Ids"], ins["Parents"]
+    t, b, w = ids.shape
+    beams = jnp.arange(w)[None, :].repeat(b, axis=0)  # [B, W]
+
+    def step(state, tp):
+        id_t, par_t = tp
+        out_t = jnp.take_along_axis(id_t, state, axis=1)
+        nxt = jnp.take_along_axis(par_t, state, axis=1)
+        return nxt, out_t
+
+    _, outs = jax.lax.scan(step, beams, (ids, parents), reverse=True)
+    return {"Out": outs}
+
+
+@register_op("random_crop",
+             inputs=[In("X"), In("Seed", dispensable=True, no_grad=True)],
+             outputs=[Out("Out"), Out("SeedOut", dispensable=True,
+                                      no_grad=True)],
+             attrs={"shape": [], "startup_seed": 0}, needs_rng=True,
+             grad=None)
+def _random_crop(ins, attrs):
+    """Random spatial crop to attrs['shape'] (trailing dims; reference
+    random_crop_op.h)."""
+    from ..core.registry import RNG_SEED_ATTR
+
+    x = ins["X"]
+    shape = [int(s) for s in attrs["shape"]]
+    nd = len(shape)
+    key = jax.random.PRNGKey(ins[RNG_SEED_ATTR])
+    starts = []
+    for i, (full, want) in enumerate(zip(x.shape[-nd:], shape)):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, full - want + 1))
+    out = x
+    for i, (st, want) in enumerate(zip(starts, shape)):
+        axis = x.ndim - nd + i
+        out = jax.lax.dynamic_slice_in_dim(out, st, want, axis=axis)
+    return {"Out": out}
+
+
+@register_op("spectral_norm",
+             inputs=[In("Weight"), In("U", no_grad=True),
+                     In("V", no_grad=True)],
+             outputs=[Out("Out")],
+             attrs={"dim": 0, "power_iters": 1, "eps": 1e-12})
+def _spectral_norm(ins, attrs):
+    """Weight / sigma_max via power iteration (reference
+    spectral_norm_op.h; U/V persistable iterates)."""
+    w = ins["Weight"]
+    dim = int(attrs.get("dim", 0))
+    eps = attrs.get("eps", 1e-12)
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    u, v = ins["U"].reshape(-1), ins["V"].reshape(-1)
+    for _ in range(int(attrs.get("power_iters", 1))):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    return {"Out": w / (sigma + eps)}
+
+
+@register_op("data_norm",
+             inputs=[In("X"), In("BatchSize", no_grad=True),
+                     In("BatchSum", no_grad=True),
+                     In("BatchSquareSum", no_grad=True)],
+             outputs=[Out("Y"), Out("Means", no_grad=True),
+                      Out("Scales", no_grad=True)],
+             attrs={"epsilon": 1e-4})
+def _data_norm(ins, attrs):
+    """Normalization by accumulated batch statistics (reference
+    data_norm_op.cc): mean = sum/size, scale = sqrt(size/square_sum)."""
+    x = ins["X"]
+    eps = attrs.get("epsilon", 1e-4)
+    size = ins["BatchSize"]
+    mean = ins["BatchSum"] / size
+    # reference data_norm_op.cc:209: scale = sqrt(size / square_sum)
+    scale = jnp.sqrt(size / (ins["BatchSquareSum"] + eps))
+    return {"Y": (x - mean[None, :]) * scale[None, :],
+            "Means": mean, "Scales": scale}
+
+
+@register_op("center_loss",
+             inputs=[In("X"), In("Label", no_grad=True),
+                     In("Centers", no_grad=True),
+                     In("CenterUpdateRate", no_grad=True)],
+             outputs=[Out("CentersOut", no_grad=True), Out("SampleCenterDiff"),
+                      Out("Loss")],
+             attrs={"cluster_num": 0, "need_update": True})
+def _center_loss(ins, attrs):
+    """Center loss (reference center_loss_op.h): pull features toward
+    per-class centers; centers update by the mean residual."""
+    x = ins["X"]
+    label = ins["Label"].reshape(-1).astype(jnp.int32)
+    centers = ins["Centers"]
+    alpha = ins["CenterUpdateRate"].reshape(())
+    picked = centers[label]
+    diff = x - picked
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=-1, keepdims=True)
+    if attrs.get("need_update", True):
+        counts = jnp.zeros(centers.shape[0], x.dtype).at[label].add(1.0)
+        sums = jnp.zeros_like(centers).at[label].add(diff)
+        update = sums / (1.0 + counts)[:, None]
+        centers = centers + alpha * update
+    return {"CentersOut": centers, "SampleCenterDiff": diff,
+            "Loss": loss}
+
+
+@register_host_op("tensor_array_to_tensor",
+                  inputs=[In("X", no_grad=True)],
+                  outputs=[Out("Out"), Out("OutIndex")],
+                  attrs={"axis": 0, "use_stack": False})
+def _tensor_array_to_tensor(executor, op, scope):
+    """Concat/stack a LoDTensorArray (reference
+    tensor_array_to_tensor_op.cc)."""
+    arr = scope.find_var(op.input("X")[0]).get_lod_tensor_array()
+    axis = op.attrs.get("axis", 0)
+    mats = [np.asarray(t.array if hasattr(t, "array") else t)
+            for t in arr]
+    if op.attrs.get("use_stack", False):
+        out = np.stack(mats, axis=axis)
+    else:
+        out = np.concatenate(mats, axis=axis)
+    executor._write_var(scope, op.output("Out")[0], out)
+    executor._write_var(scope, op.output("OutIndex")[0],
+                        np.asarray([m.shape[axis] for m in mats],
+                                   np.int32))

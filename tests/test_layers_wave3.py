@@ -488,3 +488,78 @@ class TestRNNCellSemantics:
         np.testing.assert_allclose(o[0, 2:], 0.0, atol=1e-7)
         np.testing.assert_allclose(np.asarray(f)[0], o[0, 1],
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestFinalWrapperBatch:
+    def test_gather_tree(self):
+        # T=2, B=1, W=2 beams: parents at t=1 both point to beam 0
+        ids = np.array([[[1, 2]], [[3, 4]]], dtype="int64")
+        par = np.array([[[0, 0]], [[0, 0]]], dtype="int64")
+
+        def build():
+            i = fluid.data(name="i", shape=[2, 1, 2], dtype="int64")
+            p = fluid.data(name="p", shape=[2, 1, 2], dtype="int64")
+            return fluid.layers.gather_tree(i, p)
+
+        (o,) = _run(build, {"i": ids, "p": par})
+        np.testing.assert_array_equal(np.asarray(o)[:, 0, 0], [1, 3])
+        np.testing.assert_array_equal(np.asarray(o)[:, 0, 1], [1, 4])
+
+    def test_random_crop_shape_and_content(self):
+        x = np.arange(100, dtype="float32").reshape(1, 10, 10)
+
+        def build():
+            xv = fluid.data(name="x", shape=[1, 10, 10], dtype="float32")
+            return fluid.layers.random_crop(xv, shape=[4, 4])
+
+        (o,) = _run(build, {"x": x})
+        o = np.asarray(o)
+        assert o.shape == (1, 4, 4)
+        # crops are contiguous windows of the source
+        assert o.min() >= 0 and o.max() <= 99
+
+    def test_spectral_norm_unit_sigma(self):
+        w = np.diag([3.0, 1.0]).astype("float32")
+
+        def build():
+            wv = fluid.data(name="w", shape=[2, 2], dtype="float32")
+            return fluid.layers.spectral_norm(wv, power_iters=20)
+
+        (o,) = _run(build, {"w": w})
+        # largest singular value of w/sigma is ~1
+        s = np.linalg.svd(np.asarray(o), compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+    def test_soft_relu(self):
+        def build():
+            xv = fluid.data(name="x", shape=[3], dtype="float32")
+            return fluid.layers.soft_relu(xv)
+
+        (o,) = _run(build, {"x": np.array([-1.0, 0.0, 2.0], "float32")})
+        ref = np.log1p(np.exp([-1.0, 0.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-5)
+
+    def test_center_loss_pulls_to_centers(self):
+        def build():
+            xv = fluid.data(name="x", shape=[4, 3], dtype="float32")
+            lv = fluid.data(name="l", shape=[4, 1], dtype="int64")
+            return fluid.layers.center_loss(xv, lv, num_classes=2,
+                                            alpha=0.5)
+
+        x = np.ones((4, 3), "float32")
+        lab = np.zeros((4, 1), "int64")
+        (o,) = _run(build, {"x": x, "l": lab})
+        # centers start at 0 -> loss = 0.5*||x||^2 = 1.5 per sample
+        np.testing.assert_allclose(np.asarray(o).ravel(), 1.5, rtol=1e-5)
+
+    def test_sequence_unpad_layer(self):
+        x = np.arange(12, dtype="float32").reshape(2, 3, 2)
+
+        def build():
+            xv = fluid.data(name="x", shape=[2, 3, 2], dtype="float32")
+            lv = fluid.data(name="l", shape=[2], dtype="int64")
+            return fluid.layers.sequence_unpad(xv, lv)
+
+        (o,) = _run(build, {"x": x, "l": np.array([2, 3], "int64")})
+        ref = np.concatenate([x[0, :2], x[1, :3]], axis=0)
+        np.testing.assert_array_equal(np.asarray(o), ref)
