@@ -219,13 +219,14 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
         if info is None:
             continue
         grad_type = op.type + "_grad"
+        # A callable grad maker owns its op's backward entirely (custom
+        # output binding, e.g. data_norm's in-place stat rebind) — it wins
+        # even when a <type>_grad op is also registered for it to emit.
+        if callable(info.grad) and info.grad != "auto":
+            info.grad(block, op, pending, finalize)
+            continue
         if not OpInfoMap.instance().has(grad_type):
-            if info.grad is None:
-                # non-differentiable op: grads do not flow through
-                continue
-            if callable(info.grad):
-                info.grad(block, op, pending, finalize)
-                continue
+            # info.grad is None or "auto" with no grad op: grads don't flow
             continue
         ginfo = OpInfoMap.instance().get(grad_type)
 

@@ -762,11 +762,9 @@ def grid_sampler(x, grid, name=None):
 
 def soft_relu(x, threshold=40.0, name=None):
     """log(1 + exp(min(x, threshold))) (reference soft_relu)."""
-    from .nn import elementwise_min
+    from .nn import elementwise_max, elementwise_min
     from .ops import exp, log, scale
     from .tensor import fill_constant
-
-    from .nn import elementwise_max
 
     capped = elementwise_min(
         x, fill_constant([1], x.dtype, float(threshold)))
@@ -820,8 +818,6 @@ def random_crop(x, shape, seed=None):
 
 
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
-    from .tensor import create_global_var
-
     helper = LayerHelper("spectral_norm", input=weight)
     h = int(weight.shape[dim])
     w = 1
@@ -846,7 +842,7 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     out = helper.create_variable_for_type_inference(weight.dtype)
     helper.append_op("spectral_norm",
                      inputs={"Weight": [weight], "U": [u], "V": [v]},
-                     outputs={"Out": [out]},
+                     outputs={"Out": [out], "UOut": [u], "VOut": [v]},
                      attrs={"dim": dim, "power_iters": power_iters,
                             "eps": eps},
                      infer_shape=False)
@@ -858,19 +854,35 @@ def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
               data_layout="NCHW", in_place=False, name=None,
               moving_mean_name=None, moving_variance_name=None,
               do_model_average_for_mean_and_var=False):
-    from .tensor import create_global_var
-
-    helper = LayerHelper("data_norm", input=input)
+    helper = LayerHelper("data_norm", input=input, act=act)
     d = int(input.shape[-1])
-    size = create_global_var(
-        name=framework.unique_name.generate("dn_size"), shape=[d],
-        value=1e4, dtype="float32", persistable=True)
-    ssum = create_global_var(
-        name=framework.unique_name.generate("dn_sum"), shape=[d],
-        value=0.0, dtype="float32", persistable=True)
-    sqsum = create_global_var(
-        name=framework.unique_name.generate("dn_sqsum"), shape=[d],
-        value=1e4, dtype="float32", persistable=True)
+    # reference nn.py data_norm defaults (batch_size=1e4, batch_sum=0,
+    # batch_square=1e4), overridable via a param_attr dict; the stats are
+    # persistable and UPDATED BY THE GRAD OP each backward pass (see
+    # ops/misc_ops.py _data_norm_grad_maker) — test-mode programs never
+    # run backward, so stats stay frozen, matching the reference.
+    size_default, sum_default, sq_default = 1e4, 0.0, 1e4
+    if param_attr and isinstance(param_attr, dict):
+        size_default = param_attr.get("batch_size", 1e4)
+        sum_default = param_attr.get("batch_sum", 0.0)
+        sq_default = param_attr.get("batch_square", 1e4)
+    # trainable=True parameters like the reference (their presence on the
+    # grad path is what triggers the stat-updating grad op; no optimizer
+    # update ever applies to them because the grad op rebinds the vars
+    # in-place instead of emitting @GRAD outputs)
+    from ..initializer import ConstantInitializer
+    from ..param_attr import ParamAttr
+
+    def stat_param(tag, value):
+        return helper.create_parameter(
+            attr=ParamAttr(
+                name=framework.unique_name.generate("dn_%s" % tag),
+                initializer=ConstantInitializer(float(value))),
+            shape=[d], dtype="float32")
+
+    size = stat_param("size", size_default)
+    ssum = stat_param("sum", sum_default)
+    sqsum = stat_param("sqsum", sq_default)
     out = helper.create_variable_for_type_inference(input.dtype)
     means = helper.create_variable_for_type_inference("float32")
     scales = helper.create_variable_for_type_inference("float32")
@@ -882,19 +894,24 @@ def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
                               "Scales": [scales]},
                      attrs={"epsilon": epsilon}, infer_shape=False)
     out.shape = tuple(input.shape)
-    return out
+    return helper.append_activation(out)
 
 
 def center_loss(input, label, num_classes, alpha, param_attr=None,
                 update_center=True):
-    from .tensor import create_global_var, fill_constant
+    from .tensor import fill_constant
+    from ..initializer import ConstantInitializer
+    from ..param_attr import ParamAttr
 
     helper = LayerHelper("center_loss", input=input)
     d = int(input.shape[-1])
-    centers = create_global_var(
-        name=framework.unique_name.generate("centers"),
-        shape=[num_classes, d], value=0.0, dtype="float32",
-        persistable=True)
+    # reference loss.py center_loss: centers via create_parameter with
+    # the caller's param_attr, zero-filled by default
+    centers = helper.create_parameter(
+        attr=param_attr if param_attr is not None else ParamAttr(
+            name=framework.unique_name.generate("centers")),
+        shape=[num_classes, d], dtype="float32",
+        default_initializer=ConstantInitializer(0.0), stop_gradient=True)
     rate = alpha if isinstance(alpha, framework.Variable) else \
         fill_constant([1], "float32", float(alpha))
     diff = helper.create_variable_for_type_inference(input.dtype)

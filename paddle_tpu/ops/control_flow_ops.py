@@ -132,6 +132,18 @@ def _lod_array_length(executor, op, scope):
                         np.asarray([len(arr)], dtype=np.int64))
 
 
+def _print_grad_maker(block, op, pending, finalize):
+    """Identity grad pass-through (reference print_op.cc PrintOpGradMaker
+    re-emits a print op on the grad var; we forward the grad without the
+    backward-phase print so Print never blocks learning)."""
+    outs = op.output("Out")
+    if not outs:
+        return
+    g = finalize(outs[0])
+    if g is not None:
+        pending.setdefault(op.input("In")[0], []).append(g)
+
+
 @register_host_op(
     "print",
     inputs=[In("In")],
@@ -139,6 +151,7 @@ def _lod_array_length(executor, op, scope):
     attrs={"first_n": -1, "message": "", "summarize": 20, "print_tensor_name": True,
            "print_tensor_type": True, "print_tensor_shape": True,
            "print_tensor_lod": True, "print_phase": "BOTH", "is_forward": True},
+    grad=_print_grad_maker,
 )
 def _print(executor, op, scope):
     name = op.input("In")[0]

@@ -684,11 +684,16 @@ def _random_crop(ins, attrs):
 @register_op("spectral_norm",
              inputs=[In("Weight"), In("U", no_grad=True),
                      In("V", no_grad=True)],
-             outputs=[Out("Out")],
+             outputs=[Out("Out"), Out("UOut", no_grad=True),
+                      Out("VOut", no_grad=True)],
              attrs={"dim": 0, "power_iters": 1, "eps": 1e-12})
 def _spectral_norm(ins, attrs):
     """Weight / sigma_max via power iteration (reference
-    spectral_norm_op.h; U/V persistable iterates)."""
+    spectral_norm_op.h). UOut/VOut are bound by the layer to the same
+    persistable U/V vars, so the iterates warm-start across steps as the
+    reference's in-place CalcMatrixSigmaAndNormWeight does; u/v are
+    gradient-stopped before sigma, matching the reference grad kernel
+    which treats the saved U/V as constants."""
     w = ins["Weight"]
     dim = int(attrs.get("dim", 0))
     eps = attrs.get("eps", 1e-12)
@@ -699,8 +704,42 @@ def _spectral_norm(ins, attrs):
         v = v / (jnp.linalg.norm(v) + eps)
         u = mat @ v
         u = u / (jnp.linalg.norm(u) + eps)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
     sigma = u @ mat @ v
-    return {"Out": w / (sigma + eps)}
+    return {"Out": w / (sigma + eps), "UOut": u, "VOut": v}
+
+
+def _data_norm_grad_maker(block, op, pending, finalize):
+    """Grad maker for data_norm mirroring the reference's
+    DataNormGradMaker (data_norm_op.cc:458-470): the grad op's
+    BatchSize/BatchSum/BatchSquareSum OUTPUTS are bound to the forward's
+    stat vars themselves, so each backward pass replaces the running
+    stats with this batch's (N, Σx, Σ(x-mean)²+N·ε) — that in-place
+    rebind IS the reference's stat-update rule."""
+    from .. import framework
+    from ..backward import _ensure_grad_var
+
+    y_name = op.output("Y")[0]
+    g_y = finalize(y_name)
+    if g_y is None:
+        return
+    x_name = op.input("X")[0]
+    if x_name in pending and pending[x_name]:
+        gname = "%s@GRAD@RENAME@%d" % (x_name, len(pending[x_name]))
+    else:
+        gname = framework.grad_var_name(x_name)
+    _ensure_grad_var(block, x_name, gname)
+    pending.setdefault(x_name, []).append(gname)
+    block.append_op(
+        "data_norm_grad",
+        inputs={"X": [x_name], "Means": [op.output("Means")[0]],
+                "Scales": [op.output("Scales")[0]], "Y@GRAD": [g_y]},
+        outputs={"X@GRAD": [gname],
+                 "BatchSize": [op.input("BatchSize")[0]],
+                 "BatchSum": [op.input("BatchSum")[0]],
+                 "BatchSquareSum": [op.input("BatchSquareSum")[0]]},
+        attrs=dict(op.attrs), infer_shape=False)
 
 
 @register_op("data_norm",
@@ -709,7 +748,8 @@ def _spectral_norm(ins, attrs):
                      In("BatchSquareSum", no_grad=True)],
              outputs=[Out("Y"), Out("Means", no_grad=True),
                       Out("Scales", no_grad=True)],
-             attrs={"epsilon": 1e-4})
+             attrs={"epsilon": 1e-4},
+             grad=_data_norm_grad_maker)
 def _data_norm(ins, attrs):
     """Normalization by accumulated batch statistics (reference
     data_norm_op.cc): mean = sum/size, scale = sqrt(size/square_sum)."""
@@ -721,6 +761,31 @@ def _data_norm(ins, attrs):
     scale = jnp.sqrt(size / (ins["BatchSquareSum"] + eps))
     return {"Y": (x - mean[None, :]) * scale[None, :],
             "Means": mean, "Scales": scale}
+
+
+@register_op("data_norm_grad",
+             inputs=[In("X", no_grad=True), In("Means", no_grad=True),
+                     In("Scales", no_grad=True), In("Y@GRAD", no_grad=True)],
+             outputs=[Out("X@GRAD", no_grad=True),
+                      Out("BatchSize", no_grad=True),
+                      Out("BatchSum", no_grad=True),
+                      Out("BatchSquareSum", no_grad=True)],
+             attrs={"epsilon": 1e-4}, grad=None)
+def _data_norm_grad(ins, attrs):
+    """reference data_norm_op.cc:392-397 (dX = dY·scale) and :440-449
+    (default non-slot stat update): size=N, sum=Σx,
+    square_sum=Σ(x-mean)²+N·ε."""
+    x = ins["X"]
+    dy = ins["Y@GRAD"]
+    eps = attrs.get("epsilon", 1e-4)
+    n = float(x.shape[0])
+    dx = dy * ins["Scales"][None, :]
+    mean = ins["Means"]
+    return {"X@GRAD": dx,
+            "BatchSize": jnp.full((x.shape[-1],), n, x.dtype),
+            "BatchSum": x.sum(axis=0),
+            "BatchSquareSum": ((x - mean[None, :]) ** 2).sum(axis=0)
+            + n * eps}
 
 
 @register_op("center_loss",

@@ -9,3 +9,5 @@ the step program and ride ICI.
 from .mesh_utils import default_mesh, make_mesh  # noqa: F401
 from .engine import run_data_parallel  # noqa: F401
 from .transpiler import insert_allreduce_ops  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention, sequence_parallel_attention, ulysses_attention)

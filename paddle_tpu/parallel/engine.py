@@ -29,23 +29,10 @@ from ..core.registry import BOUND_OUTPUTS_ATTR
 from ..core.scope import Scope
 from ..core.tensor import LoDTensor
 from ..ops.collective_ops import ring_axis_guard
-from .mesh_utils import default_mesh
+from .mesh_utils import default_mesh, shard_map_compat as _shard_map
 from .transpiler import insert_allreduce_ops
 
 _dp_cache: Dict = {}
-
-
-def _shard_map(f, mesh, in_specs, out_specs):
-    import jax
-
-    try:
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    except (AttributeError, TypeError):
-        from jax.experimental.shard_map import shard_map
-
-        return shard_map(f, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_rep=False)
 
 
 def run_data_parallel(core, program, scope: Scope, feed: Dict,

@@ -24,3 +24,18 @@ def default_mesh(num_devices: Optional[int] = None, axis_name: str = "dp"):
     devs = jax.devices()
     n = num_devices or len(devs)
     return make_mesh([n], [axis_name], devs)
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """shard_map across JAX versions: new jax.shard_map(check_vma=...)
+    with fallback to jax.experimental.shard_map(check_rep=...)."""
+    import jax
+
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)

@@ -563,3 +563,37 @@ class TestFinalWrapperBatch:
         (o,) = _run(build, {"x": x, "l": np.array([2, 3], "int64")})
         ref = np.concatenate([x[0, :2], x[1, :3]], axis=0)
         np.testing.assert_array_equal(np.asarray(o), ref)
+
+
+class TestDataNormTraining:
+    def test_stats_update_via_grad_path(self):
+        """The data_norm grad op rebinds the stat params to this batch's
+        (N, Σx, Σ(x-mean)²+N·ε) — reference data_norm_op.cc:440-470."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data_norm(
+                x, param_attr={"batch_size": 2.0, "batch_sum": 0.0,
+                               "batch_square": 2.0})
+            pred = fluid.layers.fc(y, size=1)
+            loss = fluid.layers.mean(fluid.layers.square(pred))
+            fluid.optimizer.SGD(0.01).minimize(loss)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            xb = (np.random.RandomState(0).randn(32, 4) * 3 + 5).astype(
+                "float32")
+            exe.run(prog, feed={"x": xb}, fetch_list=[loss])
+            names = sorted(n for n in prog.global_block().vars
+                           if n.startswith("dn_"))
+            after = {n: np.asarray(scope.find_var(n).raw().array)
+                     for n in names}
+            szn = [n for n in names if "size" in n][0]
+            sumn = [n for n in names if "sqsum" not in n and "sum" in n][0]
+            sqn = [n for n in names if "sqsum" in n][0]
+            np.testing.assert_allclose(after[szn], 32.0)
+            np.testing.assert_allclose(after[sumn], xb.sum(0), rtol=1e-5)
+            np.testing.assert_allclose(after[sqn],
+                                       (xb ** 2).sum(0) + 32 * 1e-4,
+                                       rtol=1e-4)
