@@ -10,6 +10,8 @@ operators/math/detail/lstm_cpu_kernel.h).
 """
 from __future__ import annotations
 
+from collections import namedtuple as _namedtuple
+
 from ..layer_helper import LayerHelper
 from ..initializer import ConstantInitializer
 
@@ -441,3 +443,309 @@ def rnn(cell, inputs, initial_states=None, sequence_length=None,
 
 
 __all__ += ["RNNCell", "LSTMCell", "GRUCell", "rnn"]
+
+
+# ---------------------------------------------------------------------------
+# Decoder family (reference layers/rnn.py:560 Decoder, :604 BeamSearchDecoder,
+# :1051 dynamic_decode)
+# ---------------------------------------------------------------------------
+
+
+class Decoder:
+    """Abstract step-decoder contract (reference layers/rnn.py Decoder):
+    ``initialize`` -> (initial_inputs, initial_states, initial_finished);
+    ``step`` -> (outputs, next_states, next_inputs, finished);
+    ``finalize`` -> (final_outputs, final_states)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoding over a wrapped cell (reference
+    layers/rnn.py:604). TPU-native layout: everything is DENSE
+    [batch, beam] / [batch*beam, ...] with static shapes — no LoD — so the
+    unrolled decode compiles to one XLA program; the backtrace is the
+    gather_tree op, exactly as the reference's finalize (:1030).
+
+    States and inputs handed to ``cell.call`` are shaped
+    [batch*beam, ...]; use ``tile_beam_merge_with_batch`` for any extra
+    tensor the cell closes over (e.g. attention memory)."""
+
+    OutputWrapper = _namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = _namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        # per-decode constants hoisted out of the unrolled step loop
+        # (built once in initialize; the reference caches the same mask
+        # as self.noend_mask_tensor)
+        self._consts = None
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] with each row repeated
+        beam_size times (reference :680)."""
+        from .nn import expand, reshape, unsqueeze
+
+        x = unsqueeze(x, [1])
+        times = [1] * len(x.shape)
+        times[1] = beam_size
+        x = expand(x, times)
+        shp = [int(s) for s in x.shape]
+        lead = -1 if shp[0] < 0 else shp[0] * shp[1]
+        return reshape(x, [lead] + shp[2:])
+
+    def _merge(self, x):
+        from .nn import reshape
+
+        shp = [int(s) for s in x.shape]
+        return reshape(x, [shp[0] * shp[1]] + shp[2:])
+
+    def initialize(self, initial_cell_states):
+        """Start tokens everywhere; beam 0 carries log-prob 0, the rest
+        -inf so step 1 expands only beam 0 (reference :824 kInf init)."""
+        import numpy as np
+
+        from .tensor import assign, fill_constant
+
+        states = initial_cell_states
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        B = int(states[0].shape[0])
+        if B < 0:
+            raise ValueError(
+                "BeamSearchDecoder needs a static batch size; declare the "
+                "initial state with fluid.data(..., shape=[batch, ...]) "
+                "instead of a -1 batch dim (static shapes are what let "
+                "the decode compile to one XLA program)")
+        K = self.beam_size
+        cell_states = [self.tile_beam_merge_with_batch(s, K)
+                       for s in states]
+        init_lp = assign(np.array(
+            [[0.0] + [-1e9] * (K - 1)] * B, dtype="float32"))
+        finished = fill_constant([B, K], "bool", False)
+        lengths = fill_constant([B, K], "int64", 0)
+        start = fill_constant([B, K], "int64", self.start_token)
+        self._consts = None  # rebuilt lazily on the first step (needs V)
+        init_inputs = start
+        if self.embedding_fn is not None:
+            # [B, K, E] -> [B*K, E]: the wrapped cell always sees the
+            # beam dim merged into batch (reference _merge_batch_beams)
+            init_inputs = self._merge(self.embedding_fn(start))
+        return init_inputs, self.StateWrapper(
+            cell_states, init_lp, finished, lengths), finished
+
+    def _step_consts(self, B, K, V):
+        """Build the step-invariant constant tensors ONCE per decode —
+        the unrolled loop would otherwise re-materialize a [V] literal
+        and ~8 fill_constants every step (the reference caches the same
+        thing as self.noend_mask_tensor)."""
+        if self._consts is not None:
+            return self._consts
+        import numpy as np
+
+        from .nn import expand, reshape
+        from .tensor import assign, cast, fill_constant, range as t_range
+
+        noend = np.full((V,), -1e9, dtype="float32")
+        noend[self.end_token] = 0.0
+        self._consts = {
+            "noend_bkv": expand(reshape(assign(noend), [1, 1, V]),
+                                [B, K, 1]),
+            "vconst": fill_constant([B, K], "int64", V),
+            "kconst": fill_constant([B, K], "int64", K),
+            "endconst": fill_constant([B, K], "int64", self.end_token),
+            "one_i": fill_constant([B, K], "int64", 1),
+            "neg_one_i": fill_constant([B, K], "int64", -1),
+            "offs": expand(reshape(cast(t_range(0, B, 1, "int32"),
+                                        "int64"), [B, 1]), [1, K]),
+            "eps": fill_constant([1], "float32", 1e-20),
+            "one_f": fill_constant([1], "float32", 1.0),
+            "neg_one_f": fill_constant([1], "float32", -1.0),
+        }
+        return self._consts
+
+    def _beam_search_step(self, logits, beam_state):
+        """One topk-over-(beam x vocab) selection (reference :862)."""
+        from .nn import (elementwise_add, elementwise_floordiv,
+                         elementwise_mod, elementwise_mul, expand, gather,
+                         reshape, softmax, topk, unsqueeze)
+        from .ops import log
+        from .tensor import cast
+        from .control_flow import equal, logical_or
+
+        K, B = self.beam_size, int(beam_state.log_probs.shape[0])
+        V = int(logits.shape[-1])
+        c = self._step_consts(B, K, V)
+        probs = softmax(reshape(logits, [B, K, V]))
+        step_lp = log(elementwise_add(probs, c["eps"]))
+        # finished beams may only extend with end_token at no cost
+        fin_f = expand(unsqueeze(cast(beam_state.finished, "float32"), [2]),
+                       [1, 1, V])
+        keep_f = elementwise_add(
+            elementwise_mul(fin_f, c["noend_bkv"]),
+            elementwise_mul(
+                step_lp,
+                elementwise_add(elementwise_mul(fin_f, c["neg_one_f"]),
+                                c["one_f"])))
+        total = elementwise_add(
+            keep_f, expand(unsqueeze(beam_state.log_probs, [2]), [1, 1, V]))
+        scores, idx = topk(reshape(total, [B, K * V]), K)  # [B, K]
+        beam_idx = elementwise_floordiv(idx, c["vconst"])
+        token_idx = elementwise_mod(idx, c["vconst"])
+        # flat parent rows into [B*K, ...] cell states
+        flat_parent = reshape(
+            elementwise_add(elementwise_mul(c["offs"], c["kconst"]),
+                            beam_idx),
+            [B * K])
+        next_cell = [gather(s, flat_parent) for s in beam_state.cell_states]
+        parent_finished = reshape(
+            gather(reshape(beam_state.finished, [B * K]), flat_parent),
+            [B, K])
+        parent_lengths = reshape(
+            gather(reshape(beam_state.lengths, [B * K]), flat_parent),
+            [B, K])
+        next_finished = logical_or(parent_finished,
+                                   equal(token_idx, c["endconst"]))
+        grow = elementwise_add(
+            elementwise_mul(cast(parent_finished, "int64"),
+                            c["neg_one_i"]),
+            c["one_i"])  # 1 - finished
+        next_lengths = elementwise_add(parent_lengths, grow)
+        next_state = self.StateWrapper(next_cell, scores, next_finished,
+                                       next_lengths)
+        output = self.OutputWrapper(scores, token_idx, beam_idx)
+        return output, next_state
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, next_cell = self.cell.call(inputs, states.cell_states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        probing = self.StateWrapper(next_cell, states.log_probs,
+                                    states.finished, states.lengths)
+        output, next_state = self._beam_search_step(cell_out, probing)
+        next_inputs = output.predicted_ids
+        if self.embedding_fn is not None:
+            next_inputs = self._merge(self.embedding_fn(next_inputs))
+        return output, next_state, next_inputs, next_state.finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrace beams through parent pointers (reference :1030 uses
+        the same gather_tree op)."""
+        from .extras import gather_tree
+
+        predicted = gather_tree(outputs.predicted_ids, outputs.parent_ids)
+        return predicted, final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, **kwargs):
+    """Drive ``decoder`` until max_step_num (reference layers/rnn.py:1051).
+
+    TPU-native: the loop is unrolled at program-build time with dense
+    static shapes (the reference grows LoD arrays inside a While op —
+    a dynamic shape per step that XLA cannot tile); finished beams keep
+    emitting end tokens, so the fixed trip count changes results only in
+    costing compute after convergence, never correctness."""
+    from .nn import stack, transpose
+
+    if max_step_num is None:
+        raise ValueError("dynamic_decode requires max_step_num (the "
+                         "unrolled trip count)")
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    for t in range(int(max_step_num)):
+        output, states, inputs, finished = decoder.step(t, inputs, states,
+                                                        **kwargs)
+        step_outputs.append(output)
+    stacked = type(step_outputs[0])(*[
+        stack([getattr(o, f) for o in step_outputs], axis=0)
+        for f in step_outputs[0]._fields])
+    final_outputs, final_states = decoder.finalize(
+        stacked, states, getattr(states, "lengths", None))
+    if not output_time_major:
+        import paddle_tpu.framework as _fw
+
+        def _batch_major(x):
+            if isinstance(x, _fw.Variable):
+                return transpose(x, [1, 0] + list(range(2, len(x.shape))))
+            return x
+
+        if isinstance(final_outputs, tuple) and hasattr(final_outputs,
+                                                        "_fields"):
+            final_outputs = type(final_outputs)(
+                *[_batch_major(f) for f in final_outputs])
+        else:
+            final_outputs = _batch_major(final_outputs)
+    return final_outputs, final_states
+
+
+__all__ += ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """One LoD beam-search step (reference layers/rnn.py:2698 over
+    beam_search_op.cc). Selects the top ``beam_size`` candidates per
+    source sentence from per-prefix topk candidates; see
+    ops/beam_search_ops.py for the host-side kernel and the TPU-native
+    alternative (BeamSearchDecoder)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("beam_search", input=pre_ids, name=name)
+    selected_ids = helper.create_variable_for_type_inference("int64")
+    selected_scores = helper.create_variable_for_type_inference("float32")
+    inputs = {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+              "scores": [scores]}
+    if ids is not None:
+        inputs["ids"] = [ids]
+    outputs = {"selected_ids": [selected_ids],
+               "selected_scores": [selected_scores]}
+    if return_parent_idx:
+        parent_idx = helper.create_variable_for_type_inference("int32")
+        outputs["parent_idx"] = [parent_idx]
+    helper.append_op("beam_search", inputs=inputs, outputs=outputs,
+                     attrs={"level": level, "beam_size": beam_size,
+                            "end_id": end_id,
+                            "is_accumulated": is_accumulated},
+                     infer_shape=False)
+    if return_parent_idx:
+        return selected_ids, selected_scores, parent_idx
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    """Backtrace full hypotheses from per-step beam_search outputs stored
+    in LoDTensorArrays (reference layers/rnn.py:2848 over
+    beam_search_decode_op.h)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("beam_search_decode", input=ids, name=name)
+    sentence_ids = helper.create_variable_for_type_inference("int64")
+    sentence_scores = helper.create_variable_for_type_inference("float32")
+    helper.append_op("beam_search_decode",
+                     inputs={"Ids": [ids], "Scores": [scores]},
+                     outputs={"SentenceIds": [sentence_ids],
+                              "SentenceScores": [sentence_scores]},
+                     attrs={"beam_size": beam_size, "end_id": end_id},
+                     infer_shape=False)
+    return sentence_ids, sentence_scores
+
+
+__all__ += ["beam_search", "beam_search_decode"]

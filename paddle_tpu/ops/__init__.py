@@ -27,3 +27,4 @@ from . import fused_ops  # noqa: F401
 from . import amp_ops  # noqa: F401
 from . import distributed_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
+from . import beam_search_ops  # noqa: F401

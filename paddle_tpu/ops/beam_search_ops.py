@@ -1,0 +1,224 @@
+"""LoD beam-search ops for the fluid-era seq2seq API.
+
+Parity: /root/reference/paddle/fluid/operators/beam_search_op.cc +
+math/beam_search.cc (SelectTopBeamSizeItems :215, PruneEndBeams :140,
+output LoD fill :69-92) and beam_search_decode_op.h (Backtrace :143).
+
+TPU-native stance: these are intrinsically ragged, host-side ops — the
+LoD bookkeeping is O(batch*beam) scalar work per step while all FLOPs
+(scoring the vocabulary) stay in compiled programs upstream. The dense,
+whole-program-compiled decoder lives in layers/rnn.py
+(BeamSearchDecoder/dynamic_decode + the gather_tree op); this pair
+exists so reference machine-translation programs (book
+test_machine_translation.py) run unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op
+
+
+def _abs_lod(lod):
+    return [list(level) for level in lod]
+
+
+def _select_top_beam(pre_ids, pre_scores, ids, scores, high_level,
+                     beam_size, end_id, is_accumulated):
+    """math/beam_search.cc:215 — per source sentence, the top beam_size
+    (offset, id, score) items over all its prefix rows; finished prefixes
+    (pre_id == end_id) contribute the single item (end_id, pre_score)."""
+    # flat indexing exactly like the reference kernel (pre_ids may arrive
+    # [rows, 1] or [1, rows]; data walks in row order either way)
+    flat_pre_ids = pre_ids.reshape(-1)
+    flat_pre_scores = pre_scores.reshape(-1)
+    seq_width = int(np.prod(scores.shape[1:])) if scores.ndim > 1 else 1
+    flat_scores = scores.reshape(-1)
+    flat_ids = ids.reshape(-1) if ids is not None else None
+    result = []
+    for seq_id in range(len(high_level) - 1):
+        items = []
+        for offset in range(high_level[seq_id], high_level[seq_id + 1]):
+            pre_id = int(flat_pre_ids[offset])
+            pre_score = float(flat_pre_scores[offset])
+            if pre_id == end_id:
+                items.append((offset, end_id, pre_score))
+            else:
+                base = offset * seq_width
+                for d in range(seq_width):
+                    tok = (int(flat_ids[base + d]) if flat_ids is not None
+                           else d)
+                    s = (float(flat_scores[base + d]) if is_accumulated
+                         else pre_score
+                         + float(np.log(flat_scores[base + d])))
+                    items.append((offset, tok, s))
+        # Item::operator<: greater score wins; ties -> smaller offset
+        items.sort(key=lambda it: (-it[2], it[0]))
+        result.append(items[:beam_size])
+    return result
+
+
+def _prune_end_beams(pre_ids, high_level, per_seq_items, end_id):
+    """math/beam_search.cc:140 — drop sources whose every selected item
+    AND every pre_id is already end_id (one step after finishing, so the
+    end tokens still get written out once)."""
+    flat_pre = pre_ids.reshape(-1)
+    for seq_id, items in enumerate(per_seq_items):
+        finish = True
+        for (offset, tok, _s) in items:
+            if tok != end_id or int(flat_pre[offset]) != end_id:
+                finish = False
+                break
+        if finish:
+            per_seq_items[seq_id] = []
+    return per_seq_items
+
+
+@register_host_op(
+    "beam_search",
+    inputs=[In("pre_ids", no_grad=True), In("pre_scores", no_grad=True),
+            In("ids", dispensable=True, no_grad=True),
+            In("scores", no_grad=True)],
+    outputs=[Out("selected_ids"), Out("selected_scores"),
+             Out("parent_idx", dispensable=True)],
+    attrs={"level": 0, "beam_size": 1, "end_id": 0, "is_accumulated": True},
+)
+def _beam_search(executor, op, scope):
+    level = int(op.attrs.get("level", 0))
+    beam_size = int(op.attrs["beam_size"])
+    end_id = int(op.attrs["end_id"])
+    is_accumulated = bool(op.attrs.get("is_accumulated", True))
+
+    pre_ids_t = scope.find_var(op.input("pre_ids")[0]).get_tensor()
+    pre_scores_t = scope.find_var(op.input("pre_scores")[0]).get_tensor()
+    scores_t = scope.find_var(op.input("scores")[0]).get_tensor()
+    ids_names = op.input("ids")
+    ids_arr = (scope.find_var(ids_names[0]).get_tensor().numpy()
+               if ids_names else None)
+    pre_ids = pre_ids_t.numpy()
+    pre_scores = pre_scores_t.numpy()
+    scores = scores_t.numpy()
+
+    lod = _abs_lod(scores_t.lod() or pre_ids_t.lod())
+    if not lod:
+        # first step convenience: every row its own source (flat row
+        # count — pre_ids may arrive [rows, 1] or [1, rows])
+        n = int(pre_ids.size)
+        lod = [list(range(n + 1)), list(range(n + 1))]
+    high_level = lod[level]
+
+    per_seq = _select_top_beam(pre_ids, pre_scores, ids_arr, scores,
+                               high_level, beam_size, end_id, is_accumulated)
+    per_seq = _prune_end_beams(pre_ids, high_level, per_seq, end_id)
+
+    # regroup by prefix offset (ToMap), then emit rows in offset order
+    num_prefix = high_level[-1]
+    by_offset = [[] for _ in range(num_prefix)]
+    for items in per_seq:
+        for it in items:
+            by_offset[it[0]].append(it)
+
+    sel_ids, sel_scores, parent = [], [], []
+    low_level = []
+    off = 0
+    for prefix_idx, items in enumerate(by_offset):
+        low_level.append(off)
+        for (_o, tok, s) in items:
+            sel_ids.append(tok)
+            sel_scores.append(s)
+            parent.append(prefix_idx)
+            off += 1
+    low_level.append(off)
+
+    out_lod = [list(high_level), low_level]
+    n = len(sel_ids)
+    executor._write_var(scope, op.output("selected_ids")[0],
+                        np.asarray(sel_ids, "int64").reshape(n, 1),
+                        lod=out_lod)
+    executor._write_var(scope, op.output("selected_scores")[0],
+                        np.asarray(sel_scores, "float32").reshape(n, 1),
+                        lod=out_lod)
+    pouts = op.output("parent_idx")
+    if pouts:
+        executor._write_var(scope, pouts[0], np.asarray(parent, "int32"))
+
+
+@register_host_op(
+    "beam_search_decode",
+    inputs=[In("Ids", no_grad=True), In("Scores", no_grad=True)],
+    outputs=[Out("SentenceIds"), Out("SentenceScores")],
+    attrs={"beam_size": 1, "end_id": 0},
+)
+def _beam_search_decode(executor, op, scope):
+    """beam_search_decode_op.h Backtrace: walk the per-step selected
+    LoDTensors from last step to first, following each row's prefix via
+    the step's sentence-level LoD; emit per-source sentences (reversed at
+    the end), skipping redundant trailing end tokens."""
+    end_id = int(op.attrs["end_id"])
+    ids_arr = scope.find_var(op.input("Ids")[0]).get_lod_tensor_array()
+    scores_arr = scope.find_var(op.input("Scores")[0]).get_lod_tensor_array()
+    steps = len(ids_arr)
+    if steps == 0:
+        raise ValueError("beam_search_decode: empty step array")
+
+    src_level, sent_level = 0, 1
+    src_num = len(ids_arr[0].lod()[src_level]) - 1
+    # per source: list of sentences ([word_ids], [scores]) + prefix index
+    sentences = [[] for _ in range(src_num)]
+    prefix_idx_vec = [[] for _ in range(src_num)]
+
+    for step_id in range(steps - 1, -1, -1):
+        cur_ids = ids_arr[step_id]
+        cur_scores = scores_arr[step_id]
+        id_data = cur_ids.numpy().reshape(-1)
+        sc_data = cur_scores.numpy().reshape(-1)
+        lod = cur_ids.lod()
+        for src in range(src_num):
+            p_start = lod[src_level][src]
+            p_end = lod[src_level][src + 1]
+            if not prefix_idx_vec[src]:
+                # last step (or source pruned at this step): open one
+                # sentence per selected row
+                for prefix in range(p_start, p_end):
+                    c_start = lod[sent_level][prefix]
+                    c_end = lod[sent_level][prefix + 1]
+                    for cand in range(c_start, c_end):
+                        prefix_idx_vec[src].append(prefix)
+                        sentences[src].append(
+                            ([int(id_data[cand])], [float(sc_data[cand])]))
+            else:
+                src_cand_start = lod[sent_level][p_start]
+                prefix = p_start
+                cand_num = (lod[sent_level][prefix + 1]
+                            - lod[sent_level][prefix])
+                for idx in range(len(prefix_idx_vec[src])):
+                    cand = prefix_idx_vec[src][idx]
+                    tok = int(id_data[cand])
+                    sc = float(sc_data[cand])
+                    words, scs = sentences[src][idx]
+                    if tok != end_id or not words:
+                        words.append(tok)
+                        scs.append(sc)
+                    while src_cand_start + cand_num <= cand:
+                        prefix += 1
+                        cand_num += (lod[sent_level][prefix + 1]
+                                     - lod[sent_level][prefix])
+                    prefix_idx_vec[src][idx] = prefix
+
+    # ConvertSentenceVectorToLodTensor: reversed word order, 2-level LoD
+    flat_ids, flat_scores = [], []
+    src_lod, sent_lod = [0], [0]
+    for src in range(src_num):
+        for words, scs in sentences[src]:
+            flat_ids.extend(reversed(words))
+            flat_scores.extend(reversed(scs))
+            sent_lod.append(len(flat_ids))
+        src_lod.append(len(sent_lod) - 1)
+    out_lod = [src_lod, sent_lod]
+    n = len(flat_ids)
+    executor._write_var(scope, op.output("SentenceIds")[0],
+                        np.asarray(flat_ids, "int64").reshape(n, 1),
+                        lod=out_lod)
+    executor._write_var(scope, op.output("SentenceScores")[0],
+                        np.asarray(flat_scores, "float32").reshape(n, 1),
+                        lod=out_lod)

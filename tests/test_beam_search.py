@@ -1,0 +1,133 @@
+"""BeamSearchDecoder / dynamic_decode correctness.
+
+Oracle: a numpy re-implementation of the reference beam-search step
+semantics (layers/rnn.py:862 _beam_search_step + gather_tree backtrace):
+log-softmax scores accumulate per beam, finished beams may only extend
+with end_token at zero cost, selection is topk over beam x vocab, and
+the final sequences come from walking parent pointers backward.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.layers.rnn import BeamSearchDecoder, RNNCell, dynamic_decode
+
+V, H, B, K, T = 7, 5, 2, 3, 5
+END = 1
+
+
+class TableCell(RNNCell):
+    """Markov cell: logits for the next token depend only on the current
+    token via a fixed [V, V] table — brute-forceable in numpy."""
+
+    def __init__(self, table_var):
+        self.table = table_var
+
+    def call(self, inputs, states):
+        from paddle_tpu.layers.nn import matmul, one_hot, reshape
+
+        flat = reshape(inputs, [B * K])
+        oh = one_hot(flat, V)
+        logits = matmul(oh, self.table)  # [B*K, V]
+        return logits, states
+
+
+def _np_log_softmax(x):
+    m = x.max(-1, keepdims=True)
+    e = np.exp(x - m)
+    return x - m - np.log(e.sum(-1, keepdims=True))
+
+
+def _np_beam_search(table, start, end, steps):
+    lp = np.full((B, K), -1e9, np.float64)
+    lp[:, 0] = 0.0
+    tok = np.full((B, K), start, np.int64)
+    finished = np.zeros((B, K), bool)
+    all_tokens, all_parents = [], []
+    logp = _np_log_softmax(table.astype(np.float64))
+    for _ in range(steps):
+        step_lp = np.log(
+            np.exp(_np_log_softmax(table[tok].astype(np.float64))) + 1e-20)
+        noend = np.full((V,), -1e9)
+        noend[end] = 0.0
+        step_lp = np.where(finished[..., None], noend[None, None], step_lp)
+        total = step_lp + lp[..., None]  # [B, K, V]
+        flat = total.reshape(B, K * V)
+        idx = np.argsort(-flat, axis=1, kind="stable")[:, :K]
+        lp = np.take_along_axis(flat, idx, axis=1)
+        parent = idx // V
+        tok_sel = idx % V
+        finished = np.take_along_axis(finished, parent, axis=1) | (
+            tok_sel == end)
+        tok = tok_sel
+        all_tokens.append(tok_sel)
+        all_parents.append(parent)
+    # gather_tree backtrace
+    ids = np.stack(all_tokens)       # [T, B, K]
+    parents = np.stack(all_parents)
+    out = np.zeros_like(ids)
+    beams = np.tile(np.arange(K)[None], (B, 1))
+    for t in range(steps - 1, -1, -1):
+        out[t] = np.take_along_axis(ids[t], beams, axis=1)
+        beams = np.take_along_axis(parents[t], beams, axis=1)
+    return out, lp
+
+
+def _decode_with_table(table):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        tab = fluid.layers.create_parameter(
+            [V, V], "float32", name="tab",
+            default_initializer=fluid.initializer.NumpyArrayInitializer(
+                table))
+        init = fluid.layers.fill_constant([B, H], "float32", 0.0)
+        dec = BeamSearchDecoder(TableCell(tab), start_token=0, end_token=END,
+                                beam_size=K)
+        outs, states = dynamic_decode(dec, inits=[init], max_step_num=T,
+                                      output_time_major=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        r = exe.run(prog, fetch_list=[outs, states.log_probs,
+                                      states.finished, states.lengths])
+    return [np.asarray(x) for x in r]
+
+
+def test_matches_numpy_oracle():
+    table = np.random.RandomState(3).randn(V, V).astype("float32") * 2
+    got_ids, got_lp, got_fin, got_len = _decode_with_table(table)
+    ref_ids, ref_lp = _np_beam_search(table, 0, END, T)
+    np.testing.assert_array_equal(got_ids, ref_ids)
+    np.testing.assert_allclose(got_lp, ref_lp, rtol=1e-4, atol=1e-4)
+
+
+def test_finished_beams_emit_end_forever():
+    # force token END to dominate from every state -> all beams finish at
+    # step 1 and must keep emitting END at no score cost
+    table = np.full((V, V), -5.0, np.float32)
+    table[:, END] = 5.0
+    got_ids, got_lp, got_fin, got_len = _decode_with_table(table)
+    assert got_fin.all()
+    assert (got_ids[1:] == END).all()
+    # top beam ends at step 1; the other two slots are filled by beam 0's
+    # runner-up tokens, which then emit END at step 2
+    np.testing.assert_array_equal(got_len, [[1, 2, 2]] * B)
+
+
+def test_batch_major_output_shape():
+    table = np.random.RandomState(0).randn(V, V).astype("float32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        tab = fluid.layers.create_parameter(
+            [V, V], "float32", name="tab2",
+            default_initializer=fluid.initializer.NumpyArrayInitializer(
+                table))
+        init = fluid.layers.fill_constant([B, H], "float32", 0.0)
+        dec = BeamSearchDecoder(TableCell(tab), 0, END, K)
+        outs, _ = dynamic_decode(dec, inits=[init], max_step_num=T)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (o,) = exe.run(prog, fetch_list=[outs])
+    assert np.asarray(o).shape == (B, T, K)

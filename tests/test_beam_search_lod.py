@@ -1,0 +1,104 @@
+"""LoD beam_search / beam_search_decode ops — fixtures and expected
+outputs lifted from the reference unit tests
+(tests/unittests/test_beam_search_op.py, test_beam_search_decode_op.py)
+so the host kernels match the C++ functors bit-for-bit.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.tensor import LoDTensor
+
+
+def _run_beam_search_step():
+    prog, startup = fluid.Program(), fluid.Program()
+    blk = prog.global_block()
+    for n, dt in [("pre_ids", "int64"), ("pre_scores", "float32"),
+                  ("ids", "int64"), ("scores", "float32")]:
+        blk.create_var(name=n, dtype=dt)
+    sel_i = blk.create_var(name="selected_ids", dtype="int64")
+    sel_s = blk.create_var(name="selected_scores", dtype="float32")
+    par = blk.create_var(name="parent_idx", dtype="int32")
+    blk.append_op("beam_search",
+                  inputs={"pre_ids": ["pre_ids"],
+                          "pre_scores": ["pre_scores"],
+                          "ids": ["ids"], "scores": ["scores"]},
+                  outputs={"selected_ids": ["selected_ids"],
+                          "selected_scores": ["selected_scores"],
+                          "parent_idx": ["parent_idx"]},
+                  attrs={"level": 0, "beam_size": 2, "end_id": 0,
+                         "is_accumulated": True},
+                  infer_shape=False)
+    scope = fluid.Scope()
+    lod = [[0, 2, 4], [0, 1, 2, 3, 4]]
+    scope.var("pre_ids").get_tensor().set(
+        np.array([[1, 2, 3, 4]], "int64"))
+    scope.var("pre_scores").get_tensor().set(
+        np.array([[0.1, 0.2, 0.3, 0.4]], "float32"))
+    t = scope.var("ids").get_tensor()
+    t.set(np.array([[4, 2, 5], [2, 1, 3], [3, 5, 2], [8, 2, 1]], "int64"))
+    t._lod = [list(l) for l in lod]
+    t = scope.var("scores").get_tensor()
+    t.set(np.array([[0.5, 0.3, 0.2], [0.6, 0.3, 0.1],
+                    [0.9, 0.5, 0.1], [0.7, 0.5, 0.1]], "float32"))
+    t._lod = [list(l) for l in lod]
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, fetch_list=[])
+    return scope
+
+
+def test_beam_search_op_reference_fixture():
+    scope = _run_beam_search_step()
+    sel_ids = scope.find_var("selected_ids").get_tensor()
+    sel_scores = scope.find_var("selected_scores").get_tensor()
+    parent = scope.find_var("parent_idx").get_tensor().numpy()
+    np.testing.assert_array_equal(
+        sel_ids.numpy(), np.array([4, 2, 3, 8])[:, None])
+    np.testing.assert_allclose(
+        sel_scores.numpy(), np.array([0.5, 0.6, 0.9, 0.7])[:, None])
+    assert sel_ids.lod() == [[0, 2, 4], [0, 1, 2, 3, 4]]
+    np.testing.assert_array_equal(parent, [0, 1, 2, 3])
+
+
+def test_beam_search_decode_op_reference_fixture():
+    prog, startup = fluid.Program(), fluid.Program()
+    blk = prog.global_block()
+    blk.create_var(name="ids")
+    blk.create_var(name="scores")
+    blk.create_var(name="sentence_ids", dtype="int64")
+    blk.create_var(name="sentence_scores", dtype="float32")
+    blk.append_op("beam_search_decode",
+                  inputs={"Ids": ["ids"], "Scores": ["scores"]},
+                  outputs={"SentenceIds": ["sentence_ids"],
+                           "SentenceScores": ["sentence_scores"]},
+                  attrs={"beam_size": 2, "end_id": 1},
+                  infer_shape=False)
+    scope = fluid.Scope()
+    ids_arr = scope.var("ids").get_lod_tensor_array()
+    scores_arr = scope.var("scores").get_lod_tensor_array()
+    steps = [
+        ([[0, 1, 2], [0, 1, 2]], [0, 0]),
+        ([[0, 1, 2], [0, 2, 4]], [2, 3, 4, 5]),
+        ([[0, 2, 4], [0, 2, 2, 4, 4]], [3, 1, 5, 4]),
+        ([[0, 2, 4], [0, 1, 2, 3, 4]], [1, 1, 3, 5]),
+        ([[0, 2, 4], [0, 0, 0, 2, 2]], [5, 1]),
+    ]
+    for lod, data in steps:
+        for arr, dt in ((ids_arr, "int64"), (scores_arr, "float32")):
+            t = LoDTensor()
+            t.set(np.array(data, dt))
+            t._lod = [list(l) for l in lod]
+            arr.append(t)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, fetch_list=[])
+    si = scope.find_var("sentence_ids").get_tensor()
+    ss = scope.find_var("sentence_scores").get_tensor()
+    expected_lod = [[0, 2, 4], [0, 4, 7, 12, 17]]
+    expected = np.array(
+        [0, 2, 3, 1, 0, 2, 1, 0, 4, 5, 3, 5, 0, 4, 5, 3, 1], "int64")
+    assert si.lod() == expected_lod
+    assert ss.lod() == expected_lod
+    np.testing.assert_array_equal(si.numpy().reshape(-1), expected)
+    np.testing.assert_allclose(ss.numpy().reshape(-1),
+                               expected.astype("float32"))
