@@ -181,3 +181,107 @@ def rank_loss(label, left, right, name=None):
         outputs={"Out": [out]},
     )
     return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference layers/loss.py:633
+    over nce_op.h). Returns cost / (num_neg_samples + 1) like the
+    reference. The alias tables the reference builds for custom_dist are
+    unnecessary here — the op samples the categorical directly."""
+    import numpy as np
+
+    from ..initializer import NumpyArrayInitializer
+    from ..layer_helper import LayerHelper
+    from ..param_attr import ParamAttr
+    from .ops import scale
+
+    helper = LayerHelper("nce", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = int(input.shape[1])
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                is_bias=False, dtype=input.dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if helper.bias_attr:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[num_total_classes, 1],
+                                    is_bias=True, dtype=input.dtype)
+        inputs["Bias"] = [b]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    sampler_id = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}[sampler]
+    if sampler == "custom_dist":
+        if custom_dist is None:
+            raise ValueError("custom_dist sampler needs custom_dist probs")
+        probs = helper.create_parameter(
+            attr=ParamAttr(), shape=[num_total_classes], dtype="float32",
+            default_initializer=NumpyArrayInitializer(
+                np.asarray(custom_dist, "float32")))
+        probs.stop_gradient = True
+        inputs["CustomDistProbs"] = [probs]
+    if num_neg_samples is None:
+        num_neg_samples = 10
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(input.dtype)
+    sample_labels = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "nce", inputs=inputs,
+        outputs={"Cost": [cost], "SampleLogits": [sample_logits],
+                 "SampleLabels": [sample_labels]},
+        attrs={"num_total_classes": int(num_total_classes),
+               "num_neg_samples": int(num_neg_samples), "seed": seed,
+               "sampler": sampler_id, "is_sparse": is_sparse,
+               "remote_prefetch": is_sparse},
+        infer_shape=False)
+    cost.shape = (int(input.shape[0]), 1)
+    return scale(cost, scale=1.0 / (num_neg_samples + 1))
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid loss (reference layers/loss.py:846 over
+    hierarchical_sigmoid_op.h); default tree is the complete binary tree
+    over num_classes."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("hierarchical_sigmoid", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = int(input.shape[1])
+    if is_custom and (path_table is None or path_code is None
+                      or num_classes is None):
+        raise ValueError("custom tree needs path_table, path_code and "
+                         "num_classes")
+    if not is_custom and (path_table is not None or path_code is not None):
+        raise ValueError(
+            "only num_classes should be passed without custom tree")
+    if not is_custom and num_classes < 2:
+        raise ValueError("num_classes must be >= 2")
+    rows = num_classes if is_custom else num_classes - 1
+    w = helper.create_parameter(attr=helper.param_attr, shape=[rows, dim],
+                                is_bias=False, dtype=input.dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if path_table is not None:
+        inputs["PathTable"] = [path_table]
+    if path_code is not None:
+        inputs["PathCode"] = [path_code]
+    if helper.bias_attr:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[rows, 1],
+                                    is_bias=True, dtype=input.dtype)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "hierarchical_sigmoid", inputs=inputs,
+        outputs={"Out": [out], "PreOut": [pre_out], "W_Out": [w]},
+        attrs={"num_classes": num_classes if num_classes else 2,
+               "is_sparse": is_sparse, "remote_prefetch": is_sparse},
+        infer_shape=False)
+    out.shape = (int(input.shape[0]), 1)
+    return out
+
+
+__all__ += ["nce", "hsigmoid"]

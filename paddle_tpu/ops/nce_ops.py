@@ -1,0 +1,162 @@
+"""Sampled-loss ops: NCE and hierarchical sigmoid.
+
+Parity: /root/reference/paddle/fluid/operators/nce_op.h (forward math
+:140-270, samplers math/sampler.cc) and hierarchical_sigmoid_op.h
+(:67-116, bit codes math/matrix_bit_code.h SimpleCode :103-122).
+
+TPU-native stance: both lower to dense gathers + elementwise math that
+XLA fuses — the reference's per-row Eigen loops and SelectedRows sparse
+grad paths become one gather/scatter pair (grads via auto-VJP scatter-
+add into the full table, which the compiler fuses into the update).
+Negative sampling draws from the executor-provided traced RNG seed so
+steps don't recompile.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import RNG_SEED_ATTR, In, Out, register_op
+
+
+def _sample_negatives(key, sampler, num_neg, batch, num_classes, probs):
+    """math/sampler.cc: 0=Uniform, 1=LogUniform (P(k) =
+    log((k+2)/(k+1)) / log(range+2)), 2=CustomDist."""
+    if sampler == 0:
+        return jax.random.randint(key, (batch, num_neg), 0, num_classes,
+                                  dtype=jnp.int32)
+    if sampler == 1:
+        # math/sampler.cc LogUniformSampler(range = C-1): log_range =
+        # log(range+1); Sample() = (int)(exp(u*log_range) - 1) % range
+        rng_range = num_classes - 1
+        log_range = math.log(rng_range + 1.0)
+        u = jax.random.uniform(key, (batch, num_neg))
+        val = (jnp.exp(u * log_range) - 1.0).astype(jnp.int32)
+        return jnp.remainder(val, rng_range)
+    # custom distribution: per-row categorical over the given probs
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    return jax.random.categorical(key, logits[None, :], axis=-1,
+                                  shape=(batch, num_neg)).astype(jnp.int32)
+
+
+def _sampler_prob(sampler, targets, num_classes, probs):
+    if sampler == 0:
+        return jnp.full(targets.shape, 1.0 / num_classes, jnp.float32)
+    if sampler == 1:
+        # Probability(k) = log((k+2)/(k+1)) / log(range+1)
+        rng_range = num_classes - 1
+        k = targets.astype(jnp.float32)
+        return jnp.log((k + 2.0) / (k + 1.0)) / math.log(rng_range + 1.0)
+    return probs[targets]
+
+
+@register_op(
+    "nce",
+    inputs=[In("Input"), In("Label", no_grad=True), In("Weight"),
+            In("Bias", dispensable=True),
+            In("SampleWeight", dispensable=True, no_grad=True),
+            In("CustomDistProbs", dispensable=True, no_grad=True),
+            In("CustomDistAlias", dispensable=True, no_grad=True),
+            In("CustomDistAliasProbs", dispensable=True, no_grad=True)],
+    outputs=[Out("Cost"), Out("SampleLogits", no_grad=True),
+             Out("SampleLabels", no_grad=True)],
+    attrs={"num_total_classes": 0, "num_neg_samples": 10, "seed": 0,
+           "sampler": 0, "custom_neg_classes": [], "is_sparse": False,
+           "remote_prefetch": False},
+    needs_rng=True,
+)
+def _nce(ins, attrs):
+    """nce_op.h NCEKernel: o = sigmoid(x·w_t + b_t); per-sample cost
+    -log(o/(o+b)) for true classes, -log(b/(o+b)) for sampled negatives,
+    b = sampler_prob(t) * num_neg_samples."""
+    x = ins["Input"]
+    label = ins["Label"].astype(jnp.int32)
+    w = ins["Weight"]
+    num_classes = int(attrs["num_total_classes"])
+    num_neg = int(attrs["num_neg_samples"])
+    sampler = int(attrs.get("sampler", 0))
+    B = x.shape[0]
+    num_true = label.shape[1] if label.ndim == 2 else 1
+    label2d = label.reshape(B, num_true)
+
+    custom_negs = attrs.get("custom_neg_classes") or []
+    probs = ins.get("CustomDistProbs")
+    if len(custom_negs) > 0:
+        negs = jnp.broadcast_to(
+            jnp.asarray(custom_negs, jnp.int32)[None, :], (B, len(custom_negs)))
+    else:
+        key = jax.random.fold_in(jax.random.PRNGKey(ins[RNG_SEED_ATTR]),
+                                 int(attrs.get("seed", 0)))
+        negs = _sample_negatives(key, sampler, num_neg, B, num_classes, probs)
+    sample_labels = jnp.concatenate([label2d, negs], axis=1)  # [B, T+S]
+
+    w_rows = w[sample_labels]                      # [B, T+S, D]
+    logits = jnp.einsum("bd,bsd->bs", x, w_rows)
+    if ins.get("Bias") is not None:
+        logits = logits + ins["Bias"].reshape(-1)[sample_labels]
+    o = jax.nn.sigmoid(logits)
+
+    b = _sampler_prob(sampler, sample_labels, num_classes,
+                      probs) * float(negs.shape[1])
+    is_true = jnp.arange(sample_labels.shape[1])[None, :] < num_true
+    cost = jnp.where(is_true,
+                     -jnp.log(o / (o + b) + 1e-30),
+                     -jnp.log(b / (o + b) + 1e-30))
+    sw = ins.get("SampleWeight")
+    weight = sw.reshape(B, 1) if sw is not None else 1.0
+    out = (cost * weight).sum(axis=1, keepdims=True)
+    return {"Cost": out, "SampleLogits": o,
+            "SampleLabels": sample_labels.astype(jnp.int64)}
+
+
+@register_op(
+    "hierarchical_sigmoid",
+    inputs=[In("X"), In("W"), In("Label", no_grad=True),
+            In("PathTable", dispensable=True, no_grad=True),
+            In("PathCode", dispensable=True, no_grad=True),
+            In("Bias", dispensable=True)],
+    outputs=[Out("Out"), Out("PreOut", no_grad=True),
+             Out("W_Out", no_grad=True, dispensable=True)],
+    attrs={"num_classes": 2, "is_sparse": False, "remote_prefetch": False},
+)
+def _hierarchical_sigmoid(ins, attrs):
+    """hierarchical_sigmoid_op.h: walk each label's path of internal
+    nodes; pre_out = clip(x·w_node + b_node, ±40); loss_i =
+    Σ_path [softplus(pre) - bit·pre] (= binary logistic loss at every
+    junction). Default tree = SimpleCode over c = label + num_classes
+    (index(bit) = (c >> (bit+1)) - 1, bit(b) = c & (1 << b))."""
+    x = ins["X"]
+    w = ins["W"]
+    label = ins["Label"].astype(jnp.int32).reshape(-1)
+    C = int(attrs["num_classes"])
+    B = x.shape[0]
+
+    if ins.get("PathTable") is not None:
+        table = ins["PathTable"].astype(jnp.int32)  # [B, L], -1 padded
+        code = ins["PathCode"].astype(jnp.int32)
+        mask = (table >= 0).astype(jnp.float32)
+        idx = jnp.maximum(table, 0)
+        bits = code.astype(jnp.float32)
+    else:
+        c = label + C                      # [B]; root is 1, leaves >= C
+        L = int(math.floor(math.log2(2 * C - 1)))  # max code length
+        js = jnp.arange(L)
+        # exact integer bit-length (float log2 is unsafe at powers of 2):
+        # length(c) = floor(log2(c)) = #bits - 1
+        lengths = jnp.sum((c[:, None] >> jnp.arange(1, L + 2)[None, :]) > 0,
+                          axis=1)
+        mask = (js[None, :] < lengths[:, None]).astype(jnp.float32)
+        idx = jnp.maximum((c[:, None] >> (js[None, :] + 1)) - 1, 0)
+        bits = ((c[:, None] >> js[None, :]) & 1).astype(jnp.float32)
+
+    pre = jnp.einsum("bd,bld->bl", x, w[idx])
+    if ins.get("Bias") is not None:
+        pre = pre + ins["Bias"].reshape(-1)[idx]
+    pre = jnp.clip(pre, -40.0, 40.0)
+    pre = pre * mask
+    loss = (jax.nn.softplus(pre) - bits * pre) * mask
+    out = loss.sum(axis=1, keepdims=True)
+    return {"Out": out, "PreOut": pre, "W_Out": w}

@@ -1,0 +1,187 @@
+"""NCE + hierarchical_sigmoid vs numpy oracles.
+
+Oracles re-implement the reference kernels exactly: nce_op.h cost math
+with fixed custom_neg_classes (the reference's own OpTest trick for
+determinism, test_nce.py), and matrix_bit_code.h SimpleCode paths for
+hsigmoid (test_hsigmoid_op.py).
+"""
+import math
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+B, D, C = 5, 4, 20
+
+
+def _np_nce(x, w, b, labels, negs, num_classes):
+    num_neg = len(negs)
+    sample_labels = np.concatenate(
+        [labels.reshape(B, 1), np.tile(negs, (B, 1))], axis=1)
+    logits = np.einsum("bd,bsd->bs", x, w[sample_labels]) + \
+        b.reshape(-1)[sample_labels]
+    o = 1.0 / (1.0 + np.exp(-logits))
+    prob = 1.0 / num_classes * num_neg
+    cost = np.empty_like(o)
+    cost[:, 0] = -np.log(o[:, 0] / (o[:, 0] + prob) + 1e-30)
+    cost[:, 1:] = -np.log(prob / (o[:, 1:] + prob) + 1e-30)
+    return cost.sum(1, keepdims=True) / (num_neg + 1)
+
+
+def test_nce_custom_negatives_matches_numpy():
+    rng = np.random.RandomState(0)
+    xb = rng.randn(B, D).astype("float32")
+    lab = rng.randint(0, C, (B, 1)).astype("int64")
+    wv = rng.randn(C, D).astype("float32") * 0.5
+    bv = rng.randn(C, 1).astype("float32") * 0.1
+    negs = [1, 3, 5, 7]
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[B, D], dtype="float32")
+        l = fluid.data(name="l", shape=[B, 1], dtype="int64")
+        cost = fluid.layers.nce(
+            x, l, num_total_classes=C,
+            param_attr=fluid.ParamAttr(
+                name="nce_w",
+                initializer=fluid.initializer.NumpyArrayInitializer(wv)),
+            bias_attr=fluid.ParamAttr(
+                name="nce_b",
+                initializer=fluid.initializer.NumpyArrayInitializer(bv)),
+            num_neg_samples=len(negs))
+        # pin the sampled negatives for determinism (reference OpTest
+        # custom_neg_classes path)
+        for op in prog.global_block().ops:
+            if op.type == "nce":
+                op.attrs["custom_neg_classes"] = negs
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (got,) = exe.run(prog, feed={"x": xb, "l": lab},
+                         fetch_list=[cost])
+        ref = _np_nce(xb, wv, bv, lab, negs, C)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4,
+                                   atol=1e-5)
+        # training updates the table
+        w_after = np.asarray(scope.find_var("nce_w").raw().array)
+        assert not np.allclose(w_after, wv)
+
+
+def test_nce_sampled_runs_and_trains():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[B, D], dtype="float32")
+        l = fluid.data(name="l", shape=[B, 1], dtype="int64")
+        cost = fluid.layers.nce(x, l, num_total_classes=C,
+                                num_neg_samples=6, sampler="log_uniform")
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        vals = [
+            float(np.asarray(exe.run(
+                prog, feed={"x": rng.randn(B, D).astype("float32"),
+                            "l": rng.randint(0, C, (B, 1)).astype("int64")},
+                fetch_list=[loss])[0]).ravel()[0])
+            for _ in range(3)]
+        assert all(np.isfinite(v) for v in vals)
+
+
+def _np_hsigmoid(x, w, b, labels, num_classes):
+    batch = x.shape[0]
+    out = np.zeros((batch, 1), "float64")
+    for i in range(batch):
+        c = int(labels[i]) + num_classes
+        length = int(math.floor(math.log2(c)))
+        for j in range(length):
+            node = (c >> (j + 1)) - 1
+            bit = (c >> j) & 1
+            pre = float(np.dot(x[i], w[node]) + b[node, 0])
+            pre = np.clip(pre, -40.0, 40.0)
+            out[i, 0] += np.log(1.0 + np.exp(pre)) - bit * pre
+    return out
+
+
+def test_hsigmoid_matches_numpy():
+    num_classes = 6
+    rng = np.random.RandomState(2)
+    xb = rng.randn(B, D).astype("float32")
+    lab = rng.randint(0, num_classes, (B, 1)).astype("int64")
+    wv = rng.randn(num_classes - 1, D).astype("float32") * 0.5
+    bv = rng.randn(num_classes - 1, 1).astype("float32") * 0.1
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[B, D], dtype="float32")
+        l = fluid.data(name="l", shape=[B, 1], dtype="int64")
+        out = fluid.layers.hsigmoid(
+            x, l, num_classes,
+            param_attr=fluid.ParamAttr(
+                name="hs_w",
+                initializer=fluid.initializer.NumpyArrayInitializer(wv)),
+            bias_attr=fluid.ParamAttr(
+                name="hs_b",
+                initializer=fluid.initializer.NumpyArrayInitializer(bv)))
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (got,) = exe.run(prog, feed={"x": xb, "l": lab}, fetch_list=[out])
+        ref = _np_hsigmoid(xb, wv, bv, lab.reshape(-1), num_classes)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4,
+                                   atol=1e-5)
+        w_after = np.asarray(scope.find_var("hs_w").raw().array)
+        assert not np.allclose(w_after, wv)
+
+
+def test_hsigmoid_custom_tree():
+    # custom 4-leaf tree with explicit paths (reference test_hsigmoid_op
+    # TestHSigmoidOpWithCostumTree pattern)
+    num_classes = 4
+    rng = np.random.RandomState(4)
+    xb = rng.randn(B, D).astype("float32")
+    lab = rng.randint(0, num_classes, (B, 1)).astype("int64")
+    # per-class fixed paths over 3 internal nodes, -1 padded
+    table = np.array([[0, 1, -1], [0, 1, -1], [0, 2, -1], [0, 2, -1]],
+                     "int64")
+    code = np.array([[0, 0, 0], [0, 1, 0], [1, 0, 0], [1, 1, 0]], "int64")
+    path_t = table[lab.reshape(-1)]
+    path_c = code[lab.reshape(-1)]
+    wv = rng.randn(num_classes, D).astype("float32") * 0.5
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[B, D], dtype="float32")
+        l = fluid.data(name="l", shape=[B, 1], dtype="int64")
+        pt = fluid.data(name="pt", shape=[B, 3], dtype="int64")
+        pc = fluid.data(name="pc", shape=[B, 3], dtype="int64")
+        out = fluid.layers.hsigmoid(
+            x, l, num_classes, path_table=pt, path_code=pc, is_custom=True,
+            param_attr=fluid.ParamAttr(
+                name="hs_cw",
+                initializer=fluid.initializer.NumpyArrayInitializer(wv)),
+            bias_attr=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (got,) = exe.run(prog, feed={"x": xb, "l": lab, "pt": path_t,
+                                     "pc": path_c}, fetch_list=[out])
+    # numpy oracle over explicit paths
+    ref = np.zeros((B, 1))
+    for i in range(B):
+        for j in range(3):
+            node = path_t[i, j]
+            if node < 0:
+                continue
+            pre = np.clip(float(np.dot(xb[i], wv[node])), -40, 40)
+            ref[i, 0] += np.log1p(np.exp(pre)) - path_c[i, j] * pre
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-5)
