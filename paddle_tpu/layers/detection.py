@@ -316,3 +316,150 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
 
 __all__ += ["bipartite_match", "target_assign", "density_prior_box",
             "detection_output", "ssd_loss"]
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """RPN proposal generation (reference detection.py:2713 over
+    generate_proposals_op.cc; host kernel in ops/proposal_ops.py)."""
+    helper = LayerHelper("generate_proposals", input=scores, name=name)
+    rpn_rois = helper.create_variable_for_type_inference(bbox_deltas.dtype)
+    rpn_roi_probs = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        "generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rpn_rois], "RpnRoiProbs": [rpn_roi_probs]},
+        attrs={"pre_nms_topN": pre_nms_top_n,
+               "post_nms_topN": post_nms_top_n, "nms_thresh": nms_thresh,
+               "min_size": min_size, "eta": eta},
+        infer_shape=False)
+    rpn_rois.stop_gradient = True
+    rpn_roi_probs.stop_gradient = True
+    return rpn_rois, rpn_roi_probs
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN anchor sampling (reference detection.py:289 over
+    rpn_target_assign_op.cc). Returns (predicted_scores,
+    predicted_location, target_label, target_bbox, bbox_inside_weight)."""
+    from .nn import reshape
+
+    helper = LayerHelper("rpn_target_assign", input=anchor_box)
+    loc_index = helper.create_variable_for_type_inference("int32")
+    score_index = helper.create_variable_for_type_inference("int32")
+    target_label = helper.create_variable_for_type_inference("int32")
+    target_bbox = helper.create_variable_for_type_inference(
+        anchor_box.dtype)
+    bbox_inside_weight = helper.create_variable_for_type_inference(
+        anchor_box.dtype)
+    helper.append_op(
+        "rpn_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                "IsCrowd": [is_crowd], "ImInfo": [im_info]},
+        outputs={"LocationIndex": [loc_index], "ScoreIndex": [score_index],
+                 "TargetLabel": [target_label], "TargetBBox": [target_bbox],
+                 "BBoxInsideWeight": [bbox_inside_weight]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "use_random": use_random},
+        infer_shape=False)
+    for v in (loc_index, score_index, target_label, target_bbox,
+              bbox_inside_weight):
+        v.stop_gradient = True
+    cls_flat = reshape(cls_logits, [-1, 1])
+    bbox_flat = reshape(bbox_pred, [-1, 4])
+    # index vars have runtime-only shapes — append gathers without the
+    # static shape-inference pass
+    predicted_cls = helper.create_variable_for_type_inference(
+        cls_logits.dtype)
+    predicted_loc = helper.create_variable_for_type_inference(
+        bbox_pred.dtype)
+    helper.append_op("gather",
+                     inputs={"X": [cls_flat], "Index": [score_index]},
+                     outputs={"Out": [predicted_cls]},
+                     attrs={"overwrite": True}, infer_shape=False)
+    helper.append_op("gather",
+                     inputs={"X": [bbox_flat], "Index": [loc_index]},
+                     outputs={"Out": [predicted_loc]},
+                     attrs={"overwrite": True}, infer_shape=False)
+    return (predicted_cls, predicted_loc, target_label, target_bbox,
+            bbox_inside_weight)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    """(reference detection.py:3358 over box_decoder_and_assign_op.h)."""
+    helper = LayerHelper("box_decoder_and_assign", input=prior_box,
+                         name=name)
+    decoded = helper.create_variable_for_type_inference(prior_box.dtype)
+    assigned = helper.create_variable_for_type_inference(prior_box.dtype)
+    helper.append_op(
+        "box_decoder_and_assign",
+        inputs={"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                "TargetBox": [target_box], "BoxScore": [box_score]},
+        outputs={"DecodeBox": [decoded], "OutputAssignBox": [assigned]},
+        attrs={"box_clip": box_clip}, infer_shape=False)
+    return decoded, assigned
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    """(reference detection.py:3274 over distribute_fpn_proposals_op.h).
+    Returns (multi_rois list, restore_ind)."""
+    helper = LayerHelper("distribute_fpn_proposals", input=fpn_rois,
+                         name=name)
+    num_lvl = max_level - min_level + 1
+    multi_rois = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+                  for _ in range(num_lvl)]
+    restore_ind = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "distribute_fpn_proposals",
+        inputs={"FpnRois": [fpn_rois]},
+        outputs={"MultiFpnRois": multi_rois,
+                 "RestoreIndex": [restore_ind]},
+        attrs={"min_level": min_level, "max_level": max_level,
+               "refer_level": refer_level, "refer_scale": refer_scale},
+        infer_shape=False)
+    return multi_rois, restore_ind
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    """(reference detection.py:3423 over collect_fpn_proposals_op.h)."""
+    helper = LayerHelper("collect_fpn_proposals", input=multi_rois[0],
+                         name=name)
+    num_lvl = max_level - min_level + 1
+    fpn_rois = helper.create_variable_for_type_inference(
+        multi_rois[0].dtype)
+    helper.append_op(
+        "collect_fpn_proposals",
+        inputs={"MultiLevelRois": list(multi_rois[:num_lvl]),
+                "MultiLevelScores": list(multi_scores[:num_lvl])},
+        outputs={"FpnRois": [fpn_rois]},
+        attrs={"post_nms_topN": post_nms_top_n}, infer_shape=False)
+    return fpn_rois
+
+
+def polygon_box_transform(input, name=None):
+    """(reference detection.py:858 over polygon_box_transform_op.cc)."""
+    helper = LayerHelper("polygon_box_transform", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("polygon_box_transform", inputs={"Input": [input]},
+                     outputs={"Output": [out]}, infer_shape=False)
+    out.shape = tuple(input.shape)
+    return out
+
+
+__all__ += ["generate_proposals", "rpn_target_assign",
+            "box_decoder_and_assign", "distribute_fpn_proposals",
+            "collect_fpn_proposals", "polygon_box_transform"]

@@ -193,7 +193,6 @@ def nce(input, label, num_total_classes, sample_weight=None,
     import numpy as np
 
     from ..initializer import NumpyArrayInitializer
-    from ..layer_helper import LayerHelper
     from ..param_attr import ParamAttr
     from .ops import scale
 
@@ -245,8 +244,6 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
     """Hierarchical sigmoid loss (reference layers/loss.py:846 over
     hierarchical_sigmoid_op.h); default tree is the complete binary tree
     over num_classes."""
-    from ..layer_helper import LayerHelper
-
     helper = LayerHelper("hierarchical_sigmoid", input=input,
                          param_attr=param_attr, bias_attr=bias_attr,
                          name=name)
@@ -258,8 +255,9 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
     if not is_custom and (path_table is not None or path_code is not None):
         raise ValueError(
             "only num_classes should be passed without custom tree")
-    if not is_custom and num_classes < 2:
-        raise ValueError("num_classes must be >= 2")
+    if not is_custom and (num_classes is None or num_classes < 2):
+        raise ValueError("num_classes must be an int >= 2 for the "
+                         "default tree")
     rows = num_classes if is_custom else num_classes - 1
     w = helper.create_parameter(attr=helper.param_attr, shape=[rows, dim],
                                 is_bias=False, dtype=input.dtype)

@@ -24,7 +24,7 @@ from ..core.registry import RNG_SEED_ATTR, In, Out, register_op
 
 def _sample_negatives(key, sampler, num_neg, batch, num_classes, probs):
     """math/sampler.cc: 0=Uniform, 1=LogUniform (P(k) =
-    log((k+2)/(k+1)) / log(range+2)), 2=CustomDist."""
+    log((k+2)/(k+1)) / log(range+1)), 2=CustomDist."""
     if sampler == 0:
         return jax.random.randint(key, (batch, num_neg), 0, num_classes,
                                   dtype=jnp.int32)

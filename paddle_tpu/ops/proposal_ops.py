@@ -1,0 +1,440 @@
+"""RPN / FPN proposal-generation op family.
+
+Parity: /root/reference/paddle/fluid/operators/detection/
+generate_proposals_op.cc (BoxCoder :70, ClipTiledBoxes :132,
+FilterBoxes :155, NMS :249, ProposalForOneImage :375),
+rpn_target_assign_op.cc (FilterStraddleAnchor :93, ScoreAssign :168,
+SampleRpnFgBgGt), bbox_util.h BoxToDelta :54,
+box_decoder_and_assign_op.h, distribute_fpn_proposals_op.h,
+collect_fpn_proposals_op.h.
+
+TPU-native stance: proposal generation is ragged, control-heavy,
+per-image work (dynamic box counts, greedy NMS, reservoir sampling) —
+kept host-side like every LoD-producing detection op here; the FLOP-
+heavy parts (the conv backbone and RPN heads producing scores/deltas)
+stay in compiled programs. Outputs carry LoD exactly like the
+reference so downstream roi_align/LoD consumers work unchanged.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op, register_op
+from .detection_ops import _nms_single_class
+
+_BBOX_CLIP = math.log(1000.0 / 16.0)
+
+
+def _decode_boxes(anchors, deltas, variances):
+    """generate_proposals_op.cc BoxCoder (+1 pixel conventions)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + 0.5 * aw
+    ay = anchors[:, 1] + 0.5 * ah
+    if variances is not None:
+        cx = variances[:, 0] * deltas[:, 0] * aw + ax
+        cy = variances[:, 1] * deltas[:, 1] * ah + ay
+        w = np.exp(np.minimum(variances[:, 2] * deltas[:, 2], _BBOX_CLIP)) * aw
+        h = np.exp(np.minimum(variances[:, 3] * deltas[:, 3], _BBOX_CLIP)) * ah
+    else:
+        cx = deltas[:, 0] * aw + ax
+        cy = deltas[:, 1] * ah + ay
+        w = np.exp(np.minimum(deltas[:, 2], _BBOX_CLIP)) * aw
+        h = np.exp(np.minimum(deltas[:, 3], _BBOX_CLIP)) * ah
+    return np.stack([cx - w / 2, cy - h / 2,
+                     cx + w / 2 - 1, cy + h / 2 - 1], axis=1)
+
+
+def _proposal_for_one_image(im_info, anchors, variances, deltas, scores,
+                            pre_nms_top_n, post_nms_top_n, nms_thresh,
+                            min_size, eta):
+    order = np.argsort(-scores, kind="stable")
+    if 0 < pre_nms_top_n < scores.size:
+        order = order[:pre_nms_top_n]
+    scores_sel = scores[order]
+    props = _decode_boxes(anchors[order], deltas[order],
+                          variances[order] if variances is not None else None)
+    # clip to image (im_info = [h, w, scale])
+    props[:, 0::2] = np.clip(props[:, 0::2], 0, im_info[1] - 1)
+    props[:, 1::2] = np.clip(props[:, 1::2], 0, im_info[0] - 1)
+    # filter by min_size at the ORIGINAL scale + center inside image
+    ms = max(float(min_size), 1.0)
+    ws = props[:, 2] - props[:, 0] + 1
+    hs = props[:, 3] - props[:, 1] + 1
+    ws_orig = (props[:, 2] - props[:, 0]) / im_info[2] + 1
+    hs_orig = (props[:, 3] - props[:, 1]) / im_info[2] + 1
+    cx = props[:, 0] + ws / 2
+    cy = props[:, 1] + hs / 2
+    keep = np.where((ws_orig >= ms) & (hs_orig >= ms)
+                    & (cx <= im_info[1]) & (cy <= im_info[0]))[0]
+    props, scores_sel = props[keep], scores_sel[keep]
+    if nms_thresh <= 0 or props.shape[0] == 0:
+        return props, scores_sel
+    keep_nms = _nms_single_class(props, scores_sel, -np.inf, -1,
+                                 nms_thresh, eta, normalized=False)
+    if 0 < post_nms_top_n < len(keep_nms):
+        keep_nms = keep_nms[:post_nms_top_n]
+    return props[keep_nms], scores_sel[keep_nms]
+
+
+@register_host_op(
+    "generate_proposals",
+    inputs=[In("Scores", no_grad=True), In("BboxDeltas", no_grad=True),
+            In("ImInfo", no_grad=True), In("Anchors", no_grad=True),
+            In("Variances", no_grad=True)],
+    outputs=[Out("RpnRois"), Out("RpnRoiProbs")],
+    attrs={"pre_nms_topN": 6000, "post_nms_topN": 1000, "nms_thresh": 0.5,
+           "min_size": 0.1, "eta": 1.0},
+)
+def _generate_proposals(executor, op, scope):
+    scores = scope.find_var(op.input("Scores")[0]).get_tensor().numpy()
+    deltas = scope.find_var(op.input("BboxDeltas")[0]).get_tensor().numpy()
+    im_info = scope.find_var(op.input("ImInfo")[0]).get_tensor().numpy()
+    anchors = scope.find_var(
+        op.input("Anchors")[0]).get_tensor().numpy().reshape(-1, 4)
+    variances = scope.find_var(
+        op.input("Variances")[0]).get_tensor().numpy().reshape(-1, 4)
+    N, A = scores.shape[0], scores.shape[1]
+
+    all_rois, all_probs, lod0 = [], [], [0]
+    total = 0
+    for i in range(N):
+        # [A,H,W] -> [H,W,A] flat, matching the reference transpose
+        sc = scores[i].transpose(1, 2, 0).reshape(-1)
+        dl = deltas[i].transpose(1, 2, 0).reshape(-1, 4)
+        props, probs = _proposal_for_one_image(
+            im_info[i], anchors, variances, dl, sc,
+            int(op.attrs.get("pre_nms_topN", 6000)),
+            int(op.attrs.get("post_nms_topN", 1000)),
+            float(op.attrs.get("nms_thresh", 0.5)),
+            float(op.attrs.get("min_size", 0.1)),
+            float(op.attrs.get("eta", 1.0)))
+        all_rois.append(props)
+        all_probs.append(probs)
+        total += props.shape[0]
+        lod0.append(total)
+    rois = (np.concatenate(all_rois, 0) if total
+            else np.zeros((0, 4))).astype("float32")
+    probs = (np.concatenate(all_probs, 0) if total
+             else np.zeros((0,))).astype("float32").reshape(-1, 1)
+    executor._write_var(scope, op.output("RpnRois")[0], rois, lod=[lod0])
+    executor._write_var(scope, op.output("RpnRoiProbs")[0], probs,
+                        lod=[lod0])
+
+
+def _iou_matrix(a, b):
+    """JaccardOverlap, pixel (+1) convention, [Na, Nb]."""
+    x0 = np.maximum(a[:, None, 0], b[None, :, 0])
+    y0 = np.maximum(a[:, None, 1], b[None, :, 1])
+    x1 = np.minimum(a[:, None, 2], b[None, :, 2])
+    y1 = np.minimum(a[:, None, 3], b[None, :, 3])
+    iw = np.maximum(x1 - x0 + 1, 0)
+    ih = np.maximum(y1 - y0 + 1, 0)
+    inter = iw * ih
+    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / union, 0.0)
+
+
+def _reservoir_sampling(num, inds, rng, use_random):
+    """rpn_target_assign_op.cc:151 — keep `num`; random replacement when
+    use_random else the first `num`."""
+    if len(inds) <= num:
+        return inds
+    if not use_random:
+        return inds[:num]
+    out = list(inds[:num])
+    for i in range(num, len(inds)):
+        j = rng.randint(0, i + 1)
+        if j < num:
+            out[j] = inds[i]
+    return out
+
+
+def _box_to_delta(ex, gt):
+    """bbox_util.h BoxToDelta (non-normalized, no weights)."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * ew
+    ecy = ex[:, 1] + 0.5 * eh
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    return np.stack([(gcx - ecx) / ew, (gcy - ecy) / eh,
+                     np.log(gw / ew), np.log(gh / eh)], axis=1)
+
+
+def _score_assign(iou, batch_size_per_im, fg_fraction, pos_overlap,
+                  neg_overlap, rng, use_random):
+    """rpn_target_assign_op.cc ScoreAssign — returns (fg_inds, bg_inds,
+    fg_fake, bbox_inside_weight rows)."""
+    anchor_num = iou.shape[0]
+    a2g_max = iou.max(axis=1) if iou.shape[1] else np.zeros(anchor_num)
+    g2a_max = iou.max(axis=0) if iou.shape[1] else np.zeros(0)
+    target_label = np.full(anchor_num, -1, np.int32)
+
+    eps = 1e-5
+    is_max = (np.abs(iou - g2a_max[None, :]) < eps).any(axis=1) \
+        if iou.shape[1] else np.zeros(anchor_num, bool)
+    fg_inds_fake = list(np.where(is_max | (a2g_max >= pos_overlap))[0])
+
+    if fg_fraction > 0 and batch_size_per_im > 0:
+        fg_num = int(fg_fraction * batch_size_per_im)
+        fg_inds_fake = _reservoir_sampling(fg_num, fg_inds_fake, rng,
+                                           use_random)
+    fg_fake_num = len(fg_inds_fake)
+    target_label[fg_inds_fake] = 1
+
+    bg_inds_fake = list(np.where(a2g_max < neg_overlap)[0])
+    if fg_fraction > 0 and batch_size_per_im > 0:
+        bg_num = batch_size_per_im - fg_fake_num
+        bg_inds_fake = _reservoir_sampling(bg_num, bg_inds_fake, rng,
+                                           use_random)
+
+    fg_fake, inside_w = [], []
+    fake_num = 0
+    for b in bg_inds_fake:
+        # fg fake: a bg anchor that stole a fg slot contributes a zero-
+        # weighted regression row for the first fake fg
+        if target_label[b] == 1:
+            fake_num += 1
+            fg_fake.append(fg_inds_fake[0])
+            inside_w.extend([0.0] * 4)
+        target_label[b] = 0
+    inside_w.extend([1.0] * 4 * (fg_fake_num - fake_num))
+
+    fg_inds = list(np.where(target_label == 1)[0])
+    fg_fake = fg_fake + fg_inds
+    bg_inds = list(np.where(target_label == 0)[0])
+    return fg_inds, bg_inds, fg_fake, inside_w
+
+
+@register_host_op(
+    "rpn_target_assign",
+    inputs=[In("Anchor", no_grad=True), In("GtBoxes", no_grad=True),
+            In("IsCrowd", no_grad=True), In("ImInfo", no_grad=True)],
+    outputs=[Out("LocationIndex"), Out("ScoreIndex"), Out("TargetLabel"),
+             Out("TargetBBox"), Out("BBoxInsideWeight")],
+    attrs={"rpn_batch_size_per_im": 256, "rpn_straddle_thresh": 0.0,
+           "rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3,
+           "rpn_fg_fraction": 0.25, "use_random": True, "seed": 0},
+)
+def _rpn_target_assign(executor, op, scope):
+    anchors = scope.find_var(
+        op.input("Anchor")[0]).get_tensor().numpy().reshape(-1, 4)
+    gt_t = scope.find_var(op.input("GtBoxes")[0]).get_tensor()
+    crowd_t = scope.find_var(op.input("IsCrowd")[0]).get_tensor()
+    im_info = scope.find_var(op.input("ImInfo")[0]).get_tensor().numpy()
+    gt_all = gt_t.numpy().reshape(-1, 4)
+    crowd_all = crowd_t.numpy().reshape(-1)
+    gt_lod = gt_t.lod()[0] if gt_t.lod() else [0, gt_all.shape[0]]
+
+    batch_per_im = int(op.attrs.get("rpn_batch_size_per_im", 256))
+    straddle = float(op.attrs.get("rpn_straddle_thresh", 0.0))
+    pos = float(op.attrs.get("rpn_positive_overlap", 0.7))
+    neg = float(op.attrs.get("rpn_negative_overlap", 0.3))
+    frac = float(op.attrs.get("rpn_fg_fraction", 0.25))
+    use_random = bool(op.attrs.get("use_random", True))
+    rng = np.random.RandomState(int(op.attrs.get("seed", 0)))
+
+    A = anchors.shape[0]
+    loc_all, score_all, lbl_all, tgt_all, w_all = [], [], [], [], []
+    for i in range(len(gt_lod) - 1):
+        gts = gt_all[gt_lod[i]:gt_lod[i + 1]]
+        crowd = crowd_all[gt_lod[i]:gt_lod[i + 1]]
+        gts = gts[crowd == 0]
+        h, w = im_info[i, 0], im_info[i, 1]
+        if straddle >= 0:
+            inside = np.where(
+                (anchors[:, 0] >= -straddle) & (anchors[:, 1] >= -straddle)
+                & (anchors[:, 2] < w + straddle)
+                & (anchors[:, 3] < h + straddle))[0]
+        else:
+            inside = np.arange(A)
+        iou = _iou_matrix(anchors[inside], gts)
+        fg, bg, fg_fake, inside_w = _score_assign(
+            iou, batch_per_im, frac, pos, neg, rng, use_random)
+        argmax = iou.argmax(axis=1) if gts.shape[0] else \
+            np.zeros(len(inside), np.int64)
+        gt_inds = argmax[fg_fake]
+        # map back to global anchor indices + image offset
+        loc = inside[fg_fake] + i * A
+        score = np.concatenate([inside[fg] + i * A,
+                                inside[bg] + i * A]).astype("int32")
+        labels = np.concatenate([np.ones(len(fg), np.int32),
+                                 np.zeros(len(bg), np.int32)])
+        tgt = (_box_to_delta(anchors[inside[fg_fake]], gts[gt_inds])
+               if len(fg_fake) else np.zeros((0, 4)))
+        loc_all.append(loc.astype("int32"))
+        score_all.append(score)
+        lbl_all.append(labels)
+        tgt_all.append(tgt)
+        w_all.append(np.asarray(inside_w, "float32").reshape(-1, 4))
+
+    executor._write_var(scope, op.output("LocationIndex")[0],
+                        np.concatenate(loc_all).astype("int32"))
+    executor._write_var(scope, op.output("ScoreIndex")[0],
+                        np.concatenate(score_all).astype("int32"))
+    executor._write_var(scope, op.output("TargetLabel")[0],
+                        np.concatenate(lbl_all).reshape(-1, 1))
+    executor._write_var(scope, op.output("TargetBBox")[0],
+                        np.concatenate(tgt_all).astype("float32"))
+    executor._write_var(scope, op.output("BBoxInsideWeight")[0],
+                        np.concatenate(w_all).astype("float32"))
+
+
+@register_host_op(
+    "box_decoder_and_assign",
+    inputs=[In("PriorBox", no_grad=True), In("PriorBoxVar", no_grad=True),
+            In("TargetBox", no_grad=True), In("BoxScore", no_grad=True)],
+    outputs=[Out("DecodeBox"), Out("OutputAssignBox")],
+    attrs={"box_clip": 4.135166556742356},
+)
+def _box_decoder_and_assign(executor, op, scope):
+    """box_decoder_and_assign_op.h: per-class decode + pick the best
+    non-background class's box (fallback: the prior itself)."""
+    prior = scope.find_var(
+        op.input("PriorBox")[0]).get_tensor().numpy().reshape(-1, 4)
+    var = scope.find_var(
+        op.input("PriorBoxVar")[0]).get_tensor().numpy().reshape(-1)
+    target = scope.find_var(op.input("TargetBox")[0]).get_tensor().numpy()
+    score = scope.find_var(op.input("BoxScore")[0]).get_tensor().numpy()
+    clip = float(op.attrs.get("box_clip", _BBOX_CLIP))
+    n, c = score.shape
+    target = target.reshape(n, c, 4)
+
+    pw = prior[:, 2] - prior[:, 0] + 1
+    ph = prior[:, 3] - prior[:, 1] + 1
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    dw = np.minimum(var[2] * target[:, :, 2], clip)
+    dh = np.minimum(var[3] * target[:, :, 3], clip)
+    cx = var[0] * target[:, :, 0] * pw[:, None] + pcx[:, None]
+    cy = var[1] * target[:, :, 1] * ph[:, None] + pcy[:, None]
+    w = np.exp(dw) * pw[:, None]
+    h = np.exp(dh) * ph[:, None]
+    decoded = np.stack([cx - w / 2, cy - h / 2,
+                        cx + w / 2 - 1, cy + h / 2 - 1], axis=2)  # [n,c,4]
+
+    if c > 1:
+        fg_scores = score[:, 1:]
+        best = fg_scores.argmax(axis=1) + 1
+        assign = decoded[np.arange(n), best]
+        # reference keeps the prior box when every fg score <= -1 (its
+        # max_score init value)
+        none = fg_scores.max(axis=1) <= -1
+        assign[none] = prior[none]
+    else:
+        # background-only scores: reference max_j stays -1 -> prior box
+        assign = prior.copy()
+    executor._write_var(scope, op.output("DecodeBox")[0],
+                        decoded.reshape(n, c * 4).astype("float32"))
+    executor._write_var(scope, op.output("OutputAssignBox")[0],
+                        assign.astype("float32"))
+
+
+@register_host_op(
+    "distribute_fpn_proposals",
+    inputs=[In("FpnRois", no_grad=True)],
+    outputs=[Out("MultiFpnRois", duplicable=True), Out("RestoreIndex")],
+    attrs={"min_level": 2, "max_level": 5, "refer_level": 4,
+           "refer_scale": 224},
+)
+def _distribute_fpn_proposals(executor, op, scope):
+    """distribute_fpn_proposals_op.h: route each RoI to the FPN level
+    floor(refer_level + log2(sqrt(area)/refer_scale))."""
+    rois_t = scope.find_var(op.input("FpnRois")[0]).get_tensor()
+    rois = rois_t.numpy().reshape(-1, 4)
+    lod0 = rois_t.lod()[0] if rois_t.lod() else [0, rois.shape[0]]
+    min_l = int(op.attrs["min_level"])
+    max_l = int(op.attrs["max_level"])
+    refer_l = int(op.attrs["refer_level"])
+    refer_s = int(op.attrs["refer_scale"])
+    num_level = max_l - min_l + 1
+
+    area = np.maximum(
+        (rois[:, 2] - rois[:, 0] + 1) * (rois[:, 3] - rois[:, 1] + 1), 0)
+    scale = np.sqrt(area)
+    lvl = np.floor(np.log2(scale / refer_s + 1e-6) + refer_l)
+    lvl = np.clip(lvl, min_l, max_l).astype(int)
+
+    out_names = op.output("MultiFpnRois")
+    order = []
+    for li, name in enumerate(out_names[:num_level]):
+        level = min_l + li
+        sel_rows, level_lod = [], [0]
+        for img in range(len(lod0) - 1):
+            img_rows = [r for r in range(lod0[img], lod0[img + 1])
+                        if lvl[r] == level]
+            sel_rows.extend(img_rows)
+            level_lod.append(len(sel_rows))
+        order.extend(sel_rows)
+        out = (rois[sel_rows] if sel_rows
+               else np.zeros((0, 4))).astype("float32")
+        executor._write_var(scope, name, out, lod=[level_lod])
+    restore = np.empty((rois.shape[0], 1), "int32")
+    for new_pos, orig in enumerate(order):
+        restore[orig, 0] = new_pos
+    executor._write_var(scope, op.output("RestoreIndex")[0], restore)
+
+
+@register_host_op(
+    "collect_fpn_proposals",
+    inputs=[In("MultiLevelRois", duplicable=True, no_grad=True),
+            In("MultiLevelScores", duplicable=True, no_grad=True)],
+    outputs=[Out("FpnRois")],
+    attrs={"post_nms_topN": -1},
+)
+def _collect_fpn_proposals(executor, op, scope):
+    """collect_fpn_proposals_op.h: concat all levels, keep global
+    post_nms_topN by score, then restore batch order."""
+    roi_names = op.input("MultiLevelRois")
+    score_names = op.input("MultiLevelScores")
+    all_rois, all_scores, all_batch = [], [], []
+    for rn, sn in zip(roi_names, score_names):
+        rt = scope.find_var(rn).get_tensor()
+        st = scope.find_var(sn).get_tensor()
+        r = rt.numpy().reshape(-1, 4)
+        s = st.numpy().reshape(-1)
+        lod0 = rt.lod()[0] if rt.lod() else [0, r.shape[0]]
+        batch = np.empty(r.shape[0], np.int64)
+        for img in range(len(lod0) - 1):
+            batch[lod0[img]:lod0[img + 1]] = img
+        all_rois.append(r)
+        all_scores.append(s)
+        all_batch.append(batch)
+    rois = np.concatenate(all_rois) if all_rois else np.zeros((0, 4))
+    scores = np.concatenate(all_scores) if all_scores else np.zeros((0,))
+    batch = np.concatenate(all_batch) if all_batch else np.zeros((0,),
+                                                                 np.int64)
+    topn = int(op.attrs.get("post_nms_topN", -1))
+    order = np.argsort(-scores, kind="stable")
+    if 0 < topn < order.size:
+        order = order[:topn]
+    # stable restore of batch order among the kept rois
+    order = order[np.argsort(batch[order], kind="stable")]
+    rois, batch = rois[order], batch[order]
+    n_img = int(batch.max()) + 1 if batch.size else 1
+    lod0 = [0] + list(np.searchsorted(batch, np.arange(1, n_img)))
+    lod0.append(rois.shape[0])
+    executor._write_var(scope, op.output("FpnRois")[0],
+                        rois.astype("float32"), lod=[lod0])
+
+
+@register_op("polygon_box_transform", inputs=[In("Input")],
+             outputs=[Out("Output")], grad=None)
+def _polygon_box_transform(ins, attrs):
+    """polygon_box_transform_op.cc: even (x) channels become
+    4*w_idx - in, odd (y) channels 4*h_idx - in (EAST quad geo)."""
+    import jax.numpy as jnp
+
+    x = ins["Input"]
+    n, c, h, w = x.shape
+    ww = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4
+    hh = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4
+    even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return {"Output": jnp.where(even, ww - x, hh - x)}

@@ -1,0 +1,218 @@
+"""RPN/FPN proposal op family vs hand-computed oracles.
+
+The fixtures follow the reference unit tests' shapes
+(test_generate_proposals_op.py, test_rpn_target_assign_op.py,
+test_distribute_fpn_proposals_op.py, test_collect_fpn_proposals_op.py)
+with deterministic settings (use_random=False).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+from paddle_tpu.ops.proposal_ops import (
+    _box_to_delta, _decode_boxes, _iou_matrix)
+
+
+def test_generate_proposals_end_to_end():
+    N, A, H, W = 1, 3, 4, 4
+    rng = np.random.RandomState(0)
+    scores = rng.rand(N, A, H, W).astype("float32")
+    deltas = (rng.randn(N, 4 * A, H, W) * 0.2).astype("float32")
+    im_info = np.array([[64.0, 64.0, 1.0]], "float32")
+    anchors = np.zeros((H, W, A, 4), "float32")
+    sizes = [8.0, 16.0, 24.0]
+    for h in range(H):
+        for w in range(W):
+            for a in range(A):
+                cx, cy, s = w * 16 + 8, h * 16 + 8, sizes[a]
+                anchors[h, w, a] = [cx - s, cy - s, cx + s, cy + s]
+    variances = np.full((H, W, A, 4), 1.0, "float32")
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        sc = fluid.data(name="sc", shape=[N, A, H, W], dtype="float32")
+        dl = fluid.data(name="dl", shape=[N, 4 * A, H, W], dtype="float32")
+        ii = fluid.data(name="ii", shape=[N, 3], dtype="float32")
+        an = fluid.data(name="an", shape=[H, W, A, 4], dtype="float32")
+        va = fluid.data(name="va", shape=[H, W, A, 4], dtype="float32")
+        rois, probs = fluid.layers.generate_proposals(
+            sc, dl, ii, an, va, pre_nms_top_n=20, post_nms_top_n=5,
+            nms_thresh=0.7, min_size=2.0)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, feed={"sc": scores, "dl": deltas, "ii": im_info,
+                            "an": anchors, "va": variances}, fetch_list=[])
+        rois_t = scope.find_var(rois.name).get_tensor()
+        probs_t = scope.find_var(probs.name).get_tensor()
+    r = rois_t.numpy()
+    p = probs_t.numpy()
+    assert r.shape[0] == p.shape[0] <= 5
+    assert rois_t.lod() == [[0, r.shape[0]]]
+    # every roi inside the image, min_size respected
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 63).all()
+    assert (r[:, 1] >= 0).all() and (r[:, 3] <= 63).all()
+    assert ((r[:, 2] - r[:, 0] + 1) >= 2).all()
+    # scores sorted descending (NMS emits in score order)
+    assert (np.diff(p.reshape(-1)) <= 1e-6).all()
+
+
+def test_generate_proposals_decode_matches_reference_formula():
+    anchors = np.array([[0.0, 0.0, 15.0, 15.0]], "float32")
+    deltas = np.array([[0.1, -0.2, 0.3, 0.4]], "float32")
+    var = np.array([[1.0, 1.0, 1.0, 1.0]], "float32")
+    got = _decode_boxes(anchors, deltas, var)
+    aw = ah = 16.0
+    # reference center = x0 + 0.5*w = 8 (not the midpoint 7.5)
+    cx, cy = 8.0 + 0.1 * aw, 8.0 - 0.2 * ah
+    w, h = np.exp(0.3) * aw, np.exp(0.4) * ah
+    ref = [cx - w / 2, cy - h / 2, cx + w / 2 - 1, cy + h / 2 - 1]
+    np.testing.assert_allclose(got[0], ref, rtol=1e-5)
+
+
+def test_rpn_target_assign_deterministic():
+    A = 6
+    anchors = np.array(
+        [[0, 0, 15, 15], [8, 8, 23, 23], [16, 16, 31, 31],
+         [24, 24, 39, 39], [0, 16, 15, 31], [16, 0, 31, 15]], "float32")
+    gts = np.array([[1, 1, 14, 14], [17, 17, 30, 30]], "float32")
+    crowd = np.zeros((2, 1), "int32")
+    im_info = np.array([[40.0, 40.0, 1.0]], "float32")
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        bp = fluid.data(name="bp", shape=[1, A, 4], dtype="float32")
+        cl = fluid.data(name="cl", shape=[1, A, 1], dtype="float32")
+        an = fluid.data(name="an", shape=[A, 4], dtype="float32")
+        av = fluid.data(name="av", shape=[A, 4], dtype="float32")
+        gt = fluid.data(name="gt", shape=[2, 4], dtype="float32")
+        ic = fluid.data(name="ic", shape=[2, 1], dtype="int32")
+        ii = fluid.data(name="ii", shape=[1, 3], dtype="float32")
+        outs = fluid.layers.rpn_target_assign(
+            bp, cl, an, av, gt, ic, ii, rpn_batch_size_per_im=256,
+            rpn_straddle_thresh=0.0, rpn_fg_fraction=0.5,
+            rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+            use_random=False)
+        score_pred, loc_pred, tgt_lbl, tgt_bbox, in_w = outs
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    feed = {"bp": rng.randn(1, A, 4).astype("float32"),
+            "cl": rng.randn(1, A, 1).astype("float32"),
+            "an": anchors, "av": np.ones((A, 4), "float32"),
+            "gt": gts, "ic": crowd, "ii": im_info}
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        (lbl, tb, iw) = exe.run(prog, feed=feed,
+                                fetch_list=[tgt_lbl, tgt_bbox, in_w])
+    lbl = np.asarray(lbl).reshape(-1)
+    tb = np.asarray(tb)
+    iw = np.asarray(iw)
+    # anchors 0 and 2 have max IoU with the two gts -> fg
+    iou = _iou_matrix(anchors, gts)
+    expect_fg = set(np.where(
+        (np.abs(iou - iou.max(0)[None]) < 1e-5).any(1)
+        | (iou.max(1) >= 0.7))[0])
+    n_fg = int(lbl.sum())
+    assert n_fg == len(expect_fg)
+    # regression targets match BoxToDelta for the fg anchors
+    fg_anchor_idx = sorted(expect_fg)
+    gt_idx = iou[fg_anchor_idx].argmax(1)
+    ref_tb = _box_to_delta(anchors[fg_anchor_idx], gts[gt_idx])
+    np.testing.assert_allclose(tb, ref_tb, rtol=1e-4, atol=1e-5)
+    assert iw.shape == tb.shape and (iw == 1.0).all()
+
+
+def test_distribute_and_collect_fpn():
+    # rois sized to land on distinct levels
+    rois = np.array([
+        [0, 0, 15, 15],      # small -> min level
+        [0, 0, 111, 111],    # sqrt(area)=112 -> level 3 (refer 224@4)
+        [0, 0, 223, 223],    # -> level 4
+        [0, 0, 447, 447],    # -> level 5
+    ], "float32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        fr = fluid.data(name="fr", shape=[4, 4], dtype="float32")
+        multi, restore = fluid.layers.distribute_fpn_proposals(
+            fr, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, feed={"fr": rois}, fetch_list=[])
+        outs = [scope.find_var(v.name).get_tensor().numpy() for v in multi]
+        rest = scope.find_var(restore.name).get_tensor().numpy()
+    assert [o.shape[0] for o in outs] == [1, 1, 1, 1]
+    np.testing.assert_allclose(outs[0][0], rois[0])
+    np.testing.assert_allclose(outs[3][0], rois[3])
+    assert sorted(rest.reshape(-1).tolist()) == [0, 1, 2, 3]
+
+    # collect: take top-3 by score across two levels, restore batch order
+    prog2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog2, startup2):
+        r1 = fluid.data(name="r1", shape=[2, 4], dtype="float32")
+        r2 = fluid.data(name="r2", shape=[2, 4], dtype="float32")
+        s1 = fluid.data(name="s1", shape=[2, 1], dtype="float32")
+        s2 = fluid.data(name="s2", shape=[2, 1], dtype="float32")
+        out = fluid.layers.collect_fpn_proposals(
+            [r1, r2], [s1, s2], 2, 3, post_nms_top_n=3)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog2, feed={
+            "r1": np.array([[0, 0, 1, 1], [2, 2, 3, 3]], "float32"),
+            "r2": np.array([[4, 4, 5, 5], [6, 6, 7, 7]], "float32"),
+            "s1": np.array([[0.9], [0.1]], "float32"),
+            "s2": np.array([[0.8], [0.7]], "float32")}, fetch_list=[])
+        got = scope2.find_var(out.name).get_tensor().numpy()
+    # top3 scores: 0.9, 0.8, 0.7 -> rois [0,0,1,1], [4,4,5,5], [6,6,7,7]
+    np.testing.assert_allclose(
+        got, [[0, 0, 1, 1], [4, 4, 5, 5], [6, 6, 7, 7]])
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 15, 15]], "float32")
+    var = np.array([0.1, 0.1, 0.2, 0.2], "float32")
+    target = np.array([[0, 0, 0, 0, 0.1, -0.1, 0.2, 0.3]], "float32")
+    score = np.array([[0.2, 0.8]], "float32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        pb = fluid.data(name="pb", shape=[1, 4], dtype="float32")
+        pv = fluid.data(name="pv", shape=[4], dtype="float32")
+        tb = fluid.data(name="tb", shape=[1, 8], dtype="float32")
+        bs = fluid.data(name="bs", shape=[1, 2], dtype="float32")
+        dec, asg = fluid.layers.box_decoder_and_assign(pb, pv, tb, bs, 4.135)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        (d, a) = exe.run(prog, feed={"pb": prior, "pv": var, "tb": target,
+                                     "bs": score}, fetch_list=[dec, asg])
+    d, a = np.asarray(d), np.asarray(a)
+    pw = ph = 16.0
+    # reference center = x0 + w/2 = 8
+    cx = 0.1 * 0.1 * pw + 8.0
+    cy = 0.1 * -0.1 * ph + 8.0
+    w = np.exp(0.2 * 0.2) * pw
+    h = np.exp(0.2 * 0.3) * ph
+    ref1 = [cx - w / 2, cy - h / 2, cx + w / 2 - 1, cy + h / 2 - 1]
+    np.testing.assert_allclose(d[0, 4:], ref1, rtol=1e-4)
+    np.testing.assert_allclose(a[0], ref1, rtol=1e-4)  # class 1 is best
+
+
+def test_polygon_box_transform():
+    x = np.random.RandomState(0).randn(1, 8, 2, 3).astype("float32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.data(name="x", shape=[1, 8, 2, 3], dtype="float32")
+        out = fluid.layers.polygon_box_transform(xv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        (o,) = exe.run(prog, feed={"x": x}, fetch_list=[out])
+    o = np.asarray(o)
+    ref = np.empty_like(x)
+    for c in range(8):
+        for hh in range(2):
+            for ww in range(3):
+                base = ww * 4 if c % 2 == 0 else hh * 4
+                ref[0, c, hh, ww] = base - x[0, c, hh, ww]
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
