@@ -12,6 +12,8 @@ Both import styles work:
     import paddle_tpu.fluid as fluid  (alias package)
 """
 from . import framework
+from . import ir  # noqa: F401
+from .ir import IrGraph  # noqa: F401
 from .framework import (  # noqa: F401
     Program,
     Variable,

@@ -1,0 +1,5 @@
+from .quantization_pass import (  # noqa: F401
+    ConvertToInt8Pass, QuantizationFreezePass, QuantizationTransformPass,
+    TransformForMobilePass, apply_startup_inits)
+from .post_training_quantization import (  # noqa: F401
+    PostTrainingQuantization)

@@ -1,0 +1,312 @@
+"""Quantization-aware-training graph passes.
+
+Parity: /root/reference/python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py (QuantizationTransformPass :110, the freeze /
+int8-convert / mobile passes below it). Rewrites operate on the native
+``paddle_tpu.ir.IrGraph``; the inserted fake-quant ops
+(ops/quant_ops.py) carry straight-through-estimator gradients, so a
+transformed program trains end-to-end inside one compiled XLA step.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .... import framework
+from ....ir import IrGraph
+
+_QUANTIZABLE = ["conv2d", "depthwise_conv2d", "mul"]
+
+# which input slots get quantized, and which one is the weight whose
+# scale folds into the output dequant (reference rewrite targets only
+# the designated activation/weight slots — never Bias/ResidualData)
+_QUANT_SLOTS = {
+    "conv2d": ("Input", "Filter"),
+    "depthwise_conv2d": ("Input", "Filter"),
+    "mul": ("X", "Y"),
+}
+_WEIGHT_SLOT = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                "mul": "Y"}
+
+
+def _quantized_var_name(name):
+    return "%s.quantized" % name
+
+
+def _dequantized_var_name(name):
+    return "%s.dequantized" % name
+
+
+def _scale_var_name(name):
+    return "%s.scale" % name
+
+
+class QuantizationTransformPass:
+    """Insert per-input fake quant + dequant around quantizable ops
+    (reference quantization_pass.py:110). Weight inputs always use
+    abs_max (or channel_wise_abs_max); activations use
+    ``activation_quantize_type``."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9, quantizable_op_type=None,
+                 skip_pattern="skip_quant"):
+        if activation_quantize_type not in (
+                "abs_max", "range_abs_max", "moving_average_abs_max"):
+            raise ValueError("unknown activation_quantize_type %r"
+                             % activation_quantize_type)
+        if weight_quantize_type not in ("abs_max",
+                                        "channel_wise_abs_max"):
+            raise ValueError("unknown weight_quantize_type %r"
+                             % weight_quantize_type)
+        self._scope = scope
+        self._place = place
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._act_type = activation_quantize_type
+        self._weight_type = weight_quantize_type
+        self._window_size = window_size
+        self._moving_rate = moving_rate
+        self._ops = list(quantizable_op_type or _QUANTIZABLE)
+        self._skip_pattern = skip_pattern
+
+    def apply(self, graph: IrGraph) -> IrGraph:
+        dequantized: Dict[str, str] = {}
+        for op in list(graph.all_op_nodes()):
+            if op.op_type() not in self._ops:
+                continue
+            scope_tag = op.attr("op_namescope") or ""
+            if self._skip_pattern and self._skip_pattern in str(scope_tag):
+                continue
+            quant_slots = _QUANT_SLOTS.get(
+                op.op_type(), tuple(op.input_slots()))
+            for slot, names in op.input_slots().items():
+                if slot not in quant_slots:
+                    continue
+                for name in names:
+                    if name in dequantized:
+                        op.rename_input(name, dequantized[name])
+                        continue
+                    var = (graph.var_node(name)
+                           if graph.has_var_node(name) else None)
+                    is_weight = bool(var is not None and var.persistable)
+                    deq = self._insert_quant_dequant(
+                        graph, name, var, is_weight, op)
+                    dequantized[name] = deq
+                    op.rename_input(name, deq)
+        return graph
+
+    # -- helpers -----------------------------------------------------------
+    def _insert_quant_dequant(self, graph, name, var, is_weight, before):
+        bits = self._weight_bits if is_weight else self._activation_bits
+        qtype = (self._weight_type if is_weight else self._act_type)
+        qname = _quantized_var_name(name)
+        sname = _scale_var_name(name)
+        shape = var.shape if var is not None else None
+        dtype = var.dtype if var is not None else "float32"
+        qvar = graph.create_var_node(qname, shape=shape, var_dtype=dtype)
+        svar = graph.create_persistable_node(sname, shape=[1],
+                                             var_dtype="float32")
+
+        if qtype in ("abs_max", "channel_wise_abs_max"):
+            op_type = ("fake_channel_wise_quantize_abs_max"
+                       if qtype == "channel_wise_abs_max"
+                       else "fake_quantize_abs_max")
+            graph.create_op_node(
+                op_type, {"bit_length": bits},
+                {"X": [name]}, {"Out": [qname], "OutScale": [sname]},
+                before=before)
+        elif qtype == "range_abs_max":
+            graph.set_initializer(sname, np.array([1e-3], "float32"))
+            graph.create_op_node(
+                "fake_quantize_range_abs_max",
+                {"bit_length": bits, "window_size": self._window_size,
+                 "is_test": graph._for_test},
+                {"X": [name], "InScale": [sname]},
+                {"Out": [qname], "OutScale": [sname]},
+                before=before)
+        else:  # moving_average_abs_max
+            aname, stname = name + ".quant_accum", name + ".quant_state"
+            graph.create_persistable_node(aname, shape=[1],
+                                          var_dtype="float32")
+            graph.create_persistable_node(stname, shape=[1],
+                                          var_dtype="float32")
+            graph.set_initializer(sname, np.array([1e-3], "float32"))
+            graph.set_initializer(aname, np.array([1e-3], "float32"))
+            graph.set_initializer(stname, np.array([1.0], "float32"))
+            graph.create_op_node(
+                "fake_quantize_moving_average_abs_max",
+                {"bit_length": bits, "moving_rate": self._moving_rate,
+                 "is_test": graph._for_test},
+                {"X": [name], "InScale": [sname], "InAccum": [aname],
+                 "InState": [stname]},
+                {"Out": [qname], "OutScale": [sname],
+                 "OutAccum": [aname], "OutState": [stname]},
+                before=before)
+
+        dname = _dequantized_var_name(name)
+        graph.create_var_node(dname, shape=shape, var_dtype=dtype)
+        graph.create_op_node(
+            "fake_dequantize_max_abs",
+            {"max_range": float((1 << (bits - 1)) - 1)},
+            {"X": [qname], "Scale": [sname]}, {"Out": [dname]},
+            before=before)
+        return dname
+
+
+class QuantizationFreezePass:
+    """Fold trained quantization into an inference graph (reference
+    QuantizationFreezePass): weights become stored integer levels, the
+    per-input fake ops disappear, and one channel-combining dequantize
+    lands after each quantized op's output."""
+
+    def __init__(self, scope, place, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max",
+                 quantizable_op_type=None):
+        self._scope = scope
+        self._place = place
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._weight_type = weight_quantize_type
+        self._ops = list(quantizable_op_type or _QUANTIZABLE)
+
+    def apply(self, graph: IrGraph) -> IrGraph:
+        act_scales: Dict[str, str] = {}
+        weight_scales: Dict[str, str] = {}
+        remove = []
+        # 1) strip fake quant ops; record scale vars; requantize weights
+        for op in list(graph.all_op_nodes()):
+            t = op.op_type()
+            if t.startswith("fake_quantize") or \
+                    t == "fake_channel_wise_quantize_abs_max":
+                src = op.input("X")[0]
+                qout = op.output("Out")[0]
+                sname = op.output("OutScale")[0]
+                var = (graph.var_node(src)
+                       if graph.has_var_node(src) else None)
+                if var is not None and var.persistable:
+                    weight_scales[qout] = (src, sname)
+                    self._quantize_weight_in_scope(src, sname)
+                else:
+                    act_scales[qout] = (src, sname)
+                remove.append(op)
+            elif t == "fake_dequantize_max_abs":
+                remove.append(op)
+
+        # 2) rewire consumers of dequantized names back to sources
+        for op in graph.all_op_nodes():
+            if op in remove:
+                continue
+            for name in list(op.input_arg_names()):
+                if name.endswith(".dequantized"):
+                    base = name[:-len(".dequantized")]
+                    op.rename_input(name, base)
+
+        # 3) after each quantizable op, dequantize its output with the
+        # combined (weight_scale, act-implied) range
+        bnt_w = float((1 << (self._weight_bits - 1)) - 1)
+        for op in list(graph.all_op_nodes()):
+            if op.op_type() not in self._ops or op in remove:
+                continue
+            w_scale = None
+            wslot = _WEIGHT_SLOT.get(op.op_type())
+            w_names = (op.input(wslot) if wslot
+                       else op.input_arg_names())
+            for name in w_names:
+                if graph.has_var_node(name) and \
+                        graph.var_node(name).persistable and \
+                        graph.has_var_node(_scale_var_name(name)):
+                    w_scale = _scale_var_name(name)
+            if w_scale is None:
+                continue
+            out = op.output_arg_names()[0]
+            deq_out = out + ".dequantized"
+            graph.create_var_node(deq_out)
+            # rename consumers BEFORE inserting the dequant op so its
+            # default placement (before the earliest consumer of its
+            # output) sees them — otherwise it lands at the end, after
+            # its own readers
+            for consumer in graph.all_op_nodes():
+                if consumer is op or consumer in remove:
+                    continue
+                if out in consumer.input_arg_names():
+                    consumer.rename_input(out, deq_out)
+            graph.create_op_node(
+                "fake_dequantize_max_abs", {"max_range": bnt_w},
+                {"X": [out], "Scale": [w_scale]}, {"Out": [deq_out]})
+        graph.safe_remove_nodes(remove)
+        return graph
+
+    def _quantize_weight_in_scope(self, wname, sname):
+        if self._scope is None:
+            return
+        var = self._scope.find_var(wname)
+        if var is None or not var.is_initialized():
+            return
+        import jax.numpy as jnp
+
+        w = np.asarray(var.get_tensor().numpy())
+        bnt = float((1 << (self._weight_bits - 1)) - 1)
+        if self._weight_type == "channel_wise_abs_max":
+            scale = np.abs(w.reshape(w.shape[0], -1)).max(axis=1)
+            shaped = scale.reshape((-1,) + (1,) * (w.ndim - 1))
+        else:
+            scale = np.array([np.abs(w).max()], "float32")
+            shaped = scale.reshape(())
+        q = np.round(w / np.maximum(shaped, 1e-12) * bnt)
+        var.get_tensor().set(jnp.asarray(q.astype("float32")))
+        svar = self._scope.var(sname)
+        svar.get_tensor().set(jnp.asarray(scale.astype("float32")))
+
+
+class ConvertToInt8Pass:
+    """Store frozen weights as int8 (reference ConvertToInt8Pass).
+    Scope-side conversion; the graph keeps the same var names."""
+
+    def __init__(self, scope, place, quantizable_op_type=None):
+        self._scope = scope
+        self._ops = list(quantizable_op_type or _QUANTIZABLE)
+
+    def apply(self, graph: IrGraph) -> IrGraph:
+        import jax.numpy as jnp
+
+        for op in graph.all_op_nodes():
+            if op.op_type() not in self._ops:
+                continue
+            for name in op.input_arg_names():
+                if not graph.has_var_node(name):
+                    continue
+                if not graph.var_node(name).persistable:
+                    continue
+                var = self._scope.find_var(name) if self._scope else None
+                if var is None or not var.is_initialized():
+                    continue
+                w = np.asarray(var.get_tensor().numpy())
+                if np.abs(w - np.round(w)).max() < 1e-6 and \
+                        np.abs(w).max() <= 127:
+                    var.get_tensor().set(jnp.asarray(w.astype("int8")))
+                    graph.var_node(name).dtype = "int8"
+        return graph
+
+
+class TransformForMobilePass:
+    """Rename fake ops to the mobile runtime's quantize/dequantize
+    (reference TransformForMobilePass)."""
+
+    def apply(self, graph: IrGraph) -> IrGraph:
+        for op in graph.all_op_nodes():
+            if op.op_type().startswith("fake_quantize"):
+                op._type = "quantize"
+            elif op.op_type().startswith("fake_dequantize"):
+                op._type = "dequantize"
+        return graph
+
+
+def apply_startup_inits(graph: IrGraph, scope):
+    """Materialize the scale/accum/state vars a transform pass created."""
+    import jax.numpy as jnp
+
+    for name, value in graph.startup_inits:
+        scope.var(name).get_tensor().set(jnp.asarray(value))

@@ -1,0 +1,405 @@
+"""Program-level IR graph + pass infrastructure.
+
+Parity: /root/reference/paddle/fluid/framework/ir/ (Graph graph.h, Pass
+pass.h, pass registry) and the Python ``IrGraph`` wrapper
+(python/paddle/fluid/framework.py:3212).
+
+TPU-native stance: the reference's 60+ C++ fusion passes exist because
+its executor runs ops 1:1 — fusion must happen in the graph. Here XLA
+fuses the compiled program, so this module is NOT a performance layer;
+it is the *rewriting* substrate that program-transformation features
+need (quantization-aware training, inference graph surgery, transpiler
+tooling) with the same mutate-then-``to_program`` contract as the
+reference. Nodes wrap the native Python IR directly — there is no
+separate proto graph to round-trip through.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from . import framework
+
+
+class IrVarNode:
+    """Variable node (reference IrVarNode framework.py:2966)."""
+
+    def __init__(self, graph, name: str, shape=None, dtype="float32",
+                 persistable: bool = False, is_parameter: bool = False,
+                 trainable: bool = True, stop_gradient: bool = False):
+        self._graph = graph
+        self._name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.persistable = persistable
+        self.is_parameter = is_parameter
+        self.trainable = trainable
+        self.stop_gradient = stop_gradient
+
+    def name(self) -> str:
+        return self._name
+
+    def is_var(self) -> bool:
+        return True
+
+    def is_op(self) -> bool:
+        return False
+
+    @property
+    def inputs(self) -> List["IrOpNode"]:
+        """Ops that write this var."""
+        return [op for op in self._graph.all_op_nodes()
+                if self._name in op.output_arg_names()]
+
+    @property
+    def outputs(self) -> List["IrOpNode"]:
+        """Ops that read this var."""
+        return [op for op in self._graph.all_op_nodes()
+                if self._name in op.input_arg_names()]
+
+    def __repr__(self):
+        return "IrVarNode(%s)" % self._name
+
+
+class IrOpNode:
+    """Operator node (reference IrOpNode framework.py:3059)."""
+
+    def __init__(self, graph, op_type: str, inputs: Dict, outputs: Dict,
+                 attrs: Optional[Dict] = None):
+        self._graph = graph
+        self._type = op_type
+        self._inputs = {k: list(v) for k, v in inputs.items()}
+        self._outputs = {k: list(v) for k, v in outputs.items()}
+        self._attrs = dict(attrs or {})
+
+    def name(self) -> str:
+        return self._type
+
+    def op_type(self) -> str:
+        return self._type
+
+    def is_var(self) -> bool:
+        return False
+
+    def is_op(self) -> bool:
+        return True
+
+    def input(self, slot: str) -> List[str]:
+        return list(self._inputs.get(slot, []))
+
+    def output(self, slot: str) -> List[str]:
+        return list(self._outputs.get(slot, []))
+
+    def input_slots(self):
+        return dict(self._inputs)
+
+    def output_slots(self):
+        return dict(self._outputs)
+
+    def input_arg_names(self) -> List[str]:
+        return [n for v in self._inputs.values() for n in v]
+
+    def output_arg_names(self) -> List[str]:
+        return [n for v in self._outputs.values() for n in v]
+
+    def attr(self, name: str):
+        return self._attrs.get(name)
+
+    def set_attr(self, name: str, value):
+        self._attrs[name] = value
+
+    def rename_input(self, old: str, new: str):
+        for slot, names in self._inputs.items():
+            self._inputs[slot] = [new if n == old else n for n in names]
+
+    def rename_output(self, old: str, new: str):
+        for slot, names in self._outputs.items():
+            self._outputs[slot] = [new if n == old else n for n in names]
+
+    @property
+    def inputs(self) -> List[IrVarNode]:
+        return [self._graph.var_node(n) for n in self.input_arg_names()
+                if self._graph.has_var_node(n)]
+
+    @property
+    def outputs(self) -> List[IrVarNode]:
+        return [self._graph.var_node(n) for n in self.output_arg_names()
+                if self._graph.has_var_node(n)]
+
+    def __repr__(self):
+        return "IrOpNode(%s)" % self._type
+
+
+class IrGraph:
+    """Mutable graph view over a Program (reference framework.py:3212).
+
+    Build with ``IrGraph(program)`` (or ``IrGraph.from_program``); mutate
+    with create_*/safe_remove_nodes/rename; materialize back with
+    ``to_program()`` — op order is the preserved program order with
+    created ops appended before their first consumer.
+    """
+
+    def __init__(self, program=None, for_test: bool = False):
+        self._for_test = for_test
+        self._ops: List[IrOpNode] = []
+        self._vars: Dict[str, IrVarNode] = {}
+        self._startup_inits: List = []
+        if program is not None:
+            self._load(program)
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_program(cls, program, for_test: bool = False) -> "IrGraph":
+        return cls(program, for_test=for_test)
+
+    def _load(self, program):
+        block = program.global_block()
+        for name, var in block.vars.items():
+            self._vars[name] = IrVarNode(
+                self, name, getattr(var, "shape", None),
+                getattr(var, "dtype", "float32"),
+                bool(getattr(var, "persistable", False)),
+                is_parameter=isinstance(var, framework.Parameter),
+                trainable=bool(getattr(var, "trainable", True)),
+                stop_gradient=bool(getattr(var, "stop_gradient", False)))
+        for op in block.ops:
+            self._ops.append(IrOpNode(self, op.type, dict(op.inputs),
+                                      dict(op.outputs), dict(op.attrs)))
+
+    # -- queries ----------------------------------------------------------
+    def all_op_nodes(self) -> List[IrOpNode]:
+        return list(self._ops)
+
+    def all_var_nodes(self) -> List[IrVarNode]:
+        return list(self._vars.values())
+
+    def all_persistable_nodes(self) -> List[IrVarNode]:
+        return [v for v in self._vars.values() if v.persistable]
+
+    def has_var_node(self, name: str) -> bool:
+        return name in self._vars
+
+    def var_node(self, name: str) -> IrVarNode:
+        if name not in self._vars:
+            raise ValueError("var node %r not in graph" % name)
+        return self._vars[name]
+
+    # -- mutation ---------------------------------------------------------
+    def create_var_node(self, name, var_type=None, shape=None,
+                        var_dtype="float32") -> IrVarNode:
+        node = IrVarNode(self, name, shape, var_dtype, persistable=False)
+        self._vars[name] = node
+        return node
+
+    def create_persistable_node(self, name, var_type=None, shape=None,
+                                var_dtype="float32") -> IrVarNode:
+        node = IrVarNode(self, name, shape, var_dtype, persistable=True)
+        self._vars[name] = node
+        return node
+
+    def create_op_node(self, op_type, attrs, inputs, outputs,
+                       before: Optional[IrOpNode] = None) -> IrOpNode:
+        """Insert an op node; by default right before the earliest
+        consumer of any of its outputs (keeps def-before-use)."""
+        node = IrOpNode(self, op_type, inputs, outputs, attrs)
+        pos = len(self._ops)
+        if before is not None:
+            pos = self._ops.index(before)
+        else:
+            produced = set(node.output_arg_names())
+            for i, op in enumerate(self._ops):
+                if produced & set(op.input_arg_names()):
+                    pos = i
+                    break
+        self._ops.insert(pos, node)
+        return node
+
+    def safe_remove_nodes(self, remove_nodes: Sequence):
+        for n in remove_nodes:
+            if isinstance(n, IrOpNode):
+                if n in self._ops:
+                    self._ops.remove(n)
+            else:
+                self._vars.pop(n.name(), None)
+
+    def link_to(self, node_in, node_out):
+        """Edges derive from op input/output names here — kept as a
+        no-op for reference-API compatibility (passes call it after
+        create_op_node)."""
+
+    # -- init values for created persistables ------------------------------
+    def set_initializer(self, var_name: str, value):
+        """Record a host value for a created persistable; applied to the
+        scope by Pass users / to_program callers."""
+        self._startup_inits.append((var_name, value))
+
+    @property
+    def startup_inits(self):
+        return list(self._startup_inits)
+
+    # -- materialize -------------------------------------------------------
+    def to_program(self):
+        prog = framework.Program()
+        block = prog.global_block()
+        for name, v in self._vars.items():
+            if v.is_parameter:
+                var = block.create_parameter(
+                    name=name, shape=v.shape, dtype=v.dtype,
+                    trainable=v.trainable)
+            else:
+                var = block.create_var(name=name, dtype=v.dtype,
+                                       persistable=v.persistable,
+                                       stop_gradient=v.stop_gradient)
+            if v.shape is not None:
+                var.shape = tuple(v.shape)
+        for op in self._ops:
+            block.append_op(op.op_type(), op.input_slots(),
+                            op.output_slots(), dict(op._attrs),
+                            infer_shape=False)
+        return prog
+
+    def draw(self, save_path, name, marked_nodes=None,
+             remove_ctr_var=True):
+        """Graphviz dot export (reference uses the graph_viz_pass +
+        dot binary; here we always write the .dot text)."""
+        lines = ["digraph %s {" % name]
+        for i, op in enumerate(self._ops):
+            lines.append('  op%d [label="%s" shape=box];' % (i,
+                                                             op.op_type()))
+            for n in op.input_arg_names():
+                lines.append('  "%s" -> op%d;' % (n, i))
+            for n in op.output_arg_names():
+                lines.append('  op%d -> "%s";' % (i, n))
+        lines.append("}")
+        import os
+
+        path = os.path.join(save_path, "%s.dot" % name)
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        return path
+
+
+class Pass:
+    """Graph-rewriting pass base (reference ir/pass.h)."""
+
+    name = "pass"
+
+    def apply(self, graph: IrGraph) -> IrGraph:
+        raise NotImplementedError
+
+    def __call__(self, graph: IrGraph) -> IrGraph:
+        return self.apply(graph)
+
+
+class PassRegistry:
+    _passes: Dict[str, type] = {}
+
+    @classmethod
+    def register(cls, pass_cls):
+        cls._passes[pass_cls.name] = pass_cls
+        return pass_cls
+
+    @classmethod
+    def get(cls, name: str) -> Pass:
+        if name not in cls._passes:
+            raise KeyError("pass %r not registered (have: %s)"
+                           % (name, sorted(cls._passes)))
+        return cls._passes[name]()
+
+    @classmethod
+    def has(cls, name: str) -> bool:
+        return name in cls._passes
+
+
+@PassRegistry.register
+class GraphVizPass(Pass):
+    """reference ir/graph_viz_pass.cc"""
+
+    name = "graph_viz_pass"
+
+    def __init__(self, save_path=".", graph_name="graph"):
+        self.save_path = save_path
+        self.graph_name = graph_name
+
+    def apply(self, graph: IrGraph) -> IrGraph:
+        graph.draw(self.save_path, self.graph_name)
+        return graph
+
+
+@PassRegistry.register
+class FcFusePass(Pass):
+    """mul + elementwise_add (+ activation) -> fc
+    (reference ir/fc_fuse_pass.cc). Under XLA this is cosmetic — the
+    compiler fuses the dot+add anyway — but inference-graph surgery and
+    tests exercise the same rewrite contract as the reference."""
+
+    name = "fc_fuse_pass"
+
+    _ACTS = ("relu",)
+
+    @staticmethod
+    def _consumer_index(graph):
+        idx: Dict[str, List[IrOpNode]] = {}
+        for o in graph._ops:
+            for n in o.input_arg_names():
+                idx.setdefault(n, []).append(o)
+        return idx
+
+    def _is_fc_bias(self, graph, name) -> bool:
+        """Only a persistable rank-1-ish bias qualifies (reference
+        fc_fuse_pass matches a persistable [N] / [1, N] addend) —
+        residual adds of activation tensors must NOT fuse."""
+        if not graph.has_var_node(name):
+            return False
+        v = graph.var_node(name)
+        if not v.persistable or v.shape is None:
+            return False
+        non_unit = [s for s in v.shape if s != 1]
+        return len(non_unit) <= 1
+
+    def apply(self, graph: IrGraph) -> IrGraph:
+        consumers_of = self._consumer_index(graph)
+        i = 0
+        while i < len(graph._ops):
+            op = graph._ops[i]
+            if op.op_type() != "mul":
+                i += 1
+                continue
+            out = op.output("Out")[0]
+            consumers = consumers_of.get(out, [])
+            if len(consumers) != 1 or \
+                    consumers[0].op_type() != "elementwise_add":
+                i += 1
+                continue
+            add = consumers[0]
+            bias = (add.input("Y") if add.input("X") == [out]
+                    else add.input("X"))[0]
+            if not self._is_fc_bias(graph, bias):
+                i += 1
+                continue
+            add_out = add.output("Out")[0]
+            act = None
+            act_consumers = consumers_of.get(add_out, [])
+            if len(act_consumers) == 1 and \
+                    act_consumers[0].op_type() in self._ACTS:
+                act = act_consumers[0]
+            final_out = act.output("Out")[0] if act else add_out
+            fc = IrOpNode(graph, "fc",
+                          {"Input": op.input("X"), "W": op.input("Y"),
+                           "Bias": [bias]},
+                          {"Out": [final_out]},
+                          {"in_num_col_dims": op.attr("x_num_col_dims")
+                           or 1,
+                           "activation_type": act.op_type() if act
+                           else ""})
+            graph._ops[i] = fc
+            graph.safe_remove_nodes([add] + ([act] if act else []))
+            consumers_of = self._consumer_index(graph)
+            i += 1
+        return graph
+
+
+def apply_pass(program, pass_name: str, **kwargs):
+    """Convenience: program -> pass -> program."""
+    cls = PassRegistry._passes[pass_name]
+    p = cls(**kwargs) if kwargs else cls()
+    return p.apply(IrGraph(program)).to_program()

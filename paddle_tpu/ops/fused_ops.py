@@ -79,3 +79,30 @@ def _multihead_matmul(ins, attrs):
     probs = jax.nn.softmax(scores, axis=-1)
     ctx = jnp.matmul(probs, v)
     return {"Out": ctx.transpose(0, 2, 1, 3).reshape(B, S, -1)}
+
+
+@register_op(
+    "fc",
+    inputs=[In("Input"), In("W"), In("Bias", dispensable=True)],
+    outputs=[Out("Out")],
+    attrs={"in_num_col_dims": 1, "activation_type": ""},
+)
+def _fc(ins, attrs):
+    """Fused fully-connected (reference operators/fc_op.cc — the target
+    of ir/fc_fuse_pass.cc). XLA fuses dot+add+act on its own; this op
+    exists so fused inference graphs execute 1:1."""
+    x = ins["Input"]
+    w = ins["W"]
+    k = int(attrs.get("in_num_col_dims", 1))
+    lead = 1
+    for s in x.shape[:k]:
+        lead *= s
+    out = x.reshape(lead, -1) @ w
+    if ins.get("Bias") is not None:
+        out = out + ins["Bias"].reshape(1, -1)
+    act = attrs.get("activation_type", "")
+    if act == "relu":
+        out = jnp.maximum(out, 0)
+    elif act:
+        raise NotImplementedError("fc activation %r" % act)
+    return {"Out": out.reshape(tuple(x.shape[:k]) + (w.shape[1],))}

@@ -232,6 +232,11 @@ def _rpn_target_assign(executor, op, scope):
     gt_all = gt_t.numpy().reshape(-1, 4)
     crowd_all = crowd_t.numpy().reshape(-1)
     gt_lod = gt_t.lod()[0] if gt_t.lod() else [0, gt_all.shape[0]]
+    if len(gt_lod) - 1 != im_info.shape[0]:
+        raise ValueError(
+            "rpn_target_assign: GtBoxes has %d LoD segments but ImInfo "
+            "has %d images — feed GtBoxes as a LoDTensor with one "
+            "segment per image" % (len(gt_lod) - 1, im_info.shape[0]))
 
     batch_per_im = int(op.attrs.get("rpn_batch_size_per_im", 256))
     straddle = float(op.attrs.get("rpn_straddle_thresh", 0.0))
@@ -395,12 +400,14 @@ def _collect_fpn_proposals(executor, op, scope):
     roi_names = op.input("MultiLevelRois")
     score_names = op.input("MultiLevelScores")
     all_rois, all_scores, all_batch = [], [], []
+    n_img = 1
     for rn, sn in zip(roi_names, score_names):
         rt = scope.find_var(rn).get_tensor()
         st = scope.find_var(sn).get_tensor()
         r = rt.numpy().reshape(-1, 4)
         s = st.numpy().reshape(-1)
         lod0 = rt.lod()[0] if rt.lod() else [0, r.shape[0]]
+        n_img = max(n_img, len(lod0) - 1)
         batch = np.empty(r.shape[0], np.int64)
         for img in range(len(lod0) - 1):
             batch[lod0[img]:lod0[img + 1]] = img
@@ -418,7 +425,8 @@ def _collect_fpn_proposals(executor, op, scope):
     # stable restore of batch order among the kept rois
     order = order[np.argsort(batch[order], kind="stable")]
     rois, batch = rois[order], batch[order]
-    n_img = int(batch.max()) + 1 if batch.size else 1
+    # n_img comes from the INPUT LoD segment count — images whose rois
+    # were all cut by top-N still get (empty) output segments
     lod0 = [0] + list(np.searchsorted(batch, np.arange(1, n_img)))
     lod0.append(rois.shape[0])
     executor._write_var(scope, op.output("FpnRois")[0],

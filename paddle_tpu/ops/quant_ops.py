@@ -1,0 +1,206 @@
+"""Fake-quantization op family (QAT + PTQ support).
+
+Parity: /root/reference/paddle/fluid/operators/fake_quantize_op.cc
+(ClipAndFakeQuantFunctor, FindAbsMaxFunctor, FindRangeAbsMaxFunctor,
+FindMovingAverageAbsMaxFunctor) and fake_dequantize_op.cc; consumed by
+contrib/slim/quantization/quantization_pass.py.
+
+TPU-native gradient design: the reference registers identity grad
+kernels per fake-quant op (straight-through estimator). Here each
+forward is written as ``linear_part + stop_gradient(rounded -
+linear_part)`` so the auto-VJP yields exactly the reference's STE
+composite gradients — no custom grad kernels, and the whole QAT step
+still compiles to one XLA program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import In, Out, register_op
+
+
+def _bnt(bits) -> float:
+    return float((1 << (int(bits) - 1)) - 1)
+
+
+def _ste_round(x):
+    """round(x) with identity gradient."""
+    return x + jax.lax.stop_gradient(jnp.round(x) - x)
+
+
+def _quant_levels(x, scale, bits):
+    """clip(round(x/scale*bnt)) in [-bnt, bnt], STE grads."""
+    bnt = _bnt(bits)
+    inv = bnt / jnp.maximum(scale, 1e-12)
+    y = _ste_round(x * inv)
+    return jnp.clip(y, -bnt, bnt)
+
+
+@register_op("fake_quantize_abs_max",
+             inputs=[In("X")],
+             outputs=[Out("Out"), Out("OutScale", no_grad=True)],
+             attrs={"bit_length": 8})
+def _fake_quantize_abs_max(ins, attrs):
+    x = ins["X"]
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    return {"Out": _quant_levels(x, scale, attrs["bit_length"]),
+            "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_quantize_abs_max",
+             inputs=[In("X")],
+             outputs=[Out("Out"), Out("OutScale", no_grad=True)],
+             attrs={"bit_length": 8})
+def _fake_channel_wise_quantize_abs_max(ins, attrs):
+    """Per-output-channel (axis 0) scales — conv/mul weights."""
+    x = ins["X"]
+    flat = jnp.abs(x).reshape(x.shape[0], -1)
+    scale = jax.lax.stop_gradient(flat.max(axis=1))
+    shaped = scale.reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"Out": _quant_levels(x, shaped, attrs["bit_length"]),
+            "OutScale": scale}
+
+
+@register_op("fake_quantize_range_abs_max",
+             inputs=[In("X"), In("InScale", no_grad=True),
+                     In("Iter", dispensable=True, no_grad=True)],
+             outputs=[Out("Out"), Out("OutScale", no_grad=True),
+                      Out("OutScales", dispensable=True, no_grad=True)],
+             attrs={"bit_length": 8, "window_size": 10000,
+                    "is_test": False})
+def _fake_quantize_range_abs_max(ins, attrs):
+    """Training keeps a running max of batch scales (the reference's
+    window-reset bookkeeping collapses to a running max under a traced
+    step counter; deviation documented); test mode uses InScale."""
+    x = ins["X"]
+    in_scale = ins["InScale"].reshape(())
+    if attrs.get("is_test", False):
+        scale = in_scale
+    else:
+        cur = jnp.max(jnp.abs(x))
+        scale = jnp.maximum(in_scale, cur)
+    scale = jax.lax.stop_gradient(scale)
+    return {"Out": _quant_levels(x, scale, attrs["bit_length"]),
+            "OutScale": scale.reshape(1),
+            "OutScales": scale.reshape(1)}
+
+
+@register_op("fake_quantize_moving_average_abs_max",
+             inputs=[In("X"), In("InScale", no_grad=True),
+                     In("InAccum", dispensable=True, no_grad=True),
+                     In("InState", dispensable=True, no_grad=True)],
+             outputs=[Out("Out"), Out("OutScale", no_grad=True),
+                      Out("OutAccum", dispensable=True, no_grad=True),
+                      Out("OutState", dispensable=True, no_grad=True)],
+             attrs={"bit_length": 8, "moving_rate": 0.9, "is_test": False})
+def _fake_quantize_moving_average_abs_max(ins, attrs):
+    """state = state*rate + 1; accum = accum*rate + max|x|;
+    scale = accum/state (fake_quantize_op.cc
+    FindMovingAverageAbsMaxFunctor)."""
+    x = ins["X"]
+    in_scale = ins["InScale"].reshape(())
+    rate = attrs.get("moving_rate", 0.9)
+    if attrs.get("is_test", False):
+        scale = jax.lax.stop_gradient(in_scale)
+        accum = ins.get("InAccum")
+        state = ins.get("InState")
+        out = {"Out": _quant_levels(x, scale, attrs["bit_length"]),
+               "OutScale": scale.reshape(1)}
+        if accum is not None:
+            out["OutAccum"] = accum
+        if state is not None:
+            out["OutState"] = state
+        return out
+    accum = (ins.get("InAccum") if ins.get("InAccum") is not None
+             else in_scale.reshape(1))
+    state = (ins.get("InState") if ins.get("InState") is not None
+             else jnp.ones((1,), x.dtype))
+    cur = jnp.max(jnp.abs(x))
+    new_state = state * rate + 1.0
+    new_accum = accum * rate + cur
+    scale = jax.lax.stop_gradient((new_accum / new_state).reshape(()))
+    return {"Out": _quant_levels(x, scale, attrs["bit_length"]),
+            "OutScale": scale.reshape(1),
+            "OutAccum": jax.lax.stop_gradient(new_accum),
+            "OutState": jax.lax.stop_gradient(new_state)}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             inputs=[In("X"), In("InScale", no_grad=True),
+                     In("InAccum", dispensable=True, no_grad=True),
+                     In("InState", dispensable=True, no_grad=True)],
+             outputs=[Out("Out"), Out("OutScale", no_grad=True),
+                      Out("OutAccum", dispensable=True, no_grad=True),
+                      Out("OutState", dispensable=True, no_grad=True)],
+             attrs={"bit_length": 8, "moving_rate": 0.9, "is_test": False})
+def _fake_quantize_dequantize_moving_average_abs_max(ins, attrs):
+    """Quant-dequant in one op (used on activations whose consumers want
+    float): Out = round(x/s*bnt)*s/bnt with STE identity grads."""
+    res = _fake_quantize_moving_average_abs_max(ins, attrs)
+    bnt = _bnt(attrs["bit_length"])
+    scale = res["OutScale"].reshape(())
+    res["Out"] = res["Out"] * scale / bnt
+    return res
+
+
+@register_op("fake_dequantize_max_abs",
+             inputs=[In("X"), In("Scale", no_grad=True)],
+             outputs=[Out("Out")],
+             attrs={"max_range": 127.0})
+def _fake_dequantize_max_abs(ins, attrs):
+    """Out = X * scale / max_range (fake_dequantize_op.cc)."""
+    scale = ins["Scale"].reshape(())
+    return {"Out": ins["X"] * scale / attrs["max_range"]}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             inputs=[In("X"), In("Scales", duplicable=True, no_grad=True)],
+             outputs=[Out("Out")],
+             attrs={"quant_bits": [8, 8]})
+def _fake_channel_wise_dequantize_max_abs(ins, attrs):
+    """Out = X * prod(scales_i) / prod(bnt_i); first scale is
+    per-channel (axis 0 for conv weights / axis -1 after mul)."""
+    x = ins["X"]
+    scales = ins["Scales"]
+    bits = attrs.get("quant_bits", [8, 8])
+    ch = scales[0]
+    if ch.shape[0] == x.shape[0]:
+        shaped = ch.reshape((-1,) + (1,) * (x.ndim - 1))
+    else:
+        shaped = ch.reshape((1,) * (x.ndim - 1) + (-1,))
+    out = x * shaped / _bnt(bits[0])
+    for extra, b in zip(scales[1:], bits[1:]):
+        out = out * extra.reshape(()) / _bnt(b)
+    return {"Out": out}
+
+
+@register_op("moving_average_abs_max_scale",
+             inputs=[In("X"), In("InAccum", dispensable=True, no_grad=True),
+                     In("InState", dispensable=True, no_grad=True)],
+             outputs=[Out("Out", dispensable=True),
+                      Out("OutScale", no_grad=True),
+                      Out("OutAccum", dispensable=True, no_grad=True),
+                      Out("OutState", dispensable=True, no_grad=True)],
+             attrs={"moving_rate": 0.9, "is_test": False})
+def _moving_average_abs_max_scale(ins, attrs):
+    """Scale observer only — passes X through untouched."""
+    x = ins["X"]
+    rate = attrs.get("moving_rate", 0.9)
+    accum = (ins.get("InAccum") if ins.get("InAccum") is not None
+             else jnp.zeros((1,), x.dtype))
+    state = (ins.get("InState") if ins.get("InState") is not None
+             else jnp.zeros((1,), x.dtype))
+    if attrs.get("is_test", False):
+        scale = jnp.where(state.reshape(()) > 0,
+                          accum.reshape(()) / jnp.maximum(
+                              state.reshape(()), 1e-12),
+                          jnp.max(jnp.abs(x)))
+        return {"Out": x, "OutScale": scale.reshape(1),
+                "OutAccum": accum, "OutState": state}
+    cur = jnp.max(jnp.abs(x))
+    new_state = state * rate + 1.0
+    new_accum = accum * rate + cur
+    scale = new_accum / new_state
+    return {"Out": x, "OutScale": scale.reshape(1),
+            "OutAccum": new_accum, "OutState": new_state}

@@ -1,0 +1,171 @@
+"""IrGraph/pass infrastructure + slim quantization.
+
+Covers: program->graph->program round-trip fidelity, the fc fuse pass
+rewrite, QAT transform (fake quant/dequant insertion + STE training),
+freeze to an int-level inference graph, and post-training quantization
+accuracy on a small net.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.slim.quantization import (
+    PostTrainingQuantization, QuantizationFreezePass,
+    QuantizationTransformPass, apply_startup_inits)
+from paddle_tpu.ir import IrGraph, PassRegistry, apply_pass
+
+B, D, H = 4, 6, 8
+
+
+def _small_net():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[B, D], dtype="float32")
+        h = fluid.layers.fc(x, size=H, act="relu")
+        out = fluid.layers.fc(h, size=2)
+    return prog, startup, out
+
+
+def test_irgraph_round_trip_runs_identically():
+    prog, startup, out = _small_net()
+    rebuilt = IrGraph(prog).to_program()
+    xb = np.random.RandomState(0).randn(B, D).astype("float32")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (a,) = exe.run(prog, feed={"x": xb}, fetch_list=[out])
+        (b,) = exe.run(rebuilt, feed={"x": xb}, fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_fc_fuse_pass():
+    prog, startup, out = _small_net()
+    fused = apply_pass(prog, "fc_fuse_pass")
+    types = [op.type for op in fused.global_block().ops]
+    assert "fc" in types
+    assert "mul" not in types and "elementwise_add" not in types
+    xb = np.random.RandomState(1).randn(B, D).astype("float32")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (a,) = exe.run(prog, feed={"x": xb}, fetch_list=[out])
+        (b,) = exe.run(fused, feed={"x": xb}, fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_graph_viz_pass(tmp_path):
+    prog, _, _ = _small_net()
+    p = PassRegistry._passes["graph_viz_pass"](str(tmp_path), "net")
+    p.apply(IrGraph(prog))
+    dot = (tmp_path / "net.dot").read_text()
+    assert "digraph" in dot and "mul" in dot
+
+
+def test_qat_transform_inserts_fake_ops_and_trains():
+    NB = 32
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[NB, D], dtype="float32")
+        y = fluid.data(name="y", shape=[NB, 1], dtype="float32")
+        pred = fluid.layers.fc(fluid.layers.fc(x, size=H, act="relu"),
+                               size=1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+
+    graph = IrGraph(prog)
+    transform = QuantizationTransformPass(
+        activation_quantize_type="moving_average_abs_max")
+    qprog = transform.apply(graph).to_program()
+    types = [op.type for op in qprog.global_block().ops]
+    assert "fake_quantize_moving_average_abs_max" in types
+    assert "fake_quantize_abs_max" in types  # weights
+    assert "fake_dequantize_max_abs" in types
+
+    # train the transformed program: STE must pass gradients through
+    with fluid.program_guard(qprog, startup):
+        qloss = qprog.global_block().var(loss.name)
+        fluid.optimizer.SGD(0.02).minimize(qloss)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(2)
+    W = rng.randn(D, 1).astype("float32")
+    # fixed batch: isolates STE gradient flow from minibatch noise
+    xb = rng.randn(NB, D).astype("float32")
+    yb = xb @ W
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        apply_startup_inits(graph, scope)
+        losses = []
+        for _ in range(60):
+            (l,) = exe.run(qprog, feed={"x": xb, "y": yb},
+                           fetch_list=[qloss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.2, losses[::15]
+
+
+def test_freeze_pass_produces_int_weights_and_close_outputs():
+    prog, startup, out = _small_net()
+    xb = np.random.RandomState(3).randn(B, D).astype("float32")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (ref,) = exe.run(prog, feed={"x": xb}, fetch_list=[out])
+
+        graph = IrGraph(prog, for_test=True)
+        transform = QuantizationTransformPass(scope=scope)
+        graph = transform.apply(graph)
+        apply_startup_inits(graph, scope)
+        freeze = QuantizationFreezePass(scope=scope, place=None)
+        graph = freeze.apply(graph)
+        frozen = graph.to_program()
+        types = [op.type for op in frozen.global_block().ops]
+        assert not any(t.startswith("fake_quantize") for t in types)
+        assert "fake_dequantize_max_abs" in types
+        # weights in scope are now integer levels
+        wname = prog.all_parameters()[0].name
+        w = np.asarray(scope.find_var(wname).raw().array)
+        assert np.abs(w - np.round(w)).max() < 1e-6
+        assert np.abs(w).max() <= 127
+        (got,) = exe.run(frozen, feed={"x": xb}, fetch_list=[out.name])
+    ref, got = np.asarray(ref), np.asarray(got)
+    denom = max(np.abs(ref).max(), 1e-3)
+    assert np.abs(ref - got).max() / denom < 0.1, (ref, got)
+
+
+@pytest.mark.parametrize("algo", ["abs_max", "KL"])
+def test_post_training_quantization(algo):
+    prog, startup, out = _small_net()
+    rng = np.random.RandomState(4)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xb = rng.randn(B, D).astype("float32")
+        (ref,) = exe.run(prog, feed={"x": xb}, fetch_list=[out])
+
+        ptq = PostTrainingQuantization(
+            exe, prog, scope, ["x"], out.name,
+            lambda: ([rng.randn(B, D).astype("float32")]
+                     for _ in range(4)),
+            batch_nums=4, algo=algo)
+        qprog = ptq.quantize()
+        types = [op.type for op in qprog.global_block().ops]
+        assert "fake_quantize_range_abs_max" in types  # static act scales
+        (got,) = exe.run(qprog, feed={"x": xb}, fetch_list=[out.name])
+        # calibrated scales must be LIVE: clobbering one changes output
+        import jax.numpy as jnp
+
+        sv = scope.find_var("x.scale")
+        assert sv is not None
+        orig = np.asarray(sv.get_tensor().numpy()).copy()
+        sv.get_tensor().set(jnp.asarray(orig * 1e-3))
+        (poisoned,) = exe.run(qprog, feed={"x": xb},
+                              fetch_list=[out.name])
+        assert not np.allclose(np.asarray(poisoned), np.asarray(got))
+        sv.get_tensor().set(jnp.asarray(orig))
+    ref, got = np.asarray(ref), np.asarray(got)
+    denom = max(np.abs(ref).max(), 1e-3)
+    assert np.abs(ref - got).max() / denom < 0.15, (ref, got)
