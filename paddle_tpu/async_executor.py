@@ -1,0 +1,102 @@
+"""AsyncExecutor + DataFeedDesc.
+
+Parity: /root/reference/python/paddle/fluid/async_executor.py
+(AsyncExecutor :63 — the legacy file-driven async PS trainer driver)
+and data_feed_desc.py (DataFeedDesc over the paddle.framework.DataFeedDesc
+prototext).
+
+TPU-native stance: the reference drives C++ ExecutorThreadWorker
+threads over DataFeed files with no Python in the loop; here the same
+contract routes through fluid.dataset's native-C++/numpy multi-slot
+readers into Executor.run steps (each a compiled whole-program
+dispatch). The class is kept because user scripts construct it; new
+code should prefer Executor.train_from_dataset directly, mirroring the
+reference's own deprecation path.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from . import framework
+from .executor import Executor, global_scope
+
+
+class DataFeedDesc:
+    """Parse the reference's MultiSlotDataFeed prototext into slot
+    metadata (data_feed_desc.py contract: set_batch_size,
+    set_dense_slots, set_use_slots, desc())."""
+
+    def __init__(self, proto_file_path: str):
+        with open(proto_file_path) as f:
+            self._text = f.read()
+        self.batch_size = 1
+        m = re.search(r"batch_size\s*:\s*(\d+)", self._text)
+        if m:
+            self.batch_size = int(m.group(1))
+        # slots: name/type/is_dense/is_used blocks in declaration order
+        self.slots = []
+        for block in re.findall(r"slots\s*\{([^}]*)\}", self._text):
+            name = re.search(r'name\s*:\s*"([^"]+)"', block)
+            stype = re.search(r'type\s*:\s*"([^"]+)"', block)
+            dense = re.search(r"is_dense\s*:\s*(\w+)", block)
+            used = re.search(r"is_used\s*:\s*(\w+)", block)
+            self.slots.append({
+                "name": name.group(1) if name else "",
+                "type": stype.group(1) if stype else "uint64",
+                "is_dense": bool(dense and dense.group(1) == "true"),
+                "is_used": bool(used and used.group(1) == "true"),
+            })
+        self._slot_by_name = {s["name"]: s for s in self.slots}
+
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = int(batch_size)
+
+    def set_dense_slots(self, dense_slots_name: List[str]):
+        for n in dense_slots_name:
+            self._slot_by_name[n]["is_dense"] = True
+
+    def set_use_slots(self, use_slots_name: List[str]):
+        for n in use_slots_name:
+            self._slot_by_name[n]["is_used"] = True
+
+    def desc(self) -> str:
+        return self._text
+
+
+class AsyncExecutor:
+    """(reference async_executor.py:63). ``run`` trains a program over a
+    filelist with a multi-slot feed — thread_num maps to reader threads
+    (the compute itself is one compiled program per step)."""
+
+    def __init__(self, place=None, run_mode=""):
+        from .core.place import CPUPlace
+
+        self.place = place if place is not None else CPUPlace()
+        self.executor = Executor(self.place)
+
+    def run(self, program, data_feed, filelist, thread_num=1, fetch=None,
+            mode="", debug=False, scope=None):
+        from .dataset_module import DatasetFactory
+
+        program = program or framework.default_main_program()
+        scope = scope or global_scope()
+        if isinstance(filelist, str):
+            filelist = [filelist]
+        block = program.global_block()
+
+        dataset = DatasetFactory().create_dataset("QueueDataset")
+        if isinstance(data_feed, DataFeedDesc):
+            dataset.set_batch_size(data_feed.batch_size)
+            use_vars = [block.var(s["name"]) for s in data_feed.slots
+                        if s["is_used"]]
+        else:  # an already-configured fluid.dataset object
+            return self.executor.train_from_dataset(
+                program=program, dataset=data_feed, scope=scope,
+                thread=thread_num, fetch_list=fetch, debug=debug)
+        dataset.set_use_var(use_vars)
+        dataset.set_thread(thread_num)
+        dataset.set_filelist(filelist)
+        return self.executor.train_from_dataset(
+            program=program, dataset=dataset, scope=scope,
+            thread=thread_num, fetch_list=fetch, debug=debug)

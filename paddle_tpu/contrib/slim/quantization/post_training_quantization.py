@@ -85,16 +85,21 @@ class PostTrainingQuantization:
 
     # -- calibration -------------------------------------------------------
     def _activation_names(self):
+        """Only the quantized input slots — they are the names that get
+        live .scale vars; sampling op outputs would be wasted fetches."""
+        from .quantization_pass import _QUANT_SLOTS
+
         names = []
-        for op in self._program.global_block().ops:
-            if op.type in self._op_types:
-                block = self._program.global_block()
-                for n in (list(op.inputs.values()) +
-                          list(op.outputs.values())):
-                    for name in n:
-                        v = block._find_var_recursive(name)
-                        if v is not None and not v.persistable:
-                            names.append(name)
+        block = self._program.global_block()
+        for op in block.ops:
+            if op.type not in self._op_types:
+                continue
+            slots = _QUANT_SLOTS.get(op.type, tuple(op.inputs))
+            for slot in slots:
+                for name in op.inputs.get(slot, []):
+                    v = block._find_var_recursive(name)
+                    if v is not None and not v.persistable:
+                        names.append(name)
         return sorted(set(names))
 
     def quantize(self):
@@ -150,12 +155,15 @@ class PostTrainingQuantization:
         return self._quantized_program
 
     def save_quantized_model(self, dirname):
+        """Write a loadable inference model (program + persistables),
+        like the reference's save_quantized_model."""
         from .... import io
 
         if self._quantized_program is None:
             raise RuntimeError("call quantize() first")
-        with framework.program_guard(self._quantized_program):
-            pass
-        io.save_persistables(self._exe, dirname,
-                             main_program=self._quantized_program)
+        target = self._quantized_program.global_block().var(
+            self._fetch_name)
+        io.save_inference_model(dirname, self._feed_names, [target],
+                                self._exe,
+                                main_program=self._quantized_program)
         return dirname

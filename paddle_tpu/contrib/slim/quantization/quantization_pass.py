@@ -173,24 +173,19 @@ class QuantizationFreezePass:
         self._ops = list(quantizable_op_type or _QUANTIZABLE)
 
     def apply(self, graph: IrGraph) -> IrGraph:
-        act_scales: Dict[str, str] = {}
-        weight_scales: Dict[str, str] = {}
         remove = []
-        # 1) strip fake quant ops; record scale vars; requantize weights
+        # 1) strip fake quant ops; requantize weights in the scope (the
+        # weight .scale vars stay behind for the output dequant in 3)
         for op in list(graph.all_op_nodes()):
             t = op.op_type()
             if t.startswith("fake_quantize") or \
                     t == "fake_channel_wise_quantize_abs_max":
                 src = op.input("X")[0]
-                qout = op.output("Out")[0]
                 sname = op.output("OutScale")[0]
                 var = (graph.var_node(src)
                        if graph.has_var_node(src) else None)
                 if var is not None and var.persistable:
-                    weight_scales[qout] = (src, sname)
                     self._quantize_weight_in_scope(src, sname)
-                else:
-                    act_scales[qout] = (src, sname)
                 remove.append(op)
             elif t == "fake_dequantize_max_abs":
                 remove.append(op)

@@ -252,12 +252,43 @@ class CoreExecutor:
 
     # -- block / program --------------------------------------------------
 
-    def run_block(self, block, scope: Scope):
+    def run_block(self, block, scope: Scope, gc_plan=None):
         import jax
 
         with jax.default_device(self.place.jax_device()):
-            for op in block.ops:
+            for i, op in enumerate(block.ops):
                 self.run_op(op, scope)
+                if gc_plan is not None:
+                    for name in gc_plan.get(i, ()):
+                        scope.erase(name)
+
+    @staticmethod
+    def _build_gc_plan(program, protect):
+        """Eager-deletion plan (reference framework/garbage_collector.cc
+        + eager_deletion_pass): op index -> names whose LAST use that op
+        is. Protected: feeds/fetches/persistables, and any name touched
+        inside a sub-block (while/cond bodies read parent-scope vars the
+        top-level scan can't see)."""
+        sub_used = set()
+        for b in program.blocks[1:]:
+            for op in b.ops:
+                sub_used.update(op.input_arg_names)
+                sub_used.update(op.output_arg_names)
+        block = program.global_block()
+        last_use: Dict[str, int] = {}
+        for i, op in enumerate(block.ops):
+            for name in list(op.input_arg_names) + list(
+                    op.output_arg_names):
+                last_use[name] = i
+        plan: Dict[int, list] = {}
+        for name, i in last_use.items():
+            if name in protect or name in sub_used:
+                continue
+            v = block._find_var_recursive(name)
+            if v is None or getattr(v, "persistable", False):
+                continue
+            plan.setdefault(i, []).append(name)
+        return plan
 
     def run_program(
         self,
@@ -274,7 +305,16 @@ class CoreExecutor:
             else:
                 self._write_var(scope, name, np.asarray(value))
 
-        self.run_block(program.global_block(), scope)
+        gc_plan = None
+        from .flags import get_flags
+
+        if get_flags("FLAGS_eager_delete_tensor_gb")[
+                "FLAGS_eager_delete_tensor_gb"] >= 0:
+            protect = set(feed) | {
+                (f if isinstance(f, str) else f.name)
+                for f in (fetch_list or [])}
+            gc_plan = self._build_gc_plan(program, protect)
+        self.run_block(program.global_block(), scope, gc_plan=gc_plan)
         self.rng.advance()
 
         results = []

@@ -16,8 +16,10 @@ _DEFS = {
     "FLAGS_check_nan_inf": (False, "scan op outputs for nan/inf "
                             "(reference operator.cc:1032)"),
     "FLAGS_benchmark": (False, "sync + time every op (no-op)"),
-    "FLAGS_eager_delete_tensor_gb": (0.0, "GC threshold (no-op: XLA "
-                                     "buffer liveness)"),
+    "FLAGS_eager_delete_tensor_gb": (-1.0, "eager var deletion in the "
+                                     "interpreter when >= 0; compiled "
+                                     "programs rely on XLA buffer "
+                                     "liveness instead"),
     "FLAGS_fraction_of_gpu_memory_to_use": (0.92, "allocator fraction "
                                             "(no-op)"),
     "FLAGS_allocator_strategy": ("auto_growth", "allocator choice "

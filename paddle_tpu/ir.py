@@ -152,6 +152,12 @@ class IrGraph:
         return cls(program, for_test=for_test)
 
     def _load(self, program):
+        if len(program.blocks) > 1:
+            raise NotImplementedError(
+                "IrGraph covers single-block programs; this one has %d "
+                "blocks (control-flow sub-blocks). Apply passes before "
+                "adding While/cond, or rewrite sub-blocks explicitly."
+                % len(program.blocks))
         block = program.global_block()
         for name, var in block.vars.items():
             self._vars[name] = IrVarNode(

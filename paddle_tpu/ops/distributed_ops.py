@@ -110,3 +110,52 @@ def _send_barrier(executor, op, scope):
 )
 def _fetch_barrier(executor, op, scope):
     pass
+
+
+_GEO_COUNTERS: Dict[str, int] = {}
+
+
+@register_host_op(
+    "geo_send",
+    inputs=[In("Param", no_grad=True), In("Snapshot", no_grad=True)],
+    outputs=[Out("SnapshotOut")],
+    attrs={"epmap": [], "table_name": "", "push_nums": 100, "trainers": 1},
+)
+def _geo_send(executor, op, scope):
+    """Geo-SGD delta push (reference geo_sgd_transpiler + the
+    GeoSgdCommunicator threads, communicator.h:383): every `push_nums`
+    calls, ships (param - snapshot) to the hosting pserver (which
+    applies param += delta) and refreshes the snapshot — deltas
+    accumulate locally between pushes. Other calls are a counter bump."""
+    table = op.attrs.get("table_name", "")
+    # per-trainer cadence: key by the calling scope too, or co-resident
+    # emulated trainers would share one push counter
+    key = "%s@%s@%d" % (table, ",".join(op.attrs.get("epmap", [])),
+                        id(scope))
+    _GEO_COUNTERS[key] = _GEO_COUNTERS.get(key, 0) + 1
+    if _GEO_COUNTERS[key] % max(int(op.attrs.get("push_nums", 100)), 1):
+        return
+    ep = (op.attrs.get("epmap") or [""])[0]
+    server = _EMULATED_SERVERS.get(ep)
+    if server is None:
+        raise RuntimeError(
+            "geo_send: no server at %r — run the pserver program first"
+            % ep)
+    param = np.asarray(executor._read_var(scope, op.input("Param")[0]))
+    snap = np.asarray(executor._read_var(scope, op.input("Snapshot")[0]))
+    dname = "%s.geo.delta" % table
+    server["executor"]._write_var(server["scope"], dname, param - snap)
+    sub = server["grad_to_block"].get(dname)
+    if sub is not None:
+        # param += delta via the server's optimize sub-block
+        server["executor"].run_block(sub, server["scope"])
+    else:
+        cur = np.asarray(server["executor"]._read_var(server["scope"],
+                                                      table))
+        server["executor"]._write_var(server["scope"], table,
+                                      cur + (param - snap))
+    executor._write_var(scope, op.output("SnapshotOut")[0], param)
+
+
+def reset_geo_counters():
+    _GEO_COUNTERS.clear()

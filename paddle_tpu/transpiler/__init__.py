@@ -34,3 +34,22 @@ class RoundRobin:
 
     def reset(self):
         self._i = 0
+
+
+from .geo_sgd_transpiler import GeoSgdTranspiler  # noqa: F401
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Deprecated no-op (reference memory_optimization_transpiler.py —
+    deprecated since 1.6; here XLA buffer assignment + donation subsume
+    it by construction)."""
+    import logging
+
+    logging.warning(
+        "paddle_tpu.transpiler.memory_optimize is a deprecated no-op: "
+        "XLA buffer assignment and donation handle memory reuse")
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Deprecated no-op (reference release_memory)."""

@@ -39,6 +39,9 @@ class DistributeTranspilerConfig:
     wait_port = True
     runtime_split_send_recv = False
     sync_mode = True
+    # geo-SGD: push parameter deltas every N local steps
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
 
 
 class VarBlock:

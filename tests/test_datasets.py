@@ -1,0 +1,113 @@
+"""Dataset package: every reader serves its reference sample contract
+(offline synthetic mode), deterministically.
+"""
+import numpy as np
+
+from paddle_tpu import dataset
+
+
+def _take(reader, n):
+    out = []
+    for i, s in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(s)
+    return out
+
+
+def test_mnist_contract():
+    s = _take(dataset.mnist.train(), 5)
+    img, lbl = s[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert 0 <= lbl < 10
+    # deterministic
+    s2 = _take(dataset.mnist.train(), 5)
+    np.testing.assert_array_equal(s[0][0], s2[0][0])
+
+
+def test_cifar_contract():
+    for reader, nclass in [(dataset.cifar.train10(), 10),
+                           (dataset.cifar.train100(), 100)]:
+        img, lbl = _take(reader, 1)[0]
+        assert img.shape == (3072,) and img.dtype == np.float32
+        assert 0 <= lbl < nclass
+        assert 0.0 <= img.min() and img.max() <= 1.0
+
+
+def test_imdb_contract():
+    wd = dataset.imdb.word_dict()
+    assert "<unk>" in wd
+    samples = _take(dataset.imdb.train(wd), 10)
+    for ids, lbl in samples:
+        assert all(0 <= i < len(wd) for i in ids)
+        assert lbl in (0, 1)
+    assert {l for _, l in samples} == {0, 1} or len(samples) < 4
+
+
+def test_imikolov_contract():
+    wd = dataset.imikolov.build_dict()
+    grams = _take(dataset.imikolov.train(wd, 5), 5)
+    assert all(len(g) == 5 for g in grams)
+    seqs = _take(dataset.imikolov.train(wd, -1, dataset.imikolov.SEQ), 3)
+    for src, trg in seqs:
+        assert len(src) == len(trg)
+
+
+def test_movielens_contract():
+    s = _take(dataset.movielens.train(), 5)
+    uid, gender, age, job, mid, cats, title, rating = s[0]
+    assert 1 <= uid <= dataset.movielens.max_user_id()
+    assert 1 <= mid <= dataset.movielens.max_movie_id()
+    assert gender in (0, 1)
+    assert 0 <= age < len(dataset.movielens.age_table)
+    assert 0 <= job <= dataset.movielens.max_job_id()
+    assert isinstance(cats, list) and isinstance(title, list)
+    assert 1.0 <= rating <= 5.0
+
+
+def test_flowers_contract():
+    img, lbl = _take(dataset.flowers.train(), 1)[0]
+    assert img.shape == (3, 32, 32) and img.dtype == np.float32
+    assert 0 <= lbl < 102
+
+
+def test_wmt_contracts():
+    for mod, mk in [(dataset.wmt14, lambda m: m.train(30)),
+                    (dataset.wmt16, lambda m: m.train(30, 30))]:
+        src, trg_in, trg_next = _take(mk(mod), 1)[0]
+        assert trg_in[0] == 0            # <s>
+        assert trg_next[-1] == 1         # <e>
+        assert trg_in[1:] == trg_next[:-1]
+        assert all(t >= 3 for t in src)
+    sd, td = dataset.wmt14.get_dict(30)
+    assert sd[0] == "<s>" and sd[1] == "<e>"
+
+
+def test_conll05_contract():
+    wd, vd, ld = dataset.conll05.get_dict()
+    sample = _take(dataset.conll05.test(), 1)[0]
+    assert len(sample) == 9
+    L = len(sample[0])
+    for part in sample[1:]:
+        assert len(part) == L
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape[0] == len(wd)
+
+
+def test_voc2012_contract():
+    img, mask = _take(dataset.voc2012.train(), 1)[0]
+    assert img.shape[0] == 3 and img.dtype == np.float32
+    assert mask.dtype == np.uint8 and mask.max() >= 1
+
+
+def test_sentiment_contract():
+    ids, lbl = _take(dataset.sentiment.train(), 1)[0]
+    assert lbl in (0, 1) and len(ids) > 0
+
+
+def test_image_transforms():
+    im = (np.random.RandomState(0).rand(40, 60, 3) * 255).astype("uint8")
+    out = dataset.image.simple_transform(im, 32, 28, is_train=False)
+    assert out.shape == (3, 28, 28) and out.dtype == np.float32
+    short = dataset.image.resize_short(im, 32)
+    assert min(short.shape[:2]) == 32
