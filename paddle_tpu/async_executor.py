@@ -61,7 +61,20 @@ class DataFeedDesc:
             self._slot_by_name[n]["is_used"] = True
 
     def desc(self) -> str:
-        return self._text
+        """Regenerate the prototext from current state (the reference
+        rebuilds from its proto, so setters are reflected)."""
+        lines = ['name: "MultiSlotDataFeed"',
+                 "batch_size: %d" % self.batch_size,
+                 "multi_slot_desc {"]
+        for s in self.slots:
+            lines += ["  slots {",
+                      '    name: "%s"' % s["name"],
+                      '    type: "%s"' % s["type"],
+                      "    is_dense: %s" % str(s["is_dense"]).lower(),
+                      "    is_used: %s" % str(s["is_used"]).lower(),
+                      "  }"]
+        lines.append("}")
+        return "\n".join(lines) + "\n"
 
 
 class AsyncExecutor:

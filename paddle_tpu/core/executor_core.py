@@ -94,6 +94,8 @@ class CoreExecutor:
     def __init__(self, place):
         self.place = place
         self.rng = RNGState()
+        # (program version, protect set) -> eager-GC plan
+        self._gc_plan_cache: Dict = {}
 
     # -- variable IO ------------------------------------------------------
 
@@ -310,10 +312,16 @@ class CoreExecutor:
 
         if get_flags("FLAGS_eager_delete_tensor_gb")[
                 "FLAGS_eager_delete_tensor_gb"] >= 0:
-            protect = set(feed) | {
+            protect = frozenset(feed) | frozenset(
                 (f if isinstance(f, str) else f.name)
-                for f in (fetch_list or [])}
-            gc_plan = self._build_gc_plan(program, protect)
+                for f in (fetch_list or []))
+            from .compiler_engine import _program_version
+
+            key = (_program_version(program), protect)
+            gc_plan = self._gc_plan_cache.get(key)
+            if gc_plan is None:
+                gc_plan = self._build_gc_plan(program, protect)
+                self._gc_plan_cache[key] = gc_plan
         self.run_block(program.global_block(), scope, gc_plan=gc_plan)
         self.rng.advance()
 

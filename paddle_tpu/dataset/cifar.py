@@ -45,19 +45,29 @@ def _synthetic_reader(n, num_classes, seed):
     return reader
 
 
-def _pick(archive, sub_name, n, num_classes, seed):
+def _cycled(reader):
+    def cyc():
+        while True:
+            yield from reader()
+
+    return cyc
+
+
+def _pick(archive, sub_name, n, num_classes, seed, cycle=False):
     path = os.path.join(DATA_HOME, "cifar", archive)
-    if os.path.exists(path):
-        return _tar_reader(path, sub_name)
-    return _synthetic_reader(n, num_classes, seed)
+    reader = (_tar_reader(path, sub_name) if os.path.exists(path)
+              else _synthetic_reader(n, num_classes, seed))
+    return _cycled(reader) if cycle else reader
 
 
 def train10(cycle=False):
-    return _pick("cifar-10-python.tar.gz", "data_batch", 8192, 10, 10)
+    return _pick("cifar-10-python.tar.gz", "data_batch", 8192, 10, 10,
+                 cycle)
 
 
 def test10(cycle=False):
-    return _pick("cifar-10-python.tar.gz", "test_batch", 1024, 10, 11)
+    return _pick("cifar-10-python.tar.gz", "test_batch", 1024, 10, 11,
+                 cycle)
 
 
 def train100():

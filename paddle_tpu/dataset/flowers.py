@@ -47,16 +47,26 @@ def _file_reader(list_name, mapper):
         "synthetic data" % _data_dir())
 
 
+def _cycled(reader):
+    def cyc():
+        while True:
+            yield from reader()
+
+    return cyc
+
+
 def train(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
     if os.path.exists(os.path.join(_data_dir(), "102flowers.tgz")):
         return _file_reader("trnid", mapper)
-    return _synthetic_reader(2048, seed=50)
+    r = _synthetic_reader(2048, seed=50)
+    return _cycled(r) if cycle else r
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
     if os.path.exists(os.path.join(_data_dir(), "102flowers.tgz")):
         return _file_reader("tstid", mapper)
-    return _synthetic_reader(256, seed=51)
+    r = _synthetic_reader(256, seed=51)
+    return _cycled(r) if cycle else r
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=False):

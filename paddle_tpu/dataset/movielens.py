@@ -62,24 +62,66 @@ def _archive():
     return p if os.path.exists(p) else None
 
 
+_meta_cache = None
+
+
+def _meta():
+    """Metadata derived from the real archive when present (the
+    reference computes maxima/dicts from the loaded data), else the
+    synthetic constants."""
+    global _meta_cache
+    if _meta_cache is not None:
+        return _meta_cache
+    if _archive() is None:
+        _meta_cache = {
+            "max_user": _N_USERS, "max_movie": _N_MOVIES,
+            "max_job": _N_JOBS - 1,
+            "categories": {c: i for i, c in enumerate(_CATEGORIES)},
+            "titles": {w: i for i, w in enumerate(_TITLE_WORDS)},
+        }
+        return _meta_cache
+    cats, titles = {}, {}
+    max_user = max_movie = max_job = 0
+    pat = re.compile(r"(.*)\s+\(\d{4}\)")
+    with zipfile.ZipFile(_archive()) as z:
+        for line in z.read("ml-1m/movies.dat").decode(
+                "latin1").strip().split("\n"):
+            mid, title, cs = line.split("::")
+            max_movie = max(max_movie, int(mid))
+            for c in cs.split("|"):
+                cats.setdefault(c, len(cats))
+            m = pat.match(title)
+            for w in (m.group(1) if m else title).lower().split():
+                titles.setdefault(w, len(titles))
+        for line in z.read("ml-1m/users.dat").decode(
+                "latin1").strip().split("\n"):
+            uid, _g, _a, job, _zip = line.split("::")
+            max_user = max(max_user, int(uid))
+            max_job = max(max_job, int(job))
+    _meta_cache = {"max_user": max_user, "max_movie": max_movie,
+                   "max_job": max_job, "categories": cats,
+                   "titles": titles}
+    return _meta_cache
+
+
 def movie_categories():
-    return {c: i for i, c in enumerate(_CATEGORIES)}
+    return _meta()["categories"]
 
 
 def get_movie_title_dict():
-    return {w: i for i, w in enumerate(_TITLE_WORDS)}
+    return _meta()["titles"]
 
 
 def max_movie_id():
-    return _N_MOVIES
+    return _meta()["max_movie"]
 
 
 def max_user_id():
-    return _N_USERS
+    return _meta()["max_user"]
 
 
 def max_job_id():
-    return _N_JOBS - 1
+    return _meta()["max_job"]
 
 
 def _synthetic_samples(n, seed):

@@ -52,7 +52,13 @@ def _tar_reader(split):
                     "VOCdevkit/VOC2012/SegmentationClass/%s.png"
                     % name).read()
                 img = load_image_bytes(jpg)
-                mask = load_image_bytes(png, is_color=False)
+                # P-mode palette PNG: the raw indices ARE the class ids
+                # (convert("L") would turn them into luminance garbage)
+                import io as _io
+
+                from PIL import Image
+
+                mask = np.asarray(Image.open(_io.BytesIO(png)))
                 yield to_chw(img).astype("float32") / 255.0, \
                     mask.astype("uint8")
 

@@ -191,6 +191,8 @@ def cos_sim(X, Y):
     helper.append_op("cos_sim", inputs={"X": [X], "Y": [Y]},
                      outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]},
                      infer_shape=False)
+    if X.shape is not None:
+        out.shape = (int(X.shape[0]), 1)
     return out
 
 

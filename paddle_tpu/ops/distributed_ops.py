@@ -112,7 +112,11 @@ def _fetch_barrier(executor, op, scope):
     pass
 
 
-_GEO_COUNTERS: Dict[str, int] = {}
+import weakref
+
+# scope -> {table@epmap: count}; weak keys so a dead trainer scope's
+# counters vanish with it (id()-keyed dicts alias on address reuse)
+_GEO_COUNTERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 @register_host_op(
@@ -128,12 +132,12 @@ def _geo_send(executor, op, scope):
     applies param += delta) and refreshes the snapshot — deltas
     accumulate locally between pushes. Other calls are a counter bump."""
     table = op.attrs.get("table_name", "")
-    # per-trainer cadence: key by the calling scope too, or co-resident
-    # emulated trainers would share one push counter
-    key = "%s@%s@%d" % (table, ",".join(op.attrs.get("epmap", [])),
-                        id(scope))
-    _GEO_COUNTERS[key] = _GEO_COUNTERS.get(key, 0) + 1
-    if _GEO_COUNTERS[key] % max(int(op.attrs.get("push_nums", 100)), 1):
+    # per-trainer cadence: counters live per calling scope, or
+    # co-resident emulated trainers would share one push counter
+    key = "%s@%s" % (table, ",".join(op.attrs.get("epmap", [])))
+    counters = _GEO_COUNTERS.setdefault(scope, {})
+    counters[key] = counters.get(key, 0) + 1
+    if counters[key] % max(int(op.attrs.get("push_nums", 100)), 1):
         return
     ep = (op.attrs.get("epmap") or [""])[0]
     server = _EMULATED_SERVERS.get(ep)
