@@ -34,6 +34,16 @@ class DataFeedDesc:
         m = re.search(r"batch_size\s*:\s*(\d+)", self._text)
         if m:
             self.batch_size = int(m.group(1))
+        m = re.search(r'name\s*:\s*"([^"]+)"', self._text)
+        self.name = m.group(1) if m else "MultiSlotDataFeed"
+        # top-level fields we don't model (pipe_command etc.) survive
+        # the desc() round-trip verbatim
+        body = re.sub(r"multi_slot_desc\s*\{.*\}", "", self._text,
+                      flags=re.S)
+        self._extra_lines = [
+            ln.strip() for ln in body.splitlines()
+            if ln.strip() and not re.match(
+                r'(name|batch_size)\s*:', ln.strip())]
         # slots: name/type/is_dense/is_used blocks in declaration order
         self.slots = []
         for block in re.findall(r"slots\s*\{([^}]*)\}", self._text):
@@ -63,9 +73,10 @@ class DataFeedDesc:
     def desc(self) -> str:
         """Regenerate the prototext from current state (the reference
         rebuilds from its proto, so setters are reflected)."""
-        lines = ['name: "MultiSlotDataFeed"',
-                 "batch_size: %d" % self.batch_size,
-                 "multi_slot_desc {"]
+        lines = ['name: "%s"' % self.name,
+                 "batch_size: %d" % self.batch_size]
+        lines += self._extra_lines
+        lines.append("multi_slot_desc {")
         for s in self.slots:
             lines += ["  slots {",
                       '    name: "%s"' % s["name"],

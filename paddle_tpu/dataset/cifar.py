@@ -45,19 +45,13 @@ def _synthetic_reader(n, num_classes, seed):
     return reader
 
 
-def _cycled(reader):
-    def cyc():
-        while True:
-            yield from reader()
-
-    return cyc
-
-
 def _pick(archive, sub_name, n, num_classes, seed, cycle=False):
+    from .common import cycled
+
     path = os.path.join(DATA_HOME, "cifar", archive)
     reader = (_tar_reader(path, sub_name) if os.path.exists(path)
               else _synthetic_reader(n, num_classes, seed))
-    return _cycled(reader) if cycle else reader
+    return cycled(reader) if cycle else reader
 
 
 def train10(cycle=False):

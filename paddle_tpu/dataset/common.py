@@ -52,6 +52,16 @@ def cached_path(module_name, filename):
     return p if os.path.exists(p) else None
 
 
+def cycled(reader):
+    """Wrap a reader creator to repeat forever (the reference's
+    cycle=True contract)."""
+    def cyc():
+        while True:
+            yield from reader()
+
+    return cyc
+
+
 def cluster_files_reader(files_pattern, trainer_count, trainer_id,
                          loader=pickle.load):
     """(reference common.py cluster_files_reader) — round-robin split of

@@ -47,26 +47,24 @@ def _file_reader(list_name, mapper):
         "synthetic data" % _data_dir())
 
 
-def _cycled(reader):
-    def cyc():
-        while True:
-            yield from reader()
-
-    return cyc
-
-
 def train(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
+    from .common import cycled
+
     if os.path.exists(os.path.join(_data_dir(), "102flowers.tgz")):
-        return _file_reader("trnid", mapper)
-    r = _synthetic_reader(2048, seed=50)
-    return _cycled(r) if cycle else r
+        r = _file_reader("trnid", mapper)
+    else:
+        r = _synthetic_reader(2048, seed=50)
+    return cycled(r) if cycle else r
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
+    from .common import cycled
+
     if os.path.exists(os.path.join(_data_dir(), "102flowers.tgz")):
-        return _file_reader("tstid", mapper)
-    r = _synthetic_reader(256, seed=51)
-    return _cycled(r) if cycle else r
+        r = _file_reader("tstid", mapper)
+    else:
+        r = _synthetic_reader(256, seed=51)
+    return cycled(r) if cycle else r
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=False):

@@ -34,16 +34,31 @@ def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
         return layers.transpose(x, [0, 2, 1, 3])  # [B, H, T, head]
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    q = layers.scale(q, scale=float(head) ** -0.5)
-    scores = layers.matmul(q, k, transpose_y=True)  # [B, H, T, T]
-    if attn_bias is not None:
-        scores = layers.elementwise_add(scores, attn_bias)
-    weights = layers.softmax(scores)
-    if dropout:
-        weights = layers.dropout(weights, dropout_prob=dropout,
-                                 is_test=is_test,
-                                 dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(weights, v)  # [B, H, T, head]
+    if attn_bias is None and not (dropout and not is_test):
+        # no mask, no attention dropout -> the flash path (pallas kernel
+        # on TPU: the T x T score matrix never hits HBM)
+        from ..layer_helper import LayerHelper
+
+        helper = LayerHelper("flash_attention", input=q_in)
+        ctx = helper.create_variable_for_type_inference(q_in.dtype)
+        helper.append_op("flash_attention",
+                         inputs={"Q": [q], "K": [k], "V": [v]},
+                         outputs={"Out": [ctx]},
+                         attrs={"causal": False,
+                                "scale": float(head) ** -0.5},
+                         infer_shape=False)
+        ctx.shape = (B, num_heads, T, head)
+    else:
+        q = layers.scale(q, scale=float(head) ** -0.5)
+        scores = layers.matmul(q, k, transpose_y=True)  # [B, H, T, T]
+        if attn_bias is not None:
+            scores = layers.elementwise_add(scores, attn_bias)
+        weights = layers.softmax(scores)
+        if dropout:
+            weights = layers.dropout(
+                weights, dropout_prob=dropout, is_test=is_test,
+                dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(weights, v)  # [B, H, T, head]
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [B, T, d_model])
     return _dense(ctx, d_model)

@@ -106,3 +106,21 @@ def _fc(ins, attrs):
     elif act:
         raise NotImplementedError("fc activation %r" % act)
     return {"Out": out.reshape(tuple(x.shape[:k]) + (w.shape[1],))}
+
+
+@register_op(
+    "flash_attention",
+    inputs=[In("Q"), In("K"), In("V")],
+    outputs=[Out("Out")],
+    attrs={"causal": False, "scale": 0.0},
+)
+def _flash_attention(ins, attrs):
+    """Flash attention over [B, H, S, D] (pallas kernel on TPU, exact
+    dense math elsewhere; see ops/pallas/flash_attention.py)."""
+    from .pallas import flash_attention
+
+    q, k, v = ins["Q"], ins["K"], ins["V"]
+    scale = attrs.get("scale", 0.0) or None
+    return {"Out": flash_attention(q, k, v,
+                                   causal=bool(attrs.get("causal")),
+                                   scale=scale)}

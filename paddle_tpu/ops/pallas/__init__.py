@@ -1,0 +1,9 @@
+"""Pallas TPU kernels (hand-scheduled hot ops).
+
+XLA fusion covers most of the op corpus; kernels live here only where
+hand control of VMEM streaming beats the compiler — attention is the
+canonical case (reference counterpart: the hand-fused CUDA kernels
+under operators/fused/, e.g. multihead_matmul_op.cu and
+math/bert_encoder_functor.cu).
+"""
+from .flash_attention import flash_attention  # noqa: F401

@@ -302,13 +302,34 @@ class TestMachineTranslation:
                                      max_step_num=T)
         with fluid.scope_guard(scope):
             exe = fluid.Executor(fluid.CPUPlace())
+            # snapshot the TRAINED shared weights: infer_startup must
+            # initialize the new decode-path params (enc2/dec2 cells)
+            # but would clobber the shared ones (no initialized-skip in
+            # initializer ops)
+            shared = {}
+            for name in list(scope.local_var_names()):
+                v = scope.find_var(name)
+                if v is not None and v.is_initialized():
+                    shared[name] = np.asarray(v.raw().array).copy()
             exe.run(infer_startup)
             feed = next(feeds(1))
+            # decode on the CLOBBERED (freshly initialized) weights...
+            (ids_fresh,) = exe.run(infer_prog, feed={"src": feed["src"]},
+                                   fetch_list=[outs])
+            # ...then restore the trained shared weights and decode again
+            import jax.numpy as jnp
+
+            for name, val in shared.items():
+                scope.var(name).get_tensor().set(jnp.asarray(val))
             (ids,) = exe.run(infer_prog, feed={"src": feed["src"]},
                              fetch_list=[outs])
         ids = np.asarray(ids)
         assert ids.shape == (B, T, K)
         assert ((ids >= 0) & (ids < V)).all()
+        # the decode must actually consume the trained weights: if the
+        # by-name sharing (or the restore) silently broke, the two
+        # decodes would agree
+        assert not np.array_equal(ids, np.asarray(ids_fresh))
 
 
 class TestLabelSemanticRoles:
