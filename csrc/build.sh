@@ -1,0 +1,12 @@
+#!/bin/sh
+# Build the native components (reference counterpart: cmake targets for
+# the data-feed library, the inference C API, and the C++ train demo).
+set -e
+cd "$(dirname "$0")"
+PYFLAGS="$(python3-config --includes) $(python3-config --ldflags --embed)"
+
+g++ -O2 -std=c++17 -shared -fPIC data_feed.cc -o libptfeed.so
+g++ -O2 -std=c++17 -shared -fPIC capi.cc -o libptcapi.so $PYFLAGS
+gcc -O2 capi_smoke.c -o capi_smoke -L. -lptcapi -Wl,-rpath,'$ORIGIN'
+g++ -O2 -std=c++17 train_demo.cc -o train_demo $PYFLAGS
+echo "built: libptfeed.so libptcapi.so capi_smoke train_demo"

@@ -1,0 +1,198 @@
+// C inference API over the paddle_tpu predictor.
+//
+// Parity: /root/reference/paddle/fluid/inference/capi/ (pd_predictor.cc
+// PD_NewPredictor / PD_PredictorRun / pd_config.cc) — a plain C ABI for
+// embedding the predictor in C/C++/Go/R applications.
+//
+// TPU-native stance: the compute runtime is JAX/XLA, reachable through
+// the Python layer, so this library embeds CPython (Py_Initialize) and
+// drives paddle_tpu.inference.Predictor through the C API; the XLA
+// compile/dispatch path underneath is identical to the Python one. The
+// reference's C API wraps its C++ AnalysisPredictor the same way this
+// wraps ours — one stable C ABI in front of the real runtime.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC capi.cc -o libptcapi.so \
+//            $(python3-config --includes --ldflags --embed)
+//
+// ABI (mirrors pd_predictor.h naming):
+//   PD_Predictor* PD_NewPredictor(const char* model_dir);
+//   int  PD_PredictorRun(PD_Predictor*, const char* input_name,
+//                        const float* data, const int64_t* shape,
+//                        int ndims, float* out, int64_t out_capacity,
+//                        int64_t* out_size);
+//   void PD_DeletePredictor(PD_Predictor*);
+//   const char* PD_GetLastError();
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::mutex g_mu;
+std::string g_last_error;
+bool g_py_inited = false;
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+
+void fetch_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyObject *s = value ? PyObject_Str(value) : nullptr;
+  set_error(s ? PyUnicode_AsUTF8(s) : "unknown python error");
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+void ensure_python() {
+  if (!g_py_inited) {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by initialization, or every other
+      // thread's PyGILState_Ensure would deadlock forever
+      PyEval_SaveThread();
+    }
+    g_py_inited = true;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+struct PD_Predictor {
+  PyObject *predictor;  // paddle_tpu.inference.Predictor
+};
+
+const char *PD_GetLastError() { return g_last_error.c_str(); }
+
+PD_Predictor *PD_NewPredictor(const char *model_dir) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_last_error.clear();
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PD_Predictor *out = nullptr;
+  PyObject *mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) {
+    fetch_py_error();
+    PyGILState_Release(gil);
+    return nullptr;
+  }
+  PyObject *cfg_cls = PyObject_GetAttrString(mod, "AnalysisConfig");
+  PyObject *pred_fn = PyObject_GetAttrString(mod, "create_paddle_predictor");
+  PyObject *cfg = cfg_cls ? PyObject_CallFunction(cfg_cls, "s", model_dir)
+                          : nullptr;
+  PyObject *pred = (pred_fn && cfg)
+                       ? PyObject_CallFunctionObjArgs(pred_fn, cfg, nullptr)
+                       : nullptr;
+  if (pred) {
+    out = new PD_Predictor{pred};
+  } else {
+    fetch_py_error();
+  }
+  Py_XDECREF(cfg);
+  Py_XDECREF(cfg_cls);
+  Py_XDECREF(pred_fn);
+  Py_XDECREF(mod);
+  PyGILState_Release(gil);
+  return out;
+}
+
+int PD_PredictorRun(PD_Predictor *p, const char *input_name,
+                    const float *data, const int64_t *shape, int ndims,
+                    float *out, int64_t out_capacity, int64_t *out_size) {
+  if (!p || !p->predictor) {
+    set_error("null predictor");
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_last_error.clear();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  // build a numpy array via the buffer-free float list path (no numpy
+  // C API dependency): numpy.frombuffer over a bytes object + reshape
+  PyObject *np = PyImport_ImportModule("numpy");
+  int64_t numel = 1;
+  for (int i = 0; i < ndims; ++i) numel *= shape[i];
+  PyObject *buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), numel * sizeof(float));
+  PyObject *arr = nullptr;
+  if (np && buf) {
+    PyObject *flat = PyObject_CallMethod(np, "frombuffer", "Os", buf,
+                                         "float32");
+    if (flat) {
+      PyObject *shp = PyTuple_New(ndims);
+      for (int i = 0; i < ndims; ++i)
+        PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+      arr = PyObject_CallMethod(flat, "reshape", "O", shp);
+      Py_DECREF(shp);
+      Py_DECREF(flat);
+    }
+  }
+  PyObject *result = nullptr;
+  if (arr) {
+    PyObject *feed = PyDict_New();
+    PyDict_SetItemString(feed, input_name, arr);
+    result = PyObject_CallMethod(p->predictor, "run", "O", feed);
+    Py_DECREF(feed);
+  }
+  if (result && PyList_Check(result) && PyList_Size(result) > 0) {
+    PyObject *first_t = PyList_GetItem(result, 0);  // borrowed PaddleTensor
+    PyObject *first = PyObject_CallMethod(first_t, "as_ndarray", nullptr);
+    PyObject *f32 = first ? PyObject_CallMethod(first, "astype", "s",
+                                                "float32")
+                          : nullptr;
+    Py_XDECREF(first);
+    PyObject *ravel = f32 ? PyObject_CallMethod(f32, "ravel", nullptr)
+                          : nullptr;
+    PyObject *bytes = ravel ? PyObject_CallMethod(ravel, "tobytes", nullptr)
+                            : nullptr;
+    if (bytes) {
+      int64_t n = PyBytes_Size(bytes) / (int64_t)sizeof(float);
+      *out_size = n;
+      if (n <= out_capacity) {
+        std::memcpy(out, PyBytes_AsString(bytes), n * sizeof(float));
+        rc = 0;
+      } else {
+        set_error("output buffer too small");
+      }
+      Py_DECREF(bytes);
+    }
+    Py_XDECREF(ravel);
+    Py_XDECREF(f32);
+  }
+  // a pending Python exception must always be drained before releasing
+  // the GIL, whatever message is already recorded
+  if (PyErr_Occurred()) {
+    if (rc != 0 && g_last_error.empty()) {
+      fetch_py_error();
+    } else {
+      PyErr_Clear();
+    }
+  } else if (rc != 0 && g_last_error.empty()) {
+    set_error("run failed");
+  }
+  Py_XDECREF(result);
+  Py_XDECREF(arr);
+  Py_XDECREF(buf);
+  Py_XDECREF(np);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void PD_DeletePredictor(PD_Predictor *p) {
+  if (!p) return;
+  std::lock_guard<std::mutex> lk(g_mu);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->predictor);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,50 @@
+/* Minimal C consumer of the inference C API (parity with the
+ * reference's capi tests): load a saved model dir, run one batch read
+ * from x.bin, print the outputs. Exit 0 on success. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef struct PD_Predictor PD_Predictor;
+extern PD_Predictor *PD_NewPredictor(const char *model_dir);
+extern int PD_PredictorRun(PD_Predictor *, const char *input_name,
+                           const float *data, const int64_t *shape,
+                           int ndims, float *out, int64_t out_capacity,
+                           int64_t *out_size);
+extern void PD_DeletePredictor(PD_Predictor *);
+extern const char *PD_GetLastError(void);
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s <model_dir> <x.bin> <rows> <cols>\n",
+            argv[0]);
+    return 2;
+  }
+  const char *dir = argv[1];
+  long rows = atol(argv[3]), cols = atol(argv[4]);
+  FILE *f = fopen(argv[2], "rb");
+  if (!f) return 2;
+  float *x = (float *)malloc(sizeof(float) * rows * cols);
+  if (fread(x, sizeof(float), rows * cols, f) != (size_t)(rows * cols)) {
+    fclose(f);
+    return 2;
+  }
+  fclose(f);
+
+  PD_Predictor *p = PD_NewPredictor(dir);
+  if (!p) {
+    fprintf(stderr, "create failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  int64_t shape[2] = {rows, cols};
+  float out[4096];
+  int64_t out_n = 0;
+  if (PD_PredictorRun(p, "x", x, shape, 2, out, 4096, &out_n) != 0) {
+    fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  for (int64_t i = 0; i < out_n; ++i) printf("%.6f\n", out[i]);
+  PD_DeletePredictor(p);
+  free(x);
+  return 0;
+}

@@ -34,12 +34,14 @@ class DataFeedDesc:
         m = re.search(r"batch_size\s*:\s*(\d+)", self._text)
         if m:
             self.batch_size = int(m.group(1))
-        m = re.search(r'name\s*:\s*"([^"]+)"', self._text)
+        # top-level text only (slot blocks stripped) — a slot's name
+        # must not be mistaken for the feed name
+        body = re.sub(r"multi_slot_desc\s*\{.*\}", "", self._text,
+                      flags=re.S)
+        m = re.search(r'name\s*:\s*"([^"]+)"', body)
         self.name = m.group(1) if m else "MultiSlotDataFeed"
         # top-level fields we don't model (pipe_command etc.) survive
         # the desc() round-trip verbatim
-        body = re.sub(r"multi_slot_desc\s*\{.*\}", "", self._text,
-                      flags=re.S)
         self._extra_lines = [
             ln.strip() for ln in body.splitlines()
             if ln.strip() and not re.match(

@@ -200,10 +200,17 @@ def _prune_for_inference(program, feed_names, fetch_names):
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
-                         program_only=False):
+                         program_only=False, keep_training_ops=False):
+    """``keep_training_ops=True`` skips the inference pruning and saves
+    the FULL program (backward + optimizer ops included) — the format
+    the C++ train demo consumes, mirroring the reference's
+    train/demo flow of executing a python-saved ProgramDesc
+    (train/demo/demo_trainer.cc)."""
     main_program = main_program or framework.default_main_program()
     fetch_names = [v.name for v in target_vars]
-    pruned = _prune_for_inference(main_program, feeded_var_names, fetch_names)
+    pruned = (main_program if keep_training_ops else
+              _prune_for_inference(main_program, feeded_var_names,
+                                   fetch_names))
     os.makedirs(dirname, exist_ok=True)
     model = _serialize_program(pruned)
     model["feed_names"] = list(feeded_var_names)

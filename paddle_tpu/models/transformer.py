@@ -34,9 +34,11 @@ def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
         return layers.transpose(x, [0, 2, 1, 3])  # [B, H, T, head]
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    if attn_bias is None and not (dropout and not is_test):
-        # no mask, no attention dropout -> the flash path (pallas kernel
-        # on TPU: the T x T score matrix never hits HBM)
+    if attn_bias is None and is_test:
+        # inference with no mask -> the flash path (pallas kernel on
+        # TPU: the T x T score matrix never hits HBM). Training keeps
+        # the dense lowering: the kernel's backward is dense-recompute,
+        # so flash-in-training would pay forward twice for no memory win
         from ..layer_helper import LayerHelper
 
         helper = LayerHelper("flash_attention", input=q_in)
