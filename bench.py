@@ -175,7 +175,7 @@ def _build_bert_base(batch, seq_len, use_bf16=False):
     return main, startup, loss, M, use_bf16
 
 
-def bench_bert_base(batch=32, seq_len=128, iters=30, use_bf16=True):
+def bench_bert_base(batch=32, seq_len=128, iters=60, use_bf16=True):
     import paddle_tpu as fluid
 
     main, startup, loss, M, use_bf16 = _build_bert_base(batch, seq_len,
@@ -224,7 +224,7 @@ def _bench_subprocess(name, use_bf16):
     args = [sys.executable, __file__, "--model=" + name]
     if not use_bf16:
         args.append("--no-bf16")
-    timeout = {"resnet50": 360, "bert_base": 200}.get(name, 60)
+    timeout = {"resnet50": 360, "bert_base": 420}.get(name, 60)
     proc = subprocess.run(args, capture_output=True, text=True,
                           timeout=timeout)
     if proc.returncode != 0:

@@ -43,7 +43,11 @@ void fetch_py_error() {
   PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
   PyErr_Fetch(&type, &value, &tb);
   PyObject *s = value ? PyObject_Str(value) : nullptr;
-  set_error(s ? PyUnicode_AsUTF8(s) : "unknown python error");
+  const char *msg = s ? PyUnicode_AsUTF8(s) : nullptr;
+  set_error(msg ? msg : "unknown python error");
+  // PyUnicode_AsUTF8 can itself raise (unencodable str()); never leave
+  // an exception pending past this point
+  PyErr_Clear();
   Py_XDECREF(s);
   Py_XDECREF(type);
   Py_XDECREF(value);
@@ -70,7 +74,14 @@ struct PD_Predictor {
   PyObject *predictor;  // paddle_tpu.inference.Predictor
 };
 
-const char *PD_GetLastError() { return g_last_error.c_str(); }
+const char *PD_GetLastError() {
+  // copy under the same mutex the writers hold; a thread-local buffer
+  // keeps the returned pointer stable for the calling thread
+  static thread_local std::string tl_error;
+  std::lock_guard<std::mutex> lk(g_mu);
+  tl_error = g_last_error;
+  return tl_error.c_str();
+}
 
 PD_Predictor *PD_NewPredictor(const char *model_dir) {
   std::lock_guard<std::mutex> lk(g_mu);

@@ -1,0 +1,2 @@
+from .extend_optimizer_with_weight_decay import (  # noqa: F401
+    DecoupledWeightDecay, extend_with_decoupled_weight_decay)

@@ -1,0 +1,72 @@
+"""Decoupled weight decay optimizer extension.
+
+Parity: /root/reference/python/paddle/fluid/contrib/extend_optimizer/
+extend_optimizer_with_weight_decay.py (:20 DecoupledWeightDecay mixin,
+:102 extend_with_decoupled_weight_decay). AdamW-style: the decay term
+``param -= coeff * param`` applies OUTSIDE the gradient (scaled ops
+appended after the base optimizer update), not folded into it like L2
+regularization would be.
+"""
+from __future__ import annotations
+
+from ... import framework
+
+
+class DecoupledWeightDecay:
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None):
+        if not isinstance(coeff, (float, int)):
+            raise TypeError("coeff should be float or int")
+        self._coeff = float(coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def apply_gradients(self, params_grads):
+        optimize_ops = super().apply_gradients(params_grads)
+        if self._coeff == 0.0:
+            return optimize_ops
+        block = framework.default_main_program().current_block()
+        with framework.default_main_program()._optimized_guard():
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                if self._apply_decay_param_fun is not None and \
+                        not self._apply_decay_param_fun(p.name):
+                    continue
+                # param = param * (1 - coeff), in place
+                block.append_op(
+                    "scale", {"X": [p.name]}, {"Out": [p.name]},
+                    {"scale": 1.0 - self._coeff, "bias": 0.0,
+                     "bias_after_scale": True},
+                    infer_shape=False)
+        return optimize_ops
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Build an optimizer class with decoupled weight decay on top of
+    ``base_optimizer`` (reference :102). Usage::
+
+        AdamW = extend_with_decoupled_weight_decay(fluid.optimizer.Adam)
+        optimizer = AdamW(learning_rate=1e-3, coeff=0.01)
+    """
+    from ... import optimizer as opt_mod
+
+    if not issubclass(base_optimizer, opt_mod.Optimizer):
+        raise TypeError(
+            "base_optimizer must be a subclass of Optimizer, got %r"
+            % base_optimizer)
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, *args, coeff=0.0,
+                     apply_decay_param_fun=None, **kwargs):
+            DecoupledWeightDecay.__init__(
+                self, coeff=coeff,
+                apply_decay_param_fun=apply_decay_param_fun)
+            base_optimizer.__init__(self, *args, **kwargs)
+
+        def apply_gradients(self, params_grads):
+            return DecoupledWeightDecay.apply_gradients(
+                self, params_grads)
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        "%sWithDecoupledWeightDecay" % base_optimizer.__name__)
+    return OptimizerWithDecoupledWeightDecay

@@ -1,0 +1,45 @@
+"""Program memory estimation.
+
+Parity: /root/reference/python/paddle/fluid/contrib/memory_usage_calc.py
+(memory_usage(program, batch_size) -> (low MB, high MB)). Sums var
+sizes with the -1 batch dim substituted; the reference brackets the
+estimate with empirically derived 0.8x/1.5x factors, kept here. On TPU
+the compiled program's true footprint comes from XLA buffer assignment
+(donation, rematerialization, fusion temporaries), so this remains the
+same rough pre-compile sizing tool the reference ships.
+"""
+from __future__ import annotations
+
+from ..core import dtypes as _dt
+
+DEBUG = False
+
+_DTYPE_SIZE = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+               "int8": 1, "uint8": 1, "int16": 2, "int32": 4, "int64": 8,
+               "bool": 1}
+
+
+def memory_usage(program, batch_size):
+    """Estimate [low, high] memory use in MB for one batch."""
+    from .. import framework
+
+    if not isinstance(program, framework.Program):
+        raise TypeError("program should be a Program, got %r"
+                        % type(program))
+    if not isinstance(batch_size, int) or batch_size <= 0:
+        raise ValueError("batch_size must be a positive int")
+
+    total = 0.0
+    for var in program.global_block().vars.values():
+        shape = getattr(var, "shape", None)
+        if not shape:
+            continue
+        numel = 1
+        for s in shape:
+            numel *= batch_size if (s is None or int(s) < 0) else int(s)
+        total += numel * _DTYPE_SIZE.get(
+            _dt.convert_dtype(getattr(var, "dtype", "float32")), 4)
+        if DEBUG:
+            print(var.name, shape, numel)
+    mb = total / (1024.0 * 1024.0)
+    return mb * 0.8, mb * 1.5

@@ -1,0 +1,60 @@
+"""Model parameter / FLOP summary.
+
+Parity: /root/reference/python/paddle/fluid/contrib/model_stat.py
+(summary(program) — prints a per-layer table with params and FLOPs for
+conv/fc/pool ops and returns totals).
+"""
+from __future__ import annotations
+
+
+def summary(main_prog):
+    """Print a summary table; returns (total_params, total_flops)."""
+    total_params = 0
+    total_flops = 0
+    rows = []
+    block = main_prog.global_block()
+    for var in block.vars.values():
+        from .. import framework
+
+        if isinstance(var, framework.Parameter) and var.shape:
+            n = 1
+            for s in var.shape:
+                n *= int(s)
+            total_params += n
+    for op in block.ops:
+        flops = 0
+        if op.type in ("conv2d", "depthwise_conv2d"):
+            try:
+                f = block._find_var_recursive(op.input("Filter")[0])
+                out = block._find_var_recursive(op.output("Output")[0])
+                kn = 1
+                for s in f.shape:
+                    kn *= int(s)
+                spatial = 1
+                for s in (out.shape or ())[2:]:
+                    spatial *= int(s)
+                flops = 2 * kn * spatial
+            except Exception:
+                flops = 0
+        elif op.type in ("mul", "matmul", "fc"):
+            try:
+                slot = "Y" if op.type in ("mul", "matmul") else "W"
+                w = block._find_var_recursive(op.input(slot)[0])
+                kn = 1
+                for s in w.shape:
+                    kn *= int(s)
+                flops = 2 * kn
+            except Exception:
+                flops = 0
+        if flops:
+            rows.append((op.type, flops))
+            total_flops += flops
+    print("+%s+" % ("-" * 46))
+    print("| %-20s | %-21s |" % ("op", "FLOPs (per example)"))
+    print("+%s+" % ("-" * 46))
+    for t, f in rows:
+        print("| %-20s | %-21d |" % (t, f))
+    print("+%s+" % ("-" * 46))
+    print("Total params: %d  Total FLOPs/example: %d"
+          % (total_params, total_flops))
+    return total_params, total_flops

@@ -1,0 +1,30 @@
+"""Op frequency statistics.
+
+Parity: /root/reference/python/paddle/fluid/contrib/op_frequence.py
+(op_freq_statistic: single-op counts + adjacent-pair counts over a
+program, ordered most-frequent first).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_op_freq) OrderedDicts sorted by count."""
+    from .. import framework
+
+    if not isinstance(program, framework.Program):
+        raise TypeError("program should be a Program, got %r"
+                        % type(program))
+    uni, adj = {}, {}
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if prev is not None:
+                key = "%s->%s" % (prev, op.type)
+                adj[key] = adj.get(key, 0) + 1
+            prev = op.type
+    order = lambda d: OrderedDict(
+        sorted(d.items(), key=lambda kv: -kv[1]))
+    return order(uni), order(adj)

@@ -17,3 +17,4 @@ from . import wmt16  # noqa: F401
 from . import conll05  # noqa: F401
 from . import voc2012  # noqa: F401
 from . import sentiment  # noqa: F401
+from . import mq2007  # noqa: F401

@@ -1,0 +1,47 @@
+"""Op-registry coverage checks.
+
+Parity: /root/reference/tools/check_op_register_type.py and
+diff_use_default_grad_op_maker.py — CI-style invariants over the op
+registry. Reports: registered op count, ops without grad (forward-only
+by design or omission), host ops, and RNG ops.
+
+Usage: python -m paddle_tpu.tools.check_op_registry
+"""
+from __future__ import annotations
+
+
+def registry_report():
+    from ..core.registry import OpInfoMap
+
+    m = OpInfoMap.instance()
+    all_ops = m.all_op_types()
+    base = [t for t in all_ops if not t.endswith("_grad")]
+    grads = {t for t in all_ops if t.endswith("_grad")}
+    no_grad = [t for t in base
+               if (t + "_grad") not in grads
+               and m.get(t).grad is None]
+    host = [t for t in base if m.get(t).fn is None]
+    rng = [t for t in base if getattr(m.get(t), "needs_rng", False)]
+    return {
+        "total_ops": len(base),
+        "grad_ops": len(grads),
+        "forward_only": sorted(no_grad),
+        "host_ops": sorted(host),
+        "rng_ops": sorted(rng),
+    }
+
+
+def main():
+    rep = registry_report()
+    print("registered base ops: %d (grad ops: %d)"
+          % (rep["total_ops"], rep["grad_ops"]))
+    print("host ops (%d): %s" % (len(rep["host_ops"]),
+                                 ", ".join(rep["host_ops"])))
+    print("rng ops (%d): %s" % (len(rep["rng_ops"]),
+                                ", ".join(rep["rng_ops"])))
+    print("forward-only (%d): %s" % (len(rep["forward_only"]),
+                                     ", ".join(rep["forward_only"])))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""API-signature fingerprint dump.
+
+Parity: /root/reference/tools/print_signatures.py — walks the public
+API and prints ``module.name (args) -> hash`` lines so CI can diff the
+frozen surface against an approved snapshot.
+
+Usage: python -m paddle_tpu.tools.print_signatures [module ...]
+"""
+from __future__ import annotations
+
+import hashlib
+import importlib
+import inspect
+import sys
+
+DEFAULT_MODULES = ["paddle_tpu", "paddle_tpu.layers",
+                   "paddle_tpu.optimizer", "paddle_tpu.nn",
+                   "paddle_tpu.io", "paddle_tpu.dygraph"]
+
+
+def _signature_of(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(..)"
+
+
+def iter_api(module_name):
+    mod = importlib.import_module(module_name)
+    names = getattr(mod, "__all__", None) or [
+        n for n in dir(mod) if not n.startswith("_")]
+    for name in sorted(set(names)):
+        obj = getattr(mod, name, None)
+        if obj is None or inspect.ismodule(obj):
+            continue
+        if callable(obj):
+            sig = _signature_of(obj)
+            digest = hashlib.md5(
+                ("%s.%s%s" % (module_name, name, sig)).encode()
+            ).hexdigest()[:12]
+            yield "%s.%s %s -> %s" % (module_name, name, sig, digest)
+
+
+def main(argv=None):
+    mods = (argv or sys.argv[1:]) or DEFAULT_MODULES
+    for m in mods:
+        for line in iter_api(m):
+            print(line)
+
+
+if __name__ == "__main__":
+    main()

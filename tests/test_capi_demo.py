@@ -25,8 +25,16 @@ CSRC = os.path.join(os.path.dirname(os.path.dirname(
 
 
 def _built():
-    return all(os.path.exists(os.path.join(CSRC, n))
-               for n in ("libptcapi.so", "capi_smoke", "train_demo"))
+    arts = [os.path.join(CSRC, n)
+            for n in ("libptcapi.so", "capi_smoke", "train_demo")]
+    if not all(os.path.exists(a) for a in arts):
+        return False
+    # stale-artifact guard: rebuild when any source is newer
+    srcs = [os.path.join(CSRC, n)
+            for n in ("capi.cc", "capi_smoke.c", "train_demo.cc",
+                      "data_feed.cc")]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    return min(os.path.getmtime(a) for a in arts) >= newest_src
 
 
 @pytest.fixture(scope="module", autouse=True)

@@ -1,0 +1,72 @@
+"""contrib extras (extend_optimizer, memory_usage, op_frequence,
+model_stat), tools (print_signatures, check_op_registry), mq2007."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset
+
+
+def _net(B=8):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[B, 4], dtype="float32")
+        y = fluid.data(name="y", shape=[B, 1], dtype="float32")
+        pred = fluid.layers.fc(fluid.layers.fc(x, 16, act="relu"), 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return prog, startup, loss, x, y
+
+
+def test_decoupled_weight_decay():
+    from paddle_tpu.contrib import extend_with_decoupled_weight_decay
+
+    AdamW = extend_with_decoupled_weight_decay(fluid.optimizer.Adam)
+    B = 8
+    prog, startup, loss, x, y = _net(B)
+    with fluid.program_guard(prog, startup):
+        opt = AdamW(learning_rate=0.0, coeff=0.1)  # lr 0: pure decay
+        opt.minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        wname = prog.all_parameters()[0].name
+        w0 = np.asarray(scope.find_var(wname).raw().array).copy()
+        xb = np.random.RandomState(0).randn(B, 4).astype("float32")
+        exe.run(prog, feed={"x": xb, "y": xb[:, :1]}, fetch_list=[loss])
+        w1 = np.asarray(scope.find_var(wname).raw().array)
+    # lr=0 means Adam's update is ~0 -> params shrink by exactly (1-coeff)
+    np.testing.assert_allclose(w1, w0 * 0.9, rtol=1e-4, atol=1e-6)
+
+
+def test_memory_usage_and_stats():
+    from paddle_tpu.contrib import memory_usage, op_freq_statistic
+    from paddle_tpu.contrib.model_stat import summary
+
+    prog, _, _, _, _ = _net()
+    low, high = memory_usage(prog, batch_size=32)
+    assert 0 < low < high
+    uni, adj = op_freq_statistic(prog)
+    assert uni["mul"] >= 2
+    assert any("->" in k for k in adj)
+    params, flops = summary(prog)
+    assert params > 0 and flops > 0
+
+
+def test_tools():
+    from paddle_tpu.tools.check_op_registry import registry_report
+    from paddle_tpu.tools.print_signatures import iter_api
+
+    rep = registry_report()
+    assert rep["total_ops"] > 300
+    assert "while" in rep["host_ops"]
+    lines = list(iter_api("paddle_tpu.optimizer"))
+    assert any("Adam" in ln for ln in lines)
+
+
+def test_mq2007_contracts():
+    score, feat = next(iter(dataset.mq2007.train("pointwise")()))
+    assert feat.shape == (46,)
+    pos, neg = next(iter(dataset.mq2007.train("pairwise")()))
+    assert pos.shape == neg.shape == (46,)
+    rels, feats = next(iter(dataset.mq2007.train("listwise")()))
+    assert len(rels) == feats.shape[0]
