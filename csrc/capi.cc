@@ -119,6 +119,7 @@ int PD_PredictorRun(PD_Predictor *p, const char *input_name,
                     const float *data, const int64_t *shape, int ndims,
                     float *out, int64_t out_capacity, int64_t *out_size) {
   if (!p || !p->predictor) {
+    std::lock_guard<std::mutex> lk(g_mu);
     set_error("null predictor");
     return -1;
   }

@@ -8,3 +8,5 @@ from .memory_usage_calc import memory_usage  # noqa: F401
 from . import op_frequence  # noqa: F401
 from .op_frequence import op_freq_statistic  # noqa: F401
 from . import model_stat  # noqa: F401
+from . import layers  # noqa: F401
+from . import reader  # noqa: F401

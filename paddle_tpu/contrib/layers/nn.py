@@ -1,0 +1,118 @@
+"""contrib layer APIs.
+
+Parity: /root/reference/python/paddle/fluid/contrib/layers/nn.py —
+the tractable subset (shuffle_batch :747, partial_concat :811,
+partial_sum, multiclass_nms2 :501, fused_embedding_seq_pool :435,
+fused_elemwise_activation :39). The CTR/NLP LoD specials (var_conv_2d,
+match_matrix_tensor, search_pyramid_hash, tree_conv,
+sequence_topk_avg_pooling) are intentionally absent — calling them
+should fail loudly rather than silently diverge, and their kernels are
+16k LoC of niche reference code pending demand.
+"""
+from __future__ import annotations
+
+from ...layer_helper import LayerHelper
+
+__all__ = ["shuffle_batch", "partial_concat", "partial_sum",
+           "multiclass_nms2", "fused_embedding_seq_pool",
+           "fused_elemwise_activation"]
+
+
+def shuffle_batch(x, seed=None):
+    """Random row-shuffle of the leading dims (reference :747)."""
+    helper = LayerHelper("shuffle_batch", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    idx = helper.create_variable_for_type_inference("int64")
+    order = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "shuffle_batch", inputs={"X": [x]},
+        outputs={"Out": [out], "ShuffleIdx": [idx], "SeedOut": [order]},
+        attrs={"startup_seed": int(seed) if seed is not None else 0},
+        infer_shape=False)
+    out.shape = tuple(x.shape) if x.shape else None
+    return out
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """Concat a [start:start+length] column slice of each input
+    (reference :811)."""
+    if not isinstance(input, (list, tuple)):
+        input = [input]
+    helper = LayerHelper("partial_concat", input=input[0])
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("partial_concat", inputs={"X": list(input)},
+                     outputs={"Out": [out]},
+                     attrs={"start_index": start_index, "length": length},
+                     infer_shape=False)
+    return out
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """Sum a [start:start+length] column slice across inputs."""
+    if not isinstance(input, (list, tuple)):
+        input = [input]
+    helper = LayerHelper("partial_sum", input=input[0])
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("partial_sum", inputs={"X": list(input)},
+                     outputs={"Out": [out]},
+                     attrs={"start_index": start_index, "length": length},
+                     infer_shape=False)
+    return out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                    keep_top_k, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0, return_index=False,
+                    name=None):
+    """multiclass_nms that can also return the kept row indices
+    (reference :501)."""
+    helper = LayerHelper("multiclass_nms2", input=bboxes, name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    outputs = {"Out": [out]}
+    if return_index:
+        index = helper.create_variable_for_type_inference("int32")
+        outputs["Index"] = [index]
+    helper.append_op(
+        "multiclass_nms", inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs=outputs,
+        attrs={"background_label": background_label,
+               "score_threshold": score_threshold,
+               "nms_top_k": nms_top_k, "nms_threshold": nms_threshold,
+               "nms_eta": nms_eta, "keep_top_k": keep_top_k,
+               "normalized": normalized},
+        infer_shape=False)
+    if return_index:
+        return out, index
+    return out
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False,
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32"):
+    """Embedding lookup fused with sequence sum pooling (reference
+    :435). Composite here — XLA fuses the gather+segment-sum anyway."""
+    from ... import layers
+
+    if combiner != "sum":
+        raise NotImplementedError("only combiner='sum' is supported")
+    emb = layers.embedding(input, size=size, is_sparse=is_sparse,
+                           padding_idx=padding_idx,
+                           param_attr=param_attr, dtype=dtype)
+    return layers.sequence_pool(emb, pool_type="sum")
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """(reference :39) — over the fused op in ops/fused_ops.py."""
+    helper = LayerHelper("fused_elemwise_activation", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mid = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "fused_elemwise_activation",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out], "IntermediateOut": [mid]},
+        attrs={"functor_list": list(functor_list), "axis": axis,
+               "scale": scale,
+               "save_intermediate_out": save_intermediate_out},
+        infer_shape=False)
+    return out

@@ -9,13 +9,13 @@ from __future__ import annotations
 
 def summary(main_prog):
     """Print a summary table; returns (total_params, total_flops)."""
+    from .. import framework
+
     total_params = 0
     total_flops = 0
     rows = []
     block = main_prog.global_block()
     for var in block.vars.values():
-        from .. import framework
-
         if isinstance(var, framework.Parameter) and var.shape:
             n = 1
             for s in var.shape:

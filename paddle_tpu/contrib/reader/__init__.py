@@ -1,0 +1,1 @@
+from .distributed_reader import distributed_batch_reader  # noqa: F401

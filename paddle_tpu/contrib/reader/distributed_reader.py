@@ -1,0 +1,28 @@
+"""Multi-process batch sharding reader.
+
+Parity: /root/reference/python/paddle/fluid/contrib/reader/
+distributed_reader.py — wraps a batch reader so each trainer process
+consumes its 1/N slice, driven by PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM (the env contract paddle.distributed.launch sets).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if trainer_id >= trainers_num:
+        raise ValueError(
+            "PADDLE_TRAINER_ID (%d) must be < PADDLE_TRAINERS_NUM (%d)"
+            % (trainer_id, trainers_num))
+
+    def decorator():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers_num == trainer_id:
+                yield batch
+
+    return decorator

@@ -151,17 +151,25 @@ class CoreExecutor:
             if not names:
                 ins[slot.name] = None
                 continue
-            vals = [self._read_var(scope, n) for n in names]
-            if info.needs_lod:
-                lods = []
-                for n in names:
-                    v = scope.find_var(n)
-                    t = v.raw() if v else None
-                    lods.append(
-                        tuple(tuple(l) for l in t.lod())
-                        if isinstance(t, LoDTensor)
-                        else ()
-                    )
+            # one scope lookup per name: value AND LoD come off the same
+            # handle. LoD is collected for EVERY op, not just needs_lod
+            # consumers — infer_lod="propagate" must carry LoD through
+            # intermediate ops (embedding between a feed and
+            # sequence_pool)
+            vals, lods = [], []
+            for n in names:
+                var = (scope.find_var(n)
+                       if n not in ("", "@EMPTY@") else None)
+                h = (var.raw()
+                     if var is not None and var.is_initialized() else None)
+                if isinstance(h, LoDTensor):
+                    vals.append(h.array)
+                    lods.append(tuple(tuple(l) for l in h.lod())
+                                if h.lod() else ())
+                else:
+                    vals.append(h)
+                    lods.append(())
+            if any(lods):
                 in_lods[slot.name] = tuple(lods)
             ins[slot.name] = vals if slot.duplicable else vals[0]
 

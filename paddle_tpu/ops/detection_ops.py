@@ -473,7 +473,7 @@ def _nms_single_class(boxes, scores, thresh, nms_top_k, iou_thresh, eta,
 @register_host_op(
     "multiclass_nms",
     inputs=[In("BBoxes", no_grad=True), In("Scores", no_grad=True)],
-    outputs=[Out("Out")],
+    outputs=[Out("Out"), Out("Index", dispensable=True)],
     attrs={"background_label": 0, "score_threshold": 0.0, "nms_top_k": -1,
            "nms_threshold": 0.3, "nms_eta": 1.0, "keep_top_k": -1,
            "normalized": True},
@@ -489,7 +489,7 @@ def _multiclass_nms(executor, op, scope):
     a = op.attrs
     n, nbox = bboxes.shape[0], bboxes.shape[1]
     nclass = scores.shape[1]
-    all_rows = []
+    all_rows, all_idx = [], []
     lod = [0]
     for b in range(n):
         dets = []
@@ -502,22 +502,38 @@ def _multiclass_nms(executor, op, scope):
                 a.get("nms_top_k", -1), a.get("nms_threshold", 0.3),
                 a.get("nms_eta", 1.0), a.get("normalized", True))
             for i in sel:
-                dets.append([float(c), float(scores[b, c, i])]
-                            + [float(v) for v in cls_boxes[i]])
+                dets.append(([float(c), float(scores[b, c, i])]
+                             + [float(v) for v in cls_boxes[i]],
+                             b * nbox + int(i)))
         keep_top_k = a.get("keep_top_k", -1)
         if keep_top_k > -1 and len(dets) > keep_top_k:
-            dets.sort(key=lambda r: -r[1])
+            dets.sort(key=lambda r: -r[0][1])
             dets = dets[:keep_top_k]
-        all_rows.extend(dets)
+        all_rows.extend(row for row, _i in dets)
+        all_idx.extend(i for _row, i in dets)
         lod.append(len(all_rows))
+    idx_lod = list(lod)
     if all_rows:
         out = np.asarray(all_rows, dtype=np.float32)
+        idx = np.asarray(all_idx, dtype=np.int32).reshape(-1, 1)
     else:
+        # Out keeps the reference's -1 sentinel row; Index stays EMPTY
+        # (a fabricated index would look like a real detection to any
+        # gather over the box table)
         out = np.full((1, 6), -1.0, dtype=np.float32)
+        idx = np.zeros((0, 1), dtype=np.int32)
         lod = [0, 1]
+        idx_lod = [0] * (n + 1)
     t = LoDTensor(out)
     t.set_lod([lod])
     executor._write_var(scope, op.output("Out")[0], t)
+    iouts = op.output("Index")
+    if iouts:
+        # multiclass_nms2 (contrib): kept-row indices into the
+        # flattened [N*M] box table
+        ti = LoDTensor(idx)
+        ti.set_lod([idx_lod])
+        executor._write_var(scope, iouts[0], ti)
 
 
 @register_host_op(

@@ -833,3 +833,79 @@ def _tensor_array_to_tensor(executor, op, scope):
     executor._write_var(scope, op.output("OutIndex")[0],
                         np.asarray([m.shape[axis] for m in mats],
                                    np.int32))
+
+
+@register_op("shuffle_batch",
+             inputs=[In("X")],
+             outputs=[Out("Out"), Out("ShuffleIdx", no_grad=True),
+                      Out("SeedOut", no_grad=True, dispensable=True)],
+             attrs={"startup_seed": 0}, needs_rng=True, grad=None)
+def _shuffle_batch(ins, attrs):
+    """Random shuffle of rows over all leading dims (reference
+    contrib shuffle_batch_op.cc); last dim kept intact. startup_seed
+    folds into the per-step stream (it seeds the engine, it does NOT
+    freeze the permutation — each step still draws a fresh shuffle,
+    matching the reference's evolving seed)."""
+    from ..core.registry import RNG_SEED_ATTR
+
+    x = ins["X"]
+    lead = 1
+    for s in x.shape[:-1]:
+        lead *= s
+    flat = x.reshape(lead, x.shape[-1])
+    key = jax.random.fold_in(jax.random.PRNGKey(ins[RNG_SEED_ATTR]),
+                             int(attrs.get("startup_seed", 0)))
+    perm = jax.random.permutation(key, lead)
+    return {"Out": flat[perm].reshape(x.shape),
+            "ShuffleIdx": perm.astype(jnp.int64),
+            "SeedOut": jnp.zeros((1,), jnp.int64)}
+
+
+@register_op("shuffle_batch_grad",
+             inputs=[In("ShuffleIdx", no_grad=True),
+                     In("Out@GRAD", no_grad=True)],
+             outputs=[Out("X@GRAD", no_grad=True)],
+             attrs={"startup_seed": 0}, grad=None)
+def _shuffle_batch_grad(ins, attrs):
+    """Un-permute the gradient (reference shuffle_batch_op.cc grad:
+    dX[perm[i]] = dOut[i])."""
+    dout = ins["Out@GRAD"]
+    perm = ins["ShuffleIdx"].reshape(-1).astype(jnp.int32)
+    lead = perm.shape[0]
+    flat = dout.reshape(lead, -1)
+    dx = jnp.zeros_like(flat).at[perm].set(flat)
+    return {"X@GRAD": dx.reshape(dout.shape)}
+
+
+def _partial_slice(xs, start, length):
+    outs = []
+    for x in xs:
+        s = start + x.shape[1] if start < 0 else start
+        end = x.shape[1] if length < 0 else s + length
+        outs.append(x[:, s:end])
+    return outs
+
+
+@register_op("partial_concat",
+             inputs=[In("X", duplicable=True)], outputs=[Out("Out")],
+             attrs={"start_index": 0, "length": -1})
+def _partial_concat(ins, attrs):
+    """Concat a column slice of every input (reference contrib
+    partial_concat_op.cc)."""
+    parts = _partial_slice(ins["X"], int(attrs.get("start_index", 0)),
+                           int(attrs.get("length", -1)))
+    return {"Out": jnp.concatenate(parts, axis=1)}
+
+
+@register_op("partial_sum",
+             inputs=[In("X", duplicable=True)], outputs=[Out("Out")],
+             attrs={"start_index": 0, "length": -1})
+def _partial_sum(ins, attrs):
+    """Sum a column slice across inputs (reference contrib
+    partial_sum_op.cc)."""
+    parts = _partial_slice(ins["X"], int(attrs.get("start_index", 0)),
+                           int(attrs.get("length", -1)))
+    out = parts[0]
+    for p in parts[1:]:
+        out = out + p
+    return {"Out": out}

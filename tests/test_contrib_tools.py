@@ -70,3 +70,127 @@ def test_mq2007_contracts():
     assert pos.shape == neg.shape == (46,)
     rels, feats = next(iter(dataset.mq2007.train("listwise")()))
     assert len(rels) == feats.shape[0]
+
+
+def test_contrib_layers_wave():
+    from paddle_tpu.contrib import layers as clayers
+
+    B, D = 4, 6
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[B, D], dtype="float32")
+        y = fluid.data(name="y", shape=[B, D], dtype="float32")
+        shuffled = clayers.shuffle_batch(x)
+        pc = clayers.partial_concat([x, y], start_index=1, length=2)
+        ps = clayers.partial_sum([x, y], start_index=0, length=3)
+    rng = np.random.RandomState(0)
+    xb = rng.randn(B, D).astype("float32")
+    yb = rng.randn(B, D).astype("float32")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sh, c, s = exe.run(prog, feed={"x": xb, "y": yb},
+                           fetch_list=[shuffled, pc, ps])
+    sh = np.asarray(sh)
+    # shuffle preserves the multiset of rows
+    assert sorted(map(tuple, sh.tolist())) == sorted(
+        map(tuple, xb.tolist()))
+    np.testing.assert_allclose(
+        np.asarray(c), np.concatenate([xb[:, 1:3], yb[:, 1:3]], axis=1),
+        rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s), xb[:, 0:3] + yb[:, 0:3],
+                               rtol=1e-6)
+
+
+def test_multiclass_nms2_returns_indices():
+    from paddle_tpu.contrib import layers as clayers
+
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30]]], "float32")
+    scores = np.array([[[0.0, 0.0, 0.0],       # background
+                        [0.9, 0.85, 0.6]]], "float32")  # class 1
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        bb = fluid.data(name="bb", shape=[1, 3, 4], dtype="float32")
+        sc = fluid.data(name="sc", shape=[1, 2, 3], dtype="float32")
+        out, idx = clayers.multiclass_nms2(
+            bb, sc, score_threshold=0.1, nms_top_k=10, keep_top_k=10,
+            nms_threshold=0.5, return_index=True)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, feed={"bb": boxes, "sc": scores}, fetch_list=[])
+        kept = scope.find_var(out.name).get_tensor().numpy()
+        indices = scope.find_var(idx.name).get_tensor().numpy().ravel()
+    # boxes 0 and 1 overlap -> NMS keeps 0 (higher score) and box 2
+    assert kept.shape[1] == 6
+    assert set(indices.tolist()) == {0, 2}
+
+
+def test_fused_embedding_seq_pool():
+    from paddle_tpu.contrib import layers as clayers
+
+    # LoD input: two sequences of ids, sum-pooled embeddings
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64",
+                                lod_level=1)
+        pooled = clayers.fused_embedding_seq_pool(
+            ids, size=[10, 4],
+            param_attr=fluid.ParamAttr(
+                name="fesp_w",
+                initializer=fluid.initializer.NumpyArrayInitializer(
+                    np.arange(40, dtype="float32").reshape(10, 4))))
+    from paddle_tpu.core.tensor import LoDTensor
+
+    t = LoDTensor()
+    t.set(np.array([[1], [2], [3], [4], [5]], "int64"))
+    t.set_lod([[0, 2, 5]])
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (o,) = exe.run(prog, feed={"ids": t}, fetch_list=[pooled])
+    W = np.arange(40, dtype="float32").reshape(10, 4)
+    ref = np.stack([W[1] + W[2], W[3] + W[4] + W[5]])
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-6)
+
+
+def test_shuffle_batch_grads_and_fresh_permutations():
+    from paddle_tpu.contrib import layers as clayers
+
+    B, D = 8, 4
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="x", shape=[B, D], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        sh = clayers.shuffle_batch(h, seed=5)
+        loss = fluid.layers.mean(fluid.layers.square(sh))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        wname = prog.all_parameters()[0].name
+        w0 = np.asarray(scope.find_var(wname).raw().array).copy()
+        xb = np.random.RandomState(0).randn(B, D).astype("float32")
+        exe.run(prog, feed={"x": xb}, fetch_list=[loss])
+        w1 = np.asarray(scope.find_var(wname).raw().array)
+        # grads flow through the shuffle (un-permutation grad op)
+        assert not np.allclose(w0, w1)
+        # fresh permutation each step even with a fixed startup seed
+        i1 = np.asarray(scope.find_var(sh.name.replace(
+            ".tmp_0", ".tmp_1")).raw().array) if False else None
+    # permutation freshness: run the op twice in one program
+    prog2, _ = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog2, fluid.Program()):
+        x = fluid.data(name="x", shape=[B, D], dtype="float32")
+        s1 = clayers.shuffle_batch(x, seed=5)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        xb = np.arange(B * D, dtype="float32").reshape(B, D)
+        (a,) = exe.run(prog2, feed={"x": xb}, fetch_list=[s1])
+        (b,) = exe.run(prog2, feed={"x": xb}, fetch_list=[s1])
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
