@@ -22,8 +22,8 @@ def shuffle_batch(x, seed=None):
     """Random row-shuffle of the leading dims (reference :747)."""
     helper = LayerHelper("shuffle_batch", input=x)
     out = helper.create_variable_for_type_inference(x.dtype)
-    idx = helper.create_variable_for_type_inference("int64")
-    order = helper.create_variable_for_type_inference("int64")
+    idx = helper.create_variable_for_type_inference("int32")
+    order = helper.create_variable_for_type_inference("int32")
     helper.append_op(
         "shuffle_batch", inputs={"X": [x]},
         outputs={"Out": [out], "ShuffleIdx": [idx], "SeedOut": [order]},

@@ -856,9 +856,11 @@ def _shuffle_batch(ins, attrs):
     key = jax.random.fold_in(jax.random.PRNGKey(ins[RNG_SEED_ATTR]),
                              int(attrs.get("startup_seed", 0)))
     perm = jax.random.permutation(key, lead)
+    # int32: jax's default int width here (int64 would truncate with a
+    # warning unless x64 is enabled)
     return {"Out": flat[perm].reshape(x.shape),
-            "ShuffleIdx": perm.astype(jnp.int64),
-            "SeedOut": jnp.zeros((1,), jnp.int64)}
+            "ShuffleIdx": perm.astype(jnp.int32),
+            "SeedOut": jnp.zeros((1,), jnp.int32)}
 
 
 @register_op("shuffle_batch_grad",

@@ -11,3 +11,4 @@ from .engine import run_data_parallel  # noqa: F401
 from .transpiler import insert_allreduce_ops  # noqa: F401
 from .ring_attention import (  # noqa: F401
     ring_attention, sequence_parallel_attention, ulysses_attention)
+from .moe import expert_parallel_moe, moe_reference  # noqa: F401
