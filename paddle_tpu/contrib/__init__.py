@@ -10,3 +10,7 @@ from .op_frequence import op_freq_statistic  # noqa: F401
 from . import model_stat  # noqa: F401
 from . import layers  # noqa: F401
 from . import reader  # noqa: F401
+from .trainer import (  # noqa: F401
+    BeginEpochEvent, BeginStepEvent, CheckpointConfig, EndEpochEvent,
+    EndStepEvent, Trainer)
+from .inferencer import Inferencer  # noqa: F401

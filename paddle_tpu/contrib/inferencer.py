@@ -1,0 +1,46 @@
+"""Legacy high-level Inferencer API.
+
+Parity: /root/reference/python/paddle/fluid/contrib/inferencer.py —
+``Inferencer(infer_func, param_path)`` rebuilds the inference program
+from a function returning the prediction var, loads params, and
+``infer(inputs)`` runs it.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .. import framework, io
+from ..executor import Executor
+from ..core.scope import Scope
+from .trainer import check_and_get_place
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer:
+    def __init__(self, infer_func: Callable, param_path: str,
+                 place=None, parallel: bool = False):
+        self.param_path = param_path
+        self.scope = Scope()
+        self.place = check_and_get_place(place)
+        self.inference_program = framework.Program()
+        startup = framework.Program()
+        with framework.program_guard(self.inference_program, startup):
+            self.predict_var = infer_func()
+        self.exe = Executor(self.place)
+        from .. import scope_guard
+
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            io.load_persistables(self.exe, param_path,
+                                 main_program=self.inference_program)
+        self.inference_program = self.inference_program.clone(
+            for_test=True)
+
+    def infer(self, inputs: dict, return_numpy: bool = True):
+        from .. import scope_guard
+
+        with scope_guard(self.scope):
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var],
+                                return_numpy=return_numpy)

@@ -1,0 +1,178 @@
+"""Legacy high-level Trainer API.
+
+Parity: /root/reference/python/paddle/fluid/contrib/trainer.py — the
+event-driven Trainer the (deprecated) high-level book examples used:
+``Trainer(train_func, optimizer_func)`` builds the program from a
+function returning the loss, ``train(num_epochs, event_handler,
+reader, feed_order)`` loops epochs/steps firing Begin/End events, and
+``save_params``/checkpointing round-trip through io.py.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+from .. import framework, io
+from ..executor import Executor
+from ..core.place import CPUPlace
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "CheckpointConfig", "Trainer"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            ".", "checkpoints")
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(int(epoch_interval), 1)
+        self.step_interval = max(int(step_interval), 1)
+
+
+def check_and_get_place(place):
+    if place is not None:
+        return place
+    return CPUPlace()
+
+
+class Trainer:
+    """(reference contrib/trainer.py:169)."""
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 param_path: Optional[str] = None, place=None,
+                 parallel: bool = False,
+                 checkpoint_config: Optional[CheckpointConfig] = None):
+        self.place = check_and_get_place(place)
+        self.parallel = parallel
+        self.checkpoint_cfg = checkpoint_config
+        from ..core.scope import Scope
+
+        self.scope = Scope()
+        self._saved_checkpoints = []
+        self.train_program = framework.Program()
+        self.startup_program = framework.Program()
+        with framework.program_guard(self.train_program,
+                                     self.startup_program):
+            outs = train_func()
+            if isinstance(outs, (list, tuple)):
+                self.train_func_outputs = list(outs)
+            else:
+                self.train_func_outputs = [outs]
+            self.loss = self.train_func_outputs[0]
+            optimizer = optimizer_func()
+            optimizer.minimize(self.loss)
+        self.exe = Executor(self.place)
+        from .. import scope_guard
+
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            if param_path:
+                io.load_persistables(self.exe, param_path,
+                                     main_program=self.train_program)
+
+    def stop(self):
+        self.__stopped = True
+
+    def train(self, num_epochs: int, event_handler: Callable,
+              reader: Callable, feed_order: List[str]):
+        from .. import scope_guard
+
+        self.__stopped = False
+        with scope_guard(self.scope):
+            for epoch_id in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stopped:
+                        return
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    feed = dict(zip(feed_order, data))
+                    if begin.fetch_metrics:
+                        metrics = self.exe.run(
+                            self.train_program, feed=feed,
+                            fetch_list=self.train_func_outputs)
+                    else:
+                        self.exe.run(self.train_program, feed=feed)
+                        metrics = []
+                    event_handler(EndStepEvent(epoch_id, step_id,
+                                               metrics))
+                    if self.checkpoint_cfg and \
+                            epoch_id % self.checkpoint_cfg.epoch_interval \
+                            == 0 and \
+                            step_id % self.checkpoint_cfg.step_interval \
+                            == 0:
+                        self._save_checkpoint(epoch_id, step_id)
+                event_handler(EndEpochEvent(epoch_id))
+
+    def test(self, reader: Callable, feed_order: List[str]):
+        """Mean metrics over the reader on the for_test program clone."""
+        import numpy as np
+
+        from .. import scope_guard
+
+        test_prog = self.train_program.clone(for_test=True)
+        sums, count = None, 0
+        with scope_guard(self.scope):
+            for data in reader():
+                feed = dict(zip(feed_order, data))
+                vals = self.exe.run(test_prog, feed=feed,
+                                    fetch_list=self.train_func_outputs)
+                vals = [float(np.asarray(v).mean()) for v in vals]
+                sums = (vals if sums is None
+                        else [a + b for a, b in zip(sums, vals)])
+                count += 1
+        return [s / max(count, 1) for s in (sums or [])]
+
+    def save_params(self, param_path: str):
+        from .. import scope_guard
+
+        with scope_guard(self.scope):
+            io.save_persistables(self.exe, param_path,
+                                 main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        from .. import scope_guard
+
+        with scope_guard(self.scope):
+            io.save_inference_model(
+                param_path, feeded_var_names,
+                [self.train_func_outputs[i] for i in target_var_indexes],
+                self.exe, main_program=self.train_program)
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        import shutil
+
+        d = os.path.join(self.checkpoint_cfg.checkpoint_dir,
+                         "epoch_%d_step_%d" % (epoch_id, step_id))
+        self.save_params(d)
+        self._saved_checkpoints.append(d)
+        while len(self._saved_checkpoints) > \
+                self.checkpoint_cfg.max_num_checkpoints:
+            old = self._saved_checkpoints.pop(0)
+            shutil.rmtree(old, ignore_errors=True)

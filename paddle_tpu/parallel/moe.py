@@ -106,6 +106,11 @@ def moe_reference(x, gate_w, w_in_full, w_out_full,
 
     T, D = x.shape
     e_total = w_in_full.shape[0]
+    if T % axis_size:
+        raise ValueError(
+            "moe_reference: token count %d must divide by axis_size %d "
+            "(the sharded run it mirrors requires equal shards)"
+            % (T, axis_size))
     t_local = T // axis_size
     outs = []
     for s in range(axis_size):

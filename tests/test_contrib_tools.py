@@ -191,3 +191,57 @@ def test_shuffle_batch_grads_and_fresh_permutations():
         (a,) = exe.run(prog2, feed={"x": xb}, fetch_list=[s1])
         (b,) = exe.run(prog2, feed={"x": xb}, fetch_list=[s1])
     assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_trainer_inferencer_high_level_api(tmp_path):
+    from paddle_tpu.contrib import EndStepEvent, Inferencer, Trainer
+
+    B = 8
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 1).astype("float32")
+
+    def train_func():
+        x = fluid.data(name="x", shape=[B, 4], dtype="float32")
+        y = fluid.data(name="y", shape=[B, 1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1,
+                               param_attr=fluid.ParamAttr(name="hl_w"),
+                               bias_attr=False)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+
+    def optimizer_func():
+        return fluid.optimizer.SGD(0.1)
+
+    def reader():
+        r = np.random.RandomState(1)
+        for _ in range(30):
+            xb = r.randn(B, 4).astype("float32")
+            yield xb, xb @ W
+
+    seen = []
+
+    def handler(event):
+        if isinstance(event, EndStepEvent):
+            seen.append(float(np.asarray(event.metrics[0]).ravel()[0]))
+
+    trainer = Trainer(train_func, optimizer_func)
+    trainer.train(num_epochs=2, event_handler=handler, reader=reader,
+                  feed_order=["x", "y"])
+    assert seen[-1] < seen[0] * 0.3, (seen[0], seen[-1])
+    test_metrics = trainer.test(reader, ["x", "y"])
+    assert test_metrics[0] < seen[0]
+    d = str(tmp_path / "hl_params")
+    trainer.save_params(d)
+
+    def infer_func():
+        x = fluid.data(name="x", shape=[B, 4], dtype="float32")
+        return fluid.layers.fc(x, size=1,
+                               param_attr=fluid.ParamAttr(name="hl_w"),
+                               bias_attr=False)
+
+    inf = Inferencer(infer_func, d)
+    xb = np.random.RandomState(2).randn(B, 4).astype("float32")
+    (pred,) = inf.infer({"x": xb})
+    # prediction must use the trained weights: close to xb @ W
+    err = np.abs(np.asarray(pred) - xb @ W).max()
+    assert err < 0.5, err
