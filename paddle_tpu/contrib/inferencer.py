@@ -20,6 +20,10 @@ __all__ = ["Inferencer"]
 class Inferencer:
     def __init__(self, infer_func: Callable, param_path: str,
                  place=None, parallel: bool = False):
+        if parallel:
+            raise NotImplementedError(
+                "Inferencer(parallel=True) is not supported; the "
+                "compiled predictor already uses the full device")
         self.param_path = param_path
         self.scope = Scope()
         self.place = check_and_get_place(place)

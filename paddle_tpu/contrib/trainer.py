@@ -14,7 +14,6 @@ from typing import Callable, List, Optional
 
 from .. import framework, io
 from ..executor import Executor
-from ..core.place import CPUPlace
 
 __all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
            "EndStepEvent", "CheckpointConfig", "Trainer"]
@@ -55,9 +54,13 @@ class CheckpointConfig:
 
 
 def check_and_get_place(place):
+    """Default to the accelerator when one is visible (reference
+    check_and_get_place picks CUDAPlace when compiled with CUDA)."""
     if place is not None:
         return place
-    return CPUPlace()
+    from ..core.place import _current_expected_place_default
+
+    return _current_expected_place_default()
 
 
 class Trainer:
@@ -67,8 +70,12 @@ class Trainer:
                  param_path: Optional[str] = None, place=None,
                  parallel: bool = False,
                  checkpoint_config: Optional[CheckpointConfig] = None):
+        if parallel:
+            raise NotImplementedError(
+                "Trainer(parallel=True) is not supported; use "
+                "CompiledProgram(...).with_data_parallel for mesh "
+                "data parallelism")
         self.place = check_and_get_place(place)
-        self.parallel = parallel
         self.checkpoint_cfg = checkpoint_config
         from ..core.scope import Scope
 

@@ -183,3 +183,115 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     if isinstance(true_out, (list, tuple)):
         return [merge(t, f) for t, f in zip(true_out, false_out)]
     return merge(true_out, false_out)
+
+
+class IfElse:
+    """Row-partitioned branching (reference layers/control_flow.py:2410):
+    ``input(x)`` splits x's rows by the [N, 1] bool cond, each branch
+    computes on its subset, ``output()`` collects, and calling the
+    object merges rows back in original order. Like the reference, if
+    only ONE branch produced outputs, the raw (unmerged) subset vars of
+    that branch are returned.
+
+    TPU-native note: both branches' ops execute unconditionally on
+    their (possibly empty) row subsets — dynamic row counts make this a
+    host-interpreted construct, exactly like the reference's
+    split_lod_tensor / merge_lod_tensor machinery. For scalar
+    conditions prefer ``cond()`` which compiles to lax.cond.
+    """
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        from ..layer_helper import LayerHelper
+
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self.input_table = {}
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        self.output_table = ([], [])  # (false_outs, true_outs)
+
+    class _Guard:
+        def __init__(self, ie, is_true):
+            self.ie = ie
+            self.status = (IfElse.IN_IF_ELSE_TRUE_BLOCKS if is_true
+                           else IfElse.IN_IF_ELSE_FALSE_BLOCKS)
+
+        def __enter__(self):
+            self.ie.status = self.status
+
+        def __exit__(self, *exc):
+            self.ie.status = IfElse.OUT_IF_ELSE_BLOCKS
+            return False
+
+    def true_block(self):
+        return IfElse._Guard(self, True)
+
+    def false_block(self):
+        return IfElse._Guard(self, False)
+
+    def input(self, x):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("input() must be called inside "
+                             "true_block()/false_block()")
+        block = self.helper.main_program.current_block()
+        if id(x) not in self.input_table:
+            out_true = block.create_var(
+                name=framework.unique_name.generate("ifelse_in_t"),
+                dtype=x.dtype)
+            out_false = block.create_var(
+                name=framework.unique_name.generate("ifelse_in_f"),
+                dtype=x.dtype)
+            # dynamic row counts: static shape metadata keeps the full
+            # [N, ...] upper bound (like the reference's -1 descs)
+            out_true.shape = tuple(x.shape) if x.shape else None
+            out_false.shape = tuple(x.shape) if x.shape else None
+            block.append_op(
+                "split_lod_tensor",
+                inputs={"X": [x], "Mask": [self.cond]},
+                outputs={"OutTrue": [out_true], "OutFalse": [out_false]},
+                attrs={"level": 0}, infer_shape=False)
+            self.input_table[id(x)] = (out_true, out_false)
+        out_true, out_false = self.input_table[id(x)]
+        return (out_true
+                if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS
+                else out_false)
+
+    def output(self, *outs):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("output() must be called inside a block")
+        table = self.output_table[
+            1 if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS else 0]
+        table.extend(outs)
+
+    def __call__(self):
+        if self.status != IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("call IfElse() outside the blocks")
+        false_outs, true_outs = self.output_table
+        if not false_outs and not true_outs:
+            raise ValueError("invoke true_block/false_block first")
+        if not false_outs or not true_outs:
+            return list(true_outs or false_outs)
+        if len(false_outs) != len(true_outs):
+            raise ValueError("both branches must output the same number "
+                             "of variables")
+        block = self.helper.main_program.current_block()
+        merged = []
+        for t, f in zip(true_outs, false_outs):
+            out = block.create_var(
+                name=framework.unique_name.generate("ifelse_out"),
+                dtype=t.dtype)
+            out.shape = tuple(t.shape) if t.shape else None
+            block.append_op(
+                "merge_lod_tensor",
+                inputs={"InTrue": [t], "InFalse": [f],
+                        "Mask": [self.cond]},
+                outputs={"Out": [out]},
+                attrs={"level": 0}, infer_shape=False)
+            merged.append(out)
+        return merged
+
+
+__all__ += ["IfElse"]

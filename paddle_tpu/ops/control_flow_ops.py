@@ -186,3 +186,103 @@ def _select_output(executor, op, scope):
     m = int(np.asarray(executor._read_var(scope, op.input("Mask")[0])).reshape(()))
     executor._write_var(scope, op.output("Out")[m],
                         executor._read_var(scope, op.input("X")[0]))
+
+
+def _bind_partial_grad(block, pending, var_name):
+    """Allocate a partial-grad name for var_name with the backward
+    pending/finalize discipline (mirrors backward.py's generic path)."""
+    from .. import framework
+    from ..backward import _ensure_grad_var
+
+    if var_name in pending and pending[var_name]:
+        gname = "%s@GRAD@RENAME@%d" % (var_name, len(pending[var_name]))
+    else:
+        gname = framework.grad_var_name(var_name)
+    _ensure_grad_var(block, var_name, gname)
+    pending.setdefault(var_name, []).append(gname)
+    return gname
+
+
+def _split_lod_tensor_grad_maker(block, op, pending, finalize):
+    """dX = merge(dOutTrue, dOutFalse, mask) — the ops are each other's
+    adjoints (reference split_lod_tensor grad)."""
+    g_true = finalize(op.output("OutTrue")[0])
+    g_false = finalize(op.output("OutFalse")[0])
+    if g_true is None and g_false is None:
+        return
+    from .. import framework
+
+    def zeros_like(src_name):
+        zname = framework.unique_name.generate(src_name + "@GRAD@ZERO")
+        block.create_var(name=zname, dtype="float32")
+        block.append_op("fill_zeros_like", {"X": [src_name]},
+                        {"Out": [zname]}, {}, infer_shape=False)
+        return zname
+
+    if g_true is None:
+        g_true = zeros_like(op.output("OutTrue")[0])
+    if g_false is None:
+        g_false = zeros_like(op.output("OutFalse")[0])
+    gname = _bind_partial_grad(block, pending, op.input("X")[0])
+    block.append_op(
+        "merge_lod_tensor",
+        {"InTrue": [g_true], "InFalse": [g_false],
+         "Mask": [op.input("Mask")[0]]},
+        {"Out": [gname]}, {"level": op.attrs.get("level", 0)},
+        infer_shape=False)
+
+
+def _merge_lod_tensor_grad_maker(block, op, pending, finalize):
+    """dInTrue, dInFalse = split(dOut, mask)."""
+    g_out = finalize(op.output("Out")[0])
+    if g_out is None:
+        return
+    g_true = _bind_partial_grad(block, pending, op.input("InTrue")[0])
+    g_false = _bind_partial_grad(block, pending, op.input("InFalse")[0])
+    block.append_op(
+        "split_lod_tensor",
+        {"X": [g_out], "Mask": [op.input("Mask")[0]]},
+        {"OutTrue": [g_true], "OutFalse": [g_false]},
+        {"level": op.attrs.get("level", 0)}, infer_shape=False)
+
+
+@register_host_op(
+    "split_lod_tensor",
+    inputs=[In("X"), In("Mask", no_grad=True)],
+    outputs=[Out("OutTrue"), Out("OutFalse")],
+    attrs={"level": 0},
+    grad=_split_lod_tensor_grad_maker,
+)
+def _split_lod_tensor(executor, op, scope):
+    """Row-partition X by a [N, 1] bool mask (reference
+    split_lod_tensor_op.cc, level 0)."""
+    x = np.asarray(executor._read_var(scope, op.input("X")[0]))
+    mask = np.asarray(executor._read_var(scope, op.input("Mask")[0]))
+    mask = mask.reshape(-1).astype(bool)
+    executor._write_var(scope, op.output("OutTrue")[0], x[mask])
+    executor._write_var(scope, op.output("OutFalse")[0], x[~mask])
+
+
+@register_host_op(
+    "merge_lod_tensor",
+    inputs=[In("InTrue"), In("InFalse"), In("Mask", no_grad=True),
+            In("X", dispensable=True, no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"level": 0},
+    grad=_merge_lod_tensor_grad_maker,
+)
+def _merge_lod_tensor(executor, op, scope):
+    """Inverse of split_lod_tensor: scatter the true/false row sets back
+    to mask order (reference merge_lod_tensor_op.cc, level 0)."""
+    t = np.asarray(executor._read_var(scope, op.input("InTrue")[0]))
+    f = np.asarray(executor._read_var(scope, op.input("InFalse")[0]))
+    mask = np.asarray(executor._read_var(scope, op.input("Mask")[0]))
+    mask = mask.reshape(-1).astype(bool)
+    n = mask.shape[0]
+    trailing = t.shape[1:] if t.size else f.shape[1:]
+    out = np.zeros((n,) + tuple(trailing), dtype=(t if t.size else f).dtype)
+    if t.size:
+        out[mask] = t
+    if f.size:
+        out[~mask] = f
+    executor._write_var(scope, op.output("Out")[0], out)

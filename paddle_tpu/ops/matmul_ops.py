@@ -17,7 +17,10 @@ from ..core.registry import In, Out, register_op
 
 def _flat2d(x, num_col_dims):
     lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims > 0 else 1
-    return x.reshape(lead, -1)
+    # explicit trailing size: reshape(-1) divides by `lead`, which is 0
+    # for zero-row subsets (IfElse branches on empty masks)
+    trail = int(np.prod(x.shape[num_col_dims:]))
+    return x.reshape(lead, trail)
 
 
 @register_op(
@@ -32,7 +35,7 @@ def _mul(ins, attrs):
     xd = attrs.get("x_num_col_dims", 1)
     yd = attrs.get("y_num_col_dims", 1)
     x2 = _flat2d(x, xd)
-    y2 = y.reshape(int(np.prod(y.shape[:yd])), -1)
+    y2 = _flat2d(y, yd)
     out = jnp.matmul(x2, y2)
     out_shape = x.shape[:xd] + y.shape[yd:]
     return {"Out": out.reshape(out_shape)}

@@ -597,3 +597,96 @@ class TestDataNormTraining:
             np.testing.assert_allclose(after[sqn],
                                        (xb ** 2).sum(0) + 32 * 1e-4,
                                        rtol=1e-4)
+
+
+class TestIfElse:
+    def test_reference_docstring_example(self):
+        """Exact fixture from the reference IfElse docstring
+        (control_flow.py:2420): x>y rows get -10, others +10."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.data(name="x", shape=[4, 1], dtype="float32")
+            y = fluid.data(name="y", shape=[4, 1], dtype="float32")
+            cond = fluid.layers.greater_than(x, y)
+            ie = fluid.layers.IfElse(cond)
+            with ie.true_block():
+                out_1 = ie.input(x)
+                ie.output(out_1 - 10)
+            with ie.false_block():
+                out_1 = ie.input(x)
+                ie.output(out_1 + 10)
+            output = ie()
+            total = fluid.layers.reduce_sum(output[0])
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            r0, r1 = exe.run(
+                prog,
+                feed={"x": np.array([[3], [1], [-2], [-3]], "float32"),
+                      "y": np.zeros((4, 1), "float32")},
+                fetch_list=[output[0], total])
+        np.testing.assert_allclose(np.asarray(r0).ravel(),
+                                   [-7, -9, 8, 7])
+        np.testing.assert_allclose(np.asarray(r1).ravel(), [-1.0])
+
+    def test_one_sided_mask(self):
+        """All rows on one side: the empty branch still runs (zero-row
+        arrays) and the merge restores order."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.data(name="x", shape=[3, 1], dtype="float32")
+            y = fluid.data(name="y", shape=[3, 1], dtype="float32")
+            cond = fluid.layers.greater_than(x, y)
+            ie = fluid.layers.IfElse(cond)
+            with ie.true_block():
+                ie.output(ie.input(x) * 2)
+            with ie.false_block():
+                ie.output(ie.input(x) * 3)
+            (out,) = ie()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (r,) = exe.run(
+                prog,
+                feed={"x": np.array([[1], [2], [3]], "float32"),
+                      "y": np.full((3, 1), 10.0, "float32")},
+                fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(r).ravel(), [3, 6, 9])
+
+    def test_ifelse_is_differentiable(self):
+        """Gradients flow through split/merge (their adjoints are each
+        other); a parameter used inside a branch must train."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.data(name="x", shape=[4, 2], dtype="float32")
+            y = fluid.data(name="y", shape=[4, 1], dtype="float32")
+            cond = fluid.layers.greater_than(
+                fluid.layers.reduce_sum(x, dim=1, keep_dim=True), y)
+            ie = fluid.layers.IfElse(cond)
+            with ie.true_block():
+                ie.output(fluid.layers.fc(
+                    ie.input(x), size=1,
+                    param_attr=fluid.ParamAttr(name="ie_w"),
+                    bias_attr=False))
+            with ie.false_block():
+                ie.output(fluid.layers.fc(
+                    ie.input(x), size=1,
+                    param_attr=fluid.ParamAttr(name="ie_w"),
+                    bias_attr=False))
+            (out,) = ie()
+            loss = fluid.layers.mean(fluid.layers.square(out))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            w0 = np.asarray(scope.find_var("ie_w").raw().array).copy()
+            exe.run(prog,
+                    feed={"x": np.random.RandomState(0).randn(
+                        4, 2).astype("float32"),
+                        "y": np.zeros((4, 1), "float32")},
+                    fetch_list=[loss])
+            w1 = np.asarray(scope.find_var("ie_w").raw().array)
+        assert not np.allclose(w0, w1)
