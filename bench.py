@@ -224,7 +224,7 @@ def _bench_subprocess(name, use_bf16):
     args = [sys.executable, __file__, "--model=" + name]
     if not use_bf16:
         args.append("--no-bf16")
-    timeout = {"resnet50": 360, "bert_base": 420}.get(name, 60)
+    timeout = {"resnet50": 360, "bert_base": 600}.get(name, 60)
     proc = subprocess.run(args, capture_output=True, text=True,
                           timeout=timeout)
     if proc.returncode != 0:
