@@ -31,3 +31,4 @@ from . import beam_search_ops  # noqa: F401
 from . import nce_ops  # noqa: F401
 from . import proposal_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import tail_ops  # noqa: F401

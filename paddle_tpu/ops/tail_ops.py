@@ -1,0 +1,422 @@
+"""Registry-tail ops: the remaining reference op types with direct TPU
+lowerings.
+
+Parity targets (/root/reference/paddle/fluid/operators/): squeeze_op.cc
+(v1, no XShape), unsqueeze_op.cc, minus_op.cc, l1_norm_op.cc,
+label_smooth_op.cc, pad_constant_like_op.cc, crop_tensor_op.cc,
+conv_shift_op.cc, cvm_op.cc, interpolate_op.cc (the v1 op names
+bilinear_interp/nearest_interp + trilinear_interp),
+pool_with_index_op.cc, unpool_op.cc, save_op.cc / load_op.cc /
+save_combine_op.cc / load_combine_op.cc, c_comm_init_all_op.cc, coalesce_tensor_op.cc.
+
+Intentionally absent (n/a under XLA or niche engines): the x86 fusion_*
+family, mkldnn quantize/requantize, ngraph/tensorrt/lite engine ops,
+BoxPS pull/push, pslib distributed_lookup_table.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op, register_op
+
+
+# -- shape ops (v1: no XShape output) ---------------------------------------
+
+
+@register_op("squeeze", inputs=[In("X")], outputs=[Out("Out")],
+             attrs={"axes": []})
+def _squeeze(ins, attrs):
+    x = ins["X"]
+    axes = [int(a) for a in attrs.get("axes", [])]
+    if not axes:
+        axes = [i for i, s in enumerate(x.shape) if s == 1]
+    axes = [a + x.ndim if a < 0 else a for a in axes]
+    shape = [s for i, s in enumerate(x.shape) if i not in axes or s != 1]
+    return {"Out": x.reshape(shape)}
+
+
+@register_op("unsqueeze", inputs=[In("X")], outputs=[Out("Out")],
+             attrs={"axes": []})
+def _unsqueeze(ins, attrs):
+    """Axes apply IN GIVEN ORDER on the growing rank (unsqueeze_op.cc
+    :86) — [2, 0] on (2,3) gives (1,2,3,1), not (1,2,1,3)."""
+    x = ins["X"]
+    shape = list(x.shape)
+    for a in (int(a) for a in attrs.get("axes", [])):
+        pos = a + len(shape) + 1 if a < 0 else a
+        pos = max(0, min(pos, len(shape)))
+        shape.insert(pos, 1)
+    return {"Out": x.reshape(shape)}
+
+
+# -- small math -------------------------------------------------------------
+
+
+@register_op("minus", inputs=[In("X"), In("Y")], outputs=[Out("Out")])
+def _minus(ins, attrs):
+    return {"Out": ins["X"] - ins["Y"]}
+
+
+@register_op("l1_norm", inputs=[In("X")], outputs=[Out("Out")])
+def _l1_norm(ins, attrs):
+    return {"Out": jnp.abs(ins["X"]).sum().reshape(1)}
+
+
+@register_op("label_smooth",
+             inputs=[In("X"), In("PriorDist", dispensable=True,
+                                 no_grad=True)],
+             outputs=[Out("Out")], attrs={"epsilon": 0.0})
+def _label_smooth(ins, attrs):
+    """(1-eps)*label + eps*prior (uniform 1/K default)."""
+    x = ins["X"]
+    eps = attrs.get("epsilon", 0.0)
+    prior = ins.get("PriorDist")
+    if prior is None:
+        smooth = eps / x.shape[-1]
+        return {"Out": (1.0 - eps) * x + smooth}
+    return {"Out": (1.0 - eps) * x + eps * prior.reshape(
+        (1,) * (x.ndim - 1) + (-1,))}
+
+
+@register_op("pad_constant_like", inputs=[In("X", no_grad=True), In("Y")],
+             outputs=[Out("Out")], attrs={"pad_value": 0.0})
+def _pad_constant_like(ins, attrs):
+    """Pad Y up to X's shape at the high end (pad_constant_like_op.cc)."""
+    x, y = ins["X"], ins["Y"]
+    pads = [(0, int(xs) - int(ys)) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pads,
+                           constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("crop_tensor",
+             inputs=[In("X"), In("Shape", dispensable=True, no_grad=True),
+                     In("Offsets", dispensable=True, no_grad=True)],
+             outputs=[Out("Out")],
+             attrs={"shape": [], "offsets": []})
+def _crop_tensor(ins, attrs):
+    x = ins["X"]
+    # runtime Shape/Offsets tensors take priority over the attr hints
+    # (crop_tensor_op.cc:37-75)
+    shape = ([int(v) for v in np.asarray(ins["Shape"])]
+             if ins.get("Shape") is not None
+             else list(attrs.get("shape") or []))
+    offsets = ([int(v) for v in np.asarray(ins["Offsets"])]
+               if ins.get("Offsets") is not None
+               else list(attrs.get("offsets") or [0] * x.ndim))
+    shape = [int(x.shape[i]) if int(s) < 0 else int(s)
+             for i, s in enumerate(shape)]
+    sl = tuple(slice(int(o), int(o) + s)
+               for o, s in zip(offsets, shape))
+    return {"Out": x[sl]}
+
+
+@register_op("conv_shift", inputs=[In("X"), In("Y")],
+             outputs=[Out("Out")])
+def _conv_shift(ins, attrs):
+    """Circular correlation (conv_shift_op.cc): out[b, i] =
+    sum_j x[b, (i + j - W/2) mod N] * y[b, j]."""
+    x, y = ins["X"], ins["Y"]
+    n, w = x.shape[1], y.shape[1]
+    half = w // 2
+    idx = (jnp.arange(n)[:, None] + jnp.arange(w)[None, :] - half) % n
+    gathered = x[:, idx]                       # [B, N, W]
+    return {"Out": jnp.einsum("bnw,bw->bn", gathered, y)}
+
+
+@register_op("cvm", inputs=[In("X"), In("CVM", no_grad=True)],
+             outputs=[Out("Y")], attrs={"use_cvm": True})
+def _cvm(ins, attrs):
+    """CTR show/click feature op (cvm_op.cc): use_cvm keeps the 2
+    leading cvm columns with log transforms, else strips them."""
+    x = ins["X"]
+    show = jnp.log(x[:, 0:1] + 1.0)
+    click = jnp.log(x[:, 1:2] + 1.0) - jnp.log(x[:, 0:1] + 1.0)
+    if attrs.get("use_cvm", True):
+        return {"Y": jnp.concatenate([show, click, x[:, 2:]], axis=1)}
+    return {"Y": x[:, 2:]}
+
+
+# -- interpolate v1 op names ------------------------------------------------
+
+
+def _interp_alias(method):
+    from .conv_ops import _interpolate
+
+    def impl(ins, attrs):
+        a = dict(attrs)
+        a["interp_method"] = method
+        # runtime OutSize tensor overrides out_h/out_w (interpolate_op.cc
+        # :81); concrete only in the interpreter — dynamic-size programs
+        # stay on the host path
+        if ins.get("OutSize") is not None:
+            hw = np.asarray(ins["OutSize"]).reshape(-1)
+            a["out_h"], a["out_w"] = int(hw[0]), int(hw[1])
+            a["scale"] = 0.0
+        return _interpolate(ins, a)
+
+    return impl
+
+
+register_op("bilinear_interp",
+            inputs=[In("X"), In("OutSize", dispensable=True,
+                                no_grad=True)],
+            outputs=[Out("Out")],
+            attrs={"out_h": -1, "out_w": -1, "scale": 0.0,
+                   "align_corners": True, "align_mode": 1,
+                   "interp_method": "bilinear"})(
+    _interp_alias("bilinear"))
+
+register_op("nearest_interp",
+            inputs=[In("X"), In("OutSize", dispensable=True,
+                                no_grad=True)],
+            outputs=[Out("Out")],
+            attrs={"out_h": -1, "out_w": -1, "scale": 0.0,
+                   "align_corners": True, "align_mode": 1,
+                   "interp_method": "nearest"})(
+    _interp_alias("nearest"))
+
+
+@register_op("trilinear_interp",
+             inputs=[In("X"), In("OutSize", dispensable=True,
+                                 no_grad=True)],
+             outputs=[Out("Out")],
+             attrs={"out_d": -1, "out_h": -1, "out_w": -1, "scale": 0.0,
+                    "align_corners": True, "align_mode": 1})
+def _trilinear_interp(ins, attrs):
+    """5-D [N,C,D,H,W] trilinear resize (interpolate_op.h trilinear);
+    align_corners=False only (jax.image); True raises."""
+    if attrs.get("align_corners", True):
+        raise NotImplementedError(
+            "trilinear_interp align_corners=True is not lowered; pass "
+            "align_corners=False")
+    x = ins["X"]
+    n, c, d, h, w = x.shape
+    od = attrs.get("out_d", -1)
+    oh = attrs.get("out_h", -1)
+    ow = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    if scale and scale > 0:
+        od, oh, ow = int(d * scale), int(h * scale), int(w * scale)
+    return {"Out": jax.image.resize(x, (n, c, od, oh, ow), "trilinear")}
+
+
+# -- pooling with indices / unpool ------------------------------------------
+
+
+@register_op("max_pool2d_with_index", inputs=[In("X")],
+             outputs=[Out("Out"), Out("Mask", no_grad=True)],
+             attrs={"ksize": [1, 1], "strides": [1, 1],
+                    "paddings": [0, 0], "global_pooling": False,
+                    "adaptive": False})
+def _max_pool2d_with_index(ins, attrs):
+    """Max pool that also emits flat argmax indices into each input's
+    H*W plane (pool_with_index_op.cc)."""
+    x = ins["X"]
+    n, c, h, w = x.shape
+    kh, kw = attrs["ksize"]
+    sh, sw = attrs.get("strides", [1, 1])
+    ph, pw = attrs.get("paddings", [0, 0])
+    if attrs.get("global_pooling"):
+        # "ksize and paddings will be ignored" (pool_with_index_op.cc:52)
+        kh, kw, ph, pw = h, w, 0, 0
+    if attrs.get("adaptive"):
+        return _adaptive_max_pool_with_index(x, kh, kw)
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    flat_idx = jnp.arange(xp.shape[2] * xp.shape[3]).reshape(
+        xp.shape[2], xp.shape[3])
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    outs, idxs = [], []
+    for i in range(kh):
+        for j in range(kw):
+            outs.append(xp[:, :, i:i + oh * sh:sh, j:j + ow * sw:sw])
+            idxs.append(jnp.broadcast_to(
+                flat_idx[i:i + oh * sh:sh, j:j + ow * sw:sw], (n, c, oh, ow)))
+    stack = jnp.stack(outs, axis=0)           # [K, N, C, OH, OW]
+    which = jnp.argmax(stack, axis=0)
+    out = jnp.max(stack, axis=0)
+    istack = jnp.stack(idxs, axis=0)
+    picked = jnp.take_along_axis(istack, which[None], axis=0)[0]
+    # translate padded-plane flat index back to unpadded H*W
+    prow = picked // xp.shape[3] - ph
+    pcol = picked % xp.shape[3] - pw
+    mask = prow * w + pcol
+    return {"Out": out, "Mask": mask.astype(jnp.int32)}
+
+
+@register_op("unpool", inputs=[In("X"), In("Indices", no_grad=True)],
+             outputs=[Out("Out")],
+             attrs={"ksize": [1, 1], "strides": [1, 1],
+                    "paddings": [0, 0], "unpooling_type": "max",
+                    "output_size": []})
+def _unpool(ins, attrs):
+    """Max unpooling (unpool_op.cc): scatter pooled values back to the
+    positions recorded by max_pool2d_with_index."""
+    x, idx = ins["X"], ins["Indices"].astype(jnp.int32)
+    n, c, oh, ow = x.shape
+    out_size = attrs.get("output_size") or []
+    if len(out_size) >= 2:
+        H, W = int(out_size[-2]), int(out_size[-1])
+    else:
+        kh, kw = attrs["ksize"]
+        sh, sw = attrs.get("strides", [1, 1])
+        ph, pw = attrs.get("paddings", [0, 0])
+        H = (oh - 1) * sh - 2 * ph + kh
+        W = (ow - 1) * sw - 2 * pw + kw
+    flat = jnp.zeros((n, c, H * W), x.dtype)
+    # assignment (not add): overlapping windows sharing an argmax must
+    # not double-count (unpool_op.cc assigns)
+    out = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].set(x.reshape(n, c, -1))
+    return {"Out": out.reshape(n, c, H, W)}
+
+
+# -- program-level save/load ops --------------------------------------------
+
+
+@register_host_op(
+    "save",
+    inputs=[In("X", no_grad=True)],
+    outputs=[],
+    attrs={"file_path": "", "overwrite": True, "save_as_fp16": False},
+)
+def _save(executor, op, scope):
+    """save_op.cc: serialize one variable to file_path (npy here — the
+    io.py save/load surface defines the framework's container format;
+    this op exists so reference-built programs with in-graph save ops
+    execute)."""
+    path = op.attrs["file_path"]
+    if not path.endswith(".npy"):
+        path = path + ".npy"
+    if os.path.exists(path) and not op.attrs.get("overwrite", True):
+        raise RuntimeError("save: %r exists and overwrite=False" % path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    val = np.asarray(executor._read_var(scope, op.input("X")[0]))
+    if op.attrs.get("save_as_fp16"):
+        val = val.astype(np.float16)
+    np.save(path, val)
+
+
+@register_host_op(
+    "load",
+    inputs=[],
+    outputs=[Out("Out")],
+    attrs={"file_path": "", "load_as_fp16": False},
+)
+def _load(executor, op, scope):
+    path = op.attrs["file_path"]
+    if not path.endswith(".npy"):
+        path = path + ".npy"
+    val = np.load(path)
+    if op.attrs.get("load_as_fp16"):
+        val = val.astype(np.float16)
+    executor._write_var(scope, op.output("Out")[0], val)
+
+
+@register_host_op(
+    "save_combine",
+    inputs=[In("X", duplicable=True, no_grad=True)],
+    outputs=[],
+    attrs={"file_path": "", "overwrite": True, "save_as_fp16": False},
+)
+def _save_combine(executor, op, scope):
+    path = op.attrs["file_path"]
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    if os.path.exists(path) and not op.attrs.get("overwrite", True):
+        raise RuntimeError("save_combine: %r exists" % path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrs = {n: np.asarray(executor._read_var(scope, n))
+            for n in op.input("X")}
+    if op.attrs.get("save_as_fp16"):
+        arrs = {k: v.astype(np.float16) for k, v in arrs.items()}
+    np.savez(path, **arrs)
+
+
+@register_host_op(
+    "load_combine",
+    inputs=[],
+    outputs=[Out("Out", duplicable=True)],
+    attrs={"file_path": "", "load_as_fp16": False},
+)
+def _load_combine(executor, op, scope):
+    path = op.attrs["file_path"]
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    names = op.output("Out")
+    keys = list(data.keys())
+    for i, out_name in enumerate(names):
+        key = out_name if out_name in data else keys[i]
+        val = data[key]
+        if op.attrs.get("load_as_fp16"):
+            val = val.astype(np.float16)
+        executor._write_var(scope, out_name, val)
+
+
+# -- collective / memory shims ----------------------------------------------
+
+
+@register_host_op(
+    "c_comm_init_all",
+    inputs=[],
+    outputs=[],
+    attrs={"devices": [], "ring_id": 0},
+)
+def _c_comm_init_all(executor, op, scope):
+    """c_comm_init_all_op.cc: initializes NCCL comms for all devices —
+    mesh axes are bound at shard_map entry here, so this is a no-op
+    kept for program compatibility (like c_comm_init)."""
+
+
+@register_op("coalesce_tensor",
+             inputs=[In("Input", duplicable=True)],
+             outputs=[Out("Output", duplicable=True),
+                      Out("FusedOutput")],
+             attrs={"copy_data": True, "set_constant": False,
+                    "constant": 0.0, "dtype": 5}, grad=None)
+def _coalesce_tensor(ins, attrs):
+    """coalesce_tensor_op.cc: fuse tensors into one contiguous buffer
+    (the reference uses it to group grads for fused allreduce). XLA
+    owns layout here, so outputs alias the inputs and FusedOutput is
+    their concatenation."""
+    xs = ins["Input"]
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    if attrs.get("set_constant"):
+        flat = jnp.full_like(flat, attrs.get("constant", 0.0))
+        outs = []
+        off = 0
+        for x in xs:
+            outs.append(flat[off:off + x.size].reshape(x.shape))
+            off += x.size
+        return {"Output": outs, "FusedOutput": flat}
+    return {"Output": list(xs), "FusedOutput": flat}
+
+
+def _adaptive_max_pool_with_index(x, oh, ow):
+    """Adaptive windows (pool_with_index_op.cc:65): window i spans
+    [floor(i*H/oh), ceil((i+1)*H/oh))."""
+    import math
+
+    n, c, h, w = x.shape
+    flat = x.reshape(n, c, h * w)
+    outs, idxs = [], []
+    for i in range(oh):
+        hs, he = (i * h) // oh, -(-((i + 1) * h) // oh)
+        for j in range(ow):
+            ws, we = (j * w) // ow, -(-((j + 1) * w) // ow)
+            win = x[:, :, hs:he, ws:we].reshape(n, c, -1)
+            local = jnp.argmax(win, axis=2)
+            rows = hs + local // (we - ws)
+            cols = ws + local % (we - ws)
+            outs.append(win.max(axis=2))
+            idxs.append(rows * w + cols)
+    out = jnp.stack(outs, axis=2).reshape(n, c, oh, ow)
+    mask = jnp.stack(idxs, axis=2).reshape(n, c, oh, ow)
+    return {"Out": out, "Mask": mask.astype(jnp.int32)}

@@ -1,0 +1,210 @@
+"""Registry-tail ops vs numpy oracles."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run_op(op_type, ins, outs, attrs, feeds, fetch, in_dtypes=None):
+    prog, startup = fluid.Program(), fluid.Program()
+    blk = prog.global_block()
+    for slot, names in ins.items():
+        for n in names:
+            blk.create_var(name=n, dtype=(in_dtypes or {}).get(
+                n, "float32"))
+    for slot, names in outs.items():
+        for n in names:
+            blk.create_var(name=n, dtype="float32")
+    blk.append_op(op_type, inputs=ins, outputs=outs, attrs=attrs,
+                  infer_shape=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, feed=feeds, fetch_list=list(fetch))
+        return [np.asarray(scope.find_var(f).raw().array) for f in fetch]
+
+
+def test_squeeze_unsqueeze_v1():
+    x = np.random.RandomState(0).randn(2, 1, 3).astype("float32")
+    (o,) = _run_op("squeeze", {"X": ["x"]}, {"Out": ["o"]},
+                   {"axes": [1]}, {"x": x}, ["o"])
+    assert o.shape == (2, 3)
+    (o2,) = _run_op("unsqueeze", {"X": ["x2"]}, {"Out": ["o2"]},
+                    {"axes": [0, 2]},
+                    {"x2": x.reshape(2, 3)}, ["o2"])
+    assert o2.shape == (1, 2, 1, 3)
+
+
+def test_minus_l1_label_smooth():
+    rng = np.random.RandomState(1)
+    a, b = rng.randn(3, 4).astype("float32"), rng.randn(3, 4).astype(
+        "float32")
+    (o,) = _run_op("minus", {"X": ["a"], "Y": ["b"]}, {"Out": ["o"]},
+                   {}, {"a": a, "b": b}, ["o"])
+    np.testing.assert_allclose(o, a - b, rtol=1e-6)
+    (l1,) = _run_op("l1_norm", {"X": ["a"]}, {"Out": ["l1"]}, {},
+                    {"a": a}, ["l1"])
+    np.testing.assert_allclose(l1, [np.abs(a).sum()], rtol=1e-5)
+    onehot = np.eye(4, dtype="float32")[[0, 2, 1]]
+    (ls,) = _run_op("label_smooth", {"X": ["oh"]}, {"Out": ["ls"]},
+                    {"epsilon": 0.1}, {"oh": onehot}, ["ls"])
+    np.testing.assert_allclose(ls, 0.9 * onehot + 0.1 / 4, rtol=1e-5)
+
+
+def test_pad_constant_like_and_crop_tensor():
+    big = np.zeros((4, 5), "float32")
+    small = np.ones((2, 3), "float32")
+    (o,) = _run_op("pad_constant_like",
+                   {"X": ["big"], "Y": ["small"]}, {"Out": ["o"]},
+                   {"pad_value": 7.0}, {"big": big, "small": small},
+                   ["o"])
+    assert o.shape == (4, 5)
+    np.testing.assert_allclose(o[:2, :3], 1.0)
+    np.testing.assert_allclose(o[2:], 7.0)
+    x = np.arange(24, dtype="float32").reshape(4, 6)
+    (c,) = _run_op("crop_tensor", {"X": ["x"]}, {"Out": ["c"]},
+                   {"shape": [2, 3], "offsets": [1, 2]}, {"x": x}, ["c"])
+    np.testing.assert_allclose(c, x[1:3, 2:5])
+
+
+def test_conv_shift():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 6).astype("float32")
+    y = rng.randn(2, 3).astype("float32")
+    (o,) = _run_op("conv_shift", {"X": ["x"], "Y": ["y"]},
+                   {"Out": ["o"]}, {}, {"x": x, "y": y}, ["o"])
+    ref = np.zeros_like(x)
+    for b in range(2):
+        for i in range(6):
+            for j in range(3):
+                ref[b, i] += x[b, (i + j - 1) % 6] * y[b, j]
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_cvm():
+    x = np.array([[3.0, 1.0, 5.0, 6.0]], "float32")
+    cvm = np.zeros((1, 2), "float32")
+    (y,) = _run_op("cvm", {"X": ["x"], "CVM": ["c"]}, {"Y": ["y"]},
+                   {"use_cvm": True}, {"x": x, "c": cvm}, ["y"])
+    np.testing.assert_allclose(
+        y[0, :2], [np.log(4.0), np.log(2.0) - np.log(4.0)], rtol=1e-5)
+    np.testing.assert_allclose(y[0, 2:], [5.0, 6.0])
+    (y2,) = _run_op("cvm", {"X": ["x2"], "CVM": ["c2"]}, {"Y": ["y2"]},
+                    {"use_cvm": False}, {"x2": x, "c2": cvm}, ["y2"])
+    np.testing.assert_allclose(y2, [[5.0, 6.0]])
+
+
+def test_interp_v1_names():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    (o,) = _run_op("nearest_interp", {"X": ["x"]}, {"Out": ["o"]},
+                   {"out_h": 2, "out_w": 2, "align_corners": False},
+                   {"x": x}, ["o"])
+    assert o.shape == (1, 1, 2, 2)
+    (ob,) = _run_op("bilinear_interp", {"X": ["xb"]}, {"Out": ["ob"]},
+                    {"out_h": 8, "out_w": 8, "align_corners": True},
+                    {"xb": x}, ["ob"])
+    assert ob.shape == (1, 1, 8, 8)
+    np.testing.assert_allclose(ob[0, 0, 0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(ob[0, 0, -1, -1], 15.0, atol=1e-5)
+    x5 = np.arange(8, dtype="float32").reshape(1, 1, 2, 2, 2)
+    (ot,) = _run_op("trilinear_interp", {"X": ["x5"]}, {"Out": ["ot"]},
+                    {"out_d": 4, "out_h": 4, "out_w": 4,
+                     "align_corners": False}, {"x5": x5}, ["ot"])
+    assert ot.shape == (1, 1, 4, 4, 4)
+
+
+def test_pool_with_index_and_unpool_roundtrip():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    (o, m) = _run_op("max_pool2d_with_index", {"X": ["x"]},
+                     {"Out": ["o"], "Mask": ["m"]},
+                     {"ksize": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0]}, {"x": x}, ["o", "m"])
+    ref = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(o, ref, rtol=1e-6)
+    # indices point at the argmax positions in the flat 4x4 plane
+    flat = x.reshape(2, 3, 16)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, m.reshape(2, 3, 4), axis=2),
+        o.reshape(2, 3, 4), rtol=1e-6)
+    # unpool scatters back
+    (u,) = _run_op("unpool", {"X": ["o2"], "Indices": ["m2"]},
+                   {"Out": ["u"]},
+                   {"ksize": [2, 2], "strides": [2, 2],
+                    "paddings": [0, 0]},
+                   {"o2": o, "m2": m.astype("int32")}, ["u"])
+    assert u.shape == x.shape
+    np.testing.assert_allclose(u.sum(), o.sum(), rtol=1e-5)
+
+
+def test_save_load_ops_roundtrip(tmp_path):
+    val = np.random.RandomState(4).randn(3, 2).astype("float32")
+    p = str(tmp_path / "var")
+    _run_op("save", {"X": ["v"]}, {}, {"file_path": p}, {"v": val}, [])
+    (back,) = _run_op("load", {}, {"Out": ["w"]}, {"file_path": p},
+                      {}, ["w"])
+    np.testing.assert_allclose(back, val)
+    pc = str(tmp_path / "combined")
+    a = np.ones((2, 2), "float32")
+    b = np.full((3,), 2.0, "float32")
+    _run_op("save_combine", {"X": ["a", "b"]}, {},
+            {"file_path": pc}, {"a": a, "b": b}, [])
+    (a2, b2) = _run_op("load_combine", {}, {"Out": ["a", "b"]},
+                       {"file_path": pc}, {}, ["a", "b"])
+    np.testing.assert_allclose(a2, a)
+    np.testing.assert_allclose(b2, b)
+
+
+def test_coalesce_tensor():
+    a = np.ones((2, 2), "float32")
+    b = np.full((3,), 2.0, "float32")
+    outs = _run_op("coalesce_tensor", {"Input": ["a", "b"]},
+                   {"Output": ["oa", "ob"], "FusedOutput": ["fused"]},
+                   {"copy_data": True}, {"a": a, "b": b},
+                   ["oa", "ob", "fused"])
+    np.testing.assert_allclose(outs[0], a)
+    np.testing.assert_allclose(outs[1], b)
+    np.testing.assert_allclose(outs[2],
+                               np.concatenate([a.ravel(), b.ravel()]))
+
+
+def test_unsqueeze_axis_order_matches_reference():
+    x = np.zeros((2, 3), "float32")
+    (o,) = _run_op("unsqueeze", {"X": ["xo"]}, {"Out": ["oo"]},
+                   {"axes": [2, 0]}, {"xo": x}, ["oo"])
+    assert o.shape == (1, 2, 3, 1)  # insert at 2, THEN at 0
+
+
+def test_pool_with_index_global_and_adaptive():
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    (o, m) = _run_op("max_pool2d_with_index", {"X": ["xg"]},
+                     {"Out": ["og"], "Mask": ["mg"]},
+                     {"ksize": [2, 2], "strides": [1, 1],
+                      "paddings": [1, 1], "global_pooling": True},
+                     {"xg": x}, ["og", "mg"])
+    assert o.shape == (1, 2, 1, 1)
+    np.testing.assert_allclose(o.ravel(), x.max(axis=(2, 3)).ravel(),
+                               rtol=1e-6)
+    x7 = rng.randn(1, 1, 7, 7).astype("float32")
+    (oa, ma) = _run_op("max_pool2d_with_index", {"X": ["xa"]},
+                       {"Out": ["oa"], "Mask": ["ma"]},
+                       {"ksize": [2, 2], "strides": [1, 1],
+                        "paddings": [0, 0], "adaptive": True},
+                       {"xa": x7}, ["oa", "ma"])
+    assert oa.shape == (1, 1, 2, 2)
+    # adaptive windows: [0:4)x[0:4), [0:4)x[3:7), ...
+    np.testing.assert_allclose(oa[0, 0, 0, 0], x7[0, 0, :4, :4].max(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(oa[0, 0, 1, 1], x7[0, 0, 3:, 3:].max(),
+                               rtol=1e-6)
+
+
+def test_save_overwrite_guard(tmp_path):
+    import pytest
+
+    val = np.ones((2,), "float32")
+    p = str(tmp_path / "guarded")
+    _run_op("save", {"X": ["v1"]}, {}, {"file_path": p}, {"v1": val}, [])
+    with pytest.raises(Exception):
+        _run_op("save", {"X": ["v2"]}, {},
+                {"file_path": p, "overwrite": False}, {"v2": val}, [])
