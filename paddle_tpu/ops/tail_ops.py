@@ -199,8 +199,15 @@ def _trilinear_interp(ins, attrs):
     oh = attrs.get("out_h", -1)
     ow = attrs.get("out_w", -1)
     scale = attrs.get("scale", 0.0)
-    if scale and scale > 0:
+    if ins.get("OutSize") is not None:
+        # runtime size tensor overrides attrs (interpolate_op.cc:81)
+        dhw = np.asarray(ins["OutSize"]).reshape(-1)
+        od, oh, ow = int(dhw[0]), int(dhw[1]), int(dhw[2])
+    elif scale and scale > 0:
         od, oh, ow = int(d * scale), int(h * scale), int(w * scale)
+    if od < 0 or oh < 0 or ow < 0:
+        raise ValueError("trilinear_interp needs out_d/out_h/out_w, an "
+                         "OutSize tensor, or a positive scale")
     return {"Out": jax.image.resize(x, (n, c, od, oh, ow), "trilinear")}
 
 
@@ -352,8 +359,17 @@ def _load_combine(executor, op, scope):
     data = np.load(path if path.endswith(".npz") else path + ".npz")
     names = op.output("Out")
     keys = list(data.keys())
-    for i, out_name in enumerate(names):
-        key = out_name if out_name in data else keys[i]
+    # all-or-nothing lookup: mixing name and positional resolution can
+    # silently mis-assign arrays when only SOME names match
+    if all(n in data for n in names):
+        picks = names
+    elif len(names) == len(keys):
+        picks = keys  # purely positional (reference semantics: order)
+    else:
+        raise RuntimeError(
+            "load_combine: outputs %r do not match saved keys %r"
+            % (list(names), keys))
+    for out_name, key in zip(names, picks):
         val = data[key]
         if op.attrs.get("load_as_fp16"):
             val = val.astype(np.float16)

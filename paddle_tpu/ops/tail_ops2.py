@@ -1,0 +1,317 @@
+"""Second registry-tail wave: conv-transpose variants, sequence
+conv/scatter, SelectedRows utilities, projection LSTM.
+
+Parity targets (/root/reference/paddle/fluid/operators/):
+conv_transpose_op.cc (conv3d_transpose, depthwise_conv2d_transpose),
+sequence_ops/sequence_conv_op.cc (context-window conv over LoD rows),
+sequence_ops/sequence_scatter_op.cc, distributed_ops/split_ids_op.cc /
+merge_ids_op.cc, split_selected_rows_op.cc, lstmp_op.cc (LSTM with a
+recurrent projection layer).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op, register_op
+from .lod_utils import lod_offsets
+
+
+# -- conv transpose variants ------------------------------------------------
+
+
+@register_op(
+    "conv3d_transpose",
+    inputs=[In("Input"), In("Filter")],
+    outputs=[Out("Output")],
+    attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+           "dilations": [1, 1, 1], "groups": 1, "use_cudnn": True,
+           "data_format": "NCHW"},
+)
+def _conv3d_transpose(ins, attrs):
+    """Same gradient-of-conv formulation as conv2d_transpose, one more
+    spatial dim (conv_transpose_op.cc)."""
+    from jax import lax
+
+    x, w = ins["Input"], ins["Filter"]  # w: [in_c, out_c/g, kd, kh, kw]
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    pads = attrs.get("paddings", [0, 0, 0])
+    dil = tuple(attrs.get("dilations", [1, 1, 1]))
+    groups = attrs.get("groups", 1)
+    eff = [(w.shape[2 + i] - 1) * dil[i] + 1 for i in range(3)]
+    pad_cfg = [(eff[i] - 1 - pads[i], eff[i] - 1 - pads[i])
+               for i in range(3)]
+    w_flip = jnp.flip(w, axis=(2, 3, 4))
+    if groups > 1:
+        in_c = w.shape[0]
+        w_flip = w_flip.reshape(groups, in_c // groups, *w.shape[1:])
+        w_flip = jnp.concatenate(
+            [jnp.swapaxes(w_flip[g], 0, 1) for g in range(groups)],
+            axis=0)
+    else:
+        w_flip = jnp.swapaxes(w_flip, 0, 1)
+    dn = lax.conv_dimension_numbers(x.shape, w_flip.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, w_flip, window_strides=(1, 1, 1), padding=pad_cfg,
+        lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+        feature_group_count=groups)
+    return {"Output": out}
+
+
+def _depthwise_conv2d_transpose(ins, attrs):
+    """groups == channels transposed conv (reference registers a
+    separate op type; the math is conv2d_transpose's)."""
+    from .conv_ops import _conv2d_transpose
+
+    a = dict(attrs)
+    a.setdefault("groups", ins["Filter"].shape[0])
+    return _conv2d_transpose(ins, a)
+
+
+register_op(
+    "depthwise_conv2d_transpose",
+    inputs=[In("Input"), In("Filter")],
+    outputs=[Out("Output")],
+    attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+           "groups": 1, "use_cudnn": False, "data_format": "NCHW"},
+)(_depthwise_conv2d_transpose)
+
+
+# -- sequence ops -----------------------------------------------------------
+
+
+@register_op(
+    "sequence_conv",
+    inputs=[In("X"), In("PaddingData", dispensable=True), In("Filter")],
+    outputs=[Out("Out")],
+    attrs={"contextLength": 3, "contextStart": -1, "contextStride": 1,
+           "paddingTrainable": False},
+    needs_lod=True,
+)
+def _sequence_conv(ins, attrs):
+    """Context-window convolution over LoD rows
+    (sequence_conv_op.cc + math/context_project.h): for each timestep,
+    concat rows [t+start, t+start+length) within the sequence (zero /
+    trainable padding outside) and matmul with Filter
+    [length*D, num_filters]."""
+    x = ins["X"]                                   # [T, D]
+    filt = ins["Filter"]
+    length = int(attrs.get("contextLength", 3))
+    start = int(attrs.get("contextStart", -1))
+    offsets = lod_offsets(attrs, "X")
+    if offsets is None:
+        offsets = [0, x.shape[0]]
+    T, D = x.shape
+    pad = ins.get("PaddingData")  # [up+down, D] when trainable
+
+    cols = []
+    for j in range(length):
+        shift = start + j
+        rows = []
+        for s in range(len(offsets) - 1):
+            lo, hi = offsets[s], offsets[s + 1]
+            seg = x[lo:hi]
+            n = hi - lo
+            idx = jnp.arange(n) + shift
+            inside = (idx >= 0) & (idx < n)
+            gathered = seg[jnp.clip(idx, 0, max(n - 1, 0))]
+            if pad is not None and attrs.get("paddingTrainable"):
+                # pad rows: [0, up) are up-pads for offsets -up..-1;
+                # [up, up+down) are down-pads indexed CONTIGUOUSLY from
+                # up by the overflow amount (context_project.h:188-190)
+                up = max(-start, 0)
+                pad_row = jnp.where(
+                    (idx < 0)[:, None],
+                    pad[jnp.clip(idx + up, 0, pad.shape[0] - 1)],
+                    pad[jnp.clip(up + (idx - n), 0, pad.shape[0] - 1)])
+                gathered = jnp.where(inside[:, None], gathered, pad_row)
+            else:
+                gathered = jnp.where(inside[:, None], gathered, 0.0)
+            rows.append(gathered)
+        cols.append(jnp.concatenate(rows, axis=0))
+    im = jnp.concatenate(cols, axis=1)             # [T, length*D]
+    return {"Out": im @ filt}
+
+
+@register_op(
+    "sequence_scatter",
+    inputs=[In("X"), In("Ids", no_grad=True), In("Updates")],
+    outputs=[Out("Out")],
+    needs_lod=True,
+)
+def _sequence_scatter(ins, attrs):
+    """Per-sequence scatter-add (sequence_scatter_op.cc): row i of X
+    receives Updates rows whose Ids (within sequence i of the Updates
+    LoD) index X's columns."""
+    x = ins["X"]                                   # [N, D]
+    ids = ins["Ids"].reshape(-1).astype(jnp.int32)
+    upd = ins["Updates"].reshape(-1)
+    offsets = lod_offsets(attrs, "Ids")
+    if offsets is None:
+        raise ValueError("sequence_scatter requires LoD on Ids")
+    if len(offsets) - 1 != x.shape[0]:
+        raise ValueError(
+            "sequence_scatter: Ids has %d sequences but X has %d rows"
+            % (len(offsets) - 1, x.shape[0]))
+    from .lod_utils import seg_ids
+
+    rows = seg_ids(offsets)
+    return {"Out": x.at[rows, ids].add(upd)}
+
+
+# -- SelectedRows / PS utilities --------------------------------------------
+
+
+@register_host_op(
+    "split_ids",
+    inputs=[In("Ids", duplicable=True, no_grad=True)],
+    outputs=[Out("Out", duplicable=True)],
+)
+def _split_ids(executor, op, scope):
+    """Route ids to shards by id % nshards (split_ids_op.cc)."""
+    ids = np.concatenate([
+        np.asarray(executor._read_var(scope, n)).reshape(-1)
+        for n in op.input("Ids")])
+    outs = op.output("Out")
+    n = len(outs)
+    for shard, name in enumerate(outs):
+        executor._write_var(scope, name,
+                            ids[ids % n == shard].reshape(-1, 1))
+
+
+@register_host_op(
+    "merge_ids",
+    inputs=[In("Ids", duplicable=True, no_grad=True),
+            In("Rows", duplicable=True, no_grad=True),
+            In("X", duplicable=True, no_grad=True)],
+    outputs=[Out("Out", duplicable=True)],
+)
+def _merge_ids(executor, op, scope):
+    """Inverse of split_ids for looked-up rows (merge_ids_op.cc): each
+    X[i] holds embeddings for Rows[i]; outputs gather them back into
+    the original Ids order."""
+    rows = [np.asarray(executor._read_var(scope, n)).reshape(-1)
+            for n in op.input("Rows")]
+    xs = [np.asarray(executor._read_var(scope, n))
+          for n in op.input("X")]
+    table = {}
+    for r, xv in zip(rows, xs):
+        for i, rid in enumerate(r):
+            table[int(rid)] = xv[i]
+    for ids_name, out_name in zip(op.input("Ids"), op.output("Out")):
+        ids = np.asarray(
+            executor._read_var(scope, ids_name)).reshape(-1)
+        executor._write_var(
+            scope, out_name,
+            np.stack([table[int(i)] for i in ids]))
+
+
+@register_host_op(
+    "split_selected_rows",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out", duplicable=True)],
+    attrs={"height_sections": []},
+)
+def _split_selected_rows(executor, op, scope):
+    """Partition a SelectedRows by row-id range (height sections)
+    (split_selected_rows_op.cc)."""
+    from ..core.tensor import LoDTensor, SelectedRows
+
+    sr = scope.find_var(op.input("X")[0]).raw()
+    if not isinstance(sr, SelectedRows):
+        raise TypeError("split_selected_rows expects SelectedRows input")
+    sections = [int(s) for s in op.attrs.get("height_sections", [])]
+    rows = np.asarray(sr.rows())
+    t = sr.get_tensor()
+    vals = np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+    bounds = np.cumsum([0] + sections)
+    for i, out_name in enumerate(op.output("Out")):
+        lo, hi = bounds[i], bounds[i + 1]
+        mask = (rows >= lo) & (rows < hi)
+        piece = SelectedRows(rows=(rows[mask] - lo).tolist(),
+                             height=sections[i],
+                             value=LoDTensor().set(vals[mask]))
+        scope.var(out_name).set(piece)
+
+
+# -- projection LSTM --------------------------------------------------------
+
+
+@register_op(
+    "lstmp",
+    inputs=[In("Input"), In("Weight"), In("ProjWeight"), In("Bias"),
+            In("H0", dispensable=True), In("C0", dispensable=True)],
+    outputs=[Out("Projection"), Out("Cell", no_grad=True)],
+    attrs={"use_peepholes": False, "is_reverse": False,
+           "gate_activation": "sigmoid", "cell_activation": "tanh",
+           "candidate_activation": "tanh",
+           "proj_activation": "identity"},
+    needs_lod=True, infer_lod="propagate",
+)
+def _lstmp(ins, attrs):
+    """LSTM with recurrent projection (lstmp_op.h:103-219): the
+    recurrent state is the PROJECTED hidden r = act(h @ ProjWeight),
+    Weight is [P, 4D], input arrives pre-projected [T, 4D] like the LoD
+    lstm op. ONE masked scan over all sequences (padded via
+    rnn_ops._pad_from_lod); gate column order is the reference's
+    (candidate, input, forget, output) — lstmp_op.h uses the same
+    LstmUnitFunctor as lstm. Peepholes unsupported (raise)."""
+    from .rnn_ops import _act, _pad_from_lod, _unpad_to_lod
+
+    if attrs.get("use_peepholes"):
+        raise NotImplementedError("lstmp use_peepholes=True")
+    x = ins["Input"]                               # [T, 4D]
+    w = ins["Weight"]                              # [P, 4D]
+    pw = ins["ProjWeight"]                         # [D, P]
+    b = ins["Bias"].reshape(-1)                    # [4D]
+    d = x.shape[1] // 4
+    p = pw.shape[1]
+    offsets = lod_offsets(attrs, "Input") or [0, x.shape[0]]
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    proj_act = _act(attrs.get("proj_activation", "identity"))
+    rev = bool(attrs.get("is_reverse", False))
+
+    x_pad, lens = _pad_from_lod(x + b[None, :], offsets)  # [N, Tm, 4D]
+    n, t, _ = x_pad.shape
+    mask = (jnp.arange(t)[None, :] < jnp.asarray(lens)[:, None]).astype(
+        x.dtype)
+    if rev:
+        idx = (jnp.asarray(lens)[:, None] - 1 - jnp.arange(t)[None, :]) \
+            % jnp.maximum(jnp.asarray(lens)[:, None], 1)
+        x_pad = jnp.take_along_axis(x_pad, idx[:, :, None], axis=1)
+    xs = jnp.swapaxes(x_pad, 0, 1)                 # [Tm, N, 4D]
+    ms = jnp.swapaxes(mask, 0, 1)                  # [Tm, N]
+    h0 = ins.get("H0")
+    c0 = ins.get("C0")
+    r0 = (proj_act(h0 @ pw) if h0 is not None
+          else jnp.zeros((n, p), x.dtype))
+    c0 = c0 if c0 is not None else jnp.zeros((n, d), x.dtype)
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        x_t, m_t = inp
+        g = x_t + r_prev @ w
+        cand = cand_act(g[:, :d])
+        ig = gate_act(g[:, d:2 * d])
+        fg = gate_act(g[:, 2 * d:3 * d])
+        og = gate_act(g[:, 3 * d:])
+        c_new = fg * c_prev + ig * cand
+        h = og * cell_act(c_new)
+        r_new = proj_act(h @ pw)
+        m = m_t[:, None]
+        r_new = r_new * m + r_prev * (1 - m)
+        c_new = c_new * m + c_prev * (1 - m)
+        return (r_new, c_new), (r_new, c_new)
+
+    (_, _), (rs, cs) = jax.lax.scan(step, (r0, c0), (xs, ms))
+    rs = jnp.swapaxes(rs, 0, 1)                    # [N, Tm, P]
+    cs = jnp.swapaxes(cs, 0, 1)
+    if rev:
+        rs = jnp.take_along_axis(rs, idx[:, :, None], axis=1)
+        cs = jnp.take_along_axis(cs, idx[:, :, None], axis=1)
+    return {"Projection": _unpad_to_lod(rs, offsets),
+            "Cell": _unpad_to_lod(cs, offsets)}

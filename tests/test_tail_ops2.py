@@ -1,0 +1,210 @@
+"""Second tail wave: conv transposes, sequence conv/scatter,
+SelectedRows utilities, lstmp."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.tensor import LoDTensor, SelectedRows
+
+from test_tail_ops import _run_op
+
+
+def test_conv3d_transpose_shape_and_sum():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 3, 3, 3).astype("float32")
+    w = rng.randn(2, 4, 2, 2, 2).astype("float32")
+    (o,) = _run_op("conv3d_transpose",
+                   {"Input": ["x"], "Filter": ["w"]},
+                   {"Output": ["o"]},
+                   {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "dilations": [1, 1, 1], "groups": 1},
+                   {"x": x, "w": w}, ["o"])
+    assert o.shape == (1, 4, 4, 4, 4)
+    # total mass: each input element contributes through every kernel tap
+    np.testing.assert_allclose(
+        o.sum(), (x.sum(axis=(0, 2, 3, 4)) * w.sum(axis=(1, 2, 3, 4))
+                  ).sum(), rtol=1e-4)
+
+
+def test_depthwise_conv2d_transpose():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 3, 4, 4).astype("float32")
+    w = rng.randn(3, 1, 2, 2).astype("float32")
+    (o,) = _run_op("depthwise_conv2d_transpose",
+                   {"Input": ["x"], "Filter": ["w"]},
+                   {"Output": ["o"]},
+                   {"strides": [2, 2], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 3},
+                   {"x": x, "w": w}, ["o"])
+    assert o.shape == (1, 3, 8, 8)
+    # channel 0 output depends only on channel 0 input
+    x2 = x.copy()
+    x2[0, 1:] = 0
+    (o2,) = _run_op("depthwise_conv2d_transpose",
+                    {"Input": ["x2"], "Filter": ["w2"]},
+                    {"Output": ["o2"]},
+                    {"strides": [2, 2], "paddings": [0, 0],
+                     "dilations": [1, 1], "groups": 3},
+                    {"x2": x2, "w2": w}, ["o2"])
+    np.testing.assert_allclose(np.asarray(o2)[0, 0], np.asarray(o)[0, 0],
+                               rtol=1e-5)
+
+
+def _lod_feed(arr, lod):
+    t = LoDTensor()
+    t.set(arr)
+    t.set_lod(lod)
+    return t
+
+
+def test_sequence_conv_matches_numpy():
+    rng = np.random.RandomState(2)
+    x = rng.randn(5, 3).astype("float32")   # seqs [2, 3]
+    filt = rng.randn(9, 4).astype("float32")  # length 3 * D 3 -> 4
+    (o,) = _run_op("sequence_conv",
+                   {"X": ["x"], "Filter": ["f"]}, {"Out": ["o"]},
+                   {"contextLength": 3, "contextStart": -1},
+                   {"x": _lod_feed(x, [[0, 2, 5]]), "f": filt}, ["o"])
+    # numpy oracle: context [t-1, t, t+1] zero-padded per sequence
+    ref = np.zeros((5, 4), "float32")
+    for lo, hi in [(0, 2), (2, 5)]:
+        for t in range(lo, hi):
+            ctx = []
+            for s in (-1, 0, 1):
+                j = t + s
+                ctx.append(x[j] if lo <= j < hi else np.zeros(3))
+            ref[t] = np.concatenate(ctx) @ filt
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_scatter():
+    x = np.zeros((2, 5), "float32")
+    ids = np.array([[1], [3], [0], [4]], "int64")  # seq0: [1,3]; seq1: [0,4]
+    upd = np.array([[10.0], [20.0], [30.0], [40.0]], "float32")
+    (o,) = _run_op("sequence_scatter",
+                   {"X": ["x"], "Ids": ["i"], "Updates": ["u"]},
+                   {"Out": ["o"]}, {},
+                   {"x": x, "i": _lod_feed(ids, [[0, 2, 4]]),
+                    "u": _lod_feed(upd, [[0, 2, 4]])}, ["o"])
+    ref = np.zeros((2, 5), "float32")
+    ref[0, 1], ref[0, 3] = 10, 20
+    ref[1, 0], ref[1, 4] = 30, 40
+    np.testing.assert_allclose(o, ref)
+
+
+def test_split_and_merge_ids():
+    prog, _ = fluid.Program(), fluid.Program()
+    blk = prog.global_block()
+    for n in ("ids", "s0", "s1", "r0", "r1", "x0", "x1", "out"):
+        blk.create_var(name=n, dtype="float32")
+    blk.append_op("split_ids", {"Ids": ["ids"]}, {"Out": ["s0", "s1"]},
+                  {}, infer_shape=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, feed={"ids": np.array([[0], [3], [4], [1]],
+                                            "int64")}, fetch_list=[])
+        s0 = scope.find_var("s0").get_tensor().numpy().ravel()
+        s1 = scope.find_var("s1").get_tensor().numpy().ravel()
+    assert sorted(s0.tolist()) == [0, 4]
+    assert sorted(s1.tolist()) == [1, 3]
+
+    # merge back embeddings looked up per shard
+    prog2, _ = fluid.Program(), fluid.Program()
+    blk = prog2.global_block()
+    for n in ("ids", "r0", "r1", "x0", "x1", "out"):
+        blk.create_var(name=n, dtype="float32")
+    blk.append_op("merge_ids",
+                  {"Ids": ["ids"], "Rows": ["r0", "r1"],
+                   "X": ["x0", "x1"]},
+                  {"Out": ["out"]}, {}, infer_shape=False)
+    scope2 = fluid.Scope()
+    emb = {i: np.full((2,), float(i), "float32") for i in range(5)}
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog2, feed={
+            "ids": np.array([[0], [3], [4], [1]], "int64"),
+            "r0": np.array([0, 4], "int64"),
+            "r1": np.array([3, 1], "int64"),
+            "x0": np.stack([emb[0], emb[4]]),
+            "x1": np.stack([emb[3], emb[1]])}, fetch_list=[])
+        out = scope2.find_var("out").get_tensor().numpy()
+    np.testing.assert_allclose(out, np.stack(
+        [emb[0], emb[3], emb[4], emb[1]]))
+
+
+def test_split_selected_rows():
+    prog, _ = fluid.Program(), fluid.Program()
+    blk = prog.global_block()
+    for n in ("sr", "p0", "p1"):
+        blk.create_var(name=n, dtype="float32")
+    blk.append_op("split_selected_rows", {"X": ["sr"]},
+                  {"Out": ["p0", "p1"]},
+                  {"height_sections": [4, 4]}, infer_shape=False)
+    scope = fluid.Scope()
+    sr = SelectedRows(rows=[1, 5, 6], height=8,
+                      value=np.arange(6, dtype="float32").reshape(3, 2))
+    scope.var("sr").set(sr)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, feed={}, fetch_list=[])
+        p0 = scope.find_var("p0").raw()
+        p1 = scope.find_var("p1").raw()
+    assert list(p0.rows()) == [1]
+    assert list(p1.rows()) == [1, 2]  # 5-4, 6-4
+    np.testing.assert_allclose(np.asarray(p1.get_tensor().numpy()),
+                               [[2, 3], [4, 5]])
+
+
+def test_lstmp_runs_and_projects():
+    rng = np.random.RandomState(3)
+    T, D, P = 5, 4, 3
+    x = rng.randn(T, 4 * D).astype("float32")
+    w = rng.randn(P, 4 * D).astype("float32") * 0.3
+    pw = rng.randn(D, P).astype("float32") * 0.3
+    b = rng.randn(1, 4 * D).astype("float32") * 0.1
+    (proj, cell) = _run_op(
+        "lstmp",
+        {"Input": ["x"], "Weight": ["w"], "ProjWeight": ["pw"],
+         "Bias": ["b"]},
+        {"Projection": ["proj"], "Cell": ["cell"]}, {},
+        {"x": _lod_feed(x, [[0, 2, 5]]), "w": w, "pw": pw, "b": b},
+        ["proj", "cell"])
+    assert proj.shape == (T, P) and cell.shape == (T, D)
+    # sequence boundaries reset state: step 2 (start of seq 1) must not
+    # depend on seq 0's rows
+    x2 = x.copy()
+    x2[:2] = 0
+    (proj2, _) = _run_op(
+        "lstmp",
+        {"Input": ["x2"], "Weight": ["w2"], "ProjWeight": ["pw2"],
+         "Bias": ["b2"]},
+        {"Projection": ["proj2"], "Cell": ["cell2"]}, {},
+        {"x2": _lod_feed(x2, [[0, 2, 5]]), "w2": w, "pw2": pw, "b2": b},
+        ["proj2", "cell2"])
+    np.testing.assert_allclose(proj2[2:], proj[2:], rtol=1e-5)
+
+
+def test_lstmp_identity_projection_equals_lstm():
+    """With P=D and ProjWeight=I, lstmp must reproduce the lstm op —
+    pins the (candidate, input, forget, output) gate layout against an
+    independent implementation."""
+    rng = np.random.RandomState(7)
+    T, D = 5, 3
+    x = rng.randn(T, 4 * D).astype("float32")
+    w = (rng.randn(D, 4 * D) * 0.3).astype("float32")
+    b = (rng.randn(1, 4 * D) * 0.1).astype("float32")
+    (h, c) = _run_op("lstm",
+                     {"Input": ["x"], "Weight": ["w"], "Bias": ["b"]},
+                     {"Hidden": ["h"], "Cell": ["c"]},
+                     {"use_peepholes": False},
+                     {"x": _lod_feed(x, [[0, 2, 5]]), "w": w, "b": b},
+                     ["h", "c"])
+    (p2, c2) = _run_op(
+        "lstmp",
+        {"Input": ["x2"], "Weight": ["w2"], "ProjWeight": ["pw"],
+         "Bias": ["b2"]},
+        {"Projection": ["p2"], "Cell": ["c2"]}, {},
+        {"x2": _lod_feed(x, [[0, 2, 5]]), "w2": w,
+         "pw": np.eye(D, dtype="float32"), "b2": b}, ["p2", "c2"])
+    np.testing.assert_allclose(p2, h, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(c2, c, rtol=1e-5, atol=1e-6)
