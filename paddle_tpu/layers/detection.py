@@ -463,3 +463,32 @@ def polygon_box_transform(input, name=None):
 __all__ += ["generate_proposals", "rpn_target_assign",
             "box_decoder_and_assign", "distribute_fpn_proposals",
             "collect_fpn_proposals", "polygon_box_transform"]
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """YOLOv3 training loss (reference detection.py:894 over
+    yolov3_loss_op.h; see ops/tail_ops2.py)."""
+    helper = LayerHelper("yolov3_loss", input=x, name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    obj_mask = helper.create_variable_for_type_inference(x.dtype)
+    match = helper.create_variable_for_type_inference("int32")
+    inputs = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        inputs["GTScore"] = [gt_score]
+    helper.append_op(
+        "yolov3_loss", inputs=inputs,
+        outputs={"Loss": [loss], "ObjectnessMask": [obj_mask],
+                 "GTMatchMask": [match]},
+        attrs={"anchors": list(anchors),
+               "anchor_mask": list(anchor_mask),
+               "class_num": class_num, "ignore_thresh": ignore_thresh,
+               "downsample_ratio": downsample_ratio,
+               "use_label_smooth": use_label_smooth},
+        infer_shape=False)
+    loss.shape = (int(x.shape[0]),)
+    return loss
+
+
+__all__ += ["yolov3_loss"]

@@ -315,3 +315,149 @@ def _lstmp(ins, attrs):
         cs = jnp.take_along_axis(cs, idx[:, :, None], axis=1)
     return {"Projection": _unpad_to_lod(rs, offsets),
             "Cell": _unpad_to_lod(cs, offsets)}
+
+
+@register_op(
+    "yolov3_loss",
+    inputs=[In("X"), In("GTBox", no_grad=True), In("GTLabel", no_grad=True),
+            In("GTScore", dispensable=True, no_grad=True)],
+    outputs=[Out("Loss"), Out("ObjectnessMask", no_grad=True),
+             Out("GTMatchMask", no_grad=True)],
+    attrs={"anchors": [], "anchor_mask": [], "class_num": 1,
+           "ignore_thresh": 0.7, "downsample_ratio": 32,
+           "use_label_smooth": True},
+)
+def _yolov3_loss(ins, attrs):
+    """YOLOv3 training loss (yolov3_loss_op.h): per-cell ignore mask by
+    best IoU vs gt, per-gt best-anchor matching, sigmoid-CE x/y +
+    L1 w/h location loss scaled by (2 - gt area), sigmoid-CE labels
+    (optionally smoothed), and objectness CE over positive/negative
+    cells. Ground truths are processed in order like the reference, so
+    a later gt overwrites a colliding cell's objectness while both
+    contribute their losses. Matching masks are gradient-stopped — the
+    reference grad kernel also treats them as constants."""
+    x = ins["X"]
+    gt_box = ins["GTBox"]                          # [N, B, 4] (cx,cy,w,h)
+    gt_label = ins["GTLabel"].astype(jnp.int32)    # [N, B]
+    anchors = [int(a) for a in attrs["anchors"]]
+    mask = [int(m) for m in attrs["anchor_mask"]]
+    C = int(attrs["class_num"])
+    ignore = float(attrs.get("ignore_thresh", 0.7))
+    down = int(attrs.get("downsample_ratio", 32))
+    N, _, H, W = x.shape
+    M = len(mask)
+    B = gt_box.shape[1]
+    input_size = down * H
+    an_num = len(anchors) // 2
+
+    gt_score = ins.get("GTScore")
+    if gt_score is None:
+        gt_score = jnp.ones((N, B), x.dtype)
+
+    xr = x.reshape(N, M, 5 + C, H, W)
+    tx, ty, tw, th = xr[:, :, 0], xr[:, :, 1], xr[:, :, 2], xr[:, :, 3]
+    tobj = xr[:, :, 4]
+    tcls = xr[:, :, 5:]                            # [N, M, C, H, W]
+
+    def sce(logit, label):
+        return (jnp.maximum(logit, 0.0) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    if attrs.get("use_label_smooth", True):
+        smooth = min(1.0 / C, 1.0 / 40)
+        pos_lab, neg_lab = 1.0 - smooth, smooth
+    else:
+        pos_lab, neg_lab = 1.0, 0.0
+
+    # ---- ignore mask: best pred-gt IoU per cell --------------------------
+    gx = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    aw = jnp.asarray([anchors[2 * m] for m in mask],
+                     x.dtype)[None, :, None, None]
+    ah = jnp.asarray([anchors[2 * m + 1] for m in mask],
+                     x.dtype)[None, :, None, None]
+    # reference GetYoloBox normalizes BOTH axes by grid_size = h (a
+    # reference quirk kept for bit-parity on non-square maps)
+    px = (gx + jax.nn.sigmoid(tx)) / H
+    py = (gy + jax.nn.sigmoid(ty)) / H
+    pw = jnp.exp(tw) * aw / input_size
+    ph = jnp.exp(th) * ah / input_size
+
+    # reference GtValid/LessEqualZero: w or h < 1e-6 -> invalid
+    valid = (gt_box[..., 2] >= 1e-6) & (gt_box[..., 3] >= 1e-6)  # [N, B]
+
+    def iou_xywh(x1, y1, w1, h1, x2, y2, w2, h2):
+        lo = jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)
+        hi = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2)
+        iw = jnp.maximum(hi - lo, 0.0)
+        lo = jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+        hi = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2)
+        ih = jnp.maximum(hi - lo, 0.0)
+        inter = iw * ih
+        return inter / (w1 * h1 + w2 * h2 - inter + 1e-10)
+
+    ious = iou_xywh(
+        px[..., None], py[..., None], pw[..., None], ph[..., None],
+        gt_box[:, None, None, None, :, 0], gt_box[:, None, None, None, :, 1],
+        gt_box[:, None, None, None, :, 2], gt_box[:, None, None, None, :, 3])
+    ious = jnp.where(valid[:, None, None, None, :], ious, 0.0)
+    best_iou = jax.lax.stop_gradient(ious.max(axis=-1))   # [N, M, H, W]
+    obj_mask = jnp.where(best_iou > ignore, -1.0, 0.0)
+
+    # ---- per-gt anchor matching + positive losses ------------------------
+    an_w = jnp.asarray(anchors[0::2], x.dtype) / input_size  # [A]
+    an_h = jnp.asarray(anchors[1::2], x.dtype) / input_size
+    loss = jnp.zeros((N,), x.dtype)
+    match_rows = []
+    mask_arr = np.full(an_num, -1, np.int32)
+    for mi, m in enumerate(mask):
+        mask_arr[m] = mi
+    mask_arr = jnp.asarray(mask_arr)
+    batch = jnp.arange(N)
+    for t in range(B):
+        gw, gh = gt_box[:, t, 2], gt_box[:, t, 3]
+        gx_t, gy_t = gt_box[:, t, 0], gt_box[:, t, 1]
+        inter = (jnp.minimum(an_w[None, :], gw[:, None])
+                 * jnp.minimum(an_h[None, :], gh[:, None]))
+        an_iou = inter / (an_w[None, :] * an_h[None, :]
+                          + (gw * gh)[:, None] - inter + 1e-10)
+        best_n = jnp.argmax(an_iou, axis=1)                # [N]
+        mi = mask_arr[best_n]                              # [N], -1 if out
+        v = valid[:, t]
+        match_rows.append(jnp.where(v, mi, -1))
+        gi = jnp.clip((gx_t * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gy_t * H).astype(jnp.int32), 0, H - 1)
+        # tx target also uses grid_size = h (CalcBoxLocationLoss)
+        on = v & (mi >= 0)
+        mi_c = jnp.maximum(mi, 0)
+        score = gt_score[:, t]
+        scale = (2.0 - gw * gh) * score
+        txv = gx_t * H - gi
+        tyv = gy_t * H - gj
+        twv = jnp.log(jnp.maximum(
+            gw * input_size / an_w[best_n] / input_size, 1e-10))
+        thv = jnp.log(jnp.maximum(
+            gh * input_size / an_h[best_n] / input_size, 1e-10))
+        px_l = tx[batch, mi_c, gj, gi]
+        py_l = ty[batch, mi_c, gj, gi]
+        pw_l = tw[batch, mi_c, gj, gi]
+        ph_l = th[batch, mi_c, gj, gi]
+        loc = (sce(px_l, txv) + sce(py_l, tyv)
+               + jnp.abs(twv - pw_l) + jnp.abs(thv - ph_l)) * scale
+        lab = tcls[batch, mi_c, :, gj, gi]                 # [N, C]
+        onehot = jax.nn.one_hot(gt_label[:, t], C, dtype=x.dtype)
+        lab_target = onehot * pos_lab + (1.0 - onehot) * neg_lab
+        cls_loss = (sce(lab, lab_target).sum(axis=1)) * score
+        loss = loss + jnp.where(on, loc + cls_loss, 0.0)
+        obj_mask = obj_mask.at[batch, mi_c, gj, gi].set(
+            jnp.where(on, score, obj_mask[batch, mi_c, gj, gi]))
+    obj_mask = jax.lax.stop_gradient(obj_mask)
+
+    # ---- objectness loss -------------------------------------------------
+    pos = jnp.where(obj_mask > 1e-5, sce(tobj, 1.0) * obj_mask, 0.0)
+    neg = jnp.where((obj_mask <= 1e-5) & (obj_mask > -0.5),
+                    sce(tobj, 0.0), 0.0)
+    loss = loss + (pos + neg).sum(axis=(1, 2, 3))
+
+    return {"Loss": loss, "ObjectnessMask": obj_mask,
+            "GTMatchMask": jnp.stack(match_rows, axis=1).astype(jnp.int32)}

@@ -208,3 +208,192 @@ def test_lstmp_identity_projection_equals_lstm():
          "pw": np.eye(D, dtype="float32"), "b2": b}, ["p2", "c2"])
     np.testing.assert_allclose(p2, h, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(c2, c, rtol=1e-5, atol=1e-6)
+
+
+def _np_yolov3_loss(x, gt_box, gt_label, anchors, mask, C, ignore,
+                    down, use_smooth, gt_score=None):
+    """Literal numpy port of yolov3_loss_op.h for the oracle."""
+    def sce(v, lab):
+        return max(v, 0.0) - v * lab + np.log1p(np.exp(-abs(v)))
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    N, _, H, W = x.shape
+    M, B = len(mask), gt_box.shape[1]
+    an_num = len(anchors) // 2
+    input_size = down * H
+    xr = x.reshape(N, M, 5 + C, H, W)
+    loss = np.zeros(N)
+    obj_mask = np.zeros((N, M, H, W))
+    if use_smooth:
+        sm = min(1.0 / C, 1.0 / 40)
+        posl, negl = 1 - sm, sm
+    else:
+        posl, negl = 1.0, 0.0
+
+    def iou(b1, b2):
+        lo = max(b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+        hi = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2)
+        iw = max(hi - lo, 0)
+        lo = max(b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+        hi = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2)
+        ih = max(hi - lo, 0)
+        inter = iw * ih
+        return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter + 1e-10)
+
+    for i in range(N):
+        for j in range(M):
+            for k in range(H):
+                for l in range(W):
+                    bx = (l + sig(xr[i, j, 0, k, l])) / H  # ref quirk
+                    by = (k + sig(xr[i, j, 1, k, l])) / H
+                    bw = np.exp(xr[i, j, 2, k, l]) * anchors[
+                        2 * mask[j]] / input_size
+                    bh = np.exp(xr[i, j, 3, k, l]) * anchors[
+                        2 * mask[j] + 1] / input_size
+                    best = 0.0
+                    for t in range(B):
+                        if gt_box[i, t, 2] < 1e-6 or gt_box[i, t, 3] < 1e-6:
+                            continue
+                        best = max(best, iou((bx, by, bw, bh),
+                                             gt_box[i, t]))
+                    if best > ignore:
+                        obj_mask[i, j, k, l] = -1
+        for t in range(B):
+            if gt_box[i, t, 2] < 1e-6 or gt_box[i, t, 3] < 1e-6:
+                continue
+            g = gt_box[i, t]
+            gi, gj = int(g[0] * W), int(g[1] * H)
+            best_iou, best_n = 0.0, 0
+            for a in range(an_num):
+                ab = (0, 0, anchors[2 * a] / input_size,
+                      anchors[2 * a + 1] / input_size)
+                v = iou(ab, (0, 0, g[2], g[3]))
+                if v > best_iou:
+                    best_iou, best_n = v, a
+            if best_n not in mask:
+                continue
+            mi = mask.index(best_n)
+            score = 1.0 if gt_score is None else gt_score[i, t]
+            scale = (2.0 - g[2] * g[3]) * score
+            tx = g[0] * H - gi  # ref quirk: grid_size = h
+            ty = g[1] * H - gj
+            tw = np.log(g[2] * input_size / anchors[2 * best_n])
+            th = np.log(g[3] * input_size / anchors[2 * best_n + 1])
+            loss[i] += sce(xr[i, mi, 0, gj, gi], tx) * scale
+            loss[i] += sce(xr[i, mi, 1, gj, gi], ty) * scale
+            loss[i] += abs(tw - xr[i, mi, 2, gj, gi]) * scale
+            loss[i] += abs(th - xr[i, mi, 3, gj, gi]) * scale
+            obj_mask[i, mi, gj, gi] = score
+            for c in range(C):
+                lab = posl if c == gt_label[i, t] else negl
+                loss[i] += sce(xr[i, mi, 5 + c, gj, gi], lab) * score
+        for j in range(M):
+            for k in range(H):
+                for l in range(W):
+                    o = obj_mask[i, j, k, l]
+                    if o > 1e-5:
+                        loss[i] += sce(xr[i, j, 4, k, l], 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += sce(xr[i, j, 4, k, l], 0.0)
+    return loss
+
+
+def test_yolov3_loss_matches_numpy_oracle():
+    rng = np.random.RandomState(9)
+    N, H, W, C = 2, 4, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1]
+    M = len(mask)
+    x = (rng.randn(N, M * (5 + C), H, W) * 0.5).astype("float32")
+    gt_box = rng.rand(N, 3, 4).astype("float32") * 0.4 + 0.1
+    gt_box[0, 2] = 0  # invalid gt
+    gt_label = rng.randint(0, C, (N, 3)).astype("int32")
+    (loss, obj, match) = _run_op(
+        "yolov3_loss",
+        {"X": ["x"], "GTBox": ["gb"], "GTLabel": ["gl"]},
+        {"Loss": ["loss"], "ObjectnessMask": ["obj"],
+         "GTMatchMask": ["match"]},
+        {"anchors": anchors, "anchor_mask": mask, "class_num": C,
+         "ignore_thresh": 0.5, "downsample_ratio": 32,
+         "use_label_smooth": True},
+        {"x": x, "gb": gt_box, "gl": gt_label}, ["loss", "obj", "match"])
+    ref = _np_yolov3_loss(x, gt_box, gt_label, anchors, mask, C, 0.5,
+                          32, True)
+    np.testing.assert_allclose(loss, ref, rtol=1e-4, atol=1e-4)
+    assert match.shape == (N, 3)
+    assert match[0, 2] == -1  # invalid gt unmatched
+
+
+def test_yolov3_loss_grads_flow():
+    rng = np.random.RandomState(10)
+    N, H, W, C = 1, 4, 4, 2
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.data(name="x", shape=[N, 2 * (5 + C), H, W],
+                        dtype="float32")
+        gb = fluid.data(name="gb", shape=[N, 2, 4], dtype="float32")
+        gl = fluid.data(name="gl", shape=[N, 2], dtype="int32")
+        feat = fluid.layers.conv2d(
+            xv, num_filters=2 * (5 + C), filter_size=1,
+            param_attr=fluid.ParamAttr(name="yolo_w"), bias_attr=False)
+        out = prog.global_block().create_var(name="yl", dtype="float32")
+        out.shape = (N,)
+        obj = prog.global_block().create_var(name="om", dtype="float32")
+        mm = prog.global_block().create_var(name="mm", dtype="int32")
+        prog.global_block().append_op(
+            "yolov3_loss",
+            inputs={"X": [feat.name], "GTBox": ["gb"], "GTLabel": ["gl"]},
+            outputs={"Loss": ["yl"], "ObjectnessMask": ["om"],
+                     "GTMatchMask": ["mm"]},
+            attrs={"anchors": [10, 13, 16, 30], "anchor_mask": [0, 1],
+                   "class_num": C, "ignore_thresh": 0.5,
+                   "downsample_ratio": 32, "use_label_smooth": False},
+            infer_shape=False)
+        loss = fluid.layers.mean(prog.global_block().var("yl"))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.asarray(scope.find_var("yolo_w").raw().array).copy()
+        exe.run(prog, feed={
+            "x": rng.randn(N, 2 * (5 + C), H, W).astype("float32"),
+            "gb": (rng.rand(N, 2, 4) * 0.4 + 0.1).astype("float32"),
+            "gl": rng.randint(0, C, (N, 2)).astype("int32")},
+            fetch_list=[loss])
+        w1 = np.asarray(scope.find_var("yolo_w").raw().array)
+    assert not np.allclose(w0, w1)
+
+
+def test_yolov3_loss_nonsquare_and_scores():
+    """Non-square grid (reference's grid_size=h quirk) + GTScore
+    (mixup) weighting, both against the numpy oracle."""
+    rng = np.random.RandomState(11)
+    N, H, W, C = 1, 3, 6, 2
+    anchors = [10, 13, 16, 30]
+    mask = [0, 1]
+    x = (rng.randn(N, 2 * (5 + C), H, W) * 0.5).astype("float32")
+    gt_box = (rng.rand(N, 2, 4) * 0.3 + 0.1).astype("float32")
+    gt_label = rng.randint(0, C, (N, 2)).astype("int32")
+    gt_score = np.array([[0.7, 0.3]], "float32")
+    (loss, obj, match) = _run_op(
+        "yolov3_loss",
+        {"X": ["x"], "GTBox": ["gb"], "GTLabel": ["gl"],
+         "GTScore": ["gs"]},
+        {"Loss": ["loss"], "ObjectnessMask": ["obj"],
+         "GTMatchMask": ["match"]},
+        {"anchors": anchors, "anchor_mask": mask, "class_num": C,
+         "ignore_thresh": 0.5, "downsample_ratio": 32,
+         "use_label_smooth": False},
+        {"x": x, "gb": gt_box, "gl": gt_label, "gs": gt_score},
+        ["loss", "obj", "match"])
+    ref = _np_yolov3_loss(x, gt_box, gt_label, anchors, mask, C, 0.5,
+                          32, False, gt_score=gt_score)
+    np.testing.assert_allclose(loss, ref, rtol=1e-4, atol=1e-4)
+    # matched cells carry the mixup score, not 1.0
+    matched_vals = obj[obj > 1e-5]
+    assert matched_vals.size > 0
+    rounded = set(np.round(matched_vals.astype(np.float64), 3))
+    assert rounded <= {0.7, 0.3}, rounded
