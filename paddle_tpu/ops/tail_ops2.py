@@ -461,3 +461,59 @@ def _yolov3_loss(ins, attrs):
 
     return {"Loss": loss, "ObjectnessMask": obj_mask,
             "GTMatchMask": jnp.stack(match_rows, axis=1).astype(jnp.int32)}
+
+
+@register_host_op(
+    "psroi_pool",
+    inputs=[In("X", no_grad=True), In("ROIs", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"output_channels": 1, "spatial_scale": 1.0,
+           "pooled_height": 1, "pooled_width": 1},
+)
+def _psroi_pool(executor, op, scope):
+    """Position-sensitive ROI average pooling (psroi_pool_op.h): output
+    bin (c, ph, pw) averages input channel (c*PH + ph)*PW + pw over the
+    bin's spatial window; ROI batch ids come from the ROIs LoD. Host op
+    — the windows are value-dependent (like roi rows/NMS)."""
+    x = np.asarray(executor._read_var(scope, op.input("X")[0]))
+    rois_t = scope.find_var(op.input("ROIs")[0]).get_tensor()
+    rois = rois_t.numpy().reshape(-1, 4)
+    a = op.attrs
+    oc = int(a["output_channels"])
+    ph_n = int(a["pooled_height"])
+    pw_n = int(a["pooled_width"])
+    scale = float(a.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    if C != oc * ph_n * pw_n:
+        raise ValueError(
+            "psroi_pool: channels %d != output_channels*PH*PW = %d"
+            % (C, oc * ph_n * pw_n))
+    lod = rois_t.lod()
+    offsets = list(lod[0]) if lod else [0, rois.shape[0]]
+    batch_ids = np.zeros(rois.shape[0], np.int32)
+    for i in range(len(offsets) - 1):
+        batch_ids[offsets[i]:offsets[i + 1]] = i
+
+    out = np.zeros((rois.shape[0], oc, ph_n, pw_n), x.dtype)
+    for r in range(rois.shape[0]):
+        x0 = round(float(rois[r, 0])) * scale
+        y0 = round(float(rois[r, 1])) * scale
+        x1 = (round(float(rois[r, 2])) + 1.0) * scale
+        y1 = (round(float(rois[r, 3])) + 1.0) * scale
+        rh = max(y1 - y0, 0.1)
+        rw = max(x1 - x0, 0.1)
+        bh, bw = rh / ph_n, rw / pw_n
+        plane = x[batch_ids[r]]
+        for c in range(oc):
+            for ph in range(ph_n):
+                for pw in range(pw_n):
+                    hs = min(max(int(np.floor(ph * bh + y0)), 0), H)
+                    he = min(max(int(np.ceil((ph + 1) * bh + y0)), 0), H)
+                    ws = min(max(int(np.floor(pw * bw + x0)), 0), W)
+                    we = min(max(int(np.ceil((pw + 1) * bw + x0)), 0), W)
+                    ch = (c * ph_n + ph) * pw_n + pw
+                    if he > hs and we > ws:
+                        win = plane[ch, hs:he, ws:we]
+                        out[r, c, ph, pw] = win.sum() / (
+                            (he - hs) * (we - ws))
+    executor._write_var(scope, op.output("Out")[0], out)

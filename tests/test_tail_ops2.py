@@ -397,3 +397,22 @@ def test_yolov3_loss_nonsquare_and_scores():
     assert matched_vals.size > 0
     rounded = set(np.round(matched_vals.astype(np.float64), 3))
     assert rounded <= {0.7, 0.3}, rounded
+
+
+def test_psroi_pool():
+    # C = oc(2) * PH(2) * PW(2) = 8
+    rng = np.random.RandomState(12)
+    x = rng.randn(1, 8, 6, 6).astype("float32")
+    rois = np.array([[0, 0, 3, 3]], "float32")
+    (o,) = _run_op("psroi_pool", {"X": ["x"], "ROIs": ["r"]},
+                   {"Out": ["o"]},
+                   {"output_channels": 2, "pooled_height": 2,
+                    "pooled_width": 2, "spatial_scale": 1.0},
+                   {"x": x, "r": _lod_feed(rois, [[0, 1]])}, ["o"])
+    assert o.shape == (1, 2, 2, 2)
+    # bin (c=0, ph=0, pw=0): channel 0, window rows/cols [0, 2)
+    np.testing.assert_allclose(o[0, 0, 0, 0], x[0, 0, 0:2, 0:2].mean(),
+                               rtol=1e-5)
+    # bin (c=1, ph=1, pw=1): channel (1*2+1)*2+1 = 7, rows/cols [2, 4)
+    np.testing.assert_allclose(o[0, 1, 1, 1], x[0, 7, 2:4, 2:4].mean(),
+                               rtol=1e-5)
