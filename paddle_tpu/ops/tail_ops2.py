@@ -463,57 +463,67 @@ def _yolov3_loss(ins, attrs):
             "GTMatchMask": jnp.stack(match_rows, axis=1).astype(jnp.int32)}
 
 
-@register_host_op(
+@register_op(
     "psroi_pool",
-    inputs=[In("X", no_grad=True), In("ROIs", no_grad=True)],
+    inputs=[In("X"), In("ROIs", no_grad=True)],
     outputs=[Out("Out")],
     attrs={"output_channels": 1, "spatial_scale": 1.0,
            "pooled_height": 1, "pooled_width": 1},
+    needs_lod=True,
 )
-def _psroi_pool(executor, op, scope):
+def _psroi_pool(ins, attrs):
     """Position-sensitive ROI average pooling (psroi_pool_op.h): output
     bin (c, ph, pw) averages input channel (c*PH + ph)*PW + pw over the
-    bin's spatial window; ROI batch ids come from the ROIs LoD. Host op
-    — the windows are value-dependent (like roi rows/NMS)."""
-    x = np.asarray(executor._read_var(scope, op.input("X")[0]))
-    rois_t = scope.find_var(op.input("ROIs")[0]).get_tensor()
-    rois = rois_t.numpy().reshape(-1, 4)
-    a = op.attrs
-    oc = int(a["output_channels"])
-    ph_n = int(a["pooled_height"])
-    pw_n = int(a["pooled_width"])
-    scale = float(a.get("spatial_scale", 1.0))
+    bin's window. Differentiable masked-mean formulation (like
+    roi_align here): bin membership masks over the full plane instead
+    of value-dependent slicing, so grads reach the backbone and the op
+    jits."""
+    from .lod_utils import batch_ids_for
+
+    x = ins["X"]                                   # [N, C, H, W]
+    rois = ins["ROIs"]                             # [R, 4]
+    oc = int(attrs.get("output_channels", 1))
+    ph_n = int(attrs.get("pooled_height", 1))
+    pw_n = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
     N, C, H, W = x.shape
     if C != oc * ph_n * pw_n:
         raise ValueError(
             "psroi_pool: channels %d != output_channels*PH*PW = %d"
             % (C, oc * ph_n * pw_n))
-    lod = rois_t.lod()
-    offsets = list(lod[0]) if lod else [0, rois.shape[0]]
-    batch_ids = np.zeros(rois.shape[0], np.int32)
-    for i in range(len(offsets) - 1):
-        batch_ids[offsets[i]:offsets[i + 1]] = i
+    R = rois.shape[0]
+    batch_ids = batch_ids_for(attrs, "ROIs", R)
 
-    out = np.zeros((rois.shape[0], oc, ph_n, pw_n), x.dtype)
-    for r in range(rois.shape[0]):
-        x0 = round(float(rois[r, 0])) * scale
-        y0 = round(float(rois[r, 1])) * scale
-        x1 = (round(float(rois[r, 2])) + 1.0) * scale
-        y1 = (round(float(rois[r, 3])) + 1.0) * scale
-        rh = max(y1 - y0, 0.1)
-        rw = max(x1 - x0, 0.1)
-        bh, bw = rh / ph_n, rw / pw_n
-        plane = x[batch_ids[r]]
-        for c in range(oc):
-            for ph in range(ph_n):
-                for pw in range(pw_n):
-                    hs = min(max(int(np.floor(ph * bh + y0)), 0), H)
-                    he = min(max(int(np.ceil((ph + 1) * bh + y0)), 0), H)
-                    ws = min(max(int(np.floor(pw * bw + x0)), 0), W)
-                    we = min(max(int(np.ceil((pw + 1) * bw + x0)), 0), W)
-                    ch = (c * ph_n + ph) * pw_n + pw
-                    if he > hs and we > ws:
-                        win = plane[ch, hs:he, ws:we]
-                        out[r, c, ph, pw] = win.sum() / (
-                            (he - hs) * (we - ws))
-    executor._write_var(scope, op.output("Out")[0], out)
+    x0 = jnp.round(rois[:, 0]) * scale
+    y0 = jnp.round(rois[:, 1]) * scale
+    x1 = (jnp.round(rois[:, 2]) + 1.0) * scale
+    y1 = (jnp.round(rois[:, 3]) + 1.0) * scale
+    rh = jnp.maximum(y1 - y0, 0.1)
+    rw = jnp.maximum(x1 - x0, 0.1)
+    bh = rh / ph_n                                 # [R]
+    bw = rw / pw_n
+
+    ph = jnp.arange(ph_n, dtype=x.dtype)
+    pw = jnp.arange(pw_n, dtype=x.dtype)
+    hs = jnp.floor(ph[None, :] * bh[:, None] + y0[:, None])    # [R, PH]
+    he = jnp.ceil((ph[None, :] + 1) * bh[:, None] + y0[:, None])
+    ws = jnp.floor(pw[None, :] * bw[:, None] + x0[:, None])    # [R, PW]
+    we = jnp.ceil((pw[None, :] + 1) * bw[:, None] + x0[:, None])
+    hs = jnp.clip(hs, 0, H)
+    he = jnp.clip(he, 0, H)
+    ws = jnp.clip(ws, 0, W)
+    we = jnp.clip(we, 0, W)
+
+    hh = jnp.arange(H, dtype=x.dtype)
+    wwv = jnp.arange(W, dtype=x.dtype)
+    mask_h = ((hh[None, None, :] >= hs[:, :, None])
+              & (hh[None, None, :] < he[:, :, None])).astype(x.dtype)
+    mask_w = ((wwv[None, None, :] >= ws[:, :, None])
+              & (wwv[None, None, :] < we[:, :, None])).astype(x.dtype)
+    count = ((he - hs)[:, :, None] * (we - ws)[:, None, :])    # [R,PH,PW]
+
+    xr = x[batch_ids].reshape(R, oc, ph_n, pw_n, H, W)
+    sums = jnp.einsum("rcpqhw,rph,rqw->rcpq", xr, mask_h, mask_w)
+    out = jnp.where(count[:, None] > 0, sums / jnp.maximum(
+        count[:, None], 1.0), 0.0)
+    return {"Out": out.astype(x.dtype)}

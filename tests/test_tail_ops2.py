@@ -416,3 +416,40 @@ def test_psroi_pool():
     # bin (c=1, ph=1, pw=1): channel (1*2+1)*2+1 = 7, rows/cols [2, 4)
     np.testing.assert_allclose(o[0, 1, 1, 1], x[0, 7, 2:4, 2:4].mean(),
                                rtol=1e-5)
+
+
+def test_psroi_pool_grads_flow():
+    """Masked-mean formulation keeps psroi differentiable — a backbone
+    conv upstream must receive gradients."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.data(name="x", shape=[1, 8, 6, 6], dtype="float32")
+        feat = fluid.layers.conv2d(
+            xv, num_filters=8, filter_size=1,
+            param_attr=fluid.ParamAttr(name="ps_w"), bias_attr=False)
+        r = fluid.layers.data(name="r", shape=[4], dtype="float32",
+                              lod_level=1)
+        blk = prog.global_block()
+        out = blk.create_var(name="ps_out", dtype="float32")
+        out.shape = (1, 2, 2, 2)
+        blk.append_op("psroi_pool",
+                      inputs={"X": [feat.name], "ROIs": ["r"]},
+                      outputs={"Out": ["ps_out"]},
+                      attrs={"output_channels": 2, "pooled_height": 2,
+                             "pooled_width": 2, "spatial_scale": 1.0},
+                      infer_shape=False)
+        loss = fluid.layers.mean(
+            fluid.layers.square(blk.var("ps_out")))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    t = _lod_feed(np.array([[0, 0, 3, 3]], "float32"), [[0, 1]])
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.asarray(scope.find_var("ps_w").raw().array).copy()
+        exe.run(prog,
+                feed={"x": np.random.RandomState(0).randn(
+                    1, 8, 6, 6).astype("float32"), "r": t},
+                fetch_list=[loss])
+        w1 = np.asarray(scope.find_var("ps_w").raw().array)
+    assert not np.allclose(w0, w1)
