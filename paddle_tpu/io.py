@@ -147,6 +147,14 @@ def _serialize_program(program) -> Dict:
 
 
 def _deserialize_program(data: Dict) -> framework.Program:
+    # versioned interchange (reference framework.proto carries a
+    # version message + op compatibility map): reject formats newer
+    # than this build understands instead of misparsing them
+    version = data.get("version", 1)
+    if version > 1:
+        raise RuntimeError(
+            "model format version %d is newer than this build "
+            "supports (1); upgrade paddle_tpu to load it" % version)
     program = framework.Program()
     program.blocks = []
     for bd in data["blocks"]:
