@@ -283,3 +283,60 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
 
 
 __all__ += ["nce", "hsigmoid"]
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """Sampled softmax CE (reference layers/loss.py:1010 over
+    sample_logits_op.h): softmax over [true | S sampled] classes with
+    logits corrected by -log q."""
+    from ..layer_helper import LayerHelper
+    from .nn import one_hot
+
+    helper = LayerHelper("sample_logits", input=logits)
+    if use_customized_samples:
+        samples = customized_samples
+        probabilities = customized_probabilities
+    else:
+        samples = helper.create_variable_for_type_inference("int64")
+        probabilities = helper.create_variable_for_type_inference(
+            logits.dtype)
+    sampled_logits = helper.create_variable_for_type_inference(
+        logits.dtype)
+    sampled_label = helper.create_variable_for_type_inference("int64")
+    inputs = {"Logits": [logits], "Labels": [label]}
+    if use_customized_samples:
+        inputs["CustomizedSamples"] = [samples]
+        inputs["CustomizedProbabilities"] = [probabilities]
+    helper.append_op(
+        "sample_logits", inputs=inputs,
+        outputs={"Samples": [samples], "Probabilities": [probabilities],
+                 "SampledLogits": [sampled_logits],
+                 "SampledLabels": [sampled_label]},
+        attrs={"use_customized_samples": use_customized_samples,
+               "uniq": True,
+               "remove_accidental_hits": remove_accidental_hits,
+               "num_samples": num_samples, "seed": seed},
+        infer_shape=False)
+    n = int(logits.shape[0])
+    sampled_logits.shape = (n, num_true + num_samples)
+    sampled_label.shape = (n, num_true)
+    soft = one_hot(sampled_label, num_true + num_samples)
+    if num_true > 1:
+        # [N, T, T+S] -> a valid [N, T+S] soft distribution (mass 1/T
+        # on each true position)
+        from .nn import reduce_sum
+        from .ops import scale as _scale
+
+        soft = _scale(reduce_sum(soft, dim=1), scale=1.0 / num_true)
+    loss = softmax_with_cross_entropy(sampled_logits, soft,
+                                      soft_label=True)
+    return loss
+
+
+__all__ += ["sampled_softmax_with_cross_entropy"]

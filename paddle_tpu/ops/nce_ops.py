@@ -42,14 +42,21 @@ def _sample_negatives(key, sampler, num_neg, batch, num_classes, probs):
                                   shape=(batch, num_neg)).astype(jnp.int32)
 
 
+def _log_uniform_prob(k, range_):
+    """LogUniformSampler pmf: P(k) = log((k+2)/(k+1)) / log(range+1)
+    (math/sampler.cc; `range_` follows each caller's reference
+    convention: C-1 for nce, C for sample_logits)."""
+    import jax.numpy as jnp
+
+    kf = k.astype(jnp.float32) if hasattr(k, "astype") else float(k)
+    return jnp.log((kf + 2.0) / (kf + 1.0)) / math.log(range_ + 1.0)
+
+
 def _sampler_prob(sampler, targets, num_classes, probs):
     if sampler == 0:
         return jnp.full(targets.shape, 1.0 / num_classes, jnp.float32)
     if sampler == 1:
-        # Probability(k) = log((k+2)/(k+1)) / log(range+1)
-        rng_range = num_classes - 1
-        k = targets.astype(jnp.float32)
-        return jnp.log((k + 2.0) / (k + 1.0)) / math.log(rng_range + 1.0)
+        return _log_uniform_prob(targets, num_classes - 1)
     return probs[targets]
 
 

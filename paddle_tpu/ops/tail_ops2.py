@@ -527,3 +527,77 @@ def _psroi_pool(ins, attrs):
     out = jnp.where(count[:, None] > 0, sums / jnp.maximum(
         count[:, None], 1.0), 0.0)
     return {"Out": out.astype(x.dtype)}
+
+
+@register_op(
+    "sample_logits",
+    inputs=[In("Logits"), In("Labels", no_grad=True),
+            In("CustomizedSamples", dispensable=True, no_grad=True),
+            In("CustomizedProbabilities", dispensable=True, no_grad=True)],
+    outputs=[Out("Samples", no_grad=True),
+             Out("Probabilities", no_grad=True),
+             Out("SampledLogits"), Out("SampledLabels", no_grad=True),
+             Out("LogitsDim", no_grad=True, dispensable=True),
+             Out("LabelsDim", no_grad=True, dispensable=True)],
+    attrs={"use_customized_samples": False, "uniq": True,
+           "remove_accidental_hits": True, "num_samples": 1, "seed": 0},
+    needs_rng=True,
+)
+def _sample_logits(ins, attrs):
+    """Sampled-softmax support op (sample_logits_op.h): per row emit
+    [true labels | S log-uniform UNIQUE samples], gather their logits,
+    subtract log q, and knock accidental hits down by 1e20.
+
+    TPU-native sampling: unique log-uniform draws come from the Gumbel
+    top-k trick (one shot, static shapes) instead of the reference's
+    rejection loop; the uniqueness adjustment therefore uses
+    q = -expm1(S * log1p(-p)) (num_tries = S), the standard
+    sampled-softmax formula — exact when collisions are rare."""
+    from ..core.registry import RNG_SEED_ATTR
+
+    logits = ins["Logits"]                         # [N, K]
+    labels = ins["Labels"].astype(jnp.int32)       # [N, T]
+    N, K = logits.shape
+    T = labels.shape[1]
+    S = int(attrs["num_samples"])
+    kAppro = 1e20
+
+    if attrs.get("use_customized_samples"):
+        samples = ins["CustomizedSamples"].astype(jnp.int32)
+        probs = ins["CustomizedProbabilities"]
+    else:
+        from .nce_ops import _log_uniform_prob
+
+        ks = jnp.arange(K, dtype=jnp.float32)
+        # LogUniformSampler(num_classes): P(k)=log((k+2)/(k+1))/log(K+1)
+        p = _log_uniform_prob(ks, K)
+        key = jax.random.fold_in(jax.random.PRNGKey(ins[RNG_SEED_ATTR]),
+                                 int(attrs.get("seed", 0)))
+        # ONE unique sample set shared by all rows, like the reference's
+        # CPUSampleWithProb — O(K), not O(N*K)
+        g = jax.random.gumbel(key, (K,))
+        _, sampled = jax.lax.top_k(jnp.log(p) + g, S)           # [S]
+        samples = jnp.concatenate(
+            [labels, jnp.broadcast_to(sampled.astype(jnp.int32)[None, :],
+                                      (N, S))], axis=1)         # [N, T+S]
+        q = -jnp.expm1(S * jnp.log1p(-p))
+        probs = q[samples]
+
+    sampled_logits = jnp.take_along_axis(logits, samples, axis=1)
+    if attrs.get("remove_accidental_hits", True):
+        acc = (samples[:, None, T:] == labels[:, :, None]).any(axis=1)
+        acc = jnp.concatenate(
+            [jnp.zeros((N, T), bool), acc], axis=1)
+        sampled_logits = sampled_logits - acc.astype(logits.dtype) * kAppro
+    sampled_logits = sampled_logits - jnp.clip(
+        jnp.log(probs), -kAppro, kAppro)
+    # int32 throughout: jax's default int width (int64 truncates with
+    # a warning unless x64 is enabled)
+    sampled_labels = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None, :], (N, T))
+    return {"Samples": samples.astype(jnp.int32),
+            "Probabilities": probs.astype(logits.dtype),
+            "SampledLogits": sampled_logits,
+            "SampledLabels": sampled_labels,
+            "LogitsDim": jnp.asarray([N, K], jnp.int32),
+            "LabelsDim": jnp.asarray([N, T], jnp.int32)}

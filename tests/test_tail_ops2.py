@@ -453,3 +453,66 @@ def test_psroi_pool_grads_flow():
                 fetch_list=[loss])
         w1 = np.asarray(scope.find_var("ps_w").raw().array)
     assert not np.allclose(w0, w1)
+
+
+def test_sample_logits_contract():
+    rng = np.random.RandomState(13)
+    N, K, S = 4, 12, 5
+    logits = rng.randn(N, K).astype("float32")
+    labels = rng.randint(0, K, (N, 1)).astype("int64")
+    (samples, probs, slog, slab) = _run_op(
+        "sample_logits",
+        {"Logits": ["lg"], "Labels": ["lb"]},
+        {"Samples": ["sm"], "Probabilities": ["pr"],
+         "SampledLogits": ["sl"], "SampledLabels": ["sb"]},
+        {"num_samples": S, "remove_accidental_hits": True,
+         "use_customized_samples": False, "uniq": True, "seed": 3},
+        {"lg": logits, "lb": labels}, ["sm", "pr", "sl", "sb"])
+    assert samples.shape == (N, 1 + S)
+    # col 0 is the true label; sampled columns are unique per row
+    np.testing.assert_array_equal(samples[:, 0], labels.ravel())
+    for r in range(N):
+        assert len(set(samples[r, 1:].tolist())) == S
+    # sampled logits = logits - log q (+ accidental-hit knockdown)
+    q = probs
+    gathered = np.take_along_axis(logits, samples.astype(int), axis=1)
+    acc = samples[:, 1:] == labels
+    expected = gathered - np.log(q)
+    expected[:, 1:][acc] -= 1e20
+    np.testing.assert_allclose(slog, expected, rtol=1e-4)
+    np.testing.assert_array_equal(slab, np.zeros((N, 1)))
+
+
+def test_sampled_softmax_equals_full_when_covering():
+    """With customized samples covering every class and uniform q, the
+    sampled loss reduces to full softmax cross entropy."""
+    rng = np.random.RandomState(14)
+    N, K = 3, 6
+    logits_v = rng.randn(N, K).astype("float32")
+    labels_v = rng.randint(0, K, (N, 1)).astype("int64")
+    # row: [label, all other classes]
+    samples_v = np.stack([
+        np.concatenate([labels_v[i], np.setdiff1d(np.arange(K),
+                                                  labels_v[i])])
+        for i in range(N)]).astype("int64")
+    probs_v = np.full((N, K), 1.0, "float32")  # log q = 0
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        lg = fluid.data(name="lg", shape=[N, K], dtype="float32")
+        lb = fluid.data(name="lb", shape=[N, 1], dtype="int64")
+        cs = fluid.data(name="cs", shape=[N, K], dtype="int64")
+        cp = fluid.data(name="cp", shape=[N, K], dtype="float32")
+        loss = fluid.layers.sampled_softmax_with_cross_entropy(
+            lg, lb, num_samples=K - 1, use_customized_samples=True,
+            customized_samples=cs, customized_probabilities=cp,
+            remove_accidental_hits=False)
+        full = fluid.layers.softmax_with_cross_entropy(lg, lb)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        (a, b) = exe.run(prog, feed={"lg": logits_v, "lb": labels_v,
+                                     "cs": samples_v, "cp": probs_v},
+                         fetch_list=[loss, full])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                               atol=1e-5)
