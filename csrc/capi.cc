@@ -118,6 +118,11 @@ PD_Predictor *PD_NewPredictor(const char *model_dir) {
 int PD_PredictorRun(PD_Predictor *p, const char *input_name,
                     const float *data, const int64_t *shape, int ndims,
                     float *out, int64_t out_capacity, int64_t *out_size) {
+  // out_size must never be left uninitialized: callers that check it
+  // before rc would otherwise read garbage on early-failure paths. It
+  // carries the produced element count on success (and on the
+  // buffer-too-small failure, so callers can resize); 0 otherwise.
+  if (out_size) *out_size = 0;
   if (!p || !p->predictor) {
     std::lock_guard<std::mutex> lk(g_mu);
     set_error("null predictor");
@@ -167,7 +172,7 @@ int PD_PredictorRun(PD_Predictor *p, const char *input_name,
                             : nullptr;
     if (bytes) {
       int64_t n = PyBytes_Size(bytes) / (int64_t)sizeof(float);
-      *out_size = n;
+      if (out_size) *out_size = n;
       if (n <= out_capacity) {
         std::memcpy(out, PyBytes_AsString(bytes), n * sizeof(float));
         rc = 0;

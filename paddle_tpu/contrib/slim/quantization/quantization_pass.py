@@ -28,6 +28,12 @@ _QUANT_SLOTS = {
 }
 _WEIGHT_SLOT = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
                 "mul": "Y"}
+# canonical output slot per quantizable op type — index-0 of
+# output_arg_names() is only correct for single-output ops, and slot
+# iteration order would pick an arbitrary output if quantizable_op_type
+# ever grows a multi-output member
+_OUT_SLOT = {"conv2d": "Output", "depthwise_conv2d": "Output",
+             "mul": "Out"}
 
 
 def _quantized_var_name(name):
@@ -216,7 +222,9 @@ class QuantizationFreezePass:
                     w_scale = _scale_var_name(name)
             if w_scale is None:
                 continue
-            out = op.output_arg_names()[0]
+            oslot = _OUT_SLOT.get(op.op_type())
+            out = (op.output(oslot)[0] if oslot
+                   else op.output_arg_names()[0])
             deq_out = out + ".dequantized"
             graph.create_var_node(deq_out)
             # rename consumers BEFORE inserting the dequant op so its

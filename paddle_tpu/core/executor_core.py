@@ -329,7 +329,14 @@ class CoreExecutor:
             gc_plan = self._gc_plan_cache.get(key)
             if gc_plan is None:
                 gc_plan = self._build_gc_plan(program, protect)
+                # bounded LRU: old program versions keep dead keys alive
+                # in long-lived executors that mutate programs
+                if len(self._gc_plan_cache) >= 64:
+                    self._gc_plan_cache.pop(
+                        next(iter(self._gc_plan_cache)))
                 self._gc_plan_cache[key] = gc_plan
+            else:
+                self._gc_plan_cache[key] = self._gc_plan_cache.pop(key)
         self.run_block(program.global_block(), scope, gc_plan=gc_plan)
         self.rng.advance()
 

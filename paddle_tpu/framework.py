@@ -240,6 +240,10 @@ class Block:
                 infer_op_shapes(self, op)
             except Exception:
                 if OpInfoMap.instance().has(type):
+                    # roll the failed op back out so a caller that
+                    # catches the build error isn't left with a
+                    # poisoned block that re-raises at exe.run
+                    self.ops.pop()
                     raise
         return op
 

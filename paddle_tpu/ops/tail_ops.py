@@ -27,15 +27,20 @@ from ..core.registry import In, Out, register_host_op, register_op
 # -- shape ops (v1: no XShape output) ---------------------------------------
 
 
+def _squeeze_v1_infer(ins, attrs):
+    from .tensor_ops import _squeeze_infer
+
+    return _squeeze_infer(ins, attrs, "squeeze", False)
+
+
 @register_op("squeeze", inputs=[In("X")], outputs=[Out("Out")],
-             attrs={"axes": []})
+             attrs={"axes": []}, infer_shape=_squeeze_v1_infer)
 def _squeeze(ins, attrs):
+    from .tensor_ops import normalize_squeeze_axes
+
     x = ins["X"]
-    axes = [int(a) for a in attrs.get("axes", [])]
-    if not axes:
-        axes = [i for i, s in enumerate(x.shape) if s == 1]
-    axes = [a + x.ndim if a < 0 else a for a in axes]
-    shape = [s for i, s in enumerate(x.shape) if i not in axes or s != 1]
+    axes = normalize_squeeze_axes(x, attrs.get("axes"), "squeeze")
+    shape = [s for i, s in enumerate(x.shape) if i not in axes]
     return {"Out": x.reshape(shape)}
 
 

@@ -306,16 +306,64 @@ def _flatten_range(ins, attrs):
     return {"Out": x.reshape(shape), "XShape": _xshape(x)}
 
 
+def normalize_squeeze_axes(x, explicit, op_name, at_infer=False):
+    """Shared squeeze/squeeze2 axis resolution: explicit axes must be in
+    [-ndim, ndim) (squeeze_op.cc axis enforce) and name size-1 dims;
+    empty axes means every size-1 dim. The size==1 check goes beyond
+    the reference (squeeze_op.cc drops a listed non-unit dim
+    unconditionally, silently corrupting numel) — we fail loudly
+    instead. At graph-build infer (at_infer=True) dims marked unknown
+    (-1 → sentinel) are exempt and dropped like the reference does;
+    runtime shapes are always concrete and fully checked. Duplicate
+    axes (e.g. 1 and -2 on rank 3) collapse to one."""
+    if not explicit:
+        return sorted(i for i, d in enumerate(x.shape) if d == 1)
+    axes = set()
+    for a in (int(a) for a in explicit):
+        if not -x.ndim <= a < x.ndim:
+            raise ValueError(
+                "%s: axis %d out of range for input of rank %d"
+                % (op_name, a, x.ndim))
+        axes.add(a + x.ndim if a < 0 else a)
+    from ..framework import _SENTINEL
+
+    bad = [a for a in sorted(axes)
+           if x.shape[a] != 1
+           and not (at_infer and x.shape[a] == _SENTINEL)]
+    if bad:
+        raise ValueError(
+            "%s: axes %r have size != 1 in input shape %r (each "
+            "explicitly listed axis must have size 1)"
+            % (op_name, bad, tuple(x.shape)))
+    return sorted(axes)
+
+
+def _squeeze_infer(ins, attrs, op_name, with_xshape):
+    """Graph-build shape infer that, like squeeze_op.cc GetOutputShape,
+    drops explicitly listed unknown-size dims instead of tripping
+    eval_shape (jnp.squeeze would reject the -1 sentinel)."""
+    x = ins["X"]
+    axes = normalize_squeeze_axes(x, attrs.get("axes"), op_name,
+                                  at_infer=True)
+    shape = tuple(d for i, d in enumerate(x.shape) if i not in axes)
+    out = {"Out": jax.ShapeDtypeStruct(shape, x.dtype)}
+    if with_xshape:
+        out["XShape"] = jax.ShapeDtypeStruct((0,) + tuple(x.shape),
+                                             x.dtype)
+    return out
+
+
 @register_op(
     "squeeze2",
     inputs=[In("X")],
     outputs=[Out("Out"), Out("XShape", no_grad=True)],
     attrs={"axes": []},
+    infer_shape=lambda ins, attrs: _squeeze_infer(ins, attrs, "squeeze2",
+                                                  True),
 )
 def _squeeze2(ins, attrs):
     x = ins["X"]
-    axes = attrs.get("axes") or [i for i, d in enumerate(x.shape) if d == 1]
-    axes = [a % x.ndim for a in axes if x.shape[a % x.ndim] == 1]
+    axes = normalize_squeeze_axes(x, attrs.get("axes"), "squeeze2")
     return {"Out": jnp.squeeze(x, axis=tuple(axes)), "XShape": _xshape(x)}
 
 

@@ -34,6 +34,64 @@ def test_squeeze_unsqueeze_v1():
     assert o2.shape == (1, 2, 1, 3)
 
 
+def test_squeeze_rejects_non_unit_axis():
+    """Explicitly listed axes must have size 1 and be in range
+    (squeeze_op.cc enforce) — for both the v1 op and the squeeze2 the
+    layer surface emits."""
+    import pytest
+
+    import paddle_tpu as fluid
+
+    x = np.zeros((2, 1, 3), "float32")
+    with pytest.raises(Exception, match="size != 1"):
+        _run_op("squeeze", {"X": ["xb"]}, {"Out": ["ob"]},
+                {"axes": [2]}, {"xb": x}, ["ob"])
+    with pytest.raises(Exception, match="out of range"):
+        _run_op("squeeze", {"X": ["xr"]}, {"Out": ["or_"]},
+                {"axes": [-5]}, {"xr": x}, ["or_"])
+    # negative axis resolving to a unit dim still works
+    (o,) = _run_op("squeeze", {"X": ["xn"]}, {"Out": ["on"]},
+                   {"axes": [-2]}, {"xn": x}, ["on"])
+    assert o.shape == (2, 3)
+
+    # squeeze2 via the fluid.layers surface rejects at graph build
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        inp = fluid.data(name="sq_x", shape=[2, 1, 3], dtype="float32")
+        with pytest.raises(ValueError, match="size != 1"):
+            fluid.layers.squeeze(inp, axes=[2])
+        good = fluid.layers.squeeze(inp, axes=[1])
+    assert tuple(good.shape) == (2, 3)
+
+
+def test_squeeze_duplicate_and_unknown_axes():
+    """Duplicate-resolving axes collapse to one (squeeze_op.cc
+    should_squeeze[] dedups); an explicitly listed unknown (-1) dim is
+    dropped at graph build like the reference, not rejected."""
+    import paddle_tpu as fluid
+
+    x = np.zeros((2, 1, 3), "float32")
+    (o,) = _run_op("squeeze", {"X": ["xd"]}, {"Out": ["od"]},
+                   {"axes": [1, -2]}, {"xd": x}, ["od"])
+    assert o.shape == (2, 3)
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        inp = fluid.data(name="du_x", shape=[2, 1, 3], dtype="float32")
+        dup = fluid.layers.squeeze(inp, axes=[1, -2])
+        unk = fluid.data(name="du_u", shape=[-1, 1, 3], dtype="float32")
+        sq_unk = fluid.layers.squeeze(unk, axes=[0])
+    assert tuple(dup.shape) == (2, 3)
+    assert tuple(sq_unk.shape) == (1, 3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    od, ou = exe.run(prog,
+                     feed={"du_x": np.zeros((2, 1, 3), "f4"),
+                           "du_u": np.zeros((1, 1, 3), "f4")},
+                     fetch_list=[dup, sq_unk])
+    assert od.shape == (2, 3) and ou.shape == (1, 3)
+
+
 def test_minus_l1_label_smooth():
     rng = np.random.RandomState(1)
     a, b = rng.randn(3, 4).astype("float32"), rng.randn(3, 4).astype(
