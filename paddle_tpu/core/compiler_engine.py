@@ -177,8 +177,14 @@ def _trace_conditional_block(block, op, env: Dict, step_seed) -> None:
 
 
 def _trace_block(block, env: Dict, step_seed) -> None:
+    _trace_ops(block, block.ops, env, step_seed)
+
+
+def _trace_ops(block, ops, env: Dict, step_seed) -> None:
+    """Trace a specific op sequence (a whole block, or one pipeline
+    stage's slice of it) into the running jax trace."""
     infos = OpInfoMap.instance()
-    for op in block.ops:
+    for op in ops:
         if op.type == "while":
             _trace_while(block, op, env, step_seed)
             continue

@@ -659,17 +659,26 @@ class PipelineOptimizer:
     """Synchronous pipeline training (reference optimizer.py:3422
     PipelineOptimizer + section_worker.cc).
 
-    TPU-native formulation: synchronous (GPipe-style) pipelining is
-    mathematically gradient accumulation over ``num_microbatches`` —
-    each run() call feeds ONE microbatch; gradients accumulate in-graph
-    and the wrapped optimizer's update ops run inside a
-    conditional_block that fires every k-th microbatch (lowered to
-    lax.cond, so the whole step stays one compiled program and
-    optimizer state is untouched on skip ticks). ``cut_list`` /
-    ``place_list`` / ``concurrency_list`` are accepted for API parity;
-    physical stage placement over a 'pp' mesh axis is the multi-host
-    runtime's concern (parallel/), not a per-op scope swap as in the
-    reference's SectionWorker threads."""
+    TPU-native formulation, two halves:
+
+    - single-device: synchronous (GPipe-style) pipelining is
+      mathematically gradient accumulation over ``num_microbatches`` —
+      each run() call feeds ONE microbatch; gradients accumulate
+      in-graph and the wrapped optimizer's update ops run inside a
+      conditional_block that fires every k-th microbatch (lowered to
+      lax.cond, so the whole step stays one compiled program and
+      optimizer state is untouched on skip ticks);
+    - multi-device: ``cut_list`` defines the stage split (the same
+      split-point contract as the reference's program split at
+      optimizer.py:3422); minimize() records it with the update-op
+      block in ``program._pipeline_meta`` so
+      ``parallel.pipeline.run_pipeline_parallel`` can place stages on
+      a 'pp' mesh axis and rotate activations with lax.ppermute — the
+      compiled-collective replacement for the reference's
+      SectionWorker threads + scope queues (section_worker.cc:142).
+
+    ``place_list`` / ``concurrency_list`` are accepted for API parity
+    (device placement comes from the mesh; XLA owns scheduling)."""
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
@@ -700,6 +709,11 @@ class PipelineOptimizer:
                        no_grad_set, k, program, block):
         from .layers import tensor as layers_tensor
 
+        # stage-split metadata for the pp-mesh engine: everything
+        # appended from here on is backward/update, so the forward op
+        # count is the split domain
+        n_fwd_ops = len(block.ops)
+
         # 1/k loss scaling so the accumulated grad is the full-batch mean
         scaled = loss
         if k > 1:
@@ -713,8 +727,14 @@ class PipelineOptimizer:
         params_grads = self._optimizer.backward(
             scaled, startup_program, parameter_list, no_grad_set)
         if k <= 1:
-            return (self._optimizer.apply_gradients(params_grads),
-                    params_grads)
+            n_before = len(block.ops)
+            optimize_ops = self._optimizer.apply_gradients(params_grads)
+            self._record_pipeline_meta(
+                program, loss, n_fwd_ops, k,
+                {p.name: g.name for p, g in params_grads
+                 if g is not None},
+                list(block.ops[n_before:]))
+            return optimize_ops, params_grads
 
         with program._optimized_guard():
             step = layers_tensor.create_global_var(
@@ -771,7 +791,27 @@ class PipelineOptimizer:
                 inputs={"Cond": [cond]}, outputs={},
                 attrs={"sub_block": sub, "is_scalar_condition": True},
                 infer_shape=False)
+        self._record_pipeline_meta(
+            program, loss, n_fwd_ops, k,
+            {p.name: acc.name for p, acc in accum_pg if acc is not None},
+            list(sub.ops))
         return optimize_ops, params_grads
+
+    def _record_pipeline_meta(self, program, loss, n_fwd_ops, k, acc_map,
+                              update_ops):
+        """Record the stage-split contract for
+        parallel.pipeline.run_pipeline_parallel (reference counterpart:
+        the section programs PipelineOptimizer.minimize builds at
+        optimizer.py:3422)."""
+        program._pipeline_meta = {
+            "cut_list": self._cut_list or [],
+            "num_microbatches": k,
+            "n_fwd_ops": n_fwd_ops,
+            "loss": loss.name,
+            "params": list(acc_map),
+            "acc_map": dict(acc_map),
+            "update_ops": update_ops,
+        }
 
 
 class _ParamSwapper:

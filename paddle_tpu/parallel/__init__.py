@@ -12,3 +12,5 @@ from .transpiler import insert_allreduce_ops  # noqa: F401
 from .ring_attention import (  # noqa: F401
     ring_attention, sequence_parallel_attention, ulysses_attention)
 from .moe import expert_parallel_moe, moe_reference  # noqa: F401
+from .pipeline import (  # noqa: F401
+    run_pipeline_parallel, split_forward_at_cuts)

@@ -1,0 +1,188 @@
+"""Pipeline parallelism over a 'pp' mesh axis.
+
+Contract (VERDICT r2 #4 / reference section_worker.cc:142-258 +
+optimizer.py:3422): stages assigned from cut_list, microbatch schedule,
+activations passed stage-to-stage, per-stage grad accumulation — and the
+pp run's loss/updated params must match the single-device microbatch
+path exactly (the test_dist_base.py:506 loss-parity contract)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.mesh_utils import make_mesh
+from paddle_tpu.parallel.pipeline import (
+    run_pipeline_parallel, split_forward_at_cuts)
+
+
+def _build(n_micro, cut_count=2):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[4, 12], dtype="float32")
+        label = fluid.data(name="label", shape=[4, 1], dtype="int64")
+        h1 = fluid.layers.fc(x, size=16, act="relu")
+        h2 = fluid.layers.fc(h1, size=16, act="relu")
+        pred = fluid.layers.fc(h2, size=10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        cuts = [[h1], [h2]][:cut_count]
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.MomentumOptimizer(0.1, 0.9),
+            cut_list=cuts, num_microbatches=n_micro)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _param_snapshot(scope, program):
+    out = {}
+    for name, v in program.global_block().vars.items():
+        if getattr(v, "persistable", False):
+            var = scope.find_var(name)
+            if var is not None and var.is_initialized():
+                out[name] = np.asarray(var.raw().array)
+    return out
+
+
+def test_split_forward_at_cuts():
+    main, _, _ = _build(4)
+    meta = main._pipeline_meta
+    assert meta["cut_list"], "PipelineOptimizer must record cut_list"
+    stages = split_forward_at_cuts(main, meta["cut_list"],
+                                   meta["n_fwd_ops"])
+    assert len(stages) == 3
+    # every forward op lands in exactly one stage, in program order
+    flat = [op for s in stages for op in s]
+    assert flat == list(main.global_block().ops[:meta["n_fwd_ops"]])
+
+
+def test_pipeline_pp_matches_single_device():
+    n_micro = 4
+    main, startup, loss = _build(n_micro)
+
+    rng = np.random.RandomState(3)
+    full_x = rng.randn(16, 12).astype("float32")
+    full_y = rng.randint(0, 10, (16, 1)).astype("int64")
+
+    # -- single-device oracle: k microbatch runs, update fires on the kth
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        init = _param_snapshot(scope_a, main)
+        losses = []
+        for m in range(n_micro):
+            (l,) = exe.run(
+                main,
+                feed={"x": full_x[m * 4:(m + 1) * 4],
+                      "label": full_y[m * 4:(m + 1) * 4]},
+                fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        params_a = _param_snapshot(scope_a, main)
+
+    # -- pipeline engine: one call on the full batch over a pp=3 mesh
+    import jax.numpy as jnp
+
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe_b = fluid.Executor(fluid.CPUPlace())
+        exe_b.run(startup)
+        for name, arr in init.items():
+            scope_b.var(name).get_tensor()._array = jnp.asarray(arr)
+        mesh = make_mesh([3], ["pp"])
+        (loss_pp,) = run_pipeline_parallel(
+            exe_b._core, main, scope_b,
+            feed={"x": full_x, "label": full_y}, fetch_list=[loss],
+            mesh=mesh)
+        params_b = _param_snapshot(scope_b, main)
+
+    np.testing.assert_allclose(float(loss_pp), np.mean(losses),
+                               rtol=1e-5, atol=1e-6)
+    for name in params_a:
+        if name.endswith(".pipe_acc") or name.startswith("pipe_step"):
+            continue  # engine-path bookkeeping vars differ by design
+        assert name in params_b, name
+        np.testing.assert_allclose(
+            params_a[name], params_b[name], rtol=1e-4, atol=1e-5,
+            err_msg="param %s diverged between single-device microbatch "
+                    "accumulation and the pp pipeline" % name)
+    # the update really happened (params moved from init)
+    moved = any(
+        not np.allclose(init[n], params_b[n])
+        for n in params_b if n in init and not n.endswith(".pipe_acc")
+        and "velocity" not in n.lower())
+    assert moved, "pipeline step did not update parameters"
+
+
+def test_pipeline_skip_connection():
+    """A var produced in stage 0 and consumed in stage 2 must ride the
+    rotating buffer through stage 1 untouched (the live-set carry)."""
+    n_micro = 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[4, 8], dtype="float32")
+        label = fluid.data(name="label", shape=[4, 1], dtype="int64")
+        h1 = fluid.layers.fc(x, size=8, act="relu")
+        h2 = fluid.layers.fc(h1, size=8, act="relu")
+        h3 = fluid.layers.elementwise_add(h2, h1)  # skip from stage 0
+        pred = fluid.layers.fc(h3, size=10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.1), cut_list=[[h1], [h2]],
+            num_microbatches=n_micro)
+        opt.minimize(loss)
+
+    rng = np.random.RandomState(5)
+    full_x = rng.randn(8, 8).astype("float32")
+    full_y = rng.randint(0, 10, (8, 1)).astype("int64")
+
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        init = _param_snapshot(scope_a, main)
+        losses = []
+        for m in range(n_micro):
+            (l,) = exe.run(main,
+                           feed={"x": full_x[m * 4:(m + 1) * 4],
+                                 "label": full_y[m * 4:(m + 1) * 4]},
+                           fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        params_a = _param_snapshot(scope_a, main)
+
+    import jax.numpy as jnp
+
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe_b = fluid.Executor(fluid.CPUPlace())
+        exe_b.run(startup)
+        for name, arr in init.items():
+            scope_b.var(name).get_tensor()._array = jnp.asarray(arr)
+        (loss_pp,) = run_pipeline_parallel(
+            exe_b._core, main, scope_b,
+            feed={"x": full_x, "label": full_y}, fetch_list=[loss],
+            mesh=make_mesh([3], ["pp"]))
+        params_b = _param_snapshot(scope_b, main)
+
+    np.testing.assert_allclose(float(loss_pp), np.mean(losses),
+                               rtol=1e-5, atol=1e-6)
+    for name in params_a:
+        if name.endswith(".pipe_acc") or name.startswith("pipe_step"):
+            continue
+        np.testing.assert_allclose(params_a[name], params_b[name],
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_pipeline_cut_errors():
+    main, _, _ = _build(4)
+    meta = main._pipeline_meta
+    with pytest.raises(ValueError, match="not produced"):
+        split_forward_at_cuts(main, ["nonexistent_var"],
+                              meta["n_fwd_ops"])
+    # mesh of the wrong size is rejected
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        _, startup, loss = _build(4)
+    # (just the validation path; no run needed)
+    mesh = make_mesh([2], ["pp"])
+    with pytest.raises(ValueError, match="stages"):
+        run_pipeline_parallel(exe._core, main, scope, feed={},
+                              fetch_list=[], mesh=mesh)
