@@ -181,21 +181,33 @@ class CoreExecutor:
             for k, v in in_lods.items():
                 attrs[LOD_ATTR_PREFIX + k] = v
 
-        fn = _get_jitted(op.type, attrs)
-        if info.needs_rng:
-            import jax.numpy as jnp
+        # SelectedRows operands (sparse embedding grads) can't cross a
+        # jit boundary — run the op's python body eagerly; supporting
+        # ops (sum, sgd, merge_selected_rows...) isinstance-dispatch on
+        # them, mirroring the reference kernels' SelectedRows overloads
+        has_sr = any(
+            isinstance(v, SelectedRows)
+            for vs in ins.values() if vs is not None
+            for v in (vs if isinstance(vs, list) else [vs]))
+        if has_sr:
+            outs = info.fn(ins, attrs)
+        else:
+            fn = _get_jitted(op.type, attrs)
+            if info.needs_rng:
+                import jax.numpy as jnp
 
-            if int(attrs.get("seed", 0) or 0) > 0:
-                seed_val = np.uint32(attrs["seed"])
-            else:
-                # A grad op reuses its forward op's stream (attr set by
-                # backward.py) so e.g. dropout masks match fwd/bwd.
-                seed_id = attrs.get("_fwd_op_id", op._id or 0)
-                seed_val = self.rng.next_seed(seed_id)
-            ins = dict(ins)
-            ins[RNG_SEED_ATTR] = jnp.asarray(seed_val, dtype=jnp.uint32)
+                if int(attrs.get("seed", 0) or 0) > 0:
+                    seed_val = np.uint32(attrs["seed"])
+                else:
+                    # A grad op reuses its forward op's stream (attr set
+                    # by backward.py) so e.g. dropout masks match
+                    # fwd/bwd.
+                    seed_id = attrs.get("_fwd_op_id", op._id or 0)
+                    seed_val = self.rng.next_seed(seed_id)
+                ins = dict(ins)
+                ins[RNG_SEED_ATTR] = jnp.asarray(seed_val, dtype=jnp.uint32)
 
-        outs = fn(ins)
+            outs = fn(ins)
 
         out_lods = self._infer_out_lods(info, op, in_lods, attrs)
         for slot in info.outputs:
@@ -223,11 +235,17 @@ class CoreExecutor:
         from .enforce import EnforceNotMet
         from .tensor import LoDTensor
 
+        from .tensor import SelectedRows
+
         for n in op.output_arg_names:
             var = scope.find_var(n)
             if var is None or not var.is_initialized():
                 continue
             h = var.raw()
+            if isinstance(h, SelectedRows):
+                # validate the value tensor of a sparse grad too — the
+                # reference's checker walks SelectedRows values as well
+                h = h.get_tensor()
             if not isinstance(h, LoDTensor) or h.array is None:
                 continue
             arr = h.array

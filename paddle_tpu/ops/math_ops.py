@@ -37,6 +37,24 @@ def _scale(ins, attrs):
 )
 def _sum(ins, attrs):
     xs = [x for x in ins["X"] if x is not None]
+    from ..core.tensor import LoDTensor, SelectedRows
+
+    if any(isinstance(x, SelectedRows) for x in xs):
+        # reference sum_op SelectedRows overload: all-sparse inputs
+        # concatenate rows (duplicates accumulate on densify); mixed
+        # inputs densify the sparse ones into the dense accumulator
+        if all(isinstance(x, SelectedRows) for x in xs):
+            import jax.numpy as jnp
+
+            rows = [r for x in xs for r in x.rows()]
+            vals = jnp.concatenate([x.get_tensor().array for x in xs])
+            return {"Out": SelectedRows(rows=rows, height=xs[0].height(),
+                                        value=LoDTensor(vals))}
+        out = None
+        for x in xs:
+            d = x.to_dense() if isinstance(x, SelectedRows) else x
+            out = d if out is None else out + d
+        return {"Out": out}
     out = xs[0]
     for x in xs[1:]:
         out = out + x

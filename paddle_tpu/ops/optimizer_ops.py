@@ -29,14 +29,38 @@ def _lr(ins):
     return ins["LearningRate"].reshape(())
 
 
+def _maybe_densify_grad(ins):
+    """SelectedRows grad (sparse embedding path) → dense, for optimizers
+    whose reference kernels have no row-wise sparse variant. Exact
+    non-lazy semantics: densify accumulates duplicate rows."""
+    from ..core.tensor import SelectedRows
+
+    g = ins["Grad"]
+    if isinstance(g, SelectedRows):
+        ins = dict(ins)
+        ins["Grad"] = g.to_dense()
+    return ins
+
+
 def _sgd(ins, attrs):
-    return {"ParamOut": ins["Param"] - _lr(ins) * ins["Grad"]}
+    from ..core.tensor import SelectedRows
+
+    g = ins["Grad"]
+    if isinstance(g, SelectedRows):
+        # reference sgd_op.h SelectedRows kernel: update only the
+        # touched rows (duplicates accumulate via scatter-add)
+        rows = jnp.asarray(g.rows(), dtype=jnp.int32)
+        vals = g.get_tensor().array
+        p = ins["Param"].at[rows].add(-_lr(ins) * vals)
+        return {"ParamOut": p}
+    return {"ParamOut": ins["Param"] - _lr(ins) * g}
 
 
 _op("sgd", ["Param", "Grad", "LearningRate"], ["ParamOut"], {}, _sgd)
 
 
 def _momentum(ins, attrs):
+    ins = _maybe_densify_grad(ins)
     mu = attrs.get("mu", 0.9)
     v = mu * ins["Velocity"] + ins["Grad"]
     if attrs.get("use_nesterov", False):
@@ -83,6 +107,7 @@ _op(
 
 
 def _adam(ins, attrs):
+    ins = _maybe_densify_grad(ins)
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
@@ -153,6 +178,7 @@ _op(
 
 
 def _adagrad(ins, attrs):
+    ins = _maybe_densify_grad(ins)
     eps = attrs.get("epsilon", 1e-6)
     g = ins["Grad"]
     moment = ins["Moment"] + jnp.square(g)
@@ -211,6 +237,7 @@ _op(
 
 
 def _rmsprop(ins, attrs):
+    ins = _maybe_densify_grad(ins)
     eps = attrs.get("epsilon", 1e-10)
     decay = attrs.get("decay", 0.9)
     momentum = attrs.get("momentum", 0.0)

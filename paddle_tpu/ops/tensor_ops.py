@@ -628,12 +628,61 @@ def _embedding_lookup(w, ids, padding_idx):
     return out
 
 
+def _lookup_table_grad_maker(block, op, pending, finalize):
+    """Grad maker honoring ``is_sparse`` (lookup_table_op.h grad path):
+    dense mode emits the XLA scatter-add grad op; sparse mode emits a
+    host op producing a SelectedRows (rows = the looked-up ids, values
+    = the incoming out-grad rows) — the representation change the
+    reference makes, which downstream sum/optimizer ops consume."""
+    og = finalize(op.output("Out")[0])
+    if og is None:
+        return
+    from .control_flow_ops import _bind_partial_grad
+
+    w = op.input("W")[0]
+    gname = _bind_partial_grad(block, pending, w)
+    gtype = ("lookup_table_sparse_grad" if op.attrs.get("is_sparse")
+             else op.type + "_grad")
+    block.append_op(
+        gtype,
+        {"W": [w], "Ids": [op.input("Ids")[0]], "Out@GRAD": [og]},
+        {"W@GRAD": [gname]},
+        {"padding_idx": op.attrs.get("padding_idx", -1),
+         "is_v2": op.type == "lookup_table_v2"},
+        infer_shape=False)
+
+
+def _lookup_table_dense_grad_impl(ins, attrs):
+    w, ids, og = ins["W"], ins["Ids"], ins["Out@GRAD"]
+    if not attrs.get("is_v2") and ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad >= 0:
+        og = og * (ids != pad)[..., None].astype(og.dtype)
+    flat = og.reshape(-1, w.shape[-1])
+    g = jnp.zeros_like(w).at[ids.reshape(-1)].add(flat.astype(w.dtype))
+    return {"W@GRAD": g}
+
+
+for _lt_gtype, _lt_v2 in (("lookup_table_grad", False),
+                          ("lookup_table_v2_grad", True)):
+    register_op(
+        _lt_gtype,
+        inputs=[In("W", no_grad=True), In("Ids", no_grad=True),
+                In("Out@GRAD", no_grad=True)],
+        outputs=[Out("W@GRAD")],
+        attrs={"padding_idx": -1, "is_v2": _lt_v2},
+        grad=None,
+    )(_lookup_table_dense_grad_impl)
+
+
 @register_op(
     "lookup_table",
     inputs=[In("W"), In("Ids", no_grad=True)],
     outputs=[Out("Out")],
     attrs={"padding_idx": -1, "is_sparse": False, "is_distributed": False,
            "remote_prefetch": False},
+    grad=_lookup_table_grad_maker,
 )
 def _lookup_table(ins, attrs):
     ids = ins["Ids"]
@@ -648,10 +697,45 @@ def _lookup_table(ins, attrs):
     inputs=[In("W"), In("Ids", no_grad=True)],
     outputs=[Out("Out")],
     attrs={"padding_idx": -1, "is_sparse": False, "is_distributed": False},
+    grad=_lookup_table_grad_maker,
 )
 def _lookup_table_v2(ins, attrs):
     return {"Out": _embedding_lookup(ins["W"], ins["Ids"],
                                      attrs.get("padding_idx", -1))}
+
+
+@register_host_op(
+    "lookup_table_sparse_grad",
+    inputs=[In("W", no_grad=True), In("Ids", no_grad=True),
+            In("Out@GRAD", no_grad=True)],
+    outputs=[Out("W@GRAD")],
+    attrs={"padding_idx": -1, "is_v2": False},
+)
+def _lookup_table_sparse_grad(executor, op, scope):
+    """Sparse embedding grad: emits SelectedRows(rows=ids, values=dOut)
+    instead of a dense scatter — the reference's is_sparse grad
+    representation (lookup_table_op.h SparseGradKernel). Host tier: the
+    ragged row set is host metadata; programs carrying it run on the
+    interpreter (the compiled path keeps dense grads by design)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import LoDTensor, SelectedRows
+
+    w = executor._read_var(scope, op.input("W")[0])
+    ids = np.asarray(executor._read_var(scope, op.input("Ids")[0]))
+    og = executor._read_var(scope, op.input("Out@GRAD")[0])
+    if not op.attrs.get("is_v2") and ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    rows = ids.reshape(-1)
+    vals = jnp.asarray(og).reshape(-1, w.shape[-1]).astype(w.dtype)
+    pad = op.attrs.get("padding_idx", -1)
+    if pad is not None and pad >= 0:
+        keep = rows != pad
+        rows = rows[keep]
+        vals = vals[np.asarray(keep)]
+    sr = SelectedRows(rows=np.asarray(rows).tolist(),
+                      height=int(w.shape[0]), value=LoDTensor(vals))
+    executor._write_var(scope, op.output("W@GRAD")[0], sr)
 
 
 @register_op(
