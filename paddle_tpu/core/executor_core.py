@@ -235,8 +235,6 @@ class CoreExecutor:
         from .enforce import EnforceNotMet
         from .tensor import LoDTensor
 
-        from .tensor import SelectedRows
-
         for n in op.output_arg_names:
             var = scope.find_var(n)
             if var is None or not var.is_initialized():

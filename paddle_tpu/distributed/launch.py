@@ -68,8 +68,14 @@ def launch(args=None):
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
     try:
+        # workers must import paddle_tpu even when it runs from a source
+        # checkout (script-dir sys.path[0] replaces the launcher's cwd)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
         for local_rank in range(args.nproc_per_node):
             env = dict(os.environ)
+            env["PYTHONPATH"] = pkg_root + os.pathsep + \
+                env.get("PYTHONPATH", "")
             env.update(get_cluster_env(node_ips, args.node_rank,
                                        args.nproc_per_node,
                                        args.started_port, local_rank))

@@ -151,13 +151,19 @@ def _split_like(flat, refs):
 
 def _allreduce_across_processes(flat, nranks):
     """On-device cross-process sum: the local buffer becomes one shard
-    of a global [nranks, n] array (one device per process), and a jitted
-    replicated-output sum makes XLA insert the all-reduce over ICI/DCN —
-    no host round-trip. Host-gather fallback only if the global-array
-    construction is unsupported by the runtime."""
+    of a global [nranks, n] array (one device per process); a psum under
+    shard_map makes XLA insert the all-reduce over ICI/DCN (Gloo on the
+    CPU backend). The output keeps the P('dp') sharding — every row
+    holds the sum, so each process reads its OWN local shard and no
+    cross-process gather of a replicated array is ever needed (a
+    replicated out_sharding would be non-fully-addressable under
+    multi-process jax and unreadable locally). Host-gather fallback only
+    if global-array construction is unsupported by the runtime."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh_utils import shard_map_compat
 
     try:
         devs = np.array(jax.devices()[:nranks])
@@ -167,9 +173,12 @@ def _allreduce_across_processes(flat, nranks):
         garr = jax.make_array_from_single_device_arrays(
             (nranks,) + flat.shape, dist,
             [jax.device_put(local, jax.local_devices()[0])])
-        return jax.jit(
-            lambda x: x.sum(axis=0),
-            out_shardings=NamedSharding(mesh, P()))(garr)
+        psummed = shard_map_compat(
+            lambda x: jax.lax.psum(x, "dp"), mesh,
+            in_specs=P("dp"), out_specs=P("dp"))
+        out = jax.jit(psummed)(garr)
+        [shard] = [s.data for s in out.addressable_shards]
+        return shard[0]
     except Exception as e:
         import warnings
 
@@ -178,5 +187,5 @@ def _allreduce_across_processes(flat, nranks):
             "back to host-gather — expect much slower DP steps" % e)
         from jax.experimental import multihost_utils
 
-        gathered = multihost_utils.process_allgather(flat)
+        gathered = multihost_utils.process_allgather(flat, tiled=True)
         return gathered.reshape(nranks, -1).sum(axis=0)

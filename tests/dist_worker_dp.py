@@ -1,0 +1,88 @@
+"""Worker script for the genuine multi-process DataParallel test.
+
+Launched (2 processes) by tests/test_multiprocess_dp.py via
+paddle_tpu.distributed.launch; also runnable standalone (nranks=1) for
+the single-process oracle. Mirrors the reference's dist test model
+runners (tests/unittests/test_dist_base.py TestDistRunnerBase): fixed
+seeds everywhere so the loss sequence is reproducible, one JSON line of
+per-step losses on stdout at the end.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.dygraph import Linear, to_variable
+from paddle_tpu.dygraph.parallel import DataParallel, prepare_context
+
+STEPS = 3
+FULL_BATCH = 8
+DIM, HID, CLASSES = 12, 16, 10
+
+
+class MLP(fluid.dygraph.Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = Linear(DIM, HID, act="relu")
+        self.l2 = Linear(HID, CLASSES)
+
+    def forward(self, x):
+        return self.l2(self.l1(x))
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+    env = prepare_context()
+    rank, nranks = env.local_rank, env.nranks
+    shard = FULL_BATCH // max(nranks, 1)
+
+    with fluid.dygraph.guard():
+        import jax.numpy as jnp
+
+        model = MLP()
+        # identical deterministic init on every rank (the reference
+        # broadcasts rank-0 params; fixed-seed init is equivalent)
+        wrng = np.random.RandomState(42)
+        for p in model.parameters():
+            p.set_value(jnp.asarray(
+                (wrng.randn(*p.shape) * 0.1).astype("float32")))
+        model = DataParallel(model)
+        opt = fluid.optimizer.SGD(learning_rate=0.1,
+                                  parameter_list=model.parameters())
+
+        drng = np.random.RandomState(7)
+        losses = []
+        for _ in range(STEPS):
+            x = drng.randn(FULL_BATCH, DIM).astype("float32")
+            y = drng.randint(0, CLASSES, (FULL_BATCH, 1)).astype("int64")
+            if nranks > 1:
+                x = x[rank * shard:(rank + 1) * shard]
+                y = y[rank * shard:(rank + 1) * shard]
+            logits = model(to_variable(x))
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    logits, to_variable(y)))
+            losses.append(float(np.asarray(loss.numpy()).ravel()[0]))
+            scaled = model.scale_loss(loss)
+            scaled.backward()
+            model.apply_collective_grads()
+            opt.minimize(scaled, parameter_list=model.parameters())
+            for p in model.parameters():
+                p.clear_gradient()
+
+        checksum = float(sum(
+            np.abs(np.asarray(p.numpy())).sum()
+            for p in model.parameters()))
+
+    result = json.dumps({"rank": rank, "nranks": nranks,
+                         "losses": losses, "checksum": checksum})
+    if out_path:
+        with open(os.path.join(out_path, "rank%d.json" % rank), "w") as f:
+            f.write(result)
+    print(result)
+
+
+if __name__ == "__main__":
+    main()
