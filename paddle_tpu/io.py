@@ -220,6 +220,33 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
               _prune_for_inference(main_program, feeded_var_names,
                                    fetch_names))
     os.makedirs(dirname, exist_ok=True)
+    if model_filename is not None and not model_filename.endswith(".json"):
+        # reference binary format: protobuf __model__ + combined tensor
+        # streams (core/proto_format.py)
+        from .core import proto_format
+
+        with open(os.path.join(dirname, model_filename), "wb") as f:
+            f.write(proto_format.program_to_proto_bytes(
+                pruned, feeded_var_names, fetch_names))
+        if not program_only:
+            names = sorted(v.name for v in pruned.list_vars()
+                           if is_persistable(v))
+            scope = global_scope()
+            arrays = []
+            for n in names:
+                var = scope.find_var(n)
+                if var is None or not var.is_initialized():
+                    continue  # same skip as the JSON path's _save_var_dict
+                arrays.append((n, np.asarray(var.raw().array)))
+            if params_filename:
+                proto_format.save_combine(
+                    arrays, os.path.join(dirname, params_filename))
+            else:
+                # reference default: one tensor-stream file per var
+                for n, arr in arrays:
+                    with open(os.path.join(dirname, n), "wb") as f:
+                        f.write(proto_format.serialize_lod_tensor(arr))
+        return fetch_names
     model = _serialize_program(pruned)
     model["feed_names"] = list(feeded_var_names)
     model["fetch_names"] = fetch_names
@@ -234,7 +261,14 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
-    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
+    json_path = os.path.join(dirname, model_filename or "__model__.json")
+    if model_filename is None and not os.path.exists(json_path) \
+            and os.path.exists(os.path.join(dirname, "__model__")):
+        model_filename = "__model__"  # a reference-saved model dir
+    if model_filename is not None and not model_filename.endswith(".json"):
+        return _load_inference_model_proto(dirname, model_filename,
+                                           params_filename)
+    with open(json_path) as f:
         model = json.load(f)
     program = _deserialize_program(model)
     params_path = os.path.join(dirname, params_filename or "__params__.npz")
@@ -242,6 +276,48 @@ def load_inference_model(dirname, executor, model_filename=None,
         _load_var_dict(params_path, global_scope())
     feed_names = model.get("feed_names", [])
     fetch_names = model.get("fetch_names", [])
+    fetch_vars = [program.global_block().var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars
+
+
+def _load_inference_model_proto(dirname, model_filename, params_filename):
+    """Load a reference-format model dir: protobuf ``__model__``
+    (framework.proto ProgramDesc) + params as tensor streams, either one
+    file per var or a combined file in sorted-name order
+    (inference/io.cc:111)."""
+    import jax.numpy as jnp
+
+    from .core import proto_format
+    from .core.tensor import LoDTensor
+
+    with open(os.path.join(dirname, model_filename), "rb") as f:
+        data = f.read()
+    program, feed_names, fetch_names = \
+        proto_format.proto_bytes_to_program(data)
+    # same derivation as the save side: persistables over ALL blocks,
+    # sorted (inference/io.cc:111) — global-block-only would misalign
+    # the combined stream for programs with sub-block persistables
+    names = sorted(v.name for v in program.list_vars()
+                   if getattr(v, "persistable", False))
+    scope = global_scope()
+    if params_filename:
+        arrays = proto_format.load_combine(
+            os.path.join(dirname, params_filename), names)
+        for n, arr in arrays.items():
+            scope.var(n).set(LoDTensor(jnp.asarray(arr)))
+    else:
+        missing = [n for n in names
+                   if not os.path.exists(os.path.join(dirname, n))]
+        if missing:
+            raise RuntimeError(
+                "model dir %r is missing parameter files: %s"
+                % (dirname, ", ".join(missing[:10])))
+        for n in names:
+            arr, lod, _ = proto_format.parse_lod_tensor(
+                open(os.path.join(dirname, n), "rb").read())
+            t = LoDTensor(jnp.asarray(arr))
+            t._lod = [list(l) for l in lod]
+            scope.var(n).set(t)
     fetch_vars = [program.global_block().var(n) for n in fetch_names]
     return program, feed_names, fetch_vars
 

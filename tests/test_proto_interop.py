@@ -1,0 +1,217 @@
+"""Reference model-format interop (VERDICT r2 #7).
+
+Validates the hand-written proto2 codec three ways: hand-computed wire
+bytes, cross-validation against the official ``protoc`` using a schema
+generated FROM OUR FIELD TABLES (proving wire-format agreement without
+depending on the reference tree), and a full save→load→predict round
+trip through the binary ``__model__`` + tensor-stream params path.
+"""
+import os
+import shutil
+import struct
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import proto_format as pf
+
+
+def test_wire_primitives_hand_computed():
+    # Version{version=1} -> field 1 varint: key 0x08, value 0x01
+    assert pf.encode_message({"version": 1}, pf.VERSION) == b"\x08\x01"
+    assert pf.decode_message(b"\x08\x01", pf.VERSION) == {"version": 1}
+    # TensorDesc{data_type=5, dims=[2,-1]} — negative int64 is a
+    # 10-byte varint in proto2
+    enc = pf.encode_message({"data_type": 5, "dims": [2, -1]},
+                            pf.TENSOR_DESC)
+    assert enc.startswith(b"\x08\x05\x10\x02\x10")
+    dec = pf.decode_message(enc, pf.TENSOR_DESC)
+    assert dec == {"data_type": 5, "dims": [2, -1]}
+    # packed repeated ints (proto3-style writers) also decode
+    packed = b"\x08\x05\x12\x02\x02\x03"  # dims as packed [2,3]
+    assert pf.decode_message(packed, pf.TENSOR_DESC)["dims"] == [2, 3]
+
+
+def _table_to_proto_src():
+    """Emit a .proto source from our field tables (schema generated from
+    code, for protoc cross-validation only)."""
+    lines = ['syntax = "proto2";', "package pt_check;"]
+
+    def msg(name, table, done=set()):
+        if name in done:
+            return
+        done.add(name)
+        body = []
+        for fno, spec in sorted(table.items()):
+            fname, kind = spec[0], spec[1]
+            rep = "repeated" if kind.endswith("*") else "optional"
+            base = kind.rstrip("*")
+            if base == "msg":
+                sub = "M%d_%s" % (id(spec[2]) % 997, fname)
+                msg(sub, spec[2], done)
+                typ = sub
+            else:
+                typ = {"int": "int64", "enum": "int32", "bool": "bool",
+                       "float": "float", "str": "string"}[base]
+            body.append("  %s %s %s = %d;" % (rep, typ, fname, fno))
+        lines.append("message %s {\n%s\n}" % (name, "\n".join(body)))
+
+    msg("OpDesc", pf.OP_DESC)
+    return "\n".join(lines)
+
+
+@pytest.mark.skipif(shutil.which("protoc") is None,
+                    reason="protoc not available")
+def test_codec_matches_protoc():
+    src = _table_to_proto_src()
+    with tempfile.TemporaryDirectory() as d:
+        proto_path = os.path.join(d, "check.proto")
+        with open(proto_path, "w") as f:
+            f.write(src)
+        textpb = (
+            'type: "mul"\n'
+            'inputs { parameter: "X" arguments: "a" arguments: "b" }\n'
+            'outputs { parameter: "Out" arguments: "o" }\n'
+            'attrs { name: "x_num_col_dims" type: 0 i: 1 }\n'
+            'attrs { name: "alpha" type: 1 f: 1.5 }\n'
+        )
+        official = subprocess.run(
+            ["protoc", "--proto_path", d, "--encode=pt_check.OpDesc",
+             proto_path],
+            input=textpb.encode(), capture_output=True, check=True).stdout
+        ours = pf.encode_message(
+            {"type": "mul",
+             "inputs": [{"parameter": "X", "arguments": ["a", "b"]}],
+             "outputs": [{"parameter": "Out", "arguments": ["o"]}],
+             "attrs": [
+                 {"name": "x_num_col_dims", "type": 0, "i": 1},
+                 {"name": "alpha", "type": 1, "f": 1.5},
+             ]}, pf.OP_DESC)
+        # decode both ways: our decoder reads protoc's bytes and
+        # vice versa (byte equality can differ by field order, so
+        # compare the decoded structures)
+        assert pf.decode_message(official, pf.OP_DESC) == \
+            pf.decode_message(ours, pf.OP_DESC)
+        back = subprocess.run(
+            ["protoc", "--proto_path", d, "--decode=pt_check.OpDesc",
+             proto_path],
+            input=ours, capture_output=True, check=True).stdout
+        assert b'type: "mul"' in back and b"alpha" in back
+
+
+def test_lod_tensor_stream_roundtrip():
+    arr = np.arange(12, dtype="float32").reshape(3, 4)
+    data = pf.serialize_lod_tensor(arr, lod=[[0, 2, 3]])
+    # framing: u32 version 0, u64 lod_level 1
+    assert struct.unpack_from("<I", data, 0)[0] == 0
+    assert struct.unpack_from("<Q", data, 4)[0] == 1
+    out, lod, pos = pf.parse_lod_tensor(data)
+    assert pos == len(data)
+    np.testing.assert_array_equal(out, arr)
+    assert lod == [[0, 2, 3]]
+
+    combined_path = tempfile.mktemp()
+    try:
+        b = np.arange(6, dtype="int64").reshape(2, 3)
+        pf.save_combine([("a", arr), ("b", b)], combined_path)
+        loaded = pf.load_combine(combined_path, ["a", "b"])
+        np.testing.assert_array_equal(loaded["a"], arr)
+        np.testing.assert_array_equal(loaded["b"], b)
+    finally:
+        os.unlink(combined_path)
+
+
+def test_packed_floats_and_bools_decode():
+    # proto3-style packed floats: field 7 (floats), wire type LEN
+    payload = struct.pack("<2f", 1.5, -2.0)
+    data = bytes([7 << 3 | 2, len(payload)]) + payload
+    assert pf.decode_message(data, pf.OP_DESC_ATTR)["floats"] == [1.5, -2.0]
+    # packed bools: field 11, two varints
+    data = bytes([11 << 3 | 2, 2, 1, 0])
+    assert pf.decode_message(data, pf.OP_DESC_ATTR)["bools"] == [True, False]
+
+
+def test_multi_block_program_roundtrip():
+    """Sub-block programs (cond/while) must survive the proto round
+    trip with parent links and block-attr references intact."""
+    desc = {
+        "blocks": [
+            {"idx": 0, "parent_idx": -1,
+             "vars": [{"name": "x",
+                       "type": {"type": 7,
+                                "lod_tensor": {"tensor": {
+                                    "data_type": 5, "dims": [2]}}},
+                       "persistable": False}],
+             "ops": [{"type": "conditional_block",
+                      "inputs": [{"parameter": "Cond",
+                                  "arguments": ["x"]}],
+                      "outputs": [],
+                      "attrs": [{"name": "sub_block", "type": 8,
+                                 "block_idx": 1}]}]},
+            {"idx": 1, "parent_idx": 0, "vars": [], "ops": []},
+        ],
+        "version": {"version": 1007000},
+    }
+    raw = pf.encode_message(desc, pf.PROGRAM_DESC)
+    prog, feeds, fetches = pf.proto_bytes_to_program(raw)
+    assert len(prog.blocks) == 2
+    assert prog.blocks[1].parent_block is prog.blocks[0]
+    op = prog.global_block().ops[0]
+    assert op.attrs["sub_block"] is prog.blocks[1]
+
+
+def test_rejects_2x_format_version():
+    raw = pf.encode_message(
+        {"blocks": [{"idx": 0, "parent_idx": -1}],
+         "version": {"version": 2000000}}, pf.PROGRAM_DESC)
+    with pytest.raises(RuntimeError, match="2.x"):
+        pf.proto_bytes_to_program(raw)
+
+
+def _build_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[4, 6], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        pred = fluid.layers.fc(h, size=3, act="softmax")
+    return main, startup, pred
+
+
+def test_save_load_reference_format_roundtrip(tmp_path):
+    main, startup, pred = _build_model()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 6).astype("float32")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (ref_out,) = exe.run(main, feed={"x": x}, fetch_list=[pred])
+        # save in the reference binary format (separate param files AND
+        # a combined-file variant)
+        fluid.io.save_inference_model(
+            str(tmp_path / "sep"), ["x"], [pred], exe,
+            main_program=main, model_filename="__model__")
+        fluid.io.save_inference_model(
+            str(tmp_path / "comb"), ["x"], [pred], exe,
+            main_program=main, model_filename="__model__",
+            params_filename="__params__")
+
+    assert (tmp_path / "sep" / "__model__").exists()
+    # binary, not JSON
+    head = (tmp_path / "sep" / "__model__").read_bytes()[:1]
+    assert head != b"{"
+
+    for sub, params in (("sep", None), ("comb", "__params__")):
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe2 = fluid.Executor(fluid.CPUPlace())
+            prog, feeds, fetches = fluid.io.load_inference_model(
+                str(tmp_path / sub), exe2, params_filename=params)
+            assert feeds == ["x"]
+            (out,) = exe2.run(prog, feed={"x": x}, fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg="proto round-trip (%s)" % sub)
