@@ -17,6 +17,7 @@ __all__ = [
     "yolo_box",
     "roi_align",
     "roi_pool",
+    "prroi_pool",
     "multiclass_nms",
 ]
 
@@ -147,6 +148,26 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1,
                "pooled_width": pooled_width,
                "sampling_ratio": sampling_ratio},
         infer_shape=False)
+    return out
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """Precise ROI pooling (reference layers/nn.py:12680,
+    prroi_pool_op.cc): exact bilinear-surface integration per bin."""
+    helper = LayerHelper("prroi_pool", input=input)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(
+        "prroi_pool",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"spatial_scale": spatial_scale,
+               "pooled_height": pooled_height,
+               "pooled_width": pooled_width},
+        infer_shape=False)
+    out.shape = (int(rois.shape[0]) if rois.shape else -1,
+                 int(input.shape[1]), pooled_height, pooled_width)
+    out.dtype = input.dtype
     return out
 
 

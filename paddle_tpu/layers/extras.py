@@ -541,31 +541,14 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    """Host-python escape hatch (reference py_func_op.cc). Forward-only
-    here: the callable runs on host inside the interpreter; programs
-    containing it never whole-compile."""
-    from ..core.registry import In, OpInfoMap, Out, register_host_op
+    """Delegates to the full py_func layer (nn.py) backed by the real
+    py_func op with backward-callable support (py_func_op.cc); this
+    round-2 forward-only shim kept its export slot here."""
+    from .nn import py_func as _py_func_full
 
-    xs = x if isinstance(x, (list, tuple)) else [x]
-    outs = out if isinstance(out, (list, tuple)) else [out]
-    op_type = framework.unique_name.generate("py_func")
-
-    def host_impl(executor, op, scope, _fn=func):
-        vals = [np.asarray(executor._read_var(scope, n))
-                for n in op.input("X")]
-        res = _fn(*vals)
-        if not isinstance(res, (list, tuple)):
-            res = [res]
-        for n, v in zip(op.output("Out"), res):
-            executor._write_var(scope, n, np.asarray(v))
-
-    register_host_op(op_type, inputs=[In("X", duplicable=True,
-                                         no_grad=True)],
-                     outputs=[Out("Out", duplicable=True)])(host_impl)
-    helper = LayerHelper("py_func")
-    helper.append_op(op_type, inputs={"X": list(xs)},
-                     outputs={"Out": list(outs)}, infer_shape=False)
-    return outs if isinstance(out, (list, tuple)) else outs[0]
+    return _py_func_full(func, x, out, backward_func=backward_func,
+                         skip_vars_in_backward_input=
+                         skip_vars_in_backward_input)
 
 
 def double_buffer(reader, place=None, name=None):

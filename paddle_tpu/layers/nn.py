@@ -19,6 +19,8 @@ __all__ = [
     "fc",
     "embedding",
     "conv2d",
+    "deformable_conv",
+    "py_func",
     "conv2d_transpose",
     "conv3d",
     "pool2d",
@@ -254,6 +256,77 @@ def conv2d(
     )
     pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    """Deformable convolution v2 (modulated=True) / v1 (reference
+    layers/nn.py:13095, deformable_conv_op.cc)."""
+    helper = LayerHelper("deformable_conv", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dtype = input.dtype
+    groups = groups or 1
+    deformable_groups = deformable_groups or 1
+    filter_size = _pair(filter_size)
+    num_channels = input.shape[1]
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+
+    def _default_weight_init():
+        fan_in = num_channels * filter_size[0] * filter_size[1] // groups
+        std = (2.0 / fan_in) ** 0.5
+        return NormalInitializer(0.0, std)
+
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=_default_weight_init())
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+             "dilations": _pair(dilation), "groups": groups,
+             "deformable_groups": deformable_groups,
+             "im2col_step": im2col_step or 64}
+    if modulated:
+        helper.append_op(
+            "deformable_conv",
+            inputs={"Input": [input], "Offset": [offset],
+                    "Mask": [mask], "Filter": [w]},
+            outputs={"Output": [pre_bias]}, attrs=attrs)
+    else:
+        helper.append_op(
+            "deformable_conv_v1",
+            inputs={"Input": [input], "Offset": [offset], "Filter": [w]},
+            outputs={"Output": [pre_bias]}, attrs=attrs)
+    return helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Run a user python callable as a graph op (reference
+    layers/nn.py:12394, py_func_op.cc). ``out`` vars must be
+    pre-created (create_variable/out_var list); ``backward_func``
+    receives (inputs..., outputs..., out-grads...) and returns one grad
+    per input."""
+    from ..ops.gap_ops import register_py_func
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    fwd_id = register_py_func(func)
+    bwd_id = register_py_func(backward_func) if backward_func else -1
+    helper = LayerHelper("py_func")
+    skip = [v.name if hasattr(v, "name") else v
+            for v in (skip_vars_in_backward_input or [])]
+    helper.append_op(
+        "py_func",
+        inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs={"forward_callable_id": fwd_id,
+               "backward_callable_id": bwd_id,
+               "backward_skip_vars": skip},
+        infer_shape=False)
+    return out
 
 
 def conv2d_transpose(

@@ -33,3 +33,4 @@ from . import proposal_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import tail_ops  # noqa: F401
 from . import tail_ops2  # noqa: F401
+from . import gap_ops  # noqa: F401

@@ -1,0 +1,558 @@
+"""Round-3 op-gap wave: deformable conv, precise ROI pooling, 3-D
+max-pool-with-index, int8 (de/re)quantize, py_func, and the LoD
+rank-table op family that backs dynamic RNNs.
+
+Parity targets (/root/reference/paddle/fluid/operators/):
+deformable_conv_op.cc (+_v1), prroi_pool_op.cc/.h, pool_with_index_op.cc
+(3-D), quantize_op.cc / dequantize_op.cc / requantize_op.cc,
+py_func_op.cc, lod_rank_table_op.cc, lod_tensor_to_array_op.cc,
+array_to_lod_tensor_op.cc, shrink_rnn_memory_op.cc,
+max_sequence_len_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op, register_op
+
+# ---------------------------------------------------------------------------
+# deformable convolution (v2 with modulation mask; v1 without)
+# ---------------------------------------------------------------------------
+
+
+def _dcn_sample(x, off, mask, kh, kw, strides, pads, dils, dg):
+    """Sample input taps at offset positions with bilinear interpolation.
+
+    Layout (deformable_conv_op.cu:88-111): Offset is [N, dg*2*kh*kw,
+    Ho, Wo] — per deformable group, (y, x) interleaved per tap; Mask is
+    [N, dg*kh*kw, Ho, Wo]. Returns [N, Cin, kh, kw, Ho, Wo].
+    """
+    n, cin, h, w = x.shape
+    ho, wo = off.shape[2], off.shape[3]
+    sh, sw = strides
+    ph, pw = pads
+    dh, dw = dils
+    cpg = cin // dg
+
+    off = off.reshape(n, dg, kh, kw, 2, ho, wo)
+    off_y, off_x = off[:, :, :, :, 0], off[:, :, :, :, 1]  # [N,dg,kh,kw,Ho,Wo]
+    base_y = (jnp.arange(ho) * sh - ph)[:, None] + jnp.zeros((ho, wo))
+    base_x = (jnp.arange(wo) * sw - pw)[None, :] + jnp.zeros((ho, wo))
+    tap_y = (jnp.arange(kh) * dh)[:, None, None, None]
+    tap_x = (jnp.arange(kw) * dw)[None, :, None, None]
+    py = base_y[None, None, None, None] + tap_y[None, None] + off_y
+    px = base_x[None, None, None, None] + tap_x[None, None] + off_x
+
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy1 = py - y0
+    wx1 = px - x0
+
+    xr = x.reshape(n, dg, cpg, h * w)
+
+    def corner(yc, xc):
+        valid = ((yc >= 0) & (yc < h) & (xc >= 0) & (xc < w))
+        idx = (jnp.clip(yc, 0, h - 1) * w
+               + jnp.clip(xc, 0, w - 1)).astype(jnp.int32)
+        flat = idx.reshape(n, dg, -1)
+        g = jnp.take_along_axis(xr, flat[:, :, None, :], axis=3)
+        g = g.reshape(n, dg, cpg, kh, kw, ho, wo)
+        return g * valid[:, :, None].astype(x.dtype)
+
+    v00 = corner(y0, x0)
+    v01 = corner(y0, x0 + 1)
+    v10 = corner(y0 + 1, x0)
+    v11 = corner(y0 + 1, x0 + 1)
+    wy1e = wy1[:, :, None]
+    wx1e = wx1[:, :, None]
+    sampled = (v00 * (1 - wy1e) * (1 - wx1e) + v01 * (1 - wy1e) * wx1e
+               + v10 * wy1e * (1 - wx1e) + v11 * wy1e * wx1e)
+    if mask is not None:
+        sampled = sampled * mask.reshape(
+            n, dg, 1, kh, kw, ho, wo).astype(x.dtype)
+    return sampled.reshape(n, cin, kh, kw, ho, wo)
+
+
+def _deformable_conv_impl(ins, attrs, with_mask):
+    x, offset, filt = ins["Input"], ins["Offset"], ins["Filter"]
+    mask = ins.get("Mask") if with_mask else None
+    groups = int(attrs.get("groups", 1))
+    dg = int(attrs.get("deformable_groups", 1))
+    cout, cpg_f, kh, kw = filt.shape
+    sampled = _dcn_sample(
+        x, offset, mask, kh, kw,
+        [int(s) for s in attrs.get("strides", [1, 1])],
+        [int(p) for p in attrs.get("paddings", [0, 0])],
+        [int(d) for d in attrs.get("dilations", [1, 1])], dg)
+    n, cin = x.shape[:2]
+    ho, wo = sampled.shape[-2:]
+    sg = sampled.reshape(n, groups, cin // groups, kh, kw, ho, wo)
+    fg = filt.reshape(groups, cout // groups, cpg_f, kh, kw)
+    out = jnp.einsum("ngcijhw,gocij->ngohw", sg, fg)
+    return {"Output": out.reshape(n, cout, ho, wo)}
+
+
+@register_op(
+    "deformable_conv",
+    inputs=[In("Input"), In("Offset"), In("Mask"), In("Filter")],
+    outputs=[Out("Output")],
+    attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+           "groups": 1, "deformable_groups": 1, "im2col_step": 64},
+)
+def _deformable_conv(ins, attrs):
+    return _deformable_conv_impl(ins, attrs, with_mask=True)
+
+
+@register_op(
+    "deformable_conv_v1",
+    inputs=[In("Input"), In("Offset"), In("Filter")],
+    outputs=[Out("Output")],
+    attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+           "groups": 1, "deformable_groups": 1, "im2col_step": 64},
+)
+def _deformable_conv_v1(ins, attrs):
+    return _deformable_conv_impl(ins, attrs, with_mask=False)
+
+
+# ---------------------------------------------------------------------------
+# precise ROI pooling (PrRoIPool) — exact integral of the bilinear
+# surface over each bin (prroi_pool_op.cu:68-95 window math)
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "prroi_pool",
+    inputs=[In("X"), In("ROIs", no_grad=True),
+            In("BatchRoINums", dispensable=True, no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"spatial_scale": 1.0, "pooled_height": 1, "pooled_width": 1},
+    needs_lod=True,
+)
+def _prroi_pool(ins, attrs):
+    x, rois = ins["X"], ins["ROIs"]
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ph_n = int(attrs.get("pooled_height", 1))
+    pw_n = int(attrs.get("pooled_width", 1))
+    n, c, h, w = x.shape
+    nroi = rois.shape[0]
+    # batch assignment: ROI LoD when present (single source of truth:
+    # lod_utils.batch_ids_for, as roi_align/roi_pool use), else a dense
+    # BatchRoINums tensor (reference prroi_pool non-LoD API), else
+    # image 0
+    from .lod_utils import batch_ids_for, lod_offsets
+
+    brn = ins.get("BatchRoINums")
+    if lod_offsets(attrs, "ROIs") is not None:
+        batch_ids = batch_ids_for(attrs, "ROIs", nroi)
+    elif brn is not None:
+        bounds = jnp.cumsum(brn.astype(jnp.int64))
+        batch_ids = jnp.searchsorted(bounds, jnp.arange(nroi),
+                                     side="right").astype(jnp.int32)
+    else:
+        batch_ids = jnp.zeros((nroi,), jnp.int32)
+
+    sw = rois[:, 0] * scale
+    sh = rois[:, 1] * scale
+    ew = rois[:, 2] * scale
+    eh = rois[:, 3] * scale
+    roi_w = jnp.maximum(ew - sw, 0.0)
+    roi_h = jnp.maximum(eh - sh, 0.0)
+    bin_w = roi_w / pw_n
+    bin_h = roi_h / ph_n
+
+    # per-bin windows [R, ph, pw]
+    wy0 = sh[:, None, None] + bin_h[:, None, None] * \
+        jnp.arange(ph_n)[None, :, None]
+    wx0 = sw[:, None, None] + bin_w[:, None, None] * \
+        jnp.arange(pw_n)[None, None, :]
+    wy1 = wy0 + bin_h[:, None, None]
+    wx1 = wx0 + bin_w[:, None, None]
+
+    # integral weights per grid line: cell [i, i+1] contributes
+    # A0 = ∫(1-u)du and A1 = ∫u du over u ∈ [clip(y0-i), clip(y1-i)].
+    # Cells run from -1 to size-1: the reference zero-pads DATA outside
+    # the image but still integrates boundary cells, so cell [-1, 0]
+    # contributes its ∫u weight to grid line 0 (windows past the
+    # top/left border are not clipped by PrRoIPool).
+    def line_weights(a0, a1, size):
+        i = jnp.arange(-1, size)[None, None, None, :]
+        u0 = jnp.clip(a0[..., None] - i, 0.0, 1.0)
+        u1 = jnp.clip(a1[..., None] - i, 0.0, 1.0)
+        w1 = 0.5 * (u1 * u1 - u0 * u0)     # ∫ u
+        w0 = (u1 - u0) - w1                # ∫ (1-u)
+        return w0, w1
+
+    ay0, ay1 = line_weights(wy0, wy1, h)   # [R, ph, pw, H+1] cells
+    bx0, bx1 = line_weights(wx0, wx1, w)   # [R, ph, pw, W+1] cells
+    # grid value j collects A0 from cell j (index j+1 in the padded
+    # cell axis) and A1 from cell j-1 (index j)
+    ay = ay0[..., 1:] + ay1[..., :-1]
+    bx = bx0[..., 1:] + bx1[..., :-1]
+
+    xg = x[batch_ids]                      # [R, C, H, W]
+    integral = jnp.einsum("rchw,rpqh,rpqw->rcpq", xg, ay, bx)
+    area = jnp.maximum(bin_w * bin_h, 0.0)[:, None, None, None]
+    out = jnp.where(area > 0, integral / jnp.maximum(area, 1e-12), 0.0)
+    return {"Out": out.astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# max_pool3d_with_index (pool_with_index_op.cc, NCDHW)
+# ---------------------------------------------------------------------------
+
+
+@register_op("max_pool3d_with_index", inputs=[In("X")],
+             outputs=[Out("Out"), Out("Mask", no_grad=True)],
+             attrs={"ksize": [1, 1, 1], "strides": [1, 1, 1],
+                    "paddings": [0, 0, 0], "global_pooling": False,
+                    "adaptive": False})
+def _max_pool3d_with_index(ins, attrs):
+    x = ins["X"]
+    n, c, d, h, w = x.shape
+    kd, kh, kw = attrs["ksize"]
+    sd, sh, sw = attrs.get("strides", [1, 1, 1])
+    pd, ph, pw = attrs.get("paddings", [0, 0, 0])
+    if attrs.get("global_pooling"):
+        kd, kh, kw, pd, ph, pw = d, h, w, 0, 0, 0
+    if attrs.get("adaptive"):
+        return _adaptive_max_pool3d_with_index(x, kd, kh, kw)
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    dp, hp, wp = xp.shape[2:]
+    flat_idx = jnp.arange(dp * hp * wp).reshape(dp, hp, wp)
+    od = (d + 2 * pd - kd) // sd + 1
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    outs, idxs = [], []
+    for a in range(kd):
+        for i in range(kh):
+            for j in range(kw):
+                outs.append(xp[:, :, a:a + od * sd:sd, i:i + oh * sh:sh,
+                               j:j + ow * sw:sw])
+                idxs.append(jnp.broadcast_to(
+                    flat_idx[a:a + od * sd:sd, i:i + oh * sh:sh,
+                             j:j + ow * sw:sw], (n, c, od, oh, ow)))
+    stack = jnp.stack(outs, axis=0)
+    which = jnp.argmax(stack, axis=0)
+    out = jnp.max(stack, axis=0)
+    picked = jnp.take_along_axis(jnp.stack(idxs, axis=0), which[None],
+                                 axis=0)[0]
+    prow = picked // (hp * wp) - pd
+    rem = picked % (hp * wp)
+    pr = rem // wp - ph
+    pc = rem % wp - pw
+    mask = (prow * h + pr) * w + pc
+    return {"Out": out, "Mask": mask.astype(jnp.int32)}
+
+
+def _adaptive_max_pool3d_with_index(x, od, oh, ow):
+    n, c, d, h, w = x.shape
+    out = jnp.zeros((n, c, od, oh, ow), x.dtype)
+    mask = jnp.zeros((n, c, od, oh, ow), jnp.int32)
+    for a in range(od):
+        d0, d1 = (a * d) // od, -(-((a + 1) * d) // od)
+        for i in range(oh):
+            h0, h1 = (i * h) // oh, -(-((i + 1) * h) // oh)
+            for j in range(ow):
+                w0, w1 = (j * w) // ow, -(-((j + 1) * w) // ow)
+                win = x[:, :, d0:d1, h0:h1, w0:w1].reshape(n, c, -1)
+                am = jnp.argmax(win, axis=2)
+                dd = (d1 - d0)
+                hh = (h1 - h0)
+                ww = (w1 - w0)
+                az = am // (hh * ww) + d0
+                rr = am % (hh * ww)
+                ai = rr // ww + h0
+                aj = rr % ww + w0
+                flat = (az * h + ai) * w + aj
+                out = out.at[:, :, a, i, j].set(jnp.max(win, axis=2))
+                mask = mask.at[:, :, a, i, j].set(flat.astype(jnp.int32))
+    return {"Out": out, "Mask": mask}
+
+
+# ---------------------------------------------------------------------------
+# int8 quantize / dequantize / requantize (quantize_op.cc family)
+# ---------------------------------------------------------------------------
+
+
+@register_op("quantize", inputs=[In("Input", no_grad=True)],
+             outputs=[Out("Output")],
+             attrs={"Scale": 1.0, "is_negative_input": False,
+                    "output_format": "NCHW"}, grad=None)
+def _quantize(ins, attrs):
+    """Out = round(X * Scale) saturated to int8 (signed) or uint8."""
+    x = ins["Input"]
+    s = float(attrs.get("Scale", 1.0))
+    q = jnp.round(x * s)
+    if attrs.get("is_negative_input", False):
+        return {"Output": jnp.clip(q, -128, 127).astype(jnp.int8)}
+    return {"Output": jnp.clip(q, 0, 255).astype(jnp.uint8)}
+
+
+@register_op("dequantize", inputs=[In("Input", no_grad=True)],
+             outputs=[Out("Output")],
+             attrs={"Scale": 1.0}, grad=None)
+def _dequantize(ins, attrs):
+    s = float(attrs.get("Scale", 1.0))
+    return {"Output": ins["Input"].astype(jnp.float32) / s}
+
+
+@register_op("requantize", inputs=[In("Input", no_grad=True)],
+             outputs=[Out("Output")],
+             attrs={"Scale_in": 1.0, "Scale_out": 1.0}, grad=None)
+def _requantize(ins, attrs):
+    s_in = float(attrs.get("Scale_in", 1.0))
+    s_out = float(attrs.get("Scale_out", 1.0))
+    x = ins["Input"].astype(jnp.float32)
+    q = jnp.round(x * (s_out / s_in))
+    return {"Output": jnp.clip(q, -128, 127).astype(jnp.int8)}
+
+
+# ---------------------------------------------------------------------------
+# py_func (py_func_op.cc): user python callables as graph ops
+# ---------------------------------------------------------------------------
+
+_PY_FUNC_REGISTRY = []
+
+
+def register_py_func(fn) -> int:
+    _PY_FUNC_REGISTRY.append(fn)
+    return len(_PY_FUNC_REGISTRY) - 1
+
+
+def _py_func_grad_maker(block, op, pending, finalize):
+    """Emit a backward py_func op when a backward callable was
+    registered (py_func_op.cc grad maker): the backward fn receives
+    (forward inputs..., forward outputs..., out grads...) minus any
+    backward_skip_vars, and returns one grad per (unskipped) forward
+    input (None allowed → zero grad)."""
+    bwd_id = int(op.attrs.get("backward_callable_id", -1))
+    if bwd_id < 0:
+        return
+    ogs = []
+    for n in op.output("Out"):
+        g = finalize(n)
+        ogs.append(g if g is not None else "@EMPTY@")
+    if all(g == "@EMPTY@" for g in ogs):
+        return
+    from .control_flow_ops import _bind_partial_grad
+
+    skip = set(op.attrs.get("backward_skip_vars") or [])
+    grad_for = [n for n in op.input("X") if n not in skip]
+    gnames = [_bind_partial_grad(block, pending, n) for n in grad_for]
+    bwd_x = (grad_for
+             + [n for n in op.output("Out") if n not in skip] + ogs)
+    block.append_op(
+        "py_func",
+        {"X": bwd_x},
+        {"Out": gnames},
+        {"forward_callable_id": bwd_id, "backward_callable_id": -1,
+         "_grad_for": grad_for},
+        infer_shape=False)
+
+
+@register_host_op(
+    "py_func",
+    inputs=[In("X", duplicable=True, no_grad=True)],
+    outputs=[Out("Out", duplicable=True)],
+    attrs={"forward_callable_id": -1, "backward_callable_id": -1,
+           "backward_skip_vars": []},
+    grad=_py_func_grad_maker,
+)
+def _py_func(executor, op, scope):
+    fn = _PY_FUNC_REGISTRY[int(op.attrs["forward_callable_id"])]
+    args = []
+    for n in op.input("X"):
+        v = executor._read_var(scope, n)
+        args.append(None if v is None else np.asarray(v))
+    outs = fn(*args)
+    if outs is None:
+        outs = ()
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    names = op.output("Out")
+    grad_for = op.attrs.get("_grad_for")  # set only on backward ops
+    outs = list(outs) + [None] * (len(names) - len(outs))
+    for i, (name, val) in enumerate(zip(names, outs)):
+        if val is None:
+            if grad_for is not None:
+                # backward callable returned None for this input: zero
+                # grad, shaped like the forward var (its grad slot was
+                # already bound into the pending sum)
+                ref = executor._read_var(scope, grad_for[i])
+                val = np.zeros_like(np.asarray(ref))
+            else:
+                raise ValueError(
+                    "py_func forward callable produced %d output(s) "
+                    "but the op declares %d (py_func_op.cc enforces "
+                    "the output arity)" % (len([o for o in outs
+                                                if o is not None]),
+                                           len(names)))
+        executor._write_var(scope, name, np.asarray(val))
+
+
+# ---------------------------------------------------------------------------
+# LoD rank-table family (dynamic_rnn substrate)
+# ---------------------------------------------------------------------------
+
+
+class LoDRankTable:
+    """(index, length) items sorted by length desc, stable
+    (lod_rank_table.h): the execution order for time-major RNN steps."""
+
+    def __init__(self, items):
+        self.items = list(items)  # [(original_seq_idx, seq_len), ...]
+
+    def active_at(self, t: int) -> int:
+        return sum(1 for _, ln in self.items if ln > t)
+
+    def max_len(self) -> int:
+        return self.items[0][1] if self.items else 0
+
+
+def _seq_lengths_from_lod(lod, level):
+    offsets = lod[level]
+    return [offsets[i + 1] - offsets[i] for i in range(len(offsets) - 1)]
+
+
+@register_host_op("lod_rank_table", inputs=[In("X", no_grad=True)],
+                  outputs=[Out("Out")], attrs={"level": 0})
+def _lod_rank_table(executor, op, scope):
+    var = scope.find_var(op.input("X")[0])
+    t = var.raw()
+    lod = t.lod()
+    level = int(op.attrs.get("level", 0))
+    if not lod:
+        n = t.array.shape[0]
+        lengths = [1] * n
+    else:
+        lengths = _seq_lengths_from_lod(lod, level)
+    items = sorted(enumerate(lengths), key=lambda kv: -kv[1])
+    scope.var(op.output("Out")[0]).set(LoDRankTable(items))
+
+
+@register_host_op("max_sequence_len",
+                  inputs=[In("RankTable", no_grad=True)],
+                  outputs=[Out("Out")])
+def _max_sequence_len(executor, op, scope):
+    table = scope.find_var(op.input("RankTable")[0]).raw()
+    executor._write_var(scope, op.output("Out")[0],
+                        np.asarray([table.max_len()], dtype="int64"))
+
+
+def _lod_tensor_to_array_grad_maker(block, op, pending, finalize):
+    """Adjoint pair: d(lod_tensor_to_array)/dX = array_to_lod_tensor of
+    the out-grad array with the same rank table (and vice versa)."""
+    g_out = finalize(op.output("Out")[0])
+    if g_out is None:
+        return
+    from .control_flow_ops import _bind_partial_grad
+
+    gname = _bind_partial_grad(block, pending, op.input("X")[0])
+    block.append_op(
+        "array_to_lod_tensor",
+        {"X": [g_out], "RankTable": [op.input("RankTable")[0]]},
+        {"Out": [gname]}, {}, infer_shape=False)
+
+
+def _array_to_lod_tensor_grad_maker(block, op, pending, finalize):
+    g_out = finalize(op.output("Out")[0])
+    if g_out is None:
+        return
+    from .control_flow_ops import _bind_partial_grad
+
+    gname = _bind_partial_grad(block, pending, op.input("X")[0])
+    block.append_op(
+        "lod_tensor_to_array",
+        {"X": [g_out], "RankTable": [op.input("RankTable")[0]]},
+        {"Out": [gname]}, {}, infer_shape=False)
+
+
+
+@register_host_op("lod_tensor_to_array",
+                  inputs=[In("X"), In("RankTable", no_grad=True)],
+                  outputs=[Out("Out")],
+                  grad=_lod_tensor_to_array_grad_maker)
+def _lod_tensor_to_array(executor, op, scope):
+    """Split X into a time-major TensorArray by the rank table
+    (lod_tensor_to_array_op.cc): array[t] stacks row t of every
+    sequence still active at step t, in rank order."""
+    from ..core.tensor import LoDTensor, LoDTensorArray
+
+    xvar = scope.find_var(op.input("X")[0]).raw()
+    table = scope.find_var(op.input("RankTable")[0]).raw()
+    x = np.asarray(xvar.array)
+    lod = xvar.lod()
+    offsets = (lod[0] if lod
+               else list(range(x.shape[0] + 1)))
+    arr = LoDTensorArray()
+    for t in range(table.max_len()):
+        rows = [offsets[idx] + t for idx, ln in table.items if ln > t]
+        step = LoDTensor()
+        step.set(jnp.asarray(x[np.asarray(rows, dtype=np.int64)]))
+        arr.append(step)
+    scope.var(op.output("Out")[0]).set(arr)
+
+
+@register_host_op("array_to_lod_tensor",
+                  inputs=[In("X"), In("RankTable", no_grad=True)],
+                  outputs=[Out("Out")],
+                  grad=_array_to_lod_tensor_grad_maker)
+def _array_to_lod_tensor(executor, op, scope):
+    """Inverse of lod_tensor_to_array: reassemble original sequence
+    order + LoD (array_to_lod_tensor_op.cc)."""
+    from ..core.tensor import LoDTensor
+
+    arr = scope.find_var(op.input("X")[0]).raw()
+    table = scope.find_var(op.input("RankTable")[0]).raw()
+    steps = [np.asarray(t.array) for t in arr]
+    n_seq = len(table.items)
+    lengths_by_orig = {idx: ln for idx, ln in table.items}
+    rank_of = {idx: r for r, (idx, _) in enumerate(table.items)}
+    feature_shape = steps[0].shape[1:] if steps else (0,)
+    seqs = []
+    for orig in range(n_seq):
+        ln = lengths_by_orig[orig]
+        r = rank_of[orig]
+        rows = [steps[t][r] for t in range(ln)]
+        seqs.append(np.stack(rows) if rows
+                    else np.zeros((0,) + feature_shape, steps[0].dtype))
+    full = np.concatenate(seqs) if seqs else np.zeros((0,) + feature_shape)
+    out = LoDTensor()
+    out.set(jnp.asarray(full))
+    offs = [0]
+    for orig in range(n_seq):
+        offs.append(offs[-1] + lengths_by_orig[orig])
+    out._lod = [offs]
+    scope.var(op.output("Out")[0]).set(out)
+
+
+@register_host_op("shrink_rnn_memory",
+                  inputs=[In("X"), In("RankTable", no_grad=True),
+                          In("I", no_grad=True)],
+                  outputs=[Out("Out")])
+def _shrink_rnn_memory(executor, op, scope):
+    """Keep the first k rows of X where k = #sequences active at step I
+    (shrink_rnn_memory_op.cc); the grad pads dropped rows with zeros."""
+    x = executor._read_var(scope, op.input("X")[0])
+    table = scope.find_var(op.input("RankTable")[0]).raw()
+    i = int(np.asarray(
+        executor._read_var(scope, op.input("I")[0])).ravel()[0])
+    k = table.active_at(i)
+    executor._write_var(scope, op.output("Out")[0], x[:k])
+
+
+@register_host_op("shrink_rnn_memory_grad",
+                  inputs=[In("X", no_grad=True),
+                          In("Out@GRAD", no_grad=True)],
+                  outputs=[Out("X@GRAD")])
+def _shrink_rnn_memory_grad(executor, op, scope):
+    """Zero-pad the shrunk grad back to X's row count
+    (shrink_rnn_memory_op.cc grad: dropped rows get zero grad)."""
+    x = executor._read_var(scope, op.input("X")[0])
+    og = executor._read_var(scope, op.input("Out@GRAD")[0])
+    g = jnp.zeros_like(x).at[:og.shape[0]].set(og)
+    executor._write_var(scope, op.output("X@GRAD")[0], g)

@@ -1,0 +1,433 @@
+"""Round-3 op-gap wave tests: OpTest check_output/check_grad against
+numpy oracles (reference op semantics cited per case)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from tests.op_test import OpTest
+
+
+def _bilinear(x, y, xx):
+    """Zero-padded bilinear sample of x[c, H, W] at (y, xx)."""
+    h, w = x.shape[-2:]
+    y0, x0 = int(np.floor(y)), int(np.floor(xx))
+
+    def at(i, j):
+        if i < 0 or j < 0 or i >= h or j >= w:
+            return np.zeros(x.shape[:-2], x.dtype)
+        return x[..., i, j]
+
+    ly, lx = y - y0, xx - x0
+    return (at(y0, x0) * (1 - ly) * (1 - lx)
+            + at(y0, x0 + 1) * (1 - ly) * lx
+            + at(y0 + 1, x0) * ly * (1 - lx)
+            + at(y0 + 1, x0 + 1) * ly * lx)
+
+
+def _dcn_ref(x, offset, mask, filt, stride, pad, dil, groups, dg):
+    """deformable_conv_op.cu:88-111 semantics."""
+    n, cin, h, w = x.shape
+    cout, cpgf, kh, kw = filt.shape
+    ho = (h + 2 * pad - (dil * (kh - 1) + 1)) // stride + 1
+    wo = (w + 2 * pad - (dil * (kw - 1) + 1)) // stride + 1
+    off = offset.reshape(n, dg, kh, kw, 2, ho, wo)
+    cpg = cin // dg
+    sampled = np.zeros((n, cin, kh, kw, ho, wo), x.dtype)
+    for b in range(n):
+        for c in range(cin):
+            g = c // cpg
+            for i in range(kh):
+                for j in range(kw):
+                    for p in range(ho):
+                        for q in range(wo):
+                            y = p * stride - pad + i * dil + \
+                                off[b, g, i, j, 0, p, q]
+                            xx = q * stride - pad + j * dil + \
+                                off[b, g, i, j, 1, p, q]
+                            v = _bilinear(x[b, c], y, xx)
+                            if mask is not None:
+                                v = v * mask.reshape(
+                                    n, dg, kh, kw, ho, wo)[b, g, i, j, p, q]
+                            sampled[b, c, i, j, p, q] = v
+    out = np.zeros((n, cout, ho, wo), x.dtype)
+    cing = cin // groups
+    coutg = cout // groups
+    for co in range(cout):
+        g = co // coutg
+        for c in range(cing):
+            out[:, co] += np.einsum(
+                "nijpq,ij->npq", sampled[:, g * cing + c], filt[co, c])
+    return out
+
+
+class TestDeformableConv(OpTest):
+    op_type = "deformable_conv"
+
+    def setUp(self):
+        rng = np.random.RandomState(5)
+        n, cin, h, w = 2, 4, 5, 5
+        cout, kh, kw, dg, groups = 4, 3, 3, 2, 2
+        x = rng.randn(n, cin, h, w).astype("float32")
+        ho = wo = 5  # stride 1, pad 1
+        offset = (rng.rand(n, dg * 2 * kh * kw, ho, wo)
+                  .astype("float32") - 0.5)
+        mask = rng.rand(n, dg * kh * kw, ho, wo).astype("float32")
+        filt = rng.randn(cout, cin // groups, kh, kw).astype("float32")
+        self.inputs = {"Input": x, "Offset": offset, "Mask": mask,
+                       "Filter": filt}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": groups,
+                      "deformable_groups": dg}
+        self.outputs = {"Output": _dcn_ref(x, offset, mask, filt,
+                                           1, 1, 1, groups, dg)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["input", "offset", "mask", "filter"], "Output",
+                        max_relative_error=0.06)
+
+
+class TestDeformableConvV1(OpTest):
+    op_type = "deformable_conv_v1"
+
+    def setUp(self):
+        rng = np.random.RandomState(7)
+        n, cin, h, w = 1, 2, 4, 4
+        cout, kh, kw = 2, 3, 3
+        x = rng.randn(n, cin, h, w).astype("float32")
+        offset = (rng.rand(n, 2 * kh * kw, 4, 4).astype("float32") - 0.5)
+        filt = rng.randn(cout, cin, kh, kw).astype("float32")
+        self.inputs = {"Input": x, "Offset": offset, "Filter": filt}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "deformable_groups": 1}
+        self.outputs = {"Output": _dcn_ref(x, offset, None, filt,
+                                           1, 1, 1, 1, 1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["input", "offset", "filter"], "Output",
+                        max_relative_error=0.06)
+
+
+def _prroi_ref(x, rois, batch_ids, scale, ph_n, pw_n):
+    """Exact integral oracle via dense supersampling (converges to the
+    analytic integral the kernel computes; prroi_pool_op.cu:68)."""
+    nroi = rois.shape[0]
+    c = x.shape[1]
+    out = np.zeros((nroi, c, ph_n, pw_n), "float64")
+    S = 64
+    for r in range(nroi):
+        b = batch_ids[r]
+        sw, sh, ew, eh = rois[r] * scale
+        bw = max(ew - sw, 0) / pw_n
+        bh = max(eh - sh, 0) / ph_n
+        if bw * bh <= 0:
+            continue
+        for p in range(ph_n):
+            for q in range(pw_n):
+                ys = np.linspace(sh + p * bh, sh + (p + 1) * bh,
+                                 S, endpoint=False) + bh / (2 * S)
+                xs = np.linspace(sw + q * bw, sw + (q + 1) * bw,
+                                 S, endpoint=False) + bw / (2 * S)
+                acc = np.zeros(c, "float64")
+                for y in ys:
+                    for xx in xs:
+                        acc += _bilinear(x[b].astype("float64"), y, xx)
+                out[r, :, p, q] = acc / (S * S)
+    return out.astype("float32")
+
+
+class TestPrRoiPool(OpTest):
+    op_type = "prroi_pool"
+
+    def setUp(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 2, 8, 8).astype("float32")
+        rois = np.array([[0.5, 0.7, 6.3, 6.1],
+                         [1.0, 1.0, 5.0, 7.0]], "float32")
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"spatial_scale": 1.0, "pooled_height": 2,
+                      "pooled_width": 2}
+        self.outputs = {"Out": _prroi_ref(x, rois, [0, 0], 1.0, 2, 2)}
+
+    def test_output(self):
+        self.check_output(atol=2e-3, rtol=2e-3)  # supersampling oracle
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out", max_relative_error=0.05)
+
+
+class TestPrRoiPoolBorder(OpTest):
+    """ROIs extending past the top/left border: PrRoIPool does NOT clip
+    the window — boundary cells integrate against zero-padded data
+    (prroi_pool_op.h PrRoIPoolingGetData)."""
+    op_type = "prroi_pool"
+
+    def setUp(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(1, 1, 6, 6).astype("float32")
+        rois = np.array([[-1.5, -0.5, 3.5, 2.5]], "float32")
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"spatial_scale": 1.0, "pooled_height": 1,
+                      "pooled_width": 1}
+        self.outputs = {"Out": _prroi_ref(x, rois, [0], 1.0, 1, 1)}
+
+    def test_output(self):
+        self.check_output(atol=2e-3, rtol=2e-3)
+
+
+def test_prroi_batch_roi_nums():
+    """Dense (non-LoD) ROI batches route to their images via
+    BatchRoINums (reference prroi_pool non-LoD API)."""
+    import paddle_tpu as fluid
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 1, 6, 6).astype("float32")
+    rois = np.array([[1, 1, 5, 5], [1, 1, 5, 5]], "float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    b = main.global_block()
+    for name, arr in (("pb_x", x), ("pb_rois", rois),
+                      ("pb_nums", np.array([1, 1], "int64"))):
+        v = b.create_var(name=name, shape=list(arr.shape),
+                         dtype=str(arr.dtype))
+    b.append_op("prroi_pool",
+                {"X": ["pb_x"], "ROIs": ["pb_rois"],
+                 "BatchRoINums": ["pb_nums"]},
+                {"Out": ["pb_out"]},
+                {"spatial_scale": 1.0, "pooled_height": 1,
+                 "pooled_width": 1}, infer_shape=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        (out,) = exe.run(main,
+                         feed={"pb_x": x, "pb_rois": rois,
+                               "pb_nums": np.array([1, 1], "int64")},
+                         fetch_list=["pb_out"])
+    ref0 = _prroi_ref(x[:1], rois[:1], [0], 1.0, 1, 1)
+    ref1 = _prroi_ref(x[1:], rois[1:], [0], 1.0, 1, 1)
+    np.testing.assert_allclose(out[0], ref0[0], atol=2e-3, rtol=2e-3)
+    np.testing.assert_allclose(out[1], ref1[0], atol=2e-3, rtol=2e-3)
+    assert not np.allclose(out[0], out[1])  # really different images
+
+
+class TestMaxPool3dWithIndex(OpTest):
+    op_type = "max_pool3d_with_index"
+
+    def setUp(self):
+        rng = np.random.RandomState(11)
+        x = rng.randn(2, 2, 4, 6, 6).astype("float32")
+        k, s, p = 2, 2, 0
+        n, c, d, h, w = x.shape
+        od, oh, ow = d // 2, h // 2, w // 2
+        out = np.zeros((n, c, od, oh, ow), "float32")
+        mask = np.zeros((n, c, od, oh, ow), "int32")
+        for a in range(od):
+            for i in range(oh):
+                for j in range(ow):
+                    win = x[:, :, 2 * a:2 * a + 2, 2 * i:2 * i + 2,
+                            2 * j:2 * j + 2].reshape(n, c, -1)
+                    am = np.argmax(win, axis=2)
+                    out[:, :, a, i, j] = np.max(win, axis=2)
+                    az = am // 4 + 2 * a
+                    ai = (am % 4) // 2 + 2 * i
+                    aj = am % 2 + 2 * j
+                    mask[:, :, a, i, j] = (az * h + ai) * w + aj
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [k, k, k], "strides": [s, s, s],
+                      "paddings": [p, p, p]}
+        self.outputs = {"Out": out, "Mask": mask}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "Out", max_relative_error=0.02)
+
+
+class TestQuantizeOps(OpTest):
+    op_type = "quantize"
+
+    def setUp(self):
+        x = np.array([[0.2, -1.4, 0.51], [3.1, 0.0, -0.49]], "float32")
+        self.inputs = {"Input": x}
+        self.attrs = {"Scale": 50.0, "is_negative_input": True}
+        self.outputs = {"Output": np.clip(np.round(x * 50.0), -128,
+                                          127).astype("int8")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_dequantize(self):
+        q = np.array([[10, -70], [127, -128]], "int8")
+        t = OpTest()
+        t.op_type = "dequantize"
+        t.inputs = {"Input": q}
+        t.attrs = {"Scale": 50.0}
+        t.outputs = {"Output": q.astype("float32") / 50.0}
+        t.check_output()
+
+    def test_requantize(self):
+        q = np.array([[10, -70], [127, -128]], "int8")
+        t = OpTest()
+        t.op_type = "requantize"
+        t.inputs = {"Input": q}
+        t.attrs = {"Scale_in": 50.0, "Scale_out": 25.0}
+        t.outputs = {"Output": np.clip(
+            np.round(q.astype("float32") * 0.5), -128, 127).astype("int8")}
+        t.check_output()
+
+    def test_unsigned_quantize(self):
+        x = np.array([0.1, 2.0, 7.7], "float32")
+        t = OpTest()
+        t.op_type = "quantize"
+        t.inputs = {"Input": x}
+        t.attrs = {"Scale": 40.0, "is_negative_input": False}
+        t.outputs = {"Output": np.clip(np.round(x * 40.0), 0,
+                                       255).astype("uint8")}
+        t.check_output()
+
+
+def test_py_func_forward_and_backward():
+    """py_func_op.cc: user callables in the graph; the backward callable
+    receives (ins, outs, out-grads) and returns input grads."""
+    from paddle_tpu.ops.gap_ops import register_py_func
+
+    fwd_id = register_py_func(lambda a: np.tanh(a))
+    bwd_id = register_py_func(
+        lambda a, out, dout: dout * (1.0 - out * out))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="pf_x", shape=[3, 4], dtype="float32")
+        x.stop_gradient = False
+        out = main.global_block().create_var(name="pf_out",
+                                             shape=[3, 4],
+                                             dtype="float32")
+        out.stop_gradient = False
+        main.global_block().append_op(
+            "py_func", {"X": ["pf_x"]}, {"Out": ["pf_out"]},
+            {"forward_callable_id": fwd_id,
+             "backward_callable_id": bwd_id}, infer_shape=False)
+        loss = fluid.layers.reduce_sum(out)
+    from paddle_tpu.backward import append_backward
+
+    with fluid.program_guard(main, startup):
+        append_backward(loss)
+
+    scope = fluid.Scope()
+    xv = np.random.RandomState(0).randn(3, 4).astype("float32")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        (o,) = exe.run(main, feed={"pf_x": xv}, fetch_list=["pf_out"])
+        g = np.asarray(scope.find_var("pf_x@GRAD").raw().array)
+    np.testing.assert_allclose(o, np.tanh(xv), rtol=1e-6)
+    np.testing.assert_allclose(g, 1.0 - np.tanh(xv) ** 2, rtol=1e-5)
+
+
+def test_py_func_skip_vars_in_backward():
+    """skip_vars_in_backward_input removes vars from the backward
+    callable's argument list (py_func_op.cc contract)."""
+    from paddle_tpu.backward import append_backward
+    from paddle_tpu.ops.gap_ops import register_py_func
+
+    seen = {}
+
+    def fwd(a, b):
+        return a + b * b
+
+    def bwd(b, out, dout):  # 'a' skipped: only (b, out, dout) arrive
+        seen["nargs"] = 3
+        return dout * 2.0 * b  # grad for the one unskipped input, b
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data(name="sk_a", shape=[2, 2], dtype="float32")
+        bvar = fluid.data(name="sk_b", shape=[2, 2], dtype="float32")
+        a.stop_gradient = False
+        bvar.stop_gradient = False
+        out = main.global_block().create_var(
+            name="sk_out", shape=[2, 2], dtype="float32")
+        out.stop_gradient = False
+        fluid.layers.py_func(fwd, [a, bvar], [out], backward_func=bwd,
+                             skip_vars_in_backward_input=[a])
+        loss = fluid.layers.reduce_sum(out)
+    with fluid.program_guard(main, startup):
+        append_backward(loss)
+
+    scope = fluid.Scope()
+    av = np.ones((2, 2), "float32") * 3
+    bv = np.ones((2, 2), "float32") * 5
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(main, feed={"sk_a": av, "sk_b": bv}, fetch_list=["sk_out"])
+        # 'a' was skipped, so grads bind only to b
+        assert scope.find_var("sk_a@GRAD") is None \
+            or not scope.find_var("sk_a@GRAD").is_initialized()
+        gb = np.asarray(scope.find_var("sk_b@GRAD").raw().array)
+    assert seen.get("nargs") == 3
+    np.testing.assert_allclose(gb, 2.0 * bv, rtol=1e-6)  # dout=1
+
+
+def test_lod_rank_table_family():
+    """lod_rank_table / max_sequence_len / lod_tensor_to_array /
+    array_to_lod_tensor round trip + shrink_rnn_memory semantics."""
+    from paddle_tpu.core.tensor import LoDTensor
+
+    x = np.arange(12, dtype="float32").reshape(6, 2)
+    lod = [[3, 1, 2]]  # three sequences: lengths 3, 1, 2
+    t = LoDTensor()
+    t.set(x)
+    t.set_recursive_sequence_lengths(lod)
+
+    main, startup = fluid.Program(), fluid.Program()
+    b = main.global_block()
+    for name in ("rt_x", "rt_i"):
+        b.create_var(name=name)
+    b.append_op("lod_rank_table", {"X": ["rt_x"]}, {"Out": ["rt_table"]},
+                {"level": 0}, infer_shape=False)
+    b.append_op("max_sequence_len", {"RankTable": ["rt_table"]},
+                {"Out": ["rt_maxlen"]}, {}, infer_shape=False)
+    b.append_op("lod_tensor_to_array",
+                {"X": ["rt_x"], "RankTable": ["rt_table"]},
+                {"Out": ["rt_arr"]}, {}, infer_shape=False)
+    b.append_op("array_to_lod_tensor",
+                {"X": ["rt_arr"], "RankTable": ["rt_table"]},
+                {"Out": ["rt_back"]}, {}, infer_shape=False)
+    b.append_op("shrink_rnn_memory",
+                {"X": ["rt_mem"], "RankTable": ["rt_table"],
+                 "I": ["rt_i"]},
+                {"Out": ["rt_shrunk"]}, {}, infer_shape=False)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        mem = np.arange(9, dtype="float32").reshape(3, 3)
+        exe.run(main, feed={"rt_x": t, "rt_mem": mem,
+                            "rt_i": np.array([1], "int64")},
+                fetch_list=[])
+        table = scope.find_var("rt_table").raw()
+        # sorted by length desc: seq0 (len 3), seq2 (len 2), seq1 (len 1)
+        assert table.items == [(0, 3), (2, 2), (1, 1)]
+        maxlen = np.asarray(scope.find_var("rt_maxlen").raw().array)
+        assert int(maxlen.ravel()[0]) == 3
+        arr = scope.find_var("rt_arr").raw()
+        # t=0: rows for seqs (0,2,1) = x[0], x[4], x[3]
+        np.testing.assert_array_equal(np.asarray(arr[0].array),
+                                      x[[0, 4, 3]])
+        # t=1: seqs 0 and 2 alive = x[1], x[5]
+        np.testing.assert_array_equal(np.asarray(arr[1].array),
+                                      x[[1, 5]])
+        # t=2: only seq0 = x[2]
+        np.testing.assert_array_equal(np.asarray(arr[2].array), x[[2]])
+        back = scope.find_var("rt_back").raw()
+        np.testing.assert_array_equal(np.asarray(back.array), x)
+        assert back.lod() == [[0, 3, 4, 6]]
+        shrunk = np.asarray(scope.find_var("rt_shrunk").raw().array)
+        # at step 1, two sequences are active
+        np.testing.assert_array_equal(shrunk, mem[:2])
