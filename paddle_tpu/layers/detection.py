@@ -157,9 +157,12 @@ def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
     prroi_pool_op.cc): exact bilinear-surface integration per bin."""
     helper = LayerHelper("prroi_pool", input=input)
     out = helper.create_variable_for_type_inference(helper.input_dtype())
+    inputs = {"X": [input], "ROIs": [rois]}
+    if batch_roi_nums is not None:
+        inputs["BatchRoINums"] = [batch_roi_nums]
     helper.append_op(
         "prroi_pool",
-        inputs={"X": [input], "ROIs": [rois]},
+        inputs=inputs,
         outputs={"Out": [out]},
         attrs={"spatial_scale": spatial_scale,
                "pooled_height": pooled_height,

@@ -147,7 +147,7 @@ def _prroi_pool(ins, attrs):
     if lod_offsets(attrs, "ROIs") is not None:
         batch_ids = batch_ids_for(attrs, "ROIs", nroi)
     elif brn is not None:
-        bounds = jnp.cumsum(brn.astype(jnp.int64))
+        bounds = jnp.cumsum(brn.astype(jnp.int32))
         batch_ids = jnp.searchsorted(bounds, jnp.arange(nroi),
                                      side="right").astype(jnp.int32)
     else:
@@ -340,10 +340,14 @@ def _py_func_grad_maker(block, op, pending, finalize):
         return
     from .control_flow_ops import _bind_partial_grad
 
+    # backward INPUTS drop skipped vars; backward OUTPUTS cover every
+    # forward input — "Backward IG cannot be skipped"
+    # (py_func_op.cc:239-247), the callable returns one grad per
+    # forward input in order (None allowed)
     skip = set(op.attrs.get("backward_skip_vars") or [])
-    grad_for = [n for n in op.input("X") if n not in skip]
+    grad_for = list(op.input("X"))
     gnames = [_bind_partial_grad(block, pending, n) for n in grad_for]
-    bwd_x = (grad_for
+    bwd_x = ([n for n in op.input("X") if n not in skip]
              + [n for n in op.output("Out") if n not in skip] + ogs)
     block.append_op(
         "py_func",
@@ -375,6 +379,11 @@ def _py_func(executor, op, scope):
         outs = (outs,)
     names = op.output("Out")
     grad_for = op.attrs.get("_grad_for")  # set only on backward ops
+    if len(outs) > len(names):
+        raise ValueError(
+            "py_func callable produced %d outputs but the op declares "
+            "%d (py_func_op.cc enforces the output arity)"
+            % (len(outs), len(names)))
     outs = list(outs) + [None] * (len(names) - len(outs))
     for i, (name, val) in enumerate(zip(names, outs)):
         if val is None:
@@ -401,10 +410,14 @@ def _py_func(executor, op, scope):
 
 class LoDRankTable:
     """(index, length) items sorted by length desc, stable
-    (lod_rank_table.h): the execution order for time-major RNN steps."""
+    (lod_rank_table.h): the execution order for time-major RNN steps.
+    ``level`` records which LoD level the lengths came from —
+    lod_tensor_to_array slices sub-sequences at level+1 against it
+    (lod_tensor_to_array_op.cc:107)."""
 
-    def __init__(self, items):
+    def __init__(self, items, level: int = 0):
         self.items = list(items)  # [(original_seq_idx, seq_len), ...]
+        self.level = int(level)
 
     def active_at(self, t: int) -> int:
         return sum(1 for _, ln in self.items if ln > t)
@@ -431,7 +444,7 @@ def _lod_rank_table(executor, op, scope):
     else:
         lengths = _seq_lengths_from_lod(lod, level)
     items = sorted(enumerate(lengths), key=lambda kv: -kv[1])
-    scope.var(op.output("Out")[0]).set(LoDRankTable(items))
+    scope.var(op.output("Out")[0]).set(LoDRankTable(items, level))
 
 
 @register_host_op("max_sequence_len",
@@ -486,13 +499,32 @@ def _lod_tensor_to_array(executor, op, scope):
     table = scope.find_var(op.input("RankTable")[0]).raw()
     x = np.asarray(xvar.array)
     lod = xvar.lod()
-    offsets = (lod[0] if lod
+    level = getattr(table, "level", 0)
+    offsets = (lod[level] if lod
                else list(range(x.shape[0] + 1)))
+    # with a deeper LoD level, each step item is a whole sub-sequence
+    # (lod_tensor_to_array_op.cc:124 copies [start, start+1) at
+    # rank_level+1); with a flat LoD it is one row
+    deeper = lod[level + 1] if lod and len(lod) > level + 1 else None
     arr = LoDTensorArray()
     for t in range(table.max_len()):
-        rows = [offsets[idx] + t for idx, ln in table.items if ln > t]
+        row_idx = []
+        sub_lens = []
+        for idx, ln in table.items:
+            if ln <= t:
+                continue
+            s = offsets[idx] + t
+            r0, r1 = (deeper[s], deeper[s + 1]) if deeper is not None \
+                else (s, s + 1)
+            row_idx.extend(range(r0, r1))
+            sub_lens.append(r1 - r0)
         step = LoDTensor()
-        step.set(jnp.asarray(x[np.asarray(rows, dtype=np.int64)]))
+        step.set(jnp.asarray(x[np.asarray(row_idx, dtype=np.int64)]))
+        if deeper is not None:
+            offs = [0]
+            for ln in sub_lens:
+                offs.append(offs[-1] + ln)
+            step._lod = [offs]
         arr.append(step)
     scope.var(op.output("Out")[0]).set(arr)
 
@@ -509,24 +541,51 @@ def _array_to_lod_tensor(executor, op, scope):
     arr = scope.find_var(op.input("X")[0]).raw()
     table = scope.find_var(op.input("RankTable")[0]).raw()
     steps = [np.asarray(t.array) for t in arr]
+    step_lods = [t.lod() for t in arr]
     n_seq = len(table.items)
     lengths_by_orig = {idx: ln for idx, ln in table.items}
     rank_of = {idx: r for r, (idx, _) in enumerate(table.items)}
-    feature_shape = steps[0].shape[1:] if steps else (0,)
+    if steps:
+        feature_shape = steps[0].shape[1:]
+        dtype = steps[0].dtype
+    else:
+        feature_shape, dtype = (0,), np.float32
+    has_sub = any(sl for sl in step_lods)
     seqs = []
     for orig in range(n_seq):
         ln = lengths_by_orig[orig]
         r = rank_of[orig]
-        rows = [steps[t][r] for t in range(ln)]
-        seqs.append(np.stack(rows) if rows
-                    else np.zeros((0,) + feature_shape, steps[0].dtype))
-    full = np.concatenate(seqs) if seqs else np.zeros((0,) + feature_shape)
+        rows = []
+        for t in range(ln):
+            # rank r is always within step t's active prefix: ranks are
+            # length-sorted, so ln > t implies every rank <= r is live
+            if has_sub and step_lods[t]:
+                offs = step_lods[t][0]
+                rows.append(steps[t][offs[r]:offs[r + 1]])
+            else:
+                rows.append(steps[t][r:r + 1])
+        seqs.append(np.concatenate(rows) if rows
+                    else np.zeros((0,) + feature_shape, dtype))
+    full = (np.concatenate(seqs) if seqs
+            else np.zeros((0,) + feature_shape, dtype))
     out = LoDTensor()
     out.set(jnp.asarray(full))
     offs = [0]
     for orig in range(n_seq):
         offs.append(offs[-1] + lengths_by_orig[orig])
-    out._lod = [offs]
+    if has_sub:
+        # 2-level reconstruction: level-0 counts sub-sequences, level-1
+        # holds each sub-sequence's row offsets in original order
+        sub_offs = [0]
+        for orig in range(n_seq):
+            ln = lengths_by_orig[orig]
+            r = rank_of[orig]
+            for t in range(ln):
+                o = step_lods[t][0]
+                sub_offs.append(sub_offs[-1] + (o[r + 1] - o[r]))
+        out._lod = [offs, sub_offs]
+    else:
+        out._lod = [offs]
     scope.var(op.output("Out")[0]).set(out)
 
 

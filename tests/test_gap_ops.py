@@ -330,6 +330,45 @@ def test_py_func_forward_and_backward():
     np.testing.assert_allclose(g, 1.0 - np.tanh(xv) ** 2, rtol=1e-5)
 
 
+def test_lod_tensor_to_array_two_level():
+    """2-level LoD (sentences of words): each step item is a whole
+    sub-sequence at level+1 (lod_tensor_to_array_op.cc:124), and the
+    inverse rebuilds both levels."""
+    from paddle_tpu.core.tensor import LoDTensor
+
+    # 2 sequences; seq0 has 2 sub-seqs (2,1 rows), seq1 has 1 (3 rows)
+    x = np.arange(12, dtype="float32").reshape(6, 2)
+    t = LoDTensor()
+    t.set(x)
+    t._lod = [[0, 2, 3], [0, 2, 3, 6]]
+
+    main = fluid.Program()
+    b = main.global_block()
+    b.create_var(name="tl_x")
+    b.append_op("lod_rank_table", {"X": ["tl_x"]}, {"Out": ["tl_tab"]},
+                {"level": 0}, infer_shape=False)
+    b.append_op("lod_tensor_to_array",
+                {"X": ["tl_x"], "RankTable": ["tl_tab"]},
+                {"Out": ["tl_arr"]}, {}, infer_shape=False)
+    b.append_op("array_to_lod_tensor",
+                {"X": ["tl_arr"], "RankTable": ["tl_tab"]},
+                {"Out": ["tl_back"]}, {}, infer_shape=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(main, feed={"tl_x": t}, fetch_list=[])
+        arr = scope.find_var("tl_arr").raw()
+        # step 0: seq0's first sub-seq (rows 0,1) + seq1's first (3,4,5)
+        np.testing.assert_array_equal(np.asarray(arr[0].array),
+                                      x[[0, 1, 3, 4, 5]])
+        assert arr[0].lod() == [[0, 2, 5]]
+        # step 1: only seq0 alive -> its 2nd sub-seq (row 2)
+        np.testing.assert_array_equal(np.asarray(arr[1].array), x[[2]])
+        back = scope.find_var("tl_back").raw()
+        np.testing.assert_array_equal(np.asarray(back.array), x)
+        assert back.lod() == [[0, 2, 3], [0, 2, 3, 6]]
+
+
 def test_py_func_skip_vars_in_backward():
     """skip_vars_in_backward_input removes vars from the backward
     callable's argument list (py_func_op.cc contract)."""
@@ -343,7 +382,9 @@ def test_py_func_skip_vars_in_backward():
 
     def bwd(b, out, dout):  # 'a' skipped: only (b, out, dout) arrive
         seen["nargs"] = 3
-        return dout * 2.0 * b  # grad for the one unskipped input, b
+        # grads cover ALL forward inputs in order ("Backward IG cannot
+        # be skipped", py_func_op.cc:245); None -> zero grad
+        return None, dout * 2.0 * b
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -366,11 +407,11 @@ def test_py_func_skip_vars_in_backward():
     with fluid.scope_guard(scope):
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(main, feed={"sk_a": av, "sk_b": bv}, fetch_list=["sk_out"])
-        # 'a' was skipped, so grads bind only to b
-        assert scope.find_var("sk_a@GRAD") is None \
-            or not scope.find_var("sk_a@GRAD").is_initialized()
+        # 'a' keeps a grad slot (zero-filled for the None return)
+        ga = np.asarray(scope.find_var("sk_a@GRAD").raw().array)
         gb = np.asarray(scope.find_var("sk_b@GRAD").raw().array)
     assert seen.get("nargs") == 3
+    np.testing.assert_allclose(ga, np.zeros_like(av))
     np.testing.assert_allclose(gb, 2.0 * bv, rtol=1e-6)  # dout=1
 
 

@@ -1,0 +1,22 @@
+"""op_bench harness smoke test (op_tester.cc parity: config-driven
+single-op timing must produce a number for every case)."""
+from paddle_tpu.tools import op_bench
+
+
+def test_bench_single_op_runs():
+    us = op_bench.bench_op(
+        "matmul",
+        {"X": op_bench._rng().randn(8, 16).astype("float32"),
+         "Y": op_bench._rng().randn(16, 8).astype("float32")},
+        {"transpose_X": False, "transpose_Y": False, "alpha": 1.0},
+        repeat=3, warmup=1)
+    assert us > 0
+
+
+def test_case_table_covers_hot_ops():
+    cases = op_bench._cases()
+    assert len(cases) >= 20
+    ops = {c[1] for c in cases}
+    for required in ("matmul", "conv2d", "batch_norm", "layer_norm",
+                     "softmax", "lookup_table_v2", "adam"):
+        assert required in ops, required
