@@ -142,6 +142,11 @@ def test_post_training_quantization(algo):
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe = fluid.Executor(fluid.CPUPlace())
+        # pin the executor RNG stream: initializer draws come from it,
+        # and KL calibration's 10% tolerance is order-sensitive without
+        # a fixed parameter draw (suite-order flake otherwise)
+        exe._core.rng.seed = 20260730
+        exe._core.rng.step = 0
         exe.run(startup)
         xb = rng.randn(B, D).astype("float32")
         (ref,) = exe.run(prog, feed={"x": xb}, fetch_list=[out])
