@@ -17,7 +17,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Set
 
 from . import framework
-from .core.registry import GRAD_SUFFIX, OpInfoMap
+from .core.registry import GRAD_SUFFIX, OpInfoMap, ensure_grad_op
 from .utils import unique_name
 
 
@@ -72,7 +72,12 @@ def _ensure_grad_var(block, fwd_name: str, grad_name: str):
         shape=fwd.shape if fwd is not None else None,
         dtype=fwd.dtype if fwd is not None else "float32",
         persistable=False,
-        stop_gradient=True,
+        # grad vars are differentiable quantities: a later
+        # append_backward over this program (gradient penalty /
+        # grad-of-grad) must be able to flow gradients through them —
+        # stop_gradient=True here would put every @GRAD var in that
+        # pass's no_grad set and silently sever the double-grad path
+        stop_gradient=False,
     )
     return v
 
@@ -89,9 +94,35 @@ def append_backward(
     block = loss.block
     program = block.program
     program._appending_grad_times += 1
-    with program._backward_role_guard():
-        return _append_backward_impl(loss, block, program, parameter_list,
-                                     no_grad_set, checkpoints)
+    # pass-aware grad naming (reference backward.py _rename_grad_): a
+    # second pass over a program already holding grad vars must not
+    # clobber the first pass's canonical @GRAD names — its canonicals
+    # get an @<pass> suffix when the base name predates this pass
+    prev = _PASS_STATE.copy()
+    _PASS_STATE["times"] = program._appending_grad_times
+    _PASS_STATE["preexisting"] = frozenset(
+        n for b in program.blocks for n in b.vars)
+    try:
+        with program._backward_role_guard():
+            return _append_backward_impl(loss, block, program,
+                                         parameter_list, no_grad_set,
+                                         checkpoints)
+    finally:
+        _PASS_STATE.clear()
+        _PASS_STATE.update(prev)
+
+
+_PASS_STATE: Dict = {}
+
+
+def grad_name_for(n: str) -> str:
+    """Canonical grad-var name for ``n`` in the CURRENT backward pass:
+    the plain ``n@GRAD`` unless an earlier pass already owns it."""
+    base = framework.grad_var_name(n)
+    if _PASS_STATE.get("times", 1) > 1 \
+            and base in _PASS_STATE.get("preexisting", ()):
+        return "%s@%d" % (base, _PASS_STATE["times"])
+    return base
 
 
 def _emit_recompute_ops(block, path, checkpoints) -> Dict[str, str]:
@@ -176,7 +207,7 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
         recompute_rename = _emit_recompute_ops(block, path, checkpoints)
 
     # Seed d(loss)/d(loss) = 1
-    loss_grad_name = framework.grad_var_name(loss.name)
+    loss_grad_name = grad_name_for(loss.name)
     _ensure_grad_var(block, loss.name, loss_grad_name)
     block.append_op(
         "fill_constant",
@@ -200,7 +231,7 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
         glist = pending.get(var_name)
         if not glist:
             return None
-        canonical = framework.grad_var_name(var_name)
+        canonical = grad_name_for(var_name)
         if len(glist) == 1 and glist[0] == canonical:
             return canonical
         _ensure_grad_var(block, var_name, canonical)
@@ -225,7 +256,7 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
         if callable(info.grad) and info.grad != "auto":
             info.grad(block, op, pending, finalize)
             continue
-        if not OpInfoMap.instance().has(grad_type):
+        if not _has_grad_op(op.type):
             # info.grad is None or "auto" with no grad op: grads don't flow
             continue
         ginfo = OpInfoMap.instance().get(grad_type)
@@ -282,9 +313,10 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
             for n in names:
                 if n in diffable and n not in no_grad:
                     if n in pending and pending[n]:
-                        gname = "%s%s@RENAME@%d" % (n, GRAD_SUFFIX, len(pending[n]))
+                        gname = "%s@RENAME@%d" % (grad_name_for(n),
+                                                  len(pending[n]))
                     else:
-                        gname = framework.grad_var_name(n)
+                        gname = grad_name_for(n)
                     _ensure_grad_var(block, n, gname)
                     pending.setdefault(n, []).append(gname)
                     grad_to_var[gname] = n
@@ -348,7 +380,12 @@ def _op_info(op_type):
 
 
 def _has_grad_op(op_type):
-    return OpInfoMap.instance().has(op_type + "_grad")
+    if OpInfoMap.instance().has(op_type + "_grad"):
+        return True
+    # grad programs are differentiable too: auto-VJP grad ops get their
+    # own grad op registered on demand (static double-grad — reference
+    # conv2d_grad_grad / elementwise_*_grad_grad)
+    return ensure_grad_op(op_type)
 
 
 def _dtype_enum(dtype):

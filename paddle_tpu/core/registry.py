@@ -231,14 +231,24 @@ def register_host_op(type, inputs, outputs, attrs=None, infer_shape=None,
 # ---------------------------------------------------------------------------
 
 
+# op types whose fn is a pure auto-VJP (differentiable again — the
+# substrate for grad-of-grad registration on demand)
+_AUTO_VJP_TYPES: set = set()
+
+
 def _maybe_register_auto_grad(info: OpInfo):
     if info.grad != "auto":
         return
+    _register_auto_grad_for(info)
+
+
+def _register_auto_grad_for(info: OpInfo):
     grad_type = info.type + "_grad"
     if OpInfoMap.instance()._map.get(grad_type) is not None:
         return
 
-    grad_inputs = [Slot(s.name, duplicable=s.duplicable, dispensable=True)
+    grad_inputs = [Slot(s.name, duplicable=s.duplicable, dispensable=True,
+                        no_grad=s.no_grad)
                    for s in info.inputs]
     # Forward outputs are made available too (some custom infer_lod/shape
     # uses them); the VJP itself recomputes them.
@@ -266,6 +276,23 @@ def _maybe_register_auto_grad(info: OpInfo):
         needs_lod=info.needs_lod,
     )
     OpInfoMap.instance().insert(ginfo)
+    _AUTO_VJP_TYPES.add(grad_type)
+
+
+def ensure_grad_op(op_type: str) -> bool:
+    """Register ``<op_type>_grad`` on demand when op_type is itself an
+    auto-VJP grad op — the static double-grad path (reference:
+    conv2d_grad_grad / elementwise_*_grad_grad registrations at the
+    bottom of their op .cc files). Auto-VJP grad fns are pure jax
+    functions, so their VJP is one more _register_auto_grad_for away;
+    registration is lazy to keep the import-time registry finite."""
+    m = OpInfoMap.instance()
+    if m.has(op_type + "_grad"):
+        return True
+    if op_type not in _AUTO_VJP_TYPES:
+        return False
+    _register_auto_grad_for(m.get(op_type))
+    return True
 
 
 def _is_float_arr(x):

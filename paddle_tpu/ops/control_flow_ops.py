@@ -191,13 +191,13 @@ def _select_output(executor, op, scope):
 def _bind_partial_grad(block, pending, var_name):
     """Allocate a partial-grad name for var_name with the backward
     pending/finalize discipline (mirrors backward.py's generic path)."""
-    from .. import framework
-    from ..backward import _ensure_grad_var
+    from ..backward import _ensure_grad_var, grad_name_for
 
     if var_name in pending and pending[var_name]:
-        gname = "%s@GRAD@RENAME@%d" % (var_name, len(pending[var_name]))
+        gname = "%s@RENAME@%d" % (grad_name_for(var_name),
+                                  len(pending[var_name]))
     else:
-        gname = framework.grad_var_name(var_name)
+        gname = grad_name_for(var_name)
     _ensure_grad_var(block, var_name, gname)
     pending.setdefault(var_name, []).append(gname)
     return gname

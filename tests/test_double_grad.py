@@ -1,0 +1,169 @@
+"""Static double-grad (grad-of-grad): append_backward over a program
+that already contains grad ops — the gradient-penalty pattern
+(reference registers conv2d_grad_grad, elementwise_*_grad_grad at the
+bottom of the op .cc files; here auto-VJP grad ops differentiate again
+via on-demand registration)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.backward import append_backward, gradients
+
+
+def _fd_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy()
+        xp[i] += eps
+        xm = x.copy()
+        xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_gradient_penalty_matches_finite_differences():
+    """loss = sum(xW)^2 + sum((d sum(xW)^2 / dx)^2): the second term
+    differentiates THROUGH mul_grad/square_grad ops."""
+    rng = np.random.RandomState(0)
+    xv = rng.randn(3, 4).astype("float32")
+    wv = rng.randn(4, 2).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="dg_x", shape=[3, 4], dtype="float32")
+        x.stop_gradient = False
+        w = fluid.layers.create_parameter([4, 2], "float32", name="dg_w")
+        y = fluid.layers.mul(x, w)
+        sq = fluid.layers.square(y)
+        obj = fluid.layers.reduce_sum(sq)
+        (gx,) = gradients(obj, [x])
+        penalty = fluid.layers.reduce_sum(fluid.layers.square(gx))
+        total = fluid.layers.elementwise_add(obj, penalty)
+    with fluid.program_guard(main, startup):
+        pg = append_backward(total, parameter_list=["dg_w"])
+    (gw_name,) = [g.name for _, g in pg]
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        import jax.numpy as jnp
+
+        scope.var("dg_w").get_tensor().set(jnp.asarray(wv))
+        tv, gw = exe.run(main, feed={"dg_x": xv},
+                         fetch_list=[total, gw_name])
+        gw = np.asarray(gw)
+
+    def objective(w_):
+        y = xv @ w_
+        obj = (y ** 2).sum()
+        gx = 2.0 * y @ w_.T          # d obj / dx
+        return obj + (gx ** 2).sum()
+
+    assert abs(float(np.asarray(tv).ravel()[0]) - objective(wv)) < 1e-2
+    fd = _fd_grad(lambda w_: objective(w_.astype("float64")),
+                  wv.astype("float64"))
+    np.testing.assert_allclose(gw, fd, rtol=2e-2, atol=2e-3)
+
+
+def test_conv2d_double_grad():
+    """Gradient penalty through conv2d_grad (conv2d_grad_grad parity)."""
+    rng = np.random.RandomState(1)
+    xv = rng.randn(1, 2, 5, 5).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="cg_x", shape=[1, 2, 5, 5], dtype="float32")
+        x.stop_gradient = False
+        y = fluid.layers.conv2d(x, num_filters=3, filter_size=3,
+                                padding=1,
+                                param_attr=fluid.ParamAttr(name="cg_w"),
+                                bias_attr=False)
+        obj = fluid.layers.reduce_sum(fluid.layers.square(y))
+        (gx,) = gradients(obj, [x])
+        penalty = fluid.layers.reduce_mean(fluid.layers.square(gx))
+        total = fluid.layers.elementwise_add(obj, penalty)
+    with fluid.program_guard(main, startup):
+        pg = append_backward(total, parameter_list=["cg_w"])
+    (gw_name,) = [g.name for _, g in pg]
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        wv = np.asarray(scope.find_var("cg_w").raw().array).copy()
+        tv, gw = exe.run(main, feed={"cg_x": xv},
+                         fetch_list=[total, gw_name])
+        gw = np.asarray(gw)
+
+    # independent oracle: jax value_and_grad of the same double-grad
+    # objective
+    import jax
+    import jax.numpy as jnp
+
+    def objective(w_):
+        def obj_fn(x_):
+            y = jax.lax.conv_general_dilated(
+                x_, w_, (1, 1), ((1, 1), (1, 1)),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            return (y ** 2).sum()
+
+        o, gx = jax.value_and_grad(obj_fn)(jnp.asarray(xv))
+        return o + (gx ** 2).mean()
+
+    ref_t = float(objective(jnp.asarray(wv)))
+    ref_gw = np.asarray(jax.grad(objective)(jnp.asarray(wv)))
+    assert abs(float(np.asarray(tv).ravel()[0]) - ref_t) / abs(ref_t) < 1e-4
+    np.testing.assert_allclose(gw, ref_gw, rtol=1e-3, atol=1e-4)
+
+
+def test_first_order_grad_survives_second_pass():
+    """The second append_backward must NOT clobber the var gradients()
+    returned — its canonicals get an @<pass> suffix (reference
+    _rename_grad_)."""
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 3).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="sp_x", shape=[2, 3], dtype="float32")
+        x.stop_gradient = False
+        w = fluid.layers.create_parameter([3, 2], "float32", name="sp_w")
+        obj = fluid.layers.reduce_sum(
+            fluid.layers.square(fluid.layers.mul(x, w)))
+        (gx,) = gradients(obj, [x])
+        penalty = fluid.layers.reduce_sum(fluid.layers.square(gx))
+        total = fluid.layers.elementwise_add(obj, penalty)
+    with fluid.program_guard(main, startup):
+        append_backward(total, parameter_list=["sp_w"])
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        wv = np.asarray(scope.find_var("sp_w").raw().array)
+        (gx_val,) = exe.run(main, feed={"sp_x": xv},
+                            fetch_list=[gx.name])
+    ref = 2.0 * (xv @ wv) @ wv.T
+    np.testing.assert_allclose(np.asarray(gx_val), ref, rtol=1e-5,
+                               atol=1e-6,
+                               err_msg="first-order grad was clobbered "
+                                       "by the second backward pass")
+
+
+def test_dygraph_second_order_still_works():
+    """The dygraph double-grad path must be unaffected."""
+    from paddle_tpu.dygraph import to_variable
+
+    with fluid.dygraph.guard():
+        x = to_variable(np.array([1.0, 2.0], dtype="float32"))
+        x.stop_gradient = False
+        y = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(
+                fluid.layers.elementwise_mul(x, x), x))
+        (gx,) = fluid.dygraph.grad(y, x, create_graph=True)
+        (ggx,) = fluid.dygraph.grad(fluid.layers.reduce_sum(gx), x)
+    np.testing.assert_allclose(np.asarray(ggx.numpy()), [6.0, 12.0],
+                               rtol=1e-5)
