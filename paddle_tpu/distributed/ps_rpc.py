@@ -1,0 +1,367 @@
+"""Minimal socket RPC for the parameter-server runtime.
+
+The reference's PS dataplane is gRPC/BRPC (operators/distributed/grpc/
+grpc_client.cc, grpc_server.cc) with a sync round protocol
+(listen_and_serv_op.cc:110 RunSyncLoop: wait for every trainer's grads,
+run the optimize blocks, serve param reads until all trainers fetched)
+and liveness tracking (heart_beat_monitor.h:54). This module provides
+the same contract over plain TCP sockets — enough transport for real
+multi-process PS training and its tests, without a gRPC dependency.
+
+Wire format (no pickle — frames from the network must not be able to
+execute code): 8-byte LE json-header length, json header, 8-byte LE raw
+length, raw array bytes. The header carries only json-safe scalars;
+arrays travel as dtype/shape in the header plus the raw section.
+
+Round protocol (sync mode): send_grad buffers; the fanin-th
+send_barrier sums each grad, runs its optimize block, and opens the
+params; get_param waits for the open round; the fanin-th fetch_barrier
+closes it. A send_barrier for round N+1 blocks until round N is fully
+fetched — without that gate, a fast trainer's next round would flip
+the round incomplete while a slow trainer is still mid-fetch and both
+would deadlock.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_ROUND_TIMEOUT = float(os.environ.get("PADDLE_PS_ROUND_TIMEOUT", "120"))
+
+
+def _send_msg(sock: socket.socket, msg: dict,
+              raw: bytes = b"") -> None:
+    header = json.dumps(msg).encode("utf-8")
+    sock.sendall(struct.pack("<Q", len(header)) + header
+                 + struct.pack("<Q", len(raw)) + raw)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+    """Returns (msg_dict, raw_bytes) or None on EOF."""
+    h = _recv_exact(sock, 8)
+    if h is None:
+        return None
+    (hlen,) = struct.unpack("<Q", h)
+    header = _recv_exact(sock, hlen)
+    if header is None:
+        return None
+    r = _recv_exact(sock, 8)
+    if r is None:
+        return None
+    (rlen,) = struct.unpack("<Q", r)
+    raw = _recv_exact(sock, rlen) if rlen else b""
+    if raw is None:
+        return None
+    return json.loads(header.decode("utf-8")), raw
+
+
+def _array_header(arr: np.ndarray) -> dict:
+    return {"dtype": str(arr.dtype), "shape": list(arr.shape)}
+
+
+def _array_from(header: dict, raw: bytes) -> np.ndarray:
+    return np.frombuffer(raw, dtype=header["dtype"]).reshape(
+        header["shape"]).copy()
+
+
+class HeartBeatMonitor:
+    """Per-trainer last-ping tracking (heart_beat_monitor.h:54)."""
+
+    def __init__(self, stale_seconds: float = 60.0):
+        self._last: Dict[int, float] = {}
+        self._stale = stale_seconds
+        self._lock = threading.Lock()
+
+    def ping(self, trainer_id: int) -> None:
+        with self._lock:
+            self._last[int(trainer_id)] = time.time()
+
+    def status(self) -> Dict[int, float]:
+        """trainer_id -> seconds since last ping."""
+        now = time.time()
+        with self._lock:
+            return {t: now - ts for t, ts in self._last.items()}
+
+    def stale_trainers(self) -> List[int]:
+        return [t for t, age in self.status().items()
+                if age > self._stale]
+
+
+class PSServer:
+    """Sync-mode PS endpoint implementing the RunSyncLoop round
+    protocol; async mode applies each grad immediately
+    (RunAsyncLoop)."""
+
+    def __init__(self, endpoint: str, executor, scope, grad_to_block,
+                 fanin: int = 1, sync_mode: bool = True):
+        host, port = endpoint.rsplit(":", 1)
+        self._executor = executor
+        self._scope = scope
+        self._grad_to_block = grad_to_block
+        self._fanin = max(int(fanin), 1)
+        self._sync = bool(sync_mode)
+        self.monitor = HeartBeatMonitor()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: Dict[str, List[np.ndarray]] = {}
+        self._send_barriers = 0
+        self._fetch_barriers = 0
+        self._round_complete = True   # params servable before round 1
+        self._fetches_pending = False  # True between apply and last fetch
+        self._shutdown = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host or "127.0.0.1", int(port)))
+        self._sock.listen(16)
+        self._threads: List[threading.Thread] = []
+
+    # -- round protocol ---------------------------------------------------
+
+    def _apply_round(self):
+        """All trainers' grads in (locked by caller): sum per var, run
+        its optimize block, open params for reading."""
+        for name, grads in self._pending.items():
+            total = grads[0]
+            for g in grads[1:]:
+                total = total + g
+            self._executor._write_var(self._scope, name, total)
+            sub = self._grad_to_block.get(name)
+            if sub is not None:
+                self._executor.run_block(sub, self._scope)
+        self._pending.clear()
+        self._send_barriers = 0
+        self._round_complete = True
+        self._fetches_pending = True
+        self._cond.notify_all()
+
+    def _wait_for(self, predicate, what: str):
+        """Bounded condition wait (locked by caller); surfaces stale
+        trainers instead of hanging forever when a rank died."""
+        deadline = time.time() + _ROUND_TIMEOUT
+        while not predicate():
+            if self._shutdown.is_set():
+                raise RuntimeError("pserver shut down mid-round")
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "PS round stalled waiting for %s (fanin=%d); stale "
+                    "trainers by heartbeat: %s"
+                    % (what, self._fanin, self.monitor.stale_trainers()))
+            self._cond.wait(timeout=1.0)
+
+    def _handle(self, msg: dict, raw: bytes):
+        """Returns (response_dict, response_raw)."""
+        kind = msg["kind"]
+        if "trainer_id" in msg:
+            self.monitor.ping(msg["trainer_id"])
+        if kind == "send_grad":
+            arr = _array_from(msg["array"], raw)
+            with self._lock:
+                if self._sync:
+                    self._pending.setdefault(msg["name"], []).append(arr)
+                else:  # async: apply immediately (RunAsyncLoop)
+                    self._executor._write_var(self._scope, msg["name"],
+                                              arr)
+                    sub = self._grad_to_block.get(msg["name"])
+                    if sub is not None:
+                        self._executor.run_block(sub, self._scope)
+            return {"ok": True}, b""
+        if kind == "send_barrier":
+            with self._lock:
+                # gate round N+1 on round N being fully fetched
+                self._wait_for(lambda: not self._fetches_pending,
+                               "previous round's fetch barriers")
+                self._send_barriers += 1
+                self._round_complete = False
+                if self._send_barriers >= self._fanin:
+                    self._apply_round()
+                else:
+                    self._wait_for(lambda: self._round_complete,
+                                   "all trainers' send barriers")
+            return {"ok": True}, b""
+        if kind == "get_param":
+            with self._lock:
+                if self._sync:
+                    self._wait_for(lambda: self._round_complete,
+                                   "the optimize round")
+                val = self._executor._read_var(self._scope, msg["name"])
+            if val is None:
+                return {"ok": False,
+                        "error": "no var %r" % msg["name"]}, b""
+            arr = np.ascontiguousarray(np.asarray(val))
+            return {"ok": True, "array": _array_header(arr)}, \
+                arr.tobytes()
+        if kind == "fetch_barrier":
+            with self._lock:
+                self._fetch_barriers += 1
+                if self._fetch_barriers >= self._fanin:
+                    self._fetch_barriers = 0
+                    self._fetches_pending = False
+                    self._cond.notify_all()
+            return {"ok": True}, b""
+        if kind == "heartbeat":
+            return {"ok": True,
+                    "status": {str(k): v
+                               for k, v in
+                               self.monitor.status().items()}}, b""
+        if kind == "shutdown":
+            self._shutdown.set()
+            with self._lock:
+                self._cond.notify_all()
+            return {"ok": True}, b""
+        return {"ok": False, "error": "unknown kind %r" % kind}, b""
+
+    # -- socket plumbing --------------------------------------------------
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while not self._shutdown.is_set():
+                got = _recv_msg(conn)
+                if got is None:
+                    return
+                try:
+                    resp, raw = self._handle(*got)
+                except RuntimeError as e:
+                    resp, raw = {"ok": False, "error": str(e)}, b""
+                _send_msg(conn, resp, raw)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def serve_forever(self) -> None:
+        """Accept loop; returns after a shutdown message (the reference
+        blocks inside the listen_and_serv op the same way)."""
+        self._sock.settimeout(0.2)
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._sock.close()
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+
+class PSClient:
+    """One persistent connection per (endpoint, trainer) —
+    grpc_client.cc keeps channels the same way. A dead cached socket
+    reconnects once before failing (server restarts reuse endpoints)."""
+
+    _clients: Dict[tuple, "PSClient"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, endpoint: str, trainer_id: int = 0,
+                 timeout: Optional[float] = None):
+        self._endpoint = endpoint
+        self._trainer_id = trainer_id
+        self._timeout = timeout if timeout is not None else float(
+            os.environ.get("PADDLE_PS_CONNECT_TIMEOUT", "15"))
+        self._io_lock = threading.Lock()
+        self._sock = self._connect()
+
+    def _connect(self) -> socket.socket:
+        host, port = self._endpoint.rsplit(":", 1)
+        deadline = time.time() + self._timeout
+        last: Optional[OSError] = None
+        while True:  # the pserver process may still be booting
+            try:
+                return socket.create_connection(
+                    (host or "127.0.0.1", int(port)),
+                    timeout=max(self._timeout, 1.0))
+            except OSError as e:
+                last = e
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        "cannot reach pserver %s within %.0fs (%r) — is "
+                        "the pserver program (listen_and_serv) running, "
+                        "with PADDLE_PSERVER_RPC=1 for cross-process "
+                        "mode?" % (self._endpoint, self._timeout, last))
+                time.sleep(0.2)
+
+    @classmethod
+    def for_endpoint(cls, endpoint: str, trainer_id: int = 0):
+        with cls._lock:
+            key = (endpoint, trainer_id)
+            c = cls._clients.get(key)
+            if c is None:
+                c = cls(endpoint, trainer_id)
+                cls._clients[key] = c
+            return c
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            for c in cls._clients.values():
+                try:
+                    c._sock.close()
+                except OSError:
+                    pass
+            cls._clients.clear()
+
+    def _call(self, msg: dict, raw: bytes = b""):
+        msg.setdefault("trainer_id", self._trainer_id)
+        with self._io_lock:
+            try:
+                _send_msg(self._sock, msg, raw)
+                got = _recv_msg(self._sock)
+            except OSError:
+                got = None
+            if got is None:
+                # stale cached socket (server restarted): one reconnect
+                self._sock.close()
+                self._sock = self._connect()
+                _send_msg(self._sock, msg, raw)
+                got = _recv_msg(self._sock)
+        if got is None:
+            raise RuntimeError("pserver %s closed the connection"
+                               % self._endpoint)
+        resp, resp_raw = got
+        if not resp.get("ok"):
+            raise RuntimeError("pserver error: %s" % resp.get("error"))
+        return resp, resp_raw
+
+    def send_grad(self, name: str, value) -> None:
+        arr = np.ascontiguousarray(np.asarray(value))
+        self._call({"kind": "send_grad", "name": name,
+                    "array": _array_header(arr)}, arr.tobytes())
+
+    def send_barrier(self) -> None:
+        self._call({"kind": "send_barrier"})
+
+    def get_param(self, name: str) -> np.ndarray:
+        resp, raw = self._call({"kind": "get_param", "name": name})
+        return _array_from(resp["array"], raw)
+
+    def fetch_barrier(self) -> None:
+        self._call({"kind": "fetch_barrier"})
+
+    def heartbeat(self) -> Dict[int, float]:
+        resp, _ = self._call({"kind": "heartbeat"})
+        return {int(k): v for k, v in resp["status"].items()}
+
+    def shutdown_server(self) -> None:
+        self._call({"kind": "shutdown"})

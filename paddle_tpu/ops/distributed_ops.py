@@ -25,6 +25,11 @@ _EMULATED_SERVERS: Dict[str, dict] = {}
 
 def reset_emulated_servers():
     _EMULATED_SERVERS.clear()
+    # drop cached RPC client sockets too: a fresh server on a reused
+    # endpoint must not inherit a dead connection
+    from ..distributed.ps_rpc import PSClient
+
+    PSClient.reset()
 
 
 @register_host_op(
@@ -35,8 +40,15 @@ def reset_emulated_servers():
            "sync_mode": True, "Fanin": 1},
 )
 def _listen_and_serv(executor, op, scope):
-    """Register this endpoint's server state (emulation: non-blocking —
-    the reference event-loops; here sends drive the optimize blocks)."""
+    """Register this endpoint's server.
+
+    Two transports: the in-process emulation (default — non-blocking,
+    sends drive the optimize blocks synchronously), and a real TCP RPC
+    server when PADDLE_PSERVER_RPC=1 (distributed/ps_rpc.py), which
+    BLOCKS serving the RunSyncLoop round protocol until a shutdown
+    message arrives — the reference listen_and_serv_op.cc behavior."""
+    import os
+
     grad_to_block = {}
     blocks = op.attrs.get("optimize_blocks", [])
     for entry in op.attrs.get("grad_to_block_id", []):
@@ -44,11 +56,29 @@ def _listen_and_serv(executor, op, scope):
         for b in blocks:
             if b.idx == int(bid):
                 grad_to_block[gname] = b
+    if os.environ.get("PADDLE_PSERVER_RPC") == "1":
+        from ..distributed.ps_rpc import PSServer
+
+        server = PSServer(op.attrs["endpoint"], executor, scope,
+                          grad_to_block,
+                          fanin=int(op.attrs.get("Fanin", 1)),
+                          sync_mode=bool(op.attrs.get("sync_mode", True)))
+        server.serve_forever()
+        return
     _EMULATED_SERVERS[op.attrs["endpoint"]] = {
         "executor": executor,
         "scope": scope,
         "grad_to_block": grad_to_block,
     }
+
+
+def _rpc_client(ep):
+    import os
+
+    from ..distributed.ps_rpc import PSClient
+
+    return PSClient.for_endpoint(
+        ep, trainer_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
 
 
 @register_host_op(
@@ -61,17 +91,22 @@ def _send(executor, op, scope):
     eps = op.attrs.get("epmap", [])
     for name, ep in zip(op.input("X"), eps or [""] * len(op.input("X"))):
         server = _EMULATED_SERVERS.get(ep)
-        if server is None:
+        val = executor._read_var(scope, name)
+        if server is not None:
+            server["executor"]._write_var(server["scope"], name,
+                                          np.asarray(val))
+            sub = server["grad_to_block"].get(name)
+            if sub is not None:
+                server["executor"].run_block(sub, server["scope"])
+        elif ep:
+            # cross-process endpoint: real socket RPC (grpc_client.cc
+            # counterpart); the server applies the round protocol
+            _rpc_client(ep).send_grad(name, np.asarray(val))
+        else:
             raise RuntimeError(
                 "send: no server at %r — run the pserver program "
-                "(listen_and_serv) in this process first, or use the "
-                "collective fleet for multi-host" % ep)
-        val = executor._read_var(scope, name)
-        server["executor"]._write_var(server["scope"], name,
-                                      np.asarray(val))
-        sub = server["grad_to_block"].get(name)
-        if sub is not None:
-            server["executor"].run_block(sub, server["scope"])
+                "(listen_and_serv) first, or use the collective fleet "
+                "for multi-host" % ep)
 
 
 @register_host_op(
@@ -84,12 +119,16 @@ def _recv(executor, op, scope):
     eps = op.attrs.get("epmap", [])
     for name, ep in zip(op.output("Out"), eps or [""] * len(op.output("Out"))):
         server = _EMULATED_SERVERS.get(ep)
-        if server is None:
+        if server is not None:
+            val = server["executor"]._read_var(server["scope"], name)
+            if val is None:
+                raise RuntimeError("recv: server %r has no var %r"
+                                   % (ep, name))
+            executor._write_var(scope, name, np.asarray(val))
+        elif ep:
+            executor._write_var(scope, name, _rpc_client(ep).get_param(name))
+        else:
             raise RuntimeError("recv: no server at %r" % ep)
-        val = server["executor"]._read_var(server["scope"], name)
-        if val is None:
-            raise RuntimeError("recv: server %r has no var %r" % (ep, name))
-        executor._write_var(scope, name, np.asarray(val))
 
 
 @register_host_op(
@@ -99,7 +138,11 @@ def _recv(executor, op, scope):
     attrs={"endpoints": [], "trainer_id": 0},
 )
 def _send_barrier(executor, op, scope):
-    pass  # in-process emulation: sends already applied synchronously
+    # in-process emulation applies sends synchronously; RPC endpoints
+    # need the real barrier to close the sync round (RunSyncLoop)
+    for ep in op.attrs.get("endpoints", []):
+        if ep and ep not in _EMULATED_SERVERS:
+            _rpc_client(ep).send_barrier()
 
 
 @register_host_op(
@@ -109,7 +152,9 @@ def _send_barrier(executor, op, scope):
     attrs={"endpoints": [], "trainer_id": 0},
 )
 def _fetch_barrier(executor, op, scope):
-    pass
+    for ep in op.attrs.get("endpoints", []):
+        if ep and ep not in _EMULATED_SERVERS:
+            _rpc_client(ep).fetch_barrier()
 
 
 import weakref

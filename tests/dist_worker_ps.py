@@ -1,0 +1,86 @@
+"""Worker for the two-process parameter-server test.
+
+Role from PADDLE_TRAINING_ROLE (the reference's env contract):
+PSERVER blocks in listen_and_serv over the real socket RPC
+(PADDLE_PSERVER_RPC=1); TRAINER runs the transpiled trainer program,
+training through send/recv against the live server, then asks the
+server for the final param and writes a JSON result.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+STEPS = 5
+BS = 16
+
+
+def _net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[BS, 8], dtype="float32")
+        y = fluid.data(name="y", shape=[BS, 1], dtype="float32")
+        pred = fluid.layers.fc(
+            x, 1,
+            param_attr=fluid.ParamAttr(
+                name="w",
+                initializer=fluid.initializer.ConstantInitializer(0.3)),
+            bias_attr=fluid.ParamAttr(
+                name="b",
+                initializer=fluid.initializer.ConstantInitializer(0.0)))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+    endpoint = os.environ["PSERVER_ENDPOINT"]
+    out_path = sys.argv[1]
+
+    main_prog, startup, loss = _net()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main_prog, startup_program=startup,
+                pservers=endpoint, trainers=1, sync_mode=True)
+
+    if role == "PSERVER":
+        os.environ["PADDLE_PSERVER_RPC"] = "1"
+        ps_prog = t.get_pserver_program(endpoint)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe._core.rng.seed = 77
+        exe._core.rng.step = 0
+        exe.run(t.get_startup_program(endpoint, ps_prog))
+        exe.run(ps_prog)  # blocks serving until shutdown
+        return
+
+    # trainer
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe._core.rng.seed = 77
+    exe._core.rng.step = 0
+    exe.run(startup)
+    rng = np.random.RandomState(5)
+    W = rng.randn(8, 1).astype("float32")
+    losses = []
+    for _ in range(STEPS):
+        xb = rng.randn(BS, 8).astype("float32")
+        (l,) = exe.run(main_prog, feed={"x": xb, "y": xb @ W},
+                       fetch_list=[loss])
+        losses.append(float(np.asarray(l).ravel()[0]))
+
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    client = PSClient.for_endpoint(endpoint)
+    w_final = client.get_param("w")
+    hb = client.heartbeat()
+    client.shutdown_server()
+    with open(out_path, "w") as f:
+        f.write(json.dumps({"losses": losses,
+                            "w_sum": float(np.abs(w_final).sum()),
+                            "heartbeat_trainers": sorted(hb)}))
+
+
+if __name__ == "__main__":
+    main()
