@@ -108,7 +108,7 @@ def _time_steps(exe, main, feed, loss, warmup=3, iters=20):
     return dt, final_loss
 
 
-def bench_resnet50(batch=64, iters=16, use_bf16=False):
+def bench_resnet50(batch=128, iters=12, use_bf16=False):
     import paddle_tpu as fluid
 
     main, startup, loss, use_bf16 = _build_resnet50(batch,
