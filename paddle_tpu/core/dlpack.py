@@ -1,0 +1,40 @@
+"""DLPack tensor interop.
+
+Reference counterpart: paddle/fluid/framework/dlpack_tensor.cc — zero-
+copy exchange with other frameworks through the DLPack capsule
+protocol. Here LoDTensor's device array goes through jax's dlpack
+bridge, so ``to_dlpack(t)`` hands a capsule torch/cupy/numpy consumers
+accept, and ``from_dlpack(capsule_or_tensor)`` ingests external tensors
+without a host copy where the backend allows it.
+"""
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def _as_array(t):
+    from .tensor import LoDTensor
+
+    if isinstance(t, LoDTensor):
+        return t.array
+    return t
+
+
+def to_dlpack(tensor):
+    """LoDTensor / jax array -> DLPack capsule (the legacy exchange
+    object dlpack_tensor.cc produces; jax arrays implement the modern
+    ``__dlpack__`` protocol, so the capsule comes straight from it)."""
+    return _as_array(tensor).__dlpack__()
+
+
+def from_dlpack(ext) -> "LoDTensor":
+    """DLPack capsule (or any __dlpack__ provider, e.g. a torch
+    tensor) -> LoDTensor."""
+    import jax.dlpack
+
+    from .tensor import LoDTensor
+
+    arr = jax.dlpack.from_dlpack(ext)
+    out = LoDTensor()
+    out._array = arr
+    return out

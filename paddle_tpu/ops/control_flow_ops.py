@@ -104,10 +104,31 @@ def _conditional_block(executor, op, scope):
 def _write_to_array(executor, op, scope):
     i = int(np.asarray(executor._read_var(scope, op.input("I")[0])).reshape(()))
     x_var = scope.find_var(op.input("X")[0])
-    arr = scope.var(op.output("Out")[0]).get_lod_tensor_array()
+    # resolve the array RECURSIVELY first: inside a while body the
+    # array lives in the parent scope (created by create_array's
+    # create_lod_tensor_array op) and must accumulate across
+    # iterations — a scope-local array would vanish with the body
+    # scope each trip
+    out_name = op.output("Out")[0]
+    var = scope.find_var(out_name)
+    if var is None:
+        var = scope.var(out_name)
+    arr = var.get_lod_tensor_array()
     while len(arr) <= i:
         arr.append(None)
     arr[i] = x_var.raw()
+
+
+@register_host_op(
+    "create_lod_tensor_array",
+    inputs=[],
+    outputs=[Out("Out")],
+)
+def _create_lod_tensor_array(executor, op, scope):
+    """Materialize an empty LoDTensorArray in THIS scope, so while
+    bodies appending to it mutate one persistent object (the reference
+    creates the array variable in the parent scope the same way)."""
+    scope.var(op.output("Out")[0]).get_lod_tensor_array()
 
 
 @register_host_op(

@@ -604,6 +604,52 @@ def _shrink_rnn_memory(executor, op, scope):
     executor._write_var(scope, op.output("Out")[0], x[:k])
 
 
+@register_host_op("reorder_lod_tensor_by_rank",
+                  inputs=[In("X"), In("RankTable", no_grad=True)],
+                  outputs=[Out("Out")])
+def _reorder_lod_tensor_by_rank(executor, op, scope):
+    """Reorder X's sequences into rank-table order (reorder_lod_tensor_
+    by_rank_op.cc) — DynamicRNN's static_input / memory(init=) uses it
+    so row r always belongs to the rank-r sequence."""
+    from ..core.tensor import LoDTensor
+
+    xvar = scope.find_var(op.input("X")[0]).raw()
+    table = scope.find_var(op.input("RankTable")[0]).raw()
+    x = np.asarray(xvar.array)
+    lod = xvar.lod()
+    offsets = lod[0] if lod else list(range(x.shape[0] + 1))
+    rows = []
+    new_offs = [0]
+    for idx, _ in table.items:
+        seg = range(offsets[idx], offsets[idx + 1])
+        rows.extend(seg)
+        new_offs.append(new_offs[-1] + len(seg))
+    out = LoDTensor()
+    out.set(jnp.asarray(x[np.asarray(rows, dtype=np.int64)]))
+    if lod:
+        out._lod = [new_offs]
+    scope.var(op.output("Out")[0]).set(out)
+
+
+@register_host_op("rank_table_boot_memory",
+                  inputs=[In("RankTable", no_grad=True)],
+                  outputs=[Out("Out")],
+                  attrs={"shape": [], "value": 0.0, "dtype": 5})
+def _rank_table_boot_memory(executor, op, scope):
+    """Initial RNN memory: [n_sequences, *shape] filled with value —
+    the boot the reference DynamicRNN.memory() builds from the rank
+    table's batch size."""
+    from ..core import dtypes as _dt
+
+    table = scope.find_var(op.input("RankTable")[0]).raw()
+    shape = [len(table.items)] + [int(s) for s in
+                                  op.attrs.get("shape", [])]
+    executor._write_var(
+        scope, op.output("Out")[0],
+        np.full(shape, float(op.attrs.get("value", 0.0)),
+                _dt.to_numpy_dtype(op.attrs.get("dtype", 5))))
+
+
 @register_host_op("shrink_rnn_memory_grad",
                   inputs=[In("X", no_grad=True),
                           In("Out@GRAD", no_grad=True)],

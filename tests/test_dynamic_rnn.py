@@ -1,0 +1,120 @@
+"""DynamicRNN over LoD sequences (reference layers/control_flow.py
+DynamicRNN on lod_tensor_to_array + shrink_rnn_memory + while)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.tensor import LoDTensor
+
+
+def _lod_input(rng, lengths, dim):
+    total = sum(lengths)
+    x = rng.randn(total, dim).astype("float32")
+    t = LoDTensor()
+    t.set(x)
+    t.set_recursive_sequence_lengths([list(lengths)])
+    return x, t
+
+
+def test_dynamic_rnn_matches_padded_oracle():
+    """tanh-RNN over ragged sequences == the numpy per-sequence RNN."""
+    D, H = 5, 7
+    lengths = [4, 1, 3]
+    rng = np.random.RandomState(3)
+    x_np, x_t = _lod_input(rng, lengths, D)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="seq", shape=[-1, D], dtype="float32",
+                       lod_level=1)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(x)
+            prev = drnn.memory(shape=[H], value=0.0)
+            hidden = fluid.layers.fc(
+                [word, prev], size=H, act="tanh",
+                param_attr=[fluid.ParamAttr(name="rwx"),
+                            fluid.ParamAttr(name="rwh")],
+                bias_attr=fluid.ParamAttr(name="rb"))
+            drnn.update_memory(prev, hidden)
+            drnn.output(hidden)
+        out = drnn()
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={"seq": x_t}, fetch_list=[])
+        result = scope.find_var(out.name).raw()
+        got = np.asarray(result.array)
+        got_lod = result.lod()
+        # fc over [word, prev] keeps two weights W_x [D,H], W_h [H,H]
+        wx = np.asarray(scope.find_var("rwx").raw().array)
+        wh = np.asarray(scope.find_var("rwh").raw().array)
+        b = np.asarray(scope.find_var("rb").raw().array)
+
+    # numpy oracle: per-sequence tanh RNN
+    expect = []
+    off = 0
+    for ln in lengths:
+        h = np.zeros(H, "float32")
+        for t in range(ln):
+            h = np.tanh(x_np[off + t] @ wx + h @ wh + b)
+            expect.append(h.copy())
+        off += ln
+    expect = np.stack(expect)
+    assert got_lod == [[0, 4, 5, 8]]
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_dynamic_rnn_static_input_and_init_memory():
+    """static_input rows follow the rank order; memory(init=) boots
+    from the reordered initial state."""
+    D = 3
+    lengths = [1, 3]  # rank order: seq1 (len 3) first, then seq0
+    rng = np.random.RandomState(9)
+    x_np, x_t = _lod_input(rng, lengths, D)
+    init_np = rng.randn(2, D).astype("float32")
+    stat_np = rng.randn(2, D).astype("float32")
+    stat_t = LoDTensor()
+    stat_t.set(stat_np)
+    stat_t.set_recursive_sequence_lengths([[1, 1]])
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="seq2", shape=[-1, D], dtype="float32",
+                       lod_level=1)
+        init = fluid.data(name="init", shape=[2, D], dtype="float32")
+        stat = fluid.data(name="stat", shape=[2, D], dtype="float32",
+                          lod_level=1)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(x)
+            prev = drnn.memory(init=init)
+            sv = drnn.static_input(stat)
+            nxt = fluid.layers.elementwise_add(word, prev)
+            drnn.update_memory(prev, nxt)
+            drnn.output(nxt)
+        out = drnn()
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={"seq2": x_t, "init": init_np,
+                            "stat": stat_t}, fetch_list=[])
+        result = scope.find_var(out.name).raw()
+        got = np.asarray(result.array)
+        # the reordered static input landed in rank order
+        sv_got = np.asarray(scope.find_var(sv.name).raw().array)
+
+    np.testing.assert_array_equal(sv_got, stat_np[[1, 0]])
+    # oracle: running sums of each sequence, seeded by its init row
+    expect = []
+    off = 0
+    for s, ln in enumerate(lengths):
+        h = init_np[s].copy()
+        for t in range(ln):
+            h = h + x_np[off + t]
+            expect.append(h.copy())
+        off += ln
+    np.testing.assert_allclose(got, np.stack(expect), rtol=1e-6)

@@ -1,0 +1,77 @@
+"""Memory facade + DLPack interop (§2.4 memory row, §2.1 dlpack row)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import dlpack, memory
+
+
+def test_configure_allocator_maps_flags():
+    saved = {k: os.environ.get(k) for k in
+             ("XLA_PYTHON_CLIENT_MEM_FRACTION",
+              "XLA_PYTHON_CLIENT_PREALLOCATE",
+              "XLA_PYTHON_CLIENT_ALLOCATOR")}
+    saved_flag = fluid.get_flags("FLAGS_fraction_of_gpu_memory_to_use")
+    try:
+        applied = memory.configure_allocator(fraction=0.5,
+                                             strategy="auto_growth")
+        assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
+        assert os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] == "false"
+        assert applied["XLA_PYTHON_CLIENT_ALLOCATOR"] == "bfc"
+        applied = memory.configure_allocator(fraction=0.9,
+                                             strategy="naive_best_fit")
+        assert os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] == "true"
+        # flag-registry defaults drive the no-arg call
+        fluid.set_flags({"FLAGS_fraction_of_gpu_memory_to_use": 0.25})
+        applied = memory.configure_allocator()
+        assert applied["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.25"
+    finally:
+        fluid.set_flags(saved_flag)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_alloc_and_stats():
+    buf = memory.alloc(fluid.CPUPlace(), 1024)
+    assert buf.shape == (1024,) and str(buf.dtype) == "uint8"
+    usage = memory.memory_usage(fluid.CPUPlace())
+    assert set(usage) == {"allocated", "reserved", "peak", "limit"}
+    assert all(isinstance(v, int) for v in usage.values())
+    memory.release_all()
+
+
+def test_dlpack_roundtrip_with_torch():
+    """Real cross-framework exchange against torch (cpu), the contract
+    dlpack_tensor.cc covers with its DLPack tests."""
+    import torch
+
+    from paddle_tpu.core.tensor import LoDTensor
+
+    src = np.arange(12, dtype="float32").reshape(3, 4)
+    t = LoDTensor()
+    t.set(src)
+
+    # paddle_tpu -> torch
+    th = torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(t))
+    np.testing.assert_array_equal(th.numpy(), src)
+
+    # torch -> paddle_tpu
+    th2 = torch.arange(6, dtype=torch.float32).reshape(2, 3) * 2
+    back = dlpack.from_dlpack(th2)
+    np.testing.assert_array_equal(np.asarray(back.array),
+                                  th2.numpy())
+    # and it behaves as a normal LoDTensor in a program
+    prog = fluid.Program()
+    b = prog.global_block()
+    b.create_var(name="dl_x")
+    b.append_op("scale", {"X": ["dl_x"]}, {"Out": ["dl_y"]},
+                {"scale": 3.0}, infer_shape=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        (out,) = exe.run(prog, feed={"dl_x": back}, fetch_list=["dl_y"])
+    np.testing.assert_allclose(np.asarray(out), th2.numpy() * 3.0)
