@@ -55,13 +55,19 @@ class Place:
 
 @functools.lru_cache(maxsize=None)
 def _devices_for_platform(platform: str):
+    """THIS process's devices only: under multi-process jax the global
+    list includes other processes' (non-addressable) devices, and
+    placing computation there produces arrays the process cannot read
+    (every process's Place(0) must be its own first local chip)."""
     import jax
 
     if platform == "any_accelerator":
         # Prefer the default backend's devices (TPU if present).
-        return tuple(jax.devices())
+        return tuple(jax.local_devices())
     try:
-        return tuple(jax.devices(platform))
+        # backend= keeps non-default backends reachable (CPUPlace on a
+        # TPU host); plain local_devices() lists only the default one
+        return tuple(jax.local_devices(backend=platform))
     except RuntimeError:
         return ()
 

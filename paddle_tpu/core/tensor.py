@@ -63,7 +63,13 @@ class LoDTensor:
         return self
 
     def numpy(self) -> np.ndarray:
-        return np.asarray(self._array)
+        a = self._array
+        if not getattr(a, "is_fully_addressable", True):
+            # a replicated global Array from a multi-process mesh run:
+            # this process's replica shard IS the full value (save/load
+            # and metric readers must not trip on addressability)
+            a = a.addressable_shards[0].data
+        return np.asarray(a)
 
     def __array__(self, dtype=None):
         a = self.numpy()

@@ -35,16 +35,30 @@ from .transpiler import insert_allreduce_ops
 _dp_cache: Dict = {}
 
 
+def _mesh_spans_processes(mesh) -> bool:
+    import jax
+
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
 def run_data_parallel(core, program, scope: Scope, feed: Dict,
                       fetch_list: Sequence, loss_name=None, places=None,
                       build_strategy=None, return_numpy=True,
                       mesh=None, axis_name="dp"):
+    """Single-process: `feed` carries the FULL batch, sharded by the
+    mesh. Multi-process (the mesh spans jax processes — the reference's
+    NCCL2 multi-trainer mode): each process passes its OWN batch shard,
+    assembled into a global array via
+    jax.make_array_from_process_local_data; fetches and updated state
+    are read back from the locally-addressable replica."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = mesh or default_mesh(len(places) if places else None, axis_name)
     nranks = int(np.prod(list(mesh.shape.values())))
+    multiproc = _mesh_spans_processes(mesh)
 
     sync_bn = bool(build_strategy is not None and getattr(
         build_strategy, "sync_batch_norm", False))
@@ -60,18 +74,33 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
                         for f in fetch_list)
     feed_vals = {}
     for name, value in (feed or {}).items():
-        arr = value.array if isinstance(value, LoDTensor) else jnp.asarray(
-            np.asarray(value))
+        arr = value.array if isinstance(value, LoDTensor) else value
+        if multiproc:
+            # local shard -> global array over the dp axis (straight
+            # from host memory: no intermediate device put)
+            if getattr(arr, "is_fully_addressable", True):
+                arr = jax.make_array_from_process_local_data(
+                    NamedSharding(mesh, P(axis_name)), np.asarray(arr))
+        else:
+            arr = jnp.asarray(np.asarray(arr)) \
+                if not isinstance(value, LoDTensor) else arr
         feed_vals[name] = arr
     feed_names = tuple(sorted(feed_vals))
 
     read_first, written, persist_written = _analyze(program)
     state = {}
+    repl = NamedSharding(mesh, P()) if multiproc else None
     for n in sorted(read_first - set(feed_names)):
         var = scope.find_var(n)
         if var is None or not var.is_initialized():
             raise RuntimeError("var %r must be fed or initialized" % n)
-        state[n] = var.raw().array
+        arr = var.raw().array
+        if multiproc and getattr(arr, "is_fully_addressable", True):
+            # host value / local array -> replicated global array (an
+            # already-global array from the previous step passes through)
+            arr = jax.make_array_from_process_local_data(
+                repl, np.asarray(arr))
+        state[n] = arr
     state_names = tuple(sorted(state))
     block = program.global_block()
     out_state_names = tuple(sorted(set(state_names) | persist_written))
@@ -107,9 +136,20 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
                    ((core.rng.step * 2654435761) & 0xFFFFFFFF)))
     core.rng.advance()
 
+    def _local(v):
+        """A locally-readable copy of a (replicated) result: under a
+        multi-process mesh the global Array is not fully addressable,
+        so read this process's replica shard."""
+        if multiproc and hasattr(v, "addressable_shards"):
+            return v.addressable_shards[0].data
+        return v
+
     for n, v in new_state.items():
+        # keep the global (replicated) array in scope: the next step
+        # feeds it straight back without a host round-trip
         scope.var(n).get_tensor()._array = v
     results = []
     for name, v in zip(fetch_names, fetches):
-        results.append(np.asarray(v) if return_numpy else v)
+        results.append(np.asarray(_local(v)) if return_numpy
+                       else _local(v))
     return results

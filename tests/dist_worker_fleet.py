@@ -1,0 +1,83 @@
+"""Worker for the multi-process STATIC-graph data-parallel test: the
+collective-fleet arm of the test_dist_base contract. Each process
+initializes jax.distributed (2 CPU backends, Gloo collectives), builds
+the same program, and runs it through CompiledProgram.with_data_parallel
+over the 2-process global mesh, feeding its OWN batch shard."""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.dygraph.parallel import prepare_context
+
+STEPS = 3
+SHARD = 8  # per-process batch
+DIM, CLASSES = 12, 10
+
+
+def main():
+    out_path = sys.argv[1]
+    env = prepare_context()  # jax.distributed from PADDLE_* env
+    rank, nranks = env.local_rank, env.nranks
+    # the single-process oracle trains on the SAME global batch the
+    # 2-process run consumes (ORACLE_WORLD mimics that world size)
+    world = int(os.environ.get("ORACLE_WORLD", nranks))
+    local_bs = SHARD * world // nranks
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.data(name="x", shape=[local_bs, DIM], dtype="float32")
+        y = fluid.data(name="y", shape=[local_bs, 1], dtype="int64")
+        h = fluid.layers.fc(
+            x, 16, act="relu",
+            param_attr=fluid.ParamAttr(
+                name="w1", initializer=fluid.initializer.
+                ConstantInitializer(0.05)),
+            bias_attr=fluid.ParamAttr(
+                name="b1",
+                initializer=fluid.initializer.ConstantInitializer(0.0)))
+        pred = fluid.layers.fc(
+            h, CLASSES, act="softmax",
+            param_attr=fluid.ParamAttr(
+                name="w2", initializer=fluid.initializer.
+                ConstantInitializer(0.02)),
+            bias_attr=fluid.ParamAttr(
+                name="b2",
+                initializer=fluid.initializer.ConstantInitializer(0.0)))
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+
+    compiled = fluid.CompiledProgram(main_prog).with_data_parallel(
+        loss_name=loss.name)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(7)
+        losses = []
+        for _ in range(STEPS):
+            full_x = rng.randn(SHARD * world, DIM).astype("float32")
+            full_y = rng.randint(0, CLASSES,
+                                 (SHARD * world, 1)).astype("int64")
+            my_x = full_x[rank * local_bs:(rank + 1) * local_bs]
+            my_y = full_y[rank * local_bs:(rank + 1) * local_bs]
+            (l,) = exe.run(compiled, feed={"x": my_x, "y": my_y},
+                           fetch_list=[loss])
+            # fetch is all-gathered [nranks, 1]: every rank sees every
+            # shard's loss — use the global mean
+            losses.append(float(np.mean(np.asarray(l))))
+        w1 = scope.find_var("w1").raw().array
+        w1_local = (w1.addressable_shards[0].data
+                    if hasattr(w1, "addressable_shards") else w1)
+        checksum = float(np.abs(np.asarray(w1_local)).sum())
+
+    with open("%s.rank%d" % (out_path, rank), "w") as f:
+        f.write(json.dumps({"rank": rank, "nranks": nranks,
+                            "losses": losses, "checksum": checksum}))
+
+
+if __name__ == "__main__":
+    main()
