@@ -65,10 +65,16 @@ class LoDTensor:
     def numpy(self) -> np.ndarray:
         a = self._array
         if not getattr(a, "is_fully_addressable", True):
-            # a replicated global Array from a multi-process mesh run:
-            # this process's replica shard IS the full value (save/load
-            # and metric readers must not trip on addressability)
-            a = a.addressable_shards[0].data
+            # only a REPLICATED global Array can be read locally (each
+            # shard is the full value); a sharded one would be silently
+            # truncated to one shard's rows
+            if a.sharding.is_fully_replicated:
+                a = a.addressable_shards[0].data
+            else:
+                raise RuntimeError(
+                    "cannot convert a multi-process SHARDED array to "
+                    "numpy locally; gather it first "
+                    "(multihost_utils.process_allgather)")
         return np.asarray(a)
 
     def __array__(self, dtype=None):

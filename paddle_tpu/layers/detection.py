@@ -6,6 +6,8 @@ surface the SSD/YOLO/Faster-RCNN configs touch).
 """
 from __future__ import annotations
 
+import numpy as np
+
 from ..layer_helper import LayerHelper
 
 __all__ = [
@@ -19,6 +21,10 @@ __all__ = [
     "roi_pool",
     "prroi_pool",
     "multiclass_nms",
+    "locality_aware_nms",
+    "retinanet_detection_output",
+    "detection_map",
+    "multi_box_head",
 ]
 
 
@@ -47,6 +53,20 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
             "min_max_aspect_ratios_order": min_max_aspect_ratios_order,
         },
         infer_shape=False)
+    # [H, W, num_priors, 4] (prior_box_op.cc InferShape; ratios expand
+    # to {1} ∪ {r, 1/r if flip})
+    expanded = [1.0]
+    for r in aspect_ratios:
+        if not any(abs(float(r) - e) < 1e-6 for e in expanded):
+            expanded.append(float(r))
+            if flip:
+                expanded.append(1.0 / float(r))
+    num_priors = len(expanded) * len(min_sizes) + len(max_sizes or [])
+    if input.shape is not None:
+        shape = (int(input.shape[2]), int(input.shape[3]),
+                 num_priors, 4)
+        boxes.shape = shape
+        variances.shape = shape
     return boxes, variances
 
 
@@ -187,6 +207,161 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1,
                "pooled_width": pooled_width},
         infer_shape=False)
     return out
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """Locality-aware NMS for text detection (reference
+    detection.py locality_aware_nms, locality_aware_nms_op.cc)."""
+    helper = LayerHelper("locality_aware_nms", input=bboxes)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    out.lod_level = 1
+    helper.append_op(
+        "locality_aware_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={"background_label": background_label,
+               "score_threshold": score_threshold,
+               "nms_top_k": nms_top_k, "nms_threshold": nms_threshold,
+               "nms_eta": nms_eta, "keep_top_k": keep_top_k,
+               "normalized": normalized},
+        infer_shape=False)
+    return out
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet decode + NMS over FPN levels (reference
+    retinanet_detection_output_op.cc)."""
+    helper = LayerHelper("retinanet_detection_output", input=bboxes[0])
+    out = helper.create_variable_for_type_inference(
+        helper.input_dtype("input"))
+    out.lod_level = 1
+    helper.append_op(
+        "retinanet_detection_output",
+        inputs={"BBoxes": list(bboxes), "Scores": list(scores),
+                "Anchors": list(anchors), "ImInfo": [im_info]},
+        outputs={"Out": [out]},
+        attrs={"score_threshold": score_threshold,
+               "nms_top_k": nms_top_k, "nms_threshold": nms_threshold,
+               "nms_eta": nms_eta, "keep_top_k": keep_top_k},
+        infer_shape=False)
+    return out
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None,
+                  out_states=None, ap_version="integral"):
+    """Stateful mAP evaluator (reference detection.py detection_map,
+    detection_map_op.h)."""
+    helper = LayerHelper("detection_map", input=detect_res)
+
+    def _state(shape, dtype, suffix):
+        return helper.create_variable_for_type_inference(dtype)
+
+    map_out = helper.create_variable_for_type_inference("float32")
+    acc_pos = (out_states[0] if out_states
+               else _state([class_num, 1], "int32", "pos"))
+    acc_tp = (out_states[1] if out_states
+              else _state([-1, 2], "float32", "tp"))
+    acc_fp = (out_states[2] if out_states
+              else _state([-1, 2], "float32", "fp"))
+    inputs = {"DetectRes": [detect_res], "Label": [label]}
+    if has_state is not None:
+        inputs["HasState"] = [has_state]
+    if input_states is not None:
+        inputs["PosCount"] = [input_states[0]]
+        inputs["TruePos"] = [input_states[1]]
+        inputs["FalsePos"] = [input_states[2]]
+    helper.append_op(
+        "detection_map", inputs=inputs,
+        outputs={"AccumPosCount": [acc_pos], "AccumTruePos": [acc_tp],
+                 "AccumFalsePos": [acc_fp], "MAP": [map_out]},
+        attrs={"class_num": class_num,
+               "background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version},
+        infer_shape=False)
+    return map_out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD prediction head (reference detection.py:1970): per feature
+    map, a conv for box locations and one for class confidences plus
+    prior boxes; results concatenate across maps."""
+    from .nn import conv2d, reshape, transpose
+    from .tensor import concat
+
+    n_in = len(inputs)
+    if min_sizes is None:
+        # the SSD ratio schedule (reference: min/max from base_size);
+        # with <=2 maps the schedule degenerates — the reference
+        # requires explicit sizes there
+        assert n_in > 2, ("multi_box_head: pass explicit min_sizes/"
+                          "max_sizes when len(inputs) <= 2")
+        assert min_ratio is not None and max_ratio is not None
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / (n_in - 2))) \
+            if n_in > 2 else 0
+        for ratio in range(min_ratio, max_ratio + 1,
+                           step if step else 1):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+            if len(min_sizes) == n_in - 1:
+                break
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, x in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        step_wh = (steps[i] if steps
+                   else (step_w[i] if step_w else 0.0,
+                         step_h[i] if step_h else 0.0))
+        if not isinstance(step_wh, (list, tuple)):
+            step_wh = (step_wh, step_wh)
+        boxes, variances = prior_box(
+            x, image,
+            min_sizes=[mins] if not isinstance(mins, (list, tuple))
+            else list(mins),
+            max_sizes=[maxs] if maxs and not isinstance(
+                maxs, (list, tuple)) else (maxs or None),
+            aspect_ratios=ar if isinstance(ar, (list, tuple)) else [ar],
+            variance=list(variance), flip=flip, clip=clip,
+            steps=step_wh, offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        # prior_box published its [H, W, num_priors, 4] shape
+        num_priors = int(boxes.shape[2])
+        loc = conv2d(x, num_priors * 4, kernel_size, padding=pad,
+                     stride=stride)
+        loc = transpose(loc, perm=[0, 2, 3, 1])
+        loc = reshape(loc, shape=[0, -1, 4])
+        conf = conv2d(x, num_priors * num_classes, kernel_size,
+                      padding=pad, stride=stride)
+        conf = transpose(conf, perm=[0, 2, 3, 1])
+        conf = reshape(conf, shape=[0, -1, num_classes])
+        boxes_all.append(reshape(boxes, shape=[-1, 4]))
+        vars_all.append(reshape(variances, shape=[-1, 4]))
+        locs.append(loc)
+        confs.append(conf)
+
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    boxes_cat = concat(boxes_all, axis=0)
+    vars_cat = concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes_cat, vars_cat
 
 
 def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,

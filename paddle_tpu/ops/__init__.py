@@ -34,3 +34,4 @@ from . import quant_ops  # noqa: F401
 from . import tail_ops  # noqa: F401
 from . import tail_ops2  # noqa: F401
 from . import gap_ops  # noqa: F401
+from . import detection_tail_ops  # noqa: F401

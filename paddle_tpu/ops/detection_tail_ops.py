@@ -1,0 +1,386 @@
+"""Detection tail wave: locality-aware NMS (EAST text detection),
+RetinaNet decode+NMS, and the stateful mAP evaluator.
+
+Parity targets (/root/reference/paddle/fluid/operators/):
+detection/locality_aware_nms_op.cc,
+detection/retinanet_detection_output_op.cc, detection_map_op.{cc,h}.
+All host-tier: output shapes are value-dependent.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op
+
+from .detection_ops import _nms_single_class
+
+
+def _iou_np(a, b, normalized):
+    if b[0] > a[2] or b[2] < a[0] or b[1] > a[3] or b[3] < a[1]:
+        return 0.0
+    norm = 0.0 if normalized else 1.0
+    ix = min(a[2], b[2]) - max(a[0], b[0]) + norm
+    iy = min(a[3], b[3]) - max(a[1], b[1]) + norm
+    inter = max(ix, 0.0) * max(iy, 0.0)
+    area_a = (a[2] - a[0] + norm) * (a[3] - a[1] + norm)
+    area_b = (b[2] - b[0] + norm) * (b[3] - b[1] + norm)
+    union = area_a + area_b - inter
+    return inter / union if union > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# locality_aware_nms
+# ---------------------------------------------------------------------------
+
+
+@register_host_op(
+    "locality_aware_nms",
+    inputs=[In("BBoxes", no_grad=True), In("Scores", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"background_label": -1, "score_threshold": 0.0,
+           "nms_top_k": -1, "nms_threshold": 0.3, "nms_eta": 1.0,
+           "keep_top_k": 100, "normalized": True},
+)
+def _locality_aware_nms(executor, op, scope):
+    """First pass merges consecutive overlapping boxes score-weighted
+    (locality_aware_nms_op.cc:76 PolyWeightedMerge: coords average by
+    score, scores add), then standard per-class NMS. axis-aligned
+    4-coordinate boxes (the PolyIoU 8/16-point variants raise)."""
+    from ..core.tensor import LoDTensor
+
+    a = op.attrs
+    bboxes = np.asarray(executor._read_var(scope, op.input("BBoxes")[0]))
+    scores = np.asarray(executor._read_var(scope, op.input("Scores")[0]))
+    if bboxes.shape[-1] != 4:
+        raise NotImplementedError(
+            "locality_aware_nms: only 4-coordinate boxes supported "
+            "(%d-point polygons pending)" % (bboxes.shape[-1] // 2))
+    n, nclass = scores.shape[0], scores.shape[1]
+    normalized = a.get("normalized", True)
+    nms_thresh = a.get("nms_threshold", 0.3)
+    all_rows = []
+    lod = [0]
+    for b in range(n):
+        dets = []
+        for c in range(nclass):
+            if c == a.get("background_label", -1):
+                continue
+            boxes_c = bboxes[b].copy()
+            scores_c = scores[b, c].copy()
+            # locality pass: merge runs of consecutive overlapping boxes
+            skip = np.ones(len(boxes_c), dtype=bool)
+            index = -1
+            for i in range(len(boxes_c)):
+                if index > -1:
+                    ov = _iou_np(boxes_c[i], boxes_c[index], normalized)
+                    if ov > nms_thresh:
+                        s1, s2 = scores_c[i], scores_c[index]
+                        boxes_c[index] = ((boxes_c[i] * s1
+                                           + boxes_c[index] * s2)
+                                          / (s1 + s2))
+                        scores_c[index] += s1
+                    else:
+                        skip[index] = False
+                        index = i
+                else:
+                    index = i
+            if index > -1:
+                skip[index] = False
+            # merged-away boxes are excluded UNCONDITIONALLY (the
+            # reference's skip mask) — -inf survives any threshold
+            scores_c[skip] = -np.inf
+            sel = _nms_single_class(
+                boxes_c, scores_c, a.get("score_threshold", 0.0),
+                a.get("nms_top_k", -1), nms_thresh,
+                a.get("nms_eta", 1.0), normalized)
+            for i in sel:
+                dets.append([float(c), float(scores_c[i])]
+                            + [float(v) for v in boxes_c[i]])
+        keep = a.get("keep_top_k", 100)
+        if keep > -1 and len(dets) > keep:
+            dets.sort(key=lambda r: -r[1])
+            dets = dets[:keep]
+        all_rows.extend(dets)
+        lod.append(len(all_rows))
+    if all_rows:
+        out = np.asarray(all_rows, dtype=np.float32)
+    else:
+        out = np.full((1, 6), -1.0, dtype=np.float32)
+        lod = [0, 1]
+    t = LoDTensor(out)
+    t.set_lod([lod])
+    executor._write_var(scope, op.output("Out")[0], t)
+
+
+# ---------------------------------------------------------------------------
+# retinanet_detection_output
+# ---------------------------------------------------------------------------
+
+
+@register_host_op(
+    "retinanet_detection_output",
+    inputs=[In("BBoxes", duplicable=True, no_grad=True),
+            In("Scores", duplicable=True, no_grad=True),
+            In("Anchors", duplicable=True, no_grad=True),
+            In("ImInfo", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"score_threshold": 0.05, "nms_top_k": 1000,
+           "nms_threshold": 0.3, "nms_eta": 1.0, "keep_top_k": 100},
+)
+def _retinanet_detection_output(executor, op, scope):
+    """Per-FPN-level top-k -> delta decode against anchors (+1 box
+    widths, clip to the rescaled image) -> class-wise NMS -> global
+    keep_top_k (retinanet_detection_output_op.cc:326). Labels in the
+    output are class+1 (:306)."""
+    from ..core.tensor import LoDTensor
+
+    a = op.attrs
+    levels_b = [np.asarray(executor._read_var(scope, nm))
+                for nm in op.input("BBoxes")]
+    levels_s = [np.asarray(executor._read_var(scope, nm))
+                for nm in op.input("Scores")]
+    levels_a = [np.asarray(executor._read_var(scope, nm))
+                for nm in op.input("Anchors")]
+    im_info = np.asarray(executor._read_var(scope, op.input("ImInfo")[0]))
+    n = levels_s[0].shape[0]
+    class_num = levels_s[0].shape[-1]
+    all_rows = []
+    lod = [0]
+    for b in range(n):
+        im_h, im_w, im_scale = [float(v) for v in im_info[b][:3]]
+        im_h = round(im_h / im_scale)
+        im_w = round(im_w / im_scale)
+        preds = {}  # class -> [ [x0,y0,x1,y1,score], ... ]
+        for l, (lb, ls, la) in enumerate(zip(levels_b, levels_s,
+                                             levels_a)):
+            deltas = lb[b].reshape(-1, 4)
+            scr = ls[b].reshape(-1)          # [M*C], idx = anchor*C + c
+            anchors = la.reshape(-1, 4)
+            thresh = (a.get("score_threshold", 0.05)
+                      if l < len(levels_s) - 1 else 0.0)
+            cand = np.where(scr > thresh)[0]
+            order = cand[np.argsort(-scr[cand], kind="stable")]
+            top_k = a.get("nms_top_k", 1000)
+            if top_k > -1:
+                order = order[:top_k]
+            for idx in order:
+                anc = int(idx) // class_num
+                c = int(idx) % class_num
+                ax0, ay0, ax1, ay1 = anchors[anc]
+                aw, ah = ax1 - ax0 + 1, ay1 - ay0 + 1
+                acx, acy = ax0 + aw / 2, ay0 + ah / 2
+                dx, dy, dw, dh = deltas[anc]
+                cx, cy = dx * aw + acx, dy * ah + acy
+                w, h = np.exp(dw) * aw, np.exp(dh) * ah
+                box = np.array([cx - w / 2, cy - h / 2,
+                                cx + w / 2 - 1, cy + h / 2 - 1]) / im_scale
+                box[0::2] = np.clip(box[0::2], 0, im_w - 1)
+                box[1::2] = np.clip(box[1::2], 0, im_h - 1)
+                preds.setdefault(c, []).append(
+                    list(box) + [float(scr[idx])])
+        dets = []
+        for c, rows in preds.items():
+            boxes_c = np.asarray([r[:4] for r in rows], np.float32)
+            scores_c = np.asarray([r[4] for r in rows], np.float32)
+            sel = _nms_single_class(
+                boxes_c, scores_c, 0.0, -1,
+                a.get("nms_threshold", 0.3), a.get("nms_eta", 1.0),
+                False)
+            for i in sel:
+                dets.append([float(c + 1), float(scores_c[i])]
+                            + [float(v) for v in boxes_c[i]])
+        keep = a.get("keep_top_k", 100)
+        dets.sort(key=lambda r: -r[1])
+        if keep > -1 and len(dets) > keep:
+            dets = dets[:keep]
+        all_rows.extend(dets)
+        lod.append(len(all_rows))
+    if all_rows:
+        out = np.asarray(all_rows, dtype=np.float32)
+    else:
+        out = np.full((1, 6), -1.0, dtype=np.float32)
+        lod = [0, 1]
+    t = LoDTensor(out)
+    t.set_lod([lod])
+    executor._write_var(scope, op.output("Out")[0], t)
+
+
+# ---------------------------------------------------------------------------
+# detection_map (stateful mAP evaluator)
+# ---------------------------------------------------------------------------
+
+
+def _ap_from_pairs(pos_count, tp_pairs, fp_pairs, ap_type):
+    """Average precision for one class from (score, count) pairs
+    (detection_map_op.h GetAccumulation + CalcMAP)."""
+    if pos_count == 0:
+        return None
+    pairs_tp = sorted(tp_pairs, key=lambda p: -p[0])
+    pairs_fp = sorted(fp_pairs, key=lambda p: -p[0])
+    acc_tp = np.cumsum([c for _, c in pairs_tp]) if pairs_tp else []
+    acc_fp = np.cumsum([c for _, c in pairs_fp]) if pairs_fp else []
+    num = max(len(acc_tp), len(acc_fp))
+    precision, recall = [], []
+    for i in range(num):
+        tp = acc_tp[min(i, len(acc_tp) - 1)] if len(acc_tp) else 0
+        fp = acc_fp[min(i, len(acc_fp) - 1)] if len(acc_fp) else 0
+        if tp + fp == 0:
+            continue
+        precision.append(tp / float(tp + fp))
+        recall.append(tp / float(pos_count))
+    if ap_type == "11point":
+        max_precisions = [0.0] * 11
+        start_idx = len(precision) - 1
+        for j in range(10, -1, -1):
+            for i in range(start_idx, -1, -1):
+                if recall[i] < j / 10.0:
+                    start_idx = i
+                    if j > 0:
+                        max_precisions[j - 1] = max_precisions[j]
+                    break
+                else:
+                    if max_precisions[j] < precision[i]:
+                        max_precisions[j] = precision[i]
+        return sum(max_precisions) / 11.0
+    # integral
+    ap = 0.0
+    prev_recall = 0.0
+    for i in range(len(precision)):
+        if abs(recall[i] - prev_recall) > 1e-6:
+            ap += precision[i] * abs(recall[i] - prev_recall)
+            prev_recall = recall[i]
+    return ap
+
+
+@register_host_op(
+    "detection_map",
+    inputs=[In("DetectRes", no_grad=True), In("Label", no_grad=True),
+            In("HasState", dispensable=True, no_grad=True),
+            In("PosCount", dispensable=True, no_grad=True),
+            In("TruePos", dispensable=True, no_grad=True),
+            In("FalsePos", dispensable=True, no_grad=True)],
+    outputs=[Out("AccumPosCount"), Out("AccumTruePos"),
+             Out("AccumFalsePos"), Out("MAP")],
+    attrs={"class_num": 1, "background_label": 0,
+           "overlap_threshold": 0.5, "evaluate_difficult": True,
+           "ap_type": "integral"},
+)
+def _detection_map(executor, op, scope):
+    """mAP over LoD-batched detections vs ground truth, with running
+    accumulation state (detection_map_op.h): Label rows are
+    [label, x0, y0, x1, y1(, difficult)], DetectRes rows
+    [label, score, x0, y0, x1, y1]."""
+    from ..core.tensor import LoDTensor
+
+    a = op.attrs
+    det_v = scope.find_var(op.input("DetectRes")[0]).raw()
+    lab_v = scope.find_var(op.input("Label")[0]).raw()
+    det = np.asarray(det_v.array)
+    lab = np.asarray(lab_v.array)
+    det_off = det_v.lod()[0]
+    lab_off = lab_v.lod()[0]
+    n = len(lab_off) - 1
+    class_num = int(a.get("class_num", 1))
+    eval_difficult = bool(a.get("evaluate_difficult", True))
+    thresh = float(a.get("overlap_threshold", 0.5))
+
+    pos_count = {}
+    tp = {}
+    fp = {}
+
+    # merge prior state when HasState says so
+    hs = op.input("HasState")
+    state = 0
+    if hs:
+        sv = executor._read_var(scope, hs[0])
+        if sv is not None:
+            state = int(np.asarray(sv).ravel()[0])
+    if state and op.input("PosCount"):
+        pc = np.asarray(executor._read_var(scope,
+                                           op.input("PosCount")[0]))
+        tpv = scope.find_var(op.input("TruePos")[0]).raw()
+        fpv = scope.find_var(op.input("FalsePos")[0]).raw()
+        for c in range(class_num):
+            if pc[c].item() > 0:
+                pos_count[c] = int(pc[c].item())
+        for store, var in ((tp, tpv), (fp, fpv)):
+            rows = np.asarray(var.array)
+            offs = var.lod()[0]
+            for c in range(class_num):
+                seg = rows[offs[c]:offs[c + 1]]
+                if len(seg):
+                    store[c] = [(float(s), int(k)) for s, k in seg]
+
+    # per-image matching
+    for b in range(n):
+        gts = lab[lab_off[b]:lab_off[b + 1]]
+        dts = det[det_off[b]:det_off[b + 1]]
+        has_difficult = gts.shape[1] == 6
+        by_class = {}
+        for g in gts:
+            c = int(g[0])
+            difficult = bool(g[5]) if has_difficult else False
+            by_class.setdefault(c, []).append((g[1:5], difficult))
+            if eval_difficult or not difficult:
+                pos_count[c] = pos_count.get(c, 0) + 1
+        for c in sorted({int(d[0]) for d in dts} if len(dts) else set()):
+            cls_dts = sorted([d for d in dts if int(d[0]) == c],
+                             key=lambda d: -d[1])
+            gt_list = by_class.get(c, [])
+            matched = [False] * len(gt_list)
+            for d in cls_dts:
+                score = float(d[1])
+                best, best_iou = -1, -1.0
+                for gi, (gbox, _diff) in enumerate(gt_list):
+                    iou = _iou_np(d[2:6], gbox, True)
+                    if iou > best_iou:
+                        best, best_iou = gi, iou
+                if best >= 0 and best_iou > thresh:
+                    difficult = gt_list[best][1]
+                    if eval_difficult or not difficult:
+                        if not matched[best]:
+                            matched[best] = True
+                            tp.setdefault(c, []).append((score, 1))
+                            fp.setdefault(c, []).append((score, 0))
+                        else:
+                            tp.setdefault(c, []).append((score, 0))
+                            fp.setdefault(c, []).append((score, 1))
+                else:
+                    tp.setdefault(c, []).append((score, 0))
+                    fp.setdefault(c, []).append((score, 1))
+
+    # mAP over classes with positives
+    background = int(a.get("background_label", 0))
+    aps = []
+    for c, count in pos_count.items():
+        if c == background:
+            continue
+        ap = _ap_from_pairs(count, tp.get(c, []), fp.get(c, []),
+                            a.get("ap_type", "integral"))
+        if ap is not None:
+            aps.append(ap)
+    m_ap = float(np.mean(aps)) if aps else 0.0
+
+    # serialize accumulation state
+    pc_out = np.zeros((class_num, 1), np.int32)
+    for c, v in pos_count.items():
+        if 0 <= c < class_num:
+            pc_out[c] = v
+
+    def pairs_to_lod(store):
+        rows, offs = [], [0]
+        for c in range(class_num):
+            for s, k in store.get(c, []):
+                rows.append([s, float(k)])
+            offs.append(len(rows))
+        arr = (np.asarray(rows, np.float32) if rows
+               else np.zeros((0, 2), np.float32))
+        t = LoDTensor(arr)
+        t.set_lod([offs])
+        return t
+
+    executor._write_var(scope, op.output("AccumPosCount")[0], pc_out)
+    scope.var(op.output("AccumTruePos")[0]).set(pairs_to_lod(tp))
+    scope.var(op.output("AccumFalsePos")[0]).set(pairs_to_lod(fp))
+    executor._write_var(scope, op.output("MAP")[0],
+                        np.asarray([m_ap], np.float32))

@@ -1,0 +1,168 @@
+"""Detection tail wave: locality_aware_nms, retinanet_detection_output,
+detection_map, multi_box_head."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.tensor import LoDTensor
+
+
+def _run_host(op_type, inputs, outputs, attrs, feeds, fetch_raw):
+    prog = fluid.Program()
+    b = prog.global_block()
+    for names in inputs.values():
+        for n in names:
+            b.create_var(name=n)
+    b.append_op(op_type, inputs, outputs, attrs, infer_shape=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, feed=feeds, fetch_list=[])
+        return {n: scope.find_var(n).raw() for n in fetch_raw}
+
+
+def test_locality_aware_nms_merges_then_suppresses():
+    # two heavily-overlapping boxes merge score-weighted, a distant one
+    # survives independently
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                       [50, 50, 60, 60]]], "float32")
+    scores = np.array([[[0.8, 0.4, 0.6]]], "float32")  # [N=1, C=1, M=3]
+    out = _run_host(
+        "locality_aware_nms",
+        {"BBoxes": ["la_b"], "Scores": ["la_s"]}, {"Out": ["la_o"]},
+        {"background_label": -1, "score_threshold": 0.1,
+         "nms_top_k": -1, "nms_threshold": 0.3, "keep_top_k": 10,
+         "normalized": False},
+        {"la_b": boxes, "la_s": scores}, ["la_o"])["la_o"]
+    rows = np.asarray(out.array)
+    assert out.lod() == [[0, 2]]
+    # merged box: coords weighted (0.8, 0.4) -> (x*0.8 + (x+1)*0.4)/1.2
+    merged = rows[rows[:, 1] > 1.0][0]
+    np.testing.assert_allclose(merged[1], 1.2, rtol=1e-6)  # score sum
+    np.testing.assert_allclose(merged[2], (0 * 0.4 + 1 * 0.8) / 1.2
+                               if False else (1 * 0.4 + 0 * 0.8) / 1.2,
+                               rtol=1e-5)
+    lone = rows[np.isclose(rows[:, 1], 0.6)][0]
+    np.testing.assert_allclose(lone[2:], [50, 50, 60, 60])
+
+
+def test_retinanet_detection_output_decodes_and_keeps():
+    # one level, two anchors, two classes; identity deltas
+    anchors = np.array([[0, 0, 9, 9], [20, 20, 29, 29]], "float32")
+    deltas = np.zeros((1, 2, 4), "float32")
+    scores = np.array([[[0.9, 0.1], [0.2, 0.7]]], "float32")
+    im_info = np.array([[100, 100, 1.0]], "float32")
+    out = _run_host(
+        "retinanet_detection_output",
+        {"BBoxes": ["rt_b"], "Scores": ["rt_s"], "Anchors": ["rt_a"],
+         "ImInfo": ["rt_i"]},
+        {"Out": ["rt_o"]},
+        {"score_threshold": 0.05, "nms_top_k": 100,
+         "nms_threshold": 0.3, "keep_top_k": 10},
+        {"rt_b": deltas, "rt_s": scores, "rt_a": anchors,
+         "rt_i": im_info}, ["rt_o"])["rt_o"]
+    rows = np.asarray(out.array)
+    # zero deltas decode back to the anchors; labels are class+1
+    r0 = rows[np.isclose(rows[:, 1], 0.9)][0]
+    assert r0[0] == 1.0
+    np.testing.assert_allclose(r0[2:], [0, 0, 9, 9], atol=1e-4)
+    r1 = rows[np.isclose(rows[:, 1], 0.7)][0]
+    assert r1[0] == 2.0
+    np.testing.assert_allclose(r1[2:], [20, 20, 29, 29], atol=1e-4)
+
+
+def test_detection_map_perfect_and_half():
+    # class 1: one perfect match; class 2: one hit one miss
+    label = np.array([[1, 10, 10, 20, 20, 0],
+                      [2, 40, 40, 50, 50, 0],
+                      [2, 70, 70, 80, 80, 0]], "float32")
+    lt = LoDTensor(label)
+    lt.set_lod([[0, 3]])
+    det = np.array([[1, 0.9, 10, 10, 20, 20],      # TP class 1
+                    [2, 0.8, 40, 40, 50, 50],      # TP class 2
+                    [2, 0.7, 0, 0, 5, 5]], "float32")  # FP class 2
+    dt = LoDTensor(det)
+    dt.set_lod([[0, 3]])
+    out = _run_host(
+        "detection_map",
+        {"DetectRes": ["dm_d"], "Label": ["dm_l"]},
+        {"AccumPosCount": ["dm_pc"], "AccumTruePos": ["dm_tp"],
+         "AccumFalsePos": ["dm_fp"], "MAP": ["dm_map"]},
+        {"class_num": 3, "background_label": 0,
+         "overlap_threshold": 0.5, "evaluate_difficult": True,
+         "ap_type": "integral"},
+        {"dm_d": dt, "dm_l": lt}, ["dm_map", "dm_pc"])
+    m = float(np.asarray(out["dm_map"].array).ravel()[0])
+    # class1 AP = 1.0; class2: recall 0.5 with precision 1.0 -> AP 0.5
+    np.testing.assert_allclose(m, 0.75, atol=1e-5)
+    pc = np.asarray(out["dm_pc"].array).ravel()
+    assert pc[1] == 1 and pc[2] == 2
+
+
+def test_detection_map_accumulates_state():
+    label = np.array([[1, 10, 10, 20, 20, 0]], "float32")
+    lt = LoDTensor(label)
+    lt.set_lod([[0, 1]])
+    det_hit = LoDTensor(np.array([[1, 0.9, 10, 10, 20, 20]], "float32"))
+    det_hit.set_lod([[0, 1]])
+    det_miss = LoDTensor(np.array([[1, 0.8, 90, 90, 99, 99]], "float32"))
+    det_miss.set_lod([[0, 1]])
+
+    prog = fluid.Program()
+    b = prog.global_block()
+    for n in ("s_d1", "s_l", "s_d2", "s_state"):
+        b.create_var(name=n)
+    b.append_op("detection_map",
+                {"DetectRes": ["s_d1"], "Label": ["s_l"]},
+                {"AccumPosCount": ["s_pc"], "AccumTruePos": ["s_tp"],
+                 "AccumFalsePos": ["s_fp"], "MAP": ["s_map1"]},
+                {"class_num": 2, "background_label": 0,
+                 "ap_type": "integral", "overlap_threshold": 0.5,
+                 "evaluate_difficult": True}, infer_shape=False)
+    b.append_op("detection_map",
+                {"DetectRes": ["s_d2"], "Label": ["s_l"],
+                 "HasState": ["s_state"], "PosCount": ["s_pc"],
+                 "TruePos": ["s_tp"], "FalsePos": ["s_fp"]},
+                {"AccumPosCount": ["s_pc2"], "AccumTruePos": ["s_tp2"],
+                 "AccumFalsePos": ["s_fp2"], "MAP": ["s_map2"]},
+                {"class_num": 2, "background_label": 0,
+                 "ap_type": "integral", "overlap_threshold": 0.5,
+                 "evaluate_difficult": True}, infer_shape=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog, feed={"s_d1": det_hit, "s_l": lt,
+                            "s_d2": det_miss,
+                            "s_state": np.array([1], "int32")},
+                fetch_list=[])
+        m1 = float(np.asarray(scope.find_var("s_map1").raw().array)[0])
+        m2 = float(np.asarray(scope.find_var("s_map2").raw().array)[0])
+    np.testing.assert_allclose(m1, 1.0, atol=1e-6)
+    # accumulated: 2 gt positives, 1 TP (score .9), 1 FP (.8):
+    # precision@1=1 recall .5 -> AP = .5 -> 50%
+    np.testing.assert_allclose(m2, 0.5, atol=1e-6)
+
+
+def test_multi_box_head_shapes():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data(name="mb_img", shape=[2, 3, 64, 64],
+                         dtype="float32")
+        f1 = fluid.layers.conv2d(img, 8, 3, padding=1, stride=4)
+        f2 = fluid.layers.conv2d(f1, 8, 3, padding=1, stride=2)
+        f3 = fluid.layers.conv2d(f2, 8, 3, padding=1, stride=2)
+        locs, confs, boxes, variances = fluid.layers.multi_box_head(
+            inputs=[f1, f2, f3], image=img, base_size=64, num_classes=5,
+            aspect_ratios=[[2.0], [2.0, 3.0], [2.0]], min_ratio=20,
+            max_ratio=90, offset=0.5, flip=True)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        lv, cv, bv, vv = exe.run(
+            main, feed={"mb_img": rng.rand(2, 3, 64, 64).astype("f4")},
+            fetch_list=[locs, confs, boxes, variances])
+    lv, cv, bv, vv = map(np.asarray, (lv, cv, bv, vv))
+    assert lv.shape[0] == 2 and lv.shape[2] == 4
+    assert cv.shape[:2] == lv.shape[:2] and cv.shape[2] == 5
+    assert bv.shape == (lv.shape[1], 4) and vv.shape == bv.shape
