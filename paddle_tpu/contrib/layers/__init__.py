@@ -1,3 +1,3 @@
 from .nn import (  # noqa: F401
     fused_elemwise_activation, fused_embedding_seq_pool, multiclass_nms2,
-    partial_concat, partial_sum, shuffle_batch)
+    partial_concat, partial_sum, shuffle_batch, tree_conv)

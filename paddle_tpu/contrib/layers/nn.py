@@ -13,7 +13,8 @@ from __future__ import annotations
 
 from ...layer_helper import LayerHelper
 
-__all__ = ["shuffle_batch", "partial_concat", "partial_sum",
+__all__ = ["tree_conv",
+           "shuffle_batch", "partial_concat", "partial_sum",
            "multiclass_nms2", "fused_embedding_seq_pool",
            "fused_elemwise_activation"]
 
@@ -116,3 +117,34 @@ def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
                "save_intermediate_out": save_intermediate_out},
         infer_shape=False)
     return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Tree-based convolution, TBCNN (reference contrib/layers/nn.py:370
+    over tree_conv_op.cc): Filter [F, 3, output_size, num_filters]."""
+    from ...layer_helper import LayerHelper
+
+    helper = LayerHelper("tree_conv", input=nodes_vector,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = nodes_vector.dtype
+    feature_size = int(nodes_vector.shape[2])
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[feature_size, 3, output_size, num_filters], dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"max_depth": max_depth}, infer_shape=False)
+    out.shape = (nodes_vector.shape[0], nodes_vector.shape[1],
+                 output_size, num_filters)
+    out.dtype = dtype
+    # reference tree_conv uses the default dim_start=1 bias:
+    # shape [max_nodes, output_size, num_filters]
+    pre_act = helper.append_bias_op(out)
+    return helper.append_activation(pre_act)

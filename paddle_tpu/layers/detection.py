@@ -260,16 +260,13 @@ def detection_map(detect_res, label, class_num, background_label=0,
     detection_map_op.h)."""
     helper = LayerHelper("detection_map", input=detect_res)
 
-    def _state(shape, dtype, suffix):
-        return helper.create_variable_for_type_inference(dtype)
-
     map_out = helper.create_variable_for_type_inference("float32")
     acc_pos = (out_states[0] if out_states
-               else _state([class_num, 1], "int32", "pos"))
+               else helper.create_variable_for_type_inference("int32"))
     acc_tp = (out_states[1] if out_states
-              else _state([-1, 2], "float32", "tp"))
+              else helper.create_variable_for_type_inference("float32"))
     acc_fp = (out_states[2] if out_states
-              else _state([-1, 2], "float32", "fp"))
+              else helper.create_variable_for_type_inference("float32"))
     inputs = {"DetectRes": [detect_res], "Label": [label]}
     if has_state is not None:
         inputs["HasState"] = [has_state]

@@ -35,3 +35,5 @@ from . import tail_ops  # noqa: F401
 from . import tail_ops2  # noqa: F401
 from . import gap_ops  # noqa: F401
 from . import detection_tail_ops  # noqa: F401
+from . import tree_ops  # noqa: F401
+from . import var_conv_ops  # noqa: F401

@@ -62,10 +62,13 @@ def _locality_aware_nms(executor, op, scope):
     lod = [0]
     for b in range(n):
         dets = []
+        # the reference mutates the SHARED bbox slice in place
+        # (locality_aware_nms_op.cc:217): class c+1 sees class c's
+        # merged coordinates
+        boxes_c = bboxes[b].copy()
         for c in range(nclass):
             if c == a.get("background_label", -1):
                 continue
-            boxes_c = bboxes[b].copy()
             scores_c = scores[b, c].copy()
             # locality pass: merge runs of consecutive overlapping boxes
             skip = np.ones(len(boxes_c), dtype=bool)
@@ -268,8 +271,10 @@ def _ap_from_pairs(pos_count, tp_pairs, fp_pairs, ap_type):
 def _detection_map(executor, op, scope):
     """mAP over LoD-batched detections vs ground truth, with running
     accumulation state (detection_map_op.h): Label rows are
-    [label, x0, y0, x1, y1(, difficult)], DetectRes rows
-    [label, score, x0, y0, x1, y1]."""
+    [label, is_difficult, x0, y0, x1, y1] (6-column) or
+    [label, x0, y0, x1, y1] (5-column, difficult absent), DetectRes
+    rows [label, score, x0, y0, x1, y1]; detection boxes clip to [0,1]
+    before matching (detection_map_op.h ClipBBox)."""
     from ..core.tensor import LoDTensor
 
     a = op.attrs
@@ -319,8 +324,11 @@ def _detection_map(executor, op, scope):
         by_class = {}
         for g in gts:
             c = int(g[0])
-            difficult = bool(g[5]) if has_difficult else False
-            by_class.setdefault(c, []).append((g[1:5], difficult))
+            # 6-column rows are [label, is_difficult, box]
+            # (detection_map_op.h GetBoxes)
+            difficult = bool(g[1]) if has_difficult else False
+            box = g[2:6] if has_difficult else g[1:5]
+            by_class.setdefault(c, []).append((box, difficult))
             if eval_difficult or not difficult:
                 pos_count[c] = pos_count.get(c, 0) + 1
         for c in sorted({int(d[0]) for d in dts} if len(dts) else set()):
@@ -330,9 +338,10 @@ def _detection_map(executor, op, scope):
             matched = [False] * len(gt_list)
             for d in cls_dts:
                 score = float(d[1])
+                dbox = np.clip(d[2:6], 0.0, 1.0)  # ClipBBox
                 best, best_iou = -1, -1.0
                 for gi, (gbox, _diff) in enumerate(gt_list):
-                    iou = _iou_np(d[2:6], gbox, True)
+                    iou = _iou_np(dbox, gbox, True)
                     if iou > best_iou:
                         best, best_iou = gi, iou
                 if best >= 0 and best_iou > thresh:

@@ -1,0 +1,158 @@
+"""tree_conv — tree-based convolution (TBCNN, arXiv:1409.5718).
+
+Parity: /root/reference/paddle/fluid/operators/tree_conv_op.cc +
+math/tree2col.cc. Host-tier: patch construction walks the tree
+structure (data-dependent), the matmul itself is dense.
+
+Shapes: NodesVector [B, N, F]; EdgeSet [B, E, 2] int32 (1-indexed
+parent->child, a 0 terminates); Filter [F, 3, out_size, num_filters]
+(the 3 axis orders eta_l, eta_r, eta_t); Out [B, N, out_size,
+num_filters] (rows past the sample's node count stay zero).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op
+
+
+def _construct_tree(edges):
+    """Adjacency (1-indexed) + node count (tree2col.cc:54
+    construct_tree: counts edges with both endpoints nonzero, +1)."""
+    node_count = 0
+    for u, v in edges:
+        if u != 0 and v != 0:
+            node_count += 1
+    node_count += 1
+    tr = [[] for _ in range(node_count + 2)]
+    for u, v in edges:
+        if u != 0 and v != 0:
+            tr[int(u)].append(int(v))
+        else:
+            break
+    return tr, node_count
+
+
+def _construct_patch(root, max_depth, tr):
+    """DFS patch with (node, index, pclen, depth) entries — the exact
+    stack walk of tree2col.cc:21 (patch stores 1-based child index)."""
+    stack = [(root, 1, 1, 0)]
+    patch = [(root, 1, 1, 0)]
+    visited = {root}
+    while stack:
+        node, _idx, _pclen, depth = stack[-1]
+        end = True
+        kids = tr[node] if node < len(tr) else []
+        sz = len(kids)
+        for i, v in enumerate(kids):
+            if v not in visited and depth + 1 < max_depth:
+                visited.add(v)
+                stack.append((v, i, sz, depth + 1))
+                patch.append((v, i + 1, sz, depth + 1))
+                end = False
+        if end:
+            stack.pop()
+    return patch
+
+
+def _etas(index, pclen, depth, max_depth):
+    """tree2col.h:35-52: eta_t = (d_f - depth)/d_f; eta_l =
+    (1-eta_t)*temp with temp the sibling position; eta_r =
+    (1-eta_t)*(1 - eta_l) — note eta_l here is the FULL eta_l, not
+    temp."""
+    eta_t = (max_depth - depth) / float(max_depth)
+    temp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+    eta_l = (1.0 - eta_t) * temp
+    eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+    return eta_l, eta_r, eta_t
+
+
+def _patch_matrix(features, edges, max_depth):
+    """[patch_count, F*3] column layout i*3 + {0:l, 1:r, 2:t}
+    (tree2col.cc:113-121), plus the (u, v, coeffs) triples the backward
+    scatter reuses."""
+    f = features
+    n_feat = f.shape[1]
+    tr, node_count = _construct_tree(edges)
+    rows = []
+    triples = []
+    for u in range(1, node_count + 1):
+        patch = _construct_patch(u, max_depth, tr)
+        row = np.zeros((n_feat, 3), f.dtype)
+        for node, index, pclen, depth in patch:
+            el, er, et = _etas(index, pclen, depth, max_depth)
+            row[:, 0] += el * f[node - 1]
+            row[:, 1] += er * f[node - 1]
+            row[:, 2] += et * f[node - 1]
+            triples.append((u - 1, node - 1, (el, er, et)))
+        rows.append(row.reshape(-1))
+    return (np.stack(rows) if rows
+            else np.zeros((0, n_feat * 3), f.dtype)), triples, node_count
+
+
+@register_host_op(
+    "tree_conv",
+    inputs=[In("NodesVector"), In("EdgeSet", no_grad=True),
+            In("Filter")],
+    outputs=[Out("Out")],
+    attrs={"max_depth": 2},
+)
+def _tree_conv(executor, op, scope):
+    feats = np.asarray(executor._read_var(scope,
+                                          op.input("NodesVector")[0]))
+    edges = np.asarray(executor._read_var(scope, op.input("EdgeSet")[0]))
+    filt = np.asarray(executor._read_var(scope, op.input("Filter")[0]))
+    max_depth = int(op.attrs.get("max_depth", 2))
+    bsz, n_nodes, n_feat = feats.shape
+    out_size, n_filters = filt.shape[2], filt.shape[3]
+    w2 = filt.reshape(n_feat * 3, out_size * n_filters)
+    out = np.zeros((bsz, n_nodes, out_size, n_filters), feats.dtype)
+    for b in range(bsz):
+        patch, _triples, count = _patch_matrix(feats[b], edges[b],
+                                               max_depth)
+        if count:
+            out[b, :count] = (patch @ w2).reshape(count, out_size,
+                                                  n_filters)
+    executor._write_var(scope, op.output("Out")[0], out)
+
+
+@register_host_op(
+    "tree_conv_grad",
+    inputs=[In("NodesVector", no_grad=True), In("EdgeSet", no_grad=True),
+            In("Filter", no_grad=True), In("Out@GRAD", no_grad=True)],
+    outputs=[Out("NodesVector@GRAD"), Out("Filter@GRAD")],
+    attrs={"max_depth": 2},
+)
+def _tree_conv_grad(executor, op, scope):
+    """dFilter = patchᵀ @ dOut; dNodes scatters the eta coefficients
+    back (the Col2TreeFunctor transpose)."""
+    feats = np.asarray(executor._read_var(scope,
+                                          op.input("NodesVector")[0]))
+    edges = np.asarray(executor._read_var(scope, op.input("EdgeSet")[0]))
+    filt = np.asarray(executor._read_var(scope, op.input("Filter")[0]))
+    og = np.asarray(executor._read_var(scope, op.input("Out@GRAD")[0]))
+    max_depth = int(op.attrs.get("max_depth", 2))
+    bsz, n_nodes, n_feat = feats.shape
+    out_size, n_filters = filt.shape[2], filt.shape[3]
+    w2 = filt.reshape(n_feat * 3, out_size * n_filters)
+    d_filter = np.zeros_like(w2)
+    d_nodes = np.zeros_like(feats)
+    for b in range(bsz):
+        patch, triples, count = _patch_matrix(feats[b], edges[b],
+                                              max_depth)
+        if not count:
+            continue
+        og_flat = og[b, :count].reshape(count, out_size * n_filters)
+        d_filter += patch.T @ og_flat
+        col = og_flat @ w2.T            # [count, F*3]
+        col = col.reshape(count, n_feat, 3)
+        for u, v, (el, er, et) in triples:
+            d_nodes[b, v] += (el * col[u, :, 0] + er * col[u, :, 1]
+                              + et * col[u, :, 2])
+    outs = op.output("NodesVector@GRAD")
+    if outs:
+        executor._write_var(scope, outs[0], d_nodes)
+    fouts = op.output("Filter@GRAD")
+    if fouts:
+        executor._write_var(scope, fouts[0],
+                            d_filter.reshape(filt.shape))

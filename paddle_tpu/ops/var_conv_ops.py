@@ -1,0 +1,168 @@
+"""var_conv_2d — convolution over variable-sized 2-D feature maps
+(search/match models; reference var_conv_2d_op.cc).
+
+Each sample's H comes from ROW's LoD and W from COLUMN's LoD; X is the
+flattened [sum(C*H_i*W_i), 1] LoD tensor. Im2col centers the kernel
+(half-kernel offsets, zero padding), samples by stride, and the filter
+W [out_ch, in_ch*kh*kw] GEMMs per sample. Out/Col are flat [size, 1]
+LoD tensors like the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op
+
+
+def _sizes(offset):
+    return [offset[i + 1] - offset[i] for i in range(len(offset) - 1)]
+
+
+def _im2col_sample(img, kh, kw, sh, sw):
+    """img [C, H, W] -> col [C*kh*kw, top_y*top_x] with centered kernel
+    and zero padding (var_conv_2d_op.cc:139 Im2Col)."""
+    c, h, w = img.shape
+    if h == 0 or w == 0:
+        return np.zeros((c * kh * kw, 0), img.dtype), 0, 0
+    ty = (h - 1) // sh + 1
+    tx = (w - 1) // sw + 1
+    col = np.zeros((c * kh * kw, ty * tx), img.dtype)
+    hh, hw = kh // 2, kw // 2
+    for z in range(c):
+        for yi, y in enumerate(range(0, h, sh)):
+            for xi, x in enumerate(range(0, w, sw)):
+                cidx = yi * tx + xi
+                for ky in range(kh):
+                    for kx in range(kw):
+                        iy, ix = y + ky - hh, x + kx - hw
+                        if 0 <= iy < h and 0 <= ix < w:
+                            col[z * kh * kw + ky * kw + kx, cidx] = \
+                                img[z, iy, ix]
+    return col, ty, tx
+
+
+def _sample_views(x_flat, x_off, rows, cols, in_ch):
+    for b in range(len(rows)):
+        h, w = rows[b], cols[b]
+        seg = x_flat[x_off[b]:x_off[b + 1]]
+        yield seg.reshape(in_ch, h, w) if h * w else \
+            np.zeros((in_ch, h, w), x_flat.dtype)
+
+
+@register_host_op(
+    "var_conv_2d",
+    inputs=[In("X"), In("ROW", no_grad=True),
+            In("COLUMN", no_grad=True), In("W")],
+    outputs=[Out("Out"), Out("Col", no_grad=True)],
+    attrs={"InputChannel": 1, "OutputChannel": 1, "StrideH": 1,
+           "StrideW": 1, "KernelH": 1, "KernelW": 1},
+)
+def _var_conv_2d(executor, op, scope):
+    from ..core.tensor import LoDTensor
+
+    a = op.attrs
+    in_ch = int(a.get("InputChannel", 1))
+    out_ch = int(a.get("OutputChannel", 1))
+    kh, kw = int(a.get("KernelH", 1)), int(a.get("KernelW", 1))
+    sh, sw = int(a.get("StrideH", 1)), int(a.get("StrideW", 1))
+
+    xv = scope.find_var(op.input("X")[0]).raw()
+    rowv = scope.find_var(op.input("ROW")[0]).raw()
+    colv = scope.find_var(op.input("COLUMN")[0]).raw()
+    w = np.asarray(executor._read_var(scope, op.input("W")[0]))
+    x = np.asarray(xv.array).reshape(-1)
+    x_off = xv.lod()[0]
+    rows = _sizes(rowv.lod()[0])
+    cols = _sizes(colv.lod()[0])
+    w2 = w.reshape(out_ch, in_ch * kh * kw)
+
+    tops, cols_out = [], []
+    top_off, col_off = [0], [0]
+    for img in _sample_views(x, x_off, rows, cols, in_ch):
+        col, ty, tx = _im2col_sample(img, kh, kw, sh, sw)
+        out = w2 @ col                   # [out_ch, ty*tx]
+        cols_out.append(col.reshape(-1))
+        tops.append(out.reshape(-1))
+        col_off.append(col_off[-1] + col.size)
+        top_off.append(top_off[-1] + out.size)
+    top = (np.concatenate(tops) if tops
+           else np.zeros((0,), x.dtype)).reshape(-1, 1)
+    colcat = (np.concatenate(cols_out) if cols_out
+              else np.zeros((0,), x.dtype)).reshape(-1, 1)
+    t = LoDTensor(top.astype(np.float32))
+    t.set_lod([top_off])
+    executor._write_var(scope, op.output("Out")[0], t)
+    tc = LoDTensor(colcat.astype(np.float32))
+    tc.set_lod([col_off])
+    executor._write_var(scope, op.output("Col")[0], tc)
+
+
+@register_host_op(
+    "var_conv_2d_grad",
+    inputs=[In("X", no_grad=True), In("ROW", no_grad=True),
+            In("COLUMN", no_grad=True), In("W", no_grad=True),
+            In("Out@GRAD", no_grad=True)],
+    outputs=[Out("X@GRAD"), Out("W@GRAD")],
+    attrs={"InputChannel": 1, "OutputChannel": 1, "StrideH": 1,
+           "StrideW": 1, "KernelH": 1, "KernelW": 1},
+)
+def _var_conv_2d_grad(executor, op, scope):
+    """dW = Σ_b dTop_b colᵀ_b ; dX = col2im(Wᵀ dTop_b) — the GEMM
+    transposes of the forward."""
+    a = op.attrs
+    in_ch = int(a.get("InputChannel", 1))
+    out_ch = int(a.get("OutputChannel", 1))
+    kh, kw = int(a.get("KernelH", 1)), int(a.get("KernelW", 1))
+    sh, sw = int(a.get("StrideH", 1)), int(a.get("StrideW", 1))
+
+    xv = scope.find_var(op.input("X")[0]).raw()
+    rowv = scope.find_var(op.input("ROW")[0]).raw()
+    colv = scope.find_var(op.input("COLUMN")[0]).raw()
+    w = np.asarray(executor._read_var(scope, op.input("W")[0]))
+    ogv = scope.find_var(op.input("Out@GRAD")[0]).raw()
+    og = np.asarray(ogv.array
+                    if hasattr(ogv, "array") else ogv).reshape(-1)
+    x = np.asarray(xv.array).reshape(-1)
+    x_off = xv.lod()[0]
+    rows = _sizes(rowv.lod()[0])
+    cols = _sizes(colv.lod()[0])
+    w2 = w.reshape(out_ch, in_ch * kh * kw)
+
+    d_w = np.zeros_like(w2)
+    d_x = np.zeros_like(x)
+    top_pos = 0
+    for b, img in enumerate(_sample_views(x, x_off, rows, cols, in_ch)):
+        col, ty, tx = _im2col_sample(img, kh, kw, sh, sw)
+        n_top = out_ch * ty * tx
+        d_top = og[top_pos:top_pos + n_top].reshape(out_ch, ty * tx)
+        top_pos += n_top
+        if ty * tx == 0:
+            continue
+        d_w += d_top @ col.T
+        d_col = w2.T @ d_top             # [C*kh*kw, ty*tx]
+        # col2im: scatter-add the transpose of the gather
+        h, wdt = rows[b], cols[b]
+        d_img = np.zeros((in_ch, h, wdt), x.dtype)
+        hh, hw = kh // 2, kw // 2
+        for z in range(in_ch):
+            for yi, y in enumerate(range(0, h, sh)):
+                for xi, xx in enumerate(range(0, wdt, sw)):
+                    cidx = yi * tx + xi
+                    for ky in range(kh):
+                        for kx in range(kw):
+                            iy, ix = y + ky - hh, xx + kx - hw
+                            if 0 <= iy < h and 0 <= ix < wdt:
+                                d_img[z, iy, ix] += \
+                                    d_col[z * kh * kw + ky * kw + kx,
+                                          cidx]
+        d_x[x_off[b]:x_off[b + 1]] = d_img.reshape(-1)
+    outs = op.output("X@GRAD")
+    if outs:
+        from ..core.tensor import LoDTensor
+
+        t = LoDTensor(d_x.reshape(-1, 1).astype(np.float32))
+        t.set_lod([list(x_off)])
+        scope.var(outs[0]).set(t)
+    wouts = op.output("W@GRAD")
+    if wouts:
+        executor._write_var(scope, wouts[0], d_w.reshape(w.shape))

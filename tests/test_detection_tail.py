@@ -38,8 +38,7 @@ def test_locality_aware_nms_merges_then_suppresses():
     # merged box: coords weighted (0.8, 0.4) -> (x*0.8 + (x+1)*0.4)/1.2
     merged = rows[rows[:, 1] > 1.0][0]
     np.testing.assert_allclose(merged[1], 1.2, rtol=1e-6)  # score sum
-    np.testing.assert_allclose(merged[2], (0 * 0.4 + 1 * 0.8) / 1.2
-                               if False else (1 * 0.4 + 0 * 0.8) / 1.2,
+    np.testing.assert_allclose(merged[2], (1 * 0.4 + 0 * 0.8) / 1.2,
                                rtol=1e-5)
     lone = rows[np.isclose(rows[:, 1], 0.6)][0]
     np.testing.assert_allclose(lone[2:], [50, 50, 60, 60])
@@ -72,14 +71,15 @@ def test_retinanet_detection_output_decodes_and_keeps():
 
 def test_detection_map_perfect_and_half():
     # class 1: one perfect match; class 2: one hit one miss
-    label = np.array([[1, 10, 10, 20, 20, 0],
-                      [2, 40, 40, 50, 50, 0],
-                      [2, 70, 70, 80, 80, 0]], "float32")
+    label = np.array([[1, 0, 0.10, 0.10, 0.20, 0.20],
+                      [2, 0, 0.40, 0.40, 0.50, 0.50],
+                      [2, 0, 0.70, 0.70, 0.80, 0.80]], "float32")
     lt = LoDTensor(label)
     lt.set_lod([[0, 3]])
-    det = np.array([[1, 0.9, 10, 10, 20, 20],      # TP class 1
-                    [2, 0.8, 40, 40, 50, 50],      # TP class 2
-                    [2, 0.7, 0, 0, 5, 5]], "float32")  # FP class 2
+    det = np.array([[1, 0.9, 0.10, 0.10, 0.20, 0.20],   # TP class 1
+                    [2, 0.8, 0.40, 0.40, 0.50, 0.50],    # TP class 2
+                    [2, 0.7, 0.0, 0.0, 0.05, 0.05]],
+                   "float32")                            # FP class 2
     dt = LoDTensor(det)
     dt.set_lod([[0, 3]])
     out = _run_host(
@@ -99,12 +99,14 @@ def test_detection_map_perfect_and_half():
 
 
 def test_detection_map_accumulates_state():
-    label = np.array([[1, 10, 10, 20, 20, 0]], "float32")
+    label = np.array([[1, 0, 0.10, 0.10, 0.20, 0.20]], "float32")
     lt = LoDTensor(label)
     lt.set_lod([[0, 1]])
-    det_hit = LoDTensor(np.array([[1, 0.9, 10, 10, 20, 20]], "float32"))
+    det_hit = LoDTensor(np.array([[1, 0.9, 0.10, 0.10, 0.20, 0.20]],
+                                 "float32"))
     det_hit.set_lod([[0, 1]])
-    det_miss = LoDTensor(np.array([[1, 0.8, 90, 90, 99, 99]], "float32"))
+    det_miss = LoDTensor(np.array([[1, 0.8, 0.90, 0.90, 0.99, 0.99]],
+                                  "float32"))
     det_miss.set_lod([[0, 1]])
 
     prog = fluid.Program()

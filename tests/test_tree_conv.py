@@ -1,0 +1,126 @@
+"""tree_conv (TBCNN) vs a hand-walked numpy oracle + finite-difference
+gradients (tree_conv_op.cc / math/tree2col.cc)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.ops.tree_ops import (_construct_patch, _construct_tree,
+                                     _etas, _patch_matrix)
+
+
+def _oracle(feats, edges, filt, max_depth):
+    bsz, n_nodes, n_feat = feats.shape
+    out_size, n_filters = filt.shape[2], filt.shape[3]
+    w2 = filt.reshape(n_feat * 3, out_size * n_filters)
+    out = np.zeros((bsz, n_nodes, out_size, n_filters), feats.dtype)
+    for b in range(bsz):
+        patch, _t, count = _patch_matrix(feats[b], edges[b], max_depth)
+        if count:
+            out[b, :count] = (patch @ w2).reshape(count, out_size,
+                                                  n_filters)
+    return out
+
+
+def test_patch_construction_matches_reference_walk():
+    # tree: 1 -> {2, 3}, 2 -> {4}
+    edges = np.array([[1, 2], [1, 3], [2, 4], [0, 0]], "int32")
+    tr, count = _construct_tree(edges)
+    assert count == 4
+    assert tr[1] == [2, 3] and tr[2] == [4]
+    patch = _construct_patch(1, 2, tr)
+    # depth limit 2: root + direct children only
+    assert [p[0] for p in patch] == [1, 2, 3]
+    # root coeffs: index=1 pclen=1 depth=0 -> eta_t=1, eta_l=eta_r=0
+    el, er, et = _etas(1, 1, 0, 2)
+    assert (el, er, et) == (0.0, 0.0, 1.0)
+    # child 1 of 2: index=1 pclen=2 depth=1 -> eta_t=.5, temp=0
+    el, er, et = _etas(1, 2, 1, 2)
+    np.testing.assert_allclose([el, er, et], [0.0, 0.5, 0.5])
+
+
+def test_tree_conv_op_and_grads():
+    rng = np.random.RandomState(3)
+    B, N, F, OUT, NF, DEPTH = 2, 5, 4, 3, 2, 2
+    feats = rng.randn(B, N, F).astype("float32")
+    edges = np.zeros((B, 4, 2), "int32")
+    edges[0, :3] = [[1, 2], [1, 3], [2, 4]]
+    edges[1, :2] = [[1, 2], [2, 3]]
+    filt = rng.randn(F, 3, OUT, NF).astype("float32") * 0.3
+
+    main, startup = fluid.Program(), fluid.Program()
+    b = main.global_block()
+    for n in ("tc_x", "tc_e", "tc_w"):
+        v = b.create_var(name=n)
+        v.stop_gradient = False
+    b.append_op("tree_conv",
+                {"NodesVector": ["tc_x"], "EdgeSet": ["tc_e"],
+                 "Filter": ["tc_w"]},
+                {"Out": ["tc_o"]}, {"max_depth": DEPTH},
+                infer_shape=False)
+    b.create_var(name="tc_o").stop_gradient = False
+    lv = b.create_var(name="tc_loss", shape=(), dtype="float32")
+    lv.stop_gradient = False
+    b.append_op("reduce_sum", {"X": ["tc_o"]}, {"Out": ["tc_loss"]},
+                {"dim": [], "keep_dim": False, "reduce_all": True},
+                infer_shape=False)
+    from paddle_tpu.backward import append_backward
+
+    with fluid.program_guard(main, startup):
+        append_backward(b.var("tc_loss"), parameter_list=["tc_x", "tc_w"])
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(main, feed={"tc_x": feats, "tc_e": edges, "tc_w": filt},
+                fetch_list=[])
+        got = np.asarray(scope.find_var("tc_o").raw().array)
+        gx = np.asarray(scope.find_var("tc_x@GRAD").raw().array)
+        gw = np.asarray(scope.find_var("tc_w@GRAD").raw().array)
+
+    np.testing.assert_allclose(got, _oracle(feats, edges, filt, DEPTH),
+                               rtol=1e-5, atol=1e-6)
+
+    # finite differences on sum(out)
+    def loss(fe, wt):
+        return float(_oracle(fe, edges, wt, DEPTH).sum())
+
+    eps = 1e-3
+    for _ in range(6):
+        i = tuple(rng.randint(0, s) for s in feats.shape)
+        fp = feats.copy().astype("float64")
+        fm = feats.copy().astype("float64")
+        fp[i] += eps
+        fm[i] -= eps
+        fd = (loss(fp.astype("float32"), filt)
+              - loss(fm.astype("float32"), filt)) / (2 * eps)
+        np.testing.assert_allclose(gx[i], fd, rtol=2e-2, atol=1e-3)
+    for _ in range(6):
+        i = tuple(rng.randint(0, s) for s in filt.shape)
+        wp = filt.copy()
+        wm = filt.copy()
+        wp[i] += eps
+        wm[i] -= eps
+        fd = (loss(feats, wp) - loss(feats, wm)) / (2 * eps)
+        np.testing.assert_allclose(gw[i], fd, rtol=2e-2, atol=1e-3)
+
+
+def test_tree_conv_layer():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        nodes = fluid.data(name="tl_x", shape=[1, 6, 4], dtype="float32")
+        edges = fluid.data(name="tl_e", shape=[1, 5, 2], dtype="int32")
+        out = fluid.contrib.layers.tree_conv(nodes, edges,
+                                             output_size=3,
+                                             num_filters=2, max_depth=2)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    e = np.zeros((1, 5, 2), "int32")
+    e[0, :3] = [[1, 2], [1, 3], [3, 4]]
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (o,) = exe.run(main,
+                       feed={"tl_x": rng.randn(1, 6, 4).astype("f4"),
+                             "tl_e": e},
+                       fetch_list=[out])
+    assert np.asarray(o).shape == (1, 6, 3, 2)
+    assert np.isfinite(np.asarray(o)).all()

@@ -1,0 +1,105 @@
+"""var_conv_2d (reference var_conv_2d_op.cc) vs a direct-conv
+oracle and finite-difference gradients."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.backward import append_backward
+from paddle_tpu.core.tensor import LoDTensor
+
+
+def test_var_conv_2d_fwd_and_grads():
+
+    rng = np.random.RandomState(2)
+    in_ch, out_ch, kh, kw = 2, 3, 3, 3
+    rows, cols = [4, 2], [3, 5]
+    x_sizes = [in_ch * h * w for h, w in zip(rows, cols)]
+    x = rng.randn(sum(x_sizes), 1).astype('float32')
+    w = (rng.randn(out_ch, in_ch * kh * kw) * 0.3).astype('float32')
+
+    def mk(arr, lens):
+        t = LoDTensor(arr)
+        t.set_recursive_sequence_lengths([lens])
+        return t
+
+    xt = mk(x, x_sizes)
+    rowt = mk(np.zeros((sum(rows), 1), 'float32'), rows)
+    colt = mk(np.zeros((sum(cols), 1), 'float32'), cols)
+
+    main, startup = fluid.Program(), fluid.Program()
+    b = main.global_block()
+    for n in ("vc_x", "vc_r", "vc_c", "vc_w"):
+        v = b.create_var(name=n); v.stop_gradient = False
+    b.append_op("var_conv_2d",
+                {"X": ["vc_x"], "ROW": ["vc_r"], "COLUMN": ["vc_c"], "W": ["vc_w"]},
+                {"Out": ["vc_o"], "Col": ["vc_col"]},
+                {"InputChannel": in_ch, "OutputChannel": out_ch,
+                 "KernelH": kh, "KernelW": kw, "StrideH": 1, "StrideW": 1},
+                infer_shape=False)
+    b.create_var(name="vc_o").stop_gradient = False
+    lv = b.create_var(name="vc_loss", shape=(), dtype="float32"); lv.stop_gradient = False
+    b.append_op("reduce_sum", {"X": ["vc_o"]}, {"Out": ["vc_loss"]},
+                {"dim": [], "keep_dim": False, "reduce_all": True}, infer_shape=False)
+    with fluid.program_guard(main, startup):
+        append_backward(b.var("vc_loss"), parameter_list=["vc_x", "vc_w"])
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(main, feed={"vc_x": xt, "vc_r": rowt, "vc_c": colt, "vc_w": w}, fetch_list=[])
+        out_v = scope.find_var("vc_o").raw()
+        got = np.asarray(out_v.array).ravel()
+        gx = np.asarray(scope.find_var("vc_x@GRAD").raw().array).ravel()
+        gw = np.asarray(scope.find_var("vc_w@GRAD").raw().array)
+
+    # scipy-free oracle: direct conv with centered kernel zero pad
+    def oracle():
+        outs = []
+        pos = 0
+        for h, wd in zip(rows, cols):
+            img = x.ravel()[pos:pos + in_ch*h*wd].reshape(in_ch, h, wd)
+            pos += in_ch*h*wd
+            o = np.zeros((out_ch, h, wd), 'float32')
+            for oc in range(out_ch):
+                wk = w[oc].reshape(in_ch, kh, kw)
+                for y in range(h):
+                    for xx in range(wd):
+                        acc = 0.0
+                        for z in range(in_ch):
+                            for ky in range(kh):
+                                for kx in range(kw):
+                                    iy, ix = y+ky-kh//2, xx+kx-kw//2
+                                    if 0 <= iy < h and 0 <= ix < wd:
+                                        acc += wk[z, ky, kx]*img[z, iy, ix]
+                        o[oc, y, xx] = acc
+            outs.append(o.reshape(-1))
+        return np.concatenate(outs)
+
+    ref = oracle()
+    assert np.allclose(got, ref, atol=1e-4), "forward mismatch"
+
+    # FD grads
+    def loss_with(x_=None, w_=None):
+        xs, ws = x, w
+        if x_ is not None: xs = x_
+        if w_ is not None: ws = w_
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            e2 = fluid.Executor(fluid.CPUPlace())
+            e2.run(main, feed={"vc_x": mk(xs, x_sizes), "vc_r": rowt,
+                               "vc_c": colt, "vc_w": ws}, fetch_list=[])
+            return float(np.asarray(sc.find_var("vc_loss").raw().array).ravel()[0])
+
+    eps = 1e-2
+    for _ in range(4):
+        i = rng.randint(0, x.shape[0])
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        fd = (loss_with(x_=xp) - loss_with(x_=xm)) / (2*eps)
+        assert abs(gx[i] - fd) < 2e-2, (i, gx[i], fd)
+    for _ in range(4):
+        i = (rng.randint(0, out_ch), rng.randint(0, in_ch*kh*kw))
+        wp = w.copy(); wp[i] += eps
+        wm = w.copy(); wm[i] -= eps
+        fd = (loss_with(w_=wp) - loss_with(w_=wm)) / (2*eps)
+        assert abs(gw[i] - fd) < 2e-2, (i, gw[i], fd)
+
