@@ -212,7 +212,13 @@ def _trace_ops(block, ops, env: Dict, step_seed) -> None:
             else:
                 sid = attrs.get("_fwd_op_id", op._id or 0)
                 ins[RNG_SEED_ATTR] = _op_seed(step_seed, sid)
-        outs = info.fn(ins, attrs)
+        try:
+            outs = info.fn(ins, attrs)
+        except Exception as e:
+            from .enforce import annotate_op_error
+
+            annotate_op_error(e, op, "compiled trace")
+            raise
         for slot in info.outputs:
             names = op.output(slot.name)
             if not names:

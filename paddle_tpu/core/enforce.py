@@ -68,3 +68,39 @@ def enforce_not_none(value, message, error_cls=NotFoundError):
     if value is None:
         raise error_cls(message)
     return value
+
+
+def _cmp_enforce(name, ok):
+    def check(a, b, message="", error_cls=InvalidArgumentError):
+        if not ok(a, b):
+            raise error_cls(
+                "%sExpected %r %s %r." % (message + " " if message
+                                          else "", a, name, b))
+    return check
+
+
+# PADDLE_ENFORCE_EQ family (enforce.h:300+): failures show both sides
+enforce_eq = _cmp_enforce("==", lambda a, b: a == b)
+enforce_ne = _cmp_enforce("!=", lambda a, b: a != b)
+enforce_gt = _cmp_enforce(">", lambda a, b: a > b)
+enforce_ge = _cmp_enforce(">=", lambda a, b: a >= b)
+enforce_lt = _cmp_enforce("<", lambda a, b: a < b)
+enforce_le = _cmp_enforce("<=", lambda a, b: a <= b)
+
+
+def annotate_op_error(exc: BaseException, op, phase: str) -> None:
+    """Append operator context to an in-flight exception, preserving
+    its type and traceback — the reference wraps every kernel failure
+    in EnforceNotMet carrying the op's signature (operator.cc:157
+    catch + exception_holder). Mutating args keeps pytest.raises and
+    user except-clauses working on the original type."""
+    ctx = "\n  [operator %r error during %s; inputs: %s; outputs: %s]" % (
+        getattr(op, "type", "?"), phase,
+        {k: v for k, v in getattr(op, "inputs", {}).items()},
+        {k: v for k, v in getattr(op, "outputs", {}).items()})
+    if exc.args and isinstance(exc.args[0], str):
+        if ctx in exc.args[0]:
+            return  # nested run_op frames annotate once
+        exc.args = (exc.args[0] + ctx,) + exc.args[1:]
+    else:
+        exc.args = exc.args + (ctx,)

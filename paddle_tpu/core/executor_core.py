@@ -131,10 +131,19 @@ class CoreExecutor:
 
     def run_op(self, op, scope: Scope):
         prof = _profiler_module()
-        if prof.is_profiler_enabled():
-            with prof.record_event(op.type):
-                return self._run_op_impl(op, scope)
-        return self._run_op_impl(op, scope)
+        try:
+            if prof.is_profiler_enabled():
+                with prof.record_event(op.type):
+                    return self._run_op_impl(op, scope)
+            return self._run_op_impl(op, scope)
+        except Exception as e:
+            # EnforceNotMet ergonomics (reference operator.cc catch):
+            # every kernel failure carries the op's signature; the
+            # original exception type survives for caller handling
+            from .enforce import annotate_op_error
+
+            annotate_op_error(e, op, "execution")
+            raise
 
     def _run_op_impl(self, op, scope: Scope):
         info = OpInfoMap.instance().get(op.type)

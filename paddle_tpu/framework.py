@@ -238,12 +238,15 @@ class Block:
         if infer_shape:
             try:
                 infer_op_shapes(self, op)
-            except Exception:
+            except Exception as e:
                 if OpInfoMap.instance().has(type):
                     # roll the failed op back out so a caller that
                     # catches the build error isn't left with a
                     # poisoned block that re-raises at exe.run
                     self.ops.pop()
+                    from .core.enforce import annotate_op_error
+
+                    annotate_op_error(e, op, "shape inference")
                     raise
         return op
 

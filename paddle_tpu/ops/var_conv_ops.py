@@ -101,6 +101,7 @@ def _var_conv_2d(executor, op, scope):
     "var_conv_2d_grad",
     inputs=[In("X", no_grad=True), In("ROW", no_grad=True),
             In("COLUMN", no_grad=True), In("W", no_grad=True),
+            In("Col", no_grad=True, dispensable=True),
             In("Out@GRAD", no_grad=True)],
     outputs=[Out("X@GRAD"), Out("W@GRAD")],
     attrs={"InputChannel": 1, "OutputChannel": 1, "StrideH": 1,
@@ -128,11 +129,29 @@ def _var_conv_2d_grad(executor, op, scope):
     cols = _sizes(colv.lod()[0])
     w2 = w.reshape(out_ch, in_ch * kh * kw)
 
+    # reuse the forward's materialized Col when bound (the reference
+    # VarConv2dGradMaker passes it for exactly this reason) instead of
+    # re-running the python im2col loops every backward step
+    col_cached = None
+    col_in = op.input("Col")
+    if col_in:
+        cv = scope.find_var(col_in[0])
+        if cv is not None and cv.is_initialized():
+            col_cached = (np.asarray(cv.raw().array).reshape(-1),
+                          cv.raw().lod()[0])
     d_w = np.zeros_like(w2)
     d_x = np.zeros_like(x)
     top_pos = 0
     for b, img in enumerate(_sample_views(x, x_off, rows, cols, in_ch)):
-        col, ty, tx = _im2col_sample(img, kh, kw, sh, sw)
+        h_b, w_b = rows[b], cols[b]
+        ty = (h_b - 1) // sh + 1 if h_b else 0
+        tx = (w_b - 1) // sw + 1 if w_b else 0
+        if col_cached is not None:
+            flat, coff = col_cached
+            col = flat[coff[b]:coff[b + 1]].reshape(
+                in_ch * kh * kw, ty * tx)
+        else:
+            col, ty, tx = _im2col_sample(img, kh, kw, sh, sw)
         n_top = out_ch * ty * tx
         d_top = og[top_pos:top_pos + n_top].reshape(out_ch, ty * tx)
         top_pos += n_top

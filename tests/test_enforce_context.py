@@ -1,0 +1,50 @@
+"""EnforceNotMet ergonomics (reference platform/enforce.h:261 +
+operator.cc's catch wrapping): failures carry the op signature, and the
+original exception type survives for user handling."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.enforce import (enforce_eq, enforce_ge,
+                                     InvalidArgumentError)
+
+
+def test_enforce_cmp_helpers():
+    enforce_eq(3, 3)
+    enforce_ge(4, 3, "window size check")
+    with pytest.raises(InvalidArgumentError, match="Expected 2 == 3"):
+        enforce_eq(2, 3)
+    with pytest.raises(InvalidArgumentError,
+                       match="window.*Expected 1 >= 3"):
+        enforce_ge(1, 3, "window size check")
+
+
+def test_runtime_error_carries_op_context():
+    """A kernel failure at exe.run names the op and its var bindings,
+    and keeps the original exception type."""
+    prog = fluid.Program()
+    b = prog.global_block()
+    b.create_var(name="ec_x")
+    # squeeze a non-unit axis: the ValueError must mention the op
+    b.append_op("squeeze", {"X": ["ec_x"]}, {"Out": ["ec_o"]},
+                {"axes": [1]}, infer_shape=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(ValueError) as ei:
+            exe.run(prog, feed={"ec_x": np.zeros((2, 3), "f4")},
+                    fetch_list=[])
+    msg = str(ei.value)
+    assert "operator 'squeeze'" in msg
+    assert "ec_x" in msg and "ec_o" in msg
+
+
+def test_build_error_carries_op_context():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data(name="eb_x", shape=[2, 3], dtype="float32")
+        with pytest.raises(ValueError) as ei:
+            fluid.layers.squeeze(x, axes=[1])
+    msg = str(ei.value)
+    assert "operator 'squeeze2'" in msg
+    assert "shape inference" in msg
