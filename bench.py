@@ -73,15 +73,23 @@ def _build_mnist_mlp(batch):
     return main, startup, loss
 
 
-def _time_steps(exe, main, feed, loss, warmup=3, iters=20):
+def _time_steps(exe, main, feed, loss, warmup=3, iters=20, windows=2,
+                window_gap_s=0.0):
     """Timed windows, each HARD-synced by a numpy loss fetch.
 
-    Protocol: two windows of `iters` steps; in a window the first
+    Protocol: `windows` windows of `iters` steps; in a window the first
     iters-1 steps keep results on device and the last step fetches the
     loss to numpy — the d2h is the only sync this remote runtime honors,
     so it is part of the timed window (a ~d2h/iters overestimate of step
     time, i.e. conservative). The faster window is used: d2h cost is
-    variable and only ever inflates a window.
+    variable and only ever inflates a window. ``window_gap_s`` sleeps
+    between windows so a transient tunnel-pool degradation doesn't hit
+    every window (round-3 diagnosis aid).
+
+    Returns (dt, final_loss, diag) where diag records per-window wall
+    times and whether the program took the whole-compile path — the
+    round-3 BERT collapse was a silent interpreter fallback, and this
+    makes any recurrence legible in BENCH json.
     """
     def run_n(n):
         """n-1 device-resident steps + one numpy-fetch step: the final
@@ -95,17 +103,39 @@ def _time_steps(exe, main, feed, loss, warmup=3, iters=20):
         (o,) = exe.run(main, feed=feed, fetch_list=[loss])
         return time.time() - t0, float(np.asarray(o).ravel()[0])
 
+    t_compile = time.time()
     for _ in range(warmup):
         exe.run(main, feed=feed, fetch_list=[loss], return_numpy=False)
     run_n(1)  # sync point + first (expensive) d2h out of the way
-    # two windows, take the fastest (see docstring)
+    t_compile = time.time() - t_compile
     times = []
     final_loss = float("nan")
-    for _ in range(2):
+    for w in range(windows):
+        if w and window_gap_s:
+            time.sleep(window_gap_s)
         t, final_loss = run_n(iters)
         times.append(t)
     dt = min(times) / iters
-    return dt, final_loss
+    # whole_compile must reflect THIS program: _compile_fallbacks alone
+    # misses the untraceable-program path (where the executor never
+    # attempts the compile — the round-3 silent collapse), and is
+    # executor-wide, so it must be keyed by the main program's version
+    from paddle_tpu.core.compiler_engine import (_program_version,
+                                                 untraceable_reasons)
+
+    fb = exe._compile_fallbacks.get(_program_version(main))
+    whole = exe._can_whole_compile(main) and fb is None
+    diag = {
+        "windows_s": [round(t, 3) for t in times],
+        "warmup_s": round(t_compile, 1),
+        "whole_compile": whole,
+    }
+    if not whole:
+        diag["fallback"] = (str(fb)[:200] if fb is not None else
+                            "untraceable: %s" % ", ".join(
+                                untraceable_reasons(
+                                    main.global_block()))[:200])
+    return dt, final_loss, diag
 
 
 def bench_resnet50(batch=128, iters=12, use_bf16=False):
@@ -120,11 +150,12 @@ def bench_resnet50(batch=128, iters=12, use_bf16=False):
         "img": rng.rand(batch, 3, 224, 224).astype("float32"),
         "label": rng.randint(0, 1000, (batch, 1)).astype("int64"),
     })
-    dt, final_loss = _time_steps(exe, main, feed, loss, iters=iters)
+    dt, final_loss, diag = _time_steps(exe, main, feed, loss, iters=iters)
     if not np.isfinite(final_loss):
         raise RuntimeError("resnet50 diverged: loss=%r" % final_loss)
     return {"images_per_sec": batch / dt, "step_ms": dt * 1e3,
-            "batch": batch, "loss": final_loss, "bf16": use_bf16}
+            "batch": batch, "loss": final_loss, "bf16": use_bf16,
+            "diag": diag}
 
 
 def bench_mnist_mlp(batch=512, iters=100):
@@ -138,11 +169,12 @@ def bench_mnist_mlp(batch=512, iters=100):
         "x": rng.rand(batch, 784).astype("float32"),
         "label": rng.randint(0, 10, (batch, 1)).astype("int64"),
     })
-    dt, final_loss = _time_steps(exe, main, feed, loss, iters=iters)
+    dt, final_loss, diag = _time_steps(exe, main, feed, loss, iters=iters)
     if not np.isfinite(final_loss):
         raise RuntimeError("mnist mlp diverged: loss=%r" % final_loss)
     return {"steps_per_sec": 1.0 / dt, "examples_per_sec": batch / dt,
-            "step_ms": dt * 1e3, "batch": batch, "loss": final_loss}
+            "step_ms": dt * 1e3, "batch": batch, "loss": final_loss,
+            "diag": diag}
 
 
 def _build_bert_base(batch, seq_len, use_bf16=False):
@@ -189,13 +221,26 @@ def bench_bert_base(batch=32, seq_len=128, iters=30, use_bf16=True):
         "mpos": rng.randint(0, seq_len, (batch, M)).astype("int64"),
         "labels": rng.randint(0, 30522, (batch, M, 1)).astype("int64"),
     })
-    dt, final_loss = _time_steps(exe, main, feed, loss, warmup=2,
-                                 iters=iters)
+    from paddle_tpu.core.compiler_engine import (block_is_traceable,
+                                                 untraceable_reasons)
+
+    if not block_is_traceable(main.global_block()):
+        # round-3 collapse guard: a single host op (then: `range`) drops
+        # the 1440-op program to op-by-op interpretation, ~30x slow.
+        # Fail loudly rather than record a meaningless number.
+        raise RuntimeError(
+            "bert program not whole-compilable; blockers: %s"
+            % untraceable_reasons(main.global_block()))
+    # three windows, the later ones separated in time — distinguishes a
+    # transient degraded tunnel window from a persistent regression
+    dt, final_loss, diag = _time_steps(exe, main, feed, loss, warmup=2,
+                                       iters=iters, windows=3,
+                                       window_gap_s=5.0)
     if not np.isfinite(final_loss):
         raise RuntimeError("bert diverged: loss=%r" % final_loss)
     return {"tokens_per_sec": batch * seq_len / dt, "step_ms": dt * 1e3,
             "batch": batch, "seq_len": seq_len, "loss": final_loss,
-            "bf16": use_bf16}
+            "bf16": use_bf16, "diag": diag}
 
 
 def _enable_compile_cache():

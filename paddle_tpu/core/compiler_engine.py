@@ -93,23 +93,120 @@ def _op_seed(step_seed, op_id: int):
             + jnp.uint32((op_id * 131) & 0xFFFFFFFF))
 
 
-def block_is_traceable(block) -> bool:
-    """True if every op lowers to pure XLA (recursively through
-    while/conditional_block sub-blocks)."""
+def _fold_plan(block):
+    """Constant-folding analysis over the global block.
+
+    A host op (value-dependent output shape, e.g. ``range`` — reference
+    operators/range_op.cc runs it CPU-side too) would force the whole
+    program onto the op-by-op interpreter. When such an op is marked
+    ``const_foldable`` and its inputs derive transitively from
+    deterministic constant producers (fill_constant chains — not feeds,
+    not scope state, not RNG), the compiler evaluates it ONCE at compile
+    time and embeds the result as an XLA literal, keeping the program on
+    the whole-compile path (partial evaluation, the XLA-idiomatic answer
+    to the reference's host-kernel ops).
+
+    Returns (fold_idxs, needed_idxs, fold_out_names): host-op indices to
+    pre-evaluate + skip in the trace, the pure producer indices their
+    evaluation needs, and the folded output var names.
+    """
     infos = OpInfoMap.instance()
+    writer_count: Dict[str, int] = {}
     for op in block.ops:
+        for n in op.output_arg_names:
+            if n:
+                writer_count[n] = writer_count.get(n, 0) + 1
+        # while/conditional ops are appended with outputs={} but their
+        # sub-blocks write parent vars by name — count those writes, or
+        # a loop-mutated var would classify as a single-writer constant
+        # and a downstream fold would bake in the stale pre-loop value
         sb = op.attrs.get("sub_block")
+        if op.type in ("while", "conditional_block") and sb is not None:
+            for n in _block_rw(sb)[0]:
+                writer_count[n] = writer_count.get(n, 0) + 1
+    static: Dict[str, int] = {}  # var -> producing op index
+    fold_idxs = set()
+    for i, op in enumerate(block.ops):
         if op.type in ("while", "conditional_block"):
-            if sb is None or not block_is_traceable(sb):
-                return False
             continue
         try:
             info = infos.get(op.type)
         except KeyError:
-            return False
-        if info.host_fn is not None or info.needs_lod:
-            return False
-    return True
+            continue
+        const_ok = info.const_foldable and info.host_fn is not None
+        pure = (info.host_fn is None and not info.needs_rng
+                and not info.needs_lod and not info.side_effect)
+        if not (pure or const_ok):
+            continue
+        ins = [n for n in op.input_arg_names if n]
+        outs = [n for n in op.output_arg_names if n]
+        if not outs or any(n not in static for n in ins):
+            continue
+        ok = True
+        for n in outs:
+            v = block._find_var_recursive(n)
+            if writer_count.get(n, 0) != 1 or (
+                    v is not None and getattr(v, "persistable", False)):
+                ok = False
+                break
+        if not ok:
+            continue
+        for n in outs:
+            static[n] = i
+        if const_ok:
+            fold_idxs.add(i)
+    if not fold_idxs:
+        return frozenset(), frozenset(), frozenset()
+    needed = set()
+    stack = [n for i in fold_idxs
+             for n in block.ops[i].input_arg_names if n]
+    while stack:
+        n = stack.pop()
+        i = static.get(n)
+        if i is None or i in needed or i in fold_idxs:
+            continue
+        needed.add(i)
+        stack.extend(m for m in block.ops[i].input_arg_names if m)
+    fold_outs = frozenset(n for i in fold_idxs
+                          for n in block.ops[i].output_arg_names if n)
+    return frozenset(fold_idxs), frozenset(needed), fold_outs
+
+
+def block_is_traceable(block) -> bool:
+    """True if every op lowers to pure XLA (recursively through
+    while/conditional_block sub-blocks). Const-foldable host ops with
+    static inputs don't count against a block (_fold_plan)."""
+    return not untraceable_reasons(block)
+
+
+def untraceable_reasons(block) -> List[str]:
+    """Blocking op types (with reason tags) that keep this block off the
+    whole-compile path — surfaced by the executor's fallback warning so a
+    30x interpreter cliff is never silent."""
+    infos = OpInfoMap.instance()
+    fold_idxs = _fold_plan(block)[0]
+    reasons: List[str] = []
+    for i, op in enumerate(block.ops):
+        sb = op.attrs.get("sub_block")
+        if op.type in ("while", "conditional_block"):
+            if sb is None:
+                reasons.append("%s (no sub_block)" % op.type)
+            else:
+                reasons.extend("%s>%s" % (op.type, r)
+                               for r in untraceable_reasons(sb))
+            continue
+        try:
+            info = infos.get(op.type)
+        except KeyError:
+            reasons.append("%s (unregistered)" % op.type)
+            continue
+        if i in fold_idxs:
+            continue
+        if info.host_fn is not None:
+            reasons.append("%s (host)" % op.type)
+        elif info.needs_lod:
+            reasons.append("%s (lod)" % op.type)
+    return sorted(set(reasons))
 
 
 def _trace_while(block, op, env: Dict, step_seed) -> None:
@@ -182,8 +279,15 @@ def _trace_block(block, env: Dict, step_seed) -> None:
 
 def _trace_ops(block, ops, env: Dict, step_seed) -> None:
     """Trace a specific op sequence (a whole block, or one pipeline
-    stage's slice of it) into the running jax trace."""
+    stage's slice of it) into the running jax trace.
+
+    Const-foldable host ops (range with constant bounds) are
+    pre-evaluated on the host and embedded as XLA literals — applied
+    here, not in a wrapper, so every trace entry point (whole program,
+    data-parallel shard, pipeline stage slice) gets the same treatment.
+    """
     infos = OpInfoMap.instance()
+    fold_vals = None
     for op in ops:
         if op.type == "while":
             _trace_while(block, op, env, step_seed)
@@ -192,6 +296,20 @@ def _trace_ops(block, ops, env: Dict, step_seed) -> None:
             _trace_conditional_block(block, op, env, step_seed)
             continue
         info = infos.get(op.type)
+        if info.host_fn is not None:
+            if fold_vals is None:
+                import jax.numpy as jnp
+
+                fold_vals = {n: jnp.asarray(v)
+                             for n, v in _fold_block_values(block).items()}
+            out_names = [n for n in op.output_arg_names if n]
+            if out_names and all(n in fold_vals for n in out_names):
+                for n in out_names:
+                    env[n] = fold_vals[n]
+                continue
+            raise NotImplementedError(
+                "host op %r cannot be traced (not const-foldable here)"
+                % op.type)
         ins = {}
         for slot in info.inputs:
             names = op.input(slot.name)
@@ -230,6 +348,81 @@ def _trace_ops(block, ops, env: Dict, step_seed) -> None:
             for n, v in zip(names, vals):
                 if n and v is not None:
                     env[n] = v
+
+
+import weakref
+
+_fold_values_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _fold_block_values(block) -> Dict[str, np.ndarray]:
+    """Evaluate the const-foldable subgraph once (host interpreter over a
+    scratch scope) and cache the concrete outputs per block, invalidated
+    by the owning program's version (same fingerprint compile_program
+    keys on — op count alone misses same-count in-place edits)."""
+    prog = getattr(block, "program", None)
+    stamp = (_program_version(prog) if prog is not None
+             else (len(block.ops),))
+    hit = _fold_values_cache.get(block)
+    if hit is not None and hit[0] == stamp:
+        return hit[1]
+    fold_idxs, needed, fold_outs = _fold_plan(block)
+    values: Dict[str, np.ndarray] = {}
+    if fold_idxs:
+        from .executor_core import CoreExecutor
+        from .place import CPUPlace
+
+        # run each op eagerly (info.fn / host_fn directly, no jax.jit),
+        # under ensure_compile_time_eval: _trace_block is usually already
+        # inside an outer jit trace, where any jnp bind would otherwise
+        # produce tracers — np.asarray on those raises.
+        import contextlib
+
+        import jax
+
+        scratch_exe = CoreExecutor(CPUPlace())
+        scratch = Scope()
+        infos = OpInfoMap.instance()
+        ctx = getattr(jax, "ensure_compile_time_eval",
+                      contextlib.nullcontext)
+        with ctx():
+            for i in sorted(needed | fold_idxs):
+                op = block.ops[i]
+                info = infos.get(op.type)
+                if info.host_fn is not None:
+                    info.host_fn(scratch_exe, op, scratch)
+                    continue
+                ins = {}
+                for slot in info.inputs:
+                    names = op.input(slot.name)
+                    if not names:
+                        ins[slot.name] = None
+                        continue
+                    vals = [scratch_exe._read_var(scratch, n)
+                            for n in names]
+                    ins[slot.name] = vals if slot.duplicable else vals[0]
+                attrs = dict(op.attrs)
+                attrs[BOUND_OUTPUTS_ATTR] = tuple(
+                    s.name for s in info.outputs if op.output(s.name))
+                outs = info.fn(ins, attrs)
+                for slot in info.outputs:
+                    names = op.output(slot.name)
+                    o = outs.get(slot.name) if names else None
+                    if o is None:
+                        continue
+                    for n, v in zip(names,
+                                    o if slot.duplicable else [o]):
+                        if n and v is not None:
+                            scratch_exe._write_var(scratch, n, v)
+            for n in fold_outs:
+                var = scratch.find_var(n)
+                if var is not None and var.is_initialized():
+                    values[n] = np.asarray(var.raw().array)
+    try:
+        _fold_values_cache[block] = (stamp, values)
+    except TypeError:  # non-weakrefable block: skip caching
+        pass
+    return values
 
 
 def compile_program(program, feed_names: Tuple[str, ...],

@@ -75,6 +75,7 @@ class OpInfo:
         needs_lod: bool = False,
         side_effect: bool = False,
         host_fn: Optional[Callable] = None,
+        const_foldable: bool = False,
     ):
         self.type = type
         self.fn = fn
@@ -88,6 +89,12 @@ class OpInfo:
         self.needs_lod = needs_lod
         self.side_effect = side_effect  # never DCE'd / not pure (feed, fetch, prints)
         self.host_fn = host_fn  # host-side impl(executor, op, scope); bypasses jit
+        # deterministic host op whose output depends only on its inputs
+        # (e.g. range: output SHAPE is value-dependent, so it must run on
+        # the host — but with compile-time-constant inputs the compiler
+        # engine can evaluate it once and embed the result, keeping the
+        # surrounding program on the whole-compile path)
+        self.const_foldable = const_foldable
 
     def input_slot(self, name) -> Optional[Slot]:
         for s in self.inputs:
@@ -202,7 +209,7 @@ def register_op(
 
 
 def register_host_op(type, inputs, outputs, attrs=None, infer_shape=None,
-                     grad=None):
+                     grad=None, const_foldable=False):
     """Register an op whose implementation runs on the host against the
     Scope (control flow, feed/fetch, printing) — analogue of the
     reference's kernel-less OperatorBase ops."""
@@ -219,6 +226,7 @@ def register_host_op(type, inputs, outputs, attrs=None, infer_shape=None,
             infer_lod=None,
             side_effect=True,
             host_fn=host_fn,
+            const_foldable=const_foldable,
         )
         OpInfoMap.instance().insert(info)
         return host_fn

@@ -125,6 +125,11 @@ class PSServer:
         self._fetch_barriers = 0
         self._round_complete = True   # params servable before round 1
         self._fetches_pending = False  # True between apply and last fetch
+        # per-trainer (seq, response) cache: the client resends after a
+        # reconnect; without dedupe a response lost AFTER server-side
+        # processing would double-apply a grad/barrier in the round
+        self._dedupe: Dict[int, tuple] = {}
+        self._dedupe_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -229,17 +234,66 @@ class PSServer:
 
     # -- socket plumbing --------------------------------------------------
 
+    def _dispatch(self, msg: dict, raw: bytes):
+        """Dedupe + handle one request. The client resends after a
+        reconnect; a resend may arrive (a) after the original completed
+        — return the cached response — or (b) while the original is
+        STILL EXECUTING (it blocked in a barrier wait): wait on its
+        completion event instead of running the handler twice, which
+        would double-count a barrier / double-apply a grad."""
+        tid = msg.get("trainer_id") if isinstance(msg, dict) else None
+        seq = msg.get("seq") if isinstance(msg, dict) else None
+        cid = msg.get("cid") if isinstance(msg, dict) else None
+        if tid is None or seq is None or cid is None:
+            return self._handle(msg, raw)
+        # key includes the client's random nonce: a RESTARTED trainer's
+        # fresh seq=1 must never match its previous incarnation's cache
+        key = (cid, seq)
+        with self._dedupe_lock:
+            cached = self._dedupe.get(tid)
+            if cached is not None and cached[0] == key:
+                ev = cached[1]
+            else:
+                ev = threading.Event()
+                self._dedupe[tid] = (key, ev, None, b"")
+                cached = None
+        if cached is not None:  # duplicate: original owns the handler
+            if not ev.wait(timeout=_ROUND_TIMEOUT):
+                return {"ok": False,
+                        "error": "duplicate request (trainer %s seq %s) "
+                        "still in flight" % (tid, seq)}, b""
+            with self._dedupe_lock:
+                c2 = self._dedupe.get(tid)
+            if c2 is not None and c2[0] == key:
+                return c2[2], c2[3]
+            return {"ok": False, "error": "dedupe entry superseded"}, b""
+        try:
+            resp, rraw = self._handle(msg, raw)
+        except Exception as e:
+            resp, rraw = {"ok": False, "error": "%s: %s"
+                          % (type(e).__name__, e)}, b""
+        with self._dedupe_lock:
+            if self._dedupe.get(tid, (None,))[0] == key:
+                self._dedupe[tid] = (key, ev, resp, rraw)
+        ev.set()
+        return resp, rraw
+
     def _serve_conn(self, conn: socket.socket):
         try:
             while not self._shutdown.is_set():
                 got = _recv_msg(conn)
                 if got is None:
                     return
+                msg, raw = got
+                # catch ANY handler error (malformed message, bad dtype,
+                # missing keys) and reply — a dead connection thread
+                # would leave the client blocked until its own timeout
                 try:
-                    resp, raw = self._handle(*got)
-                except RuntimeError as e:
-                    resp, raw = {"ok": False, "error": str(e)}, b""
-                _send_msg(conn, resp, raw)
+                    resp, rraw = self._dispatch(msg, raw)
+                except Exception as e:
+                    resp, rraw = {"ok": False, "error": "%s: %s"
+                                  % (type(e).__name__, e)}, b""
+                _send_msg(conn, resp, rraw)
         except OSError:
             pass
         finally:
@@ -281,6 +335,12 @@ class PSClient:
         self._timeout = timeout if timeout is not None else float(
             os.environ.get("PADDLE_PS_CONNECT_TIMEOUT", "15"))
         self._io_lock = threading.Lock()
+        self._seq = 0  # per-client sequence: lets the server dedupe the
+        # reconnect-resend in _call (send_grad/barriers are not
+        # idempotent without it). The random client nonce scopes seq so
+        # a RESTARTED trainer's fresh seq=1 never matches a stale cache
+        # entry from its previous incarnation.
+        self._cid = os.urandom(8).hex()
         self._sock = self._connect()
 
     def _connect(self) -> socket.socket:
@@ -289,9 +349,15 @@ class PSClient:
         last: Optional[OSError] = None
         while True:  # the pserver process may still be booting
             try:
-                return socket.create_connection(
+                sock = socket.create_connection(
                     (host or "127.0.0.1", int(port)),
                     timeout=max(self._timeout, 1.0))
+                # reads must BLOCK: a sync barrier legitimately waits on
+                # the slowest trainer (server bounds it by
+                # _ROUND_TIMEOUT and replies an error) — a read timeout
+                # here would trigger reconnect-resend mid-round
+                sock.settimeout(None)
+                return sock
             except OSError as e:
                 last = e
                 if time.time() > deadline:
@@ -325,6 +391,9 @@ class PSClient:
     def _call(self, msg: dict, raw: bytes = b""):
         msg.setdefault("trainer_id", self._trainer_id)
         with self._io_lock:
+            self._seq += 1
+            msg["seq"] = self._seq
+            msg["cid"] = self._cid
             try:
                 _send_msg(self._sock, msg, raw)
                 got = _recv_msg(self._sock)

@@ -113,6 +113,23 @@ class Executor:
         if hit is None:
             hit = block_is_traceable(program.global_block())
             self._traceable_cache[ver] = hit
+            if not hit and len(program.global_block().ops) >= 64:
+                # op-by-op interpretation of a big program is a 10-100x
+                # perf cliff (one device dispatch per op per step) —
+                # never take it silently (round-3 lesson: a single host
+                # `range` op dropped the 1440-op BERT program to the
+                # interpreter and the bench collapsed 30x)
+                import warnings
+
+                from .core.compiler_engine import untraceable_reasons
+
+                warnings.warn(
+                    "program %s (%d ops) is NOT whole-program "
+                    "compilable and will run op-by-op on the "
+                    "interpreter; blocking ops: %s"
+                    % (program._uid, len(program.global_block().ops),
+                       ", ".join(untraceable_reasons(
+                           program.global_block())) or "?"))
         return hit
 
     # -- Dataset-driven training (reference train_from_dataset) -----------

@@ -233,12 +233,24 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                            if is_persistable(v))
             scope = global_scope()
             arrays = []
+            missing = []
             for n in names:
                 var = scope.find_var(n)
                 if var is None or not var.is_initialized():
-                    continue  # same skip as the JSON path's _save_var_dict
+                    missing.append(n)
+                    continue
                 arrays.append((n, np.asarray(var.raw().array)))
             if params_filename:
+                if missing:
+                    # the combined-stream loader reads streams in the
+                    # order of ALL program persistables — silently
+                    # skipping one here shifts every later stream and
+                    # the load fails with an opaque parse error
+                    raise RuntimeError(
+                        "save_inference_model(combined): persistable "
+                        "var(s) %s are not initialized in the scope; "
+                        "run the startup program (or load params) "
+                        "before saving" % ", ".join(missing))
                 proto_format.save_combine(
                     arrays, os.path.join(dirname, params_filename))
             else:

@@ -955,6 +955,7 @@ def _kron(ins, attrs):
     inputs=[In("Start", no_grad=True), In("End", no_grad=True),
             In("Step", no_grad=True)],
     outputs=[Out("Out")],
+    const_foldable=True,
 )
 def _range(executor, op, scope):
     # Output length is value-dependent -> host op (the reference's range

@@ -180,9 +180,13 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
 
     live = _boundary_live_sets(stages, set(feed_names) | set(state))
 
+    # stable mesh identity (device ids + axis names): id(mesh) could be
+    # reused by a new mesh after GC and alias a stale executable
+    mesh_key = (tuple(d.id for d in mesh.devices.flat),
+                tuple(mesh.axis_names))
     key = (_program_version(program), feed_names,
            tuple((n, tuple(v.shape)) for n, v in sorted(feed_vals.items())),
-           tuple(param_names), tuple(sorted(other_state)), id(mesh),
+           tuple(param_names), tuple(sorted(other_state)), mesh_key,
            axis_name, n_micro)
     compiled = _pp_cache.get(key)
     if compiled is None:
@@ -312,14 +316,22 @@ def _build_pipeline_fn(block, stages, live, meta, mesh, axis_name,
 
         def tick(carry, t):
             buf, loss_sum = carry
-            mb = jnp.clip(t - sid, 0, n_micro - 1)
+            mbr = t - sid
+            mb = jnp.clip(mbr, 0, n_micro - 1)
             feeds_t = {
                 n: jax.lax.dynamic_index_in_dim(v, mb, 0, keepdims=False)
                 for n, v in feeds.items()
             }
             seed_t = seed + jnp.uint32(0x9E3779B9) * mb.astype(jnp.uint32)
-            newbuf, loss = jax.lax.switch(sid, branches, buf, feeds_t,
-                                          seed_t, params, other)
+            # fill/drain ticks see a garbage (zero) rotating buffer; the
+            # loss is masked below, but grad through a masked tick still
+            # NaNs when an op has an unbounded derivative at 0 (log,
+            # sqrt, 1/x): zero cotangent x inf Jacobian. A ONES sentinel
+            # keeps those Jacobians finite, so masked cotangents stay 0.
+            is_real_in = (mbr >= 0) & (mbr < n_micro)
+            safe_buf = jnp.where(is_real_in, buf, jnp.ones_like(buf))
+            newbuf, loss = jax.lax.switch(sid, branches, safe_buf,
+                                          feeds_t, seed_t, params, other)
             is_real = ((t - (n_stages - 1) >= 0)
                        & (t - (n_stages - 1) < n_micro))
             loss_sum = loss_sum + jnp.where(is_real, loss, 0.0)

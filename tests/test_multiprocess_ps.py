@@ -163,3 +163,60 @@ def test_two_process_ps_sync_training(tmp_path):
     assert result["losses"][-1] < result["losses"][0]
     # heartbeat monitor saw the trainer
     assert result["heartbeat_trainers"] == [0]
+
+
+def test_rpc_malformed_message_and_dedupe():
+    """Protocol hardening (round-3 advisor findings): a malformed frame
+    gets an {ok: false} reply instead of killing the connection thread,
+    and a resent (duplicate-seq) send_grad is applied exactly once."""
+    import socket as _socket
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed import ps_rpc
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe._core._write_var(scope, "w", np.zeros(2, "float32"))
+
+    endpoint = "127.0.0.1:%d" % _free_port()
+    server = PSServer(endpoint, exe._core, scope, {}, fanin=1,
+                      sync_mode=True)
+    server.start_background()
+    PSClient.reset()
+    try:
+        host, port = endpoint.rsplit(":", 1)
+        conn = _socket.create_connection((host, int(port)), timeout=10)
+        # malformed: no 'kind' key — must get an error REPLY, and the
+        # connection must stay usable for the next request
+        ps_rpc._send_msg(conn, {"bogus": 1})
+        resp, _ = ps_rpc._recv_msg(conn)
+        assert resp["ok"] is False
+        # duplicate seq: sync mode buffers pending grads and the
+        # barrier SUMS them — a re-applied resend would double the sum
+        g = np.ones(2, "float32")
+        msg = {"kind": "send_grad", "name": "w@GRAD", "trainer_id": 5,
+               "seq": 1, "cid": "aa", "array": ps_rpc._array_header(g)}
+        for _ in range(2):
+            ps_rpc._send_msg(conn, dict(msg), g.tobytes())
+            resp, _ = ps_rpc._recv_msg(conn)
+            assert resp["ok"] is True
+        # a restarted client (new cid) reusing seq=1 must NOT dedupe
+        msg2 = dict(msg, cid="bb",
+                    array=ps_rpc._array_header(g))
+        ps_rpc._send_msg(conn, msg2, g.tobytes())
+        resp, _ = ps_rpc._recv_msg(conn)
+        assert resp["ok"] is True
+        ps_rpc._send_msg(conn, {"kind": "send_barrier", "trainer_id": 5,
+                                "seq": 2, "cid": "bb"})
+        resp, _ = ps_rpc._recv_msg(conn)
+        assert resp["ok"] is True
+        conn.close()
+        # barrier summed: one copy from cid=aa (deduped) + one from the
+        # "restarted" cid=bb client = 2g
+        np.testing.assert_allclose(
+            np.asarray(exe._core._read_var(scope, "w@GRAD")), 2 * g)
+        c = PSClient(endpoint, trainer_id=9)
+        c.shutdown_server()
+    finally:
+        PSClient.reset()
