@@ -15,7 +15,25 @@ from ..base.fleet_base import DistributedOptimizer, Fleet
 
 
 class DistributedStrategy:
-    """Knobs (reference DistributedStrategy extends BuildStrategy)."""
+    """Knobs (reference DistributedStrategy extends BuildStrategy).
+
+    Hybrid-parallelism knobs (the axes the reference lacks — SURVEY §2.5
+    "NOT present" row — designed here as program-rewrite passes over the
+    same transpiler pattern, transpiler/collective.py:92-131):
+
+    - ``sharded_embedding`` (+ ``mp_degree``): every embedding table is
+      row-sharded over an 'mp' mesh axis (pslib sparse-PS replacement).
+    - ``sequence_parallel`` (+ ``sp_degree``, ``feed_shard_specs``):
+      attention runs ring attention over an 'sp' axis for long context;
+      feed_shard_specs declares feed layouts, e.g.
+      {"x": ("dp", None, "sp")}.
+    - ``expert_parallel`` (+ ``ep_degree``): MoE experts are sharded
+      over an 'ep' axis, tokens routed by two all_to_alls.
+
+    The rewritten program still runs densely on one device (ops fall
+    back to exact dense math), which is how the driver checks mesh-vs-
+    single-device parity through `exe.run`.
+    """
 
     def __init__(self):
         self.build_strategy = BuildStrategy()
@@ -27,6 +45,14 @@ class DistributedStrategy:
         self.recompute_checkpoints = []
         self.use_amp = False
         self.amp_loss_scaling = 1.0
+        # hybrid parallelism
+        self.sharded_embedding = False
+        self.mp_degree = 1
+        self.sequence_parallel = False
+        self.sp_degree = 1
+        self.feed_shard_specs = {}
+        self.expert_parallel = False
+        self.ep_degree = 1
 
 
 class Collective(Fleet):
@@ -82,11 +108,29 @@ class CollectiveOptimizer(DistributedOptimizer):
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        from ....parallel.transpiler import (insert_allreduce_ops,
-                                             insert_local_sgd_ops)
+        from ....parallel.transpiler import (apply_expert_parallel,
+                                             apply_sequence_parallel,
+                                             apply_sharded_embedding,
+                                             insert_allreduce_ops,
+                                             insert_local_sgd_ops,
+                                             shard_optimizer_state)
 
         opt = self._optimizer
         strategy = self._strategy
+        program = loss.block.program
+        # hybrid rewrites run BEFORE backward generation so
+        # append_backward differentiates through the collective ops
+        # (auto-VJP), not the dense originals
+        if getattr(strategy, "sharded_embedding", False):
+            apply_sharded_embedding(program, "mp",
+                                    int(strategy.mp_degree or 0))
+        if getattr(strategy, "sequence_parallel", False):
+            apply_sequence_parallel(
+                program, "sp",
+                feed_specs=getattr(strategy, "feed_shard_specs", None))
+        if getattr(strategy, "expert_parallel", False):
+            apply_expert_parallel(program, "ep",
+                                  int(strategy.ep_degree or 1))
         if getattr(strategy, "use_amp", False):
             from ....contrib import mixed_precision as mp
 
@@ -98,11 +142,21 @@ class CollectiveOptimizer(DistributedOptimizer):
             opt._set_checkpoints(strategy.recompute_checkpoints)
         optimize_ops, params_grads = opt.minimize(
             loss, startup_program, parameter_list, no_grad_set)
+        shard_optimizer_state(program)
 
-        program = loss.block.program
         nranks = self._fleet.worker_num() if self._fleet else 1
         if nranks > 1:
-            insert_allreduce_ops(program, nranks)
+            # skip only grads sharded over a DATA axis (their collective
+            # transposes already total every shard) — a grad sharded
+            # over an orthogonal model axis still needs the dp allreduce
+            skip_axes = getattr(program, "_allreduce_skip_grads",
+                                None) or {}
+            data_axes = set(getattr(program, "_data_axes", None)
+                            or ("dp",))
+            insert_allreduce_ops(
+                program, nranks,
+                skip_grads={g for g, axes in skip_axes.items()
+                            if set(axes) & data_axes})
             if getattr(strategy, "use_local_sgd", False):
                 insert_local_sgd_ops(program, nranks,
                                      strategy.local_sgd_k_steps)

@@ -955,3 +955,51 @@ __all__ += ["sequence_slice", "sequence_unpad", "im2sequence",
             "grid_sampler", "soft_relu", "Print", "gather_tree",
             "random_crop", "spectral_norm", "data_norm", "center_loss",
             "tensor_array_to_tensor", "adaptive_pool3d"]
+
+
+def flash_attention(q, k, v, causal=False, scale=0.0):
+    """Fused attention over [B, H, S, D] (the multihead hot path —
+    reference fused/multihead_matmul_op.cu). Lowers to the Pallas flash
+    kernel on TPU; ``apply_sequence_parallel`` rewrites it to ring
+    attention over an 'sp' mesh axis for long-context training."""
+    helper = LayerHelper("flash_attention", input=q)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        "flash_attention", inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"causal": bool(causal), "scale": float(scale)})
+    return out
+
+
+def switch_moe(input, num_experts, hidden_dim, capacity_factor=1.0,
+               num_groups=1, param_attr=None, name=None):
+    """Switch-routed mixture-of-experts FFN over [T, D] tokens: top-1
+    gating with fixed per-expert capacity (overflow dropped, GShard /
+    Switch-Transformer semantics). The reference snapshot has no MoE;
+    this is the Program surface that ``apply_expert_parallel`` shards
+    over an 'ep' mesh axis (experts device-local, two all_to_alls route
+    token slots — parallel/moe.py)."""
+    helper = LayerHelper("moe", input=input, param_attr=param_attr,
+                         name=name)
+    dtype = helper.input_dtype()
+    d = int(input.shape[-1])
+    gate_w = helper.create_parameter(
+        attr=helper.param_attr, shape=[d, num_experts], dtype=dtype)
+    w_in = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_experts, d, hidden_dim],
+        dtype=dtype)
+    w_out = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_experts, hidden_dim, d],
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "moe",
+        inputs={"X": [input], "GateW": [gate_w], "WIn": [w_in],
+                "WOut": [w_out]},
+        outputs={"Out": [out]},
+        attrs={"shard_axis": "", "num_groups": int(num_groups),
+               "capacity_factor": float(capacity_factor)})
+    return out
+
+
+__all__ += ["flash_attention", "switch_moe"]

@@ -37,3 +37,4 @@ from . import gap_ops  # noqa: F401
 from . import detection_tail_ops  # noqa: F401
 from . import tree_ops  # noqa: F401
 from . import var_conv_ops  # noqa: F401
+from . import hybrid_parallel_ops  # noqa: F401

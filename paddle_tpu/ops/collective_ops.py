@@ -52,6 +52,34 @@ def axis_for_ring(ring_id: int) -> Optional[str]:
     return _ACTIVE_RING_AXES.get(ring_id, _ACTIVE_RING_AXES.get(-1))
 
 
+# mesh axis names live in the current mapped trace — lets hybrid-parallel
+# ops (sharded lookup / ring attention / MoE) pick their parallel path
+# inside the mesh engine and their exact dense fallback everywhere else
+_ACTIVE_MESH_AXES: set = set()
+
+
+class mesh_axes_guard:
+    """Context manager set by the mesh engine while tracing under
+    shard_map: declares which named axes are live."""
+
+    def __init__(self, axes):
+        self.axes = set(axes or ())
+
+    def __enter__(self):
+        self._saved = set(_ACTIVE_MESH_AXES)
+        _ACTIVE_MESH_AXES.update(self.axes)
+        return self
+
+    def __exit__(self, *exc):
+        _ACTIVE_MESH_AXES.clear()
+        _ACTIVE_MESH_AXES.update(self._saved)
+        return False
+
+
+def mesh_axis_active(name: Optional[str]) -> bool:
+    return bool(name) and name in _ACTIVE_MESH_AXES
+
+
 def _allreduce(name, reducer):
     @register_op(
         name,

@@ -28,8 +28,9 @@ from ..core.compiler_engine import _analyze, _program_version, _trace_block
 from ..core.registry import BOUND_OUTPUTS_ATTR
 from ..core.scope import Scope
 from ..core.tensor import LoDTensor
-from ..ops.collective_ops import ring_axis_guard
-from .mesh_utils import default_mesh, shard_map_compat as _shard_map
+from ..ops.collective_ops import mesh_axes_guard, ring_axis_guard
+from .mesh_utils import (default_mesh, mesh_key as _mesh_key,
+                         shard_map_compat as _shard_map)
 from .transpiler import insert_allreduce_ops
 
 _dp_cache: Dict = {}
@@ -46,7 +47,13 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
                       fetch_list: Sequence, loss_name=None, places=None,
                       build_strategy=None, return_numpy=True,
                       mesh=None, axis_name="dp"):
-    """Single-process: `feed` carries the FULL batch, sharded by the
+    """Mesh execution of a (transpiled) Program — data parallelism by
+    default, and the hybrid axes when the program carries shard metadata
+    from the fleet transpiler passes (_var_shard_specs / _feed_shard_specs
+    / _data_axes: sharded embedding over 'mp', ring attention over 'sp',
+    expert parallelism over 'ep').
+
+    Single-process: `feed` carries the FULL batch, sharded by the
     mesh. Multi-process (the mesh spans jax processes — the reference's
     NCCL2 multi-trainer mode): each process passes its OWN batch shard,
     assembled into a global array via
@@ -54,21 +61,52 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     are read back from the locally-addressable replica."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    if mesh is None and isinstance(places, Mesh):
+        mesh = places  # CompiledProgram.with_data_parallel(places=mesh)
+        places = None
     mesh = mesh or default_mesh(len(places) if places else None, axis_name)
     nranks = int(np.prod(list(mesh.shape.values())))
     multiproc = _mesh_spans_processes(mesh)
 
+    # hybrid-parallel metadata recorded by the transpiler passes
+    shard_specs = dict(getattr(program, "_var_shard_specs", None) or {})
+    feed_specs = dict(getattr(program, "_feed_shard_specs", None) or {})
+    mesh_axes = set(mesh.axis_names)
+    data_axes = tuple(a for a in (getattr(program, "_data_axes", None)
+                                  or (axis_name,)) if a in mesh_axes)
+    if not data_axes:
+        data_axes = (mesh.axis_names[0],)
+    for n, spec in list(shard_specs.items()) + list(feed_specs.items()):
+        for a in spec:
+            if a is not None and a not in mesh_axes:
+                raise ValueError(
+                    "var %r sharded over axis %r absent from mesh axes %s"
+                    % (n, a, sorted(mesh_axes)))
+    if multiproc and (shard_specs or feed_specs):
+        raise NotImplementedError(
+            "hybrid shard specs over a multi-process mesh")
+    data_nranks = int(np.prod([mesh.shape[a] for a in data_axes]))
+
     sync_bn = bool(build_strategy is not None and getattr(
         build_strategy, "sync_batch_norm", False))
     # collective rewrite (insert_allreduce_ops is itself idempotent
-    # per program — fleet may have transpiled already)
+    # per program — fleet may have transpiled already). Loss/grad
+    # scaling is over the DATA axes only: model-parallel axes see the
+    # same batch and their sharded grads are already complete.
     if nranks > 1:
-        insert_allreduce_ops(program, nranks)
+        skip_axes = getattr(program, "_allreduce_skip_grads", None) or {}
+        insert_allreduce_ops(
+            program, data_nranks,
+            skip_grads={g for g, axes in skip_axes.items()
+                        if set(axes) & set(data_axes)})
         from .transpiler import mark_sync_batch_norm
 
         mark_sync_batch_norm(program, sync_bn)
+
+    ring_val = data_axes if len(data_axes) > 1 else data_axes[0]
+    default_feed_spec = (data_axes[0],)
 
     fetch_names = tuple(f if isinstance(f, str) else f.name
                         for f in fetch_list)
@@ -106,26 +144,33 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     out_state_names = tuple(sorted(set(state_names) | persist_written))
 
     key = (_program_version(program), feed_names, fetch_names, state_names,
-           out_state_names, id(mesh), axis_name, sync_bn)
+           out_state_names, _mesh_key(mesh), data_axes, sync_bn,
+           tuple(sorted((k, v) for k, v in shard_specs.items())),
+           tuple(sorted((k, v) for k, v in feed_specs.items())))
     fn = _dp_cache.get(key)
     if fn is None:
         def shard_step(state_d, feeds_d, seed):
-            with ring_axis_guard({0: axis_name, -1: axis_name}):
+            with ring_axis_guard({0: ring_val, -1: ring_val}), \
+                    mesh_axes_guard(mesh_axes):
                 env = dict(state_d)
                 env.update(feeds_d)
                 _trace_block(block, env, seed)
                 fetches = [
-                    jax.lax.all_gather(env[n], axis_name) for n in fetch_names
+                    jax.lax.all_gather(env[n], data_axes)
+                    for n in fetch_names
                 ]
                 new_state = {n: env[n] for n in out_state_names if n in env}
                 return fetches, new_state
 
         mapped = _shard_map(
             shard_step, mesh,
-            in_specs=({n: P() for n in state_names},
-                      {n: P(axis_name) for n in feed_names}, P()),
+            in_specs=({n: P(*shard_specs.get(n, ()))
+                       for n in state_names},
+                      {n: P(*feed_specs.get(n, default_feed_spec))
+                       for n in feed_names}, P()),
             out_specs=([P() for _ in fetch_names],
-                       {n: P() for n in out_state_names}),
+                       {n: P(*shard_specs.get(n, ()))
+                        for n in out_state_names}),
         )
         fn = jax.jit(mapped, donate_argnums=(0,))
         _dp_cache[key] = fn

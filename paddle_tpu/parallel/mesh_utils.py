@@ -26,6 +26,14 @@ def default_mesh(num_devices: Optional[int] = None, axis_name: str = "dp"):
     return make_mesh([n], [axis_name], devs)
 
 
+def mesh_key(mesh) -> Tuple:
+    """Stable mesh identity for executable-cache keys: id(mesh) can be
+    reused by a new mesh after GC and alias a stale executable compiled
+    for different devices."""
+    return (tuple(d.id for d in mesh.devices.flat),
+            tuple(mesh.axis_names))
+
+
 def shard_map_compat(f, mesh, in_specs, out_specs):
     """shard_map across JAX versions: new jax.shard_map(check_vma=...)
     with fallback to jax.experimental.shard_map(check_rep=...)."""

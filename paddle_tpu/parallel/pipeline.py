@@ -180,13 +180,11 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
 
     live = _boundary_live_sets(stages, set(feed_names) | set(state))
 
-    # stable mesh identity (device ids + axis names): id(mesh) could be
-    # reused by a new mesh after GC and alias a stale executable
-    mesh_key = (tuple(d.id for d in mesh.devices.flat),
-                tuple(mesh.axis_names))
+    from .mesh_utils import mesh_key
+
     key = (_program_version(program), feed_names,
            tuple((n, tuple(v.shape)) for n, v in sorted(feed_vals.items())),
-           tuple(param_names), tuple(sorted(other_state)), mesh_key,
+           tuple(param_names), tuple(sorted(other_state)), mesh_key(mesh),
            axis_name, n_micro)
     compiled = _pp_cache.get(key)
     if compiled is None:
