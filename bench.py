@@ -243,6 +243,147 @@ def bench_bert_base(batch=32, seq_len=128, iters=30, use_bf16=True):
             "bf16": use_bf16, "diag": diag}
 
 
+def _build_transformer_wmt(batch, seq_len, use_bf16=False):
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    V = 32000
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.data(name="src", shape=[batch, seq_len], dtype="int64")
+        spos = fluid.data(name="spos", shape=[batch, seq_len],
+                          dtype="int64")
+        tgt = fluid.data(name="tgt", shape=[batch, seq_len], dtype="int64")
+        tpos = fluid.data(name="tpos", shape=[batch, seq_len],
+                          dtype="int64")
+        lbl = fluid.data(name="lbl", shape=[batch, seq_len, 1],
+                         dtype="int64")
+        logits = models.transformer_wmt(src, spos, tgt, tpos,
+                                        vocab_size=V, max_len=seq_len)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.reshape(logits, [batch * seq_len, V]),
+            fluid.layers.reshape(lbl, [batch * seq_len, 1])))
+        opt = fluid.optimizer.AdamOptimizer(1e-4)
+        if use_bf16:
+            try:
+                from paddle_tpu.contrib import mixed_precision as mp
+            except ImportError:
+                use_bf16 = False
+            else:
+                opt = mp.decorate(opt)
+        opt.minimize(loss)
+    return main, startup, loss, V, use_bf16
+
+
+def bench_transformer_wmt(batch=64, seq_len=64, iters=10, use_bf16=True):
+    """North-star config 4 (Transformer-base WMT seq2seq — reference
+    tests/unittests/dist_transformer.py). Metric: target tokens/sec."""
+    import paddle_tpu as fluid
+
+    main, startup, loss, V, use_bf16 = _build_transformer_wmt(
+        batch, seq_len, use_bf16)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    pos = np.tile(np.arange(seq_len), (batch, 1)).astype("int64")
+    feed = _device_feed({
+        "src": rng.randint(0, V, (batch, seq_len)).astype("int64"),
+        "spos": pos, "tpos": pos,
+        "tgt": rng.randint(0, V, (batch, seq_len)).astype("int64"),
+        "lbl": rng.randint(0, V, (batch, seq_len, 1)).astype("int64"),
+    })
+    dt, final_loss, diag = _time_steps(exe, main, feed, loss, warmup=2,
+                                       iters=iters)
+    if not np.isfinite(final_loss):
+        raise RuntimeError("transformer diverged: loss=%r" % final_loss)
+    return {"tokens_per_sec": batch * seq_len / dt, "step_ms": dt * 1e3,
+            "batch": batch, "seq_len": seq_len, "loss": final_loss,
+            "bf16": use_bf16, "diag": diag}
+
+
+def _build_wide_deep(batch):
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    V, S, DD = 100000, 26, 13  # criteo-ish: 26 sparse slots, 13 dense
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dense = fluid.data(name="dense", shape=[batch, DD],
+                           dtype="float32")
+        sparse = fluid.data(name="sparse", shape=[batch, S],
+                            dtype="int64")
+        label = fluid.data(name="label", shape=[batch, 1], dtype="int64")
+        pred = models.wide_deep(dense, sparse, vocab_size=V)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    return main, startup, loss, V, S, DD
+
+
+def bench_wide_deep(batch=2048, iters=40):
+    """North-star config 5 (Wide&Deep CTR — reference dist_ctr.py).
+    Metric: examples/sec."""
+    import paddle_tpu as fluid
+
+    main, startup, loss, V, S, DD = _build_wide_deep(batch)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = _device_feed({
+        "dense": rng.rand(batch, DD).astype("float32"),
+        "sparse": rng.randint(0, V, (batch, S)).astype("int64"),
+        "label": rng.randint(0, 2, (batch, 1)).astype("int64"),
+    })
+    dt, final_loss, diag = _time_steps(exe, main, feed, loss, iters=iters)
+    if not np.isfinite(final_loss):
+        raise RuntimeError("wide_deep diverged: loss=%r" % final_loss)
+    return {"examples_per_sec": batch / dt, "step_ms": dt * 1e3,
+            "batch": batch, "loss": final_loss, "diag": diag}
+
+
+def bench_dygraph_mlp(batch=256, iters=30):
+    """Eager-mode bench through dygraph/tracer.py (the reference's
+    imperative Tracer::TraceOp hot path, imperative/tracer.cc:45) —
+    records per-op eager dispatch cost, which whole-program numbers
+    hide. Metric: steps/sec (an MLP is ~10 traced ops + backward +
+    optimizer per step)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.dygraph import Linear, to_variable
+
+    with fluid.dygraph.guard():
+        l1 = Linear(784, 256, act="relu")
+        l2 = Linear(256, 256, act="relu")
+        l3 = Linear(256, 10)
+        params = l1.parameters() + l2.parameters() + l3.parameters()
+        opt = fluid.optimizer.AdamOptimizer(1e-3, parameter_list=params)
+        rng = np.random.RandomState(0)
+        x = rng.rand(batch, 784).astype("float32")
+        y = rng.randint(0, 10, (batch, 1)).astype("int64")
+
+        def step():
+            logits = l3(l2(l1(to_variable(x))))
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    logits, to_variable(y)))
+            loss.backward()
+            opt.minimize(loss, parameter_list=params)
+            for p in params:
+                p.clear_gradient()
+            return loss
+
+        for _ in range(3):
+            loss = step()
+        float(np.asarray(loss.numpy()).ravel()[0])  # sync
+        t0 = time.time()
+        for _ in range(iters):
+            loss = step()
+        final_loss = float(np.asarray(loss.numpy()).ravel()[0])  # sync
+        dt = (time.time() - t0) / iters
+    if not np.isfinite(final_loss):
+        raise RuntimeError("dygraph mlp diverged: loss=%r" % final_loss)
+    return {"steps_per_sec": 1.0 / dt, "examples_per_sec": batch / dt,
+            "step_ms": dt * 1e3, "batch": batch, "loss": final_loss}
+
+
 def _enable_compile_cache():
     """Persistent on-disk XLA compilation cache: the BERT program's
     compile (~minutes through the tunnel) dominated round-2's subprocess
@@ -268,6 +409,12 @@ def _run_one(name, use_bf16):
         print(json.dumps(bench_mnist_mlp()))
     elif name == "bert_base":
         print(json.dumps(bench_bert_base(use_bf16=use_bf16)))
+    elif name == "transformer_wmt":
+        print(json.dumps(bench_transformer_wmt(use_bf16=use_bf16)))
+    elif name == "wide_deep":
+        print(json.dumps(bench_wide_deep()))
+    elif name == "dygraph_mlp":
+        print(json.dumps(bench_dygraph_mlp()))
     elif name == "resnet50":
         rn = bench_resnet50(use_bf16=use_bf16)
         # ResNet-50 train step ~= 3x fwd FLOPs; fwd ~= 4.1 GFLOP/img @224
@@ -288,8 +435,9 @@ def _bench_subprocess(name, use_bf16):
     args = [sys.executable, __file__, "--model=" + name]
     if not use_bf16:
         args.append("--no-bf16")
-    timeout = {"resnet50": 360, "bert_base": 600,
-               "mnist_mlp": 120}.get(name, 60)
+    timeout = {"resnet50": 360, "bert_base": 600, "mnist_mlp": 120,
+               "transformer_wmt": 480, "wide_deep": 240,
+               "dygraph_mlp": 240}.get(name, 60)
     proc = subprocess.run(args, capture_output=True, text=True,
                           timeout=timeout)
     if proc.returncode != 0:
@@ -351,6 +499,18 @@ def main():
             print("bert bench failed: %r" % e, file=sys.stderr)
     if rn is not None:
         extras["resnet50"] = rn
+    # north-star configs 4/5 + the eager path — budget-gated so the
+    # headline models always record first
+    for extra_model in ("wide_deep", "dygraph_mlp", "transformer_wmt"):
+        if time.time() - t_start > budget_s:
+            extras[extra_model + "_skipped"] = "time budget exhausted"
+            continue
+        try:
+            extras[extra_model] = _bench_subprocess(extra_model, use_bf16)
+        except Exception as e:
+            extras[extra_model + "_error"] = repr(e)
+            print("%s bench failed: %r" % (extra_model, e),
+                  file=sys.stderr)
     extras["wall_s"] = time.time() - t_start
     try:
         import jax

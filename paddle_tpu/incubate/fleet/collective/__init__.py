@@ -126,7 +126,7 @@ class CollectiveOptimizer(DistributedOptimizer):
                                     int(strategy.mp_degree or 0))
         if getattr(strategy, "sequence_parallel", False):
             apply_sequence_parallel(
-                program, "sp",
+                program, "sp", int(strategy.sp_degree or 0),
                 feed_specs=getattr(strategy, "feed_shard_specs", None))
         if getattr(strategy, "expert_parallel", False):
             apply_expert_parallel(program, "ep",

@@ -13,4 +13,5 @@ from .transformer import (  # noqa: F401
     encoder_layer,
     multi_head_attention,
     transformer_encoder,
+    transformer_wmt,
 )

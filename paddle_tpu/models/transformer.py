@@ -19,21 +19,27 @@ def _dense(x, size, act=None, name=None):
 
 
 def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
-                         is_test=False, attn_bias=None):
-    """Self-attention over [B, T, D]. ``attn_bias`` is an additive mask
-    broadcastable to [B, H, T, T] (the reference's src_slf_attn_bias:
-    0 for visible positions, a large negative value for padding)."""
+                         is_test=False, attn_bias=None, kv_in=None):
+    """Attention over [B, T, D]: self-attention by default, or
+    encoder-decoder cross attention when ``kv_in`` (the encoder output,
+    [B, T_src, D]) is given. ``attn_bias`` is an additive mask
+    broadcastable to [B, H, T_q, T_kv] (the reference's
+    src_slf_attn_bias: 0 for visible positions, a large negative value
+    for masked ones — padding or causal)."""
     B, T, D = q_in.shape
+    kv = q_in if kv_in is None else kv_in
+    T_kv = kv.shape[1]
     head = d_model // num_heads
     q = _dense(q_in, d_model)
-    k = _dense(q_in, d_model)
-    v = _dense(q_in, d_model)
+    k = _dense(kv, d_model)
+    v = _dense(kv, d_model)
 
-    def split_heads(x):
-        x = layers.reshape(x, [B, T, num_heads, head])
-        return layers.transpose(x, [0, 2, 1, 3])  # [B, H, T, head]
+    def split_heads(x, t):
+        x = layers.reshape(x, [B, t, num_heads, head])
+        return layers.transpose(x, [0, 2, 1, 3])  # [B, H, t, head]
 
-    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    q = split_heads(q, T)
+    k, v = split_heads(k, T_kv), split_heads(v, T_kv)
     if attn_bias is None and is_test:
         # inference with no mask -> the flash path (pallas kernel on
         # TPU: the T x T score matrix never hits HBM). Training keeps
@@ -124,3 +130,53 @@ def bert_base_pretrain(src_ids, pos_ids, masked_positions, vocab_size=30522,
     picked = layers.gather(flat, gather_idx)  # [B*M, D]
     logits = layers.fc(picked, size=vocab_size, num_flatten_dims=1)
     return layers.reshape(logits, [B, M, vocab_size])
+
+
+def decoder_layer(y, enc, num_heads, d_model, d_ff, dropout=0.0,
+                  is_test=False, self_bias=None, cross_bias=None):
+    """Post-LN decoder block: causal self-attention, encoder-decoder
+    cross attention, FFN (reference dist_transformer.py decoder stack)."""
+    sa = multi_head_attention(y, num_heads, d_model, dropout, is_test,
+                              self_bias)
+    y = layers.layer_norm(layers.elementwise_add(y, sa),
+                          begin_norm_axis=2)
+    ca = multi_head_attention(y, num_heads, d_model, dropout, is_test,
+                              cross_bias, kv_in=enc)
+    y = layers.layer_norm(layers.elementwise_add(y, ca),
+                          begin_norm_axis=2)
+    ff = _dense(y, d_ff, act="gelu")
+    ff = _dense(ff, d_model)
+    return layers.layer_norm(layers.elementwise_add(y, ff),
+                             begin_norm_axis=2)
+
+
+def _causal_bias(T, dtype="float32"):
+    """Additive causal mask [1, 1, T, T]: 0 on/below the diagonal,
+    -1e9 above (future positions)."""
+    import numpy as np
+
+    m = np.triu(np.full((T, T), -1e9, dtype=dtype), k=1)
+    return layers.assign(m.reshape(1, 1, T, T))
+
+
+def transformer_wmt(src_ids, src_pos, tgt_ids, tgt_pos, vocab_size,
+                    max_len=256, num_layers=6, num_heads=8, d_model=512,
+                    d_ff=2048, dropout=0.0, is_test=False):
+    """Transformer-base seq2seq (WMT north-star config 4 — reference
+    tests/unittests/dist_transformer.py): encoder stack over source
+    tokens, decoder stack with causal self-attention + cross attention,
+    projection to target vocab logits [B, T_tgt, V]."""
+    enc = transformer_encoder(src_ids, src_pos, vocab_size, max_len,
+                              num_layers, num_heads, d_model, d_ff,
+                              dropout, is_test)
+    emb = layers.embedding(tgt_ids, size=[vocab_size, d_model])
+    pos = layers.embedding(tgt_pos, size=[max_len, d_model])
+    y = layers.layer_norm(layers.elementwise_add(emb, pos),
+                          begin_norm_axis=2)
+    B, T, _ = y.shape
+    self_bias = _causal_bias(int(T))
+    for _ in range(num_layers):
+        y = decoder_layer(y, enc, num_heads, d_model, d_ff, dropout,
+                          is_test, self_bias=self_bias)
+    logits = layers.fc(y, size=vocab_size, num_flatten_dims=2)
+    return logits

@@ -76,7 +76,14 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     mesh_axes = set(mesh.axis_names)
     data_axes = tuple(a for a in (getattr(program, "_data_axes", None)
                                   or (axis_name,)) if a in mesh_axes)
-    if not data_axes:
+    # a pure model-parallel mesh (every mesh axis is a shard axis, no dp
+    # member) legitimately has NO data axis: the full batch is
+    # replicated, grads need no allreduce. Promoting a model axis to a
+    # data axis here would shard the feeds and skip the wrong allreduces
+    # — silently wrong gradients.
+    shard_axes_used = {a for spec in shard_specs.values()
+                       for a in spec if a}
+    if not data_axes and (mesh.axis_names[0] not in shard_axes_used):
         data_axes = (mesh.axis_names[0],)
     for n, spec in list(shard_specs.items()) + list(feed_specs.items()):
         for a in spec:
@@ -105,8 +112,12 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
 
         mark_sync_batch_norm(program, sync_bn)
 
-    ring_val = data_axes if len(data_axes) > 1 else data_axes[0]
-    default_feed_spec = (data_axes[0],)
+    if not data_axes:
+        ring_val = None  # collectives become identity (nranks_data = 1)
+        default_feed_spec = ()  # feeds replicated across the model mesh
+    else:
+        ring_val = data_axes if len(data_axes) > 1 else data_axes[0]
+        default_feed_spec = (data_axes[0],)
 
     fetch_names = tuple(f if isinstance(f, str) else f.name
                         for f in fetch_list)
@@ -156,7 +167,8 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
                 env.update(feeds_d)
                 _trace_block(block, env, seed)
                 fetches = [
-                    jax.lax.all_gather(env[n], data_axes)
+                    jax.lax.all_gather(env[n], data_axes) if data_axes
+                    else env[n]
                     for n in fetch_names
                 ]
                 new_state = {n: env[n] for n in out_state_names if n in env}

@@ -164,6 +164,16 @@ def apply_sharded_embedding(program, axis: str = "mp", degree: int = 0):
             raise ValueError(
                 "sharded embedding %r: vocab %d not divisible by "
                 "mp degree %d" % (w, v.shape[0], degree))
+        if op.attrs.get("is_sparse"):
+            # mesh sharding REPLACES the SelectedRows sparse-grad path:
+            # the local block grad is dense [V/mp, D] (the design — one
+            # gather/psum pair instead of sparse push RPC), which is a
+            # deliberate, visible semantics change for is_sparse tables
+            import warnings
+
+            warnings.warn(
+                "sharded embedding %r: is_sparse=True becomes a dense "
+                "row-sharded gradient under tensor parallelism" % w)
         squeeze = op.type == "lookup_table"  # v2 keeps the trailing dim
         op.type = "c_sharded_lookup"
         op.attrs = {"shard_axis": axis,
@@ -179,18 +189,29 @@ def apply_sharded_embedding(program, axis: str = "mp", degree: int = 0):
     return tables
 
 
-def apply_sequence_parallel(program, axis: str = "sp", feed_specs=None):
+def apply_sequence_parallel(program, axis: str = "sp", degree: int = 0,
+                            feed_specs=None):
     """Sequence/context parallelism: flash_attention ops become
     c_ring_attention over ``axis`` (K/V shards rotate the ring via
     ppermute — long-context training). ``feed_specs`` declares how data
     feeds are laid out over the mesh, e.g. {"x": ("dp", None, "sp")} for
-    [B, H, S, D] with batch over dp and sequence over sp. Call BEFORE
-    minimize()."""
+    [B, H, S, D] with batch over dp and sequence over sp. ``degree``
+    (when given) validates the attention sequence length divides evenly
+    — a clear error here beats a cryptic shard_map one at run time.
+    Call BEFORE minimize()."""
     block = program.global_block()
     n = 0
     for op in block.ops:
         if op.type != "flash_attention":
             continue
+        if degree:
+            q = block._find_var_recursive(op.input("Q")[0])
+            if (q is not None and q.shape is not None and len(q.shape) >= 3
+                    and q.shape[2] and q.shape[2] % degree):
+                raise ValueError(
+                    "sequence parallel: attention seq len %d not "
+                    "divisible by sp degree %d (Q=%r)"
+                    % (q.shape[2], degree, op.input("Q")[0]))
         op.type = "c_ring_attention"
         op.attrs = {"shard_axis": axis,
                     "causal": bool(op.attrs.get("causal")),

@@ -17,50 +17,10 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
+from __graft_entry__ import _program_parity_step as _run_dense_then_mesh
 from paddle_tpu.incubate.fleet.collective import (CollectiveOptimizer,
                                                   DistributedStrategy)
 from paddle_tpu.parallel.mesh_utils import make_mesh
-
-
-def _snapshot_params(program, scope):
-    snap = {}
-    for name, v in program.global_block().vars.items():
-        if getattr(v, "persistable", False):
-            var = scope.find_var(name)
-            if var is not None and var.is_initialized():
-                snap[name] = np.asarray(var.raw().array)
-    return snap
-
-
-def _restore(scope, snap):
-    import jax.numpy as jnp
-
-    for name, arr in snap.items():
-        scope.var(name).get_tensor()._array = jnp.asarray(arr)
-
-
-def _run_dense_then_mesh(main, startup, loss, feed, mesh):
-    """Returns (dense_loss, mesh_loss, dense_params, mesh_params)."""
-    exe = fluid.Executor(fluid.TPUPlace())
-
-    scope_a = fluid.Scope()
-    with fluid.scope_guard(scope_a):
-        exe.run(startup)
-        snap = _snapshot_params(main, scope_a)
-        (l_dense,) = exe.run(main, feed=feed, fetch_list=[loss])
-        dense_params = _snapshot_params(main, scope_a)
-
-    scope_b = fluid.Scope()
-    with fluid.scope_guard(scope_b):
-        exe_b = fluid.Executor(fluid.TPUPlace())
-        exe_b.run(startup)
-        _restore(scope_b, snap)
-        cp = fluid.CompiledProgram(main).with_data_parallel(
-            loss_name=loss.name, places=mesh)
-        (l_mesh,) = exe_b.run(cp, feed=feed, fetch_list=[loss])
-        mesh_params = _snapshot_params(main, scope_b)
-    return (float(np.ravel(l_dense)[0]), float(np.mean(np.asarray(l_mesh))),
-            dense_params, mesh_params)
 
 
 def test_program_path_sharded_embedding():
@@ -175,3 +135,37 @@ def test_program_path_expert_parallel():
     win = moe_ops[0].input("WIn")[0]
     np.testing.assert_allclose(p_mesh[win], p_dense[win],
                                rtol=1e-4, atol=1e-6)
+
+
+def test_program_path_pure_model_parallel_mesh():
+    """mp-only mesh (no data axis): the batch is replicated, grads need
+    no allreduce, and the engine must NOT promote the model axis to a
+    data axis (that would shard the feeds and silently drop cross-shard
+    gradient contributions)."""
+    mp = 4
+    V, D, N = 16, 8, 6  # N deliberately NOT divisible by mp
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data(name="ids", shape=[N, 1], dtype="int64")
+        tgt = fluid.data(name="tgt", shape=[N, D], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[V, D],
+                                     param_attr=fluid.ParamAttr(
+                                         name="emb_w"))
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(emb, tgt)))
+        strat = DistributedStrategy()
+        strat.sharded_embedding = True
+        strat.mp_degree = mp
+        CollectiveOptimizer(
+            fluid.optimizer.SGDOptimizer(0.5), strat).minimize(loss)
+
+    rng = np.random.RandomState(9)
+    feed = {"ids": rng.randint(0, V, (N, 1)).astype("int64"),
+            "tgt": rng.randn(N, D).astype("float32")}
+    mesh = make_mesh([mp], ["mp"])
+    l_dense, l_mesh, p_dense, p_mesh = _run_dense_then_mesh(
+        main, startup, loss, feed, mesh)
+    assert np.isfinite(l_dense) and np.isfinite(l_mesh)
+    assert abs(l_dense - l_mesh) < 1e-5, (l_dense, l_mesh)
+    np.testing.assert_allclose(p_mesh["emb_w"], p_dense["emb_w"],
+                               rtol=1e-5, atol=1e-6)
