@@ -177,7 +177,33 @@ class InMemoryDataset(DatasetBase):
             raise RuntimeError("load_into_memory first")
         random.shuffle(self._records)
 
-    def global_shuffle(self, fleet=None):
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Re-distribute records ACROSS workers (DatasetImpl::
+        GlobalShuffle, data_set.h:188 — via fleet RPC in the reference,
+        via distributed/record_shuffle here), then shuffle locally.
+        Worker topology from PADDLE_SHUFFLE_ENDPOINTS +
+        PADDLE_TRAINER_ID (or the fleet role maker); single-worker
+        setups degrade to a local shuffle."""
+        import os
+
+        if self._records is None:
+            raise RuntimeError("load_into_memory first")
+        eps = os.environ.get("PADDLE_SHUFFLE_ENDPOINTS", "")
+        idx = None
+        if not eps and fleet is not None:
+            try:
+                eps = ",".join(fleet.worker_endpoints())
+                idx = int(fleet.worker_index())
+            except Exception:
+                eps = ""
+        endpoints = [e for e in eps.split(",") if e]
+        if len(endpoints) > 1:
+            from .distributed.record_shuffle import global_record_shuffle
+
+            if idx is None:
+                idx = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            self._records = global_record_shuffle(self._records,
+                                                  endpoints, idx)
         self.local_shuffle()
 
     def release_memory(self):

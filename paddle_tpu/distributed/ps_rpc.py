@@ -220,6 +220,48 @@ class PSServer:
                     self._fetches_pending = False
                     self._cond.notify_all()
             return {"ok": True}, b""
+        if kind == "pull_sparse":
+            # sparse table pull (pslib PullSparseVarsSync,
+            # fleet_wrapper.h:84): LOCAL row ids in, value rows out.
+            # Deliberately NOT gated on the dense sync round: a pull
+            # happens at FORWARD time, and waiting for _round_complete
+            # here would deadlock two sync trainers (A's barrier waits
+            # for B while B's pull waits for the round A opened) —
+            # sparse tables are round-free in pslib, like the push.
+            ids = _array_from(msg["array"], raw).reshape(-1)
+            with self._lock:
+                tbl = self._executor._read_var(self._scope, msg["name"])
+            if tbl is None:
+                return {"ok": False,
+                        "error": "no table %r" % msg["name"]}, b""
+            vals = np.ascontiguousarray(np.asarray(tbl)[ids])
+            return {"ok": True, "array": _array_header(vals)}, \
+                vals.tobytes()
+        if kind == "push_sparse":
+            # sparse grad push applied IMMEDIATELY (pslib
+            # PushSparseVarsAsync semantics — downpour workers don't
+            # gate sparse updates on the dense sync round). raw =
+            # rows bytes + values bytes; rows are LOCAL to this shard.
+            rh, vh = msg["rows"], msg["array"]
+            nrows_bytes = int(np.dtype(rh["dtype"]).itemsize
+                              * int(np.prod(rh["shape"])))
+            rows = np.frombuffer(raw[:nrows_bytes],
+                                 dtype=rh["dtype"]).reshape(-1)
+            vals = _array_from(vh, raw[nrows_bytes:])
+            from ..core.tensor import LoDTensor, SelectedRows
+
+            with self._lock:
+                tbl = self._executor._read_var(self._scope,
+                                               msg.get("param", ""))
+                height = (int(np.asarray(tbl).shape[0])
+                          if tbl is not None else int(rows.max()) + 1)
+                sr = SelectedRows(rows=rows.tolist(), height=height)
+                sr._value = LoDTensor(vals)
+                self._executor._write_var(self._scope, msg["name"], sr)
+                sub = self._grad_to_block.get(msg["name"])
+                if sub is not None:
+                    self._executor.run_block(sub, self._scope)
+            return {"ok": True}, b""
         if kind == "heartbeat":
             return {"ok": True,
                     "status": {str(k): v
@@ -427,6 +469,28 @@ class PSClient:
 
     def fetch_barrier(self) -> None:
         self._call({"kind": "fetch_barrier"})
+
+    def pull_sparse(self, name: str, row_ids) -> np.ndarray:
+        """Pull value rows for LOCAL row ids from this server's table
+        shard (pslib PullSparseVarsSync counterpart)."""
+        ids = np.ascontiguousarray(np.asarray(row_ids, dtype=np.int64))
+        resp, raw = self._call({"kind": "pull_sparse", "name": name,
+                                "array": _array_header(ids)},
+                               ids.tobytes())
+        return _array_from(resp["array"], raw)
+
+    def push_sparse(self, name: str, rows, values, param: str = "") -> None:
+        """Push (local row ids, grad rows) to this server's shard; the
+        server applies its optimize block immediately (async, pslib
+        PushSparseVarsAsync counterpart). ``param`` names the table var
+        so the server can size the SelectedRows height."""
+        rows = np.ascontiguousarray(np.asarray(rows, dtype=np.int64))
+        vals = np.ascontiguousarray(np.asarray(values))
+        self._call({"kind": "push_sparse", "name": name,
+                    "param": param,
+                    "rows": _array_header(rows),
+                    "array": _array_header(vals)},
+                   rows.tobytes() + vals.tobytes())
 
     def heartbeat(self) -> Dict[int, float]:
         resp, _ = self._call({"kind": "heartbeat"})

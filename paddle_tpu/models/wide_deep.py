@@ -7,12 +7,17 @@ MLP part, sigmoid CTR head).
 from __future__ import annotations
 
 from .. import layers
+from ..param_attr import ParamAttr
 
 
 def wide_deep(dense_input, sparse_ids, vocab_size, embed_dim=16,
-              hidden_sizes=(64, 32), is_sparse=False):
+              hidden_sizes=(64, 32), is_sparse=False,
+              is_distributed=False, shared_table_name=None):
     """dense_input [N, Dd]; sparse_ids [N, S] int64 feature ids.
-    Returns (predict [N, 2] softmax, feature list)."""
+    Returns (predict [N, 2] softmax, feature list).
+    ``is_distributed`` marks the embedding tables for the PS sparse-table
+    path (row-sliced over pservers at transpile); ``shared_table_name``
+    makes all slots share ONE table (the dist_ctr.py layout)."""
     # deep: embeddings + MLP
     embs = []
     s = int(sparse_ids.shape[1])
@@ -20,7 +25,9 @@ def wide_deep(dense_input, sparse_ids, vocab_size, embed_dim=16,
         ids = layers.slice(sparse_ids, axes=[1], starts=[i], ends=[i + 1])
         emb = layers.embedding(
             ids, size=[vocab_size, embed_dim], is_sparse=is_sparse,
-            param_attr=None)
+            is_distributed=is_distributed,
+            param_attr=(None if shared_table_name is None else
+                        ParamAttr(name=shared_table_name)))
         embs.append(layers.reshape(emb, [-1, embed_dim]))
     deep = layers.concat(embs + [dense_input], axis=1)
     for h in hidden_sizes:

@@ -157,6 +157,120 @@ def _fetch_barrier(executor, op, scope):
             _rpc_client(ep).fetch_barrier()
 
 
+# -- distributed sparse tables (pslib path) ---------------------------------
+# Parity: operators/distributed_ops/distributed_lookup_table_op.cc +
+# framework/fleet/fleet_wrapper.h:84 (PullSparseVarsSync /
+# PushSparseVarsAsync) + downpour_worker.cc. The table lives ROW-SLICED
+# across pservers (slice_variable blocks); the trainer partitions global
+# ids by row range, pulls each server's rows, and pushes merged sparse
+# grads back — the server applies its optimize sub-block per push.
+
+
+def _table_partition(ids_flat, starts, counts):
+    """Yield (ep_index, mask, local_rows) per hosting server."""
+    for k, (s, c) in enumerate(zip(starts, counts)):
+        mask = (ids_flat >= s) & (ids_flat < s + c)
+        if mask.any():
+            yield k, mask, (ids_flat[mask] - s).astype(np.int64)
+
+
+def _emulated_pull(server, name, local_rows):
+    tbl = server["executor"]._read_var(server["scope"], name)
+    if tbl is None:
+        raise RuntimeError("pull_sparse: server has no table %r" % name)
+    return np.asarray(tbl)[local_rows]
+
+
+def _emulated_push(server, grad_name, param_name, local_rows, values):
+    from ..core.tensor import LoDTensor, SelectedRows
+
+    tbl = server["executor"]._read_var(server["scope"], param_name)
+    height = int(np.asarray(tbl).shape[0]) if tbl is not None \
+        else int(local_rows.max()) + 1
+    sr = SelectedRows(rows=local_rows.tolist(), height=height)
+    sr._value = LoDTensor(values)
+    server["executor"]._write_var(server["scope"], grad_name, sr)
+    sub = server["grad_to_block"].get(grad_name)
+    if sub is not None:
+        server["executor"].run_block(sub, server["scope"])
+
+
+@register_host_op(
+    "distributed_lookup_table",
+    inputs=[In("Ids", no_grad=True), In("W", dispensable=True,
+                                        no_grad=True)],
+    outputs=[Out("Outputs")],
+    attrs={"table_name": "", "endpoints": [], "row_starts": [],
+           "row_counts": [], "embed_dim": 0, "padding_idx": -1,
+           "squeeze_last": True, "dtype": "float32"},
+)
+def _distributed_lookup_table(executor, op, scope):
+    """Sparse pull: route each id to the pserver hosting its row block,
+    pull value rows, reassemble [ids shape..., D]."""
+    ids = np.asarray(executor._read_var(scope, op.input("Ids")[0]))
+    squeeze = bool(op.attrs.get("squeeze_last", True)) \
+        and ids.ndim >= 2 and ids.shape[-1] == 1
+    out_shape = (tuple(ids.shape[:-1]) if squeeze else tuple(ids.shape))
+    flat = ids.reshape(-1).astype(np.int64)
+    d = int(op.attrs["embed_dim"])
+    table = op.attrs["table_name"]
+    eps = op.attrs["endpoints"]
+    out = np.zeros((flat.size, d),
+                   dtype=np.dtype(op.attrs.get("dtype", "float32")))
+    for k, mask, local in _table_partition(
+            flat, op.attrs["row_starts"], op.attrs["row_counts"]):
+        ep = eps[k]
+        server = _EMULATED_SERVERS.get(ep)
+        if server is not None:
+            rows = _emulated_pull(server, table, local)
+        else:
+            rows = _rpc_client(ep).pull_sparse(table, local)
+        out[mask] = rows
+    pad = int(op.attrs.get("padding_idx", -1))
+    if pad >= 0:
+        out[flat == pad] = 0.0
+    executor._write_var(scope, op.output("Outputs")[0],
+                        out.reshape(out_shape + (d,)))
+
+
+@register_host_op(
+    "distributed_push_sparse",
+    inputs=[In("Ids", no_grad=True), In("OutGrad", no_grad=True)],
+    outputs=[],
+    attrs={"table_name": "", "grad_name": "", "endpoints": [],
+           "row_starts": [], "row_counts": [], "padding_idx": -1,
+           "squeeze_last": True},
+)
+def _distributed_push_sparse(executor, op, scope):
+    """Sparse push: merge duplicate ids client-side (the reference's
+    MergeAdd before push), partition by row range, push each server its
+    local (rows, grads); the server applies its optimizer sub-block."""
+    ids = np.asarray(executor._read_var(scope, op.input("Ids")[0]))
+    og = np.asarray(executor._read_var(scope, op.input("OutGrad")[0]))
+    flat = ids.reshape(-1).astype(np.int64)
+    d = og.shape[-1]
+    vals = np.asarray(og).reshape(-1, d)
+    pad = int(op.attrs.get("padding_idx", -1))
+    if pad >= 0:
+        keep = flat != pad
+        flat, vals = flat[keep], vals[keep]
+    uniq, inv = np.unique(flat, return_inverse=True)
+    merged = np.zeros((uniq.size, d), dtype=vals.dtype)
+    np.add.at(merged, inv, vals)
+    table = op.attrs["table_name"]
+    gname = op.attrs.get("grad_name") or (table + "@GRAD")
+    eps = op.attrs["endpoints"]
+    for k, mask, local in _table_partition(
+            uniq, op.attrs["row_starts"], op.attrs["row_counts"]):
+        ep = eps[k]
+        server = _EMULATED_SERVERS.get(ep)
+        if server is not None:
+            _emulated_push(server, gname, table, local, merged[mask])
+        else:
+            _rpc_client(ep).push_sparse(gname, local, merged[mask],
+                                        param=table)
+
+
 import weakref
 
 # scope -> {table@epmap: count}; weak keys so a dead trainer scope's

@@ -114,18 +114,67 @@ class DistributeTranspiler:
             return
 
         block = self.origin_program.global_block()
+        eps_all = self.pserver_endpoints
+
+        # distributed sparse tables (pslib path,
+        # distributed_lookup_table_op.cc): embedding(is_distributed=True)
+        # tables are ROW-SLICED across pservers; their lookup becomes a
+        # sparse pull, their grad a sparse push, and their optimizer op
+        # moves server-side
+        self.dist_tables: Dict[str, dict] = {}
+        for op in block.ops:
+            if op.type in ("lookup_table", "lookup_table_v2") \
+                    and op.attrs.get("is_distributed"):
+                w = op.input("W")[0]
+                v = block._find_var_recursive(w)
+                rows = int(v.shape[0])
+                per = int(math.ceil(rows / float(len(eps_all))))
+                starts, counts = [], []
+                for k in range(len(eps_all)):
+                    s = min(k * per, rows)
+                    starts.append(s)
+                    counts.append(min(per, rows - s))
+                self.dist_tables[w] = {
+                    "dim": int(v.shape[1]),
+                    "dtype": getattr(v, "dtype", "float32") or "float32",
+                    "starts": starts, "counts": counts,
+                    "squeeze": op.type == "lookup_table",
+                    "padding_idx": int(op.attrs.get("padding_idx", -1)),
+                }
+
         # param/grad pairs from optimizer ops; drop the optimizer ops —
-        # updates happen on the pservers
+        # updates happen on the pservers. Distributed tables are NOT in
+        # the dense send/recv set (their updates ride the sparse push).
         params_grads = []
         opt_ops = []
+        self._table_opt_ops: Dict[str, object] = {}
         for op in block.ops:
             if op.type in OPTIMIZER_OP_TYPES:
-                opt_ops.append(op)
                 p = op.input("Param")[0]
                 g = op.input("Grad")[0]
+                if p in self.dist_tables:
+                    self._table_opt_ops[p] = op
+                    self.dist_tables[p]["grad"] = g
+                    continue
+                opt_ops.append(op)
                 params_grads.append((p, g))
         self.params_grads = params_grads
         self._opt_ops = opt_ops
+
+        if self.dist_tables:
+            self._rewrite_dist_table_ops(block, eps_all)
+            # the trainer never touches the table itself (pull/push only)
+            # — initializing the FULL table on every trainer would OOM at
+            # exactly the giant-vocab scale this path exists for. The
+            # init ops move aside for get_startup_program, which copies
+            # them (slice-shaped) into each SERVER's startup.
+            sblk = self.startup_program.global_block()
+            self._table_init_ops = [
+                op for op in sblk.ops
+                if any(o in self.dist_tables for o in op.output_arg_names)
+            ]
+            moved = set(id(op) for op in self._table_init_ops)
+            sblk.ops = [op for op in sblk.ops if id(op) not in moved]
 
         # round-robin param blocks over endpoints (RoundRobin dispatcher)
         eps = self.pserver_endpoints
@@ -164,6 +213,61 @@ class DistributeTranspiler:
             new_ops.append(op)
         block.ops = new_ops
         self._transpiled = True
+
+    def _rewrite_dist_table_ops(self, block, eps):
+        """Swap each distributed table's lookup for a sparse pull, its
+        grad op for a sparse push, and drop its trainer-side optimizer
+        op (the update happens on the hosting pservers)."""
+        new_ops = []
+        for op in block.ops:
+            if op.type in ("lookup_table", "lookup_table_v2") \
+                    and op.input("W")[0] in self.dist_tables:
+                w = op.input("W")[0]
+                t = self.dist_tables[w]
+                nop = framework.Operator(
+                    block, "distributed_lookup_table",
+                    {"Ids": [op.input("Ids")[0]]},
+                    {"Outputs": [op.output("Out")[0]]},
+                    {"table_name": w, "endpoints": list(eps),
+                     "row_starts": t["starts"], "row_counts": t["counts"],
+                     "embed_dim": t["dim"], "squeeze_last": t["squeeze"],
+                     "padding_idx": t["padding_idx"],
+                     "dtype": str(t.get("dtype", "float32"))})
+                nop._id = self.origin_program._next_op_id()
+                new_ops.append(nop)
+                continue
+            if op.type in ("lookup_table_grad", "lookup_table_v2_grad",
+                           "lookup_table_sparse_grad") \
+                    and op.input("W") \
+                    and op.input("W")[0] in self.dist_tables:
+                w = op.input("W")[0]
+                t = self.dist_tables[w]
+                nop = framework.Operator(
+                    block, "distributed_push_sparse",
+                    {"Ids": [op.input("Ids")[0]],
+                     "OutGrad": [op.input("Out@GRAD")[0]]},
+                    {},
+                    {"table_name": w, "grad_name": t.get("grad",
+                                                         w + "@GRAD"),
+                     "endpoints": list(eps),
+                     "row_starts": t["starts"], "row_counts": t["counts"],
+                     "squeeze_last": t["squeeze"],
+                     "padding_idx": t["padding_idx"]})
+                nop._id = self.origin_program._next_op_id()
+                new_ops.append(nop)
+                continue
+            if op.type in OPTIMIZER_OP_TYPES \
+                    and op.input("Param")[0] in self.dist_tables:
+                continue  # applied server-side per push
+            if op.type == "sum" and op.output("Out") \
+                    and any(op.output("Out")[0] == t.get("grad")
+                            for t in self.dist_tables.values()):
+                # a shared table looked up N times sums N grad partials;
+                # each partial became its own sparse push, so the sum
+                # (whose inputs no longer exist) goes too
+                continue
+            new_ops.append(op)
+        block.ops = new_ops
 
     def get_trainer_program(self, wait_port=True):
         if not self._transpiled:
@@ -209,12 +313,57 @@ class DistributeTranspiler:
                 sub.ops.append(nop)
             pserver_program._rollback()
             opt_blocks.append(sub)
+        grad_to_block_id = ["%s:%d" % (g, b.idx) for (p, g), b in
+                            zip(hosted, opt_blocks)]
+
+        # distributed sparse-table slices hosted here: the var holds
+        # THIS endpoint's row block [count, dim]; the sparse push writes
+        # a SelectedRows grad (LOCAL rows) and runs the optimizer
+        # sub-block, whose kernels take the sparse path
+        ep_idx = self.pserver_endpoints.index(endpoint)
+        for w, t in getattr(self, "dist_tables", {}).items():
+            count = t["counts"][ep_idx]
+            if count <= 0:
+                continue
+            pblock.create_var(name=w, shape=[count, t["dim"]],
+                              dtype=t.get("dtype", "float32"),
+                              persistable=True)
+            gname = t.get("grad", w + "@GRAD")
+            pblock.create_var(name=gname, shape=None,
+                              dtype=t.get("dtype", "float32"))
+            opt = getattr(self, "_table_opt_ops", {}).get(w)
+            sub = pserver_program._create_block()
+            if opt is not None:
+                for name in opt.input_arg_names:
+                    v = origin_block._find_var_recursive(name)
+                    if v is not None and not pblock.has_var_local(name):
+                        shape = v.shape
+                        if name not in (w, gname) and shape is not None \
+                                and tuple(shape) and \
+                                tuple(shape)[0] == t["starts"][-1] \
+                                + t["counts"][-1]:
+                            # optimizer accumulator shaped like the full
+                            # table (momentum velocity): host the slice
+                            shape = [count] + list(shape[1:])
+                        pblock.create_var(name=name, shape=shape,
+                                          dtype=v.dtype,
+                                          persistable=v.persistable)
+                nop = framework.Operator(
+                    sub, opt.type,
+                    {k: list(vv) for k, vv in opt.inputs.items()},
+                    {k: list(vv) for k, vv in opt.outputs.items()},
+                    dict(opt.attrs))
+                nop._id = pserver_program._next_op_id()
+                sub.ops.append(nop)
+            pserver_program._rollback()
+            opt_blocks.append(sub)
+            grad_to_block_id.append("%s:%d" % (gname, sub.idx))
+
         op = framework.Operator(
             pblock, "listen_and_serv", {"X": []}, {},
             {"endpoint": endpoint,
              "optimize_blocks": opt_blocks,
-             "grad_to_block_id": ["%s:%d" % (g, b.idx) for (p, g), b in
-                                  zip(hosted, opt_blocks)],
+             "grad_to_block_id": grad_to_block_id,
              "sync_mode": self.sync_mode,
              "Fanin": self.trainer_num})
         op._id = pserver_program._next_op_id()
@@ -237,19 +386,46 @@ class DistributeTranspiler:
         else:
             hosted = {p for (p, g) in self.params_grads
                       if self.param_to_ep[p] == endpoint}
-        for op in src.ops:
+        # distributed-table slices: this endpoint initializes only ITS
+        # row block, so the copied init op's shape attr is overridden
+        ep_idx = (self.pserver_endpoints.index(endpoint)
+                  if endpoint in self.pserver_endpoints else -1)
+        slice_shapes = {}
+        if ep_idx >= 0:
+            for w, t in getattr(self, "dist_tables", {}).items():
+                count = t["counts"][ep_idx]
+                if count > 0:
+                    slice_shapes[w] = [count, t["dim"]]
+                    full = t["starts"][-1] + t["counts"][-1]
+                    opt = getattr(self, "_table_opt_ops", {}).get(w)
+                    if opt is not None:
+                        for name in opt.input_arg_names:
+                            v = src._find_var_recursive(name)
+                            if (v is not None and name != w
+                                    and v.shape and tuple(v.shape)
+                                    and tuple(v.shape)[0] == full):
+                                slice_shapes[name] = \
+                                    [count] + list(v.shape[1:])
+        for op in list(src.ops) + list(getattr(self, "_table_init_ops",
+                                               [])):
             outs = op.output_arg_names
             if any(o in hosted for o in outs):
+                attrs = dict(op.attrs)
                 for name in outs:
                     v = src._find_var_recursive(name)
+                    shape = slice_shapes.get(name,
+                                             v.shape if v is not None
+                                             else None)
                     if v is not None and not blk.has_var_local(name):
-                        blk.create_var(name=name, shape=v.shape,
+                        blk.create_var(name=name, shape=shape,
                                        dtype=v.dtype, persistable=True)
+                    if name in slice_shapes and "shape" in attrs:
+                        attrs["shape"] = list(slice_shapes[name])
                 nop = framework.Operator(
                     blk, op.type,
                     {k: list(vv) for k, vv in op.inputs.items()},
                     {k: list(vv) for k, vv in op.outputs.items()},
-                    dict(op.attrs))
+                    attrs)
                 nop._id = sp._next_op_id()
                 blk.ops.append(nop)
         return sp

@@ -1,0 +1,37 @@
+"""Worker for the multi-process global-shuffle test: each of two worker
+processes loads its OWN file shard (labels tag the origin worker), runs
+Dataset.global_shuffle — records migrate between processes through
+distributed/record_shuffle — and writes the labels it ended up owning.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def main():
+    out_path, data_file = sys.argv[1], sys.argv[2]
+    B = 2
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.data(name="x", shape=[B, 4], dtype="float32")
+        y = fluid.data(name="y", shape=[B, 1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(B)
+    ds.set_use_var([x, y])
+    ds.set_filelist([data_file])
+    ds.load_into_memory()
+    before = sorted(int(np.asarray(r["y"]).ravel()[0])
+                    for r in ds._records)
+    ds.global_shuffle()
+    after = sorted(int(np.asarray(r["y"]).ravel()[0])
+                   for r in ds._records)
+    with open(out_path, "w") as f:
+        f.write(json.dumps({"before": before, "after": after}))
+
+
+if __name__ == "__main__":
+    main()

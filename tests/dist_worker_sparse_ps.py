@@ -1,0 +1,99 @@
+"""Worker for the multi-process sparse-table PS test: Wide&Deep with
+its embedding tables row-sliced across TWO pserver processes over the
+real socket RPC (PADDLE_PSERVER_RPC=1).
+
+Roles via PADDLE_TRAINING_ROLE: each PSERVER hosts its table slices +
+dense param shard and blocks in listen_and_serv; the TRAINER pulls
+rows, trains, pushes sparse grads, and writes losses as JSON.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+
+STEPS = 60
+BS = 32
+VOCAB = 40
+SLOTS = 3
+DENSE_D = 4
+
+
+def _net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dense = fluid.data(name="dense", shape=[BS, DENSE_D],
+                           dtype="float32")
+        sparse = fluid.data(name="sparse", shape=[BS, SLOTS],
+                            dtype="int64")
+        label = fluid.data(name="label", shape=[BS, 1], dtype="int64")
+        pred = models.wide_deep(dense, sparse, vocab_size=VOCAB,
+                                embed_dim=8, hidden_sizes=(16,),
+                                is_distributed=True)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.2).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+    endpoints = os.environ["PSERVER_ENDPOINTS"]
+    out_path = sys.argv[1]
+
+    main_prog, startup, loss = _net()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main_prog, startup_program=startup,
+                pservers=endpoints, trainers=1, sync_mode=True)
+    assert t.dist_tables, "wide_deep tables must be distributed"
+
+    if role == "PSERVER":
+        my_ep = os.environ["PSERVER_ENDPOINT"]
+        os.environ["PADDLE_PSERVER_RPC"] = "1"
+        ps_prog = t.get_pserver_program(my_ep)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe._core.rng.seed = 123  # identical slice init across restarts
+        exe._core.rng.step = 0
+        exe.run(t.get_startup_program(my_ep, ps_prog))
+        exe.run(ps_prog)  # blocks serving until shutdown
+        return
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe._core.rng.seed = 321
+    exe._core.rng.step = 0
+    exe.run(startup)
+    rng = np.random.RandomState(7)
+    # fixed synthetic CTR batch: a learnable id->label correlation
+    dense_b = rng.rand(BS, DENSE_D).astype("float32")
+    sparse_b = rng.randint(0, VOCAB, (BS, SLOTS)).astype("int64")
+    label_b = (sparse_b[:, :1] % 2).astype("int64")
+    losses = []
+    for _ in range(STEPS):
+        (l,) = exe.run(main_prog,
+                       feed={"dense": dense_b, "sparse": sparse_b,
+                             "label": label_b},
+                       fetch_list=[loss])
+        losses.append(float(np.asarray(l).ravel()[0]))
+
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    eps = endpoints.split(",")
+    # every pserver hosts a nonempty slice of table slot 0
+    tname = sorted(t.dist_tables)[0]
+    slice_sums = []
+    for ep in eps:
+        c = PSClient.for_endpoint(ep)
+        slice_sums.append(float(np.abs(c.pull_sparse(
+            tname, np.arange(t.dist_tables[tname]["counts"][
+                eps.index(ep)]))).sum()))
+    for ep in eps:
+        PSClient.for_endpoint(ep).shutdown_server()
+    with open(out_path, "w") as f:
+        f.write(json.dumps({"losses": losses,
+                            "slice_sums": slice_sums}))
+
+
+if __name__ == "__main__":
+    main()
