@@ -229,7 +229,20 @@ class CoreExecutor:
             vals = o if slot.duplicable else [o]
             for i, (n, v) in enumerate(zip(names, vals)):
                 lod = out_lods.get((slot.name, i))
-                self._write_var(scope, n, v, lod=lod)
+                # consistency guard: a propagated lod only attaches when
+                # the output's row count matches it. Without this, a
+                # grad op propagates a SEQUENCE lod onto the [V, D]
+                # table grad, sgd copies it onto the param, and the next
+                # batch's lookup reads the STALE lod off the table slot
+                # (the multi-batch ragged-training bug).
+                if lod is not None and hasattr(v, "shape"):
+                    total = lod[-1][-1] if (lod and len(lod[-1])) else 0
+                    if len(v.shape) == 0 or int(v.shape[0]) != int(total):
+                        lod = None
+                # no inferred lod -> CLEAR any stale lod on the reused
+                # scope tensor rather than silently keeping it
+                self._write_var(scope, n, v,
+                                lod=lod if lod is not None else ())
         self._maybe_check_nan_inf(op, scope)
 
     def _maybe_check_nan_inf(self, op, scope):

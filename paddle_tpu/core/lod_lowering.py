@@ -1,0 +1,151 @@
+"""LoD -> padded/masked lowering for whole-program compilation.
+
+A program with ragged (LoD) feeds and sequence ops runs op-by-op on the
+interpreter — a 10-100x cliff (SURVEY §7 hard part (a)). This pass
+keeps LoD as HOST metadata: the executor pads each ragged feed to a
+bucketed [B, T_bucket, ...] dense array plus a [B] length vector, and a
+lowered CLONE of the program replaces each sequence op with its padded
+twin (ops/sequence_ops.py *_padded) that consumes the lengths as a mask.
+Bucketed T (next power of two) bounds recompiles to O(log max_len)
+shapes, the standard TPU treatment of variable-length text.
+
+Scope: the ragged region between a LoD feed and its collapsing sequence
+op must consist of rank-polymorphic ops (embedding lookups, activations,
+casts — ops that treat the leading dims uniformly), because the packed
+[sum, ...] rows become [B, T, ...]. Anything else (reshape, fc) keeps
+the program on the interpreter, correctly.
+
+Reference contract: sequence kernels over LoD
+(operators/sequence_ops/, framework/lod_tensor.h:52); the book models'
+sentiment/word2vec configs are the canonical users.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .registry import GRAD_SUFFIX
+
+# ops that treat leading dims uniformly: ragged [sum, ...] -> padded
+# [B, T, ...] without semantic change (their grads likewise)
+RANK_SAFE = {
+    "lookup_table", "lookup_table_v2", "relu", "tanh", "sigmoid", "gelu",
+    "scale", "cast", "dropout", "square", "abs", "softsign", "sqrt",
+    "exp", "log",
+}
+
+# sequence op -> (padded twin, collapses_ragged): a pooling op's output
+# is DENSE [B, ...]; a softmax's output is still ragged [B, T, ...] and
+# its consumers must stay guarded
+SWAPS = {
+    "sequence_pool": ("sequence_pool_padded", True),
+    "sequence_softmax": ("sequence_softmax_padded", False),
+}
+
+
+def _grad_base(name: str) -> Optional[str]:
+    """emb.tmp_0@GRAD / emb.tmp_0@GRAD@RENAME... -> emb.tmp_0."""
+    i = name.find(GRAD_SUFFIX)
+    return name[:i] if i > 0 else None
+
+
+def plan_lowering(program, lod_feeds):
+    """(swaps, ragged) where swaps maps op index -> (padded op type,
+    origin feed) for every sequence op (and its grad) touching ragged
+    data, and ragged maps every ragged var -> its origin feed; None if
+    any unsupported op touches the ragged region."""
+    block = program.global_block()
+    ragged: Dict[str, str] = {f: f for f in lod_feeds}
+    swaps: Dict[int, Tuple[str, str]] = {}
+    for i, op in enumerate(block.ops):
+        ins = [n for n in op.input_arg_names if n]
+        r_ins = [n for n in ins if n in ragged]
+        if not r_ins:
+            continue
+        origin = ragged[r_ins[0]]
+        is_grad = op.type.endswith("_grad")
+        base_type = op.type[:-5] if is_grad else op.type
+        if base_type in SWAPS:
+            new_type, collapses = SWAPS[base_type]
+            swaps[i] = (new_type + ("_grad" if is_grad else ""), origin)
+            if is_grad:
+                # X@GRAD is ragged-shaped like X
+                for o in op.output_arg_names:
+                    b = _grad_base(o)
+                    if o and b in ragged:
+                        ragged[o] = ragged[b]
+            elif not collapses:
+                # softmax keeps raggedness: consumers stay guarded
+                for o in op.output_arg_names:
+                    if o:
+                        ragged[o] = origin
+            continue
+        if base_type in RANK_SAFE:
+            for o in op.output_arg_names:
+                if not o:
+                    continue
+                if is_grad:
+                    b = _grad_base(o)
+                    if b in ragged:  # only grads OF ragged vars
+                        ragged[o] = ragged[b]
+                else:
+                    ragged[o] = origin
+            continue
+        return None  # unsupported op consumes ragged data
+    return swaps, ragged
+
+
+def _len_name(feed: str) -> str:
+    return feed + "@SEQ_LEN"
+
+
+def build_lowered(program, lod_feeds):
+    """Lowered clone of ``program`` (sequence ops -> padded twins wired
+    to per-feed length vars), or None when the plan fails. Returns the
+    3-tuple (clone, feeds-to-pad set, all-ragged-var set) — the last is
+    the set of vars whose fetch would return PADDED values (the
+    executor refuses those fetches)."""
+    plan = plan_lowering(program, lod_feeds)
+    if plan is None:
+        return None
+    swaps, ragged = plan
+    clone = program.clone()
+    block = clone.global_block()
+    for f in lod_feeds:
+        block.create_var(name=_len_name(f), shape=None, dtype="int64")
+    for i, (new_type, origin) in swaps.items():
+        op = block.ops[i]
+        op.type = new_type
+        op.inputs = dict(op.inputs)
+        op.inputs["Length"] = [_len_name(origin)]
+        if "MaxIndex" in op.outputs:
+            op.outputs = {k: v for k, v in op.outputs.items()
+                          if k != "MaxIndex"}
+    clone._next_op_id()  # distinct version vs the original
+    return clone, set(lod_feeds), set(ragged)
+
+
+def bucket_len(n: int, minimum: int = 8) -> int:
+    """Next power of two >= n (>= minimum): recompiles bounded to
+    O(log max_len) distinct shapes."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_lod_feed(value) -> Tuple[np.ndarray, np.ndarray]:
+    """Ragged LoDTensor ([sum, ...] + level-0 offsets) -> (padded
+    [B, T_bucket, ...], lengths [B])."""
+    arr = np.asarray(value.array)
+    offsets = list(value.lod()[0])
+    lens = np.asarray([offsets[k + 1] - offsets[k]
+                       for k in range(len(offsets) - 1)], dtype=np.int64)
+    B = len(lens)
+    T = bucket_len(int(lens.max()) if B else 1)
+    padded = np.zeros((B, T) + arr.shape[1:], dtype=arr.dtype)
+    for k in range(B):
+        s, e = offsets[k], offsets[k + 1]
+        padded[k, :e - s] = arr[s:e]
+    return padded, lens

@@ -41,6 +41,7 @@ class Executor:
         self._compiled_cache: Dict = {}
         self._traceable_cache: Dict = {}
         self._compile_fallbacks: Dict = {}
+        self._lod_lowered_cache: Dict = {}
         self._closed = False
 
     def close(self):
@@ -75,31 +76,93 @@ class Executor:
 
         # FLAGS_check_nan_inf needs the per-op interpreter (the check
         # runs after every op, reference operator.cc:1032)
-        if not _flag("check_nan_inf") and self._can_whole_compile(program):
+        if not _flag("check_nan_inf"):
             from .core.compiler_engine import (_program_version,
                                                run_compiled_program)
 
             ver = _program_version(program)
             if ver not in self._compile_fallbacks:
-                try:
-                    return run_compiled_program(
-                        self._core, program, scope, feed, fetch_list,
-                        return_numpy)
-                except (NotImplementedError, TypeError) as e:
-                    # e.g. a while carry whose shape/dtype varies across
-                    # trips — valid for the host interpreter, untraceable
-                    # for lax.while_loop. Remember so later steps skip
-                    # the doomed trace attempt — and SAY so: this is a
-                    # large perf cliff that must not be silent.
-                    import warnings
+                run_args = None
+                if self._can_whole_compile(program):
+                    run_args = (program, feed)
+                else:
+                    # LoD feeds + sequence ops: try the padded/masked
+                    # lowering (core/lod_lowering.py) so ragged text
+                    # programs still get the one-dispatch XLA path
+                    lowered = self._lod_lowered(program, feed, fetch_list)
+                    if lowered is not None:
+                        run_args = lowered
+                if run_args is not None:
+                    try:
+                        return run_compiled_program(
+                            self._core, run_args[0], scope, run_args[1],
+                            fetch_list, return_numpy)
+                    except (NotImplementedError, TypeError) as e:
+                        # e.g. a while carry whose shape/dtype varies
+                        # across trips — valid for the host interpreter,
+                        # untraceable for lax.while_loop. Remember so
+                        # later steps skip the doomed trace attempt —
+                        # and SAY so: this is a large perf cliff that
+                        # must not be silent.
+                        import warnings
 
-                    warnings.warn(
-                        "program %s falls back to op-by-op "
-                        "interpretation (whole-program compile failed: "
-                        "%r)" % (program._uid, e))
-                    self._compile_fallbacks[ver] = repr(e)
+                        warnings.warn(
+                            "program %s falls back to op-by-op "
+                            "interpretation (whole-program compile "
+                            "failed: %r)" % (program._uid, e))
+                        self._compile_fallbacks[ver] = repr(e)
         return self._core.run_program(program, scope, feed, fetch_list,
                                       return_numpy)
+
+    def _lod_lowered(self, program, feed, fetch_list):
+        """(lowered_program, padded_feed) when every ragged feed pads
+        into the compiled path, else None. The lowered clone is cached
+        per program version; feeds re-pad every step (bucketed, so
+        recompiles stay O(log max_len))."""
+        from .core.compiler_engine import _program_version
+        from .core.lod_lowering import (_len_name, build_lowered,
+                                        pad_lod_feed)
+
+        lod_with_levels = [(n, len(v.lod())) for n, v in feed.items()
+                           if isinstance(v, LoDTensor) and v.lod()]
+        if not lod_with_levels:
+            return None
+        if any(lv != 1 for _, lv in lod_with_levels):
+            # multi-level lod (sub-sequences): padding flattens the
+            # wrong level — interpreter only
+            return None
+        lod_feeds = sorted(n for n, _ in lod_with_levels)
+        ver = (_program_version(program), tuple(lod_feeds))
+        hit = self._lod_lowered_cache.get(ver)
+        if hit is None:
+            from .core.compiler_engine import block_is_traceable
+
+            built = build_lowered(program, lod_feeds)
+            if built is not None and not block_is_traceable(
+                    built[0].global_block()):
+                built = None  # other blockers remain (while bodies...)
+            if built is not None:
+                # fetching a ragged intermediate would return PADDED
+                # values — those fetches need the interpreter
+                names = {f if isinstance(f, str) else f.name
+                         for f in fetch_list}
+                if names & built[2]:
+                    built = None
+            self._lod_lowered_cache[ver] = built if built is not None \
+                else False
+            hit = self._lod_lowered_cache[ver]
+        if hit is False:
+            return None
+        lowered, ragged_feeds, _ = hit
+        feed2 = {}
+        for n, v in feed.items():
+            if n in ragged_feeds:
+                padded, lens = pad_lod_feed(v)
+                feed2[n] = padded
+                feed2[_len_name(n)] = lens
+            else:
+                feed2[n] = v
+        return lowered, feed2
 
     def _can_whole_compile(self, program) -> bool:
         # sub-blocks (while/conditional bodies) are fine — they lower to

@@ -302,3 +302,76 @@ def _im2sequence(ins, attrs):
             )
     out = jnp.stack(patches, axis=1).reshape(n * oh * ow, c * kh * kw)
     return {"Out": out}
+
+
+# -- padded/masked twins for the whole-compile path -------------------------
+# LoD semantics on a static-shape compiler (SURVEY §7 hard part (a)):
+# ragged [sum, ...] rows + host-side offsets can't trace, so the LoD
+# lowering pass (core/lod_lowering.py) rewrites sequence ops into these
+# dense twins over padded [B, T, ...] values + a [B] length vector (LoD
+# kept as host metadata, lowered to a mask). Reference semantics:
+# sequence_pooling.cc / sequence_softmax_op.h, bucketed like the
+# reference's padding workflows (sequence_pad + static RNN).
+
+
+@register_op(
+    "sequence_pool_padded",
+    inputs=[In("X"), In("Length", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"pooltype": "AVERAGE", "pad_value": 0.0, "is_test": False},
+)
+def _sequence_pool_padded(ins, attrs):
+    x, ln = ins["X"], ins["Length"]          # [B, T, ...], [B]
+    B, T = x.shape[0], x.shape[1]
+    ln = ln.reshape(-1)
+    mask = jnp.arange(T)[None, :] < ln[:, None]          # [B, T]
+    m = mask.reshape((B, T) + (1,) * (x.ndim - 2))
+    pool = attrs.get("pooltype", "AVERAGE").upper()
+    if pool in ("SUM", "AVERAGE", "SQRT"):
+        s = jnp.sum(jnp.where(m, x, 0), axis=1)
+        lens = jnp.maximum(ln, 1).astype(x.dtype).reshape(
+            (-1,) + (1,) * (x.ndim - 2))
+        if pool == "AVERAGE":
+            s = s / lens
+        elif pool == "SQRT":
+            s = s / jnp.sqrt(lens)
+        out = s
+    elif pool == "MAX":
+        out = jnp.max(jnp.where(m, x, jnp.asarray(-jnp.inf, x.dtype)),
+                      axis=1)
+        out = jnp.where((ln > 0).reshape((-1,) + (1,) * (x.ndim - 2)),
+                        out, attrs.get("pad_value", 0.0))
+    elif pool == "MIN":
+        out = jnp.min(jnp.where(m, x, jnp.asarray(jnp.inf, x.dtype)),
+                      axis=1)
+        out = jnp.where((ln > 0).reshape((-1,) + (1,) * (x.ndim - 2)),
+                        out, attrs.get("pad_value", 0.0))
+    elif pool == "LAST":
+        idx = jnp.clip(ln - 1, 0, T - 1)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype(
+                jnp.int32), axis=1).squeeze(1)
+    elif pool == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError("unknown pooltype %r" % pool)
+    return {"Out": out}
+
+
+@register_op(
+    "sequence_softmax_padded",
+    inputs=[In("X"), In("Length", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={},
+)
+def _sequence_softmax_padded(ins, attrs):
+    x, ln = ins["X"], ins["Length"]          # [B, T, ...1], [B]
+    B, T = x.shape[0], x.shape[1]
+    mask = (jnp.arange(T)[None, :] < ln.reshape(-1)[:, None]).reshape(
+        (B, T) + (1,) * (x.ndim - 2))
+    neg = jnp.asarray(-1e30, x.dtype)
+    z = jnp.where(mask, x, neg)
+    z = z - jnp.max(z, axis=1, keepdims=True)
+    e = jnp.where(mask, jnp.exp(z), 0.0)
+    return {"Out": e / jnp.maximum(
+        jnp.sum(e, axis=1, keepdims=True), 1e-30)}

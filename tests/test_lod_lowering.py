@@ -1,0 +1,189 @@
+"""LoD -> padded lowering onto the whole-compile path (round-4 VERDICT
+item #6, SURVEY §7 hard part (a)): a ragged-text program (LoD ids ->
+embedding -> sequence_pool -> fc -> loss -> sgd, the sentiment/word2vec
+book shape) must compile whole-program via the padded twins instead of
+interpreting op-by-op, with LoD kept as host metadata; bucketed padding
+bounds recompiles; results match the interpreter exactly."""
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.tensor import LoDTensor
+
+V, E, C = 30, 8, 4
+
+
+def _build(pool="AVERAGE"):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data(name="ids", shape=[-1, 1], dtype="int64",
+                         lod_level=1)
+        lab = fluid.data(name="lab", shape=[-1, 1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[V, E], param_attr=fluid.ParamAttr(name="emb_w"))
+        pooled = fluid.layers.sequence_pool(emb, pool_type=pool)
+        pred = fluid.layers.fc(pooled, size=C, act="softmax",
+                               param_attr=fluid.ParamAttr(name="fc_w"))
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, lab))
+        fluid.optimizer.SGDOptimizer(0.2).minimize(loss)
+    return main, startup, loss
+
+
+def _ragged_batch(rng, n_seq, max_len=12):
+    lens = rng.randint(1, max_len + 1, n_seq)
+    offs = np.concatenate([[0], np.cumsum(lens)])
+    vals = rng.randint(0, V, (offs[-1], 1)).astype("int64")
+    t = LoDTensor(vals)
+    t.set_lod([offs.tolist()])
+    lab = rng.randint(0, C, (n_seq, 1)).astype("int64")
+    return {"ids": t, "lab": lab}, lens
+
+
+def _run_steps(exe, main, startup, loss, batches, scope, init=None):
+    """Returns (losses, final_params, initial_params). ``init`` (if
+    given) overwrites the startup values so two executors compare from
+    identical parameters (compiled and interpreted startup derive
+    different per-op RNG streams by design)."""
+    import jax.numpy as jnp
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        if init is not None:
+            for n, arr in init.items():
+                scope.var(n).get_tensor()._array = jnp.asarray(arr)
+
+        def snap():
+            return {n: np.asarray(scope.find_var(n).raw().array)
+                    for n in ("emb_w", "fc_w")}
+
+        init_params = snap()
+        losses = []
+        for feed in batches:
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.ravel(l)[0]))
+        params = snap()
+    return losses, params, init_params
+
+
+def test_lod_program_compiles_and_matches_interpreter():
+    for pool in ("AVERAGE", "MAX", "LAST"):
+        main, startup, loss = _build(pool)
+        rng = np.random.RandomState(1)
+        batches = [_ragged_batch(rng, 6)[0] for _ in range(3)]
+
+        exe_c = fluid.Executor(fluid.CPUPlace())
+        l_c, p_c, init = _run_steps(exe_c, main, startup, loss, batches,
+                                    fluid.Scope())
+        # the lowering engaged (not the silent interpreter)
+        assert any(v not in (None, False)
+                   for v in exe_c._lod_lowered_cache.values()), pool
+        assert not exe_c._compile_fallbacks
+
+        exe_i = fluid.Executor(fluid.CPUPlace())
+        exe_i._can_whole_compile = lambda p: False
+        exe_i._lod_lowered = lambda *a, **k: None
+        l_i, p_i, _ = _run_steps(exe_i, main, startup, loss, batches,
+                                 fluid.Scope(), init=init)
+
+        np.testing.assert_allclose(l_c, l_i, rtol=1e-6, atol=1e-7,
+                                   err_msg=pool)
+        for n in p_c:
+            np.testing.assert_allclose(p_c[n], p_i[n], rtol=1e-6,
+                                       atol=1e-7, err_msg=pool)
+
+
+def test_bucketing_bounds_recompiles():
+    """Batches whose max length lands in the same power-of-two bucket
+    share one compiled executable."""
+    from paddle_tpu.core import compiler_engine
+
+    main, startup, loss = _build()
+    rng = np.random.RandomState(2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        n_before = len(compiler_engine._cache)
+        for max_len in (9, 11, 14, 16):  # all bucket to T=16
+            feed, _ = _ragged_batch(rng, 6, max_len=max_len)
+            exe.run(main, feed=feed, fetch_list=[loss])
+        n_after = len(compiler_engine._cache)
+    assert n_after - n_before == 1, (n_before, n_after)
+
+
+def test_compiled_beats_interpreter():
+    """The point of the lowering: measured speedup over op-by-op
+    interpretation on repeat steps (compile excluded via warmup)."""
+    main, startup, loss = _build()
+    rng = np.random.RandomState(3)
+    feed, _ = _ragged_batch(rng, 8, max_len=8)
+    N = 30
+
+    def timed(exe):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(3):  # warmup/compile
+                exe.run(main, feed=feed, fetch_list=[loss])
+            t0 = time.time()
+            for _ in range(N):
+                exe.run(main, feed=feed, fetch_list=[loss])
+        return time.time() - t0
+
+    for attempt in range(3):  # best-of-3 guards against host noise
+        t_compiled = timed(fluid.Executor(fluid.CPUPlace()))
+        exe_i = fluid.Executor(fluid.CPUPlace())
+        exe_i._lod_lowered = lambda *a, **k: None
+        t_interp = timed(exe_i)
+        if t_compiled < t_interp:
+            break
+    assert t_compiled < t_interp, (t_compiled, t_interp)
+
+
+def test_softmax_raggedness_guard():
+    """sequence_softmax PRESERVES raggedness: a non-rank-safe consumer
+    (mean over the padded tensor would count the pads) must keep the
+    program on the interpreter, with correct ragged numerics."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, 1], dtype="float32",
+                       lod_level=1)
+        sm = fluid.layers.sequence_softmax(x)
+        out = fluid.layers.mean(sm)
+    vals = np.asarray([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0],
+                      "float32").reshape(-1, 1)
+    t = LoDTensor(vals)
+    t.set_lod([[0, 3, 7]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (v,) = exe.run(main, feed={"x": t}, fetch_list=[out])
+    # ragged mean over 7 rows (each segment sums to 1 -> mean 2/7)
+    np.testing.assert_allclose(float(np.ravel(v)[0]), 2.0 / 7.0,
+                               rtol=1e-5)
+
+
+def test_multilevel_lod_stays_on_interpreter():
+    """lod_level >= 2 feeds (sub-sequences) cannot pad on level 0 —
+    the lowering must decline and the interpreter result stands."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, 1], dtype="float32",
+                       lod_level=2)
+        pooled = fluid.layers.sequence_pool(x, pool_type="SUM")
+        out = fluid.layers.mean(pooled)
+    vals = np.arange(1, 12, dtype="float32").reshape(-1, 1)
+    t = LoDTensor(vals)
+    t.set_lod([[0, 2, 4], [0, 3, 5, 9, 11]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (v,) = exe.run(main, feed={"x": t}, fetch_list=[out])
+    assert not any(h not in (None, False)
+                   for h in exe._lod_lowered_cache.values())
+    # interpreter pools on the LAST level: segments sum to
+    # (6, 9, 30, 21) -> mean 16.5
+    np.testing.assert_allclose(float(np.ravel(v)[0]), 16.5, rtol=1e-5)
