@@ -35,16 +35,22 @@ def _device_feed(arrays):
     return {k: LoDTensor(jnp.asarray(v)) for k, v in arrays.items()}
 
 
-def _build_resnet50(batch, use_bf16=False):
+def _resnet_img_shape(batch, data_format):
+    return ((batch, 3, 224, 224) if data_format == "NCHW"
+            else (batch, 224, 224, 3))
+
+
+def _build_resnet50(batch, use_bf16=False, data_format="NCHW"):
     import paddle_tpu as fluid
     from paddle_tpu import models
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        img = fluid.data(name="img", shape=[batch, 3, 224, 224],
+        img = fluid.data(name="img",
+                         shape=list(_resnet_img_shape(batch, data_format)),
                          dtype="float32")
         label = fluid.data(name="label", shape=[batch, 1], dtype="int64")
-        pred = models.resnet50(img)
+        pred = models.resnet50(img, data_format=data_format)
         loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
         opt = fluid.optimizer.MomentumOptimizer(learning_rate=0.1,
                                                 momentum=0.9)
@@ -138,16 +144,18 @@ def _time_steps(exe, main, feed, loss, warmup=3, iters=20, windows=2,
     return dt, final_loss, diag
 
 
-def bench_resnet50(batch=128, iters=12, use_bf16=False):
+def bench_resnet50(batch=128, iters=12, use_bf16=False,
+                   data_format="NCHW"):
     import paddle_tpu as fluid
 
-    main, startup, loss, use_bf16 = _build_resnet50(batch,
-                                                    use_bf16=use_bf16)
+    main, startup, loss, use_bf16 = _build_resnet50(
+        batch, use_bf16=use_bf16, data_format=data_format)
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
     rng = np.random.RandomState(0)
     feed = _device_feed({
-        "img": rng.rand(batch, 3, 224, 224).astype("float32"),
+        "img": rng.rand(*_resnet_img_shape(batch,
+                                           data_format)).astype("float32"),
         "label": rng.randint(0, 1000, (batch, 1)).astype("int64"),
     })
     dt, final_loss, diag = _time_steps(exe, main, feed, loss, iters=iters)
@@ -155,7 +163,7 @@ def bench_resnet50(batch=128, iters=12, use_bf16=False):
         raise RuntimeError("resnet50 diverged: loss=%r" % final_loss)
     return {"images_per_sec": batch / dt, "step_ms": dt * 1e3,
             "batch": batch, "loss": final_loss, "bf16": use_bf16,
-            "diag": diag}
+            "data_format": data_format, "diag": diag}
 
 
 def bench_mnist_mlp(batch=512, iters=100):

@@ -24,6 +24,21 @@ class ExecutionStrategy:
 
 
 class BuildStrategy:
+    """Knob disposition under the XLA model (details/build_strategy.h):
+
+    - IMPLEMENTED here: ``sync_batch_norm`` (BN stats pmean across the
+      mesh), ``gradient_scale_strategy`` (CoeffNumDevice = 1/n loss-grad
+      scale; One = no scaling — the user's loss handles it).
+    - SUBSUMED by the compiler (accepted, nothing to do): the fusion
+      knobs (XLA fuses during lowering), ``enable_inplace`` /
+      ``memory_optimize`` (buffer donation + XLA buffer assignment),
+      ``fuse_all_reduce_ops`` (XLA groups collectives),
+      ``remove_unnecessary_lock`` (no locks exist).
+    - INERT and WARNED when enabled: ``enable_sequential_execution``,
+      ``fuse_all_optimizer_ops`` (no analog; a perf knob silently
+      ignored is worse than a warning).
+    """
+
     class ReduceStrategy:
         AllReduce = 0
         Reduce = 1
@@ -32,6 +47,14 @@ class BuildStrategy:
         CoeffNumDevice = 0
         One = 1
         Customized = 2
+
+    # accepted-and-ignored ON PURPOSE: XLA owns these optimizations
+    _SUBSUMED = {"fuse_elewise_add_act_ops", "fuse_bn_act_ops",
+                 "fuse_all_reduce_ops", "enable_inplace",
+                 "memory_optimize", "remove_unnecessary_lock",
+                 "reduce_strategy"}
+    # no analog exists — enabling one warns
+    _INERT = {"enable_sequential_execution", "fuse_all_optimizer_ops"}
 
     def __init__(self):
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
@@ -49,6 +72,16 @@ class BuildStrategy:
         self.num_trainers = 1
         self.trainer_id = 0
         self.nccl_comm_num = 1
+
+    def _warn_inert(self):
+        import warnings
+
+        for k in sorted(self._INERT):
+            if getattr(self, k, False):
+                warnings.warn(
+                    "BuildStrategy.%s has no effect on the TPU/XLA "
+                    "engine (no analog exists); the knob is ignored"
+                    % k)
 
 
 class CompiledProgram:

@@ -262,6 +262,26 @@ class PSServer:
                 if sub is not None:
                     self._executor.run_block(sub, self._scope)
             return {"ok": True}, b""
+        if kind == "checkpoint":
+            # checkpoint_notify_op.cc: snapshot every servable var into
+            # the requested directory (reference tensor-stream format)
+            import os
+
+            from ..core import proto_format
+
+            dirname = msg.get("dir", "")
+            os.makedirs(dirname, exist_ok=True)
+            with self._lock:
+                names = list(self._scope.local_var_names())
+                for name in names:
+                    val = self._executor._read_var(self._scope, name)
+                    if val is None or not hasattr(val, "shape"):
+                        continue
+                    path = os.path.join(dirname, name.replace("/", "_"))
+                    with open(path, "wb") as f:
+                        f.write(proto_format.serialize_lod_tensor(
+                            np.asarray(val)))
+            return {"ok": True}, b""
         if kind == "heartbeat":
             return {"ok": True,
                     "status": {str(k): v
@@ -491,6 +511,10 @@ class PSClient:
                     "rows": _array_header(rows),
                     "array": _array_header(vals)},
                    rows.tobytes() + vals.tobytes())
+
+    def checkpoint(self, dirname: str) -> None:
+        """Ask the server to snapshot its vars (checkpoint_notify)."""
+        self._call({"kind": "checkpoint", "dir": dirname})
 
     def heartbeat(self) -> Dict[int, float]:
         resp, _ = self._call({"kind": "heartbeat"})

@@ -141,19 +141,19 @@ class Executor:
             if built is not None and not block_is_traceable(
                     built[0].global_block()):
                 built = None  # other blockers remain (while bodies...)
-            if built is not None:
-                # fetching a ragged intermediate would return PADDED
-                # values — those fetches need the interpreter
-                names = {f if isinstance(f, str) else f.name
-                         for f in fetch_list}
-                if names & built[2]:
-                    built = None
             self._lod_lowered_cache[ver] = built if built is not None \
                 else False
             hit = self._lod_lowered_cache[ver]
         if hit is False:
             return None
-        lowered, ragged_feeds, _ = hit
+        lowered, ragged_feeds, ragged_vars = hit
+        # PER-CALL check (fetch_list varies between calls on the same
+        # program): fetching a ragged intermediate would return PADDED
+        # values — those calls take the interpreter, others stay
+        # compiled
+        names = {f if isinstance(f, str) else f.name for f in fetch_list}
+        if names & ragged_vars:
+            return None
         feed2 = {}
         for n, v in feed.items():
             if n in ragged_feeds:
@@ -206,5 +206,14 @@ class Executor:
         for batch in dataset._iter_batches():
             self.run(program, feed=batch, fetch_list=fetch_list, scope=scope)
 
-    def infer_from_dataset(self, *args, **kwargs):
-        return self.train_from_dataset(*args, **kwargs)
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Side-effect-free dataset pass (reference executor.py:1120):
+        runs a for_test clone — backward/optimizer ops pruned by op
+        role — so parameters are NEVER mutated, unlike
+        train_from_dataset."""
+        program = program or framework.default_main_program()
+        return self.train_from_dataset(
+            program.clone(for_test=True), dataset, scope, thread, debug,
+            fetch_list, fetch_info, print_period)

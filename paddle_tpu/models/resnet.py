@@ -13,7 +13,8 @@ from __future__ import annotations
 from .. import layers
 
 
-def _conv_bn(x, num_filters, filter_size, stride=1, act=None, is_test=False):
+def _conv_bn(x, num_filters, filter_size, stride=1, act=None, is_test=False,
+             data_format="NCHW"):
     conv = layers.conv2d(
         x,
         num_filters=num_filters,
@@ -22,30 +23,39 @@ def _conv_bn(x, num_filters, filter_size, stride=1, act=None, is_test=False):
         padding=(filter_size - 1) // 2,
         act=None,
         bias_attr=False,
+        data_format=data_format,
     )
-    return layers.batch_norm(conv, act=act, is_test=is_test)
+    return layers.batch_norm(conv, act=act, is_test=is_test,
+                             data_layout=data_format)
 
 
-def _shortcut(x, ch_out, stride, is_test=False):
-    ch_in = x.shape[1]
+def _shortcut(x, ch_out, stride, is_test=False, data_format="NCHW"):
+    ch_in = x.shape[1] if data_format == "NCHW" else x.shape[-1]
     if ch_in != ch_out or stride != 1:
-        return _conv_bn(x, ch_out, 1, stride, is_test=is_test)
+        return _conv_bn(x, ch_out, 1, stride, is_test=is_test,
+                        data_format=data_format)
     return x
 
 
-def _bottleneck(x, num_filters, stride, is_test=False):
-    conv0 = _conv_bn(x, num_filters, 1, act="relu", is_test=is_test)
+def _bottleneck(x, num_filters, stride, is_test=False, data_format="NCHW"):
+    conv0 = _conv_bn(x, num_filters, 1, act="relu", is_test=is_test,
+                     data_format=data_format)
     conv1 = _conv_bn(conv0, num_filters, 3, stride, act="relu",
-                     is_test=is_test)
-    conv2 = _conv_bn(conv1, num_filters * 4, 1, act=None, is_test=is_test)
-    short = _shortcut(x, num_filters * 4, stride, is_test=is_test)
+                     is_test=is_test, data_format=data_format)
+    conv2 = _conv_bn(conv1, num_filters * 4, 1, act=None, is_test=is_test,
+                     data_format=data_format)
+    short = _shortcut(x, num_filters * 4, stride, is_test=is_test,
+                      data_format=data_format)
     return layers.relu(layers.elementwise_add(short, conv2))
 
 
-def _basic_block(x, num_filters, stride, is_test=False):
-    conv0 = _conv_bn(x, num_filters, 3, stride, act="relu", is_test=is_test)
-    conv1 = _conv_bn(conv0, num_filters, 3, act=None, is_test=is_test)
-    short = _shortcut(x, num_filters, stride, is_test=is_test)
+def _basic_block(x, num_filters, stride, is_test=False, data_format="NCHW"):
+    conv0 = _conv_bn(x, num_filters, 3, stride, act="relu", is_test=is_test,
+                     data_format=data_format)
+    conv1 = _conv_bn(conv0, num_filters, 3, act=None, is_test=is_test,
+                     data_format=data_format)
+    short = _shortcut(x, num_filters, stride, is_test=is_test,
+                      data_format=data_format)
     return layers.relu(layers.elementwise_add(short, conv1))
 
 
@@ -58,22 +68,29 @@ _DEPTH_CFG = {
 }
 
 
-def resnet(input, class_dim=1000, depth=50, is_test=False):
-    """ImageNet-layout ResNet. ``input`` is NCHW [N, 3, H, W]."""
+def resnet(input, class_dim=1000, depth=50, is_test=False,
+           data_format="NCHW"):
+    """ImageNet-layout ResNet. ``input`` is NCHW [N, 3, H, W] or, with
+    ``data_format="NHWC"``, channels-last [N, H, W, 3] — the layout the
+    TPU conv engine prefers (convs/pools/BN lower natively, no
+    transposes anywhere in the graph)."""
     block_fn, counts = _DEPTH_CFG[depth]
-    x = _conv_bn(input, 64, 7, stride=2, act="relu", is_test=is_test)
+    x = _conv_bn(input, 64, 7, stride=2, act="relu", is_test=is_test,
+                 data_format=data_format)
     x = layers.pool2d(x, pool_size=3, pool_type="max", pool_stride=2,
-                      pool_padding=1)
+                      pool_padding=1, data_format=data_format)
     for stage, n_blocks in enumerate(counts):
         for i in range(n_blocks):
             stride = 2 if i == 0 and stage > 0 else 1
-            x = block_fn(x, 64 * (2 ** stage), stride, is_test=is_test)
-    x = layers.pool2d(x, pool_type="avg", global_pooling=True)
+            x = block_fn(x, 64 * (2 ** stage), stride, is_test=is_test,
+                         data_format=data_format)
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True,
+                      data_format=data_format)
     return layers.fc(x, class_dim, act="softmax")
 
 
-def resnet50(input, class_dim=1000, is_test=False):
-    return resnet(input, class_dim, 50, is_test)
+def resnet50(input, class_dim=1000, is_test=False, data_format="NCHW"):
+    return resnet(input, class_dim, 50, is_test, data_format)
 
 
 def resnet_cifar(input, class_dim=10, n=3, is_test=False):

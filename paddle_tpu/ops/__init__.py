@@ -38,3 +38,5 @@ from . import detection_tail_ops  # noqa: F401
 from . import tree_ops  # noqa: F401
 from . import var_conv_ops  # noqa: F401
 from . import hybrid_parallel_ops  # noqa: F401
+from . import ctr_ops  # noqa: F401
+from . import tail_ops3  # noqa: F401

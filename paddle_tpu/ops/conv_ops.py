@@ -32,19 +32,22 @@ def _norm_pads(paddings, n=2):
 def _conv_nd(x, w, strides, paddings, dilations, groups, data_format="NCHW",
              padding_algorithm="EXPLICIT"):
     n = x.ndim - 2
-    if data_format in ("NHWC", "NDHWC"):
-        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
-        x = jnp.transpose(x, perm)
     if padding_algorithm == "SAME":
         pads = "SAME"
     elif padding_algorithm == "VALID":
         pads = "VALID"
     else:
         pads = _norm_pads(paddings, n)
-    spec = (("NCHW", "OIHW", "NCHW") if n == 2
-            else ("NCDHW", "OIDHW", "NCDHW"))
+    # NHWC lowers NATIVELY via dimension numbers (channels-last is the
+    # TPU conv engine's preferred layout — no transposes around the op;
+    # the filter stays OIHW, the framework's storage layout)
+    if data_format in ("NHWC", "NDHWC"):
+        spec = (data_format, "OIHW" if n == 2 else "OIDHW", data_format)
+    else:
+        spec = (("NCHW", "OIHW", "NCHW") if n == 2
+                else ("NCDHW", "OIDHW", "NCDHW"))
     dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
-    out = lax.conv_general_dilated(
+    return lax.conv_general_dilated(
         x,
         w,
         window_strides=tuple(strides),
@@ -53,10 +56,6 @@ def _conv_nd(x, w, strides, paddings, dilations, groups, data_format="NCHW",
         dimension_numbers=dn,
         feature_group_count=groups,
     )
-    if data_format in ("NHWC", "NDHWC"):
-        perm = (0,) + tuple(range(2, out.ndim)) + (1,)
-        out = jnp.transpose(out, perm)
-    return out
 
 
 _CONV_ATTRS = {
@@ -96,7 +95,9 @@ def _conv2d(ins, attrs):
         attrs.get("padding_algorithm", "EXPLICIT"),
     )
     if ins.get("Bias") is not None:
-        out = out + ins["Bias"].reshape(1, -1, 1, 1)
+        bshape = ((1, -1, 1, 1) if data_format != "NHWC"
+                  else (1, 1, 1, -1))
+        out = out + ins["Bias"].reshape(bshape)
     return {"Output": out}
 
 
@@ -216,11 +217,14 @@ def _ceil_extra_pads(spatial, ksize, strides, pads, ceil_mode):
 
 
 def _pool_impl(x, attrs, ndim):
-    """Rank-generic max/avg pooling over the trailing ``ndim`` spatial dims
-    of an NC... tensor. Covers ceil_mode (extra hi padding), exclusive avg
-    (valid-element count via a ones reduce_window), and adaptive pooling."""
+    """Rank-generic max/avg pooling over the ``ndim`` spatial dims of an
+    NC... (or, with data_format=NHWC/NDHWC, N...C) tensor. Covers
+    ceil_mode (extra hi padding), exclusive avg (valid-element count via
+    a ones reduce_window), and adaptive pooling."""
     ptype = attrs.get("pooling_type", "max")
-    spatial_axes = tuple(range(2, 2 + ndim))
+    nhwc = attrs.get("data_format", "NCHW") in ("NHWC", "NDHWC")
+    sp0 = 1 if nhwc else 2  # first spatial axis
+    spatial_axes = tuple(range(sp0, sp0 + ndim))
     if attrs.get("global_pooling", False) or (
         attrs.get("adaptive", False) and list(attrs.get("ksize")) == [1] * ndim
     ):
@@ -230,27 +234,36 @@ def _pool_impl(x, attrs, ndim):
         osize = attrs["ksize"]
         # adaptive pooling via even split (requires divisibility, the
         # common CNN case; reference supports ragged windows)
-        new_shape = list(x.shape[:2])
+        new_shape = list(x.shape[:sp0])
         red_axes = []
         for i, o in enumerate(osize):
-            new_shape += [o, x.shape[2 + i] // o]
-            red_axes.append(2 + 2 * i + 1)
+            new_shape += [o, x.shape[sp0 + i] // o]
+            red_axes.append(sp0 + 2 * i + 1)
+        new_shape += list(x.shape[sp0 + ndim:])
         f = jnp.max if ptype == "max" else jnp.mean
         return f(x.reshape(new_shape), axis=tuple(red_axes))
     ksize = tuple(attrs["ksize"])
     strides = tuple(attrs.get("strides", [1] * ndim))
     pads = _norm_pads(attrs.get("paddings", [0] * ndim), ndim)
-    pads = _ceil_extra_pads(x.shape[2:], ksize, strides, pads,
+    pads = _ceil_extra_pads(x.shape[sp0:sp0 + ndim], ksize, strides, pads,
                             attrs.get("ceil_mode", False))
-    pad_cfg = [(0, 0), (0, 0)] + list(pads)
-    dims = (1, 1) + ksize
-    strd = (1, 1) + strides
+    if nhwc:
+        pad_cfg = [(0, 0)] + list(pads) + [(0, 0)]
+        dims = (1,) + ksize + (1,)
+        strd = (1,) + strides + (1,)
+    else:
+        pad_cfg = [(0, 0), (0, 0)] + list(pads)
+        dims = (1, 1) + ksize
+        strd = (1, 1) + strides
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, dims, strd, pad_cfg)
     s = lax.reduce_window(x, 0.0, lax.add, dims, strd, pad_cfg)
     if attrs.get("exclusive", True):
-        ones = jnp.ones(x.shape[2:], dtype=x.dtype)[(None, None)]
+        shp = x.shape[sp0:sp0 + ndim]
+        ones = jnp.ones(shp, dtype=x.dtype)
+        ones = ones[(None,) + (slice(None),) * ndim + (None,)] if nhwc \
+            else ones[(None, None)]
         cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strd, pad_cfg)
         return s / cnt
     return s / float(np.prod(ksize))

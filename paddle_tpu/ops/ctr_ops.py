@@ -1,0 +1,226 @@
+"""CTR-path ops: filter_by_instag, pull/push_box_sparse, recv_save.
+
+Parity: /root/reference/paddle/fluid/operators/filter_by_instag_op.h
+(tag-filtered instance selection for multi-task CTR towers),
+pull_box_sparse_op.cc / push_box_sparse_op.cc (BoxPS accelerator
+embedding pull/push — emulated here by an in-process table store, the
+same role _EMULATED_SERVERS plays for the PS ops), and
+distributed_ops/recv_save_op.cc (pserver-side checkpoint: pull param
+slices from their hosting endpoints, reassemble, save).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op
+from ..core.tensor import LoDTensor
+
+
+@register_host_op(
+    "filter_by_instag",
+    inputs=[In("Ins", no_grad=True), In("Ins_tag", no_grad=True),
+            In("Filter_tag", no_grad=True)],
+    outputs=[Out("Out"), Out("LossWeight"), Out("IndexMap")],
+    attrs={"is_lod": True, "out_val_if_empty": 0},
+)
+def _filter_by_instag(executor, op, scope):
+    """Keep instances whose tag list intersects the filter set
+    (filter_by_instag_op.h FilterByInstagKernel): Out = kept rows,
+    LossWeight = 1 per kept instance, IndexMap rows =
+    [out_start, ins_start, len]."""
+    ins_var = scope.find_var(op.input("Ins")[0]).raw()
+    x1 = np.asarray(ins_var.array)
+    tag_var = scope.find_var(op.input("Ins_tag")[0]).raw()
+    x2 = np.asarray(tag_var.array).reshape(-1)
+    x2_lod = list(tag_var.lod()[0])
+    x3 = set(np.asarray(executor._read_var(
+        scope, op.input("Filter_tag")[0])).reshape(-1).tolist())
+    if op.attrs.get("is_lod", True) and ins_var.lod():
+        x1_lod = list(ins_var.lod()[0])
+    else:
+        x1_lod = list(range(x1.shape[0] + 1))
+
+    out_rows, maps, out_lod = [], [], [0]
+    for i in range(len(x2_lod) - 1):
+        tags = x2[x2_lod[i]:x2_lod[i + 1]]
+        if any(int(t) in x3 for t in tags):
+            s, e = x1_lod[i], x1_lod[i + 1]
+            maps.append([out_lod[-1], s, e - s])
+            out_lod.append(out_lod[-1] + (e - s))
+            out_rows.append(x1[s:e])
+    e_dim = x1.shape[1]
+    if out_rows:
+        out = np.concatenate(out_rows, axis=0)
+        lw = np.ones((len(maps), 1), dtype=x1.dtype)
+        idx = np.asarray(maps, dtype=np.int64)
+    else:  # every instance filtered: 1 sentinel row, zero loss weight
+        out = np.full((1, e_dim),
+                      float(op.attrs.get("out_val_if_empty", 0)),
+                      dtype=x1.dtype)
+        lw = np.zeros((1, 1), dtype=x1.dtype)
+        idx = np.zeros((1, 3), dtype=np.int64)
+        out_lod = [0, 1]
+    t = LoDTensor(out)
+    t.set_lod([out_lod])
+    executor._write_var(scope, op.output("Out")[0], t)
+    executor._write_var(scope, op.output("LossWeight")[0], lw)
+    executor._write_var(scope, op.output("IndexMap")[0], idx)
+
+
+def _filter_by_instag_grad_maker(block, op, pending, finalize):
+    from .control_flow_ops import _bind_partial_grad
+
+    og = finalize(op.output("Out")[0])
+    if og is None:
+        return
+    gname = _bind_partial_grad(block, pending, op.input("Ins")[0])
+    block.append_op(
+        "filter_by_instag_grad",
+        {"Ins": [op.input("Ins")[0]], "IndexMap": [op.output("IndexMap")[0]],
+         "LossWeight": [op.output("LossWeight")[0]],
+         "Out@GRAD": [og]},
+        {"Ins@GRAD": [gname]}, {}, infer_shape=False)
+
+
+@register_host_op(
+    "filter_by_instag_grad",
+    inputs=[In("Ins", no_grad=True), In("IndexMap", no_grad=True),
+            In("LossWeight", no_grad=True), In("Out@GRAD", no_grad=True)],
+    outputs=[Out("Ins@GRAD")],
+)
+def _filter_by_instag_grad(executor, op, scope):
+    x1 = np.asarray(executor._read_var(scope, op.input("Ins")[0]))
+    idx = np.asarray(executor._read_var(scope, op.input("IndexMap")[0]))
+    lw = np.asarray(executor._read_var(scope,
+                                       op.input("LossWeight")[0]))
+    og = np.asarray(executor._read_var(scope, op.input("Out@GRAD")[0]))
+    g = np.zeros_like(x1)
+    if lw.any():  # sentinel-only output carries no gradient
+        for out_s, ins_s, ln in idx:
+            g[ins_s:ins_s + ln] = og[out_s:out_s + ln]
+    executor._write_var(scope, op.output("Ins@GRAD")[0], g)
+
+
+# patch the maker onto the registered info (host ops default grad=None)
+from ..core.registry import OpInfoMap  # noqa: E402
+
+OpInfoMap.instance().get("filter_by_instag").grad = \
+    _filter_by_instag_grad_maker
+
+
+# -- BoxPS emulation --------------------------------------------------------
+
+# table store: slot id -> {feature id -> embedding vector}
+_BOX_TABLES: Dict[int, Dict[int, np.ndarray]] = {}
+_BOX_LR = 0.05  # BoxPS applies its own internal optimizer; fixed-lr
+# SGD stands in for it in this in-process emulation
+
+
+def reset_box_tables():
+    _BOX_TABLES.clear()
+
+
+def _box_table(slot: int):
+    return _BOX_TABLES.setdefault(int(slot), {})
+
+
+def _box_pull_grad_maker(block, op, pending, finalize):
+    grads = [finalize(n) for n in op.output("Out")]
+    if all(g is None for g in grads):
+        return
+    block.append_op(
+        "push_box_sparse",
+        {"Ids": list(op.input("Ids")),
+         "Out@GRAD": [g or "@EMPTY@" for g in grads]},
+        {},
+        {"size": op.attrs.get("size", 1)}, infer_shape=False)
+
+
+@register_host_op(
+    "pull_box_sparse",
+    inputs=[In("Ids", duplicable=True, no_grad=True),
+            In("W", dispensable=True, no_grad=True)],
+    outputs=[Out("Out", duplicable=True)],
+    attrs={"size": 1},
+)
+def _pull_box_sparse(executor, op, scope):
+    """BoxPS sparse pull (pull_box_sparse_op.cc): one table per input
+    slot; unseen feature ids initialize to zeros (the BoxPS contract —
+    the accelerator owns initialization)."""
+    d = int(op.attrs.get("size", 1))
+    for slot, (ids_name, out_name) in enumerate(
+            zip(op.input("Ids"), op.output("Out"))):
+        ids = np.asarray(executor._read_var(scope, ids_name))
+        tbl = _box_table(slot)
+        flat = ids.reshape(-1)
+        out = np.stack([
+            tbl.setdefault(int(i), np.zeros(d, dtype=np.float32))
+            for i in flat
+        ]) if flat.size else np.zeros((0, d), np.float32)
+        shape = (tuple(ids.shape[:-1]) if ids.ndim >= 2
+                 and ids.shape[-1] == 1 else tuple(ids.shape)) + (d,)
+        executor._write_var(scope, out_name, out.reshape(shape))
+
+
+OpInfoMap.instance().get("pull_box_sparse").grad = _box_pull_grad_maker
+
+
+@register_host_op(
+    "push_box_sparse",
+    inputs=[In("Ids", duplicable=True, no_grad=True),
+            In("Out@GRAD", duplicable=True, no_grad=True)],
+    outputs=[],
+    attrs={"size": 1},
+)
+def _push_box_sparse(executor, op, scope):
+    for slot, (ids_name, g_name) in enumerate(
+            zip(op.input("Ids"), op.input("Out@GRAD"))):
+        if g_name in ("", "@EMPTY@"):
+            continue
+        ids = np.asarray(executor._read_var(scope, ids_name)).reshape(-1)
+        g = np.asarray(executor._read_var(scope, g_name))
+        g = g.reshape(ids.size, -1)
+        tbl = _box_table(slot)
+        for i, row in zip(ids, g):
+            cur = tbl.setdefault(int(i),
+                                 np.zeros(g.shape[1], np.float32))
+            tbl[int(i)] = cur - _BOX_LR * row
+
+
+@register_host_op(
+    "recv_save",
+    inputs=[],
+    outputs=[],
+    attrs={"dtype": 5, "overwrite": True, "file_path": "", "shape": [],
+           "slice_varnames": [], "remote_varnames": [],
+           "slice_shapes": [], "endpoints": [], "trainer_id": 0,
+           "is_sparse": False},
+)
+def _recv_save(executor, op, scope):
+    """Pserver checkpoint (recv_save_op.cc): pull each param slice from
+    its hosting endpoint, reassemble along dim 0, serialize to
+    file_path in the reference tensor-stream format."""
+    from ..core import proto_format
+    from .distributed_ops import _EMULATED_SERVERS, _rpc_client
+
+    parts = []
+    for rname, ep in zip(op.attrs["remote_varnames"],
+                         op.attrs["endpoints"]):
+        server = _EMULATED_SERVERS.get(ep)
+        if server is not None:
+            val = server["executor"]._read_var(server["scope"], rname)
+            if val is None:
+                raise RuntimeError("recv_save: server %r has no %r"
+                                   % (ep, rname))
+            parts.append(np.asarray(val))
+        else:
+            parts.append(_rpc_client(ep).get_param(rname))
+    full = (np.concatenate(parts, axis=0) if len(parts) > 1
+            else parts[0])
+    shape = [int(s) for s in op.attrs.get("shape", [])]
+    if shape:
+        full = full.reshape(shape)
+    with open(op.attrs["file_path"], "wb") as f:
+        f.write(proto_format.serialize_lod_tensor(full))

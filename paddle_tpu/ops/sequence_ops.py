@@ -351,8 +351,11 @@ def _sequence_pool_padded(ins, attrs):
         out = jnp.take_along_axis(
             x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype(
                 jnp.int32), axis=1).squeeze(1)
+        out = jnp.where((ln > 0).reshape((-1,) + (1,) * (x.ndim - 2)),
+                        out, attrs.get("pad_value", 0.0))
     elif pool == "FIRST":
-        out = x[:, 0]
+        out = jnp.where((ln > 0).reshape((-1,) + (1,) * (x.ndim - 2)),
+                        x[:, 0], attrs.get("pad_value", 0.0))
     else:
         raise ValueError("unknown pooltype %r" % pool)
     return {"Out": out}

@@ -1,0 +1,446 @@
+"""Registry-parity wave 4: the remaining reference op tail.
+
+Each op's docstring cites its reference kernel. Pure-math ops are jax
+fns (XLA-compiled, auto-VJP); scope/PS-coupled ones are host ops —
+matching the reference's kernel-less OperatorBase split.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # jax is lazy elsewhere; this module is import-time registered
+    import jax
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover
+    jax = jnp = None
+
+from ..core.registry import (In, Out, RNG_SEED_ATTR, OpInfoMap,
+                             register_host_op, register_op)
+from ..core.tensor import LoDTensor
+
+
+@register_op(
+    "maxout",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"groups": 1, "axis": 1},
+)
+def _maxout(ins, attrs):
+    """Channel-group max (math/maxouting.cc MaxOutFunctor): the channel
+    axis splits into (C/groups, groups) and reduces max over groups."""
+    x = ins["X"]
+    g = int(attrs.get("groups", 1))
+    axis = int(attrs.get("axis", 1))
+    if axis < 0:
+        axis += x.ndim
+    c = x.shape[axis]
+    shape = x.shape[:axis] + (c // g, g) + x.shape[axis + 1:]
+    return {"Out": jnp.max(x.reshape(shape), axis=axis + 1)}
+
+
+@register_op(
+    "add_position_encoding",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"alpha": 1.0, "beta": 1.0},
+)
+def _add_position_encoding(ins, attrs):
+    """Sinusoidal position encoding over [B, T, E]
+    (add_position_encoding_op.h): out[..., k] = alpha*x + beta*sin(val),
+    out[..., half+k] = alpha*x + beta*cos(val),
+    val = t / 10000^(k/(half-1))."""
+    x = ins["X"]
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    B, T, E = x.shape
+    half = E // 2
+    t = jnp.arange(T, dtype=x.dtype)[:, None]
+    k = jnp.arange(half, dtype=x.dtype)[None, :]
+    denom = jnp.power(10000.0, k / max(half - 1, 1))
+    val = t / denom                                   # [T, half]
+    pe = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=1)  # [T, E]
+    return {"Out": x * alpha + pe[None] * beta}
+
+
+@register_op(
+    "sampling_id",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"min": 0.0, "max": 1.0, "seed": 0},
+    needs_rng=True,
+    grad=None,
+)
+def _sampling_id(ins, attrs):
+    """Sample one column id per row of a [B, C] probability matrix
+    (sampling_id_op.h: uniform u, then the first prefix-sum >= u)."""
+    x = ins["X"]
+    key = jax.random.PRNGKey(ins[RNG_SEED_ATTR].astype(jnp.uint32))
+    u = jax.random.uniform(key, (x.shape[0], 1), dtype=x.dtype)
+    cum = jnp.cumsum(x, axis=1)
+    return {"Out": jnp.argmax(cum >= u, axis=1).astype(jnp.int64)}
+
+
+@register_op(
+    "spp",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"pyramid_height": 1, "pooling_type": "max"},
+)
+def _spp(ins, attrs):
+    """Spatial pyramid pooling (spp_op.h): levels h=0..H-1 pool NCHW to
+    2^h x 2^h bins; flattened bins concat to [N, C*(4^H-1)/3]."""
+    x = ins["X"]
+    n, c = x.shape[0], x.shape[1]
+    ptype = attrs.get("pooling_type", "max")
+    outs = []
+    for h in range(int(attrs.get("pyramid_height", 1))):
+        bins = 2 ** h
+        ksize_h = -(-x.shape[2] // bins)
+        ksize_w = -(-x.shape[3] // bins)
+        pad_h = (ksize_h * bins - x.shape[2] + 1) // 2
+        pad_w = (ksize_w * bins - x.shape[3] + 1) // 2
+        from .conv_ops import _pool_impl
+
+        p = _pool_impl(x, {"pooling_type": ptype,
+                           "ksize": [ksize_h, ksize_w],
+                           "strides": [ksize_h, ksize_w],
+                           "paddings": [pad_h, pad_w],
+                           "exclusive": False}, 2)
+        outs.append(p.reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op(
+    "is_empty",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    grad=None,
+)
+def _is_empty(ins, attrs):
+    """is_empty_op.h: scalar bool, numel == 0."""
+    return {"Out": jnp.asarray(ins["X"].size == 0)}
+
+
+@register_op(
+    "fill",
+    inputs=[],
+    outputs=[Out("Out")],
+    attrs={"value": [], "shape": [], "dtype": 5, "force_cpu": False},
+    grad=None,
+)
+def _fill(ins, attrs):
+    """fill_op.cc: tensor from an explicit per-element value list."""
+    from ..core import dtypes as _dt
+
+    dt = _dt.to_numpy_dtype(attrs.get("dtype", 5))
+    vals = np.asarray(attrs.get("value", []), dtype=dt)
+    return {"Out": jnp.asarray(vals.reshape(tuple(attrs["shape"])))}
+
+
+@register_op(
+    "fill_zeros_like2",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"dtype": 5},
+    grad=None,
+)
+def _fill_zeros_like2(ins, attrs):
+    from ..core import dtypes as _dt
+
+    return {"Out": jnp.zeros(ins["X"].shape,
+                             _dt.to_numpy_dtype(attrs.get("dtype", 5)))}
+
+
+def _batch_size_like_shape(x, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = x.shape[in_idx]
+    return tuple(shape)
+
+
+@register_op(
+    "gaussian_random_batch_size_like",
+    inputs=[In("Input", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"shape": [], "input_dim_idx": 0, "output_dim_idx": 0,
+           "mean": 0.0, "std": 1.0, "seed": 0, "dtype": 5},
+    needs_rng=True,
+    grad=None,
+)
+def _gaussian_random_bsl(ins, attrs):
+    """gaussian_random_batch_size_like_op.cc: normal noise whose batch
+    dim copies the input's."""
+    shape = _batch_size_like_shape(ins["Input"], attrs)
+    key = jax.random.PRNGKey(ins[RNG_SEED_ATTR].astype(jnp.uint32))
+    return {"Out": attrs.get("mean", 0.0)
+            + attrs.get("std", 1.0) * jax.random.normal(
+                key, shape, dtype=jnp.float32)}
+
+
+@register_op(
+    "uniform_random_batch_size_like",
+    inputs=[In("Input", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"shape": [], "input_dim_idx": 0, "output_dim_idx": 0,
+           "min": -1.0, "max": 1.0, "seed": 0, "dtype": 5},
+    needs_rng=True,
+    grad=None,
+)
+def _uniform_random_bsl(ins, attrs):
+    shape = _batch_size_like_shape(ins["Input"], attrs)
+    key = jax.random.PRNGKey(ins[RNG_SEED_ATTR].astype(jnp.uint32))
+    return {"Out": jax.random.uniform(
+        key, shape, minval=attrs.get("min", -1.0),
+        maxval=attrs.get("max", 1.0), dtype=jnp.float32)}
+
+
+@register_op(
+    "modified_huber_loss",
+    inputs=[In("X"), In("Y", no_grad=True)],
+    outputs=[Out("Out"), Out("IntermediateVal", no_grad=True)],
+)
+def _modified_huber_loss(ins, attrs):
+    """modified_huber_loss_op.h: a = x*(2y-1);
+    loss = -4a (a < -1) | (1-a)^2 (a < 1) | 0."""
+    x, y = ins["X"], ins["Y"]
+    a = x * (2.0 * y - 1.0)
+    loss = jnp.where(a < -1.0, -4.0 * a,
+                     jnp.where(a < 1.0, jnp.square(1.0 - a), 0.0))
+    return {"Out": loss, "IntermediateVal": a}
+
+
+@register_op(
+    "dequantize_abs_max",
+    inputs=[In("X", no_grad=True), In("Scale", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"max_range": 127.0},
+    grad=None,
+)
+def _dequantize_abs_max(ins, attrs):
+    """dequantize_abs_max_op.cc: out = scale * x / max_range."""
+    return {"Out": ins["X"].astype(jnp.float32)
+            * ins["Scale"].reshape(()) / attrs.get("max_range", 127.0)}
+
+
+@register_op(
+    "dequantize_log",
+    inputs=[In("X", no_grad=True), In("Dict", no_grad=True)],
+    outputs=[Out("Out")],
+    grad=None,
+)
+def _dequantize_log(ins, attrs):
+    """dequantize_log_op.cc: 8-bit log-quantized codes; x < 0 indexes
+    dict[x+128] positively, x >= 0 gives -dict[x]."""
+    x, d = ins["X"], ins["Dict"].reshape(-1)
+    xi = x.astype(jnp.int32)
+    return {"Out": jnp.where(xi < 0, jnp.take(d, xi + 128),
+                             -jnp.take(d, xi))}
+
+
+@register_op(
+    "seed",
+    inputs=[],
+    outputs=[Out("Out")],
+    attrs={"seed": 0},
+    grad=None,
+)
+def _seed(ins, attrs):
+    """seed_op.cc: materialize the dropout seed as a tensor."""
+    return {"Out": jnp.asarray([int(attrs.get("seed", 0))],
+                               dtype=jnp.int32)}
+
+
+# multiclass_nms2 (multiclass_nms2 registration in multiclass_nms_op.cc)
+# shares the v1 kernel — v1 here already emits the optional Index output.
+_nms_info = OpInfoMap.instance().get("multiclass_nms")
+register_host_op(
+    "multiclass_nms2",
+    inputs=[In("BBoxes", no_grad=True), In("Scores", no_grad=True)],
+    outputs=[Out("Out"), Out("Index", dispensable=True)],
+    attrs=dict(_nms_info.attrs),
+)(_nms_info.host_fn)
+
+# infer-mode aliases (REGISTER_OPERATOR(conditional_block_infer, ...),
+# merge_lod_tensor_infer): same kernels, pruned-grad registration
+_cb = OpInfoMap.instance().get("conditional_block")
+register_host_op("conditional_block_infer",
+                 inputs=list(_cb.inputs), outputs=list(_cb.outputs),
+                 attrs=dict(_cb.attrs))(_cb.host_fn)
+_ml = OpInfoMap.instance().get("merge_lod_tensor")
+register_host_op("merge_lod_tensor_infer",
+                 inputs=list(_ml.inputs), outputs=list(_ml.outputs),
+                 attrs=dict(_ml.attrs))(_ml.host_fn)
+
+
+@register_host_op(
+    "get_places",
+    inputs=[],
+    outputs=[Out("Out")],
+    attrs={"device_count": 0, "device_type": "CPU"},
+)
+def _get_places(executor, op, scope):
+    """get_places_op.cc: the device roster (device ordinals here — the
+    reference returns a vector<Place>)."""
+    import jax as _jax
+
+    n = int(op.attrs.get("device_count", 0)) or len(_jax.devices())
+    executor._write_var(scope, op.output("Out")[0],
+                        np.arange(n, dtype=np.int64))
+
+
+@register_host_op(
+    "fake_init",
+    inputs=[],
+    outputs=[Out("Out")],
+    attrs={"shape": [], "dtype": 5},
+)
+def _fake_init(executor, op, scope):
+    """fake_init_op.cc: mark a (pserver-hosted) var initialized without
+    allocating real content on the trainer."""
+    from ..core import dtypes as _dt
+
+    shape = tuple(int(s) for s in op.attrs.get("shape", [])) or (1,)
+    executor._write_var(
+        scope, op.output("Out")[0],
+        np.zeros(shape, _dt.to_numpy_dtype(op.attrs.get("dtype", 5))))
+
+
+@register_host_op(
+    "delete_var",
+    inputs=[In("X", duplicable=True, no_grad=True)],
+    outputs=[],
+)
+def _delete_var(executor, op, scope):
+    """delete_var_op.cc: explicit GC of scope vars."""
+    for n in op.input("X"):
+        if n:
+            scope.erase(n)
+
+
+@register_host_op(
+    "lookup_sparse_table",
+    inputs=[In("W", no_grad=True), In("Ids", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"auto_grown_table": True, "padding_idx": -1},
+)
+def _lookup_sparse_table(executor, op, scope):
+    """lookup_sparse_table_op.cc: lookup into a SelectedRows table
+    (auto-grown: unseen ids read as zero rows)."""
+    from ..core.tensor import SelectedRows
+
+    w = scope.find_var(op.input("W")[0]).raw()
+    ids = np.asarray(executor._read_var(
+        scope, op.input("Ids")[0])).reshape(-1)
+    if isinstance(w, SelectedRows):
+        vals = np.asarray(w.get_tensor().array)
+        rows = {int(r): i for i, r in enumerate(w.rows())}
+        d = vals.shape[-1]
+        out = np.zeros((ids.size, d), vals.dtype)
+        for i, rid in enumerate(ids):
+            j = rows.get(int(rid))
+            if j is not None:
+                out[i] = vals[j]
+    else:
+        vals = np.asarray(w.array)
+        out = vals[np.clip(ids, 0, vals.shape[0] - 1)]
+    executor._write_var(scope, op.output("Out")[0], out)
+
+
+@register_host_op(
+    "checkpoint_notify",
+    inputs=[],
+    outputs=[],
+    attrs={"epmap": [], "dir": "", "lookup_table": ""},
+)
+def _checkpoint_notify(executor, op, scope):
+    """checkpoint_notify_op.cc: tell each pserver to snapshot its
+    persistable vars into ``dir``."""
+    import os
+
+    from ..core import proto_format
+    from .distributed_ops import _EMULATED_SERVERS, _rpc_client
+
+    dirname = op.attrs.get("dir", "")
+    os.makedirs(dirname, exist_ok=True)
+    for ep in op.attrs.get("epmap", []):
+        server = _EMULATED_SERVERS.get(ep)
+        if server is not None:
+            sc = server["scope"]
+            for name in sc.local_var_names():
+                val = server["executor"]._read_var(sc, name)
+                if val is None or not hasattr(val, "shape"):
+                    continue
+                path = os.path.join(dirname, name.replace("/", "_"))
+                with open(path, "wb") as f:
+                    f.write(proto_format.serialize_lod_tensor(
+                        np.asarray(val)))
+        elif ep:
+            _rpc_client(ep).checkpoint(dirname)
+
+
+@register_host_op(
+    "precision_recall",
+    inputs=[In("MaxProbs", no_grad=True), In("Indices", no_grad=True),
+            In("Labels", no_grad=True), In("Weights", dispensable=True,
+                                           no_grad=True),
+            In("StatesInfo", dispensable=True, no_grad=True)],
+    outputs=[Out("BatchMetrics"), Out("AccumMetrics"),
+             Out("AccumStatesInfo")],
+    attrs={"class_number": 1},
+)
+def _precision_recall(executor, op, scope):
+    """metrics/precision_recall_op.h: per-class TP/FP/TN/FN states ->
+    [macro P, macro R, macro F1, micro P, micro R, micro F1], batch and
+    accumulated."""
+    c = int(op.attrs.get("class_number", 1))
+    idx = np.asarray(executor._read_var(
+        scope, op.input("Indices")[0])).reshape(-1)
+    lab = np.asarray(executor._read_var(
+        scope, op.input("Labels")[0])).reshape(-1)
+    wname = op.input("Weights")
+    w = (np.asarray(executor._read_var(scope, wname[0])).reshape(-1)
+         if wname else np.ones_like(idx, dtype=np.float32))
+
+    def batch_states():
+        st = np.zeros((c, 4), np.float32)  # TP FP TN FN
+        for i, l, wt in zip(idx, lab, w):
+            i, l = int(i), int(l)
+            if i == l:
+                st[i, 0] += wt
+                st[:, 2] += wt
+                st[i, 2] -= wt
+            else:
+                st[l, 3] += wt
+                st[i, 1] += wt
+                st[:, 2] += wt
+                st[i, 2] -= wt
+                st[l, 2] -= wt
+        return st
+
+    def metrics(st):
+        tp, fp, fn = st[:, 0], st[:, 1], st[:, 3]
+        prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1e-12),
+                        1.0 * (tp + fp == 0))
+        rec = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1e-12),
+                       1.0 * (tp + fn == 0))
+        mp, mr = float(prec.mean()), float(rec.mean())
+        mf1 = 2 * mp * mr / (mp + mr) if mp + mr > 0 else 0.0
+        TP, FP, FN = tp.sum(), fp.sum(), fn.sum()
+        up = float(TP / max(TP + FP, 1e-12)) if TP + FP > 0 else 1.0
+        ur = float(TP / max(TP + FN, 1e-12)) if TP + FN > 0 else 1.0
+        uf1 = 2 * up * ur / (up + ur) if up + ur > 0 else 0.0
+        return np.asarray([mp, mr, mf1, up, ur, uf1], np.float32)
+
+    bst = batch_states()
+    sname = op.input("StatesInfo")
+    prev = (np.asarray(executor._read_var(scope, sname[0]),
+                       dtype=np.float32).reshape(c, 4)
+            if sname and executor._read_var(scope, sname[0]) is not None
+            else np.zeros((c, 4), np.float32))
+    acc = prev + bst
+    executor._write_var(scope, op.output("BatchMetrics")[0],
+                        metrics(bst))
+    executor._write_var(scope, op.output("AccumMetrics")[0],
+                        metrics(acc))
+    executor._write_var(scope, op.output("AccumStatesInfo")[0], acc)

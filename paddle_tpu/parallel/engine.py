@@ -98,6 +98,13 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
 
     sync_bn = bool(build_strategy is not None and getattr(
         build_strategy, "sync_batch_norm", False))
+    if build_strategy is not None and hasattr(build_strategy,
+                                              "_warn_inert"):
+        build_strategy._warn_inert()
+    # GradientScaleStrategy.One: the user's loss already accounts for
+    # the device count — no 1/n loss-grad scale (build_strategy.h)
+    scale_loss = not (build_strategy is not None and getattr(
+        build_strategy, "gradient_scale_strategy", 0) == 1)
     # collective rewrite (insert_allreduce_ops is itself idempotent
     # per program — fleet may have transpiled already). Loss/grad
     # scaling is over the DATA axes only: model-parallel axes see the
@@ -105,7 +112,7 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     if nranks > 1:
         skip_axes = getattr(program, "_allreduce_skip_grads", None) or {}
         insert_allreduce_ops(
-            program, data_nranks,
+            program, data_nranks, scale_loss=scale_loss,
             skip_grads={g for g, axes in skip_axes.items()
                         if set(axes) & set(data_axes)})
         from .transpiler import mark_sync_batch_norm

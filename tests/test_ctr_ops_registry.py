@@ -1,0 +1,189 @@
+"""CTR-path ops (filter_by_instag, pull/push_box_sparse, recv_save) +
+op-registry parity against the committed allowlist + honest knobs
+(round-4 VERDICT items #7/#9)."""
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.tensor import LoDTensor
+
+
+def test_filter_by_instag_forward_backward():
+    """filter_by_instag_op.h contract: keep instances whose tag list
+    hits the filter set; grads scatter back through IndexMap."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ins = fluid.data(name="ins", shape=[-1, 3], dtype="float32",
+                         lod_level=1)
+        tags = fluid.data(name="tags", shape=[-1, 1], dtype="int64",
+                          lod_level=1)
+        ftag = fluid.data(name="ftag", shape=[2], dtype="int64")
+        out = fluid.layers.create_variable(
+            name="fo", dtype="float32") if False else None
+        helper_block = main.global_block()
+        from paddle_tpu import framework
+
+        ov = helper_block.create_var(name="f_out", shape=None,
+                                     dtype="float32")
+        lw = helper_block.create_var(name="f_lw", shape=None,
+                                     dtype="float32")
+        im = helper_block.create_var(name="f_im", shape=None,
+                                     dtype="int64")
+        op = framework.Operator(
+            helper_block, "filter_by_instag",
+            {"Ins": ["ins"], "Ins_tag": ["tags"], "Filter_tag": ["ftag"]},
+            {"Out": ["f_out"], "LossWeight": ["f_lw"],
+             "IndexMap": ["f_im"]},
+            {"is_lod": True, "out_val_if_empty": 0})
+        op._id = main._next_op_id()
+        helper_block.ops.append(op)
+
+    # 3 instances: rows [0:2], [2:3], [3:5]; tags 1 / 7 / 2
+    ins_t = LoDTensor(np.arange(15, dtype="float32").reshape(5, 3))
+    ins_t.set_lod([[0, 2, 3, 5]])
+    tag_t = LoDTensor(np.asarray([[1], [7], [2]], dtype="int64"))
+    tag_t.set_lod([[0, 1, 2, 3]])
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        o, w, m = exe.run(
+            main,
+            feed={"ins": ins_t, "tags": tag_t,
+                  "ftag": np.asarray([1, 2], "int64")},
+            fetch_list=["f_out", "f_lw", "f_im"])
+    # instances 0 (tag 1) and 2 (tag 2) kept; instance 1 (tag 7) dropped
+    np.testing.assert_array_equal(
+        np.asarray(o),
+        np.concatenate([np.arange(6), np.arange(9, 15)]).reshape(
+            4, 3).astype("float32"))
+    np.testing.assert_array_equal(np.asarray(w).ravel(), [1.0, 1.0])
+    np.testing.assert_array_equal(np.asarray(m),
+                                  [[0, 0, 2], [2, 3, 2]])
+
+
+def test_box_sparse_pull_push_roundtrip():
+    """pull_box_sparse zero-inits unseen ids; push applies the update —
+    a second pull observes it (the BoxPS training loop contract)."""
+    from paddle_tpu import framework
+    from paddle_tpu.ops.ctr_ops import _BOX_LR, reset_box_tables
+
+    reset_box_tables()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data(name="ids", shape=[4, 1], dtype="int64")
+        blk = main.global_block()
+        blk.create_var(name="emb", shape=None, dtype="float32")
+        op = framework.Operator(
+            blk, "pull_box_sparse", {"Ids": ["ids"]}, {"Out": ["emb"]},
+            {"size": 3})
+        op._id = main._next_op_id()
+        blk.ops.append(op)
+
+    idv = np.asarray([[5], [9], [5], [2]], "int64")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (e0,) = exe.run(main, feed={"ids": idv}, fetch_list=["emb"])
+        np.testing.assert_array_equal(np.asarray(e0), np.zeros((4, 3)))
+        # push a grad: duplicate id 5 accumulates both rows
+        g = np.ones((4, 3), "float32")
+        push = framework.Operator(
+            main.global_block(), "push_box_sparse",
+            {"Ids": ["ids"], "Out@GRAD": ["g"]}, {}, {"size": 3})
+        exe._core._write_var(scope, "g", g)
+        exe._core.run_op(push, scope)
+        (e1,) = exe.run(main, feed={"ids": idv}, fetch_list=["emb"])
+    e1 = np.asarray(e1)
+    np.testing.assert_allclose(e1[1], -_BOX_LR * np.ones(3), rtol=1e-6)
+    np.testing.assert_allclose(e1[0], -2 * _BOX_LR * np.ones(3),
+                               rtol=1e-6)  # id 5 pushed twice
+    reset_box_tables()
+
+
+def test_recv_save_assembles_slices(tmp_path):
+    """recv_save_op.cc: pull slices from their endpoints, reassemble,
+    save in the reference tensor-stream format."""
+    from paddle_tpu import framework
+    from paddle_tpu.core import proto_format
+    from paddle_tpu.ops.distributed_ops import (_EMULATED_SERVERS,
+                                                reset_emulated_servers)
+
+    reset_emulated_servers()
+    exe = fluid.Executor(fluid.CPUPlace())
+    full = np.arange(24, dtype="float32").reshape(6, 4)
+    for k, ep in enumerate(("local://rs-a", "local://rs-b")):
+        scope = fluid.Scope()
+        exe._core._write_var(scope, "w.block%d" % k,
+                             full[k * 3:(k + 1) * 3])
+        _EMULATED_SERVERS[ep] = {"executor": exe._core, "scope": scope,
+                                 "grad_to_block": {}}
+    path = str(tmp_path / "w.save")
+    op = framework.Operator(
+        fluid.Program().global_block(), "recv_save", {}, {},
+        {"file_path": path, "shape": [6, 4],
+         "slice_varnames": ["w.block0", "w.block1"],
+         "remote_varnames": ["w.block0", "w.block1"],
+         "endpoints": ["local://rs-a", "local://rs-b"],
+         "trainer_id": 0})
+    exe._core.run_op(op, fluid.Scope())
+    with open(path, "rb") as f:
+        arr, _lod, _pos = proto_format.parse_lod_tensor(f.read())
+    np.testing.assert_array_equal(np.asarray(arr), full)
+    reset_emulated_servers()
+
+
+def test_op_registry_parity_diff_is_zero():
+    if not os.path.isdir("/root/reference/paddle/fluid/operators"):
+        pytest.skip("reference tree not mounted")
+    from paddle_tpu.tools.check_op_registry import parity_diff
+
+    diff = parity_diff()
+    assert diff["missing"] == [], diff["missing"]
+    assert diff["stale_allowlist"] == [], diff["stale_allowlist"]
+
+
+def test_inert_build_strategy_knob_warns():
+    bs = fluid.BuildStrategy()
+    bs.enable_sequential_execution = True
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        bs._warn_inert()
+    assert any("no effect" in str(x.message) for x in w)
+
+
+def test_infer_from_dataset_is_side_effect_free(tmp_path):
+    """reference executor.py:1120: infer_from_dataset must never mutate
+    parameters (train_from_dataset does)."""
+    p = str(tmp_path / "part-0")
+    with open(p, "w") as f:
+        for i in range(8):
+            f.write("4 0.1 0.2 0.3 0.4 1 %d\n" % (i % 10))
+    B = 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[B, 4], dtype="float32")
+        y = fluid.data(name="y", shape=[B, 1], dtype="int64")
+        pred = fluid.layers.fc(x, 10, act="softmax",
+                               param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(B)
+    ds.set_use_var([x, y])
+    ds.set_filelist([p])
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.asarray(scope.find_var("w").raw().array).copy()
+        exe.infer_from_dataset(main, ds, scope)
+        w1 = np.asarray(scope.find_var("w").raw().array)
+        np.testing.assert_array_equal(w0, w1)  # untouched
+        exe.train_from_dataset(main, ds, scope)
+        w2 = np.asarray(scope.find_var("w").raw().array)
+    assert np.abs(w2 - w0).max() > 1e-6  # training DOES update

@@ -356,3 +356,64 @@ def test_transformer_decoder_causality():
     np.testing.assert_allclose(a[:, :-1], b[:, :-1], rtol=1e-5,
                                atol=1e-6)
     assert np.abs(a[:, -1] - b[:, -1]).max() > 1e-4
+
+
+def test_resnet_nhwc_matches_nchw():
+    """NHWC end-to-end (convs/pools/BN lower natively channels-last, no
+    transposes) must match NCHW exactly: identical losses and updated
+    params over two SGD steps from identical init."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    B = 4
+    rng = np.random.RandomState(0)
+    img = rng.rand(B, 3, 16, 16).astype("float32")
+    lab = rng.randint(0, 10, (B, 1)).astype("int64")
+
+    out = {}
+    for fmt in ("NCHW", "NHWC"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            shape = [B, 3, 16, 16] if fmt == "NCHW" else [B, 16, 16, 3]
+            x = fluid.data(name="x", shape=shape, dtype="float32")
+            label = fluid.data(name="label", shape=[B, 1], dtype="int64")
+            pred = models.resnet(x, class_dim=10, depth=18,
+                                 data_format=fmt)
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            # identical params positionally (layouts share OIHW filters)
+            wr = np.random.RandomState(42)
+            order = []
+            for name, v in main.global_block().vars.items():
+                if getattr(v, "persistable", False):
+                    var = scope.find_var(name)
+                    if var is not None and var.is_initialized():
+                        a = np.asarray(var.raw().array)
+                        if a.dtype.kind == "f":
+                            scope.var(name).get_tensor()._array = \
+                                jnp.asarray((wr.randn(*a.shape) * 0.05)
+                                            .astype(a.dtype))
+                        order.append(name)
+            feed_img = img if fmt == "NCHW" else np.transpose(
+                img, (0, 2, 3, 1))
+            losses = []
+            for _ in range(2):
+                (l,) = exe.run(main, feed={"x": feed_img, "label": lab},
+                               fetch_list=[loss])
+                losses.append(float(np.ravel(l)[0]))
+            params = [np.asarray(scope.find_var(n).raw().array)
+                      for n in order]
+        out[fmt] = (losses, params)
+
+    np.testing.assert_allclose(out["NCHW"][0], out["NHWC"][0],
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(out["NCHW"][1], out["NHWC"][1]):
+        if a.shape == b.shape:
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
