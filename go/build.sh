@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Build the Go inference client + smoke binary against csrc/libptcapi.so.
+# Gated on a Go toolchain being present (not baked into the dev image);
+# tests/test_go_client.py skips cleanly without it.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+if ! command -v go >/dev/null 2>&1; then
+    echo "go toolchain not found — skipping Go client build" >&2
+    exit 3
+fi
+
+REPO="$(cd .. && pwd)"
+[ -f "$REPO/csrc/libptcapi.so" ] || (cd "$REPO/csrc" && ./build.sh)
+
+cd smoke
+go mod init paddle_tpu/go/smoke 2>/dev/null || true
+go mod edit -replace paddle_tpu/go/paddle=../paddle
+go mod tidy
+CGO_ENABLED=1 \
+CGO_LDFLAGS="-L$REPO/csrc -lptcapi -Wl,-rpath,$REPO/csrc" \
+    go build -o smoke .
+echo "built go/smoke/smoke"

@@ -1,0 +1,102 @@
+// Go inference client over the paddle_tpu C ABI.
+//
+// Parity: /root/reference/go/paddle/predictor.go (cgo binding over the
+// reference's paddle_fluid_c library). This binds csrc/libptcapi.so —
+// the same four-entry ABI (PD_NewPredictor / PD_PredictorRun /
+// PD_DeletePredictor / PD_GetLastError) in front of the XLA-compiled
+// predictor.
+//
+// Build (from repo root, after csrc/build.sh):
+//
+//	CGO_CFLAGS="-I$PWD/csrc" CGO_LDFLAGS="-L$PWD/csrc -lptcapi" \
+//	    go build ./go/paddle
+package paddle
+
+// #cgo LDFLAGS: -lptcapi
+// #include <stdint.h>
+// #include <stdlib.h>
+// typedef struct PD_Predictor PD_Predictor;
+// PD_Predictor* PD_NewPredictor(const char* model_dir);
+// int PD_PredictorRun(PD_Predictor*, const char* input_name,
+//                     const float* data, const int64_t* shape,
+//                     int ndims, float* out, int64_t out_capacity,
+//                     int64_t* out_size);
+// void PD_DeletePredictor(PD_Predictor*);
+// const char* PD_GetLastError();
+import "C"
+
+import (
+	"fmt"
+	"runtime"
+	"unsafe"
+)
+
+// Predictor wraps a loaded inference model (a saved
+// save_inference_model directory — JSON or reference __model__ format).
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+// NewPredictor loads the model saved at modelDir.
+func NewPredictor(modelDir string) (*Predictor, error) {
+	cdir := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cdir))
+	cp := C.PD_NewPredictor(cdir)
+	if cp == nil {
+		return nil, fmt.Errorf("paddle: %s", lastError())
+	}
+	p := &Predictor{c: cp}
+	runtime.SetFinalizer(p, (*Predictor).finalize)
+	return p, nil
+}
+
+func (p *Predictor) finalize() {
+	if p.c != nil {
+		C.PD_DeletePredictor(p.c)
+		p.c = nil
+	}
+}
+
+// Close releases the predictor eagerly (the finalizer also covers it).
+func (p *Predictor) Close() { p.finalize() }
+
+func lastError() string {
+	return C.GoString(C.PD_GetLastError())
+}
+
+// Run feeds one float32 input (name + row-major data + shape) and
+// returns the first fetch target's flattened float32 values.
+func (p *Predictor) Run(inputName string, data []float32,
+	shape []int64) ([]float32, error) {
+	if p.c == nil {
+		return nil, fmt.Errorf("paddle: predictor closed")
+	}
+	cname := C.CString(inputName)
+	defer C.free(unsafe.Pointer(cname))
+
+	// first call discovers the output size; grow and retry once
+	capHint := int64(len(data)) * 4
+	if capHint < 1024 {
+		capHint = 1024
+	}
+	for attempt := 0; attempt < 2; attempt++ {
+		out := make([]float32, capHint)
+		var outSize C.int64_t
+		rc := C.PD_PredictorRun(p.c, cname,
+			(*C.float)(unsafe.Pointer(&data[0])),
+			(*C.int64_t)(unsafe.Pointer(&shape[0])),
+			C.int(len(shape)),
+			(*C.float)(unsafe.Pointer(&out[0])),
+			C.int64_t(capHint), &outSize)
+		if rc == 0 {
+			return out[:outSize], nil
+		}
+		if int64(outSize) > capHint { // buffer too small: resize, retry
+			capHint = int64(outSize)
+			continue
+		}
+		return nil, fmt.Errorf("paddle: run failed: %s", lastError())
+	}
+	return nil, fmt.Errorf("paddle: run failed after resize: %s",
+		lastError())
+}

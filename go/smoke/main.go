@@ -1,0 +1,52 @@
+// Smoke test for the Go client (reference r/ + go demo role): load a
+// saved inference model, run one batch, print the output size and a
+// checksum. Driven by tests/test_go_client.py when a Go toolchain is
+// present.
+//
+// Usage: smoke <model_dir> <input_name> <d1,d2,...>
+package main
+
+import (
+	"fmt"
+	"os"
+	"strconv"
+	"strings"
+
+	paddle "paddle_tpu/go/paddle"
+)
+
+func main() {
+	if len(os.Args) != 4 {
+		fmt.Fprintln(os.Stderr,
+			"usage: smoke <model_dir> <input_name> <d1,d2,...>")
+		os.Exit(2)
+	}
+	var shape []int64
+	numel := int64(1)
+	for _, s := range strings.Split(os.Args[3], ",") {
+		d, err := strconv.ParseInt(s, 10, 64)
+		if err != nil {
+			panic(err)
+		}
+		shape = append(shape, d)
+		numel *= d
+	}
+	data := make([]float32, numel)
+	for i := range data {
+		data[i] = float32(i%7) * 0.1
+	}
+	p, err := paddle.NewPredictor(os.Args[1])
+	if err != nil {
+		panic(err)
+	}
+	defer p.Close()
+	out, err := p.Run(os.Args[2], data, shape)
+	if err != nil {
+		panic(err)
+	}
+	sum := float64(0)
+	for _, v := range out {
+		sum += float64(v)
+	}
+	fmt.Printf("OK n=%d sum=%.6f\n", len(out), sum)
+}

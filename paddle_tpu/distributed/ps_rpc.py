@@ -81,6 +81,24 @@ def _array_from(header: dict, raw: bytes) -> np.ndarray:
         header["shape"]).copy()
 
 
+def snapshot_scope_to_dir(executor, scope, dirname: str) -> None:
+    """Serialize every tensor var in ``scope`` into ``dirname`` in the
+    reference tensor-stream format (shared by the server-side
+    'checkpoint' RPC kind and the emulated checkpoint_notify path)."""
+    import os
+
+    from ..core import proto_format
+
+    os.makedirs(dirname, exist_ok=True)
+    for name in list(scope.local_var_names()):
+        val = executor._read_var(scope, name)
+        if val is None or not hasattr(val, "shape"):
+            continue
+        path = os.path.join(dirname, name.replace("/", "_"))
+        with open(path, "wb") as f:
+            f.write(proto_format.serialize_lod_tensor(np.asarray(val)))
+
+
 class HeartBeatMonitor:
     """Per-trainer last-ping tracking (heart_beat_monitor.h:54)."""
 
@@ -265,22 +283,9 @@ class PSServer:
         if kind == "checkpoint":
             # checkpoint_notify_op.cc: snapshot every servable var into
             # the requested directory (reference tensor-stream format)
-            import os
-
-            from ..core import proto_format
-
-            dirname = msg.get("dir", "")
-            os.makedirs(dirname, exist_ok=True)
             with self._lock:
-                names = list(self._scope.local_var_names())
-                for name in names:
-                    val = self._executor._read_var(self._scope, name)
-                    if val is None or not hasattr(val, "shape"):
-                        continue
-                    path = os.path.join(dirname, name.replace("/", "_"))
-                    with open(path, "wb") as f:
-                        f.write(proto_format.serialize_lod_tensor(
-                            np.asarray(val)))
+                snapshot_scope_to_dir(self._executor, self._scope,
+                                      msg.get("dir", ""))
             return {"ok": True}, b""
         if kind == "heartbeat":
             return {"ok": True,

@@ -42,6 +42,7 @@ class Executor:
         self._traceable_cache: Dict = {}
         self._compile_fallbacks: Dict = {}
         self._lod_lowered_cache: Dict = {}
+        self._infer_clone_cache: Dict = {}
         self._closed = False
 
     def close(self):
@@ -212,8 +213,17 @@ class Executor:
         """Side-effect-free dataset pass (reference executor.py:1120):
         runs a for_test clone — backward/optimizer ops pruned by op
         role — so parameters are NEVER mutated, unlike
-        train_from_dataset."""
+        train_from_dataset. The clone is cached per program version: a
+        fresh clone each call would recompile the XLA program every
+        epoch."""
+        from .core.compiler_engine import _program_version
+
         program = program or framework.default_main_program()
+        ver = _program_version(program)
+        clone = self._infer_clone_cache.get(ver)
+        if clone is None:
+            clone = program.clone(for_test=True)
+            self._infer_clone_cache[ver] = clone
         return self.train_from_dataset(
-            program.clone(for_test=True), dataset, scope, thread, debug,
-            fetch_list, fetch_info, print_period)
+            clone, dataset, scope, thread, debug, fetch_list,
+            fetch_info, print_period)

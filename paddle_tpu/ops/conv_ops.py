@@ -109,7 +109,10 @@ def _conv2d(ins, attrs):
 )
 def _depthwise_conv2d(ins, attrs):
     x, w = ins["Input"], ins["Filter"]
-    groups = x.shape[1]  # one group per input channel
+    # one group per input channel — channel axis depends on layout
+    groups = (x.shape[-1]
+              if attrs.get("data_format", "NCHW") == "NHWC"
+              else x.shape[1])
     out = _conv_nd(
         x, w,
         attrs.get("strides", [1, 1]),
@@ -130,16 +133,17 @@ def _depthwise_conv2d(ins, attrs):
            "dilations": [1, 1, 1]},
 )
 def _conv3d(ins, attrs):
-    x, w = ins["Input"], ins["Filter"]
-    pads = _norm_pads(attrs.get("paddings", [0, 0, 0]), 3)
-    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCDHW", "OIDHW", "NCDHW"))
-    out = lax.conv_general_dilated(
-        x, w,
-        window_strides=tuple(attrs.get("strides", [1, 1, 1])),
-        padding=pads,
-        rhs_dilation=tuple(attrs.get("dilations", [1, 1, 1])),
-        dimension_numbers=dn,
-        feature_group_count=attrs.get("groups", 1),
+    data_format = attrs.get("data_format", "NCHW")
+    if data_format in ("NCHW", "AnyLayout"):  # 2d-named default attr
+        data_format = "NCDHW"
+    out = _conv_nd(
+        ins["Input"], ins["Filter"],
+        attrs.get("strides", [1, 1, 1]),
+        attrs.get("paddings", [0, 0, 0]),
+        attrs.get("dilations", [1, 1, 1]),
+        attrs.get("groups", 1),
+        data_format,
+        attrs.get("padding_algorithm", "EXPLICIT"),
     )
     return {"Output": out}
 

@@ -72,12 +72,18 @@ def _add_position_encoding(ins, attrs):
 )
 def _sampling_id(ins, attrs):
     """Sample one column id per row of a [B, C] probability matrix
-    (sampling_id_op.h: uniform u, then the first prefix-sum >= u)."""
+    (sampling_id_op.h: u ~ uniform(min, max), the first prefix-sum >= u,
+    defaulting to the LAST index when u exceeds the row total)."""
     x = ins["X"]
     key = jax.random.PRNGKey(ins[RNG_SEED_ATTR].astype(jnp.uint32))
-    u = jax.random.uniform(key, (x.shape[0], 1), dtype=x.dtype)
+    u = jax.random.uniform(key, (x.shape[0], 1), dtype=x.dtype,
+                           minval=attrs.get("min", 0.0),
+                           maxval=attrs.get("max", 1.0))
     cum = jnp.cumsum(x, axis=1)
-    return {"Out": jnp.argmax(cum >= u, axis=1).astype(jnp.int64)}
+    hit = cum >= u
+    idx = jnp.where(jnp.any(hit, axis=1), jnp.argmax(hit, axis=1),
+                    x.shape[1] - 1)
+    return {"Out": idx.astype(jnp.int64)}
 
 
 @register_op(
@@ -356,25 +362,15 @@ def _lookup_sparse_table(executor, op, scope):
 def _checkpoint_notify(executor, op, scope):
     """checkpoint_notify_op.cc: tell each pserver to snapshot its
     persistable vars into ``dir``."""
-    import os
-
-    from ..core import proto_format
+    from ..distributed.ps_rpc import snapshot_scope_to_dir
     from .distributed_ops import _EMULATED_SERVERS, _rpc_client
 
     dirname = op.attrs.get("dir", "")
-    os.makedirs(dirname, exist_ok=True)
     for ep in op.attrs.get("epmap", []):
         server = _EMULATED_SERVERS.get(ep)
         if server is not None:
-            sc = server["scope"]
-            for name in sc.local_var_names():
-                val = server["executor"]._read_var(sc, name)
-                if val is None or not hasattr(val, "shape"):
-                    continue
-                path = os.path.join(dirname, name.replace("/", "_"))
-                with open(path, "wb") as f:
-                    f.write(proto_format.serialize_lod_tensor(
-                        np.asarray(val)))
+            snapshot_scope_to_dir(server["executor"], server["scope"],
+                                  dirname)
         elif ep:
             _rpc_client(ep).checkpoint(dirname)
 

@@ -101,10 +101,12 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     if build_strategy is not None and hasattr(build_strategy,
                                               "_warn_inert"):
         build_strategy._warn_inert()
-    # GradientScaleStrategy.One: the user's loss already accounts for
-    # the device count — no 1/n loss-grad scale (build_strategy.h)
-    scale_loss = not (build_strategy is not None and getattr(
-        build_strategy, "gradient_scale_strategy", 0) == 1)
+    # GradientScaleStrategy: One and Customized both mean the USER owns
+    # the loss-grad scale (One = already averaged, Customized = their
+    # own scale op) — only the default CoeffNumDevice applies 1/n
+    # (build_strategy.h)
+    scale_loss = (build_strategy is None or getattr(
+        build_strategy, "gradient_scale_strategy", 0) == 0)
     # collective rewrite (insert_allreduce_ops is itself idempotent
     # per program — fleet may have transpiled already). Loss/grad
     # scaling is over the DATA axes only: model-parallel axes see the

@@ -21,17 +21,12 @@ def test_filter_by_instag_forward_backward():
         tags = fluid.data(name="tags", shape=[-1, 1], dtype="int64",
                           lod_level=1)
         ftag = fluid.data(name="ftag", shape=[2], dtype="int64")
-        out = fluid.layers.create_variable(
-            name="fo", dtype="float32") if False else None
         helper_block = main.global_block()
         from paddle_tpu import framework
 
-        ov = helper_block.create_var(name="f_out", shape=None,
-                                     dtype="float32")
-        lw = helper_block.create_var(name="f_lw", shape=None,
-                                     dtype="float32")
-        im = helper_block.create_var(name="f_im", shape=None,
-                                     dtype="int64")
+        for name, dt in (("f_out", "float32"), ("f_lw", "float32"),
+                         ("f_im", "int64")):
+            helper_block.create_var(name=name, shape=None, dtype=dt)
         op = framework.Operator(
             helper_block, "filter_by_instag",
             {"Ins": ["ins"], "Ins_tag": ["tags"], "Filter_tag": ["ftag"]},
