@@ -50,6 +50,10 @@ func NewPredictor(modelDir string) (*Predictor, error) {
 	return p, nil
 }
 
+// keepAlive pins p past its last cgo use so the GC finalizer can't
+// free the C predictor mid-call (use-after-free hazard).
+func (p *Predictor) keepAlive() { runtime.KeepAlive(p) }
+
 func (p *Predictor) finalize() {
 	if p.c != nil {
 		C.PD_DeletePredictor(p.c)
@@ -71,6 +75,10 @@ func (p *Predictor) Run(inputName string, data []float32,
 	if p.c == nil {
 		return nil, fmt.Errorf("paddle: predictor closed")
 	}
+	if len(data) == 0 || len(shape) == 0 {
+		return nil, fmt.Errorf("paddle: empty input data/shape")
+	}
+	defer p.keepAlive()
 	cname := C.CString(inputName)
 	defer C.free(unsafe.Pointer(cname))
 

@@ -21,6 +21,25 @@ from .core.registry import GRAD_SUFFIX, OpInfoMap, ensure_grad_op
 from .utils import unique_name
 
 
+def _op_io(block, op):
+    """Effective (inputs, outputs) of an op for dataflow analysis. A
+    `while` op declares no tensors itself — its body reads/writes
+    parent vars by name (while_op.cc semantics), so its effective IO is
+    the sub-block's external read/write sets restricted to
+    parent-visible vars."""
+    if op.type == "while" and op.attrs.get("sub_block") is not None:
+        from .core.compiler_engine import _block_rw
+
+        written, read_first = _block_rw(op.attrs["sub_block"])
+        ins = [n for n in read_first
+               if block._find_var_recursive(n) is not None]
+        outs = [n for n in written
+                if block._find_var_recursive(n) is not None]
+        return (list(op.input_arg_names) + ins,
+                list(op.output_arg_names) + outs)
+    return list(op.input_arg_names), list(op.output_arg_names)
+
+
 def _find_op_path(block, loss_name: str, req: Set[str]) -> List[int]:
     """Indices of ops that both (a) depend on a grad-requiring var and
     (b) contribute to the loss."""
@@ -28,17 +47,19 @@ def _find_op_path(block, loss_name: str, req: Set[str]) -> List[int]:
     contributes: Set[str] = set(req)
     fwd_ops: Set[int] = set()
     for i, op in enumerate(block.ops):
-        if any(n in contributes for n in op.input_arg_names):
+        ins, outs = _op_io(block, op)
+        if any(n in contributes for n in ins):
             fwd_ops.add(i)
-            contributes.update(op.output_arg_names)
+            contributes.update(outs)
     # backward reachability from loss
     needed: Set[str] = {loss_name}
     path: List[int] = []
     for i in reversed(range(len(block.ops))):
         op = block.ops[i]
-        if i in fwd_ops and any(n in needed for n in op.output_arg_names):
+        ins, outs = _op_io(block, op)
+        if i in fwd_ops and any(n in needed for n in outs):
             path.append(i)
-            needed.update(op.input_arg_names)
+            needed.update(ins)
     return list(reversed(path))
 
 
@@ -179,10 +200,34 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
     if no_grad_set:
         no_grad |= {n if isinstance(n, str) else n.name for n in no_grad_set}
 
+    # a float var REWRITTEN by a while body is no longer the
+    # stop-gradient constant its initializer produced (fill_constant
+    # marks outputs stop_gradient=True by default — the natural init for
+    # a loop carry): severing it here would cut the grad chain through
+    # the loop entirely
+    for op in block.ops:
+        sub = op.attrs.get("sub_block") if op.type == "while" else None
+        if sub is None:
+            continue
+        from .core.compiler_engine import _block_rw
+
+        written, _ = _block_rw(sub)
+        for n in written:
+            v = block._find_var_recursive(n)
+            if v is not None and _is_float_var(v):
+                no_grad.discard(n)
+
     req = _requires_grad_set(block, parameter_list, no_grad)
     # propagate requires-grad forward through the op list
     diffable: Set[str] = set(req)
     for op in block.ops:
+        if op.type == "while":
+            ins, outs = _op_io(block, op)
+            if any(n in diffable for n in ins):
+                for n in outs:
+                    if n not in no_grad:
+                        diffable.add(n)
+            continue
         info = _op_info(op.type)
         if info is None or info.grad is None and not _has_grad_op(op.type):
             continue
@@ -225,14 +270,46 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
     # pending grads per forward var (producers merge on arrival)
     pending: Dict[str, List[str]] = {loss.name: [loss_grad_name]}
     grad_to_var: Dict[str, str] = {loss_grad_name: loss.name}
+    finalize = make_finalize(block, pending)
+
+    _emit_grad_ops(block, [block.ops[i] for i in path], pending,
+                   finalize, diffable, no_grad, recompute_rename,
+                   grad_to_var)
+
+    # finalize leaves (parameters & data): merge their partial grads
+    params_and_grads = []
+    target_params = (
+        [p if isinstance(p, framework.Variable) else block.var(p)
+         for p in parameter_list]
+        if parameter_list is not None
+        else block.program.all_parameters()
+    )
+    for p in target_params:
+        g = finalize(p.name)
+        if g is None:
+            continue
+        params_and_grads.append((p, block.var(g)))
+    return params_and_grads
+
+
+def make_finalize(block, pending: Dict[str, List[str]],
+                  clear_on_merge: bool = False):
+    """Finalize closure: merge a var's pending partial grads into its
+    canonical @GRAD name (sum op emitted into ``block``).
+    ``clear_on_merge`` empties the pending list after the merge — used
+    inside while-grad sub-blocks, where the same NAME is both the loop
+    carry's incoming grad (consumed by the write op's grad) and later
+    the pre-value's partials; without clearing, the consumed canonical
+    would be double-counted at the end-of-block merge."""
 
     def finalize(var_name: str) -> Optional[str]:
-        """Merge pending partial grads of var into canonical var@GRAD."""
         glist = pending.get(var_name)
         if not glist:
             return None
         canonical = grad_name_for(var_name)
         if len(glist) == 1 and glist[0] == canonical:
+            if clear_on_merge:
+                pending[var_name] = []
             return canonical
         _ensure_grad_var(block, var_name, canonical)
         block.append_op(
@@ -241,11 +318,21 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
             outputs={"Out": canonical},
             infer_shape=False,
         )
-        pending[var_name] = [canonical]
+        pending[var_name] = [] if clear_on_merge else [canonical]
         return canonical
 
-    for idx in reversed(path):
-        op = block.ops[idx]
+    return finalize
+
+
+def _emit_grad_ops(block, fwd_ops, pending, finalize, diffable, no_grad,
+                   recompute_rename, grad_to_var):
+    """Reverse-walk ``fwd_ops`` appending grad ops into ``block`` — the
+    shared engine behind append_backward AND while-body grad blocks."""
+    for op in reversed(fwd_ops):
+        if op.type == "while":
+            _emit_while_grad(block, op, pending, finalize, diffable,
+                             no_grad, grad_to_var)
+            continue
         info = _op_info(op.type)
         if info is None:
             continue
@@ -333,22 +420,130 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
         g_attrs = dict(op.attrs)
         g_attrs["_fwd_op_id"] = op._id
         block.append_op(grad_type, g_inputs, g_outputs, g_attrs,
-                        infer_shape=False)
+                       infer_shape=False)
 
-    # finalize leaves (parameters & data): merge their partial grads
-    params_and_grads = []
-    target_params = (
-        [p if isinstance(p, framework.Variable) else block.var(p)
-         for p in parameter_list]
-        if parameter_list is not None
-        else block.program.all_parameters()
-    )
-    for p in target_params:
-        g = finalize(p.name)
-        if g is None:
+
+def _is_float_var(v) -> bool:
+    if v is None or v.dtype is None:
+        return True  # unknown: let the runtime decide
+    return str(v.dtype).startswith(("float", "bfloat"))
+
+
+def _emit_while_grad(block, op, pending, finalize, diffable, no_grad,
+                     grad_to_var):
+    """Backward THROUGH a while loop (reference while_grad,
+    controlflow/while_op.cc WhileGradOp): build a grad sub-block from
+    the body's ops and append ONE while_grad host op that replays the
+    body per saved step in reverse, threading carry grads and
+    accumulating parameter grads.
+
+    Supported body shape (the RNN pattern): each parent-written carry is
+    written once per trip, with every body read of it happening before
+    the write (reads see the previous trip's value)."""
+    from .core.compiler_engine import _block_rw
+
+    sub = op.attrs.get("sub_block")
+    if sub is None:
+        return
+    program = block.program
+    written_all, read_first = _block_rw(sub)
+    parent_written = sorted(
+        n for n in written_all
+        if block._find_var_recursive(n) is not None)
+    parent_read = sorted(
+        n for n in read_first
+        if block._find_var_recursive(n) is not None)
+    carries = sorted(set(parent_written) & set(read_first))
+
+    # incoming grads of the loop's outputs (the final written values)
+    incoming = []
+    for w in parent_written:
+        if not _is_float_var(block._find_var_recursive(w)):
             continue
-        params_and_grads.append((p, block.var(g)))
-    return params_and_grads
+        g = finalize(w)
+        if g is not None:
+            incoming.append((w, g))
+            # fully consumed here: producers BEFORE the loop receive the
+            # pre-loop grad from while_grad's outputs, not this one
+            pending[w] = []
+    if not incoming:
+        return
+
+    targets = [r for r in parent_read
+               if r in diffable and r not in no_grad
+               and _is_float_var(block._find_var_recursive(r))]
+    # carries must be grad-THREADED through trips even when
+    # stop_gradient (fill_constant's default!) excludes them from
+    # user-visible grads: without a per-trip carry grad, every replayed
+    # trip would be reseeded with the stale final-output gradient
+    float_carries = [c for c in carries
+                     if _is_float_var(block._find_var_recursive(c))]
+    thread_targets = sorted(set(targets) | set(float_carries))
+    if not thread_targets:
+        return
+
+    # diffable set inside the body: threaded vars + anything they reach.
+    # Carries leave the no_grad set for the SUB-generation only (their
+    # internal grads are loop plumbing, not user-visible outputs).
+    no_grad2 = set(no_grad) - set(float_carries)
+    diffable2 = set(diffable) | set(thread_targets)
+    for bop in sub.ops:
+        if any(n in diffable2 for n in bop.input_arg_names):
+            for n in bop.output_arg_names:
+                if n not in no_grad2:
+                    diffable2.add(n)
+
+    gblock = program._create_block()
+    pending2: Dict[str, List[str]] = {}
+    seed_names = {}
+    for w, _g in incoming:
+        gname = grad_name_for(w)
+        _ensure_grad_var(gblock, w, gname)
+        pending2[w] = [gname]
+        seed_names[w] = gname
+    finalize2 = make_finalize(gblock, pending2, clear_on_merge=True)
+    _emit_grad_ops(gblock, list(sub.ops), pending2, finalize2,
+                   diffable2, no_grad2, {}, {})
+    inner_grads = {}
+    for r in thread_targets:
+        g = finalize2(r)
+        if g is not None:
+            inner_grads[r] = g
+    program._rollback()
+    if not inner_grads:
+        return
+
+    tgt_list = sorted(inner_grads)
+    # user-visible outputs only for diffable targets; pure-plumbing
+    # carry grads stay internal
+    out_tgt_list = [r for r in tgt_list if r in targets]
+    outer_out = []
+    for r in out_tgt_list:
+        if r in pending and pending[r]:
+            gname = "%s@RENAME@%d" % (grad_name_for(r),
+                                      len(pending[r]))
+        else:
+            gname = grad_name_for(r)
+        _ensure_grad_var(block, r, gname)
+        pending.setdefault(r, []).append(gname)
+        grad_to_var[gname] = r
+        outer_out.append(gname)
+
+    inc_list = [w for w, _ in incoming]
+    gop = framework.Operator(
+        block, "while_grad",
+        {"OutGrads": [g for _, g in incoming]},
+        {"InGrads": outer_out},
+        {"sub_block": gblock, "fwd_block": sub,
+         "snap_var": "@WHILE_SNAPS@%d" % (op._id or 0),
+         "written": inc_list,
+         "seed_names": [seed_names[w] for w in inc_list],
+         "targets": tgt_list,
+         "inner_grads": [inner_grads[r] for r in tgt_list],
+         "out_targets": out_tgt_list,
+         "carries": carries})
+    gop._id = program._next_op_id()
+    block.ops.append(gop)
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

@@ -40,10 +40,32 @@ def _program_version(program) -> Tuple:
 _analysis_cache: Dict = {}
 
 
+_block_rw_cache: "weakref.WeakKeyDictionary" = None  # set below
+
+
 def _block_rw(block) -> Tuple[Set[str], Set[str]]:
     """(written, read-before-written) over a block, recursing through
     while/conditional sub-blocks (their external reads are this block's
-    reads; their writes land in parent vars by name)."""
+    reads; their writes land in parent vars by name). Memoized per
+    block (invalidated by op count): the while op re-derives its
+    snapshot set every execution and backward calls this per while op."""
+    global _block_rw_cache
+    if _block_rw_cache is None:
+        import weakref as _weakref
+
+        _block_rw_cache = _weakref.WeakKeyDictionary()
+    hit = _block_rw_cache.get(block)
+    if hit is not None and hit[0] == len(block.ops):
+        return hit[1]
+    result = _block_rw_impl(block)
+    try:
+        _block_rw_cache[block] = (len(block.ops), result)
+    except TypeError:
+        pass
+    return result
+
+
+def _block_rw_impl(block) -> Tuple[Set[str], Set[str]]:
     written: Set[str] = set()
     read_first: Set[str] = set()
     for op in block.ops:

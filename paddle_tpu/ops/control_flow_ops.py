@@ -49,6 +49,36 @@ def _fetch(executor, op, scope):
     arr[col] = val.raw()
 
 
+def _copy_holder(h):
+    """Snapshot-copy a var holder: jax arrays are immutable so sharing
+    them is safe, but the WRAPPERS mutate in place (LoDTensor.set swaps
+    _array on the same object; arrays append)."""
+    from ..core.tensor import LoDTensor, LoDTensorArray, SelectedRows
+
+    if isinstance(h, LoDTensor):
+        t = LoDTensor(h.array)
+        if h.lod():
+            t.set_lod([list(l) for l in h.lod()])
+        return t
+    if isinstance(h, LoDTensorArray):
+        a = LoDTensorArray()
+        for item in h:
+            a.append(_copy_holder(item) if item is not None else None)
+        return a
+    if isinstance(h, SelectedRows):
+        s = SelectedRows(rows=list(h.rows()), height=h.height())
+        s._value = _copy_holder(h.get_tensor())
+        return s
+    return h
+
+
+def _while_snapshot_names(sub_block):
+    from ..core.compiler_engine import _block_rw
+
+    written, read_first = _block_rw(sub_block)
+    return sorted(read_first | written)
+
+
 @register_host_op(
     "while",
     inputs=[In("Condition", no_grad=True), In("X", duplicable=True, dispensable=True)],
@@ -59,11 +89,24 @@ def _fetch(executor, op, scope):
 def _while(executor, op, scope):
     sub_block = op.attrs["sub_block"]
     cond_name = op.input("Condition")[0]
+    # training mode: save a PRE-trip snapshot of every external value
+    # the body reads/writes (the reference's StepScopes,
+    # while_op.cc:70) — while_grad replays each trip from it
+    save = not op.attrs.get("is_test", False)
+    snaps = [] if save else None
+    snap_names = _while_snapshot_names(sub_block) if save else ()
     steps = 0
     while True:
         cond = executor._read_var(scope, cond_name)
         if not bool(np.asarray(cond).reshape(())):
             break
+        if save:
+            pre = {}
+            for name in snap_names:
+                var = scope.find_var(name)
+                if var is not None and var.is_initialized():
+                    pre[name] = _copy_holder(var.raw())
+            snaps.append(pre)
         body_scope = scope.new_scope()
         executor.run_block(sub_block, body_scope)
         # while-op semantics: body writes to parent-scope vars directly via
@@ -74,7 +117,117 @@ def _while(executor, op, scope):
         steps += 1
         if steps > 10_000_000:
             raise RuntimeError("while op exceeded max trip count")
+    if save:
+        scope.var("@WHILE_SNAPS@%d" % (op._id or 0)).set(snaps)
     scope.drop_kids()
+
+
+@register_host_op(
+    "while_grad",
+    inputs=[In("OutGrads", duplicable=True, dispensable=True,
+               no_grad=True)],
+    outputs=[Out("InGrads", duplicable=True, dispensable=True)],
+    attrs={"sub_block": None, "fwd_block": None, "snap_var": "",
+           "written": [], "seed_names": [], "targets": [],
+           "inner_grads": [], "out_targets": [], "carries": []},
+)
+def _while_grad(executor, op, scope):
+    """Backward through a while loop (while_op.cc WhileGradOp): for each
+    saved forward trip, in reverse — replay the body from its PRE-trip
+    snapshot (remat: temporaries are recomputed, not stored), restore
+    carries to their pre values, seed the incoming grads, run the grad
+    sub-block, then thread carry grads to the previous trip and
+    accumulate parameter grads across trips."""
+    import jax.numpy as jnp
+
+    grad_block = op.attrs["sub_block"]
+    fwd_block = op.attrs["fwd_block"]
+    written = list(op.attrs["written"])
+    seed_names = list(op.attrs["seed_names"])
+    targets = list(op.attrs["targets"])
+    inner_grads = list(op.attrs["inner_grads"])
+    carries = set(op.attrs["carries"])
+
+    snaps_var = scope.find_var(op.attrs["snap_var"])
+    snaps = snaps_var.raw() if (snaps_var is not None
+                                and snaps_var.is_initialized()) else []
+
+    def _zeros_like_name(name, lookup_scope):
+        var = lookup_scope.find_var(name)
+        if var is None or not var.is_initialized():
+            return None
+        arr = var.raw().array if hasattr(var.raw(), "array") else None
+        return None if arr is None else jnp.zeros_like(arr)
+
+    # incoming grads for the loop outputs (final values)
+    carry_g = {}
+    for w, gname in zip(written, op.input("OutGrads")):
+        if gname and gname != "@EMPTY@":
+            v = executor._read_var(scope, gname)
+            if v is not None:
+                carry_g[w] = v
+
+    param_acc = {}
+    for pre in reversed(snaps or []):
+        gs = scope.new_scope()
+        for name, holder in pre.items():
+            gs.var(name).set(_copy_holder(holder))
+        # replay the trip: temporaries materialize locally
+        executor.run_block(fwd_block, gs)
+        # carries back to PRE values (their readers saw the previous
+        # trip's value; the supported body shape writes each carry once,
+        # after all its reads)
+        for c in carries:
+            if c in pre:
+                gs.var(c).set(_copy_holder(pre[c]))
+        # seed incoming output grads (zeros when nothing arrived yet)
+        for w, sname in zip(written, seed_names):
+            g = carry_g.get(w)
+            if g is None:
+                g = _zeros_like_name(w, gs)
+            if g is not None:
+                executor._write_var(gs, sname, g)
+        executor.run_block(grad_block, gs)
+        for r, iname in zip(targets, inner_grads):
+            var = gs.find_local_var(iname) or gs.find_var(iname)
+            if var is None or not var.is_initialized():
+                g = None
+            else:
+                g = var.raw().array if hasattr(var.raw(), "array") \
+                    else None
+            if r in carries:
+                # grad w.r.t. the PRE-trip value = the incoming grad for
+                # the previous trip
+                if g is not None:
+                    carry_g[r] = g
+                elif r in carry_g:
+                    carry_g.pop(r)
+            elif g is not None:
+                acc = param_acc.get(r)
+                param_acc[r] = g if acc is None else acc + g
+        # write-only outputs are overwritten every trip: only the LAST
+        # trip's write sees the outer grad
+        for w in written:
+            if w not in carries and w in carry_g:
+                carry_g.pop(w)
+        # release this trip's replay scope — remat's point is O(1-trip)
+        # peak memory, not O(T) pinned temporaries
+        scope._kids.remove(gs)
+
+    # emit outputs: params get accumulated grads; carries get the grad
+    # w.r.t. the pre-loop value (identity pass-through on zero trips)
+    out_targets = list(op.attrs.get("out_targets", targets))
+    for r, oname in zip(out_targets, op.output("InGrads")):
+        if not oname or oname == "@EMPTY@":
+            continue
+        if r in carries:
+            g = carry_g.get(r)
+        else:
+            g = param_acc.get(r)
+        if g is None:
+            g = _zeros_like_name(r, scope)
+        if g is not None:
+            executor._write_var(scope, oname, g)
 
 
 @register_host_op(
