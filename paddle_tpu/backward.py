@@ -197,14 +197,15 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
         for v in b.vars.values():
             if v.stop_gradient:
                 no_grad.add(v.name)
-    if no_grad_set:
-        no_grad |= {n if isinstance(n, str) else n.name for n in no_grad_set}
+    user_no_grad = {n if isinstance(n, str) else n.name
+                    for n in (no_grad_set or ())}
+    no_grad |= user_no_grad
 
     # a float var REWRITTEN by a while body is no longer the
     # stop-gradient constant its initializer produced (fill_constant
     # marks outputs stop_gradient=True by default — the natural init for
     # a loop carry): severing it here would cut the grad chain through
-    # the loop entirely
+    # the loop entirely. An EXPLICIT user no_grad_set entry still wins.
     for op in block.ops:
         sub = op.attrs.get("sub_block") if op.type == "while" else None
         if sub is None:
@@ -214,7 +215,8 @@ def _append_backward_impl(loss, block, program, parameter_list=None,
         written, _ = _block_rw(sub)
         for n in written:
             v = block._find_var_recursive(n)
-            if v is not None and _is_float_var(v):
+            if v is not None and _is_float_var(v) \
+                    and n not in user_no_grad:
                 no_grad.discard(n)
 
     req = _requires_grad_set(block, parameter_list, no_grad)
@@ -456,13 +458,13 @@ def _emit_while_grad(block, op, pending, finalize, diffable, no_grad,
     carries = sorted(set(parent_written) & set(read_first))
 
     # incoming grads of the loop's outputs (the final written values)
-    incoming = []
+    incoming = {}
     for w in parent_written:
         if not _is_float_var(block._find_var_recursive(w)):
             continue
         g = finalize(w)
         if g is not None:
-            incoming.append((w, g))
+            incoming[w] = g
             # fully consumed here: producers BEFORE the loop receive the
             # pre-loop grad from while_grad's outputs, not this one
             pending[w] = []
@@ -496,7 +498,12 @@ def _emit_while_grad(block, op, pending, finalize, diffable, no_grad,
     gblock = program._create_block()
     pending2: Dict[str, List[str]] = {}
     seed_names = {}
-    for w, _g in incoming:
+    # seed EVERY float carry, not only those with outer grads: a carry
+    # without a user-visible consumer can still carry cross-trip
+    # gradient between interacting carries (h1 <- f(h2) <- previous
+    # trip's h1); the host zero-seeds entries with no value yet
+    seeded = sorted(set(incoming) | set(float_carries))
+    for w in seeded:
         gname = grad_name_for(w)
         _ensure_grad_var(gblock, w, gname)
         pending2[w] = [gname]
@@ -529,15 +536,14 @@ def _emit_while_grad(block, op, pending, finalize, diffable, no_grad,
         grad_to_var[gname] = r
         outer_out.append(gname)
 
-    inc_list = [w for w, _ in incoming]
     gop = framework.Operator(
         block, "while_grad",
-        {"OutGrads": [g for _, g in incoming]},
+        {"OutGrads": [incoming.get(w, "@EMPTY@") for w in seeded]},
         {"InGrads": outer_out},
         {"sub_block": gblock, "fwd_block": sub,
          "snap_var": "@WHILE_SNAPS@%d" % (op._id or 0),
-         "written": inc_list,
-         "seed_names": [seed_names[w] for w in inc_list],
+         "written": seeded,
+         "seed_names": [seed_names[w] for w in seeded],
          "targets": tgt_list,
          "inner_grads": [inner_grads[r] for r in tgt_list],
          "out_targets": out_tgt_list,

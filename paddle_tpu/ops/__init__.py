@@ -40,3 +40,4 @@ from . import var_conv_ops  # noqa: F401
 from . import hybrid_parallel_ops  # noqa: F401
 from . import ctr_ops  # noqa: F401
 from . import tail_ops3  # noqa: F401
+from . import text_match_ops  # noqa: F401

@@ -189,7 +189,10 @@ def _while_grad(executor, op, scope):
                 executor._write_var(gs, sname, g)
         executor.run_block(grad_block, gs)
         for r, iname in zip(targets, inner_grads):
-            var = gs.find_local_var(iname) or gs.find_var(iname)
+            # LOCAL lookup only: grad ops write into gs; walking up to
+            # the persistent outer scope could only surface a STALE
+            # @GRAD from a previous exe.run and double-count it
+            var = gs.find_local_var(iname)
             if var is None or not var.is_initialized():
                 g = None
             else:

@@ -1,0 +1,805 @@
+"""Text-matching + SSD-mining op family (registry-parity wave 5).
+
+Parity targets:
+- match_matrix_tensor_op.cc — bilinear text match over LoD pairs
+- sequence_ops/sequence_topk_avg_pooling_op.h — top-k average pooling
+  over per-pair score grids
+- similarity_focus_op.h — greedy row/col focus mask
+- lookup_table_dequant_op.h — embedding lookup decoding uint8-packed
+  rows (min/max in the first two floats)
+- detection/mine_hard_examples_op.cc — SSD OHEM negative mining
+- detection/rpn_target_assign_op.cc:1032 retinanet_target_assign
+"""
+from __future__ import annotations
+
+import struct
+from typing import List
+
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op
+from ..core.tensor import LoDTensor
+
+
+def _holder(scope, name):
+    var = scope.find_var(name)
+    return None if var is None or not var.is_initialized() else var.raw()
+
+
+def _lod0(holder, n_rows):
+    if hasattr(holder, "lod") and holder.lod():
+        return list(holder.lod()[-1])
+    return [0, n_rows]
+
+
+@register_host_op(
+    "lookup_table_dequant",
+    inputs=[In("W", no_grad=True), In("Ids", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"padding_idx": -1},
+)
+def _lookup_table_dequant(executor, op, scope):
+    """lookup_table_dequant_op.h: each table row stores [min, max,
+    packed...] where every packed float32's 4 BYTES are uint8 codes;
+    out = code * (max - min) / 256 + min — a 4x-compressed embedding."""
+    w = np.asarray(executor._read_var(scope, op.input("W")[0]))
+    ids = np.asarray(executor._read_var(
+        scope, op.input("Ids")[0])).reshape(-1)
+    pad = int(op.attrs.get("padding_idx", -1))
+    width = (w.shape[1] - 2) * 4
+    out = []
+    for i in ids:
+        if pad >= 0 and int(i) == pad:
+            out.append(np.zeros(width, np.float32))
+            continue
+        row = w[int(i)]
+        lo, hi = float(row[0]), float(row[1])
+        codes = np.frombuffer(
+            np.asarray(row[2:], dtype=np.float32).tobytes(),
+            dtype=np.uint8).astype(np.float32)
+        out.append(codes * (hi - lo) / 256.0 + lo)
+    executor._write_var(scope, op.output("Out")[0],
+                        np.stack(out).astype("float32") if out
+                        else np.zeros((0, (w.shape[1] - 2) * 4),
+                                      "float32"))
+
+
+@register_host_op(
+    "match_matrix_tensor",
+    inputs=[In("X"), In("Y"), In("W")],
+    outputs=[Out("Out"), Out("Tmp")],
+    attrs={"dim_t": 1},
+)
+def _match_matrix_tensor(executor, op, scope):
+    """match_matrix_tensor_op.cc: per (x_seq, y_seq) pair and per
+    channel t: score[i,j] = x_i . W_t . y_j; Out is the ragged stack of
+    [dim_t, len_x, len_y] grids (one LoD segment per pair), Tmp caches
+    x.W for the backward."""
+    xh = _holder(scope, op.input("X")[0])
+    yh = _holder(scope, op.input("Y")[0])
+    x = np.asarray(xh.array)
+    y = np.asarray(yh.array)
+    w = np.asarray(executor._read_var(scope, op.input("W")[0]))
+    w_t = w.transpose(1, 0, 2)  # [dim_t, h, h]
+    x_lod = _lod0(xh, x.shape[0])
+    y_lod = _lod0(yh, y.shape[0])
+    outs, tmps, out_lod = [], [], [0]
+    for i in range(len(x_lod) - 1):
+        xs = x[x_lod[i]:x_lod[i + 1]]
+        ys = y[y_lod[i]:y_lod[i + 1]]
+        t = np.einsum("ih,thk->itk", xs, w_t)    # [lx, dim_t, h]
+        tmps.append(t.reshape(-1, 1))
+        grid = np.einsum("itk,jk->tij", t, ys)   # [dim_t, lx, ly]
+        outs.append(grid.reshape(-1, 1))
+        out_lod.append(out_lod[-1] + grid.size)
+    out = (np.concatenate(outs) if outs
+           else np.zeros((0, 1), x.dtype)).astype(x.dtype)
+    t = LoDTensor(out)
+    t.set_lod([out_lod])
+    executor._write_var(scope, op.output("Out")[0], t)
+    executor._write_var(scope, op.output("Tmp")[0],
+                        (np.concatenate(tmps) if tmps
+                         else np.zeros((0, 1), x.dtype)).astype(x.dtype))
+
+
+def _match_matrix_grad_maker(block, op, pending, finalize):
+    from .control_flow_ops import _bind_partial_grad
+
+    og = finalize(op.output("Out")[0])
+    if og is None:
+        return
+    gx = _bind_partial_grad(block, pending, op.input("X")[0])
+    gy = _bind_partial_grad(block, pending, op.input("Y")[0])
+    gw = _bind_partial_grad(block, pending, op.input("W")[0])
+    block.append_op(
+        "match_matrix_tensor_grad",
+        {"X": [op.input("X")[0]], "Y": [op.input("Y")[0]],
+         "W": [op.input("W")[0]], "Out@GRAD": [og]},
+        {"X@GRAD": [gx], "Y@GRAD": [gy], "W@GRAD": [gw]},
+        dict(op.attrs), infer_shape=False)
+
+
+@register_host_op(
+    "match_matrix_tensor_grad",
+    inputs=[In("X", no_grad=True), In("Y", no_grad=True),
+            In("W", no_grad=True), In("Out@GRAD", no_grad=True)],
+    outputs=[Out("X@GRAD"), Out("Y@GRAD"), Out("W@GRAD")],
+    attrs={"dim_t": 1},
+)
+def _match_matrix_tensor_grad(executor, op, scope):
+    xh = _holder(scope, op.input("X")[0])
+    yh = _holder(scope, op.input("Y")[0])
+    x = np.asarray(xh.array)
+    y = np.asarray(yh.array)
+    w = np.asarray(executor._read_var(scope, op.input("W")[0]))
+    og = np.asarray(executor._read_var(
+        scope, op.input("Out@GRAD")[0])).reshape(-1)
+    w_t = w.transpose(1, 0, 2)
+    x_lod = _lod0(xh, x.shape[0])
+    y_lod = _lod0(yh, y.shape[0])
+    gx = np.zeros_like(x)
+    gy = np.zeros_like(y)
+    gw_t = np.zeros_like(w_t)
+    off = 0
+    for i in range(len(x_lod) - 1):
+        xs = x[x_lod[i]:x_lod[i + 1]]
+        ys = y[y_lod[i]:y_lod[i + 1]]
+        lx, ly = xs.shape[0], ys.shape[0]
+        dim_t = w.shape[1]
+        n = ly * dim_t * lx
+        g = og[off:off + n].reshape(dim_t, lx, ly)   # [t, i, j]
+        off += n
+        # score[t,i,j] = x_i W_t y_j
+        gx[x_lod[i]:x_lod[i + 1]] += np.einsum(
+            "tij,thk,jk->ih", g, w_t, ys)
+        gy[y_lod[i]:y_lod[i + 1]] += np.einsum(
+            "tij,ih,thk->jk", g, xs, w_t)
+        gw_t += np.einsum("tij,ih,jk->thk", g, xs, ys)
+    executor._write_var(scope, op.output("X@GRAD")[0], gx)
+    executor._write_var(scope, op.output("Y@GRAD")[0], gy)
+    executor._write_var(scope, op.output("W@GRAD")[0],
+                        gw_t.transpose(1, 0, 2))
+
+
+from ..core.registry import OpInfoMap  # noqa: E402
+
+OpInfoMap.instance().get("match_matrix_tensor").grad = \
+    _match_matrix_grad_maker
+
+
+@register_host_op(
+    "sequence_topk_avg_pooling",
+    inputs=[In("X"), In("ROW", no_grad=True), In("COLUMN", no_grad=True)],
+    outputs=[Out("Out"), Out("pos", no_grad=True)],
+    attrs={"topks": [1], "channel_num": 1},
+)
+def _sequence_topk_avg_pooling(executor, op, scope):
+    """sequence_topk_avg_pooling_op.h: X is the ragged stack of
+    [channel, row, col] score grids (ROW/COLUMN carry the per-pair
+    row/col lods); out[r, c, k] = mean of the top-k entries of row r of
+    channel c. `pos` saves the top-k column indices for the backward."""
+    xh = _holder(scope, op.input("X")[0])
+    rh = _holder(scope, op.input("ROW")[0])
+    ch = _holder(scope, op.input("COLUMN")[0])
+    x = np.asarray(xh.array).reshape(-1)
+    topks = [int(k) for k in op.attrs["topks"]]
+    chan = int(op.attrs["channel_num"])
+    max_k = topks[-1]
+    k_num = len(topks)
+    in_lod = _lod0(xh, x.shape[0])
+    row_lod = _lod0(rh, np.asarray(rh.array).shape[0])
+    col_lod = _lod0(ch, np.asarray(ch.array).shape[0])
+    bs = len(row_lod) - 1
+    total_rows = row_lod[-1]
+    out = np.zeros((total_rows, chan * k_num), np.float32)
+    pos = np.full(total_rows * chan * max_k, -1, np.int32)
+    for i in range(bs):
+        rs = row_lod[i + 1] - row_lod[i]
+        cs = col_lod[i + 1] - col_lod[i]
+        grid = x[in_lod[i]:in_lod[i + 1]].reshape(chan, rs, cs)
+        for j in range(chan):
+            for r in range(rs):
+                rowd = grid[j, r]
+                order = np.argsort(-rowd, kind="stable")[:max_k]
+                p0 = ((row_lod[i] + r) * chan + j) * max_k
+                pos[p0:p0 + len(order)] = order
+                csum, run = [], 0.0
+                for k in range(max_k):
+                    if k < len(order):
+                        run += rowd[order[k]]
+                    csum.append(run)
+                for kk, k in enumerate(topks):
+                    out[row_lod[i] + r, j * k_num + kk] = \
+                        csum[k - 1] / k
+    t = LoDTensor(out)
+    t.set_lod([list(row_lod)])
+    executor._write_var(scope, op.output("Out")[0], t)
+    executor._write_var(scope, op.output("pos")[0], pos)
+
+
+def _topk_avg_grad_maker(block, op, pending, finalize):
+    from .control_flow_ops import _bind_partial_grad
+
+    og = finalize(op.output("Out")[0])
+    if og is None:
+        return
+    gx = _bind_partial_grad(block, pending, op.input("X")[0])
+    block.append_op(
+        "sequence_topk_avg_pooling_grad",
+        {"X": [op.input("X")[0]], "ROW": [op.input("ROW")[0]],
+         "COLUMN": [op.input("COLUMN")[0]],
+         "pos": [op.output("pos")[0]], "Out@GRAD": [og]},
+        {"X@GRAD": [gx]}, dict(op.attrs), infer_shape=False)
+
+
+@register_host_op(
+    "sequence_topk_avg_pooling_grad",
+    inputs=[In("X", no_grad=True), In("ROW", no_grad=True),
+            In("COLUMN", no_grad=True), In("pos", no_grad=True),
+            In("Out@GRAD", no_grad=True)],
+    outputs=[Out("X@GRAD")],
+    attrs={"topks": [1], "channel_num": 1},
+)
+def _sequence_topk_avg_pooling_grad(executor, op, scope):
+    xh = _holder(scope, op.input("X")[0])
+    rh = _holder(scope, op.input("ROW")[0])
+    ch = _holder(scope, op.input("COLUMN")[0])
+    x = np.asarray(xh.array).reshape(-1)
+    og = np.asarray(executor._read_var(scope, op.input("Out@GRAD")[0]))
+    pos = np.asarray(executor._read_var(scope, op.input("pos")[0]))
+    topks = [int(k) for k in op.attrs["topks"]]
+    chan = int(op.attrs["channel_num"])
+    max_k = topks[-1]
+    k_num = len(topks)
+    in_lod = _lod0(xh, x.shape[0])
+    row_lod = _lod0(rh, np.asarray(rh.array).shape[0])
+    col_lod = _lod0(ch, np.asarray(ch.array).shape[0])
+    gx = np.zeros_like(x, dtype=np.float32)
+    og = og.reshape(row_lod[-1], chan * k_num)
+    for i in range(len(row_lod) - 1):
+        rs = row_lod[i + 1] - row_lod[i]
+        cs = col_lod[i + 1] - col_lod[i]
+        for j in range(chan):
+            for r in range(rs):
+                base = in_lod[i] + (j * rs + r) * cs
+                p0 = ((row_lod[i] + r) * chan + j) * max_k
+                for kk, k in enumerate(topks):
+                    g = og[row_lod[i] + r, j * k_num + kk] / k
+                    for k2 in range(min(k, max_k)):
+                        c = pos[p0 + k2]
+                        if c >= 0:
+                            gx[base + c] += g
+    executor._write_var(scope, op.output("X@GRAD")[0],
+                        gx.reshape(np.asarray(xh.array).shape))
+
+
+OpInfoMap.instance().get("sequence_topk_avg_pooling").grad = \
+    _topk_avg_grad_maker
+
+
+@register_host_op(
+    "similarity_focus",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"axis": 1, "indexes": []},
+)
+def _similarity_focus(executor, op, scope):
+    """similarity_focus_op.h: per batch item and per selected index on
+    `axis`, greedily pick maxima of the remaining 2-D slice whose row
+    AND column are both unused; broadcast a 1-mask along `axis` at each
+    picked cell."""
+    x = np.asarray(executor._read_var(scope, op.input("X")[0]))
+    axis = int(op.attrs["axis"])
+    indexes = [int(i) for i in op.attrs["indexes"]]
+    out = np.zeros_like(x)
+    other = [a for a in (1, 2, 3) if a != axis]
+    for b in range(x.shape[0]):
+        for index in indexes:
+            sl = np.take(x[b], index, axis=axis - 1)  # 2-D [d_o1, d_o2]
+            order = np.argsort(-sl.reshape(-1), kind="stable")
+            tag1 = np.zeros(sl.shape[0], bool)
+            tag2 = np.zeros(sl.shape[1], bool)
+            picked = 0
+            limit = min(sl.shape)
+            for flat in order:
+                i1, i2 = divmod(int(flat), sl.shape[1])
+                if tag1[i1] or tag2[i2]:
+                    continue
+                tag1[i1] = tag2[i2] = True
+                picked += 1
+                idx = [b, None, None, None]
+                idx[other[0]] = i1
+                idx[other[1]] = i2
+                sel = [slice(None) if v is None else v for v in idx]
+                out[tuple(sel)] = 1
+                if picked == limit:
+                    break
+    executor._write_var(scope, op.output("Out")[0], out)
+
+
+@register_host_op(
+    "mine_hard_examples",
+    inputs=[In("ClsLoss", no_grad=True), In("LocLoss", dispensable=True,
+                                            no_grad=True),
+            In("MatchIndices", no_grad=True), In("MatchDist",
+                                                 no_grad=True)],
+    outputs=[Out("NegIndices"), Out("UpdatedMatchIndices")],
+    attrs={"neg_pos_ratio": 1.0, "neg_dist_threshold": 0.5,
+           "mining_type": "max_negative", "sample_size": 0},
+)
+def _mine_hard_examples(executor, op, scope):
+    """detection/mine_hard_examples_op.cc: SSD OHEM — rank eligible
+    priors by loss, keep the hardest negatives (ratio-capped for
+    max_negative, sample_size-capped for hard_example)."""
+    cls = np.asarray(executor._read_var(scope, op.input("ClsLoss")[0]))
+    loc_names = op.input("LocLoss")
+    loc = (np.asarray(executor._read_var(scope, loc_names[0]))
+           if loc_names else None)
+    mi = np.asarray(executor._read_var(
+        scope, op.input("MatchIndices")[0])).astype(np.int32)
+    md = np.asarray(executor._read_var(scope, op.input("MatchDist")[0]))
+    ratio = float(op.attrs.get("neg_pos_ratio", 1.0))
+    thresh = float(op.attrs.get("neg_dist_threshold", 0.5))
+    mtype = op.attrs.get("mining_type", "max_negative")
+    sample_size = int(op.attrs.get("sample_size", 0))
+    B, P = mi.shape
+    upd = mi.copy()
+    neg_rows: List[np.ndarray] = []
+    lod = [0]
+    for n in range(B):
+        if mtype == "max_negative":
+            elig = np.where((mi[n] == -1) & (md[n] < thresh))[0]
+        else:
+            elig = np.arange(P)
+        loss = cls[n, elig]
+        if mtype == "hard_example" and loc is not None:
+            loss = loss + loc[n, elig]
+        if mtype == "max_negative":
+            num_pos = int((mi[n] != -1).sum())
+            neg_sel = min(int(num_pos * ratio), len(elig))
+        else:
+            neg_sel = min(sample_size, len(elig))
+        order = np.argsort(-loss, kind="stable")[:neg_sel]
+        sel = set(int(e) for e in elig[order])
+        negs = []
+        if mtype == "hard_example":
+            for m in range(P):
+                if mi[n, m] > -1:
+                    if m not in sel:
+                        upd[n, m] = -1
+                elif m in sel:
+                    negs.append(m)
+        else:
+            negs = sorted(sel)
+        neg_rows.append(np.asarray(negs, np.int32))
+        lod.append(lod[-1] + len(negs))
+    out = (np.concatenate(neg_rows).reshape(-1, 1) if lod[-1]
+           else np.zeros((0, 1), np.int32))
+    t = LoDTensor(out)
+    t.set_lod([lod])
+    executor._write_var(scope, op.output("NegIndices")[0], t)
+    executor._write_var(scope, op.output("UpdatedMatchIndices")[0], upd)
+
+
+@register_host_op(
+    "retinanet_target_assign",
+    inputs=[In("Anchor", no_grad=True), In("GtBoxes", no_grad=True),
+            In("GtLabels", no_grad=True), In("IsCrowd", no_grad=True),
+            In("ImInfo", no_grad=True)],
+    outputs=[Out("LocationIndex"), Out("ScoreIndex"), Out("TargetBBox"),
+             Out("TargetLabel"), Out("BBoxInsideWeight"),
+             Out("ForegroundNumber")],
+    attrs={"positive_overlap": 0.5, "negative_overlap": 0.4},
+)
+def _retinanet_target_assign(executor, op, scope):
+    """rpn_target_assign_op.cc RetinanetTargetAssignKernel: focal-loss
+    target assignment — ALL anchors kept (no subsampling), fg labels
+    come from GtLabels, bg labeled 0, per-image foreground count + 1."""
+    from .proposal_ops import _box_to_delta, _iou_matrix, _score_assign
+
+    anchors = np.asarray(executor._read_var(
+        scope, op.input("Anchor")[0])).reshape(-1, 4)
+    gbh = _holder(scope, op.input("GtBoxes")[0])
+    glh = _holder(scope, op.input("GtLabels")[0])
+    ich = _holder(scope, op.input("IsCrowd")[0])
+    gt_all = np.asarray(gbh.array).reshape(-1, 4)
+    lbl_all = np.asarray(glh.array).reshape(-1)
+    crowd_all = np.asarray(ich.array).reshape(-1)
+    im_info = np.asarray(executor._read_var(
+        scope, op.input("ImInfo")[0])).reshape(-1, 3)
+    gt_lod = _lod0(gbh, gt_all.shape[0])
+    pos = float(op.attrs.get("positive_overlap", 0.5))
+    neg = float(op.attrs.get("negative_overlap", 0.4))
+    rng = np.random.RandomState(0)
+    A = anchors.shape[0]
+    loc_all, score_all, lbl_out, tgt_all, w_all, fg_all = \
+        [], [], [], [], [], []
+    for i in range(len(gt_lod) - 1):
+        gts = gt_all[gt_lod[i]:gt_lod[i + 1]]
+        lbls = lbl_all[gt_lod[i]:gt_lod[i + 1]]
+        crowd = crowd_all[gt_lod[i]:gt_lod[i + 1]]
+        keep = crowd == 0
+        gts, lbls = gts[keep] * im_info[i, 2], lbls[keep]
+        iou = _iou_matrix(anchors, gts)
+        fg, bg, fg_fake, inside_w = _score_assign(
+            iou, -1, -1.0, pos, neg, rng, False)
+        argmax = (iou.argmax(axis=1) if gts.shape[0]
+                  else np.zeros(A, np.int64))
+        labels = np.concatenate([
+            lbls[argmax[fg]].astype(np.int32) if len(fg)
+            else np.zeros(0, np.int32),
+            np.zeros(len(bg), np.int32)])
+        loc_all.append((np.asarray(fg_fake, np.int64)
+                        + i * A).astype("int32"))
+        score_all.append((np.concatenate([fg, bg]).astype(np.int64)
+                          + i * A).astype("int32")
+                         if (fg or bg) else np.zeros(0, np.int32))
+        lbl_out.append(labels)
+        tgt_all.append(_box_to_delta(anchors[fg_fake], gts[argmax[fg_fake]])
+                       if len(fg_fake) else np.zeros((0, 4)))
+        w_all.append(np.asarray(inside_w, "float32").reshape(-1, 4))
+        fg_all.append(len(fg_fake) + 1)
+    executor._write_var(scope, op.output("LocationIndex")[0],
+                        np.concatenate(loc_all).astype("int32")
+                        if loc_all else np.zeros(0, np.int32))
+    executor._write_var(scope, op.output("ScoreIndex")[0],
+                        np.concatenate(score_all).astype("int32"))
+    executor._write_var(scope, op.output("TargetLabel")[0],
+                        np.concatenate(lbl_out).reshape(-1, 1)
+                        .astype("int32"))
+    executor._write_var(scope, op.output("TargetBBox")[0],
+                        np.concatenate(tgt_all).astype("float32"))
+    executor._write_var(scope, op.output("BBoxInsideWeight")[0],
+                        np.concatenate(w_all).astype("float32"))
+    executor._write_var(scope, op.output("ForegroundNumber")[0],
+                        np.asarray(fg_all, np.int32).reshape(-1, 1))
+
+
+@register_host_op(
+    "generate_proposal_labels",
+    inputs=[In("RpnRois", no_grad=True), In("GtClasses", no_grad=True),
+            In("IsCrowd", no_grad=True), In("GtBoxes", no_grad=True),
+            In("ImInfo", no_grad=True)],
+    outputs=[Out("Rois"), Out("LabelsInt32"), Out("BboxTargets"),
+             Out("BboxInsideWeights"), Out("BboxOutsideWeights")],
+    attrs={"batch_size_per_im": 256, "fg_fraction": 0.25,
+           "fg_thresh": 0.5, "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+           "bbox_reg_weights": [0.1, 0.1, 0.2, 0.2], "class_nums": 81,
+           "use_random": True, "is_cascade_rcnn": False,
+           "is_cls_agnostic": False, "seed": 0},
+)
+def _generate_proposal_labels(executor, op, scope):
+    """detection/generate_proposal_labels_op.cc SampleRoisForOneImage:
+    concat gts onto rpn rois (descaled by im_scale), IoU-classify
+    fg/bg, subsample by fg_fraction, emit per-class-expanded regression
+    targets + weights."""
+    rh = _holder(scope, op.input("RpnRois")[0])
+    rois_all = np.asarray(rh.array).reshape(-1, 4)
+    gch = _holder(scope, op.input("GtClasses")[0])
+    ich = _holder(scope, op.input("IsCrowd")[0])
+    gbh = _holder(scope, op.input("GtBoxes")[0])
+    gtc_all = np.asarray(gch.array).reshape(-1)
+    crowd_all = np.asarray(ich.array).reshape(-1)
+    gtb_all = np.asarray(gbh.array).reshape(-1, 4)
+    im_info = np.asarray(executor._read_var(
+        scope, op.input("ImInfo")[0])).reshape(-1, 3)
+    r_lod = _lod0(rh, rois_all.shape[0])
+    g_lod = _lod0(gbh, gtb_all.shape[0])
+
+    bpi = int(op.attrs.get("batch_size_per_im", 256))
+    frac = float(op.attrs.get("fg_fraction", 0.25))
+    fg_t = float(op.attrs.get("fg_thresh", 0.5))
+    bg_hi = float(op.attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(op.attrs.get("bg_thresh_lo", 0.0))
+    wts = [float(w) for w in op.attrs.get("bbox_reg_weights",
+                                          [0.1, 0.1, 0.2, 0.2])]
+    cls_nums = int(op.attrs.get("class_nums", 81))
+    cls_agnostic = bool(op.attrs.get("is_cls_agnostic", False))
+    cascade = bool(op.attrs.get("is_cascade_rcnn", False))
+    use_random = bool(op.attrs.get("use_random", True))
+    rng = np.random.RandomState(int(op.attrs.get("seed", 0)))
+
+    from .proposal_ops import _iou_matrix, _reservoir_sampling
+
+    rois_out, lbl_out, tgt_out, iw_out, ow_out = [], [], [], [], []
+    lod = [0]
+    for i in range(len(g_lod) - 1):
+        scale = im_info[i, 2]
+        gts = gtb_all[g_lod[i]:g_lod[i + 1]]
+        gtc = gtc_all[g_lod[i]:g_lod[i + 1]]
+        crowd = crowd_all[g_lod[i]:g_lod[i + 1]]
+        if cascade:
+            # cascade R-CNN: previous-stage rois AS-IS (no descale, no
+            # gt concat, no subsampling; degenerate boxes skipped)
+            boxes = rois_all[r_lod[i]:r_lod[i + 1]].copy()
+        else:
+            rois = rois_all[r_lod[i]:r_lod[i + 1]] / scale
+            boxes = np.concatenate([gts, rois], axis=0)
+        iou = _iou_matrix(boxes, gts) if gts.shape[0] else \
+            np.zeros((boxes.shape[0], 0))
+        maxo = iou.max(axis=1) if gts.shape[0] else \
+            np.zeros(boxes.shape[0])
+        # crowd gts never become samples
+        if not cascade:
+            maxo[:len(crowd)][crowd.astype(bool)] = -1.0
+        if cascade:
+            degenerate = ((boxes[:, 2] - boxes[:, 0] + 1 <= 0)
+                          | (boxes[:, 3] - boxes[:, 1] + 1 <= 0))
+            maxo[degenerate] = -1.0
+        argm = iou.argmax(axis=1) if gts.shape[0] else \
+            np.zeros(boxes.shape[0], np.int64)
+        fg = list(np.where(maxo >= fg_t)[0])
+        gmap = [int(argm[k]) for k in fg]
+        bg = list(np.where((maxo >= bg_lo) & (maxo < bg_hi))[0])
+        if not cascade:
+            fg_per = int(bpi * frac)
+            n_fg = min(fg_per, len(fg))
+            if use_random and len(fg) > n_fg:
+                pair = list(zip(fg, gmap))
+                kept = _reservoir_sampling(n_fg, pair, rng, True)
+                fg = [p[0] for p in kept]
+                gmap = [p[1] for p in kept]
+            else:
+                fg, gmap = fg[:n_fg], gmap[:n_fg]
+            n_bg = min(bpi - len(fg), len(bg))
+            bg = _reservoir_sampling(n_bg, bg, rng, use_random)
+        sel = fg + list(bg)
+        sb = boxes[sel]
+        labels = np.concatenate([
+            gtc[gmap].astype(np.int32) if gmap else np.zeros(0, np.int32),
+            np.zeros(len(bg), np.int32)])
+        # regression targets for fg rows
+        tgt1 = np.zeros((len(sel), 4), np.float32)
+        if fg:
+            from .proposal_ops import _box_to_delta
+
+            d = _box_to_delta(boxes[fg], gts[gmap])
+            tgt1[:len(fg)] = d / np.asarray(wts, np.float32)[None, :]
+        # per-class expansion
+        tgt = np.zeros((len(sel), 4 * cls_nums), np.float32)
+        iw = np.zeros_like(tgt)
+        for k, lab in enumerate(labels):
+            if lab > 0:
+                c = 1 if cls_agnostic else int(lab)
+                tgt[k, 4 * c:4 * c + 4] = tgt1[k]
+                iw[k, 4 * c:4 * c + 4] = 1.0
+        rois_out.append((sb * scale).astype("float32"))
+        lbl_out.append(labels.reshape(-1, 1))
+        tgt_out.append(tgt)
+        iw_out.append(iw)
+        ow_out.append(iw.copy())
+        lod.append(lod[-1] + len(sel))
+
+    def _write_lod(slot, arrays, width):
+        arr = (np.concatenate(arrays) if lod[-1]
+               else np.zeros((0, width), "float32"))
+        t = LoDTensor(arr)
+        t.set_lod([lod])
+        executor._write_var(scope, op.output(slot)[0], t)
+
+    _write_lod("Rois", rois_out, 4)
+    arr = (np.concatenate(lbl_out) if lod[-1]
+           else np.zeros((0, 1), np.int32))
+    t = LoDTensor(arr)
+    t.set_lod([lod])
+    executor._write_var(scope, op.output("LabelsInt32")[0], t)
+    _write_lod("BboxTargets", tgt_out, 4 * cls_nums)
+    _write_lod("BboxInsideWeights", iw_out, 4 * cls_nums)
+    _write_lod("BboxOutsideWeights", ow_out, 4 * cls_nums)
+
+
+def _bilinear(data, w, h):
+    """data [H, W]; clamped bilinear sample at (w, h) + the 4 corner
+    weights (for the backward scatter)."""
+    H, W = data.shape
+    w1, h1 = int(np.floor(w)), int(np.floor(h))
+    w2, h2 = min(w1 + 1, W - 1), min(h1 + 1, H - 1)
+    dw, dh = w - w1, h - h1
+    corners = [(h1, w1, (1 - dh) * (1 - dw)), (h1, w2, (1 - dh) * dw),
+               (h2, w1, dh * (1 - dw)), (h2, w2, dh * dw)]
+    val = sum(data[a, b] * c for a, b, c in corners)
+    return val, corners
+
+
+@register_host_op(
+    "deformable_psroi_pooling",
+    inputs=[In("Input"), In("ROIs", no_grad=True), In("Trans")],
+    outputs=[Out("Output"), Out("TopCount", no_grad=True)],
+    attrs={"no_trans": False, "spatial_scale": 1.0, "output_dim": 1,
+           "group_size": [1, 1], "pooled_height": 1, "pooled_width": 1,
+           "part_size": [1, 1], "sample_per_part": 1, "trans_std": 0.1},
+)
+def _deformable_psroi_pooling(executor, op, scope):
+    """deformable_psroi_pooling_op.h forward: position-sensitive ROI
+    pooling whose bin sampling windows shift by learned offsets (Trans),
+    averaged over sample_per_part^2 clamped bilinear samples."""
+    x = np.asarray(executor._read_var(scope, op.input("Input")[0]))
+    rh = _holder(scope, op.input("ROIs")[0])
+    rois = np.asarray(rh.array).reshape(-1, 4)
+    trans = np.asarray(executor._read_var(scope, op.input("Trans")[0]))
+    a = op.attrs
+    no_trans = bool(a.get("no_trans", False))
+    scale = float(a.get("spatial_scale", 1.0))
+    out_dim = int(a.get("output_dim", 1))
+    gh_n, gw_n = [int(v) for v in a.get("group_size", [1, 1])]
+    ph_n, pw_n = int(a.get("pooled_height", 1)), int(a.get("pooled_width", 1))
+    part_h, part_w = [int(v) for v in a.get("part_size", [1, 1])]
+    spp = int(a.get("sample_per_part", 1))
+    tstd = float(a.get("trans_std", 0.1))
+    B, C, H, W = x.shape
+    lod = _lod0(rh, rois.shape[0])
+    batch_id = np.zeros(rois.shape[0], np.int64)
+    for i in range(len(lod) - 1):
+        batch_id[lod[i]:lod[i + 1]] = i
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    cec = max(out_dim // num_classes, 1)
+    N = rois.shape[0]
+    out = np.zeros((N, out_dim, ph_n, pw_n), np.float32)
+    cnt = np.zeros_like(out)
+    for n in range(N):
+        rsw = round(rois[n, 0]) * scale - 0.5
+        rsh = round(rois[n, 1]) * scale - 0.5
+        rew = (round(rois[n, 2]) + 1.0) * scale - 0.5
+        reh = (round(rois[n, 3]) + 1.0) * scale - 0.5
+        rw, rhh = max(rew - rsw, 0.1), max(reh - rsh, 0.1)
+        bh, bw = rhh / ph_n, rw / pw_n
+        sbh, sbw = bh / spp, bw / spp
+        for ctop in range(out_dim):
+            cls = ctop // cec
+            for ph in range(ph_n):
+                for pw in range(pw_n):
+                    p_h = int(np.floor(ph / ph_n * part_h))
+                    p_w = int(np.floor(pw / pw_n * part_w))
+                    tx = 0.0 if no_trans else \
+                        trans[n, cls * 2, p_h, p_w] * tstd
+                    ty = 0.0 if no_trans else \
+                        trans[n, cls * 2 + 1, p_h, p_w] * tstd
+                    ws = pw * bw + rsw + tx * rw
+                    hs = ph * bh + rsh + ty * rhh
+                    gw = min(max(int(np.floor(pw * gw_n / pw_n)), 0),
+                             gw_n - 1)
+                    gh = min(max(int(np.floor(ph * gh_n / ph_n)), 0),
+                             gh_n - 1)
+                    c = (ctop * gh_n + gh) * gw_n + gw
+                    plane = x[batch_id[n], c]
+                    s, ns = 0.0, 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            w = ws + iw * sbw
+                            h = hs + ih * sbh
+                            if (w < -0.5 or w > W - 0.5 or h < -0.5
+                                    or h > H - 0.5):
+                                continue
+                            w = min(max(w, 0.0), W - 1.0)
+                            h = min(max(h, 0.0), H - 1.0)
+                            v, _ = _bilinear(plane, w, h)
+                            s += v
+                            ns += 1
+                    out[n, ctop, ph, pw] = 0.0 if ns == 0 else s / ns
+                    cnt[n, ctop, ph, pw] = ns
+    executor._write_var(scope, op.output("Output")[0], out)
+    executor._write_var(scope, op.output("TopCount")[0], cnt)
+
+
+def _dpsroi_grad_maker(block, op, pending, finalize):
+    from .control_flow_ops import _bind_partial_grad
+
+    og = finalize(op.output("Output")[0])
+    if og is None:
+        return
+    gx = _bind_partial_grad(block, pending, op.input("Input")[0])
+    gt = _bind_partial_grad(block, pending, op.input("Trans")[0])
+    block.append_op(
+        "deformable_psroi_pooling_grad",
+        {"Input": [op.input("Input")[0]], "ROIs": [op.input("ROIs")[0]],
+         "Trans": [op.input("Trans")[0]],
+         "TopCount": [op.output("TopCount")[0]], "Output@GRAD": [og]},
+        {"Input@GRAD": [gx], "Trans@GRAD": [gt]},
+        dict(op.attrs), infer_shape=False)
+
+
+@register_host_op(
+    "deformable_psroi_pooling_grad",
+    inputs=[In("Input", no_grad=True), In("ROIs", no_grad=True),
+            In("Trans", no_grad=True), In("TopCount", no_grad=True),
+            In("Output@GRAD", no_grad=True)],
+    outputs=[Out("Input@GRAD"), Out("Trans@GRAD")],
+    attrs={"no_trans": False, "spatial_scale": 1.0, "output_dim": 1,
+           "group_size": [1, 1], "pooled_height": 1, "pooled_width": 1,
+           "part_size": [1, 1], "sample_per_part": 1, "trans_std": 0.1},
+)
+def _deformable_psroi_pooling_grad(executor, op, scope):
+    """Backward (deformable_psroi_pooling_op.h Backward kernel):
+    scatter the averaged cotangent through each sample's bilinear
+    weights into Input; Trans grads from the spatial derivative of the
+    bilinear surface times roi extent."""
+    x = np.asarray(executor._read_var(scope, op.input("Input")[0]))
+    rh = _holder(scope, op.input("ROIs")[0])
+    rois = np.asarray(rh.array).reshape(-1, 4)
+    trans = np.asarray(executor._read_var(scope, op.input("Trans")[0]))
+    cnt = np.asarray(executor._read_var(scope, op.input("TopCount")[0]))
+    og = np.asarray(executor._read_var(scope,
+                                       op.input("Output@GRAD")[0]))
+    a = op.attrs
+    no_trans = bool(a.get("no_trans", False))
+    scale = float(a.get("spatial_scale", 1.0))
+    out_dim = int(a.get("output_dim", 1))
+    gh_n, gw_n = [int(v) for v in a.get("group_size", [1, 1])]
+    ph_n, pw_n = int(a.get("pooled_height", 1)), int(a.get("pooled_width", 1))
+    part_h, part_w = [int(v) for v in a.get("part_size", [1, 1])]
+    spp = int(a.get("sample_per_part", 1))
+    tstd = float(a.get("trans_std", 0.1))
+    B, C, H, W = x.shape
+    lod = _lod0(rh, rois.shape[0])
+    batch_id = np.zeros(rois.shape[0], np.int64)
+    for i in range(len(lod) - 1):
+        batch_id[lod[i]:lod[i + 1]] = i
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    cec = max(out_dim // num_classes, 1)
+    gx = np.zeros_like(x)
+    gt = np.zeros_like(trans)
+    for n in range(rois.shape[0]):
+        rsw = round(rois[n, 0]) * scale - 0.5
+        rsh = round(rois[n, 1]) * scale - 0.5
+        rew = (round(rois[n, 2]) + 1.0) * scale - 0.5
+        reh = (round(rois[n, 3]) + 1.0) * scale - 0.5
+        rw, rhh = max(rew - rsw, 0.1), max(reh - rsh, 0.1)
+        bh, bw = rhh / ph_n, rw / pw_n
+        sbh, sbw = bh / spp, bw / spp
+        for ctop in range(out_dim):
+            cls = ctop // cec
+            for ph in range(ph_n):
+                for pw in range(pw_n):
+                    ns = cnt[n, ctop, ph, pw]
+                    if ns == 0:
+                        continue
+                    g = og[n, ctop, ph, pw] / ns
+                    p_h = int(np.floor(ph / ph_n * part_h))
+                    p_w = int(np.floor(pw / pw_n * part_w))
+                    tx = 0.0 if no_trans else \
+                        trans[n, cls * 2, p_h, p_w] * tstd
+                    ty = 0.0 if no_trans else \
+                        trans[n, cls * 2 + 1, p_h, p_w] * tstd
+                    ws = pw * bw + rsw + tx * rw
+                    hs = ph * bh + rsh + ty * rhh
+                    gw = min(max(int(np.floor(pw * gw_n / pw_n)), 0),
+                             gw_n - 1)
+                    gh = min(max(int(np.floor(ph * gh_n / ph_n)), 0),
+                             gh_n - 1)
+                    c = (ctop * gh_n + gh) * gw_n + gw
+                    plane = x[batch_id[n], c]
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            w = ws + iw * sbw
+                            h = hs + ih * sbh
+                            if (w < -0.5 or w > W - 0.5 or h < -0.5
+                                    or h > H - 0.5):
+                                continue
+                            w = min(max(w, 0.0), W - 1.0)
+                            h = min(max(h, 0.0), H - 1.0)
+                            _, corners = _bilinear(plane, w, h)
+                            for hh, ww, cw in corners:
+                                gx[batch_id[n], c, hh, ww] += g * cw
+                            if not no_trans:
+                                w1, h1 = int(np.floor(w)), int(np.floor(h))
+                                w2 = min(w1 + 1, W - 1)
+                                h2 = min(h1 + 1, H - 1)
+                                dw, dh = w - w1, h - h1
+                                dvdw = ((plane[h1, w2] - plane[h1, w1])
+                                        * (1 - dh)
+                                        + (plane[h2, w2] - plane[h2, w1])
+                                        * dh)
+                                dvdh = ((plane[h2, w1] - plane[h1, w1])
+                                        * (1 - dw)
+                                        + (plane[h2, w2] - plane[h1, w2])
+                                        * dw)
+                                gt[n, cls * 2, p_h, p_w] += \
+                                    g * dvdw * tstd * rw
+                                gt[n, cls * 2 + 1, p_h, p_w] += \
+                                    g * dvdh * tstd * rhh
+    executor._write_var(scope, op.output("Input@GRAD")[0], gx)
+    executor._write_var(scope, op.output("Trans@GRAD")[0], gt)
+
+
+OpInfoMap.instance().get("deformable_psroi_pooling").grad = \
+    _dpsroi_grad_maker

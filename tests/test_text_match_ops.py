@@ -1,0 +1,323 @@
+"""Text-matching + SSD-mining op family (registry-parity wave 5):
+match_matrix_tensor, sequence_topk_avg_pooling, similarity_focus,
+lookup_table_dequant, mine_hard_examples, retinanet_target_assign.
+Each test reproduces the reference kernel's numeric contract with an
+independent numpy oracle."""
+import struct
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+from paddle_tpu.core.tensor import LoDTensor
+
+
+def _run_op(op_type, inputs, outputs, attrs, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        for n in list(inputs.values()):
+            for name in n:
+                if not blk.has_var_local(name):
+                    blk.create_var(name=name, shape=None,
+                                   dtype="float32")
+        for n in list(outputs.values()):
+            for name in n:
+                blk.create_var(name=name, shape=None, dtype="float32")
+        op = framework.Operator(blk, op_type, inputs, outputs, attrs)
+        op._id = main._next_op_id()
+        blk.ops.append(op)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for name, v in feeds.items():
+            exe._core._write_var(scope, name, v)
+        exe._core.run_block(main.global_block(), scope)
+        out = {}
+        for names in outputs.values():
+            for name in names:
+                var = scope.find_var(name)
+                out[name] = var.raw() if var is not None else None
+    return out
+
+
+def test_lookup_table_dequant():
+    rng = np.random.RandomState(0)
+    table = rng.random_sample((17, 10)).astype("float32")
+    ids = rng.randint(0, 17, (4, 1)).astype("int64")
+    out = _run_op("lookup_table_dequant",
+                  {"W": ["w"], "Ids": ["ids"]}, {"Out": ["o"]}, {},
+                  {"w": table, "ids": ids})["o"]
+    # oracle straight from the reference test's formula
+    expect = []
+    for i in ids.ravel():
+        lo, hi = table[i][0], table[i][1]
+        row = []
+        for val in table[i][2:]:
+            row += [b * (hi - lo) / 256.0 + lo
+                    for b in bytearray(struct.pack("f", val))]
+        expect.append(row)
+    np.testing.assert_allclose(np.asarray(out.array),
+                               np.asarray(expect, "float32"),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_match_matrix_tensor_matches_oracle():
+    rng = np.random.RandomState(1)
+    x_lod, y_lod = [0, 1, 3, 5], [0, 3, 4, 8]
+    h, dim_t = 6, 3
+    x = rng.random_sample((5, h)).astype("float32")
+    y = rng.random_sample((8, h)).astype("float32")
+    w = rng.random_sample((h, dim_t, h)).astype("float32")
+    xt = LoDTensor(x)
+    xt.set_lod([x_lod])
+    yt = LoDTensor(y)
+    yt.set_lod([y_lod])
+    out = _run_op("match_matrix_tensor",
+                  {"X": ["x"], "Y": ["y"], "W": ["w"]},
+                  {"Out": ["o"], "Tmp": ["tmp"]}, {"dim_t": dim_t},
+                  {"x": xt, "y": yt, "w": w})
+    # oracle: independently computed bilinear grids
+    w_t = w.transpose(1, 0, 2)
+    expect, lod = [], [0]
+    for i in range(3):
+        xs = x[x_lod[i]:x_lod[i + 1]]
+        ys = y[y_lod[i]:y_lod[i + 1]]
+        grid = np.einsum("ih,thk,jk->tij", xs, w_t, ys)
+        expect.append(grid.reshape(-1, 1))
+        lod.append(lod[-1] + grid.size)
+    np.testing.assert_allclose(np.asarray(out["o"].array),
+                               np.concatenate(expect), rtol=1e-5,
+                               atol=1e-5)
+    assert out["o"].lod() == [lod]
+
+
+def test_match_matrix_tensor_trains():
+    """End-to-end: grads flow into X, Y, and W (reference check_grad)."""
+    rng = np.random.RandomState(2)
+    h, dim_t = 4, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, h], dtype="float32",
+                       lod_level=1)
+        y = fluid.data(name="y", shape=[-1, h], dtype="float32",
+                       lod_level=1)
+        w = fluid.layers.create_parameter([h, dim_t, h], "float32",
+                                          name="w_mm")
+        blk = main.global_block()
+        o = blk.create_var(name="mm_out", shape=[-1, 1], dtype="float32")
+        blk.create_var(name="mm_tmp", shape=None, dtype="float32")
+        op = framework.Operator(
+            blk, "match_matrix_tensor",
+            {"X": ["x"], "Y": ["y"], "W": ["w_mm"]},
+            {"Out": ["mm_out"], "Tmp": ["mm_tmp"]}, {"dim_t": dim_t})
+        op._id = main._next_op_id()
+        blk.ops.append(op)
+        o.stop_gradient = False
+        loss = fluid.layers.reduce_mean(o)
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    xt = LoDTensor(rng.random_sample((4, h)).astype("float32"))
+    xt.set_lod([[0, 2, 4]])
+    yt = LoDTensor(rng.random_sample((5, h)).astype("float32"))
+    yt.set_lod([[0, 3, 5]])
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.asarray(scope.find_var("w_mm").raw().array).copy()
+        (l1,) = exe.run(main, feed={"x": xt, "y": yt},
+                        fetch_list=[loss])
+        w1 = np.asarray(scope.find_var("w_mm").raw().array)
+    assert np.isfinite(float(np.ravel(l1)[0]))
+    assert np.abs(w1 - w0).max() > 1e-8  # W actually updated
+
+
+def test_sequence_topk_avg_pooling():
+    """One pair, 2 channels, 2x3 grid, topks [1, 2]."""
+    chan, rs, cs = 2, 2, 3
+    grid = np.asarray(
+        [[[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]],     # channel 0
+         [[9.0, 7.0, 8.0], [6.0, 6.5, 6.25]]],   # channel 1
+        "float32")
+    xt = LoDTensor(grid.reshape(-1, 1))
+    xt.set_lod([[0, chan * rs * cs]])
+    rowt = LoDTensor(np.zeros((rs, 1), "float32"))
+    rowt.set_lod([[0, rs]])
+    colt = LoDTensor(np.zeros((cs, 1), "float32"))
+    colt.set_lod([[0, cs]])
+    out = _run_op("sequence_topk_avg_pooling",
+                  {"X": ["x"], "ROW": ["r"], "COLUMN": ["c"]},
+                  {"Out": ["o"], "pos": ["p"]},
+                  {"topks": [1, 2], "channel_num": chan},
+                  {"x": xt, "r": rowt, "c": colt})["o"]
+    got = np.asarray(out.array)
+    # rows x (chan * k_num); per row/channel: [top1, mean(top2)]
+    expect = np.asarray([
+        [3.0, 2.5, 9.0, 8.5],
+        [5.0, 4.5, 6.5, 6.375],
+    ], "float32")
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_similarity_focus():
+    rng = np.random.RandomState(3)
+    x = rng.random_sample((2, 3, 2, 2)).astype("float32")
+    out = np.asarray(_run_op(
+        "similarity_focus", {"X": ["x"]}, {"Out": ["o"]},
+        {"axis": 1, "indexes": [0]}, {"x": x})["o"].array)
+    # oracle: greedy row/col tagging on slice [b, 0]
+    expect = np.zeros_like(x)
+    for b in range(2):
+        sl = x[b, 0]
+        order = np.argsort(-sl.ravel(), kind="stable")
+        t1 = np.zeros(2, bool)
+        t2 = np.zeros(2, bool)
+        for f in order:
+            i1, i2 = divmod(int(f), 2)
+            if t1[i1] or t2[i2]:
+                continue
+            t1[i1] = t2[i2] = True
+            expect[b, :, i1, i2] = 1
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_mine_hard_examples_max_negative():
+    cls = np.asarray([[0.1, 0.9, 0.3, 0.7]], "float32")
+    mi = np.asarray([[0, -1, -1, -1]], "int32")
+    md = np.asarray([[0.9, 0.1, 0.2, 0.3]], "float32")
+    out = _run_op("mine_hard_examples",
+                  {"ClsLoss": ["c"], "MatchIndices": ["m"],
+                   "MatchDist": ["d"]},
+                  {"NegIndices": ["n"], "UpdatedMatchIndices": ["u"]},
+                  {"neg_pos_ratio": 2.0, "neg_dist_threshold": 0.5,
+                   "mining_type": "max_negative"},
+                  {"c": cls, "m": mi, "d": md})
+    # 1 positive -> up to 2 negatives; eligible = {1,2,3}; hardest by
+    # cls loss: 1 (0.9) and 3 (0.7)
+    np.testing.assert_array_equal(
+        np.asarray(out["n"].array).ravel(), [1, 3])
+    np.testing.assert_array_equal(np.asarray(out["u"].array), mi)
+
+
+def test_retinanet_target_assign():
+    anchors = np.asarray([[0, 0, 9, 9], [10, 10, 19, 19],
+                          [50, 50, 59, 59]], "float32")
+    gt = LoDTensor(np.asarray([[0, 0, 9, 9]], "float32"))
+    gt.set_lod([[0, 1]])
+    lbl = LoDTensor(np.asarray([[3]], "int32"))
+    lbl.set_lod([[0, 1]])
+    crowd = LoDTensor(np.zeros((1, 1), "int32"))
+    crowd.set_lod([[0, 1]])
+    im = np.asarray([[60, 60, 1.0]], "float32")
+    out = _run_op(
+        "retinanet_target_assign",
+        {"Anchor": ["a"], "GtBoxes": ["g"], "GtLabels": ["l"],
+         "IsCrowd": ["ic"], "ImInfo": ["im"]},
+        {"LocationIndex": ["li"], "ScoreIndex": ["si"],
+         "TargetBBox": ["tb"], "TargetLabel": ["tl"],
+         "BBoxInsideWeight": ["bw"], "ForegroundNumber": ["fn"]},
+        {"positive_overlap": 0.5, "negative_overlap": 0.4},
+        {"a": anchors, "g": gt, "l": lbl, "ic": crowd, "im": im})
+    # anchor 0 is fg (iou 1.0, label 3); anchors 1,2 bg (label 0); ALL
+    # anchors scored (no subsampling)
+    np.testing.assert_array_equal(
+        np.asarray(out["li"].array).ravel(), [0])
+    assert sorted(np.asarray(out["si"].array).ravel().tolist()) == \
+        [0, 1, 2]
+    labels = np.asarray(out["tl"].array).ravel()
+    assert labels[0] == 3 and set(labels[1:]) == {0}
+    np.testing.assert_array_equal(
+        np.asarray(out["fn"].array).ravel(), [2])  # fg + 1
+
+
+def test_generate_proposal_labels():
+    rois = LoDTensor(np.asarray(
+        [[0, 0, 9, 9], [0, 0, 4, 4], [30, 30, 39, 39]], "float32"))
+    rois.set_lod([[0, 3]])
+    gts = LoDTensor(np.asarray([[0, 0, 9, 9]], "float32"))
+    gts.set_lod([[0, 1]])
+    gtc = LoDTensor(np.asarray([[2]], "int32"))
+    gtc.set_lod([[0, 1]])
+    crowd = LoDTensor(np.zeros((1, 1), "int32"))
+    crowd.set_lod([[0, 1]])
+    im = np.asarray([[60, 60, 1.0]], "float32")
+    out = _run_op(
+        "generate_proposal_labels",
+        {"RpnRois": ["r"], "GtClasses": ["gc"], "IsCrowd": ["ic"],
+         "GtBoxes": ["gb"], "ImInfo": ["im"]},
+        {"Rois": ["ro"], "LabelsInt32": ["lb"], "BboxTargets": ["bt"],
+         "BboxInsideWeights": ["iw"], "BboxOutsideWeights": ["ow"]},
+        {"batch_size_per_im": 8, "fg_fraction": 0.5, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+         "bbox_reg_weights": [1.0, 1.0, 1.0, 1.0], "class_nums": 4,
+         "use_random": False, "seed": 0},
+        {"r": rois, "gc": gtc, "ic": crowd, "gb": gts, "im": im})
+    labels = np.asarray(out["lb"].array).ravel()
+    ro = np.asarray(out["ro"].array)
+    # fg: the gt itself (iou 1) + roi[0] (identical box); bg: the rest
+    assert (labels == 2).sum() == 2, labels
+    assert (labels == 0).sum() == len(labels) - 2
+    bt = np.asarray(out["bt"].array)
+    iw = np.asarray(out["iw"].array)
+    # fg rows carry class-2 slots; identical boxes -> zero deltas
+    for k, lab in enumerate(labels):
+        if lab == 2:
+            np.testing.assert_allclose(bt[k, 8:12], 0.0, atol=1e-6)
+            np.testing.assert_array_equal(iw[k, 8:12], 1.0)
+        assert iw[k, :8].sum() == 0 and iw[k, 12:].sum() == 0
+
+
+def test_deformable_psroi_pooling_numeric_grad():
+    """Forward sanity (zero offsets + aligned roi averages the bin) and
+    numeric-vs-analytic grads for Input and Trans (the reference
+    check_grad contract)."""
+    rng = np.random.RandomState(4)
+    x = rng.random_sample((1, 4, 8, 8)).astype("float64")
+    rois = LoDTensor(np.asarray([[0, 0, 7, 7]], "float64"))
+    rois.set_lod([[0, 1]])
+    trans = (rng.random_sample((1, 2, 2, 2)) * 0.2).astype("float64")
+    attrs = {"no_trans": False, "spatial_scale": 1.0, "output_dim": 1,
+             "group_size": [2, 2], "pooled_height": 2,
+             "pooled_width": 2, "part_size": [2, 2],
+             "sample_per_part": 2, "trans_std": 0.1}
+
+    def forward(xv, tv):
+        out = _run_op(
+            "deformable_psroi_pooling",
+            {"Input": ["xi"], "ROIs": ["ri"], "Trans": ["ti"]},
+            {"Output": ["oo"], "TopCount": ["tc"]}, attrs,
+            {"xi": xv, "ri": rois, "ti": tv})
+        return np.asarray(out["oo"].array), out["tc"]
+
+    y0, tc = forward(x, trans)
+    assert np.isfinite(y0).all() and y0.shape == (1, 1, 2, 2)
+
+    # analytic grads via the grad op with a ones cotangent
+    og = np.ones_like(y0)
+    gout = _run_op(
+        "deformable_psroi_pooling_grad",
+        {"Input": ["xi"], "ROIs": ["ri"], "Trans": ["ti"],
+         "TopCount": ["tc"], "Output@GRAD": ["og"]},
+        {"Input@GRAD": ["gx"], "Trans@GRAD": ["gt"]}, attrs,
+        {"xi": x, "ri": rois, "ti": trans, "tc": tc, "og": og})
+    gx = np.asarray(gout["gx"].array)
+    gt = np.asarray(gout["gt"].array)
+
+    eps = 1e-3
+    for idx in [(0, 0, 2, 3), (0, 1, 5, 5), (0, 3, 7, 0)]:
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        num = (forward(xp, trans)[0].sum()
+               - forward(xm, trans)[0].sum()) / (2 * eps)
+        np.testing.assert_allclose(gx[idx], num, rtol=2e-3, atol=1e-5)
+    for idx in [(0, 0, 0, 1), (0, 1, 1, 0)]:
+        tp = trans.copy()
+        tp[idx] += eps
+        tm = trans.copy()
+        tm[idx] -= eps
+        num = (forward(x, tp)[0].sum()
+               - forward(x, tm)[0].sum()) / (2 * eps)
+        np.testing.assert_allclose(gt[idx], num, rtol=5e-3, atol=1e-5)
