@@ -803,3 +803,341 @@ def _deformable_psroi_pooling_grad(executor, op, scope):
 
 OpInfoMap.instance().get("deformable_psroi_pooling").grad = \
     _dpsroi_grad_maker
+
+
+def _perspective_matrix(tw, th, rx, ry):
+    """get_transform_matrix (roi_perspective_transform_op.cc:110)."""
+    x0, x1, x2, x3 = rx
+    y0, y1, y2, y3 = ry
+    len1 = np.hypot(x0 - x1, y0 - y1)
+    len2 = np.hypot(x1 - x2, y1 - y2)
+    len3 = np.hypot(x2 - x3, y2 - y3)
+    len4 = np.hypot(x3 - x0, y3 - y0)
+    est_h = (len2 + len4) / 2.0
+    est_w = (len1 + len3) / 2.0
+    nh = max(2, th)
+    nw = int(round(est_w * (nh - 1) / max(est_h, 1e-5))) + 1
+    nw = max(2, min(nw, tw))
+    dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+    dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+    m = np.zeros(9)
+    den = dx1 * dy2 - dx2 * dy1 + 1e-5
+    m[6] = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+    m[7] = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+    m[8] = 1.0
+    m[3] = (y1 - y0 + m[6] * (nw - 1) * y1) / (nw - 1)
+    m[4] = (y3 - y0 + m[7] * (nh - 1) * y3) / (nh - 1)
+    m[5] = y0
+    m[0] = (x1 - x0 + m[6] * (nw - 1) * x1) / (nw - 1)
+    m[1] = (x3 - x0 + m[7] * (nh - 1) * x3) / (nh - 1)
+    m[2] = x0
+    return m
+
+
+def _in_quad(x, y, rx, ry):
+    """Point-in-quadrilateral via the crossing test (edge-inclusive)."""
+    inside = False
+    j = 3
+    for i in range(4):
+        xi, yi, xj, yj = rx[i], ry[i], rx[j], ry[j]
+        # on-edge check
+        cross = (xj - xi) * (y - yi) - (yj - yi) * (x - xi)
+        if abs(cross) < 1e-6 and min(xi, xj) - 1e-6 <= x <= \
+                max(xi, xj) + 1e-6 and min(yi, yj) - 1e-6 <= y <= \
+                max(yi, yj) + 1e-6:
+            return True
+        if (yi > y) != (yj > y) and \
+                x < (xj - xi) * (y - yi) / (yj - yi) + xi:
+            inside = not inside
+        j = i
+    return inside
+
+
+def _rpt_geometry(rois, lod, scale, tw, th):
+    batch_id = np.zeros(rois.shape[0], np.int64)
+    for i in range(len(lod) - 1):
+        batch_id[lod[i]:lod[i + 1]] = i
+    mats, quads = [], []
+    for n in range(rois.shape[0]):
+        rx = [rois[n, 2 * k] * scale for k in range(4)]
+        ry = [rois[n, 2 * k + 1] * scale for k in range(4)]
+        mats.append(_perspective_matrix(tw, th, rx, ry))
+        quads.append((rx, ry))
+    return batch_id, mats, quads
+
+
+@register_host_op(
+    "roi_perspective_transform",
+    inputs=[In("X"), In("ROIs", no_grad=True)],
+    outputs=[Out("Out"), Out("Mask", no_grad=True),
+             Out("TransformMatrix", no_grad=True),
+             Out("Out2InIdx", no_grad=True, dispensable=True),
+             Out("Out2InWeights", no_grad=True, dispensable=True)],
+    attrs={"transformed_height": 1, "transformed_width": 1,
+           "spatial_scale": 1.0},
+)
+def _roi_perspective_transform(executor, op, scope):
+    """roi_perspective_transform_op.cc: warp each quadrilateral ROI
+    (8 coords) to a [C, th, tw] rectangle via the estimated perspective
+    matrix + bilinear sampling; Mask marks in-quad pixels."""
+    x = np.asarray(executor._read_var(scope, op.input("X")[0]))
+    rh = _holder(scope, op.input("ROIs")[0])
+    rois = np.asarray(rh.array).reshape(-1, 8)
+    th = int(op.attrs["transformed_height"])
+    tw = int(op.attrs["transformed_width"])
+    scale = float(op.attrs.get("spatial_scale", 1.0))
+    B, C, H, W = x.shape
+    lod = _lod0(rh, rois.shape[0])
+    batch_id, mats, quads = _rpt_geometry(rois, lod, scale, tw, th)
+    N = rois.shape[0]
+    out = np.zeros((N, C, th, tw), np.float32)
+    mask = np.zeros((N, 1, th, tw), np.int32)
+    # per-output-pixel bilinear corner cache (the reference's
+    # Out2InIdx/Out2InWeights): flat input positions + weights, shared
+    # across channels; the grad op consumes these instead of re-deriving
+    # the geometry
+    o2i_idx = np.zeros((N * th * tw, 4), np.int64)
+    o2i_w = np.zeros((N * th * tw, 4), np.float32)
+    for n in range(N):
+        m = mats[n]
+        rx, ry = quads[n]
+        for oh in range(th):
+            for ow in range(tw):
+                wdet = m[6] * ow + m[7] * oh + m[8]
+                iw = (m[0] * ow + m[1] * oh + m[2]) / wdet
+                ih = (m[3] * ow + m[4] * oh + m[5]) / wdet
+                if not _in_quad(iw, ih, rx, ry):
+                    continue
+                if iw <= -0.5 or iw >= W - 0.5 or ih <= -0.5 \
+                        or ih >= H - 0.5:
+                    continue
+                mask[n, 0, oh, ow] = 1
+                plane_w = min(max(iw, 0.0), W - 1.0)
+                plane_h = min(max(ih, 0.0), H - 1.0)
+                flat = (n * th + oh) * tw + ow
+                for k, (hh, ww, cw) in enumerate(
+                        _bilinear(x[batch_id[n], 0], plane_w,
+                                  plane_h)[1]):
+                    o2i_idx[flat, k] = hh * W + ww
+                    o2i_w[flat, k] = cw
+                for c in range(C):
+                    v, _ = _bilinear(x[batch_id[n], c], plane_w,
+                                     plane_h)
+                    out[n, c, oh, ow] = v
+    executor._write_var(scope, op.output("Out")[0], out)
+    executor._write_var(scope, op.output("Mask")[0], mask)
+    executor._write_var(
+        scope, op.output("TransformMatrix")[0],
+        np.stack(mats).astype("float32") if mats
+        else np.zeros((0, 9), "float32"))
+    if op.output("Out2InIdx"):
+        executor._write_var(scope, op.output("Out2InIdx")[0], o2i_idx)
+    if op.output("Out2InWeights"):
+        executor._write_var(scope, op.output("Out2InWeights")[0], o2i_w)
+
+
+def _rpt_grad_maker(block, op, pending, finalize):
+    from .control_flow_ops import _bind_partial_grad
+
+    og = finalize(op.output("Out")[0])
+    if og is None:
+        return
+    gx = _bind_partial_grad(block, pending, op.input("X")[0])
+    block.append_op(
+        "roi_perspective_transform_grad",
+        {"X": [op.input("X")[0]], "ROIs": [op.input("ROIs")[0]],
+         "Mask": [op.output("Mask")[0]],
+         "Out2InIdx": list(op.output("Out2InIdx")),
+         "Out2InWeights": list(op.output("Out2InWeights")),
+         "Out@GRAD": [og]},
+        {"X@GRAD": [gx]}, dict(op.attrs), infer_shape=False)
+
+
+@register_host_op(
+    "roi_perspective_transform_grad",
+    inputs=[In("X", no_grad=True), In("ROIs", no_grad=True),
+            In("Mask", no_grad=True),
+            In("Out2InIdx", no_grad=True, dispensable=True),
+            In("Out2InWeights", no_grad=True, dispensable=True),
+            In("Out@GRAD", no_grad=True)],
+    outputs=[Out("X@GRAD")],
+    attrs={"transformed_height": 1, "transformed_width": 1,
+           "spatial_scale": 1.0},
+)
+def _roi_perspective_transform_grad(executor, op, scope):
+    """Scatter through the forward's cached bilinear corners
+    (Out2InIdx/Out2InWeights) when present — guaranteeing the same
+    geometry as the forward — else re-derive it."""
+    x = np.asarray(executor._read_var(scope, op.input("X")[0]))
+    rh = _holder(scope, op.input("ROIs")[0])
+    rois = np.asarray(rh.array).reshape(-1, 8)
+    mask = np.asarray(executor._read_var(scope, op.input("Mask")[0]))
+    og = np.asarray(executor._read_var(scope, op.input("Out@GRAD")[0]))
+    th = int(op.attrs["transformed_height"])
+    tw = int(op.attrs["transformed_width"])
+    scale = float(op.attrs.get("spatial_scale", 1.0))
+    B, C, H, W = x.shape
+    lod = _lod0(rh, rois.shape[0])
+    batch_id = np.zeros(rois.shape[0], np.int64)
+    for i in range(len(lod) - 1):
+        batch_id[lod[i]:lod[i + 1]] = i
+    idx_names = op.input("Out2InIdx")
+    cached = bool(idx_names) and executor._read_var(
+        scope, idx_names[0]) is not None
+    if cached:
+        o2i_idx = np.asarray(executor._read_var(scope, idx_names[0]))
+        o2i_w = np.asarray(executor._read_var(
+            scope, op.input("Out2InWeights")[0]))
+    else:
+        _bid, mats, _quads = _rpt_geometry(rois, lod, scale, tw, th)
+    gx = np.zeros_like(x)
+    for n in range(rois.shape[0]):
+        for oh in range(th):
+            for ow in range(tw):
+                if mask[n, 0, oh, ow] == 0:
+                    continue
+                if cached:
+                    flat = (n * th + oh) * tw + ow
+                    for k in range(4):
+                        hh, ww = divmod(int(o2i_idx[flat, k]), W)
+                        cw = o2i_w[flat, k]
+                        gx[batch_id[n], :, hh, ww] += og[n, :, oh, ow] * cw
+                    continue
+                m = mats[n]
+                wdet = m[6] * ow + m[7] * oh + m[8]
+                iw = (m[0] * ow + m[1] * oh + m[2]) / wdet
+                ih = (m[3] * ow + m[4] * oh + m[5]) / wdet
+                plane_w = min(max(iw, 0.0), W - 1.0)
+                plane_h = min(max(ih, 0.0), H - 1.0)
+                _, corners = _bilinear(x[batch_id[n], 0], plane_w,
+                                       plane_h)
+                for hh, ww, cw in corners:
+                    gx[batch_id[n], :, hh, ww] += og[n, :, oh, ow] * cw
+    executor._write_var(scope, op.output("X@GRAD")[0], gx)
+
+
+OpInfoMap.instance().get("roi_perspective_transform").grad = \
+    _rpt_grad_maker
+
+
+def _rasterize_polys(polys, box, M):
+    """Union of polygons clipped to ``box``, sampled on an M x M grid
+    at pixel centers (Polys2MaskWrtBox — the reference rasterizes via
+    COCO RLE upsampling; pixel-center crossing sampling matches it away
+    from sub-pixel boundary ties, which is the documented difference)."""
+    x0, y0, x1, y1 = box
+    w = max(x1 - x0, 1e-5)
+    h = max(y1 - y0, 1e-5)
+    mask = np.zeros((M, M), np.int32)
+    for poly in polys:
+        pts = np.asarray(poly, np.float64).reshape(-1, 2)
+        # roi-relative, scaled to the grid
+        px = (pts[:, 0] - x0) * M / w
+        py = (pts[:, 1] - y0) * M / h
+        for gy in range(M):
+            for gx_ in range(M):
+                cx, cy = gx_ + 0.5, gy + 0.5
+                inside = False
+                j = len(px) - 1
+                for i in range(len(px)):
+                    if (py[i] > cy) != (py[j] > cy) and \
+                            cx < (px[j] - px[i]) * (cy - py[i]) / \
+                            (py[j] - py[i]) + px[i]:
+                        inside = not inside
+                    j = i
+                if inside:
+                    mask[gy, gx_] = 1
+    return mask
+
+
+@register_host_op(
+    "generate_mask_labels",
+    inputs=[In("ImInfo", no_grad=True), In("GtClasses", no_grad=True),
+            In("IsCrowd", no_grad=True), In("GtSegms", no_grad=True),
+            In("Rois", no_grad=True), In("LabelsInt32", no_grad=True)],
+    outputs=[Out("MaskRois"), Out("RoiHasMaskInt32"), Out("MaskInt32")],
+    attrs={"num_classes": 81, "resolution": 14},
+)
+def _generate_mask_labels(executor, op, scope):
+    """generate_mask_labels_op.cc: per foreground roi, pick the
+    max-overlap mask gt (by its polygons' bounding box), rasterize its
+    polygons w.r.t. the roi, and expand into the per-class target
+    layout Mask-RCNN trains against."""
+    im_info = np.asarray(executor._read_var(
+        scope, op.input("ImInfo")[0])).reshape(-1, 3)
+    gch = _holder(scope, op.input("GtClasses")[0])
+    ich = _holder(scope, op.input("IsCrowd")[0])
+    sgh = _holder(scope, op.input("GtSegms")[0])
+    roih = _holder(scope, op.input("Rois")[0])
+    lblh = _holder(scope, op.input("LabelsInt32")[0])
+    gtc = np.asarray(gch.array).reshape(-1)
+    crowd = np.asarray(ich.array).reshape(-1)
+    segs = np.asarray(sgh.array).reshape(-1, 2)
+    rois = np.asarray(roih.array).reshape(-1, 4)
+    labels = np.asarray(lblh.array).reshape(-1)
+    res = int(op.attrs.get("resolution", 14))
+    ncls = int(op.attrs.get("num_classes", 81))
+    # GtSegms: the LAST two LoD levels are gt -> polys and poly ->
+    # points (reference feeds carry a leading image -> gt level too,
+    # the same tolerance _lod0 applies)
+    slod = sgh.lod()
+    lod1, lod2 = list(slod[-2]), list(slod[-1])
+    g_lod = _lod0(gch, gtc.shape[0])
+    r_lod = _lod0(roih, rois.shape[0])
+
+    from .proposal_ops import _iou_matrix
+
+    out_rois, out_has, out_mask, lod = [], [], [], [0]
+    for b in range(len(g_lod) - 1):
+        scale = im_info[b, 2]
+        g0, g1 = g_lod[b], g_lod[b + 1]
+        r0, r1 = r_lod[b], r_lod[b + 1]
+        polys_per_gt, boxes = [], []
+        for i in range(g0, g1):
+            if gtc[i] > 0 and crowd[i] == 0:
+                polys = []
+                for j in range(lod1[i], lod1[i + 1]):
+                    polys.append(segs[lod2[j]:lod2[j + 1]])
+                polys_per_gt.append(polys)
+                allp = np.concatenate(polys, axis=0)
+                boxes.append([allp[:, 0].min(), allp[:, 1].min(),
+                              allp[:, 0].max(), allp[:, 1].max()])
+        boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+        fg = [k for k in range(r0, r1) if labels[k] > 0]
+        if fg and len(polys_per_gt):
+            rois_fg = rois[fg] / scale
+            iou = _iou_matrix(rois_fg, boxes)
+            pick = iou.argmax(axis=1)
+            masks = np.full((len(fg), ncls * res * res), -1, np.int32)
+            for k, ridx in enumerate(fg):
+                m = _rasterize_polys(polys_per_gt[pick[k]],
+                                     rois_fg[k], res)
+                c = int(labels[ridx])
+                masks[k, c * res * res:(c + 1) * res * res] = \
+                    m.reshape(-1)
+            out_rois.append((rois_fg * scale).astype("float32"))
+            out_has.append(np.asarray(fg, np.int32) - r0)
+            out_mask.append(masks)
+            lod.append(lod[-1] + len(fg))
+        else:  # no fg: one bg placeholder with all -1 targets
+            bg = next((k for k in range(r0, r1) if labels[k] == 0), None)
+            # a zero-roi image still emits exactly ONE row so the LoD
+            # stays in sync with the data across all three outputs
+            row = (rois[bg:bg + 1] if bg is not None
+                   else np.zeros((1, 4), rois.dtype))
+            out_rois.append(row.astype("float32"))
+            out_has.append(np.asarray(
+                [bg - r0 if bg is not None else 0], np.int32))
+            out_mask.append(np.full((1, ncls * res * res), -1,
+                                    np.int32))
+            lod.append(lod[-1] + 1)
+
+    def _wl(slot, arrs):
+        arr = np.concatenate(arrs)
+        t = LoDTensor(arr)
+        t.set_lod([lod])
+        executor._write_var(scope, op.output(slot)[0], t)
+
+    _wl("MaskRois", out_rois)
+    _wl("RoiHasMaskInt32", [a.reshape(-1, 1) for a in out_has])
+    _wl("MaskInt32", out_mask)

@@ -321,3 +321,79 @@ def test_deformable_psroi_pooling_numeric_grad():
         num = (forward(x, tp)[0].sum()
                - forward(x, tm)[0].sum()) / (2 * eps)
         np.testing.assert_allclose(gt[idx], num, rtol=5e-3, atol=1e-5)
+
+
+def test_roi_perspective_transform_axis_aligned():
+    """An axis-aligned rectangular quad degenerates to a plain crop:
+    output equals bilinear samples on the grid, mask all ones, and the
+    grad scatters exactly through the sampled corners."""
+    rng = np.random.RandomState(5)
+    x = rng.random_sample((1, 2, 8, 8)).astype("float32")
+    # quad (1,1) -> (6,1) -> (6,6) -> (1,6); transformed 6x6
+    quad = np.asarray([[1, 1, 6, 1, 6, 6, 1, 6]], "float32")
+    rois = LoDTensor(quad)
+    rois.set_lod([[0, 1]])
+    attrs = {"transformed_height": 6, "transformed_width": 6,
+             "spatial_scale": 1.0}
+    out = _run_op(
+        "roi_perspective_transform",
+        {"X": ["x"], "ROIs": ["r"]},
+        {"Out": ["o"], "Mask": ["m"], "TransformMatrix": ["tm"],
+         "Out2InIdx": [], "Out2InWeights": []}, attrs,
+        {"x": x, "r": rois})
+    o = np.asarray(out["o"].array)
+    mask = np.asarray(out["m"].array)
+    assert o.shape == (1, 2, 6, 6)
+    assert mask.min() == 1  # fully inside the quad and the image
+    # identity-scaled crop: out[h, w] == x[1+h, 1+w]
+    np.testing.assert_allclose(o[0, :, :, :], x[0, :, 1:7, 1:7],
+                               rtol=1e-5, atol=1e-5)
+    # grad: ones cotangent scatters exactly once per sampled pixel
+    gout = _run_op(
+        "roi_perspective_transform_grad",
+        {"X": ["x"], "ROIs": ["r"], "Mask": ["m"], "Out@GRAD": ["og"]},
+        {"X@GRAD": ["gx"]}, attrs,
+        {"x": x, "r": rois, "m": out["m"], "og": np.ones_like(o)})
+    gx = np.asarray(gout["gx"].array)
+    np.testing.assert_allclose(gx[0, 0, 1:7, 1:7], 1.0, atol=1e-6)
+    assert gx[0, 0, 0, :].sum() == 0
+
+
+def test_generate_mask_labels_rect_poly():
+    """A rectangular polygon rasterizes exactly; the mask target lands
+    in the fg roi's class slot with -1 elsewhere."""
+    res, ncls = 4, 3
+    im = np.asarray([[16, 16, 1.0]], "float32")
+    gtc = LoDTensor(np.asarray([[1]], "int32"))
+    gtc.set_lod([[0, 1]])
+    crowd = LoDTensor(np.zeros((1, 1), "int32"))
+    crowd.set_lod([[0, 1]])
+    # polygon: rectangle [2,2]-[10,10] (one gt, one polygon, 4 points)
+    pts = np.asarray([[2, 2], [10, 2], [10, 10], [2, 10]], "float32")
+    segs = LoDTensor(pts)
+    segs.set_lod([[0, 1], [0, 4]])
+    # two rois: one fg matching the rect's left half, one bg
+    rois = LoDTensor(np.asarray([[2, 2, 6, 10], [12, 12, 15, 15]],
+                                "float32"))
+    rois.set_lod([[0, 2]])
+    labels = LoDTensor(np.asarray([[2], [0]], "int32"))
+    labels.set_lod([[0, 2]])
+    out = _run_op(
+        "generate_mask_labels",
+        {"ImInfo": ["im"], "GtClasses": ["gc"], "IsCrowd": ["ic"],
+         "GtSegms": ["gs"], "Rois": ["ro"], "LabelsInt32": ["lb"]},
+        {"MaskRois": ["mr"], "RoiHasMaskInt32": ["hm"],
+         "MaskInt32": ["mi"]},
+        {"num_classes": ncls, "resolution": res},
+        {"im": im, "gc": gtc, "ic": crowd, "gs": segs, "ro": rois,
+         "lb": labels})
+    mr = np.asarray(out["mr"].array)
+    hm = np.asarray(out["hm"].array).ravel()
+    mi = np.asarray(out["mi"].array)
+    assert mr.shape == (1, 4)  # one fg roi
+    np.testing.assert_array_equal(hm, [0])
+    assert mi.shape == (1, ncls * res * res)
+    # the roi sits fully inside the polygon -> class-2 slot all ones
+    cls2 = mi[0, 2 * res * res:3 * res * res]
+    np.testing.assert_array_equal(cls2, 1)
+    assert (mi[0, :2 * res * res] == -1).all()
