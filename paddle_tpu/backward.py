@@ -509,8 +509,14 @@ def _emit_while_grad(block, op, pending, finalize, diffable, no_grad,
         pending2[w] = [gname]
         seed_names[w] = gname
     finalize2 = make_finalize(gblock, pending2, clear_on_merge=True)
-    _emit_grad_ops(gblock, list(sub.ops), pending2, finalize2,
-                   diffable2, no_grad2, {}, {})
+    from .ops.control_flow_ops import _IN_WHILE_GRAD_GEN
+
+    _IN_WHILE_GRAD_GEN.append(True)
+    try:
+        _emit_grad_ops(gblock, list(sub.ops), pending2, finalize2,
+                       diffable2, no_grad2, {}, {})
+    finally:
+        _IN_WHILE_GRAD_GEN.pop()
     inner_grads = {}
     for r in thread_targets:
         g = finalize2(r)

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..core.registry import In, Out, register_host_op
+from ..core.registry import GRAD_SUFFIX, In, Out, register_host_op
 from ..core.tensor import LoDTensor, LoDTensorArray
 
 
@@ -168,12 +168,50 @@ def _while_grad(executor, op, scope):
                 carry_g[w] = v
 
     param_acc = {}
+    # grad ARRAYS (DynamicRNN memories/outputs) accumulate ACROSS trips:
+    # entries written during trip t+1's backward are read by trip t's —
+    # harvested from each trip's scope and re-seeded into the next.
+    # Array-valued TARGETS (step-input arrays) start from a fresh
+    # zero-filled full-length array ONCE per invocation (the sub-block
+    # generation skips the per-trip init op for exactly this reason).
+    from ..core.tensor import LoDTensor as _LT, LoDTensorArray as _LTA
+
+    persist_arrays = {}
+    for r, iname in zip(targets, inner_grads):
+        var = scope.find_var(r)
+        if var is None or not var.is_initialized():
+            continue
+        h = var.raw()
+        if isinstance(h, _LTA):
+            import jax.numpy as jnp
+
+            z = _LTA()
+            for item in h:
+                if item is None or getattr(item, "array", None) is None:
+                    z.append(None)
+                else:
+                    t = _LT(jnp.zeros_like(item.array))
+                    if item.lod():
+                        t.set_lod([list(l) for l in item.lod()])
+                    z.append(t)
+            persist_arrays[iname] = z
     for pre in reversed(snaps or []):
         gs = scope.new_scope()
         for name, holder in pre.items():
             gs.var(name).set(_copy_holder(holder))
+        for name, holder in persist_arrays.items():
+            gs.var(name).set(holder)
         # replay the trip: temporaries materialize locally
         executor.run_block(fwd_block, gs)
+        # zero-seed templates must match the POST-trip value shapes
+        # (carries can change shape across trips — shrinking RNN
+        # memories) — capture them BEFORE restoring pre values
+        post_zero = {}
+        for w in written:
+            if w not in carry_g:
+                z = _zeros_like_name(w, gs)
+                if z is not None:
+                    post_zero[w] = z
         # carries back to PRE values (their readers saw the previous
         # trip's value; the supported body shape writes each carry once,
         # after all its reads)
@@ -182,9 +220,7 @@ def _while_grad(executor, op, scope):
                 gs.var(c).set(_copy_holder(pre[c]))
         # seed incoming output grads (zeros when nothing arrived yet)
         for w, sname in zip(written, seed_names):
-            g = carry_g.get(w)
-            if g is None:
-                g = _zeros_like_name(w, gs)
+            g = carry_g.get(w, post_zero.get(w))
             if g is not None:
                 executor._write_var(gs, sname, g)
         executor.run_block(grad_block, gs)
@@ -213,15 +249,31 @@ def _while_grad(executor, op, scope):
         for w in written:
             if w not in carries and w in carry_g:
                 carry_g.pop(w)
+        # harvest grad arrays written this trip for the next (earlier)
+        # trip's backward
+        for lname in gs.local_var_names():
+            if GRAD_SUFFIX not in lname:
+                continue
+            lvar = gs.find_local_var(lname)
+            if lvar is not None and lvar.is_initialized() \
+                    and isinstance(lvar.raw(), _LTA):
+                persist_arrays[lname] = lvar.raw()
         # release this trip's replay scope — remat's point is O(1-trip)
         # peak memory, not O(T) pinned temporaries
         scope._kids.remove(gs)
 
     # emit outputs: params get accumulated grads; carries get the grad
-    # w.r.t. the pre-loop value (identity pass-through on zero trips)
+    # w.r.t. the pre-loop value (identity pass-through on zero trips);
+    # ARRAY-valued grads (DynamicRNN step-input arrays) hand over the
+    # accumulated grad array itself
     out_targets = list(op.attrs.get("out_targets", targets))
+    inner_of = dict(zip(targets, inner_grads))
     for r, oname in zip(out_targets, op.output("InGrads")):
         if not oname or oname == "@EMPTY@":
+            continue
+        arr_g = persist_arrays.get(inner_of.get(r, ""))
+        if arr_g is not None:
+            scope.var(oname).set(arr_g)
             continue
         if r in carries:
             g = carry_g.get(r)
@@ -252,10 +304,148 @@ def _conditional_block(executor, op, scope):
         scope.drop_kids()
 
 
+# set (as a stack) by backward._emit_while_grad while generating a
+# while-body grad block: there the while_grad HOST pre-seeds zero-filled
+# grad arrays once per invocation (per-trip init would wipe cross-trip
+# accumulation), so the maker must not emit the init op
+_IN_WHILE_GRAD_GEN: list = []
+
+
+def _array_grad_canonical(block, pending, arr_name):
+    """Array grads accumulate IN PLACE into one canonical grad-array
+    var (a `sum` over LoDTensorArrays is meaningless) — every maker
+    shares the name instead of binding fresh partials. On first use in
+    a main-block backward, a fill_zero_array_like op initializes it
+    full-length/zero-filled (a fresh array per run: resolving a STALE
+    previous run's array up the scope chain would double-accumulate)."""
+    from .. import framework
+
+    gname = framework.grad_var_name(arr_name)
+    first = not block.has_var_local(gname)
+    if first:
+        block.create_var(name=gname, shape=None, dtype="float32")
+    pending.setdefault(arr_name, [])
+    if gname not in pending[arr_name]:
+        pending[arr_name].append(gname)
+        if not _IN_WHILE_GRAD_GEN:
+            block.append_op("fill_zero_array_like",
+                            {"X": [arr_name]}, {"Out": [gname]}, {},
+                            infer_shape=False)
+    return gname
+
+
+@register_host_op(
+    "fill_zero_array_like",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _fill_zero_array_like(executor, op, scope):
+    """Fresh zero-filled grad array shaped like the forward array —
+    full length so adjoint consumers (array_to_lod_tensor) never see a
+    short or holey array."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import LoDTensor, LoDTensorArray
+
+    src = scope.find_var(op.input("X")[0]).get_lod_tensor_array()
+    out = LoDTensorArray()
+    for item in src:
+        if item is None or getattr(item, "array", None) is None:
+            out.append(None)
+        else:
+            t = LoDTensor(jnp.zeros_like(item.array))
+            if item.lod():
+                t.set_lod([list(l) for l in item.lod()])
+            out.append(t)
+    scope.var(op.output("Out")[0]).set(out)
+
+
+def _write_to_array_grad_maker(block, op, pending, finalize):
+    """write_to_array's X grad = the grad array's entry at I
+    (zeros-like X when no read consumed that slot)."""
+    g_arr = finalize(op.output("Out")[0])
+    if g_arr is None:
+        return
+    gx = _bind_partial_grad(block, pending, op.input("X")[0])
+    block.append_op(
+        "write_to_array_grad",
+        {"X": [op.input("X")[0]], "I": [op.input("I")[0]],
+         "ArrGrad": [g_arr]},
+        {"X@GRAD": [gx]}, {}, infer_shape=False)
+
+
+def _read_from_array_grad_maker(block, op, pending, finalize):
+    """read_from_array's grad scatters Out@GRAD into the grad array at
+    I, accumulating (reads at the same slot from several trips sum)."""
+    g_out = finalize(op.output("Out")[0])
+    if g_out is None:
+        return
+    g_arr = _array_grad_canonical(block, pending, op.input("X")[0])
+    block.append_op(
+        "read_from_array_grad",
+        {"OutGrad": [g_out], "I": [op.input("I")[0]]},
+        {"ArrGrad": [g_arr]}, {}, infer_shape=False)
+
+
+@register_host_op(
+    "write_to_array_grad",
+    inputs=[In("X", no_grad=True), In("I", no_grad=True),
+            In("ArrGrad", no_grad=True)],
+    outputs=[Out("X@GRAD")],
+)
+def _write_to_array_grad(executor, op, scope):
+    import jax.numpy as jnp
+
+    i = int(np.asarray(executor._read_var(
+        scope, op.input("I")[0])).reshape(()))
+    gvar = scope.find_var(op.input("ArrGrad")[0])
+    entry = None
+    if gvar is not None and gvar.is_initialized():
+        arr = gvar.get_lod_tensor_array()
+        if i < len(arr) and arr[i] is not None:
+            entry = arr[i]
+    if entry is None:
+        x = executor._read_var(scope, op.input("X")[0])
+        executor._write_var(scope, op.output("X@GRAD")[0],
+                            jnp.zeros_like(x))
+    else:
+        executor._write_var(scope, op.output("X@GRAD")[0], entry)
+
+
+@register_host_op(
+    "read_from_array_grad",
+    inputs=[In("OutGrad", no_grad=True), In("I", no_grad=True)],
+    outputs=[Out("ArrGrad")],
+)
+def _read_from_array_grad(executor, op, scope):
+    from ..core.tensor import LoDTensor
+
+    i = int(np.asarray(executor._read_var(
+        scope, op.input("I")[0])).reshape(()))
+    g = executor._read_var(scope, op.input("OutGrad")[0])
+    name = op.output("ArrGrad")[0]
+    # LOCAL-first: inside a while_grad trip the accumulated array was
+    # seeded locally; a parent-scope walk could only surface a stale
+    # array from a previous run (double accumulation)
+    var = scope.find_local_var(name)
+    if var is None or not var.is_initialized():
+        var = scope.find_var(name)
+    if var is None or not var.is_initialized():
+        var = scope.var(name)
+    arr = var.get_lod_tensor_array()
+    while len(arr) <= i:
+        arr.append(None)
+    if arr[i] is None or getattr(arr[i], "array", None) is None:
+        arr[i] = LoDTensor(g)
+    else:
+        arr[i] = LoDTensor(arr[i].array + g)
+
+
 @register_host_op(
     "write_to_array",
     inputs=[In("X"), In("I", no_grad=True)],
     outputs=[Out("Out")],
+    grad=_write_to_array_grad_maker,
 )
 def _write_to_array(executor, op, scope):
     i = int(np.asarray(executor._read_var(scope, op.input("I")[0])).reshape(()))
@@ -291,6 +481,7 @@ def _create_lod_tensor_array(executor, op, scope):
     "read_from_array",
     inputs=[In("X"), In("I", no_grad=True)],
     outputs=[Out("Out")],
+    grad=_read_from_array_grad_maker,
 )
 def _read_from_array(executor, op, scope):
     i = int(np.asarray(executor._read_var(scope, op.input("I")[0])).reshape(()))

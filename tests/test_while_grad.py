@@ -126,3 +126,141 @@ def test_while_grad_zero_trip():
         w1 = np.asarray(scope.find_var("w_z").raw().array)
     assert np.isfinite(float(np.ravel(l0)[0]))
     assert np.abs(w1 - w0).max() > 1e-8  # grads flowed around the loop
+
+
+def test_dynamic_rnn_trains_numeric_grad():
+    """Training THROUGH DynamicRNN (while + rank-table arrays): the
+    analytic weight gradient matches finite differences through the
+    full LoD pipeline, and SGD steps actually change the loss —
+    closing the round-3 'forward-only DynamicRNN' gap."""
+    from paddle_tpu.core.tensor import LoDTensor
+
+    D_in, H = 3, 4
+    lengths = [3, 1, 2]
+    rng = np.random.RandomState(11)
+    total = sum(lengths)
+    x_np = rng.randn(total, D_in).astype("float32")
+    x_t = LoDTensor(x_np)
+    offs = [0]
+    for ln in lengths:
+        offs.append(offs[-1] + ln)
+    x_t.set_lod([offs])
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="seq", shape=[-1, D_in],
+                           dtype="float32", lod_level=1)
+            drnn = fluid.layers.DynamicRNN()
+            with drnn.block():
+                word = drnn.step_input(x)
+                prev = drnn.memory(shape=[H], value=0.0)
+                hidden = fluid.layers.fc(
+                    [word, prev], size=H, act="tanh",
+                    param_attr=[fluid.ParamAttr(name="gwx"),
+                                fluid.ParamAttr(name="gwh")],
+                    bias_attr=fluid.ParamAttr(name="gb"))
+                drnn.update_memory(prev, hidden)
+                drnn.output(hidden)
+            out = drnn()
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.sequence_pool(out, pool_type="SUM"))
+            fluid.optimizer.SGDOptimizer(0.0).minimize(loss)  # lr 0:
+            # params frozen so repeated runs measure the same point
+        return main, startup, loss
+
+    main, startup, loss = build()
+    scope = fluid.Scope()
+    import jax.numpy as jnp
+
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        def run_loss():
+            (l,) = exe.run(main, feed={"seq": x_t}, fetch_list=[loss])
+            return float(np.ravel(l)[0])
+
+        run_loss()
+        g_wx = np.asarray(scope.find_var("gwx@GRAD").raw().array)
+        wx = np.asarray(scope.find_var("gwx").raw().array).copy()
+        # finite differences on three elements of W_x
+        eps = 1e-3
+        for idx in [(0, 0), (1, 2), (2, 3)]:
+            for sgn, store in ((+1, "p"), (-1, "m")):
+                w2 = wx.copy()
+                w2[idx] += sgn * eps
+                scope.var("gwx").get_tensor()._array = jnp.asarray(w2)
+                if sgn > 0:
+                    lp = run_loss()
+                else:
+                    lm = run_loss()
+            scope.var("gwx").get_tensor()._array = jnp.asarray(wx)
+            num = (lp - lm) / (2 * eps)
+            np.testing.assert_allclose(g_wx[idx], num, rtol=5e-3,
+                                       atol=1e-4)
+
+    # and with a real lr, the loss moves
+    main2, startup2, loss2 = build()
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        # swap lr var to 0.5 (created by the optimizer as a constant)
+        for name in main2.global_block().vars:
+            if "learning_rate" in name:
+                scope2.var(name).get_tensor()._array = jnp.asarray(
+                    np.asarray([0.5], "float32"))
+        losses = []
+        for _ in range(4):
+            (l,) = exe2.run(main2, feed={"seq": x_t},
+                            fetch_list=[loss2])
+            losses.append(float(np.ravel(l)[0]))
+    assert all(np.isfinite(losses)), losses
+    assert abs(losses[-1] - losses[0]) > 1e-6, losses
+
+
+def test_dynamic_rnn_input_grad_stable_across_runs():
+    """Array-valued input grads must be RECOMPUTED per run, not
+    accumulated into a stale grad array from the previous exe.run."""
+    from paddle_tpu.core.tensor import LoDTensor
+
+    D_in, H = 3, 4
+    rng = np.random.RandomState(13)
+    x_np = rng.randn(4, D_in).astype("float32")
+    x_t = LoDTensor(x_np)
+    x_t.set_lod([[0, 2, 4]])
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="seq", shape=[-1, D_in], dtype="float32",
+                       lod_level=1)
+        x.stop_gradient = False
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(x)
+            prev = drnn.memory(shape=[H], value=0.0)
+            hidden = fluid.layers.fc(
+                [word, prev], size=H, act="tanh",
+                param_attr=[fluid.ParamAttr(name="swx"),
+                            fluid.ParamAttr(name="swh")],
+                bias_attr=False)
+            drnn.update_memory(prev, hidden)
+            drnn.output(hidden)
+        out = drnn()
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.sequence_pool(out, pool_type="SUM"))
+        fluid.optimizer.SGDOptimizer(0.0).minimize(loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sums = []
+        for _ in range(3):
+            exe.run(main, feed={"seq": x_t}, fetch_list=[loss])
+            g = np.asarray(scope.find_var("seq@GRAD").raw().array)
+            sums.append(float(np.abs(g).sum()))
+    # identical every run (lr=0 keeps the function fixed)
+    np.testing.assert_allclose(sums[1], sums[0], rtol=1e-6)
+    np.testing.assert_allclose(sums[2], sums[0], rtol=1e-6)
