@@ -1,20 +1,26 @@
 """dygraph_to_static: ProgramTranslator + @declarative.
 
 Parity: /root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
-program_translator.py:229. The reference rewrites Python ASTs into
-static-graph code; the TPU-native mechanism is TRACE-based: the
-decorated function runs once eagerly per input signature while the
-tracer records every op into a Program, which then executes through the
-whole-program XLA compiler (single dispatch per call). Data-dependent
-Python control flow inside the function is therefore specialized per
-trace — the same constraint jax.jit imposes, and the honest contract on
-a tracing compiler (the reference's AST path re-plumbs `if`/`for` into
-cond/while ops instead; use fluid.layers.cond / While for dynamic
-control flow).
+program_translator.py:229. Like the reference, ``@declarative`` is
+AST-FIRST: the function's AST is rewritten (ast_transform.py) so that
+tensor-dependent ``if``/``while``/``for range`` build real graph
+control flow (select / `while` op -> lax.while_loop), then the
+converted function is run ONCE per input signature on static
+placeholder Variables to build a Program that executes through the
+whole-program XLA compiler. Data-dependent control flow therefore
+lives INSIDE the compiled program — changing tensor *values* never
+retraces.
+
+Fallback: when the function cannot build statically (dygraph Layer
+modules with eager parameters, source unavailable), we fall back to
+the TRACE path: run eagerly under the dygraph tracer recording ops
+into a Program — jax.jit-style per-signature specialization of any
+data-dependent Python control flow.
 """
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Dict
 
 import numpy as np
@@ -24,17 +30,43 @@ from .varbase import VarBase
 __all__ = ["ProgramTranslator", "declarative", "to_static"]
 
 
+def _as_array(a):
+    """Tensor-like args become feeds; anything else passes through.
+    Numpy scalars and numeric lists/tuples are tensor-like (they fed
+    as arrays before the AST path landed and must keep doing so — one
+    program per shape/dtype, not one per value); plain Python scalars
+    stay static args (usable as shapes/flags), jit-style."""
+    if isinstance(a, VarBase):
+        return a._array
+    if isinstance(a, (np.ndarray, np.generic)):
+        return np.asarray(a)
+    if isinstance(a, (list, tuple)) and a and not isinstance(
+            a[0], (str, bytes, type(None))):
+        try:
+            arr = np.asarray(a)
+        except (ValueError, TypeError):
+            return None
+        if arr.dtype != object:
+            return arr
+    return None
+
+
 class _TracedFunction:
     def __init__(self, fn):
+        from .ast_transform import ast_to_static_func
+
         self._fn = fn
-        self._cache: Dict = {}  # signature -> (program, feeds, fetches, params)
+        self._static_fn, self._ast_ok = ast_to_static_func(fn)
+        self._cache: Dict = {}  # signature -> entry dict
         self._staged: Dict = {}  # param name -> id(array) staged in scope
+        # strong refs to object-keyed args: an id() in a signature must
+        # not be recycled by a later allocation (false cache hit)
+        self._keepalive: list = []
 
     def __get__(self, obj, objtype=None):
         """Descriptor protocol: @declarative on a method binds self."""
         if obj is None:
             return self
-        import functools
 
         bound = functools.partial(self.__call__, obj)
         bound.get_program = lambda *a: self.get_program(obj, *a)
@@ -43,9 +75,64 @@ class _TracedFunction:
     def _signature(self, args):
         sig = []
         for a in args:
-            arr = a._array if isinstance(a, VarBase) else np.asarray(a)
-            sig.append((tuple(arr.shape), str(arr.dtype)))
+            arr = _as_array(a)
+            if arr is None:
+                if isinstance(a, (int, float, str, bool, type(None))):
+                    sig.append(("py", type(a).__name__, a))
+                else:
+                    # identity-keyed: pin the object so its address is
+                    # never recycled into a false cache hit (mutating
+                    # the object still reuses the stale program — the
+                    # reference's InputSpec caveat)
+                    self._keepalive.append(a)
+                    sig.append(("py", type(a).__name__, id(a)))
+            else:
+                sig.append((tuple(arr.shape), str(arr.dtype)))
         return tuple(sig)
+
+    # -- AST/static path ---------------------------------------------------
+
+    def _build_static(self, args):
+        """Build a Program by running the AST-converted function on
+        placeholder Variables (reference StaticFunction concrete
+        program, program_translator.py:480)."""
+        from .. import framework
+        from ..layers import io as lio
+
+        program = framework.Program()
+        startup = framework.Program()
+        prev_tracer = framework._dygraph_tracer_
+        framework._dygraph_tracer_ = None  # build statically
+        try:
+            with framework.program_guard(program, startup):
+                call_args = []
+                feed_names = []
+                for idx, a in enumerate(args):
+                    arr = _as_array(a)
+                    if arr is None:
+                        call_args.append(a)
+                        continue
+                    name = "_jst_feed_%d" % idx
+                    v = lio.data(name=name, shape=list(arr.shape),
+                                 dtype=str(arr.dtype),
+                                 append_batch_size=False)
+                    feed_names.append(name)
+                    call_args.append(v)
+                outs = self._static_fn(*call_args)
+        finally:
+            framework._dygraph_tracer_ = prev_tracer
+        single = not isinstance(outs, (list, tuple))
+        outs_l = [outs] if single else list(outs)
+        for o in outs_l:
+            if not isinstance(o, framework.Variable):
+                raise ValueError(
+                    "declarative function returned a non-Variable %r"
+                    % (o,))
+        return {"kind": "static", "program": program, "startup": startup,
+                "feeds": feed_names, "fetches": [o.name for o in outs_l],
+                "single": single, "initialized": False}
+
+    # -- trace fallback ----------------------------------------------------
 
     def _trace(self, args):
         from .. import framework
@@ -60,24 +147,53 @@ class _TracedFunction:
             program = framework.Program()
             blk = program.global_block()
             in_vars = []
+            call_args = []
             for a in args:
-                arr = a._array if isinstance(a, VarBase) else np.asarray(a)
+                arr = _as_array(a)
+                if arr is None:
+                    call_args.append(a)
+                    continue
                 v = VarBase(arr, stop_gradient=True)
                 var = blk.create_var(name=v.name, shape=tuple(arr.shape),
                                      dtype=str(arr.dtype))
                 var.is_data = True
                 in_vars.append(v)
+                call_args.append(v)
             tracer.start_program_recording(program)
             try:
-                outs = self._fn(*in_vars)
+                outs = self._fn(*call_args)
             finally:
                 tracer.stop_program_recording()
             single = not isinstance(outs, (list, tuple))
             outs_l = [outs] if single else list(outs)
             params = {p.name: p for p in tracer.all_parameters()
                       if blk.has_var_local(p.name)}
-            return (program, [v.name for v in in_vars],
-                    [o.name for o in outs_l], params, single)
+            return {"kind": "trace", "program": program,
+                    "feeds": [v.name for v in in_vars],
+                    "fetches": [o.name for o in outs_l],
+                    "params": params, "single": single}
+
+    def _build_entry(self, args):
+        if self._ast_ok:
+            from .ast_transform import Dy2StaticError
+
+            try:
+                return self._build_static(args)
+            except Dy2StaticError:
+                # a conversion DIAGNOSTIC (tensor control flow the
+                # graph cannot express) — surface it; the trace path
+                # would silently change semantics
+                raise
+            except Exception as e:  # dygraph Layers etc. -> trace path
+                warnings.warn(
+                    "dygraph_to_static: static AST build failed (%s: %s); "
+                    "falling back to trace-based conversion — "
+                    "data-dependent Python control flow will be "
+                    "specialized per input signature"
+                    % (type(e).__name__, e))
+        return self._trace(args)
+
+    # -- execution ---------------------------------------------------------
 
     def __call__(self, *args):
         if not ProgramTranslator().enabled:
@@ -85,41 +201,47 @@ class _TracedFunction:
         sig = self._signature(args)
         entry = self._cache.get(sig)
         if entry is None:
-            entry = self._trace(args)
+            entry = self._build_entry(args)
             self._cache[sig] = entry
-        program, feed_names, fetch_names, params, single = entry
 
         import paddle_tpu as fluid
 
         import jax.numpy as jnp
 
-        scope = fluid.global_scope()
-        for name, p in params.items():
-            # stage a COPY (the compiled program donates its state
-            # buffers; the live dygraph parameter must survive) — but
-            # only when the parameter actually changed since last call
-            if self._staged.get(name) != id(p._array):
-                scope.var(name).get_tensor()._array = jnp.array(
-                    p._array, copy=True)
-                self._staged[name] = id(p._array)
         exe = _shared_executor()
+        scope = fluid.global_scope()
+        if entry["kind"] == "static":
+            if not entry["initialized"]:
+                if entry["startup"].global_block().ops:
+                    exe.run(entry["startup"], scope=scope)
+                entry["initialized"] = True
+        else:
+            for name, p in entry["params"].items():
+                # stage a COPY (the compiled program donates its state
+                # buffers; the live dygraph parameter must survive) —
+                # only when the parameter changed since last call
+                if self._staged.get(name) != id(p._array):
+                    scope.var(name).get_tensor()._array = jnp.array(
+                        p._array, copy=True)
+                    self._staged[name] = id(p._array)
         feed = {}
-        for n, a in zip(feed_names, args):
-            feed[n] = np.asarray(a._array if isinstance(a, VarBase)
-                                 else a)
-        outs = exe.run(program, feed=feed, fetch_list=fetch_names,
-                       return_numpy=False)
+        arrs = [a for a in (_as_array(x) for x in args) if a is not None]
+        for n, a in zip(entry["feeds"], arrs):
+            feed[n] = np.asarray(a)
+        outs = exe.run(entry["program"], feed=feed,
+                       fetch_list=entry["fetches"], return_numpy=False,
+                       scope=scope)
         result = [VarBase(o.array if hasattr(o, "array") else o,
                           stop_gradient=True) for o in outs]
-        # params may have been updated elsewhere; nothing to write back —
-        # the static program here is forward-only
-        return result[0] if single else result
+        return result[0] if entry["single"] else result
 
     def get_program(self, *args):
         sig = self._signature(args)
-        entry = self._cache.get(sig) or self._trace(args)
-        self._cache[sig] = entry
-        return entry[0]
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._build_entry(args)
+            self._cache[sig] = entry
+        return entry["program"]
 
 
 _executor = None

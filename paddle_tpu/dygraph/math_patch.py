@@ -14,6 +14,18 @@ def _trace(op_type, ins, attrs=None):
     return current_tracer().trace_op(op_type, ins, {}, attrs or {})
 
 
+def _const(value, dtype):
+    """Scalar constant as a TRACED fill_constant op — a raw VarBase
+    would be invisible to program recording (@declarative trace
+    replay would find an unfed variable)."""
+    from ..core import dtypes as _dt
+
+    return _trace("fill_constant", {},
+                  {"shape": [1], "value": float(value),
+                   "dtype": _dt.dtype_to_enum(str(dtype)),
+                   "force_cpu": False})["Out"][0]
+
+
 def _binary(op_type, x, y, reverse=False):
     if not isinstance(y, VarBase):
         if op_type == "elementwise_add":
@@ -26,8 +38,7 @@ def _binary(op_type, x, y, reverse=False):
             return _trace("scale", {"X": x}, {"scale": float(y), "bias": 0.0})["Out"][0]
         if op_type == "elementwise_div" and not reverse:
             return _trace("scale", {"X": x}, {"scale": 1.0 / float(y), "bias": 0.0})["Out"][0]
-        y = VarBase(np.full((1,), y, dtype=np.asarray(x.numpy()).dtype),
-                    stop_gradient=True)
+        y = _const(y, np.asarray(x.numpy()).dtype)
     a, b = (y, x) if reverse else (x, y)
     return _trace(op_type, {"X": a, "Y": b}, {"axis": -1})["Out"][0]
 
@@ -54,6 +65,38 @@ def monkey_patch_varbase():
     VarBase.__matmul__ = lambda self, other: _trace(
         "matmul", {"X": self, "Y": other},
         {"transpose_X": False, "transpose_Y": False, "alpha": 1.0})["Out"][0]
+
+    def _cmp(op_type):
+        def impl(self, other):
+            if not isinstance(other, VarBase):
+                # promote: int tensor vs float threshold must compare
+                # as float, not truncate the threshold into the int
+                # dtype (0 > -0.5 would become 0 > 0)
+                self_dt = np.asarray(self.numpy()).dtype
+                dt = np.promote_types(self_dt, np.asarray(other).dtype)
+                if dt.kind == "f":
+                    dt = np.dtype("float32")
+                other = _const(other, dt)
+            return _trace(op_type, {"X": self, "Y": other})["Out"][0]
+
+        return impl
+
+    VarBase.__lt__ = _cmp("less_than")
+    VarBase.__le__ = _cmp("less_equal")
+    VarBase.__gt__ = _cmp("greater_than")
+    VarBase.__ge__ = _cmp("greater_equal")
+    # __eq__/__ne__ stay identity (matching static Variable + reference)
+
+    def _bool(self):
+        # eager values are concrete — numpy truthiness semantics
+        arr = np.asarray(self.numpy())
+        if arr.size != 1:
+            raise ValueError(
+                "The truth value of a multi-element VarBase is ambiguous; "
+                "use .any()/.all() reductions")
+        return bool(arr.reshape(-1)[0])
+
+    VarBase.__bool__ = _bool
 
 
 monkey_patch_varbase()

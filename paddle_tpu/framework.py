@@ -311,7 +311,9 @@ def infer_op_shapes(block: Block, op: Operator) -> None:
                 break
         for slot in info.outputs:
             for n in op.output(slot.name):
-                v = block._find_var_recursive(n) or block.create_var(name=n)
+                v = block._find_var_recursive(n)
+                if v is None:
+                    v = block.create_var(name=n)
                 if v.shape is None and first is not None \
                         and first.shape is not None:
                     v.shape = (-1,) + tuple(first.shape[1:])

@@ -83,5 +83,14 @@ def monkey_patch_variable(cls=None):
     # NB: __eq__/__ne__ stay identity comparisons (the reference does the
     # same; use layers.equal for elementwise equality)
 
+    def _bool(self):
+        raise TypeError(
+            "A static-graph Variable has no boolean value at graph-build "
+            "time. Inside @declarative functions, tensor `if`/`while` are "
+            "converted automatically unless the branch early-returns; "
+            "otherwise use fluid.layers.cond / fluid.layers.While.")
+
+    cls.__bool__ = _bool
+
 
 monkey_patch_variable()

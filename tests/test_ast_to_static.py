@@ -1,0 +1,269 @@
+"""AST-based dygraph_to_static conversion tests.
+
+Parity: /root/reference/python/paddle/fluid/tests/unittests/
+dygraph_to_static/ (test_ifelse.py, test_loop.py, test_logical.py,
+test_for_enumerate.py). The contract under test: a tensor-dependent
+``if``/``while``/``for range`` inside a ``@declarative`` function is
+rewritten into graph control flow, so ONE program (one cache entry)
+serves every tensor VALUE of the same signature — the property the
+reference's AST pass provides over naive tracing.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.dygraph.dygraph_to_static import declarative
+
+
+def _f32(x):
+    return np.asarray(x, dtype=np.float32)
+
+
+class TestTensorIf:
+    def test_both_branches_one_program(self):
+        @declarative
+        def f(x):
+            if fluid.layers.reduce_sum(x) > 0:
+                y = x + 1.0
+            else:
+                y = x - 1.0
+            return y
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # no trace-fallback warning
+            a = f(_f32(np.ones((2, 3))))
+            b = f(_f32(-np.ones((2, 3))))
+        assert np.allclose(a.numpy(), 2.0)
+        assert np.allclose(b.numpy(), -2.0)
+        assert len(f._cache) == 1, "one program must serve both signs"
+
+    def test_name_assigned_in_one_branch_keeps_outer_value(self):
+        @declarative
+        def f(x):
+            y = x * 2.0
+            if fluid.layers.reduce_sum(x) > 0:
+                y = x * 3.0
+            return y
+
+        assert np.allclose(f(_f32([1.0, 1.0])).numpy(), 3.0)
+        assert np.allclose(f(_f32([-1.0, -1.0])).numpy(), -2.0)
+
+    def test_bool_ops_in_condition(self):
+        @declarative
+        def f(x, y):
+            if fluid.layers.reduce_sum(x) > 0 and \
+                    fluid.layers.reduce_sum(y) > 0:
+                out = x + y
+            else:
+                out = x - y
+            return out
+
+        a = f(_f32([1.0]), _f32([2.0]))
+        b = f(_f32([1.0]), _f32([-2.0]))
+        assert np.allclose(a.numpy(), 3.0)
+        assert np.allclose(b.numpy(), 3.0)  # 1 - (-2)
+
+    def test_logical_not(self):
+        @declarative
+        def f(x):
+            if not (fluid.layers.reduce_sum(x) > 0):
+                out = x * 0.0
+            else:
+                out = x * 1.0
+            return out
+
+        assert np.allclose(f(_f32([5.0])).numpy(), 5.0)
+        assert np.allclose(f(_f32([-5.0])).numpy(), 0.0)
+
+    def test_python_condition_stays_python(self):
+        @declarative
+        def f(x, flag):
+            if flag:
+                return x + 10.0
+            return x - 10.0
+
+        # early return keeps the Python `if`; flag is in the signature
+        assert np.allclose(f(_f32([1.0]), True).numpy(), 11.0)
+        assert np.allclose(f(_f32([1.0]), False).numpy(), -9.0)
+        assert len(f._cache) == 2
+
+
+class TestTensorWhile:
+    def test_while_compiles_to_while_op(self):
+        @declarative
+        def g(x):
+            s = x
+            while fluid.layers.reduce_sum(s) < 100.0:
+                s = s * 2.0
+            return s
+
+        with warnings.catch_warnings():
+            # the whole point is ONE compiled XLA program — an
+            # interpreter fallback is a failure, not a warning
+            warnings.filterwarnings(
+                "error", message=".*falls back to op-by-op.*")
+            r1 = g(_f32(np.full((4,), 1.0)))
+        r2 = g(_f32(np.full((4,), 30.0)))
+        assert np.allclose(r1.numpy(), 32.0)
+        assert np.allclose(r2.numpy(), 30.0)  # already >= 100 total
+        prog = g.get_program(_f32(np.full((4,), 1.0)))
+        types = [op.type for op in prog.global_block().ops]
+        assert "while" in types
+        assert len(g._cache) == 1
+
+    def test_scalar_counter_promoted(self):
+        @declarative
+        def g(n):
+            i = 0
+            acc = n * 0.0
+            while i < fluid.layers.reduce_sum(n):
+                acc = acc + 2.0
+                i = i + 1
+            return acc
+
+        out = g(_f32([3.0]))
+        assert np.allclose(out.numpy(), 6.0)
+
+    def test_if_inside_while(self):
+        @declarative
+        def g(x):
+            s = x
+            while fluid.layers.reduce_sum(s) < 10.0:
+                if fluid.layers.reduce_sum(s) < 5.0:
+                    s = s + 2.0
+                else:
+                    s = s + 1.0
+            return s
+
+        # 1 -> 3 -> 5 -> 6 -> ... -> 10
+        out = g(_f32([1.0]))
+        assert np.allclose(out.numpy(), 10.0)
+
+    def test_python_while_unchanged(self):
+        @declarative
+        def g(x, n):
+            i = 0
+            while i < n:
+                x = x + 1.0
+                i += 1
+            return x
+
+        assert np.allclose(g(_f32([0.0]), 4).numpy(), 4.0)
+
+
+class TestForRange:
+    def test_python_range_unrolls(self):
+        @declarative
+        def h(x):
+            for i in range(3):
+                x = x + 1.0
+            return x
+
+        assert np.allclose(h(_f32([0.0])).numpy(), 3.0)
+
+    def test_tensor_range_lowers_to_while(self):
+        @declarative
+        def h(x):
+            n = fluid.layers.cast(fluid.layers.reduce_sum(x), "int64")
+            acc = x * 0.0
+            for i in range(n):
+                acc = acc + 3.0
+            return acc
+
+        out = h(_f32([2.0, 2.0]))  # n = 4
+        assert np.allclose(out.numpy(), 12.0)
+        prog = h.get_program(_f32([2.0, 2.0]))
+        types = [op.type for op in prog.global_block().ops]
+        assert "while" in types
+
+    def test_negative_step_tensor_range(self):
+        @declarative
+        def h(x):
+            n = fluid.layers.cast(fluid.layers.reduce_sum(x), "int64")
+            acc = fluid.layers.fill_constant([1], "int64", 0)
+            for i in range(n, 0, -1):
+                acc = acc + i
+            return acc
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "error", message=".*falls back to op-by-op.*")
+            out = h(_f32([2.0, 2.0]))  # 4+3+2+1
+        assert int(np.asarray(out.numpy()).ravel()[0]) == 10
+
+    def test_for_target_bound_after_loop(self):
+        @declarative
+        def h(x):
+            for i in range(3):
+                x = x + 1.0
+            return x * i  # Python: i == 2 after the loop
+
+        assert np.allclose(h(_f32([0.0])).numpy(), 6.0)
+
+    def test_iteration_var_used_in_body(self):
+        @declarative
+        def h(x):
+            n = fluid.layers.cast(fluid.layers.reduce_sum(x), "int64")
+            acc = fluid.layers.fill_constant([1], "int64", 0)
+            for i in range(n):
+                acc = acc + i
+            return acc
+
+        out = h(_f32([2.0, 3.0]))  # n=5 -> 0+1+2+3+4
+        assert int(np.asarray(out.numpy()).ravel()[0]) == 10
+
+
+class TestErrorsAndGuards:
+    def test_static_variable_bool_raises(self):
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            v = fluid.layers.fill_constant([1], "bool", 1.0)
+            with pytest.raises(TypeError, match="boolean value"):
+                bool(v)
+
+    def test_varbase_bool_is_concrete(self):
+        with fluid.dygraph.guard():
+            v = fluid.dygraph.to_variable(_f32([3.0]))
+            assert bool(v > 1.0)
+            assert not bool(v > 5.0)
+            # int tensor vs float threshold must not truncate
+            iv = fluid.dygraph.to_variable(
+                np.array([0], dtype=np.int32))
+            assert bool(iv > -0.5)
+            with pytest.raises(ValueError, match="ambiguous"):
+                bool(fluid.dygraph.to_variable(_f32([1.0, 2.0])))
+
+    def test_undefined_loop_var_raises(self):
+        @declarative
+        def g(x):
+            while fluid.layers.reduce_sum(x) < 0.0:
+                y = x + 1.0
+                x = y
+            return x
+
+        # y undefined before the loop but assigned in body -> must be
+        # a clear error in tensor mode, not a crash
+        with pytest.raises(Exception, match="initialize|NameError|no value"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                g(_f32([1.0]))
+
+
+class TestTraceFallback:
+    def test_dygraph_layer_falls_back_to_trace(self):
+        """Functions using dygraph Layers cannot build statically and
+        must keep working through the trace path."""
+        with fluid.dygraph.guard():
+            fc = fluid.dygraph.Linear(4, 2)
+
+            @declarative
+            def model(x):
+                return fluid.layers.reduce_sum(fc(x))
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                out = model(fluid.dygraph.to_variable(
+                    _f32(np.ones((1, 4)))))
+            assert np.asarray(out.numpy()).shape in ((), (1,))
