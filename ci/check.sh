@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# CI invariant gate (reference: paddle/scripts/paddle_build.sh +
+# tools/check_op_register_type.py + tools/print_signatures.py +
+# tools/check_api_approvals.sh — the reference wires these into CI; this
+# script is the equivalent single entry point).
+#
+# Usage:
+#   ci/check.sh            # run all gates
+#   ci/check.sh --update   # refresh the committed API fingerprint
+#   SKIP_TESTS=1 ci/check.sh   # invariants only (fast)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PALLAS_AXON_POOL_IPS=
+
+if [[ "${1:-}" == "--update" ]]; then
+    python -m paddle_tpu.tools.print_signatures > ci/api_fingerprint.txt
+    echo "ci/api_fingerprint.txt refreshed ($(wc -l < ci/api_fingerprint.txt) entries)"
+    exit 0
+fi
+
+echo "== gate 1: op-registry parity (diff must be 0 vs allowlist) =="
+python -m paddle_tpu.tools.check_op_registry --parity
+
+echo "== gate 2: public API signature freeze =="
+python -m paddle_tpu.tools.print_signatures > /tmp/_api_fingerprint.txt
+if ! diff -u ci/api_fingerprint.txt /tmp/_api_fingerprint.txt; then
+    echo "API surface changed. If intentional: ci/check.sh --update" >&2
+    exit 1
+fi
+echo "API surface unchanged ($(wc -l < ci/api_fingerprint.txt) entries)"
+
+echo "== gate 3: native artifacts build =="
+if command -v g++ >/dev/null; then
+    (cd csrc && ./build.sh >/dev/null)
+    echo "csrc build OK"
+else
+    echo "g++ unavailable, skipped"
+fi
+
+if [[ "${SKIP_TESTS:-0}" != "1" ]]; then
+    echo "== gate 4: test suite =="
+    python -m pytest tests/ -q
+fi
+echo "ALL CI GATES PASS"

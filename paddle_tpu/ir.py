@@ -25,7 +25,8 @@ class IrVarNode:
 
     def __init__(self, graph, name: str, shape=None, dtype="float32",
                  persistable: bool = False, is_parameter: bool = False,
-                 trainable: bool = True, stop_gradient: bool = False):
+                 trainable: bool = True, stop_gradient: bool = False,
+                 is_data: bool = False):
         self._graph = graph
         self._name = name
         self.shape = tuple(shape) if shape is not None else None
@@ -34,6 +35,7 @@ class IrVarNode:
         self.is_parameter = is_parameter
         self.trainable = trainable
         self.stop_gradient = stop_gradient
+        self.is_data = is_data
 
     def name(self) -> str:
         return self._name
@@ -166,7 +168,8 @@ class IrGraph:
                 bool(getattr(var, "persistable", False)),
                 is_parameter=isinstance(var, framework.Parameter),
                 trainable=bool(getattr(var, "trainable", True)),
-                stop_gradient=bool(getattr(var, "stop_gradient", False)))
+                stop_gradient=bool(getattr(var, "stop_gradient", False)),
+                is_data=bool(getattr(var, "is_data", False)))
         for op in block.ops:
             self._ops.append(IrOpNode(self, op.type, dict(op.inputs),
                                       dict(op.outputs), dict(op.attrs)))
@@ -254,7 +257,8 @@ class IrGraph:
             else:
                 var = block.create_var(name=name, dtype=v.dtype,
                                        persistable=v.persistable,
-                                       stop_gradient=v.stop_gradient)
+                                       stop_gradient=v.stop_gradient,
+                                       is_data=v.is_data)
             if v.shape is not None:
                 var.shape = tuple(v.shape)
         for op in self._ops:
@@ -404,8 +408,338 @@ class FcFusePass(Pass):
         return graph
 
 
+class GraphPatternDetector:
+    """Declarative subgraph matcher (reference
+    ir/graph_pattern_detector.h PDPattern/PDNode + GraphPatternDetector).
+
+    The reference builds a pattern of PDNodes with assert_is_op /
+    LinksTo edges and runs subgraph isomorphism; here a pattern is a
+    set of keyed op nodes plus slot-level edges, matched by
+    backtracking (patterns are 2-5 ops, so the search is trivial)::
+
+        d = GraphPatternDetector()
+        d.op_node("conv", "conv2d")
+        d.op_node("bn", "batch_norm")
+        d.edge_out("conv", "Output", "conv_out")
+        d.edge_in("bn", "X", "conv_out")
+        for m in d.detect(graph):
+            m["conv"], m["bn"]   # IrOpNodes
+            m["conv_out"]        # var name
+    """
+
+    def __init__(self):
+        self._op_nodes = []   # (key, op_type, predicate)
+        self._edges = []      # (op_key, direction, slot, var_key)
+        self._var_preds = {}  # var_key -> predicate(graph, name)
+
+    # -- pattern construction ---------------------------------------------
+
+    def op_node(self, key, op_type, predicate=None):
+        self._op_nodes.append((key, op_type, predicate))
+        return key
+
+    def var_node(self, key, predicate=None):
+        if predicate is not None:
+            self._var_preds[key] = predicate
+        return key
+
+    def edge_out(self, op_key, slot, var_key):
+        """op_key's output slot produces var_key (first name in slot)."""
+        self._edges.append((op_key, "out", slot, var_key))
+
+    def edge_in(self, op_key, slot, var_key):
+        """op_key consumes var_key at input slot (first name)."""
+        self._edges.append((op_key, "in", slot, var_key))
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def consumer_index(graph) -> Dict[str, List[IrOpNode]]:
+        idx: Dict[str, List[IrOpNode]] = {}
+        for o in graph.all_op_nodes():
+            for n in o.input_arg_names():
+                idx.setdefault(n, []).append(o)
+        return idx
+
+    # -- matching ----------------------------------------------------------
+
+    def detect(self, graph: IrGraph):
+        """Yield match dicts {key -> IrOpNode | var name}. Ops bind
+        injectively; overlapping matches are all yielded — rewriting
+        passes must skip ops they already consumed."""
+        ops = graph.all_op_nodes()
+        by_type: Dict[str, List[IrOpNode]] = {}
+        for o in ops:
+            by_type.setdefault(o.op_type(), []).append(o)
+
+        def backtrack(i, bound_ops, bound_vars):
+            if i == len(self._op_nodes):
+                m = dict(bound_ops)
+                m.update(bound_vars)
+                yield m
+                return
+            key, op_type, pred = self._op_nodes[i]
+            for cand in by_type.get(op_type, []):
+                if cand in bound_ops.values():
+                    continue
+                if pred is not None and not pred(cand):
+                    continue
+                new_vars = dict(bound_vars)
+                ok = True
+                for op_key, direction, slot, var_key in self._edges:
+                    if op_key != key:
+                        continue
+                    names = (cand.output(slot) if direction == "out"
+                             else cand.input(slot))
+                    if not names:
+                        ok = False
+                        break
+                    name = names[0]
+                    if var_key in new_vars and new_vars[var_key] != name:
+                        ok = False
+                        break
+                    vp = self._var_preds.get(var_key)
+                    if vp is not None and not vp(graph, name):
+                        ok = False
+                        break
+                    new_vars[var_key] = name
+                if not ok:
+                    continue
+                # edges whose op is already bound must agree too
+                for op_key, direction, slot, var_key in self._edges:
+                    if op_key == key or op_key not in bound_ops:
+                        continue
+                    other = bound_ops[op_key]
+                    names = (other.output(slot) if direction == "out"
+                             else other.input(slot))
+                    if names and var_key in new_vars \
+                            and new_vars[var_key] != names[0]:
+                        ok = False
+                        break
+                if not ok:
+                    continue
+                bound_ops[key] = cand
+                yield from backtrack(i + 1, bound_ops, new_vars)
+                del bound_ops[key]
+
+        yield from backtrack(0, {}, {})
+
+
+@PassRegistry.register
+class ConvBnFusePass(Pass):
+    """conv2d + batch_norm (inference) -> conv2d with folded weights
+    (reference ir/conv_bn_fuse_pass.cc). The BN affine transform is
+    folded into the conv filter and a bias:
+
+        W' = W * gamma / sqrt(var + eps)      (per out-channel)
+        b' = (b - mean) * gamma / sqrt(var + eps) + beta
+
+    Requires the scope holding the parameter values (like the
+    reference, which rewrites the weight tensors in place). Only valid
+    on a for_test graph — training BN updates running stats.
+    """
+
+    name = "conv_bn_fuse_pass"
+
+    def __init__(self, scope=None):
+        self.scope = scope
+
+    def apply(self, graph: IrGraph) -> IrGraph:
+        import numpy as np
+
+        if self.scope is None:
+            raise ValueError("conv_bn_fuse_pass needs the scope holding "
+                             "parameter values")
+        d = GraphPatternDetector()
+        d.op_node("conv", "conv2d")
+        d.op_node("bn", "batch_norm",
+                  predicate=lambda op: bool(op.attr("is_test")))
+        d.edge_out("conv", "Output", "conv_out")
+        d.edge_in("bn", "X", "conv_out")
+        consumed = set()
+        folded_filters = set()
+        consumers_of = GraphPatternDetector.consumer_index(graph)
+        for m in list(d.detect(graph)):
+            conv, bn = m["conv"], m["bn"]
+            if id(conv) in consumed or id(bn) in consumed:
+                continue
+            # conv_out must feed ONLY the bn (else the pre-BN value is
+            # still live and folding would corrupt it)
+            if len(consumers_of.get(m["conv_out"], [])) != 1:
+                continue
+            # a filter shared by >1 op must not be folded (in-place
+            # scope rewrite would corrupt the other consumer / fold
+            # twice)
+            filt = conv.input("Filter")[0]
+            if filt in folded_filters or \
+                    len(consumers_of.get(filt, [])) != 1:
+                continue
+
+            def _val(slot_names):
+                v = self.scope.find_var(slot_names[0])
+                return None if v is None else np.asarray(
+                    v.get_tensor().array)
+
+            w = _val(conv.input("Filter"))
+            gamma = _val(bn.input("Scale"))
+            beta = _val(bn.input("Bias"))
+            mean = _val(bn.input("Mean"))
+            var = _val(bn.input("Variance"))
+            if any(x is None for x in (w, gamma, beta, mean, var)):
+                continue
+            eps = bn.attr("epsilon")
+            eps = 1e-5 if eps is None else float(eps)
+            std = np.sqrt(var + eps)
+            factor = gamma / std
+            # Filter layout is OIHW for either data_format (the
+            # reference keeps OIHW too): scale along axis 0
+            w_new = w * factor.reshape((-1,) + (1,) * (w.ndim - 1))
+            conv_bias = conv.input("Bias")
+            b = _val(conv_bias) if conv_bias else np.zeros_like(mean)
+            if b is None:
+                b = np.zeros_like(mean)
+            b_new = (b - mean) * factor + beta
+
+            import jax.numpy as jnp
+
+            self.scope.find_var(conv.input("Filter")[0]) \
+                .get_tensor()._array = jnp.asarray(w_new)
+            bias_name = conv.input("Filter")[0] + ".bn_fold_bias"
+            graph.create_persistable_node(bias_name, shape=b_new.shape,
+                                          var_dtype=str(b_new.dtype))
+            # write the value straight into the scope (to_program
+            # callers never see the graph's startup_inits)
+            self.scope.var(bias_name).get_tensor()._array = \
+                jnp.asarray(b_new)
+            graph.set_initializer(bias_name, b_new)
+            bn_out = bn.output("Y")[0]
+            fused = IrOpNode(
+                graph, "conv2d",
+                {**conv.input_slots(), "Bias": [bias_name]},
+                {"Output": [bn_out]}, dict(conv._attrs))
+            graph._ops[graph._ops.index(conv)] = fused
+            graph.safe_remove_nodes([bn])
+            consumed.update((id(conv), id(bn)))
+            folded_filters.add(filt)
+            consumers_of = GraphPatternDetector.consumer_index(graph)
+        return graph
+
+
+@PassRegistry.register
+class GraphCheckPass(Pass):
+    """Graph consistency validator (reference
+    ir/multi_devices_graph_check_pass + the SSA sanity checks): every
+    op input must be produced by an earlier op, fed (is_data), or
+    persistable — a def-before-use audit over the op order the
+    executor/compiler will run."""
+
+    name = "graph_check_pass"
+
+    def apply(self, graph: IrGraph) -> IrGraph:
+        defined = set()
+        for v in graph.all_var_nodes():
+            if v.persistable or v.is_parameter or v.is_data:
+                defined.add(v.name())
+        for op in graph.all_op_nodes():
+            if op.op_type() in ("feed", "read", "create_py_reader"):
+                defined.update(op.output_arg_names())
+                continue
+            for n in op.input_arg_names():
+                if n not in defined:
+                    raise ValueError(
+                        "graph_check_pass: op %r reads %r which no "
+                        "earlier op produces and which is not "
+                        "persistable/fed" % (op.op_type(), n))
+            defined.update(op.output_arg_names())
+        return graph
+
+
+@PassRegistry.register
+class MemoryEstimationPass(Pass):
+    """Liveness-based memory diagnostic (reference
+    ir/memory_optimize_pass/*: the reference REWRITES the graph for
+    buffer reuse; under XLA, buffer assignment is the compiler's job,
+    so this pass only DIAGNOSES — per-var live ranges, peak concurrent
+    bytes, and reuse opportunities — for memory debugging parity with
+    memory_usage_calc.py + the inplace pass reports)."""
+
+    name = "memory_estimation_pass"
+
+    def __init__(self, batch_size=1):
+        self.batch_size = batch_size
+        self.report = None
+
+    def _nbytes(self, v) -> int:
+        import numpy as np
+
+        if v.shape is None:
+            return 0
+        n = 1
+        for d in v.shape:
+            n *= self.batch_size if d in (-1, None) else int(d)
+        return int(n) * np.dtype(str(v.dtype)).itemsize
+
+    def apply(self, graph: IrGraph) -> IrGraph:
+        ops = graph.all_op_nodes()
+        first_def: Dict[str, int] = {}
+        last_use: Dict[str, int] = {}
+        for i, op in enumerate(ops):
+            for n in op.output_arg_names():
+                first_def.setdefault(n, i)
+                last_use[n] = i
+            for n in op.input_arg_names():
+                last_use[n] = i
+        persistable_bytes = 0
+        events = []  # (step, +bytes/-bytes)
+        var_bytes = {}
+        for name in set(first_def) | set(last_use):
+            if not graph.has_var_node(name):
+                continue
+            v = graph.var_node(name)
+            b = self._nbytes(v)
+            var_bytes[name] = b
+            if v.persistable:
+                persistable_bytes += b
+                continue
+            start = first_def.get(name, 0)
+            end = last_use.get(name, start)
+            events.append((start, b))
+            events.append((end + 1, -b))
+        peak = cur = 0
+        for _, delta in sorted(events, key=lambda e: (e[0], -e[1])):
+            cur += delta
+            peak = max(peak, cur)
+        self.report = {
+            "persistable_bytes": persistable_bytes,
+            "peak_activation_bytes": peak,
+            "n_vars": len(var_bytes),
+            "live_ranges": {n: (first_def.get(n, 0), last_use.get(n, 0))
+                            for n in var_bytes},
+        }
+        return graph
+
+
 def apply_pass(program, pass_name: str, **kwargs):
     """Convenience: program -> pass -> program."""
     cls = PassRegistry._passes[pass_name]
     p = cls(**kwargs) if kwargs else cls()
     return p.apply(IrGraph(program)).to_program()
+
+
+def apply_passes(program, pass_names, **common_kwargs):
+    """Pass-pipeline runner (reference PassBuilder / ir_pass_manager):
+    threads one IrGraph through the named passes, then materializes."""
+    graph = IrGraph(program)
+    applied = []
+    for name in pass_names:
+        cls = PassRegistry._passes[name]
+        import inspect as _inspect
+
+        sig = _inspect.signature(cls.__init__)
+        kw = {k: v for k, v in common_kwargs.items()
+              if k in sig.parameters}
+        p = cls(**kw)
+        graph = p.apply(graph)
+        applied.append(p)
+    prog = graph.to_program()
+    return prog, applied

@@ -22,6 +22,80 @@ import numpy as np
 __all__ = ["DataLoader", "PyReader"]
 
 
+# ---------------------------------------------------------------------------
+# SIGCHLD-safe worker supervision (reference imperative/data_loader.cc:
+# _set_SIGCHLD_handler + CleanupKillPythonSubprocess). A registered
+# worker dying with a nonzero exit raises PROMPTLY in the main process
+# (the poll loop is only the fallback), and stragglers are terminated
+# at interpreter exit.
+# ---------------------------------------------------------------------------
+
+_active_workers: set = set()
+_sigchld_installed = False
+
+
+def _register_worker(proc):
+    _active_workers.add(proc)
+    _install_sigchld_handler()
+
+
+def _unregister_worker(proc):
+    _active_workers.discard(proc)
+
+
+def _install_sigchld_handler():
+    global _sigchld_installed
+    if _sigchld_installed:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        return  # signal API is main-thread-only; poll fallback covers us
+    try:
+        import signal
+
+        prev = signal.getsignal(signal.SIGCHLD)
+
+        def handler(signum, frame):
+            failed = []
+            for p in list(_active_workers):
+                code = p.exitcode
+                if code is None:
+                    continue  # still running (some OTHER child exited)
+                _active_workers.discard(p)
+                if code != 0:
+                    failed.append((p.pid, code))
+            if callable(prev):
+                try:
+                    prev(signum, frame)
+                except Exception:
+                    pass
+            if failed:
+                raise RuntimeError(
+                    "DataLoader worker process(es) died unexpectedly: "
+                    + ", ".join("pid %s exit %s" % f for f in failed)
+                    + ". A worker was killed (OOM?) or crashed hard; "
+                    "check the generator for native crashes.")
+
+        signal.signal(signal.SIGCHLD, handler)
+        _sigchld_installed = True
+    except (ValueError, OSError, AttributeError):
+        pass  # unsupported platform / nested interpreter
+
+
+def _cleanup_workers_at_exit():
+    for p in list(_active_workers):
+        _active_workers.discard(p)
+        try:
+            if p.is_alive():
+                p.terminate()
+        except Exception:
+            pass
+
+
+import atexit  # noqa: E402
+
+atexit.register(_cleanup_workers_at_exit)
+
+
 class _GeneratorLoader:
     def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
                  iterable=True, return_list=False, use_multiprocess=False):
@@ -116,6 +190,7 @@ class _GeneratorLoader:
 
         proc = ctx.Process(target=producer, args=(q, reader), daemon=True)
         proc.start()
+        _register_worker(proc)
         finished = False
         try:
             while True:
@@ -123,6 +198,9 @@ class _GeneratorLoader:
                     kind, payload = q.get(timeout=2.0)
                 except queue.Empty:
                     if not proc.is_alive():
+                        # poll fallback for non-main-thread consumers —
+                        # in the main thread the SIGCHLD handler raised
+                        # already
                         raise RuntimeError(
                             "DataLoader worker process died without "
                             "reporting (killed or crashed hard)")
@@ -136,6 +214,9 @@ class _GeneratorLoader:
                         "DataLoader worker process failed: %s" % payload)
                 yield payload
         finally:
+            # deregister BEFORE terminating: our own SIGTERM must not
+            # trip the SIGCHLD dead-worker alarm
+            _unregister_worker(proc)
             if finished:
                 proc.join(timeout=5)
             if proc.is_alive():

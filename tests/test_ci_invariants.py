@@ -1,0 +1,49 @@
+"""CI invariants wired into the suite (reference runs these as CI
+scripts: tools/check_op_register_type.py, tools/print_signatures.py +
+check_api_approvals.sh). ci/check.sh is the standalone entry point;
+these tests make the invariants part of every `pytest tests/` run."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_op_registry_parity_is_zero():
+    from paddle_tpu.tools.check_op_registry import parity_diff
+
+    diff = parity_diff()
+    if diff is None:
+        pytest.skip("reference tree not mounted")
+    assert diff["missing"] == [], (
+        "reference ops neither registered nor allowlisted: %s"
+        % diff["missing"])
+    assert diff["stale_allowlist"] == [], (
+        "allowlist entries now registered or gone from the reference: %s"
+        % diff["stale_allowlist"])
+
+
+def test_api_fingerprint_frozen():
+    """The committed fingerprint must match the live surface — an API
+    change requires a deliberate `ci/check.sh --update`."""
+    from paddle_tpu.tools.print_signatures import DEFAULT_MODULES, iter_api
+
+    live = []
+    for m in DEFAULT_MODULES:
+        live.extend(iter_api(m))
+    with open(os.path.join(REPO, "ci", "api_fingerprint.txt")) as f:
+        frozen = [l.rstrip("\n") for l in f if l.strip()]
+    live_set, frozen_set = set(live), set(frozen)
+    added = sorted(live_set - frozen_set)[:10]
+    removed = sorted(frozen_set - live_set)[:10]
+    assert live_set == frozen_set, (
+        "public API changed; run ci/check.sh --update if intentional. "
+        "added=%s removed=%s" % (added, removed))
+
+
+def test_ci_check_script_exists_and_parses():
+    path = os.path.join(REPO, "ci", "check.sh")
+    assert os.access(path, os.X_OK)
+    subprocess.run(["bash", "-n", path], check=True)

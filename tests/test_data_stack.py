@@ -362,3 +362,44 @@ class TestLoaderErrorPropagation:
         loader.set_batch_generator(gen)
         with pytest.raises(RuntimeError, match="reader exploded"):
             list(loader)
+
+    def test_mp_worker_hard_crash_raises(self):
+        """A worker that dies without reporting (os._exit — simulating
+        OOM-kill / native crash) must raise a clear error, not hang
+        (reference imperative/data_loader.cc SIGCHLD handling)."""
+        import os as _os
+        import time as _time
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[2, 2], dtype="float32")
+        loader = fluid.DataLoader.from_generator(
+            feed_list=[x], capacity=2, use_multiprocess=True)
+
+        def gen():
+            yield [np.zeros((2, 2), "float32")]
+            _os._exit(3)  # hard death: no exception ships
+
+        loader.set_batch_generator(gen)
+        t0 = _time.time()
+        with pytest.raises(RuntimeError,
+                           match="died|unexpectedly|crashed"):
+            list(loader)
+        assert _time.time() - t0 < 30
+
+    def test_mp_worker_normal_end_no_alarm(self):
+        """Clean worker exits must NOT trip the SIGCHLD alarm."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[2, 2], dtype="float32")
+        loader = fluid.DataLoader.from_generator(
+            feed_list=[x], capacity=2, use_multiprocess=True)
+
+        def gen():
+            for _ in range(3):
+                yield [np.ones((2, 2), "float32")]
+
+        loader.set_batch_generator(gen)
+        assert len(list(loader)) == 3
+        # and a second epoch still works (handler stays healthy)
+        assert len(list(loader)) == 3

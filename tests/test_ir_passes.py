@@ -1,0 +1,154 @@
+"""IR pass-infrastructure tests: GraphPatternDetector, conv+BN fold,
+graph checker, memory diagnostics.
+
+Parity: /root/reference/paddle/fluid/framework/ir/
+graph_pattern_detector.h (+ its *_tester.cc files),
+conv_bn_fuse_pass.cc, multi_devices_graph_check_pass,
+memory_optimize_pass/ (diagnostic analog — XLA owns actual reuse).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.ir import (GraphPatternDetector, IrGraph, PassRegistry,
+                           apply_pass)
+
+
+def _conv_bn_program():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+        conv = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                   padding=1, bias_attr=False)
+        out = fluid.layers.batch_norm(conv, is_test=True)
+        loss = fluid.layers.reduce_mean(out)
+    return prog, startup, out.name
+
+
+class TestGraphPatternDetector:
+    def test_detects_conv_bn(self):
+        prog, _, _ = _conv_bn_program()
+        g = IrGraph(prog)
+        d = GraphPatternDetector()
+        d.op_node("conv", "conv2d")
+        d.op_node("bn", "batch_norm")
+        d.edge_out("conv", "Output", "conv_out")
+        d.edge_in("bn", "X", "conv_out")
+        matches = list(d.detect(g))
+        assert len(matches) == 1
+        assert matches[0]["conv"].op_type() == "conv2d"
+        assert matches[0]["bn"].op_type() == "batch_norm"
+        assert isinstance(matches[0]["conv_out"], str)
+
+    def test_no_match_when_edge_broken(self):
+        prog, _, _ = _conv_bn_program()
+        g = IrGraph(prog)
+        d = GraphPatternDetector()
+        d.op_node("conv", "conv2d")
+        d.op_node("mean", "reduce_mean")
+        # reduce_mean reads the BN output, not the conv output
+        d.edge_out("conv", "Output", "v")
+        d.edge_in("mean", "X", "v")
+        assert list(d.detect(g)) == []
+
+    def test_predicate_filters(self):
+        prog, _, _ = _conv_bn_program()
+        g = IrGraph(prog)
+        d = GraphPatternDetector()
+        d.op_node("bn", "batch_norm",
+                  predicate=lambda op: not op.attr("is_test"))
+        assert list(d.detect(g)) == []
+
+
+class TestConvBnFuse:
+    def test_fold_matches_unfused_outputs(self):
+        prog, startup, out_name = _conv_bn_program()
+        place = fluid.TPUPlace(0)
+        exe = fluid.Executor(place)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            # non-trivial BN statistics so the fold is actually tested
+            rng = np.random.RandomState(7)
+            for v in prog.global_block().vars.values():
+                if not v.persistable:
+                    continue
+                t = scope.find_var(v.name).get_tensor()
+                arr = np.asarray(t.array)
+                if "mean" in v.name or "variance" in v.name or \
+                        "batch_norm" in v.name:
+                    newv = rng.uniform(0.5, 1.5, arr.shape).astype(
+                        arr.dtype)
+                    import jax.numpy as jnp
+
+                    t._array = jnp.asarray(newv)
+            x = rng.randn(2, 3, 8, 8).astype(np.float32)
+            ref = exe.run(prog, feed={"x": x}, fetch_list=[out_name])[0]
+
+            infer = prog.clone(for_test=True)
+            graph = IrGraph(infer)
+            p = PassRegistry._passes["conv_bn_fuse_pass"](scope=scope)
+            graph = p.apply(graph)
+            fused_prog = graph.to_program()
+            types = [op.type for op in fused_prog.global_block().ops]
+            assert "batch_norm" not in types, types
+            import jax.numpy as jnp
+
+            for name, val in graph.startup_inits:
+                scope.var(name).get_tensor()._array = jnp.asarray(val)
+            out = exe.run(fused_prog, feed={"x": x},
+                          fetch_list=[out_name])[0]
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=2e-4, atol=2e-5)
+
+
+class TestConvBnFuseSharedFilter:
+    def test_shared_filter_not_folded(self):
+        """A filter read by two convs must not be folded in place —
+        the scope rewrite would corrupt the other consumer."""
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+            w = fluid.ParamAttr(name="shared_w")
+            c1 = fluid.layers.conv2d(x, 4, 3, padding=1, param_attr=w,
+                                     bias_attr=False)
+            c2 = fluid.layers.conv2d(x, 4, 3, padding=1, param_attr=w,
+                                     bias_attr=False)
+            b1 = fluid.layers.batch_norm(c1, is_test=True)
+            b2 = fluid.layers.batch_norm(c2, is_test=True)
+            fluid.layers.reduce_mean(b1 + b2)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            g = IrGraph(prog.clone(for_test=True))
+            p = PassRegistry._passes["conv_bn_fuse_pass"](scope=scope)
+            g = p.apply(g)
+        types = [op.op_type() for op in g.all_op_nodes()]
+        assert types.count("batch_norm") == 2  # untouched
+
+
+class TestDiagnosticPasses:
+    def test_graph_check_pass_ok(self):
+        prog, _, _ = _conv_bn_program()
+        apply_pass(prog, "graph_check_pass")
+
+    def test_graph_check_pass_catches_undefined_read(self):
+        prog, _, _ = _conv_bn_program()
+        g = IrGraph(prog)
+        g.create_op_node("relu", {}, {"X": ["no_such_var"]},
+                         {"Out": ["dangling"]})
+        import pytest
+
+        with pytest.raises(ValueError, match="no_such_var"):
+            PassRegistry._passes["graph_check_pass"]().apply(g)
+
+    def test_memory_estimation_report(self):
+        prog, _, _ = _conv_bn_program()
+        p = PassRegistry._passes["memory_estimation_pass"](batch_size=8)
+        p.apply(IrGraph(prog))
+        rep = p.report
+        assert rep["peak_activation_bytes"] > 0
+        assert rep["persistable_bytes"] > 0
+        assert rep["n_vars"] > 3
