@@ -117,11 +117,15 @@ def _merge_branch_outputs(pred, t_out, f_out):
             if t == f:
                 merged.append(t)
                 continue
-            raise Dy2StaticError(
-                "dygraph_to_static: a tensor-condition `if` assigns "
-                "non-tensor values that differ between branches "
-                "(%r vs %r); graph control flow can only carry tensors"
-                % (t, f))
+            scalar = (bool, int, float)
+            if not (isinstance(t, scalar) and isinstance(f, scalar)):
+                raise Dy2StaticError(
+                    "dygraph_to_static: a tensor-condition `if` assigns "
+                    "non-tensor values that differ between branches "
+                    "(%r vs %r); graph control flow can only carry "
+                    "tensors and numeric scalars" % (t, f))
+            # differing scalars (e.g. break/continue guard flags):
+            # promote both and select
         t, f = _promote_scalar_pair(t, f)
         out = helper.create_variable_for_type_inference(t.dtype)
         helper.append_op("where",
@@ -150,7 +154,26 @@ def _promote_scalar(v, like=None):
 
 
 def _promote_scalar_pair(t, f):
-    return _promote_scalar(t), _promote_scalar(f)
+    """Promote a branch pair to a COMMON dtype (True vs 0 must not
+    become bool-vs-int64 `where` operands)."""
+    from ..layers import tensor as ltensor
+
+    def fill(v, dt):
+        return ltensor.fill_constant([1], dt, float(v))
+
+    if _is_variable(t) and _is_variable(f):
+        return t, f
+    if _is_variable(t):
+        return t, fill(f, str(t.dtype))
+    if _is_variable(f):
+        return fill(t, str(f.dtype)), f
+    if isinstance(t, float) or isinstance(f, float):
+        dt = "float32"
+    elif isinstance(t, bool) and isinstance(f, bool):
+        dt = "bool"
+    else:
+        dt = "int64"
+    return fill(t, dt), fill(f, dt)
 
 
 def convert_while(cond_fn, body_fn, loop_vars):
@@ -368,6 +391,119 @@ def _read(nodes) -> Set[str]:
     return w.names
 
 
+class _OwnLoopFlow(_ScopedWalker):
+    """Scan a loop body's OWN scope: break/continue not inside nested
+    loops; return at any statement depth (it escapes the loop either
+    way); 'clean' is False when a break/continue hides under a
+    non-If compound (try/with) the guard rewriter can't wrap."""
+
+    def __init__(self):
+        self.has_break = False
+        self.has_continue = False
+        self.has_return = False
+        self.clean = True
+        self._if_depth_only = True
+
+    def visit_Return(self, node):
+        self.has_return = True
+
+    def visit_Break(self, node):
+        self.has_break = True
+        if not self._if_depth_only:
+            self.clean = False
+
+    def visit_Continue(self, node):
+        self.has_continue = True
+        if not self._if_depth_only:
+            self.clean = False
+
+    def visit_If(self, node):
+        for s in node.body + node.orelse:
+            self.visit(s)
+
+    def _compound(self, node):
+        prev = self._if_depth_only
+        self._if_depth_only = False
+        self.generic_visit(node)
+        self._if_depth_only = prev
+
+    visit_With = _compound
+    visit_Try = _compound
+
+    def visit_While(self, node):
+        # nested loop BODY: its own break/continue scope — but a
+        # return inside it still escapes THIS loop (skip nested
+        # functions). The nested loop's else: clause is DIFFERENT:
+        # Python binds break/continue there to the OUTER loop — the
+        # guard rewriter can't wrap those, so they mark us not-clean.
+        stack = list(node.body)
+        while stack:
+            s = stack.pop()
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(s, ast.Return):
+                self.has_return = True
+            stack.extend(ast.iter_child_nodes(s))
+        prev = self._if_depth_only
+        self._if_depth_only = False  # break in else: -> clean = False
+        for s in node.orelse:
+            self.visit(s)
+        self._if_depth_only = prev
+
+    visit_For = visit_While
+
+
+def _scan_own_loop_flow(stmts) -> "_OwnLoopFlow":
+    w = _OwnLoopFlow()
+    for s in stmts:
+        w.visit(s)
+    return w
+
+
+def _flag_assign(name, value: bool):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _rewrite_break_continue(stmts, brk, cont, guard_flags):
+    """Replace break/continue with guard-flag sets and wrap statement
+    suffixes in `if not (flags):` (reference
+    break_continue_transformer.py). Returns (new_stmts, may_set)."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_flag_assign(brk, True))
+            return out, True  # anything after a bare break is dead
+        if isinstance(s, ast.Continue):
+            out.append(_flag_assign(cont, True))
+            return out, True
+        if isinstance(s, ast.If):
+            body, hit_b = _rewrite_break_continue(
+                s.body, brk, cont, guard_flags)
+            orelse, hit_o = _rewrite_break_continue(
+                s.orelse, brk, cont, guard_flags)
+            s = ast.If(test=s.test, body=body,
+                       orelse=orelse or [])
+            out.append(s)
+            if hit_b or hit_o:
+                rest, _ = _rewrite_break_continue(
+                    stmts[idx + 1:], brk, cont, guard_flags)
+                if rest:
+                    # guard: not flag1 and not flag2 ...
+                    test = None
+                    for fl in guard_flags:
+                        term = ast.UnaryOp(op=ast.Not(),
+                                           operand=_name(fl))
+                        test = term if test is None else ast.BoolOp(
+                            op=ast.And(), values=[test, term])
+                    out.append(ast.If(test=test, body=rest, orelse=[]))
+                return out, True
+            continue
+        out.append(s)
+    return out, False
+
+
 def _has_flow_escape(stmts) -> bool:
     """return/break/continue directly in this statement list (not in
     nested loops for break/continue, not in nested functions)."""
@@ -534,9 +670,41 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     # -- while ------------------------------------------------------------
 
     def visit_While(self, node):
-        self.generic_visit(node)
-        if node.orelse or _has_flow_escape(node.body):
+        if node.orelse:
+            self.generic_visit(node)
             return node
+        flow = _scan_own_loop_flow(node.body)
+        pre = []
+        if flow.has_break or flow.has_continue:
+            if flow.has_return or not flow.clean:
+                # return-in-loop (or break under try/with) stays a
+                # Python loop — tensor conditions get the
+                # Variable.__bool__ guidance error
+                self.generic_visit(node)
+                return node
+            fuid = self._uid()
+            brk = "_loopflag_brk_%d" % fuid      # NOT _jst_: must carry
+            cont = "_loopflag_cont_%d" % fuid
+            flags = ([brk] if flow.has_break else []) + \
+                ([cont] if flow.has_continue else [])
+            body, _ = _rewrite_break_continue(node.body, brk, cont,
+                                              flags)
+            if flow.has_continue:
+                # continue only skips the REST of the iteration
+                body = [_flag_assign(cont, False)] + body
+            if flow.has_break:
+                node.test = ast.BoolOp(
+                    op=ast.And(),
+                    values=[ast.UnaryOp(op=ast.Not(),
+                                        operand=_name(brk)),
+                            node.test])
+                pre.append(_flag_assign(brk, False))
+            if flow.has_continue:
+                pre.append(_flag_assign(cont, False))
+            node = ast.While(test=node.test, body=body, orelse=[])
+        self.generic_visit(node)
+        if _has_flow_escape(node.body):
+            return pre + [node] if pre else node
         uid = self._uid()
         # synthetic _jst_* temporaries (from nested transformed ifs)
         # are recomputed every iteration — never loop-carried
@@ -572,7 +740,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                       ast.Tuple(elts=[_name(v) for v in loop_vars],
                                 ctx=ast.Load())],
                 keywords=[])))
-        return stmts
+        return pre + stmts
 
     # -- for range --------------------------------------------------------
 

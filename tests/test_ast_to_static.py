@@ -141,6 +141,79 @@ class TestTensorWhile:
         out = g(_f32([1.0]))
         assert np.allclose(out.numpy(), 10.0)
 
+    def test_break_in_tensor_while(self):
+        @declarative
+        def f(x):
+            s = x
+            while fluid.layers.reduce_sum(s) < 100.0:
+                s = s * 2.0
+                if fluid.layers.reduce_sum(s) > 50.0:
+                    break
+                s = s + 1.0
+            return s
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "error", message=".*falls back to op-by-op.*")
+            out = f(_f32(np.full((4,), 1.0)))
+        # python: 1->2(+1)3 ->6(+1)7 ->14(sum56>50) break
+        assert np.allclose(out.numpy(), 14.0), out.numpy()
+        prog = f.get_program(_f32(np.full((4,), 1.0)))
+        assert "while" in [op.type for op in prog.global_block().ops]
+
+    def test_continue_in_tensor_while(self):
+        def pyref():
+            acc = 0.0
+            while acc < 10.0:
+                acc += 1.0
+                if acc > 5.0:
+                    continue
+                acc += 1.0
+            return acc
+
+        @declarative
+        def g(x):
+            acc = x * 0.0
+            while fluid.layers.reduce_sum(acc) < 10.0:
+                acc = acc + 1.0
+                if fluid.layers.reduce_sum(acc) > 5.0:
+                    continue
+                acc = acc + 1.0
+            return acc
+
+        out = g(_f32([0.0]))
+        assert np.allclose(out.numpy(), pyref()), (out.numpy(), pyref())
+
+    def test_break_python_mode_exact(self):
+        @declarative
+        def f(x, n):
+            i = 0
+            while i < n:
+                if i == 3:
+                    break
+                x = x + 1.0
+                i += 1
+            return x
+
+        assert np.allclose(f(_f32([0.0]), 10).numpy(), 3.0)
+
+    def test_break_in_nested_for_else_binds_to_outer(self):
+        """`break` in a nested for's else: clause belongs to the OUTER
+        loop (Python semantics) — the converter must keep the Python
+        loop, not emit a break outside any loop."""
+        @declarative
+        def f(x, n):
+            i = 0
+            while i < n:
+                for j in range(3):
+                    x = x + 1.0
+                else:
+                    break
+            return x
+
+        out = f(_f32([0.0, 0.0]), 5)
+        assert np.allclose(out.numpy(), 3.0)
+
     def test_python_while_unchanged(self):
         @declarative
         def g(x, n):
