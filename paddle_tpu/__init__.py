@@ -38,6 +38,7 @@ from .core import enforce  # noqa: F401
 from .core.flags import get_flags, set_flags  # noqa: F401
 from .executor import Executor  # noqa: F401
 from .async_executor import AsyncExecutor, DataFeedDesc  # noqa: F401
+from . import trainer_factory  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from . import initializer  # noqa: F401
 from . import layers  # noqa: F401

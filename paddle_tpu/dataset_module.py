@@ -65,14 +65,16 @@ class DatasetBase:
         return [tuple(int(s) for s in (v.shape or ())[1:])
                 for v in self._use_vars]
 
-    def _record_batches(self, filelist):
+    def _record_batches(self, filelist, num_threads=None):
         """Yield feed dicts batch by batch via the native pipeline."""
         types = self._slot_types()
+        if num_threads is None:
+            num_threads = self._thread_num
         try:
             from .core.native_feed import NativeMultiSlotFeed
 
             feed = NativeMultiSlotFeed(filelist, types, self._batch_size,
-                                       num_threads=self._thread_num)
+                                       num_threads=max(1, num_threads))
             native = True
         except Exception:
             feed = _python_multislot_feed(filelist, types, self._batch_size)
@@ -101,6 +103,22 @@ class DatasetBase:
 
     def _iter_batches(self):
         yield from self._record_batches(self._filelist)
+
+    def _iter_batches_sharded(self, num_workers):
+        """Per-worker batch iterators over disjoint FILE shards
+        (reference MultiTrainer assigns dataset readers to device
+        workers; data_set.cc distributes the filelist). Returns <=
+        num_workers iterators — never an empty shard."""
+        files = list(self._filelist)
+        shards = [files[i::num_workers] for i in range(num_workers)]
+        shards = [s for s in shards if s]
+        if not shards:
+            return [self._iter_batches()]
+        # split the configured parse-thread budget across shards —
+        # NOT thread_num per shard (quadratic thread blowup)
+        per = max(1, (self._thread_num or 1) // len(shards))
+        return [self._record_batches(s, num_threads=per)
+                for s in shards]
 
 
 def _python_multislot_feed(filelist, types, batch_size):
@@ -216,10 +234,25 @@ class InMemoryDataset(DatasetBase):
         if self._records is None:
             yield from super()._iter_batches()
             return
+        yield from self._batches_from_records(self._records)
+
+    def _iter_batches_sharded(self, num_workers):
+        """In-memory records shard round-robin across workers (the
+        file-based path shards the filelist instead)."""
+        if self._records is None:
+            return super()._iter_batches_sharded(num_workers)
+        shards = [self._records[i::num_workers]
+                  for i in range(num_workers)]
+        shards = [s for s in shards if len(s) >= self._batch_size]
+        if not shards:
+            return [self._iter_batches()]
+        return [self._batches_from_records(s) for s in shards]
+
+    def _batches_from_records(self, records):
         from .core.tensor import LoDTensor
 
-        for i in range(0, len(self._records), self._batch_size):
-            chunk = self._records[i:i + self._batch_size]
+        for i in range(0, len(records), self._batch_size):
+            chunk = records[i:i + self._batch_size]
             if len(chunk) < self._batch_size:
                 break  # drop remainder (static shapes)
             merged = {}

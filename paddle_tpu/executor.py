@@ -200,12 +200,32 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100):
+        """Dataset-driven training through the trainer/device-worker
+        stack (reference executor.py:1187 -> _prepare_trainer :1013 ->
+        TrainerFactory): N Hogwild workers over disjoint dataset
+        shards, shared scope, shared compiled step. Worker class and
+        debug dumps come from ``program._fleet_opt`` like the
+        reference's opt_info plumbing."""
+        from .trainer_factory import TrainerDesc, TrainerFactory
+
         scope = scope or global_scope()
         program = program or framework.default_main_program()
         if dataset is None:
             raise ValueError("dataset is required")
-        for batch in dataset._iter_batches():
-            self.run(program, feed=batch, fetch_list=fetch_list, scope=scope)
+        desc = TrainerDesc()
+        desc.thread_num = int(thread) or getattr(dataset, "_thread_num",
+                                                 0) or 1
+        desc.fetch_vars = fetch_list or []
+        desc.fetch_info = fetch_info or []
+        desc.print_period = print_period
+        desc.debug = debug
+        fleet_opt = getattr(program, "_fleet_opt", None) or {}
+        desc.device_worker = fleet_opt.get("worker_class", "Hogwild")
+        desc.dump_fields = list(fleet_opt.get("dump_fields", []))
+        desc.dump_fields_path = fleet_opt.get("dump_fields_path", "")
+        desc.dump_param = list(fleet_opt.get("dump_param", []))
+        trainer = TrainerFactory().create_trainer(desc)
+        return trainer.run(program, dataset, scope, self)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

@@ -1,0 +1,190 @@
+"""Trainer / DeviceWorker stack driving Dataset-based training.
+
+Parity: /root/reference/paddle/fluid/framework/trainer.h:38 (TrainerBase/
+MultiTrainer), device_worker.h:111 (DeviceWorker/HogwildWorker/
+DownpourWorker), trainer_desc.proto:21 (TrainerDesc, dump fields :39-45)
+and python executor.py:1013 (_prepare_trainer -> TrainerFactory).
+
+TPU-native stance: the reference spawns one C++ thread per device, each
+running the op loop over its DataFeed shard. Here the hot loop is ONE
+compiled XLA program per step, so worker threads buy host-side overlap
+(file parse, LoD assembly, and feed staging happen while the chip runs
+a step), not kernel parallelism — the chip serializes step execution
+anyway. Workers share the scope, and step DISPATCH runs under a
+trainer mutex: the compiled step donates its parameter buffers
+(in-place updates, compiler_engine), so two in-flight steps over the
+same state would hand XLA a deleted buffer; and the op-by-op
+interpreter materializes intermediates in the scope, where cross-
+thread clobbering corrupts results. Dispatch is async — the mutex
+covers enqueue + scope write-back, not device time — so the overlap
+the reference's threads buy (IO behind compute) is preserved. The
+upshot vs Hogwild: updates are sequentially consistent instead of
+lock-free-racy, which on one chip is strictly better.
+
+DownpourWorker note: the reference worker pulls/pushes sparse tables
+around the op loop via pslib. Here sparse-table traffic is expressed IN
+the program (`distributed_lookup_table` / `distributed_push_sparse` ops
+over ps_rpc — see ops/distributed_ops.py), so the Downpour worker is
+the same step loop; the RPC rides the program.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["TrainerDesc", "TrainerFactory", "MultiTrainer",
+           "HogwildWorker", "DownpourWorker"]
+
+
+class TrainerDesc:
+    """Mirror of trainer_desc.proto:21 (the fields this runtime uses)."""
+
+    def __init__(self):
+        self.class_name = "MultiTrainer"
+        self.device_worker = "Hogwild"
+        self.thread_num = 1
+        self.fetch_vars: List = []
+        self.fetch_info: List[str] = []
+        self.print_period = 100
+        self.debug = False
+        # trainer_desc.proto:39-45 debug dumps
+        self.dump_fields: List[str] = []
+        self.dump_fields_path: str = ""
+        self.dump_param: List[str] = []
+
+
+class HogwildWorker:
+    """device_worker.h:163 HogwildWorker::TrainFiles — one worker's
+    step loop over its dataset shard."""
+
+    def __init__(self, worker_id, desc: TrainerDesc, trainer):
+        self.worker_id = worker_id
+        self.desc = desc
+        self.trainer = trainer
+        self.steps = 0
+
+    def _dump(self, fh, step, scope, names):
+        for n in names:
+            var = scope.find_var(n)
+            if var is None or not var.is_initialized():
+                continue
+            arr = np.asarray(var.get_tensor().array).reshape(-1)
+            head = " ".join("%.6g" % v for v in arr[:16])
+            fh.write("%d\t%s\t%s%s\n"
+                     % (step, n, head, " ..." if arr.size > 16 else ""))
+
+    def train_files(self, program, batches, scope, executor):
+        desc = self.desc
+        fetch_names = [getattr(v, "name", v) for v in desc.fetch_vars]
+        dump_fh = None
+        if desc.dump_fields and desc.dump_fields_path:
+            os.makedirs(desc.dump_fields_path, exist_ok=True)
+            dump_fh = open(os.path.join(
+                desc.dump_fields_path,
+                "worker_%d.txt" % self.worker_id), "w")
+        try:
+            for batch in batches:
+                with self.trainer.step_guard(program):
+                    vals = executor.run(program, feed=batch,
+                                        fetch_list=fetch_names or None,
+                                        scope=scope)
+                self.steps += 1
+                if fetch_names and \
+                        self.steps % desc.print_period == 0:
+                    infos = desc.fetch_info or fetch_names
+                    msg = ", ".join(
+                        "%s=%s" % (i, np.asarray(v).reshape(-1)[:4])
+                        for i, v in zip(infos, vals or []))
+                    print("[worker %d step %d] %s"
+                          % (self.worker_id, self.steps, msg))
+                if dump_fh is not None:
+                    self._dump(dump_fh, self.steps, scope,
+                               desc.dump_fields + desc.dump_param)
+        finally:
+            if dump_fh is not None:
+                dump_fh.close()
+
+
+class DownpourWorker(HogwildWorker):
+    """device_worker.h:203 — sparse pull/push ride the program's
+    distributed_lookup_table / push ops (see module docstring)."""
+
+
+_WORKERS = {"Hogwild": HogwildWorker, "Downpour": DownpourWorker}
+
+
+class MultiTrainer:
+    """trainer.h:64 / multi_trainer.cc:157 — thread-per-worker over
+    dataset shards sharing one scope."""
+
+    def __init__(self, desc: TrainerDesc):
+        self.desc = desc
+        self.workers: List[HogwildWorker] = []
+        self._step_lock = threading.Lock()
+
+    def step_guard(self, program):
+        """Step-dispatch mutex — see module docstring for why shared
+        donated state forbids concurrent dispatch."""
+        return self._step_lock
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, program, dataset, scope, executor):
+        desc = self.desc
+        n = max(1, int(desc.thread_num))
+        worker_cls = _WORKERS.get(desc.device_worker, HogwildWorker)
+        shards = dataset._iter_batches_sharded(n)
+        n = len(shards)  # dataset may cap (fewer files than threads)
+        self.workers = [worker_cls(i, desc, self) for i in range(n)]
+
+        # first step on worker 0's shard before the fan-out: compiles
+        # the program once so workers share the warm jit cache
+        first_iters = [iter(s) for s in shards]
+        try:
+            first_batch = next(first_iters[0])
+        except StopIteration:
+            first_batch = None
+        if first_batch is not None:
+            self.workers[0].train_files(
+                program, [first_batch], scope, executor)
+
+        if n == 1:
+            self.workers[0].train_files(program, first_iters[0], scope,
+                                        executor)
+            return self.stats()
+
+        errors: List[BaseException] = []
+
+        def body(w, batches):
+            try:
+                w.train_files(program, batches, scope, executor)
+            except BaseException as e:  # propagate to the caller
+                errors.append(e)
+
+        threads = [threading.Thread(target=body, args=(w, it),
+                                    daemon=True)
+                   for w, it in zip(self.workers, first_iters)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return self.stats()
+
+    def stats(self):
+        return {"steps_per_worker": [w.steps for w in self.workers],
+                "total_steps": sum(w.steps for w in self.workers)}
+
+
+class TrainerFactory:
+    """trainer_factory.cc — TrainerDesc -> trainer instance."""
+
+    def create_trainer(self, desc: Optional[TrainerDesc] = None):
+        desc = desc or TrainerDesc()
+        if desc.class_name not in ("MultiTrainer", "DistMultiTrainer"):
+            raise ValueError("unknown trainer class %r" % desc.class_name)
+        return MultiTrainer(desc)
