@@ -24,8 +24,10 @@ echo "== gate 1: op-registry parity (diff must be 0 vs allowlist) =="
 python -m paddle_tpu.tools.check_op_registry --parity
 
 echo "== gate 2: public API signature freeze =="
-python -m paddle_tpu.tools.print_signatures > /tmp/_api_fingerprint.txt
-if ! diff -u ci/api_fingerprint.txt /tmp/_api_fingerprint.txt; then
+FP_TMP="$(mktemp)"
+trap 'rm -f "$FP_TMP"' EXIT
+python -m paddle_tpu.tools.print_signatures > "$FP_TMP"
+if ! diff -u ci/api_fingerprint.txt "$FP_TMP"; then
     echo "API surface changed. If intentional: ci/check.sh --update" >&2
     exit 1
 fi

@@ -118,9 +118,79 @@ class PaddlePredictor:
         return [PaddleTensor(np.asarray(o), name=v.name)
                 for o, v in zip(outs, self._fetch_vars)]
 
+    # -- zero-copy surface (reference analysis_predictor.cc
+    # GetInputTensor/GetOutputTensor/ZeroCopyRun; this is the API the
+    # R reticulate client r/example/*.r drives) --------------------------
+
+    def get_input_tensor(self, name) -> "ZeroCopyTensor":
+        if name not in self._feed_names:
+            raise KeyError("no input named %r (have %s)"
+                           % (name, self._feed_names))
+        return ZeroCopyTensor(self, name, is_input=True)
+
+    def get_output_tensor(self, name) -> "ZeroCopyTensor":
+        if name not in self.get_output_names():
+            raise KeyError("no output named %r (have %s)"
+                           % (name, self.get_output_names()))
+        return ZeroCopyTensor(self, name, is_input=False)
+
+    def zero_copy_run(self):
+        missing = [n for n in self._feed_names
+                   if n not in getattr(self, "_staged", {})]
+        if missing:
+            raise RuntimeError("inputs not staged via copy_from_cpu: %s"
+                              % missing)
+        outs = self.run({n: self._staged[n] for n in self._feed_names})
+        self._results = {t.name: t.data for t in outs}
+
     # 2.0-style aliases
     def get_input_handle(self, name):
-        raise NotImplementedError("use run() with a feed dict")
+        return self.get_input_tensor(name)
+
+    def get_output_handle(self, name):
+        return self.get_output_tensor(name)
+
+
+class ZeroCopyTensor:
+    """Staged input / materialized output handle (reference
+    paddle_api.h ZeroCopyTensor). 'Zero-copy' is the reference's name
+    for bypassing the feed/fetch ops; here staging IS the device
+    transfer jax performs at dispatch."""
+
+    def __init__(self, predictor, name, is_input):
+        self._p = predictor
+        self.name = name
+        self._is_input = is_input
+        self._shape = None
+
+    def reshape(self, shape):
+        self._shape = tuple(int(s) for s in shape)
+
+    def copy_from_cpu(self, arr):
+        if not self._is_input:
+            raise RuntimeError("%r is an output tensor" % self.name)
+        arr = np.asarray(arr)
+        if self._shape is not None:
+            arr = arr.reshape(self._shape)
+        if not hasattr(self._p, "_staged"):
+            self._p._staged = {}
+        self._p._staged[self.name] = arr
+
+    def copy_to_cpu(self):
+        if self._is_input:
+            raise RuntimeError("%r is an input tensor" % self.name)
+        results = getattr(self._p, "_results", None)
+        if results is None or self.name not in results:
+            raise RuntimeError("call zero_copy_run() first")
+        return results[self.name]
+
+    def shape(self):
+        if self._is_input:
+            staged = getattr(self._p, "_staged", {})
+            if self.name in staged:
+                return list(staged[self.name].shape)
+            return list(self._shape or ())
+        return list(np.asarray(self.copy_to_cpu()).shape)
 
 
 Predictor = PaddlePredictor

@@ -1,0 +1,26 @@
+"""Save a tiny UCI-housing regression inference model for the R demo
+(counterpart of the reference's r/example/mobilenet.py model prep)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def main(out_dir="data/uci_housing_model"):
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data("x", shape=[13], dtype="float32")
+        y = fluid.layers.fc(x, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    os.makedirs(out_dir, exist_ok=True)
+    fluid.io.save_inference_model(out_dir, ["x"], [y], exe,
+                                  main_program=main_prog)
+    np.savetxt(os.path.join(out_dir, "data.txt"),
+               np.random.RandomState(0).rand(13).astype("float32"))
+    print("model + sample input saved under", out_dir)
+
+
+if __name__ == "__main__":
+    main()

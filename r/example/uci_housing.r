@@ -1,0 +1,42 @@
+#!/usr/bin/env Rscript
+# R inference client for paddle_tpu (counterpart of the reference's
+# r/example/mobilenet.r): drives the Python inference API through
+# reticulate with the zero-copy tensor surface.
+#
+# Usage:
+#   1. python r/example/uci_housing.py   # saves the model under data/
+#   2. Rscript r/example/uci_housing.r
+
+library(reticulate)
+
+np <- import("numpy")
+inference <- import("paddle_tpu.inference")
+
+set_config <- function() {
+    config <- inference$AnalysisConfig("")
+    config$set_model("data/uci_housing_model")
+    config$switch_use_feed_fetch_ops(FALSE)
+    config$switch_specify_input_names(TRUE)
+    return(config)
+}
+
+zero_copy_run_housing <- function() {
+    config <- set_config()
+    predictor <- inference$create_paddle_predictor(config)
+
+    input_names <- predictor$get_input_names()
+    input_tensor <- predictor$get_input_tensor(input_names[1])
+
+    data <- np$loadtxt("data/uci_housing_model/data.txt")
+    input_tensor$reshape(as.integer(c(1, 13)))
+    input_tensor$copy_from_cpu(np_array(data, dtype = "float32"))
+
+    predictor$zero_copy_run()
+
+    output_names <- predictor$get_output_names()
+    output_tensor <- predictor$get_output_tensor(output_names[1])
+    output_data <- output_tensor$copy_to_cpu()
+    print(np_array(output_data)$reshape(as.integer(-1)))
+}
+
+zero_copy_run_housing()

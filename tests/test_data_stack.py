@@ -256,6 +256,20 @@ class TestInferencePredictor:
             np.testing.assert_allclose(out2.as_ndarray(),
                                        out.as_ndarray(), rtol=1e-6)
 
+            # zero-copy surface — the EXACT call sequence the R
+            # reticulate client performs (r/example/uci_housing.r);
+            # this test pins that surface since CI has no R runtime
+            name = predictor.get_input_names()[0]
+            t_in = predictor.get_input_tensor(name)
+            t_in.reshape([B, 1, 28, 28])
+            t_in.copy_from_cpu(x.reshape(-1))
+            predictor.zero_copy_run()
+            t_out = predictor.get_output_tensor(
+                predictor.get_output_names()[0])
+            np.testing.assert_allclose(t_out.copy_to_cpu(),
+                                       out.as_ndarray(), rtol=1e-6)
+            assert t_out.shape() == list(out.as_ndarray().shape)
+
 
 class TestInstallCheck:
     def test_run_check_multi_device(self, capsys):
