@@ -39,6 +39,18 @@ from .core.flags import get_flags, set_flags  # noqa: F401
 from .executor import Executor  # noqa: F401
 from .async_executor import AsyncExecutor, DataFeedDesc  # noqa: F401
 from . import trainer_factory  # noqa: F401
+from . import nets  # noqa: F401
+from . import lod_tensor  # noqa: F401
+from .lod_tensor import (  # noqa: F401
+    create_lod_tensor,
+    create_random_int_lodtensor,
+)
+from . import average  # noqa: F401
+from . import debugger  # noqa: F401
+from . import communicator  # noqa: F401
+from .communicator import Communicator  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import input  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from . import initializer  # noqa: F401
 from . import layers  # noqa: F401

@@ -1,0 +1,37 @@
+"""Weighted running averages.
+
+Parity: /root/reference/python/paddle/fluid/average.py
+(WeightedAverage :35) — host-side metric accumulation across steps.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number_or_matrix(x):
+    return isinstance(x, (int, float, np.ndarray)) or np.isscalar(x)
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError("add(): value must be a number or ndarray")
+        if not np.isscalar(weight):
+            raise ValueError("add(): weight must be a number")
+        self.numerator = float(
+            (self.numerator or 0.0) + np.sum(value) * weight)
+        self.denominator = float((self.denominator or 0.0) + weight)
+
+    def eval(self):
+        if self.numerator is None or self.denominator == 0.0:
+            raise ValueError("eval() before add(), or zero total weight")
+        return self.numerator / self.denominator

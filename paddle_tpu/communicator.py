@@ -1,0 +1,124 @@
+"""Async gradient communicator for parameter-server mode.
+
+Parity: /root/reference/python/paddle/fluid/communicator.py (the
+Communicator wrapper) over operators/distributed/communicator.h:176
+(AsyncCommunicator: send ops enqueue; background threads merge queued
+gradients per variable and push batches to pservers, decoupling the
+trainer loop from RPC latency). HalfAsync/Geo variants map onto the
+same flusher with different merge windows; geo-SGD delta shipping has
+its own `geo_send` op (transpiler/geo_sgd_transpiler.py).
+
+Behavior: while a Communicator is running, `send` ops with
+sync_mode=False enqueue instead of blocking on RPC
+(ops/distributed_ops.py `_send`). The flusher thread wakes every
+``send_wait_ms`` (or when ``merge_num`` grads of one var are queued),
+SUMS queued grads per (endpoint, var) — the accumulation the
+reference's merge-add performs — and delivers via the same path the
+sync op uses. ``stop()`` drains the queue before returning, so no
+gradient is lost at shutdown.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Communicator"]
+
+_global: Optional["Communicator"] = None
+
+
+def global_communicator() -> Optional["Communicator"]:
+    return _global
+
+
+class Communicator:
+    def __init__(self, program=None, mode="ASYNC", send_wait_ms=10,
+                 merge_num=20):
+        self.mode = mode
+        self.send_wait_ms = int(send_wait_ms)
+        self.merge_num = int(merge_num)
+        self._pending = defaultdict(list)  # (ep, name) -> [arrays]
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._running = False
+        self._thread = None
+        self.pushes = 0  # flush batches delivered (observability)
+        self._error = None  # first delivery failure (surfaced on use)
+
+    # -- trainer-side enqueue (called by the send op) ----------------------
+
+    def enqueue(self, name, ep, value):
+        if self._error is not None:
+            err, self._error = self._error, None
+            self.stop()
+            raise RuntimeError(
+                "Communicator background flush failed; async sends "
+                "would be lost") from err
+        if not self._running:
+            raise RuntimeError("Communicator not running")
+        with self._lock:
+            self._pending[(ep, name)].append(np.asarray(value))
+            hot = len(self._pending[(ep, name)]) >= self.merge_num
+        if hot:
+            self._wake.set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        global _global
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        _global = self
+        return self
+
+    def stop(self):
+        global _global
+        if not self._running:
+            return
+        self._running = False
+        self._wake.set()
+        self._thread.join(timeout=30)
+        if _global is self:
+            _global = None
+        self._flush()  # drain anything enqueued during shutdown
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                "Communicator background flush failed") from err
+
+    def is_running(self):
+        return self._running
+
+    # -- flusher -----------------------------------------------------------
+
+    def _loop(self):
+        while self._running:
+            self._wake.wait(self.send_wait_ms / 1000.0)
+            self._wake.clear()
+            try:
+                self._flush()
+            except Exception as e:
+                # NEVER die silently: record the first failure; the
+                # next enqueue()/stop() raises it to the trainer
+                if self._error is None:
+                    self._error = e
+        self._flush()
+
+    def _flush(self):
+        from .ops.distributed_ops import deliver_grad
+
+        with self._lock:
+            batch = {k: v for k, v in self._pending.items() if v}
+            self._pending.clear()
+        for (ep, name), grads in batch.items():
+            merged = grads[0] if len(grads) == 1 else np.sum(
+                np.stack(grads), axis=0)
+            deliver_grad(name, ep, merged)
+            self.pushes += 1

@@ -1,0 +1,74 @@
+"""Program debugging / visualization utilities.
+
+Parity: /root/reference/python/paddle/fluid/debugger.py
+(pprint_program_codes, pprint_block_codes, draw_block_graphviz) and
+net_drawer.py — human-readable program text plus graphviz .dot export
+(the reference renders via ir/graph_viz_pass.cc; here IrGraph.draw).
+"""
+from __future__ import annotations
+
+__all__ = ["pprint_program_codes", "pprint_block_codes",
+           "draw_block_graphviz"]
+
+
+def _fmt_attr(v):
+    if hasattr(v, "idx"):  # sub-block
+        return "block[%d]" % v.idx
+    s = repr(v)
+    return s if len(s) <= 48 else s[:45] + "..."
+
+
+def pprint_block_codes(block, show_backward=False):
+    """Pseudo-code text for one block (reference debugger.py)."""
+    lines = ["// block %d" % block.idx]
+    for var in block.vars.values():
+        kind = "param" if getattr(var, "trainable", None) is not None \
+            and var.persistable else (
+                "data" if getattr(var, "is_data", False) else "var")
+        lines.append("%s %s : %s%s;" % (
+            kind, var.name, getattr(var, "dtype", "?"),
+            list(var.shape) if var.shape is not None else "[?]"))
+    for op in block.ops:
+        if not show_backward and op.type.endswith("_grad"):
+            continue
+        outs = ", ".join(n for ns in op.outputs.values() for n in ns)
+        ins = ", ".join(n for ns in op.inputs.values() for n in ns)
+        attrs = ", ".join("%s=%s" % (k, _fmt_attr(v))
+                          for k, v in sorted(op.attrs.items())
+                          if not k.startswith("_"))
+        lines.append("%s = %s(%s)%s;" % (
+            outs or "_", op.type, ins,
+            " {%s}" % attrs if attrs else ""))
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program, show_backward=False):
+    return "\n\n".join(pprint_block_codes(b, show_backward)
+                       for b in program.blocks)
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Write a graphviz .dot of the block's op/var graph (reference
+    debugger.py:draw_block_graphviz; rendering is `dot -Tpng` as there)."""
+    import os
+
+    from .framework import Program
+    from .ir import IrGraph
+
+    prog = Program()
+    dst = prog.global_block()
+    for name, var in block.vars.items():
+        v = dst.create_var(name=name, dtype=getattr(var, "dtype", None),
+                           persistable=getattr(var, "persistable", False))
+        if var.shape is not None:
+            v.shape = tuple(var.shape)
+    for op in block.ops:
+        dst.append_op(op.type, {k: list(v) for k, v in op.inputs.items()},
+                      {k: list(v) for k, v in op.outputs.items()},
+                      {k: v for k, v in op.attrs.items()
+                       if not hasattr(v, "idx")}, infer_shape=False)
+    graph = IrGraph(prog)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    name = os.path.splitext(os.path.basename(path))[0]
+    written = graph.draw(d, name)
+    return written

@@ -333,3 +333,288 @@ class InstanceNorm(Layer):
             "instance_norm",
             {"X": input, "Scale": self.weight, "Bias": self.bias}, {},
             {"epsilon": self._epsilon})["Y"][0]
+
+
+class Conv3D(Layer):
+    """reference dygraph/nn.py Conv3D over conv3d_op (NCDHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None,
+                 dtype="float32"):
+        super().__init__()
+        groups = groups or 1
+        fs = _pair(filter_size, 3)
+        self._attrs = {"strides": _pair(stride, 3),
+                       "paddings": _pair(padding, 3),
+                       "dilations": _pair(dilation, 3),
+                       "groups": groups}
+        self.weight = _create_param(
+            [num_filters, num_channels // groups] + fs, dtype, param_attr)
+        self.bias = _create_param([num_filters], dtype, bias_attr,
+                                  is_bias=True)
+        self._act = act
+
+    def forward(self, input):
+        out = _tracer().trace_op(
+            "conv3d", {"Input": input, "Filter": self.weight}, {},
+            self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = _tracer().trace_op(
+                "elementwise_add", {"X": out, "Y": self.bias}, {},
+                {"axis": 1})["Out"][0]
+        if self._act:
+            out = _tracer().trace_op(self._act, {"X": out}, {},
+                                     {})["Out"][0]
+        return out
+
+
+class Conv3DTranspose(Layer):
+    """reference dygraph/nn.py Conv3DTranspose over conv3d_transpose."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None,
+                 dtype="float32"):
+        super().__init__()
+        groups = groups or 1
+        fs = _pair(filter_size, 3)
+        self._attrs = {"strides": _pair(stride, 3),
+                       "paddings": _pair(padding, 3),
+                       "dilations": _pair(dilation, 3),
+                       "groups": groups}
+        self.weight = _create_param(
+            [num_channels, num_filters // groups] + fs, dtype, param_attr)
+        self.bias = _create_param([num_filters], dtype, bias_attr,
+                                  is_bias=True)
+        self._act = act
+
+    def forward(self, input):
+        out = _tracer().trace_op(
+            "conv3d_transpose",
+            {"Input": input, "Filter": self.weight}, {},
+            self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = _tracer().trace_op(
+                "elementwise_add", {"X": out, "Y": self.bias}, {},
+                {"axis": 1})["Out"][0]
+        if self._act:
+            out = _tracer().trace_op(self._act, {"X": out}, {},
+                                     {})["Out"][0]
+        return out
+
+
+class BilinearTensorProduct(Layer):
+    """reference dygraph/nn.py BilinearTensorProduct:
+    out_k = x W_k y^T + b."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__()
+        self.weight = _create_param(
+            [output_dim, input1_dim, input2_dim], dtype, param_attr)
+        self.bias = _create_param([1, output_dim], dtype, bias_attr,
+                                  is_bias=True)
+        self._act = act
+
+    def forward(self, x, y):
+        ins = {"X": x, "Y": y, "Weight": self.weight}
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        out = _tracer().trace_op("bilinear_tensor_product", ins, {},
+                                 {})["Out"][0]
+        if self._act:
+            out = _tracer().trace_op(self._act, {"X": out}, {},
+                                     {})["Out"][0]
+        return out
+
+
+class NCE(Layer):
+    """reference dygraph/nn.py NCE over nce_op (uniform sampler)."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", seed=0, is_sparse=False,
+                 dtype="float32"):
+        super().__init__()
+        self.weight = _create_param([num_total_classes, dim], dtype,
+                                    param_attr)
+        self.bias = _create_param([num_total_classes, 1], dtype,
+                                  bias_attr, is_bias=True)
+        sampler_id = {"uniform": 0, "log_uniform": 1}[sampler]
+        self._attrs = {"num_total_classes": int(num_total_classes),
+                       "num_neg_samples": int(num_neg_samples),
+                       "seed": seed, "sampler": sampler_id,
+                       "is_sparse": is_sparse}
+        self._num_neg = num_neg_samples
+
+    def forward(self, input, label, sample_weight=None):
+        ins = {"Input": input, "Label": label, "Weight": self.weight}
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        if sample_weight is not None:
+            ins["SampleWeight"] = sample_weight
+        cost = _tracer().trace_op("nce", ins, {}, self._attrs)["Cost"][0]
+        return _tracer().trace_op(
+            "scale", {"X": cost},
+            {}, {"scale": 1.0 / (self._num_neg + 1), "bias": 0.0})["Out"][0]
+
+
+class SequenceConv(Layer):
+    """reference dygraph/nn.py SequenceConv over sequence_conv_op
+    (context-window conv; LoD input)."""
+
+    def __init__(self, name_scope=None, num_filters=1, filter_size=3,
+                 filter_stride=1, padding=None, bias_attr=None,
+                 param_attr=None, act=None, input_dim=None,
+                 dtype="float32"):
+        super().__init__()
+        if input_dim is None:
+            raise ValueError(
+                "SequenceConv needs input_dim (the reference defers "
+                "parameter creation to first forward; pass it up front)")
+        self._filter_size = int(filter_size)
+        self.weight = _create_param(
+            [self._filter_size * int(input_dim), num_filters], dtype,
+            param_attr)
+        self.bias = _create_param([num_filters], dtype, bias_attr,
+                                  is_bias=True)
+        self._attrs = {"contextLength": self._filter_size,
+                       "contextStart": -(self._filter_size // 2),
+                       "contextStride": int(filter_stride),
+                       "paddingTrainable": False}
+        self._act = act
+
+    def forward(self, input):
+        out = _tracer().trace_op(
+            "sequence_conv", {"X": input, "Filter": self.weight}, {},
+            self._attrs)["Out"][0]
+        if self.bias is not None:
+            out = _tracer().trace_op(
+                "elementwise_add", {"X": out, "Y": self.bias}, {},
+                {"axis": 1})["Out"][0]
+        if self._act:
+            out = _tracer().trace_op(self._act, {"X": out}, {},
+                                     {})["Out"][0]
+        return out
+
+
+class RowConv(Layer):
+    """reference dygraph/nn.py RowConv over row_conv_op (lookahead
+    conv for streaming models)."""
+
+    def __init__(self, name_scope=None, future_context_size=2,
+                 param_attr=None, act=None, input_dim=None,
+                 dtype="float32"):
+        super().__init__()
+        if input_dim is None:
+            raise ValueError("RowConv needs input_dim")
+        self.weight = _create_param(
+            [future_context_size + 1, int(input_dim)], dtype, param_attr)
+        self._act = act
+
+    def forward(self, input):
+        out = _tracer().trace_op(
+            "row_conv", {"X": input, "Filter": self.weight}, {},
+            {})["Out"][0]
+        if self._act:
+            out = _tracer().trace_op(self._act, {"X": out}, {},
+                                     {})["Out"][0]
+        return out
+
+
+class SpectralNorm(Layer):
+    """reference dygraph/nn.py:2700 SpectralNorm over spectral_norm_op
+    (power-iteration largest singular value normalization)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._attrs = {"dim": int(dim), "power_iters": int(power_iters),
+                       "eps": float(eps)}
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = _create_param(
+            [h], dtype, None, default_init=NormalInitializer(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = _create_param(
+            [w], dtype, None, default_init=NormalInitializer(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        res = _tracer().trace_op(
+            "spectral_norm",
+            {"Weight": weight, "U": self.weight_u, "V": self.weight_v},
+            {}, self._attrs)
+        return res["Out"][0]
+
+
+def _run_host_op_eager(op_type, ins, out_slots, attrs):
+    """Host ops (data-dependent control on the host) can't ride the
+    eager tracer; run them as a one-op Program — eager values are
+    concrete, so this is exact, just per-call interpreted."""
+    import paddle_tpu as fluid
+
+    prog = framework.Program()
+    blk = prog.global_block()
+    feed = {}
+    in_map = {}
+    for slot, v in ins.items():
+        arr = np.asarray(v._array if isinstance(v, VarBase) else v)
+        name = "_eager_%s" % slot.lower()
+        var = blk.create_var(name=name, dtype=str(arr.dtype))
+        var.shape = tuple(arr.shape)
+        var.is_data = True
+        feed[name] = arr
+        in_map[slot] = [name]
+    out_map = {s: ["_eager_out_%s" % s.lower()] for s in out_slots}
+    for names in out_map.values():
+        blk.create_var(name=names[0], dtype="float32")
+    blk.append_op(op_type, in_map, out_map, dict(attrs),
+                  infer_shape=False)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        outs = exe.run(prog, feed=feed,
+                       fetch_list=[out_map[s][0] for s in out_slots],
+                       return_numpy=False)
+    return [VarBase(np.asarray(o.array if hasattr(o, "array") else o),
+                    stop_gradient=True) for o in outs]
+
+
+class TreeConv(Layer):
+    """reference dygraph/nn.py TreeConv over tree_conv_op (TBCNN).
+    tree_conv is a host op (data-dependent edge walks), so the eager
+    forward runs it as a one-op program — inference-oriented in
+    dygraph, exactly like LoD ops."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=8, act="tanh", param_attr=None,
+                 bias_attr=None, name=None, dtype="float32"):
+        super().__init__()
+        self.weight = _create_param(
+            [feature_size, 3, output_size, num_filters], dtype,
+            param_attr)
+        self.bias = _create_param([num_filters], dtype, bias_attr,
+                                  is_bias=True)
+        self._attrs = {"max_depth": int(max_depth)}
+        self._act = act
+
+    def forward(self, nodes_vector, edge_set):
+        (out,) = _run_host_op_eager(
+            "tree_conv",
+            {"NodesVector": nodes_vector, "EdgeSet": edge_set,
+             "Filter": self.weight}, ["Out"], self._attrs)
+        if self.bias is not None:
+            out = _tracer().trace_op(
+                "elementwise_add", {"X": out, "Y": self.bias}, {},
+                {"axis": -1})["Out"][0]
+        if self._act:
+            out = _tracer().trace_op(self._act, {"X": out}, {},
+                                     {})["Out"][0]
+        return out
+
+
+__all__ += ["Conv3D", "Conv3DTranspose", "BilinearTensorProduct", "NCE",
+            "SequenceConv", "RowConv", "SpectralNorm", "TreeConv"]

@@ -1,0 +1,147 @@
+"""Graph-side evaluators (legacy surface).
+
+Parity: /root/reference/python/paddle/fluid/evaluator.py — the
+deprecated-but-shipped Evaluator classes (the reference's own docstring
+points users at fluid.metrics). Each builds accumulation STATE VARS in
+the program and appends update ops; ``eval()`` returns the aggregate.
+Here ChunkEvaluator and EditDistance keep the same contract over the
+chunk_eval / edit_distance ops; DetectionMAP lives in
+layers/detection.py (stateful mAP) as the reference's detection variant
+does.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import layers
+from .layer_helper import LayerHelper
+
+__all__ = ["ChunkEvaluator", "EditDistance"]
+
+
+def _state_value(var, scope=None):
+    """Read one accumulator state var. Pass the scope the program ran
+    under when it was not the (default) global scope."""
+    import paddle_tpu as fluid
+
+    scope = scope or fluid.global_scope()
+    v = scope.find_var(var.name)
+    if v is None or not v.is_initialized():
+        raise RuntimeError(
+            "evaluator state %r not found in the scope; pass the scope "
+            "the program ran under via eval(..., scope=...)" % var.name)
+    return float(np.asarray(v.get_tensor().array).reshape(-1)[0])
+
+
+class Evaluator:
+    """Base: tracks metric state vars created in the main program
+    (reference evaluator.py:41)."""
+
+    def __init__(self, name, **kwargs):
+        self.helper = LayerHelper(name, **kwargs)
+        self.states = []
+        self.metrics = []
+
+    def reset(self, executor, reset_program=None):
+        import paddle_tpu as fluid
+
+        if reset_program is None:
+            reset_program = fluid.Program()
+        with fluid.program_guard(reset_program):
+            for var in self.states:
+                zeros = layers.fill_constant(
+                    shape=[int(s) for s in (var.shape or (1,))],
+                    dtype=var.dtype, value=0.0)
+                layers.tensor.assign(zeros, var)
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+    def _create_state(self, suffix, dtype, shape):
+        from . import framework
+
+        var = self.helper.main_program.current_block().create_var(
+            name=framework.unique_name.generate(
+                "_".join([self.helper.layer_type, suffix])),
+            dtype=dtype, persistable=True)
+        var.shape = tuple(shape)
+        self.states.append(var)
+        return var
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulated chunk P/R/F1 across minibatches (reference
+    evaluator.py:ChunkEvaluator over chunk_eval_op)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super().__init__("chunk_eval")
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError("You can only invoke Evaluator in root block")
+        self.num_infer_chunks = self._create_state(
+            "num_infer_chunks", "int64", (1,))
+        self.num_label_chunks = self._create_state(
+            "num_label_chunks", "int64", (1,))
+        self.num_correct_chunks = self._create_state(
+            "num_correct_chunks", "int64", (1,))
+        (precision, recall, f1, num_infer, num_label,
+         num_correct) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        layers.sums(
+            input=[self.num_infer_chunks, num_infer],
+            out=self.num_infer_chunks)
+        layers.sums(
+            input=[self.num_label_chunks, num_label],
+            out=self.num_label_chunks)
+        layers.sums(
+            input=[self.num_correct_chunks, num_correct],
+            out=self.num_correct_chunks)
+        self.metrics.extend((precision, recall, f1))
+
+    def eval(self, executor, eval_program=None, scope=None):
+        ni = _state_value(self.num_infer_chunks, scope)
+        nl = _state_value(self.num_label_chunks, scope)
+        nc = _state_value(self.num_correct_chunks, scope)
+        precision = nc / ni if ni else 0.0
+        recall = nc / nl if nl else 0.0
+        f1 = 2 * precision * recall / (precision + recall) if nc else 0.0
+        return np.array([precision], np.float32), \
+            np.array([recall], np.float32), np.array([f1], np.float32)
+
+
+class EditDistance(Evaluator):
+    """Accumulated average edit distance + instance error rate
+    (reference evaluator.py:EditDistance over edit_distance_op)."""
+
+    def __init__(self, input, label, ignored_tokens=None):
+        super().__init__("edit_distance")
+        self.total_distance = self._create_state(
+            "total_distance", "float32", (1,))
+        self.seq_num = self._create_state("seq_num", "int64", (1,))
+        self.instance_error = self._create_state(
+            "instance_error", "int64", (1,))
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, normalized=False,
+            ignored_tokens=ignored_tokens)
+        zero = layers.fill_constant(shape=[1], value=0.0, dtype="float32")
+        compare_result = layers.greater_than(distances, zero)
+        compare_result = layers.cast(compare_result, dtype="int64")
+        instance_error = layers.reduce_sum(compare_result)
+        instance_error = layers.reshape(instance_error, shape=[1])
+        layers.sums(input=[self.total_distance,
+                           layers.reshape(layers.reduce_sum(distances),
+                                          shape=[1])],
+                    out=self.total_distance)
+        layers.sums(input=[self.seq_num, seq_num], out=self.seq_num)
+        layers.sums(input=[self.instance_error, instance_error],
+                    out=self.instance_error)
+
+    def eval(self, executor, eval_program=None, scope=None):
+        n = _state_value(self.seq_num, scope)
+        avg = _state_value(self.total_distance, scope) / n if n else 0.0
+        err = _state_value(self.instance_error, scope) / n if n else 0.0
+        return np.array([avg], np.float32), np.array([err], np.float32)

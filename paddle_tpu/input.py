@@ -1,0 +1,23 @@
+"""2.0-style input helpers.
+
+Parity: /root/reference/python/paddle/fluid/input.py (one_hot :24,
+embedding :126) — thin entry points over the same graph ops the
+``fluid.layers`` twins build.
+"""
+from __future__ import annotations
+
+from .layers import nn as _nn
+
+__all__ = ["one_hot", "embedding"]
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return _nn.one_hot(input, depth, allow_out_of_range)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    return _nn.embedding(input, size, is_sparse=is_sparse,
+                         is_distributed=is_distributed,
+                         padding_idx=padding_idx, param_attr=param_attr,
+                         dtype=dtype)

@@ -1003,3 +1003,38 @@ def switch_moe(input, num_experts, hidden_dim, capacity_factor=1.0,
 
 
 __all__ += ["flash_attention", "switch_moe"]
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk-detection precision/recall/F1 (reference layers/nn.py:866
+    -> chunk_eval_op; the NER evaluation layer)."""
+    helper = LayerHelper("chunk_eval", input=input)
+
+    def mk(dtype):
+        return helper.create_variable_for_type_inference(
+            dtype, stop_gradient=True)
+
+    precision, recall, f1 = mk("float32"), mk("float32"), mk("float32")
+    n_infer, n_label, n_correct = mk("int64"), mk("int64"), mk("int64")
+    inputs = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        inputs["SeqLength"] = [seq_length]
+    helper.append_op(
+        "chunk_eval", inputs=inputs,
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1], "NumInferChunks": [n_infer],
+                 "NumLabelChunks": [n_label],
+                 "NumCorrectChunks": [n_correct]},
+        attrs={"num_chunk_types": int(num_chunk_types),
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": list(excluded_chunk_types or [])},
+        infer_shape=False)
+    for v in (precision, recall, f1):
+        v.shape, v.dtype = (1,), "float32"
+    for v in (n_infer, n_label, n_correct):
+        v.shape, v.dtype = (1,), "int64"
+    return precision, recall, f1, n_infer, n_label, n_correct
+
+
+__all__ += ["chunk_eval"]

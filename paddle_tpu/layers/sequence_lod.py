@@ -35,6 +35,75 @@ def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
     return out
 
 
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window convolution over LoD sequences (reference
+    layers/nn.py sequence_conv -> sequence_conv_op.cc)."""
+    helper = LayerHelper("sequence_conv", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    d = int(input.shape[-1])
+    filter_shape = [filter_size * d, num_filters]
+    filt = helper.create_parameter(param_attr, shape=filter_shape,
+                                   dtype=input.dtype)
+    if padding_start is None:
+        padding_start = -int(filter_size // 2)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "sequence_conv",
+        inputs={"X": [input], "Filter": [filt]},
+        outputs={"Out": [out]},
+        attrs={"contextLength": int(filter_size),
+               "contextStart": int(padding_start),
+               "contextStride": int(filter_stride),
+               "paddingTrainable": False},
+    )
+    # needs_lod shape default would carry D through; the true width is
+    # num_filters — the bias below sizes itself from this
+    out.shape = (-1, int(num_filters))
+    out.dtype = input.dtype
+    out = helper.append_bias_op(out) if bias_attr is not False else out
+    return helper.append_activation(out)
+
+
+__all__.append("sequence_conv")
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Per-position id windows (reference layers/sequence_lod.py:1152
+    -> sequence_enumerate_op)."""
+    helper = LayerHelper("sequence_enumerate", input=input, name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    helper.append_op("sequence_enumerate", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"win_size": int(win_size),
+                            "pad_value": int(pad_value)},
+                     infer_shape=False)
+    out.shape = (-1, int(win_size))
+    out.dtype = input.dtype
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    """Remove listed tokens from LoD sequences (reference
+    sequence_erase_op)."""
+    helper = LayerHelper("sequence_erase", input=input, name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    helper.append_op("sequence_erase", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"tokens": [int(t) for t in tokens]},
+                     infer_shape=False)
+    out.shape = (-1, 1)
+    out.dtype = input.dtype
+    return out
+
+
+__all__ += ["sequence_enumerate", "sequence_erase"]
+
+
 def sequence_first_step(input):
     return sequence_pool(input, "first")
 

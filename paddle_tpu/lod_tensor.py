@@ -1,0 +1,62 @@
+"""LoDTensor construction helpers.
+
+Parity: /root/reference/python/paddle/fluid/lod_tensor.py
+(create_lod_tensor :24, create_random_int_lodtensor :97). The recursive
+sequence-length convention matches the reference: lengths per level,
+converted to offset LoD on the tensor.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.tensor import LoDTensor
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
+
+
+def _lengths_to_offsets(recursive_seq_lens):
+    lods = []
+    for lengths in recursive_seq_lens:
+        offs = [0]
+        for n in lengths:
+            offs.append(offs[-1] + int(n))
+        lods.append(offs)
+    return lods
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Build a LoDTensor from a numpy array / list / LoDTensor plus
+    per-level sequence LENGTHS (reference lod_tensor.py:24)."""
+    if isinstance(data, LoDTensor):
+        return create_lod_tensor(np.asarray(data.array),
+                                 recursive_seq_lens, place)
+    if isinstance(data, list):
+        # list of per-sequence rows: lengths must match
+        flat = np.concatenate([np.asarray(d).reshape(-1, 1)
+                               for d in data], axis=0)
+        lens = [len(np.asarray(d).reshape(-1)) for d in data]
+        if recursive_seq_lens and \
+                list(recursive_seq_lens[-1]) != lens:
+            raise ValueError(
+                "recursive_seq_lens %s does not match data lengths %s"
+                % (recursive_seq_lens, lens))
+        data = flat
+    arr = np.asarray(data)
+    lods = _lengths_to_offsets(recursive_seq_lens)
+    if lods and lods[-1][-1] != arr.shape[0]:
+        raise ValueError(
+            "last-level offsets end at %d but data has %d rows"
+            % (lods[-1][-1], arr.shape[0]))
+    t = LoDTensor(arr)
+    t.set_lod(lods)
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    """Random int64 LoDTensor whose last level has the given lengths
+    (reference lod_tensor.py:97) — the word-id test-data helper."""
+    total = int(sum(recursive_seq_lens[-1]))
+    shape = [total] + list(base_shape)
+    data = np.random.randint(low, high + 1, size=shape).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -1,0 +1,126 @@
+"""Composite network blocks.
+
+Parity: /root/reference/python/paddle/fluid/nets.py (simple_img_conv_pool,
+img_conv_group, sequence_conv_pool, glu, scaled_dot_product_attention) —
+the building blocks the book models and user code compose; each is a
+pure layer composition, so the TPU story is whatever XLA makes of the
+underlying ops (convs and matmuls fuse with their elementwise tails).
+"""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group",
+           "sequence_conv_pool", "glu", "scaled_dot_product_attention"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    conv_out = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=conv_stride, padding=conv_padding, dilation=conv_dilation,
+        groups=conv_groups, param_attr=param_attr, bias_attr=bias_attr,
+        act=act)
+    return layers.pool2d(
+        input=conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, pool_padding=pool_padding,
+        global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """Stacked conv(+BN+dropout) group followed by one pool — the VGG
+    block (reference nets.py img_conv_group)."""
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def expand(v):
+        if not hasattr(v, "__len__"):
+            return [v] * len(conv_num_filter)
+        assert len(v) == len(conv_num_filter)
+        return list(v)
+
+    conv_padding = expand(conv_padding)
+    conv_filter_size = expand(conv_filter_size)
+    param_attr = expand(param_attr)
+    conv_with_batchnorm = expand(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = expand(conv_batchnorm_drop_rate)
+
+    for i in range(len(conv_num_filter)):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None
+        tmp = layers.conv2d(
+            input=tmp, num_filters=conv_num_filter[i],
+            filter_size=conv_filter_size[i], padding=conv_padding[i],
+            param_attr=param_attr[i], act=local_conv_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
+    return layers.pool2d(input=tmp, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None):
+    conv_out = layers.sequence_conv(input=input, num_filters=num_filters,
+                                    filter_size=filter_size,
+                                    param_attr=param_attr, act=act,
+                                    bias_attr=bias_attr)
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in two along dim, a * sigmoid(b)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head scaled dot-product attention over dense [B, L, D]
+    tensors (reference nets.py:scaled_dot_product_attention). Heads
+    split/merge via reshape+transpose; the QK^T softmax V core is the
+    MXU-friendly batched-matmul XLA path."""
+    if len(queries.shape) != 3 or len(keys.shape) != 3 or \
+            len(values.shape) != 3:
+        raise ValueError("inputs must be 3-D [batch, len, dim]")
+    d_model = int(queries.shape[-1])
+    if d_model % num_heads != 0:
+        raise ValueError("hidden size %d not divisible by num_heads %d"
+                         % (d_model, num_heads))
+
+    def split_heads(x):
+        if num_heads == 1:
+            return x
+        b, l = x.shape[0], x.shape[1]
+        reshaped = layers.reshape(
+            x, shape=[int(b), int(l), num_heads, d_model // num_heads])
+        return layers.transpose(reshaped, perm=[0, 2, 1, 3])
+
+    def merge_heads(x):
+        if num_heads == 1:
+            return x
+        t = layers.transpose(x, perm=[0, 2, 1, 3])
+        return layers.reshape(
+            t, shape=[int(t.shape[0]), int(t.shape[1]),
+                      int(t.shape[2]) * int(t.shape[3])])
+
+    q, k, v = split_heads(queries), split_heads(keys), split_heads(values)
+    key_dim = float(d_model // num_heads)
+    scaled_q = layers.scale(q, scale=key_dim ** -0.5)
+    product = layers.matmul(scaled_q, k, transpose_y=True)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate,
+                                 is_test=False)
+    ctx = layers.matmul(weights, v)
+    return merge_heads(ctx)

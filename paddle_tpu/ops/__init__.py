@@ -41,3 +41,4 @@ from . import hybrid_parallel_ops  # noqa: F401
 from . import ctr_ops  # noqa: F401
 from . import tail_ops3  # noqa: F401
 from . import text_match_ops  # noqa: F401
+from . import eval_ops  # noqa: F401

@@ -222,3 +222,46 @@ def _c_sync_comm_stream(executor, op, scope):
                   outputs=[Out("Out", dispensable=True)], attrs={"ring_id": 0})
 def _barrier(executor, op, scope):
     pass
+
+
+@register_op(
+    "allreduce",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"reduce_type": 0, "sync_mode": False},
+    grad=None,
+)
+def _allreduce_legacy(ins, attrs):
+    """Legacy dygraph-DP allreduce (reference
+    distributed_ops/allreduce_op.cc; reduce_type 0..3 =
+    sum/prod/max/min over the default ring). Same lowering as
+    c_allreduce_* — a psum-family collective over the ring-0 axis."""
+    axis = axis_for_ring(0)
+    x = ins["X"]
+    if axis is None:
+        return {"Out": x}
+    rt = int(attrs.get("reduce_type", 0))
+    fns = {0: jax.lax.psum, 1: _pprod, 2: jax.lax.pmax, 3: jax.lax.pmin}
+    if rt not in fns:
+        raise ValueError("allreduce: bad reduce_type %d" % rt)
+    return {"Out": fns[rt](x, axis)}
+
+
+def _pprod(x, ax):
+    return jnp.exp(jax.lax.psum(jnp.log(jnp.abs(x) + 1e-38), ax)) * \
+        jnp.where(jax.lax.psum((x < 0).astype(jnp.int32), ax) % 2 == 1,
+                  -1.0, 1.0)
+
+
+@register_op(
+    "broadcast",
+    inputs=[In("X")],
+    outputs=[Out("Out")],
+    attrs={"sync_mode": False, "root": 0},
+    grad=None,
+)
+def _broadcast_legacy(ins, attrs):
+    """Legacy dygraph-DP broadcast (reference
+    distributed_ops/broadcast_op.cc) — same lowering as c_broadcast on
+    ring 0."""
+    return _c_broadcast(ins, {**attrs, "ring_id": 0})

@@ -81,6 +81,28 @@ def _rpc_client(ep):
         ep, trainer_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
 
 
+def deliver_grad(name, ep, val):
+    """Push one gradient to a pserver endpoint — in-process emulated
+    server or real socket RPC. Shared by the sync `send` op and the
+    async Communicator flusher."""
+    server = _EMULATED_SERVERS.get(ep)
+    if server is not None:
+        server["executor"]._write_var(server["scope"], name,
+                                      np.asarray(val))
+        sub = server["grad_to_block"].get(name)
+        if sub is not None:
+            server["executor"].run_block(sub, server["scope"])
+    elif ep:
+        # cross-process endpoint: real socket RPC (grpc_client.cc
+        # counterpart); the server applies the round protocol
+        _rpc_client(ep).send_grad(name, np.asarray(val))
+    else:
+        raise RuntimeError(
+            "send: no server at %r — run the pserver program "
+            "(listen_and_serv) first, or use the collective fleet "
+            "for multi-host" % ep)
+
+
 @register_host_op(
     "send",
     inputs=[In("X", duplicable=True, no_grad=True)],
@@ -89,24 +111,22 @@ def _rpc_client(ep):
 )
 def _send(executor, op, scope):
     eps = op.attrs.get("epmap", [])
+    sync = bool(op.attrs.get("sync_mode", True))
+    if not sync:
+        from ..communicator import global_communicator
+
+        comm = global_communicator()
+        if comm is not None and comm.is_running():
+            # async mode: the Communicator batches and pushes in the
+            # background (communicator.h:176 AsyncCommunicator)
+            for name, ep in zip(op.input("X"),
+                                eps or [""] * len(op.input("X"))):
+                comm.enqueue(name, ep,
+                             np.asarray(executor._read_var(scope, name)))
+            return
     for name, ep in zip(op.input("X"), eps or [""] * len(op.input("X"))):
-        server = _EMULATED_SERVERS.get(ep)
         val = executor._read_var(scope, name)
-        if server is not None:
-            server["executor"]._write_var(server["scope"], name,
-                                          np.asarray(val))
-            sub = server["grad_to_block"].get(name)
-            if sub is not None:
-                server["executor"].run_block(sub, server["scope"])
-        elif ep:
-            # cross-process endpoint: real socket RPC (grpc_client.cc
-            # counterpart); the server applies the round protocol
-            _rpc_client(ep).send_grad(name, np.asarray(val))
-        else:
-            raise RuntimeError(
-                "send: no server at %r — run the pserver program "
-                "(listen_and_serv) first, or use the collective fleet "
-                "for multi-host" % ep)
+        deliver_grad(name, ep, val)
 
 
 @register_host_op(
@@ -322,3 +342,22 @@ def _geo_send(executor, op, scope):
 
 def reset_geo_counters():
     _GEO_COUNTERS.clear()
+
+
+@register_host_op(
+    "ref_by_trainer_id",
+    inputs=[In("X", duplicable=True, no_grad=True),
+            In("TrainerId", no_grad=True)],
+    outputs=[Out("Out")])
+def _ref_by_trainer_id(executor, op, scope):
+    """Select X[trainer_id] (reference
+    distributed_ops/ref_by_trainer_id_op.h) — routes a per-trainer
+    slice (e.g. a merged-ids partition) to this trainer."""
+    tid = int(np.asarray(
+        executor._read_var(scope, op.input("TrainerId")[0])).reshape(-1)[0])
+    names = op.input("X")
+    if not 0 <= tid < len(names):
+        raise IndexError("trainer id %d out of range for %d inputs"
+                         % (tid, len(names)))
+    val = executor._read_var(scope, names[tid])
+    executor._write_var(scope, op.output("Out")[0], np.asarray(val))

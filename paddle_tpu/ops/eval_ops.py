@@ -1,0 +1,189 @@
+"""Sequence-labeling / ranking evaluation ops.
+
+Parity: /root/reference/paddle/fluid/operators/chunk_eval_op.cc
+(IOB/IOE/IOBES/plain chunk F1 over LoD label sequences) and
+positive_negative_pair_op.cc (per-query ranking pair counts). Both are
+host ops — variable-length label walks and per-query hash grouping are
+host-shaped work the reference also runs CPU-only.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import In, Out, register_host_op
+
+_SCHEMES = {
+    # scheme -> (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _segments(labels, num_tag_types, other_type, tb, ti, te, ts):
+    """Chunk segments [(begin, end, type)] of one sequence (reference
+    ChunkEvalKernel::GetSegments with its ChunkBegin/ChunkEnd rules)."""
+
+    def chunk_end(ptag, ptype, tag, typ):
+        if ptype == other_type:
+            return False
+        if typ == other_type or typ != ptype:
+            return True
+        if ptag == tb or ptag == ti:
+            return tag == tb or tag == ts
+        return ptag in (te, ts)
+
+    def chunk_begin(ptag, ptype, tag, typ):
+        if ptype == other_type:
+            return typ != other_type
+        if typ == other_type:
+            return False
+        if typ != ptype or tag == tb or tag == ts:
+            return True
+        if tag in (ti, te):
+            return ptag in (te, ts)
+        return False
+
+    segs = []
+    in_chunk = False
+    start = 0
+    tag, typ = -1, other_type
+    for i, lab in enumerate(labels):
+        ptag, ptype = tag, typ
+        tag = int(lab) % num_tag_types
+        typ = int(lab) // num_tag_types
+        if in_chunk and chunk_end(ptag, ptype, tag, typ):
+            segs.append((start, i - 1, ptype))
+            in_chunk = False
+        if chunk_begin(ptag, ptype, tag, typ):
+            start = i
+            in_chunk = True
+    if in_chunk:
+        segs.append((start, len(labels) - 1, typ))
+    return segs
+
+
+@register_host_op(
+    "chunk_eval",
+    inputs=[In("Inference", no_grad=True), In("Label", no_grad=True),
+            In("SeqLength", dispensable=True, no_grad=True)],
+    outputs=[Out("Precision"), Out("Recall"), Out("F1-Score"),
+             Out("NumInferChunks"), Out("NumLabelChunks"),
+             Out("NumCorrectChunks")],
+    attrs={"num_chunk_types": 1, "chunk_scheme": "IOB",
+           "excluded_chunk_types": []})
+def _chunk_eval(executor, op, scope):
+    from ..core.tensor import LoDTensor
+
+    scheme = op.attrs.get("chunk_scheme", "IOB")
+    if scheme not in _SCHEMES:
+        raise ValueError("unknown chunk scheme %r" % scheme)
+    ntag, tb, ti, te, ts = _SCHEMES[scheme]
+    ntype = int(op.attrs.get("num_chunk_types", 1))
+    other = ntype
+    excluded = set(int(x)
+                   for x in op.attrs.get("excluded_chunk_types", []))
+
+    def sequences(name):
+        v = scope.find_var(name).raw()
+        arr = np.asarray(v.array if isinstance(v, LoDTensor)
+                         else v).reshape(-1)
+        if isinstance(v, LoDTensor) and v.lod():
+            off = v.lod()[0]
+            return [arr[off[i]:off[i + 1]] for i in range(len(off) - 1)]
+        return [arr]  # one dense sequence
+
+    inf_seqs = sequences(op.input("Inference")[0])
+    lab_seqs = sequences(op.input("Label")[0])
+    n_inf = n_lab = n_correct = 0
+    for inf, lab in zip(inf_seqs, lab_seqs):
+        a = _segments(inf, ntag, other, tb, ti, te, ts)
+        b = _segments(lab, ntag, other, tb, ti, te, ts)
+        a = [s for s in a if s[2] not in excluded]
+        b = [s for s in b if s[2] not in excluded]
+        n_inf += len(a)
+        n_lab += len(b)
+        n_correct += len(set(a) & set(b))
+    prec = n_correct / n_inf if n_inf else 0.0
+    rec = n_correct / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if n_correct else 0.0
+    w = executor._write_var
+    w(scope, op.output("Precision")[0], np.asarray([prec], np.float32))
+    w(scope, op.output("Recall")[0], np.asarray([rec], np.float32))
+    w(scope, op.output("F1-Score")[0], np.asarray([f1], np.float32))
+    w(scope, op.output("NumInferChunks")[0],
+      np.asarray([n_inf], np.int64))
+    w(scope, op.output("NumLabelChunks")[0],
+      np.asarray([n_lab], np.int64))
+    w(scope, op.output("NumCorrectChunks")[0],
+      np.asarray([n_correct], np.int64))
+
+
+@register_host_op(
+    "positive_negative_pair",
+    inputs=[In("Score", no_grad=True), In("Label", no_grad=True),
+            In("QueryID", no_grad=True),
+            In("AccumulatePositivePair", dispensable=True, no_grad=True),
+            In("AccumulateNegativePair", dispensable=True, no_grad=True),
+            In("AccumulateNeutralPair", dispensable=True, no_grad=True),
+            In("Weight", dispensable=True, no_grad=True)],
+    outputs=[Out("PositivePair"), Out("NegativePair"),
+             Out("NeutralPair")],
+    attrs={"column": 0})
+def _positive_negative_pair(executor, op, scope):
+    """Per-query ordered-pair counts (reference
+    positive_negative_pair_op.h): for each query's doc pairs with
+    unequal labels, a pair is positive when score order matches label
+    order, negative when inverted; equal scores also count neutral."""
+
+    def val(slot):
+        names = op.input(slot)
+        if not names:
+            return None
+        return np.asarray(executor._read_var(scope, names[0]))
+
+    score = val("Score")
+    label = val("Label").reshape(-1)
+    query = val("QueryID").reshape(-1).astype(np.int64)
+    weight = val("Weight")
+    if weight is not None:
+        weight = weight.reshape(-1)
+    col = int(op.attrs.get("column", 0))
+    if score.ndim == 1:
+        score = score.reshape(-1, 1)
+    if col < 0:
+        col += score.shape[1]
+    s = score[:, col]
+    pos = neg = neu = 0.0
+    accp, accn, accu = (val("AccumulatePositivePair"),
+                        val("AccumulateNegativePair"),
+                        val("AccumulateNeutralPair"))
+    if accp is not None and accn is not None and accu is not None:
+        pos = float(accp.reshape(-1)[0])
+        neg = float(accn.reshape(-1)[0])
+        neu = float(accu.reshape(-1)[0])
+    by_query = {}
+    for i in range(len(query)):
+        by_query.setdefault(int(query[i]), []).append(i)
+    for idxs in by_query.values():
+        for a in range(len(idxs)):
+            for b in range(a + 1, len(idxs)):
+                i, j = idxs[a], idxs[b]
+                if label[i] == label[j]:
+                    continue
+                w = ((weight[i] + weight[j]) * 0.5
+                     if weight is not None else 1.0)
+                if s[i] == s[j]:
+                    neu += w
+                if (s[i] - s[j]) * (label[i] - label[j]) > 0.0:
+                    pos += w
+                else:
+                    neg += w
+    wv = executor._write_var
+    wv(scope, op.output("PositivePair")[0],
+       np.asarray([pos], np.float32))
+    wv(scope, op.output("NegativePair")[0],
+       np.asarray([neg], np.float32))
+    wv(scope, op.output("NeutralPair")[0],
+       np.asarray([neu], np.float32))

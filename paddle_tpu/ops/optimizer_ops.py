@@ -379,6 +379,93 @@ _op(
 )
 
 
+def _proximal_adagrad(ins, attrs):
+    """Adagrad moment + proximal soft-threshold step (reference
+    optimizers/proximal_adagrad_op.h)."""
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = _lr(ins)
+    m_out = ins["Moment"] + jnp.square(ins["Grad"])
+    prox = ins["Param"] - lr * ins["Grad"] / jnp.sqrt(m_out)
+    if l1 > 0.0:
+        p_out = (jnp.sign(prox)
+                 * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                 / (1.0 + lr * l2))
+    else:
+        p_out = prox / (1.0 + lr * l2)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+_op(
+    "proximal_adagrad",
+    ["Param", "Moment", "Grad", "LearningRate"],
+    ["ParamOut", "MomentOut"],
+    {"l1": 0.0, "l2": 0.0},
+    _proximal_adagrad,
+)
+
+
+def _dgc_momentum(ins, attrs):
+    """Momentum before the DGC rampup step, plain SGD after — with the
+    1/nranks grad rescale dgc_op pre-multiplied (reference
+    optimizers/dgc_momentum_op.h)."""
+    rampup = float(attrs.get("rampup_begin_step", 0.0))
+    if rampup < 0:
+        # reference dgc_momentum_op.h:34: negative rampup disables the
+        # whole update (early return, outputs untouched)
+        return {"ParamOut": ins["Param"], "VelocityOut": ins["Velocity"],
+                "Grad_out": ins["Grad"]}
+    mu = attrs.get("mu", 0.9)
+    nranks = ins["nranks"].reshape(()).astype(jnp.float32)
+    g = ins["Grad"] / nranks
+    step = ins["current_step"].reshape(()).astype(jnp.float32)
+    before_rampup = step < rampup
+    v = mu * ins["Velocity"] + g
+    if attrs.get("use_nesterov", False):
+        p_momentum = ins["Param"] - (g + mu * v) * _lr(ins)
+    else:
+        p_momentum = ins["Param"] - _lr(ins) * v
+    p_sgd = ins["Param"] - _lr(ins) * g
+    p_out = jnp.where(before_rampup, p_momentum, p_sgd)
+    v_out = jnp.where(before_rampup, v, ins["Velocity"])
+    return {"ParamOut": p_out, "VelocityOut": v_out, "Grad_out": g}
+
+
+_op(
+    "dgc_momentum",
+    ["Param", "Grad", "Velocity", "LearningRate", "current_step",
+     "nranks"],
+    ["ParamOut", "VelocityOut", "Grad_out"],
+    {"mu": 0.9, "use_nesterov": False, "rampup_begin_step": 0.0},
+    _dgc_momentum,
+)
+
+
+def _dgc_clip_by_norm(ins, attrs):
+    """clip_by_norm gated on the DGC rampup step (reference
+    dgc_clip_by_norm_op.h: a no-op until current_step reaches
+    rampup_begin_step)."""
+    x = ins["X"]
+    rampup = float(attrs.get("rampup_begin_step", 0.0))
+    if rampup < 0:
+        return {"Out": x}  # dgc_clip_by_norm_op.h:27 disable path
+    max_norm = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    clipped = jnp.where(norm > max_norm, x * (max_norm / norm), x)
+    step = ins["current_step"].reshape(()).astype(jnp.float32)
+    active = step >= rampup
+    return {"Out": jnp.where(active, clipped, x)}
+
+
+register_op(
+    "dgc_clip_by_norm",
+    inputs=[In("X"), In("current_step", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"max_norm": 1.0, "rampup_begin_step": 0.0},
+    grad=None,
+)(_dgc_clip_by_norm)
+
+
 @register_op(
     "ema_accumulate",
     inputs=[In("Param", no_grad=True), In("Shadow", no_grad=True),

@@ -378,3 +378,67 @@ def _sequence_softmax_padded(ins, attrs):
     e = jnp.where(mask, jnp.exp(z), 0.0)
     return {"Out": e / jnp.maximum(
         jnp.sum(e, axis=1, keepdims=True), 1e-30)}
+
+
+@register_host_op(
+    "sequence_enumerate",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"win_size": 2, "pad_value": 0})
+def _sequence_enumerate(executor, op, scope):
+    """Per-position forward windows of ids (reference
+    sequence_ops/sequence_enumerate_op.h): out[t] = x[t:t+win], padded
+    with pad_value past the sequence end; LoD preserved."""
+    from ..core.tensor import LoDTensor
+
+    xv = scope.find_var(op.input("X")[0]).raw()
+    x = np.asarray(xv.array if isinstance(xv, LoDTensor) else xv)
+    flat = x.reshape(-1)
+    win = int(op.attrs.get("win_size", 2))
+    pad = op.attrs.get("pad_value", 0)
+    lod = (xv.lod() if isinstance(xv, LoDTensor) and xv.lod()
+           else [[0, flat.shape[0]]])
+    offs = lod[0]
+    out = np.full((flat.shape[0], win), pad, dtype=flat.dtype)
+    for s in range(len(offs) - 1):
+        lo, hi = int(offs[s]), int(offs[s + 1])
+        for t in range(lo, hi):
+            n = min(win, hi - t)
+            out[t, :n] = flat[t:t + n]
+    t = LoDTensor(out)
+    t.set_lod([list(offs)])
+    executor._write_var(scope, op.output("Out")[0], t)
+
+
+@register_host_op(
+    "sequence_erase",
+    inputs=[In("X", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"tokens": []})
+def _sequence_erase(executor, op, scope):
+    """Drop listed tokens from each sequence, shrinking the LoD
+    (reference sequence_ops/sequence_erase_op.h)."""
+    from ..core.tensor import LoDTensor
+
+    xv = scope.find_var(op.input("X")[0]).raw()
+    x = np.asarray(xv.array if isinstance(xv, LoDTensor) else xv)
+    flat = x.reshape(-1)
+    tokens = set(int(t) for t in op.attrs.get("tokens", []))
+    lod = (xv.lod() if isinstance(xv, LoDTensor) and xv.lod()
+           else [[0, flat.shape[0]]])
+    offs = lod[-1]
+    pieces = []
+    out_offs = [0]
+    for s in range(len(offs) - 1):
+        seg = flat[int(offs[s]):int(offs[s + 1])]
+        kept = seg[~np.isin(seg, list(tokens))] if tokens else seg
+        pieces.append(kept)
+        out_offs.append(out_offs[-1] + kept.shape[0])
+    out = (np.concatenate(pieces) if pieces
+           else flat[:0]).reshape(-1, 1)
+    t = LoDTensor(out)
+    # upper LoD levels index SEQUENCES, not rows — they survive erase
+    # unchanged; only the last (row) level shrinks
+    # (sequence_erase_op.h:66-70)
+    t.set_lod([list(l) for l in lod[:-1]] + [out_offs])
+    executor._write_var(scope, op.output("Out")[0], t)

@@ -40,14 +40,20 @@ def reference_op_types(ref_root="/root/reference"):
     opdir = os.path.join(ref_root, "paddle/fluid/operators")
     if not os.path.isdir(opdir):
         return None
-    pat = re.compile(r"REGISTER_OPERATOR\(\s*([a-z0-9_]+)")
+    # both registration macros bind runnable op types (op_registry.h:
+    # REGISTER_OPERATOR :223 and REGISTER_OP_WITHOUT_GRADIENT); full
+    # identifier tokens — the nccl ops are camelCase
+    pat = re.compile(
+        r"REGISTER_OP(?:ERATOR|_WITHOUT_GRADIENT)\(\s*([A-Za-z0-9_]+)")
     types = set()
     for root, _dirs, files in os.walk(opdir):
         for fn in files:
             if fn.endswith(".cc"):
                 with open(os.path.join(root, fn), errors="ignore") as f:
                     types.update(pat.findall(f.read()))
-    return types
+    # drop macro-parameter artifacts (e.g. REGISTER_OPERATOR(KERNEL_TYPE
+    # inside a #define) — real op types are never ALL-CAPS
+    return {t for t in types if not t.isupper()}
 
 
 def load_allowlist():

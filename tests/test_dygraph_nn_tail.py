@@ -1,0 +1,73 @@
+"""Dygraph layer-class tail: Conv3D, Conv3DTranspose,
+BilinearTensorProduct, NCE, SequenceConv, RowConv, SpectralNorm,
+TreeConv (reference python/paddle/fluid/dygraph/nn.py class set)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.dygraph import nn as dnn
+
+
+def _v(arr):
+    return fluid.dygraph.to_variable(np.asarray(arr))
+
+
+class TestDygraphNnTail:
+    def test_conv3d_forward(self):
+        with fluid.dygraph.guard():
+            m = dnn.Conv3D(2, 4, filter_size=3, padding=1)
+            out = m(_v(np.random.rand(1, 2, 4, 4, 4).astype("float32")))
+            assert out.shape == (1, 4, 4, 4, 4)
+
+    def test_conv3d_transpose_forward(self):
+        with fluid.dygraph.guard():
+            m = dnn.Conv3DTranspose(2, 3, filter_size=2, stride=2)
+            out = m(_v(np.random.rand(1, 2, 3, 3, 3).astype("float32")))
+            assert out.shape[1] == 3 and out.shape[2] == 6
+
+    def test_bilinear_tensor_product(self):
+        with fluid.dygraph.guard():
+            m = dnn.BilinearTensorProduct(3, 4, 5)
+            out = m(_v(np.random.rand(2, 3).astype("float32")),
+                    _v(np.random.rand(2, 4).astype("float32")))
+            assert out.shape == (2, 5)
+
+    def test_nce_loss_positive(self):
+        with fluid.dygraph.guard():
+            m = dnn.NCE(num_total_classes=20, dim=6, num_neg_samples=5)
+            cost = m(_v(np.random.rand(4, 6).astype("float32")),
+                     _v(np.array([[1], [2], [3], [4]], "int64")))
+            arr = np.asarray(cost.numpy())
+            assert arr.shape[0] == 4 and np.all(arr > 0)
+
+    def test_sequence_conv(self):
+        with fluid.dygraph.guard():
+            m = dnn.SequenceConv(num_filters=5, filter_size=3,
+                                 input_dim=4)
+            out = m(_v(np.random.rand(6, 4).astype("float32")))
+            assert out.shape == (6, 5)
+
+    def test_row_conv(self):
+        with fluid.dygraph.guard():
+            m = dnn.RowConv(future_context_size=2, input_dim=4)
+            # dense layout [batch, time, dim] (row_conv_op.cc)
+            out = m(_v(np.random.rand(1, 6, 4).astype("float32")))
+            assert out.shape == (1, 6, 4)
+
+    def test_spectral_norm_unit_sigma(self):
+        with fluid.dygraph.guard():
+            m = dnn.SpectralNorm([4, 6], dim=0, power_iters=8)
+            w = np.random.RandomState(0).rand(4, 6).astype("float32")
+            out = np.asarray(m(_v(w)).numpy())
+            # normalized weight has largest singular value ~1
+            s = np.linalg.svd(out, compute_uv=False)[0]
+            assert abs(s - 1.0) < 0.2, s
+
+    def test_tree_conv(self):
+        with fluid.dygraph.guard():
+            m = dnn.TreeConv(feature_size=4, output_size=3,
+                             num_filters=2, max_depth=2)
+            nodes = np.random.rand(1, 5, 4).astype("float32")
+            edges = np.array([[[1, 2], [1, 3], [3, 4], [3, 5]]],
+                             "int32")
+            out = m(_v(nodes), _v(edges))
+            assert np.asarray(out.numpy()).shape[:2] == (1, 5)
