@@ -410,6 +410,78 @@ def _enable_compile_cache():
         print("compile cache unavailable: %r" % e, file=sys.stderr)
 
 
+def _build_gpt_long(batch, seq_len, d_model=1024, n_heads=16,
+                    n_layers=2, vocab=8192, use_bf16=True):
+    """Small causal LM at LONG sequence — the config that exists to
+    exercise the pallas flash-attention training kernels (BASELINE.md
+    round-4 table: at seq 4096 flash fwd+bwd measures 2.3x XLA's dense
+    lowering, and beyond 8k dense does not compile at all)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    head = d_model // n_heads
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data(name="ids", shape=[batch, seq_len],
+                         dtype="int64")
+        lbl = fluid.data(name="lbl", shape=[batch * seq_len, 1],
+                         dtype="int64")
+        x = layers.embedding(ids, size=(vocab, d_model))
+        for _ in range(n_layers):
+            h = layers.layer_norm(x)
+            q = layers.fc(h, d_model, num_flatten_dims=2)
+            k = layers.fc(h, d_model, num_flatten_dims=2)
+            v = layers.fc(h, d_model, num_flatten_dims=2)
+
+            def heads(t):
+                t = layers.reshape(t, [batch, seq_len, n_heads, head])
+                return layers.transpose(t, [0, 2, 1, 3])
+
+            ctx = layers.flash_attention(heads(q), heads(k), heads(v),
+                                         causal=True)
+            ctx = layers.transpose(ctx, [0, 2, 1, 3])
+            ctx = layers.reshape(ctx, [batch, seq_len, d_model])
+            x = x + layers.fc(ctx, d_model, num_flatten_dims=2)
+            m = layers.layer_norm(x)
+            m = layers.fc(m, d_model * 4, num_flatten_dims=2, act="gelu")
+            x = x + layers.fc(m, d_model, num_flatten_dims=2)
+        logits = layers.fc(layers.layer_norm(x), vocab,
+                           num_flatten_dims=2)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.reshape(logits, [batch * seq_len, vocab]), lbl))
+        opt = fluid.optimizer.AdamOptimizer(1e-4)
+        if use_bf16:
+            from paddle_tpu.contrib import mixed_precision as mp
+
+            opt = mp.decorate(opt)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def bench_gpt_long(batch=2, seq_len=4096, iters=6, use_bf16=True):
+    import paddle_tpu as fluid
+
+    main, startup, loss = _build_gpt_long(batch, seq_len,
+                                          use_bf16=use_bf16)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = _device_feed({
+        "ids": rng.randint(0, 8192, (batch, seq_len)).astype("int64"),
+        "lbl": rng.randint(0, 8192,
+                           (batch * seq_len, 1)).astype("int64"),
+    })
+    dt, final_loss, diag = _time_steps(exe, main, feed, loss, warmup=2,
+                                       iters=iters, windows=2,
+                                       window_gap_s=3.0)
+    if not np.isfinite(final_loss):
+        raise RuntimeError("gpt_long diverged: loss=%r" % final_loss)
+    return {"tokens_per_sec": batch * seq_len / dt, "step_ms": dt * 1e3,
+            "batch": batch, "seq_len": seq_len, "loss": final_loss,
+            "bf16": use_bf16, "attention": "pallas_flash_causal",
+            "diag": diag}
+
+
 def _run_one(name, use_bf16):
     """Child-process entry: bench one model, print its JSON."""
     _enable_compile_cache()
@@ -423,6 +495,8 @@ def _run_one(name, use_bf16):
         print(json.dumps(bench_wide_deep()))
     elif name == "dygraph_mlp":
         print(json.dumps(bench_dygraph_mlp()))
+    elif name == "gpt_long":
+        print(json.dumps(bench_gpt_long(use_bf16=use_bf16)))
     elif name == "resnet50":
         rn = bench_resnet50(use_bf16=use_bf16)
         # ResNet-50 train step ~= 3x fwd FLOPs; fwd ~= 4.1 GFLOP/img @224
@@ -445,7 +519,7 @@ def _bench_subprocess(name, use_bf16):
         args.append("--no-bf16")
     timeout = {"resnet50": 360, "bert_base": 600, "mnist_mlp": 120,
                "transformer_wmt": 480, "wide_deep": 240,
-               "dygraph_mlp": 240}.get(name, 60)
+               "dygraph_mlp": 240, "gpt_long": 480}.get(name, 60)
     proc = subprocess.run(args, capture_output=True, text=True,
                           timeout=timeout)
     if proc.returncode != 0:
@@ -509,7 +583,8 @@ def main():
         extras["resnet50"] = rn
     # north-star configs 4/5 + the eager path — budget-gated so the
     # headline models always record first
-    for extra_model in ("wide_deep", "dygraph_mlp", "transformer_wmt"):
+    for extra_model in ("wide_deep", "dygraph_mlp", "transformer_wmt",
+                        "gpt_long"):
         if time.time() - t_start > budget_s:
             extras[extra_model + "_skipped"] = "time budget exhausted"
             continue

@@ -27,11 +27,15 @@ class WeightedAverage:
             raise ValueError("add(): value must be a number or ndarray")
         if not np.isscalar(weight):
             raise ValueError("add(): weight must be a number")
-        self.numerator = float(
-            (self.numerator or 0.0) + np.sum(value) * weight)
+        # elementwise, like the reference: an ndarray value accumulates
+        # per element and eval() returns an ndarray
+        contrib = np.asarray(value, dtype=np.float64) * weight
+        self.numerator = contrib if self.numerator is None \
+            else self.numerator + contrib
         self.denominator = float((self.denominator or 0.0) + weight)
 
     def eval(self):
         if self.numerator is None or self.denominator == 0.0:
             raise ValueError("eval() before add(), or zero total weight")
-        return self.numerator / self.denominator
+        out = self.numerator / self.denominator
+        return float(out) if np.ndim(out) == 0 else out

@@ -54,7 +54,10 @@ class Communicator:
     def enqueue(self, name, ep, value):
         if self._error is not None:
             err, self._error = self._error, None
-            self.stop()
+            try:
+                self.stop()
+            except Exception:
+                pass  # the ORIGINAL failure is the one to surface
             raise RuntimeError(
                 "Communicator background flush failed; async sends "
                 "would be lost") from err

@@ -81,12 +81,33 @@ def _rpc_client(ep):
         ep, trainer_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
 
 
-def deliver_grad(name, ep, val):
+def deliver_grad(name, ep, val, trainer_id=None):
     """Push one gradient to a pserver endpoint — in-process emulated
     server or real socket RPC. Shared by the sync `send` op and the
     async Communicator flusher."""
+    import os
+
     server = _EMULATED_SERVERS.get(ep)
     if server is not None:
+        if server.get("mode") == "fl":
+            # federated round: trainers send locally-trained PARAMS;
+            # once Fanin DISTINCT trainers contributed, the server
+            # installs their mean (FedAvg — the aggregation the
+            # reference's FL optimize blocks express,
+            # fl_listen_and_serv_op.cc:100). Keyed by trainer id: a
+            # duplicate send from one trainer REPLACES its entry, it
+            # must not crowd out a lagging peer
+            if trainer_id is None:
+                trainer_id = int(os.environ.get("PADDLE_TRAINER_ID",
+                                                "0"))
+            pend = server["pending"].setdefault(name, {})
+            pend[trainer_id] = np.asarray(val)
+            if len(pend) >= server["fanin"]:
+                server["executor"]._write_var(
+                    server["scope"], name,
+                    np.mean(np.stack(list(pend.values())), axis=0))
+                server["pending"][name] = {}
+            return
         server["executor"]._write_var(server["scope"], name,
                                       np.asarray(val))
         sub = server["grad_to_block"].get(name)
@@ -361,3 +382,29 @@ def _ref_by_trainer_id(executor, op, scope):
                          % (tid, len(names)))
     val = executor._read_var(scope, names[tid])
     executor._write_var(scope, op.output("Out")[0], np.asarray(val))
+
+
+@register_host_op(
+    "fl_listen_and_serv",
+    inputs=[In("X", duplicable=True, dispensable=True, no_grad=True)],
+    outputs=[],
+    attrs={"endpoint": "", "optimize_blocks": [], "sync_mode": True,
+           "Fanin": 1},
+)
+def _fl_listen_and_serv(executor, op, scope):
+    """Federated-learning server round (reference
+    distributed_ops/fl_listen_and_serv_op.cc): each round, trainers GET
+    the global parameters, train LOCALLY, and SEND their updated
+    parameters; once Fanin copies of a parameter arrive the server
+    installs the FedAvg mean. Aggregation here is the built-in mean
+    (deliver_grad fl mode) rather than reference-style optimize
+    sub-blocks — the contract (round protocol + averaged params served
+    to the next recv) is identical."""
+    _EMULATED_SERVERS[op.attrs["endpoint"]] = {
+        "executor": executor,
+        "scope": scope,
+        "grad_to_block": {},
+        "mode": "fl",
+        "fanin": int(op.attrs.get("Fanin", 1)),
+        "pending": {},
+    }

@@ -85,14 +85,26 @@ def _chunk_eval(executor, op, scope):
     excluded = set(int(x)
                    for x in op.attrs.get("excluded_chunk_types", []))
 
+    seq_len = None
+    if op.input("SeqLength"):
+        seq_len = np.asarray(executor._read_var(
+            scope, op.input("SeqLength")[0])).reshape(-1)
+
     def sequences(name):
         v = scope.find_var(name).raw()
-        arr = np.asarray(v.array if isinstance(v, LoDTensor)
-                         else v).reshape(-1)
+        arr = np.asarray(v.array if isinstance(v, LoDTensor) else v)
         if isinstance(v, LoDTensor) and v.lod():
+            flat = arr.reshape(-1)
             off = v.lod()[0]
-            return [arr[off[i]:off[i + 1]] for i in range(len(off) - 1)]
-        return [arr]  # one dense sequence
+            return [flat[off[i]:off[i + 1]]
+                    for i in range(len(off) - 1)]
+        if seq_len is not None:
+            # dense [B, T] rows truncated at their true lengths
+            # (reference chunk_eval_op.h:181 SeqLength path)
+            rows = arr.reshape(len(seq_len), -1)
+            return [rows[i, :int(seq_len[i])]
+                    for i in range(len(seq_len))]
+        return [arr.reshape(-1)]  # one dense sequence
 
     inf_seqs = sequences(op.input("Inference")[0])
     lab_seqs = sequences(op.input("Label")[0])

@@ -1,42 +1,61 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
 Parity intent: the reference hand-fuses attention for inference in CUDA
 (operators/fused/multihead_matmul_op.cu, math/bert_encoder_functor.cu);
 this is the TPU-native equivalent, done the flash way so the S x S
 score matrix never materializes in HBM:
 
-- grid = (batch*heads, q_blocks, k_blocks) with the K dimension
-  iterated sequentially ("arbitrary") so the running-softmax scratch
-  (m, l, acc in VMEM) persists across K steps;
-- each step does two MXU matmuls (Q@K^T, P@V) on [block_q, block_k]
-  tiles streamed HBM->VMEM by pallas;
-- the log-sum-exp accumulation is float32 regardless of input dtype.
-
-Backward: dense-recompute VJP via jax.custom_vjp (exact; a pallas
-backward kernel is a later optimization — the forward is where
-inference/serving time goes).
+- forward: grid = (batch*heads, q_blocks, k_blocks) with the K
+  dimension iterated sequentially ("arbitrary") so the running-softmax
+  scratch (m, l, acc in VMEM) persists across K steps; each step does
+  two MXU matmuls (Q@K^T, P@V) on [block_q, block_k] tiles streamed
+  HBM->VMEM by pallas; the log-sum-exp accumulation is float32
+  regardless of input dtype. The forward also emits the per-row
+  logsumexp (LSE), the only O(S) residual the backward needs.
+- backward (FlashAttention-2 style): probabilities are RECOMPUTED
+  blockwise from (Q, K, LSE) instead of stored, so training memory is
+  O(S·D) instead of the O(S²) attention matrix a dense VJP carries.
+  Two kernels: dQ iterates K blocks per Q block; dK/dV iterates Q
+  blocks per K block; both consume the dense precomputed
+  delta = rowsum(dO ∘ O) (an elementwise pass XLA fuses).
 
 Off-TPU the public entry falls back to the identical dense math, so
-programs are portable and CI (CPU) still exercises the call sites.
+programs are portable and CI (CPU) still exercises the call sites;
+tests run the kernels in interpret mode on CPU where the math is
+exact.
 
 Numerics, measured on v5e: with float32 inputs both this kernel and
 XLA's dense attention run the MXU's default (bfloat16-pass) precision;
-against an fp64 oracle the kernel's max error is ~2e-3 (non-causal) /
-~8e-3 (causal) and the dense path's is ~3e-3 / ~1e-2 — the flash
-accumulation is slightly MORE accurate, and the two agree within their
-mutual rounding. Tests compare in interpret mode on CPU where the
-math is exact.
+against an fp64 oracle the forward kernel's max error is ~2e-3
+(non-causal) / ~8e-3 (causal) and the dense path's is ~3e-3 / ~1e-2 —
+the flash accumulation is slightly MORE accurate, and the two agree
+within their mutual rounding.
 """
 from __future__ import annotations
 
 import functools
-import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+def _causal_mask(s, qi, ki, block_q, block_k):
+    """Mask the score tile with absolute positions (shared by the
+    forward and both backward kernels — one definition to extend for
+    sliding-window/padding variants)."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _causal_block_needed(qi, ki, block_q, block_k):
+    """False only when the whole tile lies above the diagonal."""
+    return ki * block_k <= qi * block_q + block_q - 1
 
 
 def _dense_attention(q, k, v, causal, scale):
@@ -52,8 +71,23 @@ def _dense_attention(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale, causal, block_q, block_k, nk):
+def _dense_lse(q, k, causal, scale):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        S = q.shape[1]
+        pos = jnp.arange(S)
+        s = jnp.where((pos[:, None] >= pos[None, :])[None], s, NEG_INF)
+    return jax.scipy.special.logsumexp(s, axis=-1)[..., None]  # [BH,S,1]
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *, scale, causal, block_q, block_k, nk):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
@@ -65,41 +99,54 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
-    k = k_ref[0].astype(jnp.float32)                  # [bk, d]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [bq, bk]
-    if causal:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale      # [bq, d]
+        k = k_ref[0].astype(jnp.float32)              # [bk, d]
+        s = jax.lax.dot_general(q, k,
+                                (((1,), (1,)), ((), ())))  # [bq, bk]
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
 
-    m_prev = m_ref[:]                                 # [bq, 1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)                            # [bq, bk]
-    alpha = jnp.exp(m_prev - m_new)                   # [bq, 1]
-    l_ref[:] = l_ref[:] * alpha + p.sum(axis=1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())))
-    m_ref[:] = m_new
+        m_prev = m_ref[:]                             # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                        # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)               # [bq, 1]
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())))
+        m_ref[:] = m_new
+
+    if causal:
+        # skip K blocks entirely above the diagonal — ~2x less work
+        pl.when(_causal_block_needed(qi, ki, block_q, block_k))(
+            _accumulate)
+    else:
+        _accumulate()
 
     @pl.when(ki == nk - 1)
     def _finish():
-        o_ref[0] = (acc_ref[:] /
-                    jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(l_safe)          # [bq, 1]
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Returns (out [B,H,S,D], lse [B*H, S] float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, S, D = q.shape
+    S_kv = k.shape[2]
     bq = min(block_q, S)
     bk = min(block_k, S)
-    if S % bq or S % bk:
-        return _dense_attention(q, k, v, causal, scale)
+    if S != S_kv or S % bq or S % bk:
+        # ragged tail, or rectangular cross-attention Q/K — the kernel
+        # grid assumes square S; dense math handles both exactly
+        q3 = q.reshape(B * H, S, D)
+        k3 = k.reshape(B * H, S_kv, D)
+        return (_dense_attention(q, k, v, causal, scale),
+                _dense_lse(q3, k3, causal, scale))
     nq, nk = S // bq, S // bk
     q3 = q.reshape(B * H, S, D)
     k3 = k.reshape(B * H, S, D)
@@ -107,7 +154,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
                                block_q=bq, block_k=bk, nk=nk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, nq, nk),
         in_specs=[
@@ -115,8 +162,16 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            # [BH, S, 1]: last block dim = full array dim (exempt from
+            # the /128 lane rule), penultimate bq satisfies the /8 rule
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -126,38 +181,227 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3)
-    return out.reshape(B, H, S, D)
+    return out.reshape(B, H, S, D), lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, scale, causal, block_q,
+                         block_k, nk):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                               # [bq, 1]
+        delta = delta_ref[0]                           # [bq, 1]
+
+        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta)                          # [bq, bk]
+        dq_acc[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())))           # [bq, d]
+
+    if causal:
+        pl.when(_causal_block_needed(qi, ki, block_q, block_k))(
+            _accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          scale, causal, block_q, block_k, nq):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                               # [bq, 1]
+        delta = delta_ref[0]                           # [bq, 1]
+
+        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(              # p^T @ do
+            p, do, (((0,), (0,)), ((), ())))           # [bk, d]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta)                          # [bq, bk]
+        dk_acc[:] += scale * jax.lax.dot_general(      # ds^T @ q
+            ds, q, (((0,), (0,)), ((), ())))           # [bk, d]
+
+    if causal:
+        # rows strictly above this K block see none of it
+        pl.when(_causal_block_needed(qi, ki, block_q, block_k))(
+            _accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
+                    block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    bq = min(block_q, S)
+    bk = min(block_k, S)
+    nq, nk = S // bq, S // bk
+    q3 = q.reshape(B * H, S, D)
+    k3 = k.reshape(B * H, S, D)
+    v3 = v.reshape(B * H, S, D)
+    do3 = g.reshape(B * H, S, D)
+    o3 = out.reshape(B * H, S, D)
+    # delta = rowsum(dO ∘ O): one fused elementwise pass, O(S·D)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [BH, S, 1]
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
+        block_k=bk, nk=nk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
+        block_k=bk, nq=nq)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    shape = (B, H, S, D)
+    return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape))
+
+
+# ---------------------------------------------------------------------------
+# custom VJP plumbing
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
+    out, _lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                         interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _dense_attention(q, k, v, causal,
-                                                      scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    S = q.shape[2]
+    bq = min(block_q, S)
+    bk = min(block_k, S)
+    if S != k.shape[2] or S % bq or S % bk:
+        # ragged tail / rectangular: dense VJP (matches the forward's
+        # own fallback)
+        _, vjp = jax.vjp(
+            lambda q, k, v: _dense_attention(q, k, v, causal, scale),
+            q, k, v)
+        return vjp(g)
+    return _flash_backward(q, k, v, out, lse, g, causal, scale,
+                           block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, force_pallas: bool = False):
-    """Flash attention over ``[B, H, S, D]`` tensors.
+                    scale: Optional[float] = None, block_q: int = 512,
+                    block_k: int = 1024, force_pallas: bool = False):
+    """Flash attention over ``[B, H, S, D]`` tensors — differentiable:
+    the backward runs the pallas dQ / dK+dV kernels with blockwise
+    probability recomputation from the saved logsumexp (O(S·D) training
+    memory; no S×S matrix in HBM in either direction).
 
-    Uses the pallas kernel on TPU backends (or when ``force_pallas`` —
+    Uses the pallas kernels on TPU backends (or when ``force_pallas`` —
     interpret mode — is requested, e.g. in tests); dense math elsewhere.
+
+    Block defaults are tuned on v5e (b4 h16 d64, causal, fwd+bwd):
+    512x1024 blocks turn the 128x128 default's 0.6-0.9x vs XLA dense
+    into 1.0-2.3x FASTER (S=512..4096), and at S=8192/16384 flash
+    trains in 68/190 ms/step where the dense lowering does not compile
+    at all. Blocks auto-cap to S for short sequences.
     """
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5

@@ -37,6 +37,7 @@ class RoundRobin:
 
 
 from .geo_sgd_transpiler import GeoSgdTranspiler  # noqa: F401
+from .fl_transpiler import FlDistributeTranspiler  # noqa: F401
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,

@@ -1,0 +1,132 @@
+"""Federated-learning round protocol: fl_listen_and_serv + the FL
+transpiler (reference distributed_ops/fl_listen_and_serv_op.cc +
+tests/unittests/test_fl_listen_and_serv_op.py — recv globals, train
+locally, send params, server FedAvg-means)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler import FlDistributeTranspiler
+
+
+def _model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="fl_w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+class TestFederatedRound:
+    def test_fedavg_round(self):
+        from paddle_tpu.ops.distributed_ops import reset_emulated_servers
+
+        reset_emulated_servers()
+        main, startup, loss = _model()
+        t = FlDistributeTranspiler()
+        t.transpile(trainer_id=0, program=main, startup_program=startup,
+                    pservers="fl0:6174", trainers=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        server_scope = fluid.Scope()
+        with fluid.scope_guard(server_scope):
+            psprog = t.get_pserver_program("fl0:6174")
+            exe.run(t.get_startup_program("fl0:6174", psprog))
+            exe.run(psprog)
+            w0 = np.asarray(server_scope.find_var("fl_w").raw().array).copy()
+
+        rng = np.random.RandomState(0)
+        W = rng.randn(4, 1).astype("float32")
+        trained = []
+        scopes = [fluid.Scope(), fluid.Scope()]
+        for tid, scope in enumerate(scopes):
+            os.environ["PADDLE_TRAINER_ID"] = str(tid)
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                # ROUND: recv globals -> local steps -> send params
+                exe.run(t.get_trainer_recv_program())
+                got = np.asarray(scope.find_var("fl_w").raw().array)
+                np.testing.assert_allclose(got, w0, rtol=1e-6)
+                for _ in range(5):
+                    xb = rng.randn(8, 4).astype("float32")
+                    exe.run(main, feed={"x": xb, "y": xb @ W},
+                            fetch_list=[loss])
+                trained.append(np.asarray(
+                    scope.find_var("fl_w").raw().array).copy())
+                exe.run(t.get_trainer_send_program())
+
+        # after BOTH trainers sent, the server holds the FedAvg mean
+        with fluid.scope_guard(server_scope):
+            merged = np.asarray(server_scope.find_var("fl_w").raw().array)
+        np.testing.assert_allclose(
+            merged, (trained[0] + trained[1]) / 2.0, rtol=1e-5)
+        assert not np.allclose(merged, w0)  # training moved the params
+
+        # next round's recv returns the averaged globals
+        with fluid.scope_guard(scopes[0]):
+            exe.run(t.get_trainer_recv_program())
+            got = np.asarray(scopes[0].find_var("fl_w").raw().array)
+        np.testing.assert_allclose(got, merged, rtol=1e-6)
+
+    def test_partial_fanin_does_not_publish(self):
+        from paddle_tpu.ops.distributed_ops import reset_emulated_servers
+
+        reset_emulated_servers()
+        main, startup, loss = _model()
+        t = FlDistributeTranspiler()
+        t.transpile(trainer_id=0, program=main, startup_program=startup,
+                    pservers="fl1:6174", trainers=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        server_scope = fluid.Scope()
+        with fluid.scope_guard(server_scope):
+            psprog = t.get_pserver_program("fl1:6174")
+            exe.run(t.get_startup_program("fl1:6174", psprog))
+            exe.run(psprog)
+            w0 = np.asarray(server_scope.find_var("fl_w").raw().array).copy()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(t.get_trainer_recv_program())
+            rng = np.random.RandomState(1)
+            xb = rng.randn(8, 4).astype("float32")
+            exe.run(main, feed={"x": xb, "y": xb @ np.ones((4, 1),
+                                                          "float32")},
+                    fetch_list=[loss])
+            exe.run(t.get_trainer_send_program())  # only 1 of Fanin=2
+        with fluid.scope_guard(server_scope):
+            w_now = np.asarray(server_scope.find_var("fl_w").raw().array)
+        np.testing.assert_allclose(w_now, w0)  # round incomplete
+
+    def test_duplicate_send_replaces_not_crowds(self):
+        """A trainer re-sending (retry / next round while a peer lags)
+        must REPLACE its own contribution, never satisfy Fanin alone."""
+        from paddle_tpu.ops.distributed_ops import reset_emulated_servers
+
+        reset_emulated_servers()
+        main, startup, loss = _model()
+        t = FlDistributeTranspiler()
+        t.transpile(trainer_id=0, program=main, startup_program=startup,
+                    pservers="fl2:6174", trainers=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        server_scope = fluid.Scope()
+        with fluid.scope_guard(server_scope):
+            psprog = t.get_pserver_program("fl2:6174")
+            exe.run(t.get_startup_program("fl2:6174", psprog))
+            exe.run(psprog)
+            w0 = np.asarray(
+                server_scope.find_var("fl_w").raw().array).copy()
+        scope = fluid.Scope()
+        os.environ["PADDLE_TRAINER_ID"] = "0"
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(t.get_trainer_recv_program())
+            exe.run(t.get_trainer_send_program())
+            exe.run(t.get_trainer_send_program())  # duplicate
+        with fluid.scope_guard(server_scope):
+            w_now = np.asarray(
+                server_scope.find_var("fl_w").raw().array)
+        np.testing.assert_allclose(w_now, w0)  # still waiting for peer

@@ -277,6 +277,33 @@ class TestOpTail:
         assert (p, r, f) == (0.5, 0.5, 0.5)
         assert (ni, nl, nc) == (2, 2, 1)
 
+    def test_chunk_eval_dense_with_seq_length(self):
+        """Dense [B, T] inputs truncate per-row at SeqLength
+        (reference chunk_eval_op.h:181) — padding must not count."""
+        # row 0 (len 2): infer B-0 I-0 | label B-0 I-0 -> 1 correct
+        # row 1 (len 1): infer B-1     | label B-0     -> 0 correct
+        # padding (6 = Other) would create spurious chunks if counted
+        infer = np.array([[0, 1, 6], [2, 6, 6]], "int64")
+        label = np.array([[0, 1, 6], [0, 6, 6]], "int64")
+        outs = _run_single_op(
+            "chunk_eval",
+            {"Inference": infer, "Label": label,
+             "SeqLength": np.array([2, 1], "int64")},
+            {"Precision": ["p"], "Recall": ["r"], "F1-Score": ["f"],
+             "NumInferChunks": ["ni"], "NumLabelChunks": ["nl"],
+             "NumCorrectChunks": ["nc"]},
+            {"num_chunk_types": 3, "chunk_scheme": "IOB",
+             "excluded_chunk_types": []},
+            ["ni", "nl", "nc"])
+        ni, nl, nc = [int(o.reshape(-1)[0]) for o in outs]
+        assert (ni, nl, nc) == (2, 2, 1)
+
+    def test_weighted_average_elementwise(self):
+        wa = fluid.average.WeightedAverage()
+        wa.add(np.array([1.0, 3.0]), weight=1)
+        wa.add(np.array([3.0, 5.0]), weight=1)
+        np.testing.assert_allclose(wa.eval(), [2.0, 4.0])
+
     def test_positive_negative_pair(self):
         outs = _run_single_op(
             "positive_negative_pair",
@@ -324,9 +351,6 @@ class TestOpTail:
 
         x = LoDTensor(np.array([[2], [1], [3], [1], [5]], "int64"))
         x.set_lod([[0, 1, 2], [0, 3, 5]])  # 2 level-0 groups
-        (o,) = _run_single_op("sequence_erase", {"X": x},
-                              {"Out": ["out"]}, {"tokens": [1]}, ["out"])
-        # helper returns arrays; re-run via program for the LoD
         prog, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(prog, startup):
             xv = fluid.layers.data("x", shape=[1], dtype="int64",
