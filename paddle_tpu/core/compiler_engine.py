@@ -21,6 +21,7 @@ for (SURVEY.md §7 step 3).
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, List, Sequence, Set, Tuple
 
 import numpy as np
@@ -29,7 +30,25 @@ from .registry import BOUND_OUTPUTS_ATTR, RNG_SEED_ATTR, OpInfoMap
 from .scope import Scope
 from .tensor import LoDTensor
 
-_cache: Dict = {}
+# compiled step functions (XLA executables — the heaviest objects in
+# the process): LRU-bounded so program-churning workloads (e.g. a
+# @declarative fn fed fresh signatures forever) can't grow without
+# limit; an evicted program just recompiles on next run
+_cache: "OrderedDict" = OrderedDict()
+_CACHE_CAP = 128
+
+
+def _lru_get(cache, key):
+    hit = cache.get(key)
+    if hit is not None:
+        cache.move_to_end(key)
+    return hit
+
+
+def _lru_put(cache, key, value, cap):
+    cache[key] = value
+    while len(cache) > cap:
+        cache.popitem(last=False)
 
 
 def _program_version(program) -> Tuple:
@@ -37,7 +56,8 @@ def _program_version(program) -> Tuple:
             tuple(len(b.ops) for b in program.blocks))
 
 
-_analysis_cache: Dict = {}
+_analysis_cache: "OrderedDict" = OrderedDict()
+_ANALYSIS_CAP = 1024
 
 
 _block_rw_cache: "weakref.WeakKeyDictionary" = None  # set below
@@ -93,7 +113,7 @@ def _analyze(program):
     Cached per program version — a full-program scan per step is real
     overhead on 1000-op programs."""
     key = _program_version(program)
-    hit = _analysis_cache.get(key)
+    hit = _lru_get(_analysis_cache, key)
     if hit is not None:
         return hit
     written, read_first = _block_rw(program.global_block())
@@ -104,7 +124,7 @@ def _analyze(program):
         n for n in written
         if (v := block._find_var_recursive(n)) is not None and v.persistable)
     result = (read_first, written, persist_written)
-    _analysis_cache[key] = result
+    _lru_put(_analysis_cache, key, result, _ANALYSIS_CAP)
     return result
 
 
@@ -455,7 +475,7 @@ def compile_program(program, feed_names: Tuple[str, ...],
 
     key = (_program_version(program), feed_names, fetch_names, state_names,
            out_state_names)
-    fn = _cache.get(key)
+    fn = _lru_get(_cache, key)
     if fn is not None:
         return fn
 
@@ -470,7 +490,7 @@ def compile_program(program, feed_names: Tuple[str, ...],
         return fetches, new_state
 
     fn = jax.jit(step, donate_argnums=(0,) if donate else ())
-    _cache[key] = fn
+    _lru_put(_cache, key, fn, _CACHE_CAP)
     return fn
 
 

@@ -31,6 +31,10 @@ _DEFS = {
     "FLAGS_selected_gpus": ("", "visible devices (use JAX platform env)"),
     "FLAGS_enable_parallel_graph": (False, "executor choice (no-op)"),
     "FLAGS_max_inplace_grad_add": (0, "grad-add inplace (no-op)"),
+    "FLAGS_use_pallas_conv": ("off", "route NHWC convs to the pallas "
+                              "implicit-GEMM kernel: off | auto (only "
+                              "the measured-win shape class: expansion "
+                              "1x1) | all (every viable shape)"),
 }
 
 _values: Dict[str, object] = {}

@@ -30,6 +30,8 @@ import inspect
 import textwrap
 from typing import List, Set
 
+import numpy as np
+
 __all__ = [
     "ast_to_static_func",
     "convert_ifelse",
@@ -114,7 +116,17 @@ def _merge_branch_outputs(pred, t_out, f_out):
                     "branch of a tensor-condition `if`; assign it before "
                     "the `if` so both branches have a value"
                     % (t.name if isinstance(t, Undefined) else f.name))
-            if t == f:
+            # equality merge, array-safe: bare `bool(t == f)` on numpy
+            # arrays raises ambiguity — use array_equal there; any
+            # other type keeps plain `==` (lists, tuples, np scalars)
+            if isinstance(t, np.ndarray) or isinstance(f, np.ndarray):
+                equal = (type(t) is type(f)) and np.array_equal(t, f)
+            else:
+                try:
+                    equal = bool(t == f)
+                except Exception:
+                    equal = False
+            if equal:
                 merged.append(t)
                 continue
             scalar = (bool, int, float)
@@ -642,7 +654,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             # limitation — same contract as jax.jit)
             return node
         uid = self._uid()
-        modified = sorted(_assigned(node.body) | _assigned(node.orelse))
+        # exclude synthetic _jst_* temporaries (from nested transformed
+        # ifs) — they are dead after their converted statement and must
+        # not cross the branch merge (mirrors visit_While's filter)
+        modified = sorted(n for n in
+                          (_assigned(node.body) | _assigned(node.orelse))
+                          if not n.startswith("_jst_"))
         pred_name = "_jst_pred_%d" % uid
         true_name = "_jst_true_%d" % uid
         false_name = "_jst_false_%d" % uid

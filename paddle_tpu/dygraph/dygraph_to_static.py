@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import warnings
+from collections import OrderedDict
 from typing import Dict
 
 import numpy as np
@@ -57,11 +58,12 @@ class _TracedFunction:
 
         self._fn = fn
         self._static_fn, self._ast_ok = ast_to_static_func(fn)
-        self._cache: Dict = {}  # signature -> entry dict
+        # LRU: signature -> entry dict. Bounded so a long-lived process
+        # feeding fresh object-keyed args doesn't grow programs (and
+        # their pinned args) without limit.
+        self._cache: "OrderedDict" = OrderedDict()
+        self._cache_cap = 64
         self._staged: Dict = {}  # param name -> id(array) staged in scope
-        # strong refs to object-keyed args: an id() in a signature must
-        # not be recycled by a later allocation (false cache hit)
-        self._keepalive: list = []
 
     def __get__(self, obj, objtype=None):
         """Descriptor protocol: @declarative on a method binds self."""
@@ -73,22 +75,54 @@ class _TracedFunction:
         return bound
 
     def _signature(self, args):
+        """Returns (signature, pinned) — ``pinned`` holds the
+        identity-keyed objects whose id() appears in the signature;
+        they are stored on the cache entry so the ids stay valid for
+        exactly as long as the entry lives (LRU-bounded, no process-
+        lifetime leak)."""
         sig = []
+        pinned = []
         for a in args:
             arr = _as_array(a)
             if arr is None:
                 if isinstance(a, (int, float, str, bool, type(None))):
                     sig.append(("py", type(a).__name__, a))
                 else:
-                    # identity-keyed: pin the object so its address is
-                    # never recycled into a false cache hit (mutating
-                    # the object still reuses the stale program — the
-                    # reference's InputSpec caveat)
-                    self._keepalive.append(a)
+                    # identity-keyed: pin the object on the entry so
+                    # its address is never recycled into a false cache
+                    # hit (mutating the object still reuses the stale
+                    # program — the reference's InputSpec caveat)
+                    pinned.append(a)
                     sig.append(("py", type(a).__name__, id(a)))
             else:
                 sig.append((tuple(arr.shape), str(arr.dtype)))
-        return tuple(sig)
+        return tuple(sig), pinned
+
+    def _cache_lookup(self, args):
+        """LRU get-or-build for the (signature -> entry) program cache."""
+        sig, pinned = self._signature(args)
+        entry = self._cache.get(sig)
+        if entry is not None:
+            self._cache.move_to_end(sig)
+            return entry
+        entry = self._build_entry(args)
+        entry["pins"] = pinned
+        self._cache[sig] = entry
+        if len(self._cache) > self._cache_cap:
+            # evict least-recent entries WITHOUT parameters only:
+            # a static entry that ran its startup (or a trace entry
+            # holding params) must not be silently re-initialized with
+            # fresh weights on a later rebuild
+            for k in list(self._cache):
+                if len(self._cache) <= self._cache_cap:
+                    break
+                e = self._cache[k]
+                holds_params = (e.get("params") or
+                                (e.get("kind") == "static" and
+                                 e["startup"].global_block().ops))
+                if e is not entry and not holds_params:
+                    del self._cache[k]
+        return entry
 
     # -- AST/static path ---------------------------------------------------
 
@@ -198,11 +232,7 @@ class _TracedFunction:
     def __call__(self, *args):
         if not ProgramTranslator().enabled:
             return self._fn(*args)
-        sig = self._signature(args)
-        entry = self._cache.get(sig)
-        if entry is None:
-            entry = self._build_entry(args)
-            self._cache[sig] = entry
+        entry = self._cache_lookup(args)
 
         import paddle_tpu as fluid
 
@@ -236,12 +266,7 @@ class _TracedFunction:
         return result[0] if entry["single"] else result
 
     def get_program(self, *args):
-        sig = self._signature(args)
-        entry = self._cache.get(sig)
-        if entry is None:
-            entry = self._build_entry(args)
-            self._cache[sig] = entry
-        return entry["program"]
+        return self._cache_lookup(args)["program"]
 
 
 _executor = None

@@ -34,6 +34,7 @@ within their mutual rounding.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional
 
 import jax
@@ -386,6 +387,20 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _fit_block(S, block):
+    """Largest divisor of ``S`` that is <= ``block`` and lane-aligned
+    (a multiple of 128, or ``S`` itself when S < block). Returns 0 when
+    no aligned divisor exists (caller falls back to dense)."""
+    b = min(block, S)
+    if S % b == 0:
+        return b
+    align = 128 if b >= 128 else 8  # lane / sublane tile alignment
+    for cand in range((b // align) * align, align - 1, -align):
+        if S % cand == 0:
+            return cand
+    return 0
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 512,
                     block_k: int = 1024, force_pallas: bool = False):
@@ -405,6 +420,18 @@ def flash_attention(q, k, v, causal: bool = False,
     """
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
+    S, S_kv = q.shape[2], k.shape[2]
+    if S == S_kv:
+        # S not a multiple of the tuned blocks (e.g. 2560 % 1024):
+        # shrink to the largest aligned divisor rather than silently
+        # dropping to the dense O(S^2) path
+        bq, bk = _fit_block(S, block_q), _fit_block(S, block_k)
+        if bq and bk:
+            block_q, block_k = bq, bk
+        else:
+            warnings.warn(
+                "flash_attention: seq_len %d has no 128-aligned block "
+                "divisor; using dense O(S^2) attention" % S)
     backend = jax.default_backend()
     if backend == "tpu":
         return _flash(q, k, v, causal, scale, block_q, block_k, False)

@@ -340,3 +340,129 @@ class TestTraceFallback:
                 out = model(fluid.dygraph.to_variable(
                     _f32(np.ones((1, 4)))))
             assert np.asarray(out.numpy()).shape in ((), (1,))
+
+
+class TestNestedIf:
+    """Advisor r4 (high): visit_If leaked synthetic _jst_pred_N
+    temporaries into the branch-merge set, breaking any `if` nested
+    inside a tensor-condition `if` branch."""
+
+    def test_tensor_if_nested_in_tensor_if(self):
+        @declarative
+        def f(x):
+            if fluid.layers.reduce_sum(x) > 0:
+                if fluid.layers.reduce_sum(x) > 10.0:
+                    y = x * 3.0
+                else:
+                    y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # no silent trace fallback
+            hi = f(_f32([6.0, 6.0]))       # sum=12 -> inner true
+            mid = f(_f32([1.0, 1.0]))      # sum=2  -> inner false
+            neg = f(_f32([-1.0, -1.0]))    # outer false
+        assert np.allclose(hi.numpy(), 18.0)
+        assert np.allclose(mid.numpy(), 2.0)
+        assert np.allclose(neg.numpy(), -2.0)
+        assert len(f._cache) == 1, "one program must serve all paths"
+
+    def test_python_if_nested_in_tensor_if(self):
+        """A Python-condition `if` inside a tensor-`if` branch must not
+        raise about a '_jst_pred' temporary (it did, as a hard
+        Dy2StaticError on valid code)."""
+        @declarative
+        def f(x):
+            k = 2.0
+            if fluid.layers.reduce_sum(x) > 0:
+                if k > 1.0:
+                    y = x * k
+                else:
+                    y = x
+            else:
+                y = x - 1.0
+            return y
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            pos = f(_f32([3.0]))
+            neg = f(_f32([-3.0]))
+        assert np.allclose(pos.numpy(), 6.0)
+        assert np.allclose(neg.numpy(), -4.0)
+
+    def test_equal_numpy_arrays_in_branches_merge(self):
+        """Advisor r4 (low): both branches assigning equal numpy arrays
+        used to crash with 'truth value of an array is ambiguous';
+        equal arrays now merge and the program still compiles."""
+        @declarative
+        def f(x):
+            if fluid.layers.reduce_sum(x) > 0:
+                c = np.ones(2, dtype=np.float32)
+                y = x + 1.0
+            else:
+                c = np.ones(2, dtype=np.float32)
+                y = x - 1.0
+            return y
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # no trace fallback
+            out = f(_f32([2.0]))
+            out2 = f(_f32([-2.0]))
+        assert np.allclose(out.numpy(), 3.0)
+        assert np.allclose(out2.numpy(), -3.0)
+
+    def test_differing_nontensor_branch_values_diagnose(self):
+        """Differing non-mergeable branch values must still raise the
+        clean Dy2StaticError diagnostic (not an ambiguity crash)."""
+        from paddle_tpu.dygraph.ast_transform import Dy2StaticError
+
+        @declarative
+        def f(x):
+            if fluid.layers.reduce_sum(x) > 0:
+                c = np.ones(2, dtype=np.float32)
+            else:
+                c = np.zeros(2, dtype=np.float32)
+            return x
+
+        with pytest.raises(Dy2StaticError, match="differ between"):
+            f(_f32([2.0]))
+
+
+class TestProgramCacheBound:
+    def test_cache_is_lru_bounded(self):
+        """Advisor r4 (low): identity-keyed args must not grow the
+        program cache (and its pinned objects) without bound."""
+        @declarative
+        def f(x, cfg):
+            return x * 2.0
+
+        f._cache_cap = 3
+        objs = [object() for _ in range(6)]
+        for o in objs:
+            out = f(_f32([1.0]), o)
+            assert np.allclose(out.numpy(), 2.0)
+        assert len(f._cache) <= 3
+        pinned = [p for e in f._cache.values() for p in e.get("pins", [])]
+        assert len(pinned) <= 3, "evicted entries must drop their pins"
+
+    def test_equal_lists_and_np_scalars_merge(self):
+        """Equality merge must keep working for non-ndarray types the
+        old `==` handled (lists, np scalars) — review r5."""
+        @declarative
+        def f(x):
+            if fluid.layers.reduce_sum(x) > 0:
+                c = [1, 2]
+                d = np.float32(0.5)
+                y = x + 1.0
+            else:
+                c = [1, 2]
+                d = np.float32(0.5)
+                y = x - 1.0
+            return y
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert np.allclose(f(_f32([2.0])).numpy(), 3.0)
+            assert np.allclose(f(_f32([-2.0])).numpy(), -3.0)

@@ -174,3 +174,35 @@ def test_masked_path_still_dense():
     types = [op.type for op in prog.global_block().ops]
     assert "flash_attention" not in types
     assert "softmax" in types
+
+
+def test_fit_block_shrinks_to_aligned_divisor():
+    """S not a multiple of the tuned block must shrink the block, not
+    silently drop to dense (advisor r4): 2560 with the 512/1024
+    defaults stays on the flash path via 640-wide K blocks."""
+    from paddle_tpu.ops.pallas.flash_attention import _fit_block
+
+    assert _fit_block(2560, 512) == 512     # already divides
+    assert _fit_block(2560, 1024) == 640    # largest 128-aligned divisor
+    assert _fit_block(2688, 1024) == 896
+    assert _fit_block(768, 512) == 384
+    assert _fit_block(640, 512) == 128
+    assert _fit_block(100, 512) == 100      # short seq: block = S
+    assert _fit_block(200, 512) == 200
+    assert _fit_block(48, 32) == 24         # sub-128: 8-aligned
+    # no aligned divisor below the cap -> 0 (caller goes dense, warns)
+    assert _fit_block(770, 512) == 0
+
+
+def test_nonmultiple_seq_still_flash():
+    """S=48 with block 32 previously fell back to dense silently; the
+    fitted 16-wide block must keep the pallas path and stay exact."""
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(1, 2, 48, 8).astype("float32"))
+    k = jnp.asarray(rng.randn(1, 2, 48, 8).astype("float32"))
+    v = jnp.asarray(rng.randn(1, 2, 48, 8).astype("float32"))
+    ref = _dense_attention(q, k, v, False, 8.0 ** -0.5)
+    got = flash_attention(q, k, v, block_q=32, block_k=32,
+                          force_pallas=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -1,0 +1,107 @@
+"""Pallas implicit-GEMM conv vs XLA conv on ResNet-50 hot shapes.
+
+The round-4 verdict's #1 ask: apply the flash-attention blocking lesson
+to the conv stack and measure back-to-back (BASELINE.md gets the table,
+win or lose). Run on the real chip:  python tools/conv_experiment.py
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddle_tpu.ops.pallas.conv import conv2d_bn_act
+
+# (H, Cin, Cout, K, stride, pad) — ResNet-50 b128 bottleneck mix
+SHAPES = [
+    (56, 64, 64, 1, 1, 0),
+    (56, 64, 64, 3, 1, 1),
+    (56, 64, 256, 1, 1, 0),
+    (56, 256, 64, 1, 1, 0),
+    (28, 128, 128, 3, 1, 1),
+    (28, 512, 128, 1, 1, 0),
+    (28, 128, 512, 1, 1, 0),
+    (14, 256, 256, 3, 1, 1),
+    (14, 1024, 256, 1, 1, 0),
+    (14, 256, 1024, 1, 1, 0),
+    (7, 512, 512, 3, 1, 1),
+    (7, 2048, 512, 1, 1, 0),
+    (7, 512, 2048, 1, 1, 0),
+    (56, 256, 128, 1, 2, 0),   # stage-3 downsample 1x1
+    (28, 128, 128, 3, 2, 1),   # stage-3 first 3x3
+]
+
+
+def xla_conv(x, w, sc, sh, stride, pad, relu=True):
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    o = lax.conv_general_dilated(
+        x, w, (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=dn)
+    o = o.astype(jnp.float32) * sc + sh
+    if relu:
+        o = jnp.maximum(o, 0.0)
+    return o.astype(x.dtype)
+
+
+def timeit(fn, x, iters=30):
+    @jax.jit
+    def loop(x):
+        def body(i, carry):
+            s, = carry
+            o = fn(x * (1.0 + 0.0 * s).astype(x.dtype))
+            return (o.astype(jnp.float32).ravel()[0],)
+        return lax.fori_loop(0, iters, body, (jnp.float32(0.0),))
+
+    r = loop(x)
+    float(r[0])                     # compile + warm
+    best = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter()
+        r = loop(x)
+        float(r[0])                 # hard d2h sync
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main(batch=128, dtype=jnp.bfloat16):
+    rng = np.random.RandomState(0)
+    print("dev", jax.devices())
+    rows = []
+    for (H, Cin, Cout, K, s, p) in SHAPES:
+        x = jnp.asarray(rng.randn(batch, H, H, Cin), dtype)
+        w = jnp.asarray(rng.randn(K, K, Cin, Cout) * 0.05, dtype)
+        sc = jnp.asarray(rng.rand(Cout) + 0.5, jnp.float32)
+        sh = jnp.asarray(rng.randn(Cout), jnp.float32)
+
+        t_xla = timeit(lambda x: xla_conv(x, w, sc, sh, s, p), x)
+        try:
+            t_pl = timeit(lambda x: conv2d_bn_act(
+                x, w, sc, sh, stride=s, padding=p, relu=True), x)
+        except Exception as e:
+            t_pl = float("nan")
+            print("pallas failed:", type(e).__name__, str(e)[:200])
+        Ho = (H + 2 * p - K) // s + 1
+        gflop = 2.0 * batch * Ho * Ho * K * K * Cin * Cout / 1e9
+        rows.append((H, Cin, Cout, K, s, t_xla * 1e3, t_pl * 1e3,
+                     gflop / t_xla / 1e3, gflop / t_pl / 1e3,
+                     t_xla / t_pl))
+        print("H%3d %4d->%4d k%d s%d | xla %7.3f ms (%6.1f TF/s) | "
+              "pallas %7.3f ms (%6.1f TF/s) | speedup %.2fx"
+              % (H, Cin, Cout, K, s, t_xla * 1e3, gflop / t_xla / 1e3,
+                 t_pl * 1e3, gflop / t_pl / 1e3, t_xla / t_pl))
+    tot_x = sum(r[5] for r in rows)
+    tot_p = sum(r[6] for r in rows)
+    print("TOTAL xla %.3f ms  pallas %.3f ms  speedup %.2fx"
+          % (tot_x, tot_p, tot_x / tot_p))
+
+
+if __name__ == "__main__":
+    main()
