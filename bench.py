@@ -348,16 +348,18 @@ def bench_wide_deep(batch=2048, iters=40):
             "batch": batch, "loss": final_loss, "diag": diag}
 
 
-def bench_dygraph_mlp(batch=256, iters=30):
+def bench_dygraph_mlp(batch=256, iters=30, lazy=False):
     """Eager-mode bench through dygraph/tracer.py (the reference's
     imperative Tracer::TraceOp hot path, imperative/tracer.cc:45) —
     records per-op eager dispatch cost, which whole-program numbers
     hide. Metric: steps/sec (an MLP is ~10 traced ops + backward +
-    optimizer per step)."""
+    optimizer per step). ``lazy=True`` measures the queued-dispatch
+    mode (dygraph/lazy.py): ops flush as ONE cached compiled call per
+    step instead of ~40 tunnel round-trips."""
     import paddle_tpu as fluid
     from paddle_tpu.dygraph import Linear, to_variable
 
-    with fluid.dygraph.guard():
+    with fluid.dygraph.guard(lazy=lazy):
         l1 = Linear(784, 256, act="relu")
         l2 = Linear(256, 256, act="relu")
         l3 = Linear(256, 10)
@@ -389,7 +391,89 @@ def bench_dygraph_mlp(batch=256, iters=30):
     if not np.isfinite(final_loss):
         raise RuntimeError("dygraph mlp diverged: loss=%r" % final_loss)
     return {"steps_per_sec": 1.0 / dt, "examples_per_sec": batch / dt,
-            "step_ms": dt * 1e3, "batch": batch, "loss": final_loss}
+            "step_ms": dt * 1e3, "batch": batch, "loss": final_loss,
+            "dispatch": "lazy" if lazy else "eager"}
+
+
+def bench_dygraph_bert(batch=32, seq_len=128, iters=8, n_layers=12,
+                       d_model=768, n_heads=12, vocab=30522, lazy=True):
+    """Dygraph BERT-base masked-LM step — north-star config 3 measured
+    on the path its label names (BASELINE.md: the reference benches
+    BERT through the imperative Tracer). Eager per-op dispatch through
+    the tunnel is ~10ms/op x ~2000 ops; the lazy queue (dygraph/
+    lazy.py) makes the eager API viable, so that is the recorded
+    number. Metric: tokens/sec."""
+    import paddle_tpu as fluid
+    from paddle_tpu.dygraph import Embedding, LayerNorm, Linear, \
+        to_variable
+
+    head = d_model // n_heads
+    with fluid.dygraph.guard(lazy=lazy):
+        L = fluid.layers
+        emb = Embedding(size=[vocab, d_model])
+        pos = Embedding(size=[seq_len, d_model])
+        blocks = []
+        for _ in range(n_layers):
+            blocks.append({
+                "q": Linear(d_model, d_model),
+                "k": Linear(d_model, d_model),
+                "v": Linear(d_model, d_model),
+                "o": Linear(d_model, d_model),
+                "ln1": LayerNorm(d_model),
+                "f1": Linear(d_model, d_model * 4, act="gelu"),
+                "f2": Linear(d_model * 4, d_model),
+                "ln2": LayerNorm(d_model),
+            })
+        out_proj = Linear(d_model, vocab)
+        params = [p for b in blocks for lyr in b.values()
+                  for p in lyr.parameters()]
+        params += emb.parameters() + pos.parameters() + \
+            out_proj.parameters()
+        opt = fluid.optimizer.AdamOptimizer(1e-4, parameter_list=params)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, vocab, (batch, seq_len)).astype("int64")
+        pids = np.tile(np.arange(seq_len), (batch, 1)).astype("int64")
+        lbl = rng.randint(0, vocab,
+                          (batch * seq_len, 1)).astype("int64")
+
+        def heads_of(t):
+            t = L.reshape(t, [batch, seq_len, n_heads, head])
+            return L.transpose(t, [0, 2, 1, 3])
+
+        def step():
+            x = emb(to_variable(ids)) + pos(to_variable(pids))
+            for b in blocks:
+                q, k, v = heads_of(b["q"](x)), heads_of(b["k"](x)), \
+                    heads_of(b["v"](x))
+                s = L.matmul(q, k, transpose_y=True,
+                             alpha=float(head) ** -0.5)
+                ctx = L.matmul(L.softmax(s), v)
+                ctx = L.reshape(L.transpose(ctx, [0, 2, 1, 3]),
+                                [batch, seq_len, d_model])
+                x = b["ln1"](x + b["o"](ctx))
+                x = b["ln2"](x + b["f2"](b["f1"](x)))
+            logits = L.reshape(out_proj(x), [batch * seq_len, vocab])
+            loss = L.mean(L.softmax_with_cross_entropy(
+                logits, to_variable(lbl)))
+            loss.backward()
+            opt.minimize(loss, parameter_list=params)
+            for p in params:
+                p.clear_gradient()
+            return loss
+
+        for _ in range(2):
+            loss = step()
+        float(np.asarray(loss.numpy()).ravel()[0])  # sync
+        t0 = time.time()
+        for _ in range(iters):
+            loss = step()
+        final_loss = float(np.asarray(loss.numpy()).ravel()[0])
+        dt = (time.time() - t0) / iters
+    if not np.isfinite(final_loss):
+        raise RuntimeError("dygraph bert diverged: loss=%r" % final_loss)
+    return {"tokens_per_sec": batch * seq_len / dt, "step_ms": dt * 1e3,
+            "batch": batch, "seq_len": seq_len, "loss": final_loss,
+            "dispatch": "lazy" if lazy else "eager"}
 
 
 def _enable_compile_cache():
@@ -495,6 +579,10 @@ def _run_one(name, use_bf16):
         print(json.dumps(bench_wide_deep()))
     elif name == "dygraph_mlp":
         print(json.dumps(bench_dygraph_mlp()))
+    elif name == "dygraph_mlp_lazy":
+        print(json.dumps(bench_dygraph_mlp(lazy=True)))
+    elif name == "dygraph_bert":
+        print(json.dumps(bench_dygraph_bert()))
     elif name == "gpt_long":
         print(json.dumps(bench_gpt_long(use_bf16=use_bf16)))
     elif name == "resnet50":
@@ -519,7 +607,8 @@ def _bench_subprocess(name, use_bf16):
         args.append("--no-bf16")
     timeout = {"resnet50": 360, "bert_base": 600, "mnist_mlp": 120,
                "transformer_wmt": 480, "wide_deep": 240,
-               "dygraph_mlp": 240, "gpt_long": 480}.get(name, 60)
+               "dygraph_mlp": 240, "dygraph_mlp_lazy": 240,
+               "dygraph_bert": 600, "gpt_long": 480}.get(name, 60)
     proc = subprocess.run(args, capture_output=True, text=True,
                           timeout=timeout)
     if proc.returncode != 0:
@@ -583,8 +672,8 @@ def main():
         extras["resnet50"] = rn
     # north-star configs 4/5 + the eager path — budget-gated so the
     # headline models always record first
-    for extra_model in ("wide_deep", "dygraph_mlp", "transformer_wmt",
-                        "gpt_long"):
+    for extra_model in ("wide_deep", "dygraph_mlp", "dygraph_mlp_lazy",
+                        "transformer_wmt", "gpt_long", "dygraph_bert"):
         if time.time() - t_start > budget_s:
             extras[extra_model + "_skipped"] = "time budget exhausted"
             continue

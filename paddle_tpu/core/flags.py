@@ -35,6 +35,9 @@ _DEFS = {
                               "implicit-GEMM kernel: off | auto (only "
                               "the measured-win shape class: expansion "
                               "1x1) | all (every viable shape)"),
+    "FLAGS_dygraph_lazy": (False, "queue eager dygraph ops and flush "
+                           "them as one compiled dispatch per step "
+                           "(lazy-tensor mode, dygraph/lazy.py)"),
 }
 
 _values: Dict[str, object] = {}

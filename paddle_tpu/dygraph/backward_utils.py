@@ -91,7 +91,11 @@ def dygraph_minimize(opt, loss, parameter_list=None):
         info = infos.get(op_type)
         attrs = dict(attrs)
         attrs[BOUND_OUTPUTS_ATTR] = tuple(s.name for s in info.outputs)
-        outs = info.fn(ins, attrs)
+        if tracer.lazy_engine is not None:
+            outs = _lazy_opt_op(tracer.lazy_engine, info, op_type, ins,
+                                attrs)
+        else:
+            outs = info.fn(ins, attrs)
         p._array = outs["ParamOut"]
         if "VelocityOut" in outs:
             _get_state(opt, p.name, "velocity", p)._array = outs["VelocityOut"]
@@ -102,4 +106,44 @@ def dygraph_minimize(opt, loss, parameter_list=None):
             _get_state(opt, p.name, "beta2pow", p, shape=(1,))._array = outs["Beta2PowOut"]
         if "MomentOut" in outs:
             _get_state(opt, p.name, "moment", p)._array = outs["MomentOut"]
+    # the optimizer step is the natural flush boundary (torch/XLA's
+    # mark_step): steady-state training becomes one cached dispatch
+    # per step
+    tracer.flush()
     return None, [(p, p._grad) for p in params]
+
+
+def _lazy_opt_op(eng, info, op_type, ins, attrs):
+    """Queue an optimizer op on the LazyEngine (inputs may be pending
+    grads/params); returns {slot: handle}."""
+    import jax
+
+    from .lazy import aval_of as _aval
+
+    names = [k for k in ins if ins[k] is not None]
+    handles = [ins[k] for k in names]
+
+    holder = {}
+
+    def op_fn(vals):
+        m = dict(zip(names, vals))
+        outs = info.fn(m, attrs)
+        slots = holder.setdefault(
+            "slots", [s.name for s in info.outputs if s.name in outs])
+        return tuple(outs[n] for n in slots)
+
+    attrs_sig = repr(sorted((k, v) for k, v in attrs.items()))
+    in_avals = [_aval(h) for h in handles]
+    cache = eng._opt_aval_cache
+    ck = (op_type, attrs_sig, tuple(names),
+          tuple((tuple(a.shape), str(a.dtype)) for a in in_avals))
+    hit = cache.get(ck)
+    if hit is None:
+        out_avals = jax.eval_shape(lambda *vs: op_fn(list(vs)), *in_avals)
+        hit = (list(out_avals), list(holder["slots"]))
+        cache[ck] = hit
+    else:
+        holder["slots"] = list(hit[1])
+    sig = ("opt", op_type, attrs_sig, tuple(names))
+    pend = eng.add_node(op_fn, handles, list(hit[0]), sig)
+    return dict(zip(hit[1], pend))

@@ -21,9 +21,18 @@ def enabled():
     return framework.in_dygraph_mode()
 
 
+def _lazy_default():
+    from ..core.flags import flag
+
+    return str(flag("dygraph_lazy")).lower() in ("1", "true", "yes", "on")
+
+
 @contextlib.contextmanager
-def guard(place=None):
-    tracer = Tracer()
+def guard(place=None, lazy=None):
+    """``lazy=True`` queues eager ops and flushes them as ONE compiled
+    dispatch per step (lazy.py) — the async/batched dispatch mode;
+    default comes from FLAGS_dygraph_lazy."""
+    tracer = Tracer(lazy=_lazy_default() if lazy is None else lazy)
     old_tracer = framework._dygraph_tracer_
     old_place = framework._dygraph_place_
     framework._dygraph_tracer_ = tracer
@@ -32,13 +41,14 @@ def guard(place=None):
     try:
         yield
     finally:
+        tracer.flush()
         framework._dygraph_tracer_ = old_tracer
         framework._dygraph_place_ = old_place
         _set_tracer(old_tracer)
 
 
-def enable_dygraph(place=None):
-    tracer = Tracer()
+def enable_dygraph(place=None, lazy=None):
+    tracer = Tracer(lazy=_lazy_default() if lazy is None else lazy)
     framework._dygraph_tracer_ = tracer
     framework._dygraph_place_ = place
     _set_tracer(tracer)

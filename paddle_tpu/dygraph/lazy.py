@@ -1,0 +1,228 @@
+"""Lazy (queued) eager execution — async/batched dygraph dispatch.
+
+Parity intent: the reference attacks per-op eager overhead with
+generated C++ fast paths (pybind/op_function_generator.cc); on TPU the
+cost is not Python but PER-OP DEVICE DISPATCH — through a remote
+tunnel each eager op is a ~10ms round trip, so a ~40-op training step
+pays ~40 RTTs (BASELINE.md round-4 dygraph row). The TPU-native fix is
+the lazy-tensor pattern (torch/XLA's mark_step): ops queue into a
+graph of LazyNodes; VarBase arrays become PendingValues; a FLUSH
+compiles the queued graph into ONE jitted XLA call (cached by graph
+structure, so steady-state training is one dispatch per step) and
+materializes only values still referenced by live VarBases.
+
+Flush triggers: any host read (``numpy()``/``float``/``__array__``),
+``optimizer.minimize`` (the natural step boundary — like mark_step),
+program recording, or a node-count safety valve.
+
+Enable with ``fluid.dygraph.guard(lazy=True)`` or
+``FLAGS_dygraph_lazy=true``.
+"""
+from __future__ import annotations
+
+import weakref
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PendingValue", "LazyEngine", "is_pending", "aval_of"]
+
+
+def is_pending(x) -> bool:
+    return isinstance(x, PendingValue)
+
+
+def aval_of(h):
+    """jax.ShapeDtypeStruct of a handle (concrete array or pending)."""
+    import jax
+
+    if isinstance(h, PendingValue):
+        return h.aval
+    return jax.ShapeDtypeStruct(np.shape(h), h.dtype)
+
+
+class PendingValue:
+    """Placeholder for a not-yet-computed array. Duck-types the shape/
+    dtype surface so shape-reading code works without forcing; any
+    value read (``__array__``) forces a flush."""
+
+    __slots__ = ("aval", "value", "_resolved", "engine", "_owners",
+                 "__weakref__")
+
+    def __init__(self, aval, engine):
+        self.aval = aval          # jax.ShapeDtypeStruct
+        self.value = None
+        self._resolved = False
+        self.engine = engine
+        self._owners: List = []   # [(weakref(obj), attr or None)]
+
+    # -- shape surface ----------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.aval.shape)
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self.aval.shape:
+            n *= s
+        return n
+
+    # -- ownership (decides what a flush must materialize) ----------------
+    def add_owner(self, obj, attr: Optional[str]):
+        """attr None means "needed while obj is alive" (tape records);
+        otherwise needed while ``getattr(obj, attr) is self``."""
+        self._owners.append((weakref.ref(obj), attr))
+
+    def is_needed(self) -> bool:
+        for ref, attr in self._owners:
+            o = ref()
+            if o is None:
+                continue
+            if attr is None or getattr(o, attr, None) is self:
+                return True
+        return False
+
+    # -- forcing ----------------------------------------------------------
+    def force(self):
+        if not self._resolved:
+            self.engine.flush()
+        if not self._resolved:
+            raise RuntimeError("pending value did not resolve on flush")
+        if self.value is None:
+            raise RuntimeError(
+                "pending value was dead at flush time (no live owner) "
+                "but was read later — please report")
+        return self.value
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.force())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __repr__(self):
+        return "PendingValue(shape=%s, dtype=%s, resolved=%s)" % (
+            self.shape, self.dtype, self._resolved)
+
+
+class _LazyNode:
+    __slots__ = ("fn", "ins", "outs", "sig")
+
+    def __init__(self, fn, ins, outs, sig):
+        self.fn = fn      # list of arrays -> tuple of arrays
+        self.ins = ins    # handles: concrete arrays or PendingValues
+        self.outs = outs  # [PendingValue]
+        self.sig = sig    # structural signature (hashable)
+
+
+class LazyEngine:
+    """Queue of LazyNodes + structure-keyed jit cache."""
+
+    MAX_NODES = 4000      # safety valve: auto-flush beyond this
+    JIT_CACHE_CAP = 64
+
+    def __init__(self):
+        self.nodes: List[_LazyNode] = []
+        self._jit_cache: "OrderedDict" = OrderedDict()
+        self._flushing = False
+        # optimizer-op shape cache (backward_utils._lazy_opt_op)
+        self._opt_aval_cache: Dict = {}
+
+    # -- graph building ---------------------------------------------------
+    def add_node(self, fn, in_handles, out_avals, sig) -> List[PendingValue]:
+        outs = [PendingValue(a, self) for a in out_avals]
+        self.nodes.append(_LazyNode(fn, list(in_handles), outs, sig))
+        if len(self.nodes) >= self.MAX_NODES:
+            self.flush()
+        return outs
+
+    def constant_node(self, make, aval, sig) -> PendingValue:
+        """Zero-input node (ones/zeros seeds etc.)."""
+        return self.add_node(lambda vals: (make(),), [], [aval], sig)[0]
+
+    # -- flush ------------------------------------------------------------
+    def flush(self):
+        if self._flushing or not self.nodes:
+            return
+        self._flushing = True
+        try:
+            self._flush_impl()
+        finally:
+            self._flushing = False
+
+    def _flush_impl(self):
+        import jax
+
+        nodes, self.nodes = self.nodes, []
+        pos: Dict[int, Tuple[int, int]] = {}
+        for ni, nd in enumerate(nodes):
+            for oj, p in enumerate(nd.outs):
+                pos[id(p)] = (ni, oj)
+
+        ext: List = []
+        ext_ids: Dict[int, int] = {}
+        wiring: List[Tuple] = []
+        sig_parts: List = []
+        for nd in nodes:
+            w = []
+            for h in nd.ins:
+                if isinstance(h, PendingValue) and not h._resolved:
+                    # unresolved ⇒ produced in THIS batch (every prior
+                    # flush resolves all of its pendings)
+                    w.append(("n",) + pos[id(h)])
+                    continue
+                if isinstance(h, PendingValue):
+                    h = h.force()   # raises if dead-at-flush
+                k = ext_ids.get(id(h))
+                if k is None:
+                    k = len(ext)
+                    ext_ids[id(h)] = k
+                    ext.append(h)
+                w.append(("e", k))
+            wiring.append(tuple(w))
+            sig_parts.append((nd.sig, tuple(w)))
+
+        needed = tuple(sorted(
+            pos[id(p)]
+            for nd in nodes for p in nd.outs if p.is_needed()))
+        ext_avals = tuple(
+            (tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
+            for a in ext)
+        key = (tuple(sig_parts), needed, ext_avals)
+
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            self._jit_cache.move_to_end(key)
+        else:
+            node_fns = tuple(nd.fn for nd in nodes)
+            wiring_t = tuple(wiring)
+            needed_t = needed
+
+            def replay(ext_vals):
+                results: List = []
+                for nf, w in zip(node_fns, wiring_t):
+                    vals = [ext_vals[e[1]] if e[0] == "e"
+                            else results[e[1]][e[2]] for e in w]
+                    results.append(nf(vals))
+                return tuple(results[ni][oj] for (ni, oj) in needed_t)
+
+            fn = jax.jit(replay)
+            self._jit_cache[key] = fn
+            while len(self._jit_cache) > self.JIT_CACHE_CAP:
+                self._jit_cache.popitem(last=False)
+
+        out_vals = fn(ext)
+        by_pos = dict(zip(needed, out_vals))
+        for ni, nd in enumerate(nodes):
+            for oj, p in enumerate(nd.outs):
+                p.value = by_pos.get((ni, oj))
+                p._resolved = True
+                p._owners = []

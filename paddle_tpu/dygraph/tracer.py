@@ -36,9 +36,11 @@ def _set_tracer(t):
 
 
 class TapeRecord:
-    __slots__ = ("op_type", "vjp_fn", "in_vars", "out_vars", "fwd_fn")
+    __slots__ = ("op_type", "vjp_fn", "in_vars", "out_vars", "fwd_fn",
+                 "lazy_vjp", "__weakref__")
 
-    def __init__(self, op_type, vjp_fn, in_vars, out_vars, fwd_fn=None):
+    def __init__(self, op_type, vjp_fn, in_vars, out_vars, fwd_fn=None,
+                 lazy_vjp=None):
         self.op_type = op_type
         self.vjp_fn = vjp_fn  # pullback: (cotangents,) -> input grads
         self.in_vars = in_vars  # [VarBase] aligned with pullback results
@@ -47,6 +49,9 @@ class TapeRecord:
         # re-derive the pullback WITH its primal dependence (the saved
         # vjp_fn treats residuals as constants)
         self.fwd_fn = fwd_fn
+        # lazy mode: (cot_handles) -> [grad PendingValues] — queues a
+        # vjp node on the LazyEngine instead of computing eagerly
+        self.lazy_vjp = lazy_vjp
 
 
 class BasicEngine:
@@ -61,6 +66,8 @@ class BasicEngine:
         tape = self.tracer.tape
         if loss._array is None:
             raise ValueError("backward() on uninitialized VarBase")
+        if self.tracer.lazy_engine is not None:
+            return self._backward_lazy(loss, retain_graph)
         grads: Dict[int, object] = {id(loss): jnp.ones_like(loss._array)}
         alive: Dict[int, VarBase] = {id(loss): loss}
         for rec in reversed(tape):
@@ -85,9 +92,71 @@ class BasicEngine:
         if not retain_graph:
             self.tracer.tape.clear()
 
+    def _backward_lazy(self, loss: VarBase, retain_graph=False):
+        """Same tape walk, but every pullback/accumulation is QUEUED on
+        the LazyEngine (lazy.py) — the whole backward becomes part of
+        the one compiled step."""
+        import jax.numpy as jnp
+
+        eng = self.tracer.lazy_engine
+        tape = self.tracer.tape
+
+        from .lazy import aval_of as _aval_of
+
+        def _ones_like(h):
+            av = _aval_of(h)
+            return eng.constant_node(
+                lambda: jnp.ones(av.shape, av.dtype), av,
+                ("ones", tuple(av.shape), str(av.dtype)))
+
+        def _zeros_like(h):
+            av = _aval_of(h)
+            return eng.constant_node(
+                lambda: jnp.zeros(av.shape, av.dtype), av,
+                ("zeros", tuple(av.shape), str(av.dtype)))
+
+        def _add(a, b):
+            av = _aval_of(a)
+            return eng.add_node(
+                lambda vals: (vals[0] + vals[1],), [a, b], [av],
+                ("grad_add", tuple(av.shape), str(av.dtype)))[0]
+
+        grads: Dict[int, object] = {id(loss): _ones_like(loss._array)}
+        alive: Dict[int, VarBase] = {id(loss): loss}
+        for rec in reversed(tape):
+            if not any(id(ov) in grads for ov in rec.out_vars):
+                continue
+            cots = tuple(
+                grads[id(ov)] if grads.get(id(ov)) is not None
+                else _zeros_like(ov._array)
+                for ov in rec.out_vars)
+            if rec.lazy_vjp is not None:
+                in_grads = rec.lazy_vjp(cots)
+            else:
+                # eager-style record: force cotangents concrete, run
+                # its pullback eagerly
+                from .lazy import is_pending
+
+                cots = tuple(c.force() if is_pending(c) else c
+                             for c in cots)
+                in_grads = rec.vjp_fn(cots)
+            for iv, g in zip(rec.in_vars, in_grads):
+                prev = grads.get(id(iv))
+                grads[id(iv)] = g if prev is None else _add(prev, g)
+                alive[id(iv)] = iv
+        for vid, v in alive.items():
+            if not v.stop_gradient and vid in grads:
+                g = grads[vid]
+                if v._grad is None:
+                    v._grad = g
+                else:
+                    v._grad = _add(v._grad, g)
+        if not retain_graph:
+            self.tracer.tape.clear()
+
 
 class Tracer:
-    def __init__(self):
+    def __init__(self, lazy=False):
         self.tape: List[TapeRecord] = []
         self.engine = BasicEngine(self)
         self._params: Dict[str, ParamBase] = {}
@@ -99,9 +168,23 @@ class Tracer:
         # appended to this Program so jit.save / dygraph_to_static can
         # emit a static graph
         self._recording_program = None
+        # lazy (queued) dispatch: ops queue on a LazyEngine and flush
+        # as ONE compiled call (lazy.py) — ~40 tunnel RTTs/step -> 1
+        self.lazy_engine = None
+        if lazy:
+            from .lazy import LazyEngine
+
+            self.lazy_engine = LazyEngine()
+        # (op_type, attrs_sig, in_avals) -> (out_avals, struct)
+        self._aval_cache: Dict = {}
+
+    def flush(self):
+        if self.lazy_engine is not None:
+            self.lazy_engine.flush()
 
     # -- ProgramDesc recording --------------------------------------------
     def start_program_recording(self, program):
+        self.flush()   # recording runs ops eagerly; settle the queue
         self._recording_program = program
 
     def stop_program_recording(self):
@@ -177,6 +260,10 @@ class Tracer:
         info = OpInfoMap.instance().get(op_type)
         if info.host_fn is not None:
             raise RuntimeError("host op %r is not usable in dygraph" % op_type)
+
+        if self.lazy_engine is not None and self._recording_program is None:
+            return self._trace_op_lazy(info, op_type, inputs, outputs,
+                                       attrs, stop_gradient)
 
         def as_var(v):
             return v if isinstance(v, VarBase) else VarBase(v, stop_gradient=True)
@@ -296,6 +383,210 @@ class Tracer:
             self._record_op(op_type, var_map, result, attrs)
         return result
 
+    def _trace_op_lazy(self, info, op_type, inputs, outputs, attrs,
+                       stop_gradient):
+        """Queue the op on the LazyEngine instead of dispatching it:
+        out-VarBases carry PendingValues; shapes come from a cached
+        jax.eval_shape (host-only, no device round-trip)."""
+        import jax
+        import jax.numpy as jnp
+
+        eng = self.lazy_engine
+
+        def as_var(v):
+            return v if isinstance(v, VarBase) else VarBase(
+                v, stop_gradient=True)
+
+        var_map: Dict[str, object] = {}
+        handles: List[object] = []
+        layout: List[Tuple[str, Optional[int]]] = []  # (slot, n or None)
+        for slot in info.inputs:
+            arg = (inputs or {}).get(slot.name)
+            if arg is None or (isinstance(arg, (list, tuple)) and not arg):
+                var_map[slot.name] = None
+                continue
+            vs = [as_var(a) for a in (arg if isinstance(arg, (list, tuple))
+                                      else [arg])]
+            var_map[slot.name] = vs if slot.duplicable else vs[0]
+            if slot.duplicable:
+                layout.append((slot.name, len(vs)))
+                handles.extend(v._array for v in vs)
+            else:
+                layout.append((slot.name, None))
+                handles.append(vs[0]._array)
+
+        attrs = dict(attrs or {})
+        if outputs:
+            attrs[BOUND_OUTPUTS_ATTR] = tuple(
+                s.name for s in info.outputs if s.name in outputs)
+        else:
+            attrs[BOUND_OUTPUTS_ATTR] = tuple(s.name for s in info.outputs)
+        if info.needs_rng:
+            self._seed_counter += 1
+            seed_val = jnp.uint32(
+                max(int(attrs.get("seed", 0) or 0), 0)
+                or (self._seed_counter & 0xFFFFFFFF))
+            layout.append((RNG_SEED_ATTR, None))
+            handles.append(seed_val)
+            if "is_test" in info.attrs and "is_test" not in attrs:
+                attrs["is_test"] = not self.train_mode
+
+        def rebuild(vals):
+            m = {s.name: None for s in info.inputs}
+            k = 0
+            for name, n in layout:
+                if n is None:
+                    m[name] = vals[k]
+                    k += 1
+                else:
+                    m[name] = list(vals[k:k + n])
+                    k += n
+            return m
+
+        from .lazy import aval_of as _aval
+
+        in_avals = [_aval(h) for h in handles]
+        attrs_sig = repr(sorted(
+            (k, v) for k, v in attrs.items()))
+        # the slot LAYOUT is part of the identity: two dispensable-slot
+        # patterns (e.g. slice with StartsTensor vs EndsTensor) can
+        # have identical avals but bind inputs differently
+        layout_t = tuple(layout)
+        cache_key = (op_type, attrs_sig, layout_t,
+                     tuple((tuple(a.shape), str(a.dtype))
+                           for a in in_avals))
+
+        def op_fn(vals):
+            outs = info.fn(rebuild(vals), attrs)
+            flat = []
+            for s in info.outputs:
+                o = outs.get(s.name)
+                if o is None:
+                    continue
+                flat.extend(o) if s.duplicable else flat.append(o)
+            return tuple(flat)
+
+        cached = self._aval_cache.get(cache_key)
+        if cached is None:
+            holder: List[Tuple[str, int]] = []
+
+            def _probe(*vals):
+                outs = info.fn(rebuild(list(vals)), attrs)
+                flat, struct = [], []
+                for s in info.outputs:
+                    o = outs.get(s.name)
+                    if o is None:
+                        continue
+                    if s.duplicable:
+                        flat.extend(o)
+                        struct.append((s.name, len(o)))
+                    else:
+                        flat.append(o)
+                        struct.append((s.name, 1))
+                holder.clear()
+                holder.extend(struct)
+                return tuple(flat)
+
+            out_shapes = jax.eval_shape(_probe, *in_avals)
+            cached = (list(out_shapes), list(holder))
+            self._aval_cache[cache_key] = cached
+        out_avals, struct = cached
+
+        # differentiable leaves — same eligibility as the eager path
+        wrt_pos: List[int] = []
+        in_vars: List[VarBase] = []
+        if not self._no_grad and not stop_gradient and \
+                info.grad is not None:
+            flat_idx = 0
+            for name, n in layout:
+                if name == RNG_SEED_ATTR:
+                    flat_idx += 1
+                    continue
+                slot = next(s for s in info.inputs if s.name == name)
+                vs = var_map[name]
+                vlist = vs if isinstance(vs, list) else [vs]
+                for v in vlist:
+                    if not slot.no_grad and not v.stop_gradient and \
+                            jnp.issubdtype(np.dtype(_aval(v._array).dtype),
+                                           jnp.floating):
+                        wrt_pos.append(flat_idx)
+                        in_vars.append(v)
+                    flat_idx += 1
+        requires_grad = bool(wrt_pos)
+
+        op_sig = ("op", op_type, attrs_sig, layout_t)
+        pendings = eng.add_node(op_fn, handles, out_avals, op_sig)
+
+        result: Dict[str, List[VarBase]] = {}
+        out_vars_flat: List[VarBase] = []
+        k = 0
+        for slot_name, count in struct:
+            slot = info.output_slot(slot_name)
+            provided = (outputs or {}).get(slot_name)
+            plist = (list(provided) if isinstance(provided, (list, tuple))
+                     else [provided] if provided is not None else [])
+            vs = []
+            for j in range(count):
+                pv = plist[j] if j < len(plist) else None
+                if isinstance(pv, VarBase):
+                    ov = pv
+                    ov._array = pendings[k]
+                    ov.stop_gradient = (not requires_grad) or slot.no_grad
+                else:
+                    ov = VarBase(
+                        None,
+                        stop_gradient=(not requires_grad) or slot.no_grad)
+                    ov._array = pendings[k]
+                k += 1
+                vs.append(ov)
+                out_vars_flat.append(ov)
+            result[slot_name] = vs
+
+        if requires_grad:
+            n_in = len(handles)
+            wrt_t = tuple(wrt_pos)
+
+            def lazy_vjp(cot_handles, _handles=handles, _wrt=wrt_t,
+                         _n=n_in):
+                def vjp_node_fn(vals):
+                    ins, cots = vals[:_n], vals[_n:]
+
+                    def fwd_w(*wvals):
+                        vv = list(ins)
+                        for p, wv in zip(_wrt, wvals):
+                            vv[p] = wv
+                        return op_fn(vv)
+
+                    _, pull = jax.vjp(
+                        fwd_w, *[ins[p] for p in _wrt])
+                    return tuple(pull(tuple(cots)))
+
+                grad_avals = [_aval(_handles[p]) for p in _wrt]
+                return eng.add_node(
+                    vjp_node_fn, list(_handles) + list(cot_handles),
+                    grad_avals,
+                    ("vjp", op_type, attrs_sig, layout_t, _wrt))
+
+            rec = TapeRecord(op_type, None, in_vars, out_vars_flat,
+                             lazy_vjp=lazy_vjp)
+            # pin this record's input pendings: a pre-backward flush
+            # must materialize them for the later eager/vjp use
+            for h in handles:
+                if type(h).__name__ == "PendingValue" and not h._resolved:
+                    h.add_owner(rec, None)
+            self.tape.append(rec)
+        return result
+
+    @staticmethod
+    def _static_index(idx) -> bool:
+        """True when idx is a plain Python index (hashable/reprable) —
+        the kind the lazy queue can carry in a structure signature."""
+        if isinstance(idx, (int, slice, type(None), type(Ellipsis))):
+            return True
+        if isinstance(idx, tuple):
+            return all(Tracer._static_index(i) for i in idx)
+        return False
+
     def trace_getitem(self, var: VarBase, idx):
         import jax
 
@@ -305,11 +596,47 @@ class Tracer:
             raise UnimplementedError(
                 "tensor slicing (__getitem__) inside a program-recorded "
                 "trace is not supported yet — use layers.slice")
+        if self.lazy_engine is not None and self._static_index(idx):
+            return self._trace_getitem_lazy(var, idx)
         fwd = lambda x: (x[idx],)  # noqa: E731
-        out, vjp_fn = jax.vjp(fwd, var._array)
+        out, vjp_fn = jax.vjp(fwd, var._force())
         ov = VarBase(out[0], stop_gradient=False)
         self.tape.append(TapeRecord("getitem", vjp_fn, [var], [ov],
                                     fwd_fn=fwd))
+        return ov
+
+    def _trace_getitem_lazy(self, var: VarBase, idx):
+        """Queue a subscript as a lazy node (a mid-step flush for x[i]
+        would defeat the whole queued-dispatch mode)."""
+        import jax
+
+        from .lazy import aval_of
+
+        eng = self.lazy_engine
+        h = var._array
+        in_aval = aval_of(h)
+        out_aval = jax.eval_shape(lambda x: x[idx], in_aval)
+        sig_idx = repr(idx)
+        (p,) = eng.add_node(lambda vals: (vals[0][idx],), [h],
+                            [out_aval], ("getitem", sig_idx))
+        ov = VarBase(None, stop_gradient=var.stop_gradient)
+        ov._array = p
+        if var.stop_gradient:
+            return ov
+
+        def lazy_vjp(cot_handles, _h=h, _idx=idx, _aval=in_aval):
+            def node_fn(vals):
+                x, ct = vals
+                _, pull = jax.vjp(lambda a: a[_idx], x)
+                return (pull(ct)[0],)
+
+            return eng.add_node(node_fn, [_h, cot_handles[0]], [_aval],
+                                ("getitem_vjp", repr(_idx)))
+
+        rec = TapeRecord("getitem", None, [var], [ov], lazy_vjp=lazy_vjp)
+        if type(h).__name__ == "PendingValue" and not h._resolved:
+            h.add_owner(rec, None)
+        self.tape.append(rec)
         return ov
 
 
@@ -337,6 +664,15 @@ class PartialGradEngine:
         no_grad_ids = {id(v) for v in (no_grad_vars or [])}
         if retain_graph is None:
             retain_graph = create_graph
+        if self.tracer.lazy_engine is not None:
+            if create_graph:
+                raise NotImplementedError(
+                    "dygraph.grad(create_graph=True) needs the eager "
+                    "tracer — use fluid.dygraph.guard(lazy=False) for "
+                    "higher-order gradients")
+            return self._run_lazy(outputs, inputs, grad_outputs,
+                                  retain_graph, allow_unused,
+                                  no_grad_ids)
 
         # grad VarBases keyed by forward var identity
         gvars: Dict[int, VarBase] = {}
@@ -415,6 +751,86 @@ class PartialGradEngine:
         if not retain_graph:
             # reference semantics: the graph is freed after grad() unless
             # retained — otherwise every call leaks taped residuals
+            self.tracer.tape.clear()
+        return results
+
+    def _run_lazy(self, outputs, inputs, grad_outputs, retain_graph,
+                  allow_unused, no_grad_ids):
+        """grad() under lazy dispatch: the tape walk queues vjp nodes
+        (first-order only; results are detached VarBases, matching the
+        eager create_graph=False contract)."""
+        import jax.numpy as jnp
+
+        from .lazy import aval_of, is_pending
+
+        eng = self.tracer.lazy_engine
+
+        def _const(make, aval, kind):
+            return eng.constant_node(make, aval,
+                                     (kind, tuple(aval.shape),
+                                      str(aval.dtype)))
+
+        def _handle_of(v):
+            return v._array
+
+        ghandles: Dict[int, object] = {}
+        for i, o in enumerate(outputs):
+            if grad_outputs is not None and i < len(grad_outputs) \
+                    and grad_outputs[i] is not None:
+                go = grad_outputs[i]
+                ghandles[id(o)] = (go._array if isinstance(go, VarBase)
+                                   else go)
+            else:
+                av = aval_of(o._array)
+                ghandles[id(o)] = _const(
+                    lambda av=av: jnp.ones(av.shape, av.dtype), av,
+                    "ones")
+
+        def _add(a, b):
+            av = aval_of(a)
+            return eng.add_node(lambda vals: (vals[0] + vals[1],),
+                                [a, b], [av],
+                                ("grad_add", tuple(av.shape),
+                                 str(av.dtype)))[0]
+
+        for rec in reversed(list(self.tracer.tape)):
+            if not any(id(ov) in ghandles for ov in rec.out_vars):
+                continue
+            cots = []
+            for ov in rec.out_vars:
+                g = ghandles.get(id(ov))
+                if g is None:
+                    av = aval_of(ov._array)
+                    g = _const(
+                        lambda av=av: jnp.zeros(av.shape, av.dtype),
+                        av, "zeros")
+                cots.append(g)
+            if rec.lazy_vjp is not None:
+                in_grads = rec.lazy_vjp(tuple(cots))
+            else:
+                cc = tuple(c.force() if is_pending(c) else c
+                           for c in cots)
+                in_grads = rec.vjp_fn(cc)
+            for iv, g in zip(rec.in_vars, in_grads):
+                if id(iv) in no_grad_ids:
+                    continue
+                prev = ghandles.get(id(iv))
+                ghandles[id(iv)] = g if prev is None else _add(prev, g)
+
+        results = []
+        for v in inputs:
+            h = ghandles.get(id(v))
+            if h is None:
+                if not allow_unused:
+                    raise ValueError(
+                        "one of the inputs is unreachable from outputs; "
+                        "pass allow_unused=True to get None for it")
+                results.append(None)
+                continue
+            gv = VarBase(None, stop_gradient=True)
+            gv._array = h
+            results.append(gv)
+        if not retain_graph:
             self.tracer.tape.clear()
         return results
 

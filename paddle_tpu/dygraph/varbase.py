@@ -28,20 +28,67 @@ class VarBase:
             if dtype is not None:
                 value = value.astype(_dt.to_numpy_dtype(dtype))
             value = jnp.asarray(value)
+        self._arr_raw = None
+        self._grad_raw = None
         self._array = value
         self.name = name or unique_name.generate("generated_var")
         self.stop_gradient = stop_gradient
         self.persistable = persistable
         self._grad_node = None  # tape record that produced this var
-        self._grad: Optional[object] = None  # accumulated gradient array
+
+    # -- lazy-aware storage ------------------------------------------------
+    # `_array` / `_grad` may hold a PendingValue under lazy dygraph
+    # (lazy.py): the setters register this VarBase as an owner (so a
+    # flush knows the value must materialize) and the getters swap a
+    # resolved pending for its concrete array. Shape/dtype reads work
+    # on pendings without forcing.
+    @property
+    def _array(self):
+        a = self._arr_raw
+        if a is not None and type(a).__name__ == "PendingValue" \
+                and a._resolved:
+            a = a.value
+            self._arr_raw = a
+        return a
+
+    @_array.setter
+    def _array(self, v):
+        self._arr_raw = v
+        if v is not None and type(v).__name__ == "PendingValue" \
+                and not v._resolved:
+            v.add_owner(self, "_arr_raw")
+
+    @property
+    def _grad(self):
+        g = self._grad_raw
+        if g is not None and type(g).__name__ == "PendingValue" \
+                and g._resolved:
+            g = g.value
+            self._grad_raw = g
+        return g
+
+    @_grad.setter
+    def _grad(self, v):
+        self._grad_raw = v
+        if v is not None and type(v).__name__ == "PendingValue" \
+                and not v._resolved:
+            v.add_owner(self, "_grad_raw")
 
     # -- data -------------------------------------------------------------
     @property
     def array(self):
-        return self._array
+        return self._force()
+
+    def _force(self):
+        """Concrete array (flushes the lazy queue if pending)."""
+        a = self._array
+        if a is not None and type(a).__name__ == "PendingValue":
+            a = a.force()
+            self._arr_raw = a
+        return a
 
     def numpy(self):
-        return np.asarray(self._array)
+        return np.asarray(self._force())
 
     def __array__(self, dtype=None):
         a = self.numpy()
@@ -60,12 +107,15 @@ class VarBase:
         return self._array.ndim
 
     def detach(self):
-        v = VarBase(self._array, name=self.name + ".detached",
+        v = VarBase(None, name=self.name + ".detached",
                     stop_gradient=True)
+        v._array = self._array   # pending-aware (setter tracks)
         return v
 
     def clone(self):
-        return VarBase(self._array, stop_gradient=self.stop_gradient)
+        v = VarBase(None, stop_gradient=self.stop_gradient)
+        v._array = self._array
+        return v
 
     def astype(self, dtype):
         from .tracer import current_tracer
@@ -82,9 +132,13 @@ class VarBase:
         current_tracer().engine.backward(self, retain_graph=retain_graph)
 
     def gradient(self):
-        if self._grad is None:
+        g = self._grad
+        if g is None:
             return None
-        return np.asarray(self._grad)
+        if type(g).__name__ == "PendingValue":
+            g = g.force()
+            self._grad_raw = g
+        return np.asarray(g)
 
     @property
     def grad(self):
@@ -107,22 +161,27 @@ class VarBase:
         return int(self._array.shape[0])
 
     def __float__(self):
-        return float(np.asarray(self._array).reshape(()))
+        return float(np.asarray(self._force()).reshape(()))
 
     def __repr__(self):
         return "VarBase(name=%s, shape=%s, dtype=%s, stop_gradient=%s)\n%s" % (
             self.name, self.shape, self.dtype, self.stop_gradient,
-            np.asarray(self._array) if self._array is not None else None)
+            np.asarray(self._force()) if self._array is not None else None)
 
     def __getitem__(self, idx):
-        from .tracer import current_tracer
+        from .tracer import Tracer, current_tracer
 
+        tracer = current_tracer()
+        if tracer is not None and tracer.lazy_engine is not None \
+                and tracer._recording_program is None \
+                and Tracer._static_index(idx):
+            # queue the subscript — a flush here would defeat lazy mode
+            return tracer._trace_getitem_lazy(self, idx)
         # slice through the tracer so gradients flow
-        arr = self._array
+        arr = self._force()
         sliced = arr[idx]
         out = VarBase(sliced, stop_gradient=self.stop_gradient)
         if not self.stop_gradient:
-            tracer = current_tracer()
             if tracer is not None:
                 out = tracer.trace_getitem(self, idx)
         return out
