@@ -141,24 +141,57 @@ class LazyEngine:
         outs = [PendingValue(a, self) for a in out_avals]
         self.nodes.append(_LazyNode(fn, list(in_handles), outs, sig))
         if len(self.nodes) >= self.MAX_NODES:
-            self.flush()
+            # safety valve mid-structure: owners are not attached yet
+            # (the caller binds outs to VarBases AFTER add_node), and
+            # mid-backward cotangent handles live only in local dicts —
+            # liveness is unknowable here, so materialize EVERYTHING
+            self.flush(conservative=True)
         return outs
 
     def constant_node(self, make, aval, sig) -> PendingValue:
         """Zero-input node (ones/zeros seeds etc.)."""
         return self.add_node(lambda vals: (make(),), [], [aval], sig)[0]
 
+    def binop_node(self, fn, a, b, sig_kind) -> PendingValue:
+        """Elementwise two-arg node (e.g. gradient accumulation) —
+        shared by BasicEngine._backward_lazy and
+        PartialGradEngine._run_lazy."""
+        av = aval_of(a)
+        return self.add_node(lambda vals: (fn(vals[0], vals[1]),),
+                             [a, b], [av],
+                             (sig_kind, tuple(av.shape),
+                              str(av.dtype)))[0]
+
+    def add(self, a, b) -> PendingValue:
+        return self.binop_node(lambda x, y: x + y, a, b, "grad_add")
+
+    def ones_like(self, h) -> PendingValue:
+        import jax.numpy as jnp
+
+        av = aval_of(h)
+        return self.constant_node(
+            lambda: jnp.ones(av.shape, av.dtype), av,
+            ("ones", tuple(av.shape), str(av.dtype)))
+
+    def zeros_like(self, h) -> PendingValue:
+        import jax.numpy as jnp
+
+        av = aval_of(h)
+        return self.constant_node(
+            lambda: jnp.zeros(av.shape, av.dtype), av,
+            ("zeros", tuple(av.shape), str(av.dtype)))
+
     # -- flush ------------------------------------------------------------
-    def flush(self):
+    def flush(self, conservative=False):
         if self._flushing or not self.nodes:
             return
         self._flushing = True
         try:
-            self._flush_impl()
+            self._flush_impl(conservative)
         finally:
             self._flushing = False
 
-    def _flush_impl(self):
+    def _flush_impl(self, conservative=False):
         import jax
 
         nodes, self.nodes = self.nodes, []
@@ -192,7 +225,8 @@ class LazyEngine:
 
         needed = tuple(sorted(
             pos[id(p)]
-            for nd in nodes for p in nd.outs if p.is_needed()))
+            for nd in nodes for p in nd.outs
+            if conservative or p.is_needed()))
         ext_avals = tuple(
             (tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
             for a in ext)

@@ -101,34 +101,14 @@ class BasicEngine:
         eng = self.tracer.lazy_engine
         tape = self.tracer.tape
 
-        from .lazy import aval_of as _aval_of
-
-        def _ones_like(h):
-            av = _aval_of(h)
-            return eng.constant_node(
-                lambda: jnp.ones(av.shape, av.dtype), av,
-                ("ones", tuple(av.shape), str(av.dtype)))
-
-        def _zeros_like(h):
-            av = _aval_of(h)
-            return eng.constant_node(
-                lambda: jnp.zeros(av.shape, av.dtype), av,
-                ("zeros", tuple(av.shape), str(av.dtype)))
-
-        def _add(a, b):
-            av = _aval_of(a)
-            return eng.add_node(
-                lambda vals: (vals[0] + vals[1],), [a, b], [av],
-                ("grad_add", tuple(av.shape), str(av.dtype)))[0]
-
-        grads: Dict[int, object] = {id(loss): _ones_like(loss._array)}
+        grads: Dict[int, object] = {id(loss): eng.ones_like(loss._array)}
         alive: Dict[int, VarBase] = {id(loss): loss}
         for rec in reversed(tape):
             if not any(id(ov) in grads for ov in rec.out_vars):
                 continue
             cots = tuple(
                 grads[id(ov)] if grads.get(id(ov)) is not None
-                else _zeros_like(ov._array)
+                else eng.zeros_like(ov._array)
                 for ov in rec.out_vars)
             if rec.lazy_vjp is not None:
                 in_grads = rec.lazy_vjp(cots)
@@ -142,7 +122,7 @@ class BasicEngine:
                 in_grads = rec.vjp_fn(cots)
             for iv, g in zip(rec.in_vars, in_grads):
                 prev = grads.get(id(iv))
-                grads[id(iv)] = g if prev is None else _add(prev, g)
+                grads[id(iv)] = g if prev is None else eng.add(prev, g)
                 alive[id(iv)] = iv
         for vid, v in alive.items():
             if not v.stop_gradient and vid in grads:
@@ -150,7 +130,7 @@ class BasicEngine:
                 if v._grad is None:
                     v._grad = g
                 else:
-                    v._grad = _add(v._grad, g)
+                    v._grad = eng.add(v._grad, g)
         if not retain_graph:
             self.tracer.tape.clear()
 
@@ -765,14 +745,6 @@ class PartialGradEngine:
 
         eng = self.tracer.lazy_engine
 
-        def _const(make, aval, kind):
-            return eng.constant_node(make, aval,
-                                     (kind, tuple(aval.shape),
-                                      str(aval.dtype)))
-
-        def _handle_of(v):
-            return v._array
-
         ghandles: Dict[int, object] = {}
         for i, o in enumerate(outputs):
             if grad_outputs is not None and i < len(grad_outputs) \
@@ -781,17 +753,7 @@ class PartialGradEngine:
                 ghandles[id(o)] = (go._array if isinstance(go, VarBase)
                                    else go)
             else:
-                av = aval_of(o._array)
-                ghandles[id(o)] = _const(
-                    lambda av=av: jnp.ones(av.shape, av.dtype), av,
-                    "ones")
-
-        def _add(a, b):
-            av = aval_of(a)
-            return eng.add_node(lambda vals: (vals[0] + vals[1],),
-                                [a, b], [av],
-                                ("grad_add", tuple(av.shape),
-                                 str(av.dtype)))[0]
+                ghandles[id(o)] = eng.ones_like(o._array)
 
         for rec in reversed(list(self.tracer.tape)):
             if not any(id(ov) in ghandles for ov in rec.out_vars):
@@ -800,10 +762,7 @@ class PartialGradEngine:
             for ov in rec.out_vars:
                 g = ghandles.get(id(ov))
                 if g is None:
-                    av = aval_of(ov._array)
-                    g = _const(
-                        lambda av=av: jnp.zeros(av.shape, av.dtype),
-                        av, "zeros")
+                    g = eng.zeros_like(ov._array)
                 cots.append(g)
             if rec.lazy_vjp is not None:
                 in_grads = rec.lazy_vjp(tuple(cots))
@@ -815,7 +774,8 @@ class PartialGradEngine:
                 if id(iv) in no_grad_ids:
                     continue
                 prev = ghandles.get(id(iv))
-                ghandles[id(iv)] = g if prev is None else _add(prev, g)
+                ghandles[id(iv)] = g if prev is None else \
+                    eng.add(prev, g)
 
         results = []
         for v in inputs:

@@ -957,15 +957,19 @@ __all__ += ["sequence_slice", "sequence_unpad", "im2sequence",
             "tensor_array_to_tensor", "adaptive_pool3d"]
 
 
-def flash_attention(q, k, v, causal=False, scale=0.0):
+def flash_attention(q, k, v, causal=False, scale=0.0, lengths=None):
     """Fused attention over [B, H, S, D] (the multihead hot path —
     reference fused/multihead_matmul_op.cu). Lowers to the Pallas flash
     kernel on TPU; ``apply_sequence_parallel`` rewrites it to ring
-    attention over an 'sp' mesh axis for long-context training."""
+    attention over an 'sp' mesh axis for long-context training.
+    ``lengths`` ([B] int) masks padded keys inside the kernel."""
     helper = LayerHelper("flash_attention", input=q)
     out = helper.create_variable_for_type_inference(q.dtype)
+    ins = {"Q": [q], "K": [k], "V": [v]}
+    if lengths is not None:
+        ins["Lengths"] = [lengths]
     helper.append_op(
-        "flash_attention", inputs={"Q": [q], "K": [k], "V": [v]},
+        "flash_attention", inputs=ins,
         outputs={"Out": [out]},
         attrs={"causal": bool(causal), "scale": float(scale)})
     return out

@@ -20,7 +20,7 @@ def _dense(x, size, act=None, name=None):
 
 def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
                          is_test=False, attn_bias=None, kv_in=None,
-                         use_flash=None):
+                         use_flash=None, kv_lengths=None, causal=False):
     """Attention over [B, T, D]: self-attention by default, or
     encoder-decoder cross attention when ``kv_in`` (the encoder output,
     [B, T_src, D]) is given. ``attn_bias`` is an additive mask
@@ -28,13 +28,21 @@ def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
     src_slf_attn_bias: 0 for visible positions, a large negative value
     for masked ones — padding or causal).
 
+    ``kv_lengths`` ([B] int) is the KERNEL-SIDE padding mask: pass the
+    per-example valid lengths instead of an additive bias and masked
+    self-attention routes through the pallas flash kernels (padded key
+    blocks are skipped entirely). ``causal=True`` composes with it
+    (decoder self-attention). Use ``attn_bias`` only for masks that
+    are not expressible as (causal x per-row-length).
+
     ``use_flash``: None = auto — the pallas flash path for unmasked
-    INFERENCE at any length, and for unmasked dropout-free TRAINING
-    when T >= 2048: with tuned 512x1024 blocks the kernels measure
-    1.45x (S=2048) to 2.32x (S=4096) FASTER than XLA's dense lowering
-    on v5e fwd+bwd, and at S=8192/16384 they train in 68/190 ms/step
-    where dense does not compile at all; at T <= 1024 the two are
-    within variance, so short sequences keep the dense path (bench
+    INFERENCE at any length, for masked (kv_lengths) attention at any
+    length, and for unmasked dropout-free TRAINING when T >= 2048:
+    with tuned 512x1024 blocks the kernels measure 1.45x (S=2048) to
+    2.32x (S=4096) FASTER than XLA's dense lowering on v5e fwd+bwd,
+    and at S=8192/16384 they train in 68/190 ms/step where dense does
+    not compile at all; at T <= 1024 the two are within variance, so
+    short unmasked sequences keep the dense path (bench
     comparability). True/False force."""
     B, T, D = q_in.shape
     kv = q_in if kv_in is None else kv_in
@@ -53,32 +61,39 @@ def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
     if use_flash is None:
         # self-attention only: the kernel grid assumes T_q == T_kv
         use_flash = attn_bias is None and kv_in is None and (
-            is_test or (dropout == 0 and T >= 2048))
+            is_test or dropout == 0) and (
+            kv_lengths is not None or is_test or T >= 2048)
     elif use_flash:
         # honor the force or say why it cannot be honored — silently
         # falling back would invalidate kernel benchmarks/debugging
         if attn_bias is not None:
             raise ValueError(
                 "use_flash=True: the flash kernel has no additive-mask "
-                "support; express the mask as causal=True or drop it")
+                "support; express the mask as causal=True and/or "
+                "kv_lengths (padding)")
         if dropout != 0 and not is_test:
             raise ValueError(
                 "use_flash=True: attention dropout is not supported in "
                 "the flash kernel; set dropout=0")
     if use_flash and attn_bias is None and (is_test or dropout == 0):
-        # no mask -> the flash path (pallas kernels on TPU: the T x T
-        # score matrix never hits HBM in EITHER direction — the
-        # backward recomputes probabilities blockwise from the saved
-        # logsumexp, so training memory is O(T·D)). Attention dropout
-        # keeps the dense lowering (the kernel has no dropout state).
+        # no additive mask -> the flash path (pallas kernels on TPU:
+        # the T x T score matrix never hits HBM in EITHER direction —
+        # the backward recomputes probabilities blockwise from the
+        # saved logsumexp, so training memory is O(T·D)). Attention
+        # dropout keeps the dense lowering (no dropout state in the
+        # kernel). kv_lengths rides into the kernel as the padding
+        # mask.
         from ..layer_helper import LayerHelper
 
         helper = LayerHelper("flash_attention", input=q_in)
         ctx = helper.create_variable_for_type_inference(q_in.dtype)
+        ins = {"Q": [q], "K": [k], "V": [v]}
+        if kv_lengths is not None:
+            ins["Lengths"] = [kv_lengths]
         helper.append_op("flash_attention",
-                         inputs={"Q": [q], "K": [k], "V": [v]},
+                         inputs=ins,
                          outputs={"Out": [ctx]},
-                         attrs={"causal": False,
+                         attrs={"causal": bool(causal),
                                 "scale": float(head) ** -0.5},
                          infer_shape=False)
         ctx.shape = (B, num_heads, T, head)
@@ -87,6 +102,17 @@ def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
         scores = layers.matmul(q, k, transpose_y=True)  # [B, H, T, T]
         if attn_bias is not None:
             scores = layers.elementwise_add(scores, attn_bias)
+        if kv_lengths is not None:
+            # dense fallback of the kernel-side padding mask
+            vis = layers.cast(layers.sequence_mask(
+                kv_lengths, maxlen=T_kv), scores.dtype)   # [B, T_kv]
+            pad_bias = layers.scale(vis, scale=1e9, bias=-1.0,
+                                    bias_after_scale=False)
+            pad_bias = layers.reshape(pad_bias, [B, 1, 1, T_kv])
+            scores = layers.elementwise_add(scores, pad_bias)
+        if causal:
+            scores = layers.elementwise_add(
+                scores, _causal_bias(T, dtype=scores.dtype))
         weights = layers.softmax(scores)
         if dropout:
             weights = layers.dropout(
@@ -99,9 +125,9 @@ def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
 
 
 def encoder_layer(x, num_heads, d_model, d_ff, dropout=0.0, is_test=False,
-                  attn_bias=None):
+                  attn_bias=None, kv_lengths=None):
     attn = multi_head_attention(x, num_heads, d_model, dropout, is_test,
-                                attn_bias)
+                                attn_bias, kv_lengths=kv_lengths)
     if dropout:
         attn = layers.dropout(attn, dropout_prob=dropout, is_test=is_test,
                               dropout_implementation="upscale_in_train")
@@ -119,17 +145,19 @@ def encoder_layer(x, num_heads, d_model, d_ff, dropout=0.0, is_test=False,
 def transformer_encoder(src_ids, pos_ids, vocab_size, max_len=512,
                         num_layers=12, num_heads=12, d_model=768,
                         d_ff=3072, dropout=0.0, is_test=False,
-                        attn_bias=None):
+                        attn_bias=None, src_lengths=None):
     """BERT-style encoder over int64 [B, T] token + position ids.
     ``attn_bias`` masks padding (additive, broadcastable to
-    [B, H, T, T]); returns [B, T, d_model] encodings."""
+    [B, H, T, T]); ``src_lengths`` ([B] int) is the same mask in
+    kernel form — padded self-attention routes the pallas flash
+    kernels. Returns [B, T, d_model] encodings."""
     emb = layers.embedding(src_ids, size=[vocab_size, d_model])
     pos = layers.embedding(pos_ids, size=[max_len, d_model])
     x = layers.elementwise_add(emb, pos)
     x = layers.layer_norm(x, begin_norm_axis=2)
     for _ in range(num_layers):
         x = encoder_layer(x, num_heads, d_model, d_ff, dropout, is_test,
-                          attn_bias)
+                          attn_bias, kv_lengths=src_lengths)
     return x
 
 
@@ -159,11 +187,15 @@ def bert_base_pretrain(src_ids, pos_ids, masked_positions, vocab_size=30522,
 
 
 def decoder_layer(y, enc, num_heads, d_model, d_ff, dropout=0.0,
-                  is_test=False, self_bias=None, cross_bias=None):
+                  is_test=False, self_bias=None, cross_bias=None,
+                  tgt_lengths=None):
     """Post-LN decoder block: causal self-attention, encoder-decoder
-    cross attention, FFN (reference dist_transformer.py decoder stack)."""
+    cross attention, FFN (reference dist_transformer.py decoder stack).
+    With ``tgt_lengths``, causal+padding self-attention routes the
+    flash kernels (pass self_bias=None then)."""
     sa = multi_head_attention(y, num_heads, d_model, dropout, is_test,
-                              self_bias)
+                              self_bias, kv_lengths=tgt_lengths,
+                              causal=tgt_lengths is not None)
     y = layers.layer_norm(layers.elementwise_add(y, sa),
                           begin_norm_axis=2)
     ca = multi_head_attention(y, num_heads, d_model, dropout, is_test,
@@ -187,22 +219,40 @@ def _causal_bias(T, dtype="float32"):
 
 def transformer_wmt(src_ids, src_pos, tgt_ids, tgt_pos, vocab_size,
                     max_len=256, num_layers=6, num_heads=8, d_model=512,
-                    d_ff=2048, dropout=0.0, is_test=False):
+                    d_ff=2048, dropout=0.0, is_test=False,
+                    src_lengths=None, tgt_lengths=None):
     """Transformer-base seq2seq (WMT north-star config 4 — reference
     tests/unittests/dist_transformer.py): encoder stack over source
     tokens, decoder stack with causal self-attention + cross attention,
-    projection to target vocab logits [B, T_tgt, V]."""
+    projection to target vocab logits [B, T_tgt, V].
+
+    With ``src_lengths``/``tgt_lengths`` ([B] int), the PADDED
+    encoder self-attention and the causal+padded decoder
+    self-attention route the pallas flash kernels (the realistic
+    masked-training case); cross attention (rectangular T_tgt x T_src)
+    stays dense with an additive bias built from ``src_lengths``."""
     enc = transformer_encoder(src_ids, src_pos, vocab_size, max_len,
                               num_layers, num_heads, d_model, d_ff,
-                              dropout, is_test)
+                              dropout, is_test,
+                              src_lengths=src_lengths)
     emb = layers.embedding(tgt_ids, size=[vocab_size, d_model])
     pos = layers.embedding(tgt_pos, size=[max_len, d_model])
     y = layers.layer_norm(layers.elementwise_add(emb, pos),
                           begin_norm_axis=2)
     B, T, _ = y.shape
-    self_bias = _causal_bias(int(T))
+    self_bias = None if tgt_lengths is not None else _causal_bias(int(T))
+    cross_bias = None
+    if src_lengths is not None:
+        T_src = src_ids.shape[1]
+        vis = layers.cast(layers.sequence_mask(
+            src_lengths, maxlen=int(T_src)), "float32")
+        cross_bias = layers.reshape(
+            layers.scale(vis, scale=1e9, bias=-1.0,
+                         bias_after_scale=False), [B, 1, 1, int(T_src)])
     for _ in range(num_layers):
         y = decoder_layer(y, enc, num_heads, d_model, d_ff, dropout,
-                          is_test, self_bias=self_bias)
+                          is_test, self_bias=self_bias,
+                          cross_bias=cross_bias,
+                          tgt_lengths=tgt_lengths)
     logits = layers.fc(y, size=vocab_size, num_flatten_dims=2)
     return logits

@@ -110,17 +110,21 @@ def _fc(ins, attrs):
 
 @register_op(
     "flash_attention",
-    inputs=[In("Q"), In("K"), In("V")],
+    inputs=[In("Q"), In("K"), In("V"),
+            In("Lengths", dispensable=True, no_grad=True)],
     outputs=[Out("Out")],
     attrs={"causal": False, "scale": 0.0},
 )
 def _flash_attention(ins, attrs):
     """Flash attention over [B, H, S, D] (pallas kernel on TPU, exact
-    dense math elsewhere; see ops/pallas/flash_attention.py)."""
+    dense math elsewhere; see ops/pallas/flash_attention.py).
+    ``Lengths`` [B] int: per-row valid-KV count — the kernel-side
+    padding mask (reference's additive src_slf_attn_bias)."""
     from .pallas import flash_attention
 
     q, k, v = ins["Q"], ins["K"], ins["V"]
     scale = attrs.get("scale", 0.0) or None
     return {"Out": flash_attention(q, k, v,
                                    causal=bool(attrs.get("causal")),
-                                   scale=scale)}
+                                   scale=scale,
+                                   lengths=ins.get("Lengths"))}

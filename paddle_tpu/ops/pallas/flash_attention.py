@@ -37,6 +37,8 @@ import functools
 import warnings
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -59,7 +61,16 @@ def _causal_block_needed(qi, ki, block_q, block_k):
     return ki * block_k <= qi * block_q + block_q - 1
 
 
-def _dense_attention(q, k, v, causal, scale):
+def _kv_len_mask(s, ki, block_k, len_val):
+    """Padding mask: key positions >= len_val (per batch row) are
+    invisible — the kernel-side form of the reference's additive
+    src_slf_attn_bias (0 / -inf over padded keys)."""
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    return jnp.where(k_pos < len_val, s, NEG_INF)
+
+
+def _dense_attention(q, k, v, causal, scale, lengths=None):
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
@@ -67,18 +78,28 @@ def _dense_attention(q, k, v, causal, scale):
         pos = jnp.arange(S)
         s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s,
                       NEG_INF)
+    if lengths is not None:
+        S_kv = k.shape[2]
+        vis = jnp.arange(S_kv)[None, None, None, :] < \
+            lengths.astype(jnp.int32)[:, None, None, None]
+        s = jnp.where(vis, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _dense_lse(q, k, causal, scale):
+def _dense_lse(q, k, causal, scale, lengths_bh=None):
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
         S = q.shape[1]
         pos = jnp.arange(S)
         s = jnp.where((pos[:, None] >= pos[None, :])[None], s, NEG_INF)
+    if lengths_bh is not None:   # [BH] — already repeated per head
+        S_kv = k.shape[1]
+        vis = jnp.arange(S_kv)[None, None, :] < \
+            lengths_bh.astype(jnp.int32)[:, None, None]
+        s = jnp.where(vis, s, NEG_INF)
     return jax.scipy.special.logsumexp(s, axis=-1)[..., None]  # [BH,S,1]
 
 
@@ -87,10 +108,16 @@ def _dense_lse(q, k, causal, scale):
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
-                  acc_ref, *, scale, causal, block_q, block_k, nk):
+def _flash_kernel(*refs, scale, causal, block_q, block_k, nk, has_len):
     from jax.experimental import pallas as pl
 
+    if has_len:
+        (q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref,
+         m_ref, l_ref, acc_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_ref, l_ref, acc_ref) = refs
+        len_ref = None
     ki = pl.program_id(2)
     qi = pl.program_id(1)
 
@@ -100,6 +127,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    bi = pl.program_id(0)
+
     def _accumulate():
         q = q_ref[0].astype(jnp.float32) * scale      # [bq, d]
         k = k_ref[0].astype(jnp.float32)              # [bk, d]
@@ -107,6 +136,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                                 (((1,), (1,)), ((), ())))  # [bq, bk]
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if has_len:
+            s = _kv_len_mask(s, ki, block_k, len_ref[bi, 0])
 
         m_prev = m_ref[:]                             # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -118,10 +149,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
             p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())))
         m_ref[:] = m_new
 
+    need = None
     if causal:
         # skip K blocks entirely above the diagonal — ~2x less work
-        pl.when(_causal_block_needed(qi, ki, block_q, block_k))(
-            _accumulate)
+        need = _causal_block_needed(qi, ki, block_q, block_k)
+    if has_len:
+        # skip K blocks entirely past the padded tail
+        in_len = ki * block_k < len_ref[bi, 0]
+        need = in_len if need is None else jnp.logical_and(need, in_len)
+    if need is not None:
+        pl.when(need)(_accumulate)
     else:
         _accumulate()
 
@@ -132,7 +169,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         lse_ref[0] = m_ref[:] + jnp.log(l_safe)          # [bq, 1]
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _len_bh(lengths, B, H):
+    """[B] lengths -> [B*H, 1] int32 (one row per grid batch step)."""
+    return jnp.repeat(lengths.astype(jnp.int32), H).reshape(B * H, 1)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   lengths=None):
     """Returns (out [B,H,S,D], lse [B*H, S] float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -146,23 +189,34 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         # grid assumes square S; dense math handles both exactly
         q3 = q.reshape(B * H, S, D)
         k3 = k.reshape(B * H, S_kv, D)
-        return (_dense_attention(q, k, v, causal, scale),
-                _dense_lse(q3, k3, causal, scale))
+        lbh = (None if lengths is None
+               else jnp.repeat(lengths.astype(jnp.int32), H))
+        return (_dense_attention(q, k, v, causal, scale, lengths),
+                _dense_lse(q3, k3, causal, scale, lbh))
     nq, nk = S // bq, S // bk
     q3 = q.reshape(B * H, S, D)
     k3 = k.reshape(B * H, S, D)
     v3 = v.reshape(B * H, S, D)
 
+    has_len = lengths is not None
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=bq, block_k=bk, nk=nk)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(B * H, nq, nk),
-        in_specs=[
+                               block_q=bq, block_k=bk, nk=nk,
+                               has_len=has_len)
+    in_specs = [
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        ],
+        ]
+    args = [q3, k3, v3]
+    if has_len:
+        # whole [BH,1] array in SMEM (scalar per batch row — a
+        # (1,1) VMEM block would violate the TPU (8,128) tile rule)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(_len_bh(lengths, B, H))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq, nk),
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             # [BH, S, 1]: last block dim = full array dim (exempt from
@@ -181,7 +235,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*args)
     return out.reshape(B, H, S, D), lse
 
 
@@ -190,13 +244,20 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_acc, *, scale, causal, block_q,
-                         block_k, nk):
+def _flash_bwd_dq_kernel(*refs, scale, causal, block_q, block_k, nk,
+                         has_len):
     from jax.experimental import pallas as pl
 
+    if has_len:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, len_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+        len_ref = None
     ki = pl.program_id(2)
     qi = pl.program_id(1)
+    bi = pl.program_id(0)
 
     @pl.when(ki == 0)
     def _init():
@@ -213,15 +274,22 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if has_len:
+            s = _kv_len_mask(s, ki, block_k, len_ref[bi, 0])
         p = jnp.exp(s - lse)                           # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
         ds = p * (dp - delta)                          # [bq, bk]
         dq_acc[:] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())))           # [bq, d]
 
+    need = None
     if causal:
-        pl.when(_causal_block_needed(qi, ki, block_q, block_k))(
-            _accumulate)
+        need = _causal_block_needed(qi, ki, block_q, block_k)
+    if has_len:
+        in_len = ki * block_k < len_ref[bi, 0]
+        need = in_len if need is None else jnp.logical_and(need, in_len)
+    if need is not None:
+        pl.when(need)(_accumulate)
     else:
         _accumulate()
 
@@ -230,13 +298,20 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
-                          delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                          scale, causal, block_q, block_k, nq):
+def _flash_bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq,
+                          has_len):
     from jax.experimental import pallas as pl
 
+    if has_len:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, len_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        len_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(1)
+    bi = pl.program_id(0)
 
     @pl.when(qi == 0)
     def _init():
@@ -254,6 +329,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if has_len:
+            s = _kv_len_mask(s, ki, block_k, len_ref[bi, 0])
         p = jnp.exp(s - lse)                           # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(              # p^T @ do
             p, do, (((0,), (0,)), ((), ())))           # [bk, d]
@@ -262,10 +339,15 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_acc[:] += scale * jax.lax.dot_general(      # ds^T @ q
             ds, q, (((0,), (0,)), ((), ())))           # [bk, d]
 
+    need = None
     if causal:
         # rows strictly above this K block see none of it
-        pl.when(_causal_block_needed(qi, ki, block_q, block_k))(
-            _accumulate)
+        need = _causal_block_needed(qi, ki, block_q, block_k)
+    if has_len:
+        in_len = ki * block_k < len_ref[bi, 0]
+        need = in_len if need is None else jnp.logical_and(need, in_len)
+    if need is not None:
+        pl.when(need)(_accumulate)
     else:
         _accumulate()
 
@@ -276,7 +358,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                    block_k, interpret):
+                    block_k, interpret, lengths=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -293,9 +375,18 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)            # [BH, S, 1]
 
+    has_len = lengths is not None
+    extra_args = []
+    dq_len_specs = []
+    dkv_len_specs = []
+    if has_len:
+        extra_args.append(_len_bh(lengths, B, H))
+        dq_len_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        dkv_len_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, scale=scale, causal=causal, block_q=bq,
-        block_k=bk, nk=nk)
+        block_k=bk, nk=nk, has_len=has_len)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B * H, nq, nk),
@@ -306,18 +397,18 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
-        ],
+        ] + dq_len_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(q3, k3, v3, do3, lse, delta, *extra_args)
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
-        block_k=bk, nq=nq)
+        block_k=bk, nq=nq, has_len=has_len)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, nk, nq),
@@ -328,7 +419,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
-        ],
+        ] + dkv_len_specs,
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
@@ -344,7 +435,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(q3, k3, v3, do3, lse, delta, *extra_args)
 
     shape = (B, H, S, D)
     return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape))
@@ -387,6 +478,43 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_masked(q, k, v, lengths, causal, scale, block_q, block_k,
+                  interpret):
+    out, _lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                               interpret, lengths=lengths)
+    return out
+
+
+def _flash_masked_fwd(q, k, v, lengths, causal, scale, block_q, block_k,
+                      interpret):
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret, lengths=lengths)
+    return out, (q, k, v, lengths, out, lse)
+
+
+def _flash_masked_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    from jax.dtypes import float0
+
+    q, k, v, lengths, out, lse = res
+    S = q.shape[2]
+    bq = min(block_q, S)
+    bk = min(block_k, S)
+    dlen = np.zeros(lengths.shape, dtype=float0)  # int arg: no tangent
+    if S != k.shape[2] or S % bq or S % bk:
+        _, vjp = jax.vjp(
+            lambda q, k, v: _dense_attention(q, k, v, causal, scale,
+                                             lengths), q, k, v)
+        return vjp(g) + (dlen,)
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal, scale,
+                                 block_q, block_k, interpret,
+                                 lengths=lengths)
+    return (dq, dk, dv, dlen)
+
+
+_flash_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
+
+
 def _fit_block(S, block):
     """Largest divisor of ``S`` that is <= ``block`` and lane-aligned
     (a multiple of 128, or ``S`` itself when S < block). Returns 0 when
@@ -403,11 +531,20 @@ def _fit_block(S, block):
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 512,
-                    block_k: int = 1024, force_pallas: bool = False):
+                    block_k: int = 1024, force_pallas: bool = False,
+                    lengths=None):
     """Flash attention over ``[B, H, S, D]`` tensors — differentiable:
     the backward runs the pallas dQ / dK+dV kernels with blockwise
     probability recomputation from the saved logsumexp (O(S·D) training
     memory; no S×S matrix in HBM in either direction).
+
+    ``lengths`` ([B] int) is the padding mask: row b attends only to
+    its first ``lengths[b]`` keys (key blocks past the tail are skipped
+    entirely) — the kernel-side equivalent of the reference's additive
+    src_slf_attn_bias over padded positions, composable with
+    ``causal``. Padded QUERY rows produce zeros/garbage exactly like
+    the additive-mask formulation; mask the loss, as seq2seq training
+    already does.
 
     Uses the pallas kernels on TPU backends (or when ``force_pallas`` —
     interpret mode — is requested, e.g. in tests); dense math elsewhere.
@@ -433,8 +570,11 @@ def flash_attention(q, k, v, causal: bool = False,
                 "flash_attention: seq_len %d has no 128-aligned block "
                 "divisor; using dense O(S^2) attention" % S)
     backend = jax.default_backend()
-    if backend == "tpu":
-        return _flash(q, k, v, causal, scale, block_q, block_k, False)
-    if force_pallas:
-        return _flash(q, k, v, causal, scale, block_q, block_k, True)
-    return _dense_attention(q, k, v, causal, scale)
+    interpret = backend != "tpu"
+    if backend == "tpu" or force_pallas:
+        if lengths is not None:
+            return _flash_masked(q, k, v, lengths, causal, scale,
+                                 block_q, block_k, interpret)
+        return _flash(q, k, v, causal, scale, block_q, block_k,
+                      interpret)
+    return _dense_attention(q, k, v, causal, scale, lengths)

@@ -181,3 +181,34 @@ def test_dygraph_grad_create_graph_raises_clearly_under_lazy():
         y = fluid.layers.reduce_sum(x * x)
         with pytest.raises(NotImplementedError, match="lazy=False"):
             fluid.dygraph.grad(y, x, create_graph=True)
+
+
+def test_max_nodes_valve_is_conservative():
+    """Review r5: the safety-valve flush fires before owners attach;
+    it must materialize everything (a precise-liveness flush there
+    loses the in-flight node's outputs)."""
+    from paddle_tpu.dygraph import Linear
+
+    def run(cap):
+        with fluid.dygraph.guard(lazy=True):
+            np.random.seed(0)
+            tracer = fluid.framework._dygraph_tracer()
+            if cap:
+                tracer.lazy_engine.MAX_NODES = cap
+            l1 = Linear(8, 8)
+            params = l1.parameters()
+            opt = fluid.optimizer.SGDOptimizer(0.1,
+                                               parameter_list=params)
+            x = to_variable(np.ones((2, 8), dtype="float32"))
+            for _ in range(2):
+                loss = fluid.layers.mean(l1(l1(l1(x))))
+                loss.backward()
+                opt.minimize(loss, parameter_list=params)
+                for p in params:
+                    p.clear_gradient()
+            return float(loss.numpy())
+
+    ref = run(None)
+    # valve fires many times mid-step (including mid-backward)
+    assert np.allclose(run(3), ref, rtol=1e-5)
+    assert np.allclose(run(7), ref, rtol=1e-5)
