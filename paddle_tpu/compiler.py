@@ -112,6 +112,22 @@ class CompiledProgram:
             return executor.run(self._program, feed=feed,
                                 fetch_list=fetch_list, scope=scope,
                                 return_numpy=return_numpy)
+        # a pipelined program (PipelineOptimizer metadata) over a mesh
+        # with a 'pp' axis routes to the pipeline engine — composes
+        # with dp replicas and model axes (dp x pp x mp in one program)
+        try:
+            from jax.sharding import Mesh
+        except Exception:  # pragma: no cover
+            Mesh = ()
+        mesh = self._places if isinstance(self._places, Mesh) else None
+        if mesh is not None and \
+                getattr(self._program, "_pipeline_meta", None) and \
+                "pp" in mesh.axis_names:
+            from .parallel.pipeline import run_pipeline_parallel
+
+            return run_pipeline_parallel(
+                executor._core, self._program, scope, feed, fetch_list,
+                mesh=mesh, return_numpy=return_numpy)
         from .parallel.engine import run_data_parallel
 
         return run_data_parallel(

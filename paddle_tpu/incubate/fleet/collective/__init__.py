@@ -53,6 +53,12 @@ class DistributedStrategy:
         self.feed_shard_specs = {}
         self.expert_parallel = False
         self.ep_degree = 1
+        # pipeline parallelism over a 'pp' mesh axis — composes with a
+        # dp axis (stage replicas) and the model axes above
+        # (dp x pp x mp in one Program)
+        self.pipeline = False
+        self.pipeline_cut_list = None
+        self.pipeline_num_microbatches = 1
 
 
 class Collective(Fleet):
@@ -122,8 +128,12 @@ class CollectiveOptimizer(DistributedOptimizer):
         # append_backward differentiates through the collective ops
         # (auto-VJP), not the dense originals
         if getattr(strategy, "sharded_embedding", False):
-            apply_sharded_embedding(program, "mp",
-                                    int(strategy.mp_degree or 0))
+            from .... import framework as _fw
+
+            apply_sharded_embedding(
+                program, "mp", int(strategy.mp_degree or 0),
+                startup_program=(startup_program
+                                 or _fw.default_startup_program()))
         if getattr(strategy, "sequence_parallel", False):
             apply_sequence_parallel(
                 program, "sp", int(strategy.sp_degree or 0),
@@ -140,6 +150,13 @@ class CollectiveOptimizer(DistributedOptimizer):
 
             opt = RecomputeOptimizer(opt)
             opt._set_checkpoints(strategy.recompute_checkpoints)
+        if getattr(strategy, "pipeline", False):
+            from ....optimizer import PipelineOptimizer
+
+            opt = PipelineOptimizer(
+                opt, cut_list=strategy.pipeline_cut_list,
+                num_microbatches=int(
+                    strategy.pipeline_num_microbatches or 1))
         optimize_ops, params_grads = opt.minimize(
             loss, startup_program, parameter_list, no_grad_set)
         shard_optimizer_state(program)

@@ -18,6 +18,18 @@ def _dense(x, size, act=None, name=None):
     return layers.fc(x, size=size, act=act, num_flatten_dims=2)
 
 
+def _padding_bias(lengths, maxlen, batch, dtype="float32"):
+    """Additive key-padding mask [B, 1, 1, maxlen]: 0 for visible keys,
+    -1e9 past ``lengths``. Formula is 1e9*(vis-1) — bias BEFORE scale;
+    scaling a -1e9 bias collapsed both cases to one float32 constant
+    (a silent no-op mask, caught in round-5 review)."""
+    vis = layers.cast(layers.sequence_mask(lengths, maxlen=int(maxlen)),
+                      dtype)
+    return layers.reshape(
+        layers.scale(vis, scale=1e9, bias=-1.0, bias_after_scale=False),
+        [batch, 1, 1, int(maxlen)])
+
+
 def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
                          is_test=False, attn_bias=None, kv_in=None,
                          use_flash=None, kv_lengths=None, causal=False):
@@ -104,12 +116,9 @@ def multi_head_attention(q_in, num_heads, d_model, dropout=0.0,
             scores = layers.elementwise_add(scores, attn_bias)
         if kv_lengths is not None:
             # dense fallback of the kernel-side padding mask
-            vis = layers.cast(layers.sequence_mask(
-                kv_lengths, maxlen=T_kv), scores.dtype)   # [B, T_kv]
-            pad_bias = layers.scale(vis, scale=1e9, bias=-1.0,
-                                    bias_after_scale=False)
-            pad_bias = layers.reshape(pad_bias, [B, 1, 1, T_kv])
-            scores = layers.elementwise_add(scores, pad_bias)
+            scores = layers.elementwise_add(
+                scores, _padding_bias(kv_lengths, T_kv, B,
+                                      scores.dtype))
         if causal:
             scores = layers.elementwise_add(
                 scores, _causal_bias(T, dtype=scores.dtype))
@@ -243,12 +252,7 @@ def transformer_wmt(src_ids, src_pos, tgt_ids, tgt_pos, vocab_size,
     self_bias = None if tgt_lengths is not None else _causal_bias(int(T))
     cross_bias = None
     if src_lengths is not None:
-        T_src = src_ids.shape[1]
-        vis = layers.cast(layers.sequence_mask(
-            src_lengths, maxlen=int(T_src)), "float32")
-        cross_bias = layers.reshape(
-            layers.scale(vis, scale=1e9, bias=-1.0,
-                         bias_after_scale=False), [B, 1, 1, int(T_src)])
+        cross_bias = _padding_bias(src_lengths, src_ids.shape[1], B)
     for _ in range(num_layers):
         y = decoder_layer(y, enc, num_heads, d_model, d_ff, dropout,
                           is_test, self_bias=self_bias,

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.registry import In, Out, register_op
 from .collective_ops import mesh_axis_active
@@ -70,30 +71,35 @@ def _sharded_lookup_grad_exact(w, ids, axis):
     out = psum(contrib) for a replicated cotangent is the identity, so:
     scatter ct's hit rows straight into this shard's block."""
     import jax
+    from jax.dtypes import float0
 
     from ..parallel.sharded_embedding import sharded_embedding_lookup
 
     rows_per, d = w.shape
-    ids_flat = ids.reshape(-1)
 
+    # ids ride as a PRIMAL + residual — a bwd closure over the forward
+    # trace's ids tracer leaks it into any later staging context
+    # (lax.switch/scan transpose under the pipeline engine raises
+    # "No constant handler for DynamicJaxprTracer")
     @jax.custom_vjp
-    def lookup(w_):
-        return sharded_embedding_lookup(w_, ids, axis)
+    def lookup(w_, ids_):
+        return sharded_embedding_lookup(w_, ids_, axis)
 
-    def fwd(w_):
-        return lookup(w_), None
+    def fwd(w_, ids_):
+        return lookup(w_, ids_), ids_
 
-    def bwd(_res, ct):
+    def bwd(ids_, ct):
+        ids_flat = ids_.reshape(-1)
         idx = jax.lax.axis_index(axis)
         local = ids_flat - idx * rows_per
         hit = (local >= 0) & (local < rows_per)
         safe = jnp.clip(local, 0, rows_per - 1)
         ct2 = jnp.where(hit[:, None], ct.reshape(-1, d), 0.0)
         gw = jnp.zeros((rows_per, d), ct.dtype).at[safe].add(ct2)
-        return (gw,)
+        return (gw, np.zeros(ids_.shape, dtype=float0))
 
     lookup.defvjp(fwd, bwd)
-    return lookup(w)
+    return lookup(w, ids)
 
 
 @register_op(

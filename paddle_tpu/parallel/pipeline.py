@@ -148,15 +148,37 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
                          "defines %d stages"
                          % (axis_name, mesh.shape[axis_name], n_stages))
 
+    # -- hybrid composition: dp replicas of the pipeline, model axes
+    # inside the stages (dp x pp x mp in ONE program) ---------------------
+    # axes used by transpiled shard specs (e.g. 'mp' for a sharded
+    # embedding table) are MODEL axes; any remaining non-pp axis is a
+    # DATA axis: the batch shards over it and the loss/grads average.
+    shard_specs = dict(getattr(program, "_var_shard_specs", None) or {})
+    model_axes = {a for spec in shard_specs.values() for a in spec if a}
+    dp_axes = tuple(a for a in mesh.axis_names
+                    if a != axis_name and a not in model_axes)
+    if len(dp_axes) > 1:
+        raise NotImplementedError(
+            "at most one data axis composes with pp (got %r)"
+            % (dp_axes,))
+    dp_axis = dp_axes[0] if dp_axes else None
+    dp = mesh.shape[dp_axis] if dp_axis else 1
+    for n, spec in shard_specs.items():
+        for a in spec:
+            if a is not None and a not in mesh.axis_names:
+                raise ValueError(
+                    "var %r sharded over axis %r absent from mesh %s"
+                    % (n, a, list(mesh.axis_names)))
+
     block = program.global_block()
     feed_vals = {}
     for name, value in (feed or {}).items():
         arr = value.array if isinstance(value, LoDTensor) \
             else jnp.asarray(np.asarray(value))
-        if arr.shape[0] % n_micro:
+        if arr.shape[0] % (n_micro * dp):
             raise ValueError(
-                "feed %r batch %d not divisible by num_microbatches %d"
-                % (name, arr.shape[0], n_micro))
+                "feed %r batch %d not divisible by num_microbatches %d "
+                "x dp %d" % (name, arr.shape[0], n_micro, dp))
         feed_vals[name] = arr.reshape((n_micro, arr.shape[0] // n_micro)
                                       + arr.shape[1:])
     feed_names = tuple(sorted(feed_vals))
@@ -185,7 +207,8 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
     key = (_program_version(program), feed_names,
            tuple((n, tuple(v.shape)) for n, v in sorted(feed_vals.items())),
            tuple(param_names), tuple(sorted(other_state)), mesh_key(mesh),
-           axis_name, n_micro)
+           axis_name, n_micro, dp_axis,
+           tuple(sorted((k, v) for k, v in shard_specs.items())))
     compiled = _pp_cache.get(key)
     if compiled is None:
         compiled = _build_pipeline_fn(
@@ -193,7 +216,8 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
             feed_names, param_names, tuple(sorted(other_state)), loss_name,
             {n: (v.shape, v.dtype) for n, v in feed_vals.items()},
             {n: (v.shape, v.dtype) for n, v in params.items()},
-            {n: (v.shape, v.dtype) for n, v in other_state.items()})
+            {n: (v.shape, v.dtype) for n, v in other_state.items()},
+            dp_axis=dp_axis, shard_specs=shard_specs)
         # bounded LRU, same rationale as executor_core._gc_plan_cache:
         # program mutation bumps the version and would leak executables
         if len(_pp_cache) >= 16:
@@ -234,15 +258,37 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
 def _build_pipeline_fn(block, stages, live, meta, mesh, axis_name,
                        n_stages, n_micro, feed_names, param_names,
                        other_names, loss_name, feed_meta, param_meta,
-                       other_meta):
+                       other_meta, dp_axis=None, shard_specs=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from ..ops.collective_ops import mesh_axes_guard
+
+    shard_specs = shard_specs or {}
+    dp = mesh.shape[dp_axis] if dp_axis else 1
+    mesh_axes = set(mesh.axis_names)
+
+    def _local_shape(name, shape):
+        """Per-shard shape of a var under its shard spec."""
+        spec = shard_specs.get(name)
+        if not spec:
+            return tuple(shape)
+        out = list(shape)
+        for d, a in enumerate(spec):
+            if a:
+                out[d] = out[d] // mesh.shape[a]
+        return tuple(out)
+
     # -- dry pass: boundary layouts via eval_shape ------------------------
-    # One microbatch flows through all stages abstractly; each
-    # boundary's live set fixes the packing layout for the rotating
-    # activation buffer.
+    # One microbatch flows through all stages abstractly (at the LOCAL
+    # per-dp-shard batch size and LOCAL param shard shapes — that is
+    # what the kernels inside shard_map see); each boundary's live set
+    # fixes the packing layout for the rotating activation buffer.
+    # NOTE: no mesh_axes_guard here — this pass runs OUTSIDE shard_map
+    # (axis collectives would be unbound); hybrid ops take their dense
+    # fallback, which is shape-identical on local shard shapes, and
+    # only shapes matter to eval_shape.
     def _dry(params_a, other_a, mb_feeds_a):
         env = dict(params_a)
         env.update(other_a)
@@ -254,11 +300,11 @@ def _build_pipeline_fn(block, stages, live, meta, mesh, axis_name,
                 outs.append([env[n] for n in live[i]])
         return outs
 
-    params_s = {n: jax.ShapeDtypeStruct(s, d)
+    params_s = {n: jax.ShapeDtypeStruct(_local_shape(n, s), d)
                 for n, (s, d) in param_meta.items()}
-    other_s = {n: jax.ShapeDtypeStruct(s, d)
+    other_s = {n: jax.ShapeDtypeStruct(_local_shape(n, s), d)
                for n, (s, d) in other_meta.items()}
-    mb_feeds_s = {n: jax.ShapeDtypeStruct(s[1:], d)
+    mb_feeds_s = {n: jax.ShapeDtypeStruct((s[1] // dp,) + tuple(s[2:]), d)
                   for n, (s, d) in feed_meta.items()}
     shapes = jax.eval_shape(_dry, params_s, other_s, mb_feeds_s)
     layouts = [
@@ -309,45 +355,77 @@ def _build_pipeline_fn(block, stages, live, meta, mesh, axis_name,
     n_ticks = n_micro + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-    def shard_loss(params, other, feeds, seed):
+    def shard_step(params, other, feeds, seed):
+        """Per-shard pipeline forward + LOCAL backward, then explicit
+        grad collectives. The gradient is taken INSIDE the shard (of
+        the pre-psum local loss) rather than through the shard_map
+        boundary: differentiating through a replicated (P()) out-spec
+        divides the cotangent by the replicating axes' sizes, which
+        silently under-scales sharded-param grads (measured exactly
+        1/mp on the embedding table). With the local grad, the
+        cotangent entering each stage op is the true replicated one,
+        and the cross-device reduction is the explicit psum(pp) +
+        pmean(dp) below — the hand-placed collectives of the standard
+        SPMD recipe."""
         sid = jax.lax.axis_index(axis_name)
 
-        def tick(carry, t):
-            buf, loss_sum = carry
-            mbr = t - sid
-            mb = jnp.clip(mbr, 0, n_micro - 1)
-            feeds_t = {
-                n: jax.lax.dynamic_index_in_dim(v, mb, 0, keepdims=False)
-                for n, v in feeds.items()
-            }
-            seed_t = seed + jnp.uint32(0x9E3779B9) * mb.astype(jnp.uint32)
-            # fill/drain ticks see a garbage (zero) rotating buffer; the
-            # loss is masked below, but grad through a masked tick still
-            # NaNs when an op has an unbounded derivative at 0 (log,
-            # sqrt, 1/x): zero cotangent x inf Jacobian. A ONES sentinel
-            # keeps those Jacobians finite, so masked cotangents stay 0.
-            is_real_in = (mbr >= 0) & (mbr < n_micro)
-            safe_buf = jnp.where(is_real_in, buf, jnp.ones_like(buf))
-            newbuf, loss = jax.lax.switch(sid, branches, safe_buf,
-                                          feeds_t, seed_t, params, other)
-            is_real = ((t - (n_stages - 1) >= 0)
-                       & (t - (n_stages - 1) < n_micro))
-            loss_sum = loss_sum + jnp.where(is_real, loss, 0.0)
-            sent = jax.lax.ppermute(newbuf, axis_name, perm)
-            return (sent, loss_sum), None
+        def local_loss(params_d):
+            def tick(carry, t):
+                buf, loss_sum = carry
+                mbr = t - sid
+                mb = jnp.clip(mbr, 0, n_micro - 1)
+                feeds_t = {
+                    n: jax.lax.dynamic_index_in_dim(v, mb, 0,
+                                                    keepdims=False)
+                    for n, v in feeds.items()
+                }
+                seed_t = seed + jnp.uint32(0x9E3779B9) * \
+                    mb.astype(jnp.uint32)
+                # fill/drain ticks see a garbage (zero) rotating
+                # buffer; the loss is masked below, but grad through a
+                # masked tick still NaNs when an op has an unbounded
+                # derivative at 0 (log, sqrt, 1/x): zero cotangent x
+                # inf Jacobian. A ONES sentinel keeps those Jacobians
+                # finite, so masked cotangents stay 0.
+                is_real_in = (mbr >= 0) & (mbr < n_micro)
+                safe_buf = jnp.where(is_real_in, buf,
+                                     jnp.ones_like(buf))
+                with mesh_axes_guard(mesh_axes):
+                    newbuf, loss = jax.lax.switch(
+                        sid, branches, safe_buf, feeds_t, seed_t,
+                        params_d, other)
+                is_real = ((t - (n_stages - 1) >= 0)
+                           & (t - (n_stages - 1) < n_micro))
+                loss_sum = loss_sum + jnp.where(is_real, loss, 0.0)
+                sent = jax.lax.ppermute(newbuf, axis_name, perm)
+                return (sent, loss_sum), None
 
-        init = (jnp.zeros((buf_size,), jnp.float32), jnp.float32(0.0))
-        (_, loss_sum), _ = jax.lax.scan(tick, init,
-                                        jnp.arange(n_ticks))
-        # only the last stage accumulated real losses; psum broadcasts
-        return jax.lax.psum(loss_sum, axis_name) / n_micro
+            init = (jnp.zeros((buf_size,), jnp.float32),
+                    jnp.float32(0.0))
+            (_, loss_sum), _ = jax.lax.scan(tick, init,
+                                            jnp.arange(n_ticks))
+            # mean over this shard's microbatches; nonzero only on the
+            # last pp stage (the psum below broadcasts it)
+            return loss_sum / n_micro
 
+        loss_local, g = jax.value_and_grad(local_loss)(params)
+        loss = jax.lax.psum(loss_local, axis_name)
+        g = {n: jax.lax.psum(v, axis_name) for n, v in g.items()}
+        if dp_axis:
+            # dp replicas each pipelined their own batch shard
+            loss = jax.lax.pmean(loss, dp_axis)
+            g = {n: jax.lax.pmean(v, dp_axis) for n, v in g.items()}
+        return loss, g
+
+    feed_spec = P(None, dp_axis) if dp_axis else P()
+    param_specs = {n: P(*shard_specs.get(n, ())) for n in param_names}
     smap = shard_map_compat(
-        shard_loss, mesh,
-        in_specs=({n: P() for n in param_names},
-                  {n: P() for n in other_names}, {n: P() for n in feed_names},
+        shard_step, mesh,
+        in_specs=(param_specs,
+                  {n: P(*shard_specs.get(n, ())) for n in other_names},
+                  {n: feed_spec for n in feed_names},
                   P()),
-        out_specs=P())
+        out_specs=(P(), param_specs))
 
     # -- optimizer update: trace the program's own update block ----------
     update_ops = meta["update_ops"]
@@ -363,8 +441,7 @@ def _build_pipeline_fn(block, stages, live, meta, mesh, axis_name,
         and not n.endswith(".pipe_acc")))
 
     def full_step(params, other, upd_st, feeds, seed):
-        loss, grads = jax.value_and_grad(
-            lambda p: smap(p, other, feeds, seed))(params)
+        loss, grads = smap(params, other, feeds, seed)
         env = dict(params)
         env.update(upd_st)
         # the single-device path accumulates k grads of the 1/k-scaled

@@ -147,12 +147,19 @@ def _merge_data_axes(program, axes):
     program._data_axes = tuple(cur)
 
 
-def apply_sharded_embedding(program, axis: str = "mp", degree: int = 0):
+def apply_sharded_embedding(program, axis: str = "mp", degree: int = 0,
+                            startup_program=None):
     """Tensor parallelism for embedding tables: every lookup_table[_v2]
     op becomes c_sharded_lookup with its table row-sharded over ``axis``
     (the pslib sparse-PS replacement, fleet_wrapper.h:84 — here one
     gather+psum pair on ICI). Call BEFORE minimize(). Returns the
-    sharded table names."""
+    sharded table names.
+
+    Uneven vocab (V % degree != 0): the table var is PADDED to the next
+    multiple of ``degree`` — lookups never touch pad rows (ids < V), so
+    their grads are zero and the optimizer leaves them at init. The
+    startup program's init op is re-shaped to match, which is why it
+    must be passed when vocab is uneven."""
     block = program.global_block()
     tables = []
     for op in block.ops:
@@ -160,10 +167,16 @@ def apply_sharded_embedding(program, axis: str = "mp", degree: int = 0):
             continue
         w = op.input("W")[0]
         v = block._find_var_recursive(w)
-        if degree and v is not None and v.shape and v.shape[0] % degree:
-            raise ValueError(
-                "sharded embedding %r: vocab %d not divisible by "
-                "mp degree %d" % (w, v.shape[0], degree))
+        vocab = int(v.shape[0]) if v is not None and v.shape else 0
+        if degree and vocab and vocab % degree:
+            v_pad = -(-vocab // degree) * degree
+            if startup_program is None:
+                raise ValueError(
+                    "sharded embedding %r: vocab %d not divisible by "
+                    "mp degree %d — pass startup_program so the table "
+                    "can be padded to %d rows"
+                    % (w, v.shape[0], degree, v_pad))
+            _pad_table_rows(program, startup_program, w, v, v_pad)
         if op.attrs.get("is_sparse"):
             # mesh sharding REPLACES the SelectedRows sparse-grad path:
             # the local block grad is dense [V/mp, D] (the design — one
@@ -179,14 +192,30 @@ def apply_sharded_embedding(program, axis: str = "mp", degree: int = 0):
         op.attrs = {"shard_axis": axis,
                     "padding_idx": int(op.attrs.get("padding_idx", -1)),
                     "squeeze_last": squeeze,
-                    "vocab_size": int(v.shape[0]) if v is not None
-                    and v.shape else 0}
+                    # the TRUE vocab (captured before pad-row growth)
+                    "vocab_size": vocab}
         _mark_shard(program, w, (axis,))
         _skip_grad(program, w + GRAD_SUFFIX, (axis,))
         tables.append(w)
     _merge_data_axes(program, ("dp",))
     _bump_version(program)
     return tables
+
+
+def _pad_table_rows(program, startup_program, name, var, v_pad):
+    """Grow an embedding var to ``v_pad`` rows in BOTH programs (main
+    var shape + every startup init op writing it); pad rows are inert:
+    never looked up, zero grad."""
+    new_shape = (v_pad,) + tuple(var.shape[1:])
+    var.shape = new_shape
+    for blk in ([startup_program.global_block()]
+                + [program.global_block()]):
+        for op in blk.ops:
+            if name in op.output_arg_names and "shape" in op.attrs:
+                op.attrs["shape"] = list(new_shape)
+    sv = startup_program.global_block()._find_var_recursive(name)
+    if sv is not None:
+        sv.shape = new_shape
 
 
 def apply_sequence_parallel(program, axis: str = "sp", degree: int = 0,
@@ -204,6 +233,12 @@ def apply_sequence_parallel(program, axis: str = "sp", degree: int = 0,
     for op in block.ops:
         if op.type != "flash_attention":
             continue
+        if op.input("Lengths"):
+            raise NotImplementedError(
+                "sequence_parallel: flash_attention with a Lengths "
+                "(padding) mask cannot be rewritten to ring attention "
+                "yet — drop kv_lengths or sequence parallelism for "
+                "this op")
         if degree:
             q = block._find_var_recursive(op.input("Q")[0])
             if (q is not None and q.shape is not None and len(q.shape) >= 3

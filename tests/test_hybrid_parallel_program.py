@@ -169,3 +169,99 @@ def test_program_path_pure_model_parallel_mesh():
     assert abs(l_dense - l_mesh) < 1e-5, (l_dense, l_mesh)
     np.testing.assert_allclose(p_mesh["emb_w"], p_dense["emb_w"],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_dp_pp_mp_composed_one_program():
+    """THREE axes in one Program (VERDICT r4 #2): dp replicas of a
+    2-stage pipeline whose first stage holds an mp-row-sharded
+    embedding with an UNEVEN vocab (17 -> padded 18). Strategy-driven
+    (DistributedStrategy.pipeline + sharded_embedding), run via
+    exe.run(CompiledProgram), matched against single-device microbatch
+    accumulation on loss AND updated params."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.incubate.fleet.collective import (
+        CollectiveOptimizer, DistributedStrategy)
+    from paddle_tpu.parallel.mesh_utils import make_mesh
+
+    dp, pp, mp = 2, 2, 2
+    n_micro, mb = 2, 4
+    B = dp * n_micro * mb
+    V, D = 17, 8
+
+    def build(k):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), \
+                fluid.unique_name.guard():
+            ids = fluid.data(name="ids", shape=[mb, 1], dtype="int64")
+            tgt = fluid.data(name="tgt", shape=[mb, 6],
+                             dtype="float32")
+            emb = fluid.layers.embedding(
+                ids, size=[V, D],
+                param_attr=fluid.ParamAttr(name="emb_w"))
+            h1 = fluid.layers.fc(emb, size=12, act="relu")
+            pred = fluid.layers.fc(h1, size=6)
+            loss = fluid.layers.reduce_mean(fluid.layers.square(
+                fluid.layers.elementwise_sub(pred, tgt)))
+            strat = DistributedStrategy()
+            strat.sharded_embedding = True
+            strat.mp_degree = mp
+            strat.pipeline = True
+            strat.pipeline_cut_list = [[h1]]
+            strat.pipeline_num_microbatches = k
+            CollectiveOptimizer(
+                fluid.optimizer.MomentumOptimizer(0.1, 0.9),
+                strat).minimize(loss, startup_program=startup)
+        return main, startup, loss
+
+    rng = np.random.RandomState(41)
+    full_ids = rng.randint(0, V, (B, 1)).astype("int64")
+    full_tgt = rng.randn(B, 6).astype("float32")
+
+    ref_main, ref_startup, ref_loss = build(dp * n_micro)
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(ref_startup)
+        init = {}
+        for name, v in ref_main.global_block().vars.items():
+            if getattr(v, "persistable", False):
+                var = scope_a.find_var(name)
+                if var is not None and var.is_initialized():
+                    init[name] = np.asarray(var.raw().array)
+        losses = []
+        for m in range(dp * n_micro):
+            (l,) = exe.run(
+                ref_main,
+                feed={"ids": full_ids[m * mb:(m + 1) * mb],
+                      "tgt": full_tgt[m * mb:(m + 1) * mb]},
+                fetch_list=[ref_loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        p_ref = {n: np.asarray(scope_a.find_var(n).raw().array)
+                 for n in init}
+
+    main, startup, loss = build(n_micro)
+    emb_var = main.global_block()._find_var_recursive("emb_w")
+    assert tuple(emb_var.shape) == (18, D)  # padded uneven vocab
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe_b = fluid.Executor(fluid.TPUPlace())
+        exe_b.run(startup)
+        for name, arr in init.items():
+            scope_b.var(name).get_tensor()._array = jnp.asarray(arr)
+        cp = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name,
+            places=make_mesh([dp, pp, mp], ["dp", "pp", "mp"]))
+        (lm,) = exe_b.run(cp, feed={"ids": full_ids, "tgt": full_tgt},
+                          fetch_list=[loss])
+        p_mesh = {n: np.asarray(scope_b.find_var(n).raw().array)
+                  for n in init}
+
+    assert abs(float(np.mean(losses))
+               - float(np.asarray(lm).ravel()[0])) < 1e-4
+    for n in sorted(init):
+        if "pipe_step" in n:
+            continue
+        np.testing.assert_allclose(p_mesh[n], p_ref[n], rtol=1e-4,
+                                   atol=1e-5, err_msg=n)
