@@ -1,1 +1,5 @@
+from . import distillation  # noqa: F401
+from . import nas  # noqa: F401
+from . import prune  # noqa: F401
 from . import quantization  # noqa: F401
+from . import searcher  # noqa: F401

@@ -1,0 +1,72 @@
+"""Neural architecture search.
+
+Parity: /root/reference/python/paddle/fluid/contrib/slim/nas/
+(search_space.py SearchSpace contract; light_nas_strategy.py — the
+SA-driven search loop; the controller_server/search_agent RPC pair is
+the reference's multi-process plumbing, subsumed here by running the
+SAController in-process — the TPU framework's multi-host story is
+jax.distributed, not a bespoke socket server).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..searcher import SAController
+
+__all__ = ["SearchSpace", "SANAS", "LightNASStrategy"]
+
+
+class SearchSpace:
+    """Search-space contract (reference nas/search_space.py:20)."""
+
+    def init_tokens(self):
+        """Initial token vector."""
+        raise NotImplementedError
+
+    def range_table(self):
+        """Per-token exclusive upper bounds."""
+        raise NotImplementedError
+
+    def create_net(self, tokens=None):
+        """tokens -> (train_program, eval_program, startup_program,
+        train_metrics, eval_metrics) or any builder contract the
+        caller's reward_fn understands."""
+        raise NotImplementedError
+
+
+class SANAS:
+    """Simulated-annealing NAS driver: sample tokens, build + score the
+    candidate via ``reward_fn(tokens)``, anneal (the in-process
+    equivalent of light_nas_strategy.py's controller loop)."""
+
+    def __init__(self, search_space: SearchSpace, reduce_rate=0.85,
+                 init_temperature=1024.0, search_steps=100, seed=None,
+                 constrain_func=None):
+        self.space = search_space
+        self.controller = SAController(
+            search_space.range_table(), reduce_rate=reduce_rate,
+            init_temperature=init_temperature,
+            max_iter_number=search_steps, seed=seed)
+        self.controller.reset(search_space.range_table(),
+                              init_tokens=search_space.init_tokens(),
+                              constrain_func=constrain_func)
+        self.search_steps = search_steps
+
+    def next_archs(self):
+        """Next candidate tokens (reference SANAS.next_archs)."""
+        return self.controller.next_tokens()
+
+    def reward(self, tokens, score):
+        self.controller.update(tokens, score)
+
+    def search(self, reward_fn, steps: Optional[int] = None):
+        return self.controller.search(reward_fn,
+                                      steps or self.search_steps)
+
+    def best_tokens(self):
+        return list(self.controller.best_tokens), \
+            self.controller.max_reward
+
+
+# the reference name for the strategy wrapper
+LightNASStrategy = SANAS

@@ -1,0 +1,383 @@
+"""Structured pruning over Programs.
+
+Parity: /root/reference/python/paddle/fluid/contrib/slim/prune/
+(pruner.py:34 StructurePruner — group pruning by l1-norm along an
+axis; prune_strategy.py:36,563,672 PruneStrategy / UniformPruneStrategy
+/ SensitivePruneStrategy). TPU-native formulation: pruning is a
+PROGRAM + SCOPE rewrite — parameter arrays shrink along their channel
+axis, var shape metadata updates, and the consumer graph is walked so
+downstream params shrink their matching input-channel axis; the
+whole-program compiler then just retraces on the new (static) shapes.
+No mask ops at run time: pruned channels are genuinely gone, which is
+what buys the MXU smaller matmuls.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Pruner", "StructurePruner", "prune_parameter",
+           "UniformPruneStrategy", "SensitivePruneStrategy",
+           "compute_sensitivities", "greedy_ratios"]
+
+
+class Pruner:
+    """Base pruner (reference pruner.py:22)."""
+
+    def prune(self, param):
+        pass
+
+
+class StructurePruner(Pruner):
+    """Group pruning by criterion along an axis (reference
+    pruner.py:34). ``pruning_axis``/``criterions`` are dicts keyed by
+    param name, '*' as the wildcard; criterion: 'l1_norm'."""
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def axis_of(self, name: str) -> int:
+        return self.pruning_axis.get(name, self.pruning_axis.get("*", 0))
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        criterion = self.criterions.get(name, self.criterions.get("*"))
+        if axis is None:
+            axis = self.axis_of(name)
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
+        if criterion != "l1_norm":
+            raise ValueError("unsupported criterion %r" % criterion)
+        scores = np.sum(np.abs(np.asarray(param)), axis=reduce_dims)
+        return scores.argsort()[:prune_num]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=False):
+        tensor = np.asarray(tensor)
+        mask = np.zeros(tensor.shape[pruned_axis], dtype=bool)
+        mask[list(pruned_idx)] = True
+        if lazy:
+            out = tensor.copy()
+            sl = [slice(None)] * tensor.ndim
+            sl[pruned_axis] = mask
+            out[tuple(sl)] = 0
+            return out
+        sl = [slice(None)] * tensor.ndim
+        sl[pruned_axis] = ~mask
+        return tensor[tuple(sl)]
+
+
+# ---------------------------------------------------------------------------
+# graph-aware pruning of one parameter (+ downstream propagation)
+# ---------------------------------------------------------------------------
+
+# ops that carry the channel dim through unchanged: walk THROUGH them
+_PASS_THROUGH = {"relu", "sigmoid", "tanh", "gelu", "pool2d", "dropout",
+                 "scale", "softmax", "elementwise_add", "elementwise_mul",
+                 "leaky_relu", "relu6", "swish"}
+
+
+def _consumers(block, var_name):
+    return [op for op in block.ops if var_name in op.input_arg_names]
+
+
+def _set_scope_array(scope, name, arr):
+    import jax.numpy as jnp
+
+    scope.var(name).get_tensor()._array = jnp.asarray(arr)
+
+
+def _shrink(scope, block, name, idx, axis, pruner):
+    var = block._find_var_recursive(name)
+    v = scope.find_var(name)
+    if v is None or not v.is_initialized():
+        raise ValueError("param %r not initialized in scope" % name)
+    old_shape = tuple(np.asarray(v.raw().array).shape)
+    arr = pruner.prune_tensor(np.asarray(v.raw().array), idx, axis)
+    _set_scope_array(scope, name, arr)
+    if var is not None and var.shape is not None:
+        shape = list(var.shape)
+        shape[axis] = arr.shape[axis]
+        var.shape = tuple(shape)
+    # optimizer accumulators (moment/velocity/...) are named
+    # "<param>_<acc>_<n>" and mirror the param's shape: shrink them
+    # too, or the first finetune step shape-crashes (Adam/Momentum)
+    sc = scope
+    while sc is not None:
+        for aname in list(getattr(sc, "_vars", {})):
+            if not aname.startswith(name + "_") or aname == name:
+                continue
+            av = sc.find_var(aname)
+            if av is None or not av.is_initialized():
+                continue
+            aarr = np.asarray(av.raw().array)
+            if tuple(aarr.shape) == old_shape:
+                _set_scope_array(sc, aname,
+                                 pruner.prune_tensor(aarr, idx, axis))
+                avar = block._find_var_recursive(aname)
+                if avar is not None and avar.shape is not None:
+                    s2 = list(avar.shape)
+                    s2[axis] = int(arr.shape[axis])
+                    avar.shape = tuple(s2)
+        sc = getattr(sc, "_parent", None)
+
+
+def _shrink_var_meta(block, name, axis, new_dim):
+    var = block._find_var_recursive(name)
+    if var is not None and var.shape is not None:
+        shape = list(var.shape)
+        if axis < len(shape):
+            shape[axis] = new_dim
+            var.shape = tuple(shape)
+
+
+def prune_parameter(program, scope, param_name: str, ratio: float,
+                    pruner: Optional[StructurePruner] = None,
+                    pruned_idx=None):
+    """Prune ``ratio`` of ``param_name``'s output channels and
+    propagate: the producing op's output var shrinks its channel dim,
+    per-channel side params (BN scale/bias/stats, biases) shrink, and
+    the next param-bearing consumers shrink their input-channel axis.
+    Supported producers: conv2d (Filter [Cout,Cin,kh,kw], axis 0) and
+    fc/mul (W [Din,Dout], axis 1). Returns the pruned channel ids."""
+    pruner = pruner or StructurePruner()
+    block = program.global_block()
+    op = next((o for o in block.ops
+               if param_name in o.input_arg_names
+               and o.type in ("conv2d", "mul", "fc")), None)
+    if op is None:
+        raise ValueError("no conv2d/mul/fc consumes %r" % param_name)
+
+    v = scope.find_var(param_name)
+    w = np.asarray(v.raw().array)
+    if op.type == "conv2d":
+        out_axis, ch_axis = 0, 1   # filter OIHW; activations NCHW
+    else:
+        out_axis, ch_axis = 1, -1  # mul W [Din, Dout]; act [..., D]
+    if pruned_idx is None:
+        pruned_idx = pruner.cal_pruned_idx(param_name, w, ratio,
+                                           axis=out_axis)
+    pruned_idx = np.asarray(sorted(int(i) for i in pruned_idx))
+    if pruned_idx.size == 0:
+        return pruned_idx
+    _shrink(scope, block, param_name, pruned_idx, out_axis, pruner)
+    new_dim = w.shape[out_axis] - pruned_idx.size
+
+    out_name = op.output_arg_names[0]
+    data_axis = 1 if op.type == "conv2d" else ch_axis
+    _propagate(block, scope, pruner, out_name, pruned_idx, data_axis,
+               new_dim)
+    # shape metadata changed under the same op list: invalidate the
+    # program-version-keyed trace caches (same hook the transpiler
+    # passes use)
+    program._next_op_id()
+    return pruned_idx
+
+
+def _propagate(block, scope, pruner, var_name, idx, data_axis, new_dim,
+               _depth=0):
+    """Shrink ``var_name``'s channel dim metadata and walk consumers."""
+    if _depth > 64:
+        raise RuntimeError("pruning propagation runaway")
+    _shrink_var_meta(block, var_name, data_axis if data_axis >= 0
+                     else len(block._find_var_recursive(var_name).shape)
+                     - 1, new_dim)
+    for op in _consumers(block, var_name):
+        if op.type == "conv2d":
+            if var_name in op.input("Input"):
+                _shrink(scope, block, op.input("Filter")[0], idx, 1,
+                        pruner)
+        elif op.type in ("mul", "fc"):
+            x_slot = op.input("X") if op.type == "mul" else \
+                op.input("Input")
+            if var_name in x_slot:
+                wname = (op.input("Y") if op.type == "mul"
+                         else op.input("W"))[0]
+                _shrink(scope, block, wname, idx, 0, pruner)
+        elif op.type == "batch_norm":
+            if var_name in op.input("X"):
+                for slot in ("Scale", "Bias", "Mean", "Variance"):
+                    names = op.input(slot)
+                    if names:
+                        _shrink(scope, block, names[0], idx, 0, pruner)
+                for slot in ("Y", "MeanOut", "VarianceOut",
+                             "SavedMean", "SavedVariance"):
+                    outs = op.output(slot)
+                    if outs:
+                        ax = (data_axis if slot == "Y" else 0)
+                        _shrink_var_meta(block, outs[0], ax, new_dim)
+                if op.output("Y"):
+                    _propagate(block, scope, pruner, op.output("Y")[0],
+                               idx, data_axis, new_dim, _depth + 1)
+        elif op.type == "elementwise_add":
+            # channel-bias add: shrink the [C] bias; a RESIDUAL join
+            # (pruned branch meets a full-width same-rank tensor, or
+            # the pruned var arrives via Y) cannot be pruned through —
+            # fail loudly instead of corrupting downstream shapes
+            x, y = op.input("X"), op.input("Y")
+            if y and var_name in x:
+                yv = scope.find_var(y[0])
+                if yv is not None and yv.is_initialized():
+                    if np.asarray(yv.raw().array).ndim == 1:
+                        _shrink(scope, block, y[0], idx, 0, pruner)
+                    else:
+                        raise ValueError(
+                            "pruning %r reaches elementwise_add with a "
+                            "non-bias operand %r (residual join) — "
+                            "unsupported topology" % (var_name, y[0]))
+                else:
+                    yvar = block._find_var_recursive(y[0])
+                    if yvar is not None and yvar.shape is not None and \
+                            len(yvar.shape) > 1:
+                        raise ValueError(
+                            "pruning %r reaches elementwise_add with "
+                            "activation operand %r (residual join) — "
+                            "unsupported topology" % (var_name, y[0]))
+            elif var_name in y:
+                raise ValueError(
+                    "pruning %r reaches elementwise_add via the Y slot "
+                    "(residual join) — unsupported topology" % var_name)
+            _propagate(block, scope, pruner, op.output_arg_names[0],
+                       idx, data_axis, new_dim, _depth + 1)
+        elif op.type == "concat":
+            # channel concat: offset the pruned ids by the (current)
+            # widths of the inputs BEFORE this one, shrink the out dim
+            axis = int(op.attrs.get("axis", 0))
+            xs = op.input("X")
+            var = block._find_var_recursive(var_name)
+            cat_axis = axis if axis >= 0 else len(var.shape) + axis
+            norm_data = (data_axis if data_axis >= 0
+                         else len(var.shape) + data_axis)
+            if cat_axis != norm_data:
+                continue   # concat on another dim: channel untouched
+            offset = 0
+            for n in xs:
+                if n == var_name:
+                    break
+                v2 = block._find_var_recursive(n)
+                offset += int(v2.shape[cat_axis])
+            out = op.output_arg_names[0]
+            ov = block._find_var_recursive(out)
+            out_dim = int(ov.shape[cat_axis]) - idx.size
+            _propagate(block, scope, pruner, out, idx + offset,
+                       data_axis, out_dim, _depth + 1)
+        elif op.type in _PASS_THROUGH:
+            _propagate(block, scope, pruner, op.output_arg_names[0],
+                       idx, data_axis, new_dim, _depth + 1)
+        # anything else (loss heads over full features, fetch) is left
+        # alone — its inputs already carry the shrunk metadata
+
+
+# ---------------------------------------------------------------------------
+# strategies
+# ---------------------------------------------------------------------------
+
+
+class UniformPruneStrategy:
+    """Prune every target param by the same ratio (reference
+    prune_strategy.py:563)."""
+
+    def __init__(self, pruner=None, target_ratio=0.5, params=None):
+        self.pruner = pruner or StructurePruner()
+        self.target_ratio = target_ratio
+        self.params = params
+
+    def apply(self, program, scope):
+        pruned = {}
+        for name in self.params or []:
+            pruned[name] = prune_parameter(
+                program, scope, name, self.target_ratio, self.pruner)
+        return pruned
+
+
+def compute_sensitivities(program, scope, eval_fn, params,
+                          ratios=(0.1, 0.3, 0.5), pruner=None):
+    """Per-param sensitivity: metric loss when pruning it alone at each
+    ratio (reference SensitivePruneStrategy._compute_sensitivities,
+    prune_strategy.py:761). ``eval_fn(program, scope) -> float`` (higher
+    is better). Params are restored after each probe."""
+    pruner = pruner or StructurePruner()
+    base = float(eval_fn(program, scope))
+    block = program.global_block()
+    sens: Dict[str, Dict[float, float]] = {}
+    for name in params:
+        snap = {}
+        # snapshot EVERY var's shape metadata (pruning shrinks
+        # activation shapes too; restoring only params would leave
+        # stale widths that corrupt the next probe's concat offsets)
+        meta = {n: tuple(v.shape) for n, v in block.vars.items()
+                if v.shape is not None}
+        for n, v in list(block.vars.items()):
+            sv = scope.find_var(n)
+            if sv is not None and sv.is_initialized() and \
+                    getattr(v, "persistable", False):
+                snap[n] = np.asarray(sv.raw().array)
+        sens[name] = {}
+        for r in ratios:
+            prune_parameter(program, scope, name, r, pruner)
+            m = float(eval_fn(program, scope))
+            sens[name][r] = (base - m) / max(abs(base), 1e-12)
+            for n, arr in snap.items():
+                _set_scope_array(scope, n, arr)
+            for n, shape in meta.items():
+                var = block._find_var_recursive(n)
+                if var is not None:
+                    var.shape = shape
+    return sens
+
+
+def greedy_ratios(sensitivities, target_ratio: float,
+                  ratios=(0.1, 0.3, 0.5)):
+    """Pick per-param ratios whose mean hits ``target_ratio`` while
+    minimizing summed sensitivity (the greedy loop of
+    SensitivePruneStrategy._get_best_ratios)."""
+    names = sorted(sensitivities)
+    choice = {n: 0.0 for n in names}
+
+    def mean_ratio():
+        return sum(choice.values()) / max(len(names), 1)
+
+    steps = sorted(ratios)
+    while mean_ratio() < target_ratio:
+        best, best_cost = None, None
+        for n in names:
+            cur = choice[n]
+            nxt = next((r for r in steps if r > cur), None)
+            if nxt is None:
+                continue
+            cost = (sensitivities[n].get(nxt, 1.0)
+                    - sensitivities[n].get(cur, 0.0))
+            if best_cost is None or cost < best_cost:
+                best, best_cost = n, cost
+        if best is None:
+            break
+        choice[best] = next(r for r in steps if r > choice[best])
+    return choice
+
+
+class SensitivePruneStrategy:
+    """Sensitivity-guided pruning (reference prune_strategy.py:672):
+    probe each param's metric sensitivity, then greedily assign ratios
+    to reach the target with minimal summed sensitivity."""
+
+    def __init__(self, pruner=None, target_ratio=0.5, params=None,
+                 eval_fn=None, ratios=(0.1, 0.3, 0.5)):
+        self.pruner = pruner or StructurePruner()
+        self.target_ratio = target_ratio
+        self.params = params
+        self.eval_fn = eval_fn
+        self.ratios = ratios
+        self.sensitivities = None
+
+    def apply(self, program, scope):
+        self.sensitivities = compute_sensitivities(
+            program, scope, self.eval_fn, self.params, self.ratios,
+            self.pruner)
+        plan = greedy_ratios(self.sensitivities, self.target_ratio,
+                             self.ratios)
+        pruned = {}
+        for name, r in plan.items():
+            if r > 0:
+                pruned[name] = prune_parameter(program, scope, name, r,
+                                               self.pruner)
+        return plan, pruned

@@ -1,0 +1,98 @@
+"""Evolutionary search controllers.
+
+Parity: /root/reference/python/paddle/fluid/contrib/slim/searcher/
+controller.py (EvolutionaryController base, SAController — simulated
+annealing over integer token lists with a geometric temperature
+schedule and Metropolis acceptance).
+"""
+from __future__ import annotations
+
+import copy
+import math
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["EvolutionaryController", "SAController"]
+
+
+class EvolutionaryController:
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+    def reset(self, range_table, init_tokens=None, constrain_func=None):
+        raise NotImplementedError
+
+    def next_tokens(self):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing (reference controller.py:59): propose a
+    random mutation of the best-known tokens, accept if better or with
+    probability exp(delta / T); T decays by ``reduce_rate`` per
+    update."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024.0, max_iter_number=300,
+                 seed=None):
+        self._range_table = list(range_table or [])
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._rng = np.random.RandomState(seed)
+        self._temperature = init_temperature
+        self._tokens = None            # current state
+        self._reward = -float("inf")
+        self.best_tokens = None
+        self.max_reward = -float("inf")
+        self._constrain_func = None
+        self._iter = 0
+
+    def reset(self, range_table, init_tokens=None, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._temperature = self._init_temperature
+        self._tokens = (list(init_tokens) if init_tokens is not None
+                        else [int(self._rng.randint(0, r))
+                              for r in self._range_table])
+        self._reward = -float("inf")
+        self.best_tokens = list(self._tokens)
+        self.max_reward = -float("inf")
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        """Metropolis step (reference controller.py:105)."""
+        self._iter += 1
+        self._temperature *= self._reduce_rate
+        if reward > self._reward or self._rng.rand() <= math.exp(
+                min((reward - self._reward)
+                    / max(self._temperature, 1e-12), 0.0)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self.max_reward:
+            self.max_reward = reward
+            self.best_tokens = list(tokens)
+
+    def next_tokens(self, control_token=None):
+        base = list(control_token if control_token is not None
+                    else self._tokens)
+        for _ in range(64):
+            cand = list(base)
+            i = int(self._rng.randint(0, len(cand)))
+            cand[i] = int(self._rng.randint(0, self._range_table[i]))
+            if self._constrain_func is None or \
+                    self._constrain_func(cand):
+                return cand
+        return base
+
+    def search(self, reward_fn: Callable[[Sequence[int]], float],
+               iterations: Optional[int] = None):
+        """Convenience driver: full SA loop, returns (best_tokens,
+        max_reward)."""
+        if self._tokens is None:
+            raise RuntimeError("call reset(range_table, ...) first")
+        for _ in range(iterations or self._max_iter_number):
+            tokens = self.next_tokens()
+            self.update(tokens, float(reward_fn(tokens)))
+        return list(self.best_tokens), self.max_reward

@@ -150,13 +150,30 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
 
     # -- hybrid composition: dp replicas of the pipeline, model axes
     # inside the stages (dp x pp x mp in ONE program) ---------------------
-    # axes used by transpiled shard specs (e.g. 'mp' for a sharded
-    # embedding table) are MODEL axes; any remaining non-pp axis is a
-    # DATA axis: the batch shards over it and the loss/grads average.
+    # MODEL axes are the ones transpiled ops actually use: var shard
+    # specs (mp tables) plus any op-level shard_axis attr (sp ring
+    # attention, ep MoE). Only a remaining axis DECLARED as a data
+    # axis may shard the batch — silently promoting an op axis to a
+    # batch axis runs to completion with wrong gradients (the hazard
+    # engine.py guards the same way).
     shard_specs = dict(getattr(program, "_var_shard_specs", None) or {})
+    if getattr(program, "_feed_shard_specs", None):
+        raise NotImplementedError(
+            "pipeline + per-feed shard specs (sequence parallelism) "
+            "is not supported — drop strategy.pipeline or the sp pass")
     model_axes = {a for spec in shard_specs.values() for a in spec if a}
+    model_axes |= {op.attrs.get("shard_axis")
+                   for op in program.global_block().ops
+                   if op.attrs.get("shard_axis")}
+    declared_data = set(getattr(program, "_data_axes", None) or ("dp",))
     dp_axes = tuple(a for a in mesh.axis_names
                     if a != axis_name and a not in model_axes)
+    bad = [a for a in dp_axes if a not in declared_data]
+    if bad:
+        raise ValueError(
+            "mesh axes %r are neither the pp axis, a model shard axis, "
+            "nor declared data axes %r — refusing to guess"
+            % (bad, sorted(declared_data)))
     if len(dp_axes) > 1:
         raise NotImplementedError(
             "at most one data axis composes with pp (got %r)"
