@@ -401,6 +401,11 @@ class PSClient:
         self._trainer_id = trainer_id
         self._timeout = timeout if timeout is not None else float(
             os.environ.get("PADDLE_PS_CONNECT_TIMEOUT", "15"))
+        # per-RPC read deadline: must exceed the server round timeout
+        # so only a dead/hung server trips it
+        self._rpc_deadline = float(
+            os.environ.get("PADDLE_PS_RPC_DEADLINE",
+                           str(_ROUND_TIMEOUT + 30.0)))
         self._io_lock = threading.Lock()
         self._seq = 0  # per-client sequence: lets the server dedupe the
         # reconnect-resend in _call (send_grad/barriers are not
@@ -419,11 +424,14 @@ class PSClient:
                 sock = socket.create_connection(
                     (host or "127.0.0.1", int(port)),
                     timeout=max(self._timeout, 1.0))
-                # reads must BLOCK: a sync barrier legitimately waits on
-                # the slowest trainer (server bounds it by
-                # _ROUND_TIMEOUT and replies an error) — a read timeout
-                # here would trigger reconnect-resend mid-round
-                sock.settimeout(None)
+                # reads get a DEADLINE above the server's round bound:
+                # a functioning server always replies within
+                # _ROUND_TIMEOUT (slow barriers get an error reply), so
+                # a longer client deadline only fires when the server
+                # is dead/hung mid-round — failing fast instead of
+                # hanging the trainer's sync send loop forever
+                # (reference grpc_client.cc deadline+retry semantics)
+                sock.settimeout(self._rpc_deadline)
                 return sock
             except OSError as e:
                 last = e
@@ -461,17 +469,41 @@ class PSClient:
             self._seq += 1
             msg["seq"] = self._seq
             msg["cid"] = self._cid
+            def _deadline_exceeded(note=""):
+                # the timed-out socket may hold a late/partial reply —
+                # reusing it would desync framing or hand the NEXT call
+                # the OLD response; drop it so the next call reconnects
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                raise RuntimeError(
+                    "pserver %s did not reply within the %.0fs RPC "
+                    "deadline%s (kind=%s) — the server is dead or "
+                    "hung; raise PADDLE_PS_RPC_DEADLINE if rounds "
+                    "legitimately run longer"
+                    % (self._endpoint, self._rpc_deadline, note,
+                       msg.get("kind")))
+
+            if self._sock is None:   # dropped by a prior deadline trip
+                self._sock = self._connect()
             try:
                 _send_msg(self._sock, msg, raw)
                 got = _recv_msg(self._sock)
+            except socket.timeout:
+                _deadline_exceeded()
             except OSError:
                 got = None
             if got is None:
                 # stale cached socket (server restarted): one reconnect
                 self._sock.close()
                 self._sock = self._connect()
-                _send_msg(self._sock, msg, raw)
-                got = _recv_msg(self._sock)
+                try:
+                    _send_msg(self._sock, msg, raw)
+                    got = _recv_msg(self._sock)
+                except socket.timeout:
+                    _deadline_exceeded(" after reconnect")
         if got is None:
             raise RuntimeError("pserver %s closed the connection"
                                % self._endpoint)

@@ -93,6 +93,39 @@ class PaddlePredictor:
             (self._program, self._feed_names,
              self._fetch_vars) = fluid.io.load_inference_model(
                  config.model_dir(), self._exe)
+            if config._ir_optim:
+                self._apply_ir_passes()
+
+    def _apply_ir_passes(self):
+        """Inference-graph optimization passes (the reference's
+        AnalysisPredictor pass pipeline, paddle_pass_builder.cc):
+        conv+BN folding needs parameter values (scope) and is the one
+        rewrite XLA cannot do itself pre-quantization; fc fusion keeps
+        the rewritten-graph contract tests honest."""
+        from .. import ir as _ir
+
+        fetch_names = {v.name for v in self._fetch_vars}
+        # snapshot scope array REFS (jax arrays are immutable; passes
+        # REBIND vars, e.g. conv+BN folds weights in place) so a
+        # rejected rewrite can roll the values back — keeping the old
+        # program with folded weights would apply BN twice
+        snap = {n: var.raw().array
+                for n, var in self._scope._vars.items()
+                if var.is_initialized()}
+        graph = _ir.IrGraph(self._program)
+        graph = _ir.ConvBnFusePass(scope=self._scope).apply(graph)
+        graph = _ir.FcFusePass().apply(graph)
+        new_prog = graph.to_program()
+        # the pass pipeline must not lose the fetch targets
+        new_block = new_prog.global_block()
+        if all(new_block._find_var_recursive(n) is not None
+               for n in fetch_names):
+            self._fetch_vars = [new_block._find_var_recursive(v.name)
+                                for v in self._fetch_vars]
+            self._program = new_prog
+        else:
+            for n, arr in snap.items():
+                self._scope.var(n).get_tensor()._array = arr
 
     def get_input_names(self) -> List[str]:
         return list(self._feed_names)

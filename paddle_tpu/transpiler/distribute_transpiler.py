@@ -2,14 +2,15 @@
 
 Parity: /root/reference/python/paddle/fluid/transpiler/
 distribute_transpiler.py (:95 slice_variable, :254 config, :540
-transpile, :1146 get_pserver_program). The program REWRITE places
-WHOLE params round-robin over pservers (a documented simplification of
-the reference, which additionally slices large params into blocks —
-slice_variable implements that split and is exercised standalone);
-trainer grads route through send/barrier/recv ops, and per-endpoint
-server programs carry listen_and_serv with optimizer sub-blocks, so
-transpiler-contract tests (reference test_dist_transpiler.py) assert
-the same op sequences.
+transpile, :1146 get_pserver_program). With ``slice_var_up`` (the
+default), large dense params are SLICED into row blocks spread over
+pservers — the trainer splits each grad, sends blocks to their
+hosting servers, and concats the recv'd param blocks; per-endpoint
+server programs run the optimizer on just their block (matching the
+reference's split_byref/concat rewrite). Trainer grads route through
+send/barrier/recv ops, and server programs carry listen_and_serv with
+optimizer sub-blocks, so transpiler-contract tests (reference
+test_dist_transpiler.py) assert the same op sequences.
 
 Runtime note (TPU-native): the send/recv ops execute against an
 in-process table registry when endpoints are local ("emulated PS") —
@@ -176,41 +177,106 @@ class DistributeTranspiler:
             moved = set(id(op) for op in self._table_init_ops)
             sblk.ops = [op for op in sblk.ops if id(op) not in moved]
 
-        # round-robin param blocks over endpoints (RoundRobin dispatcher)
+        # dense block-slicing (reference :95 wired into :540): a large
+        # dense param is split into row blocks spread over pservers —
+        # the trainer splits its grad, sends each block to its server,
+        # and concats the recv'd param blocks back; each server runs
+        # the optimizer on just its block
         eps = self.pserver_endpoints
+        self.dense_blocks: Dict[str, List[dict]] = {}
+        self._block_origin: Dict[str, tuple] = {}
+        if self.config.slice_var_up and len(eps) > 1:
+            for (p, g) in params_grads:
+                v = block._find_var_recursive(p)
+                if v is None or not v.shape:
+                    continue
+                vb = slice_variable([v], len(eps),
+                                    self.config.min_block_size)
+                if len(vb) <= 1:
+                    continue
+                dim1 = 1
+                for s in v.shape[1:]:
+                    dim1 *= int(s)
+                rows = [b.size // max(dim1, 1) for b in vb]
+                entries = []
+                for k, r in enumerate(rows):
+                    pb = "%s.block%d" % (p, k)
+                    gb = "%s.block%d" % (g, k)
+                    entries.append({"pname": pb, "gname": gb,
+                                    "rows": r, "bidx": k,
+                                    "origin_grad": g})
+                    self._block_origin[pb] = (p, r, k)
+                    self._block_origin[gb] = (g, r, k)
+                self.dense_blocks[p] = entries
+
+        # round-robin placement units: whole params AND blocks share
+        # one rolling counter (RoundRobin dispatcher)
         self.param_to_ep: Dict[str, str] = {}
         self.grad_to_ep: Dict[str, str] = {}
-        for i, (p, g) in enumerate(params_grads):
-            self.param_to_ep[p] = eps[i % len(eps)]
-            self.grad_to_ep[g] = eps[i % len(eps)]
+        unit = 0
+        for (p, g) in params_grads:
+            if p in self.dense_blocks:
+                for e in self.dense_blocks[p]:
+                    e["ep"] = eps[unit % len(eps)]
+                    unit += 1
+            else:
+                self.param_to_ep[p] = eps[unit % len(eps)]
+                self.grad_to_ep[g] = eps[unit % len(eps)]
+                unit += 1
 
         new_ops = [op for op in block.ops if op.type not in OPTIMIZER_OP_TYPES]
+
+        def _append(op_type, ins, outs, attrs):
+            op = framework.Operator(block, op_type, ins, outs, attrs)
+            op._id = self.origin_program._next_op_id()
+            new_ops.append(op)
+
+        # block vars on the trainer (grad splits + recv'd param blocks)
+        for p, entries in self.dense_blocks.items():
+            v = block._find_var_recursive(p)
+            tail = list(v.shape[1:])
+            g = entries[0]["origin_grad"]
+            for e in entries:
+                block.create_var(name=e["pname"],
+                                 shape=[e["rows"]] + tail, dtype=v.dtype)
+                block.create_var(name=e["gname"],
+                                 shape=[e["rows"]] + tail, dtype=v.dtype)
+            _append("split", {"X": [g]},
+                    {"Out": [e["gname"] for e in entries]},
+                    {"sections": [e["rows"] for e in entries],
+                     "axis": 0})
+
         # send grads -> barrier -> recv params -> barrier (sync mode)
         for p, g in params_grads:
-            op = framework.Operator(
-                block, "send", {"X": [g]}, {"Out": []},
-                {"epmap": [self.grad_to_ep[g]], "sync_mode": sync_mode,
-                 "table_name": g})
-            op._id = self.origin_program._next_op_id()
-            new_ops.append(op)
+            if p in self.dense_blocks:
+                for e in self.dense_blocks[p]:
+                    _append("send", {"X": [e["gname"]]}, {"Out": []},
+                            {"epmap": [e["ep"]], "sync_mode": sync_mode,
+                             "table_name": e["gname"]})
+            else:
+                _append("send", {"X": [g]}, {"Out": []},
+                        {"epmap": [self.grad_to_ep[g]],
+                         "sync_mode": sync_mode, "table_name": g})
         if sync_mode:
-            op = framework.Operator(
-                block, "send_barrier", {}, {},
-                {"endpoints": eps, "trainer_id": trainer_id})
-            op._id = self.origin_program._next_op_id()
-            new_ops.append(op)
+            _append("send_barrier", {}, {},
+                    {"endpoints": eps, "trainer_id": trainer_id})
         for p, g in params_grads:
-            op = framework.Operator(
-                block, "recv", {}, {"Out": [p]},
-                {"epmap": [self.param_to_ep[p]], "table_name": p})
-            op._id = self.origin_program._next_op_id()
-            new_ops.append(op)
+            if p in self.dense_blocks:
+                for e in self.dense_blocks[p]:
+                    _append("recv", {}, {"Out": [e["pname"]]},
+                            {"epmap": [e["ep"]],
+                             "table_name": e["pname"]})
+                _append("concat",
+                        {"X": [e["pname"]
+                               for e in self.dense_blocks[p]]},
+                        {"Out": [p]}, {"axis": 0})
+            else:
+                _append("recv", {}, {"Out": [p]},
+                        {"epmap": [self.param_to_ep[p]],
+                         "table_name": p})
         if sync_mode:
-            op = framework.Operator(
-                block, "fetch_barrier", {}, {},
-                {"endpoints": eps, "trainer_id": trainer_id})
-            op._id = self.origin_program._next_op_id()
-            new_ops.append(op)
+            _append("fetch_barrier", {}, {},
+                    {"endpoints": eps, "trainer_id": trainer_id})
         block.ops = new_ops
         self._transpiled = True
 
@@ -283,9 +349,80 @@ class DistributeTranspiler:
         pserver_program = framework.Program()
         pblock = pserver_program.global_block()
         hosted = [(p, g) for (p, g) in self.params_grads
-                  if self.param_to_ep[p] == endpoint]
+                  if p not in self.dense_blocks
+                  and self.param_to_ep[p] == endpoint]
         origin_block = self.origin_program.global_block()
         opt_blocks = []
+        grad_to_block_id = []
+
+        # dense row-blocks hosted here: the optimizer sub-block runs on
+        # the BLOCK (param/grad/accumulators all block-shaped)
+        for p, entries in self.dense_blocks.items():
+            g = entries[0]["origin_grad"]
+            pv = origin_block._find_var_recursive(p)
+            tail = list(pv.shape[1:])
+            full_rows = int(pv.shape[0])
+            for e in entries:
+                if e["ep"] != endpoint:
+                    continue
+                sfx = ".block%d" % e["bidx"]
+                pblock.create_var(name=e["pname"],
+                                  shape=[e["rows"]] + tail,
+                                  dtype=pv.dtype, persistable=True)
+                pblock.create_var(name=e["gname"],
+                                  shape=[e["rows"]] + tail,
+                                  dtype=pv.dtype)
+                sub = pserver_program._create_block()
+                for op in self._opt_ops:
+                    if op.input("Param")[0] != p:
+                        continue
+
+                    def _map(names):
+                        out = []
+                        for n in names:
+                            if n == p:
+                                out.append(e["pname"])
+                            elif n == g:
+                                out.append(e["gname"])
+                            else:
+                                v = origin_block._find_var_recursive(n)
+                                if (v is not None and v.shape
+                                        and tuple(v.shape)
+                                        and int(v.shape[0]) == full_rows
+                                        and list(v.shape[1:]) == tail):
+                                    # full-shaped accumulator
+                                    # (velocity/moment): block slice
+                                    bn = n + sfx
+                                    if not pblock.has_var_local(bn):
+                                        pblock.create_var(
+                                            name=bn,
+                                            shape=[e["rows"]] + tail,
+                                            dtype=v.dtype,
+                                            persistable=True)
+                                    self._block_origin.setdefault(
+                                        bn, (n, e["rows"], e["bidx"]))
+                                    out.append(bn)
+                                else:
+                                    if v is not None and \
+                                            not pblock.has_var_local(n):
+                                        pblock.create_var(
+                                            name=n, shape=v.shape,
+                                            dtype=v.dtype,
+                                            persistable=v.persistable)
+                                    out.append(n)
+                        return out
+
+                    nop = framework.Operator(
+                        sub, op.type,
+                        {k: _map(vv) for k, vv in op.inputs.items()},
+                        {k: _map(vv) for k, vv in op.outputs.items()},
+                        dict(op.attrs))
+                    nop._id = pserver_program._next_op_id()
+                    sub.ops.append(nop)
+                pserver_program._rollback()
+                opt_blocks.append(sub)
+                grad_to_block_id.append("%s:%d" % (e["gname"], sub.idx))
+
         for p, g in hosted:
             pv = origin_block._find_var_recursive(p)
             pblock.create_var(name=p, shape=pv.shape, dtype=pv.dtype,
@@ -313,8 +450,7 @@ class DistributeTranspiler:
                 sub.ops.append(nop)
             pserver_program._rollback()
             opt_blocks.append(sub)
-        grad_to_block_id = ["%s:%d" % (g, b.idx) for (p, g), b in
-                            zip(hosted, opt_blocks)]
+            grad_to_block_id.append("%s:%d" % (g, sub.idx))
 
         # distributed sparse-table slices hosted here: the var holds
         # THIS endpoint's row block [count, dim]; the sparse push writes
@@ -385,7 +521,11 @@ class DistributeTranspiler:
                     hosted.update(op.output_arg_names)
         else:
             hosted = {p for (p, g) in self.params_grads
-                      if self.param_to_ep[p] == endpoint}
+                      if self.param_to_ep.get(p) == endpoint}
+            hosted |= {e["pname"]
+                       for entries in getattr(self, "dense_blocks",
+                                              {}).values()
+                       for e in entries if e["ep"] == endpoint}
         # distributed-table slices: this endpoint initializes only ITS
         # row block, so the copied init op's shape attr is overridden
         ep_idx = (self.pserver_endpoints.index(endpoint)
@@ -406,6 +546,17 @@ class DistributeTranspiler:
                                     and tuple(v.shape)[0] == full):
                                 slice_shapes[name] = \
                                     [count] + list(v.shape[1:])
+        # dense row-blocks hosted here: each hosted block name maps
+        # back to its origin var (_block_origin) so the origin's init
+        # op is cloned once per block, outputs renamed + shape attr
+        # overridden to the block shape. (Random inits are drawn
+        # per-block — distribution-equivalent to slicing one draw.)
+        origin_to_blocks: Dict[str, List[str]] = {}
+        for bn, (orig, rows, k) in getattr(self, "_block_origin",
+                                           {}).items():
+            if bn in hosted:
+                origin_to_blocks.setdefault(orig, []).append(bn)
+
         for op in list(src.ops) + list(getattr(self, "_table_init_ops",
                                                [])):
             outs = op.output_arg_names
@@ -425,6 +576,35 @@ class DistributeTranspiler:
                     blk, op.type,
                     {k: list(vv) for k, vv in op.inputs.items()},
                     {k: list(vv) for k, vv in op.outputs.items()},
+                    attrs)
+                nop._id = sp._next_op_id()
+                blk.ops.append(nop)
+                continue
+            block_outs = [o for o in outs if o in origin_to_blocks]
+            if not block_outs:
+                continue
+            orig = block_outs[0]
+            v = src._find_var_recursive(orig)
+            tail = list(v.shape[1:]) if v is not None and v.shape \
+                else []
+            for bn in origin_to_blocks[orig]:
+                _, rows, _k = self._block_origin[bn]
+                attrs = dict(op.attrs)
+                if "shape" in attrs:
+                    attrs["shape"] = [rows] + tail
+                if attrs.get("seed"):
+                    # a seeded random init must not draw IDENTICAL
+                    # blocks; derive a distinct per-block seed
+                    attrs["seed"] = int(attrs["seed"]) + 7919 * (_k + 1)
+                if not blk.has_var_local(bn):
+                    blk.create_var(name=bn, shape=[rows] + tail,
+                                   dtype=v.dtype if v is not None
+                                   else "float32", persistable=True)
+                nop = framework.Operator(
+                    blk, op.type,
+                    {k: list(vv) for k, vv in op.inputs.items()},
+                    {k: [bn if n == orig else n for n in vv]
+                     for k, vv in op.outputs.items()},
                     attrs)
                 nop._id = sp._next_op_id()
                 blk.ops.append(nop)

@@ -1,0 +1,100 @@
+"""Worker for the dense block-slicing PS test: TWO real pserver
+processes each host ONE row block of the same fc weight
+(slice_variable wired into the dataplane — reference
+distribute_transpiler.py:95,540,1146); the trainer splits grads,
+sends per-block, and concats recv'd blocks. Parity with the
+single-process oracle is asserted by the pytest harness."""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+STEPS = 5
+BS = 16
+
+
+def _net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[BS, 16], dtype="float32")
+        y = fluid.data(name="y", shape=[BS, 1], dtype="float32")
+        h = fluid.layers.fc(
+            x, 8, act="relu",
+            param_attr=fluid.ParamAttr(
+                name="w",
+                initializer=fluid.initializer.ConstantInitializer(0.12)),
+            bias_attr=fluid.ParamAttr(
+                name="b",
+                initializer=fluid.initializer.ConstantInitializer(0.0)))
+        pred = fluid.layers.fc(
+            h, 1,
+            param_attr=fluid.ParamAttr(
+                name="w2",
+                initializer=fluid.initializer.ConstantInitializer(0.2)),
+            bias_attr=fluid.ParamAttr(
+                name="b2",
+                initializer=fluid.initializer.ConstantInitializer(0.0)))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _transpiler(endpoints):
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.min_block_size = 64   # w is [16, 8] = 128 elems -> 2 blocks
+    return fluid.DistributeTranspiler(config=cfg), endpoints
+
+
+def main():
+    role = os.environ["PADDLE_TRAINING_ROLE"]
+    endpoints = os.environ["PSERVER_ENDPOINTS"].split(",")
+    out_path = sys.argv[1]
+
+    main_prog, startup, loss = _net()
+    t, eps = _transpiler(endpoints)
+    t.transpile(trainer_id=0, program=main_prog, startup_program=startup,
+                pservers=",".join(eps), trainers=1, sync_mode=True)
+
+    if role == "PSERVER":
+        endpoint = os.environ["PSERVER_ENDPOINT"]
+        os.environ["PADDLE_PSERVER_RPC"] = "1"
+        ps_prog = t.get_pserver_program(endpoint)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(t.get_startup_program(endpoint, ps_prog))
+        exe.run(ps_prog)  # serve until shutdown
+        return
+
+    # trainer
+    assert "w" in t.dense_blocks, "w must be block-sliced"
+    blocks = t.dense_blocks["w"]
+    assert len(blocks) == 2
+    assert len({e["ep"] for e in blocks}) == 2, \
+        "the two blocks must land on DIFFERENT servers"
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(5)
+    W = rng.randn(16, 1).astype("float32")
+    losses = []
+    for _ in range(STEPS):
+        xb = rng.randn(BS, 16).astype("float32")
+        (l,) = exe.run(main_prog, feed={"x": xb, "y": xb @ W},
+                       fetch_list=[loss])
+        losses.append(float(np.asarray(l).ravel()[0]))
+    scope = fluid.global_scope()
+    w_final = np.asarray(scope.find_var("w").raw().array)
+
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    for ep in endpoints:
+        PSClient.for_endpoint(ep).shutdown_server()
+    with open(out_path, "w") as f:
+        f.write(json.dumps({"losses": losses,
+                            "w_final": w_final.tolist(),
+                            "block_eps": [e["ep"] for e in blocks]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -417,3 +417,68 @@ class TestLoaderErrorPropagation:
         assert len(list(loader)) == 3
         # and a second epoch still works (handler stays healthy)
         assert len(list(loader)) == 3
+
+
+class TestPredictorIrPasses:
+    def test_conv_bn_fold_in_predictor_prepare(self):
+        """The predictor's prepare runs the ir fusion passes (reference
+        AnalysisPredictor pass pipeline, paddle_pass_builder.cc):
+        conv+BN folds into the conv weights, outputs unchanged."""
+        import tempfile
+
+        from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                          create_paddle_predictor)
+
+        B = 2
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.data(name="img", shape=[B, 3, 8, 8],
+                             dtype="float32")
+            c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                    padding=1, bias_attr=False)
+            bn = fluid.layers.batch_norm(c, is_test=True)
+            out = fluid.layers.relu(bn)
+        rng = np.random.RandomState(0)
+        x = rng.rand(B, 3, 8, 8).astype("float32")
+        scope = fluid.Scope()
+        with tempfile.TemporaryDirectory() as d:
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                # perturb BN stats so folding is non-trivial
+                import jax.numpy as jnp
+
+                for n, v in main.global_block().vars.items():
+                    if "batch_norm" in n and ("mean" in n or
+                                              "variance" in n):
+                        arr = np.asarray(scope.find_var(n).raw().array)
+                        scope.var(n).get_tensor()._array = jnp.asarray(
+                            arr + rng.rand(*arr.shape).astype("float32")
+                            * 0.3 + 0.1)
+                (ref,) = exe.run(main, feed={"img": x},
+                                 fetch_list=[out])
+                fluid.io.save_inference_model(d, ["img"], [out], exe,
+                                              main_program=main)
+            config = AnalysisConfig(d)
+            config.disable_gpu()
+            p_opt = create_paddle_predictor(config)
+            types = [op.type for op in
+                     p_opt._program.global_block().ops]
+            assert "batch_norm" not in types, types  # folded
+            (got,) = p_opt.run([PaddleTensor(x, name="img")])
+            np.testing.assert_allclose(got.as_ndarray(),
+                                       np.asarray(ref), rtol=1e-4,
+                                       atol=1e-5)
+
+            # switch_ir_optim(False) keeps the raw graph
+            config2 = AnalysisConfig(d)
+            config2.disable_gpu()
+            config2.switch_ir_optim(False)
+            p_raw = create_paddle_predictor(config2)
+            types2 = [op.type for op in
+                      p_raw._program.global_block().ops]
+            assert "batch_norm" in types2
+            (got2,) = p_raw.run([PaddleTensor(x, name="img")])
+            np.testing.assert_allclose(got2.as_ndarray(),
+                                       np.asarray(ref), rtol=1e-4,
+                                       atol=1e-5)

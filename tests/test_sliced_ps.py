@@ -1,0 +1,209 @@
+"""Dense param block-slicing in the PS dataplane (VERDICT r4 #4).
+
+Reference contract: distribute_transpiler.py:95 (slice_variable), :540
+(split send), :1146 (per-block server optimize blocks). One fc weight
+is split into row blocks across TWO pservers; the trainer splits its
+grad, each server runs the optimizer on its block, the trainer concats
+recv'd blocks — and training matches the single-process oracle."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+WORKER = os.path.join(os.path.dirname(__file__),
+                      "dist_worker_sliced_ps.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sliced_net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data(name="x", shape=[16, 16], dtype="float32")
+        y = fluid.data(name="y", shape=[16, 1], dtype="float32")
+        h = fluid.layers.fc(
+            x, 8, act="relu",
+            param_attr=fluid.ParamAttr(
+                name="w",
+                initializer=fluid.initializer.ConstantInitializer(0.12)),
+            bias_attr=fluid.ParamAttr(
+                name="b",
+                initializer=fluid.initializer.ConstantInitializer(0.0)))
+        pred = fluid.layers.fc(
+            h, 1,
+            param_attr=fluid.ParamAttr(
+                name="w2",
+                initializer=fluid.initializer.ConstantInitializer(0.2)),
+            bias_attr=fluid.ParamAttr(
+                name="b2",
+                initializer=fluid.initializer.ConstantInitializer(0.0)))
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.MomentumOptimizer(0.05, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _cfg():
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.min_block_size = 64   # w [16, 8] = 128 elements -> 2 blocks
+    return cfg
+
+
+def test_transpiled_block_contract():
+    main, startup, loss = _sliced_net()
+    t = fluid.DistributeTranspiler(config=_cfg())
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers="ps0:7164,ps1:7164", trainers=1)
+    assert "w" in t.dense_blocks
+    rows = [e["rows"] for e in t.dense_blocks["w"]]
+    assert sum(rows) == 16 and len(rows) == 2
+    types = [op.type for op in main.global_block().ops]
+    assert "split" in types and "concat" in types
+    sends = [op for op in main.global_block().ops if op.type == "send"]
+    block_sends = [op for op in sends
+                   if ".block" in op.attrs["table_name"]]
+    assert len(block_sends) == 2
+    assert {op.attrs["epmap"][0] for op in block_sends} == \
+        {"ps0:7164", "ps1:7164"}
+
+    # each server hosts exactly one w-block (param + momentum velocity
+    # block-shaped), and its optimize sub-block updates the BLOCK
+    for ep in ("ps0:7164", "ps1:7164"):
+        ps = t.get_pserver_program(ep)
+        pb = ps.global_block()
+        wblocks = [n for n in pb.vars if n.startswith("w.block")]
+        assert len(wblocks) == 1
+        bvar = pb.vars[wblocks[0]]
+        assert tuple(bvar.shape)[0] in (8,)    # 8 rows each
+        serv = pb.ops[-1]
+        assert serv.type == "listen_and_serv"
+        momentum_params = []
+        for sub in serv.attrs["optimize_blocks"]:
+            for op in sub.ops:
+                if op.type == "momentum":
+                    momentum_params.append(op.input("Param")[0])
+        assert any(p.startswith("w.block") for p in momentum_params)
+        # startup initializes the block at BLOCK shape
+        sp = t.get_startup_program(ep, ps)
+        inits = {o: op for op in sp.global_block().ops
+                 for o in op.output_arg_names}
+        assert wblocks[0] in inits
+        assert list(inits[wblocks[0]].attrs["shape"]) == [8, 8]
+
+
+def test_emulated_sliced_ps_matches_single_process():
+    from paddle_tpu.ops.distributed_ops import reset_emulated_servers
+
+    rng = np.random.RandomState(5)
+    W = rng.randn(16, 1).astype("float32")
+    batches = [rng.randn(16, 16).astype("float32") for _ in range(20)]
+
+    # oracle: plain single-process training of the same net
+    main_o, startup_o, loss_o = _sliced_net()
+    scope_o = fluid.Scope()
+    with fluid.scope_guard(scope_o):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup_o)
+        oracle_losses = []
+        for xb in batches:
+            (l,) = exe.run(main_o, feed={"x": xb, "y": xb @ W},
+                           fetch_list=[loss_o])
+            oracle_losses.append(float(np.asarray(l).ravel()[0]))
+        w_oracle = np.asarray(scope_o.find_var("w").raw().array)
+
+    # transpiled: 2 emulated pservers, w sliced across them
+    reset_emulated_servers()
+    main, startup, loss = _sliced_net()
+    t = fluid.DistributeTranspiler(config=_cfg())
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers="ps0:7164,ps1:7164", trainers=1)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        for ep in ("ps0:7164", "ps1:7164"):
+            psprog = t.get_pserver_program(ep)
+            exe.run(t.get_startup_program(ep, psprog))
+            exe.run(psprog)
+        exe.run(startup)
+        losses = []
+        for xb in batches:
+            (l,) = exe.run(t.get_trainer_program(),
+                           feed={"x": xb, "y": xb @ W},
+                           fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        w_sliced = np.asarray(scope.find_var("w").raw().array)
+
+    np.testing.assert_allclose(losses, oracle_losses, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(w_sliced, w_oracle, rtol=1e-5,
+                               atol=1e-6)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_multiprocess_sliced_ps(tmp_path):
+    """TWO real pserver processes, one block of the same fc weight
+    each; parity with the single-process oracle across real process
+    boundaries (the VERDICT r4 #4 'done' bar)."""
+    eps = ["127.0.0.1:%d" % _free_port(), "127.0.0.1:%d" % _free_port()]
+    out = tmp_path / "trainer.json"
+
+    def env(role, ep=""):
+        e = dict(os.environ)
+        e.update({"PADDLE_TRAINING_ROLE": role,
+                  "PSERVER_ENDPOINTS": ",".join(eps),
+                  "PSERVER_ENDPOINT": ep,
+                  "JAX_PLATFORMS": "cpu",
+                  "PYTHONPATH": REPO + os.pathsep
+                  + e.get("PYTHONPATH", "")})
+        return e
+
+    servers = [subprocess.Popen(
+        [sys.executable, WORKER, str(tmp_path / ("ps%d" % i))],
+        env=env("PSERVER", ep), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+        for i, ep in enumerate(eps)]
+    try:
+        tr = subprocess.run([sys.executable, WORKER, str(out)],
+                            env=env("TRAINER"), capture_output=True,
+                            text=True, timeout=240)
+        assert tr.returncode == 0, tr.stderr[-3000:]
+        for ps in servers:
+            ps.wait(timeout=60)
+    finally:
+        for ps in servers:
+            if ps.poll() is None:
+                ps.kill()
+    result = json.loads(out.read_text())
+    assert len(set(result["block_eps"])) == 2
+
+    # oracle in-process
+    rng = np.random.RandomState(5)
+    W = rng.randn(16, 1).astype("float32")
+    main_o, startup_o, loss_o = _sliced_net()
+    scope_o = fluid.Scope()
+    with fluid.scope_guard(scope_o):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup_o)
+        oracle = []
+        for _ in range(5):
+            xb = rng.randn(16, 16).astype("float32")
+            (l,) = exe.run(main_o, feed={"x": xb, "y": xb @ W},
+                           fetch_list=[loss_o])
+            oracle.append(float(np.asarray(l).ravel()[0]))
+        w_oracle = np.asarray(scope_o.find_var("w").raw().array)
+    np.testing.assert_allclose(result["losses"], oracle, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(result["w_final"]), w_oracle,
+                               rtol=1e-5, atol=1e-6)
