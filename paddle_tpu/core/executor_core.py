@@ -239,6 +239,18 @@ class CoreExecutor:
                     total = lod[-1][-1] if (lod and len(lod[-1])) else 0
                     if len(v.shape) == 0 or int(v.shape[0]) != int(total):
                         lod = None
+                # a PERSISTABLE output (param / optimizer state) never
+                # carries a sequence lod: a table grad whose row count
+                # HAPPENS to equal a batch's token total would otherwise
+                # stamp a sequence lod onto the table, poisoning later
+                # batches' propagate (row-count guard can't catch the
+                # coincidence)
+                if lod is not None:
+                    bv = op.block._find_var_recursive(n) \
+                        if getattr(op, "block", None) is not None else None
+                    if bv is not None and getattr(bv, "persistable",
+                                                  False):
+                        lod = None
                 # no inferred lod -> CLEAR any stale lod on the reused
                 # scope tensor rather than silently keeping it
                 self._write_var(scope, n, v,
@@ -285,11 +297,20 @@ class CoreExecutor:
             for (slot, i), lod in res.items():
                 out_lods[(slot, i)] = lod
             return out_lods
-        # "propagate": first input slot's lod flows to every output.
+        # "propagate": first NON-PERSISTABLE input slot's lod flows to
+        # every output (a param slot like lookup_table's W must never
+        # be the lod source — see the persistable-output guard).
         src = None
+        blk = getattr(op, "block", None)
         for slot in info.inputs:
             lods = in_lods.get(slot.name)
             if lods and lods[0]:
+                names = op.input(slot.name)
+                if blk is not None and names:
+                    bv = blk._find_var_recursive(names[0])
+                    if bv is not None and getattr(bv, "persistable",
+                                                  False):
+                        continue
                 src = lods[0]
                 break
         if src:

@@ -33,14 +33,29 @@ RANK_SAFE = {
     "lookup_table", "lookup_table_v2", "relu", "tanh", "sigmoid", "gelu",
     "scale", "cast", "dropout", "square", "abs", "softsign", "sqrt",
     "exp", "log",
+    # elementwise over ragged operands: safe when every ragged operand
+    # shares ONE length var (checked in plan) — a dense [D] bias
+    # broadcasts identically in the padded domain
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    # grad accumulation of ragged partials (same length var, checked)
+    "sum",
 }
 
-# sequence op -> (padded twin, collapses_ragged): a pooling op's output
-# is DENSE [B, ...]; a softmax's output is still ragged [B, T, ...] and
-# its consumers must stay guarded
+# sequence op -> (padded twin, collapses_ragged). A collapsing op's
+# output is DENSE [B, ...]; a non-collapsing op's output is still
+# ragged [B, T, ...] and its consumers stay guarded. Ragged vars are
+# tracked by their LENGTH VAR (feeds use <feed>@SEQ_LEN; derived ops
+# like sequence_concat emit new length vars in-graph), so lengths can
+# flow through value-producing ops (sequence_pad's Length output feeds
+# a later sequence_unpad).
 SWAPS = {
     "sequence_pool": ("sequence_pool_padded", True),
     "sequence_softmax": ("sequence_softmax_padded", False),
+    "sequence_conv": ("sequence_conv_padded", False),
+    "sequence_expand": ("sequence_expand_padded", False),
+    "sequence_pad": ("sequence_pad_padded", True),
+    "sequence_unpad": ("sequence_unpad_padded", False),
+    "sequence_concat": ("sequence_concat_padded", False),
 }
 
 
@@ -50,38 +65,125 @@ def _grad_base(name: str) -> Optional[str]:
     return name[:i] if i > 0 else None
 
 
+LAST_DECLINE = None
+
+
 def plan_lowering(program, lod_feeds):
-    """(swaps, ragged) where swaps maps op index -> (padded op type,
-    origin feed) for every sequence op (and its grad) touching ragged
-    data, and ragged maps every ragged var -> its origin feed; None if
-    any unsupported op touches the ragged region."""
+    """(swaps, ragged, axis_bumps) where swaps maps op index ->
+    (padded op type, [length var names]) for every sequence op (and
+    its grad) touching ragged data, ragged maps every ragged var ->
+    its length var, and axis_bumps lists elementwise ops whose dense-
+    operand axis shifts right in the padded domain. None if any
+    unsupported op/pattern touches the ragged region — the reason is
+    recorded in ``LAST_DECLINE`` for the executor's fallback
+    diagnostics."""
     block = program.global_block()
-    ragged: Dict[str, str] = {f: f for f in lod_feeds}
-    swaps: Dict[int, Tuple[str, str]] = {}
+    ragged: Dict[str, str] = {f: _len_name(f) for f in lod_feeds}
+    swaps: Dict[int, Tuple[str, List[str]]] = {}
+    axis_bumps: List[int] = []
     for i, op in enumerate(block.ops):
         ins = [n for n in op.input_arg_names if n]
         r_ins = [n for n in ins if n in ragged]
+        if op.type == "sequence_unpad" and not r_ins:
+            # host op with DENSE inputs (padded values + a length
+            # value var): always lowers — the twin is the identity and
+            # the output's raggedness keys off the Length input var
+            swaps[i] = ("sequence_unpad_padded", [])
+            for o in op.output("Out"):
+                ragged[o] = op.input("Length")[0]
+            continue
         if not r_ins:
             continue
-        origin = ragged[r_ins[0]]
         is_grad = op.type.endswith("_grad")
         base_type = op.type[:-5] if is_grad else op.type
+        def _decline(why):
+            global LAST_DECLINE
+            LAST_DECLINE = (i, op.type, why)
+            return None
+
         if base_type in SWAPS:
             new_type, collapses = SWAPS[base_type]
-            swaps[i] = (new_type + ("_grad" if is_grad else ""), origin)
+            lens: List[str] = []
+            if base_type == "sequence_conv":
+                if op.attrs.get("paddingTrainable"):
+                    return _decline("trainable conv padding")
+                x = op.input("X")[0]
+                if x not in ragged:
+                    return _decline("conv of non-ragged X")
+                lens = [ragged[x]]
+                out_len = lens[0]
+            elif base_type == "sequence_expand":
+                x, y = op.input("X")[0], op.input("Y")[0]
+                if x in ragged or y not in ragged:
+                    # ragged-X expand changes batch size by data —
+                    # inherently dynamic; interpreter keeps it exact
+                    return _decline("ragged-X expand")
+                lens = [ragged[y]]
+                out_len = lens[0]
+            elif base_type == "sequence_pad":
+                x = op.input("X")[0]
+                if x not in ragged:
+                    return _decline("pad of non-ragged X")
+                if int(op.attrs.get("padded_length", -1)) < 0:
+                    # pad-to-batch-max: the compiled twin would pad to
+                    # the BUCKET length instead — a fetch of the dense
+                    # Out would diverge between paths
+                    return _decline("sequence_pad without explicit "
+                                    "padded_length")
+                lens = [ragged[x]]
+                out_len = None   # Out is dense
+            elif base_type == "sequence_unpad":
+                # X is a padded DENSE tensor; the Length INPUT var (a
+                # value in the graph, e.g. sequence_pad's output)
+                # becomes the output's length var
+                if op.input("X")[0] in ragged:
+                    return _decline("unpad of ragged X")
+                lens = []        # Length input already wired
+                out_len = op.input("Length")[0]
+            elif base_type == "sequence_concat":
+                xs = op.input("X")
+                if not all(x in ragged for x in xs):
+                    return _decline("concat of mixed ragged/dense")
+                lens = [ragged[x] for x in xs]
+                out_len = "NEW"  # twin emits OutLength
+            else:   # pool / softmax
+                x = op.input("X")[0]
+                if x not in ragged:
+                    return _decline("pool/softmax of non-ragged X")
+                lens = [ragged[x]]
+                out_len = None if collapses else lens[0]
+            swaps[i] = (new_type + ("_grad" if is_grad else ""), lens)
             if is_grad:
                 # X@GRAD is ragged-shaped like X
                 for o in op.output_arg_names:
                     b = _grad_base(o)
                     if o and b in ragged:
                         ragged[o] = ragged[b]
-            elif not collapses:
-                # softmax keeps raggedness: consumers stay guarded
-                for o in op.output_arg_names:
-                    if o:
-                        ragged[o] = origin
+            else:
+                if out_len == "NEW":
+                    out0 = op.output("Out")[0]
+                    for o in op.output("Out"):
+                        ragged[o] = out0 + "@SEQ_LEN"
+                elif out_len is not None:
+                    for o in op.output("Out"):
+                        if o:
+                            ragged[o] = out_len
             continue
         if base_type in RANK_SAFE:
+            if len({ragged[n] for n in r_ins}) > 1:
+                return _decline("mixed-length elementwise")
+            if base_type.startswith("elementwise_"):
+                x_in = op.input("X")
+                y_in = op.input("Y")
+                if x_in and y_in and x_in[0] not in ragged \
+                        and y_in[0] in ragged:
+                    return _decline("dense-X + ragged-Y elementwise")
+                axis = int(op.attrs.get("axis", -1))
+                if axis >= 0 and y_in and y_in[0] not in ragged:
+                    # padded X gained a leading batch dim: a
+                    # left-aligned dense-Y broadcast shifts right by one
+                    axis_bumps.append(i)
+            origin = ragged[r_ins[0]]
             for o in op.output_arg_names:
                 if not o:
                     continue
@@ -92,8 +194,8 @@ def plan_lowering(program, lod_feeds):
                 else:
                     ragged[o] = origin
             continue
-        return None  # unsupported op consumes ragged data
-    return swaps, ragged
+        return _decline("unsupported op consumes ragged data")
+    return swaps, ragged, axis_bumps
 
 
 def _len_name(feed: str) -> str:
@@ -102,26 +204,39 @@ def _len_name(feed: str) -> str:
 
 def build_lowered(program, lod_feeds):
     """Lowered clone of ``program`` (sequence ops -> padded twins wired
-    to per-feed length vars), or None when the plan fails. Returns the
-    3-tuple (clone, feeds-to-pad set, all-ragged-var set) — the last is
-    the set of vars whose fetch would return PADDED values (the
-    executor refuses those fetches)."""
+    to length vars), or None when the plan fails. Returns the 3-tuple
+    (clone, feeds-to-pad set, all-ragged-var set) — the last is the set
+    of vars whose fetch would return PADDED values (the executor
+    refuses those fetches)."""
     plan = plan_lowering(program, lod_feeds)
     if plan is None:
         return None
-    swaps, ragged = plan
+    swaps, ragged, axis_bumps = plan
     clone = program.clone()
     block = clone.global_block()
     for f in lod_feeds:
         block.create_var(name=_len_name(f), shape=None, dtype="int64")
-    for i, (new_type, origin) in swaps.items():
+    for i, (new_type, lens) in swaps.items():
         op = block.ops[i]
         op.type = new_type
         op.inputs = dict(op.inputs)
-        op.inputs["Length"] = [_len_name(origin)]
+        if lens:
+            op.inputs["Length"] = list(lens)
+        if new_type.startswith("sequence_concat_padded") and \
+                not new_type.endswith("_grad"):
+            out0 = op.output("Out")[0]
+            ln = out0 + "@SEQ_LEN"
+            op.outputs = dict(op.outputs)
+            op.outputs["OutLength"] = [ln]
+            if not block.has_var_local(ln):
+                block.create_var(name=ln, shape=None, dtype="int64")
         if "MaxIndex" in op.outputs:
             op.outputs = {k: v for k, v in op.outputs.items()
                           if k != "MaxIndex"}
+    for i in axis_bumps:
+        op = block.ops[i]
+        op.attrs = dict(op.attrs)
+        op.attrs["axis"] = int(op.attrs.get("axis", -1)) + 1
     clone._next_op_id()  # distinct version vs the original
     return clone, set(lod_feeds), set(ragged)
 

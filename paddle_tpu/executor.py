@@ -139,8 +139,18 @@ class Executor:
             from .core.compiler_engine import block_is_traceable
 
             built = build_lowered(program, lod_feeds)
-            if built is not None and not block_is_traceable(
-                    built[0].global_block()):
+            if built is None:
+                from .core import lod_lowering as _ll
+
+                if _ll.LAST_DECLINE is not None:
+                    import warnings
+
+                    warnings.warn(
+                        "LoD lowering declined for program %s (op #%d "
+                        "%s: %s) — ragged steps take the op-by-op "
+                        "interpreter" % ((program._uid,)
+                                         + tuple(_ll.LAST_DECLINE)))
+            elif not block_is_traceable(built[0].global_block()):
                 built = None  # other blockers remain (while bodies...)
             self._lod_lowered_cache[ver] = built if built is not None \
                 else False

@@ -161,7 +161,14 @@ def sequence_pad(x, pad_value, maxlen=None, name=None):
         inputs={"X": [x], "PadValue": [pad_value]},
         outputs={"Out": [out], "Length": [length]},
         attrs={"padded_length": maxlen if maxlen is not None else -1},
+        infer_shape=False,
     )
+    # [sum, ...] -> [B, maxlen, ...]: downstream layers (fc etc.) size
+    # their params from this metadata
+    out.shape = (-1, maxlen if maxlen is not None else -1) \
+        + tuple(x.shape[1:])
+    out.dtype = x.dtype
+    length.shape = (-1,)
     return out, length
 
 

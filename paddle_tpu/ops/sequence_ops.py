@@ -222,12 +222,26 @@ def _sequence_reshape(ins, attrs):
     return {"Out": x.reshape(-1, attrs["new_dim"])}
 
 
+def _concat_out_lod(in_lods, attrs):
+    """Out seq i = concat of each input's seq i: out offsets are the
+    elementwise-summed lengths (LoD depends on input LoDs only)."""
+    lods = in_lods.get("X") or []
+    offs = [list(l[-1]) for l in lods if l]
+    if not offs:
+        return {}
+    n = min(len(o) - 1 for o in offs)
+    out = [0]
+    for i in range(n):
+        out.append(out[-1] + sum(o[i + 1] - o[i] for o in offs))
+    return {("Out", 0): (tuple(out),)}
+
+
 @register_op(
     "sequence_concat",
     inputs=[In("X", duplicable=True)],
     outputs=[Out("Out")],
     needs_lod=True,
-    infer_lod=None,
+    infer_lod=_concat_out_lod,
 )
 def _sequence_concat(ins, attrs):
     xs = ins["X"]
@@ -442,3 +456,157 @@ def _sequence_erase(executor, op, scope):
     # (sequence_erase_op.h:66-70)
     t.set_lod([list(l) for l in lod[:-1]] + [out_offs])
     executor._write_var(scope, op.output("Out")[0], t)
+
+
+@register_op(
+    "sequence_conv_padded",
+    inputs=[In("X"), In("Filter"), In("Length", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"contextLength": 3, "contextStart": -1, "contextStride": 1,
+           "paddingTrainable": False},
+)
+def _sequence_conv_padded(ins, attrs):
+    """Context-window conv over padded [B, T, D] + lengths — the
+    whole-compile twin of sequence_conv (math/context_project.h):
+    window rows outside [0, len_b) are zero; padded output rows are
+    zeroed so grads stay clean."""
+    x, filt, ln = ins["X"], ins["Filter"], ins["Length"]
+    L = int(attrs.get("contextLength", 3))
+    start = int(attrs.get("contextStart", -1))
+    B, T = x.shape[0], x.shape[1]
+    lens = ln.reshape(-1)
+    t = jnp.arange(T)
+    cols = []
+    for j in range(L):
+        idx = t + start + j                            # [T]
+        inside = (idx >= 0)[None, :] & (idx[None, :] < lens[:, None])
+        g = jnp.take(x, jnp.clip(idx, 0, T - 1), axis=1)
+        cols.append(jnp.where(inside[..., None], g, 0.0))
+    im = jnp.concatenate(cols, axis=2)                 # [B, T, L*D]
+    out = jnp.einsum("btk,kf->btf", im.astype(filt.dtype), filt)
+    valid = (t[None, :] < lens[:, None])[..., None]
+    return {"Out": jnp.where(valid, out, 0.0)}
+
+
+@register_op(
+    "sequence_expand_padded",
+    inputs=[In("X"), In("Y", no_grad=True), In("Length", no_grad=True)],
+    outputs=[Out("Out")],
+    attrs={"ref_level": -1},
+)
+def _sequence_expand_padded(ins, attrs):
+    """Whole-compile twin of the book-MT sequence_expand pattern: X is
+    DENSE per-sequence ([B, D...], e.g. the encoder final state) and is
+    broadcast along Y's time dim, masked by Y's lengths. (The general
+    ragged-X expand changes the batch size by data — inherently
+    dynamic; those programs stay on the interpreter.)"""
+    x, y, ln = ins["X"], ins["Y"], ins["Length"]
+    T = y.shape[1]
+    lens = ln.reshape(-1)
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    valid = (jnp.arange(T)[None, :] < lens[:, None])
+    valid = valid.reshape(valid.shape + (1,) * (out.ndim - 2))
+    return {"Out": jnp.where(valid, out, 0.0)}
+
+
+@register_op(
+    "sequence_pad_padded",
+    inputs=[In("X"), In("PadValue"), In("Length", no_grad=True)],
+    outputs=[Out("Out"), Out("Length", no_grad=True)],
+    attrs={"padded_length": -1},
+)
+def _sequence_pad_padded(ins, attrs):
+    """Whole-compile twin of sequence_pad: the input is already the
+    padded rep [B, T, ...]; re-pad/slice to ``padded_length`` (or keep
+    the bucket T — the static analog of the reference's
+    pad-to-batch-max) with PadValue in the tail rows, emit lengths."""
+    x, pad, ln = ins["X"], ins["PadValue"], ins["Length"]
+    B, T = x.shape[0], x.shape[1]
+    # clamp: the reference REJECTS padded_length < max seq len; inputs
+    # violating that contract get consistent truncation here (Length
+    # output clamps with the values, so downstream masks agree)
+    lens = jnp.minimum(ln.reshape(-1),
+                       int(attrs.get("padded_length", -1)))
+    plen = int(attrs.get("padded_length", -1))
+    if plen < 0:
+        plen = T
+        lens = ln.reshape(-1)
+    if plen > T:
+        x = jnp.pad(x, [(0, 0), (0, plen - T)]
+                    + [(0, 0)] * (x.ndim - 2))
+    elif plen < T:
+        x = x[:, :plen]
+    valid = (jnp.arange(plen)[None, :] < lens[:, None])
+    valid = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+    fill = jnp.broadcast_to(pad.reshape((1,) * x.ndim),
+                            x.shape).astype(x.dtype)
+    return {"Out": jnp.where(valid, x, fill),
+            "Length": lens.astype(jnp.int64)}
+
+
+@register_op(
+    "sequence_unpad_padded",
+    inputs=[In("X"), In("Length", no_grad=True)],
+    outputs=[Out("Out")],
+)
+def _sequence_unpad_padded(ins, attrs):
+    """Whole-compile twin of sequence_unpad: in the padded domain the
+    ragged rep IS [B, T, ...] + lengths, so this is the identity on
+    values; the lowering re-keys the output's raggedness to the Length
+    input var."""
+    return {"Out": ins["X"]}
+
+
+@register_op(
+    "sequence_concat_padded",
+    inputs=[In("X", duplicable=True),
+            In("Length", duplicable=True, no_grad=True)],
+    outputs=[Out("Out"), Out("OutLength", no_grad=True)],
+)
+def _sequence_concat_padded(ins, attrs):
+    """Whole-compile twin of sequence_concat (out seq b = concat of
+    each input's seq b): valid rows of each input scatter into the
+    output at data-dependent offsets (cumulative lengths); OutLength =
+    elementwise sum of lengths."""
+    xs, lns = ins["X"], ins["Length"]
+    lens = [l.reshape(-1) for l in lns]
+    B = xs[0].shape[0]
+    T_tot = sum(int(x.shape[1]) for x in xs)
+    tail = xs[0].shape[2:]
+    out = jnp.zeros((B, T_tot) + tuple(tail), xs[0].dtype)
+    b_idx = jnp.arange(B)[:, None]
+    offset = jnp.zeros((B,), jnp.int32)
+    for x, l in zip(xs, lens):
+        T_k = int(x.shape[1])
+        t = jnp.arange(T_k)
+        valid = (t[None, :] < l[:, None])
+        dest = jnp.clip(offset[:, None] + t[None, :], 0, T_tot - 1)
+        contrib = jnp.where(
+            valid.reshape(valid.shape + (1,) * len(tail)), x, 0.0)
+        out = out.at[b_idx, dest].add(contrib.astype(out.dtype))
+        offset = offset + l.astype(jnp.int32)
+    total = sum(l.astype(jnp.int64) for l in lens)
+    return {"Out": out, "OutLength": total}
+
+
+@register_host_op(
+    "sequence_unpad_grad",
+    inputs=[In("X", no_grad=True), In("Length", no_grad=True),
+            In("Out@GRAD")],
+    outputs=[Out("X@GRAD")],
+)
+def _sequence_unpad_grad(executor, op, scope):
+    """Backward of sequence_unpad: scatter the ragged cotangent rows
+    back into their padded [N, T, ...] positions (zeros in the pads) —
+    reference sequence_unpad_op.h grad functor."""
+    x = np.asarray(executor._read_var(scope, op.input("X")[0]))
+    lens = np.asarray(
+        executor._read_var(scope, op.input("Length")[0])).reshape(-1)
+    g = np.asarray(executor._read_var(scope, op.input("Out@GRAD")[0]))
+    dx = np.zeros_like(x)
+    off = 0
+    for i in range(x.shape[0]):
+        n = int(lens[i])
+        dx[i, :n] = g[off:off + n]
+        off += n
+    executor._write_var(scope, op.output("X@GRAD")[0], dx)
