@@ -1,0 +1,9 @@
+"""HDFS client for fleet jobs.
+
+Parity: /root/reference/python/paddle/fluid/incubate/fleet/utils/
+hdfs.py — the implementation lives in core/fs.py (the framework's
+filesystem layer, reference framework/io/fs.cc); this module keeps the
+reference import path."""
+from ....core.fs import HDFSClient, LocalFS, split_files  # noqa: F401
+
+__all__ = ["HDFSClient", "LocalFS", "split_files"]

@@ -1,0 +1,255 @@
+"""Filesystem layer + fleet utils (reference framework/io/fs.cc,
+incubate/fleet/utils/{hdfs.py, fleet_util.py}). The HDFSClient is
+driven against a FAKE ``hadoop`` executable that maps `fs` commands
+onto a sandbox dir — the real subprocess/retry path runs."""
+import os
+import stat
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.fs import HDFSClient, LocalFS, split_files
+from paddle_tpu.incubate.fleet.utils import FleetUtil
+
+FAKE_HADOOP = r'''#!/usr/bin/env python3
+import os, shutil, sys
+args = sys.argv[1:]
+assert args[0] == "fs", args
+args = args[1:]
+while args and args[0].startswith("-D"):
+    args = args[1:]          # configs accepted, ignored
+cmd, rest = args[0], args[1:]
+def die(code=1):
+    sys.exit(code)
+if cmd == "-ls":
+    p = rest[0]
+    if not os.path.exists(p):
+        die()
+    if os.path.isfile(p):
+        print("-rw-r--r-- 1 u g 0 2026-01-01 00:00 %s" % p)
+    else:
+        for n in sorted(os.listdir(p)):
+            full = os.path.join(p, n)
+            kind = "d" if os.path.isdir(full) else "-"
+            print("%srw-r--r-- 1 u g 0 2026-01-01 00:00 %s" % (kind, full))
+elif cmd == "-lsr":
+    p = rest[0]
+    if not os.path.exists(p):
+        die()
+    for root, dirs, files in os.walk(p):
+        for n in sorted(files):
+            print("-rw-r--r-- 1 u g 0 2026-01-01 00:00 %s"
+                  % os.path.join(root, n))
+elif cmd == "-test":
+    flag, p = rest
+    if flag == "-e":
+        ok = os.path.exists(p)
+    elif flag == "-d":
+        ok = os.path.isdir(p)
+    else:
+        ok = os.path.isfile(p)
+    die(0 if ok else 1)
+elif cmd == "-cat":
+    sys.stdout.write(open(rest[0]).read())
+elif cmd == "-mkdir":
+    if rest and rest[0] == "-p":
+        rest = rest[1:]
+    os.makedirs(rest[0], exist_ok=True)
+elif cmd == "-touchz":
+    os.makedirs(os.path.dirname(rest[0]) or ".", exist_ok=True)
+    open(rest[0], "a").close()
+elif cmd in ("-rm", "-rmr"):
+    force = "-f" in rest
+    rest = [a for a in rest if not a.startswith("-")]
+    p = rest[0]
+    if not os.path.exists(p):
+        die(0 if force else 1)
+    shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+elif cmd == "-mv":
+    os.replace(rest[0], rest[1])
+elif cmd == "-put":
+    src, dst = rest
+    if os.path.isdir(src):
+        shutil.copytree(src, dst, dirs_exist_ok=True)
+    else:
+        shutil.copy2(src, dst)
+elif cmd == "-get":
+    src, dst = rest
+    if os.path.isdir(src):
+        shutil.copytree(src, dst, dirs_exist_ok=True)
+    else:
+        shutil.copy2(src, dst)
+else:
+    die()
+'''
+
+
+@pytest.fixture
+def hdfs(tmp_path):
+    home = tmp_path / "hadoop_home"
+    (home / "bin").mkdir(parents=True)
+    bin_path = home / "bin" / "hadoop"
+    bin_path.write_text(FAKE_HADOOP)
+    bin_path.chmod(bin_path.stat().st_mode | stat.S_IEXEC)
+    return HDFSClient(str(home),
+                      {"fs.default.name": "hdfs://x", "hadoop.job.ugi":
+                       "u,p"}, retry_times=2, retry_sleep=0.01)
+
+
+def test_local_fs_roundtrip(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    assert fs.makedirs(d)
+    f = os.path.join(d, "x.txt")
+    with open(f, "w") as fh:
+        fh.write("hello")
+    assert fs.is_exist(f) and fs.is_file(f) and not fs.is_dir(f)
+    assert fs.cat(f) == "hello"
+    assert fs.ls(str(tmp_path / "a")) == [d]
+    fs.rename(f, f + ".2")
+    assert fs.is_exist(f + ".2") and not fs.is_exist(f)
+    fs.download(f + ".2", str(tmp_path / "copy.txt"))
+    assert fs.cat(str(tmp_path / "copy.txt")) == "hello"
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_hdfs_client_over_fake_hadoop(hdfs, tmp_path):
+    root = str(tmp_path / "dfs")
+    assert hdfs.makedirs(root)
+    assert hdfs.is_exist(root) and hdfs.is_dir(root)
+    local = str(tmp_path / "local.txt")
+    with open(local, "w") as f:
+        f.write("payload")
+    assert hdfs.upload(root + "/f.txt", local)
+    assert hdfs.is_file(root + "/f.txt")
+    assert hdfs.cat(root + "/f.txt") == "payload"
+    assert hdfs.ls(root) == [root + "/f.txt"]
+    sub = root + "/sub"
+    assert hdfs.makedirs(sub)
+    assert hdfs.touch(sub + "/g.txt")
+    assert sorted(hdfs.lsr(root)) == [root + "/f.txt",
+                                      sub + "/g.txt"]
+    assert hdfs.rename(root + "/f.txt", root + "/h.txt")
+    assert not hdfs.is_exist(root + "/f.txt")
+    got = str(tmp_path / "got.txt")
+    assert hdfs.download(root + "/h.txt", got)
+    assert open(got).read() == "payload"
+    assert hdfs.delete(sub)
+    assert not hdfs.is_exist(sub)
+
+
+def test_split_files():
+    files = ["f%d" % i for i in range(7)]
+    parts = [split_files(files, i, 3) for i in range(3)]
+    assert parts[0] == ["f0", "f1", "f2"]
+    assert parts[1] == ["f3", "f4"]
+    assert parts[2] == ["f5", "f6"]
+    assert sum(parts, []) == files
+
+
+def test_global_auc_matches_oracle():
+    """Bucketed AUC over pos/neg stats must match a direct ROC
+    computation on the same score distribution."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    n_bucket = 100
+    pos_scores = np.clip(rng.beta(4, 2, 4000), 0, 0.999999)
+    neg_scores = np.clip(rng.beta(2, 4, 5000), 0, 0.999999)
+    pos_buckets = np.bincount((pos_scores * n_bucket).astype(int),
+                              minlength=n_bucket).astype("int64")
+    neg_buckets = np.bincount((neg_scores * n_bucket).astype(int),
+                              minlength=n_bucket).astype("int64")
+
+    scope = fluid.Scope()
+    scope.var("sp").get_tensor()._array = jnp.asarray(pos_buckets)
+    scope.var("sn").get_tensor()._array = jnp.asarray(neg_buckets)
+    util = FleetUtil()
+    auc = util.get_global_auc(scope, stat_pos="sp", stat_neg="sn")
+
+    # oracle: rank-based AUC on the bucketized scores
+    scores = np.concatenate([(pos_scores * n_bucket).astype(int),
+                             (neg_scores * n_bucket).astype(int)])
+    labels = np.concatenate([np.ones_like(pos_scores),
+                             np.zeros_like(neg_scores)])
+    order = np.argsort(scores, kind="stable")
+    ranks = np.empty(len(scores))
+    s_sorted = scores[order]
+    i = 0
+    r = np.arange(1, len(scores) + 1, dtype=float)
+    while i < len(scores):
+        j = i
+        while j + 1 < len(scores) and s_sorted[j + 1] == s_sorted[i]:
+            j += 1
+        r[i:j + 1] = (i + j + 2) / 2.0
+        i = j + 1
+    ranks[order] = r
+    n_pos, n_neg = labels.sum(), (1 - labels).sum()
+    oracle = (ranks[labels == 1].sum()
+              - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    assert abs(auc - oracle) < 1e-6, (auc, oracle)
+
+
+def test_set_zero():
+    import jax.numpy as jnp
+
+    scope = fluid.Scope()
+    scope.var("m").get_tensor()._array = jnp.asarray(
+        np.arange(6, dtype="int64"))
+    FleetUtil().set_zero("m", scope)
+    assert np.all(np.asarray(scope.find_var("m").raw().array) == 0)
+
+
+def test_online_pass_interval():
+    util = FleetUtil()
+    intervals = util.get_online_pass_interval(
+        days="{20190720..20190729}", hours="{0..23}",
+        split_interval=5, split_per_pass=2,
+        is_data_hourly_placed=False)
+    assert len(intervals) == 24 * 60 // 5 // 2
+    assert intervals[0] == ["0000", "0005"]
+    assert intervals[-1] == ["2350", "2355"]
+    hourly = util.get_online_pass_interval(
+        days="{20190720..20190721}", hours="{8..9}",
+        split_interval=60, split_per_pass=1,
+        is_data_hourly_placed=True)
+    assert hourly == [["08"], ["09"]]
+
+
+def test_donefile_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    util = FleetUtil()
+    out = str(tmp_path / "out")
+    util.write_model_donefile(out, "20260731", 1, "key1")
+    util.write_model_donefile(out, "20260731", 2, "key2")
+    day, pass_id, path = util.get_last_save_model(out)
+    assert (day, pass_id) == (20260731, 2)
+    assert path.endswith("20260731/2")
+
+
+def test_save_inference_model_day_pass_layout(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data(name="x", shape=[4, 3], dtype="float32")
+        y = fluid.layers.fc(x, size=2)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    dest = FleetUtil().save_paddle_inference_model(
+        exe, scope, main, ["x"], [y], str(tmp_path / "out"),
+        "20260731", 3)
+    assert os.path.isdir(dest)
+    # reloadable
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_inference_model(dest, exe)
+        (o,) = exe.run(prog,
+                       feed={"x": np.ones((4, 3), "float32")},
+                       fetch_list=fetches)
+    assert np.asarray(o).shape == (4, 2)
