@@ -1,3 +1,4 @@
+from . import core  # noqa: F401
 from . import distillation  # noqa: F401
 from . import nas  # noqa: F401
 from . import prune  # noqa: F401

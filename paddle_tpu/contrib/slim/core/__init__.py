@@ -1,0 +1,222 @@
+"""Compression orchestration: Context + Strategy + Compressor.
+
+Parity: /root/reference/python/paddle/fluid/contrib/slim/core/
+(compressor.py:238 Compressor — the epoch loop driving strategies via
+on_compression_begin / on_epoch_begin / on_epoch_end /
+on_compression_end callbacks; strategy.py Strategy base). TPU-native
+right-sizing: the graph wrapper IS the Program (rewrites happen
+through the prune/distillation passes, and the whole-program compiler
+retraces on new shapes), so the Context carries (program, scope,
+executor) instead of a GraphWrapper."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Context", "Strategy", "Compressor",
+           "PruneStrategySchedule", "DistillationStrategySchedule"]
+
+
+class Context:
+    """(reference compressor.py:60) — mutable state threaded through
+    the strategy callbacks."""
+
+    def __init__(self, place, scope, train_program, startup_program,
+                 loss, executor, eval_func=None):
+        self.place = place
+        self.scope = scope
+        self.train_program = train_program
+        self.startup_program = startup_program
+        self.loss = loss
+        self.executor = executor
+        self.eval_func = eval_func
+        self.epoch_id = 0
+        # the program the train loop actually runs (a distillation
+        # strategy swaps in the merged teacher+distill-loss program)
+        self.optimize_program = train_program
+        self.optimize_loss = loss
+        self._store: Dict = {}
+
+    def put(self, key, value):
+        self._store[key] = value
+
+    def get(self, key, default=None):
+        return self._store.get(key, default)
+
+    def eval(self):
+        return (self.eval_func(self.train_program, self.scope)
+                if self.eval_func else None)
+
+
+class Strategy:
+    """Callback base (reference slim/core/strategy.py)."""
+
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+
+class PruneStrategySchedule(Strategy):
+    """Run a prune strategy (Uniform/Sensitive from slim.prune) once at
+    ``start_epoch`` (reference prune_strategy.py:36 epoch gating)."""
+
+    def __init__(self, prune_strategy, start_epoch=0):
+        super().__init__(start_epoch=start_epoch)
+        self.prune_strategy = prune_strategy
+        self.pruned = None
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch and self.pruned is None:
+            self.pruned = self.prune_strategy.apply(
+                context.train_program, context.scope)
+
+
+class DistillationStrategySchedule(Strategy):
+    """During [start_epoch, end_epoch) the train loop minimizes the
+    distillation loss on a merged teacher+student program; outside the
+    window it runs the plain student objective (reference
+    distillation_strategy.py)."""
+
+    def __init__(self, distillers, teacher_program, teacher_scope,
+                 distill_optimizer, start_epoch=0, end_epoch=1,
+                 feed_map=None):
+        super().__init__(start_epoch, end_epoch)
+        self.distillers = (distillers if isinstance(distillers, (list,
+                                                                 tuple))
+                           else [distillers])
+        self.teacher_program = teacher_program
+        self.teacher_scope = teacher_scope
+        self.distill_optimizer = distill_optimizer
+        self.feed_map = feed_map or {}
+        self._distill_program = None
+        self._distill_loss = None
+
+    def _build(self, context):
+        import paddle_tpu as fluid
+        from paddle_tpu import framework
+
+        from ..distillation import merge_programs
+
+        # clone the student's FORWARD in TRAIN mode (a for_test clone
+        # would force is_test=True and strip dropout — the reference
+        # distillation_strategy trains the train graph), dropping only
+        # the backward/optimizer ops by role, then merge the frozen
+        # teacher, append the distill losses, and minimize with the
+        # distiller optimizer
+        prog = context.train_program.clone()
+        for blk in prog.blocks:
+            blk.ops = [op for op in blk.ops
+                       if not (op._role & (framework.OpRole.Backward
+                                           | framework.OpRole.Optimize))]
+        sblk = context.startup_program.global_block()
+        n_before = len(sblk.ops)
+        with fluid.program_guard(prog, context.startup_program):
+            merge_programs(prog, self.teacher_program, context.scope,
+                           teacher_scope=self.teacher_scope,
+                           feed_map=self.feed_map)
+            loss = None
+            for d in self.distillers:
+                loss = d.distiller_loss(prog, student_loss=loss)
+            self.distill_optimizer.minimize(
+                loss, startup_program=context.startup_program)
+        # the shared startup already RAN: execute just the init ops the
+        # distill minimize appended (optimizer accumulators, lr var)
+        new_ops = sblk.ops[n_before:]
+        if new_ops:
+            sp = framework.Program()
+            b2 = sp.global_block()
+            for op in new_ops:
+                for name in (list(op.output_arg_names)
+                             + list(op.input_arg_names)):
+                    v = sblk._find_var_recursive(name)
+                    if v is not None and not b2.has_var_local(name):
+                        b2.create_var(name=name, shape=v.shape,
+                                      dtype=v.dtype,
+                                      persistable=v.persistable)
+                b2.append_op(
+                    op.type,
+                    inputs={k: list(vv) for k, vv in op.inputs.items()},
+                    outputs={k: list(vv)
+                             for k, vv in op.outputs.items()},
+                    attrs=dict(op.attrs), infer_shape=False)
+            context.executor.run(sp, scope=context.scope)
+        self._distill_program, self._distill_loss = prog, loss
+
+    def on_epoch_begin(self, context):
+        if self.start_epoch <= context.epoch_id < self.end_epoch:
+            if self._distill_program is None:
+                self._build(context)
+            context.optimize_program = self._distill_program
+            context.optimize_loss = self._distill_loss
+        else:
+            context.optimize_program = context.train_program
+            context.optimize_loss = context.loss
+
+
+class Compressor:
+    """Epoch loop over strategies (reference compressor.py:238/552).
+
+    ``train_reader`` yields feed dicts; ``eval_func(program, scope) ->
+    float`` (higher is better) is recorded per epoch."""
+
+    def __init__(self, place, scope, train_program, startup_program,
+                 loss, train_reader, epoch=1, strategies=None,
+                 eval_func=None, eval_epoch=1, log_period=0):
+        import paddle_tpu as fluid
+
+        self.place = place
+        self.scope = scope
+        self.train_program = train_program
+        self.startup_program = startup_program
+        self.loss = loss
+        self.train_reader = train_reader
+        self.epoch = epoch
+        self.strategies = list(strategies or [])
+        self.eval_func = eval_func
+        self.eval_epoch = eval_epoch
+        self.log_period = log_period
+        self.executor = fluid.Executor(place)
+        self.eval_history: List = []
+
+    def run(self):
+        import paddle_tpu as fluid
+
+        ctx = Context(self.place, self.scope, self.train_program,
+                      self.startup_program, self.loss, self.executor,
+                      self.eval_func)
+        with fluid.scope_guard(self.scope):
+            for s in self.strategies:
+                s.on_compression_begin(ctx)
+            for epoch in range(self.epoch):
+                ctx.epoch_id = epoch
+                for s in self.strategies:
+                    s.on_epoch_begin(ctx)
+                last = None
+                for i, feed in enumerate(self.train_reader()):
+                    (last,) = self.executor.run(
+                        ctx.optimize_program, feed=feed,
+                        fetch_list=[ctx.optimize_loss])
+                    if self.log_period and i % self.log_period == 0:
+                        print("epoch %d step %d loss %s"
+                              % (epoch, i, np.ravel(last)[0]))
+                if self.eval_func and epoch % self.eval_epoch == 0:
+                    self.eval_history.append(
+                        (epoch, float(ctx.eval())))
+                for s in self.strategies:
+                    s.on_epoch_end(ctx)
+            for s in self.strategies:
+                s.on_compression_end(ctx)
+        return ctx

@@ -33,13 +33,29 @@ def is_pending(x) -> bool:
     return isinstance(x, PendingValue)
 
 
+_sds_memo: Dict = {}
+
+
+def _sds(shape, dtype):
+    """Memoized jax.ShapeDtypeStruct — construction dominates the
+    per-op host cost at BERT scale (jax __setattr__ checks x thousands
+    of ops/step), and the distinct (shape, dtype) set is tiny."""
+    key = (shape, dtype)
+    s = _sds_memo.get(key)
+    if s is None:
+        import jax
+
+        s = jax.ShapeDtypeStruct(shape, dtype)
+        if len(_sds_memo) < 4096:
+            _sds_memo[key] = s
+    return s
+
+
 def aval_of(h):
     """jax.ShapeDtypeStruct of a handle (concrete array or pending)."""
-    import jax
-
     if isinstance(h, PendingValue):
         return h.aval
-    return jax.ShapeDtypeStruct(np.shape(h), h.dtype)
+    return _sds(tuple(np.shape(h)), h.dtype)
 
 
 class PendingValue:

@@ -157,6 +157,8 @@ class Tracer:
             self.lazy_engine = LazyEngine()
         # (op_type, attrs_sig, in_avals) -> (out_avals, struct)
         self._aval_cache: Dict = {}
+        # (aval_cache_key, stop_gradient pattern) -> wrt positions
+        self._wrt_cache: Dict = {}
 
     def flush(self):
         if self.lazy_engine is not None:
@@ -379,6 +381,7 @@ class Tracer:
 
         var_map: Dict[str, object] = {}
         handles: List[object] = []
+        flat_vars: List[Optional[VarBase]] = []  # aligned with handles
         layout: List[Tuple[str, Optional[int]]] = []  # (slot, n or None)
         for slot in info.inputs:
             arg = (inputs or {}).get(slot.name)
@@ -391,9 +394,11 @@ class Tracer:
             if slot.duplicable:
                 layout.append((slot.name, len(vs)))
                 handles.extend(v._array for v in vs)
+                flat_vars.extend(vs)
             else:
                 layout.append((slot.name, None))
                 handles.append(vs[0]._array)
+                flat_vars.append(vs[0])
 
         attrs = dict(attrs or {})
         if outputs:
@@ -408,6 +413,7 @@ class Tracer:
                 or (self._seed_counter & 0xFFFFFFFF))
             layout.append((RNG_SEED_ATTR, None))
             handles.append(seed_val)
+            flat_vars.append(None)   # not a VarBase: never a wrt leaf
             if "is_test" in info.attrs and "is_test" not in attrs:
                 attrs["is_test"] = not self.train_mode
 
@@ -472,26 +478,38 @@ class Tracer:
             self._aval_cache[cache_key] = cached
         out_avals, struct = cached
 
-        # differentiable leaves — same eligibility as the eager path
+        # differentiable leaves — same eligibility as the eager path;
+        # positions are cached per (op signature, stop-gradient
+        # pattern): the float-dtype checks are hot at BERT scale
         wrt_pos: List[int] = []
         in_vars: List[VarBase] = []
         if not self._no_grad and not stop_gradient and \
                 info.grad is not None:
-            flat_idx = 0
-            for name, n in layout:
-                if name == RNG_SEED_ATTR:
-                    flat_idx += 1
-                    continue
-                slot = next(s for s in info.inputs if s.name == name)
-                vs = var_map[name]
-                vlist = vs if isinstance(vs, list) else [vs]
-                for v in vlist:
-                    if not slot.no_grad and not v.stop_gradient and \
-                            jnp.issubdtype(np.dtype(_aval(v._array).dtype),
-                                           jnp.floating):
-                        wrt_pos.append(flat_idx)
-                        in_vars.append(v)
-                    flat_idx += 1
+            sg = tuple(v is None or v.stop_gradient for v in flat_vars)
+            wk = (cache_key, sg)
+            wrt_t = self._wrt_cache.get(wk)
+            if wrt_t is None:
+                flat_idx = 0
+                pos = []
+                for name, n in layout:
+                    if name == RNG_SEED_ATTR:
+                        flat_idx += 1
+                        continue
+                    slot = next(s for s in info.inputs
+                                if s.name == name)
+                    vs = var_map[name]
+                    vlist = vs if isinstance(vs, list) else [vs]
+                    for v in vlist:
+                        if not slot.no_grad and not v.stop_gradient \
+                                and jnp.issubdtype(
+                                    np.dtype(_aval(v._array).dtype),
+                                    jnp.floating):
+                            pos.append(flat_idx)
+                        flat_idx += 1
+                wrt_t = tuple(pos)
+                self._wrt_cache[wk] = wrt_t
+            wrt_pos = list(wrt_t)
+            in_vars = [flat_vars[p] for p in wrt_t]
         requires_grad = bool(wrt_pos)
 
         op_sig = ("op", op_type, attrs_sig, layout_t)

@@ -372,3 +372,99 @@ def test_prune_shrinks_optimizer_state():
             (l,) = exe.run(main, feed={"x": x_np, "y": y_np},
                            fetch_list=[loss])
     assert np.isfinite(float(np.asarray(l)))
+
+
+# ---------------------------------------------------------------------------
+# Compressor orchestration (reference slim/core/compressor.py)
+# ---------------------------------------------------------------------------
+
+
+def test_compressor_prune_schedule():
+    """Epoch 0 trains dense; epoch 1 prunes 50% then finetunes; the
+    eval history shows the damage and the recovery."""
+    from paddle_tpu.contrib.slim.core import (Compressor,
+                                              PruneStrategySchedule)
+
+    main, startup, loss = _mlp_program()
+    x, y = _toy_data()
+
+    def reader():
+        for _ in range(60):
+            yield {"x": x, "y": y}
+
+    eval_progs = {}
+
+    def eval_func(prog, scope):
+        # a PURE measurement: the optimizer ops must not run (clone
+        # keyed by program identity — pruning bumps versions)
+        key = id(prog)
+        if key not in eval_progs:
+            eval_progs[key] = prog.clone(for_test=True)
+        (l,) = fluid.Executor(fluid.CPUPlace()).run(
+            eval_progs[key], feed={"x": x, "y": y}, fetch_list=[loss])
+        return -float(np.asarray(l))
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+    comp = Compressor(fluid.CPUPlace(), scope, main, startup, loss,
+                      reader, epoch=3,
+                      strategies=[PruneStrategySchedule(
+                          UniformPruneStrategy(target_ratio=0.5,
+                                               params=["fc1_w"]),
+                          start_epoch=1)],
+                      eval_func=eval_func)
+    comp.run()
+    w1 = np.asarray(scope.find_var("fc1_w").raw().array)
+    assert w1.shape == (8, 16)          # pruned at epoch 1
+    evals = dict(comp.eval_history)
+    assert evals[2] >= evals[1] - 1e-3  # finetune recovers
+    assert len(evals) == 3
+
+
+def test_compressor_distillation_schedule():
+    """Distill epochs minimize the merged teacher loss; the student
+    lands near the teacher's (deliberately shifted) function."""
+    from paddle_tpu.contrib.slim.core import (
+        Compressor, DistillationStrategySchedule)
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 4).astype("float32")
+    y = np.tanh(x @ rng.randn(4, 1)).astype("float32")
+    teacher_prog, teacher_scope, t_pred = _train_teacher(
+        x, (y + 1.0).astype("float32"))
+    with fluid.scope_guard(teacher_scope):
+        (t_out,) = fluid.Executor(fluid.CPUPlace()).run(
+            teacher_prog, feed={"x": x}, fetch_list=[t_pred])
+    t_out = np.asarray(t_out)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        xin = fluid.data(name="x", shape=[32, 4], dtype="float32")
+        yin = fluid.data(name="y", shape=[32, 1], dtype="float32")
+        h = fluid.layers.fc(xin, size=8, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        student_loss = fluid.layers.reduce_mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, yin)))
+        fluid.optimizer.AdamOptimizer(5e-3).minimize(student_loss)
+
+    def reader():
+        for _ in range(40):
+            yield {"x": x, "y": y}
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+    strat = DistillationStrategySchedule(
+        L2Distiller(pred.name, t_pred), teacher_prog, teacher_scope,
+        fluid.optimizer.AdamOptimizer(5e-3), start_epoch=0,
+        end_epoch=2, feed_map={"x": "x"})
+    comp = Compressor(fluid.CPUPlace(), scope, main, startup,
+                      student_loss, reader, epoch=2,
+                      strategies=[strat])
+    comp.run()
+    with fluid.scope_guard(scope):
+        (out,) = fluid.Executor(fluid.CPUPlace()).run(
+            main, feed={"x": x, "y": y}, fetch_list=[pred])
+    dist = float(np.mean((np.asarray(out) - t_out) ** 2))
+    assert dist < 0.1, dist   # landed on the (shifted) teacher
