@@ -104,7 +104,8 @@ def _sharded_lookup_grad_exact(w, ids, axis):
 
 @register_op(
     "c_ring_attention",
-    inputs=[In("Q"), In("K"), In("V")],
+    inputs=[In("Q"), In("K"), In("V"),
+            In("Lengths", dispensable=True, no_grad=True)],
     outputs=[Out("Out")],
     attrs={"shard_axis": "sp", "causal": False, "scale": 0.0},
 )
@@ -112,20 +113,25 @@ def _c_ring_attention(ins, attrs):
     """Sequence-parallel attention over [B, H, S_local, D] (rewrite
     target of flash_attention, apply_sequence_parallel): K/V shards
     rotate around the ``shard_axis`` ring via ppermute with an exact
-    streaming-softmax accumulator (parallel/ring_attention.py). Dense
-    fallback is exact full-sequence attention."""
+    streaming-softmax accumulator (parallel/ring_attention.py).
+    ``Lengths`` [B] carries the GLOBAL per-example padding mask
+    (replicated across the ring). Dense fallback is exact
+    full-sequence attention."""
     q, k, v = ins["Q"], ins["K"], ins["V"]
+    lengths = ins.get("Lengths")
     causal = bool(attrs.get("causal"))
     scale = attrs.get("scale", 0.0) or None
     axis = attrs.get("shard_axis")
     if mesh_axis_active(axis):
         from ..parallel.ring_attention import ring_attention
 
-        out = ring_attention(q, k, v, axis, causal=causal, scale=scale)
+        out = ring_attention(q, k, v, axis, causal=causal, scale=scale,
+                             lengths=lengths)
     else:
         from ..parallel.ring_attention import reference_attention
 
-        out = reference_attention(q, k, v, causal=causal, scale=scale)
+        out = reference_attention(q, k, v, causal=causal, scale=scale,
+                                  lengths=lengths)
     return {"Out": out}
 
 

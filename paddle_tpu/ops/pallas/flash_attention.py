@@ -84,8 +84,13 @@ def _dense_attention(q, k, v, causal, scale, lengths=None):
             lengths.astype(jnp.int32)[:, None, None, None]
         s = jnp.where(vis, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    if lengths is not None:
+        # zero-length rows output ZEROS, matching the pallas kernels
+        out = jnp.where(
+            (lengths.astype(jnp.int32) > 0)[:, None, None, None],
+            out, 0.0)
+    return out.astype(q.dtype)
 
 
 def _dense_lse(q, k, causal, scale, lengths_bh=None):

@@ -42,7 +42,7 @@ def _axis_size(axis_name: str, axis_size: Optional[int]):
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                    scale: Optional[float] = None,
-                   axis_size: Optional[int] = None):
+                   axis_size: Optional[int] = None, lengths=None):
     """Exact attention over sequence shards rotated around a ring.
 
     Args:
@@ -53,6 +53,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         (device i's queries occupy positions ``[i*S_local, (i+1)*S_local)``).
       scale: attention scale; default ``D ** -0.5``.
       axis_size: ring size if known statically (skips lax.axis_size).
+      lengths: optional ``[B]`` GLOBAL per-example KV lengths
+        (replicated across the ring): key positions >= lengths[b] are
+        masked — the padding mask of the masked flash kernels, in ring
+        form. KV shards entirely past every example's length are
+        skipped (no einsum, the rotation still happens).
 
     Returns ``[B, H, S_local, D]`` in q.dtype.
     """
@@ -69,6 +74,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     perm = [(j, (j + 1) % n) for j in range(n)]
     pos = jnp.arange(S, dtype=jnp.int32)
 
+    lens = (None if lengths is None
+            else lengths.reshape(-1).astype(jnp.int32))
+
     def attend(o, m, l, kb, vb, src):
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32))
         if causal:
@@ -76,6 +84,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
             k_pos = src * S + pos
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None], s, NEG_INF)
+        if lens is not None:
+            k_pos = src * S + pos                       # [S_k] global
+            vis = k_pos[None, :] < lens[:, None]        # [B, S_k]
+            s = jnp.where(vis[:, None, None, :], s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
@@ -89,11 +101,19 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         # after t rotations this device holds the shard that started on
         # device (idx - t) mod n
         src = (idx - t) % n
+        need = None
         if causal:
-            # blocks entirely in the masked future (src > idx) contribute
-            # nothing — skip their einsums entirely
+            # blocks entirely in the masked future (src > idx)
+            # contribute nothing — skip their einsums entirely
+            need = src <= idx
+        if lens is not None:
+            # KV shard entirely past every example's padded tail
+            in_len = src * S < jnp.max(lens)
+            need = in_len if need is None else jnp.logical_and(need,
+                                                              in_len)
+        if need is not None:
             return lax.cond(
-                src <= idx,
+                need,
                 lambda args: attend(*args, src),
                 lambda args: args[:3],
                 (o, m, l, kb, vb))
@@ -114,12 +134,18 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     # hop saved per call)
     o, m, l, kb, vb = lax.fori_loop(0, n - 1, step, (o0, m0, l0, k, v))
     o, m, l = accumulate((o, m, l), kb, vb, n - 1)
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    if lens is not None:
+        # zero-length (all-padding) examples output ZEROS — the same
+        # contract as the masked flash kernels, and the only value
+        # that's consistent across ring/dense/ulysses
+        out = jnp.where((lens > 0)[:, None, None, None], out, 0.0)
+    return out.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
                       scale: Optional[float] = None,
-                      axis_size: Optional[int] = None):
+                      axis_size: Optional[int] = None, lengths=None):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern).
 
     Local shards ``[B, H, S_local, D]`` sequence-sharded over
@@ -146,14 +172,21 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
     s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
                    kh.astype(jnp.float32)) * scale
+    Sg = S * n
     if causal:
-        Sg = S * n
         posq = jnp.arange(Sg, dtype=jnp.int32)
         mask = posq[:, None] >= posq[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
+    if lengths is not None:
+        vis = (jnp.arange(Sg, dtype=jnp.int32)[None, :]
+               < lengths.reshape(-1).astype(jnp.int32)[:, None])
+        s = jnp.where(vis[:, None, None, :], s, NEG_INF)
     p = jnp.exp(s - s.max(axis=-1, keepdims=True))
     p = p / p.sum(axis=-1, keepdims=True)
     oh = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+    if lengths is not None:
+        oh = jnp.where(
+            (lengths.reshape(-1) > 0)[:, None, None, None], oh, 0.0)
     # back to sequence-sharded layout
     out = lax.all_to_all(oh.astype(q.dtype), axis_name, split_axis=2,
                          concat_axis=1, tiled=True)
@@ -162,7 +195,8 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
 
 def sequence_parallel_attention(q, k, v, mesh, sp_axis: str = "sp",
                                 mode: str = "ring", causal: bool = False,
-                                scale: Optional[float] = None):
+                                scale: Optional[float] = None,
+                                lengths=None):
     """Host-level wrapper: full ``[B, H, S, D]`` arrays in, attention
     computed with the sequence dimension sharded over ``mesh[sp_axis]``.
 
@@ -178,13 +212,19 @@ def sequence_parallel_attention(q, k, v, mesh, sp_axis: str = "sp",
                               scale=scale, axis_size=n)
 
     spec = P(None, None, sp_axis, None)
-    smap = shard_map_compat(local, mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec)
-    return smap(q, k, v)
+    if lengths is None:
+        smap = shard_map_compat(local, mesh,
+                                in_specs=(spec, spec, spec),
+                                out_specs=spec)
+        return smap(q, k, v)
+    smap = shard_map_compat(
+        lambda q, k, v, ln: local(q, k, v, lengths=ln), mesh,
+        in_specs=(spec, spec, spec, P()), out_specs=spec)
+    return smap(q, k, v, lengths)
 
 
 def reference_attention(q, k, v, causal: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None, lengths=None):
     """Dense single-device attention — the numeric oracle for tests."""
     import jax.numpy as jnp
 
@@ -197,7 +237,15 @@ def reference_attention(q, k, v, causal: bool = False,
         S = q.shape[2]
         pos = jnp.arange(S)
         s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, NEG_INF)
+    if lengths is not None:
+        S_kv = k.shape[2]
+        vis = (jnp.arange(S_kv)[None, :]
+               < lengths.reshape(-1).astype(jnp.int32)[:, None])
+        s = jnp.where(vis[:, None, None, :], s, NEG_INF)
     p = jnp.exp(s - s.max(axis=-1, keepdims=True))
     p = p / p.sum(axis=-1, keepdims=True)
-    return jnp.einsum("bhqk,bhkd->bhqd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    if lengths is not None:
+        out = jnp.where(
+            (lengths.reshape(-1) > 0)[:, None, None, None], out, 0.0)
+    return out.astype(q.dtype)

@@ -233,12 +233,20 @@ def apply_sequence_parallel(program, axis: str = "sp", degree: int = 0,
     for op in block.ops:
         if op.type != "flash_attention":
             continue
-        if op.input("Lengths"):
-            raise NotImplementedError(
-                "sequence_parallel: flash_attention with a Lengths "
-                "(padding) mask cannot be rewritten to ring attention "
-                "yet — drop kv_lengths or sequence parallelism for "
-                "this op")
+        # a Lengths (padding) input carries straight through: ring
+        # attention masks GLOBAL key positions >= lengths[b], the same
+        # contract as the masked flash kernels. The [B] lengths var is
+        # BATCH-aligned: pin it to the 'dp' axis so the engine's
+        # default data-axis sharding can never split it over the ring
+        # (an sp-only mesh would otherwise shard [B] over sp and mask
+        # with the wrong example's length — with that pin, an sp-only
+        # mesh fails loudly on the missing 'dp' axis instead)
+        for ln in op.input("Lengths"):
+            fs = getattr(program, "_feed_shard_specs", None)
+            if fs is None:
+                fs = {}
+                program._feed_shard_specs = fs
+            fs.setdefault(ln, ("dp",))
         if degree:
             q = block._find_var_recursive(op.input("Q")[0])
             if (q is not None and q.shape is not None and len(q.shape) >= 3
