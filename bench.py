@@ -95,8 +95,25 @@ def _time_steps(exe, main, feed, loss, warmup=3, iters=20, windows=2,
     Returns (dt, final_loss, diag) where diag records per-window wall
     times and whether the program took the whole-compile path — the
     round-3 BERT collapse was a silent interpreter fallback, and this
-    makes any recurrence legible in BENCH json.
+    makes any recurrence legible in BENCH json. Step/compile/recompile
+    counts come from the observability registry (the same counters a
+    production deployment would scrape), not hand-rolled probes.
     """
+    from paddle_tpu import observability as obs
+
+    obs.enable()
+
+    def _counts():
+        return {
+            "steps_compiled": obs.counter_value("executor.steps",
+                                                path="compiled"),
+            "steps_interpreter": obs.counter_value("executor.steps",
+                                                   path="interpreter"),
+            "compiles": obs.counter_value("executor.compiles"),
+            "compile_fallbacks": obs.counter_value(
+                "executor.compile_fallbacks"),
+        }
+
     def run_n(n):
         """n-1 device-resident steps + one numpy-fetch step: the final
         d2h is the only HARD sync this remote runtime honors
@@ -114,6 +131,7 @@ def _time_steps(exe, main, feed, loss, warmup=3, iters=20, windows=2,
         exe.run(main, feed=feed, fetch_list=[loss], return_numpy=False)
     run_n(1)  # sync point + first (expensive) d2h out of the way
     t_compile = time.time() - t_compile
+    c_warm = _counts()
     times = []
     final_loss = float("nan")
     for w in range(windows):
@@ -122,21 +140,29 @@ def _time_steps(exe, main, feed, loss, warmup=3, iters=20, windows=2,
         t, final_loss = run_n(iters)
         times.append(t)
     dt = min(times) / iters
-    # whole_compile must reflect THIS program: _compile_fallbacks alone
-    # misses the untraceable-program path (where the executor never
-    # attempts the compile — the round-3 silent collapse), and is
-    # executor-wide, so it must be keyed by the main program's version
-    from paddle_tpu.core.compiler_engine import (_program_version,
-                                                 untraceable_reasons)
-
-    fb = exe._compile_fallbacks.get(_program_version(main))
-    whole = exe._can_whole_compile(main) and fb is None
+    c_end = _counts()
+    timed = {k: c_end[k] - c_warm[k] for k in c_end}
+    # whole_compile reflects what the TIMED windows actually executed:
+    # any interpreter step during them IS the round-3 silent collapse
+    # (counter covers both the fallback path and the never-attempted
+    # untraceable path — both land in executor.steps{path=interpreter})
+    whole = timed["steps_interpreter"] == 0 and timed["steps_compiled"] > 0
     diag = {
         "windows_s": [round(t, 3) for t in times],
         "warmup_s": round(t_compile, 1),
         "whole_compile": whole,
+        # recompiles during the timed windows: nonzero means signature
+        # churn is recompiling the program mid-measurement
+        "recompiles": timed["compiles"],
+        "steps": {"compiled": timed["steps_compiled"],
+                  "interpreter": timed["steps_interpreter"]},
+        "warmup_compiles": c_warm["compiles"],
     }
     if not whole:
+        from paddle_tpu.core.compiler_engine import (_program_version,
+                                                     untraceable_reasons)
+
+        fb = exe._compile_fallbacks.get(_program_version(main))
         diag["fallback"] = (str(fb)[:200] if fb is not None else
                             "untraceable: %s" % ", ".join(
                                 untraceable_reasons(

@@ -41,8 +41,22 @@ else
     echo "g++ unavailable, skipped"
 fi
 
+echo "== gate 4: observability =="
+# 4a: the observability layer's own tests (registry, spans, exporters,
+# executor/lazy counters, profiler shim). Skipped when the full suite
+# runs below — gate 5 collects the same file; running it twice buys
+# nothing
+if [[ "${SKIP_TESTS:-0}" == "1" ]]; then
+    python -m pytest tests/test_observability.py -q
+fi
+# 4b: PADDLE_TPU_METRICS unset (default-off) must add no measurable
+# overhead to a tiny executor microbench (guard threshold, not exact
+# timing — see tools/obs_overhead.py)
+env -u PADDLE_TPU_METRICS -u FLAGS_tpu_metrics \
+    python -m paddle_tpu.tools.obs_overhead
+
 if [[ "${SKIP_TESTS:-0}" != "1" ]]; then
-    echo "== gate 4: test suite =="
+    echo "== gate 5: test suite =="
     python -m pytest tests/ -q
 fi
 echo "ALL CI GATES PASS"

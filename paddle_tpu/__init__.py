@@ -67,6 +67,7 @@ from .io import save, load  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import nn  # noqa: F401
 from . import metrics  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from .reader import DataLoader  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401

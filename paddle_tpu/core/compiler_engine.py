@@ -479,6 +479,13 @@ def compile_program(program, feed_names: Tuple[str, ...],
     if fn is not None:
         return fn
 
+    from .. import observability as _obs
+
+    # a fresh jit closure == a retrace + XLA compile at first call; a
+    # steady-state training loop should see exactly one of these, so
+    # growth of this counter mid-run IS a recompile storm
+    _obs.inc("executor.compiles")
+
     block = program.global_block()
 
     def step(state: Dict, feeds: Dict, step_seed):
@@ -531,20 +538,26 @@ def run_compiled_program(core, program, scope: Scope, feed: Dict,
 
     fn = compile_program(program, feed_names, fetch_names, state_names,
                          out_state_names)
-    import contextlib
+    import time
 
-    from ..profiler import is_profiler_enabled, record_event
+    from .. import observability as _obs
 
-    # compiled path = ONE fused dispatch: a single step-level host event
+    # compiled path = ONE fused dispatch: a single step-level host span
     # (per-op detail lives in the XPlane device trace; the op-by-op
-    # interpreter records per-op events)
-    ev = record_event("compiled_step") if is_profiler_enabled() \
-        else contextlib.nullcontext()
-    with jax.default_device(core.place.jax_device()), ev:
+    # interpreter records per-op spans)
+    t_step = time.perf_counter() if _obs.enabled() else None
+    with jax.default_device(core.place.jax_device()), \
+            _obs.tracing.span("compiled_step", cat="step",
+                              path="compiled"):
         fetches, new_state = fn(state, feed_vals, jnp.uint32(
             core.rng.next_seed(0)
             ^ (core.rng.step * 2654435761 & 0xFFFFFFFF)))
     core.rng.advance()
+    if t_step is not None:
+        _obs.inc("executor.steps", path="compiled")
+        _obs.observe("executor.step_ms",
+                     (time.perf_counter() - t_step) * 1e3,
+                     path="compiled")
 
     for n, v in new_state.items():
         var = scope.var(n)

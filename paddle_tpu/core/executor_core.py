@@ -19,6 +19,7 @@ debugging, and parity with Executor semantics.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -77,17 +78,17 @@ class RNGState:
         self.step += 1
 
 
-_profiler_cache = []
+_obs_cache = []
 
 
-def _profiler_module():
+def _obs_module():
     """Lazy module ref (a top-level import would be circular; importing
     per run_op call would tax the interpreter hot loop)."""
-    if not _profiler_cache:
-        from .. import profiler
+    if not _obs_cache:
+        from .. import observability
 
-        _profiler_cache.append(profiler)
-    return _profiler_cache[0]
+        _obs_cache.append(observability)
+    return _obs_cache[0]
 
 
 class CoreExecutor:
@@ -130,12 +131,18 @@ class CoreExecutor:
     # -- op execution -----------------------------------------------------
 
     def run_op(self, op, scope: Scope):
-        prof = _profiler_module()
+        obs = _obs_module()
         try:
-            if prof.is_profiler_enabled():
-                with prof.record_event(op.type):
-                    return self._run_op_impl(op, scope)
-            return self._run_op_impl(op, scope)
+            if obs.tracing.active():
+                # per-op host span: feeds both the legacy profiler
+                # session table and the unified chrome-trace export
+                with obs.tracing.span(op.type, cat="op"):
+                    self._run_op_impl(op, scope)
+            else:
+                self._run_op_impl(op, scope)
+            if obs.enabled():
+                obs.inc("executor.ops", type=op.type)
+            return None
         except Exception as e:
             # EnforceNotMet ergonomics (reference operator.cc catch):
             # every kernel failure carries the op's signature; the
@@ -367,6 +374,8 @@ class CoreExecutor:
         fetch_list: Optional[Sequence] = None,
         return_numpy: bool = True,
     ):
+        obs = _obs_module()
+        t_step = time.perf_counter() if obs.enabled() else None
         feed = feed or {}
         for name, value in feed.items():
             if isinstance(value, LoDTensor):
@@ -396,8 +405,15 @@ class CoreExecutor:
                 self._gc_plan_cache[key] = gc_plan
             else:
                 self._gc_plan_cache[key] = self._gc_plan_cache.pop(key)
-        self.run_block(program.global_block(), scope, gc_plan=gc_plan)
+        with obs.tracing.span("executor/step", cat="step",
+                              path="interpreter"):
+            self.run_block(program.global_block(), scope, gc_plan=gc_plan)
         self.rng.advance()
+        if t_step is not None:
+            obs.inc("executor.steps", path="interpreter")
+            obs.observe("executor.step_ms",
+                        (time.perf_counter() - t_step) * 1e3,
+                        path="interpreter")
 
         results = []
         for f in fetch_list or []:

@@ -38,6 +38,15 @@ _DEFS = {
     "FLAGS_dygraph_lazy": (False, "queue eager dygraph ops and flush "
                            "them as one compiled dispatch per step "
                            "(lazy-tensor mode, dygraph/lazy.py)"),
+    "FLAGS_tpu_metrics": (False, "arm the runtime observability layer "
+                          "(paddle_tpu/observability: metrics registry "
+                          "+ span tracing across every execution "
+                          "path). Env alias: PADDLE_TPU_METRICS"),
+}
+
+# secondary env names honored at init (the primary is FLAGS_<name>)
+_ENV_ALIASES = {
+    "FLAGS_tpu_metrics": "PADDLE_TPU_METRICS",
 }
 
 _values: Dict[str, object] = {}
@@ -56,6 +65,8 @@ def _coerce(default, raw: str):
 def _init_from_env():
     for name, (default, _doc) in _DEFS.items():
         raw = os.environ.get(name)
+        if raw is None and name in _ENV_ALIASES:
+            raw = os.environ.get(_ENV_ALIASES[name])
         _values[name] = _coerce(default, raw) if raw is not None else default
 
 
@@ -88,6 +99,12 @@ def set_flags(flags: Dict[str, object]):
         default = _DEFS[key][0]
         _values[key] = _coerce(default, v) if isinstance(v, str) else \
             type(default)(v) if not isinstance(default, str) else str(v)
+        if key == "FLAGS_tpu_metrics":
+            # keep the observability layer's fast-path bool in sync
+            from .. import observability
+
+            (observability.enable if _values[key]
+             else observability.disable)()
 
 
 def flag(name: str):

@@ -65,7 +65,25 @@ def _grad_base(name: str) -> Optional[str]:
     return name[:i] if i > 0 else None
 
 
-LAST_DECLINE = None
+class Decline:
+    """Why a lowering was refused: returned (not stored in a module
+    global — concurrent executors each get their own reason) from
+    ``plan_lowering``/``build_lowered``. Falsy, so ``if not plan``
+    keeps working for callers that only care about success."""
+
+    __slots__ = ("op_index", "op_type", "reason")
+
+    def __init__(self, op_index: int, op_type: str, reason: str):
+        self.op_index = op_index
+        self.op_type = op_type
+        self.reason = reason
+
+    def __bool__(self):
+        return False
+
+    def __repr__(self):
+        return "Decline(op #%d %s: %s)" % (self.op_index, self.op_type,
+                                           self.reason)
 
 
 def plan_lowering(program, lod_feeds):
@@ -73,10 +91,10 @@ def plan_lowering(program, lod_feeds):
     (padded op type, [length var names]) for every sequence op (and
     its grad) touching ragged data, ragged maps every ragged var ->
     its length var, and axis_bumps lists elementwise ops whose dense-
-    operand axis shifts right in the padded domain. None if any
-    unsupported op/pattern touches the ragged region — the reason is
-    recorded in ``LAST_DECLINE`` for the executor's fallback
-    diagnostics."""
+    operand axis shifts right in the padded domain. A falsy ``Decline``
+    (op index, op type, reason) if any unsupported op/pattern touches
+    the ragged region — the executor surfaces it in its fallback
+    diagnostics and the ``lod_lowering.declines`` counter."""
     block = program.global_block()
     ragged: Dict[str, str] = {f: _len_name(f) for f in lod_feeds}
     swaps: Dict[int, Tuple[str, List[str]]] = {}
@@ -96,10 +114,8 @@ def plan_lowering(program, lod_feeds):
             continue
         is_grad = op.type.endswith("_grad")
         base_type = op.type[:-5] if is_grad else op.type
-        def _decline(why):
-            global LAST_DECLINE
-            LAST_DECLINE = (i, op.type, why)
-            return None
+        def _decline(why, _i=i, _op=op):
+            return Decline(_i, _op.type, why)
 
         if base_type in SWAPS:
             new_type, collapses = SWAPS[base_type]
@@ -204,13 +220,13 @@ def _len_name(feed: str) -> str:
 
 def build_lowered(program, lod_feeds):
     """Lowered clone of ``program`` (sequence ops -> padded twins wired
-    to length vars), or None when the plan fails. Returns the 3-tuple
-    (clone, feeds-to-pad set, all-ragged-var set) — the last is the set
-    of vars whose fetch would return PADDED values (the executor
-    refuses those fetches)."""
+    to length vars), or the plan's falsy ``Decline`` when it fails.
+    Returns the 3-tuple (clone, feeds-to-pad set, all-ragged-var set) —
+    the last is the set of vars whose fetch would return PADDED values
+    (the executor refuses those fetches)."""
     plan = plan_lowering(program, lod_feeds)
-    if plan is None:
-        return None
+    if isinstance(plan, Decline):
+        return plan
     swaps, ragged, axis_bumps = plan
     clone = program.clone()
     block = clone.global_block()

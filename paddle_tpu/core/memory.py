@@ -95,15 +95,24 @@ def memory_stats(place=None) -> Dict:
 
 def memory_usage(place=None) -> Dict[str, int]:
     """Normalized view: allocated / reserved / peak bytes (the stats.h
-    surface)."""
+    surface). When the observability layer is armed, each read also
+    refreshes the ``memory.*_bytes`` gauges (live + high-water marks) —
+    ``observability.dump()`` pulls through here, so a dump always
+    carries current allocator state."""
     s = memory_stats(place)
-    return {
+    usage = {
         "allocated": int(s.get("bytes_in_use", 0)),
         "reserved": int(s.get("bytes_reserved",
                               s.get("bytes_reservable_limit", 0))),
         "peak": int(s.get("peak_bytes_in_use", 0)),
         "limit": int(s.get("bytes_limit", 0)),
     }
+    from .. import observability as _obs
+
+    if _obs.enabled():
+        for k, v in usage.items():
+            _obs.set_gauge("memory.%s_bytes" % k, v)
+    return usage
 
 
 def release_all(place=None) -> None:

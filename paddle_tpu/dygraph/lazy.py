@@ -28,6 +28,18 @@ import numpy as np
 
 __all__ = ["PendingValue", "LazyEngine", "is_pending", "aval_of"]
 
+_obs_cache: List = []
+
+
+def _obs():
+    """Lazy module ref (importing per flush is cheap, but force() sits
+    on value-read paths; mirror executor_core's cached-ref pattern)."""
+    if not _obs_cache:
+        from .. import observability
+
+        _obs_cache.append(observability)
+    return _obs_cache[0]
+
 
 def is_pending(x) -> bool:
     return isinstance(x, PendingValue)
@@ -64,7 +76,7 @@ class PendingValue:
     value read (``__array__``) forces a flush."""
 
     __slots__ = ("aval", "value", "_resolved", "engine", "_owners",
-                 "__weakref__")
+                 "_pinned", "__weakref__")
 
     def __init__(self, aval, engine):
         self.aval = aval          # jax.ShapeDtypeStruct
@@ -72,6 +84,7 @@ class PendingValue:
         self._resolved = False
         self.engine = engine
         self._owners: List = []   # [(weakref(obj), attr or None)]
+        self._pinned = False      # force() in flight: must materialize
 
     # -- shape surface ----------------------------------------------------
     @property
@@ -100,6 +113,8 @@ class PendingValue:
         self._owners.append((weakref.ref(obj), attr))
 
     def is_needed(self) -> bool:
+        if self._pinned:
+            return True
         for ref, attr in self._owners:
             o = ref()
             if o is None:
@@ -111,6 +126,13 @@ class PendingValue:
     # -- forcing ----------------------------------------------------------
     def force(self):
         if not self._resolved:
+            # pin BEFORE flushing: a value held only by local dicts
+            # (mid-backward cotangents on a mixed eager/lazy tape) has
+            # no VarBase owner, but the very act of forcing proves it
+            # is needed — without the pin the flush would skip its
+            # materialization and the read below would hit the
+            # "dead at flush time" RuntimeError
+            self._pinned = True
             self.engine.flush()
         if not self._resolved:
             raise RuntimeError("pending value did not resolve on flush")
@@ -210,6 +232,10 @@ class LazyEngine:
     def _flush_impl(self, conservative=False):
         import jax
 
+        obs = _obs()
+        if obs.enabled():
+            obs.inc("lazy.flushes")
+            obs.observe("lazy.graph_nodes", len(self.nodes))
         nodes, self.nodes = self.nodes, []
         pos: Dict[int, Tuple[int, int]] = {}
         for ni, nd in enumerate(nodes):
@@ -251,7 +277,12 @@ class LazyEngine:
         fn = self._jit_cache.get(key)
         if fn is not None:
             self._jit_cache.move_to_end(key)
+            obs.inc("lazy.cache_hits")
         else:
+            # a structural cache miss == a retrace + XLA recompile of
+            # the whole queued step: the metric that catches signature
+            # churn (varying shapes/attrs) killing steady-state perf
+            obs.inc("lazy.recompiles")
             node_fns = tuple(nd.fn for nd in nodes)
             wiring_t = tuple(wiring)
             needed_t = needed
@@ -269,7 +300,9 @@ class LazyEngine:
             while len(self._jit_cache) > self.JIT_CACHE_CAP:
                 self._jit_cache.popitem(last=False)
 
-        out_vals = fn(ext)
+        with obs.tracing.span("lazy/flush", cat="step",
+                              nodes=len(nodes)):
+            out_vals = fn(ext)
         by_pos = dict(zip(needed, out_vals))
         for ni, nd in enumerate(nodes):
             for oj, p in enumerate(nd.outs):

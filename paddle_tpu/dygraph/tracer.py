@@ -25,6 +25,42 @@ from .varbase import ParamBase, VarBase
 
 _active_tracer: Optional["Tracer"] = None
 
+_obs_cache: List = []
+
+
+def _obs():
+    """Cached observability module ref (same idiom as executor_core):
+    trace_op is the eager hot path."""
+    if not _obs_cache:
+        from .. import observability
+
+        _obs_cache.append(observability)
+    return _obs_cache[0]
+
+
+def _canon_attr(v):
+    """Hashable, content-faithful canonical form of an attr value for
+    cache signatures. Array-valued attrs hash by CONTENT (shape +
+    dtype + digest of the bytes): ``repr`` elides interior elements of
+    large arrays, which can alias two different ops onto one cached
+    compiled graph — a silent wrong-answer bug."""
+    if isinstance(v, np.ndarray):
+        import hashlib
+
+        return ("ndarray", tuple(v.shape), v.dtype.str,
+                hashlib.sha1(np.ascontiguousarray(v).tobytes())
+                .hexdigest())
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon_attr(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _canon_attr(x)) for k, x in v.items()))
+    return v
+
+
+def attrs_signature(attrs: Dict) -> str:
+    """Stable signature of an op's attr dict, safe for jit-cache keys."""
+    return repr(sorted((k, _canon_attr(v)) for k, v in attrs.items()))
+
 
 def current_tracer() -> Optional["Tracer"]:
     return _active_tracer
@@ -243,7 +279,13 @@ class Tracer:
         if info.host_fn is not None:
             raise RuntimeError("host op %r is not usable in dygraph" % op_type)
 
-        if self.lazy_engine is not None and self._recording_program is None:
+        use_lazy = (self.lazy_engine is not None
+                    and self._recording_program is None)
+        obs = _obs()
+        if obs.enabled():
+            obs.inc("dygraph.ops",
+                    dispatch="lazy" if use_lazy else "eager")
+        if use_lazy:
             return self._trace_op_lazy(info, op_type, inputs, outputs,
                                        attrs, stop_gradient)
 
@@ -432,8 +474,7 @@ class Tracer:
         from .lazy import aval_of as _aval
 
         in_avals = [_aval(h) for h in handles]
-        attrs_sig = repr(sorted(
-            (k, v) for k, v in attrs.items()))
+        attrs_sig = attrs_signature(attrs)
         # the slot LAYOUT is part of the identity: two dispensable-slot
         # patterns (e.g. slice with StartsTensor vs EndsTensor) can
         # have identical avals but bind inputs differently

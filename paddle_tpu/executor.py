@@ -112,6 +112,9 @@ class Executor:
                             "interpretation (whole-program compile "
                             "failed: %r)" % (program._uid, e))
                         self._compile_fallbacks[ver] = repr(e)
+                        from . import observability as _obs
+
+                        _obs.inc("executor.compile_fallbacks")
         return self._core.run_program(program, scope, feed, fetch_list,
                                       return_numpy)
 
@@ -136,20 +139,22 @@ class Executor:
         ver = (_program_version(program), tuple(lod_feeds))
         hit = self._lod_lowered_cache.get(ver)
         if hit is None:
+            from . import observability as _obs
             from .core.compiler_engine import block_is_traceable
+            from .core.lod_lowering import Decline
 
             built = build_lowered(program, lod_feeds)
-            if built is None:
-                from .core import lod_lowering as _ll
+            if isinstance(built, Decline):
+                import warnings
 
-                if _ll.LAST_DECLINE is not None:
-                    import warnings
-
-                    warnings.warn(
-                        "LoD lowering declined for program %s (op #%d "
-                        "%s: %s) — ragged steps take the op-by-op "
-                        "interpreter" % ((program._uid,)
-                                         + tuple(_ll.LAST_DECLINE)))
+                _obs.inc("lod_lowering.declines", op_type=built.op_type,
+                         reason=built.reason)
+                warnings.warn(
+                    "LoD lowering declined for program %s (op #%d "
+                    "%s: %s) — ragged steps take the op-by-op "
+                    "interpreter" % (program._uid, built.op_index,
+                                     built.op_type, built.reason))
+                built = None
             elif not block_is_traceable(built[0].global_block()):
                 built = None  # other blockers remain (while bodies...)
             self._lod_lowered_cache[ver] = built if built is not None \

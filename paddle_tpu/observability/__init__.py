@@ -1,0 +1,225 @@
+"""Unified runtime observability: metrics registry + span tracing.
+
+Every execution path reports here — static ``Executor`` (compiled and
+interpreter), the lazy dygraph engine, the mesh data-parallel engine,
+the pipeline engine, the LoD-lowering planner, and the memory facade —
+so "why was step N slow", "how often did the lazy engine recompile" and
+"did the pipeline bubble grow" are answerable without print-debugging.
+
+Opt-in: set ``PADDLE_TPU_METRICS=1`` (or the ``FLAGS_tpu_metrics``
+flag via ``fluid.set_flags``), or call ``observability.enable()``.
+When disabled (the default) every instrumentation site is a single
+cached-module-attribute load plus a branch — a no-op on the hot path.
+
+Metric families (see README "Runtime observability"):
+
+=====================================  ======================================
+``executor.steps{path=...}``           counter: compiled | interpreter steps
+``executor.step_ms{path=...}``         histogram: host step latency
+``executor.ops{type=...}``             counter: interpreter per-op executions
+``executor.compiles``                  counter: whole-program (re)compiles
+``executor.compile_fallbacks``         counter: compiled -> interpreter drops
+``lod_lowering.declines{op_type=...}`` counter: ragged lowering declines
+``lazy.flushes``                       counter: lazy-engine flushes
+``lazy.cache_hits`` / ``lazy.recompiles``  counter: flush jit cache hit/miss
+``lazy.graph_nodes``                   histogram: nodes per flushed graph
+``dygraph.ops{dispatch=...}``          counter: traced eager/lazy ops
+``parallel.steps`` / ``.compiles``     counter: mesh-engine steps/compiles
+``parallel.collective_bytes``          counter: bytes allreduced per step
+``parallel.step_ms``                   histogram: mesh step latency
+``pipeline.steps`` / ``.step_ms``      counter / histogram
+``pipeline.bubble_fraction``           gauge: (S-1)/(M+S-1) GPipe bubble
+``pipeline.boundary_bytes{boundary=}`` gauge: rotating-buffer payload
+``memory.*_bytes``                     gauge: live/peak/limit device bytes
+=====================================  ======================================
+
+Export: ``dump()`` -> JSON-able dict, ``dump(fmt="prometheus")`` ->
+text exposition format, ``chrome_trace()`` / ``write_chrome_trace()``
+-> Perfetto-loadable ``trace_event`` JSON merging all host spans
+(including the legacy ``fluid.profiler`` timeline).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from . import tracing  # noqa: F401
+from .registry import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
+from .tracing import span  # noqa: F401
+
+__all__ = ["enable", "disable", "enabled", "metrics", "counter", "gauge",
+           "histogram", "inc", "set_gauge", "observe", "counter_value",
+           "gauge_value", "span", "dump", "dump_prometheus",
+           "chrome_trace", "write_chrome_trace", "reset",
+           "MetricsRegistry", "Counter", "Gauge", "Histogram"]
+
+_registry = MetricsRegistry()
+_enabled = False
+
+
+def _init_from_env() -> None:
+    """Arm from the environment before core.flags is even imported —
+    observability must not drag the flag module (and transitively jax)
+    in at import time. Precedence matches core/flags._init_from_env
+    exactly (FLAGS_tpu_metrics primary, PADDLE_TPU_METRICS alias) so
+    the flag value and this layer's armed state can never diverge."""
+    raw = os.environ.get("FLAGS_tpu_metrics")
+    if raw is None:
+        raw = os.environ.get("PADDLE_TPU_METRICS", "")
+    if raw.lower() in ("1", "true", "yes", "on"):
+        enable()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _sync_flag(on: bool) -> None:
+    """Keep FLAGS_tpu_metrics truthful when enable()/disable() is
+    called directly (get_flags must report the armed state). Written
+    via sys.modules so this never forces core.flags (and its package
+    init) to load early — if flags isn't loaded yet, its own env init
+    resolves to the same value."""
+    import sys
+
+    fl = sys.modules.get(__package__.rsplit(".", 1)[0] + ".core.flags")
+    if fl is not None:
+        fl._values["FLAGS_tpu_metrics"] = bool(on)
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+    tracing._set_metrics_on(True)
+    _sync_flag(True)
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+    tracing._set_metrics_on(False)
+    _sync_flag(False)
+
+
+def metrics() -> MetricsRegistry:
+    return _registry
+
+
+# -- direct metric handles (create regardless of enabled: tests and
+# callers that hold a handle pay the branch themselves) --------------------
+
+def counter(name: str, **labels) -> Counter:
+    return _registry.counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return _registry.gauge(name, **labels)
+
+
+def histogram(name: str, **labels) -> Histogram:
+    return _registry.histogram(name, **labels)
+
+
+# -- guarded one-shot helpers (the instrumentation-site surface) -----------
+
+def inc(name: str, n: int = 1, **labels) -> None:
+    if _enabled:
+        _registry.counter(name, **labels).inc(n)
+
+
+def set_gauge(name: str, v, **labels) -> None:
+    if _enabled:
+        _registry.gauge(name, **labels).set(v)
+
+
+def observe(name: str, v, **labels) -> None:
+    if _enabled:
+        _registry.histogram(name, **labels).observe(v)
+
+
+def counter_value(name: str, **labels):
+    return _registry.counter_value(name, **labels)
+
+
+def gauge_value(name: str, **labels):
+    return _registry.gauge_value(name, **labels)
+
+
+# -- export ----------------------------------------------------------------
+
+def _refresh_memory_gauges() -> None:
+    """Pull-style gauges: live/peak device bytes are sampled at dump
+    time (the backend owns the counters; polling every step would be
+    overhead for numbers only a dump reader looks at). memory_usage
+    itself writes the ``memory.*_bytes`` gauges when the layer is
+    enabled; a disabled dump stays a pure observation and creates
+    nothing."""
+    if not _enabled:
+        return
+    try:
+        from ..core.memory import memory_usage
+
+        memory_usage()
+    except Exception:
+        pass
+
+
+def dump(fmt: str = "json") -> object:
+    """Snapshot of every metric. ``fmt="json"`` (default) returns a
+    JSON-able dict; ``fmt="prometheus"`` returns the text exposition
+    format."""
+    _refresh_memory_gauges()
+    if fmt == "prometheus":
+        return _registry.to_prometheus()
+    if fmt != "json":
+        raise ValueError("unknown dump format %r" % fmt)
+    out = _registry.snapshot()
+    out["spans"] = tracing.stats()
+    out["enabled"] = _enabled
+    return out
+
+
+def dump_prometheus() -> str:
+    return dump(fmt="prometheus")
+
+
+def _legacy_profiler_events():
+    """The old ``fluid.profiler`` timeline — live session if one is
+    running, else the last finished session's snapshot — so the chrome
+    export keeps the ``get_trace_events()`` contract alive."""
+    try:
+        from .. import profiler
+
+        if tracing.profiler_session_active():
+            return []   # live session spans are already in the buffer
+        return profiler.get_trace_events()
+    except Exception:
+        return []
+
+
+def chrome_trace() -> Dict:
+    """Perfetto-loadable ``trace_event`` JSON merging the span buffer
+    with the legacy profiler timeline."""
+    return tracing.chrome_trace(extra_events=_legacy_profiler_events())
+
+
+def write_chrome_trace(path: str) -> str:
+    return tracing.write_chrome_trace(
+        path, extra_events=_legacy_profiler_events())
+
+
+def reset() -> None:
+    """Clear all metrics and buffered spans — including the legacy
+    profiler's finished-session snapshot, so a post-reset
+    chrome_trace() is actually empty (enabled state is kept)."""
+    _registry.reset()
+    tracing.clear()
+    try:
+        from .. import profiler
+
+        del profiler._last_trace[:]
+    except Exception:
+        pass
+
+
+_init_from_env()

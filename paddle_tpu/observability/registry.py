@@ -1,0 +1,273 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The TPU-native replacement for the reference's scattered telemetry
+(platform/profiler.cc event totals, device_tracer counters,
+memory/stats.h) — one zero-dependency, thread-safe registry every
+execution path reports into. DynaFlow-style operator scheduling and the
+EQuARX collective work (PAPERS.md) both presuppose exactly this layer:
+you cannot optimize a recompile storm or a pipeline bubble you cannot
+count.
+
+Metrics are identified by (name, labels). Creation is get-or-create and
+cheap enough for hot paths *when the layer is enabled*; when disabled
+the instrumentation helpers in ``observability/__init__`` never reach
+this module.
+
+Histograms keep exact count/sum/min/max plus a bounded reservoir
+(uniform reservoir sampling, cap ``Histogram.RESERVOIR``) for
+percentile estimates — memory stays O(1) per metric no matter how many
+steps a training run records.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
+
+LabelsT = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Dict) -> LabelsT:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _qualified(name: str, labels: LabelsT) -> str:
+    if not labels:
+        return name
+    return "%s{%s}" % (name, ",".join("%s=%s" % kv for kv in labels))
+
+
+class _Metric:
+    __slots__ = ("name", "labels", "_lock")
+
+    kind = "metric"
+
+    def __init__(self, name: str, labels: LabelsT):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+
+    @property
+    def qualified_name(self) -> str:
+        return _qualified(self.name, self.labels)
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (steps run, flushes, declines)."""
+
+    __slots__ = ("_value",)
+
+    kind = "counter"
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError("counter %r cannot decrease (n=%r)"
+                             % (self.name, n))
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+
+class Gauge(_Metric):
+    """Last-written value (live bytes, bubble fraction)."""
+
+    __slots__ = ("_value",)
+
+    kind = "gauge"
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self._value = 0.0
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+
+class Histogram(_Metric):
+    """Distribution with exact count/sum/min/max and a bounded uniform
+    reservoir for percentiles (step latency, flushed-graph sizes)."""
+
+    __slots__ = ("count", "sum", "min", "max", "_reservoir", "_rng")
+
+    kind = "histogram"
+    RESERVOIR = 512
+
+    def __init__(self, name, labels):
+        super().__init__(name, labels)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None  # type: Optional[float]
+        self.max = None  # type: Optional[float]
+        self._reservoir: List[float] = []
+        # private stream: never perturbs (or is perturbed by) the
+        # global random state a training script may have seeded
+        self._rng = random.Random(0x5EED ^ hash(self.qualified_name))
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            if len(self._reservoir) < self.RESERVOIR:
+                self._reservoir.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.RESERVOIR:
+                    self._reservoir[j] = v
+
+    def percentile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._reservoir:
+                return None
+            s = sorted(self._reservoir)
+        idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        return s[idx]
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            s = sorted(self._reservoir)
+            out = {"count": self.count, "sum": self.sum,
+                   "min": self.min, "max": self.max,
+                   "mean": (self.sum / self.count) if self.count else None}
+        for q, tag in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            if s:
+                idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+                out[tag] = s[idx]
+            else:
+                out[tag] = None
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create store of metrics, thread-safe. One process-wide
+    instance lives in ``paddle_tpu.observability``; private instances
+    are fine for tests."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, LabelsT], _Metric] = {}
+
+    def _get(self, cls, name: str, labels: Dict):
+        key = (name, _labels_key(labels))
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = cls(name, key[1])
+                    self._metrics[key] = m
+        if not isinstance(m, cls):
+            raise TypeError("metric %r already registered as %s, not %s"
+                            % (name, m.kind, cls.kind))
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def counter_value(self, name: str, **labels):
+        """Current value, 0 when the counter was never touched (reads
+        never create metrics — dump stays an observation)."""
+        m = self._metrics.get((name, _labels_key(labels)))
+        return m.value if isinstance(m, Counter) else 0
+
+    def gauge_value(self, name: str, **labels):
+        m = self._metrics.get((name, _labels_key(labels)))
+        return m.value if isinstance(m, Gauge) else 0
+
+    def all_metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    # -- export -----------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """JSON-able {counters, gauges, histograms} keyed by
+        ``name{label=value,...}``."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in self.all_metrics():
+            out[m.kind + "s"][m.qualified_name] = m.snapshot()
+        return out
+
+    def to_prometheus(self, prefix: str = "paddle_tpu_") -> str:
+        """Prometheus text exposition format (0.0.4). Histograms export
+        as summaries (quantile series + _sum/_count)."""
+        def _pname(name):
+            return prefix + "".join(
+                c if (c.isalnum() or c == "_") else "_" for c in name)
+
+        def _plabels(labels, extra=()):
+            items = list(labels) + list(extra)
+            if not items:
+                return ""
+            body = ",".join('%s="%s"' % (k, str(v).replace("\\", "\\\\")
+                                         .replace('"', '\\"'))
+                            for k, v in items)
+            return "{%s}" % body
+
+        by_name: Dict[Tuple[str, str], List[_Metric]] = {}
+        for m in self.all_metrics():
+            by_name.setdefault((m.name, m.kind), []).append(m)
+        lines = []
+        for (name, kind), ms in sorted(by_name.items()):
+            pn = _pname(name)
+            ptype = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "summary"}[kind]
+            lines.append("# TYPE %s %s" % (pn, ptype))
+            for m in sorted(ms, key=lambda x: x.labels):
+                if kind == "histogram":
+                    for q in (0.5, 0.9, 0.99):
+                        v = m.percentile(q)
+                        if v is not None:
+                            lines.append("%s%s %s" % (
+                                pn, _plabels(m.labels,
+                                             [("quantile", q)]), v))
+                    lines.append("%s_sum%s %s"
+                                 % (pn, _plabels(m.labels), m.sum))
+                    lines.append("%s_count%s %s"
+                                 % (pn, _plabels(m.labels), m.count))
+                else:
+                    lines.append("%s%s %s"
+                                 % (pn, _plabels(m.labels), m.value))
+        return "\n".join(lines) + ("\n" if lines else "")

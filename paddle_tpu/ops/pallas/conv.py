@@ -105,6 +105,13 @@ def conv2d_bn_act(x, w, scale=None, shift=None, *, stride=1, padding=0,
     """
     from jax.experimental import pallas as pl
 
+    if stride not in (1, 2):
+        # the kernel's decimation path folds the stride into a
+        # hard-coded factor-2 reshape (_kernel: pad-to-2bh + keep
+        # phase 0); any other stride would run to completion with
+        # wrong output instead of failing
+        raise ValueError("conv2d_bn_act supports stride 1 or 2, got %r"
+                         % (stride,))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, H, W, Cin = x.shape

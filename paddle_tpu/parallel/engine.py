@@ -36,6 +36,48 @@ from .transpiler import insert_allreduce_ops
 _dp_cache: Dict = {}
 
 
+def _estimate_collective_bytes(program, state: Dict) -> Tuple[int, int]:
+    """(collective op count, bytes moved per step) over the transpiled
+    program's c_* collectives — the EQuARX-style comms counter a
+    collective-compression PR needs as its before/after. Shapes come
+    from block vars when recorded, else from the replicated param a
+    grad collective mirrors (same shape); unknown shapes count as 0
+    bytes rather than guessing."""
+    block = program.global_block()
+    count = 0
+    total = 0
+    for op in block.ops:
+        if not op.type.startswith("c_"):
+            continue
+        if not any(k in op.type for k in ("allreduce", "allgather",
+                                          "reducescatter", "broadcast")):
+            continue
+        count += 1
+        for name in op.input_arg_names:
+            if not name:
+                continue
+            nbytes = 0
+            v = block._find_var_recursive(name)
+            shape = getattr(v, "shape", None) if v is not None else None
+            if shape and all(isinstance(s, int) and s > 0 for s in shape):
+                try:
+                    item = np.dtype(getattr(v, "dtype", "float32")
+                                    or "float32").itemsize
+                except TypeError:
+                    item = 4
+                nbytes = int(np.prod(shape)) * item
+            else:
+                from ..core.lod_lowering import _grad_base
+
+                base = _grad_base(name)
+                arr = state.get(base) if base else None
+                if arr is not None:
+                    nbytes = int(getattr(arr, "size", 0)) * \
+                        np.dtype(arr.dtype).itemsize
+            total += nbytes
+    return count, total
+
+
 def _mesh_spans_processes(mesh) -> bool:
     import jax
 
@@ -163,12 +205,16 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     block = program.global_block()
     out_state_names = tuple(sorted(set(state_names) | persist_written))
 
+    from .. import observability as _obs
+
     key = (_program_version(program), feed_names, fetch_names, state_names,
            out_state_names, _mesh_key(mesh), data_axes, sync_bn,
            tuple(sorted((k, v) for k, v in shard_specs.items())),
            tuple(sorted((k, v) for k, v in feed_specs.items())))
-    fn = _dp_cache.get(key)
-    if fn is None:
+    hit = _dp_cache.get(key)
+    if hit is None:
+        _obs.inc("parallel.compiles")
+        coll_ops, coll_bytes = _estimate_collective_bytes(program, state)
         def shard_step(state_d, feeds_d, seed):
             with ring_axis_guard({0: ring_val, -1: ring_val}), \
                     mesh_axes_guard(mesh_axes):
@@ -194,13 +240,26 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
                         for n in out_state_names}),
         )
         fn = jax.jit(mapped, donate_argnums=(0,))
-        _dp_cache[key] = fn
+        hit = (fn, coll_ops, coll_bytes)
+        _dp_cache[key] = hit
+    fn, coll_ops, coll_bytes = hit
 
-    fetches, new_state = fn(
-        state, feed_vals,
-        jnp.uint32(core.rng.next_seed(0) ^
-                   ((core.rng.step * 2654435761) & 0xFFFFFFFF)))
+    import time as _time
+
+    t_step = _time.perf_counter() if _obs.enabled() else None
+    with _obs.tracing.span("parallel/step", cat="step",
+                           ranks=nranks):
+        fetches, new_state = fn(
+            state, feed_vals,
+            jnp.uint32(core.rng.next_seed(0) ^
+                       ((core.rng.step * 2654435761) & 0xFFFFFFFF)))
     core.rng.advance()
+    if t_step is not None:
+        _obs.inc("parallel.steps")
+        _obs.observe("parallel.step_ms",
+                     (_time.perf_counter() - t_step) * 1e3)
+        _obs.inc("parallel.collective_ops", coll_ops)
+        _obs.inc("parallel.collective_bytes", coll_bytes)
 
     def _local(v):
         """A locally-readable copy of a (replicated) result: under a

@@ -130,6 +130,8 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
     import jax
     import jax.numpy as jnp
 
+    from .. import observability as _obs
+
     meta = getattr(program, "_pipeline_meta", None)
     if meta is None:
         raise ValueError(
@@ -140,6 +142,16 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
     n_stages = len(stages)
     n_micro = int(meta["num_microbatches"])
     loss_name = meta["loss"]
+    if _obs.enabled():
+        # the GPipe fill/drain bubble: (S-1) of (M+S-1) ticks are idle
+        # per device — THE pipeline-efficiency number follow-up perf
+        # PRs must watch (more microbatches -> smaller fraction)
+        _obs.set_gauge("pipeline.stages", n_stages)
+        _obs.set_gauge("pipeline.microbatches", n_micro)
+        _obs.set_gauge("pipeline.bubble_fraction",
+                       (n_stages - 1.0) / (n_micro + n_stages - 1.0))
+        for i, s in enumerate(stages):
+            _obs.set_gauge("pipeline.stage_ops", len(s), stage=i)
 
     if mesh is None:
         mesh = make_mesh([n_stages], [axis_name])
@@ -228,13 +240,17 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
            tuple(sorted((k, v) for k, v in shard_specs.items())))
     compiled = _pp_cache.get(key)
     if compiled is None:
-        compiled = _build_pipeline_fn(
-            block, stages, live, meta, mesh, axis_name, n_stages, n_micro,
-            feed_names, param_names, tuple(sorted(other_state)), loss_name,
-            {n: (v.shape, v.dtype) for n, v in feed_vals.items()},
-            {n: (v.shape, v.dtype) for n, v in params.items()},
-            {n: (v.shape, v.dtype) for n, v in other_state.items()},
-            dp_axis=dp_axis, shard_specs=shard_specs)
+        _obs.inc("pipeline.compiles")
+        with _obs.tracing.span("pipeline/build", cat="compile",
+                               stages=n_stages, microbatches=n_micro):
+            compiled = _build_pipeline_fn(
+                block, stages, live, meta, mesh, axis_name, n_stages,
+                n_micro, feed_names, param_names,
+                tuple(sorted(other_state)), loss_name,
+                {n: (v.shape, v.dtype) for n, v in feed_vals.items()},
+                {n: (v.shape, v.dtype) for n, v in params.items()},
+                {n: (v.shape, v.dtype) for n, v in other_state.items()},
+                dp_axis=dp_axis, shard_specs=shard_specs)
         # bounded LRU, same rationale as executor_core._gc_plan_cache:
         # program mutation bumps the version and would leak executables
         if len(_pp_cache) >= 16:
@@ -242,7 +258,14 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
         _pp_cache[key] = compiled
     else:
         _pp_cache[key] = _pp_cache.pop(key)
-    jitted, upd_external, persist_out = compiled
+    jitted, upd_external, persist_out, (boundary_bytes, buffer_bytes) = \
+        compiled
+    if _obs.enabled():
+        for i, b in enumerate(boundary_bytes):
+            _obs.set_gauge("pipeline.boundary_bytes", b, boundary=i)
+        # actual per-tick ppermute transfer: every boundary moves the
+        # max-padded rotating buffer, not its logical payload
+        _obs.set_gauge("pipeline.buffer_bytes", buffer_bytes)
 
     # optimizer state is read FRESH each call — moments/lr change every
     # step and must not be baked into the compiled closure
@@ -256,8 +279,17 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
     seed = jnp.uint32(core.rng.next_seed(0)
                       ^ ((core.rng.step * 2654435761) & 0xFFFFFFFF))
     core.rng.advance()
-    loss_mean, new_persist = jitted(params, other_state, upd_state,
-                                    feed_vals, seed)
+    import time as _time
+
+    t_step = _time.perf_counter() if _obs.enabled() else None
+    with _obs.tracing.span("pipeline/step", cat="step",
+                           stages=n_stages, microbatches=n_micro):
+        loss_mean, new_persist = jitted(params, other_state, upd_state,
+                                        feed_vals, seed)
+    if t_step is not None:
+        _obs.inc("pipeline.steps")
+        _obs.observe("pipeline.step_ms",
+                     (_time.perf_counter() - t_step) * 1e3)
 
     for n, v in new_persist.items():
         scope.var(n).get_tensor()._array = v
@@ -280,6 +312,7 @@ def _build_pipeline_fn(block, stages, live, meta, mesh, axis_name,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from .. import observability as _obs
     from ..ops.collective_ops import mesh_axes_guard
 
     shard_specs = shard_specs or {}
@@ -312,7 +345,13 @@ def _build_pipeline_fn(block, stages, live, meta, mesh, axis_name,
         outs = []
         for i, ops in enumerate(stages):
             env.update(mb_feeds_a)
-            _trace_ops(block, ops, env, jnp.uint32(0))
+            # per-stage host span: stage tracing cost is the only
+            # per-stage work visible host-side (inside the compiled
+            # step the stages are one fused XLA program; device-level
+            # per-stage timing lives in the XPlane trace)
+            with _obs.tracing.span("pipeline/stage", cat="step",
+                                   stage=i, ops=len(ops)):
+                _trace_ops(block, ops, env, jnp.uint32(0))
             if i < n_stages - 1:
                 outs.append([env[n] for n in live[i]])
         return outs
@@ -470,4 +509,12 @@ def _build_pipeline_fn(block, stages, live, meta, mesh, axis_name,
         new_persist = {n: env[n] for n in persist_out if n in env}
         return loss, new_persist
 
-    return jax.jit(full_step), upd_external, persist_out
+    # gauge payloads, returned so the caller can refresh them every
+    # step (metrics armed AFTER the compile must still see them):
+    # boundary_bytes is each boundary's LOGICAL f32 payload; the wire
+    # cost per ppermute tick is the max-padded rotating buffer
+    # (buffer_bytes) regardless of boundary — both are exported so a
+    # schedule PR can't claim a win by shrinking a non-max boundary
+    boundary_bytes = tuple(s * 4 for s in sizes)
+    return (jax.jit(full_step), upd_external, persist_out,
+            (boundary_bytes, buf_size * 4))

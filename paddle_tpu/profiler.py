@@ -1,50 +1,47 @@
-"""Profiler.
+"""Profiler — thin compatibility shim over ``paddle_tpu.observability``.
 
 Parity: /root/reference/python/paddle/fluid/profiler.py (:253 profiler
 context manager, :129 start_profiler, :196 stop_profiler) + the C++
 RecordEvent/DeviceTracer pair (platform/profiler.cc, device_tracer.cc).
 
-TPU-native: host-side op events are timed in the executors; device-side
-tracing delegates to jax.profiler (XPlane -> TensorBoard / Perfetto),
-which replaces the CUPTI DeviceTracer + chrome-trace toolchain
-(tools/timeline.py). `profiler(...)` writes an XPlane trace dir and
-prints a per-op host summary table.
+The host-event machinery that used to live here (event table, trace
+tuples, enable flag) moved into ``observability/tracing.py`` where every
+execution path shares it; this module keeps the fluid API surface:
+``RecordEvent`` spans feed the same buffer as all other runtime spans,
+``start_profiler``/``stop_profiler`` bracket a *session* whose events
+are drained into a snapshot on stop (sessions never bleed), and
+``profiler(...)`` still prints the per-op host summary table.
+Device-side tracing still delegates to jax.profiler (XPlane ->
+TensorBoard / Perfetto), replacing the CUPTI DeviceTracer +
+chrome-trace toolchain (tools/timeline.py).
 """
 from __future__ import annotations
 
 import contextlib
-import time
-from collections import defaultdict
+
+from .observability import tracing as _tracing
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
            "stop_profiler"]
 
-_host_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
-_trace_events = []  # (name, t0_us, dur_us) — chrome-trace export
-_last_trace = []  # snapshot of the finished session (stop clears live)
-_enabled = False
+_last_trace = []  # (name, ts_us, dur_us) snapshot of the finished session
 _trace_dir = None
 
 
 class RecordEvent:
-    """RAII op-phase annotation (reference platform/profiler.cc:66)."""
+    """RAII op-phase annotation (reference platform/profiler.cc:66) —
+    now an observability span with cat='op'."""
 
     def __init__(self, name):
         self.name = name
 
     def __enter__(self):
-        self._t0 = time.perf_counter()
+        self._span = _tracing.span(self.name, cat="op")
+        self._span.__enter__()
         return self
 
     def __exit__(self, *exc):
-        if _enabled:
-            dur = time.perf_counter() - self._t0
-            ev = _host_events[self.name]
-            ev[0] += 1
-            ev[1] += dur
-            _trace_events.append(
-                (self.name, self._t0 * 1e6, dur * 1e6))
-        return False
+        return self._span.__exit__(*exc)
 
 
 def record_event(name):
@@ -52,25 +49,30 @@ def record_event(name):
 
 
 def is_profiler_enabled():
-    return _enabled
+    return _tracing.profiler_session_active()
 
 
 def get_trace_events():
     """(name, ts_us, dur_us) host events for timeline export: the live
     session while profiling, else the last finished session's snapshot
-    (stop_profiler clears live state so sessions never bleed)."""
-    return list(_trace_events) if _enabled else list(_last_trace)
+    (stop_profiler drains live state so sessions never bleed)."""
+    if _tracing.profiler_session_active():
+        return [(n, ts, dur)
+                for (n, ts, dur, _tid, _cat, _a)
+                in _tracing.profiler_session_events()]
+    return list(_last_trace)
 
 
 def reset_profiler():
-    _host_events.clear()
-    del _trace_events[:]
+    # session-scoped: metrics-mode spans recorded by other subsystems
+    # are not this API's to destroy
+    _tracing.profiler_session_reset()
 
 
 def start_profiler(state="All", tracer_option=None, trace_dir=None):
-    global _enabled, _trace_dir
-    _enabled = True
+    global _trace_dir
     _trace_dir = trace_dir
+    _tracing.profiler_session_start()
     if trace_dir:
         import jax
 
@@ -78,24 +80,27 @@ def start_profiler(state="All", tracer_option=None, trace_dir=None):
 
 
 def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
-    global _enabled
-    _enabled = False
     if _trace_dir:
         import jax
 
         jax.profiler.stop_trace()
-    rows = sorted(_host_events.items(), key=lambda kv: -kv[1][1])
+    session, agg = _tracing.profiler_session_stop()
+    # the aggregate side stays exact even when buffer pressure dropped
+    # old spans mid-session; the timeline snapshot below is best-effort
+    rows = sorted(((name, (count, total_us / 1e6))
+                   for name, (count, total_us) in agg.items()),
+                  key=lambda kv: -kv[1][1])
     if rows:
         print("%-40s %10s %14s %14s" % ("Event", "Calls", "Total(ms)", "Avg(ms)"))
         for name, (count, total) in rows[:50]:
             print("%-40s %10d %14.3f %14.3f"
                   % (name, count, total * 1e3, total * 1e3 / max(count, 1)))
-    # snapshot-and-clear so back-to-back sessions never bleed into each
-    # other (the reference's DisableProfiler resets after emitting)
+    # snapshot so get_trace_events() after stop still serves the
+    # finished session (the reference's DisableProfiler resets after
+    # emitting)
     del _last_trace[:]
-    _last_trace.extend(_trace_events)
-    del _trace_events[:]
-    _host_events.clear()
+    _last_trace.extend((n, ts, dur) for (n, ts, dur, _t, _c, _a)
+                       in session)
 
 
 @contextlib.contextmanager

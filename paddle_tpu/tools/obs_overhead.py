@@ -1,0 +1,107 @@
+"""Default-off observability overhead gate (ci/check.sh).
+
+Asserts that with ``PADDLE_TPU_METRICS`` unset the instrumentation
+threaded through the executors is a no-op on the hot path:
+
+1. microbenches the *disabled-path primitives* the hot loops actually
+   execute (``observability.enabled()`` check, no-op ``span()``,
+   guarded ``inc()``) — each must cost well under a microsecond;
+2. runs a tiny 2-op static program through the Executor and bounds the
+   *projected* per-step instrumentation cost (sites-per-step x
+   primitive cost) to a guard threshold — a fraction of even the
+   fastest measured step, not an exact timing (CI boxes jitter).
+
+Exit code 0 iff both bounds hold. Usage:
+    python -m paddle_tpu.tools.obs_overhead
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+# generous guard thresholds — this is a "did someone put real work on
+# the disabled path" tripwire, not a benchmark
+PRIMITIVE_BUDGET_US = 5.0       # per disabled-path call
+STEP_BUDGET_FRACTION = 0.01     # projected obs cost / measured step time
+
+
+def _bench_primitive(fn, n=100000):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n * 1e6  # us/call
+
+
+def main():
+    import os
+
+    raw = os.environ.get("FLAGS_tpu_metrics") \
+        or os.environ.get("PADDLE_TPU_METRICS") or ""
+    if raw.lower() in ("1", "true", "yes", "on"):
+        print("metrics are armed via the environment — this gate "
+              "measures the default-off path; unset "
+              "PADDLE_TPU_METRICS / FLAGS_tpu_metrics", file=sys.stderr)
+        return 2
+
+    from paddle_tpu import observability as obs
+
+    assert not obs.enabled(), "metrics must default off"
+
+    null_span = _bench_primitive(lambda: obs.tracing.span("x"))
+    enabled_chk = _bench_primitive(obs.enabled)
+    guarded_inc = _bench_primitive(lambda: obs.inc("x"))
+    print("disabled-path cost: span()=%.3fus enabled()=%.3fus "
+          "inc()=%.3fus (budget %.1fus each)"
+          % (null_span, enabled_chk, guarded_inc, PRIMITIVE_BUDGET_US))
+    ok = all(c < PRIMITIVE_BUDGET_US
+             for c in (null_span, enabled_chk, guarded_inc))
+
+    # tiny 2-op program: measure real steps, project the per-step
+    # instrumentation cost from the primitive costs above
+    import numpy as np
+
+    import paddle_tpu as fluid
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.data(name="x", shape=[4, 8], dtype="float32")
+        y = fluid.layers.scale(x, scale=2.0)
+        out = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.ones((4, 8), "float32")}
+    for _ in range(5):  # warm the compile
+        exe.run(main_p, feed=feed, fetch_list=[out])
+    iters = 200
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        exe.run(main_p, feed=feed, fetch_list=[out])
+    step_us = (time.perf_counter() - t0) / iters * 1e6
+
+    # compiled path: ~4 instrumentation touches per step (span + two
+    # guarded metric calls + enabled check); interpreter path: ~2/op.
+    # Use a conservative 4 + 2*ops bound.
+    n_ops = len(main_p.global_block().ops)
+    site_cost = max(null_span, enabled_chk, guarded_inc)
+    projected_us = (4 + 2 * n_ops) * site_cost
+    frac = projected_us / step_us
+    print("tiny step: %.1fus; projected disabled-obs cost: %.2fus "
+          "(%.4f%% of step, budget %.1f%%)"
+          % (step_us, projected_us, frac * 100,
+             STEP_BUDGET_FRACTION * 100))
+    ok = ok and frac < STEP_BUDGET_FRACTION
+
+    # and the registry stayed empty: nothing recorded while disabled
+    snap = obs.dump()
+    recorded = {k: v for k, v in snap["counters"].items()}
+    if recorded:
+        print("metrics recorded while disabled: %r" % recorded,
+              file=sys.stderr)
+        ok = False
+
+    print("obs-overhead gate: %s" % ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
