@@ -44,7 +44,7 @@ fi
 echo "== gate 4: observability =="
 # 4a: the observability layer's own tests (registry, spans, exporters,
 # executor/lazy counters, profiler shim). Skipped when the full suite
-# runs below — gate 5 collects the same file; running it twice buys
+# runs below — gate 6 collects the same file; running it twice buys
 # nothing
 if [[ "${SKIP_TESTS:-0}" == "1" ]]; then
     python -m pytest tests/test_observability.py -q
@@ -55,8 +55,20 @@ fi
 env -u PADDLE_TPU_METRICS -u FLAGS_tpu_metrics \
     python -m paddle_tpu.tools.obs_overhead
 
+echo "== gate 5: serving =="
+# 5a: serving tests (batcher/engine/http contracts). Same dedup as
+# gate 4a — the full suite below collects the same file
+if [[ "${SKIP_TESTS:-0}" == "1" ]]; then
+    python -m pytest tests/test_serving.py -q
+fi
+# 5b: end-to-end smoke — ServingEngine on a tiny MLP, 64 concurrent
+# ragged requests: zero errors, jit compiles == warmed bucket count
+# (NOT the number of distinct observed batch sizes), and an
+# undersized queue must actually reject (backpressure engages)
+python tools/serving_bench.py --smoke
+
 if [[ "${SKIP_TESTS:-0}" != "1" ]]; then
-    echo "== gate 5: test suite =="
+    echo "== gate 6: test suite =="
     python -m pytest tests/ -q
 fi
 echo "ALL CI GATES PASS"

@@ -84,6 +84,7 @@ from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa
 from . import incubate  # noqa: F401
 from . import contrib  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import distribution  # noqa: F401
 from . import metric_api as metric  # noqa: F401
 from . import tensor_api as tensor  # noqa: F401

@@ -489,6 +489,14 @@ def compile_program(program, feed_names: Tuple[str, ...],
     block = program.global_block()
 
     def step(state: Dict, feeds: Dict, step_seed):
+        # trace-time side effect: jax.jit re-enters this Python body
+        # once per novel input-shape signature, so this counts actual
+        # XLA (re)traces — `executor.compiles` above counts only fresh
+        # jit closures and stays flat while a shape-churning caller
+        # (e.g. unbucketed serving batches) compiles over and over.
+        # The serving CI smoke asserts this equals the bucket-ladder
+        # size, not the number of distinct observed batch sizes.
+        _obs.inc("executor.jit_traces")
         env = dict(state)
         env.update(feeds)
         _trace_block(block, env, step_seed)

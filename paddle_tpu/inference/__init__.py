@@ -11,6 +11,7 @@ repeat queries as single compiled dispatches.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -37,6 +38,21 @@ class AnalysisConfig:
 
     def model_dir(self):
         return self._model_dir
+
+    # combined-format plumbing (reference paddle_analysis_config.h
+    # SetProgFile/prog_file): filenames inside model_dir for the
+    # binary-proto `__model__` + combined params stream
+    def set_prog_file(self, prog_file):
+        self._prog_file = prog_file
+
+    def set_params_file(self, params_file):
+        self._params_file = params_file
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
 
     # accelerator knobs (GPU names kept for script compatibility)
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
@@ -77,6 +93,24 @@ class PaddleTensor:
         return self.data
 
 
+# predictor construction pushes its scope onto the PROCESS-GLOBAL
+# scope_guard stack while load_inference_model populates it via
+# global_scope(); two concurrent constructions would cross-load params
+# into each other's scope, so construction is serialized process-wide
+# (run() itself passes its scope explicitly and needs no global lock)
+_construct_lock = threading.Lock()
+
+
+class _ZeroCopyState(threading.local):
+    """Per-thread zero-copy staging: the stage -> run -> fetch protocol
+    has no request handle, so isolation comes from the calling thread —
+    each concurrent caller stages into and reads from its own dicts."""
+
+    def __init__(self):
+        self.staged: Dict[str, np.ndarray] = {}
+        self.results: Dict[str, np.ndarray] = {}
+
+
 class PaddlePredictor:
     """Loads a saved inference model and serves Run() (reference
     analysis_predictor.cc:485,916)."""
@@ -85,14 +119,29 @@ class PaddlePredictor:
         import paddle_tpu as fluid
 
         self._config = config
+        if config._enable_profile:
+            # arm the runtime observability layer for this predictor's
+            # runs (executor.steps/step_ms/compiles land in the shared
+            # registry; the serving layer's /metrics reads it)
+            fluid.observability.enable()
         place = (fluid.TPUPlace(0) if config.use_gpu()
                  else fluid.CPUPlace())
         self._exe = fluid.Executor(place)
         self._scope = fluid.Scope()
-        with fluid.scope_guard(self._scope):
+        # zero-copy staging state + the run lock: ONE predictor is
+        # shared across serving workers. Staging is PER-THREAD (the
+        # zero-copy protocol is stage -> run -> fetch on the caller's
+        # own thread), so concurrent zero-copy callers can't clobber
+        # each other's inputs or read each other's results; the lock
+        # serializes the dispatch itself (one device stream)
+        self._zc_state = _ZeroCopyState()
+        self._run_lock = threading.RLock()
+        with _construct_lock, fluid.scope_guard(self._scope):
             (self._program, self._feed_names,
              self._fetch_vars) = fluid.io.load_inference_model(
-                 config.model_dir(), self._exe)
+                 config.model_dir(), self._exe,
+                 model_filename=config._prog_file,
+                 params_filename=config._params_file)
             if config._ir_optim:
                 self._apply_ir_passes()
 
@@ -145,9 +194,15 @@ class PaddlePredictor:
             for i, t in enumerate(inputs):
                 name = t.name or self._feed_names[i]
                 feed[name] = np.asarray(t.data)
-        with fluid.scope_guard(self._scope):
+        # thread-safe: N serving workers share one predictor; the lock
+        # serializes staging + dispatch (one device stream anyway). The
+        # scope is passed EXPLICITLY, not via scope_guard — the guard
+        # stack is process-global, so two predictors running on
+        # different threads would resolve each other's scope mid-run
+        with self._run_lock:
             outs = self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_vars)
+                                 fetch_list=self._fetch_vars,
+                                 scope=self._scope)
         return [PaddleTensor(np.asarray(o), name=v.name)
                 for o, v in zip(outs, self._fetch_vars)]
 
@@ -167,14 +222,26 @@ class PaddlePredictor:
                            % (name, self.get_output_names()))
         return ZeroCopyTensor(self, name, is_input=False)
 
+    # staging dicts surface as properties so tools/tests can inspect
+    # them; each thread sees only its own staging (threading.local)
+    @property
+    def _staged(self) -> Dict[str, np.ndarray]:
+        return self._zc_state.staged
+
+    @property
+    def _results(self) -> Dict[str, np.ndarray]:
+        return self._zc_state.results
+
     def zero_copy_run(self):
         missing = [n for n in self._feed_names
-                   if n not in getattr(self, "_staged", {})]
+                   if n not in self._staged]
         if missing:
-            raise RuntimeError("inputs not staged via copy_from_cpu: %s"
-                              % missing)
+            raise RuntimeError(
+                "inputs not staged via copy_from_cpu: %s" % missing)
+        # run() takes the dispatch lock; staging/results are this
+        # thread's own, so no further locking is needed
         outs = self.run({n: self._staged[n] for n in self._feed_names})
-        self._results = {t.name: t.data for t in outs}
+        self._zc_state.results = {t.name: t.data for t in outs}
 
     # 2.0-style aliases
     def get_input_handle(self, name):
@@ -205,21 +272,19 @@ class ZeroCopyTensor:
         arr = np.asarray(arr)
         if self._shape is not None:
             arr = arr.reshape(self._shape)
-        if not hasattr(self._p, "_staged"):
-            self._p._staged = {}
         self._p._staged[self.name] = arr
 
     def copy_to_cpu(self):
         if self._is_input:
             raise RuntimeError("%r is an input tensor" % self.name)
-        results = getattr(self._p, "_results", None)
-        if results is None or self.name not in results:
+        results = self._p._results
+        if self.name not in results:
             raise RuntimeError("call zero_copy_run() first")
         return results[self.name]
 
     def shape(self):
         if self._is_input:
-            staged = getattr(self._p, "_staged", {})
+            staged = self._p._staged
             if self.name in staged:
                 return list(staged[self.name].shape)
             return list(self._shape or ())

@@ -18,6 +18,7 @@ Metric families (see README "Runtime observability"):
 ``executor.step_ms{path=...}``         histogram: host step latency
 ``executor.ops{type=...}``             counter: interpreter per-op executions
 ``executor.compiles``                  counter: whole-program (re)compiles
+``executor.jit_traces``                counter: per-shape XLA (re)traces
 ``executor.compile_fallbacks``         counter: compiled -> interpreter drops
 ``lod_lowering.declines{op_type=...}`` counter: ragged lowering declines
 ``lazy.flushes``                       counter: lazy-engine flushes
@@ -31,6 +32,8 @@ Metric families (see README "Runtime observability"):
 ``pipeline.bubble_fraction``           gauge: (S-1)/(M+S-1) GPipe bubble
 ``pipeline.boundary_bytes{boundary=}`` gauge: rotating-buffer payload
 ``memory.*_bytes``                     gauge: live/peak/limit device bytes
+``serving.*``                          serving engine (always-on; see
+                                       ``paddle_tpu/serving/metrics.py``)
 =====================================  ======================================
 
 Export: ``dump()`` -> JSON-able dict, ``dump(fmt="prometheus")`` ->

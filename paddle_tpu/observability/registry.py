@@ -24,7 +24,19 @@ import random
 import threading
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "reservoir_quantile"]
+
+
+def reservoir_quantile(sorted_vals, q: float):
+    """Nearest-rank quantile over an already-sorted sequence, None when
+    empty — the one estimator shared by Histogram and external
+    reporters (tools/serving_bench.py) so they can't drift."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
 
 LabelsT = Tuple[Tuple[str, str], ...]
 
@@ -149,11 +161,8 @@ class Histogram(_Metric):
 
     def percentile(self, q: float) -> Optional[float]:
         with self._lock:
-            if not self._reservoir:
-                return None
             s = sorted(self._reservoir)
-        idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
-        return s[idx]
+        return reservoir_quantile(s, q)
 
     def snapshot(self) -> Dict:
         with self._lock:
@@ -162,11 +171,7 @@ class Histogram(_Metric):
                    "min": self.min, "max": self.max,
                    "mean": (self.sum / self.count) if self.count else None}
         for q, tag in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
-            if s:
-                idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
-                out[tag] = s[idx]
-            else:
-                out[tag] = None
+            out[tag] = reservoir_quantile(s, q)
         return out
 
 

@@ -1,0 +1,49 @@
+"""Dynamic-batching inference serving on top of ``PaddlePredictor``.
+
+The inference stack stops at ``PaddlePredictor.run()`` — one
+synchronous caller, one request per dispatch. That wastes the one thing
+XLA is actually good at (one compiled dispatch over a large batch) and,
+worse, every novel request batch size is a fresh multi-ms compile on
+the serving path. This package closes the gap the reference project
+covers with its C++ serving stack, TPU-native:
+
+- ``batcher``  — ``DynamicBatcher``: queues requests as futures,
+  assembles micro-batches under a max-size/timeout policy, and buckets
+  batch sizes to a fixed ladder (padding + per-request unpadding) so
+  the executor's jit cache converges to ``len(ladder)`` shapes;
+- ``engine``   — ``ServingEngine``: N workers over one shared
+  predictor, bounded queue with typed ``ServerOverloaded`` rejection,
+  per-request deadlines dropped before dispatch, bucket warmup at
+  start, graceful drain at stop;
+- ``http``     — stdlib ``ThreadingHTTPServer``: ``POST /predict``,
+  ``GET /healthz``, ``GET /metrics`` (Prometheus text);
+- ``metrics``  — the always-on ``serving.*`` counter/histogram/gauge
+  families in the PR-1 observability registry.
+
+Minimal use::
+
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+    from paddle_tpu import serving
+
+    predictor = create_paddle_predictor(AnalysisConfig(model_dir))
+    engine = serving.ServingEngine(
+        predictor, serving.ServingConfig(max_batch_size=16)).start()
+    out = engine.predict({"img": x})          # in-process
+    serving.serve(engine, port=8080)          # ...or over HTTP
+"""
+from __future__ import annotations
+
+from . import batcher, engine, http, metrics  # noqa: F401
+from .batcher import (  # noqa: F401
+    BatchPolicy, DynamicBatcher, default_ladder, pick_bucket)
+from .engine import (  # noqa: F401
+    DeadlineExpired, EngineStopped, RequestTooLarge, ServerOverloaded,
+    ServingConfig, ServingEngine, ServingError)
+from .http import ServingHTTPServer, serve, start_http_server  # noqa: F401
+
+__all__ = [
+    "BatchPolicy", "DynamicBatcher", "default_ladder", "pick_bucket",
+    "ServingConfig", "ServingEngine", "ServingError", "ServerOverloaded",
+    "DeadlineExpired", "EngineStopped", "RequestTooLarge",
+    "ServingHTTPServer", "serve", "start_http_server",
+]

@@ -1,0 +1,255 @@
+"""Dynamic micro-batching: request queue + shape-bucketed assembly.
+
+The throughput story of the whole serving subsystem lives here. XLA
+earns its keep on one compiled dispatch over a LARGE batch; per-request
+dispatch (batch of 1) leaves the MXU mostly idle. The batcher queues
+requests as futures, lets a short window (``batch_timeout_ms``) collect
+concurrent arrivals, and assembles them into one feed.
+
+The second half of the story is the BUCKET LADDER. ``jax.jit`` traces
+and compiles per input *shape*: serving raw observed batch sizes means
+every distinct total (3 rows, then 5, then 7, ...) is a fresh multi-ms
+XLA compile on the serving path — a latency cliff per novel size,
+unbounded cache growth. Batches are instead padded up to a fixed ladder
+of sizes (default powers of two up to ``max_batch_size``) so the jit
+cache converges to ``len(ladder)`` entries that warmup can pre-compile
+before traffic arrives. The price is padded rows (counted in
+``serving.padding_waste`` so the ladder can be tuned against real
+traffic); results are sliced back per request so callers never see the
+padding.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import metrics as _m
+
+__all__ = ["BatchPolicy", "DynamicBatcher", "PendingRequest",
+           "default_ladder", "pick_bucket"]
+
+
+def default_ladder(max_batch_size: int) -> Tuple[int, ...]:
+    """Powers of two up to ``max_batch_size``, plus the max itself."""
+    if max_batch_size < 1:
+        raise ValueError("max_batch_size must be >= 1, got %r"
+                         % max_batch_size)
+    ladder = []
+    b = 1
+    while b < max_batch_size:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_batch_size)
+    return tuple(ladder)
+
+
+def pick_bucket(ladder: Sequence[int], rows: int) -> int:
+    """Smallest ladder entry >= rows."""
+    for b in ladder:
+        if b >= rows:
+            return b
+    raise ValueError("rows=%d exceeds ladder max %d" % (rows, ladder[-1]))
+
+
+class BatchPolicy:
+    """How micro-batches form: size cap, collection window, bucket
+    ladder. ``batch_timeout_ms=0`` means dispatch whatever is queued the
+    moment a worker is free (lowest latency, smallest batches)."""
+
+    def __init__(self, max_batch_size: int = 8,
+                 batch_timeout_ms: float = 2.0,
+                 ladder: Optional[Sequence[int]] = None):
+        self.max_batch_size = int(max_batch_size)
+        if self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        if self.batch_timeout_ms < 0:
+            raise ValueError("batch_timeout_ms must be >= 0")
+        if ladder is None:
+            self.ladder = default_ladder(self.max_batch_size)
+        else:
+            self.ladder = tuple(sorted(set(int(b) for b in ladder)))
+            if not self.ladder or self.ladder[0] < 1:
+                raise ValueError("ladder entries must be >= 1: %r"
+                                 % (ladder,))
+            if self.ladder[-1] < self.max_batch_size:
+                raise ValueError(
+                    "ladder max %d < max_batch_size %d (batches up to "
+                    "the cap could not be bucketed)"
+                    % (self.ladder[-1], self.max_batch_size))
+            if self.ladder[-1] > self.max_batch_size:
+                # a bucket above the cap can never be REQUIRED (rows
+                # are capped), but a gap below it would silently pad
+                # every batch past the documented per-dispatch limit
+                raise ValueError(
+                    "ladder entry %d exceeds max_batch_size %d"
+                    % (self.ladder[-1], self.max_batch_size))
+
+    def __repr__(self):
+        return ("BatchPolicy(max_batch_size=%d, batch_timeout_ms=%g, "
+                "ladder=%r)" % (self.max_batch_size, self.batch_timeout_ms,
+                                self.ladder))
+
+
+class PendingRequest:
+    """One queued request: its feed, row count, completion future, and
+    the timestamps/deadline the engine needs for queue_ms + expiry."""
+
+    __slots__ = ("feed", "rows", "future", "deadline", "t_enqueue")
+
+    def __init__(self, feed: Dict[str, np.ndarray], rows: int,
+                 deadline: Optional[float] = None):
+        self.feed = feed
+        self.rows = int(rows)
+        self.future: Future = Future()
+        self.deadline = deadline          # time.monotonic() timestamp
+        self.t_enqueue = time.monotonic()
+
+
+class DynamicBatcher:
+    """Bounded FIFO of PendingRequests + batch formation + padding.
+
+    Thread contract: any number of producer threads (``try_put``), any
+    number of consumer workers (``next_batch``). Requests are never
+    split across batches — a request's rows stay contiguous so its
+    output slice is one view.
+    """
+
+    def __init__(self, policy: BatchPolicy, max_queue: int = 64):
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.policy = policy
+        self.max_queue = int(max_queue)
+        self._queue: "deque[PendingRequest]" = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    # -- producer side -----------------------------------------------------
+
+    def try_put(self, pending: PendingRequest) -> bool:
+        """Enqueue, or return False when the queue is at capacity (the
+        engine turns that into ServerOverloaded — backpressure happens
+        HERE, at admission, not by blocking the client thread)."""
+        if pending.rows > self.policy.max_batch_size:
+            # requests are never split, so this one could never be
+            # scheduled — admitting it would pin the queue head and
+            # spin every consumer forever
+            raise ValueError(
+                "request rows=%d exceed max_batch_size=%d"
+                % (pending.rows, self.policy.max_batch_size))
+        with self._cond:
+            if self._closed or len(self._queue) >= self.max_queue:
+                return False
+            self._queue.append(pending)
+            _m.set_queue_depth(len(self._queue))
+            self._cond.notify()
+            return True
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def empty(self) -> bool:
+        return self.depth() == 0
+
+    def close(self) -> None:
+        """Wake all waiting workers; subsequent try_put is refused."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- consumer side -----------------------------------------------------
+
+    def next_batch(self, poll_timeout: float = 0.1
+                   ) -> Optional[List[PendingRequest]]:
+        """Block up to ``poll_timeout`` for the first request, then hold
+        the batch open ``batch_timeout_ms`` (or until ``max_batch_size``
+        rows) for more arrivals. Returns None on an idle poll."""
+        cap = self.policy.max_batch_size
+        with self._cond:
+            if not self._queue and not self._closed:
+                self._cond.wait(poll_timeout)
+            if not self._queue:
+                return None
+            batch: List[PendingRequest] = []
+            rows = 0
+            window_end = time.monotonic() + self.policy.batch_timeout_ms / 1e3
+            while True:
+                while self._queue and rows + self._queue[0].rows <= cap:
+                    p = self._queue.popleft()
+                    batch.append(p)
+                    rows += p.rows
+                # full, or the next request wouldn't fit this batch
+                if rows >= cap or self._queue:
+                    break
+                remaining = window_end - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(remaining)
+            _m.set_queue_depth(len(self._queue))
+            if self._queue:
+                # leftover work: another worker can start on it now
+                self._cond.notify()
+        return batch
+
+    # -- assembly ----------------------------------------------------------
+
+    def assemble(self, batch: Sequence[PendingRequest]
+                 ) -> Tuple[Dict[str, np.ndarray],
+                            List[Tuple[int, int]], int, int]:
+        """Concatenate the batch's feeds along axis 0 and pad to the
+        bucket size. Returns (feed, [(offset, rows)] per request,
+        bucket, padded_rows)."""
+        rows = sum(p.rows for p in batch)
+        bucket = pick_bucket(self.policy.ladder, rows)
+        pad = bucket - rows
+        feed: Dict[str, np.ndarray] = {}
+        for name in batch[0].feed:
+            parts = [np.asarray(p.feed[name]) for p in batch]
+            arr = parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
+            if pad:
+                # zero rows, not repeated real rows: repeats of a real
+                # sample would change batch-statistic outputs, zeros are
+                # sliced away before anyone sees them
+                arr = np.concatenate(
+                    [arr, np.zeros((pad,) + arr.shape[1:], arr.dtype)], 0)
+            feed[name] = arr
+        slices = []
+        off = 0
+        for p in batch:
+            slices.append((off, p.rows))
+            off += p.rows
+        return feed, slices, bucket, pad
+
+    @staticmethod
+    def split_outputs(outputs: Dict[str, np.ndarray],
+                      slices: Sequence[Tuple[int, int]],
+                      batch_rows: int) -> List[Dict[str, np.ndarray]]:
+        """Per-request output dicts: slice [offset, offset+rows) off
+        every output's leading axis (drops the padding rows too).
+
+        Every output must actually BE batch-major over ``batch_rows``
+        (the padded feed's leading dim): a scalar or per-batch
+        aggregate fetch (e.g. a mean) cannot be attributed to
+        individual requests, and slicing it anyway would silently hand
+        each caller the wrong elements — refuse loudly instead."""
+        arrs = {}
+        for name, arr in outputs.items():
+            arr = np.asarray(arr)
+            if arr.ndim == 0 or arr.shape[0] != batch_rows:
+                raise ValueError(
+                    "output %r has shape %s, not batch-major over the "
+                    "%d dispatched rows — per-batch aggregates cannot "
+                    "be unbatched; fetch per-row outputs when serving"
+                    % (name, arr.shape, batch_rows))
+            arrs[name] = arr
+        out = []
+        for off, rows in slices:
+            out.append({name: arr[off:off + rows]
+                        for name, arr in arrs.items()})
+        return out

@@ -1,0 +1,372 @@
+"""ServingEngine: worker threads + admission control over one predictor.
+
+The runtime half of the serving subsystem (batcher.py is the policy
+half). N worker threads pull micro-batches off the shared
+DynamicBatcher and push them through ONE shared ``PaddlePredictor`` —
+the predictor's run lock serializes the actual device dispatch (one
+accelerator, one dispatch stream), but extra workers still pay off:
+while one dispatch is in flight the next batch is being
+assembled/padded/unpadded on another thread.
+
+Production behaviors the bare predictor lacks, in one place:
+
+- **admission control** — a bounded queue; a full queue rejects at
+  submit time with ``ServerOverloaded`` instead of letting latency grow
+  without bound (the caller can shed load / retry elsewhere NOW);
+- **deadlines** — a request that has already blown its budget is
+  dropped at batch-formation time, *before* a device dispatch is wasted
+  on rows nobody is waiting for;
+- **warmup** — every ladder bucket is compiled at ``start()``, so the
+  first real request never eats a multi-ms XLA compile;
+- **graceful drain** — ``stop()`` refuses new work, finishes what's
+  queued, then joins the workers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import metrics as _m
+from .batcher import BatchPolicy, DynamicBatcher, PendingRequest
+
+__all__ = ["ServingConfig", "ServingEngine", "ServingError",
+           "ServerOverloaded", "DeadlineExpired", "EngineStopped",
+           "RequestTooLarge"]
+
+
+class ServingError(RuntimeError):
+    """Base of all typed serving failures."""
+
+
+class ServerOverloaded(ServingError):
+    """Admission control: the pending queue is full. Retry later or
+    against another replica — queuing more here only grows latency."""
+
+
+class DeadlineExpired(ServingError):
+    """The request's deadline passed while it waited in the queue."""
+
+
+class EngineStopped(ServingError):
+    """submit() after stop() (or before start())."""
+
+
+class RequestTooLarge(ServingError):
+    """A single request's rows exceed max_batch_size; the batcher never
+    splits a request, so it could never be scheduled."""
+
+
+class ServingConfig:
+    """Engine knobs. ``ladder=None`` -> powers of two up to
+    ``max_batch_size``; ``max_queue`` bounds PENDING requests (in-flight
+    batches don't count); ``default_deadline_ms=None`` -> requests
+    without an explicit deadline never expire."""
+
+    def __init__(self, max_batch_size: int = 8,
+                 batch_timeout_ms: float = 2.0,
+                 ladder: Optional[Sequence[int]] = None,
+                 max_queue: int = 64,
+                 num_workers: int = 2,
+                 default_deadline_ms: Optional[float] = None,
+                 warmup: bool = True):
+        self.policy = BatchPolicy(max_batch_size, batch_timeout_ms, ladder)
+        self.max_queue = int(max_queue)
+        self.num_workers = int(num_workers)
+        if self.num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.default_deadline_ms = default_deadline_ms
+        self.warmup = bool(warmup)
+
+
+class ServingEngine:
+    """Dynamic-batching front of a shared predictor.
+
+    ``predictor`` needs the PaddlePredictor surface: ``run(dict) ->
+    [PaddleTensor]`` (thread-safe — inference/__init__ guards it) and
+    ``get_input_names()``. ``sample_feed`` (dict name -> single-row
+    array) is the warmup template; when omitted it is derived from the
+    predictor program's feed-var shapes/dtypes (batch dim and unknown
+    dims become 1/zeros).
+    """
+
+    _POLL_S = 0.05
+
+    def __init__(self, predictor, config: Optional[ServingConfig] = None,
+                 sample_feed: Optional[Dict[str, np.ndarray]] = None):
+        self.config = config or ServingConfig()
+        self._predictor = predictor
+        self._input_names = list(predictor.get_input_names())
+        self._batcher = DynamicBatcher(self.config.policy,
+                                       self.config.max_queue)
+        # per-input template (single row, model dtype): warmup tiles it,
+        # and _validate checks/coerces requests against it so one
+        # malformed request is rejected at submit with ITS OWN error
+        # instead of poisoning every co-batched request at concatenate,
+        # and off-dtype JSON payloads (int64 from integer literals)
+        # cannot mint novel jit signatures past the bucket ladder
+        self._spec = (
+            {n: np.asarray(v) for n, v in sample_feed.items()}
+            if sample_feed else self._derive_sample_feed())
+        self._workers: List[threading.Thread] = []
+        self._state_lock = threading.Lock()
+        self._started = False
+        self._stopping = False
+        self._abort = False
+        self._stopped = False
+        self.warmed_buckets: tuple = ()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServingEngine":
+        with self._state_lock:
+            if self._stopping or self._stopped:
+                # checked BEFORE _started: stop() leaves _started True,
+                # so the old order silently returned a dead engine
+                raise EngineStopped("engine cannot be restarted")
+            if self._started:
+                return self
+            if self.config.warmup:
+                self._warmup()
+            for i in range(self.config.num_workers):
+                t = threading.Thread(target=self._worker_loop,
+                                     name="serving-worker-%d" % i,
+                                     daemon=True)
+                t.start()
+                self._workers.append(t)
+            self._started = True
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Refuse new submits; with ``drain`` finish queued work first,
+        else fail queued requests with EngineStopped; join workers."""
+        with self._state_lock:
+            if self._stopped or not self._started:
+                self._stopped = True
+                self._stopping = True
+                self._batcher.close()
+                return
+            self._stopping = True
+        end = time.monotonic() + timeout  # ONE deadline for the whole
+        # stop: drain wait + every join share it, so stop(timeout=30)
+        # cannot block 30s per phase per worker
+        if not drain:
+            # abort BEFORE touching the queue: workers that win the
+            # race for a queued batch fail it instead of dispatching
+            # work the caller just abandoned
+            self._abort = True
+        else:
+            while not self._batcher.empty() and time.monotonic() < end:
+                time.sleep(self._POLL_S / 5)
+        self._batcher.close()
+        for t in self._workers:
+            t.join(max(0.0, end - time.monotonic()))
+        # whatever is STILL queued (no-drain mode, drain timeout, or a
+        # submit that raced past close) must be failed, never stranded
+        # — a stranded future hangs its caller forever
+        while True:
+            batch = self._batcher.next_batch(poll_timeout=0)
+            if not batch:
+                break
+            for p in batch:
+                self._fail(p, EngineStopped("engine stopped"))
+        with self._state_lock:
+            self._stopped = True
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def running(self) -> bool:
+        return self._started and not self._stopping
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, feed: Dict[str, np.ndarray],
+               deadline_ms: Optional[float] = None) -> Future:
+        """Queue one request (arrays WITH leading batch axis; every
+        input must agree on rows). Returns a Future resolving to a dict
+        name -> ndarray of that request's rows."""
+        if not self._started or self._stopping:
+            raise EngineStopped("engine is not accepting requests")
+        feed, rows = self._validate(feed)
+        if rows > self.config.policy.max_batch_size:
+            raise RequestTooLarge(
+                "request has %d rows > max_batch_size %d"
+                % (rows, self.config.policy.max_batch_size))
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        pending = PendingRequest(feed, rows, deadline)
+        if not self._batcher.try_put(pending):
+            if self._stopping:
+                # refusal came from close(), not capacity: a submit
+                # that raced past the _stopping check above must not
+                # report (and count) shutdown as backpressure
+                raise EngineStopped("engine is not accepting requests")
+            _m.inc(_m.REJECTED)
+            raise ServerOverloaded(
+                "pending queue full (%d requests); retry later"
+                % self.config.max_queue)
+        _m.inc(_m.REQUESTS)
+        return pending.future
+
+    def predict(self, feed: Dict[str, np.ndarray],
+                deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """Blocking submit().result() convenience."""
+        return self.submit(feed, deadline_ms).result(timeout)
+
+    def stats(self) -> Dict:
+        out = _m.snapshot()
+        out["queue_depth"] = self._batcher.depth()
+        out["warmed_buckets"] = list(self.warmed_buckets)
+        out["running"] = self.running
+        return out
+
+    # -- internals ---------------------------------------------------------
+
+    def _validate(self, feed):
+        if not isinstance(feed, dict):
+            raise ValueError("feed must be a dict name -> ndarray")
+        missing = [n for n in self._input_names if n not in feed]
+        extra = [n for n in feed if n not in self._input_names]
+        if missing or extra:
+            raise ValueError(
+                "feed names mismatch: missing=%s unexpected=%s (inputs: %s)"
+                % (missing, extra, self._input_names))
+        arrs = {n: np.asarray(feed[n]) for n in self._input_names}
+        rows = {n: (a.shape[0] if a.ndim else -1) for n, a in arrs.items()}
+        distinct = set(rows.values())
+        if len(distinct) != 1 or -1 in distinct:
+            raise ValueError(
+                "every input needs the same leading batch axis, got %s"
+                % rows)
+        n_rows = distinct.pop()
+        if n_rows < 1:
+            # a zero-row request would spend a whole padded dispatch
+            # returning empty arrays — a client error, not work
+            raise ValueError("request has no rows (leading axis is 0)")
+        if self._spec:
+            for n, a in arrs.items():
+                tmpl = self._spec.get(n)
+                if tmpl is None:
+                    continue
+                if tuple(a.shape[1:]) != tuple(tmpl.shape[1:]):
+                    raise ValueError(
+                        "input %r rows have shape %s, model expects %s"
+                        % (n, tuple(a.shape[1:]), tuple(tmpl.shape[1:])))
+                if a.dtype != tmpl.dtype:
+                    arrs[n] = a.astype(tmpl.dtype)
+        return arrs, n_rows
+
+    def _warmup(self) -> None:
+        """Run one dispatch per ladder bucket so every shape the
+        batcher can emit is compiled before traffic arrives."""
+        sample = self._spec
+        if sample is None:
+            return
+        warmed = []
+        for bucket in self.config.policy.ladder:
+            feed = {n: np.broadcast_to(
+                        v, (bucket,) + tuple(v.shape[1:])).copy()
+                    for n, v in sample.items()}
+            self._predictor.run(feed)
+            warmed.append(bucket)
+        self.warmed_buckets = tuple(warmed)
+
+    def _derive_sample_feed(self) -> Optional[Dict[str, np.ndarray]]:
+        """Zero single-row feeds from the predictor program's feed-var
+        metadata; None when the predictor has no program surface (a
+        stub) or a shape is unknown past the batch dim."""
+        program = getattr(self._predictor, "_program", None)
+        if program is None:
+            return None
+        block = program.global_block()
+        sample = {}
+        for name in self._input_names:
+            v = block._find_var_recursive(name)
+            if v is None or v.shape is None:
+                return None
+            tail = list(v.shape)[1:]
+            if any(s is None or int(s) < 0 for s in tail):
+                return None
+            try:
+                dtype = np.dtype(v.dtype)
+            except TypeError:
+                dtype = np.float32
+            sample[name] = np.zeros([1] + [int(s) for s in tail], dtype)
+        return sample
+
+    def _worker_loop(self) -> None:
+        while True:
+            batch = self._batcher.next_batch(self._POLL_S)
+            if not batch:
+                if self._stopping and self._batcher.empty():
+                    return
+                continue
+            if self._abort:
+                for p in batch:
+                    self._fail(p, EngineStopped("engine stopped"))
+                continue
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: List[PendingRequest]) -> None:
+        now = time.monotonic()
+        live = []
+        for p in batch:
+            if p.deadline is not None and now > p.deadline:
+                _m.inc(_m.DEADLINE_EXPIRED)
+                self._fail(p, DeadlineExpired(
+                    "deadline passed %.1f ms ago while queued"
+                    % ((now - p.deadline) * 1e3)))
+            else:
+                live.append(p)
+        if not live:
+            return
+        try:
+            feed, slices, bucket, pad = self._batcher.assemble(live)
+        except Exception as e:  # noqa: BLE001 — e.g. trailing-shape
+            # mismatch between requests surfacing at concatenate
+            _m.inc(_m.ERRORS, len(live))
+            for p in live:
+                self._fail(p, e)
+            return
+        _m.inc(_m.BATCHES)
+        _m.observe(_m.BATCH_SIZE, bucket - pad)
+        if pad:
+            _m.inc(_m.PADDING_WASTE, pad)
+        for p in live:
+            _m.observe(_m.QUEUE_MS, (now - p.t_enqueue) * 1e3)
+        try:
+            outs = self._predictor.run(feed)
+            outputs = {t.name: np.asarray(t.data) for t in outs}
+            results = self._batcher.split_outputs(outputs, slices, bucket)
+        except Exception as e:  # noqa: BLE001 — batch fails as a unit;
+            # a stranded future would hang its caller forever, so ANY
+            # dispatch-side error (model or unpadding) must resolve them
+            _m.inc(_m.ERRORS, len(live))
+            for p in live:
+                self._fail(p, e)
+            return
+        done = time.monotonic()
+        for p, result in zip(live, results):
+            _m.observe(_m.TOTAL_MS, (done - p.t_enqueue) * 1e3)
+            try:
+                p.future.set_result(result)
+            except Exception:
+                pass  # caller cancelled; result has nowhere to go
+
+    @staticmethod
+    def _fail(p: PendingRequest, exc: Exception) -> None:
+        try:
+            p.future.set_exception(exc)
+        except Exception:
+            pass

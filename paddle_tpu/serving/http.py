@@ -1,0 +1,169 @@
+"""Stdlib HTTP front end for a ServingEngine.
+
+``ThreadingHTTPServer`` — one thread per connection — is exactly the
+right shape here: client threads block on their request future while
+the engine batches across them, so concurrency at the HTTP layer IS the
+batch-formation opportunity. No framework dependency.
+
+Endpoints:
+
+- ``POST /predict`` — body ``{"inputs": {name: nested-list},
+  "deadline_ms": optional}``; arrays carry the leading batch axis.
+  Replies ``{"outputs": {name: nested-list}, "latency_ms": float}``.
+  Typed failures map onto status codes: 503 (overloaded / stopped,
+  with ``Retry-After``), 504 (deadline expired), 400 (malformed).
+- ``GET /healthz`` — 200 while the engine accepts work, 503 otherwise
+  (the load-balancer drain signal).
+- ``GET /metrics`` — Prometheus text exposition straight from the
+  observability registry (serving.* plus every runtime family).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .. import observability as _obs
+from .engine import (DeadlineExpired, EngineStopped, RequestTooLarge,
+                     ServerOverloaded, ServingEngine)
+
+__all__ = ["ServingHTTPServer", "start_http_server", "serve"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
+        pass  # per-request stderr lines are noise; /metrics is the log
+
+    def _reply(self, code: int, body: bytes, ctype: str,
+               extra_headers: Tuple = ()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, code: int, payload: dict,
+                    extra_headers: Tuple = ()) -> None:
+        self._reply(code, json.dumps(payload).encode(),
+                    "application/json", extra_headers)
+
+    # -- routes ------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 — stdlib naming
+        engine = self.server.engine
+        if self.path == "/healthz":
+            if engine.running:
+                self._reply_json(200, {"status": "ok"})
+            else:
+                self._reply_json(503, {"status": "stopping"})
+        elif self.path == "/metrics":
+            self._reply(200, _obs.dump_prometheus().encode(),
+                        "text/plain; version=0.0.4")
+        elif self.path == "/stats":
+            self._reply_json(200, _json_safe(engine.stats()))
+        else:
+            self._reply_json(404, {"error": "no route %s" % self.path})
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/predict":
+            self._reply_json(404, {"error": "no route %s" % self.path})
+            return
+        engine: ServingEngine = self.server.engine
+        t0 = time.monotonic()
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(length) or b"{}")
+            inputs = req.get("inputs")
+            if not isinstance(inputs, dict) or not inputs:
+                raise ValueError('body needs {"inputs": {name: array}}')
+            deadline_ms = req.get("deadline_ms")
+            if deadline_ms is not None and not isinstance(
+                    deadline_ms, (int, float)):
+                raise ValueError("deadline_ms must be a number, got %r"
+                                 % (deadline_ms,))
+            feed = {str(n): np.asarray(v) for n, v in inputs.items()}
+            outputs = engine.predict(feed, deadline_ms=deadline_ms)
+        except ServerOverloaded as e:
+            self._reply_json(503, {"error": str(e)},
+                             (("Retry-After", "1"),))
+        except EngineStopped as e:
+            self._reply_json(503, {"error": str(e)})
+        except DeadlineExpired as e:
+            self._reply_json(504, {"error": str(e)})
+        except (ValueError, RequestTooLarge, json.JSONDecodeError) as e:
+            self._reply_json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — the model failed
+            self._reply_json(500, {"error": "%s: %s"
+                                   % (type(e).__name__, e)})
+        else:
+            self._reply_json(200, {
+                "outputs": {n: np.asarray(v).tolist()
+                            for n, v in outputs.items()},
+                "latency_ms": (time.monotonic() - t0) * 1e3,
+            })
+
+
+def _json_safe(obj):
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, (np.integer, np.floating)):
+        return obj.item()
+    return obj
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    """HTTP front of one ServingEngine. ``port=0`` binds an ephemeral
+    port (tests); ``server.server_address`` reports the real one."""
+
+    daemon_threads = True
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 8080):
+        self.engine = engine
+        super().__init__((host, port), _Handler)
+
+
+def start_http_server(engine: ServingEngine, host: str = "127.0.0.1",
+                      port: int = 0) -> Tuple[ServingHTTPServer,
+                                              threading.Thread]:
+    """Non-blocking: serve on a background thread (tests, embedding)."""
+    server = ServingHTTPServer(engine, host, port)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="serving-http", daemon=True)
+    thread.start()
+    return server, thread
+
+
+def serve(engine: ServingEngine, host: str = "0.0.0.0",
+          port: int = 8080) -> None:
+    """Blocking entry point: start the engine, serve until interrupted,
+    then drain. The accept loop runs on a background thread so that
+    DURING the drain the server still answers — /healthz returns 503
+    (the load-balancer back-off signal) while queued work finishes —
+    and only then is the listening socket closed."""
+    engine.start()
+    server = ServingHTTPServer(engine, host, port)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="serving-http", daemon=True)
+    thread.start()
+    try:
+        while thread.is_alive():
+            thread.join(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        engine.stop()       # drain: probes see 503, submits refused
+        server.shutdown()
+        server.server_close()

@@ -1,0 +1,75 @@
+"""Serving metric names + always-on recording helpers.
+
+Unlike the training hot paths (which guard every instrumentation site
+behind ``observability.enabled()`` because a step is microseconds of
+host work), serving requests are milliseconds-scale network round trips
+— a handful of dict lookups per request is noise. Serving therefore
+records UNCONDITIONALLY into the process registry so ``GET /metrics``,
+``ServingEngine.stats()`` and the CI smoke always see live numbers
+without the operator remembering to export ``PADDLE_TPU_METRICS``.
+
+Families (README "Serving"):
+
+=================================  =======================================
+``serving.requests``               counter: admitted requests
+``serving.rejected``               counter: admission-control rejections
+``serving.deadline_expired``       counter: dropped before dispatch
+``serving.errors``                 counter: dispatch failures
+``serving.batches``                counter: dispatched micro-batches
+``serving.padding_waste``          counter: padded rows (bucket - real)
+``serving.batch_size``             histogram: real rows per micro-batch
+``serving.queue_ms``               histogram: submit -> dispatch wait
+``serving.total_ms``               histogram: submit -> result latency
+``serving.queue_depth``            gauge: requests waiting right now
+=================================  =======================================
+
+Handles are re-fetched from the registry on every write (get-or-create
+is a dict lookup) instead of cached at import: ``observability.reset()``
+swaps the metric objects out from under any cached handle, and serving
+must keep reporting into the registry a dump actually reads.
+"""
+from __future__ import annotations
+
+from .. import observability as _obs
+
+__all__ = [
+    "REQUESTS", "REJECTED", "DEADLINE_EXPIRED", "ERRORS", "BATCHES",
+    "PADDING_WASTE", "BATCH_SIZE", "QUEUE_MS", "TOTAL_MS", "QUEUE_DEPTH",
+    "inc", "observe", "set_queue_depth", "snapshot",
+]
+
+REQUESTS = "serving.requests"
+REJECTED = "serving.rejected"
+DEADLINE_EXPIRED = "serving.deadline_expired"
+ERRORS = "serving.errors"
+BATCHES = "serving.batches"
+PADDING_WASTE = "serving.padding_waste"
+BATCH_SIZE = "serving.batch_size"
+QUEUE_MS = "serving.queue_ms"
+TOTAL_MS = "serving.total_ms"
+QUEUE_DEPTH = "serving.queue_depth"
+
+
+def inc(name: str, n: int = 1) -> None:
+    _obs.counter(name).inc(n)
+
+
+def observe(name: str, v) -> None:
+    _obs.histogram(name).observe(v)
+
+
+def set_queue_depth(n: int) -> None:
+    _obs.gauge(QUEUE_DEPTH).set(n)
+
+
+def snapshot() -> dict:
+    """Current serving counters/latencies as a plain dict (the
+    ``ServingEngine.stats()`` payload)."""
+    out = {}
+    for name in (REQUESTS, REJECTED, DEADLINE_EXPIRED, ERRORS, BATCHES,
+                 PADDING_WASTE):
+        out[name] = _obs.counter_value(name)
+    out[QUEUE_DEPTH] = _obs.gauge_value(QUEUE_DEPTH)
+    for name in (BATCH_SIZE, QUEUE_MS, TOTAL_MS):
+        out[name] = _obs.histogram(name).snapshot()
+    return out

@@ -1,0 +1,132 @@
+"""Inference-model save/load satellites: the combined-proto format
+(`model_filename` + `params_filename`) end-to-end THROUGH the
+predictor config surface, the "persistable var not initialized" error
+path in io.py::save_inference_model, and AnalysisConfig.enable_profile
+arming the observability registry.
+
+(test_proto_interop.py covers the raw load_inference_model proto
+round-trip; here the same format flows through AnalysisConfig
+prog_file/params_file the way a deployment would configure it.)
+"""
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+
+def _build_trained_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, 5], dtype="float32")
+        pred = fluid.layers.fc(fluid.layers.fc(x, 7, act="relu"), 2)
+    return main, startup, pred
+
+
+def test_combined_proto_roundtrip_via_predictor_config():
+    main, startup, pred = _build_trained_model()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(3)
+    x = rng.rand(4, 5).astype("float32")
+    with tempfile.TemporaryDirectory() as d:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            (ref,) = exe.run(main, feed={"x": x}, fetch_list=[pred])
+            fluid.io.save_inference_model(
+                d, ["x"], [pred], exe, main_program=main,
+                model_filename="__model__", params_filename="__params__")
+        config = AnalysisConfig(d)
+        config.set_prog_file("__model__")
+        config.set_params_file("__params__")
+        config.disable_gpu()
+        assert config.prog_file() == "__model__"
+        assert config.params_file() == "__params__"
+        predictor = create_paddle_predictor(config)
+        assert predictor.get_input_names() == ["x"]
+        (out,) = predictor.run({"x": x})
+        np.testing.assert_allclose(out.as_ndarray(), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_save_combined_uninitialized_persistable_raises():
+    """The combined stream is order-sensitive: silently skipping an
+    uninitialized persistable would shift every later stream. The save
+    must refuse loudly instead."""
+    main, startup, pred = _build_trained_model()
+    scope = fluid.Scope()
+    with tempfile.TemporaryDirectory() as d:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            # startup NOT run: parameters exist in the program but have
+            # no value in the scope
+            with pytest.raises(RuntimeError,
+                               match="not initialized in the scope"):
+                fluid.io.save_inference_model(
+                    d, ["x"], [pred], exe, main_program=main,
+                    model_filename="__model__",
+                    params_filename="__params__")
+
+
+def test_save_separate_files_skips_uninitialized():
+    """Per-var files have no ordering contract — the historical
+    skip-if-uninitialized behavior must survive the combined fix."""
+    main, startup, pred = _build_trained_model()
+    scope = fluid.Scope()
+    with tempfile.TemporaryDirectory() as d:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            fluid.io.save_inference_model(
+                d, ["x"], [pred], exe, main_program=main,
+                model_filename="__model__")  # no params_filename
+
+
+def test_enable_profile_arms_observability_registry():
+    was_enabled = obs.enabled()
+    main, startup, pred = _build_trained_model()
+    scope = fluid.Scope()
+    x = np.ones((2, 5), "float32")
+    try:
+        obs.disable()
+        obs.reset()
+        with tempfile.TemporaryDirectory() as d:
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                              main_program=main)
+            config = AnalysisConfig(d)
+            config.disable_gpu()
+            config.enable_profile()
+            predictor = create_paddle_predictor(config)
+            assert obs.enabled()  # armed by the predictor
+            predictor.run({"x": x})
+            assert obs.counter_value("executor.steps",
+                                     path="compiled") >= 1
+            assert obs.counter_value("executor.jit_traces") >= 1
+    finally:
+        obs.reset()
+        (obs.enable if was_enabled else obs.disable)()
+
+
+def test_enable_profile_off_stays_off():
+    was_enabled = obs.enabled()
+    main, startup, pred = _build_trained_model()
+    scope = fluid.Scope()
+    try:
+        obs.disable()
+        with tempfile.TemporaryDirectory() as d:
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                              main_program=main)
+            config = AnalysisConfig(d)
+            config.disable_gpu()
+            create_paddle_predictor(config)
+            assert not obs.enabled()
+    finally:
+        (obs.enable if was_enabled else obs.disable)()

@@ -1,0 +1,672 @@
+"""Serving subsystem (paddle_tpu/serving): bucket ladder, dynamic
+batcher assembly/padding, engine admission control + deadlines + warmup
++ drain, HTTP front end, and the thread-safety contract the engine
+demands of a shared PaddlePredictor.
+
+The compile-boundedness property (jit cache == bucket ladder, not
+observed batch sizes) is asserted here on a real model AND in CI gate 5
+via tools/serving_bench.py --smoke.
+"""
+import json
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+from paddle_tpu.serving.batcher import (BatchPolicy, DynamicBatcher,
+                                        PendingRequest, default_ladder,
+                                        pick_bucket)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Serving metrics are always-on; isolate counters per test and
+    leave the layer disabled (other files assume default-off)."""
+    obs.reset()
+    obs.enable()
+    yield
+    obs.reset()
+    obs.disable()
+
+
+# -- bucket ladder ---------------------------------------------------------
+
+def test_default_ladder_powers_of_two_plus_max():
+    assert default_ladder(1) == (1,)
+    assert default_ladder(8) == (1, 2, 4, 8)
+    assert default_ladder(12) == (1, 2, 4, 8, 12)
+
+
+def test_pick_bucket_smallest_fit():
+    ladder = (1, 2, 4, 8)
+    assert [pick_bucket(ladder, r) for r in (1, 2, 3, 5, 8)] == \
+        [1, 2, 4, 8, 8]
+    with pytest.raises(ValueError):
+        pick_bucket(ladder, 9)
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        BatchPolicy(max_batch_size=0)
+    with pytest.raises(ValueError):
+        BatchPolicy(max_batch_size=8, ladder=(1, 2, 4))  # max unreachable
+    with pytest.raises(ValueError, match="exceeds max_batch_size"):
+        # a gap below an oversized entry would pad every batch past
+        # the documented per-dispatch cap
+        BatchPolicy(max_batch_size=8, ladder=(1, 16))
+    p = BatchPolicy(max_batch_size=6, ladder=(4, 1, 2, 6, 2))
+    assert p.ladder == (1, 2, 4, 6)
+
+
+# -- batcher assembly ------------------------------------------------------
+
+def _pending(rows, dim=3, fill=1.0):
+    return PendingRequest({"x": np.full((rows, dim), fill, "float32")},
+                          rows)
+
+
+def test_assemble_pads_to_bucket_and_splits_back():
+    b = DynamicBatcher(BatchPolicy(max_batch_size=8))
+    batch = [_pending(1, fill=1.0), _pending(2, fill=2.0)]
+    feed, slices, bucket, pad = b.assemble(batch)
+    assert bucket == 4 and pad == 1
+    assert feed["x"].shape == (4, 3)
+    # padding rows are zeros, real rows in request order
+    np.testing.assert_array_equal(feed["x"][0], np.ones(3))
+    np.testing.assert_array_equal(feed["x"][3], np.zeros(3))
+    outs = DynamicBatcher.split_outputs({"y": feed["x"] * 10}, slices,
+                                        bucket)
+    assert [o["y"].shape[0] for o in outs] == [1, 2]
+    np.testing.assert_array_equal(outs[1]["y"],
+                                  np.full((2, 3), 20, "float32"))
+
+
+def test_split_outputs_refuses_non_batch_major():
+    """A scalar / per-batch aggregate fetch cannot be attributed to
+    requests; slicing it silently would hand back wrong data."""
+    slices = [(0, 1), (1, 2)]
+    with pytest.raises(ValueError, match="not batch-major"):
+        DynamicBatcher.split_outputs({"m": np.float32(0.5)}, slices, 4)
+    with pytest.raises(ValueError, match="not batch-major"):
+        DynamicBatcher.split_outputs({"agg": np.zeros(2)}, slices, 4)
+
+
+def test_assemble_exact_bucket_no_padding():
+    b = DynamicBatcher(BatchPolicy(max_batch_size=8))
+    feed, slices, bucket, pad = b.assemble([_pending(2), _pending(2)])
+    assert bucket == 4 and pad == 0
+
+
+def test_try_put_refuses_unschedulable_request():
+    """An oversized request admitted to the queue could never be
+    popped — it would pin the head and spin consumers forever."""
+    b = DynamicBatcher(BatchPolicy(max_batch_size=4))
+    with pytest.raises(ValueError, match="exceed max_batch_size"):
+        b.try_put(_pending(5))
+
+
+def test_try_put_bounds_queue():
+    b = DynamicBatcher(BatchPolicy(max_batch_size=4), max_queue=2)
+    assert b.try_put(_pending(1))
+    assert b.try_put(_pending(1))
+    assert not b.try_put(_pending(1))
+    assert b.depth() == 2
+    b.close()
+    assert not b.try_put(_pending(1))
+
+
+def test_next_batch_respects_row_cap():
+    b = DynamicBatcher(BatchPolicy(max_batch_size=4, batch_timeout_ms=0))
+    for rows in (2, 2, 3):
+        b.try_put(_pending(rows))
+    first = b.next_batch(0.1)
+    assert sum(p.rows for p in first) == 4  # 2+2; the 3-row stays queued
+    second = b.next_batch(0.1)
+    assert [p.rows for p in second] == [3]
+
+
+def test_next_batch_idle_poll_returns_none():
+    b = DynamicBatcher(BatchPolicy(max_batch_size=4))
+    assert b.next_batch(0.01) is None
+
+
+# -- engine over a stub predictor -----------------------------------------
+
+class _StubTensor:
+    def __init__(self, name, data):
+        self.name, self.data = name, data
+
+
+class _StubPredictor:
+    """PaddlePredictor surface; y = 2x. `delay` throttles dispatch so
+    backpressure/deadline tests are deterministic."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+        self.calls = []
+
+    def get_input_names(self):
+        return ["x"]
+
+    def get_output_names(self):
+        return ["y"]
+
+    def run(self, feed):
+        if self.delay:
+            time.sleep(self.delay)
+        x = np.asarray(feed["x"])
+        self.calls.append(x.shape[0])
+        return [_StubTensor("y", x * 2.0)]
+
+
+def _stub_engine(delay=0.0, **cfg):
+    cfg.setdefault("max_batch_size", 4)
+    cfg.setdefault("num_workers", 1)
+    cfg.setdefault("warmup", False)
+    return serving.ServingEngine(_StubPredictor(delay),
+                                 serving.ServingConfig(**cfg))
+
+
+def test_engine_predict_roundtrip_and_unpadding():
+    with _stub_engine() as eng:
+        x = np.arange(6, dtype="float32").reshape(2, 3)
+        out = eng.predict({"x": x}, timeout=10)
+        np.testing.assert_array_equal(out["y"], x * 2)
+
+
+def test_engine_feed_validation():
+    with _stub_engine() as eng:
+        with pytest.raises(ValueError, match="mismatch"):
+            eng.submit({"wrong": np.ones((1, 3), "f4")})
+        with pytest.raises(ValueError, match="batch axis"):
+            eng.submit({"x": np.float32(3.0)})
+        with pytest.raises(serving.RequestTooLarge):
+            eng.submit({"x": np.ones((5, 3), "f4")})
+        with pytest.raises(ValueError, match="no rows"):
+            eng.submit({"x": np.ones((0, 3), "f4")})
+
+
+def test_engine_rejects_wrong_row_shape_at_submit():
+    """One malformed request must get ITS OWN 400-class error at
+    submit — not poison co-batched valid requests at concatenate."""
+    eng = serving.ServingEngine(
+        _StubPredictor(), serving.ServingConfig(max_batch_size=4,
+                                                num_workers=1),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    with pytest.raises(ValueError, match="rows have shape"):
+        eng.submit({"x": np.ones((1, 5), "float32")})
+    out = eng.predict({"x": np.ones((1, 3), "float32")}, timeout=10)
+    assert out["y"].shape == (1, 3)
+    eng.stop()
+
+
+def test_engine_coerces_feed_dtype_to_model_dtype():
+    """Integer JSON payloads arrive int64; without coercion every
+    off-dtype request is a novel jit signature past the bucket
+    ladder."""
+    stub = _StubPredictor()
+    seen = []
+    real_run = stub.run
+    stub.run = lambda feed: (seen.append(np.asarray(feed["x"]).dtype),
+                             real_run(feed))[1]
+    eng = serving.ServingEngine(
+        stub, serving.ServingConfig(max_batch_size=4, num_workers=1,
+                                    warmup=False),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    out = eng.predict({"x": np.ones((2, 3), "int64")}, timeout=10)
+    eng.stop()
+    assert all(dt == np.float32 for dt in seen), seen
+    assert out["y"].dtype == np.float32
+
+
+def test_stop_never_strands_futures():
+    """Every queued future resolves at stop — drain timeout and
+    no-drain abort both fail leftovers with EngineStopped instead of
+    hanging their callers forever."""
+    # no-drain: queued work is failed, not dispatched
+    eng = _stub_engine(delay=0.05, max_queue=32).start()
+    futures = [eng.submit({"x": np.ones((1, 3), "f4")})
+               for _ in range(10)]
+    eng.stop(drain=False, timeout=5)
+    for f in futures:
+        assert f.done()
+        try:
+            f.result(0)
+        except serving.EngineStopped:
+            pass
+    # drain with a timeout too short to finish: every future still
+    # resolves in bounded time — dispatched by an in-flight worker or
+    # failed by stop()'s leftover flush; none hang forever
+    eng2 = _stub_engine(delay=0.1, max_queue=32).start()
+    futures2 = [eng2.submit({"x": np.ones((1, 3), "f4")})
+                for _ in range(6)]
+    eng2.stop(drain=True, timeout=0.15)
+    for f in futures2:
+        try:
+            f.result(5)
+        except serving.EngineStopped:
+            pass
+
+
+def test_engine_warmup_uses_sample_feed_and_covers_ladder():
+    stub = _StubPredictor()
+    eng = serving.ServingEngine(
+        stub, serving.ServingConfig(max_batch_size=4, num_workers=1),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    assert eng.warmed_buckets == (1, 2, 4)
+    assert stub.calls == [1, 2, 4]
+    eng.stop()
+
+
+def test_engine_backpressure_rejects_and_counts():
+    eng = _stub_engine(delay=0.03, max_queue=2).start()
+    rejected, futures = 0, []
+    for _ in range(12):
+        try:
+            futures.append(eng.submit({"x": np.ones((1, 3), "f4")}))
+        except serving.ServerOverloaded:
+            rejected += 1
+    for f in futures:
+        assert f.result(10)["y"].shape == (1, 3)
+    eng.stop()
+    assert rejected > 0
+    assert obs.counter_value("serving.rejected") == rejected
+    assert obs.counter_value("serving.requests") == len(futures)
+
+
+def test_engine_deadline_dropped_before_dispatch():
+    stub = _StubPredictor(delay=0.08)
+    eng = serving.ServingEngine(
+        stub, serving.ServingConfig(max_batch_size=1, num_workers=1,
+                                    warmup=False)).start()
+    f1 = eng.submit({"x": np.ones((1, 3), "f4")})      # occupies worker
+    f2 = eng.submit({"x": np.ones((1, 3), "f4")}, deadline_ms=1)
+    with pytest.raises(serving.DeadlineExpired):
+        f2.result(10)
+    f1.result(10)
+    eng.stop()
+    assert obs.counter_value("serving.deadline_expired") == 1
+    # the expired request never reached the predictor
+    assert len(stub.calls) == 1
+
+
+def test_engine_drain_completes_queued_work():
+    eng = _stub_engine(delay=0.01, max_queue=32).start()
+    futures = [eng.submit({"x": np.ones((1, 3), "f4")}) for _ in range(8)]
+    eng.stop(drain=True)
+    assert all(f.result(0)["y"].shape == (1, 3) for f in futures)
+    with pytest.raises(serving.EngineStopped):
+        eng.submit({"x": np.ones((1, 3), "f4")})
+
+
+def test_engine_submit_before_start_refused():
+    eng = _stub_engine()
+    with pytest.raises(serving.EngineStopped):
+        eng.submit({"x": np.ones((1, 3), "f4")})
+
+
+def test_submit_racing_stop_maps_to_engine_stopped_not_overload():
+    """A submit that passes the _stopping check just before stop()
+    closes the batcher must surface EngineStopped — not count a
+    shutdown as an admission-control rejection."""
+    eng = _stub_engine().start()
+    orig = eng._batcher.try_put
+
+    def racing_put(p):
+        eng._stopping = True       # stop() lands mid-submit
+        eng._batcher.close()
+        return orig(p)
+
+    eng._batcher.try_put = racing_put
+    before = obs.counter_value("serving.rejected")
+    with pytest.raises(serving.EngineStopped):
+        eng.submit({"x": np.ones((1, 3), "f4")})
+    assert obs.counter_value("serving.rejected") == before
+
+
+def test_engine_restart_raises_not_a_dead_engine():
+    eng = _stub_engine().start()
+    eng.stop()
+    with pytest.raises(serving.EngineStopped, match="restarted"):
+        eng.start()
+
+
+def test_engine_aggregate_output_fails_request_loudly():
+    class AggStub(_StubPredictor):
+        def run(self, feed):
+            x = np.asarray(feed["x"])
+            return [_StubTensor("mean", x.mean())]  # scalar, no batch axis
+
+    eng = serving.ServingEngine(
+        AggStub(), serving.ServingConfig(max_batch_size=4, num_workers=1,
+                                         warmup=False)).start()
+    f = eng.submit({"x": np.ones((1, 3), "f4")})
+    with pytest.raises(ValueError, match="not batch-major"):
+        f.result(10)
+    eng.stop()
+
+
+def test_engine_model_error_fails_batch_not_process():
+    class Boom(_StubPredictor):
+        def run(self, feed):
+            raise RuntimeError("kaboom")
+
+    eng = serving.ServingEngine(
+        Boom(), serving.ServingConfig(max_batch_size=4, num_workers=1,
+                                      warmup=False)).start()
+    f = eng.submit({"x": np.ones((1, 3), "f4")})
+    with pytest.raises(RuntimeError, match="kaboom"):
+        f.result(10)
+    assert obs.counter_value("serving.errors") == 1
+    eng.stop()
+
+
+def test_dispatch_assembly_failure_resolves_futures():
+    """A shape-mismatched pair landing in one batch must fail THOSE
+    futures (never strand them / kill the worker thread)."""
+    eng = _stub_engine()  # not started: _dispatch runs synchronously
+    p1 = PendingRequest({"x": np.ones((1, 3), "float32")}, 1)
+    p2 = PendingRequest({"x": np.ones((1, 5), "float32")}, 1)
+    eng._dispatch([p1, p2])
+    for p in (p1, p2):
+        with pytest.raises(ValueError):
+            p.future.result(0)
+    assert obs.counter_value("serving.errors") == 2
+
+
+def test_batching_actually_batches_concurrent_requests():
+    """8 concurrent 1-row requests through a throttled predictor must
+    dispatch in fewer than 8 batches (the collection window merges
+    them) and each caller still gets its own rows back."""
+    stub = _StubPredictor(delay=0.01)
+    eng = serving.ServingEngine(
+        stub, serving.ServingConfig(max_batch_size=8, batch_timeout_ms=20,
+                                    num_workers=1, warmup=False)).start()
+    results = {}
+
+    def client(i):
+        x = np.full((1, 3), float(i), "float32")
+        results[i] = eng.predict({"x": x}, timeout=10)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    eng.stop()
+    for i in range(8):
+        np.testing.assert_array_equal(results[i]["y"],
+                                      np.full((1, 3), 2.0 * i))
+    assert len(stub.calls) < 8
+    assert obs.counter_value("serving.batches") == len(stub.calls)
+
+
+# -- real predictor: compile boundedness + shared-predictor safety ---------
+
+def _build_predictor(tmpdir, dim=6, classes=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, dim], dtype="float32")
+        pred = fluid.layers.fc(fluid.layers.fc(x, 8, act="relu"),
+                               classes, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(tmpdir, ["x"], [pred], exe,
+                                      main_program=main)
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    config = AnalysisConfig(tmpdir)
+    config.disable_gpu()
+    return create_paddle_predictor(config), pred.name
+
+
+def test_bucketed_serving_bounds_jit_compiles():
+    """The tentpole property: ragged concurrent traffic compiles one
+    XLA program per LADDER BUCKET, not per observed batch size."""
+    with tempfile.TemporaryDirectory() as d:
+        predictor, out_name = _build_predictor(d)
+        traces0 = obs.counter_value("executor.jit_traces")
+        eng = serving.ServingEngine(
+            predictor, serving.ServingConfig(max_batch_size=4,
+                                             batch_timeout_ms=1.0,
+                                             num_workers=2)).start()
+        warm = obs.counter_value("executor.jit_traces") - traces0
+        assert warm == len(eng.config.policy.ladder) == 3
+
+        errors = []
+
+        def client(i):
+            try:
+                x = np.full((1 + i % 3, 6), 0.1 * i, "float32")
+                out = eng.predict({"x": x}, timeout=60)
+                assert out[out_name].shape == (1 + i % 3, 3)
+                # softmax rows must be real rows, not padding
+                np.testing.assert_allclose(out[out_name].sum(axis=1),
+                                           1.0, rtol=1e-4)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        eng.stop()
+        assert not errors, errors[:3]
+        assert obs.counter_value("executor.jit_traces") - traces0 == warm
+
+
+def test_shared_predictor_concurrent_run_is_safe():
+    """Satellite: one predictor, 8 threads calling run() directly —
+    the run lock must keep results request-correct."""
+    with tempfile.TemporaryDirectory() as d:
+        predictor, out_name = _build_predictor(d)
+        refs = {}
+        for i in range(8):
+            x = np.full((2, 6), float(i), "float32")
+            refs[i] = predictor.run({"x": x})[0].data
+        errors = []
+
+        def worker(i):
+            x = np.full((2, 6), float(i), "float32")
+            out = predictor.run({"x": x})[0].data
+            if not np.allclose(out, refs[i], rtol=1e-5):
+                errors.append(i)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, "cross-request clobbering on threads %s" % errors
+
+
+def test_concurrent_predictor_construction_isolated():
+    """Regression: construction pushes onto the process-global
+    scope_guard stack; without the construction lock, two threads
+    building predictors cross-load params into each other's scope."""
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    with tempfile.TemporaryDirectory() as d1, \
+            tempfile.TemporaryDirectory() as d2:
+        p_ref1, _ = _build_predictor(d1, dim=6, classes=3)
+        p_ref2, _ = _build_predictor(d2, dim=4, classes=2)
+        x1 = np.full((2, 6), 0.3, "float32")
+        x2 = np.full((2, 4), -0.3, "float32")
+        ref1 = p_ref1.run({"x": x1})[0].data
+        ref2 = p_ref2.run({"x": x2})[0].data
+        errors = []
+
+        def construct_and_check(d, x, ref):
+            try:
+                cfg = AnalysisConfig(d)
+                cfg.disable_gpu()
+                p = create_paddle_predictor(cfg)
+                out = p.run({"x": x})[0].data
+                if not np.allclose(out, ref, rtol=1e-5):
+                    errors.append("wrong outputs from %s" % d)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=construct_and_check, args=a)
+                   for a in ((d1, x1, ref1), (d2, x2, ref2)) * 2]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+
+
+def test_two_predictors_concurrent_runs_use_own_scopes():
+    """Regression: run() must pass its scope explicitly — the
+    scope_guard stack is process-global, so two predictors on two
+    threads used to resolve each other's scope mid-run."""
+    with tempfile.TemporaryDirectory() as d1, \
+            tempfile.TemporaryDirectory() as d2:
+        p1, out1 = _build_predictor(d1, dim=6, classes=3)
+        p2, out2 = _build_predictor(d2, dim=4, classes=2)
+        x1 = np.full((2, 6), 0.5, "float32")
+        x2 = np.full((2, 4), -0.5, "float32")
+        ref1 = p1.run({"x": x1})[0].data
+        ref2 = p2.run({"x": x2})[0].data
+        errors = []
+
+        def hammer(p, x, ref):
+            for _ in range(10):
+                out = p.run({"x": x})[0].data
+                if not np.allclose(out, ref, rtol=1e-5):
+                    errors.append(out.shape)
+
+        threads = [threading.Thread(target=hammer, args=a)
+                   for a in ((p1, x1, ref1), (p2, x2, ref2)) * 2]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+
+
+def test_concurrent_zero_copy_callers_are_isolated():
+    """Regression: staging is per-thread, so N zero-copy callers on one
+    predictor each get THEIR OWN results (the shared-dict version made
+    every caller read the last-staged input)."""
+    with tempfile.TemporaryDirectory() as d:
+        predictor, _ = _build_predictor(d)
+        out_name = predictor.get_output_names()[0]
+        barrier = threading.Barrier(4)
+        errors = []
+
+        def caller(i):
+            x = np.full((2, 6), float(i), "float32")
+            ref = predictor.run({"x": x})[0].data
+            predictor.get_input_tensor("x").copy_from_cpu(x)
+            barrier.wait()  # everyone staged before anyone runs
+            predictor.zero_copy_run()
+            out = predictor.get_output_tensor(out_name).copy_to_cpu()
+            if not np.allclose(np.asarray(out), ref, rtol=1e-5):
+                errors.append(i)
+
+        threads = [threading.Thread(target=caller, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, "caller(s) %s read another caller's results" \
+            % errors
+
+
+def test_zero_copy_state_initialized_and_locked():
+    """Satellite: _staged/_results exist from __init__ (no lazy
+    hasattr materialization) and the run path holds a lock."""
+    with tempfile.TemporaryDirectory() as d:
+        predictor, _ = _build_predictor(d)
+        assert predictor._staged == {}
+        assert predictor._results == {}
+        assert predictor._run_lock is not None
+        inp = predictor.get_input_tensor("x")
+        inp.copy_from_cpu(np.ones((2, 6), "float32"))
+        predictor.zero_copy_run()
+        out = predictor.get_output_tensor(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        assert np.asarray(out).shape == (2, 3)
+
+
+# -- HTTP front end --------------------------------------------------------
+
+@pytest.fixture()
+def http_server():
+    eng = serving.ServingEngine(
+        _StubPredictor(), serving.ServingConfig(max_batch_size=4,
+                                                num_workers=1),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    server, thread = serving.start_http_server(eng)
+    host, port = server.server_address
+    yield eng, "http://%s:%d" % (host, port)
+    server.shutdown()
+    eng.stop()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(url, json.dumps(payload).encode(),
+                                 {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_predict_and_healthz(http_server):
+    eng, base = http_server
+    with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+        assert r.status == 200
+    status, body = _post(base + "/predict",
+                         {"inputs": {"x": [[1, 2, 3], [4, 5, 6]]}})
+    assert status == 200
+    np.testing.assert_array_equal(np.asarray(body["outputs"]["y"]),
+                                  [[2, 4, 6], [8, 10, 12]])
+    assert body["latency_ms"] > 0
+
+
+def test_http_metrics_prometheus_text(http_server):
+    eng, base = http_server
+    _post(base + "/predict", {"inputs": {"x": [[1, 2, 3]]}})
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "# TYPE paddle_tpu_serving_requests counter" in text
+    assert "paddle_tpu_serving_batch_size" in text
+
+
+def test_http_error_mapping(http_server):
+    eng, base = http_server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base + "/predict", {"not_inputs": 1})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base + "/predict", {"inputs": {"x": [[1, 2, 3]]},
+                                  "deadline_ms": "soon"})
+    assert ei.value.code == 400  # client input error, not a 500
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base + "/nowhere", {})
+    assert ei.value.code == 404
+
+
+def test_http_healthz_unhealthy_after_stop(http_server):
+    eng, base = http_server
+    eng.stop()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(base + "/healthz", timeout=10)
+    assert ei.value.code == 503
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base + "/predict", {"inputs": {"x": [[1, 2, 3]]}})
+    assert ei.value.code == 503
