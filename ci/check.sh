@@ -67,8 +67,23 @@ fi
 # undersized queue must actually reject (backpressure engages)
 python tools/serving_bench.py --smoke
 
+echo "== gate 6: fault tolerance =="
+# 6a: the fault-tolerance suite (injection grammar/determinism, retry
+# + dedup exactly-once, eviction, atomic checkpoints, port hygiene,
+# /healthz drain). Same dedup as gates 4a/5a — the full suite below
+# collects the same file
+if [[ "${SKIP_TESTS:-0}" == "1" ]]; then
+    python -m pytest tests/test_fault_tolerance.py -q
+fi
+# 6b: multiprocess recovery drill — 2-trainer sync PS under the launch
+# supervisor, one trainer SIGKILLed at round 3: the job must complete
+# (eviction unblocks the survivor, the relaunch resumes from the
+# newest manifest-verified checkpoint) and the final checkpoint must
+# re-verify
+python tools/ft_smoke.py
+
 if [[ "${SKIP_TESTS:-0}" != "1" ]]; then
-    echo "== gate 6: test suite =="
+    echo "== gate 7: test suite =="
     python -m pytest tests/ -q
 fi
 echo "ALL CI GATES PASS"

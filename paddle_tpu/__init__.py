@@ -64,6 +64,7 @@ from .layers.io import fluid_data as data  # noqa: F401
 from .compiler import CompiledProgram, ExecutionStrategy, BuildStrategy  # noqa: F401
 from . import io  # noqa: F401
 from .io import save, load  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import nn  # noqa: F401
 from . import metrics  # noqa: F401

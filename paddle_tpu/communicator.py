@@ -37,11 +37,19 @@ def global_communicator() -> Optional["Communicator"]:
 
 class Communicator:
     def __init__(self, program=None, mode="ASYNC", send_wait_ms=10,
-                 merge_num=20):
+                 merge_num=20, max_retries=3):
         self.mode = mode
         self.send_wait_ms = int(send_wait_ms)
         self.merge_num = int(merge_num)
+        # delivery failures requeue the merged grad and retry on later
+        # flush ticks (bounded): a transient pserver blip must not cost
+        # the batch. Within ONE delivery the RPC layer's retries are
+        # exactly-once (dedup token); a cross-tick REDELIVERY is a
+        # fresh rpc, i.e. at-least-once — fine for the async/Geo modes
+        # this path serves, not for sync rounds
+        self.max_retries = int(max_retries)
         self._pending = defaultdict(list)  # (ep, name) -> [arrays]
+        self._attempts = defaultdict(int)  # (ep, name) -> failed tries
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._running = False
@@ -90,7 +98,15 @@ class Communicator:
         self._thread.join(timeout=30)
         if _global is self:
             _global = None
-        self._flush()  # drain anything enqueued during shutdown
+        # drain anything enqueued during shutdown. A transient failure
+        # requeues within the retry budget — but after stop() there is
+        # no later tick, so keep flushing until the queue is empty or a
+        # key's budget is spent (then _flush raises): stop() must never
+        # return cleanly with undelivered gradients sitting in _pending
+        self._flush()
+        while any(self._pending.values()):
+            time.sleep(self.send_wait_ms / 1000.0)
+            self._flush()
         if self._error is not None:
             err, self._error = self._error, None
             raise RuntimeError(
@@ -112,7 +128,12 @@ class Communicator:
                 # next enqueue()/stop() raises it to the trainer
                 if self._error is None:
                     self._error = e
-        self._flush()
+        try:
+            self._flush()  # final drain is guarded too — a budget
+            # exhaustion here must reach stop(), not the excepthook
+        except Exception as e:
+            if self._error is None:
+                self._error = e
 
     def _flush(self):
         from .ops.distributed_ops import deliver_grad
@@ -120,8 +141,34 @@ class Communicator:
         with self._lock:
             batch = {k: v for k, v in self._pending.items() if v}
             self._pending.clear()
+        failed = None
         for (ep, name), grads in batch.items():
             merged = grads[0] if len(grads) == 1 else np.sum(
                 np.stack(grads), axis=0)
-            deliver_grad(name, ep, merged)
+            try:
+                deliver_grad(name, ep, merged)
+            except Exception as e:  # noqa: BLE001 — transport failure
+                with self._lock:
+                    self._attempts[(ep, name)] += 1
+                    if self._attempts[(ep, name)] <= self.max_retries:
+                        # requeue the MERGED grad at the front: a later
+                        # flush re-merges it with newer grads and
+                        # retries. The redelivery is a FRESH rpc (new
+                        # dedup token), so this is at-least-once — the
+                        # async/Geo modes this path serves tolerate a
+                        # re-applied grad, and it beats silently losing
+                        # the batch. (Within ONE deliver_grad the RPC
+                        # layer's own retries ARE exactly-once.)
+                        self._pending[(ep, name)].insert(0, merged)
+                        continue
+                    # budget spent: surface the failure, but let a
+                    # LATER delivery for this key start a fresh budget
+                    self._attempts.pop((ep, name), None)
+                if failed is None:
+                    failed = e
+                continue
             self.pushes += 1
+            with self._lock:
+                self._attempts.pop((ep, name), None)
+        if failed is not None:
+            raise failed

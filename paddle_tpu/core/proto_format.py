@@ -497,12 +497,16 @@ def parse_lod_tensor(data: bytes, pos: int = 0):
     return arr, lod, pos
 
 
-def save_combine(named_arrays, path: str) -> None:
+def save_combine_bytes(named_arrays) -> bytes:
     """Reference save_combine_op framing: streams back to back, in the
     given order (callers pass sorted names, matching inference/io.cc)."""
+    return b"".join(serialize_lod_tensor(np.asarray(arr))
+                    for _, arr in named_arrays)
+
+
+def save_combine(named_arrays, path: str) -> None:
     with open(path, "wb") as f:
-        for _, arr in named_arrays:
-            f.write(serialize_lod_tensor(np.asarray(arr)))
+        f.write(save_combine_bytes(named_arrays))
 
 
 def load_combine(path: str, names: List[str]):

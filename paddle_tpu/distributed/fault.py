@@ -1,0 +1,235 @@
+"""Deterministic fault injection at the RPC frame boundary.
+
+The reference hardens its PS dataplane against real networks (gRPC
+deadlines + retries, heart_beat_monitor.h liveness); reproducing those
+recovery paths requires *causing* the failures on demand, on one host,
+deterministically — CI cannot wait for a switch to actually drop a
+frame. This module is the shim: ``ps_rpc`` routes every outgoing and
+incoming frame through the process-wide injector, which (seeded, so a
+failing run replays exactly) drops, delays, duplicates, truncates, or
+severs frames according to an env-configured fault plan.
+
+Grammar (``PADDLE_TPU_FAULTS``)::
+
+    plan  := spec[,spec...]
+    spec  := <side>.<kind>:<prob>[:<param>]
+    side  := send | recv | any
+    kind  := drop | delay | dup | truncate | close
+    prob  := float in [0, 1]           (per-frame probability)
+    param := delay ms (delay, default 20) | byte count (truncate)
+
+Examples::
+
+    PADDLE_TPU_FAULTS="send.drop:0.05,send.dup:0.05"
+    PADDLE_TPU_FAULTS="any.delay:0.2:50,recv.close:0.01"
+    PADDLE_TPU_FAULT_SEED=42
+
+Kinds per side — ``send``: drop (frame never transmitted), delay
+(sleep, then transmit), dup (transmit twice — exercises server-side
+dedup), truncate (transmit a prefix, then sever — the peer sees EOF
+mid-frame), close (sever without transmitting). ``recv``: drop (frame
+read and discarded — the reader sees silence), delay, close.
+
+Every injected fault increments ``fault.injected{side=,kind=}`` in the
+observability registry (recorded unconditionally, like ``serving.*`` —
+fault events are rare and CI asserts on them).
+"""
+from __future__ import annotations
+
+import os
+import random
+import socket
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["FaultRule", "FaultInjector", "FaultInjected",
+           "get_injector", "reset_injector", "parse_plan"]
+
+_SIDES = ("send", "recv", "any")
+_KINDS = ("drop", "delay", "dup", "truncate", "close")
+_RECV_KINDS = ("drop", "delay", "close")
+
+
+class FaultInjected(OSError):
+    """Raised by the injector when it severs a connection (close /
+    truncate) — an ``OSError`` so transport retry paths treat it
+    exactly like a real peer failure."""
+
+
+class FaultRule:
+    __slots__ = ("side", "kind", "prob", "param")
+
+    def __init__(self, side: str, kind: str, prob: float,
+                 param: Optional[float] = None):
+        if side not in _SIDES:
+            raise ValueError("fault side must be one of %s, got %r"
+                             % (_SIDES, side))
+        if kind not in _KINDS:
+            raise ValueError("fault kind must be one of %s, got %r"
+                             % (_KINDS, kind))
+        if side == "recv" and kind not in _RECV_KINDS:
+            raise ValueError(
+                "recv-side faults support %s (a receiver cannot %s a "
+                "frame it does not own)" % (_RECV_KINDS, kind))
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError("fault probability must be in [0,1], got %r"
+                             % prob)
+        self.side = side
+        self.kind = kind
+        self.prob = prob
+        self.param = param
+
+    def __repr__(self):
+        return "%s.%s:%g%s" % (self.side, self.kind, self.prob,
+                               ":%g" % self.param
+                               if self.param is not None else "")
+
+
+def parse_plan(plan: str) -> List[FaultRule]:
+    """Parse the ``PADDLE_TPU_FAULTS`` grammar into rules; raises
+    ``ValueError`` naming the offending spec."""
+    rules = []
+    for spec in plan.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        try:
+            head, _, rest = spec.partition(":")
+            side, _, kind = head.partition(".")
+            parts = rest.split(":")
+            prob = float(parts[0])
+            param = float(parts[1]) if len(parts) > 1 else None
+            rules.append(FaultRule(side, kind, prob, param))
+        except (ValueError, IndexError) as e:
+            raise ValueError(
+                "bad PADDLE_TPU_FAULTS spec %r (grammar: "
+                "side.kind:prob[:param]): %s" % (spec, e)) from None
+    return rules
+
+
+def _count(side: str, kind: str) -> None:
+    from .. import observability as _obs
+
+    _obs.counter("fault.injected", side=side, kind=kind).inc()
+
+
+class FaultInjector:
+    """Seeded per-process fault source. One shared ``random.Random``
+    behind a lock: the ROLL SEQUENCE (not per-connection state) is what
+    the seed pins, so a run's fault pattern replays given the same
+    interleaving — and tests that need exact replay use a single
+    thread."""
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.rules = list(rules)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultInjector"]:
+        plan = os.environ.get("PADDLE_TPU_FAULTS", "")
+        if not plan.strip():
+            return None
+        seed = int(os.environ.get("PADDLE_TPU_FAULT_SEED", "0"))
+        return cls(parse_plan(plan), seed=seed)
+
+    def _pick(self, side: str) -> Optional[FaultRule]:
+        """At most ONE fault per frame: the first matching rule whose
+        roll fires wins (rules are evaluated in plan order). An
+        ``any``-side rule whose kind has no recv meaning (dup,
+        truncate) only ever applies on the send side."""
+        with self._lock:
+            for r in self.rules:
+                if r.side not in (side, "any"):
+                    continue
+                if side == "recv" and r.kind not in _RECV_KINDS:
+                    continue
+                if self._rng.random() < r.prob:
+                    return r
+        return None
+
+    @staticmethod
+    def _sever(sock: socket.socket) -> None:
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    # -- frame hooks (called by ps_rpc) -----------------------------------
+
+    def on_send(self, sock: socket.socket, frame: bytes) -> bool:
+        """Apply at most one send-side fault to ``frame``. Returns True
+        when the frame reached the wire (possibly twice), False when it
+        was dropped; raises ``FaultInjected`` when the connection was
+        severed."""
+        r = self._pick("send")
+        if r is None:
+            sock.sendall(frame)
+            return True
+        _count("send", r.kind)
+        if r.kind == "drop":
+            return False
+        if r.kind == "delay":
+            time.sleep((r.param if r.param is not None else 20.0) / 1e3)
+            sock.sendall(frame)
+            return True
+        if r.kind == "dup":
+            sock.sendall(frame)
+            sock.sendall(frame)
+            return True
+        if r.kind == "truncate":
+            cut = int(r.param) if r.param is not None else max(
+                1, len(frame) // 2)
+            sock.sendall(frame[:max(0, min(cut, len(frame) - 1))])
+            self._sever(sock)
+            raise FaultInjected("injected: frame truncated mid-send")
+        # close
+        self._sever(sock)
+        raise FaultInjected("injected: connection closed before send")
+
+    def on_recv(self, sock: socket.socket) -> str:
+        """Decide the fate of the NEXT incoming frame. Returns
+        ``"pass"`` (deliver), ``"drop"`` (read and discard), or raises
+        ``FaultInjected`` after severing (close)."""
+        r = self._pick("recv")
+        if r is None:
+            return "pass"
+        _count("recv", r.kind)
+        if r.kind == "delay":
+            time.sleep((r.param if r.param is not None else 20.0) / 1e3)
+            return "pass"
+        if r.kind == "drop":
+            return "drop"
+        self._sever(sock)
+        raise FaultInjected("injected: connection closed before recv")
+
+
+# -- process-wide injector (env-armed, resettable for tests) ---------------
+
+_UNSET = object()
+_injector = _UNSET
+_injector_lock = threading.Lock()
+
+
+def get_injector() -> Optional[FaultInjector]:
+    """The process injector, built from ``PADDLE_TPU_FAULTS`` on first
+    use; ``None`` when no plan is configured."""
+    global _injector
+    if _injector is _UNSET:
+        with _injector_lock:
+            if _injector is _UNSET:
+                _injector = FaultInjector.from_env()
+    return _injector
+
+
+def reset_injector() -> None:
+    """Drop the cached injector so the next ``get_injector`` re-reads
+    the environment (tests toggle the plan mid-process)."""
+    global _injector
+    with _injector_lock:
+        _injector = _UNSET

@@ -1,4 +1,4 @@
-"""Multi-process training launcher.
+"""Multi-process training launcher + supervisor.
 
 Parity: /root/reference/python/paddle/distributed/launch.py:353 — spawn
 one worker process per device/host slot with the PADDLE_TRAINER_*
@@ -7,8 +7,19 @@ jax.distributed coordination variables, so dygraph prepare_context /
 the collective fleet initialize over the coordination service instead
 of a NCCL TCP id broadcast.
 
+Supervision: the launcher no longer just propagates the first nonzero
+exit. A worker that dies (crash, OOM-kill, SIGKILL) is relaunched in
+place — same rank, same env, plus ``PADDLE_RESTART_COUNT`` — up to
+``--max_restarts`` times per rank (env
+``PADDLE_LAUNCH_MAX_RESTARTS``, default 3). Workers are expected to
+resume from their newest valid checkpoint on restart
+(``paddle_tpu.checkpoint.CheckpointManager.load_latest``); surviving
+PS trainers keep making progress meanwhile via server-side heartbeat
+eviction (``distributed/ps_rpc.py``). Only when a rank exhausts its
+restart budget does the supervisor tear the job down.
+
 Usage:  python -m paddle_tpu.distributed.launch --nproc_per_node=2 \
-            train.py --your-args
+            [--max_restarts=3] train.py --your-args
 """
 from __future__ import annotations
 
@@ -17,6 +28,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 
 __all__ = ["launch", "get_cluster_env"]
 
@@ -31,6 +43,12 @@ def _parse_args(argv=None):
     p.add_argument("--node_rank", type=int, default=0)
     p.add_argument("--started_port", type=int, default=6170)
     p.add_argument("--log_dir", default=None)
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get(
+                       "PADDLE_LAUNCH_MAX_RESTARTS", "3")),
+                   help="relaunches per rank after an abnormal exit "
+                        "before the whole job is brought down "
+                        "(0 = die on first worker death)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -60,50 +78,121 @@ def get_cluster_env(node_ips, node_rank, nproc_per_node, started_port,
     return env
 
 
+def _log(msg: str) -> None:
+    print("[launch] %s" % msg, file=sys.stderr, flush=True)
+
+
+class _Worker:
+    """One supervised rank: its env, restart budget, and log sink."""
+
+    def __init__(self, local_rank: int, cmd, env, log_dir):
+        self.local_rank = local_rank
+        self.cmd = list(cmd)
+        self.env = dict(env)
+        self.log_dir = log_dir
+        self.restarts = 0
+        self.proc: subprocess.Popen = None
+        self._fp = None
+
+    def spawn(self) -> None:
+        env = dict(self.env)
+        env["PADDLE_RESTART_COUNT"] = str(self.restarts)
+        stdout = stderr = None
+        self.close_log()  # a relaunch must not leak the old handle
+        if self.log_dir:
+            # append across restarts: one workerlog per rank tells the
+            # whole story, crash included
+            self._fp = open(os.path.join(
+                self.log_dir, "workerlog.%d" % self.local_rank), "a")
+            stdout = stderr = self._fp
+        self.proc = subprocess.Popen(self.cmd, env=env, stdout=stdout,
+                                     stderr=stderr)
+
+    def close_log(self) -> None:
+        if self._fp is not None:
+            self._fp.close()
+            self._fp = None
+
+
 def launch(args=None):
     args = args if args is not None else _parse_args()
     node_ips = [ip for ip in args.ips.split(",") if ip]
-    procs = []
-    log_fps = []
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
+    # workers must import paddle_tpu even when it runs from a source
+    # checkout (script-dir sys.path[0] replaces the launcher's cwd)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    workers = []
+    for local_rank in range(args.nproc_per_node):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        env.update(get_cluster_env(node_ips, args.node_rank,
+                                   args.nproc_per_node,
+                                   args.started_port, local_rank))
+        cmd = [sys.executable, "-u", args.training_script] + \
+            list(args.training_script_args)
+        workers.append(_Worker(local_rank, cmd, env, args.log_dir))
+
+    def _terminate_all(sig=signal.SIGTERM):
+        for w in workers:
+            if w.proc is not None and w.proc.poll() is None:
+                try:
+                    w.proc.send_signal(sig)
+                except OSError:
+                    pass
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+                    w.proc.wait()
+
+    live = set(range(args.nproc_per_node))
+    rc = 0
     try:
-        # workers must import paddle_tpu even when it runs from a source
-        # checkout (script-dir sys.path[0] replaces the launcher's cwd)
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        for local_rank in range(args.nproc_per_node):
-            env = dict(os.environ)
-            env["PYTHONPATH"] = pkg_root + os.pathsep + \
-                env.get("PYTHONPATH", "")
-            env.update(get_cluster_env(node_ips, args.node_rank,
-                                       args.nproc_per_node,
-                                       args.started_port, local_rank))
-            cmd = [sys.executable, "-u", args.training_script] + \
-                list(args.training_script_args)
-            stdout = stderr = None
-            if args.log_dir:
-                fp = open(os.path.join(
-                    args.log_dir, "workerlog.%d" % local_rank), "w")
-                log_fps.append(fp)
-                stdout = stderr = fp
-            procs.append(subprocess.Popen(cmd, env=env, stdout=stdout,
-                                          stderr=stderr))
-        rc = 0
-        for p in procs:
-            p.wait()
-            rc = rc or p.returncode
+        for w in workers:
+            w.spawn()
+        # supervision loop: poll, relaunch the dead (bounded), finish
+        # when every rank has exited cleanly
+        while live:
+            time.sleep(0.2)
+            for w in workers:
+                if w.local_rank not in live:
+                    continue
+                code = w.proc.poll()
+                if code is None:
+                    continue
+                if code == 0:
+                    live.discard(w.local_rank)
+                    continue
+                sig_note = (" (signal %d)" % -code) if code < 0 else ""
+                if w.restarts >= args.max_restarts:
+                    _log("rank %d exited %d%s; restart budget (%d) "
+                         "exhausted — bringing the job down"
+                         % (w.local_rank, code, sig_note,
+                            args.max_restarts))
+                    rc = code if code > 0 else 1
+                    live.discard(w.local_rank)
+                    _terminate_all()
+                    live = set()
+                    break
+                w.restarts += 1
+                _log("rank %d exited %d%s; relaunching (restart %d/%d)"
+                     " — worker resumes from its newest valid "
+                     "checkpoint"
+                     % (w.local_rank, code, sig_note, w.restarts,
+                        args.max_restarts))
+                w.spawn()
         return rc
     except KeyboardInterrupt:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs:
-            p.wait()
+        _terminate_all()
         return 1
     finally:
-        for fp in log_fps:
-            fp.close()
+        for w in workers:
+            w.close_log()
 
 
 def main():

@@ -20,27 +20,67 @@ closes it. A send_barrier for round N+1 blocks until round N is fully
 fetched — without that gate, a fast trainer's next round would flip
 the round incomplete while a slow trainer is still mid-fetch and both
 would deadlock.
+
+Fault tolerance (reference grpc_client.cc deadline/retry +
+heart_beat_monitor.h semantics):
+
+- every frame passes through ``distributed/fault.py`` — the
+  env-configured injector (``PADDLE_TPU_FAULTS``) that makes each
+  recovery path below testable on one host;
+- the client retries EVERY rpc with bounded exponential backoff +
+  jitter after a timeout, EOF, or connection loss. Requests carry a
+  ``(cid, round, seq)`` dedup token (``cid`` is a per-incarnation
+  random nonce standing in for the trainer id, so a restarted
+  trainer's fresh ``seq`` can never match its previous life's cache);
+  the server executes each token exactly once — a retried
+  ``send_grad``/barrier is summed/counted once no matter how many
+  copies of the frame arrive. Responses echo ``seq`` so the client
+  discards stale replies left in the stream by duplicated frames;
+- the server evicts trainers whose heartbeats go silent past
+  ``PADDLE_PS_EVICT_AFTER`` seconds: the effective fanin shrinks so
+  surviving trainers' barriers complete instead of deadlocking, and
+  the heartbeat response names the evicted so survivors
+  log-and-continue. A relaunched trainer that sends again is
+  re-admitted and the fanin grows back;
+- ``rpc.retries`` / ``rpc.timeouts`` / ``ps.evictions`` /
+  ``ps.readmissions`` are recorded unconditionally in the
+  observability registry (rare events, and CI asserts on them).
 """
 from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import struct
+import sys
 import threading
 import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import fault as _fault
+
 _ROUND_TIMEOUT = float(os.environ.get("PADDLE_PS_ROUND_TIMEOUT", "120"))
+
+
+def _counter(name: str, **labels):
+    from .. import observability as _obs
+
+    return _obs.counter(name, **labels)
 
 
 def _send_msg(sock: socket.socket, msg: dict,
               raw: bytes = b"") -> None:
     header = json.dumps(msg).encode("utf-8")
-    sock.sendall(struct.pack("<Q", len(header)) + header
-                 + struct.pack("<Q", len(raw)) + raw)
+    frame = (struct.pack("<Q", len(header)) + header
+             + struct.pack("<Q", len(raw)) + raw)
+    inj = _fault.get_injector()
+    if inj is not None:
+        inj.on_send(sock, frame)  # may drop/dup/sever per the plan
+    else:
+        sock.sendall(frame)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -55,21 +95,26 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 def _recv_msg(sock: socket.socket):
     """Returns (msg_dict, raw_bytes) or None on EOF."""
-    h = _recv_exact(sock, 8)
-    if h is None:
-        return None
-    (hlen,) = struct.unpack("<Q", h)
-    header = _recv_exact(sock, hlen)
-    if header is None:
-        return None
-    r = _recv_exact(sock, 8)
-    if r is None:
-        return None
-    (rlen,) = struct.unpack("<Q", r)
-    raw = _recv_exact(sock, rlen) if rlen else b""
-    if raw is None:
-        return None
-    return json.loads(header.decode("utf-8")), raw
+    while True:
+        inj = _fault.get_injector()
+        action = inj.on_recv(sock) if inj is not None else "pass"
+        h = _recv_exact(sock, 8)
+        if h is None:
+            return None
+        (hlen,) = struct.unpack("<Q", h)
+        header = _recv_exact(sock, hlen)
+        if header is None:
+            return None
+        r = _recv_exact(sock, 8)
+        if r is None:
+            return None
+        (rlen,) = struct.unpack("<Q", r)
+        raw = _recv_exact(sock, rlen) if rlen else b""
+        if raw is None:
+            return None
+        if action == "drop":
+            continue  # injected: the frame evaporates in flight
+        return json.loads(header.decode("utf-8")), raw
 
 
 def _array_header(arr: np.ndarray) -> dict:
@@ -84,9 +129,21 @@ def _array_from(header: dict, raw: bytes) -> np.ndarray:
 def snapshot_scope_to_dir(executor, scope, dirname: str) -> None:
     """Serialize every tensor var in ``scope`` into ``dirname`` in the
     reference tensor-stream format (shared by the server-side
-    'checkpoint' RPC kind and the emulated checkpoint_notify path)."""
+    'checkpoint' RPC kind and the emulated checkpoint_notify path).
+
+    checkpoint_notify fans out over SEVERAL pservers that share one
+    dir — each contributes its shard's vars concurrently — so the
+    write is a MERGE: every file lands via tmp+fsync+rename (never a
+    torn file) and the sha256 manifest is rewritten over the whole dir
+    after this server's files. A whole-dir rename would let racing
+    shards clobber each other. Scope of the guarantee: the manifest
+    certifies integrity of the files PRESENT (no torn/corrupt file
+    loads as garbage); whether every EXPECTED server contributed is
+    the notifier's concern — it fans out the RPCs and sees each
+    server's ack or error."""
     import os
 
+    from ..checkpoint import atomic_write_bytes, write_manifest
     from ..core import proto_format
 
     os.makedirs(dirname, exist_ok=True)
@@ -94,9 +151,10 @@ def snapshot_scope_to_dir(executor, scope, dirname: str) -> None:
         val = executor._read_var(scope, name)
         if val is None or not hasattr(val, "shape"):
             continue
-        path = os.path.join(dirname, name.replace("/", "_"))
-        with open(path, "wb") as f:
-            f.write(proto_format.serialize_lod_tensor(np.asarray(val)))
+        atomic_write_bytes(
+            os.path.join(dirname, name.replace("/", "_")),
+            proto_format.serialize_lod_tensor(np.asarray(val)))
+    write_manifest(dirname)
 
 
 class HeartBeatMonitor:
@@ -111,6 +169,22 @@ class HeartBeatMonitor:
         with self._lock:
             self._last[int(trainer_id)] = time.time()
 
+    def register(self, trainer_ids) -> None:
+        """Start the staleness clock for expected trainers that have
+        not pinged yet — a rank that dies BEFORE its first rpc must
+        still become evictable, or survivors would wait out the full
+        round timeout on a trainer the monitor never heard of."""
+        now = time.time()
+        with self._lock:
+            for t in trainer_ids:
+                self._last.setdefault(int(t), now)
+
+    def forget(self, trainer_id: int) -> None:
+        """Drop a trainer's entry (post-eviction: a stale entry would
+        re-report the same trainer forever; re-admission re-pings)."""
+        with self._lock:
+            self._last.pop(int(trainer_id), None)
+
     def status(self) -> Dict[int, float]:
         """trainer_id -> seconds since last ping."""
         now = time.time()
@@ -124,18 +198,34 @@ class HeartBeatMonitor:
 
 class PSServer:
     """Sync-mode PS endpoint implementing the RunSyncLoop round
-    protocol; async mode applies each grad immediately
-    (RunAsyncLoop)."""
+    protocol; async mode applies each grad immediately (RunAsyncLoop).
+
+    ``evict_after`` (seconds; env ``PADDLE_PS_EVICT_AFTER``, 0 =
+    disabled) arms the heartbeat monitor: a trainer silent that long is
+    evicted — its slot leaves the effective fanin so the surviving
+    trainers' barriers complete, and the heartbeat response carries the
+    eviction so survivors can log-and-continue."""
+
+    _DEDUPE_CAP = 512  # distinct live client nonces remembered
 
     def __init__(self, endpoint: str, executor, scope, grad_to_block,
-                 fanin: int = 1, sync_mode: bool = True):
+                 fanin: int = 1, sync_mode: bool = True,
+                 evict_after: Optional[float] = None):
         host, port = endpoint.rsplit(":", 1)
         self._executor = executor
         self._scope = scope
         self._grad_to_block = grad_to_block
         self._fanin = max(int(fanin), 1)
         self._sync = bool(sync_mode)
-        self.monitor = HeartBeatMonitor()
+        if evict_after is None:
+            evict_after = float(os.environ.get("PADDLE_PS_EVICT_AFTER",
+                                               "0"))
+        self._evict_after = float(evict_after)
+        self.monitor = HeartBeatMonitor(
+            stale_seconds=self._evict_after if self._evict_after > 0
+            else 60.0)
+        self._evicted: set = set()
+        self._clock_started = False
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending: Dict[str, List[np.ndarray]] = {}
@@ -143,10 +233,15 @@ class PSServer:
         self._fetch_barriers = 0
         self._round_complete = True   # params servable before round 1
         self._fetches_pending = False  # True between apply and last fetch
-        # per-trainer (seq, response) cache: the client resends after a
+        # per-client (token, response) cache: the client resends after a
         # reconnect; without dedupe a response lost AFTER server-side
-        # processing would double-apply a grad/barrier in the round
-        self._dedupe: Dict[int, tuple] = {}
+        # processing would double-apply a grad/barrier in the round.
+        # Keyed by the client's random nonce (NOT trainer_id: the
+        # background heartbeater is a second connection with the same
+        # trainer_id, and sharing one slot would let its traffic evict
+        # the main client's in-flight entry mid-retry).
+        self._dedupe: Dict[str, list] = {}   # cid -> [key, ev, resp, raw, ts]
+        self._last_seq: Dict[str, int] = {}  # cid -> highest seq admitted
         self._dedupe_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -154,8 +249,18 @@ class PSServer:
         self._sock.bind((host or "127.0.0.1", int(port)))
         self._sock.listen(16)
         self._threads: List[threading.Thread] = []
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
+        if self._evict_after > 0:
+            t = threading.Thread(target=self._evict_loop,
+                                 name="ps-evict-monitor", daemon=True)
+            t.start()
+            self._threads.append(t)
 
     # -- round protocol ---------------------------------------------------
+
+    def _effective_fanin(self) -> int:
+        return max(1, self._fanin - len(self._evicted))
 
     def _apply_round(self):
         """All trainers' grads in (locked by caller): sum per var, run
@@ -188,11 +293,69 @@ class PSServer:
                     % (what, self._fanin, self.monitor.stale_trainers()))
             self._cond.wait(timeout=1.0)
 
+    # -- eviction (heart_beat_monitor.h semantics) ------------------------
+
+    def _evict_loop(self):
+        period = max(self._evict_after / 4.0, 0.05)
+        while not self._shutdown.wait(period):
+            stale = self.monitor.stale_trainers()
+            if not stale:
+                continue
+            with self._lock:
+                for t in stale:
+                    if t not in self._evicted:
+                        self._evict_locked(t)
+
+    def _evict_locked(self, trainer_id: int) -> None:
+        """Remove a dead trainer from the round math (locked by
+        caller): shrink the effective fanin and re-check both barriers
+        — the survivors may already have everyone-still-alive's
+        contributions in, in which case the round completes NOW."""
+        self._evicted.add(trainer_id)
+        self.monitor.forget(trainer_id)
+        _counter("ps.evictions").inc()
+        print("[ps_rpc] evicting trainer %d (silent > %.1fs); "
+              "effective fanin now %d"
+              % (trainer_id, self._evict_after, self._effective_fanin()),
+              file=sys.stderr, flush=True)
+        eff = self._effective_fanin()
+        if not self._round_complete and self._send_barriers >= eff:
+            self._apply_round()
+        if self._fetches_pending and self._fetch_barriers >= eff:
+            self._fetch_barriers = 0
+            self._fetches_pending = False
+        self._cond.notify_all()
+
+    def _readmit(self, trainer_id: int) -> None:
+        with self._lock:
+            if trainer_id in self._evicted:
+                self._evicted.discard(trainer_id)
+                _counter("ps.readmissions").inc()
+                print("[ps_rpc] re-admitting trainer %d; effective "
+                      "fanin now %d"
+                      % (trainer_id, self._effective_fanin()),
+                      file=sys.stderr, flush=True)
+
     def _handle(self, msg: dict, raw: bytes):
         """Returns (response_dict, response_raw)."""
         kind = msg["kind"]
         if "trainer_id" in msg:
-            self.monitor.ping(msg["trainer_id"])
+            tid = int(msg["trainer_id"])
+            if self._evict_after > 0 and not self._clock_started:
+                # first sign of life from ANY trainer arms the clock
+                # for every expected rank (0..fanin-1) — not at server
+                # construction, or slow worker startup (interpreter +
+                # jax import) would read as death before round 1
+                self._clock_started = True
+                self.monitor.register(range(self._fanin))
+            self.monitor.ping(tid)
+            # an evicted trainer that TRAINS again (a supervised
+            # relaunch) rejoins the round math; a mere heartbeat from a
+            # zombie must not grow the fanin back
+            if tid in self._evicted and kind in (
+                    "send_grad", "send_barrier", "get_param",
+                    "fetch_barrier", "pull_sparse", "push_sparse"):
+                self._readmit(tid)
         if kind == "send_grad":
             arr = _array_from(msg["array"], raw)
             with self._lock:
@@ -212,7 +375,7 @@ class PSServer:
                                "previous round's fetch barriers")
                 self._send_barriers += 1
                 self._round_complete = False
-                if self._send_barriers >= self._fanin:
+                if self._send_barriers >= self._effective_fanin():
                     self._apply_round()
                 else:
                     self._wait_for(lambda: self._round_complete,
@@ -233,7 +396,7 @@ class PSServer:
         if kind == "fetch_barrier":
             with self._lock:
                 self._fetch_barriers += 1
-                if self._fetch_barriers >= self._fanin:
+                if self._fetch_barriers >= self._effective_fanin():
                     self._fetch_barriers = 0
                     self._fetches_pending = False
                     self._cond.notify_all()
@@ -288,10 +451,22 @@ class PSServer:
                                       msg.get("dir", ""))
             return {"ok": True}, b""
         if kind == "heartbeat":
+            with self._lock:
+                evicted = sorted(self._evicted)
+                eff = self._effective_fanin()
             return {"ok": True,
                     "status": {str(k): v
                                for k, v in
-                               self.monitor.status().items()}}, b""
+                               self.monitor.status().items()},
+                    "evicted": evicted,
+                    "fanin": self._fanin,
+                    "effective_fanin": eff,
+                    # process-wide counters, surfaced so an external
+                    # probe (tests, the CI smoke) can assert on
+                    # recovery without reaching into this process
+                    "evictions": _counter("ps.evictions").value,
+                    "readmissions": _counter("ps.readmissions").value,
+                    }, b""
         if kind == "shutdown":
             self._shutdown.set()
             with self._lock:
@@ -307,45 +482,89 @@ class PSServer:
         — return the cached response — or (b) while the original is
         STILL EXECUTING (it blocked in a barrier wait): wait on its
         completion event instead of running the handler twice, which
-        would double-count a barrier / double-apply a grad."""
-        tid = msg.get("trainer_id") if isinstance(msg, dict) else None
+        would double-count a barrier / double-apply a grad. A resend of
+        a request OLDER than the client's latest (a duplicated frame
+        surfacing late) is answered with a stale marker and NEVER
+        re-executed — the client discards the reply by seq anyway."""
         seq = msg.get("seq") if isinstance(msg, dict) else None
         cid = msg.get("cid") if isinstance(msg, dict) else None
-        if tid is None or seq is None or cid is None:
+        if seq is None or cid is None:
             return self._handle(msg, raw)
-        # key includes the client's random nonce: a RESTARTED trainer's
-        # fresh seq=1 must never match its previous incarnation's cache
-        key = (cid, seq)
+        # the dedup token: the client's per-incarnation random nonce
+        # (its trainer_id stand-in that survives nothing), the sync
+        # round it believes it is in, and its per-connection sequence
+        key = (msg.get("round", 0), seq)
         with self._dedupe_lock:
-            cached = self._dedupe.get(tid)
+            cached = self._dedupe.get(cid)
             if cached is not None and cached[0] == key:
                 ev = cached[1]
+            elif seq <= self._last_seq.get(cid, 0):
+                # duplicate of an ALREADY-SUPERSEDED request (a dup'd
+                # frame surfacing after newer traffic): executing it
+                # again would double-apply; its original response is
+                # gone, so answer with a stale marker. (A legitimate
+                # retry whose completed entry was LRU-pruned — >512
+                # live cids between response loss and resend — also
+                # lands here and fails loudly: exactly-once is kept at
+                # the price of that narrow hard-fail; raise _DEDUPE_CAP
+                # if a deployment actually churns that many clients.)
+                return {"ok": False, "stale": True,
+                        "error": "stale duplicate (seq %s <= %s)"
+                        % (seq, self._last_seq.get(cid, 0))}, b""
             else:
+                # dict insertion order doubles as the LRU order:
+                # re-insert on every update so the oldest entry is
+                # the longest-idle client
+                self._last_seq.pop(cid, None)
+                self._last_seq[cid] = int(seq)
                 ev = threading.Event()
-                self._dedupe[tid] = (key, ev, None, b"")
+                self._dedupe[cid] = [key, ev, None, b"", time.time()]
+                if len(self._dedupe) > self._DEDUPE_CAP:
+                    self._prune_dedupe_locked()
                 cached = None
         if cached is not None:  # duplicate: original owns the handler
             if not ev.wait(timeout=_ROUND_TIMEOUT):
                 return {"ok": False,
-                        "error": "duplicate request (trainer %s seq %s) "
-                        "still in flight" % (tid, seq)}, b""
+                        "error": "duplicate request (cid %s seq %s) "
+                        "still in flight" % (cid, seq)}, b""
             with self._dedupe_lock:
-                c2 = self._dedupe.get(tid)
+                c2 = self._dedupe.get(cid)
             if c2 is not None and c2[0] == key:
                 return c2[2], c2[3]
-            return {"ok": False, "error": "dedupe entry superseded"}, b""
+            return {"ok": False, "stale": True,
+                    "error": "dedupe entry superseded"}, b""
         try:
             resp, rraw = self._handle(msg, raw)
         except Exception as e:
             resp, rraw = {"ok": False, "error": "%s: %s"
                           % (type(e).__name__, e)}, b""
         with self._dedupe_lock:
-            if self._dedupe.get(tid, (None,))[0] == key:
-                self._dedupe[tid] = (key, ev, resp, rraw)
+            ent = self._dedupe.get(cid)
+            if ent is not None and ent[0] == key:
+                ent[2], ent[3], ent[4] = resp, rraw, time.time()
         ev.set()
         return resp, rraw
 
+    def _prune_dedupe_locked(self):
+        """Cap the per-client caches: drop the least-recently-used
+        completed RESPONSE entries (heartbeater clients come and go; an
+        unbounded dict would grow with every incarnation). The tiny
+        ``_last_seq`` watermark is kept much longer — pruning it with
+        the response would re-open the stale-duplicate double-apply
+        window for a still-live client — and is itself LRU-capped far
+        above the response cache, where only long-dead clients fall
+        off the end."""
+        done = sorted(
+            (cid for cid, e in self._dedupe.items() if e[1].is_set()),
+            key=lambda c: self._dedupe[c][4])
+        for cid in done[:max(0, len(self._dedupe) - self._DEDUPE_CAP)]:
+            del self._dedupe[cid]
+        while len(self._last_seq) > 16 * self._DEDUPE_CAP:
+            self._last_seq.pop(next(iter(self._last_seq)))
+
     def _serve_conn(self, conn: socket.socket):
+        with self._conn_lock:
+            self._conns.add(conn)
         try:
             while not self._shutdown.is_set():
                 got = _recv_msg(conn)
@@ -360,59 +579,151 @@ class PSServer:
                 except Exception as e:
                     resp, rraw = {"ok": False, "error": "%s: %s"
                                   % (type(e).__name__, e)}, b""
+                if isinstance(msg, dict) and msg.get("seq") is not None:
+                    # echo the token: the retrying client matches
+                    # responses by seq and discards strays from dup'd
+                    # frames
+                    resp.setdefault("seq", msg.get("seq"))
+                    resp.setdefault("cid", msg.get("cid"))
+                if self._evict_after > 0:
+                    # advertise the eviction deadline: clients of an
+                    # eviction-armed server MUST heartbeat while their
+                    # main socket is blocked in a barrier, or a healthy
+                    # straggler round would read as death — the client
+                    # auto-arms its heartbeater off this field
+                    resp.setdefault("evict_after", self._evict_after)
                 _send_msg(conn, resp, rraw)
         except OSError:
             pass
         finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
             conn.close()
 
     def serve_forever(self) -> None:
         """Accept loop; returns after a shutdown message (the reference
         blocks inside the listen_and_serv op the same way)."""
         self._sock.settimeout(0.2)
-        while not self._shutdown.is_set():
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break  # listening socket closed by stop()
+                t = threading.Thread(target=self._serve_conn,
+                                     args=(conn,), daemon=True)
+                t.start()
+                if len(self._threads) > 64:
+                    # churning heartbeat clients reconnect forever;
+                    # finished handler threads must not pile up
+                    self._threads = [x for x in self._threads
+                                     if x.is_alive()]
+                self._threads.append(t)
+        finally:
             try:
-                conn, _ = self._sock.accept()
-            except socket.timeout:
-                continue
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
-        self._sock.close()
+                self._sock.close()
+            except OSError:
+                pass
 
     def start_background(self) -> threading.Thread:
-        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t = threading.Thread(target=self.serve_forever,
+                             name="ps-accept", daemon=True)
         t.start()
+        self._threads.append(t)
         return t
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        """Tear the server down NOW: wake blocked rounds, close the
+        listening socket (the bound port is released even while a
+        client is mid-frame), sever live connections, and join the
+        worker threads. Idempotent; safe from any thread."""
+        self._shutdown.set()
+        with self._lock:
+            self._cond.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        me = threading.current_thread()
+        deadline = time.time() + join_timeout
+        for t in list(self._threads):
+            if t is me or not t.is_alive():
+                continue
+            t.join(timeout=max(0.0, deadline - time.time()))
+
+
+class _RetryableRPC(Exception):
+    """Transport-level failure worth a reconnect-and-reissue."""
+
+
+class _RPCTimeout(_RetryableRPC):
+    pass
+
+
+class _RPCConnLost(_RetryableRPC):
+    pass
 
 
 class PSClient:
     """One persistent connection per (endpoint, trainer) —
-    grpc_client.cc keeps channels the same way. A dead cached socket
-    reconnects once before failing (server restarts reuse endpoints)."""
+    grpc_client.cc keeps channels the same way. Every call retries
+    with bounded exponential backoff + jitter on timeout/EOF/conn loss
+    (``PADDLE_PS_RPC_RETRIES``, default 3); the ``(cid, round, seq)``
+    dedup token makes the resend of a non-idempotent rpc
+    (send_grad/barriers) safe — the server executes it exactly once."""
 
     _clients: Dict[tuple, "PSClient"] = {}
     _lock = threading.Lock()
 
     def __init__(self, endpoint: str, trainer_id: int = 0,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 auto_heartbeat: bool = True):
         self._endpoint = endpoint
         self._trainer_id = trainer_id
+        # auto-arm the background heartbeater when the server turns
+        # out to be eviction-armed (its responses advertise
+        # evict_after). Off for the heartbeater's own inner client.
+        self._auto_heartbeat = bool(auto_heartbeat)
         self._timeout = timeout if timeout is not None else float(
             os.environ.get("PADDLE_PS_CONNECT_TIMEOUT", "15"))
-        # per-RPC read deadline: must exceed the server round timeout
-        # so only a dead/hung server trips it
+        # per-ATTEMPT read deadline: must exceed the server round
+        # timeout so only a dead/hung server trips it
         self._rpc_deadline = float(
             os.environ.get("PADDLE_PS_RPC_DEADLINE",
                            str(_ROUND_TIMEOUT + 30.0)))
+        self._max_retries = int(
+            os.environ.get("PADDLE_PS_RPC_RETRIES", "3"))
+        self._backoff_base = float(
+            os.environ.get("PADDLE_PS_RPC_BACKOFF_MS", "50")) / 1e3
+        self._backoff_cap = float(
+            os.environ.get("PADDLE_PS_RPC_BACKOFF_CAP_MS", "2000")) / 1e3
         self._io_lock = threading.Lock()
         self._seq = 0  # per-client sequence: lets the server dedupe the
         # reconnect-resend in _call (send_grad/barriers are not
         # idempotent without it). The random client nonce scopes seq so
         # a RESTARTED trainer's fresh seq=1 never matches a stale cache
         # entry from its previous incarnation.
+        self._round = 0  # completed send_barriers (the dedup token's
+        # round component: (cid, round, seq))
         self._cid = os.urandom(8).hex()
+        self._jitter = random.Random(int.from_bytes(os.urandom(4),
+                                                    "little"))
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self.evicted_peers: set = set()
         self._sock = self._connect()
 
     def _connect(self) -> socket.socket:
@@ -428,9 +739,9 @@ class PSClient:
                 # a functioning server always replies within
                 # _ROUND_TIMEOUT (slow barriers get an error reply), so
                 # a longer client deadline only fires when the server
-                # is dead/hung mid-round — failing fast instead of
-                # hanging the trainer's sync send loop forever
-                # (reference grpc_client.cc deadline+retry semantics)
+                # is dead/hung mid-round — failing fast (then retrying
+                # boundedly) instead of hanging the trainer's sync send
+                # loop forever (grpc_client.cc deadline+retry).
                 sock.settimeout(self._rpc_deadline)
                 return sock
             except OSError as e:
@@ -451,17 +762,124 @@ class PSClient:
             if c is None:
                 c = cls(endpoint, trainer_id)
                 cls._clients[key] = c
+                hb_ms = os.environ.get("PADDLE_PS_HEARTBEAT_MS")
+                if hb_ms:
+                    c.start_heartbeat(float(hb_ms) / 1e3)
             return c
 
     @classmethod
     def reset(cls):
         with cls._lock:
             for c in cls._clients.values():
-                try:
-                    c._sock.close()
-                except OSError:
-                    pass
+                c.close()
             cls._clients.clear()
+
+    def close(self) -> None:
+        self.stop_heartbeat()
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+
+    # -- background heartbeat (keeps this trainer alive in the server's
+    # monitor while the MAIN connection is blocked in a barrier) ---------
+
+    def start_heartbeat(self, interval_s: float = 1.0) -> None:
+        """Ping the server every ``interval_s`` from a dedicated
+        connection; surfaces peer evictions (``evicted_peers``) with a
+        log line so a surviving trainer knows why its barrier suddenly
+        completed. Env ``PADDLE_PS_HEARTBEAT_MS`` auto-arms this for
+        ``for_endpoint`` clients."""
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return
+        self._hb_stop.clear()
+
+        def loop():
+            hb = None
+            while not self._hb_stop.wait(interval_s):
+                try:
+                    if hb is None:
+                        hb = PSClient(self._endpoint,
+                                      trainer_id=self._trainer_id,
+                                      auto_heartbeat=False)
+                    resp = hb.heartbeat_full()
+                    evicted = {int(t) for t in resp.get("evicted", [])}
+                    new = evicted - self.evicted_peers
+                    self.evicted_peers |= evicted
+                    for t in sorted(new):
+                        print("[ps_rpc] pserver %s evicted trainer %d; "
+                              "continuing with effective fanin %s"
+                              % (self._endpoint, t,
+                                 resp.get("effective_fanin")),
+                              file=sys.stderr, flush=True)
+                except Exception:
+                    # best-effort: a failed ping must never kill the
+                    # trainer; the next tick retries (fresh connection)
+                    if hb is not None:
+                        hb.close()
+                    hb = None
+            if hb is not None:
+                hb.close()
+
+        self._hb_thread = threading.Thread(
+            target=loop, name="ps-heartbeat-%d" % self._trainer_id,
+            daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        self._hb_stop.set()
+
+    # -- request path -----------------------------------------------------
+
+    def _attempt(self, msg: dict, raw: bytes):
+        """One send + seq-matched receive on the cached socket; raises
+        a _RetryableRPC on timeout/EOF/conn loss after dropping the
+        socket (it may hold a late/partial reply — reusing it would
+        desync framing or hand the NEXT call the OLD response)."""
+        if self._sock is None:
+            self._sock = self._connect()
+        deadline = time.time() + self._rpc_deadline
+        try:
+            _send_msg(self._sock, msg, raw)
+            while True:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise socket.timeout("rpc deadline")
+                self._sock.settimeout(remaining)
+                got = _recv_msg(self._sock)
+                if got is None:
+                    raise _RPCConnLost(
+                        "pserver %s closed the connection"
+                        % self._endpoint)
+                resp, resp_raw = got
+                rseq = resp.get("seq") if isinstance(resp, dict) else None
+                if rseq is not None and rseq != msg["seq"]:
+                    continue  # stale reply from a dup'd earlier frame
+                return resp, resp_raw
+        except socket.timeout:
+            self._drop_sock()
+            _counter("rpc.timeouts").inc()
+            raise _RPCTimeout(
+                "pserver %s did not reply within the %.0fs RPC deadline "
+                "(kind=%s)" % (self._endpoint, self._rpc_deadline,
+                               msg.get("kind"))) from None
+        except _RPCConnLost:
+            self._drop_sock()
+            raise
+        except OSError as e:
+            self._drop_sock()
+            raise _RPCConnLost("pserver %s connection failed: %s"
+                               % (self._endpoint, e)) from e
+
+    def _drop_sock(self) -> None:
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
 
     def _call(self, msg: dict, raw: bytes = b""):
         msg.setdefault("trainer_id", self._trainer_id)
@@ -469,45 +887,48 @@ class PSClient:
             self._seq += 1
             msg["seq"] = self._seq
             msg["cid"] = self._cid
-            def _deadline_exceeded(note=""):
-                # the timed-out socket may hold a late/partial reply —
-                # reusing it would desync framing or hand the NEXT call
-                # the OLD response; drop it so the next call reconnects
+            msg["round"] = self._round
+            attempts = 0
+            delay = self._backoff_base
+            last_err: Optional[Exception] = None
+            while True:
                 try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
-                raise RuntimeError(
-                    "pserver %s did not reply within the %.0fs RPC "
-                    "deadline%s (kind=%s) — the server is dead or "
-                    "hung; raise PADDLE_PS_RPC_DEADLINE if rounds "
-                    "legitimately run longer"
-                    % (self._endpoint, self._rpc_deadline, note,
-                       msg.get("kind")))
-
-            if self._sock is None:   # dropped by a prior deadline trip
-                self._sock = self._connect()
-            try:
-                _send_msg(self._sock, msg, raw)
-                got = _recv_msg(self._sock)
-            except socket.timeout:
-                _deadline_exceeded()
-            except OSError:
-                got = None
-            if got is None:
-                # stale cached socket (server restarted): one reconnect
-                self._sock.close()
-                self._sock = self._connect()
-                try:
-                    _send_msg(self._sock, msg, raw)
-                    got = _recv_msg(self._sock)
-                except socket.timeout:
-                    _deadline_exceeded(" after reconnect")
-        if got is None:
-            raise RuntimeError("pserver %s closed the connection"
-                               % self._endpoint)
-        resp, resp_raw = got
+                    resp, resp_raw = self._attempt(msg, raw)
+                    break
+                except _RetryableRPC as e:
+                    attempts += 1
+                    last_err = e
+                    if attempts > self._max_retries:
+                        raise RuntimeError(
+                            "%s — gave up after %d attempt(s); the "
+                            "server is dead or hung (raise "
+                            "PADDLE_PS_RPC_DEADLINE / "
+                            "PADDLE_PS_RPC_RETRIES if rounds "
+                            "legitimately run longer)"
+                            % (e, attempts)) from e
+                    _counter("rpc.retries").inc()
+                    # exponential backoff + jitter (grpc_client.cc
+                    # retry semantics); the dedup token makes the
+                    # reissue safe even for non-idempotent kinds
+                    time.sleep(delay * (0.5 + self._jitter.random()))
+                    delay = min(delay * 2.0, self._backoff_cap)
+                except RuntimeError as e:
+                    # the RECONNECT inside a retry failed (server gone
+                    # or its backlog full of our own dead sockets):
+                    # keep the error that started the retrying — "why
+                    # it failed" beats "why the retry failed"
+                    if last_err is not None:
+                        raise RuntimeError(
+                            "%s (while reconnecting after: %s)"
+                            % (e, last_err)) from e
+                    raise
+        ea = resp.get("evict_after") if isinstance(resp, dict) else None
+        if ea and self._auto_heartbeat and (
+                self._hb_thread is None or not self._hb_thread.is_alive()):
+            # the server evicts silent trainers: keep this one alive
+            # while its main socket blocks in a barrier, even when the
+            # operator forgot PADDLE_PS_HEARTBEAT_MS
+            self.start_heartbeat(max(0.05, float(ea) / 4.0))
         if not resp.get("ok"):
             raise RuntimeError("pserver error: %s" % resp.get("error"))
         return resp, resp_raw
@@ -519,6 +940,7 @@ class PSClient:
 
     def send_barrier(self) -> None:
         self._call({"kind": "send_barrier"})
+        self._round += 1
 
     def get_param(self, name: str) -> np.ndarray:
         resp, raw = self._call({"kind": "get_param", "name": name})
@@ -556,6 +978,13 @@ class PSClient:
     def heartbeat(self) -> Dict[int, float]:
         resp, _ = self._call({"kind": "heartbeat"})
         return {int(k): v for k, v in resp["status"].items()}
+
+    def heartbeat_full(self) -> dict:
+        """Full heartbeat response: per-trainer ages plus ``evicted``
+        / ``fanin`` / ``effective_fanin`` (the log-and-continue signal
+        for survivors)."""
+        resp, _ = self._call({"kind": "heartbeat"})
+        return resp
 
     def shutdown_server(self) -> None:
         self._call({"kind": "shutdown"})

@@ -2,13 +2,17 @@
 
 Parity: /root/reference/python/paddle/fluid/dygraph/checkpoint.py:33,96.
 State dicts serialize to .npz (".pdparams"/".pdopt" naming kept).
+Writes are atomic (tmp + fsync + rename, paddle_tpu/checkpoint.py):
+a crash mid-save leaves the previous state dict, never a torn one.
 """
 from __future__ import annotations
 
+import io
 import os
 
 import numpy as np
 
+from ..checkpoint import atomic_write_bytes
 from .varbase import VarBase
 
 __all__ = ["save_dygraph", "load_dygraph"]
@@ -24,8 +28,9 @@ def save_dygraph(state_dict, model_path):
     arrays = {}
     for k, v in state_dict.items():
         arrays[k] = v.numpy() if isinstance(v, VarBase) else np.asarray(v)
-    os.makedirs(os.path.dirname(os.path.abspath(model_path)), exist_ok=True)
-    np.savez(model_path + suffix + ".npz", **arrays)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    atomic_write_bytes(model_path + suffix + ".npz", buf.getvalue())
 
 
 def load_dygraph(model_path):

@@ -9,16 +9,28 @@ Format: persistables serialize via numpy .npz (one file per save, the
 reference's save_combine path); inference models serialize the Program as
 JSON (`__model__.json`) + params .npz — the TPU-native stand-in for the
 protobuf `__model__`.
+
+Durability (see ``paddle_tpu/checkpoint.py``): every file this module
+writes lands via tmp + fsync + rename, so a crash mid-save leaves the
+previous version intact, never a truncated hybrid. Dir-level saves
+(``save_vars`` / ``save_persistables``) also write a sha256 manifest;
+the load side verifies it when present and raises the typed
+``CheckpointCorrupt`` on mismatch instead of a numpy parse error.
 """
 from __future__ import annotations
 
+import io as _pyio
 import json
 import os
+import zipfile
+import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from . import framework
+from .checkpoint import (CheckpointCorrupt, atomic_write_bytes,
+                         verify_manifest, write_manifest)
 from .core import global_scope
 from .core.tensor import LoDTensor
 
@@ -33,6 +45,7 @@ __all__ = [
     "load_inference_model",
     "save",
     "load",
+    "CheckpointCorrupt",
 ]
 
 
@@ -49,6 +62,9 @@ def is_parameter(var):
 
 
 def _save_var_dict(names: List[str], scope, path: str):
+    """Serialize named scope vars to ``path`` as .npz, ATOMICALLY: the
+    bytes are staged in memory and land via tmp + fsync + rename, so a
+    crash mid-save can never expose a truncated archive."""
     arrays = {}
     for n in names:
         var = scope.find_var(n)
@@ -57,17 +73,36 @@ def _save_var_dict(names: List[str], scope, path: str):
         h = var.raw()
         if isinstance(h, LoDTensor) and h._is_initialized():
             arrays[n] = h.numpy()
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    np.savez(path, **arrays)
+    if not path.endswith(".npz"):
+        path = path + ".npz"  # np.savez appends it; rename must agree
+    buf = _pyio.BytesIO()
+    np.savez(buf, **arrays)
+    atomic_write_bytes(path, buf.getvalue())
 
 
 def _load_var_dict(path: str, scope):
     if not path.endswith(".npz") and os.path.exists(path + ".npz"):
         path = path + ".npz"
-    data = np.load(path, allow_pickle=False)
-    for n in data.files:
-        scope.var(n).get_tensor().set(data[n])
-    return set(data.files)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            "no parameter file %r in %r — expected an .npz written by "
+            "save_vars/save_persistables (was the model saved with a "
+            "different `filename`?)"
+            % (os.path.basename(path), os.path.dirname(path) or "."))
+    try:
+        data = np.load(path, allow_pickle=False)
+        loaded = {n: data[n] for n in data.files}
+    except (ValueError, OSError, EOFError, zipfile.BadZipFile,
+            zlib.error) as e:
+        # BadZipFile/zlib.error are what np.load actually raises for a
+        # truncated/damaged archive — neither subclasses OSError
+        raise CheckpointCorrupt(
+            "parameter file %r is unreadable (%s: %s) — the save was "
+            "interrupted or the file was damaged; fall back to an "
+            "older checkpoint" % (path, type(e).__name__, e)) from e
+    for n, arr in loaded.items():
+        scope.var(n).get_tensor().set(arr)
+    return set(loaded)
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
@@ -78,6 +113,11 @@ def save_vars(executor, dirname, main_program=None, vars=None,
     names = [v.name if isinstance(v, framework.Variable) else v for v in vars]
     path = os.path.join(dirname, filename or "__params__.npz")
     _save_var_dict(names, global_scope(), path)
+    # manifest covers ONLY the file this save wrote — hashing the whole
+    # dir would pin unrelated (possibly mutable) files into it
+    fn = os.path.basename(path)
+    write_manifest(dirname,
+                   files=[fn if fn.endswith(".npz") else fn + ".npz"])
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -92,6 +132,11 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 
 def load_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
+    # integrity first: a dir saved by this build carries a sha256
+    # manifest; verify it BEFORE deserializing so corruption surfaces
+    # as the typed CheckpointCorrupt, not a numpy parse error.
+    # Pre-manifest dirs (required=False) stay loadable.
+    verify_manifest(dirname, required=False)
     path = os.path.join(dirname, filename or "__params__.npz")
     loaded = _load_var_dict(path, global_scope())
     main_program = main_program or framework.default_main_program()
@@ -225,9 +270,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         # streams (core/proto_format.py)
         from .core import proto_format
 
-        with open(os.path.join(dirname, model_filename), "wb") as f:
-            f.write(proto_format.program_to_proto_bytes(
+        atomic_write_bytes(
+            os.path.join(dirname, model_filename),
+            proto_format.program_to_proto_bytes(
                 pruned, feeded_var_names, fetch_names))
+        written = [model_filename]
         if not program_only:
             names = sorted(v.name for v in pruned.list_vars()
                            if is_persistable(v))
@@ -251,28 +298,46 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                         "var(s) %s are not initialized in the scope; "
                         "run the startup program (or load params) "
                         "before saving" % ", ".join(missing))
-                proto_format.save_combine(
-                    arrays, os.path.join(dirname, params_filename))
+                # staged in memory so the file lands atomically
+                atomic_write_bytes(
+                    os.path.join(dirname, params_filename),
+                    proto_format.save_combine_bytes(arrays))
+                written.append(params_filename)
             else:
                 # reference default: one tensor-stream file per var
                 for n, arr in arrays:
-                    with open(os.path.join(dirname, n), "wb") as f:
-                        f.write(proto_format.serialize_lod_tensor(arr))
+                    atomic_write_bytes(
+                        os.path.join(dirname, n),
+                        proto_format.serialize_lod_tensor(arr))
+                    written.append(n)
+        write_manifest(dirname, files=written)
         return fetch_names
     model = _serialize_program(pruned)
     model["feed_names"] = list(feeded_var_names)
     model["fetch_names"] = fetch_names
-    with open(os.path.join(dirname, model_filename or "__model__.json"), "w") as f:
-        json.dump(model, f)
+    atomic_write_bytes(
+        os.path.join(dirname, model_filename or "__model__.json"),
+        json.dumps(model).encode("utf-8"))
+    written = [model_filename or "__model__.json"]
     if not program_only:
         param_names = [v.name for v in pruned.list_vars() if is_persistable(v)]
+        pfile = params_filename or "__params__.npz"
+        if not pfile.endswith(".npz"):
+            pfile += ".npz"  # _save_var_dict appends it via np.savez
         _save_var_dict(param_names, global_scope(),
-                       os.path.join(dirname, params_filename or "__params__.npz"))
+                       os.path.join(dirname, pfile))
+        written.append(pfile)
+    write_manifest(dirname, files=written)
     return fetch_names
 
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
+    if not os.path.isdir(dirname):
+        raise FileNotFoundError(
+            "model dir %r does not exist — save_inference_model writes "
+            "a directory, pass that directory (not a file inside it)"
+            % dirname)
     json_path = os.path.join(dirname, model_filename or "__model__.json")
     if model_filename is None and not os.path.exists(json_path) \
             and os.path.exists(os.path.join(dirname, "__model__")):
@@ -280,12 +345,29 @@ def load_inference_model(dirname, executor, model_filename=None,
     if model_filename is not None and not model_filename.endswith(".json"):
         return _load_inference_model_proto(dirname, model_filename,
                                            params_filename)
+    if not os.path.exists(json_path):
+        raise FileNotFoundError(
+            "no model file %r (or '__model__') in %r — dir contains %s"
+            % (os.path.basename(json_path), dirname,
+               sorted(os.listdir(dirname))[:10] or "nothing"))
+    verify_manifest(dirname, required=False)
     with open(json_path) as f:
         model = json.load(f)
     program = _deserialize_program(model)
     params_path = os.path.join(dirname, params_filename or "__params__.npz")
+    if not os.path.exists(params_path) and \
+            os.path.exists(params_path + ".npz"):
+        params_path += ".npz"  # the save side appends it via np.savez
     if os.path.exists(params_path):
         _load_var_dict(params_path, global_scope())
+    elif params_filename is not None:
+        # an EXPLICITLY named params file that is absent is an error;
+        # only the default name may be legitimately missing
+        # (program_only saves)
+        raise FileNotFoundError(
+            "no parameter file %r in %r — dir contains %s"
+            % (params_filename, dirname,
+               sorted(os.listdir(dirname))[:10]))
     feed_names = model.get("feed_names", [])
     fetch_names = model.get("fetch_names", [])
     fetch_vars = [program.global_block().var(n) for n in fetch_names]
@@ -302,7 +384,14 @@ def _load_inference_model_proto(dirname, model_filename, params_filename):
     from .core import proto_format
     from .core.tensor import LoDTensor
 
-    with open(os.path.join(dirname, model_filename), "rb") as f:
+    model_path = os.path.join(dirname, model_filename)
+    if not os.path.exists(model_path):
+        raise FileNotFoundError(
+            "no model file %r in %r — dir contains %s"
+            % (model_filename, dirname,
+               sorted(os.listdir(dirname))[:10] or "nothing"))
+    verify_manifest(dirname, required=False)
+    with open(model_path, "rb") as f:
         data = f.read()
     program, feed_names, fetch_names = \
         proto_format.proto_bytes_to_program(data)
@@ -321,9 +410,11 @@ def _load_inference_model_proto(dirname, model_filename, params_filename):
         missing = [n for n in names
                    if not os.path.exists(os.path.join(dirname, n))]
         if missing:
-            raise RuntimeError(
-                "model dir %r is missing parameter files: %s"
-                % (dirname, ", ".join(missing[:10])))
+            raise FileNotFoundError(
+                "model dir %r is missing parameter file(s): %s — the "
+                "program lists %d persistables; was the model saved "
+                "with a combined params_filename?"
+                % (dirname, ", ".join(missing[:10]), len(names)))
         for n in names:
             arr, lod, _ = proto_format.parse_lod_tensor(
                 open(os.path.join(dirname, n), "rb").read())
@@ -344,8 +435,8 @@ def save(program, model_path):
            if is_persistable(v) and not is_parameter(v)]
     _save_var_dict(params, global_scope(), model_path + ".pdparams.npz")
     _save_var_dict(opt, global_scope(), model_path + ".pdopt.npz")
-    with open(model_path + ".pdmodel.json", "w") as f:
-        json.dump(_serialize_program(program), f)
+    atomic_write_bytes(model_path + ".pdmodel.json",
+                       json.dumps(_serialize_program(program)).encode())
 
 
 def load(program, model_path, executor=None, var_list=None):

@@ -34,7 +34,17 @@ Metric families (see README "Runtime observability"):
 ``memory.*_bytes``                     gauge: live/peak/limit device bytes
 ``serving.*``                          serving engine (always-on; see
                                        ``paddle_tpu/serving/metrics.py``)
+``rpc.retries`` / ``rpc.timeouts``     counter: PS client recovery events
+``ps.evictions`` / ``ps.readmissions`` counter: heartbeat-monitor actions
+``fault.injected{side=,kind=}``        counter: injected RPC-frame faults
+``checkpoint.save_ms``                 histogram: atomic checkpoint commit
+``checkpoint.bytes``                   counter: checkpointed payload bytes
+``checkpoint.corrupt``                 counter: rotations failing sha256
 =====================================  ======================================
+
+The ``rpc.* / ps.* / fault.* / checkpoint.*`` families (like
+``serving.*``) record unconditionally — recovery events are rare, and
+CI asserts on them without needing ``PADDLE_TPU_METRICS``.
 
 Export: ``dump()`` -> JSON-able dict, ``dump(fmt="prometheus")`` ->
 text exposition format, ``chrome_trace()`` / ``write_chrome_trace()``

@@ -186,6 +186,18 @@ class ServingEngine:
     def running(self) -> bool:
         return self._started and not self._stopping
 
+    def health(self) -> str:
+        """Readiness for an external supervisor: ``"ok"`` while
+        accepting work, ``"draining"`` from the moment ``stop()`` flips
+        readiness until the workers have joined (stop routing NOW, but
+        in-flight requests are still finishing), ``"stopped"`` after.
+        """
+        if self.running:
+            return "ok"
+        if self._stopping and not self._stopped:
+            return "draining"
+        return "stopped"
+
     # -- request path ------------------------------------------------------
 
     def submit(self, feed: Dict[str, np.ndarray],

@@ -62,10 +62,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — stdlib naming
         engine = self.server.engine
         if self.path == "/healthz":
-            if engine.running:
+            health = engine.health()
+            if health == "ok":
                 self._reply_json(200, {"status": "ok"})
             else:
-                self._reply_json(503, {"status": "stopping"})
+                # "draining": stop() flipped readiness but in-flight
+                # requests are still finishing — the supervisor must
+                # stop routing now and NOT kill the process yet
+                self._reply_json(503, {"status": health})
         elif self.path == "/metrics":
             self._reply(200, _obs.dump_prometheus().encode(),
                         "text/plain; version=0.0.4")

@@ -1,0 +1,150 @@
+"""Worker for the fault-tolerance multiprocess tests + CI smoke.
+
+Role from FT_ROLE:
+
+- ``pserver`` — serve a single dense param "w" (4 floats, SGD lr 0.1)
+  behind the RunSyncLoop round protocol with heartbeat eviction armed
+  (PADDLE_PS_EVICT_AFTER); blocks until a shutdown rpc.
+- ``trainer`` — FT_ROUNDS sync rounds of deterministic grads against
+  the live server, checkpointing after every completed round via
+  CheckpointManager (atomic + rotated), resuming from the newest valid
+  checkpoint on restart. FT_DIE_AT_ROUND + FT_DIE_RANK make one rank
+  SIGKILL itself mid-round (after send_grad, before the barrier) on
+  its first incarnation — the supervised-relaunch scenario.
+
+Env contract: PSERVER_ENDPOINT, PADDLE_TRAINER_ID (the launcher sets
+it), PADDLE_RESTART_COUNT (launcher, on relaunch), FT_OUT (result JSON
+path, trainer), FT_CKPT_ROOT (checkpoint root, trainer).
+
+The pserver side needs no framework program: PSServer only asks its
+executor for _read_var/_write_var/run_block, so a dict-scope shim
+keeps worker startup lean.
+"""
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+
+from paddle_tpu.checkpoint import CheckpointManager
+from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+LR = 0.1
+DIM = 4
+
+
+class MiniScope(dict):
+    def local_var_names(self):
+        return list(self)
+
+
+class MiniExec:
+    """The minimal executor surface PSServer drives."""
+
+    def _read_var(self, scope, name):
+        return scope.get(name)
+
+    def _write_var(self, scope, name, val):
+        scope[name] = np.asarray(val)
+
+    def run_block(self, block, scope):
+        block(scope)
+
+
+def _sgd_block(scope):
+    scope["w"] = scope["w"] - LR * scope["w@GRAD"]
+
+
+def grad_for(tid: int, rnd: int) -> np.ndarray:
+    """Deterministic per-(trainer, round) gradient — survivors and
+    oracles recompute the exact same values."""
+    return np.full(DIM, (tid + 1) * 0.01 * rnd, dtype=np.float32)
+
+
+def run_pserver():
+    endpoint = os.environ["PSERVER_ENDPOINT"]
+    fanin = int(os.environ.get("PADDLE_TRAINERS_NUM", "2"))
+    scope = MiniScope()
+    scope["w"] = np.zeros(DIM, dtype=np.float32)
+    server = PSServer(endpoint, MiniExec(), scope,
+                      {"w@GRAD": _sgd_block}, fanin=fanin,
+                      sync_mode=True)
+    server.serve_forever()
+    server.stop()
+
+
+def run_trainer():
+    endpoint = os.environ["PSERVER_ENDPOINT"]
+    tid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    rounds = int(os.environ.get("FT_ROUNDS", "6"))
+    restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    die_round = int(os.environ.get("FT_DIE_AT_ROUND", "0"))
+    die_rank = int(os.environ.get("FT_DIE_RANK", "-1"))
+    # per-rank result file: the launcher gives every rank the same env
+    out_path = "%s.t%d.json" % (os.environ["FT_OUT"], tid)
+    ckpt_root = os.environ.get("FT_CKPT_ROOT", "")
+
+    mgr = None
+    start = 1
+    resumed_from = None
+    if ckpt_root:
+        mgr = CheckpointManager(os.path.join(ckpt_root, "t%d" % tid),
+                                keep=3)
+        state = {}
+
+        def _load(d):
+            data = np.load(os.path.join(d, "state.npz"))
+            state["w"] = data["w"]
+
+        step = mgr.load_latest(_load)
+        if step is not None:
+            resumed_from = step
+            start = step + 1
+            print("[trainer %d] resumed from checkpoint round %d"
+                  % (tid, step), file=sys.stderr, flush=True)
+
+    client = PSClient.for_endpoint(endpoint, trainer_id=tid)
+    w = None
+    for rnd in range(start, rounds + 1):
+        client.send_grad("w@GRAD", grad_for(tid, rnd))
+        if restart == 0 and tid == die_rank and rnd == die_round:
+            # mid-round death: grad in, barrier never sent — the
+            # worst spot, the server is left waiting on this rank
+            os.kill(os.getpid(), signal.SIGKILL)
+        client.send_barrier()
+        w = client.get_param("w")
+        client.fetch_barrier()
+        if mgr is not None:
+            def _write(d, _w=w, _r=rnd):
+                buf_path = os.path.join(d, "state.npz")
+                np.savez(buf_path, w=_w, round=_r)
+            mgr.save(rnd, _write)
+
+    hb = client.heartbeat_full()
+    with open(out_path, "w") as f:
+        json.dump({
+            "tid": tid,
+            "rounds_done": rounds - start + 1,
+            "resumed_from": resumed_from,
+            "restart": restart,
+            "w": np.asarray(w).tolist(),
+            "evicted_peers": sorted(client.evicted_peers
+                                    | set(hb.get("evicted", []))),
+            "evictions": hb.get("evictions"),
+            "readmissions": hb.get("readmissions"),
+        }, f)
+
+
+def main():
+    role = os.environ["FT_ROLE"]
+    if role == "pserver":
+        run_pserver()
+    elif role == "trainer":
+        run_trainer()
+    else:
+        raise SystemExit("unknown FT_ROLE %r" % role)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,671 @@
+"""Fault-tolerant distributed training (ISSUE 3).
+
+Covers: the deterministic fault-injection shim at the RPC frame
+boundary; client retry + server dedup keeping gradient application
+exactly-once under injected drops/dups (bit-for-bit parity with the
+clean run); heartbeat eviction unblocking survivors after a SIGKILL;
+supervised relaunch resuming from the newest valid checkpoint; atomic
+checkpoint dirs (manifest, rotation, corrupt-shard fallback); typed
+load errors; PS server port hygiene on stop(); serving /healthz
+draining."""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FT_WORKER = os.path.join(REPO, "tests", "dist_worker_ft.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class MiniScope(dict):
+    def local_var_names(self):
+        return list(self)
+
+
+class MiniExec:
+    def _read_var(self, scope, name):
+        return scope.get(name)
+
+    def _write_var(self, scope, name, val):
+        scope[name] = np.asarray(val)
+
+    def run_block(self, block, scope):
+        block(scope)
+
+
+def _sgd_block(scope, lr=0.1):
+    scope["w"] = scope["w"] - lr * scope["w@GRAD"]
+
+
+def _grad(tid, rnd, dim=4):
+    return np.full(dim, (tid + 1) * 0.01 * rnd, dtype=np.float32)
+
+
+# -- fault injector ---------------------------------------------------------
+
+
+def test_fault_plan_grammar():
+    from paddle_tpu.distributed.fault import FaultRule, parse_plan
+
+    rules = parse_plan("send.drop:0.05, recv.delay:0.1:30 ,any.dup:1")
+    assert [(r.side, r.kind, r.prob) for r in rules] == [
+        ("send", "drop", 0.05), ("recv", "delay", 0.1),
+        ("any", "dup", 1.0)]
+    assert rules[1].param == 30
+    with pytest.raises(ValueError, match="side"):
+        parse_plan("up.drop:0.1")
+    with pytest.raises(ValueError, match="kind"):
+        parse_plan("send.explode:0.1")
+    with pytest.raises(ValueError, match="recv-side"):
+        FaultRule("recv", "dup", 0.5)
+    with pytest.raises(ValueError, match="probability"):
+        parse_plan("send.drop:1.5")
+    with pytest.raises(ValueError, match="bad PADDLE_TPU_FAULTS"):
+        parse_plan("send.drop:abc")
+
+
+class _FakeSock:
+    def __init__(self):
+        self.sent = []
+        self.closed = False
+
+    def sendall(self, b):
+        self.sent.append(bytes(b))
+
+    def shutdown(self, how):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+def test_fault_injector_seeded_determinism():
+    from paddle_tpu.distributed.fault import (FaultInjected,
+                                              FaultInjector, parse_plan)
+
+    def run(seed):
+        inj = FaultInjector(parse_plan("send.drop:0.3,send.dup:0.3"),
+                            seed=seed)
+        events = []
+        for i in range(50):
+            s = _FakeSock()
+            try:
+                sent = inj.on_send(s, b"frame%d" % i)
+                events.append("dup" if len(s.sent) == 2
+                              else ("sent" if sent else "drop"))
+            except FaultInjected:
+                events.append("sever")
+        return events
+
+    a, b = run(7), run(7)
+    assert a == b, "same seed must replay the same fault pattern"
+    assert set(a) & {"drop", "dup"}, "plan at 30% must actually fire"
+    assert run(8) != a, "different seed should diverge"
+
+
+def test_fault_injector_env_armed(monkeypatch):
+    from paddle_tpu.distributed import fault
+
+    monkeypatch.setenv("PADDLE_TPU_FAULTS", "send.drop:1.0")
+    fault.reset_injector()
+    try:
+        inj = fault.get_injector()
+        s = _FakeSock()
+        assert inj.on_send(s, b"x") is False and s.sent == []
+        monkeypatch.delenv("PADDLE_TPU_FAULTS")
+        fault.reset_injector()
+        assert fault.get_injector() is None
+    finally:
+        fault.reset_injector()
+
+
+# -- exactly-once under injected drop/dup ----------------------------------
+
+
+def test_ps_training_bitwise_parity_under_drop_dup(monkeypatch):
+    """5% drops + 5% dups on every RPC frame: 2-trainer sync training
+    completes via retry + (cid, round, seq) dedup, and the final param
+    matches the fault-free computation BIT-FOR-BIT — each grad summed
+    exactly once, by token, not by luck."""
+    from paddle_tpu.distributed import fault
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+    rounds, dim = 4, 4
+    # fault-free oracle: same float32 ops the server applies
+    w_clean = np.zeros(dim, dtype=np.float32)
+    for rnd in range(1, rounds + 1):
+        scope = {"w": w_clean, "w@GRAD": _grad(0, rnd, dim)
+                 + _grad(1, rnd, dim)}
+        _sgd_block(scope)
+        w_clean = scope["w"]
+
+    monkeypatch.setenv("PADDLE_TPU_FAULTS", "send.drop:0.05,send.dup:0.05")
+    monkeypatch.setenv("PADDLE_TPU_FAULT_SEED", "42")
+    monkeypatch.setenv("PADDLE_PS_RPC_DEADLINE", "1.0")
+    monkeypatch.setenv("PADDLE_PS_RPC_RETRIES", "12")
+    monkeypatch.setenv("PADDLE_PS_RPC_BACKOFF_MS", "20")
+    fault.reset_injector()
+    scope = MiniScope()
+    scope["w"] = np.zeros(dim, dtype=np.float32)
+    endpoint = "127.0.0.1:%d" % _free_port()
+    server = PSServer(endpoint, MiniExec(), scope,
+                      {"w@GRAD": _sgd_block}, fanin=2)
+    server.start_background()
+    errors = []
+
+    def trainer(tid):
+        try:
+            c = PSClient(endpoint, trainer_id=tid)
+            for rnd in range(1, rounds + 1):
+                c.send_grad("w@GRAD", _grad(tid, rnd, dim))
+                c.send_barrier()
+                c.get_param("w")
+                c.fetch_barrier()
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errors.append((tid, e))
+
+    try:
+        ts = [threading.Thread(target=trainer, args=(t,))
+              for t in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=90)
+        assert not any(t.is_alive() for t in ts), \
+            "training deadlocked under fault injection"
+        assert not errors, errors
+        np.testing.assert_array_equal(np.asarray(scope["w"]), w_clean)
+    finally:
+        monkeypatch.delenv("PADDLE_TPU_FAULTS")
+        fault.reset_injector()
+        server.stop()
+
+
+# -- eviction + re-admission (in-process) ----------------------------------
+
+
+def test_heartbeat_eviction_and_readmission():
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, dtype=np.float32)
+    endpoint = "127.0.0.1:%d" % _free_port()
+    server = PSServer(endpoint, MiniExec(), scope, {}, fanin=2,
+                      evict_after=0.6)
+    server.start_background()
+    ev0 = obs.counter("ps.evictions").value
+    re0 = obs.counter("ps.readmissions").value
+    try:
+        c0 = PSClient(endpoint, trainer_id=0)
+        c1 = PSClient(endpoint, trainer_id=1)
+        c0.send_grad("w@GRAD", np.ones(4, "f4"))
+        c1.send_grad("w@GRAD", np.ones(4, "f4"))
+        c1.close()  # trainer 1 goes silent (simulated death)
+        deadline = time.time() + 8
+        resp = {}
+        while time.time() < deadline:
+            resp = c0.heartbeat_full()  # c0 keeps itself alive
+            if 1 in resp.get("evicted", []):
+                break
+            time.sleep(0.15)
+        assert 1 in resp.get("evicted", []), resp
+        assert resp["effective_fanin"] == 1
+        assert obs.counter("ps.evictions").value - ev0 == 1
+        # the relaunched trainer TRAINING again is re-admitted
+        c1b = PSClient(endpoint, trainer_id=1)
+        c1b.send_grad("w@GRAD", np.ones(4, "f4"))
+        resp = c0.heartbeat_full()
+        assert 1 not in resp.get("evicted", [])
+        assert resp["effective_fanin"] == 2
+        assert obs.counter("ps.readmissions").value - re0 == 1
+        c0.close()
+        c1b.close()
+    finally:
+        server.stop()
+
+
+def test_barrier_completes_via_eviction():
+    """fanin=2 but only ONE live trainer: its barrier must complete in
+    ~evict_after, not hang until the round timeout."""
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, dtype=np.float32)
+    endpoint = "127.0.0.1:%d" % _free_port()
+    server = PSServer(endpoint, MiniExec(), scope,
+                      {"w@GRAD": _sgd_block}, fanin=2, evict_after=0.8)
+    server.start_background()
+    try:
+        # trainer 1 shows up once, then dies before its barrier
+        c1 = PSClient(endpoint, trainer_id=1)
+        c1.send_grad("w@GRAD", _grad(1, 1))
+        c1.close()
+        c0 = PSClient(endpoint, trainer_id=0)
+        c0.start_heartbeat(0.2)  # keeps t0 fresh while blocked
+        c0.send_grad("w@GRAD", _grad(0, 1))
+        t0 = time.time()
+        c0.send_barrier()  # blocks until t1 is evicted
+        elapsed = time.time() - t0
+        assert elapsed < 10, "eviction must beat the round timeout"
+        w = c0.get_param("w")
+        c0.fetch_barrier()
+        # the dead trainer's grad was already in: both count
+        exp = {"w": np.zeros(4, "f4"),
+               "w@GRAD": _grad(0, 1) + _grad(1, 1)}
+        _sgd_block(exp)
+        np.testing.assert_array_equal(w, exp["w"])
+        assert 1 in c0.evicted_peers or 1 in \
+            c0.heartbeat_full().get("evicted", [])
+        c0.close()
+    finally:
+        server.stop()
+
+
+def test_healthy_straggler_not_evicted_auto_heartbeat():
+    """A slow-but-alive trainer must NOT be evicted even when its step
+    takes far longer than evict_after and the operator never set
+    PADDLE_PS_HEARTBEAT_MS: the server advertises its eviction
+    deadline in every response and the client auto-arms a background
+    heartbeater off it — a partial round is never applied for a mere
+    straggler."""
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+    assert "PADDLE_PS_HEARTBEAT_MS" not in os.environ
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, dtype=np.float32)
+    endpoint = "127.0.0.1:%d" % _free_port()
+    server = PSServer(endpoint, MiniExec(), scope,
+                      {"w@GRAD": _sgd_block}, fanin=2, evict_after=0.8)
+    server.start_background()
+    errors = []
+
+    def trainer(tid, straggle):
+        try:
+            c = PSClient(endpoint, trainer_id=tid)
+            c.send_grad("w@GRAD", np.ones(4, "f4"))  # auto-arms hb
+            time.sleep(straggle)  # slow step: main socket silent
+            c.send_barrier()
+            c.get_param("w")
+            c.fetch_barrier()
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errors.append((tid, e))
+
+    try:
+        ts = [threading.Thread(target=trainer, args=(0, 0.0)),
+              threading.Thread(target=trainer, args=(1, 2.5))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in ts), "round hung"
+        assert not errors, errors
+        assert not server._evicted, \
+            "healthy straggler evicted: %s" % server._evicted
+        np.testing.assert_array_equal(
+            np.asarray(scope["w"]), np.full(4, -0.2, "f4"))
+    finally:
+        server.stop()
+
+
+def test_eviction_covers_never_connected_rank():
+    """A rank that dies BEFORE its first rpc must still be evicted:
+    the first live trainer's ping arms the staleness clock for every
+    expected rank, so the survivor's barrier completes without the
+    dead rank ever having been heard from."""
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, dtype=np.float32)
+    endpoint = "127.0.0.1:%d" % _free_port()
+    server = PSServer(endpoint, MiniExec(), scope,
+                      {"w@GRAD": _sgd_block}, fanin=2, evict_after=0.8)
+    server.start_background()
+    try:
+        c0 = PSClient(endpoint, trainer_id=0)  # rank 1 never connects
+        c0.start_heartbeat(0.2)
+        c0.send_grad("w@GRAD", _grad(0, 1))
+        t0 = time.time()
+        c0.send_barrier()
+        assert time.time() - t0 < 10
+        assert 1 in c0.heartbeat_full().get("evicted", [])
+        c0.get_param("w")
+        c0.fetch_barrier()
+        c0.close()
+    finally:
+        server.stop()
+
+
+# -- multiprocess: SIGKILL + supervised relaunch ---------------------------
+
+
+def _ft_env(**over):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PADDLE_PS_EVICT_AFTER"] = "2.0"
+    env["PADDLE_PS_HEARTBEAT_MS"] = "200"
+    env.update({k: str(v) for k, v in over.items()})
+    return env
+
+
+def test_sigkill_mid_round_survivors_finish(tmp_path):
+    """Trainer 1 SIGKILLs itself mid-round (grad sent, barrier never
+    sent). Trainer 0 must finish every round via heartbeat eviction —
+    well under the round timeout — and the server must report exactly
+    one eviction."""
+    endpoint = "127.0.0.1:%d" % _free_port()
+    ps = subprocess.Popen(
+        [sys.executable, FT_WORKER],
+        env=_ft_env(FT_ROLE="pserver", PSERVER_ENDPOINT=endpoint,
+                    PADDLE_TRAINERS_NUM=2),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    procs = []
+    try:
+        for tid in (0, 1):
+            over = dict(FT_ROLE="trainer", PSERVER_ENDPOINT=endpoint,
+                        PADDLE_TRAINERS_NUM=2, PADDLE_TRAINER_ID=tid,
+                        FT_ROUNDS=5, FT_OUT=str(tmp_path / "out"),
+                        FT_CKPT_ROOT=str(tmp_path / "ckpt"))
+            if tid == 1:
+                over.update(FT_DIE_AT_ROUND=2, FT_DIE_RANK=1)
+            procs.append(subprocess.Popen(
+                [sys.executable, FT_WORKER], env=_ft_env(**over),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        t0, t1 = procs
+        out1 = t1.communicate(timeout=120)
+        assert t1.returncode == -signal.SIGKILL, out1
+        out0 = t0.communicate(timeout=120)
+        assert t0.returncode == 0, out0[1][-3000:]
+        result = json.loads((tmp_path / "out.t0.json").read_text())
+        assert result["rounds_done"] == 5
+        assert result["evictions"] == 1, result
+        assert 1 in result["evicted_peers"], result
+    finally:
+        for p in procs + [ps]:
+            if p.poll() is None:
+                p.kill()
+        ps.communicate(timeout=10)
+
+
+def test_supervised_relaunch_resumes_from_checkpoint(tmp_path):
+    """launch.py as supervisor: rank 1 SIGKILLs itself at round 3; the
+    supervisor relaunches it, it resumes from its newest valid
+    checkpoint (round 2) and finishes; the job exits 0."""
+    endpoint = "127.0.0.1:%d" % _free_port()
+    ps = subprocess.Popen(
+        [sys.executable, FT_WORKER],
+        env=_ft_env(FT_ROLE="pserver", PSERVER_ENDPOINT=endpoint,
+                    PADDLE_TRAINERS_NUM=2),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        sup = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=2", "--max_restarts=2",
+             "--started_port=%d" % _free_port(), FT_WORKER],
+            env=_ft_env(FT_ROLE="trainer", PSERVER_ENDPOINT=endpoint,
+                        FT_ROUNDS=6, FT_DIE_AT_ROUND=3, FT_DIE_RANK=1,
+                        FT_OUT=str(tmp_path / "out"),
+                        FT_CKPT_ROOT=str(tmp_path / "ckpt")),
+            capture_output=True, text=True, timeout=240, cwd=REPO)
+        assert sup.returncode == 0, sup.stderr[-4000:]
+        assert "relaunching" in sup.stderr
+        r0 = json.loads((tmp_path / "out.t0.json").read_text())
+        r1 = json.loads((tmp_path / "out.t1.json").read_text())
+        assert r0["rounds_done"] == 6 and r0["restart"] == 0
+        assert r1["restart"] == 1, r1
+        assert r1["resumed_from"] == 2, r1
+        assert r1["rounds_done"] == 4  # rounds 3..6 after resume
+        # recovery takes one of two valid paths depending on machine
+        # load: a slow relaunch means rank 0 was unblocked by EVICTION
+        # and the relaunch was re-admitted; a fast relaunch rejoins
+        # the round before the eviction deadline and no eviction is
+        # needed. (The no-supervisor SIGKILL test above asserts the
+        # eviction path deterministically.)
+        assert r1["evictions"] >= r1["readmissions"] >= 0, r1
+        # the relaunched rank's final checkpoint is complete + verified
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt" / "t1"))
+        state = {}
+
+        def _load(d):
+            state["w"] = np.load(os.path.join(d, "state.npz"))["w"]
+
+        assert mgr.load_latest(_load) == 6
+        assert state["w"].shape == (4,)
+    finally:
+        if ps.poll() is None:
+            ps.kill()
+        ps.communicate(timeout=10)
+
+
+# -- atomic checkpoints -----------------------------------------------------
+
+
+def test_checkpoint_rotation_latest_and_corrupt_fallback(tmp_path):
+    from paddle_tpu.checkpoint import (CheckpointCorrupt,
+                                       CheckpointManager)
+
+    root = str(tmp_path / "ckpts")
+    mgr = CheckpointManager(root, keep=3)
+
+    def writer_for(step):
+        def w(d):
+            np.savez(os.path.join(d, "state.npz"),
+                     w=np.full(4, step, "f4"))
+        return w
+
+    for step in range(1, 6):
+        mgr.save(step, writer_for(step))
+    assert mgr.steps() == [3, 4, 5], "keep-last-3 rotation"
+    assert mgr.latest_step() == 5
+    assert (tmp_path / "ckpts" / "latest").read_text() == "ckpt-5"
+
+    loaded = {}
+
+    def loader(d):
+        loaded["w"] = np.load(os.path.join(d, "state.npz"))["w"]
+
+    assert mgr.load_latest(loader) == 5
+    # corrupt the newest shard: load falls back to the previous one
+    shard = tmp_path / "ckpts" / "ckpt-5" / "state.npz"
+    shard.write_bytes(b"garbage" + shard.read_bytes()[7:])
+    assert mgr.load_latest(loader) == 4
+    assert loaded["w"][0] == 4.0
+    # corrupt everything: typed failure, not garbage params
+    for step in (3, 4):
+        p = tmp_path / "ckpts" / ("ckpt-%d" % step) / "state.npz"
+        p.write_bytes(b"garbage" + p.read_bytes()[7:])
+    with pytest.raises(CheckpointCorrupt, match="sha256"):
+        mgr.load_latest(loader)
+
+
+def test_checkpoint_crash_before_rename_invisible(tmp_path):
+    """A writer that dies before the rename (simulated by raising)
+    leaves NO visible checkpoint — and a handmade leftover tmp dir is
+    ignored by the rotation scan."""
+    from paddle_tpu.checkpoint import (CheckpointManager,
+                                       atomic_checkpoint_dir)
+
+    root = str(tmp_path / "ckpts")
+    mgr = CheckpointManager(root)
+    with pytest.raises(RuntimeError, match="died mid-save"):
+        with atomic_checkpoint_dir(mgr.dir_for(7)) as tmp:
+            np.savez(os.path.join(tmp, "state.npz"), w=np.ones(4))
+            raise RuntimeError("died mid-save")
+    assert mgr.steps() == [] and mgr.latest_step() is None
+    # a stranded tmp dir from a SIGKILLed save is equally invisible
+    leftover = os.path.join(root, "ckpt-9.tmp-123-456")
+    os.makedirs(leftover)
+    with open(os.path.join(leftover, "state.npz"), "wb") as f:
+        f.write(b"partial")
+    assert mgr.steps() == []
+    assert mgr.load_latest(lambda d: None) is None
+
+
+def test_checkpoint_manifest_detects_missing_and_resized(tmp_path):
+    from paddle_tpu.checkpoint import (CheckpointCorrupt,
+                                       atomic_checkpoint_dir,
+                                       verify_manifest)
+
+    final = str(tmp_path / "snap")
+    with atomic_checkpoint_dir(final) as tmp:
+        with open(os.path.join(tmp, "a.bin"), "wb") as f:
+            f.write(b"aaaa")
+        with open(os.path.join(tmp, "b.bin"), "wb") as f:
+            f.write(b"bbbb")
+    verify_manifest(final)  # intact
+    os.remove(os.path.join(final, "b.bin"))
+    with pytest.raises(CheckpointCorrupt, match="missing file"):
+        verify_manifest(final)
+    with open(os.path.join(final, "b.bin"), "wb") as f:
+        f.write(b"bbbbbb")
+    with pytest.raises(CheckpointCorrupt, match="bytes"):
+        verify_manifest(final)
+
+
+def test_io_save_persistables_manifest_roundtrip(tmp_path):
+    """Static-graph persistables: atomic save writes a manifest;
+    load verifies it; a flipped byte raises CheckpointCorrupt."""
+    import paddle_tpu as fluid
+    from paddle_tpu.checkpoint import MANIFEST_NAME
+    from paddle_tpu.io import CheckpointCorrupt
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[2, 3], dtype="float32")
+        fluid.layers.fc(x, 4, param_attr=fluid.ParamAttr(name="wfc"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / "model")
+    fluid.io.save_persistables(exe, d, main)
+    assert os.path.exists(os.path.join(d, MANIFEST_NAME))
+    fluid.io.load_persistables(exe, d, main)  # verifies + loads
+    p = os.path.join(d, "__params__.npz")
+    with open(p, "r+b") as f:
+        f.seek(30)
+        b = f.read(1)
+        f.seek(30)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CheckpointCorrupt, match="sha256"):
+        fluid.io.load_persistables(exe, d, main)
+
+
+def test_io_load_missing_names_file_and_dir(tmp_path):
+    import paddle_tpu as fluid
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(FileNotFoundError) as ei:
+        fluid.io.load_persistables(exe, str(empty))
+    assert "__params__.npz" in str(ei.value)
+    assert str(empty) in str(ei.value)
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        fluid.io.load_inference_model(str(tmp_path / "nope"), exe)
+    with pytest.raises(FileNotFoundError, match="__model__"):
+        fluid.io.load_inference_model(str(empty), exe)
+
+
+# -- PS server socket hygiene ----------------------------------------------
+
+
+def test_server_stop_releases_port_mid_frame():
+    """stop() must close the listening socket and sever live
+    connections even while a client is mid-frame, so the port is
+    immediately rebindable (no leaks between test runs)."""
+    from paddle_tpu.distributed.ps_rpc import PSServer
+
+    port = _free_port()
+    endpoint = "127.0.0.1:%d" % port
+    server = PSServer(endpoint, MiniExec(), MiniScope(), {}, fanin=1)
+    server.start_background()
+    conn = socket.create_connection(("127.0.0.1", port), timeout=5)
+    conn.sendall(b"\x20\x00\x00")  # partial frame header: the conn
+    # thread is now blocked mid-_recv_exact
+    time.sleep(0.2)
+    server.stop()
+    for t in server._threads:
+        assert not t.is_alive(), "server thread leaked past stop()"
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", port))  # would raise EADDRINUSE on a leak
+    s.close()
+    conn.close()
+
+
+# -- serving drain signal ---------------------------------------------------
+
+
+class _SlowPredictor:
+    def __init__(self, delay=1.0):
+        self.delay = delay
+
+    def get_input_names(self):
+        return ["x"]
+
+    def run(self, feed):
+        time.sleep(self.delay)
+
+        class T:
+            name = "y"
+            data = np.asarray(feed["x"])
+
+        return [T()]
+
+
+def test_serving_healthz_draining_during_stop():
+    from paddle_tpu.serving.engine import ServingConfig, ServingEngine
+    from paddle_tpu.serving.http import start_http_server
+    import urllib.request
+
+    eng = ServingEngine(_SlowPredictor(delay=1.0),
+                        ServingConfig(max_batch_size=2, num_workers=1,
+                                      warmup=False),
+                        sample_feed={"x": np.zeros((1, 2), "f4")})
+    eng.start()
+    server, thread = start_http_server(eng)
+    base = "http://127.0.0.1:%d" % server.server_address[1]
+    try:
+        assert eng.health() == "ok"
+        fut = eng.submit({"x": np.zeros((1, 2), "f4")})
+        stopper = threading.Thread(target=eng.stop)
+        stopper.start()
+        statuses = set()
+        deadline = time.time() + 10
+        while stopper.is_alive() and time.time() < deadline:
+            statuses.add(eng.health())
+            try:
+                urllib.request.urlopen(base + "/healthz", timeout=5)
+                statuses.add("http-200")
+            except urllib.error.HTTPError as e:
+                statuses.add(json.loads(e.read())["status"])
+            time.sleep(0.05)
+        stopper.join(timeout=30)
+        assert "draining" in statuses, statuses
+        assert eng.health() == "stopped"
+        fut.result(timeout=5)  # the in-flight request still finished
+    finally:
+        server.shutdown()
+        server.server_close()

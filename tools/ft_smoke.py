@@ -1,0 +1,122 @@
+"""Fault-tolerance CI smoke (ci/check.sh gate 6).
+
+End-to-end recovery drill on one host: a real PS server process, two
+trainer processes under the ``distributed.launch`` supervisor, rank 1
+SIGKILLs itself mid-round 3. PASS requires the whole job to exit 0 —
+which can only happen if (a) the server's heartbeat monitor evicted
+the dead rank so the survivor's barriers completed, (b) the supervisor
+relaunched the rank, and (c) the relaunch resumed from its newest
+valid (manifest-verified) checkpoint and finished the remaining
+rounds. The final checkpoint is then re-verified here.
+
+Usage: python tools/ft_smoke.py [--rounds 6]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "dist_worker_ft.py")
+if REPO not in sys.path:  # script-dir sys.path[0] is tools/
+    sys.path.insert(0, REPO)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env(**over):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PADDLE_PS_EVICT_AFTER"] = "2.0"
+    env["PADDLE_PS_HEARTBEAT_MS"] = "200"
+    env.update({k: str(v) for k, v in over.items()})
+    return env
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser("ft_smoke")
+    ap.add_argument("--rounds", type=int, default=6)
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="ft_smoke_")
+    endpoint = "127.0.0.1:%d" % _free_port()
+    print("[ft_smoke] pserver at %s, %d rounds, rank 1 dies at round 3"
+          % (endpoint, args.rounds))
+    ps = subprocess.Popen(
+        [sys.executable, WORKER],
+        env=_env(FT_ROLE="pserver", PSERVER_ENDPOINT=endpoint,
+                 PADDLE_TRAINERS_NUM=2))
+    try:
+        sup = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=2", "--max_restarts=2",
+             "--started_port=%d" % _free_port(), WORKER],
+            env=_env(FT_ROLE="trainer", PSERVER_ENDPOINT=endpoint,
+                     FT_ROUNDS=args.rounds, FT_DIE_AT_ROUND=3,
+                     FT_DIE_RANK=1,
+                     FT_OUT=os.path.join(tmp, "out"),
+                     FT_CKPT_ROOT=os.path.join(tmp, "ckpt")),
+            timeout=240, cwd=REPO)
+        if sup.returncode != 0:
+            print("[ft_smoke] FAIL: supervised job exited %d"
+                  % sup.returncode)
+            return 1
+        r1 = json.load(open(os.path.join(tmp, "out.t1.json")))
+        checks = [
+            ("rank 1 was relaunched", r1["restart"] == 1),
+            ("rank 1 resumed from checkpoint round 2",
+             r1["resumed_from"] == 2),
+        ]
+        # which recovery path ran is load-dependent: a slow relaunch
+        # means eviction unblocked the survivor first (then the
+        # relaunch was re-admitted); a fast one rejoins the round
+        # before the eviction deadline. Both are successful recovery —
+        # report which happened, gate only on internal consistency.
+        if r1["evictions"]:
+            print("[ft_smoke] INFO: eviction path (evictions=%d, "
+                  "readmissions=%d)"
+                  % (r1["evictions"], r1["readmissions"]))
+        else:
+            print("[ft_smoke] INFO: fast-rejoin path (relaunch beat "
+                  "the eviction deadline)")
+        checks.append(("eviction/readmission bookkeeping consistent",
+                       r1["evictions"] >= r1["readmissions"] >= 0))
+        # the relaunched rank's final checkpoint must verify end-to-end
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(os.path.join(tmp, "ckpt", "t1"))
+        import numpy as np
+
+        state = {}
+        step = mgr.load_latest(lambda d: state.update(
+            w=np.load(os.path.join(d, "state.npz"))["w"]))
+        checks.append(("final checkpoint verifies at round %d"
+                       % args.rounds, step == args.rounds))
+        ok = True
+        for what, passed in checks:
+            print("[ft_smoke] %s: %s" % ("PASS" if passed else "FAIL",
+                                         what))
+            ok = ok and passed
+        return 0 if ok else 1
+    finally:
+        if ps.poll() is None:
+            ps.kill()
+        ps.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
