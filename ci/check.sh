@@ -81,6 +81,18 @@ fi
 # newest manifest-verified checkpoint) and the final checkpoint must
 # re-verify
 python tools/ft_smoke.py
+# 6c: SERVER-death drill — 2 trainers, 2 replicated pservers, the
+# PRIMARY SIGKILLs itself while applying round 3: the job must exit 0
+# with every trainer failed over to the promoted backup AND the final
+# params matching the clean single-server run bit-for-bit (failover
+# replay + replicated dedup watermark); the killed server must rejoin
+# as a catching-up backup under the supervisor
+python tools/ft_smoke.py --server-kill
+# 6d: bounded chaos drill — one seeded randomized schedule (random
+# fault plan + random trainer kill + random primary-pserver kill),
+# gated on bit-for-bit parity with the clean run; a failure prints
+# the seed that replays it
+python tools/chaos_drill.py --rounds 1
 
 if [[ "${SKIP_TESTS:-0}" != "1" ]]; then
     echo "== gate 7: test suite =="

@@ -39,11 +39,13 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["CheckpointCorrupt", "MANIFEST_NAME", "atomic_write_bytes",
-           "atomic_checkpoint_dir", "write_manifest", "verify_manifest",
+__all__ = ["CheckpointCorrupt", "MANIFEST_NAME", "SCOPE_VARS_NAME",
+           "atomic_write_bytes", "atomic_checkpoint_dir",
+           "write_manifest", "verify_manifest", "load_scope_snapshot",
            "CheckpointManager", "save_checkpoint", "load_checkpoint"]
 
 MANIFEST_NAME = "__manifest__.json"
+SCOPE_VARS_NAME = "__vars__.json"  # file name -> var name (snapshots)
 _LATEST_NAME = "latest"
 _CKPT_PREFIX = "ckpt-"
 
@@ -184,6 +186,36 @@ def verify_manifest(dirname: str, required: bool = True) -> Optional[Dict]:
                 "(got %s…, manifest says %s…)"
                 % (p, digest[:12], str(meta.get("sha256"))[:12]))
     return doc
+
+
+def load_scope_snapshot(executor, scope, dirname: str) -> int:
+    """Restore a ``snapshot_scope_to_dir`` directory into ``scope``
+    after verifying its manifest — the pserver rejoin catch-up path: a
+    relaunched server must never boot off a torn snapshot, so any
+    integrity failure raises the typed ``CheckpointCorrupt`` instead
+    of loading garbage params. Var names come from ``__vars__.json``
+    when present (dedicated snapshots write it) and fall back to the
+    file names. Returns the number of vars restored."""
+    from .core import proto_format
+
+    verify_manifest(dirname, required=True)
+    vmap_path = os.path.join(dirname, SCOPE_VARS_NAME)
+    if os.path.exists(vmap_path):
+        with open(vmap_path, "r", encoding="utf-8") as f:
+            names = json.load(f)
+    else:
+        names = {fn: fn for fn in sorted(os.listdir(dirname))
+                 if fn not in (MANIFEST_NAME, SCOPE_VARS_NAME)
+                 and not fn.startswith(".tmp-")
+                 and os.path.isfile(os.path.join(dirname, fn))}
+    loaded = 0
+    for fn, var in sorted(names.items()):
+        with open(os.path.join(dirname, fn), "rb") as f:
+            data = f.read()
+        arr, _lod, _pos = proto_format.parse_lod_tensor(data)
+        executor._write_var(scope, var, arr.copy())
+        loaded += 1
+    return loaded
 
 
 @contextlib.contextmanager

@@ -44,7 +44,8 @@ import time
 from typing import List, Optional
 
 __all__ = ["FaultRule", "FaultInjector", "FaultInjected",
-           "get_injector", "reset_injector", "parse_plan"]
+           "get_injector", "reset_injector", "parse_plan",
+           "random_plan"]
 
 _SIDES = ("send", "recv", "any")
 _KINDS = ("drop", "delay", "dup", "truncate", "close")
@@ -106,6 +107,44 @@ def parse_plan(plan: str) -> List[FaultRule]:
                 "bad PADDLE_TPU_FAULTS spec %r (grammar: "
                 "side.kind:prob[:param]): %s" % (spec, e)) from None
     return rules
+
+
+# menu for randomized chaos schedules (tools/chaos_drill.py): only
+# RECOVERABLE faults — drop/dup/delay are absorbed by retry + dedup.
+# close/truncate sever the connection, which the retry path also
+# survives, but at probabilities a drill can afford they would exhaust
+# the per-endpoint retry budget and turn a healthy primary into a
+# spurious failover (split-brain by chaos harness, not by the system
+# under test) — they stay directed-test material.
+_RANDOM_MENU = (
+    ("send", "drop", (0.01, 0.05), None),
+    ("send", "dup", (0.01, 0.05), None),
+    ("send", "delay", (0.02, 0.10), (5.0, 30.0)),
+    ("recv", "drop", (0.01, 0.04), None),
+    ("recv", "delay", (0.02, 0.10), (5.0, 30.0)),
+    ("any", "delay", (0.02, 0.08), (5.0, 20.0)),
+)
+
+
+def random_plan(rng: random.Random, max_rules: int = 3) -> str:
+    """Draw a randomized-but-reproducible ``PADDLE_TPU_FAULTS`` plan
+    from the recoverable-fault menu: the same ``rng`` state yields the
+    same plan, so a chaos drill's schedule replays from its seed. The
+    returned string always round-trips through ``parse_plan``."""
+    n = rng.randint(1, max(1, int(max_rules)))
+    picks = rng.sample(range(len(_RANDOM_MENU)), min(n, len(_RANDOM_MENU)))
+    specs = []
+    for i in sorted(picks):
+        side, kind, (plo, phi), prange = _RANDOM_MENU[i]
+        prob = round(rng.uniform(plo, phi), 4)
+        if prange is None:
+            specs.append("%s.%s:%g" % (side, kind, prob))
+        else:
+            param = round(rng.uniform(*prange), 1)
+            specs.append("%s.%s:%g:%g" % (side, kind, prob, param))
+    plan = ",".join(specs)
+    parse_plan(plan)  # self-check: a generated plan must always parse
+    return plan
 
 
 def _count(side: str, kind: str) -> None:

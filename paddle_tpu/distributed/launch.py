@@ -18,8 +18,22 @@ PS trainers keep making progress meanwhile via server-side heartbeat
 eviction (``distributed/ps_rpc.py``). Only when a rank exhausts its
 restart budget does the supervisor tear the job down.
 
+Multi-server supervision (ISSUE 4): ``--pserver_endpoints=ep0,ep1``
+with ``--server_script=serve.py`` additionally spawns one supervised
+parameter-server process per endpoint (env contract:
+``PADDLE_ROLE=pserver``, ``PADDLE_PSERVER_ENDPOINTS`` = full list,
+``PADDLE_PSERVER_INDEX``, ``PSERVER_ENDPOINT`` = own endpoint).
+Index 0 starts as the replication primary, the rest as backups. A
+server that dies is relaunched with ``PADDLE_PS_REJOIN=1`` so it
+rejoins as a CATCHING-UP BACKUP (never as a primary — the trainers
+have already failed over; ``distributed/ps_rpc.py`` owns that
+protocol). The job completes when every TRAINER rank exits 0; the
+servers are then torn down and their exit codes ignored.
+
 Usage:  python -m paddle_tpu.distributed.launch --nproc_per_node=2 \
-            [--max_restarts=3] train.py --your-args
+            [--max_restarts=3] \
+            [--server_script=serve.py --pserver_endpoints=ep0,ep1] \
+            train.py --your-args
 """
 from __future__ import annotations
 
@@ -49,6 +63,12 @@ def _parse_args(argv=None):
                    help="relaunches per rank after an abnormal exit "
                         "before the whole job is brought down "
                         "(0 = die on first worker death)")
+    p.add_argument("--server_script", default=None,
+                   help="script run once per --pserver_endpoints entry "
+                        "as a supervised parameter-server process")
+    p.add_argument("--pserver_endpoints", default="",
+                   help="comma-separated primary+backup pserver "
+                        "endpoints (requires --server_script)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -85,11 +105,13 @@ def _log(msg: str) -> None:
 class _Worker:
     """One supervised rank: its env, restart budget, and log sink."""
 
-    def __init__(self, local_rank: int, cmd, env, log_dir):
+    def __init__(self, local_rank: int, cmd, env, log_dir,
+                 role: str = "trainer"):
         self.local_rank = local_rank
         self.cmd = list(cmd)
         self.env = dict(env)
         self.log_dir = log_dir
+        self.role = role
         self.restarts = 0
         self.proc: subprocess.Popen = None
         self._fp = None
@@ -97,13 +119,20 @@ class _Worker:
     def spawn(self) -> None:
         env = dict(self.env)
         env["PADDLE_RESTART_COUNT"] = str(self.restarts)
+        if self.role == "pserver" and self.restarts > 0:
+            # a relaunched server must come back as a catching-up
+            # BACKUP: the trainers have already failed over, and a
+            # fresh index-0 process claiming the primary role would
+            # split the brain
+            env["PADDLE_PS_REJOIN"] = "1"
         stdout = stderr = None
         self.close_log()  # a relaunch must not leak the old handle
         if self.log_dir:
             # append across restarts: one workerlog per rank tells the
             # whole story, crash included
-            self._fp = open(os.path.join(
-                self.log_dir, "workerlog.%d" % self.local_rank), "a")
+            name = ("serverlog.%d" if self.role == "pserver"
+                    else "workerlog.%d") % self.local_rank
+            self._fp = open(os.path.join(self.log_dir, name), "a")
             stdout = stderr = self._fp
         self.proc = subprocess.Popen(self.cmd, env=env, stdout=stdout,
                                      stderr=stderr)
@@ -123,6 +152,12 @@ def launch(args=None):
     # checkout (script-dir sys.path[0] replaces the launcher's cwd)
     pkg_root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
+    pserver_eps = [e.strip() for e in args.pserver_endpoints.split(",")
+                   if e.strip()]
+    if pserver_eps and not args.server_script:
+        raise SystemExit("--pserver_endpoints requires --server_script")
+    nranks = len(node_ips) * args.nproc_per_node
+
     workers = []
     for local_rank in range(args.nproc_per_node):
         env = dict(os.environ)
@@ -131,18 +166,37 @@ def launch(args=None):
         env.update(get_cluster_env(node_ips, args.node_rank,
                                    args.nproc_per_node,
                                    args.started_port, local_rank))
+        env["PADDLE_ROLE"] = "trainer"
+        if pserver_eps:
+            env["PADDLE_PSERVER_ENDPOINTS"] = ",".join(pserver_eps)
         cmd = [sys.executable, "-u", args.training_script] + \
             list(args.training_script_args)
         workers.append(_Worker(local_rank, cmd, env, args.log_dir))
 
+    servers = []
+    for i, ep in enumerate(pserver_eps):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        env.update({
+            "PADDLE_ROLE": "pserver",
+            "PADDLE_PSERVER_ENDPOINTS": ",".join(pserver_eps),
+            "PADDLE_PSERVER_INDEX": str(i),
+            "PSERVER_ENDPOINT": ep,
+            "PADDLE_TRAINERS_NUM": str(nranks),
+        })
+        servers.append(_Worker(i, [sys.executable, "-u",
+                                   args.server_script], env,
+                               args.log_dir, role="pserver"))
+
     def _terminate_all(sig=signal.SIGTERM):
-        for w in workers:
+        for w in workers + servers:
             if w.proc is not None and w.proc.poll() is None:
                 try:
                     w.proc.send_signal(sig)
                 except OSError:
                     pass
-        for w in workers:
+        for w in workers + servers:
             if w.proc is not None:
                 try:
                     w.proc.wait(timeout=10)
@@ -153,12 +207,35 @@ def launch(args=None):
     live = set(range(args.nproc_per_node))
     rc = 0
     try:
+        for s in servers:
+            s.spawn()
         for w in workers:
             w.spawn()
         # supervision loop: poll, relaunch the dead (bounded), finish
-        # when every rank has exited cleanly
+        # when every TRAINER rank has exited cleanly (servers serve
+        # until torn down below)
         while live:
             time.sleep(0.2)
+            for s in servers:
+                code = s.proc.poll()
+                if code is None or code == 0:
+                    continue  # running, or deliberately shut down
+                sig_note = (" (signal %d)" % -code) if code < 0 else ""
+                if s.restarts >= args.max_restarts:
+                    _log("pserver %d exited %d%s; restart budget (%d) "
+                         "exhausted — bringing the job down"
+                         % (s.local_rank, code, sig_note,
+                            args.max_restarts))
+                    rc = code if code > 0 else 1
+                    _terminate_all()
+                    live = set()
+                    break
+                s.restarts += 1
+                _log("pserver %d exited %d%s; relaunching as a "
+                     "catching-up backup (restart %d/%d)"
+                     % (s.local_rank, code, sig_note, s.restarts,
+                        args.max_restarts))
+                s.spawn()
             for w in workers:
                 if w.local_rank not in live:
                     continue
@@ -191,7 +268,22 @@ def launch(args=None):
         _terminate_all()
         return 1
     finally:
-        for w in workers:
+        # trainers are done (or the job is down): the servers' work is
+        # over — tear them down and ignore their exit codes
+        for s in servers:
+            if s.proc is not None and s.proc.poll() is None:
+                try:
+                    s.proc.terminate()
+                except OSError:
+                    pass
+        for s in servers:
+            if s.proc is not None:
+                try:
+                    s.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    s.proc.kill()
+                    s.proc.wait()
+        for w in workers + servers:
             w.close_log()
 
 

@@ -42,9 +42,42 @@ heart_beat_monitor.h semantics):
   the heartbeat response names the evicted so survivors
   log-and-continue. A relaunched trainer that sends again is
   re-admitted and the fanin grows back;
-- ``rpc.retries`` / ``rpc.timeouts`` / ``ps.evictions`` /
-  ``ps.readmissions`` are recorded unconditionally in the
-  observability registry (rare events, and CI asserts on them).
+- ``rpc.retries`` / ``rpc.timeouts`` (labeled by rpc ``method``) /
+  ``ps.evictions`` / ``ps.readmissions`` are recorded unconditionally
+  in the observability registry (rare events, and CI asserts on them).
+
+Replication + failover (ISSUE 4 — the reference's brpc failover /
+checkpoint_notify availability tier, made survivable end to end):
+
+- ``PADDLE_PSERVER_ENDPOINTS`` names an ordered primary + N backups.
+  In sync mode the primary streams every applied round — round number,
+  post-round scope blobs, and the per-client ``(cid -> seq)`` dedup
+  watermark — to each live backup and waits for the acks BEFORE
+  marking the round complete, so no trainer can observe (get_param) an
+  update a promoted backup would not have;
+- ``PSClient`` accepts a comma-separated endpoint list. When the
+  bounded retry budget on the current endpoint is exhausted by
+  transport failures (conn loss / timeout — never app errors), it
+  advances to the next endpoint, replays its per-round log of
+  non-idempotent rpcs (send_grad / send_barrier / push_sparse, with
+  their ORIGINAL dedup tokens), and reissues the in-flight rpc. The
+  replicated watermark makes replays of already-folded rpcs no-ops,
+  so the replay is exactly-once on the new primary;
+- promotion is deterministic: the lowest-index live endpoint. A backup
+  only accepts the dataplane from a client that actually failed over
+  (its rpcs carry a failover epoch ``fo >= 1``); fresh clients are
+  redirected (``not_primary``) so a relaunched server can never steal
+  traffic from the live primary (no split brain);
+- a relaunched server (``PADDLE_PS_REJOIN=1``, set by the launch
+  supervisor) rejoins as a backup: it refuses the dataplane until it
+  has caught up from the active server's manifest-verified snapshot
+  (``join_backup`` rpc -> ``snapshot_scope_to_dir`` ->
+  ``checkpoint.load_scope_snapshot``), then receives the stream;
+- counters: ``ps.failovers{cause=}``, ``ps.promotions``,
+  ``ps.catchup_ms``, and the per-backup gauge
+  ``ps.replication_lag_rounds{backup=}`` (0 after every ack; a backup
+  that stops acking is dropped from the stream and the gauge freezes
+  at its lag).
 """
 from __future__ import annotations
 
@@ -69,6 +102,23 @@ def _counter(name: str, **labels):
     from .. import observability as _obs
 
     return _obs.counter(name, **labels)
+
+
+def _gauge(name: str, **labels):
+    from .. import observability as _obs
+
+    return _obs.gauge(name, **labels)
+
+
+def _histogram(name: str):
+    from .. import observability as _obs
+
+    return _obs.histogram(name)
+
+
+def _endpoints_from_env() -> List[str]:
+    raw = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+    return [e.strip() for e in raw.split(",") if e.strip()]
 
 
 def _send_msg(sock: socket.socket, msg: dict,
@@ -126,7 +176,8 @@ def _array_from(header: dict, raw: bytes) -> np.ndarray:
         header["shape"]).copy()
 
 
-def snapshot_scope_to_dir(executor, scope, dirname: str) -> None:
+def snapshot_scope_to_dir(executor, scope, dirname: str,
+                          names_map: bool = False) -> None:
     """Serialize every tensor var in ``scope`` into ``dirname`` in the
     reference tensor-stream format (shared by the server-side
     'checkpoint' RPC kind and the emulated checkpoint_notify path).
@@ -140,20 +191,34 @@ def snapshot_scope_to_dir(executor, scope, dirname: str) -> None:
     certifies integrity of the files PRESENT (no torn/corrupt file
     loads as garbage); whether every EXPECTED server contributed is
     the notifier's concern — it fans out the RPCs and sees each
-    server's ack or error."""
+    server's ack or error.
+
+    ``names_map=True`` additionally writes ``__vars__.json``
+    (file name -> original var name) so a DEDICATED snapshot — the
+    ``join_backup`` catch-up path — can restore vars whose names were
+    munged for the filesystem. Never set it for SHARED multi-server
+    dirs: concurrent shards would clobber each other's map."""
     import os
 
-    from ..checkpoint import atomic_write_bytes, write_manifest
+    from ..checkpoint import SCOPE_VARS_NAME, atomic_write_bytes, \
+        write_manifest
     from ..core import proto_format
 
     os.makedirs(dirname, exist_ok=True)
+    names: Dict[str, str] = {}
     for name in list(scope.local_var_names()):
         val = executor._read_var(scope, name)
         if val is None or not hasattr(val, "shape"):
             continue
+        fn = name.replace("/", "_")
+        names[fn] = name
         atomic_write_bytes(
-            os.path.join(dirname, name.replace("/", "_")),
+            os.path.join(dirname, fn),
             proto_format.serialize_lod_tensor(np.asarray(val)))
+    if names_map:
+        atomic_write_bytes(
+            os.path.join(dirname, SCOPE_VARS_NAME),
+            json.dumps(names, indent=1, sort_keys=True).encode())
     write_manifest(dirname)
 
 
@@ -204,19 +269,71 @@ class PSServer:
     disabled) arms the heartbeat monitor: a trainer silent that long is
     evicted — its slot leaves the effective fanin so the surviving
     trainers' barriers complete, and the heartbeat response carries the
-    eviction so survivors can log-and-continue."""
+    eviction so survivors can log-and-continue.
+
+    ``endpoints`` (env ``PADDLE_PSERVER_ENDPOINTS``) is the ordered
+    primary + backups list this server belongs to; index 0 starts as
+    the active primary, the rest as replication backups that refuse
+    the trainer dataplane until a genuinely failed-over client
+    promotes them. ``rejoin=True`` (env ``PADDLE_PS_REJOIN``, set by
+    the launch supervisor on a server relaunch) starts the server as
+    an un-caught-up backup that first pulls a manifest-verified
+    snapshot from the active server."""
 
     _DEDUPE_CAP = 512  # distinct live client nonces remembered
 
+    # rpcs that belong to trainers (gated on primary role); everything
+    # else — heartbeat, replication, catch-up, shutdown — any role
+    # answers
+    _DATAPLANE = ("send_grad", "send_barrier", "get_param",
+                  "fetch_barrier", "pull_sparse", "push_sparse")
+
     def __init__(self, endpoint: str, executor, scope, grad_to_block,
                  fanin: int = 1, sync_mode: bool = True,
-                 evict_after: Optional[float] = None):
+                 evict_after: Optional[float] = None,
+                 endpoints: Optional[List[str]] = None,
+                 rejoin: Optional[bool] = None):
         host, port = endpoint.rsplit(":", 1)
         self._executor = executor
         self._scope = scope
         self._grad_to_block = grad_to_block
         self._fanin = max(int(fanin), 1)
         self._sync = bool(sync_mode)
+        # -- replication topology -----------------------------------------
+        if endpoints is None:
+            endpoints = _endpoints_from_env()
+        self._endpoints = [e.strip() for e in (endpoints or [])
+                           if e.strip()]
+        self._own_endpoint = endpoint
+        try:
+            self._index = self._endpoints.index(endpoint)
+        except ValueError:
+            self._index = 0
+            self._endpoints = [endpoint]
+        if rejoin is None:
+            rejoin = os.environ.get("PADDLE_PS_REJOIN") == "1"
+        self._rejoin = bool(rejoin)
+        self._active = (self._index == 0 and not self._rejoin)
+        self._promoted = False
+        self._caught_up = not self._rejoin
+        self._applied_round = 0
+        # cid -> highest seq whose effect is folded into the replicated
+        # state this server holds: a failover replay at-or-below it is
+        # acknowledged without re-executing (exactly-once across the
+        # promotion)
+        self._repl_watermark: Dict[str, int] = {}
+        # the watermark AS OF THE LAST APPLIED ROUND — the only thing
+        # ever shipped to backups. The live ``_last_seq`` also covers
+        # rpcs buffered in the CURRENT unapplied round (a join_backup
+        # can land mid-round); shipping those would make a promoted
+        # backup falsely skip their replay and lose the round.
+        self._applied_watermark: Dict[str, int] = {}
+        self._repl_clients: Dict[str, "PSClient"] = {}
+        self._repl_dead: set = set()
+        self._repl_deadline = float(
+            os.environ.get("PADDLE_PS_REPL_DEADLINE", "10"))
+        self._repl_connect = float(
+            os.environ.get("PADDLE_PS_REPL_CONNECT_TIMEOUT", "3"))
         if evict_after is None:
             evict_after = float(os.environ.get("PADDLE_PS_EVICT_AFTER",
                                                "0"))
@@ -228,7 +345,12 @@ class PSServer:
         self._clock_started = False
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._pending: Dict[str, List[np.ndarray]] = {}
+        # var name -> {trainer_id: grad}: keyed (not appended) so a
+        # relaunched trainer RE-SENDING the round it died in REPLACES
+        # its dead incarnation's contribution instead of double
+        # counting it, and summed in sorted-tid order so the applied
+        # total is bit-deterministic regardless of arrival order
+        self._pending: Dict[str, Dict[int, np.ndarray]] = {}
         self._send_barriers = 0
         self._fetch_barriers = 0
         self._round_complete = True   # params servable before round 1
@@ -256,6 +378,11 @@ class PSServer:
                                  name="ps-evict-monitor", daemon=True)
             t.start()
             self._threads.append(t)
+        if self._rejoin:
+            t = threading.Thread(target=self._catchup_loop,
+                                 name="ps-catchup", daemon=True)
+            t.start()
+            self._threads.append(t)
 
     # -- round protocol ---------------------------------------------------
 
@@ -264,20 +391,198 @@ class PSServer:
 
     def _apply_round(self):
         """All trainers' grads in (locked by caller): sum per var, run
-        its optimize block, open params for reading."""
-        for name, grads in self._pending.items():
-            total = grads[0]
-            for g in grads[1:]:
-                total = total + g
+        its optimize block, replicate the applied round to every live
+        backup (acks REQUIRED before the round reads as complete — a
+        promoted backup must never be behind a state any trainer has
+        observed), then open params for reading."""
+        for name in sorted(self._pending):
+            by_tid = self._pending[name]
+            tids = sorted(by_tid)
+            total = by_tid[tids[0]]
+            for t in tids[1:]:
+                total = total + by_tid[t]
             self._executor._write_var(self._scope, name, total)
             sub = self._grad_to_block.get(name)
             if sub is not None:
                 self._executor.run_block(sub, self._scope)
         self._pending.clear()
         self._send_barriers = 0
+        self._applied_round += 1
+        # safe point for a watermark snapshot: every processed
+        # send-kind seq is now folded into the scope (trainers cannot
+        # have sent next-round traffic — their barriers haven't
+        # returned yet)
+        self._applied_watermark = self._watermark_locked()
+        self._replicate_locked()
         self._round_complete = True
         self._fetches_pending = True
         self._cond.notify_all()
+
+    # -- replication (primary -> backups) ---------------------------------
+
+    def _repl_targets(self) -> List[str]:
+        return [ep for ep in self._endpoints
+                if ep != self._own_endpoint and ep not in self._repl_dead]
+
+    def _repl_client(self, ep: str) -> "PSClient":
+        c = self._repl_clients.get(ep)
+        if c is None:
+            c = PSClient(ep, trainer_id=None, auto_heartbeat=False,
+                         timeout=self._repl_connect,
+                         rpc_deadline=self._repl_deadline,
+                         max_retries=int(os.environ.get(
+                             "PADDLE_PS_REPL_RETRIES", "3")))
+            self._repl_clients[ep] = c
+        return c
+
+    def _scope_blobs(self):
+        """(headers, raw) for every tensor var in the scope — the
+        post-round replication payload (full blobs, bit-exact by
+        construction; delta streaming is a named ROADMAP follow-up)."""
+        headers, chunks = [], []
+        for name in list(self._scope.local_var_names()):
+            val = self._executor._read_var(self._scope, name)
+            if val is None or not hasattr(val, "shape"):
+                continue
+            arr = np.ascontiguousarray(np.asarray(val))
+            h = _array_header(arr)
+            h["name"] = name
+            headers.append(h)
+            chunks.append(arr.tobytes())
+        return headers, b"".join(chunks)
+
+    def _watermark_locked(self) -> Dict[str, int]:
+        """Per-cid seq watermark covering every rpc folded into the
+        state being replicated (own processed seqs plus any watermark
+        this server itself inherited through a promotion)."""
+        with self._dedupe_lock:
+            wm = dict(self._last_seq)
+        for cid, s in self._repl_watermark.items():
+            if int(wm.get(cid, 0)) < int(s):
+                wm[cid] = int(s)
+        return wm
+
+    def _replicate_locked(self) -> None:
+        """Stream the just-applied round to every live backup and wait
+        for each ack (locked by caller — the round stays incomplete,
+        and unfetchable, until the backups hold it). A backup that
+        fails the short replication deadline is dropped from the
+        stream (its lag gauge freezes; a relaunch re-enters via
+        join_backup)."""
+        if not self._sync or not self._active_role():
+            return
+        targets = self._repl_targets()
+        if not targets:
+            return
+        headers, raw = self._scope_blobs()
+        wm = self._applied_watermark
+        for ep in targets:
+            _gauge("ps.replication_lag_rounds", backup=ep).set(1)
+            try:
+                self._repl_client(ep).replicate(
+                    self._applied_round, headers, raw, wm)
+                _gauge("ps.replication_lag_rounds", backup=ep).set(0)
+            except (RuntimeError, OSError) as e:
+                self._repl_dead.add(ep)
+                try:
+                    self._repl_clients.pop(ep).close()
+                except (KeyError, OSError):
+                    pass
+                print("[ps_rpc] dropping backup %s from the replication"
+                      " stream at round %d: %s"
+                      % (ep, self._applied_round, e),
+                      file=sys.stderr, flush=True)
+
+    def _active_role(self) -> bool:
+        return self._active or self._promoted
+
+    def _promote_locked(self, kind: str) -> None:
+        """A genuinely failed-over client reached this backup: become
+        the primary (deterministic — clients walk the endpoint list in
+        order, so the lowest-index live endpoint wins) and start
+        streaming to the remaining backups."""
+        self._promoted = True
+        self._repl_dead.discard(self._own_endpoint)
+        # the state this server holds = the replicated rounds; its
+        # folded-seq watermark is exactly the inherited one
+        self._applied_watermark = dict(self._repl_watermark)
+        _counter("ps.promotions").inc()
+        print("[ps_rpc] endpoint %s (index %d) promoted to primary at "
+              "round %d (first failover rpc: %s)"
+              % (self._own_endpoint, self._index, self._applied_round,
+                 kind), file=sys.stderr, flush=True)
+
+    # -- rejoin catch-up (relaunched server -> backup) --------------------
+
+    def _catchup_loop(self) -> None:
+        """Probe the endpoint list for the active server, pull a
+        manifest-verified snapshot (join_backup also splices this
+        server back into the replication stream, atomically with the
+        snapshot), load it, and open for replication traffic."""
+        import shutil
+        import tempfile
+
+        t0 = time.monotonic()
+        while not self._shutdown.is_set():
+            for ep in self._endpoints:
+                if ep == self._own_endpoint or self._shutdown.is_set():
+                    continue
+                probe = None
+                d = None
+                try:
+                    probe = PSClient(ep, trainer_id=None,
+                                     auto_heartbeat=False, timeout=2.0,
+                                     rpc_deadline=30.0, max_retries=0)
+                    st, _ = probe._call({"kind": "repl_status"})
+                    if not st.get("active"):
+                        continue
+                    d = tempfile.mkdtemp(prefix="ps_catchup_")
+                    resp, _ = probe._call({
+                        "kind": "join_backup", "dir": d,
+                        "endpoint": self._own_endpoint})
+                    from ..checkpoint import load_scope_snapshot
+
+                    with self._lock:
+                        # replication may already have raced past the
+                        # snapshot (we were spliced into the stream the
+                        # instant it was taken) — newer full blobs win
+                        if self._applied_round <= int(resp["round"]):
+                            load_scope_snapshot(self._executor,
+                                                self._scope, d)
+                            self._applied_round = int(resp["round"])
+                        for cid, s in (resp.get("watermark")
+                                       or {}).items():
+                            if int(self._repl_watermark.get(cid, 0)) \
+                                    < int(s):
+                                self._repl_watermark[cid] = int(s)
+                        self._pending.clear()
+                        self._send_barriers = 0
+                        self._fetch_barriers = 0
+                        self._round_complete = True
+                        self._fetches_pending = False
+                        self._caught_up = True
+                    _histogram("ps.catchup_ms").observe(
+                        (time.monotonic() - t0) * 1e3)
+                    print("[ps_rpc] endpoint %s rejoined as backup at "
+                          "round %d (caught up from %s in %.0f ms)"
+                          % (self._own_endpoint, self._applied_round,
+                             ep, (time.monotonic() - t0) * 1e3),
+                          file=sys.stderr, flush=True)
+                    return
+                except (RuntimeError, OSError, KeyError, ValueError) \
+                        as e:
+                    print("[ps_rpc] rejoin catch-up attempt via %s "
+                          "failed (will retry): %s" % (ep, e),
+                          file=sys.stderr, flush=True)
+                    continue
+                finally:
+                    if probe is not None:
+                        probe.close()
+                    if d is not None:
+                        # failed attempts must not leave a snapshot
+                        # dir per 0.5s retry during a long outage
+                        shutil.rmtree(d, ignore_errors=True)
+            self._shutdown.wait(0.5)
 
     def _wait_for(self, predicate, what: str):
         """Bounded condition wait (locked by caller); surfaces stale
@@ -339,6 +644,34 @@ class PSServer:
     def _handle(self, msg: dict, raw: bytes):
         """Returns (response_dict, response_raw)."""
         kind = msg["kind"]
+        if kind in self._DATAPLANE and not self._active_role():
+            # backup role: only a client that genuinely failed over
+            # (fo >= 1 — it watched the previous endpoint die) may
+            # promote this server; a FRESH client (a relaunched
+            # trainer walking the list from index 0) is redirected so
+            # a rejoined server can never split the brain with the
+            # live primary. An un-caught-up rejoiner redirects
+            # unconditionally — serving stale params is worse than a
+            # redirect hop.
+            with self._lock:
+                if (not self._caught_up
+                        or int(msg.get("fo", 0)) < 1
+                        # a backup that fell off the stream must never
+                        # be promoted by a client that has OBSERVED a
+                        # newer round than it holds — better no
+                        # primary (loud failure) than a stale one
+                        # (silent param regression)
+                        or int(msg.get("round", 0))
+                        > self._applied_round):
+                    return {"ok": False, "not_primary": True,
+                            "error": "endpoint %s is a backup (index "
+                            "%d, caught_up=%s, round %d vs client "
+                            "round %s), not the primary"
+                            % (self._own_endpoint, self._index,
+                               self._caught_up, self._applied_round,
+                               msg.get("round"))}, b""
+                if not self._active_role():
+                    self._promote_locked(kind)
         if "trainer_id" in msg:
             tid = int(msg["trainer_id"])
             if self._evict_after > 0 and not self._clock_started:
@@ -360,7 +693,9 @@ class PSServer:
             arr = _array_from(msg["array"], raw)
             with self._lock:
                 if self._sync:
-                    self._pending.setdefault(msg["name"], []).append(arr)
+                    self._pending.setdefault(
+                        msg["name"], {})[int(msg.get("trainer_id",
+                                                     0))] = arr
                 else:  # async: apply immediately (RunAsyncLoop)
                     self._executor._write_var(self._scope, msg["name"],
                                               arr)
@@ -395,11 +730,17 @@ class PSServer:
                 arr.tobytes()
         if kind == "fetch_barrier":
             with self._lock:
-                self._fetch_barriers += 1
-                if self._fetch_barriers >= self._effective_fanin():
-                    self._fetch_barriers = 0
-                    self._fetches_pending = False
-                    self._cond.notify_all()
+                # only count toward an OPEN fetch window: a failover
+                # replay of an already-satisfied barrier (the round it
+                # closed arrived here via replication) must not
+                # pre-pay the NEXT round's fetch count, or a later
+                # round would unlatch with a trainer still mid-fetch
+                if self._fetches_pending:
+                    self._fetch_barriers += 1
+                    if self._fetch_barriers >= self._effective_fanin():
+                        self._fetch_barriers = 0
+                        self._fetches_pending = False
+                        self._cond.notify_all()
             return {"ok": True}, b""
         if kind == "pull_sparse":
             # sparse table pull (pslib PullSparseVarsSync,
@@ -450,6 +791,64 @@ class PSServer:
                 snapshot_scope_to_dir(self._executor, self._scope,
                                       msg.get("dir", ""))
             return {"ok": True}, b""
+        if kind == "replicate":
+            # primary -> backup round stream: post-round blobs + the
+            # dedup watermark, applied atomically with a round-state
+            # reset so a promotion right after is a clean round start
+            if self._active_role():
+                return {"ok": False, "error":
+                        "replicate sent to the active primary %s"
+                        % self._own_endpoint}, b""
+            off = 0
+            with self._lock:
+                for h in msg.get("vars", []):
+                    n = int(np.dtype(h["dtype"]).itemsize
+                            * int(np.prod(h["shape"]) if h["shape"]
+                                  else 1))
+                    self._executor._write_var(
+                        self._scope, h["name"],
+                        _array_from(h, raw[off:off + n]))
+                    off += n
+                # NB "round" is the dedup-token key _call stamps on
+                # every message — the payload round travels separately
+                self._applied_round = int(msg["repl_round"])
+                for cid, s in (msg.get("watermark") or {}).items():
+                    if int(self._repl_watermark.get(cid, 0)) < int(s):
+                        self._repl_watermark[cid] = int(s)
+                self._pending.clear()
+                self._send_barriers = 0
+                self._fetch_barriers = 0
+                self._round_complete = True
+                self._fetches_pending = False
+                self._caught_up = True
+            return {"ok": True, "round": self._applied_round}, b""
+        if kind == "repl_status":
+            return {"ok": True, "active": self._active_role(),
+                    "caught_up": self._caught_up,
+                    "round": self._applied_round,
+                    "index": self._index}, b""
+        if kind == "join_backup":
+            # a relaunched server catching up: snapshot the scope into
+            # its directory AND splice it back into the replication
+            # stream in the same locked step, so every round applied
+            # after the snapshot reaches it
+            if not self._active_role():
+                return {"ok": False, "error":
+                        "join_backup sent to non-active endpoint %s"
+                        % self._own_endpoint}, b""
+            ep = msg.get("endpoint", "")
+            with self._lock:
+                snapshot_scope_to_dir(self._executor, self._scope,
+                                      msg.get("dir", ""),
+                                      names_map=True)
+                # NOT the live _last_seq: a mid-round join must ship
+                # the watermark of the state in the snapshot, or the
+                # pending round's replays would be falsely skipped
+                wm = dict(self._applied_watermark)
+                if ep:
+                    self._repl_dead.discard(ep)
+                return {"ok": True, "round": self._applied_round,
+                        "watermark": wm}, b""
         if kind == "heartbeat":
             with self._lock:
                 evicted = sorted(self._evicted)
@@ -461,11 +860,14 @@ class PSServer:
                     "evicted": evicted,
                     "fanin": self._fanin,
                     "effective_fanin": eff,
+                    "active": self._active_role(),
+                    "round": self._applied_round,
                     # process-wide counters, surfaced so an external
                     # probe (tests, the CI smoke) can assert on
                     # recovery without reaching into this process
                     "evictions": _counter("ps.evictions").value,
                     "readmissions": _counter("ps.readmissions").value,
+                    "promotions": _counter("ps.promotions").value,
                     }, b""
         if kind == "shutdown":
             self._shutdown.set()
@@ -490,6 +892,15 @@ class PSServer:
         cid = msg.get("cid") if isinstance(msg, dict) else None
         if seq is None or cid is None:
             return self._handle(msg, raw)
+        if (msg.get("kind") in ("send_grad", "send_barrier",
+                                "push_sparse")
+                and seq <= int(self._repl_watermark.get(cid, 0))):
+            # failover replay of an rpc whose effect is already folded
+            # into the replicated state this server holds (the
+            # watermark travelled with the round stream / snapshot):
+            # acknowledge without re-executing — exactly-once across
+            # the promotion
+            return {"ok": True, "replayed": True}, b""
         # the dedup token: the client's per-incarnation random nonce
         # (its trainer_id stand-in that survives nothing), the sync
         # round it believes it is in, and its per-connection sequence
@@ -646,6 +1057,12 @@ class PSServer:
             self._sock.close()
         except OSError:
             pass
+        for c in list(self._repl_clients.values()):
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._repl_clients.clear()
         with self._conn_lock:
             conns = list(self._conns)
         for c in conns:
@@ -677,21 +1094,39 @@ class _RPCConnLost(_RetryableRPC):
     pass
 
 
+class _NotPrimary(_RetryableRPC):
+    """The endpoint answered ``not_primary`` — advance along the
+    endpoint list instead of burning the retry budget."""
+
+
 class PSClient:
     """One persistent connection per (endpoint, trainer) —
     grpc_client.cc keeps channels the same way. Every call retries
     with bounded exponential backoff + jitter on timeout/EOF/conn loss
     (``PADDLE_PS_RPC_RETRIES``, default 3); the ``(cid, round, seq)``
     dedup token makes the resend of a non-idempotent rpc
-    (send_grad/barriers) safe — the server executes it exactly once."""
+    (send_grad/barriers) safe — the server executes it exactly once.
+
+    ``endpoint`` may be a comma-separated primary + backups list
+    (``PADDLE_PSERVER_ENDPOINTS``): when the retry budget on the
+    current endpoint is exhausted by TRANSPORT failures, the client
+    fails over to the next endpoint, replays its round log of
+    non-idempotent rpcs with their original dedup tokens, and reissues
+    the in-flight rpc (see the module docstring)."""
 
     _clients: Dict[tuple, "PSClient"] = {}
     _lock = threading.Lock()
 
-    def __init__(self, endpoint: str, trainer_id: int = 0,
+    def __init__(self, endpoint: str, trainer_id: Optional[int] = 0,
                  timeout: Optional[float] = None,
-                 auto_heartbeat: bool = True):
-        self._endpoint = endpoint
+                 auto_heartbeat: bool = True,
+                 rpc_deadline: Optional[float] = None,
+                 max_retries: Optional[int] = None):
+        self._endpoints = [e.strip() for e in str(endpoint).split(",")
+                           if e.strip()]
+        if not self._endpoints:
+            raise ValueError("PSClient needs at least one endpoint")
+        self._ep_idx = 0
         self._trainer_id = trainer_id
         # auto-arm the background heartbeater when the server turns
         # out to be eviction-armed (its responses advertise
@@ -701,15 +1136,38 @@ class PSClient:
             os.environ.get("PADDLE_PS_CONNECT_TIMEOUT", "15"))
         # per-ATTEMPT read deadline: must exceed the server round
         # timeout so only a dead/hung server trips it
-        self._rpc_deadline = float(
-            os.environ.get("PADDLE_PS_RPC_DEADLINE",
-                           str(_ROUND_TIMEOUT + 30.0)))
-        self._max_retries = int(
-            os.environ.get("PADDLE_PS_RPC_RETRIES", "3"))
+        self._rpc_deadline = rpc_deadline if rpc_deadline is not None \
+            else float(os.environ.get("PADDLE_PS_RPC_DEADLINE",
+                                      str(_ROUND_TIMEOUT + 30.0)))
+        self._max_retries = max_retries if max_retries is not None \
+            else int(os.environ.get("PADDLE_PS_RPC_RETRIES", "3"))
+        # failover budget: total endpoint advances per CALL (0 when
+        # there is nowhere to go)
+        self._max_failovers = int(os.environ.get(
+            "PADDLE_PS_FAILOVER_MAX",
+            str(2 * max(0, len(self._endpoints) - 1))))
+        self._failover_count = 0  # the "fo" epoch carried on every rpc
+        # non-idempotent rpcs of the round in flight, with their
+        # stamped dedup tokens — replayed verbatim on a failover;
+        # cleared when a send_barrier succeeds (the round is then
+        # applied AND replicated, so its effects survive the primary).
+        # Bounded: ASYNC mode never sends barriers, so without a cap
+        # the log would grow with every gradient of the job — async
+        # failover is best-effort (a documented gap), and the oldest
+        # entries age out instead of leaking memory
+        self._replay_log: List[tuple] = []
+        self._replay_cap = int(
+            os.environ.get("PADDLE_PS_REPLAY_LOG_CAP", "1024"))
+        self._replay_overflowed = False
         self._backoff_base = float(
             os.environ.get("PADDLE_PS_RPC_BACKOFF_MS", "50")) / 1e3
         self._backoff_cap = float(
             os.environ.get("PADDLE_PS_RPC_BACKOFF_CAP_MS", "2000")) / 1e3
+        # a failover probes endpoints that may be dead: use a short
+        # connect window, not the boot-tolerant default
+        self._failover_connect = float(os.environ.get(
+            "PADDLE_PS_FAILOVER_CONNECT_TIMEOUT",
+            str(min(self._timeout, 5.0))))
         self._io_lock = threading.Lock()
         self._seq = 0  # per-client sequence: lets the server dedupe the
         # reconnect-resend in _call (send_grad/barriers are not
@@ -724,17 +1182,36 @@ class PSClient:
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
         self.evicted_peers: set = set()
-        self._sock = self._connect()
+        try:
+            self._sock = self._connect()
+        except RuntimeError:
+            if len(self._endpoints) == 1:
+                raise
+            # the primary may be down with a backup alive (a trainer
+            # relaunched mid-failover): defer to the first _call,
+            # whose failover path walks the rest of the list
+            self._sock = None
 
-    def _connect(self) -> socket.socket:
+    @property
+    def _endpoint(self) -> str:
+        return self._endpoints[self._ep_idx]
+
+    @property
+    def endpoint(self) -> str:
+        """The endpoint currently in use (moves on failover)."""
+        return self._endpoint
+
+    def _connect(self, timeout: Optional[float] = None) -> socket.socket:
         host, port = self._endpoint.rsplit(":", 1)
-        deadline = time.time() + self._timeout
+        if timeout is None:
+            timeout = self._timeout
+        deadline = time.time() + timeout
         last: Optional[OSError] = None
         while True:  # the pserver process may still be booting
             try:
                 sock = socket.create_connection(
                     (host or "127.0.0.1", int(port)),
-                    timeout=max(self._timeout, 1.0))
+                    timeout=max(timeout, 1.0))
                 # reads get a DEADLINE above the server's round bound:
                 # a functioning server always replies within
                 # _ROUND_TIMEOUT (slow barriers get an error reply), so
@@ -751,7 +1228,7 @@ class PSClient:
                         "cannot reach pserver %s within %.0fs (%r) — is "
                         "the pserver program (listen_and_serv) running, "
                         "with PADDLE_PSERVER_RPC=1 for cross-process "
-                        "mode?" % (self._endpoint, self._timeout, last))
+                        "mode?" % (self._endpoint, timeout, last))
                 time.sleep(0.2)
 
     @classmethod
@@ -798,10 +1275,18 @@ class PSClient:
 
         def loop():
             hb = None
+            hb_ep = None
             while not self._hb_stop.wait(interval_s):
                 try:
+                    if hb is not None and hb_ep != self._endpoint:
+                        # the main client failed over: heartbeats must
+                        # follow it — pinging the abandoned endpoint
+                        # keeps nobody alive anywhere
+                        hb.close()
+                        hb = None
                     if hb is None:
-                        hb = PSClient(self._endpoint,
+                        hb_ep = self._endpoint
+                        hb = PSClient(hb_ep,
                                       trainer_id=self._trainer_id,
                                       auto_heartbeat=False)
                     resp = hb.heartbeat_full()
@@ -860,7 +1345,7 @@ class PSClient:
                 return resp, resp_raw
         except socket.timeout:
             self._drop_sock()
-            _counter("rpc.timeouts").inc()
+            _counter("rpc.timeouts", method=msg.get("kind", "?")).inc()
             raise _RPCTimeout(
                 "pserver %s did not reply within the %.0fs RPC deadline "
                 "(kind=%s)" % (self._endpoint, self._rpc_deadline,
@@ -882,46 +1367,34 @@ class PSClient:
         self._sock = None
 
     def _call(self, msg: dict, raw: bytes = b""):
-        msg.setdefault("trainer_id", self._trainer_id)
+        if self._trainer_id is not None:
+            msg.setdefault("trainer_id", self._trainer_id)
         with self._io_lock:
             self._seq += 1
             msg["seq"] = self._seq
             msg["cid"] = self._cid
             msg["round"] = self._round
-            attempts = 0
-            delay = self._backoff_base
-            last_err: Optional[Exception] = None
-            while True:
-                try:
-                    resp, resp_raw = self._attempt(msg, raw)
-                    break
-                except _RetryableRPC as e:
-                    attempts += 1
-                    last_err = e
-                    if attempts > self._max_retries:
-                        raise RuntimeError(
-                            "%s — gave up after %d attempt(s); the "
-                            "server is dead or hung (raise "
-                            "PADDLE_PS_RPC_DEADLINE / "
-                            "PADDLE_PS_RPC_RETRIES if rounds "
-                            "legitimately run longer)"
-                            % (e, attempts)) from e
-                    _counter("rpc.retries").inc()
-                    # exponential backoff + jitter (grpc_client.cc
-                    # retry semantics); the dedup token makes the
-                    # reissue safe even for non-idempotent kinds
-                    time.sleep(delay * (0.5 + self._jitter.random()))
-                    delay = min(delay * 2.0, self._backoff_cap)
-                except RuntimeError as e:
-                    # the RECONNECT inside a retry failed (server gone
-                    # or its backlog full of our own dead sockets):
-                    # keep the error that started the retrying — "why
-                    # it failed" beats "why the retry failed"
-                    if last_err is not None:
-                        raise RuntimeError(
-                            "%s (while reconnecting after: %s)"
-                            % (e, last_err)) from e
-                    raise
+            msg["fo"] = self._failover_count
+            if (len(self._endpoints) > 1 and msg["kind"] in
+                    ("send_grad", "send_barrier", "push_sparse")):
+                self._replay_log.append((dict(msg), bytes(raw)))
+                if len(self._replay_log) > self._replay_cap:
+                    self._replay_log.pop(0)
+                    if not self._replay_overflowed:
+                        self._replay_overflowed = True
+                        print("[ps_rpc] replay log exceeded %d entries"
+                              " (async mode?); oldest rpcs age out — a"
+                              " failover replay will be PARTIAL (raise"
+                              " PADDLE_PS_REPLAY_LOG_CAP if sync"
+                              " rounds are really this large)"
+                              % self._replay_cap,
+                              file=sys.stderr, flush=True)
+            resp, resp_raw = self._issue(msg, raw)
+            if msg["kind"] == "send_barrier" and resp.get("ok"):
+                # the barrier returned => the round is applied AND
+                # replicated: its effects survive a primary death, so
+                # nothing before this point ever needs replaying
+                self._replay_log.clear()
         ea = resp.get("evict_after") if isinstance(resp, dict) else None
         if ea and self._auto_heartbeat and (
                 self._hb_thread is None or not self._hb_thread.is_alive()):
@@ -932,6 +1405,142 @@ class PSClient:
         if not resp.get("ok"):
             raise RuntimeError("pserver error: %s" % resp.get("error"))
         return resp, resp_raw
+
+    def _issue(self, msg: dict, raw: bytes):
+        """Bounded retry on the current endpoint; on exhaustion (or a
+        ``not_primary`` redirect) advance along the endpoint list,
+        replay the round log, and reissue — bounded by the failover
+        budget. io-locked by caller."""
+        kind = msg.get("kind", "?")
+        attempts = 0
+        failovers = 0
+        delay = self._backoff_base
+        last_err: Optional[Exception] = None
+        while True:
+            try:
+                resp, resp_raw = self._attempt(msg, raw)
+                if isinstance(resp, dict) and resp.get("not_primary"):
+                    raise _NotPrimary(
+                        "pserver %s is not the primary (%s)"
+                        % (self._endpoint, resp.get("error")))
+                return resp, resp_raw
+            except _NotPrimary as e:
+                # a redirect, not a transport failure: advance without
+                # burning the retry budget
+                last_err = e
+                failovers += 1
+                if failovers > self._max_failovers:
+                    raise RuntimeError(
+                        "%s — no endpoint in %s accepted the dataplane "
+                        "after %d failover(s)"
+                        % (e, self._endpoints, failovers - 1)) from e
+                self._failover(e, msg, redirect=True)
+                attempts, delay = 0, self._backoff_base
+            except _RetryableRPC as e:
+                attempts += 1
+                last_err = e
+                if attempts > self._max_retries:
+                    failovers += 1
+                    if failovers > self._max_failovers:
+                        raise RuntimeError(
+                            "%s — gave up after %d attempt(s); the "
+                            "server is dead or hung (raise "
+                            "PADDLE_PS_RPC_DEADLINE / "
+                            "PADDLE_PS_RPC_RETRIES if rounds "
+                            "legitimately run longer)"
+                            % (e, attempts)) from e
+                    self._failover(e, msg)
+                    attempts, delay = 0, self._backoff_base
+                    continue
+                _counter("rpc.retries", method=kind).inc()
+                # exponential backoff + jitter (grpc_client.cc
+                # retry semantics); the dedup token makes the
+                # reissue safe even for non-idempotent kinds
+                time.sleep(delay * (0.5 + self._jitter.random()))
+                delay = min(delay * 2.0, self._backoff_cap)
+            except RuntimeError as e:
+                # the RECONNECT inside a retry failed (server gone
+                # or its backlog full of our own dead sockets)
+                failovers += 1
+                if failovers > self._max_failovers:
+                    # keep the error that started the retrying — "why
+                    # it failed" beats "why the retry failed"
+                    if last_err is not None:
+                        raise RuntimeError(
+                            "%s (while reconnecting after: %s)"
+                            % (e, last_err)) from e
+                    raise
+                self._failover(last_err if last_err is not None else e,
+                               msg)
+                attempts, delay = 0, self._backoff_base
+
+    def _failover(self, cause: Exception, msg: dict,
+                  redirect: bool = False) -> None:
+        """Advance to the next endpoint that accepts a connection and
+        the round-log replay (deterministic list order — the
+        lowest-index live endpoint ends up promoted). Raises
+        RuntimeError when no endpoint works."""
+        n = len(self._endpoints)
+        start = self._ep_idx
+        self._failover_count += 1
+        msg["fo"] = self._failover_count
+        last: Exception = cause
+        for k in range(1, n):
+            self._ep_idx = (start + k) % n
+            self._drop_sock()
+            try:
+                self._sock = self._connect(
+                    timeout=self._failover_connect)
+                self._replay()
+            except (_RetryableRPC, RuntimeError, OSError) as e:
+                last = e
+                self._drop_sock()
+                continue
+            _counter("ps.failovers",
+                     cause="redirect" if redirect else "transport").inc()
+            print("[ps_rpc] trainer %s failed over %s -> %s "
+                  "(replayed %d rpc(s); after: %s)"
+                  % (self._trainer_id,
+                     self._endpoints[start], self._endpoint,
+                     len(self._replay_log), cause),
+                  file=sys.stderr, flush=True)
+            return
+        self._ep_idx = start
+        raise RuntimeError(
+            "no reachable pserver among %s (last failover error: %s; "
+            "failing over after: %s)" % (self._endpoints, last, cause))
+
+    def _replay(self) -> None:
+        """Reissue the round log on the endpoint just connected, with
+        the ORIGINAL dedup tokens: rpcs the new primary already holds
+        (via replication) are acknowledged as ``replayed`` without
+        re-executing; the rest rebuild the in-flight round."""
+        for m, r in list(self._replay_log):
+            m["fo"] = self._failover_count
+            delay = self._backoff_base
+            for attempt in range(self._max_retries + 1):
+                try:
+                    resp, _ = self._attempt(m, r)
+                    break
+                except _RetryableRPC:
+                    # transient fault on an otherwise-healthy new
+                    # endpoint (e.g. an injected drop): retry HERE —
+                    # advancing past it would abandon a live primary
+                    if attempt >= self._max_retries:
+                        raise
+                    _counter("rpc.retries",
+                             method=m.get("kind", "?")).inc()
+                    time.sleep(delay * (0.5 + self._jitter.random()))
+                    delay = min(delay * 2.0, self._backoff_cap)
+            if resp.get("not_primary"):
+                raise _NotPrimary(
+                    "pserver %s refused the failover replay"
+                    % self._endpoint)
+            if not (resp.get("ok") or resp.get("replayed")
+                    or resp.get("stale")):
+                raise RuntimeError(
+                    "pserver error during failover replay of %s: %s"
+                    % (m.get("kind"), resp.get("error")))
 
     def send_grad(self, name: str, value) -> None:
         arr = np.ascontiguousarray(np.asarray(value))
@@ -974,6 +1583,19 @@ class PSClient:
     def checkpoint(self, dirname: str) -> None:
         """Ask the server to snapshot its vars (checkpoint_notify)."""
         self._call({"kind": "checkpoint", "dir": dirname})
+
+    def replicate(self, round_no: int, var_headers: List[dict],
+                  raw: bytes, watermark: Dict[str, int]) -> None:
+        """Primary-side: ship one applied round (post-round blobs +
+        dedup watermark) to the backup this client points at; returns
+        only on the backup's ack."""
+        self._call({"kind": "replicate", "repl_round": int(round_no),
+                    "vars": var_headers, "watermark": watermark}, raw)
+
+    def repl_status(self) -> dict:
+        """role/round probe: ``{"active":, "caught_up":, "round":}``."""
+        resp, _ = self._call({"kind": "repl_status"})
+        return resp
 
     def heartbeat(self) -> Dict[int, float]:
         resp, _ = self._call({"kind": "heartbeat"})

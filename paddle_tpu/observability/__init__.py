@@ -34,8 +34,15 @@ Metric families (see README "Runtime observability"):
 ``memory.*_bytes``                     gauge: live/peak/limit device bytes
 ``serving.*``                          serving engine (always-on; see
                                        ``paddle_tpu/serving/metrics.py``)
-``rpc.retries`` / ``rpc.timeouts``     counter: PS client recovery events
+``rpc.retries{method=}``               counter: PS client retries per rpc
+``rpc.timeouts{method=}``              counter: per-attempt deadline trips
 ``ps.evictions`` / ``ps.readmissions`` counter: heartbeat-monitor actions
+``ps.failovers{cause=}``               counter: client endpoint advances
+                                       (cause: transport | redirect)
+``ps.promotions``                      counter: backup -> primary
+``ps.catchup_ms``                      histogram: rejoin snapshot catch-up
+``ps.replication_lag_rounds{backup=}`` gauge: rounds the backup is behind
+                                       (0 after each ack; frozen = dropped)
 ``fault.injected{side=,kind=}``        counter: injected RPC-frame faults
 ``checkpoint.save_ms``                 histogram: atomic checkpoint commit
 ``checkpoint.bytes``                   counter: checkpointed payload bytes
@@ -44,7 +51,11 @@ Metric families (see README "Runtime observability"):
 
 The ``rpc.* / ps.* / fault.* / checkpoint.*`` families (like
 ``serving.*``) record unconditionally — recovery events are rare, and
-CI asserts on them without needing ``PADDLE_TPU_METRICS``.
+CI asserts on them without needing ``PADDLE_TPU_METRICS``. The
+``method=`` label on ``rpc.retries`` / ``rpc.timeouts`` exists for
+retry-policy tuning: a rising retry rate under a clean network on ONE
+method (say ``send_barrier``) means that call shape's per-attempt
+deadline is mis-set, not the transport.
 
 Export: ``dump()`` -> JSON-able dict, ``dump(fmt="prometheus")`` ->
 text exposition format, ``chrome_trace()`` / ``write_chrome_trace()``

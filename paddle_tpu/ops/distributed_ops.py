@@ -75,8 +75,15 @@ def _listen_and_serv(executor, op, scope):
 def _rpc_client(ep):
     import os
 
-    from ..distributed.ps_rpc import PSClient
+    from ..distributed.ps_rpc import PSClient, _endpoints_from_env
 
+    # PADDLE_PSERVER_ENDPOINTS names a REPLICA group (primary +
+    # backups). When this op targets the group's primary, hand the
+    # client the whole list so it can fail over; any other endpoint
+    # (a different shard) stays pinned.
+    replicas = _endpoints_from_env()
+    if replicas and replicas[0] == ep:
+        ep = ",".join(replicas)
     return PSClient.for_endpoint(
         ep, trainer_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
 

@@ -35,7 +35,7 @@ from .batcher import BatchPolicy, DynamicBatcher, PendingRequest
 
 __all__ = ["ServingConfig", "ServingEngine", "ServingError",
            "ServerOverloaded", "DeadlineExpired", "EngineStopped",
-           "RequestTooLarge"]
+           "RequestTooLarge", "BatchExecutionError"]
 
 
 class ServingError(RuntimeError):
@@ -58,6 +58,14 @@ class EngineStopped(ServingError):
 class RequestTooLarge(ServingError):
     """A single request's rows exceed max_batch_size; the batcher never
     splits a request, so it could never be scheduled."""
+
+
+class BatchExecutionError(ServingError):
+    """The predictor (or output unpadding) blew up inside a batch
+    dispatch. Exactly the co-batched requests fail — with this typed
+    error (HTTP 500) — and the engine stays healthy: worker threads
+    survive, the next batch dispatches normally. The original
+    exception rides along as ``__cause__``."""
 
 
 class ServingConfig:
@@ -360,10 +368,25 @@ class ServingEngine:
         try:
             outs = self._predictor.run(feed)
             outputs = {t.name: np.asarray(t.data) for t in outs}
+        except Exception as e:  # noqa: BLE001 — the MODEL failed: the
+            # batch fails as a unit with the TYPED wrapper (HTTP 500),
+            # serving.batch_errors counts the event once, and the
+            # worker thread survives for the next batch
+            _m.inc(_m.ERRORS, len(live))
+            _m.inc(_m.BATCH_ERRORS)
+            err = BatchExecutionError(
+                "batch dispatch failed (%d request(s), bucket %d): "
+                "%s: %s" % (len(live), bucket, type(e).__name__, e))
+            err.__cause__ = e
+            for p in live:
+                self._fail(p, err)
+            return
+        try:
             results = self._batcher.split_outputs(outputs, slices, bucket)
-        except Exception as e:  # noqa: BLE001 — batch fails as a unit;
-            # a stranded future would hang its caller forever, so ANY
-            # dispatch-side error (model or unpadding) must resolve them
+        except Exception as e:  # noqa: BLE001 — unpadding failed (an
+            # output-contract violation, not a model crash): resolve
+            # the futures with the original error — a stranded future
+            # would hang its caller forever
             _m.inc(_m.ERRORS, len(live))
             for p in live:
                 self._fail(p, e)

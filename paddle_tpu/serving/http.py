@@ -11,7 +11,9 @@ Endpoints:
   "deadline_ms": optional}``; arrays carry the leading batch axis.
   Replies ``{"outputs": {name: nested-list}, "latency_ms": float}``.
   Typed failures map onto status codes: 503 (overloaded / stopped,
-  with ``Retry-After``), 504 (deadline expired), 400 (malformed).
+  with ``Retry-After``), 504 (deadline expired), 400 (malformed),
+  500 (``BatchExecutionError`` — the model failed on that batch; the
+  engine stays healthy).
 - ``GET /healthz`` — 200 while the engine accepts work, 503 otherwise
   (the load-balancer drain signal).
 - ``GET /metrics`` — Prometheus text exposition straight from the
@@ -28,8 +30,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 from .. import observability as _obs
-from .engine import (DeadlineExpired, EngineStopped, RequestTooLarge,
-                     ServerOverloaded, ServingEngine)
+from .engine import (BatchExecutionError, DeadlineExpired, EngineStopped,
+                     RequestTooLarge, ServerOverloaded, ServingEngine)
 
 __all__ = ["ServingHTTPServer", "start_http_server", "serve"]
 
@@ -104,6 +106,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_json(503, {"error": str(e)})
         except DeadlineExpired as e:
             self._reply_json(504, {"error": str(e)})
+        except BatchExecutionError as e:
+            # the MODEL failed on this batch: the engine is still
+            # healthy (don't drain), the CLIENT isn't at fault (not a
+            # 4xx) — a plain 500 with the typed name
+            self._reply_json(500, {"error": str(e),
+                                   "type": "BatchExecutionError"})
         except (ValueError, RequestTooLarge, json.JSONDecodeError) as e:
             self._reply_json(400, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — the model failed

@@ -14,7 +14,8 @@ Families (README "Serving"):
 ``serving.requests``               counter: admitted requests
 ``serving.rejected``               counter: admission-control rejections
 ``serving.deadline_expired``       counter: dropped before dispatch
-``serving.errors``                 counter: dispatch failures
+``serving.errors``                 counter: dispatch failures (per req)
+``serving.batch_errors``           counter: predictor-failed batches
 ``serving.batches``                counter: dispatched micro-batches
 ``serving.padding_waste``          counter: padded rows (bucket - real)
 ``serving.batch_size``             histogram: real rows per micro-batch
@@ -33,8 +34,9 @@ from __future__ import annotations
 from .. import observability as _obs
 
 __all__ = [
-    "REQUESTS", "REJECTED", "DEADLINE_EXPIRED", "ERRORS", "BATCHES",
-    "PADDING_WASTE", "BATCH_SIZE", "QUEUE_MS", "TOTAL_MS", "QUEUE_DEPTH",
+    "REQUESTS", "REJECTED", "DEADLINE_EXPIRED", "ERRORS",
+    "BATCH_ERRORS", "BATCHES", "PADDING_WASTE", "BATCH_SIZE",
+    "QUEUE_MS", "TOTAL_MS", "QUEUE_DEPTH",
     "inc", "observe", "set_queue_depth", "snapshot",
 ]
 
@@ -42,6 +44,7 @@ REQUESTS = "serving.requests"
 REJECTED = "serving.rejected"
 DEADLINE_EXPIRED = "serving.deadline_expired"
 ERRORS = "serving.errors"
+BATCH_ERRORS = "serving.batch_errors"
 BATCHES = "serving.batches"
 PADDING_WASTE = "serving.padding_waste"
 BATCH_SIZE = "serving.batch_size"
@@ -66,8 +69,8 @@ def snapshot() -> dict:
     """Current serving counters/latencies as a plain dict (the
     ``ServingEngine.stats()`` payload)."""
     out = {}
-    for name in (REQUESTS, REJECTED, DEADLINE_EXPIRED, ERRORS, BATCHES,
-                 PADDING_WASTE):
+    for name in (REQUESTS, REJECTED, DEADLINE_EXPIRED, ERRORS,
+                 BATCH_ERRORS, BATCHES, PADDING_WASTE):
         out[name] = _obs.counter_value(name)
     out[QUEUE_DEPTH] = _obs.gauge_value(QUEUE_DEPTH)
     for name in (BATCH_SIZE, QUEUE_MS, TOTAL_MS):

@@ -2,7 +2,16 @@
 collective-fleet arm of the test_dist_base contract. Each process
 initializes jax.distributed (2 CPU backends, Gloo collectives), builds
 the same program, and runs it through CompiledProgram.with_data_parallel
-over the 2-process global mesh, feeding its OWN batch shard."""
+over the 2-process global mesh, feeding its OWN batch shard.
+
+FLEET_DATA_ENDPOINT (optional) switches the per-step batch source from
+the local RNG to a PS data server: every step's full batch is PULLED
+over the ``ps_rpc`` transport — which routes every frame through
+``distributed/fault.py`` — so the collective-fleet path trains through
+injected network faults (the PSClient retry + seq-matched responses
+absorb them) and must still converge to the clean-run losses. The
+server precomputes the SAME rng(7) batches, so parity targets are
+unchanged."""
 import json
 import os
 import sys
@@ -25,6 +34,14 @@ def main():
     # 2-process run consumes (ORACLE_WORLD mimics that world size)
     world = int(os.environ.get("ORACLE_WORLD", nranks))
     local_bs = SHARD * world // nranks
+
+    data_client = None
+    data_ep = os.environ.get("FLEET_DATA_ENDPOINT")
+    if data_ep:
+        from paddle_tpu.distributed.ps_rpc import PSClient
+
+        data_client = PSClient(data_ep, trainer_id=rank,
+                               auto_heartbeat=False)
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
@@ -58,10 +75,16 @@ def main():
         exe.run(startup)
         rng = np.random.RandomState(7)
         losses = []
-        for _ in range(STEPS):
-            full_x = rng.randn(SHARD * world, DIM).astype("float32")
-            full_y = rng.randint(0, CLASSES,
-                                 (SHARD * world, 1)).astype("int64")
+        for step in range(STEPS):
+            if data_client is not None:
+                # batch over the fault-injected ps_rpc transport (the
+                # data server precomputed the same rng(7) sequence)
+                full_x = data_client.get_param("x_s%d" % step)
+                full_y = data_client.get_param("y_s%d" % step)
+            else:
+                full_x = rng.randn(SHARD * world, DIM).astype("float32")
+                full_y = rng.randint(0, CLASSES,
+                                     (SHARD * world, 1)).astype("int64")
             my_x = full_x[rank * local_bs:(rank + 1) * local_bs]
             my_y = full_y[rank * local_bs:(rank + 1) * local_bs]
             (l,) = exe.run(compiled, feed={"x": my_x, "y": my_y},

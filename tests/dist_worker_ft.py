@@ -1,16 +1,25 @@
-"""Worker for the fault-tolerance multiprocess tests + CI smoke.
+"""Worker for the fault-tolerance multiprocess tests + CI smokes.
 
-Role from FT_ROLE:
+Role from PADDLE_ROLE (the launch supervisor sets it) or FT_ROLE:
 
 - ``pserver`` — serve a single dense param "w" (4 floats, SGD lr 0.1)
   behind the RunSyncLoop round protocol with heartbeat eviction armed
-  (PADDLE_PS_EVICT_AFTER); blocks until a shutdown rpc.
+  (PADDLE_PS_EVICT_AFTER); blocks until a shutdown rpc or SIGTERM.
+  Multi-server mode: PADDLE_PSERVER_ENDPOINTS (full ordered list) +
+  PSERVER_ENDPOINT (own) make index 0 the replication primary and the
+  rest backups; PADDLE_PS_REJOIN=1 (launcher, on relaunch) rejoins as
+  a catching-up backup. FT_SERVER_DIE_AT_ROUND makes the INITIAL
+  PRIMARY SIGKILL itself while applying that round (grads in, round
+  applied locally, never replicated — the worst spot) on its first
+  incarnation — the server-death failover scenario.
 - ``trainer`` — FT_ROUNDS sync rounds of deterministic grads against
-  the live server, checkpointing after every completed round via
+  the live server(s), checkpointing after every completed round via
   CheckpointManager (atomic + rotated), resuming from the newest valid
   checkpoint on restart. FT_DIE_AT_ROUND + FT_DIE_RANK make one rank
   SIGKILL itself mid-round (after send_grad, before the barrier) on
   its first incarnation — the supervised-relaunch scenario.
+  PSERVER_ENDPOINT may be the comma-separated endpoint list —
+  PSClient fails over along it.
 
 Env contract: PSERVER_ENDPOINT, PADDLE_TRAINER_ID (the launcher sets
 it), PADDLE_RESTART_COUNT (launcher, on relaunch), FT_OUT (result JSON
@@ -63,13 +72,38 @@ def grad_for(tid: int, rnd: int) -> np.ndarray:
 
 
 def run_pserver():
-    endpoint = os.environ["PSERVER_ENDPOINT"]
+    endpoints_raw = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+    endpoints = [e.strip() for e in endpoints_raw.split(",")
+                 if e.strip()]
+    endpoint = os.environ.get("PSERVER_ENDPOINT")
+    if not endpoint:
+        idx = int(os.environ.get("PADDLE_PSERVER_INDEX", "0"))
+        endpoint = endpoints[idx]
     fanin = int(os.environ.get("PADDLE_TRAINERS_NUM", "2"))
+    rejoin = os.environ.get("PADDLE_PS_REJOIN") == "1"
+    die_round = int(os.environ.get("FT_SERVER_DIE_AT_ROUND", "0"))
+    index = endpoints.index(endpoint) if endpoint in endpoints else 0
+
     scope = MiniScope()
     scope["w"] = np.zeros(DIM, dtype=np.float32)
+
+    applied = {"rounds": 0}
+    suicidal = die_round > 0 and index == 0 and not rejoin
+
+    def _block(scope):
+        _sgd_block(scope)
+        applied["rounds"] += 1
+        if suicidal and applied["rounds"] == die_round:
+            # die while APPLYING the round: grads are summed and the
+            # local optimize ran, but the round was never replicated —
+            # the trainers must rebuild it on the promoted backup from
+            # their replay logs
+            os.kill(os.getpid(), signal.SIGKILL)
+
     server = PSServer(endpoint, MiniExec(), scope,
-                      {"w@GRAD": _sgd_block}, fanin=fanin,
-                      sync_mode=True)
+                      {"w@GRAD": _block}, fanin=fanin,
+                      sync_mode=True,
+                      endpoints=endpoints or None, rejoin=rejoin)
     server.serve_forever()
     server.stop()
 
@@ -133,11 +167,19 @@ def run_trainer():
                                     | set(hb.get("evicted", []))),
             "evictions": hb.get("evictions"),
             "readmissions": hb.get("readmissions"),
+            # failover telemetry: which endpoint the client ended on,
+            # how many times it advanced, and the serving side's view
+            "endpoint": client.endpoint,
+            "ep_idx": client._ep_idx,
+            "failovers": client._failover_count,
+            "server_active": hb.get("active"),
+            "server_round": hb.get("round"),
+            "server_promotions": hb.get("promotions"),
         }, f)
 
 
 def main():
-    role = os.environ["FT_ROLE"]
+    role = os.environ.get("PADDLE_ROLE") or os.environ["FT_ROLE"]
     if role == "pserver":
         run_pserver()
     elif role == "trainer":

@@ -1,4 +1,4 @@
-"""Fault-tolerant distributed training (ISSUE 3).
+"""Fault-tolerant distributed training (ISSUES 3 + 4).
 
 Covers: the deterministic fault-injection shim at the RPC frame
 boundary; client retry + server dedup keeping gradient application
@@ -7,7 +7,15 @@ clean run); heartbeat eviction unblocking survivors after a SIGKILL;
 supervised relaunch resuming from the newest valid checkpoint; atomic
 checkpoint dirs (manifest, rotation, corrupt-shard fallback); typed
 load errors; PS server port hygiene on stop(); serving /healthz
-draining."""
+draining.
+
+ISSUE 4 additions: PS state replication + client failover (primary
+killed mid-round, trainers fail over to the backup and the final
+params match the clean run bit-for-bit); backup promotion rules
+(fresh clients redirected, only failed-over clients promote); server
+rejoin catch-up from a manifest-verified snapshot; chaos-drill
+schedule determinism; scope-snapshot load integrity; serving typed
+batch errors; per-method rpc counter labels."""
 import json
 import os
 import signal
@@ -458,6 +466,436 @@ def test_supervised_relaunch_resumes_from_checkpoint(tmp_path):
         if ps.poll() is None:
             ps.kill()
         ps.communicate(timeout=10)
+
+
+# -- replication + failover (ISSUE 4) ---------------------------------------
+
+
+def _fast_failover_env(monkeypatch):
+    """Client knobs that make an in-process failover take ~1s instead
+    of the boot-tolerant defaults (read at PSClient construction)."""
+    monkeypatch.setenv("PADDLE_PS_CONNECT_TIMEOUT", "1")
+    monkeypatch.setenv("PADDLE_PS_FAILOVER_CONNECT_TIMEOUT", "1")
+    monkeypatch.setenv("PADDLE_PS_RPC_RETRIES", "2")
+    monkeypatch.setenv("PADDLE_PS_RPC_BACKOFF_MS", "10")
+    monkeypatch.setenv("PADDLE_PS_RPC_DEADLINE", "20")
+
+
+def _mk_ps(eps, i, rejoin=False, fanin=2):
+    from paddle_tpu.distributed.ps_rpc import PSServer
+
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, dtype=np.float32)
+    server = PSServer(eps[i], MiniExec(), scope,
+                      {"w@GRAD": _sgd_block}, fanin=fanin,
+                      endpoints=eps, rejoin=rejoin)
+    server.start_background()
+    return server, scope
+
+
+def _clean_w(rounds, dim=4):
+    w = np.zeros(dim, dtype=np.float32)
+    for rnd in range(1, rounds + 1):
+        scope = {"w": w, "w@GRAD": _grad(0, rnd, dim)
+                 + _grad(1, rnd, dim)}
+        _sgd_block(scope)
+        w = scope["w"]
+    return w
+
+
+def test_replicated_ps_failover_bitwise(monkeypatch):
+    """Primary killed mid-round 3 (both grads in, round never applied
+    or replicated): both trainers must fail over to the backup, replay
+    their round logs exactly once (replicated dedup watermark), and
+    finish with params matching the clean single-server run
+    BIT-FOR-BIT. The backup must have been promoted by a genuinely
+    failed-over client."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_failover_env(monkeypatch)
+    eps = ["127.0.0.1:%d" % _free_port(), "127.0.0.1:%d" % _free_port()]
+    s0, _sc0 = _mk_ps(eps, 0)
+    s1, sc1 = _mk_ps(eps, 1)
+    rounds, kill_at = 6, 3
+    gate = threading.Barrier(3)
+    errors, ws = [], {}
+    fo0 = obs.counter_value("ps.failovers", cause="transport") or 0
+
+    def trainer(tid):
+        try:
+            c = PSClient(",".join(eps), trainer_id=tid)
+            w = None
+            for rnd in range(1, rounds + 1):
+                c.send_grad("w@GRAD", _grad(tid, rnd))
+                if rnd == kill_at:
+                    gate.wait(timeout=30)  # round-3 grads are in
+                    gate.wait(timeout=30)  # main thread killed s0
+                c.send_barrier()
+                w = c.get_param("w")
+                c.fetch_barrier()
+            ws[tid] = w
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errors.append((tid, e))
+
+    try:
+        ts = [threading.Thread(target=trainer, args=(t,))
+              for t in (0, 1)]
+        for t in ts:
+            t.start()
+        gate.wait(timeout=30)
+        s0.stop()  # sever mid-round: the round dies with the primary
+        gate.wait(timeout=30)
+        for t in ts:
+            t.join(timeout=90)
+        assert not any(t.is_alive() for t in ts), "failover deadlocked"
+        assert not errors, errors
+        expected = _clean_w(rounds)
+        assert ws[0].tobytes() == expected.tobytes()
+        assert ws[1].tobytes() == expected.tobytes()
+        assert s1._promoted, "backup was never promoted"
+        np.testing.assert_array_equal(np.asarray(sc1["w"]), expected)
+        assert (obs.counter_value("ps.failovers", cause="transport")
+                or 0) >= fo0 + 2
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_backup_redirects_fresh_clients_no_promotion(monkeypatch):
+    """A FRESH client whose endpoint list starts at a backup must be
+    redirected to the live primary WITHOUT promoting the backup — the
+    split-brain guard (only a client that watched its endpoint die,
+    fo >= 1, may promote)."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_failover_env(monkeypatch)
+    eps = ["127.0.0.1:%d" % _free_port(), "127.0.0.1:%d" % _free_port()]
+    s0, sc0 = _mk_ps(eps, 0, fanin=1)
+    s1, _sc1 = _mk_ps(eps, 1, fanin=1)
+    try:
+        # list order reversed: the client walks INTO the backup first
+        c = PSClient("%s,%s" % (eps[1], eps[0]), trainer_id=0)
+        c.send_grad("w@GRAD", _grad(0, 1))
+        c.send_barrier()
+        w = c.get_param("w")
+        c.fetch_barrier()
+        assert c.endpoint == eps[0], "client not redirected to primary"
+        assert not s1._promoted, "redirect must not promote the backup"
+        exp = {"w": np.zeros(4, "f4"), "w@GRAD": _grad(0, 1)}
+        _sgd_block(exp)
+        np.testing.assert_array_equal(w, exp["w"])
+        # and the round reached the primary, not the backup
+        np.testing.assert_array_equal(np.asarray(sc0["w"]), exp["w"])
+        c.close()
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_rejoined_server_catches_up_and_survives_second_kill(
+        monkeypatch):
+    """Full availability cycle: primary dies (failover #1), relaunched
+    server rejoins as a backup via the manifest-verified snapshot
+    catch-up, then the CURRENT primary dies and the rejoined server is
+    promoted (failover #2, wrapping the endpoint list) — final params
+    still bit-for-bit."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_failover_env(monkeypatch)
+    eps = ["127.0.0.1:%d" % _free_port(), "127.0.0.1:%d" % _free_port()]
+    s0, _ = _mk_ps(eps, 0)
+    s1, _ = _mk_ps(eps, 1)
+    rounds = 8
+    gate1, gate2 = threading.Barrier(3), threading.Barrier(3)
+    errors, ws = [], {}
+
+    def trainer(tid):
+        try:
+            c = PSClient(",".join(eps), trainer_id=tid)
+            w = None
+            for rnd in range(1, rounds + 1):
+                if rnd == 3:
+                    gate1.wait(timeout=60)  # s0 is killed
+                if rnd == 6:
+                    gate2.wait(timeout=60)  # s0 rejoined; s1 killed
+                c.send_grad("w@GRAD", _grad(tid, rnd))
+                c.send_barrier()
+                w = c.get_param("w")
+                c.fetch_barrier()
+            ws[tid] = w
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errors.append((tid, e))
+
+    s0b = None
+    try:
+        ts = [threading.Thread(target=trainer, args=(t,))
+              for t in (0, 1)]
+        for t in ts:
+            t.start()
+        gate1.wait(timeout=60)
+        s0.stop()
+        s0b, _ = _mk_ps(eps, 0, rejoin=True)
+        deadline = time.time() + 30
+        while not s0b._caught_up and time.time() < deadline:
+            time.sleep(0.1)
+        assert s0b._caught_up, "rejoined server never caught up"
+        time.sleep(0.3)  # let at least one replicated round stream
+        gate2.wait(timeout=60)
+        s1.stop()
+        for t in ts:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in ts), "deadlocked"
+        assert not errors, errors
+        expected = _clean_w(rounds)
+        assert ws[0].tobytes() == expected.tobytes()
+        assert ws[1].tobytes() == expected.tobytes()
+        assert s0b._promoted, "rejoined server never promoted"
+    finally:
+        s0.stop()
+        s1.stop()
+        if s0b is not None:
+            s0b.stop()
+
+
+def test_scope_snapshot_roundtrip_and_corruption(tmp_path):
+    """The rejoin catch-up primitive: snapshot_scope_to_dir with the
+    names map restores exact var names and bytes; a flipped byte is a
+    typed CheckpointCorrupt, never garbage params."""
+    from paddle_tpu.checkpoint import (CheckpointCorrupt,
+                                       load_scope_snapshot)
+    from paddle_tpu.distributed.ps_rpc import snapshot_scope_to_dir
+
+    exe = MiniExec()
+    scope = MiniScope()
+    scope["w"] = np.arange(4, dtype=np.float32)
+    scope["emb/table"] = np.ones((3, 2), dtype=np.float32)
+    d = str(tmp_path / "snap")
+    snapshot_scope_to_dir(exe, scope, d, names_map=True)
+
+    restored = MiniScope()
+    assert load_scope_snapshot(exe, restored, d) == 2
+    assert set(restored) == {"w", "emb/table"}  # exact names, un-munged
+    np.testing.assert_array_equal(restored["w"], scope["w"])
+    np.testing.assert_array_equal(restored["emb/table"],
+                                  scope["emb/table"])
+
+    with open(os.path.join(d, "w"), "r+b") as f:
+        f.seek(8)
+        b = f.read(1)
+        f.seek(8)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CheckpointCorrupt, match="sha256"):
+        load_scope_snapshot(exe, MiniScope(), d)
+
+
+# -- chaos drill determinism -------------------------------------------------
+
+
+def test_random_plan_seeded_and_parses():
+    import random as _random
+
+    from paddle_tpu.distributed.fault import parse_plan, random_plan
+
+    plans = {random_plan(_random.Random(5)) for _ in range(3)}
+    assert len(plans) == 1, "same rng seed must yield one plan"
+    plan = plans.pop()
+    assert parse_plan(plan), plan
+    assert random_plan(_random.Random(6)) != plan
+
+
+def test_chaos_schedule_deterministic():
+    """Same PADDLE_TPU_FAULT_SEED -> identical fault schedule (the CI
+    acceptance knob: a failing drill replays from its printed seed)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import chaos_drill
+
+    a = chaos_drill.make_schedule(4242, sync_rounds=6)
+    b = chaos_drill.make_schedule(4242, sync_rounds=6)
+    assert a == b
+    assert chaos_drill.make_schedule(4243, sync_rounds=6) != a
+    from paddle_tpu.distributed.fault import parse_plan
+
+    assert parse_plan(a["plan"])
+    assert 1 <= a["trainer_kill_round"] <= 5
+    assert 1 <= a["server_kill_round"] <= 5
+    assert a["trainer_kill_rank"] in (0, 1)
+
+
+def test_chaos_inprocess_same_seed_same_params(monkeypatch):
+    """Fast tier-1 chaos variant (in-process servers): seeded frame
+    faults + a primary kill mid-run, twice with the same seed — both
+    runs must land on the SAME final params, equal to the clean run
+    (the bit-for-bit dedup invariant, which is exactly what makes the
+    schedule reproducible end to end)."""
+    from paddle_tpu.distributed import fault
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_failover_env(monkeypatch)
+    monkeypatch.setenv("PADDLE_PS_RPC_DEADLINE", "1.0")
+    monkeypatch.setenv("PADDLE_PS_RPC_RETRIES", "12")
+    monkeypatch.setenv("PADDLE_TPU_FAULTS",
+                       "send.drop:0.04,send.dup:0.04")
+    monkeypatch.setenv("PADDLE_TPU_FAULT_SEED", "99")
+    rounds, kill_at = 5, 2
+
+    def one_run():
+        fault.reset_injector()
+        eps = ["127.0.0.1:%d" % _free_port(),
+               "127.0.0.1:%d" % _free_port()]
+        s0, _ = _mk_ps(eps, 0)
+        s1, _ = _mk_ps(eps, 1)
+        gate = threading.Barrier(3)
+        errors, ws = [], {}
+
+        def trainer(tid):
+            try:
+                c = PSClient(",".join(eps), trainer_id=tid)
+                w = None
+                for rnd in range(1, rounds + 1):
+                    c.send_grad("w@GRAD", _grad(tid, rnd))
+                    if rnd == kill_at:
+                        gate.wait(timeout=60)
+                        gate.wait(timeout=60)
+                    c.send_barrier()
+                    w = c.get_param("w")
+                    c.fetch_barrier()
+                ws[tid] = w
+                c.close()
+            except Exception as e:  # pragma: no cover
+                errors.append((tid, e))
+
+        try:
+            ts = [threading.Thread(target=trainer, args=(t,))
+                  for t in (0, 1)]
+            for t in ts:
+                t.start()
+            gate.wait(timeout=60)
+            s0.stop()
+            gate.wait(timeout=60)
+            for t in ts:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in ts), "deadlocked"
+            assert not errors, errors
+            return ws[0].tobytes(), ws[1].tobytes()
+        finally:
+            s0.stop()
+            s1.stop()
+
+    try:
+        first = one_run()
+        second = one_run()
+    finally:
+        monkeypatch.delenv("PADDLE_TPU_FAULTS")
+        fault.reset_injector()
+    expected = _clean_w(rounds).tobytes()
+    assert first == (expected, expected)
+    assert second == first
+
+
+# -- per-method rpc counter labels -------------------------------------------
+
+
+def test_rpc_counters_labeled_by_method(monkeypatch):
+    """rpc.timeouts / rpc.retries carry a method= label so a mis-set
+    per-attempt deadline shows up against the call shape that trips
+    it (ROADMAP retry-tuning item)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import fault
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, dtype=np.float32)
+    endpoint = "127.0.0.1:%d" % _free_port()
+    server = PSServer(endpoint, MiniExec(), scope, {}, fanin=1)
+    server.start_background()
+    t0 = obs.counter_value("rpc.timeouts", method="get_param") or 0
+    r0 = obs.counter_value("rpc.retries", method="get_param") or 0
+    try:
+        c = PSClient(endpoint, trainer_id=0, rpc_deadline=0.3,
+                     max_retries=1)
+        monkeypatch.setenv("PADDLE_TPU_FAULTS", "send.drop:1.0")
+        monkeypatch.setenv("PADDLE_TPU_FAULT_SEED", "1")
+        fault.reset_injector()
+        with pytest.raises(RuntimeError):
+            c.get_param("w")
+        monkeypatch.delenv("PADDLE_TPU_FAULTS")
+        fault.reset_injector()
+        assert (obs.counter_value("rpc.timeouts", method="get_param")
+                - t0) >= 1
+        assert (obs.counter_value("rpc.retries", method="get_param")
+                - r0) >= 1
+        # the unlabeled aggregate is NOT silently double-counted
+        c.close()
+    finally:
+        fault.reset_injector()
+        server.stop()
+
+
+# -- serving: typed batch errors ---------------------------------------------
+
+
+def test_serving_batch_error_typed_and_engine_stays_healthy():
+    """A predictor exception inside a batch dispatch fails exactly that
+    batch's futures with the typed BatchExecutionError (HTTP 500),
+    increments serving.batch_errors once per failed batch, and leaves
+    the engine serving the next request."""
+    import urllib.request
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.serving.engine import (BatchExecutionError,
+                                           ServingConfig, ServingEngine)
+    from paddle_tpu.serving.http import start_http_server
+
+    class FlakyPredictor:
+        def get_input_names(self):
+            return ["x"]
+
+        def run(self, feed):
+            x = np.asarray(feed["x"])
+            if float(x.max()) > 100.0:
+                raise RuntimeError("NaN in layer 3")
+
+            class T:
+                name = "y"
+                data = x * 2.0
+
+            return [T()]
+
+    be0 = obs.counter_value("serving.batch_errors") or 0
+    eng = ServingEngine(
+        FlakyPredictor(),
+        ServingConfig(max_batch_size=2, num_workers=1, warmup=False),
+        sample_feed={"x": np.zeros((1, 3), "f4")}).start()
+    server, _thread = start_http_server(eng)
+    base = "http://127.0.0.1:%d" % server.server_address[1]
+    try:
+        f = eng.submit({"x": np.full((1, 3), 999.0, "f4")})
+        with pytest.raises(BatchExecutionError, match="NaN in layer 3"):
+            f.result(10)
+        assert (obs.counter_value("serving.batch_errors") - be0) == 1
+        # the engine survived: next request dispatches normally
+        assert eng.health() == "ok"
+        out = eng.predict({"x": np.ones((1, 3), "f4")}, timeout=10)
+        np.testing.assert_array_equal(out["y"], np.full((1, 3), 2.0))
+        # and over HTTP the model failure is a 500 with the typed name
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"inputs": {"x": [[999.0, 0.0, 0.0]]}}
+                            ).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 500
+        body = json.loads(ei.value.read())
+        assert body["type"] == "BatchExecutionError"
+        assert (obs.counter_value("serving.batch_errors") - be0) == 2
+    finally:
+        eng.stop()
+        server.shutdown()
+        server.server_close()
 
 
 # -- atomic checkpoints -----------------------------------------------------

@@ -201,10 +201,20 @@ def test_rpc_malformed_message_and_dedupe():
             ps_rpc._send_msg(conn, dict(msg), g.tobytes())
             resp, _ = ps_rpc._recv_msg(conn)
             assert resp["ok"] is True
-        # a restarted client (new cid) reusing seq=1 must NOT dedupe
+        # a restarted incarnation of the SAME trainer (new cid, same
+        # trainer_id) re-sending its round's grad must not hit the seq
+        # dedup cache (fresh cid) — but it REPLACES the dead
+        # incarnation's pending contribution instead of adding a second
+        # copy (supervised-relaunch exactly-once, ISSUE 4)
         msg2 = dict(msg, cid="bb",
                     array=ps_rpc._array_header(g))
         ps_rpc._send_msg(conn, msg2, g.tobytes())
+        resp, _ = ps_rpc._recv_msg(conn)
+        assert resp["ok"] is True
+        # a DIFFERENT trainer's grad accumulates alongside it
+        msg3 = dict(msg, cid="cc", trainer_id=6,
+                    array=ps_rpc._array_header(g))
+        ps_rpc._send_msg(conn, msg3, g.tobytes())
         resp, _ = ps_rpc._recv_msg(conn)
         assert resp["ok"] is True
         ps_rpc._send_msg(conn, {"kind": "send_barrier", "trainer_id": 5,
@@ -212,8 +222,9 @@ def test_rpc_malformed_message_and_dedupe():
         resp, _ = ps_rpc._recv_msg(conn)
         assert resp["ok"] is True
         conn.close()
-        # barrier summed: one copy from cid=aa (deduped) + one from the
-        # "restarted" cid=bb client = 2g
+        # barrier summed: trainer 5 exactly once (duplicate seq
+        # deduped, restarted-incarnation resend replaced) + trainer 6's
+        # copy = 2g
         np.testing.assert_allclose(
             np.asarray(exe._core._read_var(scope, "w@GRAD")), 2 * g)
         c = PSClient(endpoint, trainer_id=9)

@@ -1,7 +1,9 @@
 """Fault-tolerance CI smoke (ci/check.sh gate 6).
 
-End-to-end recovery drill on one host: a real PS server process, two
-trainer processes under the ``distributed.launch`` supervisor, rank 1
+End-to-end recovery drills on one host.
+
+Default (trainer-kill): a real PS server process, two trainer
+processes under the ``distributed.launch`` supervisor, rank 1
 SIGKILLs itself mid-round 3. PASS requires the whole job to exit 0 —
 which can only happen if (a) the server's heartbeat monitor evicted
 the dead rank so the survivor's barriers completed, (b) the supervisor
@@ -9,7 +11,17 @@ relaunched the rank, and (c) the relaunch resumed from its newest
 valid (manifest-verified) checkpoint and finished the remaining
 rounds. The final checkpoint is then re-verified here.
 
-Usage: python tools/ft_smoke.py [--rounds 6]
+``--server-kill``: the 2-trainer / 2-server replicated job. The
+PRIMARY pserver SIGKILLs itself while applying round 3 (the round is
+summed + optimized locally but never replicated — the worst spot).
+PASS requires the job to exit 0 with every trainer failed over to the
+backup AND the final params matching the clean single-server
+computation BIT-FOR-BIT — retry + failover replay + the replicated
+dedup watermark must reconstruct the lost round exactly once. The
+supervisor also relaunches the killed server, which rejoins as a
+catching-up backup.
+
+Usage: python tools/ft_smoke.py [--rounds 6] [--server-kill]
 """
 from __future__ import annotations
 
@@ -20,6 +32,8 @@ import socket
 import subprocess
 import sys
 import tempfile
+
+import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "dist_worker_ft.py")
@@ -47,10 +61,84 @@ def _env(**over):
     return env
 
 
+def oracle_w(rounds: int, trainers: int = 2, lr: float = 0.1,
+             dim: int = 4) -> np.ndarray:
+    """The clean single-server float32 computation the recovered job
+    must match bit-for-bit (same ops, same order, as the PS applies)."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from dist_worker_ft import grad_for
+
+    w = np.zeros(dim, dtype=np.float32)
+    for rnd in range(1, rounds + 1):
+        total = grad_for(0, rnd)
+        for t in range(1, trainers):
+            total = total + grad_for(t, rnd)
+        w = w - np.float32(lr) * total
+    return w
+
+
+def run_server_kill(args) -> int:
+    """2 trainers, 2 replicated servers, primary SIGKILLed while
+    applying round 3: exit 0 + bit-for-bit params or bust."""
+    tmp = tempfile.mkdtemp(prefix="ft_smoke_sk_")
+    eps = "127.0.0.1:%d,127.0.0.1:%d" % (_free_port(), _free_port())
+    print("[ft_smoke] server-kill drill: pservers at %s, %d rounds, "
+          "primary dies applying round 3" % (eps, args.rounds))
+    sup = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", "--max_restarts=2",
+         "--started_port=%d" % _free_port(),
+         "--server_script=%s" % WORKER,
+         "--pserver_endpoints=%s" % eps, WORKER],
+        env=_env(FT_ROLE="trainer", PSERVER_ENDPOINT=eps,
+                 FT_ROUNDS=args.rounds, FT_SERVER_DIE_AT_ROUND=3,
+                 FT_OUT=os.path.join(tmp, "out"),
+                 FT_CKPT_ROOT=os.path.join(tmp, "ckpt"),
+                 PADDLE_PS_CONNECT_TIMEOUT="4",
+                 PADDLE_PS_FAILOVER_CONNECT_TIMEOUT="3",
+                 # bit-for-bit gate: eviction trades exactness for
+                 # availability, and nobody is actually dead here for
+                 # more than the failover window — keep it out of the
+                 # race (a trainer mid-failover must not be evicted by
+                 # the freshly promoted backup)
+                 PADDLE_PS_EVICT_AFTER="15"),
+        timeout=300, cwd=REPO)
+    if sup.returncode != 0:
+        print("[ft_smoke] FAIL: supervised job exited %d"
+              % sup.returncode)
+        return 1
+    expected = oracle_w(args.rounds)
+    ok = True
+    for tid in (0, 1):
+        r = json.load(open(os.path.join(tmp, "out.t%d.json" % tid)))
+        got = np.asarray(r["w"], dtype=np.float32)
+        checks = [
+            ("trainer %d finished %d rounds" % (tid, args.rounds),
+             r["rounds_done"] == args.rounds),
+            ("trainer %d failed over to the backup (idx %s, fo=%s)"
+             % (tid, r["ep_idx"], r["failovers"]),
+             r["ep_idx"] == 1 and r["failovers"] >= 1),
+            ("trainer %d's serving endpoint was promoted" % tid,
+             bool(r["server_active"]) and r["server_promotions"] >= 1),
+            ("trainer %d final params match the clean run bit-for-bit"
+             % tid, got.tobytes() == expected.tobytes()),
+        ]
+        for what, passed in checks:
+            print("[ft_smoke] %s: %s"
+                  % ("PASS" if passed else "FAIL", what))
+            ok = ok and passed
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser("ft_smoke")
     ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--server-kill", action="store_true",
+                    help="kill the PRIMARY PSERVER (replicated "
+                         "2-server job) instead of a trainer")
     args = ap.parse_args()
+    if args.server_kill:
+        return run_server_kill(args)
 
     tmp = tempfile.mkdtemp(prefix="ft_smoke_")
     endpoint = "127.0.0.1:%d" % _free_port()
