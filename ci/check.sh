@@ -50,9 +50,13 @@ if [[ "${SKIP_TESTS:-0}" == "1" ]]; then
     python -m pytest tests/test_observability.py -q
 fi
 # 4b: PADDLE_TPU_METRICS unset (default-off) must add no measurable
-# overhead to a tiny executor microbench (guard threshold, not exact
-# timing — see tools/obs_overhead.py)
+# overhead to a tiny executor microbench, and the ISSUE-5 additions —
+# trace-context propagation (header stamp / child spans) and the
+# flight-recorder ring — must stay sub-microsecond on their disabled /
+# always-on paths (guard threshold, not exact timing — see
+# tools/obs_overhead.py)
 env -u PADDLE_TPU_METRICS -u FLAGS_tpu_metrics \
+    -u PADDLE_TPU_METRICS_DIR \
     python -m paddle_tpu.tools.obs_overhead
 
 echo "== gate 5: serving =="
@@ -90,8 +94,11 @@ python tools/ft_smoke.py
 python tools/ft_smoke.py --server-kill
 # 6d: bounded chaos drill — one seeded randomized schedule (random
 # fault plan + random trainer kill + random primary-pserver kill),
-# gated on bit-for-bit parity with the clean run; a failure prints
-# the seed that replays it
+# gated on bit-for-bit parity with the clean run PLUS the merged-
+# telemetry invariants (job-level metrics.json + trace.json exist;
+# injected faults, the ps.failovers span, the promotion, and the
+# promoted backup's first applied round are visible in causal order
+# across >= 3 processes); a failure prints the seed that replays it
 python tools/chaos_drill.py --rounds 1
 
 if [[ "${SKIP_TESTS:-0}" != "1" ]]; then

@@ -260,6 +260,11 @@ def atomic_checkpoint_dir(final_dir: str, extra: Optional[Dict] = None):
         total = sum(int(m["bytes"]) for m in doc["files"].values())
         _count("checkpoint.bytes", total)
         _observe("checkpoint.save_ms", (time.monotonic() - t0) * 1e3)
+        from .observability import flight as _flight
+
+        _flight.record("checkpoint.commit",
+                       dir=os.path.basename(final_dir), bytes=total,
+                       ms=round((time.monotonic() - t0) * 1e3, 3))
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise

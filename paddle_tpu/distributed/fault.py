@@ -149,8 +149,12 @@ def random_plan(rng: random.Random, max_rules: int = 3) -> str:
 
 def _count(side: str, kind: str) -> None:
     from .. import observability as _obs
+    from ..observability import flight as _flight
 
     _obs.counter("fault.injected", side=side, kind=kind).inc()
+    # black-box line: the postmortem of a drill needs WHICH frames the
+    # injector ate interleaved with the recovery decisions they caused
+    _flight.record("fault.injected", side=side, kind=kind)
 
 
 class FaultInjector:

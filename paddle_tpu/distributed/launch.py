@@ -30,6 +30,16 @@ have already failed over; ``distributed/ps_rpc.py`` owns that
 protocol). The job completes when every TRAINER rank exits 0; the
 servers are then torn down and their exit codes ignored.
 
+Job-level observability (ISSUE 5): with ``PADDLE_TPU_METRICS_DIR``
+set, the supervisor clears stale dumps at job start (a merge must
+never mix job incarnations), records every spawn / exit / relaunch
+decision in its own flight recorder, and — in a ``finally``, so it
+happens even when children were SIGKILLed — merges every per-process
+dump into one job-level ``metrics.json`` and one merged chrome-trace
+``trace.json`` (``observability.distributed.merge_job_dir``). A killed
+child contributes its last periodic dump; the supervisor's flight ring
+contributes the kill itself (``launch.exit`` with the signal).
+
 Usage:  python -m paddle_tpu.distributed.launch --nproc_per_node=2 \
             [--max_restarts=3] \
             [--server_script=serve.py --pserver_endpoints=ep0,ep1] \
@@ -43,6 +53,9 @@ import signal
 import subprocess
 import sys
 import time
+
+from ..observability import distributed as _dobs
+from ..observability import flight as _flight
 
 __all__ = ["launch", "get_cluster_env"]
 
@@ -136,6 +149,9 @@ class _Worker:
             stdout = stderr = self._fp
         self.proc = subprocess.Popen(self.cmd, env=env, stdout=stdout,
                                      stderr=stderr)
+        _flight.record("launch.spawn", role=self.role,
+                       rank=self.local_rank, restart=self.restarts,
+                       pid=self.proc.pid)
 
     def close_log(self) -> None:
         if self._fp is not None:
@@ -148,6 +164,18 @@ def launch(args=None):
     node_ips = [ip for ip in args.ips.split(",") if ip]
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
+    metrics_dir = _dobs.metrics_dir()
+    if metrics_dir:
+        # the supervisor is a dumping process too (role "launcher"),
+        # and the job's dump dir must start empty: a merge that read a
+        # previous incarnation's dumps would "see" processes that were
+        # never part of this job
+        _dobs.set_identity("launcher", args.node_rank)
+        removed = _dobs.clear_stale_dumps(metrics_dir)
+        if removed:
+            _log("cleared %d stale dump(s) from %s"
+                 % (removed, metrics_dir))
+        _dobs.arm(metrics_dir)
     # workers must import paddle_tpu even when it runs from a source
     # checkout (script-dir sys.path[0] replaces the launcher's cwd)
     pkg_root = os.path.dirname(os.path.dirname(
@@ -221,6 +249,9 @@ def launch(args=None):
                 if code is None or code == 0:
                     continue  # running, or deliberately shut down
                 sig_note = (" (signal %d)" % -code) if code < 0 else ""
+                _flight.record("launch.exit", role="pserver",
+                               rank=s.local_rank, code=code,
+                               signal=(-code if code < 0 else None))
                 if s.restarts >= args.max_restarts:
                     _log("pserver %d exited %d%s; restart budget (%d) "
                          "exhausted — bringing the job down"
@@ -246,6 +277,9 @@ def launch(args=None):
                     live.discard(w.local_rank)
                     continue
                 sig_note = (" (signal %d)" % -code) if code < 0 else ""
+                _flight.record("launch.exit", role="trainer",
+                               rank=w.local_rank, code=code,
+                               signal=(-code if code < 0 else None))
                 if w.restarts >= args.max_restarts:
                     _log("rank %d exited %d%s; restart budget (%d) "
                          "exhausted — bringing the job down"
@@ -265,6 +299,8 @@ def launch(args=None):
                 w.spawn()
         return rc
     except KeyboardInterrupt:
+        rc = 1  # the finally's launch.done event must not read as a
+        # clean exit in the merged postmortem
         _terminate_all()
         return 1
     finally:
@@ -285,6 +321,26 @@ def launch(args=None):
                     s.proc.wait()
         for w in workers + servers:
             w.close_log()
+        if metrics_dir:
+            # even a job whose children were SIGKILLed leaves ONE
+            # merged picture: each child contributed its periodic /
+            # at-exit dumps, the supervisor contributes the kills it
+            # observed, and the merge rebases everything onto the
+            # shared wall clock
+            # an unexpected exception unwinding through here must not
+            # stamp the postmortem with a success marker
+            done_rc = rc if sys.exc_info()[0] is None else 1
+            _flight.record("launch.done", rc=done_rc)
+            try:
+                _dobs.dump_process()
+                mpath, tpath = _dobs.merge_job_dir(metrics_dir)
+                if mpath:
+                    _log("merged job telemetry: %s + %s"
+                         % (mpath, tpath))
+            except Exception as e:  # noqa: BLE001 — telemetry must
+                # never turn a green job red
+                _log("job telemetry merge failed: %s: %s"
+                     % (type(e).__name__, e))
 
 
 def main():

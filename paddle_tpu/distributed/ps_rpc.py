@@ -78,6 +78,27 @@ checkpoint_notify availability tier, made survivable end to end):
   ``ps.replication_lag_rounds{backup=}`` (0 after every ack; a backup
   that stops acking is dropped from the stream and the gauge freezes
   at its lag).
+
+Distributed observability (ISSUE 5 — Dapper-style context riding the
+existing frame):
+
+- the client stamps ``trace_id`` / ``parent_span`` onto every rpc
+  header (one trace per sync round, or the ambient context when one is
+  installed — e.g. a serving request). The server opens a child span
+  per rpc under the propagated context, and because ``child_span``
+  installs itself thread-locally, the optimize apply and the
+  replication rpcs it issues join the SAME trace — one round is one
+  timeline across client, primary, and backups, retries/failovers/
+  injected faults included. Old-frame peers ignore the extra fields;
+- ``rpc.latency_ms{method=}`` observes every attempt's reply latency
+  (retries observe separately) — the axis retry-policy tuning needs
+  next to ``rpc.retries`` counts;
+- every rpc token, retry, failover, replay, promotion, eviction, and
+  round apply/applied pair is recorded in the crash flight recorder
+  (``observability.flight``; heartbeat/status polls excluded so the
+  bounded ring holds decisions, not noise) — dumped per-process into
+  ``$PADDLE_TPU_METRICS_DIR`` and merged by ``tools/ft_timeline.py``
+  into the cross-process postmortem.
 """
 from __future__ import annotations
 
@@ -93,9 +114,16 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability import distributed as _dtrace
+from ..observability import flight as _flight
 from . import fault as _fault
 
 _ROUND_TIMEOUT = float(os.environ.get("PADDLE_PS_ROUND_TIMEOUT", "120"))
+
+# kinds whose per-frame flight events would flood the bounded ring
+# (a heartbeater ticks every few hundred ms for the whole job) — they
+# still get latency histograms and trace spans, just no black-box line
+_FLIGHT_QUIET = ("heartbeat", "repl_status")
 
 
 def _counter(name: str, **labels):
@@ -110,10 +138,10 @@ def _gauge(name: str, **labels):
     return _obs.gauge(name, **labels)
 
 
-def _histogram(name: str):
+def _histogram(name: str, **labels):
     from .. import observability as _obs
 
-    return _obs.histogram(name)
+    return _obs.histogram(name, **labels)
 
 
 def _endpoints_from_env() -> List[str]:
@@ -395,25 +423,33 @@ class PSServer:
         backup (acks REQUIRED before the round reads as complete — a
         promoted backup must never be behind a state any trainer has
         observed), then open params for reading."""
-        for name in sorted(self._pending):
-            by_tid = self._pending[name]
-            tids = sorted(by_tid)
-            total = by_tid[tids[0]]
-            for t in tids[1:]:
-                total = total + by_tid[t]
-            self._executor._write_var(self._scope, name, total)
-            sub = self._grad_to_block.get(name)
-            if sub is not None:
-                self._executor.run_block(sub, self._scope)
-        self._pending.clear()
-        self._send_barriers = 0
-        self._applied_round += 1
-        # safe point for a watermark snapshot: every processed
-        # send-kind seq is now folded into the scope (trainers cannot
-        # have sent next-round traffic — their barriers haven't
-        # returned yet)
-        self._applied_watermark = self._watermark_locked()
-        self._replicate_locked()
+        nxt = self._applied_round + 1
+        # begin/applied flight pair: a primary SIGKILLed mid-apply
+        # leaves "ps.round_apply" with no matching "ps.round_applied"
+        # in its last periodic dump — the postmortem's smoking gun
+        _flight.record("ps.round_apply", round=nxt,
+                       vars=len(self._pending))
+        with _dtrace.child_span("ps.apply_round", cat="ps", round=nxt):
+            for name in sorted(self._pending):
+                by_tid = self._pending[name]
+                tids = sorted(by_tid)
+                total = by_tid[tids[0]]
+                for t in tids[1:]:
+                    total = total + by_tid[t]
+                self._executor._write_var(self._scope, name, total)
+                sub = self._grad_to_block.get(name)
+                if sub is not None:
+                    self._executor.run_block(sub, self._scope)
+            self._pending.clear()
+            self._send_barriers = 0
+            self._applied_round += 1
+            # safe point for a watermark snapshot: every processed
+            # send-kind seq is now folded into the scope (trainers
+            # cannot have sent next-round traffic — their barriers
+            # haven't returned yet)
+            self._applied_watermark = self._watermark_locked()
+            self._replicate_locked()
+        _flight.record("ps.round_applied", round=self._applied_round)
         self._round_complete = True
         self._fetches_pending = True
         self._cond.notify_all()
@@ -484,6 +520,8 @@ class PSServer:
                 _gauge("ps.replication_lag_rounds", backup=ep).set(0)
             except (RuntimeError, OSError) as e:
                 self._repl_dead.add(ep)
+                _flight.record("ps.backup_dropped", backup=ep,
+                               round=self._applied_round)
                 try:
                     self._repl_clients.pop(ep).close()
                 except (KeyError, OSError):
@@ -507,6 +545,9 @@ class PSServer:
         # folded-seq watermark is exactly the inherited one
         self._applied_watermark = dict(self._repl_watermark)
         _counter("ps.promotions").inc()
+        _flight.record("ps.promotion", round=self._applied_round,
+                       index=self._index, endpoint=self._own_endpoint,
+                       rpc=kind)
         print("[ps_rpc] endpoint %s (index %d) promoted to primary at "
               "round %d (first failover rpc: %s)"
               % (self._own_endpoint, self._index, self._applied_round,
@@ -563,6 +604,8 @@ class PSServer:
                         self._caught_up = True
                     _histogram("ps.catchup_ms").observe(
                         (time.monotonic() - t0) * 1e3)
+                    _flight.record("ps.rejoin",
+                                   round=self._applied_round, via=ep)
                     print("[ps_rpc] endpoint %s rejoined as backup at "
                           "round %d (caught up from %s in %.0f ms)"
                           % (self._own_endpoint, self._applied_round,
@@ -619,6 +662,8 @@ class PSServer:
         self._evicted.add(trainer_id)
         self.monitor.forget(trainer_id)
         _counter("ps.evictions").inc()
+        _flight.record("ps.eviction", trainer=trainer_id,
+                       effective_fanin=self._effective_fanin())
         print("[ps_rpc] evicting trainer %d (silent > %.1fs); "
               "effective fanin now %d"
               % (trainer_id, self._evict_after, self._effective_fanin()),
@@ -636,6 +681,7 @@ class PSServer:
             if trainer_id in self._evicted:
                 self._evicted.discard(trainer_id)
                 _counter("ps.readmissions").inc()
+                _flight.record("ps.readmission", trainer=trainer_id)
                 print("[ps_rpc] re-admitting trainer %d; effective "
                       "fanin now %d"
                       % (trainer_id, self._effective_fanin()),
@@ -821,6 +867,7 @@ class PSServer:
                 self._round_complete = True
                 self._fetches_pending = False
                 self._caught_up = True
+            _flight.record("ps.replicated", round=self._applied_round)
             return {"ok": True, "round": self._applied_round}, b""
         if kind == "repl_status":
             return {"ok": True, "active": self._active_role(),
@@ -876,6 +923,26 @@ class PSServer:
             return {"ok": True}, b""
         return {"ok": False, "error": "unknown kind %r" % kind}, b""
 
+    def _traced_handle(self, msg: dict, raw: bytes):
+        """Flight-record the incoming rpc token and run the handler
+        under the client's propagated trace context (when the header
+        carries one): the server span parents to the client's round /
+        request span, and anything the handler does downstream — the
+        optimize apply, a replication rpc to a backup — joins the same
+        cross-process trace via the thread-local current context."""
+        kind = msg.get("kind", "?")
+        if kind not in _FLIGHT_QUIET:
+            _flight.record("ps.rpc", kind=kind, cid=msg.get("cid"),
+                           seq=msg.get("seq"), round=msg.get("round"),
+                           fo=msg.get("fo"))
+        tid, pspan = _dtrace.extract(msg)
+        if tid is None:
+            return self._handle(msg, raw)
+        with _dtrace.child_span("rpc.server." + kind, trace_id=tid,
+                                parent_span=pspan, cid=msg.get("cid"),
+                                seq=msg.get("seq")):
+            return self._handle(msg, raw)
+
     # -- socket plumbing --------------------------------------------------
 
     def _dispatch(self, msg: dict, raw: bytes):
@@ -891,7 +958,7 @@ class PSServer:
         seq = msg.get("seq") if isinstance(msg, dict) else None
         cid = msg.get("cid") if isinstance(msg, dict) else None
         if seq is None or cid is None:
-            return self._handle(msg, raw)
+            return self._traced_handle(msg, raw)
         if (msg.get("kind") in ("send_grad", "send_barrier",
                                 "push_sparse")
                 and seq <= int(self._repl_watermark.get(cid, 0))):
@@ -945,7 +1012,7 @@ class PSServer:
             return {"ok": False, "stale": True,
                     "error": "dedupe entry superseded"}, b""
         try:
-            resp, rraw = self._handle(msg, raw)
+            resp, rraw = self._traced_handle(msg, raw)
         except Exception as e:
             resp, rraw = {"ok": False, "error": "%s: %s"
                           % (type(e).__name__, e)}, b""
@@ -1177,6 +1244,11 @@ class PSClient:
         self._round = 0  # completed send_barriers (the dedup token's
         # round component: (cid, round, seq))
         self._cid = os.urandom(8).hex()
+        # one TraceContext per sync round (regenerated when _round
+        # advances): every rpc/retry/failover of the round rides one
+        # cross-process trace. Only populated while spans are armed.
+        self._trace_ctx = None
+        self._trace_round = -1
         self._jitter = random.Random(int.from_bytes(os.urandom(4),
                                                     "little"))
         self._hb_thread: Optional[threading.Thread] = None
@@ -1325,6 +1397,13 @@ class PSClient:
         desync framing or hand the NEXT call the OLD response)."""
         if self._sock is None:
             self._sock = self._connect()
+        kind = msg.get("kind", "?")
+        quiet = kind in _FLIGHT_QUIET
+        t0 = time.perf_counter()
+        if not quiet:
+            _flight.record("rpc.send", kind=kind, seq=msg.get("seq"),
+                           cid=msg.get("cid"), round=msg.get("round"),
+                           fo=msg.get("fo"), ep=self._endpoint)
         deadline = time.time() + self._rpc_deadline
         try:
             _send_msg(self._sock, msg, raw)
@@ -1342,19 +1421,45 @@ class PSClient:
                 rseq = resp.get("seq") if isinstance(resp, dict) else None
                 if rseq is not None and rseq != msg["seq"]:
                     continue  # stale reply from a dup'd earlier frame
+                # per-ATTEMPT reply latency (retries observe
+                # separately): the axis rpc.retries lacks — a rising
+                # retry rate with healthy latencies means a mis-set
+                # per-attempt deadline, not a slow server
+                _histogram("rpc.latency_ms", method=kind).observe(
+                    (time.perf_counter() - t0) * 1e3)
+                if msg.get("trace_id"):
+                    _dtrace.record_span(
+                        "rpc.client." + kind, t0, cat="rpc",
+                        trace_id=msg["trace_id"],
+                        parent_span=msg.get("parent_span"),
+                        endpoint=self._endpoint, seq=msg.get("seq"))
+                if not quiet:
+                    _flight.record("rpc.recv", kind=kind,
+                                   seq=msg.get("seq"),
+                                   ok=bool(resp.get("ok"))
+                                   if isinstance(resp, dict) else None)
                 return resp, resp_raw
         except socket.timeout:
             self._drop_sock()
-            _counter("rpc.timeouts", method=msg.get("kind", "?")).inc()
+            _counter("rpc.timeouts", method=kind).inc()
+            if not quiet:
+                _flight.record("rpc.timeout", kind=kind,
+                               seq=msg.get("seq"), ep=self._endpoint)
             raise _RPCTimeout(
                 "pserver %s did not reply within the %.0fs RPC deadline "
                 "(kind=%s)" % (self._endpoint, self._rpc_deadline,
                                msg.get("kind"))) from None
         except _RPCConnLost:
             self._drop_sock()
+            if not quiet:
+                _flight.record("rpc.conn_lost", kind=kind,
+                               seq=msg.get("seq"), ep=self._endpoint)
             raise
         except OSError as e:
             self._drop_sock()
+            if not quiet:
+                _flight.record("rpc.conn_lost", kind=kind,
+                               seq=msg.get("seq"), ep=self._endpoint)
             raise _RPCConnLost("pserver %s connection failed: %s"
                                % (self._endpoint, e)) from e
 
@@ -1366,6 +1471,27 @@ class PSClient:
             pass
         self._sock = None
 
+    def _stamp_trace(self, msg: dict) -> None:
+        """Propagate trace context on the rpc header (Dapper-style: it
+        rides the existing JSON frame; old-frame peers ignore the extra
+        fields). An ambient context — a serving request span, a
+        server-side handler issuing replication — wins; otherwise the
+        client keeps one trace per sync round so every rpc, retry, and
+        failover of the round lands in a single cross-process trace.
+        No-op (no id generation) while the span layer is disarmed."""
+        from ..observability import tracing as _tracing
+
+        if not _tracing.active():
+            return
+        ctx = _dtrace.current()
+        if ctx is None:
+            if self._trace_ctx is None \
+                    or self._trace_round != self._round:
+                self._trace_ctx = _dtrace.TraceContext.new()
+                self._trace_round = self._round
+            ctx = self._trace_ctx
+        _dtrace.inject(msg, ctx)
+
     def _call(self, msg: dict, raw: bytes = b""):
         if self._trainer_id is not None:
             msg.setdefault("trainer_id", self._trainer_id)
@@ -1375,6 +1501,7 @@ class PSClient:
             msg["cid"] = self._cid
             msg["round"] = self._round
             msg["fo"] = self._failover_count
+            self._stamp_trace(msg)
             if (len(self._endpoints) > 1 and msg["kind"] in
                     ("send_grad", "send_barrier", "push_sparse")):
                 self._replay_log.append((dict(msg), bytes(raw)))
@@ -1484,6 +1611,11 @@ class PSClient:
         start = self._ep_idx
         self._failover_count += 1
         msg["fo"] = self._failover_count
+        t0 = time.perf_counter()
+        _flight.record("rpc.failover.begin",
+                       frm=self._endpoints[start], fo=self._failover_count,
+                       cause=type(cause).__name__,
+                       redirect=bool(redirect))
         last: Exception = cause
         for k in range(1, n):
             self._ep_idx = (start + k) % n
@@ -1498,6 +1630,18 @@ class PSClient:
                 continue
             _counter("ps.failovers",
                      cause="redirect" if redirect else "transport").inc()
+            _flight.record("rpc.failover", frm=self._endpoints[start],
+                           to=self._endpoint, fo=self._failover_count,
+                           replayed=len(self._replay_log))
+            # the span the merged timeline shows the failover as (ISSUE
+            # 5 acceptance): parented into the round trace the failed
+            # rpc belongs to, covering connect + replay
+            _dtrace.record_span(
+                "ps.failovers", t0, cat="rpc",
+                trace_id=msg.get("trace_id"),
+                parent_span=msg.get("parent_span"),
+                cause="redirect" if redirect else "transport",
+                frm=self._endpoints[start], to=self._endpoint)
             print("[ps_rpc] trainer %s failed over %s -> %s "
                   "(replayed %d rpc(s); after: %s)"
                   % (self._trainer_id,
@@ -1506,6 +1650,8 @@ class PSClient:
                   file=sys.stderr, flush=True)
             return
         self._ep_idx = start
+        _flight.record("rpc.failover.failed", frm=self._endpoints[start],
+                       fo=self._failover_count)
         raise RuntimeError(
             "no reachable pserver among %s (last failover error: %s; "
             "failing over after: %s)" % (self._endpoints, last, cause))
@@ -1515,6 +1661,8 @@ class PSClient:
         the ORIGINAL dedup tokens: rpcs the new primary already holds
         (via replication) are acknowledged as ``replayed`` without
         re-executing; the rest rebuild the in-flight round."""
+        _flight.record("rpc.replay", n=len(self._replay_log),
+                       ep=self._endpoint)
         for m, r in list(self._replay_log):
             m["fo"] = self._failover_count
             delay = self._backoff_base

@@ -36,6 +36,8 @@ Metric families (see README "Runtime observability"):
                                        ``paddle_tpu/serving/metrics.py``)
 ``rpc.retries{method=}``               counter: PS client retries per rpc
 ``rpc.timeouts{method=}``              counter: per-attempt deadline trips
+``rpc.latency_ms{method=}``            histogram: per-ATTEMPT reply latency
+                                       (retries observe separately)
 ``ps.evictions`` / ``ps.readmissions`` counter: heartbeat-monitor actions
 ``ps.failovers{cause=}``               counter: client endpoint advances
                                        (cause: transport | redirect)
@@ -61,13 +63,26 @@ Export: ``dump()`` -> JSON-able dict, ``dump(fmt="prometheus")`` ->
 text exposition format, ``chrome_trace()`` / ``write_chrome_trace()``
 -> Perfetto-loadable ``trace_event`` JSON merging all host spans
 (including the legacy ``fluid.profiler`` timeline).
+
+Distributed (ISSUE 5, ``observability/distributed`` +
+``observability/flight``): setting ``PADDLE_TPU_METRICS_DIR`` arms
+this layer plus a periodic/at-exit/on-SIGTERM per-process dumper;
+rpc headers carry ``trace_id``/``parent_span`` so one sync round or
+serving request is one cross-process trace; every recovery decision
+lands in a bounded always-on flight-recorder ring; and the launch
+supervisor merges everything into a job-level ``metrics.json`` + one
+chrome-trace ``trace.json`` (``tools/ft_timeline.py`` prints the
+ordered cross-process postmortem). See README "Distributed
+observability".
 """
 from __future__ import annotations
 
 import os
 from typing import Dict, Optional
 
+from . import flight  # noqa: F401
 from . import tracing  # noqa: F401
+from . import distributed  # noqa: F401
 from .registry import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
 from .tracing import span  # noqa: F401
 
@@ -75,7 +90,8 @@ __all__ = ["enable", "disable", "enabled", "metrics", "counter", "gauge",
            "histogram", "inc", "set_gauge", "observe", "counter_value",
            "gauge_value", "span", "dump", "dump_prometheus",
            "chrome_trace", "write_chrome_trace", "reset",
-           "MetricsRegistry", "Counter", "Gauge", "Histogram"]
+           "MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "flight", "distributed"]
 
 _registry = MetricsRegistry()
 _enabled = False
@@ -86,12 +102,25 @@ def _init_from_env() -> None:
     observability must not drag the flag module (and transitively jax)
     in at import time. Precedence matches core/flags._init_from_env
     exactly (FLAGS_tpu_metrics primary, PADDLE_TPU_METRICS alias) so
-    the flag value and this layer's armed state can never diverge."""
+    the flag value and this layer's armed state can never diverge.
+
+    A set ``PADDLE_TPU_METRICS_DIR`` additionally arms the layer AND
+    the per-process dumper (``observability.distributed``): asking for
+    a job-level dump dir without metrics would produce empty dumps, so
+    the dir is the one switch a distributed job needs."""
     raw = os.environ.get("FLAGS_tpu_metrics")
     if raw is None:
         raw = os.environ.get("PADDLE_TPU_METRICS", "")
     if raw.lower() in ("1", "true", "yes", "on"):
         enable()
+    if distributed.metrics_dir() is not None:
+        enable()
+        distributed.arm_from_env()
+    # the crash postmortem hook is unconditional (a black box that
+    # needs arming is not a black box): it chains the existing
+    # excepthook and, with no metrics dir, only prints the flight-ring
+    # tail to stderr before the normal traceback
+    flight.install_excepthook()
 
 
 def enabled() -> bool:

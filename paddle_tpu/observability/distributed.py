@@ -1,0 +1,511 @@
+"""Distributed observability: trace propagation + job-level aggregation.
+
+PR 1 gave every *process* a registry and a span buffer; this module
+makes a *fleet* of them tell one story:
+
+- **Trace context propagation** (Dapper-style). A ``TraceContext`` is
+  a ``(trace_id, span_id)`` pair riding the existing RPC JSON header
+  (``trace_id`` / ``parent_span`` fields — json-safe scalars, so
+  old-frame peers simply ignore them). ``PSClient`` stamps one per
+  sync round, the serving HTTP front stamps one per request, and the
+  receiving side opens **child spans** under the propagated context
+  (``child_span`` sets the thread-local current context, so work the
+  handler does downstream — an apply, a replication rpc to a backup —
+  joins the same trace across a third process). One training round or
+  one HTTP request is then a single cross-process trace, retries,
+  failovers and injected faults included.
+
+- **Job-level aggregation.** When ``$PADDLE_TPU_METRICS_DIR`` is set,
+  every process (trainer, pserver, backup, serving worker, launcher)
+  arms a background dumper that periodically — and at exit, on
+  SIGTERM, and on a fatal exception — writes its registry snapshot,
+  span buffer, and flight-recorder ring to
+  ``$PADDLE_TPU_METRICS_DIR/<role>-<rank>[.r<restart>].json``
+  (atomically, via the checkpoint tmp+fsync+rename helper — a merge
+  never reads a torn dump). ``merge_job_dir`` folds the per-process
+  dumps into one job-level ``metrics.json`` (per-rank sections
+  preserved + counter totals) and one merged chrome-trace
+  ``trace.json`` (spans as "X" events, flight events as instants,
+  per-process tracks) — produced by the launch supervisor even when
+  children were SIGKILLed, since a killed child's *periodic* dumps
+  survive it.
+
+Span timestamps are ``time.perf_counter()`` microseconds; every dump
+records ``clock_offset_us = wall_us - perf_us`` at write time, and the
+merger rebases each process onto the shared wall clock — on one host
+the residual skew is microseconds, far under the event gaps being
+ordered.
+
+Setting ``PADDLE_TPU_METRICS_DIR`` also arms the metrics layer itself
+(a dump dir without metrics would be an empty dump); with the dir
+unset this module costs one env read at import and nothing on any hot
+path.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import flight, tracing
+
+__all__ = ["TraceContext", "current", "set_current", "trace",
+           "child_span", "record_span", "inject", "extract",
+           "process_identity", "set_identity", "metrics_dir",
+           "dump_path", "dump_process", "arm", "arm_from_env",
+           "clear_stale_dumps",
+           "load_dumps", "doc_flight_events", "merge_job_dir",
+           "MERGED_METRICS_NAME", "MERGED_TRACE_NAME"]
+
+MERGED_METRICS_NAME = "metrics.json"
+MERGED_TRACE_NAME = "trace.json"
+_DUMP_SCHEMA = 1
+
+
+def _gen_id(nhex: int) -> str:
+    return os.urandom(nhex // 2).hex()
+
+
+class TraceContext:
+    """One node of a distributed trace: every span created under this
+    context records ``trace_id`` and parents to ``span_id``."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = str(trace_id)
+        self.span_id = str(span_id)
+
+    @classmethod
+    def new(cls, parent: Optional["TraceContext"] = None):
+        return cls(parent.trace_id if parent is not None else _gen_id(16),
+                   _gen_id(8))
+
+    def __repr__(self):
+        return "TraceContext(%s/%s)" % (self.trace_id, self.span_id)
+
+
+_tls = threading.local()
+
+
+def current() -> Optional[TraceContext]:
+    return getattr(_tls, "ctx", None)
+
+
+def set_current(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Install ``ctx`` as this thread's context; returns the previous
+    one (callers restore it — ``child_span`` does this for you)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    return prev
+
+
+@contextlib.contextmanager
+def trace(name: str, cat: str = "trace", **args):
+    """Root span of a NEW trace, installed as the ambient context —
+    the application-level entry point: wrap a unit of YOUR work (a
+    training step, a batch job) and every rpc issued inside adopts it
+    (``PSClient._stamp_trace`` prefers the ambient context over its
+    own per-round trace; serving ``submit`` captures it). The runtime
+    paths don't need it — ps_rpc mints per-round roots and the HTTP
+    front uses ``child_span`` per request. No-op (yields None) when
+    the span layer is disarmed — callers never pay for id generation
+    on a dark path."""
+    if not tracing.active():
+        yield None
+        return
+    ctx = TraceContext.new()
+    prev = set_current(ctx)
+    t0 = time.perf_counter()
+    try:
+        yield ctx
+    finally:
+        set_current(prev)
+        if tracing.active():
+            a = {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+            a.update(args)
+            tracing._record(name, t0 * 1e6,
+                            (time.perf_counter() - t0) * 1e6, cat, a)
+
+
+@contextlib.contextmanager
+def child_span(name: str, cat: str = "rpc",
+               trace_id: Optional[str] = None,
+               parent_span: Optional[str] = None, **args):
+    """Span under a propagated or ambient context. Explicit
+    ``trace_id``/``parent_span`` (extracted from an rpc header) win;
+    otherwise the thread-local current context parents the span; with
+    neither, a fresh trace starts. Installs itself as the current
+    context for its duration, so nested work — including rpcs ISSUED
+    from inside the handler — joins the same trace."""
+    if not tracing.active():
+        yield None
+        return
+    if trace_id is None:
+        amb = current()
+        if amb is not None:
+            trace_id, parent_span = amb.trace_id, amb.span_id
+        else:
+            # fresh trace: a caller-supplied parent WITHOUT its trace
+            # id would parent this root into an unrelated trace
+            trace_id, parent_span = _gen_id(16), None
+    ctx = TraceContext(trace_id, _gen_id(8))
+    prev = set_current(ctx)
+    t0 = time.perf_counter()
+    try:
+        yield ctx
+    finally:
+        set_current(prev)
+        if tracing.active():
+            a = {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+            if parent_span:
+                a["parent_span"] = str(parent_span)
+            a.update(args)
+            tracing._record(name, t0 * 1e6,
+                            (time.perf_counter() - t0) * 1e6, cat, a)
+
+
+def record_span(name: str, t0_perf: float, cat: str = "rpc",
+                ctx: Optional[TraceContext] = None, **args) -> None:
+    """Post-hoc span: ``t0_perf`` (a ``time.perf_counter()`` reading)
+    to now, recorded under ``ctx`` (or the current context). For call
+    sites that cannot wrap their body in a ``with`` — e.g. a latency
+    measured across a retry loop."""
+    if not tracing.active():
+        return
+    if ctx is None:
+        ctx = current()
+    a = dict(args)
+    if ctx is not None:
+        a.setdefault("trace_id", ctx.trace_id)
+        a.setdefault("parent_span", ctx.span_id)
+    tracing._record(name, t0_perf * 1e6,
+                    (time.perf_counter() - t0_perf) * 1e6, cat,
+                    a or None)
+
+
+def inject(msg: Dict, ctx: Optional[TraceContext] = None) -> Dict:
+    """Stamp ``trace_id`` / ``parent_span`` onto an rpc header dict
+    (mutates and returns it). No-op when the span layer is disarmed or
+    no context is available — absent fields are the old-frame wire
+    shape and every peer tolerates them."""
+    if tracing.active():
+        if ctx is None:
+            ctx = current()
+        if ctx is not None:
+            msg["trace_id"] = ctx.trace_id
+            msg["parent_span"] = ctx.span_id
+    return msg
+
+
+def extract(msg: Dict) -> Tuple[Optional[str], Optional[str]]:
+    """(trace_id, parent_span) from an rpc header; (None, None) when
+    the peer predates propagation (or never armed it)."""
+    tid = msg.get("trace_id") if isinstance(msg, dict) else None
+    if not tid:
+        return None, None
+    return str(tid), (str(msg["parent_span"])
+                      if msg.get("parent_span") else None)
+
+
+# -- process identity -------------------------------------------------------
+
+_identity: Optional[Tuple[str, int]] = None
+
+
+def set_identity(role: str, rank: int) -> None:
+    """Override the env-derived identity (the launch supervisor calls
+    ``set_identity("launcher", 0)`` — its own env has no PADDLE_ROLE)."""
+    global _identity
+    _identity = (str(role), int(rank))
+
+
+def process_identity() -> Tuple[str, int, int]:
+    """(role, rank, restart) for dump naming. Role comes from the
+    launch env contract (``PADDLE_ROLE`` / ``FT_ROLE``), rank from
+    ``PADDLE_PSERVER_INDEX`` (servers) or ``PADDLE_TRAINER_ID``;
+    a process outside any launcher is ``proc-<pid>``."""
+    restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0") or 0)
+    if _identity is not None:
+        return _identity[0], _identity[1], restart
+    role = os.environ.get("PADDLE_ROLE") or os.environ.get("FT_ROLE")
+    if not role:
+        return "proc", os.getpid(), restart
+    if role == "pserver":
+        rank = int(os.environ.get("PADDLE_PSERVER_INDEX", "0") or 0)
+    else:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    return str(role), rank, restart
+
+
+def _dump_basename() -> str:
+    role, rank, restart = process_identity()
+    base = "%s-%d" % (role, rank)
+    if restart:
+        # a relaunched incarnation must not overwrite its dead
+        # predecessor's final dump — the merge wants both, labeled
+        base += ".r%d" % restart
+    return base + ".json"
+
+
+def metrics_dir() -> Optional[str]:
+    d = os.environ.get("PADDLE_TPU_METRICS_DIR", "").strip()
+    return d or None
+
+
+def dump_path() -> Optional[str]:
+    """This process's slot in ``$PADDLE_TPU_METRICS_DIR`` (None when
+    the dir is unset) — the one derivation ``dump_process`` writes to
+    and surfaces like serving ``/healthz`` report."""
+    d = metrics_dir()
+    return os.path.join(d, _dump_basename()) if d else None
+
+
+# -- per-process dumps ------------------------------------------------------
+
+def dump_process(path: Optional[str] = None) -> Optional[str]:
+    """Write this process's registry snapshot + span buffer + flight
+    ring to ``path`` (default: its slot in ``$PADDLE_TPU_METRICS_DIR``;
+    None and no-op when neither is given). Atomic — a reader never
+    sees a torn dump — and safe to call from anywhere, any number of
+    times: the newest write wins."""
+    from .. import observability as _obs
+    from ..checkpoint import atomic_write_bytes
+
+    with _dump_lock:
+        return _dump_process_locked(path, _obs, atomic_write_bytes)
+
+
+def _dump_process_locked(path, _obs, atomic_write_bytes):
+    if path is None:
+        path = dump_path()
+        if path is None:
+            return None
+    role, rank, restart = process_identity()
+    doc = {
+        "schema": _DUMP_SCHEMA,
+        "proc": os.path.splitext(os.path.basename(path))[0],
+        "role": role,
+        "rank": rank,
+        "restart": restart,
+        "pid": os.getpid(),
+        "wrote_at": time.time(),
+        # rebases perf_counter-stamped spans/flight events onto the
+        # wall clock the whole job shares
+        "clock_offset_us": time.time() * 1e6
+        - time.perf_counter() * 1e6,
+        "metrics": _obs.metrics().snapshot(),
+        "spans": [list(ev) for ev in tracing.trace_events()],
+        "span_stats": tracing.stats(),
+        "flight": [list(ev) for ev in flight.events()],
+        "flight_stats": flight.stats(),
+    }
+    atomic_write_bytes(path, json.dumps(doc, default=str).encode())
+    return path
+
+
+_arm_lock = threading.Lock()
+_arm_state: Dict[str, object] = {}
+# serializes dump writes against clear_stale_dumps: without it, a
+# dump in flight on the background thread when a job start clears the
+# dir could land AFTER the clear under a pre-identity name and
+# resurrect a phantom process in the merge. RLock: the SIGTERM dump
+# handler may interrupt the main thread mid-dump.
+_dump_lock = threading.RLock()
+
+
+def arm(dirname: Optional[str] = None,
+        period_s: Optional[float] = None) -> bool:
+    """Arm the periodic + at-exit + on-SIGTERM dumper (idempotent).
+    ``dirname`` defaults to ``$PADDLE_TPU_METRICS_DIR``; cadence from
+    ``period_s`` / ``$PADDLE_TPU_DUMP_PERIOD`` (seconds, default 5).
+    Returns False (and arms nothing) when no directory is known."""
+    if dirname is None:
+        dirname = metrics_dir()
+    if not dirname:
+        return False
+    with _arm_lock:
+        if _arm_state.get("armed"):
+            return True
+        os.makedirs(dirname, exist_ok=True)
+        if period_s is None:
+            period_s = float(os.environ.get("PADDLE_TPU_DUMP_PERIOD",
+                                            "5") or 5)
+        stop = threading.Event()
+
+        def _loop():
+            while not stop.wait(max(0.05, period_s)):
+                try:
+                    dump_process()
+                except Exception:
+                    pass  # a failed periodic dump must never kill work
+
+        t = threading.Thread(target=_loop, name="obs-dumper",
+                             daemon=True)
+        t.start()
+        atexit.register(_final_dump)
+        flight.install_excepthook()
+        _install_sigterm_dump()
+        _arm_state.update(armed=True, stop=stop, thread=t,
+                          dir=dirname, period=period_s)
+    return True
+
+
+def _final_dump() -> None:
+    try:
+        dump_process()
+    except Exception:
+        pass
+
+
+def _install_sigterm_dump() -> None:
+    """The launch supervisor tears servers down with SIGTERM; their
+    registries must reach disk first. Chains any existing handler;
+    silently skipped off the main thread (signal.signal would raise)."""
+    import signal as _signal
+
+    try:
+        prev = _signal.getsignal(_signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            _final_dump()
+            if prev is _signal.SIG_IGN:
+                return  # the process chose to survive SIGTERM; a
+                # telemetry hook must not change that
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+                os.kill(os.getpid(), _signal.SIGTERM)
+
+        _signal.signal(_signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass
+
+
+def arm_from_env() -> bool:
+    """Called by ``observability._init_from_env``: a set
+    ``PADDLE_TPU_METRICS_DIR`` arms the dumper (the metrics layer
+    itself is enabled by the caller)."""
+    return arm()
+
+
+# -- job-level merge --------------------------------------------------------
+
+def clear_stale_dumps(dirname: str) -> int:
+    """Remove every ``*.json`` in ``dirname`` (per-process dumps AND a
+    previous merge) — the launch supervisor calls this at job start so
+    a merged job view never mixes incarnations of the job itself.
+    Returns the number of files removed; a missing dir is 0."""
+    if not os.path.isdir(dirname):
+        return 0
+    n = 0
+    with _dump_lock:  # an in-flight dump lands before the clear, and
+        # any dump after it uses the caller's already-set identity
+        for fn in os.listdir(dirname):
+            if fn.endswith(".json") or fn.startswith(".tmp-"):
+                try:
+                    os.unlink(os.path.join(dirname, fn))
+                    n += 1
+                except OSError:
+                    pass
+    return n
+
+
+def doc_flight_events(doc: Dict):
+    """Yield one dump's flight events rebased onto the wall clock:
+    ``(t_us, kind, fields)``. The ONE place the flight tuple shape and
+    the clock rebase rule live — ``merge_job_dir`` and
+    ``tools/ft_timeline.py`` both read through here, so the chrome
+    timeline and the postmortem can never disagree about when an event
+    happened."""
+    off = float(doc.get("clock_offset_us") or 0.0)
+    for ev in doc.get("flight") or []:
+        ts, kind, fields = (list(ev) + [None] * 3)[:3]
+        yield float(ts) + off, kind, fields or {}
+
+
+def load_dumps(dirname: str) -> List[Dict]:
+    """Every readable per-process dump in ``dirname`` (schema-checked;
+    merge outputs and foreign json are skipped), sorted by proc name."""
+    out = []
+    if not os.path.isdir(dirname):
+        return out
+    for fn in sorted(os.listdir(dirname)):
+        if not fn.endswith(".json") or fn in (MERGED_METRICS_NAME,
+                                              MERGED_TRACE_NAME):
+            continue
+        try:
+            with open(os.path.join(dirname, fn), "r",
+                      encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and doc.get("schema") == _DUMP_SCHEMA \
+                and "proc" in doc:
+            out.append(doc)
+    return out
+
+
+def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
+    """Fold every per-process dump under ``dirname`` into
+    ``metrics.json`` (per-process metric sections preserved under
+    their ``<role>-<rank>`` keys + summed counter totals) and
+    ``trace.json`` (one chrome-trace timeline: spans as "X" events,
+    flight events as instants, one named track per process, all
+    rebased onto the wall clock). Returns the two paths, or
+    ``(None, None)`` when there is nothing to merge."""
+    from ..checkpoint import atomic_write_bytes
+
+    docs = load_dumps(dirname)
+    if not docs:
+        return None, None
+    processes: Dict[str, Dict] = {}
+    totals: Dict[str, float] = {}
+    events: List[Dict] = []
+    metas: List[Dict] = []
+    for doc in docs:
+        key = doc["proc"]
+        processes[key] = {
+            "role": doc.get("role"), "rank": doc.get("rank"),
+            "restart": doc.get("restart"), "pid": doc.get("pid"),
+            "wrote_at": doc.get("wrote_at"),
+            "metrics": doc.get("metrics") or {},
+            "span_stats": doc.get("span_stats"),
+            "flight_stats": doc.get("flight_stats"),
+        }
+        for qn, v in (doc.get("metrics") or {}).get("counters",
+                                                    {}).items():
+            totals[qn] = totals.get(qn, 0) + v
+        off = float(doc.get("clock_offset_us") or 0.0)
+        pid = int(doc.get("pid") or 0)
+        metas.append({"name": "process_name", "ph": "M", "pid": pid,
+                      "tid": 0, "args": {"name": key}})
+        for ev in doc.get("spans") or []:
+            name, ts, dur, tid, cat, args = (list(ev) + [None] * 6)[:6]
+            entry = {"name": name, "ph": "X", "ts": ts + off,
+                     "dur": dur, "pid": pid, "tid": tid, "cat": cat}
+            if args:
+                entry["args"] = args
+            events.append(entry)
+        for ts, kind, fields in doc_flight_events(doc):
+            entry = {"name": kind, "ph": "i", "ts": ts,
+                     "pid": pid, "tid": 0, "s": "p", "cat": "flight"}
+            if fields:
+                entry["args"] = fields
+            events.append(entry)
+    events.sort(key=lambda e: e["ts"])
+    mpath = os.path.join(dirname, MERGED_METRICS_NAME)
+    tpath = os.path.join(dirname, MERGED_TRACE_NAME)
+    atomic_write_bytes(mpath, json.dumps(
+        {"merged_at": time.time(), "processes": processes,
+         "counters_total": totals}, default=str,
+        sort_keys=True).encode())
+    atomic_write_bytes(tpath, json.dumps(
+        {"traceEvents": metas + events, "displayTimeUnit": "ms"},
+        default=str).encode())
+    return mpath, tpath

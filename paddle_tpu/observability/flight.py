@@ -1,0 +1,113 @@
+"""Crash flight recorder: a bounded ring of recent structured events.
+
+The black box for the distributed runtime. Metrics answer "how many
+failovers happened"; spans answer "how long did the apply take"; what
+neither answers after a process is SIGKILLed mid-drill is *what was it
+doing right before* — which rpc token was in flight, which round was
+being applied, which backup had just been dropped from the replication
+stream. This module records exactly that: every interesting decision in
+``ps_rpc`` / ``fault`` / ``checkpoint`` / ``launch`` appends one small
+tuple to a process-wide ring (``PADDLE_TPU_FLIGHT_RING`` entries,
+default 2048 — old history falls off, the recent past survives).
+
+Recording is UNCONDITIONAL and cheap (one ``deque.append`` under the
+GIL, no lock, no timestamp formatting) — a black box that must be armed
+in advance is not a black box. What is gated is *persistence*: the ring
+reaches disk only through ``observability.distributed`` (periodic +
+at-exit + on-signal dumps into ``$PADDLE_TPU_METRICS_DIR``) or an
+explicit ``dump()``. On a fatal uncaught exception the tail of the ring
+is additionally printed to stderr (``install_excepthook``) so even a
+process with no metrics dir leaves a postmortem in its worker log.
+
+Event shape: ``(ts_us, kind, fields)`` — ``ts_us`` is
+``time.perf_counter()`` microseconds (the span clock; the per-process
+dump carries the wall-clock offset that rebases both), ``kind`` is a
+dotted string (``rpc.send``, ``ps.promotion``, ``fault.injected``,
+``checkpoint.commit``, ``launch.exit``), ``fields`` a small dict of
+json-safe scalars or None.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["record", "events", "clear", "stats", "tail_lines",
+           "install_excepthook"]
+
+_RING_CAP = max(16, int(os.environ.get("PADDLE_TPU_FLIGHT_RING",
+                                       "2048") or "2048"))
+_ring: "collections.deque[Tuple]" = collections.deque(maxlen=_RING_CAP)
+_recorded = 0  # total ever recorded (recorded - len(ring) = dropped)
+
+
+def record(kind: str, /, **fields) -> None:
+    """Append one event to the ring. Hot-path safe: one deque append;
+    callers pass only small json-safe scalars in ``fields`` (a
+    ``kind=`` field is fine — the positional event kind won't collide
+    with it)."""
+    global _recorded
+    _ring.append((time.perf_counter() * 1e6, kind, fields or None))
+    _recorded += 1
+
+
+def events() -> List[Tuple]:
+    """Snapshot of the ring, oldest first."""
+    return list(_ring)
+
+
+def clear() -> None:
+    global _recorded
+    _ring.clear()
+    _recorded = 0
+
+
+def stats() -> Dict[str, int]:
+    n = len(_ring)
+    return {"recorded": _recorded, "buffered": n,
+            "dropped": _recorded - n, "capacity": _RING_CAP}
+
+
+def tail_lines(n: int = 50) -> List[str]:
+    """The newest ``n`` events formatted one per line (the stderr
+    postmortem shape; ``tools/ft_timeline.py`` renders the merged
+    cross-process version of the same thing)."""
+    out = []
+    for ts_us, kind, fields in list(_ring)[-n:]:
+        kv = "" if not fields else " " + " ".join(
+            "%s=%s" % (k, fields[k]) for k in sorted(fields))
+        out.append("[flight +%12.3fms] %s%s" % (ts_us / 1e3, kind, kv))
+    return out
+
+
+def install_excepthook() -> None:
+    """Chain a hook onto ``sys.excepthook`` that prints the flight-ring
+    tail to stderr before the normal traceback — the last thing a
+    crashing worker says is what it was doing. Idempotent."""
+    prev = sys.excepthook
+    if getattr(prev, "_flight_hook", False):
+        return
+
+    def hook(exc_type, exc, tb):
+        try:
+            lines = tail_lines(50)
+            if lines:
+                print("-- flight recorder (last %d of %d events) --"
+                      % (len(lines), _recorded),
+                      file=sys.stderr, flush=True)
+                for ln in lines:
+                    print(ln, file=sys.stderr)
+        except Exception:
+            pass
+        try:
+            from . import distributed as _dist
+
+            _dist.dump_process()  # best-effort: no-op without a dir
+        except Exception:
+            pass
+        prev(exc_type, exc, tb)
+
+    hook._flight_hook = True
+    sys.excepthook = hook

@@ -246,8 +246,13 @@ class MetricsRegistry:
             items = list(labels) + list(extra)
             if not items:
                 return ""
+            # exposition-format label escaping (0.0.4): backslash
+            # first, then quote and newline — an unescaped newline in
+            # a label value would split the sample line and corrupt
+            # the whole scrape
             body = ",".join('%s="%s"' % (k, str(v).replace("\\", "\\\\")
-                                         .replace('"', '\\"'))
+                                         .replace('"', '\\"')
+                                         .replace("\n", "\\n"))
                             for k, v in items)
             return "{%s}" % body
 

@@ -98,17 +98,22 @@ class BatchPolicy:
 
 class PendingRequest:
     """One queued request: its feed, row count, completion future, and
-    the timestamps/deadline the engine needs for queue_ms + expiry."""
+    the timestamps/deadline the engine needs for queue_ms + expiry.
+    ``trace_ctx`` carries the submitter's trace context across the
+    queue — the dispatch happens on a worker thread, where the
+    submitter's thread-local context is out of reach."""
 
-    __slots__ = ("feed", "rows", "future", "deadline", "t_enqueue")
+    __slots__ = ("feed", "rows", "future", "deadline", "t_enqueue",
+                 "trace_ctx")
 
     def __init__(self, feed: Dict[str, np.ndarray], rows: int,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None, trace_ctx=None):
         self.feed = feed
         self.rows = int(rows)
         self.future: Future = Future()
         self.deadline = deadline          # time.monotonic() timestamp
         self.t_enqueue = time.monotonic()
+        self.trace_ctx = trace_ctx
 
 
 class DynamicBatcher:

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability import distributed as _dtrace
 from . import metrics as _m
 from .batcher import BatchPolicy, DynamicBatcher, PendingRequest
 
@@ -224,7 +225,12 @@ class ServingEngine:
             deadline_ms = self.config.default_deadline_ms
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
-        pending = PendingRequest(feed, rows, deadline)
+        # capture the submitter's trace context (set per-request by the
+        # HTTP front, or by any caller): the dispatch worker parents
+        # this request's span to it, so one serving request is one
+        # trace from HTTP arrival through batch dispatch
+        pending = PendingRequest(feed, rows, deadline,
+                                 trace_ctx=_dtrace.current())
         if not self._batcher.try_put(pending):
             if self._stopping:
                 # refusal came from close(), not capacity: a submit
@@ -340,6 +346,7 @@ class ServingEngine:
 
     def _dispatch(self, batch: List[PendingRequest]) -> None:
         now = time.monotonic()
+        t0_perf = time.perf_counter()
         live = []
         for p in batch:
             if p.deadline is not None and now > p.deadline:
@@ -392,6 +399,14 @@ class ServingEngine:
                 self._fail(p, e)
             return
         done = time.monotonic()
+        for p in live:
+            # one span per co-batched request, parented into the
+            # request's own propagated trace (an HTTP request with an
+            # X-Trace-Id arrives, queues, and dispatches as ONE trace)
+            if p.trace_ctx is not None:
+                _dtrace.record_span("serving.dispatch", t0_perf,
+                                    cat="serving", ctx=p.trace_ctx,
+                                    bucket=bucket, rows=p.rows)
         for p, result in zip(live, results):
             _m.observe(_m.TOTAL_MS, (done - p.t_enqueue) * 1e3)
             try:

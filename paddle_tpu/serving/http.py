@@ -15,9 +15,18 @@ Endpoints:
   500 (``BatchExecutionError`` — the model failed on that batch; the
   engine stays healthy).
 - ``GET /healthz`` — 200 while the engine accepts work, 503 otherwise
-  (the load-balancer drain signal).
-- ``GET /metrics`` — Prometheus text exposition straight from the
-  observability registry (serving.* plus every runtime family).
+  (the load-balancer drain signal); the body names this process's
+  metrics-dump path (``metrics_dump``) so an operator probing a
+  replica knows where its telemetry lands.
+- ``GET /metrics`` — the FULL observability registry via
+  ``observability.dump_prometheus()`` (one code path with every other
+  exporter: serving.* plus every runtime family, histogram quantile
+  / _sum / _count series included).
+
+Trace propagation: ``POST /predict`` honors an ``X-Trace-Id`` (+
+optional ``X-Parent-Span``) request header — the request's engine
+spans land under the caller's trace — and always echoes the trace id
+back in the response's ``X-Trace-Id`` header when spans are armed.
 """
 from __future__ import annotations
 
@@ -30,6 +39,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import distributed as _dtrace
 from .engine import (BatchExecutionError, DeadlineExpired, EngineStopped,
                      RequestTooLarge, ServerOverloaded, ServingEngine)
 
@@ -65,13 +75,16 @@ class _Handler(BaseHTTPRequestHandler):
         engine = self.server.engine
         if self.path == "/healthz":
             health = engine.health()
+            dump = _dtrace.dump_path()
             if health == "ok":
-                self._reply_json(200, {"status": "ok"})
+                self._reply_json(200, {"status": "ok",
+                                       "metrics_dump": dump})
             else:
                 # "draining": stop() flipped readiness but in-flight
                 # requests are still finishing — the supervisor must
                 # stop routing now and NOT kill the process yet
-                self._reply_json(503, {"status": health})
+                self._reply_json(503, {"status": health,
+                                       "metrics_dump": dump})
         elif self.path == "/metrics":
             self._reply(200, _obs.dump_prometheus().encode(),
                         "text/plain; version=0.0.4")
@@ -86,6 +99,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         engine: ServingEngine = self.server.engine
         t0 = time.monotonic()
+        req_ctx = None
         try:
             length = int(self.headers.get("Content-Length") or 0)
             req = json.loads(self.rfile.read(length) or b"{}")
@@ -98,31 +112,50 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("deadline_ms must be a number, got %r"
                                  % (deadline_ms,))
             feed = {str(n): np.asarray(v) for n, v in inputs.items()}
-            outputs = engine.predict(feed, deadline_ms=deadline_ms)
+            # a caller-supplied X-Trace-Id joins this request to the
+            # caller's trace; without one each request is its own
+            # trace. submit() captures the context, so the worker-side
+            # dispatch span lands under it too.
+            with _dtrace.child_span(
+                    "serving.request", cat="serving",
+                    trace_id=self.headers.get("X-Trace-Id") or None,
+                    parent_span=self.headers.get("X-Parent-Span")
+                    or None) as ctx:
+                req_ctx = ctx
+                outputs = engine.predict(feed, deadline_ms=deadline_ms)
         except ServerOverloaded as e:
             self._reply_json(503, {"error": str(e)},
-                             (("Retry-After", "1"),))
+                             (("Retry-After", "1"),) + self._echo(req_ctx))
         except EngineStopped as e:
-            self._reply_json(503, {"error": str(e)})
+            self._reply_json(503, {"error": str(e)}, self._echo(req_ctx))
         except DeadlineExpired as e:
-            self._reply_json(504, {"error": str(e)})
+            self._reply_json(504, {"error": str(e)}, self._echo(req_ctx))
         except BatchExecutionError as e:
             # the MODEL failed on this batch: the engine is still
             # healthy (don't drain), the CLIENT isn't at fault (not a
             # 4xx) — a plain 500 with the typed name
             self._reply_json(500, {"error": str(e),
-                                   "type": "BatchExecutionError"})
+                                   "type": "BatchExecutionError"},
+                             self._echo(req_ctx))
         except (ValueError, RequestTooLarge, json.JSONDecodeError) as e:
-            self._reply_json(400, {"error": str(e)})
+            self._reply_json(400, {"error": str(e)}, self._echo(req_ctx))
         except Exception as e:  # noqa: BLE001 — the model failed
             self._reply_json(500, {"error": "%s: %s"
-                                   % (type(e).__name__, e)})
+                                   % (type(e).__name__, e)},
+                             self._echo(req_ctx))
         else:
             self._reply_json(200, {
                 "outputs": {n: np.asarray(v).tolist()
                             for n, v in outputs.items()},
                 "latency_ms": (time.monotonic() - t0) * 1e3,
-            })
+            }, self._echo(req_ctx))
+
+    @staticmethod
+    def _echo(req_ctx) -> Tuple:
+        """The X-Trace-Id echo, on EVERY /predict reply — a failed
+        request is the one the caller most needs to correlate with its
+        distributed trace."""
+        return (("X-Trace-Id", req_ctx.trace_id),) if req_ctx else ()
 
 
 def _json_safe(obj):

@@ -6,7 +6,12 @@ threaded through the executors is a no-op on the hot path:
 1. microbenches the *disabled-path primitives* the hot loops actually
    execute (``observability.enabled()`` check, no-op ``span()``,
    guarded ``inc()``) — each must cost well under a microsecond;
-2. runs a tiny 2-op static program through the Executor and bounds the
+2. microbenches the distributed-observability primitives riding the
+   RPC path (disabled ``distributed.inject`` header stamp, disabled
+   ``child_span``, always-on ``flight.record`` ring append) against
+   the same budget — the ISSUE-5 propagation + flight-recorder
+   machinery must be noise even at rpc frequency;
+3. runs a tiny 2-op static program through the Executor and bounds the
    *projected* per-step instrumentation cost (sites-per-step x
    primitive cost) to a guard threshold — a fraction of even the
    fastest measured step, not an exact timing (CI boxes jitter).
@@ -43,7 +48,15 @@ def main():
               "PADDLE_TPU_METRICS / FLAGS_tpu_metrics", file=sys.stderr)
         return 2
 
+    if os.environ.get("PADDLE_TPU_METRICS_DIR"):
+        print("PADDLE_TPU_METRICS_DIR is set — it arms the metrics "
+              "layer; unset it for the default-off gate",
+              file=sys.stderr)
+        return 2
+
     from paddle_tpu import observability as obs
+    from paddle_tpu.observability import distributed as dist
+    from paddle_tpu.observability import flight
 
     assert not obs.enabled(), "metrics must default off"
 
@@ -55,6 +68,28 @@ def main():
           % (null_span, enabled_chk, guarded_inc, PRIMITIVE_BUDGET_US))
     ok = all(c < PRIMITIVE_BUDGET_US
              for c in (null_span, enabled_chk, guarded_inc))
+
+    # ISSUE 5 paths. Disabled trace propagation must degenerate to a
+    # branch (inject stamps nothing, child_span yields the shared
+    # no-op); the flight ring is ALWAYS-ON by design (a black box that
+    # needs arming is not a black box), so its per-event cost — one
+    # deque append — gets the same primitive budget as everything else.
+    hdr = {}
+    inject_cost = _bench_primitive(lambda: dist.inject(hdr))
+    assert not hdr, "disabled inject must stamp nothing"
+
+    def _null_child():
+        with dist.child_span("x"):
+            pass
+
+    child_cost = _bench_primitive(_null_child)
+    flight_cost = _bench_primitive(lambda: flight.record("x", a=1))
+    flight.clear()  # the benched events are not a real postmortem
+    print("propagation/flight cost: inject()=%.3fus child_span()="
+          "%.3fus flight.record()=%.3fus (budget %.1fus each)"
+          % (inject_cost, child_cost, flight_cost, PRIMITIVE_BUDGET_US))
+    ok = ok and all(c < PRIMITIVE_BUDGET_US
+                    for c in (inject_cost, child_cost, flight_cost))
 
     # tiny 2-op program: measure real steps, project the per-step
     # instrumentation cost from the primitive costs above

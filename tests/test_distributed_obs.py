@@ -1,0 +1,335 @@
+"""Distributed observability (ISSUE 5): trace-context propagation over
+the PS rpc frame, the crash flight recorder, per-process dumps +
+job-level merge, and the ft_timeline postmortem loader.
+
+Cross-process behavior (SIGKILLed children still contributing to the
+merged timeline, causal kill->failover->promotion ordering) is drilled
+end to end by tools/ft_smoke.py and tools/chaos_drill.py in CI gate 6;
+these tests pin the in-process contracts those drills build on."""
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401 — package init precedes submodule use
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import distributed as dist
+from paddle_tpu.observability import flight
+from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.reset()
+    obs.enable()
+    flight.clear()
+    yield
+    obs.reset()
+    obs.disable()
+    flight.clear()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class MiniScope(dict):
+    def local_var_names(self):
+        return list(self)
+
+
+class MiniExec:
+    def _read_var(self, scope, name):
+        return scope.get(name)
+
+    def _write_var(self, scope, name, val):
+        scope[name] = np.asarray(val)
+
+    def run_block(self, block, scope):
+        block(scope)
+
+
+# -- trace context ----------------------------------------------------------
+
+def test_trace_and_child_span_nesting():
+    with dist.trace("round") as root:
+        assert dist.current() is root
+        with dist.child_span("inner") as child:
+            assert child.trace_id == root.trace_id
+            assert child.span_id != root.span_id
+            assert dist.current() is child
+        assert dist.current() is root
+    assert dist.current() is None
+    spans = {e[0]: e for e in obs.tracing.trace_events()}
+    assert spans["inner"][5]["trace_id"] == root.trace_id
+    assert spans["inner"][5]["parent_span"] == root.span_id
+    assert spans["round"][5]["span_id"] == root.span_id
+
+
+def test_inject_extract_roundtrip_and_disabled_noop():
+    with dist.trace("t") as ctx:
+        msg = {"kind": "send_grad"}
+        dist.inject(msg)
+        assert msg["trace_id"] == ctx.trace_id
+        assert msg["parent_span"] == ctx.span_id
+        assert dist.extract(msg) == (ctx.trace_id, ctx.span_id)
+    # absent fields extract as (None, None) — the old-frame shape
+    assert dist.extract({"kind": "send_grad"}) == (None, None)
+    assert dist.extract(None) == (None, None)
+    # disarmed: inject stamps nothing, trace/child_span yield None
+    obs.disable()
+    msg = {}
+    dist.inject(msg)
+    assert msg == {}
+    with dist.trace("x") as c:
+        assert c is None
+    with dist.child_span("y") as c:
+        assert c is None
+
+
+def test_child_span_adopts_explicit_propagated_context():
+    with dist.child_span("rpc.server.send_grad", trace_id="feedbeef",
+                         parent_span="0a0b", cid="c1") as ctx:
+        assert ctx.trace_id == "feedbeef"
+    ev = obs.tracing.trace_events()[-1]
+    assert ev[5]["trace_id"] == "feedbeef"
+    assert ev[5]["parent_span"] == "0a0b"
+    assert ev[5]["cid"] == "c1"
+
+
+# -- propagation across the rpc frame --------------------------------------
+
+def _one_round_server(scope):
+    return PSServer("127.0.0.1:%d" % _free_port(), MiniExec(), scope,
+                    {"w@GRAD": lambda sc: sc.__setitem__(
+                        "w", sc["w"] - 0.1 * sc["w@GRAD"])}, fanin=1)
+
+
+def test_round_trace_spans_client_and_server():
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, np.float32)
+    server = _one_round_server(scope)
+    server.start_background()
+    c = PSClient(server._own_endpoint, trainer_id=0)
+    try:
+        c.send_grad("w@GRAD", np.ones(4, np.float32))
+        c.send_barrier()
+        c.get_param("w")
+        c.fetch_barrier()
+    finally:
+        c.close()
+        server.stop()
+    evs = obs.tracing.trace_events()
+    client = [e for e in evs if e[0].startswith("rpc.client.")]
+    served = [e for e in evs if e[0].startswith("rpc.server.")]
+    assert {e[0] for e in client} == {
+        "rpc.client.send_grad", "rpc.client.send_barrier",
+        "rpc.client.get_param", "rpc.client.fetch_barrier"}
+    assert len(served) == 4
+    # round 0 (send_grad + send_barrier) is ONE trace; round 1
+    # (get_param + fetch_barrier, after the round advanced) is another
+    by_kind = {e[0]: e[5]["trace_id"] for e in client}
+    assert by_kind["rpc.client.send_grad"] \
+        == by_kind["rpc.client.send_barrier"]
+    assert by_kind["rpc.client.get_param"] \
+        == by_kind["rpc.client.fetch_barrier"]
+    assert by_kind["rpc.client.send_grad"] \
+        != by_kind["rpc.client.get_param"]
+    # every server span landed under the propagated trace id, parented
+    # to the client's round span
+    client_traces = set(by_kind.values())
+    for e in served:
+        assert e[5]["trace_id"] in client_traces
+        assert e[5].get("parent_span")
+    # the apply joined the barrier's trace (thread-local context flows
+    # from the server span into the handler's downstream work)
+    apply_spans = [e for e in evs if e[0] == "ps.apply_round"]
+    assert apply_spans
+    assert apply_spans[0][5]["trace_id"] \
+        == by_kind["rpc.client.send_barrier"]
+    # per-attempt latency histogram, labeled by method
+    assert obs.histogram("rpc.latency_ms", method="send_grad").count >= 1
+    assert obs.histogram("rpc.latency_ms", method="get_param").count >= 1
+
+
+def test_unknown_header_fields_ignored_by_server():
+    """An old/new peer mismatch must be harmless in both directions:
+    extra json header fields are simply ignored."""
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, np.float32)
+    server = _one_round_server(scope)
+    server.start_background()
+    c = PSClient(server._own_endpoint, trainer_id=0)
+    try:
+        resp, _ = c._call({"kind": "heartbeat",
+                           "some_future_field": {"x": 1},
+                           "trace_id": "abcd", "parent_span": "ef01"})
+        assert resp["ok"]
+    finally:
+        c.close()
+        server.stop()
+
+
+def test_disabled_client_stamps_no_trace_fields():
+    obs.disable()
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, np.float32)
+    server = _one_round_server(scope)
+    server.start_background()
+    seen = {}
+    orig = server._handle
+
+    def spy(msg, raw):
+        seen.setdefault("msg", dict(msg))
+        return orig(msg, raw)
+
+    server._handle = spy
+    c = PSClient(server._own_endpoint, trainer_id=0)
+    try:
+        c.heartbeat()
+    finally:
+        c.close()
+        server.stop()
+    assert "trace_id" not in seen["msg"]
+    assert "parent_span" not in seen["msg"]
+
+
+# -- flight recorder --------------------------------------------------------
+
+def test_flight_ring_records_and_bounds():
+    flight.record("ps.promotion", round=3, index=1)
+    evs = flight.events()
+    assert evs[-1][1] == "ps.promotion"
+    assert evs[-1][2] == {"round": 3, "index": 1}
+    for i in range(flight._RING_CAP + 100):
+        flight.record("x", i=i)
+    st = flight.stats()
+    assert st["buffered"] == flight._RING_CAP
+    assert st["dropped"] >= 100
+    assert flight.tail_lines(5) and len(flight.tail_lines(5)) == 5
+    # a kind= field must not collide with the positional kind
+    flight.record("rpc.send", kind="send_grad")
+    assert flight.events()[-1][2] == {"kind": "send_grad"}
+
+
+# -- per-process dumps + job merge -----------------------------------------
+
+def _write_dump(d, role, rank, monkeypatch, restart=0):
+    monkeypatch.setenv("PADDLE_ROLE", role)
+    monkeypatch.setenv("PADDLE_TRAINER_ID", str(rank))
+    monkeypatch.setenv("PADDLE_PSERVER_INDEX", str(rank))
+    monkeypatch.setenv("PADDLE_RESTART_COUNT", str(restart))
+    dist._identity = None
+    name = "%s-%d%s.json" % (role, rank,
+                             ".r%d" % restart if restart else "")
+    return dist.dump_process(os.path.join(d, name))
+
+
+def test_dump_process_and_merge(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    obs.counter("rpc.retries", method="send_grad").inc(3)
+    with dist.trace("round"):
+        pass
+    flight.record("fault.injected", side="send", kind="drop")
+    p1 = _write_dump(d, "trainer", 0, monkeypatch)
+    obs.counter("rpc.retries", method="send_grad").inc(2)
+    flight.record("ps.promotion", round=2)
+    p2 = _write_dump(d, "pserver", 1, monkeypatch)
+    # dumps are valid json with the schema fields
+    doc = json.load(open(p1))
+    assert doc["schema"] == 1 and doc["role"] == "trainer"
+    assert doc["spans"] and doc["flight"]
+    assert "clock_offset_us" in doc
+
+    mpath, tpath = dist.merge_job_dir(d)
+    merged = json.load(open(mpath))
+    assert set(merged["processes"]) == {"trainer-0", "pserver-1"}
+    # totals SUM counters across processes; per-rank sections keep the
+    # unsummed views
+    assert merged["counters_total"][
+        "rpc.retries{method=send_grad}"] == 8
+    assert merged["processes"]["trainer-0"]["metrics"]["counters"][
+        "rpc.retries{method=send_grad}"] == 3
+    trace = json.load(open(tpath))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "process_name" in names          # per-process tracks
+    assert "fault.injected" in names        # flight instants
+    assert "ps.promotion" in names
+    assert "round" in names                 # spans
+    # events are wall-clock ordered
+    ts = [e["ts"] for e in trace["traceEvents"] if e["ph"] != "M"]
+    assert ts == sorted(ts)
+
+
+def test_relaunched_incarnation_gets_its_own_dump(tmp_path,
+                                                  monkeypatch):
+    d = str(tmp_path)
+    _write_dump(d, "trainer", 1, monkeypatch)
+    _write_dump(d, "trainer", 1, monkeypatch, restart=1)
+    merged = json.load(open(dist.merge_job_dir(d)[0]))
+    assert set(merged["processes"]) == {"trainer-1", "trainer-1.r1"}
+
+
+def test_clear_stale_dumps(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    _write_dump(d, "trainer", 0, monkeypatch)
+    dist.merge_job_dir(d)
+    (tmp_path / "not_a_dump.txt").write_text("keep me")
+    assert dist.clear_stale_dumps(d) >= 3
+    assert os.listdir(d) == ["not_a_dump.txt"]
+    assert dist.merge_job_dir(d) == (None, None)
+    assert dist.clear_stale_dumps(str(tmp_path / "missing")) == 0
+
+
+def test_metrics_dir_arms_layer_from_env(tmp_path):
+    """The one-switch contract: a set PADDLE_TPU_METRICS_DIR enables
+    metrics (dumps of a dark registry would be empty)."""
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import paddle_tpu.observability as o;"
+         "print(o.enabled(), o.distributed._arm_state.get('armed'))"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PADDLE_TPU_METRICS_DIR=str(tmp_path),
+                 PYTHONPATH=REPO),
+        capture_output=True, text=True, timeout=120)
+    assert out.stdout.strip() == "True True", out.stderr
+
+
+# -- ft_timeline loader -----------------------------------------------------
+
+def test_ft_timeline_loads_ordered_events(tmp_path, monkeypatch):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import ft_timeline
+    finally:
+        sys.path.pop(0)
+    d = str(tmp_path)
+    flight.record("rpc.send", kind="send_grad", seq=1)
+    flight.record("ps.round_apply", round=1)
+    _write_dump(d, "pserver", 0, monkeypatch)
+    flight.record("rpc.failover", frm="a", to="b")
+    _write_dump(d, "trainer", 0, monkeypatch)
+    events = ft_timeline.load_events(d)
+    assert [e["t_us"] for e in events] \
+        == sorted(e["t_us"] for e in events)
+    kinds = [e["kind"] for e in events]
+    assert "rpc.failover" in kinds and "ps.round_apply" in kinds
+    # default postmortem folds per-frame token noise out; --all keeps it
+    lines = ft_timeline.format_events(events)
+    assert all("rpc.send" not in ln for ln in lines)
+    assert any("rpc.failover" in ln for ln in lines)
+    all_lines = ft_timeline.format_events(events, show_frames=True)
+    assert any("rpc.send" in ln for ln in all_lines)

@@ -115,6 +115,49 @@ def test_snapshot_and_prometheus_format():
     assert "paddle_tpu_bubble 0.25" in text
 
 
+def test_prometheus_label_value_escaping():
+    """Exposition format 0.0.4: backslash, double quote, and newline in
+    label VALUES must be escaped — an unescaped newline would split the
+    sample line and corrupt the whole scrape."""
+    r = MetricsRegistry()
+    r.counter("evil", path='say "hi"\\there\nbye').inc()
+    text = r.to_prometheus()
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("paddle_tpu_evil")][0]
+    assert line == ('paddle_tpu_evil{path="say \\"hi\\"\\\\there'
+                    '\\nbye"} 1')
+    # one sample line, not two: the newline never reached the wire raw
+    assert sum(ln.startswith("paddle_tpu_evil")
+               for ln in text.splitlines()) == 1
+
+
+def test_prometheus_summary_series_shape():
+    """A histogram exports as a summary: one quantile series per
+    (labels, quantile) plus _sum and _count — the shape Prometheus
+    clients parse, including labeled families like
+    rpc.latency_ms{method=}."""
+    r = MetricsRegistry()
+    for v in range(1, 11):
+        r.histogram("rpc.latency_ms", method="send_grad").observe(v)
+    r.histogram("rpc.latency_ms", method="get_param").observe(7.0)
+    text = r.to_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE paddle_tpu_rpc_latency_ms summary" in lines
+    for q in ("0.5", "0.9", "0.99"):
+        assert any(ln.startswith(
+            'paddle_tpu_rpc_latency_ms{method="send_grad",'
+            'quantile="%s"}' % q) for ln in lines), q
+    assert 'paddle_tpu_rpc_latency_ms_sum{method="send_grad"} 55.0' \
+        in lines
+    assert 'paddle_tpu_rpc_latency_ms_count{method="send_grad"} 10' \
+        in lines
+    assert 'paddle_tpu_rpc_latency_ms_count{method="get_param"} 1' \
+        in lines
+    # exactly one TYPE header for the family, not one per label set
+    assert sum("TYPE paddle_tpu_rpc_latency_ms" in ln
+               for ln in lines) == 1
+
+
 # -- span tracing ----------------------------------------------------------
 
 def test_span_nesting_records_contained_intervals():

@@ -19,6 +19,15 @@ matter which frames the injector ate and which processes died.
 The schedule is a pure function of the seed (``make_schedule``), so a
 failing drill replays exactly: rerun with the printed seed.
 
+Each drill also runs with ``PADDLE_TPU_METRICS_DIR`` armed and gates
+on the job's merged telemetry (ISSUE 5): a job-level ``metrics.json``
+and merged chrome-trace ``trace.json`` must exist, the injected faults
+and the backup promotion must be visible in them, and the kill ->
+failover (``ps.failovers`` span) -> promotion -> first-applied-round
+chain must read in causal order across >= 3 processes
+(``check_telemetry``; the human-readable version is printed via
+``tools/ft_timeline.py``).
+
 Usage: python tools/chaos_drill.py [--rounds 1] [--sync-rounds 6]
        [--seed 1234]
 
@@ -46,6 +55,7 @@ _TOOLS = os.path.dirname(os.path.abspath(__file__))
 if _TOOLS not in sys.path:  # imported by tests, not only run directly
     sys.path.insert(0, _TOOLS)
 
+import ft_timeline  # noqa: E402 — the cross-process postmortem
 from ft_smoke import oracle_w  # noqa: E402 — ONE bit-for-bit oracle
 
 
@@ -118,6 +128,13 @@ def _env(sched: dict, tmp: str, eps: str) -> dict:
         "PADDLE_PS_CONNECT_TIMEOUT": "4",
         "PADDLE_PS_FAILOVER_CONNECT_TIMEOUT": "3",
         "PADDLE_PS_REPL_DEADLINE": "5",
+        # job-level telemetry: every process dumps registry + spans +
+        # flight ring here (dir implies metrics armed); a short cadence
+        # so even a SIGKILLed process leaves a fresh black box, and the
+        # launch supervisor merges the lot into metrics.json +
+        # trace.json at job end
+        "PADDLE_TPU_METRICS_DIR": os.path.join(tmp, "metrics"),
+        "PADDLE_TPU_DUMP_PERIOD": "0.5",
     })
     return env
 
@@ -151,10 +168,90 @@ def run_drill(sched: dict) -> int:
                  "match" if bitwise else "DIVERGE FROM",
                  r.get("failovers"), r.get("evictions")))
         ok = ok and bitwise
+    ok = check_telemetry(sched, os.path.join(tmp, "metrics")) and ok
     if not ok:
         print("[chaos] reproduce with: tools/chaos_drill.py --seed %d "
               "--sync-rounds %d" % (sched["seed"], sched["sync_rounds"]))
     return 0 if ok else 1
+
+
+def check_telemetry(sched: dict, mdir: str) -> bool:
+    """The drill's second gate (ISSUE 5): the job must leave ONE merged
+    picture in which the primary's kill, the trainers' failover
+    (``ps.failovers`` span), and the promoted backup's first applied
+    round are visible in causal order across >= 3 processes — and the
+    injected faults must show up in it."""
+    ok = True
+
+    def chk(what, passed):
+        nonlocal ok
+        print("[chaos] %s: %s" % ("PASS" if passed else "FAIL", what))
+        ok = ok and passed
+
+    # the postmortem itself (also re-merges metrics.json + trace.json)
+    ft_timeline.print_postmortem(mdir, limit=40)
+    mpath = os.path.join(mdir, "metrics.json")
+    tpath = os.path.join(mdir, "trace.json")
+    chk("job-level metrics.json + trace.json merged",
+        os.path.exists(mpath) and os.path.exists(tpath))
+    if not ok:
+        return False
+    merged = json.load(open(mpath))
+    chk("merged metrics preserve per-rank sections (%d processes)"
+        % len(merged["processes"]), len(merged["processes"]) >= 4)
+    n_faults = sum(v for k, v in merged["counters_total"].items()
+                   if k.startswith("fault.injected"))
+    chk("injected faults visible in merged counters (%d)" % n_faults,
+        n_faults > 0)
+    trace = json.load(open(tpath))
+    names = {}
+    for ev in trace.get("traceEvents", []):
+        names.setdefault(ev.get("name"), []).append(ev)
+    chk("merged timeline has injected-fault events",
+        bool(names.get("fault.injected")))
+    chk("merged timeline has the promotion event",
+        bool(names.get("ps.promotion")))
+    chk("merged timeline has the ps.failovers span",
+        any(ev.get("ph") == "X"
+            for ev in names.get("ps.failovers", [])))
+
+    # causal chain: kill -> failover -> promotion -> first applied
+    # round on the promoted backup, across >= 3 distinct processes
+    events = ft_timeline.load_events(mdir)
+
+    def first(pred):
+        for e in events:
+            if pred(e):
+                return e
+        return None
+
+    kill = first(lambda e: e["kind"] == "launch.exit"
+                 and e["fields"].get("role") == "pserver"
+                 and e["fields"].get("signal") == 9)
+    fo = first(lambda e: e["kind"] == "rpc.failover.begin"
+               and e["proc"].startswith("trainer"))
+    promo = first(lambda e: e["kind"] == "ps.promotion")
+    chk("supervisor observed the primary's SIGKILL", kill is not None)
+    chk("a trainer failed over", fo is not None)
+    chk("a backup was promoted", promo is not None)
+    if not ok:
+        return False
+    applied = first(lambda e: e["kind"] == "ps.round_applied"
+                    and e["proc"] == promo["proc"]
+                    and e["fields"].get("round")
+                    == sched["server_kill_round"]
+                    and e["t_us"] > promo["t_us"])
+    chk("promoted backup (%s) applied the killed round %d"
+        % (promo["proc"], sched["server_kill_round"]),
+        applied is not None)
+    if applied is not None:
+        chk("causal order: failover < promotion < first applied round",
+            fo["t_us"] < promo["t_us"] < applied["t_us"])
+        procs = {fo["proc"], promo["proc"], applied["proc"],
+                 kill["proc"]}
+        chk("chain spans >= 3 processes (%s)" % sorted(procs),
+            len(procs) >= 3)
+    return ok
 
 
 def main() -> int:

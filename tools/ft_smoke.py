@@ -39,6 +39,31 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "dist_worker_ft.py")
 if REPO not in sys.path:  # script-dir sys.path[0] is tools/
     sys.path.insert(0, REPO)
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:  # imported by tests, not only run directly
+    sys.path.insert(0, _TOOLS)
+
+
+def _check_telemetry(mdir: str, want_promotion: bool = False) -> bool:
+    """Post-drill: print the cross-process postmortem and require the
+    job-level merged artifacts (the launch supervisor writes them even
+    though children died by SIGKILL mid-run)."""
+    import ft_timeline
+
+    ft_timeline.print_postmortem(mdir, limit=40)
+    ok = True
+    for name in ("metrics.json", "trace.json"):
+        present = os.path.exists(os.path.join(mdir, name))
+        print("[ft_smoke] %s: job-level merged %s"
+              % ("PASS" if present else "FAIL", name))
+        ok = ok and present
+    if want_promotion:
+        events = ft_timeline.load_events(mdir)
+        promo = any(e["kind"] == "ps.promotion" for e in events)
+        print("[ft_smoke] %s: promotion visible in the merged timeline"
+              % ("PASS" if promo else "FAIL"))
+        ok = ok and promo
+    return ok
 
 
 def _free_port() -> int:
@@ -82,6 +107,7 @@ def run_server_kill(args) -> int:
     applying round 3: exit 0 + bit-for-bit params or bust."""
     tmp = tempfile.mkdtemp(prefix="ft_smoke_sk_")
     eps = "127.0.0.1:%d,127.0.0.1:%d" % (_free_port(), _free_port())
+    mdir = os.path.join(tmp, "metrics")
     print("[ft_smoke] server-kill drill: pservers at %s, %d rounds, "
           "primary dies applying round 3" % (eps, args.rounds))
     sup = subprocess.run(
@@ -94,6 +120,8 @@ def run_server_kill(args) -> int:
                  FT_ROUNDS=args.rounds, FT_SERVER_DIE_AT_ROUND=3,
                  FT_OUT=os.path.join(tmp, "out"),
                  FT_CKPT_ROOT=os.path.join(tmp, "ckpt"),
+                 PADDLE_TPU_METRICS_DIR=mdir,
+                 PADDLE_TPU_DUMP_PERIOD="0.5",
                  PADDLE_PS_CONNECT_TIMEOUT="4",
                  PADDLE_PS_FAILOVER_CONNECT_TIMEOUT="3",
                  # bit-for-bit gate: eviction trades exactness for
@@ -127,6 +155,7 @@ def run_server_kill(args) -> int:
             print("[ft_smoke] %s: %s"
                   % ("PASS" if passed else "FAIL", what))
             ok = ok and passed
+    ok = _check_telemetry(mdir, want_promotion=True) and ok
     return 0 if ok else 1
 
 
@@ -142,12 +171,15 @@ def main() -> int:
 
     tmp = tempfile.mkdtemp(prefix="ft_smoke_")
     endpoint = "127.0.0.1:%d" % _free_port()
+    mdir = os.path.join(tmp, "metrics")
     print("[ft_smoke] pserver at %s, %d rounds, rank 1 dies at round 3"
           % (endpoint, args.rounds))
     ps = subprocess.Popen(
         [sys.executable, WORKER],
         env=_env(FT_ROLE="pserver", PSERVER_ENDPOINT=endpoint,
-                 PADDLE_TRAINERS_NUM=2))
+                 PADDLE_TRAINERS_NUM=2,
+                 PADDLE_TPU_METRICS_DIR=mdir,
+                 PADDLE_TPU_DUMP_PERIOD="0.5"))
     try:
         sup = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
@@ -157,7 +189,9 @@ def main() -> int:
                      FT_ROUNDS=args.rounds, FT_DIE_AT_ROUND=3,
                      FT_DIE_RANK=1,
                      FT_OUT=os.path.join(tmp, "out"),
-                     FT_CKPT_ROOT=os.path.join(tmp, "ckpt")),
+                     FT_CKPT_ROOT=os.path.join(tmp, "ckpt"),
+                     PADDLE_TPU_METRICS_DIR=mdir,
+                     PADDLE_TPU_DUMP_PERIOD="0.5"),
             timeout=240, cwd=REPO)
         if sup.returncode != 0:
             print("[ft_smoke] FAIL: supervised job exited %d"
@@ -199,11 +233,19 @@ def main() -> int:
             print("[ft_smoke] %s: %s" % ("PASS" if passed else "FAIL",
                                          what))
             ok = ok and passed
-        return 0 if ok else 1
     finally:
         if ps.poll() is None:
+            # SIGTERM, not SIGKILL: the server's dump hook flushes its
+            # registry + flight ring on the way out, so the postmortem
+            # below includes the server's own view of the drill
+            ps.terminate()
+        try:
+            ps.wait(timeout=10)
+        except subprocess.TimeoutExpired:
             ps.kill()
-        ps.wait(timeout=10)
+            ps.wait(timeout=10)
+    ok = _check_telemetry(mdir) and ok
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

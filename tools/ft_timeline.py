@@ -1,0 +1,123 @@
+"""Cross-process postmortem: merge + print a job's flight recorders.
+
+After any supervised run with ``PADDLE_TPU_METRICS_DIR`` set (a chaos
+drill, an ft smoke, a real job), every process has left per-process
+dumps — registry snapshot, span buffer, flight-recorder ring — in the
+metrics dir. This tool merges them (``metrics.json`` + chrome-trace
+``trace.json``, via ``observability.distributed.merge_job_dir``) and
+prints the ONE thing a human wants after a drill: the ordered,
+wall-clock-rebased, cross-process sequence of flight events — which
+frames the injector ate, which rpc was in flight when the primary
+died, when the supervisor saw the corpse, when the trainer failed
+over, when the backup was promoted, and which round it applied first.
+
+``chaos_drill.py`` and ``ft_smoke.py`` import ``load_events`` /
+``print_postmortem`` to render (and assert on) exactly this timeline.
+
+Usage: python tools/ft_timeline.py <metrics_dir> [--limit N] [--all]
+
+By default heartbeat-ish noise is already absent (ps_rpc never flight-
+records heartbeat/repl_status) and per-frame ``rpc.send``/``rpc.recv``
+/``ps.rpc`` token lines are folded out unless ``--all`` is given — the
+default view is decisions, the ``--all`` view is frames.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # script-dir sys.path[0] is tools/
+    sys.path.insert(0, REPO)
+
+# per-frame token chatter: useful in --all mode, noise in the default
+# decision-level postmortem
+_FRAME_KINDS = ("rpc.send", "rpc.recv", "ps.rpc")
+
+
+def load_events(dirname: str) -> List[Dict]:
+    """Every flight event from every per-process dump under
+    ``dirname``, rebased onto the shared wall clock and sorted:
+    ``{"t_us": float, "proc": str, "pid": int, "kind": str,
+    "fields": dict}``."""
+    from paddle_tpu.observability import distributed as dist
+
+    out = []
+    for doc in dist.load_dumps(dirname):
+        for t_us, kind, fields in dist.doc_flight_events(doc):
+            out.append({"t_us": t_us, "proc": doc["proc"],
+                        "pid": doc.get("pid"), "kind": kind,
+                        "fields": fields})
+    out.sort(key=lambda e: e["t_us"])
+    return out
+
+
+def merge(dirname: str):
+    """(Re)write the job-level ``metrics.json`` + ``trace.json``."""
+    from paddle_tpu.observability import distributed as dist
+
+    return dist.merge_job_dir(dirname)
+
+
+def format_events(events: List[Dict],
+                  show_frames: bool = False) -> List[str]:
+    """One line per event, times relative to the first shown event."""
+    shown = [e for e in events
+             if show_frames or e["kind"] not in _FRAME_KINDS]
+    if not shown:
+        return []
+    t0 = shown[0]["t_us"]
+    lines = []
+    for e in shown:
+        kv = " ".join("%s=%s" % (k, e["fields"][k])
+                      for k in sorted(e["fields"]))
+        lines.append("+%9.3fs  %-12s %-20s %s"
+                     % ((e["t_us"] - t0) / 1e6, e["proc"], e["kind"],
+                        kv))
+    return lines
+
+
+def print_postmortem(dirname: str, show_frames: bool = False,
+                     limit: Optional[int] = None,
+                     out=sys.stdout) -> int:
+    """Merge + print the ordered cross-process timeline. Returns the
+    number of events printed (0 = nothing to tell)."""
+    mpath, tpath = merge(dirname)
+    events = load_events(dirname)
+    lines = format_events(events, show_frames=show_frames)
+    procs = sorted({e["proc"] for e in events})
+    print("== postmortem: %d flight events from %d process(es) %s =="
+          % (len(events), len(procs), procs), file=out)
+    if limit is not None and len(lines) > limit:
+        print("... (%d earlier events elided; --limit 0 for all)"
+              % (len(lines) - limit), file=out)
+        lines = lines[-limit:]
+    for ln in lines:
+        print(ln, file=out)
+    if mpath:
+        print("merged: %s + %s" % (mpath, tpath), file=out)
+    return len(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser("ft_timeline")
+    ap.add_argument("metrics_dir",
+                    help="the job's $PADDLE_TPU_METRICS_DIR")
+    ap.add_argument("--all", action="store_true",
+                    help="include per-frame rpc.send/recv token events")
+    ap.add_argument("--limit", type=int, default=200,
+                    help="print at most the newest N lines (0 = all)")
+    args = ap.parse_args()
+    if not os.path.isdir(args.metrics_dir):
+        print("no such metrics dir: %s" % args.metrics_dir,
+              file=sys.stderr)
+        return 2
+    n = print_postmortem(args.metrics_dir, show_frames=args.all,
+                         limit=args.limit or None)
+    return 0 if n else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
