@@ -151,6 +151,10 @@ def _time_steps(exe, main, feed, loss, warmup=3, iters=20, windows=2,
         "windows_s": [round(t, 3) for t in times],
         "warmup_s": round(t_compile, 1),
         "whole_compile": whole,
+        # single-chip runs move zero collective bytes — recorded
+        # explicitly so bench_diff.py can diff single- and multi-chip
+        # records under one schema
+        "collective_bytes": 0,
         # recompiles during the timed windows: nonzero means signature
         # churn is recompiling the program mid-measurement
         "recompiles": timed["compiles"],
@@ -638,6 +642,418 @@ def bench_gpt_long(batch=2, seq_len=4096, iters=6, use_bf16=True):
             "diag": diag}
 
 
+# -- multi-chip bench (ISSUE 6) ---------------------------------------------
+#
+# Promotes the MULTICHIP dryruns into *measured* runs: dp=8 data
+# parallelism for resnet50 / bert_base / gpt_long plus one 3D config
+# (dp2 x pp2 x mp2), on a virtual 8-device CPU mesh (the same
+# xla_force_host_platform_device_count recipe the dryruns and tests
+# use — on real multi-chip hardware the pin is a no-op and the same
+# code measures ICI). Shapes are CPU-sized (recorded in the output);
+# the numbers that matter are the per-step collective counters, which
+# are shape-exact and hardware-independent:
+#   collective.ops / bytes        what the step actually moves
+#   collective.pergrad_baseline_* the same program WITHOUT bucketing /
+#                                 sharded update (the before)
+#   collective.quant_int8_saving  bytes int8 quantization would shave
+# Per-process metric dumps land in $PADDLE_TPU_METRICS_DIR and the
+# parent merges them into job-level metrics.json (PR-5 pipeline), so
+# every win is provable from counters, not prints.
+
+MC_DEVICES = 8
+
+
+def _pin_host_mesh(n_devices):
+    """Pin a CPU platform with n virtual devices BEFORE the first jax
+    backend touch (same self-bootstrapping recipe as
+    __graft_entry__.dryrun_multichip)."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None or int(m.group(1)) < n_devices:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", flags)
+        os.environ["XLA_FLAGS"] = (
+            flags.strip()
+            + " --xla_force_host_platform_device_count=%d" % n_devices
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            "need %d devices, jax exposes %d — run each multichip "
+            "config in a fresh process" % (n_devices, len(jax.devices())))
+
+
+def _mc_build_mlp(batch):
+    main, startup, loss = _build_mnist_mlp(batch)
+    return main, startup, loss, batch  # unit: examples
+
+
+def _mc_build_resnet50(batch, img):
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="img", shape=[batch, 3, img, img],
+                       dtype="float32")
+        label = fluid.data(name="label", shape=[batch, 1], dtype="int64")
+        pred = models.resnet50(x)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    return main, startup, loss, batch
+
+
+def _mc_build_bert(batch, seq_len):
+    main, startup, loss, _M, _ = _build_bert_base(batch, seq_len,
+                                                  use_bf16=False)
+    return main, startup, loss, batch * seq_len  # unit: tokens
+
+
+def _mc_build_gpt(batch, seq_len):
+    main, startup, loss = _build_gpt_long(batch, seq_len, use_bf16=False)
+    return main, startup, loss, batch * seq_len
+
+
+def _mc_feeds(name, batch, img=96, seq_len=128):
+    rng = np.random.RandomState(0)
+    if name == "mlp":
+        return {"x": rng.rand(batch, 784).astype("float32"),
+                "label": rng.randint(0, 10, (batch, 1)).astype("int64")}
+    if name == "resnet50":
+        return {"img": rng.rand(batch, 3, img, img).astype("float32"),
+                "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
+    if name == "bert_base":
+        return {
+            "src": rng.randint(0, 30522, (batch, seq_len)).astype("int64"),
+            "pos": np.tile(np.arange(seq_len), (batch, 1)).astype("int64"),
+            "mpos": rng.randint(0, seq_len, (batch, 20)).astype("int64"),
+            "labels": rng.randint(0, 30522,
+                                  (batch, 20, 1)).astype("int64"),
+        }
+    if name == "gpt_long":
+        return {
+            "ids": rng.randint(0, 8192, (batch, seq_len)).astype("int64"),
+            "lbl": rng.randint(0, 8192,
+                               (batch * seq_len, 1)).astype("int64"),
+        }
+    raise ValueError(name)
+
+
+# per-config CPU-mesh shapes. ``batch`` is the GLOBAL batch; models
+# with batch-dependent reshapes (bert/gpt) are built at the
+# per-replica batch and fed the global one (shard_map slices the feed
+# — the same recipe as the dp x pp x mp dryrun), models without
+# (mlp/resnet) build at the global batch.
+MC_CONFIGS = {
+    "mlp": {"batch": 512, "unit": "examples_per_sec", "iters": 8},
+    "resnet50": {"batch": 16, "img": 96, "unit": "images_per_sec",
+                 "iters": 2},
+    "bert_base": {"batch": 8, "seq_len": 128, "unit": "tokens_per_sec",
+                  "iters": 2, "per_replica_build": True},
+    "gpt_long": {"batch": 8, "seq_len": 512, "unit": "tokens_per_sec",
+                 "iters": 2, "per_replica_build": True},
+    "dp2_pp2_mp2": {"unit": "examples_per_sec", "iters": 4},
+}
+
+
+def _pergrad_baseline(build, scope_state):
+    """Static collective estimate of the SAME model on the per-grad
+    path (no bucketing, no sharded update): one c_allreduce_sum per
+    grad. Shape-exact, nothing executed."""
+    from paddle_tpu.parallel.engine import _estimate_collective_bytes
+    from paddle_tpu.parallel.transpiler import insert_allreduce_ops
+
+    main, _startup, _loss, _units = build()
+    insert_allreduce_ops(main, MC_DEVICES)
+    est = _estimate_collective_bytes(main, scope_state)
+    return est["ops_total"], est["bytes_total"]
+
+
+def _quant_saving(program, scope_state):
+    """PROJECTED bytes/step a NATIVE int8 collective would shave off
+    this (already rewritten) program — computed by re-estimating with
+    the bucket / sharded ops' quant attr forced to int8 at native wire
+    width, then restored. The emulated int8 lowering psums int32
+    codes, so the executed-traffic counters do NOT shrink by this."""
+    from paddle_tpu.parallel.engine import _estimate_collective_bytes
+
+    touched = []
+    for op in program.global_block().ops:
+        if op.type in ("c_bucket_allreduce", "c_sharded_update"):
+            touched.append((op, op.attrs.get("quant", "none")))
+            op.attrs["quant"] = "int8"
+    est = _estimate_collective_bytes(program, scope_state,
+                                     native_wire=True)
+    for op, prev in touched:
+        op.attrs["quant"] = prev
+    return est["bytes_exact"] - est["bytes_total"]
+
+
+def _mc_counters():
+    from paddle_tpu import observability as obs
+
+    d = obs.dump()["counters"]
+    return {k: v for k, v in d.items() if k.startswith("parallel.")}
+
+
+def _mc_measure(exe, cp, feed, loss, iters, name):
+    """Shared timing/counter protocol for every multichip config: one
+    compile+sync run, then `iters` timed steps with results kept on
+    device until a final hard-syncing fetch, counter deltas divided
+    per step. Returns (dt_s, t_compile_s, final_loss, per_step)."""
+    t_compile = time.time()
+    exe.run(cp, feed=feed, fetch_list=[loss])  # compile + sync
+    t_compile = time.time() - t_compile
+    c0 = _mc_counters()
+    t0 = time.time()
+    for _ in range(iters - 1):
+        exe.run(cp, feed=feed, fetch_list=[loss], return_numpy=False)
+    (out,) = exe.run(cp, feed=feed, fetch_list=[loss])  # hard sync
+    dt = (time.time() - t0) / iters
+    c1 = _mc_counters()
+    final_loss = float(np.mean(np.asarray(out)))
+    if not np.isfinite(final_loss):
+        raise RuntimeError("%s diverged: loss=%r" % (name, final_loss))
+    delta = {k: c1.get(k, 0) - c0.get(k, 0) for k in c1}
+    steps = max(1, delta.get("parallel.steps", iters))
+    per_step = {k: v // steps for k, v in delta.items()
+                if k.startswith("parallel.collective")}
+    return dt, t_compile, final_loss, per_step
+
+
+def bench_multichip_config(name, iters=None, quant=None, sharded=True):
+    """Child-process entry: one multichip config on an 8-device CPU
+    mesh, JSON on stdout."""
+    _pin_host_mesh(MC_DEVICES)
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu.parallel.mesh_utils import make_mesh
+
+    obs.enable()
+    cfg = dict(MC_CONFIGS[name])
+    unit = cfg.pop("unit")
+    iters = iters or cfg.pop("iters")
+    cfg.pop("iters", None)
+    per_replica = cfg.pop("per_replica_build", False)
+    if quant:
+        os.environ["PADDLE_TPU_QUANT_ALLREDUCE"] = quant
+    if sharded and name != "dp2_pp2_mp2":
+        os.environ.setdefault("PADDLE_TPU_SHARDED_UPDATE", "1")
+
+    if name == "dp2_pp2_mp2":
+        return _mc_3d_config(iters, unit)
+
+    bcfg = dict(cfg)
+    if per_replica:
+        if bcfg["batch"] % MC_DEVICES:
+            raise ValueError("global batch %d not divisible by dp=%d"
+                             % (bcfg["batch"], MC_DEVICES))
+        bcfg["batch"] //= MC_DEVICES
+    builders = {"mlp": lambda: _mc_build_mlp(bcfg["batch"]),
+                "resnet50": lambda: _mc_build_resnet50(**bcfg),
+                "bert_base": lambda: _mc_build_bert(**bcfg),
+                "gpt_long": lambda: _mc_build_gpt(**bcfg)}
+    with fluid.unique_name.guard():
+        main, startup, loss, units_per_step = builders[name]()
+    if per_replica:
+        units_per_step *= MC_DEVICES  # builder counted one replica
+    feed = _device_feed(_mc_feeds(name, **cfg))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        state = {}
+        for vname in main.global_block().vars:
+            var = scope.find_var(vname)
+            if var is not None and var.is_initialized():
+                state[vname] = np.asarray(var.raw().array)
+        with fluid.unique_name.guard():
+            base_ops, base_bytes = _pergrad_baseline(
+                builders[name], state)
+        mesh = make_mesh([MC_DEVICES], ["dp"])
+        cp = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, places=mesh)
+        dt, t_compile, final_loss, per_step = _mc_measure(
+            exe, cp, feed, loss, iters, name)
+        quant_save = _quant_saving(main, state)
+    from paddle_tpu.parallel.collectives import (bucket_mb, quant_mode,
+                                                 sharded_update_enabled)
+
+    return {
+        "config": name, "mesh": {"dp": MC_DEVICES}, "unit": unit,
+        "step_ms": dt * 1e3,
+        "tokens_or_images_per_sec": units_per_step / dt,
+        unit: units_per_step / dt,
+        "loss": final_loss, "shapes": cfg, "iters": iters,
+        "warmup_s": round(t_compile, 1),
+        "collective_bytes": per_step.get("parallel.collective_bytes", 0),
+        "collective": {
+            "per_step": per_step,
+            "pergrad_baseline_ops": base_ops,
+            "pergrad_baseline_bytes": base_bytes,
+            "quant_int8_bytes_saved": int(quant_save),
+        },
+        "knobs": {"bucket_mb": bucket_mb(), "quant": quant_mode(),
+                  "sharded_update": sharded_update_enabled()},
+    }
+
+
+def _mc_3d_config(iters, unit):
+    """dp2 x pp2 x mp2: dp replicas of a 2-stage pipeline whose first
+    stage holds an mp-row-sharded embedding (the MULTICHIP_r05 3D
+    parity config, grown to measurable size)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.incubate.fleet.collective import (CollectiveOptimizer,
+                                                      DistributedStrategy)
+    from paddle_tpu.parallel.mesh_utils import make_mesh
+
+    dp, pp, mp = 2, 2, 2
+    n_micro, mb = 2, 32
+    B = dp * n_micro * mb
+    V, D, H = 2048, 64, 256
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        ids = fluid.data(name="ids", shape=[mb, 1], dtype="int64")
+        tgt = fluid.data(name="tgt", shape=[mb, 16], dtype="float32")
+        emb = fluid.layers.embedding(
+            ids, size=[V, D], param_attr=fluid.ParamAttr(name="emb_w"))
+        h1 = fluid.layers.fc(emb, size=H, act="relu")
+        h2 = fluid.layers.fc(h1, size=H, act="relu")
+        pred = fluid.layers.fc(h2, size=16)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pred, tgt)))
+        strat = DistributedStrategy()
+        strat.sharded_embedding = True
+        strat.mp_degree = mp
+        strat.pipeline = True
+        strat.pipeline_cut_list = [[h1]]
+        strat.pipeline_num_microbatches = n_micro
+        CollectiveOptimizer(fluid.optimizer.MomentumOptimizer(0.1, 0.9),
+                            strat).minimize(loss,
+                                            startup_program=startup)
+
+    rng = np.random.RandomState(41)
+    feed = _device_feed({
+        "ids": rng.randint(0, V, (B, 1)).astype("int64"),
+        "tgt": rng.randn(B, 16).astype("float32"),
+    })
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        mesh = make_mesh([dp, pp, mp], ["dp", "pp", "mp"])
+        cp = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, places=mesh)
+        dt, t_compile, final_loss, per_step = _mc_measure(
+            exe, cp, feed, loss, iters, "dp2_pp2_mp2")
+    return {
+        "config": "dp2_pp2_mp2", "unit": unit,
+        "mesh": {"dp": dp, "pp": pp, "mp": mp},
+        "step_ms": dt * 1e3,
+        "tokens_or_images_per_sec": B / dt,
+        unit: B / dt, "loss": final_loss,
+        "shapes": {"batch": B, "vocab": V, "d": D, "hidden": H,
+                   "n_micro": n_micro},
+        "iters": iters, "warmup_s": round(t_compile, 1),
+        "collective_bytes": per_step.get("parallel.collective_bytes", 0),
+        "collective": {"per_step": per_step},
+        "knobs": {},
+    }
+
+
+def _mc_subprocess(name, jobdir, rank, quant=None, timeout=900):
+    import subprocess
+
+    args = [sys.executable, __file__, "--mc-config=" + name]
+    if quant:
+        args.append("--mc-quant=" + quant)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "").strip()
+                      + " --xla_force_host_platform_device_count=%d"
+                      % MC_DEVICES).strip(),
+        "PADDLE_TPU_METRICS": "1",
+        "PADDLE_TPU_METRICS_DIR": jobdir,
+        "PADDLE_ROLE": "bench",
+        "PADDLE_TRAINER_ID": str(rank),
+    })
+    proc = subprocess.run(args, capture_output=True, text=True,
+                          timeout=timeout, env=env)
+    if proc.returncode != 0:
+        raise RuntimeError("multichip bench %s failed: %s"
+                           % (name, proc.stderr[-2000:]))
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_multichip(out_path=None, configs=None, quant_config="bert_base"):
+    """Parent: run every multichip config in its own process (fresh
+    device-count pin per child), merge the children's metric dumps
+    into job-level metrics.json, write MULTICHIP_BENCH json."""
+    import tempfile
+
+    out_path = out_path or "MULTICHIP_BENCH_r01.json"
+    configs = configs or ["resnet50", "bert_base", "gpt_long",
+                          "dp2_pp2_mp2"]
+    jobdir = tempfile.mkdtemp(prefix="mc_bench_metrics_")
+    t_start = time.time()
+    results, errors = {}, {}
+    rank = 0
+    for name in configs:
+        try:
+            results[name] = _mc_subprocess(name, jobdir, rank)
+        except Exception as e:
+            errors[name] = repr(e)
+            print("multichip %s failed: %r" % (name, e), file=sys.stderr)
+        rank += 1
+    # one opt-in quantized variant: the measured (not just estimated)
+    # bytes saved + its throughput delta
+    if quant_config in results:
+        try:
+            results[quant_config + "_int8"] = _mc_subprocess(
+                quant_config, jobdir, rank, quant="int8")
+        except Exception as e:
+            errors[quant_config + "_int8"] = repr(e)
+            print("multichip %s int8 failed: %r" % (quant_config, e),
+                  file=sys.stderr)
+
+    from paddle_tpu.observability.distributed import merge_job_dir
+
+    metrics_path, _trace = merge_job_dir(jobdir)
+    merged = None
+    if metrics_path:
+        with open(metrics_path) as f:
+            merged = json.load(f)
+
+    doc = {
+        "schema": "multichip_bench_v1",
+        "n_devices": MC_DEVICES,
+        "platform": "cpu_host_mesh",
+        "configs": results,
+        "errors": errors,
+        "wall_s": round(time.time() - t_start, 1),
+        # job-level merged counter totals (PR-5 pipeline): the
+        # provable-win surface — collective ops/bytes by kind across
+        # every config in this run
+        "metrics_totals": (merged or {}).get("counters_total"),
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    if merged is not None:
+        mpath = os.path.splitext(out_path)[0] + ".metrics.json"
+        with open(mpath, "w") as f:
+            json.dump(merged, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(doc))
+    return doc
+
+
 def _run_one(name, use_bf16):
     """Child-process entry: bench one model, print its JSON."""
     _enable_compile_cache()
@@ -691,6 +1107,33 @@ def _bench_subprocess(name, use_bf16):
 
 def main():
     use_bf16 = "--no-bf16" not in sys.argv
+    mc_quant = None
+    mc_iters = None
+    out_path = None
+    for a in sys.argv[1:]:
+        if a.startswith("--mc-quant="):
+            mc_quant = a.split("=", 1)[1]
+        elif a.startswith("--mc-iters="):
+            mc_iters = int(a.split("=", 1)[1])
+        elif a.startswith("--out="):
+            out_path = a.split("=", 1)[1]
+    for a in sys.argv[1:]:
+        if a.startswith("--mc-config="):
+            _enable_compile_cache()
+            print(json.dumps(bench_multichip_config(
+                a.split("=", 1)[1], iters=mc_iters, quant=mc_quant)))
+            return
+    if "--multichip" in sys.argv:
+        configs = [a.split("=", 1)[1].split(",")
+                   for a in sys.argv[1:]
+                   if a.startswith("--mc-only=")]
+        doc = bench_multichip(out_path=out_path,
+                              configs=configs[0] if configs else None)
+        if doc["errors"]:
+            # the artifact (with whatever was measured) is written, but
+            # a run that failed configs must not look like a clean pass
+            raise SystemExit(1)
+        return
     for a in sys.argv[1:]:
         if a.startswith("--model="):
             _run_one(a.split("=", 1)[1], use_bf16)

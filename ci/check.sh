@@ -101,8 +101,17 @@ python tools/ft_smoke.py --server-kill
 # across >= 3 processes); a failure prints the seed that replays it
 python tools/chaos_drill.py --rounds 1
 
+echo "== gate 7: multichip fast-path smoke =="
+# dp=8 CPU host mesh, mlp config, ~1 min: the bucketed/sharded
+# collective path must STRICTLY reduce per-step collective ops vs a
+# forced per-grad run, the sharded-update parity tests must be
+# bit-for-bit, and tools/bench_diff.py must answer --help and pass
+# its --self-test (the mechanical perf gate bench artifacts diff
+# through)
+python tools/mc_smoke.py
+
 if [[ "${SKIP_TESTS:-0}" != "1" ]]; then
-    echo "== gate 7: test suite =="
+    echo "== gate 8: test suite =="
     python -m pytest tests/ -q
 fi
 echo "ALL CI GATES PASS"

@@ -80,6 +80,17 @@ def mesh_axis_active(name: Optional[str]) -> bool:
     return bool(name) and name in _ACTIVE_MESH_AXES
 
 
+def static_axis_size(axis_name) -> int:
+    """Size of a live named mesh axis as a python int.
+    ``lax.axis_size`` only exists on newer jax; ``psum(1, axis)`` of a
+    literal is the portable spelling — constant-folded to the axis size
+    at trace time on every jax this repo supports."""
+    try:
+        return int(jax.lax.axis_size(axis_name))
+    except AttributeError:
+        return int(jax.lax.psum(1, axis_name))
+
+
 def _allreduce(name, reducer):
     @register_op(
         name,
@@ -179,7 +190,7 @@ def _alltoall(ins, attrs):
     x = ins["X"]
     if axis is None:
         return {"Out": x}
-    n = jax.lax.axis_size(axis)
+    n = static_axis_size(axis)
     xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
     out = jax.lax.all_to_all(xs, axis, split_axis=0, concat_axis=0, tiled=False)
     return {"Out": out.reshape(x.shape)}
@@ -265,3 +276,194 @@ def _broadcast_legacy(ins, attrs):
     distributed_ops/broadcast_op.cc) — same lowering as c_broadcast on
     ring 0."""
     return _c_broadcast(ins, {**attrs, "ring_id": 0})
+
+
+# -- bucketed / quantized collectives (parallel/collectives.py rewrites) ----
+
+# wire width per element a NATIVE quantized collective would move
+# (the EQuARX projection); None means "the tensor's own itemsize"
+QUANT_WIRE_ITEMSIZE = {"none": None, "bf16": 2, "int8": 1}
+
+# payload width per element the EMULATED lowering actually psums:
+# bf16 crosses as bf16, but int8 codes are summed in an int32
+# accumulator (quantized_psum) — 4 bytes/element on today's wire. The
+# executed-traffic counters charge these; QUANT_WIRE_ITEMSIZE only
+# backs the projected-native-savings estimate.
+QUANT_PSUM_ITEMSIZE = {"none": None, "bf16": 2, "int8": 4}
+
+
+def quantized_psum(x, axis, quant="none"):
+    """psum with an optional EQuARX-style compressed payload.
+
+    - ``bf16``: the payload crosses the wire as bfloat16 (half the f32
+      bytes), summed in bf16, widened back.
+    - ``int8``: per-bucket uniform quantization — every replica scales
+      by the SAME per-bucket step (pmax of local absmax / 127), rounds
+      to [-127, 127], and the integer codes are summed exactly (int32
+      accumulator — the emulation of an int8 wire payload with a
+      wider-than-wire accumulation, which is how EQuARX avoids
+      saturation). Worst-case absolute error per element is
+      n * scale / 2 (each replica contributes at most half a step of
+      rounding error) — the bound tests/test_collectives.py gates on.
+    """
+    if quant in (None, "", "none"):
+        return jax.lax.psum(x, axis)
+    if quant == "bf16":
+        return jax.lax.psum(x.astype(jnp.bfloat16), axis).astype(x.dtype)
+    if quant == "int8":
+        absmax = jax.lax.pmax(jnp.max(jnp.abs(x)), axis)
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(x.dtype)
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int32)
+        return jax.lax.psum(q, axis).astype(x.dtype) * scale
+    raise ValueError("unknown quantized-allreduce mode %r" % (quant,))
+
+
+def _flat_concat(xs):
+    if len(xs) == 1:
+        return xs[0].reshape(-1)
+    return jnp.concatenate([x.reshape(-1) for x in xs])
+
+
+@register_op(
+    "c_bucket_allreduce",
+    inputs=[In("X", duplicable=True)],
+    outputs=[Out("Out", duplicable=True, is_ref=True)],
+    attrs={"ring_id": 0, "quant": "none", "use_calc_stream": True},
+    grad=None,
+)
+def _c_bucket_allreduce(ins, attrs):
+    """N same-dtype grads coalesced into ONE flat psum (the bucketed
+    replacement for N per-grad c_allreduce_sum ops — see
+    parallel/collectives.py for the scheduling rewrite). psum is
+    elementwise over replicas, so concat-then-psum is bit-for-bit
+    identical to psum-then-concat; quant != "none" opts into the
+    compressed payload."""
+    xs = ins["X"]
+    axis = axis_for_ring(attrs.get("ring_id", 0))
+    quant = attrs.get("quant", "none")
+    if axis is None:
+        return {"Out": list(xs)}
+    red = quantized_psum(_flat_concat(xs), axis, quant)
+    outs, off = [], 0
+    for x in xs:
+        k = int(x.size)
+        outs.append(red[off:off + k].reshape(x.shape))
+        off += k
+    return {"Out": outs}
+
+
+# state slots each sharded-update optimizer carries, in (StateA, StateB)
+# order; scalar Beta*Pow accumulators ride separately (per-param, tiny)
+SHARDED_UPDATE_SLOTS = {
+    "sgd": (),
+    "momentum": ("Velocity",),
+    "adam": ("Moment1", "Moment2"),
+    "adamw": ("Moment1", "Moment2"),
+}
+
+
+@register_op(
+    "c_sharded_update",
+    inputs=[In("Param", duplicable=True), In("Grad", duplicable=True),
+            In("LearningRate"),
+            In("StateA", dispensable=True), In("StateB", dispensable=True),
+            In("Beta1Pow", duplicable=True, dispensable=True),
+            In("Beta2Pow", duplicable=True, dispensable=True)],
+    outputs=[Out("ParamOut", duplicable=True, is_ref=True),
+             Out("StateAOut", is_ref=True, dispensable=True),
+             Out("StateBOut", is_ref=True, dispensable=True),
+             Out("Beta1PowOut", duplicable=True, is_ref=True,
+                 dispensable=True),
+             Out("Beta2PowOut", duplicable=True, is_ref=True,
+                 dispensable=True)],
+    attrs={"op_type": "sgd", "shard_axis": "", "nranks": 1,
+           "padded_size": 0, "quant": "none"},
+    grad=None,
+)
+def _c_sharded_update(ins, attrs):
+    """Cross-replica sharded weight update (PAPERS.md "Automatic
+    Cross-Replica Sharding of Weight Update in Data-Parallel
+    Training"): ONE op replaces a whole optimizer instance's per-param
+    (allreduce, update) pairs. Inside the mesh each replica
+
+      1. psums the flat concat of ALL the group's grads (one collective,
+         optionally quantized) — elementwise identical to the per-grad
+         psums it replaces;
+      2. slices ITS 1/n shard of the flat grads/params; optimizer state
+         arrives already sharded (StateA/StateB are flat vars the
+         rewrite marked with a dp shard spec, so each replica only ever
+         holds — and updates — its shard);
+      3. applies the (elementwise) optimizer math on the shard;
+      4. all_gathers just the updated param shards back to full
+         replicated params.
+
+    n redundant full updates become 1/n of one update per replica.
+    Outside a mesh (dense run of the transpiled program) the same math
+    runs on the full flat arrays — elementwise, so bit-for-bit with the
+    sharded path AND with the replicated per-param path.
+    """
+    from . import optimizer_ops as _oo
+
+    fns = {"sgd": _oo._sgd, "momentum": _oo._momentum,
+           "adam": _oo._adam, "adamw": _oo._adamw}
+    op_type = attrs["op_type"]
+    fn = fns[op_type]
+    slots = SHARDED_UPDATE_SLOTS[op_type]
+    axis = attrs.get("shard_axis") or None
+    quant = attrs.get("quant", "none")
+    params, grads = ins["Param"], ins["Grad"]
+    sizes = [int(p.size) for p in params]
+    total = sum(sizes)
+    padded = int(attrs.get("padded_size") or total)
+    live = mesh_axis_active(axis)
+
+    def _pad(flat):
+        if padded > flat.size:
+            return jnp.concatenate(
+                [flat, jnp.zeros((padded - flat.size,), flat.dtype)])
+        return flat
+
+    g_flat = _pad(_flat_concat(grads))
+    p_flat = _pad(_flat_concat(params))
+    sub = {"LearningRate": ins["LearningRate"]}
+    for scalar in ("Beta1Pow", "Beta2Pow"):
+        if ins.get(scalar):
+            # per-param accumulators are bitwise-identical (same init,
+            # same update); the shard math uses the first
+            sub[scalar] = ins[scalar][0]
+    if live:
+        n = int(attrs.get("nranks", 1))  # static (lax.axis_size is
+        shard = padded // n              # missing on older jax)
+        g_sum = quantized_psum(g_flat, axis, quant)
+        idx = jax.lax.axis_index(axis)
+        start = idx * shard
+        sub["Grad"] = jax.lax.dynamic_slice(g_sum, (start,), (shard,))
+        sub["Param"] = jax.lax.dynamic_slice(p_flat, (start,), (shard,))
+        for key, slot in zip(("StateA", "StateB"), slots):
+            sub[slot] = ins[key]  # already the local [padded/n] shard
+        outs = fn(sub, attrs)
+        p_new = jax.lax.all_gather(outs["ParamOut"], axis)
+        p_new = p_new.reshape(-1)[:total]
+    else:
+        sub["Grad"] = g_flat
+        sub["Param"] = p_flat
+        for key, slot in zip(("StateA", "StateB"), slots):
+            sub[slot] = ins[key]  # the full flat state
+        outs = fn(sub, attrs)
+        p_new = outs["ParamOut"][:total]
+
+    result = {"ParamOut": [], "StateAOut": outs.get(slots[0] + "Out")
+              if slots else None}
+    if len(slots) > 1:
+        result["StateBOut"] = outs.get(slots[1] + "Out")
+    off = 0
+    for p, k in zip(params, sizes):
+        result["ParamOut"].append(p_new[off:off + k].reshape(p.shape))
+        off += k
+    if ins.get("Beta1Pow"):
+        b1 = attrs.get("beta1", 0.9)
+        result["Beta1PowOut"] = [b * b1 for b in ins["Beta1Pow"]]
+    if ins.get("Beta2Pow"):
+        b2 = attrs.get("beta2", 0.999)
+        result["Beta2PowOut"] = [b * b2 for b in ins["Beta2Pow"]]
+    return result

@@ -1,0 +1,512 @@
+"""Fast collective path: bucketed / quantized allreduce + cross-replica
+sharded weight update (program rewrites over the transpiled IR).
+
+Two PAPERS.md blueprints, applied as passes after
+``transpiler.insert_allreduce_ops``:
+
+- **Bucketed gradient allreduce** (``bucket_allreduce_ops``): N per-grad
+  ``c_allreduce_sum`` ops coalesce into few ``c_bucket_allreduce`` ops
+  (one flat psum each). Buckets are assembled in grad *availability*
+  order — the order backward produces them — and each bucket op is
+  hoisted to just after the last op that touches any of its grads, so
+  early buckets reduce while later backward compute still runs (XLA
+  overlaps the independent collective), and a size cap
+  (``PADDLE_TPU_BUCKET_MB``) keeps buckets pipelined instead of one
+  giant end-of-step psum. Bit-for-bit: psum is elementwise over
+  replicas, so concat-then-psum == psum-then-concat.
+
+- **Quantized allreduce** (EQuARX): opt-in via
+  ``PADDLE_TPU_QUANT_ALLREDUCE=bf16|int8`` — the bucket payload crosses
+  the wire compressed (per-bucket scale for int8; see
+  ``ops.collective_ops.quantized_psum``). Off by default; gated by the
+  measured-error + mlp-convergence tests in tests/test_collectives.py.
+
+- **Cross-replica sharded weight update**
+  (``apply_sharded_weight_update``): each optimizer instance's per-param
+  (allreduce, update) pairs collapse into ONE ``c_sharded_update`` op —
+  one flat grad psum, each replica updates its 1/n shard of the flat
+  param/optimizer state, one allgather of updated param shards.
+  Optimizer state lives in flat vars sharded over the data axis (a
+  shard spec the engine's shard_map honors), so each replica holds 1/n
+  of the moments — the paper's memory/compute win. Opt-in via
+  ``PADDLE_TPU_SHARDED_UPDATE=1`` or
+  ``BuildStrategy.fuse_all_optimizer_ops``.
+
+Knob summary (read once per program, at first mesh run):
+
+=============================  =============================================
+``PADDLE_TPU_BUCKET_MB``       bucket cap in MB (default 4; ``0`` disables
+                               bucketing). ``BuildStrategy.
+                               fuse_all_reduce_ops=False`` also disables.
+``PADDLE_TPU_QUANT_ALLREDUCE`` ``bf16`` | ``int8`` (default off/exact)
+``PADDLE_TPU_SHARDED_UPDATE``  ``1`` enables, ``0`` forces off (overrides
+                               the BuildStrategy knob either way)
+=============================  =============================================
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.collective_ops import QUANT_WIRE_ITEMSIZE, SHARDED_UPDATE_SLOTS
+from .transpiler import _bump_version, _merge_data_axes
+
+DEFAULT_BUCKET_MB = 4.0
+
+# optimizer ops whose update math is elementwise in (param, grad, state)
+# — the precondition for flat-shard updates being bit-for-bit with the
+# per-param path. lars/lamb (param-norm terms) and friends stay on the
+# per-param path. SHARDED_UPDATE_SLOTS also names each op's accumulator
+# input slots, folded into the flat sharded state vars.
+_SHARDABLE_OPTIMIZERS = frozenset(SHARDED_UPDATE_SLOTS)
+
+
+def bucket_mb(build_strategy=None) -> float:
+    if build_strategy is not None and not getattr(
+            build_strategy, "fuse_all_reduce_ops", True):
+        return 0.0
+    raw = os.environ.get("PADDLE_TPU_BUCKET_MB", "").strip()
+    if not raw:
+        return DEFAULT_BUCKET_MB
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return DEFAULT_BUCKET_MB
+
+
+def quant_mode() -> str:
+    raw = os.environ.get("PADDLE_TPU_QUANT_ALLREDUCE", "").strip().lower()
+    if raw in ("", "0", "none", "off", "false"):
+        return "none"
+    if raw not in QUANT_WIRE_ITEMSIZE:
+        raise ValueError(
+            "PADDLE_TPU_QUANT_ALLREDUCE=%r (want bf16 or int8)" % raw)
+    return raw
+
+
+def sharded_update_enabled(build_strategy=None) -> bool:
+    raw = os.environ.get("PADDLE_TPU_SHARDED_UPDATE", "").strip()
+    if raw:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return bool(build_strategy is not None and getattr(
+        build_strategy, "fuse_all_optimizer_ops", False))
+
+
+def _lookup_value(store, name):
+    """Live value of ``name`` from either a Scope or a plain state
+    mapping (engine's scope-state dict); None when absent."""
+    if store is None or not name:
+        return None
+    find = getattr(store, "find_var", None)
+    if find is None:
+        return store.get(name)
+    var = find(name)
+    if var is not None and var.is_initialized():
+        return var.raw().array
+    return None
+
+
+def _numel_and_dtype(block, store, name) -> Tuple[Optional[int], str]:
+    """Element count + dtype of a var, best effort: block var shape,
+    else its live value (Scope or state mapping), else the replicated
+    param a grad mirrors. The ONE size resolver behind both the bucket
+    planner's byte accounting and engine._var_nbytes — the two must
+    agree for the bucketing/quantization counters to be coherent."""
+    from ..core.lod_lowering import _grad_base
+
+    v = block._find_var_recursive(name)
+    shape = getattr(v, "shape", None) if v is not None else None
+    dtype = str(getattr(v, "dtype", None) or "float32")
+    if shape and all(isinstance(s, int) and s > 0 for s in shape):
+        return int(np.prod(shape)), dtype
+    arr = _lookup_value(store, name)
+    if arr is not None:
+        return int(getattr(arr, "size", 0)), str(arr.dtype)
+    base = _grad_base(name)
+    if base:
+        bv = block._find_var_recursive(base)
+        bshape = getattr(bv, "shape", None) if bv is not None else None
+        if bshape and all(isinstance(s, int) and s > 0 for s in bshape):
+            return (int(np.prod(bshape)),
+                    str(getattr(bv, "dtype", None) or "float32"))
+        arr = _lookup_value(store, base)
+        if arr is not None:
+            return int(getattr(arr, "size", 0)), str(arr.dtype)
+    return None, dtype
+
+
+def maybe_rewrite_collectives(program, scope, nranks: int, data_axes,
+                              build_strategy=None, multiproc=False) -> None:
+    """Engine entry point: apply the sharded-update pass (opt-in), then
+    bucket whatever per-grad allreduces remain. Both passes are
+    idempotent per program (same contract as insert_allreduce_ops);
+    the knobs are read at the program's FIRST mesh run and baked in."""
+    if nranks <= 1 or not data_axes:
+        return
+    quant = quant_mode()
+    if (sharded_update_enabled(build_strategy) and len(data_axes) == 1
+            and not multiproc):
+        apply_sharded_weight_update(program, scope, nranks,
+                                    axis=data_axes[0], quant=quant)
+    resync_sharded_state(program, scope)
+    mb = bucket_mb(build_strategy)
+    if mb > 0:
+        bucket_allreduce_ops(program, bucket_bytes=int(mb * (1 << 20)),
+                             quant=quant, scope=scope)
+    elif quant != "none":
+        # quantization without bucketing: rewrite per-grad allreduces
+        # into single-member bucket ops so the payload still compresses
+        bucket_allreduce_ops(program, bucket_bytes=0, quant=quant,
+                             scope=scope)
+
+
+# -- bucketed allreduce -----------------------------------------------------
+
+
+def _pergrad_allreduce_indices(ops) -> List[int]:
+    out = []
+    for i, op in enumerate(ops):
+        if op.type != "c_allreduce_sum":
+            continue
+        x, o = op.input("X"), op.output("Out")
+        if len(x) == 1 and x == o:
+            out.append(i)
+    return out
+
+
+def plan_buckets(items, bucket_bytes: int):
+    """Greedy size-capped bucketing in availability order.
+
+    ``items``: [(anchor, first_consumer, key, nbytes, idx)] sorted by
+    anchor (the last op index that touches the grad before its
+    allreduce — i.e. when the grad becomes available). A bucket closes
+    when adding a member would blow the byte cap, change the (ring,
+    dtype) key, or push the bucket's insertion point (max anchor + 1)
+    past any member's first consumer. Returns a list of buckets, each
+    {"members": [idx...], "anchor": int, "key": key}."""
+    buckets: List[Dict] = []
+    open_by_key: Dict = {}
+    for anchor, first_use, key, nbytes, idx in sorted(items):
+        b = open_by_key.get(key)
+        if b is not None:
+            new_anchor = max(b["anchor"], anchor)
+            fits = (bucket_bytes > 0
+                    and b["bytes"] + nbytes <= bucket_bytes)
+            ordered = (new_anchor + 1 <= min(b["min_use"], first_use))
+            if not (fits and ordered):
+                b = None
+        if b is None:
+            b = {"members": [], "bytes": 0, "anchor": -1,
+                 "min_use": first_use, "key": key}
+            buckets.append(b)
+            open_by_key[key] = b
+        b["members"].append(idx)
+        b["bytes"] += nbytes
+        b["anchor"] = max(b["anchor"], anchor)
+        b["min_use"] = min(b["min_use"], first_use)
+    return buckets
+
+
+def bucket_allreduce_ops(program, bucket_bytes: int = 4 << 20,
+                         quant: str = "none", scope=None) -> int:
+    """Coalesce per-grad ``c_allreduce_sum`` ops into
+    ``c_bucket_allreduce`` ops (one flat psum per bucket), hoisted to
+    each bucket's availability point. Returns the number of bucket ops
+    emitted (0 = nothing to do). ``bucket_bytes <= 0`` means "one
+    bucket per grad" — used to apply quantization without coalescing."""
+    if getattr(program, "_allreduce_bucketed", False):
+        return 0
+    program._allreduce_bucketed = True
+    from .. import framework
+
+    block = program.global_block()
+    ops = block.ops
+    cand = _pergrad_allreduce_indices(ops)
+    if not cand or (len(cand) <= 1 and quant == "none"):
+        return 0
+
+    # one pass over the program: per-var sorted op-index lists, so each
+    # candidate's anchor (last non-candidate toucher before it) and
+    # first consumer resolve by bisection instead of an O(ops) rescan
+    # per grad
+    import bisect
+
+    cand_set = set(cand)
+    touched_at: Dict[str, List[int]] = {}
+    consumed_at: Dict[str, List[int]] = {}
+    for j, op in enumerate(ops):
+        ins = op.input_arg_names
+        for nm in ins:
+            consumed_at.setdefault(nm, []).append(j)
+        if j not in cand_set:
+            for nm in set(ins) | set(op.output_arg_names):
+                touched_at.setdefault(nm, []).append(j)
+
+    items = []
+    for i in cand:
+        g = ops[i].input("X")[0]
+        t = touched_at.get(g, ())
+        k = bisect.bisect_left(t, i)
+        last = t[k - 1] if k else -1
+        c = consumed_at.get(g, ())
+        k = bisect.bisect_right(c, i)
+        use = c[k] if k < len(c) else len(ops)
+        n, dtype = _numel_and_dtype(block, scope, g)
+        if n is None:
+            continue  # unknown payload: leave its per-grad op alone
+        try:
+            itemsize = np.dtype(dtype).itemsize if dtype else 4
+        except TypeError:  # same tolerance as engine._var_nbytes
+            itemsize = 4
+        items.append((last, use, (ops[i].attrs.get("ring_id", 0), dtype),
+                      n * itemsize, i))
+    if not items:
+        return 0
+
+    buckets = plan_buckets(items, bucket_bytes)
+    removed = set()
+    # bucket ops to splice in right AFTER the op at index `anchor`
+    # (anchor -1 = before everything)
+    after: Dict[int, List] = {}
+    for b in buckets:
+        names = [ops[i].input("X")[0] for i in b["members"]]
+        rid = b["key"][0]
+        ar = framework.Operator(
+            block, "c_bucket_allreduce", {"X": names}, {"Out": names},
+            {"ring_id": rid, "quant": quant, "use_calc_stream": True})
+        ar._id = program._next_op_id()
+        removed.update(b["members"])
+        after.setdefault(b["anchor"], []).append(ar)
+
+    new_ops = list(after.get(-1, []))
+    for i, op in enumerate(ops):
+        if i not in removed:
+            new_ops.append(op)
+        new_ops.extend(after.get(i, ()))
+    block.ops = new_ops
+    _bump_version(program)
+    return len(buckets)
+
+
+# -- cross-replica sharded weight update ------------------------------------
+
+
+def _attrs_sig(attrs) -> Tuple:
+    return tuple(sorted((k, repr(v)) for k, v in attrs.items()
+                        if not k.startswith("_")))
+
+
+def _splice_flat_state(block, scope, state_names, total, padded, dtype,
+                       slot):
+    """Concatenate the per-param accumulators named in ``state_names``
+    (zeros where uninitialized) into one zero-padded flat array."""
+    parts = []
+    for sn in state_names:
+        var = scope.find_var(sn)
+        if var is not None and var.is_initialized():
+            parts.append(np.asarray(var.raw().array).ravel())
+        else:
+            sv = block.var(sn)
+            parts.append(np.zeros(int(np.prod(sv.shape)),
+                                  dtype=np.dtype(dtype)))
+    flat = np.concatenate(parts) if parts else np.zeros(0, np.dtype(dtype))
+    if flat.size != total:
+        raise ValueError(
+            "sharded update: state %r totals %d elements, "
+            "params total %d" % (slot, flat.size, total))
+    return np.concatenate([flat, np.zeros(padded - total, flat.dtype)])
+
+
+def _src_token(scope, name):
+    """The var's current scope value OBJECT (None when absent or
+    uninitialized): training never touches the retired per-param
+    state vars, so a different object means something outside the
+    mesh step — a startup re-run — re-initialized the var. The token
+    holds the array itself (not its id), keeping it alive so a later
+    allocation can never alias a freed array's address."""
+    var = scope.find_var(name)
+    if var is None or not var.is_initialized():
+        return None
+    return var.raw().array
+
+
+def resync_sharded_state(program, scope) -> int:
+    """Re-running the STARTUP program resets the retired per-param
+    optimizer state vars but cannot see the flat ``sharded_update_*``
+    vars it never knew about — a restarted job would silently keep its
+    trained moments. Detect the restart (EVERY source var's array
+    object replaced since the splice; a partial change is left alone —
+    per-param values are stale by design after training) and rebuild
+    the flat state from the freshly-initialized per-param values.
+    Returns the number of flat vars rebuilt."""
+    layout = getattr(program, "_sharded_flat_layout", None)
+    if not layout:
+        return 0
+    tokens = program._sharded_src_tokens
+    block = program.global_block()
+    n = 0
+    for flat_name, (srcs, total, padded, dtype, slot) in layout.items():
+        cur = tuple(_src_token(scope, sn) for sn in srcs)
+        old = tokens[flat_name]
+        # vars uninitialized both then and now carry no signal either
+        # way; every var WITH a signal must have been replaced
+        signal = [(o, c) for o, c in zip(old, cur)
+                  if o is not None or c is not None]
+        if not signal or any(o is c for o, c in signal):
+            continue
+        scope.var(flat_name).get_tensor()._array = _splice_flat_state(
+            block, scope, srcs, total, padded, dtype, slot)
+        tokens[flat_name] = cur
+        n += 1
+    return n
+
+
+def apply_sharded_weight_update(program, scope, nranks: int,
+                                axis: str = "dp",
+                                quant: str = "none") -> int:
+    """Rewrite each (supported) optimizer instance's per-param
+    (c_allreduce_sum, update-op) pairs into ONE ``c_sharded_update``
+    op, and re-layout its optimizer state into flat vars sharded over
+    ``axis`` (spec recorded in ``program._var_shard_specs``; existing
+    scope values are spliced in flattened + zero-padded to a multiple
+    of ``nranks``). Returns the number of groups rewritten.
+
+    Grouping key: (op type, hyperparam attrs, LearningRate var, param
+    dtype) — i.e. one group per optimizer instance per dtype. Params
+    that are mesh-sharded (``_var_shard_specs``), use non-elementwise
+    optimizers, or whose reduced grad has readers besides the update
+    op keep their per-param path untouched.
+    """
+    prev = getattr(program, "_sharded_update_n", None)
+    if prev is not None:
+        if prev != nranks:
+            raise ValueError(
+                "program already sharded-update-rewritten for %d ranks, "
+                "mesh now has %d" % (prev, nranks))
+        return 0
+    program._sharded_update_n = nranks
+    from .. import framework
+
+    block = program.global_block()
+    ops = block.ops
+    shard_specs = getattr(program, "_var_shard_specs", None) or {}
+    cand = set(_pergrad_allreduce_indices(ops))
+    grad_ar: Dict[str, int] = {ops[i].input("X")[0]: i for i in cand}
+    consumed_at: Dict[str, List[int]] = {}
+    for j, op in enumerate(ops):
+        for nm in op.input_arg_names:
+            consumed_at.setdefault(nm, []).append(j)
+    groups: Dict[Tuple, List[int]] = {}
+    for i, op in enumerate(ops):
+        if op.type not in _SHARDABLE_OPTIMIZERS:
+            continue
+        p = op.input("Param")[0]
+        pv = block._find_var_recursive(p)
+        if (p in shard_specs or pv is None or not pv.shape
+                or not all(isinstance(s, int) and s > 0 for s in pv.shape)
+                or getattr(pv, "type", "lod_tensor") != "lod_tensor"):
+            continue
+        g = op.input("Grad")[0]
+        gv = block._find_var_recursive(g)
+        if gv is not None and getattr(gv, "type", "") == "selected_rows":
+            continue  # sparse grads keep the row-wise per-param kernel
+        ai = grad_ar.get(g)
+        if ai is not None and any(j > ai and j != i
+                                  for j in consumed_at.get(g, ())):
+            # some other op reads the REDUCED grad after its allreduce
+            # (grad clipping, a fetch op, ...); collapsing this pair
+            # would delete the in-place reduction that reader relies
+            # on — keep the param on the per-grad path
+            continue
+        key = (op.type, _attrs_sig(op.attrs),
+               op.input("LearningRate")[0], str(pv.dtype))
+        groups.setdefault(key, []).append(i)
+
+    if not groups:
+        return 0
+    removed = set()
+    # new group op spliced in at the position of the group's FIRST
+    # optimizer op
+    replace_at: Dict[int, object] = {}
+    n_groups = 0
+    for key, idxs in sorted(groups.items(), key=lambda kv: kv[1][0]):
+        op_type, _, lr_name, dtype = key
+        member_ops = [ops[i] for i in idxs]
+        params = [op.input("Param")[0] for op in member_ops]
+        grads = [op.input("Grad")[0] for op in member_ops]
+        sizes = [int(np.prod(block.var(p).shape)) for p in params]
+        total = sum(sizes)
+        shard = -(-total // nranks)
+        padded = shard * nranks
+        n_groups += 1
+        # content-derived name: scope vars are process-global, and a
+        # per-program group counter would collide when two programs
+        # with sharded updates share one Scope (e.g. a GAN's two
+        # optimizers) — the digest of (op type, member params) keeps
+        # distinct groups distinct and is stable across rebuilds
+        sig = hashlib.sha1(("%s|%s" % (op_type, ",".join(
+            "%s:%d" % t for t in zip(params, sizes)))).encode())
+        gtag = sig.hexdigest()[:8]
+
+        inputs = {"Param": params, "Grad": grads, "LearningRate": [lr_name]}
+        outputs = {"ParamOut": params}
+        for slot_key, slot in zip(("StateA", "StateB"),
+                                  SHARDED_UPDATE_SLOTS[op_type]):
+            state_names = [op.input(slot)[0] for op in member_ops]
+            flat_name = "sharded_update_%s.%s" % (gtag, slot.lower())
+            fv = block.create_var(name=flat_name, shape=(padded,),
+                                  dtype=dtype, persistable=True)
+            fv.stop_gradient = True
+            # splice current accumulator values into the flat var,
+            # zero-padded; retire the per-param vars (stale from here,
+            # but remembered so resync_sharded_state can rebuild the
+            # flat state when a startup re-run re-initializes them)
+            flat = _splice_flat_state(block, scope, state_names,
+                                      total, padded, dtype, slot)
+            for sn in state_names:
+                block.var(sn).persistable = False
+            scope.var(flat_name).get_tensor()._array = flat
+            for attr in ("_sharded_flat_layout", "_sharded_src_tokens"):
+                if getattr(program, attr, None) is None:
+                    setattr(program, attr, {})
+            program._sharded_flat_layout[flat_name] = (
+                tuple(state_names), total, padded, dtype, slot)
+            program._sharded_src_tokens[flat_name] = tuple(
+                _src_token(scope, sn) for sn in state_names)
+            inputs[slot_key] = [flat_name]
+            outputs[slot_key + "Out"] = [flat_name]
+            specs = getattr(program, "_var_shard_specs", None)
+            if specs is None:
+                specs = {}
+                program._var_shard_specs = specs
+            specs[flat_name] = (axis,)
+        for scalar in ("Beta1Pow", "Beta2Pow"):
+            names = [op.input(scalar) for op in member_ops]
+            if all(n for n in names):
+                inputs[scalar] = [n[0] for n in names]
+                outputs[scalar + "Out"] = [n[0] for n in names]
+
+        attrs = dict(member_ops[0].attrs)
+        attrs.update({"op_type": op_type, "shard_axis": axis,
+                      "nranks": int(nranks), "padded_size": int(padded),
+                      "quant": quant})
+        su = framework.Operator(block, "c_sharded_update", inputs,
+                                outputs, attrs)
+        su._id = program._next_op_id()
+        replace_at[idxs[0]] = su
+        removed.update(idxs)
+        removed.update(grad_ar[g] for g in grads if g in grad_ar)
+
+    new_ops = []
+    for i, op in enumerate(ops):
+        if i in replace_at:
+            new_ops.append(replace_at[i])
+        if i not in removed:
+            new_ops.append(op)
+    block.ops = new_ops
+    _merge_data_axes(program, (axis,))
+    _bump_version(program)
+    return n_groups

@@ -36,46 +36,103 @@ from .transpiler import insert_allreduce_ops
 _dp_cache: Dict = {}
 
 
-def _estimate_collective_bytes(program, state: Dict) -> Tuple[int, int]:
-    """(collective op count, bytes moved per step) over the transpiled
+def _var_nbytes(block, state: Dict, name: str) -> Tuple[int, int]:
+    """(bytes, itemsize) of a var via the shared size resolver in
+    parallel.collectives (block shape, else live value, else the
+    replicated param a grad mirrors); unknown shapes count as 0 bytes
+    rather than guessing."""
+    from .collectives import _numel_and_dtype
+
+    n, dtype = _numel_and_dtype(block, state, name)
+    try:
+        item = np.dtype(dtype or "float32").itemsize
+    except TypeError:
+        item = 4
+    return (0 if n is None else n * item), item
+
+
+# collective op type -> traffic kind label; substring match for the
+# c_allreduce_{sum,max,...} family
+_COLLECTIVE_KINDS = (
+    ("bucket_allreduce", "allreduce"), ("sharded_update", None),
+    ("allreduce", "allreduce"), ("allgather", "allgather"),
+    ("reducescatter", "reducescatter"), ("broadcast", "broadcast"),
+)
+
+
+def _quant_wire_itemsize(attrs, exact_itemsize: int,
+                         native: bool = False) -> int:
+    """Per-element payload width of a (possibly quantized) collective:
+    by default what the emulated lowering actually moves (int8 codes
+    psum in int32 — see QUANT_PSUM_ITEMSIZE); ``native=True`` gives
+    the width a native quantized collective would move instead."""
+    from ..ops.collective_ops import (QUANT_PSUM_ITEMSIZE,
+                                      QUANT_WIRE_ITEMSIZE)
+
+    table = QUANT_WIRE_ITEMSIZE if native else QUANT_PSUM_ITEMSIZE
+    wire = table.get(attrs.get("quant", "none"))
+    return exact_itemsize if wire is None else wire
+
+
+def _estimate_collective_bytes(program, state: Dict,
+                               native_wire: bool = False) -> Dict:
+    """Per-kind collective traffic estimate over the transpiled
     program's c_* collectives — the EQuARX-style comms counter a
-    collective-compression PR needs as its before/after. Shapes come
-    from block vars when recorded, else from the replicated param a
-    grad collective mirrors (same shape); unknown shapes count as 0
-    bytes rather than guessing."""
+    collective-compression PR needs as its before/after.
+
+    Returns ``{"ops": {kind: n}, "bytes": {kind: wire_bytes},
+    "ops_total": N, "bytes_total": B, "bytes_exact": E}`` where *wire*
+    bytes are what the EXECUTED program moves (bf16 payloads count 2
+    bytes/element, but int8 codes psum in int32 so they count 4) and
+    *exact* bytes are the same traffic uncompressed. With
+    ``native_wire=True`` quantized payloads are charged at the width a
+    native quantized collective would move (int8 = 1 byte/element) —
+    ``E - B`` under that mode is the PROJECTED bytes-saved figure the
+    multichip bench records."""
     block = program.global_block()
-    count = 0
-    total = 0
+    ops_by_kind: Dict[str, int] = {}
+    bytes_by_kind: Dict[str, int] = {}
+    exact_total = 0
+
+    def _add(kind, n_ops, wire_bytes, exact_bytes):
+        nonlocal exact_total
+        ops_by_kind[kind] = ops_by_kind.get(kind, 0) + n_ops
+        bytes_by_kind[kind] = bytes_by_kind.get(kind, 0) + wire_bytes
+        exact_total += exact_bytes
+
     for op in block.ops:
         if not op.type.startswith("c_"):
             continue
-        if not any(k in op.type for k in ("allreduce", "allgather",
-                                          "reducescatter", "broadcast")):
+        kind = next((k for sub, k in _COLLECTIVE_KINDS if sub in op.type),
+                    "skip")
+        if kind == "skip":
             continue
-        count += 1
-        for name in op.input_arg_names:
-            if not name:
-                continue
-            nbytes = 0
-            v = block._find_var_recursive(name)
-            shape = getattr(v, "shape", None) if v is not None else None
-            if shape and all(isinstance(s, int) and s > 0 for s in shape):
-                try:
-                    item = np.dtype(getattr(v, "dtype", "float32")
-                                    or "float32").itemsize
-                except TypeError:
-                    item = 4
-                nbytes = int(np.prod(shape)) * item
-            else:
-                from ..core.lod_lowering import _grad_base
-
-                base = _grad_base(name)
-                arr = state.get(base) if base else None
-                if arr is not None:
-                    nbytes = int(getattr(arr, "size", 0)) * \
-                        np.dtype(arr.dtype).itemsize
-            total += nbytes
-    return count, total
+        if op.type == "c_sharded_update":
+            # one flat (optionally quantized) grad psum + one allgather
+            # of updated param shards, both over the padded flat size
+            padded = int(op.attrs.get("padded_size", 0))
+            pname = op.input("Param")[0] if op.input("Param") else None
+            _, item = _var_nbytes(block, state, pname) if pname else (0, 4)
+            wire_item = _quant_wire_itemsize(op.attrs, item, native_wire)
+            _add("allreduce", 1, padded * wire_item, padded * item)
+            _add("allgather", 1, padded * item, padded * item)
+            continue
+        exact = sum(_var_nbytes(block, state, n)[0]
+                    for n in op.input_arg_names if n)
+        if op.type == "c_bucket_allreduce":
+            item = 4
+            for n in op.input_arg_names:
+                if n:
+                    item = _var_nbytes(block, state, n)[1]
+                    break
+            wire_item = _quant_wire_itemsize(op.attrs, item, native_wire)
+            _add(kind, 1, int(exact * wire_item / item), exact)
+        else:
+            _add(kind, 1, exact, exact)
+    return {"ops": ops_by_kind, "bytes": bytes_by_kind,
+            "ops_total": sum(ops_by_kind.values()),
+            "bytes_total": sum(bytes_by_kind.values()),
+            "bytes_exact": exact_total}
 
 
 def _mesh_spans_processes(mesh) -> bool:
@@ -162,6 +219,17 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
         from .transpiler import mark_sync_batch_norm
 
         mark_sync_batch_norm(program, sync_bn)
+        # fast collective path (bucketed / quantized allreduce, sharded
+        # weight update) — rewrites per-grad collectives in place; may
+        # add flat optimizer-state vars sharded over the data axis, so
+        # the shard-spec snapshot is refreshed below
+        from .collectives import maybe_rewrite_collectives
+
+        maybe_rewrite_collectives(program, scope, data_nranks, data_axes,
+                                  build_strategy=build_strategy,
+                                  multiproc=multiproc)
+        shard_specs = dict(getattr(program, "_var_shard_specs", None)
+                           or {})
 
     if not data_axes:
         ring_val = None  # collectives become identity (nranks_data = 1)
@@ -214,7 +282,7 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
     hit = _dp_cache.get(key)
     if hit is None:
         _obs.inc("parallel.compiles")
-        coll_ops, coll_bytes = _estimate_collective_bytes(program, state)
+        coll_est = _estimate_collective_bytes(program, state)
         def shard_step(state_d, feeds_d, seed):
             with ring_axis_guard({0: ring_val, -1: ring_val}), \
                     mesh_axes_guard(mesh_axes):
@@ -240,9 +308,9 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
                         for n in out_state_names}),
         )
         fn = jax.jit(mapped, donate_argnums=(0,))
-        hit = (fn, coll_ops, coll_bytes)
+        hit = (fn, coll_est)
         _dp_cache[key] = hit
-    fn, coll_ops, coll_bytes = hit
+    fn, coll_est = hit
 
     import time as _time
 
@@ -258,8 +326,15 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
         _obs.inc("parallel.steps")
         _obs.observe("parallel.step_ms",
                      (_time.perf_counter() - t_step) * 1e3)
-        _obs.inc("parallel.collective_ops", coll_ops)
-        _obs.inc("parallel.collective_bytes", coll_bytes)
+        _obs.inc("parallel.collective_ops", coll_est["ops_total"])
+        _obs.inc("parallel.collective_bytes", coll_est["bytes_total"])
+        for k, n in coll_est["ops"].items():
+            _obs.inc("parallel.collective_ops", n, kind=k)
+        for k, b in coll_est["bytes"].items():
+            _obs.inc("parallel.collective_bytes", b, kind=k)
+        saved = coll_est["bytes_exact"] - coll_est["bytes_total"]
+        if saved > 0:
+            _obs.inc("parallel.collective_bytes_saved", saved)
 
     def _local(v):
         """A locally-readable copy of a (replicated) result: under a

@@ -290,6 +290,25 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
         _obs.inc("pipeline.steps")
         _obs.observe("pipeline.step_ms",
                      (_time.perf_counter() - t_step) * 1e3)
+        # collective-traffic estimate, same counter family as the dp
+        # engine (engine._estimate_collective_bytes): per step the
+        # pipeline psums the loss + every param grad over pp (x dp),
+        # and each of the 2*(M+S-1) fwd/bwd ticks rotates the
+        # max-padded boundary buffer via ppermute
+        grad_bytes = sum(
+            int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+            for v in params.values())
+        ticks = 2 * (n_micro + n_stages - 1)
+        _obs.inc("parallel.collective_ops", len(params) + 1 + ticks)
+        _obs.inc("parallel.collective_ops", len(params) + 1,
+                 kind="allreduce")
+        _obs.inc("parallel.collective_ops", ticks, kind="ppermute")
+        _obs.inc("parallel.collective_bytes",
+                 grad_bytes + ticks * buffer_bytes)
+        _obs.inc("parallel.collective_bytes", grad_bytes,
+                 kind="allreduce")
+        _obs.inc("parallel.collective_bytes", ticks * buffer_bytes,
+                 kind="ppermute")
 
     for n, v in new_persist.items():
         scope.var(n).get_tensor()._array = v

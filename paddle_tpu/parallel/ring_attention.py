@@ -35,9 +35,9 @@ NEG_INF = -1e30
 def _axis_size(axis_name: str, axis_size: Optional[int]):
     if axis_size is not None:
         return int(axis_size)
-    from jax import lax
+    from ..ops.collective_ops import static_axis_size
 
-    return lax.axis_size(axis_name)
+    return static_axis_size(axis_name)
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Mechanical perf gate: diff two bench / multichip / metrics JSON files.
+
+Compares per-workload numbers between a BASE and a HEAD run and exits
+nonzero when any watched higher-is-better metric regresses by more than
+the threshold (or a lower-is-better one grows by more than it). This is
+the regression gate the ROADMAP observability item asks for: CI diffs
+the merged counters instead of a human eyeballing two JSON blobs.
+
+Understands all three record shapes this repo emits:
+
+- ``bench.py`` output           (``{"extras": {workload: {...}}}``)
+- ``bench.py --multichip``      (``{"configs": {config: {...}}}``)
+- merged job ``metrics.json``   (``{"counters_total": {counter: value}}``
+                                from observability.distributed.merge_job_dir)
+
+Single- and multi-chip records diff under one schema: every record
+carries ``step_ms`` and a throughput field, and single-chip diags
+carry an explicit ``collective_bytes: 0``.
+
+Usage:
+  tools/bench_diff.py BASE.json HEAD.json [--threshold 0.10]
+      [--counters-threshold 0.25]
+
+Exit codes: 0 = within threshold, 1 = regression past threshold,
+2 = usage/load error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# per-workload metrics worth gating; direction: +1 higher is better,
+# -1 lower is better
+WATCHED = (
+    ("images_per_sec", +1), ("tokens_per_sec", +1),
+    ("examples_per_sec", +1), ("steps_per_sec", +1),
+    ("tokens_or_images_per_sec", +1),
+    ("step_ms", -1), ("collective_bytes", -1),
+)
+
+# counter totals (metrics.json) where growth is a regression
+COUNTER_WATCH_GROWS_BAD = ("parallel.collective_bytes",
+                           "parallel.collective_ops",
+                           "executor.compile_fallbacks")
+
+
+def load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    # the bench driver wraps bench.py's JSON line as {"parsed": {...}}
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc
+
+
+def workloads(doc):
+    """{workload: record} from any of the three supported shapes."""
+    if "configs" in doc and isinstance(doc["configs"], dict):
+        return dict(doc["configs"])  # multichip bench
+    if "extras" in doc and isinstance(doc["extras"], dict):
+        return {k: v for k, v in doc["extras"].items()
+                if isinstance(v, dict) and not k.endswith("_error")}
+    return {}
+
+
+def counter_totals(doc):
+    # merged job metrics.json (merge_job_dir) names the key
+    # counters_total; accept the plain spelling too
+    for key in ("counters_total", "totals"):
+        if isinstance(doc.get(key), dict):
+            return doc[key]
+    if isinstance(doc.get("metrics_totals"), dict):
+        return doc["metrics_totals"]  # multichip bench embeds them
+    return {}
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return "%.4g" % v
+    return str(v)
+
+
+def diff_records(base, head, threshold):
+    """Yield (workload, metric, base, head, rel_delta, regressed)."""
+    b_wl, h_wl = workloads(base), workloads(head)
+    for name in sorted(set(b_wl) & set(h_wl)):
+        b, h = b_wl[name], h_wl[name]
+        for metric, direction in WATCHED:
+            bv, hv = _lookup(b, metric), _lookup(h, metric)
+            if bv is None or hv is None:
+                continue
+            if not bv:
+                # growth from a zero base has no relative delta: show
+                # the row (rel=inf) but don't hard-fail — a single-chip
+                # BASE vs multichip HEAD legitimately goes 0 -> N
+                # collective bytes, and the watched counter totals
+                # below still gate structural from-zero growth
+                if not hv:
+                    continue
+                yield name, metric, bv, hv, float("inf"), False
+                continue
+            rel = (hv - bv) / abs(bv)
+            regressed = (-direction * rel) > threshold
+            yield name, metric, bv, hv, rel, regressed
+
+
+def _lookup(rec, metric):
+    """A metric straight off the record, or from its diag (single-chip
+    collective_bytes lives there)."""
+    v = rec.get(metric)
+    if v is None and isinstance(rec.get("diag"), dict):
+        v = rec["diag"].get(metric)
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    return None
+
+
+def diff_counters(base, head, threshold):
+    b_t, h_t = counter_totals(base), counter_totals(head)
+    for key in sorted(set(b_t) & set(h_t)):
+        bv, hv = b_t[key], h_t[key]
+        if not isinstance(bv, (int, float)):
+            continue
+        # exact key or its labeled series ("...{kind=...}") — a bare
+        # prefix test would also catch parallel.collective_bytes_saved,
+        # whose growth is an improvement
+        grows_bad = any(key == w or key.startswith(w + "{")
+                        for w in COUNTER_WATCH_GROWS_BAD)
+        if not bv:
+            if not hv:
+                continue
+            # zero -> nonzero growth of a watched counter is always a
+            # regression (e.g. the first compile fallback appearing)
+            yield key, bv, hv, float("inf"), grows_bad
+            continue
+        rel = (hv - bv) / abs(bv)
+        yield key, bv, hv, rel, grows_bad and rel > threshold
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="Exit codes: 0 ok, 1 regression, 2 load error.")
+    ap.add_argument("base", nargs="?", help="BASE json (bench / "
+                    "multichip / merged metrics.json)")
+    ap.add_argument("head", nargs="?",
+                    help="HEAD json to compare against BASE")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="max relative regression per workload metric "
+                         "(default 0.10 = 10%%)")
+    ap.add_argument("--counters-threshold", type=float, default=0.25,
+                    help="max relative growth for watched counter "
+                         "totals (default 0.25)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the built-in self test and exit")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        return _self_test()
+    if not args.base or not args.head:
+        ap.error("base and head are required (unless --self-test)")
+
+    try:
+        base, head = load(args.base), load(args.head)
+    except (OSError, ValueError) as e:
+        print("bench_diff: cannot load inputs: %s" % e, file=sys.stderr)
+        return 2
+
+    regressions = 0
+    rows = list(diff_records(base, head, args.threshold))
+    for name, metric, bv, hv, rel, bad in rows:
+        mark = " REGRESSION" if bad else ""
+        print("%-24s %-26s %12s -> %-12s %+7.2f%%%s"
+              % (name, metric, _fmt(bv), _fmt(hv), rel * 100, mark))
+        regressions += bad
+    crows = list(diff_counters(base, head, args.counters_threshold))
+    for key, bv, hv, rel, bad in crows:
+        mark = " REGRESSION" if bad else ""
+        print("%-51s %12s -> %-12s %+7.2f%%%s"
+              % (key, _fmt(bv), _fmt(hv), rel * 100, mark))
+        regressions += bad
+    if not rows and not crows:
+        print("bench_diff: no common workloads or counters between "
+              "inputs", file=sys.stderr)
+        return 2
+    if regressions:
+        print("bench_diff: %d metric(s) regressed past threshold"
+              % regressions, file=sys.stderr)
+        return 1
+    print("bench_diff: ok (%d metrics compared)"
+          % (len(rows) + len(crows)))
+    return 0
+
+
+def _self_test():
+    """In-process sanity: detects a planted regression, passes a clean
+    diff, and diffs a single-chip record against a multichip one."""
+    single = {"extras": {"w": {"tokens_per_sec": 100.0, "step_ms": 10.0,
+                               "diag": {"collective_bytes": 0}}}}
+    multi = {"configs": {"w": {"tokens_per_sec": 100.0, "step_ms": 10.0,
+                               "collective_bytes": 0}}}
+    ok = list(diff_records(single, multi, 0.10))
+    assert ok and not any(r[-1] for r in ok), ok
+    # single-chip base (0 collective bytes) vs a multichip head: the
+    # 0 -> N growth row shows but must not hard-fail the diff
+    went_multi = {"configs": {"w": {"tokens_per_sec": 100.0,
+                                    "step_ms": 10.0,
+                                    "collective_bytes": 4096}}}
+    rows = list(diff_records(single, went_multi, 0.10))
+    zrow = [r for r in rows if r[1] == "collective_bytes"]
+    assert zrow and not zrow[0][-1], rows
+    slow = {"configs": {"w": {"tokens_per_sec": 50.0, "step_ms": 20.0,
+                              "collective_bytes": 4096}}}
+    bad = list(diff_records(single, slow, 0.10))
+    assert any(r[-1] for r in bad), bad
+    m0 = {"totals": {"parallel.collective_bytes": 1000,
+                     "parallel.steps": 2}}
+    m1 = {"totals": {"parallel.collective_bytes": 2000,
+                     "parallel.steps": 2}}
+    cbad = list(diff_counters(m0, m1, 0.25))
+    assert any(r[-1] for r in cbad), cbad
+    assert not any(r[-1] for r in diff_counters(m0, m0, 0.25))
+    # growth from a ZERO base must still flag (no relative delta exists)
+    z0 = {"totals": {"executor.compile_fallbacks": 0}}
+    z1 = {"totals": {"executor.compile_fallbacks": 5}}
+    zbad = list(diff_counters(z0, z1, 0.25))
+    assert zbad and zbad[0][-1], zbad
+    assert not list(diff_counters(z0, z0, 0.25))
+    print("bench_diff self-test ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

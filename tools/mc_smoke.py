@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""CI multichip smoke (gate 7): prove the fast collective path on a
+dp=8 CPU host mesh in under a minute.
+
+Runs the mlp multichip config twice in fresh processes — once on the
+fast path (bucketed allreduce + sharded weight update, the defaults
+``bench.py --mc-config`` applies) and once forced onto the per-grad
+baseline (``PADDLE_TPU_BUCKET_MB=0``, ``PADDLE_TPU_SHARDED_UPDATE=0``)
+— and asserts:
+
+  a. bucketing/sharding STRICTLY reduces per-step
+     ``parallel.collective_ops`` vs the per-grad run, and the fast
+     run's recorded per-grad-baseline figure agrees with the baseline
+     run's counters (both come from the same static program estimator
+     — this pins the two call sites to each other, it is not an
+     independent traffic measurement);
+  b. both runs converge to the same finite loss trajectory class
+     (loss finite; the bit-for-bit claim is gate-kept by
+     tests/test_collectives.py's parity tests, run here via pytest);
+  c. ``tools/bench_diff.py`` answers ``--help`` and passes its
+     built-in ``--self-test``.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# private compile-cache dir: hermetic (a cache entry another process
+# corrupted mid-write must not fail — or pass — this gate)
+_CACHE = tempfile.mkdtemp(prefix="mc_smoke_cache_")
+
+
+def _run_config(extra_env):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "").strip()
+                      + " --xla_force_host_platform_device_count=8").strip(),
+        "PADDLE_TPU_COMPILE_CACHE": _CACHE,
+    })
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"),
+         "--mc-config=mlp", "--mc-iters=2"],
+        capture_output=True, text=True, timeout=240, env=env)
+    if proc.returncode != 0:
+        raise SystemExit("mc_smoke: mlp config failed (%s): %s"
+                         % (extra_env, proc.stderr[-2000:]))
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main():
+    t0 = time.time()
+    fast = _run_config({})
+    base = _run_config({"PADDLE_TPU_BUCKET_MB": "0",
+                        "PADDLE_TPU_SHARDED_UPDATE": "0"})
+
+    f_ops = fast["collective"]["per_step"]["parallel.collective_ops"]
+    b_ops = base["collective"]["per_step"]["parallel.collective_ops"]
+    est = fast["collective"]["pergrad_baseline_ops"]
+    print("mc_smoke: fast path %d collective ops/step, per-grad "
+          "baseline %d (estimator said %d)" % (f_ops, b_ops, est))
+    assert f_ops < b_ops, (
+        "bucketed/sharded path must STRICTLY reduce collective ops: "
+        "fast=%d baseline=%d" % (f_ops, b_ops))
+    assert b_ops == est, (
+        "fast run's recorded per-grad baseline estimate (%d) disagrees "
+        "with the estimate of the actually-executed per-grad program "
+        "(%d)" % (est, b_ops))
+    for rec in (fast, base):
+        assert math.isfinite(rec["loss"]), rec["loss"]
+
+    # sharded-update parity is bit-for-bit (incl. uneven shards) —
+    # the numerics gate for the path the fast run just exercised
+    subprocess.run(
+        [sys.executable, "-m", "pytest", "-q",
+         "tests/test_collectives.py", "-k",
+         "sharded_update_bit_for_bit or uneven_shards"],
+        check=True, cwd=ROOT, timeout=240)
+
+    bd = os.path.join(ROOT, "tools", "bench_diff.py")
+    out = subprocess.run([sys.executable, bd, "--help"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0 and "--threshold" in out.stdout, out.stderr
+    subprocess.run([sys.executable, bd, "--self-test"], check=True,
+                   timeout=60)
+
+    print("mc_smoke: OK in %.1fs" % (time.time() - t0))
+
+
+if __name__ == "__main__":
+    main()
