@@ -174,6 +174,75 @@ def _time_steps(exe, main, feed, loss, warmup=3, iters=20, windows=2,
     return dt, final_loss, diag
 
 
+def _profile_phases_enabled(default: bool) -> bool:
+    """Measured phase breakdown on/off: ``PADDLE_TPU_PROFILE_BENCH``
+    overrides either way; unset keeps the caller's default (ON for
+    multichip configs — cheap CPU-mesh shapes, and the overlap number
+    is the point — OFF for single-chip runs where phase-sliced
+    re-execution means extra whole-program compiles through the
+    tunnel)."""
+    raw = os.environ.get("PADDLE_TPU_PROFILE_BENCH", "").strip().lower()
+    if not raw:
+        return default
+    return raw in ("1", "true", "yes", "on")
+
+
+def _profile_record(step_s, flops_total, by_category=None, bf16=False,
+                    n_devices=1, program=None, scope=None, feed=None,
+                    mesh=None, phases_default=False):
+    """The ``profile`` block every bench record carries — ONE schema
+    for single-chip and multichip runs: analytic FLOPs + registry-
+    derived ``mfu_est`` always; measured phase breakdown / overlap /
+    critical path when phase profiling is enabled and a static program
+    is available (``tools/bench_diff.py`` diffs these fields)."""
+    from paddle_tpu.observability import profiler as prof
+
+    rec = {
+        "flops_per_step": int(flops_total),
+        "mfu_est": prof.mfu_est(flops_total, step_s, bf16=bf16,
+                                n_devices=n_devices),
+        "peak_flops": prof.peak_flops(bf16, n_devices),
+        "n_devices": int(n_devices),
+    }
+    if by_category:
+        rec["flops_by_category"] = {k: int(v)
+                                    for k, v in by_category.items()}
+    if program is not None and _profile_phases_enabled(phases_default):
+        try:
+            rep = prof.profile_step(program, scope, feed, mesh=mesh)
+            rec.update({
+                "phase_ms": rep["phase_ms"],
+                "overlap_frac": rep["overlap_frac"],
+                "critical_path_ms": rep["critical_path_ms"],
+                "exposed_collective_ms": rep["exposed_collective_ms"],
+                "serialized_ms": rep["serialized_ms"],
+                "per_bucket": rep["per_bucket"],
+                "profiled_step_ms": rep["step_ms"],
+                "exposed_includes_fused_update":
+                    rep["exposed_includes_fused_update"],
+            })
+        except Exception as e:  # the bench number survives a broken
+            rec["phase_error"] = repr(e)  # profile, never vice versa
+    return rec
+
+
+def _program_profile(main, scope, feed, step_s, bf16=False, mesh=None,
+                     n_devices=1, phases_default=False, flops_scale=1):
+    """``flops_scale`` converts the PROGRAM's analytic FLOPs into the
+    job step's: per-replica-built multichip models (bert/gpt built at
+    batch/n, every replica runs one) scale by n_devices so mfu_est is
+    consistent with the global-throughput numbers beside it."""
+    from paddle_tpu.observability import profiler as prof
+
+    fl = prof.program_flops(main, scope)
+    return _profile_record(step_s, fl["total"] * flops_scale,
+                           {k: v * flops_scale
+                            for k, v in fl["by_category"].items()},
+                           bf16=bf16, n_devices=n_devices, program=main,
+                           scope=scope, feed=feed, mesh=mesh,
+                           phases_default=phases_default)
+
+
 def bench_resnet50(batch=128, iters=12, use_bf16=False,
                    data_format="NCHW"):
     import paddle_tpu as fluid
@@ -193,7 +262,9 @@ def bench_resnet50(batch=128, iters=12, use_bf16=False,
         raise RuntimeError("resnet50 diverged: loss=%r" % final_loss)
     return {"images_per_sec": batch / dt, "step_ms": dt * 1e3,
             "batch": batch, "loss": final_loss, "bf16": use_bf16,
-            "data_format": data_format, "diag": diag}
+            "data_format": data_format, "diag": diag,
+            "profile": _program_profile(main, fluid.global_scope(),
+                                        feed, dt, bf16=use_bf16)}
 
 
 def bench_mnist_mlp(batch=512, iters=100):
@@ -212,7 +283,9 @@ def bench_mnist_mlp(batch=512, iters=100):
         raise RuntimeError("mnist mlp diverged: loss=%r" % final_loss)
     return {"steps_per_sec": 1.0 / dt, "examples_per_sec": batch / dt,
             "step_ms": dt * 1e3, "batch": batch, "loss": final_loss,
-            "diag": diag}
+            "diag": diag,
+            "profile": _program_profile(main, fluid.global_scope(),
+                                        feed, dt)}
 
 
 def _build_bert_base(batch, seq_len, use_bf16=False):
@@ -278,7 +351,9 @@ def bench_bert_base(batch=32, seq_len=128, iters=30, use_bf16=True):
         raise RuntimeError("bert diverged: loss=%r" % final_loss)
     return {"tokens_per_sec": batch * seq_len / dt, "step_ms": dt * 1e3,
             "batch": batch, "seq_len": seq_len, "loss": final_loss,
-            "bf16": use_bf16, "diag": diag}
+            "bf16": use_bf16, "diag": diag,
+            "profile": _program_profile(main, fluid.global_scope(),
+                                        feed, dt, bf16=use_bf16)}
 
 
 def _build_transformer_wmt(batch, seq_len, use_bf16=False,
@@ -382,7 +457,9 @@ def bench_transformer_wmt(batch=64, seq_len=256, iters=10, use_bf16=True,
     return {"tokens_per_sec": tok_per_step / dt, "step_ms": dt * 1e3,
             "batch": batch, "seq_len": seq_len, "loss": final_loss,
             "loss0": l0, "bf16": use_bf16, "masked_flash": use_lengths,
-            "flash_ops": flash_ops, "diag": diag}
+            "flash_ops": flash_ops, "diag": diag,
+            "profile": _program_profile(main, fluid.global_scope(),
+                                        feed, dt, bf16=use_bf16)}
 
 
 def _build_wide_deep(batch):
@@ -421,7 +498,9 @@ def bench_wide_deep(batch=2048, iters=40):
     if not np.isfinite(final_loss):
         raise RuntimeError("wide_deep diverged: loss=%r" % final_loss)
     return {"examples_per_sec": batch / dt, "step_ms": dt * 1e3,
-            "batch": batch, "loss": final_loss, "diag": diag}
+            "batch": batch, "loss": final_loss, "diag": diag,
+            "profile": _program_profile(main, fluid.global_scope(),
+                                        feed, dt)}
 
 
 def bench_dygraph_mlp(batch=256, iters=30, lazy=False):
@@ -466,9 +545,15 @@ def bench_dygraph_mlp(batch=256, iters=30, lazy=False):
         dt = (time.time() - t0) / iters
     if not np.isfinite(final_loss):
         raise RuntimeError("dygraph mlp diverged: loss=%r" % final_loss)
+    from paddle_tpu.observability import profiler as prof
+
     return {"steps_per_sec": 1.0 / dt, "examples_per_sec": batch / dt,
             "step_ms": dt * 1e3, "batch": batch, "loss": final_loss,
-            "dispatch": "lazy" if lazy else "eager"}
+            "dispatch": "lazy" if lazy else "eager",
+            # no static program in dygraph — the analytic formula IS
+            # the registry entry for this shape
+            "profile": _profile_record(
+                dt, prof.flops_mlp(batch, (784, 256, 256, 10)))}
 
 
 def bench_dygraph_bert(batch=32, seq_len=128, iters=8, n_layers=12,
@@ -547,9 +632,14 @@ def bench_dygraph_bert(batch=32, seq_len=128, iters=8, n_layers=12,
         dt = (time.time() - t0) / iters
     if not np.isfinite(final_loss):
         raise RuntimeError("dygraph bert diverged: loss=%r" % final_loss)
+    from paddle_tpu.observability import profiler as prof
+
     return {"tokens_per_sec": batch * seq_len / dt, "step_ms": dt * 1e3,
             "batch": batch, "seq_len": seq_len, "loss": final_loss,
-            "dispatch": "lazy" if lazy else "eager"}
+            "dispatch": "lazy" if lazy else "eager",
+            "profile": _profile_record(
+                dt, prof.flops_transformer_lm(batch, seq_len, d_model,
+                                              n_layers, vocab))}
 
 
 def _enable_compile_cache():
@@ -639,7 +729,9 @@ def bench_gpt_long(batch=2, seq_len=4096, iters=6, use_bf16=True):
     return {"tokens_per_sec": batch * seq_len / dt, "step_ms": dt * 1e3,
             "batch": batch, "seq_len": seq_len, "loss": final_loss,
             "bf16": use_bf16, "attention": "pallas_flash_causal",
-            "diag": diag}
+            "diag": diag,
+            "profile": _program_profile(main, fluid.global_scope(),
+                                        feed, dt, bf16=use_bf16)}
 
 
 # -- multi-chip bench (ISSUE 6) ---------------------------------------------
@@ -880,6 +972,16 @@ def bench_multichip_config(name, iters=None, quant=None, sharded=True):
         dt, t_compile, final_loss, per_step = _mc_measure(
             exe, cp, feed, loss, iters, name)
         quant_save = _quant_saving(main, state)
+        # phase breakdown + per-bucket overlap report over the
+        # REWRITTEN program (bucketed/sharded collectives in place) —
+        # the measured answer to "do the collectives overlap backward
+        # compute". Default-on here: CPU-mesh shapes are small and the
+        # overlap number is this bench's point.
+        profile = _program_profile(main, scope, feed, dt,
+                                   mesh=mesh, n_devices=MC_DEVICES,
+                                   phases_default=True,
+                                   flops_scale=(MC_DEVICES
+                                                if per_replica else 1))
     from paddle_tpu.parallel.collectives import (bucket_mb, quant_mode,
                                                  sharded_update_enabled)
 
@@ -897,6 +999,7 @@ def bench_multichip_config(name, iters=None, quant=None, sharded=True):
             "pergrad_baseline_bytes": base_bytes,
             "quant_int8_bytes_saved": int(quant_save),
         },
+        "profile": profile,
         "knobs": {"bucket_mb": bucket_mb(), "quant": quant_mode(),
                   "sharded_update": sharded_update_enabled()},
     }
@@ -951,6 +1054,20 @@ def _mc_3d_config(iters, unit):
             loss_name=loss.name, places=mesh)
         dt, t_compile, final_loss, per_step = _mc_measure(
             exe, cp, feed, loss, iters, "dp2_pp2_mp2")
+        # FLOPs/mfu only: phase-sliced re-execution assumes the dp
+        # engine's one-shard_map step shape, which a pipeline program
+        # (scan over ticks + separate update trace) is not. The
+        # program is ONE microbatch of ONE pipeline replica; the job
+        # step runs n_micro microbatches on each of dp replicas
+        # (mp/pp shard that same work, they don't duplicate it)
+        from paddle_tpu.observability import profiler as prof
+
+        fl = prof.program_flops(main)
+        scale = dp * n_micro
+        profile = _profile_record(
+            dt, fl["total"] * scale,
+            {k: v * scale for k, v in fl["by_category"].items()},
+            n_devices=dp * pp * mp)
     return {
         "config": "dp2_pp2_mp2", "unit": unit,
         "mesh": {"dp": dp, "pp": pp, "mp": mp},
@@ -962,6 +1079,7 @@ def _mc_3d_config(iters, unit):
         "iters": iters, "warmup_s": round(t_compile, 1),
         "collective_bytes": per_step.get("parallel.collective_bytes", 0),
         "collective": {"per_step": per_step},
+        "profile": profile,
         "knobs": {},
     }
 
@@ -1001,6 +1119,11 @@ def bench_multichip(out_path=None, configs=None, quant_config="bert_base"):
     configs = configs or ["resnet50", "bert_base", "gpt_long",
                           "dp2_pp2_mp2"]
     jobdir = tempfile.mkdtemp(prefix="mc_bench_metrics_")
+    # one job trace id for every config child (the launch-supervisor
+    # contract): the merged trace.json reads as one timeline
+    from paddle_tpu.observability.distributed import JOB_TRACE_ENV
+
+    os.environ.setdefault(JOB_TRACE_ENV, os.urandom(8).hex())
     t_start = time.time()
     results, errors = {}, {}
     rank = 0
@@ -1075,10 +1198,11 @@ def _run_one(name, use_bf16):
         print(json.dumps(bench_gpt_long(use_bf16=use_bf16)))
     elif name == "resnet50":
         rn = bench_resnet50(use_bf16=use_bf16)
-        # ResNet-50 train step ~= 3x fwd FLOPs; fwd ~= 4.1 GFLOP/img @224
-        flops_per_img = 3 * 4.1e9
-        peak = 197e12 if rn["bf16"] else 98.5e12  # v5e MXU peak bf16/fp32
-        rn["mfu_est"] = rn["images_per_sec"] * flops_per_img / peak
+        # mfu from the analytic FLOP registry (profiler.program_flops
+        # over the actual program) — the hardcoded 4.1 GFLOP/img
+        # estimate this replaced lives on only as a sanity cross-check
+        # in tests/test_profiler.py
+        rn["mfu_est"] = rn["profile"]["mfu_est"]
         print(json.dumps(rn))
     else:
         raise SystemExit("unknown model %r" % name)

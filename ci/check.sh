@@ -108,7 +108,42 @@ echo "== gate 7: multichip fast-path smoke =="
 # bit-for-bit, and tools/bench_diff.py must answer --help and pass
 # its --self-test (the mechanical perf gate bench artifacts diff
 # through)
-python tools/mc_smoke.py
+MC_OUT="$(mktemp)"
+trap 'rm -f "$FP_TMP" "$MC_OUT"' EXIT
+python tools/mc_smoke.py --out "$MC_OUT"
+
+echo "== gate 7b: perf regression vs previous run =="
+# ci/baseline/ keeps the PREVIOUS run's smoke artifact on this machine
+# (gitignored: step_ms across different hosts is meaningless, so the
+# comparison is same-host run-over-run). First run seeds the baseline;
+# later runs diff automatically — the per-step collective counters are
+# deterministic (static program rewrite), so they gate at 1%; timing
+# metrics gate at a loose 50% (CI-box jitter is real; the counters are
+# the strict half). Intentional perf-profile changes:
+# PERF_BASELINE_ACCEPT=1 ci/check.sh records the new numbers as the
+# next baseline instead of failing.
+BASELINE="ci/baseline/mc_smoke.json"
+mkdir -p ci/baseline
+if [[ -f "$BASELINE" ]]; then
+    diff_rc=0
+    python tools/bench_diff.py "$BASELINE" "$MC_OUT" \
+        --threshold 0.5 --counters-threshold 0.01 || diff_rc=$?
+    if [[ "$diff_rc" == "0" ]]; then
+        echo "perf gate: no regression vs previous run"
+    elif [[ "$diff_rc" == "2" ]]; then
+        # load error (torn/corrupt baseline, schema drift) is NOT a
+        # regression — reseed rather than fail or silently "accept"
+        echo "perf gate: baseline unreadable/incomparable (rc=2) — reseeding $BASELINE"
+    elif [[ "${PERF_BASELINE_ACCEPT:-0}" == "1" ]]; then
+        echo "perf gate: regression ACCEPTED (PERF_BASELINE_ACCEPT=1) — new baseline recorded"
+    else
+        echo "perf gate: regression vs $BASELINE — intentional? re-run with PERF_BASELINE_ACCEPT=1" >&2
+        exit 1
+    fi
+else
+    echo "perf gate: no previous run on this machine — seeding $BASELINE"
+fi
+cp "$MC_OUT" "$BASELINE"
 
 if [[ "${SKIP_TESTS:-0}" != "1" ]]; then
     echo "== gate 8: test suite =="

@@ -21,6 +21,7 @@ for (SURVEY.md §7 step 3).
 """
 from __future__ import annotations
 
+import os
 from collections import OrderedDict
 from typing import Dict, List, Sequence, Set, Tuple
 
@@ -319,6 +320,26 @@ def _trace_block(block, env: Dict, step_seed) -> None:
     _trace_ops(block, block.ops, env, step_seed)
 
 
+# phase-annotation hook (observability.profiler): when installed, a
+# trace wraps each op in jax.named_scope("<phase>/<op_type>") so the
+# XPlane / Perfetto device trace shows forward/backward/collective/
+# optimizer regions. None (the default) costs exactly one branch per
+# _trace_ops call — trace-time only, never per step — and the traced
+# jaxpr is byte-identical to a pre-hook trace (the scope is never
+# entered). profiler.enable_annotation()/disable_annotation() toggle
+# it; PADDLE_TPU_PROFILE=1 arms it from the environment.
+_phase_annotator = None
+
+if os.environ.get("PADDLE_TPU_PROFILE", "").strip().lower() in (
+        "1", "true", "yes", "on"):
+    def _env_phase_annotator(block, ops):
+        from ..observability.profiler import trace_annotation
+
+        return trace_annotation(block, ops)
+
+    _phase_annotator = _env_phase_annotator
+
+
 def _trace_ops(block, ops, env: Dict, step_seed) -> None:
     """Trace a specific op sequence (a whole block, or one pipeline
     stage's slice of it) into the running jax trace.
@@ -329,26 +350,28 @@ def _trace_ops(block, ops, env: Dict, step_seed) -> None:
     data-parallel shard, pipeline stage slice) gets the same treatment.
     """
     infos = OpInfoMap.instance()
-    fold_vals = None
-    for op in ops:
+    fold_vals = [None]
+
+    def trace_one(op):
         if op.type == "while":
             _trace_while(block, op, env, step_seed)
-            continue
+            return
         if op.type == "conditional_block":
             _trace_conditional_block(block, op, env, step_seed)
-            continue
+            return
         info = infos.get(op.type)
         if info.host_fn is not None:
-            if fold_vals is None:
+            if fold_vals[0] is None:
                 import jax.numpy as jnp
 
-                fold_vals = {n: jnp.asarray(v)
-                             for n, v in _fold_block_values(block).items()}
+                fold_vals[0] = {
+                    n: jnp.asarray(v)
+                    for n, v in _fold_block_values(block).items()}
             out_names = [n for n in op.output_arg_names if n]
-            if out_names and all(n in fold_vals for n in out_names):
+            if out_names and all(n in fold_vals[0] for n in out_names):
                 for n in out_names:
-                    env[n] = fold_vals[n]
-                continue
+                    env[n] = fold_vals[0][n]
+                return
             raise NotImplementedError(
                 "host op %r cannot be traced (not const-foldable here)"
                 % op.type)
@@ -390,6 +413,21 @@ def _trace_ops(block, ops, env: Dict, step_seed) -> None:
             for n, v in zip(names, vals):
                 if n and v is not None:
                     env[n] = v
+
+    phases = (_phase_annotator(block, ops)
+              if _phase_annotator is not None else None)
+    if phases is not None:
+        import jax
+
+        for op, phase in zip(ops, phases):
+            # named_scope adds NO ops — only name-stack metadata — so
+            # the annotated jaxpr has the same equations as the plain
+            # trace, just phase-labeled for the device profile
+            with jax.named_scope("%s/%s" % (phase, op.type)):
+                trace_one(op)
+    else:
+        for op in ops:
+            trace_one(op)
 
 
 import weakref

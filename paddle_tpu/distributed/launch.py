@@ -176,6 +176,11 @@ def launch(args=None):
             _log("cleared %d stale dump(s) from %s"
                  % (removed, metrics_dir))
         _dobs.arm(metrics_dir)
+        # one job trace id, minted before the worker envs are copied
+        # from os.environ: every rank derives identical per-round span
+        # context from it (distributed.fleet_round_args), so a dp sync
+        # round is one timeline in the merged trace.json
+        os.environ.setdefault(_dobs.JOB_TRACE_ENV, os.urandom(8).hex())
     # workers must import paddle_tpu even when it runs from a source
     # checkout (script-dir sys.path[0] replaces the launcher's cwd)
     pkg_root = os.path.dirname(os.path.dirname(

@@ -83,6 +83,8 @@ from typing import Dict, Optional
 from . import flight  # noqa: F401
 from . import tracing  # noqa: F401
 from . import distributed  # noqa: F401
+from . import profiler  # noqa: F401
+from . import spool  # noqa: F401
 from .registry import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
 from .tracing import span  # noqa: F401
 
@@ -91,7 +93,7 @@ __all__ = ["enable", "disable", "enabled", "metrics", "counter", "gauge",
            "gauge_value", "span", "dump", "dump_prometheus",
            "chrome_trace", "write_chrome_trace", "reset",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
-           "flight", "distributed"]
+           "flight", "distributed", "profiler", "spool"]
 
 _registry = MetricsRegistry()
 _enabled = False
@@ -241,8 +243,6 @@ def _legacy_profiler_events():
     running, else the last finished session's snapshot — so the chrome
     export keeps the ``get_trace_events()`` contract alive."""
     try:
-        from .. import profiler
-
         if tracing.profiler_session_active():
             return []   # live session spans are already in the buffer
         return profiler.get_trace_events()
@@ -268,8 +268,6 @@ def reset() -> None:
     _registry.reset()
     tracing.clear()
     try:
-        from .. import profiler
-
         del profiler._last_trace[:]
     except Exception:
         pass

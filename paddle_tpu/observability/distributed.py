@@ -57,9 +57,9 @@ __all__ = ["TraceContext", "current", "set_current", "trace",
            "child_span", "record_span", "inject", "extract",
            "process_identity", "set_identity", "metrics_dir",
            "dump_path", "dump_process", "arm", "arm_from_env",
-           "clear_stale_dumps",
+           "clear_stale_dumps", "job_trace_id", "fleet_round_args",
            "load_dumps", "doc_flight_events", "merge_job_dir",
-           "MERGED_METRICS_NAME", "MERGED_TRACE_NAME"]
+           "JOB_TRACE_ENV", "MERGED_METRICS_NAME", "MERGED_TRACE_NAME"]
 
 MERGED_METRICS_NAME = "metrics.json"
 MERGED_TRACE_NAME = "trace.json"
@@ -212,6 +212,40 @@ def extract(msg: Dict) -> Tuple[Optional[str], Optional[str]]:
                       if msg.get("parent_span") else None)
 
 
+# -- job trace id (collective-fleet propagation) ----------------------------
+#
+# The PS/serving paths propagate trace context on an rpc header; the
+# collective-fleet path has NO header — ranks talk through compiled
+# XLA collectives. Instead the launcher mints ONE job trace id into
+# the environment every child inherits, and each rank derives the
+# same (trace_id, round span) from it plus its LOCAL sync-round
+# counter: data-parallel ranks advance in lockstep (the allreduce IS
+# the barrier), so identical derivation needs no coordination message.
+
+JOB_TRACE_ENV = "PADDLE_TPU_TRACE_ID"
+
+
+def job_trace_id() -> Optional[str]:
+    tid = os.environ.get(JOB_TRACE_ENV, "").strip()
+    return tid or None
+
+
+def fleet_round_args(round_no: int) -> Dict:
+    """Span args joining one collective sync round to the job trace:
+    every rank stamps ``trace_id`` = the job trace id and
+    ``parent_span`` = a round id derived from ``round_no``, so the
+    merged job ``trace.json`` shows rank 0..n-1's round-N steps as one
+    cross-process timeline. Empty when the span layer is disarmed or
+    no launcher minted a job trace id (a lone process stays a lone
+    trace)."""
+    if not tracing.active():
+        return {}
+    tid = job_trace_id()
+    if tid is None:
+        return {}
+    return {"trace_id": tid, "parent_span": "dpround-%d" % int(round_no)}
+
+
 # -- process identity -------------------------------------------------------
 
 _identity: Optional[Tuple[str, int]] = None
@@ -222,6 +256,17 @@ def set_identity(role: str, rank: int) -> None:
     ``set_identity("launcher", 0)`` — its own env has no PADDLE_ROLE)."""
     global _identity
     _identity = (str(role), int(rank))
+    sp = tracing.spool()
+    if sp is not None:
+        # the spool armed at import under the env-derived name; spans
+        # must land under the name the dump (and thus the merge) will
+        # use. Identity changes happen at process start, before any
+        # meaningful spans, so re-pointing loses nothing that matters.
+        base = os.path.splitext(_dump_basename())[0]
+        if base != sp.base:
+            from .spool import SpanSpool
+
+            tracing._set_spool(SpanSpool.from_env(sp.dirname, base))
 
 
 def process_identity() -> Tuple[str, int, int]:
@@ -285,6 +330,13 @@ def _dump_process_locked(path, _obs, atomic_write_bytes):
         path = dump_path()
         if path is None:
             return None
+    sp = tracing.spool()
+    if sp is not None:
+        # every dump (periodic/at-exit/on-signal) also drains the span
+        # spool: head spans reach their segment file and the reservoir
+        # file is rewritten, so a SIGKILL between dumps loses at most
+        # one flush period of reservoir churn — never a spooled span
+        sp.flush()
     role, rank, restart = process_identity()
     doc = {
         "schema": _DUMP_SCHEMA,
@@ -304,6 +356,8 @@ def _dump_process_locked(path, _obs, atomic_write_bytes):
         "flight": [list(ev) for ev in flight.events()],
         "flight_stats": flight.stats(),
     }
+    if sp is not None:
+        doc["spool"] = sp.stats()
     atomic_write_bytes(path, json.dumps(doc, default=str).encode())
     return path
 
@@ -332,6 +386,15 @@ def arm(dirname: Optional[str] = None,
         if _arm_state.get("armed"):
             return True
         os.makedirs(dirname, exist_ok=True)
+        if os.environ.get("PADDLE_TPU_SPOOL", "").strip().lower() \
+                not in ("0", "off", "false", "no"):
+            # arm the on-disk span spool (observability/spool.py): the
+            # 64k ring stays the live cache, the spool becomes the
+            # record a long-run merge reads
+            from .spool import SpanSpool
+
+            base = os.path.splitext(_dump_basename())[0]
+            tracing._set_spool(SpanSpool.from_env(dirname, base))
         if period_s is None:
             period_s = float(os.environ.get("PADDLE_TPU_DUMP_PERIOD",
                                             "5") or 5)
@@ -397,17 +460,19 @@ def arm_from_env() -> bool:
 # -- job-level merge --------------------------------------------------------
 
 def clear_stale_dumps(dirname: str) -> int:
-    """Remove every ``*.json`` in ``dirname`` (per-process dumps AND a
-    previous merge) — the launch supervisor calls this at job start so
-    a merged job view never mixes incarnations of the job itself.
-    Returns the number of files removed; a missing dir is 0."""
+    """Remove every ``*.json`` (per-process dumps AND a previous
+    merge) and ``*.jsonl`` (span-spool segments) in ``dirname`` — the
+    launch supervisor calls this at job start so a merged job view
+    never mixes incarnations of the job itself. Returns the number of
+    files removed; a missing dir is 0."""
     if not os.path.isdir(dirname):
         return 0
     n = 0
     with _dump_lock:  # an in-flight dump lands before the clear, and
         # any dump after it uses the caller's already-set identity
         for fn in os.listdir(dirname):
-            if fn.endswith(".json") or fn.startswith(".tmp-"):
+            if fn.endswith(".json") or fn.endswith(".jsonl") \
+                    or fn.startswith(".tmp-"):
                 try:
                     os.unlink(os.path.join(dirname, fn))
                     n += 1
@@ -458,8 +523,15 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
     ``trace.json`` (one chrome-trace timeline: spans as "X" events,
     flight events as instants, one named track per process, all
     rebased onto the wall clock). Returns the two paths, or
-    ``(None, None)`` when there is nothing to merge."""
+    ``(None, None)`` when there is nothing to merge.
+
+    Span source per process: the on-disk spool (head segments + the
+    sampled reservoir — the record for long runs) UNIONED with the
+    dump's ring snapshot (the exact newest-64k window — the spans a
+    crash postmortem needs most, which a reservoir only samples),
+    deduplicated; ring-only when the process never spooled."""
     from ..checkpoint import atomic_write_bytes
+    from .spool import load_spooled_spans
 
     docs = load_dumps(dirname)
     if not docs:
@@ -470,12 +542,29 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
     metas: List[Dict] = []
     for doc in docs:
         key = doc["proc"]
+        spooled = load_spooled_spans(dirname, key)
+        ring = doc.get("spans") or []
+        if spooled is None:
+            spans = ring
+        else:
+            # spool = head + reservoir (bounded, whole-run); ring =
+            # exact tail. Most ring spans are also in the spool for
+            # short runs — dedup on the full tuple (both sides have
+            # json-roundtripped through the same encoding)
+            seen = {json.dumps(ev, sort_keys=True, default=str)
+                    for ev in spooled}
+            spans = spooled + [
+                ev for ev in ring
+                if json.dumps(list(ev), sort_keys=True,
+                              default=str) not in seen]
         processes[key] = {
             "role": doc.get("role"), "rank": doc.get("rank"),
             "restart": doc.get("restart"), "pid": doc.get("pid"),
             "wrote_at": doc.get("wrote_at"),
             "metrics": doc.get("metrics") or {},
             "span_stats": doc.get("span_stats"),
+            "span_source": "spool" if spooled is not None else "ring",
+            "spool": doc.get("spool"),
             "flight_stats": doc.get("flight_stats"),
         }
         for qn, v in (doc.get("metrics") or {}).get("counters",
@@ -485,7 +574,7 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
         pid = int(doc.get("pid") or 0)
         metas.append({"name": "process_name", "ph": "M", "pid": pid,
                       "tid": 0, "args": {"name": key}})
-        for ev in doc.get("spans") or []:
+        for ev in spans:
             name, ts, dur, tid, cat, args = (list(ev) + [None] * 6)[:6]
             entry = {"name": name, "ph": "X", "ts": ts + off,
                      "dur": dur, "pid": pid, "tid": tid, "cat": cat}

@@ -37,6 +37,22 @@ _lock = threading.Lock()
 _events: List[Tuple] = []   # (name, ts_us, dur_us, tid, cat, args)
 _dropped = 0
 
+# on-disk span spool (observability/spool.py), installed by
+# distributed.arm when PADDLE_TPU_METRICS_DIR is set: the bounded ring
+# above then becomes a live CACHE while the spool's head segments +
+# seeded reservoir are the RECORD a day-long job merges from. None
+# (the default) costs one attribute load per recorded span.
+_spool = None
+
+
+def _set_spool(sp) -> None:
+    global _spool
+    _spool = sp
+
+
+def spool():
+    return _spool
+
 # armed-by: the metrics layer (observability.enable) and/or a legacy
 # profiler session (profiler.start_profiler)
 _metrics_on = False
@@ -122,6 +138,9 @@ def _record(name, ts_us, dur_us, cat, args) -> None:
             _dropped += cut
             _session_start = max(0, _session_start - cut)
         _events.append(ev)
+    sp = _spool
+    if sp is not None:
+        sp.offer(ev)
 
 
 def stats() -> Dict[str, int]:

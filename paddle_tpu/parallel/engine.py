@@ -35,6 +35,12 @@ from .transpiler import insert_allreduce_ops
 
 _dp_cache: Dict = {}
 
+# local sync-round counter: dp ranks advance in lockstep (the
+# allreduce IS the barrier), so every rank's Nth mesh step is the same
+# logical round — the basis for joining one round's spans to the job
+# trace without any rank-to-rank message (distributed.fleet_round_args)
+_sync_round = 0
+
 
 def _var_nbytes(block, state: Dict, name: str) -> Tuple[int, int]:
     """(bytes, itemsize) of a var via the shared size resolver in
@@ -314,9 +320,19 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
 
     import time as _time
 
+    from ..observability import distributed as _dtrace
+
+    global _sync_round
+    round_no = _sync_round
+    _sync_round += 1
     t_step = _time.perf_counter() if _obs.enabled() else None
-    with _obs.tracing.span("parallel/step", cat="step",
-                           ranks=nranks):
+    # the step span joins the job trace (launcher-minted
+    # PADDLE_TPU_TRACE_ID) under a round id every rank derives
+    # identically — a dp sync round is ONE cross-process timeline, the
+    # same propagation contract ps_rpc and serving already keep
+    with _obs.tracing.span("parallel/step", cat="step", ranks=nranks,
+                           round=round_no,
+                           **_dtrace.fleet_round_args(round_no)):
         fetches, new_state = fn(
             state, feed_vals,
             jnp.uint32(core.rng.next_seed(0) ^

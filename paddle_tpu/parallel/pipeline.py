@@ -281,9 +281,18 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
     core.rng.advance()
     import time as _time
 
+    from ..observability import distributed as _dtrace
+    from . import engine as _dp_engine
+
+    # pipeline steps share the dp engine's sync-round counter: a
+    # hybrid job's pp and dp step spans join the same job-trace round
+    round_no = _dp_engine._sync_round
+    _dp_engine._sync_round += 1
     t_step = _time.perf_counter() if _obs.enabled() else None
     with _obs.tracing.span("pipeline/step", cat="step",
-                           stages=n_stages, microbatches=n_micro):
+                           stages=n_stages, microbatches=n_micro,
+                           round=round_no,
+                           **_dtrace.fleet_round_args(round_no)):
         loss_mean, new_persist = jitted(params, other_state, upd_state,
                                         feed_vals, seed)
     if t_step is not None:

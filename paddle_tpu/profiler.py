@@ -1,120 +1,24 @@
-"""Profiler — thin compatibility shim over ``paddle_tpu.observability``.
+"""Profiler — re-export of ``paddle_tpu.observability.profiler``.
+
+The fluid session API (RecordEvent / start_profiler / stop_profiler /
+profiler context manager) that used to live here was absorbed into
+``observability/profiler.py`` alongside the step profiler it grew into
+(phase annotation, overlap/critical-path analysis, FLOP accounting —
+see that module's docstring). This module keeps the historic
+``fluid.profiler`` import path alive; the objects ARE the
+observability ones (``_last_trace`` is the same list, so session
+snapshots and ``observability.reset()`` stay coherent).
 
 Parity: /root/reference/python/paddle/fluid/profiler.py (:253 profiler
 context manager, :129 start_profiler, :196 stop_profiler) + the C++
 RecordEvent/DeviceTracer pair (platform/profiler.cc, device_tracer.cc).
-
-The host-event machinery that used to live here (event table, trace
-tuples, enable flag) moved into ``observability/tracing.py`` where every
-execution path shares it; this module keeps the fluid API surface:
-``RecordEvent`` spans feed the same buffer as all other runtime spans,
-``start_profiler``/``stop_profiler`` bracket a *session* whose events
-are drained into a snapshot on stop (sessions never bleed), and
-``profiler(...)`` still prints the per-op host summary table.
-Device-side tracing still delegates to jax.profiler (XPlane ->
-TensorBoard / Perfetto), replacing the CUPTI DeviceTracer +
-chrome-trace toolchain (tools/timeline.py).
 """
 from __future__ import annotations
 
-import contextlib
-
-from .observability import tracing as _tracing
+from .observability.profiler import (  # noqa: F401
+    RecordEvent, _last_trace, cuda_profiler, get_trace_events,
+    is_profiler_enabled, profiler, record_event, reset_profiler,
+    start_profiler, stop_profiler)
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
            "stop_profiler"]
-
-_last_trace = []  # (name, ts_us, dur_us) snapshot of the finished session
-_trace_dir = None
-
-
-class RecordEvent:
-    """RAII op-phase annotation (reference platform/profiler.cc:66) —
-    now an observability span with cat='op'."""
-
-    def __init__(self, name):
-        self.name = name
-
-    def __enter__(self):
-        self._span = _tracing.span(self.name, cat="op")
-        self._span.__enter__()
-        return self
-
-    def __exit__(self, *exc):
-        return self._span.__exit__(*exc)
-
-
-def record_event(name):
-    return RecordEvent(name)
-
-
-def is_profiler_enabled():
-    return _tracing.profiler_session_active()
-
-
-def get_trace_events():
-    """(name, ts_us, dur_us) host events for timeline export: the live
-    session while profiling, else the last finished session's snapshot
-    (stop_profiler drains live state so sessions never bleed)."""
-    if _tracing.profiler_session_active():
-        return [(n, ts, dur)
-                for (n, ts, dur, _tid, _cat, _a)
-                in _tracing.profiler_session_events()]
-    return list(_last_trace)
-
-
-def reset_profiler():
-    # session-scoped: metrics-mode spans recorded by other subsystems
-    # are not this API's to destroy
-    _tracing.profiler_session_reset()
-
-
-def start_profiler(state="All", tracer_option=None, trace_dir=None):
-    global _trace_dir
-    _trace_dir = trace_dir
-    _tracing.profiler_session_start()
-    if trace_dir:
-        import jax
-
-        jax.profiler.start_trace(trace_dir)
-
-
-def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
-    if _trace_dir:
-        import jax
-
-        jax.profiler.stop_trace()
-    session, agg = _tracing.profiler_session_stop()
-    # the aggregate side stays exact even when buffer pressure dropped
-    # old spans mid-session; the timeline snapshot below is best-effort
-    rows = sorted(((name, (count, total_us / 1e6))
-                   for name, (count, total_us) in agg.items()),
-                  key=lambda kv: -kv[1][1])
-    if rows:
-        print("%-40s %10s %14s %14s" % ("Event", "Calls", "Total(ms)", "Avg(ms)"))
-        for name, (count, total) in rows[:50]:
-            print("%-40s %10d %14.3f %14.3f"
-                  % (name, count, total * 1e3, total * 1e3 / max(count, 1)))
-    # snapshot so get_trace_events() after stop still serves the
-    # finished session (the reference's DisableProfiler resets after
-    # emitting)
-    del _last_trace[:]
-    _last_trace.extend((n, ts, dur) for (n, ts, dur, _t, _c, _a)
-                       in session)
-
-
-@contextlib.contextmanager
-def profiler(state="All", sorted_key="total", profile_path="/tmp/profile",
-             tracer_option=None):
-    start_profiler(state, tracer_option)
-    try:
-        yield
-    finally:
-        stop_profiler(sorted_key, profile_path)
-
-
-@contextlib.contextmanager
-def cuda_profiler(output_file=None, output_mode=None, config=None):
-    # name kept for API compatibility; delegates to the XLA trace
-    with profiler():
-        yield

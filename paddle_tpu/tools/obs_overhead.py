@@ -91,6 +91,24 @@ def main():
     ok = ok and all(c < PRIMITIVE_BUDGET_US
                     for c in (inject_cost, child_cost, flight_cost))
 
+    # ISSUE 7: the step profiler's disabled path. Phase annotation off
+    # must stay one module-flag check (the per-trace hook is a single
+    # `is None` branch, and compiled programs are byte-identical — the
+    # jaxpr claim is test-gated in tests/test_profiler.py; this bounds
+    # the primitive), and the profiler must not have armed itself.
+    from paddle_tpu.observability import profiler as prof
+
+    assert not prof.annotating(), \
+        "phase annotation must default off (PADDLE_TPU_PROFILE unset)"
+    from paddle_tpu.core import compiler_engine as _ce
+
+    assert _ce._phase_annotator is None, \
+        "trace-time phase hook must be uninstalled by default"
+    annot_cost = _bench_primitive(prof.annotating)
+    print("profiler disabled cost: annotating()=%.3fus "
+          "(budget %.1fus)" % (annot_cost, PRIMITIVE_BUDGET_US))
+    ok = ok and annot_cost < PRIMITIVE_BUDGET_US
+
     # tiny 2-op program: measure real steps, project the per-step
     # instrumentation cost from the primitive costs above
     import numpy as np

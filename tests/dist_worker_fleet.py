@@ -20,6 +20,7 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.dygraph.parallel import prepare_context
+from paddle_tpu.observability import distributed as dtrace
 
 STEPS = 3
 SHARD = 8  # per-process batch
@@ -75,23 +76,35 @@ def main():
         exe.run(startup)
         rng = np.random.RandomState(7)
         losses = []
+        # each sync round joins the job trace (PADDLE_TPU_TRACE_ID from
+        # the launching test/supervisor) under the SAME round id on
+        # every rank — fleet_round_args is the one place the
+        # derivation lives, shared with the mesh engine's step span.
+        # child_span installs the context thread-locally, so the
+        # data-fetch rpcs below ride the same trace. No-op when the
+        # span layer is disarmed.
         for step in range(STEPS):
-            if data_client is not None:
-                # batch over the fault-injected ps_rpc transport (the
-                # data server precomputed the same rng(7) sequence)
-                full_x = data_client.get_param("x_s%d" % step)
-                full_y = data_client.get_param("y_s%d" % step)
-            else:
-                full_x = rng.randn(SHARD * world, DIM).astype("float32")
-                full_y = rng.randint(0, CLASSES,
-                                     (SHARD * world, 1)).astype("int64")
-            my_x = full_x[rank * local_bs:(rank + 1) * local_bs]
-            my_y = full_y[rank * local_bs:(rank + 1) * local_bs]
-            (l,) = exe.run(compiled, feed={"x": my_x, "y": my_y},
-                           fetch_list=[loss])
-            # fetch is all-gathered [nranks, 1]: every rank sees every
-            # shard's loss — use the global mean
-            losses.append(float(np.mean(np.asarray(l))))
+            with dtrace.child_span("fleet/round", cat="step",
+                                   rank=rank,
+                                   **dtrace.fleet_round_args(step)):
+                if data_client is not None:
+                    # batch over the fault-injected ps_rpc transport
+                    # (the data server precomputed the same rng(7)
+                    # sequence)
+                    full_x = data_client.get_param("x_s%d" % step)
+                    full_y = data_client.get_param("y_s%d" % step)
+                else:
+                    full_x = rng.randn(SHARD * world,
+                                       DIM).astype("float32")
+                    full_y = rng.randint(
+                        0, CLASSES, (SHARD * world, 1)).astype("int64")
+                my_x = full_x[rank * local_bs:(rank + 1) * local_bs]
+                my_y = full_y[rank * local_bs:(rank + 1) * local_bs]
+                (l,) = exe.run(compiled, feed={"x": my_x, "y": my_y},
+                               fetch_list=[loss])
+                # fetch is all-gathered [nranks, 1]: every rank sees
+                # every shard's loss — use the global mean
+                losses.append(float(np.mean(np.asarray(l))))
         w1 = scope.find_var("w1").raw().array
         w1_local = (w1.addressable_shards[0].data
                     if hasattr(w1, "addressable_shards") else w1)

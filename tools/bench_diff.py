@@ -32,13 +32,27 @@ import json
 import sys
 
 # per-workload metrics worth gating; direction: +1 higher is better,
-# -1 lower is better
+# -1 lower is better. The profile-block metrics (bench.py `profile`:
+# flops-derived mfu_est, measured overlap_frac / critical_path_ms)
+# resolve through the record's "profile" sub-dict — _lookup descends.
 WATCHED = (
     ("images_per_sec", +1), ("tokens_per_sec", +1),
     ("examples_per_sec", +1), ("steps_per_sec", +1),
     ("tokens_or_images_per_sec", +1),
     ("step_ms", -1), ("collective_bytes", -1),
+    ("mfu_est", +1), ("overlap_frac", +1),
+    ("critical_path_ms", -1), ("exposed_collective_ms", -1),
 )
+
+# absolute noise floors for measured-timing metrics: a relative
+# threshold alone turns sub-millisecond jitter on a near-zero base
+# (0.2ms -> 0.5ms exposed time on a tiny CI smoke) into a +150%
+# "regression". A delta must clear BOTH the relative threshold and
+# this absolute floor to flag. Deterministic metrics have no floor.
+ABS_NOISE_FLOOR = {
+    "step_ms": 2.0, "critical_path_ms": 2.0,
+    "exposed_collective_ms": 2.0, "overlap_frac": 0.1,
+}
 
 # counter totals (metrics.json) where growth is a regression
 COUNTER_WATCH_GROWS_BAD = ("parallel.collective_bytes",
@@ -102,14 +116,18 @@ def diff_records(base, head, threshold):
                 yield name, metric, bv, hv, float("inf"), False
                 continue
             rel = (hv - bv) / abs(bv)
-            regressed = (-direction * rel) > threshold
+            regressed = (-direction * rel) > threshold and \
+                abs(hv - bv) > ABS_NOISE_FLOOR.get(metric, 0.0)
             yield name, metric, bv, hv, rel, regressed
 
 
 def _lookup(rec, metric):
-    """A metric straight off the record, or from its diag (single-chip
-    collective_bytes lives there)."""
+    """A metric straight off the record, or from its profile block
+    (mfu_est / overlap_frac / critical_path_ms), or from its diag
+    (single-chip collective_bytes lives there)."""
     v = rec.get(metric)
+    if v is None and isinstance(rec.get("profile"), dict):
+        v = rec["profile"].get(metric)
     if v is None and isinstance(rec.get("diag"), dict):
         v = rec["diag"].get(metric)
     if isinstance(v, (int, float)) and not isinstance(v, bool):
@@ -228,6 +246,29 @@ def _self_test():
     zbad = list(diff_counters(z0, z1, 0.25))
     assert zbad and zbad[0][-1], zbad
     assert not list(diff_counters(z0, z0, 0.25))
+    # profile-block metrics: an overlap_frac / mfu_est drop past the
+    # threshold is a regression even when raw throughput held
+    p0 = {"configs": {"w": {"tokens_per_sec": 100.0, "profile": {
+        "mfu_est": 0.40, "overlap_frac": 0.90,
+        "critical_path_ms": 10.0}}}}
+    p1 = {"configs": {"w": {"tokens_per_sec": 100.0, "profile": {
+        "mfu_est": 0.40, "overlap_frac": 0.30,
+        "critical_path_ms": 10.0}}}}
+    pbad = [r for r in diff_records(p0, p1, 0.10)
+            if r[1] == "overlap_frac"]
+    assert pbad and pbad[0][-1], pbad
+    assert not any(r[-1] for r in diff_records(p0, p0, 0.10))
+    # sub-floor jitter on a near-zero timing base must NOT flag
+    # (0.2ms -> 0.5ms exposed time is scheduler noise, not a 150%
+    # regression), while the same relative delta at real magnitude
+    # still does
+    n0 = {"configs": {"w": {"profile": {"exposed_collective_ms": 0.2}}}}
+    n1 = {"configs": {"w": {"profile": {"exposed_collective_ms": 0.5}}}}
+    assert not any(r[-1] for r in diff_records(n0, n1, 0.5))
+    n2 = {"configs": {"w": {"profile": {"exposed_collective_ms": 20.0}}}}
+    n3 = {"configs": {"w": {"profile": {"exposed_collective_ms": 50.0}}}}
+    nbad = list(diff_records(n2, n3, 0.5))
+    assert any(r[-1] for r in nbad), nbad
     print("bench_diff self-test ok")
     return 0
 

@@ -90,6 +90,21 @@ def print_postmortem(dirname: str, show_frames: bool = False,
     procs = sorted({e["proc"] for e in events})
     print("== postmortem: %d flight events from %d process(es) %s =="
           % (len(events), len(procs), procs), file=out)
+    if mpath:
+        # where each process's spans came from: "spool" = the on-disk
+        # head+reservoir record (long-run safe), "ring" = the dump's
+        # 64k in-memory snapshot (lossy past 64k spans)
+        try:
+            import json
+
+            with open(mpath, "r", encoding="utf-8") as f:
+                pinfo = json.load(f).get("processes") or {}
+            srcs = sorted("%s:%s" % (k, v.get("span_source"))
+                          for k, v in pinfo.items())
+            if srcs:
+                print("span sources: %s" % " ".join(srcs), file=out)
+        except (OSError, ValueError):
+            pass
     if limit is not None and len(lines) > limit:
         print("... (%d earlier events elided; --limit 0 for all)"
               % (len(lines) - limit), file=out)

@@ -19,6 +19,13 @@ baseline (``PADDLE_TPU_BUCKET_MB=0``, ``PADDLE_TPU_SHARDED_UPDATE=0``)
      tests/test_collectives.py's parity tests, run here via pytest);
   c. ``tools/bench_diff.py`` answers ``--help`` and passes its
      built-in ``--self-test``.
+
+``--out PATH`` additionally writes the two measured records as a
+bench_diff-compatible artifact (``{"configs": {"mlp": ...,
+"mlp_pergrad": ...}, "counters_total": ...}``) — ci/check.sh keeps the
+previous run's copy under ``ci/baseline/`` and diffs against it
+automatically (gate 7b), the ROADMAP's "CI keeps an artifact around"
+item.
 """
 from __future__ import annotations
 
@@ -57,6 +64,16 @@ def _run_config(extra_env):
 
 
 def main():
+    out_path = None
+    args = list(sys.argv[1:])
+    while args:
+        a = args.pop(0)
+        if a == "--out" and args:
+            out_path = args.pop(0)
+        elif a.startswith("--out="):
+            out_path = a.split("=", 1)[1]
+        else:
+            raise SystemExit("mc_smoke: unknown arg %r" % a)
     t0 = time.time()
     fast = _run_config({})
     base = _run_config({"PADDLE_TPU_BUCKET_MB": "0",
@@ -91,6 +108,22 @@ def main():
     assert out.returncode == 0 and "--threshold" in out.stdout, out.stderr
     subprocess.run([sys.executable, bd, "--self-test"], check=True,
                    timeout=60)
+
+    if out_path:
+        # bench_diff-compatible artifact of THIS run: the "configs"
+        # records carry step_ms/throughput/collective/profile, and the
+        # fast path's per-step collective counters double as the
+        # deterministic counters_total gate
+        doc = {
+            "schema": "mc_smoke_v1",
+            "wrote_at": time.time(),
+            "configs": {"mlp": fast, "mlp_pergrad": base},
+            "counters_total": dict(fast["collective"]["per_step"]),
+        }
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print("mc_smoke: wrote %s" % out_path)
 
     print("mc_smoke: OK in %.1fs" % (time.time() - t0))
 
