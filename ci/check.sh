@@ -90,16 +90,30 @@ python tools/ft_smoke.py
 # with every trainer failed over to the promoted backup AND the final
 # params matching the clean single-server run bit-for-bit (failover
 # replay + replicated dedup watermark); the killed server must rejoin
-# as a catching-up backup under the supervisor
+# as a catching-up backup under the supervisor, and the merged
+# telemetry must show DELTA replication actually carried the job
+# (ps.delta_rounds > 0 — a silent regression to full-blob shipping
+# fails here)
 python tools/ft_smoke.py --server-kill
 # 6d: bounded chaos drill — one seeded randomized schedule (random
 # fault plan + random trainer kill + random primary-pserver kill),
 # gated on bit-for-bit parity with the clean run PLUS the merged-
 # telemetry invariants (job-level metrics.json + trace.json exist;
-# injected faults, the ps.failovers span, the promotion, and the
-# promoted backup's first applied round are visible in causal order
-# across >= 3 processes); a failure prints the seed that replays it
+# injected faults, the quorum promotion, and the promoted backup's
+# first applied round are visible in causal order across >= 3
+# processes; delta replication ran with its bytes strictly below the
+# full anchors'); a failure prints the seed that replays it
 python tools/chaos_drill.py --rounds 1
+# 6e: ISSUE-8 acceptance drill — 2 key-range shards x (primary +
+# backup), the schedule's shard loses its primary to SIGKILL (lease
+# expiry -> tombstone-quorum election -> promotion) while the OTHER
+# shard's primary<->backup pair is network-partitioned for the whole
+# run (the backup's lease expires but every election is quorum-DENIED
+# — exactly one writable primary per shard, no split brain, no lost
+# rounds). Exit 0, per-shard params bit-for-bit, and
+# ps.replication_bytes{mode=delta} strictly below the full-anchor
+# bytes in the merged job metrics.json
+python tools/chaos_drill.py --rounds 1 --shards 2 --partition
 
 echo "== gate 7: multichip fast-path smoke =="
 # dp=8 CPU host mesh, mlp config, ~1 min: the bucketed/sharded

@@ -13,15 +13,18 @@ Grammar (``PADDLE_TPU_FAULTS``)::
 
     plan  := spec[,spec...]
     spec  := <side>.<kind>:<prob>[:<param>]
+           | partition:<prob>:<peer>[|<peer>]
     side  := send | recv | any
-    kind  := drop | delay | dup | truncate | close
+    kind  := drop | delay | dup | truncate | close | partition
     prob  := float in [0, 1]           (per-frame probability)
     param := delay ms (delay, default 20) | byte count (truncate)
+           | endpoint pair (partition — may itself contain colons)
 
 Examples::
 
     PADDLE_TPU_FAULTS="send.drop:0.05,send.dup:0.05"
     PADDLE_TPU_FAULTS="any.delay:0.2:50,recv.close:0.01"
+    PADDLE_TPU_FAULTS="partition:1:127.0.0.1:7001|127.0.0.1:7002"
     PADDLE_TPU_FAULT_SEED=42
 
 Kinds per side — ``send``: drop (frame never transmitted), delay
@@ -29,6 +32,22 @@ Kinds per side — ``send``: drop (frame never transmitted), delay
 dedup), truncate (transmit a prefix, then sever — the peer sees EOF
 mid-frame), close (sever without transmitting). ``recv``: drop (frame
 read and discarded — the reader sees silence), delay, close.
+
+``partition`` (ISSUE 8) is a NETWORK PARTITION between specific
+endpoint pairs, not a per-frame coin flip on every socket: the param
+names either an endpoint pair ``A|B`` or a single peer endpoint. A
+pair rule is active only in processes whose own identity
+(``set_identity`` — ``PSServer`` registers its endpoint; env
+``PADDLE_TPU_FAULT_IDENTITY`` works too) is one of the pair, and eats
+frames (send AND recv) on sockets connected to the OTHER endpoint.
+Both partitioned processes run the same plan (the launcher shares the
+env), so requests die in A's injector and B's die in B's — the pair
+is severed in BOTH directions while every other flow is untouched.
+Eaten frames vanish silently (the peer sees timeouts, like a real
+partition — never a connection refusal, which the lease/quorum
+promotion logic treats as positive evidence of process death).
+``prob`` is per frame; 1.0 is a hard partition, below it a flaky
+link.
 
 Every injected fault increments ``fault.injected{side=,kind=}`` in the
 observability registry (recorded unconditionally, like ``serving.*`` —
@@ -45,11 +64,11 @@ from typing import List, Optional
 
 __all__ = ["FaultRule", "FaultInjector", "FaultInjected",
            "get_injector", "reset_injector", "parse_plan",
-           "random_plan"]
+           "random_plan", "set_identity", "get_identity"]
 
 _SIDES = ("send", "recv", "any")
-_KINDS = ("drop", "delay", "dup", "truncate", "close")
-_RECV_KINDS = ("drop", "delay", "close")
+_KINDS = ("drop", "delay", "dup", "truncate", "close", "partition")
+_RECV_KINDS = ("drop", "delay", "close", "partition")
 
 
 class FaultInjected(OSError):
@@ -62,7 +81,7 @@ class FaultRule:
     __slots__ = ("side", "kind", "prob", "param")
 
     def __init__(self, side: str, kind: str, prob: float,
-                 param: Optional[float] = None):
+                 param=None):
         if side not in _SIDES:
             raise ValueError("fault side must be one of %s, got %r"
                              % (_SIDES, side))
@@ -76,15 +95,43 @@ class FaultRule:
         if not 0.0 <= prob <= 1.0:
             raise ValueError("fault probability must be in [0,1], got %r"
                              % prob)
+        if kind == "partition":
+            if not param or not str(param).strip():
+                raise ValueError(
+                    "partition rules need a peer endpoint (or an A|B "
+                    "pair) as their param")
+            param = str(param).strip()
         self.side = side
         self.kind = kind
         self.prob = prob
         self.param = param
 
+    def partition_peer(self, identity: Optional[str]) -> Optional[str]:
+        """The endpoint this rule severs FROM THIS PROCESS, or None
+        when the rule is inactive here. A pair ``A|B`` is active only
+        when the process identity is one of the pair (the peer is the
+        other one); a single-endpoint param partitions this process
+        from that peer unconditionally."""
+        if self.kind != "partition":
+            return None
+        if "|" in self.param:
+            a, _, b = self.param.partition("|")
+            a, b = a.strip(), b.strip()
+            if identity == a:
+                return b
+            if identity == b:
+                return a
+            return None
+        return self.param
+
     def __repr__(self):
-        return "%s.%s:%g%s" % (self.side, self.kind, self.prob,
-                               ":%g" % self.param
-                               if self.param is not None else "")
+        if self.param is None:
+            return "%s.%s:%g" % (self.side, self.kind, self.prob)
+        if isinstance(self.param, str):
+            return "%s.%s:%g:%s" % (self.side, self.kind, self.prob,
+                                    self.param)
+        return "%s.%s:%g:%g" % (self.side, self.kind, self.prob,
+                                self.param)
 
 
 def parse_plan(plan: str) -> List[FaultRule]:
@@ -97,10 +144,21 @@ def parse_plan(plan: str) -> List[FaultRule]:
             continue
         try:
             head, _, rest = spec.partition(":")
-            side, _, kind = head.partition(".")
-            parts = rest.split(":")
-            prob = float(parts[0])
-            param = float(parts[1]) if len(parts) > 1 else None
+            side, dot, kind = head.partition(".")
+            if not dot and side == "partition":
+                # bare "partition:prob:peer" — side is meaningless for
+                # a pair severing, default it
+                side, kind = "any", "partition"
+            if kind == "partition":
+                # the param is an endpoint (pair) and endpoints contain
+                # colons: only the FIRST colon after prob splits
+                prob_s, _, param_s = rest.partition(":")
+                prob = float(prob_s)
+                param = param_s or None
+            else:
+                parts = rest.split(":")
+                prob = float(parts[0])
+                param = float(parts[1]) if len(parts) > 1 else None
             rules.append(FaultRule(side, kind, prob, param))
         except (ValueError, IndexError) as e:
             raise ValueError(
@@ -126,11 +184,21 @@ _RANDOM_MENU = (
 )
 
 
-def random_plan(rng: random.Random, max_rules: int = 3) -> str:
+def random_plan(rng: random.Random, max_rules: int = 3,
+                partition_peers=None) -> str:
     """Draw a randomized-but-reproducible ``PADDLE_TPU_FAULTS`` plan
     from the recoverable-fault menu: the same ``rng`` state yields the
     same plan, so a chaos drill's schedule replays from its seed. The
-    returned string always round-trips through ``parse_plan``."""
+    returned string always round-trips through ``parse_plan``.
+
+    ``partition_peers`` (optional) is a list of ``"A|B"`` endpoint-pair
+    strings the plan may sever: when given, the rng picks ONE pair and
+    adds a hard ``partition:1`` rule for it (a partition is a recoverable
+    fault for the lease/quorum promotion logic the chaos drill gates —
+    the partitioned backup must fail its elections, never split the
+    brain). Callers that cannot tolerate a severed pair simply don't
+    pass peers; the rng consumption without them is unchanged, so
+    legacy schedules replay identically."""
     n = rng.randint(1, max(1, int(max_rules)))
     picks = rng.sample(range(len(_RANDOM_MENU)), min(n, len(_RANDOM_MENU)))
     specs = []
@@ -142,19 +210,54 @@ def random_plan(rng: random.Random, max_rules: int = 3) -> str:
         else:
             param = round(rng.uniform(*prange), 1)
             specs.append("%s.%s:%g:%g" % (side, kind, prob, param))
+    if partition_peers:
+        pair = partition_peers[rng.randrange(len(partition_peers))]
+        specs.append("partition:1:%s" % pair)
     plan = ",".join(specs)
     parse_plan(plan)  # self-check: a generated plan must always parse
     return plan
 
 
-def _count(side: str, kind: str) -> None:
+def _count(side: str, kind: str, **fields) -> None:
     from .. import observability as _obs
     from ..observability import flight as _flight
 
     _obs.counter("fault.injected", side=side, kind=kind).inc()
     # black-box line: the postmortem of a drill needs WHICH frames the
     # injector ate interleaved with the recovery decisions they caused
-    _flight.record("fault.injected", side=side, kind=kind)
+    # (partition events carry the severed peer so the drill can prove
+    # WHICH pair was cut)
+    _flight.record("fault.injected", side=side, kind=kind, **fields)
+
+
+# -- process identity (partition rules) -------------------------------------
+
+_identity: Optional[str] = None
+
+
+def set_identity(endpoint: Optional[str]) -> None:
+    """Name this process for endpoint-pair partition rules (a PSServer
+    registers its own endpoint at construction; env
+    ``PADDLE_TPU_FAULT_IDENTITY`` seeds it for anything else)."""
+    global _identity
+    _identity = endpoint
+
+
+def get_identity() -> Optional[str]:
+    global _identity
+    if _identity is None:
+        _identity = os.environ.get("PADDLE_TPU_FAULT_IDENTITY") or None
+    return _identity
+
+
+def _peer_endpoint(sock) -> Optional[str]:
+    """``host:port`` of the socket's remote end, or None when the
+    socket has no peer (fakes in tests, already-severed conns)."""
+    try:
+        addr = sock.getpeername()
+        return "%s:%d" % (addr[0], addr[1])
+    except (OSError, AttributeError, TypeError, IndexError):
+        return None
 
 
 class FaultInjector:
@@ -165,7 +268,8 @@ class FaultInjector:
     thread."""
 
     def __init__(self, rules: List[FaultRule], seed: int = 0):
-        self.rules = list(rules)
+        self.rules = [r for r in rules if r.kind != "partition"]
+        self.partitions = [r for r in rules if r.kind == "partition"]
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
 
@@ -203,6 +307,27 @@ class FaultInjector:
         except OSError:
             pass
 
+    def _partitioned(self, side: str, sock: socket.socket) -> bool:
+        """True when the frame crossing ``sock`` must be blackholed by
+        a partition rule: the socket's peer is the severed endpoint and
+        the per-frame roll fires. Evaluated BEFORE the single-fault
+        menu — a partition overrides everything else on that link."""
+        if not self.partitions:
+            return False
+        peer = _peer_endpoint(sock)
+        if peer is None:
+            return False
+        me = get_identity()
+        for r in self.partitions:
+            if r.partition_peer(me) != peer:
+                continue
+            with self._lock:
+                fires = self._rng.random() < r.prob
+            if fires:
+                _count(side, "partition", peer=peer)
+                return True
+        return False
+
     # -- frame hooks (called by ps_rpc) -----------------------------------
 
     def on_send(self, sock: socket.socket, frame: bytes) -> bool:
@@ -210,6 +335,8 @@ class FaultInjector:
         when the frame reached the wire (possibly twice), False when it
         was dropped; raises ``FaultInjected`` when the connection was
         severed."""
+        if self._partitioned("send", sock):
+            return False  # blackholed: the peer sees silence, not EOF
         r = self._pick("send")
         if r is None:
             sock.sendall(frame)
@@ -239,6 +366,8 @@ class FaultInjector:
         """Decide the fate of the NEXT incoming frame. Returns
         ``"pass"`` (deliver), ``"drop"`` (read and discard), or raises
         ``FaultInjected`` after severing (close)."""
+        if self._partitioned("recv", sock):
+            return "drop"  # the peer's reply dies in the partition
         r = self._pick("recv")
         if r is None:
             return "pass"

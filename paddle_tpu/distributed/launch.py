@@ -30,6 +30,20 @@ have already failed over; ``distributed/ps_rpc.py`` owns that
 protocol). The job completes when every TRAINER rank exits 0; the
 servers are then torn down and their exit codes ignored.
 
+Per-shard supervision (ISSUE 8): ``--pserver_shards=N`` slices the
+endpoint list into N contiguous primary+backup GROUPS
+(``distributed/ps_shard.py`` owns the slicing and the client-side key
+routing). Each server process gets ``PADDLE_PSERVER_SHARDS`` (the
+count), ``PADDLE_PSERVER_SHARD`` (its group index, which also labels
+its ``ps.lease_expiries{shard=}`` counters), ``PADDLE_PSERVER_INDEX``
+(its index WITHIN the group) and — crucially —
+``PADDLE_PSERVER_ENDPOINTS`` narrowed to ITS GROUP's list, so the
+whole ISSUE-4/8 replication + lease + rejoin machinery runs per group
+unchanged. Trainers get the FULL list plus the shard count and route
+via ``ps_shard.client_from_env``. Supervision (relaunch as rejoining
+backup, restart budgets) is per process, so one shard's failures
+never charge another shard's budget.
+
 Job-level observability (ISSUE 5): with ``PADDLE_TPU_METRICS_DIR``
 set, the supervisor clears stale dumps at job start (a merge must
 never mix job incarnations), records every spawn / exit / relaunch
@@ -82,6 +96,13 @@ def _parse_args(argv=None):
     p.add_argument("--pserver_endpoints", default="",
                    help="comma-separated primary+backup pserver "
                         "endpoints (requires --server_script)")
+    p.add_argument("--pserver_shards", type=int,
+                   default=int(os.environ.get("PADDLE_PSERVER_SHARDS",
+                                              "1")),
+                   help="slice --pserver_endpoints into this many "
+                        "contiguous primary+backup groups (key-range "
+                        "sharded PS; endpoint count must divide "
+                        "evenly)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -189,6 +210,15 @@ def launch(args=None):
                    if e.strip()]
     if pserver_eps and not args.server_script:
         raise SystemExit("--pserver_endpoints requires --server_script")
+    nshards = max(1, int(getattr(args, "pserver_shards", 1)))
+    shard_groups = [pserver_eps]
+    if pserver_eps and nshards > 1:
+        from .ps_shard import split_endpoint_groups
+
+        try:
+            shard_groups = split_endpoint_groups(pserver_eps, nshards)
+        except ValueError as e:
+            raise SystemExit(str(e))
     nranks = len(node_ips) * args.nproc_per_node
 
     workers = []
@@ -202,25 +232,36 @@ def launch(args=None):
         env["PADDLE_ROLE"] = "trainer"
         if pserver_eps:
             env["PADDLE_PSERVER_ENDPOINTS"] = ",".join(pserver_eps)
+            env["PADDLE_PSERVER_SHARDS"] = str(nshards)
         cmd = [sys.executable, "-u", args.training_script] + \
             list(args.training_script_args)
         workers.append(_Worker(local_rank, cmd, env, args.log_dir))
 
     servers = []
-    for i, ep in enumerate(pserver_eps):
-        env = dict(os.environ)
-        env["PYTHONPATH"] = pkg_root + os.pathsep + \
-            env.get("PYTHONPATH", "")
-        env.update({
-            "PADDLE_ROLE": "pserver",
-            "PADDLE_PSERVER_ENDPOINTS": ",".join(pserver_eps),
-            "PADDLE_PSERVER_INDEX": str(i),
-            "PSERVER_ENDPOINT": ep,
-            "PADDLE_TRAINERS_NUM": str(nranks),
-        })
-        servers.append(_Worker(i, [sys.executable, "-u",
-                                   args.server_script], env,
-                               args.log_dir, role="pserver"))
+    for shard, group in enumerate(shard_groups if pserver_eps else []):
+        for i, ep in enumerate(group):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = pkg_root + os.pathsep + \
+                env.get("PYTHONPATH", "")
+            env.update({
+                "PADDLE_ROLE": "pserver",
+                # each server sees only ITS group: the ISSUE-4/8
+                # replication/lease/rejoin machinery runs per shard
+                "PADDLE_PSERVER_ENDPOINTS": ",".join(group),
+                "PADDLE_PSERVER_SHARDS": str(nshards),
+                "PADDLE_PSERVER_SHARD": str(shard),
+                "PADDLE_PSERVER_INDEX": str(i),
+                # telemetry identity: unique across the WHOLE job
+                # (per-group indexes repeat across shards)
+                "PADDLE_PSERVER_GLOBAL_INDEX":
+                    str(pserver_eps.index(ep)),
+                "PSERVER_ENDPOINT": ep,
+                "PADDLE_TRAINERS_NUM": str(nranks),
+            })
+            servers.append(_Worker(
+                pserver_eps.index(ep),
+                [sys.executable, "-u", args.server_script], env,
+                args.log_dir, role="pserver"))
 
     def _terminate_all(sig=signal.SIGTERM):
         for w in workers + servers:

@@ -79,6 +79,61 @@ checkpoint_notify availability tier, made survivable end to end):
   that stops acking is dropped from the stream and the gauge freezes
   at its lag).
 
+GB-scale replication + failover (ISSUE 8 — the reference's sparse /
+geo-SGD PS heritage: key-range-sliced tables, delta shipping):
+
+- **delta replication**: the primary no longer ships the full
+  post-round parameter blob every round. It tracks a content digest
+  per scope var; a round ships only the vars (or, for sparse tables
+  updated by ``push_sparse``, only the touched ROWS) whose digest
+  changed, with a periodic full-snapshot ANCHOR every
+  ``PADDLE_PS_ANCHOR_EVERY`` rounds (default 8) so a rejoining backup
+  bounds its replay. A backup that cannot apply a delta (freshly
+  rejoined, behind) answers ``repl_gap`` and is re-anchored with a
+  full blob instead of silently diverging. Both paths are gated
+  bit-for-bit against each other by the ft suite.
+  ``ps.replication_bytes{mode=full|delta}`` / ``ps.delta_rounds`` /
+  ``ps.anchor_rounds`` make a regression back to full-blob shipping
+  visible (and ``tools/bench_diff.py`` watches the bytes counter).
+- **lease-based promotion with quorum**: the lowest-live-index
+  promotion rule is replaced by a lease. The active primary renews a
+  lease with every group peer each ``PADDLE_PS_LEASE_MS``/3 (renewal
+  also rides every replication rpc); a backup may promote only after
+  its lease view EXPIRED **and** a majority of the endpoint group
+  grants its epoch bump (``vote`` rpc; each voter grants once per
+  epoch, only while its own lease view is expired, and only to a
+  candidate at least as caught up as itself). A connection REFUSAL is
+  counted as a tombstone grant — on the drill topology a closed port
+  is positive evidence no server owns that endpoint — while a TIMEOUT
+  (what a real partition produces, and what the ``partition`` fault
+  primitive injects) is no evidence and denies quorum. Net effect: a
+  SIGKILLed primary is replaced within ~one lease, while a network
+  partition yields AT MOST ONE writable primary (the isolated side
+  fails loudly instead of splitting the brain). Epochs fence stale
+  primaries: a lower-epoch primary that reaches a peer which has seen
+  a newer epoch is told ``fenced`` and demotes itself, and a primary
+  in a group of >= 3 that cannot renew with a majority for a full
+  lease steps down (a majority might have elected a rival behind the
+  partition; with 2 endpoints no rival quorum can form, so the
+  primary soldiers on). ``PADDLE_PS_LEASE_MS=0`` restores the legacy
+  instant fo>=1 promotion. Counters: ``ps.lease_renewals``,
+  ``ps.lease_expiries{shard=}``.
+- **async-mode round-gating**: an async (RunAsyncLoop) primary with
+  backups replicates every ``PADDLE_PS_ASYNC_REPL_EVERY`` applied ops
+  (default 32) as a synthetic round, and every async ack tells the
+  client which replication round will carry that op
+  (``pending_round`` / ``durable_round``). The client's failover
+  replay log is round-gated on those tags — entries are dropped only
+  once their round is replicated — so a failover mid-async-push is
+  exactly-once like the sync path (closing the durability gap carried
+  since ISSUE 4).
+
+Sharding note: key-range partitioning of the parameter space across
+multiple primary+backup groups lives in ``distributed/ps_shard.py``
+(``ShardedPSClient`` routes by key and runs the two-phase round
+barrier); each ``PSServer`` group is oblivious — it sees only its own
+endpoint chain.
+
 Distributed observability (ISSUE 5 — Dapper-style context riding the
 existing frame):
 
@@ -121,9 +176,10 @@ from . import fault as _fault
 _ROUND_TIMEOUT = float(os.environ.get("PADDLE_PS_ROUND_TIMEOUT", "120"))
 
 # kinds whose per-frame flight events would flood the bounded ring
-# (a heartbeater ticks every few hundred ms for the whole job) — they
-# still get latency histograms and trace spans, just no black-box line
-_FLIGHT_QUIET = ("heartbeat", "repl_status")
+# (a heartbeater ticks every few hundred ms for the whole job, lease
+# renewals every lease/3) — they still get latency histograms and
+# trace spans, just no black-box line
+_FLIGHT_QUIET = ("heartbeat", "repl_status", "lease_renew")
 
 
 def _counter(name: str, **labels):
@@ -202,6 +258,44 @@ def _array_header(arr: np.ndarray) -> dict:
 def _array_from(header: dict, raw: bytes) -> np.ndarray:
     return np.frombuffer(raw, dtype=header["dtype"]).reshape(
         header["shape"]).copy()
+
+
+def _var_digest(arr: np.ndarray) -> str:
+    """Content digest the delta-replication planner diffs rounds by.
+    Hashing GB-scale state every round is the price of shipping only
+    what changed — blake2b streams at memory bandwidth, orders of
+    magnitude under the network cost of the full blob it avoids."""
+    import hashlib
+
+    return hashlib.blake2b(arr.tobytes(), digest_size=16).hexdigest()
+
+
+def _bare_rpc(endpoint: str, msg: dict, timeout: float = 1.0) -> dict:
+    """One connect + frame exchange with none of PSClient's retry /
+    dedup / failover machinery — the lease-and-vote control plane,
+    where a failure IS the signal. ``ConnectionRefusedError``
+    propagates distinctly: a refused connect means no listener owns
+    the endpoint (positive evidence of process death on the drill
+    topology, counted as a tombstone by elections), while a timeout —
+    what a partition produces — is no evidence at all. Frames still
+    route through the fault injector, so partitions drill this path
+    too. Patchable by tests to simulate link states in-process."""
+    host, port = endpoint.rsplit(":", 1)
+    sock = socket.create_connection((host or "127.0.0.1", int(port)),
+                                    timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        _send_msg(sock, msg)
+        got = _recv_msg(sock)
+        if got is None:
+            raise OSError("EOF from %s during %s"
+                          % (endpoint, msg.get("kind")))
+        return got[0]
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
 
 
 def snapshot_scope_to_dir(executor, scope, dirname: str,
@@ -320,8 +414,15 @@ class PSServer:
                  fanin: int = 1, sync_mode: bool = True,
                  evict_after: Optional[float] = None,
                  endpoints: Optional[List[str]] = None,
-                 rejoin: Optional[bool] = None):
+                 rejoin: Optional[bool] = None,
+                 anchor_every: Optional[int] = None,
+                 lease_ms: Optional[float] = None):
         host, port = endpoint.rsplit(":", 1)
+        # endpoint-pair partition rules address server processes by
+        # their advertised endpoint; first server in wins (one server
+        # per process everywhere but in-process unit tests)
+        if _fault.get_identity() is None:
+            _fault.set_identity(endpoint)
         self._executor = executor
         self._scope = scope
         self._grad_to_block = grad_to_block
@@ -362,6 +463,41 @@ class PSServer:
             os.environ.get("PADDLE_PS_REPL_DEADLINE", "10"))
         self._repl_connect = float(
             os.environ.get("PADDLE_PS_REPL_CONNECT_TIMEOUT", "3"))
+        # -- delta replication (ISSUE 8) ----------------------------------
+        # per-var content digest of the state last shipped to the
+        # stream; empty => next ship is a full anchor (fresh primary,
+        # fresh promotion)
+        self._shipped_digests: Dict[str, str] = {}
+        # param var -> set of rows touched by push_sparse since the
+        # last ship: lets a delta round ship row SLICES of a sparse
+        # table (sound because pslib sparse optimize blocks are
+        # row-local); any dense round wipes it — full-var diff wins
+        self._dirty_rows: Dict[str, set] = {}
+        if anchor_every is None:
+            anchor_every = int(os.environ.get("PADDLE_PS_ANCHOR_EVERY",
+                                              "8"))
+        self._anchor_every = int(anchor_every)
+        self._async_ops = 0
+        self._async_repl_every = int(
+            os.environ.get("PADDLE_PS_ASYNC_REPL_EVERY", "32"))
+        # highest round at least one backup has ACKED — what async
+        # clients may prune their replay logs up to
+        self._durable_round = 0
+        # -- lease + quorum promotion (ISSUE 8) ---------------------------
+        self._shard = os.environ.get("PADDLE_PSERVER_SHARD", "0")
+        if lease_ms is None:
+            lease_ms = float(os.environ.get("PADDLE_PS_LEASE_MS",
+                                            "1500"))
+        self._lease_s = float(lease_ms) / 1e3
+        self._epoch = 0           # the epoch this server serves at
+        self._seen_epoch = 0      # highest epoch heard from any primary
+        self._promised_epoch = 0  # highest epoch this voter granted
+        # boot grace: a backup must never elect before the primary had
+        # one full lease to introduce itself
+        self._lease_deadline = time.monotonic() + self._lease_s
+        self._lease_expired_counted = False
+        self._last_majority_ack = time.monotonic()
+        self._election_lock = threading.Lock()
         if evict_after is None:
             evict_after = float(os.environ.get("PADDLE_PS_EVICT_AFTER",
                                                "0"))
@@ -411,6 +547,11 @@ class PSServer:
                                  name="ps-catchup", daemon=True)
             t.start()
             self._threads.append(t)
+        if len(self._endpoints) > 1 and self._lease_s > 0:
+            t = threading.Thread(target=self._lease_loop,
+                                 name="ps-lease", daemon=True)
+            t.start()
+            self._threads.append(t)
 
     # -- round protocol ---------------------------------------------------
 
@@ -430,6 +571,10 @@ class PSServer:
         _flight.record("ps.round_apply", round=nxt,
                        vars=len(self._pending))
         with _dtrace.child_span("ps.apply_round", cat="ps", round=nxt):
+            # a dense round may touch any row of any var through its
+            # optimize blocks: row-slice tracking is only sound between
+            # dense rounds, so the per-var digest diff takes over
+            self._dirty_rows.clear()
             for name in sorted(self._pending):
                 by_tid = self._pending[name]
                 tids = sorted(by_tid)
@@ -471,21 +616,36 @@ class PSServer:
             self._repl_clients[ep] = c
         return c
 
-    def _scope_blobs(self):
-        """(headers, raw) for every tensor var in the scope — the
-        post-round replication payload (full blobs, bit-exact by
-        construction; delta streaming is a named ROADMAP follow-up)."""
-        headers, chunks = [], []
+    def _scope_arrays(self) -> List[tuple]:
+        """[(name, contiguous array)] for every tensor var in scope."""
+        out = []
         for name in list(self._scope.local_var_names()):
             val = self._executor._read_var(self._scope, name)
             if val is None or not hasattr(val, "shape"):
                 continue
-            arr = np.ascontiguousarray(np.asarray(val))
+            out.append((name, np.ascontiguousarray(np.asarray(val))))
+        return out
+
+    @staticmethod
+    def _blobs_for(items) -> tuple:
+        """(headers, raw) for [(name, array, rows-or-None)] — a header
+        with ``rows`` is a row SLICE of the named table (local row
+        ids), without it the array replaces the whole var."""
+        headers, chunks = [], []
+        for name, arr, rows in items:
             h = _array_header(arr)
             h["name"] = name
+            if rows is not None:
+                h["rows"] = rows
             headers.append(h)
             chunks.append(arr.tobytes())
         return headers, b"".join(chunks)
+
+    def _scope_blobs(self):
+        """Full-blob (headers, raw) for every tensor var — the anchor
+        payload and the ``repl_gap`` re-anchor fallback."""
+        return self._blobs_for(
+            [(n, a, None) for n, a in self._scope_arrays()])
 
     def _watermark_locked(self) -> Dict[str, int]:
         """Per-cid seq watermark covering every rpc folded into the
@@ -498,26 +658,88 @@ class PSServer:
                 wm[cid] = int(s)
         return wm
 
+    def _replication_plan(self, arrays) -> tuple:
+        """(mode, items, digests) for the round about to ship: a FULL
+        anchor when nothing was ever shipped or the anchor interval
+        divides the round; otherwise a DELTA of only the vars whose
+        content digest moved — as row slices where push_sparse
+        recorded which rows changed and the slice is actually smaller
+        than the table."""
+        digests = {n: _var_digest(a) for n, a in arrays}
+        anchor = (not self._shipped_digests
+                  or (self._anchor_every > 0 and self._applied_round
+                      % self._anchor_every == 0))
+        if anchor:
+            return "full", [(n, a, None) for n, a in arrays], digests
+        items = []
+        for n, a in arrays:
+            if digests[n] == self._shipped_digests.get(n):
+                continue
+            rows = self._dirty_rows.get(n)
+            if (rows and getattr(a, "ndim", 0) >= 1
+                    and len(rows) < int(a.shape[0])):
+                rs = np.asarray(sorted(rows), dtype=np.int64)
+                items.append((n, np.ascontiguousarray(a[rs]),
+                              rs.tolist()))
+            else:
+                items.append((n, a, None))
+        return "delta", items, digests
+
     def _replicate_locked(self) -> None:
         """Stream the just-applied round to every live backup and wait
         for each ack (locked by caller — the round stays incomplete,
-        and unfetchable, until the backups hold it). A backup that
-        fails the short replication deadline is dropped from the
-        stream (its lag gauge freezes; a relaunch re-enters via
-        join_backup)."""
-        if not self._sync or not self._active_role():
+        and unfetchable, until the backups hold it). Ships a DELTA of
+        what changed (full anchor every ``_anchor_every`` rounds); a
+        backup answering ``repl_gap`` (freshly rejoined / behind the
+        delta's base) is re-anchored with a full blob on the spot. A
+        backup that fails the short replication deadline is dropped
+        from the stream (its lag gauge freezes; a relaunch re-enters
+        via join_backup); one that answers ``fenced`` outranks us — a
+        higher-epoch primary exists — and this server demotes."""
+        if not self._active_role():
             return
         targets = self._repl_targets()
         if not targets:
+            # no stream to diff against: keep row tracking bounded and
+            # digests empty so a first backup gets a clean anchor
+            self._dirty_rows.clear()
             return
-        headers, raw = self._scope_blobs()
+        arrays = self._scope_arrays()
+        mode, items, digests = self._replication_plan(arrays)
+        headers, raw = self._blobs_for(items)
+        full_cache = (headers, raw) if mode == "full" else None
         wm = self._applied_watermark
+        base = self._applied_round - 1
+        acked = 0
         for ep in targets:
             _gauge("ps.replication_lag_rounds", backup=ep).set(1)
             try:
-                self._repl_client(ep).replicate(
-                    self._applied_round, headers, raw, wm)
+                resp = self._repl_client(ep).replicate(
+                    self._applied_round, headers, raw, wm, mode=mode,
+                    base_round=base, epoch=self._epoch)
+                if resp.get("fenced"):
+                    self._demote_locked(int(resp.get("epoch", 0)),
+                                        "fenced by %s during "
+                                        "replication" % ep)
+                    return
+                if resp.get("repl_gap"):
+                    if full_cache is None:
+                        full_cache = self._blobs_for(
+                            [(n, a, None) for n, a in arrays])
+                    fh, fraw = full_cache
+                    self._repl_client(ep).replicate(
+                        self._applied_round, fh, fraw, wm,
+                        mode="full", base_round=base,
+                        epoch=self._epoch)
+                    _counter("ps.replication_bytes",
+                             mode="full").inc(len(fraw))
+                    _flight.record("ps.reanchor", backup=ep,
+                                   round=self._applied_round)
+                else:
+                    _counter("ps.replication_bytes",
+                             mode=mode).inc(len(raw))
                 _gauge("ps.replication_lag_rounds", backup=ep).set(0)
+                acked += 1
             except (RuntimeError, OSError) as e:
                 self._repl_dead.add(ep)
                 _flight.record("ps.backup_dropped", backup=ep,
@@ -530,6 +752,41 @@ class PSServer:
                       " stream at round %d: %s"
                       % (ep, self._applied_round, e),
                       file=sys.stderr, flush=True)
+        _counter("ps.anchor_rounds" if mode == "full"
+                 else "ps.delta_rounds").inc()
+        if acked:
+            self._durable_round = self._applied_round
+        self._shipped_digests = digests
+        self._dirty_rows.clear()
+
+    def _async_tick_locked(self) -> dict:
+        """Async-mode (RunAsyncLoop) durability bookkeeping, locked by
+        caller: count the applied op, ship a synthetic replication
+        round every ``PADDLE_PS_ASYNC_REPL_EVERY`` ops, and tell the
+        client which round will carry this op — ``pending_round`` tags
+        its replay-log entry, ``durable_round`` prunes every entry
+        whose round is now replicated. That round-gating makes a
+        failover mid-async-push exactly-once like the sync path
+        (ISSUE 8 satellite; the gap carried since ISSUE 4)."""
+        if self._sync or len(self._endpoints) <= 1 \
+                or not self._active_role():
+            return {}
+        self._async_ops += 1
+        pending = self._applied_round + 1
+        if (self._async_repl_every > 0
+                and self._async_ops % self._async_repl_every == 0):
+            self._applied_round += 1
+            self._applied_watermark = self._watermark_locked()
+            self._replicate_locked()
+            pending = self._applied_round
+        # durable = the last round at least one backup ACKED (not the
+        # last round we merely tried to ship): a ship that reached
+        # nobody must not let the client prune ops that exist only on
+        # this primary. Replication is state-based, so a LATER
+        # successful ship retroactively makes every earlier round
+        # durable — the monotonic _durable_round encodes exactly that.
+        return {"durable_round": self._durable_round,
+                "pending_round": pending}
 
     def _active_role(self) -> bool:
         return self._active or self._promoted
@@ -542,16 +799,195 @@ class PSServer:
         self._promoted = True
         self._repl_dead.discard(self._own_endpoint)
         # the state this server holds = the replicated rounds; its
-        # folded-seq watermark is exactly the inherited one
+        # folded-seq watermark is exactly the inherited one, and its
+        # first ship as a primary must be a full ANCHOR (it never
+        # shipped anything, and the other backups' bases are unknown)
         self._applied_watermark = dict(self._repl_watermark)
+        self._shipped_digests = {}
+        # nothing is replicated BEYOND this server yet: async clients
+        # must hold their replay logs until its first acked ship
+        self._durable_round = 0
         _counter("ps.promotions").inc()
         _flight.record("ps.promotion", round=self._applied_round,
                        index=self._index, endpoint=self._own_endpoint,
-                       rpc=kind)
+                       rpc=kind, epoch=self._epoch)
         print("[ps_rpc] endpoint %s (index %d) promoted to primary at "
-              "round %d (first failover rpc: %s)"
+              "round %d epoch %d (trigger: %s)"
               % (self._own_endpoint, self._index, self._applied_round,
-                 kind), file=sys.stderr, flush=True)
+                 self._epoch, kind), file=sys.stderr, flush=True)
+
+    # -- lease + quorum (ISSUE 8: at most one writable primary) -----------
+
+    def _lease_mode(self) -> bool:
+        return self._lease_s > 0 and len(self._endpoints) > 1
+
+    def _lease_expired_locked(self) -> bool:
+        return time.monotonic() > self._lease_deadline
+
+    def _refresh_lease_locked(self, epoch: int) -> None:
+        """A renewal / replication / snapshot from an equal-or-newer
+        primary: its lease holds for another period."""
+        self._seen_epoch = max(self._seen_epoch, int(epoch))
+        self._lease_deadline = time.monotonic() + self._lease_s
+        self._lease_expired_counted = False
+
+    def _demote_locked(self, new_epoch: int, why: str) -> None:
+        """Step down: a higher-epoch primary exists (fencing) or this
+        primary lost its renewal majority long enough that one could.
+        Better a loud redirect than a second writable primary."""
+        if not self._active_role():
+            return
+        self._active = False
+        self._promoted = False
+        self._seen_epoch = max(self._seen_epoch, int(new_epoch))
+        self._lease_deadline = time.monotonic() + self._lease_s
+        self._cond.notify_all()
+        _flight.record("ps.demotion", endpoint=self._own_endpoint,
+                       epoch=self._epoch, seen_epoch=self._seen_epoch,
+                       why=why)
+        print("[ps_rpc] endpoint %s DEMOTED at round %d (epoch %d): %s"
+              % (self._own_endpoint, self._applied_round, self._epoch,
+                 why), file=sys.stderr, flush=True)
+
+    def _lease_loop(self) -> None:
+        """One background loop per multi-endpoint server: the active
+        primary renews its lease with every group peer; a caught-up
+        backup whose lease view expired stands for election. Control-
+        plane failures are signals, never fatal."""
+        period = max(self._lease_s / 3.0, 0.05)
+        while not self._shutdown.wait(period):
+            try:
+                if self._active_role():
+                    self._renew_lease()
+                elif self._caught_up:
+                    self._maybe_elect("lease-expiry")
+            except Exception as e:  # noqa: BLE001 — the lease loop
+                # must survive anything the drills throw at the wire
+                print("[ps_rpc] lease loop error on %s: %s: %s"
+                      % (self._own_endpoint, type(e).__name__, e),
+                      file=sys.stderr, flush=True)
+
+    def _renew_lease(self) -> None:
+        """Primary side: one renewal sweep over the group. A refused
+        peer is dead (tombstone — it cannot grant a rival's quorum
+        either); a fenced reply means a newer epoch rules and this
+        server demotes; in groups of >= 3, a full lease without a
+        renewal MAJORITY demotes too — behind that partition a rival
+        quorum may exist. With 2 endpoints no rival quorum can form
+        without this server's own vote, so it serves on."""
+        with self._lock:
+            epoch, rnd = self._epoch, self._applied_round
+        n = len(self._endpoints)
+        grants = 1  # self
+        for ep in self._endpoints:
+            if ep == self._own_endpoint or self._shutdown.is_set():
+                continue
+            try:
+                resp = _bare_rpc(
+                    ep, {"kind": "lease_renew", "epoch": epoch,
+                         "round": rnd, "frm": self._own_endpoint},
+                    timeout=max(self._lease_s / 3.0, 0.2))
+            except ConnectionRefusedError:
+                grants += 1  # dead listener: tombstone
+                continue
+            except (OSError, ValueError):
+                continue  # partition/timeout: no evidence either way
+            if resp.get("fenced"):
+                with self._lock:
+                    self._demote_locked(int(resp.get("epoch", 0)),
+                                        "fenced by %s during lease "
+                                        "renewal" % ep)
+                return
+            if resp.get("ok"):
+                grants += 1
+                _counter("ps.lease_renewals").inc()
+        now = time.monotonic()
+        if grants * 2 > n:
+            self._last_majority_ack = now
+        elif n >= 3 and now - self._last_majority_ack > self._lease_s:
+            with self._lock:
+                self._demote_locked(
+                    self._epoch, "no renewal majority for %.1fs "
+                    "(%d/%d reachable)" % (now - self._last_majority_ack,
+                                           grants, n))
+
+    def _maybe_elect(self, trigger: str) -> bool:
+        """Quorum election (backup side). Returns True when this
+        server is (or just became) the active primary. Prerequisites:
+        caught up, lease view expired (+ an index-staggered grace so
+        the lowest surviving index wins clean races). The epoch bump
+        needs strictly more than half the endpoint GROUP: self +
+        granted votes + refused-connect tombstones. Any voter holding
+        a newer round than this candidate vetoes — better no primary
+        than a stale one."""
+        if not self._lease_mode():
+            return self._active_role()
+        with self._lock:
+            if self._active_role():
+                return True
+            if not self._caught_up:
+                return False
+            stagger = max(0, self._index - 1) * self._lease_s / 4.0
+            if time.monotonic() <= self._lease_deadline + stagger:
+                return False
+            if not self._lease_expired_counted:
+                self._lease_expired_counted = True
+                _counter("ps.lease_expiries", shard=self._shard).inc()
+                _flight.record("ps.lease_expired",
+                               endpoint=self._own_endpoint,
+                               shard=self._shard,
+                               round=self._applied_round)
+        with self._election_lock:
+            with self._lock:
+                if self._active_role():
+                    return True
+                if time.monotonic() <= self._lease_deadline:
+                    return False  # a renewal landed while we queued
+                target = max(self._epoch, self._seen_epoch,
+                             self._promised_epoch) + 1
+                my_round = self._applied_round
+            grants, tombstones, denials = 1, 0, 0
+            stale = False
+            for ep in self._endpoints:
+                if ep == self._own_endpoint or self._shutdown.is_set():
+                    continue
+                try:
+                    resp = _bare_rpc(
+                        ep, {"kind": "vote", "epoch": target,
+                             "cand_round": my_round,
+                             "candidate": self._own_endpoint},
+                        timeout=max(self._lease_s / 3.0, 0.3))
+                except ConnectionRefusedError:
+                    tombstones += 1
+                    continue
+                except (OSError, ValueError):
+                    continue  # unreachable: silence is not assent
+                if int(resp.get("round", -1)) > my_round:
+                    stale = True
+                if resp.get("granted"):
+                    grants += 1
+                else:
+                    denials += 1
+            quorum = (grants + tombstones) * 2 > len(self._endpoints)
+            won = quorum and not stale
+            _flight.record("ps.election", endpoint=self._own_endpoint,
+                           epoch=target, grants=grants,
+                           tombstones=tombstones, denials=denials,
+                           stale=stale, won=won, trigger=trigger)
+            if not won:
+                print("[ps_rpc] endpoint %s lost election for epoch %d"
+                      " (%d grants + %d tombstones of %d, denials=%d, "
+                      "stale=%s; trigger=%s) — staying a backup"
+                      % (self._own_endpoint, target, grants, tombstones,
+                         len(self._endpoints), denials, stale, trigger),
+                      file=sys.stderr, flush=True)
+                return False
+            with self._lock:
+                if not self._active_role():
+                    self._epoch = target
+                    self._seen_epoch = max(self._seen_epoch, target)
+                    self._promote_locked(trigger)
+                return True
 
     # -- rejoin catch-up (relaunched server -> backup) --------------------
 
@@ -596,6 +1032,12 @@ class PSServer:
                             if int(self._repl_watermark.get(cid, 0)) \
                                     < int(s):
                                 self._repl_watermark[cid] = int(s)
+                        # adopt the active primary's epoch + a fresh
+                        # lease: a just-rejoined backup must not stand
+                        # for election before the primary's first
+                        # renewal reaches it
+                        self._refresh_lease_locked(
+                            int(resp.get("epoch", 0)))
                         self._pending.clear()
                         self._send_barriers = 0
                         self._fetch_barriers = 0
@@ -691,33 +1133,72 @@ class PSServer:
         """Returns (response_dict, response_raw)."""
         kind = msg["kind"]
         if kind in self._DATAPLANE and not self._active_role():
-            # backup role: only a client that genuinely failed over
-            # (fo >= 1 — it watched the previous endpoint die) may
-            # promote this server; a FRESH client (a relaunched
-            # trainer walking the list from index 0) is redirected so
-            # a rejoined server can never split the brain with the
-            # live primary. An un-caught-up rejoiner redirects
-            # unconditionally — serving stale params is worse than a
-            # redirect hop.
+            # backup role. Lease mode (the default): promotion is
+            # gated on lease expiry + a quorum election — a client
+            # merely REACHING a backup proves nothing (it may be the
+            # wrong side of a partition). Legacy mode
+            # (PADDLE_PS_LEASE_MS=0): only a client that genuinely
+            # failed over (fo >= 1 — it watched the previous endpoint
+            # die) may promote. In both: an un-caught-up rejoiner
+            # redirects unconditionally, and a backup that fell off
+            # the stream is never promoted by a client that OBSERVED a
+            # newer round than it holds — better no primary (loud
+            # failure) than a stale one (silent param regression).
             with self._lock:
-                if (not self._caught_up
-                        or int(msg.get("fo", 0)) < 1
-                        # a backup that fell off the stream must never
-                        # be promoted by a client that has OBSERVED a
-                        # newer round than it holds — better no
-                        # primary (loud failure) than a stale one
-                        # (silent param regression)
-                        or int(msg.get("round", 0))
-                        > self._applied_round):
-                    return {"ok": False, "not_primary": True,
+                lease_mode = self._lease_mode()
+                reject = (not self._caught_up
+                          or int(msg.get("round", 0))
+                          > self._applied_round
+                          or (not lease_mode
+                              and int(msg.get("fo", 0)) < 1))
+                expired = lease_mode and self._lease_expired_locked()
+            if not reject:
+                if lease_mode:
+                    # election takes its own locks (it rpcs the group)
+                    if not self._maybe_elect("dataplane:" + kind):
+                        resp = {
+                            "ok": False, "not_primary": True,
                             "error": "endpoint %s is a backup (index "
-                            "%d, caught_up=%s, round %d vs client "
-                            "round %s), not the primary"
-                            % (self._own_endpoint, self._index,
-                               self._caught_up, self._applied_round,
+                            "%d) awaiting lease expiry/quorum"
+                            % (self._own_endpoint, self._index)}
+                        if expired or int(msg.get("fo", 0)) >= 1:
+                            # a failed-over client should WAIT here
+                            # (its old primary is dead to it) instead
+                            # of burning failover budget on redirects
+                            with self._lock:
+                                left = (self._lease_deadline
+                                        - time.monotonic()) * 1e3
+                            resp["lease_wait_ms"] = max(
+                                min(left, 1000.0),
+                                self._lease_s * 250.0)
+                        return resp, b""
+                else:
+                    with self._lock:
+                        if not self._active_role():
+                            self._promote_locked(kind)
+            else:
+                return {"ok": False, "not_primary": True,
+                        "error": "endpoint %s is a backup (index "
+                        "%d, caught_up=%s, round %d vs client "
+                        "round %s), not the primary"
+                        % (self._own_endpoint, self._index,
+                           self._caught_up, self._applied_round,
+                           msg.get("round"))}, b""
+        if kind in self._DATAPLANE and self._active_role():
+            # even an ACTIVE primary must refuse a client that has
+            # OBSERVED a newer round than it holds: a backup that fell
+            # off the replication stream and later won a tombstone
+            # election (its only voter being the dead primary) would
+            # otherwise silently regress params. Better no primary —
+            # loud failure — than a stale one.
+            with self._lock:
+                if int(msg.get("round", 0)) > self._applied_round:
+                    return {"ok": False, "not_primary": True,
+                            "error": "endpoint %s is at round %d but "
+                            "the client observed round %s — refusing "
+                            "to serve stale params"
+                            % (self._own_endpoint, self._applied_round,
                                msg.get("round"))}, b""
-                if not self._active_role():
-                    self._promote_locked(kind)
         if "trainer_id" in msg:
             tid = int(msg["trainer_id"])
             if self._evict_after > 0 and not self._clock_started:
@@ -737,6 +1218,7 @@ class PSServer:
                 self._readmit(tid)
         if kind == "send_grad":
             arr = _array_from(msg["array"], raw)
+            extra = {}
             with self._lock:
                 if self._sync:
                     self._pending.setdefault(
@@ -748,7 +1230,11 @@ class PSServer:
                     sub = self._grad_to_block.get(msg["name"])
                     if sub is not None:
                         self._executor.run_block(sub, self._scope)
-            return {"ok": True}, b""
+                    # a dense async update may touch any row of any
+                    # var through its block: full-var diff takes over
+                    self._dirty_rows.clear()
+                    extra = self._async_tick_locked()
+            return dict({"ok": True}, **extra), b""
         if kind == "send_barrier":
             with self._lock:
                 # gate round N+1 on round N being fully fetched
@@ -818,9 +1304,10 @@ class PSServer:
             vals = _array_from(vh, raw[nrows_bytes:])
             from ..core.tensor import LoDTensor, SelectedRows
 
+            extra = {}
             with self._lock:
-                tbl = self._executor._read_var(self._scope,
-                                               msg.get("param", ""))
+                pname = msg.get("param", "")
+                tbl = self._executor._read_var(self._scope, pname)
                 height = (int(np.asarray(tbl).shape[0])
                           if tbl is not None else int(rows.max()) + 1)
                 sr = SelectedRows(rows=rows.tolist(), height=height)
@@ -829,7 +1316,15 @@ class PSServer:
                 sub = self._grad_to_block.get(msg["name"])
                 if sub is not None:
                     self._executor.run_block(sub, self._scope)
-            return {"ok": True}, b""
+                if pname:
+                    # pslib sparse optimize blocks are row-local: the
+                    # touched rows are exactly the pushed rows, so the
+                    # next delta round can ship a row SLICE of the
+                    # table instead of the whole thing
+                    self._dirty_rows.setdefault(pname, set()).update(
+                        int(r) for r in rows)
+                extra = self._async_tick_locked()
+            return dict({"ok": True}, **extra), b""
         if kind == "checkpoint":
             # checkpoint_notify_op.cc: snapshot every servable var into
             # the requested directory (reference tensor-stream format)
@@ -838,23 +1333,55 @@ class PSServer:
                                       msg.get("dir", ""))
             return {"ok": True}, b""
         if kind == "replicate":
-            # primary -> backup round stream: post-round blobs + the
-            # dedup watermark, applied atomically with a round-state
-            # reset so a promotion right after is a clean round start
+            # primary -> backup round stream: post-round blobs (full
+            # anchor or changed-vars/rows delta) + the dedup watermark,
+            # applied atomically with a round-state reset so a
+            # promotion right after is a clean round start. The rpc
+            # doubles as a lease renewal (it proves the primary
+            # lives); a lower-epoch sender is fenced.
             if self._active_role():
                 return {"ok": False, "error":
                         "replicate sent to the active primary %s"
                         % self._own_endpoint}, b""
+            mode = msg.get("repl_mode", "full")
             off = 0
             with self._lock:
+                epoch = int(msg.get("epoch", 0))
+                if epoch < self._seen_epoch:
+                    # ok=True: the rpc worked — the VERDICT is fenced,
+                    # and the stale primary must read it, not retry
+                    return {"ok": True, "fenced": True,
+                            "epoch": self._seen_epoch}, b""
+                self._refresh_lease_locked(epoch)
+                if mode == "delta" and (
+                        not self._caught_up
+                        or int(msg.get("repl_base_round", -1))
+                        != self._applied_round):
+                    # can't apply a delta we don't have the base for
+                    # (freshly rejoined / missed rounds): ask for a
+                    # full re-anchor instead of silently diverging
+                    return {"ok": True, "repl_gap": True,
+                            "round": self._applied_round}, b""
                 for h in msg.get("vars", []):
                     n = int(np.dtype(h["dtype"]).itemsize
                             * int(np.prod(h["shape"]) if h["shape"]
                                   else 1))
-                    self._executor._write_var(
-                        self._scope, h["name"],
-                        _array_from(h, raw[off:off + n]))
+                    arr = _array_from(h, raw[off:off + n])
                     off += n
+                    rows = h.get("rows")
+                    if rows is None:
+                        self._executor._write_var(self._scope,
+                                                  h["name"], arr)
+                    else:
+                        # row SLICE of a sparse table: splice into the
+                        # resident copy (the anchor shipped the rest)
+                        tbl = np.array(np.asarray(
+                            self._executor._read_var(self._scope,
+                                                     h["name"])),
+                            copy=True)
+                        tbl[np.asarray(rows, dtype=np.int64)] = arr
+                        self._executor._write_var(self._scope,
+                                                  h["name"], tbl)
                 # NB "round" is the dedup-token key _call stamps on
                 # every message — the payload round travels separately
                 self._applied_round = int(msg["repl_round"])
@@ -867,13 +1394,59 @@ class PSServer:
                 self._round_complete = True
                 self._fetches_pending = False
                 self._caught_up = True
-            _flight.record("ps.replicated", round=self._applied_round)
+            _flight.record("ps.replicated", round=self._applied_round,
+                           mode=mode)
             return {"ok": True, "round": self._applied_round}, b""
+        if kind == "lease_renew":
+            with self._lock:
+                epoch = int(msg.get("epoch", 0))
+                if epoch < self._seen_epoch or (
+                        self._active_role() and epoch < self._epoch):
+                    return {"ok": False, "fenced": True,
+                            "epoch": max(self._seen_epoch,
+                                         self._epoch)}, b""
+                if self._active_role() and epoch > self._epoch:
+                    # a legitimately elected higher-epoch primary is
+                    # renewing at us: we are the stale one
+                    self._demote_locked(epoch, "renewal from higher-"
+                                        "epoch primary %s"
+                                        % msg.get("frm"))
+                self._refresh_lease_locked(epoch)
+                return {"ok": True, "round": self._applied_round,
+                        "epoch": self._seen_epoch}, b""
+        if kind == "vote":
+            with self._lock:
+                epoch = int(msg.get("epoch", 0))
+                cand_round = int(msg.get("cand_round", -1))
+                granted = (self._lease_mode()
+                           and not self._active_role()
+                           and self._lease_expired_locked()
+                           and epoch > max(self._promised_epoch,
+                                           self._seen_epoch,
+                                           self._epoch)
+                           and cand_round >= self._applied_round)
+                if granted:
+                    self._promised_epoch = epoch
+                resp = {"ok": True, "granted": granted,
+                        "round": self._applied_round,
+                        "epoch": self._seen_epoch,
+                        "active": self._active_role()}
+            _flight.record("ps.vote", candidate=msg.get("candidate"),
+                           epoch=int(msg.get("epoch", 0)),
+                           granted=bool(resp["granted"]),
+                           voter=self._own_endpoint)
+            return resp, b""
         if kind == "repl_status":
-            return {"ok": True, "active": self._active_role(),
-                    "caught_up": self._caught_up,
-                    "round": self._applied_round,
-                    "index": self._index}, b""
+            with self._lock:
+                return {"ok": True, "active": self._active_role(),
+                        "caught_up": self._caught_up,
+                        "round": self._applied_round,
+                        "index": self._index,
+                        "epoch": self._epoch,
+                        "seen_epoch": self._seen_epoch,
+                        "lease_expired": (self._lease_mode()
+                                          and self._lease_expired_locked()
+                                          )}, b""
         if kind == "join_backup":
             # a relaunched server catching up: snapshot the scope into
             # its directory AND splice it back into the replication
@@ -895,7 +1468,7 @@ class PSServer:
                 if ep:
                     self._repl_dead.discard(ep)
                 return {"ok": True, "round": self._applied_round,
-                        "watermark": wm}, b""
+                        "watermark": wm, "epoch": self._epoch}, b""
         if kind == "heartbeat":
             with self._lock:
                 evicted = sorted(self._evicted)
@@ -993,7 +1566,7 @@ class PSServer:
                 # dict insertion order doubles as the LRU order:
                 # re-insert on every update so the oldest entry is
                 # the longest-idle client
-                self._last_seq.pop(cid, None)
+                prev_seq = int(self._last_seq.pop(cid, 0))
                 self._last_seq[cid] = int(seq)
                 ev = threading.Event()
                 self._dedupe[cid] = [key, ev, None, b"", time.time()]
@@ -1016,6 +1589,22 @@ class PSServer:
         except Exception as e:
             resp, rraw = {"ok": False, "error": "%s: %s"
                           % (type(e).__name__, e)}, b""
+        if isinstance(resp, dict) and resp.get("not_primary"):
+            # a redirect is NOT an execution: un-record the token so a
+            # client's lease-wait retry of the SAME rpc re-runs the
+            # handler once this server promotes — a cached redirect
+            # would poison every retry of that token forever
+            with self._dedupe_lock:
+                ent = self._dedupe.get(cid)
+                if ent is not None and ent[0] == key:
+                    del self._dedupe[cid]
+                if self._last_seq.get(cid) == int(seq):
+                    if prev_seq:
+                        self._last_seq[cid] = prev_seq
+                    else:
+                        self._last_seq.pop(cid, None)
+            ev.set()
+            return resp, rraw
         with self._dedupe_lock:
             ent = self._dedupe.get(cid)
             if ent is not None and ent[0] == key:
@@ -1214,15 +1803,26 @@ class PSClient:
             "PADDLE_PS_FAILOVER_MAX",
             str(2 * max(0, len(self._endpoints) - 1))))
         self._failover_count = 0  # the "fo" epoch carried on every rpc
-        # non-idempotent rpcs of the round in flight, with their
-        # stamped dedup tokens — replayed verbatim on a failover;
-        # cleared when a send_barrier succeeds (the round is then
-        # applied AND replicated, so its effects survive the primary).
-        # Bounded: ASYNC mode never sends barriers, so without a cap
-        # the log would grow with every gradient of the job — async
-        # failover is best-effort (a documented gap), and the oldest
-        # entries age out instead of leaking memory
-        self._replay_log: List[tuple] = []
+        # non-idempotent rpcs in flight, with their stamped dedup
+        # tokens — replayed verbatim on a failover. Entries are
+        # [msg, raw, pending_round]: SYNC entries clear when the
+        # round's barrier commits (the round is then applied AND
+        # replicated on every shard the caller barriers); ASYNC
+        # entries are round-gated — the server's ack tags each op with
+        # the replication round that will carry it (pending_round) and
+        # reports the last replicated round (durable_round), and an
+        # entry is pruned only once its round is durable, making a
+        # failover mid-async-push exactly-once (ISSUE 8; the cap below
+        # is now a safety net, not the contract)
+        self._replay_log: List[list] = []
+        # sharded mode: the ShardedPSClient owns phase 2 of the round
+        # barrier — this shard's log survives until EVERY shard acked
+        self._defer_barrier_commit = False
+        # total seconds per call a client will wait at a mid-promotion
+        # backup (lease_wait_ms hints) before treating it as one more
+        # failover hop
+        self._lease_wait_s = float(
+            os.environ.get("PADDLE_PS_LEASE_WAIT_S", "20"))
         self._replay_cap = int(
             os.environ.get("PADDLE_PS_REPLAY_LOG_CAP", "1024"))
         self._replay_overflowed = False
@@ -1502,22 +2102,38 @@ class PSClient:
             msg["round"] = self._round
             msg["fo"] = self._failover_count
             self._stamp_trace(msg)
+            entry = None
             if (len(self._endpoints) > 1 and msg["kind"] in
                     ("send_grad", "send_barrier", "push_sparse")):
-                self._replay_log.append((dict(msg), bytes(raw)))
+                entry = [dict(msg), bytes(raw), None]
+                self._replay_log.append(entry)
                 if len(self._replay_log) > self._replay_cap:
                     self._replay_log.pop(0)
                     if not self._replay_overflowed:
                         self._replay_overflowed = True
                         print("[ps_rpc] replay log exceeded %d entries"
-                              " (async mode?); oldest rpcs age out — a"
-                              " failover replay will be PARTIAL (raise"
-                              " PADDLE_PS_REPLAY_LOG_CAP if sync"
-                              " rounds are really this large)"
+                              " despite round-gated pruning; oldest"
+                              " rpcs age out — a failover replay will"
+                              " be PARTIAL (raise"
+                              " PADDLE_PS_REPLAY_LOG_CAP, or lower the"
+                              " server's PADDLE_PS_ASYNC_REPL_EVERY)"
                               % self._replay_cap,
                               file=sys.stderr, flush=True)
             resp, resp_raw = self._issue(msg, raw)
-            if msg["kind"] == "send_barrier" and resp.get("ok"):
+            if entry is not None and isinstance(resp, dict) \
+                    and resp.get("pending_round") is not None:
+                # async ack: the op rides this replication round
+                entry[2] = int(resp["pending_round"])
+            if isinstance(resp, dict) \
+                    and resp.get("durable_round") is not None:
+                # rounds <= durable_round are replicated: their ops
+                # survive the primary and never need replaying
+                dr = int(resp["durable_round"])
+                self._replay_log = [
+                    e for e in self._replay_log
+                    if e[2] is None or e[2] > dr]
+            if (msg["kind"] == "send_barrier" and resp.get("ok")
+                    and not self._defer_barrier_commit):
                 # the barrier returned => the round is applied AND
                 # replicated: its effects survive a primary death, so
                 # nothing before this point ever needs replaying
@@ -1542,16 +2158,30 @@ class PSClient:
         attempts = 0
         failovers = 0
         delay = self._backoff_base
+        wait_budget = self._lease_wait_s
         last_err: Optional[Exception] = None
         while True:
             try:
                 resp, resp_raw = self._attempt(msg, raw)
                 if isinstance(resp, dict) and resp.get("not_primary"):
-                    raise _NotPrimary(
+                    e = _NotPrimary(
                         "pserver %s is not the primary (%s)"
                         % (self._endpoint, resp.get("error")))
+                    e.wait_ms = resp.get("lease_wait_ms")
+                    raise e
                 return resp, resp_raw
             except _NotPrimary as e:
+                wait_ms = getattr(e, "wait_ms", None)
+                if wait_ms and wait_budget > 0:
+                    # the backup is mid-promotion (waiting out the
+                    # dead primary's lease / gathering its quorum):
+                    # hold HERE instead of burning failover budget on
+                    # redirect loops — bounded by the wait budget
+                    dt = min(float(wait_ms) / 1e3, 0.3)
+                    wait_budget -= dt
+                    time.sleep(dt)
+                    attempts, delay = 0, self._backoff_base
+                    continue
                 # a redirect, not a transport failure: advance without
                 # burning the retry budget
                 last_err = e
@@ -1617,16 +2247,33 @@ class PSClient:
                        cause=type(cause).__name__,
                        redirect=bool(redirect))
         last: Exception = cause
-        for k in range(1, n):
+        wait_budget = self._lease_wait_s
+        k = 1
+        while k < n:
             self._ep_idx = (start + k) % n
             self._drop_sock()
             try:
                 self._sock = self._connect(
                     timeout=self._failover_connect)
                 self._replay()
+            except _NotPrimary as e:
+                wait_ms = getattr(e, "wait_ms", None)
+                if wait_ms and wait_budget > 0:
+                    # the replay target is mid-promotion: wait it out
+                    # on THIS endpoint instead of walking on (the rest
+                    # of the list is the dead primary)
+                    dt = min(float(wait_ms) / 1e3, 0.3)
+                    wait_budget -= dt
+                    time.sleep(dt)
+                    continue
+                last = e
+                self._drop_sock()
+                k += 1
+                continue
             except (_RetryableRPC, RuntimeError, OSError) as e:
                 last = e
                 self._drop_sock()
+                k += 1
                 continue
             _counter("ps.failovers",
                      cause="redirect" if redirect else "transport").inc()
@@ -1663,7 +2310,7 @@ class PSClient:
         re-executing; the rest rebuild the in-flight round."""
         _flight.record("rpc.replay", n=len(self._replay_log),
                        ep=self._endpoint)
-        for m, r in list(self._replay_log):
+        for m, r, _pending in list(self._replay_log):
             m["fo"] = self._failover_count
             delay = self._backoff_base
             for attempt in range(self._max_retries + 1):
@@ -1681,9 +2328,11 @@ class PSClient:
                     time.sleep(delay * (0.5 + self._jitter.random()))
                     delay = min(delay * 2.0, self._backoff_cap)
             if resp.get("not_primary"):
-                raise _NotPrimary(
+                e = _NotPrimary(
                     "pserver %s refused the failover replay"
                     % self._endpoint)
+                e.wait_ms = resp.get("lease_wait_ms")
+                raise e
             if not (resp.get("ok") or resp.get("replayed")
                     or resp.get("stale")):
                 raise RuntimeError(
@@ -1696,7 +2345,24 @@ class PSClient:
                     "array": _array_header(arr)}, arr.tobytes())
 
     def send_barrier(self) -> None:
+        self.barrier_prepare()
+        self._round += 1
+
+    def barrier_prepare(self) -> None:
+        """Phase 1 of the two-phase round barrier: issue the barrier
+        rpc. With ``_defer_barrier_commit`` set (sharded mode) the
+        replay log SURVIVES this shard's ack — the round is durable
+        only when every shard acked, so a sister shard's failover can
+        still replay this round here (the dedup watermark makes that
+        exactly-once). Single-group clients clear on ack as before."""
         self._call({"kind": "send_barrier"})
+
+    def barrier_commit(self) -> None:
+        """Phase 2 (sharded mode): every shard acked its barrier — the
+        round is durable everywhere, drop the replay log and advance
+        the round."""
+        with self._io_lock:
+            self._replay_log.clear()
         self._round += 1
 
     def get_param(self, name: str) -> np.ndarray:
@@ -1733,12 +2399,23 @@ class PSClient:
         self._call({"kind": "checkpoint", "dir": dirname})
 
     def replicate(self, round_no: int, var_headers: List[dict],
-                  raw: bytes, watermark: Dict[str, int]) -> None:
-        """Primary-side: ship one applied round (post-round blobs +
-        dedup watermark) to the backup this client points at; returns
-        only on the backup's ack."""
-        self._call({"kind": "replicate", "repl_round": int(round_no),
-                    "vars": var_headers, "watermark": watermark}, raw)
+                  raw: bytes, watermark: Dict[str, int],
+                  mode: str = "full",
+                  base_round: Optional[int] = None,
+                  epoch: int = 0) -> dict:
+        """Primary-side: ship one applied round (full anchor or
+        changed-vars/rows delta + dedup watermark) to the backup this
+        client points at; returns the backup's ack — which may carry
+        ``repl_gap`` (re-anchor me) or ``fenced`` (a newer epoch
+        rules; demote yourself)."""
+        resp, _ = self._call(
+            {"kind": "replicate", "repl_round": int(round_no),
+             "vars": var_headers, "watermark": watermark,
+             "repl_mode": mode,
+             "repl_base_round": (-1 if base_round is None
+                                 else int(base_round)),
+             "epoch": int(epoch)}, raw)
+        return resp
 
     def repl_status(self) -> dict:
         """role/round probe: ``{"active":, "caught_up":, "round":}``."""

@@ -1,0 +1,246 @@
+"""Key-range sharded parameter server (ISSUE 8).
+
+One primary+backup chain holds the whole parameter space in
+``ps_rpc.py``; at GB scale both capacity and apply throughput need to
+scale horizontally. This module partitions the parameter space by key
+range across multiple independent server GROUPS — the reference's
+key-range-sliced sparse tables (PAPER.md §distributed), lifted to the
+whole PS:
+
+- **groups**: ``PADDLE_PSERVER_ENDPOINTS`` lists every endpoint;
+  ``PADDLE_PSERVER_SHARDS=N`` slices it into N contiguous groups, each
+  its own primary + backup chain with independent replication,
+  lease-based promotion, and failover (``ps_rpc.PSServer`` is
+  oblivious — each server sees only its group). The launch supervisor
+  computes the slicing and hands every server its group
+  (``PADDLE_PSERVER_SHARD`` = group index, ``PADDLE_PSERVER_ENDPOINTS``
+  = the group's list) and every trainer the full list + shard count.
+- **routing**: dense vars route by a RANGE partition of the hashed
+  128-bit keyspace (``shard_for_key`` — stable across processes, and a
+  var's ``@GRAD`` / ``@``-suffixed companions follow their base var so
+  a grad always lands where its param lives). Sparse row ids route by
+  contiguous row RANGE (``shard_for_rows`` — shard ``s`` owns global
+  rows ``[s*H/N, (s+1)*H/N)``), each shard holding its slice with
+  LOCAL row ids, exactly the reference's sliced-table layout.
+- **two-phase round barrier**: a sync round is durable only when EVERY
+  shard has acked it. Phase 1 issues each shard's ``send_barrier`` in
+  parallel (each blocks until that shard applied AND replicated its
+  round); only when all acked does phase 2 commit — clearing each
+  sub-client's replay log and advancing its round. A single shard's
+  primary dying mid-round therefore cannot lose any other shard's
+  round (their logs still hold it, and the per-shard replicated dedup
+  watermark makes any replay exactly-once) nor double-apply its own.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .ps_rpc import PSClient
+
+__all__ = ["shard_for_key", "shard_for_rows", "row_range",
+           "split_endpoint_groups", "ShardedPSClient",
+           "client_from_env", "shards_from_env"]
+
+
+def shard_for_key(name: str, nshards: int) -> int:
+    """Range partition of the hashed keyspace: md5(base_name) as a
+    128-bit int, split into ``nshards`` equal ranges. A ``@``-suffixed
+    name (``w@GRAD``, ``w@MOMENTUM``) routes by its BASE var so every
+    companion of a param lands on the param's shard."""
+    if nshards <= 1:
+        return 0
+    base = name.split("@", 1)[0]
+    h = int.from_bytes(hashlib.md5(base.encode("utf-8")).digest(),
+                       "big")
+    return (h * int(nshards)) >> 128
+
+
+def row_range(shard: int, height: int, nshards: int) -> tuple:
+    """Global row range [start, stop) owned by ``shard`` of a
+    height-``height`` table."""
+    return (shard * height // nshards, (shard + 1) * height // nshards)
+
+
+def shard_for_rows(rows, height: int, nshards: int) -> np.ndarray:
+    """Shard index per global row id (contiguous range partition)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    bounds = np.array([row_range(s, height, nshards)[0]
+                       for s in range(1, nshards)], dtype=np.int64)
+    return np.searchsorted(bounds, rows, side="right")
+
+
+def split_endpoint_groups(endpoints: List[str],
+                          nshards: int) -> List[List[str]]:
+    """Slice the flat endpoint list into ``nshards`` contiguous
+    primary+backup groups (every group the same depth — the launch
+    contract)."""
+    eps = [e.strip() for e in endpoints if e.strip()]
+    n = int(nshards)
+    if n <= 1:
+        return [eps]
+    if not eps or len(eps) % n != 0:
+        raise ValueError(
+            "PADDLE_PSERVER_SHARDS=%d needs an endpoint count "
+            "divisible by it, got %d endpoints %s"
+            % (n, len(eps), eps))
+    depth = len(eps) // n
+    return [eps[i * depth:(i + 1) * depth] for i in range(n)]
+
+
+def shards_from_env() -> int:
+    return max(1, int(os.environ.get("PADDLE_PSERVER_SHARDS", "1")))
+
+
+def client_from_env(trainer_id: int = 0,
+                    endpoints: Optional[str] = None):
+    """The right client for the env contract: a plain (possibly
+    replicated) ``PSClient`` for one group, a ``ShardedPSClient`` when
+    ``PADDLE_PSERVER_SHARDS`` > 1."""
+    raw = endpoints if endpoints is not None else os.environ.get(
+        "PADDLE_PSERVER_ENDPOINTS", "")
+    eps = [e.strip() for e in str(raw).split(",") if e.strip()]
+    n = shards_from_env()
+    if n <= 1:
+        return PSClient.for_endpoint(",".join(eps),
+                                     trainer_id=trainer_id)
+    groups = split_endpoint_groups(eps, n)
+    return ShardedPSClient([",".join(g) for g in groups],
+                           trainer_id=trainer_id)
+
+
+class ShardedPSClient:
+    """Routes the ``PSClient`` surface across N shard groups; each
+    group gets its own ``PSClient`` with its own endpoint chain,
+    replay log, and failover — one shard's death never touches the
+    others' connections. Barriers are two-phase (module docstring)."""
+
+    def __init__(self, shard_endpoints: List[str],
+                 trainer_id: Optional[int] = 0, **client_kw):
+        if not shard_endpoints:
+            raise ValueError("ShardedPSClient needs >= 1 shard group")
+        self._trainer_id = trainer_id
+        self.shards: List[PSClient] = []
+        for eps in shard_endpoints:
+            c = PSClient(eps, trainer_id=trainer_id, **client_kw)
+            # phase 2 of the round barrier belongs to THIS router
+            c._defer_barrier_commit = True
+            self.shards.append(c)
+
+    @property
+    def nshards(self) -> int:
+        return len(self.shards)
+
+    def shard_of(self, name: str) -> int:
+        return shard_for_key(name, self.nshards)
+
+    def client_for(self, name: str) -> PSClient:
+        return self.shards[self.shard_of(name)]
+
+    # -- dense path -------------------------------------------------------
+
+    def send_grad(self, name: str, value) -> None:
+        self.client_for(name).send_grad(name, value)
+
+    def get_param(self, name: str) -> np.ndarray:
+        return self.client_for(name).get_param(name)
+
+    def _all_shards(self, fn, what: str) -> List:
+        """Run ``fn(client)`` on every shard in parallel and return
+        the per-shard results; the FIRST failure (by shard index)
+        propagates after every thread finished — never a half-joined
+        round."""
+        results: List = [None] * self.nshards
+        errors: List = [None] * self.nshards
+
+        def run(i, c):
+            try:
+                results[i] = fn(c)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors[i] = e
+
+        threads = [threading.Thread(
+            target=run, args=(i, c),
+            name="ps-shard-%s-%d" % (what, i), daemon=True)
+            for i, c in enumerate(self.shards)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for e in errors:
+            if e is not None:
+                raise e
+        return results
+
+    def send_barrier(self) -> None:
+        """Two-phase round barrier: every shard must ack (apply +
+        replicate) its round before ANY shard's replay log drops it —
+        a single shard's death mid-round loses nothing and
+        double-applies nothing."""
+        self._all_shards(lambda c: c.barrier_prepare(), "prepare")
+        for c in self.shards:
+            c.barrier_commit()
+
+    def fetch_barrier(self) -> None:
+        self._all_shards(lambda c: c.fetch_barrier(), "fetch")
+
+    # -- sparse path (key-range-sliced tables) ----------------------------
+
+    def pull_sparse(self, name: str, row_ids, height: int) -> np.ndarray:
+        """Pull value rows for GLOBAL row ids: split by row range,
+        pull each shard's slice with LOCAL ids, reassemble in request
+        order."""
+        ids = np.asarray(row_ids, dtype=np.int64).reshape(-1)
+        if not len(ids):
+            # shard 0 answers the empty pull so shape/dtype still come
+            # from the real table (the non-sharded client's behavior)
+            return self.shards[0].pull_sparse(name, ids)
+        owner = shard_for_rows(ids, height, self.nshards)
+        parts: Dict[int, np.ndarray] = {}
+        for s in range(self.nshards):
+            pos = np.nonzero(owner == s)[0]
+            if not len(pos):
+                continue
+            start = row_range(s, height, self.nshards)[0]
+            parts[s] = (pos,
+                        self.shards[s].pull_sparse(name,
+                                                   ids[pos] - start))
+        first = next(iter(parts.values()))[1]
+        out = np.empty((len(ids),) + first.shape[1:], dtype=first.dtype)
+        for pos, vals in parts.values():
+            out[pos] = vals
+        return out
+
+    def push_sparse(self, name: str, rows, values, height: int,
+                    param: str = "") -> None:
+        """Push (global row ids, grad rows) split by row range; each
+        shard applies its slice immediately (async, row-local)."""
+        ids = np.asarray(rows, dtype=np.int64).reshape(-1)
+        vals = np.asarray(values)
+        owner = shard_for_rows(ids, height, self.nshards)
+        for s in range(self.nshards):
+            pos = np.nonzero(owner == s)[0]
+            if not len(pos):
+                continue
+            start = row_range(s, height, self.nshards)[0]
+            self.shards[s].push_sparse(name, ids[pos] - start,
+                                       vals[pos], param=param)
+
+    # -- plumbing ---------------------------------------------------------
+
+    def heartbeat_full(self) -> List[dict]:
+        """Per-shard heartbeat responses (index-aligned)."""
+        return self._all_shards(lambda c: c.heartbeat_full(),
+                                "heartbeat")
+
+    def start_heartbeat(self, interval_s: float = 1.0) -> None:
+        for c in self.shards:
+            c.start_heartbeat(interval_s)
+
+    def close(self) -> None:
+        for c in self.shards:
+            c.close()

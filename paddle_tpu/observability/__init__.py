@@ -45,9 +45,15 @@ Metric families (see README "Runtime observability"):
 ``ps.catchup_ms``                      histogram: rejoin snapshot catch-up
 ``ps.replication_lag_rounds{backup=}`` gauge: rounds the backup is behind
                                        (0 after each ack; frozen = dropped)
+``ps.replication_bytes{mode=}``        counter: shipped payload, full | delta
+``ps.delta_rounds`` / ``ps.anchor_rounds``  counter: delta vs full-anchor ships
+``ps.lease_renewals``                  counter: primary lease renewal acks
+``ps.lease_expiries{shard=}``          counter: backup lease-view expiries
 ``fault.injected{side=,kind=}``        counter: injected RPC-frame faults
 ``checkpoint.save_ms``                 histogram: atomic checkpoint commit
 ``checkpoint.bytes``                   counter: checkpointed payload bytes
+``checkpoint.delta_bytes``             counter: incremental-save fresh bytes
+``checkpoint.shards_reused``           counter: shards linked from prev ckpt
 ``checkpoint.corrupt``                 counter: rotations failing sha256
 =====================================  ======================================
 

@@ -272,8 +272,12 @@ def set_identity(role: str, rank: int) -> None:
 def process_identity() -> Tuple[str, int, int]:
     """(role, rank, restart) for dump naming. Role comes from the
     launch env contract (``PADDLE_ROLE`` / ``FT_ROLE``), rank from
-    ``PADDLE_PSERVER_INDEX`` (servers) or ``PADDLE_TRAINER_ID``;
-    a process outside any launcher is ``proc-<pid>``."""
+    ``PADDLE_PSERVER_GLOBAL_INDEX`` (sharded jobs: the index in the
+    FULL endpoint list — per-group ``PADDLE_PSERVER_INDEX`` repeats
+    across shards and two servers must never clobber each other's
+    dumps) falling back to ``PADDLE_PSERVER_INDEX`` (servers), or
+    ``PADDLE_TRAINER_ID``; a process outside any launcher is
+    ``proc-<pid>``."""
     restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0") or 0)
     if _identity is not None:
         return _identity[0], _identity[1], restart
@@ -281,7 +285,9 @@ def process_identity() -> Tuple[str, int, int]:
     if not role:
         return "proc", os.getpid(), restart
     if role == "pserver":
-        rank = int(os.environ.get("PADDLE_PSERVER_INDEX", "0") or 0)
+        rank = int(os.environ.get("PADDLE_PSERVER_GLOBAL_INDEX")
+                   or os.environ.get("PADDLE_PSERVER_INDEX", "0")
+                   or 0)
     else:
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
     return str(role), rank, restart

@@ -2,24 +2,36 @@
 
 Role from PADDLE_ROLE (the launch supervisor sets it) or FT_ROLE:
 
-- ``pserver`` — serve a single dense param "w" (4 floats, SGD lr 0.1)
-  behind the RunSyncLoop round protocol with heartbeat eviction armed
+- ``pserver`` — serve dense params (SGD lr 0.1) behind the
+  RunSyncLoop round protocol with heartbeat eviction armed
   (PADDLE_PS_EVICT_AFTER); blocks until a shutdown rpc or SIGTERM.
-  Multi-server mode: PADDLE_PSERVER_ENDPOINTS (full ordered list) +
+  Multi-server mode: PADDLE_PSERVER_ENDPOINTS (this server's GROUP) +
   PSERVER_ENDPOINT (own) make index 0 the replication primary and the
   rest backups; PADDLE_PS_REJOIN=1 (launcher, on relaunch) rejoins as
-  a catching-up backup. FT_SERVER_DIE_AT_ROUND makes the INITIAL
-  PRIMARY SIGKILL itself while applying that round (grads in, round
-  applied locally, never replicated — the worst spot) on its first
-  incarnation — the server-death failover scenario.
+  a catching-up backup. Sharded mode (ISSUE 8):
+  PADDLE_PSERVER_SHARDS + PADDLE_PSERVER_SHARD — each shard serves
+  the one var that routes to it (ps_shard.shard_for_key), so the
+  2-shard drills exercise real key-range partitioning.
+  FT_SERVER_DIE_AT_ROUND makes the INITIAL PRIMARY of shard
+  FT_DIE_SHARD (default 0) SIGKILL itself while applying that round
+  (grads in, round applied locally, never replicated — the worst
+  spot) on its first incarnation. Every server also holds a STATIC
+  ``ballast`` var (FT_BALLAST_FLOATS float32s, default 4096): it
+  rides full anchors but never a delta, so the drills can assert
+  ``ps.replication_bytes{mode=delta}`` strictly below the full-blob
+  bytes for the same workload.
 - ``trainer`` — FT_ROUNDS sync rounds of deterministic grads against
   the live server(s), checkpointing after every completed round via
-  CheckpointManager (atomic + rotated), resuming from the newest valid
-  checkpoint on restart. FT_DIE_AT_ROUND + FT_DIE_RANK make one rank
-  SIGKILL itself mid-round (after send_grad, before the barrier) on
-  its first incarnation — the supervised-relaunch scenario.
-  PSERVER_ENDPOINT may be the comma-separated endpoint list —
-  PSClient fails over along it.
+  CheckpointManager.save_incremental (atomic + rotated; the static
+  ballast shard is fingerprint-reused so ``checkpoint.delta_bytes`` /
+  ``checkpoint.shards_reused`` are exercised end to end), resuming
+  from the newest valid checkpoint on restart. FT_DIE_AT_ROUND +
+  FT_DIE_RANK make one rank SIGKILL itself mid-round (after
+  send_grad, before the barrier) on its first incarnation.
+  PSERVER_ENDPOINT may be a comma-separated endpoint list (PSClient
+  fails over along it); with PADDLE_PSERVER_SHARDS > 1 the trainer
+  routes through ps_shard.client_from_env and runs the TWO-PHASE
+  round barrier across shards.
 
 Env contract: PSERVER_ENDPOINT, PADDLE_TRAINER_ID (the launcher sets
 it), PADDLE_RESTART_COUNT (launcher, on relaunch), FT_OUT (result JSON
@@ -29,6 +41,7 @@ The pserver side needs no framework program: PSServer only asks its
 executor for _read_var/_write_var/run_block, so a dict-scope shim
 keeps worker startup lean.
 """
+import io
 import json
 import os
 import signal
@@ -38,6 +51,8 @@ import numpy as np
 
 from paddle_tpu.checkpoint import CheckpointManager
 from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+from paddle_tpu.distributed.ps_shard import (client_from_env,
+                                             shard_for_key)
 
 LR = 0.1
 DIM = 4
@@ -61,14 +76,45 @@ class MiniExec:
         block(scope)
 
 
-def _sgd_block(scope):
-    scope["w"] = scope["w"] - LR * scope["w@GRAD"]
+def _sgd_block_for(name):
+    def block(scope):
+        scope[name] = scope[name] - LR * scope[name + "@GRAD"]
+    return block
 
 
-def grad_for(tid: int, rnd: int) -> np.ndarray:
-    """Deterministic per-(trainer, round) gradient — survivors and
-    oracles recompute the exact same values."""
-    return np.full(DIM, (tid + 1) * 0.01 * rnd, dtype=np.float32)
+def grad_for(tid: int, rnd: int, var: int = 0) -> np.ndarray:
+    """Deterministic per-(trainer, round, var) gradient — survivors
+    and oracles recompute the exact same values. ``var=0`` keeps the
+    legacy single-var values bit-identical."""
+    return np.full(DIM, (tid + 1) * 0.01 * rnd + var * 0.001,
+                   dtype=np.float32)
+
+
+def _nshards() -> int:
+    return max(1, int(os.environ.get("PADDLE_PSERVER_SHARDS", "1")))
+
+
+def var_names(nshards: int):
+    """One trained var per shard, names chosen so var i ROUTES to
+    shard i (searched deterministically — every process agrees). One
+    shard keeps the legacy name 'w'."""
+    if nshards <= 1:
+        return ["w"]
+    names = []
+    for s in range(nshards):
+        i = 0
+        while True:
+            cand = "w%d" % i
+            if shard_for_key(cand, nshards) == s and cand not in names:
+                names.append(cand)
+                break
+            i += 1
+    return names
+
+
+def _ballast() -> np.ndarray:
+    n = int(os.environ.get("FT_BALLAST_FLOATS", "4096"))
+    return np.zeros(max(0, n), dtype=np.float32)
 
 
 def run_pserver():
@@ -82,27 +128,42 @@ def run_pserver():
     fanin = int(os.environ.get("PADDLE_TRAINERS_NUM", "2"))
     rejoin = os.environ.get("PADDLE_PS_REJOIN") == "1"
     die_round = int(os.environ.get("FT_SERVER_DIE_AT_ROUND", "0"))
+    die_shard = int(os.environ.get("FT_DIE_SHARD", "0"))
     index = endpoints.index(endpoint) if endpoint in endpoints else 0
+    nshards = _nshards()
+    my_shard = int(os.environ.get("PADDLE_PSERVER_SHARD", "0"))
 
     scope = MiniScope()
-    scope["w"] = np.zeros(DIM, dtype=np.float32)
+    grad_to_block = {}
+    for s, name in enumerate(var_names(nshards)):
+        if nshards > 1 and s != my_shard:
+            continue  # key-range partition: not this shard's var
+        scope[name] = np.zeros(DIM, dtype=np.float32)
+        grad_to_block[name + "@GRAD"] = _sgd_block_for(name)
+    # static ballast: in every anchor, never in a delta — the
+    # delta-vs-full evidence the drills gate on
+    scope["ballast"] = _ballast()
 
     applied = {"rounds": 0}
-    suicidal = die_round > 0 and index == 0 and not rejoin
+    suicidal = (die_round > 0 and index == 0 and not rejoin
+                and my_shard == die_shard)
 
-    def _block(scope):
-        _sgd_block(scope)
-        applied["rounds"] += 1
-        if suicidal and applied["rounds"] == die_round:
-            # die while APPLYING the round: grads are summed and the
-            # local optimize ran, but the round was never replicated —
-            # the trainers must rebuild it on the promoted backup from
-            # their replay logs
-            os.kill(os.getpid(), signal.SIGKILL)
+    def _wrap(block):
+        def inner(scope):
+            block(scope)
+            applied["rounds"] += 1
+            if suicidal and applied["rounds"] == die_round:
+                # die while APPLYING the round: grads are summed and
+                # the local optimize ran, but the round was never
+                # replicated — the trainers must rebuild it on the
+                # promoted backup from their replay logs
+                os.kill(os.getpid(), signal.SIGKILL)
+        return inner
 
-    server = PSServer(endpoint, MiniExec(), scope,
-                      {"w@GRAD": _block}, fanin=fanin,
-                      sync_mode=True,
+    grad_to_block = {g: _wrap(b) for g, b in grad_to_block.items()}
+
+    server = PSServer(endpoint, MiniExec(), scope, grad_to_block,
+                      fanin=fanin, sync_mode=True,
                       endpoints=endpoints or None, rejoin=rejoin)
     server.serve_forever()
     server.stop()
@@ -118,6 +179,9 @@ def run_trainer():
     # per-rank result file: the launcher gives every rank the same env
     out_path = "%s.t%d.json" % (os.environ["FT_OUT"], tid)
     ckpt_root = os.environ.get("FT_CKPT_ROOT", "")
+    nshards = _nshards()
+    names = var_names(nshards)
+    ballast_bytes = _ballast().tobytes()
 
     mgr = None
     start = 1
@@ -138,43 +202,77 @@ def run_trainer():
             print("[trainer %d] resumed from checkpoint round %d"
                   % (tid, step), file=sys.stderr, flush=True)
 
-    client = PSClient.for_endpoint(endpoint, trainer_id=tid)
-    w = None
+    if nshards > 1:
+        client = client_from_env(trainer_id=tid)
+    else:
+        client = PSClient.for_endpoint(endpoint, trainer_id=tid)
+    ws = {}
     for rnd in range(start, rounds + 1):
-        client.send_grad("w@GRAD", grad_for(tid, rnd))
+        for vi, name in enumerate(names):
+            client.send_grad(name + "@GRAD", grad_for(tid, rnd, vi))
         if restart == 0 and tid == die_rank and rnd == die_round:
             # mid-round death: grad in, barrier never sent — the
             # worst spot, the server is left waiting on this rank
             os.kill(os.getpid(), signal.SIGKILL)
         client.send_barrier()
-        w = client.get_param("w")
+        ws = {name: client.get_param(name) for name in names}
         client.fetch_barrier()
         if mgr is not None:
-            def _write(d, _w=w, _r=rnd):
-                buf_path = os.path.join(d, "state.npz")
-                np.savez(buf_path, w=_w, round=_r)
-            mgr.save(rnd, _write)
+            buf = io.BytesIO()
+            np.savez(buf, w=ws[names[0]], round=rnd,
+                     **{"v_%s" % n: w for n, w in ws.items()})
+            # the static ballast shard is fingerprint-reused: the
+            # incremental save writes only what changed this round
+            mgr.save_incremental(
+                rnd, {"state.npz": buf.getvalue(),
+                      "ballast.bin": ballast_bytes},
+                fingerprints={"ballast.bin": "static-v1"})
 
-    hb = client.heartbeat_full()
+    if nshards > 1:
+        hbs = client.heartbeat_full()  # per shard, index-aligned
+        hb = hbs[0]
+        shard_info = [
+            {"endpoint": c.endpoint, "ep_idx": c._ep_idx,
+             "failovers": c._failover_count,
+             "server_active": h.get("active"),
+             "server_round": h.get("round"),
+             "server_promotions": h.get("promotions")}
+            for c, h in zip(client.shards, hbs)]
+        ep_idx = client.shards[0]._ep_idx
+        failovers = sum(c._failover_count for c in client.shards)
+        endpoint_now = ",".join(c.endpoint for c in client.shards)
+        evicted = set()
+        for c, h in zip(client.shards, hbs):
+            evicted |= c.evicted_peers | set(h.get("evicted", []))
+    else:
+        hb = client.heartbeat_full()
+        hbs = [hb]
+        shard_info = None
+        ep_idx = client._ep_idx
+        failovers = client._failover_count
+        endpoint_now = client.endpoint
+        evicted = client.evicted_peers | set(hb.get("evicted", []))
     with open(out_path, "w") as f:
         json.dump({
             "tid": tid,
             "rounds_done": rounds - start + 1,
             "resumed_from": resumed_from,
             "restart": restart,
-            "w": np.asarray(w).tolist(),
-            "evicted_peers": sorted(client.evicted_peers
-                                    | set(hb.get("evicted", []))),
+            "w": np.asarray(ws[names[0]]).tolist(),
+            "vars": {n: np.asarray(w).tolist() for n, w in ws.items()},
+            "evicted_peers": sorted(evicted),
             "evictions": hb.get("evictions"),
             "readmissions": hb.get("readmissions"),
             # failover telemetry: which endpoint the client ended on,
             # how many times it advanced, and the serving side's view
-            "endpoint": client.endpoint,
-            "ep_idx": client._ep_idx,
-            "failovers": client._failover_count,
+            "endpoint": endpoint_now,
+            "ep_idx": ep_idx,
+            "failovers": failovers,
             "server_active": hb.get("active"),
             "server_round": hb.get("round"),
-            "server_promotions": hb.get("promotions"),
+            "server_promotions": sum(
+                h.get("promotions") or 0 for h in hbs),
+            "shards": shard_info,
         }, f)
 
 

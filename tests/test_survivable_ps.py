@@ -1,0 +1,757 @@
+"""GB-scale survivable parameter server (ISSUE 8).
+
+Covers: delta replication bit-for-bit against the full-blob path
+(anchors + changed-var deltas + sparse row slices) with its
+``ps.replication_bytes{mode=}`` / ``ps.delta_rounds`` /
+``ps.anchor_rounds`` counters; incremental checkpoints (fingerprint
+and content-hash shard reuse, load parity with full saves, corrupt
+reused-shard fallback, ``checkpoint.delta_bytes`` /
+``checkpoint.shards_reused``); lease-based promotion with quorum
+(renewals keep a backup loyal, a dead primary's tombstone elects the
+backup proactively, a partitioned control plane is quorum-DENIED —
+at most one writable primary, an isolated >=3-group primary demotes
+itself); async-mode round-gated replay (exactly-once across a
+failover mid-async-push); key-range sharding (routing, endpoint
+groups, row ranges, the two-phase round barrier, a shard primary's
+death leaving the sister shard bit-for-bit intact); the ``partition``
+fault primitive; and chaos-schedule determinism for the new modes."""
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _eps(n):
+    return ["127.0.0.1:%d" % _free_port() for _ in range(n)]
+
+
+class MiniScope(dict):
+    def local_var_names(self):
+        return list(self)
+
+
+class MiniExec:
+    def _read_var(self, scope, name):
+        return scope.get(name)
+
+    def _write_var(self, scope, name, val):
+        scope[name] = np.asarray(val)
+
+    def run_block(self, block, scope):
+        block(scope)
+
+
+def _sgd_block(scope, lr=0.1):
+    scope["w"] = scope["w"] - lr * scope["w@GRAD"]
+
+
+def _grad(tid, rnd, dim=4):
+    return np.full(dim, (tid + 1) * 0.01 * rnd, dtype=np.float32)
+
+
+def _fast_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_PS_CONNECT_TIMEOUT", "1")
+    monkeypatch.setenv("PADDLE_PS_FAILOVER_CONNECT_TIMEOUT", "1")
+    monkeypatch.setenv("PADDLE_PS_RPC_RETRIES", "2")
+    monkeypatch.setenv("PADDLE_PS_RPC_BACKOFF_MS", "10")
+    monkeypatch.setenv("PADDLE_PS_RPC_DEADLINE", "20")
+
+
+def _mk_ps(eps, i, fanin=1, sync=True, ballast=0, **kw):
+    from paddle_tpu.distributed.ps_rpc import PSServer
+
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, dtype=np.float32)
+    if ballast:
+        scope["ballast"] = np.zeros(ballast, dtype=np.float32)
+    server = PSServer(eps[i], MiniExec(), scope,
+                      {"w@GRAD": _sgd_block}, fanin=fanin,
+                      sync_mode=sync, endpoints=eps, **kw)
+    server.start_background()
+    return server, scope
+
+
+# -- delta replication -------------------------------------------------------
+
+
+def _train(eps, rounds, tid=0):
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    c = PSClient(",".join(eps), trainer_id=tid)
+    w = None
+    for rnd in range(1, rounds + 1):
+        c.send_grad("w@GRAD", _grad(tid, rnd))
+        c.send_barrier()
+        w = c.get_param("w")
+        c.fetch_barrier()
+    c.close()
+    return w
+
+
+def test_delta_replication_bitwise_vs_full(monkeypatch):
+    """The same 5-round workload replicated twice — anchors-only
+    (anchor_every=1: every round a full blob) vs delta mode
+    (anchor_every=3) — must leave the BACKUP bit-for-bit identical,
+    with the delta run recording delta rounds whose bytes are
+    strictly below the anchors' (the ballast var never changes, so
+    deltas exclude it)."""
+    from paddle_tpu import observability as obs
+
+    _fast_env(monkeypatch)
+
+    def run(anchor_every):
+        eps = _eps(2)
+        s0, sc0 = _mk_ps(eps, 0, ballast=4096,
+                         anchor_every=anchor_every)
+        s1, sc1 = _mk_ps(eps, 1, ballast=4096,
+                         anchor_every=anchor_every)
+        try:
+            _train(eps, rounds=5)
+            np.testing.assert_array_equal(np.asarray(sc0["w"]),
+                                          np.asarray(sc1["w"]))
+            return (np.asarray(sc1["w"]).tobytes(),
+                    np.asarray(sc1["ballast"]).tobytes())
+        finally:
+            s0.stop()
+            s1.stop()
+
+    d0 = obs.counter_value("ps.delta_rounds") or 0
+    a0 = obs.counter_value("ps.anchor_rounds") or 0
+    db0 = obs.counter_value("ps.replication_bytes", mode="delta") or 0
+    fb0 = obs.counter_value("ps.replication_bytes", mode="full") or 0
+    full_run = run(anchor_every=1)
+    anchors_after = (obs.counter_value("ps.anchor_rounds") or 0) - a0
+    assert anchors_after == 5, "anchor_every=1 must ship 5 full blobs"
+    assert (obs.counter_value("ps.delta_rounds") or 0) == d0
+    delta_run = run(anchor_every=3)
+    assert delta_run == full_run, \
+        "delta and full replication must converge bit-for-bit"
+    d_rounds = (obs.counter_value("ps.delta_rounds") or 0) - d0
+    assert d_rounds == 3, \
+        "anchor_every=3 over 5 rounds = anchors at 1,3 + 3 deltas"
+    d_bytes = (obs.counter_value("ps.replication_bytes", mode="delta")
+               or 0) - db0
+    f_bytes = (obs.counter_value("ps.replication_bytes", mode="full")
+               or 0) - fb0
+    assert 0 < d_bytes < f_bytes, (d_bytes, f_bytes)
+    # the per-round delta excludes the 16KB ballast entirely
+    assert d_bytes / d_rounds < 4096 * 4, d_bytes
+
+
+def test_delta_row_slice_for_push_sparse(monkeypatch):
+    """Async push_sparse marks only the touched rows dirty: after the
+    first (anchor) ship, a later push replicates a ROW SLICE of the
+    table — bytes ~ rows touched, not table size — and the backup's
+    table still matches the primary's bit-for-bit."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+    _fast_env(monkeypatch)
+    eps = _eps(2)
+    height, width = 128, 4
+
+    class SparseExec(MiniExec):
+        def _write_var(self, scope, name, val):
+            scope[name] = val  # keep SelectedRows grads un-coerced
+
+    def mk(i):
+        scope = MiniScope()
+        scope["emb"] = np.zeros((height, width), dtype=np.float32)
+
+        def sparse_block(scope):
+            g = scope["emb@GRAD"]
+            rows = np.asarray(g.rows(), dtype=np.int64)
+            vals = np.asarray(g._value)
+            emb = np.array(scope["emb"], copy=True)
+            emb[rows] -= 0.1 * vals  # row-local, like pslib sgd
+            scope["emb"] = emb
+
+        s = PSServer(eps[i], SparseExec(), scope,
+                     {"emb@GRAD": sparse_block}, fanin=1,
+                     sync_mode=False, endpoints=eps)
+        s.start_background()
+        return s, scope
+
+    s0, sc0 = mk(0)
+    s1, sc1 = mk(1)
+    monkeypatch.setattr(s0, "_async_repl_every", 1)  # ship every push
+    try:
+        c = PSClient(",".join(eps), trainer_id=0)
+        c.push_sparse("emb@GRAD", [3, 7],
+                      np.ones((2, width), "f4"), param="emb")
+        db0 = obs.counter_value("ps.replication_bytes",
+                                mode="delta") or 0
+        c.push_sparse("emb@GRAD", [5],
+                      np.full((1, width), 2.0, "f4"), param="emb")
+        d_bytes = (obs.counter_value("ps.replication_bytes",
+                                     mode="delta") or 0) - db0
+        assert 0 < d_bytes <= 4 * width * 4, \
+            "second push must ship a row slice, got %d bytes" % d_bytes
+        np.testing.assert_array_equal(np.asarray(sc0["emb"]),
+                                      np.asarray(sc1["emb"]))
+        assert np.asarray(sc1["emb"])[5, 0] == np.float32(-0.2)
+        c.close()
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+# -- incremental checkpoints -------------------------------------------------
+
+
+def test_incremental_checkpoint_parity_and_fallback(tmp_path):
+    """save_incremental == save bit-for-bit on load; a fingerprint
+    match skips even PRODUCING the shard; corrupting a reused shard
+    (the torn-write replace case) falls back to the previous
+    checkpoint; counters record the reuse."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.checkpoint import CheckpointManager, verify_manifest
+
+    big = os.urandom(1 << 15)
+    full = CheckpointManager(str(tmp_path / "full"), keep=3)
+    inc = CheckpointManager(str(tmp_path / "inc"), keep=3)
+
+    def writer(step):
+        def w(d):
+            with open(os.path.join(d, "state.bin"), "wb") as f:
+                f.write(b"round-%d" % step)
+            with open(os.path.join(d, "ballast.bin"), "wb") as f:
+                f.write(big)
+        return w
+
+    r0 = obs.counter_value("checkpoint.shards_reused") or 0
+    d0 = obs.counter_value("checkpoint.delta_bytes") or 0
+    for step in (1, 2, 3):
+        full.save(step, writer(step))
+        inc.save_incremental(
+            step, {"state.bin": b"round-%d" % step,
+                   "ballast.bin": _must_not_run if step > 1 else big},
+            fingerprints={"ballast.bin": "static-v1"})
+    assert (obs.counter_value("checkpoint.shards_reused") - r0) == 2
+    fresh = (obs.counter_value("checkpoint.delta_bytes") or 0) - d0
+    assert fresh == len(big) + 3 * len(b"round-N"), fresh
+
+    def load(mgr):
+        out = {}
+
+        def loader(d):
+            verify_manifest(d)
+            for fn in ("state.bin", "ballast.bin"):
+                with open(os.path.join(d, fn), "rb") as f:
+                    out[fn] = f.read()
+        step = mgr.load_latest(loader)
+        return step, out
+
+    assert load(full) == load(inc), \
+        "incremental and full checkpoints must load identically"
+
+    # content-hash reuse without a fingerprint still links
+    r1 = obs.counter_value("checkpoint.shards_reused")
+    inc.save_incremental(4, {"state.bin": b"round-4",
+                             "ballast.bin": big})
+    assert obs.counter_value("checkpoint.shards_reused") - r1 == 1
+
+    # corrupt the newest REUSED shard (replace: the torn-write case,
+    # which breaks the hardlink) -> load falls back one rotation
+    p = str(tmp_path / "inc" / "ckpt-4" / "ballast.bin")
+    os.remove(p)
+    with open(p, "wb") as f:
+        f.write(b"garbage")
+    step, out = load(inc)
+    assert step == 3 and out["ballast.bin"] == big
+
+
+def _must_not_run():
+    raise AssertionError("fingerprint-matched shard was produced")
+
+
+# -- lease + quorum promotion ------------------------------------------------
+
+
+def test_lease_renewals_keep_backup_loyal(monkeypatch):
+    """While the primary renews, the backup never promotes (no lease
+    expiry, no election) and a FRESH client walking into the backup is
+    redirected to the primary, exactly as before."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_env(monkeypatch)
+    eps = _eps(2)
+    s0, sc0 = _mk_ps(eps, 0, lease_ms=300)
+    s1, _ = _mk_ps(eps, 1, lease_ms=300)
+    r0 = obs.counter_value("ps.lease_renewals") or 0
+    try:
+        time.sleep(1.2)  # 4 lease periods
+        assert not s1._promoted, "backup promoted under live renewals"
+        assert (obs.counter_value("ps.lease_renewals") or 0) > r0
+        c = PSClient("%s,%s" % (eps[1], eps[0]), trainer_id=0)
+        c.send_grad("w@GRAD", _grad(0, 1))
+        c.send_barrier()
+        assert c.endpoint == eps[0], "fresh client not redirected"
+        assert not s1._promoted
+        c.get_param("w")
+        c.fetch_barrier()
+        c.close()
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_dead_primary_tombstone_elects_backup_proactively(monkeypatch):
+    """A SIGKILL-equivalent (stopped listener => connection REFUSED)
+    lets the backup win its election on the tombstone quorum WITHOUT
+    any client traffic — promotion is proactive under leases."""
+    from paddle_tpu import observability as obs
+
+    _fast_env(monkeypatch)
+    eps = _eps(2)
+    s0, _ = _mk_ps(eps, 0, lease_ms=300)
+    s1, _ = _mk_ps(eps, 1, lease_ms=300)
+    e0 = obs.counter_value("ps.lease_expiries", shard="0") or 0
+    try:
+        time.sleep(0.5)  # at least one renewal lands
+        s0.stop()
+        deadline = time.time() + 5
+        while not s1._promoted and time.time() < deadline:
+            time.sleep(0.05)
+        assert s1._promoted, "tombstone quorum never promoted backup"
+        assert s1._epoch >= 1, "promotion must bump the epoch"
+        assert (obs.counter_value("ps.lease_expiries", shard="0")
+                or 0) > e0
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_partitioned_backup_is_quorum_denied(monkeypatch):
+    """Control-plane partition (every lease/vote rpc times out): the
+    backup's lease expires but its elections gather neither a grant
+    nor a tombstone — quorum denied, NO promotion, and the primary
+    (2-endpoint group: no rival quorum can form without it) keeps
+    serving. Exactly one writable primary."""
+    from paddle_tpu.distributed import ps_rpc
+
+    _fast_env(monkeypatch)
+    eps = _eps(2)
+    s0, _ = _mk_ps(eps, 0, lease_ms=300)
+    s1, _ = _mk_ps(eps, 1, lease_ms=300)
+
+    def severed(endpoint, msg, timeout=1.0):
+        raise socket.timeout("partitioned control plane")
+
+    try:
+        time.sleep(0.5)  # healthy renewals first
+        monkeypatch.setattr(ps_rpc, "_bare_rpc", severed)
+        time.sleep(1.5)  # 5 lease periods of failed elections
+        assert not s1._promoted, \
+            "partition must never yield a second primary"
+        assert s0._active_role(), "2-endpoint primary must serve on"
+        assert s1._promised_epoch == 0 or not s1._promoted
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_isolated_primary_of_three_demotes(monkeypatch):
+    """In a group of >= 3 a primary that cannot renew with a majority
+    for a full lease steps down: behind its partition, the two backups
+    COULD have elected a rival — better a loud redirect than split
+    brain."""
+    from paddle_tpu.distributed import ps_rpc
+
+    _fast_env(monkeypatch)
+    eps = _eps(3)
+
+    def severed(endpoint, msg, timeout=1.0):
+        raise socket.timeout("partitioned control plane")
+
+    monkeypatch.setattr(ps_rpc, "_bare_rpc", severed)
+    s0, _ = _mk_ps(eps, 0, lease_ms=300)
+    try:
+        deadline = time.time() + 5
+        while s0._active_role() and time.time() < deadline:
+            time.sleep(0.05)
+        assert not s0._active_role(), \
+            "isolated 3-group primary must demote within ~a lease"
+    finally:
+        s0.stop()
+
+
+def test_legacy_instant_promotion_when_lease_disabled(monkeypatch):
+    """PADDLE_PS_LEASE_MS=0 restores the ISSUE-4 contract: a genuinely
+    failed-over client (fo >= 1) promotes the backup instantly; no
+    lease threads run."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_env(monkeypatch)
+    eps = _eps(2)
+    s0, _ = _mk_ps(eps, 0, fanin=1, lease_ms=0)
+    s1, sc1 = _mk_ps(eps, 1, fanin=1, lease_ms=0)
+    try:
+        c = PSClient(",".join(eps), trainer_id=0)
+        c.send_grad("w@GRAD", _grad(0, 1))
+        c.send_barrier()
+        c.get_param("w")
+        c.fetch_barrier()
+        s0.stop()
+        t0 = time.time()
+        c.send_grad("w@GRAD", _grad(0, 2))
+        c.send_barrier()
+        w = c.get_param("w")
+        c.fetch_barrier()
+        assert s1._promoted
+        exp = {"w": np.zeros(4, "f4"), "w@GRAD": _grad(0, 1)}
+        _sgd_block(exp)
+        exp["w@GRAD"] = _grad(0, 2)
+        _sgd_block(exp)
+        np.testing.assert_array_equal(w, exp["w"])
+        assert time.time() - t0 < 15
+        c.close()
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+# -- async-mode round-gated replay -------------------------------------------
+
+
+def test_async_failover_round_gated_exactly_once(monkeypatch):
+    """Async (RunAsyncLoop) mode with backups: every K applied ops the
+    primary ships a synthetic round, acks tag each op with the round
+    carrying it, and the client prunes its replay log by durable
+    round. Killing the primary mid-stream and finishing on the backup
+    applies every op EXACTLY once — bit-for-bit with the sequential
+    oracle — and the replay log never grows past one round."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_env(monkeypatch)
+    eps = _eps(2)
+    s0, sc0 = _mk_ps(eps, 0, sync=False, lease_ms=300)
+    s1, sc1 = _mk_ps(eps, 1, sync=False, lease_ms=300)
+    monkeypatch.setattr(s0, "_async_repl_every", 4)
+    monkeypatch.setattr(s1, "_async_repl_every", 4)
+    grads = [np.full(4, 0.01 * (i + 1), dtype=np.float32)
+             for i in range(11)]
+    try:
+        c = PSClient(",".join(eps), trainer_id=0)
+        for g in grads[:6]:
+            c.send_grad("w@GRAD", g)
+        # ops 1-4 shipped as round 1 and PRUNED; 5,6 still pending
+        assert len(c._replay_log) == 2, \
+            [e[2] for e in c._replay_log]
+        s0.stop()
+        for g in grads[6:]:
+            c.send_grad("w@GRAD", g)
+        w = c.get_param("w")
+        c.close()
+        oracle = {"w": np.zeros(4, "f4")}
+        for g in grads:
+            oracle["w@GRAD"] = g
+            _sgd_block(oracle)
+        assert w.tobytes() == oracle["w"].tobytes(), \
+            "async failover lost or double-applied a push"
+        np.testing.assert_array_equal(np.asarray(sc1["w"]),
+                                      oracle["w"])
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_async_durable_round_requires_an_acked_backup(monkeypatch):
+    """A ship that reached NOBODY must not advance durable_round: with
+    the backup dead, the client's replay log keeps every unreplicated
+    op — pruning them would lose pushes that exist only on the
+    primary."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_env(monkeypatch)
+    eps = _eps(2)
+    s0, _ = _mk_ps(eps, 0, sync=False, lease_ms=0)
+    s1, _ = _mk_ps(eps, 1, sync=False, lease_ms=0)
+    monkeypatch.setattr(s0, "_async_repl_every", 2)
+    try:
+        c = PSClient(",".join(eps), trainer_id=0)
+        c.send_grad("w@GRAD", _grad(0, 1))
+        c.send_grad("w@GRAD", _grad(0, 2))  # round 1 ships, acked
+        assert not c._replay_log, "acked round must prune"
+        s1.stop()  # the only backup dies: ships reach nobody
+        for rnd in range(3, 9):
+            c.send_grad("w@GRAD", _grad(0, rnd))
+        assert len(c._replay_log) == 6, \
+            "unacked ships must not prune the replay log"
+        c.close()
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+# -- key-range sharding ------------------------------------------------------
+
+
+def test_shard_routing_stable_and_grad_follows_param():
+    from paddle_tpu.distributed.ps_shard import (shard_for_key,
+                                                 shard_for_rows,
+                                                 row_range,
+                                                 split_endpoint_groups)
+
+    assert shard_for_key("w", 1) == 0
+    for n in (2, 3, 8):
+        for name in ("w", "emb/table", "fc_0.w_0"):
+            s = shard_for_key(name, n)
+            assert 0 <= s < n
+            assert shard_for_key(name, n) == s, "routing must be stable"
+            assert shard_for_key(name + "@GRAD", n) == s
+            assert shard_for_key(name + "@MOMENTUM", n) == s
+    # every shard of a 2-way split is reachable by SOME var name
+    hit = {shard_for_key("w%d" % i, 2) for i in range(32)}
+    assert hit == {0, 1}
+
+    groups = split_endpoint_groups(["a:1", "b:2", "c:3", "d:4"], 2)
+    assert groups == [["a:1", "b:2"], ["c:3", "d:4"]]
+    with pytest.raises(ValueError, match="divisible"):
+        split_endpoint_groups(["a:1", "b:2", "c:3"], 2)
+
+    # contiguous row ranges tile the table exactly
+    height = 103
+    for n in (2, 4):
+        edges = [row_range(s, height, n) for s in range(n)]
+        assert edges[0][0] == 0 and edges[-1][1] == height
+        for (a, b), (c, d) in zip(edges, edges[1:]):
+            assert b == c
+        owner = shard_for_rows(np.arange(height), height, n)
+        for s, (lo, hi) in enumerate(edges):
+            assert (owner[lo:hi] == s).all()
+
+
+def _mk_group(eps, name, fanin=1, **kw):
+    """One shard group's servers, all serving var ``name``."""
+    from paddle_tpu.distributed.ps_rpc import PSServer
+
+    out = []
+    for ep in eps:
+        scope = MiniScope()
+        scope[name] = np.zeros(4, dtype=np.float32)
+
+        def block(scope, _n=name):
+            scope[_n] = scope[_n] - 0.1 * scope[_n + "@GRAD"]
+
+        s = PSServer(ep, MiniExec(), scope, {name + "@GRAD": block},
+                     fanin=fanin, endpoints=eps, **kw)
+        s.start_background()
+        out.append((s, scope))
+    return out
+
+
+def _shard_var_names(nshards):
+    from paddle_tpu.distributed.ps_shard import shard_for_key
+
+    names = []
+    for s in range(nshards):
+        i = 0
+        while True:
+            cand = "w%d" % i
+            if (shard_for_key(cand, nshards) == s
+                    and cand not in names):
+                names.append(cand)
+                break
+            i += 1
+    return names
+
+
+def test_sharded_two_phase_barrier_and_shard_failover(monkeypatch):
+    """2 key-range shards x (primary+backup): the two-phase barrier
+    keeps every sub-client's replay log alive until EVERY shard acked;
+    killing shard 0's primary mid-run fails over that shard alone and
+    BOTH shards' params finish bit-for-bit against the per-var
+    oracle."""
+    from paddle_tpu.distributed.ps_shard import ShardedPSClient
+
+    _fast_env(monkeypatch)
+    names = _shard_var_names(2)
+    g0, g1 = _eps(2), _eps(2)
+    shard0 = _mk_group(g0, names[0], lease_ms=300)
+    shard1 = _mk_group(g1, names[1], lease_ms=300)
+    rounds, kill_at = 4, 2
+    try:
+        c = ShardedPSClient([",".join(g0), ",".join(g1)],
+                            trainer_id=0)
+        assert [c.shard_of(n) for n in names] == [0, 1]
+        ws = {}
+        for rnd in range(1, rounds + 1):
+            for vi, name in enumerate(names):
+                c.send_grad(name + "@GRAD", _grad(0, rnd) + vi)
+            # phase-1/phase-2 contract: the logs hold the round until
+            # EVERY shard acks
+            assert all(len(sc._replay_log) == 1 for sc in c.shards)
+            c.send_barrier()
+            assert all(not sc._replay_log for sc in c.shards), \
+                "commit must clear every shard's log"
+            for name in names:
+                ws[name] = c.get_param(name)
+            c.fetch_barrier()
+            if rnd == kill_at:
+                shard0[0][0].stop()  # shard 0 primary dies; shard 1
+                # must never notice
+        for vi, name in enumerate(names):
+            exp = {"w": np.zeros(4, "f4")}
+            for rnd in range(1, rounds + 1):
+                exp["w@GRAD"] = _grad(0, rnd) + vi
+                _sgd_block(exp)
+            assert ws[name].tobytes() == exp["w"].tobytes(), name
+        assert shard0[1][0]._promoted, "shard 0 backup not promoted"
+        assert not shard1[1][0]._promoted, \
+            "shard 1 backup must be untouched"
+        assert c.shards[1]._failover_count == 0
+        c.close()
+    finally:
+        for s, _ in shard0 + shard1:
+            s.stop()
+
+
+def test_sharded_sparse_row_range_pull_push(monkeypatch):
+    """pull/push_sparse with GLOBAL row ids: rows split by contiguous
+    range, each shard holding its slice under LOCAL ids, results
+    reassembled in request order."""
+    from paddle_tpu.distributed.ps_rpc import PSServer
+    from paddle_tpu.distributed.ps_shard import (ShardedPSClient,
+                                                 row_range)
+
+    _fast_env(monkeypatch)
+    height, width, nshards = 10, 3, 2
+    eps = _eps(2)
+    servers = []
+    for s in range(nshards):
+        lo, hi = row_range(s, height, nshards)
+        scope = MiniScope()
+        scope["emb"] = (np.arange(lo, hi, dtype=np.float32)
+                        .reshape(-1, 1) * np.ones((1, width), "f4"))
+        srv = PSServer(eps[s], MiniExec(), scope, {}, fanin=1,
+                       endpoints=[eps[s]])
+        srv.start_background()
+        servers.append(srv)
+    try:
+        c = ShardedPSClient([eps[0], eps[1]], trainer_id=0)
+        ids = [7, 1, 9, 0, 4]  # deliberately out of order, both shards
+        vals = c.pull_sparse("emb", ids, height=height)
+        np.testing.assert_array_equal(
+            vals, np.asarray(ids, "f4").reshape(-1, 1)
+            * np.ones((1, width), "f4"))
+        empty = c.pull_sparse("emb", [], height=height)
+        assert empty.shape == (0, width) and empty.dtype == np.float32
+        c.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- the partition fault primitive -------------------------------------------
+
+
+class _PeerSock:
+    def __init__(self, peer):
+        self._peer = peer
+        self.sent = []
+
+    def getpeername(self):
+        host, port = self._peer.rsplit(":", 1)
+        return (host, int(port))
+
+    def sendall(self, b):
+        self.sent.append(bytes(b))
+
+
+def test_partition_rule_parses_and_matches_pairs():
+    from paddle_tpu.distributed.fault import FaultRule, parse_plan
+
+    rules = parse_plan("partition:1:127.0.0.1:7001|127.0.0.1:7002,"
+                       "send.drop:0.1")
+    assert rules[0].kind == "partition" and rules[0].prob == 1.0
+    assert rules[0].param == "127.0.0.1:7001|127.0.0.1:7002"
+    assert rules[0].partition_peer("127.0.0.1:7001") == "127.0.0.1:7002"
+    assert rules[0].partition_peer("127.0.0.1:7002") == "127.0.0.1:7001"
+    assert rules[0].partition_peer("127.0.0.1:9999") is None
+    assert rules[0].partition_peer(None) is None
+    single = parse_plan("any.partition:0.5:127.0.0.1:7003")[0]
+    assert single.partition_peer(None) == "127.0.0.1:7003"
+    with pytest.raises(ValueError, match="peer"):
+        parse_plan("partition:1")
+    # round-trips through repr
+    assert parse_plan(repr(rules[0]))[0].param == rules[0].param
+
+
+def test_partition_injector_blackholes_both_directions():
+    """A pair rule severs frames on sockets to the peer — send AND
+    recv — only in processes whose identity is one of the pair; a
+    third party's traffic to either endpoint is untouched."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import fault
+
+    a, b = "127.0.0.1:7001", "127.0.0.1:7002"
+    inj = fault.FaultInjector(
+        fault.parse_plan("partition:1:%s|%s" % (a, b)), seed=1)
+    prev = fault.get_identity()
+    n0 = obs.counter_value("fault.injected", side="send",
+                           kind="partition") or 0
+    try:
+        fault.set_identity(a)
+        s = _PeerSock(b)
+        assert inj.on_send(s, b"frame") is False and not s.sent
+        assert inj.on_recv(_PeerSock(b)) == "drop"
+        other = _PeerSock("127.0.0.1:9999")
+        assert inj.on_send(other, b"frame") is True and other.sent
+        # a process OUTSIDE the pair (a trainer) is never severed
+        fault.set_identity("127.0.0.1:5555")
+        s2 = _PeerSock(b)
+        assert inj.on_send(s2, b"frame") is True and s2.sent
+        assert (obs.counter_value("fault.injected", side="send",
+                                  kind="partition") or 0) == n0 + 1
+    finally:
+        fault.set_identity(prev)
+
+
+def test_random_plan_partition_wiring():
+    import random as _random
+
+    from paddle_tpu.distributed.fault import parse_plan, random_plan
+
+    base = random_plan(_random.Random(11))
+    withp = random_plan(_random.Random(11),
+                        partition_peers=["h:1|h:2", "h:3|h:4"])
+    assert withp.startswith(base), \
+        "peers must not perturb the legacy rng draws"
+    assert "partition:1:" in withp
+    rules = parse_plan(withp)
+    assert rules[-1].kind == "partition"
+    assert rules[-1].param in ("h:1|h:2", "h:3|h:4")
+
+
+def test_chaos_schedule_deterministic_for_sharded_modes():
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import chaos_drill
+
+    a = chaos_drill.make_schedule(77, 6, shards=2, partition=True)
+    assert a == chaos_drill.make_schedule(77, 6, shards=2,
+                                          partition=True)
+    assert a["shards"] == 2 and a["partition"]
+    assert a["die_shard"] in (0, 1)
+    assert a["partition_shard"] == (a["die_shard"] + 1) % 2
+    legacy = chaos_drill.make_schedule(77, 6)
+    # legacy draws unchanged: same plan and kill points
+    assert legacy["plan"] == a["plan"]
+    assert legacy["trainer_kill_round"] == a["trainer_kill_round"]
+    assert legacy["partition_shard"] is None

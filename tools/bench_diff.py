@@ -54,10 +54,15 @@ ABS_NOISE_FLOOR = {
     "exposed_collective_ms": 2.0, "overlap_frac": 0.1,
 }
 
-# counter totals (metrics.json) where growth is a regression
+# counter totals (metrics.json) where growth is a regression.
+# ps.replication_bytes guards the ISSUE-8 delta-replication win: a
+# code change that silently regresses the PS back to full-blob
+# shipping shows up as growth of the byte counters (and of the
+# mode=full series specifically) for the same drilled workload.
 COUNTER_WATCH_GROWS_BAD = ("parallel.collective_bytes",
                            "parallel.collective_ops",
-                           "executor.compile_fallbacks")
+                           "executor.compile_fallbacks",
+                           "ps.replication_bytes")
 
 
 def load(path):
@@ -246,6 +251,15 @@ def _self_test():
     zbad = list(diff_counters(z0, z1, 0.25))
     assert zbad and zbad[0][-1], zbad
     assert not list(diff_counters(z0, z0, 0.25))
+    # a regression back to full-blob PS replication (delta bytes
+    # ballooning for the same drilled workload) must flag
+    r0 = {"totals": {"ps.replication_bytes{mode=delta}": 160,
+                     "ps.replication_bytes{mode=full}": 16416}}
+    r1 = {"totals": {"ps.replication_bytes{mode=delta}": 16416,
+                     "ps.replication_bytes{mode=full}": 16416}}
+    rbad = [r for r in diff_counters(r0, r1, 0.25) if r[-1]]
+    assert rbad and rbad[0][0].startswith("ps.replication_bytes"), rbad
+    assert not any(r[-1] for r in diff_counters(r0, r0, 0.25))
     # profile-block metrics: an overlap_frac / mfu_est drop past the
     # threshold is a regression even when raw throughput held
     p0 = {"configs": {"w": {"tokens_per_sec": 100.0, "profile": {

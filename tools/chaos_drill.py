@@ -1,5 +1,6 @@
 """Chaos drill: seeded randomized fault schedules against the
-replicated PS job, gated on the bit-for-bit dedup invariant.
+replicated (and sharded) PS job, gated on the bit-for-bit dedup
+invariant.
 
 Each drill derives, from one seed, a randomized schedule:
 
@@ -7,32 +8,46 @@ Each drill derives, from one seed, a randomized schedule:
   recoverable drop/dup/delay menu),
 - a random SIGKILL of one trainer at a random round (supervised
   relaunch + checkpoint resume), and
-- a random SIGKILL of the PRIMARY pserver at a random round
-  (client failover to the backup + replay + server rejoin).
+- a random SIGKILL of a PRIMARY pserver at a random round
+  (lease expiry -> quorum election on the backup + client failover +
+  replay + server rejoin).
 
-It then runs the 2-trainer / 2-server sync job under the launch
-supervisor and asserts the final params match the CLEAN single-server
-computation bit-for-bit: retry + ``(cid, round, seq)`` dedup +
-replication watermark must make every gradient count exactly once, no
-matter which frames the injector ate and which processes died.
+It then runs the sync job under the launch supervisor and asserts the
+final params match the CLEAN single-server computation bit-for-bit:
+retry + ``(cid, round, seq)`` dedup + replication watermark must make
+every gradient count exactly once, no matter which frames the
+injector ate and which processes died.
+
+ISSUE 8 modes:
+
+- ``--shards 2`` — 2 key-range shard groups x (primary+backup); the
+  schedule picks WHICH shard's primary dies. The two-phase round
+  barrier must keep the sister shard's rounds intact (bit-for-bit per
+  shard var), and the merged telemetry must show DELTA replication
+  actually ran with ``ps.replication_bytes{mode=delta}`` strictly
+  below the full-anchor bytes for the same workload.
+- ``--partition`` (requires ``--shards 2``) — additionally severs the
+  OTHER shard's primary<->backup pair with the ``partition`` fault
+  primitive for the whole run. That shard's backup must see its lease
+  expire and LOSE its elections (no quorum through a partition —
+  ``ps.lease_expiries`` without a promotion), its primary must keep
+  applying every round, and the job still exits 0 bit-for-bit:
+  exactly one writable primary per shard, no split brain, no lost
+  rounds — while the killed shard next door still promotes. This is
+  the ISSUE 8 acceptance drill (SIGKILL + partition in one run).
 
 The schedule is a pure function of the seed (``make_schedule``), so a
 failing drill replays exactly: rerun with the printed seed.
 
 Each drill also runs with ``PADDLE_TPU_METRICS_DIR`` armed and gates
-on the job's merged telemetry (ISSUE 5): a job-level ``metrics.json``
-and merged chrome-trace ``trace.json`` must exist, the injected faults
-and the backup promotion must be visible in them, and the kill ->
-failover (``ps.failovers`` span) -> promotion -> first-applied-round
-chain must read in causal order across >= 3 processes
-(``check_telemetry``; the human-readable version is printed via
-``tools/ft_timeline.py``).
+on the job's merged telemetry: metrics.json + trace.json must exist,
+the injected faults and the promotion must be visible, and the kill ->
+failover -> promotion -> first-applied-round chain must read in causal
+order across >= 3 processes (``check_telemetry``; the human-readable
+version is printed via ``tools/ft_timeline.py``).
 
 Usage: python tools/chaos_drill.py [--rounds 1] [--sync-rounds 6]
-       [--seed 1234]
-
-``--rounds`` is the number of randomized drills (CI runs 1);
-``--sync-rounds`` is the training length of each drill.
+       [--seed 1234] [--shards N] [--partition]
 """
 from __future__ import annotations
 
@@ -67,41 +82,72 @@ def _free_port() -> int:
     return port
 
 
-def make_schedule(seed: int, sync_rounds: int = 6) -> dict:
+def make_schedule(seed: int, sync_rounds: int = 6, shards: int = 1,
+                  partition: bool = False) -> dict:
     """The randomized fault schedule as a pure function of the seed —
-    two calls with the same seed MUST return the same dict (asserted
-    by tests/test_fault_tolerance.py)."""
+    two calls with the same args MUST return the same dict (asserted
+    by tests/test_fault_tolerance.py). The legacy draws keep their
+    order, so legacy schedules replay identically; shard draws come
+    after."""
     from paddle_tpu.distributed import fault
 
     rng = random.Random(int(seed))
     hi = max(1, int(sync_rounds) - 1)
-    return {
+    sched = {
         "seed": int(seed),
         "sync_rounds": int(sync_rounds),
         "plan": fault.random_plan(rng),
         "trainer_kill_rank": rng.randint(0, 1),
         "trainer_kill_round": rng.randint(1, hi),
         "server_kill_round": rng.randint(1, hi),
+        "shards": max(1, int(shards)),
+        "partition": bool(partition),
     }
+    sched["die_shard"] = (rng.randrange(sched["shards"])
+                          if sched["shards"] > 1 else 0)
+    # the partitioned pair must belong to a SURVIVING shard: the drill
+    # separates "promotion must happen" (killed shard) from "promotion
+    # must be quorum-denied" (partitioned shard)
+    sched["partition_shard"] = (
+        (sched["die_shard"] + 1) % sched["shards"]
+        if sched["partition"] and sched["shards"] > 1 else None)
+    return sched
 
 
-def _env(sched: dict, tmp: str, eps: str) -> dict:
+def _groups(sched: dict, eps: list) -> list:
+    """The shard -> endpoint-group mapping, from the ONE slicing
+    implementation launch.py hands the servers — the drill's partition
+    pair and telemetry gates must name exactly the processes the
+    launcher built."""
+    from paddle_tpu.distributed.ps_shard import split_endpoint_groups
+
+    return split_endpoint_groups(eps, sched["shards"])
+
+
+def _env(sched: dict, tmp: str, eps: list) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("PADDLE_PS_HEARTBEAT_MS", None)
+    plan = sched["plan"]
+    if sched["partition_shard"] is not None:
+        pg = _groups(sched, eps)[sched["partition_shard"]]
+        # hard both-ways partition between that shard's primary and
+        # backup for the WHOLE run: the backup must never win quorum
+        plan = "%s,partition:1:%s|%s" % (plan, pg[0], pg[1])
     env.update({
         "FT_ROLE": "trainer",
-        "PSERVER_ENDPOINT": eps,
+        "PSERVER_ENDPOINT": ",".join(eps),
         "FT_ROUNDS": str(sched["sync_rounds"]),
         "FT_DIE_AT_ROUND": str(sched["trainer_kill_round"]),
         "FT_DIE_RANK": str(sched["trainer_kill_rank"]),
         "FT_SERVER_DIE_AT_ROUND": str(sched["server_kill_round"]),
+        "FT_DIE_SHARD": str(sched["die_shard"]),
         "FT_OUT": os.path.join(tmp, "out"),
         "FT_CKPT_ROOT": os.path.join(tmp, "ckpt"),
-        "PADDLE_TPU_FAULTS": sched["plan"],
+        "PADDLE_TPU_FAULTS": plan,
         "PADDLE_TPU_FAULT_SEED": str(sched["seed"]),
         # the drill is gated on BIT-FOR-BIT parity with the clean run:
         # eviction deliberately trades exactness for availability
@@ -128,6 +174,11 @@ def _env(sched: dict, tmp: str, eps: str) -> dict:
         "PADDLE_PS_CONNECT_TIMEOUT": "4",
         "PADDLE_PS_FAILOVER_CONNECT_TIMEOUT": "3",
         "PADDLE_PS_REPL_DEADLINE": "5",
+        # a short lease keeps the SIGKILLed shard's failover inside
+        # the drill budget while still being >> one renewal period;
+        # the partitioned shard's backup gets plenty of failed
+        # elections to prove quorum denial
+        "PADDLE_PS_LEASE_MS": "1200",
         # job-level telemetry: every process dumps registry + spans +
         # flight ring here (dir implies metrics armed); a short cadence
         # so even a SIGKILLed process leaves a fresh black box, and the
@@ -139,48 +190,64 @@ def _env(sched: dict, tmp: str, eps: str) -> dict:
     return env
 
 
+def _rerun_hint(sched: dict) -> str:
+    return ("tools/chaos_drill.py --seed %d --sync-rounds %d"
+            "%s%s" % (sched["seed"], sched["sync_rounds"],
+                      " --shards %d" % sched["shards"]
+                      if sched["shards"] > 1 else "",
+                      " --partition" if sched["partition"] else ""))
+
+
 def run_drill(sched: dict) -> int:
     tmp = tempfile.mkdtemp(prefix="chaos_drill_")
-    eps = "127.0.0.1:%d,127.0.0.1:%d" % (_free_port(), _free_port())
+    eps = ["127.0.0.1:%d" % _free_port()
+           for _ in range(2 * sched["shards"])]
     print("[chaos] schedule %s" % json.dumps(sched, sort_keys=True))
     sup = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node=2", "--max_restarts=3",
          "--started_port=%d" % _free_port(),
          "--server_script=%s" % WORKER,
-         "--pserver_endpoints=%s" % eps, WORKER],
+         "--pserver_shards=%d" % sched["shards"],
+         "--pserver_endpoints=%s" % ",".join(eps), WORKER],
         env=_env(sched, tmp, eps), timeout=420, cwd=REPO)
     if sup.returncode != 0:
         print("[chaos] FAIL: job exited %d under schedule seed=%d "
-              "(rerun: tools/chaos_drill.py --seed %d --sync-rounds %d)"
-              % (sup.returncode, sched["seed"], sched["seed"],
-                 sched["sync_rounds"]))
+              "(rerun: %s)" % (sup.returncode, sched["seed"],
+                               _rerun_hint(sched)))
         return 1
-    expected = oracle_w(sched["sync_rounds"])
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from dist_worker_ft import var_names
+
+    names = var_names(sched["shards"])
     ok = True
     for tid in (0, 1):
         r = json.load(open(os.path.join(tmp, "out.t%d.json" % tid)))
-        got = np.asarray(r["w"], dtype=np.float32)
-        bitwise = got.tobytes() == expected.tobytes()
-        print("[chaos] %s: trainer %d params %s the clean run "
-              "(failovers=%s, evictions=%s)"
-              % ("PASS" if bitwise else "FAIL", tid,
-                 "match" if bitwise else "DIVERGE FROM",
-                 r.get("failovers"), r.get("evictions")))
-        ok = ok and bitwise
-    ok = check_telemetry(sched, os.path.join(tmp, "metrics")) and ok
+        for vi, name in enumerate(names):
+            expected = oracle_w(sched["sync_rounds"], var=vi)
+            got = np.asarray(r["vars"][name], dtype=np.float32)
+            bitwise = got.tobytes() == expected.tobytes()
+            print("[chaos] %s: trainer %d var %s %s the clean run "
+                  "(failovers=%s, evictions=%s)"
+                  % ("PASS" if bitwise else "FAIL", tid, name,
+                     "matches" if bitwise else "DIVERGES FROM",
+                     r.get("failovers"), r.get("evictions")))
+            ok = ok and bitwise
+    ok = check_telemetry(sched, os.path.join(tmp, "metrics"), eps) and ok
     if not ok:
-        print("[chaos] reproduce with: tools/chaos_drill.py --seed %d "
-              "--sync-rounds %d" % (sched["seed"], sched["sync_rounds"]))
+        print("[chaos] reproduce with: %s" % _rerun_hint(sched))
     return 0 if ok else 1
 
 
-def check_telemetry(sched: dict, mdir: str) -> bool:
-    """The drill's second gate (ISSUE 5): the job must leave ONE merged
-    picture in which the primary's kill, the trainers' failover
-    (``ps.failovers`` span), and the promoted backup's first applied
-    round are visible in causal order across >= 3 processes — and the
-    injected faults must show up in it."""
+def check_telemetry(sched: dict, mdir: str, eps: list) -> bool:
+    """The drill's second gate: the job must leave ONE merged picture
+    in which the killed primary's SIGKILL, the trainers' failover, and
+    the promoted backup's first applied round are visible in causal
+    order across >= 3 processes; the injected faults must show up; and
+    (ISSUE 8) delta replication must have carried the job with its
+    bytes strictly below the full anchors', while a partitioned
+    shard's backup shows lease expiries but NO promotion — at most one
+    writable primary per shard."""
     ok = True
 
     def chk(what, passed):
@@ -197,9 +264,10 @@ def check_telemetry(sched: dict, mdir: str) -> bool:
     if not ok:
         return False
     merged = json.load(open(mpath))
+    totals = merged["counters_total"]
     chk("merged metrics preserve per-rank sections (%d processes)"
         % len(merged["processes"]), len(merged["processes"]) >= 4)
-    n_faults = sum(v for k, v in merged["counters_total"].items()
+    n_faults = sum(v for k, v in totals.items()
                    if k.startswith("fault.injected"))
     chk("injected faults visible in merged counters (%d)" % n_faults,
         n_faults > 0)
@@ -211,9 +279,15 @@ def check_telemetry(sched: dict, mdir: str) -> bool:
         bool(names.get("fault.injected")))
     chk("merged timeline has the promotion event",
         bool(names.get("ps.promotion")))
-    chk("merged timeline has the ps.failovers span",
-        any(ev.get("ph") == "X"
-            for ev in names.get("ps.failovers", [])))
+
+    # -- delta replication actually carried the job (ISSUE 8) ----------
+    delta_b = totals.get("ps.replication_bytes{mode=delta}", 0)
+    full_b = totals.get("ps.replication_bytes{mode=full}", 0)
+    chk("delta rounds ran (ps.delta_rounds=%s)"
+        % totals.get("ps.delta_rounds"),
+        totals.get("ps.delta_rounds", 0) > 0)
+    chk("delta bytes (%d) strictly below full-anchor bytes (%d)"
+        % (delta_b, full_b), 0 < delta_b < full_b)
 
     # causal chain: kill -> failover -> promotion -> first applied
     # round on the promoted backup, across >= 3 distinct processes
@@ -225,15 +299,18 @@ def check_telemetry(sched: dict, mdir: str) -> bool:
                 return e
         return None
 
+    groups = _groups(sched, eps)
+    died = set(groups[sched["die_shard"]])
     kill = first(lambda e: e["kind"] == "launch.exit"
                  and e["fields"].get("role") == "pserver"
                  and e["fields"].get("signal") == 9)
     fo = first(lambda e: e["kind"] == "rpc.failover.begin"
                and e["proc"].startswith("trainer"))
-    promo = first(lambda e: e["kind"] == "ps.promotion")
+    promo = first(lambda e: e["kind"] == "ps.promotion"
+                  and e["fields"].get("endpoint") in died)
     chk("supervisor observed the primary's SIGKILL", kill is not None)
     chk("a trainer failed over", fo is not None)
-    chk("a backup was promoted", promo is not None)
+    chk("the killed shard's backup was promoted", promo is not None)
     if not ok:
         return False
     applied = first(lambda e: e["kind"] == "ps.round_applied"
@@ -245,12 +322,49 @@ def check_telemetry(sched: dict, mdir: str) -> bool:
         % (promo["proc"], sched["server_kill_round"]),
         applied is not None)
     if applied is not None:
-        chk("causal order: failover < promotion < first applied round",
-            fo["t_us"] < promo["t_us"] < applied["t_us"])
+        # lease-based promotion is PROACTIVE: the backup may win its
+        # election (kill + ~one lease) before any trainer reaches it,
+        # so failover and promotion are not ordered — but both must
+        # precede the promoted backup re-applying the killed round
+        chk("causal order: kill < promotion < first applied round",
+            kill["t_us"] < promo["t_us"] < applied["t_us"])
+        chk("trainers failed over before the round was rebuilt",
+            fo["t_us"] < applied["t_us"])
         procs = {fo["proc"], promo["proc"], applied["proc"],
                  kill["proc"]}
         chk("chain spans >= 3 processes (%s)" % sorted(procs),
             len(procs) >= 3)
+
+    # -- partition: quorum denied, exactly one writable primary --------
+    if sched["partition_shard"] is not None:
+        part = set(groups[sched["partition_shard"]])
+        part_promos = [e for e in events if e["kind"] == "ps.promotion"
+                       and e["fields"].get("endpoint") in part]
+        lost = [e for e in events if e["kind"] == "ps.election"
+                and e["fields"].get("endpoint") in part
+                and not e["fields"].get("won")]
+        expired = [e for e in events if e["kind"] == "ps.lease_expired"
+                   and e["fields"].get("endpoint") in part]
+        n_part = sum(v for k, v in totals.items()
+                     if k.startswith("fault.injected{")
+                     and "kind=partition" in k)
+        chk("partition frames were actually eaten (%d)" % n_part,
+            n_part > 0)
+        chk("partitioned backup's lease expired (%d events)"
+            % len(expired), len(expired) >= 1)
+        chk("partitioned backup lost every election (%d lost, 0 won)"
+            % len(lost), len(lost) >= 1)
+        chk("NO promotion in the partitioned shard (split brain)",
+            not part_promos)
+        # no lost rounds: the partitioned shard's PRIMARY kept
+        # applying to the end (its backup simply fell off the stream)
+        part_applied = [e for e in events
+                        if e["kind"] == "ps.round_applied"
+                        and e["fields"].get("round")
+                        == sched["sync_rounds"]]
+        chk("final round %d applied on every shard (%d appliers)"
+            % (sched["sync_rounds"], len(part_applied)),
+            len(part_applied) >= sched["shards"])
     return ok
 
 
@@ -260,14 +374,26 @@ def main() -> int:
                     help="number of randomized drills to run")
     ap.add_argument("--sync-rounds", type=int, default=6,
                     help="training rounds per drill")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="key-range PS shard groups (each "
+                         "primary+backup)")
+    ap.add_argument("--partition", action="store_true",
+                    help="also sever a surviving shard's "
+                         "primary<->backup pair for the whole run "
+                         "(requires --shards >= 2)")
     ap.add_argument("--seed", type=int,
                     default=int(os.environ.get("PADDLE_TPU_FAULT_SEED",
                                                "1234")),
                     help="base seed (drill i uses seed + i)")
     args = ap.parse_args()
+    if args.partition and args.shards < 2:
+        ap.error("--partition needs --shards >= 2 (the partitioned "
+                 "pair must belong to a shard that keeps training)")
     rc = 0
     for i in range(args.rounds):
-        rc |= run_drill(make_schedule(args.seed + i, args.sync_rounds))
+        rc |= run_drill(make_schedule(args.seed + i, args.sync_rounds,
+                                      shards=args.shards,
+                                      partition=args.partition))
     if rc == 0:
         print("[chaos] ALL %d DRILL(S) PASS" % args.rounds)
     return rc

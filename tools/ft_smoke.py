@@ -44,10 +44,15 @@ if _TOOLS not in sys.path:  # imported by tests, not only run directly
     sys.path.insert(0, _TOOLS)
 
 
-def _check_telemetry(mdir: str, want_promotion: bool = False) -> bool:
+def _check_telemetry(mdir: str, want_promotion: bool = False,
+                     want_delta: bool = False) -> bool:
     """Post-drill: print the cross-process postmortem and require the
     job-level merged artifacts (the launch supervisor writes them even
-    though children died by SIGKILL mid-run)."""
+    though children died by SIGKILL mid-run). ``want_delta`` (the
+    replicated drills) additionally requires the merged counters to
+    show DELTA replication was actually exercised — ``ps.delta_rounds``
+    > 0 — so a silent regression back to full-blob shipping fails CI
+    here even before bench_diff sees the bytes."""
     import ft_timeline
 
     ft_timeline.print_postmortem(mdir, limit=40)
@@ -57,12 +62,21 @@ def _check_telemetry(mdir: str, want_promotion: bool = False) -> bool:
         print("[ft_smoke] %s: job-level merged %s"
               % ("PASS" if present else "FAIL", name))
         ok = ok and present
-    if want_promotion:
+    if want_promotion and ok:
         events = ft_timeline.load_events(mdir)
         promo = any(e["kind"] == "ps.promotion" for e in events)
         print("[ft_smoke] %s: promotion visible in the merged timeline"
               % ("PASS" if promo else "FAIL"))
         ok = ok and promo
+    if want_delta and ok:
+        totals = json.load(open(os.path.join(
+            mdir, "metrics.json")))["counters_total"]
+        deltas = totals.get("ps.delta_rounds", 0)
+        print("[ft_smoke] %s: delta replication exercised "
+              "(ps.delta_rounds=%s, anchors=%s)"
+              % ("PASS" if deltas > 0 else "FAIL", deltas,
+                 totals.get("ps.anchor_rounds")))
+        ok = ok and deltas > 0
     return ok
 
 
@@ -87,17 +101,19 @@ def _env(**over):
 
 
 def oracle_w(rounds: int, trainers: int = 2, lr: float = 0.1,
-             dim: int = 4) -> np.ndarray:
+             dim: int = 4, var: int = 0) -> np.ndarray:
     """The clean single-server float32 computation the recovered job
-    must match bit-for-bit (same ops, same order, as the PS applies)."""
+    must match bit-for-bit (same ops, same order, as the PS applies).
+    ``var`` selects the per-shard var of the sharded drills (var 0 is
+    the legacy single-var oracle, bit-identical)."""
     sys.path.insert(0, os.path.join(REPO, "tests"))
     from dist_worker_ft import grad_for
 
     w = np.zeros(dim, dtype=np.float32)
     for rnd in range(1, rounds + 1):
-        total = grad_for(0, rnd)
+        total = grad_for(0, rnd, var)
         for t in range(1, trainers):
-            total = total + grad_for(t, rnd)
+            total = total + grad_for(t, rnd, var)
         w = w - np.float32(lr) * total
     return w
 
@@ -155,7 +171,8 @@ def run_server_kill(args) -> int:
             print("[ft_smoke] %s: %s"
                   % ("PASS" if passed else "FAIL", what))
             ok = ok and passed
-    ok = _check_telemetry(mdir, want_promotion=True) and ok
+    ok = _check_telemetry(mdir, want_promotion=True,
+                          want_delta=True) and ok
     return 0 if ok else 1
 
 
