@@ -187,14 +187,28 @@ def _profile_phases_enabled(default: bool) -> bool:
     return raw in ("1", "true", "yes", "on")
 
 
+def _device_trace_enabled(default: bool) -> bool:
+    """XPlane device-trace capture on/off: ``PADDLE_TPU_DEVICE_TRACE``
+    overrides either way; unset keeps the caller's default (ON for
+    multichip configs — the host-vs-device cross-check is this bench's
+    trust anchor — OFF for single-chip runs, same convention as the
+    phase breakdown)."""
+    from paddle_tpu.observability import device_trace as dtr
+
+    return dtr.capture_enabled(default)
+
+
 def _profile_record(step_s, flops_total, by_category=None, bf16=False,
                     n_devices=1, program=None, scope=None, feed=None,
-                    mesh=None, phases_default=False):
+                    mesh=None, phases_default=False,
+                    device_default=False):
     """The ``profile`` block every bench record carries — ONE schema
     for single-chip and multichip runs: analytic FLOPs + registry-
     derived ``mfu_est`` always; measured phase breakdown / overlap /
     critical path when phase profiling is enabled and a static program
-    is available (``tools/bench_diff.py`` diffs these fields)."""
+    is available; DEVICE-folded phase breakdown + host-vs-device
+    agreement when XPlane capture is enabled
+    (``tools/bench_diff.py`` diffs these fields)."""
     from paddle_tpu.observability import profiler as prof
 
     rec = {
@@ -217,17 +231,53 @@ def _profile_record(step_s, flops_total, by_category=None, bf16=False,
                 "exposed_collective_ms": rep["exposed_collective_ms"],
                 "serialized_ms": rep["serialized_ms"],
                 "per_bucket": rep["per_bucket"],
+                "backward_segments": rep["backward_segments"],
+                "n_compute": rep["n_compute"],
                 "profiled_step_ms": rep["step_ms"],
                 "exposed_includes_fused_update":
                     rep["exposed_includes_fused_update"],
             })
         except Exception as e:  # the bench number survives a broken
             rec["phase_error"] = repr(e)  # profile, never vice versa
+    if program is not None and _device_trace_enabled(device_default):
+        try:
+            from paddle_tpu.observability import device_trace as dtr
+
+            dev = dtr.device_profile_step(program, scope, feed,
+                                          mesh=mesh)
+            if dev is None:
+                # annotation-less / empty capture: the host numbers
+                # stand alone, flagged so readers know why
+                rec["device_trace"] = {"status": "empty",
+                                       "fallback": "host"}
+            else:
+                rec["device_phase_ms"] = dev["device_phase_ms"]
+                rec["device_overlap_frac"] = dev["overlap_frac"]
+                rec["device_critical_path_ms"] = dev["critical_path_ms"]
+                rec["device_exposed_collective_ms"] = \
+                    dev["exposed_collective_ms"]
+                rec["device_trace"] = {
+                    k: dev[k] for k in ("n_events", "n_attributed",
+                                        "unattributed_ms", "steps",
+                                        "source")}
+                if isinstance(rec.get("phase_ms"), dict):
+                    cc = dtr.cross_check(rec["phase_ms"],
+                                         dev["device_phase_ms"])
+                    rec["host_device_agreement"] = cc["agreement"]
+                    rec["agreement_per_phase"] = cc["per_phase"]
+                    from paddle_tpu import observability as _obs
+
+                    if _obs.enabled() and cc["agreement"] is not None:
+                        _obs.set_gauge("profile.host_device_agreement",
+                                       cc["agreement"])
+        except Exception as e:  # same contract as the host phases
+            rec["device_trace_error"] = repr(e)
     return rec
 
 
 def _program_profile(main, scope, feed, step_s, bf16=False, mesh=None,
-                     n_devices=1, phases_default=False, flops_scale=1):
+                     n_devices=1, phases_default=False, flops_scale=1,
+                     device_default=False):
     """``flops_scale`` converts the PROGRAM's analytic FLOPs into the
     job step's: per-replica-built multichip models (bert/gpt built at
     batch/n, every replica runs one) scale by n_devices so mfu_est is
@@ -240,7 +290,8 @@ def _program_profile(main, scope, feed, step_s, bf16=False, mesh=None,
                             for k, v in fl["by_category"].items()},
                            bf16=bf16, n_devices=n_devices, program=main,
                            scope=scope, feed=feed, mesh=mesh,
-                           phases_default=phases_default)
+                           phases_default=phases_default,
+                           device_default=device_default)
 
 
 def bench_resnet50(batch=128, iters=12, use_bf16=False,
@@ -975,16 +1026,34 @@ def bench_multichip_config(name, iters=None, quant=None, sharded=True):
         # phase breakdown + per-bucket overlap report over the
         # REWRITTEN program (bucketed/sharded collectives in place) —
         # the measured answer to "do the collectives overlap backward
-        # compute". Default-on here: CPU-mesh shapes are small and the
-        # overlap number is this bench's point.
+        # compute" — plus the XPlane device-folded counterpart and its
+        # host-vs-device agreement ratio. Default-on here: CPU-mesh
+        # shapes are small and the overlap number is this bench's
+        # point.
         profile = _program_profile(main, scope, feed, dt,
                                    mesh=mesh, n_devices=MC_DEVICES,
                                    phases_default=True,
+                                   device_default=True,
                                    flops_scale=(MC_DEVICES
                                                 if per_replica else 1))
-    from paddle_tpu.parallel.collectives import (bucket_mb, quant_mode,
+    from paddle_tpu.parallel.collectives import (bucket_mb,
+                                                 bucket_plan_mode,
+                                                 quant_mode,
                                                  sharded_update_enabled)
 
+    collective_rec = {
+        "per_step": per_step,
+        "pergrad_baseline_ops": base_ops,
+        "pergrad_baseline_bytes": base_bytes,
+        "quant_int8_bytes_saved": int(quant_save),
+        # executed bucket layout + which planner produced it —
+        # "demonstrably changes the bucket plan" is assertable from
+        # this block (mc_smoke's profile-guided replan cycle does)
+        "bucket_ops": sum(1 for op in main.global_block().ops
+                          if op.type in ("c_bucket_allreduce",
+                                         "c_sharded_update")),
+        "bucket_plan": getattr(main, "_bucket_plan", None),
+    }
     return {
         "config": name, "mesh": {"dp": MC_DEVICES}, "unit": unit,
         "step_ms": dt * 1e3,
@@ -993,15 +1062,11 @@ def bench_multichip_config(name, iters=None, quant=None, sharded=True):
         "loss": final_loss, "shapes": cfg, "iters": iters,
         "warmup_s": round(t_compile, 1),
         "collective_bytes": per_step.get("parallel.collective_bytes", 0),
-        "collective": {
-            "per_step": per_step,
-            "pergrad_baseline_ops": base_ops,
-            "pergrad_baseline_bytes": base_bytes,
-            "quant_int8_bytes_saved": int(quant_save),
-        },
+        "collective": collective_rec,
         "profile": profile,
         "knobs": {"bucket_mb": bucket_mb(), "quant": quant_mode(),
-                  "sharded_update": sharded_update_enabled()},
+                  "sharded_update": sharded_update_enabled(),
+                  "bucket_plan": bucket_plan_mode()},
     }
 
 

@@ -56,7 +56,7 @@ fi
 # always-on paths (guard threshold, not exact timing — see
 # tools/obs_overhead.py)
 env -u PADDLE_TPU_METRICS -u FLAGS_tpu_metrics \
-    -u PADDLE_TPU_METRICS_DIR \
+    -u PADDLE_TPU_METRICS_DIR -u PADDLE_TPU_DEVICE_TRACE \
     python -m paddle_tpu.tools.obs_overhead
 
 echo "== gate 5: serving =="
@@ -116,12 +116,16 @@ python tools/chaos_drill.py --rounds 1
 python tools/chaos_drill.py --rounds 1 --shards 2 --partition
 
 echo "== gate 7: multichip fast-path smoke =="
-# dp=8 CPU host mesh, mlp config, ~1 min: the bucketed/sharded
+# dp=8 CPU host mesh, mlp config, ~2 min: the bucketed/sharded
 # collective path must STRICTLY reduce per-step collective ops vs a
-# forced per-grad run, the sharded-update parity tests must be
-# bit-for-bit, and tools/bench_diff.py must answer --help and pass
-# its --self-test (the mechanical perf gate bench artifacts diff
-# through)
+# forced per-grad run; ONE profile-guided replan cycle must close the
+# measurement loop (plan -> measure -> feed the profile report back
+# via PADDLE_TPU_BUCKET_PLAN=profile -> the bucket plan demonstrably
+# changes and measured overlap_frac does not decrease, with parity
+# bit-for-bit via pytest); every dp=8 record must carry BOTH the
+# host- and device-measured phase breakdowns plus their agreement
+# ratio; and tools/bench_diff.py must answer --help and pass its
+# --self-test (the mechanical perf gate bench artifacts diff through)
 MC_OUT="$(mktemp)"
 trap 'rm -f "$FP_TMP" "$MC_OUT"' EXIT
 python tools/mc_smoke.py --out "$MC_OUT"

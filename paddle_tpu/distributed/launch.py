@@ -140,15 +140,37 @@ class _Worker:
     """One supervised rank: its env, restart budget, and log sink."""
 
     def __init__(self, local_rank: int, cmd, env, log_dir,
-                 role: str = "trainer"):
+                 role: str = "trainer", metrics_dir=None,
+                 global_rank=None):
         self.local_rank = local_rank
+        # the rank the CHILD will dump under (process_identity reads
+        # the global PADDLE_TRAINER_ID / PADDLE_PSERVER_GLOBAL_INDEX,
+        # not the node-local slot) — clock records must carry the same
+        # name or the merge can never match them to their dump
+        self.global_rank = (local_rank if global_rank is None
+                            else int(global_rank))
         self.cmd = list(cmd)
         self.env = dict(env)
         self.log_dir = log_dir
         self.role = role
+        self.metrics_dir = metrics_dir
         self.restarts = 0
         self.proc: subprocess.Popen = None
         self._fp = None
+        # clock handshake bookkeeping (observability.distributed):
+        # the ping file this incarnation will write, its dump name,
+        # the launcher-clock spawn time, and the newest poll that saw
+        # NO ping yet (tightening the skew window to one poll period)
+        self.clock_ping_path = None
+        self.clock_proc = None
+        self.spawned_at_us = None
+        self.last_absent_poll_us = None
+
+    def _proc_base(self) -> str:
+        base = "%s-%d" % (self.role, self.global_rank)
+        if self.restarts:
+            base += ".r%d" % self.restarts
+        return base
 
     def spawn(self) -> None:
         env = dict(self.env)
@@ -159,6 +181,14 @@ class _Worker:
             # fresh index-0 process claiming the primary role would
             # split the brain
             env["PADDLE_PS_REJOIN"] = "1"
+        if self.metrics_dir:
+            # clock handshake: this incarnation writes its wall clock
+            # here when its telemetry arms; the supervision loop
+            # records the launcher-relative skew for the merge
+            self.clock_proc = self._proc_base()
+            self.clock_ping_path = os.path.join(
+                self.metrics_dir, self.clock_proc + ".clockping")
+            env[_dobs.CLOCK_PING_ENV] = self.clock_ping_path
         stdout = stderr = None
         self.close_log()  # a relaunch must not leak the old handle
         if self.log_dir:
@@ -168,11 +198,50 @@ class _Worker:
                     else "workerlog.%d") % self.local_rank
             self._fp = open(os.path.join(self.log_dir, name), "a")
             stdout = stderr = self._fp
+        self.spawned_at_us = time.time() * 1e6
+        self.last_absent_poll_us = None
         self.proc = subprocess.Popen(self.cmd, env=env, stdout=stdout,
                                      stderr=stderr)
         _flight.record("launch.spawn", role=self.role,
                        rank=self.local_rank, restart=self.restarts,
                        pid=self.proc.pid)
+
+    def poll_clock_ping(self) -> None:
+        """Complete the clock handshake if this worker's ping file
+        appeared: record skew vs the launcher clock, consume the file.
+        Cheap when there is nothing to do (one stat per poll)."""
+        path = self.clock_ping_path
+        if not path:
+            return
+        if not os.path.exists(path):
+            # the ping wasn't there THIS poll: the eventual write must
+            # happen after now, so the skew window shrinks from
+            # "since spawn" (which includes seconds of interpreter +
+            # jax import) to one poll period
+            self.last_absent_poll_us = time.time() * 1e6
+            return
+        try:
+            import json as _json
+
+            with open(path, "r", encoding="utf-8") as f:
+                doc = _json.load(f)
+            child_wall = float(doc.get("wall_us") or 0.0)
+        except (OSError, ValueError):
+            return   # torn write: next poll sees the finished file
+        self.clock_ping_path = None
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        if child_wall and self.spawned_at_us:
+            t0 = max(self.spawned_at_us,
+                     self.last_absent_poll_us or self.spawned_at_us)
+            skew, unc = _dobs.record_clock_offset(
+                self.metrics_dir, self.clock_proc, child_wall,
+                t0, time.time() * 1e6)
+            _flight.record("launch.clock_sync", role=self.role,
+                           rank=self.local_rank,
+                           skew_us=round(skew), uncertainty_us=round(unc))
 
     def close_log(self) -> None:
         if self._fp is not None:
@@ -235,7 +304,12 @@ def launch(args=None):
             env["PADDLE_PSERVER_SHARDS"] = str(nshards)
         cmd = [sys.executable, "-u", args.training_script] + \
             list(args.training_script_args)
-        workers.append(_Worker(local_rank, cmd, env, args.log_dir))
+        workers.append(_Worker(
+            local_rank, cmd, env, args.log_dir,
+            metrics_dir=metrics_dir,
+            # the child dumps under its GLOBAL rank (PADDLE_TRAINER_ID)
+            global_rank=args.node_rank * args.nproc_per_node
+            + local_rank))
 
     servers = []
     for shard, group in enumerate(shard_groups if pserver_eps else []):
@@ -261,7 +335,8 @@ def launch(args=None):
             servers.append(_Worker(
                 pserver_eps.index(ep),
                 [sys.executable, "-u", args.server_script], env,
-                args.log_dir, role="pserver"))
+                args.log_dir, role="pserver",
+                metrics_dir=metrics_dir))
 
     def _terminate_all(sig=signal.SIGTERM):
         for w in workers + servers:
@@ -290,6 +365,11 @@ def launch(args=None):
         # until torn down below)
         while live:
             time.sleep(0.2)
+            for w in workers + servers:
+                # clock handshake: record each child's launcher-
+                # relative skew as soon as its ping lands (the merge
+                # rebases multi-node dumps with it)
+                w.poll_clock_ping()
             for s in servers:
                 code = s.proc.poll()
                 if code is None or code == 0:
@@ -378,6 +458,11 @@ def launch(args=None):
             done_rc = rc if sys.exc_info()[0] is None else 1
             _flight.record("launch.done", rc=done_rc)
             try:
+                for w in workers + servers:
+                    # a short job can finish before the supervision
+                    # loop saw the ping — collect stragglers so the
+                    # merge below still gets its skew records
+                    w.poll_clock_ping()
                 _dobs.dump_process()
                 mpath, tpath = _dobs.merge_job_dir(metrics_dir)
                 if mpath:

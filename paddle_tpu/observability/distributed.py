@@ -59,6 +59,9 @@ __all__ = ["TraceContext", "current", "set_current", "trace",
            "dump_path", "dump_process", "arm", "arm_from_env",
            "clear_stale_dumps", "job_trace_id", "fleet_round_args",
            "load_dumps", "doc_flight_events", "merge_job_dir",
+           "write_clock_ping", "record_clock_offset",
+           "load_clock_offsets", "applied_clock_skew_us",
+           "CLOCK_PING_ENV",
            "JOB_TRACE_ENV", "MERGED_METRICS_NAME", "MERGED_TRACE_NAME"]
 
 MERGED_METRICS_NAME = "metrics.json"
@@ -316,6 +319,101 @@ def dump_path() -> Optional[str]:
     return os.path.join(d, _dump_basename()) if d else None
 
 
+# -- cross-host clock handshake ---------------------------------------------
+#
+# Span/flight rebasing onto ``wall_us`` assumes every process shares
+# one wall clock — true on a single host, wrong across nodes (NTP skew
+# is routinely milliseconds, far above the event gaps being ordered).
+# The launcher therefore PINGS each child's clock at spawn: the child
+# writes its wall-clock reading to a ping file as soon as telemetry
+# arms, the launcher brackets the observation between two readings of
+# its OWN clock (the newest poll that did NOT see the file, and the
+# one that did — one supervision-poll period, ~0.2s) and records
+# ``skew_us = child_wall - midpoint`` with ``uncertainty_us =
+# window/2`` to ``<proc>.clock.json``. The merge subtracts a skew from
+# that process's timestamps only when it exceeds its own uncertainty —
+# a same-host handshake (skew ≈ 0 ± poll window) must not INJECT
+# poll-latency noise into a timeline that was already
+# microsecond-correct. The file handshake's resolution is therefore
+# the poll period: it corrects the unsynced-host / seconds-off-NTP
+# case; sub-poll-period drift needs a real two-way RPC ping (ROADMAP).
+
+CLOCK_PING_ENV = "PADDLE_TPU_CLOCK_PING"
+_CLOCK_SCHEMA = "clock_offset_v1"
+
+
+def write_clock_ping(path: Optional[str] = None) -> Optional[str]:
+    """Child half of the handshake: write this process's wall-clock
+    reading to the ping file the launcher named in
+    ``$PADDLE_TPU_CLOCK_PING``. Called once when telemetry arms; a
+    process outside any launcher (env unset) is a no-op."""
+    if path is None:
+        path = os.environ.get(CLOCK_PING_ENV, "").strip()
+    if not path:
+        return None
+    try:
+        from ..checkpoint import atomic_write_bytes
+
+        atomic_write_bytes(path, json.dumps(
+            {"wall_us": time.time() * 1e6,
+             "pid": os.getpid()}).encode())
+        return path
+    except Exception:
+        return None   # telemetry must never kill work
+
+
+def record_clock_offset(dirname: str, proc: str, child_wall_us: float,
+                        t0_us: float, t1_us: float) -> Tuple[float, float]:
+    """Launcher half: the child reported ``child_wall_us`` at some
+    launcher-time inside ``[t0_us, t1_us]`` (spawn .. ping observed).
+    Estimate the skew against the window midpoint, bound it by the
+    half-window, persist to ``<proc>.clock.json`` for the merge."""
+    skew = float(child_wall_us) - (float(t0_us) + float(t1_us)) / 2.0
+    unc = max(0.0, (float(t1_us) - float(t0_us)) / 2.0)
+    try:
+        from ..checkpoint import atomic_write_bytes
+
+        atomic_write_bytes(
+            os.path.join(dirname, "%s.clock.json" % proc),
+            json.dumps({"schema": _CLOCK_SCHEMA, "proc": proc,
+                        "skew_us": skew, "uncertainty_us": unc,
+                        "measured_at": time.time()}).encode())
+    except Exception:
+        pass
+    return skew, unc
+
+
+def load_clock_offsets(dirname: str) -> Dict[str, Tuple[float, float]]:
+    """{proc: (skew_us, uncertainty_us)} from every ``*.clock.json``
+    the launcher recorded in ``dirname``."""
+    out: Dict[str, Tuple[float, float]] = {}
+    if not os.path.isdir(dirname):
+        return out
+    for path in sorted(os.listdir(dirname)):
+        if not path.endswith(".clock.json"):
+            continue
+        try:
+            with open(os.path.join(dirname, path), "r",
+                      encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and doc.get("schema") == _CLOCK_SCHEMA:
+            out[doc.get("proc")
+                or path[:-len(".clock.json")]] = (
+                float(doc.get("skew_us") or 0.0),
+                float(doc.get("uncertainty_us") or 0.0))
+    return out
+
+
+def applied_clock_skew_us(skew: float, uncertainty: float) -> float:
+    """The correction the merge actually applies: the measured skew
+    when it is distinguishable from the handshake's own noise, else 0
+    (see the section comment — a same-host ping must not smear a
+    microsecond-accurate timeline by its poll latency)."""
+    return skew if abs(skew) > uncertainty else 0.0
+
+
 # -- per-process dumps ------------------------------------------------------
 
 def dump_process(path: Optional[str] = None) -> Optional[str]:
@@ -401,6 +499,10 @@ def arm(dirname: Optional[str] = None,
 
             base = os.path.splitext(_dump_basename())[0]
             tracing._set_spool(SpanSpool.from_env(dirname, base))
+        # clock handshake (child half): tell the launcher what this
+        # host's wall clock reads, as early as telemetry exists — the
+        # narrower the spawn→ping window, the tighter the skew bound
+        write_clock_ping()
         if period_s is None:
             period_s = float(os.environ.get("PADDLE_TPU_DUMP_PERIOD",
                                             "5") or 5)
@@ -478,6 +580,7 @@ def clear_stale_dumps(dirname: str) -> int:
         # any dump after it uses the caller's already-set identity
         for fn in os.listdir(dirname):
             if fn.endswith(".json") or fn.endswith(".jsonl") \
+                    or fn.endswith(".clockping") \
                     or fn.startswith(".tmp-"):
                 try:
                     os.unlink(os.path.join(dirname, fn))
@@ -542,12 +645,19 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
     docs = load_dumps(dirname)
     if not docs:
         return None, None
+    clock_offsets = load_clock_offsets(dirname)
     processes: Dict[str, Dict] = {}
     totals: Dict[str, float] = {}
     events: List[Dict] = []
     metas: List[Dict] = []
     for doc in docs:
         key = doc["proc"]
+        # cross-host clock correction: rebase this process onto the
+        # LAUNCHER's wall clock when the handshake measured a skew
+        # above its own uncertainty (multi-node NTP drift); same-host
+        # dumps keep the microsecond-accurate shared-wall assumption
+        raw_skew, skew_unc = clock_offsets.get(key, (0.0, 0.0))
+        skew = applied_clock_skew_us(raw_skew, skew_unc)
         spooled = load_spooled_spans(dirname, key)
         ring = doc.get("spans") or []
         if spooled is None:
@@ -572,11 +682,15 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
             "span_source": "spool" if spooled is not None else "ring",
             "spool": doc.get("spool"),
             "flight_stats": doc.get("flight_stats"),
+            "clock_skew_us": {"measured": raw_skew,
+                              "uncertainty": skew_unc,
+                              "applied": skew} if (key in clock_offsets)
+            else None,
         }
         for qn, v in (doc.get("metrics") or {}).get("counters",
                                                     {}).items():
             totals[qn] = totals.get(qn, 0) + v
-        off = float(doc.get("clock_offset_us") or 0.0)
+        off = float(doc.get("clock_offset_us") or 0.0) - skew
         pid = int(doc.get("pid") or 0)
         metas.append({"name": "process_name", "ph": "M", "pid": pid,
                       "tid": 0, "args": {"name": key}})
@@ -588,7 +702,7 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
                 entry["args"] = args
             events.append(entry)
         for ts, kind, fields in doc_flight_events(doc):
-            entry = {"name": kind, "ph": "i", "ts": ts,
+            entry = {"name": kind, "ph": "i", "ts": ts - skew,
                      "pid": pid, "tid": 0, "s": "p", "cat": "flight"}
             if fields:
                 entry["args"] = fields

@@ -421,6 +421,69 @@ def _sync_vars(prefix_ops, rest_ops, seg_ops) -> List[str]:
     return sorted(live | (seg_out & written))
 
 
+def _whole_sync(run_ops, persist_written) -> List[str]:
+    """Sync set for a WHOLE-program timing run: every written
+    persistable (param/optimizer-state updates, which the grads and
+    their collectives feed) plus the tail ops' outputs — so XLA cannot
+    dead-code the update chains being timed."""
+    written = {n for op in run_ops for n in op.output_arg_names if n}
+    return sorted((persist_written & written)
+                  | set(_sync_vars(run_ops, (), run_ops[-4:])))
+
+
+def _exec_inputs(program, scope, feed: Dict, mesh=None,
+                 axis_name: str = "dp") -> Dict:
+    """Everything a measurement runner needs to execute ``program`` the
+    way its engine does: staged feed/state arrays, the mesh data axes +
+    shard specs, and a ``make_fn(op_subset, sync_names)`` factory
+    (``_mesh_runner_factory``). Shared by ``profile_step`` and
+    ``device_trace.device_profile_step`` so the two measurements run
+    the SAME execution, host-timed vs device-traced."""
+    import jax.numpy as jnp
+
+    from ..core.compiler_engine import _analyze
+    from ..core.tensor import LoDTensor
+
+    block = program.global_block()
+    ops = list(block.ops)
+    feed_vals = {}
+    for name, value in (feed or {}).items():
+        arr = value.array if isinstance(value, LoDTensor) else \
+            jnp.asarray(np.asarray(value))
+        feed_vals[name] = arr
+    feed_names = tuple(sorted(feed_vals))
+
+    read_first, _written, persist_written = _analyze(program)
+    state = {}
+    for n in sorted(read_first - set(feed_names)):
+        var = scope.find_var(n)
+        if var is None or not var.is_initialized():
+            raise RuntimeError("var %r must be fed or initialized "
+                               "before profiling" % n)
+        state[n] = var.raw().array
+    state_names = tuple(sorted(state))
+
+    data_axes: Tuple[str, ...] = ()
+    shard_specs: Dict = {}
+    feed_specs: Dict = {}
+    if mesh is not None:
+        mesh_axes = set(mesh.axis_names)
+        data_axes = tuple(a for a in (getattr(program, "_data_axes", None)
+                                      or (axis_name,)) if a in mesh_axes)
+        if not data_axes:
+            data_axes = (mesh.axis_names[0],)
+        shard_specs = dict(getattr(program, "_var_shard_specs", None)
+                           or {})
+        feed_specs = dict(getattr(program, "_feed_shard_specs", None)
+                          or {})
+    make_fn = _mesh_runner_factory(block, mesh, data_axes, shard_specs,
+                                   feed_specs, state_names, feed_names)
+    return {"block": block, "ops": ops, "state": state,
+            "feed_vals": feed_vals, "feed_names": feed_names,
+            "state_names": state_names, "data_axes": data_axes,
+            "persist_written": persist_written, "make_fn": make_fn}
+
+
 def _time_call(fn, args, repeats: int) -> float:
     import jax
 
@@ -563,73 +626,35 @@ def profile_step(program, scope, feed: Dict, mesh=None,
     """
     import jax.numpy as jnp
 
-    from ..core.compiler_engine import _analyze
-    from ..core.tensor import LoDTensor
-
     if budget_s is None:
         budget_s = float(os.environ.get("PADDLE_TPU_PROFILE_BUDGET_S",
                                         "120") or 120)
     deadline = time.monotonic() + budget_s
 
-    block = program.global_block()
-    ops = list(block.ops)
-
-    feed_vals = {}
-    for name, value in (feed or {}).items():
-        arr = value.array if isinstance(value, LoDTensor) else \
-            jnp.asarray(np.asarray(value))
-        feed_vals[name] = arr
-    feed_names = tuple(sorted(feed_vals))
-
-    read_first, _written, persist_written = _analyze(program)
-    state = {}
-    for n in sorted(read_first - set(feed_names)):
-        var = scope.find_var(n)
-        if var is None or not var.is_initialized():
-            raise RuntimeError("var %r must be fed or initialized "
-                               "before profiling" % n)
-        state[n] = var.raw().array
-    state_names = tuple(sorted(state))
-
-    data_axes: Tuple[str, ...] = ()
-    shard_specs: Dict = {}
-    feed_specs: Dict = {}
-    if mesh is not None:
-        mesh_axes = set(mesh.axis_names)
-        data_axes = tuple(a for a in (getattr(program, "_data_axes", None)
-                                      or (axis_name,)) if a in mesh_axes)
-        if not data_axes:
-            data_axes = (mesh.axis_names[0],)
-        shard_specs = dict(getattr(program, "_var_shard_specs", None)
-                           or {})
-        feed_specs = dict(getattr(program, "_feed_shard_specs", None)
-                          or {})
+    ctx = _exec_inputs(program, scope, feed, mesh=mesh,
+                       axis_name=axis_name)
+    ops = ctx["ops"]
+    state = ctx["state"]
+    data_axes = ctx["data_axes"]
+    make_fn = ctx["make_fn"]
+    persist_written = ctx["persist_written"]
 
     plan = build_phase_plan(program, max_bucket_cuts=max_bucket_cuts,
                             state=state)
-    make_fn = _mesh_runner_factory(block, mesh, data_axes, shard_specs,
-                                   feed_specs, state_names, feed_names)
     seed_v = jnp.uint32(seed)
-    args = (state, feed_vals, seed_v)
+    args = (state, ctx["feed_vals"], seed_v)
 
-    # full fused step + collective-free step (exposed-collective time).
-    # Both whole-program runs sync the step's REAL output set — every
-    # written persistable (param/optimizer-state updates, which the
-    # grads and their collectives feed) plus the tail ops' outputs —
-    # so XLA cannot dead-code the update chains being timed.
-    def _whole_sync(run_ops):
-        written = {n for op in run_ops for n in op.output_arg_names
-                   if n}
-        return sorted((persist_written & written)
-                      | set(_sync_vars(run_ops, (), run_ops[-4:])))
-
-    t_full = _time_call(make_fn(ops, _whole_sync(ops)), args, repeats)
+    # full fused step + collective-free step (exposed-collective time),
+    # both synced on the step's REAL output set (_whole_sync)
+    t_full = _time_call(make_fn(ops, _whole_sync(ops, persist_written)),
+                        args, repeats)
     compute_ops = [op for op, ph in zip(ops, plan["phases"])
                    if ph != "collective"]
     exposed_measurable = bool(plan["collectives"]) and plan["skippable"]
     if exposed_measurable:
         t_nocoll = _time_call(
-            make_fn(compute_ops, _whole_sync(compute_ops)),
+            make_fn(compute_ops, _whole_sync(compute_ops,
+                                             persist_written)),
             args, repeats)
     else:
         t_nocoll = t_full
@@ -686,6 +711,10 @@ def profile_step(program, scope, feed: Dict, mesh=None,
         per_bucket.append({
             "bucket": c["bucket"], "op": c["type"], "kind": c["kind"],
             "bytes": c["bytes"], "collective_ms": c_ms,
+            # availability position in the compute-only op sequence —
+            # stable across bucket plans (compute ops never move), so a
+            # profile-guided replan can key its budgets on it
+            "avail_pos": c["avail_pos"],
             "backward_after_ms": after,
             "max_hideable_frac": (min(1.0, after / c_ms)
                                   if c_ms > 0 else 0.0),
@@ -719,6 +748,14 @@ def profile_step(program, scope, feed: Dict, mesh=None,
                              if exposed_ms is not None else None),
         "serialized_ms": compute_ms + coll_serial_ms,
         "per_bucket": per_bucket,
+        # what a profile-guided bucket replan consumes
+        # (parallel.collectives.plan_buckets_profile): measured
+        # backward time per compute-position range — positions index
+        # the collective-free op sequence, identical under ANY bucket
+        # plan — plus the sequence length as a compatibility check
+        "backward_segments": [[start, end, ms]
+                              for ms, start, end in bwd_segs],
+        "n_compute": plan["n_compute"],
         # a c_sharded_update fuses the optimizer math INTO the
         # collective op: both the exposed measurement (full minus
         # collective-free) and the serial microbench (which emulates

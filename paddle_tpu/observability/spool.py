@@ -10,10 +10,17 @@ this module makes the *disk* the source of truth:
   ``<proc>.spans-<nnn>.jsonl`` (rotated at
   ``PADDLE_TPU_SPOOL_SEGMENT_MB``, default 8 MB) — startup and warmup,
   the part of a long run the ring always loses first, is kept exactly.
-- **Reservoir**: past the head, uniform reservoir sampling (seeded —
+- **Reservoir**: past the head, WEIGHTED reservoir sampling (seeded —
   ``PADDLE_TPU_SPOOL_SEED``, default 0, so runs are reproducible) over
   the remaining stream, capacity ``PADDLE_TPU_SPOOL_RESERVOIR``
-  (default 65536). The reservoir is rewritten atomically to
+  (default 65536). Each span's keep-weight is its duration times the
+  inverse frequency of its category so far (Efraimidis–Spirakis A-ES
+  keys ``u^(1/w)`` kept in a min-heap), so a rare-but-long span — the
+  one stall in a million fast steps, the single slow rpc — survives
+  with near-certainty where uniform sampling would almost surely
+  evict it, while the bulk of the sample still mirrors the stream.
+  ``PADDLE_TPU_SPOOL_POLICY=uniform`` restores the plain uniform
+  sampler. The reservoir is rewritten atomically to
   ``<proc>.spans-res.json`` on every flush (periodic dumps, exit,
   SIGTERM ride the existing ``distributed.dump_process`` hooks), so a
   SIGKILL loses at most one flush period of reservoir churn — never a
@@ -33,6 +40,7 @@ and ring spans identically.
 from __future__ import annotations
 
 import glob
+import heapq
 import json
 import os
 import random
@@ -49,6 +57,11 @@ _FLUSH_EVERY = 1024   # pending head spans per synchronous file append
 _RES_SCHEMA = "span_reservoir_v1"
 
 
+def _policy_from_env() -> str:
+    raw = os.environ.get("PADDLE_TPU_SPOOL_POLICY", "").strip().lower()
+    return "uniform" if raw == "uniform" else "weighted"
+
+
 class SpanSpool:
     """Head + seeded-reservoir span spooler for one process."""
 
@@ -56,7 +69,8 @@ class SpanSpool:
                  head: int = DEFAULT_HEAD,
                  reservoir: int = DEFAULT_RESERVOIR,
                  segment_bytes: int = DEFAULT_SEGMENT_BYTES,
-                 seed: int = 0, flush_every: int = _FLUSH_EVERY):
+                 seed: int = 0, flush_every: int = _FLUSH_EVERY,
+                 policy: Optional[str] = None):
         self.dirname = dirname
         self.base = base
         self.head = max(0, int(head))
@@ -65,11 +79,17 @@ class SpanSpool:
         self._flush_every = max(1, int(flush_every))
         self._rng = random.Random(int(seed))
         self._lock = threading.Lock()
+        self.policy = policy if policy in ("uniform", "weighted") \
+            else _policy_from_env()
         self._offered = 0          # spans ever offered
         self._head_kept = 0
         self._pending: List[Tuple] = []   # head spans not yet on disk
-        self._res: List[Tuple[int, Tuple]] = []  # (stream idx, span)
+        # uniform: [(stream idx, span)];
+        # weighted: min-HEAP of (A-ES key, stream idx, span) — the
+        # root is always the entry with the weakest claim to survive
+        self._res: List[Tuple] = []
         self._res_seen = 0         # post-head spans seen
+        self._cat_seen: Dict[object, int] = {}  # per-category counts
         self._res_dirty = False
         self._seg_idx = 0
         self._seg_bytes = 0
@@ -92,9 +112,25 @@ class SpanSpool:
 
     # -- recording ---------------------------------------------------------
 
+    def _weight(self, ev: Tuple) -> float:
+        """Keep-weight of a span: duration x inverse category
+        frequency. Long spans outweigh short ones; spans of a category
+        seen once per million outweigh the million — "rare but long"
+        compounds both, which is exactly the event a postmortem needs
+        and a uniform sample loses."""
+        try:
+            dur = float(ev[2])
+        except (TypeError, ValueError, IndexError):
+            dur = 0.0
+        cat = ev[4] if len(ev) > 4 else None
+        seen = self._cat_seen.get(cat, 0) + 1
+        self._cat_seen[cat] = seen
+        rarity = self._res_seen / float(seen)
+        return max(dur, 1.0) * max(rarity, 1.0)
+
     def offer(self, ev: Tuple) -> None:
         """Called by ``tracing._record`` for every completed span.
-        Cheap: a counter, a list append, and (amortized) one file
+        Cheap: a counter, a list/heap append, and (amortized) one file
         append per ``flush_every`` head spans. The append happens
         under the lock — concurrent recording threads' batches must
         reach the segment file in stream order (the head's contract),
@@ -109,7 +145,22 @@ class SpanSpool:
                     self._append_segment_locked(batch)
             elif self.res_cap:
                 self._res_seen += 1
-                if len(self._res) < self.res_cap:
+                if self.policy == "weighted":
+                    # Efraimidis–Spirakis A-ES: key = u^(1/w); keeping
+                    # the res_cap LARGEST keys is a weighted sample
+                    # without replacement. Seeded rng ⇒ reproducible.
+                    w = self._weight(ev)
+                    u = self._rng.random() or 1e-12
+                    key = u ** (1.0 / w)
+                    if len(self._res) < self.res_cap:
+                        heapq.heappush(self._res,
+                                       (key, self._offered, ev))
+                        self._res_dirty = True
+                    elif key > self._res[0][0]:
+                        heapq.heapreplace(self._res,
+                                          (key, self._offered, ev))
+                        self._res_dirty = True
+                elif len(self._res) < self.res_cap:
                     self._res.append((self._offered, ev))
                     self._res_dirty = True
                 else:
@@ -158,7 +209,8 @@ class SpanSpool:
                 self._append_segment_locked(batch)
             res_dirty = self._res_dirty
             self._res_dirty = False
-            res_snapshot = sorted(self._res) if res_dirty else None
+            res_snapshot = (self._res_events_locked() if res_dirty
+                            else None)
             stats = self._stats_locked()
         if res_snapshot is not None:
             try:
@@ -166,11 +218,19 @@ class SpanSpool:
 
                 doc = {"schema": _RES_SCHEMA, "proc": self.base,
                        "stats": stats,
-                       "events": [list(ev) for _, ev in res_snapshot]}
+                       "events": [list(ev) for ev in res_snapshot]}
                 atomic_write_bytes(self._res_path(),
                                    json.dumps(doc, default=str).encode())
             except Exception:
                 pass
+
+    def _res_events_locked(self) -> List[Tuple]:
+        """Reservoir spans in stream order, either policy's entry
+        shape ((idx, ev) uniform / (key, idx, ev) weighted heap)."""
+        if self.policy == "weighted":
+            return [t[2] for t in sorted(self._res,
+                                         key=lambda t: t[1])]
+        return [ev for _, ev in sorted(self._res)]
 
     def _stats_locked(self) -> Dict[str, int]:
         return {"offered": self._offered,
@@ -178,6 +238,7 @@ class SpanSpool:
                 "reservoir_kept": len(self._res),
                 "reservoir_seen": self._res_seen,
                 "sampled_out": max(0, self._res_seen - len(self._res)),
+                "policy": self.policy,
                 "segments": self._seg_idx + 1}
 
     def stats(self) -> Dict[str, int]:

@@ -32,20 +32,37 @@ Two PAPERS.md blueprints, applied as passes after
   ``PADDLE_TPU_SHARDED_UPDATE=1`` or
   ``BuildStrategy.fuse_all_optimizer_ops``.
 
+- **Profile-guided bucket planning** (``plan_buckets_profile``,
+  ``PADDLE_TPU_BUCKET_PLAN=profile``): bucket boundaries chosen from a
+  saved step-profile report (``PADDLE_TPU_BUCKET_PROFILE`` names the
+  json — a bench record, its ``profile`` block, or a raw
+  ``profiler.profile_step`` dict) instead of the byte cap: a cost
+  model fitted to the measured per-bucket costs prices every candidate
+  bucket against the measured backward compute remaining after its
+  availability point, so buckets close exactly where the measurement
+  says further coalescing would expose wire time (DynaFlow-style
+  scheduling from measured operator timing, PAPERS.md). Bit-for-bit
+  like any bucketing; a missing/stale report falls back to the size
+  plan (``parallel.bucket_plan{mode=}`` records which ran).
+
 Knob summary (read once per program, at first mesh run):
 
-=============================  =============================================
-``PADDLE_TPU_BUCKET_MB``       bucket cap in MB (default 4; ``0`` disables
-                               bucketing). ``BuildStrategy.
-                               fuse_all_reduce_ops=False`` also disables.
-``PADDLE_TPU_QUANT_ALLREDUCE`` ``bf16`` | ``int8`` (default off/exact)
-``PADDLE_TPU_SHARDED_UPDATE``  ``1`` enables, ``0`` forces off (overrides
-                               the BuildStrategy knob either way)
-=============================  =============================================
+==============================  ============================================
+``PADDLE_TPU_BUCKET_MB``        bucket cap in MB (default 4; ``0`` disables
+                                bucketing). ``BuildStrategy.
+                                fuse_all_reduce_ops=False`` also disables.
+``PADDLE_TPU_QUANT_ALLREDUCE``  ``bf16`` | ``int8`` (default off/exact)
+``PADDLE_TPU_SHARDED_UPDATE``   ``1`` enables, ``0`` forces off (overrides
+                                the BuildStrategy knob either way)
+``PADDLE_TPU_BUCKET_PLAN``      ``size`` (default) | ``profile``
+``PADDLE_TPU_BUCKET_PROFILE``   path to the saved profile report the
+                                ``profile`` plan consumes
+==============================  ============================================
 """
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -55,6 +72,11 @@ from ..ops.collective_ops import QUANT_WIRE_ITEMSIZE, SHARDED_UPDATE_SLOTS
 from .transpiler import _bump_version, _merge_data_axes
 
 DEFAULT_BUCKET_MB = 4.0
+
+# profile-guided planner: stay safely under the measured hide budget —
+# a bucket predicted to cost more than this fraction of the backward
+# compute remaining after its anchor is closed early instead
+PROFILE_PLAN_BUDGET_FRAC = 0.5
 
 # optimizer ops whose update math is elementwise in (param, grad, state)
 # — the precondition for flat-shard updates being bit-for-bit with the
@@ -85,6 +107,47 @@ def quant_mode() -> str:
         raise ValueError(
             "PADDLE_TPU_QUANT_ALLREDUCE=%r (want bf16 or int8)" % raw)
     return raw
+
+
+def bucket_plan_mode() -> str:
+    """``PADDLE_TPU_BUCKET_PLAN``: ``size`` (default — the static
+    byte-cap greedy plan) or ``profile`` (measurement-driven: bucket
+    boundaries chosen against a saved ``profile_step`` report named by
+    ``PADDLE_TPU_BUCKET_PROFILE``)."""
+    raw = os.environ.get("PADDLE_TPU_BUCKET_PLAN", "").strip().lower()
+    if raw in ("", "size", "static"):
+        return "size"
+    if raw == "profile":
+        return "profile"
+    raise ValueError(
+        "PADDLE_TPU_BUCKET_PLAN=%r (want size or profile)" % raw)
+
+
+def load_profile_report(path: Optional[str] = None) -> Optional[Dict]:
+    """The saved step-profile report a profile-guided plan consumes:
+    a ``profiler.profile_step`` dict (or a bench record / ``profile``
+    block wrapping one) with ``per_bucket`` (measured per-bucket cost
+    vs bytes) and ``backward_segments`` (measured backward time per
+    compute-position range). None when the path is unset/unreadable or
+    the document lacks the required fields — callers fall back to the
+    size plan, never crash the step."""
+    if path is None:
+        path = os.environ.get("PADDLE_TPU_BUCKET_PROFILE", "").strip()
+    if not path:
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("profile"), dict):
+        doc = doc["profile"]
+    if not isinstance(doc.get("per_bucket"), list) \
+            or not isinstance(doc.get("backward_segments"), list):
+        return None
+    return doc
 
 
 def sharded_update_enabled(build_strategy=None) -> bool:
@@ -153,9 +216,12 @@ def maybe_rewrite_collectives(program, scope, nranks: int, data_axes,
                                     axis=data_axes[0], quant=quant)
     resync_sharded_state(program, scope)
     mb = bucket_mb(build_strategy)
+    plan = bucket_plan_mode()
+    report = load_profile_report() if plan == "profile" else None
     if mb > 0:
         bucket_allreduce_ops(program, bucket_bytes=int(mb * (1 << 20)),
-                             quant=quant, scope=scope)
+                             quant=quant, scope=scope, plan=plan,
+                             report=report)
     elif quant != "none":
         # quantization without bucketing: rewrite per-grad allreduces
         # into single-member bucket ops so the payload still compresses
@@ -210,13 +276,122 @@ def plan_buckets(items, bucket_bytes: int):
     return buckets
 
 
+def _fit_cost_model(report) -> Optional[Tuple[float, float]]:
+    """(intercept_ms, ms_per_byte) fitted to the report's measured
+    per-bucket collective costs — the cost model the profile-guided
+    planner prices candidate buckets with. With one measured point the
+    per-op latency and the bandwidth term cannot be separated; a small
+    fixed floor (10% of the measured cost) stands in for the latency so
+    the planner never treats splitting as free and shatters the plan
+    back to per-grad."""
+    pts = [(float(b.get("bytes") or 0), float(b.get("collective_ms") or 0))
+           for b in report.get("per_bucket") or []
+           if (b.get("collective_ms") or 0) > 0
+           and (b.get("bytes") or 0) > 0]
+    if not pts:
+        return None
+    xs = [p[0] for p in pts]
+    ys = [p[1] for p in pts]
+    if len(set(xs)) >= 2:
+        n = float(len(pts))
+        mx = sum(xs) / n
+        my = sum(ys) / n
+        var = sum((x - mx) ** 2 for x in xs)
+        slope = sum((x - mx) * (y - my) for x, y in pts) / var
+        icept = my - slope * mx
+        if slope <= 0:   # degenerate fit (noise-dominated): fall back
+            slope = my / mx if mx else 0.0
+            icept = 0.0
+        return max(0.0, icept), max(0.0, slope)
+    icept = 0.1 * ys[0]
+    slope = max(0.0, ys[0] - icept) / xs[0] if xs[0] else 0.0
+    return icept, slope   # model reproduces the measured point
+
+
+def plan_buckets_profile(items, report, bucket_bytes: int,
+                         compute_pos) -> Optional[List[Dict]]:
+    """Measurement-driven bucketing (DynaFlow-style: scheduling from
+    measured operator timing, PAPERS.md).
+
+    ``items`` is the same ``(anchor, first_use, key, nbytes, idx)``
+    list ``plan_buckets`` takes; ``report`` a saved ``profile_step``
+    report; ``compute_pos(op_index)`` maps an anchor to its position in
+    the collective-free op sequence (the coordinate system the
+    report's ``backward_segments`` measure — identical under any
+    bucket plan, since only collective ops move).
+
+    The rule the measurement drives: a bucket's predicted serial cost
+    (fitted ``a + b*bytes`` model) must stay under
+    ``PROFILE_PLAN_BUDGET_FRAC`` of the measured backward compute
+    remaining after its availability point — the report's
+    ``max_hideable_frac`` budget. Growing a bucket both raises its
+    cost and (by dragging the anchor later) shrinks its budget, so
+    buckets close exactly where the measurement says further
+    coalescing would expose wire time; grads whose own budget is
+    already ~zero (produced at the very end of backward — nothing left
+    to hide behind) merge into one tail bucket per key, minimizing op
+    count where overlap is impossible. The byte cap and the
+    first-consumer ordering constraint still bind. Returns None when
+    the report carries no usable cost model (caller falls back to the
+    size plan)."""
+    model = _fit_cost_model(report)
+    segs = [s for s in (report.get("backward_segments") or [])
+            if isinstance(s, (list, tuple)) and len(s) == 3]
+    if model is None or not segs:
+        return None
+    icept, slope = model
+
+    def cost(nbytes):
+        return icept + slope * nbytes
+
+    def hide(pos):
+        return sum(float(ms) for _s, e, ms in segs if e > pos)
+
+    frac = PROFILE_PLAN_BUDGET_FRAC
+    buckets: List[Dict] = []
+    open_by_key: Dict = {}
+    tail_by_key: Dict = {}
+    for anchor, first_use, key, nbytes, idx in sorted(items):
+        pos = compute_pos(anchor)
+        budget = hide(pos)
+        hideable = budget > 0.0 and cost(nbytes) - icept < budget
+        store = open_by_key if hideable else tail_by_key
+        b = store.get(key)
+        if b is not None:
+            new_anchor = max(b["anchor"], anchor)
+            # same cap contract as plan_buckets: bucket_bytes <= 0
+            # means one bucket per grad (nothing ever coalesces)
+            fits_cap = (bucket_bytes > 0
+                        and b["bytes"] + nbytes <= bucket_bytes)
+            ordered = (new_anchor + 1 <= min(b["min_use"], first_use))
+            fits_budget = (not hideable) or (
+                cost(b["bytes"] + nbytes)
+                <= frac * hide(compute_pos(new_anchor)))
+            if not (fits_cap and ordered and fits_budget):
+                b = None
+        if b is None:
+            b = {"members": [], "bytes": 0, "anchor": -1,
+                 "min_use": first_use, "key": key}
+            buckets.append(b)
+            store[key] = b
+        b["members"].append(idx)
+        b["bytes"] += nbytes
+        b["anchor"] = max(b["anchor"], anchor)
+        b["min_use"] = min(b["min_use"], first_use)
+    return buckets
+
+
 def bucket_allreduce_ops(program, bucket_bytes: int = 4 << 20,
-                         quant: str = "none", scope=None) -> int:
+                         quant: str = "none", scope=None,
+                         plan: str = "size", report=None) -> int:
     """Coalesce per-grad ``c_allreduce_sum`` ops into
     ``c_bucket_allreduce`` ops (one flat psum per bucket), hoisted to
     each bucket's availability point. Returns the number of bucket ops
     emitted (0 = nothing to do). ``bucket_bytes <= 0`` means "one
-    bucket per grad" — used to apply quantization without coalescing."""
+    bucket per grad" — used to apply quantization without coalescing.
+    ``plan="profile"`` with a loaded ``report`` switches the boundary
+    choice to ``plan_buckets_profile`` (falling back to the size plan
+    when the report doesn't fit this program)."""
     if getattr(program, "_allreduce_bucketed", False):
         return 0
     program._allreduce_bucketed = True
@@ -266,7 +441,40 @@ def bucket_allreduce_ops(program, bucket_bytes: int = 4 << 20,
     if not items:
         return 0
 
-    buckets = plan_buckets(items, bucket_bytes)
+    mode_used = "size"
+    buckets = None
+    if plan == "profile" and report is not None:
+        # positions in the collective-free op sequence — the report's
+        # coordinate system; a report from a different program shape
+        # (stale file, wrong model) is detected and ignored
+        cpos = []
+        k = 0
+        for op in ops:
+            cpos.append(k)
+            if not op.type.startswith("c_"):
+                k += 1
+        if int(report.get("n_compute") or -1) == k:
+            def compute_pos(anchor):
+                if anchor < 0:
+                    return 0
+                p = cpos[anchor]
+                return p + (0 if ops[anchor].type.startswith("c_") else 1)
+
+            buckets = plan_buckets_profile(items, report, bucket_bytes,
+                                           compute_pos)
+            if buckets is not None:
+                mode_used = "profile"
+    if buckets is None:
+        buckets = plan_buckets(items, bucket_bytes)
+    from .. import observability as _obs
+
+    _obs.inc("parallel.bucket_plan", mode=mode_used)
+    program._bucket_plan = {
+        "requested": plan, "mode": mode_used,
+        "n_buckets": len(buckets),
+        "bucket_bytes": [b["bytes"] for b in buckets],
+        "anchors": [b["anchor"] for b in buckets],
+    }
     removed = set()
     # bucket ops to splice in right AFTER the op at index `anchor`
     # (anchor -1 = before everything)

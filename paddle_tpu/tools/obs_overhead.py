@@ -109,6 +109,19 @@ def main():
           "(budget %.1fus)" % (annot_cost, PRIMITIVE_BUDGET_US))
     ok = ok and annot_cost < PRIMITIVE_BUDGET_US
 
+    # ISSUE 10: XPlane device-trace capture must default OFF — the
+    # bench/runtime only consult one env read, nothing armed, no
+    # jax.profiler import on the default path
+    from paddle_tpu.observability import device_trace as dtr
+
+    assert not dtr.capture_enabled(), \
+        "device-trace capture must default off " \
+        "(PADDLE_TPU_DEVICE_TRACE unset)"
+    dtr_cost = _bench_primitive(dtr.capture_enabled)
+    print("device-trace disabled cost: capture_enabled()=%.3fus "
+          "(budget %.1fus)" % (dtr_cost, PRIMITIVE_BUDGET_US))
+    ok = ok and dtr_cost < PRIMITIVE_BUDGET_US
+
     # tiny 2-op program: measure real steps, project the per-step
     # instrumentation cost from the primitive costs above
     import numpy as np

@@ -21,7 +21,8 @@ from paddle_tpu.parallel import collectives
 from paddle_tpu.parallel.mesh_utils import make_mesh
 
 KNOBS = ("PADDLE_TPU_BUCKET_MB", "PADDLE_TPU_QUANT_ALLREDUCE",
-         "PADDLE_TPU_SHARDED_UPDATE")
+         "PADDLE_TPU_SHARDED_UPDATE", "PADDLE_TPU_BUCKET_PLAN",
+         "PADDLE_TPU_BUCKET_PROFILE")
 
 
 @pytest.fixture(autouse=True)
@@ -549,3 +550,145 @@ def test_collective_counters_by_kind_and_bucketing_win():
                              kind="allreduce") == 1
     assert obs.counter_value("parallel.collective_ops",
                              kind="allgather") == 1
+
+
+# -- profile-guided bucket planning (ISSUE 10) ------------------------------
+
+
+def test_bucket_plan_knob_parsing(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_BUCKET_PLAN", raising=False)
+    assert collectives.bucket_plan_mode() == "size"
+    for raw, want in (("profile", "profile"), ("SIZE", "size"),
+                      ("static", "size"), ("", "size")):
+        monkeypatch.setenv("PADDLE_TPU_BUCKET_PLAN", raw)
+        assert collectives.bucket_plan_mode() == want
+    monkeypatch.setenv("PADDLE_TPU_BUCKET_PLAN", "vibes")
+    with pytest.raises(ValueError):
+        collectives.bucket_plan_mode()
+
+
+def test_load_profile_report(tmp_path, monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_BUCKET_PROFILE", raising=False)
+    assert collectives.load_profile_report() is None
+    assert collectives.load_profile_report(
+        str(tmp_path / "missing.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert collectives.load_profile_report(str(bad)) is None
+    # a report missing its measured fields is refused, not guessed at
+    import json as _json
+
+    inc = tmp_path / "inc.json"
+    inc.write_text(_json.dumps({"per_bucket": []}))
+    assert collectives.load_profile_report(str(inc)) is None
+    good = {"per_bucket": [{"bytes": 8, "collective_ms": 1.0}],
+            "backward_segments": [[0, 4, 2.0]], "n_compute": 9}
+    ok = tmp_path / "ok.json"
+    ok.write_text(_json.dumps(good))
+    assert collectives.load_profile_report(str(ok)) == good
+    # a bench record wrapping the report under "profile" unwraps
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(_json.dumps({"loss": 1.0, "profile": good}))
+    assert collectives.load_profile_report(str(wrapped)) == good
+    # env-named path works too
+    monkeypatch.setenv("PADDLE_TPU_BUCKET_PROFILE", str(ok))
+    assert collectives.load_profile_report() == good
+
+
+def test_plan_buckets_profile_splits_early_merges_tail():
+    K = (0, "float32")
+    # measured story: backward spans positions [0, 10) and takes 10ms;
+    # the (single) measured bucket cost 10ms for 100 bytes => slope
+    # 0.1 ms/B, intercept 0.1*10ms = 1ms
+    report = {"backward_segments": [[0, 10, 10.0]],
+              "per_bucket": [{"bytes": 100, "collective_ms": 10.0}],
+              "n_compute": 11}
+    # grads early in backward: each alone costs 1+3=4ms <= 0.5*10ms,
+    # together 1+6=7ms > 5ms budget -> the planner must split where
+    # the size plan (huge cap) would have merged them
+    items = [(0, 100, K, 30, 0), (2, 100, K, 30, 1),
+             # grads at the very end of backward (hide budget 0):
+             # merged into ONE tail bucket, not per-grad
+             (9, 100, K, 30, 2), (9, 100, K, 40, 3)]
+    buckets = collectives.plan_buckets_profile(
+        items, report, bucket_bytes=1 << 20,
+        compute_pos=lambda a: a + 1)
+    assert [b["members"] for b in buckets] == [[0], [1], [2, 3]]
+    # the same items under the size plan: one late bucket — the
+    # measurement is what changed the schedule
+    assert [b["members"] for b in collectives.plan_buckets(
+        items, 1 << 20)] == [[0, 1, 2, 3]]
+    # byte cap still binds in profile mode
+    capped = collectives.plan_buckets_profile(
+        items, report, bucket_bytes=35, compute_pos=lambda a: a + 1)
+    assert all(b["bytes"] <= 35 or len(b["members"]) == 1
+               for b in capped)
+    # an unusable report (no measured cost) refuses to plan
+    assert collectives.plan_buckets_profile(
+        items, {"backward_segments": [[0, 10, 10.0]], "per_bucket": []},
+        1 << 20, compute_pos=lambda a: a + 1) is None
+
+
+def test_profile_plan_bit_for_bit(tmp_path):
+    """The replanned program must stay bit-for-bit with the per-grad
+    path (the same psum algebra as any bucketing) while demonstrably
+    using a DIFFERENT, measurement-driven bucket layout."""
+    import json as _json
+
+    # a report shaped for the test model: positions from the plain
+    # program (compute ops are identical under any bucket plan)
+    with fluid.unique_name.guard():
+        main, _startup, _loss = _build(_momentum)
+    from paddle_tpu.observability.profiler import classify_ops
+
+    phases = classify_ops(main.global_block())
+    n_compute = len(phases)
+    fwd_end = sum(1 for p in phases if p == "forward")
+    bwd_end = sum(1 for p in phases if p in ("forward", "backward"))
+    report = {"n_compute": n_compute,
+              "backward_segments": [[fwd_end, bwd_end, 10.0]],
+              # slope steep enough that coalescing ALL grads blows the
+              # hide budget -> the profile plan must split
+              "per_bucket": [{"bytes": 256, "collective_ms": 1.0}]}
+    rpt = tmp_path / "report.json"
+    rpt.write_text(_json.dumps(report))
+
+    snap = {}
+    base_loss, base, t0 = _run_mesh({"PADDLE_TPU_BUCKET_MB": "0"},
+                                    _momentum, snap)
+    prof_loss, prof_state, t1 = _run_mesh(
+        {"PADDLE_TPU_BUCKET_PLAN": "profile",
+         "PADDLE_TPU_BUCKET_PROFILE": str(rpt)}, _momentum, snap)
+    assert t0.count("c_allreduce_sum") == 4
+    assert "c_allreduce_sum" not in t1
+    # the measurement split the plan (the size plan coalesces these 4
+    # grads into ONE bucket — test_bucketed_allreduce_bit_for_bit)
+    assert t1.count("c_bucket_allreduce") >= 2
+    assert prof_loss == base_loss
+    _assert_params_equal(base, prof_state)
+
+
+def test_profile_plan_falls_back_without_report(tmp_path):
+    """plan=profile with a missing/stale report must quietly use the
+    size plan — a deleted report file can never break training."""
+    snap = {}
+    _, base, t_default = _run_mesh({}, _momentum, snap)
+    # missing file
+    _, got, t1 = _run_mesh(
+        {"PADDLE_TPU_BUCKET_PLAN": "profile",
+         "PADDLE_TPU_BUCKET_PROFILE": str(tmp_path / "nope.json")},
+        _momentum, snap)
+    assert t1 == t_default
+    _assert_params_equal(base, got)
+    # stale report (n_compute mismatch): detected, ignored
+    import json as _json
+
+    stale = tmp_path / "stale.json"
+    stale.write_text(_json.dumps(
+        {"n_compute": 99999, "backward_segments": [[0, 5, 1.0]],
+         "per_bucket": [{"bytes": 8, "collective_ms": 1.0}]}))
+    _, got2, t2 = _run_mesh(
+        {"PADDLE_TPU_BUCKET_PLAN": "profile",
+         "PADDLE_TPU_BUCKET_PROFILE": str(stale)}, _momentum, snap)
+    assert t2 == t_default
+    _assert_params_equal(base, got2)

@@ -333,3 +333,148 @@ def test_ft_timeline_loads_ordered_events(tmp_path, monkeypatch):
     assert any("rpc.failover" in ln for ln in lines)
     all_lines = ft_timeline.format_events(events, show_frames=True)
     assert any("rpc.send" in ln for ln in all_lines)
+
+
+# -- cross-host clock handshake (ISSUE 10) ----------------------------------
+
+
+def _synthetic_dump(d, proc, spans, clock_offset_us=0.0, flight_evs=()):
+    doc = {"schema": 1, "proc": proc, "role": proc.split("-")[0],
+           "rank": 0, "restart": 0, "pid": hash(proc) % 100000,
+           "wrote_at": 0.0, "clock_offset_us": clock_offset_us,
+           "metrics": {"counters": {}},
+           "spans": [list(s) for s in spans],
+           "flight": [list(f) for f in flight_evs]}
+    with open(os.path.join(d, proc + ".json"), "w") as f:
+        json.dump(doc, f)
+
+
+def test_clock_ping_write_and_record_roundtrip(tmp_path, monkeypatch):
+    ping = str(tmp_path / "trainer-0.clockping")
+    monkeypatch.setenv(dist.CLOCK_PING_ENV, ping)
+    assert dist.write_clock_ping() == ping
+    doc = json.load(open(ping))
+    assert doc["wall_us"] > 0 and doc["pid"] == os.getpid()
+    # env unset: a lone process is a no-op
+    monkeypatch.delenv(dist.CLOCK_PING_ENV)
+    assert dist.write_clock_ping() is None
+
+    # launcher half: child clock 5s AHEAD, observed in a 2ms window
+    skew, unc = dist.record_clock_offset(
+        str(tmp_path), "trainer-0", child_wall_us=15_000_000.0,
+        t0_us=10_000_000.0, t1_us=10_002_000.0)
+    assert skew == pytest.approx(5_000_000.0 - 1_000.0)
+    assert unc == pytest.approx(1_000.0)
+    offs = dist.load_clock_offsets(str(tmp_path))
+    assert offs["trainer-0"] == (pytest.approx(skew),
+                                 pytest.approx(unc))
+    # significant skew applies; same-host noise (|skew| <= unc) does not
+    assert dist.applied_clock_skew_us(skew, unc) == skew
+    assert dist.applied_clock_skew_us(400.0, 1_000.0) == 0.0
+
+
+def test_merge_rebases_skewed_host_onto_launcher_clock(tmp_path):
+    """Two dumps: trainer-0 on the launcher's host, trainer-1 on a
+    host whose wall clock runs 5s ahead. Both record the SAME physical
+    instant; without the handshake the merge shows them 5s apart, with
+    it they line up."""
+    d = str(tmp_path)
+    # both spans at perf-time 1.0s with wall==perf on their own hosts,
+    # but host B's wall (and thus its clock_offset_us snapshot) is +5s
+    _synthetic_dump(d, "trainer-0", [["step", 1_000_000.0, 10.0, 0,
+                                      "step", None]],
+                    clock_offset_us=0.0,
+                    flight_evs=[[1_000_000.0, "launch.spawn", {}]])
+    _synthetic_dump(d, "trainer-1", [["step", 1_000_000.0, 10.0, 0,
+                                      "step", None]],
+                    clock_offset_us=5_000_000.0,
+                    flight_evs=[[1_000_000.0, "launch.spawn", {}]])
+    dist.record_clock_offset(d, "trainer-1",
+                             child_wall_us=5_000_000.0, t0_us=0.0,
+                             t1_us=2_000.0)
+    mpath, tpath = dist.merge_job_dir(d)
+    trace = json.load(open(tpath))
+    by_proc = {}
+    pids = {e["args"]["name"]: e["pid"] for e in trace["traceEvents"]
+            if e.get("ph") == "M"}
+    for e in trace["traceEvents"]:
+        if e.get("ph") == "X" and e["name"] == "step":
+            by_proc[e["pid"]] = e["ts"]
+    t0 = by_proc[pids["trainer-0"]]
+    t1 = by_proc[pids["trainer-1"]]
+    # rebased within the handshake's uncertainty (1ms), not 5s apart
+    assert abs(t1 - t0) <= 2_000.0, (t0, t1)
+    # flight instants rebase identically
+    flights = {e["pid"]: e["ts"] for e in trace["traceEvents"]
+               if e.get("cat") == "flight"}
+    assert abs(flights[pids["trainer-1"]]
+               - flights[pids["trainer-0"]]) <= 2_000.0
+    # the merged metrics name what was applied, per process
+    merged = json.load(open(mpath))
+    cs = merged["processes"]["trainer-1"]["clock_skew_us"]
+    assert cs and abs(cs["applied"]) > 4_000_000.0
+    assert merged["processes"]["trainer-0"]["clock_skew_us"] is None
+
+
+def test_merge_ignores_subuncertainty_skew(tmp_path):
+    """A same-host handshake (skew within its own uncertainty) must
+    not perturb the timeline at all."""
+    d = str(tmp_path)
+    _synthetic_dump(d, "trainer-0", [["step", 1_000_000.0, 10.0, 0,
+                                      "step", None]])
+    # measured skew 300us, but the poll window was 1ms wide
+    dist.record_clock_offset(d, "trainer-0", child_wall_us=300.0,
+                             t0_us=-1_000.0, t1_us=1_000.0)
+    _, tpath = dist.merge_job_dir(d)
+    trace = json.load(open(tpath))
+    (ev,) = [e for e in trace["traceEvents"]
+             if e.get("ph") == "X" and e["name"] == "step"]
+    assert ev["ts"] == pytest.approx(1_000_000.0)
+
+
+def test_clear_stale_dumps_removes_clock_files(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    (tmp_path / "trainer-0.clockping").write_text("{}")
+    dist.record_clock_offset(d, "trainer-0", 1.0, 0.0, 2.0)
+    assert (tmp_path / "trainer-0.clock.json").exists()
+    assert dist.clear_stale_dumps(d) >= 2
+    assert not os.listdir(d)
+
+
+def test_launch_worker_clock_handshake(tmp_path):
+    """Launcher-side unit: a _Worker whose ping file appears gets a
+    recorded clock offset named after its dump identity."""
+    from paddle_tpu.distributed.launch import _Worker
+
+    # local slot 2 on node 1 of an 8-per-node job: the child dumps as
+    # trainer-10 (global PADDLE_TRAINER_ID), and the clock record must
+    # carry the SAME name or the merge can never match them
+    w = _Worker(2, ["true"], {}, None, role="trainer",
+                metrics_dir=str(tmp_path), global_rank=10)
+    w.restarts = 1
+    w.spawned_at_us = 1_000_000.0
+    w.clock_proc = w._proc_base()
+    assert w.clock_proc == "trainer-10.r1"
+    w.clock_ping_path = os.path.join(str(tmp_path),
+                                     w.clock_proc + ".clockping")
+    w.metrics_dir = str(tmp_path)
+    # no ping yet: the poll is cheap AND tightens the skew window —
+    # the eventual write must postdate this observation
+    w.poll_clock_ping()
+    assert w.last_absent_poll_us is not None
+    absent_at = w.last_absent_poll_us
+    with open(w.clock_ping_path, "w") as f:
+        json.dump({"wall_us": 9_000_000.0, "pid": 1}, f)
+    w.poll_clock_ping()
+    offs = dist.load_clock_offsets(str(tmp_path))
+    assert "trainer-10.r1" in offs
+    _skew, unc = offs["trainer-10.r1"]
+    # window bottom = the absent poll (moments ago), NOT the spawn
+    # time planted far in the past: uncertainty is sub-second where
+    # the spawn-based window would have been ~half the epoch
+    assert unc < 1_000_000.0, unc
+    assert absent_at > w.spawned_at_us
+    assert not os.path.exists(os.path.join(
+        str(tmp_path), "trainer-10.r1.clockping"))   # consumed
+    # a second poll after consumption is inert
+    w.poll_clock_ping()

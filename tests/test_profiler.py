@@ -617,3 +617,58 @@ def test_bench_profile_record_schema():
     # single- and multi-chip records share this schema; phase fields
     # appear only when phase profiling ran
     assert "phase_ms" not in rec
+
+
+def test_spool_weighted_rare_long_spans_survive(tmp_path):
+    """Adaptive spooling acceptance (mirrors the 200k smoke): a
+    handful of rare-but-long spans scattered through 200k fast ones
+    must ALL survive the weighted reservoir — uniform sampling at this
+    capacity would keep each with probability ~res/stream ~ 3%."""
+    head, res = 100, 64
+    sp = SpanSpool(str(tmp_path), "w-0", head=head, reservoir=res,
+                   segment_bytes=1 << 20, seed=3, flush_every=512)
+    assert sp.policy == "weighted"   # the default policy
+    n = 200_000
+    rare = set(range(head + 500, n, 10_000))   # ~20 rare events
+    for i in range(n):
+        if i in rare:
+            # a 50ms stall in a rare category, in a sea of 5us ops
+            sp.offer(("stall%d" % i, float(i), 50_000.0, 0, "stall",
+                      {"i": i}))
+        else:
+            sp.offer(("s", float(i), 5.0, 0, "op", {"i": i}))
+    sp.flush()
+    events = spool_mod.load_spooled_spans(str(tmp_path), "w-0")
+    assert len(events) == head + res   # disk stays bounded
+    kept = {e[0] for e in events}
+    missing = {"stall%d" % i for i in rare} - kept
+    assert not missing, "rare-but-long spans evicted: %r" % missing
+    # the bulk sample still mirrors the stream (mostly ordinary spans)
+    assert sum(1 for e in events[head:] if e[0] == "s") > 0
+    assert sp.stats()["policy"] == "weighted"
+
+
+def test_spool_weighted_seeded_reproducible(tmp_path):
+    def run(base):
+        sp = SpanSpool(str(tmp_path), base, head=10, reservoir=20,
+                       segment_bytes=1 << 20, seed=42)
+        for i in range(5000):
+            sp.offer(("s%d" % i, float(i), float(1 + i % 37), 0,
+                      ("op", "rpc", "step")[i % 3], {"i": i}))
+        sp.flush()
+        return [e[5]["i"] for e in
+                spool_mod.load_spooled_spans(str(tmp_path), base)]
+
+    assert run("wa-0") == run("wb-0")
+
+
+def test_spool_policy_env_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SPOOL_POLICY", "uniform")
+    sp = SpanSpool(str(tmp_path), "u-0", head=10, reservoir=20)
+    assert sp.policy == "uniform"
+    monkeypatch.delenv("PADDLE_TPU_SPOOL_POLICY")
+    assert SpanSpool(str(tmp_path), "u-1").policy == "weighted"
+    # explicit constructor choice wins over env
+    monkeypatch.setenv("PADDLE_TPU_SPOOL_POLICY", "uniform")
+    assert SpanSpool(str(tmp_path), "u-2",
+                     policy="weighted").policy == "weighted"

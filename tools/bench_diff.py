@@ -42,6 +42,12 @@ WATCHED = (
     ("step_ms", -1), ("collective_bytes", -1),
     ("mfu_est", +1), ("overlap_frac", +1),
     ("critical_path_ms", -1), ("exposed_collective_ms", -1),
+    # device-truth counterparts (XPlane-folded; observability/
+    # device_trace.py) + the host-vs-device agreement ratio — a
+    # silently-diverging host estimate (the number the bucket planner
+    # steers by) regresses agreement even when every host metric holds
+    ("device_overlap_frac", +1), ("device_critical_path_ms", -1),
+    ("host_device_agreement", +1),
 )
 
 # absolute noise floors for measured-timing metrics: a relative
@@ -52,6 +58,8 @@ WATCHED = (
 ABS_NOISE_FLOOR = {
     "step_ms": 2.0, "critical_path_ms": 2.0,
     "exposed_collective_ms": 2.0, "overlap_frac": 0.1,
+    "device_overlap_frac": 0.1, "device_critical_path_ms": 2.0,
+    "host_device_agreement": 0.1,
 }
 
 # counter totals (metrics.json) where growth is a regression.
@@ -283,6 +291,30 @@ def _self_test():
     n3 = {"configs": {"w": {"profile": {"exposed_collective_ms": 50.0}}}}
     nbad = list(diff_records(n2, n3, 0.5))
     assert any(r[-1] for r in nbad), nbad
+    # device-truth metrics: a host-vs-device agreement collapse (the
+    # host estimate silently diverging from the XPlane-folded truth)
+    # must flag even when every host-side number held; sub-floor
+    # agreement jitter must not
+    d0 = {"configs": {"w": {"profile": {
+        "overlap_frac": 0.60, "device_overlap_frac": 0.55,
+        "host_device_agreement": 0.90}}}}
+    d1 = {"configs": {"w": {"profile": {
+        "overlap_frac": 0.60, "device_overlap_frac": 0.55,
+        "host_device_agreement": 0.40}}}}
+    dbad = [r for r in diff_records(d0, d1, 0.10)
+            if r[1] == "host_device_agreement"]
+    assert dbad and dbad[0][-1], dbad
+    d2 = {"configs": {"w": {"profile": {
+        "overlap_frac": 0.60, "device_overlap_frac": 0.55,
+        "host_device_agreement": 0.85}}}}
+    assert not any(r[-1] for r in diff_records(d0, d2, 0.10))
+    dov = {"configs": {"w": {"profile": {
+        "overlap_frac": 0.60, "device_overlap_frac": 0.10,
+        "host_device_agreement": 0.90}}}}
+    dovbad = [r for r in diff_records(d0, dov, 0.10)
+              if r[1] == "device_overlap_frac"]
+    assert dovbad and dovbad[0][-1], dovbad
+    assert not any(r[-1] for r in diff_records(d0, d0, 0.10))
     print("bench_diff self-test ok")
     return 0
 
