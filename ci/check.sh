@@ -68,8 +68,37 @@ fi
 # 5b: end-to-end smoke — ServingEngine on a tiny MLP, 64 concurrent
 # ragged requests: zero errors, jit compiles == warmed bucket count
 # (NOT the number of distinct observed batch sizes), and an
-# undersized queue must actually reject (backpressure engages)
-python tools/serving_bench.py --smoke
+# undersized queue must actually reject (backpressure engages).
+# --out also writes the bench_diff-compatible serving record
+SRV_OUT="$(mktemp)"
+trap 'rm -f "$FP_TMP" "$SRV_OUT"' EXIT
+python tools/serving_bench.py --smoke --out "$SRV_OUT"
+
+echo "== gate 5c: serving perf regression vs previous run =="
+# same machine-local run-over-run scheme as gate 7b: queue-wait /
+# batch-size / padding-waste / compile-count regressions (and any
+# serving.errors growth) fail CI exactly like training regressions.
+# Timing gates loose (CI jitter); the counters are the strict half.
+SRV_BASELINE="ci/baseline/serving_smoke.json"
+mkdir -p ci/baseline
+if [[ -f "$SRV_BASELINE" ]]; then
+    srv_rc=0
+    python tools/bench_diff.py "$SRV_BASELINE" "$SRV_OUT" \
+        --threshold 0.5 --counters-threshold 0.5 || srv_rc=$?
+    if [[ "$srv_rc" == "0" ]]; then
+        echo "serving perf gate: no regression vs previous run"
+    elif [[ "$srv_rc" == "2" ]]; then
+        echo "serving perf gate: baseline unreadable (rc=2) — reseeding $SRV_BASELINE"
+    elif [[ "${PERF_BASELINE_ACCEPT:-0}" == "1" ]]; then
+        echo "serving perf gate: regression ACCEPTED (PERF_BASELINE_ACCEPT=1)"
+    else
+        echo "serving perf gate: regression vs $SRV_BASELINE — intentional? re-run with PERF_BASELINE_ACCEPT=1" >&2
+        exit 1
+    fi
+else
+    echo "serving perf gate: no previous run on this machine — seeding $SRV_BASELINE"
+fi
+cp "$SRV_OUT" "$SRV_BASELINE"
 
 echo "== gate 6: fault tolerance =="
 # 6a: the fault-tolerance suite (injection grammar/determinism, retry
@@ -127,7 +156,7 @@ echo "== gate 7: multichip fast-path smoke =="
 # ratio; and tools/bench_diff.py must answer --help and pass its
 # --self-test (the mechanical perf gate bench artifacts diff through)
 MC_OUT="$(mktemp)"
-trap 'rm -f "$FP_TMP" "$MC_OUT"' EXIT
+trap 'rm -f "$FP_TMP" "$SRV_OUT" "$MC_OUT"' EXIT
 python tools/mc_smoke.py --out "$MC_OUT"
 
 echo "== gate 7b: perf regression vs previous run =="
@@ -163,8 +192,22 @@ else
 fi
 cp "$MC_OUT" "$BASELINE"
 
+echo "== gate 8: serving-fleet chaos drill =="
+# the ISSUE-11 acceptance drill (~45s): 2 supervised serving replicas
+# + a closed-loop FleetRouter driver under an RPC fault plan
+# (drop/delay/close on the fleet dispatch path); replica 0 SIGKILLs
+# itself mid-dispatch. Gated on the DRIVER's accounting (zero lost
+# accepted requests, every response value-verified, shed strictly by
+# cost class under the synthetic overload burst, the relaunched
+# replica demonstrably serving again) AND on the merged job telemetry
+# (p99 serving.queue_ms within budget, serving.hedges > 0,
+# serving.replica_ejections >= 1, the kill -> ejection -> relaunch ->
+# rejoin chain in causal order, per-replica serving spans joining ONE
+# job trace) — not on logs.
+python tools/serving_chaos.py --smoke
+
 if [[ "${SKIP_TESTS:-0}" != "1" ]]; then
-    echo "== gate 8: test suite =="
+    echo "== gate 9: test suite =="
     python -m pytest tests/ -q
 fi
 echo "ALL CI GATES PASS"

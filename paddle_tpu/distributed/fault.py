@@ -341,7 +341,9 @@ class FaultInjector:
         if r is None:
             sock.sendall(frame)
             return True
-        _count("send", r.kind)
+        # the flight line names the severed peer: a fleet/PS drill's
+        # postmortem needs WHICH link each eaten frame belonged to
+        _count("send", r.kind, peer=_peer_endpoint(sock))
         if r.kind == "drop":
             return False
         if r.kind == "delay":
@@ -371,7 +373,7 @@ class FaultInjector:
         r = self._pick("recv")
         if r is None:
             return "pass"
-        _count("recv", r.kind)
+        _count("recv", r.kind, peer=_peer_endpoint(sock))
         if r.kind == "delay":
             time.sleep((r.param if r.param is not None else 20.0) / 1e3)
             return "pass"

@@ -44,6 +44,21 @@ via ``ps_shard.client_from_env``. Supervision (relaunch as rejoining
 backup, restart budgets) is per process, so one shard's failures
 never charge another shard's budget.
 
+Serving-replica supervision (ISSUE 11): ``--serving_replicas=N`` with
+``--serving_script=replica.py`` spawns N supervised SERVING replica
+processes (env contract: ``PADDLE_ROLE=serving``,
+``PADDLE_SERVING_REPLICAS`` = count, ``PADDLE_SERVING_REPLICA_INDEX``,
+``PADDLE_SERVING_ENDPOINTS`` = the full ``host:port`` list —
+``--serving_endpoints`` or ``--serving_started_port`` + N —
+``PADDLE_SERVING_ENDPOINT`` = the replica's own). Replicas are
+stateless: a replica that dies (the chaos drill SIGKILLs one
+mid-flight) is relaunched in place with the same endpoint and simply
+rejoins the fleet router's rotation once its ``/healthz`` answers
+``serving`` again. Trainers see ``PADDLE_SERVING_ENDPOINTS`` too (the
+traffic driver builds its ``serving.FleetRouter`` from it). Like
+pservers, replicas serve until every trainer rank exits, then are torn
+down.
+
 Job-level observability (ISSUE 5): with ``PADDLE_TPU_METRICS_DIR``
 set, the supervisor clears stale dumps at job start (a merge must
 never mix job incarnations), records every spawn / exit / relaunch
@@ -103,6 +118,16 @@ def _parse_args(argv=None):
                         "contiguous primary+backup groups (key-range "
                         "sharded PS; endpoint count must divide "
                         "evenly)")
+    p.add_argument("--serving_script", default=None,
+                   help="script run once per serving replica as a "
+                        "supervised stateless serving process")
+    p.add_argument("--serving_replicas", type=int, default=0,
+                   help="number of supervised serving replicas "
+                        "(requires --serving_script)")
+    p.add_argument("--serving_endpoints", default="",
+                   help="comma-separated host:port per replica "
+                        "(default: 127.0.0.1:<serving_started_port>+i)")
+    p.add_argument("--serving_started_port", type=int, default=8200)
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -194,8 +219,9 @@ class _Worker:
         if self.log_dir:
             # append across restarts: one workerlog per rank tells the
             # whole story, crash included
-            name = ("serverlog.%d" if self.role == "pserver"
-                    else "workerlog.%d") % self.local_rank
+            name = {"pserver": "serverlog.%d",
+                    "serving": "servinglog.%d"}.get(
+                        self.role, "workerlog.%d") % self.local_rank
             self._fp = open(os.path.join(self.log_dir, name), "a")
             stdout = stderr = self._fp
         self.spawned_at_us = time.time() * 1e6
@@ -279,6 +305,21 @@ def launch(args=None):
                    if e.strip()]
     if pserver_eps and not args.server_script:
         raise SystemExit("--pserver_endpoints requires --server_script")
+    n_serving = max(0, int(getattr(args, "serving_replicas", 0) or 0))
+    serving_eps = [e.strip() for e in
+                   (getattr(args, "serving_endpoints", "") or "")
+                   .split(",") if e.strip()]
+    if serving_eps and not n_serving:
+        n_serving = len(serving_eps)
+    if n_serving and not args.serving_script:
+        raise SystemExit("--serving_replicas/--serving_endpoints "
+                         "require --serving_script")
+    if n_serving and not serving_eps:
+        serving_eps = ["127.0.0.1:%d" % (args.serving_started_port + i)
+                       for i in range(n_serving)]
+    if n_serving and len(serving_eps) != n_serving:
+        raise SystemExit("--serving_endpoints names %d endpoint(s) for "
+                         "%d replicas" % (len(serving_eps), n_serving))
     nshards = max(1, int(getattr(args, "pserver_shards", 1)))
     shard_groups = [pserver_eps]
     if pserver_eps and nshards > 1:
@@ -302,6 +343,9 @@ def launch(args=None):
         if pserver_eps:
             env["PADDLE_PSERVER_ENDPOINTS"] = ",".join(pserver_eps)
             env["PADDLE_PSERVER_SHARDS"] = str(nshards)
+        if serving_eps:
+            # the traffic driver builds its FleetRouter from this
+            env["PADDLE_SERVING_ENDPOINTS"] = ",".join(serving_eps)
         cmd = [sys.executable, "-u", args.training_script] + \
             list(args.training_script_args)
         workers.append(_Worker(
@@ -337,6 +381,25 @@ def launch(args=None):
                 [sys.executable, "-u", args.server_script], env,
                 args.log_dir, role="pserver",
                 metrics_dir=metrics_dir))
+
+    for i, ep in enumerate(serving_eps):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        env.update({
+            "PADDLE_ROLE": "serving",
+            "PADDLE_SERVING_REPLICAS": str(n_serving),
+            "PADDLE_SERVING_REPLICA_INDEX": str(i),
+            "PADDLE_SERVING_ENDPOINTS": ",".join(serving_eps),
+            "PADDLE_SERVING_ENDPOINT": ep,
+        })
+        # serving replicas are supervised exactly like pservers (spawn,
+        # bounded relaunch, teardown after the trainers finish) — they
+        # are stateless, so a relaunch needs no rejoin protocol: the
+        # fleet router re-admits the endpoint once /healthz answers
+        servers.append(_Worker(
+            i, [sys.executable, "-u", args.serving_script], env,
+            args.log_dir, role="serving", metrics_dir=metrics_dir))
 
     def _terminate_all(sig=signal.SIGTERM):
         for w in workers + servers:
@@ -375,23 +438,24 @@ def launch(args=None):
                 if code is None or code == 0:
                     continue  # running, or deliberately shut down
                 sig_note = (" (signal %d)" % -code) if code < 0 else ""
-                _flight.record("launch.exit", role="pserver",
+                _flight.record("launch.exit", role=s.role,
                                rank=s.local_rank, code=code,
                                signal=(-code if code < 0 else None))
                 if s.restarts >= args.max_restarts:
-                    _log("pserver %d exited %d%s; restart budget (%d) "
+                    _log("%s %d exited %d%s; restart budget (%d) "
                          "exhausted — bringing the job down"
-                         % (s.local_rank, code, sig_note,
+                         % (s.role, s.local_rank, code, sig_note,
                             args.max_restarts))
                     rc = code if code > 0 else 1
                     _terminate_all()
                     live = set()
                     break
                 s.restarts += 1
-                _log("pserver %d exited %d%s; relaunching as a "
-                     "catching-up backup (restart %d/%d)"
-                     % (s.local_rank, code, sig_note, s.restarts,
-                        args.max_restarts))
+                _log("%s %d exited %d%s; relaunching%s (restart %d/%d)"
+                     % (s.role, s.local_rank, code, sig_note,
+                        " as a catching-up backup"
+                        if s.role == "pserver" else "",
+                        s.restarts, args.max_restarts))
                 s.spawn()
             for w in workers:
                 if w.local_rank not in live:

@@ -32,7 +32,12 @@ Metric families (see README "Runtime observability"):
 ``pipeline.bubble_fraction``           gauge: (S-1)/(M+S-1) GPipe bubble
 ``pipeline.boundary_bytes{boundary=}`` gauge: rotating-buffer payload
 ``memory.*_bytes``                     gauge: live/peak/limit device bytes
-``serving.*``                          serving engine (always-on; see
+``serving.*``                          serving engine + fleet router
+                                       (always-on; incl. ``shed{class=}``,
+                                       ``hedges``, ``hedge_wasted``,
+                                       ``fleet_retries``, ``dedup_hits``,
+                                       ``replica_ejections{cause=}``,
+                                       ``replica_rejoins`` — see
                                        ``paddle_tpu/serving/metrics.py``)
 ``rpc.retries{method=}``               counter: PS client retries per rpc
 ``rpc.timeouts{method=}``              counter: per-attempt deadline trips

@@ -291,6 +291,9 @@ def process_identity() -> Tuple[str, int, int]:
         rank = int(os.environ.get("PADDLE_PSERVER_GLOBAL_INDEX")
                    or os.environ.get("PADDLE_PSERVER_INDEX", "0")
                    or 0)
+    elif role == "serving":
+        rank = int(os.environ.get("PADDLE_SERVING_REPLICA_INDEX", "0")
+                   or 0)
     else:
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
     return str(role), rank, restart

@@ -16,7 +16,14 @@ covers with its C++ serving stack, TPU-native:
   per-request deadlines dropped before dispatch, bucket warmup at
   start, graceful drain at stop;
 - ``http``     — stdlib ``ThreadingHTTPServer``: ``POST /predict``,
-  ``GET /healthz``, ``GET /metrics`` (Prometheus text);
+  ``GET /healthz`` (machine-readable lifecycle), ``GET /metrics``
+  (Prometheus text);
+- ``fleet``    — ``FleetRouter``: the replica-fleet front end (shared
+  admission control, cost-class load shedding with priority lanes,
+  health-checked routing with bounded ejection, exactly-once hedged
+  retries) over N replica processes — same ``predict``/``health``/
+  ``stats`` surface as the engine, so the HTTP front serves a fleet
+  unchanged;
 - ``metrics``  — the always-on ``serving.*`` counter/histogram/gauge
   families in the PR-1 observability registry.
 
@@ -33,17 +40,20 @@ Minimal use::
 """
 from __future__ import annotations
 
-from . import batcher, engine, http, metrics  # noqa: F401
+from . import batcher, engine, fleet, http, metrics  # noqa: F401
 from .batcher import (  # noqa: F401
     BatchPolicy, DynamicBatcher, default_ladder, pick_bucket)
 from .engine import (  # noqa: F401
     DeadlineExpired, EngineStopped, RequestTooLarge, ServerOverloaded,
     ServingConfig, ServingEngine, ServingError)
+from .fleet import (  # noqa: F401
+    FleetConfig, FleetRouter, ReplicaUnavailable, RequestShed)
 from .http import ServingHTTPServer, serve, start_http_server  # noqa: F401
 
 __all__ = [
     "BatchPolicy", "DynamicBatcher", "default_ladder", "pick_bucket",
     "ServingConfig", "ServingEngine", "ServingError", "ServerOverloaded",
     "DeadlineExpired", "EngineStopped", "RequestTooLarge",
+    "FleetConfig", "FleetRouter", "RequestShed", "ReplicaUnavailable",
     "ServingHTTPServer", "serve", "start_http_server",
 ]

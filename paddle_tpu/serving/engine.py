@@ -19,12 +19,22 @@ Production behaviors the bare predictor lacks, in one place:
 - **warmup** — every ladder bucket is compiled at ``start()``, so the
   first real request never eats a multi-ms XLA compile;
 - **graceful drain** — ``stop()`` refuses new work, finishes what's
-  queued, then joins the workers.
+  queued, then joins the workers;
+- **lifecycle** — ``health()`` reports a machine-readable state
+  (``starting | warming | serving | draining | stopped``) so a fleet
+  router can stop routing at ``draining`` instead of waiting for a
+  connection refusal;
+- **idempotent request ids** — ``submit(request_id=...)`` joins a
+  duplicate of an already-seen request to the ORIGINAL's future (a
+  bounded LRU remembers recently-completed ids too), so a hedged or
+  retried delivery never runs the predictor twice on this replica and
+  never double-counts ``serving.requests``.
 """
 from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
@@ -81,7 +91,8 @@ class ServingConfig:
                  max_queue: int = 64,
                  num_workers: int = 2,
                  default_deadline_ms: Optional[float] = None,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 request_id_cache: int = 1024):
         self.policy = BatchPolicy(max_batch_size, batch_timeout_ms, ladder)
         self.max_queue = int(max_queue)
         self.num_workers = int(num_workers)
@@ -89,6 +100,9 @@ class ServingConfig:
             raise ValueError("num_workers must be >= 1")
         self.default_deadline_ms = default_deadline_ms
         self.warmup = bool(warmup)
+        # idempotent-resubmit window: how many request ids (pending AND
+        # recently completed) the engine remembers; 0 disables dedup
+        self.request_id_cache = int(request_id_cache)
 
 
 class ServingEngine:
@@ -123,10 +137,17 @@ class ServingEngine:
         self._workers: List[threading.Thread] = []
         self._state_lock = threading.Lock()
         self._started = False
+        self._warming = False
         self._stopping = False
         self._abort = False
         self._stopped = False
         self.warmed_buckets: tuple = ()
+        # request-id -> Future, insertion-ordered LRU; entries stay
+        # after completion (bounded by request_id_cache) so a late
+        # duplicate delivery of a FINISHED request still joins its
+        # original result instead of re-running the predictor
+        self._ids: "OrderedDict[str, Future]" = OrderedDict()
+        self._ids_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -139,7 +160,11 @@ class ServingEngine:
             if self._started:
                 return self
             if self.config.warmup:
-                self._warmup()
+                self._warming = True
+                try:
+                    self._warmup()
+                finally:
+                    self._warming = False
             for i in range(self.config.num_workers):
                 t = threading.Thread(target=self._worker_loop,
                                      name="serving-worker-%d" % i,
@@ -196,26 +221,51 @@ class ServingEngine:
         return self._started and not self._stopping
 
     def health(self) -> str:
-        """Readiness for an external supervisor: ``"ok"`` while
-        accepting work, ``"draining"`` from the moment ``stop()`` flips
-        readiness until the workers have joined (stop routing NOW, but
-        in-flight requests are still finishing), ``"stopped"`` after.
-        """
+        """Machine-readable lifecycle for an external supervisor or
+        fleet router: ``starting`` (constructed, ``start()`` not done)
+        -> ``warming`` (pre-compiling ladder buckets) -> ``serving``
+        (accepting work) -> ``draining`` (from the moment ``stop()``
+        flips readiness until the workers have joined — stop routing
+        NOW, but in-flight requests are still finishing) ->
+        ``stopped``. A router must route ONLY at ``serving``."""
+        if self._stopping or self._stopped:
+            return "stopped" if self._stopped else "draining"
         if self.running:
-            return "ok"
-        if self._stopping and not self._stopped:
-            return "draining"
-        return "stopped"
+            return "serving"
+        if self._warming:
+            return "warming"
+        return "starting"
 
     # -- request path ------------------------------------------------------
 
     def submit(self, feed: Dict[str, np.ndarray],
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               request_id: Optional[str] = None,
+               cost_class: Optional[str] = None) -> Future:
         """Queue one request (arrays WITH leading batch axis; every
         input must agree on rows). Returns a Future resolving to a dict
-        name -> ndarray of that request's rows."""
+        name -> ndarray of that request's rows.
+
+        ``request_id`` makes the submit IDEMPOTENT: a duplicate of a
+        pending or recently-completed id returns the ORIGINAL future —
+        the predictor never runs twice for one id and the request is
+        counted once (how a fleet's hedge/retry duplicates stay
+        exactly-once on the replica). ``cost_class`` is accepted for
+        interface parity with the fleet router; a single engine has no
+        priority lanes and ignores it."""
+        del cost_class  # single-replica engine: no shed lanes
         if not self._started or self._stopping:
             raise EngineStopped("engine is not accepting requests")
+        if request_id is not None and self.config.request_id_cache > 0:
+            with self._ids_lock:
+                f = self._ids.get(str(request_id))
+                if f is not None:
+                    # LRU, not FIFO: a hot id (slow client re-sending,
+                    # repeated hedges) must not be evicted by age
+                    self._ids.move_to_end(str(request_id))
+            if f is not None:
+                _m.inc(_m.DEDUP_HITS)
+                return f
         feed, rows = self._validate(feed)
         if rows > self.config.policy.max_batch_size:
             raise RequestTooLarge(
@@ -231,7 +281,33 @@ class ServingEngine:
         # trace from HTTP arrival through batch dispatch
         pending = PendingRequest(feed, rows, deadline,
                                  trace_ctx=_dtrace.current())
+        if request_id is not None and self.config.request_id_cache > 0:
+            # register BEFORE the enqueue under the ids lock: two
+            # concurrent duplicates race here, and the loser must find
+            # the winner's future rather than enqueue a second copy
+            with self._ids_lock:
+                f = self._ids.get(str(request_id))
+                if f is not None:
+                    self._ids.move_to_end(str(request_id))
+                    _m.inc(_m.DEDUP_HITS)
+                    return f
+                self._ids[str(request_id)] = pending.future
+                while len(self._ids) > self.config.request_id_cache:
+                    self._ids.popitem(last=False)
         if not self._batcher.try_put(pending):
+            if request_id is not None:
+                # a concurrent duplicate may ALREADY hold this future
+                # from the dedup lookup above — resolving it with the
+                # same rejection (before raising ours) is what keeps
+                # that holder from blocking forever on a future whose
+                # producer was never admitted
+                with self._ids_lock:
+                    self._ids.pop(str(request_id), None)
+                exc = (EngineStopped("engine is not accepting requests")
+                       if self._stopping else ServerOverloaded(
+                           "pending queue full (%d requests); retry "
+                           "later" % self.config.max_queue))
+                self._fail(pending, exc)
             if self._stopping:
                 # refusal came from close(), not capacity: a submit
                 # that raced past the _stopping check above must not
@@ -246,15 +322,19 @@ class ServingEngine:
 
     def predict(self, feed: Dict[str, np.ndarray],
                 deadline_ms: Optional[float] = None,
-                timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+                timeout: Optional[float] = None,
+                request_id: Optional[str] = None,
+                cost_class: Optional[str] = None) -> Dict[str, np.ndarray]:
         """Blocking submit().result() convenience."""
-        return self.submit(feed, deadline_ms).result(timeout)
+        return self.submit(feed, deadline_ms, request_id=request_id,
+                           cost_class=cost_class).result(timeout)
 
     def stats(self) -> Dict:
         out = _m.snapshot()
         out["queue_depth"] = self._batcher.depth()
         out["warmed_buckets"] = list(self.warmed_buckets)
         out["running"] = self.running
+        out["state"] = self.health()
         return out
 
     # -- internals ---------------------------------------------------------

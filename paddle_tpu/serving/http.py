@@ -8,16 +8,23 @@ batch-formation opportunity. No framework dependency.
 Endpoints:
 
 - ``POST /predict`` — body ``{"inputs": {name: nested-list},
-  "deadline_ms": optional}``; arrays carry the leading batch axis.
+  "deadline_ms": optional, "cost_class": optional}``; arrays carry the
+  leading batch axis; an ``X-Request-Id`` header makes the request
+  idempotent (a hedge/retry duplicate joins the original execution).
   Replies ``{"outputs": {name: nested-list}, "latency_ms": float}``.
-  Typed failures map onto status codes: 503 (overloaded / stopped,
-  with ``Retry-After``), 504 (deadline expired), 400 (malformed),
-  500 (``BatchExecutionError`` — the model failed on that batch; the
+  Typed failures map onto status codes AND carry a machine-readable
+  ``type`` field: 503 (``ServerOverloaded`` / ``RequestShed`` with
+  ``Retry-After``, ``EngineStopped``), 504 (``DeadlineExpired`` — the
+  deadline passed while queued), 400 (malformed), 500
+  (``BatchExecutionError`` — the model failed on that batch; the
   engine stays healthy).
-- ``GET /healthz`` — 200 while the engine accepts work, 503 otherwise
-  (the load-balancer drain signal); the body names this process's
-  metrics-dump path (``metrics_dump``) so an operator probing a
-  replica knows where its telemetry lands.
+- ``GET /healthz`` — machine-readable lifecycle: 200 with
+  ``{"status": "serving"}`` only while the engine accepts work, 503
+  with the actual state (``starting | warming | draining | stopped``)
+  otherwise — a fleet router stops routing at ``draining``, not at
+  connection refusal; the body names this process's metrics-dump path
+  (``metrics_dump``) so an operator probing a replica knows where its
+  telemetry lands.
 - ``GET /metrics`` — the FULL observability registry via
   ``observability.dump_prometheus()`` (one code path with every other
   exporter: serving.* plus every runtime family, histogram quantile
@@ -76,13 +83,14 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             health = engine.health()
             dump = _dtrace.dump_path()
-            if health == "ok":
-                self._reply_json(200, {"status": "ok",
+            if health == "serving":
+                self._reply_json(200, {"status": "serving",
                                        "metrics_dump": dump})
             else:
-                # "draining": stop() flipped readiness but in-flight
-                # requests are still finishing — the supervisor must
-                # stop routing now and NOT kill the process yet
+                # starting/warming: not ready yet; "draining": stop()
+                # flipped readiness but in-flight requests are still
+                # finishing — the supervisor must stop routing now and
+                # NOT kill the process yet
                 self._reply_json(503, {"status": health,
                                        "metrics_dump": dump})
         elif self.path == "/metrics":
@@ -111,6 +119,11 @@ class _Handler(BaseHTTPRequestHandler):
                     deadline_ms, (int, float)):
                 raise ValueError("deadline_ms must be a number, got %r"
                                  % (deadline_ms,))
+            cost_class = req.get("cost_class")
+            if cost_class is not None and not isinstance(cost_class, str):
+                raise ValueError("cost_class must be a string, got %r"
+                                 % (cost_class,))
+            request_id = self.headers.get("X-Request-Id") or None
             feed = {str(n): np.asarray(v) for n, v in inputs.items()}
             # a caller-supplied X-Trace-Id joins this request to the
             # caller's trace; without one each request is its own
@@ -122,14 +135,29 @@ class _Handler(BaseHTTPRequestHandler):
                     parent_span=self.headers.get("X-Parent-Span")
                     or None) as ctx:
                 req_ctx = ctx
-                outputs = engine.predict(feed, deadline_ms=deadline_ms)
+                outputs = engine.predict(feed, deadline_ms=deadline_ms,
+                                         request_id=request_id,
+                                         cost_class=cost_class)
         except ServerOverloaded as e:
-            self._reply_json(503, {"error": str(e)},
+            # RequestShed is a ServerOverloaded subtype: same 503 +
+            # Retry-After back-off, but the typed name tells the
+            # caller its COST CLASS was shed (a cheaper class may
+            # still be admitted) rather than the hard queue bound hit
+            self._reply_json(503, {"error": str(e),
+                                   "type": type(e).__name__},
                              (("Retry-After", "1"),) + self._echo(req_ctx))
         except EngineStopped as e:
-            self._reply_json(503, {"error": str(e)}, self._echo(req_ctx))
+            self._reply_json(503, {"error": str(e),
+                                   "type": "EngineStopped"},
+                             self._echo(req_ctx))
         except DeadlineExpired as e:
-            self._reply_json(504, {"error": str(e)}, self._echo(req_ctx))
+            # typed 504: the deadline expired while the request was
+            # QUEUED (it never reached the predictor) — the caller's
+            # retry/hedge budget accounting needs to distinguish this
+            # from a transport loss
+            self._reply_json(504, {"error": str(e),
+                                   "type": "DeadlineExpired"},
+                             self._echo(req_ctx))
         except BatchExecutionError as e:
             # the MODEL failed on this batch: the engine is still
             # healthy (don't drain), the CLIENT isn't at fault (not a
@@ -169,8 +197,10 @@ def _json_safe(obj):
 
 
 class ServingHTTPServer(ThreadingHTTPServer):
-    """HTTP front of one ServingEngine. ``port=0`` binds an ephemeral
-    port (tests); ``server.server_address`` reports the real one."""
+    """HTTP front of one ServingEngine (or a FleetRouter — anything
+    with the ``predict``/``health``/``stats`` surface). ``port=0``
+    binds an ephemeral port (tests); ``server.server_address`` reports
+    the real one."""
 
     daemon_threads = True
 
@@ -178,6 +208,19 @@ class ServingHTTPServer(ThreadingHTTPServer):
                  port: int = 8080):
         self.engine = engine
         super().__init__((host, port), _Handler)
+
+    def handle_error(self, request, client_address):
+        # a client hanging up mid-reply is NORMAL under a fleet: the
+        # hedge winner cancels the loser by closing its socket, and a
+        # deadline-expired caller walks away — neither deserves a
+        # stack trace in the replica log
+        import sys as _sys
+
+        exc = _sys.exc_info()[1]
+        if isinstance(exc, (ConnectionResetError, BrokenPipeError,
+                            ConnectionAbortedError)):
+            return
+        super().handle_error(request, client_address)
 
 
 def start_http_server(engine: ServingEngine, host: str = "127.0.0.1",

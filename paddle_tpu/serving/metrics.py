@@ -22,7 +22,19 @@ Families (README "Serving"):
 ``serving.queue_ms``               histogram: submit -> dispatch wait
 ``serving.total_ms``               histogram: submit -> result latency
 ``serving.queue_depth``            gauge: requests waiting right now
+``serving.dedup_hits``             counter: idempotent request-id joins
+``serving.shed{class=}``           counter: cost-class load sheds (fleet)
+``serving.hedges``                 counter: hedged attempts launched
+``serving.hedge_wasted``           counter: hedge losers (result discarded)
+``serving.fleet_retries``          counter: re-dispatches after a failed
+                                   attempt (replica died mid-flight)
+``serving.replica_ejections{cause=}``  counter: replicas pulled from
+                                   rotation (dead | draining | unhealthy)
+``serving.replica_rejoins``        counter: ejected replicas back serving
 =================================  =======================================
+
+The fleet families (``shed``/``hedges``/``replica_*``) are recorded by
+``serving/fleet.py``; everything above them by the engine/batcher.
 
 Handles are re-fetched from the registry on every write (get-or-create
 is a dict lookup) instead of cached at import: ``observability.reset()``
@@ -36,7 +48,9 @@ from .. import observability as _obs
 __all__ = [
     "REQUESTS", "REJECTED", "DEADLINE_EXPIRED", "ERRORS",
     "BATCH_ERRORS", "BATCHES", "PADDING_WASTE", "BATCH_SIZE",
-    "QUEUE_MS", "TOTAL_MS", "QUEUE_DEPTH",
+    "QUEUE_MS", "TOTAL_MS", "QUEUE_DEPTH", "DEDUP_HITS",
+    "SHED", "HEDGES", "HEDGE_WASTED", "FLEET_RETRIES",
+    "REPLICA_EJECTIONS", "REPLICA_REJOINS",
     "inc", "observe", "set_queue_depth", "snapshot",
 ]
 
@@ -51,10 +65,17 @@ BATCH_SIZE = "serving.batch_size"
 QUEUE_MS = "serving.queue_ms"
 TOTAL_MS = "serving.total_ms"
 QUEUE_DEPTH = "serving.queue_depth"
+DEDUP_HITS = "serving.dedup_hits"
+SHED = "serving.shed"
+HEDGES = "serving.hedges"
+HEDGE_WASTED = "serving.hedge_wasted"
+FLEET_RETRIES = "serving.fleet_retries"
+REPLICA_EJECTIONS = "serving.replica_ejections"
+REPLICA_REJOINS = "serving.replica_rejoins"
 
 
-def inc(name: str, n: int = 1) -> None:
-    _obs.counter(name).inc(n)
+def inc(name: str, n: int = 1, **labels) -> None:
+    _obs.counter(name, **labels).inc(n)
 
 
 def observe(name: str, v) -> None:
@@ -70,7 +91,8 @@ def snapshot() -> dict:
     ``ServingEngine.stats()`` payload)."""
     out = {}
     for name in (REQUESTS, REJECTED, DEADLINE_EXPIRED, ERRORS,
-                 BATCH_ERRORS, BATCHES, PADDING_WASTE):
+                 BATCH_ERRORS, BATCHES, PADDING_WASTE, DEDUP_HITS,
+                 HEDGES, HEDGE_WASTED, FLEET_RETRIES, REPLICA_REJOINS):
         out[name] = _obs.counter_value(name)
     out[QUEUE_DEPTH] = _obs.gauge_value(QUEUE_DEPTH)
     for name in (BATCH_SIZE, QUEUE_MS, TOTAL_MS):

@@ -1,0 +1,168 @@
+"""Supervised serving replica for the fleet chaos drill.
+
+Run under ``paddle_tpu.distributed.launch --serving_script=<this>``:
+builds a tiny DETERMINISTIC MLP (fixed weights — every replica serves
+the identical function, so a hedged duplicate answered by a different
+replica returns the same bytes), saves/loads it through the REAL
+inference path (``save_inference_model`` -> ``AnalysisConfig`` ->
+``create_paddle_predictor``), and serves it with a ``ServingEngine`` +
+HTTP front on ``$PADDLE_SERVING_ENDPOINT``.
+
+Drill hooks (env):
+
+- ``SERVING_DIE_REPLICA`` / ``SERVING_DIE_AFTER`` — the named replica
+  index SIGKILLs ITSELF (no cleanup, no drain — the real failure mode)
+  after serving that many ``/predict`` requests, but only on its first
+  incarnation (``PADDLE_RESTART_COUNT=0``): the supervisor relaunches
+  it and the relaunched incarnation must rejoin the fleet and serve.
+- ``SERVING_REPLICA_DELAY_MS`` — artificial per-dispatch latency, so
+  overload/hedge phases are deterministic on arbitrarily fast hosts.
+
+The driver side of the drill imports ``build_model_dir`` to build the
+SAME model locally and verify fleet responses value-for-value.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+DIM = 16
+HIDDEN = 32
+CLASSES = 4
+
+
+def build_model_dir(tmpdir: str):
+    """Save the deterministic MLP into ``tmpdir`` through the real
+    inference-model path; returns the output var name. Weights are a
+    fixed function of a seed, NOT of initializer state — every process
+    that calls this builds bit-identical parameters."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, DIM], dtype="float32")
+        h = fluid.layers.fc(x, HIDDEN, act="relu")
+        pred = fluid.layers.fc(h, CLASSES, act="softmax")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # overwrite every persistable with a seed-derived value: the
+        # served function must be identical across replicas AND in the
+        # driver's local reference copy
+        rng = np.random.RandomState(0xC0FFEE)
+        for var in sorted(main.global_block().all_parameters,
+                          key=lambda v: v.name):
+            t = scope.find_var(var.name).get_tensor()
+            shape = np.asarray(t).shape
+            t.set(rng.uniform(-0.5, 0.5, size=shape).astype("float32"),
+                  fluid.CPUPlace())
+        fluid.io.save_inference_model(tmpdir, ["x"], [pred], exe,
+                                      main_program=main)
+    return pred.name
+
+
+def make_predictor(tmpdir: str):
+    from paddle_tpu.inference import (AnalysisConfig,
+                                      create_paddle_predictor)
+
+    config = AnalysisConfig(tmpdir)
+    config.disable_gpu()
+    return create_paddle_predictor(config)
+
+
+class _CountingPredictor:
+    """Wraps the real predictor: per-dispatch drill delay + a request
+    counter armed to SIGKILL this process mid-flight."""
+
+    def __init__(self, inner, delay_s: float, die_after: int):
+        self._inner = inner
+        self._delay = delay_s
+        self._die_after = die_after  # 0 = never
+        self._served = 0
+        self._lock = threading.Lock()
+        # the engine derives its warmup sample feed from the
+        # predictor's program — without this proxy, warmup silently
+        # no-ops and the first live request per bucket eats a compile
+        self._program = getattr(inner, "_program", None)
+
+    def get_input_names(self):
+        return self._inner.get_input_names()
+
+    def run(self, feed):
+        if self._delay:
+            time.sleep(self._delay)
+        out = self._inner.run(feed)
+        if self._die_after:
+            with self._lock:
+                self._served += 1
+                boom = self._served >= self._die_after
+            if boom:
+                # the drill's replica death: SIGKILL mid-flight, with
+                # co-batched requests in the engine and the HTTP reply
+                # unsent — exactly what a machine loss looks like
+                os.kill(os.getpid(), signal.SIGKILL)
+        return out
+
+
+def main() -> int:
+    import tempfile
+
+    from paddle_tpu import serving
+
+    endpoint = os.environ.get("PADDLE_SERVING_ENDPOINT", "127.0.0.1:8200")
+    index = int(os.environ.get("PADDLE_SERVING_REPLICA_INDEX", "0") or 0)
+    restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0") or 0)
+    delay_ms = float(os.environ.get("SERVING_REPLICA_DELAY_MS", "0") or 0)
+    die_replica = int(os.environ.get("SERVING_DIE_REPLICA", "-1") or -1)
+    die_after = int(os.environ.get("SERVING_DIE_AFTER", "0") or 0)
+    if index != die_replica or restart > 0:
+        die_after = 0  # only the named replica's FIRST incarnation dies
+
+    host, _, port = endpoint.rpartition(":")
+    with tempfile.TemporaryDirectory(prefix="serving_rep_") as d:
+        build_model_dir(d)
+        predictor = _CountingPredictor(make_predictor(d), delay_ms / 1e3,
+                                       die_after)
+        engine = serving.ServingEngine(
+            predictor,
+            serving.ServingConfig(
+                max_batch_size=int(os.environ.get(
+                    "SERVING_MAX_BATCH", "8")),
+                batch_timeout_ms=float(os.environ.get(
+                    "SERVING_BATCH_TIMEOUT_MS", "2")),
+                max_queue=int(os.environ.get("SERVING_MAX_QUEUE", "64")),
+                num_workers=2)).start()
+        server = serving.ServingHTTPServer(engine, host or "127.0.0.1",
+                                           int(port))
+        thread = threading.Thread(target=server.serve_forever,
+                                  name="replica-http", daemon=True)
+        thread.start()
+        print("[replica %d r%d] serving %s (die_after=%d delay=%gms)"
+              % (index, restart, endpoint, die_after, delay_ms),
+              flush=True)
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        try:
+            while not stop.wait(0.2):
+                pass
+        finally:
+            engine.stop()
+            server.shutdown()
+            server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

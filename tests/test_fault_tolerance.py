@@ -877,7 +877,7 @@ def test_serving_batch_error_typed_and_engine_stays_healthy():
             f.result(10)
         assert (obs.counter_value("serving.batch_errors") - be0) == 1
         # the engine survived: next request dispatches normally
-        assert eng.health() == "ok"
+        assert eng.health() == "serving"
         out = eng.predict({"x": np.ones((1, 3), "f4")}, timeout=10)
         np.testing.assert_array_equal(out["y"], np.full((1, 3), 2.0))
         # and over HTTP the model failure is a 500 with the typed name
@@ -1086,7 +1086,7 @@ def test_serving_healthz_draining_during_stop():
     server, thread = start_http_server(eng)
     base = "http://127.0.0.1:%d" % server.server_address[1]
     try:
-        assert eng.health() == "ok"
+        assert eng.health() == "serving"
         fut = eng.submit({"x": np.zeros((1, 2), "f4")})
         stopper = threading.Thread(target=eng.stop)
         stopper.start()

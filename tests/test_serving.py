@@ -670,3 +670,255 @@ def test_http_healthz_unhealthy_after_stop(http_server):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(base + "/predict", {"inputs": {"x": [[1, 2, 3]]}})
     assert ei.value.code == 503
+
+
+# -- lifecycle (machine-readable /healthz contract) ------------------------
+
+def test_engine_lifecycle_states():
+    """starting -> warming (observed from inside the warmup dispatch)
+    -> serving -> draining -> stopped."""
+    states = []
+
+    class Watching(_StubPredictor):
+        def __init__(self, engine_ref):
+            super().__init__()
+            self.engine_ref = engine_ref
+
+        def run(self, feed):
+            if self.engine_ref:  # warmup runs inside start()
+                states.append(self.engine_ref[0].health())
+            return super().run(feed)
+
+    ref = []
+    stub = Watching(ref)
+    eng = serving.ServingEngine(
+        stub, serving.ServingConfig(max_batch_size=2, num_workers=1,
+                                    warmup=True),
+        sample_feed={"x": np.zeros((1, 3), "float32")})
+    ref.append(eng)
+    assert eng.health() == "starting"
+    eng.start()
+    assert states and all(s == "warming" for s in states), states
+    assert eng.health() == "serving"
+    assert eng.stats()["state"] == "serving"
+    eng.stop()
+    assert eng.health() == "stopped"
+
+
+def test_http_healthz_body_is_machine_readable(http_server):
+    eng, base = http_server
+    with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+        assert json.loads(r.read())["status"] == "serving"
+    eng.stop()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(base + "/healthz", timeout=10)
+    assert json.loads(ei.value.read())["status"] in ("draining",
+                                                     "stopped")
+
+
+def test_http_deadline_expired_504_typed_body():
+    """Satellite 1: a queued-expired request surfaces as 504 with a
+    machine-readable type, never a silent drop."""
+    eng = serving.ServingEngine(
+        _StubPredictor(delay=0.2),
+        serving.ServingConfig(max_batch_size=1, num_workers=1,
+                              warmup=False),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    server, _ = serving.start_http_server(eng)
+    base = "http://%s:%d" % server.server_address
+    try:
+        occupier = eng.submit({"x": np.ones((1, 3), "f4")})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + "/predict", {"inputs": {"x": [[1, 2, 3]]},
+                                      "deadline_ms": 20})
+        assert ei.value.code == 504
+        assert json.loads(ei.value.read())["type"] == "DeadlineExpired"
+        occupier.result(10)
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.stop()
+
+
+# -- idempotent request ids -------------------------------------------------
+
+def test_engine_request_id_idempotent_submit():
+    stub = _StubPredictor()
+    eng = serving.ServingEngine(
+        stub, serving.ServingConfig(max_batch_size=4, num_workers=1,
+                                    warmup=False),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    try:
+        x = np.ones((1, 3), "float32")
+        f1 = eng.submit({"x": x}, request_id="a")
+        f2 = eng.submit({"x": x}, request_id="a")
+        assert f1 is f2
+        f1.result(10)
+        # completed ids stay joinable (bounded LRU) — a late duplicate
+        # delivery must not re-run the predictor
+        f3 = eng.submit({"x": x}, request_id="a")
+        assert f3 is f1
+        assert len(stub.calls) == 1
+        assert obs.counter_value("serving.requests") == 1
+        assert obs.counter_value("serving.dedup_hits") == 2
+        # distinct ids are distinct requests
+        f4 = eng.submit({"x": x}, request_id="b")
+        assert f4 is not f1
+        f4.result(10)
+    finally:
+        eng.stop()
+
+
+def test_engine_request_id_cache_bounded():
+    eng = serving.ServingEngine(
+        _StubPredictor(),
+        serving.ServingConfig(max_batch_size=4, num_workers=1,
+                              warmup=False, request_id_cache=4),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    try:
+        x = np.ones((1, 3), "float32")
+        futures = [eng.submit({"x": x}, request_id="id-%d" % i)
+                   for i in range(10)]
+        for f in futures:
+            f.result(10)
+        assert len(eng._ids) <= 4
+        # an evicted id re-executes (the window is a cache, not a log)
+        f = eng.submit({"x": x}, request_id="id-0")
+        assert f is not futures[0]
+        f.result(10)
+    finally:
+        eng.stop()
+
+
+def test_http_request_id_header_joins_duplicate():
+    stub = _StubPredictor()
+    eng = serving.ServingEngine(
+        stub, serving.ServingConfig(max_batch_size=4, num_workers=1,
+                                    warmup=False),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    server, _ = serving.start_http_server(eng)
+    base = "http://%s:%d" % server.server_address
+    try:
+        def post_with_id(rid):
+            req = urllib.request.Request(
+                base + "/predict",
+                json.dumps({"inputs": {"x": [[1, 2, 3]]}}).encode(),
+                {"Content-Type": "application/json",
+                 "X-Request-Id": rid})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read())
+
+        b1 = post_with_id("dup-1")
+        b2 = post_with_id("dup-1")      # duplicate delivery
+        assert b1["outputs"] == b2["outputs"]
+        assert len(stub.calls) == 1     # executed once
+        assert obs.counter_value("serving.dedup_hits") == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.stop()
+
+
+# -- batcher edge cases under faults (satellite 3) --------------------------
+
+def test_requests_racing_drain_never_strand():
+    """Submitters racing stop(drain=True): every future resolves in
+    bounded time — served, or failed with EngineStopped — and the jit
+    ladder property holds for whatever was served."""
+    eng = _stub_engine(delay=0.01, max_queue=64, num_workers=2).start()
+    outcomes = []
+    lock = threading.Lock()
+
+    def submitter():
+        for _ in range(20):
+            try:
+                f = eng.submit({"x": np.ones((1, 3), "f4")})
+            except serving.EngineStopped:
+                with lock:
+                    outcomes.append("refused")
+                return
+            try:
+                f.result(15)
+                with lock:
+                    outcomes.append("served")
+            except serving.EngineStopped:
+                with lock:
+                    outcomes.append("stopped")
+
+    threads = [threading.Thread(target=submitter) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    eng.stop(drain=True, timeout=20)
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive(), "a submitter hung across drain"
+    assert outcomes.count("served") > 0
+    assert obs.counter_value("serving.errors") == 0
+
+
+def test_zero_timeout_batches_serve_correctly():
+    """batch_timeout_ms=0: dispatch whatever is queued the moment a
+    worker frees — every request still gets its own correct rows."""
+    stub = _StubPredictor(delay=0.005)
+    eng = serving.ServingEngine(
+        stub, serving.ServingConfig(max_batch_size=8,
+                                    batch_timeout_ms=0,
+                                    num_workers=1, warmup=False),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    try:
+        results = {}
+
+        def client(i):
+            x = np.full((1, 3), float(i), "float32")
+            results[i] = eng.predict({"x": x}, timeout=10)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(12):
+            np.testing.assert_array_equal(results[i]["y"],
+                                          np.full((1, 3), 2.0 * i))
+        assert obs.counter_value("serving.batches") >= 1
+    finally:
+        eng.stop()
+
+
+def test_predictor_raising_mid_batch_fails_co_batched_survivors_typed():
+    """A poison request co-batched with innocents: the batch fails as
+    a unit with the typed BatchExecutionError for EVERY member (the
+    innocents were in the same dispatch — they cannot have partial
+    results), the engine survives, and the next batch is clean."""
+
+    class Poison(_StubPredictor):
+        def run(self, feed):
+            x = np.asarray(feed["x"])
+            if (x == 666.0).any():
+                raise RuntimeError("mid-batch NaN")
+            return super().run(feed)
+
+    eng = serving.ServingEngine(
+        Poison(), serving.ServingConfig(max_batch_size=8,
+                                        batch_timeout_ms=50,
+                                        num_workers=1, warmup=False),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    try:
+        # the window is long (50ms): both requests land in ONE batch
+        poison = eng.submit({"x": np.full((1, 3), 666.0, "f4")})
+        innocent = eng.submit({"x": np.ones((1, 3), "f4")})
+        for f in (poison, innocent):
+            with pytest.raises(serving.engine.BatchExecutionError,
+                               match="mid-batch NaN"):
+                f.result(10)
+        assert obs.counter_value("serving.batch_errors") == 1
+        assert obs.counter_value("serving.errors") == 2
+        # the worker thread survived: a clean request serves normally
+        out = eng.predict({"x": np.ones((1, 3), "f4")}, timeout=10)
+        np.testing.assert_array_equal(out["y"],
+                                      np.full((1, 3), 2.0))
+        assert eng.health() == "serving"
+    finally:
+        eng.stop()

@@ -1,0 +1,706 @@
+"""Replica-fleet front end (paddle_tpu/serving/fleet.py): shared
+admission control, cost-class load shedding with priority lanes,
+health-checked routing (draining beats connection-refusal), bounded
+hedged retries with exactly-once semantics, deadline inheritance, and
+the HTTP front over a fleet.
+
+Replicas here are REAL loopback HTTP servers over stub predictors —
+the fleet's transport, fault hooks, and lifecycle probing run exactly
+as in production; only the model is a stub. The multi-process drill
+(SIGKILL + supervisor relaunch + merged telemetry) lives in
+``tools/serving_chaos.py`` (CI gate 8).
+"""
+import http.server
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+from paddle_tpu.distributed import fault
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    obs.reset()
+    obs.enable()
+    yield
+    obs.reset()
+    obs.disable()
+
+
+class _StubTensor:
+    def __init__(self, name, data):
+        self.name, self.data = name, data
+
+
+class _StubPredictor:
+    """y = 2x, optional per-dispatch delay (drives hedge/overload
+    determinism)."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+        self.calls = []
+
+    def get_input_names(self):
+        return ["x"]
+
+    def run(self, feed):
+        if self.delay:
+            time.sleep(self.delay)
+        x = np.asarray(feed["x"])
+        self.calls.append(x.shape[0])
+        return [_StubTensor("y", x * 2.0)]
+
+
+def _replica(delay=0.0, **cfg):
+    cfg.setdefault("max_batch_size", 8)
+    cfg.setdefault("num_workers", 2)
+    cfg.setdefault("warmup", False)
+    stub = _StubPredictor(delay)
+    eng = serving.ServingEngine(
+        stub, serving.ServingConfig(**cfg),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    srv, _ = serving.start_http_server(eng)
+    host, port = srv.server_address
+    return eng, srv, stub, "%s:%d" % (host, port)
+
+
+@pytest.fixture()
+def two_replicas():
+    reps = [_replica(), _replica()]
+    yield reps
+    for eng, srv, _, _ in reps:
+        try:
+            srv.shutdown()
+            srv.server_close()
+        except OSError:
+            pass
+        eng.stop()
+
+
+def _router(endpoints, **cfg):
+    cfg.setdefault("max_queue", 32)
+    cfg.setdefault("num_dispatchers", 4)
+    cfg.setdefault("health_interval_ms", 40)
+    cfg.setdefault("hedge_after_ms", 100)
+    return serving.FleetRouter(endpoints,
+                               serving.FleetConfig(**cfg)).start()
+
+
+X = np.arange(6, dtype="float32").reshape(2, 3)
+
+
+# -- config ------------------------------------------------------------------
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError, match="admit fraction"):
+        serving.FleetConfig(cost_classes=[("a", 0.0)])
+    with pytest.raises(ValueError, match="duplicate"):
+        serving.FleetConfig(cost_classes=[("a", 1.0), ("a", 0.5)])
+    with pytest.raises(ValueError, match="default_class"):
+        serving.FleetConfig(default_class="nope")
+    cfg = serving.FleetConfig(max_queue=100)
+    assert cfg.admit_depth("high") == 100
+    assert cfg.admit_depth("low") == 50
+    assert cfg.class_rank("high") < cfg.class_rank("low")
+    with pytest.raises(ValueError, match="unknown cost class"):
+        cfg.class_rank("bulk")
+
+
+# -- routing + results -------------------------------------------------------
+
+def test_fleet_roundtrip_and_spread(two_replicas):
+    eps = [r[3] for r in two_replicas]
+    fr = _router(eps)
+    try:
+        for _ in range(12):
+            out = fr.predict({"x": X}, timeout=10)
+            np.testing.assert_array_equal(out["y"], X * 2)
+        served = {r["endpoint"]: r["served"]
+                  for r in fr.stats()["replicas"]}
+        # least-inflight + round-robin: both replicas took traffic
+        assert all(v > 0 for v in served.values()), served
+    finally:
+        fr.stop()
+
+
+def test_fleet_unknown_cost_class_rejected(two_replicas):
+    fr = _router([r[3] for r in two_replicas])
+    try:
+        with pytest.raises(ValueError, match="unknown cost class"):
+            fr.submit({"x": X}, cost_class="bulk")
+    finally:
+        fr.stop()
+
+
+def test_fleet_admission_hard_bound():
+    """A full shared queue rejects with typed ServerOverloaded (not a
+    shed) and counts serving.rejected."""
+    eng, srv, _, ep = _replica(delay=0.2, num_workers=1,
+                               max_batch_size=1)
+    fr = _router([ep], max_queue=2, num_dispatchers=1,
+                 cost_classes=[("only", 1.0)], hedge_after_ms=None)
+    try:
+        futures, rejected = [], 0
+        for _ in range(12):
+            try:
+                futures.append(fr.submit({"x": np.ones((1, 3), "f4")},
+                                         cost_class="only"))
+            except serving.RequestShed:
+                pytest.fail("hard bound must raise ServerOverloaded, "
+                            "not RequestShed")
+            except serving.ServerOverloaded:
+                rejected += 1
+        assert rejected > 0
+        assert obs.counter_value("serving.rejected") == rejected
+        for f in futures:
+            f.result(30)
+    finally:
+        fr.stop()
+        srv.shutdown()
+        eng.stop()
+
+
+def test_fleet_shed_by_class_under_overload():
+    """The acceptance property: under a synthetic burst the LOW lane
+    sheds strictly more than the HIGH lane, high admits outnumber low
+    admits, and sheds are typed + counted per class."""
+    eng, srv, _, ep = _replica(delay=0.05, num_workers=1,
+                               max_batch_size=4)
+    fr = _router([ep], max_queue=12, num_dispatchers=2,
+                 hedge_after_ms=None)
+    try:
+        shed = {"high": 0, "normal": 0, "low": 0}
+        admitted = dict(shed)
+        futures = []
+        classes = ("high", "normal", "low")
+        for i in range(90):
+            cls = classes[i % 3]
+            try:
+                futures.append(fr.submit({"x": np.ones((1, 3), "f4")},
+                                         cost_class=cls))
+                admitted[cls] += 1
+            except serving.RequestShed:
+                shed[cls] += 1
+            except serving.ServerOverloaded:
+                shed[cls] += 1
+        for f in futures:
+            f.result(60)
+        assert shed["low"] > shed["high"], (shed, admitted)
+        assert admitted["high"] > admitted["low"], (shed, admitted)
+        # typed + labeled: the watermark sheds are per-class counters
+        assert obs.counter_value("serving.shed",
+                                 **{"class": "low"}) > 0
+    finally:
+        fr.stop()
+        srv.shutdown()
+        eng.stop()
+
+
+def test_fleet_priority_lane_dispatch_order():
+    """Admitted high-priority work leaves the queue before admitted
+    low-priority work that arrived EARLIER."""
+    eng, srv, stub, ep = _replica(delay=0.05, num_workers=1,
+                                  max_batch_size=1)
+    fr = _router([ep], num_dispatchers=1, hedge_after_ms=None)
+    try:
+        order = []
+        lock = threading.Lock()
+
+        def track(f, tag):
+            f.add_done_callback(
+                lambda _: (lock.acquire(), order.append(tag),
+                           lock.release()))
+
+        # occupy the single dispatcher, then queue low before high
+        busy = fr.submit({"x": np.ones((1, 3), "f4")},
+                         cost_class="high")
+        track(fr.submit({"x": np.ones((1, 3), "f4")},
+                        cost_class="low"), "low")
+        track(fr.submit({"x": np.ones((1, 3), "f4")},
+                        cost_class="high"), "high")
+        busy.result(10)
+        deadline = time.monotonic() + 10
+        while len(order) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert order == ["high", "low"], order
+    finally:
+        fr.stop()
+        srv.shutdown()
+        eng.stop()
+
+
+# -- deadlines ---------------------------------------------------------------
+
+def test_fleet_queue_expiry_is_typed_not_silent():
+    """A request whose deadline passes while QUEUED fails with the
+    typed DeadlineExpired (counted) — never dispatched, never
+    silently dropped."""
+    eng, srv, stub, ep = _replica(delay=0.25, num_workers=1,
+                                  max_batch_size=1)
+    fr = _router([ep], num_dispatchers=1, hedge_after_ms=None)
+    try:
+        busy = fr.submit({"x": np.ones((1, 3), "f4")})  # occupies
+        doomed = fr.submit({"x": np.ones((1, 3), "f4")},
+                           deadline_ms=30)
+        with pytest.raises(serving.DeadlineExpired, match="queued"):
+            doomed.result(10)
+        busy.result(10)
+        assert obs.counter_value("serving.deadline_expired") >= 1
+        # the doomed request never generated a dispatch
+        assert len(stub.calls) <= 2
+    finally:
+        fr.stop()
+        srv.shutdown()
+        eng.stop()
+
+
+class _RecordingReplica(threading.Thread):
+    """A bare HTTP replica that RECORDS each /predict body (the
+    deadline the fleet actually sent) and can stall before answering —
+    the probe for deadline inheritance and hedge behavior."""
+
+    def __init__(self, stall_s=0.0, healthz="serving"):
+        super().__init__(daemon=True)
+        self.bodies = []
+        self.stall_s = stall_s
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: A003
+                pass
+
+            def do_GET(self):
+                body = json.dumps({"status": healthz}).encode()
+                code = 200 if healthz == "serving" else 503
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                doc = json.loads(self.rfile.read(n) or b"{}")
+                outer.bodies.append(doc)
+                if outer.stall_s:
+                    time.sleep(outer.stall_s)
+                x = np.asarray(doc["inputs"]["x"], "float32")
+                body = json.dumps(
+                    {"outputs": {"y": (x * 2).tolist()}}).encode()
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    pass  # hedge loser: client already hung up
+
+        self.server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), H)
+        self.server.daemon_threads = True
+        self.endpoint = "127.0.0.1:%d" % self.server.server_address[1]
+
+    def run(self):
+        self.server.serve_forever()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_hedged_attempt_inherits_remaining_deadline():
+    """Satellite 1: the hedge's wire deadline_ms must be the REMAINING
+    budget at hedge time — strictly below the original attempt's."""
+    slow = _RecordingReplica(stall_s=0.5)
+    fast = _RecordingReplica(stall_s=0.0)
+    slow.start()
+    fast.start()
+    fr = _router([slow.endpoint, fast.endpoint], num_dispatchers=1,
+                 hedge_after_ms=80, max_hedges=1)
+    try:
+        out = fr.predict({"x": np.ones((1, 3), "f4")},
+                         deadline_ms=5000, timeout=10)
+        assert out["y"].shape == (1, 3)
+        deadline = time.monotonic() + 5
+        while not (slow.bodies and fast.bodies) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert slow.bodies and fast.bodies, "hedge never fired"
+        first = slow.bodies[0]["deadline_ms"]
+        hedge = fast.bodies[0]["deadline_ms"]
+        # the hedge launched >= 80ms later: its inherited budget must
+        # be visibly smaller than the original's
+        assert hedge < first - 50, (first, hedge)
+        assert obs.counter_value("serving.hedges") == 1
+    finally:
+        fr.stop()
+        slow.close()
+        fast.close()
+
+
+# -- hedging + exactly-once --------------------------------------------------
+
+def test_fleet_hedge_straggler_exactly_once(two_replicas):
+    """One replica straggles: the hedge wins on the other, the result
+    surfaces EXACTLY once with correct values, and the request counts
+    once on the fleet."""
+    slow = _RecordingReplica(stall_s=1.0)
+    slow.start()
+    _, _, _, fast_ep = two_replicas[0]
+    fr = _router([slow.endpoint, fast_ep], num_dispatchers=1,
+                 hedge_after_ms=60, max_hedges=1)
+    try:
+        results = []
+        f = fr.submit({"x": X}, deadline_ms=8000)
+        f.add_done_callback(lambda fut: results.append(fut.result()))
+        out = f.result(10)
+        np.testing.assert_array_equal(out["y"], X * 2)
+        time.sleep(0.1)
+        assert len(results) == 1          # the latch: one surface, ever
+        assert obs.counter_value("serving.hedges") >= 1
+        # in-process registries are SHARED: 1 fleet admission + 1
+        # winning-replica engine execution (the straggler is a
+        # recording stub with no engine) — exactly 2, never 3
+        assert obs.counter_value("serving.requests") == 2
+    finally:
+        fr.stop()
+        slow.close()
+
+
+def test_fleet_request_id_dedup(two_replicas):
+    """Duplicate submits with one request id join the original future
+    and never double-count."""
+    fr = _router([r[3] for r in two_replicas])
+    try:
+        f1 = fr.submit({"x": X}, request_id="req-7")
+        f2 = fr.submit({"x": X}, request_id="req-7")
+        assert f1 is f2
+        f1.result(10)
+        # a LATE duplicate (original already done) still joins it
+        f3 = fr.submit({"x": X}, request_id="req-7")
+        assert f3 is f1
+        # shared in-process registry: 1 fleet admission + 1 replica
+        # engine execution; the duplicates joined, they never re-ran
+        assert obs.counter_value("serving.requests") == 2
+        assert obs.counter_value("serving.dedup_hits") == 2
+    finally:
+        fr.stop()
+
+
+def test_engine_request_id_dedup_never_reruns_predictor():
+    """Replica half of exactly-once: a duplicate DELIVERY (hedge, dup
+    frame, retry) joins the original execution — the predictor runs
+    once, even after the original completed."""
+    stub = _StubPredictor()
+    eng = serving.ServingEngine(
+        stub, serving.ServingConfig(max_batch_size=4, num_workers=1,
+                                    warmup=False),
+        sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+    try:
+        f1 = eng.submit({"x": X}, request_id="r1")
+        f2 = eng.submit({"x": X}, request_id="r1")
+        assert f1 is f2
+        out = f1.result(10)
+        np.testing.assert_array_equal(out["y"], X * 2)
+        # late duplicate after completion: joined from the LRU, not
+        # re-executed
+        f3 = eng.submit({"x": X}, request_id="r1")
+        assert f3 is f1
+        assert len(stub.calls) == 1
+        assert obs.counter_value("serving.requests") == 1
+        assert obs.counter_value("serving.dedup_hits") == 2
+    finally:
+        eng.stop()
+
+
+# -- health-checked routing --------------------------------------------------
+
+def test_fleet_retry_on_dead_replica_and_ejection(two_replicas):
+    """A replica whose socket refuses connections: requests still
+    succeed via retry on the survivor, and the corpse is ejected in
+    bounded time with cause=dead."""
+    (e1, s1, _, ep1), (_, _, _, ep2) = two_replicas
+    s1.shutdown()
+    s1.server_close()
+    e1.stop()
+    fr = _router([ep1, ep2], eject_after=2, hedge_after_ms=None,
+                 max_attempts=4)
+    try:
+        for _ in range(6):
+            out = fr.predict({"x": X}, timeout=10)
+            np.testing.assert_array_equal(out["y"], X * 2)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            states = {r["endpoint"]: r["state"]
+                      for r in fr.stats()["replicas"]}
+            if states[ep1] == "dead":
+                break
+            time.sleep(0.05)
+        assert states[ep1] == "dead", states
+        assert obs.counter_value("serving.replica_ejections",
+                                 cause="dead") >= 1
+    finally:
+        fr.stop()
+
+
+def test_fleet_stops_routing_at_draining_not_refusal(two_replicas):
+    """Satellite 2: the router reads the replica's machine-readable
+    lifecycle — a DRAINING replica (socket still accepting!) leaves
+    rotation proactively, and every subsequent request lands on the
+    healthy one."""
+    (e1, s1, stub1, ep1), (_, _, stub2, ep2) = two_replicas
+    fr = _router([ep1, ep2], health_interval_ms=30)
+    try:
+        fr.predict({"x": X}, timeout=10)
+        e1.stop()          # draining; its HTTP server still answers
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            states = {r["endpoint"]: r["state"]
+                      for r in fr.stats()["replicas"]}
+            if states[ep1] == "draining":
+                break
+            time.sleep(0.02)
+        assert states[ep1] == "draining", states
+        n2 = len(stub2.calls)
+        for _ in range(6):
+            out = fr.predict({"x": X}, timeout=10)
+            np.testing.assert_array_equal(out["y"], X * 2)
+        assert len(stub2.calls) >= n2 + 6   # all on the survivor
+        assert obs.counter_value("serving.replica_ejections",
+                                 cause="draining") == 1
+    finally:
+        fr.stop()
+
+
+def test_fleet_rejoin_after_replacement():
+    """An ejected endpoint whose process comes back (same port) is
+    re-admitted by the prober and serves again — the relaunch half of
+    the chaos drill, in-process."""
+    eng1, srv1, _, ep1 = _replica()
+    port = int(ep1.rsplit(":", 1)[1])
+    eng2, srv2, _, ep2 = _replica()
+    fr = _router([ep1, ep2], eject_after=2, health_interval_ms=30,
+                 hedge_after_ms=None)
+    try:
+        fr.predict({"x": X}, timeout=10)
+        # kill replica 1 hard
+        srv1.shutdown()
+        srv1.server_close()
+        eng1.stop()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            st = {r["endpoint"]: r["state"]
+                  for r in fr.stats()["replicas"]}
+            if st[ep1] == "dead":
+                break
+            time.sleep(0.02)
+        assert st[ep1] == "dead", st
+        # "relaunch" it on the SAME endpoint
+        stub = _StubPredictor()
+        eng3 = serving.ServingEngine(
+            stub, serving.ServingConfig(max_batch_size=8,
+                                        num_workers=1, warmup=False),
+            sample_feed={"x": np.zeros((1, 3), "float32")}).start()
+        srv3 = serving.ServingHTTPServer(eng3, "127.0.0.1", port)
+        t = threading.Thread(target=srv3.serve_forever, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                st = {r["endpoint"]: r["state"]
+                      for r in fr.stats()["replicas"]}
+                if st[ep1] == "serving":
+                    break
+                time.sleep(0.02)
+            assert st[ep1] == "serving", st
+            assert obs.counter_value("serving.replica_rejoins") == 1
+            # and it takes traffic again
+            deadline = time.monotonic() + 5
+            while not stub.calls and time.monotonic() < deadline:
+                fr.predict({"x": X}, timeout=10)
+            assert stub.calls
+        finally:
+            srv3.shutdown()
+            srv3.server_close()
+            eng3.stop()
+    finally:
+        fr.stop()
+        srv2.shutdown()
+        srv2.server_close()
+        eng2.stop()
+
+
+def test_fleet_no_replica_fails_typed():
+    """Nothing routable and the budget gone: the typed
+    ReplicaUnavailable, not a hang."""
+    port = _free_port()
+    fr = _router(["127.0.0.1:%d" % port], max_attempts=2,
+                 hedge_after_ms=None, request_timeout_s=1.5,
+                 eject_after=1000)  # keep it routable: test the
+    # attempt path, not the eject path
+    try:
+        with pytest.raises((serving.ReplicaUnavailable,
+                            serving.DeadlineExpired)):
+            fr.predict({"x": X}, deadline_ms=800, timeout=10)
+    finally:
+        fr.stop()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- faults on the fleet RPC path -------------------------------------------
+
+def test_fleet_absorbs_injected_rpc_faults(two_replicas, monkeypatch):
+    """drop/delay/close on the dispatch path: every request still
+    succeeds (hedge/retry), faults are counted, nothing is lost."""
+    monkeypatch.setenv("PADDLE_TPU_FAULTS",
+                       "send.drop:0.15,any.delay:0.1:5,send.close:0.05")
+    monkeypatch.setenv("PADDLE_TPU_FAULT_SEED", "7")
+    fault.reset_injector()
+    try:
+        fr = _router([r[3] for r in two_replicas], hedge_after_ms=50,
+                     max_attempts=6)
+        try:
+            for i in range(20):
+                out = fr.predict({"x": X}, deadline_ms=10000,
+                                 timeout=30)
+                np.testing.assert_array_equal(out["y"], X * 2)
+        finally:
+            fr.stop()
+        assert obs.counter_value("serving.errors") == 0
+        injected = sum(
+            m.value for m in obs.metrics().all_metrics()
+            if m.kind == "counter"
+            and m.qualified_name.startswith("fault.injected"))
+        assert injected > 0
+    finally:
+        fault.reset_injector()
+
+
+# -- HTTP front over a fleet -------------------------------------------------
+
+@pytest.fixture()
+def fleet_http(two_replicas):
+    fr = _router([r[3] for r in two_replicas])
+    server, _ = serving.start_http_server(fr)
+    host, port = server.server_address
+    yield fr, "http://%s:%d" % (host, port)
+    server.shutdown()
+    server.server_close()
+    fr.stop()
+
+
+def _post(url, payload, headers=()):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(dict(headers))
+    req = urllib.request.Request(url, json.dumps(payload).encode(),
+                                 hdrs)
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_front_serves_fleet(fleet_http):
+    fr, base = fleet_http
+    with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+        assert r.status == 200
+        assert json.loads(r.read())["status"] == "serving"
+    status, body = _post(base + "/predict",
+                         {"inputs": {"x": [[1, 2, 3]]},
+                          "cost_class": "low"},
+                         headers=[("X-Request-Id", "http-1")])
+    assert status == 200
+    np.testing.assert_array_equal(np.asarray(body["outputs"]["y"]),
+                                  [[2, 4, 6]])
+    # bad cost_class type is a 400, not a 500
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base + "/predict", {"inputs": {"x": [[1, 2, 3]]},
+                                  "cost_class": 3})
+    assert ei.value.code == 400
+
+
+def test_http_fleet_deadline_expired_504_typed():
+    """Satellite 1 end-to-end: a queued-expired fleet request surfaces
+    as HTTP 504 with the machine-readable type."""
+    port = _free_port()  # a black-hole replica: accepts, never answers
+    sink = socket.socket()
+    sink.bind(("127.0.0.1", port))
+    sink.listen(8)
+    fr = _router(["127.0.0.1:%d" % port], hedge_after_ms=None,
+                 max_attempts=1, eject_after=1000)
+    server, _ = serving.start_http_server(fr)
+    host, hport = server.server_address
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post("http://%s:%d/predict" % (host, hport),
+                  {"inputs": {"x": [[1, 2, 3]]}, "deadline_ms": 300})
+        assert ei.value.code == 504
+        body = json.loads(ei.value.read())
+        assert body["type"] == "DeadlineExpired"
+    finally:
+        server.shutdown()
+        server.server_close()
+        fr.stop()
+        sink.close()
+
+
+def test_http_fleet_shed_503_typed(two_replicas):
+    """A shed lane surfaces as 503 with type=RequestShed and a
+    Retry-After — distinguishable from the hard bound."""
+    eng, srv, _, ep = _replica(delay=0.2, num_workers=1,
+                               max_batch_size=1)
+    fr = _router([ep], max_queue=4, num_dispatchers=1,
+                 hedge_after_ms=None)
+    server, _ = serving.start_http_server(fr)
+    host, hport = server.server_address
+    base = "http://%s:%d" % (host, hport)
+    try:
+        shed_seen = None
+        threads = []
+        for i in range(10):
+            t = threading.Thread(target=lambda: _try_post(base))
+            t.start()
+            threads.append(t)
+        for i in range(20):
+            try:
+                _post(base + "/predict",
+                      {"inputs": {"x": [[1, 2, 3]]},
+                       "cost_class": "low"})
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    body = json.loads(e.read())
+                    if body.get("type") == "RequestShed":
+                        shed_seen = (e.headers.get("Retry-After"), body)
+                        break
+        for t in threads:
+            t.join(30)
+        assert shed_seen is not None, "no RequestShed surfaced"
+        assert shed_seen[0] == "1"
+    finally:
+        server.shutdown()
+        server.server_close()
+        fr.stop()
+        srv.shutdown()
+        eng.stop()
+
+
+def _try_post(base):
+    try:
+        _post(base + "/predict", {"inputs": {"x": [[1, 2, 3]]},
+                                  "cost_class": "high"})
+    except Exception:  # noqa: BLE001 — saturation traffic; errors fine
+        pass

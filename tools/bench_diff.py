@@ -48,6 +48,14 @@ WATCHED = (
     # steers by) regresses agreement even when every host metric holds
     ("device_overlap_frac", +1), ("device_critical_path_ms", -1),
     ("host_device_agreement", +1),
+    # serving records (tools/serving_bench.py --out): closed-loop
+    # throughput/latency, queue wait, real batch size, padding waste,
+    # and the compile count the bucket ladder exists to bound — a
+    # serving regression fails CI exactly like a training one
+    ("rows_per_s", +1), ("p50_ms", -1), ("p99_ms", -1),
+    ("serving_queue_ms_p50", -1), ("serving_queue_ms_p99", -1),
+    ("serving_batch_size_mean", +1),
+    ("serving_padding_waste_frac", -1), ("jit_traces", -1),
 )
 
 # absolute noise floors for measured-timing metrics: a relative
@@ -60,6 +68,11 @@ ABS_NOISE_FLOOR = {
     "exposed_collective_ms": 2.0, "overlap_frac": 0.1,
     "device_overlap_frac": 0.1, "device_critical_path_ms": 2.0,
     "host_device_agreement": 0.1,
+    # serving latencies on a loaded CI box jitter in the single-digit
+    # ms; batch size / padding waste depend on thread-arrival raggedness
+    "p50_ms": 5.0, "p99_ms": 10.0,
+    "serving_queue_ms_p50": 5.0, "serving_queue_ms_p99": 10.0,
+    "serving_batch_size_mean": 1.0, "serving_padding_waste_frac": 0.15,
 }
 
 # counter totals (metrics.json) where growth is a regression.
@@ -70,7 +83,11 @@ ABS_NOISE_FLOOR = {
 COUNTER_WATCH_GROWS_BAD = ("parallel.collective_bytes",
                            "parallel.collective_ops",
                            "executor.compile_fallbacks",
-                           "ps.replication_bytes")
+                           "ps.replication_bytes",
+                           # the serving smoke must stay error-free:
+                           # any growth (including 0 -> n) is a bug
+                           # the functional assertions may have missed
+                           "serving.errors", "serving.batch_errors")
 
 
 def load(path):
@@ -315,6 +332,30 @@ def _self_test():
               if r[1] == "device_overlap_frac"]
     assert dovbad and dovbad[0][-1], dovbad
     assert not any(r[-1] for r in diff_records(d0, d0, 0.10))
+    # serving records: a queue-wait blowup or a compile-count leak
+    # (the ladder property breaking) must flag; sub-floor latency
+    # jitter must not; serving.errors growth from zero must flag
+    s0 = {"configs": {"serving_smoke": {
+        "rows_per_s": 5000.0, "p99_ms": 40.0,
+        "serving_queue_ms_p99": 20.0, "serving_batch_size_mean": 3.0,
+        "serving_padding_waste_frac": 0.3, "jit_traces": 4}},
+        "counters_total": {"serving.errors": 0}}
+    s1 = {"configs": {"serving_smoke": {
+        "rows_per_s": 5000.0, "p99_ms": 44.0,
+        "serving_queue_ms_p99": 24.0, "serving_batch_size_mean": 3.0,
+        "serving_padding_waste_frac": 0.32, "jit_traces": 4}},
+        "counters_total": {"serving.errors": 0}}
+    assert not any(r[-1] for r in diff_records(s0, s1, 0.5)), \
+        list(diff_records(s0, s1, 0.5))
+    s2 = {"configs": {"serving_smoke": {
+        "rows_per_s": 5000.0, "p99_ms": 40.0,
+        "serving_queue_ms_p99": 200.0, "serving_batch_size_mean": 3.0,
+        "serving_padding_waste_frac": 0.3, "jit_traces": 12}},
+        "counters_total": {"serving.errors": 3}}
+    sbad = {r[1] for r in diff_records(s0, s2, 0.5) if r[-1]}
+    assert {"serving_queue_ms_p99", "jit_traces"} <= sbad, sbad
+    scbad = [r for r in diff_counters(s0, s2, 0.25) if r[-1]]
+    assert scbad and scbad[0][0] == "serving.errors", scbad
     print("bench_diff self-test ok")
     return 0
 

@@ -10,6 +10,11 @@ Usage:
     python tools/serving_bench.py                 # full bench table
     python tools/serving_bench.py --smoke         # fast CI assertions
     python tools/serving_bench.py --json out.json # also dump raw numbers
+    python tools/serving_bench.py --smoke --out r.json
+        # ALSO write a bench_diff-compatible serving record
+        # ({"configs": {"serving_smoke": ...}, "counters_total": ...})
+        # so ci/check.sh can diff serving perf run-over-run exactly
+        # like the training smokes (gate 5c)
 
 The bench is CLOSED-LOOP: each of C client threads fires its next
 request only after the previous one completes — the concurrency level,
@@ -177,10 +182,44 @@ class _Throttled:
         return self._inner.run(feed)
 
 
-def smoke():
+def serving_record(wall, lats, rows, traces):
+    """A bench_diff-compatible record of the smoke's burst phase: the
+    throughput/latency row plus the serving.* registry families the
+    perf gate watches (queue wait, real batch size, padding waste,
+    compile count, shed/hedge counters)."""
+    q = obs.histogram("serving.queue_ms").snapshot()
+    b = obs.histogram("serving.batch_size").snapshot()
+    padded = obs.counter_value("serving.padding_waste")
+    dispatched = (b["sum"] or 0) + padded
+    rec = {
+        "rows_per_s": round(rows / wall, 1),
+        "p50_ms": round(reservoir_quantile(lats, 0.5), 3),
+        "p99_ms": round(reservoir_quantile(lats, 0.99), 3),
+        "serving_queue_ms_p50": q.get("p50"),
+        "serving_queue_ms_p99": q.get("p99"),
+        "serving_batch_size_mean": b.get("mean"),
+        # padded rows as a fraction of all DISPATCHED rows — the
+        # ladder-tuning number, scale-free so run sizes can change
+        "serving_padding_waste_frac": (
+            round(padded / dispatched, 4) if dispatched else 0.0),
+        "jit_traces": traces,
+    }
+    counters = {}
+    for name in ("serving.requests", "serving.rejected",
+                 "serving.errors", "serving.batch_errors",
+                 "serving.batches", "serving.padding_waste",
+                 "serving.deadline_expired", "serving.hedges",
+                 "serving.fleet_retries", "serving.dedup_hits"):
+        counters[name] = obs.counter_value(name)
+    return {"configs": {"serving_smoke": rec},
+            "counters_total": counters}
+
+
+def smoke(out_path=None):
     """CI gate 5b: warmup bounds compiles to the ladder; 64 concurrent
     ragged requests add zero compiles and zero errors; an undersized
-    queue actually rejects (backpressure engages)."""
+    queue actually rejects (backpressure engages). With ``out_path``
+    also writes the bench_diff record gate 5c diffs run-over-run."""
     failures = []
     obs.reset()
     obs.enable()
@@ -219,6 +258,12 @@ def smoke():
         reqs = obs.counter_value("serving.requests")
         if reqs != 64:  # warmup bypasses submit(), so exactly the burst
             failures.append("serving.requests = %d, want 64" % reqs)
+        # the perf-gate record snapshots HERE — the burst phase only,
+        # before the deliberately-throttled backpressure engine below
+        # pollutes the queue_ms distribution
+        record = serving_record(wall, lats,
+                                sum(r.shape[0] for r in requests),
+                                traces)
         engine.stop()
 
         # backpressure: 1-row batches through a throttled predictor,
@@ -242,6 +287,10 @@ def smoke():
             failures.append("undersized queue rejected nothing — "
                             "admission control is not engaging")
 
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2)
+        print("wrote serving perf record: %s" % out_path)
     if failures:
         print("SERVING SMOKE FAILED:")
         for f in failures:
@@ -259,9 +308,12 @@ def main(argv=None):
                     help="fast CI assertions instead of the bench")
     ap.add_argument("--requests", type=int, default=256)
     ap.add_argument("--json", dest="json_path", default=None)
+    ap.add_argument("--out", dest="out_path", default=None,
+                    help="(with --smoke) write a bench_diff-compatible"
+                         " serving record here for the CI perf gate")
     args = ap.parse_args(argv)
     if args.smoke:
-        return smoke()
+        return smoke(out_path=args.out_path)
     bench(n_requests=args.requests, json_path=args.json_path)
     return 0
 
