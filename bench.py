@@ -1041,10 +1041,19 @@ def bench_multichip_config(name, iters=None, quant=None, sharded=True):
                                                  quant_mode,
                                                  sharded_update_enabled)
 
+    from paddle_tpu.analysis import schedule_record
+
     collective_rec = {
         "per_step": per_step,
         "pergrad_baseline_ops": base_ops,
         "pergrad_baseline_bytes": base_bytes,
+        # static collective-consistency verdict over the REWRITTEN
+        # program (ISSUE 12): ok + schedule digest — two ranks/processes
+        # running the same plan must agree on the digest, and a
+        # conditional/double-reduce hazard flips ok to False with the
+        # op named in "error"
+        "schedule": schedule_record(main, nranks=MC_DEVICES,
+                                    scope=scope),
         "quant_int8_bytes_saved": int(quant_save),
         # executed bucket layout + which planner produced it —
         # "demonstrably changes the bucket plan" is assertable from

@@ -13,12 +13,22 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export PALLAS_AXON_POOL_IPS=
+# ISSUE 12: force the static IR verifier ON for every CI gate (it
+# defaults OFF in prod). Gate 4 measures the DEFAULT-off path and
+# un-sets it explicitly.
+export PADDLE_TPU_VERIFY_IR=1
 
 if [[ "${1:-}" == "--update" ]]; then
     python -m paddle_tpu.tools.print_signatures > ci/api_fingerprint.txt
     echo "ci/api_fingerprint.txt refreshed ($(wc -l < ci/api_fingerprint.txt) entries)"
     exit 0
 fi
+
+echo "== gate 0: repo lint =="
+# bare/silent excepts, metric-naming convention, unlocked module state
+# in serving//distributed/ — new violations fail; grandfathered ones
+# live in tools/lint_allowlist.txt
+python tools/lint.py
 
 echo "== gate 1: op-registry parity (diff must be 0 vs allowlist) =="
 python -m paddle_tpu.tools.check_op_registry --parity
@@ -32,6 +42,15 @@ if ! diff -u ci/api_fingerprint.txt "$FP_TMP"; then
     exit 1
 fi
 echo "API surface unchanged ($(wc -l < ci/api_fingerprint.txt) entries)"
+
+echo "== gate 2b: IR-verifier mutation self-test =="
+# ISSUE 12 acceptance: >= 12 seeded IR corruption kinds (dangling
+# refs, use-before-def, dtype/shape flips, rank-divergent collective
+# schedules, broken rewrite contracts, ...) must each be rejected by
+# paddle_tpu/analysis with a structured finding; a clean transpiled
+# program must verify clean. This is the verifier's own regression
+# suite.
+python tools/ir_mutate.py
 
 echo "== gate 3: native artifacts build =="
 if command -v g++ >/dev/null; then
@@ -55,8 +74,12 @@ fi
 # flight-recorder ring — must stay sub-microsecond on their disabled /
 # always-on paths (guard threshold, not exact timing — see
 # tools/obs_overhead.py)
+#    ... and (ISSUE 12) the default-off IR-verify hook must stay <1us
+#    per program run — PADDLE_TPU_VERIFY_IR is un-set here because
+#    this gate measures the DEFAULT path
 env -u PADDLE_TPU_METRICS -u FLAGS_tpu_metrics \
     -u PADDLE_TPU_METRICS_DIR -u PADDLE_TPU_DEVICE_TRACE \
+    -u PADDLE_TPU_VERIFY_IR \
     python -m paddle_tpu.tools.obs_overhead
 
 echo "== gate 5: serving =="

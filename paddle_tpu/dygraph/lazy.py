@@ -279,6 +279,16 @@ class LazyEngine:
             self._jit_cache.move_to_end(key)
             obs.inc("lazy.cache_hits")
         else:
+            from ..analysis import verify_enabled as _verify_enabled
+
+            if _verify_enabled():
+                # flush graphs are the lazy path's "rewritten program":
+                # structurally verify the wiring before jitting it
+                from ..analysis import verify_lazy_graph
+
+                verify_lazy_graph(wiring,
+                                  [len(nd.outs) for nd in nodes],
+                                  len(ext), needed)
             # a structural cache miss == a retrace + XLA recompile of
             # the whole queued step: the metric that catches signature
             # churn (varying shapes/attrs) killing steady-state perf

@@ -343,8 +343,10 @@ def load_inference_model(dirname, executor, model_filename=None,
             and os.path.exists(os.path.join(dirname, "__model__")):
         model_filename = "__model__"  # a reference-saved model dir
     if model_filename is not None and not model_filename.endswith(".json"):
-        return _load_inference_model_proto(dirname, model_filename,
-                                           params_filename)
+        program, feed_names, fetch_vars = _load_inference_model_proto(
+            dirname, model_filename, params_filename)
+        _verify_loaded_program(program, fetch_vars)
+        return program, feed_names, fetch_vars
     if not os.path.exists(json_path):
         raise FileNotFoundError(
             "no model file %r (or '__model__') in %r — dir contains %s"
@@ -371,7 +373,20 @@ def load_inference_model(dirname, executor, model_filename=None,
     feed_names = model.get("feed_names", [])
     fetch_names = model.get("fetch_names", [])
     fetch_vars = [program.global_block().var(n) for n in fetch_names]
+    _verify_loaded_program(program, fetch_vars)
     return program, feed_names, fetch_vars
+
+
+def _verify_loaded_program(program, fetch_vars):
+    """Static verification of a just-deserialized inference program
+    (PADDLE_TPU_VERIFY_IR; default off): a model file corrupted on
+    disk or saved by a buggy rewrite fails HERE with the op and
+    invariant named, not at first predict."""
+    from .analysis import maybe_verify_program
+
+    maybe_verify_program(
+        program, where="io.load_inference_model",
+        fetch_names=[v.name for v in fetch_vars])
 
 
 def _load_inference_model_proto(dirname, model_filename, params_filename):

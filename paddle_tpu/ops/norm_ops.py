@@ -31,7 +31,10 @@ from ..core.registry import RNG_SEED_ATTR, In, Out, register_op
         Out("VarianceOut", is_ref=True, no_grad=True),
         Out("SavedMean", no_grad=True),
         Out("SavedVariance", no_grad=True),
-        Out("ReserveSpace", no_grad=True),
+        # cuDNN-only scratch in the reference (dispensable there too);
+        # the kernel returns None for it and inference-pruned programs
+        # never bind it — surfaced by the ISSUE-12 verifier
+        Out("ReserveSpace", dispensable=True, no_grad=True),
     ],
     attrs={
         "momentum": 0.9,

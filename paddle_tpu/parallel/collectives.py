@@ -68,6 +68,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.contracts import checked_rewrite
 from ..ops.collective_ops import QUANT_WIRE_ITEMSIZE, SHARDED_UPDATE_SLOTS
 from .transpiler import _bump_version, _merge_data_axes
 
@@ -381,6 +382,7 @@ def plan_buckets_profile(items, report, bucket_bytes: int,
     return buckets
 
 
+@checked_rewrite("bucket_allreduce")
 def bucket_allreduce_ops(program, bucket_bytes: int = 4 << 20,
                          quant: str = "none", scope=None,
                          plan: str = "size", report=None) -> int:
@@ -572,6 +574,7 @@ def resync_sharded_state(program, scope) -> int:
     return n
 
 
+@checked_rewrite("sharded_update")
 def apply_sharded_weight_update(program, scope, nranks: int,
                                 axis: str = "dp",
                                 quant: str = "none") -> int:

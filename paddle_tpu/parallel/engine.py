@@ -287,6 +287,17 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
            tuple(sorted((k, v) for k, v in feed_specs.items())))
     hit = _dp_cache.get(key)
     if hit is None:
+        # first run of this (program, mesh) pairing: statically verify
+        # the rewritten IR and its collective schedule BEFORE paying
+        # the compile — a malformed rewrite or a rank-divergent
+        # schedule fails here with the op named, not as a hang inside
+        # shard_map. Default off (PADDLE_TPU_VERIFY_IR); cache hits
+        # never reach this branch, so steady-state cost is zero.
+        from ..analysis import maybe_verify_program
+
+        maybe_verify_program(program, where="parallel.engine",
+                             fetch_names=fetch_names, nranks=nranks,
+                             scope=scope)
         _obs.inc("parallel.compiles")
         coll_est = _estimate_collective_bytes(program, state)
         def shard_step(state_d, feeds_d, seed):

@@ -240,6 +240,16 @@ def run_pipeline_parallel(core, program, scope: Scope, feed: Dict,
            tuple(sorted((k, v) for k, v in shard_specs.items())))
     compiled = _pp_cache.get(key)
     if compiled is None:
+        from ..analysis import maybe_verify_program, verify_enabled
+
+        if verify_enabled():
+            # stage-partition contract + full well-formedness check on
+            # the first compile of this (program, mesh) pairing
+            from ..analysis.contracts import check_pipeline_split
+
+            check_pipeline_split(program, stages, meta["n_fwd_ops"])
+            maybe_verify_program(program, where="parallel.pipeline",
+                                 scope=scope)
         _obs.inc("pipeline.compiles")
         with _obs.tracing.span("pipeline/build", cat="compile",
                                stages=n_stages, microbatches=n_micro):

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Optional, Set
 
+from ..analysis.contracts import checked_rewrite
 from ..core.registry import GRAD_SUFFIX, OpInfoMap
 
 OPTIMIZER_OP_TYPES = {
@@ -26,6 +27,7 @@ def _is_loss_grad_seed(op):
             and float(op.attrs.get("value", 0.0)) == 1.0)
 
 
+@checked_rewrite("insert_allreduce")
 def insert_allreduce_ops(program, nranks: int, ring_id: int = 0,
                          scale_loss: bool = True, skip_grads=None):
     """Rewrite a training program for data parallelism: scale the loss
@@ -147,6 +149,7 @@ def _merge_data_axes(program, axes):
     program._data_axes = tuple(cur)
 
 
+@checked_rewrite("sharded_embedding")
 def apply_sharded_embedding(program, axis: str = "mp", degree: int = 0,
                             startup_program=None):
     """Tensor parallelism for embedding tables: every lookup_table[_v2]
@@ -218,6 +221,7 @@ def _pad_table_rows(program, startup_program, name, var, v_pad):
         sv.shape = new_shape
 
 
+@checked_rewrite("sequence_parallel")
 def apply_sequence_parallel(program, axis: str = "sp", degree: int = 0,
                             feed_specs=None):
     """Sequence/context parallelism: flash_attention ops become
@@ -271,6 +275,7 @@ def apply_sequence_parallel(program, axis: str = "sp", degree: int = 0,
     return n
 
 
+@checked_rewrite("expert_parallel")
 def apply_expert_parallel(program, axis: str = "ep", degree: int = 1):
     """Expert parallelism: moe ops route tokens to device-local expert
     shards via two all_to_alls over ``axis``; tokens (the batch) are

@@ -159,6 +159,18 @@ class ServingEngine:
                 raise EngineStopped("engine cannot be restarted")
             if self._started:
                 return self
+            # static verification of the model program this engine is
+            # about to serve (PADDLE_TPU_VERIFY_IR, default off): a
+            # malformed loaded program fails at start(), before any
+            # worker thread exists, with the op/invariant named
+            prog = getattr(self._predictor, "_program", None)
+            if prog is not None:
+                from ..analysis import maybe_verify_program
+
+                fetch = [v.name for v in getattr(
+                    self._predictor, "_fetch_vars", None) or []]
+                maybe_verify_program(prog, where="serving.engine",
+                                     fetch_names=fetch or None)
             if self.config.warmup:
                 self._warming = True
                 try:

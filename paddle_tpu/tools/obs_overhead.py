@@ -122,6 +122,26 @@ def main():
           "(budget %.1fus)" % (dtr_cost, PRIMITIVE_BUDGET_US))
     ok = ok and dtr_cost < PRIMITIVE_BUDGET_US
 
+    # ISSUE 12: the static IR verifier must default OFF, and its
+    # engine-side hook (one env read + a branch, reached only on a
+    # compile-cache MISS) must cost <1us per call — a TIGHTER budget
+    # than the generic primitives: the acceptance criterion is per
+    # program run, and a cache-hit run pays zero (the hook is inside
+    # the miss branch), so <1us on the miss branch bounds every run
+    from paddle_tpu import analysis
+
+    VERIFY_BUDGET_US = 1.0
+    assert not analysis.verify_enabled(), \
+        "IR verification must default off (PADDLE_TPU_VERIFY_IR unset)"
+    ver_cost = _bench_primitive(analysis.verify_enabled)
+    hook_cost = _bench_primitive(
+        lambda: analysis.maybe_verify_program(None, "bench"))
+    print("verifier disabled cost: verify_enabled()=%.3fus "
+          "maybe_verify_program()=%.3fus (budget %.1fus each)"
+          % (ver_cost, hook_cost, VERIFY_BUDGET_US))
+    ok = ok and ver_cost < VERIFY_BUDGET_US \
+        and hook_cost < VERIFY_BUDGET_US
+
     # tiny 2-op program: measure real steps, project the per-step
     # instrumentation cost from the primitive costs above
     import numpy as np

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Repo lint: AST-level invariants CI holds the source tree to.
+
+Rules:
+
+- ``bare-except``     — ``except:`` anywhere, and ``except Exception:``
+                        whose whole body is ``pass``/``...`` (silently
+                        eating everything including KeyboardInterrupt-
+                        adjacent bugs). Narrow the type or handle it.
+- ``metric-name``     — observability call sites (``.inc`` /
+                        ``.observe`` / ``.set_gauge`` / ``.counter`` /
+                        ``.gauge`` / ``.histogram`` with a literal
+                        name) must follow the ``family.metric`` naming
+                        convention (``^[a-z][a-z0-9_]*\\.[a-z][a-z0-9_]*$``)
+                        with lowercase ``label=`` keywords — one
+                        registry, one grammar, greppable dashboards.
+- ``module-mutable``  — module-level mutable state (dict/list/set/
+                        deque/OrderedDict literals or constructors) in
+                        ``serving/`` or ``distributed/`` — the two
+                        packages whose modules are touched from worker
+                        threads / signal handlers — in a module that
+                        defines no module-level ``threading.Lock``.
+                        ALL_CAPS constants are exempt (convention:
+                        written once at import).
+
+Grandfathered violations live in ``tools/lint_allowlist.txt`` (one
+``path::rule::key`` per line); NEW violations exit nonzero. After a
+deliberate cleanup, refresh with ``python tools/lint.py
+--update-allowlist``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import List, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALLOWLIST = os.path.join(ROOT, "tools", "lint_allowlist.txt")
+
+SCAN_DIRS = ("paddle_tpu", "tools", "ci")
+SCAN_FILES = ("bench.py", "__graft_entry__.py")
+LOCKED_DIRS = ("paddle_tpu/serving", "paddle_tpu/distributed")
+
+METRIC_METHODS = {"inc", "observe", "set_gauge", "counter", "gauge",
+                  "histogram"}
+METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
+LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+# receivers that denote the metrics registry at call sites
+METRIC_RECEIVERS = {"obs", "_obs", "_m", "observability", "metrics"}
+
+MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                 "deque", "WeakKeyDictionary", "WeakValueDictionary"}
+LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+Violation = Tuple[str, str, str, int, str]  # path, rule, key, line, msg
+
+
+def _iter_py_files():
+    for d in SCAN_DIRS:
+        base = os.path.join(ROOT, d)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [x for x in dirnames
+                           if x not in ("__pycache__", ".git")]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+    for f in SCAN_FILES:
+        p = os.path.join(ROOT, f)
+        if os.path.exists(p):
+            yield p
+
+
+def _enclosing_name(stack) -> str:
+    names = [n.name for n in stack
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))]
+    return ".".join(names) or "<module>"
+
+
+def _is_pass_body(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant) and (
+                stmt.value.value is Ellipsis
+                or isinstance(stmt.value.value, str)):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def _receiver_name(func) -> str:
+    node = func.value
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str):
+        self.rel = rel
+        self.stack: List[ast.AST] = []
+        self.violations: List[Violation] = []
+        self.in_locked_pkg = any(rel.startswith(d + "/") or
+                                 os.path.dirname(rel) == d
+                                 for d in LOCKED_DIRS)
+        self.module_locks = False
+        self.module_mutables: List[Tuple[str, int]] = []
+
+    def _add(self, rule, key, line, msg):
+        self.violations.append((self.rel, rule, key, line, msg))
+
+    # -- rule 1: bare except ------------------------------------------------
+    def visit_ExceptHandler(self, node):
+        where = _enclosing_name(self.stack)
+        if node.type is None:
+            self._add("bare-except", where, node.lineno,
+                      "bare `except:` in %s — catch a specific type"
+                      % where)
+        elif (isinstance(node.type, ast.Name)
+              and node.type.id in ("Exception", "BaseException")
+              and _is_pass_body(node.body)):
+            self._add("bare-except", where, node.lineno,
+                      "`except %s: pass` in %s swallows every failure "
+                      "silently" % (node.type.id, where))
+        self.generic_visit(node)
+
+    # -- rule 2: metric naming ---------------------------------------------
+    def visit_Call(self, node):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in METRIC_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and _receiver_name(f) in METRIC_RECEIVERS):
+            name = node.args[0].value
+            if not METRIC_NAME_RE.match(name):
+                self._add("metric-name", name, node.lineno,
+                          "metric %r does not follow the "
+                          "`family.metric` naming convention" % name)
+            for kw in node.keywords:
+                if kw.arg and not LABEL_RE.match(kw.arg):
+                    self._add("metric-name",
+                              "%s{%s=}" % (name, kw.arg), node.lineno,
+                              "label %r on metric %r is not lowercase "
+                              "snake_case" % (kw.arg, name))
+        self.generic_visit(node)
+
+    # -- rule 3: module-level mutable state in locked packages --------------
+    def visit_Module(self, node):
+        if self.in_locked_pkg:
+            for stmt in node.body:
+                self._module_stmt(stmt)
+        self.generic_visit(node)
+        if self.in_locked_pkg and not self.module_locks:
+            for name, line in self.module_mutables:
+                self._add("module-mutable", name, line,
+                          "module-level mutable %r in a "
+                          "serving/distributed module that defines no "
+                          "module-level lock — concurrent touches race"
+                          % name)
+
+    def _module_stmt(self, stmt):
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = stmt.value
+        if value is None:
+            return
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names:
+            return
+        if isinstance(value, ast.Call) and isinstance(
+                value.func, (ast.Name, ast.Attribute)):
+            ctor = value.func.id if isinstance(value.func, ast.Name) \
+                else value.func.attr
+            if ctor in LOCK_CTORS:
+                self.module_locks = True
+                return
+            mutable = ctor in MUTABLE_CTORS
+        else:
+            mutable = isinstance(value, (ast.Dict, ast.List, ast.Set))
+        if mutable:
+            for n in names:
+                if not n.isupper() and not n.startswith("__"):
+                    self.module_mutables.append((n, stmt.lineno))
+
+    def generic_visit(self, node):
+        self.stack.append(node)
+        super().generic_visit(node)
+        self.stack.pop()
+
+
+def _lint_file(path: str) -> List[Violation]:
+    rel = os.path.relpath(path, ROOT)
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [(rel, "syntax", "parse", e.lineno or 0,
+                 "file does not parse: %s" % e)]
+    linter = _Linter(path, rel.replace(os.sep, "/"))
+    linter.visit(tree)
+    return linter.violations
+
+
+def _key(v: Violation) -> str:
+    return "%s::%s::%s" % (v[0], v[1], v[2])
+
+
+def _load_allowlist() -> set:
+    if not os.path.exists(ALLOWLIST):
+        return set()
+    out = set()
+    with open(ALLOWLIST, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    update = "--update-allowlist" in argv
+    violations: List[Violation] = []
+    for path in _iter_py_files():
+        violations.extend(_lint_file(path))
+    if update:
+        with open(ALLOWLIST, "w", encoding="utf-8") as f:
+            f.write("# grandfathered lint violations — tools/lint.py\n"
+                    "# (one `path::rule::key` per line; shrink, don't "
+                    "grow)\n")
+            for k in sorted({_key(v) for v in violations}):
+                f.write(k + "\n")
+        print("lint: allowlist refreshed (%d entries)"
+              % len({_key(v) for v in violations}))
+        return 0
+    allow = _load_allowlist()
+    fresh = [v for v in violations if _key(v) not in allow]
+    stale = allow - {_key(v) for v in violations}
+    for v in sorted(fresh):
+        print("%s:%d: [%s] %s" % (v[0], v[3], v[1], v[4]))
+    if stale:
+        print("lint: %d allowlist entries no longer fire — prune them:"
+              % len(stale))
+        for k in sorted(stale):
+            print("  " + k)
+    if fresh:
+        print("lint: %d NEW violation(s) (%d grandfathered). Fix them "
+              "or (deliberately) --update-allowlist."
+              % (len(fresh), len(violations) - len(fresh)),
+              file=sys.stderr)
+        return 1
+    print("lint: clean (%d grandfathered violation(s) allowlisted)"
+          % len(violations))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,6 +102,18 @@ def main():
     for rec in (fast, base):
         assert math.isfinite(rec["loss"]), rec["loss"]
 
+    # ISSUE 12: every executed program's static collective-consistency
+    # verdict must be clean (no conditional collectives, no
+    # double-reduce), and the two runs of the SAME plan class must
+    # carry a schedule digest at all (the cross-process comparison
+    # handle)
+    for tag, rec in (("fast", fast), ("pergrad", base)):
+        sched = rec["collective"].get("schedule") or {}
+        assert sched.get("ok") is True, (
+            "%s run's collective schedule failed static verification: "
+            "%r" % (tag, sched))
+        assert sched.get("digest"), sched
+
     # profile-guided replan cycle (plan -> measure -> replan): the
     # size-planned bucketed run IS the measurement (its profile block
     # carries per-bucket cost + backward timing); feed it back and the
