@@ -166,6 +166,24 @@ python tools/chaos_drill.py --rounds 1
 # ps.replication_bytes{mode=delta} strictly below the full-anchor
 # bytes in the merged job metrics.json
 python tools/chaos_drill.py --rounds 1 --shards 2 --partition
+# 6f: ISSUE-13 acceptance drill (~45s) — LIVE KEY-RANGE MIGRATION
+# under fire: a seeded schedule migrates one shard's var to the
+# sister shard mid-training, the donor primary is SIGKILLed in the
+# worst spot (range installed on the recipient, nothing committed or
+# replicated), and the drill gates on exit 0, params bit-for-bit vs
+# the clean run (zero lost or double-applied rounds), the rollback of
+# attempt 1 + kill -> promotion -> migration-commit causal chain in
+# the merged trace.json, every trainer adopting the bumped shard map,
+# external-witness votes in the election, and clock-jitter chaos
+# armed throughout
+python tools/chaos_drill.py --rounds 1 --shards 2 --migrate
+# 6g: sharded eviction drill (~30s) — per-shard effective fanin
+# disagreeing mid-round (the dying trainer's phase-1 barrier reaches
+# shard 0 only; eviction armed on shard 1 alone): the two-phase
+# barrier + the stale-round guard must reconcile DETERMINISTICALLY
+# (per-shard bit-for-bit oracles, trainers agreeing, stale re-sends
+# dropped not re-applied)
+python tools/chaos_drill.py --rounds 1 --shards 2 --evict
 
 echo "== gate 7: multichip fast-path smoke =="
 # dp=8 CPU host mesh, mlp config, ~2 min: the bucketed/sharded

@@ -52,7 +52,8 @@ from typing import Callable, Dict, List, Optional
 
 __all__ = ["CheckpointCorrupt", "MANIFEST_NAME", "SCOPE_VARS_NAME",
            "atomic_write_bytes", "atomic_checkpoint_dir",
-           "write_manifest", "verify_manifest", "load_scope_snapshot",
+           "write_manifest", "verify_manifest", "manifest_extra",
+           "load_scope_snapshot",
            "CheckpointManager", "save_checkpoint", "load_checkpoint"]
 
 MANIFEST_NAME = "__manifest__.json"
@@ -155,6 +156,25 @@ def write_manifest(dirname: str, extra: Optional[Dict] = None,
     atomic_write_bytes(os.path.join(dirname, MANIFEST_NAME),
                        json.dumps(doc, indent=1, sort_keys=True).encode())
     return doc
+
+
+def manifest_extra(dirname: str) -> Dict:
+    """The caller-supplied ``extra`` a save recorded in ``dirname``'s
+    manifest — everything outside the reserved ``version``/``files``
+    keys ({} when there is none, or the manifest is unreadable: the
+    extra is advisory metadata, e.g. the PS shard map a trainer
+    checkpoints so its relaunched incarnation resumes ROUTING from
+    the checkpoint instead of rediscovering migrations through
+    wrong_shard redirects; never load-bearing for the payload, which
+    stays manifest-verified)."""
+    try:
+        with open(os.path.join(dirname, MANIFEST_NAME), "r",
+                  encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {k: v for k, v in doc.items()
+            if k not in ("version", "files")}
 
 
 def verify_manifest(dirname: str, required: bool = True) -> Optional[Dict]:

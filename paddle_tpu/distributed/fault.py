@@ -25,6 +25,7 @@ Examples::
     PADDLE_TPU_FAULTS="send.drop:0.05,send.dup:0.05"
     PADDLE_TPU_FAULTS="any.delay:0.2:50,recv.close:0.01"
     PADDLE_TPU_FAULTS="partition:1:127.0.0.1:7001|127.0.0.1:7002"
+    PADDLE_TPU_FAULTS="clock_jitter:0.5:600"
     PADDLE_TPU_FAULT_SEED=42
 
 Kinds per side — ``send``: drop (frame never transmitted), delay
@@ -49,12 +50,27 @@ promotion logic treats as positive evidence of process death).
 ``prob`` is per frame; 1.0 is a hard partition, below it a flaky
 link.
 
+``clock_jitter`` (ISSUE 13) perturbs the PROCESS CLOCK as the lease /
+election machinery sees it, not any frame: ``clock_jitter:prob:ms``
+gives this process a constant SKEW drawn once (seeded by
+``PADDLE_TPU_FAULT_SEED`` x the process fault identity, so every
+process in a drill skews differently but reproducibly) in
+``[-ms, +ms]``, plus per-event JITTER in the same range with
+probability ``prob`` each time a timer is read. ``ps_rpc`` applies the
+offset wherever a lease deadline is set or an election timer fires —
+the drillable claim is that promotion stays quorum-gated (no
+split-brain) even when every participant's clock wanders by up to
+±2 lease periods. The skew draw is recorded once in the flight ring
+(``fault.clock_skew``); each fired jitter increments
+``fault.injected{side=any,kind=clock_jitter}``.
+
 Every injected fault increments ``fault.injected{side=,kind=}`` in the
 observability registry (recorded unconditionally, like ``serving.*`` —
 fault events are rare and CI asserts on them).
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import random
 import socket
@@ -64,10 +80,12 @@ from typing import List, Optional
 
 __all__ = ["FaultRule", "FaultInjector", "FaultInjected",
            "get_injector", "reset_injector", "parse_plan",
-           "random_plan", "set_identity", "get_identity"]
+           "random_plan", "set_identity", "get_identity",
+           "clock_skew"]
 
 _SIDES = ("send", "recv", "any")
-_KINDS = ("drop", "delay", "dup", "truncate", "close", "partition")
+_KINDS = ("drop", "delay", "dup", "truncate", "close", "partition",
+          "clock_jitter")
 _RECV_KINDS = ("drop", "delay", "close", "partition")
 
 
@@ -101,6 +119,12 @@ class FaultRule:
                     "partition rules need a peer endpoint (or an A|B "
                     "pair) as their param")
             param = str(param).strip()
+        if kind == "clock_jitter":
+            if param is None or float(param) <= 0:
+                raise ValueError(
+                    "clock_jitter rules need a positive magnitude in "
+                    "milliseconds as their param")
+            param = float(param)
         self.side = side
         self.kind = kind
         self.prob = prob
@@ -145,10 +169,10 @@ def parse_plan(plan: str) -> List[FaultRule]:
         try:
             head, _, rest = spec.partition(":")
             side, dot, kind = head.partition(".")
-            if not dot and side == "partition":
-                # bare "partition:prob:peer" — side is meaningless for
-                # a pair severing, default it
-                side, kind = "any", "partition"
+            if not dot and side in ("partition", "clock_jitter"):
+                # bare "partition:prob:peer" / "clock_jitter:prob:ms" —
+                # side is meaningless for a non-frame fault, default it
+                side, kind = "any", side
             if kind == "partition":
                 # the param is an endpoint (pair) and endpoints contain
                 # colons: only the FIRST colon after prob splits
@@ -184,8 +208,18 @@ _RANDOM_MENU = (
 )
 
 
+def clock_skew() -> float:
+    """The process-wide clock offset (seconds) the lease/election
+    timers should apply right now; 0.0 when no injector or no
+    ``clock_jitter`` rule is armed. The ONE hook ``ps_rpc`` calls."""
+    inj = get_injector()
+    if inj is None or not inj.clock_rules:
+        return 0.0
+    return inj.clock_skew_s()
+
+
 def random_plan(rng: random.Random, max_rules: int = 3,
-                partition_peers=None) -> str:
+                partition_peers=None, clock_jitter_ms=None) -> str:
     """Draw a randomized-but-reproducible ``PADDLE_TPU_FAULTS`` plan
     from the recoverable-fault menu: the same ``rng`` state yields the
     same plan, so a chaos drill's schedule replays from its seed. The
@@ -198,7 +232,13 @@ def random_plan(rng: random.Random, max_rules: int = 3,
     the partitioned backup must fail its elections, never split the
     brain). Callers that cannot tolerate a severed pair simply don't
     pass peers; the rng consumption without them is unchanged, so
-    legacy schedules replay identically."""
+    legacy schedules replay identically.
+
+    ``clock_jitter_ms`` (optional) appends a ``clock_jitter:0.5:<ms>``
+    rule AFTER the legacy and partition draws (no extra rng
+    consumption — the magnitude is the caller's, typically a fraction
+    of the lease in drills and ±2x lease in the directed split-brain
+    tests)."""
     n = rng.randint(1, max(1, int(max_rules)))
     picks = rng.sample(range(len(_RANDOM_MENU)), min(n, len(_RANDOM_MENU)))
     specs = []
@@ -213,6 +253,8 @@ def random_plan(rng: random.Random, max_rules: int = 3,
     if partition_peers:
         pair = partition_peers[rng.randrange(len(partition_peers))]
         specs.append("partition:1:%s" % pair)
+    if clock_jitter_ms:
+        specs.append("clock_jitter:0.5:%g" % float(clock_jitter_ms))
     plan = ",".join(specs)
     parse_plan(plan)  # self-check: a generated plan must always parse
     return plan
@@ -268,9 +310,18 @@ class FaultInjector:
     thread."""
 
     def __init__(self, rules: List[FaultRule], seed: int = 0):
-        self.rules = [r for r in rules if r.kind != "partition"]
+        self.rules = [r for r in rules
+                      if r.kind not in ("partition", "clock_jitter")]
         self.partitions = [r for r in rules if r.kind == "partition"]
+        self.clock_rules = [r for r in rules
+                            if r.kind == "clock_jitter"]
+        self._seed = int(seed)
         self._rng = random.Random(seed)
+        # per-process constant clock skew: drawn lazily (the fault
+        # identity may be registered after the injector is built) from
+        # seed x identity, so every process in a drill wanders
+        # differently but a rerun of the same schedule replays exactly
+        self._clock_skew_s: Optional[float] = None
         self._lock = threading.Lock()
 
     @classmethod
@@ -327,6 +378,38 @@ class FaultInjector:
                 _count(side, "partition", peer=peer)
                 return True
         return False
+
+    # -- clock hook (called by the ps_rpc lease/election machinery) -------
+
+    def clock_skew_s(self) -> float:
+        """The offset (seconds) this process's lease/election timers
+        are wrong by RIGHT NOW: the per-process constant skew plus,
+        with per-rule probability, a fresh jitter draw. 0.0 when no
+        ``clock_jitter`` rule is configured."""
+        if not self.clock_rules:
+            return 0.0
+        with self._lock:
+            if self._clock_skew_s is None:
+                ident = get_identity() or ""
+                h = int.from_bytes(
+                    hashlib.blake2b(
+                        ("%d|%s" % (self._seed, ident)).encode(),
+                        digest_size=8).digest(), "big")
+                srng = random.Random(h)
+                skew = 0.0
+                for r in self.clock_rules:
+                    skew += srng.uniform(-r.param, r.param) / 1e3
+                self._clock_skew_s = skew
+                from ..observability import flight as _flight
+
+                _flight.record("fault.clock_skew", identity=ident,
+                               skew_ms=round(skew * 1e3, 1))
+            off = self._clock_skew_s
+            for r in self.clock_rules:
+                if self._rng.random() < r.prob:
+                    off += self._rng.uniform(-r.param, r.param) / 1e3
+                    _count("any", "clock_jitter")
+        return off
 
     # -- frame hooks (called by ps_rpc) -----------------------------------
 

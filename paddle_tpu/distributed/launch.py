@@ -118,6 +118,13 @@ def _parse_args(argv=None):
                         "contiguous primary+backup groups (key-range "
                         "sharded PS; endpoint count must divide "
                         "evenly)")
+    p.add_argument("--ps_witness_endpoints", default="",
+                   help="comma-separated external quorum-witness "
+                        "endpoints (ISSUE 13): one witness process "
+                        "per endpoint is spawned from --server_script "
+                        "with PADDLE_ROLE=witness, and every pserver "
+                        "gets PADDLE_PS_WITNESSES so its elections "
+                        "require a live witness grant")
     p.add_argument("--serving_script", default=None,
                    help="script run once per serving replica as a "
                         "supervised stateless serving process")
@@ -220,7 +227,8 @@ class _Worker:
             # append across restarts: one workerlog per rank tells the
             # whole story, crash included
             name = {"pserver": "serverlog.%d",
-                    "serving": "servinglog.%d"}.get(
+                    "serving": "servinglog.%d",
+                    "witness": "witnesslog.%d"}.get(
                         self.role, "workerlog.%d") % self.local_rank
             self._fp = open(os.path.join(self.log_dir, name), "a")
             stdout = stderr = self._fp
@@ -305,6 +313,12 @@ def launch(args=None):
                    if e.strip()]
     if pserver_eps and not args.server_script:
         raise SystemExit("--pserver_endpoints requires --server_script")
+    witness_eps = [e.strip() for e in
+                   (getattr(args, "ps_witness_endpoints", "") or "")
+                   .split(",") if e.strip()]
+    if witness_eps and not args.server_script:
+        raise SystemExit("--ps_witness_endpoints requires "
+                         "--server_script")
     n_serving = max(0, int(getattr(args, "serving_replicas", 0) or 0))
     serving_eps = [e.strip() for e in
                    (getattr(args, "serving_endpoints", "") or "")
@@ -365,6 +379,10 @@ def launch(args=None):
                 "PADDLE_ROLE": "pserver",
                 # each server sees only ITS group: the ISSUE-4/8
                 # replication/lease/rejoin machinery runs per shard
+                # (witnesses are shared across shards — per-shard
+                # state lives in the witness, keyed by the renewal's
+                # shard label)
+                "PADDLE_PS_WITNESSES": ",".join(witness_eps),
                 "PADDLE_PSERVER_ENDPOINTS": ",".join(group),
                 "PADDLE_PSERVER_SHARDS": str(nshards),
                 "PADDLE_PSERVER_SHARD": str(shard),
@@ -381,6 +399,30 @@ def launch(args=None):
                 [sys.executable, "-u", args.server_script], env,
                 args.log_dir, role="pserver",
                 metrics_dir=metrics_dir))
+
+    for i, ep in enumerate(witness_eps):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        env.update({
+            "PADDLE_ROLE": "witness",
+            "PSERVER_ENDPOINT": ep,
+            "PADDLE_PS_WITNESSES": ",".join(witness_eps),
+            # dump identity: process_identity's fallback rank (two
+            # witnesses must not clobber each other's telemetry)
+            "PADDLE_TRAINER_ID": str(i),
+        })
+        # witnesses hold no parameter state: supervised like servers
+        # (bounded relaunch, torn down after the trainers), no rejoin
+        # protocol needed
+        # local_rank offsets past the pserver slots (distinct log
+        # files); the DUMP rank is the witness index (global_rank —
+        # process_identity falls back to PADDLE_TRAINER_ID-less 0-base)
+        servers.append(_Worker(
+            len(pserver_eps) + i,
+            [sys.executable, "-u", args.server_script], env,
+            args.log_dir, role="witness", metrics_dir=metrics_dir,
+            global_rank=i))
 
     for i, ep in enumerate(serving_eps):
         env = dict(os.environ)

@@ -134,6 +134,52 @@ multiple primary+backup groups lives in ``distributed/ps_shard.py``
 barrier); each ``PSServer`` group is oblivious — it sees only its own
 endpoint chain.
 
+Elastic PS (ISSUE 13 — live migration, chunk digests, witnesses):
+
+- **live key-range migration**: ``migrate_begin`` records an intent
+  on the donor group's primary; the transfer executes INSIDE the next
+  round apply, while every trainer is barrier-blocked — install the
+  frozen range (+ the folded-seq watermark) on the recipient's
+  primary (staged, not servable), soft-commit (shard-map version
+  bump; the var stays in the donor's stream), replicate the round
+  WITH the migration state to the donor's backups, then drive the
+  recipient's commit (staged -> scope + block_factory-rebuilt
+  optimize block + immediate push to the recipient's own backups)
+  and hard-commit (drop the var from the donor's stream). Trainers
+  adopt the bumped map atomically at the barrier ack or lazily via
+  ``wrong_shard`` redirects whose tokens are un-recorded
+  (exactly-once across the version bump; replays of pre-migration
+  rpcs answer ``replayed`` at the recipient via the shipped
+  watermark). Every kill window rolls back or completes through the
+  epoch fence: an intent/override that reached the donor's stream is
+  finished by the promoted backup; one that did not leaves the map
+  unbumped everywhere a trainer can see (the recipient's staged
+  orphan is superseded by any retry). Drilled by ``chaos_drill
+  --migrate`` (donor primary SIGKILLed between install and commit).
+- **chunk-level + incremental digests**: see the helpers around
+  ``_chunk_digests`` — ``PADDLE_PS_DIGEST_CHUNK_MB`` (default 1),
+  ``PADDLE_PS_INCR_DIGEST`` (default on). Counters ``ps.digest_ms``,
+  ``ps.digest_vars{mode=hashed|rows|skipped}``;
+  ``tools/ps_scale_bench.py`` records the cost/savings curves.
+- **external quorum witnesses**: ``PADDLE_PS_WITNESSES`` names
+  ``PSWitness`` endpoints outside every group; renewals include them,
+  and an election needs a live witness GRANT on top of its GROUP-only
+  quorum (witnesses gate, never provide margin — closing the
+  forged-tombstone corner without letting candidate+witness depose a
+  busy live primary). Voters keep Raft votedFor semantics (same
+  candidate re-collects a lost grant) and a reachable active
+  primary's denial vetoes the election.
+  ``ps.witness_votes{shard=}``.
+- **stale-round guard**: workers stamp the TRAINING round (``tr``) on
+  send_grad/send_barrier; a round this server already applied
+  (eviction shrank the fanin past a dead trainer) answers
+  ``stale_round`` instead of contaminating the next round —
+  ``ps.stale_rounds``, drilled by ``chaos_drill --evict``.
+- **clock-jitter chaos**: every lease deadline and election timer is
+  read through ``fault.clock_skew()`` (the ``clock_jitter:prob:ms``
+  rule), so drills prove promotion stays quorum-gated under skewed
+  clocks.
+
 Distributed observability (ISSUE 5 — Dapper-style context riding the
 existing frame):
 
@@ -157,9 +203,11 @@ existing frame):
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import random
+import signal
 import socket
 import struct
 import sys
@@ -265,9 +313,65 @@ def _var_digest(arr: np.ndarray) -> str:
     Hashing GB-scale state every round is the price of shipping only
     what changed — blake2b streams at memory bandwidth, orders of
     magnitude under the network cost of the full blob it avoids."""
-    import hashlib
-
     return hashlib.blake2b(arr.tobytes(), digest_size=16).hexdigest()
+
+
+# -- chunk-level digests (ISSUE 13: elasticity affordable at GB scale) ------
+#
+# A whole-var digest makes a GB embedding touched on ONE row ship the
+# whole var whenever the touched-row set is unknown (promotion cleared
+# it, a dense block updated it). Chunk digests bound that cost: every
+# dense var is hashed as fixed-size chunks of its FLAT element stream
+# (PADDLE_PS_DIGEST_CHUNK_MB, default 1 MiB; a var smaller than one
+# chunk degenerates to the whole-var digest), a delta round ships only
+# the chunks whose digest moved, and — with PADDLE_PS_INCR_DIGEST=1,
+# the default — only the rows/chunks DIRTIED since the last ship are
+# re-hashed at all. The soundness contract for the skip is family
+# locality: the optimize block for ``w@GRAD`` touches only ``w`` and
+# its ``@``-suffixed companions (true for every transpiled sgd/
+# momentum/adam block and the pslib row-local sparse blocks); every
+# ANCHOR re-hashes everything from scratch, so a contract violation is
+# bounded to at most anchor_every rounds and caught by the bit-for-bit
+# drills. PADDLE_PS_INCR_DIGEST=0 restores hash-everything-every-round.
+
+
+def _digest_chunk_bytes() -> int:
+    return max(1, int(float(os.environ.get(
+        "PADDLE_PS_DIGEST_CHUNK_MB", "1")) * (1 << 20)))
+
+
+def _incr_digest_enabled() -> bool:
+    return os.environ.get("PADDLE_PS_INCR_DIGEST", "1") != "0"
+
+
+def _chunk_elems_for(arr: np.ndarray) -> int:
+    itemsize = max(1, int(arr.dtype.itemsize))
+    return max(1, _digest_chunk_bytes() // itemsize)
+
+
+def _chunk_hash(flat: np.ndarray, ci: int, ce: int) -> str:
+    return hashlib.blake2b(flat[ci * ce:(ci + 1) * ce].tobytes(),
+                           digest_size=16).hexdigest()
+
+
+def _chunk_digests(flat: np.ndarray, ce: int) -> List[str]:
+    n = max(1, -(-int(flat.size) // ce))  # >= 1 chunk even for empty
+    return [_chunk_hash(flat, i, ce) for i in range(n)]
+
+
+def _chunks_for_rows(rows, arr: np.ndarray, ce: int) -> set:
+    """Chunk indices of the FLAT stream touched by the given row ids —
+    a row whose byte range straddles a chunk boundary dirties BOTH
+    chunks (the straddle edge case the tests pin)."""
+    rowsize = int(np.prod(arr.shape[1:])) if arr.ndim > 1 else 1
+    nchunks = max(1, -(-int(arr.size) // ce))
+    out = set()
+    for r in rows:
+        lo = int(r) * rowsize
+        hi = lo + rowsize - 1
+        for ci in range(lo // ce, min(hi // ce, nchunks - 1) + 1):
+            out.add(ci)
+    return out
 
 
 def _bare_rpc(endpoint: str, msg: dict, timeout: float = 1.0) -> dict:
@@ -416,7 +520,10 @@ class PSServer:
                  endpoints: Optional[List[str]] = None,
                  rejoin: Optional[bool] = None,
                  anchor_every: Optional[int] = None,
-                 lease_ms: Optional[float] = None):
+                 lease_ms: Optional[float] = None,
+                 shard: Optional[int] = None,
+                 witnesses: Optional[List[str]] = None,
+                 block_factory=None):
         host, port = endpoint.rsplit(":", 1)
         # endpoint-pair partition rules address server processes by
         # their advertised endpoint; first server in wins (one server
@@ -463,16 +570,24 @@ class PSServer:
             os.environ.get("PADDLE_PS_REPL_DEADLINE", "10"))
         self._repl_connect = float(
             os.environ.get("PADDLE_PS_REPL_CONNECT_TIMEOUT", "3"))
-        # -- delta replication (ISSUE 8) ----------------------------------
-        # per-var content digest of the state last shipped to the
-        # stream; empty => next ship is a full anchor (fresh primary,
-        # fresh promotion)
-        self._shipped_digests: Dict[str, str] = {}
+        # -- delta replication (ISSUE 8 / 13) -----------------------------
+        # per-var digest STATE of what was last shipped to the stream
+        # ({"chunks": [...], "chunk_elems":, "nelems":, "dtype":} —
+        # chunk-level, ISSUE 13); empty => next ship is a full anchor
+        # (fresh primary, fresh promotion)
+        self._shipped_digests: Dict[str, dict] = {}
         # param var -> set of rows touched by push_sparse since the
         # last ship: lets a delta round ship row SLICES of a sparse
         # table (sound because pslib sparse optimize blocks are
-        # row-local); any dense round wipes it — full-var diff wins
+        # row-local); a dense round touching the var's FAMILY
+        # escalates it to _dirty_dense — full-var diff wins there
         self._dirty_rows: Dict[str, set] = {}
+        # vars whose family a dense round touched since the last ship:
+        # re-hashed fully at the next plan. Vars in NEITHER dirty set
+        # skip hashing entirely under PADDLE_PS_INCR_DIGEST=1 (their
+        # shipped digests carry over — the incremental-digest win)
+        self._dirty_dense: set = set()
+        self._incr_digest = _incr_digest_enabled()
         if anchor_every is None:
             anchor_every = int(os.environ.get("PADDLE_PS_ANCHOR_EVERY",
                                               "8"))
@@ -484,7 +599,48 @@ class PSServer:
         # clients may prune their replay logs up to
         self._durable_round = 0
         # -- lease + quorum promotion (ISSUE 8) ---------------------------
-        self._shard = os.environ.get("PADDLE_PSERVER_SHARD", "0")
+        if shard is None:
+            shard = int(os.environ.get("PADDLE_PSERVER_SHARD", "0"))
+        self._shard = str(int(shard))
+        self._shard_index = int(shard)
+        # -- external quorum witnesses (ISSUE 13) -------------------------
+        # extra vote/renewal endpoints OUTSIDE the replication group.
+        # Witnesses are a pure SAFETY gate: they never join the quorum
+        # arithmetic (a candidate + a witness must not be able to
+        # out-vote a busy-but-alive primary whose handlers are briefly
+        # starved — quorum stays group-only), but with witnesses
+        # configured an election ADDITIONALLY needs at least one live
+        # witness GRANT (positive evidence the primary stopped
+        # renewing), closing the corner where N-1 forged
+        # connection-REFUSALs alone could elect a backup under a live
+        # primary. A REFUSED witness is itself a tombstone (a dead
+        # witness must not freeze promotion forever); a TIMED-OUT one
+        # keeps the requirement (a partition must not relax it).
+        if witnesses is None:
+            witnesses = [e.strip() for e in os.environ.get(
+                "PADDLE_PS_WITNESSES", "").split(",") if e.strip()]
+        self._witnesses = list(witnesses or [])
+        # -- live shard migration (ISSUE 13) ------------------------------
+        # shard-map overrides this group knows about: var base name ->
+        # {"shard": owner index, "version": map version, "committed":
+        # bool, "to_endpoints": donor-side recipient chain}; version 0
+        # = the pure hash map. Replicated to backups with every round.
+        self._shard_map_version = 0
+        self._map_overrides: Dict[str, dict] = {}
+        # donor side: the migration requested but not yet executed
+        # (runs at the next round apply, inside the barrier)
+        self._pending_migration: Optional[dict] = None
+        # recipient side: installed-but-uncommitted var blobs
+        self._staged_in: Dict[str, dict] = {}
+        # vars hard-committed AWAY from this group: masked from
+        # replication/anchors (the scope copy may linger — routing
+        # answers wrong_shard before scope is ever consulted)
+        self._dropped: set = set()
+        self._mig_clients: Dict[str, "PSClient"] = {}
+        # grad name -> optimize block builder for vars migrating IN
+        # (a migration ships state, never code; the factory rebuilds
+        # the block from the shared program definition)
+        self._block_factory = block_factory
         if lease_ms is None:
             lease_ms = float(os.environ.get("PADDLE_PS_LEASE_MS",
                                             "1500"))
@@ -492,9 +648,12 @@ class PSServer:
         self._epoch = 0           # the epoch this server serves at
         self._seen_epoch = 0      # highest epoch heard from any primary
         self._promised_epoch = 0  # highest epoch this voter granted
+        self._promised_to = None  # who holds that promise (votedFor)
         # boot grace: a backup must never elect before the primary had
-        # one full lease to introduce itself
-        self._lease_deadline = time.monotonic() + self._lease_s
+        # one full lease to introduce itself (clock-jitter chaos skews
+        # this view too, like every other lease read)
+        self._lease_deadline = (time.monotonic() + self._lease_s
+                                + _fault.clock_skew())
         self._lease_expired_counted = False
         self._last_majority_ack = time.monotonic()
         self._election_lock = threading.Lock()
@@ -558,6 +717,23 @@ class PSServer:
     def _effective_fanin(self) -> int:
         return max(1, self._fanin - len(self._evicted))
 
+    def _stale_train_round_locked(self, msg: dict) -> bool:
+        """True when the rpc names a TRAINING round (``tr``, stamped
+        by workers that track one) this server already applied — the
+        re-send of a relaunched trainer re-running a round that
+        sailed without it (eviction shrank the fanin, or its dead
+        incarnation's barrier already closed it). Distinct from the
+        ``(cid, round, seq)`` dedup token, which a fresh incarnation
+        cannot reproduce."""
+        tr = msg.get("tr")
+        stale = tr is not None and int(tr) <= self._applied_round
+        if stale:
+            _counter("ps.stale_rounds").inc()
+            _flight.record("ps.stale_round", kind=msg.get("kind"),
+                           tr=int(tr), applied=self._applied_round,
+                           trainer=msg.get("trainer_id"))
+        return stale
+
     def _apply_round(self):
         """All trainers' grads in (locked by caller): sum per var, run
         its optimize block, replicate the applied round to every live
@@ -571,10 +747,13 @@ class PSServer:
         _flight.record("ps.round_apply", round=nxt,
                        vars=len(self._pending))
         with _dtrace.child_span("ps.apply_round", cat="ps", round=nxt):
-            # a dense round may touch any row of any var through its
-            # optimize blocks: row-slice tracking is only sound between
-            # dense rounds, so the per-var digest diff takes over
-            self._dirty_rows.clear()
+            # a dense round touches, by the family-locality contract,
+            # its grad's base var and every @-companion of it: mark
+            # those FAMILIES dense-dirty (full re-hash + full-var /
+            # chunk diff at the next ship) and escalate any row-slice
+            # tracking they had — row tracking is only sound between
+            # dense touches of that family
+            self._mark_families_dirty_locked(list(self._pending))
             for name in sorted(self._pending):
                 by_tid = self._pending[name]
                 tids = sorted(by_tid)
@@ -593,11 +772,35 @@ class PSServer:
             # cannot have sent next-round traffic — their barriers
             # haven't returned yet)
             self._applied_watermark = self._watermark_locked()
+            # live migration rides the same barrier: the range is
+            # frozen HERE (no trainer can observe the round until the
+            # install + the replication below both finished)
+            self._step_migration_locked()
             self._replicate_locked()
+            self._commit_migrations_locked()
         _flight.record("ps.round_applied", round=self._applied_round)
         self._round_complete = True
         self._fetches_pending = True
         self._cond.notify_all()
+
+    def _family_index(self):
+        """base name -> [scope vars in that family], one O(V) pass —
+        the apply marks G families against it instead of scanning the
+        scope per grad (O(V+G), not O(V*G), under the server lock)."""
+        fams: Dict[str, list] = {}
+        for vn in list(self._scope.local_var_names()):
+            fams.setdefault(vn.split("@", 1)[0], []).append(vn)
+        return fams
+
+    def _mark_families_dirty_locked(self, names) -> None:
+        """A dense update touched these grads' families: each base var
+        and every ``@``-companion must be re-hashed at the next ship
+        (and any row-slice tracking for them is no longer sound)."""
+        fams = self._family_index()
+        for name in names:
+            for vn in fams.get(name.split("@", 1)[0], ()):
+                self._dirty_dense.add(vn)
+                self._dirty_rows.pop(vn, None)
 
     # -- replication (primary -> backups) ---------------------------------
 
@@ -617,9 +820,13 @@ class PSServer:
         return c
 
     def _scope_arrays(self) -> List[tuple]:
-        """[(name, contiguous array)] for every tensor var in scope."""
+        """[(name, contiguous array)] for every tensor var in scope —
+        minus vars hard-committed away by a migration (their scope
+        copy may linger; the stream must stop carrying them)."""
         out = []
         for name in list(self._scope.local_var_names()):
+            if name in self._dropped:
+                continue
             val = self._executor._read_var(self._scope, name)
             if val is None or not hasattr(val, "shape"):
                 continue
@@ -628,15 +835,17 @@ class PSServer:
 
     @staticmethod
     def _blobs_for(items) -> tuple:
-        """(headers, raw) for [(name, array, rows-or-None)] — a header
-        with ``rows`` is a row SLICE of the named table (local row
-        ids), without it the array replaces the whole var."""
+        """(headers, raw) for [(name, array, extra-or-None)] — an
+        ``extra`` of ``{"rows": [...]}`` is a row SLICE of the named
+        table (local row ids), ``{"chunk": [start, stop]}`` a FLAT
+        element range of it (chunk-digest delta); without either the
+        array replaces the whole var."""
         headers, chunks = [], []
-        for name, arr, rows in items:
+        for name, arr, extra in items:
             h = _array_header(arr)
             h["name"] = name
-            if rows is not None:
-                h["rows"] = rows
+            if extra:
+                h.update(extra)
             headers.append(h)
             chunks.append(arr.tobytes())
         return headers, b"".join(chunks)
@@ -661,29 +870,89 @@ class PSServer:
     def _replication_plan(self, arrays) -> tuple:
         """(mode, items, digests) for the round about to ship: a FULL
         anchor when nothing was ever shipped or the anchor interval
-        divides the round; otherwise a DELTA of only the vars whose
-        content digest moved — as row slices where push_sparse
-        recorded which rows changed and the slice is actually smaller
-        than the table."""
-        digests = {n: _var_digest(a) for n, a in arrays}
-        anchor = (not self._shipped_digests
+        divides the round (every var fully re-hashed — the digest
+        state RESETS at anchors, bounding any incremental-skip drift);
+        otherwise a DELTA of only the vars whose chunk digests moved —
+        as row slices where push_sparse recorded which rows changed
+        and the slice beats the var, as flat CHUNK slices where only
+        some chunks of a big dense var moved, else whole vars. Under
+        ``PADDLE_PS_INCR_DIGEST=1`` vars in neither dirty set skip
+        hashing entirely and row-dirty tables re-hash only the touched
+        chunks (``ps.digest_vars{mode=}`` counts both paths;
+        ``ps.digest_ms`` accumulates the hashing bill)."""
+        t0 = time.perf_counter()
+        prev = self._shipped_digests
+        anchor = (not prev
                   or (self._anchor_every > 0 and self._applied_round
                       % self._anchor_every == 0))
-        if anchor:
-            return "full", [(n, a, None) for n, a in arrays], digests
+        incr = self._incr_digest and not anchor
+        digests: Dict[str, dict] = {}
         items = []
         for n, a in arrays:
-            if digests[n] == self._shipped_digests.get(n):
+            flat = a.reshape(-1)
+            ps = prev.get(n)
+            ce = _chunk_elems_for(a)
+            compat = (ps is not None
+                      and ps.get("chunk_elems") == ce
+                      and ps.get("nelems") == int(flat.size)
+                      and ps.get("dtype") == str(a.dtype))
+            touched = n in self._dirty_dense or n in self._dirty_rows
+            if incr and compat and not touched:
+                # untouched since the last ship: the shipped digests
+                # carry over UNHASHED — the incremental-digest win
+                digests[n] = ps
+                _counter("ps.digest_vars", mode="skipped").inc()
                 continue
             rows = self._dirty_rows.get(n)
+            if (incr and compat and rows is not None
+                    and n not in self._dirty_dense):
+                # row-dirty only: re-hash just the chunks those rows
+                # touch, carry the rest over
+                chunks = list(ps["chunks"])
+                for ci in sorted(_chunks_for_rows(rows, a, ce)):
+                    chunks[ci] = _chunk_hash(flat, ci, ce)
+                state = dict(ps)
+                state["chunks"] = chunks
+                _counter("ps.digest_vars", mode="rows").inc()
+            else:
+                state = {"chunks": _chunk_digests(flat, ce),
+                         "chunk_elems": ce, "nelems": int(flat.size),
+                         "dtype": str(a.dtype)}
+                _counter("ps.digest_vars", mode="hashed").inc()
+            digests[n] = state
+            if anchor:
+                continue  # the anchor ships every var below anyway
+            if compat and ps["chunks"] == state["chunks"]:
+                continue  # digest says unchanged
             if (rows and getattr(a, "ndim", 0) >= 1
                     and len(rows) < int(a.shape[0])):
                 rs = np.asarray(sorted(rows), dtype=np.int64)
                 items.append((n, np.ascontiguousarray(a[rs]),
-                              rs.tolist()))
+                              {"rows": rs.tolist()}))
+            elif compat and len(state["chunks"]) > 1:
+                changed = [i for i, (x, y) in
+                           enumerate(zip(ps["chunks"],
+                                         state["chunks"])) if x != y]
+                if not changed:
+                    continue
+                # contiguous runs of changed chunks -> flat slices
+                runs = [[changed[0], changed[0]]]
+                for ci in changed[1:]:
+                    if ci == runs[-1][1] + 1:
+                        runs[-1][1] = ci
+                    else:
+                        runs.append([ci, ci])
+                for lo, hi in runs:
+                    s, e = lo * ce, min((hi + 1) * ce, int(flat.size))
+                    items.append((n, np.ascontiguousarray(flat[s:e]),
+                                  {"chunk": [s, e]}))
             else:
                 items.append((n, a, None))
-        return "delta", items, digests
+        if anchor:
+            items = [(n, a, None) for n, a in arrays]
+        _counter("ps.digest_ms").inc(
+            (time.perf_counter() - t0) * 1e3)
+        return ("full" if anchor else "delta"), items, digests
 
     def _replicate_locked(self) -> None:
         """Stream the just-applied round to every live backup and wait
@@ -700,9 +969,10 @@ class PSServer:
             return
         targets = self._repl_targets()
         if not targets:
-            # no stream to diff against: keep row tracking bounded and
-            # digests empty so a first backup gets a clean anchor
+            # no stream to diff against: keep dirty tracking bounded
+            # and digests empty so a first backup gets a clean anchor
             self._dirty_rows.clear()
+            self._dirty_dense.clear()
             return
         arrays = self._scope_arrays()
         mode, items, digests = self._replication_plan(arrays)
@@ -710,13 +980,14 @@ class PSServer:
         full_cache = (headers, raw) if mode == "full" else None
         wm = self._applied_watermark
         base = self._applied_round - 1
+        extra = self._repl_extra_locked()
         acked = 0
         for ep in targets:
             _gauge("ps.replication_lag_rounds", backup=ep).set(1)
             try:
                 resp = self._repl_client(ep).replicate(
                     self._applied_round, headers, raw, wm, mode=mode,
-                    base_round=base, epoch=self._epoch)
+                    base_round=base, epoch=self._epoch, extra=extra)
                 if resp.get("fenced"):
                     self._demote_locked(int(resp.get("epoch", 0)),
                                         "fenced by %s during "
@@ -730,7 +1001,7 @@ class PSServer:
                     self._repl_client(ep).replicate(
                         self._applied_round, fh, fraw, wm,
                         mode="full", base_round=base,
-                        epoch=self._epoch)
+                        epoch=self._epoch, extra=extra)
                     _counter("ps.replication_bytes",
                              mode="full").inc(len(fraw))
                     _flight.record("ps.reanchor", backup=ep,
@@ -758,6 +1029,276 @@ class PSServer:
             self._durable_round = self._applied_round
         self._shipped_digests = digests
         self._dirty_rows.clear()
+        self._dirty_dense.clear()
+
+    # -- live shard migration (ISSUE 13) ----------------------------------
+    #
+    # A key range (a dense var; its @-companions follow) moves from
+    # this group (the DONOR) to another (the RECIPIENT) under the
+    # two-phase round barrier, with zero lost or double-applied
+    # rounds. The whole protocol runs inside ONE round apply, while
+    # every trainer is still blocked in its round-N barrier rpc:
+    #
+    #   1. INSTALL — the donor freezes the var at the just-applied
+    #      round and ships it (with its dedup watermark) to the
+    #      recipient's active primary, which STAGES it (not servable).
+    #   2. SOFT COMMIT — the donor bumps its shard-map version and
+    #      records the override {var -> recipient shard}; the var
+    #      STAYS in the donor's scope and replication stream until the
+    #      recipient durably owns it.
+    #   3. REPLICATE — the round ships to the donor's backups WITH the
+    #      override (committed=False) + any pending intent, so a
+    #      promoted donor backup either never heard of the migration
+    #      (-> clean ROLLBACK: the map never bumped anywhere a trainer
+    #      can see) or inherits the obligation to finish it.
+    #   4. COMMIT — the recipient moves the staged var into its scope,
+    #      rebuilds its optimize block via the block_factory, ships it
+    #      to ITS backups, and acks; the donor then HARD-commits
+    #      (drops the var from its stream, ships `dropped` next
+    #      round). Re-sent every round until acked — idempotent.
+    #
+    # The epoch fence closes every kill window: a donor killed before
+    # step 3 rolls back (its promoted backup holds the var, version
+    # unbumped, the recipient's staged orphan is superseded by any
+    # retry); a donor killed after step 3 completes via its promoted
+    # backup re-driving step 4; a recipient killed mid-install fails
+    # the install (donor retries next round, bounded, else rollback).
+    # Trainers adopt the new map atomically at the round barrier
+    # (responses carry it) or lazily via `wrong_shard` redirects whose
+    # tokens are NEVER recorded as executed — replays with ORIGINAL
+    # tokens stay exactly-once across the version bump because the
+    # install carries the donor's folded-seq watermark.
+
+    def _repl_extra_locked(self) -> dict:
+        """Shard-map / migration fields riding every replicate rpc."""
+        ex = {}
+        if self._shard_map_version:
+            ex["shard_map"] = self._shard_map_payload_locked()
+            ex["map_overrides"] = {
+                n: dict(ov) for n, ov in self._map_overrides.items()}
+        if self._dropped:
+            ex["dropped"] = sorted(self._dropped)
+        if self._pending_migration is not None:
+            pm = self._pending_migration
+            ex["pending_migration"] = {
+                "name": pm["name"], "to_shard": pm["to_shard"],
+                "to_endpoints": pm["to_endpoints"]}
+        return ex
+
+    def _shard_map_payload_locked(self) -> dict:
+        """The client-facing shard map: version + var -> shard ints."""
+        return {"version": self._shard_map_version,
+                "overrides": {n: int(ov["shard"])
+                              for n, ov in self._map_overrides.items()}}
+
+    def _mig_client(self, chain: str) -> "PSClient":
+        c = self._mig_clients.get(chain)
+        if c is None:
+            c = PSClient(chain, trainer_id=None, auto_heartbeat=False,
+                         timeout=self._repl_connect,
+                         rpc_deadline=self._repl_deadline,
+                         max_retries=int(os.environ.get(
+                             "PADDLE_PS_REPL_RETRIES", "3")))
+            self._mig_clients[chain] = c
+        return c
+
+    def _step_migration_locked(self) -> None:
+        """Donor side, called inside the round apply: execute the
+        pending migration (install + soft commit). Transport failures
+        retry at the next round's barrier, bounded — then roll back."""
+        pm = self._pending_migration
+        if pm is None or not self._active_role():
+            return
+        name = pm["name"]
+        val = self._executor._read_var(self._scope, name)
+        if val is None or name in self._dropped:
+            self._pending_migration = None
+            return
+        ver = self._shard_map_version + 1
+        _flight.record("ps.migration_begin", var=name,
+                       to_shard=pm["to_shard"], version=ver,
+                       round=self._applied_round)
+        try:
+            self._install_migration_locked(name, int(pm["to_shard"]),
+                                           pm["to_endpoints"], ver)
+        except (RuntimeError, OSError) as e:
+            pm["attempts"] = int(pm.get("attempts", 0)) + 1
+            _counter("ps.migrations", outcome="install_retry").inc()
+            if pm["attempts"] >= 3:
+                self._pending_migration = None
+                _counter("ps.migrations", outcome="rollback").inc()
+                _flight.record("ps.migration_rollback", var=name,
+                               why="install failed: %s" % e)
+                print("[ps_rpc] migration of %r to shard %s ROLLED "
+                      "BACK after %d install failures (%s)"
+                      % (name, pm["to_shard"], pm["attempts"], e),
+                      file=sys.stderr, flush=True)
+            return
+        if os.environ.get("PADDLE_PS_CHAOS_DIE_AFTER_INSTALL") \
+                == self._own_endpoint:
+            # chaos-drill hook: the donor primary dies in the WORST
+            # spot — range installed on the recipient, nothing
+            # committed or replicated. The drill proves this rolls
+            # back (or completes via a retriggered migration) with
+            # params bit-for-bit.
+            print("[ps_rpc] CHAOS: donor %s dying after migrate "
+                  "install" % self._own_endpoint, file=sys.stderr,
+                  flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        # soft commit: route the var away; keep its state in our
+        # stream until the recipient durably owns it (hard commit)
+        self._pending_migration = None
+        self._shard_map_version = ver
+        self._map_overrides[name] = {
+            "shard": int(pm["to_shard"]), "version": ver,
+            "committed": False, "to_endpoints": pm["to_endpoints"]}
+        _counter("ps.migrations", outcome="installed").inc()
+        _flight.record("ps.migration_installed", var=name,
+                       version=ver, round=self._applied_round)
+
+    def _install_migration_locked(self, name: str, to_shard: int,
+                                  to_endpoints: str, ver: int) -> None:
+        """Ship ``name``'s WHOLE FAMILY (base var + every @-companion
+        in scope — momentum/adam state moves with its param, grads are
+        transient but harmless) to the recipient's active primary for
+        staging. Raises on transport/app failure — the caller owns the
+        retry/rollback policy."""
+        items = []
+        for vn in self._family_index().get(name, [name]):
+            v = self._executor._read_var(self._scope, vn)
+            if v is None or not hasattr(v, "shape"):
+                continue
+            items.append((vn, np.ascontiguousarray(np.asarray(v)),
+                          None))
+        if not items:
+            raise RuntimeError("no tensor state for %r" % name)
+        headers, raw = self._blobs_for(items)
+        self._mig_client(to_endpoints)._call({
+            "kind": "migrate_install", "name": name,
+            "mig_version": ver, "mig_round": self._applied_round,
+            "to_shard": int(to_shard),
+            "watermark": dict(self._applied_watermark),
+            "has_block": (name + "@GRAD") in self._grad_to_block,
+            "vars": headers}, raw)
+
+    def _commit_migrations_locked(self) -> None:
+        """Donor side (original or promoted): drive every uncommitted
+        outbound migration to its commit — re-sent each round until
+        the recipient acks (idempotent), then hard-commit locally. A
+        recipient that LOST its stage (its primary died between
+        install and commit; staging is memory-only) is re-installed
+        first — the donor still holds the var, which is exactly why
+        the hard commit waits for the ack."""
+        if not self._active_role():
+            return
+        for name, ov in list(self._map_overrides.items()):
+            if ov.get("committed") or "to_endpoints" not in ov:
+                continue
+            try:
+                self._mig_client(ov["to_endpoints"])._call({
+                    "kind": "migrate_commit", "name": name,
+                    "mig_version": int(ov["version"]),
+                    "to_shard": int(ov["shard"])})
+            except (RuntimeError, OSError) as e:
+                _counter("ps.migrations", outcome="commit_retry").inc()
+                print("[ps_rpc] migrate_commit of %r failed (%s) — "
+                      "re-installing and retrying next round"
+                      % (name, e), file=sys.stderr, flush=True)
+                try:
+                    # the stage may be GONE (the recipient primary
+                    # that held it died; a promoted backup has no
+                    # memory of it): put it back — this primary still
+                    # holds the state, which is exactly why the hard
+                    # commit waits for the ack
+                    self._install_migration_locked(
+                        name, int(ov["shard"]), ov["to_endpoints"],
+                        int(ov["version"]))
+                except (RuntimeError, OSError):
+                    pass  # next round retries the whole sequence
+                continue
+            ov["committed"] = True
+            self._drop_var_locked(name)
+            _counter("ps.migrations", outcome="committed").inc()
+            _flight.record("ps.migration_committed", var=name,
+                           version=int(ov["version"]),
+                           round=self._applied_round)
+
+    def _drop_var_locked(self, name: str) -> None:
+        """Hard commit: mask the migrated-out var (and its whole
+        family — grads/optimizer state moved with it conceptually)
+        from this group's stream; delete where the scope allows."""
+        for vn in list(self._scope.local_var_names()):
+            if vn.split("@", 1)[0] != name:
+                continue
+            self._dropped.add(vn)
+            self._shipped_digests.pop(vn, None)
+            self._dirty_rows.pop(vn, None)
+            self._dirty_dense.discard(vn)
+            try:
+                if hasattr(self._scope, "__delitem__"):
+                    del self._scope[vn]
+            except (KeyError, TypeError):
+                pass
+
+    def _commit_staged_locked(self, name: str) -> None:
+        """Recipient side: a staged var becomes servable — into the
+        scope, optimize block rebuilt, watermark merged (replays of
+        rpcs already folded into the shipped state answer `replayed`
+        here too — exactly-once across the shard-map bump), map
+        bumped, and the var pushed to THIS group's backups before the
+        donor ever gets the ack."""
+        st = self._staged_in.pop(name)
+        for vn, arr in st["arrays"].items():
+            self._executor._write_var(self._scope, vn, arr)
+            self._dropped.discard(vn)
+            self._shipped_digests.pop(vn, None)
+        gname = name + "@GRAD"
+        if gname not in self._grad_to_block \
+                and self._block_factory is not None:
+            blk = self._block_factory(gname)
+            if blk is not None:
+                self._grad_to_block[gname] = blk
+        for cid, s in (st.get("watermark") or {}).items():
+            if int(self._repl_watermark.get(cid, 0)) < int(s):
+                self._repl_watermark[cid] = int(s)
+        ver = int(st["version"])
+        self._shard_map_version = max(self._shard_map_version, ver)
+        self._map_overrides[name] = {"shard": int(st["to_shard"]),
+                                     "version": ver, "committed": True}
+        self._replicate_vars_locked(sorted(st["arrays"]))
+        _counter("ps.migrations", outcome="adopted").inc()
+        _flight.record("ps.migration_commit", var=name, version=ver,
+                       round=self._applied_round)
+
+    def _replicate_vars_locked(self, names) -> None:
+        """Push the named vars (plus the shard-map state) to this
+        group's backups right now — the recipient's primary must not
+        be the only holder of a freshly adopted family for even a
+        round. Any failure schedules a full re-anchor at the next
+        round instead of risking divergence."""
+        items = []
+        for name in names:
+            val = self._executor._read_var(self._scope, name)
+            if val is None or not hasattr(val, "shape"):
+                continue
+            items.append((name,
+                          np.ascontiguousarray(np.asarray(val)),
+                          None))
+        if not items:
+            return
+        headers, raw = self._blobs_for(items)
+        extra = self._repl_extra_locked()
+        for ep in self._repl_targets():
+            try:
+                resp = self._repl_client(ep).replicate(
+                    self._applied_round, headers, raw,
+                    dict(self._applied_watermark), mode="delta",
+                    base_round=self._applied_round,
+                    epoch=self._epoch, extra=extra)
+                if resp.get("repl_gap") or resp.get("fenced"):
+                    self._shipped_digests = {}
+            except (RuntimeError, OSError):
+                self._shipped_digests = {}  # anchor next round
 
     def _async_tick_locked(self) -> dict:
         """Async-mode (RunAsyncLoop) durability bookkeeping, locked by
@@ -826,9 +1367,13 @@ class PSServer:
 
     def _refresh_lease_locked(self, epoch: int) -> None:
         """A renewal / replication / snapshot from an equal-or-newer
-        primary: its lease holds for another period."""
+        primary: its lease holds for another period. The deadline is
+        read through the ``clock_jitter`` chaos hook — a drilled
+        process's lease view wanders like a real skewed clock would,
+        and the quorum math must still never split the brain."""
         self._seen_epoch = max(self._seen_epoch, int(epoch))
-        self._lease_deadline = time.monotonic() + self._lease_s
+        self._lease_deadline = (time.monotonic() + self._lease_s
+                                + _fault.clock_skew())
         self._lease_expired_counted = False
 
     def _demote_locked(self, new_epoch: int, why: str) -> None:
@@ -853,9 +1398,12 @@ class PSServer:
         """One background loop per multi-endpoint server: the active
         primary renews its lease with every group peer; a caught-up
         backup whose lease view expired stands for election. Control-
-        plane failures are signals, never fatal."""
-        period = max(self._lease_s / 3.0, 0.05)
-        while not self._shutdown.wait(period):
+        plane failures are signals, never fatal. The tick period is
+        perturbed by the ``clock_jitter`` chaos hook — a skewed
+        process renews early/late like a real drifting clock."""
+        base_period = max(self._lease_s / 3.0, 0.05)
+        while not self._shutdown.wait(
+                max(0.02, base_period + _fault.clock_skew() / 3.0)):
             try:
                 if self._active_role():
                     self._renew_lease()
@@ -877,18 +1425,26 @@ class PSServer:
         without this server's own vote, so it serves on."""
         with self._lock:
             epoch, rnd = self._epoch, self._applied_round
+        # witnesses receive renewals too (their per-shard lease views
+        # must stay fresh, or they would rubber-stamp elections under
+        # a live primary) but the renewal MAJORITY is group-only,
+        # mirroring the election quorum
         n = len(self._endpoints)
         grants = 1  # self
-        for ep in self._endpoints:
+        for ep in list(self._endpoints) + list(self._witnesses):
             if ep == self._own_endpoint or self._shutdown.is_set():
                 continue
+            witness = ep in self._witnesses
             try:
                 resp = _bare_rpc(
                     ep, {"kind": "lease_renew", "epoch": epoch,
-                         "round": rnd, "frm": self._own_endpoint},
+                         "round": rnd, "frm": self._own_endpoint,
+                         "shard": self._shard,
+                         "lease_ms": self._lease_s * 1e3},
                     timeout=max(self._lease_s / 3.0, 0.2))
             except ConnectionRefusedError:
-                grants += 1  # dead listener: tombstone
+                if not witness:
+                    grants += 1  # dead listener: tombstone
                 continue
             except (OSError, ValueError):
                 continue  # partition/timeout: no evidence either way
@@ -899,8 +1455,10 @@ class PSServer:
                                         "renewal" % ep)
                 return
             if resp.get("ok"):
-                grants += 1
-                _counter("ps.lease_renewals").inc()
+                if not witness:
+                    grants += 1
+                    _counter("ps.lease_renewals").inc()
+                # witness acks count their own ps.witness_renewals
         now = time.monotonic()
         if grants * 2 > n:
             self._last_majority_ack = now
@@ -947,39 +1505,85 @@ class PSServer:
                              self._promised_epoch) + 1
                 my_round = self._applied_round
             grants, tombstones, denials = 1, 0, 0
-            stale = False
-            for ep in self._endpoints:
+            w_grants, w_tombstones = 0, 0
+            stale = vetoed = False
+            for ep in list(self._endpoints) + list(self._witnesses):
                 if ep == self._own_endpoint or self._shutdown.is_set():
                     continue
+                witness = ep in self._witnesses
                 try:
                     resp = _bare_rpc(
                         ep, {"kind": "vote", "epoch": target,
                              "cand_round": my_round,
+                             "shard": self._shard,
+                             "lease_ms": self._lease_s * 1e3,
                              "candidate": self._own_endpoint},
                         timeout=max(self._lease_s / 3.0, 0.3))
                 except ConnectionRefusedError:
-                    tombstones += 1
+                    if witness:
+                        w_tombstones += 1  # dead witness: its veto
+                        # power dies with it (positive evidence)
+                    else:
+                        tombstones += 1
                     continue
                 except (OSError, ValueError):
                     continue  # unreachable: silence is not assent
                 if int(resp.get("round", -1)) > my_round:
                     stale = True
                 if resp.get("granted"):
-                    grants += 1
+                    if witness:
+                        w_grants += 1
+                    else:
+                        grants += 1
                 else:
                     denials += 1
-            quorum = (grants + tombstones) * 2 > len(self._endpoints)
-            won = quorum and not stale
+                    if resp.get("active"):
+                        # a REACHABLE, still-active primary denied:
+                        # it is demonstrably alive — this candidate's
+                        # lease view is merely late (a delayed
+                        # renewal sweep, a jittered clock). Deposing
+                        # it would be pure churn: VETO. Promotion
+                        # needs the primary unreachable (timeout),
+                        # dead (refused), or demoted — never outvoted
+                        # while it answers.
+                        vetoed = True
+            # quorum is GROUP-ONLY: witnesses gate below but never
+            # provide margin (a busy primary whose vote handler is
+            # starved for a moment must not be out-votable by
+            # candidate+witness — the PR-8 invariant that a 2-group
+            # backup can never promote without the primary's death
+            # evidence stays intact)
+            n = len(self._endpoints)
+            quorum = (grants + tombstones) * 2 > n
+            # witnesses configured => the election ALSO needs positive
+            # evidence: at least one live witness granting (its lease
+            # view of this shard expired — the primary really stopped
+            # renewing), unless every witness is itself a tombstone.
+            # Forged connection-REFUSALs alone can no longer elect a
+            # backup under a live primary (the ISSUE-13 corner).
+            w_ok = (not self._witnesses or w_grants >= 1
+                    or w_tombstones >= len(self._witnesses))
+            won = quorum and not stale and w_ok and not vetoed
             _flight.record("ps.election", endpoint=self._own_endpoint,
                            epoch=target, grants=grants,
                            tombstones=tombstones, denials=denials,
-                           stale=stale, won=won, trigger=trigger)
+                           witness_grants=w_grants,
+                           witness_tombstones=w_tombstones,
+                           stale=stale, vetoed=vetoed, won=won,
+                           trigger=trigger)
             if not won:
+                if vetoed:
+                    with self._lock:
+                        # the primary lives: stop standing until its
+                        # next renewal actually fails to arrive
+                        self._refresh_lease_locked(self._seen_epoch)
                 print("[ps_rpc] endpoint %s lost election for epoch %d"
                       " (%d grants + %d tombstones of %d, denials=%d, "
-                      "stale=%s; trigger=%s) — staying a backup"
+                      "witness grants=%d/%d, stale=%s, vetoed=%s; "
+                      "trigger=%s) — staying a backup"
                       % (self._own_endpoint, target, grants, tombstones,
-                         len(self._endpoints), denials, stale, trigger),
+                         n, denials, w_grants, len(self._witnesses),
+                         stale, vetoed, trigger),
                       file=sys.stderr, flush=True)
                 return False
             with self._lock:
@@ -1032,6 +1636,26 @@ class PSServer:
                             if int(self._repl_watermark.get(cid, 0)) \
                                     < int(s):
                                 self._repl_watermark[cid] = int(s)
+                        # shard-map / migration state: a rejoiner must
+                        # not re-serve (or re-anchor) vars the group
+                        # migrated away while it was down
+                        sm = resp.get("shard_map")
+                        if sm:
+                            self._shard_map_version = max(
+                                self._shard_map_version,
+                                int(sm.get("version", 0)))
+                        for n2, ov in (resp.get("map_overrides")
+                                       or {}).items():
+                            self._map_overrides[n2] = dict(ov)
+                        for n2 in resp.get("dropped", []) or []:
+                            self._dropped.add(n2)
+                            try:
+                                if hasattr(self._scope, "__delitem__") \
+                                        and n2 in \
+                                        self._scope.local_var_names():
+                                    del self._scope[n2]
+                            except (KeyError, TypeError):
+                                pass
                         # adopt the active primary's epoch + a fresh
                         # lease: a just-rejoined backup must not stand
                         # for election before the primary's first
@@ -1199,6 +1823,37 @@ class PSServer:
                             "to serve stale params"
                             % (self._own_endpoint, self._applied_round,
                                msg.get("round"))}, b""
+        if kind in ("send_grad", "get_param") and self._active_role():
+            # live-migration routing (ISSUE 13): a var migrated AWAY
+            # redirects (the token is un-recorded — the rpc executes
+            # exactly once, at the real owner); a var staged IN whose
+            # dataplane traffic arrives proves the donor's map bump
+            # reached a trainer, so the stage self-commits (backstop
+            # for a donor that died between its bump and the commit)
+            base = str(msg.get("name", "")).split("@", 1)[0]
+            if base:
+                with self._lock:
+                    st = self._staged_in.get(base)
+                    if st is not None and int(msg.get("mv", -1)) \
+                            >= int(st["version"]):
+                        # the client PROVED the donor's map bump (its
+                        # adopted map version rides the rpc): commit.
+                        # A version-0 hash-routed client proves
+                        # nothing — a var migrating BACK toward its
+                        # hash-home must not be committed by a client
+                        # that never saw the bump.
+                        self._commit_staged_locked(base)
+                    ov = self._map_overrides.get(base)
+                    if ov is not None \
+                            and int(ov["shard"]) != self._shard_index:
+                        return {"ok": False, "wrong_shard": True,
+                                "name": base,
+                                "shard_map":
+                                    self._shard_map_payload_locked(),
+                                "error": "var %r migrated to shard %s "
+                                "(map v%d)" % (base, ov["shard"],
+                                               self._shard_map_version)
+                                }, b""
         if "trainer_id" in msg:
             tid = int(msg["trainer_id"])
             if self._evict_after > 0 and not self._clock_started:
@@ -1221,6 +1876,16 @@ class PSServer:
             extra = {}
             with self._lock:
                 if self._sync:
+                    if self._stale_train_round_locked(msg):
+                        # the TRAINING round this grad belongs to was
+                        # already applied here (eviction sailed it, or
+                        # a relaunched trainer is re-running a round
+                        # whose barrier its dead incarnation already
+                        # closed): folding it into the NEXT round
+                        # would double-apply — drop it, tell the
+                        # client, keep exactly-once
+                        return {"ok": True, "stale_round": True,
+                                "round": self._applied_round}, b""
                     self._pending.setdefault(
                         msg["name"], {})[int(msg.get("trainer_id",
                                                      0))] = arr
@@ -1230,13 +1895,23 @@ class PSServer:
                     sub = self._grad_to_block.get(msg["name"])
                     if sub is not None:
                         self._executor.run_block(sub, self._scope)
-                    # a dense async update may touch any row of any
-                    # var through its block: full-var diff takes over
-                    self._dirty_rows.clear()
+                    # a dense async update touches its grad's FAMILY
+                    # through its block: full diff takes over there
+                    self._mark_families_dirty_locked([msg["name"]])
                     extra = self._async_tick_locked()
             return dict({"ok": True}, **extra), b""
         if kind == "send_barrier":
             with self._lock:
+                if self._sync and self._stale_train_round_locked(msg):
+                    # this barrier's round already applied: counting
+                    # it would pre-pay the NEXT round's fanin and
+                    # apply it early with a trainer missing
+                    resp = {"ok": True, "stale_round": True,
+                            "round": self._applied_round}
+                    if self._shard_map_version:
+                        resp["shard_map"] = \
+                            self._shard_map_payload_locked()
+                    return resp, b""
                 # gate round N+1 on round N being fully fetched
                 self._wait_for(lambda: not self._fetches_pending,
                                "previous round's fetch barriers")
@@ -1247,7 +1922,13 @@ class PSServer:
                 else:
                     self._wait_for(lambda: self._round_complete,
                                    "all trainers' send barriers")
-            return {"ok": True}, b""
+                resp = {"ok": True, "round": self._applied_round}
+                if self._shard_map_version:
+                    # the barrier IS the atomic map-adoption point:
+                    # every trainer's round-N ack carries the map that
+                    # round N's apply may just have bumped
+                    resp["shard_map"] = self._shard_map_payload_locked()
+            return resp, b""
         if kind == "get_param":
             with self._lock:
                 if self._sync:
@@ -1369,10 +2050,8 @@ class PSServer:
                     arr = _array_from(h, raw[off:off + n])
                     off += n
                     rows = h.get("rows")
-                    if rows is None:
-                        self._executor._write_var(self._scope,
-                                                  h["name"], arr)
-                    else:
+                    chunk = h.get("chunk")
+                    if rows is not None:
                         # row SLICE of a sparse table: splice into the
                         # resident copy (the anchor shipped the rest)
                         tbl = np.array(np.asarray(
@@ -1382,6 +2061,54 @@ class PSServer:
                         tbl[np.asarray(rows, dtype=np.int64)] = arr
                         self._executor._write_var(self._scope,
                                                   h["name"], tbl)
+                    elif chunk is not None:
+                        # FLAT element range of a dense var (chunk-
+                        # digest delta, ISSUE 13): splice into the
+                        # flattened resident copy
+                        tbl = np.array(np.asarray(
+                            self._executor._read_var(self._scope,
+                                                     h["name"])),
+                            copy=True)
+                        tbl.reshape(-1)[int(chunk[0]):int(chunk[1])] \
+                            = arr.reshape(-1)
+                        self._executor._write_var(self._scope,
+                                                  h["name"], tbl)
+                    else:
+                        self._executor._write_var(self._scope,
+                                                  h["name"], arr)
+                # shard-map / migration state rides the stream: a
+                # promoted backup must know what moved (or is moving)
+                # away, or it would serve — or lose — a migrated var
+                sm = msg.get("shard_map")
+                if sm and int(sm.get("version", 0)) \
+                        >= self._shard_map_version:
+                    self._shard_map_version = int(sm["version"])
+                mo = msg.get("map_overrides")
+                if mo:
+                    for n2, ov in mo.items():
+                        cur = self._map_overrides.get(n2)
+                        if cur is None or int(cur.get("version", 0)) \
+                                <= int(ov.get("version", 0)):
+                            self._map_overrides[n2] = dict(ov)
+                for n2 in msg.get("dropped", []) or []:
+                    if n2 not in self._dropped:
+                        self._dropped.add(n2)
+                        self._shipped_digests.pop(n2, None)
+                        try:
+                            if hasattr(self._scope, "__delitem__") \
+                                    and n2 in self._scope.local_var_names():
+                                del self._scope[n2]
+                        except (KeyError, TypeError):
+                            pass
+                pm = msg.get("pending_migration")
+                if pm:
+                    # inherit the intent: a promoted backup re-drives
+                    # the migration instead of silently dropping it
+                    self._pending_migration = dict(pm)
+                elif not self._active_role():
+                    # the stream is the truth: an intent that stopped
+                    # riding it was executed or rolled back upstream
+                    self._pending_migration = None
                 # NB "round" is the dedup-token key _call stamps on
                 # every message — the payload round travels separately
                 self._applied_round = int(msg["repl_round"])
@@ -1397,6 +2124,103 @@ class PSServer:
             _flight.record("ps.replicated", round=self._applied_round,
                            mode=mode)
             return {"ok": True, "round": self._applied_round}, b""
+        if kind == "migrate_begin":
+            # control plane, donor side: record the intent; the
+            # transfer itself runs inside the NEXT round apply (the
+            # freeze point every trainer is barrier-blocked behind)
+            if not self._active_role():
+                return {"ok": False, "not_primary": True,
+                        "error": "migrate_begin sent to non-active "
+                        "endpoint %s" % self._own_endpoint}, b""
+            name = str(msg.get("name", "")).split("@", 1)[0]
+            with self._lock:
+                ov = self._map_overrides.get(name)
+                if ov is not None \
+                        and int(ov["shard"]) != self._shard_index:
+                    return {"ok": True, "already_migrated": True,
+                            "shard_map":
+                                self._shard_map_payload_locked()}, b""
+                if self._executor._read_var(self._scope, name) is None:
+                    return {"ok": False, "error":
+                            "no var %r to migrate" % name}, b""
+                pm = self._pending_migration
+                if pm is not None and pm.get("name") != name:
+                    # one in-flight migration per group: silently
+                    # replacing an acked intent would strand its
+                    # caller — refuse loudly, retry after the barrier
+                    return {"ok": False, "error":
+                            "migration of %r already pending on %s — "
+                            "retry after the next round barrier"
+                            % (pm.get("name"),
+                               self._own_endpoint)}, b""
+                self._pending_migration = {
+                    "name": name, "to_shard": int(msg["to_shard"]),
+                    "to_endpoints": str(msg["to_endpoints"])}
+            _flight.record("ps.migration_requested", var=name,
+                           to_shard=int(msg["to_shard"]))
+            return {"ok": True, "pending": True}, b""
+        if kind == "migrate_install":
+            # recipient side: STAGE the inbound range (not servable
+            # until the donor's commit — or a dataplane touch that
+            # proves the donor's map bump reached a trainer)
+            if not self._active_role():
+                return {"ok": False, "not_primary": True,
+                        "error": "migrate_install sent to non-active "
+                        "endpoint %s" % self._own_endpoint}, b""
+            if msg.get("has_block") and self._block_factory is None:
+                return {"ok": False, "error":
+                        "recipient %s has no block_factory to rebuild "
+                        "the optimize block for %r"
+                        % (self._own_endpoint, msg.get("name"))}, b""
+            name = str(msg["name"])
+            arrays: Dict[str, np.ndarray] = {}
+            off = 0
+            for h in msg.get("vars", []):
+                n = int(np.dtype(h["dtype"]).itemsize
+                        * int(np.prod(h["shape"]) if h["shape"]
+                              else 1))
+                arrays[h["name"]] = _array_from(h, raw[off:off + n])
+                off += n
+            if name not in arrays:
+                return {"ok": False, "error":
+                        "migrate_install payload lacks the base var "
+                        "%r" % name}, b""
+            ver = int(msg["mig_version"])
+            with self._lock:
+                cur = self._map_overrides.get(name)
+                if cur is not None and cur.get("committed") \
+                        and int(cur.get("version", 0)) >= ver:
+                    return {"ok": True, "already_committed": True}, b""
+                self._staged_in[name] = {
+                    "version": ver, "arrays": arrays,
+                    "to_shard": int(msg["to_shard"]),
+                    "round": int(msg.get("mig_round", 0)),
+                    "watermark": dict(msg.get("watermark") or {})}
+            _flight.record("ps.migration_install", var=name,
+                           version=ver,
+                           round=int(msg.get("mig_round", 0)))
+            return {"ok": True, "staged": True}, b""
+        if kind == "migrate_commit":
+            if not self._active_role():
+                return {"ok": False, "not_primary": True,
+                        "error": "migrate_commit sent to non-active "
+                        "endpoint %s" % self._own_endpoint}, b""
+            name = str(msg["name"])
+            ver = int(msg["mig_version"])
+            with self._lock:
+                cur = self._map_overrides.get(name)
+                if cur is not None \
+                        and int(cur.get("version", 0)) >= ver \
+                        and cur.get("committed"):
+                    return {"ok": True, "already_committed": True}, b""
+                st = self._staged_in.get(name)
+                if st is None or int(st["version"]) != ver:
+                    return {"ok": False, "error":
+                            "no staged migration of %r at version %d "
+                            "on %s" % (name, ver,
+                                       self._own_endpoint)}, b""
+                self._commit_staged_locked(name)
+            return {"ok": True}, b""
         if kind == "lease_renew":
             with self._lock:
                 epoch = int(msg.get("epoch", 0))
@@ -1418,15 +2242,26 @@ class PSServer:
             with self._lock:
                 epoch = int(msg.get("epoch", 0))
                 cand_round = int(msg.get("cand_round", -1))
+                cand = msg.get("candidate")
+                # Raft votedFor semantics: the SAME candidate may
+                # re-collect a promise at the SAME epoch — an injected
+                # fault (or real packet loss) eating the grant reply
+                # must not burn the epoch and livelock every retry
+                fresh = epoch > max(self._promised_epoch,
+                                    self._seen_epoch, self._epoch)
+                re_grant = (epoch == self._promised_epoch
+                            and cand is not None
+                            and cand == self._promised_to
+                            and epoch > max(self._seen_epoch,
+                                            self._epoch))
                 granted = (self._lease_mode()
                            and not self._active_role()
                            and self._lease_expired_locked()
-                           and epoch > max(self._promised_epoch,
-                                           self._seen_epoch,
-                                           self._epoch)
+                           and (fresh or re_grant)
                            and cand_round >= self._applied_round)
                 if granted:
                     self._promised_epoch = epoch
+                    self._promised_to = cand
                 resp = {"ok": True, "granted": granted,
                         "round": self._applied_round,
                         "epoch": self._seen_epoch,
@@ -1467,8 +2302,10 @@ class PSServer:
                 wm = dict(self._applied_watermark)
                 if ep:
                     self._repl_dead.discard(ep)
-                return {"ok": True, "round": self._applied_round,
-                        "watermark": wm, "epoch": self._epoch}, b""
+                resp = {"ok": True, "round": self._applied_round,
+                        "watermark": wm, "epoch": self._epoch}
+                resp.update(self._repl_extra_locked())
+                return resp, b""
         if kind == "heartbeat":
             with self._lock:
                 evicted = sorted(self._evicted)
@@ -1488,6 +2325,11 @@ class PSServer:
                     "evictions": _counter("ps.evictions").value,
                     "readmissions": _counter("ps.readmissions").value,
                     "promotions": _counter("ps.promotions").value,
+                    "shard_map": {
+                        "version": self._shard_map_version,
+                        "overrides": {
+                            n: int(ov["shard"])
+                            for n, ov in self._map_overrides.items()}},
                     }, b""
         if kind == "shutdown":
             self._shutdown.set()
@@ -1589,11 +2431,13 @@ class PSServer:
         except Exception as e:
             resp, rraw = {"ok": False, "error": "%s: %s"
                           % (type(e).__name__, e)}, b""
-        if isinstance(resp, dict) and resp.get("not_primary"):
+        if isinstance(resp, dict) and (resp.get("not_primary")
+                                       or resp.get("wrong_shard")):
             # a redirect is NOT an execution: un-record the token so a
-            # client's lease-wait retry of the SAME rpc re-runs the
-            # handler once this server promotes — a cached redirect
-            # would poison every retry of that token forever
+            # client's lease-wait retry (or its re-route of the SAME
+            # rpc to the migrated var's real owner) re-runs the
+            # handler exactly once — a cached redirect would poison
+            # every retry of that token forever
             with self._dedupe_lock:
                 ent = self._dedupe.get(cid)
                 if ent is not None and ent[0] == key:
@@ -1713,12 +2557,14 @@ class PSServer:
             self._sock.close()
         except OSError:
             pass
-        for c in list(self._repl_clients.values()):
+        for c in (list(self._repl_clients.values())
+                  + list(self._mig_clients.values())):
             try:
                 c.close()
             except OSError:
                 pass
         self._repl_clients.clear()
+        self._mig_clients.clear()
         with self._conn_lock:
             conns = list(self._conns)
         for c in conns:
@@ -1753,6 +2599,21 @@ class _RPCConnLost(_RetryableRPC):
 class _NotPrimary(_RetryableRPC):
     """The endpoint answered ``not_primary`` — advance along the
     endpoint list instead of burning the retry budget."""
+
+
+class WrongShard(RuntimeError):
+    """The endpoint answered ``wrong_shard`` — the named var was
+    MIGRATED to another shard group (ISSUE 13). Carries the server's
+    shard map so the sharded router updates its routing and reissues
+    the rpc (with a fresh token, at the real owner — the redirecting
+    server un-recorded the original, so the rpc still executes
+    exactly once)."""
+
+    def __init__(self, what: str, shard_map: Optional[dict] = None,
+                 name: Optional[str] = None):
+        super().__init__(what)
+        self.shard_map = shard_map or {}
+        self.name = name
 
 
 class PSClient:
@@ -1835,6 +2696,10 @@ class PSClient:
         self._failover_connect = float(os.environ.get(
             "PADDLE_PS_FAILOVER_CONNECT_TIMEOUT",
             str(min(self._timeout, 5.0))))
+        # the sharded router's adopted map version, stamped (``mv``)
+        # on every rpc so a recipient can tell a map-bump-proving
+        # client from a hash-routed stale one
+        self._map_version_hint: Optional[int] = None
         self._io_lock = threading.Lock()
         self._seq = 0  # per-client sequence: lets the server dedupe the
         # reconnect-resend in _call (send_grad/barriers are not
@@ -2101,6 +2966,8 @@ class PSClient:
             msg["cid"] = self._cid
             msg["round"] = self._round
             msg["fo"] = self._failover_count
+            if self._map_version_hint is not None:
+                msg["mv"] = int(self._map_version_hint)
             self._stamp_trace(msg)
             entry = None
             if (len(self._endpoints) > 1 and msg["kind"] in
@@ -2120,6 +2987,21 @@ class PSClient:
                               % self._replay_cap,
                               file=sys.stderr, flush=True)
             resp, resp_raw = self._issue(msg, raw)
+            if isinstance(resp, dict) and resp.get("wrong_shard"):
+                # the var migrated: this rpc never executed here, and
+                # it never will — drop its replay entry and hand the
+                # server's map to the sharded router for the re-route
+                if entry is not None:
+                    try:
+                        self._replay_log.remove(entry)
+                    except ValueError:
+                        pass
+                raise WrongShard(
+                    "pserver %s no longer owns %r: %s"
+                    % (self._endpoint, resp.get("name"),
+                       resp.get("error")),
+                    shard_map=resp.get("shard_map"),
+                    name=resp.get("name"))
             if entry is not None and isinstance(resp, dict) \
                     and resp.get("pending_round") is not None:
                 # async ack: the op rides this replication round
@@ -2339,23 +3221,40 @@ class PSClient:
                     "pserver error during failover replay of %s: %s"
                     % (m.get("kind"), resp.get("error")))
 
-    def send_grad(self, name: str, value) -> None:
+    def send_grad(self, name: str, value, round: Optional[int] = None
+                  ) -> None:
+        """``round`` (optional) is the TRAINING round this grad
+        belongs to — workers that track one stamp it (``tr`` on the
+        wire) so a server that already applied that round (eviction
+        sailed it without this trainer) drops the re-send instead of
+        folding it into the NEXT round."""
         arr = np.ascontiguousarray(np.asarray(value))
-        self._call({"kind": "send_grad", "name": name,
-                    "array": _array_header(arr)}, arr.tobytes())
+        msg = {"kind": "send_grad", "name": name,
+               "array": _array_header(arr)}
+        if round is not None:
+            msg["tr"] = int(round)
+        self._call(msg, arr.tobytes())
 
-    def send_barrier(self) -> None:
-        self.barrier_prepare()
+    def send_barrier(self, round: Optional[int] = None) -> None:
+        self.barrier_prepare(round=round)
         self._round += 1
 
-    def barrier_prepare(self) -> None:
+    def barrier_prepare(self, round: Optional[int] = None) -> dict:
         """Phase 1 of the two-phase round barrier: issue the barrier
         rpc. With ``_defer_barrier_commit`` set (sharded mode) the
         replay log SURVIVES this shard's ack — the round is durable
         only when every shard acked, so a sister shard's failover can
         still replay this round here (the dedup watermark makes that
-        exactly-once). Single-group clients clear on ack as before."""
-        self._call({"kind": "send_barrier"})
+        exactly-once). Single-group clients clear on ack as before.
+        Returns the server's response — it may carry the current
+        ``shard_map`` (the atomic adoption point for live migrations)
+        and, with ``round`` stamped, ``stale_round`` when this
+        training round already applied here."""
+        msg = {"kind": "send_barrier"}
+        if round is not None:
+            msg["tr"] = int(round)
+        resp, _ = self._call(msg)
+        return resp
 
     def barrier_commit(self) -> None:
         """Phase 2 (sharded mode): every shard acked its barrier — the
@@ -2402,24 +3301,40 @@ class PSClient:
                   raw: bytes, watermark: Dict[str, int],
                   mode: str = "full",
                   base_round: Optional[int] = None,
-                  epoch: int = 0) -> dict:
+                  epoch: int = 0,
+                  extra: Optional[dict] = None) -> dict:
         """Primary-side: ship one applied round (full anchor or
-        changed-vars/rows delta + dedup watermark) to the backup this
-        client points at; returns the backup's ack — which may carry
-        ``repl_gap`` (re-anchor me) or ``fenced`` (a newer epoch
-        rules; demote yourself)."""
-        resp, _ = self._call(
-            {"kind": "replicate", "repl_round": int(round_no),
-             "vars": var_headers, "watermark": watermark,
-             "repl_mode": mode,
-             "repl_base_round": (-1 if base_round is None
-                                 else int(base_round)),
-             "epoch": int(epoch)}, raw)
+        changed-vars/rows/chunks delta + dedup watermark) to the
+        backup this client points at; returns the backup's ack —
+        which may carry ``repl_gap`` (re-anchor me) or ``fenced`` (a
+        newer epoch rules; demote yourself). ``extra`` carries the
+        shard-map / migration fields (ISSUE 13)."""
+        msg = {"kind": "replicate", "repl_round": int(round_no),
+               "vars": var_headers, "watermark": watermark,
+               "repl_mode": mode,
+               "repl_base_round": (-1 if base_round is None
+                                   else int(base_round)),
+               "epoch": int(epoch)}
+        if extra:
+            msg.update(extra)
+        resp, _ = self._call(msg, raw)
         return resp
 
     def repl_status(self) -> dict:
         """role/round probe: ``{"active":, "caught_up":, "round":}``."""
         resp, _ = self._call({"kind": "repl_status"})
+        return resp
+
+    def migrate(self, name: str, to_shard: int,
+                to_endpoints: str) -> dict:
+        """Ask THIS endpoint chain's primary (the donor) to migrate
+        var ``name`` to the group at ``to_endpoints`` (shard index
+        ``to_shard``). The transfer executes at the donor's next
+        round barrier; the ack only records the intent."""
+        resp, _ = self._call({"kind": "migrate_begin",
+                              "name": name,
+                              "to_shard": int(to_shard),
+                              "to_endpoints": str(to_endpoints)})
         return resp
 
     def heartbeat(self) -> Dict[int, float]:
@@ -2435,3 +3350,170 @@ class PSClient:
 
     def shutdown_server(self) -> None:
         self._call({"kind": "shutdown"})
+
+
+class PSWitness:
+    """External quorum witness (ISSUE 13): a tiny vote-only endpoint
+    OUTSIDE every replication group, named by ``PADDLE_PS_WITNESSES``
+    (comma-separated) in each ``PSServer``'s environment. Primaries
+    renew their lease with it exactly like with group peers (the
+    renewal carries ``shard`` + ``lease_ms``, so ONE witness serves
+    every shard of a job); a candidate's election additionally needs
+    at least one live witness GRANT, and the witness grants only when
+    its OWN per-shard lease view expired — positive evidence the
+    primary stopped renewing, which a forged connection-REFUSED
+    tombstone cannot fake. A shard the witness never heard a renewal
+    for starts with a boot-grace lease (it must not rubber-stamp the
+    first election it ever sees). Holds no parameter state; restart
+    at will.
+
+    Counters: ``ps.witness_votes{shard=}`` (every vote handled; the
+    grant rides the flight line ``ps.witness_vote``) and
+    ``ps.witness_renewals{shard=}``."""
+
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        if _fault.get_identity() is None:
+            _fault.set_identity(endpoint)
+        # shard -> {"deadline", "lease_s", "seen_epoch", "promised"}
+        self._state: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host or "127.0.0.1", int(port)))
+        self._sock.listen(16)
+        self._threads: List[threading.Thread] = []
+
+    def _shard_state_locked(self, shard: str, lease_ms) -> dict:
+        st = self._state.get(shard)
+        if st is None:
+            lease_s = max(float(lease_ms or 1500.0) / 1e3, 0.05)
+            st = {"deadline": time.monotonic() + lease_s,
+                  "lease_s": lease_s, "seen_epoch": 0, "promised": 0}
+            self._state[shard] = st
+        return st
+
+    def _handle(self, msg: dict, raw: bytes):
+        kind = msg.get("kind")
+        shard = str(msg.get("shard", "0"))
+        if kind == "lease_renew":
+            with self._lock:
+                st = self._shard_state_locked(shard,
+                                              msg.get("lease_ms"))
+                epoch = int(msg.get("epoch", 0))
+                if epoch < st["seen_epoch"]:
+                    return {"ok": False, "fenced": True,
+                            "epoch": st["seen_epoch"]}, b""
+                st["seen_epoch"] = max(st["seen_epoch"], epoch)
+                if msg.get("lease_ms"):
+                    st["lease_s"] = max(
+                        float(msg["lease_ms"]) / 1e3, 0.05)
+                st["deadline"] = time.monotonic() + st["lease_s"]
+            _counter("ps.witness_renewals", shard=shard).inc()
+            return {"ok": True, "epoch": int(msg.get("epoch", 0))}, b""
+        if kind == "vote":
+            with self._lock:
+                st = self._shard_state_locked(shard,
+                                              msg.get("lease_ms"))
+                epoch = int(msg.get("epoch", 0))
+                cand = msg.get("candidate")
+                # votedFor: the same candidate may re-collect a
+                # promise whose grant reply was lost on the wire —
+                # a burned epoch must not livelock its retries
+                fresh = epoch > max(st["promised"], st["seen_epoch"])
+                re_grant = (epoch == st["promised"]
+                            and cand is not None
+                            and cand == st.get("promised_to")
+                            and epoch > st["seen_epoch"])
+                granted = (time.monotonic() > st["deadline"]
+                           and (fresh or re_grant))
+                if granted:
+                    st["promised"] = epoch
+                    st["promised_to"] = cand
+            _counter("ps.witness_votes", shard=shard).inc()
+            _flight.record("ps.witness_vote", shard=shard,
+                           candidate=msg.get("candidate"),
+                           epoch=int(msg.get("epoch", 0)),
+                           granted=granted, witness=self.endpoint)
+            # round -1: a witness holds no rounds and never vetoes a
+            # candidate's staleness — that is the group voters' job
+            return {"ok": True, "granted": granted, "round": -1,
+                    "witness": True}, b""
+        if kind == "witness_status":
+            with self._lock:
+                return {"ok": True, "witness": True,
+                        "shards": {s: {
+                            "expired": time.monotonic() > st["deadline"],
+                            "seen_epoch": st["seen_epoch"],
+                            "promised": st["promised"]}
+                            for s, st in self._state.items()}}, b""
+        if kind == "shutdown":
+            self._shutdown.set()
+            return {"ok": True}, b""
+        # anything else (a misrouted dataplane rpc): loud refusal
+        return {"ok": False, "witness": True,
+                "error": "witness %s only answers lease_renew/vote, "
+                "got %r" % (self.endpoint, kind)}, b""
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._shutdown.is_set():
+                got = _recv_msg(conn)
+                if got is None:
+                    return
+                msg, raw = got
+                try:
+                    resp, rraw = self._handle(msg, raw)
+                except Exception as e:
+                    resp, rraw = {"ok": False, "error": "%s: %s"
+                                  % (type(e).__name__, e)}, b""
+                if isinstance(msg, dict) and msg.get("seq") is not None:
+                    resp.setdefault("seq", msg.get("seq"))
+                    resp.setdefault("cid", msg.get("cid"))
+                _send_msg(conn, resp, rraw)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def serve_forever(self) -> None:
+        self._sock.settimeout(0.2)
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                t = threading.Thread(target=self._serve_conn,
+                                     args=(conn,), daemon=True)
+                t.start()
+                if len(self._threads) > 64:
+                    # every renewal sweep opens a fresh connection;
+                    # finished handler threads must not pile up for
+                    # the lifetime of the job
+                    self._threads = [x for x in self._threads
+                                     if x.is_alive()]
+                self._threads.append(t)
+        finally:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever,
+                             name="ps-witness", daemon=True)
+        t.start()
+        self._threads.append(t)
+        return t
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -30,6 +30,18 @@ whole PS:
   primary dying mid-round therefore cannot lose any other shard's
   round (their logs still hold it, and the per-shard replicated dedup
   watermark makes any replay exactly-once) nor double-apply its own.
+- **live migration / shard-map versioning (ISSUE 13)**: the static
+  hash map is only version 0. ``migrate(name, to_shard)`` asks the
+  var's current owner (the donor group's primary) to move it to
+  another group under the round barrier (``ps_rpc`` owns the
+  install/commit protocol and its kill-fencing); the router then
+  learns the bumped map ATOMICALLY at the next barrier (every shard's
+  phase-1 ack carries the server's ``shard_map``) or lazily via
+  ``wrong_shard`` redirects — a redirected rpc's token was never
+  recorded at the old owner, so the reissue at the new owner stays
+  exactly-once. ``shard_of`` consults the version-highest override
+  before the hash. A relaunched trainer starts back at version 0 and
+  self-repairs through the same redirects.
 """
 from __future__ import annotations
 
@@ -40,7 +52,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from .ps_rpc import PSClient
+from .ps_rpc import PSClient, WrongShard
 
 __all__ = ["shard_for_key", "shard_for_rows", "row_range",
            "split_endpoint_groups", "ShardedPSClient",
@@ -124,11 +136,18 @@ class ShardedPSClient:
         if not shard_endpoints:
             raise ValueError("ShardedPSClient needs >= 1 shard group")
         self._trainer_id = trainer_id
+        self._shard_endpoints = [str(e) for e in shard_endpoints]
+        # live-migration shard map (ISSUE 13): version 0 = pure hash;
+        # overrides learned from barrier acks / wrong_shard redirects
+        self._map_lock = threading.Lock()
+        self.map_version = 0
+        self.map_overrides: Dict[str, int] = {}
         self.shards: List[PSClient] = []
         for eps in shard_endpoints:
             c = PSClient(eps, trainer_id=trainer_id, **client_kw)
             # phase 2 of the round barrier belongs to THIS router
             c._defer_barrier_commit = True
+            c._map_version_hint = 0
             self.shards.append(c)
 
     @property
@@ -136,18 +155,70 @@ class ShardedPSClient:
         return len(self.shards)
 
     def shard_of(self, name: str) -> int:
+        base = name.split("@", 1)[0]
+        with self._map_lock:
+            ov = self.map_overrides.get(base)
+        if ov is not None:
+            return int(ov)
         return shard_for_key(name, self.nshards)
 
     def client_for(self, name: str) -> PSClient:
         return self.shards[self.shard_of(name)]
 
+    def apply_shard_map(self, payload) -> None:
+        """Adopt a server-advertised shard map if it is newer than
+        ours (version-monotonic; barrier acks and wrong_shard
+        redirects both land here)."""
+        if not isinstance(payload, dict):
+            return
+        ver = int(payload.get("version", 0))
+        with self._map_lock:
+            if ver <= self.map_version:
+                return
+            self.map_version = ver
+            self.map_overrides = {
+                str(n): int(s)
+                for n, s in (payload.get("overrides") or {}).items()}
+        for c in self.shards:
+            # every rpc now carries the adopted version (``mv``): a
+            # recipient holding a STAGED var commits it only for a
+            # client that provably saw the donor's map bump
+            c._map_version_hint = ver
+
+    def _routed(self, name: str, fn):
+        """Run ``fn(client)`` against the var's owner, re-routing once
+        per ``wrong_shard`` redirect (bounded by the shard count — a
+        map can't cycle: versions only grow)."""
+        for _ in range(self.nshards + 1):
+            try:
+                return fn(self.client_for(name))
+            except WrongShard as e:
+                self.apply_shard_map(e.shard_map)
+        raise RuntimeError(
+            "var %r still redirected after %d wrong_shard hops "
+            "(map version %d)" % (name, self.nshards + 1,
+                                  self.map_version))
+
+    def migrate(self, name: str, to_shard: int) -> dict:
+        """Live-migrate ``name``'s key range to ``to_shard``'s group
+        (executes at the donor's next round barrier; see ps_rpc)."""
+        to_shard = int(to_shard)
+        if not 0 <= to_shard < self.nshards:
+            raise ValueError("to_shard %d out of range (nshards=%d)"
+                             % (to_shard, self.nshards))
+        return self._routed(
+            name, lambda c: c.migrate(
+                name, to_shard, self._shard_endpoints[to_shard]))
+
     # -- dense path -------------------------------------------------------
 
-    def send_grad(self, name: str, value) -> None:
-        self.client_for(name).send_grad(name, value)
+    def send_grad(self, name: str, value,
+                  round: Optional[int] = None) -> None:
+        self._routed(name,
+                     lambda c: c.send_grad(name, value, round=round))
 
     def get_param(self, name: str) -> np.ndarray:
-        return self.client_for(name).get_param(name)
+        return self._routed(name, lambda c: c.get_param(name))
 
     def _all_shards(self, fn, what: str) -> List:
         """Run ``fn(client)`` on every shard in parallel and return
@@ -176,14 +247,22 @@ class ShardedPSClient:
                 raise e
         return results
 
-    def send_barrier(self) -> None:
+    def send_barrier(self, round: Optional[int] = None) -> None:
         """Two-phase round barrier: every shard must ack (apply +
         replicate) its round before ANY shard's replay log drops it —
         a single shard's death mid-round loses nothing and
-        double-applies nothing."""
-        self._all_shards(lambda c: c.barrier_prepare(), "prepare")
+        double-applies nothing. Phase-1 acks may carry a bumped
+        ``shard_map`` (a migration rode this round's barrier): every
+        trainer adopts it HERE, before any round-N+1 traffic — the
+        atomic map-version bump of ISSUE 13. ``round`` stamps the
+        training round for the stale-round eviction guard."""
+        resps = self._all_shards(
+            lambda c: c.barrier_prepare(round=round), "prepare")
         for c in self.shards:
             c.barrier_commit()
+        for r in resps:
+            if isinstance(r, dict) and r.get("shard_map"):
+                self.apply_shard_map(r["shard_map"])
 
     def fetch_barrier(self) -> None:
         self._all_shards(lambda c: c.fetch_barrier(), "fetch")

@@ -27,11 +27,29 @@ Role from PADDLE_ROLE (the launch supervisor sets it) or FT_ROLE:
   ``checkpoint.shards_reused`` are exercised end to end), resuming
   from the newest valid checkpoint on restart. FT_DIE_AT_ROUND +
   FT_DIE_RANK make one rank SIGKILL itself mid-round (after
-  send_grad, before the barrier) on its first incarnation.
+  send_grad, before the barrier) on its first incarnation; with
+  FT_DIE_MODE=partial_barrier it instead dies AFTER its phase-1
+  barrier reached shard 0 only (the per-shard fanin-disagreement
+  drill). FT_RESTART_DELAY makes a relaunched incarnation sleep
+  before reconnecting (pins eviction races in drills). Every
+  send_grad/send_barrier is stamped with the TRAINING round so a
+  shard that already applied it (eviction) answers stale_round
+  instead of contaminating the next round.
   PSERVER_ENDPOINT may be a comma-separated endpoint list (PSClient
   fails over along it); with PADDLE_PSERVER_SHARDS > 1 the trainer
   routes through ps_shard.client_from_env and runs the TWO-PHASE
-  round barrier across shards.
+  round barrier across shards. FT_MIGRATE_AT_ROUND > 0 makes
+  trainer 0 trigger a LIVE MIGRATION of shard FT_MIGRATE_FROM_SHARD's
+  var to FT_MIGRATE_TO_SHARD after that round's fetch barrier
+  (re-triggered two rounds later if the shard map never bumped —
+  the donor may have been killed mid-migration; that is the drill).
+- ``witness`` — a ``PSWitness`` quorum endpoint on PSERVER_ENDPOINT
+  (no parameter state; every shard's primaries renew with it via
+  PADDLE_PS_WITNESSES).
+
+FT_EVICT_SHARD (pserver side): arm PADDLE_PS_EVICT_AFTER only on
+that shard's servers — the sharded eviction drill's disagreeing
+effective fanin.
 
 Env contract: PSERVER_ENDPOINT, PADDLE_TRAINER_ID (the launcher sets
 it), PADDLE_RESTART_COUNT (launcher, on relaunch), FT_OUT (result JSON
@@ -46,11 +64,13 @@ import json
 import os
 import signal
 import sys
+import time
 
 import numpy as np
 
-from paddle_tpu.checkpoint import CheckpointManager
-from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+from paddle_tpu.checkpoint import CheckpointManager, manifest_extra
+from paddle_tpu.distributed.ps_rpc import (PSClient, PSServer,
+                                           PSWitness)
 from paddle_tpu.distributed.ps_shard import (client_from_env,
                                              shard_for_key)
 
@@ -117,6 +137,11 @@ def _ballast() -> np.ndarray:
     return np.zeros(max(0, n), dtype=np.float32)
 
 
+def run_witness():
+    w = PSWitness(os.environ["PSERVER_ENDPOINT"])
+    w.serve_forever()
+
+
 def run_pserver():
     endpoints_raw = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
     endpoints = [e.strip() for e in endpoints_raw.split(",")
@@ -132,6 +157,14 @@ def run_pserver():
     index = endpoints.index(endpoint) if endpoint in endpoints else 0
     nshards = _nshards()
     my_shard = int(os.environ.get("PADDLE_PSERVER_SHARD", "0"))
+    evict_shard = os.environ.get("FT_EVICT_SHARD")
+    evict_after = None
+    if evict_shard is not None and evict_shard != "":
+        # sharded eviction drill: only ONE shard's servers arm the
+        # heartbeat monitor — per-shard effective fanin disagreeing
+        # mid-round is exactly the case under test
+        evict_after = (float(os.environ.get("FT_EVICT_AFTER", "1.0"))
+                       if my_shard == int(evict_shard) else 0.0)
 
     scope = MiniScope()
     grad_to_block = {}
@@ -164,7 +197,13 @@ def run_pserver():
 
     server = PSServer(endpoint, MiniExec(), scope, grad_to_block,
                       fanin=fanin, sync_mode=True,
-                      endpoints=endpoints or None, rejoin=rejoin)
+                      endpoints=endpoints or None, rejoin=rejoin,
+                      evict_after=evict_after,
+                      # a live migration ships state, never code: the
+                      # recipient rebuilds the optimize block for an
+                      # adopted var from the shared definition
+                      block_factory=lambda g: _sgd_block_for(
+                          g.split("@", 1)[0]))
     server.serve_forever()
     server.stop()
 
@@ -176,6 +215,15 @@ def run_trainer():
     restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
     die_round = int(os.environ.get("FT_DIE_AT_ROUND", "0"))
     die_rank = int(os.environ.get("FT_DIE_RANK", "-1"))
+    die_mode = os.environ.get("FT_DIE_MODE", "")
+    migrate_round = int(os.environ.get("FT_MIGRATE_AT_ROUND", "0"))
+    migrate_from = int(os.environ.get("FT_MIGRATE_FROM_SHARD", "0"))
+    migrate_to = int(os.environ.get("FT_MIGRATE_TO_SHARD", "1"))
+    if restart > 0:
+        # drills that pin an eviction race: the relaunched
+        # incarnation must come back AFTER the evicting shard's
+        # monitor fired, or the drill's oracle would be racy
+        time.sleep(float(os.environ.get("FT_RESTART_DELAY", "0")))
     # per-rank result file: the launcher gives every rank the same env
     out_path = "%s.t%d.json" % (os.environ["FT_OUT"], tid)
     ckpt_root = os.environ.get("FT_CKPT_ROOT", "")
@@ -186,6 +234,7 @@ def run_trainer():
     mgr = None
     start = 1
     resumed_from = None
+    resumed_map = None
     if ckpt_root:
         mgr = CheckpointManager(os.path.join(ckpt_root, "t%d" % tid),
                                 keep=3)
@@ -194,39 +243,81 @@ def run_trainer():
         def _load(d):
             data = np.load(os.path.join(d, "state.npz"))
             state["w"] = data["w"]
+            # advisory routing state: the shard map this incarnation's
+            # predecessor had adopted (checkpoint.manifest_extra)
+            state["shard_map"] = manifest_extra(d).get("shard_map")
 
         step = mgr.load_latest(_load)
         if step is not None:
             resumed_from = step
             start = step + 1
+            resumed_map = state.get("shard_map")
             print("[trainer %d] resumed from checkpoint round %d"
                   % (tid, step), file=sys.stderr, flush=True)
 
     if nshards > 1:
         client = client_from_env(trainer_id=tid)
+        if resumed_map:
+            # resume ROUTING too: migrations the dead incarnation saw
+            # apply immediately instead of via wrong_shard redirects
+            client.apply_shard_map(resumed_map)
     else:
         client = PSClient.for_endpoint(endpoint, trainer_id=tid)
     ws = {}
     for rnd in range(start, rounds + 1):
         for vi, name in enumerate(names):
-            client.send_grad(name + "@GRAD", grad_for(tid, rnd, vi))
+            client.send_grad(name + "@GRAD", grad_for(tid, rnd, vi),
+                             round=rnd)
         if restart == 0 and tid == die_rank and rnd == die_round:
-            # mid-round death: grad in, barrier never sent — the
-            # worst spot, the server is left waiting on this rank
+            if die_mode == "partial_barrier" and nshards > 1:
+                # phase-1 barrier reached shard 0 ONLY, then death:
+                # shard 0 can apply the round with this trainer in,
+                # the sister shard cannot — the per-shard effective
+                # fanin disagreement the eviction drill reconciles
+                client.shards[0].barrier_prepare(round=rnd)
+            # mid-round death: grad in, barrier never (fully) sent —
+            # the worst spot, servers are left waiting on this rank
             os.kill(os.getpid(), signal.SIGKILL)
-        client.send_barrier()
+        client.send_barrier(round=rnd)
         ws = {name: client.get_param(name) for name in names}
         client.fetch_barrier()
+        if (migrate_round and tid == 0 and nshards > 1
+                and (rnd == migrate_round
+                     or (rnd >= migrate_round + 2
+                         and getattr(client, "map_version", 1) == 0))):
+            # live migration rides the NEXT round's barrier; the
+            # re-trigger two rounds later covers a donor killed
+            # mid-migration before the intent ever replicated (the
+            # rollback path the --migrate chaos drill drills)
+            try:
+                client.migrate(names[migrate_from], migrate_to)
+                print("[trainer %d] requested migration of %s -> "
+                      "shard %d at round %d" % (tid,
+                                                names[migrate_from],
+                                                migrate_to, rnd),
+                      file=sys.stderr, flush=True)
+            except (RuntimeError, OSError) as e:
+                print("[trainer %d] migrate request failed (will "
+                      "retry): %s" % (tid, e), file=sys.stderr,
+                      flush=True)
         if mgr is not None:
             buf = io.BytesIO()
             np.savez(buf, w=ws[names[0]], round=rnd,
                      **{"v_%s" % n: w for n, w in ws.items()})
             # the static ballast shard is fingerprint-reused: the
-            # incremental save writes only what changed this round
+            # incremental save writes only what changed this round.
+            # The adopted shard map rides the manifest (advisory) so
+            # a relaunched incarnation resumes routing with it.
+            extra = None
+            if nshards > 1 and getattr(client, "map_version", 0):
+                extra = {"shard_map": {
+                    "version": client.map_version,
+                    "overrides": dict(client.map_overrides)}}
             mgr.save_incremental(
                 rnd, {"state.npz": buf.getvalue(),
                       "ballast.bin": ballast_bytes},
-                fingerprints={"ballast.bin": "static-v1"})
+                fingerprints={"ballast.bin": "static-v1"},
+                extra=extra)
 
     if nshards > 1:
         hbs = client.heartbeat_full()  # per shard, index-aligned
@@ -273,6 +364,13 @@ def run_trainer():
             "server_promotions": sum(
                 h.get("promotions") or 0 for h in hbs),
             "shards": shard_info,
+            # live-migration telemetry: the router's adopted map and
+            # the servers' own view of it (drill-gated)
+            "map_version": getattr(client, "map_version", 0),
+            "map_overrides": getattr(client, "map_overrides", {}),
+            "server_map_versions": [
+                (h.get("shard_map") or {}).get("version", 0)
+                for h in hbs],
         }, f)
 
 
@@ -282,6 +380,8 @@ def main():
         run_pserver()
     elif role == "trainer":
         run_trainer()
+    elif role == "witness":
+        run_witness()
     else:
         raise SystemExit("unknown FT_ROLE %r" % role)
 

@@ -278,6 +278,22 @@ def _must_not_run():
     raise AssertionError("fingerprint-matched shard was produced")
 
 
+def test_manifest_extra_roundtrips_shard_map(tmp_path):
+    """A trainer checkpoints its adopted shard map as manifest
+    ``extra``; ``manifest_extra`` hands it back (advisory — an
+    unreadable manifest degrades to {}, never an error)."""
+    from paddle_tpu.checkpoint import CheckpointManager, manifest_extra
+
+    mgr = CheckpointManager(str(tmp_path / "c"), keep=2)
+    smap = {"version": 2, "overrides": {"w0": 1}}
+    mgr.save_incremental(1, {"state.bin": b"x"},
+                         extra={"shard_map": smap})
+    d = mgr.dir_for(1)
+    got = manifest_extra(d)
+    assert got.get("shard_map") == smap
+    assert manifest_extra(str(tmp_path / "nope")) == {}
+
+
 # -- lease + quorum promotion ------------------------------------------------
 
 
@@ -657,6 +673,414 @@ def test_sharded_sparse_row_range_pull_push(monkeypatch):
             s.stop()
 
 
+# -- external quorum witness (ISSUE 13) --------------------------------------
+
+
+def test_witness_blocks_forged_tombstones_then_allows_real_death(
+        monkeypatch):
+    """The N>=3 forged-tombstone corner: every group peer of the
+    candidate answers connection-REFUSED (forgeable positive-death
+    evidence) while the primary is ALIVE and still renewing with the
+    witness — the witness denies, so the election must fail. Stop the
+    primary for real and the witness's lease view expires: the next
+    election wins on a genuine witness grant."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import ps_rpc
+    from paddle_tpu.distributed.ps_rpc import PSWitness
+
+    _fast_env(monkeypatch)
+    eps = _eps(3)
+    wit_ep = _eps(1)[0]
+    witness = PSWitness(wit_ep)
+    witness.start_background()
+    real_bare = ps_rpc._bare_rpc
+
+    def forged(endpoint, msg, timeout=1.0):
+        if endpoint == wit_ep:
+            return real_bare(endpoint, msg, timeout)
+        if msg.get("kind") in ("vote", "lease_renew") \
+                and msg.get("candidate") == eps[1]:
+            # only the CANDIDATE's probes see forged refusals; the
+            # primary's own renewals to the group stay real
+            raise ConnectionRefusedError("forged tombstone")
+        return real_bare(endpoint, msg, timeout)
+
+    s0, _ = _mk_ps(eps, 0, lease_ms=300, witnesses=[wit_ep])
+    s1, _ = _mk_ps(eps, 1, lease_ms=300, witnesses=[wit_ep])
+    s2, _ = _mk_ps(eps, 2, lease_ms=300, witnesses=[wit_ep])
+    v0 = obs.counter_value("ps.witness_votes", shard="0") or 0
+    try:
+        time.sleep(0.5)  # renewals reach group + witness
+        # backup 1's lease view never refreshes; its group probes are
+        # forged-refused — only the witness answers honestly
+        monkeypatch.setattr(s1, "_refresh_lease_locked",
+                            lambda epoch: None)
+        monkeypatch.setattr(ps_rpc, "_bare_rpc", forged)
+        s1._lease_deadline = time.monotonic() - 1.0
+        deadline = time.time() + 2.0
+        while time.time() < deadline:
+            assert not s1._promoted, \
+                "forged tombstones elected a backup under a live " \
+                "primary despite the witness"
+            time.sleep(0.05)
+        assert (obs.counter_value("ps.witness_votes", shard="0")
+                or 0) > v0, "the election never consulted the witness"
+        # now the primary REALLY dies: renewals to the witness stop,
+        # its lease view expires, the grant flows, promotion happens
+        monkeypatch.setattr(ps_rpc, "_bare_rpc", real_bare)
+        s0.stop()
+        deadline = time.time() + 6.0
+        while not (s1._promoted or s2._promoted) \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert s1._promoted or s2._promoted, \
+            "real death + expired witness view must still promote"
+    finally:
+        s0.stop()
+        s1.stop()
+        s2.stop()
+        witness.stop()
+
+
+def test_vote_regrant_same_candidate_survives_lost_reply(monkeypatch):
+    """votedFor semantics (found by the --migrate drill under an
+    injected reply drop): a voter — group peer or witness — that
+    granted an epoch must RE-GRANT the same epoch to the SAME
+    candidate, or a lost grant reply burns the epoch and livelocks
+    every election retry. A rival at the consumed epoch stays
+    denied."""
+    from paddle_tpu.distributed.ps_rpc import PSWitness
+
+    _fast_env(monkeypatch)
+    eps = _eps(2)
+    s0, _ = _mk_ps(eps, 0, lease_ms=300)
+    s1, _ = _mk_ps(eps, 1, lease_ms=300)
+    try:
+        s1._lease_deadline = time.monotonic() - 1.0  # expired voter
+        vote = {"kind": "vote", "epoch": 1, "cand_round": 99,
+                "candidate": "cand:A"}
+        r1, _ = s1._handle(dict(vote), b"")
+        assert r1["granted"]
+        r2, _ = s1._handle(dict(vote), b"")  # grant reply "lost"
+        assert r2["granted"], "re-vote by the promise holder denied"
+        rb, _ = s1._handle(dict(vote, candidate="cand:B"), b"")
+        assert not rb["granted"], "rival stole a consumed epoch"
+        r3, _ = s1._handle(dict(vote, epoch=2,
+                                candidate="cand:B"), b"")
+        assert r3["granted"], "higher epoch must still win the voter"
+    finally:
+        s0.stop()
+        s1.stop()
+
+    w = PSWitness(_eps(1)[0])
+    try:
+        wvote = {"kind": "vote", "epoch": 1, "shard": "7",
+                 "lease_ms": 50, "candidate": "cand:A"}
+        w._shard_state_locked("7", 50)["deadline"] = \
+            time.monotonic() - 1.0
+        g1, _ = w._handle(dict(wvote), b"")
+        assert g1["granted"]
+        w._state["7"]["deadline"] = time.monotonic() - 1.0
+        g2, _ = w._handle(dict(wvote), b"")
+        assert g2["granted"], "witness re-vote denied after lost reply"
+        gb, _ = w._handle(dict(wvote, candidate="cand:B"), b"")
+        assert not gb["granted"]
+    finally:
+        w.stop()
+
+
+# -- clock-jitter chaos (ISSUE 13) -------------------------------------------
+
+
+def test_clock_jitter_rule_parses_and_is_deterministic():
+    import random as _random
+
+    from paddle_tpu.distributed import fault
+
+    rules = fault.parse_plan("clock_jitter:0.5:600,send.drop:0.1")
+    assert rules[0].kind == "clock_jitter" and rules[0].param == 600.0
+    with pytest.raises(ValueError, match="magnitude"):
+        fault.parse_plan("clock_jitter:0.5")
+    # repr round-trips
+    assert fault.parse_plan(repr(rules[0]))[0].param == 600.0
+    # per-process skew: seeded by (seed x identity), reproducible,
+    # different identities wander differently
+    prev = fault.get_identity()
+    try:
+        fault.set_identity("a:1")
+        i1 = fault.FaultInjector(
+            fault.parse_plan("clock_jitter:0:500"), seed=3)
+        i2 = fault.FaultInjector(
+            fault.parse_plan("clock_jitter:0:500"), seed=3)
+        assert i1.clock_skew_s() == i2.clock_skew_s()
+        assert abs(i1.clock_skew_s()) <= 0.5
+        fault.set_identity("b:2")
+        i3 = fault.FaultInjector(
+            fault.parse_plan("clock_jitter:0:500"), seed=3)
+        assert i3.clock_skew_s() != i1.clock_skew_s()
+    finally:
+        fault.set_identity(prev)
+    # random_plan wiring: appended after the legacy draws
+    base = fault.random_plan(_random.Random(11))
+    withj = fault.random_plan(_random.Random(11), clock_jitter_ms=300)
+    assert withj.startswith(base) and "clock_jitter:0.5:300" in withj
+    fault.parse_plan(withj)
+    # frame faults are untouched by a jitter-only plan
+    inj = fault.FaultInjector(fault.parse_plan("clock_jitter:1:100"))
+    assert not inj.rules and not inj.partitions
+    assert len(inj.clock_rules) == 1
+
+
+def test_clock_jitter_2x_lease_never_splits_the_brain(monkeypatch):
+    """±2x-lease clock jitter on every participant: the backup's
+    lease view may expire spuriously, but its elections stay
+    quorum-gated (the live primary denies; in a 2-group no rival
+    quorum can form without it) — no promotion, exactly one writable
+    primary, training bit-for-bit."""
+    from paddle_tpu.distributed import fault
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_env(monkeypatch)
+    monkeypatch.setenv("PADDLE_TPU_FAULTS", "clock_jitter:0.5:600")
+    monkeypatch.setenv("PADDLE_TPU_FAULT_SEED", "9")
+    fault.reset_injector()
+    eps = _eps(2)
+    try:
+        s0, sc0 = _mk_ps(eps, 0, lease_ms=300)
+        s1, _ = _mk_ps(eps, 1, lease_ms=300)
+        try:
+            c = PSClient(",".join(eps), trainer_id=0)
+            w = None
+            for rnd in range(1, 7):
+                c.send_grad("w@GRAD", _grad(0, rnd), round=rnd)
+                c.send_barrier(round=rnd)
+                w = c.get_param("w")
+                c.fetch_barrier()
+                assert s0._active_role() and not s1._promoted, \
+                    "jitter alone promoted a backup under a live " \
+                    "primary"
+                time.sleep(0.15)
+            exp = {"w": np.zeros(4, "f4")}
+            for rnd in range(1, 7):
+                exp["w@GRAD"] = _grad(0, rnd)
+                _sgd_block(exp)
+            assert w.tobytes() == exp["w"].tobytes()
+            assert (fault.get_injector() is not None
+                    and fault.get_injector().clock_rules)
+            c.close()
+        finally:
+            s0.stop()
+            s1.stop()
+    finally:
+        fault.reset_injector()
+
+
+# -- sharded eviction: disagreeing per-shard fanin (ISSUE 13) ----------------
+
+
+def test_stale_round_guard_drops_resent_applied_round(monkeypatch):
+    """A fresh incarnation re-running a TRAINING round the server
+    already applied (its dead predecessor's barrier closed it) must
+    be dropped — grads NOT folded into the next round, barriers NOT
+    pre-paying the next fanin."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_env(monkeypatch)
+    eps = _eps(1)
+    s, scope = _mk_ps(eps, 0, fanin=1)
+    st0 = obs.counter_value("ps.stale_rounds") or 0
+    try:
+        c1 = PSClient(eps[0], trainer_id=0)
+        for rnd in (1, 2):
+            c1.send_grad("w@GRAD", _grad(0, rnd), round=rnd)
+            c1.send_barrier(round=rnd)
+            c1.get_param("w")
+            c1.fetch_barrier()
+        c1.close()  # incarnation 1 "dies" after round 2 applied
+        c2 = PSClient(eps[0], trainer_id=0)  # fresh cid, resumes at 2
+        c2.send_grad("w@GRAD", _grad(0, 2), round=2)
+        c2.send_barrier(round=2)  # both stale: dropped, not counted
+        assert (obs.counter_value("ps.stale_rounds") or 0) >= st0 + 2
+        assert s._applied_round == 2 and not s._pending
+        c2.send_grad("w@GRAD", _grad(0, 3), round=3)
+        c2.send_barrier(round=3)
+        w = c2.get_param("w")
+        c2.fetch_barrier()
+        c2.close()
+        exp = {"w": np.zeros(4, "f4")}
+        for rnd in (1, 2, 3):
+            exp["w@GRAD"] = _grad(0, rnd)
+            _sgd_block(exp)
+        assert w.tobytes() == exp["w"].tobytes(), \
+            "stale-round resend leaked into a later round"
+    finally:
+        s.stop()
+
+
+def test_sharded_eviction_disagreeing_fanin_reconciles(monkeypatch):
+    """The drill case, in-process and fully pinned: trainer 1's
+    round-1 grads reach BOTH shards but its phase-1 barrier reaches
+    shard A only, then it dies. A (no eviction) applies round 1 with
+    t1's barrier; B (evicting) evicts t1 and applies round 1 too —
+    with t1's PENDING grads, so round 1 is complete everywhere. The
+    disagreement bites at round 2: B (fanin shrunk to 1) applies it
+    with t0 alone while A waits; the relaunched incarnation re-runs
+    rounds 1-2 — stale-DROPPED exactly where they already applied —
+    and genuinely contributes where they did not. Deterministic
+    oracles: shard A's var = full 2-trainer history; shard B's var =
+    full minus t1's round-2 grad; round 3 complete on both (t1
+    re-admitted, fanin restored). Without the stale-round guard,
+    t1's re-sent round-1 barrier would pre-pay B's round-3 fanin and
+    apply it with a stale grad mix."""
+    import threading as _threading
+
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_env(monkeypatch)
+    names = _shard_var_names(2)
+    epA, epB = _eps(1), _eps(1)
+    rounds = 3
+
+    def mk(eps_one, name, evict_after):
+        from paddle_tpu.distributed.ps_rpc import PSServer
+
+        scope = MiniScope()
+        scope[name] = np.zeros(4, dtype=np.float32)
+        s = PSServer(eps_one[0], MiniExec(), scope,
+                     {name + "@GRAD": _sgd_factory(name + "@GRAD")},
+                     fanin=2, endpoints=eps_one,
+                     evict_after=evict_after)
+        s.start_background()
+        return s, scope
+
+    sA, scA = mk(epA, names[0], evict_after=0.0)   # never evicts
+    sB, scB = mk(epB, names[1], evict_after=0.6)   # evicts t1
+    servers = [sA, sB]
+
+    def t0_loop(out):
+        cA = PSClient(epA[0], trainer_id=0)
+        cB = PSClient(epB[0], trainer_id=0)
+        for rnd in range(1, rounds + 1):
+            cA.send_grad(names[0] + "@GRAD", _grad(0, rnd), round=rnd)
+            cB.send_grad(names[1] + "@GRAD", _grad(0, rnd), round=rnd)
+
+            def barrier(c, rnd=rnd):
+                c.send_barrier(round=rnd)
+            tb = [_threading.Thread(target=barrier, args=(c,))
+                  for c in (cA, cB)]
+            for t in tb:
+                t.start()
+            for t in tb:
+                t.join(timeout=30)
+            out[names[0]] = cA.get_param(names[0])
+            out[names[1]] = cB.get_param(names[1])
+            cA.fetch_barrier()
+            cB.fetch_barrier()
+        cA.close()
+        cB.close()
+
+    # incarnation 1 of t1: grads to BOTH shards, barrier to A ONLY
+    c1A = PSClient(epA[0], trainer_id=1)
+    c1B = PSClient(epB[0], trainer_id=1)
+    c1A.send_grad(names[0] + "@GRAD", _grad(1, 1), round=1)
+    c1B.send_grad(names[1] + "@GRAD", _grad(1, 1), round=1)
+    out = {}
+    t0 = _threading.Thread(target=t0_loop, args=(out,))
+    t0.start()
+    barA = _threading.Thread(
+        target=lambda: c1A.send_barrier(round=1))
+    barA.start()
+    barA.join(timeout=20)  # A applies round 1 with BOTH trainers
+    c1A.close()
+    c1B.close()  # t1 dead; B must evict it to finish round 1
+
+    def t1_incarnation2():
+        time.sleep(1.2)  # past B's eviction window
+        cA = PSClient(epA[0], trainer_id=1)
+        cB = PSClient(epB[0], trainer_id=1)
+        for rnd in range(1, rounds + 1):  # re-runs round 1 (stale)
+            cA.send_grad(names[0] + "@GRAD", _grad(1, rnd), round=rnd)
+            cB.send_grad(names[1] + "@GRAD", _grad(1, rnd), round=rnd)
+            tb = [_threading.Thread(
+                target=lambda c=c, r=rnd: c.send_barrier(round=r))
+                for c in (cA, cB)]
+            for t in tb:
+                t.start()
+            for t in tb:
+                t.join(timeout=30)
+            cA.get_param(names[0])
+            cB.get_param(names[1])
+            cA.fetch_barrier()
+            cB.fetch_barrier()
+        cA.close()
+        cB.close()
+
+    t1v2 = _threading.Thread(target=t1_incarnation2)
+    t1v2.start()
+    t0.join(timeout=60)
+    t1v2.join(timeout=60)
+    try:
+        assert not t0.is_alive() and not t1v2.is_alive(), \
+            "reconciliation deadlocked"
+        # shard A: every round had both trainers
+        expA = np.zeros(4, dtype=np.float32)
+        for rnd in range(1, rounds + 1):
+            expA = expA - np.float32(0.1) * (_grad(0, rnd)
+                                             + _grad(1, rnd))
+        np.testing.assert_array_equal(np.asarray(scA[names[0]]), expA)
+        # shard B: round 1 complete (t1's grads were pending when the
+        # eviction applied it); round 2 sailed with t0 only; round 3
+        # complete again (t1 re-admitted). The stale resends of
+        # rounds 1-2 were dropped, never mixed into round 3.
+        expB = np.zeros(4, dtype=np.float32)
+        for rnd in range(1, rounds + 1):
+            tot = _grad(0, rnd) if rnd == 2 \
+                else _grad(0, rnd) + _grad(1, rnd)
+            expB = expB - np.float32(0.1) * tot
+        np.testing.assert_array_equal(np.asarray(scB[names[1]]), expB)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# -- GB-scale measurement harness (ISSUE 13) ---------------------------------
+
+
+def test_ps_scale_bench_smoke(tmp_path):
+    """The measurement harness end to end (smoke table): incremental
+    digesting strictly cheaper than full re-hash per round, delta
+    bytes under 1% of the anchor, bench_diff-compatible record."""
+    import subprocess
+    import sys as _sys
+
+    out = str(tmp_path / "ps_scale.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_FAULTS", None)
+    r = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "tools",
+                                       "ps_scale_bench.py"),
+         "--smoke", "--rounds", "3", "--out", out],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    import json
+
+    rec = json.load(open(out))
+    cfg = rec["configs"]["ps_scale"]
+    assert cfg["ps_digest_ms"] < cfg["ps_digest_full_ms"]
+    assert 0 < cfg["repl_delta_bytes_per_round"] \
+        < 0.01 * cfg["repl_anchor_bytes"]
+    assert cfg["rounds_per_s"] > 0
+    # the record diffs cleanly through the perf gate
+    r2 = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "tools",
+                                       "bench_diff.py"), out, out],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "ps_digest_ms" in r2.stdout
+
+
 # -- the partition fault primitive -------------------------------------------
 
 
@@ -755,3 +1179,588 @@ def test_chaos_schedule_deterministic_for_sharded_modes():
     assert legacy["plan"] == a["plan"]
     assert legacy["trainer_kill_round"] == a["trainer_kill_round"]
     assert legacy["partition_shard"] is None
+    # ISSUE 13 modes: deterministic, legacy-draw-compatible
+    m = chaos_drill.make_schedule(77, 8, shards=2, migrate=True)
+    assert m == chaos_drill.make_schedule(77, 8, shards=2,
+                                          migrate=True)
+    assert m["migrate_from"] == m["die_shard"]
+    assert m["migrate_to"] == (m["die_shard"] + 1) % 2
+    assert 1 <= m["migrate_round"] <= 4
+    assert m["plan"] == chaos_drill.make_schedule(77, 8,
+                                                  shards=2)["plan"]
+    e = chaos_drill.make_schedule(77, 6, shards=2, evict=True)
+    assert e == chaos_drill.make_schedule(77, 6, shards=2, evict=True)
+    assert e["evict_shard"] == 1
+    assert e["trainer_kill_round"] <= 4
+
+
+# -- chunk-level + incremental digests (ISSUE 13) ----------------------------
+
+
+def _plan_server(eps, scope_vars, **kw):
+    """A PSServer whose _replication_plan we drive directly (no
+    backups — planning is pure given the scope + dirty state)."""
+    from paddle_tpu.distributed.ps_rpc import PSServer
+
+    scope = MiniScope()
+    scope.update(scope_vars)
+    s = PSServer(eps[0], MiniExec(), scope, {}, fanin=1,
+                 endpoints=[eps[0]], **kw)
+    return s, scope
+
+
+def _prime(server):
+    """First plan = anchor; adopt its digests as the shipped state."""
+    arrays = server._scope_arrays()
+    mode, items, digests = server._replication_plan(arrays)
+    assert mode == "full"
+    server._shipped_digests = digests
+    server._dirty_rows.clear()
+    server._dirty_dense.clear()
+    server._applied_round += 1  # off the anchor cadence
+    return digests
+
+
+def _plan_bytes(items):
+    return sum(a.nbytes for _, a, _ in items)
+
+
+def test_one_row_update_to_256mb_var_ships_under_one_percent():
+    """The ISSUE-13 acceptance bound: a single-row touch of a >=256MB
+    dense var ships < 1% of the full-var bytes — via a row slice when
+    the rows are known, via CHUNK slices when only the digest knows
+    (dense-dirty, rows lost)."""
+    from paddle_tpu.distributed import ps_rpc
+
+    height, width = 262144, 256  # 256 MiB float32
+    big = np.zeros((height, width), dtype=np.float32)
+    s, scope = _plan_server(_eps(1), {"big": big},
+                            anchor_every=1000000)
+    try:
+        _prime(s)
+        full_bytes = big.nbytes
+        # rows-known path (push_sparse tracked the touch)
+        scope["big"][12345, :] = 7.0
+        s._dirty_rows["big"] = {12345}
+        mode, items, digests = s._replication_plan(s._scope_arrays())
+        assert mode == "delta"
+        assert items and items[0][2] == {"rows": [12345]}
+        assert _plan_bytes(items) < 0.01 * full_bytes
+        s._shipped_digests = digests
+        s._dirty_rows.clear()
+        # rows-UNKNOWN path (dense-dirty): the chunk digests localize
+        # the change to one chunk of the flat stream
+        scope["big"][200000, :] = 9.0
+        s._dirty_dense.add("big")
+        mode, items, digests = s._replication_plan(s._scope_arrays())
+        assert mode == "delta"
+        assert items and "chunk" in (items[0][2] or {})
+        shipped = _plan_bytes(items)
+        assert shipped < 0.01 * full_bytes, shipped
+        ce = ps_rpc._chunk_elems_for(big)
+        assert shipped <= 2 * ce * 4  # ~one chunk (straddle-safe)
+    finally:
+        s.stop()
+
+
+def test_chunk_boundary_straddling_dirty_row():
+    """A dirty row whose byte range straddles a chunk boundary must
+    re-hash and ship BOTH chunks; the backup splice must be
+    bit-for-bit."""
+    import paddle_tpu.distributed.ps_rpc as ps_rpc
+
+    rows = ps_rpc._chunks_for_rows(
+        [1], np.zeros((4, 6), "f4"), 8)  # row 1 = elems 6..11
+    assert rows == {0, 1}
+    assert ps_rpc._chunks_for_rows([0], np.zeros((4, 6), "f4"), 8) \
+        == {0}
+    assert ps_rpc._chunks_for_rows([3], np.zeros((4, 6), "f4"), 8) \
+        == {2}
+
+    # end to end with a tiny chunk size: the straddled update ships
+    # two chunk slices and the backup matches bit-for-bit
+    prev = os.environ.pop("PADDLE_PS_DIGEST_CHUNK_MB", None)
+    os.environ["PADDLE_PS_DIGEST_CHUNK_MB"] = str(32 / (1 << 20))
+    try:
+        tbl = np.arange(24, dtype=np.float32).reshape(4, 6)
+        s, scope = _plan_server(_eps(1), {"t": tbl.copy()},
+                                anchor_every=1000000)
+        try:
+            d0 = _prime(s)
+            assert len(d0["t"]["chunks"]) == 3  # 24 elems / 8
+            # rows KNOWN: the straddled row re-hashes chunks 0+1
+            # incrementally (chunk 2 carried over) and ships the
+            # smaller ROW slice
+            scope["t"][1, :] += 100.0
+            s._dirty_rows["t"] = {1}
+            mode, items, d1 = s._replication_plan(s._scope_arrays())
+            assert mode == "delta"
+            assert items[0][2] == {"rows": [1]}  # row beats chunk
+            assert d1["t"]["chunks"][0] != d0["t"]["chunks"][0]
+            assert d1["t"]["chunks"][1] != d0["t"]["chunks"][1]
+            assert d1["t"]["chunks"][2] == d0["t"]["chunks"][2]
+            s._shipped_digests = d1
+            s._dirty_rows.clear()
+            # rows UNKNOWN (dense-dirty): the same straddling change
+            # ships ONE contiguous chunk run covering both chunks,
+            # and the flat splice is bit-for-bit
+            scope["t"][1, :] += 1.0
+            s._dirty_dense.add("t")
+            before = np.frombuffer(
+                tbl.tobytes(), dtype=np.float32).copy()
+            before.reshape(4, 6)[1, :] += 100.0  # the shipped state
+            mode, items, _ = s._replication_plan(s._scope_arrays())
+            assert mode == "delta"
+            ranges = [it[2]["chunk"] for it in items]
+            assert ranges == [[0, 16]], ranges
+            got = before.copy()
+            for _, arr, extra in items:
+                lo, hi = extra["chunk"]
+                got[lo:hi] = arr.reshape(-1)
+            assert got.reshape(4, 6).tobytes() \
+                == np.asarray(scope["t"]).tobytes()
+        finally:
+            s.stop()
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_PS_DIGEST_CHUNK_MB", None)
+        else:
+            os.environ["PADDLE_PS_DIGEST_CHUNK_MB"] = prev
+
+
+def test_chunk_size_larger_than_var_degenerates_to_whole_var():
+    s, scope = _plan_server(_eps(1), {"w": np.zeros(8, "f4")},
+                            anchor_every=1000000)
+    try:
+        d = _prime(s)
+        assert len(d["w"]["chunks"]) == 1  # one chunk covers the var
+        scope["w"][3] = 5.0
+        s._dirty_dense.add("w")
+        mode, items, _ = s._replication_plan(s._scope_arrays())
+        assert mode == "delta"
+        # single-chunk vars ship WHOLE (no chunk header)
+        assert len(items) == 1 and items[0][2] is None
+        assert items[0][1].nbytes == 32
+    finally:
+        s.stop()
+
+
+def test_digest_state_resets_after_anchor_and_skips_untouched():
+    """Anchors re-hash EVERYTHING (incremental skips cannot drift past
+    an anchor); between anchors an untouched var is neither re-hashed
+    (ps.digest_vars{mode=skipped}) nor shipped, and its carried-over
+    digest still detects a later change."""
+    from paddle_tpu import observability as obs
+
+    s, scope = _plan_server(_eps(1),
+                            {"w": np.zeros(8, "f4"),
+                             "ballast": np.zeros(64, "f4")},
+                            anchor_every=1000000)
+    try:
+        sk0 = obs.counter_value("ps.digest_vars", mode="skipped") or 0
+        _prime(s)
+        # round 1: only w touched -> ballast skipped, not shipped
+        scope["w"][0] = 1.0
+        s._dirty_dense.add("w")
+        mode, items, digests = s._replication_plan(s._scope_arrays())
+        assert mode == "delta"
+        assert [n for n, _, _ in items] == ["w"]
+        assert (obs.counter_value("ps.digest_vars", mode="skipped")
+                or 0) > sk0
+        s._shipped_digests = digests
+        s._dirty_dense.clear()
+        # force an anchor: everything re-hashed + shipped, fresh state
+        s._applied_round = 0
+        s._anchor_every = 1
+        prev_ballast = digests["ballast"]
+        mode, items, digests = s._replication_plan(s._scope_arrays())
+        assert mode == "full" and len(items) == 2
+        assert digests["ballast"] is not prev_ballast  # re-hashed
+        assert digests["ballast"]["chunks"] \
+            == prev_ballast["chunks"]  # same content, same digest
+        s._shipped_digests = digests
+        s._anchor_every = 1000000
+        s._applied_round = 1
+        # the carried digest still catches a change with NO dirty info
+        # when incremental digesting is off for that var (dense-dirty)
+        scope["ballast"][5] = 3.0
+        s._dirty_dense.add("ballast")
+        mode, items, _ = s._replication_plan(s._scope_arrays())
+        assert [n for n, _, _ in items] == ["ballast"]
+    finally:
+        s.stop()
+
+
+def test_incremental_digest_bitwise_parity_with_optimizer_family(
+        monkeypatch):
+    """A momentum-style block touches w AND w@MOM: the family-dirty
+    contract must re-hash the companions too, leaving the backup
+    bit-for-bit identical under PADDLE_PS_INCR_DIGEST=1 vs =0."""
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+    _fast_env(monkeypatch)
+
+    def run(incr):
+        monkeypatch.setenv("PADDLE_PS_INCR_DIGEST",
+                           "1" if incr else "0")
+        eps = _eps(2)
+        servers = []
+        for ep in eps:
+            scope = MiniScope()
+            scope["w"] = np.zeros(4, dtype=np.float32)
+            scope["w@MOM"] = np.zeros(4, dtype=np.float32)
+            scope["ballast"] = np.zeros(512, dtype=np.float32)
+
+            def mom_block(sc):
+                sc["w@MOM"] = (np.float32(0.9) * sc["w@MOM"]
+                               + sc["w@GRAD"])
+                sc["w"] = sc["w"] - np.float32(0.1) * sc["w@MOM"]
+
+            s = PSServer(ep, MiniExec(), scope,
+                         {"w@GRAD": mom_block}, fanin=1,
+                         endpoints=eps, anchor_every=4)
+            s.start_background()
+            servers.append((s, scope))
+        try:
+            c = PSClient(",".join(eps), trainer_id=0)
+            for rnd in range(1, 7):
+                c.send_grad("w@GRAD", _grad(0, rnd), round=rnd)
+                c.send_barrier(round=rnd)
+                c.get_param("w")
+                c.fetch_barrier()
+            c.close()
+            bsc = servers[1][1]
+            return (np.asarray(bsc["w"]).tobytes(),
+                    np.asarray(bsc["w@MOM"]).tobytes())
+        finally:
+            for s, _ in servers:
+                s.stop()
+
+    assert run(True) == run(False), \
+        "incremental digesting diverged the backup's optimizer family"
+
+
+# -- live shard migration (ISSUE 13) -----------------------------------------
+
+
+def _sgd_factory(gname):
+    base = gname.split("@", 1)[0]
+
+    def blk(scope):
+        scope[base] = scope[base] - np.float32(0.1) * scope[gname]
+    return blk
+
+
+def _mk_migration_fixture(monkeypatch, lease_ms=400, extra_var=False):
+    """2 shards x (primary+backup), one var per shard (plus an extra
+    donor var when asked), block factories armed for adoption."""
+    from paddle_tpu.distributed.ps_rpc import PSServer
+    from paddle_tpu.distributed.ps_shard import ShardedPSClient
+
+    _fast_env(monkeypatch)
+    names = _shard_var_names(2)
+    groups = [_eps(2), _eps(2)]
+    servers = []
+    for si, grp in enumerate(groups):
+        for ep in grp:
+            scope = MiniScope()
+            scope[names[si]] = np.zeros(4, dtype=np.float32)
+            g2b = {names[si] + "@GRAD": _sgd_factory(
+                names[si] + "@GRAD")}
+            if extra_var and si == 0:
+                scope["extra0"] = np.zeros(4, dtype=np.float32)
+                g2b["extra0@GRAD"] = _sgd_factory("extra0@GRAD")
+            s = PSServer(ep, MiniExec(), scope, g2b, fanin=1,
+                         endpoints=grp, lease_ms=lease_ms, shard=si,
+                         block_factory=_sgd_factory)
+            s.start_background()
+            servers.append((s, scope))
+    c = ShardedPSClient([",".join(g) for g in groups], trainer_id=0)
+    return names, groups, servers, c
+
+
+def test_live_migration_end_to_end(monkeypatch):
+    """Happy path: migrate shard 0's var to shard 1 mid-training —
+    map bumps atomically at the barrier, params stay oracle-exact,
+    the recipient's BACKUP holds the var before the donor drops it,
+    a fresh (version-0) client self-repairs via wrong_shard, and the
+    donor group keeps answering barriers for its empty range."""
+    names, groups, servers, c = _mk_migration_fixture(monkeypatch)
+    from paddle_tpu.distributed.ps_shard import ShardedPSClient
+
+    rounds = 6
+    try:
+        ws = {}
+        for rnd in range(1, rounds + 1):
+            for vi, n in enumerate(names):
+                c.send_grad(n + "@GRAD", _grad(0, rnd) + vi,
+                            round=rnd)
+            c.send_barrier(round=rnd)
+            ws = {n: c.get_param(n) for n in names}
+            c.fetch_barrier()
+            if rnd == 2:
+                r = c.migrate(names[0], 1)
+                assert r.get("pending")
+        assert c.map_version == 1
+        assert c.map_overrides == {names[0]: 1}
+        for vi, n in enumerate(names):
+            exp = {"w": np.zeros(4, "f4")}
+            for rnd in range(1, rounds + 1):
+                exp["w@GRAD"] = _grad(0, rnd) + vi
+                _sgd_block(exp)
+            assert ws[n].tobytes() == exp["w"].tobytes(), n
+        # recipient backup holds it; donor group dropped it
+        assert names[0] in servers[3][1]
+        assert names[0] not in servers[0][1]
+        assert names[0] not in servers[1][1]
+        # a fresh hash-routed client self-repairs via wrong_shard
+        c2 = ShardedPSClient([",".join(g) for g in groups],
+                             trainer_id=1)
+        got = c2.get_param(names[0])
+        assert got.tobytes() == ws[names[0]].tobytes()
+        assert c2.map_version == 1
+        c2.close()
+    finally:
+        c.close()
+        for s, _ in servers:
+            s.stop()
+
+
+def test_migration_replay_original_tokens_exactly_once(monkeypatch):
+    """The watermark shipped with the install makes a replay of an
+    rpc ALREADY FOLDED into the migrated state answer `replayed` at
+    the recipient — exactly-once across the shard-map version bump —
+    and a donor-primary death right after migration fails over with
+    original-token replays, finishing oracle-exact."""
+    names, groups, servers, c = _mk_migration_fixture(monkeypatch,
+                                                      extra_var=True)
+    rounds, kill_at = 6, 4
+    allv = names + ["extra0"]
+    try:
+        ws = {}
+        for rnd in range(1, rounds + 1):
+            for vi, n in enumerate(allv):
+                c.send_grad(n + "@GRAD", _grad(0, rnd) + vi,
+                            round=rnd)
+            c.send_barrier(round=rnd)
+            ws = {n: c.get_param(n) for n in allv}
+            c.fetch_barrier()
+            if rnd == 2:
+                c.migrate(names[0], 1)
+            if rnd == kill_at:
+                servers[0][0].stop()  # donor primary dies post-
+                # migration; its backup must serve the remaining var
+        for vi, n in enumerate(allv):
+            exp = {"w": np.zeros(4, "f4")}
+            for rnd in range(1, rounds + 1):
+                exp["w@GRAD"] = _grad(0, rnd) + vi
+                _sgd_block(exp)
+            assert ws[n].tobytes() == exp["w"].tobytes(), n
+        assert servers[1][0]._promoted
+        # the exactly-once mechanism itself: a replay of a PRE-
+        # MIGRATION rpc (the donor sub-client's folded seq) at the
+        # RECIPIENT answers `replayed` without executing
+        donor_cid = c.shards[0]._cid
+        recipient = servers[2][0] if servers[2][0]._active_role() \
+            else servers[3][0]
+        resp, _ = recipient._dispatch(
+            {"kind": "send_grad", "cid": donor_cid, "seq": 1,
+             "round": 0, "name": names[0] + "@GRAD",
+             "array": {"dtype": "float32", "shape": [4]}},
+            np.zeros(4, "f4").tobytes())
+        assert resp.get("replayed"), resp
+    finally:
+        c.close()
+        for s, _ in servers:
+            s.stop()
+
+
+def test_migration_ships_optimizer_family(monkeypatch):
+    """A momentum-optimized var migrates WITH its @-companions: the
+    recipient's rebuilt block finds w@MOM exactly where the donor
+    left it, and the training history stays oracle-exact across the
+    move. (Without family shipping, the rebuilt block would crash or
+    silently restart momentum from zero.)"""
+    from paddle_tpu.distributed.ps_rpc import PSServer
+    from paddle_tpu.distributed.ps_shard import ShardedPSClient
+
+    _fast_env(monkeypatch)
+    names = _shard_var_names(2)
+
+    def mom_factory(gname):
+        base = gname.split("@", 1)[0]
+
+        def blk(sc):
+            sc[base + "@MOM"] = (np.float32(0.9) * sc[base + "@MOM"]
+                                 + sc[gname])
+            sc[base] = sc[base] - np.float32(0.1) * sc[base + "@MOM"]
+        return blk
+
+    groups = [_eps(2), _eps(2)]
+    servers = []
+    for si, grp in enumerate(groups):
+        for ep in grp:
+            scope = MiniScope()
+            scope[names[si]] = np.zeros(4, dtype=np.float32)
+            scope[names[si] + "@MOM"] = np.zeros(4, dtype=np.float32)
+            s = PSServer(ep, MiniExec(), scope,
+                         {names[si] + "@GRAD": mom_factory(
+                             names[si] + "@GRAD")},
+                         fanin=1, endpoints=grp, lease_ms=400,
+                         shard=si, block_factory=mom_factory)
+            s.start_background()
+            servers.append((s, scope))
+    c = ShardedPSClient([",".join(g) for g in groups], trainer_id=0)
+    rounds = 6
+    try:
+        ws = {}
+        for rnd in range(1, rounds + 1):
+            for vi, n in enumerate(names):
+                c.send_grad(n + "@GRAD", _grad(0, rnd) + vi,
+                            round=rnd)
+            c.send_barrier(round=rnd)
+            ws = {n: c.get_param(n) for n in names}
+            c.fetch_barrier()
+            if rnd == 2:
+                c.migrate(names[0], 1)
+        assert c.map_version == 1
+        for vi, n in enumerate(names):
+            w = np.zeros(4, dtype=np.float32)
+            mom = np.zeros(4, dtype=np.float32)
+            for rnd in range(1, rounds + 1):
+                mom = np.float32(0.9) * mom + (_grad(0, rnd) + vi)
+                w = w - np.float32(0.1) * mom
+            assert ws[n].tobytes() == w.tobytes(), \
+                "%s diverged — optimizer state lost in migration" % n
+        # the companion physically lives on the recipient now
+        assert names[0] + "@MOM" in servers[2][1] \
+            or names[0] + "@MOM" in servers[3][1]
+        assert names[0] + "@MOM" not in servers[0][1]
+    finally:
+        c.close()
+        for s, _ in servers:
+            s.stop()
+
+
+def test_migration_reinstalls_when_recipient_lost_the_stage(
+        monkeypatch):
+    """The recipient-kill window: the staged family is memory-only,
+    so a recipient primary dying between install and commit loses it
+    — the donor (which still holds the state; that is why the hard
+    commit waits) must RE-INSTALL on the promoted recipient and drive
+    the commit home."""
+    from paddle_tpu import observability as obs
+
+    names, groups, servers, c = _mk_migration_fixture(monkeypatch)
+    donor_primary = servers[0][0]
+    recipient_primary = servers[2][0]
+    real_mig_client = donor_primary._mig_client
+    state = {"dropped": False}
+
+    class _DropFirstCommit:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def _call(self, msg, raw=b""):
+            if msg.get("kind") == "migrate_commit" \
+                    and not state["dropped"]:
+                # simulate the recipient primary dying right after
+                # the install: its promoted backup has no stage
+                state["dropped"] = True
+                with recipient_primary._lock:
+                    recipient_primary._staged_in.clear()
+                raise OSError("recipient primary died before commit")
+            return self._inner._call(msg, raw)
+
+    monkeypatch.setattr(
+        donor_primary, "_mig_client",
+        lambda chain: _DropFirstCommit(real_mig_client(chain)))
+    cr0 = obs.counter_value("ps.migrations",
+                            outcome="commit_retry") or 0
+    rounds = 6
+    try:
+        ws = {}
+        for rnd in range(1, rounds + 1):
+            for vi, n in enumerate(names):
+                c.send_grad(n + "@GRAD", _grad(0, rnd) + vi,
+                            round=rnd)
+            c.send_barrier(round=rnd)
+            ws = {n: c.get_param(n) for n in names}
+            c.fetch_barrier()
+            if rnd == 2:
+                c.migrate(names[0], 1)
+        assert state["dropped"], "the failure was never injected"
+        assert (obs.counter_value("ps.migrations",
+                                  outcome="commit_retry") or 0) > cr0
+        assert c.map_version == 1 and c.map_overrides == {names[0]: 1}
+        assert names[0] in servers[2][1], \
+            "re-install never reached the recipient"
+        for vi, n in enumerate(names):
+            exp = {"w": np.zeros(4, "f4")}
+            for rnd in range(1, rounds + 1):
+                exp["w@GRAD"] = _grad(0, rnd) + vi
+                _sgd_block(exp)
+            assert ws[n].tobytes() == exp["w"].tobytes(), n
+    finally:
+        c.close()
+        for s, _ in servers:
+            s.stop()
+
+
+def test_migrate_begin_refuses_second_pending_var(monkeypatch):
+    """One in-flight migration per group: a second migrate_begin for
+    a DIFFERENT var before the barrier executes the first is refused
+    loudly, never silently replacing the acked intent."""
+    names, groups, servers, c = _mk_migration_fixture(
+        monkeypatch, extra_var=True)
+    try:
+        r = c.migrate(names[0], 1)
+        assert r.get("pending")
+        with pytest.raises(RuntimeError, match="already pending"):
+            c.migrate("extra0", 1)
+    finally:
+        c.close()
+        for s, _ in servers:
+            s.stop()
+
+
+def test_migration_install_failure_rolls_back(monkeypatch):
+    """Unreachable recipient: bounded install retries, then ROLLBACK
+    — map never bumps, the var keeps training on the donor, params
+    oracle-exact."""
+    from paddle_tpu import observability as obs
+
+    names, groups, servers, c = _mk_migration_fixture(monkeypatch)
+    rounds = 6
+    rb0 = obs.counter_value("ps.migrations", outcome="rollback") or 0
+    try:
+        # every install the donor attempts dies on the wire
+        for s, _ in servers[:2]:
+            monkeypatch.setattr(
+                s, "_mig_client",
+                lambda chain: (_ for _ in ()).throw(
+                    OSError("recipient unreachable")))
+        ws = {}
+        for rnd in range(1, rounds + 1):
+            for vi, n in enumerate(names):
+                c.send_grad(n + "@GRAD", _grad(0, rnd) + vi,
+                            round=rnd)
+            c.send_barrier(round=rnd)
+            ws = {n: c.get_param(n) for n in names}
+            c.fetch_barrier()
+            if rnd == 1:
+                c.migrate(names[0], 1)
+        assert c.map_version == 0 and not c.map_overrides
+        assert (obs.counter_value("ps.migrations", outcome="rollback")
+                or 0) > rb0
+        assert names[0] in servers[0][1]  # donor still owns it
+        for vi, n in enumerate(names):
+            exp = {"w": np.zeros(4, "f4")}
+            for rnd in range(1, rounds + 1):
+                exp["w@GRAD"] = _grad(0, rnd) + vi
+                _sgd_block(exp)
+            assert ws[n].tobytes() == exp["w"].tobytes(), n
+    finally:
+        c.close()
+        for s, _ in servers:
+            s.stop()

@@ -56,6 +56,14 @@ WATCHED = (
     ("serving_queue_ms_p50", -1), ("serving_queue_ms_p99", -1),
     ("serving_batch_size_mean", +1),
     ("serving_padding_waste_frac", -1), ("jit_traces", -1),
+    # PS scale records (tools/ps_scale_bench.py): the per-round
+    # blake2b bill under incremental chunk digesting, and the delta
+    # wire bytes for the same touched-rows workload — a change that
+    # silently regresses incremental digesting back toward full
+    # re-hashing (or row slices back toward whole-table ships) fails
+    # here run-over-run
+    ("ps_digest_ms", -1), ("rounds_per_s", +1),
+    ("repl_delta_bytes_per_round", -1),
 )
 
 # absolute noise floors for measured-timing metrics: a relative
@@ -73,6 +81,8 @@ ABS_NOISE_FLOOR = {
     "p50_ms": 5.0, "p99_ms": 10.0,
     "serving_queue_ms_p50": 5.0, "serving_queue_ms_p99": 10.0,
     "serving_batch_size_mean": 1.0, "serving_padding_waste_frac": 0.15,
+    # hashing time on a loaded CI box jitters; byte counts do not
+    "ps_digest_ms": 5.0,
 }
 
 # counter totals (metrics.json) where growth is a regression.
@@ -356,6 +366,29 @@ def _self_test():
     assert {"serving_queue_ms_p99", "jit_traces"} <= sbad, sbad
     scbad = [r for r in diff_counters(s0, s2, 0.25) if r[-1]]
     assert scbad and scbad[0][0] == "serving.errors", scbad
+    # ps_scale records: a digest-cost regression past threshold+floor
+    # (incremental digesting broken back toward full re-hash) must
+    # flag; sub-floor hashing jitter must not; a delta-bytes blowup
+    # (row slices regressing to whole-table ships) must flag
+    g0 = {"configs": {"ps_scale": {
+        "ps_digest_ms": 8.0, "rounds_per_s": 50.0,
+        "repl_delta_bytes_per_round": 4096}}}
+    g1 = {"configs": {"ps_scale": {
+        "ps_digest_ms": 40.0, "rounds_per_s": 50.0,
+        "repl_delta_bytes_per_round": 4096}}}
+    gbad = [r for r in diff_records(g0, g1, 0.5)
+            if r[1] == "ps_digest_ms"]
+    assert gbad and gbad[0][-1], gbad
+    g2 = {"configs": {"ps_scale": {
+        "ps_digest_ms": 10.0, "rounds_per_s": 50.0,
+        "repl_delta_bytes_per_round": 4096}}}
+    assert not any(r[-1] for r in diff_records(g0, g2, 0.5))
+    g3 = {"configs": {"ps_scale": {
+        "ps_digest_ms": 8.0, "rounds_per_s": 50.0,
+        "repl_delta_bytes_per_round": 16777216}}}
+    g3bad = [r for r in diff_records(g0, g3, 0.5)
+             if r[1] == "repl_delta_bytes_per_round"]
+    assert g3bad and g3bad[0][-1], g3bad
     print("bench_diff self-test ok")
     return 0
 

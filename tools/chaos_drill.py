@@ -36,6 +36,32 @@ ISSUE 8 modes:
   rounds — while the killed shard next door still promotes. This is
   the ISSUE 8 acceptance drill (SIGKILL + partition in one run).
 
+ISSUE 13 modes:
+
+- ``--migrate`` (requires ``--shards 2``) — a LIVE KEY-RANGE
+  MIGRATION under fire: trainer 0 asks the schedule's shard to move
+  its var to the sister shard at a seeded round; the donor primary is
+  SIGKILLed in the WORST spot (range installed on the recipient,
+  nothing committed or replicated — ``PADDLE_PS_CHAOS_DIE_AFTER_
+  INSTALL``), so the first attempt must ROLL BACK (begin without
+  commit on the killed incarnation); the promoted donor backup then
+  completes the re-triggered migration. Gated on exit 0, params
+  bit-for-bit vs the clean run (zero lost or double-applied rounds),
+  the kill -> promotion -> migration-commit causal chain in the
+  merged trace, the shard-map version bump visible to every trainer,
+  and — the drill runs with one external quorum WITNESS and a
+  ``clock_jitter`` rule armed — witness votes in the merged counters.
+- ``--evict`` (requires ``--shards 2``) — per-shard effective fanin
+  DISAGREEING mid-round: the dying trainer's phase-1 barrier reaches
+  shard 0 only, eviction is armed on shard 1 alone, and the relaunch
+  is delayed past the eviction window. The two-phase barrier plus the
+  stale-round guard must reconcile DETERMINISTICALLY: shard 0's var
+  bit-for-bit with the full 2-trainer oracle, shard 1's var
+  bit-for-bit with the oracle MINUS exactly the dead trainer's grad
+  for the one round eviction sailed without it, both trainers
+  agreeing, ``ps.stale_rounds`` > 0 and eviction + readmission in the
+  merged counters.
+
 The schedule is a pure function of the seed (``make_schedule``), so a
 failing drill replays exactly: rerun with the printed seed.
 
@@ -83,12 +109,13 @@ def _free_port() -> int:
 
 
 def make_schedule(seed: int, sync_rounds: int = 6, shards: int = 1,
-                  partition: bool = False) -> dict:
+                  partition: bool = False, migrate: bool = False,
+                  evict: bool = False) -> dict:
     """The randomized fault schedule as a pure function of the seed —
     two calls with the same args MUST return the same dict (asserted
-    by tests/test_fault_tolerance.py). The legacy draws keep their
-    order, so legacy schedules replay identically; shard draws come
-    after."""
+    by tests/test_fault_tolerance.py and test_survivable_ps.py). The
+    legacy draws keep their order, so legacy schedules replay
+    identically; shard draws come after, migrate draws after those."""
     from paddle_tpu.distributed import fault
 
     rng = random.Random(int(seed))
@@ -102,6 +129,8 @@ def make_schedule(seed: int, sync_rounds: int = 6, shards: int = 1,
         "server_kill_round": rng.randint(1, hi),
         "shards": max(1, int(shards)),
         "partition": bool(partition),
+        "migrate": bool(migrate),
+        "evict": bool(evict),
     }
     sched["die_shard"] = (rng.randrange(sched["shards"])
                           if sched["shards"] > 1 else 0)
@@ -111,6 +140,24 @@ def make_schedule(seed: int, sync_rounds: int = 6, shards: int = 1,
     sched["partition_shard"] = (
         (sched["die_shard"] + 1) % sched["shards"]
         if sched["partition"] and sched["shards"] > 1 else None)
+    if sched["migrate"]:
+        # trigger at m -> executes (and the donor dies) at m+1 ->
+        # re-trigger at m+2 -> completes by m+4: keep m small enough
+        # that the completed migration still serves rounds
+        sched["migrate_round"] = rng.randint(
+            1, max(1, int(sync_rounds) - 4))
+        sched["migrate_from"] = sched["die_shard"]
+        sched["migrate_to"] = ((sched["die_shard"] + 1)
+                               % sched["shards"])
+    else:
+        sched["migrate_round"] = None
+    if sched["evict"]:
+        # the dying trainer's partial barrier reaches shard 0 only;
+        # the death round leaves room for post-reconciliation rounds
+        sched["trainer_kill_round"] = min(
+            sched["trainer_kill_round"],
+            max(1, int(sync_rounds) - 2))
+        sched["evict_shard"] = 1
     return sched
 
 
@@ -137,6 +184,16 @@ def _env(sched: dict, tmp: str, eps: list) -> dict:
         # hard both-ways partition between that shard's primary and
         # backup for the WHOLE run: the backup must never win quorum
         plan = "%s,partition:1:%s|%s" % (plan, pg[0], pg[1])
+    if sched.get("migrate"):
+        # jittered clocks ride the migration drill: the lease/quorum
+        # machinery must keep exactly one writable primary per shard
+        # while every participant's timers wander
+        plan = "%s,clock_jitter:0.3:300" % plan
+    if sched.get("evict"):
+        # the eviction-reconciliation oracle is timing-sensitive (the
+        # delayed relaunch pins WHICH round sails without the dead
+        # trainer): no frame faults in this mode
+        plan = ""
     env.update({
         "FT_ROLE": "trainer",
         "PSERVER_ENDPOINT": ",".join(eps),
@@ -187,15 +244,67 @@ def _env(sched: dict, tmp: str, eps: list) -> dict:
         "PADDLE_TPU_METRICS_DIR": os.path.join(tmp, "metrics"),
         "PADDLE_TPU_DUMP_PERIOD": "0.5",
     })
+    if sched.get("migrate"):
+        groups = _groups(sched, eps)
+        env.update({
+            # the server kill is the migration hook's, not the
+            # round-counted suicide
+            "FT_SERVER_DIE_AT_ROUND": "0",
+            "FT_MIGRATE_AT_ROUND": str(sched["migrate_round"]),
+            "FT_MIGRATE_FROM_SHARD": str(sched["migrate_from"]),
+            "FT_MIGRATE_TO_SHARD": str(sched["migrate_to"]),
+            # the donor's INITIAL primary dies between installing the
+            # range on the recipient and committing anything — the
+            # worst spot; its relaunched incarnation rejoins as a
+            # backup and never matches again
+            "PADDLE_PS_CHAOS_DIE_AFTER_INSTALL":
+                groups[sched["migrate_from"]][0],
+        })
+    if sched.get("evict"):
+        env.update({
+            "FT_SERVER_DIE_AT_ROUND": "0",
+            "FT_DIE_MODE": "partial_barrier",
+            # eviction armed on shard 1 ONLY — shard 0 (which got the
+            # dying trainer's partial barrier) keeps full fanin
+            "FT_EVICT_SHARD": str(sched["evict_shard"]),
+            "FT_EVICT_AFTER": "1.0",
+            # the relaunch must come back AFTER shard 1's monitor
+            # fired, pinning exactly one survivor-only round there
+            "FT_RESTART_DELAY": "3.0",
+        })
     return env
 
 
 def _rerun_hint(sched: dict) -> str:
     return ("tools/chaos_drill.py --seed %d --sync-rounds %d"
-            "%s%s" % (sched["seed"], sched["sync_rounds"],
-                      " --shards %d" % sched["shards"]
-                      if sched["shards"] > 1 else "",
-                      " --partition" if sched["partition"] else ""))
+            "%s%s%s%s" % (sched["seed"], sched["sync_rounds"],
+                          " --shards %d" % sched["shards"]
+                          if sched["shards"] > 1 else "",
+                          " --partition" if sched["partition"] else "",
+                          " --migrate" if sched.get("migrate") else "",
+                          " --evict" if sched.get("evict") else ""))
+
+
+def oracle_w_skipping(rounds: int, var: int, skip_tid: int,
+                      skip_round: int) -> np.ndarray:
+    """The eviction-reconciliation oracle: the clean computation MINUS
+    one trainer's contribution to one round (the round the evicting
+    shard applied while that trainer was dead) — same float32 ops in
+    the same order the PS applies them."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from dist_worker_ft import grad_for
+
+    w = np.zeros(4, dtype=np.float32)
+    for rnd in range(1, rounds + 1):
+        total = None
+        for t in (0, 1):
+            if t == skip_tid and rnd == skip_round:
+                continue
+            g = grad_for(t, rnd, var)
+            total = g if total is None else total + g
+        if total is not None:
+            w = w - np.float32(0.1) * total
+    return w
 
 
 def run_drill(sched: dict) -> int:
@@ -203,14 +312,22 @@ def run_drill(sched: dict) -> int:
     eps = ["127.0.0.1:%d" % _free_port()
            for _ in range(2 * sched["shards"])]
     print("[chaos] schedule %s" % json.dumps(sched, sort_keys=True))
-    sup = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node=2", "--max_restarts=3",
-         "--started_port=%d" % _free_port(),
-         "--server_script=%s" % WORKER,
-         "--pserver_shards=%d" % sched["shards"],
-         "--pserver_endpoints=%s" % ",".join(eps), WORKER],
-        env=_env(sched, tmp, eps), timeout=420, cwd=REPO)
+    launch_args = [
+        sys.executable, "-m", "paddle_tpu.distributed.launch",
+        "--nproc_per_node=2", "--max_restarts=3",
+        "--started_port=%d" % _free_port(),
+        "--server_script=%s" % WORKER,
+        "--pserver_shards=%d" % sched["shards"],
+        "--pserver_endpoints=%s" % ",".join(eps)]
+    witness_ep = None
+    if sched.get("migrate"):
+        # the migration drill runs with an external quorum witness:
+        # the donor-kill election must gather a real witness grant
+        witness_ep = "127.0.0.1:%d" % _free_port()
+        launch_args.append("--ps_witness_endpoints=%s" % witness_ep)
+    launch_args.append(WORKER)
+    sup = subprocess.run(launch_args, env=_env(sched, tmp, eps),
+                         timeout=420, cwd=REPO)
     if sup.returncode != 0:
         print("[chaos] FAIL: job exited %d under schedule seed=%d "
               "(rerun: %s)" % (sup.returncode, sched["seed"],
@@ -221,19 +338,48 @@ def run_drill(sched: dict) -> int:
 
     names = var_names(sched["shards"])
     ok = True
+    outs = {}
     for tid in (0, 1):
         r = json.load(open(os.path.join(tmp, "out.t%d.json" % tid)))
+        outs[tid] = r
         for vi, name in enumerate(names):
-            expected = oracle_w(sched["sync_rounds"], var=vi)
+            expected = [oracle_w(sched["sync_rounds"], var=vi)]
+            note = "the clean run"
+            if sched.get("evict") \
+                    and vi == sched.get("evict_shard"):
+                # the evicting shard may have applied EXACTLY ONE
+                # round without the dead trainer (the round its
+                # monitor fired in, kill_round + 1) — or none, when
+                # the relaunch won the race anyway. Both are exact.
+                expected.append(oracle_w_skipping(
+                    sched["sync_rounds"], vi,
+                    sched["trainer_kill_rank"],
+                    sched["trainer_kill_round"] + 1))
+                note = "a reconciliation oracle"
             got = np.asarray(r["vars"][name], dtype=np.float32)
-            bitwise = got.tobytes() == expected.tobytes()
-            print("[chaos] %s: trainer %d var %s %s the clean run "
+            bitwise = any(got.tobytes() == e.tobytes()
+                          for e in expected)
+            print("[chaos] %s: trainer %d var %s %s %s "
                   "(failovers=%s, evictions=%s)"
                   % ("PASS" if bitwise else "FAIL", tid, name,
-                     "matches" if bitwise else "DIVERGES FROM",
+                     "matches" if bitwise else "DIVERGES FROM", note,
                      r.get("failovers"), r.get("evictions")))
             ok = ok and bitwise
-    ok = check_telemetry(sched, os.path.join(tmp, "metrics"), eps) and ok
+    if sched.get("evict"):
+        # both trainers must agree var-for-var — the barrier
+        # reconciled to ONE state, whichever oracle it was
+        agree = all(
+            outs[0]["vars"][n] == outs[1]["vars"][n] for n in names)
+        print("[chaos] %s: trainers agree bit-for-bit post-eviction"
+              % ("PASS" if agree else "FAIL"))
+        ok = ok and agree
+    mdir = os.path.join(tmp, "metrics")
+    if sched.get("migrate"):
+        ok = check_migrate_telemetry(sched, mdir, eps, outs) and ok
+    elif sched.get("evict"):
+        ok = check_evict_telemetry(sched, mdir) and ok
+    else:
+        ok = check_telemetry(sched, mdir, eps) and ok
     if not ok:
         print("[chaos] reproduce with: %s" % _rerun_hint(sched))
     return 0 if ok else 1
@@ -368,6 +514,143 @@ def check_telemetry(sched: dict, mdir: str, eps: list) -> bool:
     return ok
 
 
+def _load_merged(mdir: str):
+    ft_timeline.print_postmortem(mdir, limit=40)
+    mpath = os.path.join(mdir, "metrics.json")
+    tpath = os.path.join(mdir, "trace.json")
+    if not (os.path.exists(mpath) and os.path.exists(tpath)):
+        return None, None
+    return (json.load(open(mpath)),
+            ft_timeline.load_events(mdir))
+
+
+def check_migrate_telemetry(sched: dict, mdir: str, eps: list,
+                            outs: dict) -> bool:
+    """The --migrate gate: donor-primary SIGKILL mid-migration ->
+    rollback of attempt 1 (begin on the killed incarnation, no commit
+    before the kill) -> promotion -> the re-triggered migration
+    COMPLETES (kill < promotion < migration-commit causal chain) ->
+    every trainer adopted the bumped shard map; witness votes and
+    injected clock jitter visible in the merged counters."""
+    ok = True
+
+    def chk(what, passed):
+        nonlocal ok
+        print("[chaos] %s: %s" % ("PASS" if passed else "FAIL", what))
+        ok = ok and passed
+
+    merged, events = _load_merged(mdir)
+    chk("job-level metrics.json + trace.json merged",
+        merged is not None)
+    if not ok:
+        return False
+    totals = merged["counters_total"]
+    groups = _groups(sched, eps)
+    donor = set(groups[sched["migrate_from"]])
+    donor_primary = groups[sched["migrate_from"]][0]
+
+    kill = next((e for e in events if e["kind"] == "launch.exit"
+                 and e["fields"].get("role") == "pserver"
+                 and e["fields"].get("signal") == 9), None)
+    begins = [e for e in events if e["kind"] == "ps.migration_begin"]
+    installs = [e for e in events
+                if e["kind"] == "ps.migration_install"]
+    commits = [e for e in events
+               if e["kind"] == "ps.migration_commit"]
+    promo = next((e for e in events if e["kind"] == "ps.promotion"
+                  and e["fields"].get("endpoint") in donor), None)
+    chk("supervisor observed the donor primary's SIGKILL",
+        kill is not None)
+    chk("migration began on the (to-be-killed) donor primary "
+        "(%d begin events)" % len(begins), len(begins) >= 1)
+    chk("range installed on the recipient (%d installs)"
+        % len(installs), len(installs) >= 1)
+    chk("the donor shard's backup was promoted", promo is not None)
+    chk("the re-triggered migration COMMITTED (%d commits)"
+        % len(commits), len(commits) >= 1)
+    if not ok:
+        return False
+    first_install = min(installs, key=lambda e: e["t_us"])
+    commit = min(commits, key=lambda e: e["t_us"])
+    # attempt 1 rolled back: nothing committed before the kill. (The
+    # killed donor's own `begin` flight line usually dies with it —
+    # SIGKILL eats its last ring flush — so the SURVIVING recipient's
+    # first install is the pre-kill evidence.)
+    chk("attempt 1 rolled back (no commit precedes the kill)",
+        commit["t_us"] > kill["t_us"])
+    chk("causal chain: kill < promotion < migration commit",
+        kill["t_us"] < promo["t_us"] < commit["t_us"])
+    chk("attempt 1's install reached the recipient before the kill "
+        "(install < kill)", first_install["t_us"] < kill["t_us"])
+    procs = {kill["proc"], promo["proc"], commit["proc"]}
+    chk("chain spans >= 2 processes (%s)" % sorted(procs),
+        len(procs) >= 2)
+    # every trainer adopted the bumped map, pointing the var at the
+    # recipient shard
+    for tid, r in outs.items():
+        mo = r.get("map_overrides") or {}
+        chk("trainer %d adopted shard map v%s with the var routed to "
+            "shard %d (%s)" % (tid, r.get("map_version"),
+                               sched["migrate_to"], mo),
+            int(r.get("map_version") or 0) >= 1
+            and sched["migrate_to"] in set(mo.values()))
+    n_votes = sum(v for k, v in totals.items()
+                  if k.startswith("ps.witness_votes"))
+    chk("witness voted in the election (%d votes)" % n_votes,
+        n_votes >= 1)
+    n_jit = sum(v for k, v in totals.items()
+                if k.startswith("fault.injected")
+                and "clock_jitter" in k)
+    chk("clock jitter was injected (%d events)" % n_jit, n_jit >= 1)
+    chk("delta replication still carried the job "
+        "(ps.delta_rounds=%s)" % totals.get("ps.delta_rounds"),
+        totals.get("ps.delta_rounds", 0) > 0)
+    # the final round applied on every shard — zero lost rounds
+    final = [e for e in events if e["kind"] == "ps.round_applied"
+             and e["fields"].get("round") == sched["sync_rounds"]]
+    chk("final round %d applied on every shard (%d appliers)"
+        % (sched["sync_rounds"], len(final)),
+        len(final) >= sched["shards"])
+    print("[chaos] (donor primary pinned by the schedule: %s)"
+          % donor_primary)
+    return ok
+
+
+def check_evict_telemetry(sched: dict, mdir: str) -> bool:
+    """The --evict gate: the disagreeing-fanin round must show an
+    eviction AND a readmission AND stale-round drops (the guard that
+    keeps a relaunched trainer's re-run from contaminating later
+    rounds), with the final round applied on every shard."""
+    ok = True
+
+    def chk(what, passed):
+        nonlocal ok
+        print("[chaos] %s: %s" % ("PASS" if passed else "FAIL", what))
+        ok = ok and passed
+
+    merged, events = _load_merged(mdir)
+    chk("job-level metrics.json + trace.json merged",
+        merged is not None)
+    if not ok:
+        return False
+    totals = merged["counters_total"]
+    chk("a shard evicted the dead trainer (ps.evictions=%s)"
+        % totals.get("ps.evictions"),
+        totals.get("ps.evictions", 0) >= 1)
+    chk("the relaunched trainer was re-admitted "
+        "(ps.readmissions=%s)" % totals.get("ps.readmissions"),
+        totals.get("ps.readmissions", 0) >= 1)
+    chk("stale-round re-sends were dropped, not re-applied "
+        "(ps.stale_rounds=%s)" % totals.get("ps.stale_rounds"),
+        totals.get("ps.stale_rounds", 0) >= 1)
+    final = [e for e in events if e["kind"] == "ps.round_applied"
+             and e["fields"].get("round") == sched["sync_rounds"]]
+    chk("final round %d applied on every shard (%d appliers)"
+        % (sched["sync_rounds"], len(final)),
+        len(final) >= sched["shards"])
+    return ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser("chaos_drill")
     ap.add_argument("--rounds", type=int, default=1,
@@ -381,6 +664,16 @@ def main() -> int:
                     help="also sever a surviving shard's "
                          "primary<->backup pair for the whole run "
                          "(requires --shards >= 2)")
+    ap.add_argument("--migrate", action="store_true",
+                    help="live key-range migration drill: the donor "
+                         "primary is SIGKILLed mid-migration; gated "
+                         "on rollback-then-completion bit-for-bit "
+                         "(requires --shards >= 2)")
+    ap.add_argument("--evict", action="store_true",
+                    help="sharded eviction drill: per-shard effective "
+                         "fanin disagrees mid-round; gated on "
+                         "deterministic reconciliation (requires "
+                         "--shards >= 2)")
     ap.add_argument("--seed", type=int,
                     default=int(os.environ.get("PADDLE_TPU_FAULT_SEED",
                                                "1234")),
@@ -389,11 +682,18 @@ def main() -> int:
     if args.partition and args.shards < 2:
         ap.error("--partition needs --shards >= 2 (the partitioned "
                  "pair must belong to a shard that keeps training)")
+    if (args.migrate or args.evict) and args.shards < 2:
+        ap.error("--migrate/--evict need --shards >= 2 (the range "
+                 "moves — or the fanin disagrees — between groups)")
+    if args.migrate and args.partition:
+        ap.error("--migrate and --partition are separate drills")
     rc = 0
     for i in range(args.rounds):
         rc |= run_drill(make_schedule(args.seed + i, args.sync_rounds,
                                       shards=args.shards,
-                                      partition=args.partition))
+                                      partition=args.partition,
+                                      migrate=args.migrate,
+                                      evict=args.evict))
     if rc == 0:
         print("[chaos] ALL %d DRILL(S) PASS" % args.rounds)
     return rc

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""GB-scale parameter-server delta-path measurement (ISSUE 13).
+
+Runs a synthetic SPARSE TABLE (height x width float32; --gb sizes it)
+through the REAL replication path — a primary + backup ``PSServer``
+pair over localhost sockets, push_sparse touching a few rows per
+round — and records the two curves the ROADMAP asks for:
+
+- **digest cost**: milliseconds of blake2b hashing per round, under
+  incremental chunk digesting (``PADDLE_PS_INCR_DIGEST=1``, the
+  default: only rows/chunks dirtied since the last ship re-hash) vs
+  the full re-hash-every-var-every-round baseline (=0). At GB scale
+  the full re-hash is the dominant serial cost of a delta round; the
+  bench asserts incremental is STRICTLY cheaper.
+- **wire savings**: replication bytes per round, delta vs the full
+  anchor — a GB table touched on a handful of rows must ship row
+  slices, not the table.
+
+Output (--out) is a bench_diff-compatible record::
+
+    {"configs": {"ps_scale": {"table_mb":, "rounds":, "rounds_per_s":,
+                              "step_ms":, "ps_digest_ms":,
+                              "ps_digest_full_ms":,
+                              "repl_delta_bytes_per_round":,
+                              "repl_anchor_bytes":}},
+     "counters_total": {...}}
+
+``tools/bench_diff.py`` watches ``ps_digest_ms`` (lower is better):
+a change that silently regresses incremental digesting back toward
+full re-hashing fails the perf gate run-over-run.
+
+Usage: python tools/ps_scale_bench.py [--gb 0.25] [--rows 4]
+           [--rounds 6] [--width 256] [--out rec.json] [--smoke]
+
+``--smoke`` shrinks the table to ~16 MB for CI/tests; multi-GB runs
+are the manual measurement mode (memory: ~3x the table — primary +
+backup + one in-flight copy).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class MiniScope(dict):
+    def local_var_names(self):
+        return list(self)
+
+
+class MiniExec:
+    def _read_var(self, scope, name):
+        return scope.get(name)
+
+    def _write_var(self, scope, name, val):
+        scope[name] = val
+
+    def run_block(self, block, scope):
+        block(scope)
+
+
+def _sparse_block(scope):
+    """Row-local sgd, like a pslib sparse optimize block."""
+    g = scope["emb@GRAD"]
+    rows = np.asarray(g.rows(), dtype=np.int64)
+    vals = np.asarray(g._value)
+    emb = scope["emb"]
+    emb[rows] -= np.float32(0.1) * vals  # in place: rows only
+
+
+def _mk_pair(eps, height, width):
+    from paddle_tpu.distributed.ps_rpc import PSServer
+
+    servers = []
+    for ep in eps:
+        scope = MiniScope()
+        scope["emb"] = np.zeros((height, width), dtype=np.float32)
+        s = PSServer(ep, MiniExec(), scope,
+                     {"emb@GRAD": _sparse_block}, fanin=1,
+                     sync_mode=False, endpoints=eps, lease_ms=0)
+        s._async_repl_every = 1  # every push is a replicated round
+        s.start_background()
+        servers.append(s)
+    return servers
+
+
+def _counter_delta(before, name, **labels):
+    from paddle_tpu import observability as obs
+
+    return (obs.counter_value(name, **labels) or 0) - before.get(
+        (name, tuple(sorted(labels.items()))), 0)
+
+
+def _snap(*specs):
+    from paddle_tpu import observability as obs
+
+    return {(n, tuple(sorted(ls.items()))): obs.counter_value(n, **ls)
+            or 0 for n, ls in specs}
+
+
+def run_mode(height, width, rows_per_round, rounds, incremental):
+    """One measured pass; returns (digest_ms_per_round,
+    delta_bytes_per_round, anchor_bytes, rounds_per_s)."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    os.environ["PADDLE_PS_INCR_DIGEST"] = "1" if incremental else "0"
+    eps = ["127.0.0.1:%d" % _free_port() for _ in range(2)]
+    servers = _mk_pair(eps, height, width)
+    specs = [("ps.digest_ms", {}),
+             ("ps.replication_bytes", {"mode": "delta"}),
+             ("ps.replication_bytes", {"mode": "full"})]
+    try:
+        c = PSClient(",".join(eps), trainer_id=0)
+        rng = np.random.RandomState(7)
+        # round 0 primes the anchor (the whole table hashes + ships
+        # once either way); the measured window is pure delta rounds
+        base0 = _snap(*specs)
+        c.push_sparse("emb@GRAD", [0],
+                      np.ones((1, width), "f4"), param="emb")
+        base = _snap(*specs)
+        t0 = time.perf_counter()
+        for rnd in range(rounds):
+            ids = rng.choice(height, size=rows_per_round,
+                             replace=False).astype(np.int64)
+            c.push_sparse("emb@GRAD", ids,
+                          np.full((rows_per_round, width),
+                                  0.5 + rnd, "f4"), param="emb")
+        dt = time.perf_counter() - t0
+        digest_ms = _counter_delta(base, "ps.digest_ms") / rounds
+        delta_b = _counter_delta(base, "ps.replication_bytes",
+                                 mode="delta") / rounds
+        anchor_b = _counter_delta(base0, "ps.replication_bytes",
+                                  mode="full")
+        c.close()
+        return digest_ms, delta_b, anchor_b, rounds / dt
+    finally:
+        for s in servers:
+            s.stop()
+        os.environ.pop("PADDLE_PS_INCR_DIGEST", None)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--gb", type=float, default=0.25,
+                    help="sparse table size in GiB (default 0.25; "
+                         "multi-GB for the real measurement)")
+    ap.add_argument("--rows", type=int, default=4,
+                    help="rows touched per round")
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--width", type=int, default=256)
+    ap.add_argument("--out", default=None,
+                    help="write the bench_diff-compatible record here")
+    ap.add_argument("--smoke", action="store_true",
+                    help="~16MB table (CI/tests)")
+    args = ap.parse_args(argv)
+
+    gb = 0.015625 if args.smoke else args.gb
+    height = max(64, int(gb * (1 << 30)) // (4 * args.width))
+    table_mb = height * args.width * 4 / (1 << 20)
+    # the anchor interval must exceed the run, or anchors pollute the
+    # per-round delta window
+    os.environ["PADDLE_PS_ANCHOR_EVERY"] = str(10 * (args.rounds + 2))
+    print("[ps_scale] table %.1f MB (%d x %d f32), %d rows/round, "
+          "%d rounds" % (table_mb, height, args.width, args.rows,
+                         args.rounds))
+
+    inc_ms, delta_b, anchor_b, rps = run_mode(
+        height, args.width, args.rows, args.rounds, incremental=True)
+    full_ms, delta_b2, _, _ = run_mode(
+        height, args.width, args.rows, args.rounds, incremental=False)
+    print("[ps_scale] digest cost/round: incremental %.2f ms vs full "
+          "re-hash %.2f ms (%.1fx)" % (inc_ms, full_ms,
+                                       full_ms / max(inc_ms, 1e-9)))
+    print("[ps_scale] wire: delta %.1f KB/round vs anchor %.1f MB "
+          "(%.4f%%)" % (delta_b / 1024, anchor_b / (1 << 20),
+                        100.0 * delta_b / max(anchor_b, 1)))
+    print("[ps_scale] %.1f rounds/s (incremental mode)" % rps)
+
+    ok = True
+    if full_ms <= inc_ms:
+        print("[ps_scale] FAIL: incremental digesting (%.2f ms) not "
+              "cheaper than full re-hash (%.2f ms)"
+              % (inc_ms, full_ms), file=sys.stderr)
+        ok = False
+    if not 0 < delta_b < 0.01 * anchor_b:
+        print("[ps_scale] FAIL: delta bytes %.0f not under 1%% of "
+              "the anchor %.0f" % (delta_b, anchor_b),
+              file=sys.stderr)
+        ok = False
+
+    if args.out:
+        from paddle_tpu import observability as obs
+
+        rec = {"configs": {"ps_scale": {
+            "table_mb": round(table_mb, 2),
+            "rounds": args.rounds,
+            "rows_per_round": args.rows,
+            "rounds_per_s": round(rps, 3),
+            "step_ms": round(1e3 / max(rps, 1e-9), 3),
+            "ps_digest_ms": round(inc_ms, 4),
+            "ps_digest_full_ms": round(full_ms, 4),
+            "repl_delta_bytes_per_round": round(delta_b, 1),
+            "repl_anchor_bytes": int(anchor_b),
+        }}, "counters_total": {
+            k: v for k, v in {
+                "ps.delta_rounds": obs.counter_value("ps.delta_rounds"),
+                "ps.anchor_rounds": obs.counter_value(
+                    "ps.anchor_rounds"),
+            }.items() if v}}
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+        print("[ps_scale] record -> %s" % args.out)
+    print("[ps_scale] %s" % ("OK" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
