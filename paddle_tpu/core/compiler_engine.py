@@ -393,7 +393,12 @@ def _trace_ops(block, ops, env: Dict, step_seed) -> None:
 
                 ins[RNG_SEED_ATTR] = jnp.uint32(attrs["seed"])
             else:
-                sid = attrs.get("_fwd_op_id", op._id or 0)
+                # _fwd_op_id: a grad op reuses its forward op's
+                # stream; _rng_op_id: a fused FORWARD op (epilogue
+                # fusion) reuses the stream of the RNG op it absorbed
+                # without marking itself as backward
+                sid = attrs.get("_fwd_op_id",
+                                attrs.get("_rng_op_id", op._id or 0))
                 ins[RNG_SEED_ATTR] = _op_seed(step_seed, sid)
         try:
             outs = info.fn(ins, attrs)
@@ -552,16 +557,33 @@ def run_compiled_program(core, program, scope: Scope, feed: Dict,
     import jax
     import jax.numpy as jnp
 
+    import time as _time
+
+    from .. import observability as _obs
+
     fetch_names = tuple(f if isinstance(f, str) else f.name
                         for f in fetch_list)
+    # feed staging: LoDTensor / jax.Array feeds are already device
+    # values and pass through untouched (the async feed pipeline —
+    # core/native_feed.AsyncDeviceFeeder — hands exactly those in, so
+    # its H2D work never lands on this step's critical path; the old
+    # np.asarray round-trip would have pulled a staged array back to
+    # host). Host numpy feeds pay their H2D here, measured as
+    # executor.feed_ms so the profiler can attribute it.
+    t_feed = _time.perf_counter() if _obs.enabled() else None
     feed_vals = {}
     for name, value in feed.items():
         if isinstance(value, LoDTensor):
             if value.lod():
                 raise NotImplementedError("LoD feeds use the interpreter")
             feed_vals[name] = value.array
+        elif isinstance(value, jax.Array):
+            feed_vals[name] = value
         else:
             feed_vals[name] = jnp.asarray(np.asarray(value))
+    if t_feed is not None:
+        _obs.observe("executor.feed_ms",
+                     (_time.perf_counter() - t_feed) * 1e3)
     feed_names = tuple(sorted(feed_vals))
 
     read_first, written, persist_written = _analyze(program)
@@ -585,8 +607,6 @@ def run_compiled_program(core, program, scope: Scope, feed: Dict,
     fn = compile_program(program, feed_names, fetch_names, state_names,
                          out_state_names)
     import time
-
-    from .. import observability as _obs
 
     # compiled path = ONE fused dispatch: a single step-level host span
     # (per-op detail lives in the XPlane device trace; the op-by-op

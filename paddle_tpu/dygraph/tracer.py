@@ -38,18 +38,54 @@ def _obs():
     return _obs_cache[0]
 
 
+# content digests of ndarray-valued attrs, memoized per array OBJECT
+# (weakref-guarded against id reuse): layer attrs are the same arrays
+# every step, and re-hashing them on every trace put O(bytes) sha1
+# work on the lazy hot path — at dygraph_bert scale, thousands of
+# times per step. Contract: an array used as an op attr is immutable
+# once traced (the same contract the jit caches keyed on this
+# signature already rely on — mutating it in place would stale THEM,
+# cached digest or not).
+_ndarray_digests: Dict[int, Tuple] = {}
+_NDARRAY_DIGEST_CAP = 4096
+
+
+def _ndarray_digest(v: np.ndarray) -> Tuple:
+    key = id(v)
+    hit = _ndarray_digests.get(key)
+    if hit is not None and hit[0]() is v:
+        return hit[1]
+    import hashlib
+    import weakref
+
+    d = ("ndarray", tuple(v.shape), v.dtype.str,
+         hashlib.sha1(np.ascontiguousarray(v).tobytes()).hexdigest())
+    try:
+        ref = weakref.ref(v)
+    except TypeError:
+        return d  # non-weakrefable subclass: no safe identity guard
+    if len(_ndarray_digests) >= _NDARRAY_DIGEST_CAP:
+        # drop dead entries first; if ALL are live, reset (bounded)
+        dead = [k for k, (r, _d) in _ndarray_digests.items()
+                if r() is None]
+        for k in dead:
+            del _ndarray_digests[k]
+        if len(_ndarray_digests) >= _NDARRAY_DIGEST_CAP:
+            _ndarray_digests.clear()
+    _ndarray_digests[key] = (ref, d)
+    return d
+
+
 def _canon_attr(v):
     """Hashable, content-faithful canonical form of an attr value for
     cache signatures. Array-valued attrs hash by CONTENT (shape +
     dtype + digest of the bytes): ``repr`` elides interior elements of
     large arrays, which can alias two different ops onto one cached
-    compiled graph — a silent wrong-answer bug."""
+    compiled graph — a silent wrong-answer bug. The digest is memoized
+    per array object (``_ndarray_digest``) so steady-state traces stop
+    re-hashing the same attrs every step."""
     if isinstance(v, np.ndarray):
-        import hashlib
-
-        return ("ndarray", tuple(v.shape), v.dtype.str,
-                hashlib.sha1(np.ascontiguousarray(v).tobytes())
-                .hexdigest())
+        return _ndarray_digest(v)
     if isinstance(v, (list, tuple)):
         return tuple(_canon_attr(x) for x in v)
     if isinstance(v, dict):

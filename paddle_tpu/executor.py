@@ -81,6 +81,13 @@ class Executor:
             from .core.compiler_engine import (_program_version,
                                                run_compiled_program)
 
+            # single-chip fusion rewrites (fused optimizer update /
+            # fused epilogues) — default-off knobs; the disabled path
+            # is two env reads (gate-4 budget), the enabled path is
+            # idempotent per program
+            from .core.fusion import maybe_rewrite_single_chip
+
+            maybe_rewrite_single_chip(program, scope)
             ver = _program_version(program)
             if ver not in self._compile_fallbacks:
                 run_args = None

@@ -88,13 +88,15 @@ __all__ = [
 ]
 
 # optimizer update op types (ops/optimizer_ops.py registrations) — the
-# boundary between the backward and optimizer phases
+# boundary between the backward and optimizer phases.
+# "fused_optimizer" is the single-chip fused update (core/fusion.py):
+# one op carrying a whole optimizer instance, still optimizer phase.
 OPTIMIZER_OPS = frozenset({
     "sgd", "momentum", "lars_momentum", "adam", "adamw", "adamax",
     "adagrad", "decayed_adagrad", "adadelta", "rmsprop", "ftrl", "lamb",
     "dpsgd", "dgc", "dgc_momentum", "dgc_clip_by_norm", "proximal_gd",
     "proximal_adagrad", "lookahead_update", "ema_accumulate",
-    "ema_adaptive_decay", "model_average_accumulate",
+    "ema_adaptive_decay", "model_average_accumulate", "fused_optimizer",
 })
 
 # collectives that are safe to SKIP for the collective-free timing run:
@@ -735,10 +737,36 @@ def profile_step(program, scope, feed: Dict, mesh=None,
     if plan["collectives"]:
         phase_ms_out["collective"] = coll_serial_ms
 
+    # feed staging (ISSUE 14): the H2D cost of this step's feed dict
+    # from HOST memory, hard-synced — what a naive per-step input
+    # pipeline pays on the critical path every step. Reported beside
+    # the compute phases (not inside phase_ms: the phase identities
+    # are device-compute conservation checks), as the before-number
+    # the async feeder (core/native_feed.AsyncDeviceFeeder) hides.
+    feed_ms = 0.0
+    if ctx["feed_vals"] and time.monotonic() <= deadline:
+        import jax
+
+        host_feed = [np.asarray(v) for v in ctx["feed_vals"].values()]
+
+        def _stage_feed():
+            return [jax.device_put(v) for v in host_feed]
+
+        try:
+            feed_ms = _time_call(lambda: _stage_feed(), (),
+                                 repeats) * 1e3
+        except Exception:
+            feed_ms = 0.0
+
     prof = {
         "method": "phase-sliced reexecution + collective microbench",
         "step_ms": t_full * 1e3,
         "phase_ms": phase_ms_out,
+        # flat copies bench records / tools/bench_diff.py watch
+        # directly (descending into a dict-valued metric is not in the
+        # diff schema)
+        "feed_ms": feed_ms,
+        "optimizer_ms": phase_ms.get("optimizer", 0.0),
         "segments_ms": seg_times,
         "compute_ms": compute_ms,
         "collective_ms": coll_serial_ms,
@@ -792,6 +820,8 @@ def _emit_profile(prof: Dict) -> None:
     if prof["exposed_collective_ms"] is not None:
         _obs.set_gauge("profile.exposed_collective_ms",
                        prof["exposed_collective_ms"])
+    if prof.get("feed_ms") is not None:
+        _obs.set_gauge("profile.feed_ms", prof["feed_ms"])
     if tracing.active():
         t0 = time.perf_counter() * 1e6
         off = 0.0
@@ -933,6 +963,10 @@ _FLOPS_TABLE = {
     "flash_attention": ("attention", _fl_flash),
     "batch_norm": ("norm", _fl_first_input(8)),
     "layer_norm": ("norm", _fl_first_input(8)),
+    # fused epilogues (core/fusion.py): add + act (+ dropout) ~= 3
+    # elementwise passes; add + layer_norm = 1 + the norm's 8
+    "fused_bias_act": ("elementwise", _fl_first_input(3)),
+    "fused_residual_layer_norm": ("norm", _fl_first_input(9)),
     "softmax": ("elementwise", _fl_first_input(5)),
     "softmax_with_cross_entropy": ("loss", _fl_first_input(6)),
     "cross_entropy": ("loss", _fl_first_input(3)),
@@ -996,9 +1030,13 @@ def op_flops(op, block, state=None) -> Tuple[int, str]:
     grad = t.endswith("_grad")
     base = t[:-5] if grad else t
     if base in OPTIMIZER_OPS:
-        # a handful of elementwise passes over every param element
-        tot = sum(_prod(shp(n)) or 0
-                  for n in (op.input("Param") or [])[:1])
+        # a handful of elementwise passes over every param element;
+        # fused_optimizer carries a whole instance's params in one
+        # duplicable slot — same per-element cost, summed across them
+        params = op.input("Param") or []
+        if base != "fused_optimizer":
+            params = params[:1]
+        tot = sum(_prod(shp(n)) or 0 for n in params)
         return 4 * tot, "optimizer"
     cat, fn = _FLOPS_TABLE.get(base, (None, None))
     if fn is None:

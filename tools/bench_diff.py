@@ -42,6 +42,11 @@ WATCHED = (
     ("step_ms", -1), ("collective_bytes", -1),
     ("mfu_est", +1), ("overlap_frac", +1),
     ("critical_path_ms", -1), ("exposed_collective_ms", -1),
+    # ISSUE-14 single-chip phase attribution: the fused-optimizer /
+    # fused-epilogue / async-feed wins must show up HERE (optimizer
+    # phase time and critical-path feed cost strictly down) — and a
+    # change that silently regresses them fails the gate
+    ("feed_ms", -1), ("optimizer_ms", -1),
     # device-truth counterparts (XPlane-folded; observability/
     # device_trace.py) + the host-vs-device agreement ratio — a
     # silently-diverging host estimate (the number the bucket planner
@@ -74,6 +79,9 @@ WATCHED = (
 ABS_NOISE_FLOOR = {
     "step_ms": 2.0, "critical_path_ms": 2.0,
     "exposed_collective_ms": 2.0, "overlap_frac": 0.1,
+    # feed staging on a loaded box jitters at the ~ms level; the
+    # optimizer phase is a measured re-execution slice
+    "feed_ms": 1.0, "optimizer_ms": 2.0,
     "device_overlap_frac": 0.1, "device_critical_path_ms": 2.0,
     "host_device_agreement": 0.1,
     # serving latencies on a loaded CI box jitter in the single-digit
@@ -94,6 +102,10 @@ COUNTER_WATCH_GROWS_BAD = ("parallel.collective_bytes",
                            "parallel.collective_ops",
                            "executor.compile_fallbacks",
                            "ps.replication_bytes",
+                           # fused single-chip program op count
+                           # (tools/sc_smoke.py): deterministic —
+                           # growth means the fusion passes regressed
+                           "sc.program_ops",
                            # the serving smoke must stay error-free:
                            # any growth (including 0 -> n) is a bug
                            # the functional assertions may have missed
@@ -307,6 +319,25 @@ def _self_test():
             if r[1] == "overlap_frac"]
     assert pbad and pbad[0][-1], pbad
     assert not any(r[-1] for r in diff_records(p0, p0, 0.10))
+    # single-chip phase attribution (ISSUE 14): an optimizer_ms /
+    # feed_ms blowup past threshold+floor (fused update or async feed
+    # silently off) must flag; sub-floor feed jitter must not
+    f0 = {"extras": {"resnet50": {"images_per_sec": 100.0, "profile": {
+        "mfu_est": 0.2, "optimizer_ms": 5.0, "feed_ms": 0.5}}}}
+    f1 = {"extras": {"resnet50": {"images_per_sec": 100.0, "profile": {
+        "mfu_est": 0.2, "optimizer_ms": 40.0, "feed_ms": 9.5}}}}
+    fbad = {r[1] for r in diff_records(f0, f1, 0.5) if r[-1]}
+    assert {"optimizer_ms", "feed_ms"} <= fbad, fbad
+    f2 = {"extras": {"resnet50": {"images_per_sec": 100.0, "profile": {
+        "mfu_est": 0.2, "optimizer_ms": 5.5, "feed_ms": 0.9}}}}
+    assert not any(r[-1] for r in diff_records(f0, f2, 0.5)), \
+        list(diff_records(f0, f2, 0.5))
+    # a diag-level feed_ms (single-chip timed-loop measurement) also
+    # resolves through _lookup
+    g0d = {"extras": {"w": {"diag": {"feed_ms": 1.0}}}}
+    g1d = {"extras": {"w": {"diag": {"feed_ms": 30.0}}}}
+    gdbad = [r for r in diff_records(g0d, g1d, 0.5) if r[-1]]
+    assert gdbad and gdbad[0][1] == "feed_ms", gdbad
     # sub-floor jitter on a near-zero timing base must NOT flag
     # (0.2ms -> 0.5ms exposed time is scheduler noise, not a 150%
     # regression), while the same relative delta at real magnitude
