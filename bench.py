@@ -312,6 +312,7 @@ def _profile_record(step_s, flops_total, by_category=None, bf16=False,
                 "per_bucket": rep["per_bucket"],
                 "backward_segments": rep["backward_segments"],
                 "n_compute": rep["n_compute"],
+                "nranks": rep.get("nranks"),
                 "profiled_step_ms": rep["step_ms"],
                 "exposed_includes_fused_update":
                     rep["exposed_includes_fused_update"],
@@ -1122,6 +1123,26 @@ def bench_multichip_config(name, iters=None, quant=None, sharded=True):
 
     from paddle_tpu.analysis import schedule_record
 
+    # placement block (ISSUE 15): when a searched plan drove this run
+    # (PADDLE_TPU_PLACEMENT_PLAN), record its digest + predicted vs
+    # measured step time so bench_diff can watch predicted-vs-measured
+    # drift and flag a silent plan change between runs
+    placement = None
+    pl = getattr(main, "_placement_plan", None)
+    if pl is not None:
+        pred_ms = pl.get("predicted_step_ms")
+        placement = dict(pl)
+        placement["measured_step_ms"] = dt * 1e3
+        # agreement compares on the PROFILE clock (the tight re-jitted
+        # step measurement the cost model was fitted to); the bench
+        # wall-clock dt above carries harness overhead the model never
+        # saw and rides separately
+        prof_ms = (profile or {}).get("profiled_step_ms") or dt * 1e3
+        placement["profile_step_ms"] = prof_ms
+        placement["placement_agreement"] = (
+            min(pred_ms, prof_ms) / max(pred_ms, prof_ms)
+            if pred_ms and prof_ms else None)
+
     collective_rec = {
         "per_step": per_step,
         "pergrad_baseline_ops": base_ops,
@@ -1139,6 +1160,7 @@ def bench_multichip_config(name, iters=None, quant=None, sharded=True):
         # this block (mc_smoke's profile-guided replan cycle does)
         "bucket_ops": sum(1 for op in main.global_block().ops
                           if op.type in ("c_bucket_allreduce",
+                                         "c_bucket_allreduce_start",
                                          "c_sharded_update")),
         "bucket_plan": getattr(main, "_bucket_plan", None),
     }
@@ -1152,9 +1174,12 @@ def bench_multichip_config(name, iters=None, quant=None, sharded=True):
         "collective_bytes": per_step.get("parallel.collective_bytes", 0),
         "collective": collective_rec,
         "profile": profile,
+        "placement": placement,
         "knobs": {"bucket_mb": bucket_mb(), "quant": quant_mode(),
                   "sharded_update": sharded_update_enabled(),
-                  "bucket_plan": bucket_plan_mode()},
+                  "bucket_plan": bucket_plan_mode(),
+                  "placement_plan": os.environ.get(
+                      "PADDLE_TPU_PLACEMENT_PLAN", "") or None},
     }
 
 

@@ -268,6 +268,18 @@ else
 fi
 cp "$SC_OUT" "$SC_BASELINE"
 
+echo "== gate 7e: placement-synthesis smoke =="
+# ISSUE-15 acceptance (~60s): the dp=8 mlp placement search must emit
+# a verifier-clean plan artifact — every enumerated candidate gated
+# through verify_program + check_cross_rank BEFORE anything could
+# trace it (zero rejected, zero traced-before-verify), deterministic
+# winner digest from the same report+seed, canonical round-trip
+# through PADDLE_TPU_PLACEMENT_PLAN — and the winner's measured
+# step_ms must beat (<=) the size-plan baseline, with the bench
+# record carrying the plan digest + predicted-vs-measured agreement
+# that bench_diff watches for drift.
+python tools/placement_smoke.py
+
 echo "== gate 8: serving-fleet chaos drill =="
 # the ISSUE-11 acceptance drill (~45s): 2 supervised serving replicas
 # + a closed-loop FleetRouter driver under an RPC fault plan

@@ -88,6 +88,11 @@ class CollectiveSig:
 
 
 def _is_payload_collective(op_type: str) -> bool:
+    if op_type.endswith("_await"):
+        # the await half of an async pair slices a Pending buffer back
+        # into its members — the wire payload (and the deadlock
+        # surface) belongs to the matching _start op
+        return False
     return (op_type.startswith(_PAYLOAD_PREFIXES)
             or op_type in _PAYLOAD_TYPES)
 
@@ -166,6 +171,12 @@ def _double_reduce_findings(program) -> List[Finding]:
     reduce_ops = ("c_allreduce", "c_bucket_allreduce")
     last_reduced_at: Dict[str, int] = {}
     for i, op in enumerate(block.ops):
+        if op.type == "c_bucket_allreduce_await":
+            # the await WRITES the reduced value its start produced —
+            # neither a second reduction nor a mark-clearing fresh
+            # write (a later re-reduce of the same grad must still
+            # flag, so the start's mark survives the await)
+            continue
         if op.type.startswith(reduce_ops):
             for n in _payload_names(op):
                 prev = last_reduced_at.get(n)

@@ -128,8 +128,11 @@ def checked_rewrite(name: str):
 def reduced_grad_entries(program) -> Dict[str, List[Tuple[int, str]]]:
     """grad name -> [(op index, reduce kind)] over every form a grad
     reduction takes after the rewrite passes: per-grad in-place
-    ``c_allreduce_sum``, ``c_bucket_allreduce`` membership, and the
-    implicit flat psum inside ``c_sharded_update``."""
+    ``c_allreduce_sum``, ``c_bucket_allreduce`` membership, the
+    implicit flat psum inside ``c_sharded_update``, and the AWAIT half
+    of an async start/await pair (the op that writes the reduced value
+    back — the start issues the psum but binds no grad output, so
+    counting it too would double-count every async grad)."""
     block = program.global_block()
     entries: Dict[str, List[Tuple[int, str]]] = {}
     for i, op in enumerate(block.ops):
@@ -140,6 +143,9 @@ def reduced_grad_entries(program) -> Dict[str, List[Tuple[int, str]]]:
         elif op.type == "c_bucket_allreduce":
             for n in op.input("X"):
                 entries.setdefault(n, []).append((i, "bucket"))
+        elif op.type == "c_bucket_allreduce_await":
+            for n in op.output("Out"):
+                entries.setdefault(n, []).append((i, "bucket_async"))
         elif op.type == "c_sharded_update":
             for n in op.input("Grad"):
                 entries.setdefault(n, []).append((i, "sharded"))
@@ -399,11 +405,254 @@ class _FusedEpilogueContract(RewriteContract):
                   % (state["n_ops"], len(block.ops)))
 
 
+class _AsyncCollectiveContract(RewriteContract):
+    """parallel/scheduling.py schedule_async_collectives: every grad a
+    fused bucket reduced must still be reduced exactly once (now by the
+    await half), every start/await pair must be properly bracketed
+    (start before await, Pending written once and consumed by exactly
+    one await), and no NEW reader may slip in front of a grad's
+    reduction — the consumer barrier survives the split."""
+
+    name = "async_collective"
+
+    def pre(self, program):
+        entries = reduced_grad_entries(program)
+        block = program.global_block()
+        pre_readers: Dict[str, frozenset] = {}
+        for g, es in entries.items():
+            first = min(i for i, _ in es)
+            pre_readers[g] = frozenset(
+                op._id for op in block.ops[:first]
+                if g in op.input_arg_names)
+        multiset = sorted((g, len(es)) for g, es in entries.items())
+        return {"multiset": multiset, "pre_readers": pre_readers}
+
+    def post(self, program, state) -> None:
+        entries = reduced_grad_entries(program)
+        multiset = sorted((g, len(es)) for g, es in entries.items())
+        if multiset != state["multiset"]:
+            before = dict(state["multiset"])
+            after = dict(multiset)
+            _viol(self.name,
+                  "multiset of reduced grads changed: lost %s, gained "
+                  "%s — an async split must re-cover every grad via "
+                  "its await"
+                  % (sorted(set(before) - set(after)),
+                     sorted(set(after) - set(before))))
+        block = program.global_block()
+        starts: Dict[str, int] = {}   # pending name -> start index
+        start_ids = set()
+        awaited: Dict[str, int] = {}  # pending name -> await count
+        for i, op in enumerate(block.ops):
+            if op.type == "c_bucket_allreduce_start":
+                start_ids.add(op._id)
+                p = op.output("Pending")
+                if len(p) != 1:
+                    _viol(self.name,
+                          "start op #%d binds %d Pending outputs (want "
+                          "exactly 1)" % (i, len(p)))
+                if p[0] in starts:
+                    _viol(self.name,
+                          "Pending var %r written by two start ops "
+                          "(#%d and #%d)" % (p[0], starts[p[0]], i))
+                starts[p[0]] = i
+            elif op.type == "c_bucket_allreduce_await":
+                pending = op.input("Pending")
+                if not pending:
+                    _viol(self.name,
+                          "await op #%d binds no Pending input — "
+                          "nothing to slice the reduced values from"
+                          % i)
+                p = pending[0]
+                si = starts.get(p)
+                if si is None:
+                    _viol(self.name,
+                          "await op #%d consumes Pending %r with no "
+                          "earlier start op — the slice would read "
+                          "garbage (use-before-start)" % (i, p))
+                if sorted(op.input("X")) != sorted(op.output("Out")):
+                    _viol(self.name,
+                          "await op #%d rebinds outputs %s != members "
+                          "%s — some member grad would keep its "
+                          "UNREDUCED value"
+                          % (i, sorted(op.output("Out")),
+                             sorted(op.input("X"))))
+                if si is not None:
+                    members = set(op.input("X"))
+                    for j in range(si + 1, i):
+                        mid = block.ops[j]
+                        if mid.type == "c_bucket_allreduce_await":
+                            continue
+                        hit = members & set(mid.output_arg_names)
+                        if hit:
+                            _viol(self.name,
+                                  "op #%d (%s) WRITES member grad(s) "
+                                  "%s between the start (#%d) and its "
+                                  "await (#%d) — the await would "
+                                  "clobber that write with a "
+                                  "reduction of the stale value"
+                                  % (j, mid.type, sorted(hit), si, i))
+                awaited[p] = awaited.get(p, 0) + 1
+        orphans = sorted(set(starts) - set(awaited))
+        if orphans:
+            _viol(self.name,
+                  "start op(s) for Pending %s have no await — their "
+                  "member grads are never written back (the optimizer "
+                  "would apply UNREDUCED gradients)" % orphans)
+        multi = sorted(p for p, n in awaited.items() if n > 1)
+        if multi:
+            _viol(self.name,
+                  "Pending %s consumed by multiple awaits" % multi)
+        # consumer barrier: new readers ahead of a grad's reduction may
+        # only be the start ops the split itself inserted
+        for g, es in entries.items():
+            first = min(i for i, _ in es)
+            readers_now = {op._id for op in block.ops[:first]
+                           if g in op.input_arg_names}
+            leaked = readers_now \
+                - set(state["pre_readers"].get(g, frozenset())) \
+                - start_ids
+            if leaked:
+                ops_by_id = {op._id: (i, op.type)
+                             for i, op in enumerate(block.ops)}
+                _viol(self.name,
+                      "consumer-barrier ordering broken for grad %r: "
+                      "op(s) %s now read it BEFORE its reduction at op "
+                      "#%d — they would see an unreduced value"
+                      % (g, sorted(ops_by_id[x] for x in leaked),
+                         first))
+
+
+class _ReductionSwapContract(RewriteContract):
+    """parallel/scheduling.py swap_reduction_strategy: attr-only — the
+    op sequence (identities, types, slot bindings) must be untouched
+    and every strategy attr must name a registered spelling."""
+
+    name = "reduction_swap"
+
+    def pre(self, program):
+        block = program.global_block()
+        seq = [(op._id, op.type,
+                tuple(sorted((k, tuple(v)) for k, v in op.inputs.items())),
+                tuple(sorted((k, tuple(v)) for k, v in
+                             op.outputs.items())))
+               for op in block.ops]
+        return {"seq": seq}
+
+    def post(self, program, state) -> None:
+        from ..ops.collective_ops import REDUCTION_STRATEGIES
+
+        block = program.global_block()
+        seq = [(op._id, op.type,
+                tuple(sorted((k, tuple(v)) for k, v in op.inputs.items())),
+                tuple(sorted((k, tuple(v)) for k, v in
+                             op.outputs.items())))
+               for op in block.ops]
+        if seq != state["seq"]:
+            _viol(self.name,
+                  "reduction swap changed the op sequence/bindings — "
+                  "the pass may only flip strategy attrs (op count %d "
+                  "-> %d)" % (len(state["seq"]), len(seq)))
+        for i, op in enumerate(block.ops):
+            if op.type not in ("c_bucket_allreduce",
+                               "c_bucket_allreduce_start"):
+                continue
+            s = op.attrs.get("strategy", "ring")
+            if s not in REDUCTION_STRATEGIES:
+                _viol(self.name,
+                      "op #%d (%s) carries unknown reduction strategy "
+                      "%r — the lowering would raise inside shard_map "
+                      "(want one of %s)"
+                      % (i, op.type, s,
+                         ", ".join(REDUCTION_STRATEGIES)))
+
+
+class _BucketQuantContract(RewriteContract):
+    """parallel/scheduling.py configure_bucket_quant: attr/slot-only —
+    the op sequence is untouched, quant values are registered modes,
+    and every error-feedback Residual is wired CONSISTENTLY (ResidualOut
+    rebinds the same var, the var is declared, and its size is a whole
+    multiple of the bucket payload — one shard per replica)."""
+
+    name = "bucket_quant"
+
+    def pre(self, program):
+        block = program.global_block()
+        return {"op_ids": [(op._id, op.type) for op in block.ops]}
+
+    def post(self, program, state) -> None:
+        from ..ops.collective_ops import QUANT_WIRE_ITEMSIZE
+
+        block = program.global_block()
+        if [(op._id, op.type) for op in block.ops] != state["op_ids"]:
+            _viol(self.name,
+                  "bucket-quant reconfiguration changed the op "
+                  "sequence — it may only flip attrs and wire "
+                  "residual slots")
+        for i, op in enumerate(block.ops):
+            if op.type not in ("c_bucket_allreduce",
+                               "c_bucket_allreduce_start"):
+                continue
+            quant = op.attrs.get("quant", "none")
+            if quant not in QUANT_WIRE_ITEMSIZE:
+                _viol(self.name,
+                      "op #%d carries unknown quant mode %r" % (i, quant))
+            res_in = op.input("Residual")
+            res_out = op.output("ResidualOut")
+            if bool(res_in) != bool(res_out):
+                _viol(self.name,
+                      "op #%d binds Residual %s but ResidualOut %s — "
+                      "the error-feedback state would be read or "
+                      "written only half the time (residual silently "
+                      "frozen or lost)" % (i, res_in or "(unbound)",
+                                           res_out or "(unbound)"))
+            if not res_in:
+                continue
+            if res_in != res_out:
+                _viol(self.name,
+                      "op #%d reads residual %r but writes %r — the "
+                      "next step would fold in a STALE rounding error"
+                      % (i, res_in[0], res_out[0]))
+            if quant == "none":
+                _viol(self.name,
+                      "op #%d wires an error-feedback residual but is "
+                      "not quantized — the residual would never decay"
+                      % i)
+            rv = block._find_var_recursive(res_in[0])
+            if rv is None:
+                _viol(self.name,
+                      "op #%d residual var %r is not declared"
+                      % (i, res_in[0]))
+            import numpy as _np
+
+            total = 0
+            known = True
+            for n in op.input("X"):
+                v = block._find_var_recursive(n)
+                shp = getattr(v, "shape", None) if v is not None else None
+                if not shp or not all(isinstance(s, int) and s > 0
+                                      for s in shp):
+                    known = False
+                    break
+                total += int(_np.prod(shp))
+            rshape = getattr(rv, "shape", None)
+            if known and rshape and total and \
+                    int(_np.prod(rshape)) % total:
+                _viol(self.name,
+                      "op #%d residual var %r holds %d elements, not a "
+                      "whole multiple of the %d-element bucket payload "
+                      "— per-replica shards would misalign"
+                      % (i, res_in[0], int(_np.prod(rshape)), total))
+
+
 register_contract(_InsertAllreduceContract())
 register_contract(_BucketAllreduceContract())
 register_contract(_ShardedUpdateContract())
 register_contract(_FusedOptimizerContract())
 register_contract(_FusedEpilogueContract())
+register_contract(_AsyncCollectiveContract())
+register_contract(_ReductionSwapContract())
+register_contract(_BucketQuantContract())
 
 
 # ---------------------------------------------------------------------------

@@ -319,6 +319,11 @@ def build_phase_plan(program, max_bucket_cuts: int = 12,
     for i, (op, ph) in enumerate(zip(ops, phases)):
         if ph != "collective":
             continue
+        if op.type == "c_bucket_allreduce_await":
+            # the await half of an async pair carries no wire payload
+            # (its start op is the bucket entry) and is skip-safe by
+            # construction — removing the pair removes both halves
+            continue
         if not any(op.type.startswith(p) for p in _SKIP_SAFE_COLLECTIVES):
             skippable = False
         if op.type == "c_sharded_update":
@@ -345,7 +350,12 @@ def build_phase_plan(program, max_bucket_cuts: int = 12,
             continue
         numel = 0
         dtype = "float32"
-        for n in op.input_arg_names:
+        is_bucket = op.type.startswith("c_bucket_allreduce")
+        # bucket payload = the X members only (an error-feedback
+        # Residual input is device-local state, not wire traffic)
+        payload_names = op.input("X") if is_bucket \
+            else op.input_arg_names
+        for n in payload_names:
             if not n:
                 continue
             k, dtype = numel_and_dtype(block, state, n)
@@ -355,7 +365,7 @@ def build_phase_plan(program, max_bucket_cuts: int = 12,
         except TypeError:
             item = 4
         base_item = item
-        if op.type == "c_bucket_allreduce":
+        if is_bucket:
             q = QUANT_PSUM_ITEMSIZE.get(op.attrs.get("quant", "none"))
             item = q or item
         collectives.append({
@@ -369,6 +379,11 @@ def build_phase_plan(program, max_bucket_cuts: int = 12,
             "kind": ("allreduce" if "allreduce" in op.type
                      else op.type[2:]),
             "bytes": numel * item,
+            # placement-search fitter fields: which spelling and wire
+            # mode this measured point belongs to
+            "strategy": op.attrs.get("strategy", "ring")
+            if is_bucket else "ring",
+            "quant": op.attrs.get("quant", "none"),
             "avail_pos": None,  # filled below
         })
         bucket_no += 1
@@ -713,6 +728,10 @@ def profile_step(program, scope, feed: Dict, mesh=None,
         per_bucket.append({
             "bucket": c["bucket"], "op": c["type"], "kind": c["kind"],
             "bytes": c["bytes"], "collective_ms": c_ms,
+            # which reduction spelling / wire mode this measured point
+            # belongs to — the placement cost-model fit keys on these
+            "strategy": c.get("strategy", "ring"),
+            "quant": c.get("quant", "none"),
             # availability position in the compute-only op sequence —
             # stable across bucket plans (compute ops never move), so a
             # profile-guided replan can key its budgets on it
@@ -784,6 +803,11 @@ def profile_step(program, scope, feed: Dict, mesh=None,
         "backward_segments": [[start, end, ms]
                               for ms, start, end in bwd_segs],
         "n_compute": plan["n_compute"],
+        # mesh context for the placement cost-model fitter: the data
+        # fan-in the measured collective costs were taken at (strategy
+        # transfer factors scale with it)
+        "nranks": (int(np.prod([mesh.shape[a] for a in data_axes]))
+                   if mesh is not None and data_axes else 1),
         # a c_sharded_update fuses the optimizer math INTO the
         # collective op: both the exposed measurement (full minus
         # collective-free) and the serial microbench (which emulates

@@ -1,0 +1,112 @@
+"""The ``profile report → plan`` interface (ROADMAP: one registry any
+subsystem can steer from).
+
+A *steerer* is a named callable ``fn(report, **context) -> plan`` that
+turns a saved step-profile report (``profiler.profile_step`` output, or
+a bench record wrapping one) into a subsystem-specific plan. The PR-10
+profile-guided bucket planner was the first instance; the placement
+search (``paddle_tpu/placement``) is the second. Future consumers —
+the serving bucket ladder, lazy dygraph's recompile policy, the PS
+hot-shard migrator — register here instead of growing private report
+plumbing.
+
+Contract:
+
+- ``register_steerer(name, fn)`` — idempotent per name (re-registering
+  replaces; modules that register at import stay reload-safe);
+- ``steer(name, report, **context)`` — dispatch, with a
+  ``steering.plans{steerer=}`` counter per invocation;
+- ``load_report(path)`` — the ONE report loader every steerer shares:
+  accepts a raw ``profile_step`` dict, a bench record (unwraps its
+  ``profile`` block), or a path/env naming either; returns None (never
+  raises) on missing/garbage/field-incomplete documents so a deleted
+  report can never break a training step.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["register_steerer", "get_steerer", "steerers", "steer",
+           "load_report", "REPORT_FIELDS"]
+
+# the measured fields every steerer keys on: per-collective cost points
+# (the cost-model fit) and the backward compute timeline (the hide
+# budget). A document missing either is not a usable report.
+REPORT_FIELDS = ("per_bucket", "backward_segments")
+
+_lock = threading.Lock()
+_STEERERS: Dict[str, Callable] = {}
+
+
+def register_steerer(name: str, fn: Callable,
+                     description: str = "") -> Callable:
+    """Register ``fn(report, **context) -> plan`` under ``name``.
+    Re-registration replaces (import-reload safe). Returns ``fn`` so it
+    can be used as a decorator tail."""
+    if not name or not callable(fn):
+        raise ValueError("steerer needs a name and a callable")
+    with _lock:
+        _STEERERS[name] = fn
+        if description:
+            fn.__steering_doc__ = description
+    return fn
+
+
+def get_steerer(name: str) -> Optional[Callable]:
+    with _lock:
+        return _STEERERS.get(name)
+
+
+def steerers() -> List[str]:
+    with _lock:
+        return sorted(_STEERERS)
+
+
+def steer(name: str, report, **context):
+    """Dispatch ``report`` to the named steerer. Raises ``KeyError``
+    for an unknown steerer (a typo should fail loudly, unlike a
+    missing report)."""
+    fn = get_steerer(name)
+    if fn is None:
+        raise KeyError("no steerer registered under %r (have: %s)"
+                       % (name, ", ".join(steerers()) or "none"))
+    from . import inc as _inc
+
+    _inc("steering.plans", steerer=name)
+    return fn(report, **context)
+
+
+def load_report(path: Optional[str] = None,
+                env: str = "PADDLE_TPU_BUCKET_PROFILE",
+                required_fields=REPORT_FIELDS) -> Optional[Dict]:
+    """Load a step-profile report from ``path`` (or the env var when
+    path is None/empty). Unwraps a bench record's ``profile`` block.
+    Returns None — never raises — when the path is unset, unreadable,
+    not JSON, or missing any of ``required_fields``."""
+    if path is None:
+        path = os.environ.get(env, "").strip()
+    if not path:
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return coerce_report(doc, required_fields=required_fields)
+
+
+def coerce_report(doc, required_fields=REPORT_FIELDS) -> Optional[Dict]:
+    """The in-memory half of ``load_report``: unwrap + field-check an
+    already-parsed document (a plan artifact embeds its source report
+    inline — same validation, no file)."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("profile"), dict):
+        doc = doc["profile"]
+    for field in required_fields:
+        if not isinstance(doc.get(field), list):
+            return None
+    return doc

@@ -291,8 +291,58 @@ QUANT_WIRE_ITEMSIZE = {"none": None, "bf16": 2, "int8": 1}
 # backs the projected-native-savings estimate.
 QUANT_PSUM_ITEMSIZE = {"none": None, "bf16": 2, "int8": 4}
 
+# reduction-strategy spellings of the same psum (the placement search's
+# swap dimension — "Synthesizing Optimal Parallelism Placement and
+# Reduction Strategies", PAPERS.md):
+#   ring       one fused XLA collective (the default lowering)
+#   tree       reduce_scatter + all_gather decomposition — exposes
+#              the two phases to the scheduler as separate ops
+#   two_stage  hierarchical: one psum per mesh axis in sequence (on a
+#              dp x sp / 3D mesh, reduce inside the fast axis first);
+#              degenerates to ring on a 1-axis mesh
+REDUCTION_STRATEGIES = ("ring", "tree", "two_stage")
 
-def quantized_psum(x, axis, quant="none"):
+
+def _axes_tuple(axis):
+    return tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+
+
+def strategy_psum(x, axis, strategy="ring"):
+    """The same mathematical psum spelled per ``strategy`` (see
+    ``REDUCTION_STRATEGIES``). Integer payloads are exact under every
+    spelling; float payloads may differ in summation ORDER (tree /
+    two_stage re-associate), which is the documented bounded-difference
+    contract of the reduction-swap pass."""
+    if strategy in (None, "", "auto", "ring"):
+        return jax.lax.psum(x, axis)
+    axes = _axes_tuple(axis)
+    if strategy == "two_stage":
+        out = x
+        for a in axes:
+            out = jax.lax.psum(out, a)
+        return out
+    if strategy == "tree":
+        a0 = axes[0]
+        n = static_axis_size(a0)
+        flat = x.reshape(-1)
+        pad = (-flat.size) % n
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        shard = jax.lax.psum_scatter(flat, a0, tiled=True)
+        red = jax.lax.all_gather(shard, a0, tiled=True)
+        if pad:
+            red = red[:x.size]
+        red = red.reshape(x.shape)
+        for a in axes[1:]:
+            red = jax.lax.psum(red, a)
+        return red
+    raise ValueError("unknown reduction strategy %r (want one of %s)"
+                     % (strategy, ", ".join(REDUCTION_STRATEGIES)))
+
+
+def quantized_psum(x, axis, quant="none", strategy="ring",
+                   residual=None):
     """psum with an optional EQuARX-style compressed payload.
 
     - ``bf16``: the payload crosses the wire as bfloat16 (half the f32
@@ -305,16 +355,35 @@ def quantized_psum(x, axis, quant="none"):
       saturation). Worst-case absolute error per element is
       n * scale / 2 (each replica contributes at most half a step of
       rounding error) — the bound tests/test_collectives.py gates on.
+
+    ``strategy`` picks the reduction spelling (``strategy_psum``) for
+    the wire-crossing sum. ``residual`` arms EQuARX ERROR FEEDBACK:
+    the caller passes this replica's accumulated rounding error from
+    the previous step; it is folded into the payload BEFORE
+    quantization and the call returns ``(reduced, new_residual)`` —
+    the fresh local rounding error to carry forward. Over steps the
+    quantization bias cancels instead of compounding, which is what
+    makes int8 legal for the placement search to pick.
     """
     if quant in (None, "", "none"):
-        return jax.lax.psum(x, axis)
+        out = strategy_psum(x, axis, strategy)
+        return out if residual is None else (out, residual)
     if quant == "bf16":
-        return jax.lax.psum(x.astype(jnp.bfloat16), axis).astype(x.dtype)
+        src = x if residual is None else x + residual
+        q = src.astype(jnp.bfloat16)
+        out = strategy_psum(q, axis, strategy).astype(x.dtype)
+        if residual is None:
+            return out
+        return out, src - q.astype(x.dtype)
     if quant == "int8":
-        absmax = jax.lax.pmax(jnp.max(jnp.abs(x)), axis)
+        src = x if residual is None else x + residual
+        absmax = jax.lax.pmax(jnp.max(jnp.abs(src)), axis)
         scale = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(x.dtype)
-        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int32)
-        return jax.lax.psum(q, axis).astype(x.dtype) * scale
+        q = jnp.clip(jnp.round(src / scale), -127, 127).astype(jnp.int32)
+        out = strategy_psum(q, axis, strategy).astype(x.dtype) * scale
+        if residual is None:
+            return out
+        return out, src - q.astype(x.dtype) * scale
     raise ValueError("unknown quantized-allreduce mode %r" % (quant,))
 
 
@@ -324,11 +393,22 @@ def _flat_concat(xs):
     return jnp.concatenate([x.reshape(-1) for x in xs])
 
 
+def _slice_back(red, xs):
+    outs, off = [], 0
+    for x in xs:
+        k = int(x.size)
+        outs.append(red[off:off + k].reshape(x.shape))
+        off += k
+    return outs
+
+
 @register_op(
     "c_bucket_allreduce",
-    inputs=[In("X", duplicable=True)],
-    outputs=[Out("Out", duplicable=True, is_ref=True)],
-    attrs={"ring_id": 0, "quant": "none", "use_calc_stream": True},
+    inputs=[In("X", duplicable=True), In("Residual", dispensable=True)],
+    outputs=[Out("Out", duplicable=True, is_ref=True),
+             Out("ResidualOut", is_ref=True, dispensable=True)],
+    attrs={"ring_id": 0, "quant": "none", "strategy": "ring",
+           "use_calc_stream": True},
     grad=None,
 )
 def _c_bucket_allreduce(ins, attrs):
@@ -337,19 +417,83 @@ def _c_bucket_allreduce(ins, attrs):
     parallel/collectives.py for the scheduling rewrite). psum is
     elementwise over replicas, so concat-then-psum is bit-for-bit
     identical to psum-then-concat; quant != "none" opts into the
-    compressed payload."""
+    compressed payload; ``strategy`` picks the reduction spelling
+    (parallel/scheduling.py swaps it); a bound Residual arms EQuARX
+    error feedback — the slot holds THIS replica's shard of a
+    dp-sharded rounding-error var, folded into the payload before
+    quantization and rewritten after."""
     xs = ins["X"]
     axis = axis_for_ring(attrs.get("ring_id", 0))
     quant = attrs.get("quant", "none")
+    strategy = attrs.get("strategy", "ring")
+    residual = ins.get("Residual")
     if axis is None:
-        return {"Out": list(xs)}
-    red = quantized_psum(_flat_concat(xs), axis, quant)
-    outs, off = [], 0
-    for x in xs:
-        k = int(x.size)
-        outs.append(red[off:off + k].reshape(x.shape))
-        off += k
-    return {"Out": outs}
+        # dense fallback (nranks=1): identity, residual untouched
+        out = {"Out": list(xs)}
+        if residual is not None:
+            out["ResidualOut"] = residual
+        return out
+    flat = _flat_concat(xs)
+    if residual is not None:
+        red, new_res = quantized_psum(flat, axis, quant, strategy,
+                                      residual)
+        return {"Out": _slice_back(red, xs), "ResidualOut": new_res}
+    red = quantized_psum(flat, axis, quant, strategy)
+    return {"Out": _slice_back(red, xs)}
+
+
+@register_op(
+    "c_bucket_allreduce_start",
+    inputs=[In("X", duplicable=True), In("Residual", dispensable=True)],
+    outputs=[Out("Pending"),
+             Out("ResidualOut", is_ref=True, dispensable=True)],
+    attrs={"ring_id": 0, "quant": "none", "strategy": "ring",
+           "use_calc_stream": True},
+    grad=None,
+)
+def _c_bucket_allreduce_start(ins, attrs):
+    """First half of an ASYNC bucket reduction (parallel/scheduling.py
+    ``schedule_async_collectives``): issues the flat (possibly
+    quantized / strategy-re-spelled) psum into a ``Pending`` flat
+    buffer at the bucket's availability point; the matching
+    ``c_bucket_allreduce_await`` op slices it back into the grads just
+    before their first consumer. Every op between the pair is
+    data-independent of the collective, so XLA's scheduler is FREE to
+    overlap them — the latency hiding is scheduled by us, in the IR,
+    not hoped for."""
+    xs = ins["X"]
+    axis = axis_for_ring(attrs.get("ring_id", 0))
+    quant = attrs.get("quant", "none")
+    strategy = attrs.get("strategy", "ring")
+    residual = ins.get("Residual")
+    flat = _flat_concat(xs)
+    if axis is None:
+        # dense fallback: pending carries the unreduced concat — the
+        # await slices it back, preserving the identity semantics
+        out = {"Pending": flat}
+        if residual is not None:
+            out["ResidualOut"] = residual
+        return out
+    if residual is not None:
+        red, new_res = quantized_psum(flat, axis, quant, strategy,
+                                      residual)
+        return {"Pending": red, "ResidualOut": new_res}
+    return {"Pending": quantized_psum(flat, axis, quant, strategy)}
+
+
+@register_op(
+    "c_bucket_allreduce_await",
+    inputs=[In("Pending"), In("X", duplicable=True)],
+    outputs=[Out("Out", duplicable=True, is_ref=True)],
+    attrs={"ring_id": 0, "use_calc_stream": True},
+    grad=None,
+)
+def _c_bucket_allreduce_await(ins, attrs):
+    """Second half of the async pair: slices the Pending flat reduction
+    back into the member grads (in place). Carries NO wire payload of
+    its own — the collective-schedule checker excludes it (the start op
+    is the schedule entry); X is read only for member shapes."""
+    return {"Out": _slice_back(ins["Pending"], ins["X"])}
 
 
 # state slots each sharded-update optimizer carries, in (StateA, StateB)

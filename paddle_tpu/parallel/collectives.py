@@ -62,7 +62,6 @@ Knob summary (read once per program, at first mesh run):
 from __future__ import annotations
 
 import hashlib
-import json
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -131,24 +130,12 @@ def load_profile_report(path: Optional[str] = None) -> Optional[Dict]:
     vs bytes) and ``backward_segments`` (measured backward time per
     compute-position range). None when the path is unset/unreadable or
     the document lacks the required fields — callers fall back to the
-    size plan, never crash the step."""
-    if path is None:
-        path = os.environ.get("PADDLE_TPU_BUCKET_PROFILE", "").strip()
-    if not path:
-        return None
-    try:
-        with open(path, "r", encoding="utf-8") as f:
-            doc = json.load(f)
-    except (OSError, ValueError):
-        return None
-    if not isinstance(doc, dict):
-        return None
-    if isinstance(doc.get("profile"), dict):
-        doc = doc["profile"]
-    if not isinstance(doc.get("per_bucket"), list) \
-            or not isinstance(doc.get("backward_segments"), list):
-        return None
-    return doc
+    size plan, never crash the step. (Thin wrapper over the shared
+    ``observability.steering.load_report`` loader every report
+    consumer now goes through.)"""
+    from ..observability import steering
+
+    return steering.load_report(path)
 
 
 def sharded_update_enabled(build_strategy=None) -> bool:
@@ -205,20 +192,49 @@ def _numel_and_dtype(block, store, name) -> Tuple[Optional[int], str]:
 def maybe_rewrite_collectives(program, scope, nranks: int, data_axes,
                               build_strategy=None, multiproc=False) -> None:
     """Engine entry point: apply the sharded-update pass (opt-in), then
-    bucket whatever per-grad allreduces remain. Both passes are
-    idempotent per program (same contract as insert_allreduce_ops);
-    the knobs are read at the program's FIRST mesh run and baked in."""
+    bucket whatever per-grad allreduces remain, then the placement-era
+    schedule shaping (reduction-strategy spelling, per-bucket quant +
+    error feedback, async start/await — parallel/scheduling.py). All
+    passes are idempotent per program (same contract as
+    insert_allreduce_ops); the knobs are read at the program's FIRST
+    mesh run and baked in. With ``PADDLE_TPU_PLACEMENT_PLAN`` set, a
+    searched placement artifact (paddle_tpu/placement) OVERRIDES the
+    hand knobs wholesale — the plan names the same decisions the env
+    vars do, chosen by the verifier-gated search instead of an
+    operator."""
     if nranks <= 1 or not data_axes:
         return
-    quant = quant_mode()
-    if (sharded_update_enabled(build_strategy) and len(data_axes) == 1
-            and not multiproc):
+    from ..placement.plan import active_plan
+
+    pplan = active_plan()
+    if pplan is not None and not pplan.matches(nranks, data_axes):
+        from .. import observability as _obs
+
+        _obs.inc("placement.plan_skipped", reason="mesh_mismatch")
+        pplan = None
+    if pplan is not None and pplan.sharded_update \
+            and (len(data_axes) != 1 or multiproc):
+        # the plan's fused sharded update cannot run on this topology
+        # — skip the plan WHOLESALE (never apply its bucket/strategy
+        # half while silently dropping the update it was priced with)
+        from .. import observability as _obs
+
+        _obs.inc("placement.plan_skipped", reason="unsupported_topology")
+        pplan = None
+    quant = pplan.quant_mode if pplan is not None else quant_mode()
+    use_sharded = (pplan.sharded_update if pplan is not None
+                   else sharded_update_enabled(build_strategy))
+    if use_sharded and len(data_axes) == 1 and not multiproc:
         apply_sharded_weight_update(program, scope, nranks,
                                     axis=data_axes[0], quant=quant)
     resync_sharded_state(program, scope)
-    mb = bucket_mb(build_strategy)
-    plan = bucket_plan_mode()
-    report = load_profile_report() if plan == "profile" else None
+    if pplan is not None:
+        mb, plan, report = (pplan.bucket_mb, pplan.bucket_plan_mode,
+                            pplan.report)
+    else:
+        mb = bucket_mb(build_strategy)
+        plan = bucket_plan_mode()
+        report = load_profile_report() if plan == "profile" else None
     if mb > 0:
         bucket_allreduce_ops(program, bucket_bytes=int(mb * (1 << 20)),
                              quant=quant, scope=scope, plan=plan,
@@ -228,6 +244,34 @@ def maybe_rewrite_collectives(program, scope, nranks: int, data_axes,
         # into single-member bucket ops so the payload still compresses
         bucket_allreduce_ops(program, bucket_bytes=0, quant=quant,
                              scope=scope)
+    if getattr(program, "_placement_shaped", False):
+        return  # shaping already baked in (steady-state: one getattr)
+    program._placement_shaped = True
+    from .scheduling import (async_collectives_enabled,
+                             configure_bucket_quant,
+                             quant_error_feedback, reduce_strategy_mode,
+                             schedule_async_collectives,
+                             swap_reduction_strategy)
+
+    strategy = pplan.strategy if pplan is not None \
+        else reduce_strategy_mode()
+    if strategy != "ring":
+        swap_reduction_strategy(program, strategy)
+    ef = pplan.error_feedback if pplan is not None \
+        else quant_error_feedback()
+    qmodes = pplan.quant_buckets if pplan is not None else None
+    if ef or qmodes:
+        configure_bucket_quant(program, scope, nranks, data_axes[0],
+                               modes=qmodes, error_feedback=ef)
+    do_async = pplan.async_collectives if pplan is not None \
+        else async_collectives_enabled()
+    if do_async:
+        schedule_async_collectives(program, report=report, scope=scope)
+    if pplan is not None:
+        program._placement_plan = pplan.summary()
+        from .. import observability as _obs
+
+        _obs.inc("placement.plan_applied")
 
 
 # -- bucketed allreduce -----------------------------------------------------
@@ -721,3 +765,29 @@ def apply_sharded_weight_update(program, scope, nranks: int,
     _merge_data_axes(program, (axis,))
     _bump_version(program)
     return n_groups
+
+
+# -- steering registration ---------------------------------------------------
+# The PR-10 profile-guided bucket planner, exposed through the shared
+# `profile report → plan` registry (observability.steering) so every
+# report consumer — this planner, the placement search, future serving
+# / lazy-dygraph replanners — dispatches through ONE interface instead
+# of growing private report plumbing.
+
+
+def _steer_bucket_layout(report, items=None, bucket_bytes=4 << 20,
+                         compute_pos=None, **_ctx):
+    """``steer("bucket_layout", report, items=..., compute_pos=...)``
+    → the measured bucket layout (``plan_buckets_profile``), or None
+    when the report/context cannot drive a plan (callers fall back to
+    the size plan)."""
+    if report is None or items is None or compute_pos is None:
+        return None
+    return plan_buckets_profile(items, report, bucket_bytes, compute_pos)
+
+
+from ..observability import steering as _steering  # noqa: E402
+
+_steering.register_steerer(
+    "bucket_layout", _steer_bucket_layout,
+    "profile-guided gradient-bucket boundaries (PR 10)")

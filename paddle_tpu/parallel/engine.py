@@ -109,6 +109,10 @@ def _estimate_collective_bytes(program, state: Dict,
     for op in block.ops:
         if not op.type.startswith("c_"):
             continue
+        if op.type.endswith("_await"):
+            # the await half of an async pair moves no wire bytes —
+            # its start op already carried the payload
+            continue
         kind = next((k for sub, k in _COLLECTIVE_KINDS if sub in op.type),
                     "skip")
         if kind == "skip":
@@ -123,14 +127,18 @@ def _estimate_collective_bytes(program, state: Dict,
             _add("allreduce", 1, padded * wire_item, padded * item)
             _add("allgather", 1, padded * item, padded * item)
             continue
-        exact = sum(_var_nbytes(block, state, n)[0]
-                    for n in op.input_arg_names if n)
-        if op.type == "c_bucket_allreduce":
+        if op.type.startswith("c_bucket_allreduce"):
+            # payload = the X members only (an error-feedback Residual
+            # is device-local state, not wire traffic)
+            names = [n for n in op.input("X") if n]
+        else:
+            names = [n for n in op.input_arg_names if n]
+        exact = sum(_var_nbytes(block, state, n)[0] for n in names)
+        if op.type.startswith("c_bucket_allreduce"):
             item = 4
-            for n in op.input_arg_names:
-                if n:
-                    item = _var_nbytes(block, state, n)[1]
-                    break
+            for n in names:
+                item = _var_nbytes(block, state, n)[1]
+                break
             wire_item = _quant_wire_itemsize(op.attrs, item, native_wire)
             _add(kind, 1, int(exact * wire_item / item), exact)
         else:

@@ -1,0 +1,35 @@
+"""Placement synthesis (ISSUE 15): search the parallelism-plan space
+instead of hoping the operator picked well.
+
+Three pieces over two existing substrates — the measured profile
+reports (PR 7/10) and the machine-checkable plan safety net (PR 12):
+
+- :mod:`.cost_model` — per-collective ``a + b*bytes`` terms fitted to
+  a saved step-profile report (hand-estimate fallback), every score
+  tagged ``fitted`` vs ``analytic``;
+- :mod:`.search` — a beam over dp/mp/pp/sp/ep factorizations,
+  sharded-update, bucket layouts, reduction-strategy spellings,
+  per-bucket quantization (+ EQuARX error feedback) and async
+  start/await scheduling, where EVERY candidate is rewritten
+  symbolically and gated through ``verify_program`` +
+  ``check_cross_rank`` before it could ever be traced;
+- :mod:`.plan` — the winning configuration as a self-contained JSON
+  artifact the engine loads via ``PADDLE_TPU_PLACEMENT_PLAN`` (the
+  ``PADDLE_TPU_BUCKET_PROFILE`` pattern), emitted per model by
+  ``tools/placement_search.py``.
+"""
+from __future__ import annotations
+
+from .cost_model import (CostModel, analytic_cost_model,  # noqa: F401
+                         fit_cost_model)
+from .plan import (PLAN_ENV, PlacementPlan, active_plan,  # noqa: F401
+                   load_plan, save_plan)
+from .search import (Candidate, enumerate_meshes,  # noqa: F401
+                     model_capabilities, search_placement)
+
+__all__ = [
+    "CostModel", "fit_cost_model", "analytic_cost_model",
+    "PlacementPlan", "load_plan", "save_plan", "active_plan",
+    "PLAN_ENV", "Candidate", "enumerate_meshes", "model_capabilities",
+    "search_placement",
+]

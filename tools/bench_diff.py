@@ -69,6 +69,11 @@ WATCHED = (
     # here run-over-run
     ("ps_digest_ms", -1), ("rounds_per_s", +1),
     ("repl_delta_bytes_per_round", -1),
+    # placement records (ISSUE 15, bench `placement` block): how well
+    # the searched plan's PREDICTED step time tracks the measured one
+    # (min/max ratio). A collapse means the cost model drifted off the
+    # machine — the plan may still "work" while steering wrong.
+    ("placement_agreement", +1),
 )
 
 # absolute noise floors for measured-timing metrics: a relative
@@ -91,6 +96,8 @@ ABS_NOISE_FLOOR = {
     "serving_batch_size_mean": 1.0, "serving_padding_waste_frac": 0.15,
     # hashing time on a loaded CI box jitters; byte counts do not
     "ps_digest_ms": 5.0,
+    # predicted-vs-measured ratio moves with CI-box timing noise
+    "placement_agreement": 0.15,
 }
 
 # counter totals (metrics.json) where growth is a regression.
@@ -171,17 +178,37 @@ def diff_records(base, head, threshold):
             regressed = (-direction * rel) > threshold and \
                 abs(hv - bv) > ABS_NOISE_FLOOR.get(metric, 0.0)
             yield name, metric, bv, hv, rel, regressed
+        # a SILENT placement-plan change between runs is a regression:
+        # same workload, same knobs, different plan digest means the
+        # search (or its report) drifted without anyone deciding it
+        bd = _plan_digest(b)
+        hd = _plan_digest(h)
+        if bd and hd and bd != hd:
+            yield (name, "placement.plan_digest", bd[:12], hd[:12],
+                   float("inf"), True)
+
+
+def _plan_digest(rec):
+    p = rec.get("placement")
+    if isinstance(p, dict):
+        d = p.get("plan_digest")
+        if isinstance(d, str):
+            return d
+    return None
 
 
 def _lookup(rec, metric):
     """A metric straight off the record, or from its profile block
-    (mfu_est / overlap_frac / critical_path_ms), or from its diag
-    (single-chip collective_bytes lives there)."""
+    (mfu_est / overlap_frac / critical_path_ms), its diag (single-chip
+    collective_bytes lives there), or its placement block
+    (placement_agreement)."""
     v = rec.get(metric)
     if v is None and isinstance(rec.get("profile"), dict):
         v = rec["profile"].get(metric)
     if v is None and isinstance(rec.get("diag"), dict):
         v = rec["diag"].get(metric)
+    if v is None and isinstance(rec.get("placement"), dict):
+        v = rec["placement"].get(metric)
     if isinstance(v, (int, float)) and not isinstance(v, bool):
         return float(v)
     return None
@@ -420,6 +447,35 @@ def _self_test():
     g3bad = [r for r in diff_records(g0, g3, 0.5)
              if r[1] == "repl_delta_bytes_per_round"]
     assert g3bad and g3bad[0][-1], g3bad
+    # placement records (ISSUE 15): a predicted-vs-measured agreement
+    # collapse past threshold+floor must flag; sub-floor drift must
+    # not; and a SILENT plan-digest change between runs always flags
+    # while an unchanged plan never does
+    pl0 = {"configs": {"mlp": {"step_ms": 300.0, "placement": {
+        "plan_digest": "aaaa1111", "predicted_step_ms": 290.0,
+        "placement_agreement": 0.95}}}}
+    pl1 = {"configs": {"mlp": {"step_ms": 300.0, "placement": {
+        "plan_digest": "aaaa1111", "predicted_step_ms": 120.0,
+        "placement_agreement": 0.40}}}}
+    plbad = [r for r in diff_records(pl0, pl1, 0.10)
+             if r[1] == "placement_agreement"]
+    assert plbad and plbad[0][-1], plbad
+    pl2 = {"configs": {"mlp": {"step_ms": 300.0, "placement": {
+        "plan_digest": "aaaa1111", "predicted_step_ms": 280.0,
+        "placement_agreement": 0.88}}}}
+    assert not any(r[-1] for r in diff_records(pl0, pl2, 0.10)), \
+        list(diff_records(pl0, pl2, 0.10))
+    pl3 = {"configs": {"mlp": {"step_ms": 300.0, "placement": {
+        "plan_digest": "bbbb2222", "predicted_step_ms": 290.0,
+        "placement_agreement": 0.95}}}}
+    digrow = [r for r in diff_records(pl0, pl3, 0.10)
+              if r[1] == "placement.plan_digest"]
+    assert digrow and digrow[0][-1], digrow
+    assert not any(r[1] == "placement.plan_digest"
+                   for r in diff_records(pl0, pl0, 0.10))
+    # a run WITHOUT a placement block diffs cleanly against one with
+    assert not any(r[-1] for r in diff_records(
+        {"configs": {"mlp": {"step_ms": 300.0}}}, pl0, 0.10))
     print("bench_diff self-test ok")
     return 0
 

@@ -470,6 +470,150 @@ def _m_fused_epilogue_contract():
     return False, "dropped epilogue intermediate not flagged"
 
 
+def _build_async_input():
+    """Per-grad buckets (tiny cap) so several have real slack before
+    their first consumer — the shape the async split fires on."""
+    from paddle_tpu.parallel.collectives import bucket_allreduce_ops
+
+    main, _, loss = _build(bucket=False)
+    bucket_allreduce_ops(main, bucket_bytes=1)
+    return main, loss
+
+
+def _m_async_drop_await():
+    from paddle_tpu.analysis import ContractViolation
+    from paddle_tpu.analysis.contracts import contract_for
+    from paddle_tpu.parallel.scheduling import \
+        schedule_async_collectives
+
+    main, loss = _build_async_input()
+    contract = contract_for("async_collective")
+    state = contract.pre(main)
+    n = schedule_async_collectives(main)
+    assert n >= 1, "async pass split nothing"
+    block = main.global_block()
+    # sabotage: delete one await — its members would keep their
+    # UNREDUCED values and the optimizer applies divergent grads
+    block.ops = [op for op in block.ops
+                 if op.type != "c_bucket_allreduce_await"]
+    try:
+        contract.post(main, state)
+    except ContractViolation as e:
+        return "no await" in str(e) or "lost" in str(e), str(e)[:300]
+    return False, "dropped await not flagged"
+
+
+def _m_async_reader_before_await():
+    from paddle_tpu.analysis import ContractViolation
+    from paddle_tpu.analysis.contracts import contract_for
+    from paddle_tpu.parallel.scheduling import \
+        schedule_async_collectives
+
+    main, loss = _build_async_input()
+    contract = contract_for("async_collective")
+    state = contract.pre(main)
+    n = schedule_async_collectives(main)
+    assert n >= 1, "async pass split nothing"
+    block = main.global_block()
+    # sabotage: hoist a consumer of a reduced grad ABOVE its await —
+    # it would read the unreduced value (the exact hazard the
+    # consumer barrier exists to stop)
+    for ai, op in enumerate(block.ops):
+        if op.type != "c_bucket_allreduce_await":
+            continue
+        members = set(op.input("X"))
+        for j in range(ai + 1, len(block.ops)):
+            reader = block.ops[j]
+            if reader.type.startswith("c_bucket_allreduce"):
+                continue
+            if members & set(reader.input_arg_names):
+                block.ops.insert(ai, block.ops.pop(j))
+                try:
+                    contract.post(main, state)
+                except ContractViolation as e:
+                    return ("consumer-barrier" in str(e), str(e)[:300])
+                return False, "hoisted reader not flagged"
+    return False, "no reader found to hoist"
+
+
+def _m_async_writer_between_pair():
+    import paddle_tpu.framework as fw
+    from paddle_tpu.analysis import ContractViolation
+    from paddle_tpu.analysis.contracts import contract_for
+    from paddle_tpu.parallel.scheduling import \
+        schedule_async_collectives
+
+    main, loss = _build_async_input()
+    contract = contract_for("async_collective")
+    state = contract.pre(main)
+    n = schedule_async_collectives(main)
+    assert n >= 1, "async pass split nothing"
+    block = main.global_block()
+    # sabotage: splice a WRITER of a member grad between a start and
+    # its await — the await would clobber it with a reduction of the
+    # stale pre-write value
+    for si, op in enumerate(block.ops):
+        if op.type != "c_bucket_allreduce_start":
+            continue
+        g = op.input("X")[0]
+        w = fw.Operator(block, "scale", {"X": [g]}, {"Out": [g]},
+                        {"scale": 2.0, "bias": 0.0})
+        w._id = main._next_op_id()
+        block.ops.insert(si + 1, w)
+        break
+    try:
+        contract.post(main, state)
+    except ContractViolation as e:
+        return "clobber" in str(e), str(e)[:300]
+    return False, "writer between start/await not flagged"
+
+
+def _m_reduction_swap_bogus_strategy():
+    from paddle_tpu.analysis import ContractViolation
+    from paddle_tpu.analysis.contracts import contract_for
+    from paddle_tpu.parallel.scheduling import swap_reduction_strategy
+
+    main, _, loss = _build(bucket=True)
+    contract = contract_for("reduction_swap")
+    state = contract.pre(main)
+    swap_reduction_strategy(main, "tree")
+    # sabotage: corrupt the spelling to something no lowering knows —
+    # it would raise mid-trace inside shard_map on every rank
+    op = _op_of_type(main.global_block(), "c_bucket_allreduce")
+    op.attrs["strategy"] = "quantum_leap"
+    try:
+        contract.post(main, state)
+    except ContractViolation as e:
+        return "unknown reduction strategy" in str(e), str(e)[:300]
+    return False, "bogus strategy not flagged"
+
+
+def _m_bucket_quant_residual_mismatch():
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis import ContractViolation
+    from paddle_tpu.analysis.contracts import contract_for
+    from paddle_tpu.parallel.scheduling import configure_bucket_quant
+
+    scope = fluid.Scope()
+    main, _, loss = _build(bucket=True, scope=scope)
+    contract = contract_for("bucket_quant")
+    state = contract.pre(main)
+    n = configure_bucket_quant(main, scope, NRANKS, "dp", modes="int8",
+                               error_feedback=True)
+    assert n >= 1, "bucket-quant pass wired nothing"
+    # sabotage: drop the ResidualOut rebinding — the rounding error
+    # would be read every step but never updated (frozen feedback,
+    # silently compounding bias)
+    op = _op_of_type(main.global_block(), "c_bucket_allreduce")
+    assert op.input("Residual"), "residual was not wired"
+    op.outputs.pop("ResidualOut")
+    try:
+        contract.post(main, state)
+    except ContractViolation as e:
+        return "ResidualOut" in str(e), str(e)[:300]
+    return False, "dropped ResidualOut not flagged"
+
+
 def _m_lazy_graph():
     from paddle_tpu.analysis import IRVerificationError, verify_lazy_graph
 
@@ -524,6 +668,17 @@ MUTATIONS = [
      "fused", _m_fused_optimizer_double_update),
     ("fused-epilogue-drop-intermediate", "epilogue fusion loses a "
      "written var", _m_fused_epilogue_contract),
+    ("async-drop-await", "async split loses an await (grads never "
+     "written back)", _m_async_drop_await),
+    ("async-reader-before-await", "consumer hoisted above its await",
+     _m_async_reader_before_await),
+    ("async-writer-between-pair", "member grad written between start "
+     "and await (clobbered by the slice-back)",
+     _m_async_writer_between_pair),
+    ("reduction-swap-bogus-strategy", "strategy attr set off-registry",
+     _m_reduction_swap_bogus_strategy),
+    ("bucket-quant-residual-mismatch", "error-feedback ResidualOut "
+     "dropped (frozen residual)", _m_bucket_quant_residual_mismatch),
     ("lazy-graph-miswire", "flush graph wires a later node",
      _m_lazy_graph),
 ]

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Emit a per-model placement plan: search the parallelism space
+against a saved profile report and write the winning configuration as
+a ``PADDLE_TPU_PLACEMENT_PLAN`` artifact.
+
+The search is SYMBOLIC — no device, no tracing: every candidate plan
+is rewritten on a fresh program and gated through the static verifier
+(``verify_program`` + ``check_collective_schedule`` +
+``check_cross_rank``) before it is scored by the profile-fitted cost
+model. The audit (``--audit``) records every enumerated candidate with
+its verdict, predicted step time, and cost provenance
+(fitted | analytic) — the CI gate (tools/placement_smoke.py) asserts
+zero candidates were ever traced before passing the verifier.
+
+Usage:
+  tools/placement_search.py --model mlp --report profile.json \
+      --out plan.json [--devices 8] [--beam 4] [--seed 0]
+      [--audit audit.json] [--no-quant]
+
+``--report`` accepts a raw ``profiler.profile_step`` dict, a bench
+record (its ``profile`` block unwraps), or may be omitted — the search
+then runs on the analytic hand-estimate model and says so in every
+provenance tag.
+
+Run the emitted plan:
+  PADDLE_TPU_PLACEMENT_PLAN=plan.json python bench.py --mc-config=mlp
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _builder(model: str, devices: int):
+    """A fresh-program builder per supported model, reusing bench.py's
+    model zoo (built at per-replica batch where the model reshapes by
+    batch — the same contract as ``bench.py --mc-config``)."""
+    import bench
+
+    def build_mlp():
+        import paddle_tpu as fluid
+
+        with fluid.unique_name.guard():
+            main, _startup, loss = bench._build_mnist_mlp(512)
+        return main, loss.name
+
+    def build_resnet50():
+        import paddle_tpu as fluid
+
+        with fluid.unique_name.guard():
+            main, _s, loss, _b = bench._mc_build_resnet50(16, 96)
+        return main, loss.name
+
+    def build_bert():
+        import paddle_tpu as fluid
+
+        with fluid.unique_name.guard():
+            main, _s, loss, _u = bench._mc_build_bert(
+                max(1, 8 // devices), 128)
+        return main, loss.name
+
+    def build_gpt():
+        import paddle_tpu as fluid
+
+        with fluid.unique_name.guard():
+            main, _s, loss, _u = bench._mc_build_gpt(
+                max(1, 8 // devices), 512)
+        return main, loss.name
+
+    builders = {"mlp": build_mlp, "resnet50": build_resnet50,
+                "bert_base": build_bert, "gpt_long": build_gpt}
+    if model not in builders:
+        raise SystemExit("placement_search: unknown model %r (have: %s)"
+                         % (model, ", ".join(sorted(builders))))
+    return builders[model]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--model", default="mlp",
+                    help="model to plan for (mlp | resnet50 | "
+                         "bert_base | gpt_long)")
+    ap.add_argument("--report", default=None,
+                    help="saved profile report (profile_step dict or "
+                         "bench record); omit for the analytic model")
+    ap.add_argument("--out", required=True,
+                    help="plan artifact path (PADDLE_TPU_PLACEMENT_PLAN)")
+    ap.add_argument("--audit", default=None,
+                    help="also write the full candidate audit here")
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--beam", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-quant", action="store_true",
+                    help="exclude quantized-wire candidates")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability import steering
+    from paddle_tpu.placement import save_plan
+
+    report = None
+    if args.report:
+        report = steering.load_report(args.report)
+        if report is None:
+            raise SystemExit(
+                "placement_search: %r is not a usable profile report "
+                "(need per_bucket + backward_segments; pass nothing to "
+                "search on the analytic model instead)" % args.report)
+
+    builder = _builder(args.model, args.devices)
+    # dispatch through the steering registry — the one report->plan
+    # interface every subsystem registers against
+    plan, audit = steering.steer(
+        "placement", report, builder=builder, n_devices=args.devices,
+        beam_width=args.beam, seed=args.seed, model=args.model,
+        include_quant=not args.no_quant)
+
+    if args.audit:
+        with open(args.audit, "w") as f:
+            json.dump(audit, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print("placement_search: %s: enumerated %d candidate(s) "
+          "(%d verified, %d rejected, %d deduped, %d pruned, "
+          "%d unsupported mesh(es)); cost model: %s"
+          % (args.model, audit["enumerated"], audit["verified"],
+             audit["rejected"], audit["deduped"], audit["pruned"],
+             len(audit["unsupported"]), audit["cost_provenance"]))
+    if plan is None:
+        print("placement_search: NO candidate survived the static "
+              "gate — not writing a plan", file=sys.stderr)
+        return 1
+    digest = save_plan(plan, args.out)
+    w = audit["winner"]
+    print("placement_search: winner mesh=%s sharded_update=%s "
+          "bucket=%s strategy=%s quant=%s ef=%s async=%s"
+          % (w["mesh"], w["sharded_update"], w["bucket"],
+             w["strategy"], w["quant"]["mode"],
+             w["quant"]["error_feedback"], w["async_collectives"]))
+    print("placement_search: predicted step %.3f ms (%s); plan %s "
+          "-> %s" % (plan.predicted_step_ms or 0.0,
+                     plan.cost_provenance, digest[:12], args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
