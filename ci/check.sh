@@ -77,9 +77,12 @@ fi
 #    ... and (ISSUE 12) the default-off IR-verify hook must stay <1us
 #    per program run — PADDLE_TPU_VERIFY_IR is un-set here because
 #    this gate measures the DEFAULT path
+#    ... and (ISSUE 16) sampled in-production capture must default
+#    off with its per-step hook under the same <1us budget —
+#    PADDLE_TPU_SAMPLE_EVERY is un-set for the same reason
 env -u PADDLE_TPU_METRICS -u FLAGS_tpu_metrics \
     -u PADDLE_TPU_METRICS_DIR -u PADDLE_TPU_DEVICE_TRACE \
-    -u PADDLE_TPU_VERIFY_IR \
+    -u PADDLE_TPU_VERIFY_IR -u PADDLE_TPU_SAMPLE_EVERY \
     python -m paddle_tpu.tools.obs_overhead
 
 echo "== gate 5: serving =="
@@ -293,6 +296,20 @@ echo "== gate 8: serving-fleet chaos drill =="
 # rejoin chain in causal order, per-replica serving spans joining ONE
 # job trace) — not on logs.
 python tools/serving_chaos.py --smoke
+
+echo "== gate 8b: steering drill =="
+# the ISSUE-16 acceptance drill (seeded, in-process, ~10s): sampled
+# capture fires on exactly every Nth executor step and surfaces in
+# the merged metrics.json; the steering daemon proposes exactly ONCE
+# for a sustained breach (hysteresis resets on a clean poll, the
+# cooldown prevents a replan storm); a planted serving-ladder
+# regression ROLLS BACK and a planted improvement PROMOTES under the
+# shared comparator; and the audit closes — plan digests bit-match
+# across steering_audit.json, the flight ring, the proposal artifact
+# and the active-plan pointer, with installs == promoted entries
+# (zero un-audited plan switches, the PlanStore refuses structurally).
+env -u PADDLE_TPU_METRICS_DIR -u PADDLE_TPU_SAMPLE_EVERY \
+    python tools/steering_drill.py
 
 if [[ "${SKIP_TESTS:-0}" != "1" ]]; then
     echo "== gate 9: test suite =="

@@ -135,6 +135,15 @@ def _parse_args(argv=None):
                    help="comma-separated host:port per replica "
                         "(default: 127.0.0.1:<serving_started_port>+i)")
     p.add_argument("--serving_started_port", type=int, default=8200)
+    p.add_argument("--steering", action="store_true",
+                   help="supervise a steering daemon (observability."
+                        "steering_daemon) over the job's "
+                        "PADDLE_TPU_METRICS_DIR: it watches the merged "
+                        "sampled reports and emits PROPOSED plan "
+                        "artifacts (never applies; see README "
+                        "'Self-driving runtime')")
+    p.add_argument("--steering_interval", type=float, default=5.0,
+                   help="seconds between steering-daemon polls")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -228,7 +237,8 @@ class _Worker:
             # whole story, crash included
             name = {"pserver": "serverlog.%d",
                     "serving": "servinglog.%d",
-                    "witness": "witnesslog.%d"}.get(
+                    "witness": "witnesslog.%d",
+                    "steering": "steeringlog.%d"}.get(
                         self.role, "workerlog.%d") % self.local_rank
             self._fp = open(os.path.join(self.log_dir, name), "a")
             stdout = stderr = self._fp
@@ -442,6 +452,31 @@ def launch(args=None):
         servers.append(_Worker(
             i, [sys.executable, "-u", args.serving_script], env,
             args.log_dir, role="serving", metrics_dir=metrics_dir))
+
+    if getattr(args, "steering", False):
+        if not metrics_dir:
+            _log("--steering ignored: PADDLE_TPU_METRICS_DIR is unset "
+                 "(the daemon watches the merged job dump dir)")
+        else:
+            # the steering daemon is supervised exactly like a server
+            # (bounded relaunch, torn down after the trainers): it
+            # only READS the merged telemetry and WRITES proposal
+            # artifacts — a crashed daemon costs proposals, never
+            # training state, so relaunch is always safe
+            env = dict(os.environ)
+            env["PYTHONPATH"] = pkg_root + os.pathsep + \
+                env.get("PYTHONPATH", "")
+            env.update({
+                "PADDLE_ROLE": "steering",
+                "PADDLE_TRAINER_ID": "0",
+                "PADDLE_TPU_METRICS_DIR": metrics_dir,
+            })
+            servers.append(_Worker(
+                0, [sys.executable, "-u", "-m",
+                    "paddle_tpu.observability.steering_daemon",
+                    "--interval", str(args.steering_interval)],
+                env, args.log_dir, role="steering",
+                metrics_dir=metrics_dir))
 
     def _terminate_all(sig=signal.SIGTERM):
         for w in workers + servers:

@@ -746,6 +746,7 @@ class PSServer:
         # in its last periodic dump — the postmortem's smoking gun
         _flight.record("ps.round_apply", round=nxt,
                        vars=len(self._pending))
+        t_apply = time.monotonic()
         with _dtrace.child_span("ps.apply_round", cat="ps", round=nxt):
             # a dense round touches, by the family-locality contract,
             # its grad's base var and every @-companion of it: mark
@@ -778,6 +779,12 @@ class PSServer:
             self._step_migration_locked()
             self._replicate_locked()
             self._commit_migrations_locked()
+        # per-shard apply timing (ROADMAP hot-shard detector input):
+        # always-on like every ps.* family, labeled by shard so the
+        # merged dump shows which shard's optimize blocks run hot —
+        # the steering daemon's migration signal lands here first
+        _histogram("ps.apply_ms", shard=self._shard).observe(
+            (time.monotonic() - t_apply) * 1e3)
         _flight.record("ps.round_applied", round=self._applied_round)
         self._round_complete = True
         self._fetches_pending = True

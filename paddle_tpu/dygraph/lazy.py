@@ -26,7 +26,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["PendingValue", "LazyEngine", "is_pending", "aval_of"]
+__all__ = ["PendingValue", "LazyEngine", "is_pending", "aval_of",
+           "plan_lazy_policy", "apply_lazy_policy", "JIT_CACHE_CAP_MAX"]
 
 _obs_cache: List = []
 
@@ -319,3 +320,67 @@ class LazyEngine:
                 p.value = by_pos.get((ni, oj))
                 p._resolved = True
                 p._owners = []
+
+
+# -- recompile-vs-reuse policy steering (self-driving runtime) --------------
+#
+# The structural jit cache trades memory for retraces: a cap smaller
+# than the program's working set of flush signatures turns steady
+# state into an eviction→recompile treadmill (lazy.recompiles grows,
+# lazy.cache_hits stalls). The steering daemon watches that ratio;
+# this steerer turns it into a plan {"jit_cache_cap": N} the canary
+# can try on one replica before the fleet adopts it.
+
+JIT_CACHE_CAP_MAX = 512
+
+
+def plan_lazy_policy(recompiles, cache_hits, cache_cap=None):
+    """Propose a jit-cache cap from observed recompile/hit counts:
+    double the cap (bounded by ``JIT_CACHE_CAP_MAX``) while recompiles
+    dominate AND exceed the cap (signature working set larger than the
+    cache); keep it otherwise."""
+    cap = int(cache_cap if cache_cap is not None
+              else LazyEngine.JIT_CACHE_CAP)
+    r, h = max(0, int(recompiles)), max(0, int(cache_hits))
+    total = r + h
+    frac = (r / total) if total else 0.0
+    new_cap = cap
+    if total and frac > 0.5 and r > cap:
+        new_cap = min(JIT_CACHE_CAP_MAX, cap * 2)
+    return {"jit_cache_cap": new_cap, "prev_cap": cap,
+            "recompile_frac": round(frac, 6),
+            "recompiles": r, "cache_hits": h}
+
+
+def _steer_lazy_policy(report, recompiles=None, cache_hits=None,
+                       cache_cap=None, **_ctx):
+    """``report → plan`` steerer: counts come from context (the daemon
+    reads them off the merged counters); falls back to the live
+    process registry so a manual ``steer("lazy_policy", None)`` works
+    inside a running job."""
+    if recompiles is None or cache_hits is None:
+        obs = _obs()
+        recompiles = obs.counter_value("lazy.recompiles")
+        cache_hits = obs.counter_value("lazy.cache_hits")
+    return plan_lazy_policy(recompiles, cache_hits,
+                            cache_cap=cache_cap)
+
+
+def apply_lazy_policy(plan, engine_cls=None):
+    """Install a promoted policy plan: sets the (class-level) jit
+    cache cap. The canary's apply/rollback hooks call this with the
+    proposed and the incumbent plan respectively."""
+    cls = engine_cls or LazyEngine
+    cap = int(plan["jit_cache_cap"])
+    if not 1 <= cap <= JIT_CACHE_CAP_MAX:
+        raise ValueError("jit_cache_cap %d outside [1, %d]"
+                         % (cap, JIT_CACHE_CAP_MAX))
+    cls.JIT_CACHE_CAP = cap
+    return cap
+
+
+from ..observability import steering as _steering  # noqa: E402
+
+_steering.register_steerer(
+    "lazy_policy", _steer_lazy_policy,
+    "recompile-vs-reuse jit-cache policy from flush counters (ISSUE 16)")

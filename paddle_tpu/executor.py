@@ -102,9 +102,19 @@ class Executor:
                         run_args = lowered
                 if run_args is not None:
                     try:
-                        return run_compiled_program(
+                        out = run_compiled_program(
                             self._core, run_args[0], scope, run_args[1],
                             fetch_list, return_numpy)
+                        # sampled in-production capture
+                        # (PADDLE_TPU_SAMPLE_EVERY): every Nth
+                        # successful compiled step re-profiles the
+                        # live program into a rolling report for the
+                        # steering daemon — default off, one branch
+                        from .observability import capture as _capture
+
+                        _capture.maybe_sample_step(
+                            "executor", run_args[0], scope, run_args[1])
+                        return out
                     except (NotImplementedError, TypeError) as e:
                         # e.g. a while carry whose shape/dtype varies
                         # across trips — valid for the host interpreter,

@@ -1,0 +1,278 @@
+"""Canary-gated plan rollout: apply to ONE replica, compare, decide.
+
+The apply half of the self-driving runtime. A proposal (from the
+steering daemon, or any ``report → plan`` steerer run by hand) never
+reaches the fleet directly: it is applied to a single canary —
+a serving fleet points one replica at the new bucket ladder, a
+training job re-launches one config under the new placement plan —
+measured, and compared against the incumbent with the SAME comparator
+CI gates on (``observability/comparator.py``, the extracted
+``bench_diff`` core). Then:
+
+- PROMOTE: no watched metric regressed (and, when the caller demands
+  it, the triggering metric actually improved) — the plan is
+  installed as the fleet's active plan through the ``PlanStore``
+  pointer (``PADDLE_TPU_PLACEMENT_PLAN`` for placement, the ladder
+  for serving policies);
+- ROLL BACK: any watched regression — the incumbent stays, the canary
+  is reverted via ``rollback_fn``.
+
+Every decision is flight-recorded (``canary.promoted`` /
+``canary.rolled_back`` instants with the plan digest — they land in
+the merged ``trace.json`` like every flight event) and appended to the
+``steering_audit.json`` trail. The ``PlanStore`` is the ONLY writer of
+the active-plan pointer and *refuses to install without an audit
+entry*: a plan switch that skipped the audit trail is structurally
+impossible, which is exactly what ``tools/steering_drill.py`` checks.
+
+Audit entry schema (``steering_audit_v1``)::
+
+    {"seq": n, "t": epoch_seconds, "decision": "promoted"|"rolled_back",
+     "steerer": str|None, "plan_digest": sha1,
+     "verdict": "ok"|"regression"|"no_overlap",
+     "regressions": int, "regressed_metrics": [str, ...],
+     "trigger": {...proposal trigger block or null...},
+     "comparison": {...Comparison.to_dict()...}}
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from . import comparator, flight, steering
+from . import inc as _inc
+
+__all__ = ["AuditTrail", "PlanStore", "CanaryDecision", "run_canary",
+           "AUDIT_SCHEMA", "AUDIT_NAME"]
+
+AUDIT_SCHEMA = "steering_audit_v1"
+AUDIT_NAME = "steering_audit.json"
+
+
+class AuditTrail:
+    """Append-only JSON trail of steering decisions. The whole file is
+    rewritten atomically per append (decisions are rare — human-scale
+    events, not a hot path), so a reader never sees a torn trail and a
+    crash between appends loses nothing already written."""
+
+    def __init__(self, path: str):
+        if os.path.isdir(path):
+            path = os.path.join(path, AUDIT_NAME)
+        self.path = path
+        self._lock = threading.Lock()
+
+    def entries(self) -> List[Dict]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return []
+        if isinstance(doc, dict) and isinstance(doc.get("entries"),
+                                                list):
+            return doc["entries"]
+        return []
+
+    def append(self, entry: Dict) -> Dict:
+        from ..checkpoint import atomic_write_bytes
+
+        with self._lock:
+            entries = self.entries()
+            entry = dict(entry)
+            entry["seq"] = len(entries)
+            entry.setdefault("t", time.time())
+            entries.append(entry)
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            atomic_write_bytes(self.path, json.dumps(
+                {"schema": AUDIT_SCHEMA, "entries": entries},
+                indent=2, sort_keys=True, default=str).encode())
+        return entry
+
+
+class PlanStore:
+    """The fleet's active-plan pointer for one steerer:
+    ``active_plan-<steerer>.json``. The ONLY legal write path is
+    ``install`` — and install demands the audit entry that justified
+    the switch, so an un-audited plan switch cannot be expressed."""
+
+    def __init__(self, dirname: str, steerer: str):
+        self.dirname = dirname
+        self.steerer = steerer
+        self.path = os.path.join(dirname,
+                                 "active_plan-%s.json" % steerer)
+        self.installs = 0
+
+    def read(self) -> Optional[Dict]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def active_digest(self) -> Optional[str]:
+        doc = self.read()
+        if isinstance(doc, dict):
+            d = doc.get("plan_digest") or doc.get("digest")
+            if isinstance(d, str):
+                return d
+        return None
+
+    def install(self, plan, audit_entry: Dict) -> str:
+        """Atomically point the fleet at ``plan``. Refuses without the
+        audit entry recording the promotion (and cross-checks its
+        digest — the pointer and the trail can never disagree)."""
+        from ..checkpoint import atomic_write_bytes
+
+        if not isinstance(audit_entry, dict) \
+                or audit_entry.get("decision") != "promoted":
+            raise ValueError(
+                "PlanStore.install requires the audit entry of a "
+                "promotion — un-audited plan switches are not a thing")
+        digest = steering.plan_digest(plan)
+        if audit_entry.get("plan_digest") != digest:
+            raise ValueError(
+                "audit entry digest %r does not match plan %r"
+                % (audit_entry.get("plan_digest"), digest))
+        doc = {"schema": "active_plan_v1",
+               "steerer": self.steerer,
+               "plan": steering.plan_jsonable(plan),
+               "plan_digest": digest,
+               "audit_seq": audit_entry.get("seq"),
+               "installed_at": time.time()}
+        os.makedirs(self.dirname, exist_ok=True)
+        atomic_write_bytes(self.path, json.dumps(
+            doc, indent=2, sort_keys=True, default=str).encode())
+        self.installs += 1
+        return digest
+
+
+class CanaryDecision:
+    """What ``run_canary`` returns: the verdict plus everything needed
+    to assert on it."""
+
+    __slots__ = ("promoted", "reason", "plan", "plan_digest",
+                 "comparison", "audit_entry")
+
+    def __init__(self, promoted, reason, plan, plan_digest,
+                 comparison, audit_entry):
+        self.promoted = bool(promoted)
+        self.reason = reason
+        self.plan = plan
+        self.plan_digest = plan_digest
+        self.comparison = comparison
+        self.audit_entry = audit_entry
+
+    @property
+    def decision(self) -> str:
+        return "promoted" if self.promoted else "rolled_back"
+
+    def __repr__(self):
+        return "CanaryDecision(%s, %s, plan=%s)" % (
+            self.decision, self.reason, self.plan_digest[:12])
+
+
+def run_canary(proposal, incumbent, measure: Callable,
+               *, steerer: Optional[str] = None,
+               threshold: float = 0.10,
+               counters_threshold: float = 0.25,
+               apply_fn: Optional[Callable] = None,
+               promote_fn: Optional[Callable] = None,
+               rollback_fn: Optional[Callable] = None,
+               plan_store: Optional[PlanStore] = None,
+               audit: Optional[AuditTrail] = None,
+               require_improvement: Optional[str] = None,
+               min_improvement: float = 0.0) -> CanaryDecision:
+    """One canary evaluation of ``proposal`` against ``incumbent``.
+
+    - ``proposal``: a daemon proposal artifact (``{"plan": ...,
+      "plan_digest": ...}``) or a bare plan;
+    - ``incumbent``: the incumbent's measured record (any shape the
+      comparator understands — bench record or merged metrics.json);
+    - ``measure(plan) -> record``: run the canary replica/config under
+      the plan and return its record. The caller owns HOW (one
+      FleetRouter replica, one re-launched config) — this function
+      owns the decision protocol;
+    - ``apply_fn(plan)``: point the canary at the plan before
+      measuring (optional when ``measure`` applies internally);
+    - ``promote_fn(plan)`` / ``rollback_fn(plan)``: roll the plan out
+      to the fleet / revert the canary. Called AFTER the audit entry
+      exists — the trail records the decision before the world
+      changes;
+    - ``require_improvement``: a watched metric name that must have
+      improved by more than ``min_improvement`` (direction-aware) for
+      promotion — "no regression" alone keeps a pointless plan out of
+      the fleet when set.
+
+    Promotion requires verdict ``ok`` — a canary whose record shares
+    NOTHING with the incumbent (``no_overlap``) rolls back: a blind
+    promote is worse than a spurious rollback.
+    """
+    if isinstance(proposal, dict) and "plan" in proposal:
+        plan = proposal["plan"]
+        trigger = {k: proposal.get(k) for k in
+                   ("steerer", "metric", "baseline", "observed",
+                    "threshold", "created_at") if k in proposal}
+        steerer = steerer or proposal.get("steerer")
+        digest = proposal.get("plan_digest") \
+            or steering.plan_digest(plan)
+    else:
+        plan = proposal
+        trigger = None
+        digest = steering.plan_digest(plan)
+
+    if apply_fn is not None:
+        apply_fn(plan)
+    head = measure(plan)
+    cmp = comparator.compare(incumbent, head, threshold,
+                             counters_threshold)
+
+    promoted = cmp.ok
+    reason = cmp.verdict
+    if promoted and require_improvement:
+        gain = cmp.improvement(require_improvement)
+        if gain is None or gain <= min_improvement:
+            promoted = False
+            reason = "no_improvement:%s" % require_improvement
+
+    entry = {
+        "schema": AUDIT_SCHEMA,
+        "decision": "promoted" if promoted else "rolled_back",
+        "reason": reason,
+        "steerer": steerer,
+        "plan_digest": digest,
+        "verdict": cmp.verdict,
+        "regressions": cmp.regressions,
+        "regressed_metrics": cmp.regressed_metrics,
+        "trigger": trigger,
+        "comparison": cmp.to_dict(),
+    }
+    if audit is not None:
+        entry = audit.append(entry)
+
+    if promoted:
+        if plan_store is not None:
+            if audit is None:
+                raise ValueError(
+                    "a PlanStore promotion requires an AuditTrail — "
+                    "every plan switch must be audited")
+            plan_store.install(plan, entry)
+        if promote_fn is not None:
+            promote_fn(plan)
+        _inc("canary.promoted", steerer=steerer or "none")
+        flight.record("canary.promoted", steerer=steerer,
+                      plan_digest=digest, verdict=cmp.verdict,
+                      regressions=cmp.regressions)
+    else:
+        if rollback_fn is not None:
+            rollback_fn(plan)
+        _inc("canary.rolled_back", steerer=steerer or "none")
+        flight.record("canary.rolled_back", steerer=steerer,
+                      plan_digest=digest, verdict=cmp.verdict,
+                      reason=reason,
+                      regressions=cmp.regressions)
+
+    return CanaryDecision(promoted, reason, plan, digest, cmp, entry)

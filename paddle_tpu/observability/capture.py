@@ -1,0 +1,184 @@
+"""Sampled in-production capture: ``PADDLE_TPU_SAMPLE_EVERY=N``.
+
+The PR-7 step profiler (``profiler.profile_step``) and PR-10 device
+capture were built as *offline* tools — bench.py runs them once and a
+human reads the report. A production job drifts: data distributions
+shift, a quiet neighbor starts compiling, a new checkpoint changes the
+backward timeline. This module runs the SAME machinery on every Nth
+executor/engine step of a real job, writing a rolling per-process
+profile report into the ``PADDLE_TPU_METRICS_DIR`` dump pipeline so
+``merge_job_dir`` can surface per-rank phase/overlap/agreement drift —
+the live telemetry the steering daemon watches.
+
+Contract (gate-4 enforced by ``tools/obs_overhead.py``):
+
+- default OFF — ``PADDLE_TPU_SAMPLE_EVERY`` unset/0 means the
+  steady-state hook is one memoized-int load + a branch, well under
+  the <1µs per-step budget;
+- the capture itself must NEVER break a training step: every failure
+  is swallowed into a ``capture.errors`` counter + flight event;
+- reports are ROLLING: one ``<role>-<rank>.profile.json`` per process
+  (atomic replace, newest sample wins) carrying a bounded history of
+  compact summaries so the daemon can see a trend, not just a point.
+
+The report file deliberately does NOT carry the process-dump schema —
+``distributed.load_dumps`` skips it, ``load_sampled_profiles`` reads
+it, and the merge attaches it to the process's section.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["sample_every", "sampling_enabled", "maybe_sample_step",
+           "SAMPLED_PROFILE_SCHEMA", "HISTORY_CAP"]
+
+SAMPLED_PROFILE_SCHEMA = "sampled_profile_v1"
+HISTORY_CAP = 32
+
+# memoized knob: None = env not read yet, 0 = off, N>0 = every Nth step.
+# A single module-global load keeps the disabled hook sub-µs.
+_SAMPLE_EVERY: Optional[int] = None
+
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}       # engine kind -> steps seen
+_history: Dict[str, list] = {}     # engine kind -> compact summaries
+
+
+def sample_every() -> int:
+    """``PADDLE_TPU_SAMPLE_EVERY`` as a non-negative int (0 = off),
+    read once and memoized."""
+    global _SAMPLE_EVERY
+    n = _SAMPLE_EVERY
+    if n is None:
+        raw = os.environ.get("PADDLE_TPU_SAMPLE_EVERY", "").strip()
+        try:
+            n = max(0, int(raw)) if raw else 0
+        except ValueError:
+            n = 0
+        _SAMPLE_EVERY = n
+    return n
+
+
+def sampling_enabled() -> bool:
+    return sample_every() > 0
+
+
+def _reset_for_tests() -> None:
+    global _SAMPLE_EVERY
+    with _lock:
+        _SAMPLE_EVERY = None
+        _counts.clear()
+        _history.clear()
+
+
+def maybe_sample_step(kind: str, program=None, scope=None, feed=None,
+                      mesh=None, axis_name: str = "dp"
+                      ) -> Optional[Dict]:
+    """The per-step hook the executors call AFTER a successful step.
+    Off: one global load + branch. On: every Nth call per ``kind``
+    profiles the just-run (program, scope, feed) and rolls the report
+    into the metrics dir. Returns the report on a sampled step (tests,
+    callers that want it), else None."""
+    n = _SAMPLE_EVERY
+    if n is None:
+        n = sample_every()
+    if not n:
+        return None
+    if program is None or scope is None or feed is None:
+        return None
+    with _lock:
+        c = _counts.get(kind, 0) + 1
+        _counts[kind] = c
+    if c % n:
+        return None
+    try:
+        return _capture(kind, c, program, scope, feed, mesh, axis_name)
+    except Exception as e:  # a broken capture must never break a step
+        from . import inc as _inc
+        from . import flight as _flight
+
+        _inc("capture.errors", engine=kind)
+        _flight.record("capture.error", engine=kind, step=c,
+                       error="%s: %s" % (type(e).__name__, e))
+        return None
+
+
+def _capture(kind, step, program, scope, feed, mesh, axis_name):
+    from . import inc as _inc
+    from . import flight as _flight
+    from . import profiler as _prof
+
+    budget = float(os.environ.get("PADDLE_TPU_SAMPLE_BUDGET_S", "20")
+                   or 20)
+    t0 = time.monotonic()
+    report = _prof.profile_step(program, scope, feed, mesh=mesh,
+                                axis_name=axis_name, repeats=1,
+                                budget_s=budget, max_bucket_cuts=6)
+    capture_ms = (time.monotonic() - t0) * 1e3
+    _inc("capture.samples", engine=kind)
+    _flight.record("capture.sampled", engine=kind, step=step,
+                   capture_ms=round(capture_ms, 3),
+                   step_ms=report.get("step_ms"),
+                   overlap_frac=report.get("overlap_frac"))
+    try:
+        # sampled phases join the process's chrome trace + gauges like
+        # a bench-run profile would
+        _prof._emit_profile(report)
+    except Exception:
+        # the report itself is still good — only the trace/gauge echo
+        # failed; count it rather than losing the sample
+        _inc("capture.emit_errors", engine=kind)
+    _write_rolling_report(kind, step, report, capture_ms)
+    return report
+
+
+def _summary(step, report, capture_ms):
+    out = {"step": step, "wrote_at": time.time(),
+           "capture_ms": round(capture_ms, 3)}
+    for k in ("step_ms", "overlap_frac", "critical_path_ms",
+              "exposed_collective_ms", "feed_ms", "optimizer_ms",
+              "host_device_agreement"):
+        v = report.get(k)
+        if isinstance(v, (int, float)):
+            out[k] = v
+    return out
+
+
+def _write_rolling_report(kind, step, report, capture_ms) -> None:
+    from .distributed import metrics_dir, process_identity
+    from ..checkpoint import atomic_write_bytes
+    import json
+
+    d = metrics_dir()
+    if not d:
+        return
+    role, rank, restart = process_identity()
+    base = "%s-%d" % (role, rank)
+    if restart:
+        base += ".r%d" % restart
+    with _lock:
+        hist = _history.setdefault(kind, [])
+        hist.append(_summary(step, report, capture_ms))
+        del hist[:-HISTORY_CAP]
+        doc = {
+            "schema": SAMPLED_PROFILE_SCHEMA,
+            "proc": base,
+            "role": role, "rank": rank, "restart": restart,
+            "engine": kind,
+            "step": step,
+            "sample_every": sample_every(),
+            "samples": len(hist),
+            "wrote_at": time.time(),
+            "profile": report,
+            "history": list(hist),
+        }
+    try:
+        os.makedirs(d, exist_ok=True)
+        atomic_write_bytes(
+            os.path.join(d, base + ".profile.json"),
+            json.dumps(doc, default=str).encode())
+    except OSError:
+        pass

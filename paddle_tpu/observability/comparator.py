@@ -1,0 +1,330 @@
+"""Watched-metric threshold comparator — the ONE comparison
+implementation behind both the CI perf gate (``tools/bench_diff.py``,
+now a thin CLI over this module) and the canary protocol
+(``observability/canary.py``).
+
+Compares per-workload numbers between a BASE and a HEAD record and
+flags every watched higher-is-better metric that regresses past a
+relative threshold (or lower-is-better one that grows past it), with
+absolute noise floors so sub-millisecond jitter on a near-zero base
+never reads as a 150% "regression". Understands all three record
+shapes this repo emits:
+
+- ``bench.py`` output           (``{"extras": {workload: {...}}}``)
+- ``bench.py --multichip``      (``{"configs": {config: {...}}}``)
+- merged job ``metrics.json``   (``{"counters_total": {counter: value}}``
+                                from observability.distributed.merge_job_dir)
+
+Two API layers:
+
+- the generator layer (``diff_records`` / ``diff_counters``) yields
+  raw tuples — the historical bench_diff surface, kept verbatim so the
+  CLI and existing callers stay byte-compatible;
+- ``compare(base, head)`` wraps both generators into a ``Comparison``
+  with a machine-readable verdict (``to_dict()`` is JSON-safe: the
+  ``rel=inf`` zero-base rows serialize as the string ``"inf"``), which
+  is what the canary audits and ``bench_diff --json`` emits.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "WATCHED", "ABS_NOISE_FLOOR", "COUNTER_WATCH_GROWS_BAD",
+    "load", "workloads", "counter_totals",
+    "diff_records", "diff_counters", "compare", "Comparison",
+]
+
+# per-workload metrics worth gating; direction: +1 higher is better,
+# -1 lower is better. The profile-block metrics (bench.py `profile`:
+# flops-derived mfu_est, measured overlap_frac / critical_path_ms)
+# resolve through the record's "profile" sub-dict — _lookup descends.
+WATCHED = (
+    ("images_per_sec", +1), ("tokens_per_sec", +1),
+    ("examples_per_sec", +1), ("steps_per_sec", +1),
+    ("tokens_or_images_per_sec", +1),
+    ("step_ms", -1), ("collective_bytes", -1),
+    ("mfu_est", +1), ("overlap_frac", +1),
+    ("critical_path_ms", -1), ("exposed_collective_ms", -1),
+    # ISSUE-14 single-chip phase attribution: the fused-optimizer /
+    # fused-epilogue / async-feed wins must show up HERE (optimizer
+    # phase time and critical-path feed cost strictly down) — and a
+    # change that silently regresses them fails the gate
+    ("feed_ms", -1), ("optimizer_ms", -1),
+    # device-truth counterparts (XPlane-folded; observability/
+    # device_trace.py) + the host-vs-device agreement ratio — a
+    # silently-diverging host estimate (the number the bucket planner
+    # steers by) regresses agreement even when every host metric holds
+    ("device_overlap_frac", +1), ("device_critical_path_ms", -1),
+    ("host_device_agreement", +1),
+    # serving records (tools/serving_bench.py --out): closed-loop
+    # throughput/latency, queue wait, real batch size, padding waste,
+    # and the compile count the bucket ladder exists to bound — a
+    # serving regression fails CI exactly like a training one
+    ("rows_per_s", +1), ("p50_ms", -1), ("p99_ms", -1),
+    ("serving_queue_ms_p50", -1), ("serving_queue_ms_p99", -1),
+    ("serving_batch_size_mean", +1),
+    ("serving_padding_waste_frac", -1), ("jit_traces", -1),
+    # PS scale records (tools/ps_scale_bench.py): the per-round
+    # blake2b bill under incremental chunk digesting, and the delta
+    # wire bytes for the same touched-rows workload — a change that
+    # silently regresses incremental digesting back toward full
+    # re-hashing (or row slices back toward whole-table ships) fails
+    # here run-over-run
+    ("ps_digest_ms", -1), ("rounds_per_s", +1),
+    ("repl_delta_bytes_per_round", -1),
+    # placement records (ISSUE 15, bench `placement` block): how well
+    # the searched plan's PREDICTED step time tracks the measured one
+    # (min/max ratio). A collapse means the cost model drifted off the
+    # machine — the plan may still "work" while steering wrong.
+    ("placement_agreement", +1),
+)
+
+# absolute noise floors for measured-timing metrics: a relative
+# threshold alone turns sub-millisecond jitter on a near-zero base
+# (0.2ms -> 0.5ms exposed time on a tiny CI smoke) into a +150%
+# "regression". A delta must clear BOTH the relative threshold and
+# this absolute floor to flag. Deterministic metrics have no floor.
+ABS_NOISE_FLOOR = {
+    "step_ms": 2.0, "critical_path_ms": 2.0,
+    "exposed_collective_ms": 2.0, "overlap_frac": 0.1,
+    # feed staging on a loaded box jitters at the ~ms level; the
+    # optimizer phase is a measured re-execution slice
+    "feed_ms": 1.0, "optimizer_ms": 2.0,
+    "device_overlap_frac": 0.1, "device_critical_path_ms": 2.0,
+    "host_device_agreement": 0.1,
+    # serving latencies on a loaded CI box jitter in the single-digit
+    # ms; batch size / padding waste depend on thread-arrival raggedness
+    "p50_ms": 5.0, "p99_ms": 10.0,
+    "serving_queue_ms_p50": 5.0, "serving_queue_ms_p99": 10.0,
+    "serving_batch_size_mean": 1.0, "serving_padding_waste_frac": 0.15,
+    # hashing time on a loaded CI box jitters; byte counts do not
+    "ps_digest_ms": 5.0,
+    # predicted-vs-measured ratio moves with CI-box timing noise
+    "placement_agreement": 0.15,
+}
+
+# counter totals (metrics.json) where growth is a regression.
+# ps.replication_bytes guards the ISSUE-8 delta-replication win: a
+# code change that silently regresses the PS back to full-blob
+# shipping shows up as growth of the byte counters (and of the
+# mode=full series specifically) for the same drilled workload.
+COUNTER_WATCH_GROWS_BAD = ("parallel.collective_bytes",
+                           "parallel.collective_ops",
+                           "executor.compile_fallbacks",
+                           "ps.replication_bytes",
+                           # fused single-chip program op count
+                           # (tools/sc_smoke.py): deterministic —
+                           # growth means the fusion passes regressed
+                           "sc.program_ops",
+                           # the serving smoke must stay error-free:
+                           # any growth (including 0 -> n) is a bug
+                           # the functional assertions may have missed
+                           "serving.errors", "serving.batch_errors")
+
+
+def load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    # the bench driver wraps bench.py's JSON line as {"parsed": {...}}
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc
+
+
+def workloads(doc):
+    """{workload: record} from any of the three supported shapes."""
+    if "configs" in doc and isinstance(doc["configs"], dict):
+        return dict(doc["configs"])  # multichip bench
+    if "extras" in doc and isinstance(doc["extras"], dict):
+        return {k: v for k, v in doc["extras"].items()
+                if isinstance(v, dict) and not k.endswith("_error")}
+    return {}
+
+
+def counter_totals(doc):
+    # merged job metrics.json (merge_job_dir) names the key
+    # counters_total; accept the plain spelling too
+    for key in ("counters_total", "totals"):
+        if isinstance(doc.get(key), dict):
+            return doc[key]
+    if isinstance(doc.get("metrics_totals"), dict):
+        return doc["metrics_totals"]  # multichip bench embeds them
+    return {}
+
+
+def diff_records(base, head, threshold
+                 ) -> Iterator[Tuple[str, str, object, object,
+                                     float, bool]]:
+    """Yield (workload, metric, base, head, rel_delta, regressed)."""
+    b_wl, h_wl = workloads(base), workloads(head)
+    for name in sorted(set(b_wl) & set(h_wl)):
+        b, h = b_wl[name], h_wl[name]
+        for metric, direction in WATCHED:
+            bv, hv = _lookup(b, metric), _lookup(h, metric)
+            if bv is None or hv is None:
+                continue
+            if not bv:
+                # growth from a zero base has no relative delta: show
+                # the row (rel=inf) but don't hard-fail — a single-chip
+                # BASE vs multichip HEAD legitimately goes 0 -> N
+                # collective bytes, and the watched counter totals
+                # below still gate structural from-zero growth
+                if not hv:
+                    continue
+                yield name, metric, bv, hv, float("inf"), False
+                continue
+            rel = (hv - bv) / abs(bv)
+            regressed = (-direction * rel) > threshold and \
+                abs(hv - bv) > ABS_NOISE_FLOOR.get(metric, 0.0)
+            yield name, metric, bv, hv, rel, regressed
+        # a SILENT placement-plan change between runs is a regression:
+        # same workload, same knobs, different plan digest means the
+        # search (or its report) drifted without anyone deciding it
+        bd = _plan_digest(b)
+        hd = _plan_digest(h)
+        if bd and hd and bd != hd:
+            yield (name, "placement.plan_digest", bd[:12], hd[:12],
+                   float("inf"), True)
+
+
+def _plan_digest(rec):
+    p = rec.get("placement")
+    if isinstance(p, dict):
+        d = p.get("plan_digest")
+        if isinstance(d, str):
+            return d
+    return None
+
+
+def _lookup(rec, metric):
+    """A metric straight off the record, or from its profile block
+    (mfu_est / overlap_frac / critical_path_ms), its diag (single-chip
+    collective_bytes lives there), or its placement block
+    (placement_agreement)."""
+    v = rec.get(metric)
+    if v is None and isinstance(rec.get("profile"), dict):
+        v = rec["profile"].get(metric)
+    if v is None and isinstance(rec.get("diag"), dict):
+        v = rec["diag"].get(metric)
+    if v is None and isinstance(rec.get("placement"), dict):
+        v = rec["placement"].get(metric)
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    return None
+
+
+def diff_counters(base, head, threshold
+                  ) -> Iterator[Tuple[str, object, object, float, bool]]:
+    b_t, h_t = counter_totals(base), counter_totals(head)
+    for key in sorted(set(b_t) & set(h_t)):
+        bv, hv = b_t[key], h_t[key]
+        if not isinstance(bv, (int, float)):
+            continue
+        # exact key or its labeled series ("...{kind=...}") — a bare
+        # prefix test would also catch parallel.collective_bytes_saved,
+        # whose growth is an improvement
+        grows_bad = any(key == w or key.startswith(w + "{")
+                        for w in COUNTER_WATCH_GROWS_BAD)
+        if not bv:
+            if not hv:
+                continue
+            # zero -> nonzero growth of a watched counter is always a
+            # regression (e.g. the first compile fallback appearing)
+            yield key, bv, hv, float("inf"), grows_bad
+            continue
+        rel = (hv - bv) / abs(bv)
+        yield key, bv, hv, rel, grows_bad and rel > threshold
+
+
+class Comparison:
+    """The structured result of ``compare``: every row both generators
+    yielded, the regression count, and a one-word verdict the canary
+    writes into its audit trail."""
+
+    __slots__ = ("rows", "counter_rows", "threshold",
+                 "counters_threshold")
+
+    def __init__(self, rows, counter_rows, threshold,
+                 counters_threshold):
+        self.rows: List[tuple] = rows
+        self.counter_rows: List[tuple] = counter_rows
+        self.threshold = threshold
+        self.counters_threshold = counters_threshold
+
+    @property
+    def compared(self) -> int:
+        return len(self.rows) + len(self.counter_rows)
+
+    @property
+    def regressions(self) -> int:
+        return sum(1 for r in self.rows if r[-1]) + \
+            sum(1 for r in self.counter_rows if r[-1])
+
+    @property
+    def regressed_metrics(self) -> List[str]:
+        return [r[1] for r in self.rows if r[-1]] + \
+            [r[0] for r in self.counter_rows if r[-1]]
+
+    @property
+    def ok(self) -> bool:
+        return self.compared > 0 and self.regressions == 0
+
+    @property
+    def verdict(self) -> str:
+        """``"ok"`` | ``"regression"`` | ``"no_overlap"`` (nothing in
+        common to compare — treated as NOT ok: a canary that measured
+        nothing comparable must never promote)."""
+        if not self.compared:
+            return "no_overlap"
+        return "regression" if self.regressions else "ok"
+
+    def improvement(self, metric: str) -> Optional[float]:
+        """Signed relative improvement of ``metric`` across all
+        workload rows (positive = better, direction-aware); None when
+        the metric was not compared or sits on a zero base."""
+        directions = dict(WATCHED)
+        best = None
+        for _wl, m, _bv, _hv, rel, _bad in self.rows:
+            if m != metric or not math.isfinite(rel):
+                continue
+            gain = rel * directions.get(m, +1)
+            best = gain if best is None else max(best, gain)
+        return best
+
+    def to_dict(self) -> Dict:
+        """JSON-safe: non-finite relative deltas become ``"inf"``."""
+        def _rel(rel):
+            return rel if isinstance(rel, float) and math.isfinite(rel) \
+                else "inf"
+
+        return {
+            "verdict": self.verdict,
+            "ok": self.ok,
+            "compared": self.compared,
+            "regressions": self.regressions,
+            "threshold": self.threshold,
+            "counters_threshold": self.counters_threshold,
+            "rows": [
+                {"workload": wl, "metric": m, "base": bv, "head": hv,
+                 "rel": _rel(rel), "regressed": bool(bad)}
+                for wl, m, bv, hv, rel, bad in self.rows],
+            "counter_rows": [
+                {"counter": key, "base": bv, "head": hv,
+                 "rel": _rel(rel), "regressed": bool(bad)}
+                for key, bv, hv, rel, bad in self.counter_rows],
+        }
+
+
+def compare(base, head, threshold: float = 0.10,
+            counters_threshold: float = 0.25) -> Comparison:
+    """One call over both generators. ``base``/``head`` are already-
+    parsed record documents (use ``load`` for files)."""
+    return Comparison(
+        rows=list(diff_records(base, head, threshold)),
+        counter_rows=list(diff_counters(base, head,
+                                        counters_threshold)),
+        threshold=threshold,
+        counters_threshold=counters_threshold)

@@ -59,6 +59,7 @@ __all__ = ["TraceContext", "current", "set_current", "trace",
            "dump_path", "dump_process", "arm", "arm_from_env",
            "clear_stale_dumps", "job_trace_id", "fleet_round_args",
            "load_dumps", "doc_flight_events", "merge_job_dir",
+           "load_sampled_profiles", "sampled_profile_drift",
            "write_clock_ping", "record_clock_offset",
            "load_clock_offsets", "applied_clock_skew_us",
            "CLOCK_PING_ENV",
@@ -628,6 +629,64 @@ def load_dumps(dirname: str) -> List[Dict]:
     return out
 
 
+def load_sampled_profiles(dirname: str) -> Dict[str, Dict]:
+    """Every readable rolling sampled-capture report
+    (``<proc>.profile.json``, written by ``observability.capture``) in
+    ``dirname``, keyed by proc name. Foreign/torn json is skipped."""
+    out: Dict[str, Dict] = {}
+    if not os.path.isdir(dirname):
+        return out
+    for fn in sorted(os.listdir(dirname)):
+        if not fn.endswith(".profile.json"):
+            continue
+        try:
+            with open(os.path.join(dirname, fn), "r",
+                      encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) \
+                and doc.get("schema") == "sampled_profile_v1" \
+                and "proc" in doc:
+            out[doc["proc"]] = doc
+    return out
+
+
+# the per-rank profile numbers whose cross-rank spread the steering
+# daemon watches (a straggler rank shows up as step_ms/phase spread,
+# a drifting host estimate as agreement spread)
+_DRIFT_METRICS = ("step_ms", "overlap_frac", "critical_path_ms",
+                  "exposed_collective_ms", "feed_ms", "optimizer_ms",
+                  "host_device_agreement")
+
+
+def sampled_profile_drift(sampled: Dict[str, Dict]) -> Dict[str, Dict]:
+    """Per-metric cross-rank spread over the newest sampled reports:
+    ``{metric: {per_rank, min, max, spread}}``. Phases fold in as
+    ``phase_ms.<name>`` rows."""
+    series: Dict[str, Dict[str, float]] = {}
+    for proc, doc in sampled.items():
+        prof = doc.get("profile")
+        if not isinstance(prof, dict):
+            continue
+        for m in _DRIFT_METRICS:
+            v = prof.get(m)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                series.setdefault(m, {})[proc] = float(v)
+        ph = prof.get("phase_ms")
+        if isinstance(ph, dict):
+            for name, v in ph.items():
+                if isinstance(v, (int, float)):
+                    series.setdefault("phase_ms.%s" % name,
+                                      {})[proc] = float(v)
+    out: Dict[str, Dict] = {}
+    for m, per_rank in series.items():
+        vals = list(per_rank.values())
+        out[m] = {"per_rank": per_rank, "min": min(vals),
+                  "max": max(vals), "spread": max(vals) - min(vals)}
+    return out
+
+
 def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
     """Fold every per-process dump under ``dirname`` into
     ``metrics.json`` (per-process metric sections preserved under
@@ -711,12 +770,22 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
                 entry["args"] = fields
             events.append(entry)
     events.sort(key=lambda e: e["ts"])
+    # sampled in-production capture (observability/capture.py): attach
+    # each process's rolling profile report to its section and surface
+    # the cross-rank drift the steering daemon keys on
+    sampled = load_sampled_profiles(dirname)
+    for key, sdoc in sampled.items():
+        if key in processes:
+            processes[key]["sampled_profile"] = sdoc
+    merged = {"merged_at": time.time(), "processes": processes,
+              "counters_total": totals}
+    if sampled:
+        merged["sampled_profiles"] = sampled
+        merged["sampled_profile_drift"] = sampled_profile_drift(sampled)
     mpath = os.path.join(dirname, MERGED_METRICS_NAME)
     tpath = os.path.join(dirname, MERGED_TRACE_NAME)
     atomic_write_bytes(mpath, json.dumps(
-        {"merged_at": time.time(), "processes": processes,
-         "counters_total": totals}, default=str,
-        sort_keys=True).encode())
+        merged, default=str, sort_keys=True).encode())
     atomic_write_bytes(tpath, json.dumps(
         {"traceEvents": metas + events, "displayTimeUnit": "ms"},
         default=str).encode())

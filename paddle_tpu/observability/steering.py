@@ -24,13 +24,15 @@ Contract:
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["register_steerer", "get_steerer", "steerers", "steer",
-           "load_report", "REPORT_FIELDS"]
+           "load_report", "REPORT_FIELDS", "plan_digest",
+           "plan_jsonable"]
 
 # the measured fields every steerer keys on: per-collective cost points
 # (the cost-model fit) and the backward compute timeline (the hide
@@ -96,6 +98,33 @@ def load_report(path: Optional[str] = None,
     except (OSError, ValueError):
         return None
     return coerce_report(doc, required_fields=required_fields)
+
+
+def plan_jsonable(plan):
+    """A JSON-serializable view of any plan a steerer can return: a
+    ``PlacementPlan``-style object (``to_doc()``), a plain container,
+    or a tuple ladder. The canonical form the daemon writes into a
+    proposal artifact and the digest hashes."""
+    if hasattr(plan, "to_doc"):
+        return plan.to_doc()
+    if hasattr(plan, "to_dict"):
+        return plan.to_dict()
+    if isinstance(plan, tuple):
+        return list(plan)
+    return plan
+
+
+def plan_digest(plan) -> str:
+    """Stable content digest of a plan — the identity every steering
+    decision is audited under. Plans that carry their own digest
+    (``PlacementPlan.digest``) keep it; anything else hashes its
+    canonical JSON form."""
+    d = getattr(plan, "digest", None)
+    if isinstance(d, str) and d:
+        return d
+    body = json.dumps(plan_jsonable(plan), sort_keys=True,
+                      separators=(",", ":"), default=str)
+    return hashlib.sha1(body.encode()).hexdigest()
 
 
 def coerce_report(doc, required_fields=REPORT_FIELDS) -> Optional[Dict]:

@@ -393,6 +393,15 @@ def run_data_parallel(core, program, scope: Scope, feed: Dict,
         # keep the global (replicated) array in scope: the next step
         # feeds it straight back without a host round-trip
         scope.var(n).get_tensor()._array = v
+    # sampled in-production capture (PADDLE_TPU_SAMPLE_EVERY): every
+    # Nth mesh step re-profiles the live (program, scope, feed) into a
+    # rolling report for the steering daemon — default off, one branch.
+    # AFTER the scope writeback: the step donated the previous state
+    # buffers, so the profiler must read the freshly-stored arrays.
+    from ..observability import capture as _capture
+
+    _capture.maybe_sample_step("parallel", program, scope, feed,
+                               mesh=mesh, axis_name=axis_name)
     results = []
     for name, v in zip(fetch_names, fetches):
         results.append(np.asarray(_local(v)) if return_numpy

@@ -31,7 +31,7 @@ import numpy as np
 from . import metrics as _m
 
 __all__ = ["BatchPolicy", "DynamicBatcher", "PendingRequest",
-           "default_ladder", "pick_bucket"]
+           "default_ladder", "pick_bucket", "plan_ladder"]
 
 
 def default_ladder(max_batch_size: int) -> Tuple[int, ...]:
@@ -258,3 +258,68 @@ class DynamicBatcher:
             out.append({name: arr[off:off + rows]
                         for name, arr in arrs.items()})
         return out
+
+
+# -- ladder replanning (self-driving runtime) -------------------------------
+#
+# The default power-of-two ladder is shape-agnostic; real traffic is
+# not. When measured padding waste rises (the steering daemon watches
+# serving.padding_waste per dispatched batch), the ladder can be
+# REPLANNED from the observed real-rows-per-batch distribution:
+# quantile rungs put bucket boundaries where batches actually land, so
+# the common sizes pad by little while the jit-cache bound
+# (len(ladder) compiles, warmup pre-compilable) is preserved.
+
+def plan_ladder(max_batch_size: int, batch_rows: Sequence[int],
+                max_rungs: int = 6) -> Tuple[int, ...]:
+    """A bucket ladder fitted to observed real-rows-per-batch:
+    distinct quantile rungs (p25/p50/p75/p90/max observed) plus the
+    ``max_batch_size`` cap, validated against the same rules
+    ``BatchPolicy`` enforces. Falls back to ``default_ladder`` when no
+    usable observations exist."""
+    if max_batch_size < 1:
+        raise ValueError("max_batch_size must be >= 1, got %r"
+                         % max_batch_size)
+    rows = sorted(min(max_batch_size, max(1, int(r)))
+                  for r in batch_rows
+                  if isinstance(r, (int, float)) and r > 0)
+    if not rows:
+        return default_ladder(max_batch_size)
+    rungs = {max_batch_size}
+    for q in (0.25, 0.5, 0.75, 0.9, 1.0):
+        # ceil-style index: the rung must COVER the quantile's batches
+        rungs.add(rows[min(len(rows) - 1,
+                           int(np.ceil(q * (len(rows) - 1))))])
+    ladder = tuple(sorted(rungs))
+    if len(ladder) > max_rungs:
+        # keep the cap and the largest rungs (the small end pads the
+        # least absolute rows; the big end bounds compile count)
+        ladder = tuple(sorted(rungs))[-max_rungs:]
+        if ladder[-1] != max_batch_size:
+            ladder = tuple(sorted(set(ladder) | {max_batch_size}))
+    BatchPolicy(max_batch_size=max_batch_size, ladder=ladder)  # validate
+    return ladder
+
+
+def _steer_serving_ladder(report, max_batch_size=None,
+                          batch_rows=None, max_rungs=6, **_ctx):
+    """``report → plan`` steerer: the report is optional (this steerer
+    keys on live traffic, not a step profile); the observed
+    real-rows-per-batch sequence and the batch cap come from context.
+    The returned plan IS the ladder tuple — ``BatchPolicy(ladder=...)``
+    applies it."""
+    if max_batch_size is None:
+        raise ValueError("serving_ladder steerer needs "
+                         "max_batch_size=<cap> in context")
+    if not batch_rows:
+        raise ValueError("serving_ladder steerer needs "
+                         "batch_rows=<observed real rows per batch>")
+    return plan_ladder(int(max_batch_size), batch_rows,
+                       max_rungs=int(max_rungs))
+
+
+from ..observability import steering as _steering  # noqa: E402
+
+_steering.register_steerer(
+    "serving_ladder", _steer_serving_ladder,
+    "bucket ladder replanned from measured padding waste (ISSUE 16)")

@@ -171,6 +171,26 @@ def main():
     ok = ok and fusion_cost < VERIFY_BUDGET_US \
         and feed_chk < VERIFY_BUDGET_US
 
+    # ISSUE 16: sampled in-production capture must default OFF
+    # (PADDLE_TPU_SAMPLE_EVERY unset), and the per-step hook the
+    # executors call after EVERY successful step must degenerate to a
+    # memoized-int load + branch — same tight per-run budget as the
+    # verifier hook
+    from paddle_tpu.observability import capture as _capture
+
+    assert not _capture.sampling_enabled(), \
+        "sampled capture must default off (PADDLE_TPU_SAMPLE_EVERY)"
+    sample_chk = _bench_primitive(_capture.sampling_enabled)
+    sample_hook = _bench_primitive(
+        lambda: _capture.maybe_sample_step("bench"))
+    print("sampled-capture disabled cost: sampling_enabled()=%.3fus "
+          "maybe_sample_step()=%.3fus (budget %.1fus each)"
+          % (sample_chk, sample_hook, VERIFY_BUDGET_US))
+    ok = ok and sample_chk < VERIFY_BUDGET_US \
+        and sample_hook < VERIFY_BUDGET_US
+    assert not _capture._counts, \
+        "disabled sampling hook must not count steps"
+
     # tiny 2-op program: measure real steps, project the per-step
     # instrumentation cost from the primitive costs above
     import numpy as np

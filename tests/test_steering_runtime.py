@@ -1,0 +1,569 @@
+"""ISSUE 16: the self-driving runtime — sampled capture knob, steering
+registry edge cases, daemon hysteresis (no replan storm), the extracted
+comparator, and the canary/audit closure.
+
+End-to-end (real executor job under PADDLE_TPU_SAMPLE_EVERY, planted
+regression/improvement canaries) lives in ``tools/steering_drill.py``;
+these tests pin the unit contracts the drill composes."""
+import json
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import canary as canary_mod
+from paddle_tpu.observability import capture as capture_mod
+from paddle_tpu.observability import comparator as comp_mod
+from paddle_tpu.observability import distributed as odist
+from paddle_tpu.observability import flight
+from paddle_tpu.observability import steering
+from paddle_tpu.observability import steering_daemon as sd_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_SAMPLE_EVERY", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_METRICS_DIR", raising=False)
+    obs.reset()
+    obs.enable()
+    flight.clear()
+    capture_mod._reset_for_tests()
+    yield
+    obs.reset()
+    obs.disable()
+    flight.clear()
+    capture_mod._reset_for_tests()
+
+
+# -- steering registry edge cases -------------------------------------------
+
+
+def test_register_steerer_rejects_bad_args():
+    with pytest.raises(ValueError):
+        steering.register_steerer("", lambda r: r)
+    with pytest.raises(ValueError):
+        steering.register_steerer("x", "not-callable")
+
+
+def test_reregister_replaces_idempotently():
+    try:
+        steering.register_steerer("t_dup", lambda r, **c: "v1")
+        assert steering.steer("t_dup", None) == "v1"
+        n = steering.steerers().count("t_dup")
+        assert n == 1
+        steering.register_steerer("t_dup", lambda r, **c: "v2")
+        assert steering.steerers().count("t_dup") == 1
+        assert steering.steer("t_dup", None) == "v2"
+    finally:
+        steering._STEERERS.pop("t_dup", None)
+
+
+def test_unknown_steerer_is_typed_keyerror():
+    with pytest.raises(KeyError) as ei:
+        steering.steer("no_such_steerer_xyz", None)
+    assert "no_such_steerer_xyz" in str(ei.value)
+    # and it lists what IS registered, so the typo is debuggable
+    assert "have:" in str(ei.value)
+
+
+def test_steer_counts_dispatches():
+    try:
+        steering.register_steerer("t_count", lambda r, **c: None)
+        steering.steer("t_count", None)
+        steering.steer("t_count", None)
+        assert obs.counter_value("steering.plans",
+                                 steerer="t_count") == 2
+    finally:
+        steering._STEERERS.pop("t_count", None)
+
+
+def test_coerce_report_stale_and_garbage():
+    assert steering.coerce_report(None) is None
+    assert steering.coerce_report("nope") is None
+    assert steering.coerce_report({}) is None
+    # field-incomplete (a stale pre-ISSUE-7 report shape)
+    assert steering.coerce_report({"per_bucket": []}) is None
+    good = {"per_bucket": [], "backward_segments": []}
+    assert steering.coerce_report(good) == good
+    # bench-record wrapping unwraps
+    assert steering.coerce_report({"profile": good}) == good
+
+
+def test_load_report_never_raises(tmp_path):
+    assert steering.load_report(str(tmp_path / "missing.json")) is None
+    bad = tmp_path / "garbage.json"
+    bad.write_text("{not json")
+    assert steering.load_report(str(bad)) is None
+
+
+def test_plan_digest_stable_and_shape_agnostic():
+    assert steering.plan_digest((1, 2, 4)) == \
+        steering.plan_digest([1, 2, 4])
+    assert steering.plan_digest({"a": 1, "b": 2}) == \
+        steering.plan_digest({"b": 2, "a": 1})
+    assert steering.plan_digest((1, 2)) != steering.plan_digest((1, 3))
+
+    class WithDigest:
+        digest = "feedbeef"
+    assert steering.plan_digest(WithDigest()) == "feedbeef"
+
+
+# -- comparator (extracted bench_diff core) ---------------------------------
+
+
+def _rec(**metrics):
+    return {"extras": {"wl": dict(metrics)}}
+
+
+def test_compare_verdicts():
+    base = _rec(tokens_per_sec=100.0)
+    assert comp_mod.compare(base, _rec(tokens_per_sec=99.0)).ok
+    c = comp_mod.compare(base, _rec(tokens_per_sec=80.0))
+    assert not c.ok and c.verdict == "regression"
+    assert c.regressed_metrics == ["tokens_per_sec"]
+    # nothing in common: explicitly NOT ok (a blind promote is worse
+    # than a spurious rollback)
+    c = comp_mod.compare({}, {})
+    assert c.verdict == "no_overlap" and not c.ok and c.compared == 0
+
+
+def test_compare_noise_floor_suppresses_tiny_abs_delta():
+    # +150% relative on a 0.5ms base stays under the 2ms step_ms floor
+    c = comp_mod.compare(_rec(step_ms=0.5), _rec(step_ms=1.25))
+    assert c.ok
+
+
+def test_compare_improvement_direction_aware():
+    c = comp_mod.compare(_rec(tokens_per_sec=100.0, step_ms=10.0),
+                         _rec(tokens_per_sec=150.0, step_ms=5.0))
+    assert c.improvement("tokens_per_sec") == pytest.approx(0.5)
+    assert c.improvement("step_ms") == pytest.approx(0.5)
+    assert c.improvement("never_measured") is None
+
+
+def test_compare_to_dict_json_safe_with_zero_base():
+    c = comp_mod.compare(_rec(tokens_per_sec=0.0),
+                         _rec(tokens_per_sec=5.0))
+    doc = json.loads(json.dumps(c.to_dict()))
+    rels = [r["rel"] for r in doc["rows"]]
+    assert "inf" in rels
+
+
+def test_compare_counter_growth_flags():
+    base = {"counters_total": {"executor.compile_fallbacks": 0},
+            "extras": {"wl": {"tokens_per_sec": 100.0}}}
+    head = {"counters_total": {"executor.compile_fallbacks": 3},
+            "extras": {"wl": {"tokens_per_sec": 100.0}}}
+    c = comp_mod.compare(base, head)
+    assert not c.ok
+
+
+# -- daemon hysteresis ------------------------------------------------------
+
+
+def _daemon(tmp_path, **kw):
+    kw.setdefault("merge", False)
+    kw.setdefault("hysteresis", 2)
+    kw.setdefault("cooldown", 2)
+    rule = sd_mod.WatchRule(
+        "waste", sd_mod.counter_ratio("serving.padding_waste",
+                                      "serving.batches", min_den=8),
+        direction=-1, threshold=0.25, floor=0.10,
+        steerer="t_steer")
+    kw.setdefault("rules", [rule])
+    return sd_mod.SteeringDaemon(str(tmp_path), **kw)
+
+
+def _metrics(tmp_path, ratio, batches=100):
+    doc = {"counters_total": {"serving.batches": batches,
+                              "serving.padding_waste": ratio * batches}}
+    (tmp_path / "metrics.json").write_text(json.dumps(doc))
+
+
+def test_daemon_oscillation_never_triggers(tmp_path):
+    try:
+        steering.register_steerer("t_steer", lambda r, **c: [1, 2])
+        d = _daemon(tmp_path)
+        # baseline 0.2, then alternate clean/breach forever: the clean
+        # poll resets the consecutive count each time — no proposal
+        for ratio in [0.2] + [0.6, 0.2] * 6:
+            _metrics(tmp_path, ratio)
+            assert d.poll_once() == []
+    finally:
+        steering._STEERERS.pop("t_steer", None)
+
+
+def test_daemon_sustained_breach_proposes_once_then_cooldown(tmp_path):
+    try:
+        steering.register_steerer("t_steer", lambda r, **c: [1, 2])
+        d = _daemon(tmp_path)
+        _metrics(tmp_path, 0.2)
+        assert d.poll_once() == []          # baseline
+        _metrics(tmp_path, 0.6)
+        assert d.poll_once() == []          # breach 1 of 2
+        props = []
+        for _ in range(6):                  # breach persists
+            props += d.poll_once()
+        # exactly one proposal: breach 2 fires, then the cooldown +
+        # rebaseline absorb the persisting level — no storm
+        assert len(props) == 1
+        assert props[0]["steerer"] == "t_steer"
+        assert props[0]["plan_digest"] == steering.plan_digest([1, 2])
+        assert (tmp_path / "proposed-t_steer.json").exists()
+    finally:
+        steering._STEERERS.pop("t_steer", None)
+
+
+def test_daemon_missing_metric_and_doc_skip(tmp_path):
+    try:
+        steering.register_steerer("t_steer", lambda r, **c: [1])
+        d = _daemon(tmp_path)
+        assert d.poll_once() == []          # no metrics.json at all
+        # denominator below min_den: extractor yields None, no state
+        _metrics(tmp_path, 0.9, batches=2)
+        assert d.poll_once() == []
+        assert d._state["waste"]["baseline"] is None
+    finally:
+        steering._STEERERS.pop("t_steer", None)
+
+
+def test_daemon_broken_steerer_is_flight_recorded(tmp_path):
+    def _boom(report, **ctx):
+        raise RuntimeError("planner exploded")
+    try:
+        steering.register_steerer("t_steer", _boom)
+        d = _daemon(tmp_path)
+        _metrics(tmp_path, 0.2)
+        d.poll_once()
+        for _ in range(3):
+            _metrics(tmp_path, 0.6)
+            assert d.poll_once() == []      # proposal attempt fails
+        assert obs.counter_value("steering.propose_errors",
+                                 steerer="t_steer") >= 1
+        kinds = [k for _, k, _ in flight.events()]
+        assert "steering.propose_error" in kinds
+    finally:
+        steering._STEERERS.pop("t_steer", None)
+
+
+def test_watchrule_validates():
+    with pytest.raises(ValueError):
+        sd_mod.WatchRule("x", lambda d: 0, direction=2, threshold=0.1,
+                         steerer="s")
+    with pytest.raises(ValueError):
+        sd_mod.WatchRule("x", lambda d: 0, direction=1, threshold=0.0,
+                         steerer="s")
+
+
+def test_default_rules_cover_the_issue_drifts():
+    names = {r.name: r.steerer for r in sd_mod.default_rules()}
+    assert names == {"serving_padding_waste": "serving_ladder",
+                     "lazy_recompile_frac": "lazy_policy",
+                     "placement_agreement": "placement"}
+
+
+# -- canary + audit closure -------------------------------------------------
+
+
+def _measure(waste):
+    return {"extras": {"serving": {
+        "serving_padding_waste_frac": waste,
+        "rows_per_s": 1000.0 * (1.0 - waste)}}}
+
+
+def test_canary_promote_and_rollback_audited(tmp_path):
+    audit = canary_mod.AuditTrail(str(tmp_path))
+    store = canary_mod.PlanStore(str(tmp_path), "t_steer")
+    incumbent = _measure(0.5)
+
+    bad = canary_mod.run_canary(
+        {"plan": [16], "steerer": "t_steer"}, incumbent,
+        lambda plan: _measure(0.9), plan_store=store, audit=audit)
+    assert bad.decision == "rolled_back" and store.installs == 0
+
+    good = canary_mod.run_canary(
+        {"plan": [2, 4, 16], "steerer": "t_steer"}, incumbent,
+        lambda plan: _measure(0.1), plan_store=store, audit=audit,
+        require_improvement="serving_padding_waste_frac")
+    assert good.decision == "promoted" and store.installs == 1
+
+    entries = audit.entries()
+    assert [e["decision"] for e in entries] == ["rolled_back",
+                                                "promoted"]
+    assert [e["seq"] for e in entries] == [0, 1]
+    assert store.active_digest() == good.plan_digest
+    assert store.read()["audit_seq"] == 1
+    # the flight instants carry the same digests the trail recorded
+    fl = {k: f for _, k, f in flight.events()
+          if k.startswith("canary.")}
+    assert fl["canary.rolled_back"]["plan_digest"] == bad.plan_digest
+    assert fl["canary.promoted"]["plan_digest"] == good.plan_digest
+
+
+def test_canary_no_improvement_demotes(tmp_path):
+    audit = canary_mod.AuditTrail(str(tmp_path))
+    dec = canary_mod.run_canary(
+        {"plan": [8], "steerer": "t"}, _measure(0.5),
+        lambda plan: _measure(0.49), audit=audit,
+        require_improvement="serving_padding_waste_frac",
+        min_improvement=0.05)
+    assert not dec.promoted
+    assert dec.reason == "no_improvement:serving_padding_waste_frac"
+
+
+def test_canary_no_overlap_rolls_back(tmp_path):
+    dec = canary_mod.run_canary({"plan": [8]}, {}, lambda plan: {})
+    assert not dec.promoted and dec.reason == "no_overlap"
+
+
+def test_plan_store_structurally_refuses_unaudited(tmp_path):
+    store = canary_mod.PlanStore(str(tmp_path), "t")
+    with pytest.raises(ValueError):
+        store.install([1, 2], {"decision": "rolled_back"})
+    with pytest.raises(ValueError):   # digest mismatch with the trail
+        store.install([1, 2], {"decision": "promoted",
+                               "plan_digest": "wrong"})
+    with pytest.raises(ValueError):   # PlanStore without AuditTrail
+        canary_mod.run_canary({"plan": [8]}, _measure(0.5),
+                              lambda plan: _measure(0.1),
+                              plan_store=store, audit=None)
+    assert store.installs == 0 and store.read() is None
+
+
+def test_audit_trail_survives_garbage_file(tmp_path):
+    p = tmp_path / "steering_audit.json"
+    p.write_text("{torn write")
+    audit = canary_mod.AuditTrail(str(tmp_path))
+    assert audit.entries() == []
+    e = audit.append({"decision": "promoted", "plan_digest": "d"})
+    assert e["seq"] == 0
+    assert audit.entries()[0]["decision"] == "promoted"
+
+
+# -- sampled capture knob ---------------------------------------------------
+
+
+def test_sample_every_parse(monkeypatch):
+    for raw, want in [("", 0), ("0", 0), ("-3", 0), ("nope", 0),
+                      ("7", 7)]:
+        capture_mod._reset_for_tests()
+        if raw:
+            monkeypatch.setenv("PADDLE_TPU_SAMPLE_EVERY", raw)
+        else:
+            monkeypatch.delenv("PADDLE_TPU_SAMPLE_EVERY",
+                               raising=False)
+        assert capture_mod.sample_every() == want
+    capture_mod._reset_for_tests()
+
+
+def test_disabled_hook_returns_none_without_counting():
+    assert capture_mod.maybe_sample_step("t", object(), object(),
+                                         {}) is None
+    assert capture_mod._counts == {}
+
+
+def test_sampling_cadence_and_rolling_report(tmp_path, monkeypatch):
+    from paddle_tpu.observability import profiler as prof
+
+    calls = []
+
+    def fake_profile_step(program, scope, feed, **kw):
+        calls.append(kw)
+        return {"step_ms": 5.0, "overlap_frac": 0.5,
+                "per_bucket": [], "backward_segments": []}
+
+    monkeypatch.setattr(prof, "profile_step", fake_profile_step)
+    monkeypatch.setattr(prof, "_emit_profile", lambda rep: None)
+    monkeypatch.setenv("PADDLE_TPU_SAMPLE_EVERY", "3")
+    monkeypatch.setenv("PADDLE_TPU_METRICS_DIR", str(tmp_path))
+    capture_mod._reset_for_tests()
+
+    reports = [capture_mod.maybe_sample_step("eng", object(),
+                                             object(), {})
+               for _ in range(7)]
+    fired = [r is not None for r in reports]
+    assert fired == [False, False, True, False, False, True, False]
+    assert len(calls) == 2 and calls[0]["repeats"] == 1
+    assert obs.counter_value("capture.samples", engine="eng") == 2
+
+    files = list(tmp_path.glob("*.profile.json"))
+    assert len(files) == 1
+    doc = json.loads(files[0].read_text())
+    assert doc["schema"] == capture_mod.SAMPLED_PROFILE_SCHEMA
+    assert doc["samples"] == 2 and len(doc["history"]) == 2
+    assert doc["profile"]["step_ms"] == 5.0
+
+
+def test_capture_failure_never_breaks_the_step(tmp_path, monkeypatch):
+    from paddle_tpu.observability import profiler as prof
+
+    def boom(*a, **kw):
+        raise RuntimeError("profiler exploded")
+
+    monkeypatch.setattr(prof, "profile_step", boom)
+    monkeypatch.setenv("PADDLE_TPU_SAMPLE_EVERY", "1")
+    capture_mod._reset_for_tests()
+    assert capture_mod.maybe_sample_step("eng", object(), object(),
+                                         {}) is None
+    assert obs.counter_value("capture.errors", engine="eng") == 1
+    kinds = [k for _, k, _ in flight.events()]
+    assert "capture.error" in kinds
+
+
+def test_history_bounded(tmp_path, monkeypatch):
+    from paddle_tpu.observability import profiler as prof
+
+    monkeypatch.setattr(prof, "profile_step",
+                        lambda *a, **k: {"step_ms": 1.0})
+    monkeypatch.setattr(prof, "_emit_profile", lambda rep: None)
+    monkeypatch.setenv("PADDLE_TPU_SAMPLE_EVERY", "1")
+    monkeypatch.setenv("PADDLE_TPU_METRICS_DIR", str(tmp_path))
+    capture_mod._reset_for_tests()
+    for _ in range(capture_mod.HISTORY_CAP + 9):
+        capture_mod.maybe_sample_step("eng", object(), object(), {})
+    doc = json.loads(next(tmp_path.glob("*.profile.json")).read_text())
+    assert len(doc["history"]) == capture_mod.HISTORY_CAP
+
+
+# -- merge surfacing of sampled reports -------------------------------------
+
+
+def _write_profile(tmp_path, proc, step_ms):
+    doc = {"schema": capture_mod.SAMPLED_PROFILE_SCHEMA,
+           "proc": proc, "wrote_at": 1.0,
+           "profile": {"step_ms": step_ms, "overlap_frac": 0.5,
+                       "phase_ms": {"forward": step_ms / 2}}}
+    (tmp_path / ("%s.profile.json" % proc)).write_text(
+        json.dumps(doc))
+
+
+def test_load_sampled_profiles_and_drift(tmp_path):
+    _write_profile(tmp_path, "trainer-0", 10.0)
+    _write_profile(tmp_path, "trainer-1", 12.0)
+    (tmp_path / "trainer-2.profile.json").write_text("{torn")
+    sampled = odist.load_sampled_profiles(str(tmp_path))
+    assert set(sampled) == {"trainer-0", "trainer-1"}
+    drift = odist.sampled_profile_drift(sampled)
+    row = drift["step_ms"]
+    assert row["min"] == 10.0 and row["max"] == 12.0
+    assert row["spread"] == pytest.approx(2.0)
+    assert drift["phase_ms.forward"]["max"] == 6.0
+
+
+def test_merge_job_dir_surfaces_sampled(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_METRICS_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_ROLE", "trainer")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    odist.dump_process()
+    _write_profile(tmp_path, "trainer-0", 10.0)
+    odist.merge_job_dir(str(tmp_path))
+    mdoc = json.loads((tmp_path / "metrics.json").read_text())
+    assert "trainer-0" in mdoc["sampled_profiles"]
+    assert "step_ms" in mdoc["sampled_profile_drift"]
+    # and the per-process section carries its own report
+    assert mdoc["processes"]["trainer-0"]["sampled_profile"][
+        "profile"]["step_ms"] == 10.0
+
+
+# -- consumer steerers: serving ladder + lazy policy ------------------------
+
+
+def test_plan_ladder_quantile_rungs():
+    from paddle_tpu.serving import batcher
+
+    rows = [3] * 60 + [13] * 40
+    ladder = batcher.plan_ladder(16, rows)
+    assert ladder[-1] == 16 and 3 in ladder and 13 in ladder
+    assert list(ladder) == sorted(set(ladder))
+    # no observations: power-of-two fallback
+    assert batcher.plan_ladder(16, []) == batcher.default_ladder(16)
+    with pytest.raises(ValueError):
+        batcher.plan_ladder(0, rows)
+
+
+def test_serving_ladder_steerer_registered_and_needs_context():
+    from paddle_tpu.serving import batcher  # noqa: F401 — registers
+
+    assert "serving_ladder" in steering.steerers()
+    with pytest.raises(ValueError):
+        steering.steer("serving_ladder", None)
+    plan = steering.steer("serving_ladder", None, max_batch_size=8,
+                          batch_rows=[2, 2, 5])
+    assert plan[-1] == 8
+
+
+def test_lazy_policy_plan_and_apply():
+    from paddle_tpu.dygraph import lazy
+
+    # thrash: most flushes re-trace and recompiles exceed the cap
+    plan = lazy.plan_lazy_policy(recompiles=100, cache_hits=10,
+                                 cache_cap=64)
+    assert plan["jit_cache_cap"] == 128 and plan["prev_cap"] == 64
+    # healthy cache: no change
+    plan = lazy.plan_lazy_policy(recompiles=5, cache_hits=100,
+                                 cache_cap=64)
+    assert plan["jit_cache_cap"] == 64
+    # growth is bounded
+    plan = lazy.plan_lazy_policy(recompiles=10000, cache_hits=0,
+                                 cache_cap=lazy.JIT_CACHE_CAP_MAX)
+    assert plan["jit_cache_cap"] == lazy.JIT_CACHE_CAP_MAX
+
+    class FakeEngine:
+        JIT_CACHE_CAP = 64
+    got = lazy.apply_lazy_policy({"jit_cache_cap": 128},
+                                 engine_cls=FakeEngine)
+    assert got == 128 and FakeEngine.JIT_CACHE_CAP == 128
+    with pytest.raises(ValueError):
+        lazy.apply_lazy_policy({"jit_cache_cap": 0},
+                               engine_cls=FakeEngine)
+    assert "lazy_policy" in steering.steerers()
+
+
+# -- per-shard PS apply timing ----------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_ps_apply_ms_labeled_by_shard():
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
+
+    class MiniScope(dict):
+        def local_var_names(self):
+            return list(self)
+
+    class MiniExec:
+        def _read_var(self, scope, name):
+            return scope.get(name)
+
+        def _write_var(self, scope, name, val):
+            scope[name] = np.asarray(val)
+
+        def run_block(self, block, scope):
+            block(scope)
+
+    scope = MiniScope()
+    scope["w"] = np.zeros(4, np.float32)
+    before = obs.histogram("ps.apply_ms", shard="0").count
+    server = PSServer(
+        "127.0.0.1:%d" % _free_port(), MiniExec(), scope,
+        {"w@GRAD": lambda sc: sc.__setitem__(
+            "w", sc["w"] - 0.1 * sc["w@GRAD"])}, fanin=1)
+    server.start_background()
+    c = PSClient(server._own_endpoint, trainer_id=0)
+    try:
+        c.send_grad("w@GRAD", np.ones(4, np.float32))
+        c.send_barrier()
+        c.get_param("w")
+        c.fetch_barrier()
+    finally:
+        c.close()
+        server.stop()
+    assert obs.histogram("ps.apply_ms", shard="0").count == before + 1

@@ -1,26 +1,25 @@
 #!/usr/bin/env python
 """Mechanical perf gate: diff two bench / multichip / metrics JSON files.
 
+Thin CLI over ``paddle_tpu.observability.comparator`` — the watched
+metrics, noise floors, and threshold logic live THERE now, shared with
+the canary protocol (``observability/canary.py``), so CI and the
+self-driving runtime can never disagree about what counts as a
+regression.
+
 Compares per-workload numbers between a BASE and a HEAD run and exits
 nonzero when any watched higher-is-better metric regresses by more than
 the threshold (or a lower-is-better one grows by more than it). This is
 the regression gate the ROADMAP observability item asks for: CI diffs
 the merged counters instead of a human eyeballing two JSON blobs.
 
-Understands all three record shapes this repo emits:
-
-- ``bench.py`` output           (``{"extras": {workload: {...}}}``)
-- ``bench.py --multichip``      (``{"configs": {config: {...}}}``)
-- merged job ``metrics.json``   (``{"counters_total": {counter: value}}``
-                                from observability.distributed.merge_job_dir)
-
-Single- and multi-chip records diff under one schema: every record
-carries ``step_ms`` and a throughput field, and single-chip diags
-carry an explicit ``collective_bytes: 0``.
-
 Usage:
   tools/bench_diff.py BASE.json HEAD.json [--threshold 0.10]
-      [--counters-threshold 0.25]
+      [--counters-threshold 0.25] [--json]
+
+``--json`` prints the full machine-readable comparison (the same
+``Comparison.to_dict()`` document the canary writes into
+``steering_audit.json``) instead of the human table.
 
 Exit codes: 0 = within threshold, 1 = regression past threshold,
 2 = usage/load error.
@@ -29,211 +28,26 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
-# per-workload metrics worth gating; direction: +1 higher is better,
-# -1 lower is better. The profile-block metrics (bench.py `profile`:
-# flops-derived mfu_est, measured overlap_frac / critical_path_ms)
-# resolve through the record's "profile" sub-dict — _lookup descends.
-WATCHED = (
-    ("images_per_sec", +1), ("tokens_per_sec", +1),
-    ("examples_per_sec", +1), ("steps_per_sec", +1),
-    ("tokens_or_images_per_sec", +1),
-    ("step_ms", -1), ("collective_bytes", -1),
-    ("mfu_est", +1), ("overlap_frac", +1),
-    ("critical_path_ms", -1), ("exposed_collective_ms", -1),
-    # ISSUE-14 single-chip phase attribution: the fused-optimizer /
-    # fused-epilogue / async-feed wins must show up HERE (optimizer
-    # phase time and critical-path feed cost strictly down) — and a
-    # change that silently regresses them fails the gate
-    ("feed_ms", -1), ("optimizer_ms", -1),
-    # device-truth counterparts (XPlane-folded; observability/
-    # device_trace.py) + the host-vs-device agreement ratio — a
-    # silently-diverging host estimate (the number the bucket planner
-    # steers by) regresses agreement even when every host metric holds
-    ("device_overlap_frac", +1), ("device_critical_path_ms", -1),
-    ("host_device_agreement", +1),
-    # serving records (tools/serving_bench.py --out): closed-loop
-    # throughput/latency, queue wait, real batch size, padding waste,
-    # and the compile count the bucket ladder exists to bound — a
-    # serving regression fails CI exactly like a training one
-    ("rows_per_s", +1), ("p50_ms", -1), ("p99_ms", -1),
-    ("serving_queue_ms_p50", -1), ("serving_queue_ms_p99", -1),
-    ("serving_batch_size_mean", +1),
-    ("serving_padding_waste_frac", -1), ("jit_traces", -1),
-    # PS scale records (tools/ps_scale_bench.py): the per-round
-    # blake2b bill under incremental chunk digesting, and the delta
-    # wire bytes for the same touched-rows workload — a change that
-    # silently regresses incremental digesting back toward full
-    # re-hashing (or row slices back toward whole-table ships) fails
-    # here run-over-run
-    ("ps_digest_ms", -1), ("rounds_per_s", +1),
-    ("repl_delta_bytes_per_round", -1),
-    # placement records (ISSUE 15, bench `placement` block): how well
-    # the searched plan's PREDICTED step time tracks the measured one
-    # (min/max ratio). A collapse means the cost model drifted off the
-    # machine — the plan may still "work" while steering wrong.
-    ("placement_agreement", +1),
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.observability.comparator import (  # noqa: E402
+    ABS_NOISE_FLOOR, COUNTER_WATCH_GROWS_BAD, WATCHED, compare,
+    counter_totals, diff_counters, diff_records, load, workloads,
 )
 
-# absolute noise floors for measured-timing metrics: a relative
-# threshold alone turns sub-millisecond jitter on a near-zero base
-# (0.2ms -> 0.5ms exposed time on a tiny CI smoke) into a +150%
-# "regression". A delta must clear BOTH the relative threshold and
-# this absolute floor to flag. Deterministic metrics have no floor.
-ABS_NOISE_FLOOR = {
-    "step_ms": 2.0, "critical_path_ms": 2.0,
-    "exposed_collective_ms": 2.0, "overlap_frac": 0.1,
-    # feed staging on a loaded box jitters at the ~ms level; the
-    # optimizer phase is a measured re-execution slice
-    "feed_ms": 1.0, "optimizer_ms": 2.0,
-    "device_overlap_frac": 0.1, "device_critical_path_ms": 2.0,
-    "host_device_agreement": 0.1,
-    # serving latencies on a loaded CI box jitter in the single-digit
-    # ms; batch size / padding waste depend on thread-arrival raggedness
-    "p50_ms": 5.0, "p99_ms": 10.0,
-    "serving_queue_ms_p50": 5.0, "serving_queue_ms_p99": 10.0,
-    "serving_batch_size_mean": 1.0, "serving_padding_waste_frac": 0.15,
-    # hashing time on a loaded CI box jitters; byte counts do not
-    "ps_digest_ms": 5.0,
-    # predicted-vs-measured ratio moves with CI-box timing noise
-    "placement_agreement": 0.15,
-}
-
-# counter totals (metrics.json) where growth is a regression.
-# ps.replication_bytes guards the ISSUE-8 delta-replication win: a
-# code change that silently regresses the PS back to full-blob
-# shipping shows up as growth of the byte counters (and of the
-# mode=full series specifically) for the same drilled workload.
-COUNTER_WATCH_GROWS_BAD = ("parallel.collective_bytes",
-                           "parallel.collective_ops",
-                           "executor.compile_fallbacks",
-                           "ps.replication_bytes",
-                           # fused single-chip program op count
-                           # (tools/sc_smoke.py): deterministic —
-                           # growth means the fusion passes regressed
-                           "sc.program_ops",
-                           # the serving smoke must stay error-free:
-                           # any growth (including 0 -> n) is a bug
-                           # the functional assertions may have missed
-                           "serving.errors", "serving.batch_errors")
-
-
-def load(path):
-    with open(path) as f:
-        doc = json.load(f)
-    # the bench driver wraps bench.py's JSON line as {"parsed": {...}}
-    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
-        return doc["parsed"]
-    return doc
-
-
-def workloads(doc):
-    """{workload: record} from any of the three supported shapes."""
-    if "configs" in doc and isinstance(doc["configs"], dict):
-        return dict(doc["configs"])  # multichip bench
-    if "extras" in doc and isinstance(doc["extras"], dict):
-        return {k: v for k, v in doc["extras"].items()
-                if isinstance(v, dict) and not k.endswith("_error")}
-    return {}
-
-
-def counter_totals(doc):
-    # merged job metrics.json (merge_job_dir) names the key
-    # counters_total; accept the plain spelling too
-    for key in ("counters_total", "totals"):
-        if isinstance(doc.get(key), dict):
-            return doc[key]
-    if isinstance(doc.get("metrics_totals"), dict):
-        return doc["metrics_totals"]  # multichip bench embeds them
-    return {}
+__all__ = ["WATCHED", "ABS_NOISE_FLOOR", "COUNTER_WATCH_GROWS_BAD",
+           "load", "workloads", "counter_totals", "diff_records",
+           "diff_counters", "main"]
 
 
 def _fmt(v):
     if isinstance(v, float):
         return "%.4g" % v
     return str(v)
-
-
-def diff_records(base, head, threshold):
-    """Yield (workload, metric, base, head, rel_delta, regressed)."""
-    b_wl, h_wl = workloads(base), workloads(head)
-    for name in sorted(set(b_wl) & set(h_wl)):
-        b, h = b_wl[name], h_wl[name]
-        for metric, direction in WATCHED:
-            bv, hv = _lookup(b, metric), _lookup(h, metric)
-            if bv is None or hv is None:
-                continue
-            if not bv:
-                # growth from a zero base has no relative delta: show
-                # the row (rel=inf) but don't hard-fail — a single-chip
-                # BASE vs multichip HEAD legitimately goes 0 -> N
-                # collective bytes, and the watched counter totals
-                # below still gate structural from-zero growth
-                if not hv:
-                    continue
-                yield name, metric, bv, hv, float("inf"), False
-                continue
-            rel = (hv - bv) / abs(bv)
-            regressed = (-direction * rel) > threshold and \
-                abs(hv - bv) > ABS_NOISE_FLOOR.get(metric, 0.0)
-            yield name, metric, bv, hv, rel, regressed
-        # a SILENT placement-plan change between runs is a regression:
-        # same workload, same knobs, different plan digest means the
-        # search (or its report) drifted without anyone deciding it
-        bd = _plan_digest(b)
-        hd = _plan_digest(h)
-        if bd and hd and bd != hd:
-            yield (name, "placement.plan_digest", bd[:12], hd[:12],
-                   float("inf"), True)
-
-
-def _plan_digest(rec):
-    p = rec.get("placement")
-    if isinstance(p, dict):
-        d = p.get("plan_digest")
-        if isinstance(d, str):
-            return d
-    return None
-
-
-def _lookup(rec, metric):
-    """A metric straight off the record, or from its profile block
-    (mfu_est / overlap_frac / critical_path_ms), its diag (single-chip
-    collective_bytes lives there), or its placement block
-    (placement_agreement)."""
-    v = rec.get(metric)
-    if v is None and isinstance(rec.get("profile"), dict):
-        v = rec["profile"].get(metric)
-    if v is None and isinstance(rec.get("diag"), dict):
-        v = rec["diag"].get(metric)
-    if v is None and isinstance(rec.get("placement"), dict):
-        v = rec["placement"].get(metric)
-    if isinstance(v, (int, float)) and not isinstance(v, bool):
-        return float(v)
-    return None
-
-
-def diff_counters(base, head, threshold):
-    b_t, h_t = counter_totals(base), counter_totals(head)
-    for key in sorted(set(b_t) & set(h_t)):
-        bv, hv = b_t[key], h_t[key]
-        if not isinstance(bv, (int, float)):
-            continue
-        # exact key or its labeled series ("...{kind=...}") — a bare
-        # prefix test would also catch parallel.collective_bytes_saved,
-        # whose growth is an improvement
-        grows_bad = any(key == w or key.startswith(w + "{")
-                        for w in COUNTER_WATCH_GROWS_BAD)
-        if not bv:
-            if not hv:
-                continue
-            # zero -> nonzero growth of a watched counter is always a
-            # regression (e.g. the first compile fallback appearing)
-            yield key, bv, hv, float("inf"), grows_bad
-            continue
-        rel = (hv - bv) / abs(bv)
-        yield key, bv, hv, rel, grows_bad and rel > threshold
 
 
 def main(argv=None):
@@ -250,6 +64,9 @@ def main(argv=None):
     ap.add_argument("--counters-threshold", type=float, default=0.25,
                     help="max relative growth for watched counter "
                          "totals (default 0.25)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable comparison "
+                         "(Comparison.to_dict()) instead of the table")
     ap.add_argument("--self-test", action="store_true",
                     help="run the built-in self test and exit")
     args = ap.parse_args(argv)
@@ -265,29 +82,31 @@ def main(argv=None):
         print("bench_diff: cannot load inputs: %s" % e, file=sys.stderr)
         return 2
 
-    regressions = 0
-    rows = list(diff_records(base, head, args.threshold))
-    for name, metric, bv, hv, rel, bad in rows:
+    cmp = compare(base, head, args.threshold, args.counters_threshold)
+
+    if args.as_json:
+        print(json.dumps(cmp.to_dict(), indent=2, sort_keys=True))
+        if cmp.verdict == "no_overlap":
+            return 2
+        return 1 if cmp.regressions else 0
+
+    for name, metric, bv, hv, rel, bad in cmp.rows:
         mark = " REGRESSION" if bad else ""
         print("%-24s %-26s %12s -> %-12s %+7.2f%%%s"
               % (name, metric, _fmt(bv), _fmt(hv), rel * 100, mark))
-        regressions += bad
-    crows = list(diff_counters(base, head, args.counters_threshold))
-    for key, bv, hv, rel, bad in crows:
+    for key, bv, hv, rel, bad in cmp.counter_rows:
         mark = " REGRESSION" if bad else ""
         print("%-51s %12s -> %-12s %+7.2f%%%s"
               % (key, _fmt(bv), _fmt(hv), rel * 100, mark))
-        regressions += bad
-    if not rows and not crows:
+    if not cmp.compared:
         print("bench_diff: no common workloads or counters between "
               "inputs", file=sys.stderr)
         return 2
-    if regressions:
+    if cmp.regressions:
         print("bench_diff: %d metric(s) regressed past threshold"
-              % regressions, file=sys.stderr)
+              % cmp.regressions, file=sys.stderr)
         return 1
-    print("bench_diff: ok (%d metrics compared)"
-          % (len(rows) + len(crows)))
+    print("bench_diff: ok (%d metrics compared)" % cmp.compared)
     return 0
 
 
@@ -476,6 +295,23 @@ def _self_test():
     # a run WITHOUT a placement block diffs cleanly against one with
     assert not any(r[-1] for r in diff_records(
         {"configs": {"mlp": {"step_ms": 300.0}}}, pl0, 0.10))
+    # the structured layer the canary audits: verdicts + JSON safety
+    c = compare(single, slow, 0.10)
+    assert c.verdict == "regression" and not c.ok and c.regressions
+    assert "step_ms" in c.regressed_metrics, c.regressed_metrics
+    c_ok = compare(single, multi, 0.10)
+    assert c_ok.verdict == "ok" and c_ok.ok
+    assert compare({}, {}).verdict == "no_overlap"
+    assert not compare({}, {}).ok
+    d = compare(single, went_multi, 0.10).to_dict()
+    json.dumps(d)  # inf rows must serialize
+    zr = [r for r in d["rows"] if r["metric"] == "collective_bytes"]
+    assert zr and zr[0]["rel"] == "inf" and not zr[0]["regressed"], d
+    gain = compare(single, {"extras": {"w": {
+        "tokens_per_sec": 150.0, "step_ms": 10.0,
+        "diag": {"collective_bytes": 0}}}}, 0.10)
+    imp = gain.improvement("tokens_per_sec")
+    assert imp is not None and imp > 0.4, imp
     print("bench_diff self-test ok")
     return 0
 

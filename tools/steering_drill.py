@@ -1,0 +1,428 @@
+"""Steering drill: the self-driving runtime's CI gate (ISSUE 16).
+
+One seeded, in-process run of the full sense → propose → canary →
+decide loop, gated on the invariants that make "self-driving" safe to
+ship:
+
+1. SAMPLED CAPTURE — with ``PADDLE_TPU_SAMPLE_EVERY=2`` armed, a real
+   executor job emits rolling ``*.profile.json`` reports on exactly
+   every Nth step, and ``merge_job_dir`` surfaces them (plus the
+   cross-rank drift block) in the merged ``metrics.json``.
+2. DAEMON HYSTERESIS — the steering daemon, fed a scripted metric
+   sequence, proposes exactly ONCE for a sustained breach: a single
+   noisy poll does not trigger, an oscillating metric never
+   accumulates, and the post-proposal cooldown prevents a replan
+   storm while the breach persists.
+3. CANARY DECISIONS — a PLANTED REGRESSION (a ladder that pads every
+   batch to the max) ROLLS BACK, and a PLANTED IMPROVEMENT (the
+   daemon's own quantile-ladder proposal) PROMOTES, both measured
+   with the real serving padding math over one seeded request trace
+   and compared by the shared ``observability/comparator.py``.
+4. AUDIT CLOSURE — every decision is bit-audited: the plan digests in
+   ``steering_audit.json``, the flight ring's ``steering.proposed`` /
+   ``canary.*`` instants, the proposal artifact, and the PlanStore's
+   active-plan pointer all agree; the number of active-plan installs
+   equals the number of PROMOTED audit entries (zero un-audited plan
+   switches); and the PlanStore structurally refuses a switch without
+   its promotion entry.
+
+Seeded and fast (~tens of seconds) — this is ci/check.sh's steering
+gate, not a benchmark.
+
+Usage:
+    python tools/steering_drill.py [--seed 0]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+_CHECKS = []
+
+
+def _check(what: str, passed: bool, detail: str = "") -> bool:
+    _CHECKS.append((what, bool(passed)))
+    print("[steer] %s: %s%s" % ("PASS" if passed else "FAIL", what,
+                                (" — " + detail) if detail else ""))
+    return bool(passed)
+
+
+# -- leg 1: sampled in-production capture -----------------------------------
+
+def _small_program(fluid, batch=32, hidden=32):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data(name="sx", shape=[batch, 16], dtype="float32")
+        y = fluid.data(name="sy", shape=[batch, 1], dtype="int64")
+        h = fluid.layers.fc(x, hidden, act="relu")
+        pred = fluid.layers.fc(h, 10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def leg_sampled_capture(rng, workdir: str) -> None:
+    metrics_dir = os.path.join(workdir, "capture")
+    os.makedirs(metrics_dir, exist_ok=True)
+    os.environ["PADDLE_TPU_METRICS_DIR"] = metrics_dir
+    os.environ["PADDLE_TPU_SAMPLE_EVERY"] = "2"
+    os.environ["PADDLE_TPU_SAMPLE_BUDGET_S"] = "20"
+
+    import paddle_tpu as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import capture
+    from paddle_tpu.observability import distributed as odist
+    from paddle_tpu.observability import flight
+
+    obs.reset()
+    obs.enable()
+    flight.clear()
+    capture._reset_for_tests()
+    _check("capture: knob armed", capture.sampling_enabled()
+           and capture.sample_every() == 2)
+
+    main, startup, loss = _small_program(fluid)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)  # executor step 1 — not a sample multiple
+        feed = {"sx": rng.random((32, 16)).astype("float32"),
+                "sy": rng.integers(0, 10, (32, 1)).astype("int64")}
+        for _ in range(4):  # steps 2..5 — samples fire on 2 and 4
+            exe.run(main, feed=feed, fetch_list=[loss])
+
+    n_samples = obs.counter_value("capture.samples", engine="executor")
+    _check("capture: fired on every Nth executor step",
+           n_samples == 2, "samples=%r (want 2 of 5 steps @ N=2)"
+           % (n_samples,))
+    _check("capture: zero capture errors",
+           not obs.counter_value("capture.errors", engine="executor"))
+
+    reports = glob.glob(os.path.join(metrics_dir, "*.profile.json"))
+    ok = len(reports) == 1
+    doc = {}
+    if ok:
+        with open(reports[0], "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        ok = (doc.get("schema") == capture.SAMPLED_PROFILE_SCHEMA
+              and doc.get("engine") == "executor"
+              and doc.get("sample_every") == 2
+              and doc.get("samples") == 2
+              and isinstance((doc.get("profile") or {}).get("step_ms"),
+                             (int, float))
+              and len(doc.get("history") or []) == 2)
+    _check("capture: rolling profile report on disk", ok,
+           "files=%d samples=%r history=%d"
+           % (len(reports), doc.get("samples"),
+              len(doc.get("history") or [])))
+
+    kinds = [k for _, k, _ in flight.events()]
+    _check("capture: flight-recorded", kinds.count("capture.sampled") == 2)
+
+    # the dump pipeline must surface the sampled reports + drift
+    odist.dump_process()
+    merged = odist.merge_job_dir(metrics_dir)
+    with open(os.path.join(metrics_dir, "metrics.json"), "r",
+              encoding="utf-8") as f:
+        mdoc = json.load(f)
+    sp = mdoc.get("sampled_profiles") or {}
+    drift = mdoc.get("sampled_profile_drift") or {}
+    _check("capture: merged metrics.json surfaces sampled profiles",
+           len(sp) == 1 and "step_ms" in drift
+           and isinstance(drift["step_ms"].get("spread"), (int, float)),
+           "procs=%d drift_keys=%d" % (len(sp), len(drift)))
+    del merged
+
+    os.environ.pop("PADDLE_TPU_SAMPLE_EVERY", None)
+    capture._reset_for_tests()
+    _check("capture: disarms back to off", not capture.sampling_enabled())
+
+
+# -- leg 2: daemon hysteresis (no replan storm) -----------------------------
+
+def _write_metrics(metrics_dir: str, waste: float,
+                   batches: int = 100) -> None:
+    doc = {"counters_total": {
+        "serving.batches": batches,
+        "serving.padding_waste": waste * batches,
+    }}
+    with open(os.path.join(metrics_dir, "metrics.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(doc, f)
+
+
+def leg_daemon_hysteresis(rng, workdir: str):
+    """Scripted waste-ratio sequence through a real daemon: exactly
+    one proposal despite noise, oscillation, and a sustained breach
+    under cooldown. Returns the proposal for the canary leg."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import flight, steering
+    from paddle_tpu.observability import steering_daemon as sdmod
+
+    metrics_dir = os.path.join(workdir, "daemon")
+    os.makedirs(metrics_dir, exist_ok=True)
+    obs.enable()
+
+    # bimodal seeded traffic: mostly small batches + a big mode the
+    # power-of-two ladder straddles badly
+    trace = np.concatenate([
+        rng.integers(3, 5, 60), rng.integers(11, 14, 40)])
+    rng.shuffle(trace)
+    trace = [int(r) for r in trace]
+
+    rule = sdmod.WatchRule(
+        "serving_padding_waste",
+        sdmod.counter_ratio("serving.padding_waste", "serving.batches",
+                            min_den=8),
+        direction=-1, threshold=0.25, floor=0.10,
+        steerer="serving_ladder")
+    daemon = sdmod.SteeringDaemon(
+        metrics_dir, rules=[rule], hysteresis=2, cooldown=3,
+        merge=False,
+        context={"serving_ladder": {"max_batch_size": 16,
+                                    "batch_rows": trace}})
+
+    # (waste_ratio, want_proposal_after_this_poll)
+    script = [
+        (0.20, 0),  # poll 1: baseline
+        (0.20, 0),  # poll 2: clean
+        (0.55, 0),  # poll 3: breach #1 — hysteresis holds
+        (0.20, 0),  # poll 4: clean — MUST reset the breach count
+        (0.55, 0),  # poll 5: breach #1 again (not #2)
+        (0.60, 1),  # poll 6: breach #2 — PROPOSE
+        (0.60, 1),  # polls 7..9: breach persists, cooldown holds
+        (0.60, 1),
+        (0.60, 1),
+        (0.60, 1),  # poll 10: cooldown over, but rebaselined — clean
+    ]
+    total = 0
+    storm_free = True
+    for waste, want in script:
+        _write_metrics(metrics_dir, waste)
+        total += len(daemon.poll_once())
+        storm_free = storm_free and (total == want)
+    _check("daemon: one proposal, no storm", storm_free and total == 1,
+           "proposals=%d over %d polls" % (total, daemon.polls))
+
+    prop = daemon.proposals[0] if daemon.proposals else None
+    art_path = os.path.join(metrics_dir, "proposed-serving_ladder.json")
+    art = None
+    if os.path.exists(art_path):
+        with open(art_path, "r", encoding="utf-8") as f:
+            art = json.load(f)
+    ok = (prop is not None and art is not None
+          and art["schema"] == sdmod.PROPOSAL_SCHEMA
+          and art["plan_digest"] == prop["plan_digest"]
+          and art["metric"] == "serving_padding_waste"
+          and tuple(art["plan"]) == tuple(prop["plan"])
+          and art["plan"][-1] == 16)
+    _check("daemon: proposal artifact matches in-memory proposal", ok)
+
+    proposed_events = [f for _, k, f in flight.events()
+                       if k == "steering.proposed"]
+    ok = (len(proposed_events) == 1 and prop is not None
+          and proposed_events[0]["plan_digest"] == prop["plan_digest"])
+    _check("daemon: steering.proposed flight instant carries the "
+           "digest", ok)
+    _check("daemon: proposals counter", obs.counter_value(
+        "steering.proposals", steerer="serving_ladder") == 1)
+
+    # registry contract the daemon leans on
+    try:
+        steering.steer("definitely_not_registered", None)
+        unknown_ok = False
+    except KeyError:
+        unknown_ok = True
+    _check("daemon: unknown steerer is a KeyError", unknown_ok)
+    return prop, trace
+
+
+# -- leg 3: canary decisions + audit closure --------------------------------
+
+def _measure_ladder(ladder, trace):
+    """The real padding math over the seeded request trace: each batch
+    lands in the smallest rung covering it (pick_bucket), waste is the
+    padded fraction, throughput falls as padding rises."""
+    from paddle_tpu.serving.batcher import pick_bucket
+
+    padded = real = 0
+    for rows in trace:
+        b = pick_bucket(ladder, rows)
+        padded += b
+        real += rows
+    waste = (padded - real) / float(padded)
+    return {"extras": {"serving": {
+        "serving_padding_waste_frac": waste,
+        "rows_per_s": 1000.0 * (1.0 - waste),
+        "serving_batch_size_mean": real / float(len(trace)),
+    }}}
+
+
+def leg_canary(proposal, trace, workdir: str) -> None:
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import canary, flight, steering
+    from paddle_tpu.serving.batcher import default_ladder
+
+    cdir = os.path.join(workdir, "canary")
+    os.makedirs(cdir, exist_ok=True)
+    audit = canary.AuditTrail(cdir)
+    store = canary.PlanStore(cdir, "serving_ladder")
+    incumbent_ladder = default_ladder(16)
+    incumbent = _measure_ladder(incumbent_ladder, trace)
+    applied = {"plan": None}
+
+    def apply_fn(plan):
+        applied["plan"] = tuple(plan)
+
+    # planted regression: a one-rung ladder pads EVERY batch to 16
+    bad_plan = (16,)
+    bad = canary.run_canary(
+        {"plan": list(bad_plan),
+         "plan_digest": steering.plan_digest(list(bad_plan)),
+         "steerer": "serving_ladder", "metric": "planted_regression"},
+        incumbent, lambda plan: _measure_ladder(tuple(plan), trace),
+        apply_fn=apply_fn, rollback_fn=lambda plan: None,
+        plan_store=store, audit=audit)
+    _check("canary: planted regression ROLLS BACK",
+           not bad.promoted and bad.decision == "rolled_back"
+           and "serving_padding_waste_frac" in
+           bad.comparison.regressed_metrics,
+           "reason=%s regressed=%s" % (bad.reason,
+                                       bad.comparison.regressed_metrics))
+    _check("canary: rollback installed nothing", store.installs == 0
+           and store.read() is None)
+
+    # planted improvement: the daemon's own quantile-ladder proposal
+    good = canary.run_canary(
+        proposal, incumbent,
+        lambda plan: _measure_ladder(tuple(plan), trace),
+        apply_fn=apply_fn, plan_store=store, audit=audit,
+        require_improvement="serving_padding_waste_frac",
+        min_improvement=0.05)
+    _check("canary: planted improvement PROMOTES",
+           good.promoted and good.decision == "promoted"
+           and applied["plan"] == tuple(proposal["plan"]),
+           "reason=%s" % good.reason)
+
+    # audit closure: trail <-> flight ring <-> active-plan pointer
+    entries = audit.entries()
+    ok = (len(entries) == 2
+          and entries[0]["decision"] == "rolled_back"
+          and entries[1]["decision"] == "promoted"
+          and entries[0]["seq"] == 0 and entries[1]["seq"] == 1
+          and entries[0]["plan_digest"] == steering.plan_digest(
+              list(bad_plan))
+          and entries[1]["plan_digest"] == proposal["plan_digest"]
+          and all(e["schema"] == canary.AUDIT_SCHEMA for e in entries))
+    _check("audit: both decisions on the trail, digests bit-exact", ok)
+
+    fl = {k: f for _, k, f in flight.events()
+          if k in ("canary.promoted", "canary.rolled_back")}
+    ok = (fl.get("canary.rolled_back", {}).get("plan_digest")
+          == entries[0]["plan_digest"] if len(entries) == 2 else False)
+    ok = ok and (fl.get("canary.promoted", {}).get("plan_digest")
+                 == entries[1]["plan_digest"])
+    _check("audit: flight instants bit-match the trail", ok)
+
+    active = store.read()
+    promoted_entries = [e for e in entries
+                        if e["decision"] == "promoted"]
+    ok = (store.installs == len(promoted_entries) == 1
+          and isinstance(active, dict)
+          and active["plan_digest"] == proposal["plan_digest"]
+          and active["audit_seq"] == promoted_entries[0]["seq"])
+    _check("audit: installs == promoted entries (zero un-audited "
+           "plan switches)", ok,
+           "installs=%d promoted=%d" % (store.installs,
+                                        len(promoted_entries)))
+
+    # structural refusals: a plan switch cannot skip the audit trail
+    try:
+        store.install(list(proposal["plan"]),
+                      {"decision": "rolled_back"})
+        refused = False
+    except ValueError:
+        refused = True
+    _check("audit: PlanStore refuses a non-promotion entry", refused)
+    try:
+        canary.run_canary(proposal, incumbent,
+                          lambda plan: _measure_ladder(tuple(plan),
+                                                       trace),
+                          plan_store=store, audit=None,
+                          steerer="serving_ladder")
+        refused = False
+    except ValueError:
+        refused = True
+    _check("audit: promotion with a PlanStore but no AuditTrail "
+           "refuses", refused)
+    _check("audit: decision counters", obs.counter_value(
+        "canary.promoted", steerer="serving_ladder") == 1
+        and obs.counter_value("canary.rolled_back",
+                              steerer="serving_ladder") == 1)
+
+    # satellite 3: the decisions land in the merged chrome trace
+    from paddle_tpu.observability import distributed as odist
+
+    os.environ["PADDLE_TPU_METRICS_DIR"] = cdir
+    odist.dump_process()
+    odist.merge_job_dir(cdir)
+    with open(os.path.join(cdir, "trace.json"), "r",
+              encoding="utf-8") as f:
+        rows = json.load(f).get("traceEvents", [])
+    instants = {r["name"]: r for r in rows
+                if r.get("ph") == "i" and r.get("name") in
+                ("steering.proposed", "canary.promoted",
+                 "canary.rolled_back")}
+    ok = (set(instants) == {"steering.proposed", "canary.promoted",
+                            "canary.rolled_back"}
+          and all(r.get("args", {}).get("plan_digest")
+                  for r in instants.values()))
+    _check("trace: steering/canary instants with digests in merged "
+           "trace.json", ok, "found=%s" % sorted(instants))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    rng = np.random.default_rng(args.seed)
+
+    with tempfile.TemporaryDirectory(prefix="steer_drill_") as workdir:
+        saved = os.environ.get("PADDLE_TPU_METRICS_DIR")
+        try:
+            leg_sampled_capture(rng, workdir)
+            proposal, trace = leg_daemon_hysteresis(rng, workdir)
+            if proposal is None:
+                _check("canary: skipped — daemon emitted no proposal",
+                       False)
+            else:
+                leg_canary(proposal, trace, workdir)
+        finally:
+            if saved is None:
+                os.environ.pop("PADDLE_TPU_METRICS_DIR", None)
+            else:
+                os.environ["PADDLE_TPU_METRICS_DIR"] = saved
+            os.environ.pop("PADDLE_TPU_SAMPLE_EVERY", None)
+
+    failed = [w for w, p in _CHECKS if not p]
+    if failed:
+        print("[steer] %d/%d checks FAILED" % (len(failed),
+                                               len(_CHECKS)))
+        return 1
+    print("[steer] ALL %d CHECKS PASS" % len(_CHECKS))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
