@@ -97,8 +97,14 @@ fi
 # undersized queue must actually reject (backpressure engages).
 # --out also writes the bench_diff-compatible serving record
 SRV_OUT="$(mktemp)"
-trap 'rm -f "$FP_TMP" "$SRV_OUT"' EXIT
+DEC_OUT="$(mktemp)"
+trap 'rm -f "$FP_TMP" "$SRV_OUT" "$DEC_OUT"' EXIT
 python tools/serving_bench.py --smoke --out "$SRV_OUT"
+# 5b-decode: continuous-batching decode smoke — mixed-length token
+# streams through the DecodeEngine, every stream exactly-once, zero
+# stream errors, and tokens/s must beat the static wait-for-all
+# baseline measured in the same record (ISSUE 17 acceptance)
+python tools/serving_bench.py --decode --out "$DEC_OUT"
 
 echo "== gate 5c: serving perf regression vs previous run =="
 # same machine-local run-over-run scheme as gate 7b: queue-wait /
@@ -125,6 +131,27 @@ else
     echo "serving perf gate: no previous run on this machine — seeding $SRV_BASELINE"
 fi
 cp "$SRV_OUT" "$SRV_BASELINE"
+# decode record: TTFT/ITL percentiles, the continuous-vs-static
+# speedup margin, KV occupancy and preemptions, run-over-run
+DEC_BASELINE="ci/baseline/decode_smoke.json"
+if [[ -f "$DEC_BASELINE" ]]; then
+    dec_rc=0
+    python tools/bench_diff.py "$DEC_BASELINE" "$DEC_OUT" \
+        --threshold 0.5 --counters-threshold 0.5 || dec_rc=$?
+    if [[ "$dec_rc" == "0" ]]; then
+        echo "decode perf gate: no regression vs previous run"
+    elif [[ "$dec_rc" == "2" ]]; then
+        echo "decode perf gate: baseline unreadable (rc=2) — reseeding $DEC_BASELINE"
+    elif [[ "${PERF_BASELINE_ACCEPT:-0}" == "1" ]]; then
+        echo "decode perf gate: regression ACCEPTED (PERF_BASELINE_ACCEPT=1)"
+    else
+        echo "decode perf gate: regression vs $DEC_BASELINE — intentional? re-run with PERF_BASELINE_ACCEPT=1" >&2
+        exit 1
+    fi
+else
+    echo "decode perf gate: no previous run on this machine — seeding $DEC_BASELINE"
+fi
+cp "$DEC_OUT" "$DEC_BASELINE"
 
 echo "== gate 6: fault tolerance =="
 # 6a: the fault-tolerance suite (injection grammar/determinism, retry
@@ -296,6 +323,17 @@ echo "== gate 8: serving-fleet chaos drill =="
 # rejoin chain in causal order, per-replica serving spans joining ONE
 # job trace) — not on logs.
 python tools/serving_chaos.py --smoke
+
+echo "== gate 8-decode: streaming-decode chaos drill =="
+# the ISSUE-17 acceptance drill (~10s): 2 supervised DecodeEngine
+# replicas, 8 concurrent token streams through FleetRouter.generate();
+# replica 0 SIGKILLs itself mid-stream. Zero lost accepted streams,
+# zero duplicated token indices, every delivered token value-verified
+# against local regeneration (exactly-once resume after the kill),
+# serving.stream_resumes >= 1 / stream_errors == 0 in merged
+# counters, and the kill -> eject -> resume -> relaunch -> rejoin
+# chain in causal order from the merged timeline.
+python tools/serving_chaos.py --decode
 
 echo "== gate 8b: steering drill =="
 # the ISSUE-16 acceptance drill (seeded, in-process, ~10s): sampled

@@ -67,6 +67,20 @@ WATCHED = (
     ("serving_queue_ms_p50", -1), ("serving_queue_ms_p99", -1),
     ("serving_batch_size_mean", +1),
     ("serving_padding_waste_frac", -1), ("jit_traces", -1),
+    # decode records (tools/serving_bench.py --decode): the SLO axes
+    # of the continuous-batching tier — time-to-first-token and
+    # inter-token latency — plus token throughput and its margin over
+    # the static wait-for-all baseline measured in the SAME record. A
+    # change that silently regresses per-token scheduling (TTFT/ITL
+    # blowup, the continuous-vs-static win evaporating) fails CI here.
+    # Raw tokens_per_s is in the record for humans but NOT watched:
+    # it tracks box load run-over-run; the speedup ratio is measured
+    # against a baseline run in the same process under the same load,
+    # so it isolates the scheduling margin from the machine
+    ("ttft_p50_ms", -1), ("ttft_p99_ms", -1),
+    ("itl_p50_ms", -1), ("itl_p99_ms", -1),
+    ("decode_speedup_vs_static", +1),
+    ("kv_occupancy_frac", +1), ("preemptions", -1),
     # PS scale records (tools/ps_scale_bench.py): the per-round
     # blake2b bill under incremental chunk digesting, and the delta
     # wire bytes for the same touched-rows workload — a change that
@@ -100,6 +114,14 @@ ABS_NOISE_FLOOR = {
     "p50_ms": 5.0, "p99_ms": 10.0,
     "serving_queue_ms_p50": 5.0, "serving_queue_ms_p99": 10.0,
     "serving_batch_size_mean": 1.0, "serving_padding_waste_frac": 0.15,
+    # decode SLO axes jitter on a loaded CI box: TTFT includes queued
+    # prefill chunks, ITL one padded decode step; occupancy depends on
+    # stream arrival raggedness; a couple of preemptions either way is
+    # arena-pressure noise, not a scheduling regression
+    "ttft_p50_ms": 25.0, "ttft_p99_ms": 120.0,
+    "itl_p50_ms": 3.0, "itl_p99_ms": 10.0,
+    "decode_speedup_vs_static": 0.3, "kv_occupancy_frac": 0.15,
+    "preemptions": 2.0,
     # hashing time on a loaded CI box jitters; byte counts do not
     "ps_digest_ms": 5.0,
     # predicted-vs-measured ratio moves with CI-box timing noise
@@ -119,10 +141,11 @@ COUNTER_WATCH_GROWS_BAD = ("parallel.collective_bytes",
                            # (tools/sc_smoke.py): deterministic —
                            # growth means the fusion passes regressed
                            "sc.program_ops",
-                           # the serving smoke must stay error-free:
+                           # the serving smokes must stay error-free:
                            # any growth (including 0 -> n) is a bug
                            # the functional assertions may have missed
-                           "serving.errors", "serving.batch_errors")
+                           "serving.errors", "serving.batch_errors",
+                           "serving.stream_errors")
 
 
 def load(path):

@@ -25,7 +25,11 @@ covers with its C++ serving stack, TPU-native:
   ``stats`` surface as the engine, so the HTTP front serves a fleet
   unchanged;
 - ``metrics``  — the always-on ``serving.*`` counter/histogram/gauge
-  families in the PR-1 observability registry.
+  families in the PR-1 observability registry;
+- ``decode``   — the continuous-batching autoregressive engine
+  (``DecodeEngine``): paged KV cache, per-token-step scheduling,
+  streaming ``/generate`` with token-level exactly-once failover —
+  the second ``engine_kind`` the fleet can front.
 
 Minimal use::
 
@@ -40,9 +44,12 @@ Minimal use::
 """
 from __future__ import annotations
 
-from . import batcher, engine, fleet, http, metrics  # noqa: F401
+from . import batcher, decode, engine, fleet, http, metrics  # noqa: F401
 from .batcher import (  # noqa: F401
     BatchPolicy, DynamicBatcher, default_ladder, pick_bucket)
+from .decode import (  # noqa: F401
+    DecodeConfig, DecodeEngine, DecodeStream, KVCacheConfig,
+    KVCacheFull, PagedKVCache)
 from .engine import (  # noqa: F401
     DeadlineExpired, EngineStopped, RequestTooLarge, ServerOverloaded,
     ServingConfig, ServingEngine, ServingError)
@@ -54,6 +61,8 @@ __all__ = [
     "BatchPolicy", "DynamicBatcher", "default_ladder", "pick_bucket",
     "ServingConfig", "ServingEngine", "ServingError", "ServerOverloaded",
     "DeadlineExpired", "EngineStopped", "RequestTooLarge",
+    "DecodeConfig", "DecodeEngine", "DecodeStream",
+    "KVCacheConfig", "KVCacheFull", "PagedKVCache",
     "FleetConfig", "FleetRouter", "RequestShed", "ReplicaUnavailable",
     "ServingHTTPServer", "serve", "start_http_server",
 ]

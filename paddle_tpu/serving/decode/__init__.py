@@ -1,0 +1,41 @@
+"""Continuous-batching autoregressive decode: the serving tier's
+second engine kind.
+
+The one-shot tier (``serving.engine``) schedules per REQUEST: a batch
+forms, runs once, returns. An autoregressive workload emits hundreds of
+tokens per request, each token a separate model step over a growing
+KV history — per-request scheduling would hold a batch slot hostage
+for the LONGEST stream in the batch. This package schedules per
+TOKEN STEP instead:
+
+- ``kvcache``   — ``PagedKVCache``: every resident sequence's KV
+  history in fixed-size blocks over one preallocated arena (opt-in
+  bf16/int8 shared-scale storage), strict alloc/free accounting,
+  eviction under pressure;
+- ``model``     — ``TinyDecodeLM``: the seeded deterministic toy
+  transformer the CPU-host tests and chaos drills decode with
+  (bit-identical regeneration is what makes token-level failover
+  exactly-once);
+- ``scheduler`` — ``DecodeScheduler``: per-step plan — token-budgeted
+  prefill chunks, ladder-bucketed decode batch, lowest-priority-first
+  preemption;
+- ``engine``    — ``DecodeEngine``: the step thread + streaming
+  ``submit()`` front (``DecodeStream`` iterators, TTFT/ITL histograms,
+  ``(request_id, token_index)`` resume, drain/stop lifecycle).
+
+The HTTP front serves it as ``POST /generate`` (chunked token events);
+``FleetRouter.generate()`` puts hedged-retry failover on top.
+"""
+from __future__ import annotations
+
+from . import engine, kvcache, model, scheduler  # noqa: F401
+from .engine import DecodeConfig, DecodeEngine, DecodeStream  # noqa: F401
+from .kvcache import KVCacheConfig, KVCacheFull, PagedKVCache  # noqa: F401
+from .model import TinyDecodeLM  # noqa: F401
+from .scheduler import DecodeScheduler, SeqState, StepPlan  # noqa: F401
+
+__all__ = [
+    "DecodeConfig", "DecodeEngine", "DecodeStream",
+    "KVCacheConfig", "KVCacheFull", "PagedKVCache",
+    "TinyDecodeLM", "DecodeScheduler", "SeqState", "StepPlan",
+]

@@ -1,0 +1,390 @@
+"""Paged KV-cache manager: fixed-size blocks over ONE preallocated arena.
+
+Why paged: an autoregressive batch is ragged and CHURNS — sequences
+join, grow one token per step, and leave at unpredictable lengths.
+Per-sequence contiguous buffers either over-reserve (max_len for
+everyone: memory for the p99 sequence paid by the p50) or re-allocate
+and copy as sequences grow. Fixed-size blocks over one arena make both
+problems go away: allocation is popping a free-list entry, growth is at
+most one new block per token step, and a leaving sequence returns its
+blocks for IMMEDIATE reuse by the next admit — which is what lets the
+decode engine hold the batch full (the continuous-batching win).
+
+Quantized storage (opt-in, ``dtype="bf16"|"int8"``): the KV cache is
+the decode replica's memory bill, so halving/quartering it doubles/
+quadruples the sequences a replica can hold. int8 uses EQuARX-style
+SHARED scales — one scale per (layer, block, head, k|v), so a block's
+codes dequantize with one multiply and the scale rides next to the
+block, not next to every value. Appends keep the shared-scale invariant
+by requantizing a block in place when a new token raises its amax
+(a block is ``block_tokens`` rows — the rescale is a few KB, and it
+happens at most once per amax increase). bf16 stores the top 16 bits
+of the f32 pattern (round-to-nearest-even), the same transform the
+collectives' bf16 wire format uses.
+
+Accounting is strict and self-checking: every block is either on the
+free list or owned by exactly one sequence; ``check()`` verifies the
+partition and is asserted by the churn tests after every
+join/leave/evict/re-admit cycle — a leaked block in a long-running
+replica is a slow OOM with no crash to bisect.
+
+Thread contract: the decode engine mutates the cache ONLY from its
+step thread; readers of ``stats()``/``occupancy()`` (health endpoint,
+metrics) take the same lock the mutators do.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["KVCacheConfig", "PagedKVCache", "KVCacheFull"]
+
+
+class KVCacheFull(RuntimeError):
+    """No free block: the arena is exhausted. The scheduler's move,
+    not the cache's — preempt a lower-priority sequence or defer the
+    admit; the cache itself never evicts silently."""
+
+
+class KVCacheConfig:
+    """Arena geometry + storage dtype.
+
+    ``num_blocks * block_tokens`` is the total token capacity shared
+    by every resident sequence; ``dtype`` is the STORAGE format
+    (compute is always float32): ``f32``, ``bf16`` (uint16 bit
+    patterns, 2x capacity per byte), or ``int8`` (shared-scale codes,
+    4x)."""
+
+    DTYPES = ("f32", "bf16", "int8")
+
+    def __init__(self, num_blocks: int = 64, block_tokens: int = 16,
+                 num_layers: int = 1, num_heads: int = 2,
+                 head_dim: int = 8, dtype: str = "f32"):
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        if min(self.num_blocks, self.block_tokens, self.num_layers,
+               self.num_heads, self.head_dim) < 1:
+            raise ValueError("all KVCacheConfig dims must be >= 1")
+        if dtype not in self.DTYPES:
+            raise ValueError("dtype must be one of %s, got %r"
+                             % (self.DTYPES, dtype))
+        self.dtype = dtype
+
+    @property
+    def capacity_tokens(self) -> int:
+        return self.num_blocks * self.block_tokens
+
+    def arena_bytes(self) -> int:
+        """Total K+V arena bytes (scales excluded — they are noise)."""
+        per_val = {"f32": 4, "bf16": 2, "int8": 1}[self.dtype]
+        return (2 * self.num_layers * self.num_blocks
+                * self.block_tokens * self.num_heads * self.head_dim
+                * per_val)
+
+
+class _Seq:
+    __slots__ = ("blocks", "length")
+
+    def __init__(self):
+        self.blocks: List[int] = []
+        self.length = 0
+
+
+def _to_bf16_bits(x: np.ndarray) -> np.ndarray:
+    """f32 -> bf16 bit pattern (uint16), round-to-nearest-even."""
+    bits = x.astype(np.float32).view(np.uint32)
+    rounded = bits + 0x7FFF + ((bits >> 16) & 1)
+    return (rounded >> 16).astype(np.uint16)
+
+
+def _from_bf16_bits(b: np.ndarray) -> np.ndarray:
+    return (b.astype(np.uint32) << 16).view(np.float32)
+
+
+class PagedKVCache:
+    """The arena + block tables. K and V arenas are
+    ``[num_layers, num_blocks, block_tokens, num_heads, head_dim]`` in
+    the storage dtype; int8 scales are
+    ``[num_layers, num_blocks, num_heads]`` per side."""
+
+    def __init__(self, config: Optional[KVCacheConfig] = None):
+        self.config = c = config or KVCacheConfig()
+        storage = {"f32": np.float32, "bf16": np.uint16,
+                   "int8": np.int8}[c.dtype]
+        shape = (c.num_layers, c.num_blocks, c.block_tokens,
+                 c.num_heads, c.head_dim)
+        self.k_arena = np.zeros(shape, storage)
+        self.v_arena = np.zeros(shape, storage)
+        if c.dtype == "int8":
+            sshape = (c.num_layers, c.num_blocks, c.num_heads)
+            self.k_scale = np.zeros(sshape, np.float32)
+            self.v_scale = np.zeros(sshape, np.float32)
+        else:
+            self.k_scale = self.v_scale = None
+        self._free: List[int] = list(range(c.num_blocks - 1, -1, -1))
+        self._seqs: Dict[str, _Seq] = {}
+        self._lock = threading.Lock()
+        self.allocs = 0          # lifetime block allocations
+        self.frees = 0           # lifetime block frees
+
+    # -- accounting ---------------------------------------------------------
+
+    def register(self, seq_id: str) -> None:
+        with self._lock:
+            if seq_id in self._seqs:
+                raise ValueError("sequence %r already registered" % seq_id)
+            self._seqs[seq_id] = _Seq()
+
+    def release(self, seq_id: str) -> int:
+        """Free every block the sequence owns; returns how many."""
+        with self._lock:
+            seq = self._seqs.pop(seq_id, None)
+            if seq is None:
+                return 0
+            self._free.extend(reversed(seq.blocks))
+            self.frees += len(seq.blocks)
+            return len(seq.blocks)
+
+    def has(self, seq_id: str) -> bool:
+        with self._lock:
+            return seq_id in self._seqs
+
+    def seq_len(self, seq_id: str) -> int:
+        with self._lock:
+            return self._seqs[seq_id].length
+
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def blocks_needed(self, seq_id: Optional[str], n_tokens: int) -> int:
+        """New blocks appending ``n_tokens`` to ``seq_id`` would take
+        (``seq_id=None`` -> a fresh sequence)."""
+        with self._lock:
+            used = self._seqs[seq_id].length if seq_id in self._seqs else 0
+        bt = self.config.block_tokens
+        return -(-(used + n_tokens) // bt) - (-(-used // bt))
+
+    def can_fit(self, seq_id: Optional[str], n_tokens: int) -> bool:
+        return self.blocks_needed(seq_id, n_tokens) <= self.free_blocks()
+
+    def occupancy(self) -> float:
+        with self._lock:
+            return 1.0 - len(self._free) / float(self.config.num_blocks)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            used = self.config.num_blocks - len(self._free)
+            return {
+                "num_blocks": self.config.num_blocks,
+                "used_blocks": used,
+                "free_blocks": len(self._free),
+                "occupancy": used / float(self.config.num_blocks),
+                "sequences": len(self._seqs),
+                "resident_tokens": sum(s.length
+                                       for s in self._seqs.values()),
+                "block_allocs": self.allocs,
+                "block_frees": self.frees,
+                "dtype": self.config.dtype,
+                "arena_bytes": self.config.arena_bytes(),
+            }
+
+    def check(self) -> None:
+        """Invariant audit: free + owned partitions the arena exactly
+        (no leak, no double-own), and every length fits its blocks."""
+        with self._lock:
+            owned = [b for s in self._seqs.values() for b in s.blocks]
+            all_ids = sorted(owned + self._free)
+            if all_ids != list(range(self.config.num_blocks)):
+                missing = set(range(self.config.num_blocks)) - set(all_ids)
+                dupes = {b for b in all_ids if all_ids.count(b) > 1}
+                raise AssertionError(
+                    "block accounting broken: %d owned + %d free != %d "
+                    "(leaked=%s double-owned=%s)"
+                    % (len(owned), len(self._free),
+                       self.config.num_blocks, sorted(missing)[:8],
+                       sorted(dupes)[:8]))
+            bt = self.config.block_tokens
+            for sid, s in self._seqs.items():
+                if len(s.blocks) != -(-s.length // bt) and not (
+                        s.length == 0 and not s.blocks):
+                    raise AssertionError(
+                        "seq %r: length %d needs %d block(s), owns %d"
+                        % (sid, s.length, -(-s.length // bt),
+                           len(s.blocks)))
+
+    # -- writes -------------------------------------------------------------
+
+    def reserve(self, seq_id: str, n_tokens: int) -> int:
+        """Allocate blocks for ``n_tokens`` new positions and advance
+        the sequence length; returns the first new position. Atomic:
+        raises ``KVCacheFull`` with NOTHING changed when the free list
+        cannot cover the whole reservation.
+
+        Reserve-then-write is the decode step's shape: the new token's
+        K/V rows are produced LAYER BY LAYER (layer l's row depends on
+        layer l-1's attention output), so slots must exist before the
+        first layer computes. Between reserve and the last
+        ``write_rows`` the tail positions hold stale values — callers
+        mask them with an explicit attention length, never the raw
+        ``block_table`` lengths, until the write completes."""
+        c = self.config
+        if n_tokens < 1:
+            raise ValueError("reserve needs n_tokens >= 1")
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            if seq is None:
+                raise KeyError("sequence %r not registered" % seq_id)
+            need = (-(-(seq.length + n_tokens) // c.block_tokens)
+                    - len(seq.blocks))
+            if need > len(self._free):
+                raise KVCacheFull(
+                    "reserving %d token(s) for %r needs %d block(s), "
+                    "%d free" % (n_tokens, seq_id, need,
+                                 len(self._free)))
+            for _ in range(need):
+                seq.blocks.append(self._free.pop())
+                self.allocs += 1
+            start = seq.length
+            seq.length += n_tokens
+            return start
+
+    def write_rows(self, seq_id: str, layer: int, start: int,
+                   k_rows: np.ndarray, v_rows: np.ndarray) -> None:
+        """Store ``[T, H, D]`` float32 K/V rows for ONE layer at
+        positions ``start .. start+T-1`` (already reserved)."""
+        c = self.config
+        k_rows = np.asarray(k_rows, np.float32)
+        v_rows = np.asarray(v_rows, np.float32)
+        T = k_rows.shape[0]
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            if seq is None:
+                raise KeyError("sequence %r not registered" % seq_id)
+            if start + T > seq.length:
+                raise ValueError(
+                    "write_rows [%d, %d) past reserved length %d of %r"
+                    % (start, start + T, seq.length, seq_id))
+            for t in range(T):
+                blk = seq.blocks[(start + t) // c.block_tokens]
+                off = (start + t) % c.block_tokens
+                self._write(self.k_arena, self.k_scale, layer, blk,
+                            off, k_rows[t])
+                self._write(self.v_arena, self.v_scale, layer, blk,
+                            off, v_rows[t])
+
+    def append(self, seq_id: str, k: np.ndarray, v: np.ndarray) -> None:
+        """Append ``T`` tokens' K/V (``[T, num_layers, num_heads,
+        head_dim]`` float32) across all layers at once — the
+        whole-rows convenience over reserve + write_rows (tests, and
+        any caller that has every layer's rows in hand). Raises
+        ``KVCacheFull`` with NOTHING written when the free list cannot
+        cover the append."""
+        c = self.config
+        k = np.asarray(k, np.float32)
+        v = np.asarray(v, np.float32)
+        want = (k.shape[0], c.num_layers, c.num_heads, c.head_dim)
+        if k.shape != want or v.shape != want:
+            raise ValueError("append expects k/v %s, got %s / %s"
+                             % (want, k.shape, v.shape))
+        start = self.reserve(seq_id, k.shape[0])
+        for layer in range(c.num_layers):
+            self.write_rows(seq_id, layer, start, k[:, layer],
+                            v[:, layer])
+
+    def _write(self, arena, scales, layer, blk, off, row) -> None:
+        """Store one token's [H, D] float32 row in the arena's dtype.
+        int8: per-(block, head) shared scale; a row that raises the
+        block amax requantizes the block's existing codes in place so
+        every code in the block shares ONE scale."""
+        d = self.config.dtype
+        if d == "f32":
+            arena[layer, blk, off] = row
+            return
+        if d == "bf16":
+            arena[layer, blk, off] = _to_bf16_bits(row)
+            return
+        amax = np.abs(row).max(axis=1)                    # [H]
+        cur = scales[layer, blk]                          # [H]
+        new_scale = np.maximum(cur, amax / 127.0)
+        grew = new_scale > cur * (1.0 + 1e-12)
+        if grew.any() and off > 0:
+            for h in np.nonzero(grew)[0]:
+                if cur[h] > 0:
+                    vals = arena[layer, blk, :off, h].astype(
+                        np.float32) * cur[h]
+                    arena[layer, blk, :off, h] = np.clip(
+                        np.rint(vals / new_scale[h]), -127, 127
+                    ).astype(np.int8)
+        scales[layer, blk] = new_scale
+        safe = np.where(new_scale > 0, new_scale, 1.0)
+        arena[layer, blk, off] = np.clip(
+            np.rint(row / safe[:, None]), -127, 127).astype(np.int8)
+
+    # -- reads --------------------------------------------------------------
+
+    def views(self, layer: int) -> Tuple[np.ndarray, np.ndarray,
+                                         object, object]:
+        """The attention kernel's operands for one layer:
+        ``(k_arena, v_arena, k_scales, v_scales)`` where the scales
+        slot is None (f32), ``"bf16"`` (bit patterns), or the
+        per-(block, head) scale array (int8) — exactly the contract of
+        ``ops.pallas.paged_attention``."""
+        d = self.config.dtype
+        if d == "f32":
+            return self.k_arena[layer], self.v_arena[layer], None, None
+        if d == "bf16":
+            return (self.k_arena[layer], self.v_arena[layer],
+                    "bf16", "bf16")
+        return (self.k_arena[layer], self.v_arena[layer],
+                self.k_scale[layer], self.v_scale[layer])
+
+    def block_table(self, seq_ids) -> Tuple[np.ndarray, np.ndarray]:
+        """``([B, max_blocks] int32 table (-1 padded), [B] int32
+        lengths)`` over the given sequences — the kernel's ragged-batch
+        rectangle. Unknown ids get an empty row (len 0), which the
+        kernel masks to zeros; that is how padded batch slots ride."""
+        with self._lock:
+            rows = [self._seqs[s].blocks if s in self._seqs else []
+                    for s in seq_ids]
+            lens = [self._seqs[s].length if s in self._seqs else 0
+                    for s in seq_ids]
+        width = max(1, max((len(r) for r in rows), default=1))
+        table = np.full((len(rows), width), -1, np.int32)
+        for i, r in enumerate(rows):
+            table[i, :len(r)] = r
+        return table, np.asarray(lens, np.int32)
+
+    def gather(self, seq_id: str, layer: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Dense float32 ``([T, H, D] k, [T, H, D] v)`` for one
+        sequence/layer — the prefill path's operand (causal attention
+        over the whole prefix) and the tests' oracle."""
+        c = self.config
+        with self._lock:
+            seq = self._seqs[seq_id]
+            blocks = list(seq.blocks)
+            n = seq.length
+        if n == 0:
+            z = np.zeros((0, c.num_heads, c.head_dim), np.float32)
+            return z, z.copy()
+        ids = np.asarray(blocks, np.int64)
+        tok_blocks = np.repeat(ids, c.block_tokens)[:n]
+        out = []
+        for arena, scales in ((self.k_arena, self.k_scale),
+                              (self.v_arena, self.v_scale)):
+            flat = arena[layer, ids].reshape(
+                -1, c.num_heads, c.head_dim)[:n]
+            if c.dtype == "f32":
+                out.append(flat.astype(np.float32))
+            elif c.dtype == "bf16":
+                out.append(_from_bf16_bits(flat))
+            else:
+                s = scales[layer][tok_blocks]             # [T, H]
+                out.append(flat.astype(np.float32) * s[:, :, None])
+        return out[0], out[1]

@@ -1,0 +1,206 @@
+"""TinyDecodeLM: the decode engine's deterministic toy transformer.
+
+The decode tier's CPU-host tests need a model with the real SHAPE of
+autoregressive inference — per-layer KV written into the paged cache at
+prefill, read back through the paged-attention kernel at every decode
+step — without the weight files, tokenizers, or accelerator residency
+of a real checkpoint. TinyDecodeLM is that: a seeded two-layer
+pre-norm transformer whose weights are a pure function of ``seed``,
+greedy (argmax) decoding, float32 numpy throughout.
+
+Determinism is LOAD-BEARING, not a test convenience: the fleet's
+token-level failover (``(request_id, token_index)`` resume) works by
+REGENERATING a stream on a surviving replica and suppressing emission
+below the resume index. For the chaos drill to assert "zero diverged
+tokens" the regenerated stream must be BIT-identical, and the resumed
+replica sees different prefill chunk boundaries and decode batch
+compositions than the original did. So every float op here is
+per-token, per-sequence: single-row matmuls and a per-sequence
+attention reduction whose operand shapes depend only on the token's
+position — never on how many other tokens shared the chunk or the
+batch. Batch a step however you like and the bits don't move. (A real
+checkpointed model gets the same property only with fixed-shape
+batched kernels; this is the toy-scale equivalent.)
+
+``decode_step`` still issues ONE batched paged-attention call per layer
+— that is the kernel the TPU path cares about, and its dense fallback
+reduces per-sequence so the invariance holds on CPU hosts too.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ...ops.pallas.paged_attention import paged_decode_attention
+from .kvcache import PagedKVCache
+
+__all__ = ["TinyDecodeLM"]
+
+# block_table() id that is registered to no sequence: a padded batch
+# row — zero-length, attends to nothing, output discarded
+_PAD_SEQ = "__pad__"
+
+
+def _rms_norm(x: np.ndarray) -> np.ndarray:
+    return x / np.sqrt((x * x).mean(axis=-1, keepdims=True) + 1e-6)
+
+
+class TinyDecodeLM:
+    """Seeded toy autoregressive LM over a paged KV cache.
+
+    Geometry comes from the cache config (layers, heads, head_dim);
+    the embedding width is ``num_heads * head_dim``. ``eos_token`` is
+    vocab id 0. ``attn_backend`` is threaded to
+    ``paged_decode_attention`` (None -> auto: pallas on TPU f32
+    arenas, dense elsewhere)."""
+
+    def __init__(self, cache: PagedKVCache, vocab_size: int = 97,
+                 seed: int = 0xD0DE, attn_backend: Optional[str] = None,
+                 eos_token: Optional[int] = 0):
+        self.cache = cache
+        c = cache.config
+        self.vocab_size = int(vocab_size)
+        # None -> streams only end on max_tokens/deadline; tests and
+        # benches that need a predictable stream length use that
+        self.eos_token = eos_token
+        self.num_layers = c.num_layers
+        self.num_heads = c.num_heads
+        self.head_dim = c.head_dim
+        self.embed_dim = E = c.num_heads * c.head_dim
+        self.attn_backend = attn_backend
+        rng = np.random.RandomState(seed)
+
+        def w(*shape):
+            return (rng.randn(*shape) / np.sqrt(shape[0])).astype(
+                np.float32)
+
+        self.embed = rng.randn(self.vocab_size, E).astype(np.float32)
+        self.wq = [w(E, E) for _ in range(self.num_layers)]
+        self.wk = [w(E, E) for _ in range(self.num_layers)]
+        self.wv = [w(E, E) for _ in range(self.num_layers)]
+        self.wo = [w(E, E) for _ in range(self.num_layers)]
+        self.w1 = [w(E, 2 * E) for _ in range(self.num_layers)]
+        self.w2 = [w(2 * E, E) for _ in range(self.num_layers)]
+        # bounded sinusoid position signal mixed into the embedding
+        self._pos_freq = (0.3 * (np.arange(E, dtype=np.float32) + 1.0)
+                          / E)
+        # position-keyed logit bias: without it a greedy toy this size
+        # settles into a one-token fixed point, and constant streams
+        # make the chaos drill's value checks vacuous (any resume bug
+        # that lands on the wrong POSITION would still emit the right
+        # VALUE). The bias varies argmax by position while leaving the
+        # cache -> hidden -> logits path fully load-bearing: corrupt
+        # the cache and the argmax still flips.
+        self._pos_bias = (4.0 * rng.randn(257, self.vocab_size)
+                          ).astype(np.float32)
+
+    # -- per-row pieces (single-token shapes only; see module doc) ----------
+
+    def _embed1(self, token: int, pos: int) -> np.ndarray:
+        return (self.embed[int(token)]
+                + 0.3 * np.sin(float(pos) * self._pos_freq))
+
+    def _project1(self, layer: int, h_row: np.ndarray):
+        x = _rms_norm(h_row)
+        hd = (self.num_heads, self.head_dim)
+        return ((x @ self.wq[layer]).reshape(hd),
+                (x @ self.wk[layer]).reshape(hd),
+                (x @ self.wv[layer]).reshape(hd))
+
+    def _mlp1(self, layer: int, h_row: np.ndarray,
+              attn_row: np.ndarray) -> np.ndarray:
+        h = h_row + attn_row.reshape(self.embed_dim) @ self.wo[layer]
+        return h + np.tanh(_rms_norm(h) @ self.w1[layer]) @ \
+            self.w2[layer]
+
+    def logits1(self, h_row: np.ndarray, next_pos: int) -> np.ndarray:
+        """Logits for the token AT ``next_pos`` given the final hidden
+        row of position ``next_pos - 1``. The hidden contribution is
+        down-weighted so the self-reinforcing embed[argmax] spike of a
+        tied-embedding toy cannot out-shout the position bias."""
+        return (0.5 * (_rms_norm(h_row) @ self.embed.T)
+                + self._pos_bias[next_pos % self._pos_bias.shape[0]])
+
+    # -- prefill ------------------------------------------------------------
+
+    def prefill_chunk(self, seq_id: str, tokens) -> np.ndarray:
+        """Run one prompt chunk through the model, writing its K/V
+        into the cache; returns the LAST position's final hidden row
+        (the engine takes logits from it when the prompt completes).
+
+        Caller guarantees cache fit (``can_fit``) before calling;
+        positions are reserved here, per token, so an unexpected
+        ``KVCacheFull`` surfaces before that token wrote anything.
+        Chunk boundaries are numerically irrelevant — each position
+        runs the same single-row ops it would in any other split.
+        """
+        h = None
+        for tok in tokens:
+            pos = self.cache.reserve(seq_id, 1)
+            h = self._token_step(seq_id, int(tok), pos)
+        return h
+
+    def _token_step(self, seq_id: str, token: int,
+                    pos: int) -> np.ndarray:
+        """One position through all layers: project, write K/V row,
+        attend over cache[0..pos] (itself included), MLP."""
+        h = self._embed1(token, pos)
+        lens = np.asarray([pos + 1], np.int32)
+        for layer in range(self.num_layers):
+            q, k, v = self._project1(layer, h)
+            self.cache.write_rows(seq_id, layer, pos, k[None], v[None])
+            table, _ = self.cache.block_table([seq_id])
+            k_ar, v_ar, ks, vs = self.cache.views(layer)
+            attn = paged_decode_attention(
+                q[None], k_ar, v_ar, table, lens,
+                block_tokens=self.cache.config.block_tokens,
+                k_scales=ks, v_scales=vs, backend=self.attn_backend)
+            h = self._mlp1(layer, h, attn[0])
+        return h
+
+    # -- decode -------------------------------------------------------------
+
+    def decode_step(self, seq_ids: List[str], last_tokens,
+                    pad_to: Optional[int] = None
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One token step for the active batch: reserve a position per
+        sequence, write each layer's K/V rows, run ONE batched
+        paged-attention call per layer (padded to ``pad_to`` rows so
+        accelerator hosts see a bounded set of shapes — padded rows
+        are zero-length and discarded). Returns ``([B, vocab] logits,
+        [B] greedy next tokens)`` for the real rows.
+
+        Caller guarantees fit for one token per sequence (the
+        scheduler's preemption loop runs BEFORE the step).
+        """
+        B = len(seq_ids)
+        pos = [self.cache.reserve(sid, 1) for sid in seq_ids]
+        h = np.stack([self._embed1(int(t), p)
+                      for t, p in zip(last_tokens, pos)])
+        padded_ids = list(seq_ids)
+        if pad_to is not None and pad_to > B:
+            padded_ids += [_PAD_SEQ] * (pad_to - B)
+        lens = np.asarray([p + 1 for p in pos]
+                          + [0] * (len(padded_ids) - B), np.int32)
+        for layer in range(self.num_layers):
+            rows = [self._project1(layer, h[i]) for i in range(B)]
+            for i, sid in enumerate(seq_ids):
+                self.cache.write_rows(sid, layer, pos[i],
+                                      rows[i][1][None],
+                                      rows[i][2][None])
+            q = np.zeros((len(padded_ids), self.num_heads,
+                          self.head_dim), np.float32)
+            for i in range(B):
+                q[i] = rows[i][0]
+            table, _ = self.cache.block_table(padded_ids)
+            k_ar, v_ar, ks, vs = self.cache.views(layer)
+            attn = paged_decode_attention(
+                q, k_ar, v_ar, table, lens,
+                block_tokens=self.cache.config.block_tokens,
+                k_scales=ks, v_scales=vs, backend=self.attn_backend)
+            for i in range(B):
+                h[i] = self._mlp1(layer, h[i], attn[i])
+        logits = np.stack([self.logits1(h[i], pos[i] + 1)
+                           for i in range(B)])
+        return logits, np.argmax(logits, axis=1).astype(np.int64)

@@ -248,6 +248,15 @@ class ServingEngine:
             return "warming"
         return "starting"
 
+    def health_doc(self) -> Dict:
+        """The /healthz body. ``engine_kind`` lets a prober (fleet
+        router, steering daemon) tell a one-shot replica from a decode
+        replica without schema-sniffing the rest of the payload; the
+        decode engine's doc adds its KV-occupancy fields under the
+        same contract."""
+        return {"status": self.health(), "engine_kind": "oneshot",
+                "queue_depth": self._batcher.depth()}
+
     # -- request path ------------------------------------------------------
 
     def submit(self, feed: Dict[str, np.ndarray],

@@ -45,6 +45,17 @@ the four behaviors a fleet needs and a single engine cannot have:
   loser (``serving.hedge_wasted``), and the loser's socket is closed
   so it stops consuming a replica slot.
 
+- **Token-level stream failover.** ``generate()`` proxies a decode
+  replica's chunked ``/generate`` stream; when the replica dies
+  mid-stream the router re-dispatches to a survivor with
+  ``resume_from`` set to the next undelivered index and suppresses
+  anything already yielded, so the caller sees every token index
+  exactly once, in order, with no gaps
+  (``serving.stream_resumes`` + ``serving.stream_resume`` flight
+  events). Streams are admission-priced in COST UNITS scaled by
+  ``max_tokens`` (``FleetConfig.cost_unit_tokens``), so an expensive
+  low-priority stream sheds before a cheap high-priority one.
+
 The router speaks plain HTTP/1.1 to the replicas over raw sockets and
 routes every frame through ``distributed.fault.get_injector()`` — the
 same injector that drills the PS dataplane — so ``tools/
@@ -113,13 +124,22 @@ class FleetConfig:
     """Router knobs.
 
     ``cost_classes`` — ordered (name, admit_frac) pairs, highest
-    priority first; ``admit_frac * max_queue`` is the queue depth at
-    which that class starts shedding. ``hedge_after_ms=None`` disables
-    straggler hedging (failure retries still run). ``request_timeout_s``
-    bounds a request WITHOUT an explicit deadline. ``eject_after`` is
-    consecutive probe/dispatch failures before a replica leaves
-    rotation; with ``health_interval_ms`` it bounds how long a dead
-    replica can keep eating traffic."""
+    priority first; ``admit_frac * max_queue`` is the queue depth — in
+    COST UNITS — at which that class starts shedding.
+    ``hedge_after_ms=None`` disables straggler hedging (failure
+    retries still run). ``request_timeout_s`` bounds a request WITHOUT
+    an explicit deadline. ``eject_after`` is consecutive
+    probe/dispatch failures before a replica leaves rotation; with
+    ``health_interval_ms`` it bounds how long a dead replica can keep
+    eating traffic.
+
+    Cost units price admission by EXPECTED WORK, not request count: a
+    one-shot predict is 1 unit, a decode stream is
+    ``ceil(max_tokens / cost_unit_tokens)`` units
+    (``default_stream_tokens`` when the caller names no budget) — so
+    one 512-token stream weighs what 32 one-shot requests weigh, and
+    under pressure a long low-priority stream sheds BEFORE a short
+    high-priority one rather than both being "one request"."""
 
     def __init__(self,
                  max_queue: int = 128,
@@ -134,7 +154,10 @@ class FleetConfig:
                  health_interval_ms: float = 100.0,
                  eject_after: int = 2,
                  connect_timeout_s: float = 2.0,
-                 backoff_ms: float = 25.0):
+                 backoff_ms: float = 25.0,
+                 cost_unit_tokens: int = 16,
+                 default_stream_tokens: int = 16,
+                 stream_stall_s: float = 5.0):
         self.max_queue = int(max_queue)
         if self.max_queue < 1:
             raise ValueError("max_queue must be >= 1")
@@ -173,6 +196,21 @@ class FleetConfig:
         self.eject_after = max(1, int(eject_after))
         self.connect_timeout_s = float(connect_timeout_s)
         self.backoff_ms = float(backoff_ms)
+        self.cost_unit_tokens = max(1, int(cost_unit_tokens))
+        self.default_stream_tokens = max(1, int(default_stream_tokens))
+        # the streaming analogue of hedging: a stream attempt that
+        # goes THIS long with no bytes (no token, no finish) is
+        # declared stalled and failed over — without it a replica that
+        # accepts the connection and then wedges burns the caller's
+        # whole deadline on one attempt
+        self.stream_stall_s = float(stream_stall_s)
+
+    def stream_units(self, max_tokens: Optional[int]) -> int:
+        """Admission weight of a decode stream: its expected decode
+        cost in one-shot-request equivalents."""
+        toks = (int(max_tokens) if max_tokens is not None
+                else self.default_stream_tokens)
+        return max(1, -(-toks // self.cost_unit_tokens))
 
     def class_rank(self, name: str) -> int:
         for i, (n, _) in enumerate(self.cost_classes):
@@ -207,6 +245,8 @@ class Replica:
         self.served = 0            # results actually surfaced from here
         self.ejections = 0
         self.was_ejected = False   # a rejoin is only a rejoin after one
+        self.kind = "unknown"      # healthz engine_kind: oneshot|decode
+        self.kv_occupancy: Optional[float] = None
 
     @property
     def routable(self) -> bool:
@@ -215,7 +255,8 @@ class Replica:
     def snapshot(self) -> Dict:
         return {"endpoint": self.endpoint, "state": self.state,
                 "failures": self.failures, "inflight": self.inflight,
-                "served": self.served, "ejections": self.ejections}
+                "served": self.served, "ejections": self.ejections,
+                "kind": self.kind, "kv_occupancy": self.kv_occupancy}
 
 
 class _FleetRequest:
@@ -226,10 +267,10 @@ class _FleetRequest:
     __slots__ = ("inputs", "cost_class", "rank", "deadline", "rid",
                  "future", "t_enqueue", "trace_ctx", "cond", "done",
                  "live", "last_launch", "last_error", "attempt_socks",
-                 "tried")
+                 "tried", "units")
 
     def __init__(self, inputs, cost_class, rank, deadline, rid,
-                 trace_ctx):
+                 trace_ctx, units=1):
         self.inputs = inputs          # {name: nested list} (json-ready)
         self.cost_class = cost_class
         self.rank = rank
@@ -245,6 +286,49 @@ class _FleetRequest:
         self.last_error: Optional[BaseException] = None
         self.attempt_socks: List[socket.socket] = []
         self.tried: set = set()       # endpoints with a LIVE attempt
+        self.units = int(units)       # admission cost units held
+
+
+class _FleetStream:
+    """Iterator over a fleet decode stream. Exists so the admission
+    cost units release EXACTLY once on every exit path — exhaustion,
+    ``close()``/``cancel()``, caller error, or a stream that is never
+    iterated at all (a bare generator's ``finally`` never runs if its
+    body never starts). ``cancel`` is the duck-typed hook the HTTP
+    front calls when the downstream client disconnects."""
+
+    __slots__ = ("_gen", "_release")
+
+    def __init__(self, gen, release):
+        self._gen = gen
+        self._release = release
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._gen)
+        except BaseException:
+            # StopIteration included: the stream is over either way
+            self._release()
+            raise
+
+    def close(self) -> None:
+        self._gen.close()
+        self._release()
+
+    def cancel(self) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except (RuntimeError, AttributeError):
+            # finalizer during interpreter teardown: the generator may
+            # be mid-run (RuntimeError) or the module half-cleared
+            # (AttributeError) — neither may raise out of __del__
+            pass
 
 
 # -- minimal fault-injectable HTTP client ------------------------------------
@@ -349,6 +433,131 @@ def _read_http_response(sock: socket.socket) -> Tuple[int, bytes]:
     return status, rest[:clen]
 
 
+class _StreamHTTP(Exception):
+    """A /generate attempt got a complete NON-200 reply: the replica is
+    alive and said no. Carries status + error body so the caller can
+    route (503 retry elsewhere, 4xx/5xx surface typed)."""
+
+    def __init__(self, status: int, raw: bytes):
+        super().__init__("HTTP %d: %s" % (status, _err_of(raw)))
+        self.status = int(status)
+        self.raw = raw
+
+
+def _http_stream(endpoint: str, method: str, path: str,
+                 body: Optional[bytes], timeout_s: float,
+                 connect_timeout_s: float,
+                 headers: Sequence[Tuple[str, str]] = (),
+                 sock_sink=None,
+                 stall_timeout_s: Optional[float] = None):
+    """One chunked-transfer HTTP/1.1 exchange: generator yielding each
+    ndjson event object as its bytes arrive, so tokens surface with
+    decode-step latency instead of stream-end latency. Same raw-socket
+    + fault-injector discipline as ``_http_call`` (the chaos drill
+    kills replicas mid-chunk and this path must die honestly: any
+    transport failure — EOF mid-chunk, reset, timeout, injected drop —
+    raises ``_Transport`` so the router can fail over and resume)."""
+    host, _, port = endpoint.rpartition(":")
+    try:
+        sock = socket.create_connection((host or "127.0.0.1", int(port)),
+                                        timeout=connect_timeout_s)
+    except OSError as e:
+        raise _Transport("connect %s: %s" % (endpoint, e)) from e
+    try:
+        # the socket timeout bounds each recv(), i.e. the silence
+        # BETWEEN events — the overall deadline is the caller's loop
+        sock.settimeout(max(0.05, min(timeout_s, stall_timeout_s)
+                            if stall_timeout_s is not None
+                            else timeout_s))
+        if sock_sink is not None:
+            sock_sink(sock)
+        lines = ["%s %s HTTP/1.1" % (method, path),
+                 "Host: %s" % endpoint,
+                 "Connection: close",
+                 "Content-Length: %d" % (len(body) if body else 0),
+                 "Content-Type: application/json"]
+        for k, v in headers:
+            lines.append("%s: %s" % (k, v))
+        frame = ("\r\n".join(lines) + "\r\n\r\n").encode() + (body or b"")
+        inj = _fault.get_injector()
+        try:
+            if inj is not None:
+                if not inj.on_send(sock, frame):
+                    pass  # injected send-drop -> recv timeout below
+            else:
+                sock.sendall(frame)
+            if inj is not None and inj.on_recv(sock) == "drop":
+                raise socket.timeout("injected: response dropped")
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ValueError("EOF before response headers")
+                buf += chunk
+                if len(buf) > 1 << 20:
+                    raise ValueError("oversized response headers")
+            head, _, buf = buf.partition(b"\r\n\r\n")
+            hlines = head.decode("latin-1").split("\r\n")
+            parts = hlines[0].split(None, 2)
+            if len(parts) < 2 or not parts[1].isdigit():
+                raise ValueError("bad status line %r" % hlines[0])
+            status = int(parts[1])
+            hdrs = {}
+            for ln in hlines[1:]:
+                k, _, v = ln.partition(":")
+                hdrs[k.strip().lower()] = v.strip()
+            if status != 200:
+                # complete (small) error doc, then the typed refusal
+                clen = int(hdrs.get("content-length") or 0)
+                while len(buf) < clen:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                raise _StreamHTTP(status, buf[:clen])
+            if hdrs.get("transfer-encoding", "").lower() != "chunked":
+                raise ValueError("stream reply is not chunked")
+            pending = b""  # decoded bytes not yet forming a full line
+            while True:
+                while b"\r\n" not in buf:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        raise ValueError("EOF mid-chunk header")
+                    buf += chunk
+                size_line, _, buf = buf.partition(b"\r\n")
+                size = int(size_line.strip().split(b";")[0] or b"0", 16)
+                if size == 0:
+                    return  # terminal chunk — clean stream end
+                while len(buf) < size + 2:  # data + trailing CRLF
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        raise ValueError("EOF mid-chunk (%d/%d bytes)"
+                                         % (len(buf), size))
+                    buf += chunk
+                pending += buf[:size]
+                buf = buf[size + 2:]
+                while b"\n" in pending:
+                    line, _, pending = pending.partition(b"\n")
+                    if line.strip():
+                        yield json.loads(line.decode())
+        except _StreamHTTP:
+            raise
+        except _fault.FaultInjected as e:
+            raise _Transport("injected: %s" % e) from e
+        except (socket.timeout, OSError, ValueError) as e:
+            # json.JSONDecodeError is a ValueError: a half-written line
+            # from a dying replica is a transport failure, not a
+            # protocol error
+            raise _Transport("%s %s: %s: %s"
+                             % (method, endpoint, type(e).__name__,
+                                e)) from e
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
 # -- the router --------------------------------------------------------------
 
 class FleetRouter:
@@ -369,6 +578,10 @@ class FleetRouter:
         self._heap: List[Tuple[int, int, _FleetRequest]] = []
         self._seq = itertools.count()
         self._cond = threading.Condition()
+        # admission depth in COST UNITS (see FleetConfig): queued
+        # one-shot requests + live decode streams, both under _cond
+        self._queued_units = 0
+        self._stream_units = 0
         # request-id -> Future, LRU-bounded (same contract as the
         # engine's cache: completed ids stay joinable until evicted)
         self._ids: "OrderedDict[str, Future]" = OrderedDict()
@@ -408,6 +621,7 @@ class FleetRouter:
         with self._cond:
             leftovers = [req for _, _, req in self._heap]
             self._heap = []
+            self._queued_units = 0
             self._cond.notify_all()
         for req in leftovers:
             self._finish_error(req, EngineStopped("fleet stopped"))
@@ -438,6 +652,7 @@ class FleetRouter:
         out = _m.snapshot()
         with self._cond:
             out["queue_depth"] = len(self._heap)
+            out["queue_units"] = self._queued_units + self._stream_units
         out["running"] = self.running
         out["state"] = self.health()
         with self._rep_lock:
@@ -507,9 +722,12 @@ class FleetRouter:
                     self._ids.popitem(last=False)
         try:
             with self._cond:
-                depth = len(self._heap)
+                # depth is measured in COST UNITS: a queued decode
+                # stream holding 32 units pressures the watermarks as
+                # hard as 32 queued one-shot requests would
+                depth = self._queued_units + self._stream_units
                 admit = self.config.admit_depth(cls)
-                if depth >= admit:
+                if depth + req.units - 1 >= admit:
                     # the class's watermark tripped. For the TOP lane
                     # the watermark IS the hard bound
                     # (ServerOverloaded); any cheaper lane is SHED —
@@ -527,6 +745,7 @@ class FleetRouter:
                         "— shed; retry later or use a higher-priority "
                         "class" % (depth, cls, admit))
                 heapq.heappush(self._heap, (rank, next(self._seq), req))
+                self._queued_units += req.units
                 _m.inc(_m.REQUESTS)
                 self._set_depth(len(self._heap))
                 self._cond.notify()
@@ -555,6 +774,220 @@ class FleetRouter:
         return self.submit(feed, deadline_ms, request_id=request_id,
                            cost_class=cost_class).result(timeout)
 
+    # -- streaming decode across the fleet -----------------------------------
+
+    def generate(self, prompt, *, max_tokens: Optional[int] = None,
+                 request_id: Optional[str] = None,
+                 cost_class: Optional[str] = None,
+                 deadline_s: Optional[float] = None,
+                 resume_from: int = 0):
+        """Stream one decode request through the fleet: pick a decode
+        replica, proxy its ``/generate`` chunked stream, and on replica
+        death RESUME on a survivor from the next undelivered token.
+        The ``(request_id, token_index)`` contract makes failover
+        exactly-once at the token level: each index is yielded at most
+        once, in order, with no gaps, however many replicas die
+        mid-stream (the survivor regenerates deterministically and the
+        router suppresses anything already delivered).
+
+        Admission is cost-priced: the stream holds
+        ``ceil(max_tokens / cost_unit_tokens)`` queue units for its
+        lifetime, so a long low-priority stream trips its shed
+        watermark before a short high-priority one. Pre-stream
+        failures are typed like ``submit`` (``RequestShed`` /
+        ``ServerOverloaded`` / ``EngineStopped`` / ``ValueError``);
+        once streaming, terminal failures arrive in-band as a finish
+        event (reason ``deadline_expired`` / ``replica_unavailable`` /
+        ``error``) — the engine's own contract, since the HTTP front
+        cannot retract a 200 mid-stream."""
+        if not self.running:
+            raise EngineStopped("fleet router is not accepting requests")
+        cls = cost_class or self.config.default_class
+        self.config.class_rank(cls)  # raises on unknown class
+        if not isinstance(prompt, (list, tuple)) or not prompt:
+            raise ValueError("prompt must be a non-empty token list")
+        prompt = [int(t) for t in prompt]
+        if max_tokens is not None:
+            max_tokens = int(max_tokens)
+            if max_tokens < 1:
+                raise ValueError("max_tokens must be >= 1")
+        units = self.config.stream_units(max_tokens)
+        admit = self.config.admit_depth(cls)
+        with self._cond:
+            depth = self._queued_units + self._stream_units
+            if depth + units - 1 >= admit:
+                if admit >= self.config.max_queue:
+                    _m.inc(_m.REJECTED)
+                    raise ServerOverloaded(
+                        "fleet queue full (%d + %d units over %d); "
+                        "retry later"
+                        % (depth, units, self.config.max_queue))
+                _m.inc(_m.SHED, **{"class": cls})
+                raise RequestShed(
+                    "stream of %d cost unit(s) at depth %d would cross "
+                    "class %r watermark %d — shed; retry later, lower "
+                    "max_tokens, or use a higher-priority class"
+                    % (units, depth, cls, admit))
+            self._stream_units += units
+        rid = (str(request_id) if request_id is not None
+               else uuid.uuid4().hex)
+        deadline = time.monotonic() + (
+            float(deadline_s) if deadline_s is not None
+            else self.config.request_timeout_s)
+        _m.inc(_m.STREAMS)
+        released = []
+
+        def release():
+            # exactly-once: both the generator's finally and the
+            # wrapper call this; a stream the caller never iterates
+            # (generator body never entered) still releases on close
+            if released:
+                return
+            released.append(True)
+            with self._cond:
+                self._stream_units = max(0, self._stream_units - units)
+
+        return _FleetStream(
+            self._generate_stream(prompt, max_tokens, rid, cls,
+                                  deadline, int(resume_from), release),
+            release)
+
+    def _generate_stream(self, prompt, max_tokens, rid, cls, deadline,
+                         resume_from, release):
+        """The post-admission attempt loop (a generator: admission
+        already happened eagerly in ``generate`` so callers get typed
+        refusals at call time, not at first ``next()``)."""
+        cfg = self.config
+        next_index = int(resume_from)  # next token index owed caller
+        emitted = 0
+        failures = 0          # consecutive attempts with NO progress
+        tried: set = set()    # endpoints failed since last progress
+        last_error: Optional[BaseException] = None
+        try:
+            while True:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    _m.inc(_m.DEADLINE_EXPIRED)
+                    yield {"type": "finish",
+                           "reason": "deadline_expired",
+                           "error": "stream deadline expired after %d "
+                                    "delivered token(s)" % emitted,
+                           "tokens": emitted}
+                    return
+                if failures >= cfg.max_attempts:
+                    _m.inc(_m.STREAM_ERRORS)
+                    yield {"type": "finish",
+                           "reason": "replica_unavailable",
+                           "error": "no replica could continue the "
+                                    "stream after %d attempt(s)%s"
+                                    % (failures,
+                                       (": last error %s" % last_error)
+                                       if last_error else ""),
+                           "tokens": emitted}
+                    return
+                rep = self._pick(exclude=tried, kind="decode")
+                if rep is None:
+                    # nothing routable right now: bounded nap — a
+                    # relaunching replica may rejoin within deadline
+                    failures += 1
+                    time.sleep(max(0.0, min(cfg.backoff_ms / 1e3, rem)))
+                    continue
+                if failures > 0 or next_index > int(resume_from):
+                    _m.inc(_m.FLEET_RETRIES)
+                if next_index > int(resume_from):
+                    # a true mid-stream failover: the stream resumes
+                    # token-exact on another replica
+                    _m.inc(_m.STREAM_RESUMES)
+                    _flight.record("serving.stream_resume",
+                                   rid=rid[:12], endpoint=rep.endpoint,
+                                   from_index=next_index)
+                body = json.dumps({"prompt": prompt,
+                                   "max_tokens": max_tokens,
+                                   "cost_class": cls,
+                                   "deadline_ms": rem * 1e3,
+                                   "resume_from": next_index}).encode()
+                with self._rep_lock:
+                    rep.inflight += 1
+                try:
+                    for ev in _http_stream(
+                            rep.endpoint, "POST", "/generate", body,
+                            timeout_s=rem,
+                            connect_timeout_s=min(cfg.connect_timeout_s,
+                                                  max(rem, 0.05)),
+                            headers=[("X-Request-Id", rid)],
+                            stall_timeout_s=cfg.stream_stall_s):
+                        kind = ev.get("type")
+                        if kind == "token":
+                            idx = int(ev.get("index", -1))
+                            if idx < next_index:
+                                continue  # replayed duplicate — drop
+                            if idx > next_index:
+                                # a hole means the replica's replay
+                                # contract broke; treat as transport
+                                # and resume cleanly elsewhere
+                                raise _Transport(
+                                    "token index gap from %s: got %d, "
+                                    "expected %d"
+                                    % (rep.endpoint, idx, next_index))
+                            next_index += 1
+                            emitted += 1
+                            failures = 0
+                            tried = set()
+                            yield ev
+                        elif kind == "finish":
+                            reason = str(ev.get("reason") or "")
+                            if reason in ("engine_stopped", "cancelled"):
+                                # the REPLICA is going away (drain /
+                                # replica-local cancel), not our
+                                # caller: fail over and resume
+                                raise _Transport(
+                                    "replica %s ended stream early: %s"
+                                    % (rep.endpoint, reason))
+                            if reason == "deadline_expired":
+                                _m.inc(_m.DEADLINE_EXPIRED)
+                            with self._rep_lock:
+                                rep.served += 1
+                            yield ev
+                            return
+                        else:
+                            yield ev  # forward-compat passthrough
+                    raise _Transport(
+                        "stream from %s ended without a finish event"
+                        % rep.endpoint)
+                except _StreamHTTP as e:
+                    last_error = e
+                    if e.status == 503:
+                        # alive-but-refusing (overload/drain): proof of
+                        # life, never an ejection signal
+                        with self._rep_lock:
+                            rep.failures = 0
+                        tried.add(rep.endpoint)
+                        failures += 1
+                    elif e.status == 501:
+                        # a one-shot replica in a mixed fleet: remember
+                        # its kind so streams stop landing on it
+                        with self._rep_lock:
+                            rep.kind = "oneshot"
+                        tried.add(rep.endpoint)
+                        failures += 1
+                    else:
+                        # 4xx/5xx: deterministic — a retry would fail
+                        # identically; surface in-band
+                        _m.inc(_m.STREAM_ERRORS)
+                        yield {"type": "finish", "reason": "error",
+                               "error": str(e), "tokens": emitted}
+                        return
+                except _Transport as e:
+                    last_error = e
+                    self._note_failure(rep, str(e))
+                    tried.add(rep.endpoint)
+                    failures += 1
+                finally:
+                    with self._rep_lock:
+                        rep.inflight -= 1
+        finally:
+            release()
+
     def _set_depth(self, n: int) -> None:
         _m.set_queue_depth(n)
 
@@ -568,6 +1001,8 @@ class FleetRouter:
                 if not self._heap:
                     continue
                 _, _, req = heapq.heappop(self._heap)
+                self._queued_units = max(0, self._queued_units
+                                         - req.units)
                 self._set_depth(len(self._heap))
             self._serve(req)
 
@@ -816,13 +1251,19 @@ class FleetRouter:
 
     # -- routing + health ----------------------------------------------------
 
-    def _pick(self, exclude=()) -> Optional[Replica]:
+    def _pick(self, exclude=(), kind: Optional[str] = None
+              ) -> Optional[Replica]:
         """Least-inflight routable replica, round-robin on ties;
         ``exclude`` keeps a hedge off the endpoint its original is
         already waiting on (falls back to it when there is nothing
-        else — a straggler beats nothing)."""
+        else — a straggler beats nothing). ``kind`` restricts to
+        replicas whose probed ``engine_kind`` matches (unknown is
+        optimistically allowed, like unprobed state)."""
         with self._rep_lock:
             routable = [r for r in self.replicas if r.routable]
+            if kind is not None:
+                routable = [r for r in routable
+                            if r.kind in (kind, "unknown")]
             cands = [r for r in routable if r.endpoint not in exclude] \
                 or routable
             if not cands:
@@ -886,6 +1327,13 @@ class FleetRouter:
                 pass
             state = str(doc.get("status") or "")
             if status == 200 and state in ("serving", "ok"):
+                ekind = str(doc.get("engine_kind") or "")
+                occ = doc.get("kv_occupancy")
+                with self._rep_lock:
+                    if ekind:
+                        rep.kind = ekind
+                    rep.kv_occupancy = (float(occ) if isinstance(
+                        occ, (int, float)) else None)
                 self._mark_up(rep)
             elif state in ("draining", "stopped"):
                 # the replica SAID it is leaving: stop routing NOW —

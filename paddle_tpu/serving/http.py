@@ -18,13 +18,19 @@ Endpoints:
   deadline passed while queued), 400 (malformed), 500
   (``BatchExecutionError`` — the model failed on that batch; the
   engine stays healthy).
+- ``POST /generate`` — streaming decode (an engine exposing
+  ``generate()``, i.e. a ``DecodeEngine`` or a fleet front of them):
+  chunked ndjson token events terminated by one finish event; see
+  ``_do_generate``. 501 on a one-shot engine.
 - ``GET /healthz`` — machine-readable lifecycle: 200 with
   ``{"status": "serving"}`` only while the engine accepts work, 503
   with the actual state (``starting | warming | draining | stopped``)
   otherwise — a fleet router stops routing at ``draining``, not at
-  connection refusal; the body names this process's metrics-dump path
-  (``metrics_dump``) so an operator probing a replica knows where its
-  telemetry lands.
+  connection refusal. Engines with ``health_doc()`` enrich the body:
+  ``engine_kind`` (``oneshot | decode``) plus, on decode replicas,
+  the KV occupancy a router places streams by. The body also names
+  this process's metrics-dump path (``metrics_dump``) so an operator
+  probing a replica knows where its telemetry lands.
 - ``GET /metrics`` — the FULL observability registry via
   ``observability.dump_prometheus()`` (one code path with every other
   exporter: serving.* plus every runtime family, histogram quantile
@@ -82,17 +88,21 @@ class _Handler(BaseHTTPRequestHandler):
         engine = self.server.engine
         if self.path == "/healthz":
             health = engine.health()
-            dump = _dtrace.dump_path()
+            # engines that implement health_doc() (ServingEngine:
+            # engine_kind=oneshot; DecodeEngine: engine_kind=decode +
+            # KV occupancy) enrich the body; anything else — e.g. a
+            # FleetRouter front — keeps the bare status contract
+            doc_fn = getattr(engine, "health_doc", None)
+            doc = doc_fn() if callable(doc_fn) else {"status": health}
+            doc["metrics_dump"] = _dtrace.dump_path()
             if health == "serving":
-                self._reply_json(200, {"status": "serving",
-                                       "metrics_dump": dump})
+                self._reply_json(200, _json_safe(doc))
             else:
                 # starting/warming: not ready yet; "draining": stop()
                 # flipped readiness but in-flight requests are still
                 # finishing — the supervisor must stop routing now and
                 # NOT kill the process yet
-                self._reply_json(503, {"status": health,
-                                       "metrics_dump": dump})
+                self._reply_json(503, _json_safe(doc))
         elif self.path == "/metrics":
             self._reply(200, _obs.dump_prometheus().encode(),
                         "text/plain; version=0.0.4")
@@ -102,6 +112,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_json(404, {"error": "no route %s" % self.path})
 
     def do_POST(self):  # noqa: N802
+        if self.path == "/generate":
+            self._do_generate()
+            return
         if self.path != "/predict":
             self._reply_json(404, {"error": "no route %s" % self.path})
             return
@@ -184,6 +197,95 @@ class _Handler(BaseHTTPRequestHandler):
         request is the one the caller most needs to correlate with its
         distributed trace."""
         return (("X-Trace-Id", req_ctx.trace_id),) if req_ctx else ()
+
+    # -- streaming decode ---------------------------------------------------
+
+    def _do_generate(self):
+        """``POST /generate``: chunked ndjson token stream.
+
+        Body: ``{"prompt": [ids], "max_tokens": n, "cost_class": c,
+        "deadline_ms": d, "resume_from": i}``; ``X-Request-Id`` makes
+        the stream idempotent (a hedge/failover duplicate replays or
+        attaches, and ``resume_from`` suppresses already-delivered
+        token indices — the fleet's exactly-once resume contract).
+
+        Reply: 200 + ``Transfer-Encoding: chunked``, one JSON object
+        per line — ``{"type": "token", "index": i, "token": t}``
+        events, then exactly one terminal
+        ``{"type": "finish", "reason": ...}``. Admission failures
+        reject BEFORE the stream starts, with the same typed status
+        mapping as /predict; once streaming, failures arrive in-band
+        as the finish event (the status line is already gone)."""
+        engine = self.server.engine
+        gen = getattr(engine, "generate", None)
+        if gen is None:
+            self._reply_json(
+                501, {"error": "engine %s does not stream"
+                      % type(engine).__name__,
+                      "type": "NotStreaming"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(length) or b"{}")
+            prompt = req.get("prompt")
+            if not isinstance(prompt, list) or not prompt or \
+                    not all(isinstance(t, int) for t in prompt):
+                raise ValueError(
+                    'body needs {"prompt": [token ids]}')
+            deadline_ms = req.get("deadline_ms")
+            if deadline_ms is not None and not isinstance(
+                    deadline_ms, (int, float)):
+                raise ValueError("deadline_ms must be a number, got %r"
+                                 % (deadline_ms,))
+            stream = gen(
+                prompt,
+                max_tokens=req.get("max_tokens"),
+                request_id=self.headers.get("X-Request-Id") or None,
+                cost_class=req.get("cost_class") or "high",
+                deadline_s=(deadline_ms / 1e3
+                            if deadline_ms is not None else None),
+                resume_from=int(req.get("resume_from") or 0))
+        except ServerOverloaded as e:
+            self._reply_json(503, {"error": str(e),
+                                   "type": type(e).__name__},
+                             (("Retry-After", "1"),))
+            return
+        except EngineStopped as e:
+            self._reply_json(503, {"error": str(e),
+                                   "type": "EngineStopped"})
+            return
+        except (ValueError, RequestTooLarge,
+                json.JSONDecodeError) as e:
+            self._reply_json(400, {"error": str(e),
+                                   "type": type(e).__name__})
+            return
+        except Exception as e:  # noqa: BLE001 — engine-side failure
+            self._reply_json(500, {"error": "%s: %s"
+                                   % (type(e).__name__, e)})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            for ev in stream:
+                self._write_chunk(json.dumps(ev).encode() + b"\n")
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError,
+                ConnectionAbortedError):
+            # client went away mid-stream (hedge loser, dead caller):
+            # stop generating for it
+            cancel = getattr(stream, "cancel", None)
+            if callable(cancel):
+                cancel()
+            raise
+
+    def _write_chunk(self, data: bytes) -> None:
+        self.wfile.write(b"%x\r\n" % len(data))
+        self.wfile.write(data)
+        self.wfile.write(b"\r\n")
+        self.wfile.flush()
 
 
 def _json_safe(obj):

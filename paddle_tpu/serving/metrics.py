@@ -31,10 +31,29 @@ Families (README "Serving"):
 ``serving.replica_ejections{cause=}``  counter: replicas pulled from
                                    rotation (dead | draining | unhealthy)
 ``serving.replica_rejoins``        counter: ejected replicas back serving
+``serving.streams``                counter: accepted decode streams
+``serving.ttft_ms``                histogram: submit -> first token
+``serving.itl_ms``                 histogram: gap between emitted tokens
+``serving.tokens``                 counter: decode tokens emitted
+``serving.prefill_tokens``         counter: prompt tokens prefilled
+``serving.decode_steps``           counter: per-token batch steps run
+``serving.decode_batch``           histogram: real rows per decode step
+``serving.kv_occupancy``           gauge: used / total KV-cache blocks
+``serving.preemptions``            counter: sequences evicted under KV
+                                   memory pressure (re-prefilled later)
+``serving.stream_resumes``         counter: streams resumed from a token
+                                   index > 0 ((request_id, token_index)
+                                   failover)
+``serving.stream_errors``          counter: streams finished by error
+                                   (deadline | engine stop | internal)
 =================================  =======================================
 
 The fleet families (``shed``/``hedges``/``replica_*``) are recorded by
-``serving/fleet.py``; everything above them by the engine/batcher.
+``serving/fleet.py``; the decode families (``streams`` .. ``stream_errors``,
+with ``serving.ttft_ms``/``serving.itl_ms`` as the autoregressive SLO
+axis where one-shot serving reads ``serving.queue_ms``) by
+``serving/decode/engine.py``; everything above them by the
+engine/batcher.
 
 Handles are re-fetched from the registry on every write (get-or-create
 is a dict lookup) instead of cached at import: ``observability.reset()``
@@ -51,7 +70,10 @@ __all__ = [
     "QUEUE_MS", "TOTAL_MS", "QUEUE_DEPTH", "DEDUP_HITS",
     "SHED", "HEDGES", "HEDGE_WASTED", "FLEET_RETRIES",
     "REPLICA_EJECTIONS", "REPLICA_REJOINS",
-    "inc", "observe", "set_queue_depth", "snapshot",
+    "STREAMS", "TTFT_MS", "ITL_MS", "TOKENS", "PREFILL_TOKENS",
+    "DECODE_STEPS", "DECODE_BATCH", "KV_OCCUPANCY", "PREEMPTIONS",
+    "STREAM_RESUMES", "STREAM_ERRORS",
+    "inc", "observe", "set_gauge", "set_queue_depth", "snapshot",
 ]
 
 REQUESTS = "serving.requests"
@@ -72,6 +94,17 @@ HEDGE_WASTED = "serving.hedge_wasted"
 FLEET_RETRIES = "serving.fleet_retries"
 REPLICA_EJECTIONS = "serving.replica_ejections"
 REPLICA_REJOINS = "serving.replica_rejoins"
+STREAMS = "serving.streams"
+TTFT_MS = "serving.ttft_ms"
+ITL_MS = "serving.itl_ms"
+TOKENS = "serving.tokens"
+PREFILL_TOKENS = "serving.prefill_tokens"
+DECODE_STEPS = "serving.decode_steps"
+DECODE_BATCH = "serving.decode_batch"
+KV_OCCUPANCY = "serving.kv_occupancy"
+PREEMPTIONS = "serving.preemptions"
+STREAM_RESUMES = "serving.stream_resumes"
+STREAM_ERRORS = "serving.stream_errors"
 
 
 def inc(name: str, n: int = 1, **labels) -> None:
@@ -80,6 +113,10 @@ def inc(name: str, n: int = 1, **labels) -> None:
 
 def observe(name: str, v) -> None:
     _obs.histogram(name).observe(v)
+
+
+def set_gauge(name: str, v) -> None:
+    _obs.gauge(name).set(v)
 
 
 def set_queue_depth(n: int) -> None:
@@ -92,9 +129,13 @@ def snapshot() -> dict:
     out = {}
     for name in (REQUESTS, REJECTED, DEADLINE_EXPIRED, ERRORS,
                  BATCH_ERRORS, BATCHES, PADDING_WASTE, DEDUP_HITS,
-                 HEDGES, HEDGE_WASTED, FLEET_RETRIES, REPLICA_REJOINS):
+                 HEDGES, HEDGE_WASTED, FLEET_RETRIES, REPLICA_REJOINS,
+                 STREAMS, TOKENS, PREFILL_TOKENS, DECODE_STEPS,
+                 PREEMPTIONS, STREAM_RESUMES, STREAM_ERRORS):
         out[name] = _obs.counter_value(name)
     out[QUEUE_DEPTH] = _obs.gauge_value(QUEUE_DEPTH)
-    for name in (BATCH_SIZE, QUEUE_MS, TOTAL_MS):
+    out[KV_OCCUPANCY] = _obs.gauge_value(KV_OCCUPANCY)
+    for name in (BATCH_SIZE, QUEUE_MS, TOTAL_MS, TTFT_MS, ITL_MS,
+                 DECODE_BATCH):
         out[name] = _obs.histogram(name).snapshot()
     return out

@@ -1,0 +1,104 @@
+"""Supervised streaming-decode replica for the fleet chaos drill.
+
+Run under ``paddle_tpu.distributed.launch --serving_script=<this>``:
+builds a ``DecodeEngine`` over the fixed-seed ``TinyDecodeLM`` (every
+replica serves the IDENTICAL next-token function — and regeneration is
+bit-deterministic regardless of batch composition or chunk boundaries,
+so a failed-over stream re-prefixed on a different replica continues
+with exactly the tokens the dead replica would have emitted) and
+serves it with the streaming HTTP front (``/generate`` chunked ndjson,
+``/healthz`` with ``engine_kind=decode``) on
+``$PADDLE_SERVING_ENDPOINT``.
+
+Drill hooks (env):
+
+- ``SERVING_DIE_REPLICA`` / ``DECODE_DIE_AFTER_TOKENS`` — the named
+  replica index SIGKILLs ITSELF (no cleanup, no drain, streams
+  mid-token) once it has emitted that many decode tokens, but only on
+  its first incarnation (``PADDLE_RESTART_COUNT=0``): the supervisor
+  relaunches it and the relaunched incarnation must rejoin the fleet
+  and serve streams again.
+
+The driver side of the drill builds the SAME engine config locally
+(``ENGINE_KW``) and verifies every delivered token value-for-value
+against local regeneration — a resumed stream that re-emitted,
+skipped, or diverged after failover cannot hide.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# one engine config, shared verbatim by every replica AND the driver's
+# local reference engine: determinism across processes is the drill's
+# foundation, so the config must never be able to drift between them
+ENGINE_KW = dict(
+    kv_blocks=96, kv_block_tokens=16, num_layers=2, num_heads=2,
+    head_dim=8, max_batch_size=8, max_waiting=64, max_tokens_cap=512,
+    prefill_chunk_tokens=16, eos_token=None)
+
+
+def build_engine():
+    from paddle_tpu.serving.decode import DecodeConfig, DecodeEngine
+    return DecodeEngine(DecodeConfig(**ENGINE_KW))
+
+
+def main() -> int:
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.serving import metrics as sm
+
+    endpoint = os.environ.get("PADDLE_SERVING_ENDPOINT",
+                              "127.0.0.1:8300")
+    index = int(os.environ.get("PADDLE_SERVING_REPLICA_INDEX", "0") or 0)
+    restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0") or 0)
+    die_replica = int(os.environ.get("SERVING_DIE_REPLICA", "-1") or -1)
+    die_after = int(os.environ.get("DECODE_DIE_AFTER_TOKENS", "0") or 0)
+    if index != die_replica or restart > 0:
+        die_after = 0  # only the named replica's FIRST incarnation dies
+
+    host, _, port = endpoint.rpartition(":")
+    engine = build_engine().start()
+    server, _thread = serving.start_http_server(
+        engine, host or "127.0.0.1", int(port))
+
+    if die_after:
+        # the drill's replica death: SIGKILL once the engine has
+        # emitted `die_after` tokens — streams half-delivered, KV
+        # blocks held, the HTTP chunks mid-flight. Watching the token
+        # counter (~1 token/ms on CPU) lands the kill mid-stream
+        # without reaching into the engine's step loop.
+        def watchdog():
+            while True:
+                if obs.counter_value(sm.TOKENS) >= die_after:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                time.sleep(0.001)
+
+        threading.Thread(target=watchdog, name="die-watchdog",
+                         daemon=True).start()
+
+    print("[decode replica %d r%d] serving %s (die_after_tokens=%d)"
+          % (index, restart, endpoint, die_after), flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        while not stop.wait(0.2):
+            pass
+    finally:
+        engine.stop()
+        server.shutdown()
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

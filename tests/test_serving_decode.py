@@ -1,0 +1,571 @@
+"""Continuous-batching decode tier (paddle_tpu/serving/decode/ +
+fleet streaming): paged KV cache block accounting, quantized storage,
+the paged-attention kernel's dense/interpret parity, deterministic
+regeneration (the failover contract), the per-token engine (TTFT/ITL,
+preemption, dedup replay), cost-unit fleet admission, and token-level
+exactly-once stream failover over real loopback replicas.
+
+The multi-process SIGKILL drill lives in ``tools/serving_chaos.py``
+(CI gate 8); here replicas die in-process (engine stop + socket close)
+which exercises the same router-side failover path.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.ops.pallas.paged_attention import (
+    paged_attention_reference, paged_decode_attention)
+from paddle_tpu.serving import metrics as sm
+from paddle_tpu.serving.decode import (DecodeConfig, DecodeEngine,
+                                       KVCacheConfig, KVCacheFull,
+                                       PagedKVCache, TinyDecodeLM)
+from paddle_tpu.serving.fleet import FleetConfig, FleetRouter
+from paddle_tpu.serving import (DeadlineExpired, RequestShed,
+                                ServerOverloaded)
+from paddle_tpu.serving.http import start_http_server
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    obs.reset()
+    obs.enable()
+    yield
+    obs.reset()
+    obs.disable()
+
+
+def _cache(**kw):
+    kw.setdefault("num_blocks", 8)
+    kw.setdefault("block_tokens", 4)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("head_dim", 4)
+    return PagedKVCache(KVCacheConfig(**kw))
+
+
+def _kv(rng, n, layers=2, heads=2, dim=4):
+    """``[T, layers, heads, dim]`` float32 rows for ``append``."""
+    return rng.randn(n, layers, heads, dim).astype(np.float32)
+
+
+# -- KV cache block accounting ------------------------------------------------
+
+class TestKVCacheAccounting:
+    def test_alloc_free_parity_join_leave(self):
+        c = _cache()
+        rng = np.random.RandomState(0)
+        total = c.free_blocks()
+        for round_ in range(3):
+            ids = ["s%d_%d" % (round_, i) for i in range(3)]
+            for sid in ids:
+                c.register(sid)
+                c.append(sid, _kv(rng, 5), _kv(rng, 5))
+            c.check()
+            for sid in ids:
+                c.release(sid)
+            assert c.free_blocks() == total
+            c.check()
+
+    def test_evict_readmit_parity(self):
+        c = _cache(num_blocks=4)
+        rng = np.random.RandomState(1)
+        free0 = c.free_blocks()
+        c.register("a")
+        c.append("a", _kv(rng, 9), _kv(rng, 9))
+        used = free0 - c.free_blocks()
+        assert used == 3  # ceil(9/4)
+        c.release("a")    # evicted under pressure
+        assert c.free_blocks() == free0
+        c.register("a")   # re-admitted: re-prefill from scratch
+        c.append("a", _kv(rng, 9), _kv(rng, 9))
+        assert free0 - c.free_blocks() == used
+        c.release("a")
+        assert c.free_blocks() == free0
+        c.check()
+
+    def test_reserve_is_atomic_when_full(self):
+        c = _cache(num_blocks=2, num_layers=1)
+        c.register("a")
+        start = c.reserve("a", 7)  # 2 blocks of 4
+        assert start == 0 and c.free_blocks() == 0
+        with pytest.raises(KVCacheFull):
+            c.reserve("a", 2)  # needs a 3rd block
+        # nothing changed: same length, same free count
+        assert c.seq_len("a") == 7 and c.free_blocks() == 0
+        c.check()
+
+    def test_seeded_churn_zero_leaks(self):
+        """Randomized register/append/release churn; the partition
+        invariant (free + owned == arena) must hold at every step and
+        every block must come back at the end."""
+        rng = np.random.RandomState(0xC4A0)
+        c = _cache(num_blocks=16, num_layers=1)
+        total = c.free_blocks()
+        live = {}
+        for step in range(300):
+            op = rng.rand()
+            if op < 0.45 and len(live) < 6:
+                sid = "s%d" % step
+                c.register(sid)
+                live[sid] = 0
+            elif op < 0.8 and live:
+                sid = list(live)[rng.randint(len(live))]
+                n = int(rng.randint(1, 6))
+                try:
+                    c.append(sid, _kv(rng, n, layers=1),
+                             _kv(rng, n, layers=1))
+                    live[sid] += n
+                except KVCacheFull:
+                    c.release(sid)  # preempt the victim
+                    del live[sid]
+            elif live:
+                sid = list(live)[rng.randint(len(live))]
+                c.release(sid)
+                del live[sid]
+            c.check()
+            for sid, n in live.items():
+                assert c.seq_len(sid) == n
+        for sid in list(live):
+            c.release(sid)
+        assert c.free_blocks() == total
+        c.check()
+
+    def test_block_table_shapes_and_padding(self):
+        c = _cache(num_layers=1)
+        rng = np.random.RandomState(2)
+        c.register("a")
+        c.append("a", _kv(rng, 6, layers=1), _kv(rng, 6, layers=1))
+        c.register("b")
+        c.append("b", _kv(rng, 1, layers=1), _kv(rng, 1, layers=1))
+        table, lens = c.block_table(["a", "b", "__pad__"])
+        assert table.shape == (3, 2) and list(lens) == [6, 1, 0]
+        assert table[0, 0] >= 0 and table[0, 1] >= 0
+        assert table[1, 1] == -1          # b only owns one block
+        assert list(table[2]) == [-1, -1]  # pad row owns nothing
+
+
+# -- quantized storage --------------------------------------------------------
+
+class TestQuantizedKV:
+    @pytest.mark.parametrize("dtype,tol", [("bf16", 2e-2), ("int8", 6e-2)])
+    def test_quantized_vs_f32_divergence_bounded(self, dtype, tol):
+        rng = np.random.RandomState(3)
+        k = _kv(rng, 11)
+        v = _kv(rng, 11)
+        exact = _cache(dtype="f32")
+        quant = _cache(dtype=dtype)
+        for c in (exact, quant):
+            c.register("s")
+            c.append("s", k, v)
+        for layer in range(2):
+            ke, ve = exact.gather("s", layer)
+            kq, vq = quant.gather("s", layer)
+            scale = max(np.abs(ke).max(), np.abs(ve).max())
+            assert np.abs(ke - kq).max() / scale < tol
+            assert np.abs(ve - vq).max() / scale < tol
+
+    def test_int8_requantize_on_amax_growth(self):
+        """A later row with much larger amax forces an in-place block
+        requantize; earlier rows must stay within int8 resolution of
+        the NEW scale, not collapse to garbage."""
+        c = _cache(dtype="int8", num_layers=1)
+        c.register("s")
+        small = np.full((1, 1, 2, 4), 0.01, np.float32)
+        big = np.full((1, 1, 2, 4), 10.0, np.float32)
+        c.append("s", small, small)
+        c.append("s", big, big)
+        k, _ = c.gather("s", 0)
+        # new scale = 10/127 => resolution ~0.079; 0.01 rounds to 0
+        assert abs(k[1, 0, 0] - 10.0) < 0.1
+        assert abs(k[0, 0, 0]) <= 10.0 / 127 + 1e-6
+
+    def test_arena_bytes_ordering(self):
+        f32 = KVCacheConfig(dtype="f32").arena_bytes()
+        bf16 = KVCacheConfig(dtype="bf16").arena_bytes()
+        i8 = KVCacheConfig(dtype="int8").arena_bytes()
+        assert f32 > bf16 > i8
+
+
+# -- paged attention kernel ---------------------------------------------------
+
+class TestPagedAttention:
+    def _setup(self, dtype="f32"):
+        rng = np.random.RandomState(7)
+        c = _cache(num_blocks=16, block_tokens=8, num_layers=1,
+                   num_heads=2, head_dim=8, dtype=dtype)
+        lens = [13, 1, 20]
+        for i, n in enumerate(lens):
+            c.register("s%d" % i)
+            c.append("s%d" % i, _kv(rng, n, layers=1, dim=8),
+                     _kv(rng, n, layers=1, dim=8))
+        q = rng.randn(3, 2, 8).astype(np.float32)
+        table, ln = c.block_table(["s0", "s1", "s2"])
+        return c, q, table, ln
+
+    def test_dense_matches_bruteforce(self):
+        c, q, table, lens = self._setup()
+        k_ar, v_ar, ks, vs = c.views(0)
+        out = paged_attention_reference(q, k_ar, v_ar, table, lens,
+                                        block_tokens=8)
+        for i in range(3):
+            k, v = c.gather("s%d" % i, 0)
+            s = np.einsum("hd,thd->ht", q[i], k) / np.sqrt(8.0)
+            p = np.exp(s - s.max(axis=1, keepdims=True))
+            p /= p.sum(axis=1, keepdims=True)
+            want = np.einsum("ht,thd->hd", p, v)
+            np.testing.assert_allclose(out[i], want, rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_pallas_interpret_parity(self):
+        c, q, table, lens = self._setup()
+        k_ar, v_ar, _, _ = c.views(0)
+        dense = paged_decode_attention(q, k_ar, v_ar, table, lens,
+                                       block_tokens=8, backend="dense")
+        pallas = paged_decode_attention(q, k_ar, v_ar, table, lens,
+                                        block_tokens=8,
+                                        backend="pallas_interpret")
+        np.testing.assert_allclose(pallas, dense, rtol=2e-5, atol=2e-5)
+
+    def test_quantized_arena_attention(self):
+        c, q, table, lens = self._setup(dtype="int8")
+        k_ar, v_ar, ks, vs = c.views(0)
+        out = paged_decode_attention(q, k_ar, v_ar, table, lens,
+                                     block_tokens=8, k_scales=ks,
+                                     v_scales=vs, backend="dense")
+        cf, qf, tf, lf = self._setup(dtype="f32")
+        kf, vf, _, _ = cf.views(0)
+        exact = paged_decode_attention(qf, kf, vf, tf, lf,
+                                       block_tokens=8, backend="dense")
+        assert np.abs(out - exact).max() < 0.2
+
+
+# -- deterministic regeneration (the failover contract) -----------------------
+
+class TestDeterministicRegeneration:
+    def _gen(self, prompt, n, chunks):
+        c = _cache(num_blocks=32, block_tokens=4, num_layers=2,
+                   num_heads=2, head_dim=8)
+        m = TinyDecodeLM(c, eos_token=None)
+        c.register("s")
+        h = None
+        i = 0
+        for size in chunks:
+            h = m.prefill_chunk("s", prompt[i:i + size])
+            i += size
+        logits = m.logits1(h, len(prompt))
+        tok = int(np.argmax(logits))
+        out = [tok]
+        for _ in range(n - 1):
+            _, nxt = m.decode_step(["s"], [tok])
+            tok = int(nxt[0])
+            out.append(tok)
+        return out
+
+    def test_chunking_invariance(self):
+        prompt = list(range(1, 12))
+        a = self._gen(prompt, 8, [11])
+        b = self._gen(prompt, 8, [3, 5, 2, 1])
+        d = self._gen(prompt, 8, [4, 7])
+        assert a == b == d
+        assert len(set(a)) > 2  # not a degenerate constant stream
+
+
+# -- decode engine ------------------------------------------------------------
+
+def _engine(**kw):
+    kw.setdefault("kv_blocks", 64)
+    kw.setdefault("eos_token", None)
+    return DecodeEngine(DecodeConfig(**kw)).start()
+
+
+class TestDecodeEngine:
+    def test_stream_events_and_metrics(self):
+        e = _engine()
+        try:
+            evs = list(e.submit([1, 2, 3], max_tokens=6))
+            toks = [x for x in evs if x["type"] == "token"]
+            assert [t["index"] for t in toks] == list(range(6))
+            assert evs[-1] == {"type": "finish", "reason": "max_tokens",
+                               "tokens": 6, "preemptions": 0}
+            st = e.stats()
+            assert st[sm.STREAMS] == 1
+            assert st[sm.TOKENS] == 6
+            assert st[sm.TTFT_MS]["count"] == 1
+            assert st[sm.ITL_MS]["count"] == 5
+        finally:
+            e.stop()
+
+    def test_dedup_replay_and_resume_from(self):
+        e = _engine()
+        try:
+            first = list(e.submit([4, 5], max_tokens=5,
+                                  request_id="rid1"))
+            again = list(e.submit([4, 5], max_tokens=5,
+                                  request_id="rid1"))
+            assert again == first
+            tail = list(e.submit([4, 5], max_tokens=5,
+                                 request_id="rid1", resume_from=3))
+            toks = [x for x in tail if x["type"] == "token"]
+            assert [t["index"] for t in toks] == [3, 4]
+            want = [x for x in first if x["type"] == "token"][3:]
+            assert toks == want
+        finally:
+            e.stop()
+
+    def test_mixed_length_concurrent_streams(self):
+        e = _engine(max_batch_size=4)
+        try:
+            lens = [3, 9, 1, 6, 12, 2]
+            streams = [e.submit([i + 1, i + 2], max_tokens=n,
+                                request_id="m%d" % i)
+                       for i, n in enumerate(lens)]
+            outs = [list(s) for s in streams]
+            for n, evs in zip(lens, outs):
+                toks = [x for x in evs if x["type"] == "token"]
+                assert [t["index"] for t in toks] == list(range(n))
+                assert evs[-1]["reason"] == "max_tokens"
+            # streams batched together decode the same values they
+            # would alone (the whole point of the per-row model)
+            solo = _engine(max_batch_size=1)
+            try:
+                alone = list(solo.submit([2, 3], max_tokens=9,
+                                         request_id="m1"))
+                assert [x for x in alone if x["type"] == "token"] == \
+                    [x for x in outs[1] if x["type"] == "token"]
+            finally:
+                solo.stop()
+        finally:
+            e.stop()
+
+    def test_deadline_finish_event(self):
+        # a long prompt in tiny chunks: the deadline lands mid-prefill
+        e = _engine(kv_blocks=128, prefill_chunk_tokens=4,
+                    max_prompt_tokens=512)
+        try:
+            evs = list(e.submit([1] * 300, max_tokens=500,
+                                deadline_s=0.05))
+            assert evs[-1]["type"] == "finish"
+            assert evs[-1]["reason"] == "deadline_expired"
+            with pytest.raises(DeadlineExpired):
+                e.submit([1] * 300, max_tokens=500,
+                         deadline_s=0.05).result()
+        finally:
+            e.stop()
+
+    def test_preemption_low_evicted_first_zero_leaks(self):
+        # arena of 5 blocks * 4 tokens: two 12-token streams cannot
+        # coexist; the LOW one must be evicted (re-prefilled later)
+        e = _engine(kv_blocks=5, kv_block_tokens=4, num_layers=1,
+                    max_batch_size=2, prefill_chunk_tokens=4)
+        try:
+            lo = e.submit([1, 2], max_tokens=14, cost_class="low",
+                          request_id="lo")
+            hi = e.submit([3, 4], max_tokens=14, cost_class="high",
+                          request_id="hi")
+            lo_evs, hi_evs = list(lo), list(hi)
+            for evs in (lo_evs, hi_evs):
+                assert evs[-1]["reason"] == "max_tokens"
+                assert len([x for x in evs
+                            if x["type"] == "token"]) == 14
+            st = e.stats()
+            assert st.get(sm.PREEMPTIONS, 0) >= 1
+            from paddle_tpu.observability import flight
+            ev = [f for _, kind, f in flight.events()
+                  if kind == "serving.kv_preempt"]
+            assert ev and ev[0]["priority"] == 2  # low shed first
+            assert e.health_doc()["kv_occupancy"] == 0.0
+        finally:
+            e.stop()
+
+    def test_overload_and_health_doc(self):
+        e = _engine(max_waiting=1, max_batch_size=1,
+                    prefill_chunk_tokens=2)
+        try:
+            doc = e.health_doc()
+            assert doc["engine_kind"] == "decode"
+            assert set(doc) >= {"status", "kv_occupancy", "kv_blocks",
+                                "kv_dtype", "active_streams"}
+            streams = []
+            with pytest.raises(ServerOverloaded):
+                for i in range(50):
+                    streams.append(e.submit([1] * 30, max_tokens=50,
+                                            request_id="ov%d" % i))
+            for s in streams:
+                s.cancel()
+        finally:
+            e.stop(drain=False)
+
+
+# -- fleet cost-unit admission ------------------------------------------------
+
+class TestFleetCostAdmission:
+    def test_stream_units_pricing(self):
+        cfg = FleetConfig(cost_unit_tokens=16, default_stream_tokens=16)
+        assert cfg.stream_units(None) == 1
+        assert cfg.stream_units(1) == 1
+        assert cfg.stream_units(16) == 1
+        assert cfg.stream_units(17) == 2
+        assert cfg.stream_units(512) == 32
+
+    def test_long_low_sheds_before_short_high(self):
+        """The satellite contract: with cost-priced admission a LONG
+        low-priority stream trips its watermark while a SHORT
+        high-priority one still admits — at the very same queue
+        state."""
+        r = FleetRouter(["127.0.0.1:1"], FleetConfig(
+            max_queue=32, cost_unit_tokens=16,
+            num_dispatchers=1, health_interval_ms=10_000)).start()
+        try:
+            # low watermark = 16 units; 512 tokens = 32 units
+            with pytest.raises(RequestShed):
+                r.generate([1, 2], max_tokens=512, cost_class="low")
+            # same length stream in the TOP lane: the hard bound (32)
+            # still holds it, but a short low stream AND a long high
+            # stream both admit
+            short_low = r.generate([1, 2], max_tokens=16,
+                                   cost_class="low")
+            long_high = r.generate([1, 2], max_tokens=496,
+                                   cost_class="high")
+            assert r.stats()["queue_units"] == 32
+            # the long high stream's 31 held units now push ONE-unit
+            # low traffic over its watermark: expensive work pressures
+            # cheap lanes, not the reverse
+            with pytest.raises(RequestShed):
+                r.submit({"x": np.zeros((1, 1))}, cost_class="low")
+            assert obs.counter_value(sm.SHED, **{"class": "low"}) >= 1
+            short_low.close()
+            long_high.close()
+            assert r.stats()["queue_units"] == 0
+        finally:
+            r.stop()
+
+    def test_oneshot_admission_unchanged(self):
+        """Every one-shot request is exactly one unit: the pre-decode
+        watermark behavior is bit-compatible."""
+        cfg = FleetConfig(max_queue=4, num_dispatchers=1,
+                          health_interval_ms=10_000)
+        r = FleetRouter(["127.0.0.1:1"], cfg).start()
+        try:
+            for i in range(2):  # low watermark = round(0.5*4) = 2
+                r.submit({"x": [1.0]}, cost_class="low",
+                         deadline_ms=60_000)
+            with pytest.raises(RequestShed):
+                r.submit({"x": [1.0]}, cost_class="low",
+                         deadline_ms=60_000)
+        finally:
+            r.stop()
+
+
+# -- fleet stream failover ----------------------------------------------------
+
+class _Fleet:
+    def __init__(self, n=2, **cfg_kw):
+        self.engines, self.servers, eps = [], [], []
+        for _ in range(n):
+            eng = DecodeEngine(DecodeConfig(
+                kv_blocks=256, max_tokens_cap=1024,
+                eos_token=None)).start()
+            srv, _t = start_http_server(eng, port=0)
+            self.engines.append(eng)
+            self.servers.append(srv)
+            eps.append("127.0.0.1:%d" % srv.server_address[1])
+        cfg_kw.setdefault("health_interval_ms", 50)
+        cfg_kw.setdefault("request_timeout_s", 60)
+        cfg_kw.setdefault("stream_stall_s", 1.0)
+        self.router = FleetRouter(eps, FleetConfig(**cfg_kw)).start()
+        deadline = time.monotonic() + 5
+        while (self.router.healthy_count() < n
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+
+    def kill_active(self):
+        for j, eng in enumerate(self.engines):
+            if eng.health_doc()["active_streams"] > 0:
+                self.servers[j].shutdown()
+                eng.stop(drain=False)
+                return j
+        return None
+
+    def close(self):
+        self.router.stop()
+        for s, e in zip(self.servers, self.engines):
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+            try:
+                e.stop(drain=False)
+            except Exception:
+                pass
+
+
+class TestFleetStreaming:
+    def test_probe_learns_engine_kind(self):
+        f = _Fleet(n=1)
+        try:
+            deadline = time.monotonic() + 3
+            while (f.router.replicas[0].kind != "decode"
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            snap = f.router.replicas[0].snapshot()
+            assert snap["kind"] == "decode"
+            assert snap["kv_occupancy"] is not None
+        finally:
+            f.close()
+
+    def test_exactly_once_failover_bit_identical(self):
+        """Kill the replica mid-stream: the router resumes on the
+        survivor with zero lost, zero duplicated, zero diverged
+        tokens — the failover contract the chaos drill asserts across
+        processes."""
+        f = _Fleet(n=2)
+        try:
+            n = 300
+            got, fin = [], None
+            killed = None
+            for ev in f.router.generate([5, 6, 7], max_tokens=n,
+                                        request_id="f1"):
+                if ev["type"] == "token":
+                    got.append(ev)
+                    if len(got) == 5 and killed is None:
+                        killed = f.kill_active()
+                else:
+                    fin = ev
+            assert killed is not None
+            assert [t["index"] for t in got] == list(range(n))
+            assert fin["reason"] == "max_tokens"
+            # same prompt on the survivor reproduces the stream
+            # bit-for-bit: the spliced failover stream is the TRUE one
+            redo = list(f.router.generate([5, 6, 7], max_tokens=n,
+                                          request_id="f2"))
+            assert [t["token"] for t in got] == \
+                [x["token"] for x in redo if x["type"] == "token"]
+            st = f.router.stats()
+            assert st.get(sm.STREAM_RESUMES, 0) >= 1
+        finally:
+            f.close()
+
+    def test_http_front_streams_via_fleet(self):
+        """HTTP front mounted ON the router: /generate proxies the
+        fleet's token-level stream, /healthz carries queue state."""
+        f = _Fleet(n=1)
+        front, _t = start_http_server(f.router, port=0)
+        base = "http://127.0.0.1:%d" % front.server_address[1]
+        try:
+            body = json.dumps({"prompt": [9, 8], "max_tokens": 4}
+                              ).encode()
+            req = urllib.request.Request(base + "/generate", data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                evs = [json.loads(ln) for ln in resp if ln.strip()]
+            toks = [e for e in evs if e["type"] == "token"]
+            assert [t["index"] for t in toks] == [0, 1, 2, 3]
+            assert evs[-1]["reason"] == "max_tokens"
+        finally:
+            front.shutdown()
+            f.close()

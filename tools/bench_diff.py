@@ -243,6 +243,36 @@ def _self_test():
     assert {"serving_queue_ms_p99", "jit_traces"} <= sbad, sbad
     scbad = [r for r in diff_counters(s0, s2, 0.25) if r[-1]]
     assert scbad and scbad[0][0] == "serving.errors", scbad
+    # decode records (--decode smoke): a TTFT/ITL blowup past
+    # threshold+floor must flag, as must the continuous-vs-static
+    # speedup evaporating or stream errors growing from zero;
+    # sub-floor SLO jitter and arena-pressure preemption noise must not
+    dk0 = {"configs": {"decode_smoke": {
+        "tokens_per_s": 900.0, "static_tokens_per_s": 500.0,
+        "decode_speedup_vs_static": 1.8, "ttft_p50_ms": 20.0,
+        "ttft_p99_ms": 60.0, "itl_p50_ms": 4.0, "itl_p99_ms": 12.0,
+        "kv_occupancy_frac": 0.5, "preemptions": 1}},
+        "counters_total": {"serving.stream_errors": 0}}
+    dk1 = {"configs": {"decode_smoke": {
+        "tokens_per_s": 880.0, "static_tokens_per_s": 500.0,
+        "decode_speedup_vs_static": 1.7, "ttft_p50_ms": 26.0,
+        "ttft_p99_ms": 75.0, "itl_p50_ms": 5.5, "itl_p99_ms": 17.0,
+        "kv_occupancy_frac": 0.45, "preemptions": 3}},
+        "counters_total": {"serving.stream_errors": 0}}
+    assert not any(r[-1] for r in diff_records(dk0, dk1, 0.5)), \
+        list(diff_records(dk0, dk1, 0.5))
+    dk2 = {"configs": {"decode_smoke": {
+        "tokens_per_s": 300.0, "static_tokens_per_s": 500.0,
+        "decode_speedup_vs_static": 0.6, "ttft_p50_ms": 200.0,
+        "ttft_p99_ms": 600.0, "itl_p50_ms": 40.0, "itl_p99_ms": 120.0,
+        "kv_occupancy_frac": 0.5, "preemptions": 40}},
+        "counters_total": {"serving.stream_errors": 2}}
+    dkbad = {r[1] for r in diff_records(dk0, dk2, 0.5) if r[-1]}
+    assert {"decode_speedup_vs_static", "ttft_p99_ms",
+            "itl_p99_ms", "preemptions"} <= dkbad, dkbad
+    assert "tokens_per_s" not in dkbad, dkbad  # load-bound, unwatched
+    dkcbad = [r for r in diff_counters(dk0, dk2, 0.25) if r[-1]]
+    assert dkcbad and dkcbad[0][0] == "serving.stream_errors", dkcbad
     # ps_scale records: a digest-cost regression past threshold+floor
     # (incremental digesting broken back toward full re-hash) must
     # flag; sub-floor hashing jitter must not; a delta-bytes blowup

@@ -15,6 +15,14 @@ Usage:
         # ({"configs": {"serving_smoke": ...}, "counters_total": ...})
         # so ci/check.sh can diff serving perf run-over-run exactly
         # like the training smokes (gate 5c)
+    python tools/serving_bench.py --decode --out r.json
+        # continuous-batching decode smoke: mixed-length streams
+        # through the DecodeEngine vs a static wait-for-all baseline
+        # on the SAME model; asserts per-token scheduling wins on
+        # tokens/s and every stream is exactly-once; the record
+        # carries the decode SLO axes (ttft/itl percentiles,
+        # tokens_per_s, kv_occupancy_frac, preemptions) gate 5c
+        # watches run-over-run
 
 The bench is CLOSED-LOOP: each of C client threads fires its next
 request only after the previous one completes — the concurrency level,
@@ -302,16 +310,164 @@ def smoke(out_path=None):
     return 0
 
 
+def _static_waitforall(streams_spec, wave_size, model_kw):
+    """The baseline continuous batching replaces: admit streams in
+    fixed waves; every wave member decodes EVERY step until the
+    longest member finishes (finished members keep burning compute and
+    KV rows — the dead work per-token scheduling eliminates). Returns
+    wall seconds for the whole stream set."""
+    from paddle_tpu.serving.decode import (KVCacheConfig, PagedKVCache,
+                                           TinyDecodeLM)
+    t0 = time.perf_counter()
+    for start in range(0, len(streams_spec), wave_size):
+        wave = streams_spec[start:start + wave_size]
+        cache = PagedKVCache(KVCacheConfig(**model_kw))
+        model = TinyDecodeLM(cache, eos_token=None)
+        ids, last = [], []
+        for i, (prompt, _n) in enumerate(wave):
+            sid = "w%d" % i
+            cache.register(sid)
+            h = model.prefill_chunk(sid, prompt)
+            last.append(int(np.argmax(model.logits1(h, len(prompt)))))
+            ids.append(sid)
+        for _ in range(max(n for _, n in wave) - 1):
+            _, nxt = model.decode_step(ids, last, pad_to=wave_size)
+            last = [int(t) for t in nxt]
+    return time.perf_counter() - t0
+
+
+def decode_smoke(out_path=None):
+    """CI decode gate: mixed-length streams through the continuous-
+    batching ``DecodeEngine`` must (a) each deliver exactly-once,
+    in-order token indices, (b) finish error-free, and (c) beat the
+    static wait-for-all baseline on tokens/s — measured on the same
+    tiny model in the same process, so the margin is pure scheduling.
+    With ``out_path`` also writes the bench_diff decode record."""
+    from paddle_tpu.serving import metrics as sm
+    from paddle_tpu.serving.decode import DecodeConfig, DecodeEngine
+
+    failures = []
+    obs.reset()
+    obs.enable()
+    wave = 8
+    model_kw = dict(num_blocks=64, block_tokens=16, num_layers=2,
+                    num_heads=2, head_dim=8)
+    lens = (4, 8, 16, 24, 32, 48)
+    rng = np.random.RandomState(0xDECD)
+    streams_spec = [
+        ([int(t) for t in rng.randint(1, 90, size=2 + i % 5)],
+         lens[i % len(lens)])
+        for i in range(24)]
+    total_tokens = sum(n for _, n in streams_spec)
+
+    static_wall = _static_waitforall(streams_spec, wave, model_kw)
+    static_tps = total_tokens / static_wall
+
+    engine = DecodeEngine(DecodeConfig(
+        kv_blocks=model_kw["num_blocks"],
+        kv_block_tokens=model_kw["block_tokens"],
+        num_layers=model_kw["num_layers"],
+        num_heads=model_kw["num_heads"],
+        head_dim=model_kw["head_dim"],
+        max_batch_size=wave, max_waiting=64,
+        eos_token=None)).start()
+    occ_peak = [0.0]
+    stop_evt = threading.Event()
+
+    def poll_occupancy():
+        while not stop_evt.is_set():
+            occ_peak[0] = max(occ_peak[0],
+                              engine.health_doc()["kv_occupancy"])
+            time.sleep(0.002)
+
+    poller = threading.Thread(target=poll_occupancy, daemon=True)
+    poller.start()
+    t0 = time.perf_counter()
+    streams = [engine.submit(p, max_tokens=n, request_id="d%d" % i)
+               for i, (p, n) in enumerate(streams_spec)]
+    outs = [list(s) for s in streams]
+    wall = time.perf_counter() - t0
+    stop_evt.set()
+    poller.join()
+
+    for i, ((_p, n), evs) in enumerate(zip(streams_spec, outs)):
+        toks = [e for e in evs if e["type"] == "token"]
+        if [t["index"] for t in toks] != list(range(n)):
+            failures.append(
+                "stream %d: want indices 0..%d exactly once, got %s"
+                % (i, n - 1, [t["index"] for t in toks][:8]))
+        if evs[-1].get("reason") != "max_tokens":
+            failures.append("stream %d finished %r, want max_tokens"
+                            % (i, evs[-1].get("reason")))
+    errs = obs.counter_value(sm.STREAM_ERRORS)
+    if errs:
+        failures.append("serving.stream_errors = %d" % errs)
+    tps = total_tokens / wall
+    if tps <= static_tps:
+        failures.append(
+            "continuous batching (%.0f tok/s) did not beat static "
+            "wait-for-all (%.0f tok/s) — per-token scheduling is not "
+            "reclaiming the dead work" % (tps, static_tps))
+    occupancy_peak = occ_peak[0] or engine.health_doc()["kv_occupancy"]
+    engine.stop()
+
+    ttft = obs.histogram(sm.TTFT_MS).snapshot()
+    itl = obs.histogram(sm.ITL_MS).snapshot()
+    rec = {
+        "tokens_per_s": round(tps, 1),
+        "static_tokens_per_s": round(static_tps, 1),
+        "decode_speedup_vs_static": round(tps / static_tps, 3),
+        "ttft_p50_ms": round(ttft.get("p50") or 0.0, 2),
+        "ttft_p99_ms": round(ttft.get("p99") or 0.0, 2),
+        "itl_p50_ms": round(itl.get("p50") or 0.0, 3),
+        "itl_p99_ms": round(itl.get("p99") or 0.0, 3),
+        "kv_occupancy_frac": round(float(occupancy_peak), 4),
+        "preemptions": obs.counter_value(sm.PREEMPTIONS),
+        "streams": len(streams_spec),
+        "total_tokens": total_tokens,
+    }
+    counters = {}
+    for name in (sm.STREAMS, sm.TOKENS, sm.PREFILL_TOKENS,
+                 sm.DECODE_STEPS, sm.PREEMPTIONS, sm.STREAM_RESUMES,
+                 sm.STREAM_ERRORS, sm.DEADLINE_EXPIRED):
+        counters[name] = obs.counter_value(name)
+    record = {"configs": {"decode_smoke": rec},
+              "counters_total": counters}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2)
+        print("wrote decode perf record: %s" % out_path)
+    if failures:
+        print("DECODE SMOKE FAILED:")
+        for f in failures:
+            print("  - %s" % f)
+        return 1
+    print("decode smoke OK: %d mixed-length streams, %d tokens, "
+          "%.0f tok/s continuous vs %.0f tok/s static (%.2fx), "
+          "ttft_p50=%.1fms itl_p50=%.2fms, kv occupancy peak %.0f%%, "
+          "%d preemption(s)"
+          % (len(streams_spec), total_tokens, tps, static_tps,
+             tps / static_tps, ttft.get("p50") or 0.0,
+             itl.get("p50") or 0.0, 100 * rec["kv_occupancy_frac"],
+             rec["preemptions"]))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
                     help="fast CI assertions instead of the bench")
+    ap.add_argument("--decode", action="store_true",
+                    help="continuous-batching decode smoke (vs static "
+                         "wait-for-all baseline)")
     ap.add_argument("--requests", type=int, default=256)
     ap.add_argument("--json", dest="json_path", default=None)
     ap.add_argument("--out", dest="out_path", default=None,
-                    help="(with --smoke) write a bench_diff-compatible"
-                         " serving record here for the CI perf gate")
+                    help="(with --smoke/--decode) write a bench_diff-"
+                         "compatible record here for the CI perf gate")
     args = ap.parse_args(argv)
+    if args.decode:
+        return decode_smoke(out_path=args.out_path)
     if args.smoke:
         return smoke(out_path=args.out_path)
     bench(n_requests=args.requests, json_path=args.json_path)

@@ -39,8 +39,28 @@ MERGED job telemetry — metrics.json + trace.json — not from logs):
 - per-replica ``serving.request`` spans from BOTH replicas join ONE
   job trace in the merged trace.json.
 
+The ``--decode`` scenario (ISSUE 17) runs the same supervised-job
+shape against STREAMING replicas (``tests/dist_worker_decode.py``:
+``DecodeEngine`` + chunked ``/generate``): replica 0 SIGKILLs itself
+mid-stream after emitting a fixed number of decode tokens, and the
+driver's ``FleetRouter.generate()`` streams must fail over with
+token-level ``(request_id, token_index)`` resume:
+
+- **zero lost accepted streams**: every admitted stream finishes with
+  ``max_tokens`` tokens;
+- **zero duplicated token indices**: each stream's delivered indices
+  are exactly ``0..n-1``, once each — the resume dedup holds;
+- **exactly-once BY VALUE**: every delivered token equals the local
+  reference engine's regeneration (replicas are deterministic, so a
+  resumed suffix that re-prefilled wrongly cannot hide);
+- the kill -> eject -> relaunch -> rejoin chain reads from merged
+  telemetry, ``serving.stream_resumes >= 1`` and
+  ``serving.stream_errors == 0`` in merged counters, and the
+  relaunched replica serves STREAMS again.
+
 Usage:
     python tools/serving_chaos.py --smoke      # the CI gate-8 drill
+    python tools/serving_chaos.py --decode --smoke  # streaming drill
     python tools/serving_chaos.py [--requests N] [--burst N] ...
 """
 from __future__ import annotations
@@ -57,6 +77,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "dist_worker_serving.py")
+DECODE_WORKER = os.path.join(REPO, "tests", "dist_worker_decode.py")
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 _TOOLS = os.path.dirname(os.path.abspath(__file__))
@@ -314,6 +335,218 @@ def _drive(router, ref_predictor, np, serving, obs, reservoir_quantile,
 
 
 # ---------------------------------------------------------------------------
+# decode driver mode: streaming traffic inside the launch job
+# ---------------------------------------------------------------------------
+
+def _decode_specs(n_streams, victim_tokens):
+    """Deterministic stream workload: one long 'victim' stream that is
+    guaranteed to span the replica kill, plus mixed-length peers."""
+    import numpy as np
+
+    rng = np.random.RandomState(0xFA110)
+    specs = []
+    for i in range(n_streams):
+        prompt = [int(t) for t in rng.randint(1, 90, size=3 + i % 4)]
+        n = victim_tokens if i == 0 else (24 + 8 * (i % 5))
+        specs.append((prompt, n))
+    return specs
+
+
+def decode_driver() -> int:
+    """Streaming chaos driver: run mixed-length decode streams through
+    ``FleetRouter.generate()`` while replica 0 SIGKILLs itself
+    mid-stream; verify exactly-once token delivery BY VALUE against a
+    local reference engine, then prove the relaunched replica streams
+    again. Verdict goes to $SERVING_CHAOS_OUT; exits 0 (the outer
+    process asserts — see ``driver()``)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from dist_worker_decode import build_engine
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.serving import metrics as sm
+
+    out_path = os.environ["SERVING_CHAOS_OUT"]
+    endpoints = [e for e in os.environ["PADDLE_SERVING_ENDPOINTS"]
+                 .split(",") if e]
+    die_endpoint = endpoints[int(os.environ.get("SERVING_DIE_REPLICA",
+                                                "0") or 0)]
+    n_streams = int(os.environ.get("SC_DECODE_STREAMS", "8"))
+    victim_tokens = int(os.environ.get("SC_DECODE_VICTIM_TOKENS", "240"))
+    failures = []
+    result = {"failures": failures, "accepted": 0, "completed": 0,
+              "duplicate_indices": 0, "resumes": 0, "rejoined": False}
+
+    def fail(msg):
+        print("[decode driver] FAIL: %s" % msg, flush=True)
+        failures.append(msg)
+
+    specs = _decode_specs(n_streams, victim_tokens)
+
+    # local reference regeneration: the replicas serve the identical
+    # deterministic function, so every delivered token — including the
+    # failed-over suffix re-prefixed on the OTHER replica — must equal
+    # this run value-for-value
+    ref = build_engine().start()
+    expected = []
+    try:
+        for i, (prompt, n) in enumerate(specs):
+            evs = list(ref.submit(prompt, max_tokens=n,
+                                  request_id="ref%d" % i))
+            expected.append([e["token"] for e in evs
+                             if e["type"] == "token"])
+    finally:
+        ref.stop()
+
+    router = serving.FleetRouter(
+        endpoints,
+        serving.FleetConfig(
+            max_queue=128, num_dispatchers=4,
+            health_interval_ms=100.0, eject_after=3,
+            max_attempts=8, request_timeout_s=300.0,
+            stream_stall_s=2.0)).start()
+    try:
+        rc = _drive_decode(router, serving, obs, sm, endpoints,
+                           die_endpoint, specs, expected, result, fail)
+    finally:
+        router.stop()
+        with open(out_path + ".tmp", "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(out_path + ".tmp", out_path)
+        print("[decode driver] wrote %s (%d failure(s))"
+              % (out_path, len(failures)), flush=True)
+    return rc
+
+
+def _drive_decode(router, serving, obs, sm, endpoints, die_endpoint,
+                  specs, expected, result, fail) -> int:
+    t0 = time.monotonic()
+    while router.healthy_count() < len(endpoints):
+        if time.monotonic() - t0 > 120:
+            fail("fleet never became healthy (%d/%d)"
+                 % (router.healthy_count(), len(endpoints)))
+            return 0
+        time.sleep(0.25)
+    print("[decode driver] fleet healthy (%d replicas) after %.1fs"
+          % (len(endpoints), time.monotonic() - t0), flush=True)
+
+    # -- phase 1: concurrent streams; replica 0 dies mid-stream -------
+    lock = threading.Lock()
+    per_stream = [None] * len(specs)
+
+    def consume(i, prompt, n):
+        events = []
+        try:
+            for ev in router.generate(prompt, max_tokens=n,
+                                      request_id="chaos-s%d" % i,
+                                      cost_class="high",
+                                      deadline_s=240.0):
+                events.append(ev)
+        except Exception as e:  # noqa: BLE001 — any escape is a loss
+            with lock:
+                fail("stream %d raised %r (streams must end with an "
+                     "in-band finish event)" % (i, e))
+        per_stream[i] = events
+
+    threads = [threading.Thread(target=consume, args=(i, p, n))
+               for i, (p, n) in enumerate(specs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    result["accepted"] = len(specs)
+
+    dup_total = 0
+    for i, ((_p, n), events) in enumerate(zip(specs, per_stream)):
+        events = events or []
+        toks = [e for e in events if e["type"] == "token"]
+        fin = [e for e in events if e["type"] == "finish"]
+        idxs = [t["index"] for t in toks]
+        dups = len(idxs) - len(set(idxs))
+        dup_total += dups
+        if dups:
+            fail("stream %d delivered %d DUPLICATE token index(es)"
+                 % (i, dups))
+        if not (fin and fin[-1].get("reason") == "max_tokens"):
+            fail("stream %d lost: finished %r, want max_tokens"
+                 % (i, fin[-1].get("reason") if fin else None))
+            continue
+        if idxs != list(range(n)):
+            fail("stream %d indices not exactly-once 0..%d (got %d "
+                 "tokens, head=%s)" % (i, n - 1, len(idxs), idxs[:6]))
+            continue
+        got = [t["token"] for t in toks]
+        if got != expected[i]:
+            div = next(k for k in range(n) if got[k] != expected[i][k])
+            fail("stream %d DIVERGED from reference at token %d "
+                 "(resume re-prefill broke determinism)" % (i, div))
+            continue
+        result["completed"] += 1
+    result["duplicate_indices"] = dup_total
+    result["resumes"] = obs.counter_value(sm.STREAM_RESUMES)
+    result["stream_errors"] = obs.counter_value(sm.STREAM_ERRORS)
+    if result["completed"] != result["accepted"]:
+        fail("lost streams: completed=%d != accepted=%d"
+             % (result["completed"], result["accepted"]))
+    if result["resumes"] < 1:
+        fail("serving.stream_resumes=%d — the mid-stream kill must "
+             "force at least one token-level resume"
+             % result["resumes"])
+    if result["stream_errors"]:
+        fail("serving.stream_errors=%d (want 0)"
+             % result["stream_errors"])
+    print("[decode driver] phase1: %d/%d streams exactly-once "
+          "(resumes=%d)" % (result["completed"], result["accepted"],
+                            result["resumes"]), flush=True)
+
+    # -- the relaunched replica must STREAM again ---------------------
+    def rep_state(ep):
+        for r in router.stats()["replicas"]:
+            if r["endpoint"] == ep:
+                return r
+        return None
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 90:
+        r = rep_state(die_endpoint)
+        if r and r["state"] == "serving" and r["ejections"] >= 1:
+            break
+        time.sleep(0.25)
+    r = rep_state(die_endpoint)
+    if not (r and r["ejections"] >= 1):
+        fail("killed replica %s was never ejected (state=%s)"
+             % (die_endpoint, r and r["state"]))
+    if not (r and r["state"] == "serving"):
+        fail("killed replica %s never rejoined (state=%s)"
+             % (die_endpoint, r and r["state"]))
+    else:
+        served0 = r["served"]
+        t0 = time.monotonic()
+        probe_i = 0
+        while time.monotonic() - t0 < 60:
+            evs = list(router.generate(
+                [1, 2, 3], max_tokens=4, cost_class="high",
+                request_id="rejoin-%d" % probe_i, deadline_s=30.0))
+            probe_i += 1
+            if not (evs and evs[-1].get("reason") == "max_tokens"):
+                fail("post-rejoin probe stream finished %r"
+                     % (evs and evs[-1].get("reason")))
+                break
+            r = rep_state(die_endpoint)
+            if r["served"] > served0:
+                result["rejoined"] = True
+                print("[decode driver] relaunched replica %s streaming "
+                      "again (served %d)" % (die_endpoint, r["served"]),
+                      flush=True)
+                break
+            time.sleep(0.05)
+        if not result["rejoined"]:
+            fail("relaunched replica %s never served a stream"
+                 % die_endpoint)
+    result["replicas"] = router.stats()["replicas"]
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # outer mode: orchestrate the supervised job + assert on telemetry
 # ---------------------------------------------------------------------------
 
@@ -368,6 +601,134 @@ def run_drill(args) -> int:
     ok = check_results(os.path.join(tmp, "driver.json"),
                        os.path.join(tmp, "metrics"), endpoints, args)
     return 0 if ok else 1
+
+
+def run_decode_drill(args) -> int:
+    tmp = tempfile.mkdtemp(prefix="serving_chaos_decode_")
+    endpoints = ["127.0.0.1:%d" % _free_port()
+                 for _ in range(args.replicas)]
+    env = _env(tmp, endpoints, args)
+    # streaming-path chaos: lighter RPC faults (every drop on the
+    # chunked stream already forces a full token-level resume), the
+    # kill armed on emitted decode tokens instead of dispatches
+    env.update({
+        "DECODE_DIE_AFTER_TOKENS": str(args.die_after_tokens),
+        "SC_DECODE_STREAMS": str(args.streams),
+        "SC_DECODE_VICTIM_TOKENS": str(args.victim_tokens),
+        "PADDLE_TPU_FAULTS": "send.drop:0.01,any.delay:0.05:5",
+    })
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node=1", "--max_restarts=3",
+           "--started_port=%d" % _free_port(),
+           "--serving_script=%s" % DECODE_WORKER,
+           "--serving_endpoints=%s" % ",".join(endpoints),
+           os.path.abspath(__file__), "--driver", "--decode"]
+    print("[chaos] decode drill: %d streaming replicas, kill replica 0 "
+          "after %d emitted tokens, faults=%s"
+          % (args.replicas, args.die_after_tokens,
+             env["PADDLE_TPU_FAULTS"]))
+    sup = subprocess.run(cmd, env=env, timeout=600, cwd=REPO)
+    if sup.returncode != 0:
+        print("[chaos] FAIL: job exited %d" % sup.returncode)
+        return 1
+    ok = check_decode_results(os.path.join(tmp, "driver.json"),
+                              os.path.join(tmp, "metrics"), endpoints)
+    return 0 if ok else 1
+
+
+def check_decode_results(driver_json, mdir, endpoints) -> bool:
+    """Outer gate for the streaming drill: driver verdict (exactly-once
+    by value) + the kill->resume causal chain from merged telemetry."""
+    import ft_timeline
+
+    ok = True
+
+    def chk(what, passed):
+        nonlocal ok
+        print("[chaos] %s: %s" % ("PASS" if passed else "FAIL", what))
+        ok = ok and passed
+
+    try:
+        res = json.load(open(driver_json))
+    except (OSError, ValueError) as e:
+        print("[chaos] FAIL: no driver verdict (%s)" % e)
+        return False
+    for f in res.get("failures", []):
+        chk("driver: %s" % f, False)
+    chk("zero lost accepted streams (%d/%d finished max_tokens)"
+        % (res.get("completed", 0), res.get("accepted", 0)),
+        res.get("accepted", 0) > 0
+        and res.get("completed") == res.get("accepted"))
+    chk("zero duplicated token indices",
+        res.get("duplicate_indices", -1) == 0)
+    chk("token-level resume fired (driver resumes=%d)"
+        % res.get("resumes", 0), res.get("resumes", 0) >= 1)
+    chk("relaunched replica streamed again", bool(res.get("rejoined")))
+
+    ft_timeline.print_postmortem(mdir, limit=30)
+    mpath = os.path.join(mdir, "metrics.json")
+    chk("job-level metrics.json merged", os.path.exists(mpath))
+    if not ok:
+        return False
+    merged = json.load(open(mpath))
+    totals = merged["counters_total"]
+    chk("serving.stream_resumes >= 1 in merged counters (%d)"
+        % totals.get("serving.stream_resumes", 0),
+        totals.get("serving.stream_resumes", 0) >= 1)
+    chk("serving.stream_errors == 0 in merged counters (%d)"
+        % totals.get("serving.stream_errors", 0),
+        totals.get("serving.stream_errors", 0) == 0)
+    eject = sum(v for k, v in totals.items()
+                if k.startswith("serving.replica_ejections"))
+    chk("serving.replica_ejections >= 1 (%d)" % eject, eject >= 1)
+
+    # causal chain: SIGKILL -> ejection -> token-level stream resume ->
+    # relaunch -> rejoin, all from the merged event timeline
+    events = ft_timeline.load_events(mdir)
+
+    def first(pred):
+        for e in events:
+            if pred(e):
+                return e
+        return None
+
+    die_ep = endpoints[0]
+    kill = first(lambda e: e["kind"] == "launch.exit"
+                 and e["fields"].get("role") == "serving"
+                 and e["fields"].get("signal") == 9)
+    chk("supervisor observed the replica SIGKILL", kill is not None)
+    if kill is None:
+        return False
+    t_kill = kill["t_us"]
+    eject_ev = first(lambda e: e["kind"] == "serving.replica_ejected"
+                     and e["fields"].get("endpoint") == die_ep
+                     and e["t_us"] > t_kill - 1e6)
+    resume_ev = first(lambda e: e["kind"] == "serving.stream_resume"
+                      and e["t_us"] > t_kill - 1e6)
+    relaunch = first(lambda e: e["kind"] == "launch.spawn"
+                     and e["fields"].get("role") == "serving"
+                     and e["fields"].get("restart", 0) >= 1
+                     and e["t_us"] > t_kill)
+    rejoin = first(lambda e: e["kind"] == "serving.replica_rejoined"
+                   and e["fields"].get("endpoint") == die_ep
+                   and relaunch is not None
+                   and e["t_us"] > relaunch["t_us"])
+    chk("fleet ejected the killed replica in the kill window",
+        eject_ev is not None)
+    chk("a stream resumed from a mid-stream token index after the "
+        "kill (from_index=%s)"
+        % (resume_ev and resume_ev["fields"].get("from_index")),
+        resume_ev is not None
+        and resume_ev["fields"].get("from_index", 0) > 0)
+    chk("supervisor relaunched the replica after the kill",
+        relaunch is not None)
+    chk("fleet re-admitted the replica after the relaunch",
+        rejoin is not None)
+    if ok and eject_ev and relaunch and rejoin:
+        chk("causal order: kill < relaunch < rejoin, ejection < rejoin",
+            t_kill < relaunch["t_us"] < rejoin["t_us"]
+            and eject_ev["t_us"] < rejoin["t_us"])
+    return ok
 
 
 def check_results(driver_json, mdir, endpoints, args) -> bool:
@@ -513,6 +874,10 @@ def main() -> int:
     ap = argparse.ArgumentParser("serving_chaos")
     ap.add_argument("--driver", action="store_true",
                     help="(internal) run as the in-job traffic driver")
+    ap.add_argument("--decode", action="store_true",
+                    help="streaming-decode scenario: SIGKILL a replica "
+                         "mid-stream, assert token-level exactly-once "
+                         "failover")
     ap.add_argument("--smoke", action="store_true",
                     help="CI-sized drill (the gate-8 configuration)")
     ap.add_argument("--replicas", type=int, default=2)
@@ -525,9 +890,19 @@ def main() -> int:
     ap.add_argument("--slo-p99-ms", type=float, default=3000.0,
                     help="drill budget for p99 serving.queue_ms")
     ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--streams", type=int, default=8,
+                    help="(--decode) concurrent streams in phase 1")
+    ap.add_argument("--victim-tokens", type=int, default=240,
+                    help="(--decode) length of the long stream the "
+                         "kill must land inside")
+    ap.add_argument("--die-after-tokens", type=int, default=60,
+                    help="(--decode) replica-0 emitted decode tokens "
+                         "before its self-SIGKILL")
     args = ap.parse_args()
     if args.driver:
-        return driver()
+        return decode_driver() if args.decode else driver()
+    if args.decode:
+        return run_decode_drill(args)
     if args.smoke:
         args.requests = 120
         args.burst = 150
