@@ -207,7 +207,25 @@ python tools/chaos_drill.py --rounds 1 --shards 2 --partition
 # external-witness votes in the election, and clock-jitter chaos
 # armed throughout
 python tools/chaos_drill.py --rounds 1 --shards 2 --migrate
-# 6g: sharded eviction drill (~30s) — per-shard effective fanin
+# 6g: ISSUE-18 acceptance drill (~90s) — SELF-STEERED row-range
+# rebalance under fire: trainers hammer the hot quarter of one
+# shard's slice of a sparse row-partitioned table, trainer 0's
+# SteeringDaemon watches the job's own merged ps.row_heat census,
+# proposes a migrate_range plan at the sustained skew breach, and
+# the canary applies it through the LIVE protocol — with the donor
+# primary SIGKILLed mid-apply (rows staged on the recipient, nothing
+# committed) so the re-trigger completes on its promoted backup.
+# Gated on exit 0; the sparse table bit-for-bit vs the pure
+# push-schedule oracle on BOTH trainers (exactly-once across the
+# kill, the abandoned install, and the wrong_shard redirects); the
+# plan carving a tail of the hot quarter; install < kill < promotion
+# < replicated range-commit in causal order; range bytes on
+# ps.migration_bytes{kind=range}; every trainer routing the moved
+# rows to the recipient; and the full audit chain (proposal
+# artifact, audit trail, active-plan pointer, flight order) with
+# bit-equal plan digests end to end
+python tools/chaos_drill.py --rounds 1 --shards 2 --migrate-range --sync-rounds 18
+# 6h: sharded eviction drill (~30s) — per-shard effective fanin
 # disagreeing mid-round (the dying trainer's phase-1 barrier reaches
 # shard 0 only; eviction armed on shard 1 alone): the two-phase
 # barrier + the stale-round guard must reconcile DETERMINISTICALLY

@@ -637,6 +637,20 @@ class PSServer:
         # answers wrong_shard before scope is ever consulted)
         self._dropped: set = set()
         self._mig_clients: Dict[str, "PSClient"] = {}
+        # -- row-range live migration (ISSUE 18) --------------------------
+        # per-table ROW-RANGE overrides: table base name -> list of
+        # {"lo","hi" (GLOBAL row ids), "shard" (new owner),
+        # "local_base" (recipient-LOCAL id of global lo), "version",
+        # "committed"; donor side additionally "src_lo"/"src_hi" (the
+        # donor-LOCAL window that moved) + "to_endpoints"}. Rides the
+        # replication stream like _map_overrides.
+        self._range_overrides: Dict[str, List[dict]] = {}
+        # donor side: the row-range migration requested but not yet
+        # executed (runs at the next round apply, inside the barrier)
+        self._pending_range_migration: Optional[dict] = None
+        # recipient side: installed-but-uncommitted row-range stages,
+        # keyed by table name (a re-install replaces the orphan)
+        self._staged_ranges: Dict[str, dict] = {}
         # grad name -> optimize block builder for vars migrating IN
         # (a migration ships state, never code; the factory rebuilds
         # the block from the shared program definition)
@@ -764,7 +778,13 @@ class PSServer:
                 self._executor._write_var(self._scope, name, total)
                 sub = self._grad_to_block.get(name)
                 if sub is not None:
+                    t_blk = time.monotonic()
                     self._executor.run_block(sub, self._scope)
+                    # per-TABLE apply timing: the hot-shard steerer
+                    # needs to name the hot table, not just the group
+                    _histogram("ps.apply_ms", shard=self._shard,
+                               table=name.split("@", 1)[0]).observe(
+                        (time.monotonic() - t_blk) * 1e3)
             self._pending.clear()
             self._send_barriers = 0
             self._applied_round += 1
@@ -777,13 +797,17 @@ class PSServer:
             # frozen HERE (no trainer can observe the round until the
             # install + the replication below both finished)
             self._step_migration_locked()
+            self._step_range_migration_locked()
             self._replicate_locked()
             self._commit_migrations_locked()
         # per-shard apply timing (ROADMAP hot-shard detector input):
         # always-on like every ps.* family, labeled by shard so the
         # merged dump shows which shard's optimize blocks run hot —
-        # the steering daemon's migration signal lands here first
-        _histogram("ps.apply_ms", shard=self._shard).observe(
+        # the steering daemon's migration signal lands here first.
+        # table="_round" is the whole-round series; real tables get
+        # their own series at the block run / sparse push.
+        _histogram("ps.apply_ms", shard=self._shard,
+                   table="_round").observe(
             (time.monotonic() - t_apply) * 1e3)
         _flight.record("ps.round_applied", round=self._applied_round)
         self._round_complete = True
@@ -931,8 +955,13 @@ class PSServer:
                 continue  # the anchor ships every var below anyway
             if compat and ps["chunks"] == state["chunks"]:
                 continue  # digest says unchanged
-            if (rows and getattr(a, "ndim", 0) >= 1
+            if (rows and n not in self._dirty_dense
+                    and getattr(a, "ndim", 0) >= 1
                     and len(rows) < int(a.shape[0])):
+                # rows re-dirtied AFTER a dense touch in the same
+                # window (e.g. a push right after a range-move zeroed
+                # its slice) must not shrink the ship to the slice —
+                # the dense change would silently never reach backups
                 rs = np.asarray(sorted(rows), dtype=np.int64)
                 items.append((n, np.ascontiguousarray(a[rs]),
                               {"rows": rs.tolist()}))
@@ -1090,13 +1119,35 @@ class PSServer:
             ex["pending_migration"] = {
                 "name": pm["name"], "to_shard": pm["to_shard"],
                 "to_endpoints": pm["to_endpoints"]}
+        if self._range_overrides:
+            # full server-side dicts (src window + recipient chain
+            # included): a promoted backup must be able to re-drive
+            # an uncommitted range commit, or zero the right slice
+            ex["range_overrides"] = {
+                t: [dict(r) for r in rs]
+                for t, rs in self._range_overrides.items()}
+        if self._pending_range_migration is not None:
+            pm = self._pending_range_migration
+            ex["pending_range_migration"] = {
+                "name": pm["name"], "lo": pm["lo"], "hi": pm["hi"],
+                "src_lo": pm["src_lo"], "src_hi": pm["src_hi"],
+                "to_shard": pm["to_shard"],
+                "to_endpoints": pm["to_endpoints"]}
         return ex
 
     def _shard_map_payload_locked(self) -> dict:
-        """The client-facing shard map: version + var -> shard ints."""
-        return {"version": self._shard_map_version,
-                "overrides": {n: int(ov["shard"])
-                              for n, ov in self._map_overrides.items()}}
+        """The client-facing shard map: version + var -> shard ints,
+        plus per-table row-range ownership (ISSUE 18) as
+        ``{table: [[global_lo, global_hi, shard, local_base], ...]}``."""
+        payload = {"version": self._shard_map_version,
+                   "overrides": {n: int(ov["shard"])
+                                 for n, ov in self._map_overrides.items()}}
+        if self._range_overrides:
+            payload["ranges"] = {
+                t: [[int(r["lo"]), int(r["hi"]), int(r["shard"]),
+                     int(r["local_base"])] for r in rs]
+                for t, rs in self._range_overrides.items()}
+        return payload
 
     def _mig_client(self, chain: str) -> "PSClient":
         c = self._mig_clients.get(chain)
@@ -1180,6 +1231,10 @@ class PSServer:
         if not items:
             raise RuntimeError("no tensor state for %r" % name)
         headers, raw = self._blobs_for(items)
+        # kind=var vs kind=range: a regression back to whole-var
+        # moves of a sparse table shows up as var bytes where range
+        # bytes should be (bench_diff watches this family)
+        _counter("ps.migration_bytes", kind="var").inc(len(raw))
         self._mig_client(to_endpoints)._call({
             "kind": "migrate_install", "name": name,
             "mig_version": ver, "mig_round": self._applied_round,
@@ -1187,6 +1242,131 @@ class PSServer:
             "watermark": dict(self._applied_watermark),
             "has_block": (name + "@GRAD") in self._grad_to_block,
             "vars": headers}, raw)
+
+    def _step_range_migration_locked(self) -> None:
+        """Donor side of a ROW-RANGE migration (ISSUE 18), called
+        inside the round apply: ship the dirty-row-tracked slice
+        ``[src_lo, src_hi)`` of one sparse table to the recipient and
+        soft-commit the per-range ownership split. Rides the PR-13
+        protocol verbatim: install (staged, not servable) -> soft
+        commit (map version bump; the rows stay in the donor's stream)
+        -> the caller's replication ships the override to the donor's
+        backups -> _commit_migrations_locked drives the replicated
+        commit home. Transport failures retry at the next round's
+        barrier, bounded — then roll back with no override anywhere a
+        trainer can see."""
+        pm = self._pending_range_migration
+        if pm is None or not self._active_role():
+            return
+        name = pm["name"]
+        tbl = self._executor._read_var(self._scope, name)
+        if tbl is None:
+            self._pending_range_migration = None
+            return
+        ver = self._shard_map_version + 1
+        _flight.record("ps.range_migration_begin", var=name,
+                       lo=int(pm["lo"]), hi=int(pm["hi"]),
+                       to_shard=pm["to_shard"], version=ver,
+                       round=self._applied_round)
+        try:
+            local_base = self._install_range_locked(pm, ver)
+        except (RuntimeError, OSError) as e:
+            pm["attempts"] = int(pm.get("attempts", 0)) + 1
+            _counter("ps.migrations", outcome="install_retry").inc()
+            if pm["attempts"] >= 3:
+                self._pending_range_migration = None
+                _counter("ps.migrations", outcome="rollback").inc()
+                _flight.record("ps.range_migration_rollback", var=name,
+                               why="install failed: %s" % e)
+                print("[ps_rpc] range migration of %r[%s,%s) to shard "
+                      "%s ROLLED BACK after %d install failures (%s)"
+                      % (name, pm["lo"], pm["hi"], pm["to_shard"],
+                         pm["attempts"], e),
+                      file=sys.stderr, flush=True)
+            return
+        if os.environ.get("PADDLE_PS_CHAOS_DIE_AFTER_INSTALL") \
+                == self._own_endpoint:
+            # chaos-drill hook (shared with the whole-var path): the
+            # donor primary dies in the WORST spot — rows staged on
+            # the recipient, nothing committed or replicated
+            print("[ps_rpc] CHAOS: donor %s dying after range "
+                  "install" % self._own_endpoint, file=sys.stderr,
+                  flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        # soft commit: route the window away; keep its rows in our
+        # stream (unzeroed) until the recipient durably owns them
+        self._pending_range_migration = None
+        self._shard_map_version = ver
+        rs = self._range_overrides.setdefault(name, [])
+        rs[:] = [r for r in rs
+                 if not (int(r["lo"]) == int(pm["lo"])
+                         and int(r["hi"]) == int(pm["hi"]))]
+        rs.append({"lo": int(pm["lo"]), "hi": int(pm["hi"]),
+                   "shard": int(pm["to_shard"]),
+                   "local_base": int(local_base),
+                   "src_lo": int(pm["src_lo"]),
+                   "src_hi": int(pm["src_hi"]),
+                   "version": ver, "committed": False,
+                   "to_endpoints": pm["to_endpoints"]})
+        _counter("ps.migrations", outcome="installed").inc()
+        _flight.record("ps.range_migration_installed", var=name,
+                       lo=int(pm["lo"]), hi=int(pm["hi"]),
+                       version=ver, round=self._applied_round)
+
+    def _install_range_locked(self, pm: dict, ver: int) -> int:
+        """Ship rows ``[src_lo, src_hi)`` of the table — and the same
+        window of every @-companion sharing its row axis — to the
+        recipient's active primary for staging. Returns the
+        recipient-LOCAL base id the staged rows will land at (its
+        table height at stage time). Raises on transport/app failure —
+        the caller owns the retry/rollback policy."""
+        name = pm["name"]
+        s, e = int(pm["src_lo"]), int(pm["src_hi"])
+        items = []
+        found_base = False
+        for vn in self._family_index().get(name, [name]):
+            v = self._executor._read_var(self._scope, vn)
+            if v is None or not hasattr(v, "shape"):
+                continue
+            a = np.asarray(v)
+            if a.ndim < 1 or int(a.shape[0]) < e:
+                continue  # companions off the row axis stay put
+            if vn == name:
+                found_base = True
+            items.append((vn, np.ascontiguousarray(a[s:e]), None))
+        if not found_base:
+            raise RuntimeError("no sliceable rows [%d,%d) of %r"
+                               % (s, e, name))
+        headers, raw = self._blobs_for(items)
+        _counter("ps.migration_bytes", kind="range").inc(len(raw))
+        resp, _ = self._mig_client(pm["to_endpoints"])._call({
+            "kind": "migrate_range_install", "name": name,
+            "mig_version": ver, "mig_round": self._applied_round,
+            "lo": int(pm["lo"]), "hi": int(pm["hi"]),
+            "to_shard": int(pm["to_shard"]),
+            "watermark": dict(self._applied_watermark),
+            "has_block": (name + "@GRAD") in self._grad_to_block,
+            "vars": headers}, raw)
+        return int(resp.get("local_base", 0))
+
+    def _zero_range_locked(self, name: str, s: int, e: int) -> None:
+        """Hard commit of a row-range move: ZERO the moved donor-local
+        slice (a deterministic tombstone — shrinking the array would
+        re-base every other local id this shard's clients hold) on the
+        base table and every companion sharing its row axis, and mark
+        them dense-dirty so the tombstone replicates."""
+        for vn in self._family_index().get(name, [name]):
+            v = self._executor._read_var(self._scope, vn)
+            if v is None or not hasattr(v, "shape"):
+                continue
+            a = np.asarray(v)
+            if a.ndim < 1 or int(a.shape[0]) < e:
+                continue
+            a = np.array(a, copy=True)
+            a[s:e] = 0
+            self._executor._write_var(self._scope, vn, a)
+            self._dirty_dense.add(vn)
+            self._dirty_rows.pop(vn, None)
 
     def _commit_migrations_locked(self) -> None:
         """Donor side (original or promoted): drive every uncommitted
@@ -1198,6 +1378,46 @@ class PSServer:
         the hard commit waits for the ack."""
         if not self._active_role():
             return
+        for name, rs in list(self._range_overrides.items()):
+            for ov in rs:
+                if ov.get("committed") or "to_endpoints" not in ov:
+                    continue
+                try:
+                    self._mig_client(ov["to_endpoints"])._call({
+                        "kind": "migrate_range_commit", "name": name,
+                        "mig_version": int(ov["version"]),
+                        "lo": int(ov["lo"]), "hi": int(ov["hi"]),
+                        "to_shard": int(ov["shard"])})
+                except (RuntimeError, OSError) as e:
+                    _counter("ps.migrations",
+                             outcome="commit_retry").inc()
+                    print("[ps_rpc] migrate_range_commit of %r[%s,%s) "
+                          "failed (%s) — re-installing and retrying "
+                          "next round" % (name, ov["lo"], ov["hi"], e),
+                          file=sys.stderr, flush=True)
+                    try:
+                        # stage gone (recipient primary died) or its
+                        # local base drifted: re-stage with a fresh
+                        # base — the rows are still here, unzeroed
+                        ov["local_base"] = self._install_range_locked(
+                            {"name": name, "lo": ov["lo"],
+                             "hi": ov["hi"], "src_lo": ov["src_lo"],
+                             "src_hi": ov["src_hi"],
+                             "to_shard": ov["shard"],
+                             "to_endpoints": ov["to_endpoints"]},
+                            int(ov["version"]))
+                    except (RuntimeError, OSError):
+                        pass  # next round retries the whole sequence
+                    continue
+                ov["committed"] = True
+                self._zero_range_locked(name, int(ov["src_lo"]),
+                                        int(ov["src_hi"]))
+                _counter("ps.migrations", outcome="committed").inc()
+                _flight.record("ps.range_migration_committed",
+                               var=name, lo=int(ov["lo"]),
+                               hi=int(ov["hi"]),
+                               version=int(ov["version"]),
+                               round=self._applied_round)
         for name, ov in list(self._map_overrides.items()):
             if ov.get("committed") or "to_endpoints" not in ov:
                 continue
@@ -1276,6 +1496,91 @@ class PSServer:
         _counter("ps.migrations", outcome="adopted").inc()
         _flight.record("ps.migration_commit", var=name, version=ver,
                        round=self._applied_round)
+
+    def _commit_staged_range_locked(self, name: str) -> None:
+        """Recipient side of a row-range move: the staged rows become
+        servable — APPENDED to the resident table (at the local base
+        promised in the install ack) and to every companion that
+        shipped with them, optimize block rebuilt, watermark merged,
+        map bumped with the committed range ownership, and the grown
+        family pushed to THIS group's backups before the donor ever
+        gets the ack."""
+        st = self._staged_ranges.pop(name)
+        for vn, arr in st["arrays"].items():
+            cur = self._executor._read_var(self._scope, vn)
+            if cur is not None and hasattr(cur, "shape") \
+                    and np.asarray(cur).ndim == arr.ndim:
+                grown = np.concatenate([np.asarray(cur), arr], axis=0)
+            else:
+                grown = arr
+            self._executor._write_var(self._scope, vn,
+                                      np.ascontiguousarray(grown))
+            self._dropped.discard(vn)
+            self._shipped_digests.pop(vn, None)
+            self._dirty_dense.add(vn)
+        gname = name + "@GRAD"
+        if gname not in self._grad_to_block \
+                and self._block_factory is not None:
+            blk = self._block_factory(gname)
+            if blk is not None:
+                self._grad_to_block[gname] = blk
+        for cid, s in (st.get("watermark") or {}).items():
+            if int(self._repl_watermark.get(cid, 0)) < int(s):
+                self._repl_watermark[cid] = int(s)
+        ver = int(st["version"])
+        self._shard_map_version = max(self._shard_map_version, ver)
+        rs = self._range_overrides.setdefault(name, [])
+        rs[:] = [r for r in rs
+                 if not (int(r["lo"]) == int(st["lo"])
+                         and int(r["hi"]) == int(st["hi"]))]
+        rs.append({"lo": int(st["lo"]), "hi": int(st["hi"]),
+                   "shard": int(st["to_shard"]),
+                   "local_base": int(st["local_base"]),
+                   "version": ver, "committed": True})
+        _gauge("ps.table_rows", shard=self._shard, table=name).set(
+            int(st["local_base"]) + int(st["hi"]) - int(st["lo"]))
+        self._replicate_vars_locked(sorted(st["arrays"]))
+        _counter("ps.migrations", outcome="adopted").inc()
+        _flight.record("ps.range_migration_adopted", var=name,
+                       lo=int(st["lo"]), hi=int(st["hi"]),
+                       version=ver, round=self._applied_round)
+
+    def _range_redirect_locked(self, table: str, rows, mv: int):
+        """Sparse-dataplane routing for row-range migrations: commit a
+        staged range whose appended region a map-proving client is
+        addressing (backstop for a donor that died between its bump
+        and the commit), then answer ``wrong_shard`` when ANY
+        requested local row falls in a window migrated away — all or
+        nothing, so the client re-splits the whole request against the
+        adopted map and every row executes exactly once. Returns the
+        redirect response dict, or None to proceed."""
+        st = self._staged_ranges.get(table)
+        if st is not None and mv >= int(st["version"]):
+            tbl = self._executor._read_var(self._scope, table)
+            height = (int(np.asarray(tbl).shape[0])
+                      if tbl is not None and hasattr(tbl, "shape")
+                      else 0)
+            if height == int(st["local_base"]) \
+                    and any(int(r) >= height for r in rows):
+                # the client PROVED the donor's map bump (its adopted
+                # version rides the rpc) and is addressing the staged
+                # rows' landing zone: commit
+                self._commit_staged_range_locked(table)
+        for r in self._range_overrides.get(table, ()):
+            if int(r["shard"]) == self._shard_index:
+                continue
+            s, e = int(r.get("src_lo", -1)), int(r.get("src_hi", -1))
+            if s < 0:
+                continue
+            if any(s <= int(x) < e for x in rows):
+                return {"ok": False, "wrong_shard": True,
+                        "name": table,
+                        "shard_map": self._shard_map_payload_locked(),
+                        "error": "rows [%d,%d) of %r migrated to "
+                        "shard %s (map v%d)"
+                        % (s, e, table, r["shard"],
+                           self._shard_map_version)}
+        return None
 
     def _replicate_vars_locked(self, names) -> None:
         """Push the named vars (plus the shard-map state) to this
@@ -1972,6 +2277,11 @@ class PSServer:
             # sparse tables are round-free in pslib, like the push.
             ids = _array_from(msg["array"], raw).reshape(-1)
             with self._lock:
+                base = str(msg["name"]).split("@", 1)[0]
+                redir = self._range_redirect_locked(
+                    base, ids, int(msg.get("mv", -1)))
+                if redir is not None:
+                    return redir, b""
                 tbl = self._executor._read_var(self._scope, msg["name"])
             if tbl is None:
                 return {"ok": False,
@@ -1995,6 +2305,11 @@ class PSServer:
             extra = {}
             with self._lock:
                 pname = msg.get("param", "")
+                if pname:
+                    redir = self._range_redirect_locked(
+                        pname, rows, int(msg.get("mv", -1)))
+                    if redir is not None:
+                        return redir, b""
                 tbl = self._executor._read_var(self._scope, pname)
                 height = (int(np.asarray(tbl).shape[0])
                           if tbl is not None else int(rows.max()) + 1)
@@ -2002,6 +2317,7 @@ class PSServer:
                 sr._value = LoDTensor(vals)
                 self._executor._write_var(self._scope, msg["name"], sr)
                 sub = self._grad_to_block.get(msg["name"])
+                t_blk = time.monotonic()
                 if sub is not None:
                     self._executor.run_block(sub, self._scope)
                 if pname:
@@ -2011,6 +2327,28 @@ class PSServer:
                     # table instead of the whole thing
                     self._dirty_rows.setdefault(pname, set()).update(
                         int(r) for r in rows)
+                    # hot-shard steering inputs (ISSUE 18): per-table
+                    # apply time, dirty-row census, and a coarse
+                    # row-heat histogram (8 buckets over the local
+                    # height) the steerer derives split points from
+                    _histogram("ps.apply_ms", shard=self._shard,
+                               table=pname).observe(
+                        (time.monotonic() - t_blk) * 1e3)
+                    _gauge("ps.dirty_rows", shard=self._shard,
+                           table=pname).set(
+                        len(self._dirty_rows[pname]))
+                    # GLOBAL height when the router stamped it (a
+                    # range-sliced push), else this shard's own: the
+                    # steerer sizes migrate_range plans from this
+                    _gauge("ps.table_rows", shard=self._shard,
+                           table=pname).set(
+                        int(msg.get("gh") or height))
+                    if height > 0:
+                        for r in rows:
+                            b = min(7, int(r) * 8 // height)
+                            _counter("ps.row_heat", shard=self._shard,
+                                     table=pname,
+                                     bucket=str(b)).inc()
                 extra = self._async_tick_locked()
             return dict({"ok": True}, **extra), b""
         if kind == "checkpoint":
@@ -2116,6 +2454,19 @@ class PSServer:
                     # the stream is the truth: an intent that stopped
                     # riding it was executed or rolled back upstream
                     self._pending_migration = None
+                ro = msg.get("range_overrides")
+                if ro:
+                    # row-range ownership (ISSUE 18): adopted wholesale
+                    # — the stream is the truth, and the full dicts
+                    # (src window + recipient chain) let a promoted
+                    # backup re-drive an uncommitted range commit
+                    self._range_overrides = {
+                        t: [dict(r) for r in rs] for t, rs in ro.items()}
+                prm = msg.get("pending_range_migration")
+                if prm:
+                    self._pending_range_migration = dict(prm)
+                elif not self._active_role():
+                    self._pending_range_migration = None
                 # NB "round" is the dedup-token key _call stamps on
                 # every message — the payload round travels separately
                 self._applied_round = int(msg["repl_round"])
@@ -2228,6 +2579,147 @@ class PSServer:
                                        self._own_endpoint)}, b""
                 self._commit_staged_locked(name)
             return {"ok": True}, b""
+        if kind == "migrate_range_begin":
+            # control plane, donor side (ISSUE 18): record the intent
+            # to move rows [lo, hi) (global; src_lo/src_hi donor-local)
+            # of one sparse table; the transfer itself runs inside the
+            # NEXT round apply, behind the barrier every trainer is
+            # blocked in
+            if not self._active_role():
+                return {"ok": False, "not_primary": True,
+                        "error": "migrate_range_begin sent to "
+                        "non-active endpoint %s"
+                        % self._own_endpoint}, b""
+            name = str(msg.get("name", "")).split("@", 1)[0]
+            lo, hi = int(msg["lo"]), int(msg["hi"])
+            src_lo, src_hi = int(msg["src_lo"]), int(msg["src_hi"])
+            if hi <= lo or src_hi - src_lo != hi - lo or src_lo < 0:
+                return {"ok": False, "error":
+                        "bad range [%d,%d) (src [%d,%d)) for %r"
+                        % (lo, hi, src_lo, src_hi, name)}, b""
+            with self._lock:
+                for r in self._range_overrides.get(name, ()):
+                    if int(r["shard"]) != self._shard_index \
+                            and not (hi <= int(r["lo"])
+                                     or int(r["hi"]) <= lo):
+                        return {"ok": True, "already_migrated": True,
+                                "shard_map":
+                                    self._shard_map_payload_locked()
+                                }, b""
+                tbl = self._executor._read_var(self._scope, name)
+                if tbl is None or not hasattr(tbl, "shape") \
+                        or int(np.asarray(tbl).shape[0]) < src_hi:
+                    return {"ok": False, "error":
+                            "no table %r holding local rows [%d,%d)"
+                            % (name, src_lo, src_hi)}, b""
+                if self._pending_migration is not None \
+                        or self._pending_range_migration is not None:
+                    # one in-flight migration per group, same refusal
+                    # discipline as the whole-var path
+                    return {"ok": False, "error":
+                            "a migration is already pending on %s — "
+                            "retry after the next round barrier"
+                            % self._own_endpoint}, b""
+                self._pending_range_migration = {
+                    "name": name, "lo": lo, "hi": hi,
+                    "src_lo": src_lo, "src_hi": src_hi,
+                    "to_shard": int(msg["to_shard"]),
+                    "to_endpoints": str(msg["to_endpoints"])}
+            _flight.record("ps.range_migration_requested", var=name,
+                           lo=lo, hi=hi, to_shard=int(msg["to_shard"]))
+            return {"ok": True, "pending": True}, b""
+        if kind == "migrate_range_install":
+            # recipient side: STAGE the inbound rows (not servable
+            # until the donor's replicated commit — or a dataplane
+            # touch proving the donor's map bump reached a trainer).
+            # The ack names the LOCAL base id the rows will land at.
+            if not self._active_role():
+                return {"ok": False, "not_primary": True,
+                        "error": "migrate_range_install sent to "
+                        "non-active endpoint %s"
+                        % self._own_endpoint}, b""
+            if msg.get("has_block") and self._block_factory is None:
+                return {"ok": False, "error":
+                        "recipient %s has no block_factory to rebuild "
+                        "the optimize block for %r"
+                        % (self._own_endpoint, msg.get("name"))}, b""
+            name = str(msg["name"])
+            arrays: Dict[str, np.ndarray] = {}
+            off = 0
+            for h in msg.get("vars", []):
+                n = int(np.dtype(h["dtype"]).itemsize
+                        * int(np.prod(h["shape"]) if h["shape"]
+                              else 1))
+                arrays[h["name"]] = _array_from(h, raw[off:off + n])
+                off += n
+            if name not in arrays:
+                return {"ok": False, "error":
+                        "migrate_range_install payload lacks the base "
+                        "table %r" % name}, b""
+            ver = int(msg["mig_version"])
+            lo, hi = int(msg["lo"]), int(msg["hi"])
+            with self._lock:
+                for r in self._range_overrides.get(name, ()):
+                    if (int(r["lo"]) == lo and int(r["hi"]) == hi
+                            and r.get("committed")
+                            and int(r.get("version", 0)) >= ver
+                            and int(r["shard"]) == self._shard_index):
+                        return {"ok": True, "already_committed": True,
+                                "local_base": int(r["local_base"])
+                                }, b""
+                tbl = self._executor._read_var(self._scope, name)
+                local_base = (int(np.asarray(tbl).shape[0])
+                              if tbl is not None
+                              and hasattr(tbl, "shape") else 0)
+                self._staged_ranges[name] = {
+                    "version": ver, "arrays": arrays,
+                    "lo": lo, "hi": hi,
+                    "to_shard": int(msg["to_shard"]),
+                    "local_base": local_base,
+                    "round": int(msg.get("mig_round", 0)),
+                    "watermark": dict(msg.get("watermark") or {})}
+            _flight.record("ps.range_migration_install", var=name,
+                           lo=lo, hi=hi, version=ver,
+                           round=int(msg.get("mig_round", 0)))
+            return {"ok": True, "staged": True,
+                    "local_base": local_base}, b""
+        if kind == "migrate_range_commit":
+            if not self._active_role():
+                return {"ok": False, "not_primary": True,
+                        "error": "migrate_range_commit sent to "
+                        "non-active endpoint %s"
+                        % self._own_endpoint}, b""
+            name = str(msg["name"])
+            ver = int(msg["mig_version"])
+            lo, hi = int(msg["lo"]), int(msg["hi"])
+            with self._lock:
+                for r in self._range_overrides.get(name, ()):
+                    if (int(r["lo"]) == lo and int(r["hi"]) == hi
+                            and r.get("committed")
+                            and int(r.get("version", 0)) >= ver):
+                        return {"ok": True,
+                                "already_committed": True}, b""
+                st = self._staged_ranges.get(name)
+                if st is None or int(st["version"]) != ver:
+                    return {"ok": False, "error":
+                            "no staged range of %r at version %d on %s"
+                            % (name, ver, self._own_endpoint)}, b""
+                tbl = self._executor._read_var(self._scope, name)
+                height = (int(np.asarray(tbl).shape[0])
+                          if tbl is not None
+                          and hasattr(tbl, "shape") else 0)
+                if height != int(st["local_base"]):
+                    # the landing zone drifted since the stage (a
+                    # concurrent migration grew the table): refuse —
+                    # the donor re-installs against the fresh base
+                    self._staged_ranges.pop(name, None)
+                    return {"ok": False, "error":
+                            "staged local base %d of %r no longer "
+                            "matches table height %d — re-install"
+                            % (int(st["local_base"]), name,
+                               height)}, b""
+                self._commit_staged_range_locked(name)
+            return {"ok": True}, b""
         if kind == "lease_renew":
             with self._lock:
                 epoch = int(msg.get("epoch", 0))
@@ -2317,6 +2809,7 @@ class PSServer:
             with self._lock:
                 evicted = sorted(self._evicted)
                 eff = self._effective_fanin()
+                smap = self._shard_map_payload_locked()
             return {"ok": True,
                     "status": {str(k): v
                                for k, v in
@@ -2332,11 +2825,7 @@ class PSServer:
                     "evictions": _counter("ps.evictions").value,
                     "readmissions": _counter("ps.readmissions").value,
                     "promotions": _counter("ps.promotions").value,
-                    "shard_map": {
-                        "version": self._shard_map_version,
-                        "overrides": {
-                            n: int(ov["shard"])
-                            for n, ov in self._map_overrides.items()}},
+                    "shard_map": smap,
                     }, b""
         if kind == "shutdown":
             self._shutdown.set()
@@ -3287,18 +3776,26 @@ class PSClient:
                                ids.tobytes())
         return _array_from(resp["array"], raw)
 
-    def push_sparse(self, name: str, rows, values, param: str = "") -> None:
+    def push_sparse(self, name: str, rows, values, param: str = "",
+                    global_height: Optional[int] = None) -> None:
         """Push (local row ids, grad rows) to this server's shard; the
         server applies its optimize block immediately (async, pslib
         PushSparseVarsAsync counterpart). ``param`` names the table var
-        so the server can size the SelectedRows height."""
+        so the server can size the SelectedRows height.
+        ``global_height`` is the table's GLOBAL height when the caller
+        slices a range-partitioned table (the sharded router): the
+        server's ``ps.table_rows`` gauge reports it so the hot-shard
+        steerer sizes plans from the whole table, not this shard's
+        slice."""
         rows = np.ascontiguousarray(np.asarray(rows, dtype=np.int64))
         vals = np.ascontiguousarray(np.asarray(values))
-        self._call({"kind": "push_sparse", "name": name,
-                    "param": param,
-                    "rows": _array_header(rows),
-                    "array": _array_header(vals)},
-                   rows.tobytes() + vals.tobytes())
+        msg = {"kind": "push_sparse", "name": name,
+               "param": param,
+               "rows": _array_header(rows),
+               "array": _array_header(vals)}
+        if global_height:
+            msg["gh"] = int(global_height)
+        self._call(msg, rows.tobytes() + vals.tobytes())
 
     def checkpoint(self, dirname: str) -> None:
         """Ask the server to snapshot its vars (checkpoint_notify)."""
@@ -3340,6 +3837,23 @@ class PSClient:
         round barrier; the ack only records the intent."""
         resp, _ = self._call({"kind": "migrate_begin",
                               "name": name,
+                              "to_shard": int(to_shard),
+                              "to_endpoints": str(to_endpoints)})
+        return resp
+
+    def migrate_range(self, name: str, lo: int, hi: int,
+                      src_lo: int, src_hi: int, to_shard: int,
+                      to_endpoints: str) -> dict:
+        """Ask THIS endpoint chain's primary (the donor) to migrate
+        rows ``[lo, hi)`` (GLOBAL ids; ``src_lo``/``src_hi`` the
+        donor-LOCAL window) of sparse table ``name`` to the group at
+        ``to_endpoints``. The transfer executes at the donor's next
+        round barrier; the ack only records the intent (ISSUE 18)."""
+        resp, _ = self._call({"kind": "migrate_range_begin",
+                              "name": name,
+                              "lo": int(lo), "hi": int(hi),
+                              "src_lo": int(src_lo),
+                              "src_hi": int(src_hi),
                               "to_shard": int(to_shard),
                               "to_endpoints": str(to_endpoints)})
         return resp

@@ -142,6 +142,12 @@ class ShardedPSClient:
         self._map_lock = threading.Lock()
         self.map_version = 0
         self.map_overrides: Dict[str, int] = {}
+        # row-range overrides (ISSUE 18): per base table, ordered
+        # (global_lo, global_hi, shard, local_base) entries — rows in
+        # [lo, hi) live on ``shard`` at LOCAL id
+        # ``local_base + (gid - lo)``; later entries supersede earlier
+        # ones (the server appends the newest last)
+        self.map_ranges: Dict[str, List[tuple]] = {}
         self.shards: List[PSClient] = []
         for eps in shard_endpoints:
             c = PSClient(eps, trainer_id=trainer_id, **client_kw)
@@ -179,6 +185,10 @@ class ShardedPSClient:
             self.map_overrides = {
                 str(n): int(s)
                 for n, s in (payload.get("overrides") or {}).items()}
+            self.map_ranges = {
+                str(t): [(int(r[0]), int(r[1]), int(r[2]), int(r[3]))
+                         for r in rs]
+                for t, rs in (payload.get("ranges") or {}).items()}
         for c in self.shards:
             # every rpc now carries the adopted version (``mv``): a
             # recipient holding a STAGED var commits it only for a
@@ -209,6 +219,46 @@ class ShardedPSClient:
         return self._routed(
             name, lambda c: c.migrate(
                 name, to_shard, self._shard_endpoints[to_shard]))
+
+    def migrate_range(self, name: str, lo: int, hi: int,
+                      to_shard: int, height: int) -> dict:
+        """Live-migrate GLOBAL rows ``[lo, hi)`` of sparse table
+        ``name`` to ``to_shard``'s group (ISSUE 18). The range must lie
+        entirely within ONE current ownership region (no hash or
+        range-override boundary strictly inside) so the donor-LOCAL
+        source window is contiguous; the donor executes the move at
+        its next round barrier (see ps_rpc) and the bumped map — now
+        carrying a per-range entry for the table — reaches every
+        trainer via barrier acks or ``wrong_shard`` redirects."""
+        lo, hi, to_shard = int(lo), int(hi), int(to_shard)
+        if not 0 <= to_shard < self.nshards:
+            raise ValueError("to_shard %d out of range (nshards=%d)"
+                             % (to_shard, self.nshards))
+        if not 0 <= lo < hi <= int(height):
+            raise ValueError("bad row range [%d, %d) for height %d"
+                             % (lo, hi, height))
+        base = name.split("@", 1)[0]
+        bounds = set()
+        for s in range(1, self.nshards):
+            bounds.add(row_range(s, height, self.nshards)[0])
+        with self._map_lock:
+            for rlo, rhi, _s, _b in self.map_ranges.get(base, ()):
+                bounds.add(int(rlo))
+                bounds.add(int(rhi))
+        inner = sorted(b for b in bounds if lo < b < hi)
+        if inner:
+            raise ValueError(
+                "range [%d, %d) of %r crosses ownership boundaries "
+                "%s — split the request at them" % (lo, hi, base, inner))
+        owner, local = self._row_owner(
+            base, np.asarray([lo], dtype=np.int64), height)
+        donor, src_lo = int(owner[0]), int(local[0])
+        if donor == to_shard:
+            raise ValueError("rows [%d, %d) of %r already live on "
+                             "shard %d" % (lo, hi, base, to_shard))
+        return self.shards[donor].migrate_range(
+            base, lo, hi, src_lo, src_lo + (hi - lo), to_shard,
+            self._shard_endpoints[to_shard])
 
     # -- dense path -------------------------------------------------------
 
@@ -269,45 +319,97 @@ class ShardedPSClient:
 
     # -- sparse path (key-range-sliced tables) ----------------------------
 
+    def _row_owner(self, name: str, ids: np.ndarray, height: int):
+        """Per-GLOBAL-row-id ``(owner_shard, local_id)`` arrays: the
+        static hash range partition, then every adopted row-range
+        override for the table applied in order (newest last wins) —
+        a row inside a migrated ``[lo, hi)`` lives on the recipient at
+        ``local_base + (gid - lo)``."""
+        base = name.split("@", 1)[0]
+        owner = shard_for_rows(ids, height, self.nshards)
+        starts = np.array(
+            [row_range(s, height, self.nshards)[0]
+             for s in range(self.nshards)], dtype=np.int64)
+        local = ids - starts[owner]
+        with self._map_lock:
+            ranges = list(self.map_ranges.get(base, ()))
+        for lo, hi, shard, local_base in ranges:
+            m = (ids >= lo) & (ids < hi)
+            if m.any():
+                owner = np.where(m, shard, owner)
+                local = np.where(m, local_base + (ids - lo), local)
+        return owner, local
+
     def pull_sparse(self, name: str, row_ids, height: int) -> np.ndarray:
-        """Pull value rows for GLOBAL row ids: split by row range,
-        pull each shard's slice with LOCAL ids, reassemble in request
-        order."""
+        """Pull value rows for GLOBAL row ids: split by current
+        ownership (hash ranges + adopted row-range overrides), pull
+        each shard's slice with LOCAL ids, reassemble in request
+        order. A ``wrong_shard`` redirect (rows moved mid-pull) adopts
+        the bumped map and recomputes — pulls are idempotent, so the
+        whole split simply re-runs."""
         ids = np.asarray(row_ids, dtype=np.int64).reshape(-1)
         if not len(ids):
             # shard 0 answers the empty pull so shape/dtype still come
             # from the real table (the non-sharded client's behavior)
             return self.shards[0].pull_sparse(name, ids)
-        owner = shard_for_rows(ids, height, self.nshards)
-        parts: Dict[int, np.ndarray] = {}
-        for s in range(self.nshards):
-            pos = np.nonzero(owner == s)[0]
-            if not len(pos):
-                continue
-            start = row_range(s, height, self.nshards)[0]
-            parts[s] = (pos,
-                        self.shards[s].pull_sparse(name,
-                                                   ids[pos] - start))
-        first = next(iter(parts.values()))[1]
-        out = np.empty((len(ids),) + first.shape[1:], dtype=first.dtype)
-        for pos, vals in parts.values():
-            out[pos] = vals
-        return out
+        for _ in range(self.nshards + 2):
+            owner, local = self._row_owner(name, ids, height)
+            try:
+                parts: Dict[int, tuple] = {}
+                for s in range(self.nshards):
+                    pos = np.nonzero(owner == s)[0]
+                    if not len(pos):
+                        continue
+                    parts[s] = (pos, self.shards[s].pull_sparse(
+                        name, local[pos]))
+                first = next(iter(parts.values()))[1]
+                out = np.empty((len(ids),) + first.shape[1:],
+                               dtype=first.dtype)
+                for pos, vals in parts.values():
+                    out[pos] = vals
+                return out
+            except WrongShard as e:
+                self.apply_shard_map(e.shard_map)
+        raise RuntimeError(
+            "pull_sparse(%r) still redirected after %d wrong_shard "
+            "hops (map version %d)" % (name, self.nshards + 2,
+                                       self.map_version))
 
     def push_sparse(self, name: str, rows, values, height: int,
                     param: str = "") -> None:
-        """Push (global row ids, grad rows) split by row range; each
-        shard applies its slice immediately (async, row-local)."""
+        """Push (global row ids, grad rows) split by current
+        ownership; each shard applies its slice immediately (async,
+        row-local). A shard answering ``wrong_shard`` applied NOTHING
+        (the redirect is all-or-nothing and un-records the replay
+        token), so only THAT slice's rows re-route under the adopted
+        map — rows already applied at other shards are never reissued:
+        exactly-once either way."""
         ids = np.asarray(rows, dtype=np.int64).reshape(-1)
         vals = np.asarray(values)
-        owner = shard_for_rows(ids, height, self.nshards)
-        for s in range(self.nshards):
-            pos = np.nonzero(owner == s)[0]
-            if not len(pos):
-                continue
-            start = row_range(s, height, self.nshards)[0]
-            self.shards[s].push_sparse(name, ids[pos] - start,
-                                       vals[pos], param=param)
+        pending = np.arange(len(ids), dtype=np.int64)
+        for _ in range(self.nshards + 2):
+            if not len(pending):
+                return
+            owner, local = self._row_owner(name, ids[pending], height)
+            redirected: List[np.ndarray] = []
+            for s in range(self.nshards):
+                pos = np.nonzero(owner == s)[0]
+                if not len(pos):
+                    continue
+                sel = pending[pos]
+                try:
+                    self.shards[s].push_sparse(name, local[pos],
+                                               vals[sel], param=param,
+                                               global_height=height)
+                except WrongShard as e:
+                    self.apply_shard_map(e.shard_map)
+                    redirected.append(sel)
+            pending = (np.concatenate(redirected) if redirected
+                       else np.empty(0, dtype=np.int64))
+        raise RuntimeError(
+            "push_sparse(%r): %d rows still redirected after %d "
+            "wrong_shard hops (map version %d)"
+            % (name, len(pending), self.nshards + 2, self.map_version))
 
     # -- plumbing ---------------------------------------------------------
 

@@ -185,7 +185,57 @@ def _allreduce_across_processes(flat, nranks):
         warnings.warn(
             "on-device cross-process allreduce unavailable (%s); falling "
             "back to host-gather — expect much slower DP steps" % e)
-        from jax.experimental import multihost_utils
+        try:
+            from jax.experimental import multihost_utils
 
-        gathered = multihost_utils.process_allgather(flat, tiled=True)
-        return gathered.reshape(nranks, -1).sum(axis=0)
+            gathered = multihost_utils.process_allgather(flat,
+                                                         tiled=True)
+            return gathered.reshape(nranks, -1).sum(axis=0)
+        except Exception:
+            # process_allgather is itself a jitted cross-process
+            # computation, so a backend that refused the psum above
+            # (jaxlib's CPU backend: "Multiprocess computations
+            # aren't implemented") refuses this too
+            return _kv_allreduce(np.asarray(flat), nranks)
+
+
+_kv_allreduce_seq = [0]
+
+
+def _kv_allreduce(flat: np.ndarray, nranks: int) -> np.ndarray:
+    """Last-resort cross-process sum over the jax.distributed
+    coordinator's key-value store: every rank publishes its buffer,
+    reads every peer's, sums on host. No XLA computation crosses a
+    process boundary, so this works where the CPU backend refuses
+    multiprocess programs outright. Correctness leans on the DP
+    contract that every rank traces the SAME program — collective
+    call N on rank 0 is collective call N everywhere, so a per-call
+    sequence number keys the exchange."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "cross-process allreduce needs jax.distributed to be "
+            "initialized (no coordinator client)")
+    rank = int(distributed.global_state.process_id or 0)
+    seq = _kv_allreduce_seq[0]
+    _kv_allreduce_seq[0] += 1
+    base = "paddle_tpu/allreduce/%d" % seq
+    flat = np.ascontiguousarray(flat)
+    client.key_value_set_bytes("%s/%d" % (base, rank), flat.tobytes())
+    out = np.zeros_like(flat)
+    for r in range(nranks):
+        raw = client.blocking_key_value_get_bytes(
+            "%s/%d" % (base, r), 120_000)
+        out += np.frombuffer(raw, dtype=flat.dtype).reshape(flat.shape)
+    # every rank holds the sum before anyone deletes, or a slow
+    # reader races a cleaned-up key
+    client.wait_at_barrier("%s/read" % base, 120_000)
+    if rank == 0:
+        for r in range(nranks):
+            try:
+                client.key_value_delete("%s/%d" % (base, r))
+            except Exception:
+                pass  # stale keys only cost coordinator memory
+    return out

@@ -89,6 +89,12 @@ WATCHED = (
     # here run-over-run
     ("ps_digest_ms", -1), ("rounds_per_s", +1),
     ("repl_delta_bytes_per_round", -1),
+    # PS rebalance canaries (ISSUE 18): hot/cold per-shard row-load
+    # ratio off the ps.row_heat counters. Counter-derived, so it is
+    # deterministic under chaos injection where wall-clock throughput
+    # is not — a migrate_range plan that fails to move the heat shows
+    # up as a flat-or-rising skew and rolls back
+    ("ps_row_load_skew", -1),
     # placement records (ISSUE 15, bench `placement` block): how well
     # the searched plan's PREDICTED step time tracks the measured one
     # (min/max ratio). A collapse means the cost model drifted off the
@@ -137,6 +143,12 @@ COUNTER_WATCH_GROWS_BAD = ("parallel.collective_bytes",
                            "parallel.collective_ops",
                            "executor.compile_fallbacks",
                            "ps.replication_bytes",
+                           # live-migration traffic (ISSUE 18): a
+                           # regression from row-range moves back to
+                           # whole-var moves ships the cold 99% of the
+                           # table — kind=var bytes grow where
+                           # kind=range bytes should be
+                           "ps.migration_bytes",
                            # fused single-chip program op count
                            # (tools/sc_smoke.py): deterministic —
                            # growth means the fusion passes regressed

@@ -387,7 +387,8 @@ def _import_consumers() -> None:
     for mod in ("paddle_tpu.parallel.collectives",
                 "paddle_tpu.serving.batcher",
                 "paddle_tpu.dygraph.lazy",
-                "paddle_tpu.placement.search"):
+                "paddle_tpu.placement.search",
+                "paddle_tpu.observability.ps_steering"):
         try:
             __import__(mod)
         except Exception:

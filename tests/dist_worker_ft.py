@@ -47,6 +47,20 @@ Role from PADDLE_ROLE (the launch supervisor sets it) or FT_ROLE:
   (no parameter state; every shard's primaries renew with it via
   PADDLE_PS_WITNESSES).
 
+ISSUE 18 mode (``FT_MIGRATE_RANGE=1``, requires shards > 1): every
+shard additionally serves its LOCAL slice of one sparse table ``emb``
+(height FT_EMB_HEIGHT, width FT_EMB_WIDTH, global rows sliced by
+``row_range``) behind a row-local sparse-SGD block; trainers push
+deterministic per-row grads every round — balanced across shards
+until FT_MR_BASE_ROUND, then hammering the hot quarter of shard
+FT_MR_HOT_SHARD's span (``emb_rows_for``/``emb_vals_for`` are pure,
+so ``emb_oracle`` replays the whole schedule bit-for-bit). With
+``FT_STEER_RANGE=1`` trainer 0 also runs the PR-16 SteeringDaemon
+over the job's own merged telemetry: the ``row_load_rule`` skew
+breach proposes a ``migrate_range`` plan, and the canary applies it
+through the LIVE ``ShardedPSClient.migrate_range`` protocol —
+promotion/rollback audited in ``<metrics>/steering``.
+
 FT_EVICT_SHARD (pserver side): arm PADDLE_PS_EVICT_AFTER only on
 that shard's servers — the sharded eviction drill's disagreeing
 effective fanin.
@@ -137,6 +151,91 @@ def _ballast() -> np.ndarray:
     return np.zeros(max(0, n), dtype=np.float32)
 
 
+# -- ISSUE 18: sparse table + deterministic hot-row workload -----------------
+
+
+def _mr_mode() -> bool:
+    return (os.environ.get("FT_MIGRATE_RANGE") == "1"
+            and _nshards() > 1)
+
+
+def _emb_dims():
+    return (int(os.environ.get("FT_EMB_HEIGHT", "16")),
+            int(os.environ.get("FT_EMB_WIDTH", "4")))
+
+
+def emb_init(height: int, width: int) -> np.ndarray:
+    """Global initial table: row r = r everywhere (each shard serves
+    its ``row_range`` slice of this)."""
+    return (np.arange(height, dtype=np.float32).reshape(-1, 1)
+            * np.ones((1, width), dtype=np.float32))
+
+
+def _sparse_sgd(scope):
+    g = scope["emb@GRAD"]
+    rows = np.asarray(g.rows(), dtype=np.int64)
+    vals = np.asarray(g._value)
+    emb = np.array(scope["emb"], copy=True)
+    emb[rows] -= np.float32(0.1) * vals  # row-local, like pslib sgd
+    scope["emb"] = emb
+
+
+def _block_for_grad(gname):
+    if gname.split("@", 1)[0] == "emb":
+        return _sparse_sgd
+    return _sgd_block_for(gname.split("@", 1)[0])
+
+
+class SparseExec(MiniExec):
+    def _write_var(self, scope, name, val):
+        scope[name] = val  # keep SelectedRows grads un-coerced
+
+
+def emb_rows_for(tid: int, rnd: int, base_round: int, height: int,
+                 nshards: int, hot_shard: int):
+    """Row-id arrays for trainer ``tid``'s round-``rnd`` sparse pushes
+    (one ``push_sparse`` call per array). Rows are DISJOINT per
+    trainer (tid 0 even ids, tid 1 odd) so per-row float op order is
+    a pure function of the schedule; past ``base_round`` the hot
+    quarter of ``hot_shard``'s span is hammered 8 extra times per
+    round — the per-shard row-touch skew the steerer must catch."""
+    from paddle_tpu.distributed.ps_shard import row_range
+
+    mine = np.arange(tid % 2, height, 2, dtype=np.int64)
+    pushes = [mine]
+    if rnd > base_round:
+        lo, hi = row_range(hot_shard, height, nshards)
+        hlo = lo + 3 * (hi - lo) // 4
+        hot = np.arange(hlo, hi, dtype=np.int64)
+        hot_mine = hot[hot % 2 == tid % 2]
+        if len(hot_mine):
+            pushes.extend([hot_mine] * 8)
+    return pushes
+
+
+def emb_vals_for(rnd: int, rows, width: int) -> np.ndarray:
+    rows = np.asarray(rows, dtype=np.int64)
+    return (np.float32(0.01) * np.float32(rnd)
+            * (rows.astype(np.float32) + 1.0)[:, None]
+            * np.ones((1, width), dtype=np.float32))
+
+
+def emb_oracle(rounds: int, base_round: int, height: int, width: int,
+               nshards: int, hot_shard: int) -> np.ndarray:
+    """The bit-for-bit oracle: replay both trainers' push schedules in
+    per-row order (rows are trainer-disjoint, so trainer-major replay
+    preserves every row's own float op sequence — the only order that
+    matters for the row-local sgd block)."""
+    emb = emb_init(height, width)
+    for rnd in range(1, rounds + 1):
+        for tid in (0, 1):
+            for rows in emb_rows_for(tid, rnd, base_round, height,
+                                     nshards, hot_shard):
+                emb[rows] = emb[rows] - np.float32(0.1) \
+                    * emb_vals_for(rnd, rows, width)
+    return emb
+
+
 def run_witness():
     w = PSWitness(os.environ["PSERVER_ENDPOINT"])
     w.serve_forever()
@@ -176,6 +275,14 @@ def run_pserver():
     # static ballast: in every anchor, never in a delta — the
     # delta-vs-full evidence the drills gate on
     scope["ballast"] = _ballast()
+    if _mr_mode():
+        # ISSUE 18: this shard's LOCAL slice of the global sparse
+        # table (the sharded router pushes/pulls LOCAL row ids)
+        from paddle_tpu.distributed.ps_shard import row_range
+
+        h, w = _emb_dims()
+        lo, hi = row_range(my_shard, h, nshards)
+        scope["emb"] = emb_init(h, w)[lo:hi]
 
     applied = {"rounds": 0}
     suicidal = (die_round > 0 and index == 0 and not rejoin
@@ -194,18 +301,154 @@ def run_pserver():
         return inner
 
     grad_to_block = {g: _wrap(b) for g, b in grad_to_block.items()}
+    if _mr_mode():
+        # sparse pushes apply immediately (async, row-local): keep
+        # them OUT of the round-counted suicide wrapper, and keep
+        # SelectedRows grads un-coerced in the scope
+        grad_to_block["emb@GRAD"] = _sparse_sgd
+    execer = SparseExec() if _mr_mode() else MiniExec()
 
-    server = PSServer(endpoint, MiniExec(), scope, grad_to_block,
+    server = PSServer(endpoint, execer, scope, grad_to_block,
                       fanin=fanin, sync_mode=True,
                       endpoints=endpoints or None, rejoin=rejoin,
                       evict_after=evict_after,
                       # a live migration ships state, never code: the
                       # recipient rebuilds the optimize block for an
-                      # adopted var from the shared definition
-                      block_factory=lambda g: _sgd_block_for(
-                          g.split("@", 1)[0]))
+                      # adopted var (or row range) from the shared
+                      # definition
+                      block_factory=_block_for_grad)
     server.serve_forever()
     server.stop()
+
+
+def _steer_rounds(client, one_round, rounds, height, nshards,
+                  base_round, hot_shard):
+    """Trainer 0's ISSUE 18 driver: balanced rounds -> baseline poll,
+    hot rounds -> sustained row-load skew -> a PROPOSED migrate_range
+    plan, then a LIVE canary whose ``apply_fn`` is the real
+    ``ShardedPSClient.migrate_range`` protocol. Every phase drives the
+    shared fanin-2 round barrier (trainer 1 runs its plain loop), so
+    the steering never stalls training; the canary measure is the
+    counter-derived row-load skew, which is deterministic under the
+    drill's injected chaos. Returns a summary the drill asserts on."""
+    from paddle_tpu.observability import ps_steering
+    from paddle_tpu.observability.canary import (AuditTrail, PlanStore,
+                                                 run_canary)
+    from paddle_tpu.observability.steering_daemon import SteeringDaemon
+
+    mdir = os.environ["PADDLE_TPU_METRICS_DIR"]
+    # steering artifacts live in a SUBDIR: merge_job_dir sweeps every
+    # top-level *.json in the metrics dir as a process dump
+    steer_dir = os.path.join(mdir, "steering")
+    daemon = SteeringDaemon(
+        mdir,
+        rules=[ps_steering.row_load_rule(threshold=0.3, floor=0.1,
+                                         table="emb")],
+        hysteresis=2, cooldown=1, merge=True, out_dir=steer_dir,
+        context={ps_steering.STEERER_NAME: {
+            "metrics_dir": mdir, "height": height,
+            "nshards": nshards, "by": "row_heat"}})
+    info = {"proposed": None, "promoted": None, "plan": None,
+            "decision": None, "polls": 0, "error": None}
+    state = {"rnd": 1}
+
+    def drive(n):
+        for _ in range(n):
+            if state["rnd"] > rounds:
+                raise RuntimeError("steering phases exhausted the "
+                                   "round budget (FT_ROUNDS=%d)"
+                                   % rounds)
+            one_round(state["rnd"])
+            state["rnd"] += 1
+
+    def poll():
+        time.sleep(0.7)  # let every process's 0.5s dump cadence land
+        props = daemon.poll_once()
+        info["polls"] = daemon.polls
+        return props
+
+    def finish():
+        while state["rnd"] <= rounds:
+            one_round(state["rnd"])
+            state["rnd"] += 1
+
+    try:
+        drive(base_round)        # balanced phase
+        poll()                   # baseline (skew ~1.0)
+        proposal = None
+        for _ in range(3):       # hot phase: 2 breaches -> proposal
+            drive(1)
+            props = poll()
+            if props:
+                proposal = props[0]
+                break
+        if proposal is None:
+            info["error"] = ("daemon never proposed (polls=%d)"
+                             % daemon.polls)
+            finish()
+            return info
+        info["proposed"] = proposal.get("plan_digest")
+        info["plan"] = proposal.get("plan")
+
+        def skew_record(n):
+            # drive n rounds so the CURRENT ownership's push pattern
+            # lands, then read the cumulative row-load skew off the
+            # merged counters. Wall-clock throughput is hopeless as a
+            # canary metric here — the drill SIGKILLs the donor mid
+            # apply, so the head window would sit right inside the
+            # rejoin catch-up + injected delay faults — but the skew
+            # is counter-derived: it only RISES while the hot quarter
+            # sits on one shard and decays toward balance once the
+            # rows actually move (measure rounds run post-commit, the
+            # apply_fn blocks until the map version bumps)
+            drive(n)
+            time.sleep(0.7)  # let every process's dump cadence land
+            skew = ps_steering.row_load_skew_value(table="emb")(
+                daemon.read_merged() or {})
+            if skew is None:
+                raise RuntimeError("no row-load skew in merged "
+                                   "metrics during canary measure")
+            return {"configs": {"ps_rebalance":
+                                {"ps_row_load_skew": skew}}}
+
+        incumbent = skew_record(3)
+
+        def apply_fn(plan):
+            client.migrate_range(plan["table"], plan["lo"],
+                                 plan["hi"], plan["to_shard"],
+                                 height=plan["height"])
+            t = state["rnd"]
+            while client.map_version < 1:
+                if state["rnd"] - t >= 6:
+                    raise RuntimeError("shard map never bumped after "
+                                       "migrate_range")
+                drive(1)
+                if client.map_version < 1 and state["rnd"] - t == 2:
+                    # the donor died mid-migration (the drill's kill
+                    # hook): re-trigger against its promoted backup
+                    try:
+                        client.migrate_range(
+                            plan["table"], plan["lo"], plan["hi"],
+                            plan["to_shard"], height=plan["height"])
+                        print("[trainer 0] re-triggered migrate_range"
+                              " at round %d" % state["rnd"],
+                              file=sys.stderr, flush=True)
+                    except (ValueError, RuntimeError, OSError) as e:
+                        print("[trainer 0] re-trigger failed: %s" % e,
+                              file=sys.stderr, flush=True)
+
+        dec = run_canary(
+            proposal, incumbent, lambda plan: skew_record(3),
+            threshold=0.5, apply_fn=apply_fn,
+            plan_store=PlanStore(steer_dir, ps_steering.STEERER_NAME),
+            audit=AuditTrail(steer_dir))
+        info["promoted"] = dec.promoted
+        info["decision"] = dec.decision
+        finish()
+    except Exception as e:  # noqa: BLE001 — the drill reads `error`
+        info["error"] = "%s: %s" % (type(e).__name__, e)
+        finish()
+    return info
 
 
 def run_trainer():
@@ -264,7 +507,21 @@ def run_trainer():
     else:
         client = PSClient.for_endpoint(endpoint, trainer_id=tid)
     ws = {}
-    for rnd in range(start, rounds + 1):
+    mr = _mr_mode()
+    emb_h, emb_w = _emb_dims()
+    mr_base = int(os.environ.get("FT_MR_BASE_ROUND", "3"))
+    mr_hot = int(os.environ.get("FT_MR_HOT_SHARD", str(nshards - 1)))
+
+    def one_round(rnd):
+        nonlocal ws
+        if mr:
+            # sparse workload first: row heat lands before the round
+            # barrier, so the steerer's census is round-aligned
+            for rows in emb_rows_for(tid, rnd, mr_base, emb_h,
+                                     nshards, mr_hot):
+                client.push_sparse("emb@GRAD", rows,
+                                   emb_vals_for(rnd, rows, emb_w),
+                                   height=emb_h, param="emb")
         for vi, name in enumerate(names):
             client.send_grad(name + "@GRAD", grad_for(tid, rnd, vi),
                              round=rnd)
@@ -312,12 +569,24 @@ def run_trainer():
             if nshards > 1 and getattr(client, "map_version", 0):
                 extra = {"shard_map": {
                     "version": client.map_version,
-                    "overrides": dict(client.map_overrides)}}
+                    "overrides": dict(client.map_overrides),
+                    "ranges": {
+                        t: [list(r) for r in rs] for t, rs in
+                        getattr(client, "map_ranges", {}).items()}}}
             mgr.save_incremental(
                 rnd, {"state.npz": buf.getvalue(),
                       "ballast.bin": ballast_bytes},
                 fingerprints={"ballast.bin": "static-v1"},
                 extra=extra)
+
+    steer = None
+    if (mr and tid == 0 and start == 1
+            and os.environ.get("FT_STEER_RANGE") == "1"):
+        steer = _steer_rounds(client, one_round, rounds, emb_h,
+                              nshards, mr_base, mr_hot)
+    else:
+        for rnd in range(start, rounds + 1):
+            one_round(rnd)
 
     if nshards > 1:
         hbs = client.heartbeat_full()  # per shard, index-aligned
@@ -371,6 +640,16 @@ def run_trainer():
             "server_map_versions": [
                 (h.get("shard_map") or {}).get("version", 0)
                 for h in hbs],
+            # ISSUE 18 telemetry: the final sparse table as pulled
+            # through the (possibly range-split) router, the adopted
+            # per-range map, and trainer 0's steering summary
+            "emb": (np.asarray(client.pull_sparse(
+                "emb", np.arange(emb_h, dtype=np.int64),
+                height=emb_h)).tolist() if mr else None),
+            "map_ranges": ({t: [list(r) for r in rs] for t, rs in
+                            getattr(client, "map_ranges", {}).items()}
+                           if mr else None),
+            "steer": steer,
         }, f)
 
 

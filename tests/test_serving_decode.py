@@ -449,7 +449,13 @@ class TestFleetCostAdmission:
         watermark behavior is bit-compatible."""
         cfg = FleetConfig(max_queue=4, num_dispatchers=1,
                           health_interval_ms=10_000)
-        r = FleetRouter(["127.0.0.1:1"], cfg).start()
+        r = FleetRouter(["127.0.0.1:1"], cfg)
+        # admission accounting only: pin the queue by not draining it.
+        # With a live dispatcher the pop (which releases the popped
+        # request's unit) races the submits on a loaded box, and the
+        # watermark trip becomes scheduling-dependent
+        r._dispatch_loop = lambda: None
+        r.start()
         try:
             for i in range(2):  # low watermark = round(0.5*4) = 2
                 r.submit({"x": [1.0]}, cost_class="low",

@@ -1764,3 +1764,217 @@ def test_migration_install_failure_rolls_back(monkeypatch):
         c.close()
         for s, _ in servers:
             s.stop()
+
+
+# -- row-range live migration (ISSUE 18) -------------------------------------
+
+
+class _SparseMigExec(MiniExec):
+    def _write_var(self, scope, name, val):
+        scope[name] = val  # keep SelectedRows grads un-coerced
+
+
+def _sparse_sgd(scope):
+    g = scope["emb@GRAD"]
+    rows = np.asarray(g.rows(), dtype=np.int64)
+    vals = np.asarray(g._value)
+    emb = np.array(scope["emb"], copy=True)
+    emb[rows] -= np.float32(0.1) * vals  # row-local, like pslib sgd
+    scope["emb"] = emb
+
+
+def _range_factory(gname):
+    if gname.split("@", 1)[0] == "emb":
+        return _sparse_sgd
+    return _sgd_factory(gname)
+
+
+def _mk_range_fixture(monkeypatch, height=16, width=4, lease_ms=400):
+    """2 shards x (primary+backup): each shard holds its LOCAL slice
+    of a height-``height`` sparse table ``emb`` (global rows sliced by
+    ``row_range``) plus one dense var to drive the round barrier, the
+    block factory armed so a recipient can rebuild the sparse
+    optimize block for a range it adopts."""
+    from paddle_tpu.distributed.ps_rpc import PSServer
+    from paddle_tpu.distributed.ps_shard import (ShardedPSClient,
+                                                 row_range)
+
+    _fast_env(monkeypatch)
+    # the donor's migration client inherits the replication deadline
+    # captured at server construction: keep it tight so a blackholed
+    # install fails fast instead of stalling the apply
+    monkeypatch.setenv("PADDLE_PS_REPL_DEADLINE", "2")
+    names = _shard_var_names(2)
+    groups = [_eps(2), _eps(2)]
+    servers = []
+    for si, grp in enumerate(groups):
+        lo, hi = row_range(si, height, 2)
+        for ep in grp:
+            scope = MiniScope()
+            scope[names[si]] = np.zeros(4, dtype=np.float32)
+            scope["emb"] = (np.arange(lo, hi, dtype=np.float32)
+                            .reshape(-1, 1)
+                            * np.ones((1, width), "f4"))
+            g2b = {names[si] + "@GRAD": _sgd_factory(
+                names[si] + "@GRAD"), "emb@GRAD": _sparse_sgd}
+            s = PSServer(ep, _SparseMigExec(), scope, g2b, fanin=1,
+                         endpoints=grp, lease_ms=lease_ms, shard=si,
+                         block_factory=_range_factory)
+            s.start_background()
+            servers.append((s, scope))
+    c = ShardedPSClient([",".join(g) for g in groups], trainer_id=0)
+    return names, groups, servers, c
+
+
+def _emb_oracle(height, width):
+    return (np.arange(height, dtype=np.float32).reshape(-1, 1)
+            * np.ones((1, width), "f4"))
+
+
+def _push_round(c, oracle, rows, rnd, height, width):
+    """Push one deterministic grad per row through the router AND
+    fold it into the plain-numpy oracle (row-local sgd, lr 0.1)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    vals = (np.float32(0.01) * np.float32(rnd)
+            * (rows.astype(np.float32) + 1.0)[:, None]
+            * np.ones((1, width), "f4"))
+    c.push_sparse("emb@GRAD", rows, vals, height=height, param="emb")
+    oracle[rows] = oracle[rows] - np.float32(0.1) * vals
+
+
+def test_range_migration_end_to_end(monkeypatch):
+    """Move GLOBAL rows [4, 8) of a sliced sparse table from shard 0
+    to shard 1 mid-training: the map grows a per-range entry, moved
+    rows re-base to recipient-LOCAL ids past its resident slice,
+    pushes keep landing exactly once on both sides of the split, the
+    donor's slice is zero-tombstoned after the replicated commit, and
+    a fresh version-0 client self-repairs via wrong_shard."""
+    from paddle_tpu.distributed.ps_shard import ShardedPSClient
+
+    height, width = 16, 4
+    names, groups, servers, c = _mk_range_fixture(
+        monkeypatch, height=height, width=width)
+    oracle = _emb_oracle(height, width)
+    all_rows = np.arange(height, dtype=np.int64)
+    rounds = 6
+    try:
+        for rnd in range(1, rounds + 1):
+            _push_round(c, oracle, all_rows, rnd, height, width)
+            for vi, n in enumerate(names):
+                c.send_grad(n + "@GRAD", _grad(0, rnd) + vi,
+                            round=rnd)
+            c.send_barrier(round=rnd)
+            c.fetch_barrier()
+            if rnd == 2:
+                r = c.migrate_range("emb", 4, 8, to_shard=1,
+                                    height=height)
+                assert r.get("pending"), r
+        assert c.map_version >= 1
+        assert c.map_ranges.get("emb") == [(4, 8, 1, 8)]
+        got = c.pull_sparse("emb", all_rows, height=height)
+        assert got.tobytes() == oracle.tobytes()
+        # donor hard-committed: moved local rows [4, 8) are a zero
+        # tombstone on the primary AND (via the dirty-dense stream)
+        # its backup
+        for srv, sc in servers[:2]:
+            np.testing.assert_array_equal(
+                np.asarray(sc["emb"])[4:8], np.zeros((4, width), "f4"))
+        # recipient family grew to local height 12 on primary+backup
+        assert np.asarray(servers[2][1]["emb"]).shape[0] == 12
+        assert np.asarray(servers[3][1]["emb"]).shape[0] == 12
+        # a fresh hash-routed client self-repairs via wrong_shard
+        c2 = ShardedPSClient([",".join(g) for g in groups],
+                             trainer_id=1)
+        got2 = c2.pull_sparse("emb", [5, 4, 7, 1, 12], height=height)
+        assert got2.tobytes() == oracle[[5, 4, 7, 1, 12]].tobytes()
+        assert c2.map_version >= 1
+        c2.close()
+    finally:
+        c.close()
+        for s, _ in servers:
+            s.stop()
+
+
+def test_range_migration_partition_aborts_cleanly(monkeypatch):
+    """An active ``partition:1:donor|recipient`` blackhole between the
+    donor and recipient primaries while trainers keep pushing rows on
+    both sides of the split point: bounded install retries, then
+    ROLLBACK — no override anywhere, no orphan stage servable, zero
+    lost or double-applied rows — and the same move succeeds once the
+    partition heals."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import fault
+
+    height, width = 16, 4
+    # a blackholed install stalls the donor's apply for the (tight)
+    # replication deadline each round: keep the lease comfortably
+    # above it so the test exercises the abort path, not elections
+    names, groups, servers, c = _mk_range_fixture(
+        monkeypatch, height=height, width=width, lease_ms=15000)
+    oracle = _emb_oracle(height, width)
+    all_rows = np.arange(height, dtype=np.int64)
+    donor_rows = np.arange(8, dtype=np.int64)  # both sides of lo=4
+    rb0 = obs.counter_value("ps.migrations", outcome="rollback") or 0
+    prev_ident = fault.get_identity()
+    try:
+        # the partition rule severs traffic from THIS identity to the
+        # named peer: stand in the donor primary's shoes
+        fault.set_identity(groups[0][0])
+        for rnd in (1, 2):
+            _push_round(c, oracle, all_rows, rnd, height, width)
+            for vi, n in enumerate(names):
+                c.send_grad(n + "@GRAD", _grad(0, rnd) + vi,
+                            round=rnd)
+            c.send_barrier(round=rnd)
+            c.fetch_barrier()
+        monkeypatch.setenv("PADDLE_TPU_FAULTS", "partition:1:%s|%s"
+                           % (groups[0][0], groups[1][0]))
+        fault.reset_injector()
+        # the migration client is created lazily at the first install
+        # attempt — no in-client retries and no lease-wait loitering
+        # on the recipient backup's not_primary hint: the round
+        # barrier already re-drives the install
+        monkeypatch.setenv("PADDLE_PS_REPL_RETRIES", "0")
+        monkeypatch.setenv("PADDLE_PS_LEASE_WAIT_S", "1")
+        monkeypatch.setenv("PADDLE_PS_FAILOVER_MAX", "1")
+        r = c.migrate_range("emb", 4, 8, to_shard=1, height=height)
+        assert r.get("pending"), r
+        # shard-0-only rounds while the pair is severed (the full
+        # barrier would cross the blackhole): every install attempt
+        # dies on the wire, then the donor rolls back
+        for rnd in range(3, 7):
+            _push_round(c, oracle, donor_rows, rnd, height, width)
+            c.send_grad(names[0] + "@GRAD", _grad(0, rnd), round=rnd)
+            c.shards[0].barrier_prepare(round=rnd)
+            c.shards[0].barrier_commit()
+            c.shards[0].fetch_barrier()
+        assert (obs.counter_value("ps.migrations", outcome="rollback")
+                or 0) > rb0
+        assert servers[0][0]._shard_map_version == 0
+        assert not servers[0][0]._range_overrides
+        assert "emb" not in servers[2][0]._staged_ranges
+        assert c.map_version == 0 and not c.map_ranges
+        monkeypatch.delenv("PADDLE_TPU_FAULTS")
+        fault.reset_injector()
+        # healed: rows all land exactly once, and the SAME move now
+        # completes through the real protocol
+        for rnd in (7, 8):
+            _push_round(c, oracle, all_rows, rnd, height, width)
+            for vi, n in enumerate(names):
+                c.send_grad(n + "@GRAD", _grad(0, rnd) + vi,
+                            round=rnd)
+            c.send_barrier(round=rnd)
+            c.fetch_barrier()
+            if rnd == 7:
+                assert c.migrate_range("emb", 4, 8, to_shard=1,
+                                       height=height).get("pending")
+        assert c.map_ranges.get("emb") == [(4, 8, 1, 8)]
+        got = c.pull_sparse("emb", all_rows, height=height)
+        assert got.tobytes() == oracle.tobytes()
+    finally:
+        monkeypatch.delenv("PADDLE_TPU_FAULTS", raising=False)
+        fault.reset_injector()
+        fault.set_identity(prev_ident)
+        c.close()
+        for s, _ in servers:
+            s.stop()

@@ -153,6 +153,17 @@ def _self_test():
     rbad = [r for r in diff_counters(r0, r1, 0.25) if r[-1]]
     assert rbad and rbad[0][0].startswith("ps.replication_bytes"), rbad
     assert not any(r[-1] for r in diff_counters(r0, r0, 0.25))
+    # a regression from row-range moves back to whole-var moves (the
+    # cold 99% of the table riding a migration again) must flag via
+    # the kind=var series — the kind=range series holding steady for
+    # the same drilled workload must not
+    v0 = {"totals": {"ps.migration_bytes{kind=range}": 2048,
+                     "ps.migration_bytes{kind=var}": 0}}
+    v1 = {"totals": {"ps.migration_bytes{kind=range}": 2048,
+                     "ps.migration_bytes{kind=var}": 262144}}
+    vbad = [r for r in diff_counters(v0, v1, 0.25) if r[-1]]
+    assert vbad and vbad[0][0] == "ps.migration_bytes{kind=var}", vbad
+    assert not any(r[-1] for r in diff_counters(v0, v0, 0.25))
     # profile-block metrics: an overlap_frac / mfu_est drop past the
     # threshold is a regression even when raw throughput held
     p0 = {"configs": {"w": {"tokens_per_sec": 100.0, "profile": {

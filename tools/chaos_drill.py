@@ -62,6 +62,28 @@ ISSUE 13 modes:
   agreeing, ``ps.stale_rounds`` > 0 and eviction + readmission in the
   merged counters.
 
+ISSUE 18 mode:
+
+- ``--migrate-range`` (requires ``--shards 2``) — the SELF-STEERED
+  row-range rebalance under fire: trainers hammer the hot quarter of
+  one shard's slice of a sparse table; trainer 0's SteeringDaemon
+  watches the job's own merged ``ps.row_heat`` census, proposes a
+  ``migrate_range`` plan at the skew breach, and the canary applies
+  it through the LIVE protocol — during which the donor primary is
+  SIGKILLed in the worst spot (rows staged on the recipient, nothing
+  committed — ``PADDLE_PS_CHAOS_DIE_AFTER_INSTALL``), so attempt 1
+  dies with the donor and the re-trigger completes on its promoted
+  backup. Gated on exit 0; the sparse table bit-for-bit vs the pure
+  push-schedule oracle on BOTH trainers; the plan carving a tail of
+  the hot quarter; install < kill < promotion < replicated range-commit
+  in the merged trace; ``ps.migration_bytes{kind=range}`` > 0; every
+  trainer routing the moved rows to the recipient; and the full
+  audit chain (proposal artifact, audit trail, active-plan pointer,
+  ``steering.proposed`` < ``canary.promoted`` flight order) with
+  bit-equal plan digests end to end. No trainer kill rides this mode
+  (the fire is the donor kill + live steering); witness + clock
+  jitter ride as in ``--migrate``.
+
 The schedule is a pure function of the seed (``make_schedule``), so a
 failing drill replays exactly: rerun with the printed seed.
 
@@ -110,7 +132,8 @@ def _free_port() -> int:
 
 def make_schedule(seed: int, sync_rounds: int = 6, shards: int = 1,
                   partition: bool = False, migrate: bool = False,
-                  evict: bool = False) -> dict:
+                  evict: bool = False,
+                  migrate_range: bool = False) -> dict:
     """The randomized fault schedule as a pure function of the seed —
     two calls with the same args MUST return the same dict (asserted
     by tests/test_fault_tolerance.py and test_survivable_ps.py). The
@@ -158,6 +181,16 @@ def make_schedule(seed: int, sync_rounds: int = 6, shards: int = 1,
             sched["trainer_kill_round"],
             max(1, int(sync_rounds) - 2))
         sched["evict_shard"] = 1
+    sched["migrate_range"] = bool(migrate_range)
+    if sched["migrate_range"]:
+        # draws appended AFTER every legacy draw: old schedules replay
+        # identically. The donor is the die_shard draw (its primary is
+        # the one CHAOS_DIE_AFTER_INSTALL kills); the steerer must
+        # independently re-derive it from the row-heat census.
+        sched["mr_base_round"] = rng.randint(2, 3)
+        sched["mr_hot_shard"] = sched["die_shard"]
+        sched["mr_to_shard"] = ((sched["die_shard"] + 1)
+                                % sched["shards"])
     return sched
 
 
@@ -184,8 +217,8 @@ def _env(sched: dict, tmp: str, eps: list) -> dict:
         # hard both-ways partition between that shard's primary and
         # backup for the WHOLE run: the backup must never win quorum
         plan = "%s,partition:1:%s|%s" % (plan, pg[0], pg[1])
-    if sched.get("migrate"):
-        # jittered clocks ride the migration drill: the lease/quorum
+    if sched.get("migrate") or sched.get("migrate_range"):
+        # jittered clocks ride the migration drills: the lease/quorum
         # machinery must keep exactly one writable primary per shard
         # while every participant's timers wander
         plan = "%s,clock_jitter:0.3:300" % plan
@@ -260,6 +293,26 @@ def _env(sched: dict, tmp: str, eps: list) -> dict:
             "PADDLE_PS_CHAOS_DIE_AFTER_INSTALL":
                 groups[sched["migrate_from"]][0],
         })
+    if sched.get("migrate_range"):
+        groups = _groups(sched, eps)
+        env.update({
+            # no round-counted server suicide and NO trainer kill:
+            # this drill's fire is the donor-primary kill mid-install
+            # plus the live steering chain (sparse-push exactly-once
+            # across a TRAINER relaunch is a separate, future drill)
+            "FT_SERVER_DIE_AT_ROUND": "0",
+            "FT_DIE_AT_ROUND": "0",
+            "FT_MIGRATE_RANGE": "1",
+            "FT_STEER_RANGE": "1",
+            "FT_MR_BASE_ROUND": str(sched["mr_base_round"]),
+            "FT_MR_HOT_SHARD": str(sched["mr_hot_shard"]),
+            # the donor's INITIAL primary dies between staging the
+            # rows on the recipient and committing anything — the
+            # worst spot; the canary's re-trigger completes on its
+            # promoted backup
+            "PADDLE_PS_CHAOS_DIE_AFTER_INSTALL":
+                groups[sched["mr_hot_shard"]][0],
+        })
     if sched.get("evict"):
         env.update({
             "FT_SERVER_DIE_AT_ROUND": "0",
@@ -277,12 +330,16 @@ def _env(sched: dict, tmp: str, eps: list) -> dict:
 
 def _rerun_hint(sched: dict) -> str:
     return ("tools/chaos_drill.py --seed %d --sync-rounds %d"
-            "%s%s%s%s" % (sched["seed"], sched["sync_rounds"],
-                          " --shards %d" % sched["shards"]
-                          if sched["shards"] > 1 else "",
-                          " --partition" if sched["partition"] else "",
-                          " --migrate" if sched.get("migrate") else "",
-                          " --evict" if sched.get("evict") else ""))
+            "%s%s%s%s%s" % (sched["seed"], sched["sync_rounds"],
+                            " --shards %d" % sched["shards"]
+                            if sched["shards"] > 1 else "",
+                            " --partition" if sched["partition"]
+                            else "",
+                            " --migrate" if sched.get("migrate")
+                            else "",
+                            " --evict" if sched.get("evict") else "",
+                            " --migrate-range"
+                            if sched.get("migrate_range") else ""))
 
 
 def oracle_w_skipping(rounds: int, var: int, skip_tid: int,
@@ -320,8 +377,8 @@ def run_drill(sched: dict) -> int:
         "--pserver_shards=%d" % sched["shards"],
         "--pserver_endpoints=%s" % ",".join(eps)]
     witness_ep = None
-    if sched.get("migrate"):
-        # the migration drill runs with an external quorum witness:
+    if sched.get("migrate") or sched.get("migrate_range"):
+        # the migration drills run with an external quorum witness:
         # the donor-kill election must gather a real witness grant
         witness_ep = "127.0.0.1:%d" % _free_port()
         launch_args.append("--ps_witness_endpoints=%s" % witness_ep)
@@ -373,8 +430,29 @@ def run_drill(sched: dict) -> int:
         print("[chaos] %s: trainers agree bit-for-bit post-eviction"
               % ("PASS" if agree else "FAIL"))
         ok = ok and agree
+    if sched.get("migrate_range"):
+        # the sparse table, pulled through the (now range-split)
+        # router, must match the pure push-schedule oracle on BOTH
+        # trainers — exactly-once across the donor kill, the staged
+        # install that died with it, and every wrong_shard redirect
+        from dist_worker_ft import emb_oracle
+
+        exp = emb_oracle(sched["sync_rounds"],
+                         sched["mr_base_round"], 16, 4,
+                         sched["shards"], sched["mr_hot_shard"])
+        for tid in (0, 1):
+            got = np.asarray(outs[tid].get("emb"), dtype=np.float32)
+            bitwise = got.tobytes() == exp.tobytes()
+            print("[chaos] %s: trainer %d sparse table emb %s the "
+                  "push-schedule oracle" % (
+                      "PASS" if bitwise else "FAIL", tid,
+                      "matches" if bitwise else "DIVERGES FROM"))
+            ok = ok and bitwise
     mdir = os.path.join(tmp, "metrics")
-    if sched.get("migrate"):
+    if sched.get("migrate_range"):
+        ok = check_migrate_range_telemetry(sched, mdir, eps,
+                                           outs) and ok
+    elif sched.get("migrate"):
         ok = check_migrate_telemetry(sched, mdir, eps, outs) and ok
     elif sched.get("evict"):
         ok = check_evict_telemetry(sched, mdir) and ok
@@ -616,6 +694,162 @@ def check_migrate_telemetry(sched: dict, mdir: str, eps: list,
     return ok
 
 
+def check_migrate_range_telemetry(sched: dict, mdir: str, eps: list,
+                                  outs: dict) -> bool:
+    """The --migrate-range gate: the steering chain (skew breach ->
+    proposal carving the hot quarter's tail -> canary -> promotion)
+    must be AUDITED end to end with bit-equal plan digests, and the
+    protocol chain (install staged on the recipient < donor-primary
+    SIGKILL < promotion < replicated range commit) must read in
+    causal order in the merged trace, with range bytes on the range
+    counter and every trainer routing the moved rows to the
+    recipient."""
+    from paddle_tpu.distributed.ps_shard import row_range
+    from paddle_tpu.observability import ps_steering
+    from paddle_tpu.observability.canary import AuditTrail, PlanStore
+
+    ok = True
+
+    def chk(what, passed):
+        nonlocal ok
+        print("[chaos] %s: %s" % ("PASS" if passed else "FAIL", what))
+        ok = ok and passed
+
+    merged, events = _load_merged(mdir)
+    chk("job-level metrics.json + trace.json merged",
+        merged is not None)
+    if not ok:
+        return False
+    totals = merged["counters_total"]
+    groups = _groups(sched, eps)
+    donor = set(groups[sched["mr_hot_shard"]])
+
+    # -- the steering chain, audited end to end ------------------------
+    steer = outs[0].get("steer") or {}
+    chk("trainer 0's steering driver reported no error (%s)"
+        % steer.get("error"), steer.get("error") is None)
+    chk("the daemon proposed off the row-heat skew (digest %s)"
+        % steer.get("proposed"), bool(steer.get("proposed")))
+    chk("the canary PROMOTED the plan (decision=%s)"
+        % steer.get("decision"), steer.get("promoted") is True)
+    plan = steer.get("plan") or {}
+    span_lo, span_hi = row_range(sched["mr_hot_shard"], 16,
+                                 sched["shards"])
+    hot_lo = span_lo + 3 * (span_hi - span_lo) // 4
+    # the plan must carve a non-empty TAIL of the hot quarter off the
+    # hot shard. It is NOT required to be the whole quarter: with the
+    # fanin-2 barrier, the run-ahead trainer lands its next round's
+    # hot pushes before blocking, so at poll time its parity's hot row
+    # can carry one extra round of heat and the steerer honestly
+    # isolates the hottest suffix ([15,16) instead of [14,16))
+    chk("the plan moves a tail of the hot quarter [%d, %d) of shard "
+        "%d -> shard %d (got %s)" % (hot_lo, span_hi,
+                                     sched["mr_hot_shard"],
+                                     sched["mr_to_shard"],
+                                     {k: plan.get(k) for k in
+                                      ("lo", "hi", "from_shard",
+                                       "to_shard", "by")}),
+        plan.get("hi") == span_hi
+        and hot_lo <= (plan.get("lo") if plan.get("lo") is not None
+                       else -1) < span_hi
+        and plan.get("from_shard") == sched["mr_hot_shard"]
+        and plan.get("to_shard") == sched["mr_to_shard"]
+        and plan.get("by") == "row_heat")
+    if not ok:
+        return False
+    steer_dir = os.path.join(mdir, "steering")
+    prop_path = os.path.join(
+        steer_dir, "proposed-%s.json" % ps_steering.STEERER_NAME)
+    art = (json.load(open(prop_path))
+           if os.path.exists(prop_path) else {})
+    chk("proposal artifact on disk with the SAME digest",
+        art.get("plan_digest") == steer.get("proposed"))
+    trail = AuditTrail(steer_dir).entries()
+    promoted_entries = [e for e in trail
+                        if e.get("decision") == "promoted"]
+    chk("audit trail records the promotion (%d entries)" % len(trail),
+        len(promoted_entries) == 1
+        and promoted_entries[-1].get("plan_digest")
+        == steer.get("proposed"))
+    active = PlanStore(steer_dir,
+                       ps_steering.STEERER_NAME).active_digest()
+    chk("active-plan pointer bit-matches the promoted digest",
+        active == steer.get("proposed"))
+    proposed_ev = [e for e in events
+                   if e["kind"] == "steering.proposed"]
+    promoted_ev = [e for e in events
+                   if e["kind"] == "canary.promoted"]
+    chk("steering.proposed and canary.promoted flights in the merged "
+        "timeline, in order",
+        bool(proposed_ev) and bool(promoted_ev)
+        and min(e["t_us"] for e in proposed_ev)
+        < min(e["t_us"] for e in promoted_ev))
+    digests = {e["fields"].get("plan_digest") for e in promoted_ev}
+    chk("promotion flight carries the same plan digest",
+        digests == {steer.get("proposed")})
+
+    # -- the protocol chain under the kill -----------------------------
+    kill = next((e for e in events if e["kind"] == "launch.exit"
+                 and e["fields"].get("role") == "pserver"
+                 and e["fields"].get("signal") == 9), None)
+    installs = [e for e in events
+                if e["kind"] == "ps.range_migration_install"]
+    commits = [e for e in events
+               if e["kind"] == "ps.range_migration_committed"]
+    promo = next((e for e in events if e["kind"] == "ps.promotion"
+                  and e["fields"].get("endpoint") in donor), None)
+    chk("supervisor observed the donor primary's SIGKILL",
+        kill is not None)
+    chk("rows staged on the recipient (%d install events)"
+        % len(installs), len(installs) >= 1)
+    chk("the donor shard's backup was promoted", promo is not None)
+    chk("the re-triggered range migration COMMITTED (%d commits)"
+        % len(commits), len(commits) >= 1)
+    if not ok:
+        return False
+    first_install = min(installs, key=lambda e: e["t_us"])
+    commit = min(commits, key=lambda e: e["t_us"])
+    chk("attempt 1's rows reached the recipient before the kill "
+        "(install < kill)", first_install["t_us"] < kill["t_us"])
+    chk("attempt 1 never committed (kill < first commit)",
+        kill["t_us"] < commit["t_us"])
+    chk("causal chain: kill < promotion < range commit",
+        kill["t_us"] < promo["t_us"] < commit["t_us"])
+    range_bytes = sum(
+        v for k, v in totals.items()
+        if k.startswith("ps.migration_bytes") and "kind=range" in k)
+    chk("range bytes on the range counter (%d)" % range_bytes,
+        range_bytes > 0)
+
+    # -- every trainer routes the moved rows to the recipient ----------
+    for tid, r in outs.items():
+        ranges = (r.get("map_ranges") or {}).get("emb") or []
+        chk("trainer %d adopted map v%s with emb rows [%d, %d) on "
+            "shard %d (%s)" % (tid, r.get("map_version"),
+                               plan.get("lo"), plan.get("hi"),
+                               sched["mr_to_shard"], ranges),
+            int(r.get("map_version") or 0) >= 1
+            and any(rr[0] == plan.get("lo") and rr[1] == plan.get("hi")
+                    and rr[2] == sched["mr_to_shard"]
+                    for rr in ranges))
+
+    # -- the riders: witness, jitter, no lost rounds -------------------
+    n_votes = sum(v for k, v in totals.items()
+                  if k.startswith("ps.witness_votes"))
+    chk("witness voted in the election (%d votes)" % n_votes,
+        n_votes >= 1)
+    n_jit = sum(v for k, v in totals.items()
+                if k.startswith("fault.injected")
+                and "clock_jitter" in k)
+    chk("clock jitter was injected (%d events)" % n_jit, n_jit >= 1)
+    final = [e for e in events if e["kind"] == "ps.round_applied"
+             and e["fields"].get("round") == sched["sync_rounds"]]
+    chk("final round %d applied on every shard (%d appliers)"
+        % (sched["sync_rounds"], len(final)),
+        len(final) >= sched["shards"])
+    return ok
+
+
 def check_evict_telemetry(sched: dict, mdir: str) -> bool:
     """The --evict gate: the disagreeing-fanin round must show an
     eviction AND a readmission AND stale-round drops (the guard that
@@ -674,6 +908,14 @@ def main() -> int:
                          "fanin disagrees mid-round; gated on "
                          "deterministic reconciliation (requires "
                          "--shards >= 2)")
+    ap.add_argument("--migrate-range", action="store_true",
+                    dest="migrate_range",
+                    help="self-steered row-range rebalance drill: the "
+                         "job's own SteeringDaemon proposes the move "
+                         "off the row-heat census and the canary "
+                         "applies it live while the donor primary is "
+                         "SIGKILLed mid-install (requires --shards 2 "
+                         "and --sync-rounds >= 18)")
     ap.add_argument("--seed", type=int,
                     default=int(os.environ.get("PADDLE_TPU_FAULT_SEED",
                                                "1234")),
@@ -682,18 +924,29 @@ def main() -> int:
     if args.partition and args.shards < 2:
         ap.error("--partition needs --shards >= 2 (the partitioned "
                  "pair must belong to a shard that keeps training)")
-    if (args.migrate or args.evict) and args.shards < 2:
-        ap.error("--migrate/--evict need --shards >= 2 (the range "
-                 "moves — or the fanin disagrees — between groups)")
+    if (args.migrate or args.evict or args.migrate_range) \
+            and args.shards < 2:
+        ap.error("--migrate/--evict/--migrate-range need --shards >= "
+                 "2 (the range moves — or the fanin disagrees — "
+                 "between groups)")
     if args.migrate and args.partition:
         ap.error("--migrate and --partition are separate drills")
+    if args.migrate_range and (args.migrate or args.evict
+                               or args.partition):
+        ap.error("--migrate-range is its own drill (the steering "
+                 "chain owns the fault injection points)")
+    if args.migrate_range and args.sync_rounds < 18:
+        ap.error("--migrate-range needs --sync-rounds >= 18 (worst "
+                 "case: 3 balanced + 3 hot + 3 incumbent + 6 apply + "
+                 "3 measure rounds)")
     rc = 0
     for i in range(args.rounds):
         rc |= run_drill(make_schedule(args.seed + i, args.sync_rounds,
                                       shards=args.shards,
                                       partition=args.partition,
                                       migrate=args.migrate,
-                                      evict=args.evict))
+                                      evict=args.evict,
+                                      migrate_range=args.migrate_range))
     if rc == 0:
         print("[chaos] ALL %d DRILL(S) PASS" % args.rounds)
     return rc
