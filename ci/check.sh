@@ -186,7 +186,22 @@ python tools/ft_smoke.py --server-kill
 # processes; delta replication ran with its bytes strictly below the
 # full anchors'); a failure prints the seed that replays it
 python tools/chaos_drill.py --rounds 1
-# 6e: ISSUE-8 acceptance drill — 2 key-range shards x (primary +
+# 6e: ISSUE-19 acceptance drill (~2x2min) — WHOLE-JOB CRASH
+# consistency: two seeded schedules each SIGKILL every process
+# (launcher, trainers, every pserver — the process group dies) at a
+# seeded durable round, relaunch the IDENTICAL command from the
+# durable store, and gate on final params bit-for-bit vs the
+# uninterrupted oracle PLUS the kill -> cold-start (restore_round at
+# the newest globally-complete cut) -> per-shard restore-at-the-cut
+# -> first-applied-round == cut+1 causal chain in the merged
+# cross-incarnation trace.json (stale re-sends from the dead
+# incarnation dropped, never re-applied)
+python tools/chaos_drill.py --rounds 2 --total-loss --shards 2
+# ... and the torn-tail variant: the newest durable round is torn on
+# disk between kill and relaunch — restore must fall back exactly one
+# globally-complete round and still land bit-for-bit
+python tools/chaos_drill.py --rounds 1 --total-loss --corrupt-newest --shards 2
+# 6f: ISSUE-8 acceptance drill — 2 key-range shards x (primary +
 # backup), the schedule's shard loses its primary to SIGKILL (lease
 # expiry -> tombstone-quorum election -> promotion) while the OTHER
 # shard's primary<->backup pair is network-partitioned for the whole
@@ -196,7 +211,7 @@ python tools/chaos_drill.py --rounds 1
 # ps.replication_bytes{mode=delta} strictly below the full-anchor
 # bytes in the merged job metrics.json
 python tools/chaos_drill.py --rounds 1 --shards 2 --partition
-# 6f: ISSUE-13 acceptance drill (~45s) — LIVE KEY-RANGE MIGRATION
+# 6g: ISSUE-13 acceptance drill (~45s) — LIVE KEY-RANGE MIGRATION
 # under fire: a seeded schedule migrates one shard's var to the
 # sister shard mid-training, the donor primary is SIGKILLed in the
 # worst spot (range installed on the recipient, nothing committed or
@@ -207,7 +222,7 @@ python tools/chaos_drill.py --rounds 1 --shards 2 --partition
 # external-witness votes in the election, and clock-jitter chaos
 # armed throughout
 python tools/chaos_drill.py --rounds 1 --shards 2 --migrate
-# 6g: ISSUE-18 acceptance drill (~90s) — SELF-STEERED row-range
+# 6h: ISSUE-18 acceptance drill (~90s) — SELF-STEERED row-range
 # rebalance under fire: trainers hammer the hot quarter of one
 # shard's slice of a sparse row-partitioned table, trainer 0's
 # SteeringDaemon watches the job's own merged ps.row_heat census,
@@ -225,7 +240,7 @@ python tools/chaos_drill.py --rounds 1 --shards 2 --migrate
 # artifact, audit trail, active-plan pointer, flight order) with
 # bit-equal plan digests end to end
 python tools/chaos_drill.py --rounds 1 --shards 2 --migrate-range --sync-rounds 18
-# 6h: sharded eviction drill (~30s) — per-shard effective fanin
+# 6i: sharded eviction drill (~30s) — per-shard effective fanin
 # disagreeing mid-round (the dying trainer's phase-1 barrier reaches
 # shard 0 only; eviction armed on shard 1 alone): the two-phase
 # barrier + the stale-round guard must reconcile DETERMINISTICALLY

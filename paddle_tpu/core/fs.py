@@ -76,6 +76,11 @@ class LocalFS:
                 raise FileExistsError(dst)
             self.delete(dst)
         os.replace(src, dst)
+        # rename alone survives process death, not host crash: the new
+        # dirent lives in the parent's page cache until it is synced
+        from ..checkpoint import _fsync_dir
+
+        _fsync_dir(os.path.dirname(os.path.abspath(dst)))
         return True
 
     def makedirs(self, path) -> bool:

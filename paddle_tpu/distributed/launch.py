@@ -69,6 +69,20 @@ dump into one job-level ``metrics.json`` and one merged chrome-trace
 child contributes its last periodic dump; the supervisor's flight ring
 contributes the kill itself (``launch.exit`` with the signal).
 
+Whole-job crash consistency (ISSUE 19): ``--ps_durable_dir=ROOT``
+makes every shard primary tee its applied rounds to
+``ROOT/shard-<k>/round-<n>/`` (delta frames riding the replication
+machinery) and the launcher keep ``ROOT/job.json`` (incarnation
+counter + restore cut). A relaunch over a populated root — or an
+explicit ``--restore`` — is a COLD RESTART: the launcher computes the
+newest round present on *every* shard, exports
+``PADDLE_PS_RESTORE=1`` / ``PADDLE_PS_RESTORE_ROUND`` so servers load
+exactly that cut and re-arm their fencing epochs past the dead
+incarnation, and ``PADDLE_PS_RESTORE_ROUND`` to trainers so their
+checkpoint resume clamps to the job cut. ``PADDLE_INCARNATION``
+stamps every telemetry dump; the dead incarnation's dumps are KEPT
+(postmortem evidence), never mixed into the new merge.
+
 Usage:  python -m paddle_tpu.distributed.launch --nproc_per_node=2 \
             [--max_restarts=3] \
             [--server_script=serve.py --pserver_endpoints=ep0,ep1] \
@@ -118,6 +132,19 @@ def _parse_args(argv=None):
                         "contiguous primary+backup groups (key-range "
                         "sharded PS; endpoint count must divide "
                         "evenly)")
+    p.add_argument("--ps_durable_dir",
+                   default=os.environ.get("PADDLE_PS_DURABLE_DIR", ""),
+                   help="root directory for round-fenced durable PS "
+                        "snapshots (ISSUE 19): every shard primary "
+                        "tees its applied rounds here, and a cold "
+                        "restart resumes from the newest round present "
+                        "on EVERY shard")
+    p.add_argument("--restore", action="store_true",
+                   help="force cold-restart resume from "
+                        "--ps_durable_dir (restore is AUTO-detected "
+                        "when the durable dir holds round frames; this "
+                        "flag additionally makes an empty/unrestorable "
+                        "dir a hard error instead of a fresh start)")
     p.add_argument("--ps_witness_endpoints", default="",
                    help="comma-separated external quorum-witness "
                         "endpoints (ISSUE 13): one witness process "
@@ -298,6 +325,43 @@ def launch(args=None):
     node_ips = [ip for ip in args.ips.split(",") if ip]
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
+    pserver_eps = [e.strip() for e in args.pserver_endpoints.split(",")
+                   if e.strip()]
+    nshards = max(1, int(getattr(args, "pserver_shards", 1)))
+    # -- whole-job crash consistency (ISSUE 19) ---------------------------
+    # With a durable root armed, decide BEFORE anything spawns whether
+    # this launch is a fresh start or a cold restart: compute the
+    # restore cut (the newest round restorable on EVERY shard — never
+    # a mixed one), bump the incarnation counter in job.json, and pin
+    # both into the children's env. PADDLE_INCARNATION also stamps
+    # every telemetry dump, so a restored job's metrics never mix with
+    # the dead incarnation's.
+    durable_root = (getattr(args, "ps_durable_dir", "") or "").strip()
+    incarnation = 0
+    restore_round = None
+    if durable_root and pserver_eps:
+        from .. import checkpoint as _ckpt
+
+        prev = _ckpt.read_job_manifest(durable_root)
+        if getattr(args, "restore", False) \
+                or _ckpt.job_has_durable_state(durable_root):
+            # raises the typed RestoreMissingShard when a shard group
+            # has no usable rounds — a partial restore must be loud
+            restore_round = _ckpt.job_restore_round(durable_root,
+                                                    nshards)
+        incarnation = int(prev.get("incarnation", -1)) + 1
+        _ckpt.write_job_manifest(durable_root, {
+            "incarnation": incarnation,
+            "restore_round": restore_round,
+            "shards": nshards,
+            "endpoints": pserver_eps})
+        # inherited by every child env (dict(os.environ) below) and by
+        # the launcher's own telemetry identity
+        os.environ["PADDLE_INCARNATION"] = str(incarnation)
+        if restore_round is not None:
+            _log("cold restart: incarnation %d resumes from durable "
+                 "round %d (%s)"
+                 % (incarnation, restore_round, durable_root))
     metrics_dir = _dobs.metrics_dir()
     if metrics_dir:
         # the supervisor is a dumping process too (role "launcher"),
@@ -305,11 +369,23 @@ def launch(args=None):
         # previous incarnation's dumps would "see" processes that were
         # never part of this job
         _dobs.set_identity("launcher", args.node_rank)
-        removed = _dobs.clear_stale_dumps(metrics_dir)
-        if removed:
-            _log("cleared %d stale dump(s) from %s"
-                 % (removed, metrics_dir))
+        if restore_round is None:
+            removed = _dobs.clear_stale_dumps(metrics_dir)
+            if removed:
+                _log("cleared %d stale dump(s) from %s"
+                     % (removed, metrics_dir))
+        else:
+            # a cold restart KEEPS the dead incarnation's dumps: they
+            # are the postmortem evidence of the kill, and this
+            # incarnation's dumps carry a .i<n> suffix + incarnation
+            # stamp so the merge never mixes the two
+            _log("restore: keeping the dead incarnation's telemetry "
+                 "dumps in %s" % metrics_dir)
         _dobs.arm(metrics_dir)
+        if restore_round is not None:
+            _flight.record("launch.cold_start", incarnation=incarnation,
+                           restore_round=restore_round,
+                           shards=nshards)
         # one job trace id, minted before the worker envs are copied
         # from os.environ: every rank derives identical per-round span
         # context from it (distributed.fleet_round_args), so a dp sync
@@ -319,8 +395,6 @@ def launch(args=None):
     # checkout (script-dir sys.path[0] replaces the launcher's cwd)
     pkg_root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
-    pserver_eps = [e.strip() for e in args.pserver_endpoints.split(",")
-                   if e.strip()]
     if pserver_eps and not args.server_script:
         raise SystemExit("--pserver_endpoints requires --server_script")
     witness_eps = [e.strip() for e in
@@ -344,7 +418,6 @@ def launch(args=None):
     if n_serving and len(serving_eps) != n_serving:
         raise SystemExit("--serving_endpoints names %d endpoint(s) for "
                          "%d replicas" % (len(serving_eps), n_serving))
-    nshards = max(1, int(getattr(args, "pserver_shards", 1)))
     shard_groups = [pserver_eps]
     if pserver_eps and nshards > 1:
         from .ps_shard import split_endpoint_groups
@@ -367,6 +440,11 @@ def launch(args=None):
         if pserver_eps:
             env["PADDLE_PSERVER_ENDPOINTS"] = ",".join(pserver_eps)
             env["PADDLE_PSERVER_SHARDS"] = str(nshards)
+        if restore_round is not None:
+            # trainers clamp their checkpoint resume to the job cut
+            # (CheckpointManager.load_at_or_before): a trainer ckpt
+            # can be AHEAD of the cut after a corrupt-newest fallback
+            env["PADDLE_PS_RESTORE_ROUND"] = str(restore_round)
         if serving_eps:
             # the traffic driver builds its FleetRouter from this
             env["PADDLE_SERVING_ENDPOINTS"] = ",".join(serving_eps)
@@ -404,6 +482,16 @@ def launch(args=None):
                 "PSERVER_ENDPOINT": ep,
                 "PADDLE_TRAINERS_NUM": str(nranks),
             })
+            if durable_root:
+                # round-fenced durable snapshots (ISSUE 19): every
+                # group member knows the root; the active primary
+                # tees its applied rounds there
+                env["PADDLE_PS_DURABLE_DIR"] = durable_root
+            if restore_round is not None:
+                # cold restart: every member restores the JOB cut
+                # (never its own newest round) and re-arms its fence
+                env["PADDLE_PS_RESTORE"] = "1"
+                env["PADDLE_PS_RESTORE_ROUND"] = str(restore_round)
             servers.append(_Worker(
                 pserver_eps.index(ep),
                 [sys.executable, "-u", args.server_script], env,

@@ -203,6 +203,7 @@ existing frame):
 """
 from __future__ import annotations
 
+import base64
 import hashlib
 import json
 import os
@@ -427,10 +428,12 @@ def snapshot_scope_to_dir(executor, scope, dirname: str,
     import os
 
     from ..checkpoint import SCOPE_VARS_NAME, atomic_write_bytes, \
-        write_manifest
+        makedirs_durable, write_manifest
     from ..core import proto_format
 
-    os.makedirs(dirname, exist_ok=True)
+    # durable mkdir (ISSUE 19): a fresh snapshot dir's dirent must
+    # survive a host crash, not just process death
+    makedirs_durable(dirname)
     names: Dict[str, str] = {}
     for name in list(scope.local_var_names()):
         val = executor._read_var(scope, name)
@@ -523,7 +526,8 @@ class PSServer:
                  lease_ms: Optional[float] = None,
                  shard: Optional[int] = None,
                  witnesses: Optional[List[str]] = None,
-                 block_factory=None):
+                 block_factory=None,
+                 durable_dir: Optional[str] = None):
         host, port = endpoint.rsplit(":", 1)
         # endpoint-pair partition rules address server processes by
         # their advertised endpoint; first server in wins (one server
@@ -710,6 +714,28 @@ class PSServer:
         self._threads: List[threading.Thread] = []
         self._conns: set = set()
         self._conn_lock = threading.Lock()
+        # -- whole-job durable rounds (ISSUE 19) --------------------------
+        # With a durable dir armed, the primary TEES every applied
+        # round's replication frame to disk (same delta/anchor blobs
+        # the backups get) so a CORRELATED loss — every member of the
+        # group, or the whole job — can cold-restart from a
+        # round-consistent cut. Restore runs here, before any serving
+        # thread exists, when the launcher exported
+        # PADDLE_PS_RESTORE=1 (never on a live-failover rejoin: the
+        # replication stream outranks disk for a process that has a
+        # living group to catch up from).
+        if durable_dir is None:
+            durable_dir = os.environ.get("PADDLE_PS_DURABLE_DIR") or None
+        self._durable_store = None
+        if durable_dir:
+            from ..checkpoint import RoundStore
+
+            self._durable_store = RoundStore(durable_dir,
+                                             self._shard_index)
+        self._restored_round = 0
+        if (self._durable_store is not None and not self._rejoin
+                and os.environ.get("PADDLE_PS_RESTORE") == "1"):
+            self._restore_from_disk()
         if self._evict_after > 0:
             t = threading.Thread(target=self._evict_loop,
                                  name="ps-evict-monitor", daemon=True)
@@ -1004,7 +1030,7 @@ class PSServer:
         if not self._active_role():
             return
         targets = self._repl_targets()
-        if not targets:
+        if not targets and self._durable_store is None:
             # no stream to diff against: keep dirty tracking bounded
             # and digests empty so a first backup gets a clean anchor
             self._dirty_rows.clear()
@@ -1017,6 +1043,14 @@ class PSServer:
         wm = self._applied_watermark
         base = self._applied_round - 1
         extra = self._repl_extra_locked()
+        # durable tee BEFORE shipping (ISSUE 19): the frame must be on
+        # disk before any barrier reply can make trainers observe the
+        # round, so a whole-job kill always finds every shard's disk
+        # at-or-past any round a trainer checkpointed. Same blobs as
+        # the wire — per-round durable bytes ride the delta path.
+        if self._durable_store is not None:
+            self._persist_round_locked(mode, headers, raw, wm, base,
+                                       extra)
         acked = 0
         for ep in targets:
             _gauge("ps.replication_lag_rounds", backup=ep).set(1)
@@ -1066,6 +1100,236 @@ class PSServer:
         self._shipped_digests = digests
         self._dirty_rows.clear()
         self._dirty_dense.clear()
+
+    # -- whole-job durable rounds (ISSUE 19) ------------------------------
+    #
+    # Live replication survives PARTIAL failures; these methods make
+    # the group survive a CORRELATED one. Every applied round's
+    # replication frame (headers + raw blob + watermark + shard-map /
+    # migration extras + fencing epoch) is persisted atomically under
+    # ``<durable_dir>/shard-<k>/round-<n>/`` by the active primary,
+    # and a cold-booting server replays the newest anchor chain with
+    # the SAME splice semantics a backup applies — so a restored shard
+    # is bit-for-bit the state any trainer could have observed at that
+    # round. The launcher computes the job-wide cut (the newest round
+    # present on EVERY shard) and pins it via PADDLE_PS_RESTORE_ROUND;
+    # a shard never restores past it, so a mixed cut cannot happen.
+
+    def _persist_round_locked(self, mode, headers, raw, wm, base,
+                              extra) -> None:
+        """Tee the just-applied round's frame to disk (locked by
+        caller, BEFORE the barrier reply). A persist failure is loud
+        but non-fatal: the job keeps training on live replication and
+        the operator sees ``ps.durable_errors`` grow."""
+        try:
+            self._durable_store.put_round(
+                self._applied_round, headers, raw, wm, mode=mode,
+                base_round=(base if mode == "delta" else None),
+                epoch=self._epoch, extra=extra)
+            # ops folded into this frame are covered by it now
+            self._durable_store.clear_ops_through(self._applied_round)
+        except OSError as e:
+            _counter("ps.durable_errors").inc()
+            print("[ps_rpc] durable persist of round %d failed: %s"
+                  % (self._applied_round, e), file=sys.stderr,
+                  flush=True)
+            return
+        # disk is at least as durable as a backup ack: async clients
+        # may prune replay-log entries folded into this frame
+        self._durable_round = self._applied_round
+        _flight.record("ps.round_durable", round=self._applied_round,
+                       mode=mode, shard=self._shard)
+
+    def _restore_from_disk(self) -> None:
+        """Cold-restart resume (boot-time, before any serving thread):
+        load the target round's anchor chain, re-arm the fencing epoch
+        PAST the dead incarnation so its stragglers are refused, and
+        replay the async op tail exactly-once against the restored
+        watermark. Every group member restores (a backup that booted
+        at the cut applies the primary's next delta without a
+        re-anchor); only the active primary bumps its serving epoch."""
+        from ..checkpoint import CheckpointCorrupt
+
+        store = self._durable_store
+        rounds = store.restorable_rounds()
+        if not rounds:
+            return
+        tgt_env = os.environ.get("PADDLE_PS_RESTORE_ROUND", "")
+        target = int(tgt_env) if tgt_env else rounds[-1]
+        if target not in set(rounds):
+            eligible = [r for r in rounds if r <= target]
+            if not eligible:
+                raise CheckpointCorrupt(
+                    "shard %s cannot reach the job restore cut %d: "
+                    "restorable rounds are %s"
+                    % (self._shard, target, rounds))
+            target = eligible[-1]
+        t0 = time.monotonic()
+        with self._lock:
+            store.load_round(target, self._apply_restore_frame)
+            meta = store.meta(target) or {}
+            stored_epoch = int(meta.get("epoch", 0))
+            # fence out the DEAD incarnation: any straggler still
+            # speaking its epoch is refused by every restored member
+            self._seen_epoch = max(self._seen_epoch, stored_epoch + 1)
+            if self._active:
+                self._epoch = max(self._epoch, stored_epoch + 1)
+            self._applied_round = target
+            self._durable_round = target
+            self._restored_round = target
+            self._applied_watermark = dict(self._repl_watermark)
+            self._caught_up = True
+            self._round_complete = True
+            replayed = 0
+            for e in store.pending_ops(after_round=target):
+                replayed += self._replay_logged_op_locked(e)
+        ms = (time.monotonic() - t0) * 1e3
+        _histogram("checkpoint.restore_ms").observe(ms)
+        _flight.record("ps.restore", round=target,
+                       epoch=stored_epoch + 1,
+                       shard=self._shard_index,
+                       ops_replayed=replayed, ms=ms)
+        print("[ps_rpc] %s restored shard %s at round %d "
+              "(epoch fence %d, %d async ops replayed, %.0fms)"
+              % (self._own_endpoint, self._shard, target,
+                 stored_epoch + 1, replayed, ms),
+              file=sys.stderr, flush=True)
+
+    def _apply_restore_frame(self, meta: dict, raw: bytes) -> None:
+        """Apply one durable frame — the disk twin of the 'replicate'
+        handler: splice row/chunk deltas (or whole vars) into scope
+        and adopt the shard-map / migration state the frame carried."""
+        off = 0
+        for h in meta.get("vars", []):
+            n = int(np.dtype(h["dtype"]).itemsize
+                    * int(np.prod(h["shape"]) if h["shape"] else 1))
+            arr = _array_from(h, raw[off:off + n])
+            off += n
+            rows = h.get("rows")
+            chunk = h.get("chunk")
+            if rows is not None:
+                tbl = np.array(np.asarray(
+                    self._executor._read_var(self._scope, h["name"])),
+                    copy=True)
+                tbl[np.asarray(rows, dtype=np.int64)] = arr
+                self._executor._write_var(self._scope, h["name"], tbl)
+            elif chunk is not None:
+                tbl = np.array(np.asarray(
+                    self._executor._read_var(self._scope, h["name"])),
+                    copy=True)
+                tbl.reshape(-1)[int(chunk[0]):int(chunk[1])] \
+                    = arr.reshape(-1)
+                self._executor._write_var(self._scope, h["name"], tbl)
+            else:
+                self._executor._write_var(self._scope, h["name"], arr)
+        ex = meta.get("repl_extra") or {}
+        sm = ex.get("shard_map")
+        if sm and int(sm.get("version", 0)) >= self._shard_map_version:
+            self._shard_map_version = int(sm["version"])
+        for n2, ov in (ex.get("map_overrides") or {}).items():
+            cur = self._map_overrides.get(n2)
+            if cur is None or int(cur.get("version", 0)) \
+                    <= int(ov.get("version", 0)):
+                self._map_overrides[n2] = dict(ov)
+        for n2 in ex.get("dropped", []) or []:
+            if n2 not in self._dropped:
+                self._dropped.add(n2)
+                try:
+                    if hasattr(self._scope, "__delitem__") \
+                            and n2 in self._scope.local_var_names():
+                        del self._scope[n2]
+                except (KeyError, TypeError):
+                    pass
+        pm = ex.get("pending_migration")
+        # like the stream: the newest frame is the truth — an intent
+        # that stopped riding it was executed or rolled back upstream
+        self._pending_migration = dict(pm) if pm else None
+        ro = ex.get("range_overrides")
+        if ro:
+            self._range_overrides = {
+                t: [dict(r) for r in rs] for t, rs in ro.items()}
+        prm = ex.get("pending_range_migration")
+        self._pending_range_migration = dict(prm) if prm else None
+        for cid, s in (meta.get("watermark") or {}).items():
+            if int(self._repl_watermark.get(cid, 0)) < int(s):
+                self._repl_watermark[cid] = int(s)
+
+    def _log_async_op_locked(self, msg: dict, raw: bytes,
+                             kind: str = "push_sparse") -> None:
+        """Durably log one acked async op (geo/async mode): between
+        synthetic-round frames the op exists ONLY in this process, so
+        the ack must not outlive the bytes. The entry carries the op's
+        dedup token and the round that will fold it; the tail is
+        truncated when that frame lands and replayed — exactly-once
+        against the frame watermark — on cold restart."""
+        entry = {"round": self._applied_round + 1,
+                 "kind": kind,
+                 "cid": msg.get("cid"),
+                 "seq": int(msg.get("seq") or 0),
+                 "name": msg.get("name"),
+                 "param": msg.get("param", ""),
+                 "array": msg["array"],
+                 "gh": msg.get("gh"),
+                 "raw": base64.b64encode(raw).decode("ascii")}
+        if kind == "push_sparse":
+            entry["rows"] = msg["rows"]
+        try:
+            self._durable_store.append_op(entry)
+        except OSError as e:
+            _counter("ps.durable_errors").inc()
+            print("[ps_rpc] async op-log append failed: %s" % e,
+                  file=sys.stderr, flush=True)
+
+    def _replay_logged_op_locked(self, e: dict) -> int:
+        """Re-apply one logged async op at restore; returns 1 when
+        applied, 0 when the restored frame watermark already covers
+        its (cid, seq) — the op was folded into the frame (or a newer
+        log entry superseded it) and re-applying would double-count."""
+        cid = str(e.get("cid") or "")
+        seq = int(e.get("seq") or 0)
+        if cid and seq \
+                and seq <= int(self._repl_watermark.get(cid, 0)):
+            return 0
+        raw = base64.b64decode(e.get("raw", ""))
+        if e.get("kind") == "send_grad":
+            # dense async grad: whole-var write + its optimize block
+            arr = _array_from(e["array"], raw)
+            self._executor._write_var(self._scope, e["name"], arr)
+            sub = self._grad_to_block.get(e["name"])
+            if sub is not None:
+                self._executor.run_block(sub, self._scope)
+            self._mark_families_dirty_locked([e["name"]])
+        else:
+            rh, vh = e["rows"], e["array"]
+            nrows_bytes = int(np.dtype(rh["dtype"]).itemsize
+                              * int(np.prod(rh["shape"])))
+            rows = np.frombuffer(raw[:nrows_bytes],
+                                 dtype=rh["dtype"]).reshape(-1)
+            vals = _array_from(vh, raw[nrows_bytes:])
+            from ..core.tensor import LoDTensor, SelectedRows
+
+            pname = e.get("param", "")
+            tbl = (self._executor._read_var(self._scope, pname)
+                   if pname else None)
+            height = (int(np.asarray(tbl).shape[0]) if tbl is not None
+                      else int(rows.max()) + 1)
+            sr = SelectedRows(rows=rows.tolist(), height=height)
+            sr._value = LoDTensor(vals)
+            self._executor._write_var(self._scope, e["name"], sr)
+            sub = self._grad_to_block.get(e["name"])
+            if sub is not None:
+                self._executor.run_block(sub, self._scope)
+            if pname:
+                self._dirty_rows.setdefault(pname, set()).update(
+                    int(r) for r in rows)
+        if cid and seq:
+            if seq > int(self._repl_watermark.get(cid, 0)):
+                self._repl_watermark[cid] = seq
+            with self._dedupe_lock:
+                if seq > int(self._last_seq.get(cid, 0)):
+                    self._last_seq[cid] = seq
+        self._async_ops += 1
+        return 1
 
     # -- live shard migration (ISSUE 13) ----------------------------------
     #
@@ -1621,8 +1885,13 @@ class PSServer:
         whose round is now replicated. That round-gating makes a
         failover mid-async-push exactly-once like the sync path
         (ISSUE 8 satellite; the gap carried since ISSUE 4)."""
-        if self._sync or len(self._endpoints) <= 1 \
-                or not self._active_role():
+        # a lone server normally has nobody to make rounds durable
+        # WITH — but an armed durable dir IS a durability target
+        # (ISSUE 19): synthetic rounds tick so the disk frames (and
+        # the op-log truncation riding them) keep advancing
+        if self._sync or not self._active_role() \
+                or (len(self._endpoints) <= 1
+                    and self._durable_store is None):
             return {}
         self._async_ops += 1
         pending = self._applied_round + 1
@@ -2210,6 +2479,10 @@ class PSServer:
                     # a dense async update touches its grad's FAMILY
                     # through its block: full diff takes over there
                     self._mark_families_dirty_locked([msg["name"]])
+                    if (self._durable_store is not None
+                            and self._active_role()):
+                        self._log_async_op_locked(msg, raw,
+                                                  kind="send_grad")
                     extra = self._async_tick_locked()
             return dict({"ok": True}, **extra), b""
         if kind == "send_barrier":
@@ -2349,6 +2622,14 @@ class PSServer:
                             _counter("ps.row_heat", shard=self._shard,
                                      table=pname,
                                      bucket=str(b)).inc()
+                if (self._durable_store is not None and not self._sync
+                        and self._active_role()):
+                    # log BEFORE the tick: if the tick folds this op
+                    # into a frame, clear_ops_through truncates the
+                    # entry right back — the invariant is that every
+                    # acked async op is durable somewhere (frame or
+                    # tail) the moment the ack leaves
+                    self._log_async_op_locked(msg, raw)
                 extra = self._async_tick_locked()
             return dict({"ok": True}, **extra), b""
         if kind == "checkpoint":
@@ -2375,7 +2656,15 @@ class PSServer:
                 epoch = int(msg.get("epoch", 0))
                 if epoch < self._seen_epoch:
                     # ok=True: the rpc worked — the VERDICT is fenced,
-                    # and the stale primary must read it, not retry
+                    # and the stale primary must read it, not retry.
+                    # Loud in the flight ring: after a cold restart
+                    # this is the dead incarnation's straggler being
+                    # refused by the disk-restored epoch (ISSUE 19)
+                    _counter("ps.fence_refused").inc()
+                    _flight.record("ps.fence_refused",
+                                   kind="replicate", epoch=epoch,
+                                   seen=self._seen_epoch,
+                                   shard=self._shard)
                     return {"ok": True, "fenced": True,
                             "epoch": self._seen_epoch}, b""
                 self._refresh_lease_locked(epoch)
@@ -2725,6 +3014,12 @@ class PSServer:
                 epoch = int(msg.get("epoch", 0))
                 if epoch < self._seen_epoch or (
                         self._active_role() and epoch < self._epoch):
+                    _counter("ps.fence_refused").inc()
+                    _flight.record("ps.fence_refused",
+                                   kind="lease_renew", epoch=epoch,
+                                   seen=max(self._seen_epoch,
+                                            self._epoch),
+                                   shard=self._shard)
                     return {"ok": False, "fenced": True,
                             "epoch": max(self._seen_epoch,
                                          self._epoch)}, b""
@@ -3010,7 +3305,10 @@ class PSServer:
     def serve_forever(self) -> None:
         """Accept loop; returns after a shutdown message (the reference
         blocks inside the listen_and_serv op the same way)."""
-        self._sock.settimeout(0.2)
+        try:
+            self._sock.settimeout(0.2)
+        except OSError:
+            return  # stop() closed the socket before the loop began
         try:
             while not self._shutdown.is_set():
                 try:
@@ -3731,6 +4029,17 @@ class PSClient:
             msg["tr"] = int(round)
         self._call(msg, arr.tobytes())
 
+    def seed_round(self, n: int) -> None:
+        """Floor the completed-round counter (ISSUE 19): a trainer
+        resuming after a whole-job cold restart seeds the job restore
+        cut — the servers' applied round — so the server-side
+        stale-primary guard starts from the restored state instead of
+        zero. Callers must also fast-forward their training loop past
+        the cut: seeding it and then RE-DRIVING older rounds would
+        push this counter past the servers' applied round, which
+        reads as 'refusing to serve stale params' on every pull."""
+        self._round = max(self._round, int(n))
+
     def send_barrier(self, round: Optional[int] = None) -> None:
         self.barrier_prepare(round=round)
         self._round += 1
@@ -4000,7 +4309,10 @@ class PSWitness:
             conn.close()
 
     def serve_forever(self) -> None:
-        self._sock.settimeout(0.2)
+        try:
+            self._sock.settimeout(0.2)
+        except OSError:
+            return  # stop() closed the socket before the loop began
         try:
             while not self._shutdown.is_set():
                 try:

@@ -112,7 +112,16 @@ def client_from_env(trainer_id: int = 0,
                     endpoints: Optional[str] = None):
     """The right client for the env contract: a plain (possibly
     replicated) ``PSClient`` for one group, a ``ShardedPSClient`` when
-    ``PADDLE_PSERVER_SHARDS`` > 1."""
+    ``PADDLE_PSERVER_SHARDS`` > 1.
+
+    After a whole-job cold restart (ISSUE 19) the round counter is
+    deliberately NOT seeded here from the launcher's
+    ``PADDLE_PS_RESTORE_ROUND``: seeding belongs with the caller's
+    resume logic, which must ALSO fast-forward its training loop past
+    the cut — a counter seeded at the cut and then re-driving older
+    rounds ends up ahead of the servers' applied round and trips
+    their stale-primary guard on every pull. A resumed trainer calls
+    ``seed_round`` with the cut when it fast-forwards."""
     raw = endpoints if endpoints is not None else os.environ.get(
         "PADDLE_PSERVER_ENDPOINTS", "")
     eps = [e.strip() for e in str(raw).split(",") if e.strip()]
@@ -269,6 +278,14 @@ class ShardedPSClient:
 
     def get_param(self, name: str) -> np.ndarray:
         return self._routed(name, lambda c: c.get_param(name))
+
+    def seed_round(self, n: int) -> None:
+        """Floor every shard client's completed-round counter (ISSUE
+        19): a cold-restarted trainer seeds the job restore cut — the
+        servers' applied round — and fast-forwards its training loop
+        past it (see ``PSClient.seed_round``)."""
+        for c in self.shards:
+            c.seed_round(n)
 
     def _all_shards(self, fn, what: str) -> List:
         """Run ``fn(client)`` on every shard in parallel and return

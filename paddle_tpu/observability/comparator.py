@@ -89,6 +89,11 @@ WATCHED = (
     # here run-over-run
     ("ps_digest_ms", -1), ("rounds_per_s", +1),
     ("repl_delta_bytes_per_round", -1),
+    # crash-consistent round store (ISSUE 19): the per-round durable
+    # frame must stay incremental (a regression back toward persisting
+    # whole-table snapshots at every commit shows up as byte growth)
+    # and the cold restore must stay cheap
+    ("ckpt_delta_bytes_per_round", -1), ("ckpt_restore_ms", -1),
     # PS rebalance canaries (ISSUE 18): hot/cold per-shard row-load
     # ratio off the ps.row_heat counters. Counter-derived, so it is
     # deterministic under chaos injection where wall-clock throughput
@@ -130,6 +135,9 @@ ABS_NOISE_FLOOR = {
     "preemptions": 2.0,
     # hashing time on a loaded CI box jitters; byte counts do not
     "ps_digest_ms": 5.0,
+    # a cold restore reads + verifies + splices files: fs-cache and
+    # scheduler noise at the tens-of-ms level on a loaded CI box
+    "ckpt_restore_ms": 20.0,
     # predicted-vs-measured ratio moves with CI-box timing noise
     "placement_agreement": 0.15,
 }
@@ -149,6 +157,13 @@ COUNTER_WATCH_GROWS_BAD = ("parallel.collective_bytes",
                            # table — kind=var bytes grow where
                            # kind=range bytes should be
                            "ps.migration_bytes",
+                           # durable round frames (ISSUE 19): growth
+                           # of the bytes persisted per committed
+                           # round (and of the mode=full series
+                           # specifically) means the crash-consistent
+                           # store regressed toward whole-table
+                           # snapshots
+                           "checkpoint.round_bytes",
                            # fused single-chip program op count
                            # (tools/sc_smoke.py): deterministic —
                            # growth means the fusion passes regressed

@@ -307,7 +307,27 @@ def _dump_basename() -> str:
         # a relaunched incarnation must not overwrite its dead
         # predecessor's final dump — the merge wants both, labeled
         base += ".r%d" % restart
+    inc = job_incarnation()
+    if inc:
+        # whole-JOB incarnation (cold restart, ISSUE 19): the restored
+        # job keeps the dead incarnation's dumps as postmortem
+        # evidence, so the new ones must not collide with them.
+        # Incarnation 0 keeps the bare historical name.
+        base += ".i%d" % inc
     return base + ".json"
+
+
+_INCARNATION_ENV = "PADDLE_INCARNATION"
+
+
+def job_incarnation() -> int:
+    """The whole-job incarnation this process belongs to (0 = first
+    launch; the launcher bumps ``PADDLE_INCARNATION`` on every cold
+    restart from the durable round store)."""
+    try:
+        return int(os.environ.get(_INCARNATION_ENV, "0") or 0)
+    except ValueError:
+        return 0
 
 
 def metrics_dir() -> Optional[str]:
@@ -452,6 +472,7 @@ def _dump_process_locked(path, _obs, atomic_write_bytes):
         "role": role,
         "rank": rank,
         "restart": restart,
+        "incarnation": job_incarnation(),
         "pid": os.getpid(),
         "wrote_at": time.time(),
         # rebases perf_counter-stamped spans/flight events onto the
@@ -576,13 +597,24 @@ def clear_stale_dumps(dirname: str) -> int:
     merge) and ``*.jsonl`` (span-spool segments) in ``dirname`` — the
     launch supervisor calls this at job start so a merged job view
     never mixes incarnations of the job itself. Returns the number of
-    files removed; a missing dir is 0."""
+    files removed; a missing dir is 0.
+
+    DURABLE state is never touched (ISSUE 19): ``job.json`` (the
+    whole-job restore manifest), ``__manifest__.json`` (checkpoint
+    integrity manifests) and ``oplog.jsonl`` (the async op tail) are
+    denylisted, and directories (``round-<n>``/``ckpt-<n>``/
+    ``shard-<k>``) never match the file suffixes — so a job that
+    points its metrics dir into (or at) a checkpoint tree cannot eat
+    its own recovery data."""
     if not os.path.isdir(dirname):
         return 0
+    keep = ("job.json", "__manifest__.json", "oplog.jsonl")
     n = 0
     with _dump_lock:  # an in-flight dump lands before the clear, and
         # any dump after it uses the caller's already-set identity
         for fn in os.listdir(dirname):
+            if fn in keep:
+                continue
             if fn.endswith(".json") or fn.endswith(".jsonl") \
                     or fn.endswith(".clockping") \
                     or fn.startswith(".tmp-"):
@@ -707,6 +739,22 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
     docs = load_dumps(dirname)
     if not docs:
         return None, None
+    # a restored job (ISSUE 19) merges ONLY its own incarnation's
+    # dumps: the dead incarnation's files stay on disk as postmortem
+    # evidence (ft_timeline reads them raw) but must never mix into
+    # this incarnation's metrics/trace. In-job (env set) that is THIS
+    # incarnation; an offline postmortem tool merges the newest one
+    # present. Dumps predating the field are incarnation 0.
+    raw_inc = (os.environ.get(_INCARNATION_ENV) or "").strip()
+    try:
+        inc = int(raw_inc)
+    except ValueError:
+        inc = max((int(d.get("incarnation", 0) or 0) for d in docs),
+                  default=0)
+    docs = [d for d in docs
+            if int(d.get("incarnation", 0) or 0) == inc]
+    if not docs:
+        return None, None
     clock_offsets = load_clock_offsets(dirname)
     processes: Dict[str, Dict] = {}
     totals: Dict[str, float] = {}
@@ -737,7 +785,9 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
                               default=str) not in seen]
         processes[key] = {
             "role": doc.get("role"), "rank": doc.get("rank"),
-            "restart": doc.get("restart"), "pid": doc.get("pid"),
+            "restart": doc.get("restart"),
+            "incarnation": int(doc.get("incarnation", 0) or 0),
+            "pid": doc.get("pid"),
             "wrote_at": doc.get("wrote_at"),
             "metrics": doc.get("metrics") or {},
             "span_stats": doc.get("span_stats"),
@@ -777,8 +827,8 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
     for key, sdoc in sampled.items():
         if key in processes:
             processes[key]["sampled_profile"] = sdoc
-    merged = {"merged_at": time.time(), "processes": processes,
-              "counters_total": totals}
+    merged = {"merged_at": time.time(), "incarnation": inc,
+              "processes": processes, "counters_total": totals}
     if sampled:
         merged["sampled_profiles"] = sampled
         merged["sampled_profile_drift"] = sampled_profile_drift(sampled)

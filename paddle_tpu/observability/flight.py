@@ -25,6 +25,15 @@ dump carries the wall-clock offset that rebases both), ``kind`` is a
 dotted string (``rpc.send``, ``ps.promotion``, ``fault.injected``,
 ``checkpoint.commit``, ``launch.exit``), ``fields`` a small dict of
 json-safe scalars or None.
+
+Disaster-recovery kinds (ISSUE 19) narrate a whole-job crash and cold
+restart end to end: ``launch.cold_start`` (the relaunched supervisor
+found durable rounds and computed the job restore cut),
+``ps.round_durable`` (a shard primary persisted an applied round's
+frame), ``ps.restore`` (a server loaded the cut from disk and re-armed
+its fencing epoch), ``ps.fence_refused`` (a straggler from the dead
+incarnation was refused by the restored epoch). ``tools/ft_timeline``
+highlights exactly this causal chain in the postmortem.
 """
 from __future__ import annotations
 

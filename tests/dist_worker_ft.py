@@ -490,13 +490,27 @@ def run_trainer():
             # predecessor had adopted (checkpoint.manifest_extra)
             state["shard_map"] = manifest_extra(d).get("shard_map")
 
-        step = mgr.load_latest(_load)
+        restore_cut = os.environ.get("PADDLE_PS_RESTORE_ROUND", "")
+        if restore_cut:
+            # whole-job cold restart (ISSUE 19): load local state AT
+            # OR BELOW the job restore cut, never past it — after a
+            # corrupt-newest fallback the trainer's own newest
+            # checkpoint can be AHEAD of the round the servers
+            # restored, and local state derived from a round the
+            # servers lost must not leak into the resumed run (the
+            # training loop fast-forwards to cut+1 below either way)
+            step = mgr.load_at_or_before(int(restore_cut), _load)
+        else:
+            step = mgr.load_latest(_load)
         if step is not None:
             resumed_from = step
             start = step + 1
             resumed_map = state.get("shard_map")
-            print("[trainer %d] resumed from checkpoint round %d"
-                  % (tid, step), file=sys.stderr, flush=True)
+            print("[trainer %d] resumed from checkpoint round %d%s"
+                  % (tid, step,
+                     " (clamped to job restore cut %s)" % restore_cut
+                     if restore_cut else ""),
+                  file=sys.stderr, flush=True)
 
     if nshards > 1:
         client = client_from_env(trainer_id=tid)
@@ -506,6 +520,21 @@ def run_trainer():
             client.apply_shard_map(resumed_map)
     else:
         client = PSClient.for_endpoint(endpoint, trainer_id=tid)
+    restore_cut_env = os.environ.get("PADDLE_PS_RESTORE_ROUND", "")
+    if restore_cut_env:
+        # whole-job cold restart: every round <= the cut is durably
+        # folded into EVERY shard (that is what made it the cut), so
+        # re-driving from an older checkpoint would only produce
+        # stale re-sends — and stale barrier acks don't synchronize
+        # trainers, so two resumed trainers can desync until one's
+        # real round deadlocks against the other's stale-round
+        # get_param. Fast-forward straight to cut+1 (grads are pure
+        # functions of (tid, round), so rounds the servers fell back
+        # past re-drive bit-identically) and seed the staleness-guard
+        # counter to the cut — exactly the servers' applied round.
+        cut = int(restore_cut_env)
+        start = max(start, cut + 1)
+        client.seed_round(cut)
     ws = {}
     mr = _mr_mode()
     emb_h, emb_w = _emb_dims()

@@ -1978,3 +1978,233 @@ def test_range_migration_partition_aborts_cleanly(monkeypatch):
         c.close()
         for s, _ in servers:
             s.stop()
+
+
+# -- whole-job crash consistency (ISSUE 19) ----------------------------------
+
+
+def _put_frame(store, rnd, mode="full", base=None, epoch=0):
+    hdr = [{"name": "w", "dtype": "float32", "shape": [4]}]
+    store.put_round(rnd, hdr, np.zeros(4, np.float32).tobytes(), {},
+                    mode=mode, base_round=base, epoch=epoch)
+
+
+def test_roundstore_torn_delta_drops_its_chain(tmp_path):
+    """A frame is restorable only with its whole anchor->delta chain
+    intact: tearing a mid-chain delta drops it AND every delta stacked
+    on it, while the anchor (and the previous chain) stay loadable."""
+    from paddle_tpu.checkpoint import CheckpointCorrupt, RoundStore
+
+    store = RoundStore(str(tmp_path), shard=0)
+    _put_frame(store, 1)
+    _put_frame(store, 2, mode="delta", base=1)
+    _put_frame(store, 3, mode="delta", base=2)
+    assert store.restorable_rounds() == [1, 2, 3]
+    blob = os.path.join(store.round_dir(2), "blob.bin")
+    with open(blob, "r+b") as f:
+        f.truncate(os.path.getsize(blob) // 2)
+    fresh = RoundStore(str(tmp_path), shard=0)
+    assert fresh.restorable_rounds() == [1], \
+        "a torn delta must drop itself and everything chained past it"
+    with pytest.raises(CheckpointCorrupt):
+        fresh.load_round(3, lambda meta, raw: None)
+
+
+def test_job_restore_round_is_the_common_cut(tmp_path):
+    """Mixed per-shard progress (shard 0 durable through round 3,
+    shard 1 only through round 2) restores the newest round present on
+    EVERY shard — never a mixed cut."""
+    from paddle_tpu.checkpoint import RoundStore, job_restore_round
+
+    s0 = RoundStore(str(tmp_path), shard=0)
+    s1 = RoundStore(str(tmp_path), shard=1)
+    _put_frame(s0, 1)
+    _put_frame(s0, 2, mode="delta", base=1)
+    _put_frame(s0, 3, mode="delta", base=2)
+    _put_frame(s1, 1)
+    _put_frame(s1, 2, mode="delta", base=1)
+    assert job_restore_round(str(tmp_path), 2) == 2
+    # the laggard catches up: the cut advances with it
+    _put_frame(s1, 3, mode="delta", base=2)
+    assert job_restore_round(str(tmp_path), 2) == 3
+    # tearing the newest frame on ONE shard pulls the job cut back
+    blob = os.path.join(s1.round_dir(3), "blob.bin")
+    with open(blob, "r+b") as f:
+        f.truncate(os.path.getsize(blob) // 2)
+    assert job_restore_round(str(tmp_path), 2) == 2
+
+
+def test_job_restore_missing_shard_is_a_typed_error(tmp_path):
+    """A restore that cannot see EVERY shard group must raise the
+    typed error naming the missing shard — a partial or mixed restore
+    never happens silently."""
+    from paddle_tpu.checkpoint import (RestoreMissingShard, RoundStore,
+                                       job_restore_round)
+
+    _put_frame(RoundStore(str(tmp_path), shard=0), 1)
+    with pytest.raises(RestoreMissingShard) as ei:
+        job_restore_round(str(tmp_path), 2)
+    assert ei.value.shard == 1
+    assert "shard 1" in str(ei.value)
+    # a shard dir whose every frame is torn is just as missing
+    s1 = RoundStore(str(tmp_path), shard=1)
+    _put_frame(s1, 1)
+    blob = os.path.join(s1.round_dir(1), "blob.bin")
+    with open(blob, "r+b") as f:
+        f.truncate(2)
+    fresh_err = pytest.raises(RestoreMissingShard,
+                              job_restore_round, str(tmp_path), 2)
+    assert fresh_err.value.shard == 1
+
+
+def test_cold_restart_restores_bitwise_and_fences_dead_incarnation(
+        monkeypatch, tmp_path):
+    """The tentpole end to end in one process group: a sync primary
+    with a durable dir persists every applied round; after a stop
+    (standing in for SIGKILL — the frames are already on disk before
+    any barrier ack) a fresh server booted with PADDLE_PS_RESTORE=1
+    loads the newest round bit-for-bit, re-sends of already-applied
+    rounds are dropped (exactly-once across the restart), training
+    continues at cut+1, and a straggler from the dead incarnation's
+    epoch is refused by the disk-restored fence."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.ps_rpc import PSClient, _bare_rpc
+
+    _fast_env(monkeypatch)
+    durable = str(tmp_path)
+    eps = _eps(1)
+    s0, sc0 = _mk_ps(eps, 0, durable_dir=durable)
+    try:
+        c = PSClient(",".join(eps), trainer_id=0)
+        for rnd in range(1, 5):
+            c.send_grad("w@GRAD", _grad(0, rnd), round=rnd)
+            c.send_barrier(round=rnd)
+            c.fetch_barrier()
+        w_dead = np.asarray(sc0["w"]).copy()
+        c.close()
+    finally:
+        s0.stop()
+    # whole-job loss: nothing survives but the durable dir
+    monkeypatch.setenv("PADDLE_PS_RESTORE", "1")
+    s1, sc1 = _mk_ps(eps, 0, durable_dir=durable)
+    try:
+        assert s1._restored_round == 4
+        assert np.asarray(sc1["w"]).tobytes() == w_dead.tobytes(), \
+            "cold restore must be bit-for-bit"
+        c = PSClient(",".join(eps), trainer_id=0)
+        c.seed_round(4)
+        # a dead-incarnation re-send (round 4 already applied) must be
+        # DROPPED, not folded into round 5
+        stale0 = obs.counter_value("ps.stale_rounds") or 0
+        c.send_grad("w@GRAD", _grad(0, 4), round=4)
+        resp = c.barrier_prepare(round=4)
+        assert resp.get("stale_round"), resp
+        assert (obs.counter_value("ps.stale_rounds") or 0) > stale0
+        assert np.asarray(sc1["w"]).tobytes() == w_dead.tobytes()
+        # the job continues exactly-once at cut+1
+        c.send_grad("w@GRAD", _grad(0, 5), round=5)
+        c.send_barrier(round=5)
+        c.fetch_barrier()
+        oracle = {"w": np.zeros(4, "f4")}
+        for rnd in range(1, 6):
+            oracle["w@GRAD"] = _grad(0, rnd)
+            _sgd_block(oracle)
+        assert np.asarray(sc1["w"]).tobytes() == oracle["w"].tobytes()
+        c.close()
+        # a straggler still speaking the dead incarnation's epoch is
+        # refused by the restored fence, loudly
+        f0 = obs.counter_value("ps.fence_refused") or 0
+        resp = _bare_rpc(eps[0], {"kind": "lease_renew", "epoch": 0,
+                                  "frm": "ghost"})
+        assert resp.get("fenced"), resp
+        assert (obs.counter_value("ps.fence_refused") or 0) > f0
+    finally:
+        s1.stop()
+
+
+def test_cold_restart_corrupt_newest_round_falls_back_one(
+        monkeypatch, tmp_path):
+    """A newest round frame torn by the crash (killed mid-rename or
+    mid-write) silently falls the restore back to the previous
+    complete round — bit-for-bit with what round 3 looked like — and
+    the trainer-side manager clamps its own resume to that cut."""
+    from paddle_tpu.checkpoint import CheckpointManager, RoundStore
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_env(monkeypatch)
+    durable = str(tmp_path / "ps")
+    eps = _eps(1)
+    s0, sc0 = _mk_ps(eps, 0, durable_dir=durable)
+    w_at = {}
+    try:
+        c = PSClient(",".join(eps), trainer_id=0)
+        for rnd in range(1, 5):
+            c.send_grad("w@GRAD", _grad(0, rnd), round=rnd)
+            c.send_barrier(round=rnd)
+            c.fetch_barrier()
+            w_at[rnd] = np.asarray(sc0["w"]).copy()
+        c.close()
+    finally:
+        s0.stop()
+    store = RoundStore(durable, shard=0)
+    blob = os.path.join(store.round_dir(4), "blob.bin")
+    with open(blob, "r+b") as f:
+        f.truncate(os.path.getsize(blob) // 2)
+    monkeypatch.setenv("PADDLE_PS_RESTORE", "1")
+    s1, sc1 = _mk_ps(eps, 0, durable_dir=durable)
+    try:
+        assert s1._restored_round == 3
+        assert np.asarray(sc1["w"]).tobytes() == w_at[3].tobytes()
+    finally:
+        s1.stop()
+    # the trainer resumes AT OR BEFORE the fallen-back cut even though
+    # its own newest checkpoint (step 4) outlived the servers' round 4
+    ck = tmp_path / "trainer"
+    mgr = CheckpointManager(str(ck))
+    for step in (2, 3, 4):
+        mgr.save(step, lambda d, s=step: open(
+            os.path.join(d, "step.txt"), "w").write(str(s)))
+    seen = []
+    got = mgr.load_at_or_before(3, lambda d: seen.append(
+        open(os.path.join(d, "step.txt")).read()))
+    assert got == 3 and seen == ["3"]
+    assert mgr.load_at_or_before(1, lambda d: None) is None
+
+
+def test_async_oplog_replays_exactly_once_on_cold_restart(
+        monkeypatch, tmp_path):
+    """Async/geo mode: ops acked between synthetic-round frames live
+    only in the durable op log; a cold restart replays exactly the
+    tail past the restored frame's watermark — bit-for-bit with the
+    uninterrupted sequential oracle, nothing lost, nothing doubled."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    _fast_env(monkeypatch)
+    durable = str(tmp_path)
+    eps = _eps(1)
+    s0, sc0 = _mk_ps(eps, 0, sync=False, durable_dir=durable)
+    monkeypatch.setattr(s0, "_async_repl_every", 3)
+    grads = [np.full(4, 0.01 * (i + 1), dtype=np.float32)
+             for i in range(5)]
+    try:
+        c = PSClient(",".join(eps), trainer_id=0)
+        for g in grads:
+            c.send_grad("w@GRAD", g)
+        w_dead = np.asarray(sc0["w"]).copy()
+        c.close()
+    finally:
+        s0.stop()
+    # ops 1-3 folded into the round-1 frame; 4 and 5 exist ONLY in
+    # oplog.jsonl — the kill happens before their frame ships
+    monkeypatch.setenv("PADDLE_PS_RESTORE", "1")
+    s1, sc1 = _mk_ps(eps, 0, sync=False, durable_dir=durable)
+    try:
+        oracle = {"w": np.zeros(4, "f4")}
+        for g in grads:
+            oracle["w@GRAD"] = g
+            _sgd_block(oracle)
+        assert w_dead.tobytes() == oracle["w"].tobytes()
+        assert np.asarray(sc1["w"]).tobytes() == oracle["w"].tobytes(), \
+            "op-log replay lost or double-applied an acked async push"
+    finally:
+        s1.stop()

@@ -307,6 +307,44 @@ def _self_test():
     g3bad = [r for r in diff_records(g0, g3, 0.5)
              if r[1] == "repl_delta_bytes_per_round"]
     assert g3bad and g3bad[0][-1], g3bad
+    # durable-checkpoint records (ISSUE 19): a per-round durable-frame
+    # blowup (incremental snapshots regressing to full-blob dumps) or a
+    # cold-restore-latency regression past threshold+floor must flag;
+    # fs-cache jitter under the restore_ms noise floor must not
+    k0 = {"configs": {"ps_scale": {
+        "ps_digest_ms": 8.0, "rounds_per_s": 50.0,
+        "ckpt_delta_bytes_per_round": 4096.0,
+        "ckpt_restore_ms": 60.0}}}
+    k1 = {"configs": {"ps_scale": {
+        "ps_digest_ms": 8.0, "rounds_per_s": 50.0,
+        "ckpt_delta_bytes_per_round": 16777216.0,
+        "ckpt_restore_ms": 60.0}}}
+    kbad = [r for r in diff_records(k0, k1, 0.5)
+            if r[1] == "ckpt_delta_bytes_per_round"]
+    assert kbad and kbad[0][-1], kbad
+    k2 = {"configs": {"ps_scale": {
+        "ps_digest_ms": 8.0, "rounds_per_s": 50.0,
+        "ckpt_delta_bytes_per_round": 4096.0,
+        "ckpt_restore_ms": 75.0}}}
+    assert not any(r[-1] for r in diff_records(k0, k2, 0.10)), \
+        list(diff_records(k0, k2, 0.10))
+    k3 = {"configs": {"ps_scale": {
+        "ps_digest_ms": 8.0, "rounds_per_s": 50.0,
+        "ckpt_delta_bytes_per_round": 4096.0,
+        "ckpt_restore_ms": 600.0}}}
+    k3bad = [r for r in diff_records(k0, k3, 0.5)
+             if r[1] == "ckpt_restore_ms"]
+    assert k3bad and k3bad[0][-1], k3bad
+    # the checkpoint.round_bytes counter family (labeled by mode) is
+    # watched: durable bytes ballooning for the same workload flags
+    c0 = {"totals": {"checkpoint.round_bytes{mode=delta}": 4096,
+                     "checkpoint.round_bytes{mode=full}": 16777216}}
+    c1 = {"totals": {"checkpoint.round_bytes{mode=delta}": 16777216,
+                     "checkpoint.round_bytes{mode=full}": 16777216}}
+    ckbad = [r for r in diff_counters(c0, c1, 0.25) if r[-1]]
+    assert ckbad and ckbad[0][0].startswith("checkpoint.round_bytes"), \
+        ckbad
+    assert not any(r[-1] for r in diff_counters(c0, c0, 0.25))
     # placement records (ISSUE 15): a predicted-vs-measured agreement
     # collapse past threshold+floor must flag; sub-floor drift must
     # not; and a SILENT plan-digest change between runs always flags

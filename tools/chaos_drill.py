@@ -84,6 +84,27 @@ ISSUE 18 mode:
   (the fire is the donor kill + live steering); witness + clock
   jitter ride as in ``--migrate``.
 
+ISSUE 19 mode:
+
+- ``--total-loss`` — whole-job crash consistency: the sync job runs
+  with a durable round store armed (``PADDLE_PS_DURABLE_DIR``), and
+  once the seeded round is durable on EVERY shard the drill SIGKILLs
+  every process at once — supervisor, servers, trainers, one
+  ``killpg`` on the session, no survivors, no warning. It then
+  relaunches the IDENTICAL command: the new supervisor must
+  auto-detect the durable state, compute the newest globally-complete
+  round across all shard groups (never a mixed cut), restore every
+  server to that ONE round with fencing epochs re-armed from disk,
+  clamp the trainers' checkpoint resume to the cut, and finish the
+  job with final params BIT-FOR-BIT equal to an uninterrupted run —
+  exactly-once across a total power loss. Gated on the dead
+  incarnation's black boxes surviving the relaunch and the
+  cold-start -> restore -> first-applied-round causal chain reading
+  in order in the merged timeline. ``--corrupt-newest`` additionally
+  tears the newest durable round's frame on every shard between the
+  kill and the relaunch: the restore must fall back EXACTLY one round
+  (the previous globally-complete cut) and still end bit-for-bit.
+
 The schedule is a pure function of the seed (``make_schedule``), so a
 failing drill replays exactly: rerun with the printed seed.
 
@@ -95,7 +116,8 @@ order across >= 3 processes (``check_telemetry``; the human-readable
 version is printed via ``tools/ft_timeline.py``).
 
 Usage: python tools/chaos_drill.py [--rounds 1] [--sync-rounds 6]
-       [--seed 1234] [--shards N] [--partition]
+       [--seed 1234] [--shards N] [--partition] [--total-loss
+       [--corrupt-newest]]
 """
 from __future__ import annotations
 
@@ -133,7 +155,9 @@ def _free_port() -> int:
 def make_schedule(seed: int, sync_rounds: int = 6, shards: int = 1,
                   partition: bool = False, migrate: bool = False,
                   evict: bool = False,
-                  migrate_range: bool = False) -> dict:
+                  migrate_range: bool = False,
+                  total_loss: bool = False,
+                  corrupt_newest: bool = False) -> dict:
     """The randomized fault schedule as a pure function of the seed —
     two calls with the same args MUST return the same dict (asserted
     by tests/test_fault_tolerance.py and test_survivable_ps.py). The
@@ -191,6 +215,17 @@ def make_schedule(seed: int, sync_rounds: int = 6, shards: int = 1,
         sched["mr_hot_shard"] = sched["die_shard"]
         sched["mr_to_shard"] = ((sched["die_shard"] + 1)
                                 % sched["shards"])
+    sched["total_loss"] = bool(total_loss)
+    sched["corrupt_newest"] = bool(corrupt_newest)
+    if sched["total_loss"]:
+        # drawn AFTER every legacy draw: old schedules replay
+        # identically. The whole job dies the moment this round is
+        # durable on every shard — never on the last round, so the
+        # restored incarnation must still train THROUGH the cut
+        sched["total_kill_round"] = rng.randint(
+            2, max(2, int(sync_rounds) - 2))
+    else:
+        sched["total_kill_round"] = None
     return sched
 
 
@@ -313,6 +348,15 @@ def _env(sched: dict, tmp: str, eps: list) -> dict:
             "PADDLE_PS_CHAOS_DIE_AFTER_INSTALL":
                 groups[sched["mr_hot_shard"]][0],
         })
+    if sched.get("total_loss"):
+        env.update({
+            # the fire is the whole-job SIGKILL, not the round-counted
+            # suicides — and the launcher reads the durable root from
+            # the env exactly like a real deployment would
+            "FT_DIE_AT_ROUND": "0",
+            "FT_SERVER_DIE_AT_ROUND": "0",
+            "PADDLE_PS_DURABLE_DIR": os.path.join(tmp, "durable"),
+        })
     if sched.get("evict"):
         env.update({
             "FT_SERVER_DIE_AT_ROUND": "0",
@@ -330,16 +374,18 @@ def _env(sched: dict, tmp: str, eps: list) -> dict:
 
 def _rerun_hint(sched: dict) -> str:
     return ("tools/chaos_drill.py --seed %d --sync-rounds %d"
-            "%s%s%s%s%s" % (sched["seed"], sched["sync_rounds"],
-                            " --shards %d" % sched["shards"]
-                            if sched["shards"] > 1 else "",
-                            " --partition" if sched["partition"]
-                            else "",
-                            " --migrate" if sched.get("migrate")
-                            else "",
-                            " --evict" if sched.get("evict") else "",
-                            " --migrate-range"
-                            if sched.get("migrate_range") else ""))
+            "%s%s%s%s%s%s%s"
+            % (sched["seed"], sched["sync_rounds"],
+               " --shards %d" % sched["shards"]
+               if sched["shards"] > 1 else "",
+               " --partition" if sched["partition"] else "",
+               " --migrate" if sched.get("migrate") else "",
+               " --evict" if sched.get("evict") else "",
+               " --migrate-range"
+               if sched.get("migrate_range") else "",
+               " --total-loss" if sched.get("total_loss") else "",
+               " --corrupt-newest"
+               if sched.get("corrupt_newest") else ""))
 
 
 def oracle_w_skipping(rounds: int, var: int, skip_tid: int,
@@ -885,6 +931,234 @@ def check_evict_telemetry(sched: dict, mdir: str) -> bool:
     return ok
 
 
+def _tear_newest_rounds(durable: str, shards: int) -> dict:
+    """Simulate a torn write: truncate the newest restorable round's
+    frame blob on EVERY shard. Tearing every shard's newest (rather
+    than one shard's) makes the fallback deterministic — whichever
+    shard held the pre-kill minimum loses exactly its top round, so
+    the new globally-complete cut is exactly one round earlier."""
+    from paddle_tpu import checkpoint as ckpt
+
+    torn = {}
+    for k in range(int(shards)):
+        store = ckpt.RoundStore(durable, shard=k)
+        newest = store.restorable_rounds()[-1]
+        blob = os.path.join(store.round_dir(newest), "blob.bin")
+        with open(blob, "r+b") as f:
+            f.truncate(os.path.getsize(blob) // 2)
+        torn["shard-%d" % k] = newest
+    return torn
+
+
+def run_total_loss_drill(sched: dict) -> int:
+    """The --total-loss drill (ISSUE 19): run with the durable round
+    store armed, SIGKILL the ENTIRE job (one killpg: supervisor,
+    servers, trainers) once the seeded round is durable on every
+    shard, optionally tear the newest durable round, then relaunch the
+    identical command and gate on auto-detected restore to the newest
+    globally-complete cut, bit-for-bit final params vs the
+    uninterrupted oracle, and the cold-start -> restore -> first-
+    applied-round causal chain in the merged telemetry."""
+    import signal
+    import time
+
+    from paddle_tpu import checkpoint as ckpt
+
+    tmp = tempfile.mkdtemp(prefix="chaos_total_loss_")
+    durable = os.path.join(tmp, "durable")
+    eps = ["127.0.0.1:%d" % _free_port()
+           for _ in range(2 * sched["shards"])]
+    print("[chaos] schedule %s" % json.dumps(sched, sort_keys=True))
+    launch_args = [
+        sys.executable, "-m", "paddle_tpu.distributed.launch",
+        "--nproc_per_node=2", "--max_restarts=3",
+        "--started_port=%d" % _free_port(),
+        "--server_script=%s" % WORKER,
+        "--pserver_shards=%d" % sched["shards"],
+        "--pserver_endpoints=%s" % ",".join(eps),
+        WORKER]
+    env = _env(sched, tmp, eps)
+
+    def common_cut():
+        try:
+            return ckpt.job_restore_round(durable, sched["shards"])
+        except (ckpt.RestoreMissingShard, ckpt.CheckpointCorrupt,
+                OSError, ValueError):
+            return None
+
+    # incarnation 0: run until the seeded round is durable on every
+    # shard, then kill the whole session — no survivors, no warning
+    proc = subprocess.Popen(launch_args, env=env, cwd=REPO,
+                            start_new_session=True)
+    kill_round = sched["total_kill_round"]
+    deadline = time.time() + 300
+    cut = None
+    try:
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                print("[chaos] FAIL: job exited %s before the "
+                      "whole-job kill (durable cut %s, wanted >= %d) "
+                      "(rerun: %s)" % (proc.returncode, cut,
+                                       kill_round,
+                                       _rerun_hint(sched)))
+                return 1
+            cut = common_cut()
+            if cut is not None and cut >= kill_round:
+                break
+            time.sleep(0.02)
+        else:
+            print("[chaos] FAIL: round %d never became durable on "
+                  "every shard (last common cut %s) (rerun: %s)"
+                  % (kill_round, cut, _rerun_hint(sched)))
+            return 1
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+    # the true cut: rounds kept committing between the poll that
+    # tripped the kill and the SIGKILL landing
+    cut_pre = common_cut()
+    print("[chaos] whole job SIGKILLed with round %s durable on "
+          "every shard" % cut_pre)
+    if cut_pre is None or cut_pre < kill_round:
+        print("[chaos] FAIL: durable state unreadable after the kill "
+              "(cut %s) (rerun: %s)" % (cut_pre, _rerun_hint(sched)))
+        return 1
+    expected_cut = cut_pre
+    if sched.get("corrupt_newest"):
+        torn = _tear_newest_rounds(durable, sched["shards"])
+        expected_cut = common_cut()
+        print("[chaos] tore newest durable round(s) %s: common cut "
+              "%d -> %s" % (json.dumps(torn, sort_keys=True), cut_pre,
+                            expected_cut))
+        if expected_cut != cut_pre - 1:
+            print("[chaos] FAIL: torn newest round must fall back "
+                  "EXACTLY one round (wanted %d, got %s) (rerun: %s)"
+                  % (cut_pre - 1, expected_cut, _rerun_hint(sched)))
+            return 1
+
+    # incarnation 1: the IDENTICAL command — restore is auto-detected
+    # from the durable root, exactly like a real operator's relaunch
+    sup = subprocess.run(launch_args, env=env, timeout=420, cwd=REPO)
+    if sup.returncode != 0:
+        print("[chaos] FAIL: relaunched job exited %d (rerun: %s)"
+              % (sup.returncode, _rerun_hint(sched)))
+        return 1
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from dist_worker_ft import var_names
+
+    ok = True
+    for tid in (0, 1):
+        r = json.load(open(os.path.join(tmp, "out.t%d.json" % tid)))
+        for vi, name in enumerate(var_names(sched["shards"])):
+            expected = oracle_w(sched["sync_rounds"], var=vi)
+            got = np.asarray(r["vars"][name], dtype=np.float32)
+            bitwise = got.tobytes() == expected.tobytes()
+            print("[chaos] %s: trainer %d var %s %s the uninterrupted "
+                  "oracle (resumed_from=%s)"
+                  % ("PASS" if bitwise else "FAIL", tid, name,
+                     "matches" if bitwise else "DIVERGES FROM",
+                     r.get("resumed_from")))
+            ok = ok and bitwise
+    ok = check_total_loss_telemetry(sched, os.path.join(tmp,
+                                                        "metrics"),
+                                    expected_cut) and ok
+    if not ok:
+        print("[chaos] reproduce with: %s" % _rerun_hint(sched))
+    return 0 if ok else 1
+
+
+def check_total_loss_telemetry(sched: dict, mdir: str,
+                               expected_cut: int) -> bool:
+    """The --total-loss gate: the dead incarnation's black boxes must
+    survive the relaunch; the restored supervisor's cold start must
+    name the newest globally-complete round; every server must restore
+    that ONE cut (never a mixed one); and the chain dead-incarnation <
+    cold start < restore < first-applied-round (= cut + 1: the
+    restored servers drop the resumed trainers' stale re-sends, never
+    re-apply them) must read in causal order in the merged timeline."""
+    ok = True
+
+    def chk(what, passed):
+        nonlocal ok
+        print("[chaos] %s: %s" % ("PASS" if passed else "FAIL", what))
+        ok = ok and passed
+
+    ft_timeline.print_postmortem(mdir, limit=40)
+    mpath = os.path.join(mdir, "metrics.json")
+    tpath = os.path.join(mdir, "trace.json")
+    chk("job-level metrics.json + trace.json merged",
+        os.path.exists(mpath) and os.path.exists(tpath))
+    if not ok:
+        return False
+    totals = json.load(open(mpath))["counters_total"]
+    events = ft_timeline.load_events(mdir)
+    incs = sorted({e.get("incarnation", 0) for e in events})
+    chk("dead incarnation's black boxes survived the relaunch "
+        "(incarnations %s)" % incs, 0 in incs and 1 in incs)
+    cold = [e for e in events if e["kind"] == "launch.cold_start"]
+    chk("the relaunched supervisor cold-started from durable state "
+        "(%d events)" % len(cold), len(cold) == 1)
+    restores = [e for e in events if e["kind"] == "ps.restore"]
+    chk("servers restored from disk (%d ps.restore events)"
+        % len(restores), len(restores) >= 1)
+    if not ok:
+        return False
+    cold = cold[0]
+    chk("cold start computed the newest globally-complete round "
+        "(restore_round=%s, want %d, incarnation=%s)"
+        % (cold["fields"].get("restore_round"), expected_cut,
+           cold["fields"].get("incarnation")),
+        cold["fields"].get("restore_round") == expected_cut
+        and cold["fields"].get("incarnation") == 1)
+    rshards = sorted({e["fields"].get("shard") for e in restores})
+    chk("every shard group restored (%s)" % rshards,
+        rshards == list(range(sched["shards"])))
+    rounds = sorted({e["fields"].get("round") for e in restores})
+    chk("every restore loaded the ONE cut r%d, never a mixed one "
+        "(got %s)" % (expected_cut, rounds),
+        rounds == [expected_cut])
+    inc1_applied = [e for e in events
+                    if e["kind"] == "ps.round_applied"
+                    and e.get("incarnation") == 1]
+    chk("the restored incarnation applied rounds (%d events)"
+        % len(inc1_applied), len(inc1_applied) >= 1)
+    if not ok:
+        return False
+    first_ap = min(inc1_applied, key=lambda e: e["t_us"])
+    chk("first post-restore applied round is the cut's successor "
+        "r%d (got r%s: stale re-sends dropped, not re-applied)"
+        % (expected_cut + 1, first_ap["fields"].get("round")),
+        first_ap["fields"].get("round") == expected_cut + 1)
+    last_dead = max((e["t_us"] for e in events
+                     if e.get("incarnation") == 0), default=None)
+    chk("causal chain: dead incarnation < cold start < restore < "
+        "first applied round",
+        last_dead is not None
+        and last_dead < cold["t_us"]
+        < min(e["t_us"] for e in restores) < first_ap["t_us"])
+    durs = [e for e in events if e["kind"] == "ps.round_durable"]
+    chk("round frames were persisted at commit time "
+        "(%d ps.round_durable events)" % len(durs), len(durs) >= 1)
+    n_faults = sum(v for k, v in totals.items()
+                   if k.startswith("fault.injected"))
+    chk("injected faults visible in the restored incarnation's "
+        "merged counters (%d)" % n_faults, n_faults > 0)
+    final = [e for e in events if e["kind"] == "ps.round_applied"
+             and e["fields"].get("round") == sched["sync_rounds"]]
+    chk("final round %d applied on every shard (%d appliers)"
+        % (sched["sync_rounds"], len(final)),
+        len(final) >= sched["shards"])
+    trace_names = {ev.get("name") for ev in
+                   json.load(open(tpath)).get("traceEvents", [])}
+    chk("merged trace.json carries the restore chain",
+        {"launch.cold_start", "ps.restore"} <= trace_names)
+    return ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser("chaos_drill")
     ap.add_argument("--rounds", type=int, default=1,
@@ -916,11 +1190,30 @@ def main() -> int:
                          "applies it live while the donor primary is "
                          "SIGKILLed mid-install (requires --shards 2 "
                          "and --sync-rounds >= 18)")
+    ap.add_argument("--total-loss", action="store_true",
+                    dest="total_loss",
+                    help="whole-job crash drill: SIGKILL every "
+                         "process at a seeded durable round, relaunch "
+                         "from disk, gate bit-for-bit vs an "
+                         "uninterrupted run (ISSUE 19)")
+    ap.add_argument("--corrupt-newest", action="store_true",
+                    dest="corrupt_newest",
+                    help="with --total-loss: tear the newest durable "
+                         "round between kill and relaunch — restore "
+                         "must fall back exactly one round")
     ap.add_argument("--seed", type=int,
                     default=int(os.environ.get("PADDLE_TPU_FAULT_SEED",
                                                "1234")),
                     help="base seed (drill i uses seed + i)")
     args = ap.parse_args()
+    if args.corrupt_newest and not args.total_loss:
+        ap.error("--corrupt-newest rides --total-loss (it tears the "
+                 "durable store the kill left behind)")
+    if args.total_loss and (args.migrate or args.evict
+                            or args.migrate_range or args.partition):
+        ap.error("--total-loss is its own drill (the whole job dies; "
+                 "there is no surviving shard to partition or "
+                 "migrate)")
     if args.partition and args.shards < 2:
         ap.error("--partition needs --shards >= 2 (the partitioned "
                  "pair must belong to a shard that keeps training)")
@@ -941,12 +1234,16 @@ def main() -> int:
                  "3 measure rounds)")
     rc = 0
     for i in range(args.rounds):
-        rc |= run_drill(make_schedule(args.seed + i, args.sync_rounds,
-                                      shards=args.shards,
-                                      partition=args.partition,
-                                      migrate=args.migrate,
-                                      evict=args.evict,
-                                      migrate_range=args.migrate_range))
+        sched = make_schedule(args.seed + i, args.sync_rounds,
+                              shards=args.shards,
+                              partition=args.partition,
+                              migrate=args.migrate,
+                              evict=args.evict,
+                              migrate_range=args.migrate_range,
+                              total_loss=args.total_loss,
+                              corrupt_newest=args.corrupt_newest)
+        rc |= (run_total_loss_drill(sched) if sched["total_loss"]
+               else run_drill(sched))
     if rc == 0:
         print("[chaos] ALL %d DRILL(S) PASS" % args.rounds)
     return rc

@@ -36,20 +36,29 @@ if REPO not in sys.path:  # script-dir sys.path[0] is tools/
 # decision-level postmortem
 _FRAME_KINDS = ("rpc.send", "rpc.recv", "ps.rpc")
 
+# the whole-job crash + cold-restart causal chain (ISSUE 19): flagged
+# in the timeline and summarized up front — after a total-loss drill
+# these four kinds ARE the story
+_DR_KINDS = ("launch.cold_start", "ps.restore", "ps.fence_refused",
+             "ps.round_durable")
+
 
 def load_events(dirname: str) -> List[Dict]:
     """Every flight event from every per-process dump under
-    ``dirname``, rebased onto the shared wall clock and sorted:
-    ``{"t_us": float, "proc": str, "pid": int, "kind": str,
+    ``dirname`` — ALL job incarnations (a total-loss postmortem needs
+    the dead incarnation's last dumps AND the restored one's) —
+    rebased onto the shared wall clock and sorted: ``{"t_us": float,
+    "proc": str, "pid": int, "incarnation": int, "kind": str,
     "fields": dict}``."""
     from paddle_tpu.observability import distributed as dist
 
     out = []
     for doc in dist.load_dumps(dirname):
+        inc = int(doc.get("incarnation", 0) or 0)
         for t_us, kind, fields in dist.doc_flight_events(doc):
             out.append({"t_us": t_us, "proc": doc["proc"],
-                        "pid": doc.get("pid"), "kind": kind,
-                        "fields": fields})
+                        "pid": doc.get("pid"), "incarnation": inc,
+                        "kind": kind, "fields": fields})
     out.sort(key=lambda e: e["t_us"])
     return out
 
@@ -63,20 +72,50 @@ def merge(dirname: str):
 
 def format_events(events: List[Dict],
                   show_frames: bool = False) -> List[str]:
-    """One line per event, times relative to the first shown event."""
+    """One line per event, times relative to the first shown event.
+    Multi-incarnation timelines (a cold restart happened) tag each
+    line with ``i<n>`` and flag the disaster-recovery chain with
+    ``*`` so the kill -> cold-start -> restore -> refused-straggler
+    story reads at a glance."""
     shown = [e for e in events
              if show_frames or e["kind"] not in _FRAME_KINDS]
     if not shown:
         return []
+    multi_inc = len({e.get("incarnation", 0) for e in shown}) > 1
     t0 = shown[0]["t_us"]
     lines = []
     for e in shown:
         kv = " ".join("%s=%s" % (k, e["fields"][k])
                       for k in sorted(e["fields"]))
-        lines.append("+%9.3fs  %-12s %-20s %s"
-                     % ((e["t_us"] - t0) / 1e6, e["proc"], e["kind"],
+        proc = e["proc"]
+        if multi_inc:
+            proc = "i%d:%s" % (e.get("incarnation", 0), proc)
+        mark = "*" if e["kind"] in _DR_KINDS else " "
+        lines.append("+%9.3fs %s %-12s %-20s %s"
+                     % ((e["t_us"] - t0) / 1e6, mark, proc, e["kind"],
                         kv))
     return lines
+
+
+def dr_summary(events: List[Dict]) -> Optional[str]:
+    """One line summarizing the disaster-recovery chain, or None when
+    the job never cold-started: the restore cut, per-shard restore
+    rounds, and how many dead-incarnation stragglers the restored
+    fencing epochs refused."""
+    cold = [e for e in events if e["kind"] == "launch.cold_start"]
+    if not cold:
+        return None
+    restores = [e for e in events if e["kind"] == "ps.restore"]
+    refused = sum(1 for e in events if e["kind"] == "ps.fence_refused")
+    cut = cold[-1]["fields"].get("restore_round")
+    shards = sorted({"%s@r%s" % (e["fields"].get("shard"),
+                                 e["fields"].get("round"))
+                     for e in restores})
+    return ("disaster recovery: cold start to round %s "
+            "(incarnation %s), %d server restore(s) [%s], "
+            "%d stale-incarnation rpc(s) fence-refused"
+            % (cut, cold[-1]["fields"].get("incarnation"),
+               len(restores), " ".join(shards), refused))
 
 
 def print_postmortem(dirname: str, show_frames: bool = False,
@@ -90,6 +129,9 @@ def print_postmortem(dirname: str, show_frames: bool = False,
     procs = sorted({e["proc"] for e in events})
     print("== postmortem: %d flight events from %d process(es) %s =="
           % (len(events), len(procs), procs), file=out)
+    dr = dr_summary(events)
+    if dr:
+        print(dr, file=out)
     if mpath:
         # where each process's spans came from: "spool" = the on-disk
         # head+reservoir record (long-run safe), "ring" = the dump's

@@ -15,6 +15,12 @@ round — and records the two curves the ROADMAP asks for:
 - **wire savings**: replication bytes per round, delta vs the full
   anchor — a GB table touched on a handful of rows must ship row
   slices, not the table.
+- **durable-frame cost** (ISSUE 19): bytes the primary persists to
+  the crash-consistent round store per committed round
+  (``checkpoint.round_bytes{mode=delta}``) vs the full anchor frame,
+  asserted < 1%% of the anchor on the few-rows-touched table — plus a
+  measured cold restore of the table from that store, gated
+  bit-for-bit against the primary's final state.
 
 Output (--out) is a bench_diff-compatible record::
 
@@ -22,12 +28,17 @@ Output (--out) is a bench_diff-compatible record::
                               "step_ms":, "ps_digest_ms":,
                               "ps_digest_full_ms":,
                               "repl_delta_bytes_per_round":,
-                              "repl_anchor_bytes":}},
+                              "repl_anchor_bytes":,
+                              "ckpt_delta_bytes_per_round":,
+                              "ckpt_anchor_bytes":,
+                              "ckpt_restore_ms":}},
      "counters_total": {...}}
 
-``tools/bench_diff.py`` watches ``ps_digest_ms`` (lower is better):
-a change that silently regresses incremental digesting back toward
-full re-hashing fails the perf gate run-over-run.
+``tools/bench_diff.py`` watches ``ps_digest_ms`` (lower is better),
+``ckpt_delta_bytes_per_round`` and ``ckpt_restore_ms``: a change that
+silently regresses incremental digesting back toward full re-hashing,
+or durable frames back toward whole-table snapshots, fails the perf
+gate run-over-run.
 
 Usage: python tools/ps_scale_bench.py [--gb 0.25] [--rows 4]
            [--rounds 6] [--width 256] [--out rec.json] [--smoke]
@@ -41,8 +52,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import socket
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -85,20 +98,23 @@ def _sparse_block(scope):
     emb[rows] -= np.float32(0.1) * vals  # in place: rows only
 
 
-def _mk_pair(eps, height, width):
+def _mk_pair(eps, height, width, durable_dir=None):
     from paddle_tpu.distributed.ps_rpc import PSServer
 
     servers = []
+    scopes = []
     for ep in eps:
         scope = MiniScope()
         scope["emb"] = np.zeros((height, width), dtype=np.float32)
         s = PSServer(ep, MiniExec(), scope,
                      {"emb@GRAD": _sparse_block}, fanin=1,
-                     sync_mode=False, endpoints=eps, lease_ms=0)
+                     sync_mode=False, endpoints=eps, lease_ms=0,
+                     durable_dir=durable_dir)
         s._async_repl_every = 1  # every push is a replicated round
         s.start_background()
         servers.append(s)
-    return servers
+        scopes.append(scope)
+    return servers, scopes
 
 
 def _counter_delta(before, name, **labels):
@@ -115,17 +131,24 @@ def _snap(*specs):
             or 0 for n, ls in specs}
 
 
-def run_mode(height, width, rows_per_round, rounds, incremental):
+def run_mode(height, width, rows_per_round, rounds, incremental,
+             durable_dir=None):
     """One measured pass; returns (digest_ms_per_round,
-    delta_bytes_per_round, anchor_bytes, rounds_per_s)."""
-    from paddle_tpu.distributed.ps_rpc import PSClient
+    delta_bytes_per_round, anchor_bytes, rounds_per_s, ckpt) — ckpt is
+    None without ``durable_dir``, else the durable-frame measurements
+    {"delta_b", "anchor_b", "restore_ms", "bitwise"} from the
+    crash-consistent round store (ISSUE 19), including a timed cold
+    restore of the table on a fresh server, gated bit-for-bit."""
+    from paddle_tpu.distributed.ps_rpc import PSClient, PSServer
 
     os.environ["PADDLE_PS_INCR_DIGEST"] = "1" if incremental else "0"
     eps = ["127.0.0.1:%d" % _free_port() for _ in range(2)]
-    servers = _mk_pair(eps, height, width)
+    servers, scopes = _mk_pair(eps, height, width, durable_dir)
     specs = [("ps.digest_ms", {}),
              ("ps.replication_bytes", {"mode": "delta"}),
-             ("ps.replication_bytes", {"mode": "full"})]
+             ("ps.replication_bytes", {"mode": "full"}),
+             ("checkpoint.round_bytes", {"mode": "delta"}),
+             ("checkpoint.round_bytes", {"mode": "full"})]
     try:
         c = PSClient(",".join(eps), trainer_id=0)
         rng = np.random.RandomState(7)
@@ -149,7 +172,38 @@ def run_mode(height, width, rows_per_round, rounds, incremental):
         anchor_b = _counter_delta(base0, "ps.replication_bytes",
                                   mode="full")
         c.close()
-        return digest_ms, delta_b, anchor_b, rounds / dt
+        ckpt = None
+        if durable_dir:
+            ckpt = {
+                "delta_b": _counter_delta(
+                    base, "checkpoint.round_bytes",
+                    mode="delta") / rounds,
+                "anchor_b": _counter_delta(
+                    base0, "checkpoint.round_bytes", mode="full"),
+            }
+            final = np.array(scopes[0]["emb"])
+            for s in servers:
+                s.stop()
+            # timed cold restore on a FRESH server: load the newest
+            # restorable round (anchor + delta chain) from disk
+            scope2 = MiniScope()
+            scope2["emb"] = np.zeros((height, width),
+                                     dtype=np.float32)
+            ep2 = "127.0.0.1:%d" % _free_port()
+            os.environ["PADDLE_PS_RESTORE"] = "1"
+            try:
+                t0r = time.perf_counter()
+                s2 = PSServer(ep2, MiniExec(), scope2,
+                              {"emb@GRAD": _sparse_block}, fanin=1,
+                              sync_mode=False, endpoints=[ep2],
+                              lease_ms=0, durable_dir=durable_dir)
+                ckpt["restore_ms"] = (time.perf_counter() - t0r) * 1e3
+                s2.stop()
+            finally:
+                os.environ.pop("PADDLE_PS_RESTORE", None)
+            ckpt["bitwise"] = (scope2["emb"].tobytes()
+                               == final.tobytes())
+        return digest_ms, delta_b, anchor_b, rounds / dt, ckpt
     finally:
         for s in servers:
             s.stop()
@@ -182,16 +236,29 @@ def main(argv=None) -> int:
           "%d rounds" % (table_mb, height, args.width, args.rows,
                          args.rounds))
 
-    inc_ms, delta_b, anchor_b, rps = run_mode(
-        height, args.width, args.rows, args.rounds, incremental=True)
-    full_ms, delta_b2, _, _ = run_mode(
-        height, args.width, args.rows, args.rounds, incremental=False)
+    durable_dir = tempfile.mkdtemp(prefix="ps_scale_durable_")
+    try:
+        inc_ms, delta_b, anchor_b, rps, ckpt = run_mode(
+            height, args.width, args.rows, args.rounds,
+            incremental=True, durable_dir=durable_dir)
+        full_ms, delta_b2, _, _, _ = run_mode(
+            height, args.width, args.rows, args.rounds,
+            incremental=False)
+    finally:
+        shutil.rmtree(durable_dir, ignore_errors=True)
     print("[ps_scale] digest cost/round: incremental %.2f ms vs full "
           "re-hash %.2f ms (%.1fx)" % (inc_ms, full_ms,
                                        full_ms / max(inc_ms, 1e-9)))
     print("[ps_scale] wire: delta %.1f KB/round vs anchor %.1f MB "
           "(%.4f%%)" % (delta_b / 1024, anchor_b / (1 << 20),
                         100.0 * delta_b / max(anchor_b, 1)))
+    print("[ps_scale] durable: delta frame %.1f KB/round vs anchor "
+          "frame %.1f MB (%.4f%%), cold restore %.1f ms (bit-for-bit "
+          "%s)" % (ckpt["delta_b"] / 1024,
+                   ckpt["anchor_b"] / (1 << 20),
+                   100.0 * ckpt["delta_b"] / max(ckpt["anchor_b"], 1),
+                   ckpt["restore_ms"],
+                   "PASS" if ckpt["bitwise"] else "FAIL"))
     print("[ps_scale] %.1f rounds/s (incremental mode)" % rps)
 
     ok = True
@@ -204,6 +271,15 @@ def main(argv=None) -> int:
         print("[ps_scale] FAIL: delta bytes %.0f not under 1%% of "
               "the anchor %.0f" % (delta_b, anchor_b),
               file=sys.stderr)
+        ok = False
+    if not 0 < ckpt["delta_b"] < 0.01 * ckpt["anchor_b"]:
+        print("[ps_scale] FAIL: durable frame bytes %.0f not under "
+              "1%% of the anchor frame %.0f"
+              % (ckpt["delta_b"], ckpt["anchor_b"]), file=sys.stderr)
+        ok = False
+    if not ckpt["bitwise"]:
+        print("[ps_scale] FAIL: cold restore diverged from the "
+              "primary's final table", file=sys.stderr)
         ok = False
 
     if args.out:
@@ -219,6 +295,9 @@ def main(argv=None) -> int:
             "ps_digest_full_ms": round(full_ms, 4),
             "repl_delta_bytes_per_round": round(delta_b, 1),
             "repl_anchor_bytes": int(anchor_b),
+            "ckpt_delta_bytes_per_round": round(ckpt["delta_b"], 1),
+            "ckpt_anchor_bytes": int(ckpt["anchor_b"]),
+            "ckpt_restore_ms": round(ckpt["restore_ms"], 3),
         }}, "counters_total": {
             k: v for k, v in {
                 "ps.delta_rounds": obs.counter_value("ps.delta_rounds"),
