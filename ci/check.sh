@@ -80,9 +80,13 @@ fi
 #    ... and (ISSUE 16) sampled in-production capture must default
 #    off with its per-step hook under the same <1us budget —
 #    PADDLE_TPU_SAMPLE_EVERY is un-set for the same reason
+#    ... and (ISSUE 20) the windowed time-series sampler must default
+#    off (it arms off PADDLE_TPU_METRICS_DIR) with its hooks under
+#    the same budget — PADDLE_TPU_TIMESERIES is un-set likewise
 env -u PADDLE_TPU_METRICS -u FLAGS_tpu_metrics \
     -u PADDLE_TPU_METRICS_DIR -u PADDLE_TPU_DEVICE_TRACE \
     -u PADDLE_TPU_VERIFY_IR -u PADDLE_TPU_SAMPLE_EVERY \
+    -u PADDLE_TPU_TIMESERIES -u PADDLE_TPU_TIMESERIES_WINDOWS \
     python -m paddle_tpu.tools.obs_overhead
 
 echo "== gate 5: serving =="
@@ -380,7 +384,24 @@ echo "== gate 8b: steering drill =="
 # and the active-plan pointer, with installs == promoted entries
 # (zero un-audited plan switches, the PlanStore refuses structurally).
 env -u PADDLE_TPU_METRICS_DIR -u PADDLE_TPU_SAMPLE_EVERY \
+    -u PADDLE_TPU_TIMESERIES \
     python tools/steering_drill.py
+
+echo "== gate 8c: drifting-load A/B objective drill =="
+# the ISSUE-20 acceptance drill (seeded, in-process, ~5s): under
+# injected monotone load drift (+4%/window), the LEGACY flat
+# comparator run against a stale incumbent record PROMOTES an
+# objectively-worse serving ladder (drift masquerades as a +40%
+# throughput win, every true regression hides under the flat noise
+# floors) while the interleaved A/B canary — adjacent incumbent/
+# candidate windows scored pairwise under a weighted objective —
+# ROLLS BACK the same plan 0/3 AND PROMOTES a genuinely-better plan
+# 3/3 in the same run; every window, pairwise verdict and objective
+# term is asserted present in steering_audit.json, and ft_timeline
+# renders the A/B window timeline from that trail.
+env -u PADDLE_TPU_METRICS_DIR -u PADDLE_TPU_SAMPLE_EVERY \
+    -u PADDLE_TPU_TIMESERIES -u PADDLE_TPU_AB_PAIRS \
+    python tools/steering_drill.py --drift
 
 if [[ "${SKIP_TESTS:-0}" != "1" ]]; then
     echo "== gate 9: test suite =="

@@ -236,6 +236,8 @@ def _kv_allreduce(flat: np.ndarray, nranks: int) -> np.ndarray:
         for r in range(nranks):
             try:
                 client.key_value_delete("%s/%d" % (base, r))
-            except Exception:
-                pass  # stale keys only cost coordinator memory
+            except RuntimeError:
+                # XlaRuntimeError from the coordinator: stale keys
+                # only cost coordinator memory, never the allreduce
+                pass
     return out

@@ -33,6 +33,12 @@ Audit entry schema (``steering_audit_v1``)::
      "regressions": int, "regressed_metrics": [str, ...],
      "trigger": {...proposal trigger block or null...},
      "comparison": {...Comparison.to_dict()...}}
+
+Interleaved A/B entries (``run_ab_canary``, ISSUE 20) carry
+``"protocol": "ab_interleaved"`` plus ``pairs`` / ``ok_pairs`` /
+``objective`` / ``objective_score`` / ``windows`` (every measurement
+window with open/close stamps and its record) / ``pair_verdicts``
+(every pairwise comparison) instead of the single ``comparison``.
 """
 from __future__ import annotations
 
@@ -44,12 +50,19 @@ from typing import Callable, Dict, List, Optional
 
 from . import comparator, flight, steering
 from . import inc as _inc
+from . import set_gauge as _set_gauge
 
 __all__ = ["AuditTrail", "PlanStore", "CanaryDecision", "run_canary",
-           "AUDIT_SCHEMA", "AUDIT_NAME"]
+           "run_ab_canary", "AUDIT_SCHEMA", "AUDIT_NAME",
+           "AB_PROTOCOL", "AB_PAIRS_ENV", "DEFAULT_AB_PAIRS"]
 
 AUDIT_SCHEMA = "steering_audit_v1"
 AUDIT_NAME = "steering_audit.json"
+# interleaved A/B protocol (ISSUE 20): tagged into every A/B audit
+# entry so tooling (ft_timeline) can tell the two protocols apart
+AB_PROTOCOL = "ab_interleaved"
+AB_PAIRS_ENV = "PADDLE_TPU_AB_PAIRS"
+DEFAULT_AB_PAIRS = 3
 
 
 class AuditTrail:
@@ -185,7 +198,9 @@ def run_canary(proposal, incumbent, measure: Callable,
                plan_store: Optional[PlanStore] = None,
                audit: Optional[AuditTrail] = None,
                require_improvement: Optional[str] = None,
-               min_improvement: float = 0.0) -> CanaryDecision:
+               min_improvement: float = 0.0,
+               objective: Optional["comparator.Objective"] = None
+               ) -> CanaryDecision:
     """One canary evaluation of ``proposal`` against ``incumbent``.
 
     - ``proposal``: a daemon proposal artifact (``{"plan": ...,
@@ -224,11 +239,16 @@ def run_canary(proposal, incumbent, measure: Callable,
         trigger = None
         digest = steering.plan_digest(plan)
 
+    if objective is None and isinstance(proposal, dict) \
+            and isinstance(proposal.get("objective"), dict):
+        objective = comparator.Objective.from_dict(
+            proposal["objective"])
+
     if apply_fn is not None:
         apply_fn(plan)
     head = measure(plan)
     cmp = comparator.compare(incumbent, head, threshold,
-                             counters_threshold)
+                             counters_threshold, objective=objective)
 
     promoted = cmp.ok
     reason = cmp.verdict
@@ -250,6 +270,12 @@ def run_canary(proposal, incumbent, measure: Callable,
         "trigger": trigger,
         "comparison": cmp.to_dict(),
     }
+    if objective is not None:
+        entry["objective"] = objective.to_dict()
+        entry["objective_score"] = cmp.objective_score
+        if cmp.objective_score is not None:
+            _set_gauge("steering.objective_score",
+                       cmp.objective_score, steerer=steerer or "none")
     if audit is not None:
         entry = audit.append(entry)
 
@@ -276,3 +302,180 @@ def run_canary(proposal, incumbent, measure: Callable,
                       regressions=cmp.regressions)
 
     return CanaryDecision(promoted, reason, plan, digest, cmp, entry)
+
+
+def _ab_pairs_default() -> int:
+    try:
+        n = int(os.environ.get(AB_PAIRS_ENV, "") or DEFAULT_AB_PAIRS)
+    except ValueError:
+        n = DEFAULT_AB_PAIRS
+    return max(1, n)
+
+
+def run_ab_canary(proposal, measure: Callable,
+                  *, steerer: Optional[str] = None,
+                  pairs: Optional[int] = None,
+                  objective: Optional["comparator.Objective"] = None,
+                  threshold: float = 0.10,
+                  counters_threshold: float = 0.25,
+                  apply_fn: Optional[Callable] = None,
+                  revert_fn: Optional[Callable] = None,
+                  promote_fn: Optional[Callable] = None,
+                  rollback_fn: Optional[Callable] = None,
+                  plan_store: Optional[PlanStore] = None,
+                  audit: Optional[AuditTrail] = None,
+                  min_score: float = 0.0) -> CanaryDecision:
+    """Interleaved A/B canary: alternate incumbent and candidate
+    measurement windows N times (A-B-A-B-...), score each ADJACENT
+    pair, and promote only on strict-majority pairwise agreement (plus
+    net objective improvement when an objective is configured).
+
+    Why interleaved: a single before/after comparison (``run_canary``
+    against a stale incumbent record) confuses plan effect with load
+    drift — under monotone drift everything measured later looks
+    better (or worse) regardless of the plan. Adjacent A/B windows are
+    at most one window apart in time, so the drift contribution to
+    each pairwise delta is bounded by one window of drift and the same
+    bias applies to every pair; a plan that only "wins" because of
+    drift loses the pairwise vote. ``tools/steering_drill.py --drift``
+    demonstrates exactly this divergence.
+
+    - ``measure(plan_or_None) -> record``: one measurement window.
+      ``None`` = measure the incumbent; a plan = measure the
+      candidate. The caller owns window length.
+    - ``revert_fn(plan)``: point the canary back at the incumbent
+      before each A window (optional when ``measure(None)`` handles
+      it); ``apply_fn(plan)`` points it at the candidate before each
+      B window.
+    - ``pairs``: A/B window pairs to run; default from the proposal's
+      ``ab_pairs``, then ``PADDLE_TPU_AB_PAIRS``, then 3.
+    - ``objective``: weighted scoring for every pairwise comparison;
+      default from the proposal's ``objective`` block. With one, the
+      MEAN pairwise score must exceed ``min_score`` on top of the
+      majority vote; a hard-floor violation in ANY window vetoes
+      unconditionally.
+
+    The audit entry (appended BEFORE the world changes, like every
+    canary decision) records every window, every pairwise verdict with
+    its full comparison, and every objective term.
+    """
+    if isinstance(proposal, dict) and "plan" in proposal:
+        plan = proposal["plan"]
+        trigger = {k: proposal.get(k) for k in
+                   ("steerer", "metric", "baseline", "observed",
+                    "threshold", "created_at") if k in proposal}
+        steerer = steerer or proposal.get("steerer")
+        digest = proposal.get("plan_digest") \
+            or steering.plan_digest(plan)
+        if objective is None and \
+                isinstance(proposal.get("objective"), dict):
+            objective = comparator.Objective.from_dict(
+                proposal["objective"])
+        if pairs is None and proposal.get("ab_pairs"):
+            pairs = int(proposal["ab_pairs"])
+    else:
+        plan = proposal
+        trigger = None
+        digest = steering.plan_digest(plan)
+    pairs = max(1, int(pairs)) if pairs else _ab_pairs_default()
+
+    windows: List[Dict] = []
+    pair_docs: List[Dict] = []
+    ok_pairs = 0
+    hard_veto = False
+    last_cmp = None
+
+    def _window(phase: str, pair: int, plan_arg):
+        flight.record("canary.window", phase=phase, pair=pair,
+                      steerer=steerer, plan_digest=digest)
+        _inc("canary.windows", phase=phase, steerer=steerer or "none")
+        t_open = time.time()
+        record = measure(plan_arg)
+        windows.append({"seq": len(windows), "pair": pair,
+                        "phase": phase, "t_open": t_open,
+                        "t_close": time.time(), "record": record})
+        return record
+
+    for i in range(pairs):
+        if revert_fn is not None:
+            revert_fn(plan)
+        rec_a = _window("incumbent", i, None)
+        if apply_fn is not None:
+            apply_fn(plan)
+        rec_b = _window("candidate", i, plan)
+        cmp = comparator.compare(rec_a, rec_b, threshold,
+                                 counters_threshold,
+                                 objective=objective)
+        last_cmp = cmp
+        if cmp.ok:
+            ok_pairs += 1
+        if cmp.verdict == "hard_floor":
+            hard_veto = True
+        pair_docs.append({"pair": i, "ok": cmp.ok,
+                          "verdict": cmp.verdict,
+                          "objective_score": cmp.objective_score,
+                          "comparison": cmp.to_dict()})
+
+    scores = [d["objective_score"] for d in pair_docs
+              if d["objective_score"] is not None]
+    mean_score = (sum(scores) / len(scores)) if scores else None
+
+    promoted = ok_pairs * 2 > pairs
+    reason = "ab_majority:%d/%d" % (ok_pairs, pairs)
+    if hard_veto:
+        # an SLO breach in any window vetoes regardless of the vote
+        promoted = False
+        reason = "ab_hard_floor"
+    elif promoted and objective is not None and \
+            (mean_score is None or mean_score <= min_score):
+        promoted = False
+        reason = "ab_no_objective_improvement"
+
+    if mean_score is not None:
+        _set_gauge("steering.objective_score", mean_score,
+                   steerer=steerer or "none")
+
+    entry = {
+        "schema": AUDIT_SCHEMA,
+        "protocol": AB_PROTOCOL,
+        "decision": "promoted" if promoted else "rolled_back",
+        "reason": reason,
+        "steerer": steerer,
+        "plan_digest": digest,
+        "pairs": pairs,
+        "ok_pairs": ok_pairs,
+        "objective": objective.to_dict() if objective is not None
+        else None,
+        "objective_score": mean_score,
+        "windows": windows,
+        "pair_verdicts": pair_docs,
+        "trigger": trigger,
+    }
+    if audit is not None:
+        entry = audit.append(entry)
+
+    if promoted:
+        if plan_store is not None:
+            if audit is None:
+                raise ValueError(
+                    "a PlanStore promotion requires an AuditTrail — "
+                    "every plan switch must be audited")
+            plan_store.install(plan, entry)
+        if promote_fn is not None:
+            promote_fn(plan)
+        _inc("canary.promoted", steerer=steerer or "none")
+        flight.record("canary.promoted", steerer=steerer,
+                      plan_digest=digest, protocol=AB_PROTOCOL,
+                      ok_pairs=ok_pairs, pairs=pairs,
+                      objective_score=mean_score)
+    else:
+        if rollback_fn is not None:
+            rollback_fn(plan)
+        _inc("canary.rolled_back", steerer=steerer or "none")
+        flight.record("canary.rolled_back", steerer=steerer,
+                      plan_digest=digest, protocol=AB_PROTOCOL,
+                      reason=reason, ok_pairs=ok_pairs, pairs=pairs,
+                      objective_score=mean_score)
+
+    return CanaryDecision(promoted, reason, plan, digest, last_cmp,
+                          entry)

@@ -149,6 +149,7 @@ def _summary(step, report, capture_ms):
 
 def _write_rolling_report(kind, step, report, capture_ms) -> None:
     from .distributed import metrics_dir, process_identity
+    from . import timeseries as _ts
     from ..checkpoint import atomic_write_bytes
     import json
 
@@ -161,8 +162,19 @@ def _write_rolling_report(kind, step, report, capture_ms) -> None:
         base += ".r%d" % restart
     with _lock:
         hist = _history.setdefault(kind, [])
-        hist.append(_summary(step, report, capture_ms))
+        summary = _summary(step, report, capture_ms)
+        hist.append(summary)
         del hist[:-HISTORY_CAP]
+    if _ts.series_enabled():
+        # sampled-profile trends join the windowed rings so steering
+        # rules can judge "step_ms over the last window" too
+        for k, v in summary.items():
+            if k in ("step", "wrote_at"):
+                continue
+            if isinstance(v, (int, float)):
+                _ts.record_point("capture.%s{engine=%s}" % (k, kind),
+                                 v, wall_ts=summary["wrote_at"])
+    with _lock:
         doc = {
             "schema": SAMPLED_PROFILE_SCHEMA,
             "proc": base,

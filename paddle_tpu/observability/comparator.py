@@ -35,6 +35,7 @@ __all__ = [
     "WATCHED", "ABS_NOISE_FLOOR", "COUNTER_WATCH_GROWS_BAD",
     "load", "workloads", "counter_totals",
     "diff_records", "diff_counters", "compare", "Comparison",
+    "Objective",
 ]
 
 # per-workload metrics worth gating; direction: +1 higher is better,
@@ -105,6 +106,12 @@ WATCHED = (
     # (min/max ratio). A collapse means the cost model drifted off the
     # machine — the plan may still "work" while steering wrong.
     ("placement_agreement", +1),
+    # objective-driven canaries (ISSUE 20): records that carry the
+    # scalar objective score of an A/B decision gate it here too — a
+    # change that silently degrades what the steering loop is
+    # optimizing for fails CI even when every raw metric stays inside
+    # its own flat threshold
+    ("objective_score", +1),
 )
 
 # absolute noise floors for measured-timing metrics: a relative
@@ -140,6 +147,8 @@ ABS_NOISE_FLOOR = {
     "ckpt_restore_ms": 20.0,
     # predicted-vs-measured ratio moves with CI-box timing noise
     "placement_agreement": 0.15,
+    # the objective score inherits jitter from every weighted term
+    "objective_score": 0.05,
 }
 
 # counter totals (metrics.json) where growth is a regression.
@@ -289,20 +298,173 @@ def diff_counters(base, head, threshold
         yield key, bv, hv, rel, grows_bad and rel > threshold
 
 
+class Objective:
+    """A weighted multi-metric objective: per-metric weight, direction
+    and absolute noise floor fold every compared row into ONE scalar
+    score, with full per-term provenance for the audit trail.
+
+    - ``weights``: {metric: weight > 0}. Weights are normalized (they
+      only express RELATIVE importance): {"a": 2, "b": 2} scores
+      identically to {"a": 1, "b": 1}.
+    - ``directions``: per-metric override; required for metrics not in
+      ``WATCHED``. An override that CONTRADICTS the watched direction
+      is a configuration bug and raises (a rule author flipping
+      ``step_ms`` to higher-is-better is never what they meant).
+    - ``floors``: per-metric absolute noise floor override; defaults
+      to ``ABS_NOISE_FLOOR``. A mean absolute delta at-or-under the
+      floor contributes 0 to the score (noise is not signal in EITHER
+      direction).
+    - ``hard_floors``: {metric: absolute bound on the HEAD value} —
+      SLO-style unconditional vetoes. For a lower-is-better metric the
+      head may never EXCEED the bound (p99_ms may never pass 250ms);
+      for higher-is-better it may never DROP BELOW it. A hard-floor
+      violation vetoes promotion regardless of the score.
+
+    The score is the weight-normalized sum over configured metrics of
+    ``direction * mean(rel_delta)`` (positive = net improvement). A
+    configured metric missing from the comparison contributes 0 but
+    keeps its weight in the normalization and is flagged in its term —
+    silently dropping a term would inflate the remaining ones.
+    """
+
+    __slots__ = ("weights", "directions", "floors", "hard_floors")
+
+    def __init__(self, weights: Dict[str, float], *,
+                 directions: Optional[Dict[str, int]] = None,
+                 floors: Optional[Dict[str, float]] = None,
+                 hard_floors: Optional[Dict[str, float]] = None):
+        if not isinstance(weights, dict) or not weights:
+            raise ValueError("Objective needs a non-empty weights dict")
+        self.weights = {}
+        for m, w in weights.items():
+            w = float(w)
+            if w <= 0:
+                raise ValueError("objective weight for %r must be > 0, "
+                                 "got %r" % (m, w))
+            self.weights[m] = w
+        self.hard_floors = {m: float(v)
+                            for m, v in (hard_floors or {}).items()}
+        watched = dict(WATCHED)
+        directions = directions or {}
+        self.directions = {}
+        for m in sorted(set(self.weights) | set(self.hard_floors)):
+            explicit = directions.get(m)
+            if explicit is not None:
+                explicit = int(explicit)
+                if explicit not in (-1, 1):
+                    raise ValueError("direction for %r must be +1 or "
+                                     "-1, got %r" % (m, explicit))
+                if m in watched and watched[m] != explicit:
+                    raise ValueError(
+                        "direction conflict for %r: objective says %+d "
+                        "but WATCHED says %+d" % (m, explicit,
+                                                  watched[m]))
+                self.directions[m] = explicit
+            elif m in watched:
+                self.directions[m] = watched[m]
+            else:
+                raise ValueError(
+                    "metric %r is not in WATCHED; an objective over it "
+                    "needs an explicit direction" % (m,))
+        self.floors = {}
+        for m in self.weights:
+            fl = (floors or {}).get(m)
+            self.floors[m] = float(fl) if fl is not None \
+                else float(ABS_NOISE_FLOOR.get(m, 0.0))
+
+    def score_rows(self, rows: List[tuple]
+                   ) -> Tuple[float, List[Dict]]:
+        """Fold comparison rows into ``(score, terms)``. Each term
+        carries its full provenance (weight, direction, mean relative
+        delta, floor decision, contribution)."""
+        wsum = sum(self.weights.values())
+        by_metric: Dict[str, List[tuple]] = {}
+        for row in rows:
+            _wl, m, bv, hv, rel, _bad = row
+            if m in self.weights and isinstance(rel, float) and \
+                    math.isfinite(rel) and \
+                    isinstance(bv, (int, float)) and \
+                    isinstance(hv, (int, float)):
+                by_metric.setdefault(m, []).append((float(bv),
+                                                    float(hv),
+                                                    float(rel)))
+        score = 0.0
+        terms = []
+        for m in sorted(self.weights):
+            weight = self.weights[m] / wsum
+            got = by_metric.get(m)
+            if not got:
+                terms.append({"metric": m, "weight": weight,
+                              "missing": True, "gain": 0.0,
+                              "contribution": 0.0})
+                continue
+            rel = sum(r for _b, _h, r in got) / len(got)
+            abs_delta = sum(abs(h - b) for b, h, _r in got) / len(got)
+            gain = rel * self.directions[m]
+            floored = abs_delta <= self.floors[m]
+            contribution = 0.0 if floored else weight * gain
+            score += contribution
+            terms.append({
+                "metric": m, "weight": weight,
+                "direction": self.directions[m],
+                "base": got[0][0], "head": got[0][1],
+                "rel": rel, "gain": gain, "abs_delta": abs_delta,
+                "floor": self.floors[m], "floored": floored,
+                "contribution": contribution,
+            })
+        return score, terms
+
+    def hard_floor_violations(self, rows: List[tuple]) -> List[Dict]:
+        """Every (metric, workload) where the HEAD value sits past its
+        SLO bound, regardless of relative movement."""
+        out = []
+        for _wl, m, _bv, hv, _rel, _bad in rows:
+            bound = self.hard_floors.get(m)
+            if bound is None or not isinstance(hv, (int, float)):
+                continue
+            d = self.directions[m]
+            if (d < 0 and float(hv) > bound) or \
+                    (d > 0 and float(hv) < bound):
+                out.append({"metric": m, "workload": _wl,
+                            "bound": bound, "head": float(hv)})
+        return out
+
+    def to_dict(self) -> Dict:
+        return {"weights": dict(self.weights),
+                "directions": dict(self.directions),
+                "floors": dict(self.floors),
+                "hard_floors": dict(self.hard_floors)}
+
+    @classmethod
+    def from_dict(cls, doc: Dict) -> "Objective":
+        return cls(doc.get("weights") or {},
+                   directions=doc.get("directions") or None,
+                   floors=doc.get("floors") or None,
+                   hard_floors=doc.get("hard_floors") or None)
+
+
 class Comparison:
     """The structured result of ``compare``: every row both generators
     yielded, the regression count, and a one-word verdict the canary
-    writes into its audit trail."""
+    writes into its audit trail.
+
+    With an ``objective`` attached, record-row regressions stop being
+    individually fatal — they become weighted score terms, so a net
+    win can carry one bounded regression. Three things still veto
+    unconditionally: nothing comparable (``no_overlap``), a regressed
+    WATCHED counter total (structural/error counters are never
+    tradeable), and an objective ``hard_floor`` violation."""
 
     __slots__ = ("rows", "counter_rows", "threshold",
-                 "counters_threshold")
+                 "counters_threshold", "objective")
 
     def __init__(self, rows, counter_rows, threshold,
-                 counters_threshold):
+                 counters_threshold, objective=None):
         self.rows: List[tuple] = rows
         self.counter_rows: List[tuple] = counter_rows
         self.threshold = threshold
         self.counters_threshold = counters_threshold
+        self.objective: Optional[Objective] = objective
 
     @property
     def compared(self) -> int:
@@ -319,16 +481,56 @@ class Comparison:
             [r[0] for r in self.counter_rows if r[-1]]
 
     @property
+    def counter_regressions(self) -> int:
+        return sum(1 for r in self.counter_rows if r[-1])
+
+    @property
+    def objective_score(self) -> Optional[float]:
+        """Weighted net score (positive = improvement); None when no
+        objective is attached."""
+        if self.objective is None:
+            return None
+        score, _terms = self.objective.score_rows(self.rows)
+        return score
+
+    def objective_result(self) -> Optional[Dict]:
+        """Full objective evaluation: score, per-term provenance, and
+        hard-floor violations. None without an objective."""
+        if self.objective is None:
+            return None
+        score, terms = self.objective.score_rows(self.rows)
+        violations = self.objective.hard_floor_violations(self.rows)
+        return {"score": score, "terms": terms,
+                "hard_floor_violations": violations,
+                "ok": bool(self.compared > 0 and not violations and
+                           self.counter_regressions == 0 and
+                           score > 0)}
+
+    @property
     def ok(self) -> bool:
+        if self.objective is not None:
+            res = self.objective_result()
+            return bool(res and res["ok"])
         return self.compared > 0 and self.regressions == 0
 
     @property
     def verdict(self) -> str:
-        """``"ok"`` | ``"regression"`` | ``"no_overlap"`` (nothing in
-        common to compare — treated as NOT ok: a canary that measured
-        nothing comparable must never promote)."""
+        """Flat mode: ``"ok"`` | ``"regression"`` | ``"no_overlap"``
+        (nothing in common to compare — treated as NOT ok: a canary
+        that measured nothing comparable must never promote).
+        Objective mode: ``"objective_improved"`` |
+        ``"objective_regression"`` | ``"hard_floor"`` |
+        ``"counter_regression"`` | ``"no_overlap"``."""
         if not self.compared:
             return "no_overlap"
+        if self.objective is not None:
+            res = self.objective_result()
+            if res["hard_floor_violations"]:
+                return "hard_floor"
+            if self.counter_regressions:
+                return "counter_regression"
+            return "objective_improved" if res["score"] > 0 \
+                else "objective_regression"
         return "regression" if self.regressions else "ok"
 
     def improvement(self, metric: str) -> Optional[float]:
@@ -350,7 +552,7 @@ class Comparison:
             return rel if isinstance(rel, float) and math.isfinite(rel) \
                 else "inf"
 
-        return {
+        doc = {
             "verdict": self.verdict,
             "ok": self.ok,
             "compared": self.compared,
@@ -366,15 +568,27 @@ class Comparison:
                  "rel": _rel(rel), "regressed": bool(bad)}
                 for key, bv, hv, rel, bad in self.counter_rows],
         }
+        if self.objective is not None:
+            # key present ONLY in objective mode — the default dict is
+            # byte-identical with every pre-objective audit/CI record
+            doc["objective"] = {
+                "config": self.objective.to_dict(),
+                "result": self.objective_result(),
+            }
+        return doc
 
 
 def compare(base, head, threshold: float = 0.10,
-            counters_threshold: float = 0.25) -> Comparison:
+            counters_threshold: float = 0.25,
+            objective: Optional[Objective] = None) -> Comparison:
     """One call over both generators. ``base``/``head`` are already-
-    parsed record documents (use ``load`` for files)."""
+    parsed record documents (use ``load`` for files). With an
+    ``objective``, ``ok``/``verdict`` switch to weighted-score
+    semantics; the default (None) path is unchanged."""
     return Comparison(
         rows=list(diff_records(base, head, threshold)),
         counter_rows=list(diff_counters(base, head,
                                         counters_threshold)),
         threshold=threshold,
-        counters_threshold=counters_threshold)
+        counters_threshold=counters_threshold,
+        objective=objective)

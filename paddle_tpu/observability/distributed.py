@@ -51,7 +51,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from . import flight, tracing
+from . import flight, timeseries, tracing
 
 __all__ = ["TraceContext", "current", "set_current", "trace",
            "child_span", "record_span", "inject", "extract",
@@ -487,6 +487,14 @@ def _dump_process_locked(path, _obs, atomic_write_bytes):
     }
     if sp is not None:
         doc["spool"] = sp.stats()
+    if timeseries.series_enabled():
+        # sample this snapshot into the windowed rings and ship the
+        # rings with the dump; older dumps simply lack the key
+        timeseries.record_samples(doc["metrics"],
+                                  wall_ts=doc["wrote_at"])
+        series = timeseries.process_series()
+        if series:
+            doc["series"] = series
     atomic_write_bytes(path, json.dumps(doc, default=str).encode())
     return path
 
@@ -760,6 +768,8 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
     totals: Dict[str, float] = {}
     events: List[Dict] = []
     metas: List[Dict] = []
+    per_series: Dict[str, Dict] = {}
+    series_skews: Dict[str, float] = {}
     for doc in docs:
         key = doc["proc"]
         # cross-host clock correction: rebase this process onto the
@@ -799,6 +809,13 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
                               "applied": skew} if (key in clock_offsets)
             else None,
         }
+        ser = doc.get("series")
+        if isinstance(ser, dict) and ser:
+            # windowed time-series rings (timeseries.py); ranks whose
+            # dumps predate the field just don't contribute windows
+            processes[key]["series"] = ser
+            per_series[key] = ser
+            series_skews[key] = skew
         for qn, v in (doc.get("metrics") or {}).get("counters",
                                                     {}).items():
             totals[qn] = totals.get(qn, 0) + v
@@ -829,6 +846,11 @@ def merge_job_dir(dirname: str) -> Tuple[Optional[str], Optional[str]]:
             processes[key]["sampled_profile"] = sdoc
     merged = {"merged_at": time.time(), "incarnation": inc,
               "processes": processes, "counters_total": totals}
+    if per_series:
+        # job-aligned windows: every rank's timestamps rebased by its
+        # APPLIED skew so windowed deltas line up across hosts
+        merged["series_windows"] = timeseries.job_windows(
+            per_series, skews_us=series_skews)
     if sampled:
         merged["sampled_profiles"] = sampled
         merged["sampled_profile_drift"] = sampled_profile_drift(sampled)

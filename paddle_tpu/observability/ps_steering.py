@@ -40,6 +40,13 @@ Two skew signals feed the same steerer:
   ``ps.row_heat`` counters. Deterministic for a deterministic
   workload, which is what a seeded CI drill needs
   (``row_load_rule``); production rules may combine both.
+
+Both extractors are WINDOWED-FIRST since ISSUE 20: when the merged
+doc carries ``series_windows`` (observability/timeseries.py rings
+folded by ``merge_job_dir``), the skew is computed over the LAST
+WINDOW's deltas — "hot over the last few dump ticks", not "hot since
+process start" — with the lifetime counter/histogram path kept as a
+bit-identical fallback for docs without series.
 """
 from __future__ import annotations
 
@@ -51,6 +58,7 @@ from . import steering
 
 __all__ = ["apply_skew_value", "shard_apply_means", "table_heat",
            "shard_row_load", "row_load_skew_value",
+           "windowed_shard_row_load", "windowed_shard_apply_means",
            "propose_migrate_range", "hot_shard_rule",
            "row_load_rule", "STEERER_NAME", "HEAT_BUCKETS"]
 
@@ -106,21 +114,71 @@ def shard_apply_means(doc: Dict, table: str = "_round",
             if counts.get(sh, 0) >= min_count}
 
 
+def windowed_shard_apply_means(doc: Dict, table: str = "_round",
+                               min_count: int = 1) -> Dict[int, float]:
+    """{shard: mean apply ms OVER THE LAST WINDOW} from the merged
+    ``series_windows`` (timeseries.py ships each ``ps.apply_ms``
+    histogram as a monotone ``#sum``/``#count`` pair, so the windowed
+    mean is delta(sum)/delta(count)). Empty when no series exist —
+    callers fall back to the lifetime ``shard_apply_means``."""
+    wins = doc.get("series_windows")
+    if not isinstance(wins, dict):
+        return {}
+    sums: Dict[int, float] = {}
+    counts: Dict[int, float] = {}
+    for qn, win in wins.items():
+        if not qn.endswith("#sum") or not isinstance(win, dict):
+            continue
+        name, labels = _parse_labels(qn[:-len("#sum")])
+        if name != "ps.apply_ms" or labels.get("table") != table \
+                or "shard" not in labels:
+            continue
+        cwin = wins.get(qn[:-len("#sum")] + "#count")
+        if not isinstance(cwin, dict):
+            continue
+        ds, dc = win.get("delta"), cwin.get("delta")
+        if not isinstance(ds, (int, float)) \
+                or not isinstance(dc, (int, float)) or dc <= 0:
+            continue
+        try:
+            shard = int(labels["shard"])
+        except ValueError:
+            continue
+        sums[shard] = sums.get(shard, 0.0) + float(ds)
+        counts[shard] = counts.get(shard, 0.0) + float(dc)
+    return {sh: sums[sh] / counts[sh] for sh in sums
+            if counts.get(sh, 0) >= min_count}
+
+
+def _skew_ratio(per_shard: Dict[int, float]) -> Optional[float]:
+    if len(per_shard) < 2:
+        return None
+    lo, hi = min(per_shard.values()), max(per_shard.values())
+    if lo <= 0:
+        return None
+    return hi / lo
+
+
 def apply_skew_value(table: str = "_round", min_count: int = 4,
                      ) -> Callable[[Dict], Optional[float]]:
     """WatchRule extractor: max/min ratio of per-shard mean apply time
     (>= 1.0; 1.0 = perfectly balanced). None until two shards have
     each observed ``min_count`` applies — skew over one shard or over
-    a handful of samples is noise, not a migration signal."""
+    a handful of samples is noise, not a migration signal.
+
+    Windowed-first (ISSUE 20): when the merged doc carries
+    ``series_windows`` with enough samples, the skew is computed over
+    the LAST WINDOW's apply means — a shard that went hot five minutes
+    ago reads hot now, instead of being averaged against hours of
+    balanced history. Docs without series (old dumps, sampling off)
+    take the lifetime path unchanged."""
     def _get(doc):
-        means = shard_apply_means(doc, table=table,
-                                  min_count=min_count)
-        if len(means) < 2:
-            return None
-        lo, hi = min(means.values()), max(means.values())
-        if lo <= 0:
-            return None
-        return hi / lo
+        skew = _skew_ratio(windowed_shard_apply_means(
+            doc, table=table, min_count=min_count))
+        if skew is not None:
+            return skew
+        return _skew_ratio(shard_apply_means(doc, table=table,
+                                             min_count=min_count))
     return _get
 
 
@@ -168,22 +226,57 @@ def shard_row_load(doc: Dict,
     return out
 
 
+def windowed_shard_row_load(doc: Dict, table: Optional[str] = None
+                            ) -> Dict[int, float]:
+    """{shard: row touches OVER THE LAST WINDOW} from the merged
+    ``series_windows`` deltas of the ``ps.row_heat`` counters. Empty
+    when no series exist — callers fall back to the lifetime
+    ``shard_row_load``."""
+    wins = doc.get("series_windows")
+    if not isinstance(wins, dict):
+        return {}
+    out: Dict[int, float] = {}
+    for qn, win in wins.items():
+        if not isinstance(win, dict):
+            continue
+        name, labels = _parse_labels(qn)
+        if name != "ps.row_heat" \
+                or not isinstance(win.get("delta"), (int, float)):
+            continue
+        if table is not None and labels.get("table") != table:
+            continue
+        try:
+            shard = int(labels.get("shard", ""))
+        except ValueError:
+            continue
+        out[shard] = out.get(shard, 0.0) + float(win["delta"])
+    return out
+
+
 def row_load_skew_value(table: Optional[str] = None,
                         min_rows: int = 8,
                         ) -> Callable[[Dict], Optional[float]]:
     """WatchRule extractor: max/min ratio of per-shard row touches
     (>= 1.0). None until two shards have each absorbed ``min_rows``
     touches — same noise discipline as ``apply_skew_value``, but over
-    counters, so a seeded workload yields a seeded signal."""
+    counters, so a seeded workload yields a seeded signal.
+
+    Windowed-first (ISSUE 20): with merged ``series_windows``
+    present, the ratio is over the LAST WINDOW's row touches (skew
+    since the last few dump ticks, not since process start); the
+    ``min_rows`` floor then applies per window. Lifetime fallback is
+    bit-identical for docs without series."""
     def _get(doc):
+        wload = {s: v
+                 for s, v in windowed_shard_row_load(doc,
+                                                     table).items()
+                 if v >= min_rows}
+        skew = _skew_ratio(wload)
+        if skew is not None:
+            return skew
         load = {s: v for s, v in shard_row_load(doc, table).items()
                 if v >= min_rows}
-        if len(load) < 2:
-            return None
-        lo, hi = min(load.values()), max(load.values())
-        if lo <= 0:
-            return None
-        return hi / lo
+        return _skew_ratio(load)
     return _get
 
 

@@ -43,8 +43,9 @@ from . import inc as _inc
 from . import steering
 
 __all__ = ["WatchRule", "SteeringDaemon", "default_rules",
-           "counter_ratio", "counter_value", "drift_value",
-           "placement_agreement_value", "PROPOSAL_SCHEMA"]
+           "counter_ratio", "counter_value", "windowed_counter_ratio",
+           "drift_value", "placement_agreement_value",
+           "PROPOSAL_SCHEMA"]
 
 PROPOSAL_SCHEMA = "steering_proposal_v1"
 
@@ -78,6 +79,31 @@ def counter_ratio(num: str, den: str,
                 or not isinstance(d, (int, float)) or d < min_den:
             return None
         return float(n) / float(d)
+    return _get
+
+
+def windowed_counter_ratio(num: str, den: str,
+                           min_den: float = 1.0
+                           ) -> Callable[[Dict], Optional[float]]:
+    """numerator/denominator over the merged job's WINDOWED deltas
+    (``series_windows``, timeseries.py) — "waste per batch over the
+    last window", so a fresh drift is judged against the recent past
+    instead of being diluted by hours of lifetime totals. Falls back
+    to the lifetime ``counter_ratio`` when no series exist yet (old
+    dumps, sampling disabled, or fewer than two dump ticks)."""
+    lifetime = counter_ratio(num, den, min_den)
+
+    def _get(doc):
+        wins = doc.get("series_windows")
+        if isinstance(wins, dict):
+            nw, dw = wins.get(num), wins.get(den)
+            if isinstance(nw, dict) and isinstance(dw, dict):
+                nd, dd = nw.get("delta"), dw.get("delta")
+                if isinstance(nd, (int, float)) \
+                        and isinstance(dd, (int, float)) \
+                        and dd >= min_den:
+                    return float(nd) / float(dd)
+        return lifetime(doc)
     return _get
 
 
@@ -151,11 +177,13 @@ class WatchRule:
     drift."""
 
     __slots__ = ("name", "value_fn", "direction", "threshold",
-                 "floor", "steerer", "description")
+                 "floor", "steerer", "description", "objective",
+                 "ab_pairs")
 
     def __init__(self, name: str, value_fn: Callable,
                  direction: int, threshold: float, steerer: str,
-                 floor: float = 0.0, description: str = ""):
+                 floor: float = 0.0, description: str = "",
+                 objective=None, ab_pairs: Optional[int] = None):
         if direction not in (+1, -1):
             raise ValueError("direction must be +1 or -1")
         if threshold <= 0:
@@ -167,6 +195,12 @@ class WatchRule:
         self.floor = float(floor)
         self.steerer = steerer
         self.description = description
+        # per-rule canary config (ISSUE 20): a comparator.Objective
+        # (duck-typed: anything with to_dict()) and an A/B window-pair
+        # count ride the proposal artifact into run_ab_canary, so each
+        # rule can declare WHAT trade-off its plan is allowed to make
+        self.objective = objective
+        self.ab_pairs = int(ab_pairs) if ab_pairs else None
 
     def breached(self, baseline: float, observed: float) -> bool:
         if not baseline:
@@ -183,11 +217,13 @@ def default_rules() -> List[WatchRule]:
     placement agreement collapsing (cost model off the machine)."""
     return [
         WatchRule("serving_padding_waste",
-                  counter_ratio("serving.padding_waste",
-                                "serving.batches", min_den=8),
+                  windowed_counter_ratio("serving.padding_waste",
+                                         "serving.batches",
+                                         min_den=8),
                   direction=-1, threshold=0.25, floor=0.10,
                   steerer="serving_ladder",
-                  description="padded rows per dispatched batch"),
+                  description="padded rows per dispatched batch "
+                              "(last window when series exist)"),
         WatchRule("lazy_recompile_frac", recompile_frac(),
                   direction=-1, threshold=0.25, floor=0.05,
                   steerer="lazy_policy",
@@ -339,6 +375,10 @@ class SteeringDaemon:
             "created_at": time.time(),
             "poll": self.polls,
         }
+        if rule.objective is not None:
+            artifact["objective"] = rule.objective.to_dict()
+        if rule.ab_pairs:
+            artifact["ab_pairs"] = rule.ab_pairs
         path = os.path.join(self.out_dir,
                             "proposed-%s.json" % rule.steerer)
         try:

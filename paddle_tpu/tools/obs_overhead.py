@@ -191,6 +191,29 @@ def main():
     assert not _capture._counts, \
         "disabled sampling hook must not count steps"
 
+    # ISSUE 20: the windowed time-series sampler must default OFF
+    # (armed only when PADDLE_TPU_METRICS_DIR is set — which this
+    # bench refuses to run under), and its hooks must degenerate to a
+    # memoized load + branch under the same tight budget
+    from paddle_tpu.observability import timeseries as _ts
+
+    assert not _ts.series_enabled(), \
+        "time-series sampling must default off (PADDLE_TPU_METRICS_DIR"\
+        " unset)"
+    ts_chk = _bench_primitive(_ts.series_enabled)
+    ts_hook = _bench_primitive(lambda: _ts.record_samples(None))
+    ts_point = _bench_primitive(
+        lambda: _ts.record_point("bench.metric", 1.0))
+    print("time-series disabled cost: series_enabled()=%.3fus "
+          "record_samples()=%.3fus record_point()=%.3fus "
+          "(budget %.1fus each)"
+          % (ts_chk, ts_hook, ts_point, VERIFY_BUDGET_US))
+    ok = ok and ts_chk < VERIFY_BUDGET_US \
+        and ts_hook < VERIFY_BUDGET_US \
+        and ts_point < VERIFY_BUDGET_US
+    assert not _ts._store, \
+        "disabled time-series sampler must hold no series"
+
     # tiny 2-op program: measure real steps, project the per-step
     # instrumentation cost from the primitive costs above
     import numpy as np

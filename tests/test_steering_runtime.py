@@ -20,21 +20,27 @@ from paddle_tpu.observability import distributed as odist
 from paddle_tpu.observability import flight
 from paddle_tpu.observability import steering
 from paddle_tpu.observability import steering_daemon as sd_mod
+from paddle_tpu.observability import timeseries as ts_mod
 
 
 @pytest.fixture(autouse=True)
 def _clean_obs(monkeypatch):
     monkeypatch.delenv("PADDLE_TPU_SAMPLE_EVERY", raising=False)
     monkeypatch.delenv("PADDLE_TPU_METRICS_DIR", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_TIMESERIES", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_TIMESERIES_WINDOWS", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_AB_PAIRS", raising=False)
     obs.reset()
     obs.enable()
     flight.clear()
     capture_mod._reset_for_tests()
+    ts_mod._reset_for_tests()
     yield
     obs.reset()
     obs.disable()
     flight.clear()
     capture_mod._reset_for_tests()
+    ts_mod._reset_for_tests()
 
 
 # -- steering registry edge cases -------------------------------------------
@@ -767,3 +773,373 @@ def test_ps_row_load_rule_wiring():
     assert rule.steerer == ps_steering.STEERER_NAME
     assert rule.direction == -1
     assert rule.value_fn(_ps_doc()) == pytest.approx(106.0 / 16.0)
+
+
+# -- ISSUE 20: weighted multi-metric objectives -----------------------------
+
+
+def test_objective_validates():
+    with pytest.raises(ValueError):
+        comp_mod.Objective({})
+    with pytest.raises(ValueError):
+        comp_mod.Objective({"step_ms": 0.0})
+    # contradicting a WATCHED direction is a configuration bug
+    with pytest.raises(ValueError, match="conflict"):
+        comp_mod.Objective({"step_ms": 1.0},
+                           directions={"step_ms": +1})
+    # an unwatched metric needs an explicit direction
+    with pytest.raises(ValueError, match="direction"):
+        comp_mod.Objective({"my_custom": 1.0})
+    with pytest.raises(ValueError):
+        comp_mod.Objective({"my_custom": 1.0},
+                           directions={"my_custom": 2})
+    ob = comp_mod.Objective({"my_custom": 1.0},
+                            directions={"my_custom": +1})
+    assert ob.directions == {"my_custom": +1}
+
+
+def test_objective_weights_are_relative():
+    base = _rec(tokens_per_sec=100.0, step_ms=10.0)
+    head = _rec(tokens_per_sec=150.0, step_ms=20.0)
+    s2 = comp_mod.compare(base, head, objective=comp_mod.Objective(
+        {"tokens_per_sec": 2.0, "step_ms": 2.0})).objective_score
+    s1 = comp_mod.compare(base, head, objective=comp_mod.Objective(
+        {"tokens_per_sec": 1.0, "step_ms": 1.0})).objective_score
+    assert s2 == pytest.approx(s1)
+    # +50% tokens (gain +0.5) vs +100% step_ms (gain -1.0), equal
+    # weight: net -0.25
+    assert s1 == pytest.approx(-0.25)
+
+
+def test_objective_missing_metric_keeps_its_weight():
+    ob = comp_mod.Objective({"tokens_per_sec": 1.0, "mfu_est": 1.0})
+    c = comp_mod.compare(_rec(tokens_per_sec=100.0),
+                         _rec(tokens_per_sec=150.0), objective=ob)
+    res = c.objective_result()
+    missing = [t for t in res["terms"] if t.get("missing")]
+    assert [t["metric"] for t in missing] == ["mfu_est"]
+    assert missing[0]["contribution"] == 0.0
+    # the absent term still dilutes: half the single-metric score
+    solo = comp_mod.compare(
+        _rec(tokens_per_sec=100.0), _rec(tokens_per_sec=150.0),
+        objective=comp_mod.Objective({"tokens_per_sec": 1.0}))
+    assert res["score"] == pytest.approx(solo.objective_score / 2.0)
+
+
+def test_objective_noise_floor_zeroes_the_term():
+    # +1ms on step_ms sits under its 2ms ABS_NOISE_FLOOR default
+    c = comp_mod.compare(
+        _rec(step_ms=10.0), _rec(step_ms=11.0),
+        objective=comp_mod.Objective({"step_ms": 1.0}))
+    res = c.objective_result()
+    (term,) = res["terms"]
+    assert term["floored"] and term["contribution"] == 0.0
+    assert res["score"] == 0.0
+    # zero net gain is NOT an improvement
+    assert not c.ok and c.verdict == "objective_regression"
+
+
+def test_objective_promotes_bounded_regression_flat_rejects():
+    """The whole point: a net win with ONE bounded regression. The
+    flat comparator vetoes on the waste row; the weighted objective
+    trades it against the larger rows_per_s win."""
+    base = _rec(rows_per_s=1000.0, serving_padding_waste_frac=0.10)
+    head = _rec(rows_per_s=1500.0, serving_padding_waste_frac=0.30)
+    flat = comp_mod.compare(base, head)
+    assert not flat.ok and flat.verdict == "regression"
+    ob = comp_mod.Objective({"rows_per_s": 5.0,
+                             "serving_padding_waste_frac": 1.0})
+    c = comp_mod.compare(base, head, objective=ob)
+    assert c.ok and c.verdict == "objective_improved"
+    # (5/6)*0.5 - (1/6)*2.0
+    assert c.objective_score == pytest.approx(5.0 / 12 - 1.0 / 3)
+    doc = c.to_dict()["objective"]
+    assert doc["config"] == ob.to_dict()
+    assert doc["result"]["ok"]
+
+
+def test_objective_hard_floor_vetoes_unconditionally():
+    ob = comp_mod.Objective({"rows_per_s": 1.0},
+                            hard_floors={"p50_ms": 15.0})
+    c = comp_mod.compare(_rec(rows_per_s=1000.0, p50_ms=10.0),
+                         _rec(rows_per_s=2000.0, p50_ms=16.0),
+                         objective=ob)
+    assert not c.ok and c.verdict == "hard_floor"
+    (v,) = c.objective_result()["hard_floor_violations"]
+    assert v["metric"] == "p50_ms" and v["head"] == 16.0 \
+        and v["bound"] == 15.0
+    # comfortably inside the SLO: the same objective promotes
+    ok = comp_mod.compare(_rec(rows_per_s=1000.0, p50_ms=10.0),
+                          _rec(rows_per_s=2000.0, p50_ms=10.0),
+                          objective=ob)
+    assert ok.ok and ok.verdict == "objective_improved"
+
+
+def test_objective_counter_regression_still_vetoes():
+    base = {"counters_total": {"executor.compile_fallbacks": 0},
+            "extras": {"wl": {"rows_per_s": 1000.0}}}
+    head = {"counters_total": {"executor.compile_fallbacks": 3},
+            "extras": {"wl": {"rows_per_s": 2000.0}}}
+    c = comp_mod.compare(base, head, objective=comp_mod.Objective(
+        {"rows_per_s": 1.0}))
+    assert not c.ok and c.verdict == "counter_regression"
+
+
+def test_default_compare_dict_bit_compatible():
+    # no objective -> the PR 16-19 audit/gate schema, byte for byte
+    c = comp_mod.compare(_rec(tokens_per_sec=100.0),
+                         _rec(tokens_per_sec=100.0))
+    assert "objective" not in c.to_dict()
+    assert c.objective_score is None
+
+
+def test_objective_round_trips():
+    ob = comp_mod.Objective(
+        {"rows_per_s": 2.0, "my_custom": 1.0},
+        directions={"my_custom": -1},
+        floors={"rows_per_s": 10.0},
+        hard_floors={"p99_ms": 250.0})
+    assert comp_mod.Objective.from_dict(ob.to_dict()).to_dict() \
+        == ob.to_dict()
+
+
+# -- ISSUE 20: interleaved A/B canary windows -------------------------------
+
+
+_AB_OBJECTIVE = {"weights": {"rows_per_s": 1.0,
+                             "serving_padding_waste_frac": 1.0},
+                 "floors": {"serving_padding_waste_frac": 0.02}}
+
+
+def _drifting_measure(incumbent_waste, candidate_waste, drift):
+    """measure(plan_or_None) whose throughput inflates by ``drift``
+    per WINDOW regardless of the plan — the confounder interleaving
+    exists to cancel."""
+    clock = {"n": 0}
+
+    def measure(plan):
+        rec = _measure(incumbent_waste if plan is None
+                       else candidate_waste)
+        rec["extras"]["serving"]["rows_per_s"] *= \
+            (1.0 + drift) ** clock["n"]
+        clock["n"] += 1
+        return rec
+    return measure, clock
+
+
+def test_ab_canary_rejects_drift_masked_regression(tmp_path):
+    """The drill's divergence as a unit test: under monotone load
+    drift the flat before/after canary promotes a worse plan; the
+    interleaved A/B objective canary rejects the same plan."""
+    proposal = {"plan": [5, 16], "steerer": "t_ab",
+                "objective": dict(_AB_OBJECTIVE), "ab_pairs": 3}
+
+    # flat protocol vs a stale incumbent record: drift masquerades
+    # as plan improvement (+10%/window for 5 idle windows) and the
+    # 0.1 waste delta hides under the 0.15 flat noise floor
+    measure, clock = _drifting_measure(0.2, 0.3, 0.10)
+    stale = measure(None)
+    clock["n"] += 5
+    flat = canary_mod.run_canary({"plan": [5, 16], "steerer": "t_ab"},
+                                 stale, measure)
+    assert flat.promoted and flat.reason == "ok"
+
+    # interleaved: adjacent windows see the true -0.1 waste hit and
+    # barely-moved rows; every pair votes regression
+    measure, _ = _drifting_measure(0.2, 0.3, 0.10)
+    audit = canary_mod.AuditTrail(str(tmp_path))
+    dec = canary_mod.run_ab_canary(proposal, measure, audit=audit)
+    assert not dec.promoted
+    assert dec.reason == "ab_majority:0/3"
+
+    entry = audit.entries()[-1]
+    assert entry["protocol"] == canary_mod.AB_PROTOCOL
+    assert entry["decision"] == "rolled_back"
+    assert entry["pairs"] == 3 and entry["ok_pairs"] == 0
+    assert len(entry["windows"]) == 6
+    assert [w["phase"] for w in entry["windows"]] == \
+        ["incumbent", "candidate"] * 3
+    assert [w["seq"] for w in entry["windows"]] == list(range(6))
+    # the proposal's objective block was adopted and recorded
+    assert entry["objective"]["weights"] == _AB_OBJECTIVE["weights"]
+    assert entry["objective_score"] < 0
+    for pd in entry["pair_verdicts"]:
+        assert not pd["ok"]
+        assert pd["verdict"] == "objective_regression"
+        terms = {t["metric"] for t in
+                 pd["comparison"]["objective"]["result"]["terms"]}
+        assert terms == {"rows_per_s", "serving_padding_waste_frac"}
+    # every window was metered
+    assert obs.counter_value("canary.windows", phase="incumbent",
+                             steerer="t_ab") == 3
+    assert obs.counter_value("canary.windows", phase="candidate",
+                             steerer="t_ab") == 3
+    assert obs.gauge_value("steering.objective_score",
+                           steerer="t_ab") == \
+        pytest.approx(entry["objective_score"])
+
+
+def test_ab_canary_promotes_and_installs(tmp_path):
+    measure, _ = _drifting_measure(0.2, 0.05, 0.0)
+    audit = canary_mod.AuditTrail(str(tmp_path))
+    store = canary_mod.PlanStore(str(tmp_path), "t_ab")
+    calls = []
+    dec = canary_mod.run_ab_canary(
+        {"plan": [2, 4, 16], "steerer": "t_ab",
+         "objective": dict(_AB_OBJECTIVE)},
+        measure, pairs=2,
+        apply_fn=lambda p: calls.append("apply"),
+        revert_fn=lambda p: calls.append("revert"),
+        promote_fn=lambda p: calls.append("promote"),
+        plan_store=store, audit=audit)
+    assert dec.promoted and dec.reason == "ab_majority:2/2"
+    assert calls == ["revert", "apply"] * 2 + ["promote"]
+    assert store.installs == 1
+    assert store.active_digest() == dec.plan_digest
+    entry = audit.entries()[-1]
+    assert entry["decision"] == "promoted"
+    assert entry["objective_score"] > 0
+    assert len(entry["windows"]) == 4
+    fl = {k: f for _, k, f in flight.events()
+          if k == "canary.promoted"}
+    assert fl["canary.promoted"]["protocol"] == canary_mod.AB_PROTOCOL
+    assert fl["canary.promoted"]["ok_pairs"] == 2
+
+
+def test_ab_canary_hard_floor_overrides_the_vote(tmp_path):
+    def measure(plan):
+        rec = _measure(0.05 if plan is not None else 0.2)
+        rec["extras"]["serving"]["p50_ms"] = \
+            16.0 if plan is not None else 10.0
+        return rec
+    ob = comp_mod.Objective({"rows_per_s": 1.0},
+                            hard_floors={"p50_ms": 15.0})
+    dec = canary_mod.run_ab_canary({"plan": [8], "steerer": "t_ab"},
+                                   measure, pairs=3, objective=ob)
+    assert not dec.promoted and dec.reason == "ab_hard_floor"
+
+
+def test_ab_canary_min_score_demotes_majority(tmp_path):
+    measure, _ = _drifting_measure(0.2, 0.05, 0.0)
+    dec = canary_mod.run_ab_canary(
+        {"plan": [2, 16], "steerer": "t_ab",
+         "objective": dict(_AB_OBJECTIVE)},
+        measure, pairs=3, min_score=10.0)
+    assert not dec.promoted
+    assert dec.reason == "ab_no_objective_improvement"
+
+
+def test_ab_pairs_resolution(monkeypatch):
+    assert canary_mod._ab_pairs_default() == canary_mod.DEFAULT_AB_PAIRS
+    monkeypatch.setenv(canary_mod.AB_PAIRS_ENV, "5")
+    assert canary_mod._ab_pairs_default() == 5
+    monkeypatch.setenv(canary_mod.AB_PAIRS_ENV, "bogus")
+    assert canary_mod._ab_pairs_default() == canary_mod.DEFAULT_AB_PAIRS
+    monkeypatch.setenv(canary_mod.AB_PAIRS_ENV, "-3")
+    assert canary_mod._ab_pairs_default() == 1
+
+
+# -- ISSUE 20: daemon objective wiring + windowed extractors ----------------
+
+
+def test_watchrule_objective_rides_the_proposal(tmp_path):
+    ob = comp_mod.Objective({"rows_per_s": 2.0,
+                             "serving_padding_waste_frac": 1.0})
+    rule = sd_mod.WatchRule(
+        "waste", sd_mod.counter_ratio("serving.padding_waste",
+                                      "serving.batches", min_den=8),
+        direction=-1, threshold=0.25, floor=0.10,
+        steerer="t_steer", objective=ob, ab_pairs=4)
+    try:
+        steering.register_steerer("t_steer", lambda r, **c: [1, 2])
+        d = _daemon(tmp_path, rules=[rule])
+        _metrics(tmp_path, 0.2)
+        assert d.poll_once() == []
+        props = []
+        for ratio in [0.6] * 3:
+            _metrics(tmp_path, ratio)
+            props += d.poll_once()
+        assert len(props) == 1
+        assert props[0]["ab_pairs"] == 4
+        # the artifact carries a JSON objective run_ab_canary adopts
+        assert comp_mod.Objective.from_dict(
+            props[0]["objective"]).to_dict() == ob.to_dict()
+    finally:
+        steering._STEERERS.pop("t_steer", None)
+
+
+def test_windowed_counter_ratio_prefers_last_window():
+    v = sd_mod.windowed_counter_ratio("serving.padding_waste",
+                                      "serving.batches", min_den=8)
+    lifetime = {"counters_total": {"serving.padding_waste": 50.0,
+                                   "serving.batches": 100.0}}
+    assert v(lifetime) == pytest.approx(0.5)
+    windowed = dict(lifetime)
+    windowed["series_windows"] = {
+        "serving.padding_waste": {"kind": "counter", "delta": 30.0},
+        "serving.batches": {"kind": "counter", "delta": 50.0}}
+    assert v(windowed) == pytest.approx(0.6)
+    # window denominator under min_den: lifetime fallback, not None
+    starving = dict(lifetime)
+    starving["series_windows"] = {
+        "serving.padding_waste": {"kind": "counter", "delta": 1.0},
+        "serving.batches": {"kind": "counter", "delta": 2.0}}
+    assert v(starving) == pytest.approx(0.5)
+
+
+def test_default_waste_rule_is_windowed():
+    rules = {r.name: r for r in sd_mod.default_rules()}
+    v = rules["serving_padding_waste"].value_fn
+    doc = {"counters_total": {"serving.padding_waste": 10.0,
+                              "serving.batches": 100.0},
+           "series_windows": {
+               "serving.padding_waste": {"kind": "counter",
+                                         "delta": 40.0},
+               "serving.batches": {"kind": "counter",
+                                   "delta": 50.0}}}
+    # lifetime says 0.1; the last window says 0.8 — window wins
+    assert v(doc) == pytest.approx(0.8)
+
+
+# -- ISSUE 20: PS steering over windowed rates ------------------------------
+
+
+def _ps_windowed_doc():
+    return {"series_windows": {
+        "ps.row_heat{bucket=0,shard=0,table=emb}":
+            {"kind": "counter", "delta": 10.0},
+        "ps.row_heat{bucket=3,shard=1,table=emb}":
+            {"kind": "counter", "delta": 90.0},
+        "ps.apply_ms{shard=0,table=_round}#sum":
+            {"kind": "counter", "delta": 100.0},
+        "ps.apply_ms{shard=0,table=_round}#count":
+            {"kind": "counter", "delta": 10.0},
+        "ps.apply_ms{shard=1,table=_round}#sum":
+            {"kind": "counter", "delta": 400.0},
+        "ps.apply_ms{shard=1,table=_round}#count":
+            {"kind": "counter", "delta": 10.0}}}
+
+
+def test_ps_windowed_row_load_beats_lifetime():
+    doc = _ps_windowed_doc()
+    assert ps_steering.windowed_shard_row_load(doc) == \
+        {0: 10.0, 1: 90.0}
+    # lifetime counters say balanced; the last window says 9x skew
+    doc["counters_total"] = {
+        "ps.row_heat{bucket=0,shard=0,table=emb}": 500.0,
+        "ps.row_heat{bucket=3,shard=1,table=emb}": 500.0}
+    assert ps_steering.row_load_skew_value()(doc) == pytest.approx(9.0)
+    # windowed touches under min_rows: falls back to lifetime (1.0)
+    assert ps_steering.row_load_skew_value(min_rows=50)(doc) \
+        == pytest.approx(1.0)
+    assert ps_steering.windowed_shard_row_load({}) == {}
+
+
+def test_ps_windowed_apply_means():
+    doc = _ps_windowed_doc()
+    assert ps_steering.windowed_shard_apply_means(doc) == \
+        {0: 10.0, 1: 40.0}
+    assert ps_steering.apply_skew_value()(doc) == pytest.approx(4.0)
+    # below min_count per window: windowed path yields nothing and
+    # there is no lifetime histogram either -> None
+    assert ps_steering.apply_skew_value(min_count=20)(doc) is None

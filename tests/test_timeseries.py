@@ -1,0 +1,234 @@
+"""ISSUE 20: windowed time-series telemetry — arming/force-off knobs,
+the bounded ring, counter-reset clamping, job-aligned windows under
+clock skew, and the dump/merge integration (``doc["series"]`` →
+``series_windows``)."""
+import json
+import os
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import distributed as dist
+from paddle_tpu.observability import timeseries as ts
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_METRICS_DIR", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_TIMESERIES", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_TIMESERIES_WINDOWS", raising=False)
+    obs.reset()
+    obs.enable()
+    ts._reset_for_tests()
+    yield
+    obs.reset()
+    obs.disable()
+    ts._reset_for_tests()
+
+
+# -- knobs ------------------------------------------------------------------
+
+
+def test_disabled_without_metrics_dir():
+    assert not ts.series_enabled()
+    ts.record_point("a.b", 1.0)
+    assert ts.record_samples({"counters": {"x": 1}}) == 0
+    assert ts.process_series() == {}
+
+
+def test_armed_by_metrics_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_METRICS_DIR", str(tmp_path))
+    ts._reset_for_tests()
+    assert ts.series_enabled()
+    ts.record_point("a.b", 1.0, wall_ts=10.0)
+    assert ts.process_series() == {
+        "a.b": {"kind": "gauge", "points": [[10.0, 1.0]]}}
+    # non-numeric values are ignored, not stored
+    ts.record_point("a.b", "nope")
+    ts.record_point("a.b", True)
+    assert len(ts.process_series()["a.b"]["points"]) == 1
+
+
+def test_force_off_beats_the_arm(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_METRICS_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TPU_TIMESERIES", "0")
+    ts._reset_for_tests()
+    assert not ts.series_enabled()
+    assert ts.record_samples({"counters": {"x": 1}}) == 0
+
+
+def test_window_cap_parsing(monkeypatch):
+    assert ts.window_cap() == ts.DEFAULT_WINDOWS
+    ts._reset_for_tests()
+    monkeypatch.setenv("PADDLE_TPU_TIMESERIES_WINDOWS", "bogus")
+    assert ts.window_cap() == ts.DEFAULT_WINDOWS
+    ts._reset_for_tests()
+    # a delta needs two samples: the floor is 2
+    monkeypatch.setenv("PADDLE_TPU_TIMESERIES_WINDOWS", "1")
+    assert ts.window_cap() == 2
+
+
+# -- the bounded ring -------------------------------------------------------
+
+
+def test_ring_evicts_oldest_at_the_bound(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_METRICS_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TPU_TIMESERIES_WINDOWS", "4")
+    ts._reset_for_tests()
+    for i in range(10):
+        ts.record_point("c", float(i), wall_ts=float(i),
+                        kind="counter")
+    pts = ts.process_series()["c"]["points"]
+    assert pts == [[6.0, 6.0], [7.0, 7.0], [8.0, 8.0], [9.0, 9.0]]
+
+
+# -- pure window queries ----------------------------------------------------
+
+
+def test_counter_reset_clamps_at_zero():
+    # a relaunch resets the counter between t=2 and t=3: that hop
+    # contributes 0, never a negative delta
+    pts = [[1.0, 100.0], [2.0, 150.0], [3.0, 10.0], [4.0, 30.0]]
+    assert ts.counter_delta(pts) == pytest.approx(70.0)
+    assert ts.counter_delta([[1.0, 100.0], [2.0, 40.0]]) == 0.0
+    assert ts.counter_delta([[1.0, 100.0]]) is None
+    assert ts.counter_delta([]) is None
+
+
+def test_rate_and_trailing_window():
+    pts = [[0.0, 0.0], [10.0, 100.0], [20.0, 400.0]]
+    assert ts.window_span(pts) == pytest.approx(20.0)
+    assert ts.counter_rate(pts) == pytest.approx(20.0)
+    # trailing 10s window keeps only the last hop
+    assert ts.counter_delta(pts, window_s=10.0) == pytest.approx(300.0)
+    assert ts.counter_rate(pts, window_s=10.0) == pytest.approx(30.0)
+    # span 0 (one point in window after filtering): no rate
+    assert ts.counter_rate(pts, window_s=0.0) is None
+    assert ts.last_value(pts) == 400.0
+    assert ts.last_value([]) is None
+    # unordered input is sorted before the hops are walked
+    assert ts.counter_delta([[2.0, 5.0], [1.0, 3.0]]) == \
+        pytest.approx(2.0)
+
+
+def test_record_samples_ships_histograms_as_counter_pairs(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_METRICS_DIR", str(tmp_path))
+    ts._reset_for_tests()
+    snap = {"counters": {"c{x=1}": 5.0}, "gauges": {"g": 2.5},
+            "histograms": {"h{s=0}": {"count": 4, "sum": 40.0}}}
+    assert ts.record_samples(snap, wall_ts=1.0) == 3
+    snap2 = {"counters": {"c{x=1}": 9.0}, "gauges": {"g": 3.5},
+             "histograms": {"h{s=0}": {"count": 6, "sum": 100.0}}}
+    assert ts.record_samples(snap2, wall_ts=2.0) == 3
+    ser = ts.process_series()
+    assert ser["c{x=1}"]["kind"] == "counter"
+    assert ser["g"]["kind"] == "gauge"
+    assert ts.counter_delta(ser["h{s=0}#sum"]["points"]) == 60.0
+    assert ts.counter_delta(ser["h{s=0}#count"]["points"]) == 2.0
+    # windowed mean = delta(sum)/delta(count) = 30ms
+
+
+# -- job-aligned windows ----------------------------------------------------
+
+
+def _series(points, kind="counter"):
+    return {"m": {"kind": kind, "points": points}}
+
+
+def test_job_windows_rebase_skewed_rank():
+    # both ranks saw the same physical 10s interval; rank b's wall
+    # clock runs 5s ahead and its applied skew says so
+    per = {"a": _series([[100.0, 0.0], [110.0, 50.0]]),
+           "b": _series([[105.0, 0.0], [115.0, 100.0]])}
+    win = ts.job_windows(per, skews_us={"b": 5_000_000.0})["m"]
+    assert win["kind"] == "counter"
+    assert win["delta"] == pytest.approx(150.0)
+    assert win["t0"] == pytest.approx(100.0)
+    assert win["t1"] == pytest.approx(110.0)
+    assert win["rate"] == pytest.approx(15.0)
+    assert win["per_rank"]["b"]["t0"] == pytest.approx(100.0)
+    assert win["per_rank"]["b"]["delta"] == pytest.approx(100.0)
+    # without the correction the merged window smears over 15s
+    smeared = ts.job_windows(per)["m"]
+    assert smeared["t1"] == pytest.approx(115.0)
+
+
+def test_job_windows_rank_without_usable_series():
+    # one-point rank: no delta, no per_rank entry; the other rank
+    # still folds. A rank entirely absent from per_series never shows.
+    per = {"a": _series([[0.0, 0.0], [10.0, 40.0]]),
+           "b": _series([[3.0, 7.0]])}
+    win = ts.job_windows(per)["m"]
+    assert set(win["per_rank"]) == {"a"}
+    assert win["delta"] == pytest.approx(40.0)
+    # all ranks unusable: the metric is dropped, not emitted empty
+    assert ts.job_windows({"b": _series([[3.0, 7.0]])}) == {}
+    assert ts.job_windows({}) == {}
+
+
+def test_job_windows_gauges_fold_to_last_values():
+    per = {"a": _series([[0.0, 1.0], [5.0, 2.0]], kind="gauge"),
+           "b": _series([[1.0, 9.0]], kind="gauge")}
+    win = ts.job_windows(per)["m"]
+    assert win["kind"] == "gauge"
+    assert win["per_rank"] == {"a": 2.0, "b": 9.0}
+
+
+# -- dump/merge integration -------------------------------------------------
+
+
+def _dump(d, role, rank, monkeypatch):
+    monkeypatch.setenv("PADDLE_ROLE", role)
+    monkeypatch.setenv("PADDLE_TRAINER_ID", str(rank))
+    monkeypatch.setenv("PADDLE_PSERVER_INDEX", str(rank))
+    monkeypatch.setenv("PADDLE_RESTART_COUNT", "0")
+    dist._identity = None
+    return dist.dump_process(os.path.join(d, "%s-%d.json"
+                                          % (role, rank)))
+
+
+def test_dump_attaches_series_and_merge_folds(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv("PADDLE_TPU_METRICS_DIR", d)
+    ts._reset_for_tests()
+    # two dump ticks = two ring points per metric
+    obs.counter("rpc.retries", method="send").inc(3)
+    _dump(d, "trainer", 0, monkeypatch)
+    obs.counter("rpc.retries", method="send").inc(5)
+    p = _dump(d, "trainer", 0, monkeypatch)
+    doc = json.load(open(p))
+    pts = doc["series"]["rpc.retries{method=send}"]["points"]
+    assert [v for _, v in pts] == [3.0, 8.0]
+
+    # a rank whose dump predates the field contributes no windows but
+    # merges fine
+    legacy = {"schema": 1, "proc": "pserver-1", "role": "pserver",
+              "rank": 1, "restart": 0, "pid": 4242, "wrote_at": 0.0,
+              "clock_offset_us": 0.0,
+              "metrics": {"counters": {"rpc.retries{method=send}": 2}},
+              "spans": [], "flight": []}
+    with open(os.path.join(d, "pserver-1.json"), "w") as f:
+        json.dump(legacy, f)
+
+    mpath, _ = dist.merge_job_dir(d)
+    merged = json.load(open(mpath))
+    assert "series" in merged["processes"]["trainer-0"]
+    assert "series" not in merged["processes"]["pserver-1"]
+    win = merged["series_windows"]["rpc.retries{method=send}"]
+    assert win["delta"] == pytest.approx(5.0)
+    assert set(win["per_rank"]) == {"trainer-0"}
+    # lifetime totals still sum across BOTH ranks
+    assert merged["counters_total"]["rpc.retries{method=send}"] == 10
+
+
+def test_merge_without_any_series_has_no_windows(tmp_path,
+                                                 monkeypatch):
+    d = str(tmp_path)
+    # sampling off: dumps carry no series and the merged doc must not
+    # grow an empty series_windows key (old-schema compatibility)
+    obs.counter("rpc.retries", method="send").inc(1)
+    _dump(d, "trainer", 0, monkeypatch)
+    merged = json.load(open(dist.merge_job_dir(d)[0]))
+    assert "series" not in merged["processes"]["trainer-0"]
+    assert "series_windows" not in merged

@@ -35,13 +35,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from paddle_tpu.observability.comparator import (  # noqa: E402
-    ABS_NOISE_FLOOR, COUNTER_WATCH_GROWS_BAD, WATCHED, compare,
-    counter_totals, diff_counters, diff_records, load, workloads,
+    ABS_NOISE_FLOOR, COUNTER_WATCH_GROWS_BAD, WATCHED, Objective,
+    compare, counter_totals, diff_counters, diff_records, load,
+    workloads,
 )
 
 __all__ = ["WATCHED", "ABS_NOISE_FLOOR", "COUNTER_WATCH_GROWS_BAD",
-           "load", "workloads", "counter_totals", "diff_records",
-           "diff_counters", "main"]
+           "Objective", "load", "workloads", "counter_totals",
+           "diff_records", "diff_counters", "main"]
 
 
 def _fmt(v):
@@ -391,6 +392,78 @@ def _self_test():
         "diag": {"collective_bytes": 0}}}}, 0.10)
     imp = gain.improvement("tokens_per_sec")
     assert imp is not None and imp > 0.4, imp
+    # -- objective scoring (ISSUE 20) --------------------------------
+    # a plan trading a bounded latency regression for a big
+    # throughput win: the flat bar rejects it, a weighted objective
+    # promotes it — and the default (no objective) dict stays
+    # bit-compatible (no "objective" key)
+    ob0 = {"extras": {"srv": {"rows_per_s": 1000.0, "p50_ms": 10.0}}}
+    ob1 = {"extras": {"srv": {"rows_per_s": 1300.0, "p50_ms": 16.0}}}
+    flat_c = compare(ob0, ob1, 0.10)
+    assert not flat_c.ok and "p50_ms" in flat_c.regressed_metrics
+    assert "objective" not in flat_c.to_dict()
+    obj = Objective({"rows_per_s": 3.0, "p50_ms": 1.0})
+    obj_c = compare(ob0, ob1, 0.10, objective=obj)
+    assert obj_c.ok and obj_c.verdict == "objective_improved", \
+        obj_c.verdict
+    assert obj_c.objective_score is not None \
+        and obj_c.objective_score > 0
+    json.dumps(obj_c.to_dict())
+    assert "objective" in obj_c.to_dict()
+    # weight normalization: weights express only RELATIVE importance
+    rows = obj_c.rows
+    s_a = Objective({"rows_per_s": 2.0, "p50_ms": 2.0}).score_rows(
+        rows)[0]
+    s_b = Objective({"rows_per_s": 1.0, "p50_ms": 1.0}).score_rows(
+        rows)[0]
+    assert abs(s_a - s_b) < 1e-12, (s_a, s_b)
+    # missing-metric term: contributes 0 but keeps its weight in the
+    # normalization and is flagged in the provenance
+    miss = Objective({"rows_per_s": 1.0, "mfu_est": 1.0})
+    ms, mterms = miss.score_rows(rows)
+    mrow = [t for t in mterms if t["metric"] == "mfu_est"]
+    assert mrow and mrow[0]["missing"] and \
+        mrow[0]["contribution"] == 0.0, mterms
+    only = Objective({"rows_per_s": 1.0}).score_rows(rows)[0]
+    assert abs(ms - only / 2.0) < 1e-12, (ms, only)
+    # hard-floor veto: SLO bound on the HEAD value trumps any score
+    slo = Objective({"rows_per_s": 3.0, "p50_ms": 1.0},
+                    hard_floors={"p50_ms": 15.0})
+    slo_c = compare(ob0, ob1, 0.10, objective=slo)
+    assert not slo_c.ok and slo_c.verdict == "hard_floor", \
+        slo_c.verdict
+    viol = slo_c.objective_result()["hard_floor_violations"]
+    assert viol and viol[0]["metric"] == "p50_ms" \
+        and viol[0]["head"] == 16.0, viol
+    # direction conflict with WATCHED is a configuration bug;
+    # an unwatched metric demands an explicit direction
+    try:
+        Objective({"step_ms": 1.0}, directions={"step_ms": +1})
+        raise AssertionError("direction conflict not caught")
+    except ValueError:
+        pass
+    try:
+        Objective({"custom_metric": 1.0})
+        raise AssertionError("unwatched metric without direction "
+                             "not caught")
+    except ValueError:
+        pass
+    Objective({"custom_metric": 1.0},
+              directions={"custom_metric": -1})  # explicit is fine
+    # the new watched surfaces: an objective_score drop in a record
+    # flags like any watched metric, and canary.windows{phase=}
+    # counters surface (non-fatally) through the counter diff
+    os0 = {"extras": {"ab": {"objective_score": 0.5}}}
+    os1 = {"extras": {"ab": {"objective_score": 0.3}}}
+    osbad = [r for r in diff_records(os0, os1, 0.10)
+             if r[1] == "objective_score"]
+    assert osbad and osbad[0][-1], osbad
+    w0 = {"totals": {"canary.windows{phase=incumbent}": 3,
+                     "canary.windows{phase=candidate}": 3}}
+    w1 = {"totals": {"canary.windows{phase=incumbent}": 9,
+                     "canary.windows{phase=candidate}": 9}}
+    wrows = list(diff_counters(w0, w1, 0.25))
+    assert len(wrows) == 2 and not any(r[-1] for r in wrows), wrows
     print("bench_diff self-test ok")
     return 0
 

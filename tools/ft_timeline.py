@@ -43,6 +43,73 @@ _DR_KINDS = ("launch.cold_start", "ps.restore", "ps.fence_refused",
              "ps.round_durable")
 
 
+def load_ab_entries(dirname: str):
+    """Interleaved A/B canary decisions from the dir's
+    ``steering_audit.json`` (ISSUE 20): every entry tagged
+    ``protocol == "ab_interleaved"``, in append order. The window
+    stamps inside them are launcher wall-clock — the same clock every
+    flight event is rebased onto by the merge, so the A/B section and
+    the event timeline read off ONE axis."""
+    from paddle_tpu.observability import canary as _canary
+
+    trail = _canary.AuditTrail(dirname)
+    return [e for e in trail.entries()
+            if e.get("protocol") == _canary.AB_PROTOCOL]
+
+
+def format_ab_timeline(entries) -> List[str]:
+    """One block per A/B decision: header (steerer, plan digest,
+    decision, pairs won, mean objective score), then every
+    measurement window with open/close offsets relative to the
+    entry's first window, each candidate window annotated with its
+    pairwise verdict, and the last pair's objective terms."""
+    lines: List[str] = []
+    for e in entries:
+        digest = str(e.get("plan_digest") or "")[:12]
+        score = e.get("objective_score")
+        lines.append(
+            "ab #%s %s plan %s decision=%s reason=%s pairs=%s/%s%s"
+            % (e.get("seq"), e.get("steerer"), digest,
+               e.get("decision"), e.get("reason"),
+               e.get("ok_pairs"), e.get("pairs"),
+               ("" if score is None else " score=%+.4f" % score)))
+        windows = e.get("windows") or []
+        pair_docs = {p.get("pair"): p
+                     for p in (e.get("pair_verdicts") or [])}
+        t0 = windows[0].get("t_open") if windows else None
+        for w in windows:
+            tag = "A" if w.get("phase") == "incumbent" else "B"
+            try:
+                lo = float(w.get("t_open")) - float(t0)
+                hi = float(w.get("t_close")) - float(t0)
+                span = "+%.3fs..+%.3fs" % (lo, hi)
+            except (TypeError, ValueError):
+                span = "?"
+            line = ("  w%02d pair%d %s %-10s %s"
+                    % (w.get("seq", 0), w.get("pair", 0), tag,
+                       w.get("phase"), span))
+            if tag == "B":
+                p = pair_docs.get(w.get("pair"))
+                if p:
+                    ps = p.get("objective_score")
+                    line += "  verdict=%s%s" % (
+                        p.get("verdict"),
+                        "" if ps is None else " score=%+.4f" % ps)
+            lines.append(line)
+        last = (e.get("pair_verdicts") or [{}])[-1]
+        terms = (((last.get("comparison") or {}).get("objective")
+                  or {}).get("result") or {}).get("terms") or []
+        if terms:
+            lines.append("  objective: " + " | ".join(
+                "%s w=%.2f gain=%+.4f%s"
+                % (t.get("metric"), t.get("weight", 0.0),
+                   t.get("gain", 0.0),
+                   " (floored)" if t.get("floored")
+                   else (" (missing)" if t.get("missing") else ""))
+                for t in terms))
+    return lines
+
+
 def load_events(dirname: str) -> List[Dict]:
     """Every flight event from every per-process dump under
     ``dirname`` — ALL job incarnations (a total-loss postmortem needs
@@ -153,6 +220,15 @@ def print_postmortem(dirname: str, show_frames: bool = False,
         lines = lines[-limit:]
     for ln in lines:
         print(ln, file=out)
+    # interleaved A/B canary decisions (ISSUE 20), when this job dir
+    # doubles as the steering audit dir: window-by-window story of
+    # every promote/rollback, on the same wall clock as the events
+    ab = load_ab_entries(dirname)
+    if ab:
+        print("== A/B canary windows (%d decision(s)) ==" % len(ab),
+              file=out)
+        for ln in format_ab_timeline(ab):
+            print(ln, file=out)
     if mpath:
         print("merged: %s + %s" % (mpath, tpath), file=out)
     return len(lines)

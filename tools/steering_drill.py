@@ -392,22 +392,206 @@ def leg_canary(proposal, trace, workdir: str) -> None:
            "trace.json", ok, "found=%s" % sorted(instants))
 
 
+# -- leg 4 (--drift): drifting load vs interleaved A/B objective ------------
+
+def leg_drifting_load(rng, workdir: str) -> None:
+    """Seeded drifting-load scenario (ISSUE 20). Closed-loop serving
+    throughput drifts UP +4% per measurement window — the box is
+    warming up, traffic is ramping, nobody changed a plan. A
+    candidate ladder that is objectively WORSE (more padding, lower
+    true throughput, but each delta under the flat comparator's
+    absolute noise floors) is canaried two ways:
+
+    - the legacy flat ``run_canary`` against a STALE incumbent record
+      (measured 11 drift windows earlier) PROMOTES it — accumulated
+      drift masquerades as a +40% throughput win and no flat
+      threshold catches the real regressions;
+    - the interleaved A/B objective canary measures incumbent and
+      candidate in ADJACENT windows, so drift contributes at most one
+      window (+4%) to each pairwise delta while the true effect
+      (-6.4% rows/s, +23% waste) dominates the weighted score: every
+      pair votes regression, 0/N, ROLL BACK.
+
+    The same A/B canary then PROMOTES a genuinely-better plan (the
+    quantile ladder) in the same run, proving the protocol is not
+    just "reject everything under drift". Every window, pairwise
+    verdict, and objective term is asserted present in
+    ``steering_audit.json``."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import canary, comparator, steering
+    from paddle_tpu.serving.batcher import default_ladder, plan_ladder
+
+    import ft_timeline
+
+    ddir = os.path.join(workdir, "drift")
+    os.makedirs(ddir, exist_ok=True)
+    obs.enable()
+
+    trace = np.concatenate([
+        rng.integers(3, 5, 60), rng.integers(11, 14, 40)])
+    rng.shuffle(trace)
+    trace = [int(r) for r in trace]
+
+    incumbent_ladder = default_ladder(16)      # (1, 2, 4, 8, 16)
+    bad_plan = (5, 16)   # slightly worse everywhere, each delta
+    #                      under the flat absolute noise floors
+    good_plan = plan_ladder(16, trace)         # fitted quantile ladder
+
+    true_inc = _measure_ladder(incumbent_ladder, trace)
+    true_bad = _measure_ladder(bad_plan, trace)
+    true_good = _measure_ladder(good_plan, trace)
+
+    def _w(rec):
+        return rec["extras"]["serving"]["serving_padding_waste_frac"]
+
+    _check("drift: candidate plans bracket the incumbent (ground "
+           "truth, no drift)",
+           _w(true_bad) > _w(true_inc) > _w(true_good),
+           "waste bad=%.3f inc=%.3f good=%.3f"
+           % (_w(true_bad), _w(true_inc), _w(true_good)))
+    _check("drift: bad plan hides under the flat noise floor",
+           0 < _w(true_bad) - _w(true_inc)
+           < comparator.ABS_NOISE_FLOOR["serving_padding_waste_frac"],
+           "delta=%.3f floor=%.2f"
+           % (_w(true_bad) - _w(true_inc),
+              comparator.ABS_NOISE_FLOOR["serving_padding_waste_frac"]))
+
+    # monotone load drift: throughput inflates +4% per window, no
+    # matter whose plan is being measured
+    DRIFT = 0.04
+    clock = {"win": 0}
+
+    def measure(plan):
+        ladder = tuple(plan) if plan is not None else incumbent_ladder
+        rec = _measure_ladder(ladder, trace)
+        srv = rec["extras"]["serving"]
+        srv["rows_per_s"] *= (1.0 + DRIFT) ** clock["win"]
+        clock["win"] += 1
+        return rec
+
+    objective = comparator.Objective(
+        {"rows_per_s": 2.0, "serving_padding_waste_frac": 1.0},
+        floors={"serving_padding_waste_frac": 0.02})
+
+    def _proposal(plan, with_objective=True):
+        art = {"plan": list(plan),
+               "plan_digest": steering.plan_digest(list(plan)),
+               "steerer": "serving_ladder",
+               "metric": "serving_padding_waste"}
+        if with_objective:
+            # the shape WatchRule(objective=, ab_pairs=) emits
+            art["objective"] = objective.to_dict()
+            art["ab_pairs"] = 3
+        return art
+
+    # -- the cautionary tale: flat canary on a stale incumbent -------
+    flat_dir = os.path.join(ddir, "flat")
+    os.makedirs(flat_dir, exist_ok=True)
+    incumbent_rec = measure(None)       # window 0
+    clock["win"] += 10                  # proposal sits unactioned
+    flat = canary.run_canary(
+        _proposal(bad_plan, with_objective=False),  # legacy protocol
+        incumbent_rec, measure,
+        plan_store=canary.PlanStore(flat_dir, "serving_ladder"),
+        audit=canary.AuditTrail(flat_dir),
+        require_improvement="rows_per_s", min_improvement=0.05)
+    _check("drift: FLAT comparator PROMOTES the objectively-worse "
+           "plan (drift masquerades as a win)", flat.promoted,
+           "decision=%s reason=%s" % (flat.decision, flat.reason))
+
+    # -- the fix: interleaved A/B windows + weighted objective -------
+    ab_dir = os.path.join(ddir, "ab")
+    os.makedirs(ab_dir, exist_ok=True)
+    audit = canary.AuditTrail(ab_dir)
+    store = canary.PlanStore(ab_dir, "serving_ladder")
+    bad = canary.run_ab_canary(_proposal(bad_plan), measure,
+                               audit=audit, plan_store=store)
+    _check("drift: A/B objective canary ROLLS BACK the same plan "
+           "under the same drift", not bad.promoted,
+           "reason=%s score=%s"
+           % (bad.reason, bad.audit_entry.get("objective_score")))
+
+    good = canary.run_ab_canary(_proposal(good_plan), measure,
+                                audit=audit, plan_store=store)
+    _check("drift: A/B objective canary PROMOTES the genuinely-"
+           "better plan in the same run", good.promoted,
+           "reason=%s score=%s"
+           % (good.reason, good.audit_entry.get("objective_score")))
+    _check("drift: only the good plan is installed",
+           store.installs == 1 and store.active_digest()
+           == steering.plan_digest(list(good_plan)))
+
+    # -- audit closure: windows, pairwise verdicts, objective terms --
+    entries = [e for e in audit.entries()
+               if e.get("protocol") == canary.AB_PROTOCOL]
+    ok = len(entries) == 2
+    for e in entries:
+        ok = (ok and len(e.get("windows") or []) == 2 * e["pairs"]
+              and len(e.get("pair_verdicts") or []) == e["pairs"]
+              and all(w.get("t_close") >= w.get("t_open")
+                      and w.get("phase") in ("incumbent", "candidate")
+                      for w in e["windows"])
+              and all(isinstance(p.get("objective_score"), float)
+                      and (p.get("comparison") or {}).get("objective")
+                      for p in e["pair_verdicts"])
+              and isinstance(e.get("objective_score"), float)
+              and isinstance(e.get("objective"), dict))
+        for p in (e.get("pair_verdicts") or []):
+            terms = ((p["comparison"]["objective"].get("result")
+                      or {}).get("terms")) or []
+            ok = ok and {t["metric"] for t in terms} == {
+                "rows_per_s", "serving_padding_waste_frac"}
+    _check("drift: every window, pairwise verdict and objective term "
+           "is on the audit trail", ok,
+           "ab_entries=%d" % len(entries))
+
+    exp_windows = sum(e["pairs"] for e in entries)
+    _check("drift: canary.windows{phase=} counters",
+           obs.counter_value("canary.windows", phase="incumbent",
+                             steerer="serving_ladder") == exp_windows
+           and obs.counter_value("canary.windows", phase="candidate",
+                                 steerer="serving_ladder")
+           == exp_windows)
+    _check("drift: steering.objective_score gauge follows the last "
+           "decision", obs.gauge_value(
+               "steering.objective_score",
+               steerer="serving_ladder") > 0)
+
+    # the human-readable read of the same trail (satellite: ft_timeline)
+    lines = ft_timeline.format_ab_timeline(
+        ft_timeline.load_ab_entries(ab_dir))
+    for ln in lines:
+        print("[steer]   %s" % ln)
+    _check("drift: ft_timeline renders the A/B window timeline",
+           sum(1 for ln in lines if ln.lstrip().startswith("ab #")) == 2
+           and any("verdict=objective_regression" in ln for ln in lines)
+           and any("verdict=objective_improved" in ln for ln in lines)
+           and any(ln.lstrip().startswith("objective:")
+                   for ln in lines))
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--drift", action="store_true",
+                    help="run ONLY the seeded drifting-load A/B leg "
+                         "(ISSUE 20 CI gate variant)")
     args = ap.parse_args()
     rng = np.random.default_rng(args.seed)
 
     with tempfile.TemporaryDirectory(prefix="steer_drill_") as workdir:
         saved = os.environ.get("PADDLE_TPU_METRICS_DIR")
         try:
-            leg_sampled_capture(rng, workdir)
-            proposal, trace = leg_daemon_hysteresis(rng, workdir)
-            if proposal is None:
-                _check("canary: skipped — daemon emitted no proposal",
-                       False)
+            if args.drift:
+                leg_drifting_load(rng, workdir)
             else:
-                leg_canary(proposal, trace, workdir)
+                leg_sampled_capture(rng, workdir)
+                proposal, trace = leg_daemon_hysteresis(rng, workdir)
+                if proposal is None:
+                    _check("canary: skipped — daemon emitted no "
+                           "proposal", False)
+                else:
+                    leg_canary(proposal, trace, workdir)
         finally:
             if saved is None:
                 os.environ.pop("PADDLE_TPU_METRICS_DIR", None)
